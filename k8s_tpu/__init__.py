@@ -1,0 +1,37 @@
+"""k8s_tpu — a TPU-native training-job operator and SPMD launcher stack.
+
+A ground-up rebuild of the capabilities of the kubeflow/tf-operator snapshot
+(reference layer map in SURVEY.md §1): a ``TFJob`` custom resource plus
+controllers that reconcile distributed training jobs on Kubernetes — redesigned
+for Cloud TPU pod slices.  The TF1 parameter-server/gRPC world (TF_CONFIG env,
+per-replica headless services) is replaced by a JAX/XLA multi-host SPMD model:
+the operator provisions gang-scheduled slice workers, injects
+``JAX_COORDINATOR_ADDRESS``/``TPU_WORKER_ID`` bootstrap env, and the in-pod
+launcher brings up ``jax.distributed`` + a device mesh with XLA collectives
+over ICI/DCN.
+
+Layout (cf. SURVEY.md §2 component inventory):
+
+- ``k8s_tpu.api``            — CRD schema: types, defaults, validation, helpers
+                               (reference: pkg/apis/tensorflow/)
+- ``k8s_tpu.client``         — REST client, typed clientset, informers, listers
+                               and in-memory fakes (reference: pkg/client/)
+- ``k8s_tpu.controller``     — v1 "trainer" reconciler: stateful TrainingJob
+                               state machine (reference: pkg/controller, pkg/trainer)
+- ``k8s_tpu.controller_v2``  — v2 stateless informer/expectations reconciler
+                               (reference: pkg/controller.v2/)
+- ``k8s_tpu.util``           — workqueue, exit-code policy, leader election,
+                               signals (reference: pkg/util/)
+- ``k8s_tpu.launcher``       — in-pod runtime: env → jax.distributed → Mesh
+                               (replaces the TF_CONFIG/tf.train.Server contract)
+- ``k8s_tpu.parallel``       — mesh axes, sharding rules, ring attention,
+                               collective helpers (dp/fsdp/tp/sp/ep)
+- ``k8s_tpu.models``         — workloads: ResNet-50, dist-mnist, transformer
+                               (reference: examples/tf_sample, test/e2e/dist-mnist)
+- ``k8s_tpu.ops``            — Pallas TPU kernels for hot ops
+- ``k8s_tpu.cmd``            — operator entrypoints (reference: cmd/)
+- ``k8s_tpu.dashboard``      — REST API + SPA (reference: dashboard/)
+- ``k8s_tpu.harness``        — CI/test/release harness (reference: py/)
+"""
+
+from k8s_tpu.version import __version__  # noqa: F401

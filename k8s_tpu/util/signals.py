@@ -1,0 +1,35 @@
+"""Signal handling (reference: pkg/util/signals/signal.go:29).
+
+``setup_signal_handler`` returns a ``threading.Event`` that is set on the
+first SIGINT/SIGTERM; a second signal hard-exits with code 1, mirroring the
+reference's double-signal contract (signal.go:36-43).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+_only_one = threading.Lock()
+_installed = False
+
+
+def setup_signal_handler() -> threading.Event:
+    """Install SIGINT/SIGTERM handler; may only be called once per process."""
+    global _installed
+    if not _only_one.acquire(blocking=False) or _installed:
+        raise RuntimeError("setup_signal_handler called twice")
+    _installed = True
+    _only_one.release()
+
+    stop = threading.Event()
+
+    def _handler(signum, frame):  # noqa: ARG001
+        if stop.is_set():
+            os._exit(1)  # second signal: exit directly (signal.go:40-42)
+        stop.set()
+
+    signal.signal(signal.SIGINT, _handler)
+    signal.signal(signal.SIGTERM, _handler)
+    return stop

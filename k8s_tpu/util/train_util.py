@@ -1,0 +1,55 @@
+"""Exit-code retryability policy (reference: pkg/util/train/train_util.go:18-53).
+
+This is the contract between user training code and the operator's restart
+logic.  The classification below mirrors the reference table and extends it
+with the TPU-preemption reality: Cloud TPU preemptions surface to the workload
+as SIGTERM (exit 143), which the reference already classed retryable — the
+rebuild keeps that and treats it as the primary preemption signal
+(SURVEY.md §5 "Failure detection").
+
+Permanent (do not retry):
+  1   general error            (train_util.go:21-24)
+  2   misuse of shell builtin
+  126 command not executable
+  127 command not found
+  128 invalid exit argument
+  139 SIGSEGV
+
+Retryable:
+  130 SIGINT                   (train_util.go:32-43)
+  137 SIGKILL  (often the OS OOM-killer or forced preemption)
+  143 SIGTERM  (graceful preemption — the normal TPU-preemption path)
+  138 reserved for user-defined retryable errors (train_util.go:45-48)
+
+Anything else is "unknown" and treated as permanent by callers
+(pkg/trainer/replicas.go:347-359 maps unknown codes to failure).
+"""
+
+from __future__ import annotations
+
+PERMANENT_EXIT_CODES = frozenset({1, 2, 126, 127, 128, 139})
+RETRYABLE_EXIT_CODES = frozenset({130, 137, 143, 138})
+
+# v1alpha2 RestartPolicyExitCode contract (pkg/apis/tensorflow/v1alpha2/
+# types.go:86-92): 1-127 permanent, 128-255 retryable.  Enforcement was a TODO
+# in the reference (controller_pod.go:149); implemented here.
+_EXITCODE_POLICY_RETRYABLE_MIN = 128
+
+
+def is_retryable_exit_code(exit_code: int) -> bool:
+    """Reference semantics (train_util.go:18-53): explicit-list classification."""
+    return exit_code in RETRYABLE_EXIT_CODES
+
+
+def is_permanent_exit_code(exit_code: int) -> bool:
+    return exit_code in PERMANENT_EXIT_CODES
+
+
+def is_retryable_under_exit_code_policy(exit_code: int) -> bool:
+    """RestartPolicy=ExitCode classification (v1alpha2/types.go:86-92).
+
+    1-127: permanent failure — do not restart.
+    128-255: retryable (signal-caused or user-defined retryable).
+    0 is success and not a restart candidate at all.
+    """
+    return exit_code >= _EXITCODE_POLICY_RETRYABLE_MIN

@@ -1,0 +1,54 @@
+"""General helpers (reference: pkg/util/util.go).
+
+- ``pformat`` — pretty-print any object as indented JSON for log lines
+  (pkg/util/util.go:33-48).
+- ``rand_string`` — DNS-safe random lowercase string used as a job RuntimeId
+  (pkg/util/util.go:59-66).
+- ``get_namespace`` — operator namespace from env (pkg/util/util.go:27-31).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import string
+
+# Env var naming kept from the reference (pkg/util/util.go:29,
+# pkg/apis/tensorflow/v1alpha2/constants.go:19) so existing deployment
+# manifests keep working.
+ENV_KUBEFLOW_NAMESPACE = "KUBEFLOW_NAMESPACE"
+
+_DNS_SAFE = string.ascii_lowercase  # no digits first-char hazards, DNS-1035 safe
+
+
+def _jsonable(obj):
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    if hasattr(obj, "to_dict"):
+        return obj.to_dict()
+    return str(obj)
+
+
+def pformat(obj) -> str:
+    """Pretty-format an object as indented JSON (pkg/util/util.go:33-48)."""
+    try:
+        return json.dumps(obj, indent=2, sort_keys=True, default=_jsonable)
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def rand_string(n: int, rng: random.Random | None = None) -> str:
+    """Random lowercase ascii string of length ``n`` (pkg/util/util.go:59-66).
+
+    Used for job RuntimeIds that end up in pod/service DNS names, hence
+    restricted to DNS-safe lowercase letters.
+    """
+    r = rng or random
+    return "".join(r.choice(_DNS_SAFE) for _ in range(n))
+
+
+def get_namespace(default: str = "default") -> str:
+    """Operator namespace from KUBEFLOW_NAMESPACE env (pkg/util/util.go:27-31)."""
+    return os.environ.get(ENV_KUBEFLOW_NAMESPACE) or default

@@ -1,0 +1,221 @@
+"""Controller workqueue with client-go semantics.
+
+The reference controllers (pkg/controller/controller.go:122-126,
+pkg/controller.v2/controller.go:165-170) rely on the behavior of
+k8s.io/client-go/util/workqueue:
+
+- **Dedup**: an item added while already queued is coalesced; an item added
+  while being *processed* is re-queued only after ``done()`` is called, so one
+  key is never handled by two workers concurrently (this is the concurrency
+  model the reference leans on — pkg/controller/controller.go:77-95).
+- **Rate limiting**: per-item exponential backoff (5 ms → 1000 s) combined
+  with an overall token bucket (10 qps, burst 100); the max of the two delays
+  wins (controller.go:122-126).
+- **Delaying**: ``add_after`` for the periodic re-reconcile loop.
+
+Implemented with condition variables; workers block in ``get()`` like Go's
+``queue.Get()``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Hashable, Optional
+
+
+class ItemExponentialFailureRateLimiter:
+    """Per-item exponential backoff: base*2^failures capped at max_delay.
+
+    Mirrors workqueue.NewItemExponentialFailureRateLimiter(5ms, 1000s) as used
+    at pkg/controller/controller.go:123.
+    """
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: dict[Hashable, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable) -> float:
+        with self._lock:
+            failures = self._failures.get(item, 0)
+            self._failures[item] = failures + 1
+        return min(self.base_delay * (2**failures), self.max_delay)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class BucketRateLimiter:
+    """Overall token bucket (qps, burst) — workqueue.BucketRateLimiter.
+
+    Matches rate.NewLimiter(rate.Limit(10), 100) from controller.go:125.
+    """
+
+    def __init__(self, qps: float = 10.0, burst: int = 100):
+        self.qps = qps
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            self._tokens -= 1.0
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.qps
+
+    def forget(self, item: Hashable) -> None:  # token buckets don't track items
+        pass
+
+    def num_requeues(self, item: Hashable) -> int:
+        return 0
+
+
+class MaxOfRateLimiter:
+    """Worst (longest) delay of the child limiters — workqueue.MaxOfRateLimiter."""
+
+    def __init__(self, *limiters):
+        self.limiters = limiters
+
+    def when(self, item: Hashable) -> float:
+        return max(l.when(item) for l in self.limiters)
+
+    def forget(self, item: Hashable) -> None:
+        for l in self.limiters:
+            l.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return max(l.num_requeues(item) for l in self.limiters)
+
+
+def default_controller_rate_limiter() -> MaxOfRateLimiter:
+    """workqueue.DefaultControllerRateLimiter as configured in the reference."""
+    return MaxOfRateLimiter(
+        ItemExponentialFailureRateLimiter(0.005, 1000.0),
+        BucketRateLimiter(10.0, 100),
+    )
+
+
+class WorkQueue:
+    """FIFO queue with client-go dirty/processing dedup semantics."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue: list[Any] = []
+        self._dirty: set[Any] = set()
+        self._processing: set[Any] = set()
+        self._shutting_down = False
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutting_down or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None):
+        """Block for the next item.  Returns (item, shutdown) like Go's Get.
+
+        A ``timeout`` (used by tests) returns (None, False) on expiry.
+        """
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue and not self._shutting_down:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None, False
+                self._cond.wait(remaining)
+            if not self._queue:
+                return None, True
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item, False
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    def shutting_down(self) -> bool:
+        with self._cond:
+            return self._shutting_down
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+
+class DelayingQueue(WorkQueue):
+    """WorkQueue + add_after, via a background timer thread."""
+
+    def __init__(self):
+        super().__init__()
+        self._heap: list[tuple[float, int, Any]] = []
+        self._seq = 0
+        self._timer_cond = threading.Condition()
+        self._timer = threading.Thread(target=self._loop, daemon=True)
+        self._timer.start()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._timer_cond:
+            self._seq += 1
+            heapq.heappush(self._heap, (time.monotonic() + delay, self._seq, item))
+            self._timer_cond.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._timer_cond:
+                if self.shutting_down():
+                    return
+                if not self._heap:
+                    self._timer_cond.wait(0.05)
+                    continue
+                when, _, item = self._heap[0]
+                now = time.monotonic()
+                if when > now:
+                    self._timer_cond.wait(min(when - now, 0.05))
+                    continue
+                heapq.heappop(self._heap)
+            self.add(item)
+
+
+class RateLimitingQueue(DelayingQueue):
+    """DelayingQueue + rate limiter — workqueue.NewRateLimitingQueue."""
+
+    def __init__(self, rate_limiter=None):
+        super().__init__()
+        self.rate_limiter = rate_limiter or default_controller_rate_limiter()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: Hashable) -> None:
+        self.rate_limiter.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self.rate_limiter.num_requeues(item)

@@ -1,0 +1,27 @@
+"""Version information (reference: pkg/version/version.go:22-43)."""
+
+import subprocess
+
+__version__ = "0.1.0-alpha"
+
+
+def git_sha() -> str:
+    """Best-effort git SHA of the working tree, "unknown" outside a checkout."""
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True,
+                text=True,
+                timeout=5,
+                check=True,
+            ).stdout.strip()
+            or "unknown"
+        )
+    except (OSError, subprocess.SubprocessError):
+        return "unknown"
+
+
+def print_version(program: str) -> None:
+    """Print program version + git SHA, like pkg/version/version.go:34-43."""
+    print(f"{program} version {__version__} (git: {git_sha()})")

@@ -1,0 +1,39 @@
+"""Exit-code policy tests (reference: pkg/trainer/training_test.go:33-117 table)."""
+
+import pytest
+
+from k8s_tpu.util import train_util
+
+
+@pytest.mark.parametrize(
+    "code,retryable",
+    [
+        (1, False),
+        (2, False),
+        (3, False),  # unknown → not retryable
+        (126, False),
+        (127, False),
+        (128, False),
+        (130, True),
+        (137, True),
+        (138, True),
+        (139, False),
+        (143, True),
+        (0, False),
+    ],
+)
+def test_is_retryable_exit_code(code, retryable):
+    assert train_util.is_retryable_exit_code(code) == retryable
+
+
+@pytest.mark.parametrize(
+    "code,retryable",
+    [(1, False), (127, False), (128, True), (130, True), (143, True), (255, True)],
+)
+def test_exit_code_policy(code, retryable):
+    """RestartPolicy=ExitCode: 1-127 permanent, 128-255 retryable (v1alpha2/types.go:86-92)."""
+    assert train_util.is_retryable_under_exit_code_policy(code) == retryable
+
+
+def test_permanent_and_retryable_disjoint():
+    assert not (train_util.PERMANENT_EXIT_CODES & train_util.RETRYABLE_EXIT_CODES)

@@ -1,0 +1,94 @@
+"""Workqueue semantics tests (dedup, processing re-add, rate limiting)."""
+
+import threading
+import time
+
+from k8s_tpu.util.workqueue import (
+    BucketRateLimiter,
+    ItemExponentialFailureRateLimiter,
+    RateLimitingQueue,
+    WorkQueue,
+)
+
+
+def test_dedup_while_queued():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    assert len(q) == 1
+
+
+def test_readd_while_processing_requeues_after_done():
+    q = WorkQueue()
+    q.add("a")
+    item, _ = q.get()
+    assert item == "a"
+    q.add("a")  # while processing: goes dirty, not queued
+    assert len(q) == 0
+    q.done("a")
+    assert len(q) == 1
+    item, _ = q.get(timeout=1)
+    assert item == "a"
+
+
+def test_shutdown_unblocks_getters():
+    q = WorkQueue()
+    results = []
+
+    def worker():
+        results.append(q.get())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    q.shut_down()
+    t.join(timeout=2)
+    assert results == [(None, True)]
+
+
+def test_exponential_limiter_backoff_and_forget():
+    rl = ItemExponentialFailureRateLimiter(0.005, 1000.0)
+    assert rl.when("x") == 0.005
+    assert rl.when("x") == 0.01
+    assert rl.when("x") == 0.02
+    assert rl.num_requeues("x") == 3
+    rl.forget("x")
+    assert rl.when("x") == 0.005
+
+
+def test_bucket_limiter_burst_then_throttle():
+    rl = BucketRateLimiter(qps=10.0, burst=3)
+    assert rl.when("a") == 0.0
+    assert rl.when("a") == 0.0
+    assert rl.when("a") == 0.0
+    assert rl.when("a") > 0.0
+
+
+def test_rate_limited_requeue_delivers():
+    q = RateLimitingQueue()
+    q.add_rate_limited("k")
+    item, shutdown = q.get(timeout=2)
+    assert item == "k" and not shutdown
+    q.done("k")
+    q.forget("k")
+    assert q.num_requeues("k") == 0
+    q.shut_down()
+
+
+def test_add_after_orders_by_time():
+    q = RateLimitingQueue()
+    q.add_after("late", 0.2)
+    q.add_after("early", 0.01)
+    first, _ = q.get(timeout=2)
+    q.done(first)
+    second, _ = q.get(timeout=2)
+    assert (first, second) == ("early", "late")
+    q.shut_down()
+
+
+def test_rand_string_and_pformat():
+    from k8s_tpu.util.util import pformat, rand_string
+
+    s = rand_string(4)
+    assert len(s) == 4 and s.islower() and s.isalpha()
+    assert '"a": 1' in pformat({"a": 1})
