@@ -1,0 +1,1 @@
+"""TFJob CRD API layer (reference: pkg/apis/tensorflow/)."""

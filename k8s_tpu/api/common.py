@@ -1,0 +1,41 @@
+"""Version-neutral API types shared by v1alpha1 and v1alpha2."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+@dataclass
+class TPUSpec:
+    """Slice topology for TPU worker gangs (TPU-native addition; cf.
+    BASELINE.json north_star).  ``accelerator_type`` is the Cloud TPU type
+    (e.g. ``v5litepod-16``); ``topology`` the chip layout (e.g. ``4x4``);
+    ``num_slices`` > 1 enables multi-slice (DCN) jobs."""
+
+    accelerator_type: str = ""
+    topology: str = ""
+    num_slices: int = 1
+    runtime_version: str = ""
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.accelerator_type:
+            d["acceleratorType"] = self.accelerator_type
+        if self.topology:
+            d["topology"] = self.topology
+        if self.num_slices != 1:
+            d["numSlices"] = self.num_slices
+        if self.runtime_version:
+            d["runtimeVersion"] = self.runtime_version
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "TPUSpec":
+        d = d or {}
+        return cls(
+            accelerator_type=d.get("acceleratorType", ""),
+            topology=d.get("topology", ""),
+            num_slices=int(d.get("numSlices", 1)),
+            runtime_version=d.get("runtimeVersion", ""),
+        )

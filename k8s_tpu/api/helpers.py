@@ -1,0 +1,76 @@
+"""API helpers (reference: pkg/apis/tensorflow/helper/helpers.go)."""
+
+from __future__ import annotations
+
+from k8s_tpu.api.meta import OwnerReference
+from k8s_tpu.api.v1alpha1 import types as v1
+
+
+def as_owner(tfjob) -> OwnerReference:
+    """Controller OwnerReference for resources owned by a TFJob
+    (helpers.go:36-48).  Works for either API version."""
+    return OwnerReference(
+        api_version=tfjob.api_version,
+        kind=tfjob.kind,
+        name=tfjob.metadata.name,
+        uid=tfjob.metadata.uid,
+        controller=True,
+        block_owner_deletion=True,
+    )
+
+
+def crd_name() -> str:
+    """`tfjobs.kubeflow.org` (helpers.go:114-116)."""
+    return f"{v1.CRD_KIND_PLURAL}.{v1.CRD_GROUP}"
+
+
+def configure_accelerators_for_tfjob_spec(
+    spec: v1.TFJobSpec, accelerators: dict[str, v1.AcceleratorConfig]
+) -> None:
+    """ConfigureAcceleratorsForTFJobSpec (helpers.go:50-104): for each replica's
+    `tensorflow` container, if a resource limit/request name matches a
+    configured accelerator, inject its host-path volumes + env vars.
+
+    Kept for GPU-manifest compatibility.  TPU slice hosts need no driver
+    mounts — their topology config travels via env (launcher contract), so
+    `cloud-tpus.google.com/*` limits typically have no AcceleratorConfig
+    entry.
+    """
+    for r in spec.replica_specs:
+        if r.template is None:
+            raise ValueError(f"Replica is missing Template; {r}")
+        pod_spec = r.template.setdefault("spec", {})
+        for c in pod_spec.get("containers") or []:
+            if c.get("name") != v1.DEFAULT_TF_CONTAINER:
+                continue
+            resources = c.get("resources") or {}
+            matched: dict[str, v1.AcceleratorConfig] = {}
+            for res_list in (resources.get("limits"), resources.get("requests")):
+                for name in res_list or {}:
+                    if name in accelerators:
+                        matched[name] = accelerators[name]
+            for config in matched.values():
+                for vol in config.volumes:
+                    pod_spec.setdefault("volumes", []).append(
+                        {"name": vol.name, "hostPath": {"path": vol.host_path}}
+                    )
+                    c.setdefault("volumeMounts", []).append(
+                        {"name": vol.name, "mountPath": vol.mount_path}
+                    )
+                for env_var in config.env_vars:
+                    c.setdefault("env", []).append(
+                        {"name": env_var.name, "value": env_var.value}
+                    )
+            break
+
+
+def tpu_chips_per_host(template: dict) -> int:
+    """Total `cloud-tpus.google.com/*` chips requested by the pod template's
+    containers — the TPU analogue of reading the nvidia.com/gpu limit."""
+    total = 0
+    for c in ((template.get("spec") or {}).get("containers")) or []:
+        limits = ((c.get("resources") or {}).get("limits")) or {}
+        for name, qty in limits.items():
+            if name.startswith("cloud-tpus.google.com/"):
+                total += int(qty)
+    return total

@@ -1,0 +1,123 @@
+"""Object metadata machinery (the slice of k8s.io/apimachinery the API needs).
+
+Kubernetes resources other than the TFJob CRD itself (pods, services,
+pod-disruption budgets, pod templates) are handled throughout this codebase as
+**unstructured dicts** in wire format (camelCase JSON) — the same choice the
+reference converged on for CRDs (pkg/util/unstructured/informer.go, motivated
+by kubeflow/tf-operator#561).  Only the TFJob types are strongly typed; this
+module provides the shared ObjectMeta/OwnerReference dataclasses they embed.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Any, Optional
+
+
+def now_rfc3339() -> str:
+    """Current UTC time in the RFC3339 second-resolution form K8s uses."""
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+@dataclass
+class OwnerReference:
+    """metav1.OwnerReference."""
+
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "name": self.name,
+            "uid": self.uid,
+        }
+        if self.controller is not None:
+            d["controller"] = self.controller
+        if self.block_owner_deletion is not None:
+            d["blockOwnerDeletion"] = self.block_owner_deletion
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OwnerReference":
+        return cls(
+            api_version=d.get("apiVersion", ""),
+            kind=d.get("kind", ""),
+            name=d.get("name", ""),
+            uid=d.get("uid", ""),
+            controller=d.get("controller"),
+            block_owner_deletion=d.get("blockOwnerDeletion"),
+        )
+
+
+@dataclass
+class ObjectMeta:
+    """metav1.ObjectMeta — the subset the operator reads and writes."""
+
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    creation_timestamp: str = ""
+    deletion_timestamp: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.name:
+            d["name"] = self.name
+        if self.namespace:
+            d["namespace"] = self.namespace
+        if self.uid:
+            d["uid"] = self.uid
+        if self.resource_version:
+            d["resourceVersion"] = self.resource_version
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.owner_references:
+            d["ownerReferences"] = [o.to_dict() for o in self.owner_references]
+        if self.creation_timestamp:
+            d["creationTimestamp"] = self.creation_timestamp
+        if self.deletion_timestamp:
+            d["deletionTimestamp"] = self.deletion_timestamp
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ObjectMeta":
+        d = d or {}
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", ""),
+            uid=d.get("uid", ""),
+            resource_version=d.get("resourceVersion", ""),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            owner_references=[OwnerReference.from_dict(o) for o in d.get("ownerReferences") or []],
+            creation_timestamp=d.get("creationTimestamp", ""),
+            deletion_timestamp=d.get("deletionTimestamp"),
+        )
+
+
+def get_controller_of(obj_meta: dict) -> Optional[dict]:
+    """Return the controlling ownerReference of an unstructured object's
+    metadata dict, like metav1.GetControllerOf."""
+    for ref in obj_meta.get("ownerReferences") or []:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def deep_copy(obj: Any) -> Any:
+    """DeepCopy equivalent for unstructured objects (zz_generated.deepcopy.go)."""
+    return copy.deepcopy(obj)

@@ -1,0 +1,44 @@
+"""Scheme registration (reference: pkg/apis/tensorflow/*/register.go).
+
+The Go scheme machinery (type registration + defaulting function dispatch)
+reduces in Python to a version-keyed registry mapping apiVersion to the typed
+TFJob class and its defaulting function.  ``default_tfjob`` is the analogue of
+``Scheme.Default(obj)`` as called by the controllers
+(pkg/controller/controller.go via trainer setup, pkg/controller.v2/controller.go:361).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from k8s_tpu.api import v1alpha1, v1alpha2
+
+GROUP_NAME = "kubeflow.org"
+
+_REGISTRY: dict[str, tuple[type, Callable]] = {
+    v1alpha1.CRD_API_VERSION: (v1alpha1.TFJob, v1alpha1.set_defaults_tfjob),
+    v1alpha2.CRD_API_VERSION: (v1alpha2.TFJob, v1alpha2.set_defaults_tfjob),
+}
+
+
+def tfjob_class_for(api_version: str) -> type:
+    try:
+        return _REGISTRY[api_version][0]
+    except KeyError:
+        raise ValueError(f"unregistered apiVersion {api_version!r}") from None
+
+
+def default_tfjob(tfjob) -> None:
+    """Apply the registered defaulting function for the object's version."""
+    try:
+        fn = _REGISTRY[tfjob.api_version][1]
+    except KeyError:
+        raise ValueError(f"unregistered apiVersion {tfjob.api_version!r}") from None
+    fn(tfjob)
+
+
+def tfjob_from_unstructured(obj: dict):
+    """Parse an unstructured TFJob dict into the typed class for its version
+    (the conversion seam of pkg/controller.v2/informer.go:83-96)."""
+    api_version = obj.get("apiVersion", v1alpha2.CRD_API_VERSION)
+    return tfjob_class_for(api_version).from_dict(obj)

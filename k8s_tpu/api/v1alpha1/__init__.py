@@ -1,0 +1,4 @@
+"""v1alpha1 TFJob API (reference: pkg/apis/tensorflow/v1alpha1/)."""
+
+from k8s_tpu.api.v1alpha1.types import *  # noqa: F401,F403
+from k8s_tpu.api.v1alpha1.defaults import set_defaults_tfjob  # noqa: F401

@@ -1,0 +1,34 @@
+"""v1alpha1 defaulting (reference: pkg/apis/tensorflow/v1alpha1/defaults.go:27-58)."""
+
+from __future__ import annotations
+
+from k8s_tpu.api.v1alpha1 import types
+
+
+def set_defaults_tfjob(job: types.TFJob) -> None:
+    """SetDefaults_TFJob: image, per-replica port/type/count, chief policy."""
+    spec = job.spec
+    if not spec.tf_image:
+        spec.tf_image = types.DEFAULT_TF_IMAGE
+
+    for r in spec.replica_specs:
+        if r.tf_port is None:
+            r.tf_port = types.TF_PORT
+        if not r.tf_replica_type:
+            r.tf_replica_type = types.MASTER
+        if r.replicas is None:
+            r.replicas = types.REPLICAS
+
+    if spec.termination_policy is None:
+        # Chief defaults to MASTER:0 (defaults.go:49-56).  For pure
+        # TPU_WORKER jobs (no MASTER replica) validation later retargets the
+        # chief to TPU_WORKER:0 == JAX process 0.
+        spec.termination_policy = types.TerminationPolicySpec(
+            chief=types.ChiefSpec(replica_name=types.MASTER, replica_index=0)
+        )
+        if spec.replica_specs and not any(
+            r.tf_replica_type == types.MASTER for r in spec.replica_specs
+        ):
+            tpu_specs = [r for r in spec.replica_specs if r.tf_replica_type == types.TPU_WORKER]
+            if tpu_specs:
+                spec.termination_policy.chief.replica_name = types.TPU_WORKER

@@ -1,0 +1,283 @@
+"""v1alpha1 TFJob types (reference: pkg/apis/tensorflow/v1alpha1/types.go).
+
+The v1alpha1 shape: a job is a *list* of replica specs, status is a *phase*
+plus per-replica states, and the chief-based termination policy decides job
+completion.  TPU-native changes relative to the reference:
+
+- A ``TPU_WORKER`` replica type joins MASTER/PS/WORKER (types.go:80-84): a
+  gang of slice hosts running one SPMD program.  PS remains accepted for
+  legacy manifests but the trainer never provisions gRPC servers for it.
+- ``TFJobSpec.tpu`` carries slice topology (accelerator type, topology
+  string, slice count) — the TPU analogue of ``AcceleratorConfig`` host
+  mounts (types.go:176-198), which TPU VMs do not need.
+- The default image is a JAX image, not tensorflow/tensorflow:1.3.0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from k8s_tpu.api.common import TPUSpec  # noqa: F401  (re-exported; wire shape shared)
+from k8s_tpu.api.meta import ObjectMeta
+
+# CRD identity (types.go:22-32)
+CRD_KIND = "TFJob"
+CRD_KIND_LOWER = "tfjob"
+CRD_KIND_PLURAL = "tfjobs"
+CRD_GROUP = "kubeflow.org"
+CRD_VERSION = "v1alpha1"
+CRD_API_VERSION = f"{CRD_GROUP}/{CRD_VERSION}"
+
+# Value of the APP label applied to owned entities (types.go:28-29).
+APP_LABEL = "tensorflow-job"
+
+# Spec defaults (types.go:30-32, 87-90).  The default port is kept at 2222 so
+# legacy manifests/services keep working; it now carries the JAX coordinator
+# bootstrap rather than a TF gRPC server.
+TF_PORT = 2222
+REPLICAS = 1
+DEFAULT_TF_CONTAINER = "tensorflow"
+DEFAULT_TF_IMAGE = "ghcr.io/k8s-tpu/jax-tpu:latest"
+
+# Replica types (types.go:80-84) + the TPU slice-host gang type.
+MASTER = "MASTER"
+PS = "PS"
+WORKER = "WORKER"
+TPU_WORKER = "TPU_WORKER"
+VALID_REPLICA_TYPES = (MASTER, PS, WORKER, TPU_WORKER)
+
+# Job phases (types.go:107-116)
+PHASE_NONE = ""
+PHASE_CREATING = "Creating"
+PHASE_RUNNING = "Running"
+PHASE_CLEANUP = "CleanUp"
+PHASE_FAILED = "Failed"
+PHASE_DONE = "Done"
+
+# Job / replica states (types.go:118-127, 141-148)
+STATE_UNKNOWN = "Unknown"
+STATE_RUNNING = "Running"
+STATE_SUCCEEDED = "Succeeded"
+STATE_FAILED = "Failed"
+
+REPLICA_STATE_UNKNOWN = "Unknown"
+REPLICA_STATE_RUNNING = "Running"
+REPLICA_STATE_FAILED = "Failed"
+REPLICA_STATE_SUCCEEDED = "Succeeded"
+
+
+@dataclass
+class ChiefSpec:
+    """Which replica's exit decides the job (types.go:72-75)."""
+
+    replica_name: str = ""
+    replica_index: int = 0
+
+    def to_dict(self) -> dict:
+        return {"replicaName": self.replica_name, "replicaIndex": self.replica_index}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChiefSpec":
+        return cls(d.get("replicaName", ""), int(d.get("replicaIndex", 0)))
+
+
+@dataclass
+class TerminationPolicySpec:
+    """types.go:66-69 — only the Chief policy exists."""
+
+    chief: Optional[ChiefSpec] = None
+
+    def to_dict(self) -> dict:
+        return {"chief": self.chief.to_dict()} if self.chief else {}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TerminationPolicySpec":
+        c = d.get("chief")
+        return cls(chief=ChiefSpec.from_dict(c) if c else None)
+
+
+@dataclass
+class TFReplicaSpec:
+    """One replica group (types.go:92-104).  ``template`` is an unstructured
+    PodTemplateSpec dict in wire format."""
+
+    replicas: Optional[int] = None
+    template: Optional[dict] = None
+    tf_port: Optional[int] = None
+    tf_replica_type: str = ""
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"tfReplicaType": self.tf_replica_type}
+        if self.replicas is not None:
+            d["replicas"] = self.replicas
+        if self.template is not None:
+            d["template"] = self.template
+        if self.tf_port is not None:
+            d["tfPort"] = self.tf_port
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TFReplicaSpec":
+        return cls(
+            replicas=d.get("replicas"),
+            template=d.get("template"),
+            tf_port=d.get("tfPort"),
+            tf_replica_type=d.get("tfReplicaType", ""),
+        )
+
+
+@dataclass
+class TFJobSpec:
+    """types.go:47-64 + TPU slice topology."""
+
+    runtime_id: str = ""
+    replica_specs: list[TFReplicaSpec] = field(default_factory=list)
+    tf_image: str = ""
+    termination_policy: Optional[TerminationPolicySpec] = None
+    scheduler_name: str = ""
+    tpu: Optional[TPUSpec] = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"replicaSpecs": [r.to_dict() for r in self.replica_specs]}
+        if self.runtime_id:
+            d["RuntimeId"] = self.runtime_id  # field had no json tag in the reference
+        if self.tf_image:
+            d["tfImage"] = self.tf_image
+        if self.termination_policy is not None:
+            d["terminationPolicy"] = self.termination_policy.to_dict()
+        if self.scheduler_name:
+            d["schedulerName"] = self.scheduler_name
+        if self.tpu is not None:
+            d["tpu"] = self.tpu.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "TFJobSpec":
+        d = d or {}
+        return cls(
+            runtime_id=d.get("RuntimeId", d.get("runtimeId", "")),
+            replica_specs=[TFReplicaSpec.from_dict(r) for r in d.get("replicaSpecs") or []],
+            tf_image=d.get("tfImage", ""),
+            termination_policy=(
+                TerminationPolicySpec.from_dict(d["terminationPolicy"])
+                if d.get("terminationPolicy")
+                else None
+            ),
+            scheduler_name=d.get("schedulerName", ""),
+            tpu=TPUSpec.from_dict(d["tpu"]) if d.get("tpu") else None,
+        )
+
+
+@dataclass
+class TFReplicaStatus:
+    """types.go:150-160."""
+
+    tf_replica_type: str = ""
+    state: str = REPLICA_STATE_UNKNOWN
+    replicas_states: dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "tf_replica_type": self.tf_replica_type,
+            "state": self.state,
+            "ReplicasStates": dict(self.replicas_states),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TFReplicaStatus":
+        return cls(
+            tf_replica_type=d.get("tf_replica_type", ""),
+            state=d.get("state", REPLICA_STATE_UNKNOWN),
+            replicas_states=dict(d.get("ReplicasStates") or {}),
+        )
+
+
+@dataclass
+class TFJobStatus:
+    """types.go:129-139."""
+
+    phase: str = PHASE_NONE
+    reason: str = ""
+    state: str = STATE_UNKNOWN
+    replica_statuses: list[TFReplicaStatus] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "phase": self.phase,
+            "reason": self.reason,
+            "state": self.state,
+            "replicaStatuses": [r.to_dict() for r in self.replica_statuses],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "TFJobStatus":
+        d = d or {}
+        return cls(
+            phase=d.get("phase", PHASE_NONE),
+            reason=d.get("reason", ""),
+            state=d.get("state", STATE_UNKNOWN),
+            replica_statuses=[TFReplicaStatus.from_dict(r) for r in d.get("replicaStatuses") or []],
+        )
+
+
+@dataclass
+class TFJob:
+    """types.go:39-45."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TFJobSpec = field(default_factory=TFJobSpec)
+    status: TFJobStatus = field(default_factory=TFJobStatus)
+
+    api_version: str = CRD_API_VERSION
+    kind: str = CRD_KIND
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TFJob":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata")),
+            spec=TFJobSpec.from_dict(d.get("spec")),
+            status=TFJobStatus.from_dict(d.get("status")),
+            api_version=d.get("apiVersion", CRD_API_VERSION),
+            kind=d.get("kind", CRD_KIND),
+        )
+
+
+# Accelerator config (types.go:176-212): volume/env injection keyed on a
+# container resource-limit name, loaded from the operator's --controller-config-file.
+@dataclass
+class AcceleratorVolume:
+    name: str = ""
+    host_path: str = ""
+    mount_path: str = ""
+
+
+@dataclass
+class EnvironmentVariableConfig:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class AcceleratorConfig:
+    volumes: list[AcceleratorVolume] = field(default_factory=list)
+    env_vars: list[EnvironmentVariableConfig] = field(default_factory=list)
+
+
+@dataclass
+class ControllerConfig:
+    """types.go:176-185.  ``grpc_server_file_path`` is retained for manifest
+    compatibility but unused: the PS default-server concept is deleted in the
+    TPU rebuild (SURVEY.md §2.4)."""
+
+    accelerators: dict[str, AcceleratorConfig] = field(default_factory=dict)
+    grpc_server_file_path: str = ""

@@ -1,0 +1,5 @@
+"""v1alpha2 TFJob API (reference: pkg/apis/tensorflow/v1alpha2/)."""
+
+from k8s_tpu.api.v1alpha2 import constants  # noqa: F401
+from k8s_tpu.api.v1alpha2.types import *  # noqa: F401,F403
+from k8s_tpu.api.v1alpha2.defaults import set_defaults_tfjob  # noqa: F401

@@ -1,0 +1,36 @@
+"""v1alpha2 constants (reference: pkg/apis/tensorflow/v1alpha2/constants.go).
+
+Port 2222 and the container/port names are kept verbatim for manifest and
+harness compatibility; in the TPU rebuild the port carries the
+``jax.distributed`` coordinator service on process 0 instead of a per-replica
+TF gRPC server.
+"""
+
+# ENV for the operator namespace (constants.go:18-19); single source of truth
+# in k8s_tpu.util.util, re-exported here to mirror the reference layout.
+from k8s_tpu.util.util import ENV_KUBEFLOW_NAMESPACE  # noqa: F401
+
+# Port name/number used for inter-process bootstrap (constants.go:21-27).
+DEFAULT_PORT_NAME = "tfjob-port"
+DEFAULT_CONTAINER_NAME = "tensorflow"
+DEFAULT_PORT = 2222
+
+# --- TPU-native additions ---
+
+# Resource-limit prefix that marks a container as a TPU slice host, the
+# analogue of `nvidia.com/gpu` in examples/tf_job_gpu.yaml.  e.g.
+# `cloud-tpus.google.com/v5e: 4` (4 chips per host).
+TPU_RESOURCE_PREFIX = "cloud-tpus.google.com/"
+
+# Env injected into every replica pod (replaces the TF_CONFIG contract of
+# pkg/controller.v2/controller_tensorflow.go / pkg/trainer/replicas.go:202-234).
+ENV_JAX_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_JAX_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_TPU_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_TPU_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_TPU_CONFIG = "TPU_CONFIG"  # JSON summary, kept TF_CONFIG-shaped for tooling

@@ -1,0 +1,39 @@
+"""v1alpha2 defaulting (reference: pkg/apis/tensorflow/v1alpha2/defaults.go:33-69)."""
+
+from __future__ import annotations
+
+from k8s_tpu.api.v1alpha2 import constants, types
+
+
+def _set_default_port(pod_spec: dict) -> None:
+    """Ensure the `tensorflow` container exposes the tfjob-port
+    (defaults.go:33-56).  Falls back to container 0 if none is named
+    `tensorflow`, matching the reference's index-0 fallback."""
+    containers = pod_spec.setdefault("containers", [])
+    if not containers:
+        return
+    index = 0
+    for i, c in enumerate(containers):
+        if c.get("name") == constants.DEFAULT_CONTAINER_NAME:
+            index = i
+            break
+    ports = containers[index].setdefault("ports", [])
+    if not any(p.get("name") == constants.DEFAULT_PORT_NAME for p in ports):
+        ports.append(
+            {"name": constants.DEFAULT_PORT_NAME, "containerPort": constants.DEFAULT_PORT}
+        )
+
+
+def set_defaults_tfjob(tfjob: types.TFJob) -> None:
+    """SetDefaults_TFJob (defaults.go:64-69) + restart-policy default.
+
+    The reference defaulted only replicas and the container port; the
+    RestartPolicy doc comment promised an Always default (types.go:75-78),
+    applied here."""
+    for spec in tfjob.spec.tf_replica_specs.values():
+        if spec.replicas is None:
+            spec.replicas = 1
+        if spec.template is not None:
+            _set_default_port(spec.template.setdefault("spec", {}))
+        if not spec.restart_policy:
+            spec.restart_policy = types.RestartPolicyAlways

@@ -1,0 +1,245 @@
+"""v1alpha2 TFJob types (reference: pkg/apis/tensorflow/v1alpha2/types.go).
+
+The v1alpha2 shape: replica specs are a *map* keyed by replica type
+(types.go:44-54), restart behavior is a per-replica ``RestartPolicy``
+including the ExitCode contract (types.go:81-92), and status is
+conditions + per-type counters + timestamps (types.go:115-149).
+
+TPU-native addition: replica type ``TPU`` (a gang of slice hosts running one
+SPMD program) and a job-level ``TPUSpec`` for slice topology.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from k8s_tpu.api.common import TPUSpec
+from k8s_tpu.api.meta import ObjectMeta
+
+CRD_KIND = "TFJob"
+CRD_KIND_PLURAL = "tfjobs"
+CRD_GROUP = "kubeflow.org"
+CRD_VERSION = "v1alpha2"
+CRD_API_VERSION = f"{CRD_GROUP}/{CRD_VERSION}"
+
+# Restart policies (types.go:75-92)
+RestartPolicyAlways = "Always"
+RestartPolicyOnFailure = "OnFailure"
+RestartPolicyNever = "Never"
+RestartPolicyExitCode = "ExitCode"
+VALID_RESTART_POLICIES = (
+    RestartPolicyAlways,
+    RestartPolicyOnFailure,
+    RestartPolicyNever,
+    RestartPolicyExitCode,
+)
+
+# Replica types (types.go:94-112) + TPU gang type
+TFReplicaTypePS = "PS"
+TFReplicaTypeWorker = "Worker"
+TFReplicaTypeChief = "Chief"
+TFReplicaTypeEval = "Eval"
+TFReplicaTypeTPU = "TPU"
+VALID_REPLICA_TYPES = (
+    TFReplicaTypePS,
+    TFReplicaTypeWorker,
+    TFReplicaTypeChief,
+    TFReplicaTypeEval,
+    TFReplicaTypeTPU,
+)
+
+# Condition types (types.go:168-196)
+TFJobCreated = "Created"
+TFJobRunning = "Running"
+TFJobRestarting = "Restarting"
+TFJobSucceeded = "Succeeded"
+TFJobFailed = "Failed"
+
+# v1.ConditionStatus
+ConditionTrue = "True"
+ConditionFalse = "False"
+ConditionUnknown = "Unknown"
+
+
+@dataclass
+class TFReplicaSpec:
+    """types.go:56-73.  ``template`` is an unstructured PodTemplateSpec dict."""
+
+    replicas: Optional[int] = None
+    template: Optional[dict] = None
+    restart_policy: str = ""
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.replicas is not None:
+            d["replicas"] = self.replicas
+        if self.template is not None:
+            d["template"] = self.template
+        if self.restart_policy:
+            d["restartPolicy"] = self.restart_policy
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TFReplicaSpec":
+        return cls(
+            replicas=d.get("replicas"),
+            template=d.get("template"),
+            restart_policy=d.get("restartPolicy", ""),
+        )
+
+
+@dataclass
+class TFJobSpec:
+    """types.go:44-54 + TPU slice topology."""
+
+    tf_replica_specs: dict[str, TFReplicaSpec] = field(default_factory=dict)
+    tpu: Optional[TPUSpec] = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "tfReplicaSpecs": {k: v.to_dict() for k, v in self.tf_replica_specs.items()}
+        }
+        if self.tpu is not None:
+            d["tpu"] = self.tpu.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "TFJobSpec":
+        d = d or {}
+        return cls(
+            tf_replica_specs={
+                k: TFReplicaSpec.from_dict(v) for k, v in (d.get("tfReplicaSpecs") or {}).items()
+            },
+            tpu=TPUSpec.from_dict(d["tpu"]) if d.get("tpu") else None,
+        )
+
+
+@dataclass
+class TFJobCondition:
+    """types.go:151-166."""
+
+    type: str = ""
+    status: str = ConditionUnknown
+    reason: str = ""
+    message: str = ""
+    last_update_time: str = ""
+    last_transition_time: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastUpdateTime": self.last_update_time,
+            "lastTransitionTime": self.last_transition_time,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TFJobCondition":
+        return cls(
+            type=d.get("type", ""),
+            status=d.get("status", ConditionUnknown),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_update_time=d.get("lastUpdateTime", ""),
+            last_transition_time=d.get("lastTransitionTime", ""),
+        )
+
+
+@dataclass
+class TFReplicaStatus:
+    """types.go:139-149: active/succeeded/failed pod counts."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.active:
+            d["active"] = self.active
+        if self.succeeded:
+            d["succeeded"] = self.succeeded
+        if self.failed:
+            d["failed"] = self.failed
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "TFReplicaStatus":
+        d = d or {}
+        return cls(
+            active=int(d.get("active", 0)),
+            succeeded=int(d.get("succeeded", 0)),
+            failed=int(d.get("failed", 0)),
+        )
+
+
+@dataclass
+class TFJobStatus:
+    """types.go:114-137."""
+
+    conditions: list[TFJobCondition] = field(default_factory=list)
+    tf_replica_statuses: dict[str, TFReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    last_reconcile_time: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "conditions": [c.to_dict() for c in self.conditions],
+            "tfReplicaStatuses": {k: v.to_dict() for k, v in self.tf_replica_statuses.items()},
+        }
+        if self.start_time:
+            d["startTime"] = self.start_time
+        if self.completion_time:
+            d["completionTime"] = self.completion_time
+        if self.last_reconcile_time:
+            d["lastReconcileTime"] = self.last_reconcile_time
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "TFJobStatus":
+        d = d or {}
+        return cls(
+            conditions=[TFJobCondition.from_dict(c) for c in d.get("conditions") or []],
+            tf_replica_statuses={
+                k: TFReplicaStatus.from_dict(v)
+                for k, v in (d.get("tfReplicaStatuses") or {}).items()
+            },
+            start_time=d.get("startTime"),
+            completion_time=d.get("completionTime"),
+            last_reconcile_time=d.get("lastReconcileTime"),
+        )
+
+
+@dataclass
+class TFJob:
+    """types.go:27-42."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TFJobSpec = field(default_factory=TFJobSpec)
+    status: TFJobStatus = field(default_factory=TFJobStatus)
+
+    api_version: str = CRD_API_VERSION
+    kind: str = CRD_KIND
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TFJob":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata")),
+            spec=TFJobSpec.from_dict(d.get("spec")),
+            status=TFJobStatus.from_dict(d.get("status")),
+            api_version=d.get("apiVersion", CRD_API_VERSION),
+            kind=d.get("kind", CRD_KIND),
+        )

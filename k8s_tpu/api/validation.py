@@ -1,0 +1,89 @@
+"""TFJob spec validation (reference: pkg/apis/tensorflow/validation/validation.go).
+
+Both API versions are validated here, like the reference keeps validation in
+its own package.  Errors are raised as ``ValidationError`` so callers can map
+them to the Failed phase/condition (pkg/trainer/training.go:220-228).
+"""
+
+from __future__ import annotations
+
+from k8s_tpu.api import v1alpha1
+from k8s_tpu.api.v1alpha2 import constants as v2c
+from k8s_tpu.api.v1alpha2 import types as v2
+
+
+class ValidationError(ValueError):
+    """Invalid TFJob spec."""
+
+
+def validate_v1alpha1_tfjob_spec(spec: v1alpha1.TFJobSpec) -> None:
+    """ValidateTFJobSpec (validation.go:26-79): chief policy present, every
+    replica has a template/port/valid type and a container named
+    ``tensorflow``; the chief's replica type must exist."""
+    if spec.termination_policy is None or spec.termination_policy.chief is None:
+        raise ValidationError(f"invalid termination policy: {spec.termination_policy}")
+
+    chief_name = spec.termination_policy.chief.replica_name
+    chief_exists = False
+
+    for r in spec.replica_specs:
+        if r.template is None:
+            raise ValidationError(f"Replica is missing Template; {r}")
+        if r.tf_replica_type == chief_name:
+            chief_exists = True
+        if r.tf_port is None:
+            raise ValidationError("tfReplicaSpec.TFPort can't be None")
+        if r.tf_replica_type not in v1alpha1.VALID_REPLICA_TYPES:
+            raise ValidationError(
+                f"tfReplicaSpec.TFReplicaType is {r.tf_replica_type} but must be one of "
+                f"{list(v1alpha1.VALID_REPLICA_TYPES)}"
+            )
+        _require_container(r.template, v1alpha1.DEFAULT_TF_CONTAINER, r.tf_replica_type)
+        if r.tf_replica_type == v1alpha1.TPU_WORKER:
+            _validate_tpu_replica(r.template, r.tf_replica_type)
+
+    if not chief_exists:
+        raise ValidationError(f"Missing ReplicaSpec for chief: {chief_name}")
+
+
+def validate_v1alpha2_tfjob_spec(spec: v2.TFJobSpec) -> None:
+    """v1alpha2 analogue (upstream added it post-snapshot; semantics follow
+    the CRD openAPIV3Schema in examples/crd/crd-v1alpha2.yaml: known replica
+    types, replicas >= 1, at most one Chief, container present)."""
+    if not spec.tf_replica_specs:
+        raise ValidationError("TFJobSpec.tfReplicaSpecs must not be empty")
+    for rtype, r in spec.tf_replica_specs.items():
+        if rtype not in v2.VALID_REPLICA_TYPES:
+            raise ValidationError(
+                f"tfReplicaType {rtype} must be one of {list(v2.VALID_REPLICA_TYPES)}"
+            )
+        if r.replicas is not None and r.replicas < 1:
+            raise ValidationError(f"replicas for {rtype} must be >= 1")
+        if rtype == v2.TFReplicaTypeChief and (r.replicas or 1) > 1:
+            raise ValidationError("TFJobSpec must not have more than 1 Chief replica")
+        if r.template is None:
+            raise ValidationError(f"Replica {rtype} is missing Template")
+        _require_container(r.template, v2c.DEFAULT_CONTAINER_NAME, rtype)
+        if rtype == v2.TFReplicaTypeTPU:
+            _validate_tpu_replica(r.template, rtype)
+
+
+def _require_container(template: dict, container_name: str, rtype: str) -> None:
+    containers = ((template.get("spec") or {}).get("containers")) or []
+    if not any(c.get("name") == container_name for c in containers):
+        raise ValidationError(
+            f"Replica type {rtype} is missing a container named {container_name}"
+        )
+
+
+def _validate_tpu_replica(template: dict, rtype: str) -> None:
+    """TPU gangs must declare a TPU resource limit so the scheduler can place
+    them on slice hosts (the TPU analogue of the nvidia.com/gpu limit in
+    examples/tf_job_gpu.yaml)."""
+    for c in ((template.get("spec") or {}).get("containers")) or []:
+        limits = ((c.get("resources") or {}).get("limits")) or {}
+        if any(k.startswith(v2c.TPU_RESOURCE_PREFIX) for k in limits):
+            return
+    raise ValidationError(
+        f"Replica type {rtype} must set a '{v2c.TPU_RESOURCE_PREFIX}*' resource limit"
+    )

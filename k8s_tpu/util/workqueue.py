@@ -22,6 +22,7 @@ from __future__ import annotations
 import heapq
 import threading
 import time
+from collections import deque
 from typing import Any, Hashable, Optional
 
 
@@ -42,7 +43,9 @@ class ItemExponentialFailureRateLimiter:
         with self._lock:
             failures = self._failures.get(item, 0)
             self._failures[item] = failures + 1
-        return min(self.base_delay * (2**failures), self.max_delay)
+        # Clamp the exponent: unbounded 2**failures overflows float conversion
+        # after ~1030 requeues of a persistently failing key.
+        return min(self.base_delay * (2 ** min(failures, 64)), self.max_delay)
 
     def forget(self, item: Hashable) -> None:
         with self._lock:
@@ -113,7 +116,7 @@ class WorkQueue:
 
     def __init__(self):
         self._cond = threading.Condition()
-        self._queue: list[Any] = []
+        self._queue: deque[Any] = deque()
         self._dirty: set[Any] = set()
         self._processing: set[Any] = set()
         self._shutting_down = False
@@ -141,7 +144,7 @@ class WorkQueue:
                 self._cond.wait(remaining)
             if not self._queue:
                 return None, True
-            item = self._queue.pop(0)
+            item = self._queue.popleft()
             self._processing.add(item)
             self._dirty.discard(item)
             return item, False

@@ -1,0 +1,122 @@
+"""Defaulting tests (reference: pkg/apis/tensorflow/v1alpha{1,2}/defaults_test.go)."""
+
+from k8s_tpu.api import register, v1alpha1, v1alpha2
+
+
+def _pod_template(container_name="tensorflow", ports=None):
+    c = {"name": container_name, "image": "img"}
+    if ports is not None:
+        c["ports"] = ports
+    return {"spec": {"containers": [c]}}
+
+
+class TestV1Alpha1Defaults:
+    def test_fills_image_port_type_replicas(self):
+        job = v1alpha1.TFJob(
+            spec=v1alpha1.TFJobSpec(
+                replica_specs=[v1alpha1.TFReplicaSpec(template=_pod_template())]
+            )
+        )
+        v1alpha1.set_defaults_tfjob(job)
+        r = job.spec.replica_specs[0]
+        assert job.spec.tf_image == v1alpha1.DEFAULT_TF_IMAGE
+        assert r.tf_port == 2222
+        assert r.tf_replica_type == v1alpha1.MASTER
+        assert r.replicas == 1
+        chief = job.spec.termination_policy.chief
+        assert (chief.replica_name, chief.replica_index) == ("MASTER", 0)
+
+    def test_does_not_override_explicit_values(self):
+        job = v1alpha1.TFJob(
+            spec=v1alpha1.TFJobSpec(
+                tf_image="custom:1",
+                replica_specs=[
+                    v1alpha1.TFReplicaSpec(
+                        replicas=3,
+                        tf_port=4000,
+                        tf_replica_type=v1alpha1.WORKER,
+                        template=_pod_template(),
+                    )
+                ],
+                termination_policy=v1alpha1.TerminationPolicySpec(
+                    chief=v1alpha1.ChiefSpec("WORKER", 0)
+                ),
+            )
+        )
+        v1alpha1.set_defaults_tfjob(job)
+        r = job.spec.replica_specs[0]
+        assert (job.spec.tf_image, r.replicas, r.tf_port, r.tf_replica_type) == (
+            "custom:1",
+            3,
+            4000,
+            "WORKER",
+        )
+        assert job.spec.termination_policy.chief.replica_name == "WORKER"
+
+    def test_tpu_only_job_gets_tpu_chief(self):
+        job = v1alpha1.TFJob(
+            spec=v1alpha1.TFJobSpec(
+                replica_specs=[
+                    v1alpha1.TFReplicaSpec(
+                        tf_replica_type=v1alpha1.TPU_WORKER, template=_pod_template()
+                    )
+                ]
+            )
+        )
+        v1alpha1.set_defaults_tfjob(job)
+        assert job.spec.termination_policy.chief.replica_name == v1alpha1.TPU_WORKER
+
+
+class TestV1Alpha2Defaults:
+    def test_adds_port_and_replicas(self):
+        job = v1alpha2.TFJob(
+            spec=v1alpha2.TFJobSpec(
+                tf_replica_specs={"Worker": v1alpha2.TFReplicaSpec(template=_pod_template())}
+            )
+        )
+        v1alpha2.set_defaults_tfjob(job)
+        spec = job.spec.tf_replica_specs["Worker"]
+        assert spec.replicas == 1
+        assert spec.restart_policy == v1alpha2.RestartPolicyAlways
+        ports = spec.template["spec"]["containers"][0]["ports"]
+        assert {"name": "tfjob-port", "containerPort": 2222} in ports
+
+    def test_keeps_existing_port(self):
+        ports = [{"name": "tfjob-port", "containerPort": 9999}]
+        job = v1alpha2.TFJob(
+            spec=v1alpha2.TFJobSpec(
+                tf_replica_specs={
+                    "Worker": v1alpha2.TFReplicaSpec(template=_pod_template(ports=ports))
+                }
+            )
+        )
+        v1alpha2.set_defaults_tfjob(job)
+        got = job.spec.tf_replica_specs["Worker"].template["spec"]["containers"][0]["ports"]
+        assert got == [{"name": "tfjob-port", "containerPort": 9999}]
+
+    def test_port_defaults_to_container_0_when_no_tensorflow_container(self):
+        job = v1alpha2.TFJob(
+            spec=v1alpha2.TFJobSpec(
+                tf_replica_specs={
+                    "Worker": v1alpha2.TFReplicaSpec(template=_pod_template("other"))
+                }
+            )
+        )
+        v1alpha2.set_defaults_tfjob(job)
+        got = job.spec.tf_replica_specs["Worker"].template["spec"]["containers"][0]["ports"]
+        assert got == [{"name": "tfjob-port", "containerPort": 2222}]
+
+
+def test_scheme_dispatch_and_roundtrip():
+    obj = {
+        "apiVersion": "kubeflow.org/v1alpha2",
+        "kind": "TFJob",
+        "metadata": {"name": "j", "namespace": "ns", "uid": "u1"},
+        "spec": {"tfReplicaSpecs": {"Worker": {"replicas": 2, "template": _pod_template()}}},
+    }
+    job = register.tfjob_from_unstructured(obj)
+    assert isinstance(job, v1alpha2.TFJob)
+    register.default_tfjob(job)
+    rt = v1alpha2.TFJob.from_dict(job.to_dict())
+    assert rt.spec.tf_replica_specs["Worker"].replicas == 2
+    assert rt.metadata.uid == "u1"

@@ -1,0 +1,75 @@
+"""Helper tests (reference: pkg/apis/tensorflow/helper/helpers_test.go:28)."""
+
+from k8s_tpu.api import helpers, v1alpha1
+from k8s_tpu.api.meta import ObjectMeta
+
+
+def test_as_owner():
+    job = v1alpha1.TFJob(metadata=ObjectMeta(name="myjob", namespace="ns", uid="uid-1"))
+    ref = helpers.as_owner(job)
+    d = ref.to_dict()
+    assert d == {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "TFJob",
+        "name": "myjob",
+        "uid": "uid-1",
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def test_crd_name():
+    assert helpers.crd_name() == "tfjobs.kubeflow.org"
+
+
+def test_configure_accelerators_injects_volumes_and_env():
+    # helpers_test.go:28 — accelerator config keyed on a resource-limit name
+    # adds hostPath volumes, mounts, and env to the tensorflow container.
+    template = {
+        "spec": {
+            "containers": [
+                {
+                    "name": "tensorflow",
+                    "resources": {"limits": {"nvidia.com/gpu": 1}},
+                }
+            ]
+        }
+    }
+    spec = v1alpha1.TFJobSpec(
+        replica_specs=[v1alpha1.TFReplicaSpec(template=template, tf_replica_type="MASTER")]
+    )
+    accelerators = {
+        "nvidia.com/gpu": v1alpha1.AcceleratorConfig(
+            volumes=[
+                v1alpha1.AcceleratorVolume(
+                    name="cuda-lib", host_path="/home/cuda", mount_path="/usr/local/cuda"
+                )
+            ],
+            env_vars=[v1alpha1.EnvironmentVariableConfig(name="LD_LIBRARY_PATH", value="/usr/local/cuda/lib64")],
+        )
+    }
+    helpers.configure_accelerators_for_tfjob_spec(spec, accelerators)
+    pod_spec = spec.replica_specs[0].template["spec"]
+    c = pod_spec["containers"][0]
+    assert pod_spec["volumes"] == [{"name": "cuda-lib", "hostPath": {"path": "/home/cuda"}}]
+    assert c["volumeMounts"] == [{"name": "cuda-lib", "mountPath": "/usr/local/cuda"}]
+    assert c["env"] == [{"name": "LD_LIBRARY_PATH", "value": "/usr/local/cuda/lib64"}]
+
+
+def test_configure_accelerators_no_match_is_noop():
+    template = {"spec": {"containers": [{"name": "tensorflow"}]}}
+    spec = v1alpha1.TFJobSpec(replica_specs=[v1alpha1.TFReplicaSpec(template=template)])
+    helpers.configure_accelerators_for_tfjob_spec(spec, {})
+    assert "volumes" not in spec.replica_specs[0].template["spec"]
+
+
+def test_tpu_chips_per_host():
+    template = {
+        "spec": {
+            "containers": [
+                {"name": "tensorflow", "resources": {"limits": {"cloud-tpus.google.com/v5e": 4}}}
+            ]
+        }
+    }
+    assert helpers.tpu_chips_per_host(template) == 4
+    assert helpers.tpu_chips_per_host({"spec": {"containers": [{"name": "t"}]}}) == 0

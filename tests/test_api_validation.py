@@ -1,0 +1,130 @@
+"""Validation tests (reference: pkg/apis/tensorflow/validation/validation_test.go)."""
+
+import pytest
+
+from k8s_tpu.api import v1alpha1, v1alpha2
+from k8s_tpu.api.validation import (
+    ValidationError,
+    validate_v1alpha1_tfjob_spec,
+    validate_v1alpha2_tfjob_spec,
+)
+
+
+def _template(name="tensorflow", tpu_limit=None):
+    c = {"name": name, "image": "img"}
+    if tpu_limit:
+        c["resources"] = {"limits": {tpu_limit: 4}}
+    return {"spec": {"containers": [c]}}
+
+
+def _valid_v1_spec(**kw):
+    spec = v1alpha1.TFJobSpec(
+        replica_specs=[
+            v1alpha1.TFReplicaSpec(
+                replicas=1, tf_port=2222, tf_replica_type=v1alpha1.MASTER, template=_template()
+            )
+        ],
+        termination_policy=v1alpha1.TerminationPolicySpec(chief=v1alpha1.ChiefSpec("MASTER", 0)),
+    )
+    for k, v in kw.items():
+        setattr(spec, k, v)
+    return spec
+
+
+class TestV1Alpha1Validation:
+    def test_valid_spec_passes(self):
+        validate_v1alpha1_tfjob_spec(_valid_v1_spec())
+
+    def test_missing_template_rejected(self):
+        # validation_test.go:26 — a replica without a template is invalid.
+        spec = _valid_v1_spec()
+        spec.replica_specs[0].template = None
+        with pytest.raises(ValidationError, match="Template"):
+            validate_v1alpha1_tfjob_spec(spec)
+
+    def test_missing_termination_policy_rejected(self):
+        spec = _valid_v1_spec(termination_policy=None)
+        with pytest.raises(ValidationError, match="termination policy"):
+            validate_v1alpha1_tfjob_spec(spec)
+
+    def test_chief_replica_must_exist(self):
+        spec = _valid_v1_spec(
+            termination_policy=v1alpha1.TerminationPolicySpec(
+                chief=v1alpha1.ChiefSpec("WORKER", 0)
+            )
+        )
+        with pytest.raises(ValidationError, match="chief"):
+            validate_v1alpha1_tfjob_spec(spec)
+
+    def test_invalid_replica_type_rejected(self):
+        spec = _valid_v1_spec()
+        spec.replica_specs[0].tf_replica_type = "CHIEF"  # not in the enum
+        with pytest.raises(ValidationError, match="must be one of"):
+            validate_v1alpha1_tfjob_spec(spec)
+
+    def test_missing_tensorflow_container_rejected(self):
+        spec = _valid_v1_spec()
+        spec.replica_specs[0].template = _template(name="main")
+        with pytest.raises(ValidationError, match="container named tensorflow"):
+            validate_v1alpha1_tfjob_spec(spec)
+
+    def test_nil_port_rejected(self):
+        spec = _valid_v1_spec()
+        spec.replica_specs[0].tf_port = None
+        with pytest.raises(ValidationError, match="TFPort"):
+            validate_v1alpha1_tfjob_spec(spec)
+
+    def test_tpu_worker_requires_tpu_limit(self):
+        spec = _valid_v1_spec()
+        spec.replica_specs.append(
+            v1alpha1.TFReplicaSpec(
+                replicas=4,
+                tf_port=2222,
+                tf_replica_type=v1alpha1.TPU_WORKER,
+                template=_template(),
+            )
+        )
+        with pytest.raises(ValidationError, match="cloud-tpus.google.com"):
+            validate_v1alpha1_tfjob_spec(spec)
+        spec.replica_specs[1].template = _template(tpu_limit="cloud-tpus.google.com/v5e")
+        validate_v1alpha1_tfjob_spec(spec)
+
+
+class TestV1Alpha2Validation:
+    def _spec(self, rtype="Worker", **replica_kw):
+        return v1alpha2.TFJobSpec(
+            tf_replica_specs={
+                rtype: v1alpha2.TFReplicaSpec(template=_template(), **replica_kw)
+            }
+        )
+
+    def test_valid(self):
+        validate_v1alpha2_tfjob_spec(self._spec())
+
+    def test_empty_specs_rejected(self):
+        with pytest.raises(ValidationError, match="empty"):
+            validate_v1alpha2_tfjob_spec(v1alpha2.TFJobSpec())
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValidationError, match="must be one of"):
+            validate_v1alpha2_tfjob_spec(self._spec(rtype="Sleeper"))
+
+    def test_chief_max_one(self):
+        # crd-v1alpha2.yaml openAPIV3Schema: Chief replicas max 1.
+        with pytest.raises(ValidationError, match="Chief"):
+            validate_v1alpha2_tfjob_spec(self._spec(rtype="Chief", replicas=2))
+
+    def test_replicas_minimum_one(self):
+        with pytest.raises(ValidationError, match=">= 1"):
+            validate_v1alpha2_tfjob_spec(self._spec(replicas=0))
+
+    def test_tpu_requires_limit(self):
+        spec = v1alpha2.TFJobSpec(
+            tf_replica_specs={"TPU": v1alpha2.TFReplicaSpec(template=_template())}
+        )
+        with pytest.raises(ValidationError, match="cloud-tpus.google.com"):
+            validate_v1alpha2_tfjob_spec(spec)
+        spec.tf_replica_specs["TPU"].template = _template(
+            tpu_limit="cloud-tpus.google.com/v5e"
+        )
+        validate_v1alpha2_tfjob_spec(spec)
