@@ -1,0 +1,18 @@
+"""Client machinery (reference: pkg/client/ generated clientset/informers/listers).
+
+The reference ships ~10k lines of code-generated typed clients.  Here the
+same capabilities are a small hand-written stack over one backend protocol:
+
+- ``gvr``        — GroupVersionResource identifiers for every kind we touch
+- ``errors``     — ApiError taxonomy (NotFound/Conflict/AlreadyExists)
+- ``fake``       — in-memory apiserver with watch, action log, GC by owner
+                   refs (the fake-clientset tier of SURVEY.md §4)
+- ``rest``       — real apiserver over stdlib HTTPS (in-cluster or kubeconfig)
+- ``clientset``  — typed per-resource CRUD façade over either backend
+- ``informer``   — reflector (list+watch) → thread-safe store → handlers,
+                   the SharedInformerFactory/lister layer
+"""
+
+from k8s_tpu.client.clientset import Clientset  # noqa: F401
+from k8s_tpu.client.errors import ApiError, is_not_found, is_conflict, is_already_exists  # noqa: F401
+from k8s_tpu.client.fake import FakeCluster  # noqa: F401

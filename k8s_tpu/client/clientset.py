@@ -1,0 +1,111 @@
+"""Typed clientset façade (reference: pkg/client/clientset/versioned/).
+
+``Clientset`` wraps any backend implementing the API protocol (FakeCluster or
+RestClient) and exposes per-resource accessors mirroring the generated Go
+clientset's surface: ``cs.pods(ns).create(obj)``, ``cs.tfjobs(ns).update(job)``
+etc.  TFJob accessors speak typed objects (with to_dict/from_dict); core
+resources stay unstructured dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from k8s_tpu.api import register
+from k8s_tpu.client import gvr as gvrs
+from k8s_tpu.client.gvr import GVR
+
+
+class ResourceClient:
+    """CRUD for one (resource, namespace) pair over the backend protocol."""
+
+    def __init__(self, backend, resource: GVR, namespace: str = ""):
+        self._backend = backend
+        self.resource = resource
+        self.namespace = namespace
+
+    def create(self, obj: dict) -> dict:
+        return self._backend.create(self.resource, self.namespace, obj)
+
+    def get(self, name: str) -> dict:
+        return self._backend.get(self.resource, self.namespace, name)
+
+    def list(self, label_selector=None, field_selector=None) -> list[dict]:
+        return self._backend.list(
+            self.resource, self.namespace or None, label_selector, field_selector
+        )
+
+    def update(self, obj: dict) -> dict:
+        return self._backend.update(self.resource, self.namespace, obj)
+
+    def patch(self, name: str, patch: dict) -> dict:
+        return self._backend.patch_merge(self.resource, self.namespace, name, patch)
+
+    def delete(self, name: str, propagation: str = "Background") -> None:
+        self._backend.delete(self.resource, self.namespace, name, propagation)
+
+    def delete_collection(self, label_selector=None) -> int:
+        return self._backend.delete_collection(self.resource, self.namespace, label_selector)
+
+    def watch(self, namespace: Optional[str] = None):
+        return self._backend.watch(self.resource, namespace or self.namespace or None)
+
+
+class TFJobClient(ResourceClient):
+    """Typed TFJob CRUD (reference: generated tfjob clientset) — accepts and
+    returns typed TFJob objects for either API version."""
+
+    def create(self, job) -> object:
+        return register.tfjob_from_unstructured(super().create(job.to_dict()))
+
+    def get(self, name: str) -> object:
+        return register.tfjob_from_unstructured(super().get(name))
+
+    def list(self, label_selector=None, field_selector=None) -> list:
+        return [
+            register.tfjob_from_unstructured(o)
+            for o in super().list(label_selector, field_selector)
+        ]
+
+    def update(self, job) -> object:
+        return register.tfjob_from_unstructured(super().update(job.to_dict()))
+
+
+class Clientset:
+    """One handle over the whole API surface the operator uses."""
+
+    def __init__(self, backend):
+        self.backend = backend
+
+    def pods(self, namespace: str) -> ResourceClient:
+        return ResourceClient(self.backend, gvrs.PODS, namespace)
+
+    def services(self, namespace: str) -> ResourceClient:
+        return ResourceClient(self.backend, gvrs.SERVICES, namespace)
+
+    def events(self, namespace: str) -> ResourceClient:
+        return ResourceClient(self.backend, gvrs.EVENTS, namespace)
+
+    def endpoints(self, namespace: str) -> ResourceClient:
+        return ResourceClient(self.backend, gvrs.ENDPOINTS, namespace)
+
+    def configmaps(self, namespace: str) -> ResourceClient:
+        return ResourceClient(self.backend, gvrs.CONFIGMAPS, namespace)
+
+    def namespaces(self) -> ResourceClient:
+        return ResourceClient(self.backend, gvrs.NAMESPACES, "")
+
+    def pdbs(self, namespace: str) -> ResourceClient:
+        return ResourceClient(self.backend, gvrs.PDBS, namespace)
+
+    def crds(self) -> ResourceClient:
+        return ResourceClient(self.backend, gvrs.CRDS, "")
+
+    def tfjobs(self, namespace: str, api_version: str = "kubeflow.org/v1alpha2") -> TFJobClient:
+        return TFJobClient(self.backend, gvrs.tfjobs_gvr(api_version), namespace)
+
+    def tfjobs_unstructured(
+        self, namespace: str, api_version: str = "kubeflow.org/v1alpha2"
+    ) -> ResourceClient:
+        """Dynamic-client style access (pkg/util/unstructured/informer.go)."""
+        return ResourceClient(self.backend, gvrs.tfjobs_gvr(api_version), namespace)

@@ -1,0 +1,41 @@
+"""API error taxonomy (reference: pkg/util/k8sutil/k8sutil.go:84-106 helpers
+plus apierrors.IsNotFound/IsConflict usage throughout the controllers)."""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    """A Kubernetes-style API error with an HTTP status code and reason."""
+
+    def __init__(self, code: int, reason: str, message: str = ""):
+        super().__init__(message or reason)
+        self.code = code
+        self.reason = reason
+
+
+def not_found(message: str = "") -> ApiError:
+    return ApiError(404, "NotFound", message)
+
+
+def already_exists(message: str = "") -> ApiError:
+    return ApiError(409, "AlreadyExists", message)
+
+
+def conflict(message: str = "") -> ApiError:
+    return ApiError(409, "Conflict", message)
+
+
+def invalid(message: str = "") -> ApiError:
+    return ApiError(422, "Invalid", message)
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, ApiError) and err.code == 404
+
+
+def is_already_exists(err: Exception) -> bool:
+    return isinstance(err, ApiError) and err.reason == "AlreadyExists"
+
+
+def is_conflict(err: Exception) -> bool:
+    return isinstance(err, ApiError) and err.reason == "Conflict"

@@ -1,0 +1,320 @@
+"""In-memory fake apiserver (the fake-clientset test tier of SURVEY.md §4).
+
+Plays the role of ``k8s.io/client-go/kubernetes/fake.NewSimpleClientset`` plus
+the generated ``tfJobFake.Clientset`` (pkg/client/clientset/versioned/fake/):
+full CRUD + watch over unstructured objects, an action log for assertions
+(``Actions()`` in the Go fakes), label-selector list filtering, and
+owner-reference garbage collection so e2e-style tests can assert cascade
+deletion (test/e2e/main.go:151-186 behavior).
+
+Storage is keyed by (group, plural) — API versions are representations of the
+same resource, as in a real apiserver.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+from k8s_tpu.api.meta import now_rfc3339
+from k8s_tpu.client import errors
+from k8s_tpu.client.gvr import GVR
+from k8s_tpu.client.selectors import labels_match, parse_label_selector
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class Action:
+    """One recorded API call, for test assertions (Go fake Actions())."""
+
+    verb: str
+    resource: str  # plural
+    namespace: str
+    name: str = ""
+    obj: Optional[dict] = None
+
+
+class _Watch:
+    """A single watcher: an iterator over (event_type, obj) tuples."""
+
+    def __init__(self, cluster: "FakeCluster", key, namespace: Optional[str]):
+        self._q: "queue.Queue[Optional[tuple[str, dict]]]" = queue.Queue()
+        self._cluster = cluster
+        self._key = key
+        self._namespace = namespace
+        self.stopped = False
+
+    def _emit(self, event_type: str, obj: dict) -> None:
+        ns = (obj.get("metadata") or {}).get("namespace", "")
+        if self._namespace is None or ns == self._namespace:
+            self._q.put((event_type, obj))
+
+    def stop(self) -> None:
+        self.stopped = True
+        self._q.put(None)
+        self._cluster._remove_watch(self._key, self)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        return item
+
+    def next(self, timeout: Optional[float] = None):
+        """Non-magic accessor with timeout, for tests."""
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is None:
+            return None
+        return item
+
+
+class FakeCluster:
+    """Thread-safe in-memory cluster state implementing the API backend
+    protocol consumed by ``k8s_tpu.client.clientset.Clientset``."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict[tuple[str, str], dict[tuple[str, str], dict]] = {}
+        self._watches: dict[tuple[str, str], list[_Watch]] = {}
+        self._uid_counter = itertools.count(1)
+        self._rv_counter = itertools.count(1)
+        self.actions: list[Action] = []
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _key(resource: GVR) -> tuple[str, str]:
+        return (resource.group, resource.plural)
+
+    def _bucket(self, resource: GVR) -> dict[tuple[str, str], dict]:
+        return self._store.setdefault(self._key(resource), {})
+
+    def _record(self, verb, resource: GVR, namespace, name="", obj=None):
+        self.actions.append(Action(verb, resource.plural, namespace or "", name, obj))
+
+    def _notify(self, resource: GVR, event_type: str, obj: dict) -> None:
+        for w in list(self._watches.get(self._key(resource), [])):
+            w._emit(event_type, obj)
+
+    def _remove_watch(self, key, w) -> None:
+        with self._lock:
+            if w in self._watches.get(key, []):
+                self._watches[key].remove(w)
+
+    def clear_actions(self) -> None:
+        with self._lock:
+            self.actions = []
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def create(self, resource: GVR, namespace: str, obj: dict) -> dict:
+        with self._lock:
+            import copy as _copy
+
+            # A real apiserver never mutates the caller's submitted object;
+            # work on a copy so server-assigned fields (uid, rv) don't leak
+            # back and mask conflict-handling bugs under the fake.
+            obj = _copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            name = meta.get("name", "")
+            if not name and meta.get("generateName"):
+                name = meta["generateName"] + f"{next(self._uid_counter):05d}"
+                meta["name"] = name
+            if not name:
+                raise errors.invalid("metadata.name is required")
+            if resource.namespaced:
+                meta.setdefault("namespace", namespace or "default")
+            ns = meta.get("namespace", "") if resource.namespaced else ""
+            bucket = self._bucket(resource)
+            if (ns, name) in bucket:
+                raise errors.already_exists(f"{resource.plural} {ns}/{name} already exists")
+            meta.setdefault("uid", f"uid-{next(self._uid_counter)}")
+            meta["resourceVersion"] = str(next(self._rv_counter))
+            meta.setdefault("creationTimestamp", now_rfc3339())
+            obj.setdefault("apiVersion", resource.api_version)
+            obj.setdefault("kind", resource.kind)
+            stored = obj
+            bucket[(ns, name)] = stored
+            self._record("create", resource, ns, name, _copy.deepcopy(stored))
+            self._notify(resource, ADDED, _copy.deepcopy(stored))
+            return _copy.deepcopy(stored)
+
+    def get(self, resource: GVR, namespace: str, name: str) -> dict:
+        with self._lock:
+            ns = namespace if resource.namespaced else ""
+            obj = self._bucket(resource).get((ns or "", name))
+            self._record("get", resource, ns, name)
+            if obj is None:
+                raise errors.not_found(f"{resource.plural} {ns}/{name} not found")
+            import copy as _copy
+
+            return _copy.deepcopy(obj)
+
+    def list(
+        self,
+        resource: GVR,
+        namespace: Optional[str] = None,
+        label_selector=None,
+        field_selector: Optional[dict] = None,
+    ) -> list[dict]:
+        with self._lock:
+            required = parse_label_selector(label_selector)
+            out = []
+            import copy as _copy
+
+            for (ns, _name), obj in self._bucket(resource).items():
+                if namespace is not None and resource.namespaced and ns != namespace:
+                    continue
+                if not labels_match(obj, required):
+                    continue
+                if field_selector and not self._fields_match(obj, field_selector):
+                    continue
+                out.append(_copy.deepcopy(obj))
+            self._record("list", resource, namespace or "")
+            out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
+            return out
+
+    @staticmethod
+    def _fields_match(obj: dict, selector: dict) -> bool:
+        for path, want in selector.items():
+            cur: Any = obj
+            for part in path.split("."):
+                cur = (cur or {}).get(part)
+            if cur != want:
+                return False
+        return True
+
+    def update(self, resource: GVR, namespace: str, obj: dict) -> dict:
+        with self._lock:
+            meta = obj.get("metadata") or {}
+            name = meta.get("name", "")
+            ns = (meta.get("namespace", namespace) or "") if resource.namespaced else ""
+            bucket = self._bucket(resource)
+            current = bucket.get((ns, name))
+            if current is None:
+                raise errors.not_found(f"{resource.plural} {ns}/{name} not found")
+            sent_rv = meta.get("resourceVersion")
+            cur_rv = current["metadata"].get("resourceVersion")
+            if sent_rv and sent_rv != cur_rv:
+                raise errors.conflict(
+                    f"operation cannot be fulfilled on {resource.plural} {ns}/{name}: "
+                    f"object has been modified (sent rv {sent_rv}, current {cur_rv})"
+                )
+            import copy as _copy
+
+            stored = _copy.deepcopy(obj)
+            stored["metadata"]["uid"] = current["metadata"]["uid"]
+            stored["metadata"]["creationTimestamp"] = current["metadata"].get(
+                "creationTimestamp", ""
+            )
+            stored["metadata"]["resourceVersion"] = str(next(self._rv_counter))
+            bucket[(ns, name)] = stored
+            self._record("update", resource, ns, name, _copy.deepcopy(stored))
+            self._notify(resource, MODIFIED, _copy.deepcopy(stored))
+            return _copy.deepcopy(stored)
+
+    def patch_merge(self, resource: GVR, namespace: str, name: str, patch: dict) -> dict:
+        """Strategic-merge-lite: recursive dict merge (lists replaced)."""
+        with self._lock:
+            current = self.get(resource, namespace, name)
+
+            def merge(dst, src):
+                for k, v in src.items():
+                    if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                        merge(dst[k], v)
+                    elif v is None:
+                        dst.pop(k, None)
+                    else:
+                        dst[k] = v
+
+            merge(current, patch)
+            current["metadata"].pop("resourceVersion", None)  # patch never conflicts here
+            self._record("patch", resource, namespace, name, patch)
+            return self.update(resource, namespace, current)
+
+    def delete(
+        self,
+        resource: GVR,
+        namespace: str,
+        name: str,
+        propagation: str = "Background",
+    ) -> None:
+        with self._lock:
+            ns = (namespace or "") if resource.namespaced else ""
+            bucket = self._bucket(resource)
+            obj = bucket.pop((ns, name), None)
+            self._record("delete", resource, ns, name)
+            if obj is None:
+                raise errors.not_found(f"{resource.plural} {ns}/{name} not found")
+            self._notify(resource, DELETED, obj)
+            if propagation in ("Background", "Foreground"):
+                self._gc_dependents(obj["metadata"].get("uid"), ns)
+
+    def delete_collection(self, resource: GVR, namespace: str, label_selector=None) -> int:
+        with self._lock:
+            victims = self.list(resource, namespace, label_selector)
+            deleted = 0
+            for v in victims:
+                # Use each victim's own namespace: with namespace=None the
+                # caller's argument is not a valid delete target.
+                vns = v["metadata"].get("namespace", "")
+                try:
+                    self.delete(resource, vns, v["metadata"]["name"])
+                    deleted += 1
+                except errors.ApiError:
+                    pass
+            return deleted
+
+    def _gc_dependents(self, owner_uid: Optional[str], namespace: str) -> None:
+        """Owner-reference GC: cascade-delete dependents of a deleted owner."""
+        if not owner_uid:
+            return
+        for key in list(self._store):
+            bucket = self._store[key]
+            for (ns, name), obj in list(bucket.items()):
+                refs = (obj.get("metadata") or {}).get("ownerReferences") or []
+                if any(r.get("uid") == owner_uid for r in refs):
+                    group, plural = key
+                    gvr = GVR(group, obj.get("apiVersion", "v1").split("/")[-1], plural,
+                              obj.get("kind", ""))
+                    try:
+                        self.delete(gvr, ns, name)
+                    except errors.ApiError:
+                        pass
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, resource: GVR, namespace: Optional[str] = None) -> _Watch:
+        with self._lock:
+            w = _Watch(self, self._key(resource), namespace)
+            self._watches.setdefault(self._key(resource), []).append(w)
+            return w
+
+    # -- test conveniences ---------------------------------------------------
+
+    def objects(self, resource: GVR) -> Iterable[dict]:
+        with self._lock:
+            import copy as _copy
+
+            return [_copy.deepcopy(o) for o in self._bucket(resource).values()]
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str, **status_kw) -> dict:
+        """Simulate kubelet: flip a pod's status.phase (and extra status keys)."""
+        from k8s_tpu.client.gvr import PODS
+
+        pod = self.get(PODS, namespace, name)
+        pod.setdefault("status", {})["phase"] = phase
+        pod["status"].update(status_kw)
+        return self.update(PODS, namespace, pod)

@@ -1,0 +1,47 @@
+"""GroupVersionResource identifiers for every resource the operator touches."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class GVR:
+    group: str
+    version: str
+    plural: str
+    kind: str
+    namespaced: bool = True
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+    @property
+    def path_prefix(self) -> str:
+        """URL prefix: /api/v1 for core, /apis/<group>/<version> otherwise."""
+        return f"/api/{self.version}" if not self.group else f"/apis/{self.group}/{self.version}"
+
+
+PODS = GVR("", "v1", "pods", "Pod")
+SERVICES = GVR("", "v1", "services", "Service")
+EVENTS = GVR("", "v1", "events", "Event")
+NAMESPACES = GVR("", "v1", "namespaces", "Namespace", namespaced=False)
+ENDPOINTS = GVR("", "v1", "endpoints", "Endpoints")
+CONFIGMAPS = GVR("", "v1", "configmaps", "ConfigMap")
+PDBS = GVR("policy", "v1beta1", "poddisruptionbudgets", "PodDisruptionBudget")
+CRDS = GVR(
+    "apiextensions.k8s.io",
+    "v1beta1",
+    "customresourcedefinitions",
+    "CustomResourceDefinition",
+    namespaced=False,
+)
+TFJOBS_V1ALPHA1 = GVR("kubeflow.org", "v1alpha1", "tfjobs", "TFJob")
+TFJOBS_V1ALPHA2 = GVR("kubeflow.org", "v1alpha2", "tfjobs", "TFJob")
+
+
+def tfjobs_gvr(api_version: str) -> GVR:
+    if api_version.endswith("v1alpha1"):
+        return TFJOBS_V1ALPHA1
+    return TFJOBS_V1ALPHA2

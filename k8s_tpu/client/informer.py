@@ -1,0 +1,249 @@
+"""Informer/lister machinery (reference: pkg/client/informers/, listers/, and
+the unstructured informer at pkg/util/unstructured/informer.go).
+
+A ``SharedInformer`` runs a reflector thread (list + watch against the
+backend), maintains a thread-safe ``Store`` keyed ``namespace/name``, and
+dispatches add/update/delete handlers — the shape the v2 controller consumes
+(pkg/controller.v2/controller.go:156-239).  ``SharedInformerFactory`` dedupes
+informers per resource (factory.go behavior) and supports a resync period
+(reference default 30 s: cmd/tf-operator/app/server.go:86) that re-delivers
+every cached object as an update, driving the periodic reconcile.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from k8s_tpu.client.gvr import GVR
+
+log = logging.getLogger(__name__)
+
+
+def meta_namespace_key(obj: dict) -> str:
+    """cache.MetaNamespaceKeyFunc: 'namespace/name' (or 'name')."""
+    meta = obj.get("metadata") or {}
+    ns, name = meta.get("namespace", ""), meta.get("name", "")
+    return f"{ns}/{name}" if ns else name
+
+
+def split_meta_namespace_key(key: str) -> tuple[str, str]:
+    """cache.SplitMetaNamespaceKey."""
+    if "/" in key:
+        ns, _, name = key.partition("/")
+        return ns, name
+    return "", key
+
+
+class Store:
+    """Thread-safe object cache keyed by namespace/name."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._items: dict[str, dict] = {}
+
+    def replace(self, objs: list[dict]) -> None:
+        with self._lock:
+            self._items = {meta_namespace_key(o): o for o in objs}
+
+    def add(self, obj: dict) -> None:
+        with self._lock:
+            self._items[meta_namespace_key(obj)] = obj
+
+    def delete(self, obj: dict) -> None:
+        with self._lock:
+            self._items.pop(meta_namespace_key(obj), None)
+
+    def get_by_key(self, key: str) -> Optional[dict]:
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return list(self._items.values())
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._items.keys())
+
+
+class SharedInformer:
+    """List+watch reflector with handler fan-out over one resource."""
+
+    def __init__(self, backend, resource: GVR, namespace: Optional[str] = None,
+                 resync_period: float = 30.0):
+        self.backend = backend
+        self.resource = resource
+        self.namespace = namespace
+        self.resync_period = resync_period
+        self.store = Store()
+        self._handlers: list[dict[str, Callable]] = []
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._active_watch = None
+        self._watch_lock = threading.Lock()
+
+    # handler dict keys: on_add(obj), on_update(old, new), on_delete(obj)
+    def add_event_handler(self, on_add=None, on_update=None, on_delete=None) -> None:
+        self._handlers.append(
+            {"add": on_add, "update": on_update, "delete": on_delete}
+        )
+
+    def _dispatch(self, kind: str, *args) -> None:
+        for h in self._handlers:
+            fn = h.get(kind)
+            if fn is None:
+                continue
+            try:
+                fn(*args)
+            except Exception:  # a broken handler must not kill the reflector
+                log.exception("informer handler error (%s %s)", kind, self.resource.plural)
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def run(self) -> None:
+        """Start reflector + resync threads (returns immediately)."""
+        t = threading.Thread(target=self._reflector_loop, daemon=True,
+                             name=f"informer-{self.resource.plural}")
+        t.start()
+        self._threads.append(t)
+        if self.resync_period and self.resync_period > 0:
+            rt = threading.Thread(target=self._resync_loop, daemon=True,
+                                  name=f"resync-{self.resource.plural}")
+            rt.start()
+            self._threads.append(rt)
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Close any in-flight watch so a reflector blocked on a socket read
+        # (REST backend) unblocks instead of leaking the thread + connection.
+        with self._watch_lock:
+            if self._active_watch is not None:
+                try:
+                    self._active_watch.stop()
+                except Exception:
+                    pass
+
+    def _reflector_loop(self) -> None:
+        backoff = 0.1
+        while not self._stop.is_set():
+            try:
+                objs = self.backend.list(self.resource, self.namespace)
+                old_keys = set(self.store.keys())
+                self.store.replace(objs)
+                for o in objs:
+                    key = meta_namespace_key(o)
+                    if key in old_keys:
+                        self._dispatch("update", o, o)
+                    else:
+                        self._dispatch("add", o)
+                new_keys = {meta_namespace_key(o) for o in objs}
+                # relist-detected deletions
+                for key in old_keys - new_keys:
+                    self._dispatch("delete", {"metadata": dict(zip(("namespace", "name"),
+                                                                   split_meta_namespace_key(key)))})
+                self._synced.set()
+                backoff = 0.1
+                w = self.backend.watch(self.resource, self.namespace)
+                with self._watch_lock:
+                    self._active_watch = w
+                try:
+                    self._consume_watch(w)
+                finally:
+                    with self._watch_lock:
+                        self._active_watch = None
+                    w.stop()
+            except Exception:
+                if self._stop.is_set():
+                    return
+                log.exception("reflector relist for %s", self.resource.plural)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    def _consume_watch(self, w) -> None:
+        while not self._stop.is_set():
+            item = w.next(timeout=0.2)
+            if item is None:
+                if getattr(w, "stopped", False):
+                    return
+                continue
+            event_type, obj = item
+            old = self.store.get_by_key(meta_namespace_key(obj))
+            if event_type == "ADDED":
+                self.store.add(obj)
+                self._dispatch("add", obj)
+            elif event_type == "MODIFIED":
+                self.store.add(obj)
+                self._dispatch("update", old if old is not None else obj, obj)
+            elif event_type == "DELETED":
+                self.store.delete(obj)
+                self._dispatch("delete", obj)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_period):
+            for o in self.store.list():
+                self._dispatch("update", o, o)
+
+
+class Lister:
+    """Read-only view over an informer's store (reference: pkg/client/listers)."""
+
+    def __init__(self, informer: SharedInformer):
+        self._informer = informer
+
+    def get(self, namespace: str, name: str) -> Optional[dict]:
+        key = f"{namespace}/{name}" if namespace else name
+        return self._informer.store.get_by_key(key)
+
+    def list(self, namespace: Optional[str] = None, label_selector=None) -> list[dict]:
+        from k8s_tpu.client.selectors import labels_match, parse_label_selector
+
+        required = parse_label_selector(label_selector)
+        out = []
+        for o in self._informer.store.list():
+            if namespace and (o.get("metadata") or {}).get("namespace") != namespace:
+                continue
+            if required and not labels_match(o, required):
+                continue
+            out.append(o)
+        return out
+
+
+class SharedInformerFactory:
+    """Dedupe informers per resource (reference: externalversions/factory.go)."""
+
+    def __init__(self, backend, namespace: Optional[str] = None, resync_period: float = 30.0):
+        self.backend = backend
+        self.namespace = namespace
+        self.resync_period = resync_period
+        self._informers: dict = {}
+
+    def informer_for(self, resource: GVR) -> SharedInformer:
+        key = (resource.group, resource.plural)
+        if key not in self._informers:
+            self._informers[key] = SharedInformer(
+                self.backend, resource, self.namespace, self.resync_period
+            )
+        return self._informers[key]
+
+    def lister_for(self, resource: GVR) -> Lister:
+        return Lister(self.informer_for(resource))
+
+    def start(self) -> None:
+        for inf in self._informers.values():
+            if not inf._threads:
+                inf.run()
+
+    def stop(self) -> None:
+        for inf in self._informers.values():
+            inf.stop()
+
+    def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
+        return all(i.wait_for_cache_sync(timeout) for i in self._informers.values())

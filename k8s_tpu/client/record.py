@@ -1,0 +1,72 @@
+"""Event recorder (k8s.io/client-go/tools/record equivalent).
+
+K8s Events are load-bearing telemetry in this system: the e2e harness asserts
+on pod/service create events (py/test_runner.py:301-332), so controllers must
+record them faithfully (pkg/trainer/replicas.go:470-506,
+pkg/controller.v2/service_control.go:96-112).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from k8s_tpu.api.meta import now_rfc3339
+from k8s_tpu.client.clientset import Clientset
+
+log = logging.getLogger(__name__)
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+class EventRecorder:
+    """Records events attached to an involved object, apiserver-backed."""
+
+    def __init__(self, clientset: Clientset, component: str):
+        self.clientset = clientset
+        self.component = component
+
+    def event(self, involved: dict, event_type: str, reason: str, message: str) -> None:
+        meta = involved.get("metadata") or {}
+        ns = meta.get("namespace", "default")
+        # Nanosecond suffix like client-go: unique across operator restarts
+        # and replicas, where a per-process counter would collide.
+        n = time.time_ns()
+        ev = {
+            "metadata": {"name": f"{meta.get('name', 'unknown')}.{n:x}", "namespace": ns},
+            "involvedObject": {
+                "kind": involved.get("kind", ""),
+                "namespace": ns,
+                "name": meta.get("name", ""),
+                "uid": meta.get("uid", ""),
+                "apiVersion": involved.get("apiVersion", ""),
+            },
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "source": {"component": self.component},
+            "firstTimestamp": now_rfc3339(),
+            "lastTimestamp": now_rfc3339(),
+            "count": 1,
+        }
+        try:
+            self.clientset.events(ns).create(ev)
+        except Exception:
+            log.exception("failed to record event %s/%s", reason, message)
+
+    def eventf(self, involved: dict, event_type: str, reason: str, fmt: str, *args) -> None:
+        self.event(involved, event_type, reason, fmt % args if args else fmt)
+
+
+class FakeRecorder:
+    """record.NewFakeRecorder equivalent: captures events in-memory."""
+
+    def __init__(self):
+        self.events: list[str] = []
+
+    def event(self, involved: dict, event_type: str, reason: str, message: str) -> None:
+        self.events.append(f"{event_type} {reason} {message}")
+
+    def eventf(self, involved: dict, event_type: str, reason: str, fmt: str, *args) -> None:
+        self.event(involved, event_type, reason, fmt % args if args else fmt)

@@ -1,0 +1,26 @@
+"""Label-selector parsing/matching shared by the fake, REST, and lister tiers."""
+
+from __future__ import annotations
+
+
+def parse_label_selector(selector) -> dict[str, str]:
+    """Accept 'a=b,c=d' strings or dicts; returns the required label map."""
+    if not selector:
+        return {}
+    if isinstance(selector, dict):
+        return dict(selector)
+    out = {}
+    for part in selector.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"unsupported label selector term: {part!r}")
+        k, _, v = part.partition("=")
+        out[k.strip()] = v.lstrip("=").strip()  # tolerate 'a==b'
+    return out
+
+
+def labels_match(obj: dict, required: dict[str, str]) -> bool:
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    return all(labels.get(k) == v for k, v in required.items())

@@ -1,0 +1,195 @@
+"""Client machinery tests: fake cluster CRUD/watch/GC, clientset, informers."""
+
+import time
+
+import pytest
+
+from k8s_tpu.api import v1alpha2
+from k8s_tpu.api.meta import ObjectMeta
+from k8s_tpu.client import ApiError, Clientset, FakeCluster
+from k8s_tpu.client.gvr import PODS, SERVICES, TFJOBS_V1ALPHA2
+from k8s_tpu.client.informer import Lister, SharedInformerFactory
+
+
+def _pod(name, ns="default", labels=None, owner_uid=None):
+    p = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": [{"name": "tensorflow", "image": "img"}]},
+    }
+    if owner_uid:
+        p["metadata"]["ownerReferences"] = [
+            {"apiVersion": "kubeflow.org/v1alpha2", "kind": "TFJob", "name": "j",
+             "uid": owner_uid, "controller": True}
+        ]
+    return p
+
+
+class TestFakeClusterCRUD:
+    def test_create_assigns_metadata(self):
+        cs = Clientset(FakeCluster())
+        pod = cs.pods("default").create(_pod("p1"))
+        assert pod["metadata"]["uid"]
+        assert pod["metadata"]["resourceVersion"]
+        assert pod["metadata"]["creationTimestamp"]
+
+    def test_create_duplicate_rejected(self):
+        cs = Clientset(FakeCluster())
+        cs.pods("default").create(_pod("p1"))
+        with pytest.raises(ApiError) as e:
+            cs.pods("default").create(_pod("p1"))
+        assert e.value.reason == "AlreadyExists"
+
+    def test_get_not_found(self):
+        cs = Clientset(FakeCluster())
+        with pytest.raises(ApiError) as e:
+            cs.pods("default").get("nope")
+        assert e.value.code == 404
+
+    def test_update_conflict_on_stale_rv(self):
+        cs = Clientset(FakeCluster())
+        pod = cs.pods("default").create(_pod("p1"))
+        stale = dict(pod, metadata=dict(pod["metadata"]))
+        cs.pods("default").update(pod)  # bumps rv
+        with pytest.raises(ApiError) as e:
+            cs.pods("default").update(stale)
+        assert e.value.reason == "Conflict"
+
+    def test_list_label_selector(self):
+        cs = Clientset(FakeCluster())
+        cs.pods("default").create(_pod("a", labels={"app": "x", "idx": "0"}))
+        cs.pods("default").create(_pod("b", labels={"app": "y"}))
+        got = cs.pods("default").list(label_selector="app=x")
+        assert [p["metadata"]["name"] for p in got] == ["a"]
+        got = cs.pods("default").list(label_selector={"app": "x", "idx": "0"})
+        assert len(got) == 1
+
+    def test_namespace_isolation(self):
+        cs = Clientset(FakeCluster())
+        cs.pods("ns1").create(_pod("a", ns="ns1"))
+        cs.pods("ns2").create(_pod("a", ns="ns2"))
+        assert len(cs.pods("ns1").list()) == 1
+
+    def test_patch_merge(self):
+        cs = Clientset(FakeCluster())
+        cs.pods("default").create(_pod("p1", labels={"keep": "1"}))
+        out = cs.pods("default").patch("p1", {"metadata": {"labels": {"new": "2"}}})
+        assert out["metadata"]["labels"] == {"keep": "1", "new": "2"}
+
+    def test_owner_gc_cascade(self):
+        """Deleting a TFJob deletes owned pods/services (e2e main.go:151-186)."""
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        job = cs.tfjobs("default").create(
+            v1alpha2.TFJob(metadata=ObjectMeta(name="j", namespace="default"))
+        )
+        uid = job.metadata.uid
+        cs.pods("default").create(_pod("j-worker-0", owner_uid=uid))
+        svc = _pod("j-worker-0", owner_uid=uid)
+        svc.update({"apiVersion": "v1", "kind": "Service"})
+        cs.services("default").create(svc)
+        cs.tfjobs("default").delete("j")
+        assert cs.pods("default").list() == []
+        assert cs.services("default").list() == []
+
+    def test_actions_log(self):
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        cs.pods("default").create(_pod("p1"))
+        verbs = [(a.verb, a.resource) for a in fc.actions]
+        assert ("create", "pods") in verbs
+
+
+class TestWatch:
+    def test_watch_delivers_add_update_delete(self):
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        w = fc.watch(PODS, "default")
+        cs.pods("default").create(_pod("p1"))
+        t, obj = w.next(timeout=1)
+        assert t == "ADDED" and obj["metadata"]["name"] == "p1"
+        fc.set_pod_phase("default", "p1", "Running")
+        t, obj = w.next(timeout=1)
+        assert t == "MODIFIED" and obj["status"]["phase"] == "Running"
+        cs.pods("default").delete("p1")
+        t, _ = w.next(timeout=1)
+        assert t == "DELETED"
+        w.stop()
+
+    def test_watch_namespace_filter(self):
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        w = fc.watch(PODS, "other")
+        cs.pods("default").create(_pod("p1"))
+        assert w.next(timeout=0.1) is None
+        w.stop()
+
+
+class TestInformer:
+    def test_informer_syncs_and_dispatches(self):
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        cs.pods("default").create(_pod("pre-existing"))
+        factory = SharedInformerFactory(fc, resync_period=0)
+        inf = factory.informer_for(PODS)
+        adds, updates, deletes = [], [], []
+        inf.add_event_handler(
+            on_add=lambda o: adds.append(o["metadata"]["name"]),
+            on_update=lambda old, new: updates.append(new["metadata"]["name"]),
+            on_delete=lambda o: deletes.append(o["metadata"]["name"]),
+        )
+        factory.start()
+        assert factory.wait_for_cache_sync(5)
+        cs.pods("default").create(_pod("live"))
+        fc.set_pod_phase("default", "live", "Running")
+        cs.pods("default").delete("live")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "live" not in deletes:
+            time.sleep(0.02)
+        factory.stop()
+        assert "pre-existing" in adds and "live" in adds
+        assert "live" in updates
+        assert "live" in deletes
+
+    def test_lister_reads_from_store(self):
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        cs.pods("default").create(_pod("a", labels={"app": "z"}))
+        factory = SharedInformerFactory(fc, resync_period=0)
+        lister = factory.lister_for(PODS)
+        factory.start()
+        assert factory.wait_for_cache_sync(5)
+        assert lister.get("default", "a")["metadata"]["name"] == "a"
+        assert len(lister.list("default", label_selector="app=z")) == 1
+        assert lister.list("default", label_selector="app=q") == []
+        factory.stop()
+
+    def test_factory_dedupes_informers(self):
+        factory = SharedInformerFactory(FakeCluster())
+        assert factory.informer_for(PODS) is factory.informer_for(PODS)
+        assert factory.informer_for(PODS) is not factory.informer_for(SERVICES)
+
+
+class TestTypedTFJobClient:
+    def test_typed_roundtrip(self):
+        cs = Clientset(FakeCluster())
+        job = v1alpha2.TFJob(
+            metadata=ObjectMeta(name="j1", namespace="default"),
+            spec=v1alpha2.TFJobSpec(
+                tf_replica_specs={
+                    "Worker": v1alpha2.TFReplicaSpec(
+                        replicas=2,
+                        template={"spec": {"containers": [{"name": "tensorflow"}]}},
+                    )
+                }
+            ),
+        )
+        created = cs.tfjobs("default").create(job)
+        assert isinstance(created, v1alpha2.TFJob)
+        assert created.metadata.uid
+        got = cs.tfjobs("default").get("j1")
+        assert got.spec.tf_replica_specs["Worker"].replicas == 2
+        got.spec.tf_replica_specs["Worker"].replicas = 3
+        updated = cs.tfjobs("default").update(got)
+        assert updated.spec.tf_replica_specs["Worker"].replicas == 3

@@ -1,0 +1,95 @@
+"""RestClient wire-path test against a minimal in-process HTTP apiserver."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_tpu.client.errors import ApiError
+from k8s_tpu.client.gvr import PODS, TFJOBS_V1ALPHA2
+from k8s_tpu.client.rest import ClusterConfig, RestClient
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store = {}
+
+    def log_message(self, *args):
+        pass
+
+    def _send(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        if path in self.store:
+            self._send(200, self.store[path])
+        elif path.rstrip("/").endswith(("pods", "tfjobs")):
+            items = [v for k, v in self.store.items() if k.startswith(path)]
+            self._send(200, {"kind": "List", "items": items})
+        else:
+            self._send(404, {"reason": "NotFound", "message": f"{path} not found"})
+
+    def do_POST(self):
+        length = int(self.headers["Content-Length"])
+        obj = json.loads(self.rfile.read(length))
+        name = obj["metadata"]["name"]
+        self.store[f"{self.path.split('?')[0]}/{name}"] = obj
+        # record auth header for assertion
+        _Handler.last_auth = self.headers.get("Authorization")
+        self._send(201, obj)
+
+    def do_PUT(self):
+        length = int(self.headers["Content-Length"])
+        obj = json.loads(self.rfile.read(length))
+        self.store[self.path.split("?")[0]] = obj
+        self._send(200, obj)
+
+    def do_DELETE(self):
+        path = self.path.split("?")[0]
+        if self.store.pop(path, None) is None:
+            self._send(404, {"reason": "NotFound"})
+        else:
+            self._send(200, {"status": "Success"})
+
+
+@pytest.fixture()
+def server():
+    _Handler.store = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_rest_crud_paths_and_auth(server):
+    client = RestClient(ClusterConfig(host=server, token="sekret"))
+    pod = {"metadata": {"name": "p1", "namespace": "ns1"}}
+    created = client.create(PODS, "ns1", pod)
+    assert created["metadata"]["name"] == "p1"
+    assert _Handler.last_auth == "Bearer sekret"
+    # core-group path layout: /api/v1/namespaces/<ns>/pods/<name>
+    assert "/api/v1/namespaces/ns1/pods/p1" in _Handler.store
+    got = client.get(PODS, "ns1", "p1")
+    assert got["metadata"]["name"] == "p1"
+    assert [p["metadata"]["name"] for p in client.list(PODS, "ns1")] == ["p1"]
+    client.delete(PODS, "ns1", "p1")
+    with pytest.raises(ApiError) as e:
+        client.get(PODS, "ns1", "p1")
+    assert e.value.code == 404
+
+
+def test_rest_crd_group_path(server):
+    client = RestClient(ClusterConfig(host=server))
+    job = {"metadata": {"name": "j1", "namespace": "ns1"}, "spec": {}}
+    client.create(TFJOBS_V1ALPHA2, "ns1", job)
+    # CRD path layout: /apis/kubeflow.org/v1alpha2/namespaces/<ns>/tfjobs/<name>
+    assert "/apis/kubeflow.org/v1alpha2/namespaces/ns1/tfjobs/j1" in _Handler.store
+    got = client.get(TFJOBS_V1ALPHA2, "ns1", "j1")
+    assert got["kind"] == "TFJob"
