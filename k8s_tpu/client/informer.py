@@ -136,19 +136,24 @@ class SharedInformer:
         while not self._stop.is_set():
             try:
                 objs = self.backend.list(self.resource, self.namespace)
-                old_keys = set(self.store.keys())
+                # Snapshot the pre-relist cache so handlers see REAL old
+                # objects: update handlers compare resourceVersions (a
+                # same-object echo would suppress changes recovered across a
+                # watch gap) and delete handlers need labels/ownerRefs to
+                # unwind expectations.
+                old_objs = {meta_namespace_key(o): o for o in self.store.list()}
                 self.store.replace(objs)
                 for o in objs:
                     key = meta_namespace_key(o)
-                    if key in old_keys:
-                        self._dispatch("update", o, o)
+                    if key in old_objs:
+                        self._dispatch("update", old_objs[key], o)
                     else:
                         self._dispatch("add", o)
                 new_keys = {meta_namespace_key(o) for o in objs}
-                # relist-detected deletions
-                for key in old_keys - new_keys:
-                    self._dispatch("delete", {"metadata": dict(zip(("namespace", "name"),
-                                                                   split_meta_namespace_key(key)))})
+                # relist-detected deletions, dispatched with the last-known
+                # full object (cache.DeletedFinalStateUnknown analogue)
+                for key in set(old_objs) - new_keys:
+                    self._dispatch("delete", old_objs[key])
                 self._synced.set()
                 backoff = 0.1
                 w = self.backend.watch(self.resource, self.namespace)
@@ -193,16 +198,25 @@ class SharedInformer:
 
 
 class Lister:
-    """Read-only view over an informer's store (reference: pkg/client/listers)."""
+    """Read-only view over an informer's store (reference: pkg/client/listers).
+
+    Returns **copies**: client-go forbids mutating informer-cache objects
+    (controllers default and patch what listers hand them), and handing out
+    the cached dicts would let a sync thread race the reflector."""
 
     def __init__(self, informer: SharedInformer):
         self._informer = informer
 
     def get(self, namespace: str, name: str) -> Optional[dict]:
+        import copy
+
         key = f"{namespace}/{name}" if namespace else name
-        return self._informer.store.get_by_key(key)
+        obj = self._informer.store.get_by_key(key)
+        return copy.deepcopy(obj) if obj is not None else None
 
     def list(self, namespace: Optional[str] = None, label_selector=None) -> list[dict]:
+        import copy
+
         from k8s_tpu.client.selectors import labels_match, parse_label_selector
 
         required = parse_label_selector(label_selector)
@@ -212,7 +226,7 @@ class Lister:
                 continue
             if required and not labels_match(o, required):
                 continue
-            out.append(o)
+            out.append(copy.deepcopy(o))
         return out
 
 

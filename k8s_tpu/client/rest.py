@@ -13,7 +13,6 @@ from __future__ import annotations
 import json
 import os
 import ssl
-import threading
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass
@@ -177,7 +176,6 @@ class RestClient:
     def __init__(self, config: Optional[ClusterConfig] = None):
         self.config = config or get_cluster_config()
         self._ctx = self.config.ssl_context()
-        self._local = threading.local()
 
     # -- plumbing ------------------------------------------------------------
 

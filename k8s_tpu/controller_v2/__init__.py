@@ -1,0 +1,2 @@
+"""Controller v2: stateless informer/expectations reconciler
+(reference: pkg/controller.v2/)."""

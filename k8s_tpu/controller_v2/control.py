@@ -1,0 +1,191 @@
+"""Pod/Service control seams (reference: upstream PodControl +
+pkg/controller.v2/service_control.go).
+
+These exist as interfaces *specifically because* they are the fake points for
+the controller test tier (controller_test.go:65-66): tests swap in
+``FakePodControl``/``FakeServiceControl`` to capture creates/deletes without
+an apiserver.  The real implementations validate the controller ref, create
+via the clientset, and record K8s events (service_control.go:69-115).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+
+from k8s_tpu.api.meta import OwnerReference
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.record import EventRecorder
+
+log = logging.getLogger(__name__)
+
+FAILED_CREATE_POD_REASON = "FailedCreate"
+SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreate"
+FAILED_DELETE_POD_REASON = "FailedDelete"
+SUCCESSFUL_DELETE_POD_REASON = "SuccessfulDelete"
+
+
+def _validate_controller_ref(ref: OwnerReference) -> None:
+    """RealPodControl.createPods validation (upstream pod_control semantics)."""
+    if ref is None:
+        raise ValueError("controllerRef is required")
+    if not ref.api_version or not ref.kind or not ref.name or not ref.uid:
+        raise ValueError(f"controllerRef is incomplete: {ref}")
+    if not ref.controller:
+        raise ValueError("controllerRef.controller must be true")
+
+
+def _pod_from_template(template: dict, controller_ref: OwnerReference) -> dict:
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": copy.deepcopy(template.get("metadata") or {}),
+        "spec": copy.deepcopy(template.get("spec") or {}),
+    }
+    pod["metadata"]["ownerReferences"] = [controller_ref.to_dict()]
+    return pod
+
+
+class RealPodControl:
+    def __init__(self, clientset: Clientset, recorder):
+        self.clientset = clientset
+        self.recorder = recorder
+
+    def create_pods_with_controller_ref(
+        self, namespace: str, template: dict, controller_obj: dict, controller_ref: OwnerReference
+    ) -> dict:
+        _validate_controller_ref(controller_ref)
+        pod = _pod_from_template(template, controller_ref)
+        try:
+            created = self.clientset.pods(namespace).create(pod)
+        except Exception as e:
+            self.recorder.eventf(
+                controller_obj, "Warning", FAILED_CREATE_POD_REASON,
+                "Error creating: %s", e,
+            )
+            raise
+        self.recorder.eventf(
+            controller_obj, "Normal", SUCCESSFUL_CREATE_POD_REASON,
+            "Created pod: %s", created["metadata"]["name"],
+        )
+        return created
+
+    def delete_pod(self, namespace: str, name: str, controller_obj: dict) -> None:
+        try:
+            self.clientset.pods(namespace).delete(name)
+        except Exception as e:
+            self.recorder.eventf(
+                controller_obj, "Warning", FAILED_DELETE_POD_REASON,
+                "Error deleting: %s", e,
+            )
+            raise
+        self.recorder.eventf(
+            controller_obj, "Normal", SUCCESSFUL_DELETE_POD_REASON,
+            "Deleted pod: %s", name,
+        )
+
+    def patch_pod(self, namespace: str, name: str, patch: dict) -> None:
+        self.clientset.pods(namespace).patch(name, patch)
+
+
+class RealServiceControl:
+    """service_control.go:69-115."""
+
+    def __init__(self, clientset: Clientset, recorder):
+        self.clientset = clientset
+        self.recorder = recorder
+
+    def create_services_with_controller_ref(
+        self, namespace: str, service: dict, controller_obj: dict, controller_ref: OwnerReference
+    ) -> dict:
+        _validate_controller_ref(controller_ref)
+        svc = copy.deepcopy(service)
+        svc.setdefault("apiVersion", "v1")
+        svc.setdefault("kind", "Service")
+        svc.setdefault("metadata", {})["ownerReferences"] = [controller_ref.to_dict()]
+        try:
+            created = self.clientset.services(namespace).create(svc)
+        except Exception as e:
+            self.recorder.eventf(
+                controller_obj, "Warning", FAILED_CREATE_POD_REASON,
+                "Error creating: %s", e,
+            )
+            raise
+        self.recorder.eventf(
+            controller_obj, "Normal", SUCCESSFUL_CREATE_POD_REASON,
+            "Created service: %s", created["metadata"]["name"],
+        )
+        return created
+
+    def delete_service(self, namespace: str, name: str, controller_obj: dict) -> None:
+        try:
+            self.clientset.services(namespace).delete(name)
+        except Exception as e:
+            self.recorder.eventf(
+                controller_obj, "Warning", FAILED_DELETE_POD_REASON,
+                "Error deleting: %s", e,
+            )
+            raise
+        self.recorder.eventf(
+            controller_obj, "Normal", SUCCESSFUL_DELETE_POD_REASON,
+            "Deleted service: %s", name,
+        )
+
+    def patch_service(self, namespace: str, name: str, patch: dict) -> None:
+        self.clientset.services(namespace).patch(name, patch)
+
+
+class FakePodControl:
+    """controller.FakePodControl: captures templates/deletions for asserts."""
+
+    def __init__(self):
+        self.templates: list[dict] = []
+        self.controller_refs: list[OwnerReference] = []
+        self.delete_pod_names: list[str] = []
+        self.patches: list[dict] = []
+        self.create_error: Exception | None = None
+
+    def create_pods_with_controller_ref(self, namespace, template, controller_obj, controller_ref):
+        _validate_controller_ref(controller_ref)
+        if self.create_error is not None:
+            raise self.create_error
+        self.templates.append(copy.deepcopy(template))
+        self.controller_refs.append(controller_ref)
+        return _pod_from_template(template, controller_ref)
+
+    def delete_pod(self, namespace, name, controller_obj):
+        self.delete_pod_names.append(name)
+
+    def patch_pod(self, namespace, name, patch):
+        self.patches.append(patch)
+
+    def clear(self):
+        self.__init__()
+
+
+class FakeServiceControl:
+    """service_control.go:117-175."""
+
+    def __init__(self):
+        self.services: list[dict] = []
+        self.controller_refs: list[OwnerReference] = []
+        self.delete_service_names: list[str] = []
+        self.patches: list[dict] = []
+        self.create_error: Exception | None = None
+
+    def create_services_with_controller_ref(self, namespace, service, controller_obj, controller_ref):
+        _validate_controller_ref(controller_ref)
+        if self.create_error is not None:
+            raise self.create_error
+        self.services.append(copy.deepcopy(service))
+        self.controller_refs.append(controller_ref)
+        return copy.deepcopy(service)
+
+    def delete_service(self, namespace, name, controller_obj):
+        self.delete_service_names.append(name)
+
+    def patch_service(self, namespace, name, patch):
+        self.patches.append(patch)
+
+    def clear(self):
+        self.__init__()

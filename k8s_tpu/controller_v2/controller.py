@@ -1,0 +1,363 @@
+"""TFJobController v2 (reference: pkg/controller.v2/controller.go).
+
+Stateless reconciler: three informers (TFJobs unstructured, Pods, Services)
+feed a rate-limited workqueue; workers sync one job key at a time.  The
+expectations cache dedupes creates between a create call and its informer
+echo (controller.go:417-436).
+
+Feature restored from v1 that the reference's v2 had not re-grown
+(SURVEY.md §1): gang scheduling — a PodDisruptionBudget with
+``minAvailable = Σreplicas`` guarding the whole job (pkg/trainer/
+training.go:450-511), default-on for jobs with a TPU gang since a partial
+slice cannot initialize at all.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from k8s_tpu.api import register, validation
+from k8s_tpu.api.meta import now_rfc3339
+from k8s_tpu.api.v1alpha2 import types
+from k8s_tpu.client import errors
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.gvr import PODS, SERVICES, TFJOBS_V1ALPHA2
+from k8s_tpu.client.informer import SharedInformerFactory, split_meta_namespace_key
+from k8s_tpu.client.record import EventRecorder
+from k8s_tpu.controller_v2 import pod as pod_mod
+from k8s_tpu.controller_v2 import service as service_mod
+from k8s_tpu.controller_v2 import status as status_mod
+from k8s_tpu.controller_v2 import tpu_config
+from k8s_tpu.controller_v2.control import RealPodControl, RealServiceControl
+from k8s_tpu.controller_v2.expectations import ControllerExpectations
+from k8s_tpu.util.workqueue import RateLimitingQueue
+
+log = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "tpu-job-controller-v2"
+
+
+class TFJobController:
+    def __init__(
+        self,
+        clientset: Clientset,
+        informer_factory: SharedInformerFactory | None = None,
+        enable_gang_scheduling: bool = True,
+        pod_control=None,
+        service_control=None,
+        recorder=None,
+    ):
+        self.clientset = clientset
+        self.recorder = recorder or EventRecorder(clientset, CONTROLLER_NAME)
+        self.pod_control = pod_control or RealPodControl(clientset, self.recorder)
+        self.service_control = service_control or RealServiceControl(clientset, self.recorder)
+        self.expectations = ControllerExpectations()
+        self.enable_gang_scheduling = enable_gang_scheduling
+        self.queue = RateLimitingQueue()
+
+        self.pod_reconciler = pod_mod.PodReconciler(
+            self.pod_control, self.expectations, self.recorder
+        )
+        self.service_reconciler = service_mod.ServiceReconciler(
+            self.service_control, self.expectations
+        )
+
+        factory = informer_factory or SharedInformerFactory(clientset.backend)
+        self.factory = factory
+        self.tfjob_informer = factory.informer_for(TFJOBS_V1ALPHA2)
+        self.pod_informer = factory.informer_for(PODS)
+        self.service_informer = factory.informer_for(SERVICES)
+        self.tfjob_lister = factory.lister_for(TFJOBS_V1ALPHA2)
+        self.pod_lister = factory.lister_for(PODS)
+        self.service_lister = factory.lister_for(SERVICES)
+
+        # seam overridden by tests (controller_test.go updateStatusHandler)
+        self.update_status_handler = self._update_tfjob_status
+
+        self._wire_handlers()
+        self._workers: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- wiring --------------------------------------------------------------
+
+    def _wire_handlers(self) -> None:
+        self.tfjob_informer.add_event_handler(
+            on_add=self._add_tfjob,
+            on_update=lambda old, new: self.enqueue_key(self._key_of(new)),
+            on_delete=self._delete_tfjob,
+        )
+        add_pod, update_pod, delete_pod = pod_mod.make_pod_event_handlers(self)
+        self.pod_informer.add_event_handler(
+            on_add=add_pod, on_update=update_pod, on_delete=delete_pod
+        )
+        add_svc, update_svc, delete_svc = service_mod.make_service_event_handlers(self)
+        self.service_informer.add_event_handler(
+            on_add=add_svc, on_update=update_svc, on_delete=delete_svc
+        )
+
+    @staticmethod
+    def _key_of(obj: dict) -> str:
+        meta = obj.get("metadata") or {}
+        ns, name = meta.get("namespace", ""), meta.get("name", "")
+        return f"{ns}/{name}" if ns else name
+
+    def _add_tfjob(self, obj: dict) -> None:
+        self.enqueue_key(self._key_of(obj))
+
+    def _delete_tfjob(self, obj: dict) -> None:
+        key = self._key_of(obj)
+        for rtype in (obj.get("spec") or {}).get("tfReplicaSpecs") or {}:
+            self.expectations.delete_expectations(
+                pod_mod.gen_expectation_pods_key(key, rtype.lower())
+            )
+            self.expectations.delete_expectations(
+                service_mod.gen_expectation_services_key(key, rtype.lower())
+            )
+
+    def enqueue_tfjob(self, tfjob) -> None:
+        self.enqueue_key(tpu_config.tfjob_key(tfjob))
+
+    def enqueue_key(self, key: str) -> None:
+        self.queue.add(key)
+
+    # -- run loop ------------------------------------------------------------
+
+    def run(self, threadiness: int = 1, stop_event: threading.Event | None = None) -> None:
+        """controller.go:245-284: start informers, wait for sync, run workers.
+        Blocks until ``stop_event`` (or internal stop) fires."""
+        stop = stop_event or self._stop
+        log.info("Starting %s", CONTROLLER_NAME)
+        self.factory.start()
+        if not self.factory.wait_for_cache_sync(30):
+            raise RuntimeError("failed to wait for caches to sync")
+        for i in range(threadiness):
+            t = threading.Thread(target=self._run_worker, daemon=True, name=f"worker-{i}")
+            t.start()
+            self._workers.append(t)
+        stop.wait()
+        self.shutdown()
+
+    def start(self, threadiness: int = 1) -> None:
+        """Non-blocking run (tests, embedding)."""
+        self.factory.start()
+        if not self.factory.wait_for_cache_sync(30):
+            raise RuntimeError("failed to wait for caches to sync")
+        for i in range(threadiness):
+            t = threading.Thread(target=self._run_worker, daemon=True, name=f"worker-{i}")
+            t.start()
+            self._workers.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        self.factory.stop()
+
+    def _run_worker(self) -> None:
+        while self._process_next_work_item():
+            pass
+
+    def _process_next_work_item(self) -> bool:
+        """controller.go:289-321."""
+        key, shutdown = self.queue.get()
+        if shutdown:
+            return False
+        try:
+            forget = self.sync_tfjob(key)
+            if forget:
+                self.queue.forget(key)
+            else:
+                self.queue.add_rate_limited(key)
+        except Exception:
+            log.exception("error syncing tfjob %s", key)
+            self.queue.add_rate_limited(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    # -- sync ----------------------------------------------------------------
+
+    def sync_tfjob(self, key: str) -> bool:
+        """syncTFJob (controller.go:336-373): returns True when the job was
+        synced to completion of its expectations."""
+        start = time.monotonic()
+        try:
+            ns, name = split_meta_namespace_key(key)
+            obj = self.tfjob_lister.get(ns, name)
+            if obj is None:
+                log.info("tfjob %s no longer exists", key)
+                self._delete_tfjob({"metadata": {"namespace": ns, "name": name},
+                                    "spec": {"tfReplicaSpecs": {}}})
+                return True
+            tfjob = register.tfjob_from_unstructured(obj)
+
+            if not self.satisfied_expectations(tfjob):
+                return False
+
+            register.default_tfjob(tfjob)
+            try:
+                validation.validate_v1alpha2_tfjob_spec(tfjob.spec)
+            except validation.ValidationError as e:
+                # Invalid specs fail terminally instead of hot-looping.
+                status_mod.set_condition(
+                    tfjob.status,
+                    status_mod.new_condition(
+                        types.TFJobFailed, status_mod.TFJOB_FAILED_REASON, str(e)
+                    ),
+                )
+                self.update_status_handler(tfjob)
+                return True
+
+            self.reconcile_tfjobs(tfjob)
+            return True
+        finally:
+            log.debug("finished syncing %s (%.3fs)", key, time.monotonic() - start)
+
+    def satisfied_expectations(self, tfjob) -> bool:
+        """controller.go:417-436: any replica type's pods/services satisfied."""
+        satisfied = False
+        key = tpu_config.tfjob_key(tfjob)
+        for rtype in tfjob.spec.tf_replica_specs:
+            satisfied = satisfied or self.expectations.satisfied(
+                pod_mod.gen_expectation_pods_key(key, rtype.lower())
+            )
+            satisfied = satisfied or self.expectations.satisfied(
+                service_mod.gen_expectation_services_key(key, rtype.lower())
+            )
+        return satisfied
+
+    def reconcile_tfjobs(self, tfjob) -> None:
+        """reconcileTFJobs (controller.go:377-412)."""
+        if status_mod.is_finished(tfjob.status):
+            # Terminal jobs are left alone (pods kept for log retrieval,
+            # reference behavior); status still refreshed below.
+            self.update_status_handler(tfjob)
+            return
+
+        if not status_mod.get_condition(tfjob.status, types.TFJobCreated):
+            status_mod.set_condition(
+                tfjob.status,
+                status_mod.new_condition(
+                    types.TFJobCreated,
+                    status_mod.TFJOB_CREATED_REASON,
+                    f"TFJob {tfjob.metadata.name} is created.",
+                ),
+            )
+
+        pods = self.get_pods_for_tfjob(tfjob)
+        services = self.get_services_for_tfjob(tfjob)
+
+        if self.enable_gang_scheduling:
+            self.sync_pdb(tfjob)
+
+        for rtype, spec in tfjob.spec.tf_replica_specs.items():
+            self.pod_reconciler.reconcile(tfjob, pods, rtype, spec)
+            self.service_reconciler.reconcile(tfjob, services, rtype, spec)
+
+        tfjob.status.last_reconcile_time = now_rfc3339()
+        self.update_status_handler(tfjob)
+
+    def _update_tfjob_status(self, tfjob) -> None:
+        """updateTFJobStatus (controller_status.go:88-91)."""
+        try:
+            self.clientset.tfjobs(tfjob.metadata.namespace, tfjob.api_version).update(tfjob)
+        except errors.ApiError as e:
+            if errors.is_conflict(e):
+                # A newer version exists; the enqueued update event resyncs.
+                log.info("status update conflict for %s", tfjob.metadata.name)
+            else:
+                raise
+
+    # -- adoption ------------------------------------------------------------
+
+    def resolve_controller_ref(self, namespace: str, ref: dict):
+        """controller.go:441-457."""
+        if ref.get("kind") != "TFJob":
+            return None
+        obj = self.tfjob_lister.get(namespace, ref.get("name", ""))
+        if obj is None:
+            return None
+        tfjob = register.tfjob_from_unstructured(obj)
+        if tfjob.metadata.uid != ref.get("uid"):
+            return None
+        return tfjob
+
+    def _claim_manager_args(self, tfjob):
+        key = tpu_config.tfjob_key(tfjob)
+        selector = tpu_config.gen_labels(key)
+
+        def can_adopt():
+            fresh = self.clientset.tfjobs(
+                tfjob.metadata.namespace, tfjob.api_version
+            ).get(tfjob.metadata.name)
+            if fresh.metadata.uid != tfjob.metadata.uid:
+                raise RuntimeError(
+                    f"original TFJob {key} is gone: got uid {fresh.metadata.uid}, "
+                    f"wanted {tfjob.metadata.uid}"
+                )
+
+        return selector, can_adopt
+
+    def get_pods_for_tfjob(self, tfjob) -> list[dict]:
+        """getPodsForTFJob (controller_pod.go:174-210)."""
+        from k8s_tpu.controller_v2.ref_manager import PodControllerRefManager
+
+        selector, can_adopt = self._claim_manager_args(tfjob)
+        pods = self.pod_lister.list(tfjob.metadata.namespace)
+        manager = PodControllerRefManager(
+            self.pod_control, tfjob.to_dict(), selector, "TFJob",
+            tfjob.api_version, can_adopt,
+        )
+        return manager.claim_pods(pods)
+
+    def get_services_for_tfjob(self, tfjob) -> list[dict]:
+        """getServicesForTFJob (controller_service.go:154-190)."""
+        from k8s_tpu.controller_v2.ref_manager import ServiceControllerRefManager
+
+        selector, can_adopt = self._claim_manager_args(tfjob)
+        services = self.service_lister.list(tfjob.metadata.namespace)
+        manager = ServiceControllerRefManager(
+            self.service_control, tfjob.to_dict(), selector, "TFJob",
+            tfjob.api_version, can_adopt,
+        )
+        return manager.claim_services(services)
+
+    # -- gang scheduling (restored v1 feature; pkg/trainer/training.go:450-511)
+
+    def sync_pdb(self, tfjob) -> None:
+        total = sum(
+            (spec.replicas or 1) for spec in tfjob.spec.tf_replica_specs.values()
+        )
+        if total <= 1:
+            return
+        from k8s_tpu.api import helpers
+
+        key = tpu_config.tfjob_key(tfjob)
+        name = f"tf-job-pdb-{tfjob.metadata.name}"
+        pdbs = self.clientset.pdbs(tfjob.metadata.namespace)
+        try:
+            existing = pdbs.get(name)
+            # Reconcile minAvailable against the current replica total so a
+            # scaled job is never evictable down to a partial gang.
+            if (existing.get("spec") or {}).get("minAvailable") != total:
+                pdbs.patch(name, {"spec": {"minAvailable": total}})
+            return
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                raise
+        pdb = {
+            "metadata": {
+                "name": name,
+                "ownerReferences": [helpers.as_owner(tfjob).to_dict()],
+            },
+            "spec": {
+                "minAvailable": total,
+                "selector": {"matchLabels": tpu_config.gen_labels(key)},
+            },
+        }
+        pdbs.create(pdb)
+        self.recorder.eventf(
+            tfjob.to_dict(), "Normal", "SuccessfulCreatePdb",
+            "Created PDB %s (minAvailable=%d) for gang scheduling", name, total,
+        )

@@ -1,0 +1,89 @@
+"""ControllerExpectations (k8s.io/kubernetes/pkg/controller expectations,
+consumed at pkg/controller.v2/controller.go:417-436 and controller_pod.go:99).
+
+Expectations are a TTL cache of in-flight creates/deletes per controller key,
+preventing a reconcile from re-creating pods whose informer ADD events have
+not arrived yet.  ``satisfied(key)`` is the gate before a full reconcile
+(controller.go:417): true when the record is fulfilled, expired, or absent.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+EXPECTATION_TTL_SECONDS = 5 * 60.0  # ExpectationsTimeout in upstream
+
+
+class _Expectation:
+    __slots__ = ("adds", "dels", "timestamp")
+
+    def __init__(self, adds: int = 0, dels: int = 0):
+        self.adds = adds
+        self.dels = dels
+        self.timestamp = time.monotonic()
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self) -> bool:
+        return time.monotonic() - self.timestamp > EXPECTATION_TTL_SECONDS
+
+
+class ControllerExpectations:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: dict[str, _Expectation] = {}
+
+    def expect_creations(self, key: str, count: int) -> None:
+        """Record ``count`` expected creates.  Unlike upstream's replace
+        semantics, pending un-expired expectations accumulate: the reconcilers
+        call this once per object in a burst (createNewPod pattern,
+        controller_pod.go:110), and replacing the record would let a single
+        observed ADD satisfy the whole burst, re-opening the duplicate-create
+        race the cache exists to prevent."""
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is not None and not exp.expired() and (exp.adds > 0 or exp.dels > 0):
+                exp.adds += count
+            else:
+                self._store[key] = _Expectation(adds=count)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is not None and not exp.expired() and (exp.adds > 0 or exp.dels > 0):
+                exp.dels += count
+            else:
+                self._store[key] = _Expectation(dels=count)
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, add_delta=-1)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, del_delta=-1)
+
+    def _lower(self, key: str, add_delta: int = 0, del_delta: int = 0) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is not None:
+                exp.adds += add_delta
+                exp.dels += del_delta
+
+    def raise_expectations(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is not None:
+                exp.adds += adds
+                exp.dels += dels
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is None:
+                return True  # new controller: needs a sync
+            return exp.fulfilled() or exp.expired()
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)

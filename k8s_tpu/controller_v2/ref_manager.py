@@ -1,0 +1,163 @@
+"""Controller-ref adoption/orphaning (reference: upstream
+PodControllerRefManager + pkg/controller.v2/service_ref_manager.go:31-120).
+
+``claim(objects)`` walks listed objects and for each decides:
+- owned by us (controllerRef.uid matches): keep, unless the selector no
+  longer matches — then release (strip the controllerRef via patch);
+- owned by someone else: skip;
+- orphan matching our selector: adopt (patch in our controllerRef), unless
+  the controller is being deleted.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from k8s_tpu.api.meta import OwnerReference, get_controller_of
+from k8s_tpu.client import errors
+from k8s_tpu.client.selectors import labels_match
+
+log = logging.getLogger(__name__)
+
+
+class ControllerRefManager:
+    def __init__(
+        self,
+        controller_obj: dict,
+        selector: dict[str, str],
+        controller_kind: str,
+        api_version: str,
+        can_adopt: Optional[Callable[[], None]] = None,
+    ):
+        self.controller = controller_obj
+        self.selector = selector
+        self.controller_kind = controller_kind
+        self.api_version = api_version
+        self._can_adopt = can_adopt
+        self._can_adopt_err: Optional[Exception] = None
+        self._can_adopt_checked = False
+
+    @property
+    def _meta(self) -> dict:
+        return self.controller.get("metadata") or {}
+
+    def _check_can_adopt(self) -> None:
+        """Once-per-claim recheck that the controller still exists and is not
+        being deleted (RecheckDeletionTimestamp, controller_pod.go:196-208)."""
+        if not self._can_adopt_checked:
+            self._can_adopt_checked = True
+            if self._can_adopt is not None:
+                try:
+                    self._can_adopt()
+                except Exception as e:  # noqa: BLE001
+                    self._can_adopt_err = e
+        if self._can_adopt_err is not None:
+            raise self._can_adopt_err
+        if self._meta.get("deletionTimestamp"):
+            raise RuntimeError(
+                f"{self.controller_kind} {self._meta.get('namespace')}/"
+                f"{self._meta.get('name')} has just been deleted"
+            )
+
+    def _controller_ref(self) -> OwnerReference:
+        return OwnerReference(
+            api_version=self.api_version,
+            kind=self.controller_kind,
+            name=self._meta.get("name", ""),
+            uid=self._meta.get("uid", ""),
+            controller=True,
+            block_owner_deletion=True,
+        )
+
+    def claim(self, objects: list[dict], adopt_fn, release_fn) -> list[dict]:
+        claimed = []
+        for obj in objects:
+            ref = get_controller_of(obj.get("metadata") or {})
+            matches = labels_match(obj, self.selector)
+            if ref is not None:
+                if ref.get("uid") != self._meta.get("uid"):
+                    continue  # owned by someone else
+                if matches:
+                    claimed.append(obj)
+                    continue
+                # Owned but selector no longer matches: release unless the
+                # owner is being deleted.
+                if self._meta.get("deletionTimestamp"):
+                    continue
+                try:
+                    release_fn(obj)
+                except errors.ApiError as e:
+                    if not errors.is_not_found(e):
+                        raise
+                continue
+            # Orphan
+            if self._meta.get("deletionTimestamp") or not matches:
+                continue
+            if (obj.get("metadata") or {}).get("deletionTimestamp"):
+                continue
+            try:
+                self._check_can_adopt()
+                adopt_fn(obj)
+            except errors.ApiError as e:
+                if errors.is_not_found(e):
+                    continue
+                raise
+            except RuntimeError:
+                continue  # controller being deleted: don't adopt
+            claimed.append(obj)
+        return claimed
+
+
+class PodControllerRefManager(ControllerRefManager):
+    def __init__(self, pod_control, controller_obj, selector, controller_kind,
+                 api_version, can_adopt=None):
+        super().__init__(controller_obj, selector, controller_kind, api_version, can_adopt)
+        self.pod_control = pod_control
+
+    def claim_pods(self, pods: list[dict]) -> list[dict]:
+        ref = self._controller_ref().to_dict()
+
+        def adopt(pod):
+            self.pod_control.patch_pod(
+                pod["metadata"].get("namespace", ""),
+                pod["metadata"]["name"],
+                {"metadata": {"ownerReferences": [ref]}},
+            )
+
+        def release(pod):
+            self.pod_control.patch_pod(
+                pod["metadata"].get("namespace", ""),
+                pod["metadata"]["name"],
+                {"metadata": {"ownerReferences": []}},
+            )
+
+        return self.claim(pods, adopt, release)
+
+
+class ServiceControllerRefManager(ControllerRefManager):
+    """service_ref_manager.go:31-120."""
+
+    def __init__(self, service_control, controller_obj, selector, controller_kind,
+                 api_version, can_adopt=None):
+        super().__init__(controller_obj, selector, controller_kind, api_version, can_adopt)
+        self.service_control = service_control
+
+    def claim_services(self, services: list[dict]) -> list[dict]:
+        ref = self._controller_ref().to_dict()
+
+        def adopt(svc):
+            self.service_control.patch_service(
+                svc["metadata"].get("namespace", ""),
+                svc["metadata"]["name"],
+                {"metadata": {"ownerReferences": [ref]}},
+            )
+
+        def release(svc):
+            self.service_control.patch_service(
+                svc["metadata"].get("namespace", ""),
+                svc["metadata"]["name"],
+                {"metadata": {"ownerReferences": []}},
+            )
+
+        return self.claim(services, adopt, release)

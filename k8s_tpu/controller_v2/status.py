@@ -1,0 +1,135 @@
+"""Conditions status engine (reference: pkg/controller.v2/controller_status.go).
+
+Semantics kept from the reference:
+- conditions CRUD preserves LastTransitionTime when status doesn't change
+  (setCondition, controller_status.go:122-150);
+- replica statuses are re-counted from pod phases each sync
+  (initializeTFReplicaStatuses/updateTFJobReplicaStatuses, :93-119);
+- StartTime set when all completion-deciding replicas run, CompletionTime +
+  Succeeded when ``replicas - succeeded == 0``, Failed on any failed pod
+  (updateStatus, :39-85).
+
+TPU-native extension: the "completion-deciding" replica type is TPU when
+present (the SPMD gang), falling back to Worker as in the reference, whose
+updateStatus only inspected TFReplicaTypeWorker.
+"""
+
+from __future__ import annotations
+
+from k8s_tpu.api.meta import now_rfc3339
+from k8s_tpu.api.v1alpha2 import types
+
+# Condition reasons (controller_status.go:27-36)
+TFJOB_CREATED_REASON = "TFJobCreated"
+TFJOB_SUCCEEDED_REASON = "TFJobSucceeded"
+TFJOB_RUNNING_REASON = "TFJobRunning"
+TFJOB_FAILED_REASON = "TFJobFailed"
+TFJOB_RESTARTING_REASON = "TFJobRestarting"
+
+
+def new_condition(cond_type: str, reason: str, message: str) -> types.TFJobCondition:
+    now = now_rfc3339()
+    return types.TFJobCondition(
+        type=cond_type,
+        status=types.ConditionTrue,
+        reason=reason,
+        message=message,
+        last_update_time=now,
+        last_transition_time=now,
+    )
+
+
+def get_condition(status: types.TFJobStatus, cond_type: str):
+    for c in status.conditions:
+        if c.type == cond_type:
+            return c
+    return None
+
+
+def filter_out_condition(conditions, cond_type: str):
+    return [c for c in conditions if c.type != cond_type]
+
+
+def set_condition(status: types.TFJobStatus, condition: types.TFJobCondition) -> None:
+    current = get_condition(status, condition.type)
+    if (
+        current is not None
+        and current.status == condition.status
+        and current.reason == condition.reason
+    ):
+        return
+    if current is not None and current.status == condition.status:
+        condition.last_transition_time = current.last_transition_time
+    status.conditions = filter_out_condition(status.conditions, condition.type) + [condition]
+
+
+def has_condition(status: types.TFJobStatus, cond_type: str) -> bool:
+    c = get_condition(status, cond_type)
+    return c is not None and c.status == types.ConditionTrue
+
+
+def is_finished(status: types.TFJobStatus) -> bool:
+    return has_condition(status, types.TFJobSucceeded) or has_condition(
+        status, types.TFJobFailed
+    )
+
+
+def initialize_replica_statuses(tfjob: types.TFJob, rtype: str) -> None:
+    """controller_status.go:98-105."""
+    tfjob.status.tf_replica_statuses[rtype] = types.TFReplicaStatus()
+
+
+def update_replica_statuses(tfjob: types.TFJob, rtype: str, pod: dict) -> None:
+    """controller_status.go:108-119: count one pod's phase."""
+    phase = (pod.get("status") or {}).get("phase")
+    rs = tfjob.status.tf_replica_statuses[rtype]
+    if phase == "Running":
+        rs.active += 1
+    elif phase == "Succeeded":
+        rs.succeeded += 1
+    elif phase == "Failed":
+        rs.failed += 1
+
+
+def completion_deciding_type(tfjob: types.TFJob) -> str:
+    """TPU gang if present, else Worker (reference hardcoded Worker)."""
+    if types.TFReplicaTypeTPU in tfjob.spec.tf_replica_specs:
+        return types.TFReplicaTypeTPU
+    return types.TFReplicaTypeWorker
+
+
+def update_status(tfjob: types.TFJob, rtype: str, replicas: int) -> None:
+    """updateStatus (controller_status.go:39-85) for one replica type."""
+    rs = tfjob.status.tf_replica_statuses[rtype]
+    expected = replicas - rs.succeeded
+    running = rs.active
+    failed = rs.failed
+    name = tfjob.metadata.name
+
+    if rtype == completion_deciding_type(tfjob):
+        if running == replicas and tfjob.status.start_time is None:
+            tfjob.status.start_time = now_rfc3339()
+        if running > 0:
+            set_condition(
+                tfjob.status,
+                new_condition(
+                    types.TFJobRunning, TFJOB_RUNNING_REASON, f"TFJob {name} is running."
+                ),
+            )
+        if expected == 0:
+            if tfjob.status.completion_time is None:
+                tfjob.status.completion_time = now_rfc3339()
+            set_condition(
+                tfjob.status,
+                new_condition(
+                    types.TFJobSucceeded,
+                    TFJOB_SUCCEEDED_REASON,
+                    f"TFJob {name} is successfully completed.",
+                ),
+            )
+
+    if failed > 0:
+        set_condition(
+            tfjob.status,
+            new_condition(types.TFJobFailed, TFJOB_FAILED_REASON, f"TFJob {name} is failed."),
+        )

@@ -1,0 +1,180 @@
+"""Cluster-spec / bootstrap-env generation — the TPU-native replacement for
+the TF_CONFIG generator (reference: pkg/controller.v2/controller_tensorflow.go
+and controller_helper.go).
+
+The reference emitted one env var, ``TF_CONFIG``, describing a gRPC
+parameter-server cluster.  The SPMD world needs a different contract
+(SURVEY.md §2.4, §5 "Distributed communication backend"):
+
+- every participating process gets a **global process id** and the address of
+  the **coordinator** (process 0) so the launcher can call
+  ``jax.distributed.initialize(coordinator, num_processes, process_id)``;
+- XLA collectives then run over ICI/DCN with no per-replica service mesh —
+  only the coordinator's stable DNS name matters (though per-index headless
+  services are still created for harness compatibility);
+- slice topology travels as ``TPU_ACCELERATOR_TYPE``/``TPU_TOPOLOGY``, and
+  multi-slice jobs get MEGASCALE slice ids for DCN setup.
+
+``TPU_CONFIG`` (and a ``TF_CONFIG`` alias for legacy containers) keeps the
+exact TF_CONFIG JSON shape — ``{"cluster": {type: [host:port]}, "task":
+{type, index}}`` — so existing tooling and the e2e harness parse it unchanged
+(cf. genTFConfigJSONStr, controller_tensorflow.go:63-86).
+
+Everything here is a pure function of the TFJob, unit-testable like
+TestClusterSpec (pkg/trainer/training_test.go:119).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from k8s_tpu.api.v1alpha2 import constants, types
+
+# Pod label keys (reference: pkg/controller.v2/controller.go:66-74 and
+# controller_helper.go:29-31).
+LABEL_GROUP_NAME = "group_name"
+LABEL_TFJOB_KEY = "tf_job_key"
+LABEL_REPLICA_TYPE = "tf-replica-type"
+LABEL_REPLICA_INDEX = "tf-replica-index"
+
+# SPMD participants get JAX process ids, in this deterministic order so
+# process 0 (the coordinator / chief) is stable across reconciles.  PS is a
+# deleted concept (SURVEY.md §2.4) and Eval runs out-of-band; neither joins
+# the jax.distributed world.
+SPMD_TYPE_ORDER = ("chief", "master", "tpu", "tpu_worker", "worker")
+
+
+class PortNotFoundError(ValueError):
+    """controller_helper.go:36 errPortNotFound."""
+
+
+def gen_labels(tfjob_key: str) -> dict[str, str]:
+    """controller_helper.go:53-58."""
+    return {
+        LABEL_GROUP_NAME: "kubeflow.org",
+        LABEL_TFJOB_KEY: tfjob_key.replace("/", "-"),
+    }
+
+
+def gen_general_name(tfjob_key: str, rtype: str, index) -> str:
+    """controller_helper.go:60-63: '<ns>-<name>-<type>-<index>'."""
+    return f"{tfjob_key}-{rtype}-{index}".replace("/", "-")
+
+
+def gen_dns_record(tfjob_key: str, rtype: str, index, namespace: str) -> str:
+    """controller_helper.go:65-67: pod DNS via its headless service."""
+    return f"{gen_general_name(tfjob_key, rtype, index)}.{namespace}.svc.cluster.local"
+
+
+def get_port_from_tfjob(tfjob: types.TFJob, rtype: str) -> int:
+    """controller_helper.go:84-97: the tfjob-port of the tensorflow container."""
+    spec = tfjob.spec.tf_replica_specs[rtype]
+    for container in ((spec.template or {}).get("spec") or {}).get("containers") or []:
+        if container.get("name") == constants.DEFAULT_CONTAINER_NAME:
+            for port in container.get("ports") or []:
+                if port.get("name") == constants.DEFAULT_PORT_NAME:
+                    return int(port["containerPort"])
+    raise PortNotFoundError(f"no {constants.DEFAULT_PORT_NAME} port on {rtype} container")
+
+
+def tfjob_key(tfjob: types.TFJob) -> str:
+    """cache.MetaNamespaceKeyFunc over the job: 'namespace/name'."""
+    ns = tfjob.metadata.namespace
+    return f"{ns}/{tfjob.metadata.name}" if ns else tfjob.metadata.name
+
+
+def gen_cluster_spec(tfjob: types.TFJob) -> dict[str, list[str]]:
+    """genClusterSpec (controller_tensorflow.go:89-115): map of replica type
+    (lowercase) to '<dns>:<port>' lists."""
+    key = tfjob_key(tfjob)
+    cluster: dict[str, list[str]] = {}
+    for rtype, spec in tfjob.spec.tf_replica_specs.items():
+        rt = rtype.lower()
+        port = get_port_from_tfjob(tfjob, rtype)
+        cluster[rt] = [
+            f"{gen_dns_record(key, rt, i, tfjob.metadata.namespace)}:{port}"
+            for i in range(spec.replicas or 1)
+        ]
+    return cluster
+
+
+def spmd_process_table(tfjob: types.TFJob) -> list[tuple[str, int, str]]:
+    """Global process numbering for jax.distributed: ordered (rtype_lower,
+    index, 'host:port') triples.  Process 0 is the coordinator."""
+    key = tfjob_key(tfjob)
+    table = []
+    by_type = {rt.lower(): spec for rt, spec in tfjob.spec.tf_replica_specs.items()}
+    for rt in SPMD_TYPE_ORDER:
+        spec = by_type.get(rt)
+        if spec is None:
+            continue
+        orig_rtype = next(r for r in tfjob.spec.tf_replica_specs if r.lower() == rt)
+        port = get_port_from_tfjob(tfjob, orig_rtype)
+        for i in range(spec.replicas or 1):
+            host = f"{gen_dns_record(key, rt, i, tfjob.metadata.namespace)}:{port}"
+            table.append((rt, i, host))
+    return table
+
+
+def gen_tpu_config_json(tfjob: types.TFJob, rtype_lower: str, index) -> str:
+    """TF_CONFIG-shaped JSON (genTFConfigJSONStr, controller_tensorflow.go:63-86)."""
+    config = {
+        "cluster": gen_cluster_spec(tfjob),
+        "task": {"type": rtype_lower, "index": int(index)},
+    }
+    return json.dumps(config, sort_keys=True)
+
+
+def gen_env_vars(tfjob: types.TFJob, rtype_lower: str, index) -> list[dict]:
+    """The full env contract injected into a replica pod's containers
+    (replaces the TF_CONFIG injection at controller_pod.go:129-147).
+
+    Non-SPMD types (ps/eval) get only the legacy-shaped config vars; SPMD
+    participants additionally get the jax.distributed bootstrap and TPU
+    topology env consumed by ``k8s_tpu.launcher.bootstrap``.
+    """
+    index = int(index)
+    config_json = gen_tpu_config_json(tfjob, rtype_lower, index)
+    env: list[dict] = [
+        {"name": constants.ENV_TPU_CONFIG, "value": config_json},
+        {"name": "TF_CONFIG", "value": config_json},  # legacy containers
+    ]
+
+    table = spmd_process_table(tfjob)
+    process_id: Optional[int] = None
+    for pid, (rt, i, _host) in enumerate(table):
+        if rt == rtype_lower and i == index:
+            process_id = pid
+            break
+    if process_id is None:
+        return env  # ps/eval: not a jax.distributed participant
+
+    coordinator = table[0][2]
+    same_type_hosts = [h.split(":")[0] for (rt, _i, h) in table if rt == rtype_lower]
+    env += [
+        {"name": constants.ENV_JAX_COORDINATOR_ADDRESS, "value": coordinator},
+        {"name": constants.ENV_JAX_NUM_PROCESSES, "value": str(len(table))},
+        {"name": constants.ENV_JAX_PROCESS_ID, "value": str(process_id)},
+        {"name": constants.ENV_TPU_WORKER_ID, "value": str(index)},
+        {"name": constants.ENV_TPU_WORKER_HOSTNAMES, "value": ",".join(same_type_hosts)},
+    ]
+    tpu = tfjob.spec.tpu
+    if tpu is not None:
+        if tpu.accelerator_type:
+            env.append(
+                {"name": constants.ENV_TPU_ACCELERATOR_TYPE, "value": tpu.accelerator_type}
+            )
+        if tpu.topology:
+            env.append({"name": constants.ENV_TPU_TOPOLOGY, "value": tpu.topology})
+        if tpu.num_slices > 1:
+            # Proportional partition of same-type workers into slices keeps
+            # every slice id in [0, num_slices) even when replicas is not
+            # divisible by num_slices.
+            replicas = len(same_type_hosts)
+            slice_id = min(index * tpu.num_slices // max(replicas, 1), tpu.num_slices - 1)
+            env += [
+                {"name": constants.ENV_TPU_NUM_SLICES, "value": str(tpu.num_slices)},
+                {"name": constants.ENV_TPU_SLICE_ID, "value": str(slice_id)},
+            ]
+    return env

@@ -1,0 +1,378 @@
+"""Controller v2 tests (reference: pkg/controller.v2/controller_test.go).
+
+TestNormalPath port: a table of cluster states (worker/ps counts × pod
+phases) drives one sync_tfjob pass against pre-populated informer stores with
+FakePodControl/FakeServiceControl, asserting expected creations/deletions and
+resulting conditions — the multi-node-without-a-cluster pattern from
+SURVEY.md §4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from k8s_tpu.api import v1alpha2
+from k8s_tpu.api.meta import ObjectMeta
+from k8s_tpu.client import Clientset, FakeCluster
+from k8s_tpu.client.informer import SharedInformerFactory
+from k8s_tpu.client.record import FakeRecorder
+from k8s_tpu.controller_v2 import tpu_config
+from k8s_tpu.controller_v2.control import FakePodControl, FakeServiceControl
+from k8s_tpu.controller_v2.controller import TFJobController
+from k8s_tpu.controller_v2.status import get_condition
+
+JOB_NAME = "test-tfjob"
+NS = "default"
+KEY = f"{NS}/{JOB_NAME}"
+
+
+def make_tfjob(worker=0, ps=0, tpu=0, restart_policy="", version="v1alpha2"):
+    template = {
+        "spec": {
+            "containers": [
+                {
+                    "name": "tensorflow",
+                    "image": "img",
+                    "ports": [{"name": "tfjob-port", "containerPort": 2222}],
+                }
+            ]
+        }
+    }
+    if tpu:
+        template = {
+            "spec": {
+                "containers": [
+                    {
+                        "name": "tensorflow",
+                        "image": "img",
+                        "ports": [{"name": "tfjob-port", "containerPort": 2222}],
+                        "resources": {"limits": {"cloud-tpus.google.com/v5e": 4}},
+                    }
+                ]
+            }
+        }
+    specs = {}
+    if worker:
+        specs["Worker"] = v1alpha2.TFReplicaSpec(replicas=worker, template=template)
+    if ps:
+        specs["PS"] = v1alpha2.TFReplicaSpec(replicas=ps, template=template)
+    if tpu:
+        specs["TPU"] = v1alpha2.TFReplicaSpec(
+            replicas=tpu, template=template, restart_policy=restart_policy
+        )
+    return v1alpha2.TFJob(
+        metadata=ObjectMeta(name=JOB_NAME, namespace=NS, uid="uid-job-1"),
+        spec=v1alpha2.TFJobSpec(tf_replica_specs=specs),
+    )
+
+
+def make_pod(rtype, index, phase, exit_code=None):
+    labels = tpu_config.gen_labels(KEY)
+    labels[tpu_config.LABEL_REPLICA_TYPE] = rtype
+    labels[tpu_config.LABEL_REPLICA_INDEX] = str(index)
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{NS}-{JOB_NAME}-{rtype}-{index}-x",
+            "namespace": NS,
+            "labels": labels,
+            "ownerReferences": [
+                {"apiVersion": "kubeflow.org/v1alpha2", "kind": "TFJob",
+                 "name": JOB_NAME, "uid": "uid-job-1", "controller": True}
+            ],
+        },
+        "status": {"phase": phase},
+    }
+    if exit_code is not None:
+        pod["status"]["containerStatuses"] = [
+            {"name": "tensorflow", "state": {"terminated": {"exitCode": exit_code}}}
+        ]
+    return pod
+
+
+def make_service(rtype, index):
+    labels = tpu_config.gen_labels(KEY)
+    labels[tpu_config.LABEL_REPLICA_TYPE] = rtype
+    labels[tpu_config.LABEL_REPLICA_INDEX] = str(index)
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": tpu_config.gen_general_name(KEY, rtype, index),
+            "namespace": NS,
+            "labels": labels,
+            "ownerReferences": [
+                {"apiVersion": "kubeflow.org/v1alpha2", "kind": "TFJob",
+                 "name": JOB_NAME, "uid": "uid-job-1", "controller": True}
+            ],
+        },
+        "spec": {"clusterIP": "None"},
+    }
+
+
+def build_controller(tfjob, pods, services, enable_gang=False):
+    """Controller with alwaysReady-style stores: informers pre-populated,
+    no threads started (controller_test.go:44 alwaysReady stubs)."""
+    fc = FakeCluster()
+    cs = Clientset(fc)
+    cs.tfjobs(NS).create(tfjob)
+    factory = SharedInformerFactory(fc, resync_period=0)
+    pod_control = FakePodControl()
+    service_control = FakeServiceControl()
+    tc = TFJobController(
+        cs,
+        informer_factory=factory,
+        enable_gang_scheduling=enable_gang,
+        pod_control=pod_control,
+        service_control=service_control,
+        recorder=FakeRecorder(),
+    )
+    stored_job = cs.tfjobs_unstructured(NS).get(JOB_NAME)
+    tc.tfjob_informer.store.replace([stored_job])
+    tc.pod_informer.store.replace(pods)
+    tc.service_informer.store.replace(services)
+    captured = []
+    tc.update_status_handler = lambda job: captured.append(job)
+    return tc, pod_control, service_control, captured
+
+
+@dataclasses.dataclass
+class Case:
+    worker: int = 0
+    ps: int = 0
+    pending_worker: int = 0
+    active_worker: int = 0
+    succeeded_worker: int = 0
+    failed_worker: int = 0
+    pending_ps: int = 0
+    active_ps: int = 0
+    succeeded_ps: int = 0
+    failed_ps: int = 0
+    active_worker_services: int = 0
+    active_ps_services: int = 0
+    expected_pod_creations: int = 0
+    expected_service_creations: int = 0
+    expected_active_worker: int = 0
+    expected_succeeded_worker: int = 0
+    expected_failed_worker: int = 0
+    expected_condition: str | None = None
+    check_start_time: bool = False
+
+
+NORMAL_PATH_CASES = {
+    "local TFJob created": Case(
+        worker=1, expected_pod_creations=1, expected_service_creations=1
+    ),
+    "distributed 4w2ps created": Case(
+        worker=4, ps=2, expected_pod_creations=6, expected_service_creations=6
+    ),
+    "all replicas pending": Case(
+        worker=4, ps=2, pending_worker=4, pending_ps=2,
+        active_worker_services=4, active_ps_services=2,
+    ),
+    "all replicas running": Case(
+        worker=4, ps=2, active_worker=4, active_ps=2,
+        active_worker_services=4, active_ps_services=2,
+        expected_active_worker=4, expected_condition="Running", check_start_time=True,
+    ),
+    "2w1ps pending rest missing": Case(
+        worker=4, ps=2, pending_worker=2, pending_ps=1,
+        active_worker_services=2, active_ps_services=1,
+        expected_pod_creations=3, expected_service_creations=3,
+    ),
+    "2 pending 1 running": Case(
+        worker=4, ps=2, pending_worker=2, active_worker=1, pending_ps=1,
+        active_worker_services=3, active_ps_services=1,
+        expected_pod_creations=2, expected_service_creations=2,
+        expected_active_worker=1, expected_condition="Running",
+    ),
+    "2 pending 1 succeeded": Case(
+        worker=4, ps=2, pending_worker=2, succeeded_worker=1, pending_ps=1,
+        active_worker_services=3, active_ps_services=1,
+        expected_pod_creations=2, expected_service_creations=2,
+        expected_succeeded_worker=1,
+    ),
+    "job succeeded": Case(
+        worker=4, ps=2, succeeded_worker=4, succeeded_ps=2,
+        active_worker_services=4, active_ps_services=2,
+        expected_succeeded_worker=4, expected_condition="Succeeded",
+    ),
+}
+
+
+@pytest.mark.parametrize("name", NORMAL_PATH_CASES)
+def test_normal_path(name):
+    tc_case = NORMAL_PATH_CASES[name]
+    tfjob = make_tfjob(worker=tc_case.worker, ps=tc_case.ps)
+    pods = []
+    idx = 0
+    for phase, count in [
+        ("Pending", tc_case.pending_worker),
+        ("Running", tc_case.active_worker),
+        ("Succeeded", tc_case.succeeded_worker),
+        ("Failed", tc_case.failed_worker),
+    ]:
+        for _ in range(count):
+            pods.append(make_pod("worker", idx, phase))
+            idx += 1
+    idx = 0
+    for phase, count in [
+        ("Pending", tc_case.pending_ps),
+        ("Running", tc_case.active_ps),
+        ("Succeeded", tc_case.succeeded_ps),
+        ("Failed", tc_case.failed_ps),
+    ]:
+        for _ in range(count):
+            pods.append(make_pod("ps", idx, phase))
+            idx += 1
+    services = [make_service("worker", i) for i in range(tc_case.active_worker_services)]
+    services += [make_service("ps", i) for i in range(tc_case.active_ps_services)]
+
+    controller, pod_control, service_control, captured = build_controller(
+        tfjob, pods, services
+    )
+    assert controller.sync_tfjob(KEY) is True
+
+    assert len(pod_control.templates) == tc_case.expected_pod_creations
+    assert len(service_control.services) == tc_case.expected_service_creations
+    assert pod_control.delete_pod_names == []
+
+    assert captured, "status must be updated"
+    final = captured[-1]
+    worker_status = final.status.tf_replica_statuses.get("Worker")
+    if tc_case.worker:
+        assert worker_status.active == tc_case.expected_active_worker
+        assert worker_status.succeeded == tc_case.expected_succeeded_worker
+        assert worker_status.failed == tc_case.expected_failed_worker
+    if tc_case.expected_condition:
+        cond = get_condition(final.status, tc_case.expected_condition)
+        assert cond is not None and cond.status == "True", final.status.conditions
+    if tc_case.check_start_time:
+        assert final.status.start_time is not None
+
+
+class TestCreatedPodShape:
+    def test_pod_has_labels_env_and_owner(self):
+        tfjob = make_tfjob(worker=1)
+        controller, pod_control, _, _ = build_controller(tfjob, [], [])
+        controller.sync_tfjob(KEY)
+        template = pod_control.templates[0]
+        labels = template["metadata"]["labels"]
+        assert labels[tpu_config.LABEL_REPLICA_TYPE] == "worker"
+        assert labels[tpu_config.LABEL_REPLICA_INDEX] == "0"
+        assert labels["group_name"] == "kubeflow.org"
+        env = {e["name"] for e in template["spec"]["containers"][0]["env"]}
+        assert {"TF_CONFIG", "TPU_CONFIG", "JAX_COORDINATOR_ADDRESS"} <= env
+        ref = pod_control.controller_refs[0]
+        assert ref.uid == "uid-job-1" and ref.controller
+
+    def test_service_is_headless_per_index(self):
+        tfjob = make_tfjob(worker=2)
+        controller, _, service_control, _ = build_controller(tfjob, [], [])
+        controller.sync_tfjob(KEY)
+        assert len(service_control.services) == 2
+        svc = service_control.services[0]
+        assert svc["spec"]["clusterIP"] == "None"
+        assert svc["spec"]["selector"][tpu_config.LABEL_REPLICA_INDEX] in ("0", "1")
+
+
+class TestGangSemantics:
+    def test_gang_restart_on_retryable_failure(self):
+        """TPU gang: one pod fails with SIGTERM(143) -> whole gang torn down,
+        Restarting condition, no single-pod recreation this sync."""
+        tfjob = make_tfjob(tpu=4, restart_policy="ExitCode")
+        pods = [make_pod("tpu", i, "Running") for i in range(3)]
+        pods.append(make_pod("tpu", 3, "Failed", exit_code=143))
+        controller, pod_control, _, captured = build_controller(tfjob, pods, [])
+        controller.sync_tfjob(KEY)
+        assert len(pod_control.delete_pod_names) == 4
+        assert len(pod_control.templates) == 0
+        cond = get_condition(captured[-1].status, "Restarting")
+        assert cond is not None
+
+    def test_gang_permanent_failure_marks_job_failed(self):
+        tfjob = make_tfjob(tpu=4, restart_policy="ExitCode")
+        pods = [make_pod("tpu", i, "Running") for i in range(3)]
+        pods.append(make_pod("tpu", 3, "Failed", exit_code=1))
+        controller, pod_control, _, captured = build_controller(tfjob, pods, [])
+        controller.sync_tfjob(KEY)
+        assert pod_control.delete_pod_names == []
+        cond = get_condition(captured[-1].status, "Failed")
+        assert cond is not None
+
+    def test_gang_pods_get_restart_policy_never(self):
+        tfjob = make_tfjob(tpu=2, restart_policy="Always")
+        controller, pod_control, _, _ = build_controller(tfjob, [], [])
+        controller.sync_tfjob(KEY)
+        for template in pod_control.templates:
+            assert template["spec"]["restartPolicy"] == "Never"
+
+    def test_gang_always_policy_restarts_on_any_failure(self):
+        tfjob = make_tfjob(tpu=2, restart_policy="Always")
+        pods = [make_pod("tpu", 0, "Running"), make_pod("tpu", 1, "Failed", exit_code=1)]
+        controller, pod_control, _, _ = build_controller(tfjob, pods, [])
+        controller.sync_tfjob(KEY)
+        assert len(pod_control.delete_pod_names) == 2
+
+    def test_pdb_created_for_multi_replica_job(self):
+        tfjob = make_tfjob(tpu=4)
+        controller, _, _, _ = build_controller(tfjob, [], [], enable_gang=True)
+        controller.sync_tfjob(KEY)
+        pdbs = controller.clientset.pdbs(NS).list()
+        assert len(pdbs) == 1
+        assert pdbs[0]["spec"]["minAvailable"] == 4
+        # second sync: no duplicate
+        controller.sync_tfjob(KEY)
+        assert len(controller.clientset.pdbs(NS).list()) == 1
+
+
+class TestExpectations:
+    def test_unsatisfied_expectations_skip_reconcile(self):
+        tfjob = make_tfjob(worker=1)
+        controller, pod_control, _, _ = build_controller(tfjob, [], [])
+        key = tpu_config.tfjob_key(tfjob)
+        from k8s_tpu.controller_v2.pod import gen_expectation_pods_key
+        from k8s_tpu.controller_v2.service import gen_expectation_services_key
+
+        controller.expectations.expect_creations(gen_expectation_pods_key(key, "worker"), 1)
+        controller.expectations.expect_creations(
+            gen_expectation_services_key(key, "worker"), 1
+        )
+        assert controller.sync_tfjob(KEY) is False
+        assert pod_control.templates == []
+
+    def test_creation_observed_resatisfies(self):
+        from k8s_tpu.controller_v2.expectations import ControllerExpectations
+
+        exp = ControllerExpectations()
+        exp.expect_creations("k", 2)
+        assert not exp.satisfied("k")
+        exp.creation_observed("k")
+        exp.creation_observed("k")
+        assert exp.satisfied("k")
+
+
+class TestValidationFailure:
+    def test_invalid_spec_fails_terminally(self):
+        tfjob = make_tfjob(worker=1)
+        tfjob.spec.tf_replica_specs["Worker"].template = None
+        controller, pod_control, _, captured = build_controller(tfjob, [], [])
+        assert controller.sync_tfjob(KEY) is True
+        assert pod_control.templates == []
+        cond = get_condition(captured[-1].status, "Failed")
+        assert cond is not None
+
+    def test_finished_job_not_reconciled(self):
+        tfjob = make_tfjob(worker=1)
+        from k8s_tpu.controller_v2 import status as status_mod
+
+        status_mod.set_condition(
+            tfjob.status,
+            status_mod.new_condition("Succeeded", "TFJobSucceeded", "done"),
+        )
+        controller, pod_control, _, _ = build_controller(tfjob, [], [])
+        controller.sync_tfjob(KEY)
+        assert pod_control.templates == []

@@ -1,0 +1,103 @@
+"""Regression tests for the review findings: expectation accumulation,
+non-gang ExitCode restarts, megascale slice-id bounds, PDB reconciliation."""
+
+from k8s_tpu.api.common import TPUSpec
+from k8s_tpu.controller_v2.expectations import ControllerExpectations
+from k8s_tpu.controller_v2.status import get_condition
+from tests.test_controller_v2 import KEY, NS, build_controller, make_pod, make_tfjob
+from tests.test_tpu_config import _job
+
+
+class TestExpectationAccumulation:
+    def test_burst_creates_accumulate(self):
+        """Four expect_creations(key,1) calls need four observed ADDs, not one."""
+        exp = ControllerExpectations()
+        for _ in range(4):
+            exp.expect_creations("k", 1)
+        exp.creation_observed("k")
+        assert not exp.satisfied("k")
+        for _ in range(3):
+            exp.creation_observed("k")
+        assert exp.satisfied("k")
+
+    def test_fulfilled_record_resets_not_accumulates(self):
+        exp = ControllerExpectations()
+        exp.expect_creations("k", 2)
+        exp.creation_observed("k")
+        exp.creation_observed("k")
+        assert exp.satisfied("k")
+        exp.expect_creations("k", 1)  # new burst starts from scratch
+        exp.creation_observed("k")
+        assert exp.satisfied("k")
+
+    def test_mixed_adds_dels_accumulate(self):
+        exp = ControllerExpectations()
+        exp.expect_creations("k", 1)
+        exp.expect_deletions("k", 2)
+        assert not exp.satisfied("k")
+        exp.creation_observed("k")
+        exp.deletion_observed("k")
+        exp.deletion_observed("k")
+        assert exp.satisfied("k")
+
+
+class TestNonGangExitCodeRestart:
+    def test_retryable_worker_failure_restarts_pod(self):
+        tfjob = make_tfjob(worker=2)
+        tfjob.spec.tf_replica_specs["Worker"].restart_policy = "ExitCode"
+        pods = [
+            make_pod("worker", 0, "Running"),
+            make_pod("worker", 1, "Failed", exit_code=143),
+        ]
+        controller, pod_control, _, captured = build_controller(tfjob, pods, [])
+        controller.sync_tfjob(KEY)
+        assert len(pod_control.delete_pod_names) == 1  # only the failed pod
+        assert get_condition(captured[-1].status, "Restarting") is not None
+        assert get_condition(captured[-1].status, "Failed") is None
+        # the restarted pod is not counted as failed
+        assert captured[-1].status.tf_replica_statuses["Worker"].failed == 0
+
+    def test_permanent_worker_failure_fails_job(self):
+        tfjob = make_tfjob(worker=2)
+        tfjob.spec.tf_replica_specs["Worker"].restart_policy = "ExitCode"
+        pods = [
+            make_pod("worker", 0, "Running"),
+            make_pod("worker", 1, "Failed", exit_code=1),
+        ]
+        controller, pod_control, _, captured = build_controller(tfjob, pods, [])
+        controller.sync_tfjob(KEY)
+        assert pod_control.delete_pod_names == []
+        assert get_condition(captured[-1].status, "Failed") is not None
+
+
+def test_megascale_slice_id_bounded_with_uneven_split():
+    from k8s_tpu.controller_v2 import tpu_config
+
+    job = _job({"TPU": 5}, tpu=TPUSpec(accelerator_type="v5e", num_slices=2))
+    ids = []
+    for i in range(5):
+        env = {e["name"]: e["value"] for e in tpu_config.gen_env_vars(job, "tpu", i)}
+        ids.append(int(env["MEGASCALE_SLICE_ID"]))
+    assert all(0 <= s < 2 for s in ids)
+    assert set(ids) == {0, 1}
+
+
+def test_pdb_min_available_reconciled_on_scale():
+    tfjob = make_tfjob(tpu=4)
+    controller, _, _, _ = build_controller(tfjob, [], [], enable_gang=True)
+    controller.sync_tfjob(KEY)
+    assert controller.clientset.pdbs(NS).list()[0]["spec"]["minAvailable"] == 4
+    # simulate the informer ADD echoes so the next sync isn't gated by
+    # the (correctly) pending create expectations
+    from k8s_tpu.controller_v2.pod import gen_expectation_pods_key
+    from k8s_tpu.controller_v2.service import gen_expectation_services_key
+
+    controller.expectations.delete_expectations(gen_expectation_pods_key(KEY, "tpu"))
+    controller.expectations.delete_expectations(gen_expectation_services_key(KEY, "tpu"))
+    # scale the job and resync: PDB follows
+    job = controller.clientset.tfjobs_unstructured(NS).get("test-tfjob")
+    job["spec"]["tfReplicaSpecs"]["TPU"]["replicas"] = 8
+    controller.clientset.tfjobs_unstructured(NS).update(job)
+    controller.tfjob_informer.store.replace([controller.clientset.tfjobs_unstructured(NS).get("test-tfjob")])
+    controller.sync_tfjob(KEY)
+    assert controller.clientset.pdbs(NS).list()[0]["spec"]["minAvailable"] == 8

@@ -1,0 +1,128 @@
+"""Status engine + controller-ref manager tests (reference:
+controller_status.go semantics, service_ref_manager_test.go:26 matrices)."""
+
+from k8s_tpu.api import v1alpha2
+from k8s_tpu.api.meta import ObjectMeta
+from k8s_tpu.controller_v2 import status as status_mod
+from k8s_tpu.controller_v2.control import FakePodControl, FakeServiceControl
+from k8s_tpu.controller_v2.ref_manager import (
+    PodControllerRefManager,
+    ServiceControllerRefManager,
+)
+
+
+class TestConditions:
+    def test_set_and_get(self):
+        st = v1alpha2.TFJobStatus()
+        status_mod.set_condition(st, status_mod.new_condition("Created", "r", "m"))
+        c = status_mod.get_condition(st, "Created")
+        assert c.status == "True" and c.reason == "r"
+
+    def test_same_status_reason_is_noop(self):
+        st = v1alpha2.TFJobStatus()
+        status_mod.set_condition(st, status_mod.new_condition("Running", "r", "m1"))
+        first = status_mod.get_condition(st, "Running")
+        status_mod.set_condition(st, status_mod.new_condition("Running", "r", "m2"))
+        again = status_mod.get_condition(st, "Running")
+        assert again.message == "m1"  # unchanged: same status+reason skips update
+
+    def test_transition_time_preserved_when_status_unchanged(self):
+        st = v1alpha2.TFJobStatus()
+        cond = status_mod.new_condition("Running", "r1", "m")
+        cond.last_transition_time = "2020-01-01T00:00:00Z"
+        status_mod.set_condition(st, cond)
+        status_mod.set_condition(st, status_mod.new_condition("Running", "r2", "m"))
+        c = status_mod.get_condition(st, "Running")
+        assert c.reason == "r2"
+        assert c.last_transition_time == "2020-01-01T00:00:00Z"
+
+    def test_filter_out(self):
+        st = v1alpha2.TFJobStatus()
+        status_mod.set_condition(st, status_mod.new_condition("Created", "r", "m"))
+        status_mod.set_condition(st, status_mod.new_condition("Running", "r", "m"))
+        st.conditions = status_mod.filter_out_condition(st.conditions, "Created")
+        assert [c.type for c in st.conditions] == ["Running"]
+
+    def test_is_finished(self):
+        st = v1alpha2.TFJobStatus()
+        assert not status_mod.is_finished(st)
+        status_mod.set_condition(st, status_mod.new_condition("Failed", "r", "m"))
+        assert status_mod.is_finished(st)
+
+
+def _job_dict(uid="u1", deleting=False):
+    d = {
+        "apiVersion": "kubeflow.org/v1alpha2",
+        "kind": "TFJob",
+        "metadata": {"name": "j", "namespace": "ns", "uid": uid},
+    }
+    if deleting:
+        d["metadata"]["deletionTimestamp"] = "2020-01-01T00:00:00Z"
+    return d
+
+
+def _pod(name, labels=None, owner_uid=None):
+    p = {"metadata": {"name": name, "namespace": "ns", "labels": labels or {}}}
+    if owner_uid:
+        p["metadata"]["ownerReferences"] = [
+            {"kind": "TFJob", "name": "j", "uid": owner_uid, "controller": True}
+        ]
+    return p
+
+
+SELECTOR = {"app": "x"}
+
+
+class TestClaimPods:
+    def _manager(self, job=None, control=None):
+        return PodControllerRefManager(
+            control or FakePodControl(), job or _job_dict(), SELECTOR,
+            "TFJob", "kubeflow.org/v1alpha2",
+        )
+
+    def test_adopt_matching_orphan(self):
+        control = FakePodControl()
+        m = self._manager(control=control)
+        claimed = m.claim_pods([_pod("a", labels={"app": "x"})])
+        assert [p["metadata"]["name"] for p in claimed] == ["a"]
+        assert len(control.patches) == 1  # adoption patch
+
+    def test_skip_non_matching_orphan(self):
+        m = self._manager()
+        assert m.claim_pods([_pod("a", labels={"app": "y"})]) == []
+
+    def test_keep_owned_matching(self):
+        control = FakePodControl()
+        m = self._manager(control=control)
+        claimed = m.claim_pods([_pod("a", labels={"app": "x"}, owner_uid="u1")])
+        assert len(claimed) == 1 and control.patches == []
+
+    def test_skip_owned_by_other(self):
+        m = self._manager()
+        assert m.claim_pods([_pod("a", labels={"app": "x"}, owner_uid="other")]) == []
+
+    def test_release_owned_non_matching(self):
+        control = FakePodControl()
+        m = self._manager(control=control)
+        claimed = m.claim_pods([_pod("a", labels={"app": "y"}, owner_uid="u1")])
+        assert claimed == []
+        assert control.patches == [{"metadata": {"ownerReferences": []}}]
+
+    def test_deleting_controller_does_not_adopt(self):
+        control = FakePodControl()
+        m = self._manager(job=_job_dict(deleting=True), control=control)
+        assert m.claim_pods([_pod("a", labels={"app": "x"})]) == []
+        assert control.patches == []
+
+
+class TestClaimServices:
+    def test_adopt_and_keep(self):
+        control = FakeServiceControl()
+        m = ServiceControllerRefManager(
+            control, _job_dict(), SELECTOR, "TFJob", "kubeflow.org/v1alpha2"
+        )
+        claimed = m.claim_services(
+            [_pod("s1", labels={"app": "x"}), _pod("s2", labels={"app": "x"}, owner_uid="u1")]
+        )
+        assert len(claimed) == 2
+        assert len(control.patches) == 1

@@ -1,0 +1,129 @@
+"""Cluster-spec / env generation tests (reference: TestClusterSpec at
+pkg/trainer/training_test.go:119 and genTFConfigJSONStr semantics)."""
+
+import json
+
+from k8s_tpu.api import v1alpha2
+from k8s_tpu.api.common import TPUSpec
+from k8s_tpu.api.meta import ObjectMeta
+from k8s_tpu.controller_v2 import tpu_config
+
+
+def _job(replicas_by_type, tpu=None, name="myjob", ns="ns"):
+    specs = {}
+    for rtype, n in replicas_by_type.items():
+        specs[rtype] = v1alpha2.TFReplicaSpec(
+            replicas=n,
+            template={
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "tensorflow",
+                            "ports": [{"name": "tfjob-port", "containerPort": 2222}],
+                        }
+                    ]
+                }
+            },
+        )
+    return v1alpha2.TFJob(
+        metadata=ObjectMeta(name=name, namespace=ns, uid="uid-1"),
+        spec=v1alpha2.TFJobSpec(tf_replica_specs=specs, tpu=tpu),
+    )
+
+
+class TestClusterSpec:
+    def test_exact_cluster_map(self):
+        job = _job({"Worker": 2, "PS": 1})
+        cluster = tpu_config.gen_cluster_spec(job)
+        assert cluster == {
+            "worker": [
+                "ns-myjob-worker-0.ns.svc.cluster.local:2222",
+                "ns-myjob-worker-1.ns.svc.cluster.local:2222",
+            ],
+            "ps": ["ns-myjob-ps-0.ns.svc.cluster.local:2222"],
+        }
+
+    def test_tpu_config_json_is_tf_config_shaped(self):
+        job = _job({"Worker": 1})
+        cfg = json.loads(tpu_config.gen_tpu_config_json(job, "worker", 0))
+        assert set(cfg) == {"cluster", "task"}
+        assert cfg["task"] == {"type": "worker", "index": 0}
+
+    def test_port_not_found(self):
+        job = _job({"Worker": 1})
+        job.spec.tf_replica_specs["Worker"].template["spec"]["containers"][0]["ports"] = []
+        import pytest
+
+        with pytest.raises(tpu_config.PortNotFoundError):
+            tpu_config.gen_cluster_spec(job)
+
+
+class TestSPMDProcessTable:
+    def test_chief_is_process_zero(self):
+        job = _job({"Worker": 2, "Chief": 1, "PS": 1})
+        table = tpu_config.spmd_process_table(job)
+        # chief first, then workers; PS excluded from the SPMD world.
+        assert [(rt, i) for rt, i, _ in table] == [
+            ("chief", 0),
+            ("worker", 0),
+            ("worker", 1),
+        ]
+
+    def test_tpu_gang_numbering(self):
+        job = _job({"TPU": 4})
+        table = tpu_config.spmd_process_table(job)
+        assert [(rt, i) for rt, i, _ in table] == [
+            ("tpu", 0), ("tpu", 1), ("tpu", 2), ("tpu", 3),
+        ]
+
+
+class TestEnvVars:
+    def _env_map(self, job, rt, idx):
+        return {e["name"]: e["value"] for e in tpu_config.gen_env_vars(job, rt, idx)}
+
+    def test_jax_bootstrap_env(self):
+        job = _job({"TPU": 4}, tpu=TPUSpec(accelerator_type="v5litepod-16", topology="4x4"))
+        env = self._env_map(job, "tpu", 2)
+        assert env["JAX_COORDINATOR_ADDRESS"] == "ns-myjob-tpu-0.ns.svc.cluster.local:2222"
+        assert env["JAX_NUM_PROCESSES"] == "4"
+        assert env["JAX_PROCESS_ID"] == "2"
+        assert env["TPU_WORKER_ID"] == "2"
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5litepod-16"
+        assert env["TPU_TOPOLOGY"] == "4x4"
+        assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+        # legacy harness compat
+        assert json.loads(env["TF_CONFIG"])["task"] == {"type": "tpu", "index": 2}
+        assert env["TPU_CONFIG"] == env["TF_CONFIG"]
+
+    def test_ps_gets_only_legacy_config(self):
+        job = _job({"Worker": 1, "PS": 1})
+        env = self._env_map(job, "ps", 0)
+        assert "JAX_COORDINATOR_ADDRESS" not in env
+        assert "TF_CONFIG" in env
+
+    def test_chief_is_coordinator_for_workers(self):
+        job = _job({"Worker": 2, "Chief": 1})
+        env = self._env_map(job, "worker", 1)
+        assert env["JAX_COORDINATOR_ADDRESS"].startswith("ns-myjob-chief-0.")
+        assert env["JAX_PROCESS_ID"] == "2"  # chief=0, worker0=1, worker1=2
+        assert env["JAX_NUM_PROCESSES"] == "3"
+
+    def test_multislice_megascale_env(self):
+        job = _job({"TPU": 8}, tpu=TPUSpec(accelerator_type="v5litepod-16", num_slices=2))
+        env0 = self._env_map(job, "tpu", 0)
+        env7 = self._env_map(job, "tpu", 7)
+        assert env0["MEGASCALE_NUM_SLICES"] == "2"
+        assert env0["MEGASCALE_SLICE_ID"] == "0"
+        assert env7["MEGASCALE_SLICE_ID"] == "1"
+
+
+def test_gen_labels_and_names():
+    assert tpu_config.gen_labels("ns/j") == {
+        "group_name": "kubeflow.org",
+        "tf_job_key": "ns-j",
+    }
+    assert tpu_config.gen_general_name("ns/j", "worker", 3) == "ns-j-worker-3"
+    assert (
+        tpu_config.gen_dns_record("ns/j", "worker", 3, "ns")
+        == "ns-j-worker-3.ns.svc.cluster.local"
+    )
