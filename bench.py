@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+The headline workload metric from BASELINE.md ("ResNet-50 images/sec/chip on
+a v5e slice").  The reference publishes no numbers (BASELINE.json
+``"published": {}``), so the baseline is self-established: ``vs_baseline``
+compares against the first recorded value in BENCH_BASELINE.json when
+present, else 1.0.
+
+Prints exactly one JSON line:
+  {"metric": "...", "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+
+
+def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5) -> float:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from k8s_tpu.models import train as train_lib
+    from k8s_tpu.models.resnet import resnet50
+
+    n_chips = len(jax.devices())
+    batch = batch_per_chip * n_chips
+
+    model = resnet50(dtype=jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    images = jax.random.normal(key, (batch, 224, 224, 3), jnp.bfloat16)
+    labels = jax.random.randint(key, (batch,), 0, 1000)
+
+    variables = model.init(jax.random.PRNGKey(1), images[:1], train=False)
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+
+    optimizer = optax.sgd(0.1, momentum=0.9)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return train_lib.cross_entropy_loss(logits, labels), updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_stats, new_opt_state, loss
+
+    # Synchronize by fetching the scalar loss to host: the fetch cannot
+    # complete before the whole dependency chain has executed.  (Plain
+    # block_until_ready is not a reliable barrier under remote-relay
+    # execution environments and yields impossible numbers.)
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+    _ = float(loss)
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+    _ = float(loss)
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = batch * iters / elapsed
+    return images_per_sec / n_chips
+
+
+def main() -> int:
+    value = bench_resnet50()
+    baseline = None
+    if os.path.exists(BASELINE_FILE):
+        try:
+            with open(BASELINE_FILE) as f:
+                baseline = json.load(f).get("resnet50_images_per_sec_per_chip")
+        except (OSError, ValueError):
+            baseline = None
+    vs_baseline = round(value / baseline, 4) if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": vs_baseline,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

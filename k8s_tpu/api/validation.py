@@ -64,6 +64,7 @@ def validate_v1alpha2_tfjob_spec(spec: v2.TFJobSpec) -> None:
         if r.template is None:
             raise ValidationError(f"Replica {rtype} is missing Template")
         _require_container(r.template, v2c.DEFAULT_CONTAINER_NAME, rtype)
+        _require_port(r.template, rtype)
         if rtype == v2.TFReplicaTypeTPU:
             _validate_tpu_replica(r.template, rtype)
 
@@ -74,6 +75,21 @@ def _require_container(template: dict, container_name: str, rtype: str) -> None:
         raise ValidationError(
             f"Replica type {rtype} is missing a container named {container_name}"
         )
+
+
+def _require_port(template: dict, rtype: str) -> None:
+    """The bootstrap port must exist (the v1alpha2 analogue of v1alpha1's
+    TFPort nil check, validation.go:44-46).  Defaulting adds it, so only
+    un-defaulted specs fail here — terminally, instead of the controller
+    hot-looping on PortNotFoundError during env generation."""
+    for c in ((template.get("spec") or {}).get("containers")) or []:
+        for p in c.get("ports") or []:
+            if p.get("name") == v2c.DEFAULT_PORT_NAME:
+                return
+    raise ValidationError(
+        f"Replica type {rtype} has no container port named {v2c.DEFAULT_PORT_NAME!r} "
+        "(defaulting adds it; was SetDefaults skipped?)"
+    )
 
 
 def _validate_tpu_replica(template: dict, rtype: str) -> None:

@@ -1,0 +1,1 @@
+"""Operator process entrypoints (reference: cmd/)."""

@@ -1,0 +1,146 @@
+"""v1 operator binary (reference: cmd/tf-operator/).
+
+Flags mirror cmd/tf-operator/app/options/options.go:39-47 (chaos-level is
+parsed-but-unused there too; kept for CLI compatibility).  Run flow mirrors
+app.Run (server.go:55-135): cluster config → clients → controller config →
+leader election → controller.Run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import sys
+
+import yaml
+
+from k8s_tpu import version
+from k8s_tpu.api import v1alpha1
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.util.leader_election import LeaderElectionConfig, LeaderElector
+from k8s_tpu.util.signals import setup_signal_handler
+from k8s_tpu.util.util import get_namespace
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-operator")
+    p.add_argument("--chaos-level", type=int, default=-1,
+                   help="(vestigial; parsed for compatibility, options.go:40-41)")
+    p.add_argument("--controller-config-file", default="",
+                   help="Path to the accelerator ControllerConfig YAML (server.go:138-156)")
+    p.add_argument("--enable-gang-scheduling", action="store_true",
+                   help="Create PodDisruptionBudgets for distributed jobs (options.go:46)")
+    p.add_argument("--json-log-format", action="store_true")
+    p.add_argument("--gc-interval-seconds", type=float, default=600,
+                   help="(reserved; resource GC runs via owner references)")
+    p.add_argument("--threadiness", type=int, default=1)
+    p.add_argument("--namespace", default="",
+                   help="Namespace to watch (default: KUBEFLOW_NAMESPACE or all)")
+    p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
+    p.add_argument("--version", action="store_true")
+    return p
+
+
+def read_controller_config(path: str) -> v1alpha1.ControllerConfig:
+    """server.go:138-156."""
+    if not path:
+        return v1alpha1.ControllerConfig()
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    accelerators = {}
+    for name, cfg in (raw.get("accelerators") or {}).items():
+        accelerators[name] = v1alpha1.AcceleratorConfig(
+            volumes=[
+                v1alpha1.AcceleratorVolume(
+                    name=v.get("name", ""),
+                    host_path=v.get("hostPath", ""),
+                    mount_path=v.get("mountPath", ""),
+                )
+                for v in cfg.get("volumes") or []
+            ],
+            env_vars=[
+                v1alpha1.EnvironmentVariableConfig(
+                    name=e.get("name", ""), value=e.get("value", "")
+                )
+                for e in cfg.get("envVars") or []
+            ],
+        )
+    return v1alpha1.ControllerConfig(
+        accelerators=accelerators,
+        grpc_server_file_path=raw.get("grpcServerFilePath", ""),
+    )
+
+
+def make_backend(kubeconfig: str):
+    from k8s_tpu.client.rest import RestClient, get_cluster_config, kubeconfig_config
+
+    if kubeconfig:
+        return RestClient(kubeconfig_config(kubeconfig))
+    return RestClient(get_cluster_config())
+
+
+def run(opts, backend=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format='{"level":"%(levelname)s","msg":"%(message)s","time":"%(asctime)s"}'
+        if opts.json_log_format
+        else "%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    from k8s_tpu.controller.controller import Controller
+
+    clientset = Clientset(backend if backend is not None else make_backend(opts.kubeconfig))
+    config = read_controller_config(opts.controller_config_file)
+    controller = Controller(
+        clientset,
+        config=config,
+        enable_gang_scheduling=opts.enable_gang_scheduling,
+    )
+    stop = setup_signal_handler()
+
+    namespace = opts.namespace or get_namespace()
+    elector = LeaderElector(
+        clientset,
+        LeaderElectionConfig(
+            namespace=namespace,
+            name="tf-operator",
+            identity=f"{socket.gethostname()}-{os.getpid()}",
+        ),
+    )
+
+    def on_started_leading(stop_work):
+        import threading
+
+        merged = threading.Event()
+
+        def wait_any():
+            while not stop.is_set() and not stop_work.is_set():
+                stop.wait(0.2)
+            merged.set()
+
+        import threading as _t
+
+        _t.Thread(target=wait_any, daemon=True).start()
+        controller.run(opts.threadiness, stop_event=merged)
+
+    def on_stopped_leading():
+        log.error("leader election lost")
+        os._exit(1)
+
+    elector.run_or_die(on_started_leading, on_stopped_leading)
+    return 0
+
+
+def main() -> int:
+    opts = build_parser().parse_args()
+    if opts.version:
+        version.print_version("tpu-operator")
+        return 0
+    return run(opts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

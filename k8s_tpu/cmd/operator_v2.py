@@ -1,0 +1,109 @@
+"""v2 operator binary (reference: cmd/tf-operator.v2/).
+
+Flags mirror cmd/tf-operator.v2/app/options/options.go:37-49; run flow
+mirrors app.Run (server.go:57-154): clients → unstructured informer wiring →
+leader election → controller.Run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import sys
+
+from k8s_tpu import version
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.util.leader_election import LeaderElectionConfig, LeaderElector
+from k8s_tpu.util.signals import setup_signal_handler
+from k8s_tpu.util.util import get_namespace
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-operator-v2")
+    p.add_argument("--master", default="", help="apiserver URL override (options.go:44)")
+    p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
+    p.add_argument("--threadiness", type=int, default=2, help="options.go:42")
+    p.add_argument("--namespace", default="")
+    p.add_argument("--enable-gang-scheduling", action="store_true", default=True)
+    p.add_argument("--no-gang-scheduling", dest="enable_gang_scheduling",
+                   action="store_false")
+    p.add_argument("--json-log-format", action="store_true")
+    p.add_argument("--version", action="store_true")
+    return p
+
+
+def make_backend(opts):
+    from k8s_tpu.client.rest import (
+        ClusterConfig,
+        RestClient,
+        get_cluster_config,
+        kubeconfig_config,
+    )
+
+    if opts.master:
+        return RestClient(ClusterConfig(host=opts.master))
+    if opts.kubeconfig:
+        return RestClient(kubeconfig_config(opts.kubeconfig))
+    return RestClient(get_cluster_config())
+
+
+def run(opts, backend=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format='{"level":"%(levelname)s","msg":"%(message)s","time":"%(asctime)s"}'
+        if opts.json_log_format
+        else "%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    from k8s_tpu.controller_v2.controller import TFJobController
+
+    clientset = Clientset(backend if backend is not None else make_backend(opts))
+    controller = TFJobController(
+        clientset, enable_gang_scheduling=opts.enable_gang_scheduling
+    )
+    stop = setup_signal_handler()
+
+    namespace = opts.namespace or get_namespace()
+    elector = LeaderElector(
+        clientset,
+        LeaderElectionConfig(
+            namespace=namespace,
+            name="tf-operator-v2",
+            identity=f"{socket.gethostname()}-{os.getpid()}",
+        ),
+    )
+
+    def on_started_leading(stop_work):
+        import threading
+
+        merged = threading.Event()
+
+        def wait_any():
+            while not stop.is_set() and not stop_work.is_set():
+                stop.wait(0.2)
+            merged.set()
+
+        threading.Thread(target=wait_any, daemon=True).start()
+        controller.run(opts.threadiness, stop_event=merged)
+
+    def on_stopped_leading():
+        log.error("leader election lost")
+        os._exit(1)
+
+    elector.run_or_die(on_started_leading, on_stopped_leading)
+    return 0
+
+
+def main() -> int:
+    opts = build_parser().parse_args()
+    if opts.version:
+        version.print_version("tpu-operator-v2")
+        return 0
+    return run(opts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

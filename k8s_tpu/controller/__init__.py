@@ -1,0 +1,2 @@
+"""Controller v1: stateful "trainer" reconciler (reference: pkg/controller/,
+pkg/trainer/)."""

@@ -1,0 +1,155 @@
+"""Controller v1 (reference: pkg/controller/controller.go).
+
+Workqueue + informer; maps job key → stateful in-memory ``TrainingJob``
+(keyed by UID so a delete+recreate with the same name builds a fresh one,
+controller.go:271-288).  Same rate-limit envelope as the reference
+(exp backoff 5ms→1000s, 10 qps / burst 100 — controller.go:122-126).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from k8s_tpu.api import register, v1alpha1
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.gvr import TFJOBS_V1ALPHA1
+from k8s_tpu.client.informer import SharedInformerFactory, split_meta_namespace_key
+from k8s_tpu.client.record import EventRecorder
+from k8s_tpu.controller.trainer.training import TrainingJob
+from k8s_tpu.util.workqueue import RateLimitingQueue
+
+log = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "tpu-job-controller"
+
+
+class Controller:
+    def __init__(
+        self,
+        clientset: Clientset,
+        config: v1alpha1.ControllerConfig | None = None,
+        informer_factory: SharedInformerFactory | None = None,
+        enable_gang_scheduling: bool = False,
+        recorder=None,
+    ):
+        self.clientset = clientset
+        self.config = config or v1alpha1.ControllerConfig()
+        self.enable_gang_scheduling = enable_gang_scheduling
+        self.recorder = recorder or EventRecorder(clientset, CONTROLLER_NAME)
+        self.queue = RateLimitingQueue()
+        self.jobs: dict[str, TrainingJob] = {}  # key -> TrainingJob
+        self._jobs_lock = threading.Lock()
+
+        self.factory = informer_factory or SharedInformerFactory(clientset.backend)
+        self.tfjob_informer = self.factory.informer_for(TFJOBS_V1ALPHA1)
+        self.tfjob_lister = self.factory.lister_for(TFJOBS_V1ALPHA1)
+        self.tfjob_informer.add_event_handler(
+            on_add=lambda obj: self.enqueue(obj),
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=self._on_delete,
+        )
+        self._workers: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    @staticmethod
+    def _key(obj: dict) -> str:
+        meta = obj.get("metadata") or {}
+        return f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+    def enqueue(self, obj: dict) -> None:
+        self.queue.add(self._key(obj))
+
+    def _on_delete(self, obj: dict) -> None:
+        """Deletion: tear down resources via the in-memory job, then drop it
+        (controller.go handles this through syncTFJob's not-found path; doing
+        it here keeps teardown prompt)."""
+        key = self._key(obj)
+        with self._jobs_lock:
+            job = self.jobs.pop(key, None)
+        if job is not None:
+            try:
+                job.delete()
+            except Exception:
+                log.exception("error deleting job resources for %s", key)
+
+    # -- run loop ------------------------------------------------------------
+
+    def run(self, threadiness: int = 1, stop_event: threading.Event | None = None) -> None:
+        stop = stop_event or self._stop
+        self.start(threadiness)
+        stop.wait()
+        self.shutdown()
+
+    def start(self, threadiness: int = 1) -> None:
+        log.info("Starting %s", CONTROLLER_NAME)
+        self.factory.start()
+        if not self.factory.wait_for_cache_sync(30):
+            raise RuntimeError("timed out waiting for caches to sync")
+        for i in range(threadiness):
+            t = threading.Thread(target=self._run_worker, daemon=True, name=f"v1-worker-{i}")
+            t.start()
+            self._workers.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        self.factory.stop()
+
+    def _run_worker(self) -> None:
+        while self._process_next_work_item():
+            pass
+
+    def _process_next_work_item(self) -> bool:
+        """controller.go:201-234."""
+        key, shutdown = self.queue.get()
+        if shutdown:
+            return False
+        try:
+            forget = self.sync_tfjob(key)
+            if forget:
+                self.queue.forget(key)
+            else:
+                self.queue.add_rate_limited(key)
+        except Exception:
+            log.exception("error syncing tfjob %s", key)
+            self.queue.add_rate_limited(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    # -- sync ----------------------------------------------------------------
+
+    def sync_tfjob(self, key: str) -> bool:
+        """controller.go:241-310."""
+        start = time.monotonic()
+        try:
+            ns, name = split_meta_namespace_key(key)
+            obj = self.tfjob_lister.get(ns, name)
+            if obj is None:
+                with self._jobs_lock:
+                    job = self.jobs.pop(key, None)
+                if job is not None:
+                    job.delete()
+                return True
+            tfjob = register.tfjob_from_unstructured(obj)
+
+            with self._jobs_lock:
+                existing = self.jobs.get(key)
+                if existing is None or existing.uid() != tfjob.metadata.uid:
+                    # new job (or delete+recreate under the same name)
+                    existing = TrainingJob(self.clientset, self.recorder, tfjob)
+                    self.jobs[key] = existing
+                else:
+                    existing.job = tfjob  # Update (controller.go:284-288)
+
+            existing.reconcile(self.config, self.enable_gang_scheduling)
+            return existing.status.phase in (
+                v1alpha1.PHASE_DONE,
+                v1alpha1.PHASE_FAILED,
+                v1alpha1.PHASE_RUNNING,
+                v1alpha1.PHASE_CREATING,
+            )
+        finally:
+            log.debug("finished syncing %s (%.3fs)", key, time.monotonic() - start)

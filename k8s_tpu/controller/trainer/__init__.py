@@ -1,0 +1,4 @@
+"""Per-job trainer engine (reference: pkg/trainer/)."""
+
+from k8s_tpu.controller.trainer.training import TrainingJob  # noqa: F401
+from k8s_tpu.controller.trainer.replicas import TFReplicaSet  # noqa: F401

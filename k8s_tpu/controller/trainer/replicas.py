@@ -1,0 +1,311 @@
+"""TFReplicaSet — the v1 per-replica engine (reference: pkg/trainer/replicas.go).
+
+One pod + one headless service per replica index; pod identity is the
+deterministic service name (`<job40>-<type>-<runtimeid>-<idx>`,
+replicas.go:520-526) while pod names get a random suffix.  State is derived
+from container termination states with the retryable-exit-code contract
+(replicas.go:310-363).
+
+TPU-native change: besides the legacy ``TF_CONFIG`` (with
+``environment: cloud``, replicas.go:202-213), SPMD participants (MASTER /
+WORKER / TPU_WORKER) get the jax.distributed bootstrap env — the v1 job's
+process table orders MASTER first so the chief is process 0.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from k8s_tpu.api import helpers, v1alpha1
+from k8s_tpu.client import errors
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.util import train_util
+from k8s_tpu.util.util import rand_string
+
+log = logging.getLogger(__name__)
+
+FAILED_CREATE_REASON = "FailedCreate"
+SUCCESSFUL_CREATE_REASON = "SuccessfulCreate"
+
+# v1 SPMD participants, in process-id order (MASTER ≡ chief ≡ process 0).
+V1_SPMD_TYPE_ORDER = (v1alpha1.MASTER, v1alpha1.TPU_WORKER, v1alpha1.WORKER)
+
+
+class TFReplicaSet:
+    def __init__(self, clientset: Clientset, recorder, spec: v1alpha1.TFReplicaSpec, job):
+        """NewTFReplicaSet (replicas.go:76-118) including its validations."""
+        if spec.tf_replica_type == v1alpha1.MASTER and spec.replicas != 1:
+            raise ValueError("The MASTER must have Replicas = 1")
+        if spec.tf_port is None:
+            raise ValueError("tfReplicaSpec.TFPort can't be None")
+        if spec.template is None and spec.tf_replica_type != v1alpha1.PS:
+            raise ValueError(
+                f"tfReplicaSpec.Template can't be None for replica type {spec.tf_replica_type}"
+            )
+        if spec.tf_replica_type not in v1alpha1.VALID_REPLICA_TYPES:
+            raise ValueError(
+                f"tfReplicaSpec.TFReplicaType is {spec.tf_replica_type} but must be one of "
+                f"{list(v1alpha1.VALID_REPLICA_TYPES)}"
+            )
+        self.clientset = clientset
+        self.recorder = recorder
+        self.spec = spec
+        self.job = job
+
+    # -- naming & labels -----------------------------------------------------
+
+    def labels(self) -> dict[str, str]:
+        """replicas.go:121-129."""
+        return {
+            "kubeflow.org": "",
+            "job_type": self.spec.tf_replica_type,
+            "runtime_id": self.job.job.spec.runtime_id,
+            "tf_job_name": self.job.job.metadata.name,
+        }
+
+    def labels_by_index(self, index: int) -> dict[str, str]:
+        labels = self.labels()
+        labels["task_index"] = str(index)
+        return labels
+
+    def gen_name(self, index: int) -> str:
+        """`<job:.40>-<type>-<runtimeid>-<idx>` (replicas.go:520-526)."""
+        name = self.job.job.metadata.name[:40]
+        rt = self.spec.tf_replica_type.lower()
+        return f"{name}-{rt}-{self.job.job.spec.runtime_id}-{index}"
+
+    def gen_pod_name(self, index: int) -> str:
+        return f"{self.gen_name(index)}-{rand_string(5)}"
+
+    @property
+    def _namespace(self) -> str:
+        return self.job.job.metadata.namespace
+
+    # -- env -----------------------------------------------------------------
+
+    def _env_for_index(self, index: int) -> list[dict]:
+        """TF_CONFIG with environment=cloud (replicas.go:202-213) + JAX
+        bootstrap env for SPMD participants."""
+        tf_config = {
+            "cluster": self.job.cluster_spec(),
+            "task": {"type": self.spec.tf_replica_type.lower(), "index": index},
+            "environment": "cloud",
+        }
+        env = [{"name": "TF_CONFIG", "value": json.dumps(tf_config, sort_keys=True)}]
+
+        table = self.job.spmd_process_table()
+        pid = None
+        for i, (rtype, idx, _host) in enumerate(table):
+            if rtype == self.spec.tf_replica_type and idx == index:
+                pid = i
+                break
+        if pid is not None and table:
+            env += [
+                {"name": "JAX_COORDINATOR_ADDRESS", "value": table[0][2]},
+                {"name": "JAX_NUM_PROCESSES", "value": str(len(table))},
+                {"name": "JAX_PROCESS_ID", "value": str(pid)},
+                {"name": "TPU_WORKER_ID", "value": str(index)},
+            ]
+            tpu = self.job.job.spec.tpu
+            if tpu is not None and tpu.accelerator_type:
+                env.append({"name": "TPU_ACCELERATOR_TYPE", "value": tpu.accelerator_type})
+            if tpu is not None and tpu.topology:
+                env.append({"name": "TPU_TOPOLOGY", "value": tpu.topology})
+        return env
+
+    # -- create --------------------------------------------------------------
+
+    def create_service_with_index(self, index: int) -> dict:
+        """replicas.go:139-169: headless service per index."""
+        labels = self.labels_by_index(index)
+        service = {
+            "metadata": {
+                "name": self.gen_name(index),
+                "labels": labels,
+                "ownerReferences": [helpers.as_owner(self.job.job).to_dict()],
+            },
+            "spec": {
+                "selector": labels,
+                "clusterIP": "None",
+                "ports": [{"name": "tf-port", "port": self.spec.tf_port}],
+            },
+        }
+        return self.clientset.services(self._namespace).create(service)
+
+    def create_pod_with_index(self, index: int) -> dict:
+        """replicas.go:172-240."""
+        import copy
+
+        template = self.spec.template or {}
+        labels = self.labels_by_index(index)
+        pod = {
+            "metadata": {
+                "name": self.gen_pod_name(index),
+                "labels": dict(labels),
+                "annotations": {},
+                "ownerReferences": [helpers.as_owner(self.job.job).to_dict()],
+            },
+            "spec": copy.deepcopy(template.get("spec") or {}),
+        }
+        if self.job.scheduler_name():
+            pod["spec"]["schedulerName"] = self.job.scheduler_name()
+
+        for k, v in ((template.get("metadata") or {}).get("labels") or {}).items():
+            pod["metadata"]["labels"].setdefault(k, v)
+        for k, v in ((template.get("metadata") or {}).get("annotations") or {}).items():
+            pod["metadata"]["annotations"].setdefault(k, v)
+
+        env_vars = self._env_for_index(index)
+        for c in pod["spec"].get("containers") or []:
+            if c.get("name") != v1alpha1.DEFAULT_TF_CONTAINER:
+                continue
+            c.setdefault("env", []).extend(copy.deepcopy(env_vars))
+        return self.clientset.pods(self._namespace).create(pod)
+
+    # -- sync ----------------------------------------------------------------
+
+    def sync_pods(self) -> None:
+        """replicas.go:434-485: create the missing (non-Failed) index pods."""
+        for index in range(self.spec.replicas or 1):
+            pods = self.clientset.pods(self._namespace).list(
+                label_selector=self.labels_by_index(index)
+            )
+            live = [p for p in pods if (p.get("status") or {}).get("phase") != "Failed"]
+            if live:
+                continue
+            log.info(
+                "job %s missing pod for replica %s index %d, creating",
+                self.job.name(), self.spec.tf_replica_type, index,
+            )
+            try:
+                created = self.create_pod_with_index(index)
+            except errors.ApiError as e:
+                if errors.is_already_exists(e):
+                    continue
+                self.recorder.eventf(
+                    self.job.job.to_dict(), "Warning", FAILED_CREATE_REASON,
+                    "Error creating: %s", e,
+                )
+                raise
+            self.recorder.eventf(
+                self.job.job.to_dict(), "Normal", SUCCESSFUL_CREATE_REASON,
+                "Created pod: %s", created["metadata"]["name"],
+            )
+
+    def sync_services(self) -> None:
+        """replicas.go:488-517."""
+        for index in range(self.spec.replicas or 1):
+            try:
+                self.clientset.services(self._namespace).get(self.gen_name(index))
+                continue
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    raise
+            try:
+                created = self.create_service_with_index(index)
+            except errors.ApiError as e:
+                if errors.is_already_exists(e):
+                    continue
+                self.recorder.eventf(
+                    self.job.job.to_dict(), "Warning", FAILED_CREATE_REASON,
+                    "Error creating: %s", e,
+                )
+                raise
+            self.recorder.eventf(
+                self.job.job.to_dict(), "Normal", SUCCESSFUL_CREATE_REASON,
+                "Created Service: %s", created["metadata"]["name"],
+            )
+
+    # -- status --------------------------------------------------------------
+
+    def get_single_replica_status(self, index: int) -> str:
+        """replicas.go:365-387 + replicaStatusFromPodList (:310-363)."""
+        try:
+            pods = self.clientset.pods(self._namespace).list(
+                label_selector=self.labels_by_index(index)
+            )
+        except errors.ApiError:
+            return v1alpha1.REPLICA_STATE_FAILED
+        return replica_status_from_pod_list(pods, v1alpha1.DEFAULT_TF_CONTAINER)
+
+    def get_status(self) -> v1alpha1.TFReplicaStatus:
+        """replicas.go:390-432: aggregate per-index states."""
+        status = v1alpha1.TFReplicaStatus(
+            tf_replica_type=self.spec.tf_replica_type,
+            state=v1alpha1.REPLICA_STATE_UNKNOWN,
+            replicas_states={},
+        )
+        for index in range(self.spec.replicas or 1):
+            s = self.get_single_replica_status(index)
+            status.replicas_states[s] = status.replicas_states.get(s, 0) + 1
+
+        if v1alpha1.REPLICA_STATE_FAILED in status.replicas_states:
+            status.state = v1alpha1.REPLICA_STATE_FAILED
+        elif v1alpha1.REPLICA_STATE_RUNNING in status.replicas_states:
+            status.state = v1alpha1.REPLICA_STATE_RUNNING
+        elif status.replicas_states.get(v1alpha1.REPLICA_STATE_SUCCEEDED, 0) == (
+            self.spec.replicas or 1
+        ):
+            status.state = v1alpha1.REPLICA_STATE_SUCCEEDED
+        return status
+
+    # -- delete --------------------------------------------------------------
+
+    def delete(self) -> None:
+        """replicas.go:244-307: delete owned pods + services by selector."""
+        selector = {
+            "runtime_id": self.job.job.spec.runtime_id,
+            "tf_job_name": self.job.job.metadata.name,
+            "job_type": self.spec.tf_replica_type,
+        }
+        self.clientset.pods(self._namespace).delete_collection(label_selector=selector)
+        for index in range(self.spec.replicas or 1):
+            try:
+                self.clientset.services(self._namespace).delete(self.gen_name(index))
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    log.warning("deleting service %s: %s", self.gen_name(index), e)
+
+
+def is_retryable_termination_state(terminated: dict) -> bool:
+    """training.go:192-206: OOMKilled is always permanent; otherwise the
+    exit-code table decides."""
+    if terminated.get("reason") == "OOMKilled":
+        return False
+    return train_util.is_retryable_exit_code(int(terminated.get("exitCode", -1)))
+
+
+def replica_status_from_pod_list(pods: list[dict], container_name: str) -> str:
+    """replicas.go:310-363: newest pod's container state decides; retryable
+    terminations count as Running (kubelet will restart the container)."""
+    latest = None
+    for p in pods:
+        if latest is None:
+            latest = p
+            continue
+        lt = ((latest.get("status") or {}).get("startTime")) or ""
+        ct = ((p.get("status") or {}).get("startTime")) or ""
+        if lt < ct:
+            latest = p
+    if latest is None:
+        return v1alpha1.REPLICA_STATE_RUNNING
+
+    state: dict = {}
+    for cs in ((latest.get("status") or {}).get("containerStatuses")) or []:
+        if cs.get("name") != container_name:
+            continue
+        state = cs.get("state") or {}
+        if (cs.get("lastState") or {}).get("terminated"):
+            state = cs["lastState"]
+
+    if state.get("running") is not None or state.get("waiting") is not None:
+        return v1alpha1.REPLICA_STATE_RUNNING
+    terminated = state.get("terminated")
+    if terminated is not None:
+        if int(terminated.get("exitCode", -1)) == 0:
+            return v1alpha1.REPLICA_STATE_SUCCEEDED
+        if is_retryable_termination_state(terminated):
+            return v1alpha1.REPLICA_STATE_RUNNING
+        return v1alpha1.REPLICA_STATE_FAILED
+    return v1alpha1.REPLICA_STATE_UNKNOWN

@@ -1,0 +1,267 @@
+"""TrainingJob — the v1 per-job state machine (reference: pkg/trainer/training.go).
+
+Phases: None → (setup: default+validate+accelerators+RuntimeId) → Creating →
+Running → CleanUp → Done, with Failed on setup/validation errors
+(training.go:214-248, 314-428).  The chief replica's state decides the job
+state (training.go:154-189); in the TPU world the chief is JAX process 0, so
+MASTER keeps its meaning and pure-TPU jobs chief on TPU_WORKER:0.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from k8s_tpu.api import helpers, register, v1alpha1, validation
+from k8s_tpu.client import errors
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.controller.trainer.replicas import (
+    TFReplicaSet,
+    V1_SPMD_TYPE_ORDER,
+)
+from k8s_tpu.util.util import rand_string
+
+log = logging.getLogger(__name__)
+
+
+class TrainingJob:
+    def __init__(self, clientset: Clientset, recorder, job: v1alpha1.TFJob):
+        self.clientset = clientset
+        self.recorder = recorder
+        self.job = job
+        self.status = v1alpha1.TFJobStatus.from_dict(job.status.to_dict())
+        self.replicas: list[TFReplicaSet] = []
+        self.pdb_name: str | None = None
+
+    # -- identity ------------------------------------------------------------
+
+    def name(self) -> str:
+        return self.job.metadata.name
+
+    def fullname(self) -> str:
+        return f"{self.job.metadata.namespace}:{self.job.metadata.name}"
+
+    def uid(self) -> str:
+        return self.job.metadata.uid
+
+    def scheduler_name(self) -> str:
+        return self.job.spec.scheduler_name
+
+    # -- cluster spec --------------------------------------------------------
+
+    def cluster_spec(self) -> dict[str, list[str]]:
+        """ClusterSpec (training.go:126-140): type → ['name:port', ...] using
+        the deterministic per-index service names."""
+        spec: dict[str, list[str]] = {}
+        for r in self.replicas:
+            rt = r.spec.tf_replica_type.lower()
+            spec[rt] = [
+                f"{r.gen_name(i)}:{r.spec.tf_port}" for i in range(r.spec.replicas or 1)
+            ]
+        return spec
+
+    def spmd_process_table(self) -> list[tuple[str, int, str]]:
+        """(rtype, index, host:port) triples in process-id order; MASTER (the
+        chief) is process 0.  PS is not an SPMD participant."""
+        table = []
+        by_type = {r.spec.tf_replica_type: r for r in self.replicas}
+        for rtype in V1_SPMD_TYPE_ORDER:
+            r = by_type.get(rtype)
+            if r is None:
+                continue
+            for i in range(r.spec.replicas or 1):
+                table.append((rtype, i, f"{r.gen_name(i)}:{r.spec.tf_port}"))
+        return table
+
+    # -- setup ---------------------------------------------------------------
+
+    def setup(self, config: v1alpha1.ControllerConfig) -> None:
+        """training.go:214-248."""
+        if self.status.phase != v1alpha1.PHASE_NONE:
+            log.warning("job %s has already been setup", self.name())
+            return
+        try:
+            register.default_tfjob(self.job)
+            validation.validate_v1alpha1_tfjob_spec(self.job.spec)
+            helpers.configure_accelerators_for_tfjob_spec(
+                self.job.spec, config.accelerators
+            )
+            if not self.job.spec.runtime_id:
+                self.job.spec.runtime_id = rand_string(4)
+        except (validation.ValidationError, ValueError) as e:
+            self.status.reason = f"invalid job spec: {e}"
+            self.status.phase = v1alpha1.PHASE_FAILED
+            self.status.state = v1alpha1.STATE_FAILED
+            return
+        self.status.phase = v1alpha1.PHASE_CREATING
+        self.status.state = v1alpha1.STATE_RUNNING
+
+    def setup_replicas(self) -> None:
+        """training.go:251-264."""
+        if len(self.replicas) != len(self.job.spec.replica_specs):
+            self.replicas = [
+                TFReplicaSet(self.clientset, self.recorder, spec, self)
+                for spec in self.job.spec.replica_specs
+            ]
+
+    # -- status --------------------------------------------------------------
+
+    def get_status(self) -> tuple[str, list[v1alpha1.TFReplicaStatus]]:
+        """training.go:154-189: the chief replica's state decides."""
+        chief = self.job.spec.termination_policy.chief
+        chief_state = v1alpha1.REPLICA_STATE_UNKNOWN
+        replica_statuses = []
+        for r in self.replicas:
+            replica_statuses.append(r.get_status())
+            if r.spec.tf_replica_type == chief.replica_name:
+                chief_state = r.get_single_replica_status(chief.replica_index)
+
+        state = v1alpha1.STATE_UNKNOWN
+        if chief_state == v1alpha1.REPLICA_STATE_RUNNING:
+            state = v1alpha1.STATE_RUNNING
+        elif chief_state == v1alpha1.REPLICA_STATE_FAILED:
+            state = v1alpha1.STATE_FAILED
+        elif chief_state == v1alpha1.REPLICA_STATE_SUCCEEDED:
+            state = v1alpha1.STATE_SUCCEEDED
+        return state, replica_statuses
+
+    def update_crd_status(self) -> None:
+        """training.go:295-311: write only when changed."""
+        if self.job.status.to_dict() == self.status.to_dict():
+            return
+        self.job.status = v1alpha1.TFJobStatus.from_dict(self.status.to_dict())
+        try:
+            updated = self.clientset.tfjobs(
+                self.job.metadata.namespace, self.job.api_version
+            ).update(self.job)
+            self.job = updated
+            self.job.status = v1alpha1.TFJobStatus.from_dict(self.status.to_dict())
+        except errors.ApiError as e:
+            if errors.is_conflict(e):
+                log.info("status update conflict for %s", self.name())
+            else:
+                raise
+
+    # -- gang scheduling -----------------------------------------------------
+
+    def gen_pdb_name(self) -> str:
+        return f"tf-job-pdb-{self.job.metadata.name}"
+
+    def create_pdb(self, nr_replicas: int) -> dict:
+        """training.go:450-474."""
+        pdb = {
+            "metadata": {
+                "name": self.gen_pdb_name(),
+                "ownerReferences": [helpers.as_owner(self.job).to_dict()],
+            },
+            "spec": {
+                "minAvailable": nr_replicas,
+                "selector": {
+                    "matchLabels": {
+                        "runtime_id": self.job.spec.runtime_id,
+                        "tf_job_name": self.job.metadata.name,
+                    }
+                },
+            },
+        }
+        return self.clientset.pdbs(self.job.metadata.namespace).create(pdb)
+
+    def sync_pdb(self) -> None:
+        """training.go:477-511: PDB with minAvailable = Σreplicas when the
+        job is distributed."""
+        nr_replicas = sum(r.spec.replicas or 1 for r in self.replicas)
+        if nr_replicas == 1:
+            return
+        try:
+            self.clientset.pdbs(self.job.metadata.namespace).get(self.gen_pdb_name())
+            self.pdb_name = self.gen_pdb_name()
+            return
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                raise
+        try:
+            created = self.create_pdb(nr_replicas)
+            self.pdb_name = created["metadata"]["name"]
+            self.recorder.eventf(
+                self.job.to_dict(), "Normal", "SuccessfulCreate",
+                "Created PDB: %s", self.pdb_name,
+            )
+        except errors.ApiError as e:
+            if errors.is_already_exists(e):
+                self.pdb_name = self.gen_pdb_name()
+                return
+            self.recorder.eventf(
+                self.job.to_dict(), "Warning", "FailedCreate", "Error creating: %s", e
+            )
+            raise
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def delete_resources(self) -> None:
+        for r in self.replicas:
+            r.delete()
+
+    def delete(self) -> None:
+        """training.go:267-292: user deletion → CleanUp + resource deletion."""
+        log.info("TFJob %s deleted by the user", self.fullname())
+        if self.job.status.phase != v1alpha1.PHASE_CLEANUP:
+            self.status.phase = v1alpha1.PHASE_CLEANUP
+        self.delete_resources()
+        if self.pdb_name:
+            try:
+                self.clientset.pdbs(self.job.metadata.namespace).delete(self.pdb_name)
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    log.warning("error deleting PDB %s: %s", self.pdb_name, e)
+
+    def reconcile(self, config: v1alpha1.ControllerConfig, enable_gang_scheduling: bool) -> None:
+        """training.go:314-428."""
+        if self.job.metadata.deletion_timestamp:
+            log.info("deletion timestamp set; skipping reconcile")
+            return
+
+        if self.job.status.phase == v1alpha1.PHASE_NONE and self.status.phase == v1alpha1.PHASE_NONE:
+            self.setup(config)
+            self.update_crd_status()
+
+        if self.status.phase == v1alpha1.PHASE_FAILED:
+            self.update_crd_status()
+            return
+
+        try:
+            self.setup_replicas()
+        except ValueError as e:
+            self.status.reason = f"Could not create in memory datastructures; {e}"
+            self.update_crd_status()
+            raise
+
+        if enable_gang_scheduling:
+            try:
+                self.sync_pdb()
+            except errors.ApiError as e:
+                log.error("SyncPdb error: %s", e)
+
+        if self.status.phase in (v1alpha1.PHASE_CREATING, v1alpha1.PHASE_RUNNING):
+            for r in self.replicas:
+                r.sync_pods()
+            for r in self.replicas:
+                r.sync_services()
+            self.update_crd_status()
+
+            state, replica_statuses = self.get_status()
+            self.status.replica_statuses = replica_statuses
+            if state == v1alpha1.STATE_FAILED:
+                self.status.phase = v1alpha1.PHASE_CLEANUP
+                self.status.state = v1alpha1.STATE_FAILED
+            elif state == v1alpha1.STATE_SUCCEEDED:
+                self.status.phase = v1alpha1.PHASE_CLEANUP
+                self.status.state = v1alpha1.STATE_SUCCEEDED
+            elif state == v1alpha1.STATE_RUNNING:
+                self.status.phase = v1alpha1.PHASE_RUNNING
+                self.status.state = v1alpha1.STATE_RUNNING
+            self.update_crd_status()
+
+        if self.status.phase == v1alpha1.PHASE_CLEANUP:
+            self.delete_resources()
+            self.status.phase = v1alpha1.PHASE_DONE
+
+        self.update_crd_status()

@@ -99,16 +99,20 @@ class TFJobController:
 
     @staticmethod
     def _key_of(obj: dict) -> str:
-        meta = obj.get("metadata") or {}
-        ns, name = meta.get("namespace", ""), meta.get("name", "")
-        return f"{ns}/{name}" if ns else name
+        from k8s_tpu.client.informer import meta_namespace_key
+
+        return meta_namespace_key(obj)
 
     def _add_tfjob(self, obj: dict) -> None:
         self.enqueue_key(self._key_of(obj))
 
     def _delete_tfjob(self, obj: dict) -> None:
         key = self._key_of(obj)
-        for rtype in (obj.get("spec") or {}).get("tfReplicaSpecs") or {}:
+        # The deleted object's spec may be unavailable (lister-miss path), so
+        # sweep every known replica type rather than trusting the payload.
+        rtypes = set((obj.get("spec") or {}).get("tfReplicaSpecs") or {})
+        rtypes.update(types.VALID_REPLICA_TYPES)
+        for rtype in rtypes:
             self.expectations.delete_expectations(
                 pod_mod.gen_expectation_pods_key(key, rtype.lower())
             )
@@ -196,6 +200,9 @@ class TFJobController:
                 return False
 
             register.default_tfjob(tfjob)
+            # Stash the as-observed status on the sync-local job object (not
+            # the controller: workers sync different jobs concurrently).
+            tfjob._observed_status = tfjob.status.to_dict()
             try:
                 validation.validate_v1alpha2_tfjob_spec(tfjob.spec)
             except validation.ValidationError as e:
@@ -215,17 +222,20 @@ class TFJobController:
             log.debug("finished syncing %s (%.3fs)", key, time.monotonic() - start)
 
     def satisfied_expectations(self, tfjob) -> bool:
-        """controller.go:417-436: any replica type's pods/services satisfied."""
-        satisfied = False
+        """All replica types' pod AND service expectations must be satisfied.
+
+        Deliberate fix over the reference (controller.go:417-436 ORs across
+        keys): with OR, service ADD echoes arriving before pod echoes let a
+        sync proceed against a stale pod lister and double-create the gang.
+        """
         key = tpu_config.tfjob_key(tfjob)
-        for rtype in tfjob.spec.tf_replica_specs:
-            satisfied = satisfied or self.expectations.satisfied(
-                pod_mod.gen_expectation_pods_key(key, rtype.lower())
+        return all(
+            self.expectations.satisfied(pod_mod.gen_expectation_pods_key(key, rt.lower()))
+            and self.expectations.satisfied(
+                service_mod.gen_expectation_services_key(key, rt.lower())
             )
-            satisfied = satisfied or self.expectations.satisfied(
-                service_mod.gen_expectation_services_key(key, rtype.lower())
-            )
-        return satisfied
+            for rt in tfjob.spec.tf_replica_specs
+        )
 
     def reconcile_tfjobs(self, tfjob) -> None:
         """reconcileTFJobs (controller.go:377-412)."""
@@ -258,8 +268,24 @@ class TFJobController:
         tfjob.status.last_reconcile_time = now_rfc3339()
         self.update_status_handler(tfjob)
 
+    @staticmethod
+    def _status_changed(observed: dict | None, current: dict) -> bool:
+        """Ignore last_reconcile_time: writing a bare timestamp would emit a
+        MODIFIED event that re-enqueues the job, and the resulting write →
+        event → sync → write cycle busy-loops every running job."""
+        if observed is None:
+            return True
+        a = {k: v for k, v in observed.items() if k != "lastReconcileTime"}
+        b = {k: v for k, v in current.items() if k != "lastReconcileTime"}
+        return a != b
+
     def _update_tfjob_status(self, tfjob) -> None:
-        """updateTFJobStatus (controller_status.go:88-91)."""
+        """updateTFJobStatus (controller_status.go:88-91), writing only when
+        the status materially changed since this sync observed it."""
+        if not self._status_changed(
+            getattr(tfjob, "_observed_status", None), tfjob.status.to_dict()
+        ):
+            return
         try:
             self.clientset.tfjobs(tfjob.metadata.namespace, tfjob.api_version).update(tfjob)
         except errors.ApiError as e:

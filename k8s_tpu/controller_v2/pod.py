@@ -215,7 +215,6 @@ class PodReconciler:
     ) -> None:
         """createNewPod (controller_pod.go:99-169)."""
         key = tpu_config.tfjob_key(tfjob)
-        self.expectations.expect_creations(gen_expectation_pods_key(key, rt), 1)
 
         from k8s_tpu.api import helpers
 
@@ -233,7 +232,11 @@ class PodReconciler:
         meta.pop("name", None)
         meta["generateName"] = tpu_config.gen_general_name(key, rt, index) + "-"
 
+        # Everything fallible (port lookup, env generation) happens BEFORE the
+        # expectation is raised: a raise after expect_creations with no create
+        # would leak the expectation and wedge retries.
         env_vars = tpu_config.gen_env_vars(tfjob, rt, index)
+        self.expectations.expect_creations(gen_expectation_pods_key(key, rt), 1)
         for container in template.setdefault("spec", {}).setdefault("containers", []):
             container.setdefault("env", []).extend(copy.deepcopy(env_vars))
 

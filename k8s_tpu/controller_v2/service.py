@@ -83,7 +83,6 @@ class ServiceReconciler:
         selecting exactly one replica index."""
         key = tpu_config.tfjob_key(tfjob)
         rt = rtype.lower()
-        self.expectations.expect_creations(gen_expectation_services_key(key, rt), 1)
 
         from k8s_tpu.api import helpers
 
@@ -93,7 +92,10 @@ class ServiceReconciler:
         labels[tpu_config.LABEL_REPLICA_INDEX] = str(index)
 
         name = tpu_config.gen_general_name(key, rt, index)
+        # Fallible port lookup happens before the expectation is raised (a
+        # raise afterwards would leak it — see pod.py counterpart).
         port = tpu_config.get_port_from_tfjob(tfjob, rtype)
+        self.expectations.expect_creations(gen_expectation_services_key(key, rt), 1)
         service = {
             "metadata": {"name": name, "labels": dict(labels)},
             "spec": {

@@ -1,0 +1,225 @@
+"""Dashboard backend (reference: dashboard/backend/).
+
+Serves the SPA at ``/tfjobs/ui/`` and the REST API under ``/tfjobs/api``
+(routes from dashboard/backend/handler/api_handler.go:74-113):
+
+    GET    /tfjobs/api/tfjob                         list across namespaces
+    GET    /tfjobs/api/tfjob/{namespace}             list in a namespace
+    GET    /tfjobs/api/tfjob/{namespace}/{name}      get one
+    POST   /tfjobs/api/tfjob                         deploy (creates ns if absent)
+    DELETE /tfjobs/api/tfjob/{namespace}/{name}      delete
+    GET    /tfjobs/api/logs/{namespace}/{pod}        pod logs
+    GET    /tfjobs/api/namespaces                    list namespaces
+
+Implemented on http.server (stdlib-only like the rest of the control plane).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from k8s_tpu.client import errors
+from k8s_tpu.client.clientset import Clientset
+
+log = logging.getLogger(__name__)
+
+FRONTEND_DIR = Path(__file__).parent / "frontend"
+
+
+class ClientManager:
+    """dashboard/backend/client/manager.go:13-45."""
+
+    def __init__(self, clientset: Clientset):
+        self.clientset = clientset
+
+
+def _make_handler(manager: ClientManager):
+    cs = manager.clientset
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            log.debug(fmt, *args)
+
+        # -- helpers ---------------------------------------------------------
+
+        def _send_json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, code: int, text: str, content_type="text/plain") -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, e: Exception) -> None:
+            if isinstance(e, errors.ApiError):
+                code = e.code
+            elif isinstance(e, (json.JSONDecodeError, ValueError)):
+                code = 400
+            else:
+                code = 500
+            self._send_json(code, {"error": str(e)})
+
+        def _read_body(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            return json.loads(self.rfile.read(length)) if length else {}
+
+        # -- routes ----------------------------------------------------------
+
+        def do_GET(self):  # noqa: N802
+            path = self.path.split("?")[0].rstrip("/")
+            try:
+                if path in ("", "/tfjobs/ui", "/tfjobs"):
+                    self._serve_ui("index.html")
+                elif path.startswith("/tfjobs/ui/"):
+                    self._serve_ui(path[len("/tfjobs/ui/"):] or "index.html")
+                elif path == "/tfjobs/api/tfjob":
+                    jobs = []
+                    for ns in self._namespaces():
+                        jobs += cs.tfjobs_unstructured(ns).list()
+                    self._send_json(200, {"items": jobs})
+                elif m := re.fullmatch(r"/tfjobs/api/tfjob/([^/]+)", path):
+                    self._send_json(
+                        200, {"items": cs.tfjobs_unstructured(m.group(1)).list()}
+                    )
+                elif m := re.fullmatch(r"/tfjobs/api/tfjob/([^/]+)/([^/]+)", path):
+                    ns, name = m.groups()
+                    job = cs.tfjobs_unstructured(ns).get(name)
+                    pods = cs.pods(ns).list(label_selector={"tf_job_name": name})
+                    if not pods:
+                        pods = [
+                            p
+                            for p in cs.pods(ns).list()
+                            if any(
+                                r.get("name") == name
+                                for r in (p.get("metadata", {}).get("ownerReferences") or [])
+                            )
+                        ]
+                    self._send_json(200, {"tfJob": job, "pods": pods})
+                elif m := re.fullmatch(r"/tfjobs/api/logs/([^/]+)/([^/]+)", path):
+                    ns, pod = m.groups()
+                    cs.pods(ns).get(pod)  # 404 if missing
+                    # Log retrieval needs a kubelet; the fake backend stores
+                    # them under status.log for tests.
+                    obj = cs.pods(ns).get(pod)
+                    self._send_json(
+                        200, {"logs": (obj.get("status") or {}).get("log", "")}
+                    )
+                elif path == "/tfjobs/api/namespaces":
+                    self._send_json(200, {"namespaces": self._namespaces()})
+                else:
+                    self._send_json(404, {"error": f"no route {path}"})
+            except Exception as e:  # noqa: BLE001
+                self._error(e)
+
+        def do_POST(self):  # noqa: N802
+            path = self.path.split("?")[0].rstrip("/")
+            try:
+                if path == "/tfjobs/api/tfjob":
+                    body = self._read_body()
+                    ns = (body.get("metadata") or {}).get("namespace", "default")
+                    # create the namespace if missing (api_handler.go deploy path)
+                    try:
+                        cs.namespaces().get(ns)
+                    except errors.ApiError as e:
+                        if errors.is_not_found(e):
+                            cs.namespaces().create({"metadata": {"name": ns}})
+                        else:
+                            raise
+                    created = cs.tfjobs_unstructured(
+                        ns, body.get("apiVersion", "kubeflow.org/v1alpha2")
+                    ).create(body)
+                    self._send_json(201, created)
+                else:
+                    self._send_json(404, {"error": f"no route {path}"})
+            except Exception as e:  # noqa: BLE001
+                self._error(e)
+
+        def do_DELETE(self):  # noqa: N802
+            path = self.path.split("?")[0].rstrip("/")
+            try:
+                if m := re.fullmatch(r"/tfjobs/api/tfjob/([^/]+)/([^/]+)", path):
+                    ns, name = m.groups()
+                    cs.tfjobs_unstructured(ns).delete(name)
+                    self._send_json(200, {"status": "deleted"})
+                else:
+                    self._send_json(404, {"error": f"no route {path}"})
+            except Exception as e:  # noqa: BLE001
+                self._error(e)
+
+        # -- static ----------------------------------------------------------
+
+        def _serve_ui(self, rel: str) -> None:
+            target = (FRONTEND_DIR / rel).resolve()
+            if not str(target).startswith(str(FRONTEND_DIR.resolve())) or not target.is_file():
+                target = FRONTEND_DIR / "index.html"
+            ctype = "text/html"
+            if target.suffix == ".js":
+                ctype = "application/javascript"
+            elif target.suffix == ".css":
+                ctype = "text/css"
+            self._send_text(200, target.read_text(), ctype)
+
+        def _namespaces(self) -> list[str]:
+            try:
+                return [
+                    n["metadata"]["name"] for n in cs.namespaces().list()
+                ] or ["default"]
+            except errors.ApiError:
+                return ["default"]
+
+    return Handler
+
+
+class DashboardServer:
+    def __init__(self, clientset: Clientset, host: str = "0.0.0.0", port: int = 8080):
+        self.manager = ClientManager(clientset)
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(self.manager))
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        log.info("dashboard listening on :%d (ui at /tfjobs/ui/)", self.port)
+        self.httpd.serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True, name="dashboard")
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+
+
+def main() -> int:
+    import argparse
+
+    p = argparse.ArgumentParser("tpu-dashboard")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--kubeconfig", default="")
+    opts = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    from k8s_tpu.cmd.operator import make_backend
+
+    server = DashboardServer(Clientset(make_backend(opts.kubeconfig)), port=opts.port)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

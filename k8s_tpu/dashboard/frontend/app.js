@@ -1,0 +1,135 @@
+/* Dashboard SPA (reference counterpart: dashboard/frontend/src/components/).
+ * Vanilla JS against the /tfjobs/api routes. */
+
+const api = (p) => fetch(`/tfjobs/api${p}`).then((r) => r.json());
+
+const TEMPLATE = {
+  apiVersion: "kubeflow.org/v1alpha2",
+  kind: "TFJob",
+  metadata: { name: "my-tpu-job", namespace: "default" },
+  spec: {
+    tpu: { acceleratorType: "v5litepod-16", topology: "4x4" },
+    tfReplicaSpecs: {
+      TPU: {
+        replicas: 4,
+        restartPolicy: "ExitCode",
+        template: {
+          spec: {
+            containers: [
+              {
+                name: "tensorflow",
+                image: "ghcr.io/k8s-tpu/jax-tpu:latest",
+                resources: { limits: { "cloud-tpus.google.com/v5e": 4 } },
+              },
+            ],
+          },
+        },
+      },
+    },
+  },
+};
+
+function jobState(job) {
+  const st = job.status || {};
+  if (st.phase) return st.phase; // v1alpha1
+  const conds = (st.conditions || []).filter((c) => c.status === "True");
+  return conds.length ? conds[conds.length - 1].type : "Pending";
+}
+
+function replicaSummary(job) {
+  const spec = job.spec || {};
+  if (spec.tfReplicaSpecs)
+    return Object.entries(spec.tfReplicaSpecs)
+      .map(([t, s]) => `${t}:${s.replicas ?? 1}`)
+      .join(" ");
+  if (spec.replicaSpecs)
+    return spec.replicaSpecs
+      .map((s) => `${s.tfReplicaType}:${s.replicas ?? 1}`)
+      .join(" ");
+  return "";
+}
+
+async function refresh() {
+  const data = await api("/tfjob");
+  const rows = (data.items || []).map((j) => {
+    const m = j.metadata || {};
+    const state = jobState(j);
+    return `<tr onclick="showDetail('${m.namespace}','${m.name}')">
+      <td>${m.name}</td><td>${m.namespace}</td>
+      <td>${replicaSummary(j)}</td>
+      <td><span class="state ${state}">${state}</span></td>
+      <td class="muted">${m.creationTimestamp || ""}</td>
+      <td><button class="danger" onclick="event.stopPropagation();deleteJob('${m.namespace}','${m.name}')">delete</button></td>
+    </tr>`;
+  });
+  document.getElementById("jobs").innerHTML =
+    rows.join("") || `<tr><td colspan="6" class="muted">no jobs</td></tr>`;
+}
+
+async function showDetail(ns, name) {
+  const data = await api(`/tfjob/${ns}/${name}`);
+  document.getElementById("d-name").textContent = `${ns}/${name}`;
+  document.getElementById("d-status").textContent = JSON.stringify(
+    (data.tfJob || {}).status || {}, null, 2);
+  document.getElementById("d-spec").textContent = JSON.stringify(
+    (data.tfJob || {}).spec || {}, null, 2);
+  document.getElementById("d-pods").innerHTML = (data.pods || [])
+    .map((p) => {
+      const phase = (p.status || {}).phase || "Pending";
+      return `<tr><td>${p.metadata.name}</td>
+        <td><span class="state ${phase}">${phase}</span></td>
+        <td><a onclick="showLogs('${ns}','${p.metadata.name}')">logs</a></td></tr>`;
+    })
+    .join("") || `<tr><td colspan="3" class="muted">no pods</td></tr>`;
+  document.getElementById("d-logs").style.display = "none";
+  show("detail");
+}
+
+async function showLogs(ns, pod) {
+  const data = await api(`/logs/${ns}/${pod}`);
+  const el = document.getElementById("d-logs");
+  el.textContent = data.logs || "(no logs)";
+  el.style.display = "block";
+}
+
+async function deleteJob(ns, name) {
+  await fetch(`/tfjobs/api/tfjob/${ns}/${name}`, { method: "DELETE" });
+  refresh();
+}
+
+function showCreate() {
+  document.getElementById("c-body").value = JSON.stringify(TEMPLATE, null, 2);
+  document.getElementById("c-msg").textContent = "";
+  show("create");
+}
+
+async function submitJob() {
+  let body;
+  try {
+    body = JSON.parse(document.getElementById("c-body").value);
+  } catch (e) {
+    document.getElementById("c-msg").textContent = `invalid JSON: ${e.message}`;
+    return;
+  }
+  const resp = await fetch("/tfjobs/api/tfjob", {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify(body),
+  });
+  if (resp.ok) { showList(); refresh(); }
+  else {
+    const err = await resp.json();
+    document.getElementById("c-msg").textContent = err.error || resp.statusText;
+  }
+}
+
+function show(id) {
+  for (const s of ["list", "detail", "create"])
+    document.getElementById(s).style.display = s === id ? "block" : "none";
+}
+function showList() { show("list"); refresh(); }
+
+showList();
+setInterval(() => {
+  if (document.getElementById("list").style.display !== "none") refresh();
+}, 5000);

@@ -1,0 +1,8 @@
+"""In-pod launcher runtime (replaces the reference's TF_CONFIG /
+tf.train.Server contract, SURVEY.md §3.3)."""
+
+from k8s_tpu.launcher.bootstrap import (  # noqa: F401
+    LauncherConfig,
+    initialize_distributed,
+    make_training_mesh,
+)

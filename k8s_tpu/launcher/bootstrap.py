@@ -1,0 +1,132 @@
+"""In-pod bootstrap: operator-injected env → jax.distributed → device mesh.
+
+The reference's in-pod runtime was: parse TF_CONFIG → tf.train.ClusterSpec →
+tf.train.Server(grpc) → PS blocks in server.join()
+(examples/tf_sample/tf_sample/tf_smoke.py:88-138).  The TPU-native contract
+(injected by k8s_tpu.controller_v2.tpu_config.gen_env_vars) is:
+
+    JAX_COORDINATOR_ADDRESS  host:port of process 0
+    JAX_NUM_PROCESSES        world size
+    JAX_PROCESS_ID           this pod's process id
+    TPU_ACCELERATOR_TYPE / TPU_TOPOLOGY        slice topology
+    MEGASCALE_NUM_SLICES / MEGASCALE_SLICE_ID  multi-slice (DCN)
+
+``initialize_distributed`` is idempotent and a no-op for single-process
+jobs.  ``make_training_mesh`` builds the global mesh after initialization —
+chief-exit semantics reduce to "process 0 returns / raises"
+(pkg/trainer/training.go:154-189 chief logic → process-0 exit propagation).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from k8s_tpu.parallel.mesh import MeshConfig, make_mesh
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+@dataclass
+class LauncherConfig:
+    coordinator_address: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+    accelerator_type: str = ""
+    topology: str = ""
+    num_slices: int = 1
+    slice_id: int = 0
+    checkpoint_dir: str = ""
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "LauncherConfig":
+        e = env if env is not None else os.environ
+        return cls(
+            coordinator_address=e.get("JAX_COORDINATOR_ADDRESS", ""),
+            num_processes=int(e.get("JAX_NUM_PROCESSES", "1") or 1),
+            process_id=int(e.get("JAX_PROCESS_ID", "0") or 0),
+            accelerator_type=e.get("TPU_ACCELERATOR_TYPE", ""),
+            topology=e.get("TPU_TOPOLOGY", ""),
+            num_slices=int(e.get("MEGASCALE_NUM_SLICES", "1") or 1),
+            slice_id=int(e.get("MEGASCALE_SLICE_ID", "0") or 0),
+            # Orbax-style checkpoint convention (SURVEY.md §5 Checkpoint/resume):
+            # stable across gang restarts because it is spec'd, not generated.
+            checkpoint_dir=e.get("CHECKPOINT_DIR", ""),
+        )
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_chief(self) -> bool:
+        """Chief ≡ process 0 (the v1 chief termination policy maps here)."""
+        return self.process_id == 0
+
+
+def initialize_distributed(config: Optional[LauncherConfig] = None) -> LauncherConfig:
+    """Idempotent jax.distributed bring-up from the operator env contract."""
+    global _initialized
+    cfg = config or LauncherConfig.from_env()
+    if not cfg.is_distributed:
+        log.info("single-process job; skipping jax.distributed")
+        return cfg
+    if _initialized:
+        return cfg
+    if not cfg.coordinator_address:
+        raise RuntimeError(
+            "JAX_NUM_PROCESSES > 1 but JAX_COORDINATOR_ADDRESS is not set - "
+            "was this pod created by the tpu-job operator?"
+        )
+    import jax
+
+    log.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
+        cfg.coordinator_address, cfg.num_processes, cfg.process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _initialized = True
+    return cfg
+
+
+def make_training_mesh(
+    tp: int = 1,
+    sp: int = 1,
+    fsdp: Optional[int] = None,
+    config: Optional[LauncherConfig] = None,
+):
+    """Build the global training mesh over all devices of the job.
+
+    Multi-slice layout: data-ish axes (dp/fsdp) span slices over DCN; tp/sp
+    stay within a slice on ICI (callers choose tp*sp <= devices-per-slice).
+    """
+    import jax
+
+    cfg = config or LauncherConfig.from_env()
+    mesh_cfg = MeshConfig.auto(len(jax.devices()), tp=tp, sp=sp, fsdp=fsdp)
+    mesh = make_mesh(mesh_cfg)
+    log.info("mesh: %s over %d devices", dict(mesh.shape), len(jax.devices()))
+    return mesh, cfg
+
+
+def barrier(name: str = "launcher") -> None:
+    """Cross-process sync point (used before checkpoint writes / teardown)."""
+    import jax
+
+    if jax.process_count() > 1:
+        # psum over a tiny array forces a global collective
+        import jax.numpy as jnp
+
+        jax.block_until_ready(
+            jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+                jnp.ones((jax.local_device_count(),))
+            )
+        )

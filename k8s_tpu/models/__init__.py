@@ -1,0 +1,3 @@
+"""Workload models (reference counterparts: examples/tf_sample tf_smoke,
+test/e2e/dist-mnist; plus the BASELINE.json configs: ResNet-50/ImageNet,
+BERT-base fine-tune, Llama-style FSDP)."""

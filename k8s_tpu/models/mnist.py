@@ -1,0 +1,37 @@
+"""MNIST conv net — the dist-mnist equivalent (reference:
+test/e2e/dist-mnist/dist_mnist.py, between-graph PS/worker training).
+
+The reference trained this over 2 PS + 4 workers with asynchronous gradient
+pushes; here it is a synchronous SPMD data-parallel step over the mesh — the
+"sync_replicas" mode (dist_mnist.py:70-74) made default, the PS deleted.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        # x: [B, 28, 28, 1]
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def synthetic_batch(key, batch_size: int = 64):
+    """Deterministic synthetic data for smoke/e2e runs without a dataset."""
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (batch_size, 28, 28, 1), jnp.float32)
+    y = jax.random.randint(ky, (batch_size,), 0, 10)
+    return x, y

@@ -1,0 +1,113 @@
+"""ResNet-50 in Flax — the flagship benchmark workload (BASELINE.md:
+"ResNet-50 images/sec/chip" on a v5e slice; manifest examples/tf_job_tpu.yaml).
+
+TPU-first choices:
+- bfloat16 activations/compute with float32 params and batch-norm statistics
+  (MXU-native mixed precision);
+- NHWC layout (XLA TPU's native conv layout);
+- no data-dependent control flow — the whole step jits to one XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=[(1, 1), (1, 1)], use_bias=False, name="conv2",
+        )(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        # zero-init the last BN scale: identity residual at init (standard
+        # ResNet-v1.5 trick, keeps early training stable at large batch)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, name="conv_proj",
+            )(residual)
+            residual = self.norm(name="bn_proj")(residual)
+
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 family; stage_sizes (3,4,6,3) is ResNet-50."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype, param_dtype=jnp.float32)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=None,
+        )
+
+        x = x.astype(self.dtype)
+        x = conv(
+            self.num_filters, (7, 7), strides=(2, 2),
+            padding=[(3, 3), (3, 3)], use_bias=False, name="conv_init",
+        )(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    name=f"stage{i}_block{j}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32, name="head"
+        )(x)
+        return x
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+
+
+def resnet18_thin(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    """Small variant for CPU tests."""
+    return ResNet(
+        stage_sizes=(1, 1), num_classes=num_classes, num_filters=8, dtype=dtype
+    )

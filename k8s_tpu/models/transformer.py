@@ -1,0 +1,202 @@
+"""Transformer family: one decoder/encoder implementation covering the
+BASELINE.json workload configs — BERT-base-style fine-tune (bidirectional)
+and Llama-style causal LM with FSDP/TP/SP shardings.
+
+TPU-first choices:
+- RMSNorm + SwiGLU + rotary embeddings (modern decoder recipe), all fusible
+  elementwise chains around the MXU matmuls;
+- bf16 activations, f32 params/softmax accumulation;
+- attention is pluggable: plain XLA attention for short context, ring
+  attention over the ``sp`` mesh axis for long context
+  (k8s_tpu.parallel.ring_attention);
+- logical sharding annotations (``nn.with_logical_partitioning`` style is
+  hand-rolled: params are plain, shardings applied by
+  k8s_tpu.parallel.sharding rules keyed on param-tree paths).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    ffn_hidden: int = 11008
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    causal: bool = True
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    use_ring_attention: bool = False
+    remat: bool = True  # jax.checkpoint each layer: HBM for FLOPs
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim or self.hidden // self.heads
+
+
+# Preset configs matching BASELINE.json workloads.
+def llama_8b() -> TransformerConfig:
+    """Llama-3-8B-shaped (stretch config, v5p-32 FSDP)."""
+    return TransformerConfig(
+        vocab_size=128256, hidden=4096, ffn_hidden=14336, layers=32,
+        heads=32, kv_heads=8, max_seq_len=8192, rope_theta=500000.0,
+    )
+
+
+def bert_base() -> TransformerConfig:
+    """BERT-base-shaped bidirectional encoder (fine-tune config)."""
+    return TransformerConfig(
+        vocab_size=30522, hidden=768, ffn_hidden=3072, layers=12,
+        heads=12, kv_heads=12, max_seq_len=512, causal=False,
+    )
+
+
+def tiny_test() -> TransformerConfig:
+    """CPU-testable config."""
+    return TransformerConfig(
+        vocab_size=256, hidden=64, ffn_hidden=128, layers=2, heads=4,
+        kv_heads=4, max_seq_len=128, dtype=jnp.float32, remat=False,
+    )
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale
+
+
+def rotary_embedding(x, positions, theta: float):
+    """Apply RoPE to [B, L, H, D] given [B, L] positions."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _plain_attention(q, k, v, causal: bool):
+    """XLA attention with f32 softmax; fused by the compiler on TPU."""
+    B, L, H, D = q.shape
+    kv_heads = k.shape[2]
+    if kv_heads != H:  # grouped-query: repeat kv heads
+        rep = H // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mesh=None):
+        cfg = self.config
+        D = cfg.dims_per_head
+        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name,
+        )
+        q = dense((cfg.heads, D), "q_proj")(x)
+        k = dense((cfg.kv_heads, D), "k_proj")(x)
+        v = dense((cfg.kv_heads, D), "v_proj")(x)
+        q = rotary_embedding(q, positions, cfg.rope_theta)
+        k = rotary_embedding(k, positions, cfg.rope_theta)
+
+        if cfg.use_ring_attention and mesh is not None:
+            from k8s_tpu.parallel.ring_attention import ring_attention
+
+            kv_heads = k.shape[2]
+            if kv_heads != cfg.heads:
+                rep = cfg.heads // kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = ring_attention(mesh, q, k, v, causal=cfg.causal)
+        else:
+            out = _plain_attention(q, k, v, cfg.causal)
+
+        return nn.DenseGeneral(
+            x.shape[-1], axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="o_proj",
+        )(out)
+
+
+class MLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
+        )
+        gate = dense(cfg.ffn_hidden, "gate_proj")(x)
+        up = dense(cfg.ffn_hidden, "up_proj")(x)
+        return dense(x.shape[-1], "down_proj")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mesh=None):
+        y = Attention(self.config, name="attn")(
+            RMSNorm(name="attn_norm")(x), positions, mesh
+        )
+        x = x + y
+        y = MLP(self.config, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        return x + y
+
+
+class Transformer(nn.Module):
+    """Token-in, logits-out decoder (or encoder when config.causal=False)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, mesh=None):
+        cfg = self.config
+        B, L = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(L), (B, L))
+        emb = self.param(
+            "embedding",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.hidden),
+            jnp.float32,
+        )
+        x = emb[tokens].astype(cfg.dtype)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.layers):
+            x = block(cfg, name=f"layer_{i}")(x, positions, mesh)
+
+        x = RMSNorm(name="final_norm")(x)
+        # tied embeddings: logits = x @ emb.T, f32 for a stable softmax
+        logits = jnp.einsum(
+            "bld,vd->blv", x.astype(jnp.float32), emb.astype(jnp.float32)
+        )
+        return logits

@@ -1,0 +1,10 @@
+"""Parallelism layer: mesh axes, sharding rules, collectives, ring attention.
+
+The reference's only parallelism axes were PS-vs-worker data parallelism over
+gRPC (SURVEY.md §2.4).  Here the axes are a first-class design: a
+``jax.sharding.Mesh`` with named axes (dp/fsdp/tp/sp) over which pjit/XLA
+insert ICI/DCN collectives, plus shard_map-level sequence parallelism (ring
+attention) for long context.
+"""
+
+from k8s_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401

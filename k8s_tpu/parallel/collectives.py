@@ -1,0 +1,73 @@
+"""Collective helpers over mesh axes (the XLA-collectives replacement for the
+reference's TF gRPC sessions, SURVEY.md §5 "Distributed communication
+backend").
+
+Thin, named wrappers so model code reads as topology ("ring shift over sp")
+rather than raw lax calls; all usable under ``shard_map``/``pjit``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_dim: int = 0):
+    return lax.all_gather(x, axis_name=axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_dim: int = 0):
+    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def ring_shift(x, axis: str, *, reverse: bool = False):
+    """Send our shard to the next rank on the ring (ppermute); the backbone
+    of ring attention and bidirectional pipelining over ICI."""
+    n = lax.axis_size(axis)
+    if reverse:
+        perm = [(i, (i - 1) % n) for i in range(n)]
+    else:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def global_mean_over(axes: tuple[str, ...]):
+    """Gradient reduction across every data-ish axis: psum-normalized mean."""
+
+    def reduce_fn(tree):
+        def one(x):
+            for a in axes:
+                x = lax.pmean(x, axis_name=a)
+            return x
+
+        return jax.tree.map(one, tree)
+
+    return reduce_fn
+
+
+def host_local_array_to_global(mesh, arrays, pspec):
+    """Multi-host input plumbing: assemble per-host shards into a global
+    jax.Array (the jax.make_array_from_process_local_data path)."""
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, pspec)
+    return jax.make_array_from_process_local_data(sharding, arrays)

@@ -1,0 +1,105 @@
+"""Device-mesh construction (the TPU-native replacement for the reference's
+TF cluster-spec: SURVEY.md §2.4 "Cluster membership / rendezvous").
+
+A ``MeshConfig`` names the standard axes:
+
+- ``dp``   — pure data parallelism (params replicated)
+- ``fsdp`` — data parallelism with sharded params/optimizer state
+- ``tp``   — tensor (model) parallelism, innermost so its collectives ride
+             the fastest ICI links
+- ``sp``   — sequence/context parallelism for ring attention
+
+Axis sizes of 1 are always present so sharding specs can mention every axis
+unconditionally.  ``make_mesh`` lays devices out with dp outermost and tp
+innermost, the layout that keeps tensor-parallel collectives on neighbor
+chips (scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+
+
+@dataclass
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.sp * self.tp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+
+    @classmethod
+    def auto(
+        cls,
+        num_devices: Optional[int] = None,
+        tp: int = 1,
+        sp: int = 1,
+        fsdp: Optional[int] = None,
+    ) -> "MeshConfig":
+        """Fill the data axes from the device count: fixed tp/sp, remaining
+        devices go to fsdp (default) with dp=1 — the fsdp-first default that
+        suits most training jobs."""
+        n = num_devices if num_devices is not None else len(jax.devices())
+        if n % (tp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+        rest = n // (tp * sp)
+        if fsdp is None:
+            fsdp = rest
+        if rest % fsdp != 0:
+            raise ValueError(f"{rest} non-tp/sp devices not divisible by fsdp={fsdp}")
+        return cls(dp=rest // fsdp, fsdp=fsdp, sp=sp, tp=tp)
+
+
+def make_mesh(config: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != config.num_devices:
+        raise ValueError(
+            f"mesh needs {config.num_devices} devices (dp×fsdp×sp×tp), got {len(devices)}"
+        )
+    arr = np.array(devices).reshape(
+        [config.axis_sizes()[a] for a in AXIS_ORDER]
+    )
+    return Mesh(arr, AXIS_ORDER)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dimension sharded over every data-ish axis (dp, fsdp, sp)."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def batch_spec() -> P:
+    """PartitionSpec for [batch, ...] activations: batch over dp+fsdp."""
+    return P(("dp", "fsdp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """Parse a Cloud TPU topology string like '4x4' or '2x2x4'."""
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"bad topology string {topology!r}") from None
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"bad topology string {topology!r}")
+    return dims
+
+
+def chips_in_topology(topology: str) -> int:
+    return math.prod(parse_topology(topology))

@@ -1,0 +1,145 @@
+"""Ring attention: exact attention over sequence shards with ppermute
+(sequence/context parallelism for long context — absent from the reference,
+designed in per SURVEY.md §5 "Long-context / sequence parallelism").
+
+Each device on the ``sp`` ring holds one sequence chunk of Q, K, V.  K/V
+blocks rotate around the ring while every device accumulates its Q-chunk's
+attention with an online (streaming) softmax, so the full O(L²) score matrix
+never materializes and memory stays O(L·L/sp).  Communication is ``sp``
+ppermute steps that overlap with the per-block matmuls on ICI.
+
+Causal masking uses global chunk positions: on step ``s`` a device that owns
+Q-chunk ``i`` is processing K-chunk ``(i - s) mod sp`` and masks accordingly
+(full-block skip for future chunks, triangular mask on the diagonal block).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, scale):
+    """Scores + masked online-softmax pieces for one (Q-chunk, K-chunk) pair.
+
+    q: [B, Lq, H, D], k/v: [B, Lk, H, D], mask: [Lq, Lk] bool or None.
+    Returns (numerator [B, Lq, H, D] f32, row_max [B, Lq, H] f32,
+             row_sum [B, Lq, H] f32).
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [B, H, Lq]
+    # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1.
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(scores - safe_m[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None, :, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B, H, Lq]
+    num = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    # transpose stats to [B, Lq, H]
+    return num, safe_m.transpose(0, 2, 1), l.transpose(0, 2, 1), m.transpose(0, 2, 1)
+
+
+def _combine(acc, m_acc, l_acc, num, m_blk, l_blk, m_raw):
+    """Merge one block's numerator/stats into the running accumulator.
+
+    ``m_blk`` is the (masked-row-safe) max the block's numerator was computed
+    against; ``m_raw`` the true row max (NEG_INF for fully-masked rows).
+    Fully-masked contributions get weight 0 on either side.
+    """
+    new_m = jnp.maximum(m_acc, m_raw)
+    safe_new_m = jnp.where(new_m <= NEG_INF / 2, 0.0, new_m)
+    alpha = jnp.where(m_acc <= NEG_INF / 2, 0.0, jnp.exp(m_acc - safe_new_m))
+    beta = jnp.where(m_raw <= NEG_INF / 2, 0.0, jnp.exp(m_blk - safe_new_m))
+    acc = acc * alpha[..., None] + num * beta[..., None]
+    l_acc = l_acc * alpha + l_blk * beta
+    return acc, new_m, l_acc
+
+
+def ring_attention_local(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+                         scale: float | None = None):
+    """Per-shard ring attention body; call under shard_map with Q/K/V
+    sequence-sharded over ``axis_name``.
+
+    q, k, v: [B, chunk, H, D] local shards.  Returns [B, chunk, H, D] in
+    q.dtype.
+    """
+    B, Lq, H, D = q.shape
+    sp = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = D ** -0.5
+
+    q32 = q
+    acc0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    m0 = jnp.full((B, Lq, H), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Lq, H), jnp.float32)
+
+    pos_q = jnp.arange(Lq)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(s, carry):
+        acc, m_acc, l_acc, k_cur, v_cur = carry
+        k_chunk_idx = (my_idx - s) % sp
+
+        if causal:
+            # future chunk → fully masked; diagonal → triangular; past → full
+            q_global = my_idx * Lq + pos_q[:, None]
+            k_global = k_chunk_idx * Lq + pos_q[None, :]
+            mask = q_global >= k_global
+        else:
+            mask = None
+
+        num, m_blk, l_blk, m_raw = _block_attn(q32, k_cur, v_cur, mask, scale)
+        acc, m_acc, l_acc = _combine(acc, m_acc, l_acc, num, m_blk, l_blk, m_raw)
+
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return acc, m_acc, l_acc, k_nxt, v_nxt
+
+    acc, m_acc, l_acc, _, _ = lax.fori_loop(0, sp, step, (acc0, m0, l0, k, v))
+    out = acc / jnp.maximum(l_acc, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
+                   seq_axis: str = "sp", batch_axes=("dp", "fsdp"),
+                   head_axis: str = "tp"):
+    """Global entry: shard_map ring attention over the mesh.
+
+    q, k, v: [B, L, H, D] global arrays (or shaped trees thereof); batch is
+    sharded over dp/fsdp, sequence over sp, heads over tp.
+    """
+    spec = P(batch_axes, seq_axis, head_axis, None)
+
+    fn = shard_map(
+        partial(ring_attention_local, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal: bool = True):
+    """O(L²) reference for tests: plain softmax attention, f32 accumulation."""
+    B, L, H, D = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,106 @@
+"""Sharding rules: logical parameter axes → mesh axes.
+
+Instead of translating the reference's parameter-server placement
+(variables pinned to PS replicas, pkg/trainer-era world), parameters carry
+*logical axis names* and a rule table maps them onto mesh axes — the
+pjit/GSPMD recipe: annotate, let XLA insert collectives.
+
+Conventions (transformer):
+- ``embed``  — the model/hidden dimension: sharded over ``tp`` for the
+  embedding table's vocab side stays replicated
+- ``mlp``    — the ffn hidden dimension: ``tp``
+- ``heads``  — attention heads: ``tp``
+- ``vocab``  — vocabulary: ``tp``
+- any first surviving non-tp axis additionally shards over ``fsdp`` (ZeRO-3
+  style parameter sharding)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> preferred mesh axis
+DEFAULT_RULES: dict[str, Optional[str]] = {
+    "batch": "dp",
+    "seq": "sp",
+    "embed": None,      # hidden dim stays unsharded in params (activations tp-shard it)
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": None,
+    "vocab": "tp",
+    "conv_out": "tp",
+}
+
+
+def logical_to_spec(
+    logical_axes: tuple[str | None, ...],
+    rules: dict[str, Optional[str]] | None = None,
+    fsdp_axis: str = "fsdp",
+) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    After applying the rule table, the largest still-unsharded dimension is
+    sharded over ``fsdp`` (parameter sharding a la ZeRO-3 / FSDP).
+    """
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    spec: list = [rules.get(a) if a else None for a in logical_axes]
+    if fsdp_axis and fsdp_axis not in spec:
+        for i, (axis, assigned) in enumerate(zip(logical_axes, spec)):
+            if assigned is None and axis is not None:
+                spec[i] = fsdp_axis
+                break
+    return P(*spec)
+
+
+def shard_params(
+    params: Any, logical_axes: Any, mesh: Mesh, rules=None
+) -> Any:
+    """Apply NamedShardings to a parameter pytree given a matching pytree of
+    logical-axis tuples."""
+    def to_sharding(axes):
+        return NamedSharding(mesh, logical_to_spec(axes, rules))
+
+    shardings = jax.tree.map(
+        to_sharding, logical_axes, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    return jax.device_put(params, shardings)
+
+
+def infer_logical_axes(params: Any) -> Any:
+    """Size-heuristic fallback for models without explicit annotations:
+    2D+ weights FSDP-shard their largest dim; 1D (bias/scale) replicate."""
+    def leaf_axes(x) -> tuple:
+        shape = getattr(x, "shape", ())
+        if len(shape) < 2:
+            return (None,) * len(shape)
+        largest = int(np.argmax(shape))
+        return tuple("fsdp_dim" if i == largest else None for i in range(len(shape)))
+
+    return jax.tree.map(leaf_axes, params)
+
+
+def fsdp_sharding(params: Any, mesh: Mesh) -> Any:
+    """NamedShardings that FSDP-shard every ≥2D weight's largest divisible
+    dimension over the fsdp axis, replicating the rest."""
+    fsdp_size = mesh.shape["fsdp"]
+
+    def to_sharding(x):
+        shape = getattr(x, "shape", ())
+        if len(shape) >= 2:
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in order:
+                if shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size:
+                    spec = [None] * len(shape)
+                    spec[i] = "fsdp"
+                    return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(to_sharding, params)
+
+
+def apply_shardings(tree: Any, shardings: Any) -> Any:
+    return jax.device_put(tree, shardings)

@@ -1,0 +1,150 @@
+"""Leader election (reference: cmd/tf-operator/app/server.go:109-132, using
+an Endpoints resource lock with lease 15s / renew 5s / retry 3s —
+server.go:49-52).
+
+The lock record is an annotation on an Endpoints object, exactly like
+client-go's EndpointsLock: ``{holderIdentity, leaseDurationSeconds,
+acquireTime, renewTime}``.  ``run_or_die`` blocks in the acquire loop, runs
+``on_started_leading`` while renewing in the background, and calls
+``on_stopped_leading`` if the lease is lost.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from k8s_tpu.client import errors
+from k8s_tpu.client.clientset import Clientset
+
+log = logging.getLogger(__name__)
+
+LOCK_ANNOTATION = "control-plane.alpha.kubernetes.io/leader"
+
+# server.go:49-52
+DEFAULT_LEASE_DURATION = 15.0
+DEFAULT_RENEW_DEADLINE = 5.0
+DEFAULT_RETRY_PERIOD = 3.0
+
+
+@dataclass
+class LeaderElectionConfig:
+    namespace: str
+    name: str
+    identity: str
+    lease_duration: float = DEFAULT_LEASE_DURATION
+    renew_deadline: float = DEFAULT_RENEW_DEADLINE
+    retry_period: float = DEFAULT_RETRY_PERIOD
+
+
+class LeaderElector:
+    def __init__(self, clientset: Clientset, config: LeaderElectionConfig):
+        self.clientset = clientset
+        self.config = config
+        self._stop = threading.Event()
+
+    def _read_record(self) -> tuple[Optional[dict], Optional[dict]]:
+        try:
+            obj = self.clientset.endpoints(self.config.namespace).get(self.config.name)
+        except errors.ApiError as e:
+            if errors.is_not_found(e):
+                return None, None
+            raise
+        raw = (obj.get("metadata", {}).get("annotations") or {}).get(LOCK_ANNOTATION)
+        return obj, json.loads(raw) if raw else None
+
+    def _write_record(self, obj: Optional[dict], record: dict) -> bool:
+        ann = {LOCK_ANNOTATION: json.dumps(record, sort_keys=True)}
+        try:
+            if obj is None:
+                self.clientset.endpoints(self.config.namespace).create(
+                    {
+                        "metadata": {
+                            "name": self.config.name,
+                            "namespace": self.config.namespace,
+                            "annotations": ann,
+                        }
+                    }
+                )
+            else:
+                obj.setdefault("metadata", {}).setdefault("annotations", {}).update(ann)
+                self.clientset.endpoints(self.config.namespace).update(obj)
+            return True
+        except errors.ApiError as e:
+            log.info("lock write failed: %s", e)
+            return False
+
+    def try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        obj, record = self._read_record()
+        if record is not None and record.get("holderIdentity") != self.config.identity:
+            renew = float(record.get("renewTime", 0))
+            if now - renew < float(record.get("leaseDurationSeconds", 15)):
+                return False  # someone else holds a live lease
+        new_record = {
+            "holderIdentity": self.config.identity,
+            "leaseDurationSeconds": self.config.lease_duration,
+            "acquireTime": (
+                record.get("acquireTime", now)
+                if record and record.get("holderIdentity") == self.config.identity
+                else now
+            ),
+            "renewTime": now,
+        }
+        return self._write_record(obj, new_record)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_or_die(
+        self,
+        on_started_leading: Callable[[threading.Event], None],
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ) -> None:
+        """Block until leadership, run the callback, renew until lost/stopped."""
+        while not self._stop.is_set():
+            if self.try_acquire_or_renew():
+                break
+            log.info("waiting to acquire leadership...")
+            self._stop.wait(self.config.retry_period)
+        if self._stop.is_set():
+            return
+        log.info("acquired leadership: %s", self.config.identity)
+
+        lost = threading.Event()
+
+        def renew_loop():
+            while not self._stop.is_set() and not lost.is_set():
+                deadline = time.time() + self.config.renew_deadline
+                ok = False
+                while time.time() < deadline:
+                    if self.try_acquire_or_renew():
+                        ok = True
+                        break
+                    time.sleep(0.2)
+                if not ok:
+                    log.error("failed to renew lease; stepping down")
+                    lost.set()
+                    return
+                self._stop.wait(self.config.retry_period)
+
+        renewer = threading.Thread(target=renew_loop, daemon=True, name="lease-renew")
+        renewer.start()
+        try:
+            # The workload observes `lost` (or process stop) via this event.
+            stop_work = threading.Event()
+
+            def watchdog():
+                while not self._stop.is_set() and not lost.is_set():
+                    time.sleep(0.2)
+                stop_work.set()
+
+            threading.Thread(target=watchdog, daemon=True, name="lease-watchdog").start()
+            on_started_leading(stop_work)
+        finally:
+            if lost.is_set() and on_stopped_leading is not None:
+                on_stopped_leading()

@@ -1,17 +1,27 @@
 """Test configuration.
 
 JAX-dependent tests run on a virtual 8-device CPU mesh so multi-chip sharding
-is exercised without TPU hardware (SURVEY.md §4: the reference tests multi-node
-with fakes; our "fake TPU topology" is XLA's host-platform device count).
-Env must be set before the first ``import jax`` anywhere in the test process.
+is exercised without TPU hardware (SURVEY.md §4: the reference tests
+multi-node with fakes; our "fake TPU topology" is XLA's host-platform device
+count).
+
+This image boots an `axon` TPU platform plugin from sitecustomize (which
+imports jax and pins jax_platforms before any conftest runs), so a plain
+JAX_PLATFORMS env var is not enough: the platform must be forced back to cpu
+via jax.config after import.  XLA_FLAGS still applies because CPU backend
+initialization is lazy (no jax.devices() has run yet).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

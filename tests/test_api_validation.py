@@ -10,8 +10,10 @@ from k8s_tpu.api.validation import (
 )
 
 
-def _template(name="tensorflow", tpu_limit=None):
+def _template(name="tensorflow", tpu_limit=None, ports=True):
     c = {"name": name, "image": "img"}
+    if ports:
+        c["ports"] = [{"name": "tfjob-port", "containerPort": 2222}]
     if tpu_limit:
         c["resources"] = {"limits": {tpu_limit: 4}}
     return {"spec": {"containers": [c]}}
@@ -127,4 +129,13 @@ class TestV1Alpha2Validation:
         spec.tf_replica_specs["TPU"].template = _template(
             tpu_limit="cloud-tpus.google.com/v5e"
         )
+        validate_v1alpha2_tfjob_spec(spec)
+
+
+def test_v1alpha2_missing_port_rejected():
+    """Un-defaulted spec without tfjob-port fails terminally, not at env-gen."""
+    spec = v1alpha2.TFJobSpec(
+        tf_replica_specs={"Worker": v1alpha2.TFReplicaSpec(template=_template(ports=False))}
+    )
+    with pytest.raises(ValidationError, match="tfjob-port"):
         validate_v1alpha2_tfjob_spec(spec)

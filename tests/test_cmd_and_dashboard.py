@@ -1,0 +1,175 @@
+"""Operator binaries, leader election, and dashboard API tests."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_tpu.client import Clientset, FakeCluster
+from k8s_tpu.dashboard.backend import DashboardServer
+from k8s_tpu.util.leader_election import LeaderElectionConfig, LeaderElector
+
+
+class TestLeaderElection:
+    def test_single_candidate_acquires(self):
+        cs = Clientset(FakeCluster())
+        elector = LeaderElector(
+            cs, LeaderElectionConfig(namespace="kube-system", name="tf-operator",
+                                     identity="a")
+        )
+        assert elector.try_acquire_or_renew() is True
+        record = json.loads(
+            cs.endpoints("kube-system").get("tf-operator")["metadata"]["annotations"][
+                "control-plane.alpha.kubernetes.io/leader"
+            ]
+        )
+        assert record["holderIdentity"] == "a"
+
+    def test_second_candidate_blocked_while_lease_live(self):
+        cs = Clientset(FakeCluster())
+        config = dict(namespace="kube-system", name="tf-operator")
+        a = LeaderElector(cs, LeaderElectionConfig(identity="a", **config))
+        b = LeaderElector(cs, LeaderElectionConfig(identity="b", **config))
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+
+    def test_expired_lease_taken_over(self):
+        cs = Clientset(FakeCluster())
+        config = dict(namespace="kube-system", name="tf-operator")
+        a = LeaderElector(
+            cs, LeaderElectionConfig(identity="a", lease_duration=0.1, **config)
+        )
+        b = LeaderElector(cs, LeaderElectionConfig(identity="b", **config))
+        assert a.try_acquire_or_renew()
+        time.sleep(0.15)
+        assert b.try_acquire_or_renew()
+
+    def test_run_or_die_runs_callback(self):
+        cs = Clientset(FakeCluster())
+        elector = LeaderElector(
+            cs, LeaderElectionConfig(namespace="ns", name="op", identity="x",
+                                     retry_period=0.05)
+        )
+        ran = threading.Event()
+
+        def workload(stop_work):
+            ran.set()
+
+        t = threading.Thread(target=elector.run_or_die, args=(workload,), daemon=True)
+        t.start()
+        assert ran.wait(5)
+        elector.stop()
+        t.join(timeout=5)
+
+
+class TestOperatorBinaries:
+    def test_v1_parser_flags(self):
+        from k8s_tpu.cmd.operator import build_parser
+
+        opts = build_parser().parse_args(
+            ["--enable-gang-scheduling", "--chaos-level", "2", "--json-log-format"]
+        )
+        assert opts.enable_gang_scheduling and opts.chaos_level == 2
+
+    def test_v2_parser_defaults(self):
+        from k8s_tpu.cmd.operator_v2 import build_parser
+
+        opts = build_parser().parse_args([])
+        assert opts.threadiness == 2  # options.go:42
+        assert opts.enable_gang_scheduling
+
+    def test_controller_config_yaml(self, tmp_path):
+        from k8s_tpu.cmd.operator import read_controller_config
+
+        p = tmp_path / "config.yaml"
+        p.write_text(
+            """
+accelerators:
+  nvidia.com/gpu:
+    volumes:
+      - name: cuda-lib
+        hostPath: /home/cuda
+        mountPath: /usr/local/cuda
+    envVars:
+      - name: LD_LIBRARY_PATH
+        value: /usr/local/cuda/lib64
+"""
+        )
+        config = read_controller_config(str(p))
+        acc = config.accelerators["nvidia.com/gpu"]
+        assert acc.volumes[0].host_path == "/home/cuda"
+        assert acc.env_vars[0].name == "LD_LIBRARY_PATH"
+
+
+@pytest.fixture()
+def dashboard():
+    fc = FakeCluster()
+    cs = Clientset(fc)
+    server = DashboardServer(cs, host="127.0.0.1", port=0)
+    server.start_background()
+    yield cs, f"http://127.0.0.1:{server.port}", fc
+    server.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read())
+
+
+class TestDashboard:
+    def test_create_list_get_delete_job(self, dashboard):
+        cs, base, fc = dashboard
+        job = {
+            "apiVersion": "kubeflow.org/v1alpha2",
+            "kind": "TFJob",
+            "metadata": {"name": "dash-job", "namespace": "team-a"},
+            "spec": {"tfReplicaSpecs": {"Worker": {"replicas": 1}}},
+        }
+        req = urllib.request.Request(
+            f"{base}/tfjobs/api/tfjob",
+            data=json.dumps(job).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 201
+        # namespace auto-created on deploy (api_handler.go behavior)
+        assert any(
+            n["metadata"]["name"] == "team-a" for n in cs.namespaces().list()
+        )
+        listing = _get(f"{base}/tfjobs/api/tfjob/team-a")
+        assert len(listing["items"]) == 1
+        detail = _get(f"{base}/tfjobs/api/tfjob/team-a/dash-job")
+        assert detail["tfJob"]["metadata"]["name"] == "dash-job"
+
+        del_req = urllib.request.Request(
+            f"{base}/tfjobs/api/tfjob/team-a/dash-job", method="DELETE"
+        )
+        with urllib.request.urlopen(del_req, timeout=5) as r:
+            assert r.status == 200
+        assert _get(f"{base}/tfjobs/api/tfjob/team-a")["items"] == []
+
+    def test_pod_logs_route(self, dashboard):
+        cs, base, fc = dashboard
+        cs.pods("default").create(
+            {"metadata": {"name": "p1", "namespace": "default"},
+             "status": {"log": "hello from training"}}
+        )
+        data = _get(f"{base}/tfjobs/api/logs/default/p1")
+        assert data["logs"] == "hello from training"
+
+    def test_ui_served(self, dashboard):
+        _, base, _ = dashboard
+        with urllib.request.urlopen(f"{base}/tfjobs/ui/", timeout=5) as r:
+            body = r.read().decode()
+        assert "TPU Job Operator" in body
+        with urllib.request.urlopen(f"{base}/tfjobs/ui/app.js", timeout=5) as r:
+            assert "tfjobs/api" in r.read().decode()
+
+    def test_unknown_route_404(self, dashboard):
+        _, base, _ = dashboard
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/tfjobs/api/nope", timeout=5)
+        assert e.value.code == 404

@@ -1,0 +1,134 @@
+"""Model + sharded-training tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_tpu.models import train
+from k8s_tpu.models.mnist import MnistCNN, synthetic_batch
+from k8s_tpu.models.resnet import resnet18_thin, resnet50
+from k8s_tpu.models.transformer import Transformer, tiny_test, bert_base, llama_8b
+from k8s_tpu.parallel import MeshConfig, make_mesh
+
+
+class TestResNet:
+    def test_resnet50_param_count(self):
+        model = resnet50(dtype=jnp.float32)
+        params = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), jnp.ones((1, 224, 224, 3)), train=False)
+        )
+        n = sum(np.prod(x.shape) for x in jax.tree.leaves(params["params"]))
+        # ResNet-50 ~25.5M params
+        assert 25e6 < n < 26e6, n
+
+    def test_thin_resnet_forward(self):
+        model = resnet18_thin()
+        variables = model.init(jax.random.PRNGKey(0), jnp.ones((2, 32, 32, 3)), train=False)
+        logits = model.apply(variables, jnp.ones((2, 32, 32, 3)), train=False)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+
+
+class TestTransformer:
+    def test_forward_shapes(self):
+        cfg = tiny_test()
+        model = Transformer(cfg)
+        tokens = jnp.ones((2, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+
+    def test_causal_masking(self):
+        """Changing a future token must not affect earlier logits."""
+        cfg = tiny_test()
+        model = Transformer(cfg)
+        t1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        t2 = t1.at[0, -1].set(9)
+        params = model.init(jax.random.PRNGKey(0), t1)
+        l1 = model.apply(params, t1)
+        l2 = model.apply(params, t2)
+        np.testing.assert_allclose(
+            np.asarray(l1[0, :-1]), np.asarray(l2[0, :-1]), atol=1e-5
+        )
+
+    def test_preset_configs(self):
+        assert llama_8b().kv_heads == 8
+        assert bert_base().causal is False
+
+    def test_ring_attention_variant_matches_plain(self):
+        mesh = make_mesh(MeshConfig(sp=8))
+        cfg_plain = tiny_test()
+        cfg_ring = jax.tree_util.tree_structure  # placeholder to keep names local
+        import dataclasses
+
+        cfg_ring = dataclasses.replace(cfg_plain, use_ring_attention=True)
+        tokens = jnp.arange(32, dtype=jnp.int32).reshape(1, 32) % cfg_plain.vocab_size
+        model_plain = Transformer(cfg_plain)
+        params = model_plain.init(jax.random.PRNGKey(0), tokens)
+        l_plain = model_plain.apply(params, tokens)
+        l_ring = Transformer(cfg_ring).apply(params, tokens, mesh=mesh)
+        np.testing.assert_allclose(
+            np.asarray(l_plain), np.asarray(l_ring), atol=3e-4
+        )
+
+
+class TestTraining:
+    def test_mnist_loss_decreases_sharded(self):
+        """Synchronous SPMD data-parallel training on the 8-device mesh
+        (the dist-mnist replacement: SURVEY.md §2.4)."""
+        mesh = make_mesh(MeshConfig(dp=8))
+        model = MnistCNN()
+        x, y = synthetic_batch(jax.random.PRNGKey(0), 64)
+        params = model.init(jax.random.PRNGKey(1), x[:1])
+        optimizer = train.default_optimizer(1e-3)
+        state = train.init_state(params, optimizer)
+        state, shardings = train.shard_train_state(state, mesh)
+        step = train.make_sharded_train_step(
+            lambda p, inp: model.apply(p, inp),
+            train.cross_entropy_loss,
+            optimizer,
+            mesh,
+            shardings,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_sh = NamedSharding(mesh, P(("dp", "fsdp")))
+        x = jax.device_put(x, data_sh)
+        y = jax.device_put(y, data_sh)
+        losses = []
+        for _ in range(6):
+            state, loss = step(state, (x, y))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_transformer_fsdp_train_step(self):
+        """FSDP-sharded LM step: params sharded over fsdp, loss finite and
+        decreasing (the Llama-8B-config path at test scale)."""
+        mesh = make_mesh(MeshConfig(fsdp=4, tp=2))
+        cfg = tiny_test()
+        model = Transformer(cfg)
+        tokens = (jnp.arange(8 * 32, dtype=jnp.int32).reshape(8, 32) * 7) % cfg.vocab_size
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        optimizer = train.default_optimizer(1e-2)
+        state = train.init_state(params, optimizer)
+        state, shardings = train.shard_train_state(state, mesh)
+        step = train.make_sharded_train_step(
+            lambda p, t: model.apply(p, t),
+            train.lm_loss,
+            optimizer,
+            mesh,
+            shardings,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tokens = jax.device_put(tokens, NamedSharding(mesh, P(("dp", "fsdp"))))
+        losses = []
+        for _ in range(4):
+            state, loss = step(state, (tokens, tokens))
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+        # params really are distributed
+        emb = state["params"]["params"]["embedding"]
+        assert not emb.sharding.is_fully_replicated

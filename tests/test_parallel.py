@@ -1,0 +1,150 @@
+"""Parallel layer tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from k8s_tpu.parallel import MeshConfig, make_mesh
+from k8s_tpu.parallel import collectives, sharding
+from k8s_tpu.parallel.mesh import chips_in_topology, parse_topology
+from k8s_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+)
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+class TestMesh:
+    def test_auto_config(self):
+        cfg = MeshConfig.auto(8, tp=2)
+        assert cfg.num_devices == 8
+        assert cfg.tp == 2 and cfg.fsdp == 4 and cfg.dp == 1
+
+    def test_auto_rejects_indivisible(self):
+        with pytest.raises(ValueError):
+            MeshConfig.auto(8, tp=3)
+
+    def test_make_mesh_axes(self):
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2))
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+
+    def test_topology_parsing(self):
+        assert parse_topology("4x4") == (4, 4)
+        assert chips_in_topology("2x2x4") == 16
+        with pytest.raises(ValueError):
+            parse_topology("4xx")
+
+
+class TestSharding:
+    def test_logical_to_spec_tp_and_fsdp(self):
+        spec = sharding.logical_to_spec(("mlp", "embed"))
+        # mlp -> tp; embed (unassigned) picks up fsdp
+        assert spec == P("tp", "fsdp")
+
+    def test_bias_replicated(self):
+        spec = sharding.logical_to_spec((None,))
+        assert spec == P(None)
+
+    def test_fsdp_sharding_tree(self):
+        mesh = make_mesh(MeshConfig(fsdp=8))
+        params = {
+            "w": jnp.zeros((16, 64)),
+            "b": jnp.zeros((64,)),
+            "odd": jnp.zeros((3, 5)),  # not divisible by 8 -> replicated
+        }
+        shardings = sharding.fsdp_sharding(params, mesh)
+        assert shardings["w"].spec == P(None, "fsdp")
+        assert shardings["b"].spec == P()
+        assert shardings["odd"].spec == P()
+        sharded = sharding.apply_shardings(params, shardings)
+        assert sharded["w"].sharding.spec == P(None, "fsdp")
+
+
+class TestCollectives:
+    def test_psum_and_ring_shift_under_shard_map(self):
+        from functools import partial
+
+        from jax import shard_map
+
+        mesh = make_mesh(MeshConfig(sp=8))
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=P("sp"),
+            out_specs=(P("sp"), P("sp")),
+            check_vma=False,
+        )
+        def f(x):
+            total = collectives.psum(jnp.sum(x), "sp")
+            shifted = collectives.ring_shift(x, "sp")
+            return jnp.broadcast_to(total, x.shape), shifted
+
+        x = jnp.arange(8.0)
+        total, shifted = f(x)
+        assert np.allclose(total, 28.0)
+        assert np.allclose(shifted, np.roll(np.arange(8.0), 1))
+
+    def test_reduce_scatter_matches_psum(self):
+        from functools import partial
+
+        from jax import shard_map
+
+        mesh = make_mesh(MeshConfig(sp=8))
+
+        @partial(shard_map, mesh=mesh, in_specs=P(None), out_specs=P("sp"),
+                 check_vma=False)
+        def rs(x):
+            # every rank contributes the same replicated vector; after
+            # reduce_scatter each rank holds sum-over-ranks of its slot
+            return collectives.reduce_scatter(x, "sp")
+
+        x = jnp.arange(8.0)
+        out = rs(x)
+        assert np.allclose(np.asarray(out), np.arange(8.0) * 8)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        mesh = make_mesh(MeshConfig(sp=8))
+        B, L, H, D = 2, 64, 4, 16
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, L, H, D), jnp.float32)
+        k = jax.random.normal(kk, (B, L, H, D), jnp.float32)
+        v = jax.random.normal(kv, (B, L, H, D), jnp.float32)
+
+        expected = reference_attention(q, k, v, causal=causal)
+        got = ring_attention(mesh, q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_with_tp_and_batch_axes(self):
+        mesh = make_mesh(MeshConfig(dp=2, sp=2, tp=2))
+        B, L, H, D = 4, 32, 4, 8
+        key = jax.random.PRNGKey(1)
+        q, k, v = (
+            jax.random.normal(s, (B, L, H, D), jnp.float32)
+            for s in jax.random.split(key, 3)
+        )
+        expected = reference_attention(q, k, v, causal=True)
+        got = ring_attention(mesh, q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_jit_compiles_once(self):
+        mesh = make_mesh(MeshConfig(sp=8))
+        B, L, H, D = 1, 32, 2, 8
+
+        @jax.jit
+        def fn(q, k, v):
+            return ring_attention(mesh, q, k, v, causal=True)
+
+        q = jnp.ones((B, L, H, D))
+        out = fn(q, q, q)
+        assert out.shape == (B, L, H, D)
+        assert not bool(jnp.any(jnp.isnan(out)))

@@ -1,0 +1,292 @@
+"""v1 trainer tests (reference: pkg/trainer/training_test.go,
+replicas_test.go)."""
+
+import json
+
+import pytest
+
+from k8s_tpu.api import v1alpha1
+from k8s_tpu.api.meta import ObjectMeta
+from k8s_tpu.client import Clientset, FakeCluster
+from k8s_tpu.client.record import FakeRecorder
+from k8s_tpu.controller.trainer.replicas import replica_status_from_pod_list
+from k8s_tpu.controller.trainer.training import TrainingJob
+
+NS = "default"
+
+
+def _template(image="img"):
+    return {
+        "spec": {
+            "containers": [{"name": "tensorflow", "image": image}],
+            "restartPolicy": "OnFailure",
+        }
+    }
+
+
+def make_job(name="myjob", master=1, worker=0, ps=0, runtime_id="abcd"):
+    specs = []
+    if master:
+        specs.append(
+            v1alpha1.TFReplicaSpec(
+                replicas=master, tf_port=2222, tf_replica_type="MASTER",
+                template=_template(),
+            )
+        )
+    if worker:
+        specs.append(
+            v1alpha1.TFReplicaSpec(
+                replicas=worker, tf_port=2222, tf_replica_type="WORKER",
+                template=_template(),
+            )
+        )
+    if ps:
+        specs.append(
+            v1alpha1.TFReplicaSpec(
+                replicas=ps, tf_port=2222, tf_replica_type="PS", template=_template()
+            )
+        )
+    return v1alpha1.TFJob(
+        metadata=ObjectMeta(name=name, namespace=NS, uid="uid-1"),
+        spec=v1alpha1.TFJobSpec(
+            runtime_id=runtime_id,
+            replica_specs=specs,
+            termination_policy=v1alpha1.TerminationPolicySpec(
+                chief=v1alpha1.ChiefSpec("MASTER", 0)
+            ),
+        ),
+    )
+
+
+def make_training_job(job=None, **kw):
+    cs = Clientset(FakeCluster())
+    job = job or make_job(**kw)
+    cs.tfjobs(NS, "kubeflow.org/v1alpha1").create(job)
+    tj = TrainingJob(cs, FakeRecorder(), job)
+    return tj, cs
+
+
+class TestClusterSpec:
+    def test_exact_cluster_spec(self):
+        """training_test.go:119-190: exact TF_CONFIG cluster maps."""
+        tj, _ = make_training_job(master=1, worker=2, ps=1)
+        tj.setup_replicas()
+        assert tj.cluster_spec() == {
+            "master": ["myjob-master-abcd-0:2222"],
+            "worker": ["myjob-worker-abcd-0:2222", "myjob-worker-abcd-1:2222"],
+            "ps": ["myjob-ps-abcd-0:2222"],
+        }
+
+    def test_master_is_process_zero(self):
+        tj, _ = make_training_job(master=1, worker=2)
+        tj.setup_replicas()
+        table = tj.spmd_process_table()
+        assert table[0][:2] == ("MASTER", 0)
+        assert len(table) == 3
+
+
+class TestSetup:
+    def test_setup_valid_job_moves_to_creating(self):
+        tj, _ = make_training_job()
+        tj.setup(v1alpha1.ControllerConfig())
+        assert tj.status.phase == v1alpha1.PHASE_CREATING
+        assert tj.status.state == v1alpha1.STATE_RUNNING
+        assert tj.job.spec.runtime_id  # preserved or generated
+
+    def test_setup_invalid_job_fails(self):
+        """training_test.go:216: validation failure -> Failed phase."""
+        job = make_job()
+        job.spec.replica_specs[0].template = None
+        tj, _ = make_training_job(job=job)
+        tj.setup(v1alpha1.ControllerConfig())
+        assert tj.status.phase == v1alpha1.PHASE_FAILED
+        assert tj.status.state == v1alpha1.STATE_FAILED
+        assert "invalid job spec" in tj.status.reason
+
+    def test_setup_generates_runtime_id(self):
+        job = make_job(runtime_id="")
+        tj, _ = make_training_job(job=job)
+        tj.setup(v1alpha1.ControllerConfig())
+        assert len(tj.job.spec.runtime_id) == 4
+
+
+class TestSyncPodsAndServices:
+    def test_sync_creates_pods_with_tf_config_and_owner(self):
+        """replicas_test.go:45-230."""
+        tj, cs = make_training_job(master=1, worker=1)
+        tj.setup(v1alpha1.ControllerConfig())
+        tj.setup_replicas()
+        for r in tj.replicas:
+            r.sync_pods()
+            r.sync_services()
+        pods = cs.pods(NS).list()
+        services = cs.services(NS).list()
+        assert len(pods) == 2 and len(services) == 2
+
+        master_pod = next(
+            p for p in pods if p["metadata"]["labels"]["job_type"] == "MASTER"
+        )
+        labels = master_pod["metadata"]["labels"]
+        assert labels["tf_job_name"] == "myjob"
+        assert labels["runtime_id"] == "abcd"
+        assert labels["task_index"] == "0"
+        assert master_pod["metadata"]["ownerReferences"][0]["uid"] == "uid-1"
+        # pod name: deterministic prefix + 5-char random suffix
+        assert master_pod["metadata"]["name"].startswith("myjob-master-abcd-0-")
+
+        env = {
+            e["name"]: e["value"]
+            for e in master_pod["spec"]["containers"][0]["env"]
+        }
+        tf_config = json.loads(env["TF_CONFIG"])
+        assert tf_config["environment"] == "cloud"
+        assert tf_config["task"] == {"type": "master", "index": 0}
+        assert tf_config["cluster"]["worker"] == ["myjob-worker-abcd-0:2222"]
+        assert env["JAX_PROCESS_ID"] == "0"
+        assert env["JAX_NUM_PROCESSES"] == "2"
+
+        svc = next(
+            s for s in services if s["metadata"]["labels"]["job_type"] == "MASTER"
+        )
+        assert svc["metadata"]["name"] == "myjob-master-abcd-0"
+        assert svc["spec"]["clusterIP"] == "None"
+
+    def test_sync_is_idempotent(self):
+        tj, cs = make_training_job(master=1)
+        tj.setup(v1alpha1.ControllerConfig())
+        tj.setup_replicas()
+        for _ in range(3):
+            for r in tj.replicas:
+                r.sync_pods()
+                r.sync_services()
+        assert len(cs.pods(NS).list()) == 1
+        assert len(cs.services(NS).list()) == 1
+
+    def test_failed_pod_is_replaced(self):
+        tj, cs = make_training_job(master=1)
+        tj.setup(v1alpha1.ControllerConfig())
+        tj.setup_replicas()
+        tj.replicas[0].sync_pods()
+        fc: FakeCluster = cs.backend
+        pod = cs.pods(NS).list()[0]
+        fc.set_pod_phase(NS, pod["metadata"]["name"], "Failed")
+        tj.replicas[0].sync_pods()
+        pods = cs.pods(NS).list()
+        assert len(pods) == 2  # failed one left for logs, fresh one created
+
+
+class TestReplicaStatus:
+    def _pod(self, state: dict, start="2020-01-01T00:00:00Z", last_state=None):
+        cs = {"name": "tensorflow", "state": state}
+        if last_state:
+            cs["lastState"] = last_state
+        return {
+            "metadata": {"name": "p"},
+            "status": {"startTime": start, "containerStatuses": [cs]},
+        }
+
+    def test_no_pods_means_running(self):
+        assert replica_status_from_pod_list([], "tensorflow") == "Running"
+
+    def test_running_container(self):
+        pod = self._pod({"running": {}})
+        assert replica_status_from_pod_list([pod], "tensorflow") == "Running"
+
+    def test_succeeded(self):
+        pod = self._pod({"terminated": {"exitCode": 0}})
+        assert replica_status_from_pod_list([pod], "tensorflow") == "Succeeded"
+
+    def test_retryable_exit_counts_as_running(self):
+        pod = self._pod({"terminated": {"exitCode": 143}})
+        assert replica_status_from_pod_list([pod], "tensorflow") == "Running"
+
+    def test_permanent_exit_is_failed(self):
+        pod = self._pod({"terminated": {"exitCode": 1}})
+        assert replica_status_from_pod_list([pod], "tensorflow") == "Failed"
+
+    def test_oom_killed_is_permanent_even_with_retryable_code(self):
+        """training.go:192-206."""
+        pod = self._pod({"terminated": {"exitCode": 137, "reason": "OOMKilled"}})
+        assert replica_status_from_pod_list([pod], "tensorflow") == "Failed"
+
+    def test_latest_pod_wins(self):
+        old = self._pod({"terminated": {"exitCode": 1}}, start="2020-01-01T00:00:00Z")
+        new = self._pod({"running": {}}, start="2021-01-01T00:00:00Z")
+        assert replica_status_from_pod_list([old, new], "tensorflow") == "Running"
+
+
+class TestGangPdb:
+    def test_pdb_created_for_distributed_job(self):
+        """training_test.go:376."""
+        tj, cs = make_training_job(master=1, worker=3)
+        tj.setup(v1alpha1.ControllerConfig())
+        tj.setup_replicas()
+        tj.sync_pdb()
+        pdbs = cs.pdbs(NS).list()
+        assert len(pdbs) == 1
+        assert pdbs[0]["spec"]["minAvailable"] == 4
+        assert pdbs[0]["spec"]["selector"]["matchLabels"]["runtime_id"] == "abcd"
+
+    def test_no_pdb_for_single_replica(self):
+        tj, cs = make_training_job(master=1)
+        tj.setup(v1alpha1.ControllerConfig())
+        tj.setup_replicas()
+        tj.sync_pdb()
+        assert cs.pdbs(NS).list() == []
+
+
+class TestReconcileLifecycle:
+    def test_full_lifecycle_to_done(self):
+        tj, cs = make_training_job(master=1, worker=1)
+        config = v1alpha1.ControllerConfig()
+        tj.reconcile(config, enable_gang_scheduling=True)
+        # pods exist but report no container status yet -> chief Unknown,
+        # phase stays Creating (replicas.go:310-363 zero-state path)
+        assert tj.status.phase == v1alpha1.PHASE_CREATING
+        assert len(cs.pods(NS).list()) == 2
+
+        # kubelet reports the chief running -> phase Running
+        fc: FakeCluster = cs.backend
+        for p in cs.pods(NS).list():
+            fc.set_pod_phase(
+                NS, p["metadata"]["name"], "Running",
+                containerStatuses=[{"name": "tensorflow", "state": {"running": {}}}],
+            )
+        tj.reconcile(config, enable_gang_scheduling=True)
+        assert tj.status.phase == v1alpha1.PHASE_RUNNING
+
+        # chief (master) terminates with exit 0 -> job succeeds and cleans up
+        fc: FakeCluster = cs.backend
+        for p in cs.pods(NS).list():
+            phase = "Succeeded" if p["metadata"]["labels"]["job_type"] == "MASTER" else "Running"
+            fc.set_pod_phase(
+                NS, p["metadata"]["name"], phase,
+                containerStatuses=[
+                    {"name": "tensorflow", "state": {"terminated": {"exitCode": 0}}}
+                    if phase == "Succeeded"
+                    else {"name": "tensorflow", "state": {"running": {}}}
+                ],
+            )
+        tj.reconcile(config, enable_gang_scheduling=True)
+        assert tj.status.state == v1alpha1.STATE_SUCCEEDED
+        assert tj.status.phase == v1alpha1.PHASE_DONE
+        assert cs.pods(NS).list() == []  # resources cleaned up
+
+    def test_chief_failure_fails_job(self):
+        tj, cs = make_training_job(master=1, worker=1)
+        config = v1alpha1.ControllerConfig()
+        tj.reconcile(config, False)
+        fc: FakeCluster = cs.backend
+        master = next(
+            p for p in cs.pods(NS).list()
+            if p["metadata"]["labels"]["job_type"] == "MASTER"
+        )
+        fc.set_pod_phase(
+            NS, master["metadata"]["name"], "Failed",
+            containerStatuses=[
+                {"name": "tensorflow", "state": {"terminated": {"exitCode": 1}}}
+            ],
+        )
+        tj.reconcile(config, False)
+        assert tj.status.state == v1alpha1.STATE_FAILED
+        assert tj.status.phase == v1alpha1.PHASE_DONE
