@@ -68,7 +68,8 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5) 
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, images, labels
         )
-    _ = float(loss)
+    if warmup:
+        _ = float(loss)
 
     start = time.perf_counter()
     for _ in range(iters):

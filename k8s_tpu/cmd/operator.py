@@ -20,7 +20,7 @@ from k8s_tpu import version
 from k8s_tpu.api import v1alpha1
 from k8s_tpu.client.clientset import Clientset
 from k8s_tpu.util.leader_election import LeaderElectionConfig, LeaderElector
-from k8s_tpu.util.signals import setup_signal_handler
+from k8s_tpu.util.signals import merge_stop_events, setup_signal_handler
 from k8s_tpu.util.util import get_namespace
 
 log = logging.getLogger(__name__)
@@ -112,19 +112,9 @@ def run(opts, backend=None) -> int:
     )
 
     def on_started_leading(stop_work):
-        import threading
-
-        merged = threading.Event()
-
-        def wait_any():
-            while not stop.is_set() and not stop_work.is_set():
-                stop.wait(0.2)
-            merged.set()
-
-        import threading as _t
-
-        _t.Thread(target=wait_any, daemon=True).start()
-        controller.run(opts.threadiness, stop_event=merged)
+        controller.run(
+            opts.threadiness, stop_event=merge_stop_events(stop, stop_work)
+        )
 
     def on_stopped_leading():
         log.error("leader election lost")

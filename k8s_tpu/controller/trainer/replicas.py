@@ -174,6 +174,20 @@ class TFReplicaSet:
             live = [p for p in pods if (p.get("status") or {}).get("phase") != "Failed"]
             if live:
                 continue
+            failed = [p for p in pods if (p.get("status") or {}).get("phase") == "Failed"]
+            if (
+                self.spec.tf_replica_type in V1_SPMD_TYPE_ORDER
+                and failed
+                and replica_status_from_pod_list(failed, v1alpha1.DEFAULT_TF_CONTAINER)
+                == v1alpha1.REPLICA_STATE_FAILED
+            ):
+                # Permanent failure (non-retryable exit code / OOMKilled,
+                # training.go:192-206) of an SPMD gang member: leave the
+                # failed pod in place so GetStatus surfaces Failed instead of
+                # masking it with a fresh pod.  Only retryable failures (e.g.
+                # TPU preemption, SIGTERM/143) are recreated.  Non-gang
+                # replicas (PS) keep the reference recreate behavior.
+                continue
             log.info(
                 "job %s missing pod for replica %s index %d, creating",
                 self.job.name(), self.spec.tf_replica_type, index,
@@ -220,13 +234,18 @@ class TFReplicaSet:
     # -- status --------------------------------------------------------------
 
     def get_single_replica_status(self, index: int) -> str:
-        """replicas.go:365-387 + replicaStatusFromPodList (:310-363)."""
+        """replicas.go:365-387 + replicaStatusFromPodList (:310-363).
+
+        Departure from the reference (which maps a list error to Failed):
+        a transient apiserver error yields Unknown, not Failed — job state
+        must only be derived from observed pod state, otherwise one flaky
+        List call tears down a healthy job; the workqueue retries anyway."""
         try:
             pods = self.clientset.pods(self._namespace).list(
                 label_selector=self.labels_by_index(index)
             )
         except errors.ApiError:
-            return v1alpha1.REPLICA_STATE_FAILED
+            return v1alpha1.REPLICA_STATE_UNKNOWN
         return replica_status_from_pod_list(pods, v1alpha1.DEFAULT_TF_CONTAINER)
 
     def get_status(self) -> v1alpha1.TFReplicaStatus:

@@ -106,7 +106,12 @@ class TrainingJob:
     # -- status --------------------------------------------------------------
 
     def get_status(self) -> tuple[str, list[v1alpha1.TFReplicaStatus]]:
-        """training.go:154-189: the chief replica's state decides."""
+        """training.go:154-189: the chief replica's state decides success, but
+        — a TPU-gang departure from the reference — ANY replica in a
+        permanently-Failed state fails the whole job.  An SPMD gang is
+        all-or-nothing: with a gang member permanently gone the chief would
+        block in the jax.distributed barrier forever, so waiting on the chief
+        alone would hang the job while holding TPU capacity."""
         chief = self.job.spec.termination_policy.chief
         chief_state = v1alpha1.REPLICA_STATE_UNKNOWN
         replica_statuses = []
@@ -122,6 +127,15 @@ class TrainingJob:
             state = v1alpha1.STATE_FAILED
         elif chief_state == v1alpha1.REPLICA_STATE_SUCCEEDED:
             state = v1alpha1.STATE_SUCCEEDED
+        spmd_types = {r.spec.tf_replica_type for r in self.replicas} & set(
+            V1_SPMD_TYPE_ORDER
+        )
+        if state != v1alpha1.STATE_SUCCEEDED and any(
+            rs.state == v1alpha1.REPLICA_STATE_FAILED
+            for rs in replica_statuses
+            if rs.tf_replica_type in spmd_types
+        ):
+            state = v1alpha1.STATE_FAILED
         return state, replica_statuses
 
     def update_crd_status(self) -> None:

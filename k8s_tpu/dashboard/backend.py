@@ -111,9 +111,8 @@ def _make_handler(manager: ClientManager):
                     self._send_json(200, {"tfJob": job, "pods": pods})
                 elif m := re.fullmatch(r"/tfjobs/api/logs/([^/]+)/([^/]+)", path):
                     ns, pod = m.groups()
-                    cs.pods(ns).get(pod)  # 404 if missing
                     # Log retrieval needs a kubelet; the fake backend stores
-                    # them under status.log for tests.
+                    # them under status.log for tests.  404s if missing.
                     obj = cs.pods(ns).get(pod)
                     self._send_json(
                         200, {"logs": (obj.get("status") or {}).get("log", "")}

@@ -109,11 +109,16 @@ def _plain_attention(q, k, v, causal: bool):
 
 
 class Attention(nn.Module):
+    # mesh is a module attribute (static metadata), not a call argument:
+    # under nn.remat a call argument would be treated as a traced array and
+    # jax.sharding.Mesh has no dtype, crashing every remat-enabled config.
     config: TransformerConfig
+    mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, positions, mesh=None):
+    def __call__(self, x, positions):
         cfg = self.config
+        mesh = self.mesh
         D = cfg.dims_per_head
         dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
             feats, axis=-1, use_bias=False, dtype=cfg.dtype,
@@ -159,11 +164,12 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     config: TransformerConfig
+    mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, positions, mesh=None):
-        y = Attention(self.config, name="attn")(
-            RMSNorm(name="attn_norm")(x), positions, mesh
+    def __call__(self, x, positions):
+        y = Attention(self.config, mesh=self.mesh, name="attn")(
+            RMSNorm(name="attn_norm")(x), positions
         )
         x = x + y
         y = MLP(self.config, name="mlp")(RMSNorm(name="mlp_norm")(x))
@@ -188,11 +194,9 @@ class Transformer(nn.Module):
         )
         x = emb[tokens].astype(cfg.dtype)
 
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+        block = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions, mesh)
+            x = block(cfg, mesh=mesh, name=f"layer_{i}")(x, positions)
 
         x = RMSNorm(name="final_norm")(x)
         # tied embeddings: logits = x @ emb.T, f32 for a stable softmax

@@ -40,19 +40,29 @@ def logical_to_spec(
     logical_axes: tuple[str | None, ...],
     rules: dict[str, Optional[str]] | None = None,
     fsdp_axis: str = "fsdp",
+    shape: tuple[int, ...] | None = None,
 ) -> P:
     """Map a tuple of logical axis names to a PartitionSpec.
 
-    After applying the rule table, the largest still-unsharded dimension is
-    sharded over ``fsdp`` (parameter sharding a la ZeRO-3 / FSDP).
+    After applying the rule table, one still-unsharded named dimension is
+    additionally sharded over ``fsdp`` (parameter sharding a la ZeRO-3 /
+    FSDP): the largest such dimension when ``shape`` is given (the
+    ``shard_params`` path), else the first.
     """
     rules = {**DEFAULT_RULES, **(rules or {})}
     spec: list = [rules.get(a) if a else None for a in logical_axes]
     if fsdp_axis and fsdp_axis not in spec:
-        for i, (axis, assigned) in enumerate(zip(logical_axes, spec)):
-            if assigned is None and axis is not None:
-                spec[i] = fsdp_axis
-                break
+        candidates = [
+            i
+            for i, (axis, assigned) in enumerate(zip(logical_axes, spec))
+            if assigned is None and axis is not None
+        ]
+        if candidates:
+            if shape is not None and len(shape) == len(logical_axes):
+                best = max(candidates, key=lambda i: shape[i])
+            else:
+                best = candidates[0]
+            spec[best] = fsdp_axis
     return P(*spec)
 
 
@@ -61,11 +71,13 @@ def shard_params(
 ) -> Any:
     """Apply NamedShardings to a parameter pytree given a matching pytree of
     logical-axis tuples."""
-    def to_sharding(axes):
-        return NamedSharding(mesh, logical_to_spec(axes, rules))
+    def to_sharding(x, axes):
+        return NamedSharding(
+            mesh, logical_to_spec(axes, rules, shape=getattr(x, "shape", None))
+        )
 
     shardings = jax.tree.map(
-        to_sharding, logical_axes, is_leaf=lambda x: isinstance(x, tuple)
+        to_sharding, params, logical_axes,
     )
     return jax.device_put(params, shardings)
 

@@ -33,3 +33,19 @@ def setup_signal_handler() -> threading.Event:
     signal.signal(signal.SIGINT, _handler)
     signal.signal(signal.SIGTERM, _handler)
     return stop
+
+
+def merge_stop_events(*events: threading.Event, poll: float = 0.2) -> threading.Event:
+    """Return an Event that is set as soon as any of ``events`` is set.
+
+    Used by the operator binaries to merge the process signal handler's stop
+    event with the leader elector's per-term stop-work event."""
+    merged = threading.Event()
+
+    def wait_any():
+        while not any(e.is_set() for e in events):
+            events[0].wait(poll)
+        merged.set()
+
+    threading.Thread(target=wait_any, daemon=True).start()
+    return merged

@@ -132,3 +132,41 @@ class TestTraining:
         # params really are distributed
         emb = state["params"]["params"]["embedding"]
         assert not emb.sharding.is_fully_replicated
+
+
+class TestRematWithMesh:
+    def test_remat_config_trains_with_mesh_and_ring_attention(self):
+        # Regression: nn.remat treated a mesh call-argument as a traced array
+        # (Mesh has no dtype) and crashed every remat-enabled config; mesh is
+        # now static module metadata.  Production presets default remat=True.
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from k8s_tpu.models import train
+        from k8s_tpu.models.transformer import Transformer, tiny_test
+        from k8s_tpu.parallel import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=2, tp=1), jax.devices()[:8])
+        cfg = dataclasses.replace(tiny_test(), remat=True, use_ring_attention=True)
+        model = Transformer(cfg)
+
+        batch, seq = 4, 32
+        tokens = (jnp.arange(batch * seq, dtype=jnp.int32).reshape(batch, seq) * 7) % cfg.vocab_size
+        params = model.init(jax.random.PRNGKey(0), tokens)
+
+        optimizer = train.default_optimizer(1e-3)
+        state = train.init_state(params, optimizer)
+        state, shardings = train.shard_train_state(state, mesh)
+        step = train.make_sharded_train_step(
+            lambda p, t: model.apply(p, t, mesh=mesh),
+            train.lm_loss,
+            optimizer,
+            mesh,
+            shardings,
+        )
+        tokens = jax.device_put(tokens, NamedSharding(mesh, P(("dp", "fsdp"))))
+        _, loss = step(state, (tokens, tokens))
+        assert bool(jnp.isfinite(loss))

@@ -290,3 +290,154 @@ class TestReconcileLifecycle:
         tj.reconcile(config, False)
         assert tj.status.state == v1alpha1.STATE_FAILED
         assert tj.status.phase == v1alpha1.PHASE_DONE
+
+    def test_worker_permanent_failure_fails_gang(self):
+        # TPU-gang semantics: a permanently-failed non-chief replica fails the
+        # whole job (the chief would otherwise block in the SPMD barrier
+        # forever).  Departure from reference chief-only training.go:154-189.
+        tj, cs = make_training_job(master=1, worker=2)
+        config = v1alpha1.ControllerConfig()
+        tj.reconcile(config, False)
+        fc: FakeCluster = cs.backend
+        worker = next(
+            p for p in cs.pods(NS).list()
+            if p["metadata"]["labels"]["job_type"] == "WORKER"
+        )
+        fc.set_pod_phase(
+            NS, worker["metadata"]["name"], "Failed",
+            containerStatuses=[
+                {"name": "tensorflow", "state": {"terminated": {"exitCode": 1}}}
+            ],
+        )
+        n_pods = len(cs.pods(NS).list())
+        tj.reconcile(config, False)
+        assert tj.status.state == v1alpha1.STATE_FAILED
+        assert tj.status.phase == v1alpha1.PHASE_DONE
+        # no replacement pod was created for the permanent failure
+        assert cs.pods(NS).list() == []  # cleaned up on failure
+
+    def test_worker_retryable_failure_recreates_pod(self):
+        # Retryable exit (143 = SIGTERM, TPU preemption) -> replacement pod,
+        # job keeps running (train_util.go:32-43 policy).
+        tj, cs = make_training_job(master=1, worker=1)
+        config = v1alpha1.ControllerConfig()
+        tj.reconcile(config, False)
+        fc: FakeCluster = cs.backend
+        worker = next(
+            p for p in cs.pods(NS).list()
+            if p["metadata"]["labels"]["job_type"] == "WORKER"
+        )
+        fc.set_pod_phase(
+            NS, worker["metadata"]["name"], "Failed",
+            containerStatuses=[
+                {"name": "tensorflow", "state": {"terminated": {"exitCode": 143}}}
+            ],
+        )
+        tj.reconcile(config, False)
+        workers = [
+            p for p in cs.pods(NS).list()
+            if p["metadata"]["labels"]["job_type"] == "WORKER"
+        ]
+        assert len(workers) == 2  # failed original + live replacement
+        assert tj.status.state != v1alpha1.STATE_FAILED
+
+    def test_chief_success_wins_over_late_worker_failure(self):
+        # Chief exit 0 decides success even if a worker dies permanently in
+        # the same reconcile window (post-barrier teardown casualties must
+        # not flip a completed job to Failed).
+        tj, cs = make_training_job(master=1, worker=1)
+        config = v1alpha1.ControllerConfig()
+        tj.reconcile(config, False)
+        fc: FakeCluster = cs.backend
+        for p in cs.pods(NS).list():
+            if p["metadata"]["labels"]["job_type"] == "MASTER":
+                fc.set_pod_phase(
+                    NS, p["metadata"]["name"], "Succeeded",
+                    containerStatuses=[
+                        {"name": "tensorflow", "state": {"terminated": {"exitCode": 0}}}
+                    ],
+                )
+            else:
+                fc.set_pod_phase(
+                    NS, p["metadata"]["name"], "Failed",
+                    containerStatuses=[
+                        {"name": "tensorflow", "state": {"terminated": {"exitCode": 1}}}
+                    ],
+                )
+        tj.reconcile(config, False)
+        assert tj.status.state == v1alpha1.STATE_SUCCEEDED
+        assert tj.status.phase == v1alpha1.PHASE_DONE
+
+    def test_transient_list_error_does_not_fail_job(self):
+        # A flaky apiserver List must not tear down a healthy job: replica
+        # state becomes Unknown, job state is unchanged, workqueue retries.
+        from k8s_tpu.client import errors as client_errors
+
+        tj, cs = make_training_job(master=1, worker=1)
+        config = v1alpha1.ControllerConfig()
+        tj.reconcile(config, False)
+        fc: FakeCluster = cs.backend
+        for p in cs.pods(NS).list():
+            fc.set_pod_phase(
+                NS, p["metadata"]["name"], "Running",
+                containerStatuses=[{"name": "tensorflow", "state": {"running": {}}}],
+            )
+        tj.reconcile(config, False)
+        assert tj.status.state == v1alpha1.STATE_RUNNING
+
+        worker_rs = next(
+            r for r in tj.replicas if r.spec.tf_replica_type == v1alpha1.WORKER
+        )
+
+        class FlakyPods:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def list(self, **kw):
+                raise client_errors.ApiError(500, "transient")
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+        class FlakyClientset:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def pods(self, ns):
+                return FlakyPods(self.inner.pods(ns))
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+        real = worker_rs.clientset
+        worker_rs.clientset = FlakyClientset(real)
+        try:
+            state, _ = tj.get_status()
+        finally:
+            worker_rs.clientset = real
+        assert state == v1alpha1.STATE_RUNNING  # chief still running; no failure
+
+    def test_ps_permanent_failure_recreated_not_fatal(self):
+        # PS is not an SPMD gang member: reference recreate behavior kept,
+        # and its permanent failure must not fail the job.
+        tj, cs = make_training_job(master=1, ps=1)
+        config = v1alpha1.ControllerConfig()
+        tj.reconcile(config, False)
+        fc: FakeCluster = cs.backend
+        ps = next(
+            p for p in cs.pods(NS).list()
+            if p["metadata"]["labels"]["job_type"] == "PS"
+        )
+        fc.set_pod_phase(
+            NS, ps["metadata"]["name"], "Failed",
+            containerStatuses=[
+                {"name": "tensorflow", "state": {"terminated": {"exitCode": 1}}}
+            ],
+        )
+        tj.reconcile(config, False)
+        assert tj.status.state != v1alpha1.STATE_FAILED
+        ps_pods = [
+            p for p in cs.pods(NS).list()
+            if p["metadata"]["labels"]["job_type"] == "PS"
+        ]
+        assert len(ps_pods) == 2  # failed original + live replacement
