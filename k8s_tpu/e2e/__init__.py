@@ -1,0 +1,13 @@
+"""End-to-end test layer (reference: test/e2e/main.go, py/test_runner.py).
+
+The reference e2e runs on a real GKE cluster.  This rebuild adds what the
+reference lacked (SURVEY.md §4: "a fake TPU topology/device layer"): a
+**kubelet simulator** that actually executes pod containers as local
+subprocesses, so a TFJob drives a real process end-to-end — operator creates
+the pod, the simulator runs it with the injected env (TF_CONFIG / JAX
+bootstrap), the exit code flows back through pod status into the operator's
+exit-code policy and job conditions — all without a cluster.
+"""
+
+from k8s_tpu.e2e.kubelet import KubeletSimulator  # noqa: F401
+from k8s_tpu.e2e.local import LocalCluster  # noqa: F401

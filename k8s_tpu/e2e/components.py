@@ -1,0 +1,111 @@
+"""Parameterized TFJob components for e2e runs.
+
+The reference deploys its e2e job through a ksonnet app
+(``ks env add`` / ``ks param set`` / ``ks apply``, py/test_runner.py:239-276,
+test/test-app/components/core.jsonnet).  Here the component is a pure
+function: params → TFJob dict, in either API version.
+"""
+
+from __future__ import annotations
+
+import sys
+
+DEFAULT_PORT = 2222
+
+
+def _container(params: dict) -> dict:
+    c = {"name": "tensorflow", "image": params.get("image", "k8s-tpu/smoke:latest")}
+    if params.get("command"):
+        c["command"] = list(params["command"])
+    return c
+
+
+def _template(params: dict) -> dict:
+    return {
+        "spec": {
+            "containers": [_container(params)],
+            "restartPolicy": "OnFailure",
+        }
+    }
+
+
+def core_v1alpha1(params: dict) -> dict:
+    """MASTER/WORKER/PS TFJob, v1alpha1 list-of-replica-specs shape
+    (test/e2e/main.go:83-96)."""
+    replica_specs = []
+    for rtype, count in (
+        ("MASTER", params.get("num_masters", 1)),
+        ("WORKER", params.get("num_workers", 1)),
+        ("PS", params.get("num_ps", 0)),
+    ):
+        if count <= 0:
+            continue
+        replica_specs.append(
+            {
+                "replicas": count,
+                "tfPort": params.get("port", DEFAULT_PORT),
+                "tfReplicaType": rtype,
+                "template": _template(params),
+            }
+        )
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "TFJob",
+        "metadata": {
+            "name": params["name"],
+            "namespace": params.get("namespace", "default"),
+            "labels": {"test.mlkube.io": ""},
+        },
+        "spec": {"replicaSpecs": replica_specs},
+    }
+
+
+def core_v1alpha2(params: dict) -> dict:
+    """Chief/Worker/PS TFJob, v1alpha2 map-of-replica-specs shape
+    (pkg/apis/tensorflow/v1alpha2/types.go:53)."""
+    tf_replica_specs = {}
+    for rtype, count in (
+        ("Chief", params.get("num_masters", 1)),
+        ("Worker", params.get("num_workers", 1)),
+        ("PS", params.get("num_ps", 0)),
+    ):
+        if count <= 0:
+            continue
+        tf_replica_specs[rtype] = {
+            "replicas": count,
+            "restartPolicy": params.get("restartPolicy", "OnFailure"),
+            "template": _template(params),
+        }
+    return {
+        "apiVersion": "kubeflow.org/v1alpha2",
+        "kind": "TFJob",
+        "metadata": {
+            "name": params["name"],
+            "namespace": params.get("namespace", "default"),
+        },
+        "spec": {"tfReplicaSpecs": tf_replica_specs},
+    }
+
+
+def core_component(params: dict, version: str = "v1alpha1") -> dict:
+    if version.endswith("v1alpha1"):
+        return core_v1alpha1(params)
+    return core_v1alpha2(params)
+
+
+def smoke_command(exit_code: int = 0) -> list[str]:
+    """A real subprocess workload: sanity-checks the injected TF_CONFIG /
+    JAX env the way tf_smoke.py parses TF_CONFIG (tf_smoke.py:88-118), then
+    exits with ``exit_code``."""
+    script = (
+        "import json, os, sys\n"
+        "tf_config = json.loads(os.environ['TF_CONFIG'])\n"
+        "assert 'cluster' in tf_config and 'task' in tf_config, tf_config\n"
+        "task = tf_config['task']\n"
+        "assert task['type'] in tf_config['cluster'], tf_config\n"
+        "if task['type'] in ('master', 'worker', 'tpu_worker'):\n"
+        "    assert os.environ.get('JAX_COORDINATOR_ADDRESS'), 'missing coordinator'\n"
+        "    assert os.environ.get('JAX_PROCESS_ID') is not None\n"
+        f"sys.exit({exit_code})\n"
+    )
+    return [sys.executable, "-c", script]

@@ -1,0 +1,230 @@
+"""Kubelet simulator: executes pods as local subprocesses.
+
+For each pod the apiserver (fake or REST backend) holds, the simulator
+starts the ``tensorflow`` container's command as a subprocess with the
+container's env vars, marks the pod Running, and on exit records
+Succeeded/Failed with the real exit code in ``containerStatuses`` — the
+exact surface the operator's status engine reads
+(pkg/trainer/replicas.go:310-363, pkg/controller.v2/controller_status.go).
+
+Pods whose container has no command are completed synthetically after
+``default_runtime_s`` with ``default_exit_code`` (the stand-in for a real
+training image).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+import time
+
+from k8s_tpu.client import errors
+
+log = logging.getLogger(__name__)
+
+CONTAINER_NAME = "tensorflow"
+
+
+class KubeletSimulator:
+    def __init__(
+        self,
+        clientset,
+        namespace: str = "default",
+        env_transform=None,
+        default_exit_code: int = 0,
+        default_runtime_s: float = 0.05,
+        poll_interval_s: float = 0.05,
+        restart_backoff_s: float = 0.2,
+        max_restarts: int | None = None,
+    ):
+        self.clientset = clientset
+        self.namespace = namespace
+        self.env_transform = env_transform
+        self.default_exit_code = default_exit_code
+        self.default_runtime_s = default_runtime_s
+        self.poll_interval_s = poll_interval_s
+        self.restart_backoff_s = restart_backoff_s
+        self.max_restarts = max_restarts
+        self._claimed: set[str] = set()  # pod uids this kubelet started
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "KubeletSimulator":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="kubelet-sim"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for proc in list(self._procs.values()):
+            if proc.poll() is None:
+                proc.kill()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- main loop -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sync_once()
+            except Exception:
+                log.exception("kubelet sync error")
+            self._stop.wait(self.poll_interval_s)
+
+    def _sync_once(self) -> None:
+        pods = self.clientset.pods(self.namespace).list()
+        live_uids = set()
+        for pod in pods:
+            uid = (pod.get("metadata") or {}).get("uid")
+            if not uid:
+                continue
+            live_uids.add(uid)
+            phase = (pod.get("status") or {}).get("phase")
+            if uid in self._claimed or phase in ("Succeeded", "Failed"):
+                continue
+            self._claimed.add(uid)
+            threading.Thread(
+                target=self._run_pod, args=(pod,), daemon=True,
+                name=f"pod-{pod['metadata']['name']}",
+            ).start()
+        # pods deleted from the apiserver: kill their processes (kubelet
+        # behavior for deleted pods)
+        for uid, proc in list(self._procs.items()):
+            if uid not in live_uids and proc.poll() is None:
+                proc.kill()
+
+    # -- pod execution -------------------------------------------------------
+
+    def _container(self, pod: dict) -> dict:
+        containers = (pod.get("spec") or {}).get("containers") or []
+        for c in containers:
+            if c.get("name") == CONTAINER_NAME:
+                return c
+        return containers[0] if containers else {}
+
+    def _set_status(self, pod: dict, phase: str, container_state: dict) -> None:
+        name = pod["metadata"]["name"]
+        status = {
+            "phase": phase,
+            "startTime": (pod.get("status") or {}).get("startTime")
+            or time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "containerStatuses": [
+                {"name": CONTAINER_NAME, "state": container_state}
+            ],
+        }
+        try:
+            self.clientset.pods(self.namespace).patch(name, {"status": status})
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                raise
+
+    def _run_pod(self, pod: dict) -> None:
+        name = pod["metadata"]["name"]
+        uid = pod["metadata"]["uid"]
+        restart_policy = (pod.get("spec") or {}).get("restartPolicy", "Always")
+        container = self._container(pod)
+        command = list(container.get("command") or []) + list(
+            container.get("args") or []
+        )
+        env = {
+            "PATH": os.environ.get("PATH", ""),
+            "HOME": os.environ.get("HOME", "/tmp"),
+            "PYTHONPATH": os.pathsep.join(
+                p for p in (
+                    os.environ.get("PYTHONPATH", ""),
+                    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+                ) if p
+            ),
+        }
+        for item in container.get("env") or []:
+            env[item["name"]] = item.get("value", "")
+        if self.env_transform:
+            env = self.env_transform(pod, env)
+
+        self._set_status(pod, "Running", {"running": {}})
+
+        restart_count = 0
+        while True:
+            if not command:
+                time.sleep(self.default_runtime_s)
+                exit_code = self.default_exit_code
+            else:
+                try:
+                    proc = subprocess.Popen(
+                        command, env=env,
+                        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    )
+                except OSError as e:
+                    log.error("pod %s: failed to start %s: %s", name, command, e)
+                    self._set_status(
+                        pod, "Failed",
+                        {"terminated": {"exitCode": 127, "reason": "StartError"}},
+                    )
+                    return
+                self._procs[uid] = proc
+                out, _ = proc.communicate()
+                exit_code = proc.returncode
+                self._procs.pop(uid, None)
+                if out:
+                    self._store_log(name, out.decode(errors="replace"))
+
+            if exit_code == 0:
+                self._set_status(pod, "Succeeded", {"terminated": {"exitCode": 0}})
+                return
+            log.info("pod %s exited %d", name, exit_code)
+            restartable = restart_policy in ("Always", "OnFailure")
+            if self._stop.is_set() or not restartable or (
+                self.max_restarts is not None and restart_count >= self.max_restarts
+            ):
+                # restartPolicy Never (or restart budget exhausted): the pod
+                # fails terminally.
+                self._set_status(
+                    pod, "Failed", {"terminated": {"exitCode": exit_code}}
+                )
+                return
+            # restartPolicy Always/OnFailure: the kubelet restarts the
+            # container IN the same pod — pod stays Running, the exit lands
+            # in lastState.terminated, which is exactly what the operator's
+            # exit-code policy reads (pkg/trainer/replicas.go:326-362: a
+            # permanent code there fails the replica even though the pod
+            # object never reaches phase Failed).
+            restart_count += 1
+            try:
+                current = self.clientset.pods(self.namespace).get(name)
+            except errors.ApiError:
+                return  # pod deleted while we were running it
+            status = {
+                "phase": "Running",
+                "startTime": (current.get("status") or {}).get("startTime"),
+                "containerStatuses": [
+                    {
+                        "name": CONTAINER_NAME,
+                        "restartCount": restart_count,
+                        "state": {"waiting": {"reason": "CrashLoopBackOff"}},
+                        "lastState": {"terminated": {"exitCode": exit_code}},
+                    }
+                ],
+            }
+            self.clientset.pods(self.namespace).patch(name, {"status": status})
+            # crash-loop backoff, then run again (status flips back to
+            # running on the next iteration's subprocess start)
+            if self._stop.wait(self.restart_backoff_s):
+                return
+
+    def _store_log(self, pod_name: str, text: str) -> None:
+        """Stash container output under status.log — the convention the fake
+        backend/dashboard use for log retrieval."""
+        try:
+            self.clientset.pods(self.namespace).patch(
+                pod_name, {"status": {"log": text[-65536:]}}
+            )
+        except errors.ApiError:
+            pass

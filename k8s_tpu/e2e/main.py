@@ -1,0 +1,165 @@
+"""TAP e2e binary (reference: test/e2e/main.go:62-252).
+
+Runs N TFJobs (in parallel threads like main.go:195-221), each through the
+full lifecycle: create → wait Succeeded → verify runtime id + per-replica
+resources → delete → verify GC.  Emits TAP output
+("ok 1 - Successfully ran TFJob", main.go:244-252).
+
+Against ``--local`` (default) it provisions an in-process LocalCluster
+(fake apiserver + operator + kubelet simulator); pointed at a kubeconfig it
+drives a real apiserver the way the Go binary does in-cluster.
+
+The reference checked ``BatchV1().Jobs`` for per-replica resources — stale
+against the pod-based trainer (SURVEY.md §3.4 note); this checks the
+pod-created events + services, matching the maintained Python runner.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import threading
+import time
+
+from k8s_tpu.e2e.components import core_component, smoke_command
+from k8s_tpu.harness import test_runner, tf_job_client
+from k8s_tpu.util.util import rand_string
+
+log = logging.getLogger(__name__)
+
+
+def run_one(clientset, namespace: str, version: str, timeout_s: float) -> tuple[str, str]:
+    """One job lifecycle; returns (name, error) with error == "" on success
+    (main.go:62-186)."""
+    import datetime
+
+    name = "e2e-test-job-" + rand_string(4)
+    component = core_component(
+        {
+            "name": name,
+            "namespace": namespace,
+            "num_masters": 1,
+            "num_workers": 1,
+            "num_ps": 1,
+            "command": smoke_command(),
+        },
+        version,
+    )
+    try:
+        tf_job_client.create_tf_job(clientset, component, version)
+        deadline = time.time() + timeout_s
+        tf_job = None
+        while time.time() < deadline:
+            tf_job = clientset.tfjobs_unstructured(
+                namespace, f"kubeflow.org/{version}"
+            ).get(name)
+            state = (tf_job.get("status") or {}).get("state")
+            conditions = (tf_job.get("status") or {}).get("conditions") or []
+            if version.endswith("v1alpha1") and state in ("Succeeded", "Failed"):
+                break
+            if not version.endswith("v1alpha1") and any(
+                c.get("type") in ("Succeeded", "Failed") and c.get("status") == "True"
+                for c in conditions
+            ):
+                break
+            time.sleep(0.1)
+
+        if tf_job is None:
+            return name, f"Failed to get TFJob {name}"
+        if not test_runner._succeeded(tf_job, version):
+            return name, f"TFJob {name} did not succeed; {tf_job.get('status')}"
+
+        if version.endswith("v1alpha1"):
+            if not (tf_job.get("spec") or {}).get("RuntimeId"):
+                return name, f"TFJob {name} doesn't have a RuntimeId"
+
+        # per-replica resources: creation events for every expected replica
+        uid = tf_job["metadata"]["uid"]
+        pods, services = test_runner.parse_events(
+            test_runner.get_events(clientset, namespace, uid)
+        )
+        expected = test_runner._expected_replicas(tf_job, version)
+        if len(pods) < expected:
+            return name, f"TFJob {name} created {len(pods)} pods, want {expected}"
+        if len(services) < expected:
+            return name, (
+                f"TFJob {name} created {len(services)} services, want {expected}"
+            )
+
+        # delete and verify GC (main.go:151-186)
+        tf_job_client.delete_tf_job(clientset, namespace, name, version)
+        test_runner.wait_for_delete(
+            clientset, namespace, name, version,
+            timeout=datetime.timedelta(seconds=timeout_s),
+        )
+        test_runner.wait_for_pods_to_be_deleted(
+            clientset, namespace, {"tf_job_name": name},
+            timeout=datetime.timedelta(seconds=timeout_s),
+        )
+        if any(
+            (s.get("metadata") or {}).get("labels", {}).get("tf_job_name") == name
+            for s in clientset.services(namespace).list()
+        ):
+            return name, f"TFJob {name} services were not garbage collected"
+        return name, ""
+    except Exception as e:  # noqa: BLE001 - report as TAP failure
+        log.exception("job %s failed", name)
+        return name, str(e)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="TAP e2e test.")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--num_jobs", type=int, default=1)
+    parser.add_argument("--version", default="v1alpha1")
+    parser.add_argument("--timeout_s", type=float, default=120.0)
+    parser.add_argument(
+        "--kubeconfig", default="",
+        help="Drive a real apiserver; default is the in-process LocalCluster.",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    cluster = None
+    if args.kubeconfig:
+        from k8s_tpu.client.clientset import Clientset
+        from k8s_tpu.client.rest import RestClient, kubeconfig_config
+
+        clientset = Clientset(RestClient(kubeconfig_config(args.kubeconfig)))
+    else:
+        from k8s_tpu.e2e.local import LocalCluster
+
+        cluster = LocalCluster(version=args.version, namespace=args.namespace)
+        cluster.__enter__()
+        clientset = cluster.clientset
+
+    results: list[tuple[str, str]] = [None] * args.num_jobs
+
+    def worker(i: int) -> None:
+        results[i] = run_one(clientset, args.namespace, args.version, args.timeout_s)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(args.num_jobs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if cluster:
+        cluster.stop()
+
+    # TAP output (main.go:244-252)
+    print(f"1..{args.num_jobs}")
+    failures = 0
+    for i, (name, err) in enumerate(results, start=1):
+        if err:
+            failures += 1
+            print(f"not ok {i} - TFJob {name} failed: {err}")
+        else:
+            print(f"ok {i} - Successfully ran TFJob {name}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""CI/test/release harness (reference: py/ — SURVEY.md §2.2).
+
+The reference harness runs outside the cluster and talks to GCS + GKE; this
+rebuild keeps the same behavioral surface (junit artifacts, prow metadata
+files, job-lifecycle client, event-based e2e assertions) against a pluggable
+artifact store (local filesystem in the zero-egress image) and the k8s_tpu
+clientset (fake or REST backend).
+"""
+
+from k8s_tpu.harness.artifacts import LocalArtifactStore, split_uri  # noqa: F401
+from k8s_tpu.harness.junit import (  # noqa: F401
+    TestCase,
+    TestSuite,
+    create_junit_xml_file,
+    create_xml,
+    get_num_failures,
+    wrap_test,
+)
+from k8s_tpu.harness.util import TimeoutError  # noqa: F401

@@ -1,0 +1,70 @@
+"""Artifact store abstraction.
+
+The reference writes CI artifacts to GCS (``gs://bucket/path`` URIs threaded
+through py/prow.py and py/test_util.py).  In the zero-egress TPU image the
+same layout lands on the local filesystem; the store interface keeps the
+prow/junit code transport-agnostic so a GCS (or GCS-compatible) store can be
+slotted in for real CI.
+
+URIs use ``<scheme>://<bucket>/<path>`` like the reference's
+``util.split_gcs_uri`` (py/util.py:447-457); plain paths are treated as
+local files.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable
+
+_URI_RE = re.compile(r"^([a-z][a-z0-9+.-]*)://([^/]*)/?(.*)$")
+
+
+def split_uri(uri: str) -> tuple[str, str]:
+    """Split ``scheme://bucket/path`` into (bucket, path)
+    (py/util.py:447-457 split_gcs_uri)."""
+    m = _URI_RE.match(uri)
+    if not m:
+        raise ValueError(f"not a store URI: {uri!r}")
+    return m.group(2), m.group(3)
+
+
+def is_store_uri(uri: str) -> bool:
+    return bool(_URI_RE.match(uri))
+
+
+class LocalArtifactStore:
+    """Filesystem-backed store: bucket → directory under ``root``."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, bucket: str, path: str) -> str:
+        return os.path.join(self.root, bucket, path)
+
+    def upload_from_string(self, bucket: str, path: str, data: str) -> str:
+        full = self._path(bucket, path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "w") as f:
+            f.write(data)
+        return full
+
+    def upload_from_filename(self, bucket: str, path: str, filename: str) -> str:
+        with open(filename) as f:
+            return self.upload_from_string(bucket, path, f.read())
+
+    def download_as_string(self, bucket: str, path: str) -> str:
+        with open(self._path(bucket, path)) as f:
+            return f.read()
+
+    def exists(self, bucket: str, path: str) -> bool:
+        return os.path.exists(self._path(bucket, path))
+
+    def list(self, bucket: str, prefix: str) -> Iterable[str]:
+        """Yield object paths (relative to the bucket) under ``prefix``."""
+        base = os.path.join(self.root, bucket)
+        for dirpath, _dirs, files in os.walk(base):
+            for name in files:
+                rel = os.path.relpath(os.path.join(dirpath, name), base)
+                if rel.startswith(prefix):
+                    yield rel

@@ -1,0 +1,135 @@
+"""JUnit XML emission (reference: py/test_util.py:15-187).
+
+Same behavioral contract as the reference:
+- a case with neither a time nor a failure is reported as
+  "Test was not run." (test_util.py:131-133);
+- suite attributes carry failures / tests / total time;
+- ``get_num_failures`` reads the suite's ``failures`` attribute.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import time
+from typing import Iterable, Optional
+from xml.etree import ElementTree
+
+from k8s_tpu.harness.artifacts import is_store_uri, split_uri
+
+log = logging.getLogger(__name__)
+
+
+class TestCase:
+    __test__ = False  # junit artifact class, not a pytest case
+
+    def __init__(self, class_name: str = "", name: str = ""):
+        self.class_name = class_name
+        self.name = name
+        self.time: Optional[float] = None  # seconds
+        self.failure: Optional[str] = None
+
+
+class TestSuite:
+    """A named collection of TestCases (test_util.py:26-69)."""
+
+    __test__ = False  # junit artifact class, not a pytest case
+
+    def __init__(self, class_name: str):
+        self._cases: dict[str, TestCase] = {}
+        self._class_name = class_name
+
+    def create(self, name: str) -> TestCase:
+        if name in self._cases:
+            raise ValueError(f"TestSuite already has a test named {name}")
+        case = TestCase(class_name=self._class_name, name=name)
+        self._cases[name] = case
+        return case
+
+    def get(self, name: str) -> TestCase:
+        if name not in self._cases:
+            raise KeyError(f"No TestCase named {name}")
+        return self._cases[name]
+
+    def __iter__(self):
+        return iter(self._cases.values())
+
+    def __len__(self):
+        return len(self._cases)
+
+
+def wrap_test(test_func, test_case: TestCase) -> None:
+    """Run ``test_func`` recording wall time and failure text into
+    ``test_case``; exceptions are re-raised (test_util.py:72-97)."""
+    start = time.time()
+    try:
+        test_func()
+    except subprocess.CalledProcessError as e:
+        test_case.failure = f"Subprocess failed;\n{e.output}"
+        raise
+    except Exception as e:  # noqa: BLE001
+        test_case.failure = f"Test failed; {e}"
+        raise
+    finally:
+        test_case.time = time.time() - start
+
+
+def create_xml(test_cases: Iterable[TestCase]) -> ElementTree.ElementTree:
+    """Build the <testsuite> tree (test_util.py:99-146)."""
+    cases = list(test_cases)
+    total_time = sum(c.time for c in cases if c.time is not None)
+    failures = sum(1 for c in cases if c.failure)
+    # Count not-run cases as failures up front so the suite attribute is
+    # consistent with the <failure> elements emitted below.  "Not run" means
+    # time is None — a measured 0.0s is a (fast) run, not a skip.
+    failures += sum(1 for c in cases if c.time is None and not c.failure)
+    root = ElementTree.Element(
+        "testsuite",
+        {
+            "failures": str(failures),
+            "tests": str(len(cases)),
+            "time": str(total_time),
+        },
+    )
+    for c in cases:
+        attrib = {"classname": c.class_name, "name": c.name}
+        if c.time is not None:
+            attrib["time"] = str(c.time)
+        if c.time is None and not c.failure:
+            c.failure = "Test was not run."
+        e = ElementTree.Element("testcase", attrib)
+        root.append(e)
+        if c.failure:
+            f = ElementTree.Element("failure")
+            f.text = c.failure
+            e.append(f)
+    return ElementTree.ElementTree(root)
+
+
+def create_junit_xml_file(
+    test_cases: Iterable[TestCase], output_path: str, store=None
+) -> None:
+    """Write junit XML to a local path or a store URI
+    (test_util.py:149-184)."""
+    tree = create_xml(test_cases)
+    log.info("Creating %s", output_path)
+    if is_store_uri(output_path):
+        if store is None:
+            raise ValueError(f"store required for URI output {output_path!r}")
+        bucket, path = split_uri(output_path)
+        store.upload_from_string(
+            bucket, path, ElementTree.tostring(tree.getroot(), encoding="unicode")
+        )
+        return
+    dir_name = os.path.dirname(output_path)
+    if dir_name:
+        os.makedirs(dir_name, exist_ok=True)
+    tree.write(output_path)
+
+
+def get_num_failures(xml_string: str | bytes) -> int:
+    """Number of failures recorded in a junit string
+    (test_util.py:187-191)."""
+    e = ElementTree.fromstring(xml_string)
+    return int(e.attrib.get("failures", 0))

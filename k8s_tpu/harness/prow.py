@@ -1,0 +1,184 @@
+"""Prow CI glue (reference: py/prow.py:81-315).
+
+Writes the gubernator artifact layout — started.json / finished.json /
+build-log.txt / junit files / latest_green.json / PR symlinks — through the
+pluggable artifact store.  Env contract matches prow's job environment
+variables (JOB_NAME, BUILD_NUMBER, PULL_NUMBER, PULL_REFS, PULL_PULL_SHA,
+PULL_BASE_SHA, REPO_OWNER).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+
+from k8s_tpu.harness import junit
+from k8s_tpu.harness.artifacts import LocalArtifactStore, split_uri
+
+log = logging.getLogger(__name__)
+
+# Default repository coordinates (prow.py:29-31).
+REPO_OWNER = "kubeflow"
+REPO_NAME = "tf-operator-tpu"
+
+# The store bucket that holds CI logs (reference: kubernetes-jenkins on GCS).
+LOGS_BUCKET = "ci-logs"
+RESULTS_BUCKET = "ci-results"
+STORE_SCHEME = "store"
+
+
+def get_output_dir() -> str:
+    """Store URI for this job's output, per the gubernator layout
+    (prow.py:36-64): PR jobs under pr-logs/pull/, postsubmits under
+    logs/<owner>_<repo>/, periodics under logs/<job>/."""
+    job_name = os.getenv("JOB_NAME")
+    build = os.getenv("BUILD_NUMBER")
+    pull_number = os.getenv("PULL_NUMBER")
+    if pull_number:
+        return (
+            f"{STORE_SCHEME}://{LOGS_BUCKET}/pr-logs/pull/"
+            f"{REPO_OWNER}_{REPO_NAME}/{pull_number}/{job_name}/{build}"
+        )
+    if os.getenv("REPO_OWNER"):
+        return (
+            f"{STORE_SCHEME}://{LOGS_BUCKET}/logs/"
+            f"{REPO_OWNER}_{REPO_NAME}/{job_name}/{build}"
+        )
+    return f"{STORE_SCHEME}://{LOGS_BUCKET}/logs/{job_name}/{build}"
+
+
+def get_symlink_output(pull_number: str | None, job_name: str, build_number: str) -> str:
+    """PR jobs get a pr-logs/directory symlink file (prow.py:67-78)."""
+    if not pull_number:
+        return ""
+    return (
+        f"{STORE_SCHEME}://{LOGS_BUCKET}/pr-logs/directory/"
+        f"{job_name}/{build_number}.txt"
+    )
+
+
+def create_started(store, output_dir: str, sha: str) -> str:
+    """Write started.json (prow.py:81-116)."""
+    started = {
+        "timestamp": int(time.time()),
+        "repos": {f"{REPO_OWNER}/{REPO_NAME}": sha},
+    }
+    pull_refs = os.getenv("PULL_REFS", "")
+    if pull_refs:
+        started["pull"] = pull_refs
+    bucket, path = split_uri(output_dir)
+    return store.upload_from_string(
+        bucket, os.path.join(path, "started.json"), json.dumps(started)
+    )
+
+
+def create_finished(store, output_dir: str, success: bool) -> str:
+    """Write finished.json with SUCCESS/FAILURE (prow.py:119-149)."""
+    finished = {
+        "timestamp": int(time.time()),
+        "result": "SUCCESS" if success else "FAILURE",
+        "metadata": {},
+    }
+    bucket, path = split_uri(output_dir)
+    return store.upload_from_string(
+        bucket, os.path.join(path, "finished.json"), json.dumps(finished)
+    )
+
+
+def create_symlink(store, symlink: str, output: str) -> str:
+    """Write the symlink file pointing at the output dir (prow.py:152-167)."""
+    bucket, path = split_uri(symlink)
+    return store.upload_from_string(bucket, path, output)
+
+
+def upload_outputs(store, output_dir: str, build_log: str) -> None:
+    """Upload the build log as build-log.txt (prow.py:170-180)."""
+    bucket, path = split_uri(output_dir)
+    if not os.path.exists(build_log):
+        log.error("File %s doesn't exist.", build_log)
+        return
+    store.upload_from_filename(bucket, os.path.join(path, "build-log.txt"), build_log)
+
+
+def get_commit_from_env() -> str:
+    """Presubmits test PULL_PULL_SHA, postsubmits PULL_BASE_SHA
+    (prow.py:183-195)."""
+    if os.getenv("PULL_NUMBER", ""):
+        return os.getenv("PULL_PULL_SHA", "")
+    return os.getenv("PULL_BASE_SHA", "")
+
+
+def create_latest(store, job_name: str, sha: str) -> str:
+    """Record the latest passing postsubmit (prow.py:198-215)."""
+    data = {"status": "passing", "job": job_name, "sha": sha}
+    return store.upload_from_string(
+        RESULTS_BUCKET,
+        os.path.join(job_name, "latest_green.json"),
+        json.dumps(data),
+    )
+
+
+def check_no_errors(store, artifacts_dir: str, junit_files: list[str]) -> bool:
+    """All expected junit files exist, none has failures, and no extra junit
+    files ran (prow.py:224-262)."""
+    bucket, prefix = split_uri(artifacts_dir)
+    no_errors = True
+
+    actual_junit = {
+        os.path.basename(p)
+        for p in store.list(bucket, os.path.join(prefix, "junit"))
+    }
+    for f in junit_files:
+        full = os.path.join(prefix, f)
+        log.info("Checking %s", full)
+        if not store.exists(bucket, full):
+            log.error("Missing %s", full)
+            no_errors = False
+            continue
+        if junit.get_num_failures(store.download_as_string(bucket, full)) > 0:
+            log.info("Test failures in %s", full)
+            no_errors = False
+
+    extra = actual_junit - set(junit_files)
+    if extra:
+        log.error("Extra junit files found: %s", ",".join(sorted(extra)))
+        no_errors = False
+    return no_errors
+
+
+def finalize_prow_job(store, junit_files: list[str]) -> bool:
+    """Determine job status from junit files and write finished.json
+    (prow.py:266-279)."""
+    output_dir = get_output_dir()
+    artifacts_dir = os.path.join(output_dir, "artifacts")
+    no_errors = check_no_errors(store, artifacts_dir, junit_files)
+    create_finished(store, output_dir, no_errors)
+    return no_errors
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="Steps related to prow.")
+    sub = parser.add_subparsers(dest="command", required=True)
+    fin = sub.add_parser("finalize_job", help="Finalize the prow job.")
+    fin.add_argument(
+        "--junit_files",
+        default="",
+        help="Comma separated list of expected junit file names.",
+    )
+    fin.add_argument(
+        "--artifacts_root",
+        default=os.getenv("ARTIFACTS_ROOT", "/tmp/k8s_tpu_artifacts"),
+        help="Local artifact store root.",
+    )
+    args = parser.parse_args(argv)
+    store = LocalArtifactStore(args.artifacts_root)
+    ok = finalize_prow_job(store, [f for f in args.junit_files.split(",") if f])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,223 @@
+"""E2E test runner (reference: py/test_runner.py:147-366).
+
+Deploys a parameterized TFJob component, waits for completion, verifies
+pod/service creation **events** against the expected replica counts (events
+are load-bearing API — SURVEY.md §5), then deletes and repeats for
+``num_trials`` trials to prove delete+recreate with the same name works.
+Emits junit XML.
+
+The ksonnet deployment step (``ks env add``/``param set``/``apply``,
+test_runner.py:239-276) becomes a pure component function
+(k8s_tpu.e2e.components).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import re
+import time
+
+from k8s_tpu.client import errors
+from k8s_tpu.harness import junit, tf_job_client
+from k8s_tpu.harness.util import TimeoutError, wait_for
+
+log = logging.getLogger(__name__)
+
+# Same pattern the reference greps events with (test_runner.py:193).
+CREATED_RE = re.compile(r"Created.*(pod|Service).*: (.*)", re.IGNORECASE)
+
+
+def get_events(clientset, namespace: str, uid: str) -> list[dict]:
+    """Events whose involvedObject matches ``uid``
+    (test_runner.py:147-181)."""
+    events = clientset.events(namespace).list()
+    return [
+        e for e in events
+        if (e.get("involvedObject") or {}).get("uid") == uid
+    ]
+
+
+def parse_events(events: list[dict]) -> tuple[set, set]:
+    """→ (pods_created, services_created) name sets
+    (test_runner.py:184-211)."""
+    pods, services = set(), set()
+    for e in events:
+        m = CREATED_RE.match(e.get("message") or "")
+        if not m:
+            continue
+        kind, name = m.group(1).lower(), m.group(2)
+        if kind == "pod":
+            pods.add(name)
+        elif kind == "service":
+            services.add(name)
+    return pods, services
+
+
+def get_labels(name: str, runtime_id: str | None) -> dict:
+    """Selector labels for a job's pods (test_runner.py:129-137)."""
+    labels = {"tf_job_name": name}
+    if runtime_id:
+        labels["runtime_id"] = runtime_id
+    return labels
+
+
+def to_selector(labels: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels.items())
+
+
+def wait_for_delete(
+    clientset,
+    namespace: str,
+    name: str,
+    version: str = "v1alpha1",
+    timeout: datetime.timedelta = datetime.timedelta(minutes=2),
+    polling_interval: datetime.timedelta = datetime.timedelta(milliseconds=100),
+    status_callback=None,
+) -> None:
+    """Poll until the TFJob is gone (py/test_runner.py:22-44)."""
+    client = clientset.tfjobs_unstructured(
+        namespace, version if "/" in version else f"kubeflow.org/{version}"
+    )
+
+    def gone():
+        try:
+            obj = client.get(name)
+        except errors.ApiError as e:
+            if errors.is_not_found(e):
+                return True
+            raise
+        if status_callback:
+            status_callback(obj)
+        return False
+
+    wait_for(
+        gone, timeout.total_seconds(), polling_interval.total_seconds(),
+        f"delete of {namespace}/{name}",
+    )
+
+
+def wait_for_pods_to_be_deleted(
+    clientset,
+    namespace: str,
+    pod_labels: dict,
+    timeout: datetime.timedelta = datetime.timedelta(minutes=2),
+    polling_interval: datetime.timedelta = datetime.timedelta(milliseconds=100),
+) -> None:
+    """Poll until no pods match the selector (test_runner.py:118-127)."""
+    wait_for(
+        lambda: not clientset.pods(namespace).list(label_selector=pod_labels),
+        timeout.total_seconds(),
+        polling_interval.total_seconds(),
+        f"pods {pod_labels} to be deleted",
+    )
+
+
+def _expected_replicas(results: dict, version: str) -> int:
+    """Σ replicas over the spec, version-aware (test_runner.py:303-315)."""
+    if version.endswith("v1alpha1"):
+        return sum(
+            r.get("replicas", 0)
+            for r in (results.get("spec") or {}).get("replicaSpecs", [])
+        )
+    return sum(
+        (spec or {}).get("replicas", 1)
+        for spec in ((results.get("spec") or {}).get("tfReplicaSpecs") or {}).values()
+    )
+
+
+def _succeeded(results: dict, version: str) -> bool:
+    """v1alpha1: status.state == Succeeded; v1alpha2: last condition type
+    Succeeded (test_runner.py:283-299)."""
+    status = results.get("status") or {}
+    if version.endswith("v1alpha1"):
+        return (status.get("state") or "").lower() == "succeeded"
+    conditions = status.get("conditions") or []
+    if not conditions:
+        return False
+    return (conditions[-1].get("type") or "").lower() == "succeeded"
+
+
+def run_test(
+    clientset,
+    component: dict,
+    tfjob_version: str = "v1alpha1",
+    num_trials: int = 2,
+    junit_path: str | None = None,
+    store=None,
+    wait_timeout: datetime.timedelta = datetime.timedelta(minutes=2),
+    polling_interval: datetime.timedelta = datetime.timedelta(milliseconds=100),
+) -> junit.TestCase:
+    """The reference's run_test flow (test_runner.py:214-366) against an
+    already-provisioned cluster (LocalCluster or a REST backend)."""
+    name = component["metadata"]["name"]
+    namespace = component["metadata"].get("namespace", "default")
+
+    t = junit.TestCase(class_name="tfjob_test", name=name)
+    start = time.time()
+    try:
+        for trial in range(num_trials):
+            log.info("Trial %s", trial)
+            tf_job_client.create_tf_job(clientset, component, tfjob_version)
+            results = tf_job_client.wait_for_job(
+                clientset, namespace, name, tfjob_version,
+                timeout=wait_timeout, polling_interval=polling_interval,
+                status_callback=tf_job_client.log_status,
+            )
+
+            if not _succeeded(results, tfjob_version):
+                t.failure = (
+                    f"Trial {trial} Job {name} in namespace {namespace} "
+                    f"in status {results.get('status')}"
+                )
+                log.error(t.failure)
+                break
+
+            uid = (results.get("metadata") or {}).get("uid")
+            created_pods, created_services = parse_events(
+                get_events(clientset, namespace, uid)
+            )
+            num_expected = _expected_replicas(results, tfjob_version)
+
+            creation_failures = []
+            if len(created_pods) < num_expected:
+                creation_failures.append(
+                    f"Expected {num_expected} pods to be created but only "
+                    f"got {len(created_pods)} create events."
+                )
+            if len(created_services) < num_expected:
+                creation_failures.append(
+                    f"Expected {num_expected} services to be created but only "
+                    f"got {len(created_services)} create events."
+                )
+            if creation_failures:
+                t.failure = (
+                    f"Trial {trial} Job {name} in namespace {namespace}: "
+                    + ", ".join(creation_failures)
+                )
+                log.error(t.failure)
+                break
+
+            runtime_id = (results.get("spec") or {}).get("RuntimeId")
+            if runtime_id:
+                # v1 cleans up its pods on completion (training.go:387-417)
+                wait_for_pods_to_be_deleted(
+                    clientset, namespace, get_labels(name, runtime_id),
+                    timeout=wait_timeout, polling_interval=polling_interval,
+                )
+            tf_job_client.delete_tf_job(clientset, namespace, name, tfjob_version)
+            wait_for_delete(
+                clientset, namespace, name, tfjob_version,
+                timeout=wait_timeout, polling_interval=polling_interval,
+            )
+    except TimeoutError:
+        t.failure = f"Timeout waiting for {name} in namespace {namespace} to finish."
+        log.exception(t.failure)
+    except Exception as e:  # noqa: BLE001 - any failure marks the test failed
+        log.exception("There was a problem running the job; Exception %s", e)
+        t.failure = f"Exception occured; type {type(e)} message {e}"
+    finally:
+        t.time = time.time() - start
+        if junit_path:
+            junit.create_junit_xml_file([t], junit_path, store)
+    return t

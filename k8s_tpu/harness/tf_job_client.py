@@ -1,0 +1,94 @@
+"""TFJob lifecycle client for the harness (reference: py/tf_job_client.py).
+
+Works on raw dicts against the k8s_tpu clientset (fake or REST backend), the
+way the reference drives CustomObjectsApi.  Keeps the version-aware terminal
+check: v1alpha1 is finished when ``status.phase == Done``
+(tf_job_client.py:146-148), v1alpha2 when ``status.completionTime`` is set
+(tf_job_client.py:149-152).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import time
+
+from k8s_tpu.harness.util import TimeoutError
+
+log = logging.getLogger(__name__)
+
+TF_JOB_GROUP = "kubeflow.org"
+TF_JOB_PLURAL = "tfjobs"
+TF_JOB_KIND = "TFJob"
+
+
+def _api_version(version: str) -> str:
+    return version if "/" in version else f"{TF_JOB_GROUP}/{version}"
+
+
+def create_tf_job(clientset, spec: dict, version: str = "v1alpha1") -> dict:
+    """Create a TFJob from a raw spec dict (tf_job_client.py:21-56)."""
+    namespace = (spec.get("metadata") or {}).get("namespace", "default")
+    created = clientset.tfjobs_unstructured(namespace, _api_version(version)).create(
+        spec
+    )
+    log.info("Created job %s", created["metadata"]["name"])
+    return created
+
+
+def delete_tf_job(
+    clientset, namespace: str, name: str, version: str = "v1alpha1"
+) -> None:
+    """Delete with Foreground propagation so the job lingers until owned
+    resources are gone (tf_job_client.py:58-92)."""
+    log.info("Deleting job %s.%s", namespace, name)
+    clientset.tfjobs_unstructured(namespace, _api_version(version)).delete(
+        name, propagation="Foreground"
+    )
+
+
+def log_status(tf_job: dict) -> None:
+    """Status callback for wait_for_job (tf_job_client.py:96-103)."""
+    log.info(
+        "Job %s in namespace %s; uid=%s; phase=%s, state=%s",
+        (tf_job.get("metadata") or {}).get("name"),
+        (tf_job.get("metadata") or {}).get("namespace"),
+        (tf_job.get("metadata") or {}).get("uid"),
+        (tf_job.get("status") or {}).get("phase"),
+        (tf_job.get("status") or {}).get("state"),
+    )
+
+
+def is_job_finished(tf_job: dict, version: str = "v1alpha1") -> bool:
+    """Version-aware terminal check (tf_job_client.py:144-152)."""
+    status = tf_job.get("status") or {}
+    if version.endswith("v1alpha1"):
+        return status.get("phase") == "Done"
+    return bool(status.get("completionTime"))
+
+
+def wait_for_job(
+    clientset,
+    namespace: str,
+    name: str,
+    version: str = "v1alpha1",
+    timeout: datetime.timedelta = datetime.timedelta(minutes=10),
+    polling_interval: datetime.timedelta = datetime.timedelta(seconds=30),
+    status_callback=None,
+) -> dict:
+    """Poll until the job reaches its terminal state
+    (tf_job_client.py:104-161)."""
+    client = clientset.tfjobs_unstructured(namespace, _api_version(version))
+    end_time = datetime.datetime.now() + timeout
+    while True:
+        results = client.get(name)
+        if results:
+            if status_callback:
+                status_callback(results)
+            if is_job_finished(results, version):
+                return results
+        if datetime.datetime.now() + polling_interval > end_time:
+            raise TimeoutError(
+                f"Timeout waiting for job {name} in namespace {namespace} to finish."
+            )
+        time.sleep(polling_interval.total_seconds())

@@ -1,0 +1,53 @@
+"""Shared harness utilities (reference: py/util.py:39-504).
+
+The reference's GKE/gcloud helpers are replaced by the local/fake cluster
+lifecycle in k8s_tpu.harness.deploy; what remains here is the generic
+subprocess/retry/timeout surface the rest of the harness uses.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import time
+
+log = logging.getLogger(__name__)
+
+
+class TimeoutError(Exception):  # noqa: A001 - mirrors py/util.py TimeoutError
+    """An operation timed out (py/util.py:504)."""
+
+
+def run(command: list[str], cwd: str | None = None, env: dict | None = None) -> None:
+    """Run a command logging it first; raises CalledProcessError on failure
+    (py/util.py:39-60)."""
+    log.info("Running: %s", " ".join(command))
+    subprocess.check_call(command, cwd=cwd, env=env)
+
+
+def run_and_output(
+    command: list[str], cwd: str | None = None, env: dict | None = None
+) -> str:
+    """Run a command and return its combined output (py/util.py:63-87)."""
+    log.info("Running: %s", " ".join(command))
+    return subprocess.check_output(
+        command, cwd=cwd, env=env, stderr=subprocess.STDOUT
+    ).decode()
+
+
+def wait_for(
+    predicate,
+    timeout_s: float,
+    polling_interval_s: float = 1.0,
+    description: str = "condition",
+):
+    """Poll ``predicate`` until it returns a truthy value or the deadline
+    passes (the reference's various wait_for_* loops, e.g. py/util.py:189)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        result = predicate()
+        if result:
+            return result
+        if time.monotonic() + polling_interval_s > deadline:
+            raise TimeoutError(f"Timeout waiting for {description}")
+        time.sleep(polling_interval_s)

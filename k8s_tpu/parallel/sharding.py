@@ -41,13 +41,15 @@ def logical_to_spec(
     rules: dict[str, Optional[str]] | None = None,
     fsdp_axis: str = "fsdp",
     shape: tuple[int, ...] | None = None,
+    fsdp_size: int | None = None,
 ) -> P:
     """Map a tuple of logical axis names to a PartitionSpec.
 
     After applying the rule table, one still-unsharded named dimension is
     additionally sharded over ``fsdp`` (parameter sharding a la ZeRO-3 /
-    FSDP): the largest such dimension when ``shape`` is given (the
-    ``shard_params`` path), else the first.
+    FSDP): with ``shape`` (the ``shard_params`` path) the largest such
+    dimension divisible by ``fsdp_size`` — replicated if none divides —
+    else the first named candidate.
     """
     rules = {**DEFAULT_RULES, **(rules or {})}
     spec: list = [rules.get(a) if a else None for a in logical_axes]
@@ -59,10 +61,16 @@ def logical_to_spec(
         ]
         if candidates:
             if shape is not None and len(shape) == len(logical_axes):
-                best = max(candidates, key=lambda i: shape[i])
+                if fsdp_size:
+                    candidates = [
+                        i for i in candidates
+                        if shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size
+                    ]
+                best = max(candidates, key=lambda i: shape[i], default=None)
             else:
                 best = candidates[0]
-            spec[best] = fsdp_axis
+            if best is not None:
+                spec[best] = fsdp_axis
     return P(*spec)
 
 
@@ -71,9 +79,14 @@ def shard_params(
 ) -> Any:
     """Apply NamedShardings to a parameter pytree given a matching pytree of
     logical-axis tuples."""
+    fsdp_size = dict(mesh.shape).get("fsdp")
+
     def to_sharding(x, axes):
         return NamedSharding(
-            mesh, logical_to_spec(axes, rules, shape=getattr(x, "shape", None))
+            mesh,
+            logical_to_spec(
+                axes, rules, shape=getattr(x, "shape", None), fsdp_size=fsdp_size
+            ),
         )
 
     shardings = jax.tree.map(

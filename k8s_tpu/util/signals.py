@@ -40,6 +40,8 @@ def merge_stop_events(*events: threading.Event, poll: float = 0.2) -> threading.
 
     Used by the operator binaries to merge the process signal handler's stop
     event with the leader elector's per-term stop-work event."""
+    if not events:
+        raise ValueError("merge_stop_events requires at least one event")
     merged = threading.Event()
 
     def wait_any():

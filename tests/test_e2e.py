@@ -1,0 +1,244 @@
+"""End-to-end tests: operator + kubelet simulator + real subprocesses
+(reference flow: py/test_runner.py:214-366, test/e2e/main.go:62-252)."""
+
+from __future__ import annotations
+
+import datetime
+import sys
+import time
+
+from k8s_tpu.e2e.components import core_component, smoke_command
+from k8s_tpu.e2e.kubelet import KubeletSimulator
+from k8s_tpu.e2e.local import LocalCluster
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.fake import FakeCluster
+from k8s_tpu.harness import test_runner, tf_job_client
+
+FAST = dict(
+    timeout=datetime.timedelta(seconds=30),
+    polling_interval=datetime.timedelta(milliseconds=50),
+)
+
+
+def wait_until(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestKubeletSimulator:
+    def _pod(self, name, command):
+        return {
+            "metadata": {"name": name, "labels": {}},
+            "spec": {
+                # one-shot semantics: the K8s default (Always) would
+                # crash-loop the failing pod instead of failing it
+                "restartPolicy": "Never",
+                "containers": [
+                    {
+                        "name": "tensorflow",
+                        "command": command,
+                        "env": [{"name": "E2E_MARK", "value": "yes"}],
+                    }
+                ]
+            },
+        }
+
+    def test_pod_success_and_failure_exit_codes(self):
+        cs = Clientset(FakeCluster())
+        ok_cmd = [sys.executable, "-c", "import os; assert os.environ['E2E_MARK']=='yes'"]
+        bad_cmd = [sys.executable, "-c", "raise SystemExit(3)"]
+        cs.pods("default").create(self._pod("ok-pod", ok_cmd))
+        cs.pods("default").create(self._pod("bad-pod", bad_cmd))
+        kubelet = KubeletSimulator(cs, "default").start()
+        try:
+            assert wait_until(
+                lambda: (cs.pods("default").get("ok-pod").get("status") or {}).get("phase")
+                == "Succeeded"
+            )
+            assert wait_until(
+                lambda: (cs.pods("default").get("bad-pod").get("status") or {}).get("phase")
+                == "Failed"
+            )
+            bad = cs.pods("default").get("bad-pod")
+            [cstat] = bad["status"]["containerStatuses"]
+            assert cstat["state"]["terminated"]["exitCode"] == 3
+        finally:
+            kubelet.stop()
+
+    def test_commandless_pod_uses_default_exit(self):
+        cs = Clientset(FakeCluster())
+        cs.pods("default").create(
+            {"metadata": {"name": "noop"}, "spec": {"containers": [{"name": "tensorflow"}]}}
+        )
+        kubelet = KubeletSimulator(cs, "default", default_exit_code=0).start()
+        try:
+            assert wait_until(
+                lambda: (cs.pods("default").get("noop").get("status") or {}).get("phase")
+                == "Succeeded"
+            )
+        finally:
+            kubelet.stop()
+
+
+class TestLocalClusterV1alpha1:
+    def test_job_lifecycle_with_real_subprocesses(self, tmp_path):
+        params = {
+            "name": "e2e-smoke",
+            "num_masters": 1,
+            "num_workers": 1,
+            "num_ps": 1,
+            "command": smoke_command(),
+        }
+        component = core_component(params, "v1alpha1")
+        junit_path = str(tmp_path / "junit_e2e.xml")
+        with LocalCluster(version="v1alpha1") as cluster:
+            case = test_runner.run_test(
+                cluster.clientset, component, "v1alpha1",
+                num_trials=2, junit_path=junit_path,
+                wait_timeout=datetime.timedelta(seconds=60),
+                polling_interval=datetime.timedelta(milliseconds=50),
+            )
+        assert case.failure is None, case.failure
+        from k8s_tpu.harness import get_num_failures
+
+        with open(junit_path) as f:
+            assert get_num_failures(f.read()) == 0
+
+    def test_failing_workload_fails_job(self):
+        params = {
+            "name": "e2e-fail",
+            "num_masters": 1,
+            "num_workers": 0,
+            "num_ps": 0,
+            "command": [sys.executable, "-c", "raise SystemExit(1)"],
+        }
+        component = core_component(params, "v1alpha1")
+        with LocalCluster(version="v1alpha1") as cluster:
+            tf_job_client.create_tf_job(cluster.clientset, component, "v1alpha1")
+            result = tf_job_client.wait_for_job(
+                cluster.clientset, "default", "e2e-fail", "v1alpha1", **FAST
+            )
+        assert result["status"]["state"] == "Failed"
+
+
+class TestLocalClusterV1alpha2:
+    def test_job_reaches_succeeded_condition(self):
+        params = {
+            "name": "e2e-v2",
+            "num_masters": 1,
+            "num_workers": 2,
+            "num_ps": 0,
+            "command": smoke_command(),
+        }
+        component = core_component(params, "v1alpha2")
+        with LocalCluster(version="v1alpha2") as cluster:
+            tf_job_client.create_tf_job(cluster.clientset, component, "v1alpha2")
+            result = tf_job_client.wait_for_job(
+                cluster.clientset, "default", "e2e-v2", "v1alpha2", **FAST
+            )
+        conditions = result["status"]["conditions"]
+        assert any(
+            c["type"] == "Succeeded" and c["status"] == "True" for c in conditions
+        ), conditions
+        assert result["status"]["completionTime"]
+
+
+class TestTapBinary:
+    def test_tap_output_local(self, capsys):
+        from k8s_tpu.e2e.main import main
+
+        rc = main(["--num_jobs", "2", "--timeout_s", "60"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "1..2" in out
+        assert out.count("ok ") >= 2 and "not ok" not in out
+
+
+class TestKubeletRestartPolicy:
+    def test_on_failure_restarts_until_success(self, tmp_path):
+        # First run fails, second succeeds (marker file): pod must stay
+        # Running across the crash (exit in lastState) and end Succeeded.
+        marker = tmp_path / "ran_once"
+        script = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close(); sys.exit(143)\n"
+            "sys.exit(0)\n"
+        )
+        cs = Clientset(FakeCluster())
+        cs.pods("default").create(
+            {
+                "metadata": {"name": "flaky"},
+                "spec": {
+                    "restartPolicy": "OnFailure",
+                    "containers": [
+                        {"name": "tensorflow", "command": [sys.executable, "-c", script]}
+                    ],
+                },
+            }
+        )
+        kubelet = KubeletSimulator(cs, "default", restart_backoff_s=0.05).start()
+        try:
+            assert wait_until(
+                lambda: (cs.pods("default").get("flaky").get("status") or {}).get("phase")
+                == "Succeeded",
+                timeout=15,
+            )
+            [cstat] = cs.pods("default").get("flaky")["status"]["containerStatuses"]
+            assert cstat["state"]["terminated"]["exitCode"] == 0
+        finally:
+            kubelet.stop()
+
+    def test_restart_policy_never_fails_terminally(self):
+        cs = Clientset(FakeCluster())
+        cs.pods("default").create(
+            {
+                "metadata": {"name": "oneshot"},
+                "spec": {
+                    "restartPolicy": "Never",
+                    "containers": [
+                        {"name": "tensorflow",
+                         "command": [sys.executable, "-c", "raise SystemExit(5)"]}
+                    ],
+                },
+            }
+        )
+        kubelet = KubeletSimulator(cs, "default").start()
+        try:
+            assert wait_until(
+                lambda: (cs.pods("default").get("oneshot").get("status") or {}).get("phase")
+                == "Failed"
+            )
+        finally:
+            kubelet.stop()
+
+    def test_max_restarts_cap(self):
+        cs = Clientset(FakeCluster())
+        cs.pods("default").create(
+            {
+                "metadata": {"name": "crashloop"},
+                "spec": {
+                    "restartPolicy": "OnFailure",
+                    "containers": [
+                        {"name": "tensorflow",
+                         "command": [sys.executable, "-c", "raise SystemExit(7)"]}
+                    ],
+                },
+            }
+        )
+        kubelet = KubeletSimulator(
+            cs, "default", restart_backoff_s=0.02, max_restarts=2
+        ).start()
+        try:
+            assert wait_until(
+                lambda: (cs.pods("default").get("crashloop").get("status") or {}).get("phase")
+                == "Failed",
+                timeout=15,
+            )
+        finally:
+            kubelet.stop()

@@ -1,0 +1,305 @@
+"""Harness tests (reference: py/prow_test.py, py/test_util_test.py,
+py/util_test.py)."""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import threading
+import time
+from xml.etree import ElementTree
+
+import pytest
+
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.fake import FakeCluster
+from k8s_tpu.harness import (
+    LocalArtifactStore,
+    TestCase,
+    TestSuite,
+    TimeoutError,
+    create_junit_xml_file,
+    create_xml,
+    get_num_failures,
+    prow,
+    split_uri,
+    tf_job_client,
+    wrap_test,
+)
+
+
+class TestJunit:
+    def test_write_xml(self, tmp_path):
+        success = TestCase("some_test", "first")
+        success.time = 10
+        failure = TestCase("some_test", "second")
+        failure.time = 10
+        failure.failure = "failed for some reason."
+        not_run = TestCase("some_test", "third")
+
+        out = tmp_path / "sub" / "junit_ok.xml"
+        create_junit_xml_file([success, failure, not_run], str(out))
+        root = ElementTree.parse(str(out)).getroot()
+        assert root.tag == "testsuite"
+        assert root.attrib["tests"] == "3"
+        # failure + not-run both count (test_util.py:131-133 contract made
+        # consistent: the suite attribute matches the <failure> elements)
+        assert root.attrib["failures"] == "2"
+        cases = root.findall("testcase")
+        assert [c.attrib["name"] for c in cases] == ["first", "second", "third"]
+        assert cases[2].find("failure").text == "Test was not run."
+
+    def test_get_num_failures(self):
+        c = TestCase("suite", "t")
+        c.time = 1
+        c.failure = "boom"
+        xml = ElementTree.tostring(create_xml([c]).getroot())
+        assert get_num_failures(xml) == 1
+
+        ok = TestCase("suite", "t")
+        ok.time = 1
+        xml = ElementTree.tostring(create_xml([ok]).getroot())
+        assert get_num_failures(xml) == 0
+
+    def test_suite_unique_names(self):
+        suite = TestSuite("cls")
+        suite.create("a")
+        with pytest.raises(ValueError):
+            suite.create("a")
+        assert suite.get("a").class_name == "cls"
+        with pytest.raises(KeyError):
+            suite.get("missing")
+
+    def test_wrap_test_records_time_and_failure(self):
+        case = TestCase("cls", "t")
+
+        def boom():
+            raise RuntimeError("exploded")
+
+        with pytest.raises(RuntimeError):
+            wrap_test(boom, case)
+        assert case.time is not None
+        assert "exploded" in case.failure
+
+        ok_case = TestCase("cls", "t2")
+        wrap_test(lambda: None, ok_case)
+        assert ok_case.failure is None
+        assert ok_case.time is not None
+
+    def test_write_to_store_uri(self, tmp_path):
+        store = LocalArtifactStore(str(tmp_path))
+        c = TestCase("cls", "t")
+        c.time = 1
+        create_junit_xml_file([c], "store://bucket/artifacts/junit_x.xml", store)
+        assert get_num_failures(
+            store.download_as_string("bucket", "artifacts/junit_x.xml")
+        ) == 0
+
+
+class TestArtifacts:
+    def test_split_uri(self):
+        assert split_uri("store://bucket/a/b.txt") == ("bucket", "a/b.txt")
+        with pytest.raises(ValueError):
+            split_uri("/plain/path")
+
+    def test_roundtrip_and_list(self, tmp_path):
+        store = LocalArtifactStore(str(tmp_path))
+        store.upload_from_string("b", "artifacts/junit_1.xml", "x")
+        store.upload_from_string("b", "artifacts/junit_2.xml", "y")
+        store.upload_from_string("b", "artifacts/other.txt", "z")
+        assert store.exists("b", "artifacts/junit_1.xml")
+        assert not store.exists("b", "artifacts/junit_9.xml")
+        assert store.download_as_string("b", "artifacts/junit_2.xml") == "y"
+        assert sorted(store.list("b", "artifacts/junit")) == [
+            "artifacts/junit_1.xml",
+            "artifacts/junit_2.xml",
+        ]
+
+
+class TestProw:
+    def test_create_finished(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(time, "time", lambda: 1000)
+        store = LocalArtifactStore(str(tmp_path))
+        prow.create_finished(store, "store://bucket/output", True)
+        data = json.loads(store.download_as_string("bucket", "output/finished.json"))
+        assert data == {"timestamp": 1000, "result": "SUCCESS", "metadata": {}}
+
+    def test_create_started_periodic(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(time, "time", lambda: 1000)
+        monkeypatch.delenv("PULL_REFS", raising=False)
+        store = LocalArtifactStore(str(tmp_path))
+        prow.create_started(store, "store://bucket/output", "abcd")
+        data = json.loads(store.download_as_string("bucket", "output/started.json"))
+        assert data == {
+            "timestamp": 1000,
+            "repos": {f"{prow.REPO_OWNER}/{prow.REPO_NAME}": "abcd"},
+        }
+
+    def test_output_dir_layouts(self, monkeypatch):
+        monkeypatch.setenv("JOB_NAME", "tpu-presubmit")
+        monkeypatch.setenv("BUILD_NUMBER", "20")
+        monkeypatch.setenv("PULL_NUMBER", "10")
+        assert prow.get_output_dir().endswith(
+            f"pr-logs/pull/{prow.REPO_OWNER}_{prow.REPO_NAME}/10/tpu-presubmit/20"
+        )
+        monkeypatch.delenv("PULL_NUMBER")
+        monkeypatch.setenv("REPO_OWNER", "someone")
+        assert prow.get_output_dir().endswith(
+            f"logs/{prow.REPO_OWNER}_{prow.REPO_NAME}/tpu-presubmit/20"
+        )
+        monkeypatch.delenv("REPO_OWNER")
+        assert prow.get_output_dir().endswith("logs/tpu-presubmit/20")
+
+    def test_get_symlink_output(self):
+        assert prow.get_symlink_output("10", "mlkube-build-presubmit", "20").endswith(
+            "pr-logs/directory/mlkube-build-presubmit/20.txt"
+        )
+        assert prow.get_symlink_output("", "j", "20") == ""
+
+    def test_create_symlink(self, tmp_path):
+        store = LocalArtifactStore(str(tmp_path))
+        prow.create_symlink(store, "store://bucket/symlink.txt", "store://bucket/output")
+        assert store.download_as_string("bucket", "symlink.txt") == "store://bucket/output"
+
+    def test_commit_from_env(self, monkeypatch):
+        monkeypatch.setenv("PULL_NUMBER", "7")
+        monkeypatch.setenv("PULL_PULL_SHA", "presub")
+        monkeypatch.setenv("PULL_BASE_SHA", "postsub")
+        assert prow.get_commit_from_env() == "presub"
+        monkeypatch.setenv("PULL_NUMBER", "")
+        assert prow.get_commit_from_env() == "postsub"
+
+    def _write_junit(self, store, path, failures: int):
+        c = TestCase("cls", "t")
+        c.time = 1
+        if failures:
+            c.failure = "boom"
+        xml = ElementTree.tostring(create_xml([c]).getroot(), encoding="unicode")
+        store.upload_from_string("bucket", path, xml)
+
+    def test_check_no_errors_success(self, tmp_path):
+        store = LocalArtifactStore(str(tmp_path))
+        self._write_junit(store, "dir/junit_1.xml", 0)
+        assert prow.check_no_errors(store, "store://bucket/dir", ["junit_1.xml"])
+
+    def test_check_no_errors_failure(self, tmp_path):
+        store = LocalArtifactStore(str(tmp_path))
+        self._write_junit(store, "dir/junit_1.xml", 1)
+        assert not prow.check_no_errors(store, "store://bucket/dir", ["junit_1.xml"])
+
+    def test_check_no_errors_missing(self, tmp_path):
+        store = LocalArtifactStore(str(tmp_path))
+        assert not prow.check_no_errors(store, "store://bucket/dir", ["junit_1.xml"])
+
+    def test_check_no_errors_extra_junit(self, tmp_path):
+        store = LocalArtifactStore(str(tmp_path))
+        self._write_junit(store, "dir/junit_0.xml", 0)
+        self._write_junit(store, "dir/junit_1.xml", 0)
+        assert not prow.check_no_errors(store, "store://bucket/dir", ["junit_1.xml"])
+
+    def test_finalize_prow_job(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JOB_NAME", "periodic-x")
+        monkeypatch.setenv("BUILD_NUMBER", "3")
+        monkeypatch.delenv("PULL_NUMBER", raising=False)
+        monkeypatch.delenv("REPO_OWNER", raising=False)
+        store = LocalArtifactStore(str(tmp_path))
+        self._write_junit(store, "logs/periodic-x/3/artifacts/junit_1.xml", 0)
+        # Fix the bucket: get_output_dir uses LOGS_BUCKET
+        monkeypatch.setattr(prow, "LOGS_BUCKET", "bucket")
+        assert prow.finalize_prow_job(store, ["junit_1.xml"])
+        finished = json.loads(
+            store.download_as_string("bucket", "logs/periodic-x/3/finished.json")
+        )
+        assert finished["result"] == "SUCCESS"
+
+
+class TestTFJobClient:
+    def _clientset(self):
+        return Clientset(FakeCluster())
+
+    def _job(self, name="e2e-job", version="v1alpha1"):
+        return {
+            "apiVersion": f"kubeflow.org/{version}",
+            "kind": "TFJob",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {},
+        }
+
+    def test_create_and_delete(self):
+        cs = self._clientset()
+        created = tf_job_client.create_tf_job(cs, self._job())
+        assert created["metadata"]["name"] == "e2e-job"
+        tf_job_client.delete_tf_job(cs, "default", "e2e-job")
+        assert cs.tfjobs_unstructured("default", "kubeflow.org/v1alpha1").list() == []
+
+    def test_wait_for_job_v1alpha1_phase_done(self):
+        cs = self._clientset()
+        tf_job_client.create_tf_job(cs, self._job())
+        client = cs.tfjobs_unstructured("default", "kubeflow.org/v1alpha1")
+
+        def finish():
+            time.sleep(0.1)
+            obj = client.get("e2e-job")
+            obj["status"] = {"phase": "Done", "state": "Succeeded"}
+            client.update(obj)
+
+        threading.Thread(target=finish).start()
+        seen = []
+        result = tf_job_client.wait_for_job(
+            cs, "default", "e2e-job",
+            timeout=datetime.timedelta(seconds=5),
+            polling_interval=datetime.timedelta(milliseconds=20),
+            status_callback=lambda j: seen.append(j),
+        )
+        assert result["status"]["phase"] == "Done"
+        assert seen  # callback invoked
+
+    def test_wait_for_job_v1alpha2_completion_time(self):
+        cs = self._clientset()
+        tf_job_client.create_tf_job(cs, self._job(version="v1alpha2"), "v1alpha2")
+        client = cs.tfjobs_unstructured("default", "kubeflow.org/v1alpha2")
+        obj = client.get("e2e-job")
+        obj["status"] = {"completionTime": "2026-07-29T00:00:00Z"}
+        client.update(obj)
+        result = tf_job_client.wait_for_job(
+            cs, "default", "e2e-job", version="v1alpha2",
+            timeout=datetime.timedelta(seconds=2),
+            polling_interval=datetime.timedelta(milliseconds=20),
+        )
+        assert result["status"]["completionTime"]
+
+    def test_wait_for_job_timeout(self):
+        cs = self._clientset()
+        tf_job_client.create_tf_job(cs, self._job())
+        with pytest.raises(TimeoutError):
+            tf_job_client.wait_for_job(
+                cs, "default", "e2e-job",
+                timeout=datetime.timedelta(milliseconds=80),
+                polling_interval=datetime.timedelta(milliseconds=20),
+            )
+
+
+class TestJunitZeroTime:
+    def test_zero_duration_pass_is_not_a_failure(self):
+        c = TestCase("cls", "fast")
+        c.time = 0.0  # measured, but clock resolution rounded to zero
+        xml = ElementTree.tostring(create_xml([c]).getroot())
+        assert get_num_failures(xml) == 0
+
+
+class TestMergeStopEvents:
+    def test_zero_events_raises(self):
+        from k8s_tpu.util.signals import merge_stop_events
+
+        with pytest.raises(ValueError):
+            merge_stop_events()
+
+    def test_any_event_sets_merged(self):
+        from k8s_tpu.util.signals import merge_stop_events
+
+        a, b = threading.Event(), threading.Event()
+        merged = merge_stop_events(a, b, poll=0.01)
+        assert not merged.is_set()
+        b.set()
+        assert merged.wait(2)

@@ -148,3 +148,26 @@ class TestRingAttention:
         out = fn(q, q, q)
         assert out.shape == (B, L, H, D)
         assert not bool(jnp.any(jnp.isnan(out)))
+
+
+class TestFsdpDivisibility:
+    def test_logical_to_spec_prefers_largest_divisible_dim(self):
+        from jax.sharding import PartitionSpec as P
+
+        from k8s_tpu.parallel.sharding import logical_to_spec
+
+        # largest dim (10) not divisible by fsdp=4 -> shard dim 0 (8)
+        spec = logical_to_spec(
+            ("a", "b"), rules={"a": None, "b": None},
+            shape=(8, 10), fsdp_size=4,
+        )
+        assert spec == P("fsdp", None)
+        # nothing divisible -> replicate rather than crash
+        spec = logical_to_spec(
+            ("a", "b"), rules={"a": None, "b": None},
+            shape=(6, 10), fsdp_size=4,
+        )
+        assert spec == P(None, None)
+        # no shape -> legacy first-candidate behavior
+        spec = logical_to_spec(("a", "b"), rules={"a": None, "b": None})
+        assert spec == P("fsdp", None)
