@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Distributed MNIST training — the real-workload e2e example
+(reference: test/e2e/dist-mnist/dist_mnist.py, 2×PS + 4×Worker between-graph
+replication with --sync_replicas).
+
+TPU-native shape: every pod runs THIS program; the operator's injected env
+(JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / ...) bootstraps jax.distributed,
+and the training step is one synchronous SPMD pjit over a dp×fsdp mesh —
+sync_replicas is the only mode (the PS/async world is deleted, SURVEY.md
+§2.4).  Checkpoints go to --train_dir like the reference's train dir, so a
+gang restart (preemption, SIGTERM/143 → retryable) resumes at the last saved
+step instead of step 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+log = logging.getLogger("dist_mnist")
+
+
+def parse_args(argv=None):
+    # flag surface mirrors dist_mnist.py:48-80
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train_steps", type=int, default=200)
+    p.add_argument("--batch_size", type=int, default=64, help="global batch size")
+    p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--train_dir", default=os.environ.get("CHECKPOINT_DIR", ""),
+                   help="checkpoint dir; empty disables checkpointing")
+    p.add_argument("--checkpoint_every", type=int, default=50)
+    p.add_argument("--sync_replicas", action="store_true", default=True,
+                   help="kept for flag compatibility; SPMD is always synchronous")
+    return p.parse_args(argv)
+
+
+CKPT_NAME = "mnist_state.msgpack"
+
+
+def save_checkpoint(train_dir: str, state, step: int) -> None:
+    import flax.serialization
+    import jax
+
+    # single-controller view: gather to host on chief only
+    host_state = jax.device_get(state)
+    payload = flax.serialization.to_bytes(host_state)
+    tmp = os.path.join(train_dir, CKPT_NAME + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, os.path.join(train_dir, CKPT_NAME))
+    log.info("saved checkpoint at step %d", step)
+
+
+def restore_checkpoint(train_dir: str, state):
+    import flax.serialization
+
+    path = os.path.join(train_dir, CKPT_NAME)
+    if not train_dir or not os.path.exists(path):
+        return state, 0
+    with open(path, "rb") as f:
+        restored = flax.serialization.from_bytes(state, f.read())
+    step = int(restored["step"])
+    log.info("restored checkpoint at step %d", step)
+    return restored, step
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = parse_args(argv)
+
+    from k8s_tpu.launcher import bootstrap
+
+    cfg = bootstrap.initialize_distributed()
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_tpu.models import train as train_lib
+    from k8s_tpu.models.mnist import MnistCNN, synthetic_batch
+
+    mesh, _ = bootstrap.make_training_mesh(config=cfg)
+
+    model = MnistCNN()
+    key = jax.random.PRNGKey(0)
+    x0, _ = synthetic_batch(key, args.batch_size)
+    params = model.init(key, x0[:1])["params"]
+    optimizer = train_lib.default_optimizer(args.learning_rate)
+    state = train_lib.init_state(params, optimizer)
+    state, start_step = restore_checkpoint(args.train_dir, state)
+
+    state, shardings = train_lib.shard_train_state(state, mesh)
+    step_fn = train_lib.make_sharded_train_step(
+        lambda p, x: model.apply({"params": p}, x),
+        train_lib.cross_entropy_loss,
+        optimizer,
+        mesh,
+        shardings,
+    )
+
+    loss = None
+    for step in range(start_step, args.train_steps):
+        bx, by = synthetic_batch(jax.random.fold_in(key, step), args.batch_size)
+        state, loss = step_fn(state, (bx, by))
+        if step % 10 == 0 or step == args.train_steps - 1:
+            log.info("step %d loss %.4f", step, float(loss))
+        if (
+            args.train_dir
+            and cfg.is_chief
+            and (step + 1) % args.checkpoint_every == 0
+        ):
+            bootstrap.barrier("pre-checkpoint")
+            save_checkpoint(args.train_dir, state, step + 1)
+
+    if args.train_dir and cfg.is_chief:
+        bootstrap.barrier("final-checkpoint")
+        save_checkpoint(args.train_dir, state, args.train_steps)
+    if loss is not None and not jnp.isfinite(loss):
+        log.error("non-finite loss %s", loss)
+        return 1
+    log.info("training complete at step %d", args.train_steps)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

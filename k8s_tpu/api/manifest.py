@@ -1,0 +1,57 @@
+"""Manifest loading: YAML documents -> typed, defaulted, validated TFJobs.
+
+The reference has no loader of its own — `kubectl create -f examples/tf_job.yaml`
+feeds the apiserver, which defaults via the scheme (zz_generated.defaults.go)
+and rejects on the CRD's openAPIV3Schema (examples/crd/crd-v1alpha2.yaml).
+Here the same pipeline is a library function so the dashboard deploy handler
+(dashboard/backend/handler/api_handler.go:117-266 analogue), the e2e harness,
+and tests all share one ingest path.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterator
+
+import yaml
+
+from k8s_tpu.api import register, v1alpha1, v1alpha2, validation
+
+
+def load_yaml_documents(text: str) -> Iterator[dict]:
+    """Yield the non-empty YAML documents in ``text`` (--- separated)."""
+    for doc in yaml.safe_load_all(io.StringIO(text)):
+        if doc:
+            yield doc
+
+
+def load_tfjob(doc: dict, default: bool = True, validate: bool = True):
+    """Unstructured dict -> typed TFJob for its apiVersion, optionally
+    defaulted (scheme dispatch, register.py) and validated
+    (pkg/apis/tensorflow/validation/validation.go analogue)."""
+    kind = doc.get("kind")
+    if kind != "TFJob":
+        raise ValueError(f"expected kind TFJob, got {kind!r}")
+    job = register.tfjob_from_unstructured(doc)
+    if default:
+        register.default_tfjob(job)
+    if validate:
+        if job.api_version == v1alpha1.CRD_API_VERSION:
+            validation.validate_v1alpha1_tfjob_spec(job.spec)
+        elif job.api_version == v1alpha2.CRD_API_VERSION:
+            validation.validate_v1alpha2_tfjob_spec(job.spec)
+        else:
+            raise ValueError(f"unvalidatable apiVersion {job.api_version!r}")
+    return job
+
+
+def load_tfjobs_from_file(path: str, default: bool = True, validate: bool = True) -> list:
+    """Load every TFJob document from a manifest file; non-TFJob documents
+    (e.g. the CRD itself) are skipped, matching kubectl's multi-doc apply."""
+    with open(path) as f:
+        text = f.read()
+    jobs = []
+    for doc in load_yaml_documents(text):
+        if doc.get("kind") == "TFJob":
+            jobs.append(load_tfjob(doc, default=default, validate=validate))
+    return jobs

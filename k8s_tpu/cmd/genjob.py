@@ -1,0 +1,149 @@
+"""TFJob load generator (reference: hack/genjob/genjob.go:30-120).
+
+Fabricates N TFJobs for scale/scheduler testing — worker-only jobs by
+default, master+GPU jobs with ``--use-gpu``, TPU gang jobs with ``--use-tpu``
+(the rebuild's own axis), all optionally pinned to a custom scheduler.  With
+``--dump`` the manifests go to stdout for kubectl; otherwise they're created
+through the clientset against the configured cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+
+import yaml
+
+log = logging.getLogger(__name__)
+
+
+def tfjob_template(
+    job_name: str,
+    namespace: str = "default",
+    gpu: bool = False,
+    tpu: bool = False,
+    scheduler_name: str = "default",
+) -> dict:
+    """One synthetic job (genjob.go:46-91): 1 WORKER, or 1 MASTER+GPU, or a
+    4-host TPU gang."""
+    if tpu:
+        return {
+            "apiVersion": "kubeflow.org/v1alpha2",
+            "kind": "TFJob",
+            "metadata": {"name": job_name, "namespace": namespace},
+            "spec": {
+                "tpu": {"acceleratorType": "v5litepod-16", "topology": "4x4"},
+                "tfReplicaSpecs": {
+                    "TPU": {
+                        "replicas": 4,
+                        "restartPolicy": "ExitCode",
+                        "template": {
+                            "spec": {
+                                "schedulerName": scheduler_name,
+                                "containers": [
+                                    {
+                                        "name": "tensorflow",
+                                        "image": "k8s-tpu/smoke:latest",
+                                        "resources": {
+                                            "limits": {"cloud-tpus.google.com/v5e": 4}
+                                        },
+                                    }
+                                ],
+                            }
+                        },
+                    }
+                },
+            },
+        }
+    replica = {
+        "replicas": 1,
+        "tfReplicaType": "MASTER" if gpu else "WORKER",
+        "template": {
+            "spec": {
+                "schedulerName": scheduler_name,
+                "containers": [
+                    {
+                        "name": "tensorflow",
+                        "image": "k8s-tpu/smoke-gpu:latest" if gpu else "k8s-tpu/smoke:latest",
+                    }
+                ],
+                "restartPolicy": "OnFailure",
+            }
+        },
+    }
+    if gpu:
+        replica["template"]["spec"]["containers"][0]["resources"] = {
+            "limits": {"nvidia.com/gpu": 1}
+        }
+    job = {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "TFJob",
+        "metadata": {"name": job_name, "namespace": namespace},
+        "spec": {"replicaSpecs": [replica], "schedulerName": scheduler_name},
+    }
+    # genjob.go:83-88 sets the chief only for GPU (MASTER) jobs; a worker-only
+    # job there fails the operator's chief validation.  SPMD makes worker-0
+    # the natural chief, so declare it and keep every generated job valid.
+    job["spec"]["terminationPolicy"] = {
+        "chief": {"replicaName": "MASTER" if gpu else "WORKER"}
+    }
+    return job
+
+
+def generate(
+    n: int,
+    namespace: str = "default",
+    gpu: bool = False,
+    tpu: bool = False,
+    scheduler_name: str = "default",
+    timestamp: int | None = None,
+) -> list[dict]:
+    """N uniquely-named jobs, ``tfjob-<ts>-<i>`` (genjob.go:111-114)."""
+    ts = timestamp if timestamp is not None else time.time_ns() % 10**9
+    return [
+        tfjob_template(f"tfjob-{ts}-{i}", namespace, gpu, tpu, scheduler_name)
+        for i in range(n)
+    ]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nr-tfjobs", type=int, default=1)
+    parser.add_argument("--use-gpu", action="store_true")
+    parser.add_argument("--use-tpu", action="store_true")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--scheduler-name", default="default")
+    parser.add_argument(
+        "--dump", action="store_true", help="print manifests instead of creating"
+    )
+    parser.add_argument("--kube-config-path", default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    jobs = generate(
+        args.nr_tfjobs,
+        namespace=args.namespace,
+        gpu=args.use_gpu,
+        tpu=args.use_tpu,
+        scheduler_name=args.scheduler_name,
+    )
+    if args.dump:
+        yaml.safe_dump_all(jobs, sys.stdout)
+        return 0
+
+    from k8s_tpu.client.clientset import Clientset
+    from k8s_tpu.client.rest import RestClient, kubeconfig_config
+
+    clientset = Clientset(RestClient(kubeconfig_config(args.kube_config_path)))
+    for job in jobs:
+        created = clientset.tfjobs_unstructured(
+            args.namespace, api_version=job["apiVersion"]
+        ).create(job)
+        log.info("created TFJob %s", created["metadata"]["name"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

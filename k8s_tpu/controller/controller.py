@@ -18,6 +18,7 @@ from k8s_tpu.client.gvr import TFJOBS_V1ALPHA1
 from k8s_tpu.client.informer import SharedInformerFactory, split_meta_namespace_key
 from k8s_tpu.client.record import EventRecorder
 from k8s_tpu.controller.trainer.training import TrainingJob
+from k8s_tpu.util import metrics
 from k8s_tpu.util.workqueue import RateLimitingQueue
 
 log = logging.getLogger(__name__)
@@ -39,6 +40,7 @@ class Controller:
         self.enable_gang_scheduling = enable_gang_scheduling
         self.recorder = recorder or EventRecorder(clientset, CONTROLLER_NAME)
         self.queue = RateLimitingQueue()
+        self.metrics = metrics.controller_metrics("v1")
         self.jobs: dict[str, TrainingJob] = {}  # key -> TrainingJob
         self._jobs_lock = threading.Lock()
 
@@ -111,9 +113,11 @@ class Controller:
             if forget:
                 self.queue.forget(key)
             else:
+                self.metrics["queue_retries"].labels(self.metrics["generation"]).inc()
                 self.queue.add_rate_limited(key)
         except Exception:
             log.exception("error syncing tfjob %s", key)
+            self.metrics["queue_retries"].labels(self.metrics["generation"]).inc()
             self.queue.add_rate_limited(key)
         finally:
             self.queue.done(key)
@@ -124,6 +128,7 @@ class Controller:
     def sync_tfjob(self, key: str) -> bool:
         """controller.go:241-310."""
         start = time.monotonic()
+        result = "success"
         try:
             ns, name = split_meta_namespace_key(key)
             obj = self.tfjob_lister.get(ns, name)
@@ -151,5 +156,12 @@ class Controller:
                 v1alpha1.PHASE_RUNNING,
                 v1alpha1.PHASE_CREATING,
             )
+        except Exception:
+            result = "error"
+            raise
         finally:
-            log.debug("finished syncing %s (%.3fs)", key, time.monotonic() - start)
+            elapsed = time.monotonic() - start
+            gen = self.metrics["generation"]
+            self.metrics["sync_duration"].labels(gen).observe(elapsed)
+            self.metrics["sync_total"].labels(gen, result).inc()
+            log.debug("finished syncing %s (%.3fs)", key, elapsed)

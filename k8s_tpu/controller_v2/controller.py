@@ -32,6 +32,7 @@ from k8s_tpu.controller_v2 import status as status_mod
 from k8s_tpu.controller_v2 import tpu_config
 from k8s_tpu.controller_v2.control import RealPodControl, RealServiceControl
 from k8s_tpu.controller_v2.expectations import ControllerExpectations
+from k8s_tpu.util import metrics
 from k8s_tpu.util.workqueue import RateLimitingQueue
 
 log = logging.getLogger(__name__)
@@ -56,6 +57,7 @@ class TFJobController:
         self.expectations = ControllerExpectations()
         self.enable_gang_scheduling = enable_gang_scheduling
         self.queue = RateLimitingQueue()
+        self.metrics = metrics.controller_metrics("v2")
 
         self.pod_reconciler = pod_mod.PodReconciler(
             self.pod_control, self.expectations, self.recorder
@@ -172,9 +174,11 @@ class TFJobController:
             if forget:
                 self.queue.forget(key)
             else:
+                self.metrics["queue_retries"].labels(self.metrics["generation"]).inc()
                 self.queue.add_rate_limited(key)
         except Exception:
             log.exception("error syncing tfjob %s", key)
+            self.metrics["queue_retries"].labels(self.metrics["generation"]).inc()
             self.queue.add_rate_limited(key)
         finally:
             self.queue.done(key)
@@ -186,6 +190,7 @@ class TFJobController:
         """syncTFJob (controller.go:336-373): returns True when the job was
         synced to completion of its expectations."""
         start = time.monotonic()
+        result = "success"
         try:
             ns, name = split_meta_namespace_key(key)
             obj = self.tfjob_lister.get(ns, name)
@@ -218,8 +223,15 @@ class TFJobController:
 
             self.reconcile_tfjobs(tfjob)
             return True
+        except Exception:
+            result = "error"
+            raise
         finally:
-            log.debug("finished syncing %s (%.3fs)", key, time.monotonic() - start)
+            elapsed = time.monotonic() - start
+            gen = self.metrics["generation"]
+            self.metrics["sync_duration"].labels(gen).observe(elapsed)
+            self.metrics["sync_total"].labels(gen, result).inc()
+            log.debug("finished syncing %s (%.3fs)", key, elapsed)
 
     def satisfied_expectations(self, tfjob) -> bool:
         """All replica types' pod AND service expectations must be satisfied.

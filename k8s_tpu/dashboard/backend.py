@@ -82,7 +82,16 @@ def _make_handler(manager: ClientManager):
         def do_GET(self):  # noqa: N802
             path = self.path.split("?")[0].rstrip("/")
             try:
-                if path in ("", "/tfjobs/ui", "/tfjobs"):
+                if path == "/metrics":
+                    # Prometheus text exposition (filling SURVEY.md §5's
+                    # observability gap; Go operators serve this from
+                    # controller-runtime — here the dashboard process does).
+                    from k8s_tpu.util.metrics import REGISTRY
+
+                    self._send_text(
+                        200, REGISTRY.expose(), "text/plain; version=0.0.4"
+                    )
+                elif path in ("", "/tfjobs/ui", "/tfjobs"):
                     self._serve_ui("index.html")
                 elif path.startswith("/tfjobs/ui/"):
                     self._serve_ui(path[len("/tfjobs/ui/"):] or "index.html")

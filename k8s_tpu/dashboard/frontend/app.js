@@ -1,33 +1,88 @@
-/* Dashboard SPA (reference counterpart: dashboard/frontend/src/components/).
- * Vanilla JS against the /tfjobs/api routes. */
+/* Dashboard SPA (reference counterpart: dashboard/frontend/src/components/ —
+ * JobList/JobDetail/PodList plus the CreateJob form tree:
+ * CreateJob.js, CreateReplicaSpec.js, EnvVarCreator.js, VolumeCreator.js).
+ * Vanilla JS against the /tfjobs/api routes; no build step. */
 
 const api = (p) => fetch(`/tfjobs/api${p}`).then((r) => r.json());
 
-const TEMPLATE = {
-  apiVersion: "kubeflow.org/v1alpha2",
-  kind: "TFJob",
-  metadata: { name: "my-tpu-job", namespace: "default" },
-  spec: {
-    tpu: { acceleratorType: "v5litepod-16", topology: "4x4" },
-    tfReplicaSpecs: {
-      TPU: {
-        replicas: 4,
-        restartPolicy: "ExitCode",
-        template: {
-          spec: {
-            containers: [
-              {
-                name: "tensorflow",
-                image: "ghcr.io/k8s-tpu/jax-tpu:latest",
-                resources: { limits: { "cloud-tpus.google.com/v5e": 4 } },
-              },
-            ],
-          },
-        },
-      },
-    },
-  },
+/* HTML/attribute escaping for every user-controlled value interpolated into
+ * innerHTML (names, images, commands, namespaces). */
+const esc = (s) => String(s ?? "")
+  .replace(/&/g, "&amp;").replace(/</g, "&lt;").replace(/>/g, "&gt;")
+  .replace(/"/g, "&quot;").replace(/'/g, "&#39;");
+
+/* ---------------- create-form state (CreateJob.js state tree) ----------- */
+
+const newReplicaSpec = (overrides = {}) => ({
+  replicaType: "TPU",
+  replicas: 4,
+  image: "ghcr.io/k8s-tpu/jax-tpu:latest",
+  command: "",
+  restartPolicy: "ExitCode",
+  chipsPerHost: 4,
+  ...overrides,
+});
+
+let form;
+const resetForm = () => {
+  form = {
+    name: "my-tpu-job",
+    namespace: currentNamespace() || "default",
+    acceleratorType: "v5litepod-16",
+    topology: "4x4",
+    numSlices: 1,
+    replicaSpecs: [newReplicaSpec()],
+    envVars: [],      // EnvVarCreator.js rows {name, value}
+    volumes: [],      // VolumeCreator.js rows {name, mountPath, hostPath}
+  };
 };
+
+/* Build the TFJob manifest from the form (CreateJob.js handleDeploy).
+ * Throws on duplicate replica types (object keys would silently collapse). */
+function buildManifest(f) {
+  const types = f.replicaSpecs.map((rs) => rs.replicaType);
+  const dup = types.find((t, i) => types.indexOf(t) !== i);
+  if (dup) throw new Error(`duplicate replica spec type: ${dup}`);
+  const env = f.envVars.filter((e) => e.name)
+    .map((e) => ({ name: e.name, value: e.value }));
+  const volumes = f.volumes.filter((v) => v.name);
+  const tfReplicaSpecs = {};
+  for (const rs of f.replicaSpecs) {
+    const container = {
+      name: "tensorflow",
+      image: rs.image,
+    };
+    if (rs.command.trim()) container.command = rs.command.trim().split(/\s+/);
+    if (env.length) container.env = env;
+    if (rs.replicaType === "TPU" && rs.chipsPerHost > 0)
+      container.resources = { limits: { "cloud-tpus.google.com/v5e": Number(rs.chipsPerHost) } };
+    if (volumes.length)
+      container.volumeMounts = volumes.map((v) => ({ name: v.name, mountPath: v.mountPath }));
+    const podSpec = { containers: [container] };
+    if (volumes.length)
+      podSpec.volumes = volumes.map((v) =>
+        v.hostPath ? { name: v.name, hostPath: { path: v.hostPath } }
+                   : { name: v.name, emptyDir: {} });
+    tfReplicaSpecs[rs.replicaType] = {
+      replicas: Number(rs.replicas),
+      restartPolicy: rs.restartPolicy,
+      template: { spec: podSpec },
+    };
+  }
+  const spec = { tfReplicaSpecs };
+  if (Object.keys(tfReplicaSpecs).includes("TPU")) {
+    spec.tpu = { acceleratorType: f.acceleratorType, topology: f.topology };
+    if (Number(f.numSlices) > 1) spec.tpu.numSlices = Number(f.numSlices);
+  }
+  return {
+    apiVersion: "kubeflow.org/v1alpha2",
+    kind: "TFJob",
+    metadata: { name: f.name, namespace: f.namespace },
+    spec,
+  };
+}
+
+/* ---------------- list view (JobList.js / JobSummary.js) ---------------- */
 
 function jobState(job) {
   const st = job.status || {};
@@ -49,36 +104,61 @@ function replicaSummary(job) {
   return "";
 }
 
+function currentNamespace() {
+  const sel = document.getElementById("ns-select");
+  return sel && sel.value ? sel.value : "";
+}
+
+async function loadNamespaces() {
+  const data = await api("/namespaces").catch(() => ({ namespaces: [] }));
+  const names = data.namespaces || [];
+  const sel = document.getElementById("ns-select");
+  const current = sel.value;
+  sel.innerHTML = `<option value="">all namespaces</option>` +
+    names.map((n) => `<option${n === current ? " selected" : ""}>${esc(n)}</option>`).join("");
+}
+
 async function refresh() {
-  const data = await api("/tfjob");
+  const ns = currentNamespace();
+  const data = await api(ns ? `/tfjob/${ns}` : "/tfjob");
   const rows = (data.items || []).map((j) => {
     const m = j.metadata || {};
     const state = jobState(j);
-    return `<tr onclick="showDetail('${m.namespace}','${m.name}')">
-      <td>${m.name}</td><td>${m.namespace}</td>
-      <td>${replicaSummary(j)}</td>
-      <td><span class="state ${state}">${state}</span></td>
-      <td class="muted">${m.creationTimestamp || ""}</td>
-      <td><button class="danger" onclick="event.stopPropagation();deleteJob('${m.namespace}','${m.name}')">delete</button></td>
+    return `<tr onclick="showDetail('${esc(m.namespace)}','${esc(m.name)}')">
+      <td>${esc(m.name)}</td><td>${esc(m.namespace)}</td>
+      <td>${esc(replicaSummary(j))}</td>
+      <td><span class="state ${esc(state)}">${esc(state)}</span></td>
+      <td class="muted">${esc(m.creationTimestamp || "")}</td>
+      <td><button class="danger" onclick="event.stopPropagation();deleteJob('${esc(m.namespace)}','${esc(m.name)}')">delete</button></td>
     </tr>`;
   });
   document.getElementById("jobs").innerHTML =
     rows.join("") || `<tr><td colspan="6" class="muted">no jobs</td></tr>`;
 }
 
+/* ---------------- detail view (JobDetail.js / PodList.js) --------------- */
+
 async function showDetail(ns, name) {
   const data = await api(`/tfjob/${ns}/${name}`);
+  const job = data.tfJob || {};
   document.getElementById("d-name").textContent = `${ns}/${name}`;
-  document.getElementById("d-status").textContent = JSON.stringify(
-    (data.tfJob || {}).status || {}, null, 2);
-  document.getElementById("d-spec").textContent = JSON.stringify(
-    (data.tfJob || {}).spec || {}, null, 2);
+  const tpu = (job.spec || {}).tpu;
+  document.getElementById("d-summary").innerHTML = [
+    `<span class="state ${jobState(job)}">${jobState(job)}</span>`,
+    replicaSummary(job),
+    tpu ? `${tpu.acceleratorType || ""} ${tpu.topology || ""}${
+      tpu.numSlices > 1 ? ` ×${tpu.numSlices} slices` : ""}` : "",
+  ].filter(Boolean).join(" &nbsp; ");
+  document.getElementById("d-status").textContent =
+    JSON.stringify(job.status || {}, null, 2);
+  document.getElementById("d-spec").textContent =
+    JSON.stringify(job.spec || {}, null, 2);
   document.getElementById("d-pods").innerHTML = (data.pods || [])
     .map((p) => {
       const phase = (p.status || {}).phase || "Pending";
-      return `<tr><td>${p.metadata.name}</td>
-        <td><span class="state ${phase}">${phase}</span></td>
-        <td><a onclick="showLogs('${ns}','${p.metadata.name}')">logs</a></td></tr>`;
+      return `<tr><td>${esc(p.metadata.name)}</td>
+        <td><span class="state ${esc(phase)}">${esc(phase)}</span></td>
+        <td><a onclick="showLogs('${esc(ns)}','${esc(p.metadata.name)}')">logs</a></td></tr>`;
     })
     .join("") || `<tr><td colspan="3" class="muted">no pods</td></tr>`;
   document.getElementById("d-logs").style.display = "none";
@@ -97,19 +177,193 @@ async function deleteJob(ns, name) {
   refresh();
 }
 
+/* ---------------- create view ------------------------------------------- */
+
+const REPLICA_TYPES = ["TPU", "Chief", "Worker", "PS", "Eval"];
+const RESTART_POLICIES = ["ExitCode", "OnFailure", "Always", "Never"];
+
+const opt = (vals, sel) =>
+  vals.map((v) => `<option${v === sel ? " selected" : ""}>${v}</option>`).join("");
+
+function renderForm() {
+  const f = form;
+  const rsRows = f.replicaSpecs.map((rs, i) => `
+    <div class="row">
+      <div><label>Type</label>
+        <select onchange="setRS(${i},'replicaType',this.value)">${opt(REPLICA_TYPES, rs.replicaType)}</select></div>
+      <div><label>Replicas</label>
+        <input type="number" min="1" value="${rs.replicas}" style="width:80px"
+               onchange="setRS(${i},'replicas',this.value)"></div>
+      <div style="flex:1"><label>Image</label>
+        <input value="${esc(rs.image)}" style="width:100%" onchange="setRS(${i},'image',this.value)"></div>
+      <div><label>Command (optional)</label>
+        <input value="${esc(rs.command)}" onchange="setRS(${i},'command',this.value)"></div>
+      <div><label>Restart</label>
+        <select onchange="setRS(${i},'restartPolicy',this.value)">${opt(RESTART_POLICIES, rs.restartPolicy)}</select></div>
+      ${rs.replicaType === "TPU" ? `<div><label>Chips/host</label>
+        <input type="number" min="0" value="${rs.chipsPerHost}" style="width:80px"
+               onchange="setRS(${i},'chipsPerHost',this.value)"></div>` : ""}
+      <div><button class="ghost" onclick="form.replicaSpecs.splice(${i},1);renderForm()">✕</button></div>
+    </div>`).join("");
+
+  const envRows = f.envVars.map((e, i) => `
+    <div class="row">
+      <div><label>Name</label><input value="${esc(e.name)}" onchange="form.envVars[${i}].name=this.value"></div>
+      <div style="flex:1"><label>Value</label>
+        <input value="${esc(e.value)}" style="width:100%" onchange="form.envVars[${i}].value=this.value"></div>
+      <div><button class="ghost" onclick="form.envVars.splice(${i},1);renderForm()">✕</button></div>
+    </div>`).join("");
+
+  const volRows = f.volumes.map((v, i) => `
+    <div class="row">
+      <div><label>Name</label><input value="${esc(v.name)}" onchange="form.volumes[${i}].name=this.value"></div>
+      <div><label>Mount path</label>
+        <input value="${esc(v.mountPath)}" onchange="form.volumes[${i}].mountPath=this.value"></div>
+      <div style="flex:1"><label>Host path (empty ⇒ emptyDir)</label>
+        <input value="${esc(v.hostPath)}" style="width:100%" onchange="form.volumes[${i}].hostPath=this.value"></div>
+      <div><button class="ghost" onclick="form.volumes.splice(${i},1);renderForm()">✕</button></div>
+    </div>`).join("");
+
+  document.getElementById("c-form").innerHTML = `
+    <fieldset><legend>Job</legend>
+      <div class="row">
+        <div><label>Name</label><input value="${esc(f.name)}" onchange="form.name=this.value"></div>
+        <div><label>Namespace</label><input value="${esc(f.namespace)}" onchange="form.namespace=this.value"></div>
+      </div>
+    </fieldset>
+    <fieldset><legend>TPU slice</legend>
+      <div class="row">
+        <div><label>Accelerator type</label>
+          <input value="${esc(f.acceleratorType)}" onchange="form.acceleratorType=this.value"></div>
+        <div><label>Topology</label>
+          <input value="${esc(f.topology)}" style="width:90px" onchange="form.topology=this.value"></div>
+        <div><label>Slices</label>
+          <input type="number" min="1" value="${f.numSlices}" style="width:70px"
+                 onchange="form.numSlices=this.value"></div>
+      </div>
+    </fieldset>
+    <fieldset><legend>Replica specs</legend>${rsRows}
+      <button class="ghost" onclick="form.replicaSpecs.push(newReplicaSpec({replicaType:'Worker',chipsPerHost:0}));renderForm()">+ replica spec</button>
+    </fieldset>
+    <fieldset><legend>Environment variables</legend>${envRows}
+      <button class="ghost" onclick="form.envVars.push({name:'',value:''});renderForm()">+ env var</button>
+    </fieldset>
+    <fieldset><legend>Volumes</legend>${volRows}
+      <button class="ghost" onclick="form.volumes.push({name:'',mountPath:'',hostPath:''});renderForm()">+ volume</button>
+    </fieldset>`;
+}
+
+function setRS(i, key, value) {
+  form.replicaSpecs[i][key] = value;
+  if (key === "replicaType") renderForm(); // chips/host visibility
+}
+
+/* Best-effort inverse of buildManifest: manifest -> form state.  Returns
+ * null when the manifest contains anything the form cannot express (so
+ * toggling back never silently drops JSON edits). */
+function manifestToForm(man) {
+  try {
+    const spec = man.spec || {};
+    const tpu = spec.tpu || {};
+    const f = {
+      name: (man.metadata || {}).name || "",
+      namespace: (man.metadata || {}).namespace || "default",
+      acceleratorType: tpu.acceleratorType || "v5litepod-16",
+      topology: tpu.topology || "4x4",
+      numSlices: tpu.numSlices || 1,
+      replicaSpecs: [],
+      envVars: [],
+      volumes: [],
+    };
+    for (const [rtype, rs] of Object.entries(spec.tfReplicaSpecs || {})) {
+      const podSpec = ((rs.template || {}).spec) || {};
+      const c = (podSpec.containers || [])[0] || {};
+      f.replicaSpecs.push(newReplicaSpec({
+        replicaType: rtype,
+        replicas: rs.replicas ?? 1,
+        image: c.image || "",
+        command: (c.command || []).join(" "),
+        restartPolicy: rs.restartPolicy || "ExitCode",
+        chipsPerHost: Number(((c.resources || {}).limits || {})["cloud-tpus.google.com/v5e"] || 0),
+      }));
+      f.envVars = (c.env || []).map((e) => ({ name: e.name, value: e.value ?? "" }));
+      f.volumes = (podSpec.volumes || []).map((v) => ({
+        name: v.name,
+        mountPath: ((c.volumeMounts || []).find((m) => m.name === v.name) || {}).mountPath || "",
+        hostPath: (v.hostPath || {}).path || "",
+      }));
+    }
+    // round-trip check: only accept if the form reproduces the manifest
+    if (JSON.stringify(buildManifest(f)) !== JSON.stringify(man)) return null;
+    return f;
+  } catch (e) {
+    return null;
+  }
+}
+
+let jsonMode = false;
+function toggleJsonMode() {
+  const ta = document.getElementById("c-body");
+  const msg = document.getElementById("c-msg");
+  if (!jsonMode) {
+    try {
+      ta.value = JSON.stringify(buildManifest(form), null, 2);
+    } catch (e) {
+      msg.textContent = e.message;
+      return;
+    }
+  } else {
+    // leaving JSON mode: sync edits back, or refuse rather than drop them
+    let parsed;
+    try {
+      parsed = JSON.parse(ta.value);
+    } catch (e) {
+      msg.textContent = `invalid JSON: ${e.message} — fix it or deploy from JSON mode`;
+      return;
+    }
+    const f = manifestToForm(parsed);
+    if (!f) {
+      msg.textContent =
+        "this JSON uses fields the form cannot represent; staying in JSON mode";
+      return;
+    }
+    form = f;
+    renderForm();
+  }
+  jsonMode = !jsonMode;
+  msg.textContent = "";
+  ta.style.display = jsonMode ? "block" : "none";
+  document.getElementById("c-form").style.display = jsonMode ? "none" : "block";
+  document.getElementById("mode-btn").textContent = jsonMode ? "Edit as form" : "Edit as JSON";
+}
+
 function showCreate() {
-  document.getElementById("c-body").value = JSON.stringify(TEMPLATE, null, 2);
+  resetForm();
+  jsonMode = false;
+  document.getElementById("c-body").style.display = "none";
+  document.getElementById("c-form").style.display = "block";
+  document.getElementById("mode-btn").textContent = "Edit as JSON";
   document.getElementById("c-msg").textContent = "";
+  renderForm();
   show("create");
 }
 
 async function submitJob() {
   let body;
-  try {
-    body = JSON.parse(document.getElementById("c-body").value);
-  } catch (e) {
-    document.getElementById("c-msg").textContent = `invalid JSON: ${e.message}`;
-    return;
+  if (jsonMode) {
+    try {
+      body = JSON.parse(document.getElementById("c-body").value);
+    } catch (e) {
+      document.getElementById("c-msg").textContent = `invalid JSON: ${e.message}`;
+      return;
+    }
+  } else {
+    try {
+      body = buildManifest(form);
+    } catch (e) {
+      document.getElementById("c-msg").textContent = e.message;
+      return;
+    }
   }
   const resp = await fetch("/tfjobs/api/tfjob", {
     method: "POST",
@@ -123,13 +377,15 @@ async function submitJob() {
   }
 }
 
+/* ---------------- router ------------------------------------------------ */
+
 function show(id) {
   for (const s of ["list", "detail", "create"])
     document.getElementById(s).style.display = s === id ? "block" : "none";
 }
 function showList() { show("list"); refresh(); }
 
-showList();
+loadNamespaces().then(showList);
 setInterval(() => {
   if (document.getElementById("list").style.display !== "none") refresh();
 }, 5000);

@@ -1,0 +1,95 @@
+"""Generic image builder (reference: py/build_and_push_image.py:55-176).
+
+Renders a ``Dockerfile.template`` into a build context, computes an image tag
+from the tree's git hash (plus ``-dirty-<ts>`` when the checkout is modified,
+matching build_and_push_image.py's tagging), and runs ``docker build``.  When
+no docker binary is present (this image has none) the build stops after
+writing the context — a dry run that still lets tests assert the full
+context/tag pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import logging
+import os
+import shutil
+import subprocess
+
+from k8s_tpu.harness import util as harness_util
+
+log = logging.getLogger(__name__)
+
+
+def get_image_tag(repo_dir: str) -> str:
+    """<short-sha>[ -dirty-<timestamp> ] (build_and_push_image.py:28-52)."""
+    try:
+        sha = harness_util.run_and_output(
+            ["git", "rev-parse", "--short=8", "HEAD"], cwd=repo_dir
+        ).strip()
+    except Exception:  # not a git checkout: fall back to a timestamp tag
+        return "notag-" + datetime.datetime.now().strftime("%Y%m%d%H%M%S")
+    status = harness_util.run_and_output(
+        ["git", "status", "--porcelain"], cwd=repo_dir
+    ).strip()
+    if status:
+        sha += "-dirty-" + datetime.datetime.now().strftime("%Y%m%d%H%M%S")
+    return sha
+
+
+def render_dockerfile(template_path: str, context_dir: str, substitutions: dict | None = None) -> str:
+    """Copy the Dockerfile template into the context, applying ``{key}``
+    substitutions (the template modification step of build_and_push_image.py:69-86)."""
+    with open(template_path) as f:
+        text = f.read()
+    for key, value in (substitutions or {}).items():
+        text = text.replace("{" + key + "}", value)
+    out = os.path.join(context_dir, "Dockerfile")
+    with open(out, "w") as f:
+        f.write(text)
+    return out
+
+
+def docker_available() -> bool:
+    return shutil.which("docker") is not None
+
+
+def build_and_push(
+    dockerfile_template: str,
+    context_dir: str,
+    image: str,
+    repo_dir: str | None = None,
+    substitutions: dict | None = None,
+    push: bool = False,
+) -> str:
+    """Build (and optionally push) ``image:<git tag>``; returns the full
+    image ref.  Without docker, the rendered context is left in place and the
+    ref returned for manifest generation (dry run)."""
+    tag = get_image_tag(repo_dir or os.path.dirname(dockerfile_template))
+    ref = f"{image}:{tag}"
+    render_dockerfile(dockerfile_template, context_dir, substitutions)
+    if not docker_available():
+        log.warning("docker not found; context prepared at %s, skipping build of %s", context_dir, ref)
+        return ref
+    harness_util.run(["docker", "build", "-t", ref, context_dir])
+    if push:
+        harness_util.run(["docker", "push", ref])
+    return ref
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--template", required=True, help="Dockerfile.template path")
+    parser.add_argument("--context", required=True, help="build context directory")
+    parser.add_argument("--image", required=True, help="image repo (no tag)")
+    parser.add_argument("--push", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    ref = build_and_push(args.template, args.context, args.image, push=args.push)
+    print(ref)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

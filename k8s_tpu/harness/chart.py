@@ -1,0 +1,62 @@
+"""Chart rendering: values + templates -> manifest documents.
+
+The reference ships a Helm chart (examples/tf_job/) and deploys its e2e
+component through ksonnet parameter substitution (py/test_runner.py:239-276).
+Both reduce to the same operation — merge values into templates and apply —
+implemented here with ``string.Template`` so the image needs no helm/ks
+binary.  Charts live as a directory: Chart.yaml + values.yaml +
+templates/*.yaml.
+"""
+
+from __future__ import annotations
+
+import os
+import string
+
+import yaml
+
+
+class ChartError(Exception):
+    pass
+
+
+def load_values(chart_dir: str, overrides: dict | None = None) -> dict:
+    path = os.path.join(chart_dir, "values.yaml")
+    values = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            values = yaml.safe_load(f) or {}
+    if overrides:
+        values.update(overrides)
+    return values
+
+
+def render_chart(chart_dir: str, overrides: dict | None = None) -> list[dict]:
+    """Render every template in the chart; returns parsed YAML documents.
+    Raises ChartError on an unresolved ``${var}`` (Helm fails the same way on
+    a missing .Values key)."""
+    tmpl_dir = os.path.join(chart_dir, "templates")
+    if not os.path.isdir(tmpl_dir):
+        raise ChartError(f"no templates/ under {chart_dir}")
+    values = load_values(chart_dir, overrides)
+    docs: list[dict] = []
+    for fname in sorted(os.listdir(tmpl_dir)):
+        if not fname.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tmpl_dir, fname)) as f:
+            text = f.read()
+        try:
+            rendered = string.Template(text).substitute(
+                {k: str(v) for k, v in values.items()}
+            )
+        except KeyError as e:
+            raise ChartError(f"{fname}: no value for ${{{e.args[0]}}}") from None
+        for doc in yaml.safe_load_all(rendered):
+            if doc:
+                docs.append(doc)
+    return docs
+
+
+def chart_metadata(chart_dir: str) -> dict:
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        return yaml.safe_load(f) or {}

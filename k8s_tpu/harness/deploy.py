@@ -1,0 +1,139 @@
+"""Cluster lifecycle for test runs (reference: py/deploy.py:91-277).
+
+The reference's ``setup`` creates a GKE cluster, installs GPU drivers, and
+ksonnet-deploys the operator; ``teardown`` deletes the cluster.  Here the
+same two verbs target either:
+
+- ``local`` — the in-process fake cluster + operator + kubelet simulator
+  (k8s_tpu/e2e/local.py), the default for hermetic runs, or
+- ``kubectl`` — a real cluster reachable through kubectl: apply the CRDs and
+  an operator Deployment rendered by :func:`operator_manifests`.
+
+Both paths produce the same artifact: a running operator that the test runner
+(k8s_tpu/harness/test_runner.py) can submit TFJobs to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+import yaml
+
+from k8s_tpu.harness import util as harness_util
+
+log = logging.getLogger(__name__)
+
+DEFAULT_NAMESPACE = "kubeflow"
+
+
+def operator_manifests(
+    image: str = "k8s-tpu/tf-job-operator:latest",
+    namespace: str = DEFAULT_NAMESPACE,
+    version: str = "v1alpha2",
+) -> list[dict]:
+    """Namespace + ServiceAccount + Deployment for the operator (the ksonnet
+    component the reference applies, py/deploy.py:49-88)."""
+    labels = {"name": "tf-job-operator"}
+    return [
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}},
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "tf-job-operator", "namespace": namespace},
+        },
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "tf-job-operator", "namespace": namespace, "labels": labels},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {
+                        "serviceAccountName": "tf-job-operator",
+                        "containers": [
+                            {
+                                "name": "tf-job-operator",
+                                "image": image,
+                                "command": [
+                                    "python",
+                                    "-m",
+                                    "k8s_tpu.cmd.operator_v2"
+                                    if version == "v1alpha2"
+                                    else "k8s_tpu.cmd.operator",
+                                ],
+                                "env": [
+                                    {"name": "KUBEFLOW_NAMESPACE", "value": namespace}
+                                ],
+                            }
+                        ],
+                    },
+                },
+            },
+        },
+    ]
+
+
+def setup_local(version: str = "v1alpha1", enable_gang_scheduling: bool = False):
+    """Bring up the in-process cluster; caller owns stop() (deploy.py:91's
+    contract: returns once the operator is ready)."""
+    from k8s_tpu.e2e.local import LocalCluster
+
+    cluster = LocalCluster(version=version, enable_gang_scheduling=enable_gang_scheduling)
+    cluster.__enter__()
+    return cluster
+
+
+def write_manifests(output_dir: str, image: str, namespace: str, version: str) -> list[str]:
+    """Render CRDs + operator manifests to files kubectl can apply."""
+    os.makedirs(output_dir, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    paths = []
+    for crd in ("crd.yaml", "crd-v1alpha2.yaml"):
+        src = os.path.join(repo, "examples", "crd", crd)
+        if os.path.exists(src):
+            paths.append(src)
+    operator_path = os.path.join(output_dir, "tf-job-operator.yaml")
+    with open(operator_path, "w") as f:
+        yaml.safe_dump_all(operator_manifests(image, namespace, version), f)
+    paths.append(operator_path)
+    return paths
+
+
+def setup_kubectl(image: str, namespace: str, version: str, output_dir: str) -> None:
+    """kubectl-apply the operator onto a live cluster (deploy.py:91-186)."""
+    for path in write_manifests(output_dir, image, namespace, version):
+        harness_util.run(["kubectl", "apply", "-f", path])
+
+
+def teardown_kubectl(namespace: str) -> None:
+    """Delete the operator namespace (deploy.py:189-210's cluster delete,
+    scoped to what kubectl owns here)."""
+    harness_util.run(["kubectl", "delete", "namespace", namespace, "--ignore-not-found"])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+    setup_p = sub.add_parser("setup")
+    setup_p.add_argument("--mode", choices=["kubectl"], default="kubectl")
+    setup_p.add_argument("--image", default="k8s-tpu/tf-job-operator:latest")
+    setup_p.add_argument("--namespace", default=DEFAULT_NAMESPACE)
+    setup_p.add_argument("--version", default="v1alpha2")
+    setup_p.add_argument("--output_dir", default="/tmp/k8s-tpu-deploy")
+    down_p = sub.add_parser("teardown")
+    down_p.add_argument("--namespace", default=DEFAULT_NAMESPACE)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.command == "setup":
+        setup_kubectl(args.image, args.namespace, args.version, args.output_dir)
+    else:
+        teardown_kubectl(args.namespace)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,110 @@
+"""Lint + unit-test driver (reference: py/py_checks.py:18-144).
+
+The reference runs pylint over every ``.py`` file and executes ``*_test.py``
+files, emitting one junit XML per check.  Here lint is ``pyflakes`` when
+importable, else a ``compile()`` syntax pass (no pylint in this image), and
+the test tier runs pytest; junit files land in ``--artifacts_dir`` for
+:func:`k8s_tpu.harness.prow.check_no_errors` to inspect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import subprocess
+import sys
+import time
+
+from k8s_tpu.harness import junit
+
+log = logging.getLogger(__name__)
+
+EXCLUDE_DIRS = {".git", "__pycache__", ".eggs", "build", "vendor", "node_modules"}
+
+
+def iter_py_files(src_dir: str):
+    for root, dirs, files in os.walk(src_dir):
+        dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+
+
+def _lint_one(path: str) -> str | None:
+    """Return a failure message or None (the per-file pylint run,
+    py_checks.py:40-62)."""
+    with open(path, "rb") as f:
+        source = f.read()
+    try:
+        compile(source, path, "exec")
+    except SyntaxError as e:
+        return f"SyntaxError: {e}"
+    try:
+        from pyflakes.api import check as pyflakes_check
+        from pyflakes.reporter import Reporter
+        import io
+
+        out, err = io.StringIO(), io.StringIO()
+        if pyflakes_check(source.decode("utf-8", "replace"), path, Reporter(out, err)):
+            return (out.getvalue() + err.getvalue()).strip()
+    except ImportError:
+        pass
+    return None
+
+
+def run_lint(src_dir: str, artifacts_dir: str) -> bool:
+    """Lint the tree; junit_pylint.xml analogue (py_checks.py:18-85)."""
+    suite = junit.TestSuite("pylint")
+    ok = True
+    for path in iter_py_files(src_dir):
+        case = suite.create(os.path.relpath(path, src_dir))
+        start = time.time()
+        failure = _lint_one(path)
+        case.time = time.time() - start
+        if failure:
+            case.failure = failure
+            ok = False
+    junit.create_junit_xml_file(suite, os.path.join(artifacts_dir, "junit_pylint.xml"))
+    return ok
+
+
+def run_tests(src_dir: str, artifacts_dir: str) -> bool:
+    """Run the pytest tier writing junit_pytests.xml (the *_test.py loop of
+    py_checks.py:86-121, delegated to pytest's own junit emitter)."""
+    os.makedirs(artifacts_dir, exist_ok=True)
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/",
+            "-q",
+            f"--junitxml={os.path.join(artifacts_dir, 'junit_pytests.xml')}",
+        ],
+        cwd=src_dir,
+    )
+    return result.returncode == 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--src_dir", default=os.getcwd())
+    parser.add_argument("--artifacts_dir", required=True)
+    parser.add_argument(
+        "--check", choices=["lint", "test", "all"], default="all",
+        help="which tier to run (py_checks.py runs both)",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    os.makedirs(args.artifacts_dir, exist_ok=True)
+    ok = True
+    if args.check in ("lint", "all"):
+        ok = run_lint(args.src_dir, args.artifacts_dir) and ok
+    if args.check in ("test", "all"):
+        ok = run_tests(args.src_dir, args.artifacts_dir) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,162 @@
+"""Release builder (reference: py/release.py:123-702).
+
+Builds the deployable artifacts for the operator:
+
+- the operator image build context (Dockerfile + ``k8s_tpu`` sources + e2e
+  binary entrypoints), via :mod:`k8s_tpu.harness.build_and_push_image`
+  (release.py:123-231 ``build_operator_image``),
+- the chart package: ``tf-job-operator-chart-<version>.tgz`` with
+  ``values.yaml`` rewritten to the new image ref (release.py:53-77
+  ``update_values``/``update_chart``),
+- ``build_info.yaml`` describing what was built (release.py:288-307).
+
+GCS/gcloud plumbing is replaced by the artifact-store abstraction
+(k8s_tpu/harness/artifacts.py), so the same code paths run against a local
+directory store.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import re
+import tarfile
+import tempfile
+import time
+
+import yaml
+
+from k8s_tpu.harness import build_and_push_image
+
+log = logging.getLogger(__name__)
+
+DOCKERFILE_TEMPLATE = """\
+# Operator image (reference: build/images/tf_operator/Dockerfile).
+FROM {base_image}
+COPY k8s_tpu /opt/k8s-tpu/k8s_tpu
+COPY examples /opt/k8s-tpu/examples
+ENV PYTHONPATH=/opt/k8s-tpu
+ENTRYPOINT ["python", "-m", "k8s_tpu.cmd.operator_v2"]
+"""
+
+DEFAULT_BASE_IMAGE = "python:3.11-slim"
+
+
+def update_values(values_file: str, image: str) -> None:
+    """Rewrite the ``image:`` line preserving comments (release.py:53-66)."""
+    with open(values_file) as f:
+        lines = f.readlines()
+    with open(values_file, "w") as f:
+        for line in lines:
+            if re.match(r"^image:", line):
+                f.write(f"image: {image}\n")
+            else:
+                f.write(line)
+
+
+def update_chart(chart_file: str, version: str) -> None:
+    """Stamp the chart version (release.py:68-77)."""
+    with open(chart_file) as f:
+        chart = yaml.safe_load(f)
+    chart["version"] = version
+    with open(chart_file, "w") as f:
+        yaml.safe_dump(chart, f, default_flow_style=False)
+
+
+def build_operator_image(
+    repo_dir: str, registry: str, output_dir: str, base_image: str = DEFAULT_BASE_IMAGE
+) -> dict:
+    """Prepare the operator image context and build it when docker exists
+    (release.py:123-231).  Returns {'image': ref, 'context_dir': ...}."""
+    import shutil
+
+    context_dir = os.path.join(output_dir, "image-context")
+    os.makedirs(context_dir, exist_ok=True)
+    for name in ("k8s_tpu", "examples"):
+        src = os.path.join(repo_dir, name)
+        dst = os.path.join(context_dir, name)
+        if os.path.isdir(src):
+            # always copy fresh: a stale context from a prior run must not be
+            # baked under a new tag
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(
+                src, dst, ignore=shutil.ignore_patterns("__pycache__", "*.pyc", "*.so")
+            )
+    template = os.path.join(context_dir, "Dockerfile.template")
+    with open(template, "w") as f:
+        f.write(DOCKERFILE_TEMPLATE)
+    ref = build_and_push_image.build_and_push(
+        template,
+        context_dir,
+        image=f"{registry}/tf-job-operator",
+        repo_dir=repo_dir,
+        substitutions={"base_image": base_image},
+    )
+    return {"image": ref, "context_dir": context_dir}
+
+
+def build_chart_package(repo_dir: str, image: str, version: str, output_dir: str) -> str:
+    """Package examples/tf_job_chart with the release image baked into
+    values.yaml (the helm-package step, release.py:249-286)."""
+    import shutil
+
+    chart_src = os.path.join(repo_dir, "examples", "tf_job_chart")
+    staging = os.path.join(tempfile.mkdtemp(prefix="chart-"), "tf-job")
+    shutil.copytree(chart_src, staging)
+    update_values(os.path.join(staging, "values.yaml"), image)
+    update_chart(os.path.join(staging, "Chart.yaml"), version)
+    os.makedirs(output_dir, exist_ok=True)
+    pkg = os.path.join(output_dir, f"tf-job-operator-chart-{version}.tgz")
+    with tarfile.open(pkg, "w:gz") as tar:
+        tar.add(staging, arcname="tf-job")
+    return pkg
+
+
+def write_build_info(build_info: dict, path: str) -> None:
+    """build_info.yaml (release.py:288-307)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(build_info, f, default_flow_style=False)
+
+
+def build_and_push_artifacts(
+    repo_dir: str, registry: str, output_dir: str, version: str | None = None
+) -> dict:
+    """The full release pipeline (release.py:249-307): image + chart +
+    build_info.  ``version`` defaults to 0.1.0+<image tag>."""
+    os.makedirs(output_dir, exist_ok=True)
+    image_result = build_operator_image(repo_dir, registry, output_dir)
+    tag = image_result["image"].rsplit(":", 1)[1]
+    version = version or f"0.1.0-{tag}"
+    chart_pkg = build_chart_package(repo_dir, image_result["image"], version, output_dir)
+    info = {
+        "image": image_result["image"],
+        "chart": os.path.basename(chart_pkg),
+        "version": version,
+        "timestamp": int(time.time()),
+    }
+    write_build_info(info, os.path.join(output_dir, "build_info.yaml"))
+    return info
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    subparsers = parser.add_subparsers(dest="command", required=True)
+    local = subparsers.add_parser("local", help="build from this checkout (release.py:385)")
+    local.add_argument("--registry", default="k8s-tpu")
+    local.add_argument("--output_dir", required=True)
+    local.add_argument("--src_dir", default=os.getcwd())
+    local.add_argument("--version", default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    info = build_and_push_artifacts(
+        args.src_dir, args.registry, args.output_dir, version=args.version
+    )
+    log.info("built: %s", info)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,290 @@
+"""Prometheus-style metrics (filling the observability gap SURVEY.md §5
+documents: "No Prometheus metrics anywhere — a gap to fill").
+
+A minimal, thread-safe registry producing the Prometheus text exposition
+format (version 0.0.4) with Counter / Gauge / Histogram supporting label
+sets.  Stdlib-only like the rest of the control plane; the dashboard backend
+serves it at ``/metrics`` and both controllers record reconcile telemetry
+through the default registry.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Iterable, Optional, Sequence
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _format_labels(label_names: Sequence[str], label_values: Sequence[str]) -> str:
+    if not label_names:
+        return ""
+    pairs = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in zip(label_names, label_values)
+    )
+    return "{" + pairs + "}"
+
+
+def _escape(value: str) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    """Base: one named metric with zero or more labeled children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+
+    def labels(self, *label_values: str):
+        if len(label_values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, got {label_values}"
+            )
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child()
+                self._children[key] = child
+            return child
+
+    def _default_child(self):
+        return self.labels()
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        with self._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            yield from self._collect_child(key, child)
+
+    def _collect_child(self, key: tuple, child) -> Iterable[str]:
+        raise NotImplementedError
+
+
+class _CounterChild:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+    def _collect_child(self, key, child):
+        yield f"{self.name}{_format_labels(self.label_names, key)} {_format_value(child.value)}"
+
+
+class _GaugeChild:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_text, label_names=(), fn=None):
+        super().__init__(name, help_text, label_names)
+        self._fn = fn  # callable gauges (e.g. workqueue depth)
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default_child().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+    def collect(self):
+        if self._fn is not None:
+            yield f"# HELP {self.name} {self.help}"
+            yield f"# TYPE {self.name} {self.kind}"
+            yield f"{self.name} {_format_value(float(self._fn()))}"
+            return
+        yield from super().collect()
+
+    def _collect_child(self, key, child):
+        yield f"{self.name}{_format_labels(self.label_names, key)} {_format_value(child.value)}"
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.total += value
+            self.count += 1
+            # per-bucket counts; collect() accumulates into cumulative le= form
+            i = bisect.bisect_left(self.buckets, value)
+            if i < len(self.buckets):
+                self.counts[i] += 1
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, label_names=(), buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets))
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+    def _collect_child(self, key, child):
+        cumulative = 0
+        for bound, count in zip(child.buckets, child.counts):
+            cumulative += count
+            labels = _format_labels(
+                self.label_names + ("le",), key + (_format_value(bound),)
+            )
+            yield f"{self.name}_bucket{labels} {cumulative}"
+        inf_labels = _format_labels(self.label_names + ("le",), key + ("+Inf",))
+        yield f"{self.name}_bucket{inf_labels} {child.count}"
+        yield f"{self.name}_sum{_format_labels(self.label_names, key)} {_format_value(child.total)}"
+        yield f"{self.name}_count{_format_labels(self.label_names, key)} {child.count}"
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def counter(self, name, help_text="", label_names=()) -> Counter:
+        return self.register(Counter(name, help_text, label_names))  # type: ignore[return-value]
+
+    def gauge(self, name, help_text="", label_names=(), fn=None) -> Gauge:
+        return self.register(Gauge(name, help_text, label_names, fn=fn))  # type: ignore[return-value]
+
+    def histogram(self, name, help_text="", label_names=(), buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_text, label_names, buckets))  # type: ignore[return-value]
+
+    def expose(self) -> str:
+        """Text exposition format 0.0.4."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+REGISTRY = Registry()
+
+
+# --- the operator's own telemetry (consumed by controllers and dashboard) ---
+
+def controller_metrics(generation: str, registry: Optional[Registry] = None) -> dict:
+    """The reconcile metric family for one controller generation ("v1"/"v2"):
+    sync latency (replacing the log-only timing at
+    pkg/controller.v2/controller.go:337-340), sync totals by result, and
+    pod/service create/delete counters."""
+    r = registry or REGISTRY
+    return {
+        "sync_duration": r.histogram(
+            "tfjob_sync_duration_seconds",
+            "Time spent in one syncTFJob pass.",
+            ("generation",),
+        ),
+        "sync_total": r.counter(
+            "tfjob_sync_total",
+            "syncTFJob passes by result (success/error).",
+            ("generation", "result"),
+        ),
+        "queue_retries": r.counter(
+            "tfjob_workqueue_retries_total",
+            "Rate-limited requeues of a job key.",
+            ("generation",),
+        ),
+        "generation": generation,
+    }

@@ -1,0 +1,57 @@
+"""dist-mnist example: runs, checkpoints, and resumes on the 8-device CPU
+mesh (reference workload: test/e2e/dist-mnist/dist_mnist.py)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "dist_mnist", "dist_mnist.py")
+
+
+def run_mnist(tmp_path, extra_args, env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, SCRIPT, f"--train_dir={tmp_path}", *extra_args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=240,
+    )
+
+
+class TestDistMnist:
+    def test_trains_and_resumes(self, tmp_path):
+        first = run_mnist(
+            tmp_path, ["--train_steps=6", "--batch_size=16", "--checkpoint_every=3"]
+        )
+        assert first.returncode == 0, first.stderr
+        assert "training complete at step 6" in first.stderr
+        assert (tmp_path / "mnist_state.msgpack").exists()
+
+        # second run resumes at step 6 and continues to 9
+        second = run_mnist(
+            tmp_path, ["--train_steps=9", "--batch_size=16", "--checkpoint_every=3"]
+        )
+        assert second.returncode == 0, second.stderr
+        assert "restored checkpoint at step 6" in second.stderr
+        assert "training complete at step 9" in second.stderr
+
+    def test_manifest_loads(self):
+        from k8s_tpu.api import manifest
+
+        [job] = manifest.load_tfjobs_from_file(
+            os.path.join(REPO, "examples", "dist_mnist", "tf_job_mnist.yaml")
+        )
+        spec = job.spec.tf_replica_specs["TPU"]
+        assert spec.replicas == 4
+        [vol] = spec.template["spec"]["volumes"]
+        assert vol["name"] == "ckpt"

@@ -1,0 +1,138 @@
+"""Shipped example manifests and chart are loadable, valid, and runnable
+(the kubectl-create-f contract the reference e2e harness leans on,
+py/test_runner.py:239-276)."""
+
+from __future__ import annotations
+
+import datetime
+import os
+
+import pytest
+
+from k8s_tpu.api import manifest, v1alpha1, v1alpha2
+from k8s_tpu.api.validation import ValidationError
+from k8s_tpu.e2e.local import LocalCluster
+from k8s_tpu.harness import chart, tf_job_client
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def load_one(name):
+    jobs = manifest.load_tfjobs_from_file(os.path.join(EXAMPLES, name))
+    assert len(jobs) == 1
+    return jobs[0]
+
+
+class TestExampleManifests:
+    def test_tf_job_yaml(self):
+        job = load_one("tf_job.yaml")
+        assert job.api_version == v1alpha1.CRD_API_VERSION
+        types = [r.tf_replica_type for r in job.spec.replica_specs]
+        assert types == ["MASTER", "WORKER", "PS"]
+        assert [r.replicas for r in job.spec.replica_specs] == [1, 1, 2]
+        # defaulting filled the port and chief policy
+        assert all(r.tf_port == 2222 for r in job.spec.replica_specs)
+        assert job.spec.termination_policy.chief.replica_name == "MASTER"
+
+    def test_tf_job_defaults_yaml(self):
+        job = load_one("tf_job_defaults.yaml")
+        [r] = job.spec.replica_specs
+        assert r.tf_replica_type == "MASTER"
+        assert r.replicas == 1
+        assert r.tf_port == 2222
+
+    def test_tf_job_gpu_yaml(self):
+        job = load_one("tf_job_gpu.yaml")
+        [r] = job.spec.replica_specs
+        limits = r.template["spec"]["containers"][0]["resources"]["limits"]
+        assert limits["nvidia.com/gpu"] == 1
+
+    def test_tf_job_tpu_yaml(self):
+        job = load_one("tf_job_tpu.yaml")
+        assert job.api_version == v1alpha2.CRD_API_VERSION
+        assert job.spec.tpu.accelerator_type == "v5litepod-16"
+        assert job.spec.tpu.topology == "4x4"
+        tpu = job.spec.tf_replica_specs["TPU"]
+        assert tpu.replicas == 4
+        assert tpu.restart_policy == v1alpha2.RestartPolicyExitCode
+
+    def test_tf_job_multislice_yaml(self):
+        job = load_one("tf_job_multislice.yaml")
+        assert job.spec.tpu.num_slices == 2
+        assert job.spec.tf_replica_specs["TPU"].replicas == 8
+
+    def test_tpu_smoke_yaml(self):
+        job = load_one("tpu_smoke.yaml")
+        assert job.spec.tf_replica_specs["TPU"].restart_policy == v1alpha2.RestartPolicyNever
+
+    def test_crd_documents_are_skipped(self):
+        for name in ("crd/crd.yaml", "crd/crd-v1alpha2.yaml"):
+            assert manifest.load_tfjobs_from_file(os.path.join(EXAMPLES, name)) == []
+
+    def test_load_tfjob_rejects_wrong_kind(self):
+        with pytest.raises(ValueError, match="kind"):
+            manifest.load_tfjob({"kind": "Pod"})
+
+    def test_invalid_job_fails_validation(self):
+        doc = {
+            "apiVersion": "kubeflow.org/v1alpha2",
+            "kind": "TFJob",
+            "metadata": {"name": "bad"},
+            "spec": {"tfReplicaSpecs": {"Chief": {"replicas": 2, "template": {
+                "spec": {"containers": [{"name": "tensorflow"}]}}}}},
+        }
+        with pytest.raises(ValidationError, match="Chief"):
+            manifest.load_tfjob(doc)
+
+
+class TestChart:
+    def test_render_defaults(self):
+        [doc] = chart.render_chart(os.path.join(EXAMPLES, "tf_job_chart"))
+        job = manifest.load_tfjob(doc)
+        assert job.metadata.name == "chart-job"
+        assert job.spec.tf_replica_specs["TPU"].replicas == 4
+
+    def test_render_overrides(self):
+        [doc] = chart.render_chart(
+            os.path.join(EXAMPLES, "tf_job_chart"),
+            {"name": "my-job", "image": "k8s-tpu/custom:1", "replicas": 2},
+        )
+        job = manifest.load_tfjob(doc)
+        assert job.metadata.name == "my-job"
+        assert (
+            job.spec.tf_replica_specs["TPU"].template["spec"]["containers"][0]["image"]
+            == "k8s-tpu/custom:1"
+        )
+        assert job.spec.tf_replica_specs["TPU"].replicas == 2
+
+    def test_metadata(self):
+        meta = chart.chart_metadata(os.path.join(EXAMPLES, "tf_job_chart"))
+        assert meta["name"] == "tf-job"
+
+    def test_missing_value_raises(self, tmp_path):
+        (tmp_path / "templates").mkdir()
+        (tmp_path / "templates" / "x.yaml").write_text("name: ${nope}\n")
+        with pytest.raises(chart.ChartError, match="nope"):
+            chart.render_chart(str(tmp_path))
+
+
+class TestExampleRunsEndToEnd:
+    def test_tf_job_yaml_runs_on_local_cluster(self):
+        """examples/tf_job.yaml submitted verbatim reaches a terminal success
+        state (commandless containers: kubelet simulator exits 0, chief state
+        decides the job, pkg/trainer/training.go:154-189 semantics)."""
+        job = load_one("tf_job.yaml")
+        with LocalCluster(version="v1alpha1") as lc:
+            created = tf_job_client.create_tf_job(
+                lc.clientset, job.to_dict(), version="v1alpha1"
+            )
+            finished = tf_job_client.wait_for_job(
+                lc.clientset,
+                created["metadata"]["namespace"],
+                created["metadata"]["name"],
+                version="v1alpha1",
+                timeout=datetime.timedelta(seconds=30),
+                polling_interval=datetime.timedelta(milliseconds=50),
+            )
+        assert finished["status"]["phase"] == "Done"
+        assert finished["status"]["state"] == "Succeeded"

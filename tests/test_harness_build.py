@@ -1,0 +1,192 @@
+"""Build/release/lint/deploy harness tiers (reference: py/release_test.py,
+py/py_checks.py, py/deploy.py — tested hermetically, no docker/kubectl)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tarfile
+
+import yaml
+
+from k8s_tpu.api import manifest
+from k8s_tpu.cmd import genjob
+from k8s_tpu.harness import build_and_push_image, deploy, junit, py_checks, release
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBuildAndPushImage:
+    def test_image_tag_from_git(self):
+        tag = build_and_push_image.get_image_tag(REPO)
+        assert tag
+        # short sha or dirty-suffixed short sha (build_and_push_image.py:28-52)
+        assert len(tag.split("-")[0]) >= 7 or tag.startswith("notag-")
+
+    def test_image_tag_outside_git(self, tmp_path):
+        assert build_and_push_image.get_image_tag(str(tmp_path)).startswith("notag-")
+
+    def test_render_dockerfile_substitutions(self, tmp_path):
+        template = tmp_path / "Dockerfile.template"
+        template.write_text("FROM {base_image}\n")
+        out = build_and_push_image.render_dockerfile(
+            str(template), str(tmp_path), {"base_image": "python:3.11"}
+        )
+        assert open(out).read() == "FROM python:3.11\n"
+
+    def test_build_dry_run_without_docker(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(build_and_push_image, "docker_available", lambda: False)
+        template = tmp_path / "Dockerfile.template"
+        template.write_text("FROM {base_image}\n")
+        ref = build_and_push_image.build_and_push(
+            str(template), str(tmp_path), "reg/img", repo_dir=REPO,
+            substitutions={"base_image": "x"},
+        )
+        assert ref.startswith("reg/img:")
+        assert (tmp_path / "Dockerfile").exists()
+
+
+class TestRelease:
+    def test_update_values_preserves_comments(self, tmp_path):
+        values = tmp_path / "values.yaml"
+        values.write_text("# a comment\nimage: old:1\nname: x\n")
+        release.update_values(str(values), "new:2")
+        text = values.read_text()
+        assert "# a comment" in text
+        assert "image: new:2" in text
+        assert "name: x" in text
+
+    def test_full_release_pipeline(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(build_and_push_image, "docker_available", lambda: False)
+        info = release.build_and_push_artifacts(REPO, "k8s-tpu", str(tmp_path))
+        assert info["image"].startswith("k8s-tpu/tf-job-operator:")
+        pkg = tmp_path / info["chart"]
+        assert pkg.exists()
+        with tarfile.open(pkg) as tar:
+            names = tar.getnames()
+            assert "tf-job/Chart.yaml" in names
+            values = yaml.safe_load(
+                tar.extractfile("tf-job/values.yaml").read()
+            )
+            assert values["image"] == info["image"]
+            chart_meta = yaml.safe_load(tar.extractfile("tf-job/Chart.yaml").read())
+            assert chart_meta["version"] == info["version"]
+        build_info = yaml.safe_load((tmp_path / "build_info.yaml").read_text())
+        assert build_info["image"] == info["image"]
+        # docker context carries the package sources
+        assert os.path.exists(tmp_path / "image-context" / "k8s_tpu" / "version.py")
+        assert os.path.exists(tmp_path / "image-context" / "Dockerfile")
+
+
+class TestPyChecks:
+    def test_lint_clean_tree(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "good.py").write_text("x = 1\n")
+        assert py_checks.run_lint(str(src), str(tmp_path)) is True
+        xml = (tmp_path / "junit_pylint.xml").read_text()
+        assert junit.get_num_failures(xml) == 0
+
+    def test_lint_catches_syntax_error(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "bad.py").write_text("def broken(:\n")
+        assert py_checks.run_lint(str(src), str(tmp_path)) is False
+        xml = (tmp_path / "junit_pylint.xml").read_text()
+        assert junit.get_num_failures(xml) == 1
+
+    def test_package_tree_is_lint_clean(self, tmp_path):
+        assert py_checks.run_lint(os.path.join(REPO, "k8s_tpu"), str(tmp_path)) is True
+
+
+class TestDeploy:
+    def test_operator_manifests_shape(self):
+        docs = deploy.operator_manifests(image="reg/op:1", namespace="kubeflow")
+        kinds = [d["kind"] for d in docs]
+        assert kinds == ["Namespace", "ServiceAccount", "Deployment"]
+        dep = docs[-1]
+        [container] = dep["spec"]["template"]["spec"]["containers"]
+        assert container["image"] == "reg/op:1"
+        assert "operator_v2" in container["command"][-1]
+
+    def test_write_manifests(self, tmp_path):
+        paths = deploy.write_manifests(str(tmp_path), "reg/op:1", "kubeflow", "v1alpha2")
+        assert any(p.endswith("crd-v1alpha2.yaml") for p in paths)
+        rendered = [p for p in paths if p.startswith(str(tmp_path))]
+        assert len(rendered) == 1
+        docs = list(yaml.safe_load_all(open(rendered[0])))
+        assert [d["kind"] for d in docs] == ["Namespace", "ServiceAccount", "Deployment"]
+
+    def test_setup_local_runs_a_job(self):
+        import datetime
+
+        from k8s_tpu.harness import tf_job_client
+
+        cluster = deploy.setup_local(version="v1alpha1")
+        try:
+            job = manifest.load_tfjobs_from_file(
+                os.path.join(REPO, "examples", "tf_job_defaults.yaml")
+            )[0]
+            created = tf_job_client.create_tf_job(
+                cluster.clientset, job.to_dict(), version="v1alpha1"
+            )
+            finished = tf_job_client.wait_for_job(
+                cluster.clientset,
+                created["metadata"]["namespace"],
+                created["metadata"]["name"],
+                version="v1alpha1",
+                timeout=datetime.timedelta(seconds=30),
+                polling_interval=datetime.timedelta(milliseconds=50),
+            )
+            assert finished["status"]["phase"] == "Done"
+        finally:
+            cluster.stop()
+
+
+class TestGenjob:
+    def test_default_worker_job(self):
+        [job] = genjob.generate(1, timestamp=7)
+        assert job["metadata"]["name"] == "tfjob-7-0"
+        [r] = job["spec"]["replicaSpecs"]
+        assert r["tfReplicaType"] == "WORKER"
+        manifest.load_tfjob(job)  # defaults+validates
+
+    def test_gpu_job_has_chief_and_limit(self):
+        [job] = genjob.generate(1, gpu=True, timestamp=7)
+        [r] = job["spec"]["replicaSpecs"]
+        assert r["tfReplicaType"] == "MASTER"
+        assert r["template"]["spec"]["containers"][0]["resources"]["limits"][
+            "nvidia.com/gpu"
+        ] == 1
+        assert job["spec"]["terminationPolicy"]["chief"]["replicaName"] == "MASTER"
+        manifest.load_tfjob(job)
+
+    def test_tpu_gang_job(self):
+        [job] = genjob.generate(1, tpu=True, timestamp=7)
+        spec = job["spec"]["tfReplicaSpecs"]["TPU"]
+        assert spec["replicas"] == 4
+        typed = manifest.load_tfjob(job)
+        assert typed.spec.tpu.accelerator_type == "v5litepod-16"
+
+    def test_unique_names_and_scheduler(self):
+        jobs = genjob.generate(3, scheduler_name="kube-batch", timestamp=9)
+        names = [j["metadata"]["name"] for j in jobs]
+        assert len(set(names)) == 3
+        assert all(
+            j["spec"]["replicaSpecs"][0]["template"]["spec"]["schedulerName"]
+            == "kube-batch"
+            for j in jobs
+        )
+
+    def test_cli_dump(self):
+        out = subprocess.run(
+            ["python", "-m", "k8s_tpu.cmd.genjob", "--nr-tfjobs", "2", "--dump"],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            check=True,
+        ).stdout
+        docs = list(yaml.safe_load_all(out))
+        assert len(docs) == 2
+        for d in docs:
+            assert d["kind"] == "TFJob"

@@ -1,0 +1,147 @@
+"""Prometheus metrics layer (the observability gap SURVEY.md §5 flags; no
+reference counterpart — the reference's telemetry was logs + K8s events)."""
+
+from __future__ import annotations
+
+import threading
+
+from k8s_tpu.util import metrics
+
+
+class TestPrimitives:
+    def test_counter(self):
+        r = metrics.Registry()
+        c = r.counter("requests_total", "Requests.")
+        c.inc()
+        c.inc(2)
+        out = r.expose()
+        assert "# TYPE requests_total counter" in out
+        assert "requests_total 3" in out
+
+    def test_counter_rejects_negative(self):
+        c = metrics.Registry().counter("x", "")
+        try:
+            c.inc(-1)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("expected ValueError")
+
+    def test_gauge_set_inc_dec(self):
+        r = metrics.Registry()
+        g = r.gauge("depth", "Queue depth.")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert "depth 4" in r.expose()
+
+    def test_callable_gauge(self):
+        r = metrics.Registry()
+        r.gauge("live_depth", "Depth.", fn=lambda: 7)
+        assert "live_depth 7" in r.expose()
+
+    def test_labels(self):
+        r = metrics.Registry()
+        c = r.counter("syncs", "Syncs.", ("generation", "result"))
+        c.labels("v2", "success").inc(3)
+        c.labels("v2", "error").inc()
+        out = r.expose()
+        assert 'syncs{generation="v2",result="success"} 3' in out
+        assert 'syncs{generation="v2",result="error"} 1' in out
+
+    def test_label_escaping(self):
+        r = metrics.Registry()
+        c = r.counter("e", "", ("msg",))
+        c.labels('say "hi"\n').inc()
+        assert 'msg="say \\"hi\\"\\n"' in r.expose()
+
+    def test_histogram_buckets(self):
+        r = metrics.Registry()
+        h = r.histogram("latency_seconds", "Latency.", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        out = r.expose()
+        assert 'latency_seconds_bucket{le="0.1"} 1' in out
+        assert 'latency_seconds_bucket{le="1"} 2' in out
+        assert 'latency_seconds_bucket{le="+Inf"} 3' in out
+        assert "latency_seconds_count 3" in out
+        assert "latency_seconds_sum 5.55" in out
+
+    def test_register_dedupes_by_name(self):
+        r = metrics.Registry()
+        a = r.counter("same", "")
+        b = r.counter("same", "")
+        assert a is b
+
+    def test_thread_safety(self):
+        r = metrics.Registry()
+        c = r.counter("n", "")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+
+
+class TestControllerWiring:
+    def test_sync_records_latency_and_result(self):
+        """A LocalCluster run leaves sync histograms/counters in the default
+        registry (replacing the log-only timing of controller.go:337-340)."""
+        import datetime
+        import os
+
+        from k8s_tpu.api import manifest
+        from k8s_tpu.e2e.local import LocalCluster
+        from k8s_tpu.harness import tf_job_client
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        job = manifest.load_tfjobs_from_file(
+            os.path.join(repo, "examples", "tf_job_defaults.yaml")
+        )[0]
+        with LocalCluster(version="v1alpha1") as lc:
+            sync_total = lc.controller.metrics["sync_total"]
+            before = sync_total.labels("v1", "success").value
+            created = tf_job_client.create_tf_job(
+                lc.clientset, job.to_dict(), version="v1alpha1"
+            )
+            tf_job_client.wait_for_job(
+                lc.clientset,
+                created["metadata"]["namespace"],
+                created["metadata"]["name"],
+                version="v1alpha1",
+                timeout=datetime.timedelta(seconds=30),
+                polling_interval=datetime.timedelta(milliseconds=50),
+            )
+            assert sync_total.labels("v1", "success").value > before
+        out = metrics.REGISTRY.expose()
+        assert "tfjob_sync_duration_seconds_bucket" in out
+
+
+class TestDashboardEndpoint:
+    def test_metrics_route(self):
+        import http.client
+        import threading as _t
+
+        from k8s_tpu.client.clientset import Clientset
+        from k8s_tpu.client.fake import FakeCluster
+        from k8s_tpu.dashboard import backend
+
+        cs = Clientset(FakeCluster())
+        server = backend.DashboardServer(cs, host="127.0.0.1", port=0)
+        server.start_background()
+        try:
+            metrics.REGISTRY.counter("dash_probe_total", "probe").inc()
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            assert resp.status == 200
+            assert "dash_probe_total 1" in body
+        finally:
+            server.shutdown()
