@@ -105,17 +105,18 @@ def main(argv=None) -> int:
         state, loss = step_fn(state, (bx, by))
         if step % 10 == 0 or step == args.train_steps - 1:
             log.info("step %d loss %.4f", step, float(loss))
-        if (
-            args.train_dir
-            and cfg.is_chief
-            and (step + 1) % args.checkpoint_every == 0
-        ):
+        if args.train_dir and (step + 1) % args.checkpoint_every == 0:
+            # barrier is a GLOBAL collective — every process must enter it;
+            # only the chief then writes (a chief-only barrier would leave
+            # the other hosts issuing mismatched collectives and hang).
             bootstrap.barrier("pre-checkpoint")
-            save_checkpoint(args.train_dir, state, step + 1)
+            if cfg.is_chief:
+                save_checkpoint(args.train_dir, state, step + 1)
 
-    if args.train_dir and cfg.is_chief:
+    if args.train_dir:
         bootstrap.barrier("final-checkpoint")
-        save_checkpoint(args.train_dir, state, args.train_steps)
+        if cfg.is_chief:
+            save_checkpoint(args.train_dir, state, args.train_steps)
     if loss is not None and not jnp.isfinite(loss):
         log.error("non-finite loss %s", loss)
         return 1

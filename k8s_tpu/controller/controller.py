@@ -19,7 +19,7 @@ from k8s_tpu.client.informer import SharedInformerFactory, split_meta_namespace_
 from k8s_tpu.client.record import EventRecorder
 from k8s_tpu.controller.trainer.training import TrainingJob
 from k8s_tpu.util import metrics
-from k8s_tpu.util.workqueue import RateLimitingQueue
+from k8s_tpu.util.workqueue import new_rate_limiting_queue
 
 log = logging.getLogger(__name__)
 
@@ -39,7 +39,7 @@ class Controller:
         self.config = config or v1alpha1.ControllerConfig()
         self.enable_gang_scheduling = enable_gang_scheduling
         self.recorder = recorder or EventRecorder(clientset, CONTROLLER_NAME)
-        self.queue = RateLimitingQueue()
+        self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v1")
         self.jobs: dict[str, TrainingJob] = {}  # key -> TrainingJob
         self._jobs_lock = threading.Lock()

@@ -31,9 +31,9 @@ from k8s_tpu.controller_v2 import service as service_mod
 from k8s_tpu.controller_v2 import status as status_mod
 from k8s_tpu.controller_v2 import tpu_config
 from k8s_tpu.controller_v2.control import RealPodControl, RealServiceControl
-from k8s_tpu.controller_v2.expectations import ControllerExpectations
+from k8s_tpu.controller_v2.expectations import new_controller_expectations
 from k8s_tpu.util import metrics
-from k8s_tpu.util.workqueue import RateLimitingQueue
+from k8s_tpu.util.workqueue import new_rate_limiting_queue
 
 log = logging.getLogger(__name__)
 
@@ -54,9 +54,9 @@ class TFJobController:
         self.recorder = recorder or EventRecorder(clientset, CONTROLLER_NAME)
         self.pod_control = pod_control or RealPodControl(clientset, self.recorder)
         self.service_control = service_control or RealServiceControl(clientset, self.recorder)
-        self.expectations = ControllerExpectations()
+        self.expectations = new_controller_expectations()
         self.enable_gang_scheduling = enable_gang_scheduling
-        self.queue = RateLimitingQueue()
+        self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v2")
 
         self.pod_reconciler = pod_mod.PodReconciler(

@@ -87,3 +87,17 @@ class ControllerExpectations:
     def delete_expectations(self, key: str) -> None:
         with self._lock:
             self._store.pop(key, None)
+
+
+def new_controller_expectations():
+    """Factory seam mirroring workqueue.new_rate_limiting_queue: native TTL
+    cache when the compiled runtime is available, else this module's.
+    Selection policy is shared — k8s_tpu.native.select."""
+    from k8s_tpu import native
+
+    def _native():
+        from k8s_tpu.native.runtime import NativeControllerExpectations
+
+        return NativeControllerExpectations()
+
+    return native.select(_native, ControllerExpectations)

@@ -33,8 +33,11 @@ def operator_manifests(
     namespace: str = DEFAULT_NAMESPACE,
     version: str = "v1alpha2",
 ) -> list[dict]:
-    """Namespace + ServiceAccount + Deployment for the operator (the ksonnet
-    component the reference applies, py/deploy.py:49-88)."""
+    """Namespace + ServiceAccount + RBAC + Deployment for the operator (the
+    ksonnet component the reference applies, py/deploy.py:49-88).  The
+    ClusterRole covers everything the controllers touch: tfjobs (CRD), pods,
+    services, events, endpoints (leader election), and PDBs (gang
+    scheduling)."""
     labels = {"name": "tf-job-operator"}
     return [
         {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}},
@@ -42,6 +45,50 @@ def operator_manifests(
             "apiVersion": "v1",
             "kind": "ServiceAccount",
             "metadata": {"name": "tf-job-operator", "namespace": namespace},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "tf-job-operator"},
+            "rules": [
+                {
+                    "apiGroups": ["kubeflow.org"],
+                    "resources": ["tfjobs", "tfjobs/status"],
+                    "verbs": ["*"],
+                },
+                {
+                    "apiGroups": ["apiextensions.k8s.io"],
+                    "resources": ["customresourcedefinitions"],
+                    "verbs": ["get", "list", "create"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["pods", "services", "endpoints", "events", "namespaces"],
+                    "verbs": ["*"],
+                },
+                {
+                    "apiGroups": ["policy"],
+                    "resources": ["poddisruptionbudgets"],
+                    "verbs": ["*"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "tf-job-operator"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "tf-job-operator",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "tf-job-operator",
+                    "namespace": namespace,
+                }
+            ],
         },
         {
             "apiVersion": "apps/v1",
@@ -91,11 +138,13 @@ def write_manifests(output_dir: str, image: str, namespace: str, version: str) -
     """Render CRDs + operator manifests to files kubectl can apply."""
     os.makedirs(output_dir, exist_ok=True)
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # Both CRD files define the same object name (tfjobs.kubeflow.org), so
+    # apply only the one matching the operator generation being deployed.
+    crd = "crd.yaml" if version == "v1alpha1" else "crd-v1alpha2.yaml"
     paths = []
-    for crd in ("crd.yaml", "crd-v1alpha2.yaml"):
-        src = os.path.join(repo, "examples", "crd", crd)
-        if os.path.exists(src):
-            paths.append(src)
+    src = os.path.join(repo, "examples", "crd", crd)
+    if os.path.exists(src):
+        paths.append(src)
     operator_path = os.path.join(output_dir, "tf-job-operator.yaml")
     with open(operator_path, "w") as f:
         yaml.safe_dump_all(operator_manifests(image, namespace, version), f)

@@ -103,14 +103,15 @@ def build_chart_package(repo_dir: str, image: str, version: str, output_dir: str
     import shutil
 
     chart_src = os.path.join(repo_dir, "examples", "tf_job_chart")
-    staging = os.path.join(tempfile.mkdtemp(prefix="chart-"), "tf-job")
-    shutil.copytree(chart_src, staging)
-    update_values(os.path.join(staging, "values.yaml"), image)
-    update_chart(os.path.join(staging, "Chart.yaml"), version)
     os.makedirs(output_dir, exist_ok=True)
     pkg = os.path.join(output_dir, f"tf-job-operator-chart-{version}.tgz")
-    with tarfile.open(pkg, "w:gz") as tar:
-        tar.add(staging, arcname="tf-job")
+    with tempfile.TemporaryDirectory(prefix="chart-") as tmp:
+        staging = os.path.join(tmp, "tf-job")
+        shutil.copytree(chart_src, staging)
+        update_values(os.path.join(staging, "values.yaml"), image)
+        update_chart(os.path.join(staging, "Chart.yaml"), version)
+        with tarfile.open(pkg, "w:gz") as tar:
+            tar.add(staging, arcname="tf-job")
     return pkg
 
 

@@ -1,0 +1,129 @@
+"""Native runtime loader.
+
+Builds ``src/runtime.cc`` into a shared library with the system g++ on first
+use (no pybind11/pip in this image — plain C ABI over ctypes) and caches it
+under ``_build/``.  Everything degrades gracefully: when no toolchain is
+present, :func:`available` is False and callers keep the pure-Python
+implementations (k8s_tpu/util/workqueue.py, controller_v2/expectations.py).
+
+Opt-in/out: env ``K8S_TPU_NATIVE`` — "1" forces native (raises if unbuildable),
+"0" disables, unset/auto uses native when it builds.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import shutil
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "runtime.cc")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LIB = os.path.join(_BUILD_DIR, "libk8stpu_runtime.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the library if stale; returns the .so path or None."""
+    if not force and os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    gxx = shutil.which("g++")
+    if gxx is None:
+        log.warning("g++ not found; native runtime unavailable")
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = _LIB + ".tmp"
+    cmd = [gxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        log.error("native build failed: %s", e.stderr)
+        return None
+    os.replace(tmp, _LIB)
+    log.info("built native runtime: %s", _LIB)
+    return _LIB
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes.c_char_p
+    lib.rlq_new.restype = ctypes.c_void_p
+    lib.rlq_new.argtypes = [ctypes.c_double] * 4
+    lib.rlq_free.argtypes = [ctypes.c_void_p]
+    lib.rlq_add.argtypes = [ctypes.c_void_p, c]
+    lib.rlq_add_after.argtypes = [ctypes.c_void_p, c, ctypes.c_double]
+    lib.rlq_add_rate_limited.argtypes = [ctypes.c_void_p, c]
+    lib.rlq_get.restype = ctypes.c_int
+    lib.rlq_get.argtypes = [ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+    lib.rlq_done.argtypes = [ctypes.c_void_p, c]
+    lib.rlq_forget.argtypes = [ctypes.c_void_p, c]
+    lib.rlq_num_requeues.restype = ctypes.c_int
+    lib.rlq_num_requeues.argtypes = [ctypes.c_void_p, c]
+    lib.rlq_len.restype = ctypes.c_int
+    lib.rlq_len.argtypes = [ctypes.c_void_p]
+    lib.rlq_shut_down.argtypes = [ctypes.c_void_p]
+    lib.rlq_shutting_down.restype = ctypes.c_int
+    lib.rlq_shutting_down.argtypes = [ctypes.c_void_p]
+
+    lib.exp_new.restype = ctypes.c_void_p
+    lib.exp_new.argtypes = [ctypes.c_double]
+    lib.exp_free.argtypes = [ctypes.c_void_p]
+    lib.exp_expect_creations.argtypes = [ctypes.c_void_p, c, ctypes.c_long]
+    lib.exp_expect_deletions.argtypes = [ctypes.c_void_p, c, ctypes.c_long]
+    lib.exp_creation_observed.argtypes = [ctypes.c_void_p, c]
+    lib.exp_deletion_observed.argtypes = [ctypes.c_void_p, c]
+    lib.exp_raise.argtypes = [ctypes.c_void_p, c, ctypes.c_long, ctypes.c_long]
+    lib.exp_satisfied.restype = ctypes.c_int
+    lib.exp_satisfied.argtypes = [ctypes.c_void_p, c]
+    lib.exp_delete.argtypes = [ctypes.c_void_p, c]
+    return lib
+
+
+def load() -> ctypes.CDLL | None:
+    """Build-if-needed and dlopen the native runtime; None when unavailable."""
+    global _lib, _tried
+    if os.environ.get("K8S_TPU_NATIVE", "") == "0":
+        return None  # checked outside the cache: the env var works at any time
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = build()
+        if path is None:
+            if os.environ.get("K8S_TPU_NATIVE") == "1":
+                raise RuntimeError("K8S_TPU_NATIVE=1 but native runtime failed to build")
+            return None
+        _lib = _declare(ctypes.CDLL(path))
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def select(native_factory, fallback_factory):
+    """THE selection policy, shared by every factory seam
+    (workqueue.new_rate_limiting_queue, expectations.new_controller_expectations).
+
+    - ``K8S_TPU_NATIVE=0``: fallback (handled inside :func:`load`).
+    - ``K8S_TPU_NATIVE=1``: native or raise — a forced-native operator must
+      never silently run pure Python.
+    - unset: native when it builds, else fallback.
+    """
+    lib = load()  # raises only in forced mode when unbuildable
+    if lib is None:
+        return fallback_factory()
+    try:
+        return native_factory()
+    except Exception:
+        if os.environ.get("K8S_TPU_NATIVE") == "1":
+            raise
+        log.warning("native factory failed; using Python fallback", exc_info=True)
+        return fallback_factory()

@@ -1,0 +1,120 @@
+"""Python wrappers over the native runtime (ctypes, see __init__.py).
+
+Drop-in interface matches k8s_tpu/util/workqueue.RateLimitingQueue and
+k8s_tpu/controller_v2/expectations.ControllerExpectations, so the
+controllers can take either implementation through their factory seams.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from k8s_tpu import native
+from k8s_tpu.controller_v2.expectations import EXPECTATION_TTL_SECONDS
+
+_KEY_BUF = 4096
+
+
+def _b(item) -> bytes:
+    return item.encode() if isinstance(item, str) else bytes(item)
+
+
+class NativeRateLimitingQueue:
+    """workqueue.RateLimitingQueue backed by libk8stpu_runtime.
+
+    Item keys must be strings (controller keys are "<ns>/<name>", which is
+    all the operators ever enqueue).
+    """
+
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 1000.0,
+        qps: float = 10.0,
+        burst: int = 100,
+    ):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.rlq_new(base_delay, max_delay, qps, float(burst))
+
+    def add(self, item: str) -> None:
+        self._lib.rlq_add(self._h, _b(item))
+
+    def add_after(self, item: str, delay: float) -> None:
+        self._lib.rlq_add_after(self._h, _b(item), delay)
+
+    def add_rate_limited(self, item: str) -> None:
+        self._lib.rlq_add_rate_limited(self._h, _b(item))
+
+    def get(self, timeout: Optional[float] = None):
+        import ctypes
+
+        buf = ctypes.create_string_buffer(_KEY_BUF)
+        rc = self._lib.rlq_get(self._h, -1.0 if timeout is None else timeout, buf, _KEY_BUF)
+        if rc == 1:
+            return buf.value.decode(), False
+        if rc == 0:
+            return None, False
+        return None, True
+
+    def done(self, item: str) -> None:
+        self._lib.rlq_done(self._h, _b(item))
+
+    def forget(self, item: str) -> None:
+        self._lib.rlq_forget(self._h, _b(item))
+
+    def num_requeues(self, item: str) -> int:
+        return self._lib.rlq_num_requeues(self._h, _b(item))
+
+    def shut_down(self) -> None:
+        self._lib.rlq_shut_down(self._h)
+
+    def shutting_down(self) -> bool:
+        return bool(self._lib.rlq_shutting_down(self._h))
+
+    def __len__(self) -> int:
+        return self._lib.rlq_len(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None):
+            self._lib.rlq_free(h)
+
+
+class NativeControllerExpectations:
+    """expectations.ControllerExpectations backed by libk8stpu_runtime."""
+
+    def __init__(self, ttl_seconds: float = EXPECTATION_TTL_SECONDS):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.exp_new(ttl_seconds)
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self._lib.exp_expect_creations(self._h, _b(key), count)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        self._lib.exp_expect_deletions(self._h, _b(key), count)
+
+    def creation_observed(self, key: str) -> None:
+        self._lib.exp_creation_observed(self._h, _b(key))
+
+    def deletion_observed(self, key: str) -> None:
+        self._lib.exp_deletion_observed(self._h, _b(key))
+
+    def raise_expectations(self, key: str, adds: int, dels: int) -> None:
+        self._lib.exp_raise(self._h, _b(key), adds, dels)
+
+    def satisfied(self, key: str) -> bool:
+        return bool(self._lib.exp_satisfied(self._h, _b(key)))
+
+    def delete_expectations(self, key: str) -> None:
+        self._lib.exp_delete(self._h, _b(key))
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None):
+            self._lib.exp_free(h)

@@ -222,3 +222,18 @@ class RateLimitingQueue(DelayingQueue):
 
     def num_requeues(self, item: Hashable) -> int:
         return self.rate_limiter.num_requeues(item)
+
+
+def new_rate_limiting_queue():
+    """Factory seam: the native (C++) queue when libk8stpu_runtime builds,
+    else this module's pure-Python implementation.  Selection policy lives
+    in one place: k8s_tpu.native.select (env K8S_TPU_NATIVE=1/0/unset).
+    Both implementations expose identical semantics (tests/test_native.py)."""
+    from k8s_tpu import native
+
+    def _native():
+        from k8s_tpu.native.runtime import NativeRateLimitingQueue
+
+        return NativeRateLimitingQueue()
+
+    return native.select(_native, RateLimitingQueue)

@@ -103,19 +103,51 @@ class TestDeploy:
     def test_operator_manifests_shape(self):
         docs = deploy.operator_manifests(image="reg/op:1", namespace="kubeflow")
         kinds = [d["kind"] for d in docs]
-        assert kinds == ["Namespace", "ServiceAccount", "Deployment"]
+        assert kinds == [
+            "Namespace",
+            "ServiceAccount",
+            "ClusterRole",
+            "ClusterRoleBinding",
+            "Deployment",
+        ]
         dep = docs[-1]
         [container] = dep["spec"]["template"]["spec"]["containers"]
         assert container["image"] == "reg/op:1"
         assert "operator_v2" in container["command"][-1]
+        # RBAC grants cover the controllers' resource surface
+        role = docs[2]
+        resources = {r for rule in role["rules"] for r in rule["resources"]}
+        assert {"tfjobs", "pods", "services", "events", "endpoints",
+                "poddisruptionbudgets"} <= resources
+        binding = docs[3]
+        assert binding["subjects"][0]["namespace"] == "kubeflow"
 
     def test_write_manifests(self, tmp_path):
         paths = deploy.write_manifests(str(tmp_path), "reg/op:1", "kubeflow", "v1alpha2")
-        assert any(p.endswith("crd-v1alpha2.yaml") for p in paths)
+        # only the matching CRD version is applied (same object name)
+        crds = [p for p in paths if "/crd/" in p]
+        assert len(crds) == 1 and crds[0].endswith("crd-v1alpha2.yaml")
         rendered = [p for p in paths if p.startswith(str(tmp_path))]
         assert len(rendered) == 1
         docs = list(yaml.safe_load_all(open(rendered[0])))
-        assert [d["kind"] for d in docs] == ["Namespace", "ServiceAccount", "Deployment"]
+        assert [d["kind"] for d in docs] == [
+            "Namespace",
+            "ServiceAccount",
+            "ClusterRole",
+            "ClusterRoleBinding",
+            "Deployment",
+        ]
+
+    def test_crds_are_apiextensions_v1(self):
+        for name, version in (("crd.yaml", "v1alpha1"), ("crd-v1alpha2.yaml", "v1alpha2")):
+            [doc] = list(
+                yaml.safe_load_all(open(os.path.join(REPO, "examples", "crd", name)))
+            )
+            assert doc["apiVersion"] == "apiextensions.k8s.io/v1", name
+            [v] = doc["spec"]["versions"]
+            assert v["name"] == version
+            assert v["storage"] is True
+            assert doc["spec"]["scope"] == "Namespaced"
 
     def test_setup_local_runs_a_job(self):
         import datetime

@@ -1,0 +1,211 @@
+"""Native (C++) runtime core: parity with the pure-Python workqueue and
+expectations implementations, plus an end-to-end operator run on top of it.
+
+The reference's hot loop is compiled Go (client-go workqueue +
+k8s.io/kubernetes expectations); libk8stpu_runtime is our compiled
+equivalent, and these tests pin its semantics to the Python reference
+implementation parameter-for-parameter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from k8s_tpu import native
+from k8s_tpu.controller_v2.expectations import (
+    ControllerExpectations,
+    new_controller_expectations,
+)
+from k8s_tpu.util.workqueue import RateLimitingQueue, new_rate_limiting_queue
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime not buildable (no g++)"
+)
+
+
+def make_pair():
+    from k8s_tpu.native.runtime import NativeRateLimitingQueue
+
+    return RateLimitingQueue(), NativeRateLimitingQueue()
+
+
+class TestQueueParity:
+    def test_dedup_while_queued(self):
+        for q in make_pair():
+            q.add("default/a")
+            q.add("default/a")
+            q.add("default/b")
+            assert len(q) == 2, type(q).__name__
+
+    def test_readd_while_processing_requeues_after_done(self):
+        for q in make_pair():
+            q.add("default/a")
+            item, shutdown = q.get(1)
+            assert (item, shutdown) == ("default/a", False)
+            q.add("default/a")  # goes dirty, not queued
+            assert len(q) == 0
+            q.done("default/a")
+            assert len(q) == 1
+
+    def test_get_timeout(self):
+        for q in make_pair():
+            t0 = time.monotonic()
+            assert q.get(0.05) == (None, False)
+            assert time.monotonic() - t0 >= 0.04
+
+    def test_shutdown_unblocks_getters(self):
+        for q in make_pair():
+            results = []
+
+            def worker():
+                results.append(q.get(5))
+
+            t = threading.Thread(target=worker)
+            t.start()
+            time.sleep(0.05)
+            q.shut_down()
+            t.join(timeout=2)
+            assert not t.is_alive()
+            assert results == [(None, True)]
+            assert q.shutting_down()
+
+    def test_add_after_orders_by_deadline(self):
+        for q in make_pair():
+            q.add_after("late", 0.2)
+            q.add_after("early", 0.02)
+            assert q.get(1)[0] == "early", type(q).__name__
+            assert q.get(1)[0] == "late", type(q).__name__
+
+    def test_rate_limited_backoff_grows_and_forget_resets(self):
+        for q in make_pair():
+            # exp backoff: 5ms, 10ms, 20ms...
+            q.add_rate_limited("k")
+            assert q.num_requeues("k") == 1
+            assert q.get(1)[0] == "k"
+            q.done("k")
+            q.add_rate_limited("k")
+            q.add_rate_limited("k")
+            assert q.num_requeues("k") == 3
+            q.forget("k")
+            assert q.num_requeues("k") == 0
+
+    def test_backoff_delay_actually_waits(self):
+        from k8s_tpu.native.runtime import NativeRateLimitingQueue
+
+        q = NativeRateLimitingQueue(base_delay=0.1, max_delay=1.0)
+        q.add_rate_limited("k")  # first failure: 0.1s delay
+        t0 = time.monotonic()
+        assert q.get(0.02) == (None, False)  # not yet available
+        assert q.get(2)[0] == "k"
+        assert time.monotonic() - t0 >= 0.05
+
+
+class TestExpectationsParity:
+    def impls(self):
+        from k8s_tpu.native.runtime import NativeControllerExpectations
+
+        return ControllerExpectations(), NativeControllerExpectations()
+
+    def test_unknown_key_is_satisfied(self):
+        for e in self.impls():
+            assert e.satisfied("ns/j/pods") is True
+
+    def test_expect_then_observe(self):
+        for e in self.impls():
+            e.expect_creations("k", 2)
+            assert e.satisfied("k") is False
+            e.creation_observed("k")
+            assert e.satisfied("k") is False
+            e.creation_observed("k")
+            assert e.satisfied("k") is True
+
+    def test_pending_expectations_accumulate(self):
+        """The burst-accumulation semantics our Python impl deliberately
+        chose over upstream replace (see expectations.py docstring)."""
+        for e in self.impls():
+            e.expect_creations("k", 1)
+            e.expect_creations("k", 1)
+            e.creation_observed("k")
+            assert e.satisfied("k") is False, type(e).__name__
+            e.creation_observed("k")
+            assert e.satisfied("k") is True
+
+    def test_deletions_and_raise(self):
+        for e in self.impls():
+            e.expect_deletions("k", 1)
+            assert e.satisfied("k") is False
+            e.raise_expectations("k", 1, 0)
+            e.deletion_observed("k")
+            assert e.satisfied("k") is False
+            e.creation_observed("k")
+            assert e.satisfied("k") is True
+
+    def test_delete_expectations(self):
+        for e in self.impls():
+            e.expect_creations("k", 5)
+            e.delete_expectations("k")
+            assert e.satisfied("k") is True
+
+    def test_ttl_expiry(self):
+        from k8s_tpu.native.runtime import NativeControllerExpectations
+
+        e = NativeControllerExpectations(ttl_seconds=0.05)
+        e.expect_creations("k", 5)
+        assert e.satisfied("k") is False
+        time.sleep(0.08)
+        assert e.satisfied("k") is True
+
+
+class TestFactories:
+    def test_factories_pick_native_when_available(self):
+        from k8s_tpu.native.runtime import (
+            NativeControllerExpectations,
+            NativeRateLimitingQueue,
+        )
+
+        assert isinstance(new_rate_limiting_queue(), NativeRateLimitingQueue)
+        assert isinstance(new_controller_expectations(), NativeControllerExpectations)
+
+    def test_disable_env_forces_python(self, monkeypatch):
+        monkeypatch.setenv("K8S_TPU_NATIVE", "0")
+        assert isinstance(new_rate_limiting_queue(), RateLimitingQueue)
+        assert isinstance(new_controller_expectations(), ControllerExpectations)
+
+
+class TestOperatorOnNativeRuntime:
+    def test_v2_job_runs_on_native_queue(self):
+        """Full LocalCluster pass with the controller on the native queue +
+        expectations (the factories select them automatically here)."""
+        import datetime
+        import os
+
+        from k8s_tpu.api import manifest
+        from k8s_tpu.e2e.local import LocalCluster
+        from k8s_tpu.harness import tf_job_client
+        from k8s_tpu.native.runtime import NativeRateLimitingQueue
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        [job] = manifest.load_tfjobs_from_file(
+            os.path.join(repo, "examples", "tpu_smoke.yaml")
+        )
+        job.spec.tf_replica_specs["TPU"].template["spec"]["containers"][0].pop(
+            "command"
+        )  # commandless: kubelet simulator exits 0
+        with LocalCluster(version="v1alpha2") as lc:
+            assert isinstance(lc.controller.queue, NativeRateLimitingQueue)
+            created = tf_job_client.create_tf_job(
+                lc.clientset, job.to_dict(), version="v1alpha2"
+            )
+            finished = tf_job_client.wait_for_job(
+                lc.clientset,
+                created["metadata"]["namespace"],
+                created["metadata"]["name"],
+                version="v1alpha2",
+                timeout=datetime.timedelta(seconds=30),
+                polling_interval=datetime.timedelta(milliseconds=50),
+            )
+        conds = [c["type"] for c in finished["status"]["conditions"]]
+        assert "Succeeded" in conds
