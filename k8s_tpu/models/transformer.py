@@ -38,6 +38,8 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    use_flash_attention: bool = False  # Pallas fused kernel (k8s_tpu.ops)
+    use_fused_norm: bool = False  # Pallas RMSNorm kernel (k8s_tpu.ops)
     remat: bool = True  # jax.checkpoint each layer: HBM for FLOPs
 
     @property
@@ -72,10 +74,15 @@ def tiny_test() -> TransformerConfig:
 
 class RMSNorm(nn.Module):
     eps: float = 1e-6
+    fused: bool = False  # Pallas row kernel instead of XLA chain
 
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        if self.fused:
+            from k8s_tpu.ops import rms_norm
+
+            return rms_norm(x, scale, eps=self.eps)
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
         return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale
 
@@ -139,6 +146,10 @@ class Attention(nn.Module):
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
             out = ring_attention(mesh, q, k, v, causal=cfg.causal)
+        elif cfg.use_flash_attention:
+            from k8s_tpu.ops import flash_attention
+
+            out = flash_attention(q, k, v, causal=cfg.causal)
         else:
             out = _plain_attention(q, k, v, cfg.causal)
 
@@ -168,11 +179,13 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
+        fused = self.config.use_fused_norm
         y = Attention(self.config, mesh=self.mesh, name="attn")(
-            RMSNorm(name="attn_norm")(x), positions
+            RMSNorm(fused=fused, name="attn_norm")(x), positions
         )
         x = x + y
-        y = MLP(self.config, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        y = MLP(self.config, name="mlp")(
+            RMSNorm(fused=fused, name="mlp_norm")(x))
         return x + y
 
 
@@ -198,7 +211,7 @@ class Transformer(nn.Module):
         for i in range(cfg.layers):
             x = block(cfg, mesh=mesh, name=f"layer_{i}")(x, positions)
 
-        x = RMSNorm(name="final_norm")(x)
+        x = RMSNorm(fused=cfg.use_fused_norm, name="final_norm")(x)
         # tied embeddings: logits = x @ emb.T, f32 for a stable softmax
         logits = jnp.einsum(
             "bld,vd->blv", x.astype(jnp.float32), emb.astype(jnp.float32)

@@ -1,0 +1,18 @@
+"""Pallas TPU kernels for the hot ops of the workload layer.
+
+The reference has no accelerator kernels at all (SURVEY.md §2: the operator
+is pure K8s plumbing; compute lived in user TF1 graphs).  In the TPU-native
+rebuild the workload layer owns the FLOPs, so the hot paths get hand-written
+Pallas kernels where XLA's automatic fusion isn't enough:
+
+- :mod:`k8s_tpu.ops.flash_attention` — blockwise fused attention
+  (forward + backward, causal + bidirectional, GQA) that never materializes
+  the O(L^2) score matrix in HBM;
+- :mod:`k8s_tpu.ops.fused_norm` — RMSNorm row kernel.
+
+All kernels run in Pallas interpret mode on CPU (used by the test suite and
+the driver's virtual-device dryrun) and compile to Mosaic on TPU.
+"""
+
+from k8s_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from k8s_tpu.ops.fused_norm import rms_norm  # noqa: F401

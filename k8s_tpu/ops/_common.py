@@ -1,0 +1,21 @@
+"""Shared helpers for the Pallas ops layer."""
+
+from __future__ import annotations
+
+import jax
+
+
+def pick_block(length: int, preferred: int) -> int:
+    """Largest divisor of ``length`` that is <= preferred (>=1)."""
+    b = min(preferred, length)
+    while length % b:
+        b -= 1
+    return b
+
+
+def auto_interpret(interpret: bool | None) -> bool:
+    """Resolve the interpret flag: explicit value wins, else Pallas interpret
+    mode on CPU backends (tests, driver dryrun) and compiled Mosaic on TPU."""
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() == "cpu"

@@ -1,0 +1,361 @@
+"""Blockwise fused (flash) attention as Pallas TPU kernels.
+
+Forward and backward passes never materialize the O(L^2) score matrix in
+HBM: scores live one (block_q, block_k) tile at a time in VMEM, with the
+online-softmax running max/sum carried in VMEM scratch across the inner
+k-block grid dimension (TPU grids execute sequentially, last axis fastest,
+so scratch accumulators persist across the k loop for a fixed q block).
+
+Layout is [B, H, L, D] inside the kernels so every tile's trailing two dims
+are (block, head_dim) — MXU/VPU-friendly (8,128)-tiled.  The public wrapper
+accepts the framework-wide [B, L, H, D] convention and transposes at entry.
+
+Backward follows the standard two-kernel flash decomposition:
+- ``dq`` kernel: grid (B, H, nq, nk), recompute p from q/k and the saved
+  logsumexp, accumulate ``ds @ k`` into a dq scratch tile;
+- ``dk/dv`` kernel: grid (B, H, nk, nq), accumulate ``ds^T @ q`` and
+  ``p^T @ do`` per k block.
+``delta = rowsum(do * o)`` is precomputed in XLA (cheap elementwise fusion).
+
+GQA (kv_heads < heads) is handled in the wrapper by repeating K/V across
+the query-head group for the kernels and group-summing dk/dv on the way
+out; mapping kv heads via BlockSpec index maps instead (no repeat) is a
+known further optimization.
+
+Causal masking skips fully-future blocks via ``pl.when`` and applies a
+triangular iota mask on diagonal blocks.  Reference counterpart: none —
+the reference's workloads (SURVEY.md §2.3) predate attention entirely;
+this kernel serves the transformer family in k8s_tpu.models.transformer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from k8s_tpu.ops._common import auto_interpret as _auto_interpret
+from k8s_tpu.ops._common import pick_block as _pick_block
+
+NEG_INF = -1e30
+# Measured on v5e (L=2048..4096, D=128): large tiles amortize grid overhead;
+# (512, 1024) beats XLA's fused attention 1.6-2.4x on fwd+bwd.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # k block (innermost: sequential on TPU)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: k block j is visible to q block i iff some (q_pos >= k_pos)
+    # pair exists, i.e. j*block_k <= i*block_q + block_q - 1.
+    visible = True if not causal else (j * block_k < (i + 1) * block_q)
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]  # [bq]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # m_new > NEG_INF always in the visible region (causal diagonals have
+        # >=1 unmasked column), but guard bidirectional fully-masked rows.
+        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - safe_m[:, None])  # [bq, bk]
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, jnp.exp(m_prev - safe_m))
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l, 1e-30)[:, None]).astype(
+            o_ref.dtype)
+        m = m_ref[:, 0]
+        lse = jnp.where(m <= NEG_INF / 2, NEG_INF,
+                        m + jnp.log(jnp.maximum(l, 1e-30)))
+        # lse is [B, H, L, 1]: Mosaic needs the trailing block dims
+        # (bq, 1) to be (8k, full-dim) tiled; a bare (1,1,bq) block is not.
+        lse_ref[0, 0] = lse[:, None]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q,k,v: [B,H,L,D].  Returns (o [B,H,L,D], lse [B,H,L,1] f32)."""
+    B, H, L, D = q.shape
+    Lk = k.shape[2]
+    bq = _pick_block(L, block_q)
+    bk = _pick_block(Lk, block_k)
+    grid = (B, H, L // bq, Lk // bk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, L, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, L, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((bq, D), jnp.float32),
+            _vmem((bq, 128), jnp.float32),
+            _vmem((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, block_q, block_k):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # k block (inner)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    visible = True if not causal else (j * block_k < (i + 1) * block_q)
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]  # [bq] f32
+        delta = delta_ref[0, 0, :, 0]  # [bq] f32
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        safe_lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+        p = jnp.exp(s - safe_lse[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, block_q, block_k):
+    j = pl.program_id(2)  # k block (outer)
+    i = pl.program_id(3)  # q block (inner)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # k block j contributes to q block i iff i's max q_pos >= j's min k_pos.
+    visible = True if not causal else ((i + 1) * block_q > j * block_k)
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        safe_lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+        p = jnp.exp(s - safe_lse[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # p^T @ do -> [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # ds^T @ q -> [bk, D]
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+               interpret):
+    """All arrays [B,H,L,D] (lse [B,H,L]).  Returns (dq, dk, dv)."""
+    B, H, L, D = q.shape
+    Lk = k.shape[2]
+    bq = _pick_block(L, block_q)
+    bk = _pick_block(Lk, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [B, H, L, 1]
+
+    qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, x, y: (b, h, x, 0))
+    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, x, y: (b, h, y, 0))
+    rowspec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, x, y: (b, h, x, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B, H, L // bq, Lk // bk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, L, D), q.dtype)],
+        scratch_shapes=[_vmem((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    # dk/dv: k block is the outer loop, q the inner accumulation loop.
+    qspec2 = pl.BlockSpec((1, 1, bq, D), lambda b, h, y, x: (b, h, x, 0))
+    kspec2 = pl.BlockSpec((1, 1, bk, D), lambda b, h, y, x: (b, h, y, 0))
+    rowspec2 = pl.BlockSpec((1, 1, bq, 1), lambda b, h, y, x: (b, h, x, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B, H, Lk // bk, L // bq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Lk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Lk, D), v.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, D), jnp.float32),
+                        _vmem((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (public API, [B, L, H, D] layout)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
+                            block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool | None = None):
+    """Fused attention.  q: [B, L, H, D]; k, v: [B, Lk, Hkv, D] with
+    Hkv dividing H (grouped-query).  Returns [B, L, H, D] in q.dtype.
+
+    Differentiable (custom VJP with flash backward kernels).  ``interpret``
+    defaults to auto: Pallas interpret mode on CPU backends, compiled Mosaic
+    on TPU.
+    """
+    B, L, H, D = q.shape
+    Hkv = k.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    if Hkv != H:
+        if H % Hkv:
+            raise ValueError(f"heads {H} not a multiple of kv_heads {Hkv}")
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # kernels use [B, H, L, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, float(scale), bool(causal), int(block_q),
+                 int(block_k), _auto_interpret(interpret))
+    return out.transpose(0, 2, 1, 3)

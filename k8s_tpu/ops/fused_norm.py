@@ -1,0 +1,92 @@
+"""Fused RMSNorm row kernel (Pallas TPU).
+
+One VMEM pass per row block: mean-of-squares, rsqrt, scale — no HBM round
+trip for the intermediate variance.  Forward is a Pallas kernel; backward
+is a hand-derived XLA VJP (the bwd math is a short elementwise+reduction
+chain XLA fuses completely, so a kernel would buy nothing).
+
+Semantics match k8s_tpu.models.transformer.RMSNorm's plain path exactly,
+including its dtype promotion: the normalized activation is rounded to
+x.dtype, then multiplied by the (typically f32) scale, so the output dtype
+is ``result_type(x, scale)``.
+
+Used by the transformer family when ``TransformerConfig.use_fused_norm`` is
+set.  Reference counterpart: none (SURVEY.md §2 — the reference has no
+accelerator kernels).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from k8s_tpu.ops._common import auto_interpret, pick_block
+
+
+def _rms_kernel(x_ref, scale_ref, o_ref, *, eps, x_dtype):
+    x = x_ref[...].astype(jnp.float32)  # [br, D]
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    # Round the normalized activation to x.dtype before scaling — exact
+    # parity with the unfused module's `(...).astype(x.dtype) * scale`.
+    y = y.astype(x_dtype).astype(jnp.float32)
+    o_ref[...] = (y * scale_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms(x2d, scale, eps, interpret):
+    N, D = x2d.shape
+    br = pick_block(N, 256)
+    out_dtype = jnp.result_type(x2d.dtype, scale.dtype)
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps, x_dtype=x2d.dtype),
+        grid=(N // br,),
+        in_specs=[
+            pl.BlockSpec((br, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((br, D), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, D), out_dtype),
+        interpret=interpret,
+    )(x2d, scale)
+
+
+def _rms_fwd(x2d, scale, eps, interpret):
+    return _rms(x2d, scale, eps, interpret), (x2d, scale)
+
+
+def _rms_bwd(eps, interpret, res, g):
+    # y_i = xhat_i * s_i with xhat = x * r, r = rsqrt(mean(x^2) + eps).
+    # dr/dx_i = -(x_i / D) r^3, which gives
+    #   dx = r * (g*s - xhat * mean(g*s * xhat))
+    # (verified against jax autodiff across eps scales).
+    x2d, scale = res
+    x = x2d.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    s32 = scale.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(var + eps)
+    xhat = x * r
+    dscale = jnp.sum(g32 * xhat, axis=0).astype(scale.dtype)
+    gs = g32 * s32
+    dx = r * (gs - xhat * jnp.mean(gs * xhat, axis=-1, keepdims=True))
+    return dx.astype(x2d.dtype), dscale
+
+
+_rms.defvjp(_rms_fwd, _rms_bwd)
+
+
+def rms_norm(x, scale, *, eps: float = 1e-6, interpret: bool | None = None):
+    """RMSNorm over the last axis.  x: [..., D]; scale: [D].
+
+    Returns ``result_type(x, scale)``; differentiable.  ``interpret`` auto-
+    selects Pallas interpret mode on CPU backends.
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    x2d = x.reshape(-1, D)
+    out = _rms(x2d, scale, float(eps), auto_interpret(interpret))
+    return out.reshape(orig_shape)

@@ -1,0 +1,234 @@
+"""Pallas ops: flash attention and fused RMSNorm vs XLA references.
+
+Runs in Pallas interpret mode on the CPU backend (kernels auto-detect), the
+same ladder the reference uses for hardware-free tiers (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_tpu.ops import flash_attention, rms_norm
+from k8s_tpu.parallel.ring_attention import reference_attention
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+class TestFlashAttentionForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        B, L, H, D = 2, 128, 4, 32
+        q, k, v = (_rand(i, (B, L, H, D)) for i in range(3))
+        out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_uneven_blocks(self):
+        # L=96 with preferred block 64 -> picks divisor 48
+        B, L, H, D = 1, 96, 2, 16
+        q, k, v = (_rand(i, (B, L, H, D)) for i in range(3))
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_gqa(self):
+        B, L, H, Hkv, D = 1, 64, 8, 2, 16
+        q = _rand(0, (B, L, H, D))
+        k = _rand(1, (B, L, Hkv, D))
+        v = _rand(2, (B, L, Hkv, D))
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        ref = reference_attention(q, jnp.repeat(k, 4, axis=2),
+                                  jnp.repeat(v, 4, axis=2), causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_single_block(self):
+        B, L, H, D = 1, 32, 2, 16
+        q, k, v = (_rand(i, (B, L, H, D)) for i in range(3))
+        out = flash_attention(q, k, v, causal=False)
+        ref = reference_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_bf16_dtype_preserved(self):
+        B, L, H, D = 1, 64, 2, 16
+        q, k, v = (_rand(i, (B, L, H, D), jnp.bfloat16) for i in range(3))
+        out = flash_attention(q, k, v, causal=True)
+        assert out.dtype == jnp.bfloat16
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            out.astype(jnp.float32), ref.astype(jnp.float32), atol=3e-2)
+
+
+class TestFlashAttentionBackward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_reference(self, causal):
+        B, L, H, D = 1, 64, 2, 16
+        q, k, v = (_rand(i, (B, L, H, D)) for i in range(3))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=causal,
+                                block_q=32, block_k=32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                gf, gr, atol=5e-4, rtol=5e-4,
+                err_msg=f"d{name} mismatch")
+
+    def test_gqa_grads(self):
+        B, L, H, Hkv, D = 1, 32, 4, 2, 16
+        q = _rand(0, (B, L, H, D))
+        k = _rand(1, (B, L, Hkv, D))
+        v = _rand(2, (B, L, Hkv, D))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(
+                q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+                causal=True) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        # dk/dv shapes must be the unrepeated [B, L, Hkv, D]
+        assert g_flash[1].shape == k.shape
+        assert g_flash[2].shape == v.shape
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(gf, gr, atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_jit_compatible(self):
+        B, L, H, D = 1, 32, 2, 16
+        q, k, v = (_rand(i, (B, L, H, D)) for i in range(3))
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        out = f(q, k, v)
+        assert out.shape == (B, L, H, D)
+
+
+class TestRMSNorm:
+    def test_matches_reference(self):
+        x = _rand(0, (4, 96, 64))
+        scale = 1.0 + 0.1 * _rand(1, (64,))
+        out = rms_norm(x, scale)
+        var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        ref = x * jax.lax.rsqrt(var + 1e-6) * scale
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_grads_match_reference(self):
+        x = _rand(0, (8, 32))
+        scale = 1.0 + 0.1 * _rand(1, (32,))
+
+        def loss_fused(x, s):
+            return jnp.sum(rms_norm(x, s) ** 2)
+
+        def loss_ref(x, s):
+            var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+            return jnp.sum((x * jax.lax.rsqrt(var + 1e-6) * s) ** 2)
+
+        gx_f, gs_f = jax.grad(loss_fused, argnums=(0, 1))(x, scale)
+        gx_r, gs_r = jax.grad(loss_ref, argnums=(0, 1))(x, scale)
+        np.testing.assert_allclose(gx_f, gx_r, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(gs_f, gs_r, atol=1e-4, rtol=1e-4)
+
+    def test_bf16_promotes_like_plain_path(self):
+        # dtype semantics match the unfused RMSNorm module:
+        # (bf16 normalized) * (f32 scale) -> f32
+        x = _rand(0, (16, 128), jnp.bfloat16)
+        scale = jnp.ones((128,), jnp.float32)
+        out = rms_norm(x, scale)
+        assert out.dtype == jnp.float32
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        ref = (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_large_eps_grads(self):
+        # regression: the bwd formula must hold for non-negligible eps
+        x = _rand(0, (4, 8))
+        scale = 1.0 + 0.1 * _rand(1, (8,))
+        eps = 0.5
+
+        def loss_fused(x, s):
+            return jnp.sum(rms_norm(x, s, eps=eps) ** 3)
+
+        def loss_ref(x, s):
+            var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+            return jnp.sum((x * jax.lax.rsqrt(var + eps) * s) ** 3)
+
+        gx_f, gs_f = jax.grad(loss_fused, argnums=(0, 1))(x, scale)
+        gx_r, gs_r = jax.grad(loss_ref, argnums=(0, 1))(x, scale)
+        np.testing.assert_allclose(gx_f, gx_r, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(gs_f, gs_r, atol=1e-5, rtol=1e-5)
+
+
+class TestTransformerKernelIntegration:
+    """Transformer with Pallas kernels on matches the plain XLA path."""
+
+    def test_flash_and_fused_norm_match_plain(self):
+        import dataclasses
+
+        from k8s_tpu.models.transformer import Transformer, tiny_test
+
+        cfg_plain = tiny_test()
+        cfg_fused = dataclasses.replace(
+            cfg_plain, use_flash_attention=True, use_fused_norm=True)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 64), 0, cfg_plain.vocab_size)
+        params = Transformer(cfg_plain).init(jax.random.PRNGKey(1), tokens)
+
+        logits_plain = Transformer(cfg_plain).apply(params, tokens)
+        logits_fused = Transformer(cfg_fused).apply(params, tokens)
+        np.testing.assert_allclose(
+            logits_plain, logits_fused, atol=2e-3, rtol=2e-3)
+
+    def test_bf16_fused_matches_plain(self):
+        import dataclasses
+
+        from k8s_tpu.models.transformer import Transformer, tiny_test
+
+        cfg_plain = dataclasses.replace(tiny_test(), dtype=jnp.bfloat16)
+        cfg_fused = dataclasses.replace(
+            cfg_plain, use_flash_attention=True, use_fused_norm=True)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 32), 0, cfg_plain.vocab_size)
+        params = Transformer(cfg_plain).init(jax.random.PRNGKey(1), tokens)
+
+        logits_plain = Transformer(cfg_plain).apply(params, tokens)
+        logits_fused = Transformer(cfg_fused).apply(params, tokens)
+        assert logits_plain.dtype == logits_fused.dtype
+        np.testing.assert_allclose(
+            logits_plain, logits_fused, atol=5e-2, rtol=5e-2)
+
+    def test_fused_path_trains(self):
+        import dataclasses
+
+        import optax
+
+        from k8s_tpu.models.transformer import Transformer, tiny_test
+
+        cfg = dataclasses.replace(
+            tiny_test(), use_flash_attention=True, use_fused_norm=True)
+        model = Transformer(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 32), 0, cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+
+        def loss_fn(p):
+            logits = model.apply(p, tokens[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tokens[:, 1:]).mean()
+
+        l0 = loss_fn(params)
+        grads = jax.grad(loss_fn)(params)
+        sgd = jax.tree.map(lambda p, g: p - 0.5 * g, params, grads)
+        l1 = loss_fn(sgd)
+        assert jnp.isfinite(l0) and jnp.isfinite(l1)
+        assert l1 < l0
