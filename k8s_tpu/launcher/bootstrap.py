@@ -117,6 +117,81 @@ def make_training_mesh(
     return mesh, cfg
 
 
+_profiler_server = None
+_trace_active = False
+
+
+def setup_observability(env: Optional[dict] = None) -> dict:
+    """Surface JAX profiler / XLA dump hooks from operator-injected env
+    (SURVEY.md §5 "Tracing / profiling": the reference had none; the rebuild
+    exposes them as launcher env, injected like any other pod env var).
+
+    Recognized:
+      JAX_PROFILER_PORT  start jax.profiler.start_server(port) — a pod-local
+                         endpoint TensorBoard/xprof can connect to
+      JAX_PROFILE_DIR    start a programmatic trace now; stop_trace() at
+                         job teardown captures the whole run
+      XLA_DUMP_TO        appended to XLA_FLAGS as --xla_dump_to (effective
+                         only if jax has not initialized a backend yet)
+
+    Returns {hook: value} for what was enabled.
+    """
+    global _profiler_server, _trace_active
+    e = env if env is not None else os.environ
+    enabled: dict = {}
+
+    dump_to = e.get("XLA_DUMP_TO", "")
+    if dump_to:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_dump_to" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_dump_to={dump_to}").strip()
+            enabled["xla_dump_to"] = dump_to
+        else:
+            # Report where dumps actually go: an existing flag wins (XLA
+            # reads it once), so claiming the requested path would send
+            # whoever debugs a compile to an empty directory.
+            existing = [f for f in flags.split() if "--xla_dump_to" in f]
+            actual = existing[0].split("=", 1)[-1] if existing else dump_to
+            enabled["xla_dump_to"] = actual
+            if actual != dump_to:
+                log.warning(
+                    "XLA_DUMP_TO=%s ignored: XLA_FLAGS already dumps to %s",
+                    dump_to, actual)
+
+    port = e.get("JAX_PROFILER_PORT", "")
+    if port:
+        import jax
+
+        if _profiler_server is None:
+            _profiler_server = jax.profiler.start_server(int(port))
+        enabled["profiler_port"] = int(port)
+
+    profile_dir = e.get("JAX_PROFILE_DIR", "")
+    if profile_dir:
+        import jax
+
+        if not _trace_active:
+            jax.profiler.start_trace(profile_dir)
+            _trace_active = True
+        enabled["profile_dir"] = profile_dir
+
+    return enabled
+
+
+def stop_observability(env: Optional[dict] = None) -> None:
+    """Stop a JAX_PROFILE_DIR trace (call at job teardown, chief included).
+    No-op when no trace was actually started — teardown must not mask the
+    job's real exit status."""
+    global _trace_active
+    del env  # kept for call-site symmetry with setup_observability
+    if _trace_active:
+        import jax
+
+        jax.profiler.stop_trace()
+        _trace_active = False
+
+
 def barrier(name: str = "launcher") -> None:
     """Cross-process sync point (used before checkpoint writes / teardown)."""
     import jax

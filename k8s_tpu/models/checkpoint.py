@@ -1,0 +1,164 @@
+"""Checkpoint/resume for training state (orbax-backed).
+
+The reference has no checkpoint subsystem: user code owned checkpoints via
+mounted volumes, and the operator contributed only retryable restarts +
+stable pod identity (SURVEY.md §5 "Checkpoint / resume").  The TPU-native
+rebuild keeps that division but supplies the workload half: an
+orbax CheckpointManager wrapper that
+
+- saves the full train state (params / opt_state / step) atomically, with
+  ``max_to_keep`` pruning and optional async saves;
+- restores **sharding-aware**: the target state's NamedShardings are used as
+  restore args so each host reads only its shards (multi-host resume after a
+  gang restart lands shards directly on the right devices);
+- implements the resume contract ``restore_or_init``: a fresh pod started by
+  the operator after a retryable failure (SIGTERM/143 preemption — exit-code
+  policy in k8s_tpu.util.train_util) finds CHECKPOINT_DIR via the launcher
+  env (k8s_tpu.launcher.bootstrap.LauncherConfig.checkpoint_dir) and picks
+  up at the last saved step;
+- ``save_on_preemption`` wires the operator's SIGTERM grace window into a
+  final synchronous save.
+
+Directory layout is plain orbax (``<dir>/<step>/...``), so checkpoints are
+inspectable with stock tooling.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+
+class Checkpointer:
+    """Train-state checkpoint manager.
+
+    Args:
+      directory: checkpoint root (CHECKPOINT_DIR from the operator env).
+      max_to_keep: newest N checkpoints kept, older pruned.
+      save_interval_steps: ``maybe_save`` only saves on multiples of this.
+      async_save: overlap serialization with the next train steps
+        (``wait()`` or a subsequent save joins the writer).
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1, async_save: bool = False):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = str(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._lock = threading.Lock()
+
+    # -- save ------------------------------------------------------------
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Save ``state`` at ``step``.  Returns True if a save happened
+        (CheckpointManagerOptions may skip off-interval steps unless
+        ``force``)."""
+        with self._lock:
+            return self._save_locked(step, state, force)
+
+    def _save_locked(self, step: int, state: Any, force: bool) -> bool:
+        return self._mgr.save(
+            int(step), args=self._ocp.args.StandardSave(state), force=force)
+
+    def maybe_save(self, step: int, state: Any) -> bool:
+        """Interval-respecting save (the per-step call site in train loops)."""
+        return self.save(step, state)
+
+    # -- restore ---------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int, target_state: Any) -> Any:
+        """Restore ``step`` shaped/sharded like ``target_state`` (abstract
+        arrays with NamedShardings restore shard-local per host)."""
+        import jax
+
+        abstract = jax.tree.map(_as_abstract, target_state)
+        return self._mgr.restore(
+            int(step), args=self._ocp.args.StandardRestore(abstract))
+
+    def restore_latest(self, target_state: Any) -> tuple[Any, Optional[int]]:
+        step = self.latest_step()
+        if step is None:
+            return target_state, None
+        return self.restore(step, target_state), step
+
+    def restore_or_init(self, target_state: Any) -> tuple[Any, int]:
+        """The resume contract: (restored_state, next_step) if a checkpoint
+        exists, else (target_state, 0).  Fresh pods after a gang restart call
+        this unconditionally."""
+        state, step = self.restore_latest(target_state)
+        if step is None:
+            log.info("no checkpoint under %s; fresh start", self.directory)
+            return target_state, 0
+        log.info("resumed from step %d under %s", step, self.directory)
+        return state, step + 1
+
+    # -- lifecycle -------------------------------------------------------
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        """Join any in-flight async save."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def save_on_preemption(self, get_state, get_step):
+        """Register a SIGTERM hook that synchronously saves before the pod's
+        grace period expires (TPU preemptions surface as SIGTERM/143, which
+        the operator's exit-code policy treats as retryable — the checkpoint
+        makes that restart cheap).  ``get_state``/``get_step`` are callables
+        so the hook reads the *current* values at signal time.
+
+        Best-effort by design: Python signal handlers run on the main
+        thread between bytecodes, so if the signal lands while a regular
+        interval save holds the manager lock, blocking here would deadlock
+        the process inside its grace window — instead the hook skips (the
+        in-flight save is at most one interval stale).  Cooperative loops
+        (train.fit with preemption handling) save deterministically at the
+        next step boundary regardless.
+
+        Returns the unsubscribe callable from signals.on_shutdown."""
+        from k8s_tpu.util import signals
+
+        def _save_now():
+            if not self._lock.acquire(blocking=False):
+                log.warning(
+                    "SIGTERM during an in-flight save; skipping final save")
+                return
+            try:
+                step = int(get_step())
+                log.warning("SIGTERM: checkpointing step %d before exit", step)
+                self._save_locked(step, get_state(), force=True)
+                self._mgr.wait_until_finished()
+            except Exception:  # pragma: no cover - best effort on the way out
+                log.exception("preemption checkpoint failed")
+            finally:
+                self._lock.release()
+
+        return signals.on_shutdown(_save_now)
+
+
+def _as_abstract(x):
+    """Leaf → jax.ShapeDtypeStruct carrying sharding when present."""
+    import jax
+
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        sharding = getattr(x, "sharding", None)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+    return x

@@ -117,3 +117,93 @@ def make_sharded_train_step(
         out_shardings=(state_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,),
     )
+
+
+def fit(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    state: dict,
+    mesh: Mesh,
+    data_iter,
+    *,
+    steps: int,
+    checkpoint_dir: str = "",
+    checkpoint_every: int = 100,
+    preemption_save: bool = True,
+    log_every: int = 0,
+) -> tuple[dict, list]:
+    """The canonical training loop: shard state over the mesh, jit the step,
+    checkpoint/resume via k8s_tpu.models.checkpoint.
+
+    ``data_iter`` yields (inputs, targets) global batches.  With
+    ``checkpoint_dir`` set (the operator injects CHECKPOINT_DIR — see
+    launcher.bootstrap.LauncherConfig), the loop resumes from the latest
+    step after a gang restart, saves every ``checkpoint_every`` steps, and —
+    if ``preemption_save`` — registers a SIGTERM hook so TPU preemptions
+    (retryable exit 143 under the operator's exit-code policy) leave a fresh
+    checkpoint behind.  Returns (final_state, losses).
+
+    Note: the jitted step donates the state buffers, so the caller's
+    ``state`` arrays are consumed — use the returned state.
+    """
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    state, shardings = shard_train_state(state, mesh)
+    step_fn = make_sharded_train_step(
+        apply_fn, loss_fn, optimizer, mesh, shardings)
+
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir:
+        from k8s_tpu.models.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(
+            checkpoint_dir, save_interval_steps=checkpoint_every)
+        state, start_step = ckpt.restore_or_init(state)
+
+    # Cooperative preemption: SIGTERM sets a flag; the loop saves at the
+    # next step boundary and returns early (fewer losses than steps tells
+    # the caller to exit 143 → retryable under the operator policy).  A
+    # handler-side synchronous save is deliberately NOT used here — it can
+    # race an in-flight interval save (see Checkpointer.save_on_preemption).
+    import threading
+
+    preempted = threading.Event()
+    unsubscribe = None
+    if preemption_save:
+        from k8s_tpu.util import signals
+
+        unsubscribe = signals.on_shutdown(preempted.set)
+
+    losses = []
+    last_ran = None
+    try:
+        for i in range(start_step, steps):
+            batch = next(data_iter)
+            state, loss = step_fn(state, batch)
+            losses.append(loss)
+            last_ran = i
+            if log_every and (i + 1) % log_every == 0:
+                log.info("step %d loss %.4f", i + 1, float(loss))
+            if ckpt is not None:
+                ckpt.maybe_save(i, state)
+            if preempted.is_set():
+                log.warning(
+                    "preemption: checkpointing step %d and stopping", i)
+                break
+
+        if ckpt is not None:
+            # Final/preemption save, labeled with the last step actually
+            # run.  A no-op run (start_step >= steps) saves nothing: the
+            # restored state already lives at its own step label.
+            if last_ran is not None and ckpt.latest_step() != last_ran:
+                ckpt.save(last_ran, state, force=True)
+            ckpt.wait()
+            ckpt.close()
+    finally:
+        if unsubscribe is not None:
+            unsubscribe()
+    return state, [float(l) for l in losses]

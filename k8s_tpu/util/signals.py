@@ -13,26 +13,85 @@ import threading
 
 _only_one = threading.Lock()
 _installed = False
+_setup_called = False
+_callbacks: list = []
+_stop = threading.Event()
+_prev_handlers: dict = {}
+
+
+def _handler(signum, frame):  # noqa: ARG001
+    if _stop.is_set():
+        os._exit(1)  # second signal: exit directly (signal.go:40-42)
+    _stop.set()
+    for cb in list(_callbacks):
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 - shutdown path must not raise
+            pass
+
+
+def _install() -> None:
+    global _installed
+    _installed = True
+    _prev_handlers[signal.SIGINT] = signal.signal(signal.SIGINT, _handler)
+    _prev_handlers[signal.SIGTERM] = signal.signal(signal.SIGTERM, _handler)
+
+
+def _uninstall() -> None:
+    global _installed
+    _installed = False
+    for sig, prev in _prev_handlers.items():
+        signal.signal(sig, prev)
+    _prev_handlers.clear()
 
 
 def setup_signal_handler() -> threading.Event:
-    """Install SIGINT/SIGTERM handler; may only be called once per process."""
-    global _installed
-    if not _only_one.acquire(blocking=False) or _installed:
-        raise RuntimeError("setup_signal_handler called twice")
-    _installed = True
-    _only_one.release()
+    """Install SIGINT/SIGTERM handler; may only be called once per process
+    (operator binaries).  Composes with ``on_shutdown``: callbacks
+    registered before or after still fire on the first signal."""
+    global _setup_called
+    with _only_one:
+        if _setup_called:
+            raise RuntimeError("setup_signal_handler called twice")
+        _setup_called = True
+        if not _installed:
+            _install()
+    return _stop
 
-    stop = threading.Event()
 
-    def _handler(signum, frame):  # noqa: ARG001
-        if stop.is_set():
-            os._exit(1)  # second signal: exit directly (signal.go:40-42)
-        stop.set()
+def on_shutdown(callback):
+    """Register ``callback`` to run on the first SIGINT/SIGTERM (before the
+    double-signal hard-exit window).  Used for best-effort work on the way
+    out — e.g. a final checkpoint save inside the pod's SIGTERM grace period
+    (cooperative loop in k8s_tpu.models.train.fit, handler-side fallback in
+    Checkpointer.save_on_preemption).  Installs the shared handler if no one
+    has yet.
 
-    signal.signal(signal.SIGINT, _handler)
-    signal.signal(signal.SIGTERM, _handler)
-    return stop
+    Returns an unsubscribe callable.  Unsubscribing the last callback
+    restores the original signal disposition when ``setup_signal_handler``
+    was never called — a library user's Ctrl-C behaves normally again after
+    fit() returns."""
+    with _only_one:
+        _callbacks.append(callback)
+        if not _installed:
+            _install()
+
+    def unsubscribe() -> None:
+        with _only_one:
+            try:
+                _callbacks.remove(callback)
+            except ValueError:
+                pass
+            if not _callbacks and not _setup_called and _installed:
+                _uninstall()
+
+    return unsubscribe
+
+
+def reset() -> None:
+    """Clear the first-signal latch (multi-run drivers: a consumed SIGTERM
+    from run N must not turn run N+1's first signal into a hard exit)."""
+    _stop.clear()
 
 
 def merge_stop_events(*events: threading.Event, poll: float = 0.2) -> threading.Event:

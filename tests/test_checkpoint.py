@@ -1,0 +1,291 @@
+"""Checkpoint/resume: orbax round-trips, sharded restore, preemption save.
+
+SURVEY.md §5 "Checkpoint / resume": the reference left checkpoints to user
+code; the rebuild's workload layer owns them, so these tests cover the full
+resume contract a gang restart relies on.
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from k8s_tpu.models.checkpoint import Checkpointer
+from k8s_tpu.parallel import MeshConfig, make_mesh
+
+
+def _state(value: float):
+    return {
+        "params": {"w": jnp.full((16, 8), value, jnp.float32),
+                   "b": jnp.zeros((8,), jnp.float32)},
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+class TestRoundtrip:
+    def test_save_restore(self, tmp_path):
+        ckpt = Checkpointer(os.fspath(tmp_path))
+        state = _state(3.0)
+        assert ckpt.save(0, state)
+        ckpt.wait()
+        restored, step = ckpt.restore_latest(_state(0.0))
+        assert step == 0
+        np.testing.assert_array_equal(restored["params"]["w"],
+                                      state["params"]["w"])
+        ckpt.close()
+
+    def test_restore_or_init_fresh(self, tmp_path):
+        ckpt = Checkpointer(os.fspath(tmp_path))
+        target = _state(7.0)
+        state, next_step = ckpt.restore_or_init(target)
+        assert next_step == 0
+        assert state is target
+        ckpt.close()
+
+    def test_restore_or_init_resumes_at_next_step(self, tmp_path):
+        ckpt = Checkpointer(os.fspath(tmp_path))
+        ckpt.save(4, _state(1.0))
+        ckpt.wait()
+        _, next_step = ckpt.restore_or_init(_state(0.0))
+        assert next_step == 5
+        ckpt.close()
+
+    def test_max_to_keep_prunes(self, tmp_path):
+        ckpt = Checkpointer(os.fspath(tmp_path), max_to_keep=2)
+        for s in range(4):
+            ckpt.save(s, _state(float(s)))
+        ckpt.wait()
+        assert ckpt.all_steps() == [2, 3]
+        ckpt.close()
+
+    def test_save_interval_skips_off_steps(self, tmp_path):
+        ckpt = Checkpointer(os.fspath(tmp_path), save_interval_steps=10)
+        assert ckpt.maybe_save(0, _state(0.0))
+        assert not ckpt.maybe_save(3, _state(0.0))
+        assert ckpt.maybe_save(10, _state(1.0))
+        ckpt.wait()
+        assert ckpt.all_steps() == [0, 10]
+        ckpt.close()
+
+
+class TestShardedRestore:
+    def test_restore_preserves_shardings(self, tmp_path):
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2), jax.devices())
+        sharding = NamedSharding(mesh, P("fsdp", "tp"))
+        w = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                           sharding)
+        state = {"w": w}
+        ckpt = Checkpointer(os.fspath(tmp_path))
+        ckpt.save(0, state)
+        ckpt.wait()
+
+        target = {"w": jax.device_put(jnp.zeros((8, 8), jnp.float32),
+                                      sharding)}
+        restored, step = ckpt.restore_latest(target)
+        assert step == 0
+        assert restored["w"].sharding.is_equivalent_to(sharding, 2)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(w))
+        ckpt.close()
+
+    def test_train_resume_continuity(self, tmp_path):
+        """Stop training at step k, resume from checkpoint, final params
+        match an uninterrupted run (the gang-restart correctness story)."""
+        def step_fn(state):
+            g = 0.1 * jnp.ones_like(state["params"]["w"])
+            return {
+                "params": {"w": state["params"]["w"] - g,
+                           "b": state["params"]["b"]},
+                "step": state["step"] + 1,
+            }
+
+        # uninterrupted: 6 steps
+        s = _state(1.0)
+        for _ in range(6):
+            s = step_fn(s)
+
+        # interrupted at 3, resumed, 3 more
+        ckpt = Checkpointer(os.fspath(tmp_path))
+        s2 = _state(1.0)
+        for _ in range(3):
+            s2 = step_fn(s2)
+        ckpt.save(2, s2)
+        ckpt.wait()
+
+        restored, next_step = ckpt.restore_or_init(_state(0.0))
+        assert next_step == 3
+        for _ in range(3):
+            restored = step_fn(restored)
+        np.testing.assert_allclose(restored["params"]["w"],
+                                   s["params"]["w"], atol=1e-6)
+        ckpt.close()
+
+
+class TestPreemptionSave:
+    def test_sigterm_triggers_save(self, tmp_path, monkeypatch):
+        from k8s_tpu.util import signals
+
+        # isolate module state so other tests' handlers don't interfere
+        monkeypatch.setattr(signals, "_callbacks", [])
+        monkeypatch.setattr(signals, "_stop", __import__("threading").Event())
+        monkeypatch.setattr(signals, "_installed", False)
+
+        ckpt = Checkpointer(os.fspath(tmp_path))
+        live = {"state": _state(9.0), "step": 41}
+        ckpt.save_on_preemption(lambda: live["state"], lambda: live["step"])
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        # handler runs synchronously in the main thread
+        assert signals._stop.is_set()
+        assert ckpt.latest_step() == 41
+        restored, _ = ckpt.restore_latest(_state(0.0))
+        np.testing.assert_array_equal(restored["params"]["w"],
+                                      live["state"]["params"]["w"])
+        ckpt.close()
+
+
+class TestObservabilityHooks:
+    def test_xla_dump_env(self, tmp_path, monkeypatch):
+        from k8s_tpu.launcher import bootstrap
+
+        monkeypatch.setenv("XLA_FLAGS", "--existing=1")
+        enabled = bootstrap.setup_observability(
+            {"XLA_DUMP_TO": os.fspath(tmp_path)})
+        assert enabled == {"xla_dump_to": os.fspath(tmp_path)}
+        assert f"--xla_dump_to={tmp_path}" in os.environ["XLA_FLAGS"]
+        assert "--existing=1" in os.environ["XLA_FLAGS"]
+
+    def test_profile_trace_roundtrip(self, tmp_path):
+        from k8s_tpu.launcher import bootstrap
+
+        env = {"JAX_PROFILE_DIR": os.fspath(tmp_path)}
+        enabled = bootstrap.setup_observability(env)
+        assert enabled["profile_dir"] == os.fspath(tmp_path)
+        jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+        bootstrap.stop_observability(env)
+        # a trace directory with content exists
+        files = [p for p in tmp_path.rglob("*") if p.is_file()]
+        assert files, "profiler wrote no trace files"
+
+    def test_disabled_is_noop(self):
+        from k8s_tpu.launcher import bootstrap
+
+        assert bootstrap.setup_observability({}) == {}
+
+
+class TestFitLoop:
+    def _setup(self):
+        import dataclasses
+
+        from k8s_tpu.models import train
+        from k8s_tpu.models.transformer import Transformer, tiny_test
+
+        cfg = dataclasses.replace(tiny_test(), layers=1, hidden=32,
+                                  ffn_hidden=64, heads=2, kv_heads=2)
+        model = Transformer(cfg)
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2), jax.devices())
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (8, 16), 0, cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        opt = train.default_optimizer(lr=1e-2)
+        state = train.init_state(params, opt)
+
+        def apply_fn(p, x):
+            return model.apply(p, x)
+
+        def data_iter():
+            while True:
+                yield (tokens, tokens)
+
+        return train, apply_fn, opt, state, mesh, data_iter
+
+    def test_fit_trains_and_checkpoints(self, tmp_path):
+        train, apply_fn, opt, state, mesh, data_iter = self._setup()
+        final, losses = train.fit(
+            apply_fn, train.lm_loss, opt, state, mesh, data_iter(),
+            steps=4, checkpoint_dir=os.fspath(tmp_path), checkpoint_every=2,
+            preemption_save=False)
+        assert len(losses) == 4
+        assert losses[-1] < losses[0]
+        ckpt = Checkpointer(os.fspath(tmp_path))
+        assert ckpt.latest_step() == 3
+        ckpt.close()
+
+    def test_fit_resumes_from_checkpoint(self, tmp_path):
+        train, apply_fn, opt, state, mesh, data_iter = self._setup()
+        # run 3 of 6 steps, checkpointing every step
+        train.fit(apply_fn, train.lm_loss, opt, state, mesh, data_iter(),
+                  steps=3, checkpoint_dir=os.fspath(tmp_path),
+                  checkpoint_every=1, preemption_save=False)
+        # "restart": a fresh process re-inits state (fit donates the old
+        # buffers), then fit to 6 — resumes at step 3
+        train, apply_fn, opt, state, mesh, data_iter = self._setup()
+        _, losses2 = train.fit(
+            apply_fn, train.lm_loss, opt, state, mesh, data_iter(),
+            steps=6, checkpoint_dir=os.fspath(tmp_path), checkpoint_every=1,
+            preemption_save=False)
+        assert len(losses2) == 3  # only ran the remaining steps
+
+
+class TestSignalsLifecycle:
+    """on_shutdown / setup_signal_handler composition (review findings)."""
+
+    @pytest.fixture(autouse=True)
+    def _isolate(self, monkeypatch):
+        import threading
+
+        from k8s_tpu.util import signals
+
+        monkeypatch.setattr(signals, "_callbacks", [])
+        monkeypatch.setattr(signals, "_stop", threading.Event())
+        monkeypatch.setattr(signals, "_installed", False)
+        monkeypatch.setattr(signals, "_setup_called", False)
+        monkeypatch.setattr(signals, "_prev_handlers", {})
+        self.signals = signals
+        yield
+
+    def test_setup_after_on_shutdown_does_not_raise(self):
+        unsub = self.signals.on_shutdown(lambda: None)
+        stop = self.signals.setup_signal_handler()  # must not raise
+        assert not stop.is_set()
+        unsub()
+
+    def test_unsubscribe_restores_original_handlers(self):
+        orig = signal.getsignal(signal.SIGTERM)
+        unsub = self.signals.on_shutdown(lambda: None)
+        assert signal.getsignal(signal.SIGTERM) is self.signals._handler
+        unsub()
+        assert signal.getsignal(signal.SIGTERM) is orig
+
+    def test_unsubscribe_keeps_handler_for_operator_binaries(self):
+        self.signals.setup_signal_handler()
+        unsub = self.signals.on_shutdown(lambda: None)
+        unsub()
+        # setup_signal_handler owns the handler: it must stay installed
+        assert signal.getsignal(signal.SIGTERM) is self.signals._handler
+
+    def test_reset_clears_first_signal_latch(self):
+        fired = []
+        unsub = self.signals.on_shutdown(lambda: fired.append(1))
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert fired == [1]
+        assert self.signals._stop.is_set()
+        self.signals.reset()
+        assert not self.signals._stop.is_set()
+        # a post-reset signal runs callbacks again instead of hard-exiting
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert fired == [1, 1]
+        unsub()
+
+    def test_callback_unsubscribed_stops_firing(self):
+        fired = []
+        unsub = self.signals.on_shutdown(lambda: fired.append(1))
+        unsub()
+        keep = self.signals.on_shutdown(lambda: fired.append(2))
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert fired == [2]
+        keep()
