@@ -27,6 +27,7 @@ PODS = GVR("", "v1", "pods", "Pod")
 SERVICES = GVR("", "v1", "services", "Service")
 EVENTS = GVR("", "v1", "events", "Event")
 NAMESPACES = GVR("", "v1", "namespaces", "Namespace", namespaced=False)
+NODES = GVR("", "v1", "nodes", "Node", namespaced=False)
 ENDPOINTS = GVR("", "v1", "endpoints", "Endpoints")
 CONFIGMAPS = GVR("", "v1", "configmaps", "ConfigMap")
 PDBS = GVR("policy", "v1beta1", "poddisruptionbudgets", "PodDisruptionBudget")

@@ -23,7 +23,7 @@ from k8s_tpu.api.meta import now_rfc3339
 from k8s_tpu.api.v1alpha2 import types
 from k8s_tpu.client import errors
 from k8s_tpu.client.clientset import Clientset
-from k8s_tpu.client.gvr import PODS, SERVICES, TFJOBS_V1ALPHA2
+from k8s_tpu.client.gvr import NODES, PODS, SERVICES, TFJOBS_V1ALPHA2
 from k8s_tpu.client.informer import SharedInformerFactory, split_meta_namespace_key
 from k8s_tpu.client.record import EventRecorder
 from k8s_tpu.controller_v2 import pod as pod_mod
@@ -59,9 +59,6 @@ class TFJobController:
         self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v2")
 
-        self.pod_reconciler = pod_mod.PodReconciler(
-            self.pod_control, self.expectations, self.recorder
-        )
         self.service_reconciler = service_mod.ServiceReconciler(
             self.service_control, self.expectations
         )
@@ -71,9 +68,18 @@ class TFJobController:
         self.tfjob_informer = factory.informer_for(TFJOBS_V1ALPHA2)
         self.pod_informer = factory.informer_for(PODS)
         self.service_informer = factory.informer_for(SERVICES)
+        self.node_informer = factory.informer_for(NODES)
         self.tfjob_lister = factory.lister_for(TFJOBS_V1ALPHA2)
         self.pod_lister = factory.lister_for(PODS)
         self.service_lister = factory.lister_for(SERVICES)
+        self.node_lister = factory.lister_for(NODES)
+
+        # node-condition awareness (SURVEY.md §7: exit-code-only preemption
+        # classification is lossy; node taints/Ready conditions disambiguate)
+        self.pod_reconciler = pod_mod.PodReconciler(
+            self.pod_control, self.expectations, self.recorder,
+            node_lister=self.node_lister,
+        )
 
         # seam overridden by tests (controller_test.go updateStatusHandler)
         self.update_status_handler = self._update_tfjob_status

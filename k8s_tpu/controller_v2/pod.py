@@ -85,12 +85,69 @@ def tensorflow_exit_code(pod: dict):
     return None
 
 
-def pod_failed_permanently(pod: dict, restart_policy: str) -> bool:
+# Node signals that mean "this machine is going away / gone" rather than
+# "the workload crashed".  TPU preemptions and maintenance events surface
+# through these before-or-alongside the pod's own failure, and SURVEY.md §7
+# calls exit-code-only classification lossy: a preempted worker can die with
+# any code (137 OOM-looking, 1, or none at all if the kubelet vanished).
+PREEMPTION_TAINT_KEYS = frozenset({
+    "cloud.google.com/impending-node-termination",
+    "ToBeDeletedByClusterAutoscaler",
+    "DeletionCandidateOfClusterAutoscaler",
+    "node.kubernetes.io/unreachable",
+    "node.kubernetes.io/not-ready",
+    "nvidia.com/gpu-preempt",  # parity with accelerator-generic installs
+})
+
+
+def node_indicates_preemption(node: dict) -> bool:
+    """True when the node is being reclaimed or lost: a preemption/teardown
+    taint, or Ready condition False/Unknown."""
+    spec = node.get("spec") or {}
+    for taint in spec.get("taints") or []:
+        if taint.get("key") in PREEMPTION_TAINT_KEYS:
+            return True
+    for cond in (node.get("status") or {}).get("conditions") or []:
+        if cond.get("type") == "Ready" and cond.get("status") in ("False", "Unknown"):
+            return True
+    return False
+
+
+def pod_on_preempted_node(pod: dict, node_lister) -> bool:
+    """Node-condition awareness: look up the pod's node and check for
+    preemption/teardown evidence.  ``node_lister`` may be None (no node
+    informer — e.g. RBAC without node read), which degrades gracefully to
+    exit-code-only classification."""
+    if node_lister is None:
+        return False
+    node_name = (pod.get("spec") or {}).get("nodeName")
+    if not node_name:
+        return False
+    node = node_lister.get("", node_name)
+    if node is None:
+        # the pod names a node the informer has never seen or that was
+        # deleted out from under it: the machine is gone -> preempted
+        return True
+    return node_indicates_preemption(node)
+
+
+def pod_failed_permanently(pod: dict, restart_policy: str,
+                           node_lister=None, *,
+                           node_preempted: bool | None = None) -> bool:
     """Under ExitCode policy, a failed pod with a permanent (1-127) code is a
     terminal job failure; other policies treat any failure as restartable
-    except Never."""
+    except Never.  Node evidence overrides the exit code: a pod that died
+    because its node is being preempted/reclaimed is always retryable —
+    restarting the gang elsewhere is exactly what the job wants.  An
+    explicit RestartPolicyNever still wins: the user opted out of restarts
+    entirely.  Callers that already classified the node pass the result as
+    ``node_preempted`` (one lister lookup per pod, not per question)."""
     if restart_policy == types.RestartPolicyNever:
         return True
+    if node_preempted is None:
+        node_preempted = pod_on_preempted_node(pod, node_lister)
+    if node_preempted:
+        return False
     if restart_policy == types.RestartPolicyExitCode:
         code = tensorflow_exit_code(pod)
         if code is None:
@@ -103,10 +160,12 @@ def pod_failed_permanently(pod: dict, restart_policy: str) -> bool:
 class PodReconciler:
     """reconcilePods + createNewPod bound to a TFJobController's seams."""
 
-    def __init__(self, pod_control, expectations, recorder):
+    def __init__(self, pod_control, expectations, recorder, node_lister=None):
         self.pod_control = pod_control
         self.expectations = expectations
         self.recorder = recorder
+        # node-condition awareness (optional: None degrades to exit codes)
+        self.node_lister = node_lister
 
     def reconcile(
         self, tfjob: types.TFJob, pods: list[dict], rtype: str, spec: types.TFReplicaSpec
@@ -150,8 +209,16 @@ class PodReconciler:
             return False  # Always/OnFailure restart in-place via kubelet
         if (pod.get("status") or {}).get("phase") != "Failed":
             return False
-        if pod_failed_permanently(pod, spec.restart_policy):
+        preempted = pod_on_preempted_node(pod, self.node_lister)
+        if pod_failed_permanently(pod, spec.restart_policy,
+                                  node_preempted=preempted):
             return False
+        if preempted:
+            self.recorder.eventf(
+                tfjob.to_dict(), "Normal", "TPUPreempted",
+                "Pod %s lost to node preemption/teardown; restarting",
+                pod["metadata"]["name"],
+            )
         key = tpu_config.tfjob_key(tfjob)
         name = pod["metadata"]["name"]
         log.info("restarting pod %s (retryable exit code)", name)
@@ -181,8 +248,19 @@ class PodReconciler:
         if not failed:
             return False
         policy = spec.restart_policy or types.RestartPolicyAlways
-        if any(pod_failed_permanently(p, policy) for p in failed):
+        # one node classification per pod, shared by both questions below
+        preempted_flags = [pod_on_preempted_node(p, self.node_lister)
+                           for p in failed]
+        if any(pod_failed_permanently(p, policy, node_preempted=pre)
+               for p, pre in zip(failed, preempted_flags)):
             return False  # permanent: let update_status mark the job Failed
+        preempted = [p for p, pre in zip(failed, preempted_flags) if pre]
+        if preempted:
+            self.recorder.eventf(
+                tfjob.to_dict(), "Normal", "TPUPreempted",
+                "%d gang pod(s) lost to node preemption/teardown",
+                len(preempted),
+            )
         key = tpu_config.tfjob_key(tfjob)
         log.info(
             "gang restart for %s %s: %d failed pod(s), tearing down %d pod(s)",

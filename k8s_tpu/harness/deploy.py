@@ -67,6 +67,13 @@ def operator_manifests(
                     "verbs": ["*"],
                 },
                 {
+                    # node-condition awareness for preemption classification
+                    # (controller_v2.pod.pod_on_preempted_node): read-only
+                    "apiGroups": [""],
+                    "resources": ["nodes"],
+                    "verbs": ["get", "list", "watch"],
+                },
+                {
                     "apiGroups": ["policy"],
                     "resources": ["poddisruptionbudgets"],
                     "verbs": ["*"],

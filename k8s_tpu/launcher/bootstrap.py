@@ -118,7 +118,9 @@ def make_training_mesh(
 
 
 _profiler_server = None
+_profiler_port = None
 _trace_active = False
+_trace_dir = None
 
 
 def setup_observability(env: Optional[dict] = None) -> dict:
@@ -136,7 +138,7 @@ def setup_observability(env: Optional[dict] = None) -> dict:
 
     Returns {hook: value} for what was enabled.
     """
-    global _profiler_server, _trace_active
+    global _profiler_server, _profiler_port, _trace_active, _trace_dir
     e = env if env is not None else os.environ
     enabled: dict = {}
 
@@ -165,7 +167,13 @@ def setup_observability(env: Optional[dict] = None) -> dict:
 
         if _profiler_server is None:
             _profiler_server = jax.profiler.start_server(int(port))
-        enabled["profiler_port"] = int(port)
+            _profiler_port = int(port)
+        elif _profiler_port != int(port):
+            log.warning(
+                "JAX_PROFILER_PORT=%s ignored: server already on %s",
+                port, _profiler_port)
+        # report where the server actually listens
+        enabled["profiler_port"] = _profiler_port
 
     profile_dir = e.get("JAX_PROFILE_DIR", "")
     if profile_dir:
@@ -174,7 +182,12 @@ def setup_observability(env: Optional[dict] = None) -> dict:
         if not _trace_active:
             jax.profiler.start_trace(profile_dir)
             _trace_active = True
-        enabled["profile_dir"] = profile_dir
+            _trace_dir = profile_dir
+        elif _trace_dir != profile_dir:
+            log.warning(
+                "JAX_PROFILE_DIR=%s ignored: trace already writing to %s",
+                profile_dir, _trace_dir)
+        enabled["profile_dir"] = _trace_dir
 
     return enabled
 
@@ -183,13 +196,14 @@ def stop_observability(env: Optional[dict] = None) -> None:
     """Stop a JAX_PROFILE_DIR trace (call at job teardown, chief included).
     No-op when no trace was actually started — teardown must not mask the
     job's real exit status."""
-    global _trace_active
+    global _trace_active, _trace_dir
     del env  # kept for call-site symmetry with setup_observability
     if _trace_active:
         import jax
 
         jax.profiler.stop_trace()
         _trace_active = False
+        _trace_dir = None
 
 
 def barrier(name: str = "launcher") -> None:

@@ -119,6 +119,29 @@ def make_sharded_train_step(
     )
 
 
+import dataclasses
+
+
+@dataclasses.dataclass
+class FitResult:
+    """Outcome of a fit() run.
+
+    ``preempted`` is the signal the pod entrypoint must act on (exit 143 so
+    the operator's exit-code policy restarts the gang); ``len(losses) <
+    steps`` alone cannot distinguish a preemption from a successful resumed
+    run that simply had fewer steps left.
+    """
+
+    state: dict
+    losses: list
+    preempted: bool = False
+    start_step: int = 0
+
+    def __iter__(self):  # (state, losses) unpacking compatibility
+        yield self.state
+        yield self.losses
+
+
 def fit(
     apply_fn: Callable,
     loss_fn: Callable,
@@ -132,7 +155,7 @@ def fit(
     checkpoint_every: int = 100,
     preemption_save: bool = True,
     log_every: int = 0,
-) -> tuple[dict, list]:
+) -> FitResult:
     """The canonical training loop: shard state over the mesh, jit the step,
     checkpoint/resume via k8s_tpu.models.checkpoint.
 
@@ -142,7 +165,8 @@ def fit(
     step after a gang restart, saves every ``checkpoint_every`` steps, and —
     if ``preemption_save`` — registers a SIGTERM hook so TPU preemptions
     (retryable exit 143 under the operator's exit-code policy) leave a fresh
-    checkpoint behind.  Returns (final_state, losses).
+    checkpoint behind.  Returns a FitResult; check ``.preempted`` to decide
+    the process exit code (True -> exit 143, the retryable contract).
 
     Note: the jitted step donates the state buffers, so the caller's
     ``state`` arrays are consumed — use the returned state.
@@ -165,9 +189,8 @@ def fit(
         state, start_step = ckpt.restore_or_init(state)
 
     # Cooperative preemption: SIGTERM sets a flag; the loop saves at the
-    # next step boundary and returns early (fewer losses than steps tells
-    # the caller to exit 143 → retryable under the operator policy).  A
-    # handler-side synchronous save is deliberately NOT used here — it can
+    # next step boundary and returns early with FitResult.preempted=True.
+    # A handler-side synchronous save is deliberately NOT used here — it can
     # race an in-flight interval save (see Checkpointer.save_on_preemption).
     import threading
 
@@ -206,4 +229,9 @@ def fit(
     finally:
         if unsubscribe is not None:
             unsubscribe()
-    return state, [float(l) for l in losses]
+    return FitResult(
+        state=state,
+        losses=[float(l) for l in losses],
+        preempted=preempted.is_set(),
+        start_step=start_step,
+    )

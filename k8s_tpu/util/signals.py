@@ -72,6 +72,14 @@ def on_shutdown(callback):
     was never called — a library user's Ctrl-C behaves normally again after
     fit() returns."""
     with _only_one:
+        if not _setup_called and not _callbacks:
+            # Library-only usage starting a fresh run: clear any latch left
+            # by a consumed signal from a previous run, else that run's
+            # first SIGTERM takes the second-signal os._exit(1) path and no
+            # shutdown callback (preemption checkpoint) ever fires.  With a
+            # setup_signal_handler owner the latch persists: the operator
+            # binaries keep the reference's double-signal hard-exit contract.
+            _stop.clear()
         _callbacks.append(callback)
         if not _installed:
             _install()

@@ -133,19 +133,24 @@ class TestPreemptionSave:
         monkeypatch.setattr(signals, "_callbacks", [])
         monkeypatch.setattr(signals, "_stop", __import__("threading").Event())
         monkeypatch.setattr(signals, "_installed", False)
+        monkeypatch.setattr(signals, "_setup_called", False)
+        monkeypatch.setattr(signals, "_prev_handlers", {})
 
         ckpt = Checkpointer(os.fspath(tmp_path))
         live = {"state": _state(9.0), "step": 41}
-        ckpt.save_on_preemption(lambda: live["state"], lambda: live["step"])
-
-        os.kill(os.getpid(), signal.SIGTERM)
-        # handler runs synchronously in the main thread
-        assert signals._stop.is_set()
-        assert ckpt.latest_step() == 41
-        restored, _ = ckpt.restore_latest(_state(0.0))
-        np.testing.assert_array_equal(restored["params"]["w"],
-                                      live["state"]["params"]["w"])
-        ckpt.close()
+        unsub = ckpt.save_on_preemption(
+            lambda: live["state"], lambda: live["step"])
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            # handler runs synchronously in the main thread
+            assert signals._stop.is_set()
+            assert ckpt.latest_step() == 41
+            restored, _ = ckpt.restore_latest(_state(0.0))
+            np.testing.assert_array_equal(restored["params"]["w"],
+                                          live["state"]["params"]["w"])
+        finally:
+            unsub()  # restore the process SIGTERM disposition
+            ckpt.close()
 
 
 class TestObservabilityHooks:
@@ -289,3 +294,48 @@ class TestSignalsLifecycle:
         os.kill(os.getpid(), signal.SIGTERM)
         assert fired == [2]
         keep()
+
+
+class TestFitResultContract:
+    def test_completed_resume_is_not_preempted(self, tmp_path):
+        """A successful resumed run returns fewer losses than steps but
+        preempted=False — drivers must key off the flag, not the count."""
+        import tests.test_checkpoint as _self  # reuse TestFitLoop setup
+        helper = TestFitLoop()
+        train, apply_fn, opt, state, mesh, data_iter = helper._setup()
+        train.fit(apply_fn, train.lm_loss, opt, state, mesh, data_iter(),
+                  steps=2, checkpoint_dir=os.fspath(tmp_path),
+                  checkpoint_every=1, preemption_save=False)
+        train, apply_fn, opt, state, mesh, data_iter = helper._setup()
+        result = train.fit(
+            apply_fn, train.lm_loss, opt, state, mesh, data_iter(),
+            steps=4, checkpoint_dir=os.fspath(tmp_path), checkpoint_every=1,
+            preemption_save=False)
+        assert len(result.losses) == 2 < 4
+        assert result.preempted is False
+        assert result.start_step == 2
+
+    def test_stale_latch_cleared_for_library_reruns(self, monkeypatch):
+        import threading
+
+        from k8s_tpu.util import signals
+
+        monkeypatch.setattr(signals, "_callbacks", [])
+        monkeypatch.setattr(signals, "_stop", threading.Event())
+        monkeypatch.setattr(signals, "_installed", False)
+        monkeypatch.setattr(signals, "_setup_called", False)
+        monkeypatch.setattr(signals, "_prev_handlers", {})
+
+        # run 1 consumed a signal
+        unsub = signals.on_shutdown(lambda: None)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert signals._stop.is_set()
+        unsub()
+        # run 2 registers fresh: the latch must clear, else its first
+        # signal would os._exit(1) without running any callback
+        fired = []
+        unsub2 = signals.on_shutdown(lambda: fired.append(1))
+        assert not signals._stop.is_set()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert fired == [1]
+        unsub2()

@@ -68,7 +68,7 @@ def make_tfjob(worker=0, ps=0, tpu=0, restart_policy="", version="v1alpha2"):
     )
 
 
-def make_pod(rtype, index, phase, exit_code=None):
+def make_pod(rtype, index, phase, exit_code=None, node_name=None):
     labels = tpu_config.gen_labels(KEY)
     labels[tpu_config.LABEL_REPLICA_TYPE] = rtype
     labels[tpu_config.LABEL_REPLICA_INDEX] = str(index)
@@ -86,6 +86,8 @@ def make_pod(rtype, index, phase, exit_code=None):
         },
         "status": {"phase": phase},
     }
+    if node_name is not None:
+        pod["spec"] = {"nodeName": node_name}
     if exit_code is not None:
         pod["status"]["containerStatuses"] = [
             {"name": "tensorflow", "state": {"terminated": {"exitCode": exit_code}}}
@@ -113,7 +115,7 @@ def make_service(rtype, index):
     }
 
 
-def build_controller(tfjob, pods, services, enable_gang=False):
+def build_controller(tfjob, pods, services, enable_gang=False, nodes=None):
     """Controller with alwaysReady-style stores: informers pre-populated,
     no threads started (controller_test.go:44 alwaysReady stubs)."""
     fc = FakeCluster()
@@ -134,6 +136,7 @@ def build_controller(tfjob, pods, services, enable_gang=False):
     tc.tfjob_informer.store.replace([stored_job])
     tc.pod_informer.store.replace(pods)
     tc.service_informer.store.replace(services)
+    tc.node_informer.store.replace(nodes or [])
     captured = []
     tc.update_status_handler = lambda job: captured.append(job)
     return tc, pod_control, service_control, captured

@@ -1,0 +1,117 @@
+"""Node-condition-aware preemption classification (SURVEY.md §7: exit-code
+-only classification is lossy; node taints/Ready conditions disambiguate a
+preempted machine from a crashed workload)."""
+
+from k8s_tpu.controller_v2 import pod as pod_mod
+from k8s_tpu.controller_v2.status import get_condition
+from tests.test_controller_v2 import KEY, build_controller, make_pod, make_tfjob
+
+
+def make_node(name, taint_key=None, ready="True"):
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name},
+        "spec": {},
+        "status": {"conditions": [{"type": "Ready", "status": ready}]},
+    }
+    if taint_key:
+        node["spec"]["taints"] = [{"key": taint_key, "effect": "NoSchedule"}]
+    return node
+
+
+class TestNodeSignals:
+    def test_healthy_node_is_not_preemption(self):
+        assert not pod_mod.node_indicates_preemption(make_node("n1"))
+
+    def test_termination_taint_is_preemption(self):
+        node = make_node("n1", taint_key="cloud.google.com/impending-node-termination")
+        assert pod_mod.node_indicates_preemption(node)
+
+    def test_autoscaler_taint_is_preemption(self):
+        node = make_node("n1", taint_key="ToBeDeletedByClusterAutoscaler")
+        assert pod_mod.node_indicates_preemption(node)
+
+    def test_not_ready_is_preemption(self):
+        assert pod_mod.node_indicates_preemption(make_node("n1", ready="False"))
+        assert pod_mod.node_indicates_preemption(make_node("n1", ready="Unknown"))
+
+    def test_no_lister_degrades_to_exit_codes(self):
+        pod = make_pod("tpu", 0, "Failed", exit_code=1, node_name="n1")
+        assert not pod_mod.pod_on_preempted_node(pod, None)
+
+    def test_vanished_node_is_preemption(self):
+        class EmptyLister:
+            def get(self, ns, name):
+                return None
+
+        pod = make_pod("tpu", 0, "Failed", exit_code=1, node_name="gone")
+        assert pod_mod.pod_on_preempted_node(pod, EmptyLister())
+
+
+class TestGangPreemptionOverride:
+    """A gang pod dying with a permanent-looking exit code on a preempted
+    node restarts the gang instead of failing the job."""
+
+    def _run(self, nodes, exit_code=1):
+        tfjob = make_tfjob(tpu=2, restart_policy="ExitCode")
+        pods = [
+            make_pod("tpu", 0, "Running", node_name="n-ok"),
+            make_pod("tpu", 1, "Failed", exit_code=exit_code, node_name="n-bad"),
+        ]
+        controller, pod_control, _, captured = build_controller(
+            tfjob, pods, [], nodes=nodes)
+        controller.sync_tfjob(KEY)
+        return pod_control, captured
+
+    def test_permanent_code_on_preempted_node_restarts_gang(self):
+        nodes = [make_node("n-ok"),
+                 make_node("n-bad", taint_key="ToBeDeletedByClusterAutoscaler")]
+        pod_control, captured = self._run(nodes)
+        # whole gang torn down (both pods), job Restarting not Failed
+        assert len(pod_control.delete_pod_names) == 2
+        assert get_condition(captured[-1].status, "Restarting") is not None
+        assert get_condition(captured[-1].status, "Failed") is None
+
+    def test_permanent_code_on_healthy_node_fails_job(self):
+        nodes = [make_node("n-ok"), make_node("n-bad")]
+        pod_control, captured = self._run(nodes)
+        assert pod_control.delete_pod_names == []
+        assert get_condition(captured[-1].status, "Failed") is not None
+
+    def test_node_lost_from_informer_restarts_gang(self):
+        # the bad pod's node doesn't exist at all -> machine gone -> retry
+        nodes = [make_node("n-ok")]
+        pod_control, captured = self._run(nodes)
+        assert len(pod_control.delete_pod_names) == 2
+        assert get_condition(captured[-1].status, "Failed") is None
+
+    def test_never_policy_still_wins(self):
+        tfjob = make_tfjob(tpu=2, restart_policy="Never")
+        pods = [
+            make_pod("tpu", 0, "Running", node_name="n-ok"),
+            make_pod("tpu", 1, "Failed", exit_code=143, node_name="n-bad"),
+        ]
+        nodes = [make_node("n-ok"),
+                 make_node("n-bad", taint_key="ToBeDeletedByClusterAutoscaler")]
+        controller, pod_control, _, captured = build_controller(
+            tfjob, pods, [], nodes=nodes)
+        controller.sync_tfjob(KEY)
+        assert pod_control.delete_pod_names == []
+        assert get_condition(captured[-1].status, "Failed") is not None
+
+
+class TestNonGangPreemption:
+    def test_worker_on_preempted_node_restarts(self):
+        tfjob = make_tfjob(worker=2)
+        tfjob.spec.tf_replica_specs["Worker"].restart_policy = "ExitCode"
+        pods = [
+            make_pod("worker", 0, "Running", node_name="n-ok"),
+            make_pod("worker", 1, "Failed", exit_code=1, node_name="n-bad"),
+        ]
+        nodes = [make_node("n-ok"), make_node("n-bad", ready="Unknown")]
+        controller, pod_control, _, captured = build_controller(
+            tfjob, pods, [], nodes=nodes)
+        controller.sync_tfjob(KEY)
+        assert len(pod_control.delete_pod_names) == 1
+        assert get_condition(captured[-1].status, "Failed") is None
