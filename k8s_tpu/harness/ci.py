@@ -1,0 +1,120 @@
+"""CI tier/workflow runner driven by ci_config.yaml.
+
+Reference counterpart: prow_config.yaml:3-6 routed Argo e2e workflows (via
+kubeflow/testing's run_e2e_workflow.py) and .travis.yml:23-33 ran the
+build/lint/unit tiers.  Here one config file declares both, and this module
+is the single entrypoint CI systems call:
+
+    python -m k8s_tpu.harness.ci <tier>        # lint / unit / controller...
+    python -m k8s_tpu.harness.ci --workflow tpujob-e2e
+    python -m k8s_tpu.harness.ci --all
+
+Each tier's command runs in the repo root; failures propagate as a nonzero
+exit code and a junit file per tier lands in ``artifacts.junit_dir`` (the
+harness.prow artifact contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+import yaml
+
+from k8s_tpu.harness import junit
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_CONFIG = os.path.join(REPO_ROOT, "ci_config.yaml")
+
+
+def load_config(path: str = DEFAULT_CONFIG) -> dict:
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    # explicit-null sections (`tiers:` with every entry commented out)
+    # normalize to empty, not None
+    for key, empty in (("tiers", {}), ("workflows", []), ("artifacts", {})):
+        if cfg.get(key) is None:
+            cfg[key] = empty
+    return cfg
+
+
+def _run_entry(name: str, entry: str, junit_dir: str | None,
+               timeout: float | None = None, cwd: str = REPO_ROOT) -> bool:
+    """Run one tier/workflow command; write a junit TestCase for it."""
+    start = time.time()
+    try:
+        proc = subprocess.run(
+            shlex.split(entry), cwd=cwd, timeout=timeout,
+            capture_output=True, text=True,
+        )
+        ok = proc.returncode == 0
+        failure = None if ok else (
+            f"exit {proc.returncode}\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+        out_tail = proc.stdout[-4000:] + proc.stderr[-4000:]
+    except subprocess.TimeoutExpired as e:
+        ok = False
+        failure = f"timeout after {timeout:.0f}s"
+        out_tail = (
+            (e.stdout or b"")[-4000:].decode(errors="replace")
+            if isinstance(e.stdout, bytes) else (e.stdout or "")[-4000:]
+        )
+    elapsed = time.time() - start
+    case = junit.TestCase(class_name="ci", name=name)
+    case.time = elapsed
+    case.failure = failure
+    if junit_dir:
+        os.makedirs(junit_dir, exist_ok=True)
+        junit.create_junit_xml_file(
+            [case], os.path.join(junit_dir, f"junit_ci-{name}.xml"))
+    stream = sys.stdout if ok else sys.stderr
+    print(f"[ci] {name}: {'PASS' if ok else 'FAIL'} ({elapsed:.1f}s)", file=stream)
+    if not ok:
+        print(out_tail, file=sys.stderr)
+    return ok
+
+
+def run_tier(cfg: dict, name: str) -> bool:
+    tier = cfg["tiers"].get(name)
+    if tier is None:
+        raise KeyError(f"unknown tier {name!r}; have {sorted(cfg['tiers'])}")
+    entry = tier["entry"] if isinstance(tier, dict) else str(tier)
+    return _run_entry(name, entry, cfg["artifacts"].get("junit_dir"))
+
+
+def run_workflow(cfg: dict, name: str) -> bool:
+    for wf in cfg["workflows"]:
+        if wf.get("name") == name:
+            timeout = 60.0 * float(wf.get("timeout_minutes", 30))
+            return _run_entry(name, wf["entry"],
+                              cfg["artifacts"].get("junit_dir"), timeout)
+    raise KeyError(
+        f"unknown workflow {name!r}; have {[w.get('name') for w in cfg['workflows']]}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("tier", nargs="?", help="tier name from ci_config.yaml")
+    p.add_argument("--workflow", help="workflow name from ci_config.yaml")
+    p.add_argument("--all", action="store_true", help="run every tier in order")
+    p.add_argument("--config", default=DEFAULT_CONFIG)
+    args = p.parse_args(argv)
+
+    cfg = load_config(args.config)
+    if args.all:
+        ok = all([run_tier(cfg, t) for t in cfg["tiers"]])
+    elif args.workflow:
+        ok = run_workflow(cfg, args.workflow)
+    elif args.tier:
+        ok = run_tier(cfg, args.tier)
+    else:
+        p.error("need a tier, --workflow, or --all")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""CI tier runner (ci_config.yaml; reference: prow_config.yaml + .travis.yml)."""
+
+import os
+
+from k8s_tpu.harness import ci
+
+
+def test_repo_config_loads_and_declares_ladder():
+    cfg = ci.load_config()
+    assert "lint" in cfg["tiers"]
+    assert "unit" in cfg["tiers"]
+    assert "controller" in cfg["tiers"]
+    assert any(w["name"] == "tpujob-e2e" for w in cfg["workflows"])
+
+
+def test_run_tier_pass_and_junit(tmp_path):
+    cfg = {
+        "tiers": {"ok": {"entry": "python -c pass"},
+                  "bad": {"entry": "python -c import(sys)"}},
+        "workflows": [],
+        "artifacts": {"junit_dir": os.fspath(tmp_path)},
+    }
+    assert ci.run_tier(cfg, "ok")
+    assert not ci.run_tier(cfg, "bad")
+    assert (tmp_path / "junit_ci-ok.xml").exists()
+    bad_xml = (tmp_path / "junit_ci-bad.xml").read_text()
+    assert "failure" in bad_xml
+
+
+def test_unknown_tier_raises():
+    import pytest
+
+    with pytest.raises(KeyError):
+        ci.run_tier({"tiers": {}, "workflows": [], "artifacts": {}}, "nope")
+
+
+def test_workflow_lookup():
+    import pytest
+
+    cfg = {"tiers": {}, "artifacts": {},
+           "workflows": [{"name": "wf", "entry": "python -c pass",
+                          "timeout_minutes": 1}]}
+    assert ci.run_workflow(cfg, "wf")
+    with pytest.raises(KeyError):
+        ci.run_workflow(cfg, "other")
+
+
+def test_workflow_timeout_records_failure(tmp_path):
+    cfg = {"tiers": {}, "artifacts": {"junit_dir": os.fspath(tmp_path)},
+           "workflows": [{"name": "slow",
+                          "entry": "python -c \"import time; time.sleep(30)\"",
+                          "timeout_minutes": 0.02}]}
+    assert not ci.run_workflow(cfg, "slow")
+    xml = (tmp_path / "junit_ci-slow.xml").read_text()
+    assert "timeout" in xml
+
+
+def test_null_sections_normalize():
+    import pytest
+
+    cfg = {"tiers": None, "workflows": None, "artifacts": None}
+    import yaml as _y
+    path = "/tmp/_ci_null.yaml"
+    open(path, "w").write(_y.safe_dump(cfg))
+    loaded = ci.load_config(path)
+    assert loaded["tiers"] == {} and loaded["workflows"] == []
+    with pytest.raises(KeyError):
+        ci.run_tier(loaded, "anything")

@@ -136,3 +136,19 @@ class TestExampleRunsEndToEnd:
             )
         assert finished["status"]["phase"] == "Done"
         assert finished["status"]["state"] == "Succeeded"
+
+
+def test_notebook_smoke_runs():
+    """examples/notebook_smoke.py (reference: examples/gke/test_notebook.py)
+    completes against the local cluster + dashboard."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "notebook_smoke.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "notebook smoke: OK" in out.stdout
