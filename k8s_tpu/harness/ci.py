@@ -59,10 +59,13 @@ def _run_entry(name: str, entry: str, junit_dir: str | None,
     except subprocess.TimeoutExpired as e:
         ok = False
         failure = f"timeout after {timeout:.0f}s"
-        out_tail = (
-            (e.stdout or b"")[-4000:].decode(errors="replace")
-            if isinstance(e.stdout, bytes) else (e.stdout or "")[-4000:]
-        )
+
+        def _tail(stream):
+            if isinstance(stream, bytes):
+                return stream[-4000:].decode(errors="replace")
+            return (stream or "")[-4000:]
+
+        out_tail = _tail(e.stdout) + _tail(e.stderr)
     elapsed = time.time() - start
     case = junit.TestCase(class_name="ci", name=name)
     case.time = elapsed

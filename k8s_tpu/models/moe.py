@@ -1,0 +1,139 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``ep`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.4 — no expert parallelism exists in
+the TF1 PS world); built GShard-style for the TPU-native stack:
+
+- router: dense [d → E] in f32, top-k gating with normalized weights;
+- capacity-bounded dispatch: each expert processes at most
+  ``C = ceil(tokens / E * capacity_factor)`` tokens; overflow tokens fall
+  through the residual connection (standard GShard/Switch behavior);
+- dispatch/combine are einsums against a [tokens, E, C] one-hot tensor; the
+  expert dimension of the [E, C, d] activations and the stacked expert
+  params are sharded over ``ep`` via sharding constraints, so XLA inserts
+  the all-to-alls — no hand-written collectives, the pjit recipe;
+- auxiliary load-balance loss (Switch-style: E * Σ_e f_e · p_e) returned
+  for the trainer to add.
+
+Integrated into the transformer family via TransformerConfig.num_experts
+(k8s_tpu.models.transformer.Block swaps its MLP for MoeMLP).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _constrain(x, mesh, spec):
+    if mesh is None:
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, spec))
+    except (ValueError, TypeError):  # e.g. tracing without a mesh context
+        return x
+
+
+class MoeMLP(nn.Module):
+    """Drop-in MLP replacement: top-k routed experts, each a SwiGLU MLP.
+
+    Input/output: [B, L, d].  Also stores the auxiliary load-balance loss
+    in a "losses" collection (sow) under "moe_aux_loss".
+    """
+
+    num_experts: int
+    ffn_hidden: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None  # static module attr, same pattern as Attention.mesh
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, d = x.shape
+        E = self.num_experts
+        k = min(self.top_k, E)
+        T = B * L
+        tokens = x.reshape(T, d)
+
+        # -- router (f32 for a stable softmax) ---------------------------
+        router_w = self.param(
+            "router", nn.initializers.normal(0.02), (d, E), jnp.float32)
+        logits = tokens.astype(jnp.float32) @ router_w  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k gates, renormalized over the chosen experts
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+        # -- capacity-bounded dispatch tensor ----------------------------
+        # GShard scales capacity by k: k*T (token,choice) pairs must fit in
+        # E*C slots, so C = ceil(k*T/E * cf); without the k factor, default
+        # top_k=2 would drop most secondary assignments at perfect balance
+        C = max(1, math.ceil(k * T / E * self.capacity_factor))
+        # position of each (token, choice) in its expert's buffer
+        expert_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [T,k,E]
+        # cumulative position per expert across (token, choice) pairs in
+        # priority order: primary choices first, then secondaries
+        flat = expert_onehot.transpose(1, 0, 2).reshape(k * T, E)
+        pos_flat = jnp.cumsum(flat, axis=0) - flat  # [k*T, E]
+        pos = pos_flat.reshape(k, T, E).transpose(1, 0, 2)  # [T, k, E]
+        slot = jnp.sum(pos * expert_onehot, axis=-1)  # [T, k]
+        keep = slot < C  # overflow tokens dropped (residual carries them)
+
+        # dispatch [T, E, C] one-hot; combine adds the gate weight
+        slot_onehot = jax.nn.one_hot(slot, C, dtype=jnp.float32) * (
+            keep[..., None].astype(jnp.float32))  # [T, k, C]
+        dispatch = jnp.einsum("tke,tkc->tec", expert_onehot.astype(jnp.float32),
+                              slot_onehot)  # [T, E, C]
+        combine = jnp.einsum("tk,tke,tkc->tec", gate_vals,
+                             expert_onehot.astype(jnp.float32), slot_onehot)
+
+        # -- expert computation, ep-sharded ------------------------------
+        expert_in = jnp.einsum("tec,td->ecd", dispatch,
+                               tokens.astype(jnp.float32)).astype(self.dtype)
+        expert_in = _constrain(expert_in, self.mesh, P("ep", None, None))
+
+        def init_e(rng, shape):
+            return nn.initializers.normal(0.02)(rng, shape, jnp.float32)
+
+        w_gate = self.param("w_gate", init_e, (E, d, self.ffn_hidden))
+        w_up = self.param("w_up", init_e, (E, d, self.ffn_hidden))
+        w_down = self.param("w_down", init_e, (E, self.ffn_hidden, d))
+
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(self.dtype))
+        u = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(self.dtype))
+        out = jnp.einsum("ecf,efd->ecd", nn.silu(h) * u,
+                         w_down.astype(self.dtype))
+        out = _constrain(out, self.mesh, P("ep", None, None))
+
+        # -- combine back to tokens --------------------------------------
+        y = jnp.einsum("tec,ecd->td", combine, out.astype(jnp.float32))
+
+        # -- Switch aux loss: E * sum_e (fraction routed) * (mean prob) --
+        primary = expert_onehot[:, 0, :].astype(jnp.float32)  # [T, E]
+        f = jnp.mean(primary, axis=0)
+        p = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(f * p)
+        # overwrite-reduce: robust to framework re-traces, and per-layer
+        # values stay addressable by module path
+        self.sow("losses", "moe_aux_loss", aux,
+                 init_fn=lambda: jnp.zeros(()), reduce_fn=lambda a, b: b)
+
+        return y.reshape(B, L, d).astype(x.dtype)
+
+
+def expert_sharding_rule(path: tuple, mesh) -> Optional[P]:
+    """Param-path sharding rule: stacked expert weights shard their leading
+    expert dim over ``ep`` (composes with the fsdp rules in
+    k8s_tpu.parallel.sharding)."""
+    names = [getattr(p, "key", str(p)) for p in path]
+    if any(n in ("w_gate", "w_up", "w_down") for n in names):
+        return P("ep")
+    return None

@@ -58,8 +58,15 @@ def make_train_step(
         inputs, targets = batch
 
         def compute_loss(params):
-            logits = apply_fn(params, inputs)
-            return loss_fn(logits, targets)
+            out = apply_fn(params, inputs)
+            # apply_fn may return (logits, aux_scalar) — e.g. the MoE
+            # load-balance term from make_moe_apply_fn — which is added to
+            # the task loss
+            if isinstance(out, tuple):
+                logits, aux = out
+            else:
+                logits, aux = out, 0.0
+            return loss_fn(logits, targets) + aux
 
         loss, grads = jax.value_and_grad(compute_loss)(state["params"])
         updates, new_opt_state = optimizer.update(
@@ -235,3 +242,23 @@ def fit(
         preempted=preempted.is_set(),
         start_step=start_step,
     )
+
+
+def make_moe_apply_fn(model, *, aux_loss_weight: float = 0.01, mesh=None):
+    """apply_fn for make_train_step/fit over an MoE transformer: runs the
+    model with the "losses" collection mutable, sums every sown
+    moe_aux_loss (one per MoE layer), and returns (logits, weighted_aux) so
+    the train step adds the load-balance pressure to the task loss.
+
+    Without this the routers get no balancing gradient, collapse onto a few
+    experts, and capacity-bounded dispatch silently drops most tokens.
+    """
+
+    def apply_fn(params, inputs):
+        logits, cols = model.apply(
+            params, inputs, mesh=mesh, mutable=["losses"])
+        aux_leaves = jax.tree.leaves(cols.get("losses", {}))
+        aux = sum(aux_leaves) if aux_leaves else jnp.zeros(())
+        return logits, aux_loss_weight * aux
+
+    return apply_fn

@@ -41,6 +41,11 @@ class TransformerConfig:
     use_flash_attention: bool = False  # Pallas fused kernel (k8s_tpu.ops)
     use_fused_norm: bool = False  # Pallas RMSNorm kernel (k8s_tpu.ops)
     remat: bool = True  # jax.checkpoint each layer: HBM for FLOPs
+    # MoE (k8s_tpu.models.moe): >0 swaps the dense MLP for routed experts
+    # sharded over the ep mesh axis
+    num_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
 
     @property
     def dims_per_head(self) -> int:
@@ -179,13 +184,27 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
-        fused = self.config.use_fused_norm
-        y = Attention(self.config, mesh=self.mesh, name="attn")(
+        cfg = self.config
+        fused = cfg.use_fused_norm
+        y = Attention(cfg, mesh=self.mesh, name="attn")(
             RMSNorm(fused=fused, name="attn_norm")(x), positions
         )
         x = x + y
-        y = MLP(self.config, name="mlp")(
-            RMSNorm(fused=fused, name="mlp_norm")(x))
+        if cfg.num_experts > 0:
+            from k8s_tpu.models.moe import MoeMLP
+
+            mlp = MoeMLP(
+                num_experts=cfg.num_experts,
+                ffn_hidden=cfg.ffn_hidden,
+                top_k=cfg.expert_top_k,
+                capacity_factor=cfg.expert_capacity_factor,
+                dtype=cfg.dtype,
+                mesh=self.mesh,
+                name="moe_mlp",
+            )
+        else:
+            mlp = MLP(cfg, name="mlp")
+        y = mlp(RMSNorm(fused=fused, name="mlp_norm")(x))
         return x + y
 
 

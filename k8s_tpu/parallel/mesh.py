@@ -4,7 +4,9 @@ TF cluster-spec: SURVEY.md §2.4 "Cluster membership / rendezvous").
 A ``MeshConfig`` names the standard axes:
 
 - ``dp``   — pure data parallelism (params replicated)
+- ``pp``   — pipeline parallelism (layer stages; see parallel.pipeline)
 - ``fsdp`` — data parallelism with sharded params/optimizer state
+- ``ep``   — expert parallelism (MoE expert dim; see models.moe)
 - ``tp``   — tensor (model) parallelism, innermost so its collectives ride
              the fastest ICI links
 - ``sp``   — sequence/context parallelism for ring attention
@@ -25,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass
@@ -34,13 +36,16 @@ class MeshConfig:
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
+    ep: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return self.dp * self.pp * self.fsdp * self.ep * self.sp * self.tp
 
     def axis_sizes(self) -> dict[str, int]:
-        return {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+        return {"dp": self.dp, "pp": self.pp, "fsdp": self.fsdp,
+                "ep": self.ep, "sp": self.sp, "tp": self.tp}
 
     @classmethod
     def auto(
@@ -49,26 +54,33 @@ class MeshConfig:
         tp: int = 1,
         sp: int = 1,
         fsdp: Optional[int] = None,
+        *,
+        ep: int = 1,
+        pp: int = 1,
     ) -> "MeshConfig":
-        """Fill the data axes from the device count: fixed tp/sp, remaining
-        devices go to fsdp (default) with dp=1 — the fsdp-first default that
-        suits most training jobs."""
+        """Fill the data axes from the device count: fixed model axes
+        (tp/sp/ep/pp), remaining devices go to fsdp (default) with dp=1 —
+        the fsdp-first default that suits most training jobs."""
         n = num_devices if num_devices is not None else len(jax.devices())
-        if n % (tp * sp) != 0:
-            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
-        rest = n // (tp * sp)
+        fixed = tp * sp * ep * pp
+        if n % fixed != 0:
+            raise ValueError(
+                f"{n} devices not divisible by tp*sp*ep*pp={fixed}")
+        rest = n // fixed
         if fsdp is None:
             fsdp = rest
         if rest % fsdp != 0:
-            raise ValueError(f"{rest} non-tp/sp devices not divisible by fsdp={fsdp}")
-        return cls(dp=rest // fsdp, fsdp=fsdp, sp=sp, tp=tp)
+            raise ValueError(
+                f"{rest} remaining devices not divisible by fsdp={fsdp}")
+        return cls(dp=rest // fsdp, pp=pp, fsdp=fsdp, ep=ep, sp=sp, tp=tp)
 
 
 def make_mesh(config: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) != config.num_devices:
         raise ValueError(
-            f"mesh needs {config.num_devices} devices (dp×fsdp×sp×tp), got {len(devices)}"
+            f"mesh needs {config.num_devices} devices "
+            f"(dp×pp×fsdp×ep×sp×tp), got {len(devices)}"
         )
     arr = np.array(devices).reshape(
         [config.axis_sizes()[a] for a in AXIS_ORDER]

@@ -1,0 +1,140 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe-style microbatch
+schedule via shard_map + ppermute).
+
+Absent from the reference (SURVEY.md §2.4: its only axes were PS-vs-worker
+data parallelism); in the TPU-native design pipeline stages are a mesh-axis
+choice like every other form of parallelism.
+
+Mechanics: the network is split into ``S = |pp|`` homogeneous stages; each
+device along ``pp`` holds one stage's parameters (stack stage params on a
+leading axis sharded ``P("pp", ...)``).  A batch is split into ``M``
+microbatches.  The schedule runs ``M + S - 1`` ticks; on every tick each
+stage applies its layer to the microbatch it currently holds, then the
+activations rotate one step along the ring (``lax.ppermute``).  Stage 0
+feeds fresh microbatches for the first ``M`` ticks; the last stage emits
+finished microbatches from tick ``S-1`` on.  The bubble is the standard
+GPipe ``(S-1)/(M+S-1)`` fraction — pick ``M >> S``.
+
+Everything is differentiable: ppermute's transpose is the reverse permute,
+so ``jax.grad`` through a pipelined forward produces the 1B backward
+schedule automatically.
+
+Outputs land on the last stage; a masked psum broadcasts them to every
+device (also differentiable), so the loss can be computed uniformly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
+                    axis: str):
+    """Per-device schedule body (under shard_map).
+
+    stage_params: this stage's params (leading stage axis already sliced to
+      size 1 by shard_map; squeezed here).
+    microbatches: [M, mb, ...] — replicated input; only stage 0 reads it.
+    Returns [M, mb, ...] finished outputs (valid on the last stage, zeros
+    elsewhere).
+    """
+    S = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    M = microbatches.shape[0]
+    params = jax.tree.map(lambda x: jnp.squeeze(x, 0), stage_params)
+
+    # ring: stage i sends to i+1; last stage's send wraps to 0 (discarded)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        holding, outputs = carry
+        # stage 0 ingests microbatch t (while t < M); others use what they
+        # received last tick
+        mb_in = microbatches[jnp.minimum(t, M - 1)]
+        x = jnp.where(s == 0, mb_in, holding)
+        y = stage_fn(params, x)
+        # the last stage's result at tick t is finished microbatch t-(S-1)
+        out_idx = t - (S - 1)
+        is_done = jnp.logical_and(s == S - 1, out_idx >= 0)
+        outputs = lax.cond(
+            is_done,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(out_idx, 0), 0),
+            lambda o: o,
+            outputs,
+        )
+        holding = lax.ppermute(y, axis, perm)
+        return (holding, outputs), None
+
+    holding0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(
+        tick, (holding0, outputs0), jnp.arange(M + S - 1))
+
+    # make outputs visible everywhere: only the last stage holds non-zero
+    # data, so a psum over the axis broadcasts it (differentiable)
+    mask = jnp.where(s == S - 1, 1.0, 0.0).astype(outputs.dtype)
+    return lax.psum(outputs * mask, axis)
+
+
+def pipeline_apply(mesh: Mesh, stage_fn: Callable, stage_params, batch, *,
+                   num_microbatches: int, axis: str = "pp",
+                   batch_axes=("dp", "fsdp")):
+    """Run ``batch`` through the pipeline.
+
+    stage_fn(params, x) -> y: one stage's computation, same activation shape
+      in and out (homogeneous stages).
+    stage_params: pytree with leading stage axis of size ``|pp|``.
+    batch: [B, ...] global; B must divide into num_microbatches.
+    Returns [B, ...] outputs.
+    """
+    B = batch.shape[0]
+    if B % num_microbatches:
+        raise ValueError(f"batch {B} not divisible into {num_microbatches} microbatches")
+    mb = B // num_microbatches
+    data_shards = 1
+    for a in (batch_axes if isinstance(batch_axes, (tuple, list)) else (batch_axes,)):
+        data_shards *= mesh.shape[a]
+    if mb % data_shards:
+        raise ValueError(
+            f"microbatch size {mb} not divisible by data shards {data_shards} "
+            f"(axes {batch_axes}); use fewer microbatches or a bigger batch")
+    micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+    # microbatch data stays sharded over the data axes; every pp rank sees
+    # its slice of each microbatch
+    mspec = P(None, batch_axes)
+
+    fn = shard_map(
+        partial(_pipeline_local, stage_fn=stage_fn, axis=axis),
+        mesh=mesh,
+        in_specs=(param_specs, mspec),
+        out_specs=mspec,
+        check_vma=False,
+    )
+    out = fn(stage_params, micro)
+    return out.reshape((B,) + out.shape[2:])
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage param pytrees into the leading-stage-axis layout
+    pipeline_apply expects, e.g. from S separately-initialized stages."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, 0), *params_list)
+
+
+def stage_sharding(mesh: Mesh, stage_params, axis: str = "pp"):
+    """NamedShardings placing each stage's params on its pp rank."""
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, P(axis)), stage_params)

@@ -31,7 +31,18 @@ class TestMesh:
 
     def test_make_mesh_axes(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2))
-        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+        assert dict(mesh.shape) == {"dp": 2, "pp": 1, "fsdp": 2, "ep": 1,
+                                    "sp": 1, "tp": 2}
+
+    def test_make_mesh_six_axes(self):
+        mesh = make_mesh(MeshConfig(pp=2, ep=2, tp=2))
+        assert dict(mesh.shape) == {"dp": 1, "pp": 2, "fsdp": 1, "ep": 2,
+                                    "sp": 1, "tp": 2}
+
+    def test_auto_six_axes(self):
+        cfg = MeshConfig.auto(8, tp=2, pp=2)
+        assert cfg.pp == 2 and cfg.tp == 2 and cfg.fsdp == 2
+        assert cfg.num_devices == 8
 
     def test_topology_parsing(self):
         assert parse_topology("4x4") == (4, 4)
