@@ -1,0 +1,110 @@
+"""Operator benchmark: TFJob time-to-ready and reconcile throughput.
+
+Measures the two operator-attributable numbers BASELINE.md defines:
+
+- **time-to-ready**: submit (tfjobs.create) → every replica pod Running /
+  the job's Running condition set (StartTime logic,
+  pkg/controller.v2/controller_status.go:45-50 in the reference);
+- **reconcile throughput**: jobs/second the controller drives to ready at a
+  given concurrency (the reference's design target is O(100) concurrent
+  TFJobs per cluster, tf_job_design_doc.md "Requirements and Scale").
+
+Runs against the in-process local cluster (fake apiserver + kubelet
+simulator), so the numbers isolate operator overhead from cluster noise.
+
+CLI:  python -m k8s_tpu.harness.bench_operator [--jobs N] [--replicas R]
+Prints one JSON line per metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def _tpu_job(name: str, namespace: str, replicas: int) -> dict:
+    return {
+        "apiVersion": "kubeflow.org/v1alpha2",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"tfReplicaSpecs": {"TPU": {
+            "replicas": replicas,
+            "template": {"spec": {"containers": [{
+                "name": "tensorflow",
+                "image": "k8s-tpu/bench:latest",
+                "ports": [{"name": "tfjob-port", "containerPort": 2222}],
+                "resources": {"limits": {"cloud-tpus.google.com/v5e": 4}},
+            }]}},
+        }}},
+    }
+
+
+def _running_condition_set(job: dict) -> bool:
+    for c in ((job.get("status") or {}).get("conditions")) or []:
+        if c.get("type") == "Running" and c.get("status") == "True":
+            return True
+    return False
+
+
+def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
+                        timeout_s: float = 60.0) -> dict:
+    """Submit ``jobs`` gang jobs back to back; measure each submit→Running
+    latency and the aggregate throughput."""
+    from k8s_tpu.e2e.local import LocalCluster
+
+    ns = "bench"
+    latencies = []
+    # runtime long enough that jobs stay Running while we poll
+    with LocalCluster(version="v1alpha2", namespace=ns,
+                      enable_gang_scheduling=True,
+                      kubelet_kwargs={"default_runtime_s": timeout_s}) as lc:
+        t_all0 = time.perf_counter()
+        submitted = []
+        for i in range(jobs):
+            name = f"bench-{i}"
+            lc.clientset.tfjobs_unstructured(ns).create(
+                _tpu_job(name, ns, replicas))
+            submitted.append((name, time.perf_counter()))
+
+        pending = dict(submitted)
+        deadline = time.perf_counter() + timeout_s
+        while pending and time.perf_counter() < deadline:
+            for name in list(pending):
+                job = lc.clientset.tfjobs_unstructured(ns).get(name)
+                if job is not None and _running_condition_set(job):
+                    latencies.append(time.perf_counter() - pending.pop(name))
+            time.sleep(0.01)
+        elapsed_all = time.perf_counter() - t_all0
+
+    if pending:
+        raise RuntimeError(
+            f"{len(pending)} of {jobs} jobs never reached Running in "
+            f"{timeout_s}s: {sorted(pending)[:5]}")
+    return {
+        "jobs": jobs,
+        "replicas": replicas,
+        "time_to_ready_p50_s": round(statistics.median(latencies), 4),
+        "time_to_ready_max_s": round(max(latencies), 4),
+        "jobs_per_sec": round(jobs / elapsed_all, 2),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--jobs", type=int, default=20)
+    p.add_argument("--replicas", type=int, default=4)
+    p.add_argument("--timeout", type=float, default=60.0)
+    args = p.parse_args(argv)
+
+    result = bench_time_to_ready(args.jobs, args.replicas, args.timeout)
+    print(json.dumps({"metric": "tfjob_time_to_ready_p50",
+                      "value": result["time_to_ready_p50_s"],
+                      "unit": "s", **result}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,3 +222,15 @@ class TestGenjob:
         assert len(docs) == 2
         for d in docs:
             assert d["kind"] == "TFJob"
+
+
+def test_bench_operator_time_to_ready():
+    """harness.bench_operator measures submit->Running on the local cluster
+    (BASELINE.md metric #1)."""
+    from k8s_tpu.harness.bench_operator import bench_time_to_ready
+
+    result = bench_time_to_ready(jobs=4, replicas=2, timeout_s=30.0)
+    assert result["jobs"] == 4
+    assert result["time_to_ready_p50_s"] > 0
+    assert result["time_to_ready_max_s"] < 30.0
+    assert result["jobs_per_sec"] > 0
