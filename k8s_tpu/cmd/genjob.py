@@ -25,9 +25,10 @@ def tfjob_template(
     gpu: bool = False,
     tpu: bool = False,
     scheduler_name: str = "default",
+    tpu_replicas: int = 4,
 ) -> dict:
     """One synthetic job (genjob.go:46-91): 1 WORKER, or 1 MASTER+GPU, or a
-    4-host TPU gang."""
+    TPU gang of ``tpu_replicas`` hosts."""
     if tpu:
         return {
             "apiVersion": "kubeflow.org/v1alpha2",
@@ -37,7 +38,7 @@ def tfjob_template(
                 "tpu": {"acceleratorType": "v5litepod-16", "topology": "4x4"},
                 "tfReplicaSpecs": {
                     "TPU": {
-                        "replicas": 4,
+                        "replicas": tpu_replicas,
                         "restartPolicy": "ExitCode",
                         "template": {
                             "spec": {

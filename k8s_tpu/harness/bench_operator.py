@@ -26,33 +26,25 @@ import time
 
 
 def _tpu_job(name: str, namespace: str, replicas: int) -> dict:
-    return {
-        "apiVersion": "kubeflow.org/v1alpha2",
-        "kind": "TFJob",
-        "metadata": {"name": name, "namespace": namespace},
-        "spec": {"tfReplicaSpecs": {"TPU": {
-            "replicas": replicas,
-            "template": {"spec": {"containers": [{
-                "name": "tensorflow",
-                "image": "k8s-tpu/bench:latest",
-                "ports": [{"name": "tfjob-port", "containerPort": 2222}],
-                "resources": {"limits": {"cloud-tpus.google.com/v5e": 4}},
-            }]}},
-        }}},
-    }
+    from k8s_tpu.cmd.genjob import tfjob_template
+
+    return tfjob_template(name, namespace, tpu=True, tpu_replicas=replicas)
 
 
-def _running_condition_set(job: dict) -> bool:
-    for c in ((job.get("status") or {}).get("conditions")) or []:
-        if c.get("type") == "Running" and c.get("status") == "True":
-            return True
-    return False
+def _all_replicas_running(job: dict) -> bool:
+    """The metric's definition is ALL replica pods Running; the controller's
+    startTime is set exactly when running == replicas
+    (controller_v2/status.py:110-111, mirroring controller_status.go:45-50).
+    The Running *condition* fires at the first running pod — too early."""
+    return bool((job.get("status") or {}).get("startTime"))
 
 
 def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
                         timeout_s: float = 60.0) -> dict:
-    """Submit ``jobs`` gang jobs back to back; measure each submit→Running
-    latency and the aggregate throughput."""
+    """Submit ``jobs`` gang jobs back to back; measure each
+    submit→all-replicas-Running latency and the aggregate throughput."""
+    if jobs < 1:
+        raise ValueError("jobs must be >= 1")
     from k8s_tpu.e2e.local import LocalCluster
 
     ns = "bench"
@@ -72,10 +64,13 @@ def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
         pending = dict(submitted)
         deadline = time.perf_counter() + timeout_s
         while pending and time.perf_counter() < deadline:
-            for name in list(pending):
-                job = lc.clientset.tfjobs_unstructured(ns).get(name)
-                if job is not None and _running_condition_set(job):
-                    latencies.append(time.perf_counter() - pending.pop(name))
+            # one list() per tick: a single backend lock acquisition, so the
+            # poller does not contend with the controller it measures
+            now = time.perf_counter()
+            for job in lc.clientset.tfjobs_unstructured(ns).list():
+                name = (job.get("metadata") or {}).get("name")
+                if name in pending and _all_replicas_running(job):
+                    latencies.append(now - pending.pop(name))
             time.sleep(0.01)
         elapsed_all = time.perf_counter() - t_all0
 
