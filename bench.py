@@ -1,14 +1,25 @@
 #!/usr/bin/env python3
-"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+"""Benchmark: TPU training throughput with MFU accounting.
 
-The headline workload metric from BASELINE.md ("ResNet-50 images/sec/chip on
-a v5e slice").  The reference publishes no numbers (BASELINE.json
-``"published": {}``), so the baseline is self-established: ``vs_baseline``
-compares against the first recorded value in BENCH_BASELINE.json when
-present, else 1.0.
+Two workloads, both from BASELINE.md:
+- ResNet-50 train step (images/sec/chip) — the headline metric.
+- Transformer LM train step (tokens/sec/chip) with the Pallas flash-attention
+  kernel (k8s_tpu.ops.flash_attention) — exercises the path all the
+  ring/flash machinery exists to serve.
+
+The reference publishes no numbers (BASELINE.json ``"published": {}``), so the
+baseline is self-established: ``vs_baseline`` compares against
+BENCH_BASELINE.json when present, else 1.0.
+
+Robustness: this image reaches the TPU through a remote-compile relay that is
+known to drop connections (round-1 BENCH died with ``UNAVAILABLE:
+/remote_compile: Connection refused``).  All device work therefore runs inside
+a retry-with-backoff wrapper, preceded by a cheap connectivity preflight that
+fails fast with an actionable diagnostic when the backend is genuinely absent.
 
 Prints exactly one JSON line:
-  {"metric": "...", "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "images/sec/chip", "vs_baseline": N, ...}
+(extra keys: per-workload MFU, FLOPs/step, device kind, transformer metrics).
 """
 
 from __future__ import annotations
@@ -16,17 +27,179 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
 
+# Peak bf16 dense FLOP/s per chip, by jax device_kind substring (public
+# cloud.google.com/tpu numbers). Used for the MFU denominator.
+PEAK_FLOPS = [
+    ("v6", 918e12),       # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),       # v5e reports device_kind "TPU v5 lite" / "TPU v5e"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5) -> float:
+
+def peak_flops_for(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, peak in PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+_TRANSIENT = (
+    "unavailable", "connection refused", "remote_compile", "deadline_exceeded",
+    "socket closed", "connection reset", "failed to connect", "broken pipe",
+)
+
+
+def is_transient(err: BaseException) -> bool:
+    msg = str(err).lower()
+    return any(t in msg for t in _TRANSIENT)
+
+
+def with_retries(fn, attempts: int = 5, base_delay: float = 5.0, what: str = ""):
+    """Run fn(), retrying on relay/connectivity errors with exp backoff."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - jax raises various XlaRuntimeError subclasses
+            if not is_transient(e) or i == attempts - 1:
+                raise
+            delay = base_delay * (2 ** i)
+            print(
+                f"bench: transient backend error during {what or 'device work'} "
+                f"(attempt {i + 1}/{attempts}, retrying in {delay:.0f}s): "
+                f"{str(e).splitlines()[0][:200]}",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+
+
+class ProbeTimeout(Exception):
+    pass
+
+
+def run_with_timeout(fn, timeout: float, what: str):
+    """Run fn() in a daemon thread; raise ProbeTimeout if it blocks.
+
+    The relay's failure mode is not only fast connection-refused errors but
+    also indefinite hangs on socket I/O (observed round 2: backend init
+    blocked with no exception).  A hung call cannot be cancelled, but the
+    daemon thread lets the caller detect the hang and exit with a
+    diagnostic instead of riding into the driver's rc=124 timeout.
+    """
+    result: list = []
+    error: list = []
+
+    def target():
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001
+            error.append(e)
+
+    t = threading.Thread(target=target, daemon=True, name=f"bench-{what}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise ProbeTimeout(f"{what} still blocked after {timeout:.0f}s")
+    if error:
+        raise error[0]
+    return result[0]
+
+
+def preflight():
+    """Cheap end-to-end device check; fail fast with diagnostics if dead."""
+
+    def probe():
+        import jax.numpy as jnp
+
+        x = jnp.ones((128, 128), jnp.bfloat16)
+        return float(jnp.sum(x @ x))
+
+    timeout = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
+    attempts = 3
+    last = None
+    for i in range(attempts):
+        try:
+            val = run_with_timeout(probe, timeout, "preflight")
+            assert val == 128 * 128 * 128, f"bad preflight result {val}"
+            return
+        except ProbeTimeout as e:
+            # A hung attempt holds JAX's global backend-init lock, so a
+            # fresh thread would just queue on it and time out too — fail
+            # immediately rather than burning more wall-clock.
+            last = e
+            break
+        except Exception as e:  # noqa: BLE001
+            last = e
+            if not is_transient(e):
+                print(
+                    "bench: FATAL: preflight failed with a non-relay error "
+                    "(this is a code/setup bug, not backend connectivity):\n"
+                    f"  {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+                raise
+            print(
+                f"bench: preflight attempt {i + 1}/{attempts} failed "
+                f"({str(e).splitlines()[0][:200]})",
+                file=sys.stderr,
+            )
+            if i < attempts - 1:
+                time.sleep(5 * (i + 1))
+    print(
+        "bench: FATAL: TPU backend unreachable (connection refused or hung "
+        "relay).\n"
+        f"  last error: {type(last).__name__}: {last}\n"
+        "  If this is the axon relay, check the tunnel (remote_compile "
+        "endpoint) is up; on CPU-only hosts run with JAX_PLATFORMS=cpu for a "
+        "smoke value.",
+        file=sys.stderr,
+    )
+    raise SystemExit(2)
+
+
+def cost_analysis_flops(compiled) -> float | None:
+    """Per-step FLOPs from a Compiled object's XLA cost analysis."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca:
+            f = ca.get("flops")
+            if f and f > 0:
+                return float(f)
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        pass
+    return None
+
+
+def _time_steps(run_step, state, iters: int, warmup: int):
+    """Time `iters` dependent steps; sync via scalar fetch (a host fetch of
+    the loss cannot complete before the whole chain executes — plain
+    block_until_ready is not a reliable barrier over the remote relay)."""
+    for _ in range(warmup):
+        state, loss = run_step(state)
+    if warmup:
+        _ = float(loss)
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, loss = run_step(state)
+    _ = float(loss)
+    return time.perf_counter() - start
+
+
+def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5):
     import jax
     import jax.numpy as jnp
     import optax
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from k8s_tpu.models import train as train_lib
     from k8s_tpu.models.resnet import resnet50
 
@@ -38,13 +211,15 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5) 
     images = jax.random.normal(key, (batch, 224, 224, 3), jnp.bfloat16)
     labels = jax.random.randint(key, (batch,), 0, 1000)
 
-    variables = model.init(jax.random.PRNGKey(1), images[:1], train=False)
+    variables = with_retries(
+        lambda: model.init(jax.random.PRNGKey(1), images[:1], train=False),
+        what="resnet init",
+    )
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
 
     optimizer = optax.sgd(0.1, momentum=0.9)
-    opt_state = optimizer.init(params)
+    opt_state = with_retries(lambda: optimizer.init(params), what="opt init")
 
-    @jax.jit
     def step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             logits, updates = model.apply(
@@ -60,49 +235,208 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5) 
         new_params = optax.apply_updates(params, updates)
         return new_params, new_stats, new_opt_state, loss
 
-    # Synchronize by fetching the scalar loss to host: the fetch cannot
-    # complete before the whole dependency chain has executed.  (Plain
-    # block_until_ready is not a reliable barrier under remote-relay
-    # execution environments and yields impossible numbers.)
-    for _ in range(warmup):
-        params, batch_stats, opt_state, loss = step(
+    # AOT-compile once and reuse the Compiled object for both cost analysis
+    # and the timed loop (compiling via jit dispatch again would do a second
+    # full XLA compile over the flaky relay).
+    step_c = with_retries(
+        lambda: jax.jit(step).lower(
+            params, batch_stats, opt_state, images, labels
+        ).compile(),
+        what="resnet compile",
+    )
+    # MFU uses the analytic model-FLOPs convention (ResNet-50 fwd ~4.1
+    # GFLOP/img at 224^2 counting 2*MACs, train step ~3x fwd); XLA's
+    # cost-analysis count is reported separately as a cross-check — it
+    # includes BN/elementwise and backend-specific expansions, so using it
+    # for MFU would overstate utilization.
+    flops = 3 * 4.1e9 * batch
+    xla_flops = cost_analysis_flops(step_c)
+
+    def run_step(state):
+        params, batch_stats, opt_state = state
+        params, batch_stats, opt_state, loss = step_c(
             params, batch_stats, opt_state, images, labels
         )
-    if warmup:
-        _ = float(loss)
+        return (params, batch_stats, opt_state), loss
 
-    start = time.perf_counter()
-    for _ in range(iters):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
-        )
-    _ = float(loss)
-    elapsed = time.perf_counter() - start
-
+    elapsed = with_retries(
+        lambda: _time_steps(run_step, (params, batch_stats, opt_state), iters, warmup),
+        what="resnet timing",
+    )
     images_per_sec = batch * iters / elapsed
-    return images_per_sec / n_chips
+    return {
+        "images_per_sec_per_chip": images_per_sec / n_chips,
+        "flops_per_step": flops,
+        "xla_flops_per_step": xla_flops,
+        "flops_per_sec_per_chip": flops * iters / elapsed / n_chips,
+        "step_time_ms": elapsed / iters * 1000,
+    }
+
+
+def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
+                      iters: int = 30, warmup: int = 5):
+    """GPT-2-small-shaped causal LM train step with Pallas flash attention."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_tpu.models import train as train_lib
+    from k8s_tpu.models.transformer import Transformer, TransformerConfig
+
+    n_chips = len(jax.devices())
+    batch = batch_per_chip * n_chips
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = TransformerConfig(
+        vocab_size=32000, hidden=768, ffn_hidden=3072, layers=12, heads=12,
+        kv_heads=12, max_seq_len=seq, dtype=jnp.bfloat16, remat=False,
+        use_flash_attention=on_tpu,  # Pallas kernel is TPU-only
+    )
+    model = Transformer(cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size
+    )
+    params = with_retries(
+        lambda: model.init(jax.random.PRNGKey(1), tokens[:1]),
+        what="transformer init",
+    )
+    optimizer = train_lib.default_optimizer(1e-4)
+    opt_state = with_retries(lambda: optimizer.init(params), what="opt init")
+
+    import optax
+
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            return train_lib.lm_loss(model.apply(p, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state, loss
+
+    step_c = with_retries(
+        lambda: jax.jit(step).lower(params, opt_state, tokens).compile(),
+        what="transformer compile",
+    )
+    # Analytic model FLOPs for MFU: 6N per token (fwd+bwd dense, incl. the
+    # tied-embedding logits matmul) + attention 12*layers*hidden*seq
+    # (full-matrix convention). XLA's count reported as a cross-check.
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    flops = (6 * n_params + 12 * cfg.layers * cfg.hidden * seq) * batch * seq
+    xla_flops = cost_analysis_flops(step_c)
+
+    def run_step(state):
+        params, opt_state = state
+        params, opt_state, loss = step_c(params, opt_state, tokens)
+        return (params, opt_state), loss
+
+    elapsed = with_retries(
+        lambda: _time_steps(run_step, (params, opt_state), iters, warmup),
+        what="transformer timing",
+    )
+    tokens_per_sec = batch * seq * iters / elapsed
+    return {
+        "tokens_per_sec_per_chip": tokens_per_sec / n_chips,
+        "flops_per_step": flops,
+        "xla_flops_per_step": xla_flops,
+        "flops_per_sec_per_chip": flops * iters / elapsed / n_chips,
+        "step_time_ms": elapsed / iters * 1000,
+        "n_params": n_params,
+        "flash_attention": cfg.use_flash_attention,
+    }
 
 
 def main() -> int:
-    value = bench_resnet50()
-    baseline = None
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    # Global watchdog: if the relay hangs mid-bench (after a green
+    # preflight), exit with a diagnostic instead of the driver's rc=124.
+    total_timeout = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "2400"))
+
+    def die():
+        print(
+            f"bench: FATAL: wall-clock exceeded {total_timeout:.0f}s — TPU "
+            "relay most likely hung mid-run (preflight was green). Aborting.",
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+        os._exit(3)
+
+    watchdog = threading.Timer(total_timeout, die)
+    watchdog.daemon = True
+    watchdog.start()
+
+    preflight()
+    import jax
+    device_kind = jax.devices()[0].device_kind
+    peak = peak_flops_for(device_kind)
+
+    only = os.environ.get("BENCH_ONLY", "").lower()
+    if only not in ("", "resnet", "transformer"):
+        print(
+            f"bench: FATAL: unknown BENCH_ONLY={only!r} "
+            "(expected 'resnet' or 'transformer')",
+            file=sys.stderr,
+        )
+        return 2
+    # Smoke knobs (CPU validation / quick runs); defaults are the real bench.
+    rn_kw = {}
+    tf_kw = {}
+    if os.environ.get("BENCH_SMOKE"):
+        rn_kw = dict(batch_per_chip=2, iters=2, warmup=1)
+        tf_kw = dict(batch_per_chip=1, seq=128, iters=2, warmup=1)
+
+    resnet = bench_resnet50(**rn_kw) if only in ("", "resnet") else None
+    transformer = bench_transformer(**tf_kw) if only in ("", "transformer") else None
+
+    baseline = {}
     if os.path.exists(BASELINE_FILE):
         try:
             with open(BASELINE_FILE) as f:
-                baseline = json.load(f).get("resnet50_images_per_sec_per_chip")
+                baseline = json.load(f)
         except (OSError, ValueError):
-            baseline = None
-    vs_baseline = round(value / baseline, 4) if baseline else 1.0
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_images_per_sec_per_chip",
-                "value": round(value, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": vs_baseline,
-            }
+            baseline = {}
+
+    out = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+        "device_kind": device_kind,
+        "n_chips": len(jax.devices()),
+    }
+    if resnet:
+        out["value"] = round(resnet["images_per_sec_per_chip"], 2)
+        base = baseline.get("resnet50_images_per_sec_per_chip")
+        if base:
+            out["vs_baseline"] = round(out["value"] / base, 4)
+        out["resnet50_step_time_ms"] = round(resnet["step_time_ms"], 2)
+        out["resnet50_flops_per_step"] = resnet["flops_per_step"]
+        if peak:
+            out["resnet50_mfu"] = round(resnet["flops_per_sec_per_chip"] / peak, 4)
+    if transformer:
+        out["transformer_tokens_per_sec_per_chip"] = round(
+            transformer["tokens_per_sec_per_chip"], 1
         )
-    )
+        out["transformer_step_time_ms"] = round(transformer["step_time_ms"], 2)
+        out["transformer_n_params"] = transformer["n_params"]
+        out["transformer_flash_attention"] = transformer["flash_attention"]
+        base = baseline.get("transformer_tokens_per_sec_per_chip")
+        if base:
+            out["transformer_vs_baseline"] = round(
+                out["transformer_tokens_per_sec_per_chip"] / base, 4
+            )
+        if peak:
+            out["transformer_mfu"] = round(
+                transformer["flops_per_sec_per_chip"] / peak, 4
+            )
+        if resnet is None:  # transformer-only run: promote to headline metric
+            out["metric"] = "transformer_tokens_per_sec_per_chip"
+            out["value"] = out["transformer_tokens_per_sec_per_chip"]
+            out["unit"] = "tokens/sec/chip"
+            out["vs_baseline"] = out.get("transformer_vs_baseline", 1.0)
+    if peak:
+        out["peak_flops_per_chip"] = peak
+
+    print(json.dumps(out))
     return 0
 
 
