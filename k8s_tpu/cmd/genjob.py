@@ -19,6 +19,28 @@ import yaml
 log = logging.getLogger(__name__)
 
 
+V5E_CHIPS_PER_HOST = 4
+
+
+def v5e_slice_for_hosts(num_hosts: int) -> tuple[str, str]:
+    """(acceleratorType, topology) for a v5e slice of ``num_hosts`` hosts
+    (4 chips/host).  v5e topologies are XxY chip grids with power-of-two
+    sides, so num_hosts must be a power of two (1 -> 2x2 single host,
+    4 -> 4x4, 16 -> 8x8, ...)."""
+    if num_hosts < 1 or num_hosts & (num_hosts - 1):
+        raise ValueError(
+            f"v5e slices need a power-of-two host count, got {num_hosts}"
+        )
+    chips = num_hosts * V5E_CHIPS_PER_HOST
+    x = 1
+    while x * x < chips:
+        x *= 2
+    if x * x > chips:
+        x //= 2
+    y = chips // x
+    return f"v5litepod-{chips}", f"{x}x{y}"
+
+
 def tfjob_template(
     job_name: str,
     namespace: str = "default",
@@ -30,12 +52,13 @@ def tfjob_template(
     """One synthetic job (genjob.go:46-91): 1 WORKER, or 1 MASTER+GPU, or a
     TPU gang of ``tpu_replicas`` hosts."""
     if tpu:
+        accel, topology = v5e_slice_for_hosts(tpu_replicas)
         return {
             "apiVersion": "kubeflow.org/v1alpha2",
             "kind": "TFJob",
             "metadata": {"name": job_name, "namespace": namespace},
             "spec": {
-                "tpu": {"acceleratorType": "v5litepod-16", "topology": "4x4"},
+                "tpu": {"acceleratorType": accel, "topology": topology},
                 "tfReplicaSpecs": {
                     "TPU": {
                         "replicas": tpu_replicas,
@@ -48,7 +71,10 @@ def tfjob_template(
                                         "name": "tensorflow",
                                         "image": "k8s-tpu/smoke:latest",
                                         "resources": {
-                                            "limits": {"cloud-tpus.google.com/v5e": 4}
+                                            "limits": {
+                                                "cloud-tpus.google.com/v5e":
+                                                    V5E_CHIPS_PER_HOST
+                                            }
                                         },
                                     }
                                 ],

@@ -24,7 +24,9 @@ TPU-native departures (SURVEY.md §7 "hard parts", designed deliberately):
 from __future__ import annotations
 
 import copy
+import datetime
 import logging
+import time
 
 from k8s_tpu.api.v1alpha2 import types
 from k8s_tpu.controller_v2 import status as status_mod
@@ -113,7 +115,41 @@ def node_indicates_preemption(node: dict) -> bool:
     return False
 
 
-def pod_on_preempted_node(pod: dict, node_lister) -> bool:
+# How recently a pod must have failed for a *missing* node to count as
+# preemption evidence.  A node can legitimately vanish long after an
+# unrelated pod failure (autoscaler scale-down, reconcile backlog after
+# operator downtime); inferring preemption from staleness would reclassify
+# a permanently-failing job as retryable and gang-restart it forever.
+# Tradeoff: a genuine preemption first reconciled more than this window
+# after the pod died (operator down throughout) keeps its exit-code
+# verdict.  That is acceptable because preempted pods normally die with
+# SIGTERM/143 — retryable under ExitCode policy on its own — so the node
+# evidence only matters for the rarer permanent-looking codes, where
+# failing closed (no restart loop) is the safer default.
+MISSING_NODE_FRESHNESS_SECONDS = 10 * 60.0
+
+
+def _pod_failure_finished_at(pod: dict) -> float | None:
+    """Latest terminated.finishedAt across container statuses, as a POSIX
+    timestamp (None when no terminated status carries one)."""
+    latest = None
+    for cs in (pod.get("status") or {}).get("containerStatuses") or []:
+        term = (cs.get("state") or {}).get("terminated") or {}
+        ts = term.get("finishedAt")
+        if not ts:
+            continue
+        try:
+            parsed = datetime.datetime.fromisoformat(str(ts).replace("Z", "+00:00"))
+        except ValueError:
+            continue
+        if parsed.tzinfo is None:
+            parsed = parsed.replace(tzinfo=datetime.timezone.utc)
+        stamp = parsed.timestamp()
+        latest = stamp if latest is None else max(latest, stamp)
+    return latest
+
+
+def pod_on_preempted_node(pod: dict, node_lister, *, now: float | None = None) -> bool:
     """Node-condition awareness: look up the pod's node and check for
     preemption/teardown evidence.  ``node_lister`` may be None (no node
     informer — e.g. RBAC without node read), which degrades gracefully to
@@ -125,9 +161,18 @@ def pod_on_preempted_node(pod: dict, node_lister) -> bool:
         return False
     node = node_lister.get("", node_name)
     if node is None:
-        # the pod names a node the informer has never seen or that was
-        # deleted out from under it: the machine is gone -> preempted
-        return True
+        # The pod names a node the informer has never seen or that was
+        # deleted out from under it.  That is preemption evidence only when
+        # the pod's failure is *recent* — the node deletion then plausibly
+        # caused the failure.  A stale failure (or one with no finishedAt to
+        # date it) whose node later disappeared keeps its exit-code
+        # classification; pods that died because the kubelet vanished have
+        # no exit code and stay retryable through that path anyway.
+        finished = _pod_failure_finished_at(pod)
+        if finished is None:
+            return False
+        now = time.time() if now is None else now
+        return (now - finished) <= MISSING_NODE_FRESHNESS_SECONDS
     return node_indicates_preemption(node)
 
 

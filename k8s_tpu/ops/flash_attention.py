@@ -344,6 +344,15 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     """
     B, L, H, D = q.shape
     Hkv = k.shape[2]
+    if causal and L != k.shape[1]:
+        # The kernels' causal mask assumes q and k positions are both
+        # 0-aligned; with Lk != L (e.g. kv-cache decode, where q positions
+        # are conventionally offset by Lk - L) it would silently mask the
+        # wrong entries.  Self-attention is the only supported causal shape.
+        raise ValueError(
+            f"causal=True requires L == Lk (got L={L}, Lk={k.shape[1]}); "
+            "use causal=False or 0-pad q to the kv length"
+        )
     if scale is None:
         scale = D ** -0.5
     if Hkv != H:

@@ -68,7 +68,8 @@ def make_tfjob(worker=0, ps=0, tpu=0, restart_policy="", version="v1alpha2"):
     )
 
 
-def make_pod(rtype, index, phase, exit_code=None, node_name=None):
+def make_pod(rtype, index, phase, exit_code=None, node_name=None,
+             finished_at=None):
     labels = tpu_config.gen_labels(KEY)
     labels[tpu_config.LABEL_REPLICA_TYPE] = rtype
     labels[tpu_config.LABEL_REPLICA_INDEX] = str(index)
@@ -84,13 +85,17 @@ def make_pod(rtype, index, phase, exit_code=None, node_name=None):
                  "name": JOB_NAME, "uid": "uid-job-1", "controller": True}
             ],
         },
+        "spec": {"containers": [{"name": "tensorflow"}]},
         "status": {"phase": phase},
     }
     if node_name is not None:
-        pod["spec"] = {"nodeName": node_name}
+        pod["spec"]["nodeName"] = node_name
     if exit_code is not None:
+        terminated = {"exitCode": exit_code}
+        if finished_at is not None:
+            terminated["finishedAt"] = finished_at
         pod["status"]["containerStatuses"] = [
-            {"name": "tensorflow", "state": {"terminated": {"exitCode": exit_code}}}
+            {"name": "tensorflow", "state": {"terminated": terminated}}
         ]
     return pod
 

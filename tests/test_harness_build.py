@@ -7,6 +7,7 @@ import os
 import subprocess
 import tarfile
 
+import pytest
 import yaml
 
 from k8s_tpu.api import manifest
@@ -199,6 +200,24 @@ class TestGenjob:
         assert spec["replicas"] == 4
         typed = manifest.load_tfjob(job)
         assert typed.spec.tpu.accelerator_type == "v5litepod-16"
+
+    def test_tpu_topology_tracks_replica_count(self):
+        # acceleratorType/topology must be consistent with the host count
+        # (4 chips/host on v5e), not hardcoded to one slice shape
+        cases = {1: ("v5litepod-4", "2x2"), 2: ("v5litepod-8", "2x4"),
+                 4: ("v5litepod-16", "4x4"), 8: ("v5litepod-32", "4x8"),
+                 16: ("v5litepod-64", "8x8")}
+        for hosts, (accel, topo) in cases.items():
+            job = genjob.tfjob_template("j", tpu=True, tpu_replicas=hosts)
+            assert job["spec"]["tpu"] == {
+                "acceleratorType": accel, "topology": topo
+            }, hosts
+
+    def test_tpu_non_power_of_two_hosts_rejected(self):
+        with pytest.raises(ValueError):
+            genjob.v5e_slice_for_hosts(3)
+        with pytest.raises(ValueError):
+            genjob.v5e_slice_for_hosts(0)
 
     def test_unique_names_and_scheduler(self):
         jobs = genjob.generate(3, scheduler_name="kube-batch", timestamp=9)

@@ -2,9 +2,16 @@
 -only classification is lossy; node taints/Ready conditions disambiguate a
 preempted machine from a crashed workload)."""
 
+import time
+from datetime import datetime, timezone
+
 from k8s_tpu.controller_v2 import pod as pod_mod
 from k8s_tpu.controller_v2.status import get_condition
 from tests.test_controller_v2 import KEY, build_controller, make_pod, make_tfjob
+
+
+def _iso(stamp: float) -> str:
+    return datetime.fromtimestamp(stamp, timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
 def make_node(name, taint_key=None, ready="True"):
@@ -40,24 +47,52 @@ class TestNodeSignals:
         pod = make_pod("tpu", 0, "Failed", exit_code=1, node_name="n1")
         assert not pod_mod.pod_on_preempted_node(pod, None)
 
-    def test_vanished_node_is_preemption(self):
+    def test_vanished_node_with_recent_failure_is_preemption(self):
+        class EmptyLister:
+            def get(self, ns, name):
+                return None
+
+        pod = make_pod("tpu", 0, "Failed", exit_code=1, node_name="gone",
+                       finished_at=_iso(time.time() - 30))
+        assert pod_mod.pod_on_preempted_node(pod, EmptyLister())
+
+    def test_vanished_node_with_stale_failure_is_not_preemption(self):
+        """A node removed long after an unrelated pod failure (autoscaler
+        scale-down, reconcile backlog) must not reclassify a permanent
+        failure as retryable — that would gang-restart the job forever."""
+
+        class EmptyLister:
+            def get(self, ns, name):
+                return None
+
+        stale = time.time() - 2 * pod_mod.MISSING_NODE_FRESHNESS_SECONDS
+        pod = make_pod("tpu", 0, "Failed", exit_code=1, node_name="gone",
+                       finished_at=_iso(stale))
+        assert not pod_mod.pod_on_preempted_node(pod, EmptyLister())
+
+    def test_vanished_node_without_timestamp_is_not_preemption(self):
+        """No finishedAt -> cannot establish the deletion caused the
+        failure; keep the exit-code classification.  (A kubelet-vanished pod
+        has no exit code at all and stays retryable through that path.)"""
+
         class EmptyLister:
             def get(self, ns, name):
                 return None
 
         pod = make_pod("tpu", 0, "Failed", exit_code=1, node_name="gone")
-        assert pod_mod.pod_on_preempted_node(pod, EmptyLister())
+        assert not pod_mod.pod_on_preempted_node(pod, EmptyLister())
 
 
 class TestGangPreemptionOverride:
     """A gang pod dying with a permanent-looking exit code on a preempted
     node restarts the gang instead of failing the job."""
 
-    def _run(self, nodes, exit_code=1):
+    def _run(self, nodes, exit_code=1, finished_at=None):
         tfjob = make_tfjob(tpu=2, restart_policy="ExitCode")
         pods = [
             make_pod("tpu", 0, "Running", node_name="n-ok"),
-            make_pod("tpu", 1, "Failed", exit_code=exit_code, node_name="n-bad"),
+            make_pod("tpu", 1, "Failed", exit_code=exit_code, node_name="n-bad",
+                     finished_at=finished_at),
         ]
         controller, pod_control, _, captured = build_controller(
             tfjob, pods, [], nodes=nodes)
@@ -80,11 +115,22 @@ class TestGangPreemptionOverride:
         assert get_condition(captured[-1].status, "Failed") is not None
 
     def test_node_lost_from_informer_restarts_gang(self):
-        # the bad pod's node doesn't exist at all -> machine gone -> retry
+        # the bad pod's node doesn't exist at all and the failure is fresh
+        # -> machine gone took the pod with it -> retry
         nodes = [make_node("n-ok")]
-        pod_control, captured = self._run(nodes)
+        pod_control, captured = self._run(
+            nodes, finished_at=_iso(time.time() - 30))
         assert len(pod_control.delete_pod_names) == 2
         assert get_condition(captured[-1].status, "Failed") is None
+
+    def test_node_lost_long_after_failure_fails_job(self):
+        # node vanished (scale-down) long after the permanent failure:
+        # the exit-code verdict stands, job is Failed, no restart loop
+        nodes = [make_node("n-ok")]
+        stale = time.time() - 2 * pod_mod.MISSING_NODE_FRESHNESS_SECONDS
+        pod_control, captured = self._run(nodes, finished_at=_iso(stale))
+        assert pod_control.delete_pod_names == []
+        assert get_condition(captured[-1].status, "Failed") is not None
 
     def test_never_policy_still_wins(self):
         tfjob = make_tfjob(tpu=2, restart_policy="Never")

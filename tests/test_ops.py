@@ -44,6 +44,16 @@ class TestFlashAttentionForward:
                                   jnp.repeat(v, 4, axis=2), causal=True)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    def test_causal_cross_length_rejected(self):
+        # causal masking assumes 0-aligned self-attention; a kv-cache decode
+        # shape (L != Lk) would silently mask the wrong entries
+        q = _rand(0, (1, 16, 2, 16))
+        k = _rand(1, (1, 64, 2, 16))
+        v = _rand(2, (1, 64, 2, 16))
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=True)
+        flash_attention(q, k, v, causal=False)  # cross-attention still fine
+
     def test_single_block(self):
         B, L, H, D = 1, 32, 2, 16
         q, k, v = (_rand(i, (B, L, H, D)) for i in range(3))
