@@ -4,13 +4,16 @@ The reference's only parallelism axes were PS-vs-worker data parallelism over
 gRPC (SURVEY.md §2.4).  Here the axes are a first-class design: a
 ``jax.sharding.Mesh`` with named axes (dp/pp/fsdp/ep/sp/tp) over which
 pjit/XLA insert ICI/DCN collectives, plus shard_map-level sequence
-parallelism (ring attention) for long context and a GPipe microbatch
-schedule (parallel.pipeline) for pipeline parallelism.
+parallelism (ring attention) for long context and two pipeline microbatch
+schedules (parallel.pipeline: GPipe and memory-bounded 1F1B).  Explicit
+latency-hiding ring collectives for shard_map code live in
+parallel.collectives.
 """
 
 from k8s_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
 from k8s_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
+    pipeline_train_step_1f1b,
     stack_stage_params,
     stage_sharding,
 )

@@ -1,39 +1,31 @@
-"""Collective helpers over mesh axes (the XLA-collectives replacement for the
-reference's TF gRPC sessions, SURVEY.md §5 "Distributed communication
-backend").
+"""Explicit ring collectives with compute/communication overlap.
 
-Thin, named wrappers so model code reads as topology ("ring shift over sp")
-rather than raw lax calls; all usable under ``shard_map``/``pjit``.
+The reference's "communication backend" is TF gRPC sessions over kube-dns
+(SURVEY.md §5 "Distributed communication backend"); the TPU-native
+replacement is XLA collectives over ICI.  For most code the pjit recipe —
+annotate shardings, let XLA insert psum/all-gather — is the whole story and
+callers should use ``jax.lax`` directly.  This module holds the cases where
+the *schedule* of a collective matters: manual ring algorithms (ppermute
+chains under ``shard_map``) that interleave each hop's transfer with the
+compute that consumes it, hiding ICI latency under MXU work.  Ring attention
+(k8s_tpu.parallel.ring_attention) and both pipeline schedules
+(k8s_tpu.parallel.pipeline) are built on the same ``ring_shift`` primitive.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def psum(x, axis: str):
-    return lax.psum(x, axis_name=axis)
-
-
-def pmean(x, axis: str):
-    return lax.pmean(x, axis_name=axis)
-
-
-def all_gather(x, axis: str, *, tiled: bool = True, gather_dim: int = 0):
-    return lax.all_gather(x, axis_name=axis, axis=gather_dim, tiled=tiled)
-
-
-def reduce_scatter(x, axis: str, *, scatter_dim: int = 0):
-    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_dim, tiled=True)
-
-
 def ring_shift(x, axis: str, *, reverse: bool = False):
     """Send our shard to the next rank on the ring (ppermute); the backbone
-    of ring attention and bidirectional pipelining over ICI."""
+    of ring attention, pipeline microbatch rotation, and the ring collectives
+    below.  ``reverse`` sends up-ring (rank i -> i-1), the direction pipeline
+    backward passes use."""
     n = lax.axis_size(axis)
     if reverse:
         perm = [(i, (i - 1) % n) for i in range(n)]
@@ -42,26 +34,85 @@ def ring_shift(x, axis: str, *, reverse: bool = False):
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
 
-def axis_index(axis: str):
-    return lax.axis_index(axis)
+def ring_all_gather(x, axis: str, *, fold_fn: Optional[Callable] = None):
+    """Ring all-gather of per-rank shards, one hop per step.
+
+    Where ``lax.all_gather`` leaves scheduling to XLA, the explicit ring
+    exposes each shard to ``fold_fn(acc, shard, src_rank)`` the step it
+    lands, so per-shard compute overlaps the next hop's transfer (``acc`` is
+    None on the first fold).  Without ``fold_fn``, returns ``[n, ...]``
+    stacked shards in rank order (equivalent to ``lax.all_gather``); with
+    it, returns the final accumulator (see ``collective_matmul``).
+    """
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+
+    if fold_fn is None:
+        def fold_fn(acc, shard, src):  # default: stack into rank order
+            if acc is None:
+                acc = jnp.zeros((n,) + shard.shape, shard.dtype)
+            return lax.dynamic_update_index_in_dim(acc, shard, src, 0)
+
+    # step 0 folds our own shard, then each hop delivers the shard that
+    # originated t ranks up-ring
+    acc = fold_fn(None, x, i)
+    cur = ring_shift(x, axis)
+
+    def body(t, carry):
+        cur, acc = carry
+        acc = fold_fn(acc, cur, (i - t) % n)
+        # the final iteration's send is dead; XLA drops it (static loop
+        # structure keeps the whole chain one fused while on TPU)
+        cur = ring_shift(cur, axis)
+        return cur, acc
+
+    _, acc = lax.fori_loop(1, n, body, (cur, acc))
+    return acc
 
 
-def axis_size(axis: str):
-    return lax.axis_size(axis)
+def ring_reduce_scatter(x, axis: str):
+    """Ring reduce-scatter: ``x`` is ``[n, chunk...]`` per rank (one chunk
+    addressed to each rank); returns this rank's ``[chunk...]`` sum across
+    ranks — equivalent to ``lax.psum_scatter(x, tiled=False)``.
+
+    Classic bandwidth-optimal ring: the partial sum for chunk ``c`` starts
+    at rank ``c+1`` and travels the ring once, each rank adding its local
+    contribution as it passes through, arriving fully reduced at rank ``c``
+    after ``n-1`` hops.  Each hop's addition overlaps the next transfer.
+    """
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+
+    # rank i initializes the partial for chunk i-1 (which will land on rank
+    # i-1 after the full loop of the ring)
+    partial = x[(i - 1) % n]
+
+    def body(k, partial):
+        partial = ring_shift(partial, axis)
+        # after hop k we hold the partial for chunk i-1-k; fold in our piece
+        return partial + x[(i - 1 - k) % n]
+
+    return lax.fori_loop(1, n, body, partial)
 
 
-def global_mean_over(axes: tuple[str, ...]):
-    """Gradient reduction across every data-ish axis: psum-normalized mean."""
+def collective_matmul(x_shard, w, axis: str):
+    """Latency-hiding tensor-parallel matmul: ``x`` row-sharded over
+    ``axis`` (``x_shard: [rows/n, k]``), ``w`` replicated; returns the full
+    ``x @ w`` (``[rows, out]``) by overlapping each ring hop of the
+    all-gather with the matmul of the shard that just arrived — the
+    "collective matmul" pattern XLA fuses for all-gather+dot under pjit,
+    written explicitly for shard_map code where that fusion isn't available.
+    """
+    n = lax.axis_size(axis)
+    rows = x_shard.shape[0]
 
-    def reduce_fn(tree):
-        def one(x):
-            for a in axes:
-                x = lax.pmean(x, axis_name=a)
-            return x
+    def fold(acc, shard, src):
+        y = shard @ w  # MXU work for this hop, overlapping the next transfer
+        if acc is None:
+            acc = jnp.zeros((n * rows,) + y.shape[1:], y.dtype)
+        return lax.dynamic_update_slice_in_dim(acc, y, src * rows, 0)
 
-        return jax.tree.map(one, tree)
-
-    return reduce_fn
+    return ring_all_gather(x_shard, axis, fold_fn=fold)
 
 
 def host_local_array_to_global(mesh, arrays, pspec):

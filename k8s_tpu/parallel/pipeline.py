@@ -49,13 +49,12 @@ def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
     Returns [M, mb, ...] finished outputs (valid on the last stage, zeros
     elsewhere).
     """
+    from k8s_tpu.parallel.collectives import ring_shift
+
     S = lax.axis_size(axis)
     s = lax.axis_index(axis)
     M = microbatches.shape[0]
     params = jax.tree.map(lambda x: jnp.squeeze(x, 0), stage_params)
-
-    # ring: stage i sends to i+1; last stage's send wraps to 0 (discarded)
-    perm = [(i, (i + 1) % S) for i in range(S)]
 
     def tick(carry, t):
         holding, outputs = carry
@@ -74,7 +73,8 @@ def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
             lambda o: o,
             outputs,
         )
-        holding = lax.ppermute(y, axis, perm)
+        # ring: stage i sends to i+1; last stage's wrap to 0 is discarded
+        holding = ring_shift(y, axis)
         return (holding, outputs), None
 
     holding0 = jnp.zeros_like(microbatches[0])
@@ -132,6 +132,182 @@ def stack_stage_params(params_list):
     """Stack per-stage param pytrees into the leading-stage-axis layout
     pipeline_apply expects, e.g. from S separately-initialized stages."""
     return jax.tree.map(lambda *xs: jnp.stack(xs, 0), *params_list)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule
+# ---------------------------------------------------------------------------
+#
+# GPipe above runs all M forwards, then jax.grad's reverse scan runs all M
+# backwards — every stage must keep (or remat from) M microbatches of
+# activations.  The 1F1B (one-forward-one-backward) schedule interleaves:
+# the last stage starts microbatch m's backward the moment its forward
+# finishes, so at any instant a stage has at most O(S) microbatches in
+# flight regardless of M.  Non-interleaved 1F1B has the SAME bubble fraction
+# as GPipe — (S-1)/(M+S-1) — its win is peak activation memory O(S) vs O(M)
+# (see bubble_fraction / peak_activation_microbatches below, asserted in
+# tests/test_pipeline.py).
+#
+# SPMD formulation: one lax.scan over ticks; per tick every device does one
+# forward compute (activations ppermute down-ring) AND one backward compute
+# (cotangents ppermute up-ring), with index masks selecting which microbatch
+# each stream is on.  Timeline (stage s, microbatch m):
+#   forward  of m at tick m + s
+#   backward of m at tick m + 2S - 2 - s   (last stage: same tick as fwd)
+# Total ticks: M + 2S - 2.  Residuals: each stage stores only the stage
+# *input* x_m in a circular buffer of min(M, 2S-1) slots and re-linearizes
+# (jax.vjp) at backward time — rematerialization, the standard TPU
+# HBM-for-FLOPs trade.
+#
+# The loss must decompose over microbatches (loss = 1/M sum loss_mb), which
+# lets the last stage emit dL/dout_m immediately; LM/cross-entropy losses
+# all have this shape.
+
+
+def bubble_fraction(schedule: str, num_microbatches: int, num_stages: int) -> float:
+    """Fraction of stage-time idle; identical for gpipe and (non-interleaved)
+    1f1b: (S-1)/(M+S-1)."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    M, S = num_microbatches, num_stages
+    return (S - 1) / (M + S - 1)
+
+
+def peak_activation_microbatches(schedule: str, num_microbatches: int,
+                                 num_stages: int) -> int:
+    """Peak in-flight microbatch residuals a stage must hold — the metric
+    1f1b exists to bound: O(M) for gpipe, O(S) for 1f1b."""
+    M, S = num_microbatches, num_stages
+    if schedule == "gpipe":
+        return M
+    if schedule == "1f1b":
+        return min(M, 2 * S - 1)
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def _pipeline_1f1b_local(stage_params, microbatches, targets, *,
+                         stage_fn: Callable, loss_fn: Callable, axis: str,
+                         batch_axes):
+    """Per-device 1F1B train tick-loop (under shard_map).
+
+    Returns (loss, param_grads) with loss replicated and grads in the
+    size-1-leading-stage-axis layout shard_map expects back.
+    """
+    from k8s_tpu.parallel.collectives import ring_shift
+
+    S = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    M = microbatches.shape[0]
+    BUF = min(M, 2 * S - 1)
+    params = jax.tree.map(lambda x: jnp.squeeze(x, 0), stage_params)
+    inv_m = 1.0 / M
+
+    def tick(carry, t):
+        fwd_holding, bwd_holding, buf, gacc, loss_acc = carry
+
+        # ---- forward stream: stage s computes microbatch m_f = t - s ----
+        m_f = t - s
+        fwd_live = jnp.logical_and(m_f >= 0, m_f < M)
+        m_f_c = jnp.clip(m_f, 0, M - 1)
+        x_in = jnp.where(s == 0, microbatches[m_f_c], fwd_holding)
+        y = stage_fn(params, x_in)
+        # stash this tick's stage input for the backward re-linearization
+        buf = lax.cond(
+            fwd_live,
+            lambda b: lax.dynamic_update_index_in_dim(b, x_in, m_f_c % BUF, 0),
+            lambda b: b,
+            buf,
+        )
+
+        # ---- backward stream: stage s computes microbatch m_b ----
+        m_b = t - (2 * S - 2) + s
+        bwd_live = jnp.logical_and(m_b >= 0, m_b < M)
+        m_b_c = jnp.clip(m_b, 0, M - 1)
+        x_saved = buf[m_b_c % BUF]
+
+        def stage_loss(p, x):
+            out = stage_fn(p, x)
+            mb_loss = loss_fn(out, targets[m_b_c])
+            return out, mb_loss
+
+        (out_b, mb_loss), vjp = jax.vjp(stage_loss, params, x_saved)
+        # last stage seeds the cotangent from the loss; upstream stages use
+        # the cotangent that just arrived from the next stage
+        is_last = s == S - 1
+        d_out = jnp.where(is_last, jnp.zeros_like(out_b), bwd_holding)
+        d_loss = jnp.where(is_last, inv_m, 0.0).astype(mb_loss.dtype)
+        dparams, dx = vjp((d_out, d_loss))
+
+        live_f = fwd_live.astype(jnp.float32)
+        live_b = bwd_live.astype(jnp.float32)
+        gacc = jax.tree.map(
+            lambda g, d: g + live_b * d.astype(g.dtype), gacc, dparams)
+        loss_acc = loss_acc + live_b * jnp.where(is_last, inv_m, 0.0) * (
+            mb_loss.astype(loss_acc.dtype))
+
+        # rotate both streams: activations down-ring, cotangents up-ring
+        fwd_holding = ring_shift(y * live_f.astype(y.dtype), axis)
+        bwd_holding = ring_shift(dx * live_b.astype(dx.dtype), axis,
+                                 reverse=True)
+        return (fwd_holding, bwd_holding, buf, gacc, loss_acc), None
+
+    zero_act = jnp.zeros_like(microbatches[0])
+    buf0 = jnp.zeros((BUF,) + zero_act.shape, zero_act.dtype)
+    gacc0 = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), params)
+    carry0 = (zero_act, zero_act, buf0, gacc0, jnp.zeros((), jnp.float32))
+    (_, _, _, gacc, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(M + 2 * S - 2))
+
+    # loss lives on the last stage only -> broadcast over pp, then average
+    # the data-parallel shards of each microbatch
+    loss = lax.psum(loss_acc, axis)
+    loss = lax.pmean(loss, batch_axes)
+    # param grads: data-sharded inputs mean the local grad covers this data
+    # shard; average over the batch axes, restore stage axis for shard_map
+    gacc = jax.tree.map(lambda g: lax.pmean(g, batch_axes), gacc)
+    gacc = jax.tree.map(lambda g, p: g.astype(p.dtype)[None], gacc, stage_params)
+    return loss, gacc
+
+
+def pipeline_train_step_1f1b(mesh: Mesh, stage_fn: Callable, stage_params,
+                             batch, targets, loss_fn: Callable, *,
+                             num_microbatches: int, axis: str = "pp",
+                             batch_axes=("dp", "fsdp")):
+    """Loss + parameter gradients under the 1F1B schedule.
+
+    stage_fn(params, x) -> y: one homogeneous stage.
+    loss_fn(out_mb, target_mb) -> scalar: per-microbatch loss; the total is
+      the mean over microbatches (the decomposition 1F1B requires).
+    batch/targets: [B, ...] global, B divisible by num_microbatches.
+    Returns (loss, grads) with grads matching stage_params' stacked layout.
+    """
+    B = batch.shape[0]
+    if B % num_microbatches:
+        raise ValueError(f"batch {B} not divisible into {num_microbatches} microbatches")
+    mb = B // num_microbatches
+    axes = batch_axes if isinstance(batch_axes, (tuple, list)) else (batch_axes,)
+    data_shards = 1
+    for a in axes:
+        data_shards *= mesh.shape[a]
+    if mb % data_shards:
+        raise ValueError(
+            f"microbatch size {mb} not divisible by data shards {data_shards} "
+            f"(axes {batch_axes}); use fewer microbatches or a bigger batch")
+    micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
+    tmicro = targets.reshape((num_microbatches, mb) + targets.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+    mspec = P(None, tuple(axes))
+
+    fn = shard_map(
+        partial(_pipeline_1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn,
+                axis=axis, batch_axes=tuple(axes)),
+        mesh=mesh,
+        in_specs=(param_specs, mspec, mspec),
+        out_specs=(P(), param_specs),
+        check_vma=False,
+    )
+    return fn(stage_params, micro, tmicro)
 
 
 def stage_sharding(mesh: Mesh, stage_params, axis: str = "pp"):

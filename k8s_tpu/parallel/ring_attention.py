@@ -87,8 +87,9 @@ def ring_attention_local(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     m0 = jnp.full((B, Lq, H), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, Lq, H), jnp.float32)
 
+    from k8s_tpu.parallel.collectives import ring_shift
+
     pos_q = jnp.arange(Lq)
-    perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def step(s, carry):
         acc, m_acc, l_acc, k_cur, v_cur = carry
@@ -105,8 +106,8 @@ def ring_attention_local(q, k, v, *, axis_name: str = "sp", causal: bool = True,
         num, m_blk, l_blk, m_raw = _block_attn(q32, k_cur, v_cur, mask, scale)
         acc, m_acc, l_acc = _combine(acc, m_acc, l_acc, num, m_blk, l_blk, m_raw)
 
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = ring_shift(k_cur, axis_name)
+        v_nxt = ring_shift(v_cur, axis_name)
         return acc, m_acc, l_acc, k_nxt, v_nxt
 
     acc, m_acc, l_acc, _, _ = lax.fori_loop(0, sp, step, (acc0, m0, l0, k, v))

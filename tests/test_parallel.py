@@ -77,10 +77,10 @@ class TestSharding:
 
 
 class TestCollectives:
-    def test_psum_and_ring_shift_under_shard_map(self):
+    def test_ring_shift_under_shard_map(self):
         from functools import partial
 
-        from jax import shard_map
+        from jax import lax, shard_map
 
         mesh = make_mesh(MeshConfig(sp=8))
 
@@ -88,36 +88,96 @@ class TestCollectives:
             shard_map,
             mesh=mesh,
             in_specs=P("sp"),
-            out_specs=(P("sp"), P("sp")),
+            out_specs=(P("sp"), P("sp"), P("sp")),
             check_vma=False,
         )
         def f(x):
-            total = collectives.psum(jnp.sum(x), "sp")
-            shifted = collectives.ring_shift(x, "sp")
-            return jnp.broadcast_to(total, x.shape), shifted
+            total = lax.psum(jnp.sum(x), "sp")
+            down = collectives.ring_shift(x, "sp")
+            up = collectives.ring_shift(x, "sp", reverse=True)
+            return jnp.broadcast_to(total, x.shape), down, up
 
         x = jnp.arange(8.0)
-        total, shifted = f(x)
+        total, down, up = f(x)
         assert np.allclose(total, 28.0)
-        assert np.allclose(shifted, np.roll(np.arange(8.0), 1))
+        assert np.allclose(down, np.roll(np.arange(8.0), 1))
+        assert np.allclose(up, np.roll(np.arange(8.0), -1))
 
-    def test_reduce_scatter_matches_psum(self):
+    def test_ring_all_gather_matches_lax(self):
+        from functools import partial
+
+        from jax import lax, shard_map
+
+        mesh = make_mesh(MeshConfig(sp=8))
+
+        @partial(shard_map, mesh=mesh, in_specs=P("sp"),
+                 out_specs=(P("sp", None), P("sp", None)), check_vma=False)
+        def gather(x):
+            ours = collectives.ring_all_gather(x, "sp")
+            ref = lax.all_gather(x, "sp")
+            return ours, ref
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+        ours, ref = gather(x)
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_ring_reduce_scatter_matches_psum_scatter(self):
+        from functools import partial
+
+        from jax import lax, shard_map
+
+        mesh = make_mesh(MeshConfig(sp=8))
+
+        @partial(shard_map, mesh=mesh, in_specs=P(None, "sp"),
+                 out_specs=(P("sp"), P("sp")), check_vma=False)
+        def rs(x):
+            # x local: [n, chunk] — one chunk addressed to each rank
+            ours = collectives.ring_reduce_scatter(x, "sp")
+            ref = lax.psum_scatter(x, "sp", scatter_dimension=0,
+                                   tiled=False)
+            return ours[None], ref[None]
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 8 * 4))
+        ours, ref = rs(x)
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_collective_matmul_matches_dense(self):
         from functools import partial
 
         from jax import shard_map
 
         mesh = make_mesh(MeshConfig(sp=8))
+        x = jax.random.normal(jax.random.PRNGKey(2), (32, 12))
+        w = jax.random.normal(jax.random.PRNGKey(3), (12, 6))
 
-        @partial(shard_map, mesh=mesh, in_specs=P(None), out_specs=P("sp"),
-                 check_vma=False)
-        def rs(x):
-            # every rank contributes the same replicated vector; after
-            # reduce_scatter each rank holds sum-over-ranks of its slot
-            return collectives.reduce_scatter(x, "sp")
+        @partial(shard_map, mesh=mesh, in_specs=(P("sp"), P(None, None)),
+                 out_specs=P(None, None), check_vma=False)
+        def mm(x_shard, w):
+            return collectives.collective_matmul(x_shard, w, "sp")
 
-        x = jnp.arange(8.0)
-        out = rs(x)
-        assert np.allclose(np.asarray(out), np.arange(8.0) * 8)
+        np.testing.assert_allclose(np.asarray(mm(x, w)), np.asarray(x @ w),
+                                   atol=1e-5)
+
+    def test_collective_matmul_is_differentiable(self):
+        from functools import partial
+
+        from jax import shard_map
+
+        mesh = make_mesh(MeshConfig(sp=8))
+        x = jax.random.normal(jax.random.PRNGKey(4), (16, 8))
+        w = jax.random.normal(jax.random.PRNGKey(5), (8, 4))
+
+        @partial(shard_map, mesh=mesh, in_specs=(P("sp"), P(None, None)),
+                 out_specs=P(None, None), check_vma=False)
+        def mm(x_shard, w):
+            return collectives.collective_matmul(x_shard, w, "sp")
+
+        g_ours = jax.grad(lambda w: jnp.sum(mm(x, w) ** 2))(w)
+        g_ref = jax.grad(lambda w: jnp.sum((x @ w) ** 2))(w)
+        np.testing.assert_allclose(np.asarray(g_ours), np.asarray(g_ref),
+                                   atol=1e-4)
 
 
 class TestRingAttention:

@@ -1,5 +1,5 @@
-"""Pipeline parallelism (parallel.pipeline): GPipe schedule over the pp axis
-matches sequential stage application, forward and backward."""
+"""Pipeline parallelism (parallel.pipeline): GPipe and 1F1B schedules over
+the pp axis match sequential stage application, forward and backward."""
 
 import jax
 import jax.numpy as jnp
@@ -8,7 +8,12 @@ import pytest
 
 from k8s_tpu.parallel import MeshConfig, make_mesh
 from k8s_tpu.parallel.pipeline import (
-    pipeline_apply, stack_stage_params, stage_sharding,
+    bubble_fraction,
+    peak_activation_microbatches,
+    pipeline_apply,
+    pipeline_train_step_1f1b,
+    stack_stage_params,
+    stage_sharding,
 )
 
 
@@ -105,3 +110,99 @@ class TestPipelineBackward:
             g = jax.grad(loss)(stacked)
             stacked = jax.tree.map(lambda p, gg: p - 0.1 * gg, stacked, g)
         assert loss(stacked) < l0
+
+
+def _mse_mb(out, target):
+    return jnp.mean((out - target) ** 2)
+
+
+class TestOneFOneB:
+    """1F1B must be grad-exact vs both GPipe and the sequential model."""
+
+    @pytest.mark.parametrize("S,micro", [(2, 4), (4, 8), (2, 2), (4, 2)])
+    def test_loss_and_grads_match_gpipe(self, S, micro):
+        mesh = make_mesh(MeshConfig(pp=S, fsdp=8 // S), jax.devices())
+        stages, stacked, x = _setup(S)
+        target = jnp.sin(x)
+
+        loss_1f1b, grads_1f1b = pipeline_train_step_1f1b(
+            mesh, _mlp_stage, stacked, x, target, _mse_mb,
+            num_microbatches=micro, batch_axes=("fsdp",))
+
+        # GPipe reference: same per-microbatch loss decomposition
+        def loss_gpipe(p):
+            out = pipeline_apply(mesh, _mlp_stage, p, x,
+                                 num_microbatches=micro,
+                                 batch_axes=("fsdp",))
+            outs = out.reshape((micro, -1) + out.shape[1:])
+            tgts = target.reshape((micro, -1) + target.shape[1:])
+            return jnp.mean(jax.vmap(_mse_mb)(outs, tgts))
+
+        l_ref, g_ref = jax.value_and_grad(loss_gpipe)(stacked)
+        np.testing.assert_allclose(loss_1f1b, l_ref, atol=1e-5, rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4),
+            grads_1f1b, g_ref)
+
+    def test_grads_match_sequential(self):
+        S, micro = 2, 4
+        mesh = make_mesh(MeshConfig(pp=S, fsdp=8 // S), jax.devices())
+        stages, stacked, x = _setup(S)
+        target = jnp.sin(x)
+
+        _, grads = pipeline_train_step_1f1b(
+            mesh, _mlp_stage, stacked, x, target, _mse_mb,
+            num_microbatches=micro, batch_axes=("fsdp",))
+
+        def loss_seq(stages_list):
+            out = _sequential(stages_list, x)
+            outs = out.reshape((micro, -1) + out.shape[1:])
+            tgts = target.reshape((micro, -1) + target.shape[1:])
+            return jnp.mean(jax.vmap(_mse_mb)(outs, tgts))
+
+        g_seq = stack_stage_params(jax.grad(loss_seq)(stages))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4),
+            grads, g_seq)
+
+    def test_jit_and_training_decreases_loss(self):
+        S, micro = 4, 8
+        mesh = make_mesh(MeshConfig(pp=S, fsdp=2), jax.devices())
+        _, stacked, x = _setup(S)
+        target = jnp.sin(x)
+
+        step = jax.jit(lambda p: pipeline_train_step_1f1b(
+            mesh, _mlp_stage, p, x, target, _mse_mb,
+            num_microbatches=micro, batch_axes=("fsdp",)))
+        l0, _ = step(stacked)
+        for _ in range(5):
+            _, g = step(stacked)
+            stacked = jax.tree.map(lambda p, gg: p - 0.1 * gg, stacked, g)
+        l1, _ = step(stacked)
+        assert float(l1) < float(l0)
+
+
+class TestScheduleAccounting:
+    def test_bubble_fraction_identical_nonInterleaved(self):
+        # non-interleaved 1F1B does not reduce the bubble, it bounds memory
+        for M, S in [(8, 2), (8, 4), (32, 4), (4, 4)]:
+            assert bubble_fraction("gpipe", M, S) == bubble_fraction("1f1b", M, S)
+            assert bubble_fraction("gpipe", M, S) == pytest.approx(
+                (S - 1) / (M + S - 1))
+
+    def test_bubble_shrinks_with_more_microbatches(self):
+        assert bubble_fraction("1f1b", 32, 4) < bubble_fraction("1f1b", 8, 4)
+
+    def test_peak_activations_bounded_by_stages_not_microbatches(self):
+        # the point of 1F1B: O(S) residuals vs GPipe's O(M)
+        assert peak_activation_microbatches("gpipe", 64, 4) == 64
+        assert peak_activation_microbatches("1f1b", 64, 4) == 7  # 2S-1
+        assert peak_activation_microbatches("1f1b", 2, 4) == 2  # never > M
+        for M in (8, 64, 512):
+            assert peak_activation_microbatches("1f1b", M, 4) <= 7
+
+    def test_unknown_schedule_rejected(self):
+        with pytest.raises(ValueError):
+            bubble_fraction("interleaved", 8, 4)
+        with pytest.raises(ValueError):
+            peak_activation_microbatches("interleaved", 8, 4)
