@@ -20,16 +20,23 @@ log = logging.getLogger(__name__)
 
 
 V5E_CHIPS_PER_HOST = 4
+V5E_MAX_HOSTS = 64  # v5litepod-256 (16x16) is the largest v5e slice
 
 
 def v5e_slice_for_hosts(num_hosts: int) -> tuple[str, str]:
     """(acceleratorType, topology) for a v5e slice of ``num_hosts`` hosts
     (4 chips/host).  v5e topologies are XxY chip grids with power-of-two
     sides, so num_hosts must be a power of two (1 -> 2x2 single host,
-    4 -> 4x4, 16 -> 8x8, ...)."""
+    4 -> 4x4, 16 -> 8x8, ...), capped at the real product's 256-chip pod
+    (scale past that is multislice, not a bigger slice)."""
     if num_hosts < 1 or num_hosts & (num_hosts - 1):
         raise ValueError(
             f"v5e slices need a power-of-two host count, got {num_hosts}"
+        )
+    if num_hosts > V5E_MAX_HOSTS:
+        raise ValueError(
+            f"v5e slices top out at {V5E_MAX_HOSTS} hosts (v5litepod-256); "
+            f"got {num_hosts} — use multiple slices (multislice) instead"
         )
     chips = num_hosts * V5E_CHIPS_PER_HOST
     x = 1

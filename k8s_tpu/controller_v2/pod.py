@@ -130,23 +130,25 @@ MISSING_NODE_FRESHNESS_SECONDS = 10 * 60.0
 
 
 def _pod_failure_finished_at(pod: dict) -> float | None:
-    """Latest terminated.finishedAt across container statuses, as a POSIX
-    timestamp (None when no terminated status carries one)."""
-    latest = None
+    """terminated.finishedAt of the ``tensorflow`` container — the same
+    container whose exit code drives classification (tensorflow_exit_code
+    above); a sidecar killed at node teardown must not make a stale training
+    failure look fresh.  POSIX timestamp, or None."""
     for cs in (pod.get("status") or {}).get("containerStatuses") or []:
+        if cs.get("name") != "tensorflow":
+            continue
         term = (cs.get("state") or {}).get("terminated") or {}
         ts = term.get("finishedAt")
         if not ts:
-            continue
+            return None
         try:
             parsed = datetime.datetime.fromisoformat(str(ts).replace("Z", "+00:00"))
         except ValueError:
-            continue
+            return None
         if parsed.tzinfo is None:
             parsed = parsed.replace(tzinfo=datetime.timezone.utc)
-        stamp = parsed.timestamp()
-        latest = stamp if latest is None else max(latest, stamp)
-    return latest
+        return parsed.timestamp()
+    return None
 
 
 def pod_on_preempted_node(pod: dict, node_lister, *, now: float | None = None) -> bool:

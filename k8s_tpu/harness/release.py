@@ -31,16 +31,25 @@ from k8s_tpu.harness import build_and_push_image
 
 log = logging.getLogger(__name__)
 
-DOCKERFILE_TEMPLATE = """\
-# Operator image (reference: build/images/tf_operator/Dockerfile).
-FROM {base_image}
-COPY k8s_tpu /opt/k8s-tpu/k8s_tpu
-COPY examples /opt/k8s-tpu/examples
-ENV PYTHONPATH=/opt/k8s-tpu
-ENTRYPOINT ["python", "-m", "k8s_tpu.cmd.operator_v2"]
-"""
-
 DEFAULT_BASE_IMAGE = "python:3.11-slim"
+
+# The checked-in build context (reference keeps its Dockerfile at
+# build/images/tf_operator/Dockerfile:1; ours is a template because the base
+# image is substituted at build time).
+DOCKERFILE_TEMPLATE_RELPATH = os.path.join(
+    "build", "images", "tf_operator", "Dockerfile.template"
+)
+
+
+def dockerfile_template_path(repo_dir: str) -> str:
+    path = os.path.join(repo_dir, DOCKERFILE_TEMPLATE_RELPATH)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"missing checked-in Dockerfile template at {path} "
+            "(build/images/tf_operator/ is part of the repo, like the "
+            "reference's build/images/tf_operator/Dockerfile)"
+        )
+    return path
 
 
 def update_values(values_file: str, image: str) -> None:
@@ -84,11 +93,13 @@ def build_operator_image(
             shutil.copytree(
                 src, dst, ignore=shutil.ignore_patterns("__pycache__", "*.pyc", "*.so")
             )
-    template = os.path.join(context_dir, "Dockerfile.template")
-    with open(template, "w") as f:
-        f.write(DOCKERFILE_TEMPLATE)
+    # every COPY source the Dockerfile names must be in the context
+    shutil.copy2(
+        os.path.join(repo_dir, "ci_config.yaml"),
+        os.path.join(context_dir, "ci_config.yaml"),
+    )
     ref = build_and_push_image.build_and_push(
-        template,
+        dockerfile_template_path(repo_dir),
         context_dir,
         image=f"{registry}/tf-job-operator",
         repo_dir=repo_dir,

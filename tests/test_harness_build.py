@@ -78,6 +78,33 @@ class TestRelease:
         assert os.path.exists(tmp_path / "image-context" / "k8s_tpu" / "version.py")
         assert os.path.exists(tmp_path / "image-context" / "Dockerfile")
 
+    def test_image_context_is_docker_acceptable(self, tmp_path, monkeypatch):
+        """The rendered context must stand alone: the Dockerfile comes from
+        the checked-in build/images/tf_operator/ template (reference commits
+        build/images/tf_operator/Dockerfile:1), every COPY source exists in
+        the context, the base image was substituted, and the e2e entrypoint
+        is baked in (Dockerfile:18 parity: image carries the e2e binary)."""
+        monkeypatch.setattr(build_and_push_image, "docker_available", lambda: False)
+        result = release.build_operator_image(REPO, "k8s-tpu", str(tmp_path))
+        ctx = result["context_dir"]
+        dockerfile = os.path.join(ctx, "Dockerfile")
+        text = open(dockerfile).read()
+        # template came from the committed file, not an inline string
+        committed = open(release.dockerfile_template_path(REPO)).read()
+        assert text == committed.replace("{base_image}", release.DEFAULT_BASE_IMAGE)
+        assert "{base_image}" not in text
+        assert text.startswith("#") or text.startswith("FROM") or "FROM" in text
+        # every COPY source resolves inside the context
+        copies = [line.split()[1] for line in text.splitlines()
+                  if line.startswith("COPY ")]
+        assert copies, "no COPY lines found"
+        for src in copies:
+            assert os.path.exists(os.path.join(ctx, src)), f"COPY source {src} missing"
+        # e2e binary baked into the image (module form)
+        assert os.path.exists(os.path.join(ctx, "k8s_tpu", "e2e", "main.py"))
+        # the operator entrypoint is the v2 binary
+        assert '"-m", "k8s_tpu.cmd.operator_v2"' in text.replace("', '", '", "')
+
 
 class TestPyChecks:
     def test_lint_clean_tree(self, tmp_path):
@@ -218,6 +245,11 @@ class TestGenjob:
             genjob.v5e_slice_for_hosts(3)
         with pytest.raises(ValueError):
             genjob.v5e_slice_for_hosts(0)
+
+    def test_tpu_hosts_beyond_largest_slice_rejected(self):
+        assert genjob.v5e_slice_for_hosts(64) == ("v5litepod-256", "16x16")
+        with pytest.raises(ValueError, match="multislice"):
+            genjob.v5e_slice_for_hosts(128)
 
     def test_unique_names_and_scheduler(self):
         jobs = genjob.generate(3, scheduler_name="kube-batch", timestamp=9)

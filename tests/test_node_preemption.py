@@ -70,6 +70,25 @@ class TestNodeSignals:
                        finished_at=_iso(stale))
         assert not pod_mod.pod_on_preempted_node(pod, EmptyLister())
 
+    def test_sidecar_freshness_does_not_mask_stale_failure(self):
+        """Freshness must come from the tensorflow container (the one whose
+        exit code drives classification), not a sidecar killed at node
+        teardown."""
+
+        class EmptyLister:
+            def get(self, ns, name):
+                return None
+
+        stale = time.time() - 2 * pod_mod.MISSING_NODE_FRESHNESS_SECONDS
+        pod = make_pod("tpu", 0, "Failed", exit_code=1, node_name="gone",
+                       finished_at=_iso(stale))
+        pod["status"]["containerStatuses"].append({
+            "name": "istio-proxy",
+            "state": {"terminated": {"exitCode": 137,
+                                     "finishedAt": _iso(time.time() - 5)}},
+        })
+        assert not pod_mod.pod_on_preempted_node(pod, EmptyLister())
+
     def test_vanished_node_without_timestamp_is_not_preemption(self):
         """No finishedAt -> cannot establish the deletion caused the
         failure; keep the exit-code classification.  (A kubelet-vanished pod
