@@ -138,17 +138,81 @@ async function refresh() {
 
 /* ---------------- detail view (JobDetail.js / PodList.js) --------------- */
 
+/* InfoEntry.js: one labeled row */
+const infoRow = (label, value) =>
+  `<tr><th style="width:220px">${esc(label)}</th><td>${value}</td></tr>`;
+
+/* JobSummary.js/InfoEntry.js: identity + timing rows */
+function renderInfo(job) {
+  const m = job.metadata || {};
+  const st = job.status || {};
+  const tpu = (job.spec || {}).tpu;
+  const rows = [
+    infoRow("Name", esc(m.name)),
+    infoRow("Namespace", esc(m.namespace)),
+    infoRow("State", `<span class="state ${esc(jobState(job))}">${esc(jobState(job))}</span>`),
+    infoRow("Created", esc(m.creationTimestamp || "—")),
+    infoRow("Started", esc(st.startTime || "—")),
+    infoRow("Completed", esc(st.completionTime || "—")),
+    infoRow("Last reconcile", esc(st.lastReconcileTime || "—")),
+  ];
+  if (m.uid) rows.push(infoRow("UID", esc(m.uid)));
+  if (tpu)
+    rows.push(infoRow("TPU slice",
+      esc(`${tpu.acceleratorType || ""} ${tpu.topology || ""}` +
+          (tpu.numSlices > 1 ? ` ×${tpu.numSlices} slices` : ""))));
+  return rows.join("");
+}
+
+/* JobDetail.js conditions table: the status engine's full condition list
+ * (type/status/reason/message/lastTransitionTime), newest last */
+function renderConditions(job) {
+  const conds = ((job.status || {}).conditions || []);
+  return conds.map((c) => `<tr>
+      <td><span class="state ${esc(c.type)}">${esc(c.type)}</span></td>
+      <td>${esc(c.status)}</td>
+      <td>${esc(c.reason || "")}</td>
+      <td>${esc(c.message || "")}</td>
+      <td class="muted">${esc(c.lastTransitionTime || c.lastUpdateTime || "")}</td>
+    </tr>`).join("")
+    || `<tr><td colspan="5" class="muted">no conditions</td></tr>`;
+}
+
+/* ReplicaSpec.js drill-down: desired vs active/succeeded/failed per type */
+function renderReplicaStatuses(job) {
+  const spec = (job.spec || {}).tfReplicaSpecs || {};
+  const statuses = (job.status || {}).tfReplicaStatuses || {};
+  const types = [...new Set([...Object.keys(spec), ...Object.keys(statuses)])];
+  return types.map((t) => {
+    const s = statuses[t] || {};
+    const rs = spec[t] || {};
+    return `<tr><td>${esc(t)}</td>
+      <td>${esc(rs.replicas ?? "—")}</td>
+      <td>${esc(s.active || 0)}</td>
+      <td class="${s.succeeded ? "" : "muted"}">${esc(s.succeeded || 0)}</td>
+      <td class="${s.failed ? "" : "muted"}">${esc(s.failed || 0)}</td>
+      <td class="muted">${esc(rs.restartPolicy || "")}</td></tr>`;
+  }).join("") || `<tr><td colspan="6" class="muted">no replica specs</td></tr>`;
+}
+
+/* PodList.js: replica labels + container exit codes alongside phase/logs */
+function podExit(p) {
+  const cs = ((p.status || {}).containerStatuses || [])
+    .find((c) => c.name === "tensorflow");
+  const term = ((cs || {}).state || {}).terminated ||
+               ((cs || {}).lastState || {}).terminated;
+  return term && term.exitCode !== undefined ? String(term.exitCode) : "";
+}
+
 async function showDetail(ns, name) {
   const data = await api(`/tfjob/${ns}/${name}`);
   const job = data.tfJob || {};
   document.getElementById("d-name").textContent = `${ns}/${name}`;
-  const tpu = (job.spec || {}).tpu;
-  document.getElementById("d-summary").innerHTML = [
-    `<span class="state ${jobState(job)}">${jobState(job)}</span>`,
-    replicaSummary(job),
-    tpu ? `${tpu.acceleratorType || ""} ${tpu.topology || ""}${
-      tpu.numSlices > 1 ? ` ×${tpu.numSlices} slices` : ""}` : "",
-  ].filter(Boolean).join(" &nbsp; ");
+  document.getElementById("d-summary").innerHTML =
+    `<span class="state ${esc(jobState(job))}">${esc(jobState(job))}</span> &nbsp; ${esc(replicaSummary(job))}`;
+  document.getElementById("d-info").innerHTML = renderInfo(job);
+  document.getElementById("d-conditions").innerHTML = renderConditions(job);
+  document.getElementById("d-replica-status").innerHTML = renderReplicaStatuses(job);
   document.getElementById("d-status").textContent =
     JSON.stringify(job.status || {}, null, 2);
   document.getElementById("d-spec").textContent =
@@ -156,11 +220,16 @@ async function showDetail(ns, name) {
   document.getElementById("d-pods").innerHTML = (data.pods || [])
     .map((p) => {
       const phase = (p.status || {}).phase || "Pending";
+      const labels = (p.metadata || {}).labels || {};
+      const replica = [labels["tf-replica-type"], labels["tf-replica-index"]]
+        .filter((x) => x !== undefined).join("-");
       return `<tr><td>${esc(p.metadata.name)}</td>
+        <td class="muted">${esc(replica)}</td>
         <td><span class="state ${esc(phase)}">${esc(phase)}</span></td>
+        <td class="muted">${esc(podExit(p))}</td>
         <td><a onclick="showLogs('${esc(ns)}','${esc(p.metadata.name)}')">logs</a></td></tr>`;
     })
-    .join("") || `<tr><td colspan="3" class="muted">no pods</td></tr>`;
+    .join("") || `<tr><td colspan="5" class="muted">no pods</td></tr>`;
   document.getElementById("d-logs").style.display = "none";
   show("detail");
 }

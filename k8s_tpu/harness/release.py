@@ -91,7 +91,12 @@ def build_operator_image(
             if os.path.exists(dst):
                 shutil.rmtree(dst)
             shutil.copytree(
-                src, dst, ignore=shutil.ignore_patterns("__pycache__", "*.pyc", "*.so")
+                src, dst,
+                # _build holds host-arch artifacts (runtime .so, stress/TSan
+                # binaries) that must never be baked into the image
+                ignore=shutil.ignore_patterns(
+                    "__pycache__", "*.pyc", "*.so", "_build", "*.tmp"
+                ),
             )
     # every COPY source the Dockerfile names must be in the context
     shutil.copy2(

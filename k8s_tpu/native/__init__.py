@@ -52,6 +52,37 @@ def build(force: bool = False) -> str | None:
     return _LIB
 
 
+_STRESS_SRC = os.path.join(_DIR, "src", "stress_main.cc")
+
+
+def build_stress_binary(tsan: bool = False) -> str | None:
+    """Compile the C++ concurrency stress harness (src/stress_main.cc, which
+    includes runtime.cc) into a standalone binary; with ``tsan`` it is built
+    under -fsanitize=thread — the `go test -race` analogue for the native
+    runtime.  Returns the binary path or None when the toolchain (or libtsan)
+    is missing."""
+    gxx = shutil.which("g++")
+    if gxx is None:
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    out = os.path.join(_BUILD_DIR, "stress_tsan" if tsan else "stress")
+    sources_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_STRESS_SRC))
+    if os.path.exists(out) and os.path.getmtime(out) >= sources_mtime:
+        return out
+    cmd = [gxx, "-O1", "-g", "-std=c++17", "-pthread",
+           "-I", os.path.dirname(_SRC), _STRESS_SRC, "-o", out + ".tmp"]
+    if tsan:
+        cmd.insert(1, "-fsanitize=thread")
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        log.warning("stress binary build failed (%s): %s",
+                    "tsan" if tsan else "plain", e.stderr[-500:])
+        return None
+    os.replace(out + ".tmp", out)
+    return out
+
+
 def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     c = ctypes.c_char_p
     lib.rlq_new.restype = ctypes.c_void_p

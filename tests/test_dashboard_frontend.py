@@ -1,0 +1,139 @@
+"""Frontend smoke tier (reference: dashboard/frontend/src/components/
+App.test.js — a render-without-crashing smoke over the React tree).
+
+No node/jest in this image, so the smoke is structural: the SPA's DOM
+contract against index.html, its API calls against the backend's real
+routes, and the detail drill-down's field names against what the status
+engine actually writes (JobDetail.js/JobSummary.js/InfoEntry.js parity).
+Served-asset checks run against a live backend over HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.fake import FakeCluster
+from k8s_tpu.dashboard import backend as dashboard_backend
+
+FRONTEND = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "k8s_tpu", "dashboard", "frontend",
+)
+
+
+@pytest.fixture(scope="module")
+def app_js():
+    with open(os.path.join(FRONTEND, "app.js")) as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def index_html():
+    with open(os.path.join(FRONTEND, "index.html")) as f:
+        return f.read()
+
+
+class TestSpaDomContract:
+    def test_every_dom_id_the_spa_touches_exists(self, app_js, index_html):
+        """Renaming an element in index.html must not silently break app.js
+        (the class of regression the API-level tests cannot see)."""
+        used = set(re.findall(r"getElementById\(\s*[\"']([\w-]+)[\"']\s*\)", app_js))
+        assert used, "no getElementById calls found — parser broken?"
+        defined = set(re.findall(r"id=\"([\w-]+)\"", index_html))
+        missing = used - defined
+        assert not missing, f"app.js touches ids missing from index.html: {missing}"
+
+    def test_detail_drilldown_sections_exist(self, index_html):
+        # JobDetail.js parity: info entries, conditions, replica statuses
+        for el in ("d-info", "d-conditions", "d-replica-status", "d-pods"):
+            assert f'id="{el}"' in index_html, el
+
+    def test_braces_balanced(self, app_js):
+        # crude parse smoke: catches truncation/merge damage without node
+        for open_c, close_c in ("{}", "()", "[]"):
+            assert app_js.count(open_c) == app_js.count(close_c), open_c
+
+    def test_interpolations_into_html_are_escaped(self, app_js):
+        """Every ${...} inside an innerHTML template that carries
+        user-controlled object fields must route through esc()."""
+        # spot-check the known user-controlled fields
+        for field in ("m.name", "m.namespace", "p.metadata.name", "c.message"):
+            pattern = re.compile(r"\$\{" + re.escape(field) + r"\}")
+            assert not pattern.search(app_js), (
+                f"unescaped interpolation of {field}; wrap in esc()")
+
+
+class TestSpaApiContract:
+    def test_spa_routes_exist_on_backend(self, app_js):
+        """Every /tfjobs/api path the SPA fetches must match a backend
+        route regex (api_handler.go:74-113 route table parity)."""
+        backend_src = open(dashboard_backend.__file__).read()
+        spa_paths = set(re.findall(r"api\(`/([\w]+)", app_js))
+        spa_paths |= {p.split("/")[0] for p in
+                      re.findall(r"/tfjobs/api/([\w]+)", app_js)}
+        for p in spa_paths:
+            assert f"/tfjobs/api/{p}" in backend_src, f"SPA calls unknown route {p}"
+
+    def test_detail_reads_fields_the_status_engine_writes(self, app_js):
+        # drill-down renders the real wire field names
+        for field in ("conditions", "tfReplicaStatuses", "lastTransitionTime",
+                      "startTime", "completionTime", "containerStatuses",
+                      "tf-replica-type", "tf-replica-index"):
+            assert field in app_js, f"detail view never reads {field}"
+
+
+class TestServedAssets:
+    @pytest.fixture()
+    def server(self):
+        cluster = FakeCluster()
+        clientset = Clientset(cluster)
+        # seed a job whose status exercises every drill-down section
+        clientset.tfjobs_unstructured("default", "kubeflow.org/v1alpha2").create({
+            "apiVersion": "kubeflow.org/v1alpha2",
+            "kind": "TFJob",
+            "metadata": {"name": "seeded", "namespace": "default"},
+            "spec": {"tfReplicaSpecs": {"Worker": {
+                "replicas": 2, "restartPolicy": "ExitCode",
+                "template": {"spec": {"containers": [
+                    {"name": "tensorflow", "image": "x"}]}}}}},
+            "status": {
+                "conditions": [
+                    {"type": "Created", "status": "True", "reason": "Seeded",
+                     "message": "m", "lastTransitionTime": "2026-01-01T00:00:00Z"},
+                    {"type": "Running", "status": "True", "reason": "R",
+                     "message": "", "lastTransitionTime": "2026-01-01T00:01:00Z"},
+                ],
+                "tfReplicaStatuses": {"Worker": {"active": 2}},
+                "startTime": "2026-01-01T00:01:00Z",
+            },
+        })
+        srv = dashboard_backend.DashboardServer(clientset, host="127.0.0.1", port=0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield srv
+        srv.shutdown()
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}") as r:
+            return r.status, r.read().decode()
+
+    def test_spa_assets_served(self, server):
+        status, html = self._get(server, "/tfjobs/ui/")
+        assert status == 200 and "app.js" in html
+        status, js = self._get(server, "/tfjobs/ui/app.js")
+        assert status == 200 and "showDetail" in js
+
+    def test_detail_api_feeds_drilldown(self, server):
+        status, body = self._get(server, "/tfjobs/api/tfjob/default/seeded")
+        assert status == 200
+        job = json.loads(body)["tfJob"]
+        assert job["status"]["tfReplicaStatuses"]["Worker"]["active"] == 2
+        assert [c["type"] for c in job["status"]["conditions"]] == [
+            "Created", "Running"]

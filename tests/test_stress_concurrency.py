@@ -1,0 +1,275 @@
+"""Concurrency stress tier — the `go test -race` analogue (SURVEY.md §5).
+
+The reference leaned on Go's race detector plus client-go's guarantee that a
+workqueue key is never processed by two workers (pkg/controller/
+controller.go:77-95).  This tier hammers the load-bearing concurrent
+machinery from many threads and checks the invariants directly:
+
+- workqueue (Python and native): exclusive per-key processing, eventual
+  processing of every produced key, clean drain + shutdown;
+- expectations (Python and native): balanced expect/observe from racing
+  threads always ends satisfied;
+- informer/reflector: a write-storm against the backend converges the
+  informer store to the backend's final state;
+- the native runtime additionally runs under ThreadSanitizer
+  (-fsanitize=thread) via the standalone C++ harness
+  (k8s_tpu/native/src/stress_main.cc).
+
+Wired as the ``stress`` tier in ci_config.yaml.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import threading
+import time
+
+import pytest
+
+from k8s_tpu import native
+from k8s_tpu.client.fake import FakeCluster
+from k8s_tpu.client.gvr import PODS
+from k8s_tpu.client.informer import SharedInformerFactory, meta_namespace_key
+from k8s_tpu.controller_v2 import expectations as exp_mod
+from k8s_tpu.util import workqueue as wq_mod
+
+KEYS = [f"ns/job-{i}" for i in range(16)]
+
+
+def _make_queue(impl):
+    if impl == "python":
+        return wq_mod.RateLimitingQueue(
+            wq_mod.MaxOfRateLimiter(
+                wq_mod.ItemExponentialFailureRateLimiter(0.0005, 0.05),
+                wq_mod.BucketRateLimiter(qps=1e6, burst=10**6),
+            )
+        )
+    from k8s_tpu.native.runtime import NativeRateLimitingQueue
+
+    return NativeRateLimitingQueue(
+        base_delay=0.0005, max_delay=0.05, qps=1e6, burst=10**6)
+
+
+def _make_expectations(impl):
+    if impl == "python":
+        return exp_mod.ControllerExpectations()
+    from k8s_tpu.native.runtime import NativeControllerExpectations
+
+    return NativeControllerExpectations()
+
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native runtime unavailable")
+
+IMPLS = [
+    pytest.param("python", id="python"),
+    pytest.param("native", id="native", marks=needs_native),
+]
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+class TestWorkqueueStress:
+    def test_exclusive_processing_under_storm(self, impl):
+        q = _make_queue(impl)
+        in_flight = {k: 0 for k in KEYS}
+        processed = {k: 0 for k in KEYS}
+        violations: list[str] = []
+        guard = threading.Lock()
+
+        def producer(seed):
+            rng = random.Random(seed)
+            for _ in range(300):
+                k = rng.choice(KEYS)
+                op = rng.randrange(3)
+                if op == 0:
+                    q.add(k)
+                elif op == 1:
+                    q.add_rate_limited(k)
+                else:
+                    q.add_after(k, rng.random() * 0.002)
+                if rng.randrange(7) == 0:
+                    q.forget(k)
+                if rng.randrange(50) == 0:
+                    time.sleep(0.0001)
+
+        def worker():
+            rng = random.Random(threading.get_ident())
+            while True:
+                item, shutdown = q.get(timeout=0.2)
+                if shutdown:
+                    return
+                if item is None:
+                    continue
+                with guard:
+                    in_flight[item] += 1
+                    if in_flight[item] != 1:
+                        violations.append(item)
+                if rng.randrange(4) == 0:
+                    time.sleep(rng.random() * 0.0003)
+                with guard:
+                    in_flight[item] -= 1
+                    processed[item] += 1
+                q.done(item)
+
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(6)]
+        for w in workers:
+            w.start()
+        producers = [threading.Thread(target=producer, args=(i,), daemon=True)
+                     for i in range(4)]
+        for p in producers:
+            p.start()
+        for p in producers:
+            p.join(30)
+            assert not p.is_alive(), "producer wedged"
+
+        # drain: the delay heap (max 50ms backoff) must flush through
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with guard:
+                busy = any(in_flight.values())
+            if len(q) == 0 and not busy:
+                # two consecutive quiet observations ride out heap items
+                time.sleep(0.1)
+                if len(q) == 0:
+                    break
+        q.shut_down()
+        for w in workers:
+            w.join(10)
+            assert not w.is_alive(), "worker failed to shut down"
+
+        assert violations == [], f"concurrent processing of {set(violations)}"
+        with guard:
+            missing = [k for k in KEYS if processed[k] == 0]
+        assert not missing, f"keys never processed: {missing}"
+        assert len(q) == 0
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+class TestExpectationsStress:
+    def test_balanced_expect_observe_ends_satisfied(self, impl):
+        exp = _make_expectations(impl)
+
+        def hammer(seed):
+            rng = random.Random(seed)
+            for _ in range(300):
+                key = rng.choice(KEYS)
+                n = 1 + rng.randrange(4)
+                exp.expect_creations(key, n)
+                for _ in range(n):
+                    exp.creation_observed(key)
+                d = 1 + rng.randrange(3)
+                exp.expect_deletions(key, d)
+                for _ in range(d):
+                    exp.deletion_observed(key)
+                exp.satisfied(key)  # racing readers
+
+        threads = [threading.Thread(target=hammer, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+            assert not t.is_alive(), "hammer thread wedged"
+
+        unsatisfied = [k for k in KEYS if not exp.satisfied(k)]
+        assert not unsatisfied, f"balanced expectations stuck: {unsatisfied}"
+
+
+class TestInformerStress:
+    def test_store_converges_under_write_storm(self):
+        cluster = FakeCluster()
+        factory = SharedInformerFactory(cluster, resync_period=0.05)
+        informer = factory.informer_for(PODS)
+        handler_errors: list[Exception] = []
+        adds = []
+        deletes = []
+        lock = threading.Lock()
+
+        def on_add(obj):
+            with lock:
+                adds.append(meta_namespace_key(obj))
+
+        def on_delete(obj):
+            with lock:
+                deletes.append(meta_namespace_key(obj))
+
+        informer.add_event_handler(on_add=on_add, on_delete=on_delete)
+        factory.start()
+        assert factory.wait_for_cache_sync(10)
+
+        def writer(seed):
+            rng = random.Random(seed)
+            try:
+                for _ in range(200):
+                    name = f"pod-{rng.randrange(24)}"
+                    op = rng.randrange(3)
+                    try:
+                        if op == 0:
+                            cluster.create(PODS, "default", {
+                                "metadata": {"name": name,
+                                             "namespace": "default"}})
+                        elif op == 1:
+                            pod = cluster.get(PODS, "default", name)
+                            pod.setdefault("labels", {})
+                            pod["metadata"].setdefault("labels", {})[
+                                "touch"] = str(rng.random())
+                            cluster.update(PODS, "default", pod)
+                        else:
+                            cluster.delete(PODS, "default", name)
+                    except Exception as e:  # noqa: BLE001
+                        # not-found / already-exists races between writers
+                        # are expected; anything else is a real failure
+                        from k8s_tpu.client import errors as err_mod
+
+                        if not isinstance(e, err_mod.ApiError):
+                            raise
+            except Exception as e:  # noqa: BLE001
+                handler_errors.append(e)
+
+        writers = [threading.Thread(target=writer, args=(i,), daemon=True)
+                   for i in range(4)]
+        for w in writers:
+            w.start()
+        for w in writers:
+            w.join(60)
+            assert not w.is_alive(), "writer wedged"
+        assert not handler_errors, handler_errors
+
+        # convergence: informer store must reach the backend's final state
+        final = {meta_namespace_key(o) for o in cluster.list(PODS)}
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if set(informer.store.keys()) == final:
+                break
+            time.sleep(0.05)
+        assert set(informer.store.keys()) == final
+        factory.stop()
+
+
+class TestNativeSanitized:
+    """Run the C++ stress harness, plain and under ThreadSanitizer."""
+
+    @needs_native
+    def test_stress_binary_passes(self):
+        path = native.build_stress_binary(tsan=False)
+        assert path, "stress binary failed to build"
+        out = subprocess.run([path], capture_output=True, text=True,
+                             timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "PASS" in out.stdout
+
+    @needs_native
+    def test_stress_binary_passes_under_tsan(self):
+        path = native.build_stress_binary(tsan=True)
+        if path is None:
+            pytest.skip("libtsan not available")
+        env = dict(os.environ, TSAN_OPTIONS="halt_on_error=1")
+        out = subprocess.run([path], capture_output=True, text=True,
+                             timeout=300, env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "ThreadSanitizer" not in out.stdout + out.stderr, (
+            out.stdout + out.stderr)
+        assert "PASS" in out.stdout
