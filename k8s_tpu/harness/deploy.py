@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import time
 
 import yaml
 
@@ -141,8 +142,15 @@ def setup_local(version: str = "v1alpha1", enable_gang_scheduling: bool = False)
     return cluster
 
 
-def write_manifests(output_dir: str, image: str, namespace: str, version: str) -> list[str]:
-    """Render CRDs + operator manifests to files kubectl can apply."""
+def write_manifests(output_dir: str, image: str, namespace: str, version: str,
+                    test_app_dir: str | None = None) -> list[str]:
+    """Render CRDs + operator manifests to files kubectl can apply.
+
+    With ``test_app_dir``, the operator objects come from the checked-in
+    declarative app (test/test-app/components/core.yaml rendered by
+    harness.workflows — the reference's ksonnet-app deploy path,
+    py/deploy.py:49-88); otherwise from :func:`operator_manifests`.
+    """
     os.makedirs(output_dir, exist_ok=True)
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     # Both CRD files define the same object name (tfjobs.kubeflow.org), so
@@ -152,16 +160,26 @@ def write_manifests(output_dir: str, image: str, namespace: str, version: str) -
     src = os.path.join(repo, "examples", "crd", crd)
     if os.path.exists(src):
         paths.append(src)
+    if test_app_dir:
+        from k8s_tpu.harness import workflows
+
+        objects = workflows.render_component(
+            test_app_dir, "core",
+            {"image": image, "namespace": namespace, "tfjob_version": version},
+        )
+    else:
+        objects = operator_manifests(image, namespace, version)
     operator_path = os.path.join(output_dir, "tf-job-operator.yaml")
     with open(operator_path, "w") as f:
-        yaml.safe_dump_all(operator_manifests(image, namespace, version), f)
+        yaml.safe_dump_all(objects, f)
     paths.append(operator_path)
     return paths
 
 
-def setup_kubectl(image: str, namespace: str, version: str, output_dir: str) -> None:
+def setup_kubectl(image: str, namespace: str, version: str, output_dir: str,
+                  test_app_dir: str | None = None) -> None:
     """kubectl-apply the operator onto a live cluster (deploy.py:91-186)."""
-    for path in write_manifests(output_dir, image, namespace, version):
+    for path in write_manifests(output_dir, image, namespace, version, test_app_dir):
         harness_util.run(["kubectl", "apply", "-f", path])
 
 
@@ -180,14 +198,39 @@ def main(argv=None) -> int:
     setup_p.add_argument("--namespace", default=DEFAULT_NAMESPACE)
     setup_p.add_argument("--version", default="v1alpha2")
     setup_p.add_argument("--output_dir", default="/tmp/k8s-tpu-deploy")
+    setup_p.add_argument(
+        "--test_app_dir", default=None,
+        help="Deploy the operator from this declarative app dir "
+        "(test/test-app) instead of the built-in manifests.",
+    )
     down_p = sub.add_parser("teardown")
     down_p.add_argument("--namespace", default=DEFAULT_NAMESPACE)
+    for p in (setup_p, down_p):
+        p.add_argument(
+            "--junit_path", default=None,
+            help="Write a junit TestCase for this step (reference "
+            "py/deploy.py setup --junit_path contract).",
+        )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    if args.command == "setup":
-        setup_kubectl(args.image, args.namespace, args.version, args.output_dir)
-    else:
-        teardown_kubectl(args.namespace)
+
+    from k8s_tpu.harness import junit as junit_lib
+
+    t = junit_lib.TestCase(class_name="deploy", name=args.command)
+    start = time.time()
+    try:
+        if args.command == "setup":
+            setup_kubectl(args.image, args.namespace, args.version,
+                          args.output_dir, args.test_app_dir)
+        else:
+            teardown_kubectl(args.namespace)
+    except Exception as e:  # noqa: BLE001 - report the failure via junit too
+        t.failure = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        t.time = time.time() - start
+        if args.junit_path:
+            junit_lib.create_junit_xml_file([t], args.junit_path)
     return 0
 
 

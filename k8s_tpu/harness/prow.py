@@ -159,9 +159,46 @@ def finalize_prow_job(store, junit_files: list[str]) -> bool:
     return no_errors
 
 
+def create_pr_symlink(store) -> str:
+    """The Argo `create-pr-symlink` step (reference workflow:
+    test/workflows/components/workflows.libsonnet:307-314 invoking
+    prow_artifacts create_pr_symlink): for PR jobs, write the
+    pr-logs/directory pointer at the job's output dir."""
+    pull_number = os.getenv("PULL_NUMBER")
+    symlink = get_symlink_output(
+        pull_number, os.getenv("JOB_NAME", ""), os.getenv("BUILD_NUMBER", "")
+    )
+    if not symlink:
+        log.info("not a PR job (no PULL_NUMBER); skipping symlink")
+        return ""
+    return create_symlink(store, symlink, get_output_dir())
+
+
+def copy_artifacts(store, artifacts_dir: str) -> int:
+    """The Argo `copy-artifacts` step (workflows.libsonnet:333-341):
+    upload everything under ``artifacts_dir`` to the job's output dir,
+    preserving relative paths.  Returns the file count."""
+    output_dir = get_output_dir()
+    bucket, base = split_uri(output_dir)
+    count = 0
+    for root, _, files in os.walk(artifacts_dir):
+        for fname in files:
+            local = os.path.join(root, fname)
+            rel = os.path.relpath(local, artifacts_dir)
+            store.upload_from_filename(bucket, os.path.join(base, rel), local)
+            count += 1
+    log.info("copied %d artifact files to %s", count, output_dir)
+    return count
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     parser = argparse.ArgumentParser(description="Steps related to prow.")
+    parser.add_argument(
+        "--artifacts_root",
+        default=os.getenv("ARTIFACTS_ROOT", "/tmp/k8s_tpu_artifacts"),
+        help="Local artifact store root.",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
     fin = sub.add_parser("finalize_job", help="Finalize the prow job.")
     fin.add_argument(
@@ -169,13 +206,17 @@ def main(argv=None) -> int:
         default="",
         help="Comma separated list of expected junit file names.",
     )
-    fin.add_argument(
-        "--artifacts_root",
-        default=os.getenv("ARTIFACTS_ROOT", "/tmp/k8s_tpu_artifacts"),
-        help="Local artifact store root.",
-    )
+    sub.add_parser("create_pr_symlink", help="Write the PR directory pointer.")
+    copy = sub.add_parser("copy_artifacts", help="Upload the artifacts dir.")
+    copy.add_argument("--artifacts_dir", required=True)
     args = parser.parse_args(argv)
     store = LocalArtifactStore(args.artifacts_root)
+    if args.command == "create_pr_symlink":
+        create_pr_symlink(store)
+        return 0
+    if args.command == "copy_artifacts":
+        copy_artifacts(store, args.artifacts_dir)
+        return 0
     ok = finalize_prow_job(store, [f for f in args.junit_files.split(",") if f])
     return 0 if ok else 1
 

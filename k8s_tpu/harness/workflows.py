@@ -1,0 +1,279 @@
+"""Declarative workflow/component app dirs — the ksonnet analogue.
+
+The reference declares its CI workflows and deployable test app as ksonnet
+component trees (test/workflows/components/workflows.libsonnet:139-344,
+test/test-app/components/core.jsonnet:1-5), rendered with ``ks param set`` +
+``ks show``/``ks apply``.  Here an *app dir* is plain YAML:
+
+    <app_dir>/params.yaml              # per-component default params
+    <app_dir>/components/<name>.yaml   # template(s) with ${param} holes
+
+``render_component`` substitutes params (defaults overridden by ``--params
+k=v,...`` — the `ks param set` model) and returns the parsed documents.
+Substitution is strict both ways: a ``${hole}`` with no param and an
+override naming no declared param are errors, so manifests and params.yaml
+cannot drift apart silently.
+
+CLI (mirrors the reference's test_runner/ks usage, py/test_runner.py:239-276):
+
+    python -m k8s_tpu.harness.workflows render --app_dir test/workflows \\
+        --component e2e --params name=pr-123,version_tag=abc
+    python -m k8s_tpu.harness.workflows run --app_dir test/workflows \\
+        --component simple_tfjob --params name=smoke,namespace=default
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import re
+import sys
+
+import yaml
+
+log = logging.getLogger(__name__)
+
+_HOLE_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+class ComponentError(Exception):
+    """Bad app dir / component / params."""
+
+
+def load_params(app_dir: str, component: str) -> dict:
+    """Default params for ``component`` from <app_dir>/params.yaml."""
+    path = os.path.join(app_dir, "params.yaml")
+    try:
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+    except OSError as e:
+        raise ComponentError(f"no params.yaml in app dir {app_dir}: {e}") from e
+    components = cfg.get("components") or {}
+    if component not in components:
+        raise ComponentError(
+            f"component {component!r} not declared in {path} "
+            f"(have: {sorted(components)})"
+        )
+    return dict(components[component] or {})
+
+
+def parse_params(spec: str) -> dict:
+    """``"k=v,k2=v2"`` → dict (the reference test_runner --params format,
+    py/test_runner.py:388-396)."""
+    out = {}
+    for piece in (spec or "").split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        if "=" not in piece:
+            raise ComponentError(f"bad --params piece {piece!r} (want k=v)")
+        k, v = piece.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _substitute(text: str, params: dict) -> str:
+    def repl(m: re.Match) -> str:
+        key = m.group(1)
+        if key not in params:
+            raise ComponentError(
+                f"template hole ${{{key}}} has no parameter (declared: "
+                f"{sorted(params)})"
+            )
+        v = params[key]
+        return v if isinstance(v, str) else json.dumps(v)
+
+    return _HOLE_RE.sub(repl, text)
+
+
+def render_component(
+    app_dir: str, component: str, overrides: dict | None = None
+) -> list[dict]:
+    """Render one component to its list of YAML documents."""
+    params = load_params(app_dir, component)
+    for key in overrides or {}:
+        if key not in params:
+            raise ComponentError(
+                f"override {key!r} names no declared param of {component!r} "
+                f"(declared: {sorted(params)})"
+            )
+    params.update(overrides or {})
+
+    path = os.path.join(app_dir, "components", f"{component}.yaml")
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise ComponentError(f"no such component template: {path}") from e
+
+    docs = [d for d in yaml.safe_load_all(_substitute(text, params)) if d]
+    if not docs:
+        raise ComponentError(f"component {component!r} rendered no documents")
+    return docs
+
+
+def list_components(app_dir: str) -> list[str]:
+    comp_dir = os.path.join(app_dir, "components")
+    try:
+        names = sorted(
+            f[:-5] for f in os.listdir(comp_dir) if f.endswith(".yaml")
+        )
+    except OSError as e:
+        raise ComponentError(f"no components/ dir in {app_dir}: {e}") from e
+    return names
+
+
+def validate_workflow(wf: dict) -> None:
+    """Structural checks on an Argo-shaped Workflow: entrypoint/onExit
+    resolve, every step references a defined template, no duplicate
+    template names, and the step graph is acyclic."""
+    if wf.get("kind") != "Workflow":
+        raise ComponentError(f"not a Workflow: kind={wf.get('kind')!r}")
+    spec = wf.get("spec") or {}
+    templates = spec.get("templates") or []
+    names = [t.get("name") for t in templates]
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise ComponentError(f"duplicate template names: {dupes}")
+    by_name = {t["name"]: t for t in templates}
+
+    for key in ("entrypoint", "onExit"):
+        ref = spec.get(key)
+        if ref and ref not in by_name:
+            raise ComponentError(f"spec.{key}={ref!r} names no template")
+    if not spec.get("entrypoint"):
+        raise ComponentError("spec.entrypoint is required")
+
+    edges: dict[str, set] = {n: set() for n in by_name}
+    for t in templates:
+        for group in t.get("steps") or []:
+            for step in group:
+                ref = step.get("template")
+                if ref not in by_name:
+                    raise ComponentError(
+                        f"step {step.get('name')!r} in template "
+                        f"{t['name']!r} references unknown template {ref!r}"
+                    )
+                edges[t["name"]].add(ref)
+
+    # cycle check (steps templates may nest, e.g. e2e -> sub-steps)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in by_name}
+
+    def visit(n: str, stack: list) -> None:
+        color[n] = GRAY
+        for m in edges[n]:
+            if color[m] == GRAY:
+                raise ComponentError(
+                    f"template cycle: {' -> '.join(stack + [n, m])}"
+                )
+            if color[m] == WHITE:
+                visit(m, stack + [n])
+        color[n] = BLACK
+
+    for n in by_name:
+        if color[n] == WHITE:
+            visit(n, [])
+
+
+def workflow_step_commands(wf: dict) -> dict:
+    """template name → container command list, for harness-side execution
+    and for tests asserting the step inventory."""
+    out = {}
+    for t in (wf.get("spec") or {}).get("templates") or []:
+        container = t.get("container")
+        if container and container.get("command"):
+            out[t["name"]] = list(container["command"])
+    return out
+
+
+def run_component(app_dir: str, component: str, overrides: dict | None,
+                  tfjob_version: str = "v1alpha2",
+                  junit_path: str | None = None,
+                  num_trials: int = 1,
+                  smoke: bool = True) -> bool:
+    """Deploy a rendered TFJob component against a LocalCluster and run the
+    full test_runner verification (the reference's `run-tests` Argo step,
+    workflows.libsonnet:281-295).
+
+    With ``smoke`` (the default), container commands are replaced by the e2e
+    smoke command before submission: the LocalCluster kubelet executes pod
+    commands as real local subprocesses, and the manifest's in-cluster
+    command (launcher.tpu_smoke) needs a TPU runtime this harness host may
+    not have.  ``smoke=False`` submits the manifest verbatim (real-cluster
+    runs through a REST clientset).
+    """
+    from k8s_tpu.e2e.components import smoke_command
+    from k8s_tpu.e2e.local import LocalCluster
+    from k8s_tpu.harness import test_runner
+
+    docs = render_component(app_dir, component, overrides)
+    if len(docs) != 1:
+        raise ComponentError(
+            f"component {component!r} rendered {len(docs)} documents; "
+            "run expects exactly one TFJob"
+        )
+    job = docs[0]
+    if job.get("kind") != "TFJob":
+        raise ComponentError(f"component {component!r} is not a TFJob")
+    if smoke:
+        for spec in (job["spec"].get("tfReplicaSpecs") or {}).values():
+            for c in spec["template"]["spec"].get("containers") or []:
+                c["command"] = smoke_command()
+
+    namespace = job["metadata"].get("namespace", "default")
+    with LocalCluster(version=tfjob_version, namespace=namespace) as cluster:
+        case = test_runner.run_test(
+            cluster.clientset, job, tfjob_version=tfjob_version,
+            num_trials=num_trials, junit_path=junit_path,
+        )
+    if case.failure:
+        log.error("component %s failed: %s", component, case.failure)
+        return False
+    log.info("component %s passed in %.1fs", component, case.time)
+    return True
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="verb", required=True)
+
+    for verb in ("render", "run"):
+        p = sub.add_parser(verb)
+        p.add_argument("--app_dir", required=True)
+        p.add_argument("--component", required=True)
+        p.add_argument("--params", default="", help="k=v,k2=v2 overrides")
+        if verb == "run":
+            p.add_argument("--tfjob_version", default="v1alpha2")
+            p.add_argument("--junit_path", default=None)
+            p.add_argument("--num_trials", type=int, default=1)
+            p.add_argument(
+                "--no-smoke", dest="smoke", action="store_false",
+                help="Submit the manifest's real command instead of the "
+                "local smoke substitution.",
+            )
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    overrides = parse_params(args.params)
+
+    if args.verb == "render":
+        docs = render_component(args.app_dir, args.component, overrides)
+        for doc in docs:
+            if doc.get("kind") == "Workflow":
+                validate_workflow(doc)
+        yaml.safe_dump_all(docs, sys.stdout, sort_keys=False)
+        return 0
+
+    ok = run_component(
+        args.app_dir, args.component, overrides,
+        tfjob_version=args.tfjob_version, junit_path=args.junit_path,
+        num_trials=args.num_trials, smoke=args.smoke,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

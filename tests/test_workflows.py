@@ -1,0 +1,191 @@
+"""Declarative workflow app-dir tests (k8s_tpu.harness.workflows).
+
+Covers the ksonnet-app analogue the reference keeps in test/workflows/ and
+test/test-app/ (workflows.libsonnet:139-344, core.jsonnet:1-5): param
+rendering, strict substitution, Argo-shape validation of the checked-in e2e
+workflow, consistency between the checked-in test-app and the programmatic
+deploy manifests, and an end-to-end `run` of the simple_tfjob component
+against the LocalCluster.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from k8s_tpu.harness import deploy, workflows
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKFLOWS_APP = os.path.join(REPO, "test", "workflows")
+TEST_APP = os.path.join(REPO, "test", "test-app")
+
+
+def test_list_components():
+    assert workflows.list_components(WORKFLOWS_APP) == [
+        "e2e", "simple_tfjob", "tpu_tfjob",
+    ]
+    assert workflows.list_components(TEST_APP) == ["core"]
+
+
+def test_parse_params():
+    assert workflows.parse_params("a=1,b=x=y, c = z ") == {
+        "a": "1", "b": "x=y", "c": "z",
+    }
+    assert workflows.parse_params("") == {}
+    with pytest.raises(workflows.ComponentError):
+        workflows.parse_params("noequals")
+
+
+def test_render_simple_tfjob_defaults_and_overrides():
+    (job,) = workflows.render_component(WORKFLOWS_APP, "simple_tfjob")
+    assert job["kind"] == "TFJob"
+    assert job["metadata"]["name"] == "simple-tfjob"
+    specs = job["spec"]["tfReplicaSpecs"]
+    # numeric params render as YAML ints, not strings
+    assert specs["Chief"]["replicas"] == 1
+    assert specs["Worker"]["replicas"] == 1
+
+    (job,) = workflows.render_component(
+        WORKFLOWS_APP, "simple_tfjob", {"name": "my-job", "num_workers": 3}
+    )
+    assert job["metadata"]["name"] == "my-job"
+    assert job["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 3
+
+
+def test_strict_substitution():
+    # override naming no declared param → error (ks param set model)
+    with pytest.raises(workflows.ComponentError, match="declared param"):
+        workflows.render_component(WORKFLOWS_APP, "simple_tfjob", {"nope": "x"})
+    with pytest.raises(workflows.ComponentError, match="not declared"):
+        workflows.render_component(WORKFLOWS_APP, "missing-component")
+
+
+def test_strict_substitution_unfilled_hole(tmp_path):
+    (tmp_path / "components").mkdir()
+    (tmp_path / "params.yaml").write_text("components:\n  c:\n    a: 1\n")
+    (tmp_path / "components" / "c.yaml").write_text(
+        "kind: X\nmetadata:\n  name: ${missing}\n"
+    )
+    with pytest.raises(workflows.ComponentError, match="missing"):
+        workflows.render_component(str(tmp_path), "c")
+
+
+def test_e2e_workflow_renders_and_validates():
+    (wf,) = workflows.render_component(WORKFLOWS_APP, "e2e")
+    workflows.validate_workflow(wf)
+    # reference DAG shape: checkout -> build/lint/test -> setup -> run-tests,
+    # exit handler tears down then copies artifacts
+    # (workflows.libsonnet:171-226)
+    steps = {t["name"]: t for t in wf["spec"]["templates"]}["e2e"]["steps"]
+    assert [s["name"] for s in steps[0]] == ["checkout"]
+    assert {s["name"] for s in steps[1]} == {
+        "build", "create-pr-symlink", "py-test", "py-lint",
+    }
+    assert [s["name"] for s in steps[2]] == ["setup-cluster"]
+    assert {s["name"] for s in steps[3]} == {"run-tests", "run-tpu-tests"}
+    exit_steps = {t["name"]: t for t in wf["spec"]["templates"]}["exit-handler"]["steps"]
+    assert [s["name"] for s in exit_steps[0]] == ["teardown-cluster"]
+    assert [s["name"] for s in exit_steps[1]] == ["copy-artifacts"]
+
+    # every container step invokes a module that actually exists
+    commands = workflows.workflow_step_commands(wf)
+    for name, cmd in commands.items():
+        if cmd[:2] == ["python", "-m"]:
+            module = cmd[2]
+            parts = module.split(".")
+            path = os.path.join(REPO, *parts) + ".py"
+            assert os.path.exists(path), f"step {name}: no module {module}"
+
+
+def test_validate_workflow_rejects_bad_refs():
+    wf = {
+        "kind": "Workflow",
+        "spec": {
+            "entrypoint": "main",
+            "templates": [
+                {"name": "main", "steps": [[{"name": "a", "template": "ghost"}]]},
+            ],
+        },
+    }
+    with pytest.raises(workflows.ComponentError, match="ghost"):
+        workflows.validate_workflow(wf)
+
+    wf["spec"]["templates"][0]["steps"][0][0]["template"] = "main"  # self-cycle
+    with pytest.raises(workflows.ComponentError, match="cycle"):
+        workflows.validate_workflow(wf)
+
+    with pytest.raises(workflows.ComponentError, match="entrypoint"):
+        workflows.validate_workflow({"kind": "Workflow", "spec": {"templates": []}})
+
+
+def test_tpu_tfjob_topology_consistent():
+    """The TPU component's worker count must match its declared slice
+    topology (the genjob derivation contract)."""
+    (job,) = workflows.render_component(WORKFLOWS_APP, "tpu_tfjob")
+    workers = job["spec"]["tfReplicaSpecs"]["Worker"]
+    sel = workers["template"]["spec"]["nodeSelector"]
+    x, y = (int(v) for v in sel["cloud.google.com/gke-tpu-topology"].split("x"))
+    chips = x * y
+    # v5e: 4 chips per host → hosts = chips/4 = expected worker replicas
+    assert workers["replicas"] == chips // 4
+
+
+def test_test_app_core_matches_deploy_manifests():
+    """The checked-in app and deploy.operator_manifests must not drift."""
+    rendered = workflows.render_component(
+        TEST_APP, "core", {"namespace": "kubeflow", "image": "img:v1"}
+    )
+    programmatic = deploy.operator_manifests(image="img:v1", namespace="kubeflow")
+    by_kind = lambda docs: {d["kind"] for d in docs}  # noqa: E731
+    assert by_kind(rendered) == by_kind(programmatic)
+
+    def cluster_role(docs):
+        return next(d for d in docs if d["kind"] == "ClusterRole")
+
+    rules = lambda d: {  # noqa: E731
+        (tuple(r["apiGroups"]), tuple(sorted(r["resources"])))
+        for r in d["rules"]
+    }
+    assert rules(cluster_role(rendered)) == rules(cluster_role(programmatic))
+
+    def image_of(docs):
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        return dep["spec"]["template"]["spec"]["containers"][0]["image"]
+
+    assert image_of(rendered) == image_of(programmatic) == "img:v1"
+
+
+def test_deploy_write_manifests_from_test_app(tmp_path):
+    paths = deploy.write_manifests(
+        str(tmp_path), "img:v2", "kubeflow", "v1alpha2", test_app_dir=TEST_APP
+    )
+    operator_yaml = [p for p in paths if p.endswith("tf-job-operator.yaml")]
+    assert operator_yaml
+    with open(operator_yaml[0]) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == "img:v2"
+
+
+def test_run_component_e2e_local():
+    """`workflows run` of the simple_tfjob component passes against the
+    LocalCluster (the Argo run-tests step, end to end)."""
+    ok = workflows.run_component(
+        WORKFLOWS_APP, "simple_tfjob",
+        {"name": "wf-smoke", "num_workers": 2},
+        tfjob_version="v1alpha2", num_trials=1,
+    )
+    assert ok
+
+
+def test_render_cli(tmp_path, capsys):
+    rc = workflows.main([
+        "render", "--app_dir", WORKFLOWS_APP, "--component", "e2e",
+        "--params", "name=pr-99,version_tag=abc123",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    (wf,) = [d for d in yaml.safe_load_all(out) if d]
+    assert wf["metadata"]["name"] == "pr-99"
+    assert any("abc123" in " ".join(c)
+               for c in workflows.workflow_step_commands(wf).values())
