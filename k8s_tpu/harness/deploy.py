@@ -18,7 +18,6 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import time
 
 import yaml
 
@@ -217,18 +216,16 @@ def main(argv=None) -> int:
     from k8s_tpu.harness import junit as junit_lib
 
     t = junit_lib.TestCase(class_name="deploy", name=args.command)
-    start = time.time()
     try:
         if args.command == "setup":
-            setup_kubectl(args.image, args.namespace, args.version,
-                          args.output_dir, args.test_app_dir)
+            junit_lib.wrap_test(
+                lambda: setup_kubectl(args.image, args.namespace, args.version,
+                                      args.output_dir, args.test_app_dir),
+                t,
+            )
         else:
-            teardown_kubectl(args.namespace)
-    except Exception as e:  # noqa: BLE001 - report the failure via junit too
-        t.failure = f"{type(e).__name__}: {e}"
-        raise
+            junit_lib.wrap_test(lambda: teardown_kubectl(args.namespace), t)
     finally:
-        t.time = time.time() - start
         if args.junit_path:
             junit_lib.create_junit_xml_file([t], args.junit_path)
     return 0

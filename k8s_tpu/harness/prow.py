@@ -177,7 +177,11 @@ def create_pr_symlink(store) -> str:
 def copy_artifacts(store, artifacts_dir: str) -> int:
     """The Argo `copy-artifacts` step (workflows.libsonnet:333-341):
     upload everything under ``artifacts_dir`` to the job's output dir,
-    preserving relative paths.  Returns the file count."""
+    preserving relative paths.  Returns the file count; a missing
+    artifacts dir is an error (a silent 0-file green here would hide the
+    real failure until finalize_job reports missing junit files)."""
+    if not os.path.isdir(artifacts_dir):
+        raise FileNotFoundError(f"artifacts dir does not exist: {artifacts_dir}")
     output_dir = get_output_dir()
     bucket, base = split_uri(output_dir)
     count = 0
@@ -206,9 +210,16 @@ def main(argv=None) -> int:
         default="",
         help="Comma separated list of expected junit file names.",
     )
-    sub.add_parser("create_pr_symlink", help="Write the PR directory pointer.")
+    symlink = sub.add_parser(
+        "create_pr_symlink", help="Write the PR directory pointer.")
     copy = sub.add_parser("copy_artifacts", help="Upload the artifacts dir.")
     copy.add_argument("--artifacts_dir", required=True)
+    # accept --artifacts_root after the subcommand too (the historical
+    # finalize_job flag position); SUPPRESS keeps the top-level value
+    # unless the subcommand explicitly overrides it
+    for p in (fin, symlink, copy):
+        p.add_argument("--artifacts_root", default=argparse.SUPPRESS,
+                       help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     store = LocalArtifactStore(args.artifacts_root)
     if args.command == "create_pr_symlink":

@@ -1,0 +1,191 @@
+"""Input pipeline: host-side batching + async device prefetch.
+
+The reference feeds its workloads with TF-side input pipelines inside the
+user container (dist_mnist reads MNIST via tf input_data,
+test/e2e/dist-mnist/dist_mnist.py:120-138); the operator itself ships no
+loader.  A TPU-native framework needs one: on TPU the train step should
+never wait on PCIe — batches must already be in HBM (sharded across the
+mesh) when the step is dispatched.
+
+``PrefetchIterator`` wraps any host iterator and stages up to
+``buffer_size`` batches ahead through ``jax.device_put`` on a background
+thread.  ``device_put`` dispatches asynchronously, so the host→HBM DMA of
+batch N+1/N+2 overlaps the device compute of batch N; the queue hand-off
+just bounds how far ahead the host runs.  With a ``sharding``
+(NamedSharding over the dp/fsdp axes), staging also scatters each batch
+shard to its device, which is exactly what make_sharded_train_step's
+``in_shardings`` expect — the jit call then finds its inputs already
+placed and inserts no transfer.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh, axes: Sequence[str] = ("dp", "fsdp")) -> NamedSharding:
+    """Sharding for a [global_batch, ...] array: leading dim split over the
+    data axes, trailing dims replicated (the make_sharded_train_step batch
+    contract, k8s_tpu.models.train)."""
+    present = tuple(a for a in axes if a in mesh.shape)
+    return NamedSharding(mesh, P(present if present else None))
+
+
+def array_batches(
+    arrays: Sequence[np.ndarray],
+    batch_size: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epochs: Optional[int] = None,
+    drop_remainder: bool = True,
+) -> Iterator[tuple]:
+    """Host-side epoch/shuffle/batch over aligned numpy arrays.
+
+    Yields tuples of per-array batches (the (inputs, targets) shape fit()
+    consumes).  ``epochs=None`` repeats forever — the step budget lives in
+    fit(steps=...), not the data pipeline.
+    """
+    n = len(arrays[0])
+    for a in arrays:
+        if len(a) != n:
+            raise ValueError(f"misaligned arrays: {len(a)} != {n}")
+    if batch_size > n:
+        raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        idx = rng.permutation(n) if shuffle else np.arange(n)
+        end = n - (n % batch_size) if drop_remainder else n
+        for start in range(0, end, batch_size):
+            take = idx[start:start + batch_size]
+            yield tuple(a[take] for a in arrays)
+        epoch += 1
+
+
+class PrefetchIterator:
+    """Async device staging of a host batch iterator.
+
+    Runs the wrapped iterator on a daemon thread, ``jax.device_put``-ing
+    each batch (optionally with a per-leaf or single ``sharding``) into a
+    bounded queue.  Iteration yields device-resident batches; the host
+    thread producing batch N+k runs concurrently with device compute on
+    batch N.
+
+    Exceptions in the producer propagate to the consumer at the next
+    ``__next__``; ``close()`` (or GC) stops the producer.
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        it: Iterable,
+        *,
+        buffer_size: int = 2,
+        sharding: Any = None,
+        transform: Optional[Callable] = None,
+    ):
+        if buffer_size < 1:
+            raise ValueError("buffer_size must be >= 1")
+        self._sharding = sharding
+        self._transform = transform
+        self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, args=(iter(it),), daemon=True,
+            name="prefetch-producer",
+        )
+        self._thread.start()
+
+    def _stage(self, batch):
+        if self._transform is not None:
+            batch = self._transform(batch)
+        if self._sharding is None:
+            return jax.device_put(batch)
+        if jax.tree_util.treedef_is_leaf(
+            jax.tree_util.tree_structure(self._sharding)
+        ):
+            return jax.tree.map(lambda x: jax.device_put(x, self._sharding), batch)
+        return jax.device_put(batch, self._sharding)
+
+    def _produce(self, it) -> None:
+        try:
+            for batch in it:
+                if self._stop.is_set():
+                    return
+                staged = self._stage(batch)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+            self._put_blocking(self._DONE)
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+            self._put_blocking(e)
+
+    def _put_blocking(self, item) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._stop.set()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._stop.set()
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so a blocked producer can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __del__(self):  # pragma: no cover - GC safety net
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+
+def prefetch_to_mesh(
+    it: Iterable,
+    mesh: Mesh,
+    *,
+    axes: Sequence[str] = ("dp", "fsdp"),
+    buffer_size: int = 2,
+    transform: Optional[Callable] = None,
+) -> PrefetchIterator:
+    """The one-call path for fit(): shard every leaf's leading dim over the
+    mesh's data axes and prefetch ``buffer_size`` batches ahead."""
+    return PrefetchIterator(
+        it,
+        buffer_size=buffer_size,
+        sharding=batch_sharding(mesh, axes),
+        transform=transform,
+    )

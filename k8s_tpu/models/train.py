@@ -20,10 +20,22 @@ from k8s_tpu.parallel.sharding import fsdp_sharding
 
 
 def cross_entropy_loss(logits, labels) -> jnp.ndarray:
-    """Mean softmax cross entropy; logits f32 [B, C] (or [B, L, C])."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
-    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+    """Mean softmax cross entropy; logits f32 [B, C] (or [B, L, C]).
+
+    logsumexp-minus-gather form: identical math to one_hot·log_softmax but
+    never materializes a [..., C] one-hot or log-prob tensor — at LM vocab
+    sizes those are the largest activations in the whole step.  Out-of-range
+    labels (the ``label = -1`` padding idiom) contribute zero loss and zero
+    gradient, exactly as a one-hot of an out-of-range index (all zeros) did,
+    while still counting in the mean's denominator.
+    """
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    valid = (labels >= 0) & (labels < num_classes)
+    safe = jnp.clip(labels, 0, num_classes - 1)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    return jnp.mean(jnp.where(valid, lse - picked, 0.0))
 
 
 def lm_loss(logits, tokens) -> jnp.ndarray:

@@ -1,0 +1,139 @@
+"""Input pipeline tests (k8s_tpu.models.data): host batching, async device
+prefetch, mesh sharding, and the fit() integration."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_tpu.models import data as data_lib
+from k8s_tpu.models import train as train_lib
+from k8s_tpu.parallel import MeshConfig, make_mesh
+
+
+def test_array_batches_shapes_and_epochs():
+    x = np.arange(10, dtype=np.float32)
+    y = np.arange(10, dtype=np.int32) * 2
+    batches = list(data_lib.array_batches(
+        (x, y), 4, shuffle=False, epochs=1))
+    # drop_remainder: 10 → 2 batches of 4
+    assert len(batches) == 2
+    bx, by = batches[0]
+    assert bx.shape == (4,) and by.shape == (4,)
+    np.testing.assert_array_equal(bx, x[:4])
+    np.testing.assert_array_equal(by, y[:4])
+
+    # keep remainder
+    batches = list(data_lib.array_batches(
+        (x, y), 4, shuffle=False, epochs=1, drop_remainder=False))
+    assert len(batches) == 3
+    assert batches[-1][0].shape == (2,)
+
+
+def test_array_batches_shuffle_is_epochwise_permutation():
+    x = np.arange(8)
+    batches = list(data_lib.array_batches((x,), 4, shuffle=True, seed=7, epochs=2))
+    epoch0 = np.concatenate([b[0] for b in batches[:2]])
+    epoch1 = np.concatenate([b[0] for b in batches[2:]])
+    assert sorted(epoch0) == list(range(8))
+    assert sorted(epoch1) == list(range(8))
+    assert not np.array_equal(epoch0, np.arange(8))  # seed 7 permutes
+
+
+def test_array_batches_validation():
+    with pytest.raises(ValueError, match="misaligned"):
+        next(data_lib.array_batches((np.zeros(3), np.zeros(4)), 2))
+    with pytest.raises(ValueError, match="batch_size"):
+        next(data_lib.array_batches((np.zeros(3),), 8))
+
+
+def test_prefetch_yields_device_arrays_in_order():
+    src = ((np.full((2, 2), i, np.float32), np.full((2,), i, np.int32))
+           for i in range(5))
+    it = data_lib.PrefetchIterator(src, buffer_size=2)
+    got = list(it)
+    assert len(got) == 5
+    for i, (bx, by) in enumerate(got):
+        assert isinstance(bx, jax.Array)
+        assert float(bx[0, 0]) == i and int(by[0]) == i
+
+
+def test_prefetch_propagates_producer_error():
+    def bad():
+        yield np.zeros(2)
+        raise RuntimeError("boom")
+
+    it = data_lib.PrefetchIterator(bad(), buffer_size=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+    # iterator is dead after the error
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_close_unblocks_producer():
+    def infinite():
+        i = 0
+        while True:
+            yield np.full((1,), i, np.float32)
+            i += 1
+
+    it = data_lib.PrefetchIterator(infinite(), buffer_size=1)
+    next(it)
+    it.close()
+    assert not it._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_to_mesh_places_shards():
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2), jax.devices()[:4])
+    src = ((np.arange(32, dtype=np.float32).reshape(8, 4),) for _ in range(3))
+    it = data_lib.prefetch_to_mesh(src, mesh, buffer_size=2)
+    (batch,) = next(it)
+    assert batch.sharding == data_lib.batch_sharding(mesh, ("dp", "fsdp"))
+    # leading dim split over dp*fsdp=4 devices → shard shape (2, 4)
+    assert batch.addressable_shards[0].data.shape == (2, 4)
+    it.close()
+
+
+def test_batch_sharding_skips_absent_axes():
+    # a raw mesh that genuinely lacks the fsdp axis (make_mesh always
+    # carries all six axes, absent ones at size 1)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("dp",))
+    sh = data_lib.batch_sharding(mesh, ("dp", "fsdp"))
+    assert sh.spec == jax.sharding.PartitionSpec(("dp",))
+    # and on a make_mesh mesh both axes exist (fsdp at size 1) and are kept
+    full = make_mesh(MeshConfig(dp=2), jax.devices()[:2])
+    assert data_lib.batch_sharding(full, ("dp", "fsdp")).spec == \
+        jax.sharding.PartitionSpec(("dp", "fsdp"))
+
+
+def test_fit_consumes_prefetch_iterator():
+    """End to end: array_batches → prefetch_to_mesh → fit() on a mesh."""
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2), jax.devices()[:4])
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    def loss_fn(pred, target):
+        return jnp.mean((pred - target) ** 2)
+
+    optimizer = train_lib.default_optimizer(0.1)
+    params = {"w": jnp.zeros((4, 1))}
+    state = train_lib.init_state(params, optimizer)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = x @ w_true
+
+    it = data_lib.prefetch_to_mesh(
+        data_lib.array_batches((x, y), 16, seed=1), mesh, buffer_size=2)
+    result = train_lib.fit(
+        apply_fn, loss_fn, optimizer, state, mesh, it, steps=200)
+    it.close()
+    assert result.losses[-1] < result.losses[0]
+    assert result.losses[-1] < 0.1

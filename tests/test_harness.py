@@ -213,6 +213,38 @@ class TestProw:
         )
         assert finished["result"] == "SUCCESS"
 
+    def test_create_pr_symlink_and_copy_artifacts(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JOB_NAME", "tpu-presubmit")
+        monkeypatch.setenv("BUILD_NUMBER", "8")
+        monkeypatch.setenv("PULL_NUMBER", "77")
+        store = LocalArtifactStore(str(tmp_path))
+        out = prow.create_pr_symlink(store)
+        assert out
+        pointer = store.download_as_string(
+            prow.LOGS_BUCKET, "pr-logs/directory/tpu-presubmit/8.txt")
+        assert pointer.endswith("/77/tpu-presubmit/8")
+
+        art = tmp_path / "artifacts"
+        (art / "sub").mkdir(parents=True)
+        (art / "junit_e2e.xml").write_text("<testsuite/>")
+        (art / "sub" / "log.txt").write_text("x")
+        assert prow.copy_artifacts(store, str(art)) == 2
+        base = f"pr-logs/pull/{prow.REPO_OWNER}_{prow.REPO_NAME}/77/tpu-presubmit/8"
+        assert store.download_as_string(
+            prow.LOGS_BUCKET, f"{base}/junit_e2e.xml") == "<testsuite/>"
+        assert store.download_as_string(
+            prow.LOGS_BUCKET, f"{base}/sub/log.txt") == "x"
+
+    def test_copy_artifacts_missing_dir_is_error(self, tmp_path):
+        store = LocalArtifactStore(str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            prow.copy_artifacts(store, str(tmp_path / "nope"))
+
+    def test_create_pr_symlink_skips_non_pr(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("PULL_NUMBER", raising=False)
+        store = LocalArtifactStore(str(tmp_path))
+        assert prow.create_pr_symlink(store) == ""
+
 
 class TestTFJobClient:
     def _clientset(self):

@@ -12,6 +12,44 @@ from k8s_tpu.models.transformer import Transformer, tiny_test, bert_base, llama_
 from k8s_tpu.parallel import MeshConfig, make_mesh
 
 
+class TestCrossEntropy:
+    def test_matches_onehot_form(self):
+        """Gather form == one_hot·log_softmax (value and grad)."""
+        logits = jax.random.normal(jax.random.PRNGKey(0), (8, 32)) * 4.0
+        labels = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 32)
+
+        def onehot_ce(logits, labels):
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+            return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+        np.testing.assert_allclose(
+            train.cross_entropy_loss(logits, labels), onehot_ce(logits, labels),
+            rtol=1e-6)
+        g1 = jax.grad(lambda l: train.cross_entropy_loss(l, labels))(logits)
+        g2 = jax.grad(lambda l: onehot_ce(l, labels))(logits)
+        np.testing.assert_allclose(g1, g2, atol=1e-6)
+
+    def test_out_of_range_labels_contribute_zero(self):
+        """label = -1 padding: zero loss and zero grad at that position,
+        still counted in the mean denominator (one-hot semantics)."""
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+        labels = jnp.array([1, -1, 3, 8])  # -1 and 8 both out of range
+        valid = jnp.array([0, 2])
+
+        loss = train.cross_entropy_loss(logits, labels)
+        expected = jnp.sum(
+            jax.vmap(lambda l, y: -jax.nn.log_softmax(l)[y])(
+                logits[valid], labels[valid])
+        ) / 4.0  # denominator includes the padded rows
+        np.testing.assert_allclose(loss, expected, rtol=1e-6)
+
+        grads = jax.grad(lambda l: train.cross_entropy_loss(l, labels))(logits)
+        np.testing.assert_array_equal(grads[1], jnp.zeros(8))
+        np.testing.assert_array_equal(grads[3], jnp.zeros(8))
+        assert float(jnp.max(jnp.abs(grads[0]))) > 0
+
+
 class TestResNet:
     def test_resnet50_param_count(self):
         model = resnet50(dtype=jnp.float32)

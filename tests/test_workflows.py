@@ -96,6 +96,24 @@ def test_e2e_workflow_renders_and_validates():
             path = os.path.join(REPO, *parts) + ".py"
             assert os.path.exists(path), f"step {name}: no module {module}"
 
+    # every container carries the prow env contract (reference injects
+    # prow_env into each buildTemplate) so create-pr-symlink/copy-artifacts
+    # can resolve the job's output location
+    for t in wf["spec"]["templates"]:
+        if t.get("container"):
+            env = {e["name"] for e in t["container"].get("env") or []}
+            assert {"JOB_NAME", "BUILD_NUMBER", "PULL_NUMBER",
+                    "PULL_REFS", "ARTIFACTS_ROOT"} <= env, t["name"]
+
+
+def test_e2e_workflow_checkout_honors_ref():
+    (wf,) = workflows.render_component(
+        WORKFLOWS_APP, "e2e", {"checkout_ref": "pull/123/head"})
+    cmd = workflows.workflow_step_commands(wf)["checkout"]
+    script = " ".join(cmd)
+    assert "git fetch origin pull/123/head" in script
+    assert "git checkout FETCH_HEAD" in script
+
 
 def test_validate_workflow_rejects_bad_refs():
     wf = {
