@@ -76,7 +76,9 @@ def main(argv=None) -> int:
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
+    from k8s_tpu.models import data as data_lib
     from k8s_tpu.models import train as train_lib
     from k8s_tpu.models.mnist import MnistCNN, synthetic_batch
 
@@ -99,20 +101,34 @@ def main(argv=None) -> int:
         shardings,
     )
 
-    loss = None
-    for step in range(start_step, args.train_steps):
-        bx, by = synthetic_batch(jax.random.fold_in(key, step), args.batch_size)
-        state, loss = step_fn(state, (bx, by))
-        if step % 10 == 0 or step == args.train_steps - 1:
-            log.info("step %d loss %.4f", step, float(loss))
-        if args.train_dir and (step + 1) % args.checkpoint_every == 0:
-            # barrier is a GLOBAL collective — every process must enter it;
-            # only the chief then writes (a chief-only barrier would leave
-            # the other hosts issuing mismatched collectives and hang).
-            bootstrap.barrier("pre-checkpoint")
-            if cfg.is_chief:
-                save_checkpoint(args.train_dir, state, step + 1)
+    # Host-side dataset streamed through the async prefetch pipeline — the
+    # same host→HBM path the reference's feed_dict/input_data loop takes
+    # (test/e2e/dist-mnist/dist_mnist.py:120-138), but staged ahead of the
+    # step so the TPU never waits on the transfer.
+    rng = np.random.default_rng(0)
+    ds_x = rng.normal(size=(64 * args.batch_size, 28, 28, 1)).astype(np.float32)
+    ds_y = rng.integers(0, 10, size=(64 * args.batch_size,)).astype(np.int32)
+    data_iter = data_lib.prefetch_to_mesh(
+        data_lib.array_batches((ds_x, ds_y), args.batch_size, seed=start_step),
+        mesh,
+    )
 
+    loss = None
+    try:
+        for step in range(start_step, args.train_steps):
+            state, loss = step_fn(state, next(data_iter))
+            if step % 10 == 0 or step == args.train_steps - 1:
+                log.info("step %d loss %.4f", step, float(loss))
+            if args.train_dir and (step + 1) % args.checkpoint_every == 0:
+                # barrier is a GLOBAL collective — every process must enter
+                # it; only the chief then writes (a chief-only barrier would
+                # leave the other hosts issuing mismatched collectives and
+                # hang).
+                bootstrap.barrier("pre-checkpoint")
+                if cfg.is_chief:
+                    save_checkpoint(args.train_dir, state, step + 1)
+    finally:
+        data_iter.close()
     if args.train_dir:
         bootstrap.barrier("final-checkpoint")
         if cfg.is_chief:

@@ -105,15 +105,28 @@ def make_training_mesh(
 ):
     """Build the global training mesh over all devices of the job.
 
-    Multi-slice layout: data-ish axes (dp/fsdp) span slices over DCN; tp/sp
-    stay within a slice on ICI (callers choose tp*sp <= devices-per-slice).
+    Multi-slice layout (MEGASCALE_NUM_SLICES > 1): data parallelism spans
+    slices over DCN via the explicit two-level hybrid mesh
+    (parallel.mesh.make_hybrid_mesh — slice boundary guaranteed on the
+    outer stride); all other axes stay within a slice on ICI (callers
+    choose tp*sp <= devices-per-slice).
     """
     import jax
 
     cfg = config or LauncherConfig.from_env()
-    mesh_cfg = MeshConfig.auto(len(jax.devices()), tp=tp, sp=sp, fsdp=fsdp)
-    mesh = make_mesh(mesh_cfg)
-    log.info("mesh: %s over %d devices", dict(mesh.shape), len(jax.devices()))
+    n = len(jax.devices())
+    if cfg.num_slices > 1:
+        from k8s_tpu.parallel.mesh import DcnConfig, make_hybrid_mesh
+
+        if n % cfg.num_slices != 0:
+            raise ValueError(
+                f"{n} devices not divisible by {cfg.num_slices} slices")
+        ici = MeshConfig.auto(n // cfg.num_slices, tp=tp, sp=sp, fsdp=fsdp)
+        mesh = make_hybrid_mesh(ici, DcnConfig(dp=cfg.num_slices))
+    else:
+        mesh = make_mesh(MeshConfig.auto(n, tp=tp, sp=sp, fsdp=fsdp))
+    log.info("mesh: %s over %d devices (%d slice(s))",
+             dict(mesh.shape), n, cfg.num_slices)
     return mesh, cfg
 
 

@@ -79,7 +79,11 @@ class PrefetchIterator:
     batch N.
 
     Exceptions in the producer propagate to the consumer at the next
-    ``__next__``; ``close()`` (or GC) stops the producer.
+    ``__next__``.  Call ``close()`` (use try/finally around the consuming
+    loop) to stop the producer: the live thread keeps the iterator
+    reachable, so garbage collection alone will NOT stop it — an abandoned
+    un-closed iterator over an infinite source polls its full queue until
+    process exit (daemon thread, so exit itself is never blocked).
     """
 
     _DONE = object()
@@ -120,15 +124,7 @@ class PrefetchIterator:
             for batch in it:
                 if self._stop.is_set():
                     return
-                staged = self._stage(batch)
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                else:
-                    return
+                self._put_blocking(self._stage(batch))
             self._put_blocking(self._DONE)
         except BaseException as e:  # noqa: BLE001 - forwarded to consumer
             self._put_blocking(e)

@@ -88,6 +88,90 @@ def make_mesh(config: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(arr, AXIS_ORDER)
 
 
+# Axes that tolerate DCN bandwidth/latency between slices: gradient
+# all-reduce (dp/fsdp) and pipeline hops (pp) amortize over a full
+# microbatch of compute, while tp/sp/ep collectives sit on the critical
+# path of every layer and must stay on ICI (scaling-book multislice recipe).
+DCN_AXES = ("dp", "fsdp", "pp")
+
+
+@dataclass
+class DcnConfig:
+    """Cross-slice (DCN) factors for the hybrid two-level mesh.  Each factor
+    multiplies the same-named ICI axis; only DCN-tolerant axes are legal."""
+
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+
+    @property
+    def num_slices(self) -> int:
+        return self.dp * self.fsdp * self.pp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a, 1) if a in DCN_AXES else 1
+                for a in AXIS_ORDER}
+
+
+def device_slice_groups(devices: Sequence, num_slices: int) -> list[list]:
+    """Group devices by TPU slice: honor ``device.slice_index`` when the
+    platform reports it (multislice TPU), else split the given order into
+    ``num_slices`` equal contiguous chunks (CPU/test meshes)."""
+    devices = list(devices)
+    if len(devices) % num_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by {num_slices} slices")
+    indices = {getattr(d, "slice_index", None) for d in devices}
+    if None not in indices and len(indices) == num_slices:
+        groups: dict = {i: [] for i in sorted(indices)}
+        for d in devices:
+            groups[d.slice_index].append(d)
+        sizes = {len(g) for g in groups.values()}
+        if len(sizes) != 1:
+            raise ValueError(f"uneven slice sizes: { {k: len(v) for k, v in groups.items()} }")
+        return [groups[i] for i in sorted(groups)]
+    per = len(devices) // num_slices
+    return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
+
+
+def make_hybrid_mesh(
+    ici: MeshConfig,
+    dcn: DcnConfig,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Two-level multislice mesh: ``dcn`` factors span slices over DCN,
+    ``ici`` factors live within a slice on ICI.
+
+    The returned mesh has the standard six axis names with combined sizes
+    ``dcn[a] * ici[a]``, laid out so the slice boundary is the *outer*
+    stride of each combined axis — a psum over ``fsdp`` therefore
+    decomposes into a fast ICI reduce-scatter within each slice plus one
+    DCN all-reduce of the partial, which is how XLA lowers hierarchical
+    collectives (the TPU-native replacement for the reference's flat
+    gRPC worker pool, SURVEY.md §2.4)."""
+    devices = list(devices if devices is not None else jax.devices())
+    per_slice = ici.num_devices
+    total = per_slice * dcn.num_slices
+    if len(devices) != total:
+        raise ValueError(
+            f"hybrid mesh needs {dcn.num_slices} slices x {per_slice} "
+            f"devices = {total}, got {len(devices)}")
+
+    groups = device_slice_groups(devices, dcn.num_slices)
+    dcn_sizes = dcn.axis_sizes()
+    ici_sizes = ici.axis_sizes()
+    # [slice, within-slice] -> [d0..d5, i0..i5] -> interleave -> combined
+    arr = np.array(groups).reshape(
+        [dcn_sizes[a] for a in AXIS_ORDER] + [ici_sizes[a] for a in AXIS_ORDER]
+    )
+    n = len(AXIS_ORDER)
+    perm = [k for i in range(n) for k in (i, n + i)]
+    arr = arr.transpose(perm).reshape(
+        [dcn_sizes[a] * ici_sizes[a] for a in AXIS_ORDER]
+    )
+    return Mesh(arr, AXIS_ORDER)
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
     """Batch dimension sharded over every data-ish axis (dp, fsdp, sp)."""
     return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))

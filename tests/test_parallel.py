@@ -8,7 +8,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from k8s_tpu.parallel import MeshConfig, make_mesh
 from k8s_tpu.parallel import collectives, sharding
-from k8s_tpu.parallel.mesh import chips_in_topology, parse_topology
+from k8s_tpu.parallel.mesh import (
+    DcnConfig,
+    chips_in_topology,
+    device_slice_groups,
+    make_hybrid_mesh,
+    parse_topology,
+)
 from k8s_tpu.parallel.ring_attention import (
     reference_attention,
     ring_attention,
@@ -43,6 +49,59 @@ class TestMesh:
         cfg = MeshConfig.auto(8, tp=2, pp=2)
         assert cfg.pp == 2 and cfg.tp == 2 and cfg.fsdp == 2
         assert cfg.num_devices == 8
+
+    def test_hybrid_mesh_slice_boundary_is_outer_stride(self):
+        """2 slices x 4 devices, dp across DCN, fsdp*tp within ICI: each
+        dp block must contain exactly one slice's devices."""
+        devices = jax.devices()
+        mesh = make_hybrid_mesh(
+            MeshConfig(fsdp=2, tp=2), DcnConfig(dp=2), devices)
+        assert dict(mesh.shape) == {
+            "dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2,
+        }
+        arr = mesh.devices
+        slice0 = set(devices[:4])  # contiguous chunks = virtual slices
+        dp0 = set(arr[0].flatten())
+        dp1 = set(arr[1].flatten())
+        assert dp0 == slice0
+        assert dp1 == set(devices[4:])
+
+    def test_hybrid_mesh_combines_same_axis(self):
+        """DCN fsdp=2 x ICI fsdp=2 -> one fsdp axis of 4 with slice
+        boundary outermost: positions [i, :2] all from one slice."""
+        devices = jax.devices()
+        mesh = make_hybrid_mesh(
+            MeshConfig(fsdp=2, tp=2), DcnConfig(fsdp=2), devices)
+        assert mesh.shape["fsdp"] == 4 and mesh.shape["tp"] == 2
+        arr = mesh.devices  # [dp=1, pp=1, fsdp=4, ep=1, sp=1, tp=2]
+        fsdp_axis = arr.reshape(4, 2)
+        assert set(fsdp_axis[:2].flatten()) == set(devices[:4])
+        assert set(fsdp_axis[2:].flatten()) == set(devices[4:])
+
+    def test_hybrid_mesh_runs_sharded_step(self):
+        """A psum-bearing computation executes over the hybrid mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_hybrid_mesh(
+            MeshConfig(fsdp=2, tp=2), DcnConfig(dp=2), jax.devices())
+        x = jnp.arange(16.0).reshape(8, 2)
+        x = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"))))
+        total = jax.jit(
+            lambda x: jnp.sum(x),
+            out_shardings=NamedSharding(mesh, P()),
+        )(x)
+        assert float(total) == float(np.arange(16.0).sum())
+
+    def test_hybrid_mesh_validates_device_count(self):
+        with pytest.raises(ValueError, match="hybrid mesh needs"):
+            make_hybrid_mesh(
+                MeshConfig(fsdp=2), DcnConfig(dp=2), jax.devices())
+
+    def test_device_slice_groups_chunks_evenly(self):
+        groups = device_slice_groups(jax.devices(), 4)
+        assert [len(g) for g in groups] == [2, 2, 2, 2]
+        with pytest.raises(ValueError, match="not divisible"):
+            device_slice_groups(jax.devices(), 3)
 
     def test_topology_parsing(self):
         assert parse_topology("4x4") == (4, 4)
