@@ -183,7 +183,17 @@ def cost_analysis_flops(compiled) -> float | None:
 def _time_steps(run_step, state, iters: int, warmup: int):
     """Time `iters` dependent steps; sync via scalar fetch (a host fetch of
     the loss cannot complete before the whole chain executes — plain
-    block_until_ready is not a reliable barrier over the remote relay)."""
+    block_until_ready is not a reliable barrier over the remote relay).
+
+    The compiled step donates its state buffers, so the caller's ``state``
+    must stay intact for with_retries to re-enter this function after a
+    relay drop: the chain therefore starts from a device-side copy, and
+    only the copies are ever donated.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    state = jax.tree_util.tree_map(jnp.copy, state)
     for _ in range(warmup):
         state, loss = run_step(state)
     if warmup:
@@ -237,9 +247,11 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5):
 
     # AOT-compile once and reuse the Compiled object for both cost analysis
     # and the timed loop (compiling via jit dispatch again would do a second
-    # full XLA compile over the flaky relay).
+    # full XLA compile over the flaky relay).  State buffers are donated —
+    # params/stats/opt_state are dead after each step (measured +0.5%:
+    # 2689 vs 2676 img/s at batch 128).
     step_c = with_retries(
-        lambda: jax.jit(step).lower(
+        lambda: jax.jit(step, donate_argnums=(0, 1, 2)).lower(
             params, batch_stats, opt_state, images, labels
         ).compile(),
         what="resnet compile",
@@ -313,7 +325,8 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
         return optax.apply_updates(params, updates), new_opt_state, loss
 
     step_c = with_retries(
-        lambda: jax.jit(step).lower(params, opt_state, tokens).compile(),
+        lambda: jax.jit(step, donate_argnums=(0, 1)).lower(
+            params, opt_state, tokens).compile(),
         what="transformer compile",
     )
     # Analytic model FLOPs for MFU: 6N per token (fwd+bwd dense, incl. the
