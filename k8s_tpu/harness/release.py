@@ -139,10 +139,13 @@ def write_build_info(build_info: dict, path: str) -> None:
 
 
 def build_and_push_artifacts(
-    repo_dir: str, registry: str, output_dir: str, version: str | None = None
+    repo_dir: str, registry: str, output_dir: str, version: str | None = None,
+    extra_info: dict | None = None,
 ) -> dict:
     """The full release pipeline (release.py:249-307): image + chart +
-    build_info.  ``version`` defaults to 0.1.0+<image tag>."""
+    build_info.  ``version`` defaults to 0.1.0+<image tag>; ``extra_info``
+    keys (e.g. the source commit) are merged into the one build_info.yaml
+    write so the file is never on disk incomplete."""
     os.makedirs(output_dir, exist_ok=True)
     image_result = build_operator_image(repo_dir, registry, output_dir)
     tag = image_result["image"].rsplit(":", 1)[1]
@@ -153,24 +156,143 @@ def build_and_push_artifacts(
         "chart": os.path.basename(chart_pkg),
         "version": version,
         "timestamp": int(time.time()),
+        **(extra_info or {}),
     }
     write_build_info(info, os.path.join(output_dir, "build_info.yaml"))
     return info
 
 
+# -- source selection: which commit gets built (reference release.py's
+# clone subcommands, :404-461, over util.clone_repo) ---------------------
+
+
+def git_clone(repo_url: str, dest: str, commit: str | None = None,
+              branches: list[str] | None = None) -> str:
+    """Clone ``repo_url`` into ``dest``, fetch any extra refspecs, check out
+    ``commit`` if given; returns the checked-out sha (the util.clone_repo
+    contract, py/util.py:90-135)."""
+    from k8s_tpu.harness import util as harness_util
+
+    harness_util.run(["git", "clone", repo_url, dest])
+    for refspec in branches or []:
+        harness_util.run(["git", "fetch", "origin", refspec], cwd=dest)
+    if commit:
+        harness_util.run(["git", "checkout", commit], cwd=dest)
+    return harness_util.run_and_output(
+        ["git", "rev-parse", "HEAD"], cwd=dest).strip()
+
+
+def clone_pr(repo_url: str, dest: str, pr: int,
+             commit: str | None = None) -> str:
+    """Check out a pull request head (release.py:408-410: fetches
+    pull/<pr>/head into a local ``pr`` branch)."""
+    return git_clone(repo_url, dest, commit or "pr",
+                     branches=[f"pull/{pr}/head:pr"])
+
+
+def clone_postsubmit(repo_url: str, dest: str,
+                     commit: str | None = None) -> str:
+    """Check out a postsubmit commit (default branch head when None;
+    release.py:413-414)."""
+    return git_clone(repo_url, dest, commit)
+
+
+def latest_green_sha(store, job_name: str) -> str:
+    """The sha recorded by prow.create_latest for the last passing
+    postsubmit (release.py:455-460 get_latest_green_presubmit)."""
+    import json
+
+    from k8s_tpu.harness import prow
+
+    payload = store.download_as_string(
+        prow.RESULTS_BUCKET, os.path.join(job_name, "latest_green.json"))
+    data = json.loads(payload)
+    if data.get("status") != "passing" or not data.get("sha"):
+        raise ValueError(f"no passing postsubmit recorded: {data}")
+    return data["sha"]
+
+
+def clone_lastgreen(repo_url: str, dest: str, store, job_name: str) -> str:
+    """Check out the last green postsubmit (release.py:455-460)."""
+    return git_clone(repo_url, dest, latest_green_sha(store, job_name))
+
+
+def build_at_ref(repo_url: str, registry: str, output_dir: str,
+                 clone_fn, version: str | None = None) -> dict:
+    """clone → build pipeline shared by the pr/postsubmit/lastgreen modes
+    (release.py:419-452 build_commit).  Reruns with the same output_dir
+    wipe the previous clone — a stale checkout must not be built under a
+    new tag (same contract as build_operator_image's context refresh)."""
+    import shutil
+
+    os.makedirs(output_dir, exist_ok=True)
+    src_dir = os.path.join(output_dir, "src")
+    if os.path.exists(src_dir):
+        shutil.rmtree(src_dir)
+    sha = clone_fn(repo_url, src_dir)
+    return build_and_push_artifacts(src_dir, registry, output_dir,
+                                    version=version,
+                                    extra_info={"commit": sha})
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     subparsers = parser.add_subparsers(dest="command", required=True)
-    local = subparsers.add_parser("local", help="build from this checkout (release.py:385)")
-    local.add_argument("--registry", default="k8s-tpu")
-    local.add_argument("--output_dir", required=True)
+
+    local = subparsers.add_parser(
+        "local", help="build from this checkout (release.py:385)")
     local.add_argument("--src_dir", default=os.getcwd())
-    local.add_argument("--version", default=None)
+
+    pr = subparsers.add_parser(
+        "pr", help="clone a PR head and build it (release.py:449-452)")
+    pr.add_argument("--pr", type=int, required=True)
+    pr.add_argument("--commit", default=None)
+
+    post = subparsers.add_parser(
+        "postsubmit",
+        help="clone a postsubmit commit and build it (release.py:442-444)")
+    post.add_argument("--commit", default=None)
+
+    green = subparsers.add_parser(
+        "lastgreen",
+        help="build the last passing postsubmit (release.py:455-460)")
+    green.add_argument("--job_name", required=True)
+    green.add_argument(
+        "--artifacts_root",
+        default=os.getenv("ARTIFACTS_ROOT", "/tmp/k8s_tpu_artifacts"))
+
+    for p in (local, pr, post, green):
+        p.add_argument("--registry", default="k8s-tpu")
+        p.add_argument("--output_dir", required=True)
+        p.add_argument("--version", default=None)
+    for p in (pr, post, green):
+        p.add_argument("--repo_url", required=True,
+                       help="git URL (or local path) to clone")
+
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    info = build_and_push_artifacts(
-        args.src_dir, args.registry, args.output_dir, version=args.version
-    )
+
+    if args.command == "local":
+        info = build_and_push_artifacts(
+            args.src_dir, args.registry, args.output_dir, version=args.version)
+    elif args.command == "pr":
+        info = build_at_ref(
+            args.repo_url, args.registry, args.output_dir,
+            lambda url, dest: clone_pr(url, dest, args.pr, args.commit),
+            version=args.version)
+    elif args.command == "postsubmit":
+        info = build_at_ref(
+            args.repo_url, args.registry, args.output_dir,
+            lambda url, dest: clone_postsubmit(url, dest, args.commit),
+            version=args.version)
+    else:
+        from k8s_tpu.harness.artifacts import LocalArtifactStore
+
+        store = LocalArtifactStore(args.artifacts_root)
+        info = build_at_ref(
+            args.repo_url, args.registry, args.output_dir,
+            lambda url, dest: clone_lastgreen(url, dest, store, args.job_name),
+            version=args.version)
     log.info("built: %s", info)
     return 0
 

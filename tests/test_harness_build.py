@@ -106,6 +106,114 @@ class TestRelease:
         assert '"-m", "k8s_tpu.cmd.operator_v2"' in text.replace("', '", '", "')
 
 
+def _git(args, cwd):
+    subprocess.run(
+        ["git", "-c", "user.email=ci@test", "-c", "user.name=ci", *args],
+        cwd=cwd, check=True, capture_output=True, text=True)
+
+
+def _sha(cwd, ref="HEAD"):
+    return subprocess.run(
+        ["git", "rev-parse", ref], cwd=cwd, check=True,
+        capture_output=True, text=True).stdout.strip()
+
+
+@pytest.fixture()
+def src_repo(tmp_path):
+    """A clonable origin with the minimal release build context, a main
+    commit, and a PR ref (pull/7/head) one commit ahead."""
+    src = tmp_path / "origin"
+    (src / "k8s_tpu").mkdir(parents=True)
+    (src / "k8s_tpu" / "version.py").write_text('VERSION = "main"\n')
+    tpl_dir = src / "build" / "images" / "tf_operator"
+    tpl_dir.mkdir(parents=True)
+    (tpl_dir / "Dockerfile.template").write_text(
+        "FROM {base_image}\nCOPY k8s_tpu k8s_tpu\n"
+        "COPY ci_config.yaml ci_config.yaml\n")
+    chart = src / "examples" / "tf_job_chart"
+    chart.mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: tf-job\nversion: 0.0.1\n")
+    (chart / "values.yaml").write_text("image: old:0\n")
+    (src / "ci_config.yaml").write_text("tiers: {}\n")
+    _git(["init", "-q", "-b", "main"], src)
+    _git(["add", "-A"], src)
+    _git(["commit", "-q", "-m", "main"], src)
+    main_sha = _sha(src)
+
+    _git(["checkout", "-q", "-b", "feature"], src)
+    (src / "k8s_tpu" / "version.py").write_text('VERSION = "pr"\n')
+    _git(["add", "-A"], src)
+    _git(["commit", "-q", "-m", "pr change"], src)
+    pr_sha = _sha(src)
+    _git(["update-ref", "refs/pull/7/head", pr_sha], src)
+    _git(["checkout", "-q", "main"], src)
+    return {"url": str(src), "main": main_sha, "pr": pr_sha}
+
+
+class TestReleaseCloneModes:
+    """The reference's clone/pr/postsubmit/lastgreen source-selection modes
+    (py/release.py:404-461), against a local git origin."""
+
+    def test_clone_pr_checks_out_pr_head(self, src_repo, tmp_path):
+        dest = str(tmp_path / "pr-src")
+        sha = release.clone_pr(src_repo["url"], dest, 7)
+        assert sha == src_repo["pr"]
+        assert 'VERSION = "pr"' in open(
+            os.path.join(dest, "k8s_tpu", "version.py")).read()
+
+    def test_clone_postsubmit_default_and_pinned(self, src_repo, tmp_path):
+        sha = release.clone_postsubmit(src_repo["url"], str(tmp_path / "a"))
+        assert sha == src_repo["main"]
+        pinned = release.clone_postsubmit(
+            src_repo["url"], str(tmp_path / "b"), src_repo["main"])
+        assert pinned == src_repo["main"]
+
+    def test_clone_lastgreen_reads_prow_record(self, src_repo, tmp_path,
+                                               monkeypatch):
+        from k8s_tpu.harness import prow
+        from k8s_tpu.harness.artifacts import LocalArtifactStore
+
+        store = LocalArtifactStore(str(tmp_path / "store"))
+        prow.create_latest(store, "postsubmit-x", src_repo["main"])
+        sha = release.clone_lastgreen(
+            src_repo["url"], str(tmp_path / "green"), store, "postsubmit-x")
+        assert sha == src_repo["main"]
+
+    def test_lastgreen_requires_passing_record(self, tmp_path):
+        from k8s_tpu.harness.artifacts import LocalArtifactStore
+
+        store = LocalArtifactStore(str(tmp_path / "store"))
+        store.upload_from_string(
+            "ci-results", "job-y/latest_green.json",
+            '{"status": "failing", "sha": ""}')
+        with pytest.raises(ValueError, match="no passing postsubmit"):
+            release.latest_green_sha(store, "job-y")
+
+    def test_pr_mode_builds_cloned_source(self, src_repo, tmp_path,
+                                          monkeypatch):
+        monkeypatch.setattr(
+            build_and_push_image, "docker_available", lambda: False)
+        out = tmp_path / "out"
+        rc = release.main([
+            "pr", "--pr", "7", f"--repo_url={src_repo['url']}",
+            f"--output_dir={out}", "--registry=test-reg",
+        ])
+        assert rc == 0
+        info = yaml.safe_load((out / "build_info.yaml").read_text())
+        assert info["commit"] == src_repo["pr"]
+        assert info["image"].startswith("test-reg/tf-job-operator:")
+        # the image context was built from the PR's source
+        ctx_version = (out / "image-context" / "k8s_tpu" / "version.py")
+        assert 'VERSION = "pr"' in ctx_version.read_text()
+
+        # rerun into the same output_dir must wipe the stale clone, not die
+        rc = release.main([
+            "pr", "--pr", "7", f"--repo_url={src_repo['url']}",
+            f"--output_dir={out}", "--registry=test-reg",
+        ])
+        assert rc == 0
+
+
 class TestPyChecks:
     def test_lint_clean_tree(self, tmp_path):
         src = tmp_path / "src"
