@@ -200,9 +200,21 @@ class SharedInformer:
 class Lister:
     """Read-only view over an informer's store (reference: pkg/client/listers).
 
-    Returns **copies**: client-go forbids mutating informer-cache objects
-    (controllers default and patch what listers hand them), and handing out
-    the cached dicts would let a sync thread race the reflector."""
+    ``get`` returns a **copy** — it is the mutation seam: sync_tfjob
+    defaults and status-updates the object it gets, and the typed
+    ``from_dict`` wrappers alias nested dicts, so an uncopied get would
+    write through into the cache.
+
+    ``list`` returns the **cached objects themselves** under client-go's
+    contract: listed objects MUST be treated as read-only (adoption,
+    status derivation, and preemption checks all are).  Copying here was
+    the operator's scale bottleneck — every reconcile deep-copied the
+    whole namespace (O(jobs²) at the 100-concurrent design point; see
+    BASELINE.md).  The reflector never mutates a stored object in place
+    (watch events replace whole objects), so readers race only the
+    key→object map, never an object's interior.  The stress tier's
+    store-convergence check compares cache contents against the
+    backend, so a consumer that mutates a listed object fails it."""
 
     def __init__(self, informer: SharedInformer):
         self._informer = informer
@@ -215,8 +227,6 @@ class Lister:
         return copy.deepcopy(obj) if obj is not None else None
 
     def list(self, namespace: Optional[str] = None, label_selector=None) -> list[dict]:
-        import copy
-
         from k8s_tpu.client.selectors import labels_match, parse_label_selector
 
         required = parse_label_selector(label_selector)
@@ -226,7 +236,7 @@ class Lister:
                 continue
             if required and not labels_match(o, required):
                 continue
-            out.append(copy.deepcopy(o))
+            out.append(o)
         return out
 
 

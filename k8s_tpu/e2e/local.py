@@ -27,7 +27,11 @@ class LocalCluster:
         namespace: str = "default",
         enable_gang_scheduling: bool = False,
         kubelet_kwargs: dict | None = None,
+        threadiness: int = 1,
     ):
+        # threadiness mirrors the operator flag (reference default: v1 runs
+        # 1 worker, v2's flag defaults to 2 — options.go:42, server.go:95)
+        self.threadiness = threadiness
         self.backend = FakeCluster()
         self.clientset = Clientset(self.backend)
         self.namespace = namespace
@@ -60,7 +64,7 @@ class LocalCluster:
     def __enter__(self) -> "LocalCluster":
         t = threading.Thread(
             target=self.controller.run,
-            kwargs={"threadiness": 1, "stop_event": self._stop},
+            kwargs={"threadiness": self.threadiness, "stop_event": self._stop},
             daemon=True,
             name="operator",
         )

@@ -40,7 +40,8 @@ def _all_replicas_running(job: dict) -> bool:
 
 
 def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
-                        timeout_s: float = 60.0) -> dict:
+                        timeout_s: float = 60.0,
+                        threadiness: int = 1) -> dict:
     """Submit ``jobs`` gang jobs back to back; measure each
     submit→all-replicas-Running latency and the aggregate throughput."""
     if jobs < 1:
@@ -52,7 +53,8 @@ def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
     # runtime long enough that jobs stay Running while we poll
     with LocalCluster(version="v1alpha2", namespace=ns,
                       enable_gang_scheduling=True,
-                      kubelet_kwargs={"default_runtime_s": timeout_s}) as lc:
+                      kubelet_kwargs={"default_runtime_s": timeout_s},
+                      threadiness=threadiness) as lc:
         t_all0 = time.perf_counter()
         submitted = []
         for i in range(jobs):
@@ -92,9 +94,12 @@ def main(argv=None) -> int:
     p.add_argument("--jobs", type=int, default=20)
     p.add_argument("--replicas", type=int, default=4)
     p.add_argument("--timeout", type=float, default=60.0)
+    p.add_argument("--threadiness", type=int, default=1,
+                   help="controller worker threads (operator --threadiness)")
     args = p.parse_args(argv)
 
-    result = bench_time_to_ready(args.jobs, args.replicas, args.timeout)
+    result = bench_time_to_ready(args.jobs, args.replicas, args.timeout,
+                                 threadiness=args.threadiness)
     print(json.dumps({"metric": "tfjob_time_to_ready_p50",
                       "value": result["time_to_ready_p50_s"],
                       "unit": "s", **result}))

@@ -8,6 +8,7 @@ subprocess/retry/timeout surface the rest of the harness uses.
 from __future__ import annotations
 
 import logging
+import re
 import subprocess
 import time
 
@@ -18,10 +19,19 @@ class TimeoutError(Exception):  # noqa: A001 - mirrors py/util.py TimeoutError
     """An operation timed out (py/util.py:504)."""
 
 
+_URL_USERINFO = re.compile(r"(?<=://)[^/@\s]+@")
+
+
+def _redact(arg: str) -> str:
+    """Strip URL userinfo (user:token@) so credential-bearing clone URLs
+    never reach persisted CI logs."""
+    return _URL_USERINFO.sub("<redacted>@", arg)
+
+
 def run(command: list[str], cwd: str | None = None, env: dict | None = None) -> None:
     """Run a command logging it first; raises CalledProcessError on failure
     (py/util.py:39-60)."""
-    log.info("Running: %s", " ".join(command))
+    log.info("Running: %s", " ".join(_redact(c) for c in command))
     subprocess.check_call(command, cwd=cwd, env=env)
 
 
@@ -29,7 +39,7 @@ def run_and_output(
     command: list[str], cwd: str | None = None, env: dict | None = None
 ) -> str:
     """Run a command and return its combined output (py/util.py:63-87)."""
-    log.info("Running: %s", " ".join(command))
+    log.info("Running: %s", " ".join(_redact(c) for c in command))
     return subprocess.check_output(
         command, cwd=cwd, env=env, stderr=subprocess.STDOUT
     ).decode()

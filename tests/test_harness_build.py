@@ -168,8 +168,7 @@ class TestReleaseCloneModes:
             src_repo["url"], str(tmp_path / "b"), src_repo["main"])
         assert pinned == src_repo["main"]
 
-    def test_clone_lastgreen_reads_prow_record(self, src_repo, tmp_path,
-                                               monkeypatch):
+    def test_clone_lastgreen_reads_prow_record(self, src_repo, tmp_path):
         from k8s_tpu.harness import prow
         from k8s_tpu.harness.artifacts import LocalArtifactStore
 

@@ -238,14 +238,22 @@ class TestInformerStress:
             assert not w.is_alive(), "writer wedged"
         assert not handler_errors, handler_errors
 
-        # convergence: informer store must reach the backend's final state
-        final = {meta_namespace_key(o) for o in cluster.list(PODS)}
+        # convergence: informer store must reach the backend's final state —
+        # by CONTENT, not just keys: since Lister.list hands out cached
+        # objects under the read-only contract, a consumer that mutated one
+        # would diverge the cache interior while the key set stays equal
+        def backend_state():
+            return {meta_namespace_key(o): o for o in cluster.list(PODS)}
+
+        def store_state():
+            return {meta_namespace_key(o): o for o in informer.store.list()}
+
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
-            if set(informer.store.keys()) == final:
+            if store_state() == backend_state():
                 break
             time.sleep(0.05)
-        assert set(informer.store.keys()) == final
+        assert store_state() == backend_state()
         factory.stop()
 
 
