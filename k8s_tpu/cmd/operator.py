@@ -1,9 +1,11 @@
 """v1 operator binary (reference: cmd/tf-operator/).
 
-Flags mirror cmd/tf-operator/app/options/options.go:39-47 (chaos-level is
-parsed-but-unused there too; kept for CLI compatibility).  Run flow mirrors
-app.Run (server.go:55-135): cluster config → clients → controller config →
-leader election → controller.Run.
+Flags mirror cmd/tf-operator/app/options/options.go:39-47.  Run flow
+mirrors app.Run (server.go:55-135): cluster config → clients → controller
+config → leader election → controller.Run.  Unlike the reference (which
+parses chaos-level with the implementation excised), --chaos-level here is
+live: while leading, a ChaosMonkey deletes managed pods in the watched
+namespace (test clusters only).
 """
 
 from __future__ import annotations
@@ -29,7 +31,11 @@ log = logging.getLogger(__name__)
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("tpu-operator")
     p.add_argument("--chaos-level", type=int, default=-1,
-                   help="(vestigial; parsed for compatibility, options.go:40-41)")
+                   help="Fault injection: delete up to N managed pods per "
+                   "tick (<=0 disables). The reference kept the flag with "
+                   "the implementation excised (options.go:40-41); here it "
+                   "drives e2e.chaos.ChaosMonkey against the watched "
+                   "namespace — test clusters only.")
     p.add_argument("--controller-config-file", default="",
                    help="Path to the accelerator ControllerConfig YAML (server.go:138-156)")
     p.add_argument("--enable-gang-scheduling", action="store_true",
@@ -112,9 +118,25 @@ def run(opts, backend=None) -> int:
     )
 
     def on_started_leading(stop_work):
-        controller.run(
-            opts.threadiness, stop_event=merge_stop_events(stop, stop_work)
-        )
+        # chaos only while LEADING: a standby replica injecting faults
+        # would double the configured rate and outlive its lease
+        monkey = None
+        if opts.chaos_level > 0:
+            from k8s_tpu.e2e.chaos import ChaosMonkey
+
+            monkey = ChaosMonkey(
+                clientset, namespace, level=opts.chaos_level
+            ).start()
+            log.warning(
+                "chaos level %d: injecting managed-pod faults in %s",
+                opts.chaos_level, namespace)
+        try:
+            controller.run(
+                opts.threadiness, stop_event=merge_stop_events(stop, stop_work)
+            )
+        finally:
+            if monkey is not None:
+                monkey.stop()
 
     def on_stopped_leading():
         log.error("leader election lost")

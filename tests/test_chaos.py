@@ -1,0 +1,109 @@
+"""Fault-injection e2e (k8s_tpu.e2e.chaos): a chaos storm deletes running
+pods out from under the operator; the reconciler replaces them and the job
+still completes once the storm ends.
+
+This makes the --chaos-level flag's contract real (the reference parsed it
+with the implementation excised, options.go:40-41); the exit-code half of
+the failure story is tests/test_restart_semantics.py.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.fake import FakeCluster
+from k8s_tpu.e2e.chaos import ChaosMonkey
+from k8s_tpu.e2e.components import core_component
+from k8s_tpu.e2e.local import LocalCluster
+
+NS = "default"
+
+
+def _slow_ok_command(runtime_s: float = 0.4) -> list[str]:
+    return [sys.executable, "-c", f"import time; time.sleep({runtime_s})"]
+
+
+def _conditions(job: dict) -> list[dict]:
+    return (job.get("status") or {}).get("conditions") or []
+
+
+def _has(job: dict, ctype: str) -> bool:
+    return any(c.get("type") == ctype and c.get("status") == "True"
+               for c in _conditions(job))
+
+
+def test_job_completes_after_chaos_storm():
+    with LocalCluster(version="v1alpha2", namespace=NS) as lc:
+        cs = lc.clientset
+        job = core_component(
+            {"name": "chaos-job", "namespace": NS, "num_masters": 0,
+             "num_workers": 2, "num_ps": 0,
+             "command": _slow_ok_command()},
+            "v1alpha2",
+        )
+        cs.tfjobs_unstructured(NS).create(job)
+
+        monkey = ChaosMonkey(cs, NS, level=2, interval_s=0.1, seed=3).start()
+        # let the storm overlap actual pod runtime
+        deadline = time.time() + 8
+        while time.time() < deadline and not monkey.victims:
+            time.sleep(0.05)
+        time.sleep(0.5)
+        monkey.stop()
+        assert monkey.victims, "chaos never struck a running pod"
+
+        # with faults stopped, the reconciler must drive the job to done
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            got = cs.tfjobs_unstructured(NS).get("chaos-job")
+            if _has(got, "Succeeded"):
+                break
+            assert not _has(got, "Failed"), _conditions(got)
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"job did not recover from chaos: {_conditions(got)}")
+
+
+def test_monkey_level_zero_is_inert():
+    cs = Clientset(FakeCluster())
+    cs.pods(NS).create({"metadata": {"name": "p1"},
+                        "status": {"phase": "Running"}})
+    monkey = ChaosMonkey(cs, NS, level=0, interval_s=0.01).start()
+    time.sleep(0.1)
+    monkey.stop()
+    assert monkey.victims == []
+    assert cs.pods(NS).get("p1") is not None
+
+
+def test_monkey_spares_unmanaged_pods():
+    """Bystanders (no TFJob labels — e.g. the operator's own pod) are never
+    victims; managed pods are."""
+    cs = Clientset(FakeCluster())
+    cs.pods(NS).create({"metadata": {"name": "operator-pod"},
+                        "status": {"phase": "Running"}})
+    cs.pods(NS).create({
+        "metadata": {"name": "v1-pod", "labels": {"tf_job_name": "j"}},
+        "status": {"phase": "Running"}})
+    cs.pods(NS).create({
+        "metadata": {"name": "v2-pod",
+                     "labels": {"group_name": "kubeflow.org"}},
+        "status": {"phase": "Running"}})
+    monkey = ChaosMonkey(cs, NS, level=3, interval_s=0.01, seed=1).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(monkey.victims) < 2:
+        time.sleep(0.02)
+    monkey.stop()
+    assert set(monkey.victims) == {"v1-pod", "v2-pod"}
+    assert cs.pods(NS).get("operator-pod") is not None
+
+
+def test_operator_binary_wires_chaos_flag():
+    from k8s_tpu.cmd.operator import build_parser
+
+    opts = build_parser().parse_args(["--chaos-level", "2"])
+    assert opts.chaos_level == 2
+    # default stays disabled
+    assert build_parser().parse_args([]).chaos_level == -1
