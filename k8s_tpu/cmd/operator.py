@@ -90,6 +90,15 @@ def make_backend(kubeconfig: str):
 
 
 def run(opts, backend=None) -> int:
+    if opts.chaos_level > 0 and os.environ.get("K8S_TPU_ALLOW_CHAOS") != "1":
+        # The reference shipped this flag inert ("DO NOT USE IN PRODUCTION",
+        # options.go:40-41); here it is live, so a second explicit key is
+        # required before the leader may delete managed pods.  Fail fast at
+        # startup rather than after winning the election.
+        raise SystemExit(
+            "--chaos-level > 0 deletes managed pods; refusing to start "
+            "without K8S_TPU_ALLOW_CHAOS=1 in the environment"
+        )
     logging.basicConfig(
         level=logging.INFO,
         format='{"level":"%(levelname)s","msg":"%(message)s","time":"%(asctime)s"}'

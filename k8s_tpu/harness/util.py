@@ -32,7 +32,12 @@ def run(command: list[str], cwd: str | None = None, env: dict | None = None) -> 
     """Run a command logging it first; raises CalledProcessError on failure
     (py/util.py:39-60)."""
     log.info("Running: %s", " ".join(_redact(c) for c in command))
-    subprocess.check_call(command, cwd=cwd, env=env)
+    try:
+        subprocess.check_call(command, cwd=cwd, env=env)
+    except subprocess.CalledProcessError as e:
+        # e.cmd ends up in tracebacks and persisted junit output; strip
+        # credential-bearing URLs (release.py git_clone) there too
+        raise _redacted_error(e) from None
 
 
 def run_and_output(
@@ -40,9 +45,32 @@ def run_and_output(
 ) -> str:
     """Run a command and return its combined output (py/util.py:63-87)."""
     log.info("Running: %s", " ".join(_redact(c) for c in command))
-    return subprocess.check_output(
-        command, cwd=cwd, env=env, stderr=subprocess.STDOUT
-    ).decode()
+    try:
+        return subprocess.check_output(
+            command, cwd=cwd, env=env, stderr=subprocess.STDOUT
+        ).decode()
+    except subprocess.CalledProcessError as e:
+        raise _redacted_error(e) from None
+
+
+def _redacted_error(e: subprocess.CalledProcessError) -> subprocess.CalledProcessError:
+    cmd = e.cmd
+    if isinstance(cmd, (list, tuple)):
+        cmd = [_redact(str(c)) for c in cmd]
+    else:
+        cmd = _redact(str(cmd))
+
+    def scrub(out):
+        # git prints the failing URL to stderr→output; junit wrap_test
+        # persists e.output verbatim, so it needs the same redaction
+        if out is None:
+            return None
+        if isinstance(out, bytes):
+            return _redact(out.decode(errors="replace")).encode()
+        return _redact(out)
+
+    return subprocess.CalledProcessError(
+        e.returncode, cmd, scrub(e.output), scrub(e.stderr))
 
 
 def wait_for(

@@ -141,9 +141,17 @@ class PrefetchIterator:
         return self
 
     def __next__(self):
-        if self._stop.is_set():
-            raise StopIteration
-        item = self._q.get()
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                # re-check _stop: close() from another thread may have
+                # stopped the producer before it enqueued the sentinel,
+                # mirroring the producer's _put_blocking pattern
+                continue
         if item is self._DONE:
             self._stop.set()
             raise StopIteration

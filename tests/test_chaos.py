@@ -107,3 +107,55 @@ def test_operator_binary_wires_chaos_flag():
     assert opts.chaos_level == 2
     # default stays disabled
     assert build_parser().parse_args([]).chaos_level == -1
+
+
+def test_operator_refuses_chaos_without_optin(monkeypatch):
+    """--chaos-level > 0 is a destructive knob: the binary must refuse to
+    start unless K8S_TPU_ALLOW_CHAOS=1 (the reference shipped the flag
+    inert with 'DO NOT USE IN PRODUCTION')."""
+    import pytest
+
+    from k8s_tpu.cmd import operator
+
+    monkeypatch.delenv("K8S_TPU_ALLOW_CHAOS", raising=False)
+    opts = operator.build_parser().parse_args(["--chaos-level", "1"])
+    with pytest.raises(SystemExit, match="K8S_TPU_ALLOW_CHAOS"):
+        operator.run(opts, backend=FakeCluster())
+
+
+def test_monkey_survives_delete_transport_errors():
+    """A non-ApiError from pods.delete (REST teardown race) must not kill
+    the storm thread; the failure is recorded for tests to detect."""
+    cs = Clientset(FakeCluster())
+    cs.pods(NS).create({
+        "metadata": {"name": "v1-pod", "labels": {"tf_job_name": "j"}},
+        "status": {"phase": "Running"}})
+    pods_api = cs.pods(NS)
+    real_delete = pods_api.delete
+    calls = {"n": 0}
+
+    def flaky_delete(name, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("connection reset")
+        return real_delete(name, **kw)
+
+    class FlakyPods:
+        def list(self):
+            return pods_api.list()
+
+        delete = staticmethod(flaky_delete)
+
+    class FlakyClientset:
+        def pods(self, ns):
+            return FlakyPods()
+
+    monkey = ChaosMonkey(FlakyClientset(), NS, level=1,
+                         interval_s=0.01, seed=0).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not monkey.victims:
+        time.sleep(0.02)
+    monkey.stop()
+    assert monkey.delete_errors, "transport failure was not recorded"
+    assert monkey.victims == ["v1-pod"], \
+        "storm died after the transport error instead of retrying"

@@ -1,6 +1,9 @@
 """Input pipeline tests (k8s_tpu.models.data): host batching, async device
 prefetch, mesh sharding, and the fit() integration."""
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -137,3 +140,35 @@ def test_fit_consumes_prefetch_iterator():
     it.close()
     assert result.losses[-1] < result.losses[0]
     assert result.losses[-1] < 0.1
+
+
+def test_prefetch_close_unblocks_blocked_consumer():
+    """close() from another thread while the consumer is blocked on an empty
+    queue must raise StopIteration in the consumer, not deadlock (the
+    producer observes _stop and exits without enqueuing the sentinel)."""
+    release = threading.Event()
+
+    def slow():
+        release.wait(10)
+        yield np.zeros((1,), np.float32)
+
+    it = data_lib.PrefetchIterator(slow(), buffer_size=1)
+    got: list = []
+
+    def consume():
+        try:
+            next(it)
+            got.append("item")
+        except StopIteration:
+            got.append("stop")
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)  # let the consumer block on the empty queue
+    # wake the producer shortly after close() so its join() doesn't burn
+    # the full timeout waiting out release.wait()
+    threading.Timer(0.3, release.set).start()
+    it.close()
+    t.join(timeout=5)
+    assert not t.is_alive(), "consumer deadlocked after close()"
+    assert got == ["stop"]
