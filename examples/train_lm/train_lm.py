@@ -46,6 +46,14 @@ def parse_args(argv=None):
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel size (>1 enables ring attention)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages (>1 runs the 1F1B "
+                   "schedule; layers must divide evenly)")
+    p.add_argument("--pp_virtual", type=int, default=1,
+                   help="virtual chunks per pp stage (>1: interleaved "
+                   "1F1B, bubble (S-1)/(v*M+S-1))")
+    p.add_argument("--num_microbatches", type=int, default=0,
+                   help="pp microbatches per step (0: auto = 2*pp)")
     p.add_argument("--remat", action="store_true",
                    help="checkpoint each layer (HBM for FLOPs)")
     p.add_argument("--train_dir", default=os.environ.get("CHECKPOINT_DIR", ""),
@@ -73,6 +81,10 @@ def build_config(args, on_tpu: bool):
             vocab_size=32000, hidden=768, ffn_hidden=3072, layers=12,
             heads=12, kv_heads=12, max_seq_len=args.seq_len,
             dtype=jnp.bfloat16)
+    if args.pp > 1 and args.sp > 1:
+        raise SystemExit("--pp composes with flash attention, not the sp "
+                         "ring (collectives can't nest inside the pp "
+                         "shard_map); use --sp 1 with --pp")
     return dataclasses.replace(
         cfg,
         max_seq_len=max(cfg.max_seq_len, args.seq_len),
@@ -108,7 +120,7 @@ def main(argv=None) -> int:
     from k8s_tpu.models.transformer import Transformer
 
     mesh, _ = bootstrap.make_training_mesh(
-        tp=args.tp, sp=args.sp, config=cfg_launch)
+        tp=args.tp, sp=args.sp, pp=args.pp, config=cfg_launch)
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = build_config(args, on_tpu)
@@ -125,7 +137,6 @@ def main(argv=None) -> int:
 
     optimizer = train_lib.default_optimizer(
         args.learning_rate, weight_decay=args.weight_decay)
-    state = train_lib.init_state(params, optimizer)
 
     corpus = synthetic_corpus(
         cfg.vocab_size, 64 * args.batch_size * args.seq_len, args.seq_len,
@@ -136,6 +147,43 @@ def main(argv=None) -> int:
         mesh,
     )
 
+    step_fn = None
+    shardings = None
+    if args.pp > 1:
+        from k8s_tpu.models import pp_lm
+        from k8s_tpu.parallel.pipeline import bubble_fraction
+
+        vp = args.pp_virtual
+        if cfg.layers % (args.pp * vp):
+            raise SystemExit(
+                f"{cfg.layers} layers not divisible into {args.pp * vp} pp "
+                f"chunks ({args.pp} stages x {vp} virtual)")
+        micro = args.num_microbatches or 2 * args.pp
+        if args.batch_size % micro:
+            raise SystemExit(
+                f"--batch_size {args.batch_size} not divisible into "
+                f"{micro} microbatches (--num_microbatches)")
+        if vp > 1 and micro % args.pp:
+            raise SystemExit(
+                f"interleaved schedule ingests microbatches in groups of "
+                f"{args.pp} (=pp); --num_microbatches {micro} is not a "
+                f"multiple")
+        # optimizer state is built over the SPLIT layout only — building it
+        # over the full tree first would transiently double moment memory
+        state = train_lib.init_state(
+            pp_lm.split_lm_params(params, args.pp, vp), optimizer)
+        shardings = pp_lm.pp_state_shardings(state, mesh, num_virtual=vp)
+        step_fn = pp_lm.make_pp_train_step(
+            cfg, optimizer, mesh, num_stages=args.pp,
+            num_microbatches=micro, num_virtual=vp,
+            state_shardings=shardings)
+        schedule = "interleaved" if vp > 1 else "1f1b"
+        log.info("pipeline: %d stages x %d virtual, %d microbatches, %s "
+                 "(bubble %.1f%%)", args.pp, vp, micro, schedule,
+                 100 * bubble_fraction(schedule, micro, args.pp, vp))
+    else:
+        state = train_lib.init_state(params, optimizer)
+
     apply_fn = (lambda p, t: model.apply(p, t, mesh=mesh))
     try:
         result = train_lib.fit(
@@ -144,6 +192,8 @@ def main(argv=None) -> int:
             checkpoint_dir=args.train_dir,
             checkpoint_every=args.checkpoint_every,
             log_every=args.log_every,
+            step_fn=step_fn,
+            state_shardings=shardings,
         )
     finally:
         data_iter.close()

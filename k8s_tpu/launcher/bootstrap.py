@@ -102,6 +102,8 @@ def make_training_mesh(
     sp: int = 1,
     fsdp: Optional[int] = None,
     config: Optional[LauncherConfig] = None,
+    *,
+    pp: int = 1,
 ):
     """Build the global training mesh over all devices of the job.
 
@@ -121,10 +123,11 @@ def make_training_mesh(
         if n % cfg.num_slices != 0:
             raise ValueError(
                 f"{n} devices not divisible by {cfg.num_slices} slices")
-        ici = MeshConfig.auto(n // cfg.num_slices, tp=tp, sp=sp, fsdp=fsdp)
+        ici = MeshConfig.auto(n // cfg.num_slices, tp=tp, sp=sp, fsdp=fsdp,
+                              pp=pp)
         mesh = make_hybrid_mesh(ici, DcnConfig(dp=cfg.num_slices))
     else:
-        mesh = make_mesh(MeshConfig.auto(n, tp=tp, sp=sp, fsdp=fsdp))
+        mesh = make_mesh(MeshConfig.auto(n, tp=tp, sp=sp, fsdp=fsdp, pp=pp))
     log.info("mesh: %s over %d devices (%d slice(s))",
              dict(mesh.shape), n, cfg.num_slices)
     return mesh, cfg

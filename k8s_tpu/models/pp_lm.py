@@ -1,0 +1,298 @@
+"""Pipeline-parallel causal LM: the flagship Transformer decomposed into
+(pre = embedding, S homogeneous block stages, post = final norm + tied LM
+head + loss) for parallel.pipeline's heterogeneous schedules.
+
+The reference has no pipeline parallelism anywhere (SURVEY.md §2.4 — its
+only axes were PS-vs-worker data parallelism); in the TPU-native design the
+``pp`` mesh axis is a first-class choice for models whose layer stack
+doesn't fit one chip's HBM.  The decomposition here reuses the exact
+modules of models.transformer — a pipelined step is grad-exact against the
+unpipelined ``Transformer.apply`` on the same parameters (asserted in
+tests/test_pp_lm.py), because it IS the same computation, re-scheduled.
+
+Embedding tying: the token embedding is used by stage 0 (lookup) and the
+last stage (vocab projection).  The split layout stores it ONCE; the train
+step passes it to both ends and sums the two gradient contributions — the
+standard first/last-stage all-reduce of tied-embedding training, here a
+``psum`` over pp inside the 1F1B body plus an add outside.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_tpu.models.transformer import Block, RMSNorm, TransformerConfig
+from k8s_tpu.parallel.pipeline import (
+    interleave_chunks,
+    pipeline_apply,
+    pipeline_train_step_1f1b,
+    pipeline_train_step_interleaved,
+    stack_stage_params,
+)
+
+_LAYER_RE = re.compile(r"^layer_(\d+)$")
+
+
+def _unwrap(params):
+    return params["params"] if "params" in params else params
+
+
+def split_lm_params(params, num_stages: int, num_virtual: int = 1) -> dict:
+    """Re-layout a Transformer param tree for the pp schedules.
+
+    Returns ``{"embedding", "final_norm", "stages"}`` where ``stages``
+    stacks ``layers/(num_stages*num_virtual)`` blocks per chunk on a
+    leading chunk axis (renamed ``block_{j}`` locally so every chunk has an
+    identical pytree structure, as stack_stage_params requires).
+
+    With ``num_virtual > 1`` (interleaved 1F1B) the chunk axis is stored in
+    device-major round-robin order — chunk c on pp rank c mod S — so the
+    step's P("pp") slicing needs no per-step weight gather.
+    """
+    p = _unwrap(params)
+    idxs = sorted(
+        int(m.group(1)) for k in p if (m := _LAYER_RE.match(k)))
+    n_layers = len(idxs)
+    if idxs != list(range(n_layers)):
+        raise ValueError(f"non-contiguous layer keys: {idxs}")
+    n_chunks = num_stages * num_virtual
+    if n_layers % n_chunks:
+        raise ValueError(
+            f"{n_layers} layers not divisible into {n_chunks} pp chunks "
+            f"({num_stages} stages x {num_virtual} virtual)")
+    per = n_layers // n_chunks
+    chunk_trees = [
+        {f"block_{j}": p[f"layer_{ci * per + j}"] for j in range(per)}
+        for ci in range(n_chunks)
+    ]
+    stages = stack_stage_params(chunk_trees)
+    if num_virtual > 1:
+        stages = interleave_chunks(stages, num_stages, num_virtual)
+    return {
+        "embedding": p["embedding"],
+        "final_norm": p["final_norm"],
+        "stages": stages,
+    }
+
+
+def merge_lm_params(pp_params: dict, num_stages: int,
+                    num_virtual: int = 1) -> dict:
+    """Inverse of split_lm_params — back to the plain ``Transformer`` tree
+    (``{"params": {...}}``), e.g. for checkpoint export or eval without pp."""
+    stages = pp_params["stages"]
+    if num_virtual > 1:
+        stages = interleave_chunks(
+            stages, num_stages, num_virtual, inverse=True)
+    n_chunks = num_stages * num_virtual
+    per = None
+    flat = {}
+    for ci in range(n_chunks):
+        stage = jax.tree.map(lambda x: x[ci], stages)
+        if per is None:
+            per = len(stage)
+        for j in range(per):
+            flat[f"layer_{ci * per + j}"] = stage[f"block_{j}"]
+    flat["embedding"] = pp_params["embedding"]
+    flat["final_norm"] = pp_params["final_norm"]
+    return {"params": flat}
+
+
+def make_stage_fn(cfg: TransformerConfig, blocks_per_stage: int) -> Callable:
+    """One homogeneous pp stage: ``blocks_per_stage`` transformer blocks.
+
+    Ring attention is a cross-device collective over ``sp`` and cannot run
+    inside the pp shard_map body; pp + long-context composes via the flash
+    kernel (device-local Pallas) instead.
+    """
+    if cfg.use_ring_attention:
+        raise ValueError(
+            "use_ring_attention composes with pp via flash attention, not "
+            "the sp ring (collectives can't nest inside the pp shard_map)")
+    block = Block(cfg)
+
+    def apply_block(block_params, x, positions):
+        return block.apply({"params": block_params}, x, positions)
+
+    if cfg.remat:
+        apply_block = jax.checkpoint(apply_block)
+
+    def stage_fn(stage_params, x):
+        B, L, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(L), (B, L))
+        for j in range(blocks_per_stage):
+            x = apply_block(stage_params[f"block_{j}"], x, positions)
+        return x
+
+    return stage_fn
+
+
+def make_pre_fn(cfg: TransformerConfig) -> Callable:
+    """Stage-0 ingest: token ids -> embedded activations (transformer.py's
+    ``emb[tokens]`` line, run on the first pp rank only)."""
+
+    def pre_fn(pre_params, tokens):
+        return pre_params["embedding"][tokens].astype(cfg.dtype)
+
+    return pre_fn
+
+
+def _head_logits(cfg: TransformerConfig, post_params, x):
+    norm = RMSNorm(fused=cfg.use_fused_norm)
+    x = norm.apply({"params": post_params["final_norm"]}, x)
+    # tied embeddings, bf16 operands + f32 accumulation — same kernel
+    # shape as Transformer.__call__'s head einsum
+    return jnp.einsum(
+        "bld,vd->blv", x.astype(cfg.dtype),
+        post_params["embedding"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def make_post_logits_fn(cfg: TransformerConfig) -> Callable:
+    """Last-stage output map for pipeline_apply: activations -> logits."""
+    return lambda post_params, x: _head_logits(cfg, post_params, x)
+
+
+def make_post_loss_fn(cfg: TransformerConfig) -> Callable:
+    """Last-stage loss head for 1F1B: activations + target tokens ->
+    per-microbatch next-token loss (train.lm_loss on the microbatch).
+
+    Equal-sized microbatches make the mean-over-microbatches of this equal
+    to the global lm_loss — the decomposition 1F1B requires.
+    """
+    from k8s_tpu.models.train import lm_loss
+
+    def post_fn(post_params, x, target_tokens):
+        return lm_loss(_head_logits(cfg, post_params, x), target_tokens)
+
+    return post_fn
+
+
+def pp_apply(mesh: Mesh, cfg: TransformerConfig, pp_params: dict, tokens,
+             *, num_stages: int, num_microbatches: int,
+             batch_axes=("dp", "fsdp"), axis: str = "pp"):
+    """Pipelined forward: tokens -> logits, numerically equal to
+    ``Transformer(cfg).apply(merge_lm_params(...), tokens)``."""
+    stage_fn = make_stage_fn(cfg, cfg.layers // num_stages)
+    return pipeline_apply(
+        mesh, stage_fn, pp_params["stages"], tokens,
+        num_microbatches=num_microbatches, axis=axis, batch_axes=batch_axes,
+        pre_fn=make_pre_fn(cfg),
+        pre_params={"embedding": pp_params["embedding"]},
+        post_fn=make_post_logits_fn(cfg),
+        post_params={"final_norm": pp_params["final_norm"],
+                     "embedding": pp_params["embedding"]},
+    )
+
+
+def pp_loss_and_grads(mesh: Mesh, cfg: TransformerConfig, pp_params: dict,
+                      tokens, targets, *, num_stages: int,
+                      num_microbatches: int, num_virtual: int = 1,
+                      batch_axes=("dp", "fsdp"), axis: str = "pp"):
+    """1F1B loss + gradients in the split layout (tied-embedding grads
+    summed across the two end stages).  num_virtual > 1 runs the
+    interleaved schedule on the device-major chunk layout split_lm_params
+    produced."""
+    ends = dict(
+        pre_fn=make_pre_fn(cfg),
+        pre_params={"embedding": pp_params["embedding"]},
+        post_fn=make_post_loss_fn(cfg),
+        post_params={"final_norm": pp_params["final_norm"],
+                     "embedding": pp_params["embedding"]},
+    )
+    stage_fn = make_stage_fn(
+        cfg, cfg.layers // (num_stages * num_virtual))
+    if num_virtual > 1:
+        loss, (g_stage, g_pre, g_post) = pipeline_train_step_interleaved(
+            mesh, stage_fn, pp_params["stages"], tokens, targets,
+            num_microbatches=num_microbatches, num_virtual=num_virtual,
+            axis=axis, batch_axes=batch_axes, device_major=True, **ends)
+    else:
+        loss, (g_stage, g_pre, g_post) = pipeline_train_step_1f1b(
+            mesh, stage_fn, pp_params["stages"], tokens, targets,
+            num_microbatches=num_microbatches, axis=axis,
+            batch_axes=batch_axes, **ends)
+    grads = {
+        "stages": g_stage,
+        # tied embedding: lookup grad (stage 0) + head grad (last stage)
+        "embedding": g_pre["embedding"] + g_post["embedding"],
+        "final_norm": g_post["final_norm"],
+    }
+    return loss, grads
+
+
+def pp_state_shardings(state: dict, mesh: Mesh, axis: str = "pp",
+                       num_virtual: int = 1) -> Any:
+    """Shardings for a train state over split-layout params: each stage's
+    blocks live on their pp rank (leading chunk axis sharded over ``axis``;
+    with interleaving each rank holds its num_virtual device-major chunks);
+    the tied embedding and final norm are replicated (both end ranks read
+    them).  Optimizer moments mirror their parameter leaves; scalars
+    replicate."""
+
+    def param_sh(params):
+        stage_sh = jax.tree.map(
+            lambda _: NamedSharding(mesh, P(axis)), params["stages"])
+        rep = NamedSharding(mesh, P())
+        return {
+            "stages": stage_sh,
+            "embedding": rep,
+            "final_norm": jax.tree.map(lambda _: rep, params["final_norm"]),
+        }
+
+    p_sh = param_sh(state["params"])
+
+    n_chunks = mesh.shape[axis] * num_virtual
+
+    def opt_leaf_sh(x):
+        # moment tensors in the split layout mirror params positionally is
+        # not guaranteed across optax versions; shard by shape instead: a
+        # leaf with the chunk-stacked leading axis gets the stage sharding
+        if hasattr(x, "shape") and x.ndim >= 1 and (
+                x.shape[:1] == (n_chunks,)) and mesh.shape[axis] > 1:
+            return NamedSharding(mesh, P(axis))
+        return NamedSharding(mesh, P())
+
+    opt_sh = jax.tree.map(opt_leaf_sh, state["opt_state"])
+    return {"params": p_sh, "opt_state": opt_sh,
+            "step": NamedSharding(mesh, P())}
+
+
+def make_pp_train_step(cfg: TransformerConfig, optimizer, mesh: Mesh, *,
+                       num_stages: int, num_microbatches: int,
+                       num_virtual: int = 1,
+                       batch_axes=("dp", "fsdp"), axis: str = "pp",
+                       state_shardings=None) -> Callable:
+    """jitted 1F1B train step over split-layout state, with donated state —
+    the pp analogue of train.make_sharded_train_step."""
+
+    def step(state, batch):
+        tokens, targets = batch
+        loss, grads = pp_loss_and_grads(
+            mesh, cfg, state["params"], tokens, targets,
+            num_stages=num_stages, num_microbatches=num_microbatches,
+            num_virtual=num_virtual, batch_axes=batch_axes, axis=axis)
+        updates, new_opt = optimizer.update(
+            grads, state["opt_state"], state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": new_params, "opt_state": new_opt,
+             "step": state["step"] + 1},
+            loss,
+        )
+
+    if state_shardings is None:
+        return jax.jit(step, donate_argnums=(0,))
+    batch_sh = NamedSharding(mesh, P(batch_axes))
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, (batch_sh, batch_sh)),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )

@@ -174,6 +174,8 @@ def fit(
     checkpoint_every: int = 100,
     preemption_save: bool = True,
     log_every: int = 0,
+    step_fn: Optional[Callable] = None,
+    state_shardings: Any = None,
 ) -> FitResult:
     """The canonical training loop: shard state over the mesh, jit the step,
     checkpoint/resume via k8s_tpu.models.checkpoint.
@@ -189,14 +191,23 @@ def fit(
 
     Note: the jitted step donates the state buffers, so the caller's
     ``state`` arrays are consumed — use the returned state.
+
+    A prebuilt ``step_fn(state, batch) -> (state, loss)`` (e.g.
+    pp_lm.make_pp_train_step's pipelined step, whose gradient schedule
+    fit cannot derive from an apply_fn) bypasses the default
+    FSDP-shard-and-jit path; pass ``state_shardings`` with it so the
+    initial state is placed the way the step expects.
     """
     import logging
 
     log = logging.getLogger(__name__)
 
-    state, shardings = shard_train_state(state, mesh)
-    step_fn = make_sharded_train_step(
-        apply_fn, loss_fn, optimizer, mesh, shardings)
+    if step_fn is None:
+        state, shardings = shard_train_state(state, mesh)
+        step_fn = make_sharded_train_step(
+            apply_fn, loss_fn, optimizer, mesh, shardings)
+    elif state_shardings is not None:
+        state = jax.device_put(state, state_shardings)
 
     ckpt = None
     start_step = 0

@@ -39,13 +39,46 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 
-def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
-                    axis: str):
+def _act_template(pre_fn, pre_params, mb0):
+    """Shape/dtype of one microbatch's ring activation (what flows between
+    stages): pre_fn's output when the input end is heterogeneous, the raw
+    microbatch otherwise."""
+    if pre_fn is None:
+        return jax.eval_shape(lambda m: m, mb0)
+    return jax.eval_shape(pre_fn, pre_params, mb0)
+
+
+def _make_ingest(pre_fn, s, microbatches):
+    """Stage-0 input selection, shared by forward and re-linearization.
+
+    Returns ingest(pre_p, idx, x_ring): the stage input for microbatch
+    ``idx`` — pre_fn applied to the raw microbatch on stage 0 (under a
+    lax.cond so only stage 0 pays for it), the ring activation elsewhere.
+    """
+    if pre_fn is None:
+        return lambda _pre_p, idx, x_ring: jnp.where(
+            s == 0, microbatches[idx], x_ring)
+
+    def ingest(pre_p, idx, x_ring):
+        return lax.cond(
+            s == 0,
+            lambda: pre_fn(pre_p, microbatches[idx]).astype(x_ring.dtype),
+            lambda: x_ring,
+        )
+
+    return ingest
+
+
+def _pipeline_local(stage_params, pre_params, post_params, microbatches, *,
+                    stage_fn: Callable, pre_fn, post_fn, axis: str):
     """Per-device schedule body (under shard_map).
 
     stage_params: this stage's params (leading stage axis already sliced to
       size 1 by shard_map; squeezed here).
     microbatches: [M, mb, ...] — replicated input; only stage 0 reads it.
+    pre_fn/post_fn: optional heterogeneous ends — stage 0 maps the raw
+      microbatch into ring-activation space (e.g. an embedding lookup), the
+      last stage maps its activation into output space (e.g. an LM head).
     Returns [M, mb, ...] finished outputs (valid on the last stage, zeros
     elsewhere).
     """
@@ -55,30 +88,39 @@ def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
     s = lax.axis_index(axis)
     M = microbatches.shape[0]
     params = jax.tree.map(lambda x: jnp.squeeze(x, 0), stage_params)
+    ingest = _make_ingest(pre_fn, s, microbatches)
+
+    act = _act_template(pre_fn, pre_params, microbatches[0])
+    if post_fn is None:
+        out_t = act
+    else:
+        out_t = jax.eval_shape(post_fn, post_params,
+                               jnp.zeros(act.shape, act.dtype))
 
     def tick(carry, t):
         holding, outputs = carry
         # stage 0 ingests microbatch t (while t < M); others use what they
         # received last tick
-        mb_in = microbatches[jnp.minimum(t, M - 1)]
-        x = jnp.where(s == 0, mb_in, holding)
+        x = ingest(pre_params, jnp.minimum(t, M - 1), holding)
         y = stage_fn(params, x)
         # the last stage's result at tick t is finished microbatch t-(S-1)
         out_idx = t - (S - 1)
         is_done = jnp.logical_and(s == S - 1, out_idx >= 0)
-        outputs = lax.cond(
-            is_done,
-            lambda o: lax.dynamic_update_index_in_dim(
-                o, y, jnp.maximum(out_idx, 0), 0),
-            lambda o: o,
-            outputs,
-        )
+
+        def emit(o):
+            out = y if post_fn is None else post_fn(post_params, y)
+            return lax.dynamic_update_index_in_dim(
+                o, out.astype(o.dtype), jnp.maximum(out_idx, 0), 0)
+
+        # post_fn (an LM head is a double-digit share of forward FLOPs)
+        # runs only on the last stage's emitting ticks, via the cond
+        outputs = lax.cond(is_done, emit, lambda o: o, outputs)
         # ring: stage i sends to i+1; last stage's wrap to 0 is discarded
         holding = ring_shift(y, axis)
         return (holding, outputs), None
 
-    holding0 = jnp.zeros_like(microbatches[0])
-    outputs0 = jnp.zeros_like(microbatches)
+    holding0 = jnp.zeros(act.shape, act.dtype)
+    outputs0 = jnp.zeros((M,) + out_t.shape, out_t.dtype)
     (_, outputs), _ = lax.scan(
         tick, (holding0, outputs0), jnp.arange(M + S - 1))
 
@@ -88,44 +130,62 @@ def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
     return lax.psum(outputs * mask, axis)
 
 
-def pipeline_apply(mesh: Mesh, stage_fn: Callable, stage_params, batch, *,
-                   num_microbatches: int, axis: str = "pp",
-                   batch_axes=("dp", "fsdp")):
-    """Run ``batch`` through the pipeline.
-
-    stage_fn(params, x) -> y: one stage's computation, same activation shape
-      in and out (homogeneous stages).
-    stage_params: pytree with leading stage axis of size ``|pp|``.
-    batch: [B, ...] global; B must divide into num_microbatches.
-    Returns [B, ...] outputs.
-    """
+def _check_microbatching(mesh, batch, num_microbatches, batch_axes):
     B = batch.shape[0]
     if B % num_microbatches:
         raise ValueError(f"batch {B} not divisible into {num_microbatches} microbatches")
     mb = B // num_microbatches
+    axes = batch_axes if isinstance(batch_axes, (tuple, list)) else (batch_axes,)
     data_shards = 1
-    for a in (batch_axes if isinstance(batch_axes, (tuple, list)) else (batch_axes,)):
+    for a in axes:
         data_shards *= mesh.shape[a]
     if mb % data_shards:
         raise ValueError(
             f"microbatch size {mb} not divisible by data shards {data_shards} "
             f"(axes {batch_axes}); use fewer microbatches or a bigger batch")
+    return mb, tuple(axes)
+
+
+def pipeline_apply(mesh: Mesh, stage_fn: Callable, stage_params, batch, *,
+                   num_microbatches: int, axis: str = "pp",
+                   batch_axes=("dp", "fsdp"),
+                   pre_fn: Callable | None = None, pre_params=None,
+                   post_fn: Callable | None = None, post_params=None):
+    """Run ``batch`` through the pipeline.
+
+    stage_fn(params, x) -> y: one stage's computation, same activation shape
+      in and out (homogeneous ring body).
+    stage_params: pytree with leading stage axis of size ``|pp|``.
+    batch: [B, ...] global; B must divide into num_microbatches.
+    pre_fn(pre_params, mb) -> x / post_fn(post_params, y) -> out: optional
+      heterogeneous input/output stages (embedding in, LM head out) run on
+      the first/last pp rank only; their params are replicated over pp.
+    Returns [B, ...] outputs (post_fn's output space when given).
+    """
+    mb, axes = _check_microbatching(mesh, batch, num_microbatches, batch_axes)
     micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
 
+    if pre_params is None:
+        pre_params = ()
+    if post_params is None:
+        post_params = ()
     param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+    end_specs = lambda tree: jax.tree.map(lambda _: P(), tree)  # noqa: E731
     # microbatch data stays sharded over the data axes; every pp rank sees
     # its slice of each microbatch
-    mspec = P(None, batch_axes)
+    mspec = P(None, axes)
 
     fn = shard_map(
-        partial(_pipeline_local, stage_fn=stage_fn, axis=axis),
+        partial(_pipeline_local, stage_fn=stage_fn, pre_fn=pre_fn,
+                post_fn=post_fn, axis=axis),
         mesh=mesh,
-        in_specs=(param_specs, mspec),
+        in_specs=(param_specs, end_specs(pre_params), end_specs(post_params),
+                  mspec),
         out_specs=mspec,
         check_vma=False,
     )
-    out = fn(stage_params, micro)
-    return out.reshape((B,) + out.shape[2:])
+    out = fn(stage_params, pre_params, post_params, micro)
+    return out.reshape((out.shape[0] * out.shape[1],) + out.shape[2:])
 
 
 def stack_stage_params(params_list):
@@ -164,34 +224,97 @@ def stack_stage_params(params_list):
 # all have this shape.
 
 
-def bubble_fraction(schedule: str, num_microbatches: int, num_stages: int) -> float:
-    """Fraction of stage-time idle; identical for gpipe and (non-interleaved)
-    1f1b: (S-1)/(M+S-1)."""
-    if schedule not in ("gpipe", "1f1b"):
-        raise ValueError(f"unknown schedule {schedule!r}")
-    M, S = num_microbatches, num_stages
-    return (S - 1) / (M + S - 1)
+def bubble_fraction(schedule: str, num_microbatches: int, num_stages: int,
+                    num_virtual: int = 1) -> float:
+    """Fraction of stage-time idle.
+
+    gpipe and non-interleaved 1f1b are identical: (S-1)/(M+S-1).
+    interleaved 1f1b with v virtual stages per device cuts the fill/drain
+    to (S-1)/(v*M + S-1) — each device's work grows v-fold (v chunk
+    computes per microbatch) while the pipeline fill stays S-1 ticks.
+    """
+    M, S, v = num_microbatches, num_stages, num_virtual
+    if schedule in ("gpipe", "1f1b"):
+        return (S - 1) / (M + S - 1)
+    if schedule == "interleaved":
+        return (S - 1) / (v * M + S - 1)
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def _interleaved_base(m: int, S: int, v: int) -> int:
+    """Tick at which microbatch m's first chunk is computed: microbatches
+    run in groups of S; group g starts at tick g*S*v (the device needs S*v
+    ticks to push a group through its v chunks)."""
+    return (m // S) * S * v + (m % S)
+
+
+def _simulate_interleaved(M: int, S: int, v: int) -> tuple[int, int]:
+    """Exact trace-time accounting of the interleaved schedule.
+
+    Chunk c of microbatch m runs forward at tick base(m)+c and backward at
+    tick base(m)+2(C-1)-c (C = S*v chunks).  Returns (buf_slots,
+    peak_total): the per-chunk circular-buffer depth the kernel needs (max
+    in-flight residuals of any single chunk — the in-flight set of a chunk
+    is a contiguous m-interval, so `m mod buf_slots` indexing is
+    collision-free), and the peak total residuals a device holds across its
+    v chunks (the memory figure peak_activation_microbatches reports).
+    """
+    C = S * v
+    ticks = M * v + 2 * C + S + 2
+    bases = [_interleaved_base(m, S, v) for m in range(M)]
+
+    def peak_of(chunks: list[int]) -> int:
+        # difference-array sweep: O(M·|chunks| + ticks), not a full
+        # per-tick scan (this runs at trace time on every step build)
+        delta = [0] * (ticks + 1)
+        for c in chunks:
+            for base in bases:
+                delta[base + c] += 1          # fwd tick, inclusive
+                delta[base + 2 * (C - 1) - c + 1] -= 1  # past bwd tick
+        peak = cur = 0
+        for x in delta:
+            cur += x
+            peak = max(peak, cur)
+        return peak
+
+    per_chunk_peak = max(peak_of([c]) for c in range(C))
+    device_peak = max(
+        peak_of([q * S + d for q in range(v)]) for d in range(S))
+    return per_chunk_peak, device_peak
 
 
 def peak_activation_microbatches(schedule: str, num_microbatches: int,
-                                 num_stages: int) -> int:
+                                 num_stages: int, num_virtual: int = 1) -> int:
     """Peak in-flight microbatch residuals a stage must hold — the metric
-    1f1b exists to bound: O(M) for gpipe, O(S) for 1f1b."""
-    M, S = num_microbatches, num_stages
+    1f1b exists to bound: O(M) for gpipe, O(S) for 1f1b.  Interleaving
+    trades some of that memory back (plus v× the comm volume) for the
+    smaller bubble; its peak is computed exactly from the schedule."""
+    M, S, v = num_microbatches, num_stages, num_virtual
     if schedule == "gpipe":
         return M
     if schedule == "1f1b":
         return min(M, 2 * S - 1)
+    if schedule == "interleaved":
+        return _simulate_interleaved(M, S, v)[1]
     raise ValueError(f"unknown schedule {schedule!r}")
 
 
-def _pipeline_1f1b_local(stage_params, microbatches, targets, *,
-                         stage_fn: Callable, loss_fn: Callable, axis: str,
-                         batch_axes):
+def _pipeline_1f1b_local(stage_params, pre_params, post_params,
+                         microbatches, targets, *,
+                         stage_fn: Callable, loss_fn, pre_fn, post_fn,
+                         axis: str, batch_axes):
     """Per-device 1F1B train tick-loop (under shard_map).
 
-    Returns (loss, param_grads) with loss replicated and grads in the
-    size-1-leading-stage-axis layout shard_map expects back.
+    Returns (loss, (stage_grads, pre_grads, post_grads)) with loss
+    replicated, stage grads in the size-1-leading-stage-axis layout
+    shard_map expects back, and end-stage grads psum'd over pp (stage 0 /
+    the last stage are the only contributors).
+
+    The per-microbatch loss is loss_fn(y, target) applied to the ring
+    output when the output end is homogeneous, or
+    post_fn(post_params, y, target) when heterogeneous (e.g. final norm +
+    LM head + cross entropy); either way the total loss is the mean over
+    microbatches — the decomposition 1F1B requires.
     """
     from k8s_tpu.parallel.collectives import ring_shift
 
@@ -201,20 +324,25 @@ def _pipeline_1f1b_local(stage_params, microbatches, targets, *,
     BUF = min(M, 2 * S - 1)
     params = jax.tree.map(lambda x: jnp.squeeze(x, 0), stage_params)
     inv_m = 1.0 / M
+    ingest = _make_ingest(pre_fn, s, microbatches)
+    act = _act_template(pre_fn, pre_params, microbatches[0])
 
     def tick(carry, t):
-        fwd_holding, bwd_holding, buf, gacc, loss_acc = carry
+        fwd_holding, bwd_holding, buf, gacc, pre_gacc, post_gacc, loss_acc = carry
 
         # ---- forward stream: stage s computes microbatch m_f = t - s ----
         m_f = t - s
         fwd_live = jnp.logical_and(m_f >= 0, m_f < M)
         m_f_c = jnp.clip(m_f, 0, M - 1)
-        x_in = jnp.where(s == 0, microbatches[m_f_c], fwd_holding)
+        x_in = ingest(pre_params, m_f_c, fwd_holding)
         y = stage_fn(params, x_in)
-        # stash this tick's stage input for the backward re-linearization
+        # stash this tick's RING input for the backward re-linearization
+        # (pre-ingest: stage 0's backward re-applies pre_fn from the raw
+        # microbatch so its cotangents reach pre_params)
         buf = lax.cond(
             fwd_live,
-            lambda b: lax.dynamic_update_index_in_dim(b, x_in, m_f_c % BUF, 0),
+            lambda b: lax.dynamic_update_index_in_dim(
+                b, fwd_holding, m_f_c % BUF, 0),
             lambda b: b,
             buf,
         )
@@ -225,23 +353,37 @@ def _pipeline_1f1b_local(stage_params, microbatches, targets, *,
         m_b_c = jnp.clip(m_b, 0, M - 1)
         x_saved = buf[m_b_c % BUF]
 
-        def stage_loss(p, x):
-            out = stage_fn(p, x)
-            mb_loss = loss_fn(out, targets[m_b_c])
+        def stage_loss(p, pre_p, post_p, x):
+            h = ingest(pre_p, m_b_c, x)
+            out = stage_fn(p, h)
+            if post_fn is None:
+                mb_loss = loss_fn(out, targets[m_b_c])
+            else:
+                # the loss head (norm + vocab projection) runs only on the
+                # last stage, via the cond
+                mb_loss = lax.cond(
+                    s == S - 1,
+                    lambda: post_fn(post_p, out, targets[m_b_c])
+                    .astype(jnp.float32),
+                    lambda: jnp.zeros((), jnp.float32),
+                )
             return out, mb_loss
 
-        (out_b, mb_loss), vjp = jax.vjp(stage_loss, params, x_saved)
+        (out_b, mb_loss), vjp = jax.vjp(
+            stage_loss, params, pre_params, post_params, x_saved)
         # last stage seeds the cotangent from the loss; upstream stages use
         # the cotangent that just arrived from the next stage
         is_last = s == S - 1
         d_out = jnp.where(is_last, jnp.zeros_like(out_b), bwd_holding)
         d_loss = jnp.where(is_last, inv_m, 0.0).astype(mb_loss.dtype)
-        dparams, dx = vjp((d_out, d_loss))
+        dparams, dpre, dpost, dx = vjp((d_out, d_loss))
 
         live_f = fwd_live.astype(jnp.float32)
         live_b = bwd_live.astype(jnp.float32)
-        gacc = jax.tree.map(
-            lambda g, d: g + live_b * d.astype(g.dtype), gacc, dparams)
+        acc = lambda g, d: g + live_b * d.astype(g.dtype)  # noqa: E731
+        gacc = jax.tree.map(acc, gacc, dparams)
+        pre_gacc = jax.tree.map(acc, pre_gacc, dpre)
+        post_gacc = jax.tree.map(acc, post_gacc, dpost)
         loss_acc = loss_acc + live_b * jnp.where(is_last, inv_m, 0.0) * (
             mb_loss.astype(loss_acc.dtype))
 
@@ -249,13 +391,17 @@ def _pipeline_1f1b_local(stage_params, microbatches, targets, *,
         fwd_holding = ring_shift(y * live_f.astype(y.dtype), axis)
         bwd_holding = ring_shift(dx * live_b.astype(dx.dtype), axis,
                                  reverse=True)
-        return (fwd_holding, bwd_holding, buf, gacc, loss_acc), None
+        return (fwd_holding, bwd_holding, buf, gacc, pre_gacc, post_gacc,
+                loss_acc), None
 
-    zero_act = jnp.zeros_like(microbatches[0])
+    zero_act = jnp.zeros(act.shape, act.dtype)
     buf0 = jnp.zeros((BUF,) + zero_act.shape, zero_act.dtype)
-    gacc0 = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), params)
-    carry0 = (zero_act, zero_act, buf0, gacc0, jnp.zeros((), jnp.float32))
-    (_, _, _, gacc, loss_acc), _ = lax.scan(
+    f32_zeros = lambda tree: jax.tree.map(  # noqa: E731
+        lambda x: jnp.zeros_like(x, jnp.float32), tree)
+    carry0 = (zero_act, zero_act, buf0, f32_zeros(params),
+              f32_zeros(pre_params), f32_zeros(post_params),
+              jnp.zeros((), jnp.float32))
+    (_, _, _, gacc, pre_gacc, post_gacc, loss_acc), _ = lax.scan(
         tick, carry0, jnp.arange(M + 2 * S - 2))
 
     # loss lives on the last stage only -> broadcast over pp, then average
@@ -266,48 +412,294 @@ def _pipeline_1f1b_local(stage_params, microbatches, targets, *,
     # shard; average over the batch axes, restore stage axis for shard_map
     gacc = jax.tree.map(lambda g: lax.pmean(g, batch_axes), gacc)
     gacc = jax.tree.map(lambda g, p: g.astype(p.dtype)[None], gacc, stage_params)
-    return loss, gacc
+    # end-stage grads: only stage 0 (pre) / the last stage (post)
+    # contributed non-zeros; psum over pp replicates the true value
+    end = lambda tree, ref: jax.tree.map(  # noqa: E731
+        lambda g, p: lax.pmean(lax.psum(g, axis), batch_axes).astype(p.dtype),
+        tree, ref)
+    return loss, (gacc, end(pre_gacc, pre_params), end(post_gacc, post_params))
 
 
 def pipeline_train_step_1f1b(mesh: Mesh, stage_fn: Callable, stage_params,
-                             batch, targets, loss_fn: Callable, *,
+                             batch, targets, loss_fn: Callable = None, *,
                              num_microbatches: int, axis: str = "pp",
-                             batch_axes=("dp", "fsdp")):
+                             batch_axes=("dp", "fsdp"),
+                             pre_fn: Callable | None = None, pre_params=None,
+                             post_fn: Callable | None = None, post_params=None):
     """Loss + parameter gradients under the 1F1B schedule.
 
-    stage_fn(params, x) -> y: one homogeneous stage.
+    stage_fn(params, x) -> y: one homogeneous ring stage.
     loss_fn(out_mb, target_mb) -> scalar: per-microbatch loss; the total is
       the mean over microbatches (the decomposition 1F1B requires).
     batch/targets: [B, ...] global, B divisible by num_microbatches.
-    Returns (loss, grads) with grads matching stage_params' stacked layout.
+    pre_fn(pre_params, mb) -> x: optional heterogeneous input stage
+      (embedding lookup) run on pp rank 0 only.
+    post_fn(post_params, y, target_mb) -> scalar: optional heterogeneous
+      loss head (final norm + LM head + loss) run on the last rank only;
+      replaces loss_fn.
+    Returns (loss, grads): grads matches stage_params' stacked layout when
+    no end stages are given, else (stage_grads, pre_grads, post_grads).
     """
-    B = batch.shape[0]
-    if B % num_microbatches:
-        raise ValueError(f"batch {B} not divisible into {num_microbatches} microbatches")
-    mb = B // num_microbatches
-    axes = batch_axes if isinstance(batch_axes, (tuple, list)) else (batch_axes,)
-    data_shards = 1
-    for a in axes:
-        data_shards *= mesh.shape[a]
-    if mb % data_shards:
-        raise ValueError(
-            f"microbatch size {mb} not divisible by data shards {data_shards} "
-            f"(axes {batch_axes}); use fewer microbatches or a bigger batch")
+    if (loss_fn is None) == (post_fn is None):
+        raise ValueError("exactly one of loss_fn / post_fn must be given")
+    mb, axes = _check_microbatching(mesh, batch, num_microbatches, batch_axes)
     micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
     tmicro = targets.reshape((num_microbatches, mb) + targets.shape[1:])
 
+    hetero = pre_fn is not None or post_fn is not None
+    if pre_params is None:
+        pre_params = ()
+    if post_params is None:
+        post_params = ()
     param_specs = jax.tree.map(lambda _: P(axis), stage_params)
-    mspec = P(None, tuple(axes))
+    end_specs = lambda tree: jax.tree.map(lambda _: P(), tree)  # noqa: E731
+    mspec = P(None, axes)
 
     fn = shard_map(
         partial(_pipeline_1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn,
-                axis=axis, batch_axes=tuple(axes)),
+                pre_fn=pre_fn, post_fn=post_fn, axis=axis, batch_axes=axes),
         mesh=mesh,
-        in_specs=(param_specs, mspec, mspec),
-        out_specs=(P(), param_specs),
+        in_specs=(param_specs, end_specs(pre_params), end_specs(post_params),
+                  mspec, mspec),
+        out_specs=(P(), (param_specs, end_specs(pre_params),
+                         end_specs(post_params))),
         check_vma=False,
     )
-    return fn(stage_params, micro, tmicro)
+    loss, (g_stage, g_pre, g_post) = fn(
+        stage_params, pre_params, post_params, micro, tmicro)
+    if not hetero:
+        return loss, g_stage
+    return loss, (g_stage, g_pre, g_post)
+
+
+def _pipeline_interleaved_local(chunk_params, pre_params, post_params,
+                                microbatches, targets, *,
+                                stage_fn: Callable, loss_fn, pre_fn, post_fn,
+                                S: int, v: int, buf_slots: int,
+                                axis: str, batch_axes):
+    """Per-device interleaved-1F1B tick loop (under shard_map).
+
+    Device d holds chunks {d, S+d, ..., (v-1)S+d} of the C = S*v-deep
+    virtual pipeline (sliced to local leading axis v, device-major order —
+    the wrapper pre-permutes).  Because chunk c lives on device c mod S,
+    every chunk→chunk handoff is one down-ring hop, so the dataflow is the
+    same two counter-rotating ppermute rings as non-interleaved 1F1B; only
+    the tick→(microbatch, chunk) maps change:
+
+      forward  of (m, c) at tick base(m) + c
+      backward of (m, c) at tick base(m) + 2(C-1) - c
+      base(m) = (m//S)*S*v + m%S     (microbatches ingested in groups of S)
+
+    Both maps are, per device, bijections over ticks (each device does at
+    most one forward and one backward chunk-compute per tick), and both
+    handoffs always take exactly one tick — the schedule property that
+    makes the (S-1)/(vM+S-1) bubble claim real.
+    """
+    from k8s_tpu.parallel.collectives import ring_shift
+
+    d = lax.axis_index(axis)
+    M = microbatches.shape[0]
+    C = S * v
+    Sv = S * v
+    inv_m = 1.0 / M
+    act = _act_template(pre_fn, pre_params, microbatches[0])
+
+    def sel_in(pre_p, idx, is_chunk0, x_ring):
+        """Chunk-0 ingest (pre_fn on the raw microbatch) vs ring input —
+        unlike non-interleaved, 'chunk 0' is device 0 only on its q==0
+        ticks, so the flag comes in precomputed."""
+        if pre_fn is None:
+            return jnp.where(is_chunk0, microbatches[idx], x_ring)
+        return lax.cond(
+            is_chunk0,
+            lambda: pre_fn(pre_p, microbatches[idx]).astype(x_ring.dtype),
+            lambda: x_ring,
+        )
+
+    def chunk(cp, q):
+        return jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(x, q, 0, keepdims=False), cp)
+
+    def tick(carry, t):
+        fwd_holding, bwd_holding, buf, gacc, pre_gacc, post_gacc, loss_acc = carry
+
+        # ---- forward: invert u = t - d = g*Sv + q*S + j ----
+        u = t - d
+        fwd_live = jnp.logical_and(u >= 0, u < M * v)
+        uc = jnp.clip(u, 0, M * v - 1)
+        q_f = (uc % Sv) // S
+        m_f = (uc // Sv) * S + uc % S
+        is_chunk0_f = jnp.logical_and(d == 0, q_f == 0)
+
+        x_ring = fwd_holding
+        x_in = sel_in(pre_params, m_f, is_chunk0_f, x_ring)
+        y = stage_fn(chunk(chunk_params, q_f), x_in)
+        # store the RING input (pre-ingest) for backward re-linearization
+        buf = lax.cond(
+            fwd_live,
+            lambda b: b.at[q_f, m_f % buf_slots].set(x_ring),
+            lambda b: b,
+            buf,
+        )
+
+        # ---- backward: invert r + (v-1)S = g*Sv + (v-1-q)*S + j ----
+        rv = t - 2 * (C - 1) + d + (v - 1) * S
+        bwd_live = jnp.logical_and(rv >= 0, rv < M * v)
+        rvc = jnp.clip(rv, 0, M * v - 1)
+        q_b = v - 1 - (rvc % Sv) // S
+        m_b = (rvc // Sv) * S + rvc % S
+        is_chunk0_b = jnp.logical_and(d == 0, q_b == 0)
+        is_last_b = jnp.logical_and(d == S - 1, q_b == v - 1)
+        x_saved = buf[q_b, m_b % buf_slots]
+
+        def chunk_loss(cp, pre_p, post_p, x):
+            h = sel_in(pre_p, m_b, is_chunk0_b, x)
+            out = stage_fn(chunk(cp, q_b), h)
+            if post_fn is None:
+                mb_loss = loss_fn(out, targets[m_b]).astype(jnp.float32)
+            else:
+                mb_loss = lax.cond(
+                    is_last_b,
+                    lambda: post_fn(post_p, out, targets[m_b])
+                    .astype(jnp.float32),
+                    lambda: jnp.zeros((), jnp.float32),
+                )
+            return out, mb_loss
+
+        (out_b, mb_loss), vjp = jax.vjp(
+            chunk_loss, chunk_params, pre_params, post_params, x_saved)
+        d_out = jnp.where(is_last_b, jnp.zeros_like(out_b), bwd_holding)
+        d_loss = jnp.where(is_last_b, inv_m, 0.0).astype(mb_loss.dtype)
+        dchunks, dpre, dpost, dx = vjp((d_out, d_loss))
+
+        live_f = fwd_live.astype(jnp.float32)
+        live_b = bwd_live.astype(jnp.float32)
+        acc = lambda g, dd: g + live_b * dd.astype(g.dtype)  # noqa: E731
+        # dchunks already has the full [v, ...] leading axis (the vjp saw
+        # the dynamic_index), zero except chunk q_b
+        gacc = jax.tree.map(acc, gacc, dchunks)
+        pre_gacc = jax.tree.map(acc, pre_gacc, dpre)
+        post_gacc = jax.tree.map(acc, post_gacc, dpost)
+        loss_acc = loss_acc + live_b * jnp.where(is_last_b, inv_m, 0.0) * (
+            mb_loss.astype(loss_acc.dtype))
+
+        fwd_holding = ring_shift(y * live_f.astype(y.dtype), axis)
+        bwd_holding = ring_shift(dx * live_b.astype(dx.dtype), axis,
+                                 reverse=True)
+        return (fwd_holding, bwd_holding, buf, gacc, pre_gacc, post_gacc,
+                loss_acc), None
+
+    zero_act = jnp.zeros(act.shape, act.dtype)
+    buf0 = jnp.zeros((v, buf_slots) + zero_act.shape, zero_act.dtype)
+    f32_zeros = lambda tree: jax.tree.map(  # noqa: E731
+        lambda x: jnp.zeros_like(x, jnp.float32), tree)
+    carry0 = (zero_act, zero_act, buf0, f32_zeros(chunk_params),
+              f32_zeros(pre_params), f32_zeros(post_params),
+              jnp.zeros((), jnp.float32))
+    total_ticks = M * v + Sv + S - 2
+    (_, _, _, gacc, pre_gacc, post_gacc, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(total_ticks))
+
+    loss = lax.pmean(lax.psum(loss_acc, axis), batch_axes)
+    gacc = jax.tree.map(lambda g: lax.pmean(g, batch_axes), gacc)
+    gacc = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, chunk_params)
+    end = lambda tree, ref: jax.tree.map(  # noqa: E731
+        lambda g, p: lax.pmean(lax.psum(g, axis), batch_axes).astype(p.dtype),
+        tree, ref)
+    return loss, (gacc, end(pre_gacc, pre_params), end(post_gacc, post_params))
+
+
+def pipeline_train_step_interleaved(
+        mesh: Mesh, stage_fn: Callable, chunk_params, batch, targets,
+        loss_fn: Callable = None, *, num_microbatches: int, num_virtual: int,
+        axis: str = "pp", batch_axes=("dp", "fsdp"),
+        pre_fn: Callable | None = None, pre_params=None,
+        post_fn: Callable | None = None, post_params=None,
+        device_major: bool = False):
+    """Loss + gradients under the interleaved 1F1B schedule.
+
+    chunk_params: pytree with leading axis C = |pp| * num_virtual, in
+      natural chunk order (chunk c is the c-th slice of the model); chunk c
+      is placed on device c mod |pp| (the round-robin layout that shrinks
+      the bubble to (S-1)/(vM+S-1) at the cost of v× the ring traffic).
+    num_microbatches must be a multiple of |pp| (microbatches are ingested
+      in groups of S).
+    loss_fn / pre_fn / post_fn: as in pipeline_train_step_1f1b.
+    device_major: chunk_params (and the returned grads) are already in the
+      round-robin device-major layout (interleave_chunks).  Long-lived
+      train states should use this: natural order under a P(axis) sharding
+      makes every step re-gather (v-1)/v of the weights across the ring.
+    Returns (loss, grads) — grads in chunk order matching chunk_params when
+    homogeneous, else (chunk_grads, pre_grads, post_grads).
+    """
+    if (loss_fn is None) == (post_fn is None):
+        raise ValueError("exactly one of loss_fn / post_fn must be given")
+    S = mesh.shape[axis]
+    v = num_virtual
+    if v < 1:
+        raise ValueError(f"num_virtual must be >= 1, got {v}")
+    if num_microbatches % S:
+        raise ValueError(
+            f"interleaved schedule ingests microbatches in groups of "
+            f"{S} (=|{axis}|); {num_microbatches} is not a multiple")
+    leading = {x.shape[0] for x in jax.tree.leaves(chunk_params)}
+    if leading != {S * v}:
+        raise ValueError(
+            f"chunk_params leading axis must be S*v={S * v}, got {leading}")
+    mb, axes = _check_microbatching(mesh, batch, num_microbatches, batch_axes)
+    micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
+    tmicro = targets.reshape((num_microbatches, mb) + targets.shape[1:])
+
+    buf_slots, _ = _simulate_interleaved(num_microbatches, S, v)
+
+    if device_major:
+        permuted = chunk_params
+    else:
+        # device-major permutation: device d's contiguous shard_map slice
+        # [d*v:(d+1)*v] must hold chunks d, S+d, ..., (v-1)S+d
+        permuted = interleave_chunks(chunk_params, S, v)
+
+    hetero = pre_fn is not None or post_fn is not None
+    if pre_params is None:
+        pre_params = ()
+    if post_params is None:
+        post_params = ()
+    param_specs = jax.tree.map(lambda _: P(axis), permuted)
+    end_specs = lambda tree: jax.tree.map(lambda _: P(), tree)  # noqa: E731
+    mspec = P(None, axes)
+
+    fn = shard_map(
+        partial(_pipeline_interleaved_local, stage_fn=stage_fn,
+                loss_fn=loss_fn, pre_fn=pre_fn, post_fn=post_fn,
+                S=S, v=v, buf_slots=buf_slots, axis=axis, batch_axes=axes),
+        mesh=mesh,
+        in_specs=(param_specs, end_specs(pre_params), end_specs(post_params),
+                  mspec, mspec),
+        out_specs=(P(), (param_specs, end_specs(pre_params),
+                         end_specs(post_params))),
+        check_vma=False,
+    )
+    loss, (g_chunks, g_pre, g_post) = fn(
+        permuted, pre_params, post_params, micro, tmicro)
+    if not device_major:
+        g_chunks = interleave_chunks(g_chunks, S, v, inverse=True)
+    if not hetero:
+        return loss, g_chunks
+    return loss, (g_chunks, g_pre, g_post)
+
+
+def interleave_chunks(chunk_params, num_stages: int, num_virtual: int,
+                      inverse: bool = False):
+    """Natural chunk order <-> device-major round-robin layout (chunk c on
+    device c mod S): the layout a long-lived interleaved train state should
+    be stored in so the step's P(axis) slicing needs no per-step gather."""
+    import numpy as np
+
+    S, v = num_stages, num_virtual
+    perm = np.array([q * S + d for d in range(S) for q in range(v)])
+    if inverse:
+        perm = np.argsort(perm)
+    return jax.tree.map(lambda x: x[perm], chunk_params)
 
 
 def stage_sharding(mesh: Mesh, stage_params, axis: str = "pp"):
