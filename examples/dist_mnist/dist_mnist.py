@@ -27,6 +27,10 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--train_steps", type=int, default=200)
     p.add_argument("--batch_size", type=int, default=64, help="global batch size")
+    p.add_argument("--data_dir", default="",
+                   help="MNIST-layout directory (gzipped IDX files, the "
+                   "reference's input_data contract); empty uses synthetic "
+                   "data")
     p.add_argument("--learning_rate", type=float, default=1e-3)
     p.add_argument("--train_dir", default=os.environ.get("CHECKPOINT_DIR", ""),
                    help="checkpoint dir; empty disables checkpointing")
@@ -105,9 +109,15 @@ def main(argv=None) -> int:
     # same host→HBM path the reference's feed_dict/input_data loop takes
     # (test/e2e/dist-mnist/dist_mnist.py:120-138), but staged ahead of the
     # step so the TPU never waits on the transfer.
-    rng = np.random.default_rng(0)
-    ds_x = rng.normal(size=(64 * args.batch_size, 28, 28, 1)).astype(np.float32)
-    ds_y = rng.integers(0, 10, size=(64 * args.batch_size,)).astype(np.int32)
+    if args.data_dir:
+        from k8s_tpu.models.mnist_data import load_dataset
+
+        ds_x, ds_y = load_dataset(args.data_dir)
+        log.info("loaded %d real images from %s", len(ds_x), args.data_dir)
+    else:
+        rng = np.random.default_rng(0)
+        ds_x = rng.normal(size=(64 * args.batch_size, 28, 28, 1)).astype(np.float32)
+        ds_y = rng.integers(0, 10, size=(64 * args.batch_size,)).astype(np.int32)
     data_iter = data_lib.prefetch_to_mesh(
         data_lib.array_batches((ds_x, ds_y), args.batch_size, seed=start_step),
         mesh,

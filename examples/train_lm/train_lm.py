@@ -56,6 +56,10 @@ def parse_args(argv=None):
                    help="pp microbatches per step (0: auto = 2*pp)")
     p.add_argument("--remat", action="store_true",
                    help="checkpoint each layer (HBM for FLOPs)")
+    p.add_argument("--data_dir", default="",
+                   help="token-shard directory (models.dataset format: "
+                   "checksummed .npy shards + MANIFEST.json); empty uses "
+                   "a synthetic corpus")
     p.add_argument("--train_dir", default=os.environ.get("CHECKPOINT_DIR", ""),
                    help="checkpoint dir; empty disables checkpointing")
     p.add_argument("--checkpoint_every", type=int, default=100)
@@ -85,6 +89,10 @@ def build_config(args, on_tpu: bool):
         raise SystemExit("--pp composes with flash attention, not the sp "
                          "ring (collectives can't nest inside the pp "
                          "shard_map); use --sp 1 with --pp")
+    if args.pp > 1 and args.tp > 1:
+        raise SystemExit("--tp does nothing under --pp yet (stage compute "
+                         "is replicated over tp inside the pp shard_map, "
+                         "wasting those devices); use --tp 1 with --pp")
     return dataclasses.replace(
         cfg,
         max_seq_len=max(cfg.max_seq_len, args.seq_len),
@@ -138,14 +146,27 @@ def main(argv=None) -> int:
     optimizer = train_lib.default_optimizer(
         args.learning_rate, weight_decay=args.weight_decay)
 
-    corpus = synthetic_corpus(
-        cfg.vocab_size, 64 * args.batch_size * args.seq_len, args.seq_len,
-        seed=1)
-    data_iter = data_lib.prefetch_to_mesh(
-        ((b, b) for (b,) in data_lib.array_batches(
-            (corpus,), args.batch_size, seed=0)),
-        mesh,
-    )
+    if args.data_dir:
+        from k8s_tpu.models.dataset import TokenDataset
+
+        ds = TokenDataset(args.data_dir)
+        if not ds.vocab_size or ds.vocab_size > cfg.vocab_size:
+            # a missing/zero vocab_size must not pass the guard: ids beyond
+            # the model vocab would clamp silently in the embedding gather
+            raise SystemExit(
+                f"dataset vocab {ds.vocab_size or 'unknown'} missing or "
+                f"exceeds model vocab {cfg.vocab_size}")
+        log.info("token dataset: %d tokens, %d windows of %d",
+                 ds.total_tokens, ds.num_sequences(args.seq_len),
+                 args.seq_len)
+        batches = ds.batches(args.batch_size, args.seq_len, seed=0)
+    else:
+        corpus = synthetic_corpus(
+            cfg.vocab_size, 64 * args.batch_size * args.seq_len,
+            args.seq_len, seed=1)
+        batches = ((b, b) for (b,) in data_lib.array_batches(
+            (corpus,), args.batch_size, seed=0))
+    data_iter = data_lib.prefetch_to_mesh(batches, mesh)
 
     step_fn = None
     shardings = None
@@ -172,6 +193,7 @@ def main(argv=None) -> int:
         # over the full tree first would transiently double moment memory
         state = train_lib.init_state(
             pp_lm.split_lm_params(params, args.pp, vp), optimizer)
+        del params  # split copied the stacked layers; drop the duplicate
         shardings = pp_lm.pp_state_shardings(state, mesh, num_virtual=vp)
         step_fn = pp_lm.make_pp_train_step(
             cfg, optimizer, mesh, num_stages=args.pp,

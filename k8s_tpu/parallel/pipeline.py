@@ -48,20 +48,23 @@ def _act_template(pre_fn, pre_params, mb0):
     return jax.eval_shape(pre_fn, pre_params, mb0)
 
 
-def _make_ingest(pre_fn, s, microbatches):
-    """Stage-0 input selection, shared by forward and re-linearization.
+def _make_ingest(pre_fn, microbatches):
+    """First-stage input selection, shared by forward and re-linearization
+    in both the plain and interleaved schedules.
 
-    Returns ingest(pre_p, idx, x_ring): the stage input for microbatch
-    ``idx`` — pre_fn applied to the raw microbatch on stage 0 (under a
-    lax.cond so only stage 0 pays for it), the ring activation elsewhere.
+    Returns ingest(pre_p, idx, x_ring, is_first): the stage input for
+    microbatch ``idx`` — pre_fn applied to the raw microbatch when
+    ``is_first`` (non-interleaved: s == 0; interleaved: device 0 on its
+    chunk-0 ticks), under a lax.cond so only that rank pays for it; the
+    ring activation otherwise.
     """
     if pre_fn is None:
-        return lambda _pre_p, idx, x_ring: jnp.where(
-            s == 0, microbatches[idx], x_ring)
+        return lambda _pre_p, idx, x_ring, is_first: jnp.where(
+            is_first, microbatches[idx], x_ring)
 
-    def ingest(pre_p, idx, x_ring):
+    def ingest(pre_p, idx, x_ring, is_first):
         return lax.cond(
-            s == 0,
+            is_first,
             lambda: pre_fn(pre_p, microbatches[idx]).astype(x_ring.dtype),
             lambda: x_ring,
         )
@@ -88,7 +91,7 @@ def _pipeline_local(stage_params, pre_params, post_params, microbatches, *,
     s = lax.axis_index(axis)
     M = microbatches.shape[0]
     params = jax.tree.map(lambda x: jnp.squeeze(x, 0), stage_params)
-    ingest = _make_ingest(pre_fn, s, microbatches)
+    ingest = _make_ingest(pre_fn, microbatches)
 
     act = _act_template(pre_fn, pre_params, microbatches[0])
     if post_fn is None:
@@ -101,7 +104,7 @@ def _pipeline_local(stage_params, pre_params, post_params, microbatches, *,
         holding, outputs = carry
         # stage 0 ingests microbatch t (while t < M); others use what they
         # received last tick
-        x = ingest(pre_params, jnp.minimum(t, M - 1), holding)
+        x = ingest(pre_params, jnp.minimum(t, M - 1), holding, s == 0)
         y = stage_fn(params, x)
         # the last stage's result at tick t is finished microbatch t-(S-1)
         out_idx = t - (S - 1)
@@ -324,7 +327,7 @@ def _pipeline_1f1b_local(stage_params, pre_params, post_params,
     BUF = min(M, 2 * S - 1)
     params = jax.tree.map(lambda x: jnp.squeeze(x, 0), stage_params)
     inv_m = 1.0 / M
-    ingest = _make_ingest(pre_fn, s, microbatches)
+    ingest = _make_ingest(pre_fn, microbatches)
     act = _act_template(pre_fn, pre_params, microbatches[0])
 
     def tick(carry, t):
@@ -334,7 +337,7 @@ def _pipeline_1f1b_local(stage_params, pre_params, post_params,
         m_f = t - s
         fwd_live = jnp.logical_and(m_f >= 0, m_f < M)
         m_f_c = jnp.clip(m_f, 0, M - 1)
-        x_in = ingest(pre_params, m_f_c, fwd_holding)
+        x_in = ingest(pre_params, m_f_c, fwd_holding, s == 0)
         y = stage_fn(params, x_in)
         # stash this tick's RING input for the backward re-linearization
         # (pre-ingest: stage 0's backward re-applies pre_fn from the raw
@@ -354,7 +357,7 @@ def _pipeline_1f1b_local(stage_params, pre_params, post_params,
         x_saved = buf[m_b_c % BUF]
 
         def stage_loss(p, pre_p, post_p, x):
-            h = ingest(pre_p, m_b_c, x)
+            h = ingest(pre_p, m_b_c, x, s == 0)
             out = stage_fn(p, h)
             if post_fn is None:
                 mb_loss = loss_fn(out, targets[m_b_c])
@@ -503,18 +506,7 @@ def _pipeline_interleaved_local(chunk_params, pre_params, post_params,
     Sv = S * v
     inv_m = 1.0 / M
     act = _act_template(pre_fn, pre_params, microbatches[0])
-
-    def sel_in(pre_p, idx, is_chunk0, x_ring):
-        """Chunk-0 ingest (pre_fn on the raw microbatch) vs ring input —
-        unlike non-interleaved, 'chunk 0' is device 0 only on its q==0
-        ticks, so the flag comes in precomputed."""
-        if pre_fn is None:
-            return jnp.where(is_chunk0, microbatches[idx], x_ring)
-        return lax.cond(
-            is_chunk0,
-            lambda: pre_fn(pre_p, microbatches[idx]).astype(x_ring.dtype),
-            lambda: x_ring,
-        )
+    ingest = _make_ingest(pre_fn, microbatches)
 
     def chunk(cp, q):
         return jax.tree.map(
@@ -532,7 +524,7 @@ def _pipeline_interleaved_local(chunk_params, pre_params, post_params,
         is_chunk0_f = jnp.logical_and(d == 0, q_f == 0)
 
         x_ring = fwd_holding
-        x_in = sel_in(pre_params, m_f, is_chunk0_f, x_ring)
+        x_in = ingest(pre_params, m_f, x_ring, is_chunk0_f)
         y = stage_fn(chunk(chunk_params, q_f), x_in)
         # store the RING input (pre-ingest) for backward re-linearization
         buf = lax.cond(
@@ -553,7 +545,7 @@ def _pipeline_interleaved_local(chunk_params, pre_params, post_params,
         x_saved = buf[q_b, m_b % buf_slots]
 
         def chunk_loss(cp, pre_p, post_p, x):
-            h = sel_in(pre_p, m_b, is_chunk0_b, x)
+            h = ingest(pre_p, m_b, x, is_chunk0_b)
             out = stage_fn(chunk(cp, q_b), h)
             if post_fn is None:
                 mb_loss = loss_fn(out, targets[m_b]).astype(jnp.float32)
