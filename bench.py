@@ -180,10 +180,15 @@ def cost_analysis_flops(compiled) -> float | None:
     return None
 
 
-def _time_steps(run_step, state, iters: int, warmup: int):
-    """Time `iters` dependent steps; sync via scalar fetch (a host fetch of
-    the loss cannot complete before the whole chain executes — plain
-    block_until_ready is not a reliable barrier over the remote relay).
+def _time_steps(run_step, state, iters: int, warmup: int, repeats: int = 1):
+    """Time ``repeats`` independent repetitions of ``iters`` dependent steps;
+    returns a list of per-repetition elapsed seconds.
+
+    Sync is via scalar fetch (a host fetch of the loss cannot complete before
+    the whole chain executes — plain block_until_ready is not a reliable
+    barrier over the remote relay).  Warmup runs once; each repetition then
+    times a fresh chain, so the caller can report median + spread instead of
+    a single sample that a relay hiccup can bias either way.
 
     The compiled step donates its state buffers, so the caller's ``state``
     must stay intact for with_retries to re-enter this function after a
@@ -198,14 +203,34 @@ def _time_steps(run_step, state, iters: int, warmup: int):
         state, loss = run_step(state)
     if warmup:
         _ = float(loss)
-    start = time.perf_counter()
-    for _ in range(iters):
-        state, loss = run_step(state)
-    _ = float(loss)
-    return time.perf_counter() - start
+    times = []
+    for _ in range(max(1, repeats)):
+        start = time.perf_counter()
+        for _ in range(iters):
+            state, loss = run_step(state)
+        _ = float(loss)
+        times.append(time.perf_counter() - start)
+    return times
 
 
-def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5):
+def _median(xs):
+    import statistics
+
+    return statistics.median(xs)
+
+
+def _stdev(xs):
+    import statistics
+
+    return statistics.stdev(xs) if len(xs) > 1 else 0.0
+
+
+def _repeats_default() -> int:
+    return int(os.environ.get("BENCH_REPEATS", "5"))
+
+
+def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5,
+                   stem: str | None = None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -216,7 +241,12 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5):
     n_chips = len(jax.devices())
     batch = batch_per_chip * n_chips
 
-    model = resnet50(dtype=jnp.bfloat16)
+    if stem is None:
+        stem = os.environ.get("BENCH_RESNET_STEM", "s2d")
+    if stem not in ("conv", "s2d"):
+        raise ValueError(f"unknown BENCH_RESNET_STEM {stem!r} "
+                         "(expected 'conv' or 's2d')")
+    model = resnet50(dtype=jnp.bfloat16, stem=stem)
     key = jax.random.PRNGKey(0)
     images = jax.random.normal(key, (batch, 224, 224, 3), jnp.bfloat16)
     labels = jax.random.randint(key, (batch,), 0, 1000)
@@ -271,13 +301,20 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5):
         )
         return (params, batch_stats, opt_state), loss
 
-    elapsed = with_retries(
-        lambda: _time_steps(run_step, (params, batch_stats, opt_state), iters, warmup),
+    times = with_retries(
+        lambda: _time_steps(
+            run_step, (params, batch_stats, opt_state), iters, warmup,
+            repeats=_repeats_default(),
+        ),
         what="resnet timing",
     )
-    images_per_sec = batch * iters / elapsed
+    elapsed = _median(times)
+    rates = [batch * iters / t / n_chips for t in times]
     return {
-        "images_per_sec_per_chip": images_per_sec / n_chips,
+        "stem": stem,
+        "images_per_sec_per_chip": _median(rates),
+        "images_per_sec_per_chip_std": _stdev(rates),
+        "repeats": len(times),
         "flops_per_step": flops,
         "xla_flops_per_step": xla_flops,
         "flops_per_sec_per_chip": flops * iters / elapsed / n_chips,
@@ -286,8 +323,15 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5):
 
 
 def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
-                      iters: int = 30, warmup: int = 5):
-    """GPT-2-small-shaped causal LM train step with Pallas flash attention."""
+                      iters: int = 30, warmup: int = 5,
+                      use_flash: bool | None = None,
+                      repeats: int | None = None):
+    """GPT-2-small-shaped causal LM train step.
+
+    ``use_flash=None`` selects the Pallas flash-attention kernel on TPU and
+    plain XLA attention elsewhere; passing False forces the XLA-attention
+    control so a single bench run can capture both numbers in the artifact.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -298,10 +342,12 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
     batch = batch_per_chip * n_chips
 
     on_tpu = jax.default_backend() == "tpu"
+    if use_flash is None:
+        use_flash = on_tpu  # Pallas kernel is TPU-only
     cfg = TransformerConfig(
         vocab_size=32000, hidden=768, ffn_hidden=3072, layers=12, heads=12,
         kv_heads=12, max_seq_len=seq, dtype=jnp.bfloat16, remat=False,
-        use_flash_attention=on_tpu,  # Pallas kernel is TPU-only
+        use_flash_attention=use_flash,
     )
     model = Transformer(cfg)
     tokens = jax.random.randint(
@@ -341,13 +387,19 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
         params, opt_state, loss = step_c(params, opt_state, tokens)
         return (params, opt_state), loss
 
-    elapsed = with_retries(
-        lambda: _time_steps(run_step, (params, opt_state), iters, warmup),
+    times = with_retries(
+        lambda: _time_steps(
+            run_step, (params, opt_state), iters, warmup,
+            repeats=_repeats_default() if repeats is None else repeats,
+        ),
         what="transformer timing",
     )
-    tokens_per_sec = batch * seq * iters / elapsed
+    elapsed = _median(times)
+    rates = [batch * seq * iters / t / n_chips for t in times]
     return {
-        "tokens_per_sec_per_chip": tokens_per_sec / n_chips,
+        "tokens_per_sec_per_chip": _median(rates),
+        "tokens_per_sec_per_chip_std": _stdev(rates),
+        "repeats": len(times),
         "flops_per_step": flops,
         "xla_flops_per_step": xla_flops,
         "flops_per_sec_per_chip": flops * iters / elapsed / n_chips,
@@ -398,7 +450,17 @@ def main() -> int:
         tf_kw = dict(batch_per_chip=1, seq=128, iters=2, warmup=1)
 
     resnet = bench_resnet50(**rn_kw) if only in ("", "resnet") else None
-    transformer = bench_transformer(**tf_kw) if only in ("", "transformer") else None
+    transformer = None
+    transformer_control = None
+    if only in ("", "transformer"):
+        transformer = bench_transformer(**tf_kw)
+        if transformer["flash_attention"] and not os.environ.get("BENCH_NO_CONTROL"):
+            # XLA-attention control: same model/shapes, flash off, fewer
+            # repeats — it exists to anchor the flash speedup in the
+            # artifact, not to be a precision measurement of the slow path.
+            transformer_control = bench_transformer(
+                **{**tf_kw, "use_flash": False, "repeats": 3}
+            )
 
     baseline = {}
     if os.path.exists(BASELINE_FILE):
@@ -421,6 +483,9 @@ def main() -> int:
         base = baseline.get("resnet50_images_per_sec_per_chip")
         if base:
             out["vs_baseline"] = round(out["value"] / base, 4)
+        out["resnet50_std"] = round(resnet["images_per_sec_per_chip_std"], 2)
+        out["resnet50_stem"] = resnet["stem"]
+        out["repeats"] = resnet["repeats"]
         out["resnet50_step_time_ms"] = round(resnet["step_time_ms"], 2)
         out["resnet50_flops_per_step"] = resnet["flops_per_step"]
         if peak:
@@ -429,9 +494,21 @@ def main() -> int:
         out["transformer_tokens_per_sec_per_chip"] = round(
             transformer["tokens_per_sec_per_chip"], 1
         )
+        out["transformer_std"] = round(
+            transformer["tokens_per_sec_per_chip_std"], 1
+        )
         out["transformer_step_time_ms"] = round(transformer["step_time_ms"], 2)
         out["transformer_n_params"] = transformer["n_params"]
         out["transformer_flash_attention"] = transformer["flash_attention"]
+        if transformer_control:
+            out["transformer_xla_attention_tokens_per_sec"] = round(
+                transformer_control["tokens_per_sec_per_chip"], 1
+            )
+            out["flash_attention_speedup"] = round(
+                transformer["tokens_per_sec_per_chip"]
+                / transformer_control["tokens_per_sec_per_chip"],
+                4,
+            )
         base = baseline.get("transformer_tokens_per_sec_per_chip")
         if base:
             out["transformer_vs_baseline"] = round(

@@ -29,6 +29,13 @@ def invalid(message: str = "") -> ApiError:
     return ApiError(422, "Invalid", message)
 
 
+def expired(message: str = "") -> ApiError:
+    """410 Gone: a watch resourceVersion older than the server's retained
+    event window.  Clients must relist and re-watch from the fresh list's
+    resourceVersion (the client-go reflector's 410 path)."""
+    return ApiError(410, "Expired", message)
+
+
 def is_not_found(err: Exception) -> bool:
     return isinstance(err, ApiError) and err.code == 404
 
@@ -39,3 +46,7 @@ def is_already_exists(err: Exception) -> bool:
 
 def is_conflict(err: Exception) -> bool:
     return isinstance(err, ApiError) and err.reason == "Conflict"
+
+
+def is_expired(err: Exception) -> bool:
+    return isinstance(err, ApiError) and err.code == 410

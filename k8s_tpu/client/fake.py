@@ -84,13 +84,32 @@ class FakeCluster:
     """Thread-safe in-memory cluster state implementing the API backend
     protocol consumed by ``k8s_tpu.client.clientset.Clientset``."""
 
+    # Events retained per resource for resourceVersion-resumed watches; a
+    # resume older than the window gets 410 Expired (etcd's compaction
+    # analogue — small enough that tests can actually hit the 410 path).
+    EVENT_HISTORY_LIMIT = 2048
+
     def __init__(self):
         self._lock = threading.RLock()
         self._store: dict[tuple[str, str], dict[tuple[str, str], dict]] = {}
         self._watches: dict[tuple[str, str], list[_Watch]] = {}
         self._uid_counter = itertools.count(1)
-        self._rv_counter = itertools.count(1)
+        self._rv = 0
+        # per-resource event log [(rv, type, obj)] + highest rv trimmed out
+        self._events: dict[tuple[str, str], list[tuple[int, str, dict]]] = {}
+        self._events_trimmed: dict[tuple[str, str], int] = {}
         self.actions: list[Action] = []
+
+    def _next_rv(self) -> int:
+        with self._lock:
+            self._rv += 1
+            return self._rv
+
+    def latest_rv(self) -> int:
+        """The cluster-wide resourceVersion high-water mark (etcd revision
+        analogue) — what a List response advertises for watch resumption."""
+        with self._lock:
+            return self._rv
 
     # -- helpers -------------------------------------------------------------
 
@@ -105,7 +124,25 @@ class FakeCluster:
         self.actions.append(Action(verb, resource.plural, namespace or "", name, obj))
 
     def _notify(self, resource: GVR, event_type: str, obj: dict) -> None:
-        for w in list(self._watches.get(self._key(resource), [])):
+        key = self._key(resource)
+        try:
+            rv = int((obj.get("metadata") or {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            rv = 0
+        import copy as _copy
+
+        hist = self._events.setdefault(key, [])
+        # private copy: live watchers receive ``obj`` itself, and a consumer
+        # mutating its event must not corrupt what a later rv-resumed watch
+        # replays
+        hist.append((rv, event_type, _copy.deepcopy(obj)))
+        if len(hist) > self.EVENT_HISTORY_LIMIT:
+            overflow = len(hist) - self.EVENT_HISTORY_LIMIT
+            self._events_trimmed[key] = max(
+                self._events_trimmed.get(key, 0), hist[overflow - 1][0]
+            )
+            del hist[:overflow]
+        for w in list(self._watches.get(key, [])):
             w._emit(event_type, obj)
 
     def _remove_watch(self, key, w) -> None:
@@ -141,7 +178,7 @@ class FakeCluster:
             if (ns, name) in bucket:
                 raise errors.already_exists(f"{resource.plural} {ns}/{name} already exists")
             meta.setdefault("uid", f"uid-{next(self._uid_counter)}")
-            meta["resourceVersion"] = str(next(self._rv_counter))
+            meta["resourceVersion"] = str(self._next_rv())
             meta.setdefault("creationTimestamp", now_rfc3339())
             obj.setdefault("apiVersion", resource.api_version)
             obj.setdefault("kind", resource.kind)
@@ -219,7 +256,7 @@ class FakeCluster:
             stored["metadata"]["creationTimestamp"] = current["metadata"].get(
                 "creationTimestamp", ""
             )
-            stored["metadata"]["resourceVersion"] = str(next(self._rv_counter))
+            stored["metadata"]["resourceVersion"] = str(self._next_rv())
             bucket[(ns, name)] = stored
             self._record("update", resource, ns, name, _copy.deepcopy(stored))
             self._notify(resource, MODIFIED, _copy.deepcopy(stored))
@@ -258,6 +295,9 @@ class FakeCluster:
             self._record("delete", resource, ns, name)
             if obj is None:
                 raise errors.not_found(f"{resource.plural} {ns}/{name} not found")
+            # deletion is a state change: the DELETED event gets its own rv
+            # (as in etcd) so rv-resumed watches can order it correctly
+            obj["metadata"]["resourceVersion"] = str(self._next_rv())
             self._notify(resource, DELETED, obj)
             if propagation in ("Background", "Foreground"):
                 self._gc_dependents(obj["metadata"].get("uid"), ns)
@@ -296,10 +336,45 @@ class FakeCluster:
 
     # -- watch ---------------------------------------------------------------
 
-    def watch(self, resource: GVR, namespace: Optional[str] = None) -> _Watch:
+    def list_with_rv(
+        self,
+        resource: GVR,
+        namespace: Optional[str] = None,
+        label_selector=None,
+        field_selector: Optional[dict] = None,
+    ) -> tuple[list[dict], int]:
+        """List plus the collection resourceVersion to resume a watch from —
+        the ListMeta.resourceVersion contract real apiservers provide."""
         with self._lock:
-            w = _Watch(self, self._key(resource), namespace)
-            self._watches.setdefault(self._key(resource), []).append(w)
+            items = self.list(resource, namespace, label_selector, field_selector)
+            return items, self.latest_rv()
+
+    def watch(
+        self,
+        resource: GVR,
+        namespace: Optional[str] = None,
+        resource_version: Optional[int] = None,
+    ) -> _Watch:
+        """Open a watch.  With ``resource_version``, replay retained events
+        with rv > resource_version before going live (atomically, under the
+        cluster lock, so no event is missed or duplicated).  A resume older
+        than the retained window raises 410 Expired."""
+        with self._lock:
+            key = self._key(resource)
+            w = _Watch(self, key, namespace)
+            if resource_version is not None:
+                if resource_version < self._events_trimmed.get(key, 0):
+                    raise errors.expired(
+                        f"resourceVersion {resource_version} is too old "
+                        f"(retained history starts after "
+                        f"{self._events_trimmed.get(key, 0)})"
+                    )
+                import copy as _copy
+
+                for rv, event_type, obj in self._events.get(key, []):
+                    if rv > resource_version:
+                        w._emit(event_type, _copy.deepcopy(obj))
+            self._watches.setdefault(key, []).append(w)
             return w
 
     # -- test conveniences ---------------------------------------------------

@@ -131,55 +131,89 @@ class SharedInformer:
                 except Exception:
                     pass
 
+    def _relist(self) -> Optional[int]:
+        """Full list + cache diff; returns the collection resourceVersion to
+        resume the watch from (None if the backend can't provide one)."""
+        rv: Optional[int] = None
+        if hasattr(self.backend, "list_with_rv"):
+            objs, rv = self.backend.list_with_rv(self.resource, self.namespace)
+        else:
+            objs = self.backend.list(self.resource, self.namespace)
+        # Snapshot the pre-relist cache so handlers see REAL old
+        # objects: update handlers compare resourceVersions (a
+        # same-object echo would suppress changes recovered across a
+        # watch gap) and delete handlers need labels/ownerRefs to
+        # unwind expectations.
+        old_objs = {meta_namespace_key(o): o for o in self.store.list()}
+        self.store.replace(objs)
+        for o in objs:
+            key = meta_namespace_key(o)
+            if key in old_objs:
+                self._dispatch("update", old_objs[key], o)
+            else:
+                self._dispatch("add", o)
+        new_keys = {meta_namespace_key(o) for o in objs}
+        # relist-detected deletions, dispatched with the last-known
+        # full object (cache.DeletedFinalStateUnknown analogue)
+        for key in set(old_objs) - new_keys:
+            self._dispatch("delete", old_objs[key])
+        self._synced.set()
+        return rv
+
     def _reflector_loop(self) -> None:
         backoff = 0.1
+        last_rv: Optional[int] = None  # None → a full relist is required
         while not self._stop.is_set():
             try:
-                objs = self.backend.list(self.resource, self.namespace)
-                # Snapshot the pre-relist cache so handlers see REAL old
-                # objects: update handlers compare resourceVersions (a
-                # same-object echo would suppress changes recovered across a
-                # watch gap) and delete handlers need labels/ownerRefs to
-                # unwind expectations.
-                old_objs = {meta_namespace_key(o): o for o in self.store.list()}
-                self.store.replace(objs)
-                for o in objs:
-                    key = meta_namespace_key(o)
-                    if key in old_objs:
-                        self._dispatch("update", old_objs[key], o)
-                    else:
-                        self._dispatch("add", o)
-                new_keys = {meta_namespace_key(o) for o in objs}
-                # relist-detected deletions, dispatched with the last-known
-                # full object (cache.DeletedFinalStateUnknown analogue)
-                for key in set(old_objs) - new_keys:
-                    self._dispatch("delete", old_objs[key])
-                self._synced.set()
+                if last_rv is None:
+                    last_rv = self._relist()
                 backoff = 0.1
-                w = self.backend.watch(self.resource, self.namespace)
+                w = self.backend.watch(
+                    self.resource, self.namespace, resource_version=last_rv
+                )
                 with self._watch_lock:
                     self._active_watch = w
                 try:
-                    self._consume_watch(w)
+                    # A cleanly-ended watch (server-side timeoutSeconds)
+                    # resumes from the last delivered event's rv — the
+                    # steady state does NO relisting.  Only a gap (410
+                    # Expired, no rv support, transport error) falls back.
+                    last_rv = self._consume_watch(w, last_rv)
                 finally:
                     with self._watch_lock:
                         self._active_watch = None
                     w.stop()
-            except Exception:
+            except Exception as e:
                 if self._stop.is_set():
                     return
+                last_rv = None  # any failure invalidates the resume point
+                if getattr(e, "code", None) == 410:
+                    log.info(
+                        "watch rv expired for %s; relisting", self.resource.plural
+                    )
+                    continue  # immediate relist, no backoff: 410 is expected
                 log.exception("reflector relist for %s", self.resource.plural)
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
 
-    def _consume_watch(self, w) -> None:
+    def _consume_watch(self, w, last_rv: Optional[int]) -> Optional[int]:
+        """Dispatch watch events until the stream ends; returns the rv of the
+        last event seen (the resume point), or None if rv tracking broke."""
         while not self._stop.is_set():
             item = w.next(timeout=0.2)
             if item is None:
                 if getattr(w, "stopped", False):
-                    return
+                    return last_rv
                 continue
             event_type, obj = item
+            if event_type == "ERROR":
+                # server-sent error frame (e.g. 410 mid-stream): relist
+                return None
+            if last_rv is not None:
+                try:
+                    last_rv = int((obj.get("metadata") or {}).get("resourceVersion"))
+                except (TypeError, ValueError):
+                    last_rv = None
             old = self.store.get_by_key(meta_namespace_key(obj))
             if event_type == "ADDED":
                 self.store.add(obj)
@@ -190,6 +224,7 @@ class SharedInformer:
             elif event_type == "DELETED":
                 self.store.delete(obj)
                 self._dispatch("delete", obj)
+        return last_rv
 
     def _resync_loop(self) -> None:
         while not self._stop.wait(self.resync_period):

@@ -228,6 +228,13 @@ class RestClient:
         return self._request("GET", self._url(resource, namespace, name))
 
     def list(self, resource: GVR, namespace=None, label_selector=None, field_selector=None):
+        items, _rv = self.list_with_rv(resource, namespace, label_selector, field_selector)
+        return items
+
+    def list_with_rv(self, resource: GVR, namespace=None, label_selector=None,
+                     field_selector=None):
+        """List plus ListMeta.resourceVersion (0 if the server omits it) —
+        the rv a reflector resumes its watch from."""
         query = {}
         required = parse_label_selector(label_selector)
         if required:
@@ -235,7 +242,11 @@ class RestClient:
         if field_selector:
             query["fieldSelector"] = ",".join(f"{k}={v}" for k, v in field_selector.items())
         out = self._request("GET", self._url(resource, namespace, query=query))
-        return out.get("items", [])
+        try:
+            rv = int((out.get("metadata") or {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            rv = 0
+        return out.get("items", []), rv
 
     def update(self, resource: GVR, namespace: str, obj: dict) -> dict:
         name = obj["metadata"]["name"]
@@ -261,7 +272,9 @@ class RestClient:
                 pass
         return deleted
 
-    def watch(self, resource: GVR, namespace=None) -> _RestWatch:
+    def watch(self, resource: GVR, namespace=None, resource_version=None) -> _RestWatch:
         query = {"watch": "true"}
+        if resource_version is not None:
+            query["resourceVersion"] = str(resource_version)
         resp = self._request("GET", self._url(resource, namespace, query=query), stream=True)
         return _RestWatch(resp)

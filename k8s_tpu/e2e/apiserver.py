@@ -181,7 +181,10 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if query.get("watch") in ("true", "1"):
                 return self._stream_watch(route, query)
-            items = cluster.list(
+            # items + rv must come from one atomic snapshot: an event
+            # between the list and the rv read would be invisible both in
+            # the items and in a watch resumed from that rv.
+            items, rv = cluster.list_with_rv(
                 route.resource,
                 route.namespace,
                 label_selector=query.get("labelSelector"),
@@ -192,7 +195,7 @@ class _Handler(BaseHTTPRequestHandler):
                 {
                     "apiVersion": route.resource.api_version,
                     "kind": route.resource.kind + "List",
-                    "metadata": {"resourceVersion": str(self.server.resource_version())},
+                    "metadata": {"resourceVersion": str(rv)},
                     "items": items,
                 },
             )
@@ -266,7 +269,15 @@ class _Handler(BaseHTTPRequestHandler):
         import time as _time
 
         timeout = float(query.get("timeoutSeconds") or self.server.watch_timeout)
-        w = self.server.cluster.watch(route.resource, route.namespace)
+        rv = query.get("resourceVersion")
+        try:
+            w = self.server.cluster.watch(
+                route.resource,
+                route.namespace,
+                resource_version=int(rv) if rv is not None else None,
+            )
+        except errors.ApiError as e:  # 410 Expired: client must relist
+            return self._send_status_error(e)
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Connection", "close")
@@ -320,7 +331,7 @@ class ApiServer:
         self._httpd.watch_timeout = watch_timeout  # type: ignore[attr-defined]
         self._httpd.stopping = self.stopping  # type: ignore[attr-defined]
         self._httpd.resource_version = (  # type: ignore[attr-defined]
-            lambda: len(self.cluster.actions)
+            self.cluster.latest_rv
         )
         self._thread: Optional[threading.Thread] = None
 

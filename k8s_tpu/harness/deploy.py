@@ -188,11 +188,57 @@ def teardown_kubectl(namespace: str) -> None:
     harness_util.run(["kubectl", "delete", "namespace", namespace, "--ignore-not-found"])
 
 
+def setup_with_provider(provider, args) -> None:
+    """Full setup through the provider seam (reference py/deploy.py setup:
+    create cluster -> configure kubectl -> deploy operator -> wait for the
+    operator Deployment and accelerator capacity)."""
+    import datetime
+
+    from k8s_tpu.harness import providers as providers_lib
+
+    provider.create_cluster()
+    provider.configure_kubectl()
+    setup_kubectl(args.image, args.namespace, args.version,
+                  args.output_dir, args.test_app_dir)
+    # --wait_timeout_s 0 skips the readiness wait entirely (apply-only
+    # workflows, clusters where the operator image can't pull yet)
+    if args.wait_timeout_s > 0:
+        providers_lib.wait_for_deployment(
+            args.namespace, "tf-job-operator",
+            datetime.timedelta(seconds=args.wait_timeout_s),
+        )
+        if getattr(args, "wait_for_tpu", False):
+            provider.wait_for_accelerators(
+                datetime.timedelta(seconds=args.wait_timeout_s))
+
+
+def teardown_with_provider(provider, args) -> None:
+    """Teardown through the provider: gke deletes the cluster
+    (py/deploy.py:189); kubectl deletes only what it deployed."""
+    if provider.name == "gke":
+        provider.delete_cluster()
+    else:
+        teardown_kubectl(args.namespace)
+
+
+def _provider_from_args(args):
+    from k8s_tpu.harness import providers as providers_lib
+
+    return providers_lib.make_provider(
+        args.mode,
+        project=getattr(args, "project", ""),
+        zone=getattr(args, "zone", ""),
+        cluster=getattr(args, "cluster", ""),
+        machine_type=getattr(args, "machine_type", "n2-standard-8"),
+        tpu_type=getattr(args, "tpu_type", ""),
+        tpu_topology=getattr(args, "tpu_topology", ""),
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
     setup_p = sub.add_parser("setup")
-    setup_p.add_argument("--mode", choices=["kubectl"], default="kubectl")
     setup_p.add_argument("--image", default="k8s-tpu/tf-job-operator:latest")
     setup_p.add_argument("--namespace", default=DEFAULT_NAMESPACE)
     setup_p.add_argument("--version", default="v1alpha2")
@@ -202,9 +248,32 @@ def main(argv=None) -> int:
         help="Deploy the operator from this declarative app dir "
         "(test/test-app) instead of the built-in manifests.",
     )
+    setup_p.add_argument(
+        "--machine_type", default="n2-standard-8",
+        help="gke mode: machine type of the default node pool.")
+    setup_p.add_argument(
+        "--tpu_type", default="",
+        help="gke mode: machine type of a TPU node pool to add "
+        "(e.g. ct5lp-hightpu-4t).")
+    setup_p.add_argument(
+        "--tpu_topology", default="",
+        help="gke mode: TPU slice topology for the pool (e.g. 2x4).")
+    setup_p.add_argument(
+        "--wait_for_tpu", action="store_true",
+        help="Block until google.com/tpu node capacity is schedulable.")
+    setup_p.add_argument(
+        "--wait_timeout_s", type=float, default=600.0,
+        help="Deadline for the operator/TPU readiness waits.")
     down_p = sub.add_parser("teardown")
     down_p.add_argument("--namespace", default=DEFAULT_NAMESPACE)
     for p in (setup_p, down_p):
+        p.add_argument("--mode", choices=["kubectl", "gke"], default="kubectl")
+        p.add_argument("--project", default="",
+                       help="gke mode: GCP project.")
+        p.add_argument("--zone", default="us-central1-a",
+                       help="gke mode: cluster zone.")
+        p.add_argument("--cluster", default="",
+                       help="gke mode: cluster name.")
         p.add_argument(
             "--junit_path", default=None,
             help="Write a junit TestCase for this step (reference "
@@ -215,16 +284,13 @@ def main(argv=None) -> int:
 
     from k8s_tpu.harness import junit as junit_lib
 
+    provider = _provider_from_args(args)
     t = junit_lib.TestCase(class_name="deploy", name=args.command)
     try:
         if args.command == "setup":
-            junit_lib.wrap_test(
-                lambda: setup_kubectl(args.image, args.namespace, args.version,
-                                      args.output_dir, args.test_app_dir),
-                t,
-            )
+            junit_lib.wrap_test(lambda: setup_with_provider(provider, args), t)
         else:
-            junit_lib.wrap_test(lambda: teardown_kubectl(args.namespace), t)
+            junit_lib.wrap_test(lambda: teardown_with_provider(provider, args), t)
     finally:
         if args.junit_path:
             junit_lib.create_junit_xml_file([t], args.junit_path)

@@ -59,20 +59,34 @@ class TestSuite:
         return len(self._cases)
 
 
+def _failure_text(exc: BaseException) -> str:
+    """Render an exception into the junit <failure> body.
+
+    Subprocess failures carry the captured output (the exit status alone is
+    useless in CI artifacts); everything else is summarized by its message.
+    The junit *schema* matches the reference's emitter (test_util.py:72-97)
+    but the wording and structure here are our own.
+    """
+    if isinstance(exc, subprocess.CalledProcessError):
+        return (
+            f"command exited with status {exc.returncode}\n"
+            f"captured output:\n{exc.output}"
+        )
+    return f"{type(exc).__name__}: {exc}"
+
+
 def wrap_test(test_func, test_case: TestCase) -> None:
-    """Run ``test_func`` recording wall time and failure text into
-    ``test_case``; exceptions are re-raised (test_util.py:72-97)."""
-    start = time.time()
+    """Run ``test_func``, stamping wall time and any failure into
+    ``test_case``.  Exceptions propagate to the caller after being
+    recorded — the junit artifact is a side channel, not a handler."""
+    start = time.monotonic()
     try:
         test_func()
-    except subprocess.CalledProcessError as e:
-        test_case.failure = f"Subprocess failed;\n{e.output}"
-        raise
-    except Exception as e:  # noqa: BLE001
-        test_case.failure = f"Test failed; {e}"
+    except BaseException as e:  # noqa: BLE001 — record *everything*, re-raise
+        test_case.failure = _failure_text(e)
         raise
     finally:
-        test_case.time = time.time() - start
+        test_case.time = time.monotonic() - start
 
 
 def create_xml(test_cases: Iterable[TestCase]) -> ElementTree.ElementTree:

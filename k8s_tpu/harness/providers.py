@@ -1,0 +1,297 @@
+"""Cluster providers: the cloud-lifecycle seam for test deployments.
+
+The reference provisions real GKE clusters for its e2e runs (py/deploy.py:91
+creates the cluster through the GKE API and waits on the operation;
+py/util.py:348 installs the accelerator driver daemonset and py/util.py:375
+polls nodes until accelerators are schedulable; py/deploy.py:189 tears the
+cluster down).  This module is the same seam, TPU-first:
+
+- every cloud interaction goes through subprocess ``gcloud``/``kubectl`` so
+  the provider is unit-testable against PATH shims with no cloud reachable;
+- the accelerator wait looks for ``google.com/tpu`` node capacity (TPU node
+  pools advertise it via the TPU device plugin — no driver daemonset to
+  install, unlike the reference's GPU alpha flow);
+- providers share one protocol, so ``deploy.py`` dispatches on ``--mode``
+  and the rest of the harness never knows which one it got.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from k8s_tpu.harness import util as harness_util
+
+log = logging.getLogger(__name__)
+
+
+class ProviderError(RuntimeError):
+    """A cluster-lifecycle step failed (non-retryably)."""
+
+
+class WaitTimeout(ProviderError):
+    """Polling for a readiness condition exceeded its deadline."""
+
+
+class Provider:
+    """Cluster lifecycle protocol.
+
+    ``create_cluster``/``delete_cluster`` bracket the test run;
+    ``configure_kubectl`` points kubectl at the cluster;
+    ``wait_for_accelerators`` blocks until accelerator capacity is
+    schedulable (the reference's driver-daemonset wait, py/util.py:375).
+    """
+
+    name = "abstract"
+
+    def create_cluster(self) -> None:
+        raise NotImplementedError
+
+    def delete_cluster(self) -> None:
+        raise NotImplementedError
+
+    def configure_kubectl(self) -> None:
+        raise NotImplementedError
+
+    def wait_for_accelerators(self, timeout: datetime.timedelta) -> None:
+        raise NotImplementedError
+
+
+class LocalProvider(Provider):
+    """In-process fake cluster: every lifecycle verb is a no-op; the
+    LocalCluster context manager owns actual setup (e2e/local.py)."""
+
+    name = "local"
+
+    def create_cluster(self) -> None:
+        log.info("local provider: no cluster to create")
+
+    def delete_cluster(self) -> None:
+        log.info("local provider: no cluster to delete")
+
+    def configure_kubectl(self) -> None:
+        pass
+
+    def wait_for_accelerators(self, timeout=None) -> None:
+        pass
+
+
+class KubectlProvider(Provider):
+    """An existing cluster reachable through the current kubectl context:
+    lifecycle verbs are no-ops, readiness waits are real."""
+
+    name = "kubectl"
+
+    def create_cluster(self) -> None:
+        log.info("kubectl provider: using the existing cluster")
+
+    def delete_cluster(self) -> None:
+        log.info("kubectl provider: leaving the existing cluster in place")
+
+    def configure_kubectl(self) -> None:
+        pass  # caller's kubeconfig is already the contract
+
+    def wait_for_accelerators(self, timeout=None) -> None:
+        wait_for_tpu_nodes(timeout or datetime.timedelta(minutes=10))
+
+
+@dataclass
+class GkeProvider(Provider):
+    """GKE cluster lifecycle over subprocess gcloud (py/deploy.py:91-189
+    parity; the REST-discovery client there becomes ``gcloud`` here).
+
+    ``tpu_topology``/``tpu_type`` request a TPU node pool at create time
+    (e.g. type ``ct5lp-hightorch-...``/topology ``2x4``); without them the
+    cluster is CPU-only, as the reference's is without ``--accelerator``.
+    """
+
+    project: str
+    zone: str
+    cluster: str
+    machine_type: str = "n2-standard-8"
+    num_nodes: int = 1
+    tpu_type: str = ""       # GKE machine type of the TPU node pool
+    tpu_topology: str = ""   # e.g. "2x4"
+    network: str = ""
+    name = "gke"
+    # operation polling (reference wait_for_operation: py/util.py:226)
+    poll_interval: float = 5.0
+    create_timeout: datetime.timedelta = field(
+        default_factory=lambda: datetime.timedelta(hours=1))
+
+    def _gcloud(self, *args: str) -> str:
+        # always run_and_output: the AlreadyExists/NotFound idempotency
+        # checks read the failure text off CalledProcessError.output, which
+        # plain run() (no capture) would leave empty
+        cmd = ["gcloud", f"--project={self.project}", *args]
+        return harness_util.run_and_output(cmd)
+
+    def create_cluster(self) -> None:
+        cmd = [
+            "container", "clusters", "create", self.cluster,
+            f"--zone={self.zone}",
+            f"--machine-type={self.machine_type}",
+            f"--num-nodes={self.num_nodes}",
+            "--scopes=cloud-platform",
+            "--async",  # returns an operation; we poll status ourselves
+        ]
+        if self.network:
+            cmd.append(f"--network={self.network}")
+        try:
+            self._gcloud(*cmd)
+        except subprocess.CalledProcessError as e:
+            # 409 AlreadyExists parity (py/util.py:196): reuse the cluster.
+            if "already exists" in _output_text(e).lower():
+                log.info("cluster %s already exists; reusing", self.cluster)
+            else:
+                raise
+        self._wait_cluster_status("RUNNING", self.create_timeout)
+        if self.tpu_type:
+            self._create_tpu_node_pool()
+
+    def _create_tpu_node_pool(self) -> None:
+        cmd = [
+            "container", "node-pools", "create", "tpu-pool",
+            f"--cluster={self.cluster}",
+            f"--zone={self.zone}",
+            f"--machine-type={self.tpu_type}",
+            f"--num-nodes={self.num_nodes}",
+        ]
+        if self.tpu_topology:
+            cmd.append(f"--tpu-topology={self.tpu_topology}")
+        try:
+            self._gcloud(*cmd)
+        except subprocess.CalledProcessError as e:
+            if "already exists" in _output_text(e).lower():
+                log.info("tpu-pool already exists; reusing")
+            else:
+                raise
+
+    def _wait_cluster_status(self, want: str,
+                             timeout: datetime.timedelta) -> None:
+        """Poll `describe` until the cluster reaches ``want`` (the operation
+        wait of py/util.py:226, expressed over cluster status)."""
+        deadline = time.monotonic() + timeout.total_seconds()
+        while True:
+            out = self._gcloud(
+                "container", "clusters", "describe", self.cluster,
+                f"--zone={self.zone}", "--format=json",
+            )
+            try:
+                status = (json.loads(out) or {}).get("status", "")
+            except ValueError:
+                status = ""  # transiently garbled describe output: keep polling
+            if status == want:
+                log.info("cluster %s is %s", self.cluster, want)
+                return
+            if status in ("ERROR", "DEGRADED"):
+                raise ProviderError(
+                    f"cluster {self.cluster} entered status {status}")
+            if time.monotonic() > deadline:
+                raise WaitTimeout(
+                    f"timed out waiting for cluster {self.cluster} to reach "
+                    f"{want} (last status {status!r})")
+            time.sleep(self.poll_interval)
+
+    def delete_cluster(self) -> None:
+        try:
+            self._gcloud(
+                "container", "clusters", "delete", self.cluster,
+                f"--zone={self.zone}", "--quiet",
+            )
+        except subprocess.CalledProcessError as e:
+            # parity with delete_cluster's log-and-continue (py/util.py:202):
+            # a missing cluster is a successful teardown
+            if "not found" in _output_text(e).lower():
+                log.info("cluster %s already gone", self.cluster)
+            else:
+                raise
+
+    def configure_kubectl(self) -> None:
+        # py/util.py:272
+        self._gcloud(
+            "container", "clusters", "get-credentials", self.cluster,
+            f"--zone={self.zone}",
+        )
+
+    def wait_for_accelerators(self, timeout=None) -> None:
+        wait_for_tpu_nodes(timeout or datetime.timedelta(minutes=10))
+
+
+def _output_text(e: subprocess.CalledProcessError) -> str:
+    out = e.output
+    if isinstance(out, bytes):
+        return out.decode(errors="replace")
+    return out or ""
+
+
+def _kubectl_json(*args: str) -> dict:
+    out = harness_util.run_and_output(["kubectl", *args, "-o", "json"])
+    return json.loads(out or "{}")
+
+
+def wait_for_tpu_nodes(timeout: datetime.timedelta,
+                       poll_interval: float = 15.0) -> None:
+    """Block until at least one node advertises schedulable google.com/tpu
+    capacity (the reference's wait_for_gpu_driver_install, py/util.py:375,
+    retargeted at the TPU device plugin)."""
+    deadline = time.monotonic() + timeout.total_seconds()
+    while True:
+        nodes = _kubectl_json("get", "nodes").get("items", [])
+        for n in nodes:
+            cap = ((n.get("status") or {}).get("capacity") or {})
+            try:
+                if int(cap.get("google.com/tpu", 0)) > 0:
+                    log.info("TPU capacity is schedulable")
+                    return
+            except (TypeError, ValueError):
+                continue
+        if time.monotonic() > deadline:
+            raise WaitTimeout("timed out waiting for TPU node capacity")
+        log.info("waiting for TPU nodes (%d nodes present)", len(nodes))
+        time.sleep(poll_interval)
+
+
+def wait_for_deployment(namespace: str, name: str,
+                        timeout: datetime.timedelta,
+                        poll_interval: float = 10.0) -> dict:
+    """Block until a Deployment has a ready replica (py/util.py:280)."""
+    deadline = time.monotonic() + timeout.total_seconds()
+    while True:
+        try:
+            deploy = _kubectl_json(
+                "get", "deployment", name, "-n", namespace)
+        except subprocess.CalledProcessError:
+            deploy = {}
+        ready = ((deploy.get("status") or {}).get("readyReplicas") or 0)
+        if ready >= 1:
+            log.info("deployment %s/%s is ready", namespace, name)
+            return deploy
+        if time.monotonic() > deadline:
+            raise WaitTimeout(
+                f"timed out waiting for deployment {namespace}/{name}")
+        log.info("waiting for deployment %s/%s", namespace, name)
+        time.sleep(poll_interval)
+
+
+def make_provider(mode: str, **kwargs) -> Provider:
+    """Factory keyed by the deploy --mode flag."""
+    if mode == "local":
+        return LocalProvider()
+    if mode == "kubectl":
+        return KubectlProvider()
+    if mode == "gke":
+        required = ("project", "zone", "cluster")
+        missing = [k for k in required if not kwargs.get(k)]
+        if missing:
+            raise ProviderError(
+                f"gke provider requires {', '.join('--' + m for m in missing)}")
+        allowed = {k: v for k, v in kwargs.items()
+                   if k in GkeProvider.__dataclass_fields__}
+        return GkeProvider(**allowed)
+    raise ProviderError(f"unknown provider mode {mode!r}")

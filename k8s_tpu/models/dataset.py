@@ -67,6 +67,12 @@ def write_token_shards(
         raise ValueError(f"tokens must be a packed 1-D stream, got {tokens.shape}")
     if tokens.size == 0:
         raise ValueError("empty token stream")
+    if tokens.min() < 0:
+        # a -1 sentinel would wrap to 65535 under uint16 and checksum as
+        # valid — reject up front rather than shipping corrupted shards
+        raise ValueError(
+            f"negative token ids (min {int(tokens.min())}); token shards "
+            "store vocabulary indices — map padding/sentinel ids first")
     dtype = np.uint16 if tokens.max() < (1 << 16) else np.int32
     tokens = tokens.astype(dtype)
     os.makedirs(out_dir, exist_ok=True)
@@ -150,25 +156,31 @@ class TokenDataset:
         epochs: Optional[int] = None,
     ) -> Iterator[np.ndarray]:
         """Yield [seq_len] int32 windows; shuffle permutes the global window
-        order each epoch (windows indexed across shards, read via mmap so
-        only touched pages load)."""
-        windows: list[tuple[str, int]] = []
-        for s in self.manifest["shards"]:
-            for w in range(s["n_tokens"] // seq_len):
-                windows.append((s["file"], w * seq_len))
-        if not windows:
+        order each epoch.
+
+        Window bookkeeping is O(num_shards), not O(num_windows): a global
+        window index is decoded to (shard, offset) through a cumulative
+        count table, so a multi-hundred-GB corpus costs a few ints per
+        shard, and mmap reads touch only the pages actually yielded.
+        """
+        counts = [s["n_tokens"] // seq_len for s in self.manifest["shards"]]
+        names = [s["file"] for s in self.manifest["shards"]]
+        cum = np.cumsum([0] + counts)  # cum[i] = first global index of shard i
+        total = int(cum[-1])
+        if total == 0:
             raise ValueError(
                 f"seq_len {seq_len} longer than every shard "
                 f"(max {max(s['n_tokens'] for s in self.manifest['shards'])})")
         rng = np.random.default_rng(seed)
         epoch = 0
         while epochs is None or epoch < epochs:
-            order = rng.permutation(len(windows)) if shuffle else range(
-                len(windows))
+            order = rng.permutation(total) if shuffle else range(total)
             for i in order:
-                name, start = windows[i]
+                shard_i = int(np.searchsorted(cum, i, side="right")) - 1
+                start = (int(i) - int(cum[shard_i])) * seq_len
                 yield np.asarray(
-                    self._shard(name)[start:start + seq_len], dtype=np.int32)
+                    self._shard(names[shard_i])[start:start + seq_len],
+                    dtype=np.int32)
             epoch += 1
 
     def batches(

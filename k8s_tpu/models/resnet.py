@@ -54,13 +54,53 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+def space_to_depth(x, block: int = 2):
+    """NHWC space-to-depth: (B, H, W, C) -> (B, H/b, W/b, b*b*C).
+
+    Channel packing order is (row_offset, col_offset, channel) — the order
+    ``stem_weights_to_s2d`` assumes when transforming 7x7 stem weights.
+    """
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
+def stem_weights_to_s2d(w7):
+    """Map 7x7-stride-2 stem weights [7,7,C,O] to the equivalent
+    4x4-stride-1 weights [4,4,4C,O] over a 2x2 space-to-depth input.
+
+    The 7x7 kernel is zero-padded to 8x8 at the top-left (tap k of the
+    original covers input row 2i-3+k; block di holds rows 2i-4+2di and
+    2i-3+2di, so tap (di, r) of the block kernel is original tap 2di+r-1,
+    with (di=0, r=0) falling off the kernel — the zero row/col).
+    """
+    import numpy as np
+
+    k, k2, c, o = w7.shape
+    assert (k, k2) == (7, 7), "stem transform is specific to the 7x7 stem"
+    p = np.zeros((8, 8, c, o), dtype=np.asarray(w7).dtype)
+    p[1:, 1:] = np.asarray(w7)
+    # [8,8,C,O] -> [4, 2(row off), 4, 2(col off), C, O] -> [4,4,2,2,C,O]
+    p = p.reshape(4, 2, 4, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+    return p.reshape(4, 4, 4 * c, o)
+
+
 class ResNet(nn.Module):
-    """ResNet-v1.5 family; stage_sizes (3,4,6,3) is ResNet-50."""
+    """ResNet-v1.5 family; stage_sizes (3,4,6,3) is ResNet-50.
+
+    ``stem="s2d"`` uses the space-to-depth stem: mathematically the same
+    function class as the 7x7/s2 conv (see ``stem_weights_to_s2d``), but the
+    conv the MXU actually runs is 4x4/s1 over 12 input channels instead of
+    7x7/s2 over 3 — no stride decimation, 4x the input-channel depth
+    (the standard TPU ResNet trick from the MLPerf submissions).
+    """
 
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    stem: str = "conv"  # "conv" (7x7/s2) | "s2d" (space-to-depth 4x4/s1)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -76,10 +116,17 @@ class ResNet(nn.Module):
         )
 
         x = x.astype(self.dtype)
-        x = conv(
-            self.num_filters, (7, 7), strides=(2, 2),
-            padding=[(3, 3), (3, 3)], use_bias=False, name="conv_init",
-        )(x)
+        if self.stem == "s2d":
+            x = space_to_depth(x, 2)
+            x = conv(
+                self.num_filters, (4, 4), strides=(1, 1),
+                padding=[(2, 1), (2, 1)], use_bias=False, name="conv_init",
+            )(x)
+        else:
+            x = conv(
+                self.num_filters, (7, 7), strides=(2, 2),
+                padding=[(3, 3), (3, 3)], use_bias=False, name="conv_init",
+            )(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -102,8 +149,10 @@ class ResNet(nn.Module):
         return x
 
 
-def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
-    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16, stem: str = "conv") -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype, stem=stem
+    )
 
 
 def resnet18_thin(num_classes: int = 10, dtype=jnp.float32) -> ResNet:

@@ -6,7 +6,7 @@ import pytest
 
 from k8s_tpu.api import v1alpha2
 from k8s_tpu.api.meta import ObjectMeta
-from k8s_tpu.client import ApiError, Clientset, FakeCluster
+from k8s_tpu.client import ApiError, Clientset, FakeCluster, errors
 from k8s_tpu.client.gvr import PODS, SERVICES, TFJOBS_V1ALPHA2
 from k8s_tpu.client.informer import Lister, SharedInformerFactory
 
@@ -123,6 +123,55 @@ class TestWatch:
         w = fc.watch(PODS, "other")
         cs.pods("default").create(_pod("p1"))
         assert w.next(timeout=0.1) is None
+        w.stop()
+
+    def test_watch_resume_replays_events_after_rv(self):
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        cs.pods("default").create(_pod("p1"))
+        _, rv = fc.list_with_rv(PODS, "default")
+        # events after the snapshot: one create, one delete
+        cs.pods("default").create(_pod("p2"))
+        cs.pods("default").delete("p1")
+        w = fc.watch(PODS, "default", resource_version=rv)
+        t, obj = w.next(timeout=1)
+        assert (t, obj["metadata"]["name"]) == ("ADDED", "p2")
+        t, obj = w.next(timeout=1)
+        assert (t, obj["metadata"]["name"]) == ("DELETED", "p1")
+        # the deleted event carries a fresh rv (etcd semantics)
+        assert int(obj["metadata"]["resourceVersion"]) > rv
+        # ... and the watch then goes live
+        cs.pods("default").create(_pod("p3"))
+        t, obj = w.next(timeout=1)
+        assert (t, obj["metadata"]["name"]) == ("ADDED", "p3")
+        w.stop()
+
+    def test_watch_resume_at_head_replays_nothing(self):
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        cs.pods("default").create(_pod("p1"))
+        _, rv = fc.list_with_rv(PODS, "default")
+        w = fc.watch(PODS, "default", resource_version=rv)
+        assert w.next(timeout=0.1) is None
+        w.stop()
+
+    def test_watch_resume_too_old_raises_410(self):
+        fc = FakeCluster()
+        fc.EVENT_HISTORY_LIMIT = 4
+        cs = Clientset(fc)
+        cs.pods("default").create(_pod("p0"))
+        _, rv = fc.list_with_rv(PODS, "default")
+        for i in range(1, 8):  # overflow the 4-event window
+            cs.pods("default").create(_pod(f"p{i}"))
+        with pytest.raises(errors.ApiError) as ei:
+            fc.watch(PODS, "default", resource_version=rv)
+        assert errors.is_expired(ei.value)
+        # a fresh list gives a resumable rv again
+        _, new_rv = fc.list_with_rv(PODS, "default")
+        w = fc.watch(PODS, "default", resource_version=new_rv)
+        cs.pods("default").create(_pod("p99"))
+        t, obj = w.next(timeout=1)
+        assert (t, obj["metadata"]["name"]) == ("ADDED", "p99")
         w.stop()
 
 

@@ -87,6 +87,21 @@ class TestJunit:
         assert ok_case.failure is None
         assert ok_case.time is not None
 
+    def test_wrap_test_subprocess_failure_carries_output(self):
+        import subprocess
+
+        case = TestCase("cls", "t3")
+
+        def boom():
+            raise subprocess.CalledProcessError(
+                7, ["cmd"], output="stderr said why"
+            )
+
+        with pytest.raises(subprocess.CalledProcessError):
+            wrap_test(boom, case)
+        assert "status 7" in case.failure
+        assert "stderr said why" in case.failure
+
     def test_write_to_store_uri(self, tmp_path):
         store = LocalArtifactStore(str(tmp_path))
         c = TestCase("cls", "t")

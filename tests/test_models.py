@@ -67,6 +67,36 @@ class TestResNet:
         assert logits.shape == (2, 10)
         assert logits.dtype == jnp.float32
 
+    def test_s2d_stem_is_exactly_the_7x7_stem(self):
+        """The space-to-depth stem computes the SAME function as the 7x7/s2
+        stem under the weight transform — this is a re-layout for the MXU,
+        not a different model."""
+        from flax import linen as nn
+
+        from k8s_tpu.models.resnet import space_to_depth, stem_weights_to_s2d
+
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (2, 32, 32, 3), jnp.float32)
+        conv7 = nn.Conv(16, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False)
+        w7 = conv7.init(key, x)["params"]["kernel"]
+        ref = conv7.apply({"params": {"kernel": w7}}, x)
+
+        conv4 = nn.Conv(16, (4, 4), strides=(1, 1), padding=[(2, 1), (2, 1)],
+                        use_bias=False)
+        w4 = jnp.asarray(stem_weights_to_s2d(w7))
+        got = conv4.apply({"params": {"kernel": w4}}, space_to_depth(x, 2))
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_s2d_resnet_trains(self):
+        model = resnet50(num_classes=10, dtype=jnp.float32, stem="s2d")
+        x = jnp.ones((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        logits = model.apply(variables, x, train=False)
+        assert logits.shape == (2, 10)
+
 
 class TestTransformer:
     def test_forward_shapes(self):

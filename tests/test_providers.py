@@ -1,0 +1,260 @@
+"""Cloud-provider seam tests (reference: py/deploy.py:91-210, py/util.py:
+172-310, 375).  No cloud is reachable here, so gcloud/kubectl are PATH shims
+that record every invocation and play back scripted responses — the same
+hermetic pattern the reference could have used for its own subprocess
+orchestration."""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import stat
+import subprocess
+
+import pytest
+
+from k8s_tpu.harness import deploy
+from k8s_tpu.harness import providers
+from k8s_tpu.harness.providers import (
+    GkeProvider,
+    LocalProvider,
+    KubectlProvider,
+    ProviderError,
+    WaitTimeout,
+    make_provider,
+    wait_for_deployment,
+    wait_for_tpu_nodes,
+)
+
+SHIM = r'''#!/usr/bin/env python3
+"""Records argv; replays the first unconsumed scripted response that
+substring-matches the joined args."""
+import json, os, sys
+
+shim_dir = os.environ["SHIM_DIR"]
+tool = os.path.basename(sys.argv[0])
+args = " ".join(sys.argv[1:])
+with open(os.path.join(shim_dir, "calls.log"), "a") as f:
+    f.write(json.dumps({"tool": tool, "args": sys.argv[1:]}) + "\n")
+
+script_path = os.path.join(shim_dir, "script.json")
+entries = json.load(open(script_path)) if os.path.exists(script_path) else []
+for i, e in enumerate(entries):
+    if not e.get("consumed") and e.get("tool", tool) == tool and e["match"] in args:
+        e["consumed"] = True
+        json.dump(entries, open(script_path, "w"))
+        sys.stdout.write(e.get("stdout", ""))
+        sys.exit(e.get("rc", 0))
+sys.exit(0)
+'''
+
+
+@pytest.fixture()
+def shim(tmp_path, monkeypatch):
+    """Install gcloud/kubectl shims at the front of PATH."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    for tool in ("gcloud", "kubectl"):
+        p = bin_dir / tool
+        p.write_text(SHIM)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("SHIM_DIR", str(tmp_path))
+
+    class Shim:
+        dir = tmp_path
+
+        def script(self, entries):
+            (tmp_path / "script.json").write_text(json.dumps(entries))
+
+        def calls(self, tool=None):
+            log = tmp_path / "calls.log"
+            if not log.exists():
+                return []
+            out = [json.loads(l) for l in log.read_text().splitlines()]
+            if tool:
+                out = [c for c in out if c["tool"] == tool]
+            return out
+
+    return Shim()
+
+
+def _gke(**kw):
+    kw.setdefault("project", "proj")
+    kw.setdefault("zone", "z-a")
+    kw.setdefault("cluster", "test-cl")
+    p = GkeProvider(**kw)
+    p.poll_interval = 0.01
+    return p
+
+
+class TestGkeProvider:
+    def test_create_polls_until_running(self, shim):
+        shim.script([
+            {"match": "clusters create", "stdout": "op queued\n"},
+            {"match": "clusters describe",
+             "stdout": json.dumps({"status": "PROVISIONING"})},
+            {"match": "clusters describe",
+             "stdout": json.dumps({"status": "RUNNING"})},
+        ])
+        _gke().create_cluster()
+        calls = shim.calls("gcloud")
+        create = next(c for c in calls if "create" in c["args"])
+        assert "--project=proj" in create["args"]
+        assert "--zone=z-a" in create["args"]
+        assert "--async" in create["args"]
+        describes = [c for c in calls if "describe" in c["args"]]
+        assert len(describes) == 2  # PROVISIONING then RUNNING
+
+    def test_create_adds_tpu_node_pool(self, shim):
+        shim.script([
+            {"match": "clusters create"},
+            {"match": "clusters describe",
+             "stdout": json.dumps({"status": "RUNNING"})},
+            {"match": "node-pools create"},
+        ])
+        _gke(tpu_type="ct5lp-hightpu-4t", tpu_topology="2x4").create_cluster()
+        pool = next(c for c in shim.calls("gcloud")
+                    if "node-pools" in c["args"])
+        assert "--machine-type=ct5lp-hightpu-4t" in pool["args"]
+        assert "--tpu-topology=2x4" in pool["args"]
+
+    def test_create_tolerates_already_exists(self, shim):
+        shim.script([
+            {"match": "clusters create", "rc": 1,
+             "stdout": "ERROR: cluster test-cl already exists\n"},
+            {"match": "clusters describe",
+             "stdout": json.dumps({"status": "RUNNING"})},
+        ])
+        _gke().create_cluster()  # must not raise (py/util.py:196 parity)
+
+    def test_create_error_status_raises(self, shim):
+        shim.script([
+            {"match": "clusters create"},
+            {"match": "clusters describe",
+             "stdout": json.dumps({"status": "ERROR"})},
+        ])
+        with pytest.raises(ProviderError):
+            _gke().create_cluster()
+
+    def test_create_timeout_raises(self, shim):
+        shim.script([{"match": "clusters create"}])
+        p = _gke()
+        p.create_timeout = datetime.timedelta(seconds=0.05)
+        with pytest.raises(WaitTimeout):
+            p.create_cluster()
+
+    def test_delete_tolerates_not_found(self, shim):
+        shim.script([
+            {"match": "clusters delete", "rc": 1,
+             "stdout": "ERROR: cluster not found\n"},
+        ])
+        _gke().delete_cluster()  # py/util.py:202 log-and-continue parity
+
+    def test_delete_real_failure_raises(self, shim):
+        shim.script([
+            {"match": "clusters delete", "rc": 1,
+             "stdout": "ERROR: permission denied\n"},
+        ])
+        with pytest.raises(subprocess.CalledProcessError):
+            _gke().delete_cluster()
+
+    def test_configure_kubectl(self, shim):
+        _gke().configure_kubectl()
+        creds = shim.calls("gcloud")[0]
+        assert "get-credentials" in creds["args"]
+        assert "test-cl" in creds["args"]
+
+
+class TestReadinessWaits:
+    def test_wait_for_tpu_nodes(self, shim):
+        no_tpu = json.dumps({"items": [
+            {"status": {"capacity": {"cpu": "8"}}}]})
+        tpu = json.dumps({"items": [
+            {"status": {"capacity": {"cpu": "8", "google.com/tpu": "4"}}}]})
+        shim.script([
+            {"match": "get nodes", "stdout": no_tpu},
+            {"match": "get nodes", "stdout": tpu},
+        ])
+        wait_for_tpu_nodes(datetime.timedelta(seconds=5), poll_interval=0.01)
+        assert len(shim.calls("kubectl")) == 2
+
+    def test_wait_for_tpu_nodes_timeout(self, shim):
+        shim.script([{"match": "get nodes",
+                      "stdout": json.dumps({"items": []})}])
+        with pytest.raises(WaitTimeout):
+            wait_for_tpu_nodes(datetime.timedelta(seconds=0.05),
+                               poll_interval=0.01)
+
+    def test_wait_for_deployment(self, shim):
+        not_ready = json.dumps({"status": {}})
+        ready = json.dumps({"status": {"readyReplicas": 1}})
+        shim.script([
+            {"match": "get deployment", "stdout": not_ready},
+            {"match": "get deployment", "stdout": ready},
+        ])
+        out = wait_for_deployment(
+            "kubeflow", "tf-job-operator",
+            datetime.timedelta(seconds=5), poll_interval=0.01)
+        assert out["status"]["readyReplicas"] == 1
+
+
+class TestFactory:
+    def test_modes(self):
+        assert isinstance(make_provider("local"), LocalProvider)
+        assert isinstance(make_provider("kubectl"), KubectlProvider)
+        gke = make_provider("gke", project="p", zone="z", cluster="c")
+        assert isinstance(gke, GkeProvider)
+
+    def test_gke_requires_identity(self):
+        with pytest.raises(ProviderError) as ei:
+            make_provider("gke", project="p")
+        assert "--zone" in str(ei.value) and "--cluster" in str(ei.value)
+
+    def test_unknown_mode(self):
+        with pytest.raises(ProviderError):
+            make_provider("fleet-of-toasters")
+
+
+class TestDeployCli:
+    def test_teardown_gke_deletes_cluster(self, shim, tmp_path):
+        shim.script([{"match": "clusters delete"}])
+        junit_path = str(tmp_path / "junit.xml")
+        rc = deploy.main([
+            "teardown", "--mode", "gke", "--project", "p",
+            "--cluster", "c", "--junit_path", junit_path,
+        ])
+        assert rc == 0
+        assert any("delete" in c["args"] for c in shim.calls("gcloud"))
+        from k8s_tpu.harness import junit as junit_lib
+        assert junit_lib.get_num_failures(
+            open(junit_path).read()) == 0
+
+    def test_setup_gke_full_flow(self, shim, tmp_path):
+        """create -> get-credentials -> kubectl apply -> deployment wait."""
+        ready = json.dumps({"status": {"readyReplicas": 1}})
+        shim.script([
+            {"match": "clusters create"},
+            {"match": "clusters describe",
+             "stdout": json.dumps({"status": "RUNNING"})},
+            {"match": "get-credentials"},
+            {"match": "get deployment", "stdout": ready},
+        ])
+        rc = deploy.main([
+            "setup", "--mode", "gke", "--project", "p", "--cluster", "c",
+            "--output_dir", str(tmp_path / "out"),
+            "--wait_timeout_s", "5",
+        ])
+        assert rc == 0
+        gcloud_args = [" ".join(c["args"]) for c in shim.calls("gcloud")]
+        assert any("clusters create" in a for a in gcloud_args)
+        assert any("get-credentials" in a for a in gcloud_args)
+        kubectl_args = [" ".join(c["args"]) for c in shim.calls("kubectl")]
+        applies = [a for a in kubectl_args if a.startswith("apply")]
+        assert applies, "operator manifests were never applied"
+        assert any("get deployment" in a for a in kubectl_args)
+
+    def test_setup_gke_without_cluster_flag_fails_fast(self, shim):
+        with pytest.raises(ProviderError):
+            deploy.main(["setup", "--mode", "gke", "--project", "p"])

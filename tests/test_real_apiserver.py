@@ -180,6 +180,73 @@ class TestWatchStreaming:
             assert w.stopped
             assert time.monotonic() - start < 5
 
+    def test_informer_steady_state_does_not_relist(self):
+        """rv-resumed watches: after the initial list, any number of
+        server-side watch-stream ends must cost ZERO further relists — at
+        the 200-concurrent-job design point a relist is O(N) churn per
+        cycle (the round-2 scale bottleneck, BASELINE.md)."""
+        with ApiServer(watch_timeout=0.25) as s:
+            for i in range(200):
+                s.cluster.create(
+                    PODS, "default",
+                    {"metadata": {"name": f"pre-{i:03d}", "namespace": "default"}},
+                )
+            backend = RestClient(ClusterConfig(host=s.url))
+            seen = []
+            factory = SharedInformerFactory(backend, resync_period=0)
+            informer = factory.informer_for(PODS)
+            informer.add_event_handler(
+                on_add=lambda o: seen.append(o["metadata"]["name"]))
+            factory.start()
+            assert factory.wait_for_cache_sync(10)
+            lists_after_sync = sum(
+                1 for a in s.cluster.actions if a.verb == "list")
+            # span several watch-timeout cycles, with events in each
+            for i in range(4):
+                time.sleep(0.4)
+                backend.create(
+                    PODS, "default",
+                    {"metadata": {"name": f"live-{i}", "namespace": "default"}})
+            assert wait_until(
+                lambda: all(f"live-{i}" in seen for i in range(4)))
+            lists_now = sum(1 for a in s.cluster.actions if a.verb == "list")
+            assert lists_now == lists_after_sync, (
+                f"steady-state watch cycles relisted "
+                f"({lists_now - lists_after_sync} extra lists)")
+            assert len(informer.store.list()) == 204
+            factory.stop()
+
+    def test_informer_recovers_from_410_expired(self):
+        """A watch resume past the server's retained event window gets 410
+        and must fall back to a relist, not wedge."""
+        with ApiServer(watch_timeout=0.25) as s:
+            s.cluster.EVENT_HISTORY_LIMIT = 4
+            backend = RestClient(ClusterConfig(host=s.url))
+            seen = []
+            factory = SharedInformerFactory(backend, resync_period=0)
+            informer = factory.informer_for(PODS)
+            informer.add_event_handler(
+                on_add=lambda o: seen.append(o["metadata"]["name"]))
+            factory.start()
+            assert factory.wait_for_cache_sync(10)
+            # Burst enough events inside one watch gap to trim the history
+            # past the informer's resume point.  The burst happens while the
+            # informer is between streams often enough across cycles that a
+            # 410 is effectively guaranteed; either way the invariant below
+            # must hold.
+            for i in range(12):
+                s.cluster.create(
+                    PODS, "default",
+                    {"metadata": {"name": f"burst-{i}", "namespace": "default"}})
+            assert wait_until(
+                lambda: len(informer.store.list()) == 12, timeout=10)
+            # still live after any 410/relist:
+            backend.create(
+                PODS, "default",
+                {"metadata": {"name": "post-410", "namespace": "default"}})
+            assert wait_until(lambda: "post-410" in seen)
+            factory.stop()
+
     def test_informer_over_rest_relists_after_stream_end(self, client):
         """The reflector's list→watch→relist loop against a short server
         watch timeout: events before AND after a forced relist arrive."""
