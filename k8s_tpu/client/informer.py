@@ -162,6 +162,7 @@ class SharedInformer:
 
     def _reflector_loop(self) -> None:
         backoff = 0.1
+        expired_in_row = 0
         last_rv: Optional[int] = None  # None → a full relist is required
         while not self._stop.is_set():
             try:
@@ -179,6 +180,7 @@ class SharedInformer:
                     # steady state does NO relisting.  Only a gap (410
                     # Expired, no rv support, transport error) falls back.
                     last_rv = self._consume_watch(w, last_rv)
+                    expired_in_row = 0
                 finally:
                     with self._watch_lock:
                         self._active_watch = None
@@ -191,7 +193,14 @@ class SharedInformer:
                     log.info(
                         "watch rv expired for %s; relisting", self.resource.plural
                     )
-                    continue  # immediate relist, no backoff: 410 is expected
+                    # first 410 relists immediately (expected after a churn
+                    # burst); repeats back off — a server whose history
+                    # can't hold one watch cycle must not induce a hot
+                    # O(N)-list loop
+                    expired_in_row += 1
+                    if expired_in_row > 1:
+                        time.sleep(min(0.1 * (2 ** expired_in_row), 5.0))
+                    continue
                 log.exception("reflector relist for %s", self.resource.plural)
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)

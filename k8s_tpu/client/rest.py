@@ -233,7 +233,9 @@ class RestClient:
 
     def list_with_rv(self, resource: GVR, namespace=None, label_selector=None,
                      field_selector=None):
-        """List plus ListMeta.resourceVersion (0 if the server omits it) —
+        """List plus ListMeta.resourceVersion (None if the server omits it,
+        so the reflector falls back to resume-free watches instead of
+        treating rv=0 as a real resume point) —
         the rv a reflector resumes its watch from."""
         query = {}
         required = parse_label_selector(label_selector)
@@ -242,10 +244,11 @@ class RestClient:
         if field_selector:
             query["fieldSelector"] = ",".join(f"{k}={v}" for k, v in field_selector.items())
         out = self._request("GET", self._url(resource, namespace, query=query))
+        raw = (out.get("metadata") or {}).get("resourceVersion")
         try:
-            rv = int((out.get("metadata") or {}).get("resourceVersion", 0))
+            rv = int(raw) if raw is not None else None
         except (TypeError, ValueError):
-            rv = 0
+            rv = None
         return out.get("items", []), rv
 
     def update(self, resource: GVR, namespace: str, obj: dict) -> dict:

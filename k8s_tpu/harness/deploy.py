@@ -284,13 +284,16 @@ def main(argv=None) -> int:
 
     from k8s_tpu.harness import junit as junit_lib
 
-    provider = _provider_from_args(args)
     t = junit_lib.TestCase(class_name="deploy", name=args.command)
     try:
+        # provider construction happens inside the junit bracket so a bad
+        # flag combination is recorded in the artifact, not just a traceback
         if args.command == "setup":
-            junit_lib.wrap_test(lambda: setup_with_provider(provider, args), t)
+            junit_lib.wrap_test(
+                lambda: setup_with_provider(_provider_from_args(args), args), t)
         else:
-            junit_lib.wrap_test(lambda: teardown_with_provider(provider, args), t)
+            junit_lib.wrap_test(
+                lambda: teardown_with_provider(_provider_from_args(args), args), t)
     finally:
         if args.junit_path:
             junit_lib.create_junit_xml_file([t], args.junit_path)

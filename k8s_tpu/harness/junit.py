@@ -68,9 +68,12 @@ def _failure_text(exc: BaseException) -> str:
     but the wording and structure here are our own.
     """
     if isinstance(exc, subprocess.CalledProcessError):
+        out = exc.output
+        if isinstance(out, bytes):  # run_and_output failures carry bytes
+            out = out.decode(errors="replace")
         return (
             f"command exited with status {exc.returncode}\n"
-            f"captured output:\n{exc.output}"
+            f"captured output:\n{out or ''}"
         )
     return f"{type(exc).__name__}: {exc}"
 

@@ -1,0 +1,586 @@
+"""minidom: a headless DOM + browser harness for executing the dashboard SPA
+under the bundled minijs interpreter (the jsdom analogue for the frontend CI
+tier; reference runs its SPA under jest+jsdom —
+dashboard/frontend/src/components/App.test.js).
+
+Implements the surface app.js touches: getElementById, createElement,
+innerHTML (parsed into a real element tree via html.parser), textContent,
+``value`` semantics for input/select/textarea, ``style.display``, inline
+on* attribute handlers with ``this``/``event`` binding, event bubbling with
+stopPropagation, addEventListener, fetch (host-routed, synchronous
+promises), and setInterval/setTimeout with manual test-driven firing.
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+import json
+from html.parser import HTMLParser
+from typing import Any, Callable, Optional
+
+from k8s_tpu.harness.minijs.interp import (
+    UNDEFINED,
+    Environment,
+    Interpreter,
+    JSException,
+    JSObject,
+    JSPromise,
+    NativeFunction,
+    js_to_py,
+    js_to_string,
+    make_error,
+    py_to_js,
+)
+
+VOID_TAGS = {"area", "base", "br", "col", "embed", "hr", "img", "input",
+             "link", "meta", "source", "track", "wbr"}
+
+
+class Style:
+    """element.style — arbitrary camelCase properties, display is the one
+    the SPA routes on."""
+
+    def __init__(self, initial: str = ""):
+        self.props: dict[str, str] = {}
+        for part in initial.split(";"):
+            if ":" in part:
+                k, _, v = part.partition(":")
+                self.props[_camel(k.strip())] = v.strip()
+
+    def js_get(self, name: str):
+        return self.props.get(name, "")
+
+    def js_set(self, name: str, value) -> None:
+        self.props[name] = js_to_string(value)
+
+
+def _camel(css_name: str) -> str:
+    parts = css_name.split("-")
+    return parts[0] + "".join(p.capitalize() for p in parts[1:])
+
+
+class Text:
+    def __init__(self, data: str):
+        self.data = data
+
+
+class Element:
+    def __init__(self, tag: str, browser: "Browser"):
+        self.tag = tag.lower()
+        self.attrs: dict[str, str] = {}
+        self.children: list[Any] = []  # Element | Text
+        self.parent: Optional[Element] = None
+        self.browser = browser
+        self.style = Style()
+        self._value: Optional[str] = None  # JS-assigned value overrides attrs
+        self._listeners: dict[str, list] = {}
+
+    # -- tree ----------------------------------------------------------------
+
+    def append(self, child) -> None:
+        if isinstance(child, Element):
+            child.parent = self
+        self.children.append(child)
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            if isinstance(c, Element):
+                yield from c.walk()
+
+    def get_element_by_id(self, el_id: str) -> Optional["Element"]:
+        for el in self.walk():
+            if el.attrs.get("id") == el_id:
+                return el
+        return None
+
+    # -- text / html ---------------------------------------------------------
+
+    @property
+    def text_content(self) -> str:
+        out = []
+        for c in self.children:
+            if isinstance(c, Text):
+                out.append(c.data)
+            else:
+                out.append(c.text_content)
+        return "".join(out)
+
+    def set_text_content(self, text: str) -> None:
+        self.children = [Text(text)] if text else []
+
+    @property
+    def inner_html(self) -> str:
+        return "".join(_serialize(c) for c in self.children)
+
+    def set_inner_html(self, markup: str) -> None:
+        self.children = _parse_fragment(markup, self.browser)
+        for c in self.children:
+            if isinstance(c, Element):
+                c.parent = self
+
+    # -- form value semantics -------------------------------------------------
+
+    @property
+    def value(self) -> str:
+        if self._value is not None:
+            return self._value
+        if self.tag == "select":
+            options = [el for el in self.walk() if el.tag == "option"]
+            chosen = next((o for o in options if "selected" in o.attrs),
+                          options[0] if options else None)
+            if chosen is None:
+                return ""
+            return chosen.attrs.get("value", chosen.text_content)
+        if self.tag == "textarea":
+            return self.text_content
+        return self.attrs.get("value", "")
+
+    @value.setter
+    def value(self, v: str) -> None:
+        self._value = v
+
+    # -- events ---------------------------------------------------------------
+
+    def add_event_listener(self, event_type: str, fn) -> None:
+        self._listeners.setdefault(event_type, []).append(fn)
+
+    def dispatch(self, event_type: str, bubbles: bool = True) -> "Event":
+        event = Event(event_type, self)
+        node: Optional[Element] = self
+        while node is not None:
+            handler_src = node.attrs.get("on" + event_type)
+            if handler_src:
+                self.browser.run_handler(handler_src, this=node, event=event)
+            for fn in node._listeners.get(event_type, []):
+                self.browser.interp.call(fn, [event], this=node)
+            if event.stopped or not bubbles:
+                break
+            node = node.parent
+        self.browser.interp.drain()
+        return event
+
+    # -- JS property protocol -------------------------------------------------
+
+    def js_get(self, name: str):
+        interp = self.browser.interp
+        simple = {
+            "tagName": self.tag.upper(),
+            "id": self.attrs.get("id", ""),
+            "className": self.attrs.get("class", ""),
+            "innerHTML": self.inner_html,
+            "textContent": self.text_content,
+            "innerText": self.text_content,
+            "value": self.value,
+            "style": self.style,
+            "parentElement": self.parent,
+            "parentNode": self.parent,
+            "children": py_to_js([]) if not self.children else
+                _els(self.children),
+            "options": _els([e for e in self.walk() if e.tag == "option"]),
+            "dataset": JSObject({k[5:]: v for k, v in self.attrs.items()
+                                 if k.startswith("data-")}),
+            "checked": "checked" in self.attrs or self._value == "true",
+            "disabled": "disabled" in self.attrs,
+        }
+        if name in simple:
+            return simple[name]
+        if name == "getAttribute":
+            return NativeFunction(
+                lambda attr=UNDEFINED:
+                    self.attrs.get(js_to_string(attr), None), "getAttribute")
+        if name == "setAttribute":
+            def set_attr(attr=UNDEFINED, value=UNDEFINED):
+                self.attrs[js_to_string(attr)] = js_to_string(value)
+                return UNDEFINED
+            return NativeFunction(set_attr, "setAttribute")
+        if name == "appendChild":
+            def append_child(child=UNDEFINED):
+                self.append(child)
+                return child
+            return NativeFunction(append_child, "appendChild")
+        if name == "addEventListener":
+            def ael(event_type=UNDEFINED, fn=UNDEFINED, *_):
+                self.add_event_listener(js_to_string(event_type), fn)
+                return UNDEFINED
+            return NativeFunction(ael, "addEventListener")
+        if name == "click":
+            return NativeFunction(lambda: (self.dispatch("click"), UNDEFINED)[1],
+                                  "click")
+        if name == "querySelector":
+            return NativeFunction(
+                lambda sel=UNDEFINED:
+                    _query(self, js_to_string(sel), first=True),
+                "querySelector")
+        if name == "querySelectorAll":
+            return NativeFunction(
+                lambda sel=UNDEFINED:
+                    _els(_query(self, js_to_string(sel), first=False)),
+                "querySelectorAll")
+        if name == "getElementsByTagName":
+            return NativeFunction(
+                lambda t=UNDEFINED: _els(
+                    [e for e in self.walk()
+                     if e.tag == js_to_string(t).lower()]),
+                "getElementsByTagName")
+        if name == "remove":
+            def remove():
+                if self.parent is not None:
+                    self.parent.children.remove(self)
+                    self.parent = None
+                return UNDEFINED
+            return NativeFunction(remove, "remove")
+        if name == "focus" or name == "blur":
+            return NativeFunction(lambda: UNDEFINED, name)
+        return UNDEFINED
+
+    def js_set(self, name: str, value) -> None:
+        if name == "innerHTML":
+            self.set_inner_html(js_to_string(value))
+        elif name in ("textContent", "innerText"):
+            self.set_text_content(js_to_string(value))
+        elif name == "value":
+            self.value = js_to_string(value)
+        elif name == "id":
+            self.attrs["id"] = js_to_string(value)
+        elif name == "className":
+            self.attrs["class"] = js_to_string(value)
+        elif name == "checked":
+            if value:
+                self.attrs["checked"] = ""
+            else:
+                self.attrs.pop("checked", None)
+        elif name.startswith("on"):
+            # element.onclick = fn
+            self.add_event_listener(name[2:], value)
+        elif name == "style":
+            self.style = Style(js_to_string(value))
+        else:
+            self.attrs[name] = js_to_string(value)
+
+
+def _els(items) -> Any:
+    from k8s_tpu.harness.minijs.interp import JSArray
+
+    return JSArray(items)
+
+
+def _query(root: Element, selector: str, first: bool):
+    out = []
+    for sel in [s.strip() for s in selector.split(",")]:
+        for el in root.walk():
+            if el is root:
+                continue
+            if _matches(el, sel) and el not in out:
+                out.append(el)
+    if first:
+        return out[0] if out else None
+    return out
+
+
+def _matches(el: Element, sel: str) -> bool:
+    if sel.startswith("#"):
+        return el.attrs.get("id") == sel[1:]
+    if sel.startswith("."):
+        return sel[1:] in el.attrs.get("class", "").split()
+    if "[" in sel and sel.endswith("]"):
+        tag, _, attr_part = sel.partition("[")
+        attr_expr = attr_part[:-1]
+        if tag and el.tag != tag.lower():
+            return False
+        if "=" in attr_expr:
+            k, _, v = attr_expr.partition("=")
+            return el.attrs.get(k) == v.strip("'\"")
+        return attr_expr in el.attrs
+    return el.tag == sel.lower()
+
+
+def _serialize(node) -> str:
+    if isinstance(node, Text):
+        return html_mod.escape(node.data, quote=False)
+    attrs = "".join(
+        f' {k}' if v == "" and k in ("selected", "checked", "disabled")
+        else f' {k}="{html_mod.escape(v, quote=True)}"'
+        for k, v in node.attrs.items())
+    if node.tag in VOID_TAGS:
+        return f"<{node.tag}{attrs}>"
+    return f"<{node.tag}{attrs}>{node.inner_html}</{node.tag}>"
+
+
+class _FragmentParser(HTMLParser):
+    def __init__(self, browser: "Browser"):
+        super().__init__(convert_charrefs=True)
+        self.browser = browser
+        self.root = Element("#fragment", browser)
+        self.stack = [self.root]
+
+    def handle_starttag(self, tag, attrs):
+        el = Element(tag, self.browser)
+        for k, v in attrs:
+            el.attrs[k] = v if v is not None else ""
+        if "style" in el.attrs:
+            el.style = Style(el.attrs["style"])
+        self.stack[-1].append(el)
+        if tag.lower() not in VOID_TAGS:
+            self.stack.append(el)
+
+    def handle_startendtag(self, tag, attrs):
+        el = Element(tag, self.browser)
+        for k, v in attrs:
+            el.attrs[k] = v if v is not None else ""
+        self.stack[-1].append(el)
+
+    def handle_endtag(self, tag):
+        # close the nearest matching open tag (tolerates minor nesting slop)
+        for i in range(len(self.stack) - 1, 0, -1):
+            if self.stack[i].tag == tag.lower():
+                del self.stack[i:]
+                return
+
+    def handle_data(self, data):
+        if data:
+            self.stack[-1].append(Text(data))
+
+
+def _parse_fragment(markup: str, browser: "Browser") -> list:
+    p = _FragmentParser(browser)
+    p.feed(markup)
+    p.close()
+    return p.root.children
+
+
+class Event:
+    def __init__(self, event_type: str, target: Element):
+        self.type = event_type
+        self.target = target
+        self.stopped = False
+        self.default_prevented = False
+
+    def js_get(self, name: str):
+        if name == "type":
+            return self.type
+        if name == "target":
+            return self.target
+        if name == "stopPropagation":
+            def stop():
+                self.stopped = True
+                return UNDEFINED
+            return NativeFunction(stop, "stopPropagation")
+        if name == "preventDefault":
+            def prevent():
+                self.default_prevented = True
+                return UNDEFINED
+            return NativeFunction(prevent, "preventDefault")
+        return UNDEFINED
+
+    def js_set(self, name: str, value) -> None:
+        pass
+
+
+class Document:
+    def __init__(self, browser: "Browser"):
+        self.browser = browser
+        self.root = Element("html", browser)
+
+    def js_get(self, name: str):
+        if name == "getElementById":
+            return NativeFunction(
+                lambda el_id=UNDEFINED:
+                    self.root.get_element_by_id(js_to_string(el_id)),
+                "getElementById")
+        if name == "createElement":
+            return NativeFunction(
+                lambda tag=UNDEFINED:
+                    Element(js_to_string(tag), self.browser),
+                "createElement")
+        if name == "querySelector":
+            return NativeFunction(
+                lambda sel=UNDEFINED:
+                    _query(self.root, js_to_string(sel), first=True),
+                "querySelector")
+        if name == "querySelectorAll":
+            return NativeFunction(
+                lambda sel=UNDEFINED:
+                    _els(_query(self.root, js_to_string(sel), first=False)),
+                "querySelectorAll")
+        if name == "body":
+            for el in self.root.walk():
+                if el.tag == "body":
+                    return el
+            return self.root
+        if name == "addEventListener":
+            return NativeFunction(lambda *a: UNDEFINED, "addEventListener")
+        return UNDEFINED
+
+    def js_set(self, name: str, value) -> None:
+        pass
+
+
+class Browser:
+    """The test harness: document + script + fetch routing + timers.
+
+    ``fetch_handler(method, url, body) -> (status, payload)`` where payload
+    is JSON-ish Python data; provide it before load().  All promises settle
+    synchronously so assertions can run immediately after an interaction.
+    """
+
+    def __init__(self, fetch_handler: Optional[Callable] = None):
+        self.interp = Interpreter()
+        self.document = Document(self)
+        self.fetch_handler = fetch_handler or (lambda m, u, b: (404, {}))
+        self.requests: list[tuple[str, str, Any]] = []
+        self.timers: list[dict] = []
+        self._timer_id = 0
+        self.errors: list[str] = []
+        self._install_globals()
+
+    # -- harness API ---------------------------------------------------------
+
+    def load(self, html_text: str, script: str) -> None:
+        """Parse the page, then execute its script (as <script src> would)."""
+        self.document.root.children = _parse_fragment(html_text, self)
+        for c in self.document.root.children:
+            if isinstance(c, Element):
+                c.parent = self.document.root
+        self.interp.run(script)
+
+    def by_id(self, el_id: str) -> Optional[Element]:
+        return self.document.root.get_element_by_id(el_id)
+
+    def click(self, el: Element) -> Event:
+        return el.dispatch("click")
+
+    def set_value(self, el: Element, value: str, fire: str = "change") -> None:
+        el.value = value
+        if fire:
+            el.dispatch(fire, bubbles=False)
+
+    def fire_timers(self, kind: str = "interval") -> int:
+        """Run all registered interval (or timeout) callbacks once."""
+        fired = 0
+        for t in list(self.timers):
+            if t["kind"] != kind:
+                continue
+            self.interp.call(t["fn"], [])
+            fired += 1
+            if kind == "timeout":
+                self.timers.remove(t)
+        self.interp.drain()
+        return fired
+
+    def run_handler(self, src: str, this: Element, event: Event) -> None:
+        env = Environment(self.interp.globals)
+        env.declare("this", this)
+        env.declare("event", event)
+        try:
+            from k8s_tpu.harness.minijs.parser import parse
+
+            program = parse(src)
+            self.interp._hoist(program["body"], env)
+            for stmt in program["body"]:
+                self.interp.exec_stmt(stmt, env)
+        except JSException as e:
+            self.errors.append(js_to_string(e.value))
+            raise
+
+    # -- globals -------------------------------------------------------------
+
+    def _install_globals(self) -> None:
+        interp = self.interp
+        interp.define("document", self.document)
+
+        def fetch(url=UNDEFINED, opts=UNDEFINED):
+            method = "GET"
+            body = None
+            if isinstance(opts, JSObject):
+                method = js_to_string(opts.get("method", "GET")).upper()
+                raw = opts.get("body")
+                if raw is not None and raw is not UNDEFINED:
+                    try:
+                        body = json.loads(js_to_string(raw))
+                    except ValueError:
+                        body = js_to_string(raw)
+            url_s = js_to_string(url)
+            self.requests.append((method, url_s, body))
+            promise = JSPromise(interp)
+            try:
+                status, payload = self.fetch_handler(method, url_s, body)
+            except Exception as e:  # noqa: BLE001 - network-failure analogue
+                promise.reject(make_error(str(e), name="TypeError"))
+                return promise
+            response = _make_response(interp, int(status), payload)
+            promise.resolve(response)
+            return promise
+
+        interp.define("fetch", NativeFunction(fetch, "fetch"))
+
+        def set_interval(fn=UNDEFINED, ms=0.0, *args):
+            self._timer_id += 1
+            self.timers.append({"id": self._timer_id, "fn": fn,
+                                "ms": float(js_to_py(ms) or 0), "kind": "interval"})
+            return float(self._timer_id)
+
+        def set_timeout(fn=UNDEFINED, ms=0.0, *args):
+            self._timer_id += 1
+            self.timers.append({"id": self._timer_id, "fn": fn,
+                                "ms": float(js_to_py(ms) or 0), "kind": "timeout"})
+            return float(self._timer_id)
+
+        def clear_timer(timer_id=UNDEFINED):
+            tid = js_to_py(timer_id)
+            self.timers = [t for t in self.timers if t["id"] != tid]
+            return UNDEFINED
+
+        interp.define("setInterval", NativeFunction(set_interval, "setInterval"))
+        interp.define("setTimeout", NativeFunction(set_timeout, "setTimeout"))
+        interp.define("clearInterval", NativeFunction(clear_timer, "clearInterval"))
+        interp.define("clearTimeout", NativeFunction(clear_timer, "clearTimeout"))
+        interp.define("window", _Window(self))
+
+        def alert(msg=UNDEFINED):
+            self.errors.append(f"alert: {js_to_string(msg)}")
+            return UNDEFINED
+
+        interp.define("alert", NativeFunction(alert, "alert"))
+        interp.define("confirm", NativeFunction(lambda msg=UNDEFINED: True,
+                                                "confirm"))
+
+
+class _Window:
+    def __init__(self, browser: Browser):
+        self.browser = browser
+
+    def js_get(self, name: str):
+        if name == "document":
+            return self.browser.document
+        if self.browser.interp.globals.has(name):
+            return self.browser.interp.globals.lookup(name)
+        return UNDEFINED
+
+    def js_set(self, name: str, value) -> None:
+        self.browser.interp.globals.declare(name, value)
+
+
+_STATUS_TEXT = {200: "OK", 201: "Created", 400: "Bad Request",
+                404: "Not Found", 409: "Conflict", 500: "Internal Server Error"}
+
+
+def _make_response(interp: Interpreter, status: int, payload) -> JSObject:
+    response = JSObject()
+    response["ok"] = 200 <= status < 300
+    response["status"] = float(status)
+    response["statusText"] = _STATUS_TEXT.get(status, str(status))
+
+    def json_method():
+        p = JSPromise(interp)
+        p.resolve(py_to_js(payload))
+        return p
+
+    def text_method():
+        p = JSPromise(interp)
+        p.resolve(json.dumps(payload))
+        return p
+
+    response["json"] = NativeFunction(json_method, "json")
+    response["text"] = NativeFunction(text_method, "text")
+    return response

@@ -1,0 +1,23 @@
+"""minijs: a minimal JavaScript interpreter for executing the dashboard SPA
+in tests (the App.test.js analogue — reference:
+dashboard/frontend/src/components/App.test.js runs the reference SPA under
+jest; this image has no node, so the frontend CI tier bundles its own
+interpreter).
+
+Scope: the ES2017/ES2020 subset the SPA uses — let/const, functions, arrow
+functions (incl. param defaults and array-destructuring params), template
+literals (nested), object/array literals with spread, for-of with
+destructuring, try/catch/throw, regex literals, async/await over a
+synchronous microtask queue, Promise/then/catch, Set, JSON, and the usual
+String/Array/Object builtins.  Not a general-purpose engine: no classes, no
+generators, no labels, no `with`, no getters/setters, no prototype mutation.
+"""
+
+from k8s_tpu.harness.minijs.interp import (  # noqa: F401
+    Interpreter,
+    JSError,
+    JSException,
+    UNDEFINED,
+)
+from k8s_tpu.harness.minijs.lexer import LexError  # noqa: F401
+from k8s_tpu.harness.minijs.parser import ParseError, parse  # noqa: F401

@@ -1,0 +1,567 @@
+"""minijs standard library: globals (JSON, Object, Math, console, Promise,
+Set, Error, Number/String/Boolean, parseInt/parseFloat) and the per-type
+method dispatch used by the interpreter's member access."""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+from k8s_tpu.harness.minijs.interp import (
+    UNDEFINED,
+    Interpreter,
+    JSArray,
+    JSException,
+    JSFunction,
+    JSObject,
+    JSPromise,
+    JSRegExp,
+    JSSet,
+    NativeFunction,
+    format_number,
+    js_to_number,
+    js_to_string,
+    js_truthy,
+    json_parse,
+    json_stringify,
+    make_error,
+    strict_equals,
+)
+
+
+def _nf(fn, name=""):
+    return NativeFunction(fn, name)
+
+
+def install_globals(interp: Interpreter) -> None:
+    g = interp.define
+
+    # console ------------------------------------------------------------
+    console = JSObject()
+    interp.console_lines: list[str] = []
+
+    def _log(*args):
+        interp.console_lines.append(" ".join(js_to_string(a) for a in args))
+        return UNDEFINED
+
+    for name in ("log", "warn", "error", "info", "debug"):
+        console[name] = _nf(_log, name)
+    g("console", console)
+
+    # JSON ---------------------------------------------------------------
+    json_obj = JSObject()
+    json_obj["stringify"] = _nf(
+        lambda value=UNDEFINED, replacer=None, space=0.0:
+            json_stringify(value, int(js_to_number(space) or 0)),
+        "stringify")
+    json_obj["parse"] = _nf(
+        lambda text=UNDEFINED: json_parse(js_to_string(text)), "parse")
+    g("JSON", json_obj)
+
+    # Object -------------------------------------------------------------
+    obj_ns = JSObject()
+    obj_ns["keys"] = _nf(
+        lambda o=UNDEFINED: JSArray(o.keys()) if isinstance(o, JSObject)
+        else JSArray(format_number(float(i)) for i in range(len(o)))
+        if isinstance(o, JSArray) else JSArray(), "keys")
+    obj_ns["values"] = _nf(
+        lambda o=UNDEFINED: JSArray(o.values()) if isinstance(o, JSObject)
+        else JSArray(o) if isinstance(o, JSArray) else JSArray(), "values")
+    obj_ns["entries"] = _nf(
+        lambda o=UNDEFINED: JSArray(
+            JSArray([k, v]) for k, v in o.items())
+        if isinstance(o, JSObject) else JSArray(), "entries")
+
+    def _assign(target=UNDEFINED, *sources):
+        for s in sources:
+            if isinstance(s, JSObject):
+                target.update(s)
+        return target
+
+    obj_ns["assign"] = _nf(_assign, "assign")
+    obj_ns["fromEntries"] = _nf(
+        lambda pairs=UNDEFINED: JSObject(
+            (js_to_string(p[0]), p[1]) for p in pairs), "fromEntries")
+    g("Object", obj_ns)
+
+    # Array --------------------------------------------------------------
+    arr_ns = JSObject()
+    arr_ns["isArray"] = _nf(lambda v=UNDEFINED: isinstance(v, JSArray),
+                            "isArray")
+
+    def _array_from(v=UNDEFINED, map_fn=None):
+        items = JSArray(interp._iterate(v)) if not isinstance(v, JSObject) \
+            else JSArray(
+                interp.get_index(v, float(i))
+                for i in range(int(js_to_number(v.get("length", 0.0)))))
+        if map_fn is not None and map_fn is not UNDEFINED:
+            items = JSArray(interp.call(map_fn, [x, float(i)])
+                            for i, x in enumerate(items))
+        return items
+
+    arr_ns["from"] = _nf(_array_from, "from")
+    g("Array", arr_ns)
+
+    # Math ---------------------------------------------------------------
+    math_obj = JSObject()
+    math_obj["floor"] = _nf(lambda v=UNDEFINED: float(math.floor(js_to_number(v))))
+    math_obj["ceil"] = _nf(lambda v=UNDEFINED: float(math.ceil(js_to_number(v))))
+    math_obj["round"] = _nf(
+        lambda v=UNDEFINED: float(math.floor(js_to_number(v) + 0.5)))
+    math_obj["abs"] = _nf(lambda v=UNDEFINED: abs(js_to_number(v)))
+    math_obj["min"] = _nf(lambda *a: min((js_to_number(x) for x in a),
+                                         default=float("inf")))
+    math_obj["max"] = _nf(lambda *a: max((js_to_number(x) for x in a),
+                                         default=float("-inf")))
+    math_obj["trunc"] = _nf(lambda v=UNDEFINED: float(math.trunc(js_to_number(v))))
+    math_obj["sqrt"] = _nf(lambda v=UNDEFINED: math.sqrt(js_to_number(v)))
+    math_obj["pow"] = _nf(lambda a=UNDEFINED, b=UNDEFINED:
+                          js_to_number(a) ** js_to_number(b))
+    g("Math", math_obj)
+
+    # primitives / conversions -------------------------------------------
+    number_fn = _nf(lambda v=0.0: js_to_number(v), "Number")
+    number_fn.js_get = lambda prop: {  # type: ignore[attr-defined]
+        "isInteger": _nf(lambda v=UNDEFINED: isinstance(v, float)
+                         and not math.isnan(v) and not math.isinf(v)
+                         and v == int(v)),
+        "isFinite": _nf(lambda v=UNDEFINED: isinstance(v, float)
+                        and math.isfinite(v)),
+        "isNaN": _nf(lambda v=UNDEFINED: isinstance(v, float)
+                     and math.isnan(v)),
+        "parseFloat": _nf(_parse_float),
+        "parseInt": _nf(_parse_int),
+        "MAX_SAFE_INTEGER": float(2**53 - 1),
+    }.get(prop, UNDEFINED)
+    g("Number", number_fn)
+    g("String", _nf(lambda v="": js_to_string(v), "String"))
+    g("Boolean", _nf(lambda v=UNDEFINED: js_truthy(v), "Boolean"))
+    g("parseInt", _nf(_parse_int, "parseInt"))
+    g("parseFloat", _nf(_parse_float, "parseFloat"))
+    g("isNaN", _nf(lambda v=UNDEFINED: math.isnan(js_to_number(v)), "isNaN"))
+
+    # Error constructors --------------------------------------------------
+    for name in ("Error", "TypeError", "RangeError", "SyntaxError"):
+        g(name, _error_ctor(name))
+
+    # Set -----------------------------------------------------------------
+    set_ctor = _nf(lambda it=UNDEFINED: JSSet(
+        () if it is UNDEFINED or it is None else interp._iterate(it)), "Set")
+    set_ctor.js_construct = lambda args: JSSet(  # type: ignore[attr-defined]
+        () if not args or args[0] is UNDEFINED or args[0] is None
+        else interp._iterate(args[0]))
+    g("Set", set_ctor)
+
+    # Promise -------------------------------------------------------------
+    promise_ns = JSObject()
+
+    def _resolved(v=UNDEFINED):
+        p = JSPromise(interp)
+        p.resolve(v)
+        return p
+
+    def _rejected(v=UNDEFINED):
+        p = JSPromise(interp)
+        p.reject(v)
+        return p
+
+    def _all(items=UNDEFINED):
+        arr = list(interp._iterate(items))
+        out = JSPromise(interp)
+        results = JSArray([UNDEFINED] * len(arr))
+        remaining = [len(arr)]
+        if not arr:
+            out.resolve(results)
+            return out
+        for i, item in enumerate(arr):
+            p = item if isinstance(item, JSPromise) else _resolved(item)
+
+            def ok(v, i=i):
+                results[i] = v
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    out.resolve(results)
+
+            p.then_native(ok, out.reject)
+        return out
+
+    promise_ns["resolve"] = _nf(_resolved, "resolve")
+    promise_ns["reject"] = _nf(_rejected, "reject")
+    promise_ns["all"] = _nf(_all, "all")
+
+    def _promise_construct(args):
+        executor = args[0] if args else UNDEFINED
+        p = JSPromise(interp)
+        interp.call(executor, [
+            _nf(lambda v=UNDEFINED: p.resolve(v), "resolve"),
+            _nf(lambda v=UNDEFINED: p.reject(v), "reject"),
+        ])
+        return p
+
+    promise_ns.js_construct = _promise_construct  # type: ignore[attr-defined]
+    g("Promise", promise_ns)
+
+    g("globalThis", _GlobalThis(interp))
+
+
+class _GlobalThis:
+    def __init__(self, interp: Interpreter):
+        self._interp = interp
+
+    def js_get(self, name):
+        if self._interp.globals.has(name):
+            return self._interp.globals.lookup(name)
+        return UNDEFINED
+
+    def js_set(self, name, value):
+        self._interp.globals.declare(name, value)
+
+
+def _error_ctor(name: str) -> NativeFunction:
+    def ctor(message=UNDEFINED):
+        return make_error(
+            "" if message is UNDEFINED else js_to_string(message), name=name)
+
+    fn = _nf(ctor, name)
+    fn.js_construct = lambda args: ctor(*args[:1])  # type: ignore[attr-defined]
+    return fn
+
+
+def _parse_int(v=UNDEFINED, radix=UNDEFINED):
+    s = js_to_string(v).strip()
+    base = int(js_to_number(radix)) if radix is not UNDEFINED and \
+        not math.isnan(js_to_number(radix)) else 10
+    m = re.match(r"[+-]?[0-9a-zA-Z]+", s)
+    if not m:
+        return float("nan")
+    text = m.group(0)
+    try:
+        # trim until parseable in base (JS stops at the first bad char)
+        while text and text not in "+-":
+            try:
+                return float(int(text, base))
+            except ValueError:
+                text = text[:-1]
+        return float("nan")
+    except ValueError:
+        return float("nan")
+
+
+def _parse_float(v=UNDEFINED):
+    s = js_to_string(v).strip()
+    m = re.match(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?", s)
+    return float(m.group(0)) if m else float("nan")
+
+
+# ---------------------------------------------------------------------------
+# per-type methods
+# ---------------------------------------------------------------------------
+
+def string_method(interp: Interpreter, s: str, prop: str) -> Optional[NativeFunction]:
+    def replace(pattern=UNDEFINED, repl=UNDEFINED):
+        if isinstance(pattern, JSRegExp):
+            count = 0 if pattern.global_ else 1
+            if callable(repl) or isinstance(repl, (JSFunction, NativeFunction)):
+                return pattern.pattern.sub(
+                    lambda m: js_to_string(
+                        interp.call(repl, [m.group(0),
+                                           *[g if g is not None else UNDEFINED
+                                             for g in m.groups()]])),
+                    s, count=count)
+            text = js_to_string(repl)
+            return pattern.pattern.sub(lambda m: text, s, count=count)
+        needle = js_to_string(pattern)
+        text = js_to_string(repl)
+        return s.replace(needle, text, 1)
+
+    def split(sep=UNDEFINED, limit=UNDEFINED):
+        if sep is UNDEFINED:
+            return JSArray([s])
+        if isinstance(sep, JSRegExp):
+            parts = sep.pattern.split(s)
+            # drop capture groups the Python split interleaves
+            if sep.pattern.groups:
+                parts = parts[::sep.pattern.groups + 1]
+            return JSArray(parts)
+        sep = js_to_string(sep)
+        if sep == "":
+            return JSArray(list(s))
+        return JSArray(s.split(sep))
+
+    def _idx(v, default):
+        if v is UNDEFINED:
+            return default
+        i = int(js_to_number(v))
+        return max(len(s) + i, 0) if i < 0 else min(i, len(s))
+
+    table = {
+        "replace": replace,
+        "replaceAll": lambda pattern=UNDEFINED, repl=UNDEFINED:
+            s.replace(js_to_string(pattern), js_to_string(repl))
+            if not isinstance(pattern, JSRegExp) else replace(pattern, repl),
+        "split": split,
+        "trim": lambda: s.strip(),
+        "trimStart": lambda: s.lstrip(),
+        "trimEnd": lambda: s.rstrip(),
+        "includes": lambda needle=UNDEFINED: js_to_string(needle) in s,
+        "indexOf": lambda needle=UNDEFINED:
+            float(s.find(js_to_string(needle))),
+        "lastIndexOf": lambda needle=UNDEFINED:
+            float(s.rfind(js_to_string(needle))),
+        "startsWith": lambda needle=UNDEFINED:
+            s.startswith(js_to_string(needle)),
+        "endsWith": lambda needle=UNDEFINED: s.endswith(js_to_string(needle)),
+        "toLowerCase": lambda: s.lower(),
+        "toUpperCase": lambda: s.upper(),
+        "slice": lambda a=UNDEFINED, b=UNDEFINED: s[_idx(a, 0):_idx(b, len(s))],
+        "substring": lambda a=UNDEFINED, b=UNDEFINED:
+            s[min(_idx(a, 0), _idx(b, len(s))):max(_idx(a, 0), _idx(b, len(s)))],
+        "charAt": lambda i=0.0: s[int(js_to_number(i))]
+            if 0 <= int(js_to_number(i)) < len(s) else "",
+        "charCodeAt": lambda i=0.0: float(ord(s[int(js_to_number(i))]))
+            if 0 <= int(js_to_number(i)) < len(s) else float("nan"),
+        "concat": lambda *a: s + "".join(js_to_string(x) for x in a),
+        "repeat": lambda nrep=0.0: s * int(js_to_number(nrep)),
+        "padStart": lambda width=0.0, fill=" ":
+            _pad(s, int(js_to_number(width)), js_to_string(fill), True),
+        "padEnd": lambda width=0.0, fill=" ":
+            _pad(s, int(js_to_number(width)), js_to_string(fill), False),
+        "match": lambda pattern=UNDEFINED: _str_match(s, pattern),
+        "toString": lambda: s,
+    }
+    fn = table.get(prop)
+    return _nf(fn, prop) if fn is not None else None
+
+
+def _pad(s: str, width: int, fill: str, start: bool) -> str:
+    if len(s) >= width or not fill:
+        return s
+    pad = (fill * width)[:width - len(s)]
+    return pad + s if start else s + pad
+
+
+def _str_match(s: str, pattern):
+    if not isinstance(pattern, JSRegExp):
+        pattern = JSRegExp(js_to_string(pattern), "")
+    if pattern.global_:
+        return JSArray(m.group(0) for m in pattern.pattern.finditer(s)) \
+            or None
+    m = pattern.pattern.search(s)
+    if m is None:
+        return None
+    out = JSArray([m.group(0), *[g if g is not None else UNDEFINED
+                                 for g in m.groups()]])
+    return out
+
+
+def array_method(interp: Interpreter, arr: JSArray, prop: str) -> Optional[NativeFunction]:
+    call = interp.call
+
+    def _cb(fn, x, i):
+        return call(fn, [x, float(i), arr])
+
+    def splice(start=0.0, delete_count=UNDEFINED, *items):
+        i = int(js_to_number(start))
+        if i < 0:
+            i = max(len(arr) + i, 0)
+        dc = len(arr) - i if delete_count is UNDEFINED \
+            else max(0, int(js_to_number(delete_count)))
+        removed = JSArray(arr[i:i + dc])
+        arr[i:i + dc] = list(items)
+        return removed
+
+    def sort(cmp=UNDEFINED):
+        import functools
+        if cmp is UNDEFINED:
+            arr.sort(key=js_to_string)
+        else:
+            arr.sort(key=functools.cmp_to_key(
+                lambda a, b: (lambda r: (r > 0) - (r < 0))(
+                    js_to_number(call(cmp, [a, b])))))
+        return arr
+
+    def reduce(fn=UNDEFINED, *init):
+        if not arr and not init:
+            raise JSException(make_error(
+                "Reduce of empty array with no initial value",
+                name="TypeError"))
+        items = list(arr)
+        if init:
+            acc = init[0]
+            start = 0
+        else:
+            acc = items[0]
+            start = 1
+        for i in range(start, len(items)):
+            acc = call(fn, [acc, items[i], float(i), arr])
+        return acc
+
+    def index_of(needle=UNDEFINED):
+        for i, x in enumerate(arr):
+            if strict_equals(x, needle):
+                return float(i)
+        return -1.0
+
+    def flat(depth=1.0):
+        d = int(js_to_number(depth))
+
+        def go(a, d):
+            out = []
+            for x in a:
+                if isinstance(x, JSArray) and d > 0:
+                    out.extend(go(x, d - 1))
+                else:
+                    out.append(x)
+            return out
+        return JSArray(go(arr, d))
+
+    table = {
+        "push": lambda *items: (arr.extend(items), float(len(arr)))[1],
+        "pop": lambda: arr.pop() if arr else UNDEFINED,
+        "shift": lambda: arr.pop(0) if arr else UNDEFINED,
+        "unshift": lambda *items: (arr.__setitem__(
+            slice(0, 0), list(items)), float(len(arr)))[1],
+        "map": lambda fn=UNDEFINED: JSArray(
+            _cb(fn, x, i) for i, x in enumerate(list(arr))),
+        "filter": lambda fn=UNDEFINED: JSArray(
+            x for i, x in enumerate(list(arr)) if js_truthy(_cb(fn, x, i))),
+        "forEach": lambda fn=UNDEFINED: (
+            [_cb(fn, x, i) for i, x in enumerate(list(arr))], UNDEFINED)[1],
+        "find": lambda fn=UNDEFINED: next(
+            (x for i, x in enumerate(list(arr)) if js_truthy(_cb(fn, x, i))),
+            UNDEFINED),
+        "findIndex": lambda fn=UNDEFINED: next(
+            (float(i) for i, x in enumerate(list(arr))
+             if js_truthy(_cb(fn, x, i))), -1.0),
+        "some": lambda fn=UNDEFINED: any(
+            js_truthy(_cb(fn, x, i)) for i, x in enumerate(list(arr))),
+        "every": lambda fn=UNDEFINED: all(
+            js_truthy(_cb(fn, x, i)) for i, x in enumerate(list(arr))),
+        "join": lambda sep=",": js_to_string(sep).join(
+            "" if x is UNDEFINED or x is None else js_to_string(x)
+            for x in arr),
+        "indexOf": index_of,
+        "includes": lambda needle=UNDEFINED: any(
+            strict_equals(x, needle) for x in arr),
+        "slice": lambda a=UNDEFINED, b=UNDEFINED: JSArray(
+            arr[_slice_idx(arr, a, 0):_slice_idx(arr, b, len(arr))]),
+        "splice": splice,
+        "concat": lambda *others: JSArray(
+            list(arr) + [y for o in others for y in
+                         (list(o) if isinstance(o, JSArray) else [o])]),
+        "reverse": lambda: (arr.reverse(), arr)[1],
+        "sort": sort,
+        "reduce": reduce,
+        "flat": flat,
+        "flatMap": lambda fn=UNDEFINED: JSArray(
+            y for i, x in enumerate(list(arr))
+            for y in (lambda r: list(r) if isinstance(r, JSArray) else [r])(
+                _cb(fn, x, i))),
+        "keys": lambda: JSArray(float(i) for i in range(len(arr))),
+        "entries": lambda: JSArray(
+            JSArray([float(i), x]) for i, x in enumerate(arr)),
+        "toString": lambda: js_to_string(arr),
+    }
+    fn = table.get(prop)
+    return _nf(fn, prop) if fn is not None else None
+
+
+def _slice_idx(arr, v, default):
+    if v is UNDEFINED:
+        return default
+    i = int(js_to_number(v))
+    return max(len(arr) + i, 0) if i < 0 else min(i, len(arr))
+
+
+def object_method(interp: Interpreter, obj: JSObject, prop: str):
+    if prop == "hasOwnProperty":
+        return _nf(lambda k=UNDEFINED: js_to_string(k) in obj,
+                   "hasOwnProperty")
+    if prop == "toString":
+        return _nf(lambda: js_to_string(obj), "toString")
+    return None
+
+
+def promise_method(interp: Interpreter, p: JSPromise, prop: str):
+    if prop == "then":
+        def then(on_ok=UNDEFINED, on_err=UNDEFINED):
+            ok = (lambda v: interp.call(on_ok, [v])) \
+                if on_ok is not UNDEFINED and on_ok is not None else None
+            err = (lambda v: interp.call(on_err, [v])) \
+                if on_err is not UNDEFINED and on_err is not None else None
+            return p.then_native(ok, err)
+        return _nf(then, "then")
+    if prop == "catch":
+        def catch(on_err=UNDEFINED):
+            err = (lambda v: interp.call(on_err, [v])) \
+                if on_err is not UNDEFINED else None
+            return p.then_native(None, err)
+        return _nf(catch, "catch")
+    if prop == "finally":
+        def finally_(cb=UNDEFINED):
+            def run_ok(v):
+                interp.call(cb, [])
+                return v
+
+            def run_err(e):
+                interp.call(cb, [])
+                raise JSException(e)
+            return p.then_native(run_ok, run_err)
+        return _nf(finally_, "finally")
+    return UNDEFINED
+
+
+def set_method(interp: Interpreter, s: JSSet, prop: str):
+    if prop == "size":
+        return float(len(s.items))
+    table = {
+        "add": lambda v=UNDEFINED: s.add(v),
+        "has": lambda v=UNDEFINED: s.has(v),
+        "delete": lambda v=UNDEFINED: _set_delete(s, v),
+        "forEach": lambda fn=UNDEFINED: (
+            [interp.call(fn, [x, x, s]) for x in list(s.items)], UNDEFINED)[1],
+        "clear": lambda: (s.items.clear(), UNDEFINED)[1],
+    }
+    fn = table.get(prop)
+    return _nf(fn, prop) if fn is not None else UNDEFINED
+
+
+def _set_delete(s: JSSet, v) -> bool:
+    for i, x in enumerate(s.items):
+        if strict_equals(x, v):
+            del s.items[i]
+            return True
+    return False
+
+
+def regexp_method(interp: Interpreter, r: JSRegExp, prop: str):
+    if prop == "source":
+        return r.source
+    if prop == "flags":
+        return r.flags
+    if prop == "test":
+        return _nf(lambda s=UNDEFINED:
+                   r.pattern.search(js_to_string(s)) is not None, "test")
+    if prop == "exec":
+        def exec_(s=UNDEFINED):
+            m = r.pattern.search(js_to_string(s))
+            if m is None:
+                return None
+            return JSArray([m.group(0),
+                            *[g if g is not None else UNDEFINED
+                              for g in m.groups()]])
+        return _nf(exec_, "exec")
+    return UNDEFINED
+
+
+def number_method(interp: Interpreter, f: float, prop: str):
+    table = {
+        "toFixed": lambda digits=0.0:
+            f"{f:.{int(js_to_number(digits))}f}",
+        "toString": lambda: format_number(f),
+        "toPrecision": lambda digits=UNDEFINED: format_number(f)
+            if digits is UNDEFINED else f"{f:.{int(js_to_number(digits))}g}",
+    }
+    fn = table.get(prop)
+    return _nf(fn, prop) if fn is not None else UNDEFINED

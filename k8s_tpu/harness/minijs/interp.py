@@ -1,0 +1,1078 @@
+"""minijs evaluator: tree-walking interpreter over parser.py's AST.
+
+Value model (JS -> Python):
+  undefined -> UNDEFINED singleton        null  -> None
+  number    -> float                      string -> str
+  boolean   -> bool                       object -> JSObject (dict subclass)
+  array     -> JSArray (list subclass)    function -> JSFunction / callable
+  plus JSRegExp, JSSet, JSPromise.
+
+Host objects (the DOM shim) plug in via a duck-typed protocol:
+``js_get(name)`` / ``js_set(name, value)``; anything exposing it can be
+read, written, and have its returned callables invoked from script.
+
+Async model: single-threaded with a synchronous microtask queue.  ``await``
+drains the queue until its promise settles — the host's fetch() resolves
+promises synchronously, so the SPA's entire async surface runs
+deterministically inside one test process.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import math
+import re
+from typing import Any, Callable, Optional
+
+from k8s_tpu.harness.minijs.parser import parse
+
+
+class _Undefined:
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "undefined"
+
+    def __bool__(self):
+        return False
+
+
+UNDEFINED = _Undefined()
+
+
+class JSError(Exception):
+    """Host-level interpreter error (unsupported construct, engine bug)."""
+
+
+class JSException(Exception):
+    """A JS ``throw``; ``value`` is the thrown JS value."""
+
+    def __init__(self, value):
+        self.value = value
+        super().__init__(js_to_string(value) if not isinstance(value, JSObject)
+                         else str(value.get("message", "Error")))
+
+
+class JSObject(dict):
+    """A plain JS object; insertion-ordered like real JS string keys."""
+
+
+class JSArray(list):
+    pass
+
+
+class JSSet:
+    def __init__(self, items=()):
+        self.items: list = []
+        for x in items:
+            self.add(x)
+
+    def add(self, x):
+        if not any(strict_equals(x, y) for y in self.items):
+            self.items.append(x)
+        return self
+
+    def has(self, x) -> bool:
+        return any(strict_equals(x, y) for y in self.items)
+
+    def __iter__(self):
+        return iter(self.items)
+
+
+class JSRegExp:
+    def __init__(self, source: str, flags: str):
+        self.source = source
+        self.flags = flags
+        py_flags = re.IGNORECASE if "i" in flags else 0
+        self.pattern = re.compile(source, py_flags)
+        self.global_ = "g" in flags
+
+    def __repr__(self):
+        return f"/{self.source}/{self.flags}"
+
+
+class JSPromise:
+    PENDING, FULFILLED, REJECTED = "pending", "fulfilled", "rejected"
+
+    def __init__(self, interp: "Interpreter"):
+        self.interp = interp
+        self.state = self.PENDING
+        self.value: Any = UNDEFINED
+        self._callbacks: list[tuple[Optional[Callable], Optional[Callable],
+                                    "JSPromise"]] = []
+
+    # -- settling ----------------------------------------------------------
+
+    def resolve(self, value) -> None:
+        if self.state != self.PENDING:
+            return
+        if isinstance(value, JSPromise):  # chain through
+            value._on_settled(self.resolve, self.reject)
+            return
+        self.state = self.FULFILLED
+        self.value = value
+        self._flush()
+
+    def reject(self, value) -> None:
+        if self.state != self.PENDING:
+            return
+        self.state = self.REJECTED
+        self.value = value
+        self._flush()
+
+    def _on_settled(self, on_ok, on_err) -> None:
+        def cb():
+            (on_ok if self.state == self.FULFILLED else on_err)(self.value)
+        if self.state == self.PENDING:
+            self._callbacks.append((None, None, None))
+            # simplest chaining: register via then-machinery
+            self.then_native(lambda v: on_ok(v), lambda e: on_err(e))
+        else:
+            self.interp.microtasks.append(cb)
+
+    def _flush(self) -> None:
+        for on_ok, on_err, out in self._callbacks:
+            self._schedule(on_ok, on_err, out)
+        self._callbacks = []
+
+    def _schedule(self, on_ok, on_err, out: Optional["JSPromise"]) -> None:
+        state, value, interp = self.state, self.value, self.interp
+
+        def task():
+            handler = on_ok if state == self.FULFILLED else on_err
+            if handler is None:  # pass-through
+                if out is not None:
+                    (out.resolve if state == self.FULFILLED else out.reject)(value)
+                return
+            try:
+                result = handler(value)
+            except JSException as e:
+                if out is not None:
+                    out.reject(e.value)
+                return
+            if out is not None:
+                out.resolve(result)
+        interp.microtasks.append(task)
+
+    def then_native(self, on_ok, on_err) -> "JSPromise":
+        out = JSPromise(self.interp)
+        if self.state == self.PENDING:
+            self._callbacks.append((on_ok, on_err, out))
+        else:
+            self._schedule(on_ok, on_err, out)
+        return out
+
+
+class Environment:
+    __slots__ = ("vars", "parent")
+
+    def __init__(self, parent: Optional["Environment"] = None):
+        self.vars: dict[str, Any] = {}
+        self.parent = parent
+
+    def lookup(self, name: str):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        raise JSException(make_error(f"{name} is not defined",
+                                     name="ReferenceError"))
+
+    def has(self, name: str) -> bool:
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return True
+            env = env.parent
+        return False
+
+    def set_existing(self, name: str, value) -> None:
+        env = self
+        while env is not None:
+            if name in env.vars:
+                env.vars[name] = value
+                return
+            env = env.parent
+        # implicit global (sloppy mode) — the SPA doesn't rely on it, but
+        # attribute handlers assigning globals shouldn't crash the harness
+        root = self
+        while root.parent is not None:
+            root = root.parent
+        root.vars[name] = value
+
+    def declare(self, name: str, value) -> None:
+        self.vars[name] = value
+
+
+class JSFunction:
+    def __init__(self, node: dict, env: Environment, interp: "Interpreter"):
+        self.node = node
+        self.env = env
+        self.interp = interp
+        self.name = node.get("name") or ""
+
+    def __call__(self, *args):  # host-side convenience
+        return self.interp.call(self, list(args), UNDEFINED)
+
+
+class NativeFunction:
+    # no __slots__: hosts attach js_get / js_construct hooks ad hoc
+
+    def __init__(self, fn: Callable, name: str = ""):
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", "")
+
+    def __call__(self, *args):
+        return self.fn(*args)
+
+
+def make_error(message: str, name: str = "Error") -> JSObject:
+    e = JSObject()
+    e["name"] = name
+    e["message"] = message
+    e["__is_error__"] = True
+    return e
+
+
+# -- conversions -----------------------------------------------------------
+
+def js_truthy(v) -> bool:
+    if v is UNDEFINED or v is None or v is False:
+        return False
+    if isinstance(v, float):
+        return not (v == 0 or math.isnan(v))
+    if isinstance(v, str):
+        return v != ""
+    if v is True:
+        return True
+    return True
+
+
+def js_to_string(v) -> str:
+    if v is UNDEFINED:
+        return "undefined"
+    if v is None:
+        return "null"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float):
+        return format_number(v)
+    if isinstance(v, str):
+        return v
+    if isinstance(v, JSArray):
+        return ",".join("" if x is UNDEFINED or x is None else js_to_string(x)
+                        for x in v)
+    if isinstance(v, JSObject):
+        if v.get("__is_error__"):
+            return f"{v.get('name', 'Error')}: {v.get('message', '')}"
+        return "[object Object]"
+    if isinstance(v, (JSFunction, NativeFunction)):
+        return f"function {getattr(v, 'name', '')}() {{ [code] }}"
+    if isinstance(v, JSRegExp):
+        return repr(v)
+    return str(v)
+
+
+def format_number(f: float) -> str:
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "Infinity" if f > 0 else "-Infinity"
+    if f == int(f) and abs(f) < 1e21:
+        return str(int(f))
+    return repr(f)
+
+
+def js_to_number(v) -> float:
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, float):
+        return v
+    if v is UNDEFINED:
+        return float("nan")
+    if v is None:
+        return 0.0
+    if isinstance(v, str):
+        s = v.strip()
+        if not s:
+            return 0.0
+        try:
+            return float(int(s, 16)) if s.lower().startswith("0x") else float(s)
+        except ValueError:
+            return float("nan")
+    if isinstance(v, JSArray):
+        if not v:
+            return 0.0
+        if len(v) == 1:
+            return js_to_number(v[0])
+    return float("nan")
+
+
+def strict_equals(a, b) -> bool:
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, float) and isinstance(b, float):
+        return a == b
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    if a is UNDEFINED or b is UNDEFINED or a is None or b is None:
+        return a is b
+    return a is b  # objects: identity
+
+
+def loose_equals(a, b) -> bool:
+    if (a is None or a is UNDEFINED) and (b is None or b is UNDEFINED):
+        return True
+    if isinstance(a, (float, str, bool)) and isinstance(b, (float, str, bool)):
+        return js_to_number(a) == js_to_number(b) if not (
+            isinstance(a, str) and isinstance(b, str)) else a == b
+    return strict_equals(a, b)
+
+
+# -- control-flow signals ----------------------------------------------------
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class Interpreter:
+    """One global realm.  ``run(src)`` executes a program in the realm's
+    global environment; ``drain()`` runs queued microtasks to quiescence."""
+
+    MAX_MICROTASK_ROUNDS = 100_000
+
+    def __init__(self):
+        self.globals = Environment()
+        self.microtasks: list[Callable] = []
+        from k8s_tpu.harness.minijs.builtins import install_globals
+        install_globals(self)
+
+    # -- host API ----------------------------------------------------------
+
+    def run(self, src: str, env: Optional[Environment] = None):
+        program = parse(src)
+        env = env or self.globals
+        result = UNDEFINED
+        self._hoist(program["body"], env)
+        for stmt in program["body"]:
+            result = self.exec_stmt(stmt, env)
+        self.drain()
+        return result
+
+    def drain(self) -> None:
+        rounds = 0
+        while self.microtasks:
+            rounds += 1
+            if rounds > self.MAX_MICROTASK_ROUNDS:
+                raise JSError("microtask queue did not quiesce")
+            task = self.microtasks.pop(0)
+            task()
+
+    def define(self, name: str, value) -> None:
+        self.globals.declare(name, value)
+
+    def native(self, fn: Callable, name: str = "") -> NativeFunction:
+        return NativeFunction(fn, name)
+
+    def call(self, fn, args: list, this=UNDEFINED):
+        """Invoke a JS or native function from host or script."""
+        if isinstance(fn, NativeFunction):
+            return fn.fn(*args)
+        if isinstance(fn, JSFunction):
+            return self._call_jsfunction(fn, args, this)
+        if callable(fn):
+            return fn(*args)
+        raise JSException(make_error(
+            f"{js_to_string(fn)} is not a function", name="TypeError"))
+
+    def _call_jsfunction(self, fn: JSFunction, args: list, this):
+        node = fn.node
+        env = Environment(fn.env)
+        if not node["is_arrow"]:
+            env.declare("this", this)
+            env.declare("arguments", JSArray(args))
+        self._bind_params(node["params"], args, env)
+        if node["is_async"]:
+            promise = JSPromise(self)
+            try:
+                self._exec_body(node["body"], env)
+                promise.resolve(UNDEFINED)
+            except _Return as r:
+                promise.resolve(r.value)
+            except JSException as e:
+                promise.reject(e.value)
+            return promise
+        try:
+            self._exec_body(node["body"], env)
+        except _Return as r:
+            return r.value
+        return UNDEFINED
+
+    def _bind_params(self, params: list[dict], args: list, env: Environment):
+        i = 0
+        for p in params:
+            if p["rest"]:
+                self._bind_target(p["target"], JSArray(args[i:]), env)
+                return
+            value = args[i] if i < len(args) else UNDEFINED
+            if value is UNDEFINED and p["default"] is not None:
+                value = self.eval(p["default"], env)
+            self._bind_target(p["target"], value, env)
+            i += 1
+
+    def _bind_target(self, target: dict, value, env: Environment):
+        t = target["t"]
+        if t == "Ident":
+            env.declare(target["name"], value)
+        elif t == "ArrayPattern":
+            items = list(self._iterate(value))
+            for k, el in enumerate(target["elements"]):
+                if el is None:
+                    continue
+                self._bind_target(el, items[k] if k < len(items) else UNDEFINED,
+                                  env)
+        elif t == "ObjectPattern":
+            for key, sub in target["props"]:
+                self._bind_target(sub, self.get_member(value, key), env)
+        else:
+            raise JSError(f"bad binding target {t}")
+
+    def _exec_body(self, block: dict, env: Environment) -> None:
+        self._hoist(block["body"], env)
+        for stmt in block["body"]:
+            self.exec_stmt(stmt, env)
+
+    def _hoist(self, stmts: list[dict], env: Environment) -> None:
+        for s in stmts:
+            if s["t"] == "FuncDecl":
+                env.declare(s["name"], JSFunction(s["fn"], env, self))
+
+    # -- statements --------------------------------------------------------
+
+    def exec_stmt(self, node: dict, env: Environment):
+        t = node["t"]
+        if t == "ExprStmt":
+            return self.eval(node["expr"], env)
+        if t == "VarDecl":
+            for target, init in node["decls"]:
+                value = UNDEFINED if init is None else self.eval(init, env)
+                self._bind_target(target, value, env)
+            return UNDEFINED
+        if t == "FuncDecl":
+            env.declare(node["name"], JSFunction(node["fn"], env, self))
+            return UNDEFINED
+        if t == "If":
+            if js_truthy(self.eval(node["test"], env)):
+                self.exec_stmt(node["cons"], env)
+            elif node["alt"] is not None:
+                self.exec_stmt(node["alt"], env)
+            return UNDEFINED
+        if t == "Block":
+            block_env = Environment(env)
+            self._hoist(node["body"], block_env)
+            for s in node["body"]:
+                self.exec_stmt(s, block_env)
+            return UNDEFINED
+        if t == "Return":
+            raise _Return(UNDEFINED if node["arg"] is None
+                          else self.eval(node["arg"], env))
+        if t == "Throw":
+            raise JSException(self.eval(node["arg"], env))
+        if t == "Break":
+            raise _Break()
+        if t == "Continue":
+            raise _Continue()
+        if t == "While":
+            while js_truthy(self.eval(node["test"], env)):
+                try:
+                    self.exec_stmt(node["body"], Environment(env))
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return UNDEFINED
+        if t == "DoWhile":
+            while True:
+                try:
+                    self.exec_stmt(node["body"], Environment(env))
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if not js_truthy(self.eval(node["test"], env)):
+                    break
+            return UNDEFINED
+        if t == "For":
+            loop_env = Environment(env)
+            if node["init"] is not None:
+                self.exec_stmt(node["init"], loop_env)
+            while node["test"] is None or js_truthy(
+                    self.eval(node["test"], loop_env)):
+                try:
+                    self.exec_stmt(node["body"], Environment(loop_env))
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if node["update"] is not None:
+                    self.eval(node["update"], loop_env)
+            return UNDEFINED
+        if t == "ForOf":
+            iterable = self.eval(node["iter"], env)
+            for item in self._iterate(iterable):
+                it_env = Environment(env)
+                if node["kind"] is None:
+                    self._assign_target(node["target"], item, env)
+                else:
+                    self._bind_target(node["target"], item, it_env)
+                try:
+                    self.exec_stmt(node["body"], it_env)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return UNDEFINED
+        if t == "ForIn":
+            obj = self.eval(node["iter"], env)
+            keys = list(obj.keys()) if isinstance(obj, JSObject) else \
+                [format_number(float(i)) for i in range(len(obj))] \
+                if isinstance(obj, JSArray) else []
+            for key in keys:
+                it_env = Environment(env)
+                if node["kind"] is None:
+                    self._assign_target(node["target"], key, env)
+                else:
+                    self._bind_target(node["target"], key, it_env)
+                try:
+                    self.exec_stmt(node["body"], it_env)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return UNDEFINED
+        if t == "Try":
+            try:
+                self.exec_stmt(node["block"], env)
+            except JSException as e:
+                if node["handler"] is not None:
+                    catch_env = Environment(env)
+                    if node["param"] is not None:
+                        self._bind_target(node["param"], e.value, catch_env)
+                    self.exec_stmt(node["handler"], catch_env)
+                elif node["finalizer"] is None:
+                    raise
+                else:
+                    self.exec_stmt(node["finalizer"], env)
+                    raise
+            finally:
+                if node["finalizer"] is not None:
+                    self.exec_stmt(node["finalizer"], env)
+            return UNDEFINED
+        if t == "Empty":
+            return UNDEFINED
+        raise JSError(f"unsupported statement {t}")
+
+    # -- expressions -------------------------------------------------------
+
+    def eval(self, node: dict, env: Environment):
+        t = node["t"]
+        if t == "Num":
+            return node["value"]
+        if t == "Str":
+            return node["value"]
+        if t == "Bool":
+            return node["value"]
+        if t == "Null":
+            return None
+        if t == "Ident":
+            name = node["name"]
+            if name == "undefined":
+                return UNDEFINED
+            if name == "NaN":
+                return float("nan")
+            if name == "Infinity":
+                return float("inf")
+            return env.lookup(name)
+        if t == "This":
+            return env.lookup("this") if env.has("this") else UNDEFINED
+        if t == "Template":
+            out = []
+            for kind, part in node["quasis"]:
+                out.append(part if kind == "str"
+                           else js_to_string(self.eval(part, env)))
+            return "".join(out)
+        if t == "Regex":
+            return JSRegExp(node["source"], node["flags"])
+        if t == "Array":
+            arr = JSArray()
+            for el in node["elements"]:
+                if el["t"] == "Spread":
+                    arr.extend(self._iterate(self.eval(el["arg"], env)))
+                else:
+                    arr.append(self.eval(el, env))
+            return arr
+        if t == "Object":
+            obj = JSObject()
+            for key, value_node in node["props"]:
+                if key == "spread" and isinstance(value_node, dict) \
+                        and value_node.get("t") not in (None,):
+                    src = self.eval(value_node, env)
+                    if isinstance(src, JSObject):
+                        obj.update(src)
+                    continue
+                obj[key] = self.eval(value_node, env)
+            return obj
+        if t == "Func":
+            if node.get("name"):
+                # named function expression: the name is in scope inside
+                # its own body (for recursion) but not outside
+                fenv = Environment(env)
+                fn = JSFunction(node, fenv, self)
+                fenv.declare(node["name"], fn)
+                return fn
+            return JSFunction(node, env, self)
+        if t == "Member":
+            return self.get_member(self.eval(node["obj"], env), node["prop"])
+        if t == "Index":
+            obj = self.eval(node["obj"], env)
+            key = self.eval(node["expr"], env)
+            return self.get_index(obj, key)
+        if t == "Call":
+            return self._eval_call(node, env)
+        if t == "New":
+            callee = self.eval(node["callee"], env)
+            args = self._eval_args(node["args"], env)
+            ctor = getattr(callee, "js_construct", None)
+            if ctor is not None:
+                return ctor(args)
+            if isinstance(callee, (NativeFunction, JSFunction)):
+                return self.call(callee, args, UNDEFINED)
+            raise JSException(make_error("not a constructor", name="TypeError"))
+        if t == "Assign":
+            return self._eval_assign(node, env)
+        if t == "Cond":
+            return self.eval(node["cons"] if js_truthy(
+                self.eval(node["test"], env)) else node["alt"], env)
+        if t == "Logical":
+            left = self.eval(node["left"], env)
+            op = node["op"]
+            if op == "&&":
+                return self.eval(node["right"], env) if js_truthy(left) else left
+            if op == "||":
+                return left if js_truthy(left) else self.eval(node["right"], env)
+            # ??
+            return self.eval(node["right"], env) \
+                if left is None or left is UNDEFINED else left
+        if t == "Binary":
+            return self._eval_binary(node, env)
+        if t == "Unary":
+            return self._eval_unary(node, env)
+        if t == "Update":
+            old = js_to_number(self._eval_ref_get(node["target"], env))
+            new = old + (1.0 if node["op"] == "++" else -1.0)
+            self._assign_target(node["target"], new, env)
+            return new if node["prefix"] else old
+        if t == "Await":
+            return self._eval_await(node, env)
+        if t == "Sequence":
+            self.eval(node["left"], env)
+            return self.eval(node["right"], env)
+        if t == "Spread":
+            raise JSError("spread outside call/array/object")
+        raise JSError(f"unsupported expression {t}")
+
+    def _eval_ref_get(self, target: dict, env: Environment):
+        if target["t"] == "Ident":
+            return env.lookup(target["name"])
+        if target["t"] == "Member":
+            return self.get_member(self.eval(target["obj"], env), target["prop"])
+        if target["t"] == "Index":
+            return self.get_index(self.eval(target["obj"], env),
+                                  self.eval(target["expr"], env))
+        raise JSError("bad reference")
+
+    def _eval_call(self, node: dict, env: Environment):
+        callee = node["callee"]
+        args = self._eval_args(node["args"], env)
+        if callee["t"] == "Member":
+            obj = self.eval(callee["obj"], env)
+            fn = self.get_member(obj, callee["prop"])
+            return self.call(fn, args, this=obj)
+        if callee["t"] == "Index":
+            obj = self.eval(callee["obj"], env)
+            fn = self.get_index(obj, self.eval(callee["expr"], env))
+            return self.call(fn, args, this=obj)
+        fn = self.eval(callee, env)
+        return self.call(fn, args, UNDEFINED)
+
+    def _eval_args(self, arg_nodes: list[dict], env: Environment) -> list:
+        args = []
+        for a in arg_nodes:
+            if a["t"] == "Spread":
+                args.extend(self._iterate(self.eval(a["arg"], env)))
+            else:
+                args.append(self.eval(a, env))
+        return args
+
+    def _eval_assign(self, node: dict, env: Environment):
+        op = node["op"]
+        if op == "=":
+            value = self.eval(node["value"], env)
+        else:
+            current = self._eval_ref_get(node["target"], env)
+            rhs = self.eval(node["value"], env)
+            binop = op[:-1]
+            value = self._binary_op(binop, current, rhs)
+        self._assign_target(node["target"], value, env)
+        return value
+
+    def _assign_target(self, target: dict, value, env: Environment) -> None:
+        t = target["t"]
+        if t == "Ident":
+            env.set_existing(target["name"], value)
+        elif t == "Member":
+            self.set_member(self.eval(target["obj"], env), target["prop"], value)
+        elif t == "Index":
+            obj = self.eval(target["obj"], env)
+            key = self.eval(target["expr"], env)
+            self.set_index(obj, key, value)
+        elif t == "ArrayPattern":
+            items = list(self._iterate(value))
+            for k, el in enumerate(target["elements"]):
+                if el is not None:
+                    self._assign_target(
+                        el, items[k] if k < len(items) else UNDEFINED, env)
+        else:
+            raise JSError(f"bad assignment target {t}")
+
+    def _eval_binary(self, node: dict, env: Environment):
+        op = node["op"]
+        left = self.eval(node["left"], env)
+        right = self.eval(node["right"], env)
+        return self._binary_op(op, left, right)
+
+    def _binary_op(self, op: str, left, right):
+        if op == "+":
+            if isinstance(left, str) or isinstance(right, str) or \
+                    isinstance(left, (JSArray, JSObject)) or \
+                    isinstance(right, (JSArray, JSObject)):
+                return js_to_string(left) + js_to_string(right)
+            return js_to_number(left) + js_to_number(right)
+        if op == "-":
+            return js_to_number(left) - js_to_number(right)
+        if op == "*":
+            return js_to_number(left) * js_to_number(right)
+        if op == "/":
+            rn = js_to_number(right)
+            ln = js_to_number(left)
+            if rn == 0:
+                if math.isnan(rn) or math.isnan(ln) or ln == 0:
+                    return float("nan")
+                return math.copysign(float("inf"), ln) * math.copysign(1, rn)
+            return ln / rn
+        if op == "%":
+            rn = js_to_number(right)
+            ln = js_to_number(left)
+            if rn == 0 or math.isnan(rn) or math.isnan(ln) or math.isinf(ln):
+                return float("nan")
+            return math.fmod(ln, rn)
+        if op == "===":
+            return strict_equals(left, right)
+        if op == "!==":
+            return not strict_equals(left, right)
+        if op == "==":
+            return loose_equals(left, right)
+        if op == "!=":
+            return not loose_equals(left, right)
+        if op in ("<", ">", "<=", ">="):
+            if isinstance(left, str) and isinstance(right, str):
+                a, b = left, right
+            else:
+                a, b = js_to_number(left), js_to_number(right)
+                if math.isnan(a) or math.isnan(b):
+                    return False
+            return {"<": a < b, ">": a > b, "<=": a <= b, ">=": a >= b}[op]
+        if op == "in":
+            if isinstance(right, JSObject):
+                return js_to_string(left) in right
+            if isinstance(right, JSArray):
+                idx = js_to_number(left)
+                return 0 <= idx < len(right)
+            raise JSException(make_error("'in' on non-object", name="TypeError"))
+        if op == "instanceof":
+            return False  # no user prototypes in this subset
+        raise JSError(f"unsupported binary op {op}")
+
+    def _eval_unary(self, node: dict, env: Environment):
+        op = node["op"]
+        if op == "typeof":
+            arg = node["arg"]
+            if arg["t"] == "Ident" and not env.has(arg["name"]) \
+                    and arg["name"] not in ("undefined", "NaN", "Infinity"):
+                return "undefined"
+            return js_typeof(self.eval(arg, env))
+        if op == "delete":
+            arg = node["arg"]
+            if arg["t"] == "Member":
+                obj = self.eval(arg["obj"], env)
+                if isinstance(obj, JSObject):
+                    obj.pop(arg["prop"], None)
+                return True
+            if arg["t"] == "Index":
+                obj = self.eval(arg["obj"], env)
+                key = self.eval(arg["expr"], env)
+                if isinstance(obj, JSObject):
+                    obj.pop(js_to_string(key), None)
+                return True
+            return True
+        value = self.eval(node["arg"], env)
+        if op == "!":
+            return not js_truthy(value)
+        if op == "-":
+            return -js_to_number(value)
+        if op == "+":
+            return js_to_number(value)
+        if op == "~":
+            return float(~int(js_to_number(value)))
+        if op == "void":
+            return UNDEFINED
+        raise JSError(f"unsupported unary op {op}")
+
+    def _eval_await(self, node: dict, env: Environment):
+        value = self.eval(node["arg"], env)
+        if not isinstance(value, JSPromise):
+            return value
+        # synchronous model: drain microtasks until the promise settles
+        rounds = 0
+        while value.state == JSPromise.PENDING and self.microtasks:
+            rounds += 1
+            if rounds > self.MAX_MICROTASK_ROUNDS:
+                raise JSError("await: microtask storm without settlement")
+            self.microtasks.pop(0)()
+        if value.state == JSPromise.PENDING:
+            raise JSError(
+                "await on a promise that never settles (host stubs must "
+                "resolve synchronously)")
+        if value.state == JSPromise.REJECTED:
+            raise JSException(value.value)
+        return value.value
+
+    # -- member access -----------------------------------------------------
+
+    def get_member(self, obj, prop: str):
+        from k8s_tpu.harness.minijs import builtins as b
+
+        if obj is UNDEFINED or obj is None:
+            raise JSException(make_error(
+                f"Cannot read properties of {js_to_string(obj)} "
+                f"(reading '{prop}')", name="TypeError"))
+        getter = getattr(obj, "js_get", None)
+        if getter is not None:
+            return getter(prop)
+        if isinstance(obj, JSObject):
+            if prop in obj:
+                return obj[prop]
+            method = b.object_method(self, obj, prop)
+            return method if method is not None else UNDEFINED
+        if isinstance(obj, JSArray):
+            if prop == "length":
+                return float(len(obj))
+            method = b.array_method(self, obj, prop)
+            if method is None:
+                return UNDEFINED
+            return method
+        if isinstance(obj, str):
+            if prop == "length":
+                return float(len(obj))
+            method = b.string_method(self, obj, prop)
+            if method is None:
+                return UNDEFINED
+            return method
+        if isinstance(obj, JSPromise):
+            return b.promise_method(self, obj, prop)
+        if isinstance(obj, JSSet):
+            return b.set_method(self, obj, prop)
+        if isinstance(obj, JSRegExp):
+            return b.regexp_method(self, obj, prop)
+        if isinstance(obj, float):
+            return b.number_method(self, obj, prop)
+        if isinstance(obj, (JSFunction, NativeFunction)):
+            if prop == "name":
+                return getattr(obj, "name", "")
+            if prop == "call":
+                return NativeFunction(
+                    lambda this=UNDEFINED, *args:
+                        self.call(obj, list(args), this), "call")
+            if prop == "apply":
+                return NativeFunction(
+                    lambda this=UNDEFINED, args=None:
+                        self.call(obj, list(args or []), this), "apply")
+            return UNDEFINED
+        if isinstance(obj, bool):
+            return UNDEFINED
+        raise JSError(f"cannot read {prop!r} of {type(obj).__name__}")
+
+    def get_index(self, obj, key):
+        if isinstance(obj, JSArray):
+            if isinstance(key, float) or isinstance(key, bool):
+                idx = int(js_to_number(key))
+                if 0 <= idx < len(obj):
+                    return obj[idx]
+                return UNDEFINED
+            return self.get_member(obj, js_to_string(key))
+        if isinstance(obj, str):
+            if isinstance(key, float):
+                idx = int(key)
+                if 0 <= idx < len(obj):
+                    return obj[idx]
+                return UNDEFINED
+            return self.get_member(obj, js_to_string(key))
+        if isinstance(obj, JSObject):
+            return obj.get(js_to_string(key), UNDEFINED)
+        return self.get_member(obj, js_to_string(key))
+
+    def set_member(self, obj, prop: str, value) -> None:
+        if obj is UNDEFINED or obj is None:
+            raise JSException(make_error(
+                f"Cannot set properties of {js_to_string(obj)} "
+                f"(setting '{prop}')", name="TypeError"))
+        setter = getattr(obj, "js_set", None)
+        if setter is not None:
+            setter(prop, value)
+            return
+        if isinstance(obj, JSObject):
+            obj[prop] = value
+            return
+        if isinstance(obj, JSArray) and prop == "length":
+            new_len = int(js_to_number(value))
+            del obj[new_len:]
+            while len(obj) < new_len:
+                obj.append(UNDEFINED)
+            return
+        raise JSError(f"cannot set {prop!r} on {type(obj).__name__}")
+
+    def set_index(self, obj, key, value) -> None:
+        if isinstance(obj, JSArray) and isinstance(key, (float, bool)):
+            idx = int(js_to_number(key))
+            while len(obj) <= idx:
+                obj.append(UNDEFINED)
+            obj[idx] = value
+            return
+        self.set_member(obj, js_to_string(key), value)
+
+    # -- iteration ---------------------------------------------------------
+
+    def _iterate(self, value):
+        if isinstance(value, JSArray):
+            return list(value)
+        if isinstance(value, str):
+            return list(value)
+        if isinstance(value, JSSet):
+            return list(value.items)
+        if isinstance(value, JSObject):
+            raise JSException(make_error(
+                "object is not iterable (arrays, strings, Sets are)",
+                name="TypeError"))
+        hook = getattr(value, "js_iter", None)
+        if hook is not None:
+            return list(hook())
+        raise JSException(make_error(
+            f"{js_to_string(value)} is not iterable", name="TypeError"))
+
+
+def js_typeof(v) -> str:
+    if v is UNDEFINED:
+        return "undefined"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, float):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, (JSFunction, NativeFunction)):
+        return "function"
+    return "object"
+
+
+# -- JSON bridge (used by builtins and the DOM/fetch shims) ------------------
+
+def py_to_js(v):
+    """Recursively convert plain Python JSON-ish data into JS values."""
+    if v is None or isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, dict):
+        out = JSObject()
+        for k, val in v.items():
+            out[str(k)] = py_to_js(val)
+        return out
+    if isinstance(v, (list, tuple)):
+        return JSArray(py_to_js(x) for x in v)
+    return v
+
+
+def js_to_py(v):
+    if v is UNDEFINED:
+        return None
+    if isinstance(v, float):
+        return int(v) if v == int(v) and abs(v) < 2**53 else v
+    if isinstance(v, JSObject):
+        return {k: js_to_py(x) for k, x in v.items()
+                if x is not UNDEFINED and not isinstance(
+                    x, (JSFunction, NativeFunction))}
+    if isinstance(v, JSArray):
+        return [js_to_py(x) for x in v]
+    return v
+
+
+def json_stringify(value, space: int = 0) -> str:
+    def default_filter(v):
+        return not isinstance(v, (JSFunction, NativeFunction)) \
+            and v is not UNDEFINED
+
+    def conv(v):
+        if v is UNDEFINED:
+            return None
+        if isinstance(v, float):
+            if math.isnan(v) or math.isinf(v):
+                return None
+            return int(v) if v == int(v) and abs(v) < 2**53 else v
+        if isinstance(v, JSObject):
+            return {k: conv(x) for k, x in v.items() if default_filter(x)}
+        if isinstance(v, JSArray):
+            return [conv(x) if default_filter(x) else None for x in v]
+        return v
+
+    if value is UNDEFINED or isinstance(value, (JSFunction, NativeFunction)):
+        return "undefined"
+    indent = int(space) if space else None
+    return _json.dumps(conv(value), indent=indent,
+                       separators=(",", ": ") if indent else (",", ":"),
+                       ensure_ascii=False)
+
+
+def json_parse(text: str):
+    try:
+        return py_to_js(_json.loads(text))
+    except (ValueError, TypeError) as e:
+        raise JSException(make_error(str(e), name="SyntaxError")) from None

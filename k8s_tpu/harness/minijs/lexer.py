@@ -1,0 +1,304 @@
+"""minijs lexer.
+
+Produces a flat token list.  Template literals come out as one TEMPLATE
+token whose value is a list of ("str", cooked) / ("expr", source) parts —
+the parser re-lexes each expression source, which makes nested templates
+work without lexer/parser coupling.  Regex-vs-division is disambiguated by
+the previous significant token, the standard single-token-lookbehind
+heuristic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+class LexError(SyntaxError):
+    pass
+
+
+KEYWORDS = {
+    "var", "let", "const", "function", "return", "if", "else", "for", "of",
+    "in", "while", "do", "break", "continue", "new", "typeof", "instanceof",
+    "try", "catch", "finally", "throw", "true", "false", "null", "this",
+    "async", "await", "delete", "void",
+}
+
+# longest first
+PUNCTUATORS = [
+    "===", "!==", "**=", "...",
+    "=>", "==", "!=", "<=", ">=", "&&", "||", "??", "+=", "-=", "*=", "/=",
+    "%=", "++", "--", "**",
+    "{", "}", "(", ")", "[", "]", ";", ",", "<", ">", "+", "-", "*", "/",
+    "%", "=", "!", "?", ":", ".", "&", "|", "^", "~",
+]
+
+# a `/` right after one of these starts a regex literal, not division
+_REGEX_PRECEDERS = {
+    "(", ",", "=", ":", "[", "!", "&", "|", "?", "{", "}", ";", "=>", "==",
+    "===", "!=", "!==", "<", ">", "<=", ">=", "&&", "||", "??", "+", "-",
+    "*", "/", "%", "+=", "-=", "*=", "/=", "%=", "...",
+}
+_REGEX_PRECEDER_KEYWORDS = {
+    "return", "typeof", "instanceof", "new", "in", "of", "throw", "await",
+    "delete", "void", "case",
+}
+
+
+@dataclass
+class Token:
+    type: str   # NUM STR TEMPLATE REGEX IDENT KEYWORD PUNCT EOF
+    value: Any
+    line: int
+
+
+def _is_ident_start(c: str) -> bool:
+    return c.isalpha() or c in "_$"
+
+
+def _is_ident_part(c: str) -> bool:
+    return c.isalnum() or c in "_$"
+
+
+class Lexer:
+    def __init__(self, src: str):
+        self.src = src
+        self.i = 0
+        self.line = 1
+        self.tokens: list[Token] = []
+
+    def error(self, msg: str) -> LexError:
+        return LexError(f"line {self.line}: {msg}")
+
+    def _prev_significant(self) -> Token | None:
+        return self.tokens[-1] if self.tokens else None
+
+    def tokenize(self) -> list[Token]:
+        src, n = self.src, len(self.src)
+        while self.i < n:
+            c = src[self.i]
+            if c == "\n":
+                self.line += 1
+                self.i += 1
+                continue
+            if c.isspace():
+                self.i += 1
+                continue
+            if src.startswith("//", self.i):
+                j = src.find("\n", self.i)
+                self.i = n if j < 0 else j
+                continue
+            if src.startswith("/*", self.i):
+                j = src.find("*/", self.i + 2)
+                if j < 0:
+                    raise self.error("unterminated block comment")
+                self.line += src.count("\n", self.i, j)
+                self.i = j + 2
+                continue
+            if c == "`":
+                self.tokens.append(self._template())
+                continue
+            if c in "'\"":
+                self.tokens.append(self._string(c))
+                continue
+            if c.isdigit() or (c == "." and self.i + 1 < n and src[self.i + 1].isdigit()):
+                self.tokens.append(self._number())
+                continue
+            if _is_ident_start(c):
+                j = self.i + 1
+                while j < n and _is_ident_part(src[j]):
+                    j += 1
+                word = src[self.i:j]
+                self.i = j
+                t = "KEYWORD" if word in KEYWORDS else "IDENT"
+                self.tokens.append(Token(t, word, self.line))
+                continue
+            if c == "/" and self._regex_allowed():
+                self.tokens.append(self._regex())
+                continue
+            for p in PUNCTUATORS:
+                if src.startswith(p, self.i):
+                    self.i += len(p)
+                    self.tokens.append(Token("PUNCT", p, self.line))
+                    break
+            else:
+                raise self.error(f"unexpected character {c!r}")
+        self.tokens.append(Token("EOF", None, self.line))
+        return self.tokens
+
+    def _regex_allowed(self) -> bool:
+        prev = self._prev_significant()
+        if prev is None:
+            return True
+        if prev.type == "PUNCT":
+            return prev.value in _REGEX_PRECEDERS
+        if prev.type == "KEYWORD":
+            return prev.value in _REGEX_PRECEDER_KEYWORDS
+        return False  # after IDENT/NUM/STR/REGEX/TEMPLATE, `/` is division
+
+    def _string(self, quote: str) -> Token:
+        src, n = self.src, len(self.src)
+        i = self.i + 1
+        out = []
+        while i < n:
+            c = src[i]
+            if c == quote:
+                self.i = i + 1
+                return Token("STR", "".join(out), self.line)
+            if c == "\n":
+                raise self.error("unterminated string")
+            if c == "\\":
+                if i + 1 >= n:
+                    raise self.error("bad escape at end of input")
+                out.append(self._escape(src[i + 1]))
+                i += 2
+                continue
+            out.append(c)
+            i += 1
+        raise self.error("unterminated string")
+
+    @staticmethod
+    def _escape(c: str) -> str:
+        return {"n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
+                "0": "\0", "v": "\v"}.get(c, c)  # \\ \' \" \` fall through
+
+    def _number(self) -> Token:
+        src, n = self.src, len(self.src)
+        i = self.i
+        if src.startswith(("0x", "0X"), i):
+            j = i + 2
+            while j < n and src[j] in "0123456789abcdefABCDEF":
+                j += 1
+            self.i = j
+            return Token("NUM", float(int(src[i:j], 16)), self.line)
+        j = i
+        while j < n and src[j].isdigit():
+            j += 1
+        if j < n and src[j] == ".":
+            j += 1
+            while j < n and src[j].isdigit():
+                j += 1
+        if j < n and src[j] in "eE":
+            j += 1
+            if j < n and src[j] in "+-":
+                j += 1
+            while j < n and src[j].isdigit():
+                j += 1
+        self.i = j
+        return Token("NUM", float(src[i:j]), self.line)
+
+    def _regex(self) -> Token:
+        src, n = self.src, len(self.src)
+        i = self.i + 1
+        body = []
+        in_class = False
+        while i < n:
+            c = src[i]
+            if c == "\\":
+                if i + 1 >= n:
+                    raise self.error("bad regex escape")
+                body.append(src[i:i + 2])
+                i += 2
+                continue
+            if c == "\n":
+                raise self.error("unterminated regex")
+            if c == "[":
+                in_class = True
+            elif c == "]":
+                in_class = False
+            elif c == "/" and not in_class:
+                j = i + 1
+                while j < n and _is_ident_part(src[j]):
+                    j += 1
+                flags = src[i + 1:j]
+                self.i = j
+                return Token("REGEX", ("".join(body), flags), self.line)
+            body.append(c)
+            i += 1
+        raise self.error("unterminated regex")
+
+    def _template(self) -> Token:
+        """Scan `...${expr}...`; expressions are captured as raw source and
+        re-lexed by the parser (so nesting is handled by recursion)."""
+        src, n = self.src, len(self.src)
+        i = self.i + 1
+        parts: list[tuple[str, str]] = []
+        buf: list[str] = []
+        while i < n:
+            c = src[i]
+            if c == "`":
+                if buf:
+                    parts.append(("str", "".join(buf)))
+                self.i = i + 1
+                return Token("TEMPLATE", parts, self.line)
+            if c == "\\":
+                if i + 1 >= n:
+                    raise self.error("bad escape in template")
+                buf.append(self._escape(src[i + 1]))
+                i += 2
+                continue
+            if c == "\n":
+                self.line += 1
+                buf.append(c)
+                i += 1
+                continue
+            if src.startswith("${", i):
+                if buf:
+                    parts.append(("str", "".join(buf)))
+                    buf = []
+                j = self._scan_template_expr(i + 2)
+                parts.append(("expr", src[i + 2:j]))
+                i = j + 1  # past the closing }
+                continue
+            buf.append(c)
+            i += 1
+        raise self.error("unterminated template literal")
+
+    def _scan_template_expr(self, start: int) -> int:
+        """Index of the `}` closing a ${...}, skipping nested braces,
+        strings, and nested templates."""
+        src, n = self.src, len(self.src)
+        depth = 0
+        i = start
+        while i < n:
+            c = src[i]
+            if c == "\\":
+                i += 2
+                continue
+            if c in "'\"":
+                q = c
+                i += 1
+                while i < n and src[i] != q:
+                    i += 2 if src[i] == "\\" else 1
+                i += 1
+                continue
+            if c == "`":
+                # nested template: recurse through its own ${} structure
+                i += 1
+                while i < n and src[i] != "`":
+                    if src[i] == "\\":
+                        i += 2
+                        continue
+                    if src.startswith("${", i):
+                        i = self._scan_template_expr(i + 2) + 1
+                        continue
+                    if src[i] == "\n":
+                        self.line += 1
+                    i += 1
+                i += 1
+                continue
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                if depth == 0:
+                    return i
+                depth -= 1
+            elif c == "\n":
+                self.line += 1
+            i += 1
+        raise self.error("unterminated ${...} in template")
+
+
+def tokenize(src: str) -> list[Token]:
+    return Lexer(src).tokenize()

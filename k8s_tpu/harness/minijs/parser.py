@@ -1,0 +1,644 @@
+"""minijs parser: recursive descent over the lexer's token list, producing
+dict-shaped AST nodes ({"t": <type>, ...}).  Backtracking (token index
+save/restore) is used only for the arrow-function parameter ambiguity."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from k8s_tpu.harness.minijs.lexer import Token, tokenize
+
+
+class ParseError(SyntaxError):
+    pass
+
+
+def n(t: str, **kw) -> dict:
+    kw["t"] = t
+    return kw
+
+
+ASSIGN_OPS = {"=", "+=", "-=", "*=", "/=", "%="}
+EQUALITY = {"===", "!==", "==", "!="}
+RELATIONAL = {"<", ">", "<=", ">="}
+ADDITIVE = {"+", "-"}
+MULTIPLICATIVE = {"*", "/", "%"}
+UNARY = {"!", "-", "+", "~"}
+
+
+class Parser:
+    def __init__(self, tokens: list[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # -- token plumbing ----------------------------------------------------
+
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.type != "EOF":
+            self.i += 1
+        return t
+
+    def at_punct(self, *vals: str) -> bool:
+        t = self.peek()
+        return t.type == "PUNCT" and t.value in vals
+
+    def at_kw(self, *vals: str) -> bool:
+        t = self.peek()
+        return t.type == "KEYWORD" and t.value in vals
+
+    def eat_punct(self, val: str) -> None:
+        t = self.next()
+        if t.type != "PUNCT" or t.value != val:
+            raise ParseError(
+                f"line {t.line}: expected {val!r}, got {t.type} {t.value!r}")
+
+    def eat_kw(self, val: str) -> None:
+        t = self.next()
+        if t.type != "KEYWORD" or t.value != val:
+            raise ParseError(
+                f"line {t.line}: expected keyword {val!r}, got {t.value!r}")
+
+    def error(self, msg: str) -> ParseError:
+        return ParseError(f"line {self.peek().line}: {msg}")
+
+    # -- program / statements ---------------------------------------------
+
+    def parse_program(self) -> dict:
+        body = []
+        while self.peek().type != "EOF":
+            body.append(self.parse_statement())
+        return n("Program", body=body)
+
+    def parse_statement(self) -> dict:
+        t = self.peek()
+        if t.type == "PUNCT":
+            if t.value == "{":
+                return self.parse_block()
+            if t.value == ";":
+                self.next()
+                return n("Empty")
+        if t.type == "KEYWORD":
+            kw = t.value
+            if kw in ("var", "let", "const"):
+                s = self.parse_var_decl()
+                self.semi()
+                return s
+            if kw == "function":
+                return self.parse_function(is_async=False, as_decl=True)
+            if kw == "async" and self.peek(1).type == "KEYWORD" \
+                    and self.peek(1).value == "function":
+                self.next()
+                return self.parse_function(is_async=True, as_decl=True)
+            if kw == "if":
+                return self.parse_if()
+            if kw == "for":
+                return self.parse_for()
+            if kw == "while":
+                return self.parse_while()
+            if kw == "do":
+                return self.parse_do_while()
+            if kw == "return":
+                self.next()
+                arg = None
+                if not (self.at_punct(";", "}") or self.peek().type == "EOF"):
+                    arg = self.parse_expression()
+                self.semi()
+                return n("Return", arg=arg)
+            if kw == "throw":
+                self.next()
+                arg = self.parse_expression()
+                self.semi()
+                return n("Throw", arg=arg)
+            if kw == "break":
+                self.next()
+                self.semi()
+                return n("Break")
+            if kw == "continue":
+                self.next()
+                self.semi()
+                return n("Continue")
+            if kw == "try":
+                return self.parse_try()
+        expr = self.parse_expression()
+        self.semi()
+        return n("ExprStmt", expr=expr)
+
+    def semi(self) -> None:
+        """Consume a `;` if present (ASI: tolerate its absence)."""
+        if self.at_punct(";"):
+            self.next()
+
+    def parse_block(self) -> dict:
+        self.eat_punct("{")
+        body = []
+        while not self.at_punct("}"):
+            if self.peek().type == "EOF":
+                raise self.error("unterminated block")
+            body.append(self.parse_statement())
+        self.next()
+        return n("Block", body=body)
+
+    def parse_var_decl(self) -> dict:
+        kind = self.next().value
+        decls = []
+        while True:
+            target = self.parse_binding_target()
+            init = None
+            if self.at_punct("="):
+                self.next()
+                init = self.parse_assignment()
+            decls.append((target, init))
+            if self.at_punct(","):
+                self.next()
+                continue
+            break
+        return n("VarDecl", kind=kind, decls=decls)
+
+    def parse_binding_target(self) -> dict:
+        t = self.peek()
+        if t.type == "IDENT":
+            self.next()
+            return n("Ident", name=t.value)
+        if self.at_punct("["):
+            self.next()
+            elements: list[Optional[dict]] = []
+            while not self.at_punct("]"):
+                if self.at_punct(","):
+                    self.next()
+                    elements.append(None)  # elision
+                    continue
+                elements.append(self.parse_binding_target())
+                if self.at_punct(","):
+                    self.next()
+            self.next()
+            return n("ArrayPattern", elements=elements)
+        if self.at_punct("{"):
+            self.next()
+            props = []
+            while not self.at_punct("}"):
+                key = self.next()
+                if key.type not in ("IDENT", "STR"):
+                    raise self.error("bad object-pattern key")
+                if self.at_punct(":"):
+                    self.next()
+                    props.append((key.value, self.parse_binding_target()))
+                else:
+                    props.append((key.value, n("Ident", name=key.value)))
+                if self.at_punct(","):
+                    self.next()
+            self.next()
+            return n("ObjectPattern", props=props)
+        raise self.error(f"bad binding target {t.value!r}")
+
+    def parse_if(self) -> dict:
+        self.eat_kw("if")
+        self.eat_punct("(")
+        test = self.parse_expression()
+        self.eat_punct(")")
+        cons = self.parse_statement()
+        alt = None
+        if self.at_kw("else"):
+            self.next()
+            alt = self.parse_statement()
+        return n("If", test=test, cons=cons, alt=alt)
+
+    def parse_for(self) -> dict:
+        self.eat_kw("for")
+        self.eat_punct("(")
+        # for-of / for-in with a declaration
+        if self.at_kw("var", "let", "const"):
+            kind = self.next().value
+            target = self.parse_binding_target()
+            if self.at_kw("of", "in"):
+                which = self.next().value
+                it = self.parse_expression()
+                self.eat_punct(")")
+                body = self.parse_statement()
+                return n("ForOf" if which == "of" else "ForIn",
+                         kind=kind, target=target, iter=it, body=body)
+            # classic for with declaration init
+            init = None
+            if self.at_punct("="):
+                self.next()
+                init = self.parse_assignment()
+            decls = [(target, init)]
+            while self.at_punct(","):
+                self.next()
+                t2 = self.parse_binding_target()
+                i2 = None
+                if self.at_punct("="):
+                    self.next()
+                    i2 = self.parse_assignment()
+                decls.append((t2, i2))
+            init_node = n("VarDecl", kind=kind, decls=decls)
+            return self._finish_classic_for(init_node)
+        if self.at_punct(";"):
+            return self._finish_classic_for(None)
+        first = self.parse_expression()
+        if self.at_kw("of", "in"):
+            which = self.next().value
+            it = self.parse_expression()
+            self.eat_punct(")")
+            body = self.parse_statement()
+            return n("ForOf" if which == "of" else "ForIn",
+                     kind=None, target=first, iter=it, body=body)
+        return self._finish_classic_for(n("ExprStmt", expr=first))
+
+    def _finish_classic_for(self, init) -> dict:
+        self.eat_punct(";")
+        test = None if self.at_punct(";") else self.parse_expression()
+        self.eat_punct(";")
+        update = None if self.at_punct(")") else self.parse_expression()
+        self.eat_punct(")")
+        body = self.parse_statement()
+        return n("For", init=init, test=test, update=update, body=body)
+
+    def parse_while(self) -> dict:
+        self.eat_kw("while")
+        self.eat_punct("(")
+        test = self.parse_expression()
+        self.eat_punct(")")
+        return n("While", test=test, body=self.parse_statement())
+
+    def parse_do_while(self) -> dict:
+        self.eat_kw("do")
+        body = self.parse_statement()
+        self.eat_kw("while")
+        self.eat_punct("(")
+        test = self.parse_expression()
+        self.eat_punct(")")
+        self.semi()
+        return n("DoWhile", test=test, body=body)
+
+    def parse_try(self) -> dict:
+        self.eat_kw("try")
+        block = self.parse_block()
+        param = None
+        handler = None
+        finalizer = None
+        if self.at_kw("catch"):
+            self.next()
+            if self.at_punct("("):
+                self.next()
+                param = self.parse_binding_target()
+                self.eat_punct(")")
+            handler = self.parse_block()
+        if self.at_kw("finally"):
+            self.next()
+            finalizer = self.parse_block()
+        if handler is None and finalizer is None:
+            raise self.error("try without catch or finally")
+        return n("Try", block=block, param=param, handler=handler,
+                 finalizer=finalizer)
+
+    def parse_function(self, is_async: bool, as_decl: bool) -> dict:
+        self.eat_kw("function")
+        name = None
+        if self.peek().type == "IDENT":
+            name = self.next().value
+        elif as_decl:
+            raise self.error("function declaration needs a name")
+        params = self.parse_params_paren()
+        body = self.parse_block()
+        fn = n("Func", name=name, params=params, body=body,
+               is_async=is_async, is_arrow=False)
+        return n("FuncDecl", name=name, fn=fn) if as_decl else fn
+
+    def parse_params_paren(self) -> list[dict]:
+        self.eat_punct("(")
+        params = []
+        while not self.at_punct(")"):
+            rest = False
+            if self.at_punct("..."):
+                self.next()
+                rest = True
+            target = self.parse_binding_target()
+            default = None
+            if self.at_punct("="):
+                self.next()
+                default = self.parse_assignment()
+            params.append(n("Param", target=target, default=default, rest=rest))
+            if self.at_punct(","):
+                self.next()
+        self.next()
+        return params
+
+    # -- expressions -------------------------------------------------------
+
+    def parse_expression(self) -> dict:
+        expr = self.parse_assignment()
+        while self.at_punct(","):
+            self.next()
+            right = self.parse_assignment()
+            expr = n("Sequence", left=expr, right=right)
+        return expr
+
+    def parse_assignment(self) -> dict:
+        arrow = self._try_arrow()
+        if arrow is not None:
+            return arrow
+        left = self.parse_conditional()
+        if self.at_punct(*ASSIGN_OPS):
+            op = self.next().value
+            if left["t"] not in ("Ident", "Member", "Index"):
+                raise self.error(f"invalid assignment target {left['t']}")
+            value = self.parse_assignment()
+            return n("Assign", op=op, target=left, value=value)
+        return left
+
+    def _try_arrow(self) -> Optional[dict]:
+        """Parse an arrow function if one starts here, else restore."""
+        start = self.i
+        is_async = False
+        if self.at_kw("async") and (
+                self.peek(1).type == "IDENT" or
+                (self.peek(1).type == "PUNCT" and self.peek(1).value == "(")):
+            # `async` on the same line followed by params
+            self.next()
+            is_async = True
+        t = self.peek()
+        if t.type == "IDENT" and self.peek(1).type == "PUNCT" \
+                and self.peek(1).value == "=>":
+            self.next()
+            params = [n("Param", target=n("Ident", name=t.value),
+                        default=None, rest=False)]
+            self.eat_punct("=>")
+            return self._finish_arrow(params, is_async)
+        if t.type == "PUNCT" and t.value == "(":
+            try:
+                params = self.parse_params_paren()
+                if self.at_punct("=>"):
+                    self.next()
+                    return self._finish_arrow(params, is_async)
+            except ParseError:
+                pass
+            self.i = start
+            return None
+        self.i = start
+        return None
+
+    def _finish_arrow(self, params: list[dict], is_async: bool) -> dict:
+        if self.at_punct("{"):
+            body = self.parse_block()
+        else:
+            body = n("Block", body=[n("Return", arg=self.parse_assignment())])
+        return n("Func", name=None, params=params, body=body,
+                 is_async=is_async, is_arrow=True)
+
+    def parse_conditional(self) -> dict:
+        test = self.parse_nullish_or()
+        if self.at_punct("?"):
+            self.next()
+            cons = self.parse_assignment()
+            self.eat_punct(":")
+            alt = self.parse_assignment()
+            return n("Cond", test=test, cons=cons, alt=alt)
+        return test
+
+    def parse_nullish_or(self) -> dict:
+        left = self.parse_and()
+        while self.at_punct("||", "??"):
+            op = self.next().value
+            right = self.parse_and()
+            left = n("Logical", op=op, left=left, right=right)
+        return left
+
+    def parse_and(self) -> dict:
+        left = self.parse_equality()
+        while self.at_punct("&&"):
+            self.next()
+            right = self.parse_equality()
+            left = n("Logical", op="&&", left=left, right=right)
+        return left
+
+    def parse_equality(self) -> dict:
+        left = self.parse_relational()
+        while self.at_punct(*EQUALITY):
+            op = self.next().value
+            right = self.parse_relational()
+            left = n("Binary", op=op, left=left, right=right)
+        return left
+
+    def parse_relational(self) -> dict:
+        left = self.parse_additive()
+        while self.at_punct(*RELATIONAL) or self.at_kw("instanceof", "in"):
+            op = self.next().value
+            right = self.parse_additive()
+            left = n("Binary", op=op, left=left, right=right)
+        return left
+
+    def parse_additive(self) -> dict:
+        left = self.parse_multiplicative()
+        while self.at_punct(*ADDITIVE):
+            op = self.next().value
+            right = self.parse_multiplicative()
+            left = n("Binary", op=op, left=left, right=right)
+        return left
+
+    def parse_multiplicative(self) -> dict:
+        left = self.parse_unary()
+        while self.at_punct(*MULTIPLICATIVE):
+            op = self.next().value
+            right = self.parse_unary()
+            left = n("Binary", op=op, left=left, right=right)
+        return left
+
+    def parse_unary(self) -> dict:
+        t = self.peek()
+        if t.type == "PUNCT" and t.value in UNARY:
+            self.next()
+            return n("Unary", op=t.value, arg=self.parse_unary())
+        if t.type == "PUNCT" and t.value in ("++", "--"):
+            self.next()
+            target = self.parse_unary()
+            return n("Update", op=t.value, prefix=True, target=target)
+        if t.type == "KEYWORD" and t.value in ("typeof", "delete", "void"):
+            self.next()
+            return n("Unary", op=t.value, arg=self.parse_unary())
+        if t.type == "KEYWORD" and t.value == "await":
+            self.next()
+            return n("Await", arg=self.parse_unary())
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> dict:
+        expr = self.parse_call_member()
+        if self.at_punct("++", "--"):
+            op = self.next().value
+            return n("Update", op=op, prefix=False, target=expr)
+        return expr
+
+    def parse_call_member(self) -> dict:
+        if self.at_kw("new"):
+            self.next()
+            callee = self.parse_call_member_no_call()
+            args = self.parse_args() if self.at_punct("(") else []
+            expr = n("New", callee=callee, args=args)
+        else:
+            expr = self.parse_primary()
+        return self._member_chain(expr, allow_calls=True)
+
+    def parse_call_member_no_call(self) -> dict:
+        expr = self.parse_primary()
+        return self._member_chain(expr, allow_calls=False)
+
+    def _member_chain(self, expr: dict, allow_calls: bool) -> dict:
+        while True:
+            if self.at_punct("."):
+                self.next()
+                name = self.next()
+                if name.type not in ("IDENT", "KEYWORD"):
+                    raise self.error("bad member name")
+                expr = n("Member", obj=expr, prop=name.value)
+            elif self.at_punct("["):
+                self.next()
+                idx = self.parse_expression()
+                self.eat_punct("]")
+                expr = n("Index", obj=expr, expr=idx)
+            elif allow_calls and self.at_punct("("):
+                expr = n("Call", callee=expr, args=self.parse_args())
+            else:
+                return expr
+
+    def parse_args(self) -> list[dict]:
+        self.eat_punct("(")
+        args = []
+        while not self.at_punct(")"):
+            if self.at_punct("..."):
+                self.next()
+                args.append(n("Spread", arg=self.parse_assignment()))
+            else:
+                args.append(self.parse_assignment())
+            if self.at_punct(","):
+                self.next()
+        self.next()
+        return args
+
+    def parse_primary(self) -> dict:
+        t = self.peek()
+        if t.type == "NUM":
+            self.next()
+            return n("Num", value=t.value)
+        if t.type == "STR":
+            self.next()
+            return n("Str", value=t.value)
+        if t.type == "REGEX":
+            self.next()
+            return n("Regex", source=t.value[0], flags=t.value[1])
+        if t.type == "TEMPLATE":
+            self.next()
+            quasis = []
+            for kind, val in t.value:
+                if kind == "str":
+                    quasis.append(("str", val))
+                else:
+                    quasis.append(("expr", parse_expr_source(val)))
+            return n("Template", quasis=quasis)
+        if t.type == "IDENT":
+            self.next()
+            return n("Ident", name=t.value)
+        if t.type == "KEYWORD":
+            kw = t.value
+            if kw == "true":
+                self.next()
+                return n("Bool", value=True)
+            if kw == "false":
+                self.next()
+                return n("Bool", value=False)
+            if kw == "null":
+                self.next()
+                return n("Null")
+            if kw == "this":
+                self.next()
+                return n("This")
+            if kw == "function":
+                return self.parse_function(is_async=False, as_decl=False)
+            if kw == "async" and self.peek(1).type == "KEYWORD" \
+                    and self.peek(1).value == "function":
+                self.next()
+                return self.parse_function(is_async=True, as_decl=False)
+            # contextual keywords used as plain identifiers (of, async, ...)
+            if kw in ("of", "async", "let"):
+                self.next()
+                return n("Ident", name=kw)
+        if t.type == "PUNCT":
+            if t.value == "(":
+                self.next()
+                expr = self.parse_expression()
+                self.eat_punct(")")
+                return expr
+            if t.value == "[":
+                return self.parse_array_literal()
+            if t.value == "{":
+                return self.parse_object_literal()
+        raise self.error(f"unexpected token {t.type} {t.value!r}")
+
+    def parse_array_literal(self) -> dict:
+        self.eat_punct("[")
+        elements = []
+        while not self.at_punct("]"):
+            if self.at_punct(","):
+                self.next()
+                continue
+            if self.at_punct("..."):
+                self.next()
+                elements.append(n("Spread", arg=self.parse_assignment()))
+            else:
+                elements.append(self.parse_assignment())
+            if self.at_punct(","):
+                self.next()
+        self.next()
+        return n("Array", elements=elements)
+
+    def parse_object_literal(self) -> dict:
+        self.eat_punct("{")
+        props = []
+        while not self.at_punct("}"):
+            if self.at_punct("..."):
+                self.next()
+                props.append(("spread", self.parse_assignment()))
+            else:
+                key_tok = self.next()
+                if key_tok.type in ("IDENT", "KEYWORD"):
+                    key = key_tok.value
+                elif key_tok.type == "STR":
+                    key = key_tok.value
+                elif key_tok.type == "NUM":
+                    key = _num_key(key_tok.value)
+                else:
+                    raise self.error(f"bad object key {key_tok.value!r}")
+                if self.at_punct(":"):
+                    self.next()
+                    props.append((key, self.parse_assignment()))
+                elif self.at_punct("("):
+                    # method shorthand: name(args) { ... }
+                    params = self.parse_params_paren()
+                    body = self.parse_block()
+                    props.append((key, n("Func", name=key, params=params,
+                                         body=body, is_async=False,
+                                         is_arrow=False)))
+                else:
+                    props.append((key, n("Ident", name=key)))  # shorthand
+            if self.at_punct(","):
+                self.next()
+        self.next()
+        return n("Object", props=props)
+
+
+def _num_key(v: float) -> str:
+    return str(int(v)) if v == int(v) else str(v)
+
+
+def parse(src: str) -> dict:
+    return Parser(tokenize(src)).parse_program()
+
+
+def parse_expr_source(src: str) -> dict:
+    p = Parser(tokenize(src))
+    expr = p.parse_expression()
+    if p.peek().type != "EOF":
+        raise ParseError(f"trailing tokens in expression {src!r}")
+    return expr

@@ -178,12 +178,16 @@ class GkeProvider(Provider):
         wait of py/util.py:226, expressed over cluster status)."""
         deadline = time.monotonic() + timeout.total_seconds()
         while True:
-            out = self._gcloud(
-                "container", "clusters", "describe", self.cluster,
-                f"--zone={self.zone}", "--format=json",
-            )
             try:
+                out = self._gcloud(
+                    "container", "clusters", "describe", self.cluster,
+                    f"--zone={self.zone}", "--format=json",
+                )
                 status = (json.loads(out) or {}).get("status", "")
+            except subprocess.CalledProcessError:
+                # transient describe failure (not-found race right after an
+                # async create, network blip): keep polling to the deadline
+                status = ""
             except ValueError:
                 status = ""  # transiently garbled describe output: keep polling
             if status == want:
@@ -242,7 +246,10 @@ def wait_for_tpu_nodes(timeout: datetime.timedelta,
     retargeted at the TPU device plugin)."""
     deadline = time.monotonic() + timeout.total_seconds()
     while True:
-        nodes = _kubectl_json("get", "nodes").get("items", [])
+        try:
+            nodes = _kubectl_json("get", "nodes").get("items", [])
+        except subprocess.CalledProcessError:
+            nodes = []  # apiserver warming up right after get-credentials
         for n in nodes:
             cap = ((n.get("status") or {}).get("capacity") or {})
             try:

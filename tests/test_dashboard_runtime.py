@@ -1,0 +1,347 @@
+"""Frontend RUNTIME tier: app.js executed in the bundled minijs interpreter
+against the minidom headless browser (the App.test.js analogue — reference:
+dashboard/frontend/src/components/App.test.js runs the reference SPA under
+jest/jsdom; this tier fails if app.js throws at runtime, which the static
+regex checks in test_dashboard_frontend.py cannot detect).
+
+The fetch layer is routed to in-test fixtures shaped exactly like
+k8s_tpu.dashboard.backend's responses."""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+from k8s_tpu.harness.minidom import Browser
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FRONTEND = os.path.join(REPO, "k8s_tpu", "dashboard", "frontend")
+
+JOB_A = {
+    "metadata": {"name": "mnist", "namespace": "default", "uid": "uid-1",
+                 "creationTimestamp": "2026-07-01T10:00:00Z"},
+    "spec": {
+        "tfReplicaSpecs": {
+            "TPU": {"replicas": 4, "restartPolicy": "ExitCode",
+                    "template": {"spec": {"containers": [
+                        {"name": "tensorflow", "image": "img:1"}]}}},
+            "Chief": {"replicas": 1, "restartPolicy": "Never",
+                      "template": {"spec": {"containers": [
+                          {"name": "tensorflow", "image": "img:1"}]}}},
+        },
+        "tpu": {"acceleratorType": "v5litepod-16", "topology": "4x4"},
+    },
+    "status": {
+        "conditions": [
+            {"type": "Created", "status": "True", "reason": "JobCreated",
+             "lastTransitionTime": "2026-07-01T10:00:01Z"},
+            {"type": "Running", "status": "True", "reason": "JobRunning",
+             "message": "all replicas ready",
+             "lastTransitionTime": "2026-07-01T10:00:10Z"},
+        ],
+        "tfReplicaStatuses": {"TPU": {"active": 4}, "Chief": {"active": 1}},
+        "startTime": "2026-07-01T10:00:05Z",
+    },
+}
+
+JOB_XSS = {
+    "metadata": {"name": "<img src=x onerror=pwn()>", "namespace": "default"},
+    "spec": {"tfReplicaSpecs": {"Worker": {"replicas": 1}}},
+    "status": {},
+}
+
+PODS = [
+    {"metadata": {"name": "mnist-tpu-0",
+                  "labels": {"tf-replica-type": "tpu",
+                             "tf-replica-index": "0"}},
+     "status": {"phase": "Running", "containerStatuses": [
+         {"name": "tensorflow", "state": {"running": {}}}]}},
+    {"metadata": {"name": "mnist-chief-0",
+                  "labels": {"tf-replica-type": "chief",
+                             "tf-replica-index": "0"}},
+     "status": {"phase": "Failed", "containerStatuses": [
+         {"name": "tensorflow",
+          "state": {"terminated": {"exitCode": 137}}}]}},
+]
+
+
+class Backend:
+    """In-test stand-in for dashboard/backend.py's REST surface."""
+
+    def __init__(self, jobs=None):
+        self.jobs = jobs if jobs is not None else [JOB_A]
+        self.deleted: list[str] = []
+        self.created: list[dict] = []
+        self.create_error: str | None = None
+
+    def __call__(self, method, url, body):
+        if url == "/tfjobs/api/namespaces":
+            return 200, {"namespaces": ["default", "kubeflow"]}
+        m = re.fullmatch(r"/tfjobs/api/tfjob", url)
+        if m and method == "GET":
+            return 200, {"items": self.jobs}
+        if m and method == "POST":
+            if self.create_error:
+                return 409, {"error": self.create_error}
+            self.created.append(body)
+            return 201, body
+        m = re.fullmatch(r"/tfjobs/api/tfjob/([^/]+)", url)
+        if m and method == "GET":
+            ns = m.group(1)
+            return 200, {"items": [
+                j for j in self.jobs if j["metadata"]["namespace"] == ns]}
+        m = re.fullmatch(r"/tfjobs/api/tfjob/([^/]+)/([^/]+)", url)
+        if m and method == "GET":
+            for j in self.jobs:
+                if j["metadata"]["name"] == m.group(2):
+                    return 200, {"tfJob": j, "pods": PODS}
+            return 404, {"error": "not found"}
+        if m and method == "DELETE":
+            self.deleted.append(f"{m.group(1)}/{m.group(2)}")
+            return 200, {}
+        m = re.fullmatch(r"/tfjobs/api/logs/([^/]+)/([^/]+)", url)
+        if m:
+            return 200, {"logs": f"log line from {m.group(2)}"}
+        return 404, {"error": f"no route {url}"}
+
+
+def make_browser(backend=None):
+    backend = backend or Backend()
+    b = Browser(backend)
+    with open(os.path.join(FRONTEND, "index.html")) as f:
+        html = f.read()
+    with open(os.path.join(FRONTEND, "app.js")) as f:
+        js = f.read()
+    b.load(html, js)
+    return b, backend
+
+
+class TestListView:
+    def test_initial_load_renders_jobs_and_namespaces(self):
+        b, _ = make_browser()
+        rows = b.by_id("jobs").inner_html
+        assert "mnist" in rows
+        assert "TPU:4 Chief:1" in rows
+        assert 'class="state Running"' in rows
+        # namespaces dropdown populated from the API
+        assert "kubeflow" in b.by_id("ns-select").inner_html
+        # list view visible, others hidden
+        assert b.by_id("list").style.props["display"] == "block"
+        assert b.by_id("detail").style.props["display"] == "none"
+
+    def test_empty_list_renders_placeholder(self):
+        b, _ = make_browser(Backend(jobs=[]))
+        assert "no jobs" in b.by_id("jobs").inner_html
+
+    def test_user_content_is_escaped(self):
+        b, _ = make_browser(Backend(jobs=[JOB_XSS]))
+        rows = b.by_id("jobs").inner_html
+        assert "<img" not in rows          # tag neutralized...
+        assert "&lt;img" in rows           # ...but visible as text
+        # and the DOM contains no parsed img element
+        assert not [el for el in b.by_id("jobs").walk() if el.tag == "img"]
+
+    def test_delete_button_issues_delete_and_stops_row_navigation(self):
+        b, backend = make_browser()
+        button = next(el for el in b.by_id("jobs").walk()
+                      if el.tag == "button")
+        b.click(button)
+        assert backend.deleted == ["default/mnist"]
+        # stopPropagation kept the row's showDetail from firing
+        assert b.by_id("detail").style.props["display"] == "none"
+
+    def test_poll_timer_refreshes_only_list_view(self):
+        b, backend = make_browser()
+        n_before = len(b.requests)
+        assert b.fire_timers("interval") == 1
+        assert len(b.requests) == n_before + 1   # refresh fetched
+        # navigate to detail; the timer must then skip refreshing
+        row = next(el for el in b.by_id("jobs").walk() if el.tag == "tr")
+        b.click(row)
+        n_before = len(b.requests)
+        b.fire_timers("interval")
+        assert len(b.requests) == n_before
+
+
+class TestDetailView:
+    def _open_detail(self):
+        b, backend = make_browser()
+        row = next(el for el in b.by_id("jobs").walk() if el.tag == "tr")
+        b.click(row)
+        return b, backend
+
+    def test_row_click_renders_detail(self):
+        b, _ = self._open_detail()
+        assert b.by_id("detail").style.props["display"] == "block"
+        assert b.by_id("d-name").text_content == "default/mnist"
+        info = b.by_id("d-info").inner_html
+        assert "v5litepod-16 4x4" in info
+        conds = b.by_id("d-conditions").inner_html
+        assert "JobRunning" in conds and "all replicas ready" in conds
+        # replica drill-down: desired vs active
+        drill = b.by_id("d-replica-status").inner_html
+        assert "TPU" in drill and "Chief" in drill
+        # raw status/spec JSON present
+        assert '"startTime"' in b.by_id("d-status").text_content
+
+    def test_pod_table_shows_exit_codes_and_replica_labels(self):
+        b, _ = self._open_detail()
+        pods = b.by_id("d-pods").inner_html
+        assert "mnist-tpu-0" in pods
+        assert "tpu-0" in pods           # replica label join
+        assert "137" in pods             # terminated exit code
+
+    def test_logs_link_fetches_and_shows_logs(self):
+        b, _ = self._open_detail()
+        link = next(el for el in b.by_id("d-pods").walk() if el.tag == "a")
+        b.click(link)
+        logs = b.by_id("d-logs")
+        assert logs.style.props["display"] == "block"
+        assert "log line from" in logs.text_content
+
+    def test_back_link_returns_to_list(self):
+        b, _ = self._open_detail()
+        back = next(el for el in b.by_id("detail").walk() if el.tag == "a")
+        b.click(back)
+        assert b.by_id("list").style.props["display"] == "block"
+        assert b.by_id("detail").style.props["display"] == "none"
+
+
+class TestCreateFlow:
+    def _open_create(self):
+        b, backend = make_browser()
+        create_btn = next(el for el in b.document.root.walk()
+                          if el.tag == "button"
+                          and "showCreate" in el.attrs.get("onclick", ""))
+        b.click(create_btn)
+        return b, backend
+
+    def test_form_renders_with_defaults(self):
+        b, _ = self._open_create()
+        form_html = b.by_id("c-form").inner_html
+        assert "my-tpu-job" in form_html
+        assert "v5litepod-16" in form_html
+        assert b.by_id("create").style.props["display"] == "block"
+
+    def test_submit_posts_manifest_built_from_form(self):
+        b, backend = self._open_create()
+        # edit the job name through the DOM, as a user would
+        name_input = next(el for el in b.by_id("c-form").walk()
+                          if el.tag == "input"
+                          and el.attrs.get("onchange") == "form.name=this.value")
+        b.set_value(name_input, "my-run")
+        deploy = next(el for el in b.by_id("create").walk()
+                      if el.tag == "button"
+                      and "submitJob" in el.attrs.get("onclick", ""))
+        b.click(deploy)
+        assert len(backend.created) == 1
+        man = backend.created[0]
+        assert man["metadata"]["name"] == "my-run"
+        assert man["apiVersion"] == "kubeflow.org/v1alpha2"
+        tpu_spec = man["spec"]["tfReplicaSpecs"]["TPU"]
+        assert tpu_spec["replicas"] == 4
+        assert tpu_spec["template"]["spec"]["containers"][0]["resources"][
+            "limits"]["cloud-tpus.google.com/v5e"] == 4
+        assert man["spec"]["tpu"]["acceleratorType"] == "v5litepod-16"
+        # after a successful deploy the SPA returns to the list
+        assert b.by_id("list").style.props["display"] == "block"
+
+    def test_env_var_rows_flow_into_manifest(self):
+        b, backend = self._open_create()
+        add_env = next(el for el in b.by_id("c-form").walk()
+                       if el.tag == "button"
+                       and "envVars.push" in el.attrs.get("onclick", ""))
+        b.click(add_env)
+        name_in = next(el for el in b.by_id("c-form").walk()
+                       if el.attrs.get("onchange") ==
+                       "form.envVars[0].name=this.value")
+        value_in = next(el for el in b.by_id("c-form").walk()
+                        if el.attrs.get("onchange") ==
+                        "form.envVars[0].value=this.value")
+        b.set_value(name_in, "JAX_PLATFORMS")
+        b.set_value(value_in, "tpu")
+        deploy = next(el for el in b.by_id("create").walk()
+                      if el.tag == "button"
+                      and "submitJob" in el.attrs.get("onclick", ""))
+        b.click(deploy)
+        env = backend.created[0]["spec"]["tfReplicaSpecs"]["TPU"][
+            "template"]["spec"]["containers"][0]["env"]
+        assert env == [{"name": "JAX_PLATFORMS", "value": "tpu"}]
+
+    def test_duplicate_replica_type_is_rejected_client_side(self):
+        b, backend = self._open_create()
+        add_rs = next(el for el in b.by_id("c-form").walk()
+                      if el.tag == "button"
+                      and "replicaSpecs.push" in el.attrs.get("onclick", ""))
+        b.click(add_rs)
+        b.click(add_rs)  # two Worker specs -> duplicate
+        deploy = next(el for el in b.by_id("create").walk()
+                      if el.tag == "button"
+                      and "submitJob" in el.attrs.get("onclick", ""))
+        b.click(deploy)
+        assert backend.created == []
+        assert "duplicate replica spec type: Worker" in \
+            b.by_id("c-msg").text_content
+
+    def test_server_error_shown_in_message(self):
+        b, backend = self._open_create()
+        backend.create_error = "tfjobs my-tpu-job already exists"
+        deploy = next(el for el in b.by_id("create").walk()
+                      if el.tag == "button"
+                      and "submitJob" in el.attrs.get("onclick", ""))
+        b.click(deploy)
+        assert "already exists" in b.by_id("c-msg").text_content
+        # stayed on the create view
+        assert b.by_id("create").style.props["display"] == "block"
+
+    def test_json_mode_round_trip(self):
+        b, backend = self._open_create()
+        toggle = b.by_id("mode-btn")
+        b.click(toggle)
+        ta = b.by_id("c-body")
+        assert '"kind": "TFJob"' in ta.value
+        assert ta.style.props["display"] == "block"
+        # edit the JSON, toggle back: the form must absorb the change
+        edited = ta.value.replace('"my-tpu-job"', '"from-json"')
+        b.set_value(ta, edited, fire="")
+        b.click(toggle)
+        assert "from-json" in b.by_id("c-form").inner_html
+        # deploy from form mode carries the JSON edit
+        deploy = next(el for el in b.by_id("create").walk()
+                      if el.tag == "button"
+                      and "submitJob" in el.attrs.get("onclick", ""))
+        b.click(deploy)
+        assert backend.created[0]["metadata"]["name"] == "from-json"
+
+    def test_invalid_json_refuses_to_leave_json_mode(self):
+        b, _ = self._open_create()
+        toggle = b.by_id("mode-btn")
+        b.click(toggle)
+        b.set_value(b.by_id("c-body"), "{not json", fire="")
+        b.click(toggle)
+        assert "invalid JSON" in b.by_id("c-msg").text_content
+        assert b.by_id("c-body").style.props["display"] == "block"
+
+
+class TestNamespaceFilter:
+    def test_selecting_namespace_scopes_refresh(self):
+        b, _ = make_browser()
+        sel = b.by_id("ns-select")
+        b.set_value(sel, "kubeflow")
+        assert b.requests[-1][1] == "/tfjobs/api/tfjob/kubeflow"
+
+
+class TestRuntimeErrorDetection:
+    def test_broken_script_fails_loudly(self):
+        """The tier's reason to exist: a runtime-broken SPA must not pass."""
+        from k8s_tpu.harness.minijs import JSException
+
+        backend = Backend()
+        b = Browser(backend)
+        with open(os.path.join(FRONTEND, "index.html")) as f:
+            html = f.read()
+        broken = "function refresh() { return missingGlobal.items; }\nrefresh();"
+        with pytest.raises(JSException):
+            b.load(html, broken)

@@ -1,0 +1,246 @@
+"""minijs engine tests: the language subset the dashboard SPA depends on.
+Each case is a small program with an asserted value — the contract the
+interpreter must hold for the frontend runtime tier to be trustworthy."""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_tpu.harness.minijs import Interpreter, JSException, parse
+
+
+def run(src: str):
+    return Interpreter().run(src)
+
+
+def run_then(setup: str, expr: str):
+    """Execute ``setup``, drain microtasks, then evaluate ``expr`` — the
+    state visible after the job queue quiesces (what a test of real JS
+    would observe after awaiting the event loop)."""
+    interp = Interpreter()
+    interp.run(setup)
+    return interp.run(expr)
+
+
+class TestExpressions:
+    @pytest.mark.parametrize("src,want", [
+        ("1 + 2 * 3", 7.0),
+        ("(1 + 2) * 3", 9.0),
+        ("'a' + 1", "a1"),
+        ("1 + '2'", "12"),
+        ("10 / 4", 2.5),
+        ("7 % 3", 1.0),
+        ("'b' === 'b'", True),
+        ("1 !== 2", True),
+        ("null == undefined", True),
+        ("null === undefined", False),
+        ("!0", True),
+        ("-'5'", -5.0),
+        ("typeof 'x'", "string"),
+        ("typeof undefined", "undefined"),
+        ("typeof missing_global", "undefined"),
+        ("true ? 'y' : 'n'", "y"),
+        ("null ?? 'dflt'", "dflt"),
+        ("0 ?? 'dflt'", 0.0),
+        ("'' || 'fallback'", "fallback"),
+        ("'x' && 'y'", "y"),
+        ("1 < 2 && 2 <= 2 && 3 > 2 && 3 >= 3", True),
+        ("'abc'.length", 3.0),
+        ("[1,2,3].length", 3.0),
+    ])
+    def test_value(self, src, want):
+        assert run(src) == want
+
+    def test_template_literals_nest(self):
+        src = "`a${`b${1+1}c`}d${'e'}`"
+        assert run(src) == "ab2cde"
+
+    def test_number_to_string_is_js_style(self):
+        assert run("'' + 4") == "4"          # not 4.0
+        assert run("`${8/2}`") == "4"
+        assert run("'' + 2.5") == "2.5"
+
+
+class TestBindingsAndFunctions:
+    def test_closures(self):
+        assert run("""
+            function counter() { let n = 0; return () => { n = n + 1; return n; }; }
+            const c = counter(); c(); c();
+            c()""") == 3.0
+
+    def test_default_and_rest_params(self):
+        assert run("((a, b = 10, ...rest) => a + b + rest.length)(1)") == 11.0
+        assert run("((...xs) => xs.join(''))('a','b','c')") == "abc"
+
+    def test_array_destructuring_params(self):
+        assert run("[[1,'a'],[2,'b']].map(([n, s]) => s + n).join(',')") == "a1,b2"
+
+    def test_object_spread_order(self):
+        assert run("""
+            const base = {x: 1, y: 2};
+            const o = {x: 0, ...base, z: 3};
+            JSON.stringify(o)""") == '{"x":1,"y":2,"z":3}'
+
+    def test_shorthand_properties(self):
+        assert run("const spec = {a: 1}; JSON.stringify({spec})") == '{"spec":{"a":1}}'
+
+    def test_for_of_destructuring(self):
+        assert run("""
+            let out = '';
+            for (const [k, v] of Object.entries({a: 1, b: 2})) out += k + v;
+            out""") == "a1b2"
+
+    def test_classic_for_and_while(self):
+        assert run("""
+            let s = 0;
+            for (let i = 0; i < 5; i++) s += i;
+            let j = 0; while (j < 3) { s += 10; j++; }
+            s""") == 40.0
+
+    def test_function_hoisting(self):
+        assert run("const v = later(); function later() { return 42; } v") == 42.0
+
+    def test_named_function_expression_recursion(self):
+        assert run("(function f(n) { return n <= 1 ? 1 : n * f(n - 1); })(5)") == 120.0
+
+
+class TestBuiltins:
+    def test_array_methods(self):
+        assert run("[3,1,2].filter((x) => x > 1).map((x) => x * 10).join('-')") == "30-20"
+        assert run("[1,2,3].find((x, i) => i === 2)") == 3.0
+        assert run("(() => { const a = [1,2,3,4]; const cut = a.splice(1, 2); return a.join('') + '|' + cut.join(''); })()") == "14|23"
+        assert run("[[1,2],[3]].flat().join('')") == "123"
+        assert run("[1,2,3].reduce((a, b) => a + b, 10)") == 16.0
+        assert run("['b','a'].sort().join('')") == "ab"
+        assert run("[1,2].concat([3], 4).join('')") == "1234"
+        assert run("[1,2,3].includes(2)") == True  # noqa: E712
+        assert run("[1,2,3].indexOf(9)") == -1.0
+
+    def test_string_methods(self):
+        assert run("'a&b<c>\"d\\''.replace(/&/g,'&amp;').replace(/</g,'&lt;')"
+                   ".replace(/>/g,'&gt;')") == "a&amp;b&lt;c&gt;\"d'"
+        assert run("'  x  '.trim()") == "x"
+        assert run("'a b   c'.split(/\\s+/).length") == 3.0
+        assert run("'hello'.slice(1, 3)") == "el"
+        assert run("'a-b-c'.split('-').join('+')") == "a+b+c"
+        assert run("'Hi'.toLowerCase() + 'no'.toUpperCase()") == "hiNO"
+        assert run("'str'.replace('t', 'T')") == "sTr"
+
+    def test_set_and_spread(self):
+        assert run("[...new Set([...['a','b'], ...['b','c']])].join('')") == "abc"
+        assert run("new Set(['x','x']).size") == 1.0
+
+    def test_object_statics(self):
+        assert run("Object.keys({a:1,b:2}).join('')") == "ab"
+        assert run("Object.values({a:1,b:2}).join('')") == "12"
+        assert run("JSON.stringify(Object.assign({}, {a:1}, {b:2}))") == '{"a":1,"b":2}'
+
+    def test_json_roundtrip(self):
+        assert run("JSON.parse(JSON.stringify({a: [1, 'x', true, null]})).a.length") == 4.0
+        assert run("JSON.stringify({n: 4})") == '{"n":4}'  # ints stay ints
+        assert run("JSON.stringify({a:1}, null, 2)") == '{\n  "a": 1\n}'
+
+    def test_json_parse_error_is_catchable(self):
+        assert run("""
+            let msg = '';
+            try { JSON.parse('{nope'); } catch (e) { msg = 'bad:' + (e.message.length > 0); }
+            msg""") == "bad:true"
+
+    def test_number_string_boolean(self):
+        assert run("Number('12') + Number('')") == 12.0
+        assert run("String(3) + String(null) + String(undefined)") == "3nullundefined"
+        assert run("Boolean('') || Boolean('x')") == True  # noqa: E712
+
+
+class TestControlFlowAndErrors:
+    def test_throw_catch_finally(self):
+        assert run("""
+            let log = '';
+            try { throw new Error('boom'); }
+            catch (e) { log += 'c:' + e.message; }
+            finally { log += ';f'; }
+            log""") == "c:boom;f"
+
+    def test_uncaught_throw_surfaces(self):
+        with pytest.raises(JSException) as ei:
+            run("throw new Error('unhandled')")
+        assert "unhandled" in str(ei.value)
+
+    def test_break_continue(self):
+        assert run("""
+            let s = '';
+            for (const x of ['a','b','c','d']) {
+              if (x === 'b') continue;
+              if (x === 'd') break;
+              s += x;
+            }
+            s""") == "ac"
+
+    def test_member_of_undefined_is_type_error(self):
+        with pytest.raises(JSException) as ei:
+            run("const o = {}; o.missing.deeper")
+        assert "Cannot read properties of undefined" in str(ei.value)
+
+
+class TestAsync:
+    def test_await_resolved_promise(self):
+        assert run("""
+            let got = 0;
+            async function f() { got = await Promise.resolve(7); }
+            f();
+            got""") == 7.0
+
+    def test_then_catch_chain(self):
+        assert run_then("""
+            let out = [];
+            Promise.resolve(1).then((v) => v + 1).then((v) => out.push(v));
+            Promise.reject(new Error('x')).catch((e) => out.push(e.message));
+            """, "out.join(',')") == "x,2"
+        # real-JS ordering: the first .then and the .catch are queued in
+        # creation order; the second .then only enqueues after the first
+        # handler runs, so it lands after the catch
+
+    def test_async_function_returns_promise(self):
+        assert run_then("""
+            let got = '';
+            async function f() { return 'val'; }
+            f().then((v) => { got = v; });
+            """, "got") == "val"
+
+    def test_await_rejection_caught_by_try(self):
+        assert run("""
+            let msg = '';
+            async function f() {
+              try { await Promise.reject(new Error('nope')); }
+              catch (e) { msg = e.message; }
+            }
+            f();
+            msg""") == "nope"
+
+    def test_catch_fallback_value(self):
+        # the SPA's loadNamespaces pattern
+        assert run("""
+            let got = null;
+            async function f() {
+              const data = await Promise.reject(new Error('down'))
+                .catch(() => ({ namespaces: [] }));
+              got = data.namespaces.length;
+            }
+            f();
+            got""") == 0.0
+
+    def test_promise_all(self):
+        assert run_then("""
+            let got = '';
+            Promise.all([Promise.resolve('a'), 'b']).then((vs) => { got = vs.join(''); });
+            """, "got") == "ab"
+
+
+class TestParserErrors:
+    def test_syntax_error_reported_with_line(self):
+        with pytest.raises(SyntaxError):
+            parse("const = 1;")
+
+    def test_unterminated_template(self):
+        with pytest.raises(SyntaxError):
+            parse("`abc")
