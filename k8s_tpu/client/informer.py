@@ -38,23 +38,70 @@ def split_meta_namespace_key(key: str) -> tuple[str, str]:
 
 
 class Store:
-    """Thread-safe object cache keyed by namespace/name."""
+    """Thread-safe object cache keyed by namespace/name, with optional
+    secondary indexes (client-go cache.Indexers): ``add_index`` registers a
+    function obj -> [index keys]; ``by_index`` answers point queries
+    without scanning the cache.  Indexes turn the controller's
+    pods-for-job lookup from O(all pods) into O(gang size) — the scale
+    fix past 200 concurrent jobs."""
 
     def __init__(self):
         self._lock = threading.RLock()
         self._items: dict[str, dict] = {}
+        self._index_funcs: dict[str, Callable[[dict], list[str]]] = {}
+        # index name -> index key -> set of object keys
+        self._indexes: dict[str, dict[str, set[str]]] = {}
+
+    def add_index(self, name: str, fn: Callable[[dict], list[str]]) -> None:
+        with self._lock:
+            self._index_funcs[name] = fn
+            idx: dict[str, set[str]] = {}
+            for key, obj in self._items.items():
+                for ik in fn(obj):
+                    idx.setdefault(ik, set()).add(key)
+            self._indexes[name] = idx
+
+    def _index_add(self, key: str, obj: dict) -> None:
+        for name, fn in self._index_funcs.items():
+            idx = self._indexes[name]
+            for ik in fn(obj):
+                idx.setdefault(ik, set()).add(key)
+
+    def _index_remove(self, key: str, obj: dict) -> None:
+        for name, fn in self._index_funcs.items():
+            idx = self._indexes[name]
+            for ik in fn(obj):
+                bucket = idx.get(ik)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del idx[ik]
 
     def replace(self, objs: list[dict]) -> None:
         with self._lock:
             self._items = {meta_namespace_key(o): o for o in objs}
+            for name, fn in self._index_funcs.items():
+                idx: dict[str, set[str]] = {}
+                for key, obj in self._items.items():
+                    for ik in fn(obj):
+                        idx.setdefault(ik, set()).add(key)
+                self._indexes[name] = idx
 
     def add(self, obj: dict) -> None:
         with self._lock:
-            self._items[meta_namespace_key(obj)] = obj
+            key = meta_namespace_key(obj)
+            old = self._items.get(key)
+            if old is not None:
+                self._index_remove(key, old)
+            self._items[key] = obj
+            self._index_add(key, obj)
 
     def delete(self, obj: dict) -> None:
         with self._lock:
-            self._items.pop(meta_namespace_key(obj), None)
+            key = meta_namespace_key(obj)
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._index_remove(key, old)
 
     def get_by_key(self, key: str) -> Optional[dict]:
         with self._lock:
@@ -67,6 +114,15 @@ class Store:
     def keys(self) -> list[str]:
         with self._lock:
             return list(self._items.keys())
+
+    def by_index(self, name: str, index_key: str) -> list[dict]:
+        """Objects whose index function emitted ``index_key`` (no-copy,
+        same read-only contract as Lister.list)."""
+        with self._lock:
+            keys = self._indexes.get(name, {}).get(index_key)
+            if not keys:
+                return []
+            return [self._items[k] for k in keys if k in self._items]
 
 
 class SharedInformer:
@@ -282,6 +338,35 @@ class Lister:
                 continue
             out.append(o)
         return out
+
+    def by_index(self, name: str, index_key: str) -> list[dict]:
+        """Point query against a registered store index (read-only
+        objects, like ``list``)."""
+        return self._informer.store.by_index(name, index_key)
+
+
+# standard index functions (client-go's cache.Indexers equivalents)
+
+OWNER_INDEX = "controller-uid"
+ORPHAN_INDEX = "orphans-by-namespace"
+
+
+def index_by_controller_uid(obj: dict) -> list[str]:
+    """Index key: the owning controller's uid (at most one per object)."""
+    for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
+        if ref.get("controller"):
+            uid = ref.get("uid")
+            return [uid] if uid else []
+    return []
+
+
+def index_orphans_by_namespace(obj: dict) -> list[str]:
+    """Index key: namespace, only for objects with NO controller owner —
+    the (normally tiny) adoption-candidate set."""
+    for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
+        if ref.get("controller"):
+            return []
+    return [(obj.get("metadata") or {}).get("namespace", "")]
 
 
 class SharedInformerFactory:

@@ -138,6 +138,20 @@ class _RestWatch:
 
     def stop(self) -> None:
         self.stopped = True
+        # Shut down the socket FIRST: close() must take the BufferedReader
+        # lock, which a reader blocked in readline() holds until the next
+        # frame arrives — stop() from another thread would block for the
+        # rest of the watch.  shutdown() needs no lock and makes the
+        # blocked recv return EOF immediately.
+        try:
+            sock = getattr(getattr(self._resp, "fp", None), "raw", None)
+            sock = getattr(sock, "_sock", None)
+            if sock is not None:
+                import socket as _socket
+
+                sock.shutdown(_socket.SHUT_RDWR)
+        except Exception:
+            pass
         try:
             self._resp.close()
         except Exception:

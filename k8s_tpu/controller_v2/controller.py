@@ -74,6 +74,22 @@ class TFJobController:
         self.service_lister = factory.lister_for(SERVICES)
         self.node_lister = factory.lister_for(NODES)
 
+        # Indexers (client-go cache.Indexers): pods/services for one job are
+        # point lookups — owned objects by controller uid, plus the (tiny)
+        # orphan set per namespace for adoption — instead of an O(all pods
+        # in namespace) scan per sync, which was the 200-concurrent-job
+        # scale wall (BASELINE.md).
+        from k8s_tpu.client.informer import (
+            ORPHAN_INDEX,
+            OWNER_INDEX,
+            index_by_controller_uid,
+            index_orphans_by_namespace,
+        )
+
+        for informer in (self.pod_informer, self.service_informer):
+            informer.store.add_index(OWNER_INDEX, index_by_controller_uid)
+            informer.store.add_index(ORPHAN_INDEX, index_orphans_by_namespace)
+
         # node-condition awareness (SURVEY.md §7: exit-code-only preemption
         # classification is lossy; node taints/Ready conditions disambiguate)
         self.pod_reconciler = pod_mod.PodReconciler(
@@ -316,10 +332,17 @@ class TFJobController:
     # -- adoption ------------------------------------------------------------
 
     def resolve_controller_ref(self, namespace: str, ref: dict):
-        """controller.go:441-457."""
+        """controller.go:441-457.
+
+        Reads the cached object WITHOUT the lister's defensive copy: every
+        pod/service event resolves its owner, so this is the hottest read
+        in the controller, and its callers only derive the enqueue key /
+        expectation key from the result (read-only by contract — the
+        mutation seam is sync_tfjob's ``lister.get``)."""
         if ref.get("kind") != "TFJob":
             return None
-        obj = self.tfjob_lister.get(namespace, ref.get("name", ""))
+        key = f"{namespace}/{ref.get('name', '')}" if namespace else ref.get("name", "")
+        obj = self.tfjob_informer.store.get_by_key(key)
         if obj is None:
             return None
         tfjob = register.tfjob_from_unstructured(obj)
@@ -343,12 +366,30 @@ class TFJobController:
 
         return selector, can_adopt
 
+    def _claim_candidates(self, lister, tfjob) -> list[dict]:
+        """Owned objects (owner-uid index) + same-namespace orphans (orphan
+        index) — the only objects claim_* can possibly keep or adopt.
+        Objects owned by OTHER controllers are excluded by construction,
+        exactly as the ref manager would skip them after an O(N) scan."""
+        from k8s_tpu.client.informer import ORPHAN_INDEX, OWNER_INDEX
+
+        ns = tfjob.metadata.namespace
+        # OWNER_INDEX is keyed by uid alone, so filter by namespace here: a
+        # cross-namespace object carrying this uid must not be counted as
+        # part of the gang.  ORPHAN_INDEX keys ARE namespaces, so its
+        # results need no further filtering.
+        owned = [
+            o for o in lister.by_index(OWNER_INDEX, tfjob.metadata.uid)
+            if (o.get("metadata") or {}).get("namespace") == ns
+        ]
+        return owned + lister.by_index(ORPHAN_INDEX, ns)
+
     def get_pods_for_tfjob(self, tfjob) -> list[dict]:
         """getPodsForTFJob (controller_pod.go:174-210)."""
         from k8s_tpu.controller_v2.ref_manager import PodControllerRefManager
 
         selector, can_adopt = self._claim_manager_args(tfjob)
-        pods = self.pod_lister.list(tfjob.metadata.namespace)
+        pods = self._claim_candidates(self.pod_lister, tfjob)
         manager = PodControllerRefManager(
             self.pod_control, tfjob.to_dict(), selector, "TFJob",
             tfjob.api_version, can_adopt,
@@ -360,7 +401,7 @@ class TFJobController:
         from k8s_tpu.controller_v2.ref_manager import ServiceControllerRefManager
 
         selector, can_adopt = self._claim_manager_args(tfjob)
-        services = self.service_lister.list(tfjob.metadata.namespace)
+        services = self._claim_candidates(self.service_lister, tfjob)
         manager = ServiceControllerRefManager(
             self.service_control, tfjob.to_dict(), selector, "TFJob",
             tfjob.api_version, can_adopt,

@@ -51,6 +51,8 @@ class KubeletSimulator:
         self._procs: dict[str, subprocess.Popen] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._active_watch = None
+        self._watch_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -63,6 +65,16 @@ class KubeletSimulator:
 
     def stop(self) -> None:
         self._stop.set()
+        # Close the in-flight watch so a loop blocked in w.next() (the REST
+        # backend's next() blocks on the stream regardless of its timeout
+        # argument) unblocks instead of leaking the thread + connection —
+        # the SharedInformer._active_watch pattern.
+        with self._watch_lock:
+            if self._active_watch is not None:
+                try:
+                    self._active_watch.stop()
+                except Exception:
+                    pass
         for proc in list(self._procs.values()):
             if proc.poll() is None:
                 proc.kill()
@@ -71,30 +83,83 @@ class KubeletSimulator:
 
     # -- main loop -----------------------------------------------------------
 
+    # Periodic full-relist fallback behind the watch stream.  A real
+    # kubelet is watch-driven; the relist only reconciles anything a
+    # dropped stream missed, so it can be orders slower than the old
+    # poll-everything loop (at 1600 pods a 50 ms list-poll deep-copied the
+    # whole namespace 20x/s — the e2e-scale bottleneck).
+    RELIST_FALLBACK_S = 10.0
+
     def _loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self._sync_once()
-            except Exception:
-                log.exception("kubelet sync error")
-            self._stop.wait(self.poll_interval_s)
+        w = None
+        last_relist = 0.0
+        try:
+            while not self._stop.is_set():
+                try:
+                    if w is None:
+                        w = self.clientset.pods(self.namespace).watch()
+                        with self._watch_lock:
+                            self._active_watch = w
+                        self._sync_once()  # catch up across the watch gap
+                        last_relist = time.monotonic()
+                    item = w.next(timeout=0.2)
+                    if item is None:
+                        if getattr(w, "stopped", False):
+                            w.stop()
+                            w = None
+                            with self._watch_lock:
+                                self._active_watch = None
+                        elif (time.monotonic() - last_relist
+                              > self.RELIST_FALLBACK_S):
+                            self._sync_once()
+                            last_relist = time.monotonic()
+                        continue
+                    event_type, pod = item
+                    if event_type == "DELETED":
+                        self._kill_deleted(pod)
+                    else:
+                        self._maybe_claim(pod)
+                except Exception:
+                    if self._stop.is_set():
+                        return
+                    log.exception("kubelet sync error")
+                    if w is not None:
+                        w.stop()
+                        w = None
+                        with self._watch_lock:
+                            self._active_watch = None
+                    self._stop.wait(self.poll_interval_s)
+        finally:
+            if w is not None:
+                w.stop()
+
+    def _maybe_claim(self, pod: dict) -> None:
+        uid = (pod.get("metadata") or {}).get("uid")
+        if not uid:
+            return
+        phase = (pod.get("status") or {}).get("phase")
+        if uid in self._claimed or phase in ("Succeeded", "Failed"):
+            return
+        self._claimed.add(uid)
+        threading.Thread(
+            target=self._run_pod, args=(pod,), daemon=True,
+            name=f"pod-{pod['metadata']['name']}",
+        ).start()
+
+    def _kill_deleted(self, pod: dict) -> None:
+        uid = (pod.get("metadata") or {}).get("uid")
+        proc = self._procs.get(uid)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
 
     def _sync_once(self) -> None:
         pods = self.clientset.pods(self.namespace).list()
         live_uids = set()
         for pod in pods:
             uid = (pod.get("metadata") or {}).get("uid")
-            if not uid:
-                continue
-            live_uids.add(uid)
-            phase = (pod.get("status") or {}).get("phase")
-            if uid in self._claimed or phase in ("Succeeded", "Failed"):
-                continue
-            self._claimed.add(uid)
-            threading.Thread(
-                target=self._run_pod, args=(pod,), daemon=True,
-                name=f"pod-{pod['metadata']['name']}",
-            ).start()
+            if uid:
+                live_uids.add(uid)
+            self._maybe_claim(pod)
         # pods deleted from the apiserver: kill their processes (kubelet
         # behavior for deleted pods)
         for uid, proc in list(self._procs.items()):

@@ -28,6 +28,7 @@ class LocalCluster:
         enable_gang_scheduling: bool = False,
         kubelet_kwargs: dict | None = None,
         threadiness: int = 1,
+        resync_period_s: float = RESYNC_S,
     ):
         # threadiness mirrors the operator flag (reference default: v1 runs
         # 1 worker, v2's flag defaults to 2 — options.go:42, server.go:95)
@@ -39,7 +40,7 @@ class LocalCluster:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
-        factory = SharedInformerFactory(self.backend, resync_period=RESYNC_S)
+        factory = SharedInformerFactory(self.backend, resync_period=resync_period_s)
         if version.endswith("v1alpha1"):
             from k8s_tpu.controller.controller import Controller
 

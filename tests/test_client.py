@@ -175,6 +175,51 @@ class TestWatch:
         w.stop()
 
 
+class TestStoreIndexes:
+    def test_owner_and_orphan_indexes_track_mutations(self):
+        from k8s_tpu.client.informer import (
+            ORPHAN_INDEX,
+            OWNER_INDEX,
+            Store,
+            index_by_controller_uid,
+            index_orphans_by_namespace,
+        )
+
+        store = Store()
+        store.add_index(OWNER_INDEX, index_by_controller_uid)
+        store.add_index(ORPHAN_INDEX, index_orphans_by_namespace)
+
+        owned = _pod("p-owned", owner_uid="u1")
+        orphan = _pod("p-orphan")
+        store.add(owned)
+        store.add(orphan)
+        assert [o["metadata"]["name"] for o in store.by_index(OWNER_INDEX, "u1")] == ["p-owned"]
+        assert [o["metadata"]["name"] for o in store.by_index(ORPHAN_INDEX, "default")] == ["p-orphan"]
+
+        # adoption: orphan gains a controller ref -> moves between indexes
+        adopted = _pod("p-orphan", owner_uid="u2")
+        store.add(adopted)
+        assert store.by_index(ORPHAN_INDEX, "default") == []
+        assert len(store.by_index(OWNER_INDEX, "u2")) == 1
+
+        # delete removes from indexes
+        store.delete(owned)
+        assert store.by_index(OWNER_INDEX, "u1") == []
+
+        # replace() rebuilds from scratch
+        store.replace([_pod("x", owner_uid="u9"), _pod("y")])
+        assert len(store.by_index(OWNER_INDEX, "u9")) == 1
+        assert len(store.by_index(ORPHAN_INDEX, "default")) == 1
+
+    def test_add_index_on_populated_store_backfills(self):
+        from k8s_tpu.client.informer import OWNER_INDEX, Store, index_by_controller_uid
+
+        store = Store()
+        store.add(_pod("pre", owner_uid="u1"))
+        store.add_index(OWNER_INDEX, index_by_controller_uid)
+        assert len(store.by_index(OWNER_INDEX, "u1")) == 1
+
+
 class TestInformer:
     def test_informer_syncs_and_dispatches(self):
         fc = FakeCluster()
