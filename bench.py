@@ -344,10 +344,16 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
     on_tpu = jax.default_backend() == "tpu"
     if use_flash is None:
         use_flash = on_tpu  # Pallas kernel is TPU-only
+    def _env_int(name):
+        raw = os.environ.get(name)
+        return int(raw) if raw else None
+
     cfg = TransformerConfig(
         vocab_size=32000, hidden=768, ffn_hidden=3072, layers=12, heads=12,
         kv_heads=12, max_seq_len=seq, dtype=jnp.bfloat16, remat=False,
         use_flash_attention=use_flash,
+        flash_block_q=_env_int("BENCH_FLASH_BLOCK_Q"),
+        flash_block_k=_env_int("BENCH_FLASH_BLOCK_K"),
     )
     model = Transformer(cfg)
     tokens = jax.random.randint(

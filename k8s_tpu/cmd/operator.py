@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--namespace", default="",
                    help="Namespace to watch (default: KUBEFLOW_NAMESPACE or all)")
     p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve /metrics + /healthz on this port (0 = off, "
+                   "matching the reference, which exposes no endpoint)")
     p.add_argument("--version", action="store_true")
     return p
 
@@ -116,6 +119,11 @@ def run(opts, backend=None) -> int:
     )
     stop = setup_signal_handler()
 
+    from k8s_tpu.util.metrics_server import maybe_start
+
+    metrics_server = maybe_start(getattr(opts, "metrics_port", 0),
+                                health_fn=controller.healthy)
+
     namespace = opts.namespace or get_namespace()
     elector = LeaderElector(
         clientset,
@@ -151,7 +159,11 @@ def run(opts, backend=None) -> int:
         log.error("leader election lost")
         os._exit(1)
 
-    elector.run_or_die(on_started_leading, on_stopped_leading)
+    try:
+        elector.run_or_die(on_started_leading, on_stopped_leading)
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

@@ -78,6 +78,15 @@ class Controller:
 
     # -- run loop ------------------------------------------------------------
 
+
+    def healthy(self) -> bool:
+        """Liveness signal for /healthz: healthy before run() starts (a
+        standby replica is alive), and, once running, while at least one
+        worker thread is still processing the queue."""
+        if not self._workers:
+            return True
+        return any(t.is_alive() for t in self._workers)
+
     def run(self, threadiness: int = 1, stop_event: threading.Event | None = None) -> None:
         stop = stop_event or self._stop
         self.start(threadiness)

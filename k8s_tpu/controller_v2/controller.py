@@ -152,6 +152,15 @@ class TFJobController:
 
     # -- run loop ------------------------------------------------------------
 
+
+    def healthy(self) -> bool:
+        """Liveness signal for /healthz: healthy before run() starts (a
+        standby replica is alive), and, once running, while at least one
+        worker thread is still processing the queue."""
+        if not self._workers:
+            return True
+        return any(t.is_alive() for t in self._workers)
+
     def run(self, threadiness: int = 1, stop_event: threading.Event | None = None) -> None:
         """controller.go:245-284: start informers, wait for sync, run workers.
         Blocks until ``stop_event`` (or internal stop) fires."""

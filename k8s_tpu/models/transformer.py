@@ -39,6 +39,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
     use_flash_attention: bool = False  # Pallas fused kernel (k8s_tpu.ops)
+    # flash kernel tile sizes (None -> kernel defaults); sweepable per
+    # device generation without touching the kernel
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
     use_fused_norm: bool = False  # Pallas RMSNorm kernel (k8s_tpu.ops)
     remat: bool = True  # jax.checkpoint each layer: HBM for FLOPs
     # MoE (k8s_tpu.models.moe): >0 swaps the dense MLP for routed experts
@@ -153,8 +157,16 @@ class Attention(nn.Module):
             out = ring_attention(mesh, q, k, v, causal=cfg.causal)
         elif cfg.use_flash_attention:
             from k8s_tpu.ops import flash_attention
+            from k8s_tpu.ops.flash_attention import (
+                DEFAULT_BLOCK_K,
+                DEFAULT_BLOCK_Q,
+            )
 
-            out = flash_attention(q, k, v, causal=cfg.causal)
+            out = flash_attention(
+                q, k, v, causal=cfg.causal,
+                block_q=cfg.flash_block_q or DEFAULT_BLOCK_Q,
+                block_k=cfg.flash_block_k or DEFAULT_BLOCK_K,
+            )
         else:
             out = _plain_attention(q, k, v, cfg.causal)
 

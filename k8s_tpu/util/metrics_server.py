@@ -1,0 +1,92 @@
+"""Operator observability endpoint: /metrics (Prometheus text 0.0.4 from
+util.metrics.Registry) and /healthz.
+
+The reference operator exposed no scrape endpoint at all (cmd/tf-operator*/
+app/server.go wires no HTTP server); a production operator needs one, so
+this is an intentional superset.  Served on ``--metrics-port`` (0 =
+disabled, the default, preserving reference behavior).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from k8s_tpu.util import metrics as metrics_mod
+
+log = logging.getLogger(__name__)
+
+
+class MetricsServer:
+    """Threaded HTTP server for /metrics and /healthz.
+
+    ``health_fn`` (optional) returns True when the process is healthy —
+    wire the leader elector / controller liveness there; without one,
+    /healthz answers 200 while the process serves at all.
+    """
+
+    def __init__(self, port: int, registry: Optional[metrics_mod.Registry] = None,
+                 host: str = "0.0.0.0",
+                 health_fn: Optional[Callable[[], bool]] = None):
+        registry = registry or metrics_mod.REGISTRY
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route through logging
+                log.debug("metrics: " + fmt, *args)
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    return self._send(
+                        200, registry.expose(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                if path == "/healthz":
+                    try:
+                        healthy = health_fn() if health_fn else True
+                    except Exception:  # noqa: BLE001 - a broken probe is unhealthy
+                        healthy = False
+                    return self._send(200 if healthy else 503,
+                                      "ok\n" if healthy else "unhealthy\n",
+                                      "text/plain")
+                return self._send(404, "not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name="metrics-server",
+        )
+        self._thread.start()
+        log.info("metrics endpoint on :%d (/metrics, /healthz)", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def maybe_start(port: int, **kwargs) -> Optional[MetricsServer]:
+    """Start a MetricsServer when ``port`` is non-zero; 0 disables (the
+    reference-parity default)."""
+    if not port:
+        return None
+    return MetricsServer(port, **kwargs).start()

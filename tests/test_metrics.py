@@ -145,3 +145,62 @@ class TestDashboardEndpoint:
             assert "dash_probe_total 1" in body
         finally:
             server.shutdown()
+
+
+class TestMetricsServer:
+    def _get(self, port, path):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_serves_metrics_and_healthz(self):
+        from k8s_tpu.util import metrics as metrics_mod
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        registry = metrics_mod.Registry()
+        counter = registry.counter("demo_total", "demo", ("kind",))
+        counter.labels("x").inc(3)
+        server = MetricsServer(0, registry=registry, host="127.0.0.1")
+        server.start()
+        try:
+            code, body = self._get(server.port, "/metrics")
+            assert code == 200
+            assert 'demo_total{kind="x"} 3' in body
+            code, body = self._get(server.port, "/healthz")
+            assert (code, body) == (200, "ok\n")
+            code, _ = self._get(server.port, "/nope")
+            assert code == 404
+        finally:
+            server.stop()
+
+    def test_healthz_reflects_health_fn(self):
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        healthy = [True]
+        server = MetricsServer(0, host="127.0.0.1",
+                               health_fn=lambda: healthy[0])
+        server.start()
+        try:
+            assert self._get(server.port, "/healthz")[0] == 200
+            healthy[0] = False
+            assert self._get(server.port, "/healthz")[0] == 503
+        finally:
+            server.stop()
+
+    def test_maybe_start_disabled_at_port_zero(self):
+        from k8s_tpu.util.metrics_server import maybe_start
+
+        assert maybe_start(0) is None
+
+    def test_operator_flag_parses(self):
+        from k8s_tpu.cmd import operator, operator_v2
+
+        for mod in (operator, operator_v2):
+            opts = mod.build_parser().parse_args(["--metrics-port", "9091"])
+            assert opts.metrics_port == 9091

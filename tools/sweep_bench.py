@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Perf sweep driver: runs bench.py across a variant matrix, one subprocess
+per variant (XLA flags and env knobs need fresh processes), and prints a
+ranked table.  Used to chase the round-3 headline targets:
+
+- ResNet: conv vs s2d stem (BENCH_RESNET_STEM);
+- transformer: flash tile sizes (BENCH_FLASH_BLOCK_Q/K).
+
+Each variant runs BENCH_ONLY-scoped with reduced repeats so one sweep fits
+in a relay-friendly window; the winner is then re-run at full repeats by
+the operator before committing numbers to BENCH_BASELINE/BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RESNET_VARIANTS = [
+    {"name": "conv-stem", "env": {"BENCH_RESNET_STEM": "conv"}},
+    {"name": "s2d-stem", "env": {"BENCH_RESNET_STEM": "s2d"}},
+]
+
+TRANSFORMER_VARIANTS = [
+    {"name": "flash-512x1024", "env": {}},  # kernel defaults
+    {"name": "flash-256x512",
+     "env": {"BENCH_FLASH_BLOCK_Q": "256", "BENCH_FLASH_BLOCK_K": "512"}},
+    {"name": "flash-512x512",
+     "env": {"BENCH_FLASH_BLOCK_Q": "512", "BENCH_FLASH_BLOCK_K": "512"}},
+    {"name": "flash-1024x1024",
+     "env": {"BENCH_FLASH_BLOCK_Q": "1024", "BENCH_FLASH_BLOCK_K": "1024"}},
+    {"name": "flash-256x1024",
+     "env": {"BENCH_FLASH_BLOCK_Q": "256", "BENCH_FLASH_BLOCK_K": "1024"}},
+]
+
+
+def run_variant(which: str, variant: dict, repeats: int, timeout: float):
+    env = dict(os.environ)
+    env.update(variant["env"])
+    env.update({
+        "BENCH_ONLY": which,
+        "BENCH_REPEATS": str(repeats),
+        "BENCH_NO_CONTROL": "1",
+        # floor: a small --timeout must not arm bench.py's watchdog with a
+        # zero/negative budget (it would os._exit immediately)
+        "BENCH_TOTAL_TIMEOUT": str(max(60.0, timeout - 30)),
+    })
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"name": variant["name"], "error": "timeout"}
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return {"name": variant["name"],
+                "error": tail[-1][:160] if tail else f"rc={r.returncode}"}
+    try:
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"name": variant["name"], "error": "bad output"}
+    key = ("value" if which == "resnet"
+           else "transformer_tokens_per_sec_per_chip")
+    std_key = "resnet50_std" if which == "resnet" else "transformer_std"
+    return {"name": variant["name"], "value": out.get(key),
+            "std": out.get(std_key), "raw": out}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("which", choices=["resnet", "transformer"])
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="per-variant wall clock (compile + repeats)")
+    args = p.parse_args(argv)
+
+    variants = RESNET_VARIANTS if args.which == "resnet" \
+        else TRANSFORMER_VARIANTS
+    results = []
+    for v in variants:
+        print(f"sweep: running {v['name']} ...", file=sys.stderr, flush=True)
+        res = run_variant(args.which, v, args.repeats, args.timeout)
+        results.append(res)
+        print(f"sweep: {v['name']} -> "
+              f"{res.get('value', res.get('error'))}",
+              file=sys.stderr, flush=True)
+
+    ok = [r for r in results if "value" in r and r["value"]]
+    ok.sort(key=lambda r: -r["value"])
+    for r in ok:
+        print(f"{r['name']:>18}: {r['value']:>10.1f} ± {r.get('std') or 0:.1f}")
+    for r in results:
+        if "error" in r:
+            print(f"{r['name']:>18}: ERROR {r['error']}")
+    if ok:
+        print(json.dumps({"winner": ok[0]["name"], "value": ok[0]["value"]}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
