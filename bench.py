@@ -242,7 +242,9 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5,
     batch = batch_per_chip * n_chips
 
     if stem is None:
-        stem = os.environ.get("BENCH_RESNET_STEM", "s2d")
+        # default stays on the hardware-validated stem; tools/sweep_bench.py
+        # flips the default once s2d measures faster on the target chip
+        stem = os.environ.get("BENCH_RESNET_STEM", "conv")
     if stem not in ("conv", "s2d"):
         raise ValueError(f"unknown BENCH_RESNET_STEM {stem!r} "
                          "(expected 'conv' or 's2d')")

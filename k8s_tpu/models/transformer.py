@@ -147,14 +147,29 @@ class Attention(nn.Module):
         k = rotary_embedding(k, positions, cfg.rope_theta)
 
         if cfg.use_ring_attention and mesh is not None:
-            from k8s_tpu.parallel.ring_attention import ring_attention
-
             kv_heads = k.shape[2]
             if kv_heads != cfg.heads:
                 rep = cfg.heads // kv_heads
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            out = ring_attention(mesh, q, k, v, causal=cfg.causal)
+            if cfg.use_flash_attention:
+                # ring + flash compose: ring for O(L/sp) memory across the
+                # mesh, the Pallas kernel for the per-shard block compute
+                from k8s_tpu.parallel.ring_flash import ring_flash_attention
+                from k8s_tpu.ops.flash_attention import (
+                    DEFAULT_BLOCK_K,
+                    DEFAULT_BLOCK_Q,
+                )
+
+                out = ring_flash_attention(
+                    mesh, q, k, v, causal=cfg.causal,
+                    block_q=cfg.flash_block_q or DEFAULT_BLOCK_Q,
+                    block_k=cfg.flash_block_k or DEFAULT_BLOCK_K,
+                )
+            else:
+                from k8s_tpu.parallel.ring_attention import ring_attention
+
+                out = ring_attention(mesh, q, k, v, causal=cfg.causal)
         elif cfg.use_flash_attention:
             from k8s_tpu.ops import flash_attention
             from k8s_tpu.ops.flash_attention import (

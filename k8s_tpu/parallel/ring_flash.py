@@ -1,0 +1,217 @@
+"""Ring FLASH attention: the Pallas flash kernel as the per-block compute
+inside sequence-parallel ring attention.
+
+``parallel.ring_attention`` keeps the full [chunk x chunk] score block in
+XLA-managed memory for every ring step; this module runs each (Q-chunk,
+K-chunk) pair through the fused flash kernel (k8s_tpu.ops.flash_attention)
+instead, so scores never leave VMEM tiles even within a chunk — the
+composition long-context training actually wants: O(L/sp) memory from the
+ring, flash-level HBM traffic within the shard.
+
+Math: the flash forward emits per-row log-sum-exp, and two partial
+attentions over disjoint key sets combine exactly as
+
+    lse = logaddexp(lse_a, lse_b)
+    out = out_a * exp(lse_a - lse) + out_b * exp(lse_b - lse)
+
+so each ring step merges one flash call into the running (out, lse).  The
+backward is a second ring pass: with the GLOBAL lse and delta = rowsum(do *
+out) — both per Q row — the flash backward kernels give the exact dq and
+the exact (dk, dv) contribution of each (Q-chunk, K-chunk) pair
+independently; dk/dv accumulators travel around the ring with their K/V
+chunks and arrive home after sp hops.
+
+Reference counterpart: none (the reference has no sequence parallelism);
+the algorithm is the standard ring-flash composition (Liu et al., Ring
+Attention; PAPERS.md) expressed with this repo's kernels and collectives.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from k8s_tpu.ops.flash_attention import (
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
+    NEG_INF,
+    _auto_interpret,
+    _flash_bwd,
+    _flash_fwd,
+)
+from k8s_tpu.parallel.collectives import ring_shift
+
+# step relations on the ring (lax.switch indices)
+_SKIP, _DIAG, _FULL = 0, 1, 2
+
+
+def _relation(my_idx, k_chunk_idx, causal: bool):
+    if not causal:
+        return jnp.full((), _FULL, jnp.int32)
+    return jnp.where(
+        k_chunk_idx > my_idx, _SKIP,
+        jnp.where(k_chunk_idx == my_idx, _DIAG, _FULL),
+    ).astype(jnp.int32)
+
+
+def _merge(o_acc, lse_acc, o_blk, lse_blk):
+    """Combine two partial attentions over disjoint key sets (f32)."""
+    lse_new = jnp.logaddexp(lse_acc, lse_blk)
+    safe = jnp.where(lse_new <= NEG_INF / 2, 0.0, lse_new)
+    w_acc = jnp.where(lse_acc <= NEG_INF / 2, 0.0, jnp.exp(lse_acc - safe))
+    w_blk = jnp.where(lse_blk <= NEG_INF / 2, 0.0, jnp.exp(lse_blk - safe))
+    return o_acc * w_acc[..., None] + o_blk * w_blk[..., None], lse_new
+
+
+@lru_cache(maxsize=None)
+def _make_ring_flash(axis_name: str, causal: bool, scale: float,
+                     block_q: int, block_k: int, interpret: bool):
+    """Build the custom-VJP ring-flash local function for one config."""
+
+    def fwd_pass(q, k, v):
+        """q,k,v: [B,H,Lc,D] local shards.  Returns (out, lse [B,H,Lc,1])."""
+        B, H, Lc, D = q.shape
+        sp = lax.axis_size(axis_name)
+        my_idx = lax.axis_index(axis_name)
+
+        o0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+        lse0 = jnp.full((B, H, Lc), NEG_INF, jnp.float32)
+
+        def flash(causal_flag, k_cur, v_cur):
+            o_s, lse_s = _flash_fwd(q, k_cur, v_cur, scale, causal_flag,
+                                    block_q, block_k, interpret)
+            return o_s.astype(jnp.float32), lse_s[..., 0]
+
+        def step(s, carry):
+            o, lse, k_cur, v_cur = carry
+            c = (my_idx - s) % sp
+            o_s, lse_s = lax.switch(
+                _relation(my_idx, c, causal),
+                [
+                    lambda kc, vc: (jnp.zeros((B, H, Lc, D), jnp.float32),
+                                    jnp.full((B, H, Lc), NEG_INF, jnp.float32)),
+                    lambda kc, vc: flash(True, kc, vc),
+                    lambda kc, vc: flash(False, kc, vc),
+                ],
+                k_cur, v_cur,
+            )
+            o, lse = _merge(o, lse, o_s, lse_s)
+            return o, lse, ring_shift(k_cur, axis_name), \
+                ring_shift(v_cur, axis_name)
+
+        o, lse, _, _ = lax.fori_loop(0, sp, step, (o0, lse0, k, v))
+        return o.astype(q.dtype), lse[..., None]
+
+    def ring_fwd(q, k, v):
+        out, lse = fwd_pass(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def ring_bwd(res, do):
+        q, k, v, out, lse = res
+        B, H, Lc, D = q.shape
+        sp = lax.axis_size(axis_name)
+        my_idx = lax.axis_index(axis_name)
+
+        dq0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+        dk0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+        dv0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+
+        def flash_bwd(causal_flag, k_cur, v_cur):
+            # global lse/delta make each (Q-chunk, K-chunk) contribution
+            # exact and independent; _flash_bwd derives delta from (out, do)
+            dq_s, dk_s, dv_s = _flash_bwd(
+                q, k_cur.astype(q.dtype), v_cur.astype(q.dtype), out, lse,
+                do, scale, causal_flag, block_q, block_k, interpret)
+            return (dq_s.astype(jnp.float32), dk_s.astype(jnp.float32),
+                    dv_s.astype(jnp.float32))
+
+        zeros = lambda kc, vc: (dq0, dk0, dv0)  # noqa: E731
+
+        def step(s, carry):
+            dq, k_cur, v_cur, dk_cur, dv_cur = carry
+            c = (my_idx - s) % sp
+            dq_s, dk_s, dv_s = lax.switch(
+                _relation(my_idx, c, causal),
+                [
+                    zeros,
+                    lambda kc, vc: flash_bwd(True, kc, vc),
+                    lambda kc, vc: flash_bwd(False, kc, vc),
+                ],
+                k_cur, v_cur,
+            )
+            dq = dq + dq_s
+            dk_cur = dk_cur + dk_s
+            dv_cur = dv_cur + dv_s
+            # K/V chunks travel WITH their gradient accumulators: after the
+            # full ring (sp hops) each chunk's grads are back on its owner
+            return (dq, ring_shift(k_cur, axis_name),
+                    ring_shift(v_cur, axis_name),
+                    ring_shift(dk_cur, axis_name),
+                    ring_shift(dv_cur, axis_name))
+
+        dq, _, _, dk, dv = lax.fori_loop(
+            0, sp, step, (dq0, k, v, dk0, dv0))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    ring = jax.custom_vjp(lambda q, k, v: fwd_pass(q, k, v)[0])
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
+def ring_flash_attention_local(q, k, v, *, axis_name: str = "sp",
+                               causal: bool = True,
+                               scale: float | None = None,
+                               block_q: int = DEFAULT_BLOCK_Q,
+                               block_k: int = DEFAULT_BLOCK_K,
+                               interpret: bool | None = None):
+    """Per-shard ring flash attention body; call under shard_map with
+    Q/K/V sequence-sharded over ``axis_name``.
+
+    q, k, v: [B, chunk, H, D] local shards (same convention as
+    ring_attention_local).  Hkv must equal H (repeat grouped-query KV heads
+    before sharding).  Returns [B, chunk, H, D] in q.dtype.
+    """
+    B, Lc, H, D = q.shape
+    if k.shape[2] != H:
+        raise ValueError(
+            f"ring flash needs H == Hkv (got {H} vs {k.shape[2]}); "
+            "repeat KV heads before the shard_map")
+    if scale is None:
+        scale = D ** -0.5
+    ring = _make_ring_flash(axis_name, bool(causal), float(scale),
+                            int(block_q), int(block_k),
+                            bool(_auto_interpret(interpret)))
+    # kernels use [B, H, L, D]
+    out = ring(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+               v.transpose(0, 2, 1, 3))
+    return out.transpose(0, 2, 1, 3)
+
+
+def ring_flash_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
+                         seq_axis: str = "sp", batch_axes=("dp", "fsdp"),
+                         head_axis: str = "tp",
+                         block_q: int = DEFAULT_BLOCK_Q,
+                         block_k: int = DEFAULT_BLOCK_K,
+                         interpret: bool | None = None):
+    """Global entry: shard_map ring flash attention over the mesh
+    (drop-in for parallel.ring_attention.ring_attention)."""
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = shard_map(
+        partial(ring_flash_attention_local, axis_name=seq_axis,
+                causal=causal, block_q=block_q, block_k=block_k,
+                interpret=interpret),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
