@@ -128,17 +128,24 @@ class TokenDataset:
                 f"total_tokens says {self.total_tokens}")
         self._sums = {s["file"]: s["sha256"] for s in self.manifest["shards"]}
         self._mmaps: dict[str, np.ndarray] = {}
+        self._verified: set[str] = set()
+
+    def _check_shard(self, name: str) -> str:
+        """Verify one shard's checksum (once, shared by both readers);
+        returns its path."""
+        path = os.path.join(self.data_dir, name)
+        if self._verify and name not in self._verified:
+            got = _sha256(path)
+            if got != self._sums[name]:
+                raise ValueError(
+                    f"checksum mismatch for {name}: manifest "
+                    f"{self._sums[name][:12]}…, file {got[:12]}…")
+            self._verified.add(name)
+        return path
 
     def _shard(self, name: str) -> np.ndarray:
         if name not in self._mmaps:
-            path = os.path.join(self.data_dir, name)
-            if self._verify:
-                got = _sha256(path)
-                if got != self._sums[name]:
-                    raise ValueError(
-                        f"checksum mismatch for {name}: manifest "
-                        f"{self._sums[name][:12]}…, file {got[:12]}…")
-            self._mmaps[name] = np.load(path, mmap_mode="r")
+            self._mmaps[name] = np.load(self._check_shard(name), mmap_mode="r")
         return self._mmaps[name]
 
     def num_sequences(self, seq_len: int) -> int:
@@ -147,6 +154,17 @@ class TokenDataset:
         return sum(s["n_tokens"] // seq_len
                    for s in self.manifest["shards"])
 
+    def _window_index(self, seq_len: int):
+        """(names, cum) for O(num_shards) global-window-index decoding."""
+        counts = [s["n_tokens"] // seq_len for s in self.manifest["shards"]]
+        names = [s["file"] for s in self.manifest["shards"]]
+        cum = np.cumsum([0] + counts)  # cum[i] = first global index of shard i
+        if int(cum[-1]) == 0:
+            raise ValueError(
+                f"seq_len {seq_len} longer than every shard "
+                f"(max {max(s['n_tokens'] for s in self.manifest['shards'])})")
+        return names, cum
+
     def sequences(
         self,
         seq_len: int,
@@ -154,6 +172,7 @@ class TokenDataset:
         shuffle: bool = True,
         seed: int = 0,
         epochs: Optional[int] = None,
+        reader: str = "auto",
     ) -> Iterator[np.ndarray]:
         """Yield [seq_len] int32 windows; shuffle permutes the global window
         order each epoch.
@@ -161,16 +180,25 @@ class TokenDataset:
         Window bookkeeping is O(num_shards), not O(num_windows): a global
         window index is decoded to (shard, offset) through a cumulative
         count table, so a multi-hundred-GB corpus costs a few ints per
-        shard, and mmap reads touch only the pages actually yielded.
+        shard, and reads touch only the windows actually yielded.
+
+        ``reader``: "mmap" reads through numpy memory maps (page faults
+        hold the GIL); "native" streams windows through the C++ loader
+        (k8s_tpu/native/dataloader.py — reads on C++ threads, GIL-free);
+        "auto" picks native when the toolchain built it, else mmap.  Both
+        yield identical streams.
         """
-        counts = [s["n_tokens"] // seq_len for s in self.manifest["shards"]]
-        names = [s["file"] for s in self.manifest["shards"]]
-        cum = np.cumsum([0] + counts)  # cum[i] = first global index of shard i
+        if reader not in ("auto", "mmap", "native"):
+            raise ValueError(f"unknown reader {reader!r}")
+        if reader == "auto":
+            from k8s_tpu.native import dataloader as native_dl
+
+            reader = "native" if native_dl.available() else "mmap"
+        if reader == "native":
+            yield from self._sequences_native(seq_len, shuffle, seed, epochs)
+            return
+        names, cum = self._window_index(seq_len)
         total = int(cum[-1])
-        if total == 0:
-            raise ValueError(
-                f"seq_len {seq_len} longer than every shard "
-                f"(max {max(s['n_tokens'] for s in self.manifest['shards'])})")
         rng = np.random.default_rng(seed)
         epoch = 0
         while epochs is None or epoch < epochs:
@@ -182,6 +210,44 @@ class TokenDataset:
                     self._shard(names[shard_i])[start:start + seq_len],
                     dtype=np.int32)
             epoch += 1
+
+    def _sequences_native(self, seq_len: int, shuffle: bool, seed: int,
+                          epochs: Optional[int]) -> Iterator[np.ndarray]:
+        """The C++-reader stream: same windows, same order as mmap.
+
+        Checksums stay LAZY (matching the class docstring's no-startup-
+        stall contract): a shard is hashed the first time one of its
+        windows is submitted, not at registration.
+        """
+        from k8s_tpu.native.dataloader import NativeWindowReader
+
+        names, cum = self._window_index(seq_len)
+        total = int(cum[-1])
+        dtype = np.dtype(self.manifest["dtype"])
+        window_bytes = seq_len * dtype.itemsize
+        paths = [os.path.join(self.data_dir, n) for n in names]
+        # npy payload starts after the header: size - n_tokens * itemsize
+        data_off = [
+            os.path.getsize(p) - s["n_tokens"] * dtype.itemsize
+            for p, s in zip(paths, self.manifest["shards"])
+        ]
+        rng = np.random.default_rng(seed)
+
+        with NativeWindowReader(paths, window_bytes) as r:
+            epoch = 0
+            while epochs is None or epoch < epochs:
+                order = rng.permutation(total) if shuffle else range(total)
+
+                def descriptors():
+                    for i in order:
+                        shard_i = int(np.searchsorted(cum, i, side="right")) - 1
+                        self._check_shard(names[shard_i])  # lazy, once each
+                        start = (int(i) - int(cum[shard_i])) * seq_len
+                        yield shard_i, data_off[shard_i] + start * dtype.itemsize
+
+                for raw in r.stream(descriptors()):
+                    yield np.frombuffer(raw, dtype=dtype).astype(np.int32)
+                epoch += 1
 
     def batches(
         self,

@@ -23,6 +23,7 @@ log = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "runtime.cc")
+_DL_SRC = os.path.join(_DIR, "src", "dataloader.cc")
 _BUILD_DIR = os.path.join(_DIR, "_build")
 _LIB = os.path.join(_BUILD_DIR, "libk8stpu_runtime.so")
 
@@ -33,7 +34,12 @@ _tried = False
 
 def build(force: bool = False) -> str | None:
     """Compile the library if stale; returns the .so path or None."""
-    if not force and os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+    sources = [p for p in (_SRC, _DL_SRC) if os.path.exists(p)]
+    if len(sources) < 2:
+        log.warning("native sources missing; native runtime unavailable")
+        return None  # graceful: callers fall back to pure Python
+    src_mtime = max(os.path.getmtime(p) for p in sources)
+    if not force and os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
         return _LIB
     gxx = shutil.which("g++")
     if gxx is None:
@@ -41,7 +47,8 @@ def build(force: bool = False) -> str | None:
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
     tmp = _LIB + ".tmp"
-    cmd = [gxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    cmd = [gxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC,
+           _DL_SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as e:
@@ -66,7 +73,8 @@ def build_stress_binary(tsan: bool = False) -> str | None:
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
     out = os.path.join(_BUILD_DIR, "stress_tsan" if tsan else "stress")
-    sources_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_STRESS_SRC))
+    sources_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_STRESS_SRC),
+                        os.path.getmtime(_DL_SRC))
     if os.path.exists(out) and os.path.getmtime(out) >= sources_mtime:
         return out
     cmd = [gxx, "-O1", "-g", "-std=c++17", "-pthread",
@@ -114,6 +122,22 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.exp_satisfied.restype = ctypes.c_int
     lib.exp_satisfied.argtypes = [ctypes.c_void_p, c]
     lib.exp_delete.argtypes = [ctypes.c_void_p, c]
+
+    lib.dl_new.restype = ctypes.c_void_p
+    lib.dl_new.argtypes = [ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+    lib.dl_free.argtypes = [ctypes.c_void_p]
+    lib.dl_register_file.restype = ctypes.c_int
+    lib.dl_register_file.argtypes = [ctypes.c_void_p, c]
+    lib.dl_submit.restype = ctypes.c_int
+    lib.dl_submit.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                              ctypes.c_uint64, ctypes.c_uint64]
+    lib.dl_next.restype = ctypes.c_int64
+    lib.dl_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.c_uint64, ctypes.c_int]
+    lib.dl_error.restype = ctypes.c_int
+    lib.dl_error.argtypes = [ctypes.c_void_p]
+    lib.dl_inflight.restype = ctypes.c_uint64
+    lib.dl_inflight.argtypes = [ctypes.c_void_p]
     return lib
 
 

@@ -100,6 +100,69 @@ class TestTokenShards:
         assert ds_lib.decode_bytes(toks) == text
 
 
+class TestNativeReader:
+    """The C++ window loader (native/dataloader.py + src/dataloader.cc)
+    must yield byte-identical streams to the mmap path."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from k8s_tpu.native import dataloader as native_dl
+
+        if not native_dl.available():
+            pytest.skip("native toolchain unavailable")
+
+    def test_stream_matches_mmap(self, tmp_path):
+        tokens = (np.arange(5000, dtype=np.int64) * 37) % 251
+        ds_lib.write_token_shards(str(tmp_path), tokens, shard_tokens=1024)
+        ds = ds_lib.TokenDataset(str(tmp_path))
+        mmap_seq = list(ds.sequences(64, shuffle=True, seed=3, epochs=2,
+                                     reader="mmap"))
+        native_seq = list(ds.sequences(64, shuffle=True, seed=3, epochs=2,
+                                       reader="native"))
+        assert len(mmap_seq) == len(native_seq) > 0
+        for a, b in zip(mmap_seq, native_seq):
+            np.testing.assert_array_equal(a, b)
+            assert b.dtype == np.int32
+
+    def test_int32_shards(self, tmp_path):
+        tokens = np.arange(300, dtype=np.int64) + 70000  # forces int32
+        ds_lib.write_token_shards(str(tmp_path), tokens)
+        ds = ds_lib.TokenDataset(str(tmp_path))
+        a = list(ds.sequences(50, shuffle=False, epochs=1, reader="mmap"))
+        b = list(ds.sequences(50, shuffle=False, epochs=1, reader="native"))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_native_verifies_checksums(self, tmp_path):
+        tokens = np.arange(500, dtype=np.int64) % 97
+        man = ds_lib.write_token_shards(str(tmp_path), tokens)
+        victim = tmp_path / man["shards"][0]["file"]
+        data = bytearray(victim.read_bytes())
+        data[-1] ^= 0xFF
+        victim.write_bytes(bytes(data))
+        ds = ds_lib.TokenDataset(str(tmp_path))
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            next(ds.sequences(50, reader="native"))
+
+    def test_truncated_shard_poisons_loader(self, tmp_path):
+        tokens = np.arange(2000, dtype=np.int64) % 97
+        man = ds_lib.write_token_shards(str(tmp_path), tokens,
+                                        shard_tokens=1000)
+        ds = ds_lib.TokenDataset(str(tmp_path), verify=False)
+        # truncate a shard AFTER the dataset computed its offsets
+        victim = tmp_path / man["shards"][1]["file"]
+        victim.write_bytes(victim.read_bytes()[:100])
+        with pytest.raises((IOError, ValueError)):
+            list(ds.sequences(500, shuffle=False, epochs=1,
+                              reader="native"))
+
+    def test_unknown_reader_rejected(self, tmp_path):
+        ds_lib.write_token_shards(str(tmp_path), np.arange(100))
+        ds = ds_lib.TokenDataset(str(tmp_path))
+        with pytest.raises(ValueError, match="unknown reader"):
+            next(ds.sequences(10, reader="carrier-pigeon"))
+
+
 class TestCommittedTokenFixture:
     """The checked-in corpus: real English text (this repo's docs),
     byte-tokenized, checksums enforced on open."""
