@@ -156,6 +156,12 @@ class _RestWatch:
             self._resp.close()
         except Exception:
             pass
+        conn = getattr(self._resp, "_k8s_tpu_conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
 
     def __iter__(self) -> Iterator[tuple[str, dict]]:
         while True:
@@ -185,16 +191,67 @@ class _RestWatch:
 
 
 class RestClient:
-    """Backend-protocol implementation against a real apiserver."""
+    """Backend-protocol implementation against a real apiserver.
+
+    Unary requests ride thread-local keep-alive connections (http.client) —
+    one TCP handshake per thread, not per call, which is the difference
+    between 20 and 100+ reconciled jobs/s over the wire.  Watch streams
+    get dedicated connections (the server closes them at its watch
+    timeout; the reflector's resume path reopens).
+    """
 
     def __init__(self, config: Optional[ClusterConfig] = None):
         self.config = config or get_cluster_config()
         self._ctx = self.config.ssl_context()
+        import threading as _threading
+        import urllib.parse as _parse
+
+        parsed = _parse.urlsplit(self.config.host)
+        self._scheme = parsed.scheme or "http"
+        self._netloc = parsed.netloc
+        self._local = _threading.local()
+
+    def _new_conn(self, timeout):
+        import http.client
+        import socket as socket_mod
+
+        if self._scheme == "https":
+            conn = http.client.HTTPSConnection(
+                self._netloc, timeout=timeout, context=self._ctx)
+        else:
+            conn = http.client.HTTPConnection(self._netloc, timeout=timeout)
+        conn.connect()
+        # Nagle + delayed-ACK interact to ~40ms/request on keep-alive
+        # connections with small header+body writes; kill Nagle.
+        try:
+            conn.sock.setsockopt(socket_mod.IPPROTO_TCP,
+                                 socket_mod.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        return conn
+
+    def _pooled_conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._new_conn(timeout=30)
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
 
     # -- plumbing ------------------------------------------------------------
 
     def _url(self, resource: GVR, namespace: Optional[str], name: str = "", query=None) -> str:
-        parts = [self.config.host.rstrip("/"), resource.path_prefix.lstrip("/")]
+        """Request target (path + query; the pooled connections already
+        know the host)."""
+        parts = ["", resource.path_prefix.strip("/")]
         if resource.namespaced and namespace:
             parts += ["namespaces", namespace]
         parts.append(resource.plural)
@@ -205,31 +262,69 @@ class RestClient:
             url += "?" + urllib.parse.urlencode(query)
         return url
 
+    def _headers(self, body) -> dict:
+        headers = {"Accept": "application/json"}
+        if body is not None:
+            headers["Content-Type"] = "application/json"
+        if self.config.token:
+            headers["Authorization"] = f"Bearer {self.config.token}"
+        return headers
+
     def _request(self, method: str, url: str, body: Optional[dict] = None, stream: bool = False):
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if body is not None:
-            content_type = (
-                "application/merge-patch+json" if method == "PATCH" else "application/json"
-            )
-            req.add_header("Content-Type", content_type)
-        if self.config.token:
-            req.add_header("Authorization", f"Bearer {self.config.token}")
-        try:
-            resp = urllib.request.urlopen(req, context=self._ctx, timeout=None if stream else 30)
-        except urllib.error.HTTPError as e:
-            try:
-                status = json.loads(e.read().decode())
-            except Exception:
-                status = {}
-            raise errors.ApiError(
-                e.code, status.get("reason", e.reason), status.get("message", str(e))
-            ) from None
+        headers = self._headers(body)
+        if body is not None and method == "PATCH":
+            headers["Content-Type"] = "application/merge-patch+json"
+        path = url
+
         if stream:
+            # dedicated connection: the response body is an open stream the
+            # caller consumes until server close — never pooled
+            conn = self._new_conn(timeout=None)
+            conn.request(method, path, body=data, headers=headers)
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                raw = resp.read()
+                conn.close()
+                raise self._api_error(resp, raw)
+            resp._k8s_tpu_conn = conn  # keep the connection alive with it
             return resp
-        payload = resp.read().decode()
+
+        import http.client
+
+        # Only idempotent methods are retried on a transport error: a POST
+        # whose connection died after the server processed it would
+        # double-execute on resend (spurious 409s, lost-update PUTs).
+        attempts = (0, 1) if method in ("GET", "HEAD") else (0,)
+        for attempt in attempts:
+            conn = self._pooled_conn()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()  # fully drain so the connection can be reused
+                break
+            except (http.client.HTTPException, ConnectionError, OSError):
+                # stale keep-alive (server closed between requests) or
+                # transport hiccup
+                self._drop_conn()
+                if attempt == attempts[-1]:
+                    raise
+        if resp.status >= 400:
+            raise self._api_error(resp, raw)
+        payload = raw.decode()
         return json.loads(payload) if payload else {}
+
+    @staticmethod
+    def _api_error(resp, raw: bytes) -> errors.ApiError:
+        try:
+            status = json.loads(raw.decode())
+        except Exception:
+            status = {}
+        return errors.ApiError(
+            resp.status,
+            status.get("reason", resp.reason),
+            status.get("message", f"HTTP {resp.status} {resp.reason}"),
+        )
 
     # -- backend protocol ----------------------------------------------------
 

@@ -101,23 +101,44 @@ def parse_route(path: str) -> Optional[_Route]:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    # EOF-terminated bodies for watch streams; RestClient reads until close.
+    # HTTP/1.1 keep-alive for unary requests (Content-Length is always
+    # set); watch streams opt out via Connection: close + close_connection
+    # so their EOF-terminated bodies still end at server close.
     # self.server is the ThreadingHTTPServer, onto which ApiServer.__init__
     # pins cluster/token/watch_timeout/stopping/resource_version.
-    protocol_version = "HTTP/1.0"
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True  # pair with the client's TCP_NODELAY
 
     # -- plumbing ----------------------------------------------------------
 
     def log_message(self, fmt, *args):  # quiet: route through logging
         log.debug("apiserver: " + fmt, *args)
 
+    def parse_request(self) -> bool:
+        # one handler instance serves many keep-alive requests: the
+        # body-consumed flag is per REQUEST, so reset it here
+        self._body_consumed = False
+        return super().parse_request()
+
     def _send_json(self, code: int, obj: dict) -> None:
+        # Keep-alive hygiene: if the request body was never consumed (early
+        # 401/route errors), drain it first — leftover bytes would be parsed
+        # as the NEXT request line, desynchronizing the pooled connection.
+        self._drain_unread_body()
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _drain_unread_body(self) -> None:
+        if getattr(self, "_body_consumed", False):
+            return
+        self._body_consumed = True
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > 0:
+            self.rfile.read(length)
 
     def _send_status_error(self, err: errors.ApiError) -> None:
         self._send_json(
@@ -133,6 +154,7 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _read_body(self) -> dict:
+        self._body_consumed = True
         length = int(self.headers.get("Content-Length") or 0)
         if not length:
             return {}
@@ -278,6 +300,7 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except errors.ApiError as e:  # 410 Expired: client must relist
             return self._send_status_error(e)
+        self.close_connection = True  # stream body ends at server close
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Connection", "close")

@@ -42,7 +42,8 @@ def _all_replicas_running(job: dict) -> bool:
 def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
                         timeout_s: float = 60.0,
                         threadiness: int = 1,
-                        resync_period_s: float = 5.0) -> dict:
+                        resync_period_s: float = 5.0,
+                        backend_mode: str = "fake") -> dict:
     """Submit ``jobs`` gang jobs back to back; measure each
     submit→all-replicas-Running latency and the aggregate throughput."""
     if jobs < 1:
@@ -56,11 +57,15 @@ def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
     # 10x/s — at 200+ concurrent jobs the resync storm, not event handling,
     # dominated; the reference runs 30 s (server.go:86), so a bench-scale
     # 5 s keeps the periodic-reconcile backstop without measuring it.
+    # backend_mode="rest" runs the whole bench over the wire protocol
+    # (HTTP apiserver fixture): the deployed-operator data path, including
+    # serialization and watch streaming costs the fake backend skips.
     with LocalCluster(version="v1alpha2", namespace=ns,
                       enable_gang_scheduling=True,
                       kubelet_kwargs={"default_runtime_s": timeout_s},
                       threadiness=threadiness,
-                      resync_period_s=resync_period_s) as lc:
+                      resync_period_s=resync_period_s,
+                      backend_mode=backend_mode) as lc:
         # Watch-based readiness tracking: the poller's list() deep-copied
         # every job per 10 ms tick, which at 300+ concurrent jobs consumed
         # the core being measured.  A watch costs one event per status
@@ -68,6 +73,10 @@ def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
         # competing with it.
         from k8s_tpu.client.gvr import TFJOBS_V1ALPHA2
 
+        # NOTE (--backend rest): _RestWatch.next() blocks on the stream
+        # rather than honoring the poll timeout, so on a stalled run the
+        # deadline check can overshoot --timeout by up to the server
+        # watch timeout.
         w = lc.backend.watch(TFJOBS_V1ALPHA2, ns)
         try:
             t_all0 = time.perf_counter()
@@ -113,14 +122,18 @@ def main(argv=None) -> int:
                    help="controller worker threads (operator --threadiness)")
     p.add_argument("--resync", type=float, default=5.0,
                    help="informer resync period seconds (reference: 30)")
+    p.add_argument("--backend", choices=["fake", "rest"], default="fake",
+                   help="fake = in-process store; rest = full HTTP wire "
+                   "protocol through the apiserver fixture")
     args = p.parse_args(argv)
 
     result = bench_time_to_ready(args.jobs, args.replicas, args.timeout,
                                  threadiness=args.threadiness,
-                                 resync_period_s=args.resync)
+                                 resync_period_s=args.resync,
+                                 backend_mode=args.backend)
     print(json.dumps({"metric": "tfjob_time_to_ready_p50",
                       "value": result["time_to_ready_p50_s"],
-                      "unit": "s", **result}))
+                      "unit": "s", "backend": args.backend, **result}))
     return 0
 
 
