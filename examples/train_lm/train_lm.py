@@ -98,8 +98,9 @@ def build_config(args, on_tpu: bool):
         max_seq_len=max(cfg.max_seq_len, args.seq_len),
         remat=args.remat,
         use_ring_attention=args.sp > 1,
-        # Pallas kernel is TPU-only; ring attention owns the sp>1 case
-        use_flash_attention=on_tpu and args.sp == 1,
+        # Pallas kernel is TPU-only; with sp>1 it composes INSIDE the ring
+        # (parallel.ring_flash) — flash tiles per chunk, ring for O(L/sp)
+        use_flash_attention=on_tpu,
     )
 
 

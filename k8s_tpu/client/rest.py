@@ -209,7 +209,16 @@ class RestClient:
         parsed = _parse.urlsplit(self.config.host)
         self._scheme = parsed.scheme or "http"
         self._netloc = parsed.netloc
+        # proxy-fronted apiservers (kubeconfig cluster.server with a path,
+        # e.g. https://gw/k8s/clusters/c-abc) need the base path prefixed
+        # onto every request target
+        self._base_path = parsed.path.rstrip("/")
         self._local = _threading.local()
+        # drop pooled connections idle past this: LBs/servers close idle
+        # keep-alives, and a write on a dead socket must not fail the call
+        # (writes are not retried — resending a processed POST would
+        # double-execute)
+        self._idle_limit_s = 30.0
 
     def _new_conn(self, timeout):
         import http.client
@@ -231,10 +240,18 @@ class RestClient:
         return conn
 
     def _pooled_conn(self):
+        import time as time_mod
+
         conn = getattr(self._local, "conn", None)
+        last = getattr(self._local, "last_use", 0.0)
+        now = time_mod.monotonic()
+        if conn is not None and now - last > self._idle_limit_s:
+            self._drop_conn()
+            conn = None
         if conn is None:
             conn = self._new_conn(timeout=30)
             self._local.conn = conn
+        self._local.last_use = now
         return conn
 
     def _drop_conn(self) -> None:
@@ -250,8 +267,8 @@ class RestClient:
 
     def _url(self, resource: GVR, namespace: Optional[str], name: str = "", query=None) -> str:
         """Request target (path + query; the pooled connections already
-        know the host)."""
-        parts = ["", resource.path_prefix.strip("/")]
+        know the host).  Any base path from config.host is preserved."""
+        parts = [self._base_path, resource.path_prefix.strip("/")]
         if resource.namespaced and namespace:
             parts += ["namespaces", namespace]
         parts.append(resource.plural)

@@ -173,6 +173,7 @@ class TokenDataset:
         seed: int = 0,
         epochs: Optional[int] = None,
         reader: str = "auto",
+        start_window: int = 0,
     ) -> Iterator[np.ndarray]:
         """Yield [seq_len] int32 windows; shuffle permutes the global window
         order each epoch.
@@ -195,24 +196,41 @@ class TokenDataset:
 
             reader = "native" if native_dl.available() else "mmap"
         if reader == "native":
-            yield from self._sequences_native(seq_len, shuffle, seed, epochs)
+            yield from self._sequences_native(seq_len, shuffle, seed, epochs,
+                                              start_window)
             return
         names, cum = self._window_index(seq_len)
         total = int(cum[-1])
         rng = np.random.default_rng(seed)
-        epoch = 0
+        epoch, offset = self._fast_forward(rng, total, start_window, shuffle)
         while epochs is None or epoch < epochs:
             order = rng.permutation(total) if shuffle else range(total)
-            for i in order:
+            for i in order[offset:]:
                 shard_i = int(np.searchsorted(cum, i, side="right")) - 1
                 start = (int(i) - int(cum[shard_i])) * seq_len
                 yield np.asarray(
                     self._shard(names[shard_i])[start:start + seq_len],
                     dtype=np.int32)
+            offset = 0
             epoch += 1
 
+    @staticmethod
+    def _fast_forward(rng, total: int, start_window: int, shuffle: bool):
+        """Advance the stream position to ``start_window`` (flat index over
+        the multi-epoch stream) without reading anything: whole skipped
+        epochs burn one permutation draw each so shuffle determinism is
+        preserved."""
+        if start_window < 0:
+            raise ValueError(f"start_window must be >= 0, got {start_window}")
+        epoch, offset = divmod(start_window, total)
+        if shuffle:
+            for _ in range(epoch):
+                rng.permutation(total)
+        return epoch, offset
+
     def _sequences_native(self, seq_len: int, shuffle: bool, seed: int,
-                          epochs: Optional[int]) -> Iterator[np.ndarray]:
+                          epochs: Optional[int],
+                          start_window: int = 0) -> Iterator[np.ndarray]:
         """The C++-reader stream: same windows, same order as mmap.
 
         Checksums stay LAZY (matching the class docstring's no-startup-
@@ -234,12 +252,13 @@ class TokenDataset:
         rng = np.random.default_rng(seed)
 
         with NativeWindowReader(paths, window_bytes) as r:
-            epoch = 0
+            epoch, offset = self._fast_forward(rng, total, start_window,
+                                               shuffle)
             while epochs is None or epoch < epochs:
                 order = rng.permutation(total) if shuffle else range(total)
 
-                def descriptors():
-                    for i in order:
+                def descriptors(offset=offset):
+                    for i in order[offset:]:
                         shard_i = int(np.searchsorted(cum, i, side="right")) - 1
                         self._check_shard(names[shard_i])  # lazy, once each
                         start = (int(i) - int(cum[shard_i])) * seq_len
@@ -247,6 +266,7 @@ class TokenDataset:
 
                 for raw in r.stream(descriptors()):
                     yield np.frombuffer(raw, dtype=dtype).astype(np.int32)
+                offset = 0
                 epoch += 1
 
     def batches(
@@ -257,26 +277,65 @@ class TokenDataset:
         shuffle: bool = True,
         seed: int = 0,
         epochs: Optional[int] = None,
-    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        """Yield (tokens, tokens) [B, L] pairs — the (inputs, targets) shape
+    ) -> "BatchStream":
+        """(tokens, tokens) [B, L] pairs — the (inputs, targets) shape
         train.fit consumes for next-token prediction (lm_loss shifts
-        internally).  Incomplete trailing batches are dropped."""
+        internally).  Incomplete trailing batches are dropped.
+
+        Returns a BatchStream: an iterator that additionally supports
+        ``skip(n)`` BEFORE consumption — an index jump over the first n
+        batches with no disk reads, which is how train.fit fast-forwards
+        the stream on checkpoint resume.
+        """
         if self.num_sequences(seq_len) < batch_size:
             raise ValueError(
                 f"dataset has {self.num_sequences(seq_len)} windows of "
                 f"{seq_len}, need >= batch_size {batch_size}")
-        it = self.sequences(seq_len, shuffle=shuffle, seed=seed,
-                            epochs=epochs)
-        while True:
-            rows = []
-            for seq in it:
-                rows.append(seq)
-                if len(rows) == batch_size:
-                    break
-            if len(rows) < batch_size:
-                return
-            batch = np.stack(rows)
-            yield batch, batch
+        return BatchStream(self, batch_size, seq_len, shuffle=shuffle,
+                           seed=seed, epochs=epochs)
+
+
+class BatchStream:
+    """Iterator over token batches with a pre-consumption ``skip(n)``.
+
+    The skip advances the deterministic window order WITHOUT touching the
+    shards (the permutation is recomputed per epoch from the seed), so
+    resuming at step 100k costs index arithmetic, not 100k batch reads.
+    """
+
+    def __init__(self, ds: "TokenDataset", batch_size: int, seq_len: int,
+                 *, shuffle: bool, seed: int, epochs: Optional[int]):
+        self._ds = ds
+        self._batch_size = batch_size
+        self._seq_len = seq_len
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epochs = epochs
+        self._skip_windows = 0
+        self._iter = None
+
+    def skip(self, n_batches: int) -> None:
+        if self._iter is not None:
+            raise RuntimeError("skip() must be called before consumption")
+        self._skip_windows += int(n_batches) * self._batch_size
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._iter is None:
+            self._iter = self._ds.sequences(
+                self._seq_len, shuffle=self._shuffle, seed=self._seed,
+                epochs=self._epochs, start_window=self._skip_windows)
+        rows = []
+        for seq in self._iter:
+            rows.append(seq)
+            if len(rows) == self._batch_size:
+                break
+        if len(rows) < self._batch_size:
+            raise StopIteration
+        batch = np.stack(rows)
+        return batch, batch
 
 
 def write_text_corpus(out_dir: str, texts: Sequence[str | bytes], *,

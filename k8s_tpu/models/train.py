@@ -176,6 +176,7 @@ def fit(
     log_every: int = 0,
     step_fn: Optional[Callable] = None,
     state_shardings: Any = None,
+    skip_data_on_resume: bool = True,
 ) -> FitResult:
     """The canonical training loop: shard state over the mesh, jit the step,
     checkpoint/resume via k8s_tpu.models.checkpoint.
@@ -217,6 +218,18 @@ def fit(
         ckpt = Checkpointer(
             checkpoint_dir, save_interval_steps=checkpoint_every)
         state, start_step = ckpt.restore_or_init(state)
+        if start_step > 0 and skip_data_on_resume:
+            # Fast-forward the (deterministic, seeded) data stream so resume
+            # continues where training stopped instead of re-seeing the
+            # epoch head.  Iterators exposing skip(n) (TokenDataset.batches)
+            # jump by index; anything else is drained batch by batch.
+            skip = getattr(data_iter, "skip", None)
+            if callable(skip):
+                skip(start_step)
+            else:
+                for _ in range(start_step):
+                    next(data_iter)
+            log.info("resume: fast-forwarded %d data batches", start_step)
 
     # Cooperative preemption: SIGTERM sets a flag; the loop saves at the
     # next step boundary and returns early with FitResult.preempted=True.
