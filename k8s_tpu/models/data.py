@@ -100,13 +100,36 @@ class PrefetchIterator:
             raise ValueError("buffer_size must be >= 1")
         self._sharding = sharding
         self._transform = transform
+        self._source = it
         self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
         self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._produce, args=(iter(it),), daemon=True,
-            name="prefetch-producer",
-        )
-        self._thread.start()
+        # lazy start: the producer begins on first consumption, so a
+        # pre-consumption skip() (checkpoint-resume fast-forward) can still
+        # reach the source's index-jump path
+        self._thread: Optional[threading.Thread] = None
+
+    def skip(self, n: int) -> None:
+        """Forward a pre-consumption skip to the source (the
+        checkpoint-resume contract of BatchStream.skip); sources without
+        an index jump are drained lazily by the producer."""
+        if self._thread is not None:
+            raise RuntimeError("skip() must be called before consumption")
+        source_skip = getattr(self._source, "skip", None)
+        if callable(source_skip):
+            source_skip(n)
+        else:
+            it = iter(self._source)
+            for _ in range(n):
+                next(it)
+            self._source = it
+
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._produce, args=(iter(self._source),), daemon=True,
+                name="prefetch-producer",
+            )
+            self._thread.start()
 
     def _stage(self, batch):
         if self._transform is not None:
@@ -141,6 +164,7 @@ class PrefetchIterator:
         return self
 
     def __next__(self):
+        self._ensure_started()
         while True:
             if self._stop.is_set():
                 raise StopIteration
@@ -168,7 +192,8 @@ class PrefetchIterator:
                 self._q.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
 
     def __del__(self):  # pragma: no cover - GC safety net
         try:

@@ -221,14 +221,25 @@ def fit(
         if start_step > 0 and skip_data_on_resume:
             # Fast-forward the (deterministic, seeded) data stream so resume
             # continues where training stopped instead of re-seeing the
-            # epoch head.  Iterators exposing skip(n) (TokenDataset.batches)
-            # jump by index; anything else is drained batch by batch.
+            # epoch head.  Iterators exposing skip(n) (TokenDataset.batches,
+            # PrefetchIterator) jump by index; anything else is drained
+            # batch by batch.  NOTE: ``data_iter`` must be freshly
+            # positioned at stream start — re-passing a partially-consumed
+            # iterator (e.g. looping fit() on preemption in-process) would
+            # double-skip; build a new stream per fit() call.
             skip = getattr(data_iter, "skip", None)
-            if callable(skip):
-                skip(start_step)
-            else:
-                for _ in range(start_step):
-                    next(data_iter)
+            try:
+                if callable(skip):
+                    skip(start_step)
+                else:
+                    for _ in range(start_step):
+                        next(data_iter)
+            except StopIteration:
+                raise ValueError(
+                    f"data stream exhausted before the resume point "
+                    f"(start_step={start_step}); the stream must cover at "
+                    f"least as many batches as the checkpointed run "
+                    f"consumed") from None
             log.info("resume: fast-forwarded %d data batches", start_step)
 
     # Cooperative preemption: SIGTERM sets a flag; the loop saves at the

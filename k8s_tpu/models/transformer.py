@@ -38,6 +38,11 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    # sequence-parallel strategy when use_ring_attention is set: "ring"
+    # rotates K/V (memory-optimal, works for any head count); "ulysses"
+    # all-to-alls seq<->head shards (two collectives per layer, needs
+    # heads % sp == 0) — parallel/ulysses.py
+    sp_strategy: str = "ring"
     use_flash_attention: bool = False  # Pallas fused kernel (k8s_tpu.ops)
     # flash kernel tile sizes (None -> kernel defaults); sweepable per
     # device generation without touching the kernel
@@ -147,12 +152,24 @@ class Attention(nn.Module):
         k = rotary_embedding(k, positions, cfg.rope_theta)
 
         if cfg.use_ring_attention and mesh is not None:
+            if cfg.sp_strategy not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"unknown sp_strategy {cfg.sp_strategy!r} "
+                    "(expected 'ring' or 'ulysses')")
             kv_heads = k.shape[2]
             if kv_heads != cfg.heads:
                 rep = cfg.heads // kv_heads
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            if cfg.use_flash_attention:
+            if cfg.sp_strategy == "ulysses":
+                from k8s_tpu.parallel.ulysses import ulysses_attention
+
+                out = ulysses_attention(
+                    mesh, q, k, v, causal=cfg.causal,
+                    use_flash=cfg.use_flash_attention,
+                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                )
+            elif cfg.use_flash_attention:
                 # ring + flash compose: ring for O(L/sp) memory across the
                 # mesh, the Pallas kernel for the per-shard block compute
                 from k8s_tpu.parallel.ring_flash import ring_flash_attention

@@ -1,0 +1,117 @@
+"""Ulysses (all-to-all) sequence parallelism — the second long-context
+strategy next to ring attention (SURVEY.md §2.4 maps both; the reference
+has neither).
+
+Where the ring keeps K/V moving and attention local, Ulysses re-shards:
+inputs arrive sequence-sharded [B, L/sp, H, D]; one all-to-all over the
+``sp`` axis exchanges the sequence shards for head shards, giving every
+device the FULL sequence for H/sp heads; attention runs completely locally
+(the Pallas flash kernel unchanged — heads are independent); a second
+all-to-all restores sequence sharding.  Communication is two all-to-alls
+of the activations per layer, independent of sequence length — cheaper
+than the ring's sp K/V rotations when sp is moderate and heads divide
+evenly; the ring wins when H < sp or memory for the full-L slice is the
+binding constraint.
+
+Gradients need no custom VJP: all_to_all and the flash kernel are both
+differentiable, so autodiff composes them exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
+                            causal: bool = True,
+                            scale: float | None = None,
+                            use_flash: bool = False,
+                            block_q: int | None = None,
+                            block_k: int | None = None,
+                            interpret: bool | None = None):
+    """Per-shard Ulysses body; call under shard_map with Q/K/V
+    sequence-sharded over ``axis_name``.
+
+    q, k, v: [B, chunk, H, D] local shards; H must be divisible by the
+    axis size (each device owns H/sp heads during attention).  Returns
+    [B, chunk, H, D] in q.dtype.
+    """
+    B, Lc, H, D = q.shape
+    sp = lax.axis_size(axis_name)
+    if H % sp:
+        raise ValueError(
+            f"Ulysses needs heads ({H}) divisible by the {axis_name} axis "
+            f"({sp}); use ring attention for H < sp")
+    if k.shape[2] != H:
+        raise ValueError(
+            f"Ulysses needs H == Hkv (got {H} vs {k.shape[2]}); repeat "
+            "grouped-query KV heads before the shard_map")
+
+    def seq_to_heads(x):
+        # [B, Lc, H, D] -> [B, sp*Lc, H/sp, D]: give away sp-1 head groups,
+        # receive the other devices' sequence chunks for ours.  Tiled
+        # all-to-all: the head axis splits sp ways, received chunks
+        # concatenate peer-major onto the sequence axis — peer-major IS
+        # global sequence order because device d owns chunk d.
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+
+    if use_flash:
+        from k8s_tpu.ops import flash_attention
+        from k8s_tpu.ops.flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+        )
+
+        out = flash_attention(
+            qh, kh, vh, causal=causal, scale=scale,
+            block_q=block_q or DEFAULT_BLOCK_Q,
+            block_k=block_k or DEFAULT_BLOCK_K,
+            interpret=interpret,
+        )
+    else:
+        from k8s_tpu.parallel.ring_attention import reference_attention
+
+        out = reference_attention(qh, kh, vh, causal=causal)
+        out = out.astype(q.dtype)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
+                      seq_axis: str = "sp", batch_axes=("dp", "fsdp"),
+                      use_flash: bool = False,
+                      block_q: int | None = None,
+                      block_k: int | None = None,
+                      interpret: bool | None = None):
+    """Global entry: shard_map Ulysses attention over the mesh (drop-in for
+    ring_attention where heads divide the sp axis).
+
+    Note: unlike the ring entry, heads are NOT additionally sharded over
+    tp here — Ulysses already spends the head dimension on the sp axis.
+    """
+    spec = P(batch_axes, seq_axis, None, None)
+    fn = shard_map(
+        partial(ulysses_attention_local, axis_name=seq_axis, causal=causal,
+                use_flash=use_flash, block_q=block_q, block_k=block_k,
+                interpret=interpret),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
