@@ -46,6 +46,11 @@ def parse_args(argv=None):
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel size (>1 enables ring attention)")
+    p.add_argument("--sp_strategy", choices=["ring", "ulysses"],
+                   default="ring",
+                   help="sequence-parallel strategy: ring rotates K/V "
+                   "(any head count); ulysses all-to-alls seq<->head "
+                   "shards (needs heads %% sp == 0)")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline-parallel stages (>1 runs the 1F1B "
                    "schedule; layers must divide evenly)")
@@ -98,6 +103,7 @@ def build_config(args, on_tpu: bool):
         max_seq_len=max(cfg.max_seq_len, args.seq_len),
         remat=args.remat,
         use_ring_attention=args.sp > 1,
+        sp_strategy=args.sp_strategy,
         # Pallas kernel is TPU-only; with sp>1 it composes INSIDE the ring
         # (parallel.ring_flash) — flash tiles per chunk, ring for O(L/sp)
         use_flash_attention=on_tpu,
