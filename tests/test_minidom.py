@@ -1,0 +1,146 @@
+"""minidom component tests: the headless DOM exercised directly (the SPA
+runtime tier covers the integrated paths; these pin the DOM contracts the
+interpreter relies on)."""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_tpu.harness.minidom import Browser
+
+
+def load(html, js="", handler=None):
+    b = Browser(handler)
+    b.load(html, js)
+    return b
+
+
+class TestTree:
+    def test_inner_html_parse_and_serialize_roundtrip(self):
+        b = load('<div id="root"></div>')
+        root = b.by_id("root")
+        root.set_inner_html(
+            '<p class="x">hi <b>there</b></p><input value="v">')
+        assert [c.tag for c in root.children] == ["p", "input"]
+        assert root.children[0].text_content == "hi there"
+        out = root.inner_html
+        assert '<p class="x">' in out and "<b>there</b>" in out
+        assert '<input value="v">' in out  # void element, no closing tag
+
+    def test_entities_unescape_on_parse_and_escape_on_serialize(self):
+        b = load('<div id="root"></div>')
+        root = b.by_id("root")
+        root.set_inner_html("<span>&lt;tag&gt; &amp; text</span>")
+        assert root.children[0].text_content == "<tag> & text"
+        assert "&lt;tag&gt;" in root.inner_html
+
+    def test_get_element_by_id_nested(self):
+        b = load('<div><section><p id="deep">x</p></section></div>')
+        assert b.by_id("deep").text_content == "x"
+        assert b.by_id("missing") is None
+
+    def test_query_selectors(self):
+        b = load('<div id="a" class="box"><p class="box">1</p>'
+                 '<input type="number"></div>')
+        doc = b.document
+        assert doc.js_get("querySelector").fn("#a").attrs["id"] == "a"
+        assert len(doc.js_get("querySelectorAll").fn(".box")) == 2
+        assert doc.js_get("querySelector").fn("input[type=number]") is not None
+        assert doc.js_get("querySelector").fn("video") is None
+
+    def test_create_element_and_append(self):
+        b = load('<div id="root"></div>')
+        el = b.document.js_get("createElement").fn("span")
+        el.js_set("textContent", "made")
+        b.by_id("root").js_get("appendChild").fn(el)
+        assert "<span>made</span>" in b.by_id("root").inner_html
+
+
+class TestFormSemantics:
+    def test_select_value_rules(self):
+        b = load('<select id="s"><option value="">all</option>'
+                 '<option selected>ns1</option><option>ns2</option></select>')
+        sel = b.by_id("s")
+        assert sel.value == "ns1"       # [selected] wins
+        sel.set_inner_html('<option value="x">X</option><option>Y</option>')
+        assert sel.value == "x"          # first option's value attr
+        sel.value = "Y"                  # JS assignment overrides
+        assert sel.value == "Y"
+
+    def test_textarea_value_is_text_content(self):
+        b = load('<textarea id="t">seed</textarea>')
+        t = b.by_id("t")
+        assert t.value == "seed"
+        t.value = "edited"
+        assert t.value == "edited"
+
+
+class TestEvents:
+    def test_bubbling_and_stop_propagation(self):
+        calls = []
+        b = load('<div id="outer" onclick="hits.push(\'outer\')">'
+                 '<button id="inner" onclick="hits.push(\'inner\')">x'
+                 '</button></div>')
+        from k8s_tpu.harness.minijs.interp import JSArray
+
+        hits = JSArray()
+        b.interp.define("hits", hits)
+        b.click(b.by_id("inner"))
+        assert list(hits) == ["inner", "outer"]  # bubbles inner -> outer
+        hits.clear()
+        b.by_id("inner").attrs["onclick"] = (
+            "event.stopPropagation(); hits.push('inner')")
+        b.click(b.by_id("inner"))
+        assert list(hits) == ["inner"]
+
+    def test_add_event_listener_and_this_binding(self):
+        b = load('<button id="btn" data-k="v">x</button>',
+                 js="""
+                 let got = null;
+                 document.getElementById('btn').addEventListener('click',
+                   function (e) { got = e.target.id; });
+                 """)
+        b.click(b.by_id("btn"))
+        assert b.interp.globals.lookup("got") == "btn"
+
+    def test_change_event_via_set_value(self):
+        b = load('<input id="i" onchange="seen = this.value">',
+                 js="let seen = '';")
+        b.set_value(b.by_id("i"), "typed")
+        assert b.interp.globals.lookup("seen") == "typed"
+
+
+class TestFetchAndTimers:
+    def test_fetch_routes_and_records(self):
+        def handler(method, url, body):
+            return 200, {"echo": [method, url, body]}
+
+        b = load("<div></div>", js="""
+            let got = null;
+            fetch('/x/y', {method: 'POST', body: JSON.stringify({a: 1})})
+              .then((r) => r.json()).then((j) => { got = j.echo; });
+        """, handler=handler)
+        got = b.interp.globals.lookup("got")
+        assert list(got) == ["POST", "/x/y", {"a": 1}]
+        assert b.requests == [("POST", "/x/y", {"a": 1})]
+
+    def test_fetch_error_status_flows_to_script(self):
+        b = load("<div></div>", js="""
+            let status = 0, ok = null;
+            fetch('/gone').then((r) => { status = r.status; ok = r.ok; });
+        """, handler=lambda m, u, b_: (404, {}))
+        assert b.interp.globals.lookup("status") == 404.0
+        assert b.interp.globals.lookup("ok") is False
+
+    def test_timers_fire_manually_and_clear(self):
+        b = load("<div></div>", js="""
+            let n = 0;
+            const id = setInterval(() => { n = n + 1; }, 1000);
+            setTimeout(() => { n = n + 10; }, 50);
+        """)
+        assert b.fire_timers("interval") == 1
+        assert b.fire_timers("timeout") == 1
+        b.fire_timers("timeout")  # one-shot: gone after firing
+        assert b.interp.globals.lookup("n") == 11.0
+        b.interp.run("clearInterval(id)")
+        assert b.fire_timers("interval") == 0
