@@ -236,6 +236,69 @@ class TestAsync:
             """, "got") == "ab"
 
 
+class TestLexerEdges:
+    def test_regex_vs_division(self):
+        # after an identifier/number, / is division; after (, =, return
+        # etc. it starts a regex
+        assert run("(() => { const a = 10; const b = 2; return a / b / 1; })()") == 5.0
+        assert run("'aXbXc'.split(/X/).length") == 3.0
+        assert run("[4, 2].map((x) => x / 2).join(',')") == "2,1"
+
+    def test_string_escapes(self):
+        assert run(r"'a\nb'.split('\n').length") == 2.0
+        assert run(r'"quote:\" tick:\' back:\\"') == 'quote:" tick:\' back:\\'
+        assert run(r"'tab\there'") == "tab\there"
+
+    def test_template_escapes_and_literal_braces(self):
+        assert run(r"`dollar: \${notexpr}`") == "dollar: ${notexpr}"
+        assert run("`obj: ${JSON.stringify({a: 1})}`") == 'obj: {"a":1}'
+
+    def test_comments(self):
+        assert run("""
+            // line comment with ${weird} /stuff/
+            /* block
+               comment */
+            1 + 1  // trailing
+        """) == 2.0
+
+    def test_hex_and_float_literals(self):
+        assert run("0xff + 1") == 256.0
+        assert run("0.5 + .25 + 1e2") == 100.75
+
+    def test_keywords_as_member_names(self):
+        assert run("({new: 1, for: 2}).new + ({in: 3}).in") == 4.0
+
+
+class TestInterpreterEdges:
+    def test_ternary_nesting_matches_js(self):
+        assert run("1 ? 2 ? 'a' : 'b' : 'c'") == "a"
+        assert run("0 ? 'a' : 0 ? 'b' : 'c'") == "c"
+
+    def test_assignment_operators(self):
+        assert run("(() => { let x = 5; x += 2; x -= 1; x *= 3; return x; })()") == 18.0
+
+    def test_update_expressions(self):
+        assert run("(() => { let i = 0; const a = i++; const b = ++i; return `${a},${b},${i}`; })()") == "0,2,2"
+
+    def test_array_holes_and_length_set(self):
+        assert run("(() => { const a = [1,2,3]; a.length = 1; return a.join(','); })()") == "1"
+        assert run("(() => { const a = []; a[3] = 'x'; return a.length; })()") == 4.0
+
+    def test_delete_and_in(self):
+        assert run("(() => { const o = {a: 1}; delete o.a; return 'a' in o; })()") == False  # noqa: E712
+
+    def test_nan_semantics(self):
+        assert run("NaN === NaN") == False  # noqa: E712
+        assert run("isNaN(Number('nope'))") == True  # noqa: E712
+        assert run("'' + (0 / 0)") == "NaN"
+
+    def test_string_number_coercion_corners(self):
+        assert run("'5' - 2") == 3.0      # minus coerces
+        assert run("'5' + 2") == "52"     # plus concatenates
+        assert run("+'  7 '") == 7.0
+        assert run("Number('0x10')") == 16.0
+
+
 class TestParserErrors:
     def test_syntax_error_reported_with_line(self):
         with pytest.raises(SyntaxError):
