@@ -370,8 +370,15 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
 
     import optax
 
+    use_fused_ce = bool(os.environ.get("BENCH_FUSED_CE"))
+    fused_apply = (train_lib.make_fused_lm_apply_fn(model)
+                   if use_fused_ce else None)
+
     def step(params, opt_state, tokens):
         def loss_fn(p):
+            if fused_apply is not None:
+                # chunked head+CE: [B, L, V] logits never materialize
+                return fused_apply(p, tokens)
             return train_lib.lm_loss(model.apply(p, tokens), tokens)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -414,11 +421,20 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
         "step_time_ms": elapsed / iters * 1000,
         "n_params": n_params,
         "flash_attention": cfg.use_flash_attention,
+        "fused_ce": use_fused_ce,
     }
 
 
 def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # honor the documented smoke path: this image's sitecustomize pins
+        # the axon TPU platform before env vars apply, so force CPU back
+        # via config (the tests/conftest.py pattern)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     # Global watchdog: if the relay hangs mid-bench (after a green
     # preflight), exit with a diagnostic instead of the driver's rc=124.
@@ -508,6 +524,7 @@ def main() -> int:
         out["transformer_step_time_ms"] = round(transformer["step_time_ms"], 2)
         out["transformer_n_params"] = transformer["n_params"]
         out["transformer_flash_attention"] = transformer["flash_attention"]
+        out["transformer_fused_ce"] = transformer["fused_ce"]
         if transformer_control:
             out["transformer_xla_attention_tokens_per_sec"] = round(
                 transformer_control["tokens_per_sec_per_chip"], 1

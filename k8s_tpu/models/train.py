@@ -291,6 +291,40 @@ def fit(
     )
 
 
+def make_fused_lm_apply_fn(model, *, vocab_chunk: int = 8192, mesh=None):
+    """apply_fn computing the LM loss WITHOUT materializing logits: the
+    model returns pre-head hidden states and ops.fused_ce folds the
+    tied-embedding matmul into a chunked online-softmax loss (the largest
+    activation in LM training — [T, vocab] — never exists).
+
+    Use with ``fused_loss_passthrough`` as the loss_fn:
+        step = make_sharded_train_step(
+            make_fused_lm_apply_fn(model), fused_loss_passthrough, ...)
+    """
+    from k8s_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+    if getattr(model, "config", None) is not None and             getattr(model.config, "num_experts", 0) > 0:
+        # sow() into a non-mutable collection is a silent no-op: the MoE
+        # load-balance loss would vanish and routers would collapse
+        raise ValueError(
+            "make_fused_lm_apply_fn does not collect the MoE aux loss; "
+            "use make_moe_apply_fn for expert models")
+
+    def apply_fn(params, tokens):
+        hidden = model.apply(params, tokens, mesh=mesh, return_hidden=True)
+        emb = params["params"]["embedding"]
+        # next-token shift, as lm_loss does on logits
+        return fused_linear_cross_entropy(
+            hidden[:, :-1], emb, tokens[:, 1:], vocab_chunk=vocab_chunk)
+
+    return apply_fn
+
+
+def fused_loss_passthrough(loss, targets):
+    """loss_fn for apply_fns that already computed the scalar loss."""
+    return loss
+
+
 def make_moe_apply_fn(model, *, aux_loss_weight: float = 0.01, mesh=None):
     """apply_fn for make_train_step/fit over an MoE transformer: runs the
     model with the "losses" collection mutable, sums every sown

@@ -258,7 +258,7 @@ class Transformer(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, mesh=None):
+    def __call__(self, tokens, mesh=None, return_hidden: bool = False):
         cfg = self.config
         B, L = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(L), (B, L))
@@ -275,6 +275,11 @@ class Transformer(nn.Module):
             x = block(cfg, mesh=mesh, name=f"layer_{i}")(x, positions)
 
         x = RMSNorm(fused=cfg.use_fused_norm, name="final_norm")(x)
+        if return_hidden:
+            # pre-head hidden states for the fused-CE path
+            # (ops.fused_ce.fused_linear_cross_entropy takes hidden + the
+            # embedding matrix and never materializes [B, L, V] logits)
+            return x.astype(cfg.dtype)
         # tied embeddings: logits = x @ emb.T.  bf16 operands on the MXU
         # with f32 accumulation (preferred_element_type) — an f32 matmul
         # here would run at a fraction of MXU peak while the vocab

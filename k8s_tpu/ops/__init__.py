@@ -8,11 +8,14 @@ Pallas kernels where XLA's automatic fusion isn't enough:
 - :mod:`k8s_tpu.ops.flash_attention` — blockwise fused attention
   (forward + backward, causal + bidirectional, GQA) that never materializes
   the O(L^2) score matrix in HBM;
-- :mod:`k8s_tpu.ops.fused_norm` — RMSNorm row kernel.
+- :mod:`k8s_tpu.ops.fused_norm` — RMSNorm row kernel;
+- :mod:`k8s_tpu.ops.fused_ce` — chunked-vocabulary fused linear +
+  cross-entropy (the LM head's [T, vocab] logits never materialize).
 
 All kernels run in Pallas interpret mode on CPU (used by the test suite and
 the driver's virtual-device dryrun) and compile to Mosaic on TPU.
 """
 
 from k8s_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from k8s_tpu.ops.fused_ce import fused_linear_cross_entropy  # noqa: F401
 from k8s_tpu.ops.fused_norm import rms_norm  # noqa: F401

@@ -1,0 +1,162 @@
+"""Fused linear + cross-entropy over vocabulary chunks.
+
+The LM loss's logits tensor is the largest activation in training: at
+B=8, L=1024, V=32000 the [T, V] f32 logits are ~1 GB and exist only to be
+immediately reduced to one scalar.  This op fuses the tied-embedding head
+matmul into an online-softmax loss computed chunk-by-chunk over the
+vocabulary, so peak memory is [T, vocab_chunk] — the flash-attention idea
+applied to the LM head (no reference counterpart; the reference has no
+LM path at all).
+
+Semantics match ``train.cross_entropy_loss`` exactly: matmul in the
+model dtype with f32 accumulation, loss math in f32, out-of-range targets
+(the ``label = -1`` padding idiom) contribute zero loss and zero gradient
+while still counting in the mean's denominator.
+
+Forward runs a ``lax.scan`` over vocabulary chunks carrying the online
+(max, sum) softmax statistics plus the target logit; backward (custom
+VJP) rescans, recomputing each chunk's logits against the saved
+log-sum-exp — FLOPs for memory, the same trade flash attention makes.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _flatten(hidden, targets):
+    if hidden.ndim == 3:
+        B, L, d = hidden.shape
+        return hidden.reshape(B * L, d), targets.reshape(B * L)
+    return hidden, targets
+
+
+@lru_cache(maxsize=None)
+def _make_fused_ce(vocab_chunk: int):
+    def pad_vocab(emb):
+        V = emb.shape[0]
+        n_chunks = -(-V // vocab_chunk)
+        pad = n_chunks * vocab_chunk - V
+        if pad:
+            emb = jnp.pad(emb, ((0, pad), (0, 0)))
+        return emb, n_chunks
+
+    def chunk_logits(h, emb_pad, c):
+        """[T, C] f32 logits of chunk c, padded columns masked to NEG_INF."""
+        emb_c = lax.dynamic_slice_in_dim(
+            emb_pad, c * vocab_chunk, vocab_chunk, axis=0)
+        logits = jnp.einsum(
+            "td,vd->tv", h, emb_c.astype(h.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits
+
+    def fwd_stats(h, emb_pad, n_chunks, targets, V):
+        T = h.shape[0]
+        col = jnp.arange(vocab_chunk)
+
+        def body(carry, c):
+            m, s, t = carry
+            logits = chunk_logits(h, emb_pad, c)
+            logits = jnp.where((c * vocab_chunk + col)[None, :] < V,
+                               logits, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+            s = s * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(logits - m_new[:, None]), axis=-1)
+            local = targets - c * vocab_chunk
+            in_chunk = (local >= 0) & (local < vocab_chunk)
+            picked = jnp.take_along_axis(
+                logits, jnp.clip(local, 0, vocab_chunk - 1)[:, None], axis=1
+            )[:, 0]
+            t = t + jnp.where(in_chunk, picked, 0.0)
+            return (m_new, s, t), None
+
+        init = (jnp.full((T,), NEG_INF, jnp.float32),
+                jnp.zeros((T,), jnp.float32),
+                jnp.zeros((T,), jnp.float32))
+        (m, s, t), _ = lax.scan(body, init, jnp.arange(n_chunks))
+        lse = m + jnp.log(jnp.maximum(s, 1e-30))
+        return lse, t
+
+    def primal(hidden, emb, targets):
+        h, tg = _flatten(hidden, targets)
+        V = emb.shape[0]
+        emb_pad, n_chunks = pad_vocab(emb)
+        lse, t = fwd_stats(h, emb_pad, n_chunks, tg, V)
+        valid = (tg >= 0) & (tg < V)
+        return jnp.sum(jnp.where(valid, lse - t, 0.0)) / h.shape[0]
+
+    def fwd(hidden, emb, targets):
+        h, tg = _flatten(hidden, targets)
+        V = emb.shape[0]
+        emb_pad, n_chunks = pad_vocab(emb)
+        lse, t = fwd_stats(h, emb_pad, n_chunks, tg, V)
+        valid = (tg >= 0) & (tg < V)
+        loss = jnp.sum(jnp.where(valid, lse - t, 0.0)) / h.shape[0]
+        return loss, (hidden, emb, targets, lse)
+
+    def bwd(res, g):
+        hidden, emb, targets, lse = res
+        h, tg = _flatten(hidden, targets)
+        T, d = h.shape
+        V = emb.shape[0]
+        emb_pad, n_chunks = pad_vocab(emb)
+        valid = (tg >= 0) & (tg < V)
+        # d loss / d logits[i, v] = valid_i * (softmax_iv - onehot_iv) / T
+        coeff = (g / T) * valid.astype(jnp.float32)
+        col = jnp.arange(vocab_chunk)
+
+        def body(carry, c):
+            dh, demb_pad = carry
+            logits = chunk_logits(h, emb_pad, c)
+            logits = jnp.where((c * vocab_chunk + col)[None, :] < V,
+                               logits, NEG_INF)
+            p = jnp.exp(logits - lse[:, None])  # masked cols -> 0
+            local = tg - c * vocab_chunk
+            in_chunk = (local >= 0) & (local < vocab_chunk)
+            onehot = (col[None, :] == jnp.clip(
+                local, 0, vocab_chunk - 1)[:, None]) & in_chunk[:, None]
+            dl = (p - onehot.astype(jnp.float32)) * coeff[:, None]  # [T, C]
+            emb_c = lax.dynamic_slice_in_dim(
+                emb_pad, c * vocab_chunk, vocab_chunk, axis=0)
+            dh = dh + jnp.einsum(
+                "tv,vd->td", dl, emb_c.astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+            demb_c = jnp.einsum(
+                "tv,td->vd", dl, h.astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+            demb_pad = lax.dynamic_update_slice_in_dim(
+                demb_pad, demb_c, c * vocab_chunk, axis=0)
+            return (dh, demb_pad), None
+
+        init = (jnp.zeros((T, d), jnp.float32),
+                jnp.zeros_like(emb_pad, dtype=jnp.float32))
+        (dh, demb_pad), _ = lax.scan(body, init, jnp.arange(n_chunks))
+        dh = dh.astype(hidden.dtype).reshape(hidden.shape)
+        demb = demb_pad[:V].astype(emb.dtype)
+        return dh, demb, None
+
+    fused = jax.custom_vjp(primal)
+    fused.defvjp(fwd, bwd)
+    return fused
+
+
+def fused_linear_cross_entropy(hidden, emb, targets, *,
+                               vocab_chunk: int = 8192):
+    """Mean next-token-style CE of ``hidden @ emb.T`` against ``targets``
+    without materializing the [T, V] logits.
+
+    hidden: [B, L, d] or [T, d] in the model dtype (the matmul runs in
+    this dtype with f32 accumulation, like the unfused head);
+    emb: [V, d] (any float dtype; cast per chunk);
+    targets: int [B, L] or [T]; out-of-range ids contribute zero.
+    """
+    if vocab_chunk < 1:
+        raise ValueError(f"vocab_chunk must be >= 1, got {vocab_chunk}")
+    return _make_fused_ce(int(vocab_chunk))(hidden, emb, targets)
