@@ -291,7 +291,8 @@ def fit(
     )
 
 
-def make_fused_lm_apply_fn(model, *, vocab_chunk: int = 8192, mesh=None):
+def make_fused_lm_apply_fn(model, *, vocab_chunk: int = 8192, mesh=None,
+                           z_loss: float = 0.0):
     """apply_fn computing the LM loss WITHOUT materializing logits: the
     model returns pre-head hidden states and ops.fused_ce folds the
     tied-embedding matmul into a chunked online-softmax loss (the largest
@@ -315,7 +316,8 @@ def make_fused_lm_apply_fn(model, *, vocab_chunk: int = 8192, mesh=None):
         emb = params["params"]["embedding"]
         # next-token shift, as lm_loss does on logits
         return fused_linear_cross_entropy(
-            hidden[:, :-1], emb, tokens[:, 1:], vocab_chunk=vocab_chunk)
+            hidden[:, :-1], emb, tokens[:, 1:], vocab_chunk=vocab_chunk,
+            z_loss=z_loss)
 
     return apply_fn
 

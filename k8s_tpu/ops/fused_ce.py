@@ -38,7 +38,7 @@ def _flatten(hidden, targets):
 
 
 @lru_cache(maxsize=None)
-def _make_fused_ce(vocab_chunk: int):
+def _make_fused_ce(vocab_chunk: int, z_loss: float):
     def pad_vocab(emb):
         V = emb.shape[0]
         n_chunks = -(-V // vocab_chunk)
@@ -84,13 +84,21 @@ def _make_fused_ce(vocab_chunk: int):
         lse = m + jnp.log(jnp.maximum(s, 1e-30))
         return lse, t
 
+    def total_loss(lse, t, valid, T):
+        per_token = lse - t
+        if z_loss:
+            # PaLM-style stabilizer: z_loss * log(Z)^2 keeps logits from
+            # drifting; lse is already the online log-partition
+            per_token = per_token + z_loss * jnp.square(lse)
+        return jnp.sum(jnp.where(valid, per_token, 0.0)) / T
+
     def primal(hidden, emb, targets):
         h, tg = _flatten(hidden, targets)
         V = emb.shape[0]
         emb_pad, n_chunks = pad_vocab(emb)
         lse, t = fwd_stats(h, emb_pad, n_chunks, tg, V)
         valid = (tg >= 0) & (tg < V)
-        return jnp.sum(jnp.where(valid, lse - t, 0.0)) / h.shape[0]
+        return total_loss(lse, t, valid, h.shape[0])
 
     def fwd(hidden, emb, targets):
         h, tg = _flatten(hidden, targets)
@@ -98,7 +106,7 @@ def _make_fused_ce(vocab_chunk: int):
         emb_pad, n_chunks = pad_vocab(emb)
         lse, t = fwd_stats(h, emb_pad, n_chunks, tg, V)
         valid = (tg >= 0) & (tg < V)
-        loss = jnp.sum(jnp.where(valid, lse - t, 0.0)) / h.shape[0]
+        loss = total_loss(lse, t, valid, h.shape[0])
         return loss, (hidden, emb, targets, lse)
 
     def bwd(res, g):
@@ -108,8 +116,10 @@ def _make_fused_ce(vocab_chunk: int):
         V = emb.shape[0]
         emb_pad, n_chunks = pad_vocab(emb)
         valid = (tg >= 0) & (tg < V)
-        # d loss / d logits[i, v] = valid_i * (softmax_iv - onehot_iv) / T
+        # d loss / d logits[i, v] = valid_i * (softmax_iv - onehot_iv) / T;
+        # the z-loss term adds valid_i * 2*z*lse_i * softmax_iv / T
         coeff = (g / T) * valid.astype(jnp.float32)
+        p_coeff = coeff * (1.0 + 2.0 * z_loss * lse) if z_loss else coeff
         col = jnp.arange(vocab_chunk)
 
         def body(carry, c):
@@ -122,7 +132,7 @@ def _make_fused_ce(vocab_chunk: int):
             in_chunk = (local >= 0) & (local < vocab_chunk)
             onehot = (col[None, :] == jnp.clip(
                 local, 0, vocab_chunk - 1)[:, None]) & in_chunk[:, None]
-            dl = (p - onehot.astype(jnp.float32)) * coeff[:, None]  # [T, C]
+            dl = p * p_coeff[:, None] - onehot.astype(jnp.float32) * coeff[:, None]  # [T, C]
             emb_c = lax.dynamic_slice_in_dim(
                 emb_pad, c * vocab_chunk, vocab_chunk, axis=0)
             dh = dh + jnp.einsum(
@@ -148,7 +158,8 @@ def _make_fused_ce(vocab_chunk: int):
 
 
 def fused_linear_cross_entropy(hidden, emb, targets, *,
-                               vocab_chunk: int = 8192):
+                               vocab_chunk: int = 8192,
+                               z_loss: float = 0.0):
     """Mean next-token-style CE of ``hidden @ emb.T`` against ``targets``
     without materializing the [T, V] logits.
 
@@ -156,7 +167,8 @@ def fused_linear_cross_entropy(hidden, emb, targets, *,
     this dtype with f32 accumulation, like the unfused head);
     emb: [V, d] (any float dtype; cast per chunk);
     targets: int [B, L] or [T]; out-of-range ids contribute zero.
+    ``z_loss``: PaLM-style stabilizer weight on log(Z)^2 (0 disables).
     """
     if vocab_chunk < 1:
         raise ValueError(f"vocab_chunk must be >= 1, got {vocab_chunk}")
-    return _make_fused_ce(int(vocab_chunk))(hidden, emb, targets)
+    return _make_fused_ce(int(vocab_chunk), float(z_loss))(hidden, emb, targets)

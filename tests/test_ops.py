@@ -341,6 +341,33 @@ class TestFusedLinearCrossEntropy:
 
         jax.tree.map(assert_leaf, gu, gf)
 
+    def test_z_loss_matches_reference(self):
+        import jax
+
+        from k8s_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+        h, emb, tg = self._setup(V=67)
+        Z = 1e-2
+
+        def ref(h, emb, tg):
+            logits = jnp.einsum("td,vd->tv", h, emb,
+                                preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, tg[:, None], 1)[:, 0]
+            return jnp.mean(lse - picked + Z * lse ** 2)
+
+        def fused(h, emb, tg):
+            return fused_linear_cross_entropy(h, emb, tg, vocab_chunk=16,
+                                              z_loss=Z)
+
+        np.testing.assert_allclose(float(fused(h, emb, tg)),
+                                   float(ref(h, emb, tg)), rtol=1e-5)
+        gu = jax.grad(ref, argnums=(0, 1))(h, emb, tg)
+        gf = jax.grad(fused, argnums=(0, 1))(h, emb, tg)
+        for a, b in zip(gf, gu):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
     def test_trains_through_sharded_step(self):
         import jax
 
