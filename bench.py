@@ -24,13 +24,23 @@ Prints exactly one JSON line:
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
 
-BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE_FILE = os.path.join(_HERE, "BENCH_BASELINE.json")
+# Timestamped last-good-on-hardware record.  Round 3 lost a whole round of
+# perf evidence because the relay died before the (all-or-nothing) bench
+# could run: numbers measured hours earlier existed nowhere machine-readable.
+# Every sub-benchmark now lands here the moment it is measured on real TPU
+# hardware, and the final JSON line falls back to this record (with explicit
+# provenance + timestamps) when the relay is down at emission time.
+LASTGOOD_FILE = os.path.join(_HERE, "BENCH_LASTGOOD.json")
 
 # Peak bf16 dense FLOP/s per chip, by jax device_kind substring (public
 # cloud.google.com/tpu numbers). Used for the MFU denominator.
@@ -113,56 +123,183 @@ def run_with_timeout(fn, timeout: float, what: str):
     return result[0]
 
 
-def preflight():
-    """Cheap end-to-end device check; fail fast with diagnostics if dead."""
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
 
-    def probe():
-        import jax.numpy as jnp
 
-        x = jnp.ones((128, 128), jnp.bfloat16)
-        return float(jnp.sum(x @ x))
+def _atomic_write_json(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
 
-    timeout = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
-    attempts = 3
-    last = None
-    for i in range(attempts):
+
+# Keys a persisted record must carry for build_output to consume it.  The
+# last-good file deliberately survives across rounds/code versions, so a
+# record written by older code (schema drift) must read as "absent", not
+# KeyError — especially inside die(), where an exception would kill the
+# watchdog thread and hang the process with no JSON line at all.
+_REQUIRED_KEYS = {
+    "resnet50": ("images_per_sec_per_chip", "images_per_sec_per_chip_std",
+                 "stem", "repeats", "step_time_ms", "flops_per_step",
+                 "flops_per_sec_per_chip"),
+    "transformer": ("tokens_per_sec_per_chip", "tokens_per_sec_per_chip_std",
+                    "step_time_ms", "n_params", "flash_attention",
+                    "fused_ce", "flops_per_sec_per_chip"),
+    "transformer_xla_control": ("tokens_per_sec_per_chip",),
+}
+
+
+class Recorder:
+    """Incrementally persists sub-benchmark results as they are measured.
+
+    Two jobs (VERDICT round 3, "what's weak" #1):
+    - every result is printed to stderr the moment it exists, so a log tail
+      survives any later hang;
+    - results measured on real TPU hardware are merged into LASTGOOD_FILE
+      atomically (tmp+rename), each stamped with measured_at/device_kind, so
+      a relay death mid-round can no longer erase a round's evidence.
+    """
+
+    def __init__(self, path: str = LASTGOOD_FILE):
+        self.path = path
+        self.fresh: dict = {}
+        self._lock = threading.Lock()
+        self.last_good: dict = {"benchmarks": {}}
         try:
-            val = run_with_timeout(probe, timeout, "preflight")
-            assert val == 128 * 128 * 128, f"bad preflight result {val}"
-            return
-        except ProbeTimeout as e:
-            # A hung attempt holds JAX's global backend-init lock, so a
-            # fresh thread would just queue on it and time out too — fail
-            # immediately rather than burning more wall-clock.
-            last = e
-            break
-        except Exception as e:  # noqa: BLE001
-            last = e
-            if not is_transient(e):
-                print(
-                    "bench: FATAL: preflight failed with a non-relay error "
-                    "(this is a code/setup bug, not backend connectivity):\n"
-                    f"  {type(e).__name__}: {e}",
-                    file=sys.stderr,
-                )
-                raise
+            with open(path) as f:
+                data = json.load(f)
+            if isinstance(data.get("benchmarks"), dict):
+                self.last_good = data
+        except (OSError, ValueError):
+            pass
+
+    def record(self, name: str, result: dict, on_hardware: bool,
+               device_kind: str | None = None):
+        if os.environ.get("BENCH_NO_PERSIST"):
+            # sweep variants explore non-default configs; their numbers are
+            # captured by the sweep driver, not the last-good record
+            on_hardware = False
+        with self._lock:
+            result = dict(result)
+            result["measured_at"] = _utcnow()
+            if device_kind:
+                result["device_kind"] = device_kind
+            self.fresh[name] = result
+            print(f"bench: measured {name}: {json.dumps(result)}",
+                  file=sys.stderr)
+            sys.stderr.flush()
+            if on_hardware:
+                self.last_good["benchmarks"][name] = result
+                self.last_good["updated_at"] = result["measured_at"]
+                try:
+                    _atomic_write_json(self.path, self.last_good)
+                except OSError as e:  # persistence is best-effort
+                    print(f"bench: warning: could not persist last-good "
+                          f"record: {e}", file=sys.stderr)
+
+    def get(self, name: str, allow_stale: bool):
+        """Fresh result for ``name``, else last-good (marked) if allowed."""
+        if name in self.fresh:
+            return self.fresh[name], False
+        if allow_stale:
+            stale = self.last_good["benchmarks"].get(name)
+            if isinstance(stale, dict) and all(
+                k in stale for k in _REQUIRED_KEYS.get(name, ())
+            ):
+                return stale, True
+        return None, False
+
+
+def _probe_subprocess(timeout: float) -> tuple[str, str]:
+    """Run the connectivity probe in a THROWAWAY subprocess.
+
+    A hung in-process probe permanently poisons this process: the stuck
+    thread holds JAX's global backend-init lock, so every later attempt just
+    queues behind it (round-3 failure mode — one 120s hang ended the round's
+    evidence).  A subprocess can hang and be killed without touching our
+    interpreter, which lets the preflight retry across a long outage window
+    and only initialize JAX in-process once a probe has actually succeeded.
+
+    Returns (status, detail): status is "ok", "hang", "transient" (relay
+    outage — retry), or "fatal" (code/setup bug — do NOT retry or mask with
+    stale evidence).
+    """
+    force_cpu = ""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # sitecustomize pins the axon TPU platform before env vars apply;
+        # mirror main()'s config-update fallback inside the probe too
+        force_cpu = "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+    code = force_cpu + (
+        "import jax, jax.numpy as jnp\n"
+        "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+        "v = float(jnp.sum(x @ x))\n"
+        "assert v == 128 * 128 * 128, v\n"
+        "print('PROBE_OK', jax.devices()[0].device_kind)\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return "hang", f"probe subprocess hung past {timeout:.0f}s"
+    except OSError as e:
+        return "fatal", f"probe spawn failed: {e}"
+    if r.returncode == 0 and "PROBE_OK" in r.stdout:
+        return "ok", r.stdout.strip()
+    full = ((r.stderr or "") + "\n" + (r.stdout or "")).lower()
+    tail = (r.stderr or r.stdout or "").strip().splitlines()
+    detail = tail[-1][:200] if tail else f"rc={r.returncode}"
+    status = "transient" if any(t in full for t in _TRANSIENT) else "fatal"
+    return status, detail
+
+
+def preflight() -> bool:
+    """Bounded retry-with-backoff connectivity check across outage windows.
+
+    Returns True when the backend answered, False when the retry window was
+    exhausted on relay-shaped failures (the caller decides whether last-good
+    evidence lets it emit anyway).  Non-relay failures — a broken install,
+    a bad probe result — FATAL immediately: retrying a deterministic bug for
+    the whole window and then reporting rc=0 from stale numbers would mask
+    it.  Each attempt is subprocess-isolated — see _probe_subprocess.
+    """
+    probe_timeout = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
+    window = float(os.environ.get("BENCH_PREFLIGHT_WINDOW", "600"))
+    deadline = time.monotonic() + window
+    delay = 15.0
+    attempt = 0
+    while True:
+        attempt += 1
+        status, detail = _probe_subprocess(probe_timeout)
+        if status == "ok":
+            if attempt > 1:
+                print(f"bench: preflight green on attempt {attempt} "
+                      f"({detail})", file=sys.stderr)
+            return True
+        if status == "fatal":
             print(
-                f"bench: preflight attempt {i + 1}/{attempts} failed "
-                f"({str(e).splitlines()[0][:200]})",
+                "bench: FATAL: preflight failed with a non-relay error "
+                "(this is a code/setup bug, not backend connectivity):\n"
+                f"  {detail}",
                 file=sys.stderr,
             )
-            if i < attempts - 1:
-                time.sleep(5 * (i + 1))
-    print(
-        "bench: FATAL: TPU backend unreachable (connection refused or hung "
-        "relay).\n"
-        f"  last error: {type(last).__name__}: {last}\n"
-        "  If this is the axon relay, check the tunnel (remote_compile "
-        "endpoint) is up; on CPU-only hosts run with JAX_PLATFORMS=cpu for a "
-        "smoke value.",
-        file=sys.stderr,
-    )
-    raise SystemExit(2)
+            raise SystemExit(2)
+        remaining = deadline - time.monotonic()
+        print(
+            f"bench: preflight attempt {attempt} failed ({detail}); "
+            f"{max(0, remaining):.0f}s left in retry window",
+            file=sys.stderr,
+        )
+        if remaining <= delay:
+            return False
+        time.sleep(delay)
+        delay = min(delay * 2, 120.0)
 
 
 def cost_analysis_flops(compiled) -> float | None:
@@ -425,67 +562,12 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
     }
 
 
-def main() -> int:
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # honor the documented smoke path: this image's sitecustomize pins
-        # the axon TPU platform before env vars apply, so force CPU back
-        # via config (the tests/conftest.py pattern)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-
-    # Global watchdog: if the relay hangs mid-bench (after a green
-    # preflight), exit with a diagnostic instead of the driver's rc=124.
-    total_timeout = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "2400"))
-
-    def die():
-        print(
-            f"bench: FATAL: wall-clock exceeded {total_timeout:.0f}s — TPU "
-            "relay most likely hung mid-run (preflight was green). Aborting.",
-            file=sys.stderr,
-        )
-        sys.stderr.flush()
-        os._exit(3)
-
-    watchdog = threading.Timer(total_timeout, die)
-    watchdog.daemon = True
-    watchdog.start()
-
-    preflight()
-    import jax
-    device_kind = jax.devices()[0].device_kind
-    peak = peak_flops_for(device_kind)
-
-    only = os.environ.get("BENCH_ONLY", "").lower()
-    if only not in ("", "resnet", "transformer"):
-        print(
-            f"bench: FATAL: unknown BENCH_ONLY={only!r} "
-            "(expected 'resnet' or 'transformer')",
-            file=sys.stderr,
-        )
-        return 2
-    # Smoke knobs (CPU validation / quick runs); defaults are the real bench.
-    rn_kw = {}
-    tf_kw = {}
-    if os.environ.get("BENCH_SMOKE"):
-        rn_kw = dict(batch_per_chip=2, iters=2, warmup=1)
-        tf_kw = dict(batch_per_chip=1, seq=128, iters=2, warmup=1)
-
-    resnet = bench_resnet50(**rn_kw) if only in ("", "resnet") else None
-    transformer = None
-    transformer_control = None
-    if only in ("", "transformer"):
-        transformer = bench_transformer(**tf_kw)
-        if transformer["flash_attention"] and not os.environ.get("BENCH_NO_CONTROL"):
-            # XLA-attention control: same model/shapes, flash off, fewer
-            # repeats — it exists to anchor the flash speedup in the
-            # artifact, not to be a precision measurement of the slow path.
-            transformer_control = bench_transformer(
-                **{**tf_kw, "use_flash": False, "repeats": 3}
-            )
-
+def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
+                 allow_stale: bool, device_kind: str | None,
+                 n_chips: int | None) -> dict:
+    """Assemble the single JSON line from fresh + (optionally) last-good
+    results, with per-result provenance so stale evidence is never silently
+    presented as this round's measurement."""
     baseline = {}
     if os.path.exists(BASELINE_FILE):
         try:
@@ -494,14 +576,50 @@ def main() -> int:
         except (OSError, ValueError):
             baseline = {}
 
+    resnet = transformer = control = None
+    stale_names = []
+    if want_resnet:
+        resnet, stale = recorder.get("resnet50", allow_stale)
+        if stale:
+            stale_names.append("resnet50")
+    if want_transformer:
+        transformer, t_stale = recorder.get("transformer", allow_stale)
+        if t_stale:
+            stale_names.append("transformer")
+        # a stale control may only pair with a stale transformer (same
+        # persisted battery, same default config); dividing a fresh —
+        # possibly env-tweaked — run by an hours-old control would present
+        # a cross-run ratio as this round's flash speedup
+        control, stale = recorder.get(
+            "transformer_xla_control",
+            allow_stale and transformer is not None and t_stale,
+        )
+        if stale:
+            stale_names.append("transformer_xla_control")
+
+    if device_kind is None:
+        for r in (resnet, transformer):
+            if r and r.get("device_kind"):
+                device_kind = r["device_kind"]
+                break
+    peak = peak_flops_for(device_kind) if device_kind else None
+
+    def peak_for(result) -> float | None:
+        # MFU must use the peak of the chip the result was MEASURED on —
+        # a stale record from a v5e divided by the current chip's (e.g.
+        # v6e) peak would mislabel utilization by the chips' ratio
+        kind = (result or {}).get("device_kind") or device_kind
+        return peak_flops_for(kind) if kind else None
+
     out = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": None,
         "unit": "images/sec/chip",
         "vs_baseline": 1.0,
         "device_kind": device_kind,
-        "n_chips": len(jax.devices()),
     }
+    if n_chips is not None:
+        out["n_chips"] = n_chips
     if resnet:
         out["value"] = round(resnet["images_per_sec_per_chip"], 2)
         base = baseline.get("resnet50_images_per_sec_per_chip")
@@ -512,8 +630,10 @@ def main() -> int:
         out["repeats"] = resnet["repeats"]
         out["resnet50_step_time_ms"] = round(resnet["step_time_ms"], 2)
         out["resnet50_flops_per_step"] = resnet["flops_per_step"]
-        if peak:
-            out["resnet50_mfu"] = round(resnet["flops_per_sec_per_chip"] / peak, 4)
+        rn_peak = peak_for(resnet)
+        if rn_peak:
+            out["resnet50_mfu"] = round(
+                resnet["flops_per_sec_per_chip"] / rn_peak, 4)
     if transformer:
         out["transformer_tokens_per_sec_per_chip"] = round(
             transformer["tokens_per_sec_per_chip"], 1
@@ -525,13 +645,13 @@ def main() -> int:
         out["transformer_n_params"] = transformer["n_params"]
         out["transformer_flash_attention"] = transformer["flash_attention"]
         out["transformer_fused_ce"] = transformer["fused_ce"]
-        if transformer_control:
+        if control:
             out["transformer_xla_attention_tokens_per_sec"] = round(
-                transformer_control["tokens_per_sec_per_chip"], 1
+                control["tokens_per_sec_per_chip"], 1
             )
             out["flash_attention_speedup"] = round(
                 transformer["tokens_per_sec_per_chip"]
-                / transformer_control["tokens_per_sec_per_chip"],
+                / control["tokens_per_sec_per_chip"],
                 4,
             )
         base = baseline.get("transformer_tokens_per_sec_per_chip")
@@ -539,9 +659,10 @@ def main() -> int:
             out["transformer_vs_baseline"] = round(
                 out["transformer_tokens_per_sec_per_chip"] / base, 4
             )
-        if peak:
+        tf_peak = peak_for(transformer)
+        if tf_peak:
             out["transformer_mfu"] = round(
-                transformer["flops_per_sec_per_chip"] / peak, 4
+                transformer["flops_per_sec_per_chip"] / tf_peak, 4
             )
         if resnet is None:  # transformer-only run: promote to headline metric
             out["metric"] = "transformer_tokens_per_sec_per_chip"
@@ -550,9 +671,193 @@ def main() -> int:
             out["vs_baseline"] = out.get("transformer_vs_baseline", 1.0)
     if peak:
         out["peak_flops_per_chip"] = peak
+    if stale_names:
+        out["results_from_last_good"] = stale_names
+        out["last_good_measured_at"] = {
+            n: recorder.last_good["benchmarks"][n].get("measured_at")
+            for n in stale_names
+        }
+    return out
 
-    print(json.dumps(out))
-    return 0
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # honor the documented smoke path: this image's sitecustomize pins
+        # the axon TPU platform before env vars apply, so force CPU back
+        # via config (the tests/conftest.py pattern)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    only = os.environ.get("BENCH_ONLY", "").lower()
+    if only not in ("", "resnet", "transformer"):
+        print(
+            f"bench: FATAL: unknown BENCH_ONLY={only!r} "
+            "(expected 'resnet' or 'transformer')",
+            file=sys.stderr,
+        )
+        return 2
+    want_resnet = only in ("", "resnet")
+    want_transformer = only in ("", "transformer")
+
+    recorder = Recorder()
+    # Variant runs (sweeps, A/B drivers) set BENCH_NO_PERSIST: their configs
+    # differ from the persisted default-config record, so falling back to it
+    # would let a relay outage silently attribute stale default numbers to a
+    # variant (the sweep would then rank identical values and pick a bogus
+    # winner).  For those runs an outage must be a hard failure.  Smoke runs
+    # are non-default shapes for the same reason (they already don't persist).
+    stale_ok = not (os.environ.get("BENCH_NO_PERSIST")
+                    or os.environ.get("BENCH_SMOKE"))
+
+    def emit(allow_stale: bool, device_kind=None, n_chips=None) -> int:
+        """Print the JSON line; return an exit code.
+
+        0  — every requested benchmark is present (fresh or marked stale);
+        4  — a line was printed but a requested benchmark is MISSING (the
+             line carries "partial" so no caller can mistake it for a full
+             run and e.g. never re-measure the missing workload);
+        -1 — nothing to print.
+        """
+        allow_stale = allow_stale and stale_ok
+        out = build_output(recorder, want_resnet, want_transformer,
+                           allow_stale, device_kind, n_chips)
+        missing = []
+        if want_resnet and "resnet50_step_time_ms" not in out:
+            missing.append("resnet50")
+        if want_transformer and "transformer_step_time_ms" not in out:
+            missing.append("transformer")
+        if missing and len(missing) == int(want_resnet) + int(want_transformer):
+            return -1
+        if missing:
+            out["partial"] = True
+            out["missing"] = missing
+        print(json.dumps(out))
+        sys.stdout.flush()
+        return 4 if missing else 0
+
+    # Global watchdog: if the relay hangs mid-bench (after a green
+    # preflight), emit whatever evidence exists — fresh results from this
+    # run plus timestamped last-good — instead of dying empty-handed.
+    total_timeout = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "2400"))
+
+    def die():
+        print(
+            f"bench: wall-clock exceeded {total_timeout:.0f}s — TPU relay "
+            "most likely hung mid-run (preflight was green). Emitting "
+            "partial/last-good evidence.",
+            file=sys.stderr,
+        )
+        rc = emit(allow_stale=True)
+        sys.stderr.flush()
+        os._exit(3 if rc < 0 else rc)
+
+    watchdog = threading.Timer(total_timeout, die)
+    watchdog.daemon = True
+    watchdog.start()
+
+    if not preflight():
+        # Backend unreachable for the whole retry window. Fall back to the
+        # persisted last-good-on-hardware record rather than erasing the
+        # round's evidence; only FATAL when there is truly nothing to show.
+        rc = emit(allow_stale=True)
+        if rc >= 0:
+            print(
+                "bench: backend unreachable — emitted last-good-on-hardware "
+                "record (see results_from_last_good/timestamps).",
+                file=sys.stderr,
+            )
+            return rc
+        reason = ("no last-good record exists" if stale_ok else
+                  "stale fallback is disabled for smoke/variant runs")
+        print(
+            f"bench: FATAL: TPU backend unreachable and {reason}.\n"
+            "  If this is the axon relay, check the tunnel (remote_compile "
+            "endpoint) is up; on CPU-only hosts run with JAX_PLATFORMS=cpu "
+            "for a smoke value.",
+            file=sys.stderr,
+        )
+        return 2
+
+    # First in-process backend init after the subprocess probes: the relay
+    # can still die in the gap and this init then blocks with no exception
+    # (the round-2 failure mode).  Bound it like a probe — on a hang, fall
+    # back to last-good instead of burning 40min of watchdog budget.
+    def _init_backend():
+        import jax
+
+        return jax.devices()
+
+    try:
+        devices = run_with_timeout(
+            _init_backend,
+            float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120")) * 2,
+            "backend init",
+        )
+    except ProbeTimeout as e:
+        print(f"bench: relay died between preflight and init ({e}); "
+              "emitting last-good evidence.", file=sys.stderr)
+        rc = emit(allow_stale=True)
+        return 2 if rc < 0 else rc
+    device_kind = devices[0].device_kind
+    n_chips = len(devices)
+    import jax
+
+    on_hardware = jax.default_backend() == "tpu"
+
+    # Smoke knobs (CPU validation / quick runs); defaults are the real bench.
+    rn_kw = {}
+    tf_kw = {}
+    if os.environ.get("BENCH_SMOKE"):
+        rn_kw = dict(batch_per_chip=2, iters=2, warmup=1)
+        tf_kw = dict(batch_per_chip=1, seq=128, iters=2, warmup=1)
+    if os.environ.get("BENCH_SMOKE") and on_hardware:
+        on_hardware = False  # smoke shapes must not overwrite real evidence
+
+    try:
+        if want_resnet:
+            recorder.record("resnet50", bench_resnet50(**rn_kw), on_hardware,
+                            device_kind)
+        if want_transformer:
+            transformer = bench_transformer(**tf_kw)
+            recorder.record("transformer", transformer, on_hardware,
+                            device_kind)
+            if transformer["flash_attention"] and not os.environ.get("BENCH_NO_CONTROL"):
+                # XLA-attention control: same model/shapes, flash off, fewer
+                # repeats — it exists to anchor the flash speedup in the
+                # artifact, not to be a precision measurement of the slow path.
+                recorder.record(
+                    "transformer_xla_control",
+                    bench_transformer(
+                        **{**tf_kw, "use_flash": False, "repeats": 3}),
+                    on_hardware, device_kind,
+                )
+    except Exception as e:  # noqa: BLE001
+        watchdog.cancel()
+        if not is_transient(e):
+            raise
+        # Relay died mid-measurement and with_retries gave up (the round-1
+        # failure mode: UNAVAILABLE mid-run). Emit what exists — fresh
+        # results already recorded plus last-good — exactly like die() does
+        # for hangs, instead of dying with a traceback and no JSON line.
+        print(
+            "bench: relay lost mid-measurement after retries "
+            f"({str(e).splitlines()[0][:200]}); emitting partial/last-good "
+            "evidence.",
+            file=sys.stderr,
+        )
+        rc = emit(allow_stale=True, device_kind=device_kind, n_chips=n_chips)
+        return 3 if rc < 0 else rc
+
+    # Every requested benchmark ran: emit fresh-only (no stale fill) so a
+    # normal green run is never contaminated by old numbers.  Cancel the
+    # watchdog first — a die() firing at the boundary would print a second
+    # JSON line and clobber the exit code.
+    watchdog.cancel()
+    rc = emit(allow_stale=False, device_kind=device_kind, n_chips=n_chips)
+    return max(rc, 0)
 
 
 if __name__ == "__main__":

@@ -46,6 +46,13 @@ def run_variant(which: str, variant: dict, repeats: int, timeout: float):
         "BENCH_ONLY": which,
         "BENCH_REPEATS": str(repeats),
         "BENCH_NO_CONTROL": "1",
+        # variants explore non-default configs; keep them out of the
+        # last-good-on-hardware record (the sweep table is their artifact)
+        "BENCH_NO_PERSIST": "1",
+        # the caller (relay_watch) is the retry loop — a mid-sweep relay
+        # death must fail each remaining variant in ~1min, not burn the
+        # default 600s preflight window per variant
+        "BENCH_PREFLIGHT_WINDOW": "60",
         # floor: a small --timeout must not arm bench.py's watchdog with a
         # zero/negative budget (it would os._exit immediately)
         "BENCH_TOTAL_TIMEOUT": str(max(60.0, timeout - 30)),
@@ -99,8 +106,15 @@ def main(argv=None) -> int:
         if "error" in r:
             print(f"{r['name']:>18}: ERROR {r['error']}")
     if ok:
-        print(json.dumps({"winner": ok[0]["name"], "value": ok[0]["value"]}))
-    return 0 if ok else 1
+        print(json.dumps({"winner": ok[0]["name"], "value": ok[0]["value"],
+                          "variants_ok": len(ok),
+                          "variants_total": len(variants)}))
+    # Partial success exits nonzero: a caller that marks a sweep "done" on
+    # rc=0 (tools/relay_watch.py) must not lose the variants the relay ate —
+    # a winner picked from a one-variant table is not an A/B.
+    if len(ok) == len(variants):
+        return 0
+    return 1 if not ok else 3
 
 
 if __name__ == "__main__":
