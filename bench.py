@@ -727,9 +727,19 @@ def main() -> int:
         missing = []
         if want_resnet and "resnet50_step_time_ms" not in out:
             missing.append("resnet50")
-        if want_transformer and "transformer_step_time_ms" not in out:
+        have_transformer = "transformer_step_time_ms" in out
+        if want_transformer and not have_transformer:
             missing.append("transformer")
-        if missing and len(missing) == int(want_resnet) + int(want_transformer):
+        if (want_transformer and have_transformer
+                and out.get("transformer_flash_attention")
+                and not os.environ.get("BENCH_NO_CONTROL")
+                and "flash_attention_speedup" not in out):
+            # the XLA-attention control was expected (flash ran, control not
+            # suppressed) but never landed — without this, a relay death
+            # during the control run would emit a full-looking line and the
+            # flash-speedup A/B would silently vanish from the round
+            missing.append("transformer_xla_control")
+        if missing and "resnet50" in missing and "transformer" in missing:
             return -1
         if missing:
             out["partial"] = True

@@ -1,0 +1,173 @@
+"""In-pod payload for the multi-process rendezvous e2e.
+
+This is the TPU-native analogue of the reference's smoke workload — every
+pod of a distributed TFJob ran a real ``tf.train.Server`` and the master
+drove remote ops over gRPC (examples/tf_sample/tf_sample/tf_smoke.py:88-138);
+real between-graph training did the same through replica_device_setter
+(test/e2e/dist-mnist/dist_mnist.py:48-80).  Here every process:
+
+1. reads the operator-injected env contract VERBATIM through
+   ``launcher.bootstrap.LauncherConfig.from_env`` and brings up
+   ``jax.distributed.initialize`` against the coordinator;
+2. cross-checks the legacy-shaped ``TPU_CONFIG`` JSON against its own
+   process identity (the two halves of the contract must agree);
+3. runs a membership collective in which every process contributes a
+   distinct value — proving all N processes joined one world, not N
+   single-process worlds;
+4. runs ONE real sharded train step of the repo Transformer through
+   ``models.train.make_sharded_train_step`` (FSDP state shardings, donated
+   buffers, psum-inserted grads) over the mesh built by
+   ``launcher.bootstrap.make_training_mesh`` — including the hybrid
+   DCN-over-slices mesh when MEGASCALE env is present;
+5. prints one ``RDZV_OK {json}`` line; the chief's line is the gang's
+   result artifact.
+
+Failure injection (gang-semantics testing): ``K8S_TPU_E2E_FAIL=pid:rc:phase``
+makes process ``pid`` exit ``rc`` at ``phase`` (``startup`` before any
+rendezvous, ``post_init`` after the world is up).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+log = logging.getLogger(__name__)
+
+SEQ = 16
+
+
+def _maybe_fail(phase: str, process_id: int) -> None:
+    spec = os.environ.get("K8S_TPU_E2E_FAIL", "")
+    if not spec:
+        return
+    pid_s, rc_s, fail_phase = spec.split(":")
+    if int(pid_s) == process_id and fail_phase == phase:
+        print(f"rendezvous_worker: injected failure at {phase} "
+              f"rc={rc_s}", flush=True)
+        # os._exit so a signal-style death (137/143) isn't converted into a
+        # Python exception by any cleanup machinery
+        os._exit(int(rc_s))
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    if os.environ.get("K8S_TPU_E2E_PLATFORM") == "cpu":
+        # localhost e2e: force the CPU backend the way tests/conftest.py
+        # does (the image's sitecustomize pins the axon TPU platform first)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from k8s_tpu.launcher import bootstrap
+
+    cfg = bootstrap.LauncherConfig.from_env()
+    _maybe_fail("startup", cfg.process_id)
+    cfg = bootstrap.initialize_distributed(cfg)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == cfg.num_processes, (
+        jax.process_count(), cfg.num_processes)
+    assert jax.process_index() == cfg.process_id
+
+    # Contract consistency: the legacy-shaped TPU_CONFIG must describe the
+    # same world the jax.distributed env does (controller_tensorflow.go's
+    # two outputs must agree).
+    tpu_config = json.loads(os.environ["TPU_CONFIG"])
+    cluster_size = sum(len(v) for v in tpu_config["cluster"].values())
+    assert cluster_size >= cfg.num_processes, (tpu_config, cfg)
+    task = tpu_config["task"]
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+    assert len(hostnames) == len(tpu_config["cluster"][task["type"]])
+
+    _maybe_fail("post_init", cfg.process_id)
+
+    mesh, cfg = bootstrap.make_training_mesh(config=cfg)
+
+    # Membership collective: every process contributes (process_id + 1) per
+    # local device; the global sum is wrong unless every process's distinct
+    # value arrived — N independent single-process worlds can't fake it.
+    local = np.full((jax.local_device_count(), 1),
+                    float(cfg.process_id + 1), np.float32)
+    flat = NamedSharding(mesh, P(mesh.axis_names))
+    x = jax.make_array_from_process_local_data(flat, local)
+    total = float(jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x))
+    expect = float(sum(
+        (pid + 1) * jax.local_device_count()
+        for pid in range(cfg.num_processes)
+    ))
+    assert total == expect, f"membership psum {total} != {expect}"
+
+    # One REAL sharded train step of the repo Transformer: FSDP-sharded
+    # state initialized inside jit (no host-side global transfer), batch
+    # sharded over the data axes, gradients psum'd by XLA.
+    from k8s_tpu.models import train as train_lib
+    from k8s_tpu.models.transformer import Transformer, TransformerConfig
+    from k8s_tpu.parallel.sharding import fsdp_sharding
+
+    tcfg = TransformerConfig(
+        vocab_size=64, hidden=32, ffn_hidden=64, layers=1, heads=2,
+        kv_heads=2, max_seq_len=SEQ, use_flash_attention=False,
+    )
+    model = Transformer(tcfg)
+    optimizer = train_lib.default_optimizer(1e-2)
+
+    def init_all():
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32))
+        return train_lib.init_state(params, optimizer)
+
+    state_shape = jax.eval_shape(init_all)
+    shardings = {
+        "params": fsdp_sharding(state_shape["params"], mesh),
+        "opt_state": jax.tree.map(
+            lambda x: fsdp_sharding(x, mesh) if hasattr(x, "shape")
+            else NamedSharding(mesh, P()),
+            state_shape["opt_state"],
+        ),
+        "step": NamedSharding(mesh, P()),
+    }
+    state = jax.jit(init_all, out_shardings=shardings)()
+
+    step = train_lib.make_sharded_train_step(
+        model.apply, train_lib.lm_loss, optimizer, mesh, shardings)
+
+    batch_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+    n_local = jax.local_device_count()
+    rng = np.random.default_rng(1234 + cfg.process_id)
+    local_tokens = rng.integers(
+        0, tcfg.vocab_size, (n_local, SEQ)).astype(np.int32)
+    tokens = jax.make_array_from_process_local_data(
+        batch_sharding, local_tokens)
+
+    state, loss = step(state, (tokens, tokens))
+    loss = float(loss)
+    assert np.isfinite(loss), loss
+    step_no = int(jax.device_get(
+        jax.jit(lambda s: s["step"],
+                out_shardings=NamedSharding(mesh, P()))(state)))
+    assert step_no == 1
+
+    result = {
+        "process_id": cfg.process_id,
+        "num_processes": cfg.num_processes,
+        "is_chief": cfg.is_chief,
+        "global_devices": jax.device_count(),
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "num_slices": cfg.num_slices,
+        "membership_sum": total,
+        "loss": loss,
+        "step": step_no,
+    }
+    print("RDZV_OK " + json.dumps(result, sort_keys=True), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

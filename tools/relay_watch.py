@@ -68,13 +68,15 @@ def build_plan() -> list[dict]:
          "argv": [PY, bench],
          "env": {"BENCH_ONLY": "transformer", "BENCH_FUSED_CE": "1",
                  "BENCH_NO_CONTROL": "1", "BENCH_REPEATS": "3",
-                 "BENCH_NO_PERSIST": "1", "BENCH_TOTAL_TIMEOUT": "1380"},
+                 "BENCH_NO_PERSIST": "1", "BENCH_TOTAL_TIMEOUT": "1380",
+                 "BENCH_PREFLIGHT_WINDOW": "60"},
          "timeout": 1500},
         {"label": "fused_ce_off",
          "argv": [PY, bench],
          "env": {"BENCH_ONLY": "transformer", "BENCH_NO_CONTROL": "1",
                  "BENCH_REPEATS": "3", "BENCH_NO_PERSIST": "1",
-                 "BENCH_TOTAL_TIMEOUT": "1380"},
+                 "BENCH_TOTAL_TIMEOUT": "1380",
+                 "BENCH_PREFLIGHT_WINDOW": "60"},
          "timeout": 1500},
         {"label": "flash_tile_sweep",  # 5 variants x 650s + slack
          "argv": [PY, sweep, "transformer", "--repeats", "2",
@@ -160,10 +162,18 @@ def main(argv=None) -> int:
 
     deadline = time.monotonic() + args.max_hours * 3600
     state = load_state()
-    state.setdefault("failed", {})
+    # failure counts never persist across watcher restarts: a crashed or
+    # re-launched watcher must not pre-load an item toward permanent-skip
+    state["failed"] = {}
     plan = [i for i in build_plan() if i["label"] not in state["done"]]
     log(f"plan: {[i['label'] for i in plan]}")
     MAX_ITEM_FAILURES = 3
+    # A deterministic bug fails fast (bad env → fatal preflight, argparse
+    # error, crash on import).  A relay death mid-item burns most of the
+    # item's budget before failing — and the relay may well be back up by
+    # re-probe time (windows can be shorter than an item), so "probe ok
+    # after failure" alone must NOT classify the failure as deterministic.
+    FAST_FAILURE_S = 300
     while plan and time.monotonic() < deadline:
         status = probe(args.probe_timeout)
         if status == "fatal":
@@ -186,25 +196,28 @@ def main(argv=None) -> int:
                 state["results"][item["label"]] = res["parsed"]
                 save_state(state)
                 continue
-            fails = state["failed"].get(item["label"], 0) + 1
-            state["failed"][item["label"]] = fails
-            save_state(state)
-            log(f"{item['label']} FAILED rc={res['rc']} attempt {fails} "
+            log(f"{item['label']} FAILED rc={res['rc']} in {res['seconds']}s "
                 f"({(res['stderr_tail'] or ['?'])[-1][:160]})")
-            # Relay-shaped failure (relay died mid-item): probing again is
-            # the only cure — stop the battery and wait.  If the relay is
-            # still UP the failure is deterministic: move on to the NEXT
-            # item rather than starving the rest of the plan, and give up
-            # on an item entirely after MAX_ITEM_FAILURES attempts.
+            # Slow failure ⇒ relay-shaped (died mid-item) even if a re-probe
+            # succeeds — relay windows can be shorter than an item, so
+            # "relay up now" says nothing about why a 40-minute run died.
+            # Leave the item pending and go back to probing.  Only FAST
+            # failures with the relay still up count as deterministic
+            # attempts; after MAX_ITEM_FAILURES of those, skip the item so
+            # it can't starve the rest of the plan.
+            if res["seconds"] >= FAST_FAILURE_S:
+                break
             if probe(args.probe_timeout) != "ok":
                 break
+            fails = state["failed"].get(item["label"], 0) + 1
+            state["failed"][item["label"]] = fails
             if fails >= MAX_ITEM_FAILURES:
-                log(f"{item['label']} failed {fails}x with relay up — "
+                log(f"{item['label']} failed fast {fails}x with relay up — "
                     "marking permanently failed")
                 state["done"].append(item["label"])
                 state["results"][item["label"]] = {"error": "permanent",
                                                    "rc": res["rc"]}
-                save_state(state)
+            save_state(state)
         plan = [i for i in build_plan()
                 if i["label"] not in state["done"]]
         if plan:
