@@ -13,10 +13,11 @@ same resource, as in a real apiserver.
 
 from __future__ import annotations
 
+import copy as _copy_mod
 import itertools
 import queue
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Iterable, Optional
 
 from k8s_tpu.api.meta import now_rfc3339
@@ -89,7 +90,7 @@ class FakeCluster:
     # analogue — small enough that tests can actually hit the 410 path).
     EVENT_HISTORY_LIMIT = 2048
 
-    def __init__(self):
+    def __init__(self, copy_on_io: bool = True):
         self._lock = threading.RLock()
         self._store: dict[tuple[str, str], dict[tuple[str, str], dict]] = {}
         self._watches: dict[tuple[str, str], list[_Watch]] = {}
@@ -99,6 +100,16 @@ class FakeCluster:
         self._events: dict[tuple[str, str], list[tuple[int, str, dict]]] = {}
         self._events_trimmed: dict[tuple[str, str], int] = {}
         self.actions: list[Action] = []
+        # copy_on_io=False shares stored dicts across the IO boundary instead
+        # of deep-copying (~5 deepcopies per create, the dominant per-request
+        # cost under the wire bench).  ONLY safe when every consumer treats
+        # returned objects as immutable — i.e. behind the HTTP apiserver
+        # (e2e.apiserver), where objects are serialized immediately and the
+        # store itself never mutates a bucket entry in place (update/patch
+        # REPLACE entries, so history/watch refs stay frozen).  In-process
+        # fake-mode callers mutate returned dicts freely; they keep the
+        # default.
+        self._copy = _copy_mod.deepcopy if copy_on_io else (lambda x: x)
 
     def _next_rv(self) -> int:
         with self._lock:
@@ -129,13 +140,11 @@ class FakeCluster:
             rv = int((obj.get("metadata") or {}).get("resourceVersion", 0))
         except (TypeError, ValueError):
             rv = 0
-        import copy as _copy
-
         hist = self._events.setdefault(key, [])
         # private copy: live watchers receive ``obj`` itself, and a consumer
         # mutating its event must not corrupt what a later rv-resumed watch
         # replays
-        hist.append((rv, event_type, _copy.deepcopy(obj)))
+        hist.append((rv, event_type, self._copy(obj)))
         if len(hist) > self.EVENT_HISTORY_LIMIT:
             overflow = len(hist) - self.EVENT_HISTORY_LIMIT
             self._events_trimmed[key] = max(
@@ -158,12 +167,10 @@ class FakeCluster:
 
     def create(self, resource: GVR, namespace: str, obj: dict) -> dict:
         with self._lock:
-            import copy as _copy
-
             # A real apiserver never mutates the caller's submitted object;
             # work on a copy so server-assigned fields (uid, rv) don't leak
             # back and mask conflict-handling bugs under the fake.
-            obj = _copy.deepcopy(obj)
+            obj = self._copy(obj)
             meta = obj.setdefault("metadata", {})
             name = meta.get("name", "")
             if not name and meta.get("generateName"):
@@ -184,9 +191,9 @@ class FakeCluster:
             obj.setdefault("kind", resource.kind)
             stored = obj
             bucket[(ns, name)] = stored
-            self._record("create", resource, ns, name, _copy.deepcopy(stored))
-            self._notify(resource, ADDED, _copy.deepcopy(stored))
-            return _copy.deepcopy(stored)
+            self._record("create", resource, ns, name, self._copy(stored))
+            self._notify(resource, ADDED, self._copy(stored))
+            return self._copy(stored)
 
     def get(self, resource: GVR, namespace: str, name: str) -> dict:
         with self._lock:
@@ -195,9 +202,7 @@ class FakeCluster:
             self._record("get", resource, ns, name)
             if obj is None:
                 raise errors.not_found(f"{resource.plural} {ns}/{name} not found")
-            import copy as _copy
-
-            return _copy.deepcopy(obj)
+            return self._copy(obj)
 
     def list(
         self,
@@ -209,8 +214,6 @@ class FakeCluster:
         with self._lock:
             required = parse_label_selector(label_selector)
             out = []
-            import copy as _copy
-
             for (ns, _name), obj in self._bucket(resource).items():
                 if namespace is not None and resource.namespaced and ns != namespace:
                     continue
@@ -218,7 +221,7 @@ class FakeCluster:
                     continue
                 if field_selector and not self._fields_match(obj, field_selector):
                     continue
-                out.append(_copy.deepcopy(obj))
+                out.append(self._copy(obj))
             self._record("list", resource, namespace or "")
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
             return out
@@ -249,23 +252,28 @@ class FakeCluster:
                     f"operation cannot be fulfilled on {resource.plural} {ns}/{name}: "
                     f"object has been modified (sent rv {sent_rv}, current {cur_rv})"
                 )
-            import copy as _copy
-
-            stored = _copy.deepcopy(obj)
+            stored = self._copy(obj)
             stored["metadata"]["uid"] = current["metadata"]["uid"]
             stored["metadata"]["creationTimestamp"] = current["metadata"].get(
                 "creationTimestamp", ""
             )
             stored["metadata"]["resourceVersion"] = str(self._next_rv())
             bucket[(ns, name)] = stored
-            self._record("update", resource, ns, name, _copy.deepcopy(stored))
-            self._notify(resource, MODIFIED, _copy.deepcopy(stored))
-            return _copy.deepcopy(stored)
+            self._record("update", resource, ns, name, self._copy(stored))
+            self._notify(resource, MODIFIED, self._copy(stored))
+            return self._copy(stored)
 
     def patch_merge(self, resource: GVR, namespace: str, name: str, patch: dict) -> dict:
         """Strategic-merge-lite: recursive dict merge (lists replaced)."""
         with self._lock:
+            # The merge target must be a PRIVATE copy: a patch is logically
+            # replace-after-merge, and merging into the stored dict in place
+            # would corrupt watch-history aliases.  With copy_on_io=True,
+            # get() already returned one — don't pay a second deepcopy on
+            # the hottest verb of the reconcile/kubelet loops.
             current = self.get(resource, namespace, name)
+            if self._copy is not _copy_mod.deepcopy:
+                current = _copy_mod.deepcopy(current)
 
             def merge(dst, src):
                 for k, v in src.items():
@@ -296,7 +304,12 @@ class FakeCluster:
             if obj is None:
                 raise errors.not_found(f"{resource.plural} {ns}/{name} not found")
             # deletion is a state change: the DELETED event gets its own rv
-            # (as in etcd) so rv-resumed watches can order it correctly
+            # (as in etcd) so rv-resumed watches can order it correctly.
+            # Re-stamp on a fresh top-two-level copy, never in place: with
+            # copy_on_io=False the popped dict is aliased by watch history
+            # and already-delivered events, whose rvs must stay frozen.
+            obj = dict(obj)
+            obj["metadata"] = dict(obj["metadata"])
             obj["metadata"]["resourceVersion"] = str(self._next_rv())
             self._notify(resource, DELETED, obj)
             if propagation in ("Background", "Foreground"):
@@ -363,17 +376,18 @@ class FakeCluster:
             key = self._key(resource)
             w = _Watch(self, key, namespace)
             if resource_version is not None:
+                # rvs are opaque to clients; this backend minted them as
+                # ints, so it may (and must) interpret them numerically here
+                resource_version = int(resource_version)
                 if resource_version < self._events_trimmed.get(key, 0):
                     raise errors.expired(
                         f"resourceVersion {resource_version} is too old "
                         f"(retained history starts after "
                         f"{self._events_trimmed.get(key, 0)})"
                     )
-                import copy as _copy
-
                 for rv, event_type, obj in self._events.get(key, []):
                     if rv > resource_version:
-                        w._emit(event_type, _copy.deepcopy(obj))
+                        w._emit(event_type, self._copy(obj))
             self._watches.setdefault(key, []).append(w)
             return w
 
@@ -381,15 +395,13 @@ class FakeCluster:
 
     def objects(self, resource: GVR) -> Iterable[dict]:
         with self._lock:
-            import copy as _copy
-
-            return [_copy.deepcopy(o) for o in self._bucket(resource).values()]
+            return [self._copy(o) for o in self._bucket(resource).values()]
 
     def set_pod_phase(self, namespace: str, name: str, phase: str, **status_kw) -> dict:
         """Simulate kubelet: flip a pod's status.phase (and extra status keys)."""
         from k8s_tpu.client.gvr import PODS
 
-        pod = self.get(PODS, namespace, name)
+        pod = _copy_mod.deepcopy(self.get(PODS, namespace, name))
         pod.setdefault("status", {})["phase"] = phase
         pod["status"].update(status_kw)
         return self.update(PODS, namespace, pod)

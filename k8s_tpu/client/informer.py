@@ -219,7 +219,9 @@ class SharedInformer:
     def _reflector_loop(self) -> None:
         backoff = 0.1
         expired_in_row = 0
-        last_rv: Optional[int] = None  # None → a full relist is required
+        # opaque rv (str from real servers, int from the fake's list_with_rv);
+        # None → a full relist is required
+        last_rv = None
         while not self._stop.is_set():
             try:
                 if last_rv is None:
@@ -275,10 +277,12 @@ class SharedInformer:
                 # server-sent error frame (e.g. 410 mid-stream): relist
                 return None
             if last_rv is not None:
-                try:
-                    last_rv = int((obj.get("metadata") or {}).get("resourceVersion"))
-                except (TypeError, ValueError):
-                    last_rv = None
+                # rv is opaque (K8s API contract): carry the string through
+                # to the next watch's resume parameter untouched — only the
+                # backend that MINTED the rv may interpret it (the fake
+                # int()s its own numeric rvs; a real apiserver just echoes)
+                last_rv = (obj.get("metadata") or {}).get("resourceVersion") \
+                    or None
             old = self.store.get_by_key(meta_namespace_key(obj))
             if event_type == "ADDED":
                 self.store.add(obj)

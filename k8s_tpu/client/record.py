@@ -9,6 +9,8 @@ pkg/controller.v2/service_control.go:96-112).
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 import time
 
 from k8s_tpu.api.meta import now_rfc3339
@@ -57,6 +59,82 @@ class EventRecorder:
 
     def eventf(self, involved: dict, event_type: str, reason: str, fmt: str, *args) -> None:
         self.event(involved, event_type, reason, fmt % args if args else fmt)
+
+
+class AsyncEventRecorder(EventRecorder):
+    """EventRecorder that posts from a background sink thread — the
+    client-go EventBroadcaster architecture (record.NewBroadcaster +
+    StartRecordingToSink): recording an event is a buffered enqueue, never
+    an API round-trip on the reconcile hot path.
+
+    Measured motivation: under the 200-gang-job wire bench, synchronous
+    event POSTs were ~9 of the ~27 HTTP requests per job *inside* the
+    reconcile loop.  Event content is unchanged (one event per message —
+    the harness parses pod names out of messages, so no cross-object
+    aggregation); only the posting moves off-thread.
+
+    Overflow drops the newest event with a log line, exactly like
+    client-go's full buffered channel.  ``flush()`` waits for the queue to
+    drain (tests; controller shutdown).
+    """
+
+    QUEUE_SIZE = 4096
+
+    def __init__(self, clientset: Clientset, component: str):
+        super().__init__(clientset, component)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.QUEUE_SIZE)
+        self._unfinished = 0
+        self._closed = False
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._sink, daemon=True, name=f"event-sink-{component}")
+        self._thread.start()
+
+    def event(self, involved: dict, event_type: str, reason: str, message: str) -> None:
+        try:
+            with self._cond:
+                if self._closed:
+                    return
+                self._q.put_nowait((involved, event_type, reason, message))
+                self._unfinished += 1
+        except queue.Full:
+            log.warning("event queue full; dropping %s %s", reason, message)
+
+    def _sink(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                super().event(*item)
+            finally:
+                with self._cond:
+                    self._unfinished -= 1
+                    self._cond.notify_all()
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until every recorded event has been posted (or timeout)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._unfinished > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    def close(self, timeout: float = 10.0) -> bool:
+        """Drain, then terminate the sink thread.  Without this every
+        recorder instance would leak its thread for process lifetime (a
+        test suite builds controllers by the dozen)."""
+        drained = self.flush(timeout)
+        with self._cond:
+            if self._closed:
+                return drained
+            self._closed = True
+        self._q.put(None)  # sentinel: _sink exits
+        self._thread.join(timeout=5)
+        return drained
 
 
 class FakeRecorder:

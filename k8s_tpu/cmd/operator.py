@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve /metrics + /healthz on this port (0 = off, "
                    "matching the reference, which exposes no endpoint)")
+    p.add_argument("--metrics-host", default="0.0.0.0",
+                   help="bind address for the metrics endpoint. The "
+                   "endpoints are UNAUTHENTICATED: the default binds all "
+                   "interfaces because in-pod scrapers must reach them; "
+                   "pass 127.0.0.1 to restrict to loopback (the library "
+                   "default outside this binary)")
     p.add_argument("--version", action="store_true")
     return p
 
@@ -122,6 +128,7 @@ def run(opts, backend=None) -> int:
     from k8s_tpu.util.metrics_server import maybe_start
 
     metrics_server = maybe_start(getattr(opts, "metrics_port", 0),
+                                host=getattr(opts, "metrics_host", "0.0.0.0"),
                                 health_fn=controller.healthy)
 
     namespace = opts.namespace or get_namespace()

@@ -16,7 +16,7 @@ from k8s_tpu.api import register, v1alpha1
 from k8s_tpu.client.clientset import Clientset
 from k8s_tpu.client.gvr import TFJOBS_V1ALPHA1
 from k8s_tpu.client.informer import SharedInformerFactory, split_meta_namespace_key
-from k8s_tpu.client.record import EventRecorder
+from k8s_tpu.client.record import AsyncEventRecorder, EventRecorder  # noqa: F401 (EventRecorder is part of the module's injection surface)
 from k8s_tpu.controller.trainer.training import TrainingJob
 from k8s_tpu.util import metrics
 from k8s_tpu.util.workqueue import new_rate_limiting_queue
@@ -38,7 +38,7 @@ class Controller:
         self.clientset = clientset
         self.config = config or v1alpha1.ControllerConfig()
         self.enable_gang_scheduling = enable_gang_scheduling
-        self.recorder = recorder or EventRecorder(clientset, CONTROLLER_NAME)
+        self.recorder = recorder or AsyncEventRecorder(clientset, CONTROLLER_NAME)
         self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v1")
         self.jobs: dict[str, TrainingJob] = {}  # key -> TrainingJob
@@ -107,6 +107,10 @@ class Controller:
         self._stop.set()
         self.queue.shut_down()
         self.factory.stop()
+        close = getattr(self.recorder, "close", None)
+        if close:  # drain + terminate the async event sink (mirrors v2) —
+            # events from the final reconciles must reach the apiserver
+            close(timeout=5.0)
 
     def _run_worker(self) -> None:
         while self._process_next_work_item():

@@ -15,7 +15,6 @@ import logging
 
 from k8s_tpu.api.meta import OwnerReference
 from k8s_tpu.client.clientset import Clientset
-from k8s_tpu.client.record import EventRecorder
 
 log = logging.getLogger(__name__)
 

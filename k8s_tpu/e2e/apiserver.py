@@ -100,6 +100,20 @@ def parse_route(path: str) -> Optional[_Route]:
     return _Route(res, None, name)
 
 
+class _LeanHeaders(dict):
+    """Case-insensitive header lookup over lowercased keys — the minimal
+    surface the handlers (and stdlib's Expect check) actually use."""
+
+    def get(self, key, default=None):  # type: ignore[override]
+        return dict.get(self, key.lower(), default)
+
+    def __getitem__(self, key):
+        return dict.__getitem__(self, key.lower())
+
+    def __contains__(self, key):
+        return dict.__contains__(self, key.lower())
+
+
 class _Handler(BaseHTTPRequestHandler):
     # HTTP/1.1 keep-alive for unary requests (Content-Length is always
     # set); watch streams opt out via Connection: close + close_connection
@@ -108,6 +122,11 @@ class _Handler(BaseHTTPRequestHandler):
     # pins cluster/token/watch_timeout/stopping/resource_version.
     protocol_version = "HTTP/1.1"
     disable_nagle_algorithm = True  # pair with the client's TCP_NODELAY
+    # Buffer response writes: send_response/send_header each wrote straight
+    # to the socket (wbufsize=0), costing 5+ syscalls per response; stdlib's
+    # handle_one_request flushes after every handler, and the watch stream
+    # flushes per frame, so buffering never delays a byte that matters.
+    wbufsize = 64 * 1024
 
     # -- plumbing ----------------------------------------------------------
 
@@ -115,10 +134,48 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("apiserver: " + fmt, *args)
 
     def parse_request(self) -> bool:
+        """Lean HTTP/1.1 request parse.
+
+        Replaces stdlib's parse (which builds an email.message.Message per
+        request) with a request-line split + flat header dict — measured at
+        ~100us/request saved, a double-digit share of the wire bench where
+        a 200-gang-job burst is ~6000 requests on one core.  Same contract:
+        sets command/path/request_version/headers/close_connection.
+        """
         # one handler instance serves many keep-alive requests: the
         # body-consumed flag is per REQUEST, so reset it here
         self._body_consumed = False
-        return super().parse_request()
+        self.command = None
+        self.request_version = version = "HTTP/0.9"
+        self.close_connection = True
+        requestline = str(self.raw_requestline, "iso-8859-1").rstrip("\r\n")
+        self.requestline = requestline
+        parts = requestline.split()
+        if len(parts) != 3:
+            self.send_error(400, f"Bad request syntax ({requestline!r})")
+            return False
+        self.command, self.path, version = parts
+        if not version.startswith("HTTP/1."):
+            self.send_error(505, f"Invalid HTTP version ({version})")
+            return False
+        self.request_version = version
+        headers = _LeanHeaders()
+        while True:
+            line = self.rfile.readline(65537)
+            if len(line) > 65536:
+                self.send_error(431, "Header line too long")
+                return False
+            if line in (b"\r\n", b"\n", b""):
+                break
+            key, _, value = line.decode("iso-8859-1").partition(":")
+            headers[key.strip().lower()] = value.strip()
+        self.headers = headers
+        conn = (headers.get("connection") or "").lower()
+        self.close_connection = (
+            conn == "close"
+            or (version == "HTTP/1.0" and conn != "keep-alive")
+        )
+        return True
 
     def _send_json(self, code: int, obj: dict) -> None:
         # Keep-alive hygiene: if the request body was never consumed (early
@@ -305,6 +362,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Connection", "close")
         self.end_headers()
+        # wfile is buffered (wbufsize): push the headers NOW — the client
+        # blocks on them before it considers the watch established, and the
+        # first frame may be arbitrarily far away
+        self.wfile.flush()
         deadline = _time.monotonic() + timeout
         try:
             while not self.server.stopping.is_set():
@@ -342,7 +403,12 @@ class ApiServer:
     def __init__(self, cluster: Optional[FakeCluster] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  token: str = "", watch_timeout: float = 60.0):
-        self.cluster = cluster if cluster is not None else FakeCluster()
+        # Behind the wire protocol, store objects are serialized at the
+        # boundary and never handed to in-process consumers, so the store
+        # runs copy-free (copy_on_io=False): ~5 deepcopies per create was
+        # the dominant per-request CPU under the 200-job wire bench.
+        self.cluster = (cluster if cluster is not None
+                        else FakeCluster(copy_on_io=False))
         self.token = token
         self.watch_timeout = watch_timeout
         self.stopping = threading.Event()

@@ -14,6 +14,8 @@ training image).
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import logging
 import os
 import subprocess
@@ -38,6 +40,7 @@ class KubeletSimulator:
         poll_interval_s: float = 0.05,
         restart_backoff_s: float = 0.2,
         max_restarts: int | None = None,
+        termination_grace_s: float = 10.0,
     ):
         self.clientset = clientset
         self.namespace = namespace
@@ -47,12 +50,25 @@ class KubeletSimulator:
         self.poll_interval_s = poll_interval_s
         self.restart_backoff_s = restart_backoff_s
         self.max_restarts = max_restarts
+        self.termination_grace_s = termination_grace_s
         self._claimed: set[str] = set()  # pod uids this kubelet started
         self._procs: dict[str, subprocess.Popen] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._active_watch = None
         self._watch_lock = threading.Lock()
+        # Command-less (synthetic) pods run on a single timer wheel instead
+        # of a thread each: at e2e scale (1600+ pods) thread-per-pod meant
+        # a thread + its own pooled REST connection + a server-side handler
+        # thread PER POD, and the connection storm dominated the wire bench.
+        # One timer thread issues every synthetic status patch over one
+        # pooled connection — which is also what a real kubelet is: an event
+        # loop, not a thread per container.
+        self._timer_heap: list = []
+        self._timer_seq = itertools.count()
+        self._timer_cond = threading.Condition()
+        self._timer_thread: threading.Thread | None = None
+        self._deleted: set[str] = set()  # synthetic pods deleted mid-flight
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -61,7 +77,40 @@ class KubeletSimulator:
             target=self._loop, daemon=True, name="kubelet-sim"
         )
         self._thread.start()
+        self._timer_thread = threading.Thread(
+            target=self._timer_loop, daemon=True, name="kubelet-timers"
+        )
+        self._timer_thread.start()
         return self
+
+    # -- synthetic-pod timer wheel -------------------------------------------
+
+    def _schedule(self, delay_s: float, fn) -> None:
+        with self._timer_cond:
+            heapq.heappush(
+                self._timer_heap,
+                (time.monotonic() + delay_s, next(self._timer_seq), fn),
+            )
+            self._timer_cond.notify()
+
+    def _timer_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._timer_cond:
+                while not self._timer_heap and not self._stop.is_set():
+                    self._timer_cond.wait(0.5)
+                if self._stop.is_set():
+                    return
+                due_at = self._timer_heap[0][0]
+                now = time.monotonic()
+                if due_at > now:
+                    self._timer_cond.wait(min(due_at - now, 0.5))
+                    continue
+                _at, _seq, fn = heapq.heappop(self._timer_heap)
+            try:
+                fn()
+            except Exception:
+                if not self._stop.is_set():
+                    log.exception("kubelet timer task failed")
 
     def stop(self) -> None:
         self._stop.set()
@@ -78,6 +127,10 @@ class KubeletSimulator:
         for proc in list(self._procs.values()):
             if proc.poll() is None:
                 proc.kill()
+        with self._timer_cond:
+            self._timer_cond.notify_all()
+        if self._timer_thread:
+            self._timer_thread.join(timeout=5)
         if self._thread:
             self._thread.join(timeout=5)
 
@@ -141,16 +194,96 @@ class KubeletSimulator:
         if uid in self._claimed or phase in ("Succeeded", "Failed"):
             return
         self._claimed.add(uid)
+        container = self._container(pod)
+        command = list(container.get("command") or []) + list(
+            container.get("args") or []
+        )
+        if not command:
+            # synthetic pod: no subprocess to babysit — run its whole
+            # lifecycle on the timer wheel (Running now, completion after
+            # default_runtime_s), all from the single timer thread
+            self._schedule(0.0, lambda: self._start_sleep_pod(pod))
+            return
         threading.Thread(
             target=self._run_pod, args=(pod,), daemon=True,
             name=f"pod-{pod['metadata']['name']}",
         ).start()
 
+    def _start_sleep_pod(self, pod: dict) -> None:
+        uid = pod["metadata"]["uid"]
+        if uid in self._deleted:
+            return
+        self._set_status(pod, "Running", {"running": {}})
+        self._schedule(
+            self.default_runtime_s,
+            lambda: self._finish_sleep_pod(pod, restart_count=0),
+        )
+
+    def _finish_sleep_pod(self, pod: dict, restart_count: int) -> None:
+        """Synthetic completion with the same semantics as _run_pod's loop
+        for command-less pods: exit default_exit_code; 0 → Succeeded,
+        nonzero → crash-loop (restartable) or terminal Failed."""
+        uid = pod["metadata"]["uid"]
+        name = pod["metadata"]["name"]
+        if uid in self._deleted or self._stop.is_set():
+            return
+        exit_code = self.default_exit_code
+        if exit_code == 0:
+            self._set_status(pod, "Succeeded", {"terminated": {"exitCode": 0}})
+            return
+        restart_policy = (pod.get("spec") or {}).get("restartPolicy", "Always")
+        restartable = restart_policy in ("Always", "OnFailure")
+        if not restartable or (
+            self.max_restarts is not None and restart_count >= self.max_restarts
+        ):
+            self._set_status(
+                pod, "Failed", {"terminated": {"exitCode": exit_code}})
+            return
+        restart_count += 1
+        try:
+            current = self.clientset.pods(self.namespace).get(name)
+        except errors.ApiError:
+            return  # pod deleted while it was "running"
+        status = {
+            "phase": "Running",
+            "startTime": (current.get("status") or {}).get("startTime"),
+            "containerStatuses": [
+                {
+                    "name": CONTAINER_NAME,
+                    "restartCount": restart_count,
+                    "state": {"waiting": {"reason": "CrashLoopBackOff"}},
+                    "lastState": {"terminated": {"exitCode": exit_code}},
+                }
+            ],
+        }
+        try:
+            self.clientset.pods(self.namespace).patch(name, {"status": status})
+        except errors.ApiError:
+            return
+        self._schedule(
+            self.restart_backoff_s + self.default_runtime_s,
+            lambda: self._finish_sleep_pod(pod, restart_count),
+        )
+
     def _kill_deleted(self, pod: dict) -> None:
         uid = (pod.get("metadata") or {}).get("uid")
+        if uid:
+            self._deleted.add(uid)  # cancels pending synthetic timers
         proc = self._procs.get(uid)
         if proc is not None and proc.poll() is None:
-            proc.kill()
+            # Real kubelet contract: SIGTERM first, SIGKILL after
+            # terminationGracePeriodSeconds.  The grace window is what lets
+            # a training process run its cooperative-preemption path (save
+            # checkpoint at the next step boundary, exit 143) instead of
+            # losing state to an immediate kill.
+            grace = float(
+                (pod.get("spec") or {}).get("terminationGracePeriodSeconds",
+                                            self.termination_grace_s))
+            proc.terminate()
+            def _force_kill(p=proc):
+                if p.poll() is None:
+                    p.kill()
+            self._schedule(grace, _force_kill)
 
     def _sync_once(self) -> None:
         pods = self.clientset.pods(self.namespace).list()

@@ -152,7 +152,7 @@ def run_gang(n_workers: int = 4, *, num_slices: int = 1,
     for i in range(n_workers):
         env = dict(os.environ)
         env.update(localhost_env(tfjob, "worker", i))
-        env["K8S_TPU_E2E_PLATFORM"] = "cpu"
+        env["K8S_TPU_PLATFORM"] = "cpu"
         # one local device per process — the "one chip per pod" model; also
         # strips the virtual-8-device flag tests/conftest.py exports, which
         # would otherwise inflate every worker to 8 local devices

@@ -54,14 +54,11 @@ def _maybe_fail(phase: str, process_id: int) -> None:
 
 def main() -> int:
     logging.basicConfig(level=logging.INFO)
-    if os.environ.get("K8S_TPU_E2E_PLATFORM") == "cpu":
-        # localhost e2e: force the CPU backend the way tests/conftest.py
-        # does (the image's sitecustomize pins the axon TPU platform first)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-
     from k8s_tpu.launcher import bootstrap
+
+    # localhost e2e: the driver injects K8S_TPU_PLATFORM=cpu; the bootstrap
+    # owns the sitecustomize workaround
+    bootstrap.apply_platform_env()
 
     cfg = bootstrap.LauncherConfig.from_env()
     _maybe_fail("startup", cfg.process_id)

@@ -15,7 +15,6 @@ import datetime
 import logging
 import os
 import shutil
-import subprocess
 
 from k8s_tpu.harness import util as harness_util
 

@@ -1,0 +1,211 @@
+"""Line coverage via sys.monitoring (PEP 669) — no third-party deps.
+
+The reference gated CI on coveralls (.travis.yml:23-33: goveralls over
+every package).  This image has no coverage.py, so the harness brings its
+own collector: Python 3.12's ``sys.monitoring`` delivers a LINE event per
+newly-executed location, and returning ``DISABLE`` from the callback turns
+that location off after its FIRST hit — steady-state overhead near zero
+(the same mechanism coverage.py 7.4+ uses).
+
+Denominator: executable lines discovered by compiling every source file
+under the measured package and walking the code-object tree's
+``co_lines()`` — i.e. exactly the lines the interpreter could report.
+
+CLI (the CI ``coverage`` tier):
+
+    python -m k8s_tpu.harness.coverage run --baseline coverage_baseline.json \
+        -- -m pytest tests/test_api_defaults.py ...
+
+exits nonzero when measured coverage regresses below the recorded baseline
+(minus a small tolerance), and prints the per-run percentage so the tier
+log always carries the number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+TOOL_ID = 3  # sys.monitoring.COVERAGE_ID
+
+
+class Collector:
+    """First-hit line collector for files under ``root``."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root) + os.sep
+        self.hits: dict[str, set[int]] = {}
+
+    def _on_line(self, code, lineno):
+        fn = code.co_filename
+        if fn.startswith(self.root):
+            self.hits.setdefault(fn, set()).add(lineno)
+        return sys.monitoring.DISABLE
+
+    def start(self) -> None:
+        mon = sys.monitoring
+        mon.use_tool_id(TOOL_ID, "k8s-tpu-coverage")
+        mon.register_callback(TOOL_ID, mon.events.LINE, self._on_line)
+        mon.set_events(TOOL_ID, mon.events.LINE)
+
+    def stop(self) -> None:
+        mon = sys.monitoring
+        mon.set_events(TOOL_ID, 0)
+        mon.register_callback(TOOL_ID, mon.events.LINE, None)
+        mon.free_tool_id(TOOL_ID)
+
+
+def executable_lines(path: str) -> set[int]:
+    """All line numbers the compiled module could report (co_lines over the
+    whole nested code-object tree)."""
+    with open(path, "rb") as f:
+        src = f.read()
+    try:
+        top = compile(src, path, "exec")
+    except SyntaxError:
+        return set()
+    lines: set[int] = set()
+    stack = [top]
+    while stack:
+        code = stack.pop()
+        for _start, _end, lineno in code.co_lines():
+            # line 0 / None are synthetic (module RESUME etc.), never
+            # reported by the LINE event
+            if lineno:
+                lines.add(lineno)
+        for const in code.co_consts:
+            if hasattr(const, "co_lines"):
+                stack.append(const)
+    return lines
+
+
+def iter_sources(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def report(collector: Collector, root: str) -> dict:
+    root = os.path.abspath(root)
+    files = {}
+    total_exec = total_hit = 0
+    for path in sorted(iter_sources(root)):
+        execs = executable_lines(path)
+        if not execs:
+            continue
+        hit = collector.hits.get(path, set()) & execs
+        total_exec += len(execs)
+        total_hit += len(hit)
+        files[os.path.relpath(path, os.path.dirname(root))] = {
+            "executable": len(execs),
+            "hit": len(hit),
+            "pct": round(100.0 * len(hit) / len(execs), 1),
+        }
+    return {
+        "pct": round(100.0 * total_hit / max(total_exec, 1), 2),
+        "lines_executable": total_exec,
+        "lines_hit": total_hit,
+        "files": files,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    runp = sub.add_parser("run", help="measure a python invocation")
+    runp.add_argument("--package", default="k8s_tpu",
+                      help="source tree to measure (default: k8s_tpu)")
+    runp.add_argument("--out", default="",
+                      help="write the full JSON report here")
+    runp.add_argument("--baseline", default="",
+                      help="baseline JSON ({'pct': N}); exit 5 when the "
+                      "measured pct drops more than --tolerance below it")
+    runp.add_argument("--tolerance", type=float, default=1.0,
+                      help="allowed regression in percentage points")
+    runp.add_argument("--update-baseline", action="store_true",
+                      help="rewrite the baseline file with this run's pct")
+    runp.add_argument("argv", nargs=argparse.REMAINDER,
+                      help="-- -m pytest ... (a python command line)")
+    args = p.parse_args(argv)
+
+    cmd = list(args.argv)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("give the python command after --, e.g. -- -m pytest tests -q")
+
+    repo = os.getcwd()
+    package_root = os.path.join(repo, args.package)
+    collector = Collector(package_root)
+    collector.start()
+    try:
+        rc = _run_python_argv(cmd)
+    finally:
+        collector.stop()
+
+    rep = report(collector, package_root)
+    print(f"coverage: {rep['pct']}% "
+          f"({rep['lines_hit']}/{rep['lines_executable']} lines of "
+          f"{args.package})")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if args.baseline:
+        if args.update_baseline or not os.path.exists(args.baseline):
+            with open(args.baseline, "w") as f:
+                json.dump({"pct": rep["pct"]}, f)
+                f.write("\n")
+            print(f"coverage: baseline written: {rep['pct']}%")
+        else:
+            with open(args.baseline) as f:
+                base = json.load(f)["pct"]
+            if rep["pct"] < base - args.tolerance:
+                print(
+                    f"coverage: REGRESSION: {rep['pct']}% < baseline "
+                    f"{base}% - {args.tolerance}",
+                    file=sys.stderr,
+                )
+                return 5
+            print(f"coverage: ok vs baseline {base}% "
+                  f"(tolerance {args.tolerance})")
+    return rc
+
+
+def _run_python_argv(cmd: list[str]) -> int:
+    """Execute ``-m module args...`` or ``script.py args...`` in-process so
+    the monitoring tool observes it."""
+    import runpy
+
+    if cmd[0] == "-m":
+        module, rest = cmd[1], cmd[2:]
+        old_argv = sys.argv
+        sys.argv = [module] + rest
+        try:
+            if module == "pytest":
+                import pytest
+
+                return pytest.main(rest)
+            runpy.run_module(module, run_name="__main__")
+            return 0
+        except SystemExit as e:
+            return int(e.code or 0)
+        finally:
+            sys.argv = old_argv
+    old_argv = sys.argv
+    sys.argv = cmd
+    try:
+        runpy.run_path(cmd[0], run_name="__main__")
+        return 0
+    except SystemExit as e:
+        return int(e.code or 0)
+    finally:
+        sys.argv = old_argv
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,7 @@ save/restore) is used only for the arrow-function parameter ambiguity."""
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from k8s_tpu.harness.minijs.lexer import Token, tokenize
 

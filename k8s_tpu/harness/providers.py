@@ -23,7 +23,6 @@ import logging
 import subprocess
 import time
 from dataclasses import dataclass, field
-from typing import Optional
 
 from k8s_tpu.harness import util as harness_util
 

@@ -33,13 +33,23 @@ def iter_py_files(src_dir: str):
 
 def _lint_one(path: str) -> str | None:
     """Return a failure message or None (the per-file pylint run,
-    py_checks.py:40-62)."""
+    py_checks.py:40-62).
+
+    Three layers: compile() for syntax, the in-tree AST/symtable linter
+    (harness.pylint_lite — undefined names, unused imports, mutable
+    defaults, bare except, ...), and pyflakes on top when the image has it.
+    """
     with open(path, "rb") as f:
         source = f.read()
     try:
         compile(source, path, "exec")
     except SyntaxError as e:
         return f"SyntaxError: {e}"
+    from k8s_tpu.harness import pylint_lite
+
+    findings = pylint_lite.check_file(path)
+    if findings:
+        return "\n".join(str(f) for f in findings)
     try:
         from pyflakes.api import check as pyflakes_check
         from pyflakes.reporter import Reporter

@@ -1,0 +1,259 @@
+"""AST + symtable lint — real defect detection without third-party deps.
+
+The reference ran full pylint with a tuned config over every file
+(py/py_checks.py:18, .pylintrc) plus gometalinter's analyzer set
+(linter_config.json:4-18).  This image ships neither pylint nor pyflakes,
+and round 3's fallback was a bare ``compile()`` — a syntax check in
+disguise.  This module implements the high-signal subset with near-zero
+false positives:
+
+- **undefined-name** (symtable): a name read in some scope that no scope
+  binds, the module never defines, and builtins don't provide — the classic
+  typo'd-identifier NameError that ``compile()`` happily accepts.
+- **unused-import**: module-level imports never referenced anywhere in the
+  file (and not re-exported via ``__all__``).
+- **mutable-default-arg**: ``def f(x=[])`` / ``{}`` / ``set()`` — shared
+  across calls.
+- **bare-except**: ``except:`` swallows KeyboardInterrupt/SystemExit.
+- **duplicate-dict-key**: a literal key repeated in a dict display.
+- **assert-tuple**: ``assert (cond, "msg")`` is always true.
+- **is-literal**: ``x is "s"`` / ``x is 3`` — identity on literals.
+
+``# noqa`` on a line suppresses its findings (optionally ``# noqa: CODE``).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import symtable
+
+_BUILTIN_NAMES = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__spec__", "__loader__",
+    "__package__", "__builtins__", "__debug__", "__annotations__",
+    "__path__", "__dict__", "__class__", "__module__", "__qualname__",
+    "WindowsError",
+}
+
+
+class Finding:
+    def __init__(self, code: str, lineno: int, message: str):
+        self.code = code
+        self.lineno = lineno
+        self.message = message
+
+    def __str__(self):
+        return f"{self.lineno}: {self.code}: {self.message}"
+
+
+# pyflakes/pycodestyle code aliases so existing ``# noqa: F401`` comments
+# keep working against this linter's named codes
+_NOQA_ALIASES = {
+    "unused-import": {"f401"},
+    "undefined-name": {"f821"},
+    "bare-except": {"e722"},
+    "duplicate-dict-key": {"f601", "f602"},
+}
+
+
+def _noqa_lines(source: str) -> dict[int, set[str] | None]:
+    """line -> None (blanket noqa) or set of codes."""
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        if "# noqa" not in line:
+            continue
+        _, _, tail = line.partition("# noqa")
+        tail = tail.strip()
+        if tail.startswith(":"):
+            # codes run until the first token that isn't a comma-separated
+            # identifier (trailing prose is tolerated)
+            codes = set()
+            for chunk in tail[1:].split(","):
+                tok = chunk.strip().split()
+                if not tok:
+                    continue
+                codes.add(tok[0].lower())
+            out[i] = codes
+        else:
+            out[i] = None
+    return out
+
+
+def _module_bindings(tree: ast.Module, table: symtable.SymbolTable) -> set[str]:
+    """Names the module scope binds (assignments, defs, imports) plus names
+    any nested scope declares ``global`` and assigns."""
+    bound = set()
+    for sym in table.get_symbols():
+        if sym.is_assigned() or sym.is_imported():
+            bound.add(sym.get_name())
+
+    class GlobalCollector(ast.NodeVisitor):
+        def visit_Global(self, node):
+            bound.update(node.names)
+
+    GlobalCollector().visit(tree)
+    return bound
+
+
+def _walk_scopes(table: symtable.SymbolTable):
+    stack = [table]
+    while stack:
+        t = stack.pop()
+        yield t
+        stack.extend(t.get_children())
+
+
+def _check_undefined(source: str, path: str, tree: ast.Module) -> list[Finding]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+                a.name == "*" for a in node.names):
+            return []  # star import: name set is unknowable statically
+    try:
+        table = symtable.symtable(source, path, "exec")
+    except (SyntaxError, ValueError):
+        return []
+    module_bound = _module_bindings(tree, table)
+
+    # map line numbers for Name loads so findings point somewhere useful
+    load_lines: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            load_lines.setdefault(node.id, node.lineno)
+
+    findings = []
+    reported = set()
+    for scope in _walk_scopes(table):
+        for sym in scope.get_symbols():
+            name = sym.get_name()
+            if name in reported or not sym.is_referenced():
+                continue
+            if sym.is_local() or sym.is_parameter() or sym.is_imported():
+                continue
+            if sym.is_free():
+                continue  # bound in an enclosing function scope
+            # remaining: global reads — must resolve at module level or in
+            # builtins
+            if name in module_bound or name in _BUILTIN_NAMES:
+                continue
+            reported.add(name)
+            findings.append(Finding(
+                "undefined-name", load_lines.get(name, 1),
+                f"undefined name {name!r}"))
+    return findings
+
+
+def _check_ast(tree: ast.Module, module_used: set[str],
+               dunder_all: set[str], is_init: bool) -> list[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set")
+                ):
+                    findings.append(Finding(
+                        "mutable-default", d.lineno,
+                        f"mutable default argument in {node.name}()"))
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding(
+                "bare-except", node.lineno,
+                "bare 'except:' also catches KeyboardInterrupt/SystemExit"))
+        elif isinstance(node, ast.Dict):
+            seen: dict = {}
+            for k in node.keys:
+                if isinstance(k, ast.Constant):
+                    try:
+                        if k.value in seen:
+                            findings.append(Finding(
+                                "duplicate-dict-key", k.lineno,
+                                f"duplicate dict key {k.value!r}"))
+                        seen[k.value] = True
+                    except TypeError:
+                        pass
+        elif isinstance(node, ast.Assert):
+            if isinstance(node.test, ast.Tuple) and node.test.elts:
+                findings.append(Finding(
+                    "assert-tuple", node.lineno,
+                    "assert on a non-empty tuple is always true"))
+        elif isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                # bools/None are singletons — identity is well-defined there
+                if isinstance(op, (ast.Is, ast.IsNot)) and isinstance(
+                        comp, ast.Constant) and isinstance(
+                        comp.value, (str, int, float, bytes, complex)
+                ) and not isinstance(comp.value, bool):
+                    findings.append(Finding(
+                        "is-literal", node.lineno,
+                        "identity comparison with a literal; use ==/!="))
+    # unused module-level imports (skipped in __init__.py: re-export files
+    # bind names precisely so CALLERS can import them)
+    if is_init:
+        return findings
+    for node in tree.body:
+        names: list[tuple[str, int]] = []
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                names.append((bound, node.lineno))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                names.append((a.asname or a.name, node.lineno))
+        for bound, lineno in names:
+            if bound not in module_used and bound not in dunder_all:
+                findings.append(Finding(
+                    "unused-import", lineno, f"{bound!r} imported but unused"))
+    return findings
+
+
+def check_source(source: str, path: str = "<string>") -> list[Finding]:
+    try:
+        tree = ast.parse(source, path)
+    except SyntaxError as e:
+        return [Finding("syntax-error", e.lineno or 1, str(e))]
+
+    module_used: set[str] = set()
+    dunder_all: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and not isinstance(node.ctx, ast.Store):
+            module_used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # base resolves through a Name node anyway
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    dunder_all.add(elt.value)
+
+    is_init = path.replace("\\", "/").endswith("__init__.py")
+    findings = _check_undefined(source, path, tree)
+    findings += _check_ast(tree, module_used, dunder_all, is_init)
+
+    noqa = _noqa_lines(source)
+    kept = []
+    for f in findings:
+        if f.lineno in noqa:
+            codes = noqa[f.lineno]
+            if codes is None or (
+                ({f.code.lower()} | _NOQA_ALIASES.get(f.code, set())) & codes
+            ):
+                continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.lineno, f.code))
+    return kept
+
+
+def check_file(path: str) -> list[Finding]:
+    with open(path, "rb") as f:
+        source = f.read().decode("utf-8", "replace")
+    return check_source(source, path)

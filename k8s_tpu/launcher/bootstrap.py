@@ -68,9 +68,25 @@ class LauncherConfig:
         return self.process_id == 0
 
 
+def apply_platform_env(env: Optional[dict] = None) -> None:
+    """Honor K8S_TPU_PLATFORM (e.g. "cpu") from the pod env.
+
+    This image's sitecustomize pins the axon TPU platform before env vars
+    apply, so CPU pods (e2e kubelet subprocesses, CPU-only node pools) need
+    the platform re-forced via jax.config after import — the operator can
+    inject this var like any other pod env."""
+    e = env if env is not None else os.environ
+    platform = e.get("K8S_TPU_PLATFORM", "")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
 def initialize_distributed(config: Optional[LauncherConfig] = None) -> LauncherConfig:
     """Idempotent jax.distributed bring-up from the operator env contract."""
     global _initialized
+    apply_platform_env()
     cfg = config or LauncherConfig.from_env()
     if not cfg.is_distributed:
         log.info("single-process job; skipping jax.distributed")

@@ -178,10 +178,14 @@ class TokenDataset:
         """Yield [seq_len] int32 windows; shuffle permutes the global window
         order each epoch.
 
-        Window bookkeeping is O(num_shards), not O(num_windows): a global
-        window index is decoded to (shard, offset) through a cumulative
-        count table, so a multi-hundred-GB corpus costs a few ints per
-        shard, and reads touch only the windows actually yielded.
+        Window bookkeeping is O(num_shards) (a global window index decodes
+        to (shard, offset) through a cumulative count table; no per-window
+        tuple list), and reads touch only the windows actually yielded.
+        With ``shuffle=True`` each epoch still materializes one
+        rng.permutation(num_windows) int64 array — O(num_windows) MEMORY
+        (~800 MB at 100M windows).  For corpora past that scale, plug a
+        block- or Feistel-style streaming shuffle in here; unshuffled
+        streams stay O(num_shards) end to end.
 
         ``reader``: "mmap" reads through numpy memory maps (page faults
         hold the GIL); "native" streams windows through the C++ loader
@@ -318,6 +322,18 @@ class BatchStream:
         if self._iter is not None:
             raise RuntimeError("skip() must be called before consumption")
         self._skip_windows += int(n_batches) * self._batch_size
+        # Bounded streams validate the jump target eagerly: silently
+        # skipping past the end would make iteration yield nothing and a
+        # resumed fit() "complete" zero steps, while the drain fallback
+        # raises for the same condition — the two paths must agree.
+        if self._epochs is not None:
+            total_windows = self._ds.num_sequences(self._seq_len) * self._epochs
+            usable = (total_windows // self._batch_size) * self._batch_size
+            if self._skip_windows >= usable and n_batches > 0:
+                raise ValueError(
+                    f"skip({n_batches}) jumps past the stream: "
+                    f"{usable // self._batch_size} batches available over "
+                    f"{self._epochs} epoch(s)")
 
     def __iter__(self):
         return self

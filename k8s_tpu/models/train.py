@@ -8,7 +8,6 @@ over the data axes, XLA inserting the gradient all-reduces.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Optional
 
 import jax

@@ -10,7 +10,7 @@ backend for token-shard windows.
 from __future__ import annotations
 
 import ctypes
-from typing import Iterable, Iterator, Optional, Sequence
+from typing import Iterable, Iterator, Sequence
 
 from k8s_tpu import native
 

@@ -28,8 +28,12 @@ class MetricsServer:
     """
 
     def __init__(self, port: int, registry: Optional[metrics_mod.Registry] = None,
-                 host: str = "0.0.0.0",
+                 host: str = "127.0.0.1",
                  health_fn: Optional[Callable[[], bool]] = None):
+        # Default bind is loopback: /metrics and /healthz are
+        # UNAUTHENTICATED, so exposing them is an explicit deployment
+        # decision (pass host="0.0.0.0" — the operator manifests do, inside
+        # the pod network, where the scrape must reach them).
         registry = registry or metrics_mod.REGISTRY
 
         class Handler(BaseHTTPRequestHandler):

@@ -7,8 +7,8 @@ import pytest
 from k8s_tpu.api import v1alpha2
 from k8s_tpu.api.meta import ObjectMeta
 from k8s_tpu.client import ApiError, Clientset, FakeCluster, errors
-from k8s_tpu.client.gvr import PODS, SERVICES, TFJOBS_V1ALPHA2
-from k8s_tpu.client.informer import Lister, SharedInformerFactory
+from k8s_tpu.client.gvr import PODS, SERVICES
+from k8s_tpu.client.informer import SharedInformerFactory
 
 
 def _pod(name, ns="default", labels=None, owner_uid=None):

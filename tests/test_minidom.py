@@ -4,7 +4,6 @@ interpreter relies on)."""
 
 from __future__ import annotations
 
-import pytest
 
 from k8s_tpu.harness.minidom import Browser
 

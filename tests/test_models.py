@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from k8s_tpu.models import train
 from k8s_tpu.models.mnist import MnistCNN, synthetic_batch

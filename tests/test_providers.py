@@ -15,7 +15,6 @@ import subprocess
 import pytest
 
 from k8s_tpu.harness import deploy
-from k8s_tpu.harness import providers
 from k8s_tpu.harness.providers import (
     GkeProvider,
     LocalProvider,

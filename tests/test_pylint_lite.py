@@ -1,0 +1,143 @@
+"""The in-tree linter must catch what compile() can't (VERDICT r3 #6's
+done-criterion), at zero false positives on the repo itself (enforced by
+the lint CI tier staying green)."""
+
+from k8s_tpu.harness import pylint_lite
+
+
+def _codes(source: str) -> list[str]:
+    return [f.code for f in pylint_lite.check_source(source, "t.py")]
+
+
+class TestSeededDefects:
+    def test_undefined_name_is_caught_but_compiles(self):
+        src = "def f():\n    return jsn.dumps({})\n"
+        compile(src, "t.py", "exec")  # the old 'lint' accepted this
+        assert "undefined-name" in _codes(src)
+
+    def test_typo_in_nested_scope(self):
+        src = ("def outer():\n"
+               "    total = 0\n"
+               "    def inner():\n"
+               "        return totl + 1\n"
+               "    return inner\n")
+        assert "undefined-name" in _codes(src)
+
+    def test_unused_import(self):
+        assert "unused-import" in _codes("import json\nx = 1\n")
+
+    def test_mutable_default(self):
+        assert "mutable-default" in _codes("def f(a, b=[]):\n    return b\n")
+
+    def test_bare_except(self):
+        assert "bare-except" in _codes(
+            "try:\n    pass\nexcept:\n    pass\n")
+
+    def test_duplicate_dict_key(self):
+        assert "duplicate-dict-key" in _codes('d = {"a": 1, "a": 2}\n')
+
+    def test_assert_tuple(self):
+        assert "assert-tuple" in _codes('assert (1, "msg")\n')
+
+    def test_is_literal(self):
+        assert "is-literal" in _codes('x = 1\ny = x is "s"\n')
+
+
+class TestNoFalsePositives:
+    def test_clean_module(self):
+        src = ("import json\n\n"
+               "def f(x=None):\n"
+               "    if x is None:\n"
+               "        x = []\n"
+               "    return json.dumps(x)\n")
+        assert _codes(src) == []
+
+    def test_free_variables_resolve(self):
+        src = ("def outer():\n"
+               "    total = 0\n"
+               "    def inner():\n"
+               "        return total + 1\n"
+               "    return inner()\n")
+        assert _codes(src) == []
+
+    def test_global_declared_elsewhere(self):
+        src = ("def setup():\n"
+               "    global CACHE\n"
+               "    CACHE = {}\n\n"
+               "def use():\n"
+               "    return CACHE\n")
+        assert "undefined-name" not in _codes(src)
+
+    def test_is_bool_and_none_allowed(self):
+        assert _codes("x = 1\ny = x is True\nz = x is None\n") == []
+
+    def test_class_attr_via_self_ok(self):
+        src = ("class A:\n"
+               "    X = 1\n"
+               "    def m(self):\n"
+               "        return self.X\n")
+        assert _codes(src) == []
+
+    def test_star_import_disables_undefined(self):
+        src = "from os.path import *\nx = join('a', 'b')\n"
+        assert "undefined-name" not in _codes(src)
+
+    def test_init_reexports_not_flagged(self):
+        findings = pylint_lite.check_source(
+            "from .mod import thing\n", "pkg/__init__.py")
+        assert [f.code for f in findings] == []
+
+    def test_dunder_all_counts_as_use(self):
+        src = 'from .mod import thing\n__all__ = ["thing"]\n'
+        assert "unused-import" not in _codes(src)
+
+    def test_noqa_blanket_and_coded(self):
+        assert _codes("import json  # noqa\n") == []
+        assert _codes("import json  # noqa: F401\n") == []
+        assert _codes("import json  # noqa: unused-import\n") == []
+        # an unrelated code does NOT suppress
+        assert _codes("import json  # noqa: E501\n") == ["unused-import"]
+
+    def test_annotations_count_as_use(self):
+        src = ("from typing import Optional\n\n"
+               "def f(x: Optional[int]) -> Optional[int]:\n"
+               "    return x\n")
+        assert "unused-import" not in _codes(src)
+
+
+class TestCoverageTool:
+    def test_executable_lines_and_report(self, tmp_path):
+        from k8s_tpu.harness import coverage as cov
+
+        p = tmp_path / "m.py"
+        p.write_text("def f():\n    return 1\n\n\nX = f()\n")
+        lines = cov.executable_lines(str(p))
+        assert 2 in lines and 5 in lines
+
+    def test_collector_counts_only_measured_root(self, tmp_path):
+        import subprocess
+        import sys
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "mod.py").write_text(
+            "def hit():\n    return 1\n\n"
+            "def missed():\n    return 2\n")
+        script = tmp_path / "use.py"
+        script.write_text("from pkg import mod\nprint(mod.hit())\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "k8s_tpu.harness.coverage", "run",
+             "--package", "pkg", "--out", str(tmp_path / "r.json"),
+             "--", str(script)],
+            capture_output=True, text=True, cwd=tmp_path,
+            env=dict(__import__("os").environ,
+                     PYTHONPATH=f"{tmp_path}:/root/repo"),
+            timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        import json
+
+        rep = json.load(open(tmp_path / "r.json"))
+        f = rep["files"]["pkg/mod.py"]
+        # hit() ran, missed() was only defined: 3 of 4 executable lines
+        assert f["executable"] == 4 and f["hit"] == 3

@@ -93,3 +93,40 @@ def test_rest_crd_group_path(server):
     assert "/apis/kubeflow.org/v1alpha2/namespaces/ns1/tfjobs/j1" in _Handler.store
     got = client.get(TFJOBS_V1ALPHA2, "ns1", "j1")
     assert got["kind"] == "TFJob"
+
+
+class _ChunkedHandler(BaseHTTPRequestHandler):
+    """A plain-HTTP server that chunks every response — the kubectl-proxy /
+    Go net/http shape the lean raw-socket parser cannot speak."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        body = json.dumps({"kind": "Pod", "metadata": {"name": "c1"}}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        self.wfile.write(b"%x\r\n%s\r\n0\r\n\r\n" % (len(body), body))
+
+
+def test_chunked_server_downgrades_lean_path():
+    """A Transfer-Encoding response must not fail the client: the lean
+    parser stands down (sticky) and http.client decodes chunked bodies."""
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _ChunkedHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        client = RestClient(
+            ClusterConfig(host=f"http://127.0.0.1:{srv.server_address[1]}"))
+        got = client.get(PODS, "ns1", "c1")
+        assert got["metadata"]["name"] == "c1"
+        assert client._lean_disabled is True
+        # and the downgraded client keeps working
+        got = client.get(PODS, "ns1", "c1")
+        assert got["metadata"]["name"] == "c1"
+    finally:
+        srv.shutdown()

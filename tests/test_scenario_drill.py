@@ -1,0 +1,369 @@
+"""The adversarial end-to-end drill (VERDICT r3 next-round #7/#8): every
+resilience mechanism exercised TOGETHER on the wire protocol, not in
+isolation.
+
+One REST apiserver; two full operator instances behind leader election; a
+watch-driven kubelet executing real subprocesses; then, concurrently:
+
+- a storm of gang jobs reconciling over HTTP;
+- a REAL training job (examples/train_lm, checkpointing to disk) whose pod
+  is deleted mid-run — the kubelet delivers SIGTERM with a grace window
+  (the real kubelet contract), train_lm's cooperative-preemption path saves
+  and exits 143, the operator's exit-code policy restarts, and the
+  replacement pod RESUMES from the checkpoint;
+- a chaos monkey deleting random managed pods;
+- the leading operator crashing without releasing its lease (SIGKILL
+  semantics) — the standby must wait out the lease and finish the drill.
+
+Done = every job converges, and the interrupted training run's final loss
+is IDENTICAL to an uninterrupted control run (checkpoint + data-stream
+resume are exact through the production path).
+
+Preemption realism (VERDICT #8) rides the same wire setup: node NotReady
+with a permanent-looking exit code must classify as preemption (gang
+restart), and the missing-node freshness window must keep a STALE failure
+permanent — both through watch/REST, not the fake.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import threading
+import time
+
+import pytest
+
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.gvr import NODES
+from k8s_tpu.client.rest import ClusterConfig, RestClient
+from k8s_tpu.controller_v2.controller import TFJobController
+from k8s_tpu.e2e.apiserver import ApiServer
+from k8s_tpu.e2e.kubelet import KubeletSimulator
+from k8s_tpu.util.leader_election import LeaderElectionConfig, LeaderElector
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NS = "drill"
+
+
+def _gang_job(name: str, replicas: int = 4, *, command=None, env=None,
+              restart_policy: str = "ExitCode",
+              node_name: str | None = None) -> dict:
+    spec: dict = {
+        "containers": [{
+            "name": "tensorflow",
+            "image": "k8s-tpu/drill:test",
+            "ports": [{"name": "tfjob-port", "containerPort": 2222}],
+        }]
+    }
+    if command:
+        spec["containers"][0]["command"] = command
+    if env:
+        spec["containers"][0]["env"] = [
+            {"name": k, "value": v} for k, v in env.items()]
+    if node_name:
+        spec["nodeName"] = node_name
+    return {
+        "apiVersion": "kubeflow.org/v1alpha2",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": replicas,
+                    "restartPolicy": restart_policy,
+                    "template": {"spec": spec},
+                }
+            }
+        },
+    }
+
+
+class _Candidate:
+    """One operator instance over its own REST client (as in
+    tests/test_leader_failover.py, but on the wire backend)."""
+
+    def __init__(self, url: str, identity: str, lease_duration: float = 1.5):
+        self.clientset = Clientset(RestClient(ClusterConfig(host=url)))
+        self.controller = TFJobController(self.clientset)
+        self.elector = LeaderElector(
+            self.clientset,
+            LeaderElectionConfig(
+                namespace="kube-system", name="tf-operator-v2",
+                identity=identity, lease_duration=lease_duration,
+                retry_period=0.05,
+            ),
+        )
+        self.leading = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"operator-{identity}")
+
+    def start(self) -> "_Candidate":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        def on_started_leading(stop_work):
+            self.leading.set()
+            self.controller.run(1, stop_event=stop_work)
+
+        self.elector.run_or_die(on_started_leading)
+
+    def crash(self) -> None:
+        """Stop renewing WITHOUT releasing the lease (SIGKILLed leader)."""
+        self.elector.stop()
+        self._thread.join(timeout=10)
+
+    def shutdown(self) -> None:
+        self.elector.stop()
+        self.controller.shutdown()
+        self._thread.join(timeout=10)
+
+
+def _job_condition(job: dict, ctype: str) -> bool:
+    for c in (job.get("status") or {}).get("conditions") or []:
+        if c.get("type") == ctype and c.get("status") == "True":
+            return True
+    return False
+
+
+FINAL_LOSS_RE = re.compile(r"final loss ([0-9.]+)")
+
+
+def _train_command(steps: int, data_dir: str) -> list[str]:
+    return [
+        sys.executable, os.path.join(REPO, "examples", "train_lm", "train_lm.py"),
+        "--preset", "tiny", "--train_steps", str(steps),
+        "--batch_size", "2", "--seq_len", "64",
+        "--checkpoint_every", "3", "--log_every", "1",
+        "--data_dir", data_dir,
+    ]
+
+
+@pytest.fixture(scope="module")
+def corpus_dir(tmp_path_factory):
+    from k8s_tpu.models.dataset import write_text_corpus
+
+    d = tmp_path_factory.mktemp("drill-corpus")
+    write_text_corpus(str(d), [bytes(range(256)) * 64] * 4)
+    return str(d)
+
+
+def test_adversarial_drill(tmp_path, corpus_dir):
+    n_storm = 50
+    steps = 12
+    ckpt_dir = tmp_path / "ckpt"
+    control_ckpt = tmp_path / "ckpt-control"
+
+    # -- control run first (no cluster): the uninterrupted loss trajectory
+    import subprocess
+
+    env = dict(os.environ, K8S_TPU_PLATFORM="cpu",
+               CHECKPOINT_DIR=str(control_ckpt),
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)  # single-device control, no virtual mesh
+    control = subprocess.run(
+        _train_command(steps, corpus_dir), env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=420)
+    assert control.returncode == 0, control.stdout + control.stderr
+    m = FINAL_LOSS_RE.search(control.stderr + control.stdout)
+    assert m, control.stdout + control.stderr
+    control_loss = m.group(1)
+
+    server = ApiServer(watch_timeout=60.0).start()
+    cs = Clientset(RestClient(ClusterConfig(host=server.url)))
+    kubelet = KubeletSimulator(
+        cs, NS, default_runtime_s=3.0, termination_grace_s=30.0,
+        env_transform=lambda pod, e: dict(
+            e, K8S_TPU_PLATFORM="cpu",
+            XLA_FLAGS="",  # pods are single-device; drop the virtual mesh
+        ),
+    )
+    a = _Candidate(server.url, "op-a").start()
+    b = _Candidate(server.url, "op-b").start()
+    kubelet.start()
+    monkey = None
+    try:
+        assert a.leading.wait(10) or b.leading.wait(10)
+
+        tfjobs = cs.tfjobs_unstructured(NS)
+        # Trainer first: on this 1-core box its first checkpoint costs a
+        # jax import + compile (~1 min); reaching it before the storm makes
+        # the drill deterministic.  Everything adversarial — storm, chaos,
+        # targeted preemption, leader kill — happens while it is still
+        # TRAINING, so the resume must survive the full circus.
+        tfjobs.create(_gang_job(
+            "trainer", replicas=1, command=_train_command(steps, corpus_dir),
+            env={"CHECKPOINT_DIR": str(ckpt_dir)},
+            restart_policy="ExitCode",
+        ))
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if ckpt_dir.exists() and any(ckpt_dir.iterdir()):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("trainer never wrote a checkpoint")
+
+        for i in range(n_storm):
+            tfjobs.create(_gang_job(f"storm-{i}", replicas=4,
+                                    restart_policy="Never"))
+
+        # chaos storm against the namespace while everything reconciles.
+        # The trainer is excluded from RANDOM kills because this drill
+        # preempts it deterministically below — a random re-kill during its
+        # restart's compile window would just re-test the same path slower.
+        from k8s_tpu.e2e.chaos import ChaosMonkey, is_managed_pod
+
+        def spare_trainer(pod: dict) -> bool:
+            if pod["metadata"]["name"].startswith("drill-trainer"):
+                return False
+            return is_managed_pod(pod)
+
+        monkey = ChaosMonkey(cs, NS, level=2, interval_s=0.5,
+                             victim_filter=spare_trainer).start()
+
+        # preempt the trainer pod: DELETE → kubelet SIGTERM + grace →
+        # cooperative save → exit 143 → operator recreates → resume
+        pods = cs.pods(NS).list()
+        trainer_pods = [p for p in pods
+                        if p["metadata"]["name"].startswith("drill-trainer")]
+        assert trainer_pods, [p["metadata"]["name"] for p in pods]
+        cs.pods(NS).delete(trainer_pods[0]["metadata"]["name"])
+
+        # crash whichever operator leads, mid-storm
+        leader, standby = (a, b) if a.leading.is_set() else (b, a)
+        leader.crash()
+
+        # everything must still converge under the standby
+        deadline = time.time() + 360
+        done_storm = set()
+        trainer_done = False
+        while time.time() < deadline and not (
+                len(done_storm) == n_storm and trainer_done):
+            for i in range(n_storm):
+                if i in done_storm:
+                    continue
+                job = tfjobs.get(f"storm-{i}")
+                if _job_condition(job, "Succeeded"):
+                    done_storm.add(i)
+            trainer_done = _job_condition(tfjobs.get("trainer"), "Succeeded")
+            time.sleep(0.5)
+        assert standby.leading.wait(5), "standby never took the lease"
+        assert len(done_storm) == n_storm, (
+            f"only {len(done_storm)}/{n_storm} storm jobs converged")
+        assert trainer_done, tfjobs.get("trainer").get("status")
+
+        # loss-identical resume THROUGH the cluster: the resumed trainer's
+        # final loss equals the uninterrupted control bit-for-bit
+        logs = [
+            ((p.get("status") or {}).get("log") or "")
+            for p in cs.pods(NS).list()
+            if p["metadata"]["name"].startswith("drill-trainer")
+        ]
+        final = [m.group(1) for log_text in logs
+                 for m in [FINAL_LOSS_RE.search(log_text)] if m]
+        assert final, f"no final-loss line in trainer logs: {logs}"
+        assert final[-1] == control_loss, (
+            f"resumed loss {final[-1]} != control {control_loss}")
+    finally:
+        if monkey is not None:
+            monkey.stop()
+        kubelet.stop()
+        for cand in (a, b):
+            cand.shutdown()
+        server.stop()
+
+
+def test_node_preemption_freshness_over_wire():
+    """Node NotReady + permanent-looking exit code → preemption (restart);
+    missing node + STALE failure → stays permanent.  Both classified by the
+    operator over watch/REST, mirroring pkg/util/train semantics + the
+    round-3 freshness window — previously only unit-tested on the fake."""
+    import datetime
+
+    server = ApiServer(watch_timeout=60.0).start()
+    cs = Clientset(RestClient(ClusterConfig(host=server.url)))
+    op = _Candidate(server.url, "op-n").start()
+    try:
+        assert op.leading.wait(10)
+        nodes = cs.backend
+        nodes.create(NODES, "", {
+            "metadata": {"name": "drill-node"},
+            "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+        })
+        tfjobs = cs.tfjobs_unstructured(NS)
+        tfjobs.create(_gang_job("preempt-me", replicas=2,
+                                node_name="drill-node"))
+
+        # wait for pods, then flip the node NotReady and fail one pod with
+        # a PERMANENT-looking code (1): node evidence must win → restart
+        deadline = time.time() + 60
+        pods = []
+        while time.time() < deadline and len(pods) < 2:
+            pods = [p for p in cs.pods(NS).list()
+                    if p["metadata"]["name"].startswith("drill-preempt-me")]
+            time.sleep(0.2)
+        assert len(pods) == 2
+        nodes.update(NODES, "", {
+            "metadata": {"name": "drill-node"},
+            "status": {"conditions": [{"type": "Ready", "status": "False"}]},
+        })
+        now_iso = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ")
+        cs.pods(NS).patch(pods[0]["metadata"]["name"], {"status": {
+            "phase": "Failed",
+            "containerStatuses": [{
+                "name": "tensorflow",
+                "state": {"terminated": {"exitCode": 1,
+                                         "finishedAt": now_iso}},
+            }],
+        }})
+        # preemption → whole-gang restart, job must NOT go terminal Failed;
+        # the dead pod is replaced and the job returns to active
+        deadline = time.time() + 60
+        restarted = False
+        while time.time() < deadline and not restarted:
+            job = tfjobs.get("preempt-me")
+            if _job_condition(job, "Failed"):
+                pytest.fail(f"preemption misread as permanent: {job['status']}")
+            live = [p for p in cs.pods(NS).list()
+                    if p["metadata"]["name"].startswith("drill-preempt-me")
+                    and (p.get("status") or {}).get("phase") != "Failed"]
+            restarted = len(live) >= 2
+            time.sleep(0.2)
+        assert restarted, "gang was not restarted after node preemption"
+
+        # stale-failure control: node GONE + failure dated past the
+        # freshness window → exit-code verdict stands → job Failed
+        nodes.delete(NODES, "", "drill-node")
+        tfjobs.create(_gang_job("stale-fail", replicas=2,
+                                node_name="drill-node"))
+        deadline = time.time() + 60
+        pods = []
+        while time.time() < deadline and len(pods) < 2:
+            pods = [p for p in cs.pods(NS).list()
+                    if p["metadata"]["name"].startswith("drill-stale-fail")]
+            time.sleep(0.2)
+        stale_iso = (datetime.datetime.now(datetime.timezone.utc)
+                     - datetime.timedelta(hours=2)).strftime(
+                         "%Y-%m-%dT%H:%M:%SZ")
+        cs.pods(NS).patch(pods[0]["metadata"]["name"], {"status": {
+            "phase": "Failed",
+            "containerStatuses": [{
+                "name": "tensorflow",
+                "state": {"terminated": {"exitCode": 1,
+                                         "finishedAt": stale_iso}},
+            }],
+        }})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if _job_condition(tfjobs.get("stale-fail"), "Failed"):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("stale failure was not classified permanent")
+    finally:
+        op.shutdown()
+        server.stop()

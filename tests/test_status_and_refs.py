@@ -2,7 +2,6 @@
 controller_status.go semantics, service_ref_manager_test.go:26 matrices)."""
 
 from k8s_tpu.api import v1alpha2
-from k8s_tpu.api.meta import ObjectMeta
 from k8s_tpu.controller_v2 import status as status_mod
 from k8s_tpu.controller_v2.control import FakePodControl, FakeServiceControl
 from k8s_tpu.controller_v2.ref_manager import (

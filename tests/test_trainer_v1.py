@@ -3,7 +3,6 @@ replicas_test.go)."""
 
 import json
 
-import pytest
 
 from k8s_tpu.api import v1alpha1
 from k8s_tpu.api.meta import ObjectMeta
