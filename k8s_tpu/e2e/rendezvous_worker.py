@@ -137,19 +137,60 @@ def main() -> int:
 
     batch_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
     n_local = jax.local_device_count()
-    rng = np.random.default_rng(1234 + cfg.process_id)
-    local_tokens = rng.integers(
-        0, tcfg.vocab_size, (n_local, SEQ)).astype(np.int32)
-    tokens = jax.make_array_from_process_local_data(
-        batch_sharding, local_tokens)
+    total_steps = int(os.environ.get("K8S_TPU_E2E_STEPS", "1"))
+    ckpt_every = int(os.environ.get("K8S_TPU_E2E_CKPT_EVERY", "0"))
+    process_id = cfg.process_id
 
-    state, loss = step(state, (tokens, tokens))
-    loss = float(loss)
-    assert np.isfinite(loss), loss
+    class _Batches:
+        """Deterministic per-(process, step) stream with fit's skip()
+        resume contract; a resumed run replays exactly what an
+        uninterrupted run would have seen.  Failure injection lives in
+        __next__: serving batch j means j steps completed and step j-1's
+        checkpoint committed — the same post-save boundary the gang
+        preemption scenarios target."""
+
+        def __init__(self):
+            self.i = 0
+
+        def skip(self, n: int) -> None:
+            self.i += n
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            _maybe_fail(f"step_{self.i}", process_id)
+            rng = np.random.default_rng(1234 + process_id * 1000 + self.i)
+            local_tokens = rng.integers(
+                0, tcfg.vocab_size, (n_local, SEQ)).astype(np.int32)
+            self.i += 1
+            t = jax.make_array_from_process_local_data(
+                batch_sharding, local_tokens)
+            return (t, t)
+
+    # Checkpoint/resume through the PRODUCTION fit() loop (orbax-backed,
+    # sharding-aware): after a gang restart each process restores its own
+    # shards via the operator-injected CHECKPOINT_DIR — executed here with
+    # a real multi-process world, not a virtual mesh.
+    result_fit = train_lib.fit(
+        model.apply, train_lib.lm_loss, optimizer, state, mesh, _Batches(),
+        steps=total_steps,
+        checkpoint_dir=cfg.checkpoint_dir if ckpt_every else "",
+        checkpoint_every=ckpt_every or 1,
+        log_every=0,
+        step_fn=step,
+        state_shardings=shardings,
+    )
+    _maybe_fail(f"step_{total_steps}", process_id)
+
+    loss = float(result_fit.losses[-1]) if result_fit.losses else None
+    if loss is not None:
+        assert np.isfinite(loss), loss
+    state = result_fit.state
     step_no = int(jax.device_get(
         jax.jit(lambda s: s["step"],
                 out_shardings=NamedSharding(mesh, P()))(state)))
-    assert step_no == 1
+    assert step_no == total_steps, (step_no, total_steps)
 
     result = {
         "process_id": cfg.process_id,
@@ -161,6 +202,7 @@ def main() -> int:
         "membership_sum": total,
         "loss": loss,
         "step": step_no,
+        "start_step": result_fit.start_step,
     }
     print("RDZV_OK " + json.dumps(result, sort_keys=True), flush=True)
     return 0
