@@ -739,8 +739,11 @@ def main() -> int:
             # during the control run would emit a full-looking line and the
             # flash-speedup A/B would silently vanish from the round
             missing.append("transformer_xla_control")
-        if missing and "resnet50" in missing and "transformer" in missing:
-            return -1
+        requested = [n for n, wanted in (("resnet50", want_resnet),
+                                         ("transformer", want_transformer))
+                     if wanted]
+        if missing and all(n in missing for n in requested):
+            return -1  # nothing at all to show (single-benchmark runs too)
         if missing:
             out["partial"] = True
             out["missing"] = missing

@@ -132,7 +132,14 @@ class AsyncEventRecorder(EventRecorder):
             if self._closed:
                 return drained
             self._closed = True
-        self._q.put(None)  # sentinel: _sink exits
+        try:
+            # never block here: if flush timed out with the queue still
+            # full (sink wedged on a dead apiserver), a blocking put would
+            # hang shutdown indefinitely; the sink is a daemon thread and
+            # event() drops everything once _closed is set
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
         self._thread.join(timeout=5)
         return drained
 

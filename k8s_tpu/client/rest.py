@@ -123,10 +123,6 @@ def get_cluster_config() -> ClusterConfig:
         return kubeconfig_config()
 
 
-class _ChunkedResponse(ConnectionError):
-    """Server answered with Transfer-Encoding; lean parser must stand down."""
-
-
 class _RestWatch:
     """Streaming watch: iterates (type, object) from a chunked response.
 
@@ -231,8 +227,6 @@ class RestClient:
         self._static_hdr = f"Host: {self._netloc}\r\nAccept: application/json\r\n"
         if self.config.token:
             self._static_hdr += f"Authorization: Bearer {self.config.token}\r\n"
-        # flips when the server turns out to chunk responses (→ http.client)
-        self._lean_disabled = False
 
     def _new_conn(self, timeout):
         import http.client
@@ -317,6 +311,7 @@ class RestClient:
         status = int(parts[1])
         reason = parts[2].strip().decode("latin-1") if len(parts) > 2 else ""
         clen = 0
+        chunked = False
         # HTTP/1.0 servers close after each response unless they opt into
         # keep-alive explicitly; 1.1 is persistent unless told otherwise
         close = parts[0] == b"HTTP/1.0"
@@ -337,14 +332,43 @@ class RestClient:
                 elif b"keep-alive" in v:
                     close = False
             elif kl == b"transfer-encoding":
-                # e.g. kubectl proxy / Go servers chunking large lists —
-                # the caller downgrades this client to http.client, which
-                # decodes chunked transparently
-                raise _ChunkedResponse("server sent transfer-encoding")
-        body = rfile.read(clen) if clen else b""
+                # kubectl proxy / Go servers chunk large list responses.
+                # Decode it HERE: by this point the server has already
+                # processed the request, so bailing out and re-sending
+                # through another transport would double-execute writes.
+                chunked = b"chunked" in value.lower()
+        if chunked:
+            body = self._read_chunked(rfile)
+        else:
+            body = rfile.read(clen) if clen else b""
         if close:
             self._drop_sock()
         return status, reason, body
+
+    @staticmethod
+    def _read_chunked(rfile) -> bytes:
+        """RFC 7230 §4.1 chunked body (trailers tolerated and discarded)."""
+        out = []
+        while True:
+            size_line = rfile.readline(65537)
+            if not size_line:
+                raise ConnectionError("eof inside chunked body")
+            try:
+                size = int(size_line.split(b";", 1)[0].strip(), 16)
+            except ValueError:
+                raise ConnectionError(
+                    f"bad chunk size line {size_line[:40]!r}") from None
+            if size == 0:
+                while True:  # trailer section ends at a blank line
+                    t = rfile.readline(65537)
+                    if t in (b"\r\n", b"\n", b""):
+                        break
+                return b"".join(out)
+            chunk = rfile.read(size)
+            if len(chunk) != size:
+                raise ConnectionError("eof inside chunk")
+            out.append(chunk)
+            rfile.read(2)  # trailing CRLF
 
     def _pooled_conn(self):
         import time as time_mod
@@ -419,33 +443,21 @@ class RestClient:
         # double-execute on resend (spurious 409s, lost-update PUTs).
         attempts = (0, 1) if method in ("GET", "HEAD") else (0,)
 
-        if self._scheme == "http" and not self._lean_disabled:
+        if self._scheme == "http":
             # lean raw-socket path (TLS stays on http.client below)
-            try:
-                for attempt in attempts:
-                    try:
-                        status, reason, raw = self._lean_unary(
-                            method, path, data,
-                            headers.get("Content-Type", ""))
-                        break
-                    except _ChunkedResponse:
+            for attempt in attempts:
+                try:
+                    status, reason, raw = self._lean_unary(
+                        method, path, data, headers.get("Content-Type", ""))
+                    break
+                except (ConnectionError, OSError, ValueError):
+                    self._drop_sock()
+                    if attempt == attempts[-1]:
                         raise
-                    except (ConnectionError, OSError, ValueError):
-                        self._drop_sock()
-                        if attempt == attempts[-1]:
-                            raise
-                if status >= 400:
-                    raise self._api_error_from(status, reason, raw)
-                payload = raw.decode()
-                return json.loads(payload) if payload else {}
-            except _ChunkedResponse:
-                # This server chunks responses; the lean parser only speaks
-                # Content-Length.  Downgrade the CLIENT (sticky) and fall
-                # through to http.client, which handles chunked natively.
-                # The in-flight response was consumed only through its
-                # headers — the connection is dirty, so drop it.
-                self._lean_disabled = True
-                self._drop_sock()
+            if status >= 400:
+                raise self._api_error_from(status, reason, raw)
+            payload = raw.decode()
+            return json.loads(payload) if payload else {}
 
         import http.client
 

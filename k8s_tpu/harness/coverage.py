@@ -28,7 +28,7 @@ import json
 import os
 import sys
 
-TOOL_ID = 3  # sys.monitoring.COVERAGE_ID
+TOOL_ID = 3  # a free slot (sys.monitoring reserves 0-5 for tools)
 
 
 class Collector:

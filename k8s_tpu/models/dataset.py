@@ -329,7 +329,10 @@ class BatchStream:
         if self._epochs is not None:
             total_windows = self._ds.num_sequences(self._seq_len) * self._epochs
             usable = (total_windows // self._batch_size) * self._batch_size
-            if self._skip_windows >= usable and n_batches > 0:
+            # strictly greater: skipping EXACTLY to the end is the
+            # completed-run resume (fit's documented no-op path), matching
+            # the drain fallback, which only fails when a next() is missing
+            if self._skip_windows > usable:
                 raise ValueError(
                     f"skip({n_batches}) jumps past the stream: "
                     f"{usable // self._batch_size} batches available over "

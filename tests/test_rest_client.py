@@ -113,9 +113,11 @@ class _ChunkedHandler(BaseHTTPRequestHandler):
         self.wfile.write(b"%x\r\n%s\r\n0\r\n\r\n" % (len(body), body))
 
 
-def test_chunked_server_downgrades_lean_path():
-    """A Transfer-Encoding response must not fail the client: the lean
-    parser stands down (sticky) and http.client decodes chunked bodies."""
+def test_chunked_server_is_decoded_in_place():
+    """A Transfer-Encoding response must not fail the client — and must
+    NOT be handled by re-sending through another transport (the server
+    already executed the request; a re-send would double-execute writes).
+    The lean parser decodes chunked bodies itself, keep-alive intact."""
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _ChunkedHandler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -124,8 +126,6 @@ def test_chunked_server_downgrades_lean_path():
             ClusterConfig(host=f"http://127.0.0.1:{srv.server_address[1]}"))
         got = client.get(PODS, "ns1", "c1")
         assert got["metadata"]["name"] == "c1"
-        assert client._lean_disabled is True
-        # and the downgraded client keeps working
         got = client.get(PODS, "ns1", "c1")
         assert got["metadata"]["name"] == "c1"
     finally:

@@ -52,7 +52,7 @@ def log(msg: str) -> None:
 # because a last-good record from round 3's shapes already exists the
 # moment the first A/B lands.
 def build_plan() -> list[dict]:
-    bench = os.path.join(REPO, "bench.py")
+    bench_py = os.path.join(REPO, "bench.py")
     sweep = os.path.join(REPO, "tools", "sweep_bench.py")
     # Timeout coordination: each bench item's BENCH_TOTAL_TIMEOUT sits below
     # the subprocess kill so bench's watchdog gets to emit its diagnostic +
@@ -65,14 +65,14 @@ def build_plan() -> list[dict]:
                   "--timeout", "1000"],
          "env": {}, "timeout": 2400},
         {"label": "fused_ce_on",
-         "argv": [PY, bench],
+         "argv": [PY, bench_py],
          "env": {"BENCH_ONLY": "transformer", "BENCH_FUSED_CE": "1",
                  "BENCH_NO_CONTROL": "1", "BENCH_REPEATS": "3",
                  "BENCH_NO_PERSIST": "1", "BENCH_TOTAL_TIMEOUT": "1380",
                  "BENCH_PREFLIGHT_WINDOW": "60"},
          "timeout": 1500},
         {"label": "fused_ce_off",
-         "argv": [PY, bench],
+         "argv": [PY, bench_py],
          "env": {"BENCH_ONLY": "transformer", "BENCH_NO_CONTROL": "1",
                  "BENCH_REPEATS": "3", "BENCH_NO_PERSIST": "1",
                  "BENCH_TOTAL_TIMEOUT": "1380",
@@ -83,7 +83,7 @@ def build_plan() -> list[dict]:
                   "--timeout", "650"],
          "env": {}, "timeout": 3600},
         {"label": "full_bench",
-         "argv": [PY, bench],
+         "argv": [PY, bench_py],
          "env": {"BENCH_PREFLIGHT_WINDOW": "120",
                  "BENCH_TOTAL_TIMEOUT": "2550"},
          "timeout": 2700},
