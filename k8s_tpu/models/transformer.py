@@ -43,6 +43,12 @@ class TransformerConfig:
     # all-to-alls seq<->head shards (two collectives per layer, needs
     # heads % sp == 0) — parallel/ulysses.py
     sp_strategy: str = "ring"
+    # ring K/V placement: "contiguous" | "zigzag" (causal load balancing —
+    # rank r owns blocks (r, 2sp-1-r) so every ring step costs every rank
+    # one chunk of flash work; parallel/ring_flash.py).  Zigzag needs the
+    # flash ring (use_flash_attention) and an even sp; it silently falls
+    # back to contiguous on an odd ring.
+    ring_layout: str = "contiguous"
     use_flash_attention: bool = False  # Pallas fused kernel (k8s_tpu.ops)
     # flash kernel tile sizes (None -> kernel defaults); sweepable per
     # device generation without touching the kernel
@@ -182,6 +188,7 @@ class Attention(nn.Module):
                     mesh, q, k, v, causal=cfg.causal,
                     block_q=cfg.flash_block_q or DEFAULT_BLOCK_Q,
                     block_k=cfg.flash_block_k or DEFAULT_BLOCK_K,
+                    layout=cfg.ring_layout if cfg.causal else "contiguous",
                 )
             else:
                 from k8s_tpu.parallel.ring_attention import ring_attention

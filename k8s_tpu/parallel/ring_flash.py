@@ -167,18 +167,210 @@ def _make_ring_flash(axis_name: str, causal: bool, scale: float,
     return ring
 
 
+# -- zigzag (load-balanced) layout -------------------------------------------
+#
+# With contiguous chunks, causal ring attention is pathologically unbalanced:
+# rank 0 computes one diagonal block and then SKIPs sp-1 ring steps while
+# rank sp-1 computes on every step — the ring's critical path is the last
+# rank's full column, ~2x the mean work.  Zigzag placement fixes it: the
+# sequence is cut into 2*sp half-chunks and rank r owns the PAIR
+# (r, 2sp-1-r), one early and one late block.  Every ring step then costs
+# every rank exactly one chunk-equivalent of flash work:
+#
+#   step s, incoming pair from rank j=(r-s)%sp:
+#     j == r: the own pair — plain causal over [low;high] (low precedes high
+#             globally, so the concatenated causal mask is exactly right);
+#     j <  r: both my blocks attend j's LOW block fully (q_all x k_low);
+#     j >  r: only my HIGH block attends, but fully, to BOTH of j's blocks
+#             (q_high x k_all) — same FLOPs as the j < r case.
+#
+# The exchange between the model's contiguous layout and zigzag ownership is
+# two ppermutes of half-chunks each way, hidden inside the shard_map so the
+# public API semantics are unchanged.  (Zigzag composition as in the public
+# context-parallel literature — e.g. the zigzag ring-flash variants around
+# Ring Attention, PAPERS.md — re-expressed with this repo's kernels.)
+
+_Z_DIAG, _Z_LOW, _Z_HIGH = 0, 1, 2
+
+
+def _zigzag_perms(sp: int):
+    p1 = [(r, 2 * r if 2 * r < sp else 2 * sp - 1 - 2 * r)
+          for r in range(sp)]
+    p2 = [(r, 2 * r + 1 if 2 * r + 1 < sp else 2 * sp - 2 - 2 * r)
+          for r in range(sp)]
+    return p1, p2
+
+
+def _zigzag_to(x, axis_name: str):
+    """Contiguous halves (2r, 2r+1) -> zigzag pair (r, 2sp-1-r); split on
+    axis 2 (the local sequence axis in kernel layout)."""
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    h0, h1 = jnp.split(x, 2, axis=2)
+    p1, p2 = _zigzag_perms(sp)
+    a = lax.ppermute(h0, axis_name, p1)
+    b = lax.ppermute(h1, axis_name, p2)
+    even = (my % 2) == 0  # via p1 even ranks receive their LOW, odd their HIGH
+    low = jnp.where(even, a, b)
+    high = jnp.where(even, b, a)
+    return jnp.concatenate([low, high], axis=2)
+
+
+def _zigzag_from(x, axis_name: str):
+    """Inverse of _zigzag_to."""
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    low, high = jnp.split(x, 2, axis=2)
+    p1, p2 = _zigzag_perms(sp)
+    inv1 = [(d, s) for (s, d) in p1]
+    inv2 = [(d, s) for (s, d) in p2]
+    even = (my % 2) == 0
+    send1 = jnp.where(even, low, high)  # what arrived via p1 returns via inv1
+    send2 = jnp.where(even, high, low)
+    h0 = lax.ppermute(send1, axis_name, inv1)
+    h1 = lax.ppermute(send2, axis_name, inv2)
+    return jnp.concatenate([h0, h1], axis=2)
+
+
+@lru_cache(maxsize=None)
+def _make_ring_flash_zigzag(axis_name: str, scale: float,
+                            block_q: int, block_k: int, interpret: bool):
+    """Causal-only load-balanced variant; external layout stays contiguous."""
+
+    def zz_relation(my_idx, j):
+        return jnp.where(j == my_idx, _Z_DIAG,
+                         jnp.where(j < my_idx, _Z_LOW, _Z_HIGH)
+                         ).astype(jnp.int32)
+
+    def fwd_core(q, k, v):
+        """q,k,v: ZIGZAG-layout [B,H,Lc,D] shards; returns zigzag (o, lse)."""
+        B, H, Lc, D = q.shape
+        half = Lc // 2
+        sp = lax.axis_size(axis_name)
+        my_idx = lax.axis_index(axis_name)
+
+        def flash(causal_flag, q_, k_, v_):
+            o_s, lse_s = _flash_fwd(q_, k_, v_, scale, causal_flag,
+                                    block_q, block_k, interpret)
+            return o_s.astype(jnp.float32), lse_s[..., 0]
+
+        def br_diag(kc, vc):
+            return flash(True, q, kc, vc)
+
+        def br_low(kc, vc):
+            return flash(False, q, kc[:, :, :half], vc[:, :, :half])
+
+        def br_high(kc, vc):
+            o_h, lse_h = flash(False, q[:, :, half:], kc, vc)
+            o_s = jnp.concatenate(
+                [jnp.zeros((B, H, half, D), jnp.float32), o_h], axis=2)
+            lse_s = jnp.concatenate(
+                [jnp.full((B, H, half), NEG_INF, jnp.float32), lse_h], axis=2)
+            return o_s, lse_s
+
+        def step(s, carry):
+            o, lse, k_cur, v_cur = carry
+            j = (my_idx - s) % sp
+            o_s, lse_s = lax.switch(
+                zz_relation(my_idx, j), [br_diag, br_low, br_high],
+                k_cur, v_cur)
+            o, lse = _merge(o, lse, o_s, lse_s)
+            return o, lse, ring_shift(k_cur, axis_name), \
+                ring_shift(v_cur, axis_name)
+
+        o0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+        lse0 = jnp.full((B, H, Lc), NEG_INF, jnp.float32)
+        o, lse, _, _ = lax.fori_loop(0, sp, step, (o0, lse0, k, v))
+        return o.astype(q.dtype), lse[..., None]
+
+    def fwd_pass(q, k, v):
+        qz = _zigzag_to(q, axis_name)
+        kz = _zigzag_to(k, axis_name)
+        vz = _zigzag_to(v, axis_name)
+        oz, lsez = fwd_core(qz, kz, vz)
+        return _zigzag_from(oz, axis_name), (qz, kz, vz, oz, lsez)
+
+    def ring_bwd(res, do):
+        qz, kz, vz, oz, lsez = res
+        do = _zigzag_to(do, axis_name)
+        B, H, Lc, D = qz.shape
+        half = Lc // 2
+        sp = lax.axis_size(axis_name)
+        my_idx = lax.axis_index(axis_name)
+
+        dq0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+        dkv0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+
+        def bwd_diag(kc, vc):
+            dq_s, dk_s, dv_s = _flash_bwd(
+                qz, kc.astype(qz.dtype), vc.astype(qz.dtype), oz, lsez, do,
+                scale, True, block_q, block_k, interpret)
+            return (dq_s.astype(jnp.float32), dk_s.astype(jnp.float32),
+                    dv_s.astype(jnp.float32))
+
+        def bwd_low(kc, vc):
+            dq_s, dk_h, dv_h = _flash_bwd(
+                qz, kc[:, :, :half].astype(qz.dtype),
+                vc[:, :, :half].astype(qz.dtype), oz, lsez, do,
+                scale, False, block_q, block_k, interpret)
+            pad = jnp.zeros((B, H, half, D), jnp.float32)
+            return (dq_s.astype(jnp.float32),
+                    jnp.concatenate([dk_h.astype(jnp.float32), pad], axis=2),
+                    jnp.concatenate([dv_h.astype(jnp.float32), pad], axis=2))
+
+        def bwd_high(kc, vc):
+            dq_h, dk_s, dv_s = _flash_bwd(
+                qz[:, :, half:], kc.astype(qz.dtype), vc.astype(qz.dtype),
+                oz[:, :, half:], lsez[:, :, half:], do[:, :, half:],
+                scale, False, block_q, block_k, interpret)
+            pad = jnp.zeros((B, H, half, D), jnp.float32)
+            return (jnp.concatenate([pad, dq_h.astype(jnp.float32)], axis=2),
+                    dk_s.astype(jnp.float32), dv_s.astype(jnp.float32))
+
+        def step(s, carry):
+            dq, k_cur, v_cur, dk_cur, dv_cur = carry
+            j = (my_idx - s) % sp
+            dq_s, dk_s, dv_s = lax.switch(
+                zz_relation(my_idx, j), [bwd_diag, bwd_low, bwd_high],
+                k_cur, v_cur)
+            dq = dq + dq_s
+            dk_cur = dk_cur + dk_s
+            dv_cur = dv_cur + dv_s
+            return (dq, ring_shift(k_cur, axis_name),
+                    ring_shift(v_cur, axis_name),
+                    ring_shift(dk_cur, axis_name),
+                    ring_shift(dv_cur, axis_name))
+
+        dq, _, _, dk, dv = lax.fori_loop(
+            0, sp, step, (dq0, kz, vz, dkv0, dkv0))
+        return (_zigzag_from(dq.astype(qz.dtype), axis_name),
+                _zigzag_from(dk.astype(qz.dtype), axis_name),
+                _zigzag_from(dv.astype(qz.dtype), axis_name))
+
+    ring = jax.custom_vjp(lambda q, k, v: fwd_pass(q, k, v)[0])
+    ring.defvjp(fwd_pass, ring_bwd)
+    return ring
+
+
 def ring_flash_attention_local(q, k, v, *, axis_name: str = "sp",
                                causal: bool = True,
                                scale: float | None = None,
                                block_q: int = DEFAULT_BLOCK_Q,
                                block_k: int = DEFAULT_BLOCK_K,
-                               interpret: bool | None = None):
+                               interpret: bool | None = None,
+                               layout: str = "contiguous"):
     """Per-shard ring flash attention body; call under shard_map with
     Q/K/V sequence-sharded over ``axis_name``.
 
     q, k, v: [B, chunk, H, D] local shards (same convention as
     ring_attention_local).  Hkv must equal H (repeat grouped-query KV heads
     before sharding).  Returns [B, chunk, H, D] in q.dtype.
+
+    ``layout="zigzag"`` (causal only, even sp, even per-rank chunk)
+    load-balances the causal ring: every rank computes one chunk-equivalent
+    of flash work per ring step instead of rank i skipping sp-1-i steps —
+    the critical path drops ~2x at large sp.  External semantics are
+    unchanged (contiguous in, contiguous out).
     """
     B, Lc, H, D = q.shape
     if k.shape[2] != H:
@@ -187,9 +379,23 @@ def ring_flash_attention_local(q, k, v, *, axis_name: str = "sp",
             "repeat KV heads before the shard_map")
     if scale is None:
         scale = D ** -0.5
-    ring = _make_ring_flash(axis_name, bool(causal), float(scale),
-                            int(block_q), int(block_k),
-                            bool(_auto_interpret(interpret)))
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError(
+                "zigzag layout only balances the CAUSAL ring (non-causal "
+                "rings are already uniform); use layout='contiguous'")
+        if Lc % 2:
+            raise ValueError(
+                f"zigzag needs an even per-rank chunk (got {Lc})")
+        ring = _make_ring_flash_zigzag(
+            axis_name, float(scale), int(block_q), int(block_k),
+            bool(_auto_interpret(interpret)))
+    else:
+        ring = _make_ring_flash(axis_name, bool(causal), float(scale),
+                                int(block_q), int(block_k),
+                                bool(_auto_interpret(interpret)))
     # kernels use [B, H, L, D]
     out = ring(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                v.transpose(0, 2, 1, 3))
@@ -201,14 +407,19 @@ def ring_flash_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
                          head_axis: str = "tp",
                          block_q: int = DEFAULT_BLOCK_Q,
                          block_k: int = DEFAULT_BLOCK_K,
-                         interpret: bool | None = None):
+                         interpret: bool | None = None,
+                         layout: str = "contiguous"):
     """Global entry: shard_map ring flash attention over the mesh
-    (drop-in for parallel.ring_attention.ring_attention)."""
+    (drop-in for parallel.ring_attention.ring_attention).  ``layout``:
+    "contiguous" | "zigzag" (causal load balancing; needs even sp)."""
+    if layout == "zigzag" and mesh.shape[seq_axis] % 2:
+        # odd ring size cannot pair early/late blocks; stay contiguous
+        layout = "contiguous"
     spec = P(batch_axes, seq_axis, head_axis, None)
     fn = shard_map(
         partial(ring_flash_attention_local, axis_name=seq_axis,
                 causal=causal, block_q=block_q, block_k=block_k,
-                interpret=interpret),
+                interpret=interpret, layout=layout),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
