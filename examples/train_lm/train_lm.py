@@ -87,6 +87,11 @@ def _effective_ring_layout(args, on_tpu: bool) -> str:
         log.warning("--ring_layout zigzag is ignored with --sp_strategy "
                     "ulysses (no ring to balance); using contiguous")
         return "contiguous"
+    if args.sp < 2 or args.sp % 2:
+        log.warning("--ring_layout zigzag needs an even --sp >= 2 to pair "
+                    "early/late blocks (got --sp %d); using contiguous",
+                    args.sp)
+        return "contiguous"
     if not on_tpu:
         log.warning("--ring_layout zigzag needs the flash ring, which is "
                     "TPU-only; this host runs plain ring attention with "
