@@ -163,7 +163,15 @@ class Attention(nn.Module):
                     f"unknown sp_strategy {cfg.sp_strategy!r} "
                     "(expected 'ring' or 'ulysses')")
             kv_heads = k.shape[2]
-            if kv_heads != cfg.heads:
+            # The flash ring handles GQA natively: K/V ride the ring at
+            # Hkv heads (ICI traffic / group) and expand per flash call.
+            # Everything else still wants the pre-ring repeat, as does a
+            # tp size the native kv head count can't shard.
+            ring_flash_path = (cfg.sp_strategy == "ring"
+                               and cfg.use_flash_attention)
+            tp_size = mesh.shape.get("tp", 1)
+            if kv_heads != cfg.heads and not (
+                    ring_flash_path and kv_heads % tp_size == 0):
                 rep = cfg.heads // kv_heads
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)

@@ -54,6 +54,20 @@ from k8s_tpu.parallel.collectives import ring_shift
 _SKIP, _DIAG, _FULL = 0, 1, 2
 
 
+def _repeat_kv(x, group: int):
+    """[B, Hkv, L, D] -> [B, Hkv*group, L, D] (GQA query-head expansion)."""
+    return x if group == 1 else jnp.repeat(x, group, axis=1)
+
+
+def _group_sum(dx, group: int):
+    """Reduce per-query-head dk/dv back to the kv heads that produced them:
+    [B, Hkv*group, L, D] -> [B, Hkv, L, D]."""
+    if group == 1:
+        return dx
+    B, H, L, D = dx.shape
+    return dx.reshape(B, H // group, group, L, D).sum(axis=2)
+
+
 def _relation(my_idx, k_chunk_idx, causal: bool):
     if not causal:
         return jnp.full((), _FULL, jnp.int32)
@@ -74,11 +88,19 @@ def _merge(o_acc, lse_acc, o_blk, lse_blk):
 
 @lru_cache(maxsize=None)
 def _make_ring_flash(axis_name: str, causal: bool, scale: float,
-                     block_q: int, block_k: int, interpret: bool):
-    """Build the custom-VJP ring-flash local function for one config."""
+                     block_q: int, block_k: int, interpret: bool,
+                     group: int = 1):
+    """Build the custom-VJP ring-flash local function for one config.
+
+    ``group`` > 1 is grouped-query attention: K/V ride the ring at their
+    NATIVE Hkv = H/group heads — the per-hop ICI traffic the ring exists to
+    minimize shrinks by the group factor — and are expanded to H query
+    heads only transiently inside each flash call; dk/dv are group-summed
+    back to Hkv before joining the travelling accumulators."""
 
     def fwd_pass(q, k, v):
-        """q,k,v: [B,H,Lc,D] local shards.  Returns (out, lse [B,H,Lc,1])."""
+        """q: [B,H,Lc,D]; k,v: [B,H/group,Lc,D] local shards.
+        Returns (out, lse [B,H,Lc,1])."""
         B, H, Lc, D = q.shape
         sp = lax.axis_size(axis_name)
         my_idx = lax.axis_index(axis_name)
@@ -87,8 +109,9 @@ def _make_ring_flash(axis_name: str, causal: bool, scale: float,
         lse0 = jnp.full((B, H, Lc), NEG_INF, jnp.float32)
 
         def flash(causal_flag, k_cur, v_cur):
-            o_s, lse_s = _flash_fwd(q, k_cur, v_cur, scale, causal_flag,
-                                    block_q, block_k, interpret)
+            o_s, lse_s = _flash_fwd(q, _repeat_kv(k_cur, group),
+                                    _repeat_kv(v_cur, group), scale,
+                                    causal_flag, block_q, block_k, interpret)
             return o_s.astype(jnp.float32), lse_s[..., 0]
 
         def step(s, carry):
@@ -122,17 +145,22 @@ def _make_ring_flash(axis_name: str, causal: bool, scale: float,
         my_idx = lax.axis_index(axis_name)
 
         dq0 = jnp.zeros((B, H, Lc, D), jnp.float32)
-        dk0 = jnp.zeros((B, H, Lc, D), jnp.float32)
-        dv0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+        Hkv = H // group
+        dk0 = jnp.zeros((B, Hkv, Lc, D), jnp.float32)
+        dv0 = jnp.zeros((B, Hkv, Lc, D), jnp.float32)
 
         def flash_bwd(causal_flag, k_cur, v_cur):
             # global lse/delta make each (Q-chunk, K-chunk) contribution
             # exact and independent; _flash_bwd derives delta from (out, do)
             dq_s, dk_s, dv_s = _flash_bwd(
-                q, k_cur.astype(q.dtype), v_cur.astype(q.dtype), out, lse,
+                q, _repeat_kv(k_cur, group).astype(q.dtype),
+                _repeat_kv(v_cur, group).astype(q.dtype), out, lse,
                 do, scale, causal_flag, block_q, block_k, interpret)
-            return (dq_s.astype(jnp.float32), dk_s.astype(jnp.float32),
-                    dv_s.astype(jnp.float32))
+            # dk/dv group-sum back to the native kv heads so the ring
+            # accumulators stay Hkv-sized (ICI traffic / group)
+            return (dq_s.astype(jnp.float32),
+                    _group_sum(dk_s.astype(jnp.float32), group),
+                    _group_sum(dv_s.astype(jnp.float32), group))
 
         zeros = lambda kc, vc: (dq0, dk0, dv0)  # noqa: E731
 
@@ -234,8 +262,10 @@ def _zigzag_from(x, axis_name: str):
 
 @lru_cache(maxsize=None)
 def _make_ring_flash_zigzag(axis_name: str, scale: float,
-                            block_q: int, block_k: int, interpret: bool):
-    """Causal-only load-balanced variant; external layout stays contiguous."""
+                            block_q: int, block_k: int, interpret: bool,
+                            group: int = 1):
+    """Causal-only load-balanced variant; external layout stays contiguous.
+    ``group`` > 1 = GQA: K/V ring at native Hkv heads (see _make_ring_flash)."""
 
     def zz_relation(my_idx, j):
         return jnp.where(j == my_idx, _Z_DIAG,
@@ -250,8 +280,9 @@ def _make_ring_flash_zigzag(axis_name: str, scale: float,
         my_idx = lax.axis_index(axis_name)
 
         def flash(causal_flag, q_, k_, v_):
-            o_s, lse_s = _flash_fwd(q_, k_, v_, scale, causal_flag,
-                                    block_q, block_k, interpret)
+            o_s, lse_s = _flash_fwd(q_, _repeat_kv(k_, group),
+                                    _repeat_kv(v_, group), scale,
+                                    causal_flag, block_q, block_k, interpret)
             return o_s.astype(jnp.float32), lse_s[..., 0]
 
         def br_diag(kc, vc):
@@ -298,34 +329,44 @@ def _make_ring_flash_zigzag(axis_name: str, scale: float,
         sp = lax.axis_size(axis_name)
         my_idx = lax.axis_index(axis_name)
 
+        Hkv = H // group
         dq0 = jnp.zeros((B, H, Lc, D), jnp.float32)
-        dkv0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+        dkv0 = jnp.zeros((B, Hkv, Lc, D), jnp.float32)
 
         def bwd_diag(kc, vc):
             dq_s, dk_s, dv_s = _flash_bwd(
-                qz, kc.astype(qz.dtype), vc.astype(qz.dtype), oz, lsez, do,
+                qz, _repeat_kv(kc, group).astype(qz.dtype),
+                _repeat_kv(vc, group).astype(qz.dtype), oz, lsez, do,
                 scale, True, block_q, block_k, interpret)
-            return (dq_s.astype(jnp.float32), dk_s.astype(jnp.float32),
-                    dv_s.astype(jnp.float32))
+            return (dq_s.astype(jnp.float32),
+                    _group_sum(dk_s.astype(jnp.float32), group),
+                    _group_sum(dv_s.astype(jnp.float32), group))
 
         def bwd_low(kc, vc):
             dq_s, dk_h, dv_h = _flash_bwd(
-                qz, kc[:, :, :half].astype(qz.dtype),
-                vc[:, :, :half].astype(qz.dtype), oz, lsez, do,
+                qz, _repeat_kv(kc[:, :, :half], group).astype(qz.dtype),
+                _repeat_kv(vc[:, :, :half], group).astype(qz.dtype),
+                oz, lsez, do,
                 scale, False, block_q, block_k, interpret)
-            pad = jnp.zeros((B, H, half, D), jnp.float32)
+            pad = jnp.zeros((B, Hkv, half, D), jnp.float32)
             return (dq_s.astype(jnp.float32),
-                    jnp.concatenate([dk_h.astype(jnp.float32), pad], axis=2),
-                    jnp.concatenate([dv_h.astype(jnp.float32), pad], axis=2))
+                    jnp.concatenate(
+                        [_group_sum(dk_h.astype(jnp.float32), group), pad],
+                        axis=2),
+                    jnp.concatenate(
+                        [_group_sum(dv_h.astype(jnp.float32), group), pad],
+                        axis=2))
 
         def bwd_high(kc, vc):
             dq_h, dk_s, dv_s = _flash_bwd(
-                qz[:, :, half:], kc.astype(qz.dtype), vc.astype(qz.dtype),
+                qz[:, :, half:], _repeat_kv(kc, group).astype(qz.dtype),
+                _repeat_kv(vc, group).astype(qz.dtype),
                 oz[:, :, half:], lsez[:, :, half:], do[:, :, half:],
                 scale, False, block_q, block_k, interpret)
             pad = jnp.zeros((B, H, half, D), jnp.float32)
             return (jnp.concatenate([pad, dq_h.astype(jnp.float32)], axis=2),
-                    dk_s.astype(jnp.float32), dv_s.astype(jnp.float32))
+                    _group_sum(dk_s.astype(jnp.float32), group),
+                    _group_sum(dv_s.astype(jnp.float32), group))
 
         def step(s, carry):
             dq, k_cur, v_cur, dk_cur, dv_cur = carry
@@ -362,9 +403,11 @@ def ring_flash_attention_local(q, k, v, *, axis_name: str = "sp",
     """Per-shard ring flash attention body; call under shard_map with
     Q/K/V sequence-sharded over ``axis_name``.
 
-    q, k, v: [B, chunk, H, D] local shards (same convention as
-    ring_attention_local).  Hkv must equal H (repeat grouped-query KV heads
-    before sharding).  Returns [B, chunk, H, D] in q.dtype.
+    q: [B, chunk, H, D]; k, v: [B, chunk, Hkv, D] local shards (same
+    convention as ring_attention_local).  Hkv may DIVIDE H (grouped-query
+    attention): K/V then ride the ring at their native head count — the
+    per-hop ICI traffic shrinks by H/Hkv vs repeating KV before the ring —
+    and are expanded per flash call only.  Returns [B, chunk, H, D].
 
     ``layout="zigzag"`` (causal only, even sp, even per-rank chunk)
     load-balances the causal ring: every rank computes one chunk-equivalent
@@ -373,10 +416,11 @@ def ring_flash_attention_local(q, k, v, *, axis_name: str = "sp",
     unchanged (contiguous in, contiguous out).
     """
     B, Lc, H, D = q.shape
-    if k.shape[2] != H:
+    hkv = k.shape[2]
+    if hkv == 0 or H % hkv:
         raise ValueError(
-            f"ring flash needs H == Hkv (got {H} vs {k.shape[2]}); "
-            "repeat KV heads before the shard_map")
+            f"ring flash needs Hkv dividing H (got H={H}, Hkv={hkv})")
+    group = H // hkv
     if scale is None:
         scale = D ** -0.5
     if layout not in ("contiguous", "zigzag"):
@@ -391,11 +435,11 @@ def ring_flash_attention_local(q, k, v, *, axis_name: str = "sp",
                 f"zigzag needs an even per-rank chunk (got {Lc})")
         ring = _make_ring_flash_zigzag(
             axis_name, float(scale), int(block_q), int(block_k),
-            bool(_auto_interpret(interpret)))
+            bool(_auto_interpret(interpret)), group)
     else:
         ring = _make_ring_flash(axis_name, bool(causal), float(scale),
                                 int(block_q), int(block_k),
-                                bool(_auto_interpret(interpret)))
+                                bool(_auto_interpret(interpret)), group)
     # kernels use [B, H, L, D]
     out = ring(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                v.transpose(0, 2, 1, 3))

@@ -326,13 +326,13 @@ class TestRingFlashAttention:
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        atol=5e-5)
 
-    def test_head_mismatch_rejected(self):
+    def test_non_dividing_heads_rejected(self):
         from k8s_tpu.parallel.ring_flash import ring_flash_attention_local
 
-        with pytest.raises(ValueError, match="Hkv"):
+        with pytest.raises(ValueError, match="Hkv dividing H"):
             ring_flash_attention_local(
-                jnp.ones((1, 8, 4, 8)), jnp.ones((1, 8, 2, 8)),
-                jnp.ones((1, 8, 2, 8)))
+                jnp.ones((1, 8, 4, 8)), jnp.ones((1, 8, 3, 8)),
+                jnp.ones((1, 8, 3, 8)))
 
     def test_transformer_ring_flash_path(self):
         """use_ring_attention + use_flash_attention composes in the model."""
@@ -546,3 +546,125 @@ class TestZigzagRingFlash:
         out_zz = Transformer(cfg_zz).apply(params, tokens, mesh=mesh)
         np.testing.assert_allclose(np.asarray(out_zz),
                                    np.asarray(out_contig), atol=3e-5)
+
+
+class TestGQARingFlash:
+    """Grouped-query attention through the flash ring: K/V ride the ring at
+    their native Hkv = H/group heads (per-hop ICI traffic / group) and are
+    expanded only inside each flash call; dk/dv group-sum back to Hkv.
+    Exactness vs the repeat-then-attend reference is the contract."""
+
+    @staticmethod
+    def _ref(q, k, v, group):
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+        return reference_attention(q, k, v, causal=True)
+
+    @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+    def test_values_match_repeat_reference(self, layout):
+        from k8s_tpu.parallel.ring_flash import ring_flash_attention
+
+        mesh = make_mesh(MeshConfig(sp=4, dp=2))
+        B, L, H, Hkv, D = 2, 128, 4, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32) * 0.5
+        k = jax.random.normal(ks[1], (B, L, Hkv, D), jnp.float32) * 0.5
+        v = jax.random.normal(ks[2], (B, L, Hkv, D), jnp.float32) * 0.5
+        expected = self._ref(q, k, v, H // Hkv)
+        got = ring_flash_attention(mesh, q, k, v, causal=True,
+                                   block_q=16, block_k=16, layout=layout)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   atol=2e-5)
+
+    @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+    def test_gradients_match_repeat_reference(self, layout):
+        """dk/dv are SUMS over the query-head group — exactly what grad of
+        the repeat-then-attend reference produces for the unrepeated KV."""
+        from k8s_tpu.parallel.ring_flash import ring_flash_attention
+
+        mesh = make_mesh(MeshConfig(sp=4, dp=2))
+        B, L, H, Hkv, D = 2, 64, 4, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(12), 3)
+        q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32) * 0.5
+        k = jax.random.normal(ks[1], (B, L, Hkv, D), jnp.float32) * 0.5
+        v = jax.random.normal(ks[2], (B, L, Hkv, D), jnp.float32) * 0.5
+        group = H // Hkv
+
+        def loss_ring(q, k, v):
+            out = ring_flash_attention(mesh, q, k, v, causal=True,
+                                       block_q=16, block_k=16, layout=layout)
+            return jnp.sum(jnp.sin(out))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(self._ref(q, k, v, group)))
+
+        got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            assert g.shape == w.shape
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=5e-5)
+
+    def test_transformer_gqa_ring_matches_repeated(self):
+        """The model's GQA fast path (native-Hkv ring) must produce the
+        same logits as forcing the pre-ring repeat."""
+        from k8s_tpu.models.transformer import Transformer, TransformerConfig
+
+        mesh = make_mesh(MeshConfig(sp=4, dp=2))
+        cfg = TransformerConfig(
+            vocab_size=64, hidden=32, ffn_hidden=64, layers=1, heads=4,
+            kv_heads=2, max_seq_len=64, dtype=jnp.float32, remat=False,
+            use_ring_attention=True, use_flash_attention=True,
+            flash_block_q=16, flash_block_k=16,
+        )
+        tokens = (jnp.arange(2 * 64, dtype=jnp.int32).reshape(2, 64) * 3) % 64
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        out_gqa = model.apply(params, tokens, mesh=mesh)
+        # control: same params through the ulysses path repeats KV up front
+        import dataclasses
+
+        cfg_u = dataclasses.replace(cfg, sp_strategy="ulysses")
+        out_rep = Transformer(cfg_u).apply(params, tokens, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_rep),
+                                   atol=3e-5)
+
+    def test_gqa_under_tensor_parallel_heads(self):
+        """Native-Hkv ring with the head axis ALSO sharded over tp: the
+        per-shard q/kv group alignment must reproduce the global mapping."""
+        from k8s_tpu.parallel.ring_flash import ring_flash_attention
+
+        mesh = make_mesh(MeshConfig(sp=2, tp=2, dp=2))
+        B, L, H, Hkv, D = 2, 64, 4, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(13), 3)
+        q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32) * 0.5
+        k = jax.random.normal(ks[1], (B, L, Hkv, D), jnp.float32) * 0.5
+        v = jax.random.normal(ks[2], (B, L, Hkv, D), jnp.float32) * 0.5
+        expected = self._ref(q, k, v, H // Hkv)
+        got = ring_flash_attention(mesh, q, k, v, causal=True,
+                                   block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   atol=2e-5)
+
+    def test_model_falls_back_to_repeat_when_tp_exceeds_kv_heads(self):
+        """kv_heads=1 with tp=2 cannot shard natively; the model must take
+        the pre-ring repeat fallback and still match the repeated control."""
+        import dataclasses
+
+        from k8s_tpu.models.transformer import Transformer, TransformerConfig
+
+        mesh = make_mesh(MeshConfig(sp=2, tp=2, dp=2))
+        cfg = TransformerConfig(
+            vocab_size=64, hidden=32, ffn_hidden=64, layers=1, heads=4,
+            kv_heads=1, max_seq_len=64, dtype=jnp.float32, remat=False,
+            use_ring_attention=True, use_flash_attention=True,
+            flash_block_q=16, flash_block_k=16,
+        )
+        tokens = (jnp.arange(2 * 64, dtype=jnp.int32).reshape(2, 64) * 7) % 64
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        out = model.apply(params, tokens, mesh=mesh)
+        cfg_u = dataclasses.replace(cfg, sp_strategy="ulysses")
+        out_rep = Transformer(cfg_u).apply(params, tokens, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_rep),
+                                   atol=3e-5)
