@@ -55,6 +55,11 @@ class TransformerConfig:
     flash_block_q: Optional[int] = None
     flash_block_k: Optional[int] = None
     use_fused_norm: bool = False  # Pallas RMSNorm kernel (k8s_tpu.ops)
+    # Sliding-window attention (Mistral/Gemma-style): each query attends the
+    # window most recent positions.  Flash-kernel path only (out-of-window
+    # key blocks are SKIPPED — O(L*window) compute); not yet composed with
+    # the sp ring (would need per-step position offsets in the kernel).
+    window_size: Optional[int] = None
     remat: bool = True  # jax.checkpoint each layer: HBM for FLOPs
     # MoE (k8s_tpu.models.moe): >0 swaps the dense MLP for routed experts
     # sharded over the ep mesh axis
@@ -158,6 +163,11 @@ class Attention(nn.Module):
         k = rotary_embedding(k, positions, cfg.rope_theta)
 
         if cfg.use_ring_attention and mesh is not None:
+            if cfg.window_size is not None:
+                raise ValueError(
+                    "window_size is not composed with sequence parallelism "
+                    "yet (the ring kernels would need per-step position "
+                    "offsets); use window_size with sp=1")
             if cfg.sp_strategy not in ("ring", "ulysses"):
                 raise ValueError(
                     f"unknown sp_strategy {cfg.sp_strategy!r} "
@@ -213,8 +223,14 @@ class Attention(nn.Module):
                 q, k, v, causal=cfg.causal,
                 block_q=cfg.flash_block_q or DEFAULT_BLOCK_Q,
                 block_k=cfg.flash_block_k or DEFAULT_BLOCK_K,
+                window=cfg.window_size,
             )
         else:
+            if cfg.window_size is not None:
+                raise ValueError(
+                    "window_size requires use_flash_attention (the sliding "
+                    "window lives in the flash kernels; plain attention "
+                    "would silently ignore it)")
             out = _plain_attention(q, k, v, cfg.causal)
 
         return nn.DenseGeneral(

@@ -51,21 +51,77 @@ DEFAULT_BLOCK_K = 1024
 # ---------------------------------------------------------------------------
 
 
+def _window_lo(i, block_q, block_k, window):
+    """First k block a windowed q block i can see (floor-div on traced
+    ints; clamped at 0)."""
+    return jnp.maximum(0, (i * block_q - window + 1) // block_k)
+
+
+def _window_q_lo(j, block_q, block_k):
+    """First q block that can causally reach k block j."""
+    return (j * block_k) // block_q
+
+
+def _window_visible(i, j, block_q, block_k, window):
+    """Block pair (q block i, k block j) holds >= 1 position pair with
+    0 <= q_pos - k_pos < window.  The SINGLE source of truth for the
+    windowed mask at block granularity: forward and both backward kernels
+    must agree exactly on which blocks participate, or gradients silently
+    diverge from the forward.  Callers add their own grid-bounds check."""
+    return ((j * block_k < (i + 1) * block_q)
+            & ((j + 1) * block_k > i * block_q - window + 1))
+
+
+def _window_span_k(block_q, block_k, window, nk_total):
+    """(n_inner, index_map) for grids whose INNER dim walks k blocks of a
+    fixed q block i (fwd, dq)."""
+    n_inner = min(nk_total, (block_q + window - 2) // block_k + 2)
+
+    def idx(b, h, i, jj):
+        return (b, h, jnp.minimum(
+            _window_lo(i, block_q, block_k, window) + jj, nk_total - 1), 0)
+
+    return n_inner, idx
+
+
+def _window_span_q(block_q, block_k, window, nq_total):
+    """(n_inner, index_map) for grids whose INNER dim walks q blocks of a
+    fixed k block j (dkv)."""
+    n_inner = min(nq_total, (block_k + window - 2) // block_q + 2)
+
+    def idx(b, h, j, ii):
+        return (b, h, jnp.minimum(
+            _window_q_lo(j, block_q, block_k) + ii, nq_total - 1), 0)
+
+    return n_inner, idx
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k):
+                *, scale, causal, block_q, block_k, window=None,
+                nk_total=None):
     i = pl.program_id(2)  # q block
-    j = pl.program_id(3)  # k block (innermost: sequential on TPU)
+    jj = pl.program_id(3)  # k step (innermost: sequential on TPU)
     nk = pl.num_programs(3)
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
     # Causal: k block j is visible to q block i iff some (q_pos >= k_pos)
-    # pair exists, i.e. j*block_k <= i*block_q + block_q - 1.
-    visible = True if not causal else (j * block_k < (i + 1) * block_q)
+    # pair exists, i.e. j*block_k <= i*block_q + block_q - 1.  Sliding
+    # window: the inner grid dim is SHRUNK to the ~window/block_k steps a
+    # q block can see (the BlockSpec index map adds the same offset), so
+    # out-of-window K/V blocks are never even DMA'd — compute AND traffic
+    # drop to O(L*window).
+    if window is None:
+        j = jj
+        visible = True if not causal else (j * block_k < (i + 1) * block_q)
+    else:
+        j = _window_lo(i, block_q, block_k, window) + jj
+        visible = (_window_visible(i, j, block_q, block_k, window)
+                   & (j < nk_total))
 
     @pl.when(visible)
     def _compute():
@@ -81,7 +137,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = q_pos >= k_pos
+            if window is not None:
+                keep = keep & (q_pos - k_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
 
         m_prev = m_ref[:, 0]  # [bq]
         m_cur = jnp.max(s, axis=-1)
@@ -91,7 +150,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - safe_m[:, None])  # [bq, bk]
         if causal:
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+            p = jnp.where(keep, p, 0.0)
         alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, jnp.exp(m_prev - safe_m))
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
         m_ref[:, 0] = m_new
@@ -100,7 +159,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(j == nk - 1)
+    @pl.when(jj == nk - 1)
     def _finalize():
         l = l_ref[:, 0]
         o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l, 1e-30)[:, None]).astype(
@@ -113,27 +172,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0, 0] = lse[:, None]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               window=None):
     """q,k,v: [B,H,L,D].  Returns (o [B,H,L,D], lse [B,H,L,1] f32)."""
     B, H, L, D = q.shape
     Lk = k.shape[2]
     bq = _pick_block(L, block_q)
     bk = _pick_block(Lk, block_k)
-    grid = (B, H, L // bq, Lk // bk)
+    nk_total = Lk // bk
+    if window is None:
+        n_inner = nk_total
+        k_idx = lambda b, h, i, jj: (b, h, jj, 0)  # noqa: E731
+    else:
+        # only the ~window/bk k blocks a q block can see enter the grid;
+        # the index map re-bases each step and clamps (clamped duplicates
+        # are predicated off inside the kernel)
+        n_inner, k_idx = _window_span_k(bq, bk, window, nk_total)
+    grid = (B, H, L // bq, n_inner)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        window=window, nk_total=nk_total)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, jj: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), k_idx),
+            pl.BlockSpec((1, 1, bk, D), k_idx),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, jj: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, jj: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, L, D), q.dtype),
@@ -161,16 +231,23 @@ def _vmem(shape, dtype):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, block_q, block_k):
+               dq_acc, *, scale, causal, block_q, block_k, window=None,
+               nk_total=None):
     i = pl.program_id(2)  # q block
-    j = pl.program_id(3)  # k block (inner)
+    jj = pl.program_id(3)  # k step (inner)
     nk = pl.num_programs(3)
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    visible = True if not causal else (j * block_k < (i + 1) * block_q)
+    if window is None:
+        j = jj
+        visible = True if not causal else (j * block_k < (i + 1) * block_q)
+    else:
+        j = _window_lo(i, block_q, block_k, window) + jj
+        visible = (_window_visible(i, j, block_q, block_k, window)
+                   & (j < nk_total))
 
     @pl.when(visible)
     def _compute():
@@ -189,7 +266,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = q_pos >= k_pos
+            if window is not None:
+                keep = keep & (q_pos - k_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
         safe_lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
         p = jnp.exp(s - safe_lse[:, None])
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
@@ -201,25 +281,33 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == nk - 1)
+    @pl.when(jj == nk - 1)
     def _finalize():
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, block_q, block_k):
+                *, scale, causal, block_q, block_k, window=None,
+                nq_total=None):
     j = pl.program_id(2)  # k block (outer)
-    i = pl.program_id(3)  # q block (inner)
+    ii = pl.program_id(3)  # q step (inner)
     nq = pl.num_programs(3)
 
-    @pl.when(i == 0)
+    @pl.when(ii == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     # k block j contributes to q block i iff i's max q_pos >= j's min k_pos.
-    visible = True if not causal else ((i + 1) * block_q > j * block_k)
+    if window is None:
+        i = ii
+        visible = True if not causal else ((i + 1) * block_q > j * block_k)
+    else:
+        # first q block whose positions can reach k block j causally
+        i = _window_q_lo(j, block_q, block_k) + ii
+        visible = (_window_visible(i, j, block_q, block_k, window)
+                   & (i < nq_total))
 
     @pl.when(visible)
     def _compute():
@@ -238,7 +326,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = q_pos >= k_pos
+            if window is not None:
+                keep = keep & (q_pos - k_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
         safe_lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
         p = jnp.exp(s - safe_lse[:, None])
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
@@ -254,14 +345,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # ds^T @ q -> [bk, D]
 
-    @pl.when(i == nq - 1)
+    @pl.when(ii == nq - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-               interpret):
+               interpret, window=None):
     """All arrays [B,H,L,D] (lse [B,H,L]).  Returns (dq, dk, dv)."""
     B, H, L, D = q.shape
     Lk = k.shape[2]
@@ -270,14 +361,22 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [B, H, L, 1]
+    nk_total = Lk // bk
+    nq_total = L // bq
 
+    if window is None:
+        n_inner_k = nk_total
+        k_idx = lambda b, h, x, y: (b, h, y, 0)  # noqa: E731
+    else:
+        n_inner_k, k_idx = _window_span_k(bq, bk, window, nk_total)
     qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, x, y: (b, h, x, 0))
-    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, x, y: (b, h, y, 0))
+    kspec = pl.BlockSpec((1, 1, bk, D), k_idx)
     rowspec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, x, y: (b, h, x, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
-        grid=(B, H, L // bq, Lk // bk),
+                          block_q=bq, block_k=bk, window=window,
+                          nk_total=nk_total),
+        grid=(B, H, nq_total, n_inner_k),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct((B, H, L, D), q.dtype)],
@@ -286,13 +385,19 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
     )(q, k, v, do, lse, delta)[0]
 
     # dk/dv: k block is the outer loop, q the inner accumulation loop.
-    qspec2 = pl.BlockSpec((1, 1, bq, D), lambda b, h, y, x: (b, h, x, 0))
+    if window is None:
+        n_inner_q = nq_total
+        q_idx = lambda b, h, y, x: (b, h, x, 0)  # noqa: E731
+    else:
+        n_inner_q, q_idx = _window_span_q(bq, bk, window, nq_total)
+    qspec2 = pl.BlockSpec((1, 1, bq, D), q_idx)
     kspec2 = pl.BlockSpec((1, 1, bk, D), lambda b, h, y, x: (b, h, y, 0))
-    rowspec2 = pl.BlockSpec((1, 1, bq, 1), lambda b, h, y, x: (b, h, x, 0))
+    rowspec2 = pl.BlockSpec((1, 1, bq, 1), q_idx)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
-        grid=(B, H, Lk // bk, L // bq),
+                          block_q=bq, block_k=bk, window=window,
+                          nq_total=nq_total),
+        grid=(B, H, nk_total, n_inner_q),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
@@ -311,21 +416,25 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window=None):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                      window)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                   window=None):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                        window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, window,
+                   res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
-                            block_q, block_k, interpret)
+                            block_q, block_k, interpret, window)
     return dq, dk, dv
 
 
@@ -334,9 +443,18 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
                     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    window: int | None = None):
     """Fused attention.  q: [B, L, H, D]; k, v: [B, Lk, Hkv, D] with
     Hkv dividing H (grouped-query).  Returns [B, L, H, D] in q.dtype.
+
+    ``window`` (sliding-window attention, Mistral/Gemma-style): each query
+    attends only the ``window`` most recent positions including itself
+    (0 <= q_pos - k_pos < window).  Causal-only.  The inner grid dimension
+    of all three kernels (fwd, dq, dkv) shrinks to the ~window/block_k
+    steps a block can see, with index maps re-based per block — so
+    out-of-window K/V tiles are never DMA'd and both compute and HBM
+    traffic drop from O(L^2) to O(L*window).
 
     Differentiable (custom VJP with flash backward kernels).  ``interpret``
     defaults to auto: Pallas interpret mode on CPU backends, compiled Mosaic
@@ -344,6 +462,12 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     """
     B, L, H, D = q.shape
     Hkv = k.shape[2]
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding-window "
+                             "attention is a causal construction)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1 (got {window})")
     if causal and L != k.shape[1]:
         # The kernels' causal mask assumes q and k positions are both
         # 0-aligned; with Lk != L (e.g. kv-cache decode, where q positions
@@ -366,5 +490,6 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     out = _flash(qt, kt, vt, float(scale), bool(causal), int(block_q),
-                 int(block_k), _auto_interpret(interpret))
+                 int(block_k), _auto_interpret(interpret),
+                 int(window) if window is not None else None)
     return out.transpose(0, 2, 1, 3)
