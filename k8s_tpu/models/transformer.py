@@ -163,11 +163,17 @@ class Attention(nn.Module):
         k = rotary_embedding(k, positions, cfg.rope_theta)
 
         if cfg.use_ring_attention and mesh is not None:
-            if cfg.window_size is not None:
+            if cfg.window_size is not None and not (
+                    cfg.sp_strategy == "ring" and cfg.use_flash_attention):
                 raise ValueError(
-                    "window_size is not composed with sequence parallelism "
-                    "yet (the ring kernels would need per-step position "
-                    "offsets); use window_size with sp=1")
+                    "window_size under sequence parallelism needs the flash "
+                    "ring (sp_strategy='ring' + use_flash_attention); the "
+                    "plain ring and ulysses paths would silently ignore it")
+            if cfg.window_size is not None and not cfg.causal:
+                raise ValueError(
+                    "window_size requires causal=True (the windowed ring is "
+                    "a causal construction); matching flash_attention's "
+                    "single-device contract")
             if cfg.sp_strategy not in ("ring", "ulysses"):
                 raise ValueError(
                     f"unknown sp_strategy {cfg.sp_strategy!r} "
@@ -202,12 +208,25 @@ class Attention(nn.Module):
                     DEFAULT_BLOCK_Q,
                 )
 
-                out = ring_flash_attention(
-                    mesh, q, k, v, causal=cfg.causal,
-                    block_q=cfg.flash_block_q or DEFAULT_BLOCK_Q,
-                    block_k=cfg.flash_block_k or DEFAULT_BLOCK_K,
-                    layout=cfg.ring_layout if cfg.causal else "contiguous",
-                )
+                if cfg.window_size is not None:
+                    # windowed ring: only the ceil(window/chunk) neighbor
+                    # chunks are exchanged — ICI hops O(window/Lc), not sp
+                    from k8s_tpu.parallel.ring_flash import (
+                        ring_flash_attention_windowed,
+                    )
+
+                    out = ring_flash_attention_windowed(
+                        mesh, q, k, v, window=cfg.window_size,
+                        block_q=cfg.flash_block_q or DEFAULT_BLOCK_Q,
+                        block_k=cfg.flash_block_k or DEFAULT_BLOCK_K,
+                    )
+                else:
+                    out = ring_flash_attention(
+                        mesh, q, k, v, causal=cfg.causal,
+                        block_q=cfg.flash_block_q or DEFAULT_BLOCK_Q,
+                        block_k=cfg.flash_block_k or DEFAULT_BLOCK_K,
+                        layout=cfg.ring_layout if cfg.causal else "contiguous",
+                    )
             else:
                 from k8s_tpu.parallel.ring_attention import ring_attention
 

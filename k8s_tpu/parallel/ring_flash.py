@@ -399,7 +399,8 @@ def ring_flash_attention_local(q, k, v, *, axis_name: str = "sp",
                                block_q: int = DEFAULT_BLOCK_Q,
                                block_k: int = DEFAULT_BLOCK_K,
                                interpret: bool | None = None,
-                               layout: str = "contiguous"):
+                               layout: str = "contiguous",
+                               window: int | None = None):
     """Per-shard ring flash attention body; call under shard_map with
     Q/K/V sequence-sharded over ``axis_name``.
 
@@ -425,7 +426,19 @@ def ring_flash_attention_local(q, k, v, *, axis_name: str = "sp",
         scale = D ** -0.5
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring layout {layout!r}")
-    if layout == "zigzag":
+    if window is not None:
+        # windowed ring: only the ceil((window-1)/Lc) preceding chunks are
+        # exchanged — O(window/Lc) ICI hops instead of sp; causal by
+        # construction, already balanced (no zigzag needed)
+        if not causal:
+            raise ValueError("window requires causal=True (sliding-window "
+                             "attention is a causal construction)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1 (got {window})")
+        ring = _make_windowed_ring(
+            axis_name, int(window), float(scale), int(block_q), int(block_k),
+            bool(_auto_interpret(interpret)), group)
+    elif layout == "zigzag":
         if not causal:
             raise ValueError(
                 "zigzag layout only balances the CAUSAL ring (non-causal "
@@ -452,10 +465,13 @@ def ring_flash_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
                          block_q: int = DEFAULT_BLOCK_Q,
                          block_k: int = DEFAULT_BLOCK_K,
                          interpret: bool | None = None,
-                         layout: str = "contiguous"):
+                         layout: str = "contiguous",
+                         window: int | None = None):
     """Global entry: shard_map ring flash attention over the mesh
     (drop-in for parallel.ring_attention.ring_attention).  ``layout``:
-    "contiguous" | "zigzag" (causal load balancing; needs even sp)."""
+    "contiguous" | "zigzag" (causal load balancing; needs even sp).
+    ``window``: sliding-window attention — only the ceil((window-1)/chunk)
+    neighbor chunks are exchanged (O(window/chunk) ICI hops, not sp)."""
     if layout == "zigzag" and mesh.shape[seq_axis] % 2:
         # odd ring size cannot pair early/late blocks; stay contiguous
         layout = "contiguous"
@@ -463,10 +479,198 @@ def ring_flash_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
     fn = shard_map(
         partial(ring_flash_attention_local, axis_name=seq_axis,
                 causal=causal, block_q=block_q, block_k=block_k,
-                interpret=interpret, layout=layout),
+                interpret=interpret, layout=layout, window=window),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+# -- windowed ring (sliding-window attention across chunks) ------------------
+#
+# Sliding-window attention bounds how far back a query looks, so the ring
+# does not need to rotate K/V all the way around: a chunk of length Lc needs
+# its own chunk plus the M = ceil((window-1)/Lc) preceding chunks — the ring
+# becomes M+1 hops instead of sp, and total work is O(L*window/sp) per rank.
+#
+# Masking per hop m (k chunk base = q chunk base - m*Lc):
+#   m = 0: positions are aligned — the flash kernel's own `window` parameter
+#          applies directly (causal + q-k < window);
+#   1 <= m, window - m*Lc >= Lc: every (q,k) pair in the block is in-window
+#          and strictly causal — plain flash(causal=False);
+#   the single BOUNDARY hop (0 < window - m*Lc < Lc): the band
+#          q_rel - k_rel < window - m*Lc crosses the block; it is computed
+#          with a masked XLA block (one [Lc x Lc] score block on one hop —
+#          the same cost envelope parallel.ring_attention pays every hop).
+#
+# Because the hop count is BOUNDED (M+1, not sp), the custom-VJP backward
+# simply REPLAYS the same M+1 hops (residuals: q, k, v, out, global lse —
+# O(chunk) memory) instead of running a full backward ring: each hop's
+# dk/dv are computed against the global lse/delta (the same convention the
+# flash backward kernels use), group-summed for GQA, and sent home with m
+# reverse ring hops.
+
+
+def _xla_band_block(q, k_cur, v_cur, scale, band):
+    """Partial attention of q against a k chunk where only
+    q_rel - k_rel < band is visible (band in (0, Lc)); returns (o, lse)
+    in the _merge convention.  [B,H,Lc,D] kernel layout."""
+    B, H, Lc, D = q.shape
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k_cur.astype(jnp.float32)) * scale
+    q_pos = jnp.arange(Lc)[:, None]
+    k_pos = jnp.arange(Lc)[None, :]
+    keep = (q_pos - k_pos) < band
+    s = jnp.where(keep, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.where(keep, jnp.exp(s - safe_m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    lse = jnp.where(m <= NEG_INF / 2, NEG_INF,
+                    m + jnp.log(jnp.maximum(l, 1e-30)))
+    return o, lse
+
+
+@lru_cache(maxsize=None)
+def _make_windowed_ring(axis_name: str, window: int, scale: float,
+                        block_q: int, block_k: int, interpret: bool,
+                        group: int):
+    """custom-VJP windowed ring for one config ([B,H,Lc,D] kernel layout,
+    k/v at Hkv heads).  Hop count is bounded by the window, so the backward
+    replays the same M+1 hops instead of a full backward ring."""
+
+    def _hop_band(Lc: int, m: int) -> int:
+        return window - m * Lc
+
+    def fwd_pass(q, k, v):
+        B, H, Lc, D = q.shape
+        sp = lax.axis_size(axis_name)
+        my_idx = lax.axis_index(axis_name)
+        hops = min(sp - 1, -(-(window - 1) // Lc))
+
+        w0 = window if window < Lc else None  # window >= Lc: plain causal
+        o, lse = _flash_fwd(q, _repeat_kv(k, group), _repeat_kv(v, group),
+                            scale, True, block_q, block_k, interpret, w0)
+        o, lse = o.astype(jnp.float32), lse[..., 0]
+
+        k_cur, v_cur = k, v
+        for m in range(1, hops + 1):
+            k_cur = ring_shift(k_cur, axis_name)
+            v_cur = ring_shift(v_cur, axis_name)
+            band = _hop_band(Lc, m)
+            if band >= Lc:
+                o_s, lse_s = _flash_fwd(
+                    q, _repeat_kv(k_cur, group), _repeat_kv(v_cur, group),
+                    scale, False, block_q, block_k, interpret, None)
+                o_s, lse_s = o_s.astype(jnp.float32), lse_s[..., 0]
+            else:
+                o_s, lse_s = _xla_band_block(
+                    q, _repeat_kv(k_cur, group), _repeat_kv(v_cur, group),
+                    scale, band)
+            # chunk c attends chunks c-m >= 0 only: wrap-around ranks
+            # contribute nothing from this hop
+            valid = my_idx >= m
+            lse_s = jnp.where(valid, lse_s, NEG_INF)
+            o_s = jnp.where(valid, o_s, 0.0)
+            o, lse = _merge(o, lse, o_s, lse_s)
+        return o.astype(q.dtype), lse
+
+    def vjp_fwd(q, k, v):
+        out, lse = fwd_pass(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def vjp_bwd(res, do):
+        q, k, v, out, lse = res
+        B, H, Lc, D = q.shape
+        sp = lax.axis_size(axis_name)
+        my_idx = lax.axis_index(axis_name)
+        hops = min(sp - 1, -(-(window - 1) // Lc))
+        lse4 = lse[..., None]
+
+        w0 = window if window < Lc else None
+        dq, dk_h, dv_h = _flash_bwd(
+            q, _repeat_kv(k, group).astype(q.dtype),
+            _repeat_kv(v, group).astype(q.dtype), out, lse4, do,
+            scale, True, block_q, block_k, interpret, w0)
+        dq = dq.astype(jnp.float32)
+        dk = _group_sum(dk_h.astype(jnp.float32), group)
+        dv = _group_sum(dv_h.astype(jnp.float32), group)
+
+        k_cur, v_cur = k, v
+        for m in range(1, hops + 1):
+            k_cur = ring_shift(k_cur, axis_name)
+            v_cur = ring_shift(v_cur, axis_name)
+            band = _hop_band(Lc, m)
+            if band >= Lc:
+                dq_m, dk_m, dv_m = _flash_bwd(
+                    q, _repeat_kv(k_cur, group).astype(q.dtype),
+                    _repeat_kv(v_cur, group).astype(q.dtype), out, lse4, do,
+                    scale, False, block_q, block_k, interpret, None)
+                dq_m = dq_m.astype(jnp.float32)
+                dk_m = dk_m.astype(jnp.float32)
+                dv_m = dv_m.astype(jnp.float32)
+            else:
+                dq_m, dk_m, dv_m = _xla_band_bwd(
+                    q, _repeat_kv(k_cur, group), _repeat_kv(v_cur, group),
+                    out, lse, do, scale, band)
+            valid = (my_idx >= m).astype(jnp.float32)
+            dq = dq + dq_m * valid
+            dk_m = _group_sum(dk_m, group) * valid
+            dv_m = _group_sum(dv_m, group) * valid
+            # this hop's dk/dv belong to the chunk m ranks UP-ring; send
+            # them home (m reverse hops — M is small, O(M^2) total hops)
+            for _ in range(m):
+                dk_m = ring_shift(dk_m, axis_name, reverse=True)
+                dv_m = ring_shift(dv_m, axis_name, reverse=True)
+            dk = dk + dk_m
+            dv = dv + dv_m
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+    ring = jax.custom_vjp(lambda q, k, v: fwd_pass(q, k, v)[0])
+    ring.defvjp(vjp_fwd, vjp_bwd)
+    return ring
+
+
+def _xla_band_bwd(q, k_cur, v_cur, out, lse, do, scale, band):
+    """Backward of _xla_band_block given the GLOBAL lse (same convention as
+    the flash backward kernels: p from global lse, delta = rowsum(do*out))."""
+    B, H, Lc, D = q.shape
+    qf = q.astype(jnp.float32)
+    kf = k_cur.astype(jnp.float32)
+    vf = v_cur.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # [B,H,Lc]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    q_pos = jnp.arange(Lc)[:, None]
+    k_pos = jnp.arange(Lc)[None, :]
+    keep = (q_pos - k_pos) < band
+    safe_lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+    p = jnp.where(keep, jnp.exp(s - safe_lse[..., None]), 0.0)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq, dk, dv
+
+
+def ring_flash_attention_windowed(mesh: Mesh, q, k, v, *, window: int,
+                                  seq_axis: str = "sp",
+                                  batch_axes=("dp", "fsdp"),
+                                  head_axis: str = "tp",
+                                  block_q: int = DEFAULT_BLOCK_Q,
+                                  block_k: int = DEFAULT_BLOCK_K,
+                                  interpret: bool | None = None):
+    """Sliding-window attention over a sequence-parallel mesh: thin alias
+    for ring_flash_attention(window=...) — each rank exchanges only the
+    ceil((window-1)/chunk) neighbor chunks instead of rotating the whole
+    ring.  Causal by construction; GQA supported."""
+    return ring_flash_attention(
+        mesh, q, k, v, causal=True, seq_axis=seq_axis,
+        batch_axes=batch_axes, head_axis=head_axis,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window)

@@ -505,16 +505,23 @@ class TestSlidingWindowAttention:
         with pytest.raises(ValueError, match="window must be >= 1"):
             flash_attention(x, x, x, causal=True, window=0)
 
+        # ring + flash + window is SUPPORTED (the windowed ring); the
+        # guard fires only where window would be silently ignored:
+        # ulysses, and the plain (non-flash) ring
         mesh = make_mesh(MeshConfig(sp=4, dp=2))
         cfg = TransformerConfig(
             vocab_size=64, hidden=32, ffn_hidden=64, layers=1, heads=2,
             kv_heads=2, max_seq_len=64, dtype=jnp.float32, remat=False,
             use_ring_attention=True, use_flash_attention=True,
             flash_block_q=16, flash_block_k=16, window_size=32,
+            sp_strategy="ulysses",
         )
         tokens = jnp.zeros((2, 64), jnp.int32)
-        model = Transformer(cfg)
         cfg_ok = dataclasses.replace(cfg, use_ring_attention=False)
         params = Transformer(cfg_ok).init(jax.random.PRNGKey(0), tokens)
-        with pytest.raises(ValueError, match="sequence parallelism"):
-            model.apply(params, tokens, mesh=mesh)
+        with pytest.raises(ValueError, match="flash ring"):
+            Transformer(cfg).apply(params, tokens, mesh=mesh)
+        cfg_plain_ring = dataclasses.replace(
+            cfg, sp_strategy="ring", use_flash_attention=False)
+        with pytest.raises(ValueError, match="flash ring"):
+            Transformer(cfg_plain_ring).apply(params, tokens, mesh=mesh)
