@@ -165,7 +165,7 @@ def test_adversarial_drill(tmp_path, corpus_dir):
     env.pop("XLA_FLAGS", None)  # single-device control, no virtual mesh
     control = subprocess.run(
         _train_command(steps, corpus_dir), env=env, cwd=REPO,
-        capture_output=True, text=True, timeout=420)
+        capture_output=True, text=True, timeout=600)
     assert control.returncode == 0, control.stdout + control.stderr
     m = FINAL_LOSS_RE.search(control.stderr + control.stdout)
     assert m, control.stdout + control.stderr
