@@ -477,15 +477,17 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
     from k8s_tpu.models import train as train_lib
     from k8s_tpu.models.transformer import Transformer, TransformerConfig
 
+    def _env_int(name):
+        raw = os.environ.get(name)
+        return int(raw) if raw else None
+
+    seq = _env_int("BENCH_SEQ") or seq
     n_chips = len(jax.devices())
     batch = batch_per_chip * n_chips
 
     on_tpu = jax.default_backend() == "tpu"
     if use_flash is None:
         use_flash = on_tpu  # Pallas kernel is TPU-only
-    def _env_int(name):
-        raw = os.environ.get(name)
-        return int(raw) if raw else None
 
     cfg = TransformerConfig(
         vocab_size=32000, hidden=768, ffn_hidden=3072, layers=12, heads=12,
@@ -493,6 +495,9 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
         use_flash_attention=use_flash,
         flash_block_q=_env_int("BENCH_FLASH_BLOCK_Q"),
         flash_block_k=_env_int("BENCH_FLASH_BLOCK_K"),
+        # sliding-window A/B knob (flash path only; kernels skip
+        # out-of-window tiles, so this measures the O(L*window) claim)
+        window_size=_env_int("BENCH_WINDOW") if use_flash else None,
     )
     model = Transformer(cfg)
     tokens = jax.random.randint(
@@ -528,10 +533,14 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
         what="transformer compile",
     )
     # Analytic model FLOPs for MFU: 6N per token (fwd+bwd dense, incl. the
-    # tied-embedding logits matmul) + attention 12*layers*hidden*seq
-    # (full-matrix convention). XLA's count reported as a cross-check.
+    # tied-embedding logits matmul) + attention 12*layers*hidden*ctx
+    # (full-matrix convention; ctx = window when SWA bounds the context —
+    # crediting skipped tiles would inflate MFU). XLA's count is the
+    # cross-check.
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    flops = (6 * n_params + 12 * cfg.layers * cfg.hidden * seq) * batch * seq
+    attn_ctx = min(seq, cfg.window_size) if cfg.window_size else seq
+    flops = (6 * n_params
+             + 12 * cfg.layers * cfg.hidden * attn_ctx) * batch * seq
     xla_flops = cost_analysis_flops(step_c)
 
     def run_step(state):
@@ -559,6 +568,8 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
         "n_params": n_params,
         "flash_attention": cfg.use_flash_attention,
         "fused_ce": use_fused_ce,
+        "window": cfg.window_size,
+        "seq": seq,
     }
 
 
@@ -645,6 +656,10 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
         out["transformer_n_params"] = transformer["n_params"]
         out["transformer_flash_attention"] = transformer["flash_attention"]
         out["transformer_fused_ce"] = transformer["fused_ce"]
+        if transformer.get("window"):
+            out["transformer_window"] = transformer["window"]
+        if transformer.get("seq"):
+            out["transformer_seq"] = transformer["seq"]
         if control:
             out["transformer_xla_attention_tokens_per_sec"] = round(
                 control["tokens_per_sec_per_chip"], 1
@@ -655,7 +670,11 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
                 4,
             )
         base = baseline.get("transformer_tokens_per_sec_per_chip")
-        if base:
+        # the baseline is the default shape (seq 1024, no window): a
+        # seq/window-overridden run must not report a phantom ratio
+        default_shape = (transformer.get("seq", 1024) == 1024
+                         and not transformer.get("window"))
+        if base and default_shape:
             out["transformer_vs_baseline"] = round(
                 out["transformer_tokens_per_sec_per_chip"] / base, 4
             )
@@ -710,7 +729,9 @@ def main() -> int:
     # winner).  For those runs an outage must be a hard failure.  Smoke runs
     # are non-default shapes for the same reason (they already don't persist).
     stale_ok = not (os.environ.get("BENCH_NO_PERSIST")
-                    or os.environ.get("BENCH_SMOKE"))
+                    or os.environ.get("BENCH_SMOKE")
+                    or os.environ.get("BENCH_SEQ")
+                    or os.environ.get("BENCH_WINDOW"))
 
     def emit(allow_stale: bool, device_kind=None, n_chips=None) -> int:
         """Print the JSON line; return an exit code.
@@ -732,6 +753,7 @@ def main() -> int:
             missing.append("transformer")
         if (want_transformer and have_transformer
                 and out.get("transformer_flash_attention")
+                and not out.get("transformer_window")
                 and not os.environ.get("BENCH_NO_CONTROL")
                 and "flash_attention_speedup" not in out):
             # the XLA-attention control was expected (flash ran, control not
@@ -826,8 +848,10 @@ def main() -> int:
     if os.environ.get("BENCH_SMOKE"):
         rn_kw = dict(batch_per_chip=2, iters=2, warmup=1)
         tf_kw = dict(batch_per_chip=1, seq=128, iters=2, warmup=1)
-    if os.environ.get("BENCH_SMOKE") and on_hardware:
-        on_hardware = False  # smoke shapes must not overwrite real evidence
+    if on_hardware and (os.environ.get("BENCH_SMOKE")
+                        or os.environ.get("BENCH_SEQ")
+                        or os.environ.get("BENCH_WINDOW")):
+        on_hardware = False  # non-default shapes must not overwrite evidence
 
     try:
         if want_resnet:
@@ -837,7 +861,9 @@ def main() -> int:
             transformer = bench_transformer(**tf_kw)
             recorder.record("transformer", transformer, on_hardware,
                             device_kind)
-            if transformer["flash_attention"] and not os.environ.get("BENCH_NO_CONTROL"):
+            if (transformer["flash_attention"]
+                    and not transformer.get("window")
+                    and not os.environ.get("BENCH_NO_CONTROL")):
                 # XLA-attention control: same model/shapes, flash off, fewer
                 # repeats — it exists to anchor the flash speedup in the
                 # artifact, not to be a precision measurement of the slow path.

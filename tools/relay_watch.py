@@ -78,10 +78,10 @@ def build_plan() -> list[dict]:
                  "BENCH_TOTAL_TIMEOUT": "1380",
                  "BENCH_PREFLIGHT_WINDOW": "60"},
          "timeout": 1500},
-        {"label": "flash_tile_sweep",  # 5 variants x 650s + slack
+        {"label": "flash_tile_sweep",  # 5 tiles x 650s + 2 SWA x 1300s
          "argv": [PY, sweep, "transformer", "--repeats", "2",
                   "--timeout", "650"],
-         "env": {}, "timeout": 3600},
+         "env": {}, "timeout": 6600},
         {"label": "full_bench",
          "argv": [PY, bench_py],
          "env": {"BENCH_PREFLIGHT_WINDOW": "120",

@@ -36,10 +36,21 @@ TRANSFORMER_VARIANTS = [
      "env": {"BENCH_FLASH_BLOCK_Q": "1024", "BENCH_FLASH_BLOCK_K": "1024"}},
     {"name": "flash-256x1024",
      "env": {"BENCH_FLASH_BLOCK_Q": "256", "BENCH_FLASH_BLOCK_K": "1024"}},
+    # sliding-window A/B at seq 2048 (vs the full-causal seq-2048 control):
+    # measures the bounded-grid O(L*window) claim on hardware.  Separate
+    # GROUP: these are an A/B pair, not tile candidates — mixing them into
+    # the tile ranking would let a window (cheaper per token by design)
+    # "win" the tile sweep.  Longer per-variant budget: ~2x tokens and up
+    # to 4x attention work per step plus a fresh seq-2048 compile.
+    {"name": "swa-2048-w512", "group": "swa", "timeout": 1300,
+     "env": {"BENCH_SEQ": "2048", "BENCH_WINDOW": "512"}},
+    {"name": "causal-2048-control", "group": "swa", "timeout": 1300,
+     "env": {"BENCH_SEQ": "2048"}},
 ]
 
 
 def run_variant(which: str, variant: dict, repeats: int, timeout: float):
+    timeout = variant.get("timeout", timeout)
     env = dict(os.environ)
     env.update(variant["env"])
     env.update({
@@ -98,17 +109,29 @@ def main(argv=None) -> int:
               f"{res.get('value', res.get('error'))}",
               file=sys.stderr, flush=True)
 
+    by_name = {v["name"]: v for v in variants}
     ok = [r for r in results if "value" in r and r["value"]]
-    ok.sort(key=lambda r: -r["value"])
-    for r in ok:
-        print(f"{r['name']:>18}: {r['value']:>10.1f} ± {r.get('std') or 0:.1f}")
+    # rank/report per GROUP: the default group competes for the config
+    # crown; A/B groups (e.g. "swa") are comparisons, never winners
+    main_ok = [r for r in ok if not by_name[r["name"]].get("group")]
+    main_ok.sort(key=lambda r: -r["value"])
+    for r in sorted(ok, key=lambda r: -r["value"]):
+        group = by_name[r["name"]].get("group")
+        tag = f" [{group}]" if group else ""
+        print(f"{r['name']:>22}: {r['value']:>10.1f} "
+              f"± {r.get('std') or 0:.1f}{tag}")
     for r in results:
         if "error" in r:
-            print(f"{r['name']:>18}: ERROR {r['error']}")
-    if ok:
-        print(json.dumps({"winner": ok[0]["name"], "value": ok[0]["value"],
-                          "variants_ok": len(ok),
-                          "variants_total": len(variants)}))
+            print(f"{r['name']:>22}: ERROR {r['error']}")
+    if main_ok:
+        out = {"winner": main_ok[0]["name"], "value": main_ok[0]["value"],
+               "variants_ok": len(ok), "variants_total": len(variants)}
+        groups = sorted({by_name[r["name"]].get("group")
+                         for r in ok if by_name[r["name"]].get("group")})
+        for g in groups:
+            out[f"{g}_ab"] = {r["name"]: r["value"] for r in ok
+                              if by_name[r["name"]].get("group") == g}
+        print(json.dumps(out))
     # Partial success exits nonzero: a caller that marks a sweep "done" on
     # rc=0 (tools/relay_watch.py) must not lose the variants the relay ate —
     # a winner picked from a one-variant table is not an A/B.
