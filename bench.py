@@ -499,6 +499,13 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
         # out-of-window tiles, so this measures the O(L*window) claim)
         window_size=_env_int("BENCH_WINDOW") if use_flash else None,
     )
+    if _env_int("BENCH_WINDOW") and not use_flash:
+        # dropping the window silently would let an 'swa' variant measure
+        # full-causal attention under a windowed name — a ~1.0x A/B that
+        # reads as "SWA gives no speedup" when it never ran
+        raise SystemExit(
+            "BENCH_WINDOW needs the flash path (TPU backend); refusing to "
+            "run the windowed variant as full-causal attention")
     model = Transformer(cfg)
     tokens = jax.random.randint(
         jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size

@@ -34,6 +34,12 @@ log = logging.getLogger("train_lm")
 
 PRESETS = ("tiny", "gpt2-small", "bert-base", "llama-8b")
 
+# What --fused_ce auto resolves to, set by the measured hardware A/B
+# (BASELINE.md "Transformer tokens/sec/chip" row; tools/relay_watch.py
+# fused_ce_on/off items).  Exactness is not in question (the fused head is
+# bit-tested against the materialized one); this records which is FASTER.
+_FUSED_CE_AUTO = False
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
@@ -66,6 +72,13 @@ def parse_args(argv=None):
                    help="pp microbatches per step (0: auto = 2*pp)")
     p.add_argument("--remat", action="store_true",
                    help="checkpoint each layer (HBM for FLOPs)")
+    p.add_argument("--fused_ce", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="fused linear+cross-entropy head: the [B, L, vocab] "
+                   "logits never materialize (ops.fused_ce; loss-exact vs "
+                   "the materialized head).  auto follows the hardware A/B "
+                   "in BASELINE.md; on/off force it (off is the fallback "
+                   "if the fused path misbehaves)")
     p.add_argument("--data_dir", default="",
                    help="token-shard directory (models.dataset format: "
                    "checksummed .npy shards + MANIFEST.json); empty uses "
@@ -126,6 +139,10 @@ def build_config(args, on_tpu: bool):
         raise SystemExit("--tp does nothing under --pp yet (stage compute "
                          "is replicated over tp inside the pp shard_map, "
                          "wasting those devices); use --tp 1 with --pp")
+    if args.pp > 1 and args.fused_ce == "on":
+        raise SystemExit("--fused_ce on does not reach the pipeline step "
+                         "(pp uses its own fused-loss step_fn); use "
+                         "--fused_ce off with --pp")
     return dataclasses.replace(
         cfg,
         max_seq_len=max(cfg.max_seq_len, args.seq_len),
@@ -242,10 +259,23 @@ def main(argv=None) -> int:
     else:
         state = train_lib.init_state(params, optimizer)
 
-    apply_fn = (lambda p, t: model.apply(p, t, mesh=mesh))
+    # Fused head eligibility: pp runs its own step_fn (apply_fn unused), so
+    # "auto" demotes to off there ("on" was refused in build_config before
+    # any heavy setup).  Every preset ties the head to the embedding
+    # (transformer.py tied-embeddings head) — the matmul fused_ce folds in.
+    fused = args.pp == 1 and (
+        args.fused_ce == "on"
+        or (args.fused_ce == "auto" and _FUSED_CE_AUTO))
+    if fused:
+        apply_fn = train_lib.make_fused_lm_apply_fn(model, mesh=mesh)
+        loss_fn = train_lib.fused_loss_passthrough
+        log.info("fused linear+cross-entropy head (logits never materialize)")
+    else:
+        apply_fn = (lambda p, t: model.apply(p, t, mesh=mesh))
+        loss_fn = train_lib.lm_loss
     try:
         result = train_lib.fit(
-            apply_fn, train_lib.lm_loss, optimizer, state, mesh, data_iter,
+            apply_fn, loss_fn, optimizer, state, mesh, data_iter,
             steps=args.train_steps,
             checkpoint_dir=args.train_dir,
             checkpoint_every=args.checkpoint_every,

@@ -57,6 +57,31 @@ class TestTrainLM:
         assert third.returncode == 0, third.stderr
         assert "already complete" in third.stderr, third.stderr[-600:]
 
+    def test_fused_ce_loss_exact(self, tmp_path):
+        """--fused_ce on trains through make_fused_lm_apply_fn and the
+        logged losses match the materialized head exactly (same seed, same
+        data): the production wiring, not just the op, is loss-exact."""
+        import re
+
+        on = run_lm(tmp_path / "on", BASE + ["--train_steps=4",
+                                             "--fused_ce=on"])
+        assert on.returncode == 0, on.stderr
+        assert "fused linear+cross-entropy" in on.stderr
+        off = run_lm(tmp_path / "off", BASE + ["--train_steps=4",
+                                               "--fused_ce=off"])
+        assert off.returncode == 0, off.stderr
+        losses = [re.findall(r"step \d+ loss ([\d.]+)", r.stderr)
+                  for r in (on, off)]
+        assert losses[0] and losses[0] == losses[1], losses
+
+    def test_fused_ce_on_refuses_pp(self, tmp_path):
+        """--fused_ce on under --pp would silently measure nothing (pp uses
+        its own step_fn); the combination must refuse, not no-op."""
+        out = run_lm(tmp_path, BASE + ["--train_steps=2", "--pp=2",
+                                       "--fused_ce=on"])
+        assert out.returncode != 0
+        assert "--fused_ce on" in out.stderr
+
     def test_ring_attention_sp_axis(self, tmp_path):
         """sp=2 turns on ring attention over the mesh's sp axis."""
         out = run_lm(tmp_path, BASE + ["--train_steps=2", "--sp=2"])

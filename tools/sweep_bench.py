@@ -123,9 +123,14 @@ def main(argv=None) -> int:
     for r in results:
         if "error" in r:
             print(f"{r['name']:>22}: ERROR {r['error']}")
-    if main_ok:
-        out = {"winner": main_ok[0]["name"], "value": main_ok[0]["value"],
-               "variants_ok": len(ok), "variants_total": len(variants)}
+    if ok:
+        # emit whenever ANYTHING succeeded: if the relay ate every tile
+        # variant but the A/B groups landed, their hardware evidence must
+        # still reach the machine-readable line ("winner" becomes optional)
+        out = {"variants_ok": len(ok), "variants_total": len(variants)}
+        if main_ok:
+            out["winner"] = main_ok[0]["name"]
+            out["value"] = main_ok[0]["value"]
         groups = sorted({by_name[r["name"]].get("group")
                          for r in ok if by_name[r["name"]].get("group")})
         for g in groups:
