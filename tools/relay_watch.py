@@ -36,6 +36,7 @@ PY = sys.executable
 
 sys.path.insert(0, REPO)
 import bench  # noqa: E402  (probe protocol's single source of truth)
+import sweep_bench  # noqa: E402  (variant matrix's single source of truth)
 
 
 def now() -> str:
@@ -48,44 +49,84 @@ def log(msg: str) -> None:
 
 # Priority order: the resnet stem A/B and fused-CE A/B are the two open
 # headline questions (VERDICT r3 weak #2/#3); the full default bench run
-# (which refreshes BENCH_LASTGOOD at full repeats) comes after the A/Bs
-# because a last-good record from round 3's shapes already exists the
-# moment the first A/B lands.
+# (which refreshes BENCH_LASTGOOD at full repeats) comes last because a
+# last-good record from round 2/3's shapes already exists the moment the
+# first A/B lands.
+#
+# Items are WINDOW-SIZED: one variant per item, 2 repeats.  The first
+# round-4 relay window lasted ~7 minutes and a 2-variant x 1000s sweep item
+# died mid-variant having landed nothing; single-variant items mean every
+# window that survives one compile+measure cycle banks one number, and the
+# A/B pairs are adjacent so a single healthy window measures both sides.
+# A persistent XLA compilation cache (shared dir below) lets a re-attempt
+# after a mid-compile relay death skip straight to measurement when the
+# backend supports executable serialization.
+# A mid-compile relay death costs the whole compile; the persistent cache
+# lets the re-attempt skip straight to measurement when the backend
+# supports executable serialization.
+CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1",
+}
+
+
+def _variant_env(variants: list[dict], name: str) -> dict:
+    for v in variants:
+        if v["name"] == name:
+            return dict(v["env"])
+    raise KeyError(f"sweep_bench variant {name!r} not found")
+
+
 def build_plan() -> list[dict]:
     bench_py = os.path.join(REPO, "bench.py")
-    sweep = os.path.join(REPO, "tools", "sweep_bench.py")
-    # Timeout coordination: each bench item's BENCH_TOTAL_TIMEOUT sits below
-    # the subprocess kill so bench's watchdog gets to emit its diagnostic +
-    # partial evidence before rc=124 erases it; each sweep's per-variant
-    # --timeout is sized so all variants fit inside the item budget (the
-    # sweep already sets the per-variant BENCH_TOTAL_TIMEOUT under it).
+    base = {
+        "BENCH_REPEATS": "2",
+        "BENCH_NO_CONTROL": "1",
+        "BENCH_PREFLIGHT_WINDOW": "60",
+        **CACHE_ENV,
+    }
+
+    def item(label, extra_env, timeout=1500, only=None, persist=False):
+        env = dict(base)
+        env.update(extra_env)
+        if only:
+            env["BENCH_ONLY"] = only
+        if not persist:
+            # non-default configs stay out of the last-good-on-hardware
+            # record; the battery log (sweeps_r04/) is their artifact
+            env["BENCH_NO_PERSIST"] = "1"
+        # bench's watchdog must fire before the subprocess kill so it can
+        # emit its diagnostic + partial evidence before rc=124 erases it
+        env["BENCH_TOTAL_TIMEOUT"] = str(timeout - 120)
+        return {"label": label, "argv": [PY, bench_py], "env": env,
+                "timeout": timeout}
+
+    rn = sweep_bench.RESNET_VARIANTS
+    tf = sweep_bench.TRANSFORMER_VARIANTS
+    # flash tile candidates: the sweep matrix's non-group entries with a
+    # non-default env (the default tile is measured by the fused_ce_off row)
+    tiles = [v for v in tf if v["env"] and not v.get("group")]
+    # the SWA pair measures the O(L*window) claim at seq 2048: ~2x tokens
+    # and up to 4x attention work per step, plus a fresh seq-2048 compile
+    swa = [v for v in tf if v.get("group") == "swa"]
     return [
-        {"label": "resnet_stem_ab",  # 2 variants x 1000s + slack
-         "argv": [PY, sweep, "resnet", "--repeats", "3",
-                  "--timeout", "1000"],
-         "env": {}, "timeout": 2400},
-        {"label": "fused_ce_on",
-         "argv": [PY, bench_py],
-         "env": {"BENCH_ONLY": "transformer", "BENCH_FUSED_CE": "1",
-                 "BENCH_NO_CONTROL": "1", "BENCH_REPEATS": "3",
-                 "BENCH_NO_PERSIST": "1", "BENCH_TOTAL_TIMEOUT": "1380",
-                 "BENCH_PREFLIGHT_WINDOW": "60"},
-         "timeout": 1500},
-        {"label": "fused_ce_off",
-         "argv": [PY, bench_py],
-         "env": {"BENCH_ONLY": "transformer", "BENCH_NO_CONTROL": "1",
-                 "BENCH_REPEATS": "3", "BENCH_NO_PERSIST": "1",
-                 "BENCH_TOTAL_TIMEOUT": "1380",
-                 "BENCH_PREFLIGHT_WINDOW": "60"},
-         "timeout": 1500},
-        {"label": "flash_tile_sweep",  # 5 tiles x 650s + 2 SWA x 1300s
-         "argv": [PY, sweep, "transformer", "--repeats", "2",
-                  "--timeout", "650"],
-         "env": {}, "timeout": 6600},
+        # resnet stem A/B: s2d is the unmeasured side (conv has the round-3
+        # number 2627±13); conv re-measures adjacently as the same-window
+        # control and refreshes the last-good record (it is the default)
+        item("resnet_s2d", _variant_env(rn, "s2d-stem"), only="resnet"),
+        item("resnet_conv", _variant_env(rn, "conv-stem"), only="resnet",
+             persist=True),
+        item("fused_ce_on", {"BENCH_FUSED_CE": "1"}, only="transformer"),
+        item("fused_ce_off", {}, only="transformer", persist=True),
+        *[item("flash_" + v["name"].removeprefix("flash-"), dict(v["env"]),
+               only="transformer") for v in tiles],
+        *[item(v["name"].replace("-", "_"), dict(v["env"]),
+               only="transformer", timeout=1800) for v in swa],
         {"label": "full_bench",
          "argv": [PY, bench_py],
          "env": {"BENCH_PREFLIGHT_WINDOW": "120",
-                 "BENCH_TOTAL_TIMEOUT": "2550"},
+                 "BENCH_TOTAL_TIMEOUT": "2550",
+                 **CACHE_ENV},
          "timeout": 2700},
     ]
 

@@ -60,9 +60,9 @@ def run_variant(which: str, variant: dict, repeats: int, timeout: float):
         # variants explore non-default configs; keep them out of the
         # last-good-on-hardware record (the sweep table is their artifact)
         "BENCH_NO_PERSIST": "1",
-        # the caller (relay_watch) is the retry loop — a mid-sweep relay
-        # death must fail each remaining variant in ~1min, not burn the
-        # default 600s preflight window per variant
+        # the caller owns retries — a mid-sweep relay death must fail each
+        # remaining variant in ~1min, not burn the default 600s preflight
+        # window per variant
         "BENCH_PREFLIGHT_WINDOW": "60",
         # floor: a small --timeout must not arm bench.py's watchdog with a
         # zero/negative budget (it would os._exit immediately)
@@ -138,8 +138,8 @@ def main(argv=None) -> int:
                               if by_name[r["name"]].get("group") == g}
         print(json.dumps(out))
     # Partial success exits nonzero: a caller that marks a sweep "done" on
-    # rc=0 (tools/relay_watch.py) must not lose the variants the relay ate —
-    # a winner picked from a one-variant table is not an A/B.
+    # rc=0 must not lose the variants the relay ate — a winner picked from
+    # a one-variant table is not an A/B.
     if len(ok) == len(variants):
         return 0
     return 1 if not ok else 3
