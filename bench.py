@@ -74,10 +74,24 @@ def is_transient(err: BaseException) -> bool:
 
 
 def with_retries(fn, attempts: int = 5, base_delay: float = 5.0, what: str = ""):
-    """Run fn(), retrying on relay/connectivity errors with exp backoff."""
+    """Run fn(), retrying on relay/connectivity errors with exp backoff.
+
+    Every device-touching phase routes through here, so the start/done
+    lines below double as the bench's phase trace: when the relay dies
+    mid-run, the log tail shows exactly WHICH phase (init / compile /
+    timing) absorbed the hang — round 4's first window died 23 minutes
+    into an unattributable silence.
+    """
+    t0 = time.perf_counter()
+    print(f"bench: [{_utcnow()}] start {what or 'device work'}",
+          file=sys.stderr, flush=True)
     for i in range(attempts):
         try:
-            return fn()
+            out = fn()
+            print(f"bench: [{_utcnow()}] done {what or 'device work'} "
+                  f"in {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr, flush=True)
+            return out
         except Exception as e:  # noqa: BLE001 - jax raises various XlaRuntimeError subclasses
             if not is_transient(e) or i == attempts - 1:
                 raise

@@ -61,9 +61,6 @@ def log(msg: str) -> None:
 # A persistent XLA compilation cache (shared dir below) lets a re-attempt
 # after a mid-compile relay death skip straight to measurement when the
 # backend supports executable serialization.
-# A mid-compile relay death costs the whole compile; the persistent cache
-# lets the re-attempt skip straight to measurement when the backend
-# supports executable serialization.
 CACHE_ENV = {
     "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
     "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1",
