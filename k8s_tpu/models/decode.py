@@ -1,0 +1,133 @@
+"""Autoregressive inference driver: jit-able prefill + KV-cached token loop.
+
+The reference repo has no serving story at all (it is a training operator);
+this is the framework's inference surface for the Transformer family, built
+TPU-first:
+
+- the whole generation — prefill, every decode step, and sampling — is one
+  jit program: the token loop is a ``lax.scan`` with a static step count
+  (no data-dependent Python control flow, one compile, static shapes);
+- K/V caches live in the flax ``cache`` collection threaded through the
+  scan carry as ordinary pytree state (transformer.Attention._decode_step);
+- sliding-window configs decode from an O(window) ring-buffer cache, so
+  long-context inference memory is bounded by the window, not the sequence;
+- EOS is handled with a done-mask (finished rows emit ``pad_id`` and stop
+  advancing), keeping the scan shape-static instead of early-exiting.
+
+Reference parity note: the closest upstream artifact is the smoke
+workload's inference-free matmul graph (tf_smoke); decode exists because a
+complete LM framework needs it, not because the operator did.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from k8s_tpu.models.transformer import Transformer, TransformerConfig
+
+
+def sample_logits(logits, rng, temperature: float = 0.0,
+                  top_k: Optional[int] = None):
+    """Sample next tokens from [B, V] logits.
+
+    temperature == 0 is greedy argmax (rng unused); otherwise softmax
+    sampling at the given temperature, optionally truncated to the top_k
+    highest-probability tokens (mask, not gather — XLA-friendly and
+    shape-static).
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def make_generate_fn(config: TransformerConfig, max_new_tokens: int,
+                     temperature: float = 0.0, top_k: Optional[int] = None,
+                     eos_id: Optional[int] = None, pad_id: int = 0):
+    """Build ``generate(params, prompt, rng) -> [B, max_new_tokens]``.
+
+    The returned function is jit-compiled once per (config, prompt shape):
+    prefill consumes the prompt and populates the caches, then a
+    ``lax.scan`` of single-token steps carries ``(cache, token, position,
+    done, rng)``.  Rows that emit ``eos_id`` are frozen to ``pad_id`` for
+    the remaining steps.
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    model = Transformer(config)
+
+    @jax.jit
+    def generate(params, prompt, rng):
+        B, Lp = prompt.shape
+        # the LAST sampled token is returned, never fed back, so the
+        # highest position written/attended is Lp + max_new_tokens - 2
+        if config.window_size is None and \
+                Lp + max_new_tokens - 1 > config.max_seq_len:
+            raise ValueError(
+                f"prompt ({Lp}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({config.max_seq_len}) and no "
+                "window_size is set (the full KV cache is max_seq_len "
+                "long; sliding-window configs decode indefinitely)")
+        logits, varz = model.apply(
+            {"params": params}, prompt, mode="prefill", mutable=["cache"])
+        rng, sub = jax.random.split(rng)
+        tok = sample_logits(logits[:, -1], sub, temperature, top_k)
+        # EOS itself is emitted; rows freeze to pad_id from the NEXT step
+        done = (tok == eos_id) if eos_id is not None \
+            else jnp.zeros((B,), bool)
+
+        def step(carry, _):
+            cache, tok, pos, done, rng = carry
+            logits, varz = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None], positions=pos[:, None], mode="decode",
+                mutable=["cache"])
+            rng, sub = jax.random.split(rng)
+            nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
+            nxt = jnp.where(done, pad_id, nxt)
+            if eos_id is not None:
+                done = done | (nxt == eos_id)
+            return (varz["cache"], nxt, pos + 1, done, rng), nxt
+
+        pos = jnp.full((B,), Lp, jnp.int32)
+        carry = (varz["cache"], tok, pos, done, rng)
+        if max_new_tokens == 1:
+            return tok[:, None]
+        _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
+        return jnp.concatenate([tok[:, None], rest.T], axis=1)
+
+    return generate
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_generate_fn(config, max_new_tokens, temperature, top_k, eos_id,
+                        pad_id):
+    return make_generate_fn(config, max_new_tokens, temperature=temperature,
+                            top_k=top_k, eos_id=eos_id, pad_id=pad_id)
+
+
+def generate(config: TransformerConfig, params, prompt, max_new_tokens: int,
+             rng=None, temperature: float = 0.0, top_k: Optional[int] = None,
+             eos_id: Optional[int] = None, pad_id: int = 0):
+    """One-shot convenience wrapper around :func:`make_generate_fn`.
+
+    Caches the compiled function per sampling config (TransformerConfig is
+    a frozen dataclass, so it is hashable) — repeated calls with the same
+    shapes reuse the executable.
+
+    NOTE: ``rng`` defaults to ``PRNGKey(0)``, so temperature-sampling
+    calls that omit it are deterministic across invocations by design
+    (reproducibility-first); pass a fresh key per call for fresh samples.
+    """
+    fn = _cached_generate_fn(config, max_new_tokens, temperature, top_k,
+                             eos_id, pad_id)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return fn(params, jnp.asarray(prompt, jnp.int32), rng)

@@ -55,10 +55,12 @@ class TransformerConfig:
     flash_block_q: Optional[int] = None
     flash_block_k: Optional[int] = None
     use_fused_norm: bool = False  # Pallas RMSNorm kernel (k8s_tpu.ops)
-    # Sliding-window attention (Mistral/Gemma-style): each query attends the
-    # window most recent positions.  Flash-kernel path only (out-of-window
-    # key blocks are SKIPPED — O(L*window) compute); not yet composed with
-    # the sp ring (would need per-step position offsets in the kernel).
+    # Sliding-window attention (Mistral/Gemma-style): each query attends
+    # the window most recent positions (0 <= q - k < window, causal only).
+    # Flash path bounds the kernel GRID (out-of-window key blocks are never
+    # DMA'd — O(L*window) compute); the plain path applies the same mask
+    # over the O(L^2) scores; the sp ring composes via the windowed ring
+    # (bounded neighbor hops).  Decode uses an O(window) ring-buffer cache.
     window_size: Optional[int] = None
     remat: bool = True  # jax.checkpoint each layer: HBM for FLOPs
     # MoE (k8s_tpu.models.moe): >0 swaps the dense MLP for routed experts
@@ -124,8 +126,24 @@ def rotary_embedding(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
-def _plain_attention(q, k, v, causal: bool):
-    """XLA attention with f32 softmax; fused by the compiler on TPU."""
+def _plain_attention(q, k, v, causal: bool, window: int | None = None):
+    """XLA attention with f32 softmax; fused by the compiler on TPU.
+
+    ``window`` applies the sliding-window mask ``0 <= q_pos - k_pos <
+    window`` — the same convention as the flash kernels'
+    ``_window_visible`` (ops/flash_attention.py), so the two paths are
+    interchangeable in exactness tests.  Here it is a mask over the full
+    O(L^2) score matrix (the flash path is where the compute bound lives).
+    The flash kernels' contract is enforced here too: a window is a causal
+    construction and must be >= 1 (window=0 would mask EVERY key and
+    softmax a row of -1e30s into uniform garbage).
+    """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (matching "
+                             "ops.flash_attention's contract)")
+        if window < 1:
+            raise ValueError("window must be >= 1")
     B, L, H, D = q.shape
     kv_heads = k.shape[2]
     if kv_heads != H:  # grouped-query: repeat kv heads
@@ -133,8 +151,14 @@ def _plain_attention(q, k, v, causal: bool):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D ** -0.5)
-    if causal:
-        mask = jnp.tril(jnp.ones((L, L), bool))
+    if causal or window is not None:
+        qpos = jnp.arange(L)[:, None]
+        kpos = jnp.arange(L)[None, :]
+        mask = jnp.ones((L, L), bool)
+        if causal:
+            mask &= kpos <= qpos
+        if window is not None:
+            mask &= qpos - kpos < window
         scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
@@ -147,8 +171,74 @@ class Attention(nn.Module):
     config: TransformerConfig
     mesh: Any = None
 
+    def _cache_vars(self, batch: int):
+        """KV cache for autoregressive decoding (flax ``cache`` collection).
+
+        Cache length is ``window_size`` when sliding-window attention is
+        configured — a RING BUFFER (slot = position % window): decode
+        memory is O(window), not O(max_seq_len), which is the whole point
+        of SWA at inference (Mistral-style).  Keys are stored
+        post-rotary (RoPE is absolute-position, applied at write time), and
+        per-slot absolute positions make the validity/window mask exact in
+        both regimes.
+        """
+        cfg = self.config
+        # ring size is the WINDOW, not min(window, max_seq_len): a window
+        # wider than max_seq_len still needs all window slots once decoding
+        # runs past max_seq_len, or the cache would silently narrow it
+        S = cfg.window_size or cfg.max_seq_len
+        shape = (batch, S, cfg.kv_heads, cfg.dims_per_head)
+        ck = self.variable("cache", "k", jnp.zeros, shape, cfg.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, shape, cfg.dtype)
+        cp = self.variable(
+            "cache", "pos", lambda: jnp.full((batch, S), -1, jnp.int32))
+        return ck, cv, cp, S
+
+    def _decode_step(self, q, k, v, positions):
+        """One cached decode step: write this token's K/V, attend the cache.
+
+        q/k/v are [B, 1, H(kv), D] post-rotary; positions is [B, 1]
+        absolute.  The ring-buffer overwrite happens BEFORE attending, so
+        at position p the cache holds exactly positions p-S+1..p (once
+        warm) — the flash kernels' window convention 0 <= q_pos - k_pos <
+        window falls out of the buffer size, no extra window mask needed.
+        """
+        cfg = self.config
+        B = q.shape[0]
+        ck, cv, cp, S = self._cache_vars(B)
+        b = jnp.arange(B)
+        slot = positions[:, 0] % S
+        ck.value = ck.value.at[b, slot].set(k[:, 0].astype(cfg.dtype))
+        cv.value = cv.value.at[b, slot].set(v[:, 0].astype(cfg.dtype))
+        cp.value = cp.value.at[b, slot].set(positions[:, 0])
+        keys, values, kpos = ck.value, cv.value, cp.value
+        if cfg.kv_heads != cfg.heads:  # grouped-query: repeat at attend time
+            rep = cfg.heads // cfg.kv_heads
+            keys = jnp.repeat(keys, rep, axis=2)
+            values = jnp.repeat(values, rep, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+        scores = scores * (cfg.dims_per_head ** -0.5)
+        valid = kpos >= 0  # unfilled slots; ring overwrite enforces window
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(values.dtype), values)
+
+    def _prefill_write(self, k, v, positions):
+        """Scatter the prompt's last min(L, S) K/V into the cache."""
+        B, L = k.shape[:2]
+        ck, cv, cp, S = self._cache_vars(B)
+        keep = min(L, S)
+        b = jnp.arange(B)[:, None]
+        last_pos = positions[:, L - keep:]
+        slots = last_pos % S
+        ck.value = ck.value.at[b, slots].set(
+            k[:, L - keep:].astype(self.config.dtype))
+        cv.value = cv.value.at[b, slots].set(
+            v[:, L - keep:].astype(self.config.dtype))
+        cp.value = cp.value.at[b, slots].set(last_pos)
+
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, mode: str = "train"):
         cfg = self.config
         mesh = self.mesh
         D = cfg.dims_per_head
@@ -162,7 +252,29 @@ class Attention(nn.Module):
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
 
-        if cfg.use_ring_attention and mesh is not None:
+        if mode == "decode":
+            out = self._decode_step(q, k, v, positions)
+        elif mode == "prefill":
+            # prompt attention is the ordinary causal (+window) pass; the
+            # only extra work is writing K/V into the cache for the token
+            # loop that follows
+            self._prefill_write(k, v, positions)
+            if cfg.use_flash_attention:
+                from k8s_tpu.ops import flash_attention
+                from k8s_tpu.ops.flash_attention import (
+                    DEFAULT_BLOCK_K,
+                    DEFAULT_BLOCK_Q,
+                )
+
+                out = flash_attention(
+                    q, k, v, causal=True, window=cfg.window_size,
+                    block_q=cfg.flash_block_q or DEFAULT_BLOCK_Q,
+                    block_k=cfg.flash_block_k or DEFAULT_BLOCK_K,
+                )
+            else:
+                out = _plain_attention(
+                    q, k, v, causal=True, window=cfg.window_size)
+        elif cfg.use_ring_attention and mesh is not None:
             if cfg.window_size is not None and not (
                     cfg.sp_strategy == "ring" and cfg.use_flash_attention):
                 raise ValueError(
@@ -245,12 +357,8 @@ class Attention(nn.Module):
                 window=cfg.window_size,
             )
         else:
-            if cfg.window_size is not None:
-                raise ValueError(
-                    "window_size requires use_flash_attention (the sliding "
-                    "window lives in the flash kernels; plain attention "
-                    "would silently ignore it)")
-            out = _plain_attention(q, k, v, cfg.causal)
+            out = _plain_attention(q, k, v, cfg.causal,
+                                   window=cfg.window_size)
 
         return nn.DenseGeneral(
             x.shape[-1], axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
@@ -277,11 +385,11 @@ class Block(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, mode: str = "train"):
         cfg = self.config
         fused = cfg.use_fused_norm
         y = Attention(cfg, mesh=self.mesh, name="attn")(
-            RMSNorm(fused=fused, name="attn_norm")(x), positions
+            RMSNorm(fused=fused, name="attn_norm")(x), positions, mode
         )
         x = x + y
         if cfg.num_experts > 0:
@@ -308,10 +416,33 @@ class Transformer(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, mesh=None, return_hidden: bool = False):
+    def __call__(self, tokens, mesh=None, return_hidden: bool = False,
+                 positions=None, mode: str = "train"):
+        """``mode``: "train" (the default full teacher-forced pass),
+        "prefill" (same pass + KV-cache population), or "decode" (one
+        cached token step; ``positions`` carries the absolute position).
+
+        Decode modes are single-device (or dp/tp-sharded) paths: the
+        sp ring and MoE routing are training-scale constructions and are
+        rejected rather than silently mis-composed (models/decode.py is
+        the driver).
+        """
         cfg = self.config
         B, L = tokens.shape
-        positions = jnp.broadcast_to(jnp.arange(L), (B, L))
+        if mode not in ("train", "prefill", "decode"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if mode != "train":
+            if not cfg.causal:
+                raise ValueError("decode modes require causal=True")
+            if cfg.use_ring_attention:
+                raise ValueError(
+                    "decode modes do not compose with the sp ring "
+                    "(use_ring_attention); decode on the unsharded or "
+                    "dp/tp mesh instead")
+            if cfg.num_experts > 0:
+                raise ValueError("decode modes do not support MoE yet")
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(L), (B, L))
         emb = self.param(
             "embedding",
             nn.initializers.normal(0.02),
@@ -320,9 +451,16 @@ class Transformer(nn.Module):
         )
         x = emb[tokens].astype(cfg.dtype)
 
-        block = nn.remat(Block) if cfg.remat else Block
+        # remat trades HBM for recompute in the backward pass; decode has
+        # no backward, and threading the static mode string through
+        # nn.remat would need static_argnums plumbing for zero benefit
+        block = nn.remat(Block) if (cfg.remat and mode == "train") else Block
         for i in range(cfg.layers):
-            x = block(cfg, mesh=mesh, name=f"layer_{i}")(x, positions)
+            if mode == "train":
+                x = block(cfg, mesh=mesh, name=f"layer_{i}")(x, positions)
+            else:
+                x = block(cfg, mesh=mesh, name=f"layer_{i}")(
+                    x, positions, mode)
 
         x = RMSNorm(fused=cfg.use_fused_norm, name="final_norm")(x)
         if return_hidden:

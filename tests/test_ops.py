@@ -489,10 +489,12 @@ class TestSlidingWindowAttention:
         cfg_full = dataclasses.replace(cfg, window_size=None)
         out_full = Transformer(cfg_full).apply(params, tokens)
         assert not np.allclose(np.asarray(out), np.asarray(out_full))
-        # guards: no silent ignore on unsupported paths
+        # the plain path APPLIES the window too (mask-based; it used to
+        # raise) — same convention, so it must agree with the flash path
         cfg_plain = dataclasses.replace(cfg, use_flash_attention=False)
-        with pytest.raises(ValueError, match="use_flash_attention"):
-            Transformer(cfg_plain).apply(params, tokens)
+        out_plain = Transformer(cfg_plain).apply(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out_plain), np.asarray(out), rtol=2e-5, atol=2e-5)
 
     def test_window_rejected_under_ring_and_below_one(self):
         import dataclasses
