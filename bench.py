@@ -69,6 +69,11 @@ _TRANSIENT = (
 
 
 def is_transient(err: BaseException) -> bool:
+    if isinstance(err, ProbeTimeout):
+        # a phase hang IS the relay failure mode (blocked socket I/O, no
+        # exception) — callers should fall back to partial/last-good
+        # evidence exactly like an UNAVAILABLE error after retries
+        return True
     msg = str(err).lower()
     return any(t in msg for t in _TRANSIENT)
 
@@ -85,14 +90,27 @@ def with_retries(fn, attempts: int = 5, base_delay: float = 5.0, what: str = "")
     t0 = time.perf_counter()
     print(f"bench: [{_utcnow()}] start {what or 'device work'}",
           file=sys.stderr, flush=True)
+    # BENCH_PHASE_TIMEOUT bounds each phase ATTEMPT: a hung relay then
+    # costs one phase budget (~minutes), not the whole watchdog window —
+    # short relay windows get more bench attempts per hour.  0 disables.
+    phase_timeout = float(os.environ.get("BENCH_PHASE_TIMEOUT", "0") or 0)
     for i in range(attempts):
         try:
-            out = fn()
+            if phase_timeout > 0:
+                out = run_with_timeout(fn, phase_timeout,
+                                       what or "device work")
+            else:
+                out = fn()
             print(f"bench: [{_utcnow()}] done {what or 'device work'} "
                   f"in {time.perf_counter() - t0:.1f}s",
                   file=sys.stderr, flush=True)
             return out
         except Exception as e:  # noqa: BLE001 - jax raises various XlaRuntimeError subclasses
+            if isinstance(e, ProbeTimeout):
+                # the hung attempt's thread still holds the backend lock;
+                # retrying in-process would just hang again — surface it
+                # so the caller emits partial/last-good and exits
+                raise
             if not is_transient(e) or i == attempts - 1:
                 raise
             delay = base_delay * (2 ** i)

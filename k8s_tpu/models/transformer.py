@@ -212,16 +212,21 @@ class Attention(nn.Module):
         cv.value = cv.value.at[b, slot].set(v[:, 0].astype(cfg.dtype))
         cp.value = cp.value.at[b, slot].set(positions[:, 0])
         keys, values, kpos = ck.value, cv.value, cp.value
-        if cfg.kv_heads != cfg.heads:  # grouped-query: repeat at attend time
-            rep = cfg.heads // cfg.kv_heads
-            keys = jnp.repeat(keys, rep, axis=2)
-            values = jnp.repeat(values, rep, axis=2)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+        # grouped-query via grouped einsum: query head j attends kv head
+        # j // rep (the same consecutive-duplication order as jnp.repeat
+        # on axis 2) WITHOUT materializing a heads/kv_heads-times larger
+        # copy of the cache inside the token loop's hot path
+        rep = cfg.heads // cfg.kv_heads
+        B_, Q_ = q.shape[0], q.shape[1]
+        qg = q.reshape(B_, Q_, cfg.kv_heads, rep, cfg.dims_per_head)
+        scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg, keys).astype(jnp.float32)
         scores = scores * (cfg.dims_per_head ** -0.5)
         valid = kpos >= 0  # unfilled slots; ring overwrite enforces window
-        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        scores = jnp.where(valid[:, None, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(values.dtype), values)
+        out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(values.dtype),
+                         values)
+        return out.reshape(B_, Q_, cfg.heads, cfg.dims_per_head)
 
     def _prefill_write(self, k, v, positions):
         """Scatter the prompt's last min(L, S) K/V into the cache."""

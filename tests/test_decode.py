@@ -71,6 +71,17 @@ class TestGreedyEquivalence:
         want = reference_greedy(cfg, params, prompt, 6)
         np.testing.assert_array_equal(got, want)
 
+    def test_gqa_with_windowed_ring_buffer(self):
+        # GQA grouping and the wrapped ring cache interact inside
+        # _decode_step (grouped einsum over ring slots + validity mask);
+        # exercise them TOGETHER, not only in isolation
+        cfg = tiny(kv_heads=2, window_size=4)
+        params = init_params(cfg, prompt_len=6)
+        prompt = (jnp.arange(12, dtype=jnp.int32).reshape(2, 6) * 9) % 61
+        got = np.asarray(generate(cfg, params, prompt, 8))
+        want = reference_greedy(cfg, params, prompt, 8)
+        np.testing.assert_array_equal(got, want)
+
     def test_windowed_decode_unbounded_by_max_seq_len(self):
         # sliding-window decode is O(window) memory and may run past
         # max_seq_len; the full-cache config must refuse the same ask

@@ -80,14 +80,21 @@ def build_plan() -> list[dict]:
         "BENCH_REPEATS": "2",
         "BENCH_NO_CONTROL": "1",
         "BENCH_PREFLIGHT_WINDOW": "60",
+        # a hung phase (relay death) fails the item in ~10min instead of
+        # burning the whole 23min watchdog budget — more attempts per
+        # relay window (bench.py with_retries BENCH_PHASE_TIMEOUT)
+        "BENCH_PHASE_TIMEOUT": "600",
         **CACHE_ENV,
     }
 
-    def item(label, extra_env, timeout=1500, only=None, persist=False):
+    def item(label, extra_env, timeout=1500, only=None, persist=False,
+             phase_timeout=None):
         env = dict(base)
         env.update(extra_env)
         if only:
             env["BENCH_ONLY"] = only
+        if phase_timeout is not None:
+            env["BENCH_PHASE_TIMEOUT"] = str(phase_timeout)
         if not persist:
             # non-default configs stay out of the last-good-on-hardware
             # record; the battery log (sweeps_r04/) is their artifact
@@ -118,11 +125,13 @@ def build_plan() -> list[dict]:
         *[item("flash_" + v["name"].removeprefix("flash-"), dict(v["env"]),
                only="transformer") for v in tiles],
         *[item(v["name"].replace("-", "_"), dict(v["env"]),
-               only="transformer", timeout=1800) for v in swa],
+               only="transformer", timeout=1800, phase_timeout=900)
+          for v in swa],
         {"label": "full_bench",
          "argv": [PY, bench_py],
          "env": {"BENCH_PREFLIGHT_WINDOW": "120",
                  "BENCH_TOTAL_TIMEOUT": "2550",
+                 "BENCH_PHASE_TIMEOUT": "900",
                  **CACHE_ENV},
          "timeout": 2700},
     ]
