@@ -182,6 +182,9 @@ _REQUIRED_KEYS = {
                     "step_time_ms", "n_params", "flash_attention",
                     "fused_ce", "flops_per_sec_per_chip"),
     "transformer_xla_control": ("tokens_per_sec_per_chip",),
+    "decode": ("tokens_per_sec_per_chip", "tokens_per_sec_per_chip_std",
+               "per_token_ms", "n_params", "batch_per_chip", "prompt_len",
+               "new_tokens"),
 }
 
 
@@ -493,6 +496,22 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5,
     }
 
 
+def _gpt2_small_config(max_seq_len: int, **overrides):
+    """The benchmarked GPT-2-small shape, shared by the training and decode
+    benches so their params/MFU always describe the SAME model."""
+    import jax.numpy as jnp
+
+    from k8s_tpu.models.transformer import TransformerConfig
+
+    kw = dict(
+        vocab_size=32000, hidden=768, ffn_hidden=3072, layers=12, heads=12,
+        kv_heads=12, max_seq_len=max_seq_len, dtype=jnp.bfloat16,
+        remat=False,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
 def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
                       iters: int = 30, warmup: int = 5,
                       use_flash: bool | None = None,
@@ -504,10 +523,9 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
     control so a single bench run can capture both numbers in the artifact.
     """
     import jax
-    import jax.numpy as jnp
 
     from k8s_tpu.models import train as train_lib
-    from k8s_tpu.models.transformer import Transformer, TransformerConfig
+    from k8s_tpu.models.transformer import Transformer
 
     def _env_int(name):
         raw = os.environ.get(name)
@@ -521,9 +539,8 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
     if use_flash is None:
         use_flash = on_tpu  # Pallas kernel is TPU-only
 
-    cfg = TransformerConfig(
-        vocab_size=32000, hidden=768, ffn_hidden=3072, layers=12, heads=12,
-        kv_heads=12, max_seq_len=seq, dtype=jnp.bfloat16, remat=False,
+    cfg = _gpt2_small_config(
+        max_seq_len=seq,
         use_flash_attention=use_flash,
         flash_block_q=_env_int("BENCH_FLASH_BLOCK_Q"),
         flash_block_k=_env_int("BENCH_FLASH_BLOCK_K"),
@@ -612,9 +629,84 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
     }
 
 
+def bench_decode(batch_per_chip: int = 32, prompt_len: int = 128,
+                 new_tokens: int = 128, calls: int = 4, warmup: int = 1):
+    """KV-cached autoregressive generation throughput (models/decode.py).
+
+    One jit program per call: prefill over the prompt + a lax.scan of
+    cached single-token steps, greedy sampling.  The measured unit is
+    GENERATED tokens/sec/chip end-to-end (prefill amortized across
+    new_tokens), the number a serving user cares about.  Decode is
+    memory-bound (matmuls are [B,1,*]), so MFU here is expected to be far
+    below the training benches — the per-token step time is the headline.
+    """
+    import jax
+
+    from k8s_tpu.models.decode import make_generate_fn
+    from k8s_tpu.models.transformer import Transformer
+
+    n_chips = len(jax.devices())
+    batch = batch_per_chip * n_chips
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = _gpt2_small_config(
+        max_seq_len=prompt_len + new_tokens,
+        use_flash_attention=on_tpu,  # prefill path; decode steps are cached
+    )
+    model = Transformer(cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, prompt_len), 0, cfg.vocab_size)
+    variables = with_retries(
+        lambda: model.init(jax.random.PRNGKey(1), prompt[:1]),
+        what="decode init",
+    )
+    params = variables["params"]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    gen = make_generate_fn(cfg, new_tokens)
+    rng = jax.random.PRNGKey(2)
+
+    def one_call():
+        return jax.block_until_ready(gen(params, prompt, rng))
+
+    with_retries(one_call, what="decode compile")
+    for _ in range(max(0, warmup - 1)):
+        one_call()
+
+    def timed():
+        times = []
+        for _ in range(max(1, _repeats_default())):
+            start = time.perf_counter()
+            for _ in range(calls):
+                one_call()
+            times.append(time.perf_counter() - start)
+        return times
+
+    times = with_retries(timed, what="decode timing")
+    elapsed = _median(times)
+    rates = [batch * new_tokens * calls / t / n_chips for t in times]
+    # fwd-only analytic FLOPs per generated token ~ 2 * params (matmul
+    # MACs x2), ignoring the O(L) attention term — the standard decode
+    # accounting; prefill FLOPs are excluded from MFU but included in the
+    # measured wall time, which understates utilization slightly
+    flops_per_token = 2.0 * n_params
+    return {
+        "tokens_per_sec_per_chip": _median(rates),
+        "tokens_per_sec_per_chip_std": _stdev(rates),
+        "repeats": len(times),
+        "per_token_ms": elapsed / calls / new_tokens * 1000,
+        "step_time_ms": elapsed / calls * 1000,  # one full generate() call
+        "flops_per_sec_per_chip": (flops_per_token * batch * new_tokens
+                                   * calls / elapsed / n_chips),
+        "n_params": n_params,
+        "batch_per_chip": batch_per_chip,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "flash_prefill": cfg.use_flash_attention,
+    }
+
+
 def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
                  allow_stale: bool, device_kind: str | None,
-                 n_chips: int | None) -> dict:
+                 n_chips: int | None, want_decode: bool = False) -> dict:
     """Assemble the single JSON line from fresh + (optionally) last-good
     results, with per-result provenance so stale evidence is never silently
     presented as this round's measurement."""
@@ -626,8 +718,12 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
         except (OSError, ValueError):
             baseline = {}
 
-    resnet = transformer = control = None
+    resnet = transformer = control = decode = None
     stale_names = []
+    if want_decode:
+        decode, stale = recorder.get("decode", allow_stale)
+        if stale:
+            stale_names.append("decode")
     if want_resnet:
         resnet, stale = recorder.get("resnet50", allow_stale)
         if stale:
@@ -648,7 +744,7 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
             stale_names.append("transformer_xla_control")
 
     if device_kind is None:
-        for r in (resnet, transformer):
+        for r in (resnet, transformer, decode):
             if r and r.get("device_kind"):
                 device_kind = r["device_kind"]
                 break
@@ -727,6 +823,25 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
             out["value"] = out["transformer_tokens_per_sec_per_chip"]
             out["unit"] = "tokens/sec/chip"
             out["vs_baseline"] = out.get("transformer_vs_baseline", 1.0)
+    if decode:
+        out["decode_tokens_per_sec_per_chip"] = round(
+            decode["tokens_per_sec_per_chip"], 1)
+        out["decode_std"] = round(decode["tokens_per_sec_per_chip_std"], 1)
+        out["decode_per_token_ms"] = round(decode["per_token_ms"], 3)
+        out["decode_batch_per_chip"] = decode["batch_per_chip"]
+        out["decode_prompt_len"] = decode["prompt_len"]
+        out["decode_new_tokens"] = decode["new_tokens"]
+        dc_peak = peak_for(decode)
+        if dc_peak:
+            out["decode_mfu"] = round(
+                decode["flops_per_sec_per_chip"] / dc_peak, 4)
+        if resnet is None and transformer is None:  # decode-only run
+            out["metric"] = "decode_tokens_per_sec_per_chip"
+            out["value"] = out["decode_tokens_per_sec_per_chip"]
+            out["unit"] = "generated tokens/sec/chip"
+            base = baseline.get("decode_tokens_per_sec_per_chip")
+            out["vs_baseline"] = (round(out["value"] / base, 4)
+                                  if base else 1.0)
     if peak:
         out["peak_flops_per_chip"] = peak
     if stale_names:
@@ -750,15 +865,19 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
 
     only = os.environ.get("BENCH_ONLY", "").lower()
-    if only not in ("", "resnet", "transformer"):
+    if only not in ("", "resnet", "transformer", "decode"):
         print(
             f"bench: FATAL: unknown BENCH_ONLY={only!r} "
-            "(expected 'resnet' or 'transformer')",
+            "(expected 'resnet', 'transformer' or 'decode')",
             file=sys.stderr,
         )
         return 2
     want_resnet = only in ("", "resnet")
     want_transformer = only in ("", "transformer")
+    # inference throughput is opt-in (BENCH_ONLY=decode): the driver's
+    # default round-end run stays the two training headlines, minimizing
+    # its exposure to relay outages
+    want_decode = only == "decode"
 
     recorder = Recorder()
     # Variant runs (sweeps, A/B drivers) set BENCH_NO_PERSIST: their configs
@@ -783,10 +902,13 @@ def main() -> int:
         """
         allow_stale = allow_stale and stale_ok
         out = build_output(recorder, want_resnet, want_transformer,
-                           allow_stale, device_kind, n_chips)
+                           allow_stale, device_kind, n_chips,
+                           want_decode=want_decode)
         missing = []
         if want_resnet and "resnet50_step_time_ms" not in out:
             missing.append("resnet50")
+        if want_decode and "decode_per_token_ms" not in out:
+            missing.append("decode")
         have_transformer = "transformer_step_time_ms" in out
         if want_transformer and not have_transformer:
             missing.append("transformer")
@@ -801,7 +923,8 @@ def main() -> int:
             # flash-speedup A/B would silently vanish from the round
             missing.append("transformer_xla_control")
         requested = [n for n, wanted in (("resnet50", want_resnet),
-                                         ("transformer", want_transformer))
+                                         ("transformer", want_transformer),
+                                         ("decode", want_decode))
                      if wanted]
         if missing and all(n in missing for n in requested):
             return -1  # nothing at all to show (single-benchmark runs too)
@@ -884,15 +1007,21 @@ def main() -> int:
     # Smoke knobs (CPU validation / quick runs); defaults are the real bench.
     rn_kw = {}
     tf_kw = {}
+    dc_kw = {}
     if os.environ.get("BENCH_SMOKE"):
         rn_kw = dict(batch_per_chip=2, iters=2, warmup=1)
         tf_kw = dict(batch_per_chip=1, seq=128, iters=2, warmup=1)
+        dc_kw = dict(batch_per_chip=2, prompt_len=16, new_tokens=16,
+                     calls=2, warmup=1)
     if on_hardware and (os.environ.get("BENCH_SMOKE")
                         or os.environ.get("BENCH_SEQ")
                         or os.environ.get("BENCH_WINDOW")):
         on_hardware = False  # non-default shapes must not overwrite evidence
 
     try:
+        if want_decode:
+            recorder.record("decode", bench_decode(**dc_kw), on_hardware,
+                            device_kind)
         if want_resnet:
             recorder.record("resnet50", bench_resnet50(**rn_kw), on_hardware,
                             device_kind)

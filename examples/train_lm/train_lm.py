@@ -87,6 +87,11 @@ def parse_args(argv=None):
                    help="checkpoint dir; empty disables checkpointing")
     p.add_argument("--checkpoint_every", type=int, default=100)
     p.add_argument("--log_every", type=int, default=10)
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, greedily generate N tokens from a "
+                   "held-out prompt with the trained weights (KV-cached "
+                   "decode, models/decode.py); single-slice configs only "
+                   "(skipped under --sp/--pp)")
     return p.parse_args(argv)
 
 
@@ -309,6 +314,34 @@ def main(argv=None) -> int:
         return 1
     log.info("training complete: %d steps, final loss %.4f",
              args.train_steps, final)
+    if args.generate > 0:
+        if args.sp > 1 or args.pp > 1 or not cfg.causal \
+                or cfg_launch.num_processes > 1 or cfg_launch.num_slices > 1:
+            # a failed decode after SUCCESSFUL training must never flip the
+            # job's exit code (restartPolicy ExitCode would gang-restart a
+            # finished job): skip everything decode can't serve — the sp
+            # ring / pp schedule, bidirectional presets (bert-base), and
+            # multi-process/multi-slice gangs whose sharded global arrays
+            # are not host-fetchable here
+            log.warning("--generate skipped: KV-cached decode serves "
+                        "causal single-process configs (no sp/pp)")
+        else:
+            import numpy as np
+
+            from k8s_tpu.models import decode as decode_lib
+
+            prompt_len = max(1, min(64, args.seq_len // 2))
+            gen_cfg = dataclasses.replace(
+                cfg, use_ring_attention=False, remat=False,
+                max_seq_len=max(cfg.max_seq_len,
+                                prompt_len + args.generate))
+            prompt = tokens0[:2, :prompt_len]
+            toks = decode_lib.generate(
+                gen_cfg, result.state["params"]["params"], prompt,
+                args.generate)
+            for b, row in enumerate(np.asarray(toks).tolist()):
+                log.info("generated[%d] (greedy, %d tokens): %s",
+                         b, args.generate, row)
     return 0
 
 

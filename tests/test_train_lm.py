@@ -57,6 +57,20 @@ class TestTrainLM:
         assert third.returncode == 0, third.stderr
         assert "already complete" in third.stderr, third.stderr[-600:]
 
+    def test_generate_after_training(self, tmp_path):
+        # --generate runs KV-cached greedy decode with the TRAINED weights
+        r = run_lm(tmp_path, BASE + ["--train_steps=2", "--generate=4"])
+        assert r.returncode == 0, r.stderr
+        assert "generated[0] (greedy, 4 tokens):" in r.stderr, \
+            r.stderr[-600:]
+        assert "generated[1]" in r.stderr
+
+    def test_generate_skipped_under_sp(self, tmp_path):
+        r = run_lm(tmp_path, BASE + ["--train_steps=2", "--generate=4",
+                                     "--sp=2"])
+        assert r.returncode == 0, r.stderr
+        assert "--generate skipped" in r.stderr, r.stderr[-600:]
+
     def test_fused_ce_loss_exact(self, tmp_path):
         """--fused_ce on trains through make_fused_lm_apply_fn and the
         logged losses match the materialized head exactly (same seed, same
