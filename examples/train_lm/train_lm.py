@@ -87,6 +87,16 @@ def parse_args(argv=None):
                    help="checkpoint dir; empty disables checkpointing")
     p.add_argument("--checkpoint_every", type=int, default=100)
     p.add_argument("--log_every", type=int, default=10)
+    p.add_argument("--eval_every", type=int, default=0, metavar="N",
+                   help="evaluate held-out loss every N steps (plus a "
+                   "final eval); 0 disables. With --data_dir the holdout "
+                   "is a stable --eval_fraction tail of the corpus "
+                   "windows (training excludes it — flag changes change "
+                   "the train stream, so keep them fixed across resumes); "
+                   "without, a fixed synthetic eval corpus")
+    p.add_argument("--eval_fraction", type=float, default=0.05)
+    p.add_argument("--eval_batches", type=int, default=8,
+                   help="batches averaged per evaluation")
     p.add_argument("--generate", type=int, default=0, metavar="N",
                    help="after training, greedily generate N tokens from a "
                    "held-out prompt with the trained weights (KV-cached "
@@ -148,6 +158,10 @@ def build_config(args, on_tpu: bool):
         raise SystemExit("--fused_ce on does not reach the pipeline step "
                          "(pp uses its own fused-loss step_fn); use "
                          "--fused_ce off with --pp")
+    if args.pp > 1 and args.eval_every > 0:
+        raise SystemExit("--eval_every does not reach the pipeline step "
+                         "(eval drives the plain apply_fn, which --pp "
+                         "bypasses); use --eval_every 0 with --pp")
     return dataclasses.replace(
         cfg,
         max_seq_len=max(cfg.max_seq_len, args.seq_len),
@@ -217,13 +231,30 @@ def main(argv=None) -> int:
         log.info("token dataset: %d tokens, %d windows of %d",
                  ds.total_tokens, ds.num_sequences(args.seq_len),
                  args.seq_len)
-        batches = ds.batches(args.batch_size, args.seq_len, seed=0)
+        if args.eval_every > 0:
+            # training excludes the stable eval tail; the eval factory
+            # re-reads the SAME held-out windows every evaluation
+            batches = ds.batches(args.batch_size, args.seq_len, seed=0,
+                                 split="train",
+                                 eval_fraction=args.eval_fraction)
+            eval_iter_factory = lambda: ds.batches(  # noqa: E731
+                args.batch_size, args.seq_len, shuffle=False, seed=0,
+                split="eval", eval_fraction=args.eval_fraction)
+        else:
+            batches = ds.batches(args.batch_size, args.seq_len, seed=0)
     else:
         corpus = synthetic_corpus(
             cfg.vocab_size, 64 * args.batch_size * args.seq_len,
             args.seq_len, seed=1)
         batches = ((b, b) for (b,) in data_lib.array_batches(
             (corpus,), args.batch_size, seed=0))
+        if args.eval_every > 0:
+            eval_corpus = synthetic_corpus(
+                cfg.vocab_size, 8 * args.batch_size * args.seq_len,
+                args.seq_len, seed=2)  # disjoint fixed eval draw
+            eval_iter_factory = lambda: (  # noqa: E731
+                (b, b) for (b,) in data_lib.array_batches(
+                    (eval_corpus,), args.batch_size, seed=0))
     data_iter = data_lib.prefetch_to_mesh(batches, mesh)
 
     step_fn = None
@@ -278,6 +309,11 @@ def main(argv=None) -> int:
     else:
         apply_fn = (lambda p, t: model.apply(p, t, mesh=mesh))
         loss_fn = train_lib.lm_loss
+    eval_fn = None
+    if args.eval_every > 0:
+        eval_fn = train_lib.make_eval_fn(
+            apply_fn, loss_fn, eval_iter_factory,
+            batches=args.eval_batches)
     try:
         result = train_lib.fit(
             apply_fn, loss_fn, optimizer, state, mesh, data_iter,
@@ -287,6 +323,8 @@ def main(argv=None) -> int:
             log_every=args.log_every,
             step_fn=step_fn,
             state_shardings=shardings,
+            eval_fn=eval_fn,
+            eval_every=args.eval_every,
         )
     finally:
         data_iter.close()

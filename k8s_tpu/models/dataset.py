@@ -154,6 +154,45 @@ class TokenDataset:
         return sum(s["n_tokens"] // seq_len
                    for s in self.manifest["shards"])
 
+    @staticmethod
+    def _split_bounds(total: int, split: str, eval_fraction: float):
+        """(base, size) of a split's window range within [0, total).
+
+        The eval split is the LAST ceil-ish slice of the UNSHUFFLED global
+        window order — a stable function of (corpus, seq_len,
+        eval_fraction) only, so train/eval never overlap across runs,
+        resumes, or reader implementations.  train is the complementary
+        prefix, which keeps its shuffled index math identical to the
+        no-split path (a permutation of [0, train_total)).
+        """
+        if split not in ("all", "train", "eval"):
+            raise ValueError(f"unknown split {split!r} "
+                             "(expected 'all', 'train' or 'eval')")
+        if split == "all":
+            if eval_fraction:
+                raise ValueError(
+                    "eval_fraction requires split='train' or 'eval' "
+                    "(split='all' would silently leak the holdout into "
+                    "training)")
+            return 0, total
+        if not 0.0 < eval_fraction < 1.0:
+            raise ValueError(
+                f"split={split!r} needs 0 < eval_fraction < 1, "
+                f"got {eval_fraction}")
+        n_eval = max(1, int(total * eval_fraction))
+        if n_eval >= total:
+            raise ValueError(
+                f"eval_fraction {eval_fraction} leaves no training windows "
+                f"(total {total})")
+        return (total - n_eval, n_eval) if split == "eval" \
+            else (0, total - n_eval)
+
+    def num_split_sequences(self, seq_len: int, split: str = "all",
+                            eval_fraction: float = 0.0) -> int:
+        """Windows per epoch in a holdout split (see _split_bounds)."""
+        return self._split_bounds(
+            self.num_sequences(seq_len), split, eval_fraction)[1]
+
     def _window_index(self, seq_len: int):
         """(names, cum) for O(num_shards) global-window-index decoding."""
         counts = [s["n_tokens"] // seq_len for s in self.manifest["shards"]]
@@ -174,6 +213,8 @@ class TokenDataset:
         epochs: Optional[int] = None,
         reader: str = "auto",
         start_window: int = 0,
+        split: str = "all",
+        eval_fraction: float = 0.0,
     ) -> Iterator[np.ndarray]:
         """Yield [seq_len] int32 windows; shuffle permutes the global window
         order each epoch.
@@ -192,6 +233,11 @@ class TokenDataset:
         (k8s_tpu/native/dataloader.py — reads on C++ threads, GIL-free);
         "auto" picks native when the toolchain built it, else mmap.  Both
         yield identical streams.
+
+        ``split``/``eval_fraction``: holdout evaluation — "eval" is the
+        stable last slice of the unshuffled global window order, "train"
+        the complementary prefix (see _split_bounds); shuffle/seed/
+        start_window all operate WITHIN the chosen split.
         """
         if reader not in ("auto", "mmap", "native"):
             raise ValueError(f"unknown reader {reader!r}")
@@ -201,17 +247,19 @@ class TokenDataset:
             reader = "native" if native_dl.available() else "mmap"
         if reader == "native":
             yield from self._sequences_native(seq_len, shuffle, seed, epochs,
-                                              start_window)
+                                              start_window, split,
+                                              eval_fraction)
             return
         names, cum = self._window_index(seq_len)
-        total = int(cum[-1])
+        base, total = self._split_bounds(int(cum[-1]), split, eval_fraction)
         rng = np.random.default_rng(seed)
         epoch, offset = self._fast_forward(rng, total, start_window, shuffle)
         while epochs is None or epoch < epochs:
             order = rng.permutation(total) if shuffle else range(total)
             for i in order[offset:]:
+                i = base + int(i)
                 shard_i = int(np.searchsorted(cum, i, side="right")) - 1
-                start = (int(i) - int(cum[shard_i])) * seq_len
+                start = (i - int(cum[shard_i])) * seq_len
                 yield np.asarray(
                     self._shard(names[shard_i])[start:start + seq_len],
                     dtype=np.int32)
@@ -234,7 +282,9 @@ class TokenDataset:
 
     def _sequences_native(self, seq_len: int, shuffle: bool, seed: int,
                           epochs: Optional[int],
-                          start_window: int = 0) -> Iterator[np.ndarray]:
+                          start_window: int = 0, split: str = "all",
+                          eval_fraction: float = 0.0
+                          ) -> Iterator[np.ndarray]:
         """The C++-reader stream: same windows, same order as mmap.
 
         Checksums stay LAZY (matching the class docstring's no-startup-
@@ -244,7 +294,7 @@ class TokenDataset:
         from k8s_tpu.native.dataloader import NativeWindowReader
 
         names, cum = self._window_index(seq_len)
-        total = int(cum[-1])
+        base, total = self._split_bounds(int(cum[-1]), split, eval_fraction)
         dtype = np.dtype(self.manifest["dtype"])
         window_bytes = seq_len * dtype.itemsize
         paths = [os.path.join(self.data_dir, n) for n in names]
@@ -263,9 +313,10 @@ class TokenDataset:
 
                 def descriptors(offset=offset):
                     for i in order[offset:]:
+                        i = base + int(i)
                         shard_i = int(np.searchsorted(cum, i, side="right")) - 1
                         self._check_shard(names[shard_i])  # lazy, once each
-                        start = (int(i) - int(cum[shard_i])) * seq_len
+                        start = (i - int(cum[shard_i])) * seq_len
                         yield shard_i, data_off[shard_i] + start * dtype.itemsize
 
                 for raw in r.stream(descriptors()):
@@ -281,6 +332,8 @@ class TokenDataset:
         shuffle: bool = True,
         seed: int = 0,
         epochs: Optional[int] = None,
+        split: str = "all",
+        eval_fraction: float = 0.0,
     ) -> "BatchStream":
         """(tokens, tokens) [B, L] pairs — the (inputs, targets) shape
         train.fit consumes for next-token prediction (lm_loss shifts
@@ -290,13 +343,19 @@ class TokenDataset:
         ``skip(n)`` BEFORE consumption — an index jump over the first n
         batches with no disk reads, which is how train.fit fast-forwards
         the stream on checkpoint resume.
+
+        ``split``/``eval_fraction`` select the holdout partition (see
+        sequences); batch accounting (skip bounds, the batch_size guard)
+        is against the SPLIT's window count.
         """
-        if self.num_sequences(seq_len) < batch_size:
+        n = self.num_split_sequences(seq_len, split, eval_fraction)
+        if n < batch_size:
             raise ValueError(
-                f"dataset has {self.num_sequences(seq_len)} windows of "
+                f"dataset split {split!r} has {n} windows of "
                 f"{seq_len}, need >= batch_size {batch_size}")
         return BatchStream(self, batch_size, seq_len, shuffle=shuffle,
-                           seed=seed, epochs=epochs)
+                           seed=seed, epochs=epochs, split=split,
+                           eval_fraction=eval_fraction)
 
 
 class BatchStream:
@@ -308,13 +367,16 @@ class BatchStream:
     """
 
     def __init__(self, ds: "TokenDataset", batch_size: int, seq_len: int,
-                 *, shuffle: bool, seed: int, epochs: Optional[int]):
+                 *, shuffle: bool, seed: int, epochs: Optional[int],
+                 split: str = "all", eval_fraction: float = 0.0):
         self._ds = ds
         self._batch_size = batch_size
         self._seq_len = seq_len
         self._shuffle = shuffle
         self._seed = seed
         self._epochs = epochs
+        self._split = split
+        self._eval_fraction = eval_fraction
         self._skip_windows = 0
         self._iter = None
 
@@ -327,7 +389,9 @@ class BatchStream:
         # resumed fit() "complete" zero steps, while the drain fallback
         # raises for the same condition — the two paths must agree.
         if self._epochs is not None:
-            total_windows = self._ds.num_sequences(self._seq_len) * self._epochs
+            total_windows = self._ds.num_split_sequences(
+                self._seq_len, self._split, self._eval_fraction
+            ) * self._epochs
             usable = (total_windows // self._batch_size) * self._batch_size
             # strictly greater: skipping EXACTLY to the end is the
             # completed-run resume (fit's documented no-op path), matching
@@ -345,7 +409,8 @@ class BatchStream:
         if self._iter is None:
             self._iter = self._ds.sequences(
                 self._seq_len, shuffle=self._shuffle, seed=self._seed,
-                epochs=self._epochs, start_window=self._skip_windows)
+                epochs=self._epochs, start_window=self._skip_windows,
+                split=self._split, eval_fraction=self._eval_fraction)
         rows = []
         for seq in self._iter:
             rows.append(seq)

@@ -57,6 +57,20 @@ def init_state(params: Any, optimizer) -> dict:
     }
 
 
+def _combined_loss(apply_fn: Callable, loss_fn: Callable, params, batch):
+    """The one definition of 'the loss' shared by training and held-out
+    eval: apply_fn may return (logits, aux_scalar) — e.g. the MoE
+    load-balance term from make_moe_apply_fn — which is added to the task
+    loss."""
+    inputs, targets = batch
+    out = apply_fn(params, inputs)
+    if isinstance(out, tuple):
+        logits, aux = out
+    else:
+        logits, aux = out, 0.0
+    return loss_fn(logits, targets) + aux
+
+
 def make_train_step(
     apply_fn: Callable,
     loss_fn: Callable,
@@ -66,18 +80,8 @@ def make_train_step(
     with sharded inputs, XLA inserts the psum/reduce-scatter collectives."""
 
     def step(state, batch):
-        inputs, targets = batch
-
         def compute_loss(params):
-            out = apply_fn(params, inputs)
-            # apply_fn may return (logits, aux_scalar) — e.g. the MoE
-            # load-balance term from make_moe_apply_fn — which is added to
-            # the task loss
-            if isinstance(out, tuple):
-                logits, aux = out
-            else:
-                logits, aux = out, 0.0
-            return loss_fn(logits, targets) + aux
+            return _combined_loss(apply_fn, loss_fn, params, batch)
 
         loss, grads = jax.value_and_grad(compute_loss)(state["params"])
         updates, new_opt_state = optimizer.update(
@@ -137,6 +141,44 @@ def make_sharded_train_step(
     )
 
 
+def make_eval_fn(apply_fn: Callable, loss_fn: Callable,
+                 eval_iter_factory: Callable, *, batches: int = 8):
+    """Held-out evaluation for fit(): mean loss over ``batches`` batches.
+
+    ``eval_iter_factory()`` must return a FRESH iterator positioned at the
+    eval split's start on every call (e.g. ``lambda:
+    ds.batches(B, L, split="eval", eval_fraction=f, shuffle=False)``), so
+    every evaluation scores the same windows and the numbers are
+    comparable across steps.  The eval step is jit'd WITHOUT donation —
+    the training state buffers must survive the call.
+    """
+
+    @jax.jit
+    def eval_step(params, batch):
+        return _combined_loss(apply_fn, loss_fn, params, batch)
+
+    def eval_fn(state) -> float:
+        import itertools
+
+        it = eval_iter_factory()
+        try:
+            total, n = 0.0, 0
+            # islice, not zip(it, range(...)): zip would pull (and discard)
+            # one extra batch from the stream after the last yielded pair
+            for batch in itertools.islice(it, batches):
+                total += float(eval_step(state["params"], batch))
+                n += 1
+        finally:
+            close = getattr(it, "close", None)
+            if callable(close):
+                close()
+        if n == 0:
+            raise ValueError("eval stream yielded no batches")
+        return total / n
+
+    return eval_fn
+
+
 import dataclasses
 
 
@@ -154,6 +196,8 @@ class FitResult:
     losses: list
     preempted: bool = False
     start_step: int = 0
+    # (step, loss) pairs from the held-out eval_fn, when one was passed
+    eval_losses: list = dataclasses.field(default_factory=list)
 
     def __iter__(self):  # (state, losses) unpacking compatibility
         yield self.state
@@ -176,6 +220,8 @@ def fit(
     step_fn: Optional[Callable] = None,
     state_shardings: Any = None,
     skip_data_on_resume: bool = True,
+    eval_fn: Optional[Callable] = None,
+    eval_every: int = 0,
 ) -> FitResult:
     """The canonical training loop: shard state over the mesh, jit the step,
     checkpoint/resume via k8s_tpu.models.checkpoint.
@@ -197,6 +243,12 @@ def fit(
     fit cannot derive from an apply_fn) bypasses the default
     FSDP-shard-and-jit path; pass ``state_shardings`` with it so the
     initial state is placed the way the step expects.
+
+    ``eval_fn(state) -> float`` (see make_eval_fn) runs every
+    ``eval_every`` steps and once more after the final step; results land
+    in FitResult.eval_losses as (step, loss) pairs.  Held-out evaluation
+    parity: the reference's dist-mnist logs test-set metrics alongside
+    training (test/e2e/dist-mnist/dist_mnist.py).
     """
     import logging
 
@@ -255,6 +307,13 @@ def fit(
         unsubscribe = signals.on_shutdown(preempted.set)
 
     losses = []
+    eval_losses = []
+
+    def run_eval(step_no):
+        el = float(eval_fn(state))
+        eval_losses.append((step_no, el))
+        log.info("step %d eval loss %.4f", step_no, el)
+
     last_ran = None
     try:
         for i in range(start_step, steps):
@@ -264,12 +323,18 @@ def fit(
             last_ran = i
             if log_every and (i + 1) % log_every == 0:
                 log.info("step %d loss %.4f", i + 1, float(loss))
+            if eval_fn is not None and eval_every \
+                    and (i + 1) % eval_every == 0 and (i + 1) != steps:
+                run_eval(i + 1)
             if ckpt is not None:
                 ckpt.maybe_save(i, state)
             if preempted.is_set():
                 log.warning(
                     "preemption: checkpointing step %d and stopping", i)
                 break
+        if eval_fn is not None and last_ran is not None \
+                and not preempted.is_set():
+            run_eval(last_ran + 1)  # final held-out number for the run
 
         if ckpt is not None:
             # Final/preemption save, labeled with the last step actually
@@ -287,6 +352,7 @@ def fit(
         losses=[float(l) for l in losses],
         preempted=preempted.is_set(),
         start_step=start_step,
+        eval_losses=eval_losses,
     )
 
 

@@ -100,6 +100,74 @@ class TestTokenShards:
         assert ds_lib.decode_bytes(toks) == text
 
 
+class TestHoldoutSplit:
+    """split/eval_fraction: the eval tail is stable, disjoint from train,
+    and identical across readers; skip/batch accounting follows the
+    split's own window count."""
+
+    def _mk(self, tmp_path):
+        tokens = (np.arange(4000, dtype=np.int64) * 13) % 199
+        ds_lib.write_token_shards(str(tmp_path), tokens, shard_tokens=1024)
+        return ds_lib.TokenDataset(str(tmp_path))
+
+    @pytest.mark.parametrize("reader", ["mmap", "native"])
+    def test_partition_disjoint_and_complete(self, tmp_path, reader):
+        from k8s_tpu.native import dataloader as native_dl
+
+        if reader == "native" and not native_dl.available():
+            pytest.skip("native toolchain unavailable")
+        ds = self._mk(tmp_path)
+        L, frac = 64, 0.2
+        all_w = [w.tobytes() for w in ds.sequences(
+            L, shuffle=False, epochs=1, reader=reader)]
+        train = [w.tobytes() for w in ds.sequences(
+            L, shuffle=False, epochs=1, reader=reader, split="train",
+            eval_fraction=frac)]
+        ev = [w.tobytes() for w in ds.sequences(
+            L, shuffle=False, epochs=1, reader=reader, split="eval",
+            eval_fraction=frac)]
+        # eval is the stable TAIL of the unshuffled order; train the prefix
+        assert train + ev == all_w
+        assert len(ev) == max(1, int(len(all_w) * frac))
+        assert ds.num_split_sequences(L, "train", frac) == len(train)
+        assert ds.num_split_sequences(L, "eval", frac) == len(ev)
+        # shuffled train never leaks a holdout window
+        shuffled = {w.tobytes() for w in ds.sequences(
+            L, shuffle=True, seed=3, epochs=2, reader=reader,
+            split="train", eval_fraction=frac)}
+        assert shuffled.isdisjoint(set(ev))
+
+    def test_split_batches_and_skip_accounting(self, tmp_path):
+        ds = self._mk(tmp_path)
+        L, frac = 64, 0.2
+        n_eval = ds.num_split_sequences(L, "eval", frac)
+        # batch_size guard measures the SPLIT, not the whole corpus
+        with pytest.raises(ValueError, match="split 'eval'"):
+            ds.batches(n_eval + 1, L, split="eval", eval_fraction=frac)
+        # skip bounds follow the split's window count
+        bs = ds.batches(1, L, split="eval", eval_fraction=frac, epochs=1)
+        with pytest.raises(ValueError, match="jumps past"):
+            bs.skip(n_eval + 1)
+        # resume semantics within a split: skip(k) == drop first k batches
+        full = list(ds.batches(2, L, split="train", eval_fraction=frac,
+                               seed=7, epochs=1))
+        resumed_stream = ds.batches(2, L, split="train", eval_fraction=frac,
+                                    seed=7, epochs=1)
+        resumed_stream.skip(3)
+        resumed = list(resumed_stream)
+        assert len(resumed) == len(full) - 3
+        np.testing.assert_array_equal(resumed[0][0], full[3][0])
+
+    def test_split_guards(self, tmp_path):
+        ds = self._mk(tmp_path)
+        with pytest.raises(ValueError, match="unknown split"):
+            next(ds.sequences(64, split="test"))
+        with pytest.raises(ValueError, match="eval_fraction requires"):
+            next(ds.sequences(64, split="all", eval_fraction=0.1))
+        with pytest.raises(ValueError, match="needs 0 < eval_fraction"):
+            next(ds.sequences(64, split="eval"))
+
+
 class TestResumeSkip:
     """BatchStream.skip + sequences(start_window): the checkpoint-resume
     fast-forward must continue the stream exactly where a fresh run would
@@ -408,3 +476,35 @@ class TestWorkloadsOnRealData:
         # uniform byte entropy is ln(256) = 5.545; real text structure must
         # pull the loss clearly below it
         assert losses[-1] < 4.0, losses
+
+    def test_train_lm_holdout_eval_on_real_text(self):
+        """train_lm --eval_every on --data_dir: training excludes the
+        stable holdout tail and logs a finite held-out loss."""
+        import logging
+
+        from examples.train_lm.train_lm import main
+
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, r):
+                records.append(r.getMessage())
+
+        h = Capture()
+        logger = logging.getLogger("k8s_tpu.models.train")
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+        try:
+            rc = main(["--preset", "tiny", "--train_steps", "6",
+                       "--batch_size", "8", "--seq_len", "64",
+                       "--data_dir", TOKEN_DIR,
+                       "--eval_every", "3", "--eval_batches", "2",
+                       "--eval_fraction", "0.2"])
+        finally:
+            logger.removeHandler(h)
+        assert rc == 0
+        evals = [m for m in records if "eval loss" in m]
+        # step-3 interval eval + final step-6 eval
+        assert len(evals) == 2, records
+        vals = [float(m.rsplit(" ", 1)[-1]) for m in evals]
+        assert all(np.isfinite(v) for v in vals)

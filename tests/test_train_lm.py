@@ -65,6 +65,13 @@ class TestTrainLM:
             r.stderr[-600:]
         assert "generated[1]" in r.stderr
 
+    def test_eval_every_logs_holdout_loss(self, tmp_path):
+        r = run_lm(tmp_path, BASE + ["--train_steps=4", "--eval_every=2",
+                                     "--eval_batches=2"])
+        assert r.returncode == 0, r.stderr
+        # interval eval at step 2 + the final eval at step 4
+        assert r.stderr.count("eval loss") == 2, r.stderr[-800:]
+
     def test_generate_skipped_under_sp(self, tmp_path):
         r = run_lm(tmp_path, BASE + ["--train_steps=2", "--generate=4",
                                      "--sp=2"])
