@@ -142,12 +142,13 @@ class _RestWatch:
         # lock, which a reader blocked in readline() holds until the next
         # frame arrives — stop() from another thread would block for the
         # rest of the watch.  shutdown() needs no lock and makes the
-        # blocked recv return EOF immediately.  The socket comes straight
-        # off the dedicated connection object the watch holds
-        # (_k8s_tpu_conn.sock) — no BufferedReader internals involved.
+        # blocked recv return EOF immediately.  The socket reference was
+        # captured at request time (_k8s_tpu_sock): for Connection: close
+        # responses http.client detaches conn.sock (it is None by now), so
+        # only that early-captured reference reaches the live socket — no
+        # BufferedReader internals involved.
         try:
-            conn = getattr(self._resp, "_k8s_tpu_conn", None)
-            sock = getattr(conn, "sock", None)
+            sock = getattr(self._resp, "_k8s_tpu_sock", None)
             if sock is not None:
                 import socket as _socket
 
@@ -430,12 +431,21 @@ class RestClient:
             # caller consumes until server close — never pooled
             conn = self._new_conn(timeout=None)
             conn.request(method, path, body=data, headers=headers)
+            # Capture the socket BEFORE getresponse(): for Connection:
+            # close responses (every watch stream) http.client detaches —
+            # conn.sock becomes None and the socket lives on only inside
+            # the response's buffered reader.  _RestWatch.stop() needs this
+            # direct reference to shutdown() a blocked reader; without it
+            # the stop blocks until the server's watch timeout (measured
+            # 59s, 2x per LocalCluster teardown in rest mode).
+            sock = conn.sock
             resp = conn.getresponse()
             if resp.status >= 400:
                 raw = resp.read()
                 conn.close()
                 raise self._api_error(resp, raw)
             resp._k8s_tpu_conn = conn  # keep the connection alive with it
+            resp._k8s_tpu_sock = sock
             return resp
 
         # Only idempotent methods are retried on a transport error: a POST

@@ -130,3 +130,44 @@ def test_chunked_server_is_decoded_in_place():
         assert got["metadata"]["name"] == "c1"
     finally:
         srv.shutdown()
+
+
+def test_watch_stop_unblocks_blocked_reader_immediately():
+    """_RestWatch.stop() from another thread must return in well under a
+    second even while a reader is blocked in next() on an idle stream.
+
+    Regression: watch responses are Connection: close, so http.client
+    DETACHES the socket at getresponse() (conn.sock becomes None); the
+    stop-path socket shutdown silently no-oped and resp.close() then
+    blocked on the reader's buffer lock until the SERVER watch timeout —
+    measured 59s, twice per rest-mode LocalCluster teardown.  The client
+    now captures the socket reference at request time."""
+    import time
+
+    from k8s_tpu.client.gvr import PODS as PODS_GVR
+    from k8s_tpu.e2e.apiserver import ApiServer
+
+    srv = ApiServer().start()
+    try:
+        client = RestClient(ClusterConfig(host=srv.url))
+        w = client.watch(PODS_GVR, "default")
+        ended = []
+
+        def reader():
+            while True:
+                item = w.next(timeout=0.2)
+                if item is None and w.stopped:
+                    ended.append(True)
+                    return
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.5)  # reader is now blocked inside the stream read
+        t0 = time.perf_counter()
+        w.stop()
+        dt = time.perf_counter() - t0
+        t.join(timeout=5)
+        assert dt < 1.0, f"stop() blocked {dt:.1f}s (watch-timeout stall)"
+        assert ended and not t.is_alive()
+    finally:
+        srv.stop()
