@@ -48,6 +48,20 @@ def sample_logits(logits, rng, temperature: float = 0.0,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _check_cache_capacity(config: TransformerConfig, prompt_len: int,
+                          max_new_tokens: int) -> None:
+    """Shared full-cache bound for greedy and beam decoding: the LAST
+    sampled token is returned, never fed back, so the highest position
+    written/attended is prompt_len + max_new_tokens - 2."""
+    if config.window_size is None and \
+            prompt_len + max_new_tokens - 1 > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_seq_len ({config.max_seq_len}) and no "
+            "window_size is set (the full KV cache is max_seq_len "
+            "long; sliding-window configs decode indefinitely)")
+
+
 def make_generate_fn(config: TransformerConfig, max_new_tokens: int,
                      temperature: float = 0.0, top_k: Optional[int] = None,
                      eos_id: Optional[int] = None, pad_id: int = 0):
@@ -66,15 +80,7 @@ def make_generate_fn(config: TransformerConfig, max_new_tokens: int,
     @jax.jit
     def generate(params, prompt, rng):
         B, Lp = prompt.shape
-        # the LAST sampled token is returned, never fed back, so the
-        # highest position written/attended is Lp + max_new_tokens - 2
-        if config.window_size is None and \
-                Lp + max_new_tokens - 1 > config.max_seq_len:
-            raise ValueError(
-                f"prompt ({Lp}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_seq_len ({config.max_seq_len}) and no "
-                "window_size is set (the full KV cache is max_seq_len "
-                "long; sliding-window configs decode indefinitely)")
+        _check_cache_capacity(config, Lp, max_new_tokens)
         logits, varz = model.apply(
             {"params": params}, prompt, mode="prefill", mutable=["cache"])
         rng, sub = jax.random.split(rng)
@@ -104,6 +110,101 @@ def make_generate_fn(config: TransformerConfig, max_new_tokens: int,
         return jnp.concatenate([tok[:, None], rest.T], axis=1)
 
     return generate
+
+
+def make_beam_generate_fn(config: TransformerConfig, max_new_tokens: int,
+                          beam_size: int, eos_id: Optional[int] = None,
+                          pad_id: int = 0, length_penalty: float = 0.0):
+    """Beam search over the KV cache: ``beam(params, prompt) ->
+    (tokens [B, max_new_tokens], scores [B])``.
+
+    One jit program, like greedy generate: prefill once per batch row,
+    repeat every cache leaf to B*beam rows, then a ``lax.scan`` whose
+    carry holds (cache, running scores, per-beam token history).  Each
+    step expands [B, K, V] candidates, takes the global top-K, and
+    REORDERS the cache by gathering leaves with the parent-beam indices —
+    XLA turns the gather into an on-device shuffle, no host round trips.
+    Beams that emit ``eos_id`` freeze: their only continuation is
+    ``pad_id`` at log-prob 0, so their score stops accumulating.
+
+    ``length_penalty`` is GNMT-style alpha: final scores divide by
+    ((5 + len) / 6) ** alpha where len counts tokens through EOS
+    (0.0 = pure log-prob).  Returned scores are the penalized ones the
+    winner was chosen by.
+    """
+    if beam_size < 1:
+        raise ValueError("beam_size must be >= 1")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    model = Transformer(config)
+    K, T = beam_size, max_new_tokens
+
+    def penalize(scores, lengths):
+        if length_penalty == 0.0:
+            return scores
+        return scores / (((5.0 + lengths) / 6.0) ** length_penalty)
+
+    @jax.jit
+    def beam(params, prompt):
+        B, Lp = prompt.shape
+        _check_cache_capacity(config, Lp, T)
+        logits, varz = model.apply(
+            {"params": params}, prompt, mode="prefill", mutable=["cache"])
+        logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        V = logp0.shape[-1]
+        kk = min(K, V)
+        scores, tok = jax.lax.top_k(logp0, kk)  # [B, kk]
+        if kk < K:  # beam wider than vocab: pad with dead beams
+            scores = jnp.pad(scores, ((0, 0), (0, K - kk)),
+                             constant_values=-1e30)
+            tok = jnp.pad(tok, ((0, 0), (0, K - kk)))
+        # beam row layout: flat index b*K + k
+        cache = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, K, axis=0), varz["cache"])
+        finished = (tok == eos_id) if eos_id is not None \
+            else jnp.zeros((B, K), bool)
+        lengths = jnp.ones((B, K), jnp.int32)
+        seqs = jnp.full((B, K, T), pad_id, jnp.int32)
+        seqs = seqs.at[:, :, 0].set(tok)
+        # a frozen beam may only continue with pad_id, at zero cost
+        pad_only = jnp.full((V,), -1e30, jnp.float32).at[pad_id].set(0.0)
+
+        def step(carry, t):
+            cache, scores, finished, lengths, seqs, tok = carry
+            logits, varz = model.apply(
+                {"params": params, "cache": cache},
+                tok.reshape(B * K, 1),
+                positions=jnp.full((B * K, 1), Lp + t - 1, jnp.int32),
+                mode="decode", mutable=["cache"])
+            logp = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32)).reshape(B, K, V)
+            logp = jnp.where(finished[..., None], pad_only, logp)
+            cand = (scores[..., None] + logp).reshape(B, K * V)
+            scores, idx = jax.lax.top_k(cand, K)  # [B, K]
+            parent, tok = idx // V, (idx % V).astype(jnp.int32)
+            flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+            cache = jax.tree_util.tree_map(
+                lambda x: x[flat_parent], varz["cache"])
+            gather = lambda a: jnp.take_along_axis(a, parent, axis=1)  # noqa: E731
+            finished = gather(finished)
+            lengths = gather(lengths) + (~finished).astype(jnp.int32)
+            seqs = jnp.take_along_axis(
+                seqs, parent[..., None], axis=1).at[:, :, t].set(tok)
+            if eos_id is not None:
+                finished = finished | (tok == eos_id)
+            return (cache, scores, finished, lengths, seqs, tok), None
+
+        carry = (cache, scores, finished, lengths, seqs, tok)
+        if T > 1:
+            carry, _ = jax.lax.scan(step, carry, jnp.arange(1, T))
+        _, scores, finished, lengths, seqs, _ = carry
+        final = penalize(scores, lengths.astype(jnp.float32))
+        best = jnp.argmax(final, axis=1)  # [B]
+        out = jnp.take_along_axis(
+            seqs, best[:, None, None], axis=1)[:, 0]
+        return out, jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
+
+    return beam
 
 
 @functools.lru_cache(maxsize=8)
