@@ -89,11 +89,21 @@ def iter_sources(root: str):
                 yield os.path.join(dirpath, name)
 
 
-def report(collector: Collector, root: str) -> dict:
+def report(collector: Collector, root: str,
+           exclude: tuple[str, ...] = ()) -> dict:
+    """``exclude``: package-relative directory prefixes dropped from BOTH
+    the numerator and the denominator — a gate scoped to the subsystems
+    its test set actually drives (e.g. the control-plane tier excluding
+    models/ops/parallel, which the workload tier owns) is not diluted
+    every time an unrelated subsystem gains well-tested code."""
     root = os.path.abspath(root)
+    skip = tuple(os.path.join(root, e.strip(os.sep)) + os.sep
+                 for e in exclude if e)
     files = {}
     total_exec = total_hit = 0
     for path in sorted(iter_sources(root)):
+        if skip and path.startswith(skip):
+            continue
         execs = executable_lines(path)
         if not execs:
             continue
@@ -119,6 +129,10 @@ def main(argv=None) -> int:
     runp = sub.add_parser("run", help="measure a python invocation")
     runp.add_argument("--package", default="k8s_tpu",
                       help="source tree to measure (default: k8s_tpu)")
+    runp.add_argument("--exclude", default="",
+                      help="comma-separated package-relative dirs dropped "
+                      "from numerator AND denominator (scope the gate to "
+                      "what its test set drives)")
     runp.add_argument("--out", default="",
                       help="write the full JSON report here")
     runp.add_argument("--baseline", default="",
@@ -147,10 +161,13 @@ def main(argv=None) -> int:
     finally:
         collector.stop()
 
-    rep = report(collector, package_root)
+    exclude = tuple(e.strip() for e in args.exclude.split(",") if e.strip())
+    rep = report(collector, package_root, exclude=exclude)
+    scope = (f"{args.package} minus {','.join(exclude)}" if exclude
+             else args.package)
     print(f"coverage: {rep['pct']}% "
           f"({rep['lines_hit']}/{rep['lines_executable']} lines of "
-          f"{args.package})")
+          f"{scope})")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(rep, f, indent=1, sort_keys=True)

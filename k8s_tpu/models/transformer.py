@@ -427,10 +427,10 @@ class Transformer(nn.Module):
         "prefill" (same pass + KV-cache population), or "decode" (one
         cached token step; ``positions`` carries the absolute position).
 
-        Decode modes are single-device (or dp/tp-sharded) paths: the
-        sp ring and MoE routing are training-scale constructions and are
-        rejected rather than silently mis-composed (models/decode.py is
-        the driver).
+        Decode modes are single-device (or dp/tp-sharded) paths: the sp
+        ring is a training-scale construction and is rejected rather than
+        silently mis-composed (models/decode.py is the driver).  MoE
+        configs decode (see the capacity note below).
         """
         cfg = self.config
         B, L = tokens.shape
@@ -444,8 +444,13 @@ class Transformer(nn.Module):
                     "decode modes do not compose with the sp ring "
                     "(use_ring_attention); decode on the unsharded or "
                     "dp/tp mesh instead")
-            if cfg.num_experts > 0:
-                raise ValueError("decode modes do not support MoE yet")
+            # MoE decodes: routing is per-token, so cached decode matches
+            # the teacher-forced pass EXACTLY whenever no (token, choice)
+            # pair overflows expert capacity.  Capacity competition is per
+            # CALL (batch*1 tokens per decode step vs batch*seq in
+            # training) — raise capacity_factor for serving if drops are
+            # observed; the aux-loss sow is a no-op outside training
+            # (the "losses" collection is not mutable here).
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(L), (B, L))
         emb = self.param(

@@ -2,7 +2,11 @@
 done-criterion), at zero false positives on the repo itself (enforced by
 the lint CI tier staying green)."""
 
+import os
+
 from k8s_tpu.harness import pylint_lite
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _codes(source: str) -> list[str]:
@@ -132,7 +136,7 @@ class TestCoverageTool:
              "--", str(script)],
             capture_output=True, text=True, cwd=tmp_path,
             env=dict(__import__("os").environ,
-                     PYTHONPATH=f"{tmp_path}:/root/repo"),
+                     PYTHONPATH=f"{tmp_path}:{REPO}"),
             timeout=60)
         assert out.returncode == 0, out.stdout + out.stderr
         import json
@@ -141,3 +145,36 @@ class TestCoverageTool:
         f = rep["files"]["pkg/mod.py"]
         # hit() ran, missed() was only defined: 3 of 4 executable lines
         assert f["executable"] == 4 and f["hit"] == 3
+
+    def test_exclude_scopes_numerator_and_denominator(self, tmp_path):
+        """--exclude drops a subtree from BOTH sides of the ratio, so a
+        gate scoped to one subsystem is not diluted by code another
+        tier's tests own."""
+        import subprocess
+        import sys
+
+        pkg = tmp_path / "pkg"
+        (pkg / "sub").mkdir(parents=True)
+        (pkg / "__init__.py").write_text("")
+        (pkg / "mod.py").write_text("def hit():\n    return 1\n")
+        (pkg / "sub" / "__init__.py").write_text("")
+        (pkg / "sub" / "big.py").write_text(
+            "\n".join(f"def f{i}():\n    return {i}" for i in range(20)))
+        script = tmp_path / "use.py"
+        script.write_text("from pkg import mod\nprint(mod.hit())\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "k8s_tpu.harness.coverage", "run",
+             "--package", "pkg", "--exclude", "sub",
+             "--out", str(tmp_path / "r.json"), "--", str(script)],
+            capture_output=True, text=True, cwd=tmp_path,
+            env=dict(__import__("os").environ,
+                     PYTHONPATH=f"{tmp_path}:{REPO}"),
+            timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        import json
+
+        rep = json.load(open(tmp_path / "r.json"))
+        assert not any(p.startswith("pkg/sub/") for p in rep["files"])
+        # only mod.py counts: 2 executable lines, both hit = 100%
+        assert rep["lines_executable"] == 2 and rep["pct"] == 100.0
+        assert "minus sub" in out.stdout
