@@ -64,7 +64,8 @@ def _check_cache_capacity(config: TransformerConfig, prompt_len: int,
 
 def make_generate_fn(config: TransformerConfig, max_new_tokens: int,
                      temperature: float = 0.0, top_k: Optional[int] = None,
-                     eos_id: Optional[int] = None, pad_id: int = 0):
+                     eos_id: Optional[int] = None, pad_id: int = 0,
+                     chunked_prefill: bool = False):
     """Build ``generate(params, prompt, rng) -> [B, max_new_tokens]``.
 
     The returned function is jit-compiled once per (config, prompt shape):
@@ -72,19 +73,67 @@ def make_generate_fn(config: TransformerConfig, max_new_tokens: int,
     ``lax.scan`` of single-token steps carries ``(cache, token, position,
     done, rng)``.  Rows that emit ``eos_id`` are frozen to ``pad_id`` for
     the remaining steps.
+
+    ``chunked_prefill``: instead of one full-length prefill pass, stream
+    the prompt through the cache in ``config.prefill_chunk``-token chunks
+    (a leading remainder chunk plus a ``lax.scan`` over the full ones).
+    Prefill activation memory becomes O(chunk * cache) instead of
+    O(prompt^2 / blocks), and with a sliding window the cache itself is
+    O(window + chunk) — rolling prefill for arbitrarily long prompts.
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    if chunked_prefill and config.prefill_chunk < 1:
+        raise ValueError("chunked_prefill needs config.prefill_chunk >= 1")
     model = Transformer(config)
+
+    def _chunked_prefill(params, prompt):
+        """Stream the prompt through decode-mode cache calls; returns
+        (cache, last-position logits)."""
+        B, Lp = prompt.shape
+        C = config.prefill_chunk
+        first = Lp % C or min(C, Lp)  # leading remainder (or one chunk)
+        pos0 = jnp.broadcast_to(jnp.arange(first), (B, first))
+        logits, varz = model.apply(
+            {"params": params}, prompt[:, :first], positions=pos0,
+            mode="decode", mutable=["cache"])
+        cache, last = varz["cache"], logits[:, -1]
+        n_full = (Lp - first) // C
+        if n_full == 0:
+            return cache, last
+        chunks = prompt[:, first:].reshape(B, n_full, C).transpose(1, 0, 2)
+        bases = first + C * jnp.arange(n_full)
+
+        # last logits ride the CARRY, not the scan outputs: stacking every
+        # chunk's [B, V] logits would grow HBM with prompt length, exactly
+        # what rolling prefill exists to avoid
+        def body(carry, xs):
+            cache, _ = carry
+            chunk, base = xs
+            pos = base + jnp.broadcast_to(jnp.arange(C), (B, C))
+            logits, varz = model.apply(
+                {"params": params, "cache": cache}, chunk, positions=pos,
+                mode="decode", mutable=["cache"])
+            return (varz["cache"], logits[:, -1]), None
+
+        (cache, last), _ = jax.lax.scan(body, (cache, last),
+                                        (chunks, bases))
+        return cache, last
 
     @jax.jit
     def generate(params, prompt, rng):
         B, Lp = prompt.shape
         _check_cache_capacity(config, Lp, max_new_tokens)
-        logits, varz = model.apply(
-            {"params": params}, prompt, mode="prefill", mutable=["cache"])
+        if chunked_prefill:
+            cache, last = _chunked_prefill(params, prompt)
+            varz = {"cache": cache}
+        else:
+            logits, varz = model.apply(
+                {"params": params}, prompt, mode="prefill",
+                mutable=["cache"])
+            last = logits[:, -1]
         rng, sub = jax.random.split(rng)
-        tok = sample_logits(logits[:, -1], sub, temperature, top_k)
+        tok = sample_logits(last, sub, temperature, top_k)
         # EOS itself is emitted; rows freeze to pad_id from the NEXT step
         done = (tok == eos_id) if eos_id is not None \
             else jnp.zeros((B,), bool)

@@ -62,6 +62,12 @@ class TransformerConfig:
     # over the O(L^2) scores; the sp ring composes via the windowed ring
     # (bounded neighbor hops).  Decode uses an O(window) ring-buffer cache.
     window_size: Optional[int] = None
+    # Chunked prefill (decode.py make_generate_fn(prefill_chunk=...)):
+    # the largest multi-token chunk the decode-mode cache must serve in
+    # one call.  Windowed caches size their ring window+chunk-1 so a
+    # chunk's earliest query still sees its full window before the
+    # chunk's own writes evict it; irrelevant for full-length caches.
+    prefill_chunk: int = 1
     remat: bool = True  # jax.checkpoint each layer: HBM for FLOPs
     # MoE (k8s_tpu.models.moe): >0 swaps the dense MLP for routed experts
     # sharded over the ep mesh axis
@@ -174,19 +180,28 @@ class Attention(nn.Module):
     def _cache_vars(self, batch: int):
         """KV cache for autoregressive decoding (flax ``cache`` collection).
 
-        Cache length is ``window_size`` when sliding-window attention is
-        configured — a RING BUFFER (slot = position % window): decode
-        memory is O(window), not O(max_seq_len), which is the whole point
-        of SWA at inference (Mistral-style).  Keys are stored
-        post-rotary (RoPE is absolute-position, applied at write time), and
-        per-slot absolute positions make the validity/window mask exact in
-        both regimes.
+        Cache length is window-sized when sliding-window attention is
+        configured — a RING BUFFER (slot = position % S): decode memory is
+        O(window), not O(max_seq_len), which is the whole point of SWA at
+        inference (Mistral-style).  The ring holds ``window +
+        prefill_chunk - 1`` slots: a multi-token chunk writes itself
+        before attending, so the chunk's FIRST query (needing keys back to
+        q - window + 1) must still find them un-evicted after the chunk's
+        last write — the extra chunk-1 slots are exactly that headroom,
+        and the window upper bound is enforced by the mask instead of the
+        ring size.  Keys are stored post-rotary (RoPE is
+        absolute-position, applied at write time), and per-slot absolute
+        positions make the validity/causal/window mask exact in all
+        regimes.
         """
         cfg = self.config
-        # ring size is the WINDOW, not min(window, max_seq_len): a window
+        # ring size is window-based, not min'd with max_seq_len: a window
         # wider than max_seq_len still needs all window slots once decoding
         # runs past max_seq_len, or the cache would silently narrow it
-        S = cfg.window_size or cfg.max_seq_len
+        if cfg.window_size:
+            S = cfg.window_size + max(1, cfg.prefill_chunk) - 1
+        else:
+            S = cfg.max_seq_len
         shape = (batch, S, cfg.kv_heads, cfg.dims_per_head)
         ck = self.variable("cache", "k", jnp.zeros, shape, cfg.dtype)
         cv = self.variable("cache", "v", jnp.zeros, shape, cfg.dtype)
@@ -195,38 +210,49 @@ class Attention(nn.Module):
         return ck, cv, cp, S
 
     def _decode_step(self, q, k, v, positions):
-        """One cached decode step: write this token's K/V, attend the cache.
+        """One cached decode call: write this chunk's K/V, attend the cache.
 
-        q/k/v are [B, 1, H(kv), D] post-rotary; positions is [B, 1]
-        absolute.  The ring-buffer overwrite happens BEFORE attending, so
-        at position p the cache holds exactly positions p-S+1..p (once
-        warm) — the flash kernels' window convention 0 <= q_pos - k_pos <
-        window falls out of the buffer size, no extra window mask needed.
+        q/k/v are [B, Lc, H(kv), D] post-rotary (Lc = 1 for the token
+        loop, up to config.prefill_chunk for chunked prefill); positions
+        is [B, Lc] absolute.  Writes happen BEFORE attending; the mask
+        then does all the work — slot validity (kpos >= 0), causality
+        (kpos <= qpos, which also hides the chunk's own future tokens),
+        and the sliding window (qpos - kpos < window) when configured,
+        since a chunk-sized ring holds slightly more than one window.
         """
         cfg = self.config
-        B = q.shape[0]
+        B, Lc = q.shape[0], q.shape[1]
+        if cfg.window_size and Lc > max(1, cfg.prefill_chunk):
+            raise ValueError(
+                f"decode chunk of {Lc} tokens exceeds prefill_chunk "
+                f"({cfg.prefill_chunk}): the windowed ring cache only has "
+                "window + prefill_chunk - 1 slots, so a larger chunk "
+                "would evict keys its own earliest query still needs")
         ck, cv, cp, S = self._cache_vars(B)
-        b = jnp.arange(B)
-        slot = positions[:, 0] % S
-        ck.value = ck.value.at[b, slot].set(k[:, 0].astype(cfg.dtype))
-        cv.value = cv.value.at[b, slot].set(v[:, 0].astype(cfg.dtype))
-        cp.value = cp.value.at[b, slot].set(positions[:, 0])
+        b = jnp.arange(B)[:, None]
+        slot = positions % S  # [B, Lc]
+        ck.value = ck.value.at[b, slot].set(k.astype(cfg.dtype))
+        cv.value = cv.value.at[b, slot].set(v.astype(cfg.dtype))
+        cp.value = cp.value.at[b, slot].set(positions)
         keys, values, kpos = ck.value, cv.value, cp.value
         # grouped-query via grouped einsum: query head j attends kv head
         # j // rep (the same consecutive-duplication order as jnp.repeat
         # on axis 2) WITHOUT materializing a heads/kv_heads-times larger
         # copy of the cache inside the token loop's hot path
         rep = cfg.heads // cfg.kv_heads
-        B_, Q_ = q.shape[0], q.shape[1]
-        qg = q.reshape(B_, Q_, cfg.kv_heads, rep, cfg.dims_per_head)
+        qg = q.reshape(B, Lc, cfg.kv_heads, rep, cfg.dims_per_head)
         scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg, keys).astype(jnp.float32)
         scores = scores * (cfg.dims_per_head ** -0.5)
-        valid = kpos >= 0  # unfilled slots; ring overwrite enforces window
-        scores = jnp.where(valid[:, None, None, None, :], scores, -1e30)
+        mask = (kpos >= 0)[:, None, :] & \
+            (kpos[:, None, :] <= positions[:, :, None])  # [B, Lc, S]
+        if cfg.window_size:
+            mask &= positions[:, :, None] - kpos[:, None, :] \
+                < cfg.window_size
+        scores = jnp.where(mask[:, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(values.dtype),
                          values)
-        return out.reshape(B_, Q_, cfg.heads, cfg.dims_per_head)
+        return out.reshape(B, Lc, cfg.heads, cfg.dims_per_head)
 
     def _prefill_write(self, k, v, positions):
         """Scatter the prompt's last min(L, S) K/V into the cache."""

@@ -126,6 +126,60 @@ class TestShardedDecode:
         np.testing.assert_array_equal(got, want)
 
 
+class TestChunkedPrefill:
+    """Streaming the prompt through the cache in fixed chunks must be
+    token-exact with the one-shot prefill — including the windowed ring
+    buffer, whose extra chunk-1 slots keep a chunk's earliest query's
+    window alive across the chunk's own writes."""
+
+    def _run(self, cfg, prompt, steps):
+        from k8s_tpu.models.decode import make_generate_fn
+
+        params = init_params(cfg, prompt_len=prompt.shape[1])
+        a = make_generate_fn(cfg, steps)(
+            params, prompt, jax.random.PRNGKey(0))
+        b = make_generate_fn(cfg, steps, chunked_prefill=True)(
+            params, prompt, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        want = reference_greedy(cfg, params, prompt, steps)
+        np.testing.assert_array_equal(np.asarray(b), want)
+
+    def test_full_cache_with_remainder_chunk(self):
+        cfg = tiny(prefill_chunk=4)
+        prompt = (jnp.arange(20, dtype=jnp.int32).reshape(2, 10) * 7) % 61
+        self._run(cfg, prompt, 6)  # 10 = 2 (remainder) + 4 + 4
+
+    def test_windowed_gqa_rolling_prefill(self):
+        cfg = tiny(window_size=6, kv_heads=2, prefill_chunk=4)
+        prompt = (jnp.arange(20, dtype=jnp.int32).reshape(2, 10) * 11) % 61
+        self._run(cfg, prompt, 6)
+
+    def test_rolling_prefill_many_wraps_past_max_seq_len(self):
+        # the headline claim: a prompt MANY windows long (and past
+        # max_seq_len) streams through a 5-slot ring (window 4 + chunk 2
+        # - 1), wrapping it 8 times; exactness vs the teacher-forced
+        # oracle catches slot aliasing (position // S > 1) and
+        # position handling beyond max_seq_len
+        cfg = tiny(window_size=4, prefill_chunk=2, max_seq_len=16)
+        prompt = (jnp.arange(80, dtype=jnp.int32).reshape(2, 40) * 13) % 61
+        self._run(cfg, prompt, 6)
+
+    def test_prompt_shorter_than_chunk(self):
+        cfg = tiny(prefill_chunk=8)
+        prompt = (jnp.arange(6, dtype=jnp.int32).reshape(2, 3) * 5) % 61
+        self._run(cfg, prompt, 4)
+
+    def test_oversized_chunk_rejected_on_windowed_cache(self):
+        cfg = tiny(window_size=4, prefill_chunk=2)
+        params = init_params(cfg, prompt_len=6)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            Transformer(cfg).apply(
+                {"params": params},
+                jnp.zeros((2, 6), jnp.int32),
+                positions=jnp.broadcast_to(jnp.arange(6), (2, 6)),
+                mode="decode", mutable=["cache"])
+
+
 def seq_logprob(cfg, params, prompt, cont):
     """Teacher-forced log-prob of continuation ``cont`` [B, T] given
     prompt — the scoring oracle for beam search."""
