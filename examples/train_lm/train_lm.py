@@ -49,6 +49,16 @@ def parse_args(argv=None):
     p.add_argument("--seq_len", type=int, default=1024)
     p.add_argument("--learning_rate", type=float, default=1e-4)
     p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--lr_schedule", choices=["constant", "cosine", "linear"],
+                   default="constant")
+    p.add_argument("--warmup_steps", type=int, default=0,
+                   help="linear LR warmup before the schedule")
+    p.add_argument("--clip_norm", type=float, default=0.0,
+                   help="global gradient-norm clip; 0 disables")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="microbatches per optimizer update: activation "
+                   "memory of batch_size/grad_accum with full-batch "
+                   "update semantics (batch_size must divide evenly)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel size (>1 enables ring attention)")
@@ -158,6 +168,11 @@ def build_config(args, on_tpu: bool):
         raise SystemExit("--fused_ce on does not reach the pipeline step "
                          "(pp uses its own fused-loss step_fn); use "
                          "--fused_ce off with --pp")
+    if args.pp > 1 and args.grad_accum > 1:
+        raise SystemExit("--grad_accum does not reach the pipeline step "
+                         "(pp already microbatches via "
+                         "--num_microbatches); use --grad_accum 1 with "
+                         "--pp")
     if args.pp > 1 and args.eval_every > 0:
         raise SystemExit("--eval_every does not reach the pipeline step "
                          "(eval drives the plain apply_fn, which --pp "
@@ -216,7 +231,12 @@ def main(argv=None) -> int:
     log.info("%.1fM params", n_params / 1e6)
 
     optimizer = train_lib.default_optimizer(
-        args.learning_rate, weight_decay=args.weight_decay)
+        args.learning_rate, weight_decay=args.weight_decay,
+        clip_norm=args.clip_norm, schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        # decay spans whatever budget this run has; a resumed run restores
+        # opt_state (schedule step count included) from the checkpoint
+        decay_steps=max(1, args.train_steps - args.warmup_steps))
 
     if args.data_dir:
         from k8s_tpu.models.dataset import TokenDataset
@@ -240,6 +260,16 @@ def main(argv=None) -> int:
             eval_iter_factory = lambda: ds.batches(  # noqa: E731
                 args.batch_size, args.seq_len, shuffle=False, seed=0,
                 split="eval", eval_fraction=args.eval_fraction)
+            # probe NOW: an eval split smaller than the batch must fail at
+            # startup with a clear ask, not at the first eval mid-run
+            # after minutes of training (BatchStream's constructor guard
+            # runs without reading any data)
+            try:
+                eval_iter_factory()
+            except ValueError as e:
+                raise SystemExit(
+                    f"{e}\n  (raise --eval_fraction or lower "
+                    "--batch_size so the holdout covers one batch)")
         else:
             batches = ds.batches(args.batch_size, args.seq_len, seed=0)
     else:
@@ -325,6 +355,7 @@ def main(argv=None) -> int:
             state_shardings=shardings,
             eval_fn=eval_fn,
             eval_every=args.eval_every,
+            grad_accum=args.grad_accum,
         )
     finally:
         data_iter.close()

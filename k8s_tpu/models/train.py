@@ -42,10 +42,48 @@ def lm_loss(logits, tokens) -> jnp.ndarray:
     return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
 
 
-def default_optimizer(lr: float = 1e-3, weight_decay: float = 0.0):
-    if weight_decay:
-        return optax.adamw(lr, weight_decay=weight_decay)
-    return optax.adam(lr)
+def lr_schedule(lr: float, *, schedule: str = "constant",
+                warmup_steps: int = 0, decay_steps: int = 0,
+                final_fraction: float = 0.1):
+    """Learning-rate schedule factory: linear warmup to ``lr`` over
+    ``warmup_steps``, then "constant" | "cosine" | "linear" decay over
+    ``decay_steps`` down to ``final_fraction * lr``.  Pure optax
+    schedules — everything stays jit-traceable."""
+    if schedule not in ("constant", "cosine", "linear"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule != "constant" and decay_steps <= 0:
+        raise ValueError(f"schedule {schedule!r} needs decay_steps > 0")
+    end = lr * final_fraction
+    if schedule == "cosine":
+        main = optax.cosine_decay_schedule(lr, decay_steps,
+                                           alpha=final_fraction)
+    elif schedule == "linear":
+        main = optax.linear_schedule(lr, end, decay_steps)
+    else:
+        main = optax.constant_schedule(lr)
+    if warmup_steps > 0:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, warmup_steps), main],
+            boundaries=[warmup_steps])
+    return main
+
+
+def default_optimizer(lr: float = 1e-3, weight_decay: float = 0.0,
+                      *, clip_norm: float = 0.0, schedule: str = "constant",
+                      warmup_steps: int = 0, decay_steps: int = 0):
+    """Adam/AdamW with optional global-norm clipping and LR schedule.
+
+    The bare two-arg form is unchanged (constant LR, no clipping); the
+    keyword knobs compose as an optax chain: clip_by_global_norm →
+    adam(w)(schedule)."""
+    sched = lr if (schedule == "constant" and not warmup_steps) else \
+        lr_schedule(lr, schedule=schedule, warmup_steps=warmup_steps,
+                    decay_steps=decay_steps)
+    opt = (optax.adamw(sched, weight_decay=weight_decay) if weight_decay
+           else optax.adam(sched))
+    if clip_norm and clip_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(clip_norm), opt)
+    return opt
 
 
 def init_state(params: Any, optimizer) -> dict:
@@ -75,15 +113,60 @@ def make_train_step(
     apply_fn: Callable,
     loss_fn: Callable,
     optimizer,
+    grad_accum: int = 1,
 ) -> Callable:
     """One SPMD train step: grad → optimizer update.  Under jit over a mesh
-    with sharded inputs, XLA inserts the psum/reduce-scatter collectives."""
+    with sharded inputs, XLA inserts the psum/reduce-scatter collectives.
+
+    ``grad_accum > 1`` splits the global batch into that many microbatches
+    and accumulates their gradients under ``lax.scan`` before the single
+    optimizer update: activation memory drops to one microbatch's worth
+    while the update sees the FULL batch.  For batch-DECOMPOSABLE losses
+    (mean-reduced over examples, e.g. lm_loss / cross-entropy) the
+    mean-of-microbatch-grads equals the full-batch grad exactly when the
+    batch divides evenly (enforced).  Losses with batch-coupled terms —
+    notably the MoE load-balance aux, a product of batch statistics —
+    are averaged per microbatch instead, a standard and well-behaved but
+    not bit-identical approximation."""
 
     def step(state, batch):
-        def compute_loss(params):
-            return _combined_loss(apply_fn, loss_fn, params, batch)
+        def compute_loss(params, b):
+            return _combined_loss(apply_fn, loss_fn, params, b)
 
-        loss, grads = jax.value_and_grad(compute_loss)(state["params"])
+        if grad_accum > 1:
+            inputs, targets = batch
+            if inputs.shape[0] % grad_accum:
+                raise ValueError(
+                    f"global batch {inputs.shape[0]} not divisible into "
+                    f"{grad_accum} microbatches")
+
+            def split(x):
+                return x.reshape((grad_accum, x.shape[0] // grad_accum)
+                                 + x.shape[1:])
+
+            micro = (split(inputs), split(targets))
+
+            def accum(carry, mb):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(compute_loss)(
+                    state["params"], mb)
+                return (loss_sum + loss,
+                        jax.tree_util.tree_map(jnp.add, grad_sum, grads)), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"])
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                accum, (jnp.zeros((), jnp.float32), zeros), micro)
+            loss = loss_sum / grad_accum
+            # cast back to the PARAM leaf dtype — what value_and_grad
+            # would have produced directly — so the optimizer state never
+            # silently promotes to the f32 accumulator dtype
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / grad_accum).astype(p.dtype),
+                grad_sum, state["params"])
+        else:
+            loss, grads = jax.value_and_grad(compute_loss)(
+                state["params"], batch)
         updates, new_opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )
@@ -128,10 +211,12 @@ def make_sharded_train_step(
     mesh: Mesh,
     state_shardings: Any,
     batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    grad_accum: int = 1,
 ) -> Callable:
     """jit the train step with explicit in/out shardings and donated state —
     the full pjit path the dryrun validates multi-chip."""
-    step = make_train_step(apply_fn, loss_fn, optimizer)
+    step = make_train_step(apply_fn, loss_fn, optimizer,
+                           grad_accum=grad_accum)
     batch_sharding = NamedSharding(mesh, P(batch_axes))
     return jax.jit(
         step,
@@ -222,6 +307,7 @@ def fit(
     skip_data_on_resume: bool = True,
     eval_fn: Optional[Callable] = None,
     eval_every: int = 0,
+    grad_accum: int = 1,
 ) -> FitResult:
     """The canonical training loop: shard state over the mesh, jit the step,
     checkpoint/resume via k8s_tpu.models.checkpoint.
@@ -257,7 +343,8 @@ def fit(
     if step_fn is None:
         state, shardings = shard_train_state(state, mesh)
         step_fn = make_sharded_train_step(
-            apply_fn, loss_fn, optimizer, mesh, shardings)
+            apply_fn, loss_fn, optimizer, mesh, shardings,
+            grad_accum=grad_accum)
     elif state_shardings is not None:
         state = jax.device_put(state, state_shardings)
 

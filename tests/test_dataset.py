@@ -477,6 +477,18 @@ class TestWorkloadsOnRealData:
         # pull the loss clearly below it
         assert losses[-1] < 4.0, losses
 
+    def test_train_lm_undersized_eval_split_fails_at_startup(self):
+        """An eval split smaller than the batch must fail BEFORE training
+        starts (clear ask), not at the first eval minutes in."""
+        from examples.train_lm.train_lm import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["--preset", "tiny", "--train_steps", "6",
+                  "--batch_size", "16", "--seq_len", "64",
+                  "--data_dir", TOKEN_DIR,
+                  "--eval_every", "3", "--eval_fraction", "0.05"])
+        assert "eval_fraction" in str(exc.value)
+
     def test_train_lm_holdout_eval_on_real_text(self):
         """train_lm --eval_every on --data_dir: training excludes the
         stable holdout tail and logs a finite held-out loss."""

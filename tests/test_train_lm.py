@@ -65,6 +65,20 @@ class TestTrainLM:
             r.stderr[-600:]
         assert "generated[1]" in r.stderr
 
+    def test_trainer_knob_flags(self, tmp_path):
+        # cosine warmup schedule + clipping + grad accumulation through
+        # the CLI: trains to completion with finite loss
+        r = run_lm(tmp_path, BASE + [
+            "--train_steps=4", "--grad_accum=2", "--lr_schedule=cosine",
+            "--warmup_steps=2", "--clip_norm=1.0"])
+        assert r.returncode == 0, r.stderr
+        assert "training complete: 4 steps" in r.stderr
+
+    def test_grad_accum_rejected_under_pp(self, tmp_path):
+        r = run_lm(tmp_path, BASE + ["--pp=2", "--grad_accum=2"])
+        assert r.returncode != 0
+        assert "--grad_accum does not reach the pipeline step" in r.stderr
+
     def test_eval_every_logs_holdout_loss(self, tmp_path):
         r = run_lm(tmp_path, BASE + ["--train_steps=4", "--eval_every=2",
                                      "--eval_batches=2"])
