@@ -97,6 +97,9 @@ def parse_args(argv=None):
                    help="checkpoint dir; empty disables checkpointing")
     p.add_argument("--checkpoint_every", type=int, default=100)
     p.add_argument("--log_every", type=int, default=10)
+    p.add_argument("--metrics_path", default="",
+                   help="append train/eval scalars as JSONL; defaults to "
+                   "<train_dir>/metrics.jsonl when --train_dir is set")
     p.add_argument("--eval_every", type=int, default=0, metavar="N",
                    help="evaluate held-out loss every N steps (plus a "
                    "final eval); 0 disables. With --data_dir the holdout "
@@ -173,6 +176,10 @@ def build_config(args, on_tpu: bool):
                          "(pp already microbatches via "
                          "--num_microbatches); use --grad_accum 1 with "
                          "--pp")
+    if args.grad_accum > 1 and args.batch_size % args.grad_accum:
+        raise SystemExit(
+            f"--batch_size {args.batch_size} is not divisible into "
+            f"--grad_accum {args.grad_accum} microbatches")
     if args.pp > 1 and args.eval_every > 0:
         raise SystemExit("--eval_every does not reach the pipeline step "
                          "(eval drives the plain apply_fn, which --pp "
@@ -356,6 +363,9 @@ def main(argv=None) -> int:
             eval_fn=eval_fn,
             eval_every=args.eval_every,
             grad_accum=args.grad_accum,
+            metrics_path=args.metrics_path or (
+                os.path.join(args.train_dir, "metrics.jsonl")
+                if args.train_dir else ""),
         )
     finally:
         data_iter.close()

@@ -226,6 +226,46 @@ def make_sharded_train_step(
     )
 
 
+class MetricsWriter:
+    """Append-only JSONL training scalars (the TF-summaries role in the
+    reference's world — user code there wrote TF event files to a
+    mounted volume; here the trainer itself streams one JSON object per
+    record so curves survive preemption and are greppable/plottable with
+    nothing but the standard library).
+
+    Each record: {"step": N, "wall_time": unix_s, ...scalars}.  Writes
+    are line-buffered appends — a gang restart reopens the same file and
+    the resumed run's steps continue after the checkpoint's (earlier
+    in-flight duplicates are harmless: last-write-wins per step when
+    plotting).
+    """
+
+    def __init__(self, path: str):
+        import os as _os
+
+        self.path = path
+        d = _os.path.dirname(path)
+        if d:
+            _os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def write(self, step: int, **scalars) -> None:
+        import json as _json
+        import time as _time
+
+        rec = {"step": int(step),
+               "wall_time": round(_time.time(), 3)}
+        for k, v in scalars.items():
+            rec[k] = float(v)
+        self._f.write(_json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
 def make_eval_fn(apply_fn: Callable, loss_fn: Callable,
                  eval_iter_factory: Callable, *, batches: int = 8):
     """Held-out evaluation for fit(): mean loss over ``batches`` batches.
@@ -308,6 +348,7 @@ def fit(
     eval_fn: Optional[Callable] = None,
     eval_every: int = 0,
     grad_accum: int = 1,
+    metrics_path: str = "",
 ) -> FitResult:
     """The canonical training loop: shard state over the mesh, jit the step,
     checkpoint/resume via k8s_tpu.models.checkpoint.
@@ -335,6 +376,11 @@ def fit(
     in FitResult.eval_losses as (step, loss) pairs.  Held-out evaluation
     parity: the reference's dist-mnist logs test-set metrics alongside
     training (test/e2e/dist-mnist/dist_mnist.py).
+
+    ``metrics_path``: append training/eval scalars as JSONL
+    (MetricsWriter) — a loss record every log_every'th step (every step
+    when log_every=0) plus the final step and each eval; curves survive
+    preemption because records stream as they happen.
     """
     import logging
 
@@ -393,6 +439,14 @@ def fit(
 
         unsubscribe = signals.on_shutdown(preempted.set)
 
+    # chief-only: in a multi-host gang every process runs fit() and
+    # metrics_path usually points at the SHARED checkpoint volume — N
+    # writers appending the same file would duplicate every record and
+    # can interleave partial lines on network filesystems (orbax
+    # coordinates its own writes; scalars need this gate instead)
+    metrics = MetricsWriter(metrics_path) \
+        if metrics_path and jax.process_index() == 0 else None
+
     losses = []
     eval_losses = []
 
@@ -400,6 +454,8 @@ def fit(
         el = float(eval_fn(state))
         eval_losses.append((step_no, el))
         log.info("step %d eval loss %.4f", step_no, el)
+        if metrics is not None:
+            metrics.write(step_no, eval_loss=el)
 
     last_ran = None
     try:
@@ -410,6 +466,10 @@ def fit(
             last_ran = i
             if log_every and (i + 1) % log_every == 0:
                 log.info("step %d loss %.4f", i + 1, float(loss))
+            if metrics is not None and (
+                    not log_every or (i + 1) % log_every == 0
+                    or i + 1 == steps):
+                metrics.write(i + 1, loss=float(loss))
             if eval_fn is not None and eval_every \
                     and (i + 1) % eval_every == 0 and (i + 1) != steps:
                 run_eval(i + 1)
@@ -434,6 +494,8 @@ def fit(
     finally:
         if unsubscribe is not None:
             unsubscribe()
+        if metrics is not None:
+            metrics.close()
     return FitResult(
         state=state,
         losses=[float(l) for l in losses],

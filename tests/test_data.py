@@ -262,6 +262,54 @@ class TestTrainerKnobs:
                                np.asarray(u_free["w"]))
 
 
+def test_fit_metrics_writer_streams_jsonl(tmp_path):
+    """metrics_path streams loss records per log_every'th step + the
+    final step + every eval as JSONL; a second (resumed-style) fit
+    APPENDS rather than truncating."""
+    import json
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2), jax.devices()[:4])
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    def loss_fn(pred, target):
+        return jnp.mean((pred - target) ** 2)
+
+    optimizer = train_lib.default_optimizer(0.1)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32))
+    path = str(tmp_path / "m" / "metrics.jsonl")
+    eval_fn = train_lib.make_eval_fn(
+        apply_fn, loss_fn, lambda: data_lib.array_batches((x, y), 16,
+                                                          seed=9),
+        batches=1)
+
+    def run():
+        it = data_lib.prefetch_to_mesh(
+            data_lib.array_batches((x, y), 16, seed=1), mesh,
+            buffer_size=2)
+        state = train_lib.init_state({"w": jnp.zeros((4, 1))}, optimizer)
+        r = train_lib.fit(apply_fn, loss_fn, optimizer, state, mesh, it,
+                          steps=10, log_every=4, eval_fn=eval_fn,
+                          eval_every=5, metrics_path=path)
+        it.close()
+        return r
+
+    run()
+    rows = [json.loads(l) for l in open(path)]
+    loss_steps = [r["step"] for r in rows if "loss" in r]
+    eval_steps = [r["step"] for r in rows if "eval_loss" in r]
+    assert loss_steps == [4, 8, 10]  # log_every'th + final
+    assert eval_steps == [5, 10]     # interval + final eval
+    assert all("wall_time" in r for r in rows)
+
+    run()  # resumed-style second run appends
+    rows2 = [json.loads(l) for l in open(path)]
+    assert len(rows2) == 2 * len(rows)
+
+
 def test_prefetch_close_unblocks_blocked_consumer():
     """close() from another thread while the consumer is blocked on an empty
     queue must raise StopIteration in the consumer, not deadlock (the
