@@ -1,0 +1,139 @@
+"""Vision Transformer: patch embedding + the repo's Transformer encoder.
+
+Reference parity note: the reference's vision workloads are CNNs
+(dist-mnist, and the rebuild's ResNet-50 per BASELINE.json); ViT extends
+the model-family coverage with the dominant modern vision architecture
+while REUSING the LM stack wholesale — `transformer.Block` runs
+bidirectionally (config.causal=False), positions are passed as zeros so
+RoPE degenerates to the identity rotation (cos 0 = 1, sin 0 = 0) and the
+standard ViT learned position embedding does the positional work.  Fused
+RMSNorm, remat, and the FSDP sharding rules therefore apply to ViT
+unchanged.  Attention is the plain XLA path by design: the 197-token
+sequence (196 patches + cls) cannot align to the flash kernels' block
+tiling, and at this length the O(L^2) scores are small enough that XLA's
+fused attention is the right tool — flash earns its keep at LM context
+lengths, not here.
+
+TPU-first choices: the patch embed is a strided Conv (one big MXU matmul
+per image at patch granularity), tokens stay [B, 197, hidden] static, and
+bf16 activations with f32 params follow the LM configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from k8s_tpu.models.transformer import Block, RMSNorm, TransformerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden: int = 768
+    # the blocks use SwiGLU (three FFN matrices), so the canonical ViT-B
+    # parameter budget calls for 2/3 of the classic 4*hidden width —
+    # 2048, the same reparameterization Llama applies (total ~86M params,
+    # matching ViT-B/16)
+    ffn_hidden: int = 2048
+    layers: int = 12
+    heads: int = 12
+    dtype: Any = jnp.bfloat16
+    pool: str = "cls"  # "cls" token | "mean" over patch tokens
+    use_fused_norm: bool = False
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}")
+        n = self.image_size // self.patch_size
+        return n * n
+
+    def block_config(self) -> TransformerConfig:
+        """The encoder blocks' TransformerConfig: bidirectional, no
+        window, sequence = patches + cls token."""
+        return TransformerConfig(
+            vocab_size=1,  # unused: ViT embeds patches, not tokens
+            hidden=self.hidden, ffn_hidden=self.ffn_hidden,
+            layers=self.layers, heads=self.heads, kv_heads=self.heads,
+            max_seq_len=self.num_patches + 1, causal=False,
+            dtype=self.dtype, remat=self.remat,
+            # plain XLA attention: 197 tokens can't align to the flash
+            # kernels' tiling and don't need them (module docstring)
+            use_flash_attention=False,
+            use_fused_norm=self.use_fused_norm,
+        )
+
+
+def vit_b16(**overrides) -> ViTConfig:
+    """ViT-B/16 (the standard base config)."""
+    return ViTConfig(**overrides)
+
+
+def vit_tiny_test() -> ViTConfig:
+    """CPU-testable config."""
+    return ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                     hidden=64, ffn_hidden=128, layers=2, heads=4,
+                     dtype=jnp.float32, remat=False)
+
+
+class ViT(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        """[B, H, W, C] images -> [B, num_classes] logits.
+
+        ``train`` is accepted for API symmetry with resnet50 (ViT has no
+        batch-stat state; dropout-free following the modern recipe).
+        """
+        cfg = self.config
+        del train
+        B = images.shape[0]
+        bc = cfg.block_config()
+
+        x = nn.Conv(
+            cfg.hidden, kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), padding="VALID",
+            use_bias=True, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        x = x.reshape(B, cfg.num_patches, cfg.hidden)
+
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.hidden), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, cfg.hidden)).astype(cfg.dtype), x],
+            axis=1)
+        pos = self.param("pos_embedding", nn.initializers.normal(0.02),
+                         (1, cfg.num_patches + 1, cfg.hidden), jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+
+        # positions=0 everywhere: RoPE at position 0 is the identity, so
+        # the learned pos_embedding above is the only positional signal —
+        # and the LM Block is reused verbatim
+        zeros = jnp.zeros((B, cfg.num_patches + 1), jnp.int32)
+        block = nn.remat(Block) if cfg.remat else Block
+        for i in range(cfg.layers):
+            x = block(bc, name=f"layer_{i}")(x, zeros)
+
+        x = RMSNorm(fused=cfg.use_fused_norm, name="final_norm")(x)
+        if cfg.pool == "cls":
+            feat = x[:, 0]
+        elif cfg.pool == "mean":
+            feat = jnp.mean(x[:, 1:], axis=1)
+        else:
+            raise ValueError(f"unknown pool {cfg.pool!r} "
+                             "(expected 'cls' or 'mean')")
+        return nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="head",
+        )(feat.astype(jnp.float32))
