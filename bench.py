@@ -185,6 +185,9 @@ _REQUIRED_KEYS = {
     "decode": ("tokens_per_sec_per_chip", "tokens_per_sec_per_chip_std",
                "per_token_ms", "n_params", "batch_per_chip", "prompt_len",
                "new_tokens"),
+    "vit": ("images_per_sec_per_chip", "images_per_sec_per_chip_std",
+            "repeats", "step_time_ms", "flops_per_step",
+            "flops_per_sec_per_chip"),
 }
 
 
@@ -460,12 +463,17 @@ def bench_resnet50(batch_per_chip: int = 128, iters: int = 40, warmup: int = 5,
         ).compile(),
         what="resnet compile",
     )
-    # MFU uses the analytic model-FLOPs convention (ResNet-50 fwd ~4.1
-    # GFLOP/img at 224^2 counting 2*MACs, train step ~3x fwd); XLA's
+    # MFU uses the 2*MACs FLOP convention — the convention the hardware
+    # peak numbers (and bench_transformer's 6*params/token) use.
+    # ResNet-50 fwd is ~4.1 G MACs/img at 224^2 = ~8.2 GFLOP/img; train
+    # ~3x fwd.  NOTE: rounds 2-3 reported MFU from the raw MAC count
+    # (16.5% at 2657 img/s); the corrected convention doubles that to
+    # ~33% — the HBM-roofline analysis in BASELINE.md (44.8 GB/step at
+    # 819 GB/s bounds the step) is bandwidth-side and unchanged.  XLA's
     # cost-analysis count is reported separately as a cross-check — it
-    # includes BN/elementwise and backend-specific expansions, so using it
-    # for MFU would overstate utilization.
-    flops = 3 * 4.1e9 * batch
+    # includes BN/elementwise and backend-specific expansions, so using
+    # it for MFU would overstate utilization.
+    flops = 3 * 2 * 4.1e9 * batch
     xla_flops = cost_analysis_flops(step_c)
 
     def run_step(state):
@@ -629,6 +637,77 @@ def bench_transformer(batch_per_chip: int = 8, seq: int = 1024,
     }
 
 
+def bench_vit(batch_per_chip: int = 128, iters: int = 30, warmup: int = 5):
+    """ViT-B/16 train step, images/sec/chip (models/vit.py).
+
+    FLOP convention: 2*MACs (one multiply + one add), the same convention
+    the hardware peak numbers use and bench_transformer's 6*params/token
+    already follows.  ViT-B/16 fwd is ~17.6 G MACs/img at 224^2 with the
+    SwiGLU-2048 blocks (16.7G block matmuls + 0.7G attention + 0.1G patch
+    embed) = ~35.2 GFLOP/img; train ~3x fwd.  XLA's cost analysis is
+    reported as a cross-check.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_tpu.models.vit import ViT, vit_b16
+
+    n_chips = len(jax.devices())
+    batch = batch_per_chip * n_chips
+    # remat off: like the other benches this measures the throughput
+    # config (remat trades FLOPs for memory; B/16 at batch 128 fits)
+    model = ViT(vit_b16(remat=False))
+    images = jax.random.normal(
+        jax.random.PRNGKey(0), (batch, 224, 224, 3), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(0), (batch,), 0, 1000)
+
+    params = with_retries(
+        lambda: model.init(jax.random.PRNGKey(1), images[:1]),
+        what="vit init")
+    optimizer = optax.adamw(1e-3, weight_decay=0.05)
+    opt_state = with_retries(lambda: optimizer.init(params),
+                             what="vit opt init")
+
+    def step(params, opt_state, images, labels):
+        def loss_fn(p):
+            logits = model.apply(p, images)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step_c = with_retries(
+        lambda: jax.jit(step, donate_argnums=(0, 1)).lower(
+            params, opt_state, images, labels).compile(),
+        what="vit compile")
+    flops = 3 * 2 * 17.6e9 * batch  # 2*MACs convention, train ~3x fwd
+    xla_flops = cost_analysis_flops(step_c)
+
+    def run_step(state):
+        params, opt_state = state
+        params, opt_state, loss = step_c(params, opt_state, images, labels)
+        return (params, opt_state), loss
+
+    times = with_retries(
+        lambda: _time_steps(run_step, (params, opt_state), iters, warmup,
+                            repeats=_repeats_default()),
+        what="vit timing")
+    elapsed = _median(times)
+    rates = [batch * iters / t / n_chips for t in times]
+    return {
+        "images_per_sec_per_chip": _median(rates),
+        "images_per_sec_per_chip_std": _stdev(rates),
+        "repeats": len(times),
+        "flops_per_step": flops,
+        "xla_flops_per_step": xla_flops,
+        "flops_per_sec_per_chip": flops * iters / elapsed / n_chips,
+        "step_time_ms": elapsed / iters * 1000,
+    }
+
+
 def bench_decode(batch_per_chip: int = 32, prompt_len: int = 128,
                  new_tokens: int = 128, calls: int = 4, warmup: int = 1):
     """KV-cached autoregressive generation throughput (models/decode.py).
@@ -706,7 +785,8 @@ def bench_decode(batch_per_chip: int = 32, prompt_len: int = 128,
 
 def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
                  allow_stale: bool, device_kind: str | None,
-                 n_chips: int | None, want_decode: bool = False) -> dict:
+                 n_chips: int | None, want_decode: bool = False,
+                 want_vit: bool = False) -> dict:
     """Assemble the single JSON line from fresh + (optionally) last-good
     results, with per-result provenance so stale evidence is never silently
     presented as this round's measurement."""
@@ -718,8 +798,12 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
         except (OSError, ValueError):
             baseline = {}
 
-    resnet = transformer = control = decode = None
+    resnet = transformer = control = decode = vit = None
     stale_names = []
+    if want_vit:
+        vit, stale = recorder.get("vit", allow_stale)
+        if stale:
+            stale_names.append("vit")
     if want_decode:
         decode, stale = recorder.get("decode", allow_stale)
         if stale:
@@ -744,7 +828,7 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
             stale_names.append("transformer_xla_control")
 
     if device_kind is None:
-        for r in (resnet, transformer, decode):
+        for r in (resnet, transformer, decode, vit):
             if r and r.get("device_kind"):
                 device_kind = r["device_kind"]
                 break
@@ -823,6 +907,21 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
             out["value"] = out["transformer_tokens_per_sec_per_chip"]
             out["unit"] = "tokens/sec/chip"
             out["vs_baseline"] = out.get("transformer_vs_baseline", 1.0)
+    if vit:
+        out["vit_images_per_sec_per_chip"] = round(
+            vit["images_per_sec_per_chip"], 2)
+        out["vit_std"] = round(vit["images_per_sec_per_chip_std"], 2)
+        out["vit_step_time_ms"] = round(vit["step_time_ms"], 2)
+        vt_peak = peak_for(vit)
+        if vt_peak:
+            out["vit_mfu"] = round(vit["flops_per_sec_per_chip"] / vt_peak, 4)
+        if resnet is None and transformer is None and decode is None:
+            out["metric"] = "vit_images_per_sec_per_chip"
+            out["value"] = out["vit_images_per_sec_per_chip"]
+            out["unit"] = "images/sec/chip"
+            base = baseline.get("vit_images_per_sec_per_chip")
+            out["vs_baseline"] = (round(out["value"] / base, 4)
+                                  if base else 1.0)
     if decode:
         out["decode_tokens_per_sec_per_chip"] = round(
             decode["tokens_per_sec_per_chip"], 1)
@@ -865,10 +964,10 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
 
     only = os.environ.get("BENCH_ONLY", "").lower()
-    if only not in ("", "resnet", "transformer", "decode"):
+    if only not in ("", "resnet", "transformer", "decode", "vit"):
         print(
             f"bench: FATAL: unknown BENCH_ONLY={only!r} "
-            "(expected 'resnet', 'transformer' or 'decode')",
+            "(expected 'resnet', 'transformer', 'decode' or 'vit')",
             file=sys.stderr,
         )
         return 2
@@ -878,6 +977,7 @@ def main() -> int:
     # default round-end run stays the two training headlines, minimizing
     # its exposure to relay outages
     want_decode = only == "decode"
+    want_vit = only == "vit"
 
     recorder = Recorder()
     # Variant runs (sweeps, A/B drivers) set BENCH_NO_PERSIST: their configs
@@ -903,12 +1003,14 @@ def main() -> int:
         allow_stale = allow_stale and stale_ok
         out = build_output(recorder, want_resnet, want_transformer,
                            allow_stale, device_kind, n_chips,
-                           want_decode=want_decode)
+                           want_decode=want_decode, want_vit=want_vit)
         missing = []
         if want_resnet and "resnet50_step_time_ms" not in out:
             missing.append("resnet50")
         if want_decode and "decode_per_token_ms" not in out:
             missing.append("decode")
+        if want_vit and "vit_step_time_ms" not in out:
+            missing.append("vit")
         have_transformer = "transformer_step_time_ms" in out
         if want_transformer and not have_transformer:
             missing.append("transformer")
@@ -924,7 +1026,8 @@ def main() -> int:
             missing.append("transformer_xla_control")
         requested = [n for n, wanted in (("resnet50", want_resnet),
                                          ("transformer", want_transformer),
-                                         ("decode", want_decode))
+                                         ("decode", want_decode),
+                                         ("vit", want_vit))
                      if wanted]
         if missing and all(n in missing for n in requested):
             return -1  # nothing at all to show (single-benchmark runs too)
@@ -1008,17 +1111,22 @@ def main() -> int:
     rn_kw = {}
     tf_kw = {}
     dc_kw = {}
+    vt_kw = {}
     if os.environ.get("BENCH_SMOKE"):
         rn_kw = dict(batch_per_chip=2, iters=2, warmup=1)
         tf_kw = dict(batch_per_chip=1, seq=128, iters=2, warmup=1)
         dc_kw = dict(batch_per_chip=2, prompt_len=16, new_tokens=16,
                      calls=2, warmup=1)
+        vt_kw = dict(batch_per_chip=2, iters=2, warmup=1)
     if on_hardware and (os.environ.get("BENCH_SMOKE")
                         or os.environ.get("BENCH_SEQ")
                         or os.environ.get("BENCH_WINDOW")):
         on_hardware = False  # non-default shapes must not overwrite evidence
 
     try:
+        if want_vit:
+            recorder.record("vit", bench_vit(**vt_kw), on_hardware,
+                            device_kind)
         if want_decode:
             recorder.record("decode", bench_decode(**dc_kw), on_hardware,
                             device_kind)

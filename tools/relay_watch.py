@@ -134,9 +134,11 @@ def build_plan() -> list[dict]:
                  "BENCH_PHASE_TIMEOUT": "900",
                  **CACHE_ENV},
          "timeout": 2700},
-        # bonus: inference throughput (default decode config — persists to
-        # last-good); last so it can never starve the headline A/Bs
+        # bonus items: inference throughput and the ViT family bench
+        # (default configs — persist to last-good); last so they can
+        # never starve the headline A/Bs
         item("decode", {}, only="decode", persist=True),
+        item("vit", {}, only="vit", persist=True),
     ]
 
 
