@@ -163,7 +163,6 @@ class Element:
     # -- JS property protocol -------------------------------------------------
 
     def js_get(self, name: str):
-        interp = self.browser.interp
         simple = {
             "tagName": self.tag.upper(),
             "id": self.attrs.get("id", ""),

@@ -18,6 +18,12 @@ false positives:
 - **duplicate-dict-key**: a literal key repeated in a dict display.
 - **assert-tuple**: ``assert (cond, "msg")`` is always true.
 - **is-literal**: ``x is "s"`` / ``x is 3`` — identity on literals.
+- **unused-variable**: a function-local assigned but never read (pyflakes
+  F841 scope: tuple unpacking, bare annotations, and ``_``-prefixed names
+  are exempt; closure reads count as uses).
+- **f-string-no-placeholder**: ``f"text"`` with no ``{}`` interpolation.
+- **self-compare**: ``x == x`` / ``x is x`` / ``x < x`` on a bare name
+  (the NaN idiom ``x != x`` is allowed).
 
 ``# noqa`` on a line suppresses its findings (optionally ``# noqa: CODE``).
 """
@@ -53,6 +59,8 @@ _NOQA_ALIASES = {
     "undefined-name": {"f821"},
     "bare-except": {"e722"},
     "duplicate-dict-key": {"f601", "f602"},
+    "unused-variable": {"f841", "w0612"},
+    "f-string-no-placeholder": {"f541", "w1309"},
 }
 
 
@@ -145,6 +153,9 @@ def _check_undefined(source: str, path: str, tree: ast.Module) -> list[Finding]:
 def _check_ast(tree: ast.Module, module_used: set[str],
                dunder_all: set[str], is_init: bool) -> list[Finding]:
     findings = []
+    spec_ids = {id(n.format_spec) for n in ast.walk(tree)
+                if isinstance(n, ast.FormattedValue)
+                and n.format_spec is not None}
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defaults = list(node.args.defaults) + [
@@ -189,6 +200,26 @@ def _check_ast(tree: ast.Module, module_used: set[str],
                     findings.append(Finding(
                         "is-literal", node.lineno,
                         "identity comparison with a literal; use ==/!="))
+            # x == x / x is x / x < x on a bare name: always-constant
+            # result, almost certainly a typo for a second variable
+            # (NaN-check idiom is x != x — allowed)
+            left = node.left
+            for op, comp in zip(node.ops, node.comparators):
+                if (isinstance(left, ast.Name) and isinstance(comp, ast.Name)
+                        and left.id == comp.id
+                        and not isinstance(op, ast.NotEq)):
+                    findings.append(Finding(
+                        "self-compare", node.lineno,
+                        f"'{left.id}' compared with itself"))
+                left = comp
+        elif isinstance(node, ast.JoinedStr):
+            # skip format-spec JoinedStrs: {x:.1f} nests a placeholder-free
+            # JoinedStr('.1f') inside the FormattedValue — not an f-string
+            if id(node) not in spec_ids and not any(
+                    isinstance(v, ast.FormattedValue) for v in node.values):
+                findings.append(Finding(
+                    "f-string-no-placeholder", node.lineno,
+                    "f-string without any placeholders"))
     # unused module-level imports (skipped in __init__.py: re-export files
     # bind names precisely so CALLERS can import them)
     if is_init:
@@ -210,6 +241,80 @@ def _check_ast(tree: ast.Module, module_used: set[str],
             if bound not in module_used and bound not in dunder_all:
                 findings.append(Finding(
                     "unused-import", lineno, f"{bound!r} imported but unused"))
+    return findings
+
+
+def _check_unused_locals(tree: ast.Module) -> list[Finding]:
+    """Locals assigned but never read (pylint W0612), pure-AST scoping.
+
+    Conservative by construction: STORES are collected only from a
+    function's own immediate body (descent stops at nested
+    function/class/lambda scopes), while LOADS are collected from the
+    ENTIRE subtree — a name read by a nested closure therefore always
+    counts as used.  Underscore-prefixed names, parameters, and
+    global/nonlocal declarations are exempt; for-loop and except-as
+    bindings are included (the unused-binding idiom is ``_``).
+    """
+    findings = []
+
+    def own_body_nodes(fn):
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    # tuple/list unpacking is exempt (pyflakes F841 behavior): the
+    # B, L, H, D = x.shape idiom DOCUMENTS the shape; partial use is
+    # fine.  Applies wherever unpacking binds: assignments, for targets,
+    # comprehension generators, and with-items.  Bare annotations
+    # (x: int with no value) are declarations, not assignments — exempt.
+    exempt: set[int] = set()
+    for n in ast.walk(tree):
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, ast.For):
+            targets = [n.target]
+        elif isinstance(n, ast.comprehension):
+            targets = [n.target]
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            targets = [n.optional_vars]
+        elif isinstance(n, ast.AnnAssign) and n.value is None:
+            targets = [n.target]
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)) or (
+                    isinstance(n, ast.AnnAssign)):
+                exempt.update(id(x) for x in ast.walk(t)
+                              if isinstance(x, ast.Name))
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        declared: set[str] = set()
+        stores: dict[str, int] = {}
+        for n in own_body_nodes(fn):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                declared.update(n.names)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store) \
+                    and id(n) not in exempt:
+                # min(): own_body_nodes walks a stack (reverse order) and
+                # the finding must anchor — and noqa must match — the
+                # FIRST assignment line
+                stores[n.id] = min(stores.get(n.id, n.lineno), n.lineno)
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                stores[n.name] = min(stores.get(n.name, n.lineno), n.lineno)
+        loads = {n.id for n in ast.walk(fn)
+                 if isinstance(n, ast.Name)
+                 and not isinstance(n.ctx, ast.Store)}
+        for name, lineno in sorted(stores.items(), key=lambda kv: kv[1]):
+            if name.startswith("_") or name in declared or name in loads:
+                continue
+            findings.append(Finding(
+                "unused-variable", lineno,
+                f"local variable {name!r} assigned but never used"))
     return findings
 
 
@@ -238,6 +343,7 @@ def check_source(source: str, path: str = "<string>") -> list[Finding]:
     is_init = path.replace("\\", "/").endswith("__init__.py")
     findings = _check_undefined(source, path, tree)
     findings += _check_ast(tree, module_used, dunder_all, is_init)
+    findings += _check_unused_locals(tree)
 
     noqa = _noqa_lines(source)
     kept = []

@@ -76,7 +76,6 @@ class TestFormSemantics:
 
 class TestEvents:
     def test_bubbling_and_stop_propagation(self):
-        calls = []
         b = load('<div id="outer" onclick="hits.push(\'outer\')">'
                  '<button id="inner" onclick="hits.push(\'inner\')">x'
                  '</button></div>')

@@ -102,6 +102,63 @@ class TestNoFalsePositives:
         # an unrelated code does NOT suppress
         assert _codes("import json  # noqa: E501\n") == ["unused-import"]
 
+    def test_unused_variable_caught_but_unpacking_exempt(self):
+        src = ("def f(x):\n"
+               "    tmp = x + 1\n"
+               "    return x\n")
+        compile(src, "t.py", "exec")
+        assert "unused-variable" in _codes(src)
+        # tuple unpacking documents shapes — exempt (pyflakes F841)
+        assert "unused-variable" not in _codes(
+            "def f(q):\n    B, L, H = q.shape\n    return B\n")
+        # closure reads count as uses (loads come from the whole subtree)
+        assert "unused-variable" not in _codes(
+            "def f():\n    acc = []\n"
+            "    def g():\n        acc.append(1)\n    return g\n")
+        # underscore names are the intentional-discard idiom
+        assert "unused-variable" not in _codes(
+            "def f(xs):\n    _unused = xs.pop()\n    return xs\n")
+        # comprehension generators and with-items unpack too
+        assert "unused-variable" not in _codes(
+            "def f(items):\n    return [k for k, v in items]\n")
+        assert "unused-variable" not in _codes(
+            "def f(p):\n    with p as (a, b):\n        return a\n")
+        # bare annotations declare, they don't assign
+        assert "unused-variable" not in _codes(
+            "def f(cond):\n    x: int\n    return cond\n")
+
+    def test_unused_variable_anchors_first_assignment(self):
+        # the finding (and noqa matching) must sit on the FIRST
+        # assignment, regardless of AST traversal order
+        src = ("def f():\n"
+               "    x = 1\n"
+               "    y = 0\n"
+               "    x = 2\n"
+               "    return y\n")
+        hits = [f for f in pylint_lite.check_source(src, "t.py")
+                if f.code == "unused-variable"]
+        assert [f.lineno for f in hits] == [2]
+        suppressed = src.replace("    x = 1", "    x = 1  # noqa: F841")
+        assert "unused-variable" not in _codes(suppressed)
+
+    def test_f_string_without_placeholders(self):
+        assert "f-string-no-placeholder" in _codes('x = f"hello"\n')
+        # format specs nest placeholder-free JoinedStrs — not flagged
+        assert "f-string-no-placeholder" not in _codes(
+            'x = f"{1.0:.1f}"\n')
+        assert "f-string-no-placeholder" not in _codes(
+            'x = f"a {1}"\n')
+
+    def test_self_comparison(self):
+        src = "def f(a):\n    return a == a\n"
+        compile(src, "t.py", "exec")
+        assert "self-compare" in _codes(src)
+        # the NaN idiom x != x is allowed
+        assert "self-compare" not in _codes(
+            "def f(a):\n    return a != a\n")
+        assert "self-compare" not in _codes(
+            "def f(a, b):\n    return a == b\n")
+
     def test_annotations_count_as_use(self):
         src = ("from typing import Optional\n\n"
                "def f(x: Optional[int]) -> Optional[int]:\n"

@@ -20,7 +20,6 @@ class TestConditions:
     def test_same_status_reason_is_noop(self):
         st = v1alpha2.TFJobStatus()
         status_mod.set_condition(st, status_mod.new_condition("Running", "r", "m1"))
-        first = status_mod.get_condition(st, "Running")
         status_mod.set_condition(st, status_mod.new_condition("Running", "r", "m2"))
         again = status_mod.get_condition(st, "Running")
         assert again.message == "m1"  # unchanged: same status+reason skips update
