@@ -1,0 +1,110 @@
+"""Generate from a train_lm serving artifact: the inference half of the
+train -> checkpoint -> serve loop.
+
+    python serve_lm.py --train_dir DIR --text "once upon a "
+    python serve_lm.py --train_dir DIR --tokens 5,12,99 --beam 4
+
+Loads ``<train_dir>/serving/`` (written by train_lm on successful
+completion), reconstructs the model from model_config.json, and decodes
+with the KV-cached generator (models/decode.py) — greedy by default,
+temperature/top-k sampling, or beam search with --beam.  ``--text``
+byte-tokenizes the prompt (dataset.encode_bytes, the corpus format
+train_lm's --data_dir fixtures use) and prints decoded text back;
+``--tokens`` takes raw comma-separated ids and prints ids.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+log = logging.getLogger("serve_lm")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train_dir", required=True)
+    p.add_argument("--text", default="",
+                   help="byte-tokenized prompt (vocab must cover bytes)")
+    p.add_argument("--tokens", default="",
+                   help="comma-separated raw token ids")
+    p.add_argument("--max_new_tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--beam", type=int, default=0,
+                   help="beam width; >0 selects beam search (greedy "
+                   "scoring, ignores --temperature)")
+    p.add_argument("--eos", type=int, default=-1, help="eos token id")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--chunked_prefill", action="store_true",
+                   help="stream the prompt through the cache in "
+                   "config.prefill_chunk-token chunks")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = parse_args(argv)
+    if bool(args.text) == bool(args.tokens):
+        raise SystemExit("give exactly one of --text or --tokens")
+    if args.beam > 0 and args.chunked_prefill:
+        raise SystemExit("--chunked_prefill is not plumbed through beam "
+                         "search yet; drop one of the two flags")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_tpu.models import decode as decode_lib
+    from k8s_tpu.models import serving
+    from k8s_tpu.models.dataset import decode_bytes, encode_bytes
+
+    config, variables = serving.load_serving(args.train_dir)
+    log.info("loaded %s: %d layers, hidden %d, vocab %d",
+             args.train_dir, config.layers, config.hidden,
+             config.vocab_size)
+
+    if args.text:
+        ids = encode_bytes(args.text).astype(np.int32)
+        if ids.max(initial=0) >= config.vocab_size:
+            raise SystemExit(
+                f"--text byte ids exceed model vocab {config.vocab_size}; "
+                "use --tokens for non-byte-tokenized models")
+    else:
+        try:
+            ids = np.asarray([int(t) for t in args.tokens.split(",")],
+                             np.int32)
+        except ValueError:
+            raise SystemExit(f"bad --tokens {args.tokens!r}")
+        if ids.min(initial=0) < 0 or ids.max(initial=0) >= config.vocab_size:
+            raise SystemExit(f"token ids outside [0, {config.vocab_size})")
+    prompt = jnp.asarray(ids)[None, :]
+
+    eos = args.eos if args.eos >= 0 else None
+    params = variables["params"]
+    if args.beam > 0:
+        fn = decode_lib.make_beam_generate_fn(
+            config, args.max_new_tokens, beam_size=args.beam, eos_id=eos)
+        out, scores = fn(params, prompt)
+        log.info("beam score %.4f", float(scores[0]))
+    else:
+        fn = decode_lib.make_generate_fn(
+            config, args.max_new_tokens, temperature=args.temperature,
+            top_k=args.top_k or None, eos_id=eos,
+            chunked_prefill=args.chunked_prefill)
+        out = fn(params, prompt, jax.random.PRNGKey(args.seed))
+    toks = np.asarray(out)[0]
+    if eos is not None and eos in toks:
+        # rows freeze to pad after EOS; neither the EOS token nor the
+        # padding belongs in the rendered output
+        toks = toks[:list(toks).index(eos)]
+    if args.text:
+        print(args.text + decode_bytes(toks), flush=True)
+    else:
+        print(",".join(str(int(t)) for t in toks), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

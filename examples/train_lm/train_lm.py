@@ -370,6 +370,30 @@ def main(argv=None) -> int:
     finally:
         data_iter.close()
 
+    def maybe_export_serving():
+        # Chief-only, single-process (orbax save is a collective: a
+        # chief-only save on multi-host sharded arrays would hang in the
+        # multihost barrier), causal configs only (decode-mode attention
+        # is causal by construction — a bert-base artifact would serve
+        # silently wrong), never under pp (different state layout).
+        # Best-effort: a failed export must not flip the exit code of a
+        # SUCCESSFULLY completed training run (restartPolicy ExitCode
+        # would gang-restart a finished job).
+        if not (args.train_dir and args.pp == 1 and cfg.causal
+                and cfg_launch.process_id == 0
+                and cfg_launch.num_processes == 1
+                and cfg_launch.num_slices == 1):
+            return
+        try:
+            from k8s_tpu.models import serving
+
+            d = serving.export_serving(args.train_dir, cfg,
+                                       result.state["params"])
+            log.info("serving artifact exported to %s", d)
+        except Exception:  # noqa: BLE001 - never fail a finished job
+            log.exception("serving export failed (training itself "
+                          "succeeded; exit code unaffected)")
+
     if result.preempted:
         # retryable contract: the operator's exit-code policy gang-restarts
         # and the next run resumes from the checkpoint
@@ -381,9 +405,11 @@ def main(argv=None) -> int:
         # checkpoint restores at start_step >= steps and the loop never
         # runs.  That is success, not failure — exiting nonzero here would
         # turn a completed job permanent-Failed under restartPolicy
-        # ExitCode.
+        # ExitCode.  The restored state still exports a serving artifact:
+        # run 1 may have died in the export window after its final save.
         log.info("already complete at step %d (>= %d); nothing to do",
                  result.start_step, args.train_steps)
+        maybe_export_serving()
         return 0
     final = float(result.losses[-1])
     import math
@@ -393,6 +419,7 @@ def main(argv=None) -> int:
         return 1
     log.info("training complete: %d steps, final loss %.4f",
              args.train_steps, final)
+    maybe_export_serving()
     if args.generate > 0:
         if args.sp > 1 or args.pp > 1 or not cfg.causal \
                 or cfg_launch.num_processes > 1 or cfg_launch.num_slices > 1:

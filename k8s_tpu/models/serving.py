@@ -1,0 +1,112 @@
+"""Serving artifacts: the bridge from a training run to inference.
+
+``export_serving`` writes ``<train_dir>/serving/`` — ``model_config.json``
+(the TransformerConfig, dtype serialized by name) plus a params-only orbax
+checkpoint — so an inference process can reconstruct the model WITHOUT the
+training flags that produced it.  ``load_serving`` is the inverse; the
+pair closes the train → checkpoint → serve loop that the reference left
+entirely to user containers (its pods just mounted volumes;
+checkpoint/serving formats were user business — SURVEY.md §5
+checkpoint/resume).
+
+The params checkpoint is separate from the training checkpoints on
+purpose: training state embeds the optimizer pytree, whose STRUCTURE
+depends on the exact optimizer chain (schedule, clipping, accumulation),
+so restoring it requires reproducing those flags — exactly the coupling a
+serving artifact must not have.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax.numpy as jnp
+
+from k8s_tpu.models.transformer import TransformerConfig
+
+CONFIG_FILE = "model_config.json"
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+}
+
+
+def export_serving(train_dir: str, config: TransformerConfig,
+                   variables: Any) -> str:
+    """Write the serving artifact; returns the serving directory path.
+
+    ``variables`` is the model's variables dict ({"params": ...}) — the
+    same object train_lm passes to model.apply.
+    """
+    from k8s_tpu.models.checkpoint import Checkpointer
+
+    if not config.causal:
+        raise ValueError(
+            "serving artifacts are for causal LMs: decode-mode attention "
+            "is causal by construction, so a bidirectional (causal=False) "
+            "model would serve silently wrong")
+    d = os.path.join(train_dir, "serving")
+    os.makedirs(d, exist_ok=True)
+    # strip training-scale composition: the sp ring is rejected by decode
+    # modes, and params are identical with or without it
+    config = dataclasses.replace(config, use_ring_attention=False)
+    cfg = dataclasses.asdict(config)
+    dtype_name = jnp.dtype(config.dtype).name
+    if dtype_name not in _DTYPES:
+        raise ValueError(f"unserializable dtype {dtype_name!r}")
+    cfg["dtype"] = dtype_name
+    tmp = os.path.join(d, CONFIG_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(cfg, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, os.path.join(d, CONFIG_FILE))
+
+    # a resumed/re-run training writes a FRESH artifact: orbax refuses to
+    # overwrite an existing step in place, so replace the old step dir
+    import shutil
+
+    old = os.path.join(d, "0")
+    if os.path.isdir(old):
+        shutil.rmtree(old)
+    ckpt = Checkpointer(d, max_to_keep=1)
+    ckpt.save(0, {"params": variables}, force=True)
+    ckpt.wait()
+    ckpt.close()
+    return d
+
+
+def load_serving(train_dir: str) -> tuple[TransformerConfig, Any]:
+    """Reconstruct (config, variables) from a serving artifact.
+
+    The params template comes from a throwaway model.init at tiny
+    sequence length — shapes depend only on the config, not on the
+    sequence the training run used.
+    """
+    import jax
+
+    from k8s_tpu.models.checkpoint import Checkpointer
+    from k8s_tpu.models.transformer import Transformer
+
+    d = os.path.join(train_dir, "serving")
+    path = os.path.join(d, CONFIG_FILE)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no serving artifact at {d} (train with --train_dir; the "
+            "exporter runs on successful completion)")
+    with open(path) as f:
+        cfg_dict = json.load(f)
+    cfg_dict["dtype"] = _DTYPES[cfg_dict["dtype"]]
+    config = TransformerConfig(**cfg_dict)
+
+    model = Transformer(config)
+    seq = min(8, config.max_seq_len)
+    template = model.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, seq), jnp.int32))
+    ckpt = Checkpointer(d, max_to_keep=1)
+    restored = ckpt.restore(0, {"params": template})
+    ckpt.close()
+    return config, restored["params"]

@@ -92,6 +92,52 @@ class TestTrainLM:
         assert r.returncode == 0, r.stderr
         assert "--generate skipped" in r.stderr, r.stderr[-600:]
 
+    def test_serving_artifact_roundtrip_and_serve_cli(self, tmp_path):
+        """train -> serving artifact -> serve_lm generates: the full
+        train-to-inference loop through the artifacts alone (no training
+        flags reach the serving side)."""
+        import json
+        import subprocess
+
+        r = run_lm(tmp_path, BASE + ["--train_steps=2"])
+        assert r.returncode == 0, r.stderr
+        assert "serving artifact exported" in r.stderr
+        cfgd = json.load(open(tmp_path / "serving" / "model_config.json"))
+        assert cfgd["vocab_size"] == 256 and not cfgd["use_ring_attention"]
+
+        serve = os.path.join(REPO, "examples", "train_lm", "serve_lm.py")
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, serve, f"--train_dir={tmp_path}",
+             "--tokens=5,9,12", "--max_new_tokens=6"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert out.returncode == 0, out.stderr
+        ids = [int(t) for t in out.stdout.strip().split(",")]
+        assert len(ids) == 6 and all(0 <= t < 256 for t in ids)
+
+        # beam mode through the same artifact
+        out2 = subprocess.run(
+            [sys.executable, serve, f"--train_dir={tmp_path}",
+             "--tokens=5,9,12", "--max_new_tokens=4", "--beam=2"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert out2.returncode == 0, out2.stderr
+        assert "beam score" in out2.stderr
+
+    def test_serve_text_roundtrip_on_byte_corpus(self, tmp_path):
+        import subprocess
+
+        r = run_lm(tmp_path, BASE + [
+            "--train_steps=2", f"--data_dir={os.path.join(REPO, 'tests', 'fixtures', 'tokens')}"])
+        assert r.returncode == 0, r.stderr
+        serve = os.path.join(REPO, "examples", "train_lm", "serve_lm.py")
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, serve, f"--train_dir={tmp_path}",
+             "--text=the ", "--max_new_tokens=8"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.startswith("the ")  # prompt echoed + continuation
+
     def test_fused_ce_loss_exact(self, tmp_path):
         """--fused_ce on trains through make_fused_lm_apply_fn and the
         logged losses match the materialized head exactly (same seed, same
