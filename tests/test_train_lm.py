@@ -65,6 +65,40 @@ class TestTrainLM:
             r.stderr[-600:]
         assert "generated[1]" in r.stderr
 
+    def test_schedule_resumes_where_it_left_off(self, tmp_path):
+        """Cosine-with-warmup across a restart: opt_state carries the
+        schedule count, so an interrupted+resumed run's final loss must
+        EQUAL the uninterrupted control's — if resume restarted the
+        schedule at step 0 the LR trajectory (and loss) would differ."""
+        import re
+
+        # COUPLING: the interrupted run must stop at warmup_steps+1 steps.
+        # train_lm derives decay_steps from ITS OWN --train_steps, so the
+        # 3-step run's schedule only matches the control's first 3 steps
+        # because every update lands in warmup or exactly on the
+        # warmup/decay boundary (cosine phase 0 for any decay_steps).
+        # Change --train_steps/--warmup_steps together or the test fails
+        # without any resume bug.
+        knobs = ["--lr_schedule=cosine", "--warmup_steps=2",
+                 "--learning_rate=1e-2", "--clip_norm=1.0"]
+        control = run_lm(tmp_path / "a", BASE + knobs + [
+            "--train_steps=6", "--checkpoint_every=100"])
+        assert control.returncode == 0, control.stderr
+
+        first = run_lm(tmp_path / "b", BASE + knobs + [
+            "--train_steps=3", "--checkpoint_every=3"])
+        assert first.returncode == 0, first.stderr
+        second = run_lm(tmp_path / "b", BASE + knobs + [
+            "--train_steps=6", "--checkpoint_every=3"])
+        assert second.returncode == 0, second.stderr
+        assert "resumed" in second.stderr
+
+        def final_loss(stderr):
+            return re.findall(r"final loss ([\d.]+)", stderr)[-1]
+
+        assert final_loss(control.stderr) == final_loss(second.stderr), (
+            final_loss(control.stderr), final_loss(second.stderr))
+
     def test_trainer_knob_flags(self, tmp_path):
         # cosine warmup schedule + clipping + grad accumulation through
         # the CLI: trains to completion with finite loss
