@@ -32,6 +32,11 @@ def parse_args(argv=None):
                    "reference's input_data contract); empty uses synthetic "
                    "data")
     p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--eval_holdout", type=int, default=0, metavar="N",
+                   help="reserve the LAST N examples as a held-out eval "
+                   "split (excluded from training); final held-out "
+                   "accuracy is logged — the reference dist_mnist's "
+                   "test-set evaluation (single-process runs only)")
     p.add_argument("--train_dir", default=os.environ.get("CHECKPOINT_DIR", ""),
                    help="checkpoint dir; empty disables checkpointing")
     p.add_argument("--checkpoint_every", type=int, default=50)
@@ -118,6 +123,28 @@ def main(argv=None) -> int:
         rng = np.random.default_rng(0)
         ds_x = rng.normal(size=(64 * args.batch_size, 28, 28, 1)).astype(np.float32)
         ds_y = rng.integers(0, 10, size=(64 * args.batch_size,)).astype(np.int32)
+    eval_x = eval_y = None
+    if args.eval_holdout > 0:
+        if cfg.num_processes > 1:
+            log.warning("--eval_holdout skipped: multi-process runs hold "
+                        "sharded global params this single-host eval "
+                        "cannot fetch")
+        elif args.eval_holdout > len(ds_x) - args.batch_size:
+            raise SystemExit(
+                f"--eval_holdout {args.eval_holdout} leaves fewer than "
+                f"one training batch of {len(ds_x)} examples")
+        else:
+            eval_x, eval_y = ds_x[-args.eval_holdout:], ds_y[-args.eval_holdout:]
+            ds_x, ds_y = ds_x[:-args.eval_holdout], ds_y[:-args.eval_holdout]
+            log.info("held out %d examples for evaluation", len(eval_x))
+            if start_step > 0:
+                # the holdout is positional (last N): an earlier run with
+                # different/no --eval_holdout may have TRAINED on these
+                # examples before checkpointing
+                log.warning(
+                    "resuming at step %d: held-out accuracy is only a "
+                    "clean eval if every prior run used the same "
+                    "--eval_holdout", start_step)
     data_iter = data_lib.prefetch_to_mesh(
         data_lib.array_batches((ds_x, ds_y), args.batch_size, seed=start_step),
         mesh,
@@ -146,6 +173,13 @@ def main(argv=None) -> int:
     if loss is not None and not jnp.isfinite(loss):
         log.error("non-finite loss %s", loss)
         return 1
+    if eval_x is not None:
+        logits = jax.jit(
+            lambda p, x: model.apply({"params": p}, x)
+        )(state["params"], jnp.asarray(eval_x))
+        acc = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(eval_y)))
+        log.info("held-out accuracy %.4f over %d examples",
+                 acc, len(eval_x))
     log.info("training complete at step %d", args.train_steps)
     return 0
 

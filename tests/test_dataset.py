@@ -418,8 +418,11 @@ class TestCommittedMnistFixture:
 class TestWorkloadsOnRealData:
     def test_dist_mnist_trains_on_real_bytes(self, tmp_path):
         """dist_mnist --data_dir: loss decreases on the real-digits fixture
-        (the reference's real-MNIST e2e, dist_mnist.py:120-138)."""
+        and the held-out split scores far above chance (the reference's
+        real-MNIST e2e incl. its test-set evaluation,
+        dist_mnist.py:120-138)."""
         import logging
+        import re
 
         from examples.dist_mnist.dist_mnist import main
 
@@ -436,7 +439,8 @@ class TestWorkloadsOnRealData:
         try:                            # in main() is a no-op under it
             rc = main(["--train_steps", "30", "--batch_size", "64",
                        "--data_dir", MNIST_DIR,
-                       "--learning_rate", "3e-3"])
+                       "--learning_rate", "3e-3",
+                       "--eval_holdout", "256"])
         finally:
             logger.removeHandler(h)
         assert rc == 0
@@ -444,6 +448,10 @@ class TestWorkloadsOnRealData:
                   if "loss" in m and "step" in m]
         assert losses and losses[-1] < losses[0] * 0.7, losses
         assert any("real images" in m for m in records)
+        accs = [m for m in records if "held-out accuracy" in m]
+        assert accs, records
+        acc = float(re.search(r"accuracy ([\d.]+)", accs[0]).group(1))
+        assert acc > 0.3, acc  # chance is 0.1; 30 steps is a short run
 
     def test_train_lm_trains_on_real_text(self):
         """train_lm --data_dir: byte-level LM on the committed real-text
