@@ -61,6 +61,18 @@ class TestExampleManifests:
         assert job.spec.tpu.num_slices == 2
         assert job.spec.tf_replica_specs["TPU"].replicas == 8
 
+    def test_tf_job_serve_yaml(self):
+        # the serving manifest: single replica, Never (inference is
+        # idempotent — a crash should not loop), decodes from the volume
+        # the training job checkpointed to
+        job = load_one("tf_job_serve.yaml")
+        spec = job.spec.tf_replica_specs["Worker"]
+        assert spec.replicas == 1
+        assert spec.restart_policy == v1alpha2.RestartPolicyNever
+        cmd = spec.template["spec"]["containers"][0]["command"]
+        assert any("serve_lm.py" in c for c in cmd)
+        assert any(c.startswith("--train_dir=") for c in cmd)
+
     def test_tpu_smoke_yaml(self):
         job = load_one("tpu_smoke.yaml")
         assert job.spec.tf_replica_specs["TPU"].restart_policy == v1alpha2.RestartPolicyNever
