@@ -35,6 +35,13 @@ import time
 
 import pytest
 
+# One shared budget for every real-time bound that scales with box
+# contention (1-core full suite + relay-watcher probe subprocesses).
+# Retune HERE, not per-site: three prior rounds of per-literal edits
+# left the deadlines mutually inconsistent more than once.
+CONTENTION_BUDGET_S = float(os.environ.get("DRILL_CONTENTION_BUDGET_S",
+                                           "900"))
+
 from k8s_tpu.client.clientset import Clientset
 from k8s_tpu.client.gvr import NODES
 from k8s_tpu.client.rest import ClusterConfig, RestClient
@@ -165,7 +172,7 @@ def test_adversarial_drill(tmp_path, corpus_dir):
     env.pop("XLA_FLAGS", None)  # single-device control, no virtual mesh
     control = subprocess.run(
         _train_command(steps, corpus_dir), env=env, cwd=REPO,
-        capture_output=True, text=True, timeout=600)
+        capture_output=True, text=True, timeout=CONTENTION_BUDGET_S)
     assert control.returncode == 0, control.stdout + control.stderr
     m = FINAL_LOSS_RE.search(control.stderr + control.stdout)
     assert m, control.stdout + control.stderr
@@ -198,7 +205,7 @@ def test_adversarial_drill(tmp_path, corpus_dir):
             env={"CHECKPOINT_DIR": str(ckpt_dir)},
             restart_policy="ExitCode",
         ))
-        deadline = time.time() + 480  # worst-case: full-suite contention
+        deadline = time.time() + 0.8 * CONTENTION_BUDGET_S  # first checkpoint
         while time.time() < deadline:
             if ckpt_dir.exists() and any(ckpt_dir.iterdir()):
                 break
@@ -237,7 +244,7 @@ def test_adversarial_drill(tmp_path, corpus_dir):
         leader.crash()
 
         # everything must still converge under the standby
-        deadline = time.time() + 600  # sized for 1-core full-suite contention
+        deadline = time.time() + CONTENTION_BUDGET_S  # full convergence
         done_storm = set()
         trainer_done = False
         while time.time() < deadline and not (
