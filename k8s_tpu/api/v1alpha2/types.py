@@ -28,6 +28,15 @@ RestartPolicyAlways = "Always"
 RestartPolicyOnFailure = "OnFailure"
 RestartPolicyNever = "Never"
 RestartPolicyExitCode = "ExitCode"
+
+# Terminal-job pod cleanup (the capability upstream added immediately
+# after this snapshot's era; here opt-in).  "None" — the default —
+# preserves snapshot behavior: pods of finished jobs are kept for log
+# retrieval.  "Running" deletes only still-running pods (e.g. PS-style
+# replicas that never exit on their own); "All" deletes the whole gang.
+CleanPodPolicyNone = "None"
+CleanPodPolicyRunning = "Running"
+CleanPodPolicyAll = "All"
 VALID_RESTART_POLICIES = (
     RestartPolicyAlways,
     RestartPolicyOnFailure,
@@ -95,6 +104,8 @@ class TFJobSpec:
 
     tf_replica_specs: dict[str, TFReplicaSpec] = field(default_factory=dict)
     tpu: Optional[TPUSpec] = None
+    # None (unset) behaves as CleanPodPolicyNone — snapshot-era behavior
+    clean_pod_policy: Optional[str] = None
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -102,6 +113,8 @@ class TFJobSpec:
         }
         if self.tpu is not None:
             d["tpu"] = self.tpu.to_dict()
+        if self.clean_pod_policy is not None:
+            d["cleanPodPolicy"] = self.clean_pod_policy
         return d
 
     @classmethod
@@ -112,6 +125,7 @@ class TFJobSpec:
                 k: TFReplicaSpec.from_dict(v) for k, v in (d.get("tfReplicaSpecs") or {}).items()
             },
             tpu=TPUSpec.from_dict(d["tpu"]) if d.get("tpu") else None,
+            clean_pod_policy=d.get("cleanPodPolicy"),
         )
 
 

@@ -52,6 +52,12 @@ def validate_v1alpha2_tfjob_spec(spec: v2.TFJobSpec) -> None:
     types, replicas >= 1, at most one Chief, container present)."""
     if not spec.tf_replica_specs:
         raise ValidationError("TFJobSpec.tfReplicaSpecs must not be empty")
+    if spec.clean_pod_policy is not None and spec.clean_pod_policy not in (
+            v2.CleanPodPolicyNone, v2.CleanPodPolicyRunning,
+            v2.CleanPodPolicyAll):
+        raise ValidationError(
+            f"cleanPodPolicy {spec.clean_pod_policy!r} must be one of "
+            "None, Running, All")
     for rtype, r in spec.tf_replica_specs.items():
         if rtype not in v2.VALID_REPLICA_TYPES:
             raise ValidationError(

@@ -143,6 +143,7 @@ class FakePodControl:
         self.delete_pod_names: list[str] = []
         self.patches: list[dict] = []
         self.create_error: Exception | None = None
+        self.delete_error: Exception | None = None
 
     def create_pods_with_controller_ref(self, namespace, template, controller_obj, controller_ref):
         _validate_controller_ref(controller_ref)
@@ -153,6 +154,8 @@ class FakePodControl:
         return _pod_from_template(template, controller_ref)
 
     def delete_pod(self, namespace, name, controller_obj):
+        if self.delete_error is not None:
+            raise self.delete_error
         self.delete_pod_names.append(name)
 
     def patch_pod(self, namespace, name, patch):

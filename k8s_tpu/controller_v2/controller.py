@@ -296,8 +296,11 @@ class TFJobController:
     def reconcile_tfjobs(self, tfjob) -> None:
         """reconcileTFJobs (controller.go:377-412)."""
         if status_mod.is_finished(tfjob.status):
-            # Terminal jobs are left alone (pods kept for log retrieval,
-            # reference behavior); status still refreshed below.
+            # Terminal jobs: optionally clean up pods per cleanPodPolicy
+            # (upstream added the field right after this snapshot; the
+            # default None keeps pods for log retrieval — the snapshot's
+            # behavior); status still refreshed below.
+            self._clean_up_terminal_pods(tfjob)
             self.update_status_handler(tfjob)
             return
 
@@ -323,6 +326,55 @@ class TFJobController:
 
         tfjob.status.last_reconcile_time = now_rfc3339()
         self.update_status_handler(tfjob)
+
+    def _clean_up_terminal_pods(self, tfjob) -> None:
+        """cleanPodPolicy for finished jobs: "All" deletes the whole gang,
+        "Running" only pods still running (PS-style replicas that never
+        exit on their own), None/"None" keeps everything.  Deletions go
+        through PodControl with expectations accounting, exactly like a
+        gang restart, so the informer feedback loop stays consistent."""
+        policy = tfjob.spec.clean_pod_policy or types.CleanPodPolicyNone
+        if policy == types.CleanPodPolicyNone:
+            return
+        pods = self.get_pods_for_tfjob(tfjob)
+        key = tpu_config.tfjob_key(tfjob)
+        job_dict = tfjob.to_dict()
+        by_type: dict[str, list] = {}
+        for p in pods:
+            phase = (p.get("status") or {}).get("phase")
+            if policy == types.CleanPodPolicyRunning and phase != "Running":
+                continue
+            if (p.get("metadata") or {}).get("deletionTimestamp"):
+                continue  # already being deleted
+            rtype = ((p.get("metadata") or {}).get("labels") or {}).get(
+                tpu_config.LABEL_REPLICA_TYPE)
+            by_type.setdefault(rtype or "", []).append(p)
+        deleted = 0
+        for rtype, victims in by_type.items():
+            exp_key = (pod_mod.gen_expectation_pods_key(key, rtype)
+                       if rtype else None)
+            if exp_key:
+                self.expectations.expect_deletions(exp_key, len(victims))
+            for p in victims:
+                try:
+                    self.pod_control.delete_pod(
+                        tfjob.metadata.namespace, p["metadata"]["name"],
+                        job_dict)
+                    deleted += 1
+                except Exception:  # noqa: BLE001 - transient API failure
+                    # unwind THIS pod's expectation or the leaked count
+                    # wedges every later sync until the TTL (the creation
+                    # path guards its symmetric leak the same way,
+                    # pod.py _create_new_pod)
+                    if exp_key:
+                        self.expectations.deletion_observed(exp_key)
+                    log.exception("cleanPodPolicy delete failed for %s",
+                                  p["metadata"]["name"])
+        if deleted:
+            self.recorder.eventf(
+                job_dict, "Normal", "CleanPodPolicy",
+                "Deleted %d pod(s) of finished TFJob per cleanPodPolicy=%s",
+                deleted, policy)
 
     @staticmethod
     def _status_changed(observed: dict | None, current: dict) -> bool:

@@ -384,3 +384,83 @@ class TestValidationFailure:
         controller, pod_control, _, _ = build_controller(tfjob, [], [])
         controller.sync_tfjob(KEY)
         assert pod_control.templates == []
+
+
+class TestCleanPodPolicy:
+    """cleanPodPolicy on terminal jobs: All deletes the gang, Running only
+    still-running pods, default (unset/None) keeps everything — the
+    snapshot's keep-for-logs behavior."""
+
+    def _finished_job(self, policy):
+        from k8s_tpu.controller_v2 import status as status_mod
+
+        job = make_tfjob(worker=2, ps=1)
+        job.spec.clean_pod_policy = policy
+        status_mod.set_condition(
+            job.status,
+            status_mod.new_condition(v1alpha2.TFJobSucceeded, "done", "m"))
+        return job
+
+    def _pods(self):
+        return [
+            make_pod("worker", 0, "Succeeded", exit_code=0),
+            make_pod("worker", 1, "Running"),
+            make_pod("ps", 0, "Running"),
+        ]
+
+    def test_all_deletes_whole_gang(self):
+        job = self._finished_job(v1alpha2.CleanPodPolicyAll)
+        tc, pod_control, _, _ = build_controller(job, self._pods(), [])
+        tc.reconcile_tfjobs(job)
+        assert len(pod_control.delete_pod_names) == 3
+
+    def test_running_deletes_only_running_pods(self):
+        job = self._finished_job(v1alpha2.CleanPodPolicyRunning)
+        tc, pod_control, _, _ = build_controller(job, self._pods(), [])
+        tc.reconcile_tfjobs(job)
+        assert sorted(pod_control.delete_pod_names) == sorted([
+            f"{NS}-{JOB_NAME}-worker-1-x", f"{NS}-{JOB_NAME}-ps-0-x"])
+
+    def test_default_keeps_pods(self):
+        for policy in (None, v1alpha2.CleanPodPolicyNone):
+            job = self._finished_job(policy)
+            tc, pod_control, _, _ = build_controller(job, self._pods(), [])
+            tc.reconcile_tfjobs(job)
+            assert pod_control.delete_pod_names == []
+
+    def test_non_terminal_jobs_untouched(self):
+        job = make_tfjob(worker=2)
+        job.spec.clean_pod_policy = v1alpha2.CleanPodPolicyAll
+        pods = [make_pod("worker", 0, "Running"),
+                make_pod("worker", 1, "Running")]
+        tc, pod_control, _, _ = build_controller(job, pods, [])
+        tc.reconcile_tfjobs(job)
+        assert pod_control.delete_pod_names == []  # still training
+
+    def test_failed_delete_unwinds_expectation(self):
+        """A transient delete failure must not leak a deletion
+        expectation: the next sync of the job would otherwise early-out
+        on satisfied_expectations until the TTL."""
+        from k8s_tpu.controller_v2.pod import gen_expectation_pods_key
+
+        job = self._finished_job(v1alpha2.CleanPodPolicyAll)
+        tc, pod_control, _, _ = build_controller(job, self._pods(), [])
+        pod_control.delete_error = RuntimeError("api 500")
+        tc.reconcile_tfjobs(job)  # must not raise; unwinds per-pod
+        for rtype in ("worker", "ps"):
+            assert tc.expectations.satisfied(
+                gen_expectation_pods_key(KEY, rtype)), rtype
+
+    def test_spec_roundtrip_and_validation(self):
+        from k8s_tpu.api import validation
+
+        job = make_tfjob(worker=1)
+        job.spec.clean_pod_policy = "All"
+        d = job.spec.to_dict()
+        assert d["cleanPodPolicy"] == "All"
+        back = v1alpha2.TFJobSpec.from_dict(d)
+        assert back.clean_pod_policy == "All"
+        assert "cleanPodPolicy" not in make_tfjob(worker=1).spec.to_dict()
+        job.spec.clean_pod_policy = "Sometimes"
+        with pytest.raises(validation.ValidationError, match="cleanPodPolicy"):
+            validation.validate_v1alpha2_tfjob_spec(job.spec)
