@@ -21,6 +21,25 @@ def now_rfc3339() -> str:
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
+def parse_rfc3339(ts: Any) -> Optional[datetime]:
+    """RFC3339 → aware datetime, or None on junk.
+
+    Timezone-naive inputs (no 'Z'/offset — hand-edited statuses, foreign
+    clients) are pinned to UTC rather than left naive: a naive datetime
+    subtracted from an aware one raises TypeError, which once hot-looped a
+    controller sync.  The ONE parse used everywhere timestamps are read.
+    """
+    if not ts:
+        return None
+    try:
+        parsed = datetime.fromisoformat(str(ts).replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    if parsed.tzinfo is None:
+        parsed = parsed.replace(tzinfo=timezone.utc)
+    return parsed
+
+
 @dataclass
 class OwnerReference:
     """metav1.OwnerReference."""

@@ -106,6 +106,9 @@ class TFJobSpec:
     tpu: Optional[TPUSpec] = None
     # None (unset) behaves as CleanPodPolicyNone — snapshot-era behavior
     clean_pod_policy: Optional[str] = None
+    # wall-clock budget from StartTime (all replicas running): exceeded ->
+    # the job fails with reason DeadlineExceeded (+ cleanPodPolicy applies)
+    active_deadline_seconds: Optional[int] = None
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -115,6 +118,8 @@ class TFJobSpec:
             d["tpu"] = self.tpu.to_dict()
         if self.clean_pod_policy is not None:
             d["cleanPodPolicy"] = self.clean_pod_policy
+        if self.active_deadline_seconds is not None:
+            d["activeDeadlineSeconds"] = self.active_deadline_seconds
         return d
 
     @classmethod
@@ -126,6 +131,7 @@ class TFJobSpec:
             },
             tpu=TPUSpec.from_dict(d["tpu"]) if d.get("tpu") else None,
             clean_pod_policy=d.get("cleanPodPolicy"),
+            active_deadline_seconds=d.get("activeDeadlineSeconds"),
         )
 
 

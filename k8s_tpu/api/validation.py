@@ -58,6 +58,11 @@ def validate_v1alpha2_tfjob_spec(spec: v2.TFJobSpec) -> None:
         raise ValidationError(
             f"cleanPodPolicy {spec.clean_pod_policy!r} must be one of "
             "None, Running, All")
+    if spec.active_deadline_seconds is not None \
+            and spec.active_deadline_seconds <= 0:
+        raise ValidationError(
+            f"activeDeadlineSeconds must be > 0, "
+            f"got {spec.active_deadline_seconds}")
     for rtype, r in spec.tf_replica_specs.items():
         if rtype not in v2.VALID_REPLICA_TYPES:
             raise ValidationError(

@@ -304,6 +304,29 @@ class TFJobController:
             self.update_status_handler(tfjob)
             return
 
+        if self._deadline_exceeded(tfjob):
+            # fail the job; the NEXT sync (woken by the status MODIFIED
+            # event) takes the terminal path, where cleanPodPolicy runs.
+            # Deadline crossings with no cluster events are caught by the
+            # periodic resync (the reference's 15-30s backstop cadence).
+            status_mod.set_condition(
+                tfjob.status,
+                status_mod.new_condition(
+                    types.TFJobFailed, "DeadlineExceeded",
+                    f"TFJob {tfjob.metadata.name} exceeded its "
+                    f"activeDeadlineSeconds="
+                    f"{tfjob.spec.active_deadline_seconds}.",
+                ),
+            )
+            if tfjob.status.completion_time is None:
+                tfjob.status.completion_time = now_rfc3339()
+            self.recorder.eventf(
+                tfjob.to_dict(), "Warning", "DeadlineExceeded",
+                "Job ran for longer than activeDeadlineSeconds=%s",
+                tfjob.spec.active_deadline_seconds)
+            self.update_status_handler(tfjob)
+            return
+
         if not status_mod.get_condition(tfjob.status, types.TFJobCreated):
             status_mod.set_condition(
                 tfjob.status,
@@ -326,6 +349,24 @@ class TFJobController:
 
         tfjob.status.last_reconcile_time = now_rfc3339()
         self.update_status_handler(tfjob)
+
+    @staticmethod
+    def _deadline_exceeded(tfjob) -> bool:
+        """activeDeadlineSeconds: wall clock since StartTime (set when all
+        replicas first run, controller_status.go:45-50 semantics)."""
+        import datetime
+
+        from k8s_tpu.api.meta import parse_rfc3339
+
+        deadline = tfjob.spec.active_deadline_seconds
+        if not deadline:
+            return False
+        start = parse_rfc3339(tfjob.status.start_time)
+        if start is None:
+            return False
+        elapsed = (datetime.datetime.now(datetime.timezone.utc)
+                   - start).total_seconds()
+        return elapsed > deadline
 
     def _clean_up_terminal_pods(self, tfjob) -> None:
         """cleanPodPolicy for finished jobs: "All" deletes the whole gang,

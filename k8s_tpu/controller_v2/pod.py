@@ -138,16 +138,10 @@ def _pod_failure_finished_at(pod: dict) -> float | None:
         if cs.get("name") != "tensorflow":
             continue
         term = (cs.get("state") or {}).get("terminated") or {}
-        ts = term.get("finishedAt")
-        if not ts:
-            return None
-        try:
-            parsed = datetime.datetime.fromisoformat(str(ts).replace("Z", "+00:00"))
-        except ValueError:
-            return None
-        if parsed.tzinfo is None:
-            parsed = parsed.replace(tzinfo=datetime.timezone.utc)
-        return parsed.timestamp()
+        from k8s_tpu.api.meta import parse_rfc3339
+
+        parsed = parse_rfc3339(term.get("finishedAt"))
+        return parsed.timestamp() if parsed is not None else None
     return None
 
 

@@ -464,3 +464,77 @@ class TestCleanPodPolicy:
         job.spec.clean_pod_policy = "Sometimes"
         with pytest.raises(validation.ValidationError, match="cleanPodPolicy"):
             validation.validate_v1alpha2_tfjob_spec(job.spec)
+
+
+class TestActiveDeadline:
+    """activeDeadlineSeconds: wall-clock budget from StartTime; exceeded
+    jobs fail with reason DeadlineExceeded, then the terminal path applies
+    cleanPodPolicy on the next sync."""
+
+    def _running_job(self, deadline, started_ago_s):
+        import datetime
+
+        job = make_tfjob(worker=2)
+        job.spec.active_deadline_seconds = deadline
+        start = datetime.datetime.now(datetime.timezone.utc) - \
+            datetime.timedelta(seconds=started_ago_s)
+        job.status.start_time = start.strftime("%Y-%m-%dT%H:%M:%SZ")
+        return job
+
+    def test_exceeded_marks_failed_and_then_cleans(self):
+        job = self._running_job(deadline=30, started_ago_s=120)
+        job.spec.clean_pod_policy = v1alpha2.CleanPodPolicyAll
+        pods = [make_pod("worker", 0, "Running"),
+                make_pod("worker", 1, "Running")]
+        tc, pod_control, _, captured = build_controller(job, pods, [])
+        tc.reconcile_tfjobs(job)
+        cond = get_condition(job.status, v1alpha2.TFJobFailed)
+        assert cond is not None and cond.reason == "DeadlineExceeded"
+        assert job.status.completion_time
+        assert captured  # status written
+        assert pod_control.delete_pod_names == []  # cleanup is NEXT sync
+        tc.reconcile_tfjobs(job)  # terminal path now
+        assert len(pod_control.delete_pod_names) == 2
+
+    def test_within_deadline_untouched(self):
+        job = self._running_job(deadline=3600, started_ago_s=5)
+        pods = [make_pod("worker", 0, "Running"),
+                make_pod("worker", 1, "Running")]
+        tc, _, _, _ = build_controller(job, pods, [])
+        tc.reconcile_tfjobs(job)
+        assert get_condition(job.status, v1alpha2.TFJobFailed) is None
+
+    def test_timezone_naive_start_time_does_not_crash(self):
+        # a startTime without Z/offset (foreign client, hand-edited
+        # status) must neither crash the sync (naive - aware TypeError)
+        # nor be ignored: parse_rfc3339 pins naive stamps to UTC
+        job = self._running_job(deadline=30, started_ago_s=120)
+        job.status.start_time = job.status.start_time.rstrip("Z")
+        pods = [make_pod("worker", 0, "Running"),
+                make_pod("worker", 1, "Running")]
+        tc, _, _, _ = build_controller(job, pods, [])
+        tc.reconcile_tfjobs(job)
+        cond = get_condition(job.status, v1alpha2.TFJobFailed)
+        assert cond is not None and cond.reason == "DeadlineExceeded"
+
+    def test_no_start_time_never_expires(self):
+        job = make_tfjob(worker=2)
+        job.spec.active_deadline_seconds = 1
+        tc, _, _, _ = build_controller(
+            job, [make_pod("worker", 0, "Pending"),
+                  make_pod("worker", 1, "Pending")], [])
+        tc.reconcile_tfjobs(job)  # pods pending: StartTime unset
+        assert get_condition(job.status, v1alpha2.TFJobFailed) is None
+
+    def test_roundtrip_and_validation(self):
+        from k8s_tpu.api import validation
+
+        job = make_tfjob(worker=1)
+        job.spec.active_deadline_seconds = 600
+        d = job.spec.to_dict()
+        assert d["activeDeadlineSeconds"] == 600
+        assert v1alpha2.TFJobSpec.from_dict(d).active_deadline_seconds == 600
+        job.spec.active_deadline_seconds = 0
+        with pytest.raises(validation.ValidationError,
+                           match="activeDeadlineSeconds"):
+            validation.validate_v1alpha2_tfjob_spec(job.spec)
