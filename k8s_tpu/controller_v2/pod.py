@@ -24,7 +24,6 @@ TPU-native departures (SURVEY.md §7 "hard parts", designed deliberately):
 from __future__ import annotations
 
 import copy
-import datetime
 import logging
 import time
 
