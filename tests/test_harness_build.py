@@ -387,8 +387,13 @@ def test_bench_operator_time_to_ready():
     (BASELINE.md metric #1)."""
     from k8s_tpu.harness.bench_operator import bench_time_to_ready
 
-    result = bench_time_to_ready(jobs=4, replicas=2, timeout_s=30.0)
+    # 90s budget: 4 tiny jobs take <1s idle, but this test rides the e2e
+    # tier right after the ~30-min workload tier whose tail contention
+    # once flaked a 30s deadline on the 1-core box
+    result = bench_time_to_ready(jobs=4, replicas=2, timeout_s=90.0)
     assert result["jobs"] == 4
     assert result["time_to_ready_p50_s"] > 0
-    assert result["time_to_ready_max_s"] < 30.0
+    # no max_s assertion: bench_time_to_ready raises past timeout_s, so
+    # max < timeout holds by construction (a bound here is vacuous)
+    assert result["time_to_ready_max_s"] >= result["time_to_ready_p50_s"]
     assert result["jobs_per_sec"] > 0

@@ -37,6 +37,10 @@ class _Candidate:
             LeaderElectionConfig(
                 namespace="kube-system", name="tf-operator-v2",
                 identity=identity, lease_duration=lease_duration,
+                # renew_deadline < lease_duration (the k8s invariant):
+                # a starved leader must give up BEFORE the standby can
+                # legitimately acquire, or both reconcile concurrently
+                renew_deadline=min(1.0, lease_duration / 2),
                 retry_period=0.05,
             ),
         )
@@ -66,7 +70,7 @@ class _Candidate:
         self._thread.join(timeout=10)
 
 
-def _submit_and_wait(clientset, name: str, timeout: float = 30.0) -> dict:
+def _submit_and_wait(clientset, name: str, timeout: float = 90.0) -> dict:
     job = core_component(
         {"name": name, "namespace": NS, "num_masters": 1, "num_workers": 1,
          "num_ps": 0, "command": smoke_command()},
@@ -91,8 +95,11 @@ def test_standby_takes_over_after_leader_crash():
     backend = FakeCluster()
     observer = Clientset(backend)
     kubelet = KubeletSimulator(observer, NS).start()
-    a = _Candidate(backend, "op-a", lease_duration=0.6).start()
-    b = _Candidate(backend, "op-b", lease_duration=0.6).start()
+    # 3s lease: with the old 0.6s lease, a renewer thread starved for
+    # >0.6s under full-suite contention let the standby LEGITIMATELY
+    # take the lease and flake the exactly-one-leader assertion
+    a = _Candidate(backend, "op-a", lease_duration=3.0).start()
+    b = _Candidate(backend, "op-b", lease_duration=3.0).start()
     try:
         # exactly one instance leads; it serves a full job lifecycle
         deadline = time.time() + 10
@@ -108,9 +115,9 @@ def test_standby_takes_over_after_leader_crash():
         # only after the lease expires, then keep serving
         t0 = time.time()
         leader.crash()
-        assert standby.leading.wait(15), "standby never took over"
+        assert standby.leading.wait(45), "standby never took over"
         takeover = time.time() - t0
-        assert takeover >= 0.3, (
+        assert takeover >= 1.5, (
             f"standby led after {takeover:.2f}s — before lease expiry, "
             "meaning the crashed leader's lease was not honored")
         _submit_and_wait(observer, "job-after-failover")
