@@ -221,6 +221,15 @@ class Recorder:
             # sweep variants explore non-default configs; their numbers are
             # captured by the sweep driver, not the last-good record
             on_hardware = False
+        if (on_hardware and result.get("repeats", 1) < 2
+                and not os.environ.get("BENCH_ALLOW_SINGLE_REPEAT")):
+            # Statistical hygiene (VERDICT r4 weak #3): a single measurement
+            # has no std and must not become the round's headline evidence.
+            # BENCH_ALLOW_SINGLE_REPEAT=1 overrides for desperate windows.
+            print(f"bench: NOT persisting {name}: repeats="
+                  f"{result.get('repeats', 1)} < 2 (set "
+                  "BENCH_ALLOW_SINGLE_REPEAT=1 to override)", file=sys.stderr)
+            on_hardware = False
         with self._lock:
             result = dict(result)
             result["measured_at"] = _utcnow()

@@ -11,9 +11,10 @@ fixes that operationally:
 - the moment a probe succeeds, runs the measurement plan in priority order
   (cheapest/highest-value first), so even a short relay window yields the
   headline A/Bs;
-- every item's JSON line + stderr tail is appended to sweeps_r04/ as it
-  completes, and bench.py itself persists BENCH_LASTGOOD.json incrementally,
-  so a mid-battery relay death keeps everything measured so far;
+- every item's JSON line + stderr tail is appended to the sweep dir
+  (sweeps_r05/ by default; $RELAY_SWEEP_DIR overrides) as it completes, and
+  bench.py itself persists BENCH_LASTGOOD.json incrementally, so a
+  mid-battery relay death keeps everything measured so far;
 - items that fail (relay died) stay pending: the watcher goes back to
   probing and resumes the remaining plan on the next window.
 
@@ -30,7 +31,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUTDIR = os.path.join(REPO, "sweeps_r04")
+OUTDIR = os.path.join(REPO, os.environ.get("RELAY_SWEEP_DIR", "sweeps_r05"))
 STATE = os.path.join(OUTDIR, "state.json")
 PY = sys.executable
 
@@ -47,17 +48,24 @@ def log(msg: str) -> None:
     print(f"[{now()}] relay_watch: {msg}", flush=True)
 
 
-# Priority order: the resnet stem A/B and fused-CE A/B are the two open
-# headline questions (VERDICT r3 weak #2/#3); the full default bench run
-# (which refreshes BENCH_LASTGOOD at full repeats) comes last because a
-# last-good record from round 2/3's shapes already exists the moment the
-# first A/B lands.
+# Priority order (VERDICT r4 "next round" #1): spend relay windows
+# COST-AWARE — round 4's only green window (8 min) died inside a fresh
+# 23-minute resnet_s2d compile and landed nothing.  Round-5 order:
+#   (a) default-config persist items first (fused_ce_off = the headline
+#       transformer at repeats>=3, resnet_conv = the ~2-min provenance
+#       refresh) — these fix the repeats=1/std=0.0 and stale-provenance
+#       weaknesses with the smallest possible compile bill;
+#   (b) the fused-CE A/B partner (shares most of the transformer program);
+#   (c) decode + vit: the unmeasured inference/ViT perf identities
+#       (VERDICT r4 weak #5), moderate compiles;
+#   (d) flash-tile candidates (same shapes, different kernel tiles);
+#   (e) fresh-compile gambles LAST: resnet_s2d (the known 23-min compile)
+#       and the seq-2048 SWA pair;
+#   (f) full_bench to refresh everything at full repeats.
 #
-# Items are WINDOW-SIZED: one variant per item, 2 repeats.  The first
-# round-4 relay window lasted ~7 minutes and a 2-variant x 1000s sweep item
-# died mid-variant having landed nothing; single-variant items mean every
-# window that survives one compile+measure cycle banks one number, and the
-# A/B pairs are adjacent so a single healthy window measures both sides.
+# Items are WINDOW-SIZED: one variant per item, 3 repeats (statistical
+# hygiene: bench.py now refuses to stamp last-good at repeats=1).  A/B
+# pairs are adjacent so a single healthy window measures both sides.
 # A persistent XLA compilation cache (shared dir below) lets a re-attempt
 # after a mid-compile relay death skip straight to measurement when the
 # backend supports executable serialization.
@@ -77,7 +85,7 @@ def _variant_env(variants: list[dict], name: str) -> dict:
 def build_plan() -> list[dict]:
     bench_py = os.path.join(REPO, "bench.py")
     base = {
-        "BENCH_REPEATS": "2",
+        "BENCH_REPEATS": "3",
         "BENCH_NO_CONTROL": "1",
         "BENCH_PREFLIGHT_WINDOW": "60",
         # a hung phase (relay death) fails the item in ~10min instead of
@@ -97,7 +105,7 @@ def build_plan() -> list[dict]:
             env["BENCH_PHASE_TIMEOUT"] = str(phase_timeout)
         if not persist:
             # non-default configs stay out of the last-good-on-hardware
-            # record; the battery log (sweeps_r04/) is their artifact
+            # record; the battery log (OUTDIR) is their artifact
             env["BENCH_NO_PERSIST"] = "1"
         # bench's watchdog must fire before the subprocess kill so it can
         # emit its diagnostic + partial evidence before rc=124 erases it
@@ -114,19 +122,28 @@ def build_plan() -> list[dict]:
     # and up to 4x attention work per step, plus a fresh seq-2048 compile
     swa = [v for v in tf if v.get("group") == "swa"]
     return [
-        # resnet stem A/B: s2d is the unmeasured side (conv has the round-3
-        # number 2627±13); conv re-measures adjacently as the same-window
-        # control and refreshes the last-good record (it is the default)
-        item("resnet_s2d", _variant_env(rn, "s2d-stem"), only="resnet"),
+        # (a) default configs, persisted: headline transformer at 3 repeats
+        # (kills the std=0.0 weakness) and the ~2-min conv ResNet
+        # provenance refresh
+        item("fused_ce_off", {}, only="transformer", persist=True),
         item("resnet_conv", _variant_env(rn, "conv-stem"), only="resnet",
              persist=True),
+        # (b) the fused-CE A/B partner — mostly-shared transformer program
         item("fused_ce_on", {"BENCH_FUSED_CE": "1"}, only="transformer"),
-        item("fused_ce_off", {}, only="transformer", persist=True),
+        # (c) unmeasured perf identities: decode tokens/s + ViT images/s
+        item("decode", {}, only="decode", persist=True),
+        item("vit", {}, only="vit", persist=True),
+        # (d) flash-tile candidates (same model shapes, new kernel tiles)
         *[item("flash_" + v["name"].removeprefix("flash-"), dict(v["env"]),
                only="transformer") for v in tiles],
+        # (e) fresh-compile gambles LAST: s2d stem (died at 1382s compile in
+        # r4 — give it room) and the seq-2048 SWA pair
+        item("resnet_s2d", _variant_env(rn, "s2d-stem"), only="resnet",
+             timeout=2400, phase_timeout=2000),
         *[item(v["name"].replace("-", "_"), dict(v["env"]),
                only="transformer", timeout=1800, phase_timeout=900)
           for v in swa],
+        # (f) the full default bench at full repeats
         {"label": "full_bench",
          "argv": [PY, bench_py],
          "env": {"BENCH_PREFLIGHT_WINDOW": "120",
@@ -134,11 +151,6 @@ def build_plan() -> list[dict]:
                  "BENCH_PHASE_TIMEOUT": "900",
                  **CACHE_ENV},
          "timeout": 2700},
-        # bonus items: inference throughput and the ViT family bench
-        # (default configs — persist to last-good); last so they can
-        # never starve the headline A/Bs
-        item("decode", {}, only="decode", persist=True),
-        item("vit", {}, only="vit", persist=True),
     ]
 
 
