@@ -51,6 +51,8 @@ def main(argv=None) -> int:
     if args.beam > 0 and args.chunked_prefill:
         raise SystemExit("--chunked_prefill is not plumbed through beam "
                          "search yet; drop one of the two flags")
+    if args.top_k < 0:
+        raise SystemExit(f"--top_k must be >= 0, got {args.top_k}")
 
     import jax
     import jax.numpy as jnp
@@ -84,11 +86,18 @@ def main(argv=None) -> int:
     eos = args.eos if args.eos >= 0 else None
     params = variables["params"]
     if args.beam > 0:
+        if args.top_k > 0:
+            log.warning("--top_k %d has no effect with --beam (beam search "
+                        "scores greedily)", args.top_k)
         fn = decode_lib.make_beam_generate_fn(
             config, args.max_new_tokens, beam_size=args.beam, eos_id=eos)
         out, scores = fn(params, prompt)
         log.info("beam score %.4f", float(scores[0]))
     else:
+        if args.top_k > 0 and args.temperature == 0.0:
+            log.warning("--top_k %d has no effect at --temperature 0 "
+                        "(greedy argmax); pass --temperature > 0 to sample",
+                        args.top_k)
         fn = decode_lib.make_generate_fn(
             config, args.max_new_tokens, temperature=args.temperature,
             top_k=args.top_k or None, eos_id=eos,

@@ -312,7 +312,8 @@ class TFJobController:
             status_mod.set_condition(
                 tfjob.status,
                 status_mod.new_condition(
-                    types.TFJobFailed, "DeadlineExceeded",
+                    types.TFJobFailed,
+                    status_mod.TFJOB_DEADLINE_EXCEEDED_REASON,
                     f"TFJob {tfjob.metadata.name} exceeded its "
                     f"activeDeadlineSeconds="
                     f"{tfjob.spec.active_deadline_seconds}.",
@@ -376,7 +377,22 @@ class TFJobController:
         gang restart, so the informer feedback loop stays consistent."""
         policy = tfjob.spec.clean_pod_policy or types.CleanPodPolicyNone
         if policy == types.CleanPodPolicyNone:
-            return
+            # batch/v1 Job semantics for wall-clock budgets: a job failed
+            # for DeadlineExceeded must actually stop consuming the gang's
+            # TPUs, even under the keep-for-logs default — escalate to
+            # "Running" (running pods terminated, exited pods kept for
+            # logs).  Without this the deadline would mark the job Failed
+            # and leave the whole gang training forever.
+            failed = status_mod.get_condition(tfjob.status, types.TFJobFailed)
+            if (failed is not None and failed.reason ==
+                    status_mod.TFJOB_DEADLINE_EXCEEDED_REASON
+                    and failed.status == types.ConditionTrue):
+                policy = types.CleanPodPolicyRunning
+                escalated = True
+            else:
+                return
+        else:
+            escalated = False
         pods = self.get_pods_for_tfjob(tfjob)
         key = tpu_config.tfjob_key(tfjob)
         job_dict = tfjob.to_dict()
@@ -412,10 +428,20 @@ class TFJobController:
                     log.exception("cleanPodPolicy delete failed for %s",
                                   p["metadata"]["name"])
         if deleted:
-            self.recorder.eventf(
-                job_dict, "Normal", "CleanPodPolicy",
-                "Deleted %d pod(s) of finished TFJob per cleanPodPolicy=%s",
-                deleted, policy)
+            if escalated:
+                # the spec never set Running — say why pods vanished under
+                # the keep-for-logs default instead of naming a policy the
+                # user didn't write
+                self.recorder.eventf(
+                    job_dict, "Normal", "CleanPodPolicy",
+                    "Terminated %d running pod(s): activeDeadlineSeconds "
+                    "exceeded (cleanPodPolicy unset; exited pods kept)",
+                    deleted)
+            else:
+                self.recorder.eventf(
+                    job_dict, "Normal", "CleanPodPolicy",
+                    "Deleted %d pod(s) of finished TFJob per "
+                    "cleanPodPolicy=%s", deleted, policy)
 
     @staticmethod
     def _status_changed(observed: dict | None, current: dict) -> bool:

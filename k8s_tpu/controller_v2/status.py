@@ -25,6 +25,9 @@ TFJOB_SUCCEEDED_REASON = "TFJobSucceeded"
 TFJOB_RUNNING_REASON = "TFJobRunning"
 TFJOB_FAILED_REASON = "TFJobFailed"
 TFJOB_RESTARTING_REASON = "TFJobRestarting"
+# activeDeadlineSeconds failures (batch/v1 Job reason); load-bearing in the
+# controller: set on the deadline path, matched on the terminal-cleanup path
+TFJOB_DEADLINE_EXCEEDED_REASON = "DeadlineExceeded"
 
 
 def new_condition(cond_type: str, reason: str, message: str) -> types.TFJobCondition:

@@ -43,7 +43,12 @@ def sample_logits(logits, rng, temperature: float = 0.0,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        # clamp the large side: top_k >= vocab is a no-op filter, not a
+        # trace-time shape error (serve_lm lets arbitrary --top_k through)
+        kk = min(top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, kk)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 

@@ -496,6 +496,35 @@ class TestActiveDeadline:
         tc.reconcile_tfjobs(job)  # terminal path now
         assert len(pod_control.delete_pod_names) == 2
 
+    def test_exceeded_default_policy_still_stops_running_pods(self):
+        # batch/v1 Job semantics: a wall-clock budget that fires must free
+        # the gang's TPUs even under the keep-for-logs default policy —
+        # running pods are terminated, exited pods stay for logs
+        job = self._running_job(deadline=30, started_ago_s=120)
+        assert job.spec.clean_pod_policy is None
+        pods = [make_pod("worker", 0, "Running"),
+                make_pod("worker", 1, "Succeeded")]
+        tc, pod_control, _, _ = build_controller(job, pods, [])
+        tc.reconcile_tfjobs(job)  # marks Failed/DeadlineExceeded
+        tc.reconcile_tfjobs(job)  # terminal path: escalate None -> Running
+        assert len(pod_control.delete_pod_names) == 1
+
+    def test_non_deadline_failure_keeps_pods_under_default_policy(self):
+        # the escalation is scoped to DeadlineExceeded: an ordinary failed
+        # job under the default policy keeps its pods for log retrieval
+        from k8s_tpu.controller_v2 import status as status_mod
+
+        job = make_tfjob(worker=1)
+        status_mod.set_condition(
+            job.status,
+            status_mod.new_condition(v1alpha2.TFJobFailed,
+                                     status_mod.TFJOB_FAILED_REASON,
+                                     "worker exited 1"))
+        pods = [make_pod("worker", 0, "Running")]
+        tc, pod_control, _, _ = build_controller(job, pods, [])
+        tc.reconcile_tfjobs(job)
+        assert pod_control.delete_pod_names == []
+
     def test_within_deadline_untouched(self):
         job = self._running_job(deadline=3600, started_ago_s=5)
         pods = [make_pod("worker", 0, "Running"),

@@ -333,6 +333,15 @@ class TestSamplingAndEos:
                                 temperature=1.0, top_k=2)
             assert int(tok[0]) in (2, 3)
 
+    def test_top_k_wider_than_vocab_is_a_noop_filter(self):
+        # serve_lm lets arbitrary --top_k through; >= vocab must behave
+        # like unfiltered sampling, not raise a trace-time shape error
+        logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+        for k in (4, 7, 1000):
+            tok = sample_logits(logits, jax.random.PRNGKey(k),
+                                temperature=1.0, top_k=k)
+            assert 0 <= int(tok[0]) < 4
+
     def test_greedy_ignores_rng(self):
         logits = jnp.asarray([[0.0, 5.0, 1.0]])
         tok = sample_logits(logits, None, temperature=0.0)

@@ -143,11 +143,14 @@ class TestTrainLM:
         env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
         out = subprocess.run(
             [sys.executable, serve, f"--train_dir={tmp_path}",
-             "--tokens=5,9,12", "--max_new_tokens=6"],
+             "--tokens=5,9,12", "--max_new_tokens=6", "--top_k=5"],
             capture_output=True, text=True, env=env, timeout=300)
         assert out.returncode == 0, out.stderr
         ids = [int(t) for t in out.stdout.strip().split(",")]
         assert len(ids) == 6 and all(0 <= t < 256 for t in ids)
+        # --top_k at the greedy default temperature does nothing: the CLI
+        # must say so instead of silently ignoring the flag
+        assert "no effect at --temperature 0" in out.stderr, out.stderr[-600:]
 
         # beam mode through the same artifact
         out2 = subprocess.run(
