@@ -38,7 +38,17 @@ class ResourceClient:
     def update(self, obj: dict) -> dict:
         return self._backend.update(self.resource, self.namespace, obj)
 
-    def patch(self, name: str, patch: dict) -> dict:
+    def patch(self, name: str, patch: dict,
+              patch_type: str = "merge") -> dict:
+        """``patch_type`` selects the wire semantics: ``"merge"`` (RFC 7386
+        JSON merge patch, the default) or ``"strategic"`` (merge-keyed list
+        semantics — built-in API groups only; apiservers answer 415 for
+        custom resources)."""
+        if patch_type == "strategic":
+            return self._backend.patch_strategic(
+                self.resource, self.namespace, name, patch)
+        if patch_type != "merge":
+            raise ValueError(f"unknown patch_type {patch_type!r}")
         return self._backend.patch_merge(self.resource, self.namespace, name, patch)
 
     def delete(self, name: str, propagation: str = "Background") -> None:

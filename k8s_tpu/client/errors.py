@@ -29,6 +29,10 @@ def invalid(message: str = "") -> ApiError:
     return ApiError(422, "Invalid", message)
 
 
+def unsupported_media_type(message: str = "") -> ApiError:
+    return ApiError(415, "UnsupportedMediaType", message)
+
+
 def expired(message: str = "") -> ApiError:
     """410 Gone: a watch resourceVersion older than the server's retained
     event window.  Clients must relist and re-watch from the fresh list's

@@ -24,6 +24,7 @@ from k8s_tpu.api.meta import now_rfc3339
 from k8s_tpu.client import errors
 from k8s_tpu.client.gvr import GVR
 from k8s_tpu.client.selectors import labels_match, parse_label_selector
+from k8s_tpu.client import strategic_merge as strategic_merge_mod
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -285,9 +286,51 @@ class FakeCluster:
                         dst[k] = v
 
             merge(current, patch)
+            self._require_patch_metadata(current, resource, name)
             current["metadata"].pop("resourceVersion", None)  # patch never conflicts here
             self._record("patch", resource, namespace, name, patch)
             return self.update(resource, namespace, current)
+
+    @staticmethod
+    def _require_patch_metadata(merged: dict, resource: GVR, name: str) -> None:
+        """A patch that nulls out metadata (or replaces the object without
+        one) must 422 like a real apiserver, not KeyError in the handler
+        thread (the connection would die with no Status body)."""
+        if not isinstance(merged.get("metadata"), dict):
+            raise errors.invalid(
+                f"patch on {resource.plural} {name!r} may not remove "
+                "object metadata")
+
+    # API groups whose types carry strategic-merge struct tags.  Custom
+    # resources have no Go structs to tag: a real apiserver answers 415
+    # UnsupportedMediaType to a strategic patch on a CRD, and so does this
+    # store — silently merging would let the operator ship a patch type a
+    # real cluster rejects.
+    _STRATEGIC_GROUPS = frozenset({"", "apps", "batch", "policy", "extensions"})
+
+    def patch_strategic(self, resource: GVR, namespace: str, name: str,
+                        patch: dict) -> dict:
+        """application/strategic-merge-patch+json (client/strategic_merge)."""
+        if resource.group not in self._STRATEGIC_GROUPS:
+            raise errors.unsupported_media_type(
+                f"strategic merge patch is not supported for custom "
+                f"resource {resource.group}/{resource.plural}; use "
+                "application/merge-patch+json")
+        with self._lock:
+            current = self.get(resource, namespace, name)
+            try:
+                merged = strategic_merge_mod.strategic_merge(current, patch)
+            except strategic_merge_mod.StrategicMergeError as e:
+                raise errors.invalid(str(e))
+            # strategic_merge is pure, but metadata may still alias the
+            # store under copy_on_io=False; update() stores a private copy
+            # only when copy_on_io is on, so re-copy the merged tree here
+            if self._copy is not _copy_mod.deepcopy:
+                merged = _copy_mod.deepcopy(merged)
+            self._require_patch_metadata(merged, resource, name)
+            merged["metadata"].pop("resourceVersion", None)
+            self._record("patch", resource, namespace, name, patch)
+            return self.update(resource, namespace, merged)
 
     def delete(
         self,

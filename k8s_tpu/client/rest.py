@@ -419,11 +419,12 @@ class RestClient:
             headers["Authorization"] = f"Bearer {self.config.token}"
         return headers
 
-    def _request(self, method: str, url: str, body: Optional[dict] = None, stream: bool = False):
+    def _request(self, method: str, url: str, body: Optional[dict] = None,
+                 stream: bool = False, content_type: Optional[str] = None):
         data = json.dumps(body).encode() if body is not None else None
         headers = self._headers(body)
         if body is not None and method == "PATCH":
-            headers["Content-Type"] = "application/merge-patch+json"
+            headers["Content-Type"] = content_type or "application/merge-patch+json"
         path = url
 
         if stream:
@@ -546,6 +547,14 @@ class RestClient:
 
     def patch_merge(self, resource: GVR, namespace: str, name: str, patch: dict) -> dict:
         return self._request("PATCH", self._url(resource, namespace, name), patch)
+
+    def patch_strategic(self, resource: GVR, namespace: str, name: str,
+                        patch: dict) -> dict:
+        """PATCH with application/strategic-merge-patch+json (merge-keyed
+        list semantics; 415 from real apiservers for custom resources)."""
+        return self._request(
+            "PATCH", self._url(resource, namespace, name), patch,
+            content_type="application/strategic-merge-patch+json")
 
     def delete(self, resource: GVR, namespace: str, name: str, propagation="Background"):
         url = self._url(resource, namespace, name, query={"propagationPolicy": propagation})

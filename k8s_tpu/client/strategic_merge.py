@@ -1,0 +1,206 @@
+"""Strategic merge patch (``application/strategic-merge-patch+json``).
+
+Real PodControl paths patch with *strategic* merge semantics, not JSON merge
+(reference: pkg/controller.v2/controller_pod.go:99-169 uses client-go's
+PatchPod, which sends types.StrategicMergePatchType): lists tagged with a
+``patchMergeKey`` in the Kubernetes API structs merge element-by-element on
+that key instead of being replaced wholesale, and ``$patch`` directives can
+delete or replace individual elements.  A fixture that only speaks JSON
+merge patch (RFC 7386) silently diverges on every list the operator touches
+— containers, env, ports, volumes, ownerReferences.
+
+This module implements the subset of SMP semantics the operator's shapes
+exercise, driven by the core-v1 merge-key schema below:
+
+- maps merge recursively; an explicit ``null`` deletes the key (as in JSON
+  merge patch); a map carrying ``{"$patch": "replace"}`` replaces the
+  target map wholesale;
+- lists whose field has a merge key merge by that key: patch elements
+  update matching current elements (recursively), unmatched patch elements
+  append, and ``{"$patch": "delete", <key>: v}`` elements remove the
+  matching current element; a literal ``{"$patch": "replace"}`` element
+  makes the remainder of the patch list replace the current list;
+- ``$setElementOrder/<field>`` reorders a merged list by its merge keys;
+- ``$deleteFromPrimitiveList/<field>`` removes values from a primitive
+  list; primitive lists tagged ``patchStrategy: merge`` (finalizers) union;
+- every other list is atomic and replaces, exactly like JSON merge patch.
+
+Not implemented (the operator never generates them, and the fixture should
+fail loudly rather than guess): ``$retainKeys``, merge keys nested beyond
+one level of the same field name, ``patchStrategy: retainKeys``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# patchMergeKey by FIELD NAME, as tagged in the core-v1 / apps / policy Go
+# structs (k8s.io/api).  Several distinct structs share a field name with
+# different keys ("ports" is containerPort on containers, port/name on
+# services), so each entry lists candidates; _resolve_merge_key picks the
+# first candidate present in every element on both sides, which is exactly
+# the element shape the API guarantees for that struct.
+MERGE_KEYS: dict[str, tuple[str, ...]] = {
+    "containers": ("name",),
+    "initContainers": ("name",),
+    "ephemeralContainers": ("name",),
+    "env": ("name",),
+    "ports": ("containerPort", "port", "name"),
+    "volumes": ("name",),
+    "volumeMounts": ("mountPath",),
+    "volumeDevices": ("devicePath",),
+    "hostAliases": ("ip",),
+    "imagePullSecrets": ("name",),
+    "ownerReferences": ("uid",),
+    "conditions": ("type",),
+    "secrets": ("name",),
+}
+# NOT merge-keyed, deliberately: tolerations, taints, and readinessGates
+# carry no patchMergeKey tag in k8s.io/api structs — they are atomic lists
+# that replace wholesale, and merging them here would diverge from a real
+# apiserver in the opposite direction.
+
+# primitive lists tagged patchStrategy=merge in the API structs: the patch
+# list unions into the current list instead of replacing it
+PRIMITIVE_MERGE_FIELDS = frozenset({"finalizers"})
+
+_PATCH = "$patch"
+_ORDER_PREFIX = "$setElementOrder/"
+_DELETE_PRIMITIVE_PREFIX = "$deleteFromPrimitiveList/"
+
+
+class StrategicMergeError(ValueError):
+    """Malformed strategic merge patch (unknown directive, bad shape)."""
+
+
+def _resolve_merge_key(field: str, current: list, patch: list) -> Optional[str]:
+    """The merge key for ``field``, or None for non-merge-keyed fields.
+
+    For a merge-keyed field, every patch element must CARRY the key — a
+    real apiserver rejects the patch otherwise ("does not contain declared
+    merge key"); silently degrading to atomic replacement would let a buggy
+    controller patch pass the fixture and fail the real cluster.
+    """
+    candidates = MERGE_KEYS.get(field, ())
+    if not candidates:
+        return None
+    elems = [e for e in (*current, *patch) if isinstance(e, dict)]
+    if not elems:
+        return None
+    for cand in candidates:
+        if all(cand in e for e in elems):
+            return cand
+    raise StrategicMergeError(
+        f"strategic merge patch for {field!r} needs every element to carry "
+        f"one of the merge keys {list(candidates)}")
+
+
+def _merge_list(field: str, current: list, patch: list, order: Optional[list]):
+    # a literal {"$patch": "replace"} element: the rest of the patch list IS
+    # the new list
+    cleaned = []
+    replace = False
+    for e in patch:
+        if isinstance(e, dict) and e.get(_PATCH) == "replace" and len(e) == 1:
+            replace = True
+            continue
+        cleaned.append(e)
+    if replace:
+        return [e for e in cleaned if not (
+            isinstance(e, dict) and e.get(_PATCH) == "delete")]
+
+    key = _resolve_merge_key(field, current, cleaned)
+    if key is None:
+        if field in PRIMITIVE_MERGE_FIELDS and all(
+                not isinstance(e, (dict, list)) for e in (*current, *cleaned)):
+            return current + [e for e in cleaned if e not in current]
+        return cleaned  # atomic: replace wholesale (JSON-merge behavior)
+
+    out = list(current)
+    for e in cleaned:
+        if not isinstance(e, dict):
+            raise StrategicMergeError(
+                f"list field {field!r} merges on {key!r} but patch element "
+                f"{e!r} is not an object")
+        directive = e.get(_PATCH)
+        idx = next((i for i, c in enumerate(out)
+                    if isinstance(c, dict) and c.get(key) == e.get(key)), None)
+        if directive == "delete":
+            if idx is not None:
+                out.pop(idx)
+            continue
+        if directive is not None:
+            raise StrategicMergeError(
+                f"unknown $patch directive {directive!r} in {field!r}")
+        if idx is None:
+            out.append(e)
+        else:
+            out[idx] = strategic_merge(out[idx], e)
+    if order is not None:
+        # order entries are objects carrying the merge key (the format
+        # kubectl emits), but tolerate raw key values too
+        pos = {}
+        for i, e in enumerate(order):
+            pos[e.get(key) if isinstance(e, dict) else e] = i
+        out.sort(key=lambda c: pos.get(
+            c.get(key) if isinstance(c, dict) else None, len(pos)))
+    return out
+
+
+def strategic_merge(current: dict, patch: dict) -> dict:
+    """Apply ``patch`` to ``current`` with strategic-merge semantics.
+
+    Pure: returns a new dict; neither input is mutated (callers hand in
+    store-aliased objects).
+    """
+    if patch.get(_PATCH) == "replace":
+        return {k: v for k, v in patch.items() if k != _PATCH}
+    if _PATCH in patch:
+        raise StrategicMergeError(
+            f"unknown map-level $patch directive {patch[_PATCH]!r}")
+    out = dict(current)
+    orders: dict[str, list] = {}
+    deletes: dict[str, list] = {}
+    for k, v in patch.items():
+        if k.startswith(_ORDER_PREFIX):
+            orders[k[len(_ORDER_PREFIX):]] = v
+        elif k.startswith(_DELETE_PRIMITIVE_PREFIX):
+            deletes[k[len(_DELETE_PRIMITIVE_PREFIX):]] = v
+    for k, v in patch.items():
+        if k.startswith(_ORDER_PREFIX) or k.startswith(_DELETE_PRIMITIVE_PREFIX):
+            continue
+        cur = out.get(k)
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and v.get(_PATCH) == "delete":
+            # {"$patch": "delete"} as a map value deletes the key —
+            # consistently whether or not the target currently exists
+            if len(v) > 1:
+                raise StrategicMergeError(
+                    f"map-level $patch delete for {k!r} must not carry "
+                    "other fields")
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(cur, dict):
+            out[k] = strategic_merge(cur, v)
+        elif isinstance(v, dict) and v.get(_PATCH) == "replace":
+            out[k] = {kk: vv for kk, vv in v.items() if kk != _PATCH}
+        elif isinstance(v, list):
+            out[k] = _merge_list(k, cur if isinstance(cur, list) else [],
+                                 v, orders.pop(k, None))
+        else:
+            out[k] = v
+    # $setElementOrder / $deleteFromPrimitiveList can arrive WITHOUT a
+    # sibling patch list (reorder-only / delete-only patches)
+    for field, order in orders.items():
+        cur = out.get(field)
+        if isinstance(cur, list):
+            out[field] = _merge_list(field, cur, [], order)
+    for field, victims in deletes.items():
+        cur = out.get(field)
+        if isinstance(cur, list):
+            if not isinstance(victims, list):
+                raise StrategicMergeError(
+                    f"$deleteFromPrimitiveList/{field} must be a list, "
+                    f"got {victims!r}")
+            out[field] = [e for e in cur if e not in victims]
+    return out

@@ -84,7 +84,11 @@ class RealPodControl:
         )
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> None:
-        self.clientset.pods(namespace).patch(name, patch)
+        # strategic, not JSON merge: client-go's PodControl sends
+        # types.StrategicMergePatchType (controller_pod.go:99-169), so
+        # ownerReferences/containers/env lists merge by key on the wire
+        self.clientset.pods(namespace).patch(name, patch,
+                                             patch_type="strategic")
 
 
 class RealServiceControl:
@@ -131,7 +135,9 @@ class RealServiceControl:
         )
 
     def patch_service(self, namespace: str, name: str, patch: dict) -> None:
-        self.clientset.services(namespace).patch(name, patch)
+        # strategic for the same reason as RealPodControl.patch_pod
+        self.clientset.services(namespace).patch(name, patch,
+                                                 patch_type="strategic")
 
 
 class FakePodControl:

@@ -119,6 +119,10 @@ class PodControllerRefManager(ControllerRefManager):
         ref = self._controller_ref().to_dict()
 
         def adopt(pod):
+            # strategic merge on ownerReferences (merge key: uid): OUR ref
+            # is added/updated, other owners survive — replacing the list
+            # wholesale would silently drop them (pod_control.go adoption
+            # patch semantics)
             self.pod_control.patch_pod(
                 pod["metadata"].get("namespace", ""),
                 pod["metadata"]["name"],
@@ -126,10 +130,13 @@ class PodControllerRefManager(ControllerRefManager):
             )
 
         def release(pod):
+            # delete ONLY our ownerReference via the $patch delete
+            # directive, exactly like client-go's release patch
             self.pod_control.patch_pod(
                 pod["metadata"].get("namespace", ""),
                 pod["metadata"]["name"],
-                {"metadata": {"ownerReferences": []}},
+                {"metadata": {"ownerReferences": [
+                    {"$patch": "delete", "uid": ref["uid"]}]}},
             )
 
         return self.claim(pods, adopt, release)
@@ -157,7 +164,8 @@ class ServiceControllerRefManager(ControllerRefManager):
             self.service_control.patch_service(
                 svc["metadata"].get("namespace", ""),
                 svc["metadata"]["name"],
-                {"metadata": {"ownerReferences": []}},
+                {"metadata": {"ownerReferences": [
+                    {"$patch": "delete", "uid": ref["uid"]}]}},
             )
 
         return self.claim(services, adopt, release)

@@ -315,10 +315,27 @@ class _Handler(BaseHTTPRequestHandler):
         route, _ = self._route_and_query()
         if route is None or not route.name:
             return self._send_status_error(errors.invalid(f"bad patch path {self.path}"))
+        # real apiservers dispatch PATCH semantics on Content-Type; a JSON
+        # merge patch and a strategic merge patch differ on every
+        # merge-keyed list (containers, env, ownerReferences, ...)
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
         try:
-            obj = self.server.cluster.patch_merge(
-                route.resource, route.namespace or "", route.name, self._read_body()
-            )
+            body = self._read_body()
+            if ctype == "application/merge-patch+json":
+                obj = self.server.cluster.patch_merge(
+                    route.resource, route.namespace or "", route.name, body)
+            elif ctype == "application/strategic-merge-patch+json":
+                obj = self.server.cluster.patch_strategic(
+                    route.resource, route.namespace or "", route.name, body)
+            else:
+                # real apiservers accept only the registered patch media
+                # types — a bare application/json (or nothing) gets 415,
+                # and so does this fixture, so a client that forgets the
+                # header fails here, not first on a real cluster
+                return self._send_status_error(errors.unsupported_media_type(
+                    f"unsupported patch type {ctype!r}; use "
+                    "application/merge-patch+json or "
+                    "application/strategic-merge-patch+json"))
             return self._send_json(200, obj)
         except errors.ApiError as e:
             return self._send_status_error(e)

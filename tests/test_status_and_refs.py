@@ -104,7 +104,11 @@ class TestClaimPods:
         m = self._manager(control=control)
         claimed = m.claim_pods([_pod("a", labels={"app": "y"}, owner_uid="u1")])
         assert claimed == []
-        assert control.patches == [{"metadata": {"ownerReferences": []}}]
+        # release deletes ONLY our ref via the strategic $patch directive
+        # (a bare [] would be a strategic no-op and nuke co-owners under
+        # JSON merge)
+        assert control.patches == [{"metadata": {"ownerReferences": [
+            {"$patch": "delete", "uid": "u1"}]}}]
 
     def test_deleting_controller_does_not_adopt(self):
         control = FakePodControl()

@@ -1,0 +1,317 @@
+"""Strategic-merge-patch conformance (VERDICT r4 #5).
+
+Real PodControl paths patch with application/strategic-merge-patch+json
+(reference: pkg/controller.v2/controller_pod.go:99-169 via client-go's
+types.StrategicMergePatchType); the fixture apiserver previously spoke JSON
+merge patch only, which diverges on every merge-keyed list.  These tests pit
+BOTH patch types against known-divergent fixtures — unit-level against the
+engine, store-level against FakeCluster, and wire-level against the HTTP
+apiserver — so the operator's patch paths run under the semantics a real
+apiserver would apply.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_tpu.client import errors, gvr
+from k8s_tpu.client.fake import FakeCluster
+from k8s_tpu.client.strategic_merge import (
+    StrategicMergeError,
+    strategic_merge,
+)
+
+POD = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {
+        "name": "p",
+        "namespace": "ns",
+        "labels": {"app": "x"},
+        "ownerReferences": [
+            {"kind": "TFJob", "name": "a", "uid": "u-a", "controller": True},
+            {"kind": "Other", "name": "b", "uid": "u-b"},
+        ],
+        "finalizers": ["keep.io/one"],
+    },
+    "spec": {
+        "containers": [
+            {"name": "tensorflow",
+             "image": "tf:1",
+             "env": [{"name": "A", "value": "1"}, {"name": "B", "value": "2"}],
+             "ports": [{"containerPort": 2222, "name": "tfjob-port"}]},
+            {"name": "sidecar", "image": "sc:1"},
+        ],
+        "volumes": [{"name": "data", "emptyDir": {}}],
+        "tolerations": [{"key": "tpu", "operator": "Exists"}],
+    },
+}
+
+
+class TestEngineDivergence:
+    """The list semantics that make strategic != JSON merge."""
+
+    def test_containers_merge_by_name_not_replace(self):
+        patch = {"spec": {"containers": [
+            {"name": "tensorflow", "image": "tf:2"}]}}
+        out = strategic_merge(POD, patch)
+        by_name = {c["name"]: c for c in out["spec"]["containers"]}
+        # JSON merge would have REPLACED the list, dropping the sidecar and
+        # the tensorflow container's env/ports
+        assert set(by_name) == {"tensorflow", "sidecar"}
+        assert by_name["tensorflow"]["image"] == "tf:2"
+        assert by_name["tensorflow"]["env"] == POD["spec"]["containers"][0]["env"]
+        # inputs are never mutated
+        assert POD["spec"]["containers"][0]["image"] == "tf:1"
+
+    def test_env_merges_by_name_inside_merged_container(self):
+        patch = {"spec": {"containers": [
+            {"name": "tensorflow",
+             "env": [{"name": "B", "value": "22"},
+                     {"name": "C", "value": "3"}]}]}}
+        out = strategic_merge(POD, patch)
+        env = {e["name"]: e["value"]
+               for e in out["spec"]["containers"][0]["env"]}
+        assert env == {"A": "1", "B": "22", "C": "3"}
+
+    def test_owner_references_merge_by_uid(self):
+        # adoption patch: our ref merges in, the co-owner SURVIVES (JSON
+        # merge would wipe it)
+        ref = {"kind": "TFJob", "name": "a", "uid": "u-a",
+               "controller": True, "blockOwnerDeletion": True}
+        out = strategic_merge(POD, {"metadata": {"ownerReferences": [ref]}})
+        refs = {r["uid"]: r for r in out["metadata"]["ownerReferences"]}
+        assert set(refs) == {"u-a", "u-b"}
+        assert refs["u-a"]["blockOwnerDeletion"] is True
+
+    def test_patch_delete_directive_removes_one_element(self):
+        out = strategic_merge(POD, {"metadata": {"ownerReferences": [
+            {"$patch": "delete", "uid": "u-a"}]}})
+        assert [r["uid"] for r in out["metadata"]["ownerReferences"]] == ["u-b"]
+
+    def test_empty_list_patch_is_a_noop(self):
+        # the old release payload: under strategic semantics [] merges
+        # nothing and deletes nothing
+        out = strategic_merge(POD, {"metadata": {"ownerReferences": []}})
+        assert len(out["metadata"]["ownerReferences"]) == 2
+
+    def test_patch_replace_directive_replaces_list(self):
+        out = strategic_merge(POD, {"spec": {"containers": [
+            {"$patch": "replace"},
+            {"name": "only", "image": "o:1"}]}})
+        assert [c["name"] for c in out["spec"]["containers"]] == ["only"]
+
+    def test_atomic_list_replaces_like_json_merge(self):
+        # no merge key for command/args: wholesale replacement
+        cur = {"spec": {"containers": [
+            {"name": "c", "command": ["a", "b"]}]}}
+        out = strategic_merge(cur, {"spec": {"containers": [
+            {"name": "c", "command": ["z"]}]}})
+        assert out["spec"]["containers"][0]["command"] == ["z"]
+
+    def test_null_deletes_key(self):
+        out = strategic_merge(POD, {"metadata": {"labels": None}})
+        assert "labels" not in out["metadata"]
+
+    def test_finalizers_union(self):
+        out = strategic_merge(POD, {"metadata": {
+            "finalizers": ["keep.io/two", "keep.io/one"]}})
+        assert out["metadata"]["finalizers"] == ["keep.io/one", "keep.io/two"]
+
+    def test_delete_from_primitive_list(self):
+        out = strategic_merge(POD, {"metadata": {
+            "$deleteFromPrimitiveList/finalizers": ["keep.io/one"]}})
+        assert out["metadata"]["finalizers"] == []
+
+    def test_set_element_order(self):
+        patch = {"spec": {
+            "$setElementOrder/containers": [
+                {"name": "sidecar"}, {"name": "tensorflow"}],
+            "containers": [{"name": "tensorflow", "image": "tf:2"}]}}
+        out = strategic_merge(POD, patch)
+        assert [c["name"] for c in out["spec"]["containers"]] == \
+            ["sidecar", "tensorflow"]
+
+    def test_service_ports_use_port_key(self):
+        svc = {"spec": {"ports": [
+            {"name": "web", "port": 80}, {"name": "dbg", "port": 9090}]}}
+        out = strategic_merge(svc, {"spec": {"ports": [
+            {"name": "web2", "port": 80}]}})
+        assert {(p["name"], p["port"]) for p in out["spec"]["ports"]} == \
+            {("web2", 80), ("dbg", 9090)}
+
+    def test_tolerations_are_atomic(self):
+        # no patchMergeKey tag in k8s.io/api: the list REPLACES — merging
+        # here would diverge from a real apiserver in the other direction
+        out = strategic_merge(POD, {"spec": {"tolerations": [
+            {"key": "tpu2", "operator": "Exists"}]}})
+        assert out["spec"]["tolerations"] == [
+            {"key": "tpu2", "operator": "Exists"}]
+
+    def test_missing_merge_key_is_rejected(self):
+        # a real apiserver errors ("does not contain declared merge key");
+        # silently replacing would let a buggy controller patch pass the
+        # fixture and fail the real cluster
+        with pytest.raises(StrategicMergeError, match="merge key"):
+            strategic_merge(POD, {"spec": {"containers": [
+                {"image": "tf:2"}]}})
+
+    def test_map_level_patch_delete(self):
+        out = strategic_merge(POD, {"metadata": {"labels": {
+            "$patch": "delete"}}})
+        assert "labels" not in out["metadata"]
+        # deleting an ABSENT key is a no-op, not an error or stored junk
+        out = strategic_merge(POD, {"spec": {"affinity": {
+            "$patch": "delete"}}})
+        assert "affinity" not in out["spec"]
+
+    def test_unknown_directive_raises(self):
+        with pytest.raises(StrategicMergeError, match="directive"):
+            strategic_merge(POD, {"spec": {"containers": [
+                {"$patch": "merge", "name": "tensorflow"}]}})
+
+    def test_set_element_order_alone_reorders(self):
+        out = strategic_merge(POD, {"spec": {
+            "$setElementOrder/containers": [
+                {"name": "sidecar"}, {"name": "tensorflow"}]}})
+        assert [c["name"] for c in out["spec"]["containers"]] == \
+            ["sidecar", "tensorflow"]
+
+
+def _seed(cluster):
+    import copy
+
+    cluster.create(gvr.PODS, "ns", copy.deepcopy(POD))
+
+
+class TestFakeClusterStrategic:
+    def test_strategic_vs_merge_divergence_on_store(self):
+        patch = {"spec": {"containers": [
+            {"name": "tensorflow", "image": "tf:2"}]}}
+        a = FakeCluster()
+        _seed(a)
+        merged = a.patch_merge(gvr.PODS, "ns", "p", patch)
+        b = FakeCluster()
+        _seed(b)
+        strat = b.patch_strategic(gvr.PODS, "ns", "p", patch)
+        assert len(merged["spec"]["containers"]) == 1  # JSON merge replaced
+        assert len(strat["spec"]["containers"]) == 2   # strategic merged
+        assert strat["spec"]["containers"][0]["env"]
+
+    def test_crd_strategic_patch_is_415(self):
+        cluster = FakeCluster()
+        job = {"apiVersion": "kubeflow.org/v1alpha2", "kind": "TFJob",
+               "metadata": {"name": "j", "namespace": "ns"}, "spec": {}}
+        cluster.create(gvr.TFJOBS_V1ALPHA2, "ns", job)
+        with pytest.raises(errors.ApiError) as ei:
+            cluster.patch_strategic(gvr.TFJOBS_V1ALPHA2, "ns", "j",
+                                    {"spec": {"x": 1}})
+        assert ei.value.code == 415
+
+    def test_malformed_directive_is_400(self):
+        cluster = FakeCluster()
+        _seed(cluster)
+        with pytest.raises(errors.ApiError) as ei:
+            cluster.patch_strategic(gvr.PODS, "ns", "p", {
+                "spec": {"containers": [{"$patch": "bogus", "name": "x"}]}})
+        assert ei.value.code in (400, 422)
+
+    def test_watch_history_not_corrupted_by_strategic_patch(self):
+        # copy-free store: the patched object must not mutate frames
+        # already delivered to a watch
+        cluster = FakeCluster(copy_on_io=False)
+        w = cluster.watch(gvr.PODS, "ns")
+        _seed(cluster)  # ADDED arrives after subscription
+        added = w.next(timeout=1)
+        assert added and added[0] == "ADDED"
+        before = [c["image"] for c in added[1]["spec"]["containers"]]
+        cluster.patch_strategic(gvr.PODS, "ns", "p", {"spec": {"containers": [
+            {"name": "tensorflow", "image": "tf:9"}]}})
+        after = [c["image"] for c in added[1]["spec"]["containers"]]
+        assert before == after == ["tf:1", "sc:1"]
+        w.stop()
+
+
+class TestWireConformance:
+    """Both content types over real HTTP against the apiserver fixture."""
+
+    @pytest.fixture()
+    def server(self):
+        from k8s_tpu.e2e.apiserver import ApiServer
+
+        with ApiServer() as srv:
+            _seed(srv.cluster)
+            yield srv
+
+    def _rest(self, server):
+        from k8s_tpu.client.rest import ClusterConfig, RestClient
+
+        return RestClient(ClusterConfig(host=server.url))
+
+    def test_content_type_selects_semantics(self, server):
+        rc = self._rest(server)
+        patch = {"spec": {"containers": [
+            {"name": "tensorflow", "image": "tf:3"}]}}
+        strat = rc.patch_strategic(gvr.PODS, "ns", "p", patch)
+        assert len(strat["spec"]["containers"]) == 2
+        merged = rc.patch_merge(gvr.PODS, "ns", "p", patch)
+        assert len(merged["spec"]["containers"]) == 1
+
+    def test_adoption_release_round_trip_over_wire(self, server):
+        rc = self._rest(server)
+        ref = {"kind": "TFJob", "name": "new", "uid": "u-new",
+               "controller": True}
+        out = rc.patch_strategic(gvr.PODS, "ns", "p",
+                                 {"metadata": {"ownerReferences": [ref]}})
+        assert {r["uid"] for r in out["metadata"]["ownerReferences"]} == \
+            {"u-a", "u-b", "u-new"}
+        out = rc.patch_strategic(
+            gvr.PODS, "ns", "p",
+            {"metadata": {"ownerReferences": [
+                {"$patch": "delete", "uid": "u-new"}]}})
+        assert {r["uid"] for r in out["metadata"]["ownerReferences"]} == \
+            {"u-a", "u-b"}
+
+    def test_crd_strategic_415_over_wire(self, server):
+        rc = self._rest(server)
+        job = {"apiVersion": "kubeflow.org/v1alpha2", "kind": "TFJob",
+               "metadata": {"name": "j", "namespace": "ns"}, "spec": {}}
+        rc.create(gvr.TFJOBS_V1ALPHA2, "ns", job)
+        with pytest.raises(errors.ApiError) as ei:
+            rc.patch_strategic(gvr.TFJOBS_V1ALPHA2, "ns", "j",
+                               {"spec": {"x": 1}})
+        assert ei.value.code == 415
+
+    @pytest.mark.parametrize("ctype", [
+        "application/json-patch+json",  # JSON Patch: not implemented
+        "application/json",             # not a registered patch type
+        "",                             # missing header
+    ])
+    def test_unregistered_patch_content_type_is_415(self, server, ctype):
+        import json as json_mod
+        import urllib.request
+
+        headers = {"Content-Type": ctype} if ctype else {}
+        req = urllib.request.Request(
+            server.url + "/api/v1/namespaces/ns/pods/p",
+            data=json_mod.dumps({"metadata": {"labels": {"a": "b"}}}).encode(),
+            headers=headers, method="PATCH")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 415
+
+    @pytest.mark.parametrize("ctype", ["application/merge-patch+json",
+                                       "application/strategic-merge-patch+json"])
+    def test_metadata_null_is_422_not_connection_death(self, server, ctype):
+        import json as json_mod
+        import urllib.request
+
+        req = urllib.request.Request(
+            server.url + "/api/v1/namespaces/ns/pods/p",
+            data=json_mod.dumps({"metadata": None}).encode(),
+            headers={"Content-Type": ctype}, method="PATCH")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 422
+        body = json_mod.loads(ei.value.read())
+        assert body["kind"] == "Status"  # a Status object, not a dead socket
