@@ -46,15 +46,27 @@ class Collector:
 
     def start(self) -> None:
         mon = sys.monitoring
-        mon.use_tool_id(TOOL_ID, "k8s-tpu-coverage")
-        mon.register_callback(TOOL_ID, mon.events.LINE, self._on_line)
-        mon.set_events(TOOL_ID, mon.events.LINE)
+        # prefer the canonical slot, but fall back to any free one: under
+        # the full-ladder tier the subprocess shim (sitecustomize.py) may
+        # already hold a slot in this interpreter
+        self._tool_id = None
+        for tool_id in (TOOL_ID, 1, 2, 4, 5):
+            try:
+                mon.use_tool_id(tool_id, "k8s-tpu-coverage")
+            except ValueError:
+                continue
+            self._tool_id = tool_id
+            break
+        if self._tool_id is None:
+            raise RuntimeError("no free sys.monitoring tool slot")
+        mon.register_callback(self._tool_id, mon.events.LINE, self._on_line)
+        mon.set_events(self._tool_id, mon.events.LINE)
 
     def stop(self) -> None:
         mon = sys.monitoring
-        mon.set_events(TOOL_ID, 0)
-        mon.register_callback(TOOL_ID, mon.events.LINE, None)
-        mon.free_tool_id(TOOL_ID)
+        mon.set_events(self._tool_id, 0)
+        mon.register_callback(self._tool_id, mon.events.LINE, None)
+        mon.free_tool_id(self._tool_id)
 
 
 def executable_lines(path: str) -> set[int]:
@@ -89,6 +101,24 @@ def iter_sources(root: str):
                 yield os.path.join(dirpath, name)
 
 
+def merge_subprocess_hits(collector: Collector, cov_dir: str) -> int:
+    """Union child dumps (written by the repo-root sitecustomize shim) into
+    the collector; returns how many child processes contributed."""
+    import glob
+
+    n = 0
+    for path in glob.glob(os.path.join(cov_dir, "*.json")):
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+        except (OSError, ValueError):
+            continue  # a child died mid-write: lose that child, not the run
+        n += 1
+        for fn, lines in dump.items():
+            collector.hits.setdefault(fn, set()).update(lines)
+    return n
+
+
 def report(collector: Collector, root: str,
            exclude: tuple[str, ...] = ()) -> dict:
     """``exclude``: package-relative directory prefixes dropped from BOTH
@@ -115,11 +145,24 @@ def report(collector: Collector, root: str,
             "hit": len(hit),
             "pct": round(100.0 * len(hit) / len(execs), 1),
         }
+    # per-package rollup (first path segment under the measured root):
+    # regressions in the tier log are attributable to a subsystem, not
+    # just a global percentage (goveralls listed every package)
+    packages: dict[str, dict] = {}
+    for rel, stats in files.items():
+        parts = rel.split(os.sep)
+        pkg = parts[1] if len(parts) > 2 else "."
+        agg = packages.setdefault(pkg, {"executable": 0, "hit": 0})
+        agg["executable"] += stats["executable"]
+        agg["hit"] += stats["hit"]
+    for agg in packages.values():
+        agg["pct"] = round(100.0 * agg["hit"] / max(agg["executable"], 1), 1)
     return {
         "pct": round(100.0 * total_hit / max(total_exec, 1), 2),
         "lines_executable": total_exec,
         "lines_hit": total_hit,
         "files": files,
+        "packages": packages,
     }
 
 
@@ -142,6 +185,9 @@ def main(argv=None) -> int:
                       help="allowed regression in percentage points")
     runp.add_argument("--update-baseline", action="store_true",
                       help="rewrite the baseline file with this run's pct")
+    runp.add_argument("--no-subprocess", action="store_true",
+                      help="skip the sitecustomize subprocess collector "
+                      "(in-process lines only)")
     runp.add_argument("argv", nargs=argparse.REMAINDER,
                       help="-- -m pytest ... (a python command line)")
     args = p.parse_args(argv)
@@ -155,11 +201,40 @@ def main(argv=None) -> int:
     repo = os.getcwd()
     package_root = os.path.join(repo, args.package)
     collector = Collector(package_root)
+
+    # Subprocess collection: the repo-root sitecustomize shim starts a
+    # child collector in every python subprocess that sees these env vars
+    # (operator binaries, gang workers, kubelet pods) and dumps hits for
+    # the merge below.  Repo root is prepended to PYTHONPATH so even
+    # children spawned with a bare inherited environment import the shim.
+    import tempfile
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("K8S_TPU_COV_DIR", "K8S_TPU_COV_ROOT", "PYTHONPATH")}
+    cov_dir = None
+    if not args.no_subprocess:
+        cov_dir = tempfile.mkdtemp(prefix="k8s-tpu-cov-")
+        os.environ["K8S_TPU_COV_DIR"] = cov_dir
+        os.environ["K8S_TPU_COV_ROOT"] = package_root
+        os.environ["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo, saved_env["PYTHONPATH"]) if p)
     collector.start()
     try:
         rc = _run_python_argv(cmd)
     finally:
         collector.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    children = 0
+    if cov_dir:
+        children = merge_subprocess_hits(collector, cov_dir)
+        import shutil
+
+        shutil.rmtree(cov_dir, ignore_errors=True)
 
     exclude = tuple(e.strip() for e in args.exclude.split(",") if e.strip())
     rep = report(collector, package_root, exclude=exclude)
@@ -167,7 +242,12 @@ def main(argv=None) -> int:
              else args.package)
     print(f"coverage: {rep['pct']}% "
           f"({rep['lines_hit']}/{rep['lines_executable']} lines of "
-          f"{scope})")
+          f"{scope}; {children} subprocess(es) merged)")
+    width = max((len(p) for p in rep["packages"]), default=1)
+    for pkg in sorted(rep["packages"]):
+        agg = rep["packages"][pkg]
+        print(f"coverage:   {pkg:<{width}} {agg['pct']:>5.1f}% "
+              f"({agg['hit']}/{agg['executable']})")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(rep, f, indent=1, sort_keys=True)

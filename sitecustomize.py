@@ -1,0 +1,88 @@
+"""Repo-root sitecustomize: subprocess coverage shim + chain-loader.
+
+Why this file exists: the CI ``coverage`` tier measures the FULL ladder
+(VERDICT r4 #2), and much of the control plane runs in *subprocesses* — the
+operator binary in the rest/drill tiers, gang workers in the multiprocess
+tier, kubelet-executed pods.  An in-process ``sys.monitoring`` collector
+can't see them.  Python imports ``sitecustomize`` from ``sys.path`` at
+interpreter startup, and every child-spawn path in this repo puts the repo
+root on ``PYTHONPATH`` (tests/e2e) or inherits the coverage runner's
+environment — so this file IS the subprocess hook.
+
+Behavior is gated and chained so it is a no-op outside the coverage tier:
+
+- FIRST chain-load the environment's real ``sitecustomize`` (this image
+  boots its TPU plugin there; breaking that would break every JAX
+  subprocess), found as the next ``sitecustomize`` on ``sys.path``;
+- then, ONLY when ``K8S_TPU_COV_DIR``/``K8S_TPU_COV_ROOT`` are set by
+  ``k8s_tpu.harness.coverage run``, start a first-hit line collector
+  (PEP 669) and dump hits to a unique JSON in the dir at exit, where the
+  parent merges them.
+
+Everything is wrapped so no failure here can break a child process.
+"""
+
+import os
+import sys
+
+
+def _chain_real_sitecustomize() -> None:
+    try:
+        import importlib.machinery
+        import importlib.util
+
+        me = os.path.dirname(os.path.abspath(__file__))
+        paths = [p for p in sys.path if p and os.path.abspath(p) != me]
+        spec = importlib.machinery.PathFinder.find_spec("sitecustomize", paths)
+        if spec is not None and spec.origin and \
+                os.path.abspath(spec.origin) != os.path.abspath(__file__):
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+    except Exception:
+        pass  # a missing/broken real sitecustomize must not kill children
+
+
+def _start_subprocess_collector() -> None:
+    cov_dir = os.environ.get("K8S_TPU_COV_DIR")
+    root = os.environ.get("K8S_TPU_COV_ROOT")
+    if not cov_dir or not root:
+        return
+    try:
+        import atexit
+        import json
+        import uuid
+
+        # NOT the harness's slot (3): a child may itself run
+        # `k8s_tpu.harness.coverage run` (the harness's own tests do), and
+        # its in-process collector must still find its slot free
+        tool_id = 4
+        rootp = os.path.abspath(root) + os.sep
+        hits: dict = {}
+        mon = sys.monitoring
+
+        def on_line(code, lineno):
+            fn = code.co_filename
+            if fn.startswith(rootp):
+                hits.setdefault(fn, set()).add(lineno)
+            return mon.DISABLE
+
+        mon.use_tool_id(tool_id, "k8s-tpu-coverage-sub")
+        mon.register_callback(tool_id, mon.events.LINE, on_line)
+        mon.set_events(tool_id, mon.events.LINE)
+
+        def dump():
+            try:
+                path = os.path.join(
+                    cov_dir, f"{os.getpid()}-{uuid.uuid4().hex[:8]}.json")
+                with open(path, "w") as f:
+                    json.dump({k: sorted(v) for k, v in hits.items()}, f)
+            except Exception:
+                pass  # best-effort: a dead dump loses one child's lines
+
+        atexit.register(dump)
+    except Exception:
+        pass
+
+
+_chain_real_sitecustomize()
+_start_subprocess_collector()
