@@ -62,6 +62,27 @@ def peak_flops_for(device_kind: str) -> float | None:
     return None
 
 
+# Peak HBM bandwidth per chip, GB/s (public cloud.google.com/tpu specs).
+# Decode is HBM-bound — every generated token re-reads the params and the
+# KV cache — so the honest utilization denominator is bandwidth, not FLOPs.
+PEAK_HBM_GBPS = [
+    ("v6", 1640.0),
+    ("v5p", 2765.0),
+    ("v5", 819.0),        # v5e
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+]
+
+
+def peak_hbm_gbps_for(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, bw in PEAK_HBM_GBPS:
+        if key in kind:
+            return bw
+    return None
+
+
 _TRANSIENT = (
     "unavailable", "connection refused", "remote_compile", "deadline_exceeded",
     "socket closed", "connection reset", "failed to connect", "broken pipe",
@@ -188,6 +209,10 @@ _REQUIRED_KEYS = {
     "vit": ("images_per_sec_per_chip", "images_per_sec_per_chip_std",
             "repeats", "step_time_ms", "flops_per_step",
             "flops_per_sec_per_chip"),
+    "decode_depth": ("prefill_oneshot_prompt_tokens_per_sec_per_chip",
+                     "prefill_chunked_prompt_tokens_per_sec_per_chip",
+                     "chunked_prefill_vs_oneshot", "beam4_overhead",
+                     "repeats"),
 }
 
 
@@ -776,7 +801,34 @@ def bench_decode(batch_per_chip: int = 32, prompt_len: int = 128,
     # accounting; prefill FLOPs are excluded from MFU but included in the
     # measured wall time, which understates utilization slightly
     flops_per_token = 2.0 * n_params
+
+    # HBM roofline (the ResNet-style bound analysis, VERDICT r4 weak #5):
+    # each decode STEP re-reads the full params once per chip plus each
+    # row's KV cache up to its current length; per generated token that is
+    # params/batch + 2*layers*kv_heads*head_dim*avg_len*itemsize.  Decode
+    # is expected to sit near this bound, far from the FLOP peak.
+    import numpy as np
+
+    param_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(params))
+    head_dim = cfg.head_dim or cfg.hidden // cfg.heads
+    kv_itemsize = np.dtype(cfg.dtype).itemsize  # cache dtype = compute dtype
+    avg_len = prompt_len + new_tokens / 2.0
+    kv_bytes_per_token = (2 * cfg.layers * cfg.kv_heads * head_dim
+                          * avg_len * kv_itemsize)
+    bytes_per_token = param_bytes / batch_per_chip + kv_bytes_per_token
+    hbm = peak_hbm_gbps_for(jax.devices()[0].device_kind)
+    analytics = {
+        "hbm_bytes_per_token": int(bytes_per_token),
+        "kv_cache_bytes_per_token": int(kv_bytes_per_token),
+        "param_bytes": int(param_bytes),
+    }
+    if hbm:
+        bound = hbm * 1e9 / bytes_per_token
+        analytics["hbm_bound_tokens_per_sec_per_chip"] = round(bound, 1)
+        analytics["hbm_utilization"] = round(_median(rates) / bound, 4)
     return {
+        **analytics,
         "tokens_per_sec_per_chip": _median(rates),
         "tokens_per_sec_per_chip_std": _stdev(rates),
         "repeats": len(times),
@@ -792,10 +844,115 @@ def bench_decode(batch_per_chip: int = 32, prompt_len: int = 128,
     }
 
 
+def bench_decode_depth(batch_per_chip: int = 32, prompt_len: int = 1024,
+                       chunk: int = 256, beam_prompt: int = 128,
+                       beam_new: int = 32, sweep_batch: int = 128,
+                       calls: int = 3):
+    """Serving-depth A/Bs (VERDICT r4 weak #5): the numbers that give the
+    inference surface a perf identity beyond headline tokens/s.
+
+    - one-shot vs CHUNKED prefill throughput (prompt tokens/s consuming a
+      ``prompt_len`` prompt; chunked streams ``chunk``-token chunks through
+      the cache — O(chunk x cache) activation memory instead of
+      O(prompt^2/blocks));
+    - beam-4 overhead: per-token cost of make_beam_generate_fn(beam=4)
+      relative to greedy at the same shapes;
+    - a ``sweep_batch`` decode point: decode is KV/param-read bound, so
+      tokens/s/chip should scale sublinearly from the headline batch — the
+      measured pair anchors the roofline analysis in bench_decode.
+    """
+    import jax
+
+    from k8s_tpu.models.decode import make_beam_generate_fn, make_generate_fn
+    from k8s_tpu.models.transformer import Transformer
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    repeats = _repeats_default()
+
+    def timed_call(fn, *args):
+        def one():
+            return jax.block_until_ready(fn(*args))
+
+        with_retries(one, what="decode_depth compile")
+        one()  # steady-state warmup
+        times = []
+        for _ in range(max(1, repeats)):
+            start = time.perf_counter()
+            for _ in range(calls):
+                one()
+            times.append((time.perf_counter() - start) / calls)
+        return times
+
+    out = {"repeats": repeats, "batch_per_chip": batch_per_chip,
+           "prompt_len": prompt_len, "chunk": chunk}
+
+    # -- prefill A/B: one-shot vs chunked ---------------------------------
+    new_tail = 8  # a token of decode tail so both paths run the full api
+    cfg = _gpt2_small_config(max_seq_len=prompt_len + new_tail,
+                             use_flash_attention=on_tpu,
+                             prefill_chunk=chunk)
+    model = Transformer(cfg)
+    batch = batch_per_chip * n_chips
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, prompt_len), 0, cfg.vocab_size)
+    params = with_retries(
+        lambda: model.init(jax.random.PRNGKey(1), prompt[:1]),
+        what="decode_depth init")["params"]
+    rng = jax.random.PRNGKey(2)
+    for label, chunked in (("prefill_oneshot", False), ("prefill_chunked", True)):
+        gen = make_generate_fn(cfg, new_tail, chunked_prefill=chunked)
+        times = timed_call(gen, params, prompt, rng)
+        rates = [batch * prompt_len / t / n_chips for t in times]
+        out[f"{label}_prompt_tokens_per_sec_per_chip"] = round(_median(rates), 1)
+        out[f"{label}_std"] = round(_stdev(rates), 1)
+    out["chunked_prefill_vs_oneshot"] = round(
+        out["prefill_chunked_prompt_tokens_per_sec_per_chip"]
+        / out["prefill_oneshot_prompt_tokens_per_sec_per_chip"], 4)
+
+    # -- beam-4 overhead ---------------------------------------------------
+    bcfg = _gpt2_small_config(max_seq_len=beam_prompt + beam_new,
+                              use_flash_attention=on_tpu)
+    bprompt = jax.random.randint(
+        jax.random.PRNGKey(3), (batch, beam_prompt), 0, bcfg.vocab_size)
+    bparams = with_retries(
+        lambda: Transformer(bcfg).init(jax.random.PRNGKey(1), bprompt[:1]),
+        what="decode_depth beam init")["params"]
+    greedy = make_generate_fn(bcfg, beam_new)
+    gtimes = timed_call(greedy, bparams, bprompt, rng)
+    beam = make_beam_generate_fn(bcfg, beam_new, beam_size=4)
+    btimes = timed_call(beam, bparams, bprompt)
+    out["greedy_per_token_ms"] = round(
+        _median(gtimes) / beam_new / batch * 1000, 4)
+    out["beam4_per_token_ms"] = round(
+        _median(btimes) / beam_new / batch * 1000, 4)
+    out["beam4_overhead"] = round(_median(btimes) / _median(gtimes), 3)
+    out["beam_prompt"], out["beam_new"] = beam_prompt, beam_new
+
+    # -- batch sweep point -------------------------------------------------
+    scfg = _gpt2_small_config(max_seq_len=128 + 128,
+                              use_flash_attention=on_tpu)
+    sbatch = sweep_batch * n_chips
+    sprompt = jax.random.randint(
+        jax.random.PRNGKey(4), (sbatch, 128), 0, scfg.vocab_size)
+    sparams = with_retries(
+        lambda: Transformer(scfg).init(jax.random.PRNGKey(1), sprompt[:1]),
+        what="decode_depth sweep init")["params"]
+    sgen = make_generate_fn(scfg, 128)
+    stimes = timed_call(sgen, sparams, sprompt, rng)
+    srates = [sbatch * 128 / t / n_chips for t in stimes]
+    out[f"decode_b{sweep_batch}_tokens_per_sec_per_chip"] = round(
+        _median(srates), 1)
+    out[f"decode_b{sweep_batch}_std"] = round(_stdev(srates), 1)
+    out["sweep_batch"] = sweep_batch
+    return out
+
+
 def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
                  allow_stale: bool, device_kind: str | None,
                  n_chips: int | None, want_decode: bool = False,
-                 want_vit: bool = False) -> dict:
+                 want_vit: bool = False,
+                 want_decode_depth: bool = False) -> dict:
     """Assemble the single JSON line from fresh + (optionally) last-good
     results, with per-result provenance so stale evidence is never silently
     presented as this round's measurement."""
@@ -807,8 +964,12 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
         except (OSError, ValueError):
             baseline = {}
 
-    resnet = transformer = control = decode = vit = None
+    resnet = transformer = control = decode = vit = depth = None
     stale_names = []
+    if want_decode_depth:
+        depth, stale = recorder.get("decode_depth", allow_stale)
+        if stale:
+            stale_names.append("decode_depth")
     if want_vit:
         vit, stale = recorder.get("vit", allow_stale)
         if stale:
@@ -943,6 +1104,10 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
         if dc_peak:
             out["decode_mfu"] = round(
                 decode["flops_per_sec_per_chip"] / dc_peak, 4)
+        for k in ("hbm_bound_tokens_per_sec_per_chip", "hbm_utilization",
+                  "hbm_bytes_per_token"):
+            if k in decode:
+                out[f"decode_{k}"] = decode[k]
         if resnet is None and transformer is None:  # decode-only run
             out["metric"] = "decode_tokens_per_sec_per_chip"
             out["value"] = out["decode_tokens_per_sec_per_chip"]
@@ -950,6 +1115,24 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
             base = baseline.get("decode_tokens_per_sec_per_chip")
             out["vs_baseline"] = (round(out["value"] / base, 4)
                                   if base else 1.0)
+    if depth:
+        for k in ("prefill_oneshot_prompt_tokens_per_sec_per_chip",
+                  "prefill_chunked_prompt_tokens_per_sec_per_chip",
+                  "chunked_prefill_vs_oneshot", "beam4_overhead",
+                  "greedy_per_token_ms", "beam4_per_token_ms"):
+            if k in depth:
+                out[f"decode_depth_{k}"] = depth[k]
+        sweep = depth.get("sweep_batch")
+        if sweep:
+            key = f"decode_b{sweep}_tokens_per_sec_per_chip"
+            if key in depth:
+                out[f"decode_depth_{key}"] = depth[key]
+        if (resnet is None and transformer is None and decode is None
+                and vit is None):
+            out["metric"] = "chunked_prefill_vs_oneshot"
+            out["value"] = depth["chunked_prefill_vs_oneshot"]
+            out["unit"] = "ratio"
+            out["vs_baseline"] = 1.0
     if peak:
         out["peak_flops_per_chip"] = peak
     if stale_names:
@@ -973,10 +1156,12 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
 
     only = os.environ.get("BENCH_ONLY", "").lower()
-    if only not in ("", "resnet", "transformer", "decode", "vit"):
+    if only not in ("", "resnet", "transformer", "decode", "vit",
+                    "decode_depth"):
         print(
             f"bench: FATAL: unknown BENCH_ONLY={only!r} "
-            "(expected 'resnet', 'transformer', 'decode' or 'vit')",
+            "(expected 'resnet', 'transformer', 'decode', 'vit' or "
+            "'decode_depth')",
             file=sys.stderr,
         )
         return 2
@@ -987,6 +1172,7 @@ def main() -> int:
     # its exposure to relay outages
     want_decode = only == "decode"
     want_vit = only == "vit"
+    want_decode_depth = only == "decode_depth"
 
     recorder = Recorder()
     # Variant runs (sweeps, A/B drivers) set BENCH_NO_PERSIST: their configs
@@ -1012,7 +1198,8 @@ def main() -> int:
         allow_stale = allow_stale and stale_ok
         out = build_output(recorder, want_resnet, want_transformer,
                            allow_stale, device_kind, n_chips,
-                           want_decode=want_decode, want_vit=want_vit)
+                           want_decode=want_decode, want_vit=want_vit,
+                           want_decode_depth=want_decode_depth)
         missing = []
         if want_resnet and "resnet50_step_time_ms" not in out:
             missing.append("resnet50")
@@ -1020,6 +1207,9 @@ def main() -> int:
             missing.append("decode")
         if want_vit and "vit_step_time_ms" not in out:
             missing.append("vit")
+        if want_decode_depth and \
+                "decode_depth_beam4_overhead" not in out:
+            missing.append("decode_depth")
         have_transformer = "transformer_step_time_ms" in out
         if want_transformer and not have_transformer:
             missing.append("transformer")
@@ -1036,7 +1226,8 @@ def main() -> int:
         requested = [n for n, wanted in (("resnet50", want_resnet),
                                          ("transformer", want_transformer),
                                          ("decode", want_decode),
-                                         ("vit", want_vit))
+                                         ("vit", want_vit),
+                                         ("decode_depth", want_decode_depth))
                      if wanted]
         if missing and all(n in missing for n in requested):
             return -1  # nothing at all to show (single-benchmark runs too)
@@ -1121,12 +1312,15 @@ def main() -> int:
     tf_kw = {}
     dc_kw = {}
     vt_kw = {}
+    dd_kw = {}
     if os.environ.get("BENCH_SMOKE"):
         rn_kw = dict(batch_per_chip=2, iters=2, warmup=1)
         tf_kw = dict(batch_per_chip=1, seq=128, iters=2, warmup=1)
         dc_kw = dict(batch_per_chip=2, prompt_len=16, new_tokens=16,
                      calls=2, warmup=1)
         vt_kw = dict(batch_per_chip=2, iters=2, warmup=1)
+        dd_kw = dict(batch_per_chip=2, prompt_len=64, chunk=16,
+                     beam_prompt=16, beam_new=8, sweep_batch=4, calls=1)
     if on_hardware and (os.environ.get("BENCH_SMOKE")
                         or os.environ.get("BENCH_SEQ")
                         or os.environ.get("BENCH_WINDOW")):
@@ -1139,6 +1333,9 @@ def main() -> int:
         if want_decode:
             recorder.record("decode", bench_decode(**dc_kw), on_hardware,
                             device_kind)
+        if want_decode_depth:
+            recorder.record("decode_depth", bench_decode_depth(**dd_kw),
+                            on_hardware, device_kind)
         if want_resnet:
             recorder.record("resnet50", bench_resnet50(**rn_kw), on_hardware,
                             device_kind)

@@ -130,9 +130,14 @@ def build_plan() -> list[dict]:
              persist=True),
         # (b) the fused-CE A/B partner — mostly-shared transformer program
         item("fused_ce_on", {"BENCH_FUSED_CE": "1"}, only="transformer"),
-        # (c) unmeasured perf identities: decode tokens/s + ViT images/s
+        # (c) unmeasured perf identities: decode tokens/s + ViT images/s,
+        # then the serving-depth A/Bs (prefill one-shot vs chunked, beam-4
+        # overhead, batch sweep point — 4 fresh compiles, so after the
+        # cheap identities)
         item("decode", {}, only="decode", persist=True),
         item("vit", {}, only="vit", persist=True),
+        item("decode_depth", {}, only="decode_depth", persist=True,
+             timeout=2100, phase_timeout=900),
         # (d) flash-tile candidates (same model shapes, new kernel tiles)
         *[item("flash_" + v["name"].removeprefix("flash-"), dict(v["env"]),
                only="transformer") for v in tiles],
