@@ -184,6 +184,11 @@ def strategic_merge(current: dict, patch: dict) -> dict:
             out[k] = strategic_merge(cur, v)
         elif isinstance(v, dict) and v.get(_PATCH) == "replace":
             out[k] = {kk: vv for kk, vv in v.items() if kk != _PATCH}
+        elif isinstance(v, dict):
+            # target absent/non-dict: merge into {} so NESTED directives
+            # are still applied and stripped — storing the patch subtree
+            # verbatim would persist literal "$patch" keys into the object
+            out[k] = strategic_merge({}, v)
         elif isinstance(v, list):
             out[k] = _merge_list(k, cur if isinstance(cur, list) else [],
                                  v, orders.pop(k, None))

@@ -315,3 +315,14 @@ class TestWireConformance:
         assert ei.value.code == 422
         body = json_mod.loads(ei.value.read())
         assert body["kind"] == "Status"  # a Status object, not a dead socket
+
+
+class TestNestedDirectivesOnAbsentTarget:
+    def test_directives_processed_when_target_absent(self):
+        # storing the patch subtree verbatim would persist literal $patch
+        # keys into the object (review finding, round 5)
+        out = strategic_merge({"spec": {}}, {"spec": {"securityContext": {
+            "seLinuxOptions": {"$patch": "delete"}}}})
+        assert out["spec"]["securityContext"] == {}
+        out = strategic_merge({}, {"metadata": {"labels": {"a": "b"}}})
+        assert out == {"metadata": {"labels": {"a": "b"}}}
