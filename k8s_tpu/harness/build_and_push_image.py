@@ -54,6 +54,119 @@ def docker_available() -> bool:
     return shutil.which("docker") is not None
 
 
+class DockerfileLintError(ValueError):
+    """The rendered Dockerfile would not build."""
+
+
+_DOCKERFILE_INSTRUCTIONS = frozenset({
+    "FROM", "RUN", "CMD", "LABEL", "EXPOSE", "ENV", "ADD", "COPY",
+    "ENTRYPOINT", "VOLUME", "USER", "WORKDIR", "ARG", "ONBUILD",
+    "STOPSIGNAL", "HEALTHCHECK", "SHELL",
+})
+
+
+def _dockerfile_instructions(text: str):
+    """(keyword, args) pairs with comments stripped and ``\\`` continuations
+    joined — the subset of Dockerfile syntax docker build itself parses."""
+    logical: list[str] = []
+    buf = ""
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.endswith("\\"):
+            buf += line[:-1] + " "
+            continue
+        logical.append(buf + line)
+        buf = ""
+    if buf:
+        logical.append(buf)
+    for line in logical:
+        word, _, rest = line.partition(" ")
+        yield word.upper(), rest.strip()
+
+
+def lint_dockerfile(dockerfile_path: str, context_dir: str) -> None:
+    """Dry build-check of a RENDERED Dockerfile (VERDICT r4 #7: no docker
+    binary exists in this image, so the template would otherwise rot
+    silently).  Validates what `docker build` would reject on sight:
+    unsubstituted ``{placeholders}``, unknown instructions, a non-FROM
+    first instruction, COPY/ADD sources missing from the context,
+    ``COPY --from`` naming an undefined stage, and exec-form
+    ENTRYPOINT/CMD that is not valid JSON."""
+    import json as json_mod
+    import re
+
+    with open(dockerfile_path) as f:
+        text = f.read()
+    # substitution placeholders are single-brace {word}; a leftover one
+    # means render_dockerfile was skipped or the mapping missed a key
+    # exclude ${VAR} (docker's own variable expansion) and {{ }} escapes
+    leftover = re.search(r"(?<![\{\$])\{([a-zA-Z_][a-zA-Z0-9_]*)\}(?!\})",
+                         "\n".join(ln for ln in text.splitlines()
+                                   if not ln.strip().startswith("#")))
+    if leftover:
+        raise DockerfileLintError(
+            f"unsubstituted template placeholder {{{leftover.group(1)}}}")
+
+    stages: list[str] = []
+    seen_from = False
+    for word, rest in _dockerfile_instructions(text):
+        if word not in _DOCKERFILE_INSTRUCTIONS:
+            raise DockerfileLintError(f"unknown instruction {word!r}")
+        if not seen_from and word not in ("FROM", "ARG"):
+            raise DockerfileLintError(
+                f"first instruction must be FROM (or ARG), got {word}")
+        if word == "FROM":
+            seen_from = True
+            m = re.search(r"\bAS\s+(\S+)", rest, re.IGNORECASE)
+            stages.append(m.group(1).lower() if m else str(len(stages)))
+            if not rest.split():
+                raise DockerfileLintError("FROM needs a base image")
+        elif word in ("COPY", "ADD"):
+            parts = rest.split()
+            flags = [p for p in parts if p.startswith("--")]
+            operands = [p for p in parts if not p.startswith("--")]
+            if len(operands) < 2:
+                raise DockerfileLintError(f"{word} needs src... dest: {rest}")
+            from_stage = next(
+                (f.split("=", 1)[1] for f in flags if f.startswith("--from=")),
+                None)
+            if from_stage is not None:
+                # stage-relative sources can't be checked without building
+                # the earlier stage, but the stage itself must exist
+                if from_stage.lower() not in stages[:-1] and \
+                        not from_stage.isdigit() and "/" not in from_stage \
+                        and ":" not in from_stage:
+                    raise DockerfileLintError(
+                        f"{word} --from={from_stage} names no earlier stage")
+                continue
+            for src in operands[:-1]:
+                if "*" in src or "?" in src or "[" in src:
+                    import glob as glob_mod
+
+                    if not glob_mod.glob(os.path.join(context_dir, src)):
+                        raise DockerfileLintError(
+                            f"{word} source glob {src!r} matches nothing "
+                            f"in context {context_dir}")
+                elif not os.path.exists(os.path.join(context_dir, src)):
+                    raise DockerfileLintError(
+                        f"{word} source {src!r} missing from context "
+                        f"{context_dir}")
+        elif word in ("ENTRYPOINT", "CMD") and rest.startswith("["):
+            try:
+                parsed = json_mod.loads(rest)
+                ok = isinstance(parsed, list) and all(
+                    isinstance(x, str) for x in parsed)
+            except ValueError:
+                ok = False
+            if not ok:
+                raise DockerfileLintError(
+                    f"{word} exec form is not a JSON string array: {rest}")
+    if not seen_from:
+        raise DockerfileLintError("Dockerfile has no FROM instruction")
+
+
 def build_and_push(
     dockerfile_template: str,
     context_dir: str,
@@ -67,7 +180,10 @@ def build_and_push(
     ref returned for manifest generation (dry run)."""
     tag = get_image_tag(repo_dir or os.path.dirname(dockerfile_template))
     ref = f"{image}:{tag}"
-    render_dockerfile(dockerfile_template, context_dir, substitutions)
+    rendered = render_dockerfile(dockerfile_template, context_dir, substitutions)
+    # always lint the rendered file: without a docker binary this is the
+    # only thing standing between the template and silent rot
+    lint_dockerfile(rendered, context_dir)
     if not docker_available():
         log.warning("docker not found; context prepared at %s, skipping build of %s", context_dir, ref)
         return ref
@@ -83,9 +199,20 @@ def main(argv=None) -> int:
     parser.add_argument("--context", required=True, help="build context directory")
     parser.add_argument("--image", required=True, help="image repo (no tag)")
     parser.add_argument("--push", action="store_true")
+    parser.add_argument("--substitute", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="template {key} substitution (repeatable); "
+                        "an unsubstituted placeholder fails the lint")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    ref = build_and_push(args.template, args.context, args.image, push=args.push)
+    subs = {}
+    for item in args.substitute:
+        key, sep, value = item.partition("=")
+        if not sep:
+            parser.error(f"--substitute needs KEY=VALUE, got {item!r}")
+        subs[key] = value
+    ref = build_and_push(args.template, args.context, args.image,
+                         substitutions=subs, push=args.push)
     print(ref)
     return 0
 
