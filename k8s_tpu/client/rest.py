@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import ssl
+import time
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass
@@ -23,6 +24,54 @@ from k8s_tpu.client.gvr import GVR
 from k8s_tpu.client.selectors import parse_label_selector
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# Wire profiling (K8S_TPU_WIRE_PROFILE=1): per-(method, resource) request
+# counts and cumulative seconds across every RestClient in the process.
+# This is how the round-4/5 wire-gap numbers were derived (BASELINE.md) —
+# committed so the profile can be reproduced, not re-invented, whenever the
+# rest-vs-fake ratio needs re-auditing.  Counters are plain dict updates
+# under a lock; zero cost when the env var is unset.
+WIRE_PROFILE_ENABLED = bool(os.environ.get("K8S_TPU_WIRE_PROFILE"))
+_wire_profile: dict = {}
+_wire_profile_lock = None
+if WIRE_PROFILE_ENABLED:
+    import threading as _threading
+
+    _wire_profile_lock = _threading.Lock()
+
+
+def _profile_key(method: str, path: str) -> str:
+    # /api/v1/namespaces/ns/pods/name?q -> "GET pods"; /apis/g/v/t -> t
+    path = path.split("?", 1)[0]
+    parts = [p for p in path.split("/") if p]
+    resource = "?"
+    if "namespaces" in parts:
+        i = parts.index("namespaces")
+        resource = parts[i + 2] if len(parts) > i + 2 else "namespaces"
+    elif parts[:1] == ["api"] and len(parts) >= 3:
+        resource = parts[2]
+    elif parts[:1] == ["apis"] and len(parts) >= 4:
+        resource = parts[3]
+    return f"{method} {resource}"
+
+
+def _profile_record(method: str, path: str, seconds: float) -> None:
+    key = _profile_key(method, path)
+    with _wire_profile_lock:
+        ent = _wire_profile.setdefault(key, [0, 0.0])
+        ent[0] += 1
+        ent[1] += seconds
+
+
+def wire_profile_snapshot() -> dict:
+    """{key: {"count": n, "seconds": s}} sorted by cumulative seconds."""
+    if not WIRE_PROFILE_ENABLED:
+        return {}
+    with _wire_profile_lock:
+        items = {k: {"count": v[0], "seconds": round(v[1], 4)}
+                 for k, v in _wire_profile.items()}
+    return dict(sorted(items.items(),
+                       key=lambda kv: -kv[1]["seconds"]))
 
 
 @dataclass
@@ -456,6 +505,7 @@ class RestClient:
 
         if self._scheme == "http":
             # lean raw-socket path (TLS stays on http.client below)
+            t0 = time.perf_counter() if WIRE_PROFILE_ENABLED else 0.0
             for attempt in attempts:
                 try:
                     status, reason, raw = self._lean_unary(
@@ -465,6 +515,8 @@ class RestClient:
                     self._drop_sock()
                     if attempt == attempts[-1]:
                         raise
+            if WIRE_PROFILE_ENABLED:
+                _profile_record(method, path, time.perf_counter() - t0)
             if status >= 400:
                 raise self._api_error_from(status, reason, raw)
             payload = raw.decode()
@@ -472,6 +524,7 @@ class RestClient:
 
         import http.client
 
+        t0 = time.perf_counter() if WIRE_PROFILE_ENABLED else 0.0
         for attempt in attempts:
             conn = self._pooled_conn()
             try:
@@ -485,6 +538,8 @@ class RestClient:
                 self._drop_conn()
                 if attempt == attempts[-1]:
                     raise
+        if WIRE_PROFILE_ENABLED:
+            _profile_record(method, path, time.perf_counter() - t0)
         if resp.status >= 400:
             raise self._api_error(resp, raw)
         payload = raw.decode()

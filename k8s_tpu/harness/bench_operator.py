@@ -134,6 +134,23 @@ def main(argv=None) -> int:
     print(json.dumps({"metric": "tfjob_time_to_ready_p50",
                       "value": result["time_to_ready_p50_s"],
                       "unit": "s", "backend": args.backend, **result}))
+
+    from k8s_tpu.client import rest
+
+    if rest.WIRE_PROFILE_ENABLED and args.backend == "rest":
+        # K8S_TPU_WIRE_PROFILE=1: the per-verb budget behind the
+        # rest-vs-fake ratio (BASELINE.md wire-floor arithmetic)
+        profile = rest.wire_profile_snapshot()
+        total_calls = sum(v["count"] for v in profile.values())
+        total_s = sum(v["seconds"] for v in profile.values())
+        print(json.dumps({
+            "metric": "wire_profile",
+            "requests_total": total_calls,
+            "requests_per_job": round(total_calls / args.jobs, 1),
+            "client_seconds_total": round(total_s, 3),
+            "mean_us_per_call": round(1e6 * total_s / max(total_calls, 1)),
+            "by_verb": profile,
+        }))
     return 0
 
 
