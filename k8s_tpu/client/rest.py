@@ -31,7 +31,7 @@ SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 # committed so the profile can be reproduced, not re-invented, whenever the
 # rest-vs-fake ratio needs re-auditing.  Counters are plain dict updates
 # under a lock; zero cost when the env var is unset.
-WIRE_PROFILE_ENABLED = bool(os.environ.get("K8S_TPU_WIRE_PROFILE"))
+WIRE_PROFILE_ENABLED = os.environ.get("K8S_TPU_WIRE_PROFILE") == "1"
 _wire_profile: dict = {}
 _wire_profile_lock = None
 if WIRE_PROFILE_ENABLED:

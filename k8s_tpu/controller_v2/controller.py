@@ -572,17 +572,12 @@ class TFJobController:
         if self._pdb_cache.get(cache_key) == total:
             return
         pdbs = self.clientset.pdbs(tfjob.metadata.namespace)
-        try:
-            existing = pdbs.get(name)
-            # Reconcile minAvailable against the current replica total so a
-            # scaled job is never evictable down to a partial gang.
-            if (existing.get("spec") or {}).get("minAvailable") != total:
-                pdbs.patch(name, {"spec": {"minAvailable": total}})
-            self._pdb_cache[cache_key] = total
-            return
-        except errors.ApiError as e:
-            if not errors.is_not_found(e):
-                raise
+        # Optimistic create-first: a cache miss is almost always a NEW job
+        # (one per job on the wire bench), so GET-before-create pays a
+        # guaranteed 404 round-trip on the hot path; the already-exists
+        # fallback below verifies minAvailable for the rare restart/race
+        # case, paying one extra (rejected) POST there relative to the old
+        # GET-first order.
         pdb = {
             "metadata": {
                 "name": name,
