@@ -29,6 +29,10 @@ def invalid(message: str = "") -> ApiError:
     return ApiError(422, "Invalid", message)
 
 
+def bad_request(message: str = "") -> ApiError:
+    return ApiError(400, "BadRequest", message)
+
+
 def unsupported_media_type(message: str = "") -> ApiError:
     return ApiError(415, "UnsupportedMediaType", message)
 

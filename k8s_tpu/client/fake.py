@@ -180,6 +180,7 @@ class FakeCluster:
             if not name:
                 raise errors.invalid("metadata.name is required")
             if resource.namespaced:
+                self._check_namespace_match(meta, namespace, resource)
                 meta.setdefault("namespace", namespace or "default")
             ns = meta.get("namespace", "") if resource.namespaced else ""
             bucket = self._bucket(resource)
@@ -241,6 +242,8 @@ class FakeCluster:
         with self._lock:
             meta = obj.get("metadata") or {}
             name = meta.get("name", "")
+            if resource.namespaced:
+                self._check_namespace_match(meta, namespace, resource)
             ns = (meta.get("namespace", namespace) or "") if resource.namespaced else ""
             bucket = self._bucket(resource)
             current = bucket.get((ns, name))
@@ -273,6 +276,7 @@ class FakeCluster:
             # get() already returned one — don't pay a second deepcopy on
             # the hottest verb of the reconcile/kubelet loops.
             current = self.get(resource, namespace, name)
+            self._check_patch_rv_precondition(patch, current, resource, name)
             if self._copy is not _copy_mod.deepcopy:
                 current = _copy_mod.deepcopy(current)
 
@@ -290,6 +294,36 @@ class FakeCluster:
             current["metadata"].pop("resourceVersion", None)  # patch never conflicts here
             self._record("patch", resource, namespace, name, patch)
             return self.update(resource, namespace, current)
+
+    @staticmethod
+    def _check_namespace_match(meta: dict, namespace: str,
+                               resource: GVR) -> None:
+        """Real apiservers 400 when the body names a DIFFERENT namespace
+        than the request targets (an unset body namespace defaults from
+        the request).  Enforced in the store so the in-process clientset
+        and the HTTP fixture agree — a divergence here would let an
+        in-process test pass code a real apiserver rejects."""
+        body_ns = meta.get("namespace") or ""
+        if body_ns and namespace and body_ns != namespace:
+            raise errors.bad_request(
+                f"the namespace of the object ({body_ns}) does not match "
+                f"the namespace on the request ({namespace}) for "
+                f"{resource.plural}")
+
+    @staticmethod
+    def _check_patch_rv_precondition(patch: dict, current: dict,
+                                     resource: GVR, name: str) -> None:
+        """A patch CARRYING metadata.resourceVersion makes it a precondition
+        (real apiserver semantics for merge + strategic patches): mismatch
+        is 409 Conflict.  Patches without an rv never conflict."""
+        meta = patch.get("metadata")
+        sent = meta.get("resourceVersion") if isinstance(meta, dict) else None
+        cur = (current.get("metadata") or {}).get("resourceVersion")
+        if sent is not None and str(sent) != str(cur):
+            raise errors.conflict(
+                f"operation cannot be fulfilled on {resource.plural} "
+                f"{name!r}: the object has been modified (patch rv {sent}, "
+                f"current {cur})")
 
     @staticmethod
     def _require_patch_metadata(merged: dict, resource: GVR, name: str) -> None:
@@ -318,6 +352,7 @@ class FakeCluster:
                 "application/merge-patch+json")
         with self._lock:
             current = self.get(resource, namespace, name)
+            self._check_patch_rv_precondition(patch, current, resource, name)
             try:
                 merged = strategic_merge_mod.strategic_merge(current, patch)
             except strategic_merge_mod.StrategicMergeError as e:

@@ -288,6 +288,9 @@ class _Handler(BaseHTTPRequestHandler):
         if route is None or route.name:
             return self._send_status_error(errors.invalid(f"bad create path {self.path}"))
         try:
+            # namespace-mismatch validation lives in the store
+            # (FakeCluster._check_namespace_match) so the in-process
+            # clientset and this wire surface agree
             obj = self.server.cluster.create(
                 route.resource, route.namespace or "", self._read_body()
             )
@@ -302,8 +305,17 @@ class _Handler(BaseHTTPRequestHandler):
         if route is None or not route.name:
             return self._send_status_error(errors.invalid(f"bad update path {self.path}"))
         try:
+            body = self._read_body()
+            body_name = ((body.get("metadata") or {}).get("name") or "")
+            if body_name and body_name != route.name:
+                # real apiserver conformance: update bodies must name the
+                # URL's object — silently honoring the body name would let
+                # a buggy client update the wrong object
+                return self._send_status_error(errors.bad_request(
+                    f"the name of the object ({body_name}) does not match "
+                    f"the name on the URL ({route.name})"))
             obj = self.server.cluster.update(
-                route.resource, route.namespace or "", self._read_body()
+                route.resource, route.namespace or "", body
             )
             return self._send_json(200, obj)
         except errors.ApiError as e:

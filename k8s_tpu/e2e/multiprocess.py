@@ -141,8 +141,14 @@ class GangResult:
 
 def run_gang(n_workers: int = 4, *, num_slices: int = 1,
              fail: Optional[str] = None, timeout: float = 420.0,
-             extra_env: Optional[dict] = None) -> GangResult:
-    """Spawn the gang and supervise it with whole-gang failure semantics."""
+             extra_env: Optional[dict] = None,
+             module: str = "k8s_tpu.e2e.rendezvous_worker") -> GangResult:
+    """Spawn the gang and supervise it with whole-gang failure semantics.
+
+    ``module``: the in-pod entrypoint each worker executes (``python -m``);
+    defaults to the rendezvous worker.  ``k8s_tpu.launcher.tpu_smoke`` runs
+    the operator's actual smoke workload through the same env contract.
+    """
     port = free_port()
     tfjob = build_gang_tfjob(n_workers, port, num_slices=num_slices)
 
@@ -172,7 +178,7 @@ def run_gang(n_workers: int = 4, *, num_slices: int = 1,
         logf = tempfile.TemporaryFile()
         logs.append(logf)
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", "k8s_tpu.e2e.rendezvous_worker"],
+            [sys.executable, "-m", module],
             env=env, cwd=REPO_ROOT,
             stdout=logf, stderr=subprocess.STDOUT,
         ))

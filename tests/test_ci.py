@@ -66,3 +66,17 @@ def test_null_sections_normalize():
     assert loaded["tiers"] == {} and loaded["workflows"] == []
     with pytest.raises(KeyError):
         ci.run_tier(loaded, "anything")
+
+
+def test_pytest_counts_extracted_for_ladder_log():
+    # skips must stay visible in the ladder line (hardware-gated tests
+    # otherwise silently shrink the round's authoritative total)
+    out = "....s.s\n2 failed, 120 passed, 2 skipped in 3.21s\n"
+    assert ci._pytest_counts(out) == "2 failed, 120 passed, 2 skipped"
+    assert ci._pytest_counts("no summary here") == ""
+    # non-pytest tiers (lint, coverage) produce no counts -> no suffix
+    assert ci._pytest_counts("coverage: 84.02% (9851/11725 lines)") == ""
+    # counts OUTSIDE the summary line must not match (a linter printing
+    # "found 2 errors" is not a pytest count)
+    assert ci._pytest_counts("found 2 errors\nall done") == ""
+    assert ci._pytest_counts("2 errors happened\n5 passed in 1.2s") == "5 passed"

@@ -51,6 +51,25 @@ class TestGangRendezvous:
         assert sizes == 4
 
 
+class TestSmokeWorkload:
+    def test_tpu_smoke_runs_as_a_real_gang(self):
+        """The operator's smoke workload (launcher/tpu_smoke — the
+        reference's tf_smoke.py analogue, tf_smoke.py:52-60) executes as
+        real OS processes under the operator env contract: jax.distributed
+        over the generated coordinator address, an FSDP-sharded matmul on
+        the bootstrap mesh, and a cross-process reduction whose checksum
+        both workers verify (exit 0 = the chief exit-code contract)."""
+        res = multiprocess.run_gang(
+            2, module="k8s_tpu.launcher.tpu_smoke", timeout=300)
+        if not res.success:
+            for i, out in enumerate(res.worker_outputs):
+                print(f"--- worker {i} rc={res.exit_codes[i]} ---\n"
+                      f"{out[-2000:]}")
+        assert res.success, res.exit_codes
+        assert any("smoke OK on 2 devices" in out
+                   for out in res.worker_outputs)
+
+
 class TestHybridMultiSlice:
     def test_two_slice_gang_builds_hybrid_mesh(self):
         """MEGASCALE env present → make_training_mesh builds the DCN×ICI

