@@ -761,9 +761,11 @@ def bench_decode(batch_per_chip: int = 32, prompt_len: int = 128,
     n_chips = len(jax.devices())
     batch = batch_per_chip * n_chips
     on_tpu = jax.default_backend() == "tpu"
+    kv_cache_dtype = os.environ.get("BENCH_KV_CACHE") or None
     cfg = _gpt2_small_config(
         max_seq_len=prompt_len + new_tokens,
         use_flash_attention=on_tpu,  # prefill path; decode steps are cached
+        kv_cache_dtype=kv_cache_dtype,
     )
     model = Transformer(cfg)
     prompt = jax.random.randint(
@@ -773,6 +775,16 @@ def bench_decode(batch_per_chip: int = 32, prompt_len: int = 128,
         what="decode init",
     )
     params = variables["params"]
+    # Serving runs inference-dtype params (decode re-reads ALL of them
+    # every token — at f32 they are the dominant HBM term); the roofline
+    # below accounts the CAST bytes, so the number stays honest.
+    param_dtype = os.environ.get("BENCH_DECODE_PARAM_DTYPE", "bfloat16")
+    if param_dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"BENCH_DECODE_PARAM_DTYPE={param_dtype!r}")
+    if param_dtype == "bfloat16":
+        from k8s_tpu.models.serving import cast_params_for_serving
+
+        params = cast_params_for_serving(params)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     gen = make_generate_fn(cfg, new_tokens)
     rng = jax.random.PRNGKey(2)
@@ -812,16 +824,22 @@ def bench_decode(batch_per_chip: int = 32, prompt_len: int = 128,
     param_bytes = sum(x.size * x.dtype.itemsize
                       for x in jax.tree_util.tree_leaves(params))
     head_dim = cfg.head_dim or cfg.hidden // cfg.heads
-    kv_itemsize = np.dtype(cfg.dtype).itemsize  # cache dtype = compute dtype
+    if cfg.kv_cache_dtype == "int8":
+        # int8 vector + one f32 absmax scale per (slot, head) per k/v
+        kv_vec_bytes = head_dim * 1 + 4
+    else:
+        kv_vec_bytes = head_dim * np.dtype(cfg.dtype).itemsize
     avg_len = prompt_len + new_tokens / 2.0
-    kv_bytes_per_token = (2 * cfg.layers * cfg.kv_heads * head_dim
-                          * avg_len * kv_itemsize)
+    kv_bytes_per_token = 2 * cfg.layers * cfg.kv_heads * kv_vec_bytes * avg_len
     bytes_per_token = param_bytes / batch_per_chip + kv_bytes_per_token
     hbm = peak_hbm_gbps_for(jax.devices()[0].device_kind)
     analytics = {
         "hbm_bytes_per_token": int(bytes_per_token),
         "kv_cache_bytes_per_token": int(kv_bytes_per_token),
         "param_bytes": int(param_bytes),
+        "param_dtype": param_dtype,
+        "kv_cache_dtype": cfg.kv_cache_dtype or str(
+            np.dtype(cfg.dtype).name),
     }
     if hbm:
         bound = hbm * 1e9 / bytes_per_token
@@ -1181,10 +1199,14 @@ def main() -> int:
     # variant (the sweep would then rank identical values and pick a bogus
     # winner).  For those runs an outage must be a hard failure.  Smoke runs
     # are non-default shapes for the same reason (they already don't persist).
+    non_default_param_dtype = os.environ.get(
+        "BENCH_DECODE_PARAM_DTYPE", "bfloat16") != "bfloat16"
     stale_ok = not (os.environ.get("BENCH_NO_PERSIST")
                     or os.environ.get("BENCH_SMOKE")
                     or os.environ.get("BENCH_SEQ")
-                    or os.environ.get("BENCH_WINDOW"))
+                    or os.environ.get("BENCH_WINDOW")
+                    or os.environ.get("BENCH_KV_CACHE")
+                    or non_default_param_dtype)
 
     def emit(allow_stale: bool, device_kind=None, n_chips=None) -> int:
         """Print the JSON line; return an exit code.
@@ -1323,7 +1345,9 @@ def main() -> int:
                      beam_prompt=16, beam_new=8, sweep_batch=4, calls=1)
     if on_hardware and (os.environ.get("BENCH_SMOKE")
                         or os.environ.get("BENCH_SEQ")
-                        or os.environ.get("BENCH_WINDOW")):
+                        or os.environ.get("BENCH_WINDOW")
+                        or os.environ.get("BENCH_KV_CACHE")
+                        or non_default_param_dtype):
         on_hardware = False  # non-default shapes must not overwrite evidence
 
     try:

@@ -40,6 +40,14 @@ def parse_args(argv=None):
     p.add_argument("--chunked_prefill", action="store_true",
                    help="stream the prompt through the cache in "
                    "config.prefill_chunk-token chunks")
+    p.add_argument("--kv_cache", choices=["model", "int8"], default="model",
+                   help="int8 stores the KV cache as per-vector-scaled "
+                   "int8 — half the per-token cache reads, ~quantization-"
+                   "noise output differences")
+    p.add_argument("--param_dtype", choices=["model", "bfloat16"],
+                   default="model",
+                   help="bfloat16 casts f32 params for serving (halves "
+                   "the dominant decode HBM term)")
     return p.parse_args(argv)
 
 
@@ -63,6 +71,13 @@ def main(argv=None) -> int:
     from k8s_tpu.models.dataset import decode_bytes, encode_bytes
 
     config, variables = serving.load_serving(args.train_dir)
+    if args.kv_cache == "int8":
+        import dataclasses
+
+        config = dataclasses.replace(config, kv_cache_dtype="int8")
+    if args.param_dtype == "bfloat16":
+        variables = {**variables, "params": serving.cast_params_for_serving(
+            variables["params"])}
     log.info("loaded %s: %d layers, hidden %d, vocab %d",
              args.train_dir, config.layers, config.hidden,
              config.vocab_size)

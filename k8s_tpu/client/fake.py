@@ -315,8 +315,20 @@ class FakeCluster:
                                      resource: GVR, name: str) -> None:
         """A patch CARRYING metadata.resourceVersion makes it a precondition
         (real apiserver semantics for merge + strategic patches): mismatch
-        is 409 Conflict.  Patches without an rv never conflict."""
+        is 409 Conflict.  Patches without an rv never conflict.  A patch
+        renaming or re-namespacing the object is rejected outright —
+        name/namespace are immutable, and honoring the body name would
+        route the write to a DIFFERENT bucket key."""
         meta = patch.get("metadata")
+        if isinstance(meta, dict):
+            for field in ("name", "namespace"):
+                sent_id = meta.get(field)
+                cur_id = (current.get("metadata") or {}).get(field)
+                if sent_id is not None and sent_id != cur_id:
+                    raise errors.invalid(
+                        f"metadata.{field} is immutable: patch on "
+                        f"{resource.plural} {name!r} may not change it "
+                        f"({cur_id!r} -> {sent_id!r})")
         sent = meta.get("resourceVersion") if isinstance(meta, dict) else None
         cur = (current.get("metadata") or {}).get("resourceVersion")
         if sent is not None and str(sent) != str(cur):

@@ -110,3 +110,16 @@ def load_serving(train_dir: str) -> tuple[TransformerConfig, Any]:
     restored = ckpt.restore(0, {"params": template})
     ckpt.close()
     return config, restored["params"]
+
+
+def cast_params_for_serving(params):
+    """f32 -> bf16 param cast for inference (decode re-reads every param
+    per token, so at f32 they are the dominant HBM term).  Non-f32 leaves
+    (already-bf16, integer tables) pass through untouched.  The single
+    definition keeps the benchmarked configuration (bench.py decode) and
+    the served one (serve_lm --param_dtype bfloat16) identical."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if x.dtype == jnp.float32 else x, params)

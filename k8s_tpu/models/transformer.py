@@ -68,6 +68,14 @@ class TransformerConfig:
     # chunk's earliest query still sees its full window before the
     # chunk's own writes evict it; irrelevant for full-length caches.
     prefill_chunk: int = 1
+    # KV-cache storage dtype for DECODE: None stores cfg.dtype; "int8"
+    # stores per-(slot, head)-scaled int8 (absmax/127 symmetric), halving
+    # the per-token KV HBM reads decode is bound by (see bench.py's
+    # roofline: bytes/token = params/batch + 2*layers*kv_heads*head_dim*
+    # len*itemsize).  Dequantization happens after the HBM load, fused
+    # into the attention einsum's operand feed by XLA.  Training/prefill
+    # attention math is untouched — only cache storage quantizes.
+    kv_cache_dtype: Optional[str] = None
     remat: bool = True  # jax.checkpoint each layer: HBM for FLOPs
     # MoE (k8s_tpu.models.moe): >0 swaps the dense MLP for routed experts
     # sharded over the ep mesh axis
@@ -203,11 +211,51 @@ class Attention(nn.Module):
         else:
             S = cfg.max_seq_len
         shape = (batch, S, cfg.kv_heads, cfg.dims_per_head)
-        ck = self.variable("cache", "k", jnp.zeros, shape, cfg.dtype)
-        cv = self.variable("cache", "v", jnp.zeros, shape, cfg.dtype)
+        if cfg.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None or 'int8', "
+                f"got {cfg.kv_cache_dtype!r}")
+        if cfg.kv_cache_dtype == "int8":
+            ck = self.variable("cache", "k", jnp.zeros, shape, jnp.int8)
+            cv = self.variable("cache", "v", jnp.zeros, shape, jnp.int8)
+            # per-(slot, head) absmax scales; float32 (4B per 64-128B
+            # vector — negligible traffic, no precision stacking).  Batch
+            # axis first so beam search's cache-pytree gather reorders
+            # scales with their vectors.
+            cks = self.variable("cache", "k_scale", jnp.zeros,
+                                shape[:3], jnp.float32)
+            cvs = self.variable("cache", "v_scale", jnp.zeros,
+                                shape[:3], jnp.float32)
+        else:
+            ck = self.variable("cache", "k", jnp.zeros, shape, cfg.dtype)
+            cv = self.variable("cache", "v", jnp.zeros, shape, cfg.dtype)
+            cks = cvs = None
         cp = self.variable(
             "cache", "pos", lambda: jnp.full((batch, S), -1, jnp.int32))
-        return ck, cv, cp, S
+        return ck, cv, cks, cvs, cp, S
+
+    def _kv_cache_write(self, ck, scale_var, b, slots, x):
+        """Store [B, L, H, D] vectors at cache slots, quantizing when the
+        cache is int8 (symmetric absmax per vector)."""
+        if self.config.kv_cache_dtype == "int8":
+            amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+            scale = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(
+                jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                -127, 127).astype(jnp.int8)
+            ck.value = ck.value.at[b, slots].set(q)
+            scale_var.value = scale_var.value.at[b, slots].set(scale)
+        else:
+            ck.value = ck.value.at[b, slots].set(x.astype(self.config.dtype))
+
+    def _kv_cache_read(self, ck, scale_var):
+        """The full cache as cfg.dtype vectors (dequantized when int8 —
+        the int8 load IS the HBM saving; the convert+scale fuses into the
+        attention einsum's operand feed)."""
+        if self.config.kv_cache_dtype == "int8":
+            return (ck.value.astype(self.config.dtype)
+                    * scale_var.value[..., None].astype(self.config.dtype))
+        return ck.value
 
     def _decode_step(self, q, k, v, positions):
         """One cached decode call: write this chunk's K/V, attend the cache.
@@ -228,13 +276,15 @@ class Attention(nn.Module):
                 f"({cfg.prefill_chunk}): the windowed ring cache only has "
                 "window + prefill_chunk - 1 slots, so a larger chunk "
                 "would evict keys its own earliest query still needs")
-        ck, cv, cp, S = self._cache_vars(B)
+        ck, cv, cks, cvs, cp, S = self._cache_vars(B)
         b = jnp.arange(B)[:, None]
         slot = positions % S  # [B, Lc]
-        ck.value = ck.value.at[b, slot].set(k.astype(cfg.dtype))
-        cv.value = cv.value.at[b, slot].set(v.astype(cfg.dtype))
+        self._kv_cache_write(ck, cks, b, slot, k)
+        self._kv_cache_write(cv, cvs, b, slot, v)
         cp.value = cp.value.at[b, slot].set(positions)
-        keys, values, kpos = ck.value, cv.value, cp.value
+        keys = self._kv_cache_read(ck, cks)
+        values = self._kv_cache_read(cv, cvs)
+        kpos = cp.value
         # grouped-query via grouped einsum: query head j attends kv head
         # j // rep (the same consecutive-duplication order as jnp.repeat
         # on axis 2) WITHOUT materializing a heads/kv_heads-times larger
@@ -257,15 +307,13 @@ class Attention(nn.Module):
     def _prefill_write(self, k, v, positions):
         """Scatter the prompt's last min(L, S) K/V into the cache."""
         B, L = k.shape[:2]
-        ck, cv, cp, S = self._cache_vars(B)
+        ck, cv, cks, cvs, cp, S = self._cache_vars(B)
         keep = min(L, S)
         b = jnp.arange(B)[:, None]
         last_pos = positions[:, L - keep:]
         slots = last_pos % S
-        ck.value = ck.value.at[b, slots].set(
-            k[:, L - keep:].astype(self.config.dtype))
-        cv.value = cv.value.at[b, slots].set(
-            v[:, L - keep:].astype(self.config.dtype))
+        self._kv_cache_write(ck, cks, b, slots, k[:, L - keep:])
+        self._kv_cache_write(cv, cvs, b, slots, v[:, L - keep:])
         cp.value = cp.value.at[b, slots].set(last_pos)
 
     @nn.compact

@@ -438,3 +438,27 @@ class TestPatchPreconditionsAndFieldValidation:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(req, timeout=10)
             assert ei.value.code == 400
+
+    def test_patch_may_not_rename_or_renamespace(self):
+        import copy
+
+        cluster = FakeCluster()
+        cluster.create(gvr.PODS, "ns", copy.deepcopy(POD))
+        cluster.create(gvr.PODS, "ns", {"metadata": {"name": "b",
+                                                     "namespace": "ns"}})
+        # renaming via patch would route the write to pod "b"'s bucket key
+        for patcher in (cluster.patch_merge, cluster.patch_strategic):
+            with pytest.raises(errors.ApiError) as ei:
+                patcher(gvr.PODS, "ns", "p",
+                        {"metadata": {"name": "b"}})
+            assert ei.value.code == 422
+            with pytest.raises(errors.ApiError) as ei:
+                patcher(gvr.PODS, "ns", "p",
+                        {"metadata": {"namespace": "elsewhere"}})
+            assert ei.value.code == 422
+        # pod "b" untouched, pod "p" untouched
+        assert cluster.get(gvr.PODS, "ns", "p")["spec"]["containers"]
+        assert "spec" not in cluster.get(gvr.PODS, "ns", "b") or \
+            not cluster.get(gvr.PODS, "ns", "b").get("spec")
+        # a SAME-name patch (harmless identity) still passes
+        cluster.patch_merge(gvr.PODS, "ns", "p", {"metadata": {"name": "p"}})

@@ -231,3 +231,21 @@ class TestTrainLM:
         x, y = (int(v) for v in
                 sel["cloud.google.com/gke-tpu-topology"].split("x"))
         assert worker["replicas"] == (x * y) // 4  # v5e: 4 chips/host
+
+    def test_serve_int8_kv_and_bf16_params(self, tmp_path):
+        """The serving-efficiency flags work end to end on a real
+        artifact: int8 KV cache + bf16 params generate valid text."""
+        import subprocess
+
+        r = run_lm(tmp_path, BASE + ["--train_steps=2"])
+        assert r.returncode == 0, r.stderr
+        serve = os.path.join(REPO, "examples", "train_lm", "serve_lm.py")
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, serve, f"--train_dir={tmp_path}",
+             "--tokens=5,9,12", "--max_new_tokens=6",
+             "--kv_cache=int8", "--param_dtype=bfloat16"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert out.returncode == 0, out.stderr
+        ids = [int(t) for t in out.stdout.strip().split(",")]
+        assert len(ids) == 6 and all(0 <= t < 256 for t in ids)

@@ -136,6 +136,9 @@ def build_plan() -> list[dict]:
         # cheap identities)
         item("decode", {}, only="decode", persist=True),
         item("vit", {}, only="vit", persist=True),
+        # int8 KV cache A/B vs the bf16-cache decode above (same shapes,
+        # one new compile; non-default config so it never persists)
+        item("decode_kv_int8", {"BENCH_KV_CACHE": "int8"}, only="decode"),
         item("decode_depth", {}, only="decode_depth", persist=True,
              timeout=2100, phase_timeout=900),
         # (d) flash-tile candidates (same model shapes, new kernel tiles)
