@@ -322,9 +322,11 @@ class FakeCluster:
         meta = patch.get("metadata")
         if isinstance(meta, dict):
             for field in ("name", "namespace"):
-                sent_id = meta.get(field)
+                if field not in meta:
+                    continue
+                sent_id = meta[field]  # None = merge-delete: also immutable
                 cur_id = (current.get("metadata") or {}).get(field)
-                if sent_id is not None and sent_id != cur_id:
+                if sent_id != cur_id:
                     raise errors.invalid(
                         f"metadata.{field} is immutable: patch on "
                         f"{resource.plural} {name!r} may not change it "

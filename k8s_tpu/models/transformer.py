@@ -253,8 +253,12 @@ class Attention(nn.Module):
         the int8 load IS the HBM saving; the convert+scale fuses into the
         attention einsum's operand feed)."""
         if self.config.kv_cache_dtype == "int8":
-            return (ck.value.astype(self.config.dtype)
-                    * scale_var.value[..., None].astype(self.config.dtype))
+            # dequantize in f32 (int8 * f32 scale), cast the PRODUCT once:
+            # casting the scale itself to bf16 first would stack ~0.2%
+            # scale-rounding error on the int8 step error it was stored
+            # as f32 to avoid
+            return (ck.value.astype(jnp.float32)
+                    * scale_var.value[..., None]).astype(self.config.dtype)
         return ck.value
 
     def _decode_step(self, q, k, v, positions):

@@ -462,3 +462,9 @@ class TestPatchPreconditionsAndFieldValidation:
             not cluster.get(gvr.PODS, "ns", "b").get("spec")
         # a SAME-name patch (harmless identity) still passes
         cluster.patch_merge(gvr.PODS, "ns", "p", {"metadata": {"name": "p"}})
+        # an explicit null (merge-delete of the name) is also immutable:
+        # 422, not a 404 on an object that exists
+        with pytest.raises(errors.ApiError) as ei:
+            cluster.patch_merge(gvr.PODS, "ns", "p",
+                                {"metadata": {"name": None}})
+        assert ei.value.code == 422
