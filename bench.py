@@ -880,6 +880,7 @@ def bench_decode_depth(batch_per_chip: int = 32, prompt_len: int = 1024,
       measured pair anchors the roofline analysis in bench_decode.
     """
     import jax
+    import jax.numpy as jnp
 
     from k8s_tpu.models.decode import make_beam_generate_fn, make_generate_fn
     from k8s_tpu.models.transformer import Transformer
@@ -889,18 +890,21 @@ def bench_decode_depth(batch_per_chip: int = 32, prompt_len: int = 1024,
     repeats = _repeats_default()
 
     def timed_call(fn, *args):
+        """(times, last_result): callers needing the deterministic output
+        (e.g. speculative stats) reuse it instead of paying another run."""
         def one():
             return jax.block_until_ready(fn(*args))
 
         with_retries(one, what="decode_depth compile")
         one()  # steady-state warmup
         times = []
+        out = None
         for _ in range(max(1, repeats)):
             start = time.perf_counter()
             for _ in range(calls):
-                one()
+                out = one()
             times.append((time.perf_counter() - start) / calls)
-        return times
+        return times, out
 
     out = {"repeats": repeats, "batch_per_chip": batch_per_chip,
            "prompt_len": prompt_len, "chunk": chunk}
@@ -920,7 +924,7 @@ def bench_decode_depth(batch_per_chip: int = 32, prompt_len: int = 1024,
     rng = jax.random.PRNGKey(2)
     for label, chunked in (("prefill_oneshot", False), ("prefill_chunked", True)):
         gen = make_generate_fn(cfg, new_tail, chunked_prefill=chunked)
-        times = timed_call(gen, params, prompt, rng)
+        times, _ = timed_call(gen, params, prompt, rng)
         rates = [batch * prompt_len / t / n_chips for t in times]
         out[f"{label}_prompt_tokens_per_sec_per_chip"] = round(_median(rates), 1)
         out[f"{label}_std"] = round(_stdev(rates), 1)
@@ -937,9 +941,9 @@ def bench_decode_depth(batch_per_chip: int = 32, prompt_len: int = 1024,
         lambda: Transformer(bcfg).init(jax.random.PRNGKey(1), bprompt[:1]),
         what="decode_depth beam init")["params"]
     greedy = make_generate_fn(bcfg, beam_new)
-    gtimes = timed_call(greedy, bparams, bprompt, rng)
+    gtimes, _ = timed_call(greedy, bparams, bprompt, rng)
     beam = make_beam_generate_fn(bcfg, beam_new, beam_size=4)
-    btimes = timed_call(beam, bparams, bprompt)
+    btimes, _ = timed_call(beam, bparams, bprompt)
     out["greedy_per_token_ms"] = round(
         _median(gtimes) / beam_new / batch * 1000, 4)
     out["beam4_per_token_ms"] = round(
@@ -957,12 +961,37 @@ def bench_decode_depth(batch_per_chip: int = 32, prompt_len: int = 1024,
         lambda: Transformer(scfg).init(jax.random.PRNGKey(1), sprompt[:1]),
         what="decode_depth sweep init")["params"]
     sgen = make_generate_fn(scfg, 128)
-    stimes = timed_call(sgen, sparams, sprompt, rng)
+    stimes, _ = timed_call(sgen, sparams, sprompt, rng)
     srates = [sbatch * 128 / t / n_chips for t in stimes]
     out[f"decode_b{sweep_batch}_tokens_per_sec_per_chip"] = round(
         _median(srates), 1)
     out[f"decode_b{sweep_batch}_std"] = round(_stdev(srates), 1)
     out["sweep_batch"] = sweep_batch
+
+    # -- speculative decoding on a PERIODIC prompt (the favorable case —
+    # prompt-lookup drafts hit; random prompts degrade to vanilla pace,
+    # measured by the plain decode bench) -------------------------------
+    from k8s_tpu.models.decode import make_speculative_generate_fn
+
+    sp_prompt_len, sp_new, sp_k = (16, 16, 4) if os.environ.get(
+        "BENCH_SMOKE") else (128, 128, 4)
+    pcfg = _gpt2_small_config(
+        max_seq_len=sp_prompt_len + sp_new + sp_k,
+        use_flash_attention=on_tpu)
+    period = jnp.arange(4, dtype=jnp.int32) + 5
+    pprompt = jnp.tile(period, (batch, sp_prompt_len // 4))
+    pparams = with_retries(
+        lambda: Transformer(pcfg).init(jax.random.PRNGKey(1), pprompt[:1]),
+        what="decode_depth spec init")["params"]
+    spec = make_speculative_generate_fn(pcfg, sp_new, draft_k=sp_k,
+                                        return_stats=True)
+    sptimes, (_, stats) = timed_call(spec, pparams, pprompt)
+    sprates = [batch * sp_new / t / n_chips for t in sptimes]
+    out["spec_tokens_per_sec_per_chip"] = round(_median(sprates), 1)
+    out["spec_std"] = round(_stdev(sprates), 1)
+    out["spec_tokens_per_call"] = round(float(stats["tokens_per_call"]), 2)
+    out["spec_draft_k"] = sp_k
+    out["spec_prompt"] = "periodic4"
     return out
 
 
@@ -1137,7 +1166,9 @@ def build_output(recorder: Recorder, want_resnet: bool, want_transformer: bool,
         for k in ("prefill_oneshot_prompt_tokens_per_sec_per_chip",
                   "prefill_chunked_prompt_tokens_per_sec_per_chip",
                   "chunked_prefill_vs_oneshot", "beam4_overhead",
-                  "greedy_per_token_ms", "beam4_per_token_ms"):
+                  "greedy_per_token_ms", "beam4_per_token_ms",
+                  "spec_tokens_per_sec_per_chip", "spec_tokens_per_call",
+                  "spec_draft_k"):
             if k in depth:
                 out[f"decode_depth_{k}"] = depth[k]
         sweep = depth.get("sweep_batch")

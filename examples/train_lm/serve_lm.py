@@ -40,6 +40,11 @@ def parse_args(argv=None):
     p.add_argument("--chunked_prefill", action="store_true",
                    help="stream the prompt through the cache in "
                    "config.prefill_chunk-token chunks")
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="greedy speculative decoding with prompt-lookup "
+                   "drafting: verify K-1 drafted tokens per model call "
+                   "(output identical to greedy; fewer model calls on "
+                   "repetitive text)")
     p.add_argument("--kv_cache", choices=["model", "int8"], default="model",
                    help="int8 stores the KV cache as per-vector-scaled "
                    "int8 — half the per-token cache reads, ~quantization-"
@@ -61,6 +66,15 @@ def main(argv=None) -> int:
                          "search yet; drop one of the two flags")
     if args.top_k < 0:
         raise SystemExit(f"--top_k must be >= 0, got {args.top_k}")
+    if args.speculative > 0 and (
+            args.beam > 0 or args.temperature != 0.0 or args.top_k > 0
+            or args.chunked_prefill):
+        raise SystemExit(
+            "--speculative is greedy-only and does its own prefill; drop "
+            "--beam/--temperature/--top_k/--chunked_prefill")
+    if args.speculative == 1:
+        raise SystemExit("--speculative must be >= 2 (K-1 drafted tokens "
+                         "+ 1 bonus per call); 0 disables")
 
     import jax
     import jax.numpy as jnp
@@ -100,7 +114,15 @@ def main(argv=None) -> int:
 
     eos = args.eos if args.eos >= 0 else None
     params = variables["params"]
-    if args.beam > 0:
+    if args.speculative > 0:
+        fn = decode_lib.make_speculative_generate_fn(
+            config, args.max_new_tokens, draft_k=args.speculative,
+            eos_id=eos, return_stats=True)
+        out, stats = fn(params, prompt)
+        log.info("speculative: %.2f tokens/model-call over %d calls",
+                 float(stats["tokens_per_call"]),
+                 int(stats["model_calls"]))
+    elif args.beam > 0:
         if args.top_k > 0:
             log.warning("--top_k %d has no effect with --beam (beam search "
                         "scores greedily)", args.top_k)

@@ -166,6 +166,157 @@ def make_generate_fn(config: TransformerConfig, max_new_tokens: int,
     return generate
 
 
+def make_speculative_generate_fn(config: TransformerConfig,
+                                 max_new_tokens: int, draft_k: int = 4,
+                                 eos_id: Optional[int] = None,
+                                 pad_id: int = 0,
+                                 return_stats: bool = False):
+    """Greedy speculative decoding with prompt-lookup drafting:
+    ``generate(params, prompt) -> [B, max_new_tokens]`` (plus a per-call
+    stats dict when ``return_stats``).
+
+    Each iteration proposes ``draft_k - 1`` continuation tokens by copying
+    what followed the most recent earlier occurrence of the current
+    2-gram in the row's own context (prompt-lookup decoding — model-free
+    drafting, strongest on repetitive/structured text), then VERIFIES the
+    whole proposal in ONE ``draft_k``-token cached decode call: position
+    ``i``'s logits depend only on the (correct) chunk prefix, so the
+    longest draft prefix matching the model's own argmax is accepted,
+    plus the model's bonus token after it.  Output is argmax-EXACT with
+    vanilla greedy decoding by construction — speculation changes the
+    number of model calls (one per ``accepted+1`` tokens, amortizing the
+    per-step parameter read decode is bound by), never the tokens.
+
+    Rejected-draft cache writes need no rollback: their slots carry
+    positions the causal mask hides from every later query, and the next
+    chunk (which starts at the first rejected position) overwrites them
+    before attending — the write-then-mask chunk contract from chunked
+    prefill.  Composes with GQA and the int8 KV cache; sliding-window
+    ring caches are refused (draft chunks would need window+draft_k ring
+    headroom) as is sampling (temperature speculation needs rejection
+    sampling, not implemented).
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if draft_k < 2:
+        raise ValueError("draft_k must be >= 2 (k-1 drafts + 1 bonus)")
+    if config.window_size is not None:
+        raise ValueError(
+            "speculative decoding does not compose with sliding-window "
+            "ring caches (draft chunks would overrun the ring); use "
+            "make_generate_fn")
+    model = Transformer(config)
+
+    @jax.jit
+    def generate(params, prompt):
+        B, Lp = prompt.shape
+        if Lp < 2:
+            raise ValueError("prompt-lookup drafting needs prompt_len >= 2")
+        # the final iteration (n = max_new_tokens - 1) writes draft
+        # positions up to Lp + max_new_tokens + draft_k - 3, which must
+        # stay <= max_seq_len - 1: a full cache wraps slot = pos % S at
+        # max_seq_len and silently EVICTS prompt token 0's K/V before the
+        # same call attends
+        if Lp + max_new_tokens - 2 + draft_k > config.max_seq_len:
+            raise ValueError(
+                f"prompt ({Lp}) + max_new_tokens ({max_new_tokens}) + "
+                f"draft_k ({draft_k}) headroom exceeds max_seq_len "
+                f"({config.max_seq_len})")
+        T = Lp + max_new_tokens
+        K = draft_k
+
+        logits, varz = model.apply({"params": params}, prompt,
+                                   mode="prefill", mutable=["cache"])
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate(
+            [prompt.astype(jnp.int32),
+             jnp.full((B, max_new_tokens), pad_id, jnp.int32)], axis=1)
+        seq = seq.at[:, Lp].set(first)
+        n = jnp.ones((B,), jnp.int32)
+        done = (first == eos_id) if eos_id is not None \
+            else jnp.zeros((B,), bool)
+        iters = jnp.zeros((), jnp.int32)
+
+        def lookup_draft(seq, length, last):
+            """[B, K-1] proposed continuations of each row's last 2-gram
+            (latest earlier occurrence wins; fallback: repeat last)."""
+            a = jnp.take_along_axis(seq, (length - 2)[:, None], 1)[:, 0]
+            idx = jnp.arange(T - 1)
+            hit = (seq[:, :-1] == a[:, None]) & (seq[:, 1:] == last[:, None]) \
+                & (idx[None, :] < (length - 2)[:, None])
+            j = jnp.where(hit, idx[None, :], -1).max(axis=1)  # [B]
+            offs = (j + 2)[:, None] + jnp.arange(K - 1)[None, :]
+            valid = (j >= 0)[:, None] & (offs < length[:, None])
+            toks = jnp.take_along_axis(seq, jnp.clip(offs, 0, T - 1), 1)
+            return jnp.where(valid, toks, last[:, None])
+
+        def cond(carry):
+            seq, n, last, done, cache, iters = carry
+            return jnp.any(~done & (n < max_new_tokens))
+
+        def draft_padded(draft):
+            # draft is [B, K-1]; pad one column so `where` shapes line up
+            return jnp.concatenate(
+                [draft, jnp.full((draft.shape[0], 1), pad_id, jnp.int32)],
+                axis=1)
+
+        def body(carry):
+            seq, n, last, done, cache, iters = carry
+            length = Lp + n                      # next write index per row
+            draft = lookup_draft(seq, length, last)          # [B, K-1]
+            chunk = jnp.concatenate([last[:, None], draft], axis=1)
+            positions = (length - 1)[:, None] + jnp.arange(K)[None, :]
+            logits, varz = model.apply(
+                {"params": params, "cache": cache}, chunk,
+                positions=positions, mode="decode", mutable=["cache"])
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
+            # draft[i] is accepted iff it equals the model's own argmax
+            # after consuming the (accepted) chunk prefix 0..i
+            match = (draft == greedy[:, :-1]).astype(jnp.int32)
+            acc = jnp.cumprod(match, axis=1).sum(axis=1)     # [B] 0..K-1
+            bonus = jnp.take_along_axis(greedy, acc[:, None], 1)[:, 0]
+            ar = jnp.arange(K)[None, :]
+            emit = jnp.where(ar < acc[:, None], draft_padded(draft),
+                             bonus[:, None])                 # [B, K]
+            n_new = acc + 1
+            if eos_id is not None:
+                # truncate at the FIRST emitted EOS (inclusive)
+                is_eos = (emit == eos_id) & (ar < n_new[:, None])
+                any_eos = is_eos.any(axis=1)
+                first_eos = jnp.where(is_eos, ar, K).min(axis=1)
+                n_new = jnp.where(any_eos, first_eos + 1, n_new)
+                done_next = done | any_eos
+            else:
+                done_next = done
+            n_new = jnp.minimum(n_new, max_new_tokens - n)
+            n_new = jnp.where(done | (n >= max_new_tokens), 0, n_new)
+            cols = length[:, None] + ar
+            write = (ar < n_new[:, None])
+            seq = seq.at[jnp.arange(B)[:, None],
+                         jnp.where(write, cols, T)].set(
+                jnp.where(write, emit, pad_id), mode="drop")
+            last_new = jnp.take_along_axis(
+                emit, jnp.maximum(n_new - 1, 0)[:, None], 1)[:, 0]
+            last = jnp.where(n_new > 0, last_new, last)
+            return (seq, n + n_new, last, done_next, varz["cache"],
+                    iters + 1)
+
+        carry = (seq, n, first, done, varz["cache"], iters)
+        seq, n, _, _, _, iters = jax.lax.while_loop(cond, body, carry)
+        out = seq[:, Lp:]
+        if return_stats:
+            return out, {
+                "model_calls": iters + 1,  # +1 for the prefill call
+                # mean tokens landed per batched verify call per row
+                # (1.0 = vanilla decode pace; up to draft_k when drafts hit)
+                "tokens_per_call": (n - 1).sum()
+                / (jnp.maximum(iters, 1) * B),
+            }
+        return out
+
+    return generate
+
+
 def make_beam_generate_fn(config: TransformerConfig, max_new_tokens: int,
                           beam_size: int, eos_id: Optional[int] = None,
                           pad_id: int = 0, length_penalty: float = 0.0):

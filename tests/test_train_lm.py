@@ -249,3 +249,33 @@ class TestTrainLM:
         assert out.returncode == 0, out.stderr
         ids = [int(t) for t in out.stdout.strip().split(",")]
         assert len(ids) == 6 and all(0 <= t < 256 for t in ids)
+
+    def test_serve_speculative(self, tmp_path):
+        """--speculative serves greedily through the prompt-lookup
+        verifier and reports its call amortization; output must be the
+        plain greedy output exactly (speculation never changes tokens)."""
+        import subprocess
+
+        r = run_lm(tmp_path, BASE + ["--train_steps=2"])
+        assert r.returncode == 0, r.stderr
+        serve = os.path.join(REPO, "examples", "train_lm", "serve_lm.py")
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+
+        def run_serve(*flags):
+            out = subprocess.run(
+                [sys.executable, serve, f"--train_dir={tmp_path}",
+                 "--tokens=5,9,12", "--max_new_tokens=8", *flags],
+                capture_output=True, text=True, env=env, timeout=300)
+            assert out.returncode == 0, out.stderr
+            return out
+
+        plain = run_serve()
+        spec = run_serve("--speculative=4")
+        assert spec.stdout == plain.stdout, (spec.stdout, plain.stdout)
+        assert "tokens/model-call" in spec.stderr
+        # greedy-only: sampling flags refuse loudly
+        bad = subprocess.run(
+            [sys.executable, serve, f"--train_dir={tmp_path}",
+             "--tokens=5,9", "--speculative=4", "--temperature=0.5"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert bad.returncode != 0 and "greedy-only" in bad.stderr
