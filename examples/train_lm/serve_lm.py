@@ -84,14 +84,8 @@ def main(argv=None) -> int:
     from k8s_tpu.models import serving
     from k8s_tpu.models.dataset import decode_bytes, encode_bytes
 
-    config, variables = serving.load_serving(args.train_dir)
-    if args.kv_cache == "int8":
-        import dataclasses
-
-        config = dataclasses.replace(config, kv_cache_dtype="int8")
-    if args.param_dtype == "bfloat16":
-        variables = {**variables, "params": serving.cast_params_for_serving(
-            variables["params"])}
+    config, params = serving.load_for_serving(
+        args.train_dir, kv_cache=args.kv_cache, param_dtype=args.param_dtype)
     log.info("loaded %s: %d layers, hidden %d, vocab %d",
              args.train_dir, config.layers, config.hidden,
              config.vocab_size)
@@ -113,7 +107,6 @@ def main(argv=None) -> int:
     prompt = jnp.asarray(ids)[None, :]
 
     eos = args.eos if args.eos >= 0 else None
-    params = variables["params"]
     if args.speculative > 0:
         fn = decode_lib.make_speculative_generate_fn(
             config, args.max_new_tokens, draft_k=args.speculative,
@@ -140,13 +133,9 @@ def main(argv=None) -> int:
             top_k=args.top_k or None, eos_id=eos,
             chunked_prefill=args.chunked_prefill)
         out = fn(params, prompt, jax.random.PRNGKey(args.seed))
-    toks = np.asarray(out)[0]
-    if eos is not None and eos in toks:
-        # rows freeze to pad after EOS; neither the EOS token nor the
-        # padding belongs in the rendered output
-        toks = toks[:list(toks).index(eos)]
+    toks = serving.strip_after_eos(np.asarray(out)[0], eos)
     if args.text:
-        print(args.text + decode_bytes(toks), flush=True)
+        print(args.text + decode_bytes(np.asarray(toks)), flush=True)
     else:
         print(",".join(str(int(t)) for t in toks), flush=True)
     return 0

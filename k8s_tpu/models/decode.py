@@ -317,6 +317,19 @@ def make_speculative_generate_fn(config: TransformerConfig,
     return generate
 
 
+@functools.lru_cache(maxsize=32)
+def cached_speculative_fn(config: TransformerConfig, max_new_tokens: int,
+                          draft_k: int = 4, eos_id: Optional[int] = None,
+                          pad_id: int = 0):
+    """Program-cached :func:`make_speculative_generate_fn` (config is a
+    frozen dataclass, so the whole generation config is hashable) — a
+    resident server's repeated shapes reuse the executable instead of
+    re-tracing per request."""
+    return make_speculative_generate_fn(config, max_new_tokens,
+                                        draft_k=draft_k, eos_id=eos_id,
+                                        pad_id=pad_id)
+
+
 def make_beam_generate_fn(config: TransformerConfig, max_new_tokens: int,
                           beam_size: int, eos_id: Optional[int] = None,
                           pad_id: int = 0, length_penalty: float = 0.0):

@@ -1,0 +1,217 @@
+"""HTTP inference server over a train_lm serving artifact.
+
+The CLI loop (examples/train_lm/serve_lm.py) pays artifact load + jit
+compile per invocation; a resident server pays them once and serves every
+request from the warm jit cache — the practical half of the train→serve
+story (`examples/tf_job_serve.yaml` can run this as the serving TFJob's
+long-lived process instead of a one-shot generation).
+
+    python -m k8s_tpu.models.server --train_dir DIR --port 8000
+
+Endpoints (JSON over HTTP/1.1, stdlib-only like the rest of the repo):
+
+- ``GET /healthz`` → ``{"status": "ok", "model": {...}}`` — readiness for
+  kubelet probes.
+- ``POST /v1/generate`` with ``{"text": str | "tokens": [int], ...}`` →
+  ``{"text": str | "tokens": [int]}``.  Optional fields:
+  ``max_new_tokens`` (default from --max_new_tokens), ``temperature``,
+  ``top_k``, ``eos``, ``seed``, ``speculative`` (draft_k, greedy-only).
+
+Device work is single-flight (one lock): decode programs are compiled per
+(prompt-length, generation-config) shape and cached by jit, so repeated
+shapes are served at device speed; a NEW prompt length pays one compile
+(documented, not hidden — there is no silent left-pad bucketing, which
+would corrupt RoPE positions).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class LmServer:
+    """Loads a serving artifact once; thread-safe generate()."""
+
+    def __init__(self, train_dir: str, kv_cache: str = "model",
+                 param_dtype: str = "model",
+                 default_max_new_tokens: int = 64):
+        from k8s_tpu.models import serving
+
+        self.config, self.params = serving.load_for_serving(
+            train_dir, kv_cache=kv_cache, param_dtype=param_dtype)
+        self.default_max_new_tokens = default_max_new_tokens
+        self._lock = threading.Lock()  # single-flight device work
+
+    def model_info(self) -> dict:
+        c = self.config
+        return {"layers": c.layers, "hidden": c.hidden,
+                "vocab_size": c.vocab_size, "max_seq_len": c.max_seq_len,
+                "kv_cache_dtype": c.kv_cache_dtype}
+
+    def generate(self, req: dict) -> dict:
+        """One generation request; raises ValueError on bad input."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from k8s_tpu.models import decode as decode_lib
+        from k8s_tpu.models.dataset import decode_bytes, encode_bytes
+
+        has_text = isinstance(req.get("text"), str)
+        has_tokens = isinstance(req.get("tokens"), list)
+        if has_text == has_tokens:
+            raise ValueError('give exactly one of "text" or "tokens"')
+        if has_text:
+            ids = encode_bytes(req["text"]).astype(np.int32)
+        else:
+            try:
+                ids = np.asarray([int(t) for t in req["tokens"]], np.int32)
+            except (TypeError, ValueError):
+                raise ValueError('"tokens" must be a list of ints')
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if ids.min(initial=0) < 0 or \
+                ids.max(initial=0) >= self.config.vocab_size:
+            raise ValueError(
+                f"token ids outside [0, {self.config.vocab_size})")
+
+        def opt(key, default, cast):
+            # JSON null means "not set" (use the default), like an absent
+            # key; a non-castable value is the CLIENT's error -> 400
+            val = req.get(key)
+            if val is None:
+                return default
+            try:
+                return cast(val)
+            except (TypeError, ValueError):
+                raise ValueError(f"bad {key!r}: {val!r}")
+
+        max_new = opt("max_new_tokens", self.default_max_new_tokens, int)
+        if not 1 <= max_new <= self.config.max_seq_len:
+            raise ValueError(f"max_new_tokens must be in "
+                             f"[1, {self.config.max_seq_len}]")
+        temperature = opt("temperature", 0.0, float)
+        top_k = opt("top_k", 0, int) or None
+        if top_k is not None and top_k < 1:
+            raise ValueError("top_k must be >= 1 (omit or 0 disables)")
+        eos: Optional[int] = opt("eos", None, int)
+        seed = opt("seed", 0, int)
+        spec = opt("speculative", 0, int)
+        if spec != 0 and spec < 2:
+            raise ValueError("speculative must be >= 2 (0 disables)")
+        if spec > 0 and (temperature != 0.0 or top_k is not None):
+            raise ValueError("speculative generation is greedy-only")
+
+        prompt = jnp.asarray(ids)[None, :]
+        with self._lock:
+            if spec > 0:
+                fn = decode_lib.cached_speculative_fn(
+                    self.config, max_new, draft_k=spec, eos_id=eos)
+                out = fn(self.params, prompt)
+            else:
+                out = decode_lib.generate(
+                    self.config, self.params, prompt, max_new,
+                    rng=jax.random.PRNGKey(seed), temperature=temperature,
+                    top_k=top_k, eos_id=eos)
+        from k8s_tpu.models.serving import strip_after_eos
+
+        toks = strip_after_eos(np.asarray(out)[0], eos)
+        if has_text:
+            return {"text": req["text"] + decode_bytes(np.asarray(toks))}
+        return {"tokens": [int(t) for t in toks]}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "k8s-tpu-lm"
+
+    def log_message(self, fmt, *args):
+        log.debug("server: " + fmt, *args)
+
+    def _send(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            return self._send(200, {"status": "ok",
+                                    "model": self.server.lm.model_info()})
+        return self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/v1/generate":
+            return self._send(404, {"error": f"unknown path {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            return self._send(400, {"error": f"bad request body: {e}"})
+        try:
+            return self._send(200, self.server.lm.generate(req))
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 - surface, don't kill the worker
+            log.exception("generate failed")
+            return self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+def serve(lm: LmServer, host: str = "127.0.0.1", port: int = 0):
+    """Returns a started ThreadingHTTPServer (caller owns shutdown())."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True
+    httpd.lm = lm  # type: ignore[attr-defined]
+    t = threading.Thread(target=httpd.serve_forever,
+                         kwargs={"poll_interval": 0.1}, daemon=True,
+                         name="lm-server")
+    t.start()
+    return httpd
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train_dir", required=True)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default loopback; set 0.0.0.0 "
+                   "explicitly for pod exposure)")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max_new_tokens", type=int, default=64,
+                   help="per-request default")
+    p.add_argument("--kv_cache", choices=["model", "int8"], default="model")
+    p.add_argument("--param_dtype", choices=["model", "bfloat16"],
+                   default="model")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    lm = LmServer(args.train_dir, kv_cache=args.kv_cache,
+                  param_dtype=args.param_dtype,
+                  default_max_new_tokens=args.max_new_tokens)
+    httpd = serve(lm, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    log.info("serving %s on http://%s:%d (POST /v1/generate)",
+             args.train_dir, host, port)
+    print(f"READY http://{host}:{port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

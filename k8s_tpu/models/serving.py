@@ -112,6 +112,36 @@ def load_serving(train_dir: str) -> tuple[TransformerConfig, Any]:
     return config, restored["params"]
 
 
+def load_for_serving(train_dir: str, kv_cache: str = "model",
+                     param_dtype: str = "model"):
+    """Artifact load + the serving-efficiency overrides, shared by the CLI
+    (examples/train_lm/serve_lm.py) and the resident HTTP server
+    (models/server.py) so the two never drift: returns (config, params)
+    with ``kv_cache="int8"`` / ``param_dtype="bfloat16"`` applied."""
+    config, variables = load_serving(train_dir)
+    if kv_cache == "int8":
+        config = dataclasses.replace(config, kv_cache_dtype="int8")
+    elif kv_cache != "model":
+        raise ValueError(
+            f"kv_cache must be 'model' or 'int8', got {kv_cache!r}")
+    params = variables["params"]
+    if param_dtype == "bfloat16":
+        params = cast_params_for_serving(params)
+    elif param_dtype != "model":
+        raise ValueError(
+            f"param_dtype must be 'model' or 'bfloat16', got {param_dtype!r}")
+    return config, params
+
+
+def strip_after_eos(toks, eos_id):
+    """Rendered output: drop the EOS token and the pad tail after it
+    (rows freeze to pad once EOS is emitted)."""
+    toks = list(toks)
+    if eos_id is not None and eos_id in toks:
+        toks = toks[:toks.index(eos_id)]
+    return toks
+
+
 def cast_params_for_serving(params):
     """f32 -> bf16 param cast for inference (decode re-reads every param
     per token, so at f32 they are the dominant HBM term).  Non-f32 leaves

@@ -73,6 +73,19 @@ class TestExampleManifests:
         assert any("serve_lm.py" in c for c in cmd)
         assert any(c.startswith("--train_dir=") for c in cmd)
 
+    def test_tf_job_serve_http_yaml(self):
+        # the RESIDENT serving manifest: the HTTP server process
+        # (k8s_tpu.models.server) with OnFailure restarts and a /healthz
+        # readiness probe on the bound port
+        job = load_one("tf_job_serve_http.yaml")
+        spec = job.spec.tf_replica_specs["Worker"]
+        assert spec.replicas == 1
+        assert spec.restart_policy == v1alpha2.RestartPolicyOnFailure
+        c = spec.template["spec"]["containers"][0]
+        assert "k8s_tpu.models.server" in c["command"]
+        assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+        assert any(p.get("containerPort") == 8000 for p in c["ports"])
+
     def test_tpu_smoke_yaml(self):
         job = load_one("tpu_smoke.yaml")
         assert job.spec.tf_replica_specs["TPU"].restart_policy == v1alpha2.RestartPolicyNever

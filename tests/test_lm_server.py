@@ -1,0 +1,130 @@
+"""HTTP inference server (k8s_tpu/models/server.py): a resident process
+loading a train_lm serving artifact once and answering real HTTP requests
+from the warm jit cache — the long-lived half of the train→serve loop
+(examples/tf_job_serve.yaml's process model)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _post(url, payload, timeout=300):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    td = tmp_path_factory.mktemp("lm")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_lm",
+                                      "train_lm.py"),
+         f"--train_dir={td}", "--preset=tiny", "--train_steps=4",
+         "--batch_size=8", "--seq_len=64", "--learning_rate=1e-2",
+         f"--data_dir={os.path.join(REPO, 'tests', 'fixtures', 'tokens')}"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "k8s_tpu.models.server",
+         f"--train_dir={td}", "--port=0", "--max_new_tokens=16",
+         "--param_dtype=bfloat16"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    # synchronize on the READY line via a reader THREAD: a bare
+    # readline() blocks past any deadline if the server wedges before
+    # printing, hanging the whole CI tier instead of failing in 120s
+    import queue
+    import threading
+
+    lines: queue.Queue = queue.Queue()
+
+    def pump():
+        for line in proc.stdout:
+            lines.put(line)
+
+    threading.Thread(target=pump, daemon=True).start()
+    url = None
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            line = lines.get(timeout=1.0)
+        except queue.Empty:
+            if proc.poll() is not None:
+                raise AssertionError(f"server died: rc={proc.returncode}")
+            continue
+        if line.startswith("READY "):
+            url = line.split()[1].strip()
+            break
+    assert url, "server never printed READY within 120s"
+    yield url
+    proc.terminate()
+    try:
+        proc.wait(10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+class TestLmServer:
+    def test_healthz_reports_model(self, server):
+        with urllib.request.urlopen(server + "/healthz", timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["status"] == "ok"
+        assert body["model"]["vocab_size"] == 256
+
+    def test_text_generation_round_trip(self, server):
+        out = _post(server + "/v1/generate",
+                    {"text": "the ", "max_new_tokens": 8})
+        assert out["text"].startswith("the ") and len(out["text"]) > 4
+
+    def test_token_generation_and_repeat_is_warm(self, server):
+        out = _post(server + "/v1/generate", {"tokens": [5, 9, 12]})
+        assert len(out["tokens"]) == 16  # server default max_new_tokens
+        assert all(0 <= t < 256 for t in out["tokens"])
+        # same shape again: served from the warm jit cache, and
+        # deterministic (greedy)
+        t0 = time.time()
+        again = _post(server + "/v1/generate", {"tokens": [5, 9, 12]})
+        assert again == out
+        assert time.time() - t0 < 30  # no recompile-scale stall
+
+    def test_speculative_matches_greedy(self, server):
+        a = _post(server + "/v1/generate",
+                  {"text": "the the the ", "max_new_tokens": 12})
+        b = _post(server + "/v1/generate",
+                  {"text": "the the the ", "max_new_tokens": 12,
+                   "speculative": 4})
+        assert a == b  # speculation never changes tokens
+
+    @pytest.mark.parametrize("payload,frag", [
+        ({}, "exactly one"),
+        ({"text": "x", "tokens": [1]}, "exactly one"),
+        ({"tokens": [999999]}, "outside"),
+        ({"text": "x", "max_new_tokens": 0}, "max_new_tokens"),
+        ({"text": "x", "speculative": 1}, "speculative"),
+        ({"text": "x", "speculative": 4, "temperature": 0.5}, "greedy-only"),
+    ])
+    def test_bad_requests_are_400_with_reason(self, server, payload, frag):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/generate", payload)
+        assert ei.value.code == 400
+        assert frag in json.loads(ei.value.read())["error"]
+
+    def test_unknown_path_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/nope", {})
+        assert ei.value.code == 404
