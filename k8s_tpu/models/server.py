@@ -3,8 +3,9 @@
 The CLI loop (examples/train_lm/serve_lm.py) pays artifact load + jit
 compile per invocation; a resident server pays them once and serves every
 request from the warm jit cache — the practical half of the train→serve
-story (`examples/tf_job_serve.yaml` can run this as the serving TFJob's
-long-lived process instead of a one-shot generation).
+story (`examples/tf_job_serve_http.yaml` runs this as the serving
+TFJob's long-lived process; `tf_job_serve.yaml` is the one-shot batch
+variant).
 
     python -m k8s_tpu.models.server --train_dir DIR --port 8000
 
@@ -150,11 +151,19 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
+        # ALWAYS drain the declared body first: replying on a keep-alive
+        # connection with unread bytes leaves them to be parsed as the
+        # next request line, 400-ing every later request on the socket
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            self.close_connection = True  # unknown body size: can't drain
+            return self._send(400, {"error": "bad Content-Length"})
+        raw = self.rfile.read(length) if length > 0 else b""
         if self.path != "/v1/generate":
             return self._send(404, {"error": f"unknown path {self.path}"})
         try:
-            length = int(self.headers.get("Content-Length") or 0)
-            req = json.loads(self.rfile.read(length) or b"{}")
+            req = json.loads(raw or b"{}")
             if not isinstance(req, dict):
                 raise ValueError("request body must be a JSON object")
         except (ValueError, json.JSONDecodeError) as e:

@@ -128,3 +128,28 @@ class TestLmServer:
         with pytest.raises(urllib.error.HTTPError) as ei:
             _post(server + "/v1/nope", {})
         assert ei.value.code == 404
+
+
+class TestKeepAliveHygiene:
+    def test_404_with_body_does_not_desync_keepalive(self, server):
+        """A keep-alive client POSTing to a wrong path must get clean
+        responses on the SAME socket afterwards — an undrained body would
+        be parsed as the next request line."""
+        import http.client
+
+        host = server.split("//")[1]
+        conn = http.client.HTTPConnection(host, timeout=60)
+        body = json.dumps({"text": "the ", "max_new_tokens": 4}).encode()
+        conn.request("POST", "/v1/nope", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        # same connection: the next request must parse cleanly
+        conn.request("POST", "/v1/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()[:200]
+        out = json.loads(resp.read())
+        assert out["text"].startswith("the ")
+        conn.close()
