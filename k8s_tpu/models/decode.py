@@ -191,20 +191,26 @@ def make_speculative_generate_fn(config: TransformerConfig,
     positions the causal mask hides from every later query, and the next
     chunk (which starts at the first rejected position) overwrites them
     before attending — the write-then-mask chunk contract from chunked
-    prefill.  Composes with GQA and the int8 KV cache; sliding-window
-    ring caches are refused (draft chunks would need window+draft_k ring
-    headroom) as is sampling (temperature speculation needs rejection
-    sampling, not implemented).
+    prefill.  Composes with GQA, the int8 KV cache, and sliding-window
+    ring caches (requiring ``config.prefill_chunk >= draft_k`` so draft
+    writes never evict still-attended ring slots); sampling is refused
+    (temperature speculation needs rejection sampling, not implemented).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if draft_k < 2:
         raise ValueError("draft_k must be >= 2 (k-1 drafts + 1 bonus)")
-    if config.window_size is not None:
+    if config.window_size is not None and config.prefill_chunk < draft_k:
+        # Ring soundness: a draft chunk writes up to draft_k slots ahead,
+        # evicting position p - (window + prefill_chunk - 1) when it
+        # writes p.  With prefill_chunk >= draft_k the evicted position
+        # is always OUTSIDE every remaining query's window (current
+        # chunk's earliest query included) — smaller chunks would evict
+        # keys still attended, which no rollback can restore.
         raise ValueError(
-            "speculative decoding does not compose with sliding-window "
-            "ring caches (draft chunks would overrun the ring); use "
-            "make_generate_fn")
+            f"speculative decoding over a sliding-window ring needs "
+            f"config.prefill_chunk >= draft_k ({config.prefill_chunk} < "
+            f"{draft_k}): the ring is sized window + prefill_chunk - 1")
     model = Transformer(config)
 
     @jax.jit
@@ -212,12 +218,15 @@ def make_speculative_generate_fn(config: TransformerConfig,
         B, Lp = prompt.shape
         if Lp < 2:
             raise ValueError("prompt-lookup drafting needs prompt_len >= 2")
-        # the final iteration (n = max_new_tokens - 1) writes draft
-        # positions up to Lp + max_new_tokens + draft_k - 3, which must
-        # stay <= max_seq_len - 1: a full cache wraps slot = pos % S at
+        # FULL caches only: the final iteration (n = max_new_tokens - 1)
+        # writes draft positions up to Lp + max_new_tokens + draft_k - 3,
+        # which must stay <= max_seq_len - 1 — slot = pos % S wraps at
         # max_seq_len and silently EVICTS prompt token 0's K/V before the
-        # same call attends
-        if Lp + max_new_tokens - 2 + draft_k > config.max_seq_len:
+        # same call attends.  Windowed rings wrap BY DESIGN (eviction
+        # safety is the prefill_chunk >= draft_k build-time guard) and
+        # decode indefinitely.
+        if config.window_size is None and \
+                Lp + max_new_tokens - 2 + draft_k > config.max_seq_len:
             raise ValueError(
                 f"prompt ({Lp}) + max_new_tokens ({max_new_tokens}) + "
                 f"draft_k ({draft_k}) headroom exceeds max_seq_len "

@@ -589,3 +589,46 @@ class TestSpeculativeDecode:
         fn = make_generate_fn(cfg, 10)
         ref = np.asarray(fn(params, p4, jax.random.PRNGKey(0)))
         np.testing.assert_array_equal(got, ref)
+
+
+class TestSpeculativeWithWindow:
+    """Speculative decoding over the sliding-window RING cache: sound when
+    prefill_chunk >= draft_k (draft writes never evict still-attended
+    slots); tokens must stay exact vs vanilla windowed greedy, including
+    generations that wrap the ring many times and run past max_seq_len."""
+
+    def _check(self, cfg, prompt, steps, k):
+        from k8s_tpu.models.decode import make_speculative_generate_fn
+
+        params = init_params(cfg, prompt_len=prompt.shape[1])
+        got = np.asarray(
+            make_speculative_generate_fn(cfg, steps, draft_k=k)(
+                params, prompt))
+        ref = np.asarray(make_generate_fn(cfg, steps)(
+            params, prompt, jax.random.PRNGKey(0)))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_windowed_exact_with_ring_wraps(self):
+        cfg = tiny(window_size=8, prefill_chunk=4)
+        prompt = (jnp.arange(12, dtype=jnp.int32).reshape(2, 6) * 11) % 61
+        self._check(cfg, prompt, 20, k=4)  # 20 tokens through an 11-slot ring
+
+    def test_windowed_past_max_seq_len(self):
+        # windowed spec decode is unbounded by max_seq_len, like vanilla
+        cfg = tiny(window_size=6, prefill_chunk=3, max_seq_len=16)
+        prompt = (jnp.arange(12, dtype=jnp.int32).reshape(2, 6) * 7) % 61
+        self._check(cfg, prompt, 16, k=3)  # 6 + 16 > 16
+
+    def test_windowed_gqa_int8_composition(self):
+        cfg = tiny(window_size=8, prefill_chunk=4, kv_heads=2,
+                   kv_cache_dtype="int8")
+        prompt = (jnp.arange(14, dtype=jnp.int32).reshape(2, 7) * 5) % 61
+        self._check(cfg, prompt, 12, k=4)
+
+    def test_small_chunk_refused_at_build_time(self):
+        from k8s_tpu.models.decode import make_speculative_generate_fn
+
+        with pytest.raises(ValueError, match="prefill_chunk >= draft_k"):
+            make_speculative_generate_fn(tiny(window_size=8,
+                                              prefill_chunk=2), 8,
+                                         draft_k=4)
