@@ -1,0 +1,87 @@
+"""bench.py Recorder persistence rules: what may enter the last-good
+on-hardware record decides what evidence a relay-outage round can present.
+Locked down here without touching a device (the Recorder is pure file+dict
+machinery)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture()
+def lastgood(tmp_path):
+    return str(tmp_path / "LASTGOOD.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ("BENCH_NO_PERSIST", "BENCH_ALLOW_SINGLE_REPEAT"):
+        monkeypatch.delenv(k, raising=False)
+
+
+class TestRepeatsGate:
+    def test_single_repeat_never_persists(self, lastgood):
+        r = bench.Recorder(lastgood)
+        r.record("transformer", {"tokens_per_sec_per_chip": 1.0,
+                                 "repeats": 1},
+                 on_hardware=True, device_kind="TPU v5e")
+        assert "transformer" not in r.last_good["benchmarks"]
+        assert not os.path.exists(lastgood)
+        # the fresh result is still available to build_output
+        got, stale = r.get("transformer", allow_stale=True)
+        assert got["repeats"] == 1 and not stale
+
+    def test_missing_repeats_key_treated_as_single(self, lastgood):
+        r = bench.Recorder(lastgood)
+        r.record("decode", {"tokens_per_sec_per_chip": 9.9},
+                 on_hardware=True)
+        assert "decode" not in r.last_good["benchmarks"]
+
+    def test_override_flag_persists_single_repeat(self, lastgood,
+                                                  monkeypatch):
+        monkeypatch.setenv("BENCH_ALLOW_SINGLE_REPEAT", "1")
+        r = bench.Recorder(lastgood)
+        r.record("transformer", {"tokens_per_sec_per_chip": 2.0,
+                                 "repeats": 1}, on_hardware=True)
+        assert "transformer" in r.last_good["benchmarks"]
+
+    def test_multi_repeat_persists_with_provenance(self, lastgood):
+        r = bench.Recorder(lastgood)
+        r.record("resnet50", {"value": 5.0, "repeats": 3},
+                 on_hardware=True, device_kind="TPU v5e")
+        disk = json.load(open(lastgood))
+        rec = disk["benchmarks"]["resnet50"]
+        assert rec["repeats"] == 3
+        assert rec["measured_at"] and rec["device_kind"] == "TPU v5e"
+
+    def test_no_persist_env_blocks_hardware_write(self, lastgood,
+                                                  monkeypatch):
+        monkeypatch.setenv("BENCH_NO_PERSIST", "1")
+        r = bench.Recorder(lastgood)
+        r.record("resnet50", {"value": 5.0, "repeats": 3},
+                 on_hardware=True)
+        assert not os.path.exists(lastgood)
+
+
+class TestSchemaGuard:
+    def test_stale_record_missing_required_keys_reads_as_absent(
+            self, lastgood):
+        # a record written by OLDER code (schema drift) must read as
+        # absent, not KeyError inside die()
+        with open(lastgood, "w") as f:
+            json.dump({"benchmarks": {"decode_depth": {"old": 1}}}, f)
+        r = bench.Recorder(lastgood)
+        got, stale = r.get("decode_depth", allow_stale=True)
+        assert got is None and not stale
+
+    def test_round5_record_names_have_required_keys(self):
+        # every battery item that persists must be consumable later
+        for name in ("resnet50", "transformer", "decode", "vit",
+                     "decode_depth"):
+            assert name in bench._REQUIRED_KEYS, name
