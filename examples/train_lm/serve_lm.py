@@ -41,10 +41,12 @@ def parse_args(argv=None):
                    help="stream the prompt through the cache in "
                    "config.prefill_chunk-token chunks")
     p.add_argument("--speculative", type=int, default=0, metavar="K",
-                   help="greedy speculative decoding with prompt-lookup "
-                   "drafting: verify K-1 drafted tokens per model call "
-                   "(output identical to greedy; fewer model calls on "
-                   "repetitive text)")
+                   help="speculative decoding with prompt-lookup "
+                   "drafting: verify K-1 drafted tokens per model call. "
+                   "Greedy output is identical to plain greedy; with "
+                   "--temperature > 0 tokens are rejection-sampled to "
+                   "the exact sampling distribution. Fewer model calls "
+                   "on repetitive text either way")
     p.add_argument("--kv_cache", choices=["model", "int8"], default="model",
                    help="int8 stores the KV cache as per-vector-scaled "
                    "int8 — half the per-token cache reads, ~quantization-"
@@ -66,12 +68,11 @@ def main(argv=None) -> int:
                          "search yet; drop one of the two flags")
     if args.top_k < 0:
         raise SystemExit(f"--top_k must be >= 0, got {args.top_k}")
-    if args.speculative > 0 and (
-            args.beam > 0 or args.temperature != 0.0 or args.top_k > 0
-            or args.chunked_prefill):
+    if args.speculative > 0 and (args.beam > 0 or args.chunked_prefill):
         raise SystemExit(
-            "--speculative is greedy-only and does its own prefill; drop "
-            "--beam/--temperature/--top_k/--chunked_prefill")
+            "--speculative does its own prefill and replaces beam "
+            "scoring; drop --beam/--chunked_prefill (temperature/top_k "
+            "compose via rejection sampling)")
     if args.speculative == 1:
         raise SystemExit("--speculative must be >= 2 (K-1 drafted tokens "
                          "+ 1 bonus per call); 0 disables")
@@ -108,10 +109,16 @@ def main(argv=None) -> int:
 
     eos = args.eos if args.eos >= 0 else None
     if args.speculative > 0:
+        if args.top_k > 0 and args.temperature == 0.0:
+            log.warning("--top_k %d has no effect at --temperature 0 "
+                        "(greedy argmax); pass --temperature > 0 to "
+                        "sample", args.top_k)
         fn = decode_lib.make_speculative_generate_fn(
             config, args.max_new_tokens, draft_k=args.speculative,
-            eos_id=eos, return_stats=True)
-        out, stats = fn(params, prompt)
+            eos_id=eos, temperature=args.temperature,
+            top_k=(args.top_k or None) if args.temperature > 0 else None,
+            return_stats=True)
+        out, stats = fn(params, prompt, jax.random.PRNGKey(args.seed))
         log.info("speculative: %.2f tokens/model-call over %d calls",
                  float(stats["tokens_per_call"]),
                  int(stats["model_calls"]))

@@ -30,6 +30,23 @@ import jax.numpy as jnp
 from k8s_tpu.models.transformer import Transformer, TransformerConfig
 
 
+def _process_logits(logits, temperature: float, top_k: Optional[int]):
+    """Temperature/top-k-processed logits (f32): the softmax of THIS is
+    the sampling distribution — the single definition shared by vanilla
+    sampling and speculative rejection sampling, which must match it
+    EXACTLY."""
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        # clamp the large side: top_k >= vocab is a no-op filter, not a
+        # trace-time shape error (serve_lm lets arbitrary --top_k through)
+        kk = min(top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, kk)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return logits
+
+
 def sample_logits(logits, rng, temperature: float = 0.0,
                   top_k: Optional[int] = None):
     """Sample next tokens from [B, V] logits.
@@ -41,15 +58,7 @@ def sample_logits(logits, rng, temperature: float = 0.0,
     """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits.astype(jnp.float32) / temperature
-    if top_k is not None:
-        if top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        # clamp the large side: top_k >= vocab is a no-op filter, not a
-        # trace-time shape error (serve_lm lets arbitrary --top_k through)
-        kk = min(top_k, logits.shape[-1])
-        kth = jax.lax.top_k(logits, kk)[0][..., -1:]
-        logits = jnp.where(logits < kth, -1e30, logits)
+    logits = _process_logits(logits, temperature, top_k)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -170,22 +179,32 @@ def make_speculative_generate_fn(config: TransformerConfig,
                                  max_new_tokens: int, draft_k: int = 4,
                                  eos_id: Optional[int] = None,
                                  pad_id: int = 0,
+                                 temperature: float = 0.0,
+                                 top_k: Optional[int] = None,
                                  return_stats: bool = False):
-    """Greedy speculative decoding with prompt-lookup drafting:
-    ``generate(params, prompt) -> [B, max_new_tokens]`` (plus a per-call
-    stats dict when ``return_stats``).
+    """Speculative decoding with prompt-lookup drafting:
+    ``generate(params, prompt[, rng]) -> [B, max_new_tokens]`` (plus a
+    per-call stats dict when ``return_stats``).
 
     Each iteration proposes ``draft_k - 1`` continuation tokens by copying
     what followed the most recent earlier occurrence of the current
     2-gram in the row's own context (prompt-lookup decoding — model-free
     drafting, strongest on repetitive/structured text), then VERIFIES the
     whole proposal in ONE ``draft_k``-token cached decode call: position
-    ``i``'s logits depend only on the (correct) chunk prefix, so the
-    longest draft prefix matching the model's own argmax is accepted,
-    plus the model's bonus token after it.  Output is argmax-EXACT with
-    vanilla greedy decoding by construction — speculation changes the
-    number of model calls (one per ``accepted+1`` tokens, amortizing the
-    per-step parameter read decode is bound by), never the tokens.
+    ``i``'s logits depend only on the (correct) chunk prefix.
+
+    - ``temperature == 0`` (default): the longest draft prefix matching
+      the model's own argmax is accepted, plus the model's bonus token.
+      Output is argmax-EXACT with vanilla greedy by construction.
+    - ``temperature > 0``: REJECTION sampling (Leviathan et al.).  The
+      deterministic draft is a point-mass proposal, so draft ``d`` at
+      position ``i`` is accepted with probability ``p_i(d)`` (the model's
+      temperature/top-k sampling distribution); on the first rejection
+      the emitted token is drawn from the renormalized residual — ``p_i``
+      with ``d`` masked out — and when every draft survives, a bonus
+      token is drawn from ``p_{k-1}``.  Each emitted token is therefore
+      distributed EXACTLY as vanilla temperature/top-k sampling; only the
+      number of model calls changes.  Pass ``rng``.
 
     Rejected-draft cache writes need no rollback: their slots carry
     positions the causal mask hides from every later query, and the next
@@ -193,13 +212,18 @@ def make_speculative_generate_fn(config: TransformerConfig,
     before attending — the write-then-mask chunk contract from chunked
     prefill.  Composes with GQA, the int8 KV cache, and sliding-window
     ring caches (requiring ``config.prefill_chunk >= draft_k`` so draft
-    writes never evict still-attended ring slots); sampling is refused
-    (temperature speculation needs rejection sampling, not implemented).
+    writes never evict still-attended ring slots).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if draft_k < 2:
         raise ValueError("draft_k must be >= 2 (k-1 drafts + 1 bonus)")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and temperature == 0.0:
+        raise ValueError("top_k needs temperature > 0 (greedy ignores it)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     if config.window_size is not None and config.prefill_chunk < draft_k:
         # Ring soundness: a draft chunk writes up to draft_k slots ahead,
         # evicting position p - (window + prefill_chunk - 1) when it
@@ -212,12 +236,20 @@ def make_speculative_generate_fn(config: TransformerConfig,
             f"config.prefill_chunk >= draft_k ({config.prefill_chunk} < "
             f"{draft_k}): the ring is sized window + prefill_chunk - 1")
     model = Transformer(config)
+    sampling = temperature > 0.0
+
+    def _proc(logits):
+        # the SHARED processing (one definition with vanilla sampling —
+        # the exactness guarantee is stated against its softmax)
+        return _process_logits(logits, temperature, top_k)
 
     @jax.jit
-    def generate(params, prompt):
+    def generate(params, prompt, rng=None):
         B, Lp = prompt.shape
         if Lp < 2:
             raise ValueError("prompt-lookup drafting needs prompt_len >= 2")
+        if sampling and rng is None:
+            raise ValueError("temperature > 0 needs an rng key")
         # FULL caches only: the final iteration (n = max_new_tokens - 1)
         # writes draft positions up to Lp + max_new_tokens + draft_k - 3,
         # which must stay <= max_seq_len - 1 — slot = pos % S wraps at
@@ -236,7 +268,13 @@ def make_speculative_generate_fn(config: TransformerConfig,
 
         logits, varz = model.apply({"params": params}, prompt,
                                    mode="prefill", mutable=["cache"])
-        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        if sampling:
+            rng, sub = jax.random.split(rng)
+            first = jax.random.categorical(
+                sub, _proc(logits[:, -1]), axis=-1).astype(jnp.int32)
+        else:
+            rng = jax.random.PRNGKey(0) if rng is None else rng
+            first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         seq = jnp.concatenate(
             [prompt.astype(jnp.int32),
              jnp.full((B, max_new_tokens), pad_id, jnp.int32)], axis=1)
@@ -260,7 +298,7 @@ def make_speculative_generate_fn(config: TransformerConfig,
             return jnp.where(valid, toks, last[:, None])
 
         def cond(carry):
-            seq, n, last, done, cache, iters = carry
+            seq, n, last, done, cache, iters, rng = carry
             return jnp.any(~done & (n < max_new_tokens))
 
         def draft_padded(draft):
@@ -270,7 +308,7 @@ def make_speculative_generate_fn(config: TransformerConfig,
                 axis=1)
 
         def body(carry):
-            seq, n, last, done, cache, iters = carry
+            seq, n, last, done, cache, iters, rng = carry
             length = Lp + n                      # next write index per row
             draft = lookup_draft(seq, length, last)          # [B, K-1]
             chunk = jnp.concatenate([last[:, None], draft], axis=1)
@@ -278,12 +316,39 @@ def make_speculative_generate_fn(config: TransformerConfig,
             logits, varz = model.apply(
                 {"params": params, "cache": cache}, chunk,
                 positions=positions, mode="decode", mutable=["cache"])
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
-            # draft[i] is accepted iff it equals the model's own argmax
-            # after consuming the (accepted) chunk prefix 0..i
-            match = (draft == greedy[:, :-1]).astype(jnp.int32)
-            acc = jnp.cumprod(match, axis=1).sum(axis=1)     # [B] 0..K-1
-            bonus = jnp.take_along_axis(greedy, acc[:, None], 1)[:, 0]
+            if sampling:
+                # rejection sampling against the point-mass draft
+                # proposal: accept draft[i] w.p. p_i(draft[i]); on the
+                # first rejection sample the residual (p with the draft
+                # masked — q's mass is only at the draft, so the residual
+                # IS renormalized p without it); all-accepted rows draw
+                # the bonus from the unmasked final distribution
+                rng, ku, kc = jax.random.split(rng, 3)
+                x = _proc(logits)                            # [B, K, V]
+                logp = jax.nn.log_softmax(x, axis=-1)
+                pd = jnp.exp(jnp.take_along_axis(
+                    logp[:, :-1], draft[..., None], 2)[..., 0])  # [B, K-1]
+                u = jax.random.uniform(ku, pd.shape)
+                accept = (u < pd).astype(jnp.int32)
+                acc = jnp.cumprod(accept, axis=1).sum(axis=1)
+                x_acc = jnp.take_along_axis(
+                    x, acc[:, None, None], 1)[:, 0]          # [B, V]
+                d_acc = jnp.take_along_axis(
+                    draft_padded(draft), acc[:, None], 1)[:, 0]
+                rejected = acc < (K - 1)
+                vocab = jnp.arange(x.shape[-1])[None, :]
+                x_res = jnp.where(
+                    rejected[:, None] & (vocab == d_acc[:, None]),
+                    -1e30, x_acc)
+                bonus = jax.random.categorical(
+                    kc, x_res, axis=-1).astype(jnp.int32)
+            else:
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # draft[i] is accepted iff it equals the model's own
+                # argmax after consuming the (accepted) chunk prefix 0..i
+                match = (draft == greedy[:, :-1]).astype(jnp.int32)
+                acc = jnp.cumprod(match, axis=1).sum(axis=1)  # [B] 0..K-1
+                bonus = jnp.take_along_axis(greedy, acc[:, None], 1)[:, 0]
             ar = jnp.arange(K)[None, :]
             emit = jnp.where(ar < acc[:, None], draft_padded(draft),
                              bonus[:, None])                 # [B, K]
@@ -308,10 +373,10 @@ def make_speculative_generate_fn(config: TransformerConfig,
                 emit, jnp.maximum(n_new - 1, 0)[:, None], 1)[:, 0]
             last = jnp.where(n_new > 0, last_new, last)
             return (seq, n + n_new, last, done_next, varz["cache"],
-                    iters + 1)
+                    iters + 1, rng)
 
-        carry = (seq, n, first, done, varz["cache"], iters)
-        seq, n, _, _, _, iters = jax.lax.while_loop(cond, body, carry)
+        carry = (seq, n, first, done, varz["cache"], iters, rng)
+        seq, n, _, _, _, iters, _ = jax.lax.while_loop(cond, body, carry)
         out = seq[:, Lp:]
         if return_stats:
             return out, {
@@ -329,14 +394,17 @@ def make_speculative_generate_fn(config: TransformerConfig,
 @functools.lru_cache(maxsize=32)
 def cached_speculative_fn(config: TransformerConfig, max_new_tokens: int,
                           draft_k: int = 4, eos_id: Optional[int] = None,
-                          pad_id: int = 0):
+                          pad_id: int = 0, temperature: float = 0.0,
+                          top_k: Optional[int] = None):
     """Program-cached :func:`make_speculative_generate_fn` (config is a
     frozen dataclass, so the whole generation config is hashable) — a
     resident server's repeated shapes reuse the executable instead of
     re-tracing per request."""
     return make_speculative_generate_fn(config, max_new_tokens,
                                         draft_k=draft_k, eos_id=eos_id,
-                                        pad_id=pad_id)
+                                        pad_id=pad_id,
+                                        temperature=temperature,
+                                        top_k=top_k)
 
 
 def make_beam_generate_fn(config: TransformerConfig, max_new_tokens: int,

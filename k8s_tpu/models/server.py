@@ -107,15 +107,18 @@ class LmServer:
         spec = opt("speculative", 0, int)
         if spec != 0 and spec < 2:
             raise ValueError("speculative must be >= 2 (0 disables)")
-        if spec > 0 and (temperature != 0.0 or top_k is not None):
-            raise ValueError("speculative generation is greedy-only")
 
         prompt = jnp.asarray(ids)[None, :]
         with self._lock:
             if spec > 0:
+                # temperature/top_k compose via rejection sampling: the
+                # emitted tokens are distributed exactly as vanilla
+                # temperature/top-k sampling
                 fn = decode_lib.cached_speculative_fn(
-                    self.config, max_new, draft_k=spec, eos_id=eos)
-                out = fn(self.params, prompt)
+                    self.config, max_new, draft_k=spec, eos_id=eos,
+                    temperature=temperature,
+                    top_k=top_k if temperature > 0 else None)
+                out = fn(self.params, prompt, jax.random.PRNGKey(seed))
             else:
                 out = decode_lib.generate(
                     self.config, self.params, prompt, max_new,

@@ -116,13 +116,27 @@ class TestLmServer:
         ({"tokens": [999999]}, "outside"),
         ({"text": "x", "max_new_tokens": 0}, "max_new_tokens"),
         ({"text": "x", "speculative": 1}, "speculative"),
-        ({"text": "x", "speculative": 4, "temperature": 0.5}, "greedy-only"),
     ])
     def test_bad_requests_are_400_with_reason(self, server, payload, frag):
         with pytest.raises(urllib.error.HTTPError) as ei:
             _post(server + "/v1/generate", payload)
         assert ei.value.code == 400
         assert frag in json.loads(ei.value.read())["error"]
+
+    def test_speculative_composes_with_sampling(self, server):
+        # rejection sampling: same seed -> same tokens; different seed
+        # -> (with near-certainty on 8 tokens) different tokens
+        a = _post(server + "/v1/generate",
+                  {"text": "the ", "max_new_tokens": 8, "speculative": 3,
+                   "temperature": 1.0, "seed": 5})
+        b = _post(server + "/v1/generate",
+                  {"text": "the ", "max_new_tokens": 8, "speculative": 3,
+                   "temperature": 1.0, "seed": 5})
+        c = _post(server + "/v1/generate",
+                  {"text": "the ", "max_new_tokens": 8, "speculative": 3,
+                   "temperature": 1.0, "seed": 6})
+        assert a == b
+        assert c != a
 
     def test_unknown_path_404(self, server):
         with pytest.raises(urllib.error.HTTPError) as ei:

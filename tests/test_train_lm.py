@@ -273,9 +273,11 @@ class TestTrainLM:
         spec = run_serve("--speculative=4")
         assert spec.stdout == plain.stdout, (spec.stdout, plain.stdout)
         assert "tokens/model-call" in spec.stderr
-        # greedy-only: sampling flags refuse loudly
+        # sampling composes (rejection sampling); beam still refuses
+        ok = run_serve("--speculative=4", "--temperature=0.7", "--seed=3")
+        assert ok.stdout.strip()
         bad = subprocess.run(
             [sys.executable, serve, f"--train_dir={tmp_path}",
-             "--tokens=5,9", "--speculative=4", "--temperature=0.5"],
+             "--tokens=5,9", "--speculative=4", "--beam=2"],
             capture_output=True, text=True, env=env, timeout=120)
-        assert bad.returncode != 0 and "greedy-only" in bad.stderr
+        assert bad.returncode != 0 and "--speculative" in bad.stderr
