@@ -17,6 +17,7 @@ import copy as _copy_mod
 import itertools
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Iterable, Optional
 
@@ -111,6 +112,11 @@ class FakeCluster:
         # fake-mode callers mutate returned dicts freely; they keep the
         # default.
         self._copy = _copy_mod.deepcopy if copy_on_io else (lambda x: x)
+        # Injected per-create latency (seconds): models the apiserver round
+        # trip for benches/tests measuring the operator's creation fan-out.
+        # Slept OUTSIDE the store lock, exactly as concurrent real requests
+        # overlap their RTTs on the wire.
+        self.create_delay_s = 0.0
 
     def _next_rv(self) -> int:
         with self._lock:
@@ -167,6 +173,8 @@ class FakeCluster:
     # -- CRUD ----------------------------------------------------------------
 
     def create(self, resource: GVR, namespace: str, obj: dict) -> dict:
+        if self.create_delay_s:
+            time.sleep(self.create_delay_s)
         with self._lock:
             # A real apiserver never mutates the caller's submitted object;
             # work on a copy so server-assigned fields (uid, rv) don't leak

@@ -12,6 +12,10 @@ from __future__ import annotations
 
 import copy
 import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 
 from k8s_tpu.api.meta import OwnerReference
 from k8s_tpu.client.clientset import Clientset
@@ -22,6 +26,201 @@ FAILED_CREATE_POD_REASON = "FailedCreate"
 SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreate"
 FAILED_DELETE_POD_REASON = "FailedDelete"
 SUCCESSFUL_DELETE_POD_REASON = "SuccessfulDelete"
+
+# -- bounded-concurrency creation layer ---------------------------------------
+#
+# A TFJob on a TPU pod slice means 64-256 worker pods, and one blocking API
+# round trip per pod makes first-sync latency O(replicas x RTT).  The batch
+# APIs below fan a creation wave out over a shared ThreadPoolExecutor so the
+# sync loop scales O(replicas / concurrency) instead.  The apiserver is the
+# explicit sizing target: client-go defaults to 5 qps/10 burst per client but
+# tolerates far more in-flight mutations; 16 matches the priority-and-fairness
+# per-client seat budget magnitude without approaching storm territory.
+
+DEFAULT_CREATE_CONCURRENCY = 16
+
+_shared_executor: ThreadPoolExecutor | None = None
+_shared_executor_lock = threading.Lock()
+
+
+def create_concurrency_from_env() -> int:
+    """K8S_TPU_CREATE_CONCURRENCY, defaulting to DEFAULT_CREATE_CONCURRENCY;
+    values < 1 (or garbage) fall back to the default."""
+    raw = os.environ.get("K8S_TPU_CREATE_CONCURRENCY", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = 0
+    return n if n >= 1 else DEFAULT_CREATE_CONCURRENCY
+
+
+def shared_create_executor() -> ThreadPoolExecutor:
+    """The process-wide creation pool, sized once from the environment.
+    Shared across controls/controllers: total in-flight creates against the
+    apiserver stay bounded no matter how many jobs sync concurrently."""
+    global _shared_executor
+    with _shared_executor_lock:
+        if _shared_executor is None:
+            _shared_executor = ThreadPoolExecutor(
+                max_workers=create_concurrency_from_env(),
+                thread_name_prefix="create-fanout",
+            )
+        return _shared_executor
+
+
+def executor_for_concurrency(concurrency: int | None):
+    """Map a requested create concurrency to an executor:
+
+    - ``None``  -> the shared env-sized pool (production default);
+    - ``1``     -> ``None`` (inline serial; no thread hop for the degenerate
+      case, and the serial baseline the bench compares against);
+    - ``n > 1`` -> a dedicated pool the caller owns (must ``shutdown()``).
+    """
+    if concurrency is None:
+        return shared_create_executor()
+    if concurrency <= 1:
+        return None
+    return ThreadPoolExecutor(max_workers=concurrency,
+                              thread_name_prefix="create-fanout")
+
+
+class _BatchCreateMixin:
+    """Batch-create plumbing shared by the real and fake controls.
+
+    ``_run_create_batch`` runs one callable per object through the control's
+    executor (or inline when serial) and returns ``[(created, exc), ...]``
+    aligned with the input order — partial failures are per-slot data, never
+    an exception, so callers can unwind exactly the expectations whose
+    creates failed while the successful creates' informer ADDs are already
+    in flight."""
+
+    _create_executor = None  # None -> inline serial
+
+    @property
+    def create_width(self) -> int:
+        """Effective in-flight create concurrency: the slow-start initial
+        chunk size (a wedged job's per-sync failure storm is bounded by the
+        pool width, while a wave no larger than the pool stays one round)."""
+        ex = self._create_executor
+        return getattr(ex, "_max_workers", 1) if ex is not None else 1
+
+    def _run_create_batch(self, calls):
+        results: list[tuple[dict | None, Exception | None]]
+        if self._create_executor is None or len(calls) <= 1:
+            results = []
+            for call in calls:
+                try:
+                    results.append((call(), None))
+                except Exception as e:  # noqa: BLE001 - per-slot failure data
+                    results.append((None, e))
+            return results
+
+        def _one(call):
+            try:
+                return (call(), None)
+            except Exception as e:  # noqa: BLE001
+                return (None, e)
+
+        futures = []
+        tail: list[tuple[dict | None, Exception | None]] = []
+        for call in calls:
+            try:
+                futures.append(self._create_executor.submit(_one, call))
+            except RuntimeError as e:
+                # Executor shut down mid-wave: the unsubmitted slots become
+                # per-slot failures so the caller unwinds exactly their
+                # expectations — a wholesale raise here would also unwind the
+                # already-submitted slots, whose informer ADDs are coming.
+                tail.append((None, e))
+        return [f.result() for f in futures] + tail
+
+
+def run_create_wave(expectations, exp_key: str, submit_range, count: int,
+                    metrics, kind: str, describe, initial: int = 1) -> None:
+    """The creation-wave contract shared by the pod/service reconcilers:
+    raise ``count`` expectations up-front, submit creates in slow-start
+    chunks of ``initial``, 2x, 4x, ... (client-go's slowStartBatch: a chunk
+    containing any failure stops further submission, so a hard apiserver
+    rejection costs O(pool-width) calls per retry sync instead of
+    re-storming all N through the shared pool; callers pass the control's
+    ``create_width`` so a wave no larger than the pool stays one round),
+    unwind the expectations of failed and never-submitted
+    slots (no informer ADD will ever decrement them), tolerate AlreadyExists
+    as a stale-cache signal, and re-raise the first real error so the sync
+    retries.  ``submit_range(lo, hi)`` must create slots [lo, hi) and return
+    per-slot ``(created, exc)`` pairs, never raise wholesale — see
+    ``_run_create_batch``.  Callers must finish ALL fallible prep — template
+    assembly, port/env generation, the job-dict snapshot — before calling:
+    nothing between ``expect_creations`` and the submits may raise, or the
+    expectations leak and the job wedges until the TTL.  ``describe(i)``
+    names slot i for logs."""
+    expectations.expect_creations(exp_key, count)
+    t0 = time.monotonic()
+    results: list[tuple[dict | None, Exception | None]] = []
+    try:
+        chunk = max(1, initial)
+        while len(results) < count:
+            lo = len(results)
+            part = submit_range(lo, min(lo + chunk, count))
+            results.extend(part)
+            # Only REAL errors stop the wave: AlreadyExists is a stale
+            # informer cache telling us the object is fine — the remaining
+            # replicas must still be created in this sync, as the old
+            # per-object path did.
+            if any(exc is not None and not _is_already_exists(exc)
+                   for _, exc in part):
+                break
+            chunk *= 2
+    finally:
+        # Slots never submitted (slow-start aborted, or a contract-violating
+        # wholesale raise from submit_range): no create happened for them,
+        # so no informer ADD will ever decrement their expectations.
+        for _ in range(count - len(results)):
+            expectations.creation_observed(exp_key)
+    record_batch_metrics(metrics, kind, results, time.monotonic() - t0)
+    first_error: Exception | None = None
+    for i, (_created, exc) in enumerate(results):
+        if exc is None:
+            continue
+        expectations.creation_observed(exp_key)
+        if _is_already_exists(exc):
+            log.info("%s already exists", describe(i))
+            continue
+        log.warning("create failed for %s: %s", describe(i), exc)
+        if first_error is None:
+            first_error = exc
+    if first_error is not None:
+        raise first_error
+
+
+def _is_already_exists(exc) -> bool:
+    """The one definition of the stale-cache 409 signal: AlreadyExists means
+    the object is fine and the sync proceeds — the wave-abort decision, the
+    per-slot unwind, and the metrics classification must all agree on it."""
+    from k8s_tpu.client import errors as api_errors
+
+    return (isinstance(exc, api_errors.ApiError)
+            and api_errors.is_already_exists(exc))
+
+
+def record_batch_metrics(metrics, kind: str, results, elapsed: float) -> None:
+    """Account one create wave into a controller_metrics dict (no-op when the
+    reconciler runs without metrics, e.g. bare unit-test wiring)."""
+    if not metrics:
+        return
+    gen = metrics["generation"]
+    metrics["create_batch_duration"].labels(gen, kind).observe(elapsed)
+    by_result = {"success": 0, "already_exists": 0, "error": 0}
+    for _, exc in results:
+        if exc is None:
+            by_result["success"] += 1
+        elif _is_already_exists(exc):
+            by_result["already_exists"] += 1
+        else:
+            by_result["error"] += 1
+    for result, n in by_result.items():
+        if n:
+            metrics["creates_total"].labels(gen, kind, result).inc(n)
 
 
 def _validate_controller_ref(ref: OwnerReference) -> None:
@@ -45,10 +244,27 @@ def _pod_from_template(template: dict, controller_ref: OwnerReference) -> dict:
     return pod
 
 
-class RealPodControl:
-    def __init__(self, clientset: Clientset, recorder):
+class RealPodControl(_BatchCreateMixin):
+    def __init__(self, clientset: Clientset, recorder, executor="shared"):
         self.clientset = clientset
         self.recorder = recorder
+        # executor: "shared" (default) -> process-wide pool; None -> serial;
+        # or any ThreadPoolExecutor-alike the caller owns (bench/tests).
+        self._create_executor = (
+            shared_create_executor() if executor == "shared" else executor
+        )
+
+    def create_pods_batch(
+        self, namespace: str, templates: list[dict], controller_obj: dict,
+        controller_ref: OwnerReference,
+    ) -> list[tuple[dict | None, Exception | None]]:
+        """Fan out one create per template with bounded concurrency.
+        Returns (created, exc) per slot, input-ordered."""
+        return self._run_create_batch([
+            (lambda t=t: self.create_pods_with_controller_ref(
+                namespace, t, controller_obj, controller_ref))
+            for t in templates
+        ])
 
     def create_pods_with_controller_ref(
         self, namespace: str, template: dict, controller_obj: dict, controller_ref: OwnerReference
@@ -91,12 +307,27 @@ class RealPodControl:
                                              patch_type="strategic")
 
 
-class RealServiceControl:
+class RealServiceControl(_BatchCreateMixin):
     """service_control.go:69-115."""
 
-    def __init__(self, clientset: Clientset, recorder):
+    def __init__(self, clientset: Clientset, recorder, executor="shared"):
         self.clientset = clientset
         self.recorder = recorder
+        self._create_executor = (
+            shared_create_executor() if executor == "shared" else executor
+        )
+
+    def create_services_batch(
+        self, namespace: str, services: list[dict], controller_obj: dict,
+        controller_ref: OwnerReference,
+    ) -> list[tuple[dict | None, Exception | None]]:
+        """Fan out one create per service with bounded concurrency.
+        Returns (created, exc) per slot, input-ordered."""
+        return self._run_create_batch([
+            (lambda s=s: self.create_services_with_controller_ref(
+                namespace, s, controller_obj, controller_ref))
+            for s in services
+        ])
 
     def create_services_with_controller_ref(
         self, namespace: str, service: dict, controller_obj: dict, controller_ref: OwnerReference
@@ -140,10 +371,18 @@ class RealServiceControl:
                                                  patch_type="strategic")
 
 
-class FakePodControl:
-    """controller.FakePodControl: captures templates/deletions for asserts."""
+class FakePodControl(_BatchCreateMixin):
+    """controller.FakePodControl: captures templates/deletions for asserts.
+
+    Thread-safe: the concurrent creators (create_pods_batch, the per-replica-
+    type reconcile fan-out) hit one fake from many threads, so every capture
+    list append and ``clear()`` runs under a lock.  Batch creates stay inline
+    serial by default (``_create_executor = None``) so per-test capture order
+    is deterministic; the thread-safety matters because the *controller* may
+    call the fake from concurrent reconcile tasks."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.templates: list[dict] = []
         self.controller_refs: list[OwnerReference] = []
         self.delete_pod_names: list[str] = []
@@ -155,26 +394,45 @@ class FakePodControl:
         _validate_controller_ref(controller_ref)
         if self.create_error is not None:
             raise self.create_error
-        self.templates.append(copy.deepcopy(template))
-        self.controller_refs.append(controller_ref)
+        captured = copy.deepcopy(template)
+        with self._lock:
+            self.templates.append(captured)
+            self.controller_refs.append(controller_ref)
         return _pod_from_template(template, controller_ref)
+
+    def create_pods_batch(self, namespace, templates, controller_obj, controller_ref):
+        return self._run_create_batch([
+            (lambda t=t: self.create_pods_with_controller_ref(
+                namespace, t, controller_obj, controller_ref))
+            for t in templates
+        ])
 
     def delete_pod(self, namespace, name, controller_obj):
         if self.delete_error is not None:
             raise self.delete_error
-        self.delete_pod_names.append(name)
+        with self._lock:
+            self.delete_pod_names.append(name)
 
     def patch_pod(self, namespace, name, patch):
-        self.patches.append(patch)
+        with self._lock:
+            self.patches.append(patch)
 
     def clear(self):
-        self.__init__()
+        with self._lock:
+            self.templates = []
+            self.controller_refs = []
+            self.delete_pod_names = []
+            self.patches = []
+            self.create_error = None
+            self.delete_error = None
 
 
-class FakeServiceControl:
-    """service_control.go:117-175."""
+class FakeServiceControl(_BatchCreateMixin):
+    """service_control.go:117-175.  Thread-safe for the same reason as
+    FakePodControl."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.services: list[dict] = []
         self.controller_refs: list[OwnerReference] = []
         self.delete_service_names: list[str] = []
@@ -185,15 +443,31 @@ class FakeServiceControl:
         _validate_controller_ref(controller_ref)
         if self.create_error is not None:
             raise self.create_error
-        self.services.append(copy.deepcopy(service))
-        self.controller_refs.append(controller_ref)
+        captured = copy.deepcopy(service)
+        with self._lock:
+            self.services.append(captured)
+            self.controller_refs.append(controller_ref)
         return copy.deepcopy(service)
 
+    def create_services_batch(self, namespace, services, controller_obj, controller_ref):
+        return self._run_create_batch([
+            (lambda s=s: self.create_services_with_controller_ref(
+                namespace, s, controller_obj, controller_ref))
+            for s in services
+        ])
+
     def delete_service(self, namespace, name, controller_obj):
-        self.delete_service_names.append(name)
+        with self._lock:
+            self.delete_service_names.append(name)
 
     def patch_service(self, namespace, name, patch):
-        self.patches.append(patch)
+        with self._lock:
+            self.patches.append(patch)
 
     def clear(self):
-        self.__init__()
+        with self._lock:
+            self.services = []
+            self.controller_refs = []
+            self.delete_service_names = []
+            self.patches = []
+            self.create_error = None

@@ -49,22 +49,59 @@ class TFJobController:
         pod_control=None,
         service_control=None,
         recorder=None,
+        create_concurrency: int | None = None,
     ):
         self.clientset = clientset
         # async sink: recording is a buffered enqueue, not an API round trip
         # on the reconcile path (client-go EventBroadcaster architecture)
         self.recorder = recorder or AsyncEventRecorder(clientset, CONTROLLER_NAME)
-        self.pod_control = pod_control or RealPodControl(clientset, self.recorder)
-        self.service_control = service_control or RealServiceControl(clientset, self.recorder)
+        # create_concurrency: None -> shared env-sized pool
+        # (K8S_TPU_CREATE_CONCURRENCY, default 16); 1 -> fully serial (the
+        # bench baseline); n -> a dedicated pool this controller owns.
+        from k8s_tpu.controller_v2 import control as control_mod
+
+        if (create_concurrency is None
+                and control_mod.create_concurrency_from_env() == 1):
+            # K8S_TPU_CREATE_CONCURRENCY=1 must mean the documented fully
+            # serial behavior (inline creates AND serial replica types, for
+            # bisecting), not a 1-wide thread pool with concurrent rtypes.
+            create_concurrency = 1
+        self._owned_executors: list = []
+        create_executor = "shared"
+        if create_concurrency is not None and (
+                pod_control is None or service_control is None):
+            # Only build a dedicated pool when a Real*Control below will
+            # actually submit to it — injected controls (tests) bring their
+            # own creation behavior.
+            create_executor = control_mod.executor_for_concurrency(create_concurrency)
+            if create_executor is not None:
+                self._owned_executors.append(create_executor)
+        self.create_concurrency = create_concurrency
+        self.pod_control = pod_control or RealPodControl(
+            clientset, self.recorder, executor=create_executor)
+        self.service_control = service_control or RealServiceControl(
+            clientset, self.recorder, executor=create_executor)
         self.expectations = new_controller_expectations()
         self.enable_gang_scheduling = enable_gang_scheduling
         # (namespace, pdb-name, job-uid) -> minAvailable last created/verified
         self._pdb_cache: dict = {}
         self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v2")
+        # Serializes tfjob.status mutation across concurrent per-replica-type
+        # reconcile tasks (one lock per controller: workers sync different
+        # jobs, so contention is bounded by the rtype fan-out width).
+        self._status_lock = threading.Lock()
+        # Per-replica-type fan-out pool: DISTINCT from the create pool — the
+        # rtype tasks themselves submit create batches, and nesting both on
+        # one saturated executor would deadlock.  Width 4 covers every valid
+        # replica-type combination; serial mode (create_concurrency=1) skips
+        # it entirely.  Lazily created on the first multi-type sync.
+        self._rtype_executor = None
+        self._rtype_executor_lock = threading.Lock()
 
         self.service_reconciler = service_mod.ServiceReconciler(
-            self.service_control, self.expectations
+            self.service_control, self.expectations, metrics=self.metrics,
+            status_lock=self._status_lock,
         )
 
         factory = informer_factory or SharedInformerFactory(clientset.backend)
@@ -99,6 +136,7 @@ class TFJobController:
         self.pod_reconciler = pod_mod.PodReconciler(
             self.pod_control, self.expectations, self.recorder,
             node_lister=self.node_lister,
+            status_lock=self._status_lock, metrics=self.metrics,
         )
 
         # seam overridden by tests (controller_test.go updateStatusHandler)
@@ -200,6 +238,12 @@ class TFJobController:
         self._stop.set()
         self.queue.shut_down()
         self.factory.stop()
+        with self._rtype_executor_lock:
+            if self._rtype_executor is not None:
+                self._rtype_executor.shutdown(wait=False)
+                self._rtype_executor = None
+        for ex in self._owned_executors:
+            ex.shutdown(wait=False)
         close = getattr(self.recorder, "close", None)
         if close:  # drain + terminate the async event sink
             close(timeout=5.0)
@@ -213,6 +257,12 @@ class TFJobController:
         key, shutdown = self.queue.get()
         if shutdown:
             return False
+        # Sampled backlog gauge: one reading per work item keeps the gauge
+        # fresh exactly when the queue is moving (an idle queue stays at its
+        # last — correct — observation of 0).
+        depth = getattr(self.queue, "depth", None)
+        self.metrics["workqueue_depth"].labels(self.metrics["generation"]).set(
+            depth() if depth is not None else len(self.queue))
         try:
             forget = self.sync_tfjob(key)
             if forget:
@@ -252,6 +302,12 @@ class TFJobController:
             # Stash the as-observed status on the sync-local job object (not
             # the controller: workers sync different jobs concurrently).
             tfjob._observed_status = tfjob.status.to_dict()
+            # Sync-scoped memo for get_pods_for_tfjob/get_services_for_tfjob:
+            # guarantees the claim/adoption scan (plus its can_adopt GET)
+            # runs at most once per sync no matter how many callers a sync
+            # grows — today each path calls each getter once, so this is a
+            # guard for future second callers, not a hot-path save.
+            tfjob._sync_cache = {}
             try:
                 validation.validate_v1alpha2_tfjob_spec(tfjob.spec)
             except validation.ValidationError as e:
@@ -344,12 +400,60 @@ class TFJobController:
         if self.enable_gang_scheduling:
             self.sync_pdb(tfjob)
 
-        for rtype, spec in tfjob.spec.tf_replica_specs.items():
-            self.pod_reconciler.reconcile(tfjob, pods, rtype, spec)
-            self.service_reconciler.reconcile(tfjob, services, rtype, spec)
+        self._reconcile_replica_types(tfjob, pods, services)
 
         tfjob.status.last_reconcile_time = now_rfc3339()
         self.update_status_handler(tfjob)
+
+    def _reconcile_replica_types(self, tfjob, pods, services) -> None:
+        """Run the pod+service reconcile pair for every replica type —
+        concurrently across types when there is more than one and the
+        controller is not pinned serial.  Each type's pair stays ordered
+        (pods before services, as the reference does), status mutation is
+        serialized by the shared status lock, and the first task error
+        re-raises so the sync retries."""
+        items = list(tfjob.spec.tf_replica_specs.items())
+
+        def _one(rtype, spec):
+            self.pod_reconciler.reconcile(tfjob, pods, rtype, spec)
+            self.service_reconciler.reconcile(tfjob, services, rtype, spec)
+
+        executor = None
+        if len(items) > 1 and self.create_concurrency != 1:
+            executor = self._get_rtype_executor()
+        if executor is None:  # single type, pinned serial, or shutting down
+            for rtype, spec in items:
+                _one(rtype, spec)
+            return
+
+        futures = [executor.submit(_one, rtype, spec) for rtype, spec in items]
+        first_error = None
+        for (rtype, _spec), f in zip(items, futures):
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001 - collected, first re-raised
+                if first_error is None:
+                    first_error = e
+                else:
+                    # the sync retry only carries the first error; keep the
+                    # rest visible instead of vanishing them
+                    log.warning("reconcile of %s also failed: %s", rtype, e)
+        if first_error is not None:
+            raise first_error
+
+    def _get_rtype_executor(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._rtype_executor_lock:
+            # shutdown() nulls the pool under this lock AFTER setting _stop:
+            # an in-flight sync racing it must not lazily recreate a pool
+            # nobody will ever shut down — it falls back to serial instead.
+            if self._stop.is_set():
+                return None
+            if self._rtype_executor is None:
+                self._rtype_executor = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="rtype-reconcile")
+            return self._rtype_executor
 
     @staticmethod
     def _deadline_exceeded(tfjob) -> bool:
@@ -525,29 +629,50 @@ class TFJobController:
         ]
         return owned + lister.by_index(ORPHAN_INDEX, ns)
 
-    def get_pods_for_tfjob(self, tfjob) -> list[dict]:
-        """getPodsForTFJob (controller_pod.go:174-210)."""
-        from k8s_tpu.controller_v2.ref_manager import PodControllerRefManager
+    @staticmethod
+    def _sync_cached(tfjob, kind: str, compute):
+        """Memoize one claim scan on the sync-local job object.  The cache
+        only exists while sync_tfjob owns the object (set right after
+        conversion), so a stale list can never outlive its sync."""
+        cache = getattr(tfjob, "_sync_cache", None)
+        if cache is None:
+            return compute()
+        if kind not in cache:
+            cache[kind] = compute()
+        return cache[kind]
 
-        selector, can_adopt = self._claim_manager_args(tfjob)
-        pods = self._claim_candidates(self.pod_lister, tfjob)
-        manager = PodControllerRefManager(
-            self.pod_control, tfjob.to_dict(), selector, "TFJob",
-            tfjob.api_version, can_adopt,
-        )
-        return manager.claim_pods(pods)
+    def get_pods_for_tfjob(self, tfjob) -> list[dict]:
+        """getPodsForTFJob (controller_pod.go:174-210), memoized per sync."""
+
+        def _compute():
+            from k8s_tpu.controller_v2.ref_manager import PodControllerRefManager
+
+            selector, can_adopt = self._claim_manager_args(tfjob)
+            pods = self._claim_candidates(self.pod_lister, tfjob)
+            manager = PodControllerRefManager(
+                self.pod_control, tfjob.to_dict(), selector, "TFJob",
+                tfjob.api_version, can_adopt,
+            )
+            return manager.claim_pods(pods)
+
+        return self._sync_cached(tfjob, "pods", _compute)
 
     def get_services_for_tfjob(self, tfjob) -> list[dict]:
-        """getServicesForTFJob (controller_service.go:154-190)."""
-        from k8s_tpu.controller_v2.ref_manager import ServiceControllerRefManager
+        """getServicesForTFJob (controller_service.go:154-190), memoized per
+        sync."""
 
-        selector, can_adopt = self._claim_manager_args(tfjob)
-        services = self._claim_candidates(self.service_lister, tfjob)
-        manager = ServiceControllerRefManager(
-            self.service_control, tfjob.to_dict(), selector, "TFJob",
-            tfjob.api_version, can_adopt,
-        )
-        return manager.claim_services(services)
+        def _compute():
+            from k8s_tpu.controller_v2.ref_manager import ServiceControllerRefManager
+
+            selector, can_adopt = self._claim_manager_args(tfjob)
+            services = self._claim_candidates(self.service_lister, tfjob)
+            manager = ServiceControllerRefManager(
+                self.service_control, tfjob.to_dict(), selector, "TFJob",
+                tfjob.api_version, can_adopt,
+            )
+            return manager.claim_services(services)
+
+        return self._sync_cached(tfjob, "services", _compute)
 
     # -- gang scheduling (restored v1 feature; pkg/trainer/training.go:450-511)
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import threading
 import time
 
 from k8s_tpu.api.v1alpha2 import types
@@ -200,22 +201,33 @@ def pod_failed_permanently(pod: dict, restart_policy: str,
 class PodReconciler:
     """reconcilePods + createNewPod bound to a TFJobController's seams."""
 
-    def __init__(self, pod_control, expectations, recorder, node_lister=None):
+    def __init__(self, pod_control, expectations, recorder, node_lister=None,
+                 status_lock=None, metrics=None):
         self.pod_control = pod_control
         self.expectations = expectations
         self.recorder = recorder
         # node-condition awareness (optional: None degrades to exit codes)
         self.node_lister = node_lister
+        # Serializes tfjob.status mutations when the controller reconciles
+        # replica types concurrently: set_condition is read-modify-write on
+        # the shared conditions list, and replica counters live in one dict.
+        self.status_lock = status_lock or threading.Lock()
+        self.metrics = metrics  # optional controller_metrics dict
 
     def reconcile(
         self, tfjob: types.TFJob, pods: list[dict], rtype: str, spec: types.TFReplicaSpec
     ) -> None:
-        """reconcilePods (controller_pod.go:41-74) + gang-restart extension."""
+        """reconcilePods (controller_pod.go:41-74) + gang-restart extension.
+
+        Creation is a single bounded-concurrency wave per replica type: all
+        missing indices are collected first, their expectations raised once
+        up-front, then created through ``pod_control.create_pods_batch``."""
         rt = rtype.lower()
         pods = filter_pods_for_replica_type(pods, rt)
         replicas = spec.replicas or 1
 
-        status_mod.initialize_replica_statuses(tfjob, rtype)
+        with self.status_lock:
+            status_mod.initialize_replica_statuses(tfjob, rtype)
 
         restarting = False
         if rtype in SPMD_GANG_TYPES:
@@ -223,17 +235,22 @@ class PodReconciler:
 
         if not restarting:
             slices = get_pod_slices(pods, replicas)
+            missing: list[int] = []
             for index, pod_slice in enumerate(slices):
                 if len(pod_slice) > 1:
                     log.warning("too many pods for %s %d", rt, index)
                 elif len(pod_slice) == 0:
-                    self._create_new_pod(tfjob, rt, index, spec)
+                    missing.append(index)
                 elif self._maybe_restart_pod(tfjob, pod_slice[0], rtype, spec):
                     restarting = True
                 else:
-                    status_mod.update_replica_statuses(tfjob, rtype, pod_slice[0])
+                    with self.status_lock:
+                        status_mod.update_replica_statuses(tfjob, rtype, pod_slice[0])
+            if missing:
+                self._create_pods_wave(tfjob, rt, missing, spec)
 
-        status_mod.update_status(tfjob, rtype, replicas)
+        with self.status_lock:
+            status_mod.update_status(tfjob, rtype, replicas)
 
     def _maybe_restart_pod(
         self, tfjob: types.TFJob, pod: dict, rtype: str, spec: types.TFReplicaSpec
@@ -253,27 +270,35 @@ class PodReconciler:
         if pod_failed_permanently(pod, spec.restart_policy,
                                   node_preempted=preempted):
             return False
+        job_dict = self._job_snapshot(tfjob)
         if preempted:
             self.recorder.eventf(
-                tfjob.to_dict(), "Normal", "TPUPreempted",
+                job_dict, "Normal", "TPUPreempted",
                 "Pod %s lost to node preemption/teardown; restarting",
                 pod["metadata"]["name"],
             )
         key = tpu_config.tfjob_key(tfjob)
         name = pod["metadata"]["name"]
         log.info("restarting pod %s (retryable exit code)", name)
-        status_mod.set_condition(
-            tfjob.status,
-            status_mod.new_condition(
-                types.TFJobRestarting,
-                status_mod.TFJOB_RESTARTING_REASON,
-                f"pod {name} exited retryably and is restarting",
-            ),
-        )
-        self.expectations.expect_deletions(
-            gen_expectation_pods_key(key, rtype.lower()), 1
-        )
-        self.pod_control.delete_pod(tfjob.metadata.namespace, name, tfjob.to_dict())
+        with self.status_lock:
+            status_mod.set_condition(
+                tfjob.status,
+                status_mod.new_condition(
+                    types.TFJobRestarting,
+                    status_mod.TFJOB_RESTARTING_REASON,
+                    f"pod {name} exited retryably and is restarting",
+                ),
+            )
+        exp_key = gen_expectation_pods_key(key, rtype.lower())
+        self.expectations.expect_deletions(exp_key, 1)
+        try:
+            self.pod_control.delete_pod(tfjob.metadata.namespace, name, job_dict)
+        except Exception:
+            # A failed delete produces no informer DELETE event, so the raised
+            # expectation must be unwound or the job wedges until the TTL —
+            # the same invariant run_create_wave enforces for creates.
+            self.expectations.deletion_observed(exp_key)
+            raise
         return True
 
     # -- gang restart --------------------------------------------------------
@@ -294,10 +319,11 @@ class PodReconciler:
         if any(pod_failed_permanently(p, policy, node_preempted=pre)
                for p, pre in zip(failed, preempted_flags)):
             return False  # permanent: let update_status mark the job Failed
+        job_dict = self._job_snapshot(tfjob)
         preempted = [p for p, pre in zip(failed, preempted_flags) if pre]
         if preempted:
             self.recorder.eventf(
-                tfjob.to_dict(), "Normal", "TPUPreempted",
+                job_dict, "Normal", "TPUPreempted",
                 "%d gang pod(s) lost to node preemption/teardown",
                 len(preempted),
             )
@@ -306,37 +332,45 @@ class PodReconciler:
             "gang restart for %s %s: %d failed pod(s), tearing down %d pod(s)",
             key, rtype, len(failed), len(pods),
         )
-        status_mod.set_condition(
-            tfjob.status,
-            status_mod.new_condition(
-                types.TFJobRestarting,
-                status_mod.TFJOB_RESTARTING_REASON,
-                f"gang {rtype} restarting: {len(failed)} pod(s) failed retryably",
-            ),
-        )
+        with self.status_lock:
+            status_mod.set_condition(
+                tfjob.status,
+                status_mod.new_condition(
+                    types.TFJobRestarting,
+                    status_mod.TFJOB_RESTARTING_REASON,
+                    f"gang {rtype} restarting: {len(failed)} pod(s) failed retryably",
+                ),
+            )
         self.recorder.eventf(
-            tfjob.to_dict(), "Normal", "GangRestart",
+            job_dict, "Normal", "GangRestart",
             "Restarting whole %s gang (%d pods) after retryable failure", rtype, len(pods),
         )
         exp_key = gen_expectation_pods_key(key, rtype)
         self.expectations.expect_deletions(exp_key, len(pods))
-        for pod in pods:
-            self.pod_control.delete_pod(
-                tfjob.metadata.namespace, pod["metadata"]["name"], tfjob.to_dict()
-            )
+        for i, pod in enumerate(pods):
+            try:
+                self.pod_control.delete_pod(
+                    tfjob.metadata.namespace, pod["metadata"]["name"], job_dict
+                )
+            except Exception:
+                # Unwind this pod's expectation AND every not-yet-submitted
+                # one: no DELETE event will ever decrement them (the already-
+                # deleted pods' events are in flight and stay counted).
+                for _ in range(len(pods) - i):
+                    self.expectations.deletion_observed(exp_key)
+                raise
         return True
 
     # -- creation ------------------------------------------------------------
 
-    def _create_new_pod(
+    def _build_pod_template(
         self, tfjob: types.TFJob, rt: str, index: int, spec: types.TFReplicaSpec
-    ) -> None:
-        """createNewPod (controller_pod.go:99-169)."""
+    ) -> dict:
+        """createNewPod's template assembly (controller_pod.go:99-169),
+        separated from the create so a wave can prepare every template —
+        including the fallible port/env generation — before any expectation
+        is raised."""
         key = tpu_config.tfjob_key(tfjob)
-
-        from k8s_tpu.api import helpers
-
-        controller_ref = helpers.as_owner(tfjob)
 
         labels = tpu_config.gen_labels(key)
         labels[tpu_config.LABEL_REPLICA_TYPE] = rt
@@ -350,11 +384,7 @@ class PodReconciler:
         meta.pop("name", None)
         meta["generateName"] = tpu_config.gen_general_name(key, rt, index) + "-"
 
-        # Everything fallible (port lookup, env generation) happens BEFORE the
-        # expectation is raised: a raise after expect_creations with no create
-        # would leak the expectation and wedge retries.
         env_vars = tpu_config.gen_env_vars(tfjob, rt, index)
-        self.expectations.expect_creations(gen_expectation_pods_key(key, rt), 1)
         for container in template.setdefault("spec", {}).setdefault("containers", []):
             container.setdefault("env", []).extend(copy.deepcopy(env_vars))
 
@@ -370,23 +400,52 @@ class PodReconciler:
             pod_spec["restartPolicy"] = spec.restart_policy
         else:
             pod_spec.setdefault("restartPolicy", "Never")
+        return template
 
-        try:
-            self.pod_control.create_pods_with_controller_ref(
-                tfjob.metadata.namespace, template, tfjob.to_dict(), controller_ref
-            )
-        except Exception as e:
-            # A failed create produces no informer ADD event, so the raised
-            # expectation must be unwound or the job wedges until the TTL
-            # (upstream decrements via CreationObserved on create errors).
-            self.expectations.creation_observed(gen_expectation_pods_key(key, rt))
-            from k8s_tpu.client import errors as api_errors
+    def _create_new_pod(
+        self, tfjob: types.TFJob, rt: str, index: int, spec: types.TFReplicaSpec
+    ) -> None:
+        """Single-pod compatibility shim over the wave path."""
+        self._create_pods_wave(tfjob, rt, [index], spec)
 
-            if isinstance(e, api_errors.ApiError) and api_errors.is_already_exists(e):
-                # Stale informer cache: the pod exists; next sync sees it.
-                log.info("pod for %s %s/%d already exists", key, rt, index)
-                return
-            raise
+    def _create_pods_wave(
+        self, tfjob: types.TFJob, rt: str, indices: list[int], spec: types.TFReplicaSpec
+    ) -> None:
+        """Create every missing replica of one type in a bounded-concurrency
+        wave (contract: control.run_create_wave — expectations raised once
+        up-front, per-slot unwind on failure, first real error re-raised).
+        Failed creates are simply observed-as-missing next sync — the
+        successful slots' informer ADDs are already in flight, so nothing is
+        ever double-created."""
+        key = tpu_config.tfjob_key(tfjob)
+
+        from k8s_tpu.api import helpers
+        from k8s_tpu.controller_v2.control import run_create_wave
+
+        controller_ref = helpers.as_owner(tfjob)
+        # Everything fallible (port lookup, env generation, the job-dict
+        # snapshot) happens BEFORE the expectations are raised: a raise after
+        # expect_creations with no create would leak them and wedge retries.
+        templates = [
+            self._build_pod_template(tfjob, rt, index, spec) for index in indices
+        ]
+        job_dict = self._job_snapshot(tfjob)
+        run_create_wave(
+            self.expectations, gen_expectation_pods_key(key, rt),
+            lambda lo, hi: self.pod_control.create_pods_batch(
+                tfjob.metadata.namespace, templates[lo:hi], job_dict,
+                controller_ref),
+            len(templates), self.metrics, "pod",
+            lambda i: f"pod for {key} {rt}/{indices[i]}",
+            initial=getattr(self.pod_control, "create_width", 1),
+        )
+
+    def _job_snapshot(self, tfjob: types.TFJob) -> dict:
+        """tfjob.to_dict() under the status lock: concurrent replica-type
+        tasks mutate tfjob.status under it, and a dict resized mid-iteration
+        makes an unlocked to_dict() raise RuntimeError."""
+        with self.status_lock:
+            return tfjob.to_dict()
 
 
 # -- informer event handlers (controller_pod.go:237-322) ----------------------

@@ -9,6 +9,7 @@ reference's one-service-per-index contract is preserved.
 from __future__ import annotations
 
 import logging
+import threading
 
 from k8s_tpu.api.v1alpha2 import types
 from k8s_tpu.controller_v2 import tpu_config
@@ -55,9 +56,15 @@ def get_service_slices(services: list[dict], replicas: int) -> list[list[dict]]:
 class ServiceReconciler:
     """reconcileServices + createNewService bound to controller seams."""
 
-    def __init__(self, service_control, expectations):
+    def __init__(self, service_control, expectations, metrics=None,
+                 status_lock=None):
         self.service_control = service_control
         self.expectations = expectations
+        self.metrics = metrics  # optional controller_metrics dict
+        # Shared with PodReconciler: tfjob.status is mutated under it by
+        # concurrent replica-type tasks, so the job-dict snapshot below must
+        # hold it too (an unlocked to_dict() can crash mid-iteration).
+        self.status_lock = status_lock or threading.Lock()
 
     def reconcile(
         self,
@@ -66,37 +73,32 @@ class ServiceReconciler:
         rtype: str,
         spec: types.TFReplicaSpec,
     ) -> None:
-        """controller_service.go:35-64."""
+        """controller_service.go:35-64, with creation batched into one
+        bounded-concurrency wave per replica type (see pod.py counterpart)."""
         rt = rtype.lower()
         services = filter_services_for_replica_type(services, rt)
         replicas = spec.replicas or 1
+        missing: list[int] = []
         for index, svc_slice in enumerate(get_service_slices(services, replicas)):
             if len(svc_slice) > 1:
                 log.warning("too many services for %s %d", rt, index)
             elif len(svc_slice) == 0:
-                self._create_new_service(tfjob, rtype, index, spec)
+                missing.append(index)
+        if missing:
+            self._create_services_wave(tfjob, rtype, missing, spec)
 
-    def _create_new_service(
-        self, tfjob: types.TFJob, rtype: str, index: int, spec: types.TFReplicaSpec
-    ) -> None:
-        """createNewService (controller_service.go:91-149): headless service
-        selecting exactly one replica index."""
+    def _build_service(self, tfjob: types.TFJob, rtype: str, index: int) -> dict:
+        """createNewService's object assembly (controller_service.go:91-149):
+        headless service selecting exactly one replica index.  The fallible
+        port lookup lives here so a wave fails before raising expectations."""
         key = tpu_config.tfjob_key(tfjob)
         rt = rtype.lower()
-
-        from k8s_tpu.api import helpers
-
-        controller_ref = helpers.as_owner(tfjob)
         labels = tpu_config.gen_labels(key)
         labels[tpu_config.LABEL_REPLICA_TYPE] = rt
         labels[tpu_config.LABEL_REPLICA_INDEX] = str(index)
-
         name = tpu_config.gen_general_name(key, rt, index)
-        # Fallible port lookup happens before the expectation is raised (a
-        # raise afterwards would leak it — see pod.py counterpart).
         port = tpu_config.get_port_from_tfjob(tfjob, rtype)
-        self.expectations.expect_creations(gen_expectation_services_key(key, rt), 1)
-        service = {
+        return {
             "metadata": {"name": name, "labels": dict(labels)},
             "spec": {
                 "clusterIP": "None",
@@ -104,20 +106,42 @@ class ServiceReconciler:
                 "ports": [{"name": name[-63:], "port": port}],
             },
         }
-        try:
-            self.service_control.create_services_with_controller_ref(
-                tfjob.metadata.namespace, service, tfjob.to_dict(), controller_ref
-            )
-        except Exception as e:
-            # Unwind the expectation on a failed create (no ADD event will
-            # decrement it); AlreadyExists just means the cache was stale.
-            self.expectations.creation_observed(gen_expectation_services_key(key, rt))
-            from k8s_tpu.client import errors as api_errors
 
-            if isinstance(e, api_errors.ApiError) and api_errors.is_already_exists(e):
-                log.info("service %s already exists", name)
-                return
-            raise
+    def _create_new_service(
+        self, tfjob: types.TFJob, rtype: str, index: int, spec: types.TFReplicaSpec
+    ) -> None:
+        """Single-service compatibility shim over the wave path."""
+        self._create_services_wave(tfjob, rtype, [index], spec)
+
+    def _create_services_wave(
+        self, tfjob: types.TFJob, rtype: str, indices: list[int],
+        spec: types.TFReplicaSpec,
+    ) -> None:
+        """One bounded-concurrency create per missing index via the shared
+        wave contract (control.run_create_wave — expectations raised once
+        up-front, per-slot unwind on failure, first real error re-raised)."""
+        key = tpu_config.tfjob_key(tfjob)
+        rt = rtype.lower()
+
+        from k8s_tpu.api import helpers
+        from k8s_tpu.controller_v2.control import run_create_wave
+
+        controller_ref = helpers.as_owner(tfjob)
+        # All fallible prep (port lookup, the job-dict snapshot) happens
+        # before any expectation is raised (a raise afterwards would leak
+        # it — see pod.py counterpart).
+        service_objs = [self._build_service(tfjob, rtype, i) for i in indices]
+        with self.status_lock:
+            job_dict = tfjob.to_dict()
+        run_create_wave(
+            self.expectations, gen_expectation_services_key(key, rt),
+            lambda lo, hi: self.service_control.create_services_batch(
+                tfjob.metadata.namespace, service_objs[lo:hi], job_dict,
+                controller_ref),
+            len(service_objs), self.metrics, "service",
+            lambda i: f"service {service_objs[i]['metadata']['name']}",
+            initial=getattr(self.service_control, "create_width", 1),
+        )
 
 
 def make_service_event_handlers(controller):

@@ -30,6 +30,8 @@ class LocalCluster:
         threadiness: int = 1,
         resync_period_s: float = RESYNC_S,
         backend_mode: str = "fake",
+        create_concurrency: int | None = None,
+        create_delay_s: float = 0.0,
     ):
         # threadiness mirrors the operator flag (reference default: v1 runs
         # 1 worker, v2's flag defaults to 2 — options.go:42, server.go:95)
@@ -52,6 +54,9 @@ class LocalCluster:
         else:
             raise ValueError(f"unknown backend_mode {backend_mode!r} "
                              "(expected 'fake' or 'rest')")
+        if create_delay_s and hasattr(self.backend, "create_delay_s"):
+            # fake-backend RTT injection for creation fan-out benches
+            self.backend.create_delay_s = create_delay_s
         self.clientset = Clientset(self.backend)
         self.namespace = namespace
         self.version = version
@@ -75,6 +80,7 @@ class LocalCluster:
                 self.clientset,
                 informer_factory=factory,
                 enable_gang_scheduling=enable_gang_scheduling,
+                create_concurrency=create_concurrency,
             )
         self.kubelet = KubeletSimulator(
             self.clientset, namespace, **(kubelet_kwargs or {})

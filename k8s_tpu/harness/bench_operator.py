@@ -31,6 +31,38 @@ def _tpu_job(name: str, namespace: str, replicas: int) -> dict:
     return tfjob_template(name, namespace, tpu=True, tpu_replicas=replicas)
 
 
+def _worker_gang_job(name: str, namespace: str, replicas: int) -> dict:
+    """Worker gang of arbitrary size for the slice-scale fan-out scenario:
+    a single v5e slice tops out at 64 hosts (genjob.v5e_slice_for_hosts),
+    but the creation fan-out under test is type-agnostic — a 256-replica
+    Worker gang exercises exactly the same create path a multislice TPU
+    deployment would, without faking an impossible topology."""
+    return {
+        "apiVersion": "kubeflow.org/v1alpha2",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": replicas,
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "tensorflow",
+                                    "image": "k8s-tpu/smoke:latest",
+                                    "ports": [{"name": "tfjob-port",
+                                               "containerPort": 2222}],
+                                }
+                            ]
+                        }
+                    },
+                }
+            }
+        },
+    }
+
+
 def _all_replicas_running(job: dict) -> bool:
     """The metric's definition is ALL replica pods Running; the controller's
     startTime is set exactly when running == replicas
@@ -39,19 +71,36 @@ def _all_replicas_running(job: dict) -> bool:
     return bool((job.get("status") or {}).get("startTime"))
 
 
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank quantile over raw samples (no interpolation surprises
+    at the tiny sample counts a bench round produces)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
 def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
                         timeout_s: float = 60.0,
                         threadiness: int = 1,
                         resync_period_s: float = 5.0,
-                        backend_mode: str = "fake") -> dict:
+                        backend_mode: str = "fake",
+                        create_delay_s: float = 0.0,
+                        create_concurrency: int | None = None) -> dict:
     """Submit ``jobs`` gang jobs back to back; measure each
-    submit→all-replicas-Running latency and the aggregate throughput."""
+    submit→all-replicas-Running latency and the aggregate throughput.
+
+    ``create_delay_s`` injects a per-create RTT into the fake backend (the
+    apiserver-round-trip model the slice-scale comparison needs) and
+    ``create_concurrency`` pins the controller's creation fan-out width
+    (1 = the serial baseline, None = production default)."""
     if jobs < 1:
         raise ValueError("jobs must be >= 1")
     from k8s_tpu.e2e.local import LocalCluster
 
     ns = "bench"
     latencies = []
+    sync_latencies: list[float] = []
     # runtime long enough that jobs stay Running while we poll
     # resync default: 5 s. The e2e default (0.1 s) re-enqueues EVERY job
     # 10x/s — at 200+ concurrent jobs the resync storm, not event handling,
@@ -60,12 +109,28 @@ def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
     # backend_mode="rest" runs the whole bench over the wire protocol
     # (HTTP apiserver fixture): the deployed-operator data path, including
     # serialization and watch streaming costs the fake backend skips.
-    with LocalCluster(version="v1alpha2", namespace=ns,
+    lc = LocalCluster(version="v1alpha2", namespace=ns,
                       enable_gang_scheduling=True,
                       kubelet_kwargs={"default_runtime_s": timeout_s},
                       threadiness=threadiness,
                       resync_period_s=resync_period_s,
-                      backend_mode=backend_mode) as lc:
+                      backend_mode=backend_mode,
+                      create_concurrency=create_concurrency,
+                      create_delay_s=create_delay_s)
+    # Per-sync latency accounting: wrap the sync seam before workers start
+    # so every pass lands one raw sample (histogram buckets can't give
+    # exact p99 at bench sample counts).
+    _orig_sync = lc.controller.sync_tfjob
+
+    def _timed_sync(key):
+        t0 = time.perf_counter()
+        try:
+            return _orig_sync(key)
+        finally:
+            sync_latencies.append(time.perf_counter() - t0)
+
+    lc.controller.sync_tfjob = _timed_sync
+    with lc:
         # Watch-based readiness tracking: the poller's list() deep-copied
         # every job per 10 ms tick, which at 300+ concurrent jobs consumed
         # the core being measured.  A watch costs one event per status
@@ -104,12 +169,142 @@ def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
         raise RuntimeError(
             f"{len(pending)} of {jobs} jobs never reached Running in "
             f"{timeout_s}s: {sorted(pending)[:5]}")
+    syncs = sorted(sync_latencies)
     return {
         "jobs": jobs,
         "replicas": replicas,
         "time_to_ready_p50_s": round(statistics.median(latencies), 4),
         "time_to_ready_max_s": round(max(latencies), 4),
         "jobs_per_sec": round(jobs / elapsed_all, 2),
+        "sync_count": len(syncs),
+        "sync_latency_p50_s": round(_quantile(syncs, 0.50), 4),
+        "sync_latency_p99_s": round(_quantile(syncs, 0.99), 4),
+    }
+
+
+def _slice_sync_round(replicas: int, create_latency_s: float,
+                      concurrency: int | None) -> dict:
+    """One cold first-sync of a single <replicas>-worker gang job against a
+    fresh fake cluster with an injected per-create RTT: the pure control-
+    plane fan-out cost, no kubelet/informer noise.  Returns the round's
+    create count and sync wall time."""
+    from k8s_tpu.client.clientset import Clientset
+    from k8s_tpu.client.fake import FakeCluster
+    from k8s_tpu.client.gvr import PODS, SERVICES
+    from k8s_tpu.client.informer import SharedInformerFactory
+    from k8s_tpu.client.record import FakeRecorder
+    from k8s_tpu.controller_v2.controller import TFJobController
+
+    ns = "bench"
+    name = "slice-0"
+    fc = FakeCluster()
+    fc.create_delay_s = create_latency_s
+    cs = Clientset(fc)
+    factory = SharedInformerFactory(fc, resync_period=0)
+    tc = TFJobController(
+        cs,
+        informer_factory=factory,
+        enable_gang_scheduling=False,
+        recorder=FakeRecorder(),
+        create_concurrency=concurrency,
+    )
+    tc.update_status_handler = lambda job: None  # no status API writes
+    try:
+        fc.create_delay_s = 0.0  # the job submit itself isn't measured
+        cs.tfjobs_unstructured(ns).create(_worker_gang_job(name, ns, replicas))
+        fc.create_delay_s = create_latency_s
+        stored = cs.tfjobs_unstructured(ns).get(name)
+        # alwaysReady stores: sync directly, no informer threads
+        tc.tfjob_informer.store.replace([stored])
+        t0 = time.perf_counter()
+        ok = tc.sync_tfjob(f"{ns}/{name}")
+        elapsed = time.perf_counter() - t0
+        if not ok:
+            raise RuntimeError("slice-scale sync did not complete")
+        pods = fc.list(PODS, ns)
+        services = fc.list(SERVICES, ns)
+        names = [p["metadata"]["name"] for p in pods]
+        if len(set(names)) != replicas or len(services) != replicas:
+            raise RuntimeError(
+                f"expected {replicas} unique pods + services, got "
+                f"{len(set(names))} pods / {len(services)} services")
+        return {"creates": len(pods) + len(services), "sync_s": elapsed}
+    finally:
+        tc.shutdown()
+
+
+def bench_slice_scale(replicas: int = 256, create_latency_s: float = 0.01,
+                      concurrency: int | None = None, rounds: int = 3,
+                      serial_rounds: int = 1) -> dict:
+    """Slice-scale creation fan-out: 1 job × ``replicas`` workers, fake
+    backend with ``create_latency_s`` injected per create.  Runs the
+    parallel path ``rounds`` times and the serial baseline
+    ``serial_rounds`` times (the serial sync is O(replicas × RTT) — one
+    round of it already costs more wall clock than every parallel round
+    together), reporting creates/sec for both plus p50/p99 sync latency."""
+    from k8s_tpu.controller_v2 import control as control_mod
+
+    if concurrency is None:
+        concurrency = control_mod.create_concurrency_from_env()
+    par = [_slice_sync_round(replicas, create_latency_s, concurrency)
+           for _ in range(max(1, rounds))]
+    ser = [_slice_sync_round(replicas, create_latency_s, 1)
+           for _ in range(max(1, serial_rounds))]
+
+    par_syncs = sorted(r["sync_s"] for r in par)
+    par_creates = sum(r["creates"] for r in par)
+    par_elapsed = sum(r["sync_s"] for r in par)
+    ser_creates = sum(r["creates"] for r in ser)
+    ser_elapsed = sum(r["sync_s"] for r in ser)
+    par_cps = par_creates / par_elapsed if par_elapsed else 0.0
+    ser_cps = ser_creates / ser_elapsed if ser_elapsed else 0.0
+    return {
+        "replicas": replicas,
+        "create_latency_ms": round(create_latency_s * 1e3, 3),
+        "concurrency": concurrency,
+        "rounds": len(par),
+        "creates_per_sec": round(par_cps, 1),
+        "serial_creates_per_sec": round(ser_cps, 1),
+        "creates_speedup": round(par_cps / ser_cps, 2) if ser_cps else 0.0,
+        "sync_latency_p50_s": round(_quantile(par_syncs, 0.50), 4),
+        "sync_latency_p99_s": round(_quantile(par_syncs, 0.99), 4),
+        "serial_sync_latency_p50_s": round(
+            _quantile(sorted(r["sync_s"] for r in ser), 0.50), 4),
+    }
+
+
+def run_slice_scale(args) -> dict:
+    """The --slice-scale scenario: serial-vs-parallel creation fan-out at
+    1×N gang scale PLUS the 20×4 time-to-ready comparison under the same
+    injected create RTT.  Returns one JSON-able dict (bench.py contract:
+    metric/value/unit headline + supporting keys)."""
+    slice_result = bench_slice_scale(
+        replicas=args.slice_replicas,
+        create_latency_s=args.create_latency,
+        rounds=args.slice_rounds,
+    )
+    ttr = {}
+    for mode, conc in (("parallel", None), ("serial", 1)):
+        r = bench_time_to_ready(
+            args.jobs, args.replicas, args.timeout,
+            threadiness=args.threadiness, resync_period_s=args.resync,
+            backend_mode="fake", create_delay_s=args.create_latency,
+            create_concurrency=conc)
+        ttr[mode] = r
+    p50_par = ttr["parallel"]["time_to_ready_p50_s"]
+    p50_ser = ttr["serial"]["time_to_ready_p50_s"]
+    return {
+        "metric": "operator_creates_per_sec",
+        "value": slice_result["creates_per_sec"],
+        "unit": "creates/sec",
+        **slice_result,
+        "ttr_jobs": args.jobs,
+        "ttr_replicas": args.replicas,
+        "ttr_p50_s": p50_par,
+        "ttr_serial_p50_s": p50_ser,
+        "ttr_speedup": round(p50_ser / p50_par, 2) if p50_par else 0.0,
+        "ttr_sync_latency_p50_s": ttr["parallel"]["sync_latency_p50_s"],
+        "ttr_sync_latency_p99_s": ttr["parallel"]["sync_latency_p99_s"],
     }
 
 
@@ -125,12 +320,41 @@ def main(argv=None) -> int:
     p.add_argument("--backend", choices=["fake", "rest"], default="fake",
                    help="fake = in-process store; rest = full HTTP wire "
                    "protocol through the apiserver fixture")
+    p.add_argument("--slice-scale", action="store_true",
+                   help="run the slice-scale fan-out scenario (1 job x "
+                   "--slice-replicas workers, serial vs parallel creation, "
+                   "plus the --jobs x --replicas time-to-ready comparison) "
+                   "and emit one JSON line")
+    p.add_argument("--slice-replicas", type=int, default=256,
+                   help="gang size for the 1-job slice-scale scenario")
+    p.add_argument("--create-latency", type=float, default=None,
+                   help="injected per-create RTT seconds (fake backend only; "
+                   "default 0.01 under --slice-scale, 0 otherwise)")
+    p.add_argument("--create-concurrency", type=int, default=None,
+                   help="pin the controller's creation fan-out width "
+                   "(1 = fully serial legacy path; default: "
+                   "K8S_TPU_CREATE_CONCURRENCY or 16)")
+    p.add_argument("--slice-rounds", type=int, default=3,
+                   help="parallel-path rounds for p50/p99 sync latency")
     args = p.parse_args(argv)
 
+    if args.slice_scale:
+        if args.backend != "fake":
+            p.error("--slice-scale requires --backend fake: the injected "
+                    "per-create RTT only exists on the fake backend")
+        if args.create_latency is None:
+            args.create_latency = 0.01
+        print(json.dumps(run_slice_scale(args)))
+        return 0
+
+    if args.create_latency and args.backend != "fake":
+        p.error("--create-latency only exists on the fake backend")
     result = bench_time_to_ready(args.jobs, args.replicas, args.timeout,
                                  threadiness=args.threadiness,
                                  resync_period_s=args.resync,
-                                 backend_mode=args.backend)
+                                 backend_mode=args.backend,
+                                 create_delay_s=args.create_latency or 0.0,
+                                 create_concurrency=args.create_concurrency)
     print(json.dumps({"metric": "tfjob_time_to_ready_p50",
                       "value": result["time_to_ready_p50_s"],
                       "unit": "s", "backend": args.backend, **result}))

@@ -77,6 +77,11 @@ class NativeRateLimitingQueue:
     def __len__(self) -> int:
         return self._lib.rlq_len(self._h)
 
+    def depth(self) -> int:
+        """Ready backlog for the workqueue_depth gauge (same contract as the
+        pure-Python WorkQueue.depth)."""
+        return len(self)
+
     def __del__(self):
         h, self._h = getattr(self, "_h", None), None
         if h and getattr(self, "_lib", None):

@@ -286,5 +286,23 @@ def controller_metrics(generation: str, registry: Optional[Registry] = None) -> 
             "Rate-limited requeues of a job key.",
             ("generation",),
         ),
+        # -- reconcile fan-out telemetry (parallel create waves) --------------
+        "workqueue_depth": r.gauge(
+            "tfjob_workqueue_depth",
+            "Ready backlog of the controller workqueue, sampled per work "
+            "item (client-go workqueue depth analogue).",
+            ("generation",),
+        ),
+        "create_batch_duration": r.histogram(
+            "tfjob_create_batch_duration_seconds",
+            "Wall time of one bounded-concurrency create wave (all missing "
+            "replicas of one type).",
+            ("generation", "kind"),
+        ),
+        "creates_total": r.counter(
+            "tfjob_creates_total",
+            "Pod/service creates issued by the fan-out layer, by result.",
+            ("generation", "kind", "result"),
+        ),
         "generation": generation,
     }

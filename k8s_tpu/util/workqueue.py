@@ -79,8 +79,12 @@ class BucketRateLimiter:
                 return 0.0
             return -self._tokens / self.qps
 
-    def forget(self, item: Hashable) -> None:  # token buckets don't track items
-        pass
+    def forget(self, item: Hashable) -> None:
+        """Deliberate no-op: a token bucket has no per-item state to reset —
+        consumed tokens are gone regardless of whether the item later
+        succeeded.  Composite limiters (MaxOfRateLimiter) therefore only
+        reset their *backoff* member on forget; callers must not expect
+        forget() to refund bucket tokens."""
 
     def num_requeues(self, item: Hashable) -> int:
         return 0
@@ -96,6 +100,10 @@ class MaxOfRateLimiter:
         return max(l.when(item) for l in self.limiters)
 
     def forget(self, item: Hashable) -> None:
+        # Fans out to every child, but only the per-item backoff member
+        # actually resets: BucketRateLimiter.forget is a documented no-op
+        # (no per-item state), so "forgetting" a key in the default
+        # composite limiter means exactly "clear its exponential backoff".
         for l in self.limiters:
             l.forget(item)
 
@@ -168,6 +176,13 @@ class WorkQueue:
     def __len__(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def depth(self) -> int:
+        """Ready backlog: items queued and waiting for a worker.  Excludes
+        in-flight (processing) items and delayed items still on the timer
+        heap — the number a ``workqueue_depth`` gauge should export, matching
+        client-go's workqueue depth metric."""
+        return len(self)
 
 
 class DelayingQueue(WorkQueue):

@@ -13,6 +13,32 @@ def test_repo_config_loads_and_declares_ladder():
     assert any(w["name"] == "tpujob-e2e" for w in cfg["workflows"])
 
 
+def test_repo_config_declares_nongating_bench_smoke():
+    """The slice-scale operator microbench rides the ladder as advisory
+    trend data: present, one-JSON-line contract, but never gating."""
+    cfg = ci.load_config()
+    smoke = cfg["tiers"]["bench_smoke"]
+    assert smoke["gating"] is False
+    assert "bench_operator" in smoke["entry"]
+    assert "--slice-scale" in smoke["entry"]
+
+
+def test_nongating_tier_failure_does_not_fail_ladder(tmp_path):
+    cfg = {
+        "tiers": {
+            "smoke": {"entry": "python -c import(sys)", "gating": False},
+            "gated": {"entry": "python -c import(sys)"},
+        },
+        "workflows": [],
+        "artifacts": {"junit_dir": os.fspath(tmp_path)},
+    }
+    # same failing command: ignored when non-gating, fatal when gating
+    assert ci.run_tier(cfg, "smoke")
+    assert not ci.run_tier(cfg, "gated")
+    # the junit artifact still records the real failure for trend tooling
+    assert "failure" in (tmp_path / "junit_ci-smoke.xml").read_text()
+
+
 def test_run_tier_pass_and_junit(tmp_path):
     cfg = {
         "tiers": {"ok": {"entry": "python -c pass"},

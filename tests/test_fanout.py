@@ -1,0 +1,490 @@
+"""Parallel reconcile fan-out tests: bounded-concurrency pod/service
+creation (controller_v2.control batch APIs), thread-safe fake controls,
+per-replica-type concurrency, expectations accounting under partial
+failure, and the slice-scale bench's tier-1 variant."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from k8s_tpu.api import v1alpha2
+from k8s_tpu.api.meta import ObjectMeta, OwnerReference
+from k8s_tpu.client import Clientset, FakeCluster
+from k8s_tpu.client.gvr import PODS, SERVICES
+from k8s_tpu.client.informer import SharedInformerFactory
+from k8s_tpu.client.record import FakeRecorder
+from k8s_tpu.controller_v2 import tpu_config
+from k8s_tpu.controller_v2.control import (
+    FakePodControl,
+    FakeServiceControl,
+    create_concurrency_from_env,
+    executor_for_concurrency,
+)
+from k8s_tpu.controller_v2.controller import TFJobController
+from k8s_tpu.controller_v2.pod import gen_expectation_pods_key
+from k8s_tpu.controller_v2.service import gen_expectation_services_key
+
+NS = "default"
+JOB = "fanout-job"
+KEY = f"{NS}/{JOB}"
+
+OWNER_REF = OwnerReference(
+    api_version="kubeflow.org/v1alpha2", kind="TFJob", name=JOB,
+    uid="uid-1", controller=True,
+)
+
+POD_TEMPLATE = {
+    "spec": {
+        "containers": [
+            {
+                "name": "tensorflow",
+                "image": "img",
+                "ports": [{"name": "tfjob-port", "containerPort": 2222}],
+            }
+        ]
+    }
+}
+
+
+def make_tfjob(worker=0, ps=0):
+    specs = {}
+    if worker:
+        specs["Worker"] = v1alpha2.TFReplicaSpec(replicas=worker,
+                                                 template=POD_TEMPLATE)
+    if ps:
+        specs["PS"] = v1alpha2.TFReplicaSpec(replicas=ps, template=POD_TEMPLATE)
+    return v1alpha2.TFJob(
+        metadata=ObjectMeta(name=JOB, namespace=NS, uid="uid-1"),
+        spec=v1alpha2.TFJobSpec(tf_replica_specs=specs),
+    )
+
+
+def build_controller(tfjob, create_concurrency=None, pod_control=None,
+                     service_control=None):
+    """alwaysReady-style controller: stores pre-populated, no threads."""
+    fc = FakeCluster()
+    cs = Clientset(fc)
+    cs.tfjobs(NS).create(tfjob)
+    tc = TFJobController(
+        cs,
+        informer_factory=SharedInformerFactory(fc, resync_period=0),
+        enable_gang_scheduling=False,
+        pod_control=pod_control,
+        service_control=service_control,
+        recorder=FakeRecorder(),
+        create_concurrency=create_concurrency,
+    )
+    tc.tfjob_informer.store.replace([cs.tfjobs_unstructured(NS).get(JOB)])
+    tc.update_status_handler = lambda job: None
+    return tc, fc
+
+
+class TestFakeControlThreadSafety:
+    """Satellite: fakes must be valid under the concurrent creators."""
+
+    N_THREADS = 16
+    N_PER_THREAD = 50
+
+    def _hammer(self, fn):
+        errors = []
+        barrier = threading.Barrier(self.N_THREADS)
+
+        def run():
+            barrier.wait()
+            for _ in range(self.N_PER_THREAD):
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=run) for _ in range(self.N_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+
+    def test_fake_pod_control_concurrent_creates(self):
+        pc = FakePodControl()
+
+        def one():
+            pc.create_pods_with_controller_ref(NS, POD_TEMPLATE, {}, OWNER_REF)
+            pc.delete_pod(NS, "p", {})
+            pc.patch_pod(NS, "p", {"x": 1})
+
+        self._hammer(one)
+        total = self.N_THREADS * self.N_PER_THREAD
+        assert len(pc.templates) == total
+        assert len(pc.controller_refs) == total
+        assert len(pc.delete_pod_names) == total
+        assert len(pc.patches) == total
+        pc.clear()
+        assert pc.templates == [] and pc.delete_pod_names == []
+
+    def test_fake_service_control_concurrent_creates(self):
+        sc = FakeServiceControl()
+        svc = {"metadata": {"name": "s"}, "spec": {"clusterIP": "None"}}
+
+        def one():
+            sc.create_services_with_controller_ref(NS, svc, {}, OWNER_REF)
+            sc.delete_service(NS, "s", {})
+            sc.patch_service(NS, "s", {"x": 1})
+
+        self._hammer(one)
+        total = self.N_THREADS * self.N_PER_THREAD
+        assert len(sc.services) == total
+        assert len(sc.delete_service_names) == total
+        assert len(sc.patches) == total
+        sc.clear()
+        assert sc.services == []
+
+    def test_concurrent_clear_does_not_corrupt(self):
+        """clear() racing creates must never leave half-cleared state or
+        raise — both paths hold the same lock."""
+        pc = FakePodControl()
+        stop = threading.Event()
+
+        def clearer():
+            while not stop.is_set():
+                pc.clear()
+
+        t = threading.Thread(target=clearer)
+        t.start()
+        try:
+            for _ in range(500):
+                pc.create_pods_with_controller_ref(NS, POD_TEMPLATE, {}, OWNER_REF)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert len(pc.templates) == len(pc.controller_refs)
+
+
+class TestBatchCreate:
+    def test_batch_results_are_input_ordered(self):
+        pc = FakePodControl()
+        templates = []
+        for i in range(5):
+            t = {"metadata": {"labels": {"i": str(i)}},
+                 "spec": POD_TEMPLATE["spec"]}
+            templates.append(t)
+        results = pc.create_pods_batch(NS, templates, {}, OWNER_REF)
+        assert len(results) == 5
+        for i, (created, exc) in enumerate(results):
+            assert exc is None
+            assert created["metadata"]["labels"]["i"] == str(i)
+
+    def test_batch_concurrent_executor_partial_failure(self):
+        """A create that fails mid-wave surfaces as per-slot data; the other
+        slots still complete."""
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        from k8s_tpu.controller_v2.control import RealPodControl
+
+        ex = executor_for_concurrency(8)
+        try:
+            pc = RealPodControl(cs, FakeRecorder(), executor=ex)
+            templates = [
+                {"metadata": {"name": f"p-{i}"}, "spec": {}} for i in range(6)
+            ]
+            templates[3]["metadata"] = {}  # no name/generateName -> invalid
+            results = pc.create_pods_batch(NS, templates, {}, OWNER_REF)
+            assert [exc is None for _, exc in results] == [
+                True, True, True, False, True, True]
+            assert len(cs.pods(NS).list()) == 5
+        finally:
+            ex.shutdown(wait=False)
+
+    def test_env_concurrency_parsing(self, monkeypatch):
+        monkeypatch.delenv("K8S_TPU_CREATE_CONCURRENCY", raising=False)
+        assert create_concurrency_from_env() == 16
+        monkeypatch.setenv("K8S_TPU_CREATE_CONCURRENCY", "4")
+        assert create_concurrency_from_env() == 4
+        monkeypatch.setenv("K8S_TPU_CREATE_CONCURRENCY", "zero")
+        assert create_concurrency_from_env() == 16
+        monkeypatch.setenv("K8S_TPU_CREATE_CONCURRENCY", "-3")
+        assert create_concurrency_from_env() == 16
+
+    def test_executor_for_concurrency_modes(self):
+        assert executor_for_concurrency(1) is None
+        ex = executor_for_concurrency(2)
+        try:
+            assert ex is not None
+        finally:
+            ex.shutdown(wait=False)
+
+
+class TestFanOutPath:
+    """Satellite: 1 job x 128 replicas, 10ms injected create latency."""
+
+    REPLICAS = 128
+    LATENCY_S = 0.010
+
+    def _one_fanout_sync(self) -> float:
+        """One cold 128-replica sync on a fresh cluster; returns wall clock
+        after asserting all correctness invariants."""
+        tfjob = make_tfjob(worker=self.REPLICAS)
+        tc, fc = build_controller(tfjob, create_concurrency=16)
+        tc.factory.start()
+        assert tc.factory.wait_for_cache_sync(10)
+        try:
+            fc.create_delay_s = self.LATENCY_S
+            t0 = time.perf_counter()
+            assert tc.sync_tfjob(KEY) is True
+            elapsed = time.perf_counter() - t0
+
+            # No duplicate pod names; the full gang + services exist.
+            pods = fc.list(PODS, NS)
+            services = fc.list(SERVICES, NS)
+            names = [p["metadata"]["name"] for p in pods]
+            assert len(names) == self.REPLICAS
+            assert len(set(names)) == self.REPLICAS
+            assert len(services) == self.REPLICAS
+
+            # Expectations satisfied after one sync, once the informer ADD
+            # echoes drain (the real steady-state contract).
+            pod_key = gen_expectation_pods_key(KEY, "worker")
+            svc_key = gen_expectation_services_key(KEY, "worker")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if (tc.expectations.satisfied(pod_key)
+                        and tc.expectations.satisfied(svc_key)):
+                    break
+                time.sleep(0.01)
+            assert tc.expectations.satisfied(pod_key)
+            assert tc.expectations.satisfied(svc_key)
+            return elapsed
+        finally:
+            fc.create_delay_s = 0.0
+            tc.shutdown()
+
+    def test_fanout_128_replicas(self):
+        # Wall clock beats the serial bound by >= 4x: serially, 256 creates
+        # x 10ms = 2.56s minimum.  The timing half gets ONE retry on a fresh
+        # cluster: a real serialization regression fails both attempts
+        # deterministically (each would take >= serial_bound), while a CI
+        # scheduler stall only loses one.
+        serial_bound = 2 * self.REPLICAS * self.LATENCY_S
+        elapsed = self._one_fanout_sync()
+        if elapsed >= serial_bound / 4:
+            elapsed = self._one_fanout_sync()
+        assert elapsed < serial_bound / 4, (
+            f"fan-out sync took {elapsed:.3f}s twice; serial bound is "
+            f"{serial_bound:.2f}s")
+
+    def test_second_sync_creates_nothing_new(self):
+        """Duplicate-create guard: a second sync over the populated lister
+        must not create anything (expectations + index slices)."""
+        tfjob = make_tfjob(worker=8)
+        tc, fc = build_controller(tfjob, create_concurrency=8)
+        tc.factory.start()
+        assert tc.factory.wait_for_cache_sync(10)
+        try:
+            assert tc.sync_tfjob(KEY) is True
+            deadline = time.monotonic() + 10
+            pod_key = gen_expectation_pods_key(KEY, "worker")
+            svc_key = gen_expectation_services_key(KEY, "worker")
+            while time.monotonic() < deadline:
+                if (tc.expectations.satisfied(pod_key)
+                        and tc.expectations.satisfied(svc_key)
+                        and len(tc.pod_informer.store.list()) == 8):
+                    break
+                time.sleep(0.01)
+            assert tc.sync_tfjob(KEY) is True
+            assert len(fc.list(PODS, NS)) == 8
+            assert len(fc.list(SERVICES, NS)) == 8
+        finally:
+            tc.shutdown()
+
+
+class TestSlowStart:
+    def test_chunks_grow_exponentially(self):
+        """client-go slowStartBatch: the wave starts at the control's pool
+        width (1 for the inline-serial fake) and doubles, so a healthy
+        apiserver converges in O(log N) rounds while a rejecting one is
+        probed with O(pool-width) calls."""
+        from k8s_tpu.api import register
+
+        pc = FakePodControl()
+        sizes = []
+        orig = pc.create_pods_batch
+
+        def record(ns, templates, obj, ref):
+            sizes.append(len(templates))
+            return orig(ns, templates, obj, ref)
+
+        pc.create_pods_batch = record
+        tfjob = make_tfjob(worker=13)
+        tc, _ = build_controller(tfjob, pod_control=pc,
+                                 service_control=FakeServiceControl())
+        job = register.tfjob_from_unstructured(tc.tfjob_informer.store.list()[0])
+        register.default_tfjob(job)
+        tc.reconcile_tfjobs(job)
+        assert sizes == [1, 2, 4, 6]
+        assert len(pc.templates) == 13
+        tc.shutdown()
+
+    def test_total_failure_costs_o1_api_calls(self):
+        """A hard apiserver rejection stops the wave after the first chunk:
+        a wedged 64-replica job must not re-storm 64 failing creates through
+        the shared pool on every retry sync."""
+        from k8s_tpu.api import register
+
+        pc = FakePodControl()
+        pc.create_error = RuntimeError("quota exceeded")
+        calls = {"n": 0}
+        orig = pc.create_pods_with_controller_ref
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        pc.create_pods_with_controller_ref = counting
+        tfjob = make_tfjob(worker=64)
+        tc, _ = build_controller(tfjob, pod_control=pc,
+                                 service_control=FakeServiceControl())
+        job = register.tfjob_from_unstructured(tc.tfjob_informer.store.list()[0])
+        register.default_tfjob(job)
+        with pytest.raises(RuntimeError, match="quota exceeded"):
+            tc.reconcile_tfjobs(job)
+        assert calls["n"] == 1  # first chunk failed; the other 63 never sent
+        # every raised expectation was unwound (failed slot + unsubmitted tail)
+        assert tc.expectations.satisfied(gen_expectation_pods_key(KEY, "worker"))
+        tc.shutdown()
+
+    def test_already_exists_does_not_abort_wave(self):
+        """Stale informer cache: an AlreadyExists mid-wave is not a real
+        failure and must not stop the remaining replicas from being created
+        in the same sync (the old per-object path kept going too)."""
+        from k8s_tpu.controller_v2.control import (
+            RealServiceControl,
+            run_create_wave,
+        )
+        from k8s_tpu.controller_v2.expectations import (
+            new_controller_expectations,
+        )
+
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        # index 1 already exists on the apiserver; the lister missed it
+        cs.services(NS).create({"metadata": {"name": "svc-1"}, "spec": {}})
+        sc = RealServiceControl(cs, FakeRecorder(), executor=None)
+        exp = new_controller_expectations()
+        objs = [{"metadata": {"name": f"svc-{i}"}, "spec": {}}
+                for i in range(8)]
+        run_create_wave(
+            exp, "exp-key",
+            lambda lo, hi: sc.create_services_batch(NS, objs[lo:hi], {},
+                                                    OWNER_REF),
+            len(objs), None, "service",
+            lambda i: objs[i]["metadata"]["name"], initial=1,
+        )
+        # chunk 2 ([1, 2]) hit the AlreadyExists; slots 3-7 must still exist
+        assert len(cs.services(NS).list()) == 8
+
+    def test_env_concurrency_one_pins_fully_serial(self, monkeypatch):
+        """K8S_TPU_CREATE_CONCURRENCY=1 is the documented bisect knob: it
+        must force inline-serial creates AND serial replica types, exactly
+        like the create_concurrency=1 constructor arg."""
+        monkeypatch.setenv("K8S_TPU_CREATE_CONCURRENCY", "1")
+        tfjob = make_tfjob(worker=2)
+        tc, _ = build_controller(tfjob)  # create_concurrency=None
+        try:
+            assert tc.create_concurrency == 1
+            assert tc.pod_control._create_executor is None
+            assert tc.service_control._create_executor is None
+        finally:
+            tc.shutdown()
+
+
+class TestPartialFailure:
+    def test_failed_wave_unwinds_expectations(self):
+        """Every failed slot must decrement its expectation or the job
+        wedges on satisfied_expectations until the TTL."""
+        tfjob = make_tfjob(worker=4)
+        pc = FakePodControl()
+        pc.create_error = RuntimeError("api 500")
+        tc, _ = build_controller(tfjob, pod_control=pc,
+                                 service_control=FakeServiceControl())
+        from k8s_tpu.api import register
+
+        job = register.tfjob_from_unstructured(tc.tfjob_informer.store.list()[0])
+        register.default_tfjob(job)
+        with pytest.raises(RuntimeError, match="api 500"):
+            tc.reconcile_tfjobs(job)
+        assert tc.expectations.satisfied(gen_expectation_pods_key(KEY, "worker"))
+
+
+class TestConcurrentReplicaTypes:
+    def test_multi_type_reconcile_matches_serial_counts(self):
+        """Worker+PS reconciled concurrently must produce exactly the serial
+        outcome: one pod + one service per index, statuses for both types."""
+        from k8s_tpu.api import register
+
+        for concurrency in (1, 8):
+            tfjob = make_tfjob(worker=4, ps=2)
+            pc, sc = FakePodControl(), FakeServiceControl()
+            tc, _ = build_controller(tfjob, create_concurrency=concurrency,
+                                     pod_control=pc, service_control=sc)
+            job = register.tfjob_from_unstructured(
+                tc.tfjob_informer.store.list()[0])
+            register.default_tfjob(job)
+            tc.reconcile_tfjobs(job)
+            assert len(pc.templates) == 6, f"concurrency={concurrency}"
+            assert len(sc.services) == 6
+            assert set(job.status.tf_replica_statuses) == {"Worker", "PS"}
+            tc.shutdown()
+
+    def test_sync_list_cache_scans_once_per_sync(self):
+        """get_pods_for_tfjob memoizes on the sync-local job object."""
+        tfjob = make_tfjob(worker=1)
+        tc, _ = build_controller(tfjob)
+        job = tc.tfjob_lister.get(NS, JOB)
+        from k8s_tpu.api import register
+
+        job = register.tfjob_from_unstructured(job)
+        job._sync_cache = {}
+        first = tc.get_pods_for_tfjob(job)
+        assert tc.get_pods_for_tfjob(job) is first
+        svcs = tc.get_services_for_tfjob(job)
+        assert tc.get_services_for_tfjob(job) is svcs
+
+
+class TestFanOutMetrics:
+    def test_create_wave_metrics_recorded(self):
+        tfjob = make_tfjob(worker=4)
+        tc, _ = build_controller(tfjob, create_concurrency=4)
+        counter = tc.metrics["creates_total"]
+        pods_before = counter.labels("v2", "pod", "success").value
+        svcs_before = counter.labels("v2", "service", "success").value
+        assert tc.sync_tfjob(KEY) is True
+        assert counter.labels("v2", "pod", "success").value - pods_before == 4
+        assert counter.labels("v2", "service", "success").value - svcs_before == 4
+        tc.shutdown()
+
+    def test_workqueue_depth_gauge_sampled(self):
+        tfjob = make_tfjob(worker=1)
+        tc, _ = build_controller(tfjob)
+        tc.queue.add(KEY)
+        tc.queue.add("other/key")
+        assert tc._process_next_work_item() is True
+        # sampled right after get(): one item was still queued
+        assert tc.metrics["workqueue_depth"].labels("v2").value == 1
+        tc.shutdown()
+
+
+def test_slice_scale_bench_tiny():
+    """Tier-1 (not slow) variant of the slice-scale microbench: 4 replicas,
+    2ms injected RTT — exercises the whole serial-vs-parallel path in well
+    under a second and pins the output contract."""
+    from k8s_tpu.harness.bench_operator import bench_slice_scale
+
+    r = bench_slice_scale(replicas=4, create_latency_s=0.002, rounds=1)
+    assert r["creates_per_sec"] > 0
+    assert r["serial_creates_per_sec"] > 0
+    assert r["creates_speedup"] > 0
+    for k in ("sync_latency_p50_s", "sync_latency_p99_s",
+              "serial_sync_latency_p50_s"):
+        assert k in r and r[k] >= 0

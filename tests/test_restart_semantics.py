@@ -101,3 +101,65 @@ def test_pdb_min_available_reconciled_on_scale():
     controller.tfjob_informer.store.replace([controller.clientset.tfjobs_unstructured(NS).get("test-tfjob")])
     controller.sync_tfjob(KEY)
     assert controller.clientset.pdbs(NS).list()[0]["spec"]["minAvailable"] == 8
+
+
+class TestDeleteExpectationUnwind:
+    """A failed delete produces no informer DELETE event, so its raised
+    deletion expectation must be unwound (same invariant run_create_wave
+    enforces for creates) — otherwise the job wedges until the TTL."""
+
+    def test_failed_restart_delete_unwinds_expectation(self):
+        import pytest
+
+        from k8s_tpu.controller_v2.pod import gen_expectation_pods_key
+
+        tfjob = make_tfjob(worker=2)
+        tfjob.spec.tf_replica_specs["Worker"].restart_policy = "ExitCode"
+        pods = [
+            make_pod("worker", 0, "Running"),
+            make_pod("worker", 1, "Failed", exit_code=143),
+        ]
+        controller, pod_control, _, _ = build_controller(tfjob, pods, [])
+        pod_control.delete_error = RuntimeError("apiserver 500")
+        with pytest.raises(RuntimeError):
+            controller.sync_tfjob(KEY)
+        # nothing was deleted, so nothing may stay expected: the retry sync
+        # must not short-circuit at satisfied_expectations
+        assert controller.expectations.satisfied(
+            gen_expectation_pods_key(KEY, "worker"))
+
+    def test_failed_gang_delete_unwinds_remaining_expectations(self):
+        import pytest
+
+        from k8s_tpu.controller_v2.control import FakePodControl
+        from k8s_tpu.controller_v2.pod import gen_expectation_pods_key
+
+        class FlakyDeleteControl(FakePodControl):
+            """Deletes 2 pods, then the apiserver starts failing."""
+
+            def __init__(self):
+                super().__init__()
+                self.deletes_before_failure = 2
+
+            def delete_pod(self, namespace, name, controller_obj):
+                if len(self.delete_pod_names) >= self.deletes_before_failure:
+                    raise RuntimeError("apiserver 500")
+                super().delete_pod(namespace, name, controller_obj)
+
+        tfjob = make_tfjob(tpu=4, restart_policy="ExitCode")
+        pods = [make_pod("tpu", i, "Running") for i in range(3)]
+        pods.append(make_pod("tpu", 3, "Failed", exit_code=143))
+        pod_control = FlakyDeleteControl()
+        controller, _, _, _ = build_controller(tfjob, pods, [])
+        controller.pod_control = pod_control
+        controller.pod_reconciler.pod_control = pod_control
+        with pytest.raises(RuntimeError):
+            controller.sync_tfjob(KEY)
+        assert len(pod_control.delete_pod_names) == 2
+        # the 2 successful deletes' informer DELETE echoes are still owed;
+        # the failed + never-submitted slots must already be unwound
+        exp_key = gen_expectation_pods_key(KEY, "tpu")
+        assert not controller.expectations.satisfied(exp_key)
+        controller.expectations.deletion_observed(exp_key)
+        controller.expectations.deletion_observed(exp_key)
+        assert controller.expectations.satisfied(exp_key)

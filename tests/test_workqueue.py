@@ -6,6 +6,7 @@ import time
 from k8s_tpu.util.workqueue import (
     BucketRateLimiter,
     ItemExponentialFailureRateLimiter,
+    MaxOfRateLimiter,
     RateLimitingQueue,
     WorkQueue,
 )
@@ -83,6 +84,60 @@ def test_add_after_orders_by_time():
     q.done(first)
     second, _ = q.get(timeout=2)
     assert (first, second) == ("early", "late")
+    q.shut_down()
+
+
+def test_depth_counts_ready_backlog_only():
+    """depth() is the workqueue_depth gauge's source: queued items only —
+    in-flight (processing) items are excluded."""
+    q = WorkQueue()
+    assert q.depth() == 0
+    q.add("a")
+    q.add("b")
+    assert q.depth() == 2
+    item, _ = q.get()
+    assert item == "a"
+    assert q.depth() == 1  # "a" is processing, not queued
+    q.done("a")
+    assert q.depth() == 1
+
+
+def test_bucket_forget_is_documented_noop():
+    """BucketRateLimiter.forget refunds nothing: consumed tokens stay
+    consumed, so a forget between throttled when() calls changes no delay.
+    qps=0.1 keeps the refill window at 10s/token so wall-clock jitter
+    between the when() calls can't un-throttle the bucket mid-test."""
+    rl = BucketRateLimiter(qps=0.1, burst=2)
+    rl.when("a")
+    rl.when("a")  # bucket drained
+    throttled = rl.when("a")
+    assert throttled > 0.0
+    rl.forget("a")
+    assert rl.when("a") > throttled  # still throttled; nothing was refunded
+    assert rl.num_requeues("a") == 0
+
+
+def test_composite_forget_resets_backoff_member_only():
+    """MaxOfRateLimiter.forget clears exactly the per-item exponential
+    backoff; the token-bucket member's no-op forget leaves its state."""
+    backoff = ItemExponentialFailureRateLimiter(0.005, 1000.0)
+    bucket = BucketRateLimiter(qps=1000.0, burst=1000)
+    rl = MaxOfRateLimiter(backoff, bucket)
+    rl.when("k")
+    rl.when("k")
+    assert backoff.num_requeues("k") == 2
+    rl.forget("k")
+    assert backoff.num_requeues("k") == 0  # backoff member reset
+    assert rl.when("k") == 0.005  # first-failure delay again
+
+
+def test_rate_limiting_queue_exposes_depth():
+    q = RateLimitingQueue()
+    q.add("x")
+    assert q.depth() == 1
+    item, _ = q.get(timeout=2)
+    q.done(item)
+    assert q.depth() == 0
     q.shut_down()
 
 
