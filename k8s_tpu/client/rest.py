@@ -337,16 +337,18 @@ class RestClient:
             self._local.sock = None
 
     def _lean_unary(self, method: str, path: str, data: Optional[bytes],
-                    content_type: str):
+                    content_type: str, extra_hdr: str = ""):
         """One keep-alive request/response on the raw pooled socket.
 
         Handles exactly the protocol the unary path needs — status line,
         flat headers, Content-Length body (every unary apiserver response
         carries one) — and raises ConnectionError on anything else so the
-        caller's stale-connection logic takes over.
+        caller's stale-connection logic takes over.  ``extra_hdr`` carries
+        per-request header lines (CRLF-terminated) the precomposed static
+        block can't: today that's the traceparent header.
         """
         head = (
-            f"{method} {path} HTTP/1.1\r\n" + self._static_hdr
+            f"{method} {path} HTTP/1.1\r\n" + self._static_hdr + extra_hdr
             + (f"Content-Type: {content_type}\r\n" if data is not None else "")
             + f"Content-Length: {len(data) if data is not None else 0}\r\n\r\n"
         )
@@ -507,11 +509,20 @@ class RestClient:
             # lean raw-socket path (TLS stays on http.client below)
             t0 = time.perf_counter() if WIRE_PROFILE_ENABLED else 0.0
             for attempt in attempts:
+                span, traceparent = self._trace_attempt(method, path, attempt)
                 try:
                     status, reason, raw = self._lean_unary(
-                        method, path, data, headers.get("Content-Type", ""))
+                        method, path, data, headers.get("Content-Type", ""),
+                        extra_hdr=(f"traceparent: {traceparent}\r\n"
+                                   if traceparent else ""))
+                    if span is not None:
+                        span.set_attribute("http_status", status)
+                        span.finish()
                     break
-                except (ConnectionError, OSError, ValueError):
+                except (ConnectionError, OSError, ValueError) as e:
+                    if span is not None:
+                        span.set_error(e)
+                        span.finish()
                     self._drop_sock()
                     if attempt == attempts[-1]:
                         raise
@@ -526,15 +537,24 @@ class RestClient:
 
         t0 = time.perf_counter() if WIRE_PROFILE_ENABLED else 0.0
         for attempt in attempts:
+            span, traceparent = self._trace_attempt(method, path, attempt)
+            if traceparent:
+                headers["traceparent"] = traceparent
             conn = self._pooled_conn()
             try:
                 conn.request(method, path, body=data, headers=headers)
                 resp = conn.getresponse()
                 raw = resp.read()  # fully drain so the connection can be reused
+                if span is not None:
+                    span.set_attribute("http_status", resp.status)
+                    span.finish()
                 break
-            except (http.client.HTTPException, ConnectionError, OSError):
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
                 # stale keep-alive (server closed between requests) or
                 # transport hiccup
+                if span is not None:
+                    span.set_error(e)
+                    span.finish()
                 self._drop_conn()
                 if attempt == attempts[-1]:
                     raise
@@ -544,6 +564,25 @@ class RestClient:
             raise self._api_error(resp, raw)
         payload = raw.decode()
         return json.loads(payload) if payload else {}
+
+    @staticmethod
+    def _trace_attempt(method: str, path: str, attempt: int):
+        """(span, traceparent-header-value) for one wire attempt, or
+        (None, None) when tracing is off or no span is current.
+
+        One FRESH span per attempt — same trace-id, new span-id — so a
+        transport-retried GET shows up as two wire calls in the span tree
+        and in whatever the apiserver logged, instead of two server-side
+        operations claiming one client span."""
+        from k8s_tpu import trace
+
+        if not trace.enabled() or trace.current_span() is None:
+            return None, None
+        span = trace.TRACER.start_span(
+            f"http {_profile_key(method, path)}", method=method,
+            attempt=attempt)
+        return span, trace.format_traceparent(
+            span.trace_id, span.span_id, span.head_sampled)
 
     @staticmethod
     def _api_error_from(code: int, reason: str, raw: bytes) -> errors.ApiError:

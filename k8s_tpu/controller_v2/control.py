@@ -121,11 +121,19 @@ class _BatchCreateMixin:
             except Exception as e:  # noqa: BLE001
                 return (None, e)
 
+        # Carry the wave span onto the pool threads: each slot gets its own
+        # Context copy, so the REST-call spans it opens parent under the
+        # create-batch span instead of starting orphan traces.
+        from k8s_tpu import trace
+
+        tracing = trace.enabled()
         futures = []
         tail: list[tuple[dict | None, Exception | None]] = []
         for call in calls:
             try:
-                futures.append(self._create_executor.submit(_one, call))
+                futures.append(self._create_executor.submit(
+                    trace.bind_current_context(_one) if tracing else _one,
+                    call))
             except RuntimeError as e:
                 # Executor shut down mid-wave: the unsubmitted slots become
                 # per-slot failures so the caller unwinds exactly their
@@ -154,6 +162,18 @@ def run_create_wave(expectations, exp_key: str, submit_range, count: int,
     nothing between ``expect_creations`` and the submits may raise, or the
     expectations leak and the job wedges until the TTL.  ``describe(i)``
     names slot i for logs."""
+    from k8s_tpu import trace
+
+    # One span per wave (create_pods_batch / create_services_batch); the
+    # per-slot REST-call spans nest under it via the executor's context
+    # binding.  An error re-raised out of the wave marks the span failed.
+    with trace.span(f"create_{kind}s_batch", kind=kind, count=count):
+        _run_wave(expectations, exp_key, submit_range, count, metrics,
+                  kind, describe, initial)
+
+
+def _run_wave(expectations, exp_key: str, submit_range, count: int,
+              metrics, kind: str, describe, initial: int) -> None:
     expectations.expect_creations(exp_key, count)
     t0 = time.monotonic()
     results: list[tuple[dict | None, Exception | None]] = []

@@ -18,6 +18,7 @@ import logging
 import threading
 import time
 
+from k8s_tpu import trace
 from k8s_tpu.api import register, validation
 from k8s_tpu.api.meta import now_rfc3339
 from k8s_tpu.api.v1alpha2 import types
@@ -263,19 +264,30 @@ class TFJobController:
         depth = getattr(self.queue, "depth", None)
         self.metrics["workqueue_depth"].labels(self.metrics["generation"]).set(
             depth() if depth is not None else len(self.queue))
-        try:
-            forget = self.sync_tfjob(key)
-            if forget:
-                self.queue.forget(key)
-            else:
+        # pop_wait is best-effort (getattr: a custom queue may not track
+        # waits); None just means this sync gets no queue_wait span
+        pop_wait = getattr(self.queue, "pop_wait", None)
+        wait_s = pop_wait(key) if pop_wait is not None else None
+        with trace.span("sync_tfjob", job=key) as root:
+            if wait_s is not None:
+                trace.record_span("queue_wait", wait_s)
+            try:
+                forget = self.sync_tfjob(key)
+                root.set_attribute("forget", forget)
+                if forget:
+                    self.queue.forget(key)
+                else:
+                    self.metrics["queue_retries"].labels(self.metrics["generation"]).inc()
+                    self.queue.add_rate_limited(key)
+            except Exception as e:
+                # swallowed here (the worker loop must survive), so the
+                # root span is marked by hand — tail sampling keeps it
+                root.set_error(e)
+                log.exception("error syncing tfjob %s", key)
                 self.metrics["queue_retries"].labels(self.metrics["generation"]).inc()
                 self.queue.add_rate_limited(key)
-        except Exception:
-            log.exception("error syncing tfjob %s", key)
-            self.metrics["queue_retries"].labels(self.metrics["generation"]).inc()
-            self.queue.add_rate_limited(key)
-        finally:
-            self.queue.done(key)
+            finally:
+                self.queue.done(key)
         return True
 
     # -- sync ----------------------------------------------------------------
@@ -394,8 +406,10 @@ class TFJobController:
                 ),
             )
 
-        pods = self.get_pods_for_tfjob(tfjob)
-        services = self.get_services_for_tfjob(tfjob)
+        with trace.span("list_pods"):
+            pods = self.get_pods_for_tfjob(tfjob)
+        with trace.span("list_services"):
+            services = self.get_services_for_tfjob(tfjob)
 
         if self.enable_gang_scheduling:
             self.sync_pdb(tfjob)
@@ -426,7 +440,15 @@ class TFJobController:
                 _one(rtype, spec)
             return
 
-        futures = [executor.submit(_one, rtype, spec) for rtype, spec in items]
+        # Each task carries its own copy of the calling context so the
+        # per-replica-type spans parent under this sync's root span (a
+        # shared Context copy cannot be entered concurrently).
+        futures = [
+            executor.submit(
+                trace.bind_current_context(_one) if trace.enabled() else _one,
+                rtype, spec)
+            for rtype, spec in items
+        ]
         first_error = None
         for (rtype, _spec), f in zip(items, futures):
             try:

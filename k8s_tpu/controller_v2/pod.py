@@ -37,6 +37,11 @@ log = logging.getLogger(__name__)
 
 SPMD_GANG_TYPES = {types.TFReplicaTypeTPU}
 
+# Stamped onto every pod created inside a traced sync: the trace id of the
+# sync_tfjob span whose create wave produced it (ISSUE 2 — lets apiserver
+# audit entries and kubelet logs be joined back to the operator's span tree).
+TRACE_ID_ANNOTATION = "kubeflow.org/trace-id"
+
 
 def gen_expectation_pods_key(tfjob_key: str, replica_type: str) -> str:
     """controller_pod.go:212-214."""
@@ -222,6 +227,14 @@ class PodReconciler:
         Creation is a single bounded-concurrency wave per replica type: all
         missing indices are collected first, their expectations raised once
         up-front, then created through ``pod_control.create_pods_batch``."""
+        from k8s_tpu import trace
+
+        with trace.span("reconcile_pods", rtype=rtype):
+            self._reconcile(tfjob, pods, rtype, spec)
+
+    def _reconcile(
+        self, tfjob: types.TFJob, pods: list[dict], rtype: str, spec: types.TFReplicaSpec
+    ) -> None:
         rt = rtype.lower()
         pods = filter_pods_for_replica_type(pods, rt)
         replicas = spec.replicas or 1
@@ -379,6 +392,13 @@ class PodReconciler:
         template = copy.deepcopy(spec.template or {})
         meta = template.setdefault("metadata", {})
         meta.setdefault("labels", {}).update(labels)
+        from k8s_tpu import trace
+
+        trace_id = trace.current_trace_id()
+        if trace_id:
+            # join key for apiserver audit / kubelet logs: which sync's
+            # create wave produced this pod
+            meta.setdefault("annotations", {})[TRACE_ID_ANNOTATION] = trace_id
         # Pod identity lives in the labels (reference behavior); the name is
         # generated so recreated gang members never collide.
         meta.pop("name", None)

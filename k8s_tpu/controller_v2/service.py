@@ -75,6 +75,18 @@ class ServiceReconciler:
     ) -> None:
         """controller_service.go:35-64, with creation batched into one
         bounded-concurrency wave per replica type (see pod.py counterpart)."""
+        from k8s_tpu import trace
+
+        with trace.span("reconcile_services", rtype=rtype):
+            self._reconcile(tfjob, services, rtype, spec)
+
+    def _reconcile(
+        self,
+        tfjob: types.TFJob,
+        services: list[dict],
+        rtype: str,
+        spec: types.TFReplicaSpec,
+    ) -> None:
         rt = rtype.lower()
         services = filter_services_for_replica_type(services, rt)
         replicas = spec.replicas or 1

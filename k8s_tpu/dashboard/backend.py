@@ -80,7 +80,8 @@ def _make_handler(manager: ClientManager):
         # -- routes ----------------------------------------------------------
 
         def do_GET(self):  # noqa: N802
-            path = self.path.split("?")[0].rstrip("/")
+            raw_path, _, query = self.path.partition("?")
+            path = raw_path.rstrip("/")
             try:
                 if path == "/metrics":
                     # Prometheus text exposition (filling SURVEY.md §5's
@@ -91,6 +92,21 @@ def _make_handler(manager: ClientManager):
                     self._send_text(
                         200, REGISTRY.expose(), "text/plain; version=0.0.4"
                     )
+                elif path == "/debug/traces":
+                    # Same contract as the operator's metrics endpoint:
+                    # recent span trees slowest-first, ?job= filter, 404
+                    # with an explicit body while tracing is off.  Like
+                    # /metrics above, this reads THIS process's state —
+                    # it shows operator spans only when the dashboard is
+                    # embedded with the controller (the LocalCluster /
+                    # single-binary layout); a separately deployed
+                    # dashboard should scrape the operator's
+                    # --metrics-port endpoint instead.
+                    from k8s_tpu import trace
+
+                    code, body, ctype = trace.debug_traces_response(
+                        trace.TRACER, query)
+                    self._send_text(code, body, ctype)
                 elif path in ("", "/tfjobs/ui", "/tfjobs"):
                     self._serve_ui("index.html")
                 elif path.startswith("/tfjobs/ui/"):

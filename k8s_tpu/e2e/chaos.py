@@ -48,12 +48,25 @@ class ChaosMonkey:
     def __init__(self, clientset, namespace: str = "default", *,
                  level: int = 0, interval_s: float = 0.2, seed: int = 0,
                  victim_filter=is_managed_pod):
+        from k8s_tpu.util import metrics
+
         self.clientset = clientset
         self.namespace = namespace
         self.level = level
         self.interval_s = interval_s
         self.victims: list[str] = []
         self.delete_errors: list[str] = []
+        # Scrapeable chaos telemetry: the in-memory lists above only exist
+        # for in-process test asserts, but a long-lived drill (the leader's
+        # whole tenure) needs its kill/error rate on /metrics like any
+        # other component.  Counters are process-wide cumulative across
+        # monkeys, exactly like Prometheus counters across restarts.
+        self.kills_total = metrics.REGISTRY.counter(
+            "chaos_kills_total",
+            "Pods deleted by the chaos monkey.")
+        self.delete_errors_total = metrics.REGISTRY.counter(
+            "chaos_delete_errors_total",
+            "Chaos-monkey pod deletes that failed for non-404 reasons.")
         self._victim_filter = victim_filter or (lambda pod: True)
         self._rng = random.Random(seed)
         self._stop = threading.Event()
@@ -101,7 +114,9 @@ class ChaosMonkey:
                         if len(self.delete_errors) >= 100:
                             del self.delete_errors[0]
                         self.delete_errors.append(f"{name}: {e}")
+                        self.delete_errors_total.inc()
                         log.warning("chaos: delete %s failed: %s", name, e)
                     continue
                 self.victims.append(name)
+                self.kills_total.inc()
                 log.info("chaos: deleted pod %s", name)

@@ -32,7 +32,14 @@ class LocalCluster:
         backend_mode: str = "fake",
         create_concurrency: int | None = None,
         create_delay_s: float = 0.0,
+        metrics_port: int | None = None,
     ):
+        # metrics_port wires the operator observability endpoint
+        # (/metrics, /healthz, /debug/traces) into the local cluster:
+        # None = off (default), 0 = ephemeral port (read it back from
+        # self.metrics_server.port — what e2e/tests use).
+        self.metrics_server = None
+        self._metrics_port = metrics_port
         # threadiness mirrors the operator flag (reference default: v1 runs
         # 1 worker, v2's flag defaults to 2 — options.go:42, server.go:95)
         self.threadiness = threadiness
@@ -87,6 +94,13 @@ class LocalCluster:
         )
 
     def __enter__(self) -> "LocalCluster":
+        if self._metrics_port is not None:
+            from k8s_tpu.util.metrics_server import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                self._metrics_port, host="127.0.0.1",
+                health_fn=getattr(self.controller, "healthy", None),
+            ).start()
         t = threading.Thread(
             target=self.controller.run,
             kwargs={"threadiness": self.threadiness, "stop_event": self._stop},
@@ -109,5 +123,8 @@ class LocalCluster:
             shutdown()
         for t in self._threads:
             t.join(timeout=5)
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         if self._api_server is not None:
             self._api_server.stop()

@@ -248,8 +248,9 @@ def bench_slice_scale(replicas: int = 256, create_latency_s: float = 0.01,
         concurrency = control_mod.create_concurrency_from_env()
     par = [_slice_sync_round(replicas, create_latency_s, concurrency)
            for _ in range(max(1, rounds))]
-    ser = [_slice_sync_round(replicas, create_latency_s, 1)
-           for _ in range(max(1, serial_rounds))]
+    with untraced():  # keep baseline spans out of the --trace stage table
+        ser = [_slice_sync_round(replicas, create_latency_s, 1)
+               for _ in range(max(1, serial_rounds))]
 
     par_syncs = sorted(r["sync_s"] for r in par)
     par_creates = sum(r["creates"] for r in par)
@@ -285,11 +286,13 @@ def run_slice_scale(args) -> dict:
     )
     ttr = {}
     for mode, conc in (("parallel", None), ("serial", 1)):
-        r = bench_time_to_ready(
-            args.jobs, args.replicas, args.timeout,
-            threadiness=args.threadiness, resync_period_s=args.resync,
-            backend_mode="fake", create_delay_s=args.create_latency,
-            create_concurrency=conc)
+        ctx = untraced() if mode == "serial" else _noop_ctx()
+        with ctx:
+            r = bench_time_to_ready(
+                args.jobs, args.replicas, args.timeout,
+                threadiness=args.threadiness, resync_period_s=args.resync,
+                backend_mode="fake", create_delay_s=args.create_latency,
+                create_concurrency=conc)
         ttr[mode] = r
     p50_par = ttr["parallel"]["time_to_ready_p50_s"]
     p50_ser = ttr["serial"]["time_to_ready_p50_s"]
@@ -306,6 +309,70 @@ def run_slice_scale(args) -> dict:
         "ttr_sync_latency_p50_s": ttr["parallel"]["sync_latency_p50_s"],
         "ttr_sync_latency_p99_s": ttr["parallel"]["sync_latency_p99_s"],
     }
+
+
+def _noop_ctx():
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def untraced():
+    """Context manager suppressing span recording for a bench segment.
+
+    The serial-baseline rounds exist to be *compared against*, not to be
+    profiled: letting their O(replicas x RTT) create waves land in the
+    same ring buffer would fold baseline latencies into the --trace
+    stage table and misreport where the parallel path spends time.
+    """
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        from k8s_tpu import trace
+
+        old = trace.TRACER.sample_rate
+        trace.TRACER.sample_rate = 0.0
+        try:
+            yield
+        finally:
+            trace.TRACER.sample_rate = old
+
+    return _cm()
+
+
+def trace_stage_breakdown() -> dict:
+    """Per-stage p50/p99 latency breakdown over every span in the tracing
+    ring buffer, grouped by span name — the "where did the sync go" table
+    for a --trace bench run.
+
+    FAIL-SOFT by contract (ci_config.yaml bench_smoke runs non-gating):
+    any failure to assemble the breakdown — tracing import broken, empty
+    buffer, malformed trace dicts — degrades to a ``trace_error`` key in
+    the JSON line instead of failing the bench.
+    """
+    try:
+        from k8s_tpu import trace
+
+        by_stage: dict[str, list[float]] = {}
+        stack = list(trace.debug_traces(limit=1_000_000))
+        while stack:
+            span = stack.pop()
+            by_stage.setdefault(span["name"], []).append(span["duration_ms"])
+            stack.extend(span.get("children") or [])
+        if not by_stage:
+            return {"trace_error": "no traces captured"}
+        stages = {}
+        for name, vals in sorted(by_stage.items()):
+            vals.sort()
+            stages[name] = {
+                "count": len(vals),
+                "p50_ms": round(_quantile(vals, 0.50), 3),
+                "p99_ms": round(_quantile(vals, 0.99), 3),
+            }
+        return {"stages": stages}
+    except Exception as e:  # noqa: BLE001 - advisory data must not gate
+        return {"trace_error": f"{type(e).__name__}: {e}"}
 
 
 def main(argv=None) -> int:
@@ -336,7 +403,19 @@ def main(argv=None) -> int:
                    "K8S_TPU_CREATE_CONCURRENCY or 16)")
     p.add_argument("--slice-rounds", type=int, default=3,
                    help="parallel-path rounds for p50/p99 sync latency")
+    p.add_argument("--trace", action="store_true",
+                   help="force tracing on (sample rate 1.0) and append a "
+                   "per-stage p50/p99 breakdown ('stages') to the JSON "
+                   "line; serial-baseline segments run untraced so the "
+                   "table reflects the parallel path only, and breakdown "
+                   "assembly is fail-soft (a 'trace_error' key, never a "
+                   "nonzero exit)")
     args = p.parse_args(argv)
+
+    if args.trace:
+        from k8s_tpu import trace
+
+        trace.configure(sample_rate=1.0)
 
     if args.slice_scale:
         if args.backend != "fake":
@@ -344,7 +423,10 @@ def main(argv=None) -> int:
                     "per-create RTT only exists on the fake backend")
         if args.create_latency is None:
             args.create_latency = 0.01
-        print(json.dumps(run_slice_scale(args)))
+        result = run_slice_scale(args)
+        if args.trace:
+            result.update(trace_stage_breakdown())
+        print(json.dumps(result))
         return 0
 
     if args.create_latency and args.backend != "fake":
@@ -355,9 +437,12 @@ def main(argv=None) -> int:
                                  backend_mode=args.backend,
                                  create_delay_s=args.create_latency or 0.0,
                                  create_concurrency=args.create_concurrency)
-    print(json.dumps({"metric": "tfjob_time_to_ready_p50",
-                      "value": result["time_to_ready_p50_s"],
-                      "unit": "s", "backend": args.backend, **result}))
+    out = {"metric": "tfjob_time_to_ready_p50",
+           "value": result["time_to_ready_p50_s"],
+           "unit": "s", "backend": args.backend, **result}
+    if args.trace:
+        out.update(trace_stage_breakdown())
+    print(json.dumps(out))
 
     from k8s_tpu.client import rest
 

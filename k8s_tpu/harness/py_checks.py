@@ -23,6 +23,49 @@ log = logging.getLogger(__name__)
 EXCLUDE_DIRS = {".git", "__pycache__", ".eggs", "build", "vendor", "node_modules"}
 
 
+def check_trace_stdlib(path: str, source: bytes | None = None) -> list[str]:
+    """Stdlib-only gate for ``k8s_tpu/trace/``: the tracing package is
+    imported on the REST client's request hot path and by ops tooling, so
+    it must never grow a third-party (or even intra-repo) dependency —
+    only the standard library and the trace package itself are allowed.
+
+    Returns one message per offending import (empty = clean).
+    """
+    import ast
+
+    if source is None:
+        with open(path, "rb") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # the syntax layer reports this one
+    violations = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            names = [alias.name for alias in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import: stays inside the package
+                continue
+            names = [node.module or ""]
+        else:
+            continue
+        for name in names:
+            if name == "k8s_tpu.trace" or name.startswith("k8s_tpu.trace."):
+                continue
+            if name.split(".", 1)[0] in sys.stdlib_module_names:
+                continue
+            violations.append(
+                f"non-stdlib import '{name}' in k8s_tpu/trace "
+                f"(stdlib-only package; line {node.lineno})")
+    return violations
+
+
+def _is_trace_package_file(path: str) -> bool:
+    norm = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
+    return "/k8s_tpu/trace/" in norm
+
+
 def iter_py_files(src_dir: str):
     for root, dirs, files in os.walk(src_dir):
         dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
@@ -45,6 +88,10 @@ def _lint_one(path: str) -> str | None:
         compile(source, path, "exec")
     except SyntaxError as e:
         return f"SyntaxError: {e}"
+    if _is_trace_package_file(path):
+        trace_violations = check_trace_stdlib(path, source)
+        if trace_violations:
+            return "\n".join(trace_violations)
     from k8s_tpu.harness import pylint_lite
 
     findings = pylint_lite.check_file(path)

@@ -33,16 +33,31 @@ class NativeRateLimitingQueue:
         qps: float = 10.0,
         burst: int = 100,
     ):
+        from k8s_tpu.util.workqueue import WaitTracker
+
         lib = native.load()
         if lib is None:
             raise RuntimeError("native runtime unavailable")
         self._lib = lib
         self._h = lib.rlq_new(base_delay, max_delay, qps, float(burst))
+        # enqueue→dequeue wait accounting via the same WaitTracker the
+        # Python WorkQueue uses (one pop_wait contract, one
+        # implementation).  The C++ core is opaque about WHEN an item
+        # lands in the ready deque, so the stamps are best-effort: add()
+        # stamps now, add_after() stamps now+delay (the scheduled
+        # delivery), add_rate_limited() doesn't stamp at all (the backoff
+        # delay is computed inside the core and is deliberate latency, not
+        # queue wait) — those deliveries simply record no wait.
+        self._wait_tracker = WaitTracker()
 
     def add(self, item: str) -> None:
+        self._wait_tracker.stamp(item)
         self._lib.rlq_add(self._h, _b(item))
 
     def add_after(self, item: str, delay: float) -> None:
+        import time
+
+        self._wait_tracker.stamp(item, at=time.monotonic() + max(delay, 0.0))
         self._lib.rlq_add_after(self._h, _b(item), delay)
 
     def add_rate_limited(self, item: str) -> None:
@@ -54,12 +69,26 @@ class NativeRateLimitingQueue:
         buf = ctypes.create_string_buffer(_KEY_BUF)
         rc = self._lib.rlq_get(self._h, -1.0 if timeout is None else timeout, buf, _KEY_BUF)
         if rc == 1:
-            return buf.value.decode(), False
+            item = buf.value.decode()
+            wait = self._wait_tracker.claim(item)
+            if wait is not None:
+                from k8s_tpu.util.workqueue import workqueue_wait_histogram
+
+                workqueue_wait_histogram().observe(wait)
+            return item, False
         if rc == 0:
             return None, False
         return None, True
 
+    def pop_wait(self, item: str) -> Optional[float]:
+        """Same contract as WorkQueue.pop_wait: the wait measured at the
+        last get() of ``item``, consumed on read; None when untracked."""
+        return self._wait_tracker.pop(item)
+
     def done(self, item: str) -> None:
+        # evict any unclaimed wait (same lifecycle rule as the Python
+        # WorkQueue.done: consumers that never pop_wait must not leak)
+        self._wait_tracker.evict(item)
         self._lib.rlq_done(self._h, _b(item))
 
     def forget(self, item: str) -> None:

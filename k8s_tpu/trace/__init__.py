@@ -1,0 +1,83 @@
+"""End-to-end reconcile tracing (ISSUE 2; the per-stage attribution half
+of the observability story — metrics answer "how slow", spans answer
+"*where* did this 300ms sync go").
+
+Module-level convenience API over one process-wide :class:`Tracer`:
+
+    from k8s_tpu import trace
+
+    with trace.span("sync_tfjob", job=key):
+        trace.record_span("queue_wait", wait_s)   # retroactive child
+        ...
+
+Sampling knobs (read at import; ``trace.configure()`` re-reads):
+
+- ``K8S_TPU_TRACE_SAMPLE``  — head sample rate in [0, 1]; 0/unset = off.
+- ``K8S_TPU_TRACE_SLOW_MS`` — tail keep-if-slow threshold (default 250);
+  slow or errored traces are always kept once tracing is on.
+
+This package is stdlib-only by policy (``harness/py_checks.py`` gates it):
+the REST client imports it on the request hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from k8s_tpu.trace.export import (  # noqa: F401 (public surface)
+    RingBufferExporter,
+    debug_traces_response,
+    select_traces,
+)
+from k8s_tpu.trace.propagation import (  # noqa: F401
+    format_traceparent,
+    parse_traceparent,
+)
+from k8s_tpu.trace.tracer import (  # noqa: F401
+    NOOP_SPAN,
+    Span,
+    Tracer,
+    bind_current_context,
+    current_span,
+    current_trace_id,
+)
+
+# The process-wide tracer every instrumentation site records through
+# (operator binaries inherit env config; tests call configure()).
+TRACER = Tracer()
+
+
+def configure(sample_rate: Optional[float] = None,
+              slow_threshold_s: Optional[float] = None,
+              exporter=None) -> Tracer:
+    """Reconfigure the global tracer; None args re-read the environment."""
+    return TRACER.configure(sample_rate=sample_rate,
+                            slow_threshold_s=slow_threshold_s,
+                            exporter=exporter)
+
+
+def enabled() -> bool:
+    return TRACER.enabled
+
+
+def span(name: str, **attributes):
+    """Start a span on the global tracer (context manager)."""
+    return TRACER.start_span(name, **attributes)
+
+
+def record_span(name: str, duration_s: float, **attributes):
+    """Retroactive child of the current span (interval ending now)."""
+    return TRACER.record_span(name, duration_s, **attributes)
+
+
+def current_traceparent() -> Optional[str]:
+    """W3C traceparent for the current span, or None."""
+    sp = current_span()
+    if sp is None:
+        return None
+    return format_traceparent(sp.trace_id, sp.span_id, sp.head_sampled)
+
+
+def debug_traces(limit: int = 50, job: Optional[str] = None) -> list[dict]:
+    """Buffered traces, slowest-first (the /debug/traces view)."""
+    return select_traces(TRACER.exporter.snapshot(), limit=limit, job=job)

@@ -1,10 +1,18 @@
 """Operator observability endpoint: /metrics (Prometheus text 0.0.4 from
-util.metrics.Registry) and /healthz.
+util.metrics.Registry), /healthz, and /debug/traces (recent span trees
+from the tracing ring buffer, slowest-first; 404 with an explicit
+"tracing disabled" body when K8S_TPU_TRACE_SAMPLE is 0).
 
 The reference operator exposed no scrape endpoint at all (cmd/tf-operator*/
 app/server.go wires no HTTP server); a production operator needs one, so
 this is an intentional superset.  Served on ``--metrics-port`` (0 =
 disabled, the default, preserving reference behavior).
+
+/healthz gates on the registry: until the first successful scrape
+(``registry.expose()`` completing without raising — attempted lazily by
+the probe itself if no /metrics request came first), it answers 503.  A
+registry wedged by a broken callable gauge therefore fails the liveness
+probe instead of reporting a healthy process that can't be observed.
 """
 
 from __future__ import annotations
@@ -35,6 +43,10 @@ class MetricsServer:
         # decision (pass host="0.0.0.0" — the operator manifests do, inside
         # the pod network, where the scrape must reach them).
         registry = registry or metrics_mod.REGISTRY
+        # flips True at the first successful registry.expose(); /healthz
+        # stays 503 until then (shared mutable cell: the handler class has
+        # one instance per request)
+        scrape_state = {"ok": False}
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route through logging
@@ -49,12 +61,29 @@ class MetricsServer:
                 self.wfile.write(data)
 
             def do_GET(self):  # noqa: N802
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
+                    try:
+                        body = registry.expose()
+                    except Exception as e:  # noqa: BLE001 - broken collector
+                        return self._send(500, f"scrape failed: {e}\n",
+                                          "text/plain")
+                    scrape_state["ok"] = True
                     return self._send(
-                        200, registry.expose(),
+                        200, body,
                         "text/plain; version=0.0.4; charset=utf-8")
                 if path == "/healthz":
+                    if not scrape_state["ok"]:
+                        # no scraper came by yet: probe the registry
+                        # ourselves so a healthy process isn't 503 forever
+                        try:
+                            registry.expose()
+                            scrape_state["ok"] = True
+                        except Exception:  # noqa: BLE001
+                            return self._send(
+                                503,
+                                "no successful scrape of the metrics "
+                                "registry yet\n", "text/plain")
                     try:
                         healthy = health_fn() if health_fn else True
                     except Exception:  # noqa: BLE001 - a broken probe is unhealthy
@@ -62,6 +91,12 @@ class MetricsServer:
                     return self._send(200 if healthy else 503,
                                       "ok\n" if healthy else "unhealthy\n",
                                       "text/plain")
+                if path == "/debug/traces":
+                    from k8s_tpu import trace
+
+                    code, body, ctype = trace.debug_traces_response(
+                        trace.TRACER, query)
+                    return self._send(code, body, ctype)
                 return self._send(404, "not found\n", "text/plain")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

@@ -119,6 +119,80 @@ def default_controller_rate_limiter() -> MaxOfRateLimiter:
     )
 
 
+# Queue-wait telemetry: one histogram for every queue in the process
+# (client-go's workqueue_queue_duration_seconds analogue).  Registered
+# lazily so importing this module never touches the metrics registry.
+_wait_histogram = None
+_wait_histogram_lock = threading.Lock()
+
+# Bench-measured queue waits span sub-ms (idle) to tens of seconds
+# (rate-limited backoff), so the default request-latency buckets clip
+# both ends.
+_WAIT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                 5.0, 10.0, 30.0)
+
+
+def workqueue_wait_histogram():
+    """The ``workqueue_wait_seconds`` histogram (enqueue→dequeue latency),
+    shared by every WorkQueue in the process."""
+    global _wait_histogram
+    if _wait_histogram is None:
+        with _wait_histogram_lock:
+            if _wait_histogram is None:
+                from k8s_tpu.util import metrics
+
+                _wait_histogram = metrics.REGISTRY.histogram(
+                    "workqueue_wait_seconds",
+                    "Enqueue-to-dequeue wait of workqueue items (time an "
+                    "item sat in the ready backlog before a worker picked "
+                    "it up).",
+                    buckets=_WAIT_BUCKETS,
+                )
+    return _wait_histogram
+
+
+class WaitTracker:
+    """Enqueue→dequeue wait bookkeeping, shared by the Python WorkQueue
+    and the native queue wrapper so the pop_wait contract has exactly one
+    implementation: ``stamp()`` when an item (is expected to) land in the
+    ready backlog, ``claim()`` at dequeue (measures and stores the wait),
+    ``pop()`` by the consumer turning it into telemetry, ``evict()`` at
+    done() so consumers that never pop don't leak one entry per key.
+
+    claim() deliberately does NOT observe the histogram — callers record
+    the returned wait outside whatever queue lock they hold.
+    """
+
+    __slots__ = ("_lock", "_enqueued_at", "_waits")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._enqueued_at: dict[Any, float] = {}
+        self._waits: dict[Any, float] = {}
+
+    def stamp(self, item: Hashable, at: Optional[float] = None) -> None:
+        with self._lock:
+            self._enqueued_at.setdefault(
+                item, time.monotonic() if at is None else at)
+
+    def claim(self, item: Hashable) -> Optional[float]:
+        with self._lock:
+            enqueued = self._enqueued_at.pop(item, None)
+            if enqueued is None:
+                return None
+            wait = max(0.0, time.monotonic() - enqueued)
+            self._waits[item] = wait
+            return wait
+
+    def pop(self, item: Hashable) -> Optional[float]:
+        with self._lock:
+            return self._waits.pop(item, None)
+
+    def evict(self, item: Hashable) -> None:
+        with self._lock:
+            self._waits.pop(item, None)
+
+
 class WorkQueue:
     """FIFO queue with client-go dirty/processing dedup semantics."""
 
@@ -128,6 +202,10 @@ class WorkQueue:
         self._dirty: set[Any] = set()
         self._processing: set[Any] = set()
         self._shutting_down = False
+        # enqueue→dequeue wait accounting: stamped when an item lands in
+        # the READY deque (a delayed add_after item starts its clock on
+        # delivery, so the deliberate delay is not counted as wait).
+        self._wait_tracker = WaitTracker()
 
     def add(self, item: Hashable) -> None:
         with self._cond:
@@ -136,6 +214,7 @@ class WorkQueue:
             self._dirty.add(item)
             if item not in self._processing:
                 self._queue.append(item)
+                self._wait_tracker.stamp(item)
                 self._cond.notify()
 
     def get(self, timeout: Optional[float] = None):
@@ -143,6 +222,7 @@ class WorkQueue:
 
         A ``timeout`` (used by tests) returns (None, False) on expiry.
         """
+        wait = None
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout
             while not self._queue and not self._shutting_down:
@@ -155,13 +235,32 @@ class WorkQueue:
             item = self._queue.popleft()
             self._processing.add(item)
             self._dirty.discard(item)
-            return item, False
+            wait = self._wait_tracker.claim(item)
+        if wait is not None:
+            # outside the queue mutex: the histogram has its own locks and
+            # must not extend the dequeue critical section
+            workqueue_wait_histogram().observe(wait)
+        return item, False
+
+    def pop_wait(self, item: Hashable) -> Optional[float]:
+        """The enqueue→dequeue wait measured when ``item`` was last handed
+        out by get(), consumed on read (the controller turns it into the
+        sync's queue_wait span).  None when unknown — e.g. an item whose
+        delivery wasn't stamped (the native queue's rate-limited re-adds)."""
+        return self._wait_tracker.pop(item)
 
     def done(self, item: Hashable) -> None:
         with self._cond:
             self._processing.discard(item)
+            # Evict any unclaimed wait: consumers that never call
+            # pop_wait (the v1 controller) must not grow the tracker by
+            # one entry per distinct key forever.  Consumers that do claim
+            # it (v2) read it between get() and done(), so this is a no-op
+            # for them.
+            self._wait_tracker.evict(item)
             if item in self._dirty:
                 self._queue.append(item)
+                self._wait_tracker.stamp(item)
                 self._cond.notify()
 
     def shut_down(self) -> None:

@@ -80,7 +80,9 @@ def test_monkey_level_zero_is_inert():
 
 def test_monkey_spares_unmanaged_pods():
     """Bystanders (no TFJob labels — e.g. the operator's own pod) are never
-    victims; managed pods are."""
+    victims; managed pods are.  Kills are also exported as the
+    chaos_kills_total counter (scrapeable chaos telemetry, not just the
+    in-memory victims list)."""
     cs = Clientset(FakeCluster())
     cs.pods(NS).create({"metadata": {"name": "operator-pod"},
                         "status": {"phase": "Running"}})
@@ -91,13 +93,21 @@ def test_monkey_spares_unmanaged_pods():
         "metadata": {"name": "v2-pod",
                      "labels": {"group_name": "kubeflow.org"}},
         "status": {"phase": "Running"}})
-    monkey = ChaosMonkey(cs, NS, level=3, interval_s=0.01, seed=1).start()
+    monkey = ChaosMonkey(cs, NS, level=3, interval_s=0.01, seed=1)
+    kills_before = monkey.kills_total.value
+    monkey.start()
     deadline = time.time() + 5
     while time.time() < deadline and len(monkey.victims) < 2:
         time.sleep(0.02)
     monkey.stop()
     assert set(monkey.victims) == {"v1-pod", "v2-pod"}
     assert cs.pods(NS).get("operator-pod") is not None
+    # counter moved in lockstep with the in-memory list (process-wide
+    # cumulative metric, so assert the delta, not the absolute value)
+    assert monkey.kills_total.value == kills_before + 2
+    from k8s_tpu.util.metrics import REGISTRY
+
+    assert "chaos_kills_total" in REGISTRY.expose()
 
 
 def test_operator_binary_wires_chaos_flag():
@@ -151,7 +161,9 @@ def test_monkey_survives_delete_transport_errors():
             return FlakyPods()
 
     monkey = ChaosMonkey(FlakyClientset(), NS, level=1,
-                         interval_s=0.01, seed=0).start()
+                         interval_s=0.01, seed=0)
+    errors_before = monkey.delete_errors_total.value
+    monkey.start()
     deadline = time.time() + 5
     while time.time() < deadline and not monkey.victims:
         time.sleep(0.02)
@@ -159,3 +171,5 @@ def test_monkey_survives_delete_transport_errors():
     assert monkey.delete_errors, "transport failure was not recorded"
     assert monkey.victims == ["v1-pod"], \
         "storm died after the transport error instead of retrying"
+    # the failure is also a scrapeable counter (chaos_delete_errors_total)
+    assert monkey.delete_errors_total.value == errors_before + 1

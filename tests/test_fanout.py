@@ -16,7 +16,6 @@ from k8s_tpu.client import Clientset, FakeCluster
 from k8s_tpu.client.gvr import PODS, SERVICES
 from k8s_tpu.client.informer import SharedInformerFactory
 from k8s_tpu.client.record import FakeRecorder
-from k8s_tpu.controller_v2 import tpu_config
 from k8s_tpu.controller_v2.control import (
     FakePodControl,
     FakeServiceControl,

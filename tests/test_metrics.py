@@ -193,6 +193,38 @@ class TestMetricsServer:
         finally:
             server.stop()
 
+    def test_healthz_503_until_first_successful_scrape(self):
+        """/healthz gates on the registry: while expose() raises (broken
+        callable gauge), the probe answers 503 with an explicit body; once
+        a scrape succeeds, normal health semantics resume — and the flag
+        latches (one success is enough)."""
+        from k8s_tpu.util import metrics as metrics_mod
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        registry = metrics_mod.Registry()
+
+        def broken():
+            raise RuntimeError("collector wedged")
+
+        registry.gauge("bad_gauge", "broken collector", fn=broken)
+        server = MetricsServer(0, registry=registry, host="127.0.0.1")
+        server.start()
+        try:
+            code, body = self._get(server.port, "/healthz")
+            assert code == 503
+            assert "no successful scrape" in body
+            # /metrics itself reports the broken collector, not a 200 lie
+            code, _ = self._get(server.port, "/metrics")
+            assert code == 500
+            registry.unregister("bad_gauge")
+            code, body = self._get(server.port, "/healthz")
+            assert (code, body) == (200, "ok\n")
+            # latched: re-breaking the registry doesn't flip healthz back
+            registry.gauge("bad_gauge", "broken again", fn=broken)
+            assert self._get(server.port, "/healthz")[0] == 200
+        finally:
+            server.stop()
+
     def test_maybe_start_disabled_at_port_zero(self):
         from k8s_tpu.util.metrics_server import maybe_start
 

@@ -1,0 +1,505 @@
+"""Tracing subsystem (k8s_tpu.trace): span trees, sampling, the ring
+buffer, W3C traceparent propagation through client/rest.py retries, the
+/debug/traces endpoints, and the end-to-end reconcile instrumentation
+(ISSUE 2 acceptance: a LocalCluster run with sampling on yields a
+sync_tfjob root with queue-wait/list/create-batch children)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_tpu import trace
+from k8s_tpu.trace.export import RingBufferExporter, select_traces
+from k8s_tpu.trace.propagation import format_traceparent, parse_traceparent
+
+
+@pytest.fixture()
+def traced():
+    """Tracing on at rate 1.0 against a private exporter; global tracer
+    restored afterwards so the rest of the suite stays untraced."""
+    old_rate = trace.TRACER.sample_rate
+    old_slow = trace.TRACER.slow_threshold_s
+    old_exporter = trace.TRACER.exporter
+    trace.configure(sample_rate=1.0, exporter=RingBufferExporter())
+    yield trace
+    trace.TRACER.sample_rate = old_rate
+    trace.TRACER.slow_threshold_s = old_slow
+    trace.TRACER.exporter = old_exporter
+
+
+def _names(tree: dict) -> set[str]:
+    out = {tree["name"]}
+    for child in tree["children"]:
+        out |= _names(child)
+    return out
+
+
+class TestTracerCore:
+    def test_nested_spans_parent_and_export_on_root_finish(self, traced):
+        with trace.span("root", job="ns/j") as root:
+            assert trace.current_span() is root
+            assert trace.current_trace_id() == root.trace_id
+            with trace.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+            assert trace.TRACER.exporter.snapshot() == []  # root still open
+        assert trace.current_span() is None
+        (tree,) = trace.TRACER.exporter.snapshot()
+        assert tree["name"] == "root"
+        assert tree["attributes"] == {"job": "ns/j"}
+        assert [c["name"] for c in tree["children"]] == ["child"]
+
+    def test_disabled_returns_shared_noop(self):
+        old = trace.TRACER.sample_rate
+        trace.TRACER.sample_rate = 0.0
+        try:
+            s = trace.span("x")
+            assert s is trace.NOOP_SPAN
+            with s:
+                assert trace.current_span() is None
+                assert trace.record_span("y", 0.1) is None
+        finally:
+            trace.TRACER.sample_rate = old
+
+    def test_exception_marks_error_and_propagates(self, traced):
+        with pytest.raises(ValueError):
+            with trace.span("boom"):
+                raise ValueError("nope")
+        (tree,) = trace.TRACER.exporter.snapshot()
+        assert tree["status"] == "error"
+        assert "nope" in tree["status_message"]
+
+    def test_record_span_is_retroactive_child(self, traced):
+        with trace.span("root"):
+            trace.record_span("queue_wait", 0.05, job="k")
+        (tree,) = trace.TRACER.exporter.snapshot()
+        (wait,) = tree["children"]
+        assert wait["name"] == "queue_wait"
+        assert wait["duration_ms"] == pytest.approx(50, abs=5)
+        # retroactive: started before its own recording instant
+        assert wait["start_unix"] <= tree["start_unix"] + tree["duration_ms"] / 1e3
+
+    def test_record_span_without_parent_is_dropped(self, traced):
+        assert trace.record_span("orphan", 0.01) is None
+        assert trace.TRACER.exporter.snapshot() == []
+
+    def test_bind_current_context_carries_parent_across_pool(self, traced):
+        from concurrent.futures import ThreadPoolExecutor
+
+        def task(i):
+            with trace.span(f"task-{i}"):
+                pass
+
+        with ThreadPoolExecutor(4) as ex:
+            with trace.span("root"):
+                futures = [ex.submit(trace.bind_current_context(task), i)
+                           for i in range(4)]
+                for f in futures:
+                    f.result()
+        (tree,) = trace.TRACER.exporter.snapshot()
+        assert sorted(c["name"] for c in tree["children"]) == [
+            "task-0", "task-1", "task-2", "task-3"]
+
+    def test_env_configuration(self, monkeypatch):
+        monkeypatch.setenv("K8S_TPU_TRACE_SAMPLE", "0.25")
+        monkeypatch.setenv("K8S_TPU_TRACE_SLOW_MS", "500")
+        t = trace.Tracer()
+        assert t.sample_rate == 0.25
+        assert t.slow_threshold_s == 0.5
+        monkeypatch.setenv("K8S_TPU_TRACE_SAMPLE", "garbage")
+        assert trace.Tracer().sample_rate == 0.0  # garbage disables
+
+
+class TestTailSampling:
+    def test_slow_root_kept_despite_head_rejection(self, traced):
+        # head rate effectively 0 but tracing on: tail keep-if-slow fires
+        trace.TRACER.sample_rate = 1e-12
+        trace.TRACER.slow_threshold_s = 0.01
+        with trace.span("fast"):
+            pass
+        with trace.span("slow"):
+            time.sleep(0.02)
+        kept = [t["name"] for t in trace.TRACER.exporter.snapshot()]
+        assert kept == ["slow"]
+
+    def test_errored_root_always_kept(self, traced):
+        trace.TRACER.sample_rate = 1e-12
+        trace.TRACER.slow_threshold_s = 60.0
+        with pytest.raises(RuntimeError):
+            with trace.span("failing"):
+                raise RuntimeError("x")
+        assert [t["name"] for t in trace.TRACER.exporter.snapshot()] == ["failing"]
+
+    def test_error_in_descendant_keeps_tree(self, traced):
+        trace.TRACER.sample_rate = 1e-12
+        trace.TRACER.slow_threshold_s = 60.0
+        with trace.span("root"):
+            child = trace.TRACER.start_span("child")
+            child.set_error("deep failure")
+            child.finish()
+        (tree,) = trace.TRACER.exporter.snapshot()
+        assert tree["status"] == "ok"
+        assert tree["children"][0]["status"] == "error"
+
+
+class TestRingBuffer:
+    def test_fifo_eviction_order(self):
+        ex = RingBufferExporter(capacity=3)
+        for i in range(6):
+            ex.export({"name": f"t{i}", "duration_ms": 1.0})
+        assert [t["name"] for t in ex.snapshot()] == ["t3", "t4", "t5"]
+        stats = ex.stats()
+        assert stats["exported_total"] == 6
+        assert stats["evicted_total"] == 3
+
+    def test_eviction_under_concurrent_writers(self):
+        """The append+evict pair is atomic: after a storm from N threads
+        the buffer holds exactly `capacity` traces, and a serial tail of
+        exports lands in exact FIFO order (the storm never corrupts the
+        deque's ordering invariant)."""
+        ex = RingBufferExporter(capacity=16)
+        n_threads, per_thread = 8, 200
+
+        def storm(tid):
+            for i in range(per_thread):
+                ex.export({"name": f"w{tid}-{i}", "duration_ms": 1.0})
+
+        threads = [threading.Thread(target=storm, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = ex.snapshot()
+        assert len(snap) == 16
+        assert len({t["name"] for t in snap}) == 16  # no duplicates
+        assert ex.stats()["exported_total"] == n_threads * per_thread
+        # deterministic tail: the last `capacity` serial exports evict
+        # everything the storm left, in order
+        for i in range(16):
+            ex.export({"name": f"tail-{i}", "duration_ms": 1.0})
+        assert [t["name"] for t in ex.snapshot()] == [
+            f"tail-{i}" for i in range(16)]
+
+    def test_select_traces_slowest_first_and_job_filter(self):
+        traces = [
+            {"name": "a", "duration_ms": 5.0, "attributes": {"job": "ns/j1"}},
+            {"name": "b", "duration_ms": 50.0, "attributes": {"job": "ns/j2"}},
+            {"name": "c", "duration_ms": 20.0, "attributes": {"job": "ns/j1"}},
+        ]
+        assert [t["name"] for t in select_traces(traces)] == ["b", "c", "a"]
+        assert [t["name"] for t in select_traces(traces, limit=1)] == ["b"]
+        assert [t["name"] for t in select_traces(traces, job="j1")] == ["c", "a"]
+
+
+class TestPropagation:
+    def test_round_trip(self):
+        header = format_traceparent("ab" * 16, "cd" * 8, sampled=True)
+        assert header == f"00-{'ab' * 16}-{'cd' * 8}-01"
+        assert parse_traceparent(header) == ("ab" * 16, "cd" * 8, True)
+        assert parse_traceparent(
+            format_traceparent("ab" * 16, "cd" * 8, sampled=False)
+        ) == ("ab" * 16, "cd" * 8, False)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "junk",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # zero span id
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # invalid version
+        "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",  # uppercase hex
+    ])
+    def test_rejects_malformed(self, bad):
+        assert parse_traceparent(bad) is None
+
+
+class TestRestPropagation:
+    def test_retry_keeps_trace_id_with_fresh_span_id(self, traced):
+        """A transport-retried GET must carry the SAME trace id on both
+        attempts but a NEW span id each time (two wire calls = two spans),
+        and both spans land under the calling span in the exported tree."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from k8s_tpu.client.gvr import PODS
+        from k8s_tpu.client.rest import ClusterConfig, RestClient
+
+        class Handler(BaseHTTPRequestHandler):
+            seen: list = []
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                Handler.seen.append(self.headers.get("traceparent"))
+                if len(Handler.seen) == 1:
+                    return  # close with no response -> transport retry
+                body = json.dumps(
+                    {"kind": "Pod", "metadata": {"name": "p1"}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        Handler.seen = []
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            client = RestClient(ClusterConfig(
+                host=f"http://127.0.0.1:{srv.server_address[1]}"))
+            with trace.span("caller") as root:
+                got = client.get(PODS, "ns1", "p1")
+            assert got["metadata"]["name"] == "p1"
+        finally:
+            srv.shutdown()
+
+        first, second = (parse_traceparent(h) for h in Handler.seen)
+        assert first is not None and second is not None
+        assert first[0] == second[0] == root.trace_id
+        assert first[1] != second[1]
+        (tree,) = trace.TRACER.exporter.snapshot()
+        attempts = tree["children"]
+        assert len(attempts) == 2
+        assert attempts[0]["status"] == "error"  # the aborted wire call
+        assert attempts[1]["status"] == "ok"
+        assert attempts[1]["attributes"]["http_status"] == 200
+
+    def test_no_header_when_tracing_off(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from k8s_tpu.client.gvr import PODS
+        from k8s_tpu.client.rest import ClusterConfig, RestClient
+
+        class Handler(BaseHTTPRequestHandler):
+            seen: list = []
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                Handler.seen.append(self.headers.get("traceparent"))
+                body = json.dumps({"metadata": {"name": "p1"}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        Handler.seen = []
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            client = RestClient(ClusterConfig(
+                host=f"http://127.0.0.1:{srv.server_address[1]}"))
+            client.get(PODS, "ns1", "p1")
+        finally:
+            srv.shutdown()
+        assert Handler.seen == [None]
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestDebugTracesEndpoint:
+    def test_404_with_explicit_body_when_disabled(self):
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        assert not trace.enabled()
+        server = MetricsServer(0, host="127.0.0.1").start()
+        try:
+            code, body = _get(server.port, "/debug/traces")
+            assert code == 404
+            assert "tracing disabled" in body
+            assert "K8S_TPU_TRACE_SAMPLE" in body
+        finally:
+            server.stop()
+
+    def test_serves_traces_slowest_first_with_filters(self, traced):
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        for name, job, dur in (("a", "ns/j1", 0.001), ("b", "ns/j2", 0.05)):
+            with trace.span("sync_tfjob", job=job) as s:
+                s.set_attribute("tag", name)
+                time.sleep(dur)
+        server = MetricsServer(0, host="127.0.0.1").start()
+        try:
+            code, body = _get(server.port, "/debug/traces")
+            payload = json.loads(body)
+            assert code == 200
+            assert payload["count"] == 2
+            # slowest first
+            assert payload["traces"][0]["attributes"]["tag"] == "b"
+            code, body = _get(server.port, "/debug/traces?job=j1&n=10")
+            payload = json.loads(body)
+            assert [t["attributes"]["tag"] for t in payload["traces"]] == ["a"]
+        finally:
+            server.stop()
+
+    def test_dashboard_serves_same_contract(self, traced):
+        import http.client
+
+        from k8s_tpu.client.clientset import Clientset
+        from k8s_tpu.client.fake import FakeCluster
+        from k8s_tpu.dashboard import backend
+
+        with trace.span("sync_tfjob", job="ns/dash"):
+            pass
+        server = backend.DashboardServer(
+            Clientset(FakeCluster()), host="127.0.0.1", port=0)
+        server.start_background()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request("GET", "/debug/traces?job=dash")
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+            assert resp.status == 200
+            assert payload["count"] == 1
+            assert payload["traces"][0]["name"] == "sync_tfjob"
+        finally:
+            server.shutdown()
+
+
+class TestEndToEnd:
+    def test_local_cluster_sync_produces_full_span_tree(self, traced):
+        """ISSUE 2 acceptance: a chaos-free e2e run with sampling on yields
+        >= 1 span tree whose sync_tfjob root has queue-wait, list, and
+        create-batch children, retrievable via /debug/traces — and the
+        created pods carry the trace-id annotation."""
+        import sys
+
+        from k8s_tpu.controller_v2.pod import TRACE_ID_ANNOTATION
+        from k8s_tpu.e2e.components import core_component
+        from k8s_tpu.e2e.local import LocalCluster
+
+        ns = "default"
+        with LocalCluster(version="v1alpha2", namespace=ns,
+                          metrics_port=0) as lc:
+            job = core_component(
+                {"name": "traced-job", "namespace": ns, "num_masters": 0,
+                 "num_workers": 2, "num_ps": 0,
+                 "command": [sys.executable, "-c",
+                             "import time; time.sleep(0.2)"]},
+                "v1alpha2")
+            lc.clientset.tfjobs_unstructured(ns).create(job)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                got = lc.clientset.tfjobs_unstructured(ns).get("traced-job")
+                conds = (got.get("status") or {}).get("conditions") or []
+                if any(c.get("type") == "Succeeded"
+                       and c.get("status") == "True" for c in conds):
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError("job never completed")
+            code, body = _get(lc.metrics_server.port,
+                              "/debug/traces?job=traced-job&n=500")
+            annotations = [
+                ((p.get("metadata") or {}).get("annotations") or {})
+                .get(TRACE_ID_ANNOTATION)
+                for p in lc.clientset.pods(ns).list()
+            ]
+        assert code == 200
+        roots = json.loads(body)["traces"]
+        full = [t for t in roots
+                if t["name"] == "sync_tfjob"
+                and "queue_wait" in _names(t)
+                and any(n.startswith("list") for n in _names(t))
+                and any("batch" in n for n in _names(t))]
+        assert full, [sorted(_names(t)) for t in roots[:3]]
+        # every pod was created inside a traced sync
+        assert annotations and all(annotations), annotations
+        exported_ids = {t["trace_id"] for t in roots}
+        assert set(annotations) <= exported_ids
+
+
+class TestBenchTraceMode:
+    def test_stage_breakdown_from_buffer(self, traced):
+        from k8s_tpu.harness.bench_operator import trace_stage_breakdown
+
+        with trace.span("sync_tfjob"):
+            trace.record_span("queue_wait", 0.002)
+        out = trace_stage_breakdown()
+        assert "stages" in out
+        assert set(out["stages"]) == {"sync_tfjob", "queue_wait"}
+        for stage in out["stages"].values():
+            assert {"count", "p50_ms", "p99_ms"} <= set(stage)
+
+    def test_breakdown_fails_soft_on_empty_buffer(self, traced):
+        from k8s_tpu.harness.bench_operator import trace_stage_breakdown
+
+        out = trace_stage_breakdown()
+        assert "stages" not in out
+        assert "trace_error" in out  # advisory, never an exception
+
+    def test_cli_trace_mode_emits_stages(self, traced, capsys):
+        """`bench_operator --trace` appends the per-stage table to its JSON
+        line (the bench_smoke CI tier's contract)."""
+        from k8s_tpu.harness import bench_operator
+
+        rc = bench_operator.main(
+            ["--jobs", "1", "--replicas", "1", "--timeout", "30", "--trace"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+        assert "stages" in out or "trace_error" in out
+        if "stages" in out:
+            assert "sync_tfjob" in out["stages"]
+
+    def test_ci_smoke_tier_runs_trace_mode(self):
+        import os
+
+        import yaml
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(repo, "ci_config.yaml")) as f:
+            cfg = yaml.safe_load(f)
+        smoke = cfg["tiers"]["bench_smoke"]
+        assert "--trace" in smoke["entry"]
+        assert smoke["gating"] is False  # stays advisory
+
+
+class TestStdlibOnlyGate:
+    def test_trace_package_passes(self):
+        import os
+
+        from k8s_tpu.harness.py_checks import check_trace_stdlib
+
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "k8s_tpu", "trace")
+        files = [f for f in os.listdir(pkg) if f.endswith(".py")]
+        assert files
+        for name in files:
+            assert check_trace_stdlib(os.path.join(pkg, name)) == []
+
+    def test_rule_flags_third_party_and_intra_repo_imports(self):
+        from k8s_tpu.harness.py_checks import check_trace_stdlib
+
+        bad = (b"import yaml\n"
+               b"from k8s_tpu.util import metrics\n"
+               b"from k8s_tpu.trace.tracer import Span\n"
+               b"import json\n")
+        findings = check_trace_stdlib("k8s_tpu/trace/fake.py", source=bad)
+        assert len(findings) == 2
+        assert any("'yaml'" in f for f in findings)
+        assert any("'k8s_tpu.util'" in f for f in findings)
+
+    def test_lint_tier_enforces_rule(self, tmp_path):
+        """A trace-package file with a third-party import fails the lint
+        tier's per-file check (the rule is wired into _lint_one, not just
+        exported)."""
+        from k8s_tpu.harness.py_checks import _lint_one
+
+        pkg = tmp_path / "k8s_tpu" / "trace"
+        pkg.mkdir(parents=True)
+        offender = pkg / "bad.py"
+        offender.write_text("import yaml\n")
+        failure = _lint_one(str(offender))
+        assert failure is not None and "non-stdlib import 'yaml'" in failure

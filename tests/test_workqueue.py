@@ -141,6 +141,70 @@ def test_rate_limiting_queue_exposes_depth():
     q.shut_down()
 
 
+def test_wait_tracking_and_histogram():
+    """get() measures enqueue→dequeue wait, exposes it via pop_wait()
+    (consumed on read), and records it into the process-wide
+    workqueue_wait_seconds histogram."""
+    from k8s_tpu.util import metrics
+    from k8s_tpu.util.workqueue import workqueue_wait_histogram
+
+    hist = workqueue_wait_histogram()
+    count_before = hist._default_child().count
+    q = WorkQueue()
+    q.add("a")
+    time.sleep(0.02)
+    item, _ = q.get()
+    assert item == "a"
+    wait = q.pop_wait("a")
+    assert wait is not None and wait >= 0.02
+    assert q.pop_wait("a") is None  # consumed
+    assert hist._default_child().count == count_before + 1
+    assert "workqueue_wait_seconds_bucket" in metrics.REGISTRY.expose()
+
+
+def test_wait_restarts_on_requeue_while_processing():
+    """An item re-added while processing starts a fresh wait clock when
+    done() returns it to the ready queue — the wait reflects time in the
+    backlog, not time being worked on."""
+    q = WorkQueue()
+    q.add("a")
+    item, _ = q.get()
+    assert q.pop_wait("a") is not None
+    q.add("a")  # dirty while processing: not yet ready
+    q.done("a")  # re-queued now
+    time.sleep(0.01)
+    item, _ = q.get(timeout=1)
+    assert item == "a"
+    wait = q.pop_wait("a")
+    assert wait is not None and wait >= 0.01
+
+
+def test_unclaimed_wait_evicted_at_done():
+    """A consumer that never calls pop_wait (the v1 controller) must not
+    leak one _waits entry per distinct key: done() evicts unclaimed
+    waits."""
+    q = WorkQueue()
+    for key in ("a", "b"):
+        q.add(key)
+        item, _ = q.get()
+        q.done(item)  # no pop_wait in between
+    assert q._wait_tracker._waits == {}
+    assert q.pop_wait("a") is None
+
+
+def test_wait_excludes_add_after_delay():
+    """A delayed item's deliberate add_after delay is NOT counted as queue
+    wait — the clock starts when the timer delivers it to the ready
+    deque."""
+    q = RateLimitingQueue()
+    q.add_after("d", 0.15)
+    item, _ = q.get(timeout=2)
+    assert item == "d"
+    wait = q.pop_wait("d")
+    assert wait is not None and wait < 0.15
+    q.shut_down()
+
+
 def test_rand_string_and_pformat():
     from k8s_tpu.util.util import pformat, rand_string
 
