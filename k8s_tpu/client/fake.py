@@ -112,11 +112,13 @@ class FakeCluster:
         # fake-mode callers mutate returned dicts freely; they keep the
         # default.
         self._copy = _copy_mod.deepcopy if copy_on_io else (lambda x: x)
-        # Injected per-create latency (seconds): models the apiserver round
-        # trip for benches/tests measuring the operator's creation fan-out.
-        # Slept OUTSIDE the store lock, exactly as concurrent real requests
-        # overlap their RTTs on the wire.
+        # Injected per-create/per-delete latency (seconds): models the
+        # apiserver round trip for benches/tests measuring the operator's
+        # creation and teardown fan-outs.  Slept OUTSIDE the store lock,
+        # exactly as concurrent real requests overlap their RTTs on the
+        # wire.
         self.create_delay_s = 0.0
+        self.delete_delay_s = 0.0
 
     def _next_rv(self) -> int:
         with self._lock:
@@ -396,6 +398,8 @@ class FakeCluster:
         name: str,
         propagation: str = "Background",
     ) -> None:
+        if self.delete_delay_s:
+            time.sleep(self.delete_delay_s)
         with self._lock:
             ns = (namespace or "") if resource.namespaced else ""
             bucket = self._bucket(resource)

@@ -38,20 +38,40 @@ SUCCESSFUL_DELETE_POD_REASON = "SuccessfulDelete"
 # per-client seat budget magnitude without approaching storm territory.
 
 DEFAULT_CREATE_CONCURRENCY = 16
+# Teardown mirrors creation: the gang restart after a retryable failure or
+# TPU preemption is delete-all-then-recreate-all, so the delete fan-out gets
+# the same default width and the same apiserver-budget rationale.
+DEFAULT_DELETE_CONCURRENCY = 16
 
 _shared_executor: ThreadPoolExecutor | None = None
+_shared_delete_executor: ThreadPoolExecutor | None = None
 _shared_executor_lock = threading.Lock()
+
+
+def _concurrency_env(var: str) -> int:
+    """Parse one concurrency env var; 0 means unset/garbage/sub-1."""
+    raw = os.environ.get(var, "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = 0
+    return n if n >= 1 else 0
 
 
 def create_concurrency_from_env() -> int:
     """K8S_TPU_CREATE_CONCURRENCY, defaulting to DEFAULT_CREATE_CONCURRENCY;
     values < 1 (or garbage) fall back to the default."""
-    raw = os.environ.get("K8S_TPU_CREATE_CONCURRENCY", "")
-    try:
-        n = int(raw)
-    except ValueError:
-        n = 0
-    return n if n >= 1 else DEFAULT_CREATE_CONCURRENCY
+    return (_concurrency_env("K8S_TPU_CREATE_CONCURRENCY")
+            or DEFAULT_CREATE_CONCURRENCY)
+
+
+def delete_concurrency_from_env() -> int:
+    """K8S_TPU_DELETE_CONCURRENCY, falling back to K8S_TPU_CREATE_CONCURRENCY
+    (one knob tunes both fan-outs — including the documented ``=1`` fully
+    serial bisect mode), then to DEFAULT_DELETE_CONCURRENCY."""
+    return (_concurrency_env("K8S_TPU_DELETE_CONCURRENCY")
+            or _concurrency_env("K8S_TPU_CREATE_CONCURRENCY")
+            or DEFAULT_DELETE_CONCURRENCY)
 
 
 def shared_create_executor() -> ThreadPoolExecutor:
@@ -68,8 +88,22 @@ def shared_create_executor() -> ThreadPoolExecutor:
         return _shared_executor
 
 
-def executor_for_concurrency(concurrency: int | None):
-    """Map a requested create concurrency to an executor:
+def shared_delete_executor() -> ThreadPoolExecutor:
+    """The process-wide deletion pool — DISTINCT from the create pool so a
+    256-replica teardown wave can't starve another job's creation wave (and
+    vice versa); each side keeps its own bounded apiserver budget."""
+    global _shared_delete_executor
+    with _shared_executor_lock:
+        if _shared_delete_executor is None:
+            _shared_delete_executor = ThreadPoolExecutor(
+                max_workers=delete_concurrency_from_env(),
+                thread_name_prefix="delete-fanout",
+            )
+        return _shared_delete_executor
+
+
+def executor_for_concurrency(concurrency: int | None, kind: str = "create"):
+    """Map a requested create/delete concurrency to an executor:
 
     - ``None``  -> the shared env-sized pool (production default);
     - ``1``     -> ``None`` (inline serial; no thread hop for the degenerate
@@ -77,11 +111,56 @@ def executor_for_concurrency(concurrency: int | None):
     - ``n > 1`` -> a dedicated pool the caller owns (must ``shutdown()``).
     """
     if concurrency is None:
-        return shared_create_executor()
+        return (shared_create_executor() if kind == "create"
+                else shared_delete_executor())
     if concurrency <= 1:
         return None
     return ThreadPoolExecutor(max_workers=concurrency,
-                              thread_name_prefix="create-fanout")
+                              thread_name_prefix=f"{kind}-fanout")
+
+
+def _run_batch(calls, executor):
+    """Run one callable per slot through ``executor`` (inline when None or a
+    single slot) and return ``[(result, exc), ...]`` aligned with the input
+    order — partial failures are per-slot data, never a wholesale raise, so
+    callers can unwind exactly the expectations whose calls failed while the
+    successful calls' informer echoes are already in flight."""
+    results: list[tuple[dict | None, Exception | None]]
+    if executor is None or len(calls) <= 1:
+        results = []
+        for call in calls:
+            try:
+                results.append((call(), None))
+            except Exception as e:  # noqa: BLE001 - per-slot failure data
+                results.append((None, e))
+        return results
+
+    def _one(call):
+        try:
+            return (call(), None)
+        except Exception as e:  # noqa: BLE001
+            return (None, e)
+
+    # Carry the wave span onto the pool threads: each slot gets its own
+    # Context copy, so the REST-call spans it opens parent under the
+    # batch span instead of starting orphan traces.
+    from k8s_tpu import trace
+
+    tracing = trace.enabled()
+    futures = []
+    tail: list[tuple[dict | None, Exception | None]] = []
+    for call in calls:
+        try:
+            futures.append(executor.submit(
+                trace.bind_current_context(_one) if tracing else _one,
+                call))
+        except RuntimeError as e:
+            # Executor shut down mid-wave: the unsubmitted slots become
+            # per-slot failures so the caller unwinds exactly their
+            # expectations — a wholesale raise here would also unwind the
+            # already-submitted slots, whose informer echoes are coming.
+            tail.append((None, e))
+    return [f.result() for f in futures] + tail
 
 
 class _BatchCreateMixin:
@@ -105,42 +184,25 @@ class _BatchCreateMixin:
         return getattr(ex, "_max_workers", 1) if ex is not None else 1
 
     def _run_create_batch(self, calls):
-        results: list[tuple[dict | None, Exception | None]]
-        if self._create_executor is None or len(calls) <= 1:
-            results = []
-            for call in calls:
-                try:
-                    results.append((call(), None))
-                except Exception as e:  # noqa: BLE001 - per-slot failure data
-                    results.append((None, e))
-            return results
+        return _run_batch(calls, self._create_executor)
 
-        def _one(call):
-            try:
-                return (call(), None)
-            except Exception as e:  # noqa: BLE001
-                return (None, e)
 
-        # Carry the wave span onto the pool threads: each slot gets its own
-        # Context copy, so the REST-call spans it opens parent under the
-        # create-batch span instead of starting orphan traces.
-        from k8s_tpu import trace
+class _BatchDeleteMixin:
+    """Batch-delete plumbing shared by the real and fake controls — the
+    teardown mirror of ``_BatchCreateMixin``, backed by the separate delete
+    pool so restart waves and creation waves can't starve each other."""
 
-        tracing = trace.enabled()
-        futures = []
-        tail: list[tuple[dict | None, Exception | None]] = []
-        for call in calls:
-            try:
-                futures.append(self._create_executor.submit(
-                    trace.bind_current_context(_one) if tracing else _one,
-                    call))
-            except RuntimeError as e:
-                # Executor shut down mid-wave: the unsubmitted slots become
-                # per-slot failures so the caller unwinds exactly their
-                # expectations — a wholesale raise here would also unwind the
-                # already-submitted slots, whose informer ADDs are coming.
-                tail.append((None, e))
-        return [f.result() for f in futures] + tail
+    _delete_executor = None  # None -> inline serial
+
+    @property
+    def delete_width(self) -> int:
+        """Effective in-flight delete concurrency: the slow-start initial
+        chunk size for teardown waves (same contract as create_width)."""
+        ex = self._delete_executor
+        return getattr(ex, "_max_workers", 1) if ex is not None else 1
+
+    def _run_delete_batch(self, calls):
+        return _run_batch(calls, self._delete_executor)
 
 
 def run_create_wave(expectations, exp_key: str, submit_range, count: int,
@@ -172,25 +234,36 @@ def run_create_wave(expectations, exp_key: str, submit_range, count: int,
                   kind, describe, initial)
 
 
+def _slow_start_submit(submit_range, count: int, initial: int, is_benign,
+                       out: list) -> None:
+    """client-go's slowStartBatch, shared by the create and delete waves:
+    submit in chunks of ``initial``, 2x, 4x, ...; a chunk containing any
+    non-benign failure stops further submission (a hard apiserver rejection
+    costs O(pool-width) calls per retry sync, never a re-storm of all N).
+    Appends per-slot results to ``out`` in place so a contract-violating
+    wholesale raise from ``submit_range`` still leaves the already-submitted
+    slots visible to the caller's unwind accounting."""
+    chunk = max(1, initial)
+    while len(out) < count:
+        lo = len(out)
+        part = submit_range(lo, min(lo + chunk, count))
+        out.extend(part)
+        if any(exc is not None and not is_benign(exc) for _, exc in part):
+            break
+        chunk *= 2
+
+
 def _run_wave(expectations, exp_key: str, submit_range, count: int,
               metrics, kind: str, describe, initial: int) -> None:
     expectations.expect_creations(exp_key, count)
     t0 = time.monotonic()
     results: list[tuple[dict | None, Exception | None]] = []
     try:
-        chunk = max(1, initial)
-        while len(results) < count:
-            lo = len(results)
-            part = submit_range(lo, min(lo + chunk, count))
-            results.extend(part)
-            # Only REAL errors stop the wave: AlreadyExists is a stale
-            # informer cache telling us the object is fine — the remaining
-            # replicas must still be created in this sync, as the old
-            # per-object path did.
-            if any(exc is not None and not _is_already_exists(exc)
-                   for _, exc in part):
-                break
-            chunk *= 2
+        # Only REAL errors stop the wave: AlreadyExists is a stale informer
+        # cache telling us the object is fine — the remaining replicas must
+        # still be created in this sync, as the old per-object path did.
+        _slow_start_submit(submit_range, count, initial, _is_already_exists,
+                           results)
     finally:
         # Slots never submitted (slow-start aborted, or a contract-violating
         # wholesale raise from submit_range): no create happened for them,
@@ -243,6 +316,126 @@ def record_batch_metrics(metrics, kind: str, results, elapsed: float) -> None:
             metrics["creates_total"].labels(gen, kind, result).inc(n)
 
 
+# -- bounded-concurrency deletion layer ----------------------------------------
+#
+# Every deletion path is the prerequisite for a gang restart: on TPU pod
+# slices the whole gang restarts together whenever one host fails, so
+# kill-to-re-running latency is pure idle-TPU time.  The wave contract below
+# is deliberately symmetric with run_create_wave; the asymmetries are the
+# delete-specific semantics (NotFound is success, and some callers — terminal
+# cleanup — swallow errors instead of retrying the sync).
+
+
+def unwind_delete_expectations(expectations, exp_key: str | None,
+                               count: int) -> None:
+    """The one deletion-unwind helper: a failed or never-submitted delete
+    produced no apiserver deletion, so no informer DELETE event will ever
+    decrement its expectation — it must be observed by hand or the job
+    wedges on satisfied_expectations until the TTL.  ``exp_key`` may be
+    None (cleanup of rtype-less pods keeps no expectations).  One bulk
+    lower instead of ``count`` observed calls: an aborted 256-slot wave is
+    one lock acquisition, and both implementations (Python and native)
+    no-op identically on a missing record."""
+    if not exp_key or count <= 0:
+        return
+    expectations.raise_expectations(exp_key, 0, -count)
+
+
+def run_delete_wave(expectations, exp_key: str | None, submit_range,
+                    count: int, metrics, kind: str, describe,
+                    initial: int = 1, raise_on_error: bool = True) -> int:
+    """The teardown-wave contract shared by gang restart, single-pod restart,
+    and terminal cleanup: raise ``count`` deletion expectations up-front,
+    submit deletes in slow-start chunks of ``initial``, 2x, 4x, ... (a hard
+    apiserver rejection costs O(pool-width) calls per retry sync), unwind the
+    expectations of failed and never-submitted slots via
+    ``unwind_delete_expectations``, and treat NotFound as success — the
+    object is already gone, and its informer DELETE event is (or was) in
+    flight; the NotFound slot's expectation is unwound exactly like
+    client-go's DeletionObserved-on-error, so a racing external delete never
+    wedges the job.  ``submit_range(lo, hi)`` must delete slots [lo, hi) and
+    return per-slot ``(result, exc)`` pairs, never raise wholesale.  Returns
+    the number of objects now gone (successes + NotFounds); the first real
+    error re-raises when ``raise_on_error`` (restart paths retry the sync)
+    and is swallowed-after-logging otherwise (terminal cleanup must still
+    write status)."""
+    from k8s_tpu import trace
+
+    with trace.span(f"delete_{kind}s_batch", kind=kind, count=count):
+        return _run_delete_wave(expectations, exp_key, submit_range, count,
+                                metrics, kind, describe, initial,
+                                raise_on_error)
+
+
+def _run_delete_wave(expectations, exp_key, submit_range, count, metrics,
+                     kind, describe, initial, raise_on_error) -> int:
+    if exp_key:
+        expectations.expect_deletions(exp_key, count)
+    t0 = time.monotonic()
+    results: list[tuple[dict | None, Exception | None]] = []
+    try:
+        # Only REAL errors stop the wave: NotFound means the object is
+        # already gone (chaos kill, GC cascade, a prior sync's delete) —
+        # the remaining slots must still be deleted in this sync.
+        _slow_start_submit(submit_range, count, initial, _is_not_found,
+                           results)
+    finally:
+        # Slots never submitted (slow-start aborted, or a contract-violating
+        # wholesale raise from submit_range): nothing was deleted for them.
+        unwind_delete_expectations(expectations, exp_key,
+                                   count - len(results))
+    record_delete_batch_metrics(metrics, kind, results,
+                                time.monotonic() - t0)
+    first_error: Exception | None = None
+    gone = 0
+    for i, (_result, exc) in enumerate(results):
+        if exc is None:
+            gone += 1
+            continue
+        unwind_delete_expectations(expectations, exp_key, 1)
+        if _is_not_found(exc):
+            gone += 1
+            log.info("%s already deleted", describe(i))
+            continue
+        log.warning("delete failed for %s: %s", describe(i), exc)
+        if first_error is None:
+            first_error = exc
+    if first_error is not None and raise_on_error:
+        raise first_error
+    return gone
+
+
+def _is_not_found(exc) -> bool:
+    """The one definition of the already-gone 404 signal: the wave-abort
+    decision, the per-slot unwind, and the metrics classification must all
+    agree on it (mirror of _is_already_exists on the create side)."""
+    from k8s_tpu.client import errors as api_errors
+
+    return (isinstance(exc, api_errors.ApiError)
+            and api_errors.is_not_found(exc))
+
+
+def record_delete_batch_metrics(metrics, kind: str, results,
+                                elapsed: float) -> None:
+    """Account one delete wave into a controller_metrics dict (no-op when
+    the caller runs without metrics, e.g. bare unit-test wiring)."""
+    if not metrics or "deletes_total" not in metrics:
+        return
+    gen = metrics["generation"]
+    metrics["delete_batch_duration"].labels(gen, kind).observe(elapsed)
+    by_result = {"success": 0, "not_found": 0, "error": 0}
+    for _, exc in results:
+        if exc is None:
+            by_result["success"] += 1
+        elif _is_not_found(exc):
+            by_result["not_found"] += 1
+        else:
+            by_result["error"] += 1
+    for result, n in by_result.items():
+        if n:
+            metrics["deletes_total"].labels(gen, kind, result).inc(n)
+
+
 def _validate_controller_ref(ref: OwnerReference) -> None:
     """RealPodControl.createPods validation (upstream pod_control semantics)."""
     if ref is None:
@@ -264,14 +457,20 @@ def _pod_from_template(template: dict, controller_ref: OwnerReference) -> dict:
     return pod
 
 
-class RealPodControl(_BatchCreateMixin):
-    def __init__(self, clientset: Clientset, recorder, executor="shared"):
+class RealPodControl(_BatchCreateMixin, _BatchDeleteMixin):
+    def __init__(self, clientset: Clientset, recorder, executor="shared",
+                 delete_executor="shared"):
         self.clientset = clientset
         self.recorder = recorder
-        # executor: "shared" (default) -> process-wide pool; None -> serial;
-        # or any ThreadPoolExecutor-alike the caller owns (bench/tests).
+        # executor / delete_executor: "shared" (default) -> process-wide
+        # pool; None -> serial; or any ThreadPoolExecutor-alike the caller
+        # owns (bench/tests).
         self._create_executor = (
             shared_create_executor() if executor == "shared" else executor
+        )
+        self._delete_executor = (
+            shared_delete_executor() if delete_executor == "shared"
+            else delete_executor
         )
 
     def create_pods_batch(
@@ -305,6 +504,17 @@ class RealPodControl(_BatchCreateMixin):
         )
         return created
 
+    def delete_pods_batch(
+        self, namespace: str, names: list[str], controller_obj: dict,
+    ) -> list[tuple[dict | None, Exception | None]]:
+        """Fan out one delete per name with bounded concurrency.
+        Returns (result, exc) per slot, input-ordered (result is always
+        None for deletes; only exc carries information)."""
+        return self._run_delete_batch([
+            (lambda n=n: self.delete_pod(namespace, n, controller_obj))
+            for n in names
+        ])
+
     def delete_pod(self, namespace: str, name: str, controller_obj: dict) -> None:
         try:
             self.clientset.pods(namespace).delete(name)
@@ -327,14 +537,19 @@ class RealPodControl(_BatchCreateMixin):
                                              patch_type="strategic")
 
 
-class RealServiceControl(_BatchCreateMixin):
+class RealServiceControl(_BatchCreateMixin, _BatchDeleteMixin):
     """service_control.go:69-115."""
 
-    def __init__(self, clientset: Clientset, recorder, executor="shared"):
+    def __init__(self, clientset: Clientset, recorder, executor="shared",
+                 delete_executor="shared"):
         self.clientset = clientset
         self.recorder = recorder
         self._create_executor = (
             shared_create_executor() if executor == "shared" else executor
+        )
+        self._delete_executor = (
+            shared_delete_executor() if delete_executor == "shared"
+            else delete_executor
         )
 
     def create_services_batch(
@@ -371,6 +586,16 @@ class RealServiceControl(_BatchCreateMixin):
         )
         return created
 
+    def delete_services_batch(
+        self, namespace: str, names: list[str], controller_obj: dict,
+    ) -> list[tuple[dict | None, Exception | None]]:
+        """Fan out one delete per name with bounded concurrency.
+        Returns (result, exc) per slot, input-ordered."""
+        return self._run_delete_batch([
+            (lambda n=n: self.delete_service(namespace, n, controller_obj))
+            for n in names
+        ])
+
     def delete_service(self, namespace: str, name: str, controller_obj: dict) -> None:
         try:
             self.clientset.services(namespace).delete(name)
@@ -391,15 +616,16 @@ class RealServiceControl(_BatchCreateMixin):
                                                  patch_type="strategic")
 
 
-class FakePodControl(_BatchCreateMixin):
+class FakePodControl(_BatchCreateMixin, _BatchDeleteMixin):
     """controller.FakePodControl: captures templates/deletions for asserts.
 
-    Thread-safe: the concurrent creators (create_pods_batch, the per-replica-
-    type reconcile fan-out) hit one fake from many threads, so every capture
-    list append and ``clear()`` runs under a lock.  Batch creates stay inline
-    serial by default (``_create_executor = None``) so per-test capture order
-    is deterministic; the thread-safety matters because the *controller* may
-    call the fake from concurrent reconcile tasks."""
+    Thread-safe: the concurrent creators AND deleters (create_pods_batch,
+    delete_pods_batch, the per-replica-type reconcile fan-out) hit one fake
+    from many threads, so every capture list append and ``clear()`` runs
+    under a lock.  Batch creates/deletes stay inline serial by default
+    (``_create_executor = _delete_executor = None``) so per-test capture
+    order is deterministic; the thread-safety matters because the
+    *controller* may call the fake from concurrent reconcile tasks."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -427,6 +653,12 @@ class FakePodControl(_BatchCreateMixin):
             for t in templates
         ])
 
+    def delete_pods_batch(self, namespace, names, controller_obj):
+        return self._run_delete_batch([
+            (lambda n=n: self.delete_pod(namespace, n, controller_obj))
+            for n in names
+        ])
+
     def delete_pod(self, namespace, name, controller_obj):
         if self.delete_error is not None:
             raise self.delete_error
@@ -447,9 +679,11 @@ class FakePodControl(_BatchCreateMixin):
             self.delete_error = None
 
 
-class FakeServiceControl(_BatchCreateMixin):
+class FakeServiceControl(_BatchCreateMixin, _BatchDeleteMixin):
     """service_control.go:117-175.  Thread-safe for the same reason as
-    FakePodControl."""
+    FakePodControl, and carries the same ``delete_error`` injection seam —
+    the service teardown wave (terminal cleanup under cleanPodPolicy=All)
+    needs failure tests exactly like the pod side."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -458,6 +692,7 @@ class FakeServiceControl(_BatchCreateMixin):
         self.delete_service_names: list[str] = []
         self.patches: list[dict] = []
         self.create_error: Exception | None = None
+        self.delete_error: Exception | None = None
 
     def create_services_with_controller_ref(self, namespace, service, controller_obj, controller_ref):
         _validate_controller_ref(controller_ref)
@@ -476,7 +711,15 @@ class FakeServiceControl(_BatchCreateMixin):
             for s in services
         ])
 
+    def delete_services_batch(self, namespace, names, controller_obj):
+        return self._run_delete_batch([
+            (lambda n=n: self.delete_service(namespace, n, controller_obj))
+            for n in names
+        ])
+
     def delete_service(self, namespace, name, controller_obj):
+        if self.delete_error is not None:
+            raise self.delete_error
         with self._lock:
             self.delete_service_names.append(name)
 
@@ -491,3 +734,4 @@ class FakeServiceControl(_BatchCreateMixin):
             self.delete_service_names = []
             self.patches = []
             self.create_error = None
+            self.delete_error = None

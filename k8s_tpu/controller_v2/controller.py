@@ -51,6 +51,7 @@ class TFJobController:
         service_control=None,
         recorder=None,
         create_concurrency: int | None = None,
+        delete_concurrency: int | None = None,
     ):
         self.clientset = clientset
         # async sink: recording is a buffered enqueue, not an API round trip
@@ -59,6 +60,8 @@ class TFJobController:
         # create_concurrency: None -> shared env-sized pool
         # (K8S_TPU_CREATE_CONCURRENCY, default 16); 1 -> fully serial (the
         # bench baseline); n -> a dedicated pool this controller owns.
+        # delete_concurrency mirrors it for the teardown fan-out
+        # (K8S_TPU_DELETE_CONCURRENCY, falling back to the create knob).
         from k8s_tpu.controller_v2 import control as control_mod
 
         if (create_concurrency is None
@@ -67,21 +70,38 @@ class TFJobController:
             # serial behavior (inline creates AND serial replica types, for
             # bisecting), not a 1-wide thread pool with concurrent rtypes.
             create_concurrency = 1
+        if delete_concurrency is None:
+            if control_mod.delete_concurrency_from_env() == 1:
+                delete_concurrency = 1  # env-pinned fully serial teardown
+            elif create_concurrency == 1:
+                # the explicit fully-serial constructor mode (bench baseline,
+                # bisecting) covers teardown too
+                delete_concurrency = 1
         self._owned_executors: list = []
         create_executor = "shared"
-        if create_concurrency is not None and (
-                pod_control is None or service_control is None):
-            # Only build a dedicated pool when a Real*Control below will
-            # actually submit to it — injected controls (tests) bring their
-            # own creation behavior.
-            create_executor = control_mod.executor_for_concurrency(create_concurrency)
-            if create_executor is not None:
-                self._owned_executors.append(create_executor)
+        delete_executor = "shared"
+        if pod_control is None or service_control is None:
+            # Only build dedicated pools when a Real*Control below will
+            # actually submit to them — injected controls (tests) bring
+            # their own creation/deletion behavior.
+            if create_concurrency is not None:
+                create_executor = control_mod.executor_for_concurrency(
+                    create_concurrency)
+                if create_executor is not None:
+                    self._owned_executors.append(create_executor)
+            if delete_concurrency is not None:
+                delete_executor = control_mod.executor_for_concurrency(
+                    delete_concurrency, kind="delete")
+                if delete_executor is not None:
+                    self._owned_executors.append(delete_executor)
         self.create_concurrency = create_concurrency
+        self.delete_concurrency = delete_concurrency
         self.pod_control = pod_control or RealPodControl(
-            clientset, self.recorder, executor=create_executor)
+            clientset, self.recorder, executor=create_executor,
+            delete_executor=delete_executor)
         self.service_control = service_control or RealServiceControl(
-            clientset, self.recorder, executor=create_executor)
+            clientset, self.recorder, executor=create_executor,
+            delete_executor=delete_executor)
         self.expectations = new_controller_expectations()
         self.enable_gang_scheduling = enable_gang_scheduling
         # (namespace, pdb-name, job-uid) -> minAvailable last created/verified
@@ -496,11 +516,14 @@ class TFJobController:
         return elapsed > deadline
 
     def _clean_up_terminal_pods(self, tfjob) -> None:
-        """cleanPodPolicy for finished jobs: "All" deletes the whole gang,
-        "Running" only pods still running (PS-style replicas that never
-        exit on their own), None/"None" keeps everything.  Deletions go
-        through PodControl with expectations accounting, exactly like a
-        gang restart, so the informer feedback loop stays consistent."""
+        """cleanPodPolicy for finished jobs: "All" deletes the whole gang
+        AND its headless services (which otherwise leak forever — nothing
+        else ever deletes them while the job object is kept), "Running"
+        only pods still running (PS-style replicas that never exit on
+        their own), None/"None" keeps everything.  Deletions go through
+        the control batch APIs in bounded-concurrency waves with
+        expectations accounting, exactly like a gang restart, so the
+        informer feedback loop stays consistent."""
         policy = tfjob.spec.clean_pod_policy or types.CleanPodPolicyNone
         if policy == types.CleanPodPolicyNone:
             # batch/v1 Job semantics for wall-clock budgets: a job failed
@@ -532,27 +555,33 @@ class TFJobController:
             rtype = ((p.get("metadata") or {}).get("labels") or {}).get(
                 tpu_config.LABEL_REPLICA_TYPE)
             by_type.setdefault(rtype or "", []).append(p)
+        from k8s_tpu.controller_v2.control import run_delete_wave
+
         deleted = 0
         for rtype, victims in by_type.items():
             exp_key = (pod_mod.gen_expectation_pods_key(key, rtype)
                        if rtype else None)
-            if exp_key:
-                self.expectations.expect_deletions(exp_key, len(victims))
-            for p in victims:
-                try:
-                    self.pod_control.delete_pod(
-                        tfjob.metadata.namespace, p["metadata"]["name"],
-                        job_dict)
-                    deleted += 1
-                except Exception:  # noqa: BLE001 - transient API failure
-                    # unwind THIS pod's expectation or the leaked count
-                    # wedges every later sync until the TTL (the creation
-                    # path guards its symmetric leak the same way,
-                    # pod.py _create_new_pod)
-                    if exp_key:
-                        self.expectations.deletion_observed(exp_key)
-                    log.exception("cleanPodPolicy delete failed for %s",
-                                  p["metadata"]["name"])
+            names = [p["metadata"]["name"] for p in victims]
+            # raise_on_error=False: the terminal path must still write
+            # status this sync; failed slots are unwound inside the wave
+            # (no DELETE event will decrement them) and the pods are simply
+            # re-listed by the next sync of the still-terminal job.
+            deleted += run_delete_wave(
+                self.expectations, exp_key,
+                lambda lo, hi, names=names: self.pod_control.delete_pods_batch(
+                    tfjob.metadata.namespace, names[lo:hi], job_dict),
+                len(names), self.metrics, "pod",
+                lambda i, names=names: f"pod {names[i]} (cleanPodPolicy)",
+                initial=getattr(self.pod_control, "delete_width", 1),
+                raise_on_error=False,
+            )
+        svc_deleted = self._clean_up_terminal_services(tfjob, policy, key,
+                                                       job_dict)
+        if svc_deleted:
+            self.recorder.eventf(
+                job_dict, "Normal", "CleanPodPolicy",
+                "Deleted %d service(s) of finished TFJob per "
+                "cleanPodPolicy=All", svc_deleted)
         if deleted:
             if escalated:
                 # the spec never set Running — say why pods vanished under
@@ -568,6 +597,41 @@ class TFJobController:
                     job_dict, "Normal", "CleanPodPolicy",
                     "Deleted %d pod(s) of finished TFJob per "
                     "cleanPodPolicy=%s", deleted, policy)
+
+    def _clean_up_terminal_services(self, tfjob, policy, key: str,
+                                    job_dict: dict) -> int:
+        """Under cleanPodPolicy=All a finished job keeps nothing — including
+        its per-index headless services, which the old pod-only cleanup
+        leaked forever.  Scoped to the explicit "All" policy: "Running"
+        (and the DeadlineExceeded escalation to it) keeps exited pods for
+        logs, and their DNS names stay resolvable with them."""
+        if policy != types.CleanPodPolicyAll:
+            return 0
+        from k8s_tpu.controller_v2.control import run_delete_wave
+
+        by_type: dict[str, list] = {}
+        for s in self.get_services_for_tfjob(tfjob):
+            if (s.get("metadata") or {}).get("deletionTimestamp"):
+                continue  # already being deleted
+            rtype = ((s.get("metadata") or {}).get("labels") or {}).get(
+                tpu_config.LABEL_REPLICA_TYPE)
+            by_type.setdefault(rtype or "", []).append(s)
+        deleted = 0
+        for rtype, victims in by_type.items():
+            exp_key = (service_mod.gen_expectation_services_key(key, rtype)
+                       if rtype else None)
+            names = [s["metadata"]["name"] for s in victims]
+            deleted += run_delete_wave(
+                self.expectations, exp_key,
+                lambda lo, hi, names=names:
+                    self.service_control.delete_services_batch(
+                        tfjob.metadata.namespace, names[lo:hi], job_dict),
+                len(names), self.metrics, "service",
+                lambda i, names=names: f"service {names[i]} (cleanPodPolicy)",
+                initial=getattr(self.service_control, "delete_width", 1),
+                raise_on_error=False,
+            )
+        return deleted
 
     @staticmethod
     def _status_changed(observed: dict | None, current: dict) -> bool:

@@ -290,7 +290,6 @@ class PodReconciler:
                 "Pod %s lost to node preemption/teardown; restarting",
                 pod["metadata"]["name"],
             )
-        key = tpu_config.tfjob_key(tfjob)
         name = pod["metadata"]["name"]
         log.info("restarting pod %s (retryable exit code)", name)
         with self.status_lock:
@@ -302,16 +301,12 @@ class PodReconciler:
                     f"pod {name} exited retryably and is restarting",
                 ),
             )
-        exp_key = gen_expectation_pods_key(key, rtype.lower())
-        self.expectations.expect_deletions(exp_key, 1)
-        try:
-            self.pod_control.delete_pod(tfjob.metadata.namespace, name, job_dict)
-        except Exception:
-            # A failed delete produces no informer DELETE event, so the raised
-            # expectation must be unwound or the job wedges until the TTL —
-            # the same invariant run_create_wave enforces for creates.
-            self.expectations.deletion_observed(exp_key)
-            raise
+        # Single-pod restart batches trivially: a 1-slot wave buys the shared
+        # expectation-unwind, NotFound-as-success, span, and metrics contract
+        # for free (run_delete_wave — the invariant the old inline
+        # try/except hand-rolled).
+        self._delete_pods_wave(tfjob, rtype, [name], job_dict,
+                               reason="retryable-exit restart")
         return True
 
     # -- gang restart --------------------------------------------------------
@@ -358,21 +353,35 @@ class PodReconciler:
             job_dict, "Normal", "GangRestart",
             "Restarting whole %s gang (%d pods) after retryable failure", rtype, len(pods),
         )
-        exp_key = gen_expectation_pods_key(key, rtype)
-        self.expectations.expect_deletions(exp_key, len(pods))
-        for i, pod in enumerate(pods):
-            try:
-                self.pod_control.delete_pod(
-                    tfjob.metadata.namespace, pod["metadata"]["name"], job_dict
-                )
-            except Exception:
-                # Unwind this pod's expectation AND every not-yet-submitted
-                # one: no DELETE event will ever decrement them (the already-
-                # deleted pods' events are in flight and stay counted).
-                for _ in range(len(pods) - i):
-                    self.expectations.deletion_observed(exp_key)
-                raise
+        # The hot path: kill-to-re-running is what chaos measures, and a
+        # serial teardown of a 256-replica slice gang is O(N x RTT) of pure
+        # idle-TPU time.  One bounded-concurrency wave instead — failed and
+        # never-submitted slots are unwound by the shared helper, the
+        # already-deleted pods' DELETE events stay counted.
+        self._delete_pods_wave(
+            tfjob, rtype, [p["metadata"]["name"] for p in pods], job_dict,
+            reason="gang restart")
         return True
+
+    def _delete_pods_wave(
+        self, tfjob: types.TFJob, rtype: str, names: list[str],
+        job_dict: dict, reason: str,
+    ) -> None:
+        """Tear down ``names`` in one bounded-concurrency wave (contract:
+        control.run_delete_wave — deletion expectations raised up-front,
+        per-slot unwind on failure, NotFound counts as deleted, first real
+        error re-raised so the sync retries)."""
+        from k8s_tpu.controller_v2.control import run_delete_wave
+
+        key = tpu_config.tfjob_key(tfjob)
+        run_delete_wave(
+            self.expectations, gen_expectation_pods_key(key, rtype),
+            lambda lo, hi: self.pod_control.delete_pods_batch(
+                tfjob.metadata.namespace, names[lo:hi], job_dict),
+            len(names), self.metrics, "pod",
+            lambda i: f"pod {names[i]} ({reason} of {key})",
+            initial=getattr(self.pod_control, "delete_width", 1),
+        )
 
     # -- creation ------------------------------------------------------------
 

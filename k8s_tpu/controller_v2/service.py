@@ -194,6 +194,9 @@ def make_service_event_handlers(controller):
                 controller.enqueue_tfjob(tfjob)
 
     def delete_service(svc: dict) -> None:
+        """Observe teardown-wave deletions (symmetric with the pod DELETE
+        handler): the terminal-cleanup service wave raises deletion
+        expectations, and this DELETE echo is what decrements them."""
         meta = svc.get("metadata") or {}
         from k8s_tpu.api.meta import get_controller_of
 
@@ -201,7 +204,13 @@ def make_service_event_handlers(controller):
         if ref is None:
             return
         tfjob = controller.resolve_controller_ref(meta.get("namespace", ""), ref)
-        if tfjob is not None:
-            controller.enqueue_tfjob(tfjob)
+        if tfjob is None:
+            return
+        rtype = (meta.get("labels") or {}).get(tpu_config.LABEL_REPLICA_TYPE)
+        if rtype:
+            key = tpu_config.tfjob_key(tfjob)
+            controller.expectations.deletion_observed(
+                gen_expectation_services_key(key, rtype))
+        controller.enqueue_tfjob(tfjob)
 
     return add_service, update_service, delete_service

@@ -32,6 +32,8 @@ class LocalCluster:
         backend_mode: str = "fake",
         create_concurrency: int | None = None,
         create_delay_s: float = 0.0,
+        delete_concurrency: int | None = None,
+        delete_delay_s: float = 0.0,
         metrics_port: int | None = None,
     ):
         # metrics_port wires the operator observability endpoint
@@ -64,6 +66,9 @@ class LocalCluster:
         if create_delay_s and hasattr(self.backend, "create_delay_s"):
             # fake-backend RTT injection for creation fan-out benches
             self.backend.create_delay_s = create_delay_s
+        if delete_delay_s and hasattr(self.backend, "delete_delay_s"):
+            # symmetric RTT injection for teardown/restart benches
+            self.backend.delete_delay_s = delete_delay_s
         self.clientset = Clientset(self.backend)
         self.namespace = namespace
         self.version = version
@@ -88,6 +93,7 @@ class LocalCluster:
                 informer_factory=factory,
                 enable_gang_scheduling=enable_gang_scheduling,
                 create_concurrency=create_concurrency,
+                delete_concurrency=delete_concurrency,
             )
         self.kubelet = KubeletSimulator(
             self.clientset, namespace, **(kubelet_kwargs or {})

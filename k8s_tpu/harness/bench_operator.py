@@ -63,6 +63,25 @@ def _worker_gang_job(name: str, namespace: str, replicas: int) -> dict:
     }
 
 
+def _tpu_gang_job(name: str, namespace: str, replicas: int) -> dict:
+    """SPMD TPU gang of arbitrary size for the restart scenario (gang
+    restart is type-gated to TPU replicas, so a Worker gang won't exercise
+    the teardown wave): a single v5e slice up to 64 hosts, multislice
+    (``numSlices``) beyond — 256 replicas is 4 x v5litepod-256, the
+    all-or-nothing restart domain the teardown fan-out exists for."""
+    from k8s_tpu.cmd.genjob import V5E_MAX_HOSTS, tfjob_template
+
+    # largest power-of-two slice that fits (v5e topology constraint); any
+    # remainder is expressed as extra slices — the operator only cares that
+    # the replica count matches what the bench asks for
+    hosts = 1 << (min(replicas, V5E_MAX_HOSTS).bit_length() - 1)
+    job = tfjob_template(name, namespace, tpu=True, tpu_replicas=hosts)
+    if replicas != hosts:
+        job["spec"]["tpu"]["numSlices"] = -(-replicas // hosts)
+        job["spec"]["tfReplicaSpecs"]["TPU"]["replicas"] = replicas
+    return job
+
+
 def _all_replicas_running(job: dict) -> bool:
     """The metric's definition is ALL replica pods Running; the controller's
     startTime is set exactly when running == replicas
@@ -86,14 +105,17 @@ def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
                         resync_period_s: float = 5.0,
                         backend_mode: str = "fake",
                         create_delay_s: float = 0.0,
-                        create_concurrency: int | None = None) -> dict:
+                        create_concurrency: int | None = None,
+                        delete_delay_s: float = 0.0,
+                        delete_concurrency: int | None = None) -> dict:
     """Submit ``jobs`` gang jobs back to back; measure each
     submit→all-replicas-Running latency and the aggregate throughput.
 
-    ``create_delay_s`` injects a per-create RTT into the fake backend (the
-    apiserver-round-trip model the slice-scale comparison needs) and
-    ``create_concurrency`` pins the controller's creation fan-out width
-    (1 = the serial baseline, None = production default)."""
+    ``create_delay_s``/``delete_delay_s`` inject per-create/per-delete RTTs
+    into the fake backend (the apiserver-round-trip model the fan-out
+    comparisons need); ``create_concurrency``/``delete_concurrency`` pin the
+    controller's fan-out widths (1 = the serial baselines, None =
+    production defaults)."""
     if jobs < 1:
         raise ValueError("jobs must be >= 1")
     from k8s_tpu.e2e.local import LocalCluster
@@ -116,7 +138,9 @@ def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
                       resync_period_s=resync_period_s,
                       backend_mode=backend_mode,
                       create_concurrency=create_concurrency,
-                      create_delay_s=create_delay_s)
+                      create_delay_s=create_delay_s,
+                      delete_concurrency=delete_concurrency,
+                      delete_delay_s=delete_delay_s)
     # Per-sync latency accounting: wrap the sync seam before workers start
     # so every pass lands one raw sample (histogram buckets can't give
     # exact p99 at bench sample counts).
@@ -311,6 +335,141 @@ def run_slice_scale(args) -> dict:
     }
 
 
+def _restart_rounds(replicas: int, delete_latency_s: float,
+                    delete_concurrency: int | None, rounds: int,
+                    timeout_s: float) -> list[float]:
+    """``rounds`` kill-to-all-Running samples against one local cluster:
+    bring up a TPU gang, wait until every replica is Running, then per round
+    fail one member retryably (SIGTERM/143, the preemption signature) and
+    measure until a full gang of NEW pods is Running again.  The injected
+    per-delete RTT (``FakeCluster.delete_delay_s``) makes the teardown wave
+    the dominant term, so parallel-vs-serial isolates exactly the
+    delete fan-out; creates run at RTT 0 in both modes."""
+    from k8s_tpu.client.gvr import PODS
+    from k8s_tpu.e2e.local import LocalCluster
+
+    ns = "bench"
+    samples: list[float] = []
+    lc = LocalCluster(version="v1alpha2", namespace=ns,
+                      enable_gang_scheduling=True,
+                      # synthetic pods must stay Running for the whole bench:
+                      # only the injected failure may take a gang member down
+                      kubelet_kwargs={"default_runtime_s": 20 * timeout_s},
+                      threadiness=1, resync_period_s=5.0,
+                      delete_concurrency=delete_concurrency,
+                      delete_delay_s=delete_latency_s)
+    with lc:
+        # Watch-based phase tracking (same rationale as bench_time_to_ready:
+        # observe the operator instead of competing with it): one dict of
+        # pod name -> phase, fed by the event stream, deleted pods removed.
+        w = lc.backend.watch(PODS, ns)
+        try:
+            phases: dict[str, str] = {}
+
+            def pump_until(pred, deadline: float, what: str) -> None:
+                while True:
+                    if pred():
+                        return
+                    if time.perf_counter() >= deadline:
+                        raise RuntimeError(
+                            f"restart bench: {what} not reached in "
+                            f"{timeout_s}s")
+                    item = w.next(timeout=0.2)
+                    if item is None:
+                        continue
+                    etype, pod = item
+                    name = (pod.get("metadata") or {}).get("name")
+                    if etype == "DELETED":
+                        phases.pop(name, None)
+                    else:
+                        phases[name] = (pod.get("status") or {}).get("phase")
+
+            lc.clientset.tfjobs_unstructured(ns).create(
+                _tpu_gang_job("restart-0", ns, replicas))
+            pump_until(
+                lambda: sum(1 for p in phases.values()
+                            if p == "Running") >= replicas,
+                time.perf_counter() + timeout_s, "initial gang Running")
+
+            for _ in range(max(1, rounds)):
+                gen = set(phases)  # the incumbent gang's pod names
+                victim = next(n for n, p in phases.items() if p == "Running")
+                lc.backend.set_pod_phase(
+                    ns, victim, "Failed",
+                    containerStatuses=[{
+                        "name": "tensorflow",
+                        "state": {"terminated": {"exitCode": 143}},
+                    }])
+                t0 = time.perf_counter()
+                # recovered == a FULL gang of new-generation pods Running
+                # (the whole gang restarts together: every incumbent is
+                # torn down, so no gen-1 name may satisfy the count)
+                pump_until(
+                    lambda: sum(
+                        1 for n, p in phases.items()
+                        if p == "Running" and n not in gen) >= replicas,
+                    t0 + timeout_s, "gang re-Running after kill")
+                samples.append(time.perf_counter() - t0)
+        finally:
+            w.stop()
+    return samples
+
+
+def bench_restart(replicas: int = 256, delete_latency_s: float = 0.01,
+                  delete_concurrency: int | None = None, rounds: int = 3,
+                  serial_rounds: int = 1,
+                  timeout_s: float = 60.0) -> dict:
+    """Gang-restart teardown fan-out: 1 TPU gang x ``replicas``, fake
+    backend with ``delete_latency_s`` injected per delete.  Runs the
+    parallel teardown ``rounds`` times and the serial baseline
+    ``serial_rounds`` times (a serial teardown is O(replicas x RTT) — one
+    round of it dominates the whole parallel series), reporting
+    kill-to-all-Running p50 for both."""
+    from k8s_tpu.controller_v2 import control as control_mod
+
+    if delete_concurrency is None:
+        delete_concurrency = control_mod.delete_concurrency_from_env()
+    par = _restart_rounds(replicas, delete_latency_s, delete_concurrency,
+                          rounds, timeout_s)
+    with untraced():  # baseline spans stay out of the --trace stage table
+        ser = _restart_rounds(replicas, delete_latency_s, 1,
+                              max(1, serial_rounds), timeout_s)
+    par_sorted = sorted(par)
+    ser_sorted = sorted(ser)
+    p50_par = _quantile(par_sorted, 0.50)
+    p50_ser = _quantile(ser_sorted, 0.50)
+    return {
+        "replicas": replicas,
+        "delete_latency_ms": round(delete_latency_s * 1e3, 3),
+        "delete_concurrency": delete_concurrency,
+        "rounds": len(par),
+        "kill_to_running_p50_s": round(p50_par, 4),
+        "kill_to_running_max_s": round(max(par), 4),
+        "serial_kill_to_running_p50_s": round(p50_ser, 4),
+        "restart_speedup": round(p50_ser / p50_par, 2) if p50_par else 0.0,
+    }
+
+
+def run_measure_restart(args) -> dict:
+    """The --measure-restart scenario: kill-to-all-Running for a 1 x N TPU
+    gang under an injected per-delete RTT, parallel vs serial teardown.
+    Returns one JSON-able dict (bench.py contract: metric/value/unit
+    headline + supporting keys)."""
+    r = bench_restart(
+        replicas=args.slice_replicas,
+        delete_latency_s=args.delete_latency,
+        delete_concurrency=args.delete_concurrency,
+        rounds=args.restart_rounds,
+        timeout_s=args.timeout,
+    )
+    return {
+        "metric": "gang_kill_to_running_p50",
+        "value": r["kill_to_running_p50_s"],
+        "unit": "s",
+        **r,
+    }
+
+
 def _noop_ctx():
     import contextlib
 
@@ -403,6 +562,22 @@ def main(argv=None) -> int:
                    "K8S_TPU_CREATE_CONCURRENCY or 16)")
     p.add_argument("--slice-rounds", type=int, default=3,
                    help="parallel-path rounds for p50/p99 sync latency")
+    p.add_argument("--measure-restart", action="store_true",
+                   help="run the gang-restart teardown scenario (1 TPU gang "
+                   "x --slice-replicas, fail one member retryably, measure "
+                   "kill-to-all-Running at parallel vs serial teardown "
+                   "under --delete-latency) and emit one JSON line; "
+                   "combinable with --slice-scale (two lines)")
+    p.add_argument("--delete-latency", type=float, default=None,
+                   help="injected per-delete RTT seconds (fake backend "
+                   "only; default 0.01 under --measure-restart)")
+    p.add_argument("--delete-concurrency", type=int, default=None,
+                   help="pin the controller's teardown fan-out width "
+                   "(1 = fully serial legacy path; default: "
+                   "K8S_TPU_DELETE_CONCURRENCY, falling back to "
+                   "K8S_TPU_CREATE_CONCURRENCY, then 16)")
+    p.add_argument("--restart-rounds", type=int, default=3,
+                   help="parallel-teardown kill-to-running samples for p50")
     p.add_argument("--trace", action="store_true",
                    help="force tracing on (sample rate 1.0) and append a "
                    "per-stage p50/p99 breakdown ('stages') to the JSON "
@@ -417,26 +592,38 @@ def main(argv=None) -> int:
 
         trace.configure(sample_rate=1.0)
 
-    if args.slice_scale:
+    if args.slice_scale or args.measure_restart:
         if args.backend != "fake":
-            p.error("--slice-scale requires --backend fake: the injected "
-                    "per-create RTT only exists on the fake backend")
+            p.error("--slice-scale/--measure-restart require --backend "
+                    "fake: the injected per-create/per-delete RTTs only "
+                    "exist on the fake backend")
         if args.create_latency is None:
             args.create_latency = 0.01
-        result = run_slice_scale(args)
+        if args.delete_latency is None:
+            args.delete_latency = 0.01
+        results = []
+        if args.slice_scale:
+            results.append(run_slice_scale(args))
+        if args.measure_restart:
+            results.append(run_measure_restart(args))
         if args.trace:
-            result.update(trace_stage_breakdown())
-        print(json.dumps(result))
+            # one stage table for the whole invocation, on the last line
+            results[-1].update(trace_stage_breakdown())
+        for result in results:
+            print(json.dumps(result))
         return 0
 
-    if args.create_latency and args.backend != "fake":
-        p.error("--create-latency only exists on the fake backend")
+    if (args.create_latency or args.delete_latency) and args.backend != "fake":
+        p.error("--create-latency/--delete-latency only exist on the fake "
+                "backend")
     result = bench_time_to_ready(args.jobs, args.replicas, args.timeout,
                                  threadiness=args.threadiness,
                                  resync_period_s=args.resync,
                                  backend_mode=args.backend,
                                  create_delay_s=args.create_latency or 0.0,
-                                 create_concurrency=args.create_concurrency)
+                                 create_concurrency=args.create_concurrency,
+                                 delete_delay_s=args.delete_latency or 0.0,
+                                 delete_concurrency=args.delete_concurrency)
     out = {"metric": "tfjob_time_to_ready_p50",
            "value": result["time_to_ready_p50_s"],
            "unit": "s", "backend": args.backend, **result}

@@ -304,5 +304,19 @@ def controller_metrics(generation: str, registry: Optional[Registry] = None) -> 
             "Pod/service creates issued by the fan-out layer, by result.",
             ("generation", "kind", "result"),
         ),
+        # -- teardown fan-out telemetry (parallel delete waves) ----------------
+        "delete_batch_duration": r.histogram(
+            "tfjob_delete_batch_duration_seconds",
+            "Wall time of one bounded-concurrency delete wave (gang "
+            "restart, single-pod restart, or terminal cleanup).",
+            ("generation", "kind"),
+        ),
+        "deletes_total": r.counter(
+            "tfjob_deletes_total",
+            "Pod/service deletes issued by the teardown fan-out layer, by "
+            "result (success / not_found / error; not_found counts as "
+            "deleted — the object was already gone).",
+            ("generation", "kind", "result"),
+        ),
         "generation": generation,
     }

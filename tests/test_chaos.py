@@ -67,6 +67,58 @@ def test_job_completes_after_chaos_storm():
                 f"job did not recover from chaos: {_conditions(got)}")
 
 
+def test_gang_restarts_whole_slice_after_retryable_failure():
+    """Kill-to-re-running at gang scale: one TPU gang member fails with the
+    preemption signature (SIGTERM/143) and the operator tears down the
+    WHOLE slice in one bounded-concurrency delete wave, then brings back a
+    full gang of new pods — the all-or-nothing SPMD restart the teardown
+    fan-out exists for (tests/test_restart_semantics.py covers the
+    exit-code classification half)."""
+    from k8s_tpu.harness.bench_operator import _tpu_gang_job
+
+    replicas = 8
+    with LocalCluster(version="v1alpha2", namespace=NS,
+                      enable_gang_scheduling=True,
+                      kubelet_kwargs={"default_runtime_s": 300.0}) as lc:
+        cs = lc.clientset
+        cs.tfjobs_unstructured(NS).create(_tpu_gang_job("gang-job", NS,
+                                                        replicas))
+
+        def running_pods() -> set[str]:
+            return {p["metadata"]["name"]
+                    for p in cs.pods(NS).list()
+                    if (p.get("status") or {}).get("phase") == "Running"}
+
+        deadline = time.time() + 30
+        gen1: set[str] = set()
+        while time.time() < deadline and len(gen1) < replicas:
+            gen1 = running_pods()
+            time.sleep(0.05)
+        assert len(gen1) == replicas, (
+            f"initial gang never fully Running ({len(gen1)}/{replicas})")
+
+        victim = sorted(gen1)[0]
+        lc.backend.set_pod_phase(
+            NS, victim, "Failed",
+            containerStatuses=[{"name": "tensorflow",
+                                "state": {"terminated": {"exitCode": 143}}}])
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(running_pods() - gen1) >= replicas:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                "gang did not restart to a full new generation: "
+                f"{len(running_pods() - gen1)}/{replicas} new pods Running")
+        # all-or-nothing: no incumbent survived the restart
+        assert not (running_pods() & gen1)
+        got = cs.tfjobs_unstructured(NS).get("gang-job")
+        assert any(c.get("type") == "Restarting" for c in _conditions(got)), (
+            _conditions(got))
+
+
 def test_monkey_level_zero_is_inert():
     cs = Clientset(FakeCluster())
     cs.pods(NS).create({"metadata": {"name": "p1"},

@@ -1,0 +1,507 @@
+"""Parallel gang-teardown tests: bounded-concurrency delete fan-out
+(controller_v2.control batch delete APIs + run_delete_wave), expectation
+unwind under mid-wave failure, NotFound-as-success, terminal service
+cleanup, delete telemetry, and the teardown wall-clock regression guard."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from k8s_tpu.api import v1alpha2
+from k8s_tpu.client import Clientset, FakeCluster, errors
+from k8s_tpu.client.gvr import PODS
+from k8s_tpu.client.record import FakeRecorder
+from k8s_tpu.controller_v2 import service as service_mod
+from k8s_tpu.controller_v2.control import (
+    FakePodControl,
+    FakeServiceControl,
+    RealPodControl,
+    delete_concurrency_from_env,
+    executor_for_concurrency,
+    run_delete_wave,
+    unwind_delete_expectations,
+)
+from k8s_tpu.controller_v2.expectations import new_controller_expectations
+from k8s_tpu.controller_v2.pod import gen_expectation_pods_key
+from k8s_tpu.controller_v2.service import gen_expectation_services_key
+from k8s_tpu.controller_v2.status import get_condition
+from tests.test_controller_v2 import (
+    KEY,
+    NS,
+    build_controller,
+    make_pod,
+    make_service,
+    make_tfjob,
+)
+
+
+class TestDeleteConcurrencyEnv:
+    def test_fallback_chain(self, monkeypatch):
+        monkeypatch.delenv("K8S_TPU_DELETE_CONCURRENCY", raising=False)
+        monkeypatch.delenv("K8S_TPU_CREATE_CONCURRENCY", raising=False)
+        assert delete_concurrency_from_env() == 16
+        # falls back to the create knob when unset...
+        monkeypatch.setenv("K8S_TPU_CREATE_CONCURRENCY", "4")
+        assert delete_concurrency_from_env() == 4
+        # ...but its own knob wins
+        monkeypatch.setenv("K8S_TPU_DELETE_CONCURRENCY", "8")
+        assert delete_concurrency_from_env() == 8
+        # garbage/sub-1 values fall through the chain
+        monkeypatch.setenv("K8S_TPU_DELETE_CONCURRENCY", "zero")
+        assert delete_concurrency_from_env() == 4
+        monkeypatch.setenv("K8S_TPU_DELETE_CONCURRENCY", "-3")
+        monkeypatch.setenv("K8S_TPU_CREATE_CONCURRENCY", "junk")
+        assert delete_concurrency_from_env() == 16
+
+    def test_env_serial_pins_delete_executor(self, monkeypatch):
+        """K8S_TPU_DELETE_CONCURRENCY=1 (or CREATE=1 with DELETE unset —
+        the documented fully-serial bisect knob) must force inline-serial
+        deletes on the real controls."""
+        from tests.test_fanout import build_controller as fanout_controller
+        from tests.test_fanout import make_tfjob as fanout_tfjob
+
+        monkeypatch.setenv("K8S_TPU_DELETE_CONCURRENCY", "1")
+        tc, _ = fanout_controller(fanout_tfjob(worker=1))
+        try:
+            assert tc.delete_concurrency == 1
+            assert tc.pod_control._delete_executor is None
+            assert tc.service_control._delete_executor is None
+        finally:
+            tc.shutdown()
+        monkeypatch.delenv("K8S_TPU_DELETE_CONCURRENCY", raising=False)
+        monkeypatch.setenv("K8S_TPU_CREATE_CONCURRENCY", "1")
+        tc, _ = fanout_controller(fanout_tfjob(worker=1))
+        try:
+            assert tc.delete_concurrency == 1
+            assert tc.pod_control._delete_executor is None
+        finally:
+            tc.shutdown()
+
+    def test_dedicated_delete_pool_width(self):
+        """An explicit delete_concurrency=n gives the controller's controls
+        a dedicated n-wide delete pool (the bench's pinning knob)."""
+        from k8s_tpu.client.informer import SharedInformerFactory
+        from k8s_tpu.controller_v2.controller import TFJobController
+
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        tc = TFJobController(
+            cs, informer_factory=SharedInformerFactory(fc, resync_period=0),
+            enable_gang_scheduling=False, recorder=FakeRecorder(),
+            delete_concurrency=4,
+        )
+        try:
+            assert tc.delete_concurrency == 4
+            assert tc.pod_control.delete_width == 4
+            assert tc.service_control.delete_width == 4
+        finally:
+            tc.shutdown()
+        assert executor_for_concurrency(1, "delete") is None
+
+
+class _FailByNameControl(FakePodControl):
+    """Deletes fail for an explicit set of pod names — deterministic under
+    any executor width, unlike count-based flaky controls."""
+
+    def __init__(self, failing_names=(), not_found_names=()):
+        super().__init__()
+        self.failing_names = set(failing_names)
+        self.not_found_names = set(not_found_names)
+
+    def delete_pod(self, namespace, name, controller_obj):
+        if name in self.failing_names:
+            raise RuntimeError(f"apiserver 500 for {name}")
+        if name in self.not_found_names:
+            raise errors.not_found(f"pods {name} not found")
+        super().delete_pod(namespace, name, controller_obj)
+
+
+class TestGangTeardownWave:
+    def _gang(self, n=4, failed_index=None):
+        pods = []
+        for i in range(n):
+            if i == failed_index:
+                pods.append(make_pod("tpu", i, "Failed", exit_code=143))
+            else:
+                pods.append(make_pod("tpu", i, "Running"))
+        return pods
+
+    def test_mid_wave_failure_unwinds_unsubmitted_remainder(self):
+        """One delete fails mid-wave: exactly the successful slots' DELETE
+        echoes stay owed — the failed slot and every never-submitted slot
+        are unwound (invariant to wave ordering, which the lister does not
+        guarantee)."""
+        tfjob = make_tfjob(tpu=8, restart_policy="ExitCode")
+        pods = self._gang(8, failed_index=7)
+        failing = pods[3]["metadata"]["name"]
+        pod_control = _FailByNameControl(failing_names=[failing])
+        controller, _, _, _ = build_controller(tfjob, pods, [])
+        controller.pod_control = pod_control
+        controller.pod_reconciler.pod_control = pod_control
+        with pytest.raises(RuntimeError, match="apiserver 500"):
+            controller.sync_tfjob(KEY)
+        # slow-start aborted at the failing chunk: not all 8 were submitted
+        owed = len(pod_control.delete_pod_names)
+        assert owed < 8
+        exp_key = gen_expectation_pods_key(KEY, "tpu")
+        if owed:  # successful deletes keep their echoes owed...
+            assert not controller.expectations.satisfied(exp_key)
+        for _ in range(owed):
+            controller.expectations.deletion_observed(exp_key)
+        # ...and the failed + never-submitted slots were already unwound
+        assert controller.expectations.satisfied(exp_key)
+
+    def test_total_failure_over_pool_unwinds_everything(self):
+        """Every delete in the first (pool-width) chunk fails: the wave
+        stops after O(pool-width) calls and EVERY raised expectation is
+        unwound — failed chunk and unsubmitted remainder alike."""
+        tfjob = make_tfjob(tpu=8, restart_policy="ExitCode")
+        pod_control = FakePodControl()
+        pod_control.delete_error = RuntimeError("apiserver 500")
+        pod_control._delete_executor = executor_for_concurrency(4, "delete")
+        controller, _, _, _ = build_controller(
+            tfjob, self._gang(8, failed_index=7), [])
+        controller.pod_control = pod_control
+        controller.pod_reconciler.pod_control = pod_control
+        try:
+            with pytest.raises(RuntimeError, match="apiserver 500"):
+                controller.sync_tfjob(KEY)
+            assert pod_control.delete_pod_names == []
+            assert controller.expectations.satisfied(
+                gen_expectation_pods_key(KEY, "tpu"))
+        finally:
+            pod_control._delete_executor.shutdown(wait=False)
+
+    def test_not_found_counts_as_deleted(self):
+        """A pod already gone (chaos kill, prior sync) is success: the wave
+        keeps going, nothing raises, the restart proceeds, and the NotFound
+        slot's expectation is unwound (client-go DeletionObserved-on-error
+        semantics — its DELETE event may already have been delivered)."""
+        tfjob = make_tfjob(tpu=4, restart_policy="ExitCode")
+        pods = self._gang(4, failed_index=3)
+        missing = pods[1]["metadata"]["name"]
+        pod_control = _FailByNameControl(not_found_names=[missing])
+        controller, _, _, captured = build_controller(tfjob, pods, [])
+        controller.pod_control = pod_control
+        controller.pod_reconciler.pod_control = pod_control
+        assert controller.sync_tfjob(KEY) is True
+        # the other 3 pods were all deleted despite the mid-wave 404
+        assert len(pod_control.delete_pod_names) == 3
+        assert missing not in pod_control.delete_pod_names
+        assert get_condition(captured[-1].status, "Restarting") is not None
+        # 4 expected, NotFound unwound 1 → exactly 3 echoes owed
+        exp_key = gen_expectation_pods_key(KEY, "tpu")
+        assert not controller.expectations.satisfied(exp_key)
+        for _ in range(3):
+            controller.expectations.deletion_observed(exp_key)
+        assert controller.expectations.satisfied(exp_key)
+
+    def test_delete_metrics_recorded(self):
+        tfjob = make_tfjob(tpu=4, restart_policy="ExitCode")
+        controller, pod_control, _, _ = build_controller(
+            tfjob, self._gang(4, failed_index=0), [])
+        counter = controller.metrics["deletes_total"]
+        before = counter.labels("v2", "pod", "success").value
+        assert controller.sync_tfjob(KEY) is True
+        assert counter.labels("v2", "pod", "success").value - before == 4
+        assert len(pod_control.delete_pod_names) == 4
+
+    def test_delete_wave_traced(self):
+        from k8s_tpu import trace
+
+        old_rate = trace.TRACER.sample_rate
+        trace.configure(sample_rate=1.0)
+        try:
+            tfjob = make_tfjob(tpu=2, restart_policy="ExitCode")
+            controller, _, _, _ = build_controller(
+                tfjob, self._gang(2, failed_index=0), [])
+            assert controller.sync_tfjob(KEY) is True
+            names = set()
+            stack = list(trace.debug_traces(limit=1000))
+            while stack:
+                span = stack.pop()
+                names.add(span["name"])
+                stack.extend(span.get("children") or [])
+            assert "delete_pods_batch" in names
+        finally:
+            trace.TRACER.sample_rate = old_rate
+
+
+class TestRunDeleteWave:
+    """Contract-level tests against a real FakeCluster (actual 404s)."""
+
+    def _cluster_with_pods(self, n):
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        for i in range(n):
+            cs.pods(NS).create({"metadata": {"name": f"p-{i}"}, "spec": {}})
+        return fc, cs
+
+    def test_real_not_found_is_success_and_counted(self):
+        fc, cs = self._cluster_with_pods(4)
+        cs.pods(NS).delete("p-2")  # someone else got there first
+        pc = RealPodControl(cs, FakeRecorder(), executor=None,
+                            delete_executor=None)
+        exp = new_controller_expectations()
+        names = [f"p-{i}" for i in range(4)]
+        gone = run_delete_wave(
+            exp, "exp-key",
+            lambda lo, hi: pc.delete_pods_batch(NS, names[lo:hi], {}),
+            len(names), None, "pod", lambda i: names[i], initial=1,
+        )
+        assert gone == 4  # 3 deleted now + 1 already gone
+        assert cs.pods(NS).list() == []
+        # 4 expected, the 404 slot unwound → 3 echoes owed
+        for _ in range(3):
+            exp.deletion_observed("exp-key")
+        assert exp.satisfied("exp-key")
+
+    def test_none_exp_key_skips_expectations(self):
+        fc, cs = self._cluster_with_pods(2)
+        pc = RealPodControl(cs, FakeRecorder(), executor=None,
+                            delete_executor=None)
+        gone = run_delete_wave(
+            None, None,
+            lambda lo, hi: pc.delete_pods_batch(
+                NS, [f"p-{i}" for i in range(2)][lo:hi], {}),
+            2, None, "pod", lambda i: f"p-{i}", initial=1,
+        )
+        assert gone == 2
+
+    def test_raise_on_error_false_swallows_and_reports(self):
+        pc = _FailByNameControl(failing_names=["p-1"])
+        exp = new_controller_expectations()
+        names = ["p-0", "p-1", "p-2"]
+        gone = run_delete_wave(
+            exp, "exp-key",
+            lambda lo, hi: pc.delete_pods_batch(NS, names[lo:hi], {}),
+            3, None, "pod", lambda i: names[i], initial=3,
+            raise_on_error=False,
+        )
+        assert gone == 2
+        assert pc.delete_pod_names == ["p-0", "p-2"]
+        exp.deletion_observed("exp-key")
+        exp.deletion_observed("exp-key")
+        assert exp.satisfied("exp-key")
+
+    def test_unwind_helper_tolerates_none_key_and_zero(self):
+        exp = new_controller_expectations()
+        unwind_delete_expectations(exp, None, 5)  # no-op, no raise
+        unwind_delete_expectations(exp, "k", 0)
+        exp.expect_deletions("k", 2)
+        unwind_delete_expectations(exp, "k", 2)
+        assert exp.satisfied("k")
+
+    def test_wave_wall_clock_is_pool_bound(self):
+        """Concurrency regression guard: a 64-pod wave over a 16-wide pool
+        with a 10ms injected delete RTT must take ≈ ceil(64/16) x RTT, not
+        64 x RTT.  One retry absorbs a CI scheduler stall; a real
+        serialization regression fails both attempts deterministically."""
+        serial_bound = 64 * 0.010
+
+        def one_wave() -> float:
+            fc, cs = self._cluster_with_pods(64)
+            ex = executor_for_concurrency(16, "delete")
+            try:
+                pc = RealPodControl(cs, FakeRecorder(), executor=None,
+                                    delete_executor=ex)
+                exp = new_controller_expectations()
+                names = [f"p-{i}" for i in range(64)]
+                fc.delete_delay_s = 0.010
+                t0 = time.perf_counter()
+                gone = run_delete_wave(
+                    exp, "exp-key",
+                    lambda lo, hi: pc.delete_pods_batch(NS, names[lo:hi], {}),
+                    64, None, "pod", lambda i: names[i],
+                    initial=pc.delete_width,
+                )
+                elapsed = time.perf_counter() - t0
+                assert gone == 64
+                assert cs.pods(NS).list() == []
+                return elapsed
+            finally:
+                ex.shutdown(wait=False)
+
+        elapsed = one_wave()
+        if elapsed >= serial_bound / 4:
+            elapsed = one_wave()
+        assert elapsed < serial_bound / 4, (
+            f"teardown wave took {elapsed:.3f}s twice; serial bound is "
+            f"{serial_bound:.2f}s")
+
+
+class TestTerminalServiceCleanup:
+    """Satellite: cleanPodPolicy=All must also delete the gang's headless
+    services — they otherwise leak forever once the job finishes."""
+
+    def _finished_job(self, policy):
+        from k8s_tpu.controller_v2 import status as status_mod
+
+        job = make_tfjob(worker=2, ps=1)
+        job.spec.clean_pod_policy = policy
+        status_mod.set_condition(
+            job.status,
+            status_mod.new_condition(v1alpha2.TFJobSucceeded, "done", "m"))
+        return job
+
+    def _cluster(self, policy):
+        job = self._finished_job(policy)
+        pods = [make_pod("worker", 0, "Succeeded", exit_code=0),
+                make_pod("worker", 1, "Running"),
+                make_pod("ps", 0, "Running")]
+        services = [make_service("worker", 0), make_service("worker", 1),
+                    make_service("ps", 0)]
+        tc, pod_control, service_control, _ = build_controller(
+            job, pods, services)
+        return job, tc, pod_control, service_control
+
+    def test_all_deletes_services_alongside_pods(self):
+        job, tc, pod_control, service_control = self._cluster(
+            v1alpha2.CleanPodPolicyAll)
+        tc.reconcile_tfjobs(job)
+        assert len(pod_control.delete_pod_names) == 3
+        assert sorted(service_control.delete_service_names) == sorted(
+            s["metadata"]["name"]
+            for s in [make_service("worker", 0), make_service("worker", 1),
+                      make_service("ps", 0)])
+
+    def test_running_policy_keeps_services(self):
+        job, tc, _, service_control = self._cluster(
+            v1alpha2.CleanPodPolicyRunning)
+        tc.reconcile_tfjobs(job)
+        assert service_control.delete_service_names == []
+
+    def test_default_policy_keeps_services(self):
+        job, tc, _, service_control = self._cluster(None)
+        tc.reconcile_tfjobs(job)
+        assert service_control.delete_service_names == []
+
+    def test_deadline_escalation_keeps_services(self):
+        """DeadlineExceeded under the keep-for-logs default escalates pods
+        to Running-cleanup only; service DNS stays with the kept pods."""
+        import datetime
+
+        from k8s_tpu.controller_v2 import status as status_mod
+
+        job = make_tfjob(worker=1)
+        job.spec.active_deadline_seconds = 30
+        start = (datetime.datetime.now(datetime.timezone.utc)
+                 - datetime.timedelta(seconds=120))
+        job.status.start_time = start.strftime("%Y-%m-%dT%H:%M:%SZ")
+        status_mod.set_condition(
+            job.status,
+            status_mod.new_condition(
+                v1alpha2.TFJobFailed,
+                status_mod.TFJOB_DEADLINE_EXCEEDED_REASON, "deadline"))
+        job.status.completion_time = job.status.start_time
+        pods = [make_pod("worker", 0, "Running")]
+        services = [make_service("worker", 0)]
+        tc, pod_control, service_control, _ = build_controller(
+            job, pods, services)
+        tc.reconcile_tfjobs(job)  # terminal path, escalated to Running
+        assert len(pod_control.delete_pod_names) == 1
+        assert service_control.delete_service_names == []
+
+    def test_failed_service_delete_unwinds_and_does_not_raise(self):
+        job, tc, _, service_control = self._cluster(v1alpha2.CleanPodPolicyAll)
+        service_control.delete_error = RuntimeError("api 500")
+        tc.reconcile_tfjobs(job)  # must not raise
+        for rtype in ("worker", "ps"):
+            assert tc.expectations.satisfied(
+                gen_expectation_services_key(KEY, rtype)), rtype
+
+    def test_service_delete_event_observes_expectation(self):
+        """The informer DELETE echo decrements the wave's expectation —
+        without this the terminal job would wedge until the TTL."""
+        job = self._finished_job(v1alpha2.CleanPodPolicyAll)
+        svc = make_service("worker", 0)
+        tc, _, _, _ = build_controller(job, [], [svc])
+        _add, _update, delete_service = service_mod.make_service_event_handlers(tc)
+        exp_key = gen_expectation_services_key(KEY, "worker")
+        tc.expectations.expect_deletions(exp_key, 1)
+        assert not tc.expectations.satisfied(exp_key)
+        delete_service(svc)
+        assert tc.expectations.satisfied(exp_key)
+
+
+class TestFakeControlDeleteParity:
+    def test_fake_service_control_delete_error_and_clear(self):
+        sc = FakeServiceControl()
+        sc.delete_error = RuntimeError("boom")
+        with pytest.raises(RuntimeError):
+            sc.delete_service(NS, "s", {})
+        results = sc.delete_services_batch(NS, ["a", "b"], {})
+        assert all(exc is not None for _, exc in results)
+        sc.clear()
+        assert sc.delete_error is None
+        sc.delete_service(NS, "s", {})
+        assert sc.delete_service_names == ["s"]
+
+    def test_batch_deletes_thread_safe_under_pooled_executor(self):
+        """Many threads driving pooled batch deletes against one fake must
+        never lose an append (the bookkeeping runs under the fake's lock)."""
+        pc = FakePodControl()
+        sc = FakeServiceControl()
+        pc._delete_executor = executor_for_concurrency(8, "delete")
+        sc._delete_executor = executor_for_concurrency(8, "delete")
+        try:
+            n_threads, per_thread = 8, 20
+            barrier = threading.Barrier(n_threads)
+            failures = []
+
+            def run(i):
+                barrier.wait()
+                for j in range(per_thread):
+                    names = [f"p-{i}-{j}-{k}" for k in range(4)]
+                    try:
+                        rp = pc.delete_pods_batch(NS, names, {})
+                        rs = sc.delete_services_batch(NS, names, {})
+                        assert all(e is None for _, e in rp + rs)
+                    except Exception as e:  # noqa: BLE001
+                        failures.append(e)
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not failures
+            total = n_threads * per_thread * 4
+            assert len(pc.delete_pod_names) == total
+            assert len(sc.delete_service_names) == total
+        finally:
+            pc._delete_executor.shutdown(wait=False)
+            sc._delete_executor.shutdown(wait=False)
+
+
+def test_fake_cluster_delete_delay_injection():
+    """delete_delay_s models the apiserver delete RTT symmetrically with
+    create_delay_s: serial deletes pay it once per call."""
+    fc = FakeCluster()
+    cs = Clientset(fc)
+    for i in range(3):
+        cs.pods(NS).create({"metadata": {"name": f"p-{i}"}, "spec": {}})
+    fc.delete_delay_s = 0.01
+    t0 = time.perf_counter()
+    for i in range(3):
+        cs.pods(NS).delete(f"p-{i}")
+    assert time.perf_counter() - t0 >= 0.03
+    assert fc.list(PODS, NS) == []
+
+
+def test_restart_bench_tiny():
+    """Tier-1 (not slow) variant of the gang-restart microbench: 4 replicas,
+    2ms injected delete RTT — exercises the whole kill-to-all-Running
+    serial-vs-parallel path quickly and pins the output contract."""
+    from k8s_tpu.harness.bench_operator import bench_restart
+
+    r = bench_restart(replicas=4, delete_latency_s=0.002, rounds=1,
+                      timeout_s=30.0)
+    assert r["kill_to_running_p50_s"] > 0
+    assert r["serial_kill_to_running_p50_s"] > 0
+    assert r["restart_speedup"] > 0
+    assert r["replicas"] == 4
