@@ -37,3 +37,9 @@ def set_defaults_tfjob(tfjob: types.TFJob) -> None:
             _set_default_port(spec.template.setdefault("spec", {}))
         if not spec.restart_policy:
             spec.restart_policy = types.RestartPolicyAlways
+    # gang-admission knobs (ISSUE 4): every job schedules at priority 0 in
+    # the "default" queue unless the spec says otherwise
+    if tfjob.spec.priority is None:
+        tfjob.spec.priority = 0
+    if not tfjob.spec.queue:
+        tfjob.spec.queue = types.DEFAULT_SCHEDULING_QUEUE

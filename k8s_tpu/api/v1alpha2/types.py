@@ -58,12 +58,24 @@ VALID_REPLICA_TYPES = (
     TFReplicaTypeTPU,
 )
 
-# Condition types (types.go:168-196)
+# Condition types (types.go:168-196) + Queued (gang admission, ISSUE 4:
+# a job parked by the capacity scheduler carries Queued=True and owns
+# zero pods until the whole slice's worth of chips can be reserved)
 TFJobCreated = "Created"
 TFJobRunning = "Running"
 TFJobRestarting = "Restarting"
 TFJobSucceeded = "Succeeded"
 TFJobFailed = "Failed"
+TFJobQueued = "Queued"
+
+# Gang-admission scheduling knobs (TFJobSpec.priority / .queue): priority
+# defaults to 0 via SetDefaults, higher wins; the queue name is a logical
+# grouping label for /debug/scheduler and multi-tenant reporting.
+DEFAULT_SCHEDULING_QUEUE = "default"
+# |priority| bound: enough headroom for any tiering scheme while keeping
+# the aging boost (a handful of steps) meaningful arithmetic, and rejecting
+# obvious garbage like timestamps.
+MAX_PRIORITY_ABS = 1_000_000
 
 # v1.ConditionStatus
 ConditionTrue = "True"
@@ -109,6 +121,11 @@ class TFJobSpec:
     # wall-clock budget from StartTime (all replicas running): exceeded ->
     # the job fails with reason DeadlineExceeded (+ cleanPodPolicy applies)
     active_deadline_seconds: Optional[int] = None
+    # gang-admission knobs (ISSUE 4): higher priority is admitted first and
+    # may preempt strictly-lower-priority running gangs; queue is a logical
+    # grouping label.  None = unset; SetDefaults fills 0 / "default".
+    priority: Optional[int] = None
+    queue: Optional[str] = None
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -120,6 +137,10 @@ class TFJobSpec:
             d["cleanPodPolicy"] = self.clean_pod_policy
         if self.active_deadline_seconds is not None:
             d["activeDeadlineSeconds"] = self.active_deadline_seconds
+        if self.priority is not None:
+            d["priority"] = self.priority
+        if self.queue is not None:
+            d["queue"] = self.queue
         return d
 
     @classmethod
@@ -132,6 +153,8 @@ class TFJobSpec:
             tpu=TPUSpec.from_dict(d["tpu"]) if d.get("tpu") else None,
             clean_pod_policy=d.get("cleanPodPolicy"),
             active_deadline_seconds=d.get("activeDeadlineSeconds"),
+            priority=d.get("priority"),
+            queue=d.get("queue"),
         )
 
 

@@ -63,6 +63,7 @@ def validate_v1alpha2_tfjob_spec(spec: v2.TFJobSpec) -> None:
         raise ValidationError(
             f"activeDeadlineSeconds must be > 0, "
             f"got {spec.active_deadline_seconds}")
+    _validate_scheduling_fields(spec)
     for rtype, r in spec.tf_replica_specs.items():
         if rtype not in v2.VALID_REPLICA_TYPES:
             raise ValidationError(
@@ -78,6 +79,36 @@ def validate_v1alpha2_tfjob_spec(spec: v2.TFJobSpec) -> None:
         _require_port(r.template, rtype)
         if rtype == v2.TFReplicaTypeTPU:
             _validate_tpu_replica(r.template, rtype)
+
+
+_QUEUE_NAME_RE = None  # compiled lazily; validation is import-hot
+
+
+def _validate_scheduling_fields(spec: v2.TFJobSpec) -> None:
+    """Gang-admission knobs (ISSUE 4): ``priority`` must be a genuine int
+    within +/-MAX_PRIORITY_ABS (bool is an int subclass but means a typo'd
+    manifest, so it is rejected), ``queue`` a label-shaped name."""
+    if spec.priority is not None:
+        if isinstance(spec.priority, bool) or not isinstance(spec.priority, int):
+            raise ValidationError(
+                f"priority must be an integer, got {spec.priority!r}")
+        if abs(spec.priority) > v2.MAX_PRIORITY_ABS:
+            raise ValidationError(
+                f"priority must be within +/-{v2.MAX_PRIORITY_ABS}, "
+                f"got {spec.priority}")
+    if spec.queue is not None:
+        global _QUEUE_NAME_RE
+        if _QUEUE_NAME_RE is None:
+            import re
+
+            # DNS-label-shaped: alphanumeric ends, [-._] allowed inside
+            _QUEUE_NAME_RE = re.compile(
+                r"[A-Za-z0-9]([A-Za-z0-9._-]{0,61}[A-Za-z0-9])?")
+        if (not isinstance(spec.queue, str)
+                or not _QUEUE_NAME_RE.fullmatch(spec.queue)):
+            raise ValidationError(
+                f"queue must be a label-shaped name (<= 63 chars, "
+                f"alphanumeric ends), got {spec.queue!r}")
 
 
 def _require_container(template: dict, container_name: str, rtype: str) -> None:

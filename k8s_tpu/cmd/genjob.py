@@ -55,12 +55,16 @@ def tfjob_template(
     tpu: bool = False,
     scheduler_name: str = "default",
     tpu_replicas: int = 4,
+    priority: int | None = None,
+    queue: str | None = None,
 ) -> dict:
     """One synthetic job (genjob.go:46-91): 1 WORKER, or 1 MASTER+GPU, or a
-    TPU gang of ``tpu_replicas`` hosts."""
+    TPU gang of ``tpu_replicas`` hosts.  ``priority``/``queue`` set the
+    v1alpha2 gang-admission fields so generated manifests can exercise the
+    capacity scheduler (ISSUE 4)."""
     if tpu:
         accel, topology = v5e_slice_for_hosts(tpu_replicas)
-        return {
+        job = {
             "apiVersion": "kubeflow.org/v1alpha2",
             "kind": "TFJob",
             "metadata": {"name": job_name, "namespace": namespace},
@@ -91,6 +95,11 @@ def tfjob_template(
                 },
             },
         }
+        if priority is not None:
+            job["spec"]["priority"] = priority
+        if queue is not None:
+            job["spec"]["queue"] = queue
+        return job
     replica = {
         "replicas": 1,
         "tfReplicaType": "MASTER" if gpu else "WORKER",
@@ -123,6 +132,13 @@ def tfjob_template(
     job["spec"]["terminationPolicy"] = {
         "chief": {"replicaName": "MASTER" if gpu else "WORKER"}
     }
+    # v1alpha1 has no scheduling fields; the keys still travel in the
+    # manifest (ignored by the v1 operator) so one flag works for both
+    # generations, but only v1alpha2 jobs are actually arbitrated.
+    if priority is not None:
+        job["spec"]["priority"] = priority
+    if queue is not None:
+        job["spec"]["queue"] = queue
     return job
 
 
@@ -133,11 +149,14 @@ def generate(
     tpu: bool = False,
     scheduler_name: str = "default",
     timestamp: int | None = None,
+    priority: int | None = None,
+    queue: str | None = None,
 ) -> list[dict]:
     """N uniquely-named jobs, ``tfjob-<ts>-<i>`` (genjob.go:111-114)."""
     ts = timestamp if timestamp is not None else time.time_ns() % 10**9
     return [
-        tfjob_template(f"tfjob-{ts}-{i}", namespace, gpu, tpu, scheduler_name)
+        tfjob_template(f"tfjob-{ts}-{i}", namespace, gpu, tpu, scheduler_name,
+                       priority=priority, queue=queue)
         for i in range(n)
     ]
 
@@ -149,6 +168,12 @@ def main(argv=None) -> int:
     parser.add_argument("--use-tpu", action="store_true")
     parser.add_argument("--namespace", default="default")
     parser.add_argument("--scheduler-name", default="default")
+    parser.add_argument("--priority", type=int, default=None,
+                        help="gang-admission priority (v1alpha2 "
+                        "spec.priority; higher wins, may preempt)")
+    parser.add_argument("--queue", default=None,
+                        help="gang-admission queue label (v1alpha2 "
+                        "spec.queue)")
     parser.add_argument(
         "--dump", action="store_true", help="print manifests instead of creating"
     )
@@ -162,6 +187,8 @@ def main(argv=None) -> int:
         gpu=args.use_gpu,
         tpu=args.use_tpu,
         scheduler_name=args.scheduler_name,
+        priority=args.priority,
+        queue=args.queue,
     )
     if args.dump:
         yaml.safe_dump_all(jobs, sys.stdout)

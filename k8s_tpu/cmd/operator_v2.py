@@ -41,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
                    "interfaces because in-pod scrapers must reach them; "
                    "pass 127.0.0.1 to restrict to loopback (the library "
                    "default outside this binary)")
+    p.add_argument("--cluster-chips", type=int, default=None,
+                   help="total TPU chips the gang-admission scheduler may "
+                   "reserve (ISSUE 4).  Default: K8S_TPU_CLUSTER_CHIPS, "
+                   "else derived from node allocatable "
+                   "cloud-tpus.google.com/* resources, else unlimited "
+                   "(admission disabled); 0 = explicitly unlimited")
     p.add_argument("--version", action="store_true")
     return p
 
@@ -71,7 +77,8 @@ def run(opts, backend=None) -> int:
 
     clientset = Clientset(backend if backend is not None else make_backend(opts))
     controller = TFJobController(
-        clientset, enable_gang_scheduling=opts.enable_gang_scheduling
+        clientset, enable_gang_scheduling=opts.enable_gang_scheduling,
+        cluster_chips=getattr(opts, "cluster_chips", None),
     )
     stop = setup_signal_handler()
 

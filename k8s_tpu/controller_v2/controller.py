@@ -15,9 +15,11 @@ slice cannot initialize at all.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
+from k8s_tpu import scheduler as scheduler_mod
 from k8s_tpu import trace
 from k8s_tpu.api import register, validation
 from k8s_tpu.api.meta import now_rfc3339
@@ -41,6 +43,20 @@ log = logging.getLogger(__name__)
 CONTROLLER_NAME = "tpu-job-controller-v2"
 
 
+def cluster_chips_from_env() -> int | None:
+    """K8S_TPU_CLUSTER_CHIPS: total TPU chips the gang-admission scheduler
+    may reserve.  Unset/garbage -> None (capacity derived from node
+    listings, else unlimited); 0 -> explicitly unlimited (admission off)."""
+    raw = os.environ.get("K8S_TPU_CLUSTER_CHIPS", "")
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n >= 0 else None
+
+
 class TFJobController:
     def __init__(
         self,
@@ -52,6 +68,8 @@ class TFJobController:
         recorder=None,
         create_concurrency: int | None = None,
         delete_concurrency: int | None = None,
+        cluster_chips: int | None = None,
+        scheduler=None,
     ):
         self.clientset = clientset
         # async sink: recording is a buffered enqueue, not an API round trip
@@ -108,6 +126,27 @@ class TFJobController:
         self._pdb_cache: dict = {}
         self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v2")
+        # Gang admission & capacity scheduler (ISSUE 4).  cluster_chips:
+        # None -> K8S_TPU_CLUSTER_CHIPS, else derive from node allocatable
+        # TPU resources per sync, else unlimited (admission off — the
+        # compatibility default: the operator behaves exactly as before);
+        # 0 -> explicitly unlimited; an injected ``scheduler`` wins (tests).
+        if scheduler is not None:
+            self.scheduler = scheduler
+            self._capacity_pinned = True
+        else:
+            if cluster_chips is not None and cluster_chips < 0:
+                # same contract as the env path: a negative knob is garbage,
+                # not a secret admission-off switch (that is 0)
+                log.warning("ignoring negative cluster_chips=%d",
+                            cluster_chips)
+                cluster_chips = None
+            if cluster_chips is None:
+                cluster_chips = cluster_chips_from_env()
+            self.scheduler = scheduler_mod.GangScheduler(
+                total_chips=cluster_chips or None)
+            self._capacity_pinned = cluster_chips is not None
+        scheduler_mod.set_active(self.scheduler)
         # Serializes tfjob.status mutation across concurrent per-replica-type
         # reconcile tasks (one lock per controller: workers sync different
         # jobs, so contention is bounded by the rtype fan-out width).
@@ -212,6 +251,10 @@ class TFJobController:
             self.expectations.delete_expectations(
                 service_mod.gen_expectation_services_key(key, rtype.lower())
             )
+        # deleted jobs keep nothing in the capacity scheduler: reservation,
+        # queue entry, and preemption marker all go, and freed chips wake
+        # the parked jobs that were waiting on them
+        self._release_scheduler_key(key)
 
     def enqueue_tfjob(self, tfjob) -> None:
         self.enqueue_key(tpu_config.tfjob_key(tfjob))
@@ -387,7 +430,11 @@ class TFJobController:
             # Terminal jobs: optionally clean up pods per cleanPodPolicy
             # (upstream added the field right after this snapshot; the
             # default None keeps pods for log retrieval — the snapshot's
-            # behavior); status still refreshed below.
+            # behavior); status still refreshed below.  The gang's chip
+            # reservation is released first: capacity frees the moment the
+            # job is terminal, not when its pods happen to be garbage
+            # collected, and the freed chips wake the admission queue.
+            self._release_scheduler_key(tpu_config.tfjob_key(tfjob))
             self._clean_up_terminal_pods(tfjob)
             self.update_status_handler(tfjob)
             return
@@ -426,6 +473,15 @@ class TFJobController:
                 ),
             )
 
+        # Gang admission (ISSUE 4): all-or-nothing — either the whole
+        # slice's worth of chips is reserved and reconcile proceeds, or the
+        # job parks in Queued with ZERO pods (the half-scheduled-gang
+        # deadlock two multislice jobs racing for one pod's chips would
+        # otherwise produce).  Runs before any pod/service listing or PDB
+        # work: a parked job costs one scheduler lookup per sync.
+        if not self._sync_admission(tfjob):
+            return
+
         with trace.span("list_pods"):
             pods = self.get_pods_for_tfjob(tfjob)
         with trace.span("list_services"):
@@ -438,6 +494,190 @@ class TFJobController:
 
         tfjob.status.last_reconcile_time = now_rfc3339()
         self.update_status_handler(tfjob)
+
+    # -- gang admission & capacity scheduling (ISSUE 4) -----------------------
+
+    def _maybe_derive_capacity(self) -> None:
+        """No config knob pinned: derive total chips from the node informer's
+        allocatable TPU resources, tracking node churn sync-to-sync.  Zero
+        TPU-bearing nodes keeps the last known total (an informer hiccup
+        must not flip the cluster to unlimited and mass-admit the queue) —
+        or unlimited if none were ever seen, the compatibility default."""
+        if self._capacity_pinned:
+            return
+        chips = scheduler_mod.chips_from_nodes(self.node_lister.list())
+        if chips > 0:
+            self.scheduler.set_total(chips)
+
+    def _sync_admission(self, tfjob) -> bool:
+        """The per-sync admission gate: True — the whole gang's chips are
+        reserved (or capacity is unlimited) and reconcile proceeds; False —
+        the job is parked with a Queued condition, zero pods, and its
+        status written."""
+        self._maybe_derive_capacity()
+        sched = self.scheduler
+        if sched.unlimited:
+            return True
+        key = tpu_config.tfjob_key(tfjob)
+        if sched.is_reserved(key):
+            # steady-state fast path: every sync of a running gang skips
+            # the O(replicas) demand computation below
+            return True
+        chips = tpu_config.chips_for_tfjob(tfjob)
+        priority = getattr(tfjob.spec, "priority", 0) or 0
+        queue_name = (getattr(tfjob.spec, "queue", None)
+                      or types.DEFAULT_SCHEDULING_QUEUE)
+        # Reality wins over the ledger: a gang whose pods already run
+        # (controller restart) re-adopts its reservation instead of being
+        # parked — unless it was deliberately preempted this incarnation.
+        running = status_mod.has_condition(tfjob.status, types.TFJobRunning)
+        with trace.span("gang_admission", job=key, chips=chips,
+                        priority=priority) as sp:
+            decision = sched.sync_admit(key, chips, priority, queue_name,
+                                        running=running)
+            if not decision.admitted and decision.victims:
+                decision = self._preempt_victims(
+                    tfjob, key, chips, priority, queue_name, decision.victims)
+            sp.set_attribute("decision", decision.reason)
+            gen = self.metrics["generation"]
+            self.metrics["queue_depth"].labels(gen).set(sched.queue_depth())
+            if decision.admitted:
+                if decision.newly_admitted:
+                    self.metrics["admitted_total"].labels(gen).inc()
+                    self.metrics["admission_wait"].labels(gen).observe(
+                        decision.wait_s)
+                    self._clear_queued_condition(tfjob, decision)
+                return True
+            self._park_queued(tfjob, key, chips, decision)
+            return False
+
+    def _preempt_victims(self, tfjob, key: str, chips: int, priority: int,
+                         queue_name: str, victims: list[str]):
+        """Seat this higher-priority job by evicting the scheduler-chosen
+        victims: the scheduler atomically releases each victim exactly once
+        and requeues it at its base priority; the woken victim's OWN next
+        sync parks it and tears down its pods through the normal delete
+        waves (teardown retries stay with the owner, and a gang already
+        mid-teardown is never double-counted — the requeued entry holds no
+        reservation and release is idempotent)."""
+        with trace.span("preempt_victims", job=key, victims=len(victims)):
+            decision = self.scheduler.preempt(key, chips, priority,
+                                              queue_name, victims)
+            if not decision.victims:
+                return decision
+            gen = self.metrics["generation"]
+            self.metrics["preemptions_total"].labels(gen).inc(
+                len(decision.victims))
+            for vkey in decision.victims:
+                ns, name = split_meta_namespace_key(vkey)
+                vobj = self.tfjob_lister.get(ns, name)
+                if vobj is not None:
+                    self.recorder.eventf(
+                        vobj, "Warning", "Preempted",
+                        "Gang preempted by higher-priority TFJob %s "
+                        "(priority %d); requeued", key, priority)
+                self.enqueue_key(vkey)
+            self.recorder.eventf(
+                tfjob.to_dict(), "Normal", "PreemptedVictims",
+                "Preempted %d lower-priority gang(s) to reserve %d chip(s)",
+                len(decision.victims), chips)
+            return decision
+
+    def _clear_queued_condition(self, tfjob, decision) -> None:
+        """A formerly-parked job was admitted: flip Queued to False (keeping
+        the condition as history) and record how long it waited."""
+        queued = status_mod.get_condition(tfjob.status, types.TFJobQueued)
+        if queued is None or queued.status != types.ConditionTrue:
+            return
+        cond = status_mod.new_condition(
+            types.TFJobQueued, status_mod.TFJOB_ADMITTED_REASON,
+            f"gang admitted after {decision.wait_s:.1f}s in the queue")
+        cond.status = types.ConditionFalse
+        with self._status_lock:
+            status_mod.set_condition(tfjob.status, cond)
+        self.recorder.eventf(
+            tfjob.to_dict(), "Normal", "GangAdmitted",
+            "Admitted after %.1fs in the admission queue", decision.wait_s)
+
+    def _park_queued(self, tfjob, key: str, chips: int, decision) -> None:
+        """Park a job the capacity model cannot seat: Queued=True (with the
+        preemption story when that is why), Running flipped False for
+        evicted gangs, any remaining pods torn down (all-or-nothing — a
+        parked job may not hold chips via leftover pods), status written."""
+        preemptor = self.scheduler.preempted_by(key)
+        if preemptor:
+            reason = status_mod.TFJOB_PREEMPTED_REASON
+            message = (f"gang preempted by {preemptor}; requeued waiting "
+                       f"for {chips} TPU chip(s)")
+        else:
+            reason = status_mod.TFJOB_QUEUED_REASON
+            message = (f"waiting for {chips} TPU chip(s): "
+                       f"{decision.reason}")
+        with self._status_lock:
+            status_mod.set_condition(
+                tfjob.status,
+                status_mod.new_condition(types.TFJobQueued, reason, message))
+            running = status_mod.get_condition(tfjob.status, types.TFJobRunning)
+            if running is not None and running.status == types.ConditionTrue:
+                cond = status_mod.new_condition(
+                    types.TFJobRunning, reason,
+                    "gang torn down; job is requeued")
+                cond.status = types.ConditionFalse
+                status_mod.set_condition(tfjob.status, cond)
+        self._teardown_parked_pods(tfjob, key)
+        self.update_status_handler(tfjob)
+
+    def _teardown_parked_pods(self, tfjob, key: str) -> int:
+        """Delete any pods a parked job still owns (only preemption victims
+        ever have some) in bounded delete waves with the job's own
+        expectation accounting.  raise_on_error=False: the parked status
+        must still be written; failed slots are simply re-listed by the
+        next sync of the still-parked job."""
+        pods = [p for p in self.get_pods_for_tfjob(tfjob)
+                if not (p.get("metadata") or {}).get("deletionTimestamp")]
+        if not pods:
+            return 0
+        from k8s_tpu.controller_v2.control import run_delete_wave
+
+        job_dict = tfjob.to_dict()
+        by_type: dict[str, list] = {}
+        for p in pods:
+            rtype = ((p.get("metadata") or {}).get("labels") or {}).get(
+                tpu_config.LABEL_REPLICA_TYPE)
+            by_type.setdefault(rtype or "", []).append(p)
+        deleted = 0
+        for rtype, victims in by_type.items():
+            exp_key = (pod_mod.gen_expectation_pods_key(key, rtype)
+                       if rtype else None)
+            names = [p["metadata"]["name"] for p in victims]
+            deleted += run_delete_wave(
+                self.expectations, exp_key,
+                lambda lo, hi, names=names: self.pod_control.delete_pods_batch(
+                    tfjob.metadata.namespace, names[lo:hi], job_dict),
+                len(names), self.metrics, "pod",
+                lambda i, names=names: f"pod {names[i]} (preemption teardown)",
+                initial=getattr(self.pod_control, "delete_width", 1),
+                raise_on_error=False,
+            )
+        if deleted:
+            self.recorder.eventf(
+                job_dict, "Normal", "PreemptionTeardown",
+                "Deleted %d pod(s): gang preempted and requeued", deleted)
+        return deleted
+
+    def _release_scheduler_key(self, key: str) -> None:
+        """Drop every scheduler trace of a terminal/deleted job (reservation,
+        queue entry, preemption marker) and, when chips actually freed, wake
+        the parked jobs so their next sync can re-ask for capacity."""
+        sched = self.scheduler
+        if sched.unlimited:
+            return
+        freed = sched.forget(key)
+        self.metrics["queue_depth"].labels(self.metrics["generation"]).set(
+            sched.queue_depth())
+        if freed:
+            for waiting in sched.waiting_keys():
+                self.enqueue_key(waiting)
 
     def _reconcile_replica_types(self, tfjob, pods, services) -> None:
         """Run the pod+service reconcile pair for every replica type —

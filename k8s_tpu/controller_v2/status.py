@@ -28,6 +28,12 @@ TFJOB_RESTARTING_REASON = "TFJobRestarting"
 # activeDeadlineSeconds failures (batch/v1 Job reason); load-bearing in the
 # controller: set on the deadline path, matched on the terminal-cleanup path
 TFJOB_DEADLINE_EXCEEDED_REASON = "DeadlineExceeded"
+# Gang admission (ISSUE 4): Queued-condition reasons.  TFJobQueued — parked
+# for capacity; Preempted — evicted by a higher-priority gang and requeued;
+# Admitted — the Queued=False transition once the reservation lands.
+TFJOB_QUEUED_REASON = "TFJobQueued"
+TFJOB_PREEMPTED_REASON = "Preempted"
+TFJOB_ADMITTED_REASON = "Admitted"
 
 
 def new_condition(cond_type: str, reason: str, message: str) -> types.TFJobCondition:

@@ -117,6 +117,43 @@ def spmd_process_table(tfjob: types.TFJob) -> list[tuple[str, int, str]]:
     return table
 
 
+def tpu_chips_per_host(tfjob: types.TFJob, rtype: str) -> int:
+    """TPU chips one replica pod of ``rtype`` consumes: the sum of its
+    containers' ``cloud-tpus.google.com/*`` resource limits (the same
+    limits validation requires on TPU gangs).  0 for CPU-only replicas."""
+    spec = tfjob.spec.tf_replica_specs[rtype]
+    chips = 0
+    for container in ((spec.template or {}).get("spec") or {}).get("containers") or []:
+        limits = ((container.get("resources") or {}).get("limits")) or {}
+        for key, value in limits.items():
+            if key.startswith(constants.TPU_RESOURCE_PREFIX):
+                try:
+                    chips += int(value)
+                except (TypeError, ValueError):
+                    continue
+    return chips
+
+
+def chips_for_tfjob(tfjob: types.TFJob) -> int:
+    """Whole-job TPU chip demand — the gang-admission unit (ISSUE 4).
+
+    Derived from ``spmd_process_table``: every SPMD participant is one
+    slice host, and each host consumes its replica type's declared chip
+    limit.  Multislice jobs are already flattened by the table (replicas
+    spans all slices), so a 4x v5litepod-256 gang of 256 hosts at 4
+    chips/host prices at 1024 chips.  Jobs with no TPU limits anywhere
+    (CPU worker/PS topologies) price at 0 and bypass capacity arbitration.
+    """
+    by_rtype_lower = {rt.lower(): rt for rt in tfjob.spec.tf_replica_specs}
+    per_host: dict[str, int] = {}
+    total = 0
+    for rt, _index, _host in spmd_process_table(tfjob):
+        if rt not in per_host:
+            per_host[rt] = tpu_chips_per_host(tfjob, by_rtype_lower[rt])
+        total += per_host[rt]
+    return total
+
+
 def gen_tpu_config_json(tfjob: types.TFJob, rtype_lower: str, index) -> str:
     """TF_CONFIG-shaped JSON (genTFConfigJSONStr, controller_tensorflow.go:63-86)."""
     config = {

@@ -107,6 +107,16 @@ def _make_handler(manager: ClientManager):
                     code, body, ctype = trace.debug_traces_response(
                         trace.TRACER, query)
                     self._send_text(code, body, ctype)
+                elif path == "/debug/scheduler":
+                    # Gang-admission queue/capacity state — same per-process
+                    # scope caveat as /debug/traces above: meaningful when
+                    # the dashboard embeds the controller (LocalCluster /
+                    # single-binary layout); a separately deployed dashboard
+                    # should scrape the operator's --metrics-port endpoint.
+                    from k8s_tpu import scheduler as scheduler_mod
+
+                    code, body, ctype = scheduler_mod.debug_response(query)
+                    self._send_text(code, body, ctype)
                 elif path in ("", "/tfjobs/ui", "/tfjobs"):
                     self._serve_ui("index.html")
                 elif path.startswith("/tfjobs/ui/"):

@@ -35,7 +35,12 @@ class LocalCluster:
         delete_concurrency: int | None = None,
         delete_delay_s: float = 0.0,
         metrics_port: int | None = None,
+        cluster_chips: int | None = None,
     ):
+        # cluster_chips: total TPU chips the v2 controller's gang-admission
+        # scheduler may reserve (ISSUE 4).  None = unlimited/off (the
+        # compatibility default) unless K8S_TPU_CLUSTER_CHIPS or node
+        # allocatables say otherwise.
         # metrics_port wires the operator observability endpoint
         # (/metrics, /healthz, /debug/traces) into the local cluster:
         # None = off (default), 0 = ephemeral port (read it back from
@@ -94,6 +99,7 @@ class LocalCluster:
                 enable_gang_scheduling=enable_gang_scheduling,
                 create_concurrency=create_concurrency,
                 delete_concurrency=delete_concurrency,
+                cluster_chips=cluster_chips,
             )
         self.kubelet = KubeletSimulator(
             self.clientset, namespace, **(kubelet_kwargs or {})

@@ -470,6 +470,157 @@ def run_measure_restart(args) -> dict:
     }
 
 
+def bench_contention(jobs: int = 4, replicas: int = 4, hi_priority: int = 10,
+                     runtime_s: float = 0.5, cluster_chips: int | None = None,
+                     timeout_s: float = 60.0) -> dict:
+    """The --contention scenario (ISSUE 4): N equal low-priority TPU gangs
+    race for a cluster that fits ONE gang at a time, then a high-priority
+    job arrives mid-backlog.  Measures per-job admission latency (submit ->
+    gang Running), chip utilization (reserved chip-seconds over the
+    makespan, from the scheduler's event ledger), and preemption turnaround
+    (high-priority submit -> Running, which includes evicting the victim).
+    The headline assertion: the late high-priority job is admitted AHEAD of
+    earlier low-priority arrivals still in the queue."""
+    from k8s_tpu.client.gvr import TFJOBS_V1ALPHA2
+    from k8s_tpu.cmd.genjob import V5E_CHIPS_PER_HOST
+    from k8s_tpu.e2e.local import LocalCluster
+
+    if jobs < 2:
+        raise ValueError("contention needs >= 2 low-priority jobs")
+    ns = "bench"
+    chips_per_job = replicas * V5E_CHIPS_PER_HOST
+    if cluster_chips is None:
+        cluster_chips = chips_per_job  # exactly one gang fits at a time
+
+    def _job(name: str, priority: int) -> dict:
+        j = _tpu_gang_job(name, ns, replicas)
+        j["spec"]["priority"] = priority
+        j["spec"]["queue"] = "prod" if priority else "batch"
+        return j
+
+    submit_ts: dict[str, float] = {}
+    running_ts: dict[str, float] = {}
+    done_ts: dict[str, float] = {}
+    queued_seen: set[str] = set()
+    lc = LocalCluster(version="v1alpha2", namespace=ns,
+                      enable_gang_scheduling=True,
+                      kubelet_kwargs={"default_runtime_s": runtime_s},
+                      threadiness=1, resync_period_s=0.5,
+                      cluster_chips=cluster_chips)
+    with lc:
+        w = lc.backend.watch(TFJOBS_V1ALPHA2, ns)
+        try:
+            deadline = time.perf_counter() + timeout_s
+
+            def pump_until(pred, what: str) -> None:
+                while not pred():
+                    if time.perf_counter() >= deadline:
+                        raise RuntimeError(
+                            f"contention bench: {what} not reached in "
+                            f"{timeout_s}s (running={sorted(running_ts)}, "
+                            f"done={sorted(done_ts)})")
+                    item = w.next(timeout=0.2)
+                    if item is None:
+                        continue
+                    _etype, jb = item
+                    name = (jb.get("metadata") or {}).get("name")
+                    status = jb.get("status") or {}
+                    conds = {c.get("type"): c.get("status")
+                             for c in status.get("conditions") or []}
+                    # startTime is set exactly once, when the FIRST full
+                    # gang runs — admission latency's end marker
+                    if name not in running_ts and status.get("startTime"):
+                        running_ts[name] = time.perf_counter()
+                    if conds.get("Queued") == "True":
+                        queued_seen.add(name)
+                    if name not in done_ts and conds.get("Succeeded") == "True":
+                        done_ts[name] = time.perf_counter()
+
+            low = [f"lo-{i}" for i in range(jobs)]
+            for name in low:
+                lc.clientset.tfjobs_unstructured(ns).create(_job(name, 0))
+                submit_ts[name] = time.perf_counter()
+            # the slice must actually be HELD before the VIP shows up, so
+            # the run always exercises preemption, not a lucky free slot
+            pump_until(lambda: any(n in running_ts for n in low),
+                       "first low-priority gang Running")
+            hi = "hi-0"
+            lc.clientset.tfjobs_unstructured(ns).create(_job(hi, hi_priority))
+            submit_ts[hi] = time.perf_counter()
+            everyone = low + [hi]
+            pump_until(lambda: all(n in done_ts for n in everyone),
+                       "all jobs Succeeded (incl. requeued victims)")
+        finally:
+            w.stop()
+        sched = lc.controller.scheduler
+        events = sched.events()
+        preemptions = sched.preemptions_total
+
+    waits = sorted(running_ts[n] - submit_ts[n] for n in running_ts)
+    hi_wait = running_ts[hi] - submit_ts[hi]
+    # admitted ahead of the backlog: some EARLIER low-priority arrival ran
+    # only AFTER the late high-priority job
+    hi_jumped = any(
+        submit_ts[n] < submit_ts[hi] and running_ts[n] > running_ts[hi]
+        for n in low
+    )
+    admission_order = sorted(running_ts, key=running_ts.get)
+
+    # chip utilization over the contended window, from the scheduler's own
+    # admit/preempt/release ledger (reservation chip-seconds / capacity)
+    busy = 0.0
+    open_grants: dict[str, tuple[float, int]] = {}
+    tmin, tmax = None, None
+    for evt in sorted(events, key=lambda e: e["ts"]):
+        ts, etype, key = evt["ts"], evt["type"], evt["key"]
+        if etype in ("admit", "adopt"):
+            open_grants[key] = (ts, evt["chips"])
+            tmin = ts if tmin is None else min(tmin, ts)
+        elif etype in ("preempt", "release") and key in open_grants:
+            t_open, chips = open_grants.pop(key)
+            busy += chips * (ts - t_open)
+            tmax = ts if tmax is None else max(tmax, ts)
+    makespan = (tmax - tmin) if (tmin is not None and tmax is not None) else 0.0
+    utilization = (busy / (cluster_chips * makespan)) if makespan > 0 else 0.0
+
+    return {
+        "jobs": jobs + 1,
+        "replicas": replicas,
+        "cluster_chips": cluster_chips,
+        "chips_per_job": chips_per_job,
+        "hi_priority": hi_priority,
+        "runtime_s": runtime_s,
+        "admission_wait_p50_s": round(_quantile(waits, 0.50), 4),
+        "admission_wait_max_s": round(waits[-1], 4) if waits else 0.0,
+        "hi_admission_wait_s": round(hi_wait, 4),
+        "hi_jumped_backlog": hi_jumped,
+        "admission_order": admission_order,
+        "queued_jobs_observed": len(queued_seen),
+        "preemptions": preemptions,
+        "preemption_turnaround_s": round(hi_wait, 4) if preemptions else None,
+        "utilization": round(utilization, 3),
+    }
+
+
+def run_contention(args) -> dict:
+    """The --contention scenario wrapper (bench.py contract: one JSON-able
+    dict with a metric/value/unit headline)."""
+    r = bench_contention(
+        jobs=args.contention_jobs,
+        replicas=args.contention_replicas,
+        hi_priority=args.contention_priority,
+        runtime_s=args.contention_runtime,
+        cluster_chips=args.contention_chips,
+        timeout_s=args.timeout,
+    )
+    return {
+        "metric": "contention_hi_admission_wait",
+        "value": r["hi_admission_wait_s"],
+        "unit": "s",
+        **r,
+    }
+
+
 def _noop_ctx():
     import contextlib
 
@@ -578,6 +729,25 @@ def main(argv=None) -> int:
                    "K8S_TPU_CREATE_CONCURRENCY, then 16)")
     p.add_argument("--restart-rounds", type=int, default=3,
                    help="parallel-teardown kill-to-running samples for p50")
+    p.add_argument("--contention", action="store_true",
+                   help="run the gang-admission contention scenario "
+                   "(--contention-jobs low-priority TPU gangs racing for a "
+                   "cluster that fits one gang, then a high-priority "
+                   "arrival preempting mid-backlog; measures admission "
+                   "latency, chip utilization, and preemption turnaround) "
+                   "and emit one JSON line; combinable with the other "
+                   "scenarios")
+    p.add_argument("--contention-jobs", type=int, default=4,
+                   help="low-priority gangs racing for the slice (>= 2)")
+    p.add_argument("--contention-replicas", type=int, default=4,
+                   help="hosts per contention gang")
+    p.add_argument("--contention-priority", type=int, default=10,
+                   help="priority of the late-arriving preemptor job")
+    p.add_argument("--contention-runtime", type=float, default=0.5,
+                   help="synthetic per-job runtime seconds")
+    p.add_argument("--contention-chips", type=int, default=None,
+                   help="total cluster chips (default: exactly one gang's "
+                   "worth, so jobs strictly serialize)")
     p.add_argument("--trace", action="store_true",
                    help="force tracing on (sample rate 1.0) and append a "
                    "per-stage p50/p99 breakdown ('stages') to the JSON "
@@ -592,11 +762,11 @@ def main(argv=None) -> int:
 
         trace.configure(sample_rate=1.0)
 
-    if args.slice_scale or args.measure_restart:
+    if args.slice_scale or args.measure_restart or args.contention:
         if args.backend != "fake":
-            p.error("--slice-scale/--measure-restart require --backend "
-                    "fake: the injected per-create/per-delete RTTs only "
-                    "exist on the fake backend")
+            p.error("--slice-scale/--measure-restart/--contention require "
+                    "--backend fake: the injected RTTs and the capacity "
+                    "knob only exist on the in-process cluster")
         if args.create_latency is None:
             args.create_latency = 0.01
         if args.delete_latency is None:
@@ -606,6 +776,8 @@ def main(argv=None) -> int:
             results.append(run_slice_scale(args))
         if args.measure_restart:
             results.append(run_measure_restart(args))
+        if args.contention:
+            results.append(run_contention(args))
         if args.trace:
             # one stage table for the whole invocation, on the last line
             results[-1].update(trace_stage_breakdown())

@@ -23,11 +23,17 @@ log = logging.getLogger(__name__)
 EXCLUDE_DIRS = {".git", "__pycache__", ".eggs", "build", "vendor", "node_modules"}
 
 
-def check_trace_stdlib(path: str, source: bytes | None = None) -> list[str]:
-    """Stdlib-only gate for ``k8s_tpu/trace/``: the tracing package is
-    imported on the REST client's request hot path and by ops tooling, so
-    it must never grow a third-party (or even intra-repo) dependency —
-    only the standard library and the trace package itself are allowed.
+# Packages that must stay stdlib-only (plus themselves): trace/ rides the
+# REST client's request hot path; scheduler/ (ISSUE 4) holds cross-job
+# admission state consulted from every sync and is served by two HTTP
+# processes — neither may grow a third-party (or even intra-repo) import.
+STDLIB_ONLY_PACKAGES = ("k8s_tpu.trace", "k8s_tpu.scheduler")
+
+
+def check_stdlib_only(path: str, source: bytes | None = None,
+                      package: str = "k8s_tpu.trace") -> list[str]:
+    """Stdlib-only gate for one of STDLIB_ONLY_PACKAGES: only the standard
+    library and the package itself may be imported.
 
     Returns one message per offending import (empty = clean).
     """
@@ -41,6 +47,7 @@ def check_trace_stdlib(path: str, source: bytes | None = None) -> list[str]:
     except SyntaxError:
         return []  # the syntax layer reports this one
     violations = []
+    pkg_path = package.replace(".", "/")
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             names = [alias.name for alias in node.names]
@@ -51,19 +58,27 @@ def check_trace_stdlib(path: str, source: bytes | None = None) -> list[str]:
         else:
             continue
         for name in names:
-            if name == "k8s_tpu.trace" or name.startswith("k8s_tpu.trace."):
+            if name == package or name.startswith(package + "."):
                 continue
             if name.split(".", 1)[0] in sys.stdlib_module_names:
                 continue
             violations.append(
-                f"non-stdlib import '{name}' in k8s_tpu/trace "
+                f"non-stdlib import '{name}' in {pkg_path} "
                 f"(stdlib-only package; line {node.lineno})")
     return violations
 
 
-def _is_trace_package_file(path: str) -> bool:
+def check_trace_stdlib(path: str, source: bytes | None = None) -> list[str]:
+    """Back-compat alias: the original trace-only gate."""
+    return check_stdlib_only(path, source, package="k8s_tpu.trace")
+
+
+def _stdlib_only_package_of(path: str) -> str | None:
     norm = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
-    return "/k8s_tpu/trace/" in norm
+    for package in STDLIB_ONLY_PACKAGES:
+        if f"/{package.replace('.', '/')}/" in norm:
+            return package
+    return None
 
 
 def iter_py_files(src_dir: str):
@@ -88,10 +103,11 @@ def _lint_one(path: str) -> str | None:
         compile(source, path, "exec")
     except SyntaxError as e:
         return f"SyntaxError: {e}"
-    if _is_trace_package_file(path):
-        trace_violations = check_trace_stdlib(path, source)
-        if trace_violations:
-            return "\n".join(trace_violations)
+    stdlib_only_pkg = _stdlib_only_package_of(path)
+    if stdlib_only_pkg:
+        violations = check_stdlib_only(path, source, package=stdlib_only_pkg)
+        if violations:
+            return "\n".join(violations)
     from k8s_tpu.harness import pylint_lite
 
     findings = pylint_lite.check_file(path)

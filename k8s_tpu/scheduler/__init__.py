@@ -1,0 +1,51 @@
+"""Gang admission & TPU capacity scheduler (ISSUE 4).
+
+The operator's arbitration layer for finite TPU capacity: a chip ledger
+(:mod:`capacity`), a priority queue with FIFO-within-priority and
+starvation-resistant aging (:mod:`queue`), and the all-or-nothing
+admission + priority-preemption engine (:mod:`scheduler`) the v2
+controller consults before creating any pod.
+
+Process-global active-scheduler registry (mirror of ``trace.TRACER``):
+the controller registers its scheduler on construction so the metrics
+server and dashboard can serve ``/debug/scheduler`` without holding a
+controller reference.
+
+This package is stdlib-only by policy (``harness/py_checks.py`` gates it
+like ``k8s_tpu/trace/``): it holds cross-job state consulted from every
+sync, and all TFJob/topology knowledge stays with its callers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from k8s_tpu.scheduler.capacity import (  # noqa: F401 (public surface)
+    ClusterCapacity,
+    Reservation,
+    chips_from_nodes,
+)
+from k8s_tpu.scheduler.debug import debug_scheduler_response  # noqa: F401
+from k8s_tpu.scheduler.queue import AdmissionQueue, QueueEntry  # noqa: F401
+from k8s_tpu.scheduler.scheduler import (  # noqa: F401
+    Decision,
+    GangScheduler,
+)
+
+# The process's active scheduler (last controller constructed wins — one
+# operator process runs one controller; embedded/test layouts overwrite).
+_ACTIVE: Optional[GangScheduler] = None
+
+
+def set_active(scheduler: Optional[GangScheduler]) -> None:
+    global _ACTIVE
+    _ACTIVE = scheduler
+
+
+def active() -> Optional[GangScheduler]:
+    return _ACTIVE
+
+
+def debug_response(query: str = "") -> tuple[int, str, str]:
+    """The /debug/scheduler endpoint body for the active scheduler."""
+    return debug_scheduler_response(_ACTIVE, query)

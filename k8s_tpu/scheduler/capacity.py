@@ -1,0 +1,118 @@
+"""Cluster TPU capacity model: total chips, live reservations, node math.
+
+The capacity unit is the **chip** — the one number that is conserved
+across topologies (a v5litepod-256 is 64 hosts x 4 chips whether it is
+one slice or four).  A reservation is all-or-nothing by construction:
+the scheduler either records the whole job's chip demand or nothing, so
+a half-scheduled gang can never hold chips (the deadlock gang admission
+exists to prevent — see "Exploring the limits of Concurrency in ML
+Training on Google TPUs", PAPERS.md).
+
+Stdlib-only by policy (``harness/py_checks.py`` gates this package like
+``k8s_tpu/trace/``): the controller hands us plain ints and dicts; all
+TFJob/topology knowledge stays in ``controller_v2.tpu_config``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Mirrors api.v1alpha2.constants.TPU_RESOURCE_PREFIX; duplicated by value
+# because this package may not import the rest of the repo (stdlib-only
+# gate).  harness/py_checks would flag the import; tests pin the two equal.
+TPU_RESOURCE_PREFIX = "cloud-tpus.google.com/"
+
+
+def chips_from_nodes(nodes: list[dict],
+                     resource_prefix: str = TPU_RESOURCE_PREFIX) -> int:
+    """Total allocatable TPU chips across ``nodes`` (plain Node dicts):
+    the node-listing half of the capacity knob.  Unparseable quantities
+    count as 0 — a garbage label must not inflate the cluster."""
+    total = 0
+    for node in nodes or []:
+        alloc = ((node.get("status") or {}).get("allocatable")) or {}
+        for key, value in alloc.items():
+            if not key.startswith(resource_prefix):
+                continue
+            try:
+                total += int(value)
+            except (TypeError, ValueError):
+                continue
+    return total
+
+
+@dataclass
+class Reservation:
+    """One admitted gang's whole-slice chip hold."""
+
+    key: str                       # namespace/name of the TFJob
+    chips: int
+    priority: int = 0
+    queue: str = "default"
+    granted_at: float = 0.0        # POSIX seconds
+    adopted: bool = False          # re-reserved for an already-running gang
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "chips": self.chips,
+            "priority": self.priority,
+            "queue": self.queue,
+            "granted_at": self.granted_at,
+            "adopted": self.adopted,
+        }
+
+
+@dataclass
+class ClusterCapacity:
+    """Chip ledger.  ``total_chips is None`` means **unlimited** — the
+    compatibility default that disables gang admission entirely (the
+    operator behaves exactly as before the scheduler existed).
+
+    Not thread-safe on its own: the owning GangScheduler serializes all
+    access under its lock.
+    """
+
+    total_chips: Optional[int] = None
+    reservations: dict[str, Reservation] = field(default_factory=dict)
+
+    @property
+    def unlimited(self) -> bool:
+        return self.total_chips is None
+
+    def in_use(self) -> int:
+        return sum(r.chips for r in self.reservations.values())
+
+    def available(self) -> int:
+        """Chips not currently reserved.  Adoption (reality-wins
+        re-reservation after a controller restart) may legally drive this
+        negative; admission always checks ``fits`` before reserving, so
+        the ledger converges back as adopted jobs finish."""
+        if self.total_chips is None:
+            raise RuntimeError("available() is undefined on unlimited capacity")
+        return self.total_chips - self.in_use()
+
+    def fits(self, chips: int) -> bool:
+        return self.unlimited or chips <= self.available()
+
+    def reserve(self, key: str, chips: int, priority: int, queue: str,
+                now: float, adopted: bool = False) -> Reservation:
+        """Record the whole gang's hold.  Idempotent per key: re-reserving
+        an existing key keeps the original grant (a double-admit must not
+        double-count chips)."""
+        existing = self.reservations.get(key)
+        if existing is not None:
+            return existing
+        r = Reservation(key=key, chips=chips, priority=priority, queue=queue,
+                        granted_at=now, adopted=adopted)
+        self.reservations[key] = r
+        return r
+
+    def release(self, key: str) -> int:
+        """Free a reservation; returns the chips freed, 0 when absent.
+        Idempotent — a gang mid-teardown whose job is preempted AND
+        cleaned up terminally releases exactly once, never double-counting
+        its chips back into the pool."""
+        r = self.reservations.pop(key, None)
+        return r.chips if r is not None else 0

@@ -1,0 +1,38 @@
+"""/debug/scheduler responder (mirror of trace.debug_traces_response).
+
+Serves the active GangScheduler's state as JSON for the metrics server
+and the dashboard backend; 404 with an explicit body when no scheduler
+is active in this process (same contract as /debug/traces while tracing
+is off).  Stdlib-only like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import json
+from urllib.parse import parse_qs
+
+
+def debug_scheduler_response(scheduler, query: str = "") -> tuple[int, str, str]:
+    """(status_code, body, content_type) for GET /debug/scheduler.
+
+    ``?queue=<name>`` filters reservations and queue entries to one
+    logical queue; ``?events=0`` drops the event ring from the payload.
+    """
+    if scheduler is None:
+        return (404,
+                "no scheduler active (the controller registers one on "
+                "startup)\n",
+                "text/plain")
+    params = parse_qs(query or "")
+    state = scheduler.debug_state()
+    queue_name = (params.get("queue") or [None])[0]
+    if queue_name:
+        state["reservations"] = [
+            r for r in state["reservations"] if r.get("queue") == queue_name
+        ]
+        state["queue"] = [
+            e for e in state["queue"] if e.get("queue") == queue_name
+        ]
+    if (params.get("events") or ["1"])[0] in ("0", "false"):
+        state.pop("events", None)
+    return 200, json.dumps(state, indent=2, sort_keys=True) + "\n", "application/json"

@@ -1,0 +1,82 @@
+"""Admission queue: priority order, FIFO within a priority, aging.
+
+Ordering contract (the one ``tests/test_scheduler.py`` pins):
+
+- higher **effective** priority first;
+- FIFO within equal effective priority (a monotonic enqueue sequence
+  breaks ties — arrival order, never dict order);
+- **aging**: a parked job gains one effective-priority step per
+  ``aging_interval_s`` of waiting, capped at ``max_boost`` steps, so a
+  steady stream of higher-priority arrivals cannot starve a low-priority
+  job forever.  Aging affects *queue order only* — preemption compares
+  **base** priorities (scheduler.py), so an aged job never evicts a
+  genuinely more important running gang; it just stops being overtaken.
+
+Stdlib-only by policy (harness/py_checks.py gates this package).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class QueueEntry:
+    key: str                  # namespace/name of the TFJob
+    chips: int
+    priority: int             # base priority from the spec
+    queue: str = "default"    # logical queue label (grouping/reporting)
+    enqueued_at: float = 0.0  # POSIX seconds of FIRST enqueue
+    seq: int = 0              # arrival order tiebreaker
+
+
+class AdmissionQueue:
+    """Not thread-safe on its own: the owning GangScheduler serializes
+    access under its lock."""
+
+    def __init__(self, aging_interval_s: float = 300.0, max_boost: int = 5):
+        self.aging_interval_s = max(aging_interval_s, 1e-9)
+        self.max_boost = max(max_boost, 0)
+        self._entries: dict[str, QueueEntry] = {}
+        self._seq = 0
+
+    def add(self, key: str, chips: int, priority: int, queue: str,
+            now: float) -> QueueEntry:
+        """Enqueue, or refresh an existing entry's demand/priority from the
+        latest spec.  ``enqueued_at``/``seq`` survive the refresh: waiting
+        time (and with it aging and the FIFO position) is measured from the
+        first time the job asked, not the latest resync."""
+        entry = self._entries.get(key)
+        if entry is None:
+            entry = QueueEntry(key=key, chips=chips, priority=priority,
+                               queue=queue, enqueued_at=now, seq=self._seq)
+            self._seq += 1
+            self._entries[key] = entry
+        else:
+            entry.chips = chips
+            entry.priority = priority
+            entry.queue = queue
+        return entry
+
+    def get(self, key: str) -> QueueEntry | None:
+        return self._entries.get(key)
+
+    def remove(self, key: str) -> QueueEntry | None:
+        return self._entries.pop(key, None)
+
+    def depth(self) -> int:
+        return len(self._entries)
+
+    def keys(self) -> list[str]:
+        return list(self._entries)
+
+    def effective_priority(self, entry: QueueEntry, now: float) -> int:
+        boost = int((now - entry.enqueued_at) / self.aging_interval_s)
+        return entry.priority + min(max(boost, 0), self.max_boost)
+
+    def ordered(self, now: float) -> list[QueueEntry]:
+        """Entries in admission order: effective priority desc, then FIFO."""
+        return sorted(
+            self._entries.values(),
+            key=lambda e: (-self.effective_priority(e, now), e.seq),
+        )

@@ -1,0 +1,355 @@
+"""Gang admission & capacity scheduler with priority preemption.
+
+One ``GangScheduler`` arbitrates every TFJob's whole-slice chip demand
+against finite cluster capacity (ISSUE 4).  The controller calls it once
+per sync, *before* any pod exists:
+
+- ``sync_admit`` — the all-or-nothing decision: either the whole gang's
+  chips are reserved (reconcile proceeds) or the job stays parked with
+  zero pods.  A decision may instead name preemption victims.
+- ``preempt`` — atomically evict the victims (release + requeue at their
+  base priority) and reserve the preemptor.
+- ``release``/``forget`` — free chips on completion/deletion; idempotent,
+  so a gang mid-teardown can never be double-counted.
+
+Admission policy: walk the queue in effective-priority order (aging
+included, queue.py) and seat jobs until the first one that does not fit
+— a job is admitted iff it is in that strict prefix.  No backfill past a
+waiting head: small jobs can never starve a parked giant by recycling
+the chips it is waiting for; the price (idle chips while the head
+waits) is bounded by aging and preemption.
+
+Preemption policy: base priorities only (aging never evicts), victims
+chosen lowest-priority-first and newest-grant-first within a priority,
+taking the minimal prefix that frees enough chips.  No victims are named
+unless the preemptor then actually fits.
+
+Thread-safe: controller workers sync different jobs concurrently and all
+cross-job state (ledger + queue) lives here, under one lock.
+
+Stdlib-only by policy (harness/py_checks.py gates this package like
+``k8s_tpu/trace/``); all TFJob knowledge stays with the caller.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from k8s_tpu.scheduler.capacity import ClusterCapacity
+from k8s_tpu.scheduler.queue import AdmissionQueue
+
+# Aging knob (seconds of waiting per effective-priority step); the
+# constructor arg wins, then the environment, then the default.
+ENV_AGING_INTERVAL = "K8S_TPU_SCHED_AGING_S"
+DEFAULT_AGING_INTERVAL_S = 300.0
+
+_EVENT_RING = 128  # /debug/scheduler recent-events window
+
+
+def _aging_from_env() -> float:
+    try:
+        v = float(os.environ.get(ENV_AGING_INTERVAL, ""))
+    except ValueError:
+        return DEFAULT_AGING_INTERVAL_S
+    return v if v > 0 else DEFAULT_AGING_INTERVAL_S
+
+
+@dataclass
+class Decision:
+    """Outcome of one sync's admission question."""
+
+    admitted: bool
+    reason: str = ""
+    # queued=True: the job holds no reservation and must create no pods.
+    queued: bool = False
+    # victims: admission is possible NOW by evicting these keys (all
+    # strictly lower base priority); caller tears them down then calls
+    # ``preempt``.
+    victims: list[str] = field(default_factory=list)
+    # seconds between first enqueue and this admission (0 when admitted
+    # without ever waiting) — feeds tfjob_admission_wait_seconds.
+    wait_s: float = 0.0
+    # True when this decision granted a NEW reservation (vs. one that
+    # already existed) — feeds tfjob_admitted_total.
+    newly_admitted: bool = False
+
+
+class GangScheduler:
+    def __init__(self, total_chips: Optional[int] = None,
+                 aging_interval_s: Optional[float] = None,
+                 max_aging_boost: int = 5):
+        self._lock = threading.RLock()
+        self.capacity = ClusterCapacity(total_chips=total_chips)
+        self.queue = AdmissionQueue(
+            aging_interval_s=(aging_interval_s if aging_interval_s is not None
+                              else _aging_from_env()),
+            max_boost=max_aging_boost,
+        )
+        # victim key -> preemptor key, held until the victim is re-admitted
+        # (or forgotten); lets the victim's own sync explain WHY it parked
+        # and suppresses its reality-wins re-adoption.
+        self._preempted_by: dict[str, str] = {}
+        self.preemptions_total = 0
+        self.admitted_total = 0
+        self._events: collections.deque = collections.deque(maxlen=_EVENT_RING)
+
+    # -- configuration --------------------------------------------------------
+
+    @property
+    def unlimited(self) -> bool:
+        return self.capacity.unlimited
+
+    @property
+    def total_chips(self) -> Optional[int]:
+        return self.capacity.total_chips
+
+    def set_total(self, chips: Optional[int]) -> None:
+        """(Re)pin total capacity — the node-derived path updates this as
+        nodes come and go.  Shrinking below current use does not evict
+        anyone; running gangs drain naturally and nothing new is admitted
+        until the ledger fits again."""
+        with self._lock:
+            if chips == self.capacity.total_chips:
+                return
+            self.capacity.total_chips = chips
+            self._event("set_total", key="", chips=chips or 0)
+
+    # -- admission ------------------------------------------------------------
+
+    def sync_admit(self, key: str, chips: int, priority: int = 0,
+                   queue: str = "default", running: bool = False,
+                   now: Optional[float] = None) -> Decision:
+        """The per-sync admission question for one job.
+
+        ``running=True`` asserts the gang's pods already run (controller
+        restart): the reservation is re-adopted unconditionally — reality
+        wins over the ledger — unless the job was deliberately preempted,
+        in which case the eviction stands.
+        """
+        now = time.time() if now is None else now
+        with self._lock:
+            if self.unlimited:
+                return Decision(admitted=True, reason="unlimited")
+            if key in self.capacity.reservations:
+                return Decision(admitted=True, reason="reserved")
+            if chips <= 0:
+                # No TPU demand (CPU-only replicas): nothing to arbitrate.
+                return Decision(admitted=True, reason="no-tpu-demand")
+            if running and key not in self._preempted_by:
+                self.capacity.reserve(key, chips, priority, queue, now,
+                                      adopted=True)
+                self.queue.remove(key)
+                self.admitted_total += 1
+                self._event("adopt", key=key, chips=chips)
+                return Decision(admitted=True, reason="adopted",
+                                newly_admitted=True)
+
+            newly_queued = self.queue.get(key) is None
+            entry = self.queue.add(key, chips, priority, queue, now)
+            if key in self._admissible_prefix(now):
+                self.queue.remove(key)
+                self.capacity.reserve(key, chips, priority, queue, now)
+                self._preempted_by.pop(key, None)
+                self.admitted_total += 1
+                wait = max(now - entry.enqueued_at, 0.0)
+                self._event("admit", key=key, chips=chips)
+                return Decision(admitted=True, reason="fit", wait_s=wait,
+                                newly_admitted=True)
+
+            if chips > (self.capacity.total_chips or 0):
+                # Infeasible: no amount of draining or preemption can ever
+                # seat this job.  It stays parked with a reason that says
+                # so, and the prefix walk skips it, so it cannot starve
+                # feasible work behind it.
+                if newly_queued:
+                    self._event("queue", key=key, chips=chips)
+                return Decision(admitted=False, queued=True,
+                                reason="infeasible-demand-exceeds-cluster")
+            victims = self._select_victims(chips, priority)
+            if victims:
+                return Decision(admitted=False, queued=True, victims=victims,
+                                reason="preemptible")
+            if newly_queued:
+                # first parking only: a resyncing parked job must not flood
+                # the event ring and evict the admit/preempt history
+                self._event("queue", key=key, chips=chips)
+            return Decision(admitted=False, queued=True,
+                            reason="insufficient-capacity")
+
+    def _admissible_prefix(self, now: float) -> set[str]:
+        """Keys the priority-ordered walk can seat in the available chips,
+        stopping at the FIRST entry that does not fit: the waiting head
+        holds every free chip for itself (strict head-of-line order), so a
+        stream of small lower-priority jobs can never backfill a parked
+        giant into starvation — the queue drains in effective-priority
+        order, period.  The cost is idle chips while the head waits; aging
+        plus preemption keep that wait bounded."""
+        avail = self.capacity.available()
+        total = self.capacity.total_chips or 0
+        seated: set[str] = set()
+        for e in self.queue.ordered(now):
+            if e.chips > total:
+                continue  # infeasible forever: must not block feasible work
+            if e.chips > avail:
+                break
+            seated.add(e.key)
+            avail -= e.chips
+        return seated
+
+    # -- preemption -----------------------------------------------------------
+
+    def _select_victims(self, chips_needed: int, priority: int) -> list[str]:
+        """Minimal victim set freeing >= the shortfall: strictly lower BASE
+        priority only, lowest priority first, newest grant first within a
+        priority (the job that ran least loses least).  Empty when even
+        evicting every lower-priority gang would not fit."""
+        avail = self.capacity.available()
+        candidates = sorted(
+            (r for r in self.capacity.reservations.values()
+             if r.priority < priority),
+            key=lambda r: (r.priority, -r.granted_at),
+        )
+        chosen: list[str] = []
+        for r in candidates:
+            if avail >= chips_needed:
+                break
+            chosen.append(r.key)
+            avail += r.chips
+        return chosen if avail >= chips_needed else []
+
+    def preempt(self, preemptor: str, chips: int, priority: int,
+                queue: str, victims: Optional[list[str]] = None,
+                now: Optional[float] = None) -> Decision:
+        """Atomically select victims, evict them, and seat ``preemptor`` —
+        all under one lock acquisition.  The caller's ``victims`` hint (from
+        a prior sync_admit decision) is ADVISORY only: the ledger may have
+        moved between that decision and this call (another worker admitted
+        into the free chips, a victim finished), and evicting a stale set
+        would tear down innocent gangs without seating anyone.  Each actual
+        victim's reservation is released exactly once and the victim
+        re-enters the queue at its ORIGINAL base priority with a fresh
+        waiting clock.  If nothing can seat the preemptor any more, nothing
+        is evicted and the preemptor stays queued."""
+        del victims  # advisory hint; re-selected fresh under the lock
+        now = time.time() if now is None else now
+        with self._lock:
+            if preemptor in self.capacity.reservations:
+                return Decision(admitted=True, reason="reserved")
+            evicted: list[str] = []
+            if not self.capacity.fits(chips):
+                fresh = self._select_victims(chips, priority)
+                if not fresh:
+                    # the window closed: stay queued, the next sync re-decides
+                    self.queue.add(preemptor, chips, priority, queue, now)
+                    return Decision(admitted=False, queued=True,
+                                    reason="preempt-insufficient")
+                for v in fresh:
+                    r = self.capacity.reservations.get(v)
+                    self.capacity.release(v)
+                    self.queue.add(v, r.chips, r.priority, r.queue, now)
+                    self._preempted_by[v] = preemptor
+                    self.preemptions_total += 1
+                    evicted.append(v)
+                    self._event("preempt", key=v, chips=r.chips, by=preemptor)
+            entry = self.queue.remove(preemptor)
+            self.capacity.reserve(preemptor, chips, priority, queue, now)
+            self._preempted_by.pop(preemptor, None)
+            self.admitted_total += 1
+            wait = (max(now - entry.enqueued_at, 0.0)
+                    if entry is not None else 0.0)
+            self._event("admit", key=preemptor, chips=chips)
+            return Decision(admitted=True, reason="preempted",
+                            victims=evicted, wait_s=wait, newly_admitted=True)
+
+    def preempted_by(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._preempted_by.get(key)
+
+    def is_reserved(self, key: str) -> bool:
+        """Cheap steady-state fast path: lets callers skip computing a
+        job's chip demand entirely when its reservation already exists
+        (every sync of a running gang)."""
+        with self._lock:
+            return key in self.capacity.reservations
+
+    # -- release --------------------------------------------------------------
+
+    def release(self, key: str) -> int:
+        """Free the job's reservation (terminal cleanup); returns chips
+        freed (0 when it held none — idempotent)."""
+        with self._lock:
+            freed = self.capacity.release(key)
+            if freed:
+                self._event("release", key=key, chips=freed)
+            return freed
+
+    def forget(self, key: str) -> int:
+        """Job deleted: release its chips AND drop any queue entry or
+        preemption marker; returns chips freed."""
+        with self._lock:
+            freed = self.capacity.release(key)
+            self.queue.remove(key)
+            self._preempted_by.pop(key, None)
+            if freed:
+                self._event("release", key=key, chips=freed)
+            return freed
+
+    # -- introspection --------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self.queue.depth()
+
+    def waiting_keys(self) -> list[str]:
+        """Parked jobs in admission order — the wake list after a release."""
+        with self._lock:
+            return [e.key for e in self.queue.ordered(time.time())]
+
+    def _event(self, etype: str, key: str, chips: int = 0, **extra) -> None:
+        evt = {"ts": time.time(), "type": etype, "key": key, "chips": chips}
+        evt.update(extra)
+        self._events.append(evt)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def debug_state(self, now: Optional[float] = None) -> dict:
+        """The /debug/scheduler document: capacity ledger, queue with
+        effective priorities and waits, recent events."""
+        now = time.time() if now is None else now
+        with self._lock:
+            unlimited = self.unlimited
+            state = {
+                "total_chips": self.capacity.total_chips,
+                "unlimited": unlimited,
+                "in_use_chips": self.capacity.in_use(),
+                "available_chips": (None if unlimited
+                                    else self.capacity.available()),
+                "admitted_total": self.admitted_total,
+                "preemptions_total": self.preemptions_total,
+                "reservations": sorted(
+                    (r.to_dict() for r in self.capacity.reservations.values()),
+                    key=lambda d: d["granted_at"],
+                ),
+                "queue": [
+                    {
+                        "key": e.key,
+                        "chips": e.chips,
+                        "priority": e.priority,
+                        "effective_priority":
+                            self.queue.effective_priority(e, now),
+                        "queue": e.queue,
+                        "wait_s": round(max(now - e.enqueued_at, 0.0), 3),
+                        "preempted_by": self._preempted_by.get(e.key),
+                    }
+                    for e in self.queue.ordered(now)
+                ],
+                "events": list(self._events),
+            }
+        return state

@@ -318,5 +318,33 @@ def controller_metrics(generation: str, registry: Optional[Registry] = None) -> 
             "deleted — the object was already gone).",
             ("generation", "kind", "result"),
         ),
+        # -- gang admission / capacity scheduler (ISSUE 4) --------------------
+        "admitted_total": r.counter(
+            "tfjob_admitted_total",
+            "Gang admissions granted (new whole-slice chip reservations, "
+            "including adoptions and preemption-backed admissions).",
+            ("generation",),
+        ),
+        "preemptions_total": r.counter(
+            "tfjob_preemptions_total",
+            "Running gangs evicted to seat a higher-priority job (one per "
+            "victim).",
+            ("generation",),
+        ),
+        "queue_depth": r.gauge(
+            "tfjob_queue_depth",
+            "TFJobs parked by gang admission (holding zero pods), sampled "
+            "after each scheduler interaction.",
+            ("generation",),
+        ),
+        "admission_wait": r.histogram(
+            "tfjob_admission_wait_seconds",
+            "Seconds between a job first asking for capacity and its gang "
+            "being admitted.",
+            ("generation",),
+            # admission waits are queueing times, minutes-scale under
+            # contention — the default request-latency buckets top out at 10s
+            buckets=(0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0),
+        ),
         "generation": generation,
     }

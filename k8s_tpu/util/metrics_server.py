@@ -1,7 +1,9 @@
 """Operator observability endpoint: /metrics (Prometheus text 0.0.4 from
-util.metrics.Registry), /healthz, and /debug/traces (recent span trees
+util.metrics.Registry), /healthz, /debug/traces (recent span trees
 from the tracing ring buffer, slowest-first; 404 with an explicit
-"tracing disabled" body when K8S_TPU_TRACE_SAMPLE is 0).
+"tracing disabled" body when K8S_TPU_TRACE_SAMPLE is 0), and
+/debug/scheduler (gang-admission capacity ledger + priority queue; 404
+with an explicit body when no controller registered a scheduler).
 
 The reference operator exposed no scrape endpoint at all (cmd/tf-operator*/
 app/server.go wires no HTTP server); a production operator needs one, so
@@ -96,6 +98,15 @@ class MetricsServer:
 
                     code, body, ctype = trace.debug_traces_response(
                         trace.TRACER, query)
+                    return self._send(code, body, ctype)
+                if path == "/debug/scheduler":
+                    # gang-admission state: capacity ledger, priority queue
+                    # with effective priorities/waits, recent admit/preempt
+                    # events (404 with an explicit body when no controller
+                    # registered a scheduler in this process)
+                    from k8s_tpu import scheduler as scheduler_mod
+
+                    code, body, ctype = scheduler_mod.debug_response(query)
                     return self._send(code, body, ctype)
                 return self._send(404, "not found\n", "text/plain")
 

@@ -119,6 +119,91 @@ def test_gang_restarts_whole_slice_after_retryable_failure():
             _conditions(got))
 
 
+def test_preemption_while_victim_restarting_does_not_double_count_chips():
+    """ISSUE 4 satellite: a high-priority gang preempts a victim that is
+    MID-RESTART — one member just failed retryably (SIGTERM/143) and the
+    gang-restart delete wave is tearing the slice down.  The capacity
+    scheduler must account the victim's chips exactly once: release is
+    idempotent and the requeued victim holds no reservation, so the ledger
+    never goes over total and the preemptor's whole gang comes up."""
+    from k8s_tpu.harness.bench_operator import _tpu_gang_job
+
+    replicas = 4
+    chips = replicas * 4  # one v5e gang's worth: the jobs cannot co-run
+    with LocalCluster(version="v1alpha2", namespace=NS,
+                      enable_gang_scheduling=True,
+                      kubelet_kwargs={"default_runtime_s": 300.0},
+                      cluster_chips=chips) as lc:
+        cs = lc.clientset
+
+        def pods_of(job_name: str, phase: str | None = "Running") -> set[str]:
+            key = f"{NS}-{job_name}"
+            return {p["metadata"]["name"] for p in cs.pods(NS).list()
+                    if (p["metadata"].get("labels") or {}).get(
+                        "tf_job_key") == key
+                    and (phase is None
+                         or (p.get("status") or {}).get("phase") == phase)}
+
+        cs.tfjobs_unstructured(NS).create(
+            _tpu_gang_job("victim-job", NS, replicas))
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pods_of("victim-job")) < replicas:
+            time.sleep(0.05)
+        assert len(pods_of("victim-job")) == replicas
+
+        # one member dies with the preemption signature -> the gang restart
+        # delete wave starts tearing the slice down...
+        victim_pod = sorted(pods_of("victim-job"))[0]
+        lc.backend.set_pod_phase(
+            NS, victim_pod, "Failed",
+            containerStatuses=[{"name": "tensorflow",
+                                "state": {"terminated": {"exitCode": 143}}}])
+        # ...and the VIP arrives exactly then
+        hi = _tpu_gang_job("hi-job", NS, replicas)
+        hi["spec"]["priority"] = 50
+        cs.tfjobs_unstructured(NS).create(hi)
+
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pods_of("hi-job")) < replicas:
+            time.sleep(0.05)
+        assert len(pods_of("hi-job")) == replicas, "preemptor gang never ran"
+
+        sched = lc.controller.scheduler
+        state = sched.debug_state()
+        # the whole point: chips accounted exactly once, ledger never over
+        assert state["in_use_chips"] <= state["total_chips"] == chips
+        assert [r["key"] for r in state["reservations"]] == [f"{NS}/hi-job"]
+        assert sched.preemptions_total == 1
+
+        # the victim is parked (Queued/Preempted) with zero live pods
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            got = cs.tfjobs_unstructured(NS).get("victim-job")
+            queued = next((c for c in _conditions(got)
+                           if c.get("type") == "Queued"), None)
+            if (queued and queued.get("status") == "True"
+                    and queued.get("reason") == "Preempted"
+                    and not pods_of("victim-job", phase=None)):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"victim never parked cleanly: conds={_conditions(got)}, "
+                f"pods={pods_of('victim-job', phase=None)}")
+
+        # capacity frees -> the requeued victim gets the slice back
+        cs.tfjobs_unstructured(NS).delete("hi-job")
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pods_of("victim-job")) < replicas:
+            time.sleep(0.05)
+        assert len(pods_of("victim-job")) == replicas, \
+            "victim never re-admitted after the preemptor freed the slice"
+        state = sched.debug_state()
+        assert [r["key"] for r in state["reservations"]] == \
+            [f"{NS}/victim-job"]
+        assert state["in_use_chips"] == chips
+
+
 def test_monkey_level_zero_is_inert():
     cs = Clientset(FakeCluster())
     cs.pods(NS).create({"metadata": {"name": "p1"},
