@@ -621,6 +621,28 @@ def run_contention(args) -> dict:
     }
 
 
+def run_serve(args) -> dict:
+    """The --serve scenario wrapper: the continuous-batching serving
+    bench (harness/bench_serve.py — single-flight vs batched tokens/s
+    over real HTTP on the tiny CPU model), emitted on the same one-JSON-
+    line contract as the operator scenarios.  Imported lazily: this is
+    the only scenario that pulls in JAX."""
+    from k8s_tpu.harness import bench_serve
+
+    result = bench_serve.run_bench(
+        concurrency=args.serve_concurrency, slots=args.serve_slots,
+        requests_per_client=args.serve_requests,
+        max_new_short=args.serve_max_new_short,
+        max_new_long=args.serve_max_new_long)
+    if args.serve_out:
+        import os
+
+        os.makedirs(os.path.dirname(args.serve_out) or ".", exist_ok=True)
+        with open(args.serve_out, "w") as f:
+            f.write(json.dumps(result) + "\n")
+    return result
+
+
 def _noop_ctx():
     import contextlib
 
@@ -748,6 +770,23 @@ def main(argv=None) -> int:
     p.add_argument("--contention-chips", type=int, default=None,
                    help="total cluster chips (default: exactly one gang's "
                    "worth, so jobs strictly serialize)")
+    p.add_argument("--serve", action="store_true",
+                   help="run the continuous-batching serving bench "
+                   "(harness/bench_serve.py: N closed-loop HTTP clients "
+                   "vs the tiny-model inference server, single-flight vs "
+                   "batched tokens/s + p50/p99 latency) and emit one JSON "
+                   "line; combinable with the other scenarios")
+    p.add_argument("--serve-concurrency", type=int, default=8,
+                   help="closed-loop client threads for --serve")
+    p.add_argument("--serve-slots", type=int, default=8,
+                   help="decode slots for the batched --serve phase")
+    p.add_argument("--serve-requests", type=int, default=4,
+                   help="requests per client per --serve phase")
+    p.add_argument("--serve-max-new-short", type=int, default=32)
+    p.add_argument("--serve-max-new-long", type=int, default=96)
+    p.add_argument("--serve-out", default=None,
+                   help="also write the --serve JSON result to this path "
+                   "(bench artifact)")
     p.add_argument("--trace", action="store_true",
                    help="force tracing on (sample rate 1.0) and append a "
                    "per-stage p50/p99 breakdown ('stages') to the JSON "
@@ -762,8 +801,11 @@ def main(argv=None) -> int:
 
         trace.configure(sample_rate=1.0)
 
-    if args.slice_scale or args.measure_restart or args.contention:
-        if args.backend != "fake":
+    if args.slice_scale or args.measure_restart or args.contention \
+            or args.serve:
+        if args.backend != "fake" and (args.slice_scale
+                                       or args.measure_restart
+                                       or args.contention):
             p.error("--slice-scale/--measure-restart/--contention require "
                     "--backend fake: the injected RTTs and the capacity "
                     "knob only exist on the in-process cluster")
@@ -778,6 +820,8 @@ def main(argv=None) -> int:
             results.append(run_measure_restart(args))
         if args.contention:
             results.append(run_contention(args))
+        if args.serve:
+            results.append(run_serve(args))
         if args.trace:
             # one stage table for the whole invocation, on the last line
             results[-1].update(trace_stage_breakdown())

@@ -1,0 +1,279 @@
+"""Serving throughput benchmark: N concurrent closed-loop clients vs the
+resident HTTP inference server (models/server.py), single-flight vs the
+continuous-batching engine (models/engine.py).
+
+    python -m k8s_tpu.harness.bench_serve --concurrency 8 --slots 8
+
+Both phases run the SAME tiny randomly-initialized transformer in the
+same process over real HTTP (ThreadingHTTPServer + stdlib clients), so
+the comparison isolates the serving architecture:
+
+- **single_flight**: ``slots=0`` — the legacy one-lock path, every
+  request a whole-generation program, requests fully serialized;
+- **batched**: ``slots=N`` — slot-based continuous batching, one shared
+  decode step advancing all active slots, join/retire between steps.
+
+The workload is deliberately adversarial for the serialized path: client
+0 issues LONG generations (``--max-new-long``) while the rest issue
+short ones, so single-flight p99 for short requests degrades to
+"wait for the long generation", while iteration-level scheduling lets
+shorts retire mid-flight.  Emits one JSON line (bench.py contract) with
+aggregate tokens/s per phase, the speedup, p50/p99 request latency
+(overall and shorts-only), and the engine's batch-occupancy timeline;
+``--out`` additionally writes the full JSON artifact.
+
+CPU-provable: everything runs on the host platform; no TPU required.
+Numbers are advisory trend data — ci_config.yaml wires this into the
+non-gating bench_smoke tier via ``bench_operator --serve``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+log = logging.getLogger(__name__)
+
+# mixed prompt lengths exercising several prefill buckets (13 = 8+4+1 ...)
+PROMPT_LENGTHS = (4, 6, 13, 21)
+
+
+def _downsample(timeline: list, points: int) -> list:
+    """Evenly-strided subset of a (step, occupancy) timeline, keeping the
+    final sample so the retire tail is visible."""
+    if len(timeline) <= points:
+        return [list(t) for t in timeline]
+    stride = len(timeline) / points
+    out = [list(timeline[int(i * stride)]) for i in range(points)]
+    out.append(list(timeline[-1]))
+    return out
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[int(idx)]
+
+
+def build_model(seed: int = 0):
+    """CPU-benchable causal LM with byte vocab (256).  Sized so decode is
+    PARAM-BOUND like real serving (streaming ~10 MB of weights per
+    unbatched token): hidden 256 / 4 layers makes a batch-8 step cost
+    ~2x one fused-scan token, so continuous batching wins on shared
+    weight reads — the same mechanism as on TPU — rather than on
+    framework-overhead artifacts of a toy model."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_tpu.models.transformer import Transformer, TransformerConfig
+
+    config = TransformerConfig(
+        vocab_size=256, hidden=256, ffn_hidden=512, layers=4, heads=8,
+        kv_heads=8, max_seq_len=128, dtype=jnp.float32, remat=False)
+    params = Transformer(config).init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, params
+
+
+def _prompt(rank: int, length: int) -> list[int]:
+    # deterministic per (client, length) so both phases see identical work
+    return [(rank * 31 + i * 7 + length) % 256 for i in range(length)]
+
+
+def _post(url: str, payload: dict, timeout: float = 300.0) -> dict:
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def run_phase(config, params, *, slots: int, concurrency: int,
+              requests_per_client: int, max_new_short: int,
+              max_new_long: int, queue_limit: int = 1024) -> dict:
+    """One closed-loop phase: start a server, warm every program shape,
+    then hammer it with ``concurrency`` clients and measure."""
+    from k8s_tpu.models.server import LmServer, serve
+    from k8s_tpu.util.metrics import Registry
+
+    lm = LmServer(config=config, params=params, slots=slots,
+                  queue_limit=queue_limit, registry=Registry())
+    httpd = serve(lm)
+    url = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        # warmup: compile every (prompt_len, max_new) shape ANY client
+        # will issue — the long client cycles through all prompt lengths
+        # too — so the measured section is compile-free in both phases
+        for length in PROMPT_LENGTHS:
+            for max_new in (max_new_short, max_new_long):
+                _post(url, {"tokens": _prompt(0, length),
+                            "max_new_tokens": max_new})
+
+        lat_all: list[float] = []
+        lat_short: list[float] = []
+        tokens_out = [0]
+        errors: list[str] = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(concurrency + 1)
+
+        def client(rank: int) -> None:
+            import http.client
+
+            is_long = rank == 0  # one long-generation client vs the rest
+            max_new = max_new_long if is_long else max_new_short
+            # one keep-alive connection per client: a real closed-loop
+            # client reuses its socket, and per-request TCP + server
+            # thread churn would otherwise dominate the tiny-model math
+            conn = http.client.HTTPConnection(
+                "%s:%d" % httpd.server_address[:2], timeout=300)
+            barrier.wait()
+            # desynchronize starts: a perfectly phase-locked client fleet
+            # is a load-generator artifact (every request joins and
+            # retires in one wave, so the batch convoys at low occupancy
+            # and the "concurrent" load is really sequential bursts);
+            # a few ms of per-rank jitter restores steady-state arrivals
+            time.sleep(rank * 0.005)
+            try:
+                for i in range(requests_per_client):
+                    length = PROMPT_LENGTHS[(rank + i) % len(PROMPT_LENGTHS)]
+                    body = json.dumps(
+                        {"tokens": _prompt(rank, length),
+                         "max_new_tokens": max_new}).encode()
+                    t0 = time.monotonic()
+                    try:
+                        conn.request(
+                            "POST", "/v1/generate", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        out = json.loads(resp.read())
+                        assert resp.status == 200, out
+                    except Exception as e:  # noqa: BLE001 - count, don't crash
+                        with lock:
+                            errors.append(f"{type(e).__name__}: {e}")
+                        continue
+                    dt = time.monotonic() - t0
+                    with lock:
+                        lat_all.append(dt)
+                        if not is_long:
+                            lat_short.append(dt)
+                        tokens_out[0] += len(out["tokens"])
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=client, args=(r,), daemon=True)
+                   for r in range(concurrency)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.monotonic()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+
+        engine_stats = lm.engine.stats() if lm.engine is not None else {}
+        lat_all.sort()
+        lat_short.sort()
+        occ = [o for _, o in engine_stats.get("occupancy_timeline", [])]
+        return {
+            "mode": "batched" if slots > 0 else "single_flight",
+            "slots": slots,
+            "requests": len(lat_all),
+            "errors": errors[:5],
+            "wall_s": round(wall, 3),
+            "tokens": tokens_out[0],
+            "tokens_per_s": round(tokens_out[0] / max(wall, 1e-9), 1),
+            "latency_p50_s": round(_quantile(lat_all, 0.50), 4),
+            "latency_p99_s": round(_quantile(lat_all, 0.99), 4),
+            "short_p99_s": round(_quantile(lat_short, 0.99), 4),
+            "mean_batch_occupancy": round(sum(occ) / len(occ), 2)
+            if occ else None,
+            # downsampled (step, active-slots) curve: how full the batch
+            # stayed over the run, compact enough for the JSON line
+            "occupancy_timeline": _downsample(
+                engine_stats.get("occupancy_timeline", []), 32),
+            "decode_steps": engine_stats.get("steps"),
+            "prefill_programs": engine_stats.get("prefill_programs"),
+        }
+    finally:
+        httpd.shutdown()
+        lm.close()
+
+
+def run_bench(concurrency: int = 16, slots: int = 8,
+              requests_per_client: int = 4, max_new_short: int = 32,
+              max_new_long: int = 64, seed: int = 0) -> dict:
+    """Single-flight vs continuous batching over the same model/workload;
+    returns the JSON-able comparison dict."""
+    config, params = build_model(seed)
+    single = run_phase(config, params, slots=0, concurrency=concurrency,
+                       requests_per_client=requests_per_client,
+                       max_new_short=max_new_short,
+                       max_new_long=max_new_long)
+    batched = run_phase(config, params, slots=slots,
+                        concurrency=concurrency,
+                        requests_per_client=requests_per_client,
+                        max_new_short=max_new_short,
+                        max_new_long=max_new_long)
+    speedup = batched["tokens_per_s"] / max(single["tokens_per_s"], 1e-9)
+    return {
+        "metric": "serve_tokens_per_s",
+        "value": batched["tokens_per_s"],
+        "unit": "tok/s",
+        "concurrency": concurrency,
+        "requests_per_client": requests_per_client,
+        "max_new_short": max_new_short,
+        "max_new_long": max_new_long,
+        "single_flight": single,
+        "batched": batched,
+        "speedup": round(speedup, 2),
+        # iteration-level scheduling headline: short requests behind a
+        # long generation (p99) — serialized vs continuous batching
+        "short_p99_single_s": single["short_p99_s"],
+        "short_p99_batched_s": batched["short_p99_s"],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--concurrency", type=int, default=16,
+                   help="closed-loop client threads (>= 2; client 0 "
+                   "issues long generations; > slots keeps a backlog so "
+                   "slots stay fed through client turnaround)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="decode slots for the batched phase")
+    p.add_argument("--requests", type=int, default=4,
+                   help="requests per client per phase")
+    p.add_argument("--max-new-short", type=int, default=32)
+    p.add_argument("--max-new-long", type=int, default=64,
+                   help="the long-client generation length (the head-of-"
+                   "line blocker for the serialized baseline)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None,
+                   help="also write the JSON result to this path "
+                   "(bench artifact)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.WARNING)
+    result = run_bench(concurrency=args.concurrency, slots=args.slots,
+                       requests_per_client=args.requests,
+                       max_new_short=args.max_new_short,
+                       max_new_long=args.max_new_long, seed=args.seed)
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        import os
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

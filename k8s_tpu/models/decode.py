@@ -76,6 +76,43 @@ def _check_cache_capacity(config: TransformerConfig, prompt_len: int,
             "long; sliding-window configs decode indefinitely)")
 
 
+def prefill_buckets_for(config: TransformerConfig) -> tuple[int, ...]:
+    """The default prefill chunk-size bucket set for a serving engine:
+    powers of two up to ``max_seq_len`` (capped at ``prefill_chunk`` for
+    sliding-window configs, whose ring cache only has window +
+    prefill_chunk - 1 slots per chunk write).  Any prompt length
+    decomposes into bucket-sized chunks (1 is always a bucket), so the
+    engine compiles at most ``len(buckets)`` prefill programs instead of
+    one per distinct prompt length."""
+    cap = config.max_seq_len
+    if config.window_size:
+        cap = min(cap, max(1, config.prefill_chunk))
+    out, b = [], 1
+    while b <= cap:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def split_prefill(length: int, buckets: tuple[int, ...]) -> list[int]:
+    """Greedy largest-first decomposition of a prompt length into
+    bucket-sized chunks (e.g. 13 over {1,2,4,8} -> [8, 4, 1]).  Each
+    chunk is one decode-mode cache call at exact absolute positions — no
+    padding, so there is no left-pad RoPE corruption to work around."""
+    if length < 1:
+        raise ValueError(f"length must be >= 1, got {length}")
+    bs = sorted(buckets, reverse=True)
+    if not bs or bs[-1] != 1:
+        raise ValueError(f"buckets must include 1, got {buckets}")
+    out: list[int] = []
+    rem = length
+    for b in bs:
+        while rem >= b:
+            out.append(b)
+            rem -= b
+    return out
+
+
 def make_generate_fn(config: TransformerConfig, max_new_tokens: int,
                      temperature: float = 0.0, top_k: Optional[int] = None,
                      eos_id: Optional[int] = None, pad_id: int = 0,

@@ -1,0 +1,530 @@
+"""Slot-based continuous-batching inference engine (the serving TFJob's
+throughput core).
+
+The resident HTTP server (models/server.py) used to be single-flight: one
+lock around all device work, batch size 1, a long generation blocking
+every short one behind it.  This module replaces that with
+Orca/vLLM-style iteration-level scheduling:
+
+- a fixed pool of ``B`` decode **slots**, each owning one batch row of a
+  shared fixed-shape KV cache (``[B, S, kv_heads, head_dim]`` per layer)
+  plus a per-slot absolute-position counter;
+- incoming requests are **prefilled** into a free slot through the
+  chunked decode-mode cache path (transformer.Attention._decode_step)
+  with exact per-token positions — no left-padding, so RoPE and the
+  validity mask stay correct — then scattered into the slot's cache row;
+- one **batched decode step** advances every active slot per iteration;
+  requests join and retire *between* steps, so a long generation never
+  serializes short ones behind it;
+- prompt chunk sizes are drawn from a small fixed **bucket** set
+  (decode.prefill_buckets_for / split_prefill), so the engine compiles at
+  most ``len(buckets)`` prefill programs + 1 batched decode program,
+  instead of one program per distinct prompt length;
+- a **bounded admission queue** gives backpressure: when it is full,
+  submit() raises :class:`QueueFull` and the HTTP layer answers 503 with
+  ``Retry-After`` (readiness is not not-busy — /healthz stays 200 while
+  shedding).
+
+Greedy determinism is preserved: prefill logits flow through the same
+chunked cache calls the single-request chunked-prefill path uses, and the
+batched step takes each row's argmax independently, so batched output is
+token-identical to the unbatched path (asserted in tests/test_engine.py,
+including requests that join mid-decode).  Sampling (temperature > 0) and
+speculative requests run on the **exclusive lane**: FIFO through the same
+queue, executed single-flight between batch iterations with the legacy
+per-shape programs — the pre-engine behavior, kept for the request
+classes a shared greedy batch step cannot express.
+
+Knobs: ``K8S_TPU_SERVE_SLOTS`` (decode slots, default 4; the server
+treats 0 as "engine off" → legacy single-flight) and
+``K8S_TPU_SERVE_QUEUE`` (admission queue bound, default 64).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from collections import deque
+from collections.abc import Mapping
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from k8s_tpu.models.decode import prefill_buckets_for, split_prefill
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SLOTS = 4
+DEFAULT_QUEUE = 64
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        val = int(raw)
+    except ValueError:
+        if raw:
+            log.warning("ignoring non-integer %s=%r", name, raw)
+        return default
+    if val < 0:
+        log.warning("ignoring negative %s=%d", name, val)
+        return default
+    return val
+
+
+def env_slots() -> int:
+    """K8S_TPU_SERVE_SLOTS (>= 0; 0 = single-flight, engine off)."""
+    return _env_int("K8S_TPU_SERVE_SLOTS", DEFAULT_SLOTS)
+
+
+def env_queue() -> int:
+    """K8S_TPU_SERVE_QUEUE admission bound (0 rejects everything)."""
+    return _env_int("K8S_TPU_SERVE_QUEUE", DEFAULT_QUEUE)
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity; carries the Retry-After hint."""
+
+    def __init__(self, depth: int, limit: int, retry_after_s: float = 1.0):
+        super().__init__(
+            f"admission queue full ({depth}/{limit} waiting)")
+        self.depth = depth
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+
+
+class EngineClosed(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class _Request:
+    """One queued unit of work: either a batched greedy generation
+    (``ids`` set) or an exclusive-lane callable (``fn`` set)."""
+
+    ids: Optional[np.ndarray] = None
+    max_new_tokens: int = 0
+    eos_id: Optional[int] = None
+    fn: Optional[Callable[[], Any]] = None
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    result: Any = None
+    error: Optional[BaseException] = None
+
+    def finish(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self.done.set()
+
+
+class _Slot:
+    """One decode slot: a batch row of the shared cache plus host-side
+    generation state.  ``ready`` flips True once prefill has scattered
+    the row in; only ready slots participate in the batched step."""
+
+    __slots__ = ("idx", "req", "pos", "last", "tokens", "ready")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.req: Optional[_Request] = None
+        self.pos = 0          # absolute position of the NEXT cache write
+        self.last = 0         # last emitted token (fed to the next step)
+        self.tokens: list[int] = []
+        self.ready = False
+
+    @property
+    def free(self) -> bool:
+        return self.req is None
+
+    def clear(self) -> None:
+        self.req = None
+        self.tokens = []
+        self.ready = False
+
+
+def _reset_positions(tree):
+    """Fresh-cache normalization: every ``pos`` leaf to -1 (no slot
+    valid), leaving K/V storage untouched — the mask keys validity off
+    ``pos``, so stale vectors are unreachable."""
+    import jax.numpy as jnp
+
+    def rec(node):
+        if isinstance(node, Mapping):
+            return {k: (jnp.full_like(v, -1) if k == "pos" else rec(v))
+                    for k, v in node.items()}
+        return node
+
+    return rec(tree)
+
+
+class Engine:
+    """Continuous-batching decode engine over one model + params.
+
+    All device work happens on the single engine thread; callers block in
+    :meth:`submit` / :meth:`submit_exclusive` on a per-request event.
+    """
+
+    def __init__(self, config, params, *, slots: Optional[int] = None,
+                 queue_limit: Optional[int] = None,
+                 buckets: Optional[tuple] = None, pad_id: int = 0,
+                 metrics: Optional[dict] = None):
+        import jax
+
+        from k8s_tpu.models.transformer import Transformer
+
+        if slots is None:
+            slots = env_slots() or DEFAULT_SLOTS
+        if slots < 1:
+            raise ValueError(f"engine needs slots >= 1, got {slots}")
+        if queue_limit is None:
+            queue_limit = env_queue()
+        self.config = config
+        self.params = params
+        self.pad_id = pad_id
+        self.queue_limit = queue_limit
+        self.buckets = tuple(sorted(buckets or prefill_buckets_for(config)))
+        if not self.buckets or self.buckets[0] != 1:
+            raise ValueError(
+                f"buckets must include 1 so every prompt length "
+                f"decomposes, got {self.buckets}")
+        if config.window_size and \
+                self.buckets[-1] > max(1, config.prefill_chunk):
+            raise ValueError(
+                f"bucket {self.buckets[-1]} exceeds prefill_chunk "
+                f"({config.prefill_chunk}): a windowed ring cache only "
+                "holds window + prefill_chunk - 1 slots")
+        self.metrics = metrics or {}
+        self._model = Transformer(config)
+        self._slots = [_Slot(i) for i in range(slots)]
+        self._queue: deque[_Request] = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._crashed = False
+
+        # jit program inventory — the compile-bound contract: one prefill
+        # program per USED bucket size (lazy, tracked in _prefill_fns),
+        # one batched decode step, plus two shape-constant auxiliaries
+        # (row scatter, cache init) that never grow with traffic.
+        self._prefill_fns: dict[int, Callable] = {}
+        self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
+        self._scatter_fn = jax.jit(self._scatter_impl, donate_argnums=(0,))
+        self._decode_compiled = False
+        self._cache = self._init_cache(slots)
+        self._row_template = self._init_cache(1)
+
+        # stats (mutated on the engine thread; read under _cond)
+        self._steps = 0
+        self._completed = 0
+        self._peak_active = 0
+        self._occupancy: deque[tuple[int, int]] = deque(maxlen=4096)
+
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lm-engine")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ API
+
+    def submit(self, ids, max_new_tokens: int, eos_id: Optional[int] = None,
+               timeout: Optional[float] = None) -> list[int]:
+        """Batched greedy generation; returns emitted tokens (stopping at
+        the first EOS, inclusive).  Raises QueueFull under backpressure."""
+        from k8s_tpu.models.decode import _check_cache_capacity
+
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # same bound the unbatched jit enforces at trace time, surfaced
+        # BEFORE the request occupies queue space (an over-capacity row
+        # would wrap slot = pos % S and corrupt its own cache row)
+        _check_cache_capacity(self.config, int(ids.size),
+                              int(max_new_tokens))
+        req = _Request(ids=ids, max_new_tokens=int(max_new_tokens),
+                       eos_id=eos_id)
+        return self._enqueue_and_wait(req, timeout)
+
+    def submit_exclusive(self, fn: Callable[[], Any],
+                         timeout: Optional[float] = None):
+        """Run ``fn`` single-flight on the engine thread between batch
+        iterations (the sampling / speculative lane); FIFO with batched
+        admissions through the same bounded queue."""
+        req = _Request(fn=fn)
+        return self._enqueue_and_wait(req, timeout)
+
+    def _enqueue_and_wait(self, req: _Request, timeout: Optional[float]):
+        with self._cond:
+            if self._closed:
+                raise EngineClosed("engine is shut down")
+            if len(self._queue) >= self.queue_limit:
+                rej = self.metrics.get("rejected")
+                if rej is not None:
+                    rej.inc()
+                raise QueueFull(len(self._queue), self.queue_limit)
+            self._queue.append(req)
+            self._cond.notify_all()
+        if not req.done.wait(timeout):
+            # best-effort cancellation: a still-queued request is removed
+            # so abandoned retries don't pile phantom work onto a loaded
+            # engine; one already admitted to a slot runs to completion
+            # (its tokens are simply discarded)
+            with self._cond:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    pass
+            raise TimeoutError("generation did not complete in time")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    @property
+    def healthy(self) -> bool:
+        """False once the engine loop has died on an unexpected error —
+        the serving /healthz must flip to 503 so the kubelet restarts the
+        pod instead of routing to a process that 500s every generate.
+        Deliberate shutdown() and queue shedding are NOT unhealthy."""
+        return not self._crashed
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def active_slots(self) -> int:
+        with self._cond:
+            return sum(1 for s in self._slots if not s.free)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "slots": len(self._slots),
+                "active": sum(1 for s in self._slots if not s.free),
+                "queue_depth": len(self._queue),
+                "queue_limit": self.queue_limit,
+                "steps": self._steps,
+                "completed": self._completed,
+                "peak_active": self._peak_active,
+                "buckets": list(self.buckets),
+                "prefill_programs": sorted(self._prefill_fns),
+                "decode_programs": int(self._decode_compiled),
+                "occupancy_timeline": list(self._occupancy),
+            }
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+    # -------------------------------------------------------- jit programs
+
+    def _init_cache(self, batch: int):
+        """Batched cache pytree for ``batch`` rows, every slot invalid.
+        Built by one eager decode-mode apply (flax initializes the cache
+        collection), then pos-reset — runs op-by-op, compiles nothing."""
+        import jax.numpy as jnp
+
+        toks = jnp.zeros((batch, 1), jnp.int32)
+        pos = jnp.zeros((batch, 1), jnp.int32)
+        _, varz = self._model.apply(
+            {"params": self.params}, toks, positions=pos, mode="decode",
+            mutable=["cache"])
+        return _reset_positions(varz["cache"])
+
+    def _step_impl(self, params, cache, toks, poss):
+        """One batched decode step: feed each row's last token at its own
+        position, greedy argmax per row (matching sample_logits'
+        temperature-0 path exactly — raw-dtype argmax, no cast)."""
+        import jax.numpy as jnp
+
+        logits, varz = self._model.apply(
+            {"params": params, "cache": cache}, toks[:, None],
+            positions=poss[:, None], mode="decode", mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return varz["cache"], nxt
+
+    def _scatter_impl(self, cache, row, idx):
+        """Replace batch row ``idx`` of every cache leaf with the freshly
+        prefilled batch-1 row (slot join)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda full, r: full.at[idx].set(r[0]), cache, row)
+
+    def _prefill_fn(self, chunk_len: int) -> Callable:
+        fn = self._prefill_fns.get(chunk_len)
+        if fn is None:
+            import jax
+
+            def run(params, cache, chunk, positions):
+                logits, varz = self._model.apply(
+                    {"params": params, "cache": cache}, chunk,
+                    positions=positions, mode="decode", mutable=["cache"])
+                return varz["cache"], logits[:, -1]
+
+            fn = jax.jit(run)
+            # copy-on-write rebind: stats() iterates this dict from probe
+            # threads without the engine lock, so never mutate in place
+            self._prefill_fns = {**self._prefill_fns, chunk_len: fn}
+        return fn
+
+    # -------------------------------------------------------- engine loop
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    while (not self._closed and not self._queue
+                           and not any(s.ready for s in self._slots)):
+                        self._cond.wait()
+                    if self._closed:
+                        self._drain_locked()
+                        return
+                    actions = self._admit_locked()
+                for req, slot in actions:
+                    if req.fn is not None:
+                        self._run_exclusive(req)
+                    else:
+                        self._prefill_into(slot, req)
+                if any(s.ready for s in self._slots):
+                    self._decode_step_all()
+        except BaseException:  # noqa: BLE001 - engine thread must not die silently
+            log.exception("engine loop crashed; failing all requests")
+            with self._cond:
+                self._closed = True
+                self._crashed = True
+                self._drain_locked()
+
+    def _drain_locked(self) -> None:
+        err = EngineClosed("engine shut down with requests in flight")
+        while self._queue:
+            self._queue.popleft().finish(error=err)
+        for s in self._slots:
+            if s.req is not None:
+                s.req.finish(error=err)
+                s.clear()
+
+    def _admit_locked(self) -> list[tuple[_Request, Optional[_Slot]]]:
+        """FIFO admission: exclusive requests always pop (they run inline
+        between steps); batched requests pop while a free slot exists."""
+        out: list[tuple[_Request, Optional[_Slot]]] = []
+        while self._queue:
+            head = self._queue[0]
+            if head.fn is not None:
+                out.append((self._queue.popleft(), None))
+                continue
+            slot = next((s for s in self._slots if s.free), None)
+            if slot is None:
+                break
+            slot.req = self._queue.popleft()
+            slot.ready = False
+            out.append((slot.req, slot))
+        return out
+
+    def _run_exclusive(self, req: _Request) -> None:
+        from k8s_tpu import trace
+
+        try:
+            with trace.span("exclusive_generate"):
+                result = req.fn()
+        except BaseException as e:  # noqa: BLE001 - surfaced to the caller
+            req.finish(error=e)
+            return
+        req.finish(result=result)
+        with self._cond:
+            self._completed += 1
+
+    def _prefill_into(self, slot: _Slot, req: _Request) -> None:
+        """Chunked prefill of one prompt (batch-1, bucket-sized chunks at
+        exact positions), then scatter the row into the slot and emit the
+        first token.  A first-token EOS or max_new_tokens == 1 retires the
+        request without ever occupying a step."""
+        import jax.numpy as jnp
+
+        from k8s_tpu import trace
+
+        try:
+            ids = req.ids
+            chunks = split_prefill(len(ids), self.buckets)
+            with trace.span("prefill", prompt_len=len(ids),
+                            chunks=len(chunks)):
+                cache = self._row_template
+                off = 0
+                last = None
+                for c in chunks:
+                    chunk = jnp.asarray(ids[off:off + c], jnp.int32)[None, :]
+                    positions = (off + jnp.arange(c, dtype=jnp.int32))[None, :]
+                    cache, last = self._prefill_fn(c)(
+                        self.params, cache, chunk, positions)
+                    off += c
+                first = int(np.asarray(
+                    jnp.argmax(last, axis=-1).astype(jnp.int32))[0])
+        except BaseException as e:  # noqa: BLE001 - bad request must not kill the loop
+            req.finish(error=e)
+            with self._cond:
+                slot.clear()
+            return
+        tokens = [first]
+        if (req.eos_id is not None and first == req.eos_id) \
+                or req.max_new_tokens <= 1:
+            self._retire(slot, req, tokens)
+            return
+        self._cache = self._scatter_fn(self._cache, cache,
+                                       jnp.asarray(slot.idx, jnp.int32))
+        slot.tokens = tokens
+        slot.last = first
+        slot.pos = len(ids)
+        slot.ready = True
+        with self._cond:
+            self._peak_active = max(
+                self._peak_active,
+                sum(1 for s in self._slots if not s.free))
+
+    def _retire(self, slot: _Slot, req: _Request, tokens: list[int]) -> None:
+        tok_counter = self.metrics.get("tokens")
+        if tok_counter is not None:
+            tok_counter.inc(len(tokens))
+        req.finish(result=tokens)
+        with self._cond:
+            self._completed += 1
+            slot.clear()
+
+    def _decode_step_all(self) -> None:
+        """One batched step over every ready slot.  Free rows ride along
+        with (token 0, position 0); their stray cache writes land in rows
+        the next prefill scatter fully replaces, and row independence of
+        the batched math keeps active rows exact."""
+        import jax.numpy as jnp
+
+        from k8s_tpu import trace
+
+        B = len(self._slots)
+        toks = np.full((B,), self.pad_id, np.int32)
+        poss = np.zeros((B,), np.int32)
+        active = [s for s in self._slots if s.ready]
+        for s in active:
+            toks[s.idx] = s.last
+            poss[s.idx] = s.pos
+        with trace.span("decode_step", active=len(active)):
+            self._cache, nxt = self._step_fn(
+                self.params, self._cache, jnp.asarray(toks),
+                jnp.asarray(poss))
+            nxt_host = np.asarray(nxt)
+        self._decode_compiled = True
+        occ = self.metrics.get("occupancy")
+        if occ is not None:
+            occ.set(len(active))
+        with self._cond:
+            self._steps += 1
+            self._occupancy.append((self._steps, len(active)))
+        for s in active:
+            tok = int(nxt_host[s.idx])
+            s.tokens.append(tok)
+            s.pos += 1
+            s.last = tok
+            req = s.req
+            hit_eos = req.eos_id is not None and tok == req.eos_id
+            if hit_eos or len(s.tokens) >= req.max_new_tokens:
+                self._retire(s, req, s.tokens)

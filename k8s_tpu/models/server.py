@@ -11,44 +11,210 @@ variant).
 
 Endpoints (JSON over HTTP/1.1, stdlib-only like the rest of the repo):
 
-- ``GET /healthz`` → ``{"status": "ok", "model": {...}}`` — readiness for
-  kubelet probes.
+- ``GET /healthz`` → ``{"status": "ok", "model": {...}, "serving":
+  {...}}`` — readiness for kubelet probes, including queue depth / slot
+  occupancy.  Stays 200 while the admission queue is shedding: readiness
+  is "can answer HTTP", not "not busy".
+- ``GET /metrics`` → Prometheus text exposition (serve_requests_total,
+  serve_queue_depth, serve_batch_occupancy, serve_tokens_total,
+  serve_rejected_total, serve_request_duration_seconds).
+- ``GET /debug/traces`` → recent prefill/decode_step span trees (the
+  operator's responder, k8s_tpu.trace; 404 with an explicit body when
+  K8S_TPU_TRACE_SAMPLE is 0).
 - ``POST /v1/generate`` with ``{"text": str | "tokens": [int], ...}`` →
   ``{"text": str | "tokens": [int]}``.  Optional fields:
   ``max_new_tokens`` (default from --max_new_tokens), ``temperature``,
   ``top_k``, ``eos``, ``seed``, ``speculative`` (draft_k, greedy-only).
+  Bad input answers 400 with ``{"error": ..., "field": ...}`` naming the
+  offending field; a full admission queue answers 503 with a
+  ``Retry-After`` header.
 
-Device work is single-flight (one lock): decode programs are compiled per
-(prompt-length, generation-config) shape and cached by jit, so repeated
-shapes are served at device speed; a NEW prompt length pays one compile
-(documented, not hidden — there is no silent left-pad bucketing, which
-would corrupt RoPE positions).
+Device work goes through the continuous-batching engine
+(k8s_tpu.models.engine): greedy requests share one batched decode step
+over K8S_TPU_SERVE_SLOTS slots with iteration-level join/retire, so a
+long generation no longer serializes short ones; sampling and
+speculative requests run single-flight on the engine's exclusive lane
+(their legacy behavior).  ``--slots 0`` disables the engine entirely and
+restores the original one-lock single-flight path (the bench_serve
+baseline).  Prompt-length compiles are bounded by the engine's bucket
+set instead of unbounded per-prompt-length.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 log = logging.getLogger(__name__)
 
 
+class RequestError(ValueError):
+    """400-class input error carrying the offending field name."""
+
+    def __init__(self, field: str, msg: str):
+        super().__init__(msg)
+        self.field = field
+
+
+@dataclasses.dataclass
+class ParsedRequest:
+    """A fully validated /v1/generate request — everything the device
+    path needs, produced on the HTTP handler thread so no request
+    parsing, tokenization, or validation ever runs inside the engine."""
+
+    ids: "object"                      # np.ndarray [Lp] int32
+    echo_text: Optional[str]           # original text, or None for tokens
+    max_new_tokens: int
+    temperature: float
+    top_k: Optional[int]
+    eos: Optional[int]
+    seed: int
+    speculative: int
+
+    @property
+    def batched(self) -> bool:
+        """Greedy non-speculative requests ride the shared batch step;
+        everything else takes the exclusive lane."""
+        return self.temperature == 0.0 and self.speculative == 0
+
+
+def parse_request(config, req: dict, default_max_new_tokens: int
+                  ) -> ParsedRequest:
+    """Validate one request dict against the model config; raises
+    :class:`RequestError` naming the offending field."""
+    import numpy as np
+
+    from k8s_tpu.models.dataset import encode_bytes
+
+    has_text = isinstance(req.get("text"), str)
+    has_tokens = isinstance(req.get("tokens"), list)
+    if has_text == has_tokens:
+        raise RequestError("text", 'give exactly one of "text" or "tokens"')
+    field = "text" if has_text else "tokens"
+    if has_text:
+        ids = encode_bytes(req["text"]).astype(np.int32)
+    else:
+        try:
+            ids = np.asarray([int(t) for t in req["tokens"]], np.int32)
+        except (TypeError, ValueError):
+            raise RequestError("tokens", '"tokens" must be a list of ints')
+    if ids.size < 1:
+        raise RequestError(field, "empty prompt")
+    if ids.min(initial=0) < 0 or ids.max(initial=0) >= config.vocab_size:
+        raise RequestError(
+            field, f"token ids outside [0, {config.vocab_size})")
+
+    def opt(key, default, cast):
+        # JSON null means "not set" (use the default), like an absent
+        # key; a non-castable value is the CLIENT's error -> 400
+        val = req.get(key)
+        if val is None:
+            return default
+        try:
+            return cast(val)
+        except (TypeError, ValueError):
+            raise RequestError(key, f"bad {key!r}: {val!r}")
+
+    max_new = opt("max_new_tokens", default_max_new_tokens, int)
+    if not 1 <= max_new <= config.max_seq_len:
+        raise RequestError(
+            "max_new_tokens",
+            f"max_new_tokens must be in [1, {config.max_seq_len}]")
+    from k8s_tpu.models.decode import _check_cache_capacity
+
+    try:
+        # the ONE definition of the cache-capacity bound, surfaced here
+        # as a client error before any device work
+        _check_cache_capacity(config, int(ids.size), max_new)
+    except ValueError as e:
+        raise RequestError("max_new_tokens", str(e))
+    temperature = opt("temperature", 0.0, float)
+    if temperature < 0.0:
+        raise RequestError("temperature", "temperature must be >= 0")
+    top_k = opt("top_k", 0, int) or None
+    if top_k is not None and top_k < 1:
+        raise RequestError("top_k",
+                           "top_k must be >= 1 (omit or 0 disables)")
+    eos: Optional[int] = opt("eos", None, int)
+    seed = opt("seed", 0, int)
+    spec = opt("speculative", 0, int)
+    if spec != 0 and spec < 2:
+        raise RequestError("speculative",
+                           "speculative must be >= 2 (0 disables)")
+    return ParsedRequest(
+        ids=ids, echo_text=req["text"] if has_text else None,
+        max_new_tokens=max_new, temperature=temperature, top_k=top_k,
+        eos=eos, seed=seed, speculative=spec)
+
+
+def _emitted(toks, eos) -> int:
+    """Tokens actually emitted by a shape-static generation row: through
+    the first EOS inclusive, excluding the frozen pad tail — the same
+    definition the engine counts at retirement, so serve_tokens_total
+    means one thing across lanes."""
+    toks = list(toks)
+    if eos is not None and eos in toks:
+        return toks.index(eos) + 1
+    return len(toks)
+
+
 class LmServer:
-    """Loads a serving artifact once; thread-safe generate()."""
+    """Loads a serving artifact (or takes config+params directly) once;
+    thread-safe generate() through the continuous-batching engine."""
 
-    def __init__(self, train_dir: str, kv_cache: str = "model",
-                 param_dtype: str = "model",
-                 default_max_new_tokens: int = 64):
-        from k8s_tpu.models import serving
+    def __init__(self, train_dir: Optional[str] = None,
+                 kv_cache: str = "model", param_dtype: str = "model",
+                 default_max_new_tokens: int = 64, *,
+                 config=None, params=None, slots: Optional[int] = None,
+                 queue_limit: Optional[int] = None, registry=None):
+        from k8s_tpu.models import engine as engine_lib
+        from k8s_tpu.util import metrics as metrics_mod
 
-        self.config, self.params = serving.load_for_serving(
-            train_dir, kv_cache=kv_cache, param_dtype=param_dtype)
+        if train_dir is not None:
+            from k8s_tpu.models import serving
+
+            config, params = serving.load_for_serving(
+                train_dir, kv_cache=kv_cache, param_dtype=param_dtype)
+        elif config is None or params is None:
+            raise ValueError("need train_dir or config+params")
+        self.config = config
+        self.params = params
         self.default_max_new_tokens = default_max_new_tokens
-        self._lock = threading.Lock()  # single-flight device work
+        self.registry = registry or metrics_mod.REGISTRY
+        self.metrics = metrics_mod.serving_metrics(self.registry)
+        # registry.register() returns the EXISTING metric on a name
+        # collision, so rebind the gauge callable to THIS server (latest
+        # wins) instead of baking it in at registration — a second
+        # LmServer on the shared default registry must not report a
+        # closed predecessor's queue forever (nor pin it against GC;
+        # close() releases the binding)
+        self.metrics["queue_depth"]._fn = self.queue_depth
+        if slots is None:
+            slots = engine_lib.env_slots()
+        if slots > 0:
+            self.engine: Optional[engine_lib.Engine] = engine_lib.Engine(
+                config, params, slots=slots, queue_limit=queue_limit,
+                metrics=self.metrics)
+        else:
+            # legacy single-flight path: one lock around all device work
+            # (kept as the bench_serve baseline and an escape hatch)
+            self.engine = None
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self.metrics["queue_depth"]._fn == self.queue_depth:
+            self.metrics["queue_depth"]._fn = None
+        if self.engine is not None:
+            self.engine.shutdown()
+
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth() if self.engine is not None else 0
 
     def model_info(self) -> dict:
         c = self.config
@@ -56,101 +222,131 @@ class LmServer:
                 "vocab_size": c.vocab_size, "max_seq_len": c.max_seq_len,
                 "kv_cache_dtype": c.kv_cache_dtype}
 
-    def generate(self, req: dict) -> dict:
-        """One generation request; raises ValueError on bad input."""
+    def serving_info(self) -> dict:
+        """Engine occupancy for /healthz (shedding is NOT unreadiness)."""
+        if self.engine is None:
+            return {"engine": "single-flight", "slots": 0,
+                    "queue_depth": 0}
+        s = self.engine.stats()
+        return {"engine": "continuous-batching", "slots": s["slots"],
+                "active": s["active"], "queue_depth": s["queue_depth"],
+                "queue_limit": s["queue_limit"]}
+
+    def generate(self, parsed: ParsedRequest) -> dict:
+        """One validated generation request (parse_request ran on the
+        handler thread).  May raise engine.QueueFull under backpressure."""
+        import numpy as np
+
+        from k8s_tpu.models.dataset import decode_bytes
+        from k8s_tpu.models.serving import strip_after_eos
+
+        if self.engine is not None and parsed.batched:
+            toks = self.engine.submit(parsed.ids, parsed.max_new_tokens,
+                                      eos_id=parsed.eos)
+        elif self.engine is not None:
+            toks = self.engine.submit_exclusive(
+                lambda: self._generate_exclusive(parsed))
+            self.metrics["tokens"].inc(_emitted(toks, parsed.eos))
+        else:
+            with self._lock:
+                toks = self._generate_exclusive(parsed)
+            self.metrics["tokens"].inc(_emitted(toks, parsed.eos))
+        toks = strip_after_eos(np.asarray(toks), parsed.eos)
+        if parsed.echo_text is not None:
+            return {"text": parsed.echo_text
+                    + decode_bytes(np.asarray(toks))}
+        return {"tokens": [int(t) for t in toks]}
+
+    def _generate_exclusive(self, parsed: ParsedRequest):
+        """The pre-engine device path (sampling / speculative / legacy
+        single-flight): one whole-generation program per shape."""
         import jax
         import jax.numpy as jnp
         import numpy as np
 
         from k8s_tpu.models import decode as decode_lib
-        from k8s_tpu.models.dataset import decode_bytes, encode_bytes
 
-        has_text = isinstance(req.get("text"), str)
-        has_tokens = isinstance(req.get("tokens"), list)
-        if has_text == has_tokens:
-            raise ValueError('give exactly one of "text" or "tokens"')
-        if has_text:
-            ids = encode_bytes(req["text"]).astype(np.int32)
+        prompt = jnp.asarray(parsed.ids)[None, :]
+        if parsed.speculative > 0:
+            # temperature/top_k compose via rejection sampling: the
+            # emitted tokens are distributed exactly as vanilla
+            # temperature/top-k sampling
+            fn = decode_lib.cached_speculative_fn(
+                self.config, parsed.max_new_tokens,
+                draft_k=parsed.speculative, eos_id=parsed.eos,
+                temperature=parsed.temperature,
+                top_k=parsed.top_k if parsed.temperature > 0 else None)
+            out = fn(self.params, prompt, jax.random.PRNGKey(parsed.seed))
         else:
-            try:
-                ids = np.asarray([int(t) for t in req["tokens"]], np.int32)
-            except (TypeError, ValueError):
-                raise ValueError('"tokens" must be a list of ints')
-        if ids.size < 1:
-            raise ValueError("empty prompt")
-        if ids.min(initial=0) < 0 or \
-                ids.max(initial=0) >= self.config.vocab_size:
-            raise ValueError(
-                f"token ids outside [0, {self.config.vocab_size})")
-
-        def opt(key, default, cast):
-            # JSON null means "not set" (use the default), like an absent
-            # key; a non-castable value is the CLIENT's error -> 400
-            val = req.get(key)
-            if val is None:
-                return default
-            try:
-                return cast(val)
-            except (TypeError, ValueError):
-                raise ValueError(f"bad {key!r}: {val!r}")
-
-        max_new = opt("max_new_tokens", self.default_max_new_tokens, int)
-        if not 1 <= max_new <= self.config.max_seq_len:
-            raise ValueError(f"max_new_tokens must be in "
-                             f"[1, {self.config.max_seq_len}]")
-        temperature = opt("temperature", 0.0, float)
-        top_k = opt("top_k", 0, int) or None
-        if top_k is not None and top_k < 1:
-            raise ValueError("top_k must be >= 1 (omit or 0 disables)")
-        eos: Optional[int] = opt("eos", None, int)
-        seed = opt("seed", 0, int)
-        spec = opt("speculative", 0, int)
-        if spec != 0 and spec < 2:
-            raise ValueError("speculative must be >= 2 (0 disables)")
-
-        prompt = jnp.asarray(ids)[None, :]
-        with self._lock:
-            if spec > 0:
-                # temperature/top_k compose via rejection sampling: the
-                # emitted tokens are distributed exactly as vanilla
-                # temperature/top-k sampling
-                fn = decode_lib.cached_speculative_fn(
-                    self.config, max_new, draft_k=spec, eos_id=eos,
-                    temperature=temperature,
-                    top_k=top_k if temperature > 0 else None)
-                out = fn(self.params, prompt, jax.random.PRNGKey(seed))
-            else:
-                out = decode_lib.generate(
-                    self.config, self.params, prompt, max_new,
-                    rng=jax.random.PRNGKey(seed), temperature=temperature,
-                    top_k=top_k, eos_id=eos)
-        from k8s_tpu.models.serving import strip_after_eos
-
-        toks = strip_after_eos(np.asarray(out)[0], eos)
-        if has_text:
-            return {"text": req["text"] + decode_bytes(np.asarray(toks))}
-        return {"tokens": [int(t) for t in toks]}
+            out = decode_lib.generate(
+                self.config, self.params, prompt, parsed.max_new_tokens,
+                rng=jax.random.PRNGKey(parsed.seed),
+                temperature=parsed.temperature, top_k=parsed.top_k,
+                eos_id=parsed.eos)
+        return np.asarray(out)[0]
 
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "k8s-tpu-lm"
+    # one TCP segment per response: fully buffer writes (flushed once per
+    # request by handle_one_request) and disable Nagle.  With the default
+    # unbuffered wfile, the header write and the body write leave as two
+    # small segments; Nagle holds the second until the first is ACKed and
+    # the client's delayed ACK waits on more data — a 40-200ms stall per
+    # response on every keep-alive connection.
+    wbufsize = -1
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):
         log.debug("server: " + fmt, *args)
 
-    def _send(self, code: int, obj: dict) -> None:
+    def _send(self, code: int, obj: dict, headers: Optional[dict] = None
+              ) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
-        if self.path == "/healthz":
-            return self._send(200, {"status": "ok",
-                                    "model": self.server.lm.model_info()})
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            lm = self.server.lm
+            # busy (shedding) is still ready; a CRASHED engine is not —
+            # 503 here makes the kubelet recycle the pod instead of
+            # routing to a process that 500s every generate
+            dead = lm.engine is not None and not lm.engine.healthy
+            return self._send(503 if dead else 200,
+                              {"status": "engine crashed" if dead
+                               else "ok",
+                               "model": lm.model_info(),
+                               "serving": lm.serving_info()})
+        if path == "/metrics":
+            try:
+                body = self.server.lm.registry.expose()
+            except Exception as e:  # noqa: BLE001 - broken collector
+                return self._send_text(500, f"scrape failed: {e}\n",
+                                       "text/plain")
+            return self._send_text(
+                200, body, "text/plain; version=0.0.4; charset=utf-8")
+        if path == "/debug/traces":
+            from k8s_tpu import trace
+
+            code, body, ctype = trace.debug_traces_response(
+                trace.TRACER, query)
+            return self._send_text(code, body, ctype)
         return self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
@@ -165,19 +361,47 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length > 0 else b""
         if self.path != "/v1/generate":
             return self._send(404, {"error": f"unknown path {self.path}"})
+        lm = self.server.lm
+        m = lm.metrics
         try:
             req = json.loads(raw or b"{}")
             if not isinstance(req, dict):
                 raise ValueError("request body must be a JSON object")
         except (ValueError, json.JSONDecodeError) as e:
+            m["requests"].labels("bad_request").inc()
             return self._send(400, {"error": f"bad request body: {e}"})
+        # parse/validate ENTIRELY on the handler thread: the engine only
+        # ever sees token arrays and validated knobs
         try:
-            return self._send(200, self.server.lm.generate(req))
+            parsed = parse_request(lm.config, req,
+                                   lm.default_max_new_tokens)
+        except RequestError as e:
+            m["requests"].labels("bad_request").inc()
+            return self._send(400, {"error": str(e), "field": e.field})
+        from k8s_tpu.models.engine import QueueFull
+
+        start = time.monotonic()
+        try:
+            out = lm.generate(parsed)
+        except QueueFull as e:
+            # backpressure: shed with an explicit retry hint; /healthz
+            # stays 200 (the serve_rejected_total counter is incremented
+            # by the engine at the rejection site)
+            m["requests"].labels("rejected").inc()
+            return self._send(
+                503, {"error": str(e)},
+                headers={"Retry-After":
+                         str(max(1, int(round(e.retry_after_s))))})
         except ValueError as e:
+            m["requests"].labels("bad_request").inc()
             return self._send(400, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 - surface, don't kill the worker
             log.exception("generate failed")
+            m["requests"].labels("error").inc()
             return self._send(500, {"error": f"{type(e).__name__}: {e}"})
+        m["requests"].labels("ok").inc()
+        m["duration"].observe(time.monotonic() - start)
+        return self._send(200, out)
 
 
 def serve(lm: LmServer, host: str = "127.0.0.1", port: int = 0):
@@ -204,11 +428,18 @@ def main(argv=None) -> int:
     p.add_argument("--kv_cache", choices=["model", "int8"], default="model")
     p.add_argument("--param_dtype", choices=["model", "bfloat16"],
                    default="model")
+    p.add_argument("--slots", type=int, default=None,
+                   help="continuous-batching decode slots (default "
+                   "K8S_TPU_SERVE_SLOTS or 4; 0 = legacy single-flight)")
+    p.add_argument("--queue", type=int, default=None,
+                   help="admission queue bound before 503 shedding "
+                   "(default K8S_TPU_SERVE_QUEUE or 64)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     lm = LmServer(args.train_dir, kv_cache=args.kv_cache,
                   param_dtype=args.param_dtype,
-                  default_max_new_tokens=args.max_new_tokens)
+                  default_max_new_tokens=args.max_new_tokens,
+                  slots=args.slots, queue_limit=args.queue)
     httpd = serve(lm, args.host, args.port)
     host, port = httpd.server_address[:2]
     log.info("serving %s on http://%s:%d (POST /v1/generate)",
@@ -220,6 +451,7 @@ def main(argv=None) -> int:
         pass
     finally:
         httpd.shutdown()
+        lm.close()
     return 0
 
 

@@ -348,3 +348,51 @@ def controller_metrics(generation: str, registry: Optional[Registry] = None) -> 
         ),
         "generation": generation,
     }
+
+
+def serving_metrics(registry: Optional[Registry] = None,
+                    queue_depth_fn=None) -> dict:
+    # NOTE: on a name collision the registry returns the EXISTING gauge,
+    # so queue_depth_fn only takes effect for the first registrant —
+    # callers that can be instantiated repeatedly (models/server.py)
+    # rebind the gauge's _fn to themselves instead of passing it here.
+    """The inference-server metric family (ISSUE 5): request totals by
+    result, backpressure rejections, emitted tokens, live batch occupancy
+    and admission-queue depth, and end-to-end request latency — exported
+    on the serving pod's own ``/metrics`` (models/server.py) so the
+    serving half of the train→serve story is observable like the control
+    plane."""
+    r = registry or REGISTRY
+    return {
+        "requests": r.counter(
+            "serve_requests_total",
+            "Generate requests by result (ok / bad_request / rejected / "
+            "error).",
+            ("result",),
+        ),
+        "rejected": r.counter(
+            "serve_rejected_total",
+            "Requests shed by admission-queue backpressure (HTTP 503 + "
+            "Retry-After).",
+        ),
+        "tokens": r.counter(
+            "serve_tokens_total",
+            "Tokens emitted across all completed generations.",
+        ),
+        "occupancy": r.gauge(
+            "serve_batch_occupancy",
+            "Active decode slots in the most recent batched step "
+            "(continuous-batching engine; 0..K8S_TPU_SERVE_SLOTS).",
+        ),
+        "queue_depth": r.gauge(
+            "serve_queue_depth",
+            "Requests waiting in the bounded admission queue, sampled at "
+            "scrape time.",
+            fn=queue_depth_fn,
+        ),
+        "duration": r.histogram(
+            "serve_request_duration_seconds",
+            "End-to-end /v1/generate latency (parse to response body), "
+            "successful requests.",
+        ),
+    }
