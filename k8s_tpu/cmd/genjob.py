@@ -48,6 +48,98 @@ def v5e_slice_for_hosts(num_hosts: int) -> tuple[str, str]:
     return f"v5litepod-{chips}", f"{x}x{y}"
 
 
+def serve_tfjob_template(
+    job_name: str,
+    namespace: str = "default",
+    train_dir: str = "/checkpoints/train-lm",
+    scheduler_name: str = "default",
+    serve_slots: int = 8,
+    serve_queue: int = 64,
+    serve_prefix_blocks: int | None = None,
+    serve_batch_sampling: bool = True,
+    priority: int | None = None,
+    queue: str | None = None,
+) -> dict:
+    """A resident serving TFJob (the examples/tf_job_serve_http.yaml
+    shape) with the engine knobs surfaced as env: decode slots and
+    admission queue bound, plus the round-6 shared-prefix KV pool
+    retention (``K8S_TPU_SERVE_PREFIX_BLOCKS``; omit for auto, 0
+    disables reuse) and batched-sampling lane routing
+    (``K8S_TPU_SERVE_BATCH_SAMPLING``)."""
+    env = [
+        {"name": "K8S_TPU_SERVE_SLOTS", "value": str(serve_slots)},
+        {"name": "K8S_TPU_SERVE_QUEUE", "value": str(serve_queue)},
+        {"name": "K8S_TPU_SERVE_BATCH_SAMPLING",
+         "value": "1" if serve_batch_sampling else "0"},
+    ]
+    if serve_prefix_blocks is not None:
+        env.append({"name": "K8S_TPU_SERVE_PREFIX_BLOCKS",
+                    "value": str(serve_prefix_blocks)})
+    job = {
+        "apiVersion": "kubeflow.org/v1alpha2",
+        "kind": "TFJob",
+        "metadata": {"name": job_name, "namespace": namespace},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": 1,
+                    "restartPolicy": "OnFailure",
+                    "template": {
+                        "spec": {
+                            "schedulerName": scheduler_name,
+                            "containers": [
+                                {
+                                    "name": "tensorflow",
+                                    "image": "k8s-tpu/train-lm:latest",
+                                    "command": [
+                                        "python", "-m",
+                                        "k8s_tpu.models.server",
+                                        f"--train_dir={train_dir}",
+                                        "--host=0.0.0.0", "--port=8000",
+                                    ],
+                                    "env": env,
+                                    "ports": [{"containerPort": 8000,
+                                               "name": "http"}],
+                                    "readinessProbe": {
+                                        "httpGet": {"path": "/healthz",
+                                                    "port": 8000}
+                                    },
+                                    # match the example manifest: a TPU
+                                    # + memory request (the block pool
+                                    # lives in pod memory limits) and
+                                    # the checkpoint volume the
+                                    # --train_dir path loads from
+                                    "resources": {
+                                        "limits": {
+                                            "google.com/tpu": 4,
+                                            "memory": "16Gi",
+                                        }
+                                    },
+                                    "volumeMounts": [
+                                        {"name": "checkpoints",
+                                         "mountPath": "/checkpoints"}
+                                    ],
+                                }
+                            ],
+                            "volumes": [
+                                {"name": "checkpoints",
+                                 "persistentVolumeClaim": {
+                                     "claimName": "train-lm-checkpoints"
+                                 }}
+                            ],
+                        }
+                    },
+                }
+            }
+        },
+    }
+    if priority is not None:
+        job["spec"]["priority"] = priority
+    if queue is not None:
+        job["spec"]["queue"] = queue
+    return job
+
+
 def tfjob_template(
     job_name: str,
     namespace: str = "default",
@@ -151,9 +243,25 @@ def generate(
     timestamp: int | None = None,
     priority: int | None = None,
     queue: str | None = None,
+    serve: bool = False,
+    serve_slots: int = 8,
+    serve_queue: int = 64,
+    serve_prefix_blocks: int | None = None,
+    serve_batch_sampling: bool = True,
 ) -> list[dict]:
     """N uniquely-named jobs, ``tfjob-<ts>-<i>`` (genjob.go:111-114)."""
     ts = timestamp if timestamp is not None else time.time_ns() % 10**9
+    if serve:
+        return [
+            serve_tfjob_template(
+                f"tfjob-{ts}-{i}", namespace,
+                scheduler_name=scheduler_name,
+                serve_slots=serve_slots, serve_queue=serve_queue,
+                serve_prefix_blocks=serve_prefix_blocks,
+                serve_batch_sampling=serve_batch_sampling,
+                priority=priority, queue=queue)
+            for i in range(n)
+        ]
     return [
         tfjob_template(f"tfjob-{ts}-{i}", namespace, gpu, tpu, scheduler_name,
                        priority=priority, queue=queue)
@@ -174,6 +282,22 @@ def main(argv=None) -> int:
     parser.add_argument("--queue", default=None,
                         help="gang-admission queue label (v1alpha2 "
                         "spec.queue)")
+    parser.add_argument("--serve", action="store_true",
+                        help="generate resident serving TFJobs "
+                        "(k8s_tpu.models.server) instead of training "
+                        "jobs, with the engine knobs as env")
+    parser.add_argument("--serve-slots", type=int, default=8,
+                        help="K8S_TPU_SERVE_SLOTS for --serve jobs")
+    parser.add_argument("--serve-queue", type=int, default=64,
+                        help="K8S_TPU_SERVE_QUEUE for --serve jobs")
+    parser.add_argument("--serve-prefix-blocks", type=int, default=None,
+                        help="K8S_TPU_SERVE_PREFIX_BLOCKS for --serve "
+                        "jobs (omit = auto-size; 0 disables shared-"
+                        "prefix KV reuse)")
+    parser.add_argument("--serve-batch-sampling", type=int,
+                        choices=(0, 1), default=1,
+                        help="K8S_TPU_SERVE_BATCH_SAMPLING for --serve "
+                        "jobs (0 = exclusive-lane sampling)")
     parser.add_argument(
         "--dump", action="store_true", help="print manifests instead of creating"
     )
@@ -189,6 +313,11 @@ def main(argv=None) -> int:
         scheduler_name=args.scheduler_name,
         priority=args.priority,
         queue=args.queue,
+        serve=args.serve,
+        serve_slots=args.serve_slots,
+        serve_queue=args.serve_queue,
+        serve_prefix_blocks=args.serve_prefix_blocks,
+        serve_batch_sampling=bool(args.serve_batch_sampling),
     )
     if args.dump:
         yaml.safe_dump_all(jobs, sys.stdout)

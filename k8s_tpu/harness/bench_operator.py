@@ -633,7 +633,9 @@ def run_serve(args) -> dict:
         concurrency=args.serve_concurrency, slots=args.serve_slots,
         requests_per_client=args.serve_requests,
         max_new_short=args.serve_max_new_short,
-        max_new_long=args.serve_max_new_long)
+        max_new_long=args.serve_max_new_long,
+        sampled=bool(args.serve_sampled),
+        shared_frac=args.serve_shared_frac)
     if args.serve_out:
         import os
 
@@ -784,6 +786,16 @@ def main(argv=None) -> int:
                    help="requests per client per --serve phase")
     p.add_argument("--serve-max-new-short", type=int, default=32)
     p.add_argument("--serve-max-new-long", type=int, default=96)
+    p.add_argument("--serve-sampled", type=int, choices=(0, 1),
+                   default=1,
+                   help="include the shared-prefix temperature>0 phases "
+                   "in --serve: exclusive-lane sampling vs the batched "
+                   "sampling lane with radix prefix-cache reuse "
+                   "(compile counts + hit rate land in the JSON "
+                   "artifact)")
+    p.add_argument("--serve-shared-frac", type=float, default=0.8,
+                   help="fraction of sampled-phase requests sharing the "
+                   "templated prompt prefix")
     p.add_argument("--serve-out", default=None,
                    help="also write the --serve JSON result to this path "
                    "(bench artifact)")

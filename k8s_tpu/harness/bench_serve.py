@@ -22,6 +22,19 @@ aggregate tokens/s per phase, the speedup, p50/p99 request latency
 (overall and shorts-only), and the engine's batch-occupancy timeline;
 ``--out`` additionally writes the full JSON artifact.
 
+Round 6 adds the PRODUCTION-SHAPED phases (``--sampled``, on by
+default): 80% of requests share a templated prompt prefix and all carry
+``temperature>0`` with per-request seeds — the mix that used to
+serialize completely on the engine's exclusive single-flight lane.
+Phase ``sampled_exclusive`` routes sampling exclusively with prefix
+reuse off (the pre-round-6 engine); ``sampled_batched`` rides the slot
+lanes with the radix prefix cache on.  Every phase records its compile
+counts (bucket prefill programs, batched decode programs, whole-
+generation exclusive programs) and the prefix-cache hit rate measured
+AFTER warmup, so reuse wins are not conflated with compile warming; a
+fixed-seed equivalence spot check asserts the two sampled routings emit
+identical tokens.
+
 CPU-provable: everything runs on the host platform; no TPU required.
 Numbers are advisory trend data — ci_config.yaml wires this into the
 non-gating bench_smoke tier via ``bench_operator --serve``.
@@ -85,6 +98,25 @@ def _prompt(rank: int, length: int) -> list[int]:
     return [(rank * 31 + i * 7 + length) % 256 for i in range(length)]
 
 
+def _template(length: int) -> list[int]:
+    """The shared system-prompt prefix of the sampled phases."""
+    return [(i * 5 + 3) % 256 for i in range(length)]
+
+
+def _shared_prompt(rank: int, i: int, template_len: int,
+                   tail_len: int, shared: bool) -> list[int]:
+    """Templated traffic: ``shared`` requests are the common template
+    plus a per-(client, request) unique tail; the rest are fully unique
+    prompts of the same total length (so both routings compile the same
+    shapes and only REUSE differs)."""
+    if shared:
+        tail = [(rank * 17 + i * 13 + j * 7 + 1) % 256
+                for j in range(tail_len)]
+        return _template(template_len) + tail
+    return [(rank * 37 + i * 101 + j * 7 + 11) % 256
+            for j in range(template_len + tail_len)]
+
+
 def _post(url: str, payload: dict, timeout: float = 300.0) -> dict:
     req = urllib.request.Request(
         url + "/v1/generate", data=json.dumps(payload).encode(),
@@ -95,24 +127,58 @@ def _post(url: str, payload: dict, timeout: float = 300.0) -> dict:
 
 def run_phase(config, params, *, slots: int, concurrency: int,
               requests_per_client: int, max_new_short: int,
-              max_new_long: int, queue_limit: int = 1024) -> dict:
+              max_new_long: int, queue_limit: int = 1024,
+              temperature: float = 0.0,
+              batch_sampling: bool = True,
+              prefix_blocks: int | None = None,
+              shared_frac: float = 0.0, template_len: int = 40,
+              tail_len: int = 6, mode: str | None = None) -> dict:
     """One closed-loop phase: start a server, warm every program shape,
-    then hammer it with ``concurrency`` clients and measure."""
+    then hammer it with ``concurrency`` clients and measure.
+
+    ``shared_frac > 0`` switches to the templated workload: that
+    fraction of requests shares a ``template_len``-token prefix (the
+    rest are unique same-length prompts), every request carries
+    ``temperature`` with a per-request seed, and the phase reports the
+    prefix-cache hit rate of the MEASURED section (warmup pre-seeds the
+    tree, then counters are snapshotted — reuse wins are not conflated
+    with compile warming)."""
+    from k8s_tpu.models import decode as decode_lib
     from k8s_tpu.models.server import LmServer, serve
     from k8s_tpu.util.metrics import Registry
 
     lm = LmServer(config=config, params=params, slots=slots,
-                  queue_limit=queue_limit, registry=Registry())
+                  queue_limit=queue_limit, batch_sampling=batch_sampling,
+                  prefix_blocks=prefix_blocks, registry=Registry())
     httpd = serve(lm)
     url = "http://%s:%d" % httpd.server_address[:2]
+    gen_programs0 = decode_lib._cached_generate_fn.cache_info().currsize
     try:
         # warmup: compile every (prompt_len, max_new) shape ANY client
         # will issue — the long client cycles through all prompt lengths
         # too — so the measured section is compile-free in both phases
-        for length in PROMPT_LENGTHS:
-            for max_new in (max_new_short, max_new_long):
-                _post(url, {"tokens": _prompt(0, length),
-                            "max_new_tokens": max_new})
+        if shared_frac > 0:
+            for shared in (True, False):
+                _post(url, {"tokens": _shared_prompt(
+                    99, 99, template_len, tail_len, shared),
+                    "max_new_tokens": max_new_short,
+                    "temperature": temperature, "seed": 99})
+            # warm the copy-on-write program too: a mid-block partial
+            # match (truncated template + unique tail) CoWs the
+            # divergence block, so that compile never lands inside the
+            # measured section either
+            cut = (template_len // 2) | 1  # odd: never block-aligned
+            _post(url, {"tokens": _template(template_len)[:cut]
+                        + [250, 251, 252],
+                        "max_new_tokens": max_new_short,
+                        "temperature": temperature, "seed": 98})
+        else:
+            for length in PROMPT_LENGTHS:
+                for max_new in (max_new_short, max_new_long):
+                    _post(url, {"tokens": _prompt(0, length),
+                                "max_new_tokens": max_new,
+                                "temperature": temperature})
+        warm_stats = lm.engine.stats() if lm.engine is not None else {}
 
         lat_all: list[float] = []
         lat_short: list[float] = []
@@ -124,7 +190,12 @@ def run_phase(config, params, *, slots: int, concurrency: int,
         def client(rank: int) -> None:
             import http.client
 
-            is_long = rank == 0  # one long-generation client vs the rest
+            # greedy phases: one long-generation client exposes the
+            # head-of-line price.  Sampled phases: a uniform short mix —
+            # the headline there is aggregate tokens/s under the
+            # production traffic shape, and a single long straggler
+            # would only measure the tail of an emptying batch.
+            is_long = rank == 0 and shared_frac == 0
             max_new = max_new_long if is_long else max_new_short
             # one keep-alive connection per client: a real closed-loop
             # client reuses its socket, and per-request TCP + server
@@ -140,10 +211,24 @@ def run_phase(config, params, *, slots: int, concurrency: int,
             time.sleep(rank * 0.005)
             try:
                 for i in range(requests_per_client):
-                    length = PROMPT_LENGTHS[(rank + i) % len(PROMPT_LENGTHS)]
-                    body = json.dumps(
-                        {"tokens": _prompt(rank, length),
-                         "max_new_tokens": max_new}).encode()
+                    if shared_frac > 0:
+                        # deterministic split accurate to 1% for ANY
+                        # fraction (a modulus of round(1/(1-f)) would
+                        # collapse to 0% shared for f <= 0.33): the SAME
+                        # mix hits every phase, so only routing differs
+                        shared = ((rank * 37 + i * 11) % 100) \
+                            < round(shared_frac * 100)
+                        payload = {"tokens": _shared_prompt(
+                            rank, i, template_len, tail_len, shared),
+                            "max_new_tokens": max_new,
+                            "temperature": temperature,
+                            "seed": rank * 1000 + i}
+                    else:
+                        length = PROMPT_LENGTHS[(rank + i)
+                                                % len(PROMPT_LENGTHS)]
+                        payload = {"tokens": _prompt(rank, length),
+                                   "max_new_tokens": max_new}
+                    body = json.dumps(payload).encode()
                     t0 = time.monotonic()
                     try:
                         conn.request(
@@ -179,9 +264,36 @@ def run_phase(config, params, *, slots: int, concurrency: int,
         lat_all.sort()
         lat_short.sort()
         occ = [o for _, o in engine_stats.get("occupancy_timeline", [])]
+        # per-phase compile inventory + MEASURED-section prefix stats
+        # (deltas vs the post-warmup snapshot: reuse wins must not be
+        # conflated with compile warming)
+        compile_counts = {
+            "prefill_programs": engine_stats.get("prefill_programs", []),
+            "decode_programs": engine_stats.get("decode_programs", 0),
+            "whole_gen_programs":
+                decode_lib._cached_generate_fn.cache_info().currsize
+                - gen_programs0,
+        }
+        hits = engine_stats.get("prefix_hits", 0) \
+            - warm_stats.get("prefix_hits", 0)
+        prefix = {
+            "hits": hits,
+            "hit_rate": round(hits / max(1, len(lat_all)), 3),
+            "tokens_saved": engine_stats.get("prefix_tokens_saved", 0)
+            - warm_stats.get("prefix_tokens_saved", 0),
+            "cow_copies": engine_stats.get("cow_copies", 0),
+            "tree_nodes": engine_stats.get("tree_nodes", 0),
+            "blocks_in_use": engine_stats.get("blocks_in_use", 0),
+            "pool_blocks": engine_stats.get("pool_blocks", 0),
+        }
         return {
-            "mode": "batched" if slots > 0 else "single_flight",
+            "mode": mode or ("batched" if slots > 0 else "single_flight"),
             "slots": slots,
+            "temperature": temperature,
+            "batch_sampling": bool(batch_sampling) and slots > 0,
+            "shared_frac": shared_frac,
+            "compile": compile_counts,
+            "prefix": prefix,
             "requests": len(lat_all),
             "errors": errors[:5],
             "wall_s": round(wall, 3),
@@ -204,23 +316,58 @@ def run_phase(config, params, *, slots: int, concurrency: int,
         lm.close()
 
 
+def check_sampled_equivalence(config, params, template_len: int = 40,
+                              tail_len: int = 6) -> bool:
+    """Fixed-seed spot check over real HTTP: the batched sampling lane
+    and the exclusive lane must emit IDENTICAL tokens — the bench's
+    speedup claim is only meaningful if the routing is output-invariant."""
+    from k8s_tpu.models.server import LmServer, serve
+    from k8s_tpu.util.metrics import Registry
+
+    payload = {"tokens": _shared_prompt(3, 1, template_len, tail_len,
+                                        True),
+               "max_new_tokens": 8, "temperature": 1.0, "seed": 7}
+    outs = []
+    for batch_sampling in (True, False):
+        lm = LmServer(config=config, params=params, slots=2,
+                      queue_limit=8, batch_sampling=batch_sampling,
+                      registry=Registry())
+        httpd = serve(lm)
+        try:
+            outs.append(_post("http://%s:%d" % httpd.server_address[:2],
+                              payload))
+        finally:
+            httpd.shutdown()
+            lm.close()
+    return outs[0] == outs[1]
+
+
 def run_bench(concurrency: int = 16, slots: int = 8,
               requests_per_client: int = 4, max_new_short: int = 32,
-              max_new_long: int = 64, seed: int = 0) -> dict:
-    """Single-flight vs continuous batching over the same model/workload;
-    returns the JSON-able comparison dict."""
+              max_new_long: int = 64, seed: int = 0,
+              sampled: bool = True, shared_frac: float = 0.8) -> dict:
+    """Single-flight vs continuous batching over the same model/workload
+    (the PR-5 greedy phases), plus the round-6 production mix: 80%
+    shared-prefix traffic at temperature>0, exclusive-lane sampling (the
+    pre-round-6 engine) vs the batched sampling lane with prefix reuse.
+    Returns the JSON-able comparison dict."""
     config, params = build_model(seed)
     single = run_phase(config, params, slots=0, concurrency=concurrency,
                        requests_per_client=requests_per_client,
                        max_new_short=max_new_short,
                        max_new_long=max_new_long)
+    # prefix reuse OFF in the greedy comparison: the slots=0 baseline
+    # cannot have a prefix cache, so leaving it on would fold reuse wins
+    # into the "continuous batching vs single flight" claim (the warmup
+    # even pre-seeds client 0's exact prompts).  The sampled phases
+    # below measure reuse explicitly.
     batched = run_phase(config, params, slots=slots,
                         concurrency=concurrency,
                         requests_per_client=requests_per_client,
                         max_new_short=max_new_short,
-                        max_new_long=max_new_long)
+                        max_new_long=max_new_long, prefix_blocks=0)
     speedup = batched["tokens_per_s"] / max(single["tokens_per_s"], 1e-9)
-    return {
+    result = {
         "metric": "serve_tokens_per_s",
         "value": batched["tokens_per_s"],
         "unit": "tok/s",
@@ -236,6 +383,33 @@ def run_bench(concurrency: int = 16, slots: int = 8,
         "short_p99_single_s": single["short_p99_s"],
         "short_p99_batched_s": batched["short_p99_s"],
     }
+    if sampled:
+        # the production-shaped mix: templated prompts, temperature>0.
+        # Baseline = the pre-round-6 engine (sampling exclusive, no
+        # prefix reuse); candidate = batched sampling + radix reuse.
+        # Load is raised past the greedy phases' (2x the clients): a
+        # serialized baseline is load-invariant while the batched lane
+        # exists exactly to convert backlog into occupancy.
+        sampled_kw = dict(
+            slots=slots, concurrency=concurrency * 2,
+            requests_per_client=requests_per_client,
+            max_new_short=max_new_short, max_new_long=max_new_long,
+            temperature=1.0, shared_frac=shared_frac)
+        exclusive = run_phase(config, params, batch_sampling=False,
+                              prefix_blocks=0, mode="sampled_exclusive",
+                              **sampled_kw)
+        promoted = run_phase(config, params, batch_sampling=True,
+                             prefix_blocks=None, mode="sampled_batched",
+                             **sampled_kw)
+        result["sampled_exclusive"] = exclusive
+        result["sampled_batched"] = promoted
+        result["sampled_speedup"] = round(
+            promoted["tokens_per_s"]
+            / max(exclusive["tokens_per_s"], 1e-9), 2)
+        result["sampled_shared_frac"] = shared_frac
+        result["sampled_equivalence_ok"] = check_sampled_equivalence(
+            config, params)
+    return result
 
 
 def main(argv=None) -> int:
@@ -253,6 +427,13 @@ def main(argv=None) -> int:
                    help="the long-client generation length (the head-of-"
                    "line blocker for the serialized baseline)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sampled", type=int, choices=(0, 1), default=1,
+                   help="also run the shared-prefix temperature>0 "
+                   "phases: exclusive-lane sampling vs the batched "
+                   "sampling lane with prefix reuse (default on)")
+    p.add_argument("--shared-frac", type=float, default=0.8,
+                   help="fraction of sampled-phase requests sharing the "
+                   "templated prompt prefix")
     p.add_argument("--out", default=None,
                    help="also write the JSON result to this path "
                    "(bench artifact)")
@@ -261,7 +442,9 @@ def main(argv=None) -> int:
     result = run_bench(concurrency=args.concurrency, slots=args.slots,
                        requests_per_client=args.requests,
                        max_new_short=args.max_new_short,
-                       max_new_long=args.max_new_long, seed=args.seed)
+                       max_new_long=args.max_new_long, seed=args.seed,
+                       sampled=bool(args.sampled),
+                       shared_frac=args.shared_frac)
     line = json.dumps(result)
     print(line)
     if args.out:

@@ -62,6 +62,54 @@ def sample_logits(logits, rng, temperature: float = 0.0,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_logits_rows(logits, keys, temperature, top_k):
+    """Row-wise sampling for the batched decode step: each batch row
+    samples from ITS OWN distribution with ITS OWN rng key.
+
+    ``logits`` is [B, V]; ``keys`` is [B, 2] uint32 (one PRNG key per
+    row); ``temperature`` is [B] float32 (0 = greedy); ``top_k`` is [B]
+    int32 (0 = disabled).  Returns ``(new_keys [B, 2], tokens [B])``.
+
+    EXACTNESS CONTRACT (the batched-sampling lane's correctness claim,
+    asserted in tests/test_engine.py and over HTTP in
+    tests/test_serve_http.py): for every row this computes token-for-
+    token what the exclusive lane's jit program computes for a batch-1
+    request —
+
+    - the key schedule is ``rng, sub = jax.random.split(rng)`` per step
+      (``new_keys`` carries ``rng`` forward, ``sub`` draws the sample),
+      the same unconditional split :func:`make_generate_fn` performs;
+    - temperature/top-k processing mirrors :func:`_process_logits`
+      value-for-value — the kth-largest threshold comes from a full
+      descending sort instead of ``lax.top_k`` (per-row k is a traced
+      value here, so the static-k gather is unavailable), but the kth
+      VALUE and the ``logits < kth`` mask are identical;
+    - the draw is ``jax.random.categorical`` over a [1, V] row under
+      ``vmap`` — vmap semantics guarantee the per-row result equals the
+      unbatched batch-1 call with the same key;
+    - temperature-0 rows take the raw-dtype argmax (no f32 cast), the
+      same greedy path :func:`sample_logits` takes, and their sampled
+      draw is discarded (their key still advances — make_generate_fn
+      splits unconditionally too, so the schedule stays aligned even
+      for requests that never use the sub key).
+    """
+    V = logits.shape[-1]
+
+    def row(key, lg, t, tk):
+        ks = jax.random.split(key)  # [2, 2]: ks[0] carries, ks[1] draws
+        greedy = jnp.argmax(lg).astype(jnp.int32)
+        # _process_logits, row-wise: divide by the row's temperature
+        # (guarded for greedy rows whose division result is unused)
+        x = lg.astype(jnp.float32) / jnp.where(t > 0, t, 1.0)
+        srt = jnp.sort(x)[::-1]
+        kth = srt[jnp.clip(tk, 1, V) - 1]  # kth-largest == lax.top_k [-1]
+        x = jnp.where((tk > 0) & (x < kth), -1e30, x)
+        s = jax.random.categorical(ks[1], x[None, :], axis=-1)[0]
+        return ks[0], jnp.where(t > 0, s.astype(jnp.int32), greedy)
+
+    return jax.vmap(row)(keys, logits, temperature, top_k)
+
+
 def _check_cache_capacity(config: TransformerConfig, prompt_len: int,
                           max_new_tokens: int) -> None:
     """Shared full-cache bound for greedy and beam decoding: the LAST

@@ -4,46 +4,63 @@ throughput core).
 The resident HTTP server (models/server.py) used to be single-flight: one
 lock around all device work, batch size 1, a long generation blocking
 every short one behind it.  This module replaces that with
-Orca/vLLM-style iteration-level scheduling:
+Orca/vLLM-style iteration-level scheduling plus (round 6) a paged KV
+cache with shared-prefix reuse and a batched sampling lane:
 
-- a fixed pool of ``B`` decode **slots**, each owning one batch row of a
-  shared fixed-shape KV cache (``[B, S, kv_heads, head_dim]`` per layer)
-  plus a per-slot absolute-position counter;
-- incoming requests are **prefilled** into a free slot through the
-  chunked decode-mode cache path (transformer.Attention._decode_step)
-  with exact per-token positions — no left-padding, so RoPE and the
-  validity mask stay correct — then scattered into the slot's cache row;
-- one **batched decode step** advances every active slot per iteration;
-  requests join and retire *between* steps, so a long generation never
-  serializes short ones behind it;
-- prompt chunk sizes are drawn from a small fixed **bucket** set
-  (decode.prefill_buckets_for / split_prefill), so the engine compiles at
-  most ``len(buckets)`` prefill programs + 1 batched decode program,
-  instead of one program per distinct prompt length;
+- a fixed pool of ``B`` decode **slots**; for full-cache configs each
+  slot references a per-request **block table** over one shared
+  block-granular KV pool (``[num_blocks, block_size, kv_heads,
+  head_dim]`` per layer) instead of owning a fixed ``max_seq_len`` row —
+  persistent KV memory is ``num_blocks x block_size``, deduplicated
+  across requests, no longer ``slots x max_seq_len`` by construction;
+- a **radix prefix tree** (models/kvblocks.py) caches block-sized
+  token runs: a request walks the tree, attaches to already-prefilled
+  blocks **by reference** (refcounted), copy-on-writes the divergence
+  block when the match ends mid-block, and prefills only its unshared
+  tail — templated traffic prefills the common prefix once per process,
+  not once per request;
+- incoming tails are **prefilled** through the chunked decode-mode cache
+  path with exact per-token positions (no left-pad RoPE corruption) in
+  bucket-sized chunks (decode.prefill_buckets_for / split_prefill), then
+  land in the request's own pool blocks;
+- one **batched decode step** advances every active slot per iteration
+  over a gathered block-table view of the pool; requests join and retire
+  *between* steps, so a long generation never serializes short ones;
+- **sampling rides the batch** (round 6): per-slot RNG keys, temperature
+  and top-k are threaded through the batched step and
+  ``decode.sample_logits_rows`` draws each row from its own distribution
+  with the exact key schedule of the exclusive lane's program — a
+  fixed-seed ``temperature>0`` request emits token-identical output on
+  either lane (asserted in tests).  Speculative and beam requests still
+  take the **exclusive lane** (single-flight between batch iterations):
+  their multi-token verify steps need write-masked variable-width
+  chunks the shared batched step does not express yet;
+- compile count stays bounded: one prefill program per USED bucket, one
+  batched decode program, and a constant set of pool auxiliaries
+  (copy-on-write, block reset, row scatter) — never per prefix length;
 - a **bounded admission queue** gives backpressure: when it is full,
   submit() raises :class:`QueueFull` and the HTTP layer answers 503 with
   ``Retry-After`` (readiness is not not-busy — /healthz stays 200 while
   shedding).
 
-Greedy determinism is preserved: prefill logits flow through the same
-chunked cache calls the single-request chunked-prefill path uses, and the
-batched step takes each row's argmax independently, so batched output is
-token-identical to the unbatched path (asserted in tests/test_engine.py,
-including requests that join mid-decode).  Sampling (temperature > 0) and
-speculative requests run on the **exclusive lane**: FIFO through the same
-queue, executed single-flight between batch iterations with the legacy
-per-shape programs — the pre-engine behavior, kept for the request
-classes a shared greedy batch step cannot express.
+Sliding-window configs keep the pre-paging dense slot rows (their ring
+cache is position-wrapped per row and does not decompose into shareable
+absolute-position blocks); prefix reuse is a full-cache feature.
 
 Knobs: ``K8S_TPU_SERVE_SLOTS`` (decode slots, default 4; the server
-treats 0 as "engine off" → legacy single-flight) and
-``K8S_TPU_SERVE_QUEUE`` (admission queue bound, default 64).
+treats 0 as "engine off" → legacy single-flight),
+``K8S_TPU_SERVE_QUEUE`` (admission queue bound, default 64), and
+``K8S_TPU_SERVE_PREFIX_BLOCKS`` (extra pool blocks retained for the
+prefix tree beyond the ``1 + slots x blocks_per_row`` floor; 0 disables
+prefix reuse, unset auto-sizes to two full-length rows).  The
+``K8S_TPU_SERVE_BATCH_SAMPLING`` lane-routing knob lives in the server.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 import os
 import threading
 from collections import deque
@@ -53,11 +70,22 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from k8s_tpu.models.decode import prefill_buckets_for, split_prefill
+from k8s_tpu.models.kvblocks import BlockPool, PrefixTree
 
 log = logging.getLogger(__name__)
 
 DEFAULT_SLOTS = 4
 DEFAULT_QUEUE = 64
+# preferred KV block size (tokens); clamped into the bucket set so block
+# boundaries line up with prefill chunk boundaries
+DEFAULT_BLOCK = 16
+# fused decode: up to this many batched iterations run as ONE program
+# (lax.scan) when no active row can retire mid-scan (no EOS condition,
+# >= k tokens remaining everywhere) — the pool gather, write-back, and
+# host round-trip amortize over k tokens.  Joins and exclusive-lane
+# work wait at most k-1 extra iterations (~a few ms); the paged step
+# compiles one program per used k, bounded by this constant.
+MAX_STEP_TOKENS = 4
 
 
 def _env_int(name: str, default: int) -> int:
@@ -84,6 +112,25 @@ def env_queue() -> int:
     return _env_int("K8S_TPU_SERVE_QUEUE", DEFAULT_QUEUE)
 
 
+def env_prefix_blocks() -> Optional[int]:
+    """K8S_TPU_SERVE_PREFIX_BLOCKS: pool blocks retained for the prefix
+    tree beyond the slot floor (0 = prefix reuse off; unset = auto)."""
+    if "K8S_TPU_SERVE_PREFIX_BLOCKS" not in os.environ:
+        return None
+    return _env_int("K8S_TPU_SERVE_PREFIX_BLOCKS", 0)
+
+
+def env_batch_sampling() -> bool:
+    """K8S_TPU_SERVE_BATCH_SAMPLING: route temperature>0 requests onto
+    the batched slot lanes (default on; 0/false restores the exclusive
+    single-flight routing — the pre-round-6 behavior and the bench
+    baseline).  Consumed by models/server.py's lane routing."""
+    raw = os.environ.get("K8S_TPU_SERVE_BATCH_SAMPLING", "").strip().lower()
+    if raw in ("0", "false", "no", "off"):
+        return False
+    return True
+
+
 class QueueFull(RuntimeError):
     """Admission queue at capacity; carries the Retry-After hint."""
 
@@ -101,12 +148,15 @@ class EngineClosed(RuntimeError):
 
 @dataclasses.dataclass
 class _Request:
-    """One queued unit of work: either a batched greedy generation
-    (``ids`` set) or an exclusive-lane callable (``fn`` set)."""
+    """One queued unit of work: either a batched generation (``ids``
+    set; greedy or sampled) or an exclusive-lane callable (``fn``)."""
 
     ids: Optional[np.ndarray] = None
     max_new_tokens: int = 0
     eos_id: Optional[int] = None
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    seed: int = 0
     fn: Optional[Callable[[], Any]] = None
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
@@ -120,19 +170,23 @@ class _Request:
 
 
 class _Slot:
-    """One decode slot: a batch row of the shared cache plus host-side
-    generation state.  ``ready`` flips True once prefill has scattered
-    the row in; only ready slots participate in the batched step."""
+    """One decode slot: generation state plus either a block table over
+    the shared pool (paged mode) or one batch row of the dense cache
+    (windowed fallback).  ``ready`` flips True once prefill landed."""
 
-    __slots__ = ("idx", "req", "pos", "last", "tokens", "ready")
+    __slots__ = ("idx", "req", "pos", "last", "tokens", "ready",
+                 "key", "table", "nblocks")
 
-    def __init__(self, idx: int):
+    def __init__(self, idx: int, maxb: int):
         self.idx = idx
         self.req: Optional[_Request] = None
         self.pos = 0          # absolute position of the NEXT cache write
         self.last = 0         # last emitted token (fed to the next step)
         self.tokens: list[int] = []
         self.ready = False
+        self.key = np.zeros(2, np.uint32)   # per-slot PRNG carry
+        self.table = np.zeros(maxb, np.int32)  # pool block ids (0 = null)
+        self.nblocks = 0
 
     @property
     def free(self) -> bool:
@@ -142,6 +196,8 @@ class _Slot:
         self.req = None
         self.tokens = []
         self.ready = False
+        self.table[:] = 0
+        self.nblocks = 0
 
 
 def _reset_positions(tree):
@@ -159,6 +215,32 @@ def _reset_positions(tree):
     return rec(tree)
 
 
+def _is_cache_node(node) -> bool:
+    # detect by k/v (not pos): the POOL's cache nodes carry no pos leaf —
+    # validity is synthesized from row lengths at view time
+    return isinstance(node, Mapping) and "k" in node and "v" in node \
+        and not isinstance(node["k"], Mapping)
+
+
+def _map_cache(tree, fn):
+    """Rebuild a cache pytree applying ``fn`` to every attention cache
+    node (the dict holding the k/v/pos(/scale) leaves)."""
+    if _is_cache_node(tree):
+        return fn(tree)
+    if isinstance(tree, Mapping):
+        return {k: _map_cache(v, fn) for k, v in tree.items()}
+    return tree
+
+
+def _map_cache2(a, b, fn):
+    """Like :func:`_map_cache` over two structurally-identical trees."""
+    if _is_cache_node(a):
+        return fn(a, b)
+    if isinstance(a, Mapping):
+        return {k: _map_cache2(v, b[k], fn) for k, v in a.items()}
+    return a
+
+
 class Engine:
     """Continuous-batching decode engine over one model + params.
 
@@ -169,6 +251,8 @@ class Engine:
     def __init__(self, config, params, *, slots: Optional[int] = None,
                  queue_limit: Optional[int] = None,
                  buckets: Optional[tuple] = None, pad_id: int = 0,
+                 block_size: Optional[int] = None,
+                 prefix_blocks: Optional[int] = None,
                  metrics: Optional[dict] = None):
         import jax
 
@@ -197,27 +281,84 @@ class Engine:
                 "holds window + prefill_chunk - 1 slots")
         self.metrics = metrics or {}
         self._model = Transformer(config)
-        self._slots = [_Slot(i) for i in range(slots)]
         self._queue: deque[_Request] = deque()
         self._cond = threading.Condition()
         self._closed = False
         self._crashed = False
 
+        # paged block cache (full-cache configs only): a windowed ring
+        # wraps positions per row and cannot share absolute-position
+        # blocks, so it keeps the dense per-slot rows
+        self.paged = config.window_size is None
+        if block_size is None:
+            block_size = max(b for b in self.buckets
+                             if b <= DEFAULT_BLOCK)
+        if block_size not in self.buckets:
+            raise ValueError(
+                f"block_size {block_size} must be one of the prefill "
+                f"buckets {self.buckets} so block boundaries line up "
+                "with chunk boundaries")
+        self.block_size = block_size
+        self._maxb = math.ceil(config.max_seq_len / block_size)
+        if prefix_blocks is None:
+            prefix_blocks = env_prefix_blocks()
+        if prefix_blocks is None:
+            prefix_blocks = 2 * self._maxb  # auto: ~two full-length rows
+        self.prefix_blocks = prefix_blocks if self.paged else 0
+        # pool floor: null block + worst-case fully-private slots, so
+        # decode-time allocation can always succeed by evicting the tree
+        self.pool_blocks = (1 + slots * self._maxb + self.prefix_blocks) \
+            if self.paged else 0
+        self._slots = [_Slot(i, self._maxb) for i in range(slots)]
+
         # jit program inventory — the compile-bound contract: one prefill
         # program per USED bucket size (lazy, tracked in _prefill_fns),
-        # one batched decode step, plus two shape-constant auxiliaries
-        # (row scatter, cache init) that never grow with traffic.
+        # one batched decode step, plus shape-constant auxiliaries
+        # (copy-on-write, block reset, row scatter, cache init) that
+        # never grow with traffic or with distinct prefix lengths.
         self._prefill_fns: dict[int, Callable] = {}
-        self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
-        self._scatter_fn = jax.jit(self._scatter_impl, donate_argnums=(0,))
-        self._decode_compiled = False
-        self._cache = self._init_cache(slots)
+        # (fused width, has-sampling) step programs compiled so far
+        self._step_ks: set[tuple[int, bool]] = set()
         self._row_template = self._init_cache(1)
+        if self.paged:
+            # one jit entry point; the fused iteration count k and the
+            # has-sampling flag are static arguments, so the decode
+            # program set is (widths used) x (greedy-only | sampling) —
+            # an all-greedy batch pays a bare argmax, never the per-row
+            # sort/split/categorical machinery
+            self._step_fn = jax.jit(self._paged_step_impl,
+                                    donate_argnums=(1,),
+                                    static_argnums=(6, 7))
+            self._cow_fn = jax.jit(self._cow_impl, donate_argnums=(0,))
+            self._pool = self._make_pool()
+            self._row_template = None  # only _make_pool needed it; a
+            # dense [1, max_seq_len] row would idle on device forever
+            self._pool_alloc = BlockPool(self.pool_blocks)
+            self._tree = PrefixTree(block_size) \
+                if self.prefix_blocks > 0 else None
+            self._cache = None
+            # device-side table stack, refreshed only when a slot's
+            # table changes (join/retire/growth) — not every step
+            self._tables_dev = None
+            self._tables_dirty = True
+        else:
+            self._step_fn = jax.jit(self._dense_step_impl,
+                                    donate_argnums=(1,),
+                                    static_argnums=(7,))
+            self._scatter_fn = jax.jit(self._scatter_impl,
+                                       donate_argnums=(0,))
+            self._cache = self._init_cache(slots)
+            self._pool = None
+            self._pool_alloc = None
+            self._tree = None
 
         # stats (mutated on the engine thread; read under _cond)
         self._steps = 0
         self._completed = 0
         self._peak_active = 0
+        self._prefix_hits = 0
+        self._prefix_tokens_saved = 0
+        self._cow_copies = 0
         self._occupancy: deque[tuple[int, int]] = deque(maxlen=4096)
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -227,9 +368,12 @@ class Engine:
     # ------------------------------------------------------------------ API
 
     def submit(self, ids, max_new_tokens: int, eos_id: Optional[int] = None,
-               timeout: Optional[float] = None) -> list[int]:
-        """Batched greedy generation; returns emitted tokens (stopping at
-        the first EOS, inclusive).  Raises QueueFull under backpressure."""
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               seed: int = 0, timeout: Optional[float] = None) -> list[int]:
+        """Batched generation (greedy at ``temperature == 0``, otherwise
+        temperature/top-k sampling with the exclusive lane's exact key
+        schedule for ``seed``); returns emitted tokens, stopping at the
+        first EOS inclusive.  Raises QueueFull under backpressure."""
         from k8s_tpu.models.decode import _check_cache_capacity
 
         ids = np.asarray(ids, np.int32).reshape(-1)
@@ -237,19 +381,24 @@ class Engine:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
         # same bound the unbatched jit enforces at trace time, surfaced
         # BEFORE the request occupies queue space (an over-capacity row
         # would wrap slot = pos % S and corrupt its own cache row)
         _check_cache_capacity(self.config, int(ids.size),
                               int(max_new_tokens))
         req = _Request(ids=ids, max_new_tokens=int(max_new_tokens),
-                       eos_id=eos_id)
+                       eos_id=eos_id, temperature=float(temperature),
+                       top_k=top_k, seed=int(seed))
         return self._enqueue_and_wait(req, timeout)
 
     def submit_exclusive(self, fn: Callable[[], Any],
                          timeout: Optional[float] = None):
         """Run ``fn`` single-flight on the engine thread between batch
-        iterations (the sampling / speculative lane); FIFO with batched
+        iterations (the speculative / beam lane); FIFO with batched
         admissions through the same bounded queue."""
         req = _Request(fn=fn)
         return self._enqueue_and_wait(req, timeout)
@@ -308,8 +457,24 @@ class Engine:
                 "peak_active": self._peak_active,
                 "buckets": list(self.buckets),
                 "prefill_programs": sorted(self._prefill_fns),
-                "decode_programs": int(self._decode_compiled),
+                # one batched decode program per (fused width, sampling)
+                # combination actually used; bounded by a static set
+                # (widths {1,2,4} x greedy/sampling), never by traffic
+                # shape
+                "decode_programs": len(self._step_ks),
+                "decode_step_ks": sorted(
+                    [list(t) for t in self._step_ks]),
                 "occupancy_timeline": list(self._occupancy),
+                # paged-cache / prefix-reuse surface
+                "paged": self.paged,
+                "block_size": self.block_size if self.paged else 0,
+                "pool_blocks": self.pool_blocks,
+                "blocks_in_use": self._pool_alloc.used_blocks
+                if self.paged else 0,
+                "tree_nodes": self._tree.nodes if self._tree else 0,
+                "prefix_hits": self._prefix_hits,
+                "prefix_tokens_saved": self._prefix_tokens_saved,
+                "cow_copies": self._cow_copies,
             }
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -317,6 +482,31 @@ class Engine:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout)
+
+    def debug_check_blocks(self) -> None:
+        """Test hook: assert pool refcounts exactly equal the references
+        actually held (slot tables + tree nodes) and that free blocks
+        hold no references.  Call when the engine is quiescent."""
+        if not self.paged:
+            return
+        expect = [0] * self.pool_blocks
+        with self._cond:
+            for s in self._slots:
+                if s.req is not None:
+                    for b in s.table[:s.nblocks]:
+                        expect[int(b)] += 1
+        if self._tree is not None:
+            def walk(node):
+                for child in node.children.values():
+                    expect[child.block] += 1
+                    walk(child)
+            walk(self._tree.root)
+        actual = [self._pool_alloc.refcount(i)
+                  for i in range(self.pool_blocks)]
+        if actual != expect:
+            diffs = [(i, e, a) for i, (e, a)
+                     in enumerate(zip(expect, actual)) if e != a]
+            raise AssertionError(f"block refcount drift: {diffs[:8]}")
 
     # -------------------------------------------------------- jit programs
 
@@ -333,42 +523,235 @@ class Engine:
             mutable=["cache"])
         return _reset_positions(varz["cache"])
 
-    def _step_impl(self, params, cache, toks, poss):
-        """One batched decode step: feed each row's last token at its own
-        position, greedy argmax per row (matching sample_logits'
-        temperature-0 path exactly — raw-dtype argmax, no cast)."""
+    def _make_pool(self):
+        """The block-granular KV pool: every dense-cache K/V(/scale)
+        leaf ``[1, S, ...]`` becomes ``[num_blocks, block_size, ...]``.
+        No pos leaf is pooled: validity is synthesized from each row's
+        written length at view time, so recycled blocks need no reset
+        pass and stale content is unreachable by construction."""
         import jax.numpy as jnp
+
+        N, blk = self.pool_blocks, self.block_size
+
+        def build(node):
+            return {k: jnp.zeros((N, blk) + tuple(v.shape[2:]), v.dtype)
+                    for k, v in node.items() if k != "pos"}
+
+        return _map_cache(self._row_template, build)
+
+    def _view(self, pool, tables, lens):
+        """Gather per-row block tables into a dense decode-cache view:
+        leaf ``[N, blk, ...]`` + tables ``[B, MAXB]`` →
+        ``[B, MAXB * blk, ...]``.  View index p IS absolute position p
+        (block p//blk, offset p%blk), so the model's ``slot = pos % S``
+        addressing is the identity for every in-capacity position.  The
+        pos leaf is synthesized: position p is valid iff ``p < lens[b]``
+        (everything below a row's written length is its own or shared
+        content by the table invariant; everything above — stale
+        recycled-block data, a CoW'd divergence tail, null-block
+        padding — is masked)."""
+        import jax.numpy as jnp
+
+        B = tables.shape[0]
+        S_view = self._maxb * self.block_size
+        idx = jnp.arange(S_view, dtype=jnp.int32)
+        pos_view = jnp.where(idx[None, :] < lens[:, None],
+                             idx[None, :], -1)
+
+        def build(node):
+            out = {k: v[tables].reshape((B, S_view) + v.shape[2:])
+                   for k, v in node.items()}
+            out["pos"] = pos_view
+            return out
+
+        return _map_cache(pool, build)
+
+    def _paged_step_impl(self, params, pool, tables, ints, keys, temps,
+                         k: int, sampling: bool):
+        """``k`` fused batched decode iterations over ONE gathered pool
+        view (``k`` is jit-static, bounded by MAX_STEP_TOKENS): feed
+        each row's last token at its own position, sample/argmax per row
+        from its own distribution (decode.sample_logits_rows — the
+        exclusive lane's exact key schedule, one split per emitted
+        token), carry the updated view through a scan, then scatter all
+        written K/V back to the pool in one pass.  ``ints`` packs
+        [toks, poss, topks] into one [3, B] transfer; a row's position
+        doubles as its written length for the view.  Inactive rows ride
+        at position -1: their writes land at view slot S-1 → their
+        null-block table entry → harmless."""
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_tpu.models.decode import sample_logits_rows
+
+        toks0, poss0, topks = ints[0], ints[1], ints[2]
+        S = self.config.max_seq_len
+        view = self._view(pool, tables, poss0)
+
+        def body(carry, _):
+            cache, toks, poss, kk = carry
+            logits, varz = self._model.apply(
+                {"params": params, "cache": cache}, toks[:, None],
+                positions=poss[:, None], mode="decode",
+                mutable=["cache"])
+            if sampling:
+                new_keys, nxt = sample_logits_rows(logits[:, -1], kk,
+                                                   temps, topks)
+            else:
+                # all-greedy batch: the raw-dtype argmax the exclusive
+                # lane takes at temperature 0; no key ever advances
+                # because no row will ever draw from one
+                new_keys = kk
+                nxt = jnp.argmax(logits[:, -1],
+                                 axis=-1).astype(jnp.int32)
+            act = poss >= 0
+            return (varz["cache"], jnp.where(act, nxt, toks),
+                    jnp.where(act, poss + 1, poss), new_keys), nxt
+
+        (view, _, _, keys_out), toks_all = jax.lax.scan(
+            body, (view, toks0, poss0, keys), None, length=k)
+        # write back the k positions each row wrote (from the scanned
+        # view, which carries them); inactive rows target slot S-1
+        ar = jnp.arange(k)
+        idxs = jnp.where((poss0 >= 0)[:, None],
+                         poss0[:, None] + ar[None, :], S - 1) % S  # [B,k]
+        blk = self.block_size
+        dstb = jnp.take_along_axis(tables, idxs // blk, axis=1)
+        off = idxs % blk
+        rows = jnp.arange(tables.shape[0])[:, None]
+
+        def wb(pool_node, view_node):
+            return {name: v.at[dstb, off].set(view_node[name][rows, idxs])
+                    for name, v in pool_node.items()}
+
+        pool = _map_cache2(pool, view, wb)
+        return pool, toks_all, keys_out  # toks_all [k, B]
+
+    def _cow_impl(self, pool, src, dst):
+        """Copy-on-write at the divergence block: duplicate block ``src``
+        into the private block ``dst``.  Only the shared prefix of the
+        run is ever valid for the attaching row (validity is length-
+        based); the divergent tail is overwritten by its own prefill
+        before the row's length reaches it."""
+        def cw(node):
+            return {k: v.at[dst].set(v[src]) for k, v in node.items()}
+
+        return _map_cache(pool, cw)
+
+    def _dense_step_impl(self, params, cache, toks, poss, keys, temps,
+                         topks, sampling: bool):
+        """One batched decode step over the dense per-slot rows (windowed
+        fallback): same row-wise sampling (or all-greedy argmax fast
+        path) as the paged step."""
+        import jax.numpy as jnp
+
+        from k8s_tpu.models.decode import sample_logits_rows
 
         logits, varz = self._model.apply(
             {"params": params, "cache": cache}, toks[:, None],
             positions=poss[:, None], mode="decode", mutable=["cache"])
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return varz["cache"], nxt
+        if sampling:
+            new_keys, nxt = sample_logits_rows(logits[:, -1], keys,
+                                               temps, topks)
+        else:
+            new_keys = keys
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return varz["cache"], nxt, new_keys
 
     def _scatter_impl(self, cache, row, idx):
         """Replace batch row ``idx`` of every cache leaf with the freshly
-        prefilled batch-1 row (slot join)."""
+        prefilled batch-1 row (dense-mode slot join)."""
         import jax
 
         return jax.tree_util.tree_map(
             lambda full, r: full.at[idx].set(r[0]), cache, row)
 
     def _prefill_fn(self, chunk_len: int) -> Callable:
+        """Per-bucket prefill program.  Paged mode: one chunked
+        decode-mode call over the request's gathered row view with the
+        written range scattered back to its pool blocks.  Dense mode:
+        the batch-1 row-cache call (scattered into the slot later)."""
         fn = self._prefill_fns.get(chunk_len)
         if fn is None:
             import jax
+            import jax.numpy as jnp
 
-            def run(params, cache, chunk, positions):
-                logits, varz = self._model.apply(
-                    {"params": params, "cache": cache}, chunk,
-                    positions=positions, mode="decode", mutable=["cache"])
-                return varz["cache"], logits[:, -1]
+            if self.paged:
+                def run(params, pool, table, chunk, positions):
+                    # written length BEFORE this chunk = its first
+                    # position (chunks land in order)
+                    view = self._view(pool, table[None, :],
+                                      positions[:, 0])
+                    logits, varz = self._model.apply(
+                        {"params": params, "cache": view}, chunk,
+                        positions=positions, mode="decode",
+                        mutable=["cache"])
+                    idxs = positions[0] % self.config.max_seq_len
+                    blk = self.block_size
+                    dstb = table[idxs // blk]
+                    off = idxs % blk
 
-            fn = jax.jit(run)
+                    def wb(pool_node, view_node):
+                        return {k: v.at[dstb, off].set(view_node[k][0,
+                                                                    idxs])
+                                for k, v in pool_node.items()}
+
+                    pool = _map_cache2(pool, varz["cache"], wb)
+                    return pool, logits[:, -1]
+
+                fn = jax.jit(run, donate_argnums=(1,))
+            else:
+                def run(params, cache, chunk, positions):
+                    logits, varz = self._model.apply(
+                        {"params": params, "cache": cache}, chunk,
+                        positions=positions, mode="decode",
+                        mutable=["cache"])
+                    return varz["cache"], logits[:, -1]
+
+                fn = jax.jit(run)
             # copy-on-write rebind: stats() iterates this dict from probe
             # threads without the engine lock, so never mutate in place
             self._prefill_fns = {**self._prefill_fns, chunk_len: fn}
         return fn
+
+    # ---------------------------------------------------- block machinery
+
+    def _alloc_block(self) -> int:
+        """Pop a free pool block, evicting least-recently-hit prefix-tree
+        leaves as needed; with the pool floor of 1 + slots x blocks_per_
+        row this cannot fail while slot tables are within capacity.
+        Recycled blocks need no scrubbing: stale content sits above the
+        new owner's written length and is masked by the synthesized
+        validity."""
+        idx = self._pool_alloc.alloc()
+        while idx is None:
+            # only leaves whose block nothing else pins: evicting a
+            # slot-referenced block frees nothing and throws away a hot
+            # cache entry for no progress
+            victim = self._tree.evict_one(
+                pinned=lambda b: self._pool_alloc.refcount(b) > 1) \
+                if self._tree else None
+            if victim is None:
+                raise RuntimeError(
+                    "KV block pool exhausted (no evictable prefix "
+                    "blocks) — pool sizing invariant violated")
+            released = self._pool_alloc.release(victim)
+            assert released, "unpinned tree leaf must free its block"
+            idx = self._pool_alloc.alloc()
+        return idx
+
+    def _release_table(self, slot: _Slot) -> None:
+        for b in slot.table[:slot.nblocks]:
+            self._pool_alloc.release(int(b))
+        slot.table[:] = 0
+        slot.nblocks = 0
+        self._tables_dirty = True
+        self._update_block_gauge()
+
+    def _update_block_gauge(self) -> None:
+        gauge = self.metrics.get("blocks_in_use")
+        if gauge is not None and self._pool_alloc is not None:
+            gauge.set(self._pool_alloc.used_blocks)
 
     # -------------------------------------------------------- engine loop
 
@@ -436,34 +819,126 @@ class Engine:
         with self._cond:
             self._completed += 1
 
+    def _first_token(self, req: _Request, last_logits) -> tuple:
+        """Sample/argmax the first token from the prefill's last-position
+        logits with the exclusive lane's exact key schedule: split the
+        seed key once, draw with the sub key, carry the parent."""
+        import jax
+
+        from k8s_tpu.models.decode import sample_logits
+
+        key = jax.random.PRNGKey(req.seed)
+        ks = jax.random.split(key)
+        first = int(np.asarray(sample_logits(
+            last_logits, ks[1], req.temperature, req.top_k))[0])
+        return first, np.asarray(ks[0])
+
+    def _attach_prefix(self, slot: _Slot, ids) -> int:
+        """Walk the prefix tree and attach shared blocks by reference;
+        copy-on-write the divergence block when the match ends mid-run.
+        Returns the number of prompt tokens whose prefill is skipped
+        (always <= len(ids) - 1: the last prompt token is recomputed for
+        its logits)."""
+        import jax.numpy as jnp
+
+        if self._tree is None:
+            return 0
+        full, partial = self._tree.match(ids, len(ids) - 1)
+        shared = 0
+        for node in full:
+            self._pool_alloc.retain(node.block)
+            slot.table[slot.nblocks] = node.block
+            slot.nblocks += 1
+            shared += self.block_size
+        if partial is not None:
+            node, j = partial
+            dst = self._alloc_block()
+            self._pool = self._cow_fn(
+                self._pool, jnp.asarray(node.block, jnp.int32),
+                jnp.asarray(dst, jnp.int32))
+            slot.table[slot.nblocks] = dst
+            slot.nblocks += 1
+            shared += j
+            self._cow_copies += 1
+        if shared > 0:
+            self._prefix_hits += 1
+            self._prefix_tokens_saved += shared
+            hits = self.metrics.get("prefix_hits")
+            if hits is not None:
+                hits.inc()
+            saved = self.metrics.get("prefill_saved")
+            if saved is not None:
+                saved.inc(shared)
+        return shared
+
     def _prefill_into(self, slot: _Slot, req: _Request) -> None:
-        """Chunked prefill of one prompt (batch-1, bucket-sized chunks at
-        exact positions), then scatter the row into the slot and emit the
-        first token.  A first-token EOS or max_new_tokens == 1 retires the
-        request without ever occupying a step."""
+        """Prefill one prompt into the slot (tail-only when a prefix was
+        attached), then emit the first token.  A first-token EOS or
+        max_new_tokens == 1 retires the request without ever occupying a
+        step."""
         import jax.numpy as jnp
 
         from k8s_tpu import trace
 
+        ids = req.ids
         try:
-            ids = req.ids
-            chunks = split_prefill(len(ids), self.buckets)
-            with trace.span("prefill", prompt_len=len(ids),
-                            chunks=len(chunks)):
-                cache = self._row_template
-                off = 0
-                last = None
-                for c in chunks:
-                    chunk = jnp.asarray(ids[off:off + c], jnp.int32)[None, :]
-                    positions = (off + jnp.arange(c, dtype=jnp.int32))[None, :]
-                    cache, last = self._prefill_fn(c)(
-                        self.params, cache, chunk, positions)
-                    off += c
-                first = int(np.asarray(
-                    jnp.argmax(last, axis=-1).astype(jnp.int32))[0])
+            if self.paged:
+                shared = self._attach_prefix(slot, ids)
+                # blocks covering the unshared prompt tail (the CoW
+                # block, if any, already covers its own span)
+                needed = math.ceil(len(ids) / self.block_size)
+                while slot.nblocks < needed:
+                    slot.table[slot.nblocks] = self._alloc_block()
+                    slot.nblocks += 1
+                self._tables_dirty = True
+                self._update_block_gauge()
+                chunks = split_prefill(len(ids) - shared, self.buckets)
+                with trace.span("prefill", prompt_len=len(ids),
+                                chunks=len(chunks), shared=shared):
+                    table_dev = jnp.asarray(slot.table)
+                    off = shared
+                    last = None
+                    for c in chunks:
+                        chunk = jnp.asarray(ids[off:off + c],
+                                            jnp.int32)[None, :]
+                        positions = (off + jnp.arange(
+                            c, dtype=jnp.int32))[None, :]
+                        self._pool, last = self._prefill_fn(c)(
+                            self.params, self._pool, table_dev, chunk,
+                            positions)
+                        off += c
+                    first, slot.key = self._first_token(req, last)
+                if self._tree is not None:
+                    # re-match NOW: block allocations above may have
+                    # evicted part of the originally-matched path, and
+                    # inserting under a detached node would leak
+                    # unreachable (unevictable) references
+                    created = self._tree.insert(
+                        self._tree.match(ids, len(ids) - 1)[0], ids,
+                        [int(b) for b in slot.table[:slot.nblocks]])
+                    for node in created:
+                        self._pool_alloc.retain(node.block)
+            else:
+                chunks = split_prefill(len(ids), self.buckets)
+                with trace.span("prefill", prompt_len=len(ids),
+                                chunks=len(chunks)):
+                    cache = self._row_template
+                    off = 0
+                    last = None
+                    for c in chunks:
+                        chunk = jnp.asarray(ids[off:off + c],
+                                            jnp.int32)[None, :]
+                        positions = (off + jnp.arange(
+                            c, dtype=jnp.int32))[None, :]
+                        cache, last = self._prefill_fn(c)(
+                            self.params, cache, chunk, positions)
+                        off += c
+                    first, slot.key = self._first_token(req, last)
         except BaseException as e:  # noqa: BLE001 - bad request must not kill the loop
             req.finish(error=e)
             with self._cond:
+                if self.paged:
+                    self._release_table(slot)
                 slot.clear()
             return
         tokens = [first]
@@ -471,8 +946,9 @@ class Engine:
                 or req.max_new_tokens <= 1:
             self._retire(slot, req, tokens)
             return
-        self._cache = self._scatter_fn(self._cache, cache,
-                                       jnp.asarray(slot.idx, jnp.int32))
+        if not self.paged:
+            self._cache = self._scatter_fn(self._cache, cache,
+                                           jnp.asarray(slot.idx, jnp.int32))
         slot.tokens = tokens
         slot.last = first
         slot.pos = len(ids)
@@ -486,45 +962,118 @@ class Engine:
         tok_counter = self.metrics.get("tokens")
         if tok_counter is not None:
             tok_counter.inc(len(tokens))
+        if req.temperature > 0:
+            sampled = self.metrics.get("sampled_batched")
+            if sampled is not None:
+                sampled.inc()
         req.finish(result=tokens)
         with self._cond:
             self._completed += 1
+            if self.paged:
+                self._release_table(slot)
             slot.clear()
 
     def _decode_step_all(self) -> None:
-        """One batched step over every ready slot.  Free rows ride along
-        with (token 0, position 0); their stray cache writes land in rows
-        the next prefill scatter fully replaces, and row independence of
-        the batched math keeps active rows exact."""
+        """One batched step over every ready slot.  Inactive rows ride
+        along at position -1: the model's write slot wraps to S-1 with a
+        stored pos of -1, so (paged) their stray write lands in their
+        table's null block, never valid, or (dense) in a row the next
+        prefill scatter fully replaces.  Row independence of the batched
+        math keeps active rows exact."""
         import jax.numpy as jnp
 
         from k8s_tpu import trace
 
         B = len(self._slots)
-        toks = np.full((B,), self.pad_id, np.int32)
-        poss = np.zeros((B,), np.int32)
         active = [s for s in self._slots if s.ready]
+        k = 1
+        if self.paged and active:
+            # fuse up to MAX_STEP_TOKENS iterations into one program
+            # call when no active row can retire mid-scan: no EOS
+            # condition anywhere, and k capped at the smallest remaining
+            # count.  k is quantized to powers of two so the fused-width
+            # program set stays tiny and predictable ({1, 2, 4}; a
+            # single solo request's tail walks through all of them, so
+            # they warm early instead of compiling lazily mid-traffic
+            # and stalling the whole batch).  A join or exclusive
+            # request arriving mid-scan waits at most k-1 iterations —
+            # a few ms.
+            if all(s.req.eos_id is None for s in active):
+                k = min(MAX_STEP_TOKENS,
+                        min(s.req.max_new_tokens - len(s.tokens)
+                            for s in active))
+                while k & (k - 1):  # round down to a power of two
+                    k &= k - 1
+            # grow tables so every write of the fused window lands in an
+            # owned block
+            grew = False
+            for s in active:
+                need_bi = (s.pos + k - 1) // self.block_size
+                while s.nblocks <= need_bi:
+                    s.table[s.nblocks] = self._alloc_block()
+                    s.nblocks += 1
+                    grew = True
+            if grew:
+                self._tables_dirty = True
+                self._update_block_gauge()
+        ints = np.zeros((3, B), np.int32)  # [toks, poss, topks]
+        ints[0] = self.pad_id
+        ints[1] = -1
+        keys = np.zeros((B, 2), np.uint32)
+        temps = np.zeros((B,), np.float32)
         for s in active:
-            toks[s.idx] = s.last
-            poss[s.idx] = s.pos
-        with trace.span("decode_step", active=len(active)):
-            self._cache, nxt = self._step_fn(
-                self.params, self._cache, jnp.asarray(toks),
-                jnp.asarray(poss))
-            nxt_host = np.asarray(nxt)
-        self._decode_compiled = True
+            ints[0, s.idx] = s.last
+            ints[1, s.idx] = s.pos
+            ints[2, s.idx] = s.req.top_k or 0
+            keys[s.idx] = s.key
+            temps[s.idx] = s.req.temperature
+        # jit-static: a batch with no sampled row compiles/uses the
+        # argmax-only program (no per-row sort/split/categorical tax on
+        # pure-greedy traffic)
+        sampling = any(s.req.temperature > 0 for s in active)
+        with trace.span("decode_step", active=len(active), fused=k):
+            if self.paged:
+                if self._tables_dirty:
+                    self._tables_dev = jnp.asarray(
+                        np.stack([s.table for s in self._slots]))
+                    self._tables_dirty = False
+                self._pool, toks_all, new_keys = self._step_fn(
+                    self.params, self._pool, self._tables_dev,
+                    jnp.asarray(ints), jnp.asarray(keys),
+                    jnp.asarray(temps), k, sampling)
+                toks_host = np.asarray(toks_all)  # [k, B]
+            else:
+                self._cache, nxt, new_keys = self._step_fn(
+                    self.params, self._cache, jnp.asarray(ints[0]),
+                    jnp.asarray(ints[1]), jnp.asarray(keys),
+                    jnp.asarray(temps), jnp.asarray(ints[2]), sampling)
+                toks_host = np.asarray(nxt)[None, :]  # [1, B]
+            keys_host = np.asarray(new_keys)
+        # copy-on-write rebind like _prefill_fns: stats() reads this set
+        # from probe threads without the engine lock
+        self._step_ks = self._step_ks | {
+            (k if self.paged else 1, sampling)}
         occ = self.metrics.get("occupancy")
         if occ is not None:
             occ.set(len(active))
         with self._cond:
-            self._steps += 1
-            self._occupancy.append((self._steps, len(active)))
+            for i in range(k):
+                self._steps += 1
+                self._occupancy.append((self._steps, len(active)))
         for s in active:
-            tok = int(nxt_host[s.idx])
-            s.tokens.append(tok)
-            s.pos += 1
-            s.last = tok
             req = s.req
-            hit_eos = req.eos_id is not None and tok == req.eos_id
-            if hit_eos or len(s.tokens) >= req.max_new_tokens:
-                self._retire(s, req, s.tokens)
+            for i in range(k):
+                tok = int(toks_host[i, s.idx])
+                s.tokens.append(tok)
+                s.pos += 1
+                s.last = tok
+                hit_eos = req.eos_id is not None and tok == req.eos_id
+                if hit_eos or len(s.tokens) >= req.max_new_tokens:
+                    assert i == k - 1, "mid-scan retirement is excluded" \
+                        " by the fused-step gate"
+                    self._retire(s, req, s.tokens)
+                    break
+            else:
+                s.key = keys_host[s.idx]
+                continue
+            # retired: key update irrelevant (slot cleared)

@@ -30,14 +30,21 @@ Endpoints (JSON over HTTP/1.1, stdlib-only like the rest of the repo):
   ``Retry-After`` header.
 
 Device work goes through the continuous-batching engine
-(k8s_tpu.models.engine): greedy requests share one batched decode step
-over K8S_TPU_SERVE_SLOTS slots with iteration-level join/retire, so a
-long generation no longer serializes short ones; sampling and
-speculative requests run single-flight on the engine's exclusive lane
-(their legacy behavior).  ``--slots 0`` disables the engine entirely and
-restores the original one-lock single-flight path (the bench_serve
-baseline).  Prompt-length compiles are bounded by the engine's bucket
-set instead of unbounded per-prompt-length.
+(k8s_tpu.models.engine): greedy AND sampled (``temperature > 0``,
+optional ``top_k``) requests share one batched decode step over
+K8S_TPU_SERVE_SLOTS slots with iteration-level join/retire and per-slot
+RNG keys, so a long generation no longer serializes short ones and the
+production sampling mix gets the batching speedup too — fixed-seed
+sampled output is token-identical across lanes.  The engine's paged KV
+cache reuses shared prompt prefixes across requests (radix tree,
+refcounted blocks, copy-on-write at the divergence block;
+K8S_TPU_SERVE_PREFIX_BLOCKS sizes the retained pool, 0 disables reuse).
+``K8S_TPU_SERVE_BATCH_SAMPLING=0`` (or ``--batch-sampling 0``) restores
+the exclusive-lane routing for sampled requests; speculative requests
+always run single-flight on the exclusive lane.  ``--slots 0`` disables
+the engine entirely and restores the original one-lock single-flight
+path (the bench_serve baseline).  Prompt-length compiles are bounded by
+the engine's bucket set instead of unbounded per-prompt-length.
 """
 
 from __future__ import annotations
@@ -79,9 +86,14 @@ class ParsedRequest:
 
     @property
     def batched(self) -> bool:
-        """Greedy non-speculative requests ride the shared batch step;
-        everything else takes the exclusive lane."""
-        return self.temperature == 0.0 and self.speculative == 0
+        """Eligible for the shared batch step: greedy and sampled
+        requests both ride the slot lanes (per-slot RNG keys); only
+        speculative requests are confined to the exclusive lane (their
+        multi-token verify step needs write-masked variable-width
+        chunks the batched step does not express).  The server's
+        ``batch_sampling`` toggle can still route sampled requests
+        exclusively."""
+        return self.speculative == 0
 
 
 def parse_request(config, req: dict, default_max_new_tokens: int
@@ -172,7 +184,9 @@ class LmServer:
                  kv_cache: str = "model", param_dtype: str = "model",
                  default_max_new_tokens: int = 64, *,
                  config=None, params=None, slots: Optional[int] = None,
-                 queue_limit: Optional[int] = None, registry=None):
+                 queue_limit: Optional[int] = None,
+                 prefix_blocks: Optional[int] = None,
+                 batch_sampling: Optional[bool] = None, registry=None):
         from k8s_tpu.models import engine as engine_lib
         from k8s_tpu.util import metrics as metrics_mod
 
@@ -197,10 +211,13 @@ class LmServer:
         self.metrics["queue_depth"]._fn = self.queue_depth
         if slots is None:
             slots = engine_lib.env_slots()
+        if batch_sampling is None:
+            batch_sampling = engine_lib.env_batch_sampling()
+        self.batch_sampling = bool(batch_sampling)
         if slots > 0:
             self.engine: Optional[engine_lib.Engine] = engine_lib.Engine(
                 config, params, slots=slots, queue_limit=queue_limit,
-                metrics=self.metrics)
+                prefix_blocks=prefix_blocks, metrics=self.metrics)
         else:
             # legacy single-flight path: one lock around all device work
             # (kept as the bench_serve baseline and an escape hatch)
@@ -230,7 +247,13 @@ class LmServer:
         s = self.engine.stats()
         return {"engine": "continuous-batching", "slots": s["slots"],
                 "active": s["active"], "queue_depth": s["queue_depth"],
-                "queue_limit": s["queue_limit"]}
+                "queue_limit": s["queue_limit"],
+                "batch_sampling": self.batch_sampling,
+                "paged": s["paged"], "block_size": s["block_size"],
+                "pool_blocks": s["pool_blocks"],
+                "blocks_in_use": s["blocks_in_use"],
+                "prefix_hits": s["prefix_hits"],
+                "prefix_tokens_saved": s["prefix_tokens_saved"]}
 
     def generate(self, parsed: ParsedRequest) -> dict:
         """One validated generation request (parse_request ran on the
@@ -240,9 +263,14 @@ class LmServer:
         from k8s_tpu.models.dataset import decode_bytes
         from k8s_tpu.models.serving import strip_after_eos
 
-        if self.engine is not None and parsed.batched:
+        use_batched = parsed.batched and (
+            parsed.temperature == 0.0 or self.batch_sampling)
+        if self.engine is not None and use_batched:
             toks = self.engine.submit(parsed.ids, parsed.max_new_tokens,
-                                      eos_id=parsed.eos)
+                                      eos_id=parsed.eos,
+                                      temperature=parsed.temperature,
+                                      top_k=parsed.top_k,
+                                      seed=parsed.seed)
         elif self.engine is not None:
             toks = self.engine.submit_exclusive(
                 lambda: self._generate_exclusive(parsed))
@@ -434,12 +462,25 @@ def main(argv=None) -> int:
     p.add_argument("--queue", type=int, default=None,
                    help="admission queue bound before 503 shedding "
                    "(default K8S_TPU_SERVE_QUEUE or 64)")
+    p.add_argument("--prefix-blocks", type=int, default=None,
+                   help="KV pool blocks retained for shared-prefix reuse "
+                   "beyond the per-slot floor (default "
+                   "K8S_TPU_SERVE_PREFIX_BLOCKS or auto; 0 disables "
+                   "prefix reuse)")
+    p.add_argument("--batch-sampling", type=int, choices=(0, 1),
+                   default=None,
+                   help="route temperature>0 requests onto the batched "
+                   "slot lanes (default K8S_TPU_SERVE_BATCH_SAMPLING or "
+                   "1; 0 = exclusive-lane sampling, the legacy routing)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     lm = LmServer(args.train_dir, kv_cache=args.kv_cache,
                   param_dtype=args.param_dtype,
                   default_max_new_tokens=args.max_new_tokens,
-                  slots=args.slots, queue_limit=args.queue)
+                  slots=args.slots, queue_limit=args.queue,
+                  prefix_blocks=args.prefix_blocks,
+                  batch_sampling=None if args.batch_sampling is None
+                  else bool(args.batch_sampling))
     httpd = serve(lm, args.host, args.port)
     host, port = httpd.server_address[:2]
     log.info("serving %s on http://%s:%d (POST /v1/generate)",

@@ -395,4 +395,25 @@ def serving_metrics(registry: Optional[Registry] = None,
             "End-to-end /v1/generate latency (parse to response body), "
             "successful requests.",
         ),
+        # -- paged KV cache / shared-prefix reuse (ISSUE 6) ----------------
+        "prefix_hits": r.counter(
+            "serve_prefix_hits_total",
+            "Requests that attached to at least one shared-prefix KV "
+            "block instead of prefilling it (radix prefix tree).",
+        ),
+        "prefill_saved": r.counter(
+            "serve_prefill_tokens_saved_total",
+            "Prompt tokens whose prefill was skipped by shared-prefix "
+            "KV reuse (attached by reference or copy-on-write).",
+        ),
+        "sampled_batched": r.counter(
+            "serve_sampled_batched_total",
+            "temperature>0 generations served on the batched slot lanes "
+            "(row-wise sampling) instead of the exclusive lane.",
+        ),
+        "blocks_in_use": r.gauge(
+            "serve_kv_blocks_in_use",
+            "Live KV-cache pool blocks (slot tables + prefix tree), "
+            "sampled after each allocation/release.",
+        ),
     }

@@ -22,6 +22,7 @@ from k8s_tpu.models.decode import prefill_buckets_for, split_prefill
 from k8s_tpu.models.engine import (
     DEFAULT_QUEUE,
     DEFAULT_SLOTS,
+    MAX_STEP_TOKENS,
     Engine,
     EngineClosed,
     QueueFull,
@@ -44,12 +45,16 @@ def init_params(cfg, seed=0):
                       jnp.zeros((1, 5), jnp.int32))["params"]
 
 
-def unbatched(cfg, params, prompt, max_new, eos_id=None):
+def unbatched(cfg, params, prompt, max_new, eos_id=None,
+              temperature=0.0, top_k=None, seed=0):
     """The single-request oracle: decode_lib.generate truncated the way
-    the engine reports (stop at the first EOS, inclusive)."""
+    the engine reports (stop at the first EOS, inclusive).  This is THE
+    exclusive lane's program, so matching it with temperature>0 is the
+    round-6 batched-sampling exactness claim."""
     row = np.asarray(decode_lib.generate(
         cfg, params, np.asarray(prompt, np.int32)[None], max_new,
-        eos_id=eos_id))[0]
+        rng=jax.random.PRNGKey(seed), temperature=temperature,
+        top_k=top_k, eos_id=eos_id))[0]
     out = []
     for t in row:
         out.append(int(t))
@@ -209,7 +214,291 @@ class TestCompileBound:
             stats = eng.stats()
             assert len(stats["prefill_programs"]) <= len(stats["buckets"])
             assert set(stats["prefill_programs"]) <= set(stats["buckets"])
-            assert stats["decode_programs"] == 1
+            # decode programs: one per fused-iteration width actually
+            # used — a static set bounded by MAX_STEP_TOKENS, never by
+            # prompt/prefix shape
+            assert 1 <= stats["decode_programs"] <= 2 * MAX_STEP_TOKENS
+        finally:
+            eng.shutdown()
+
+    def test_prefix_reuse_compiles_no_per_prefix_programs(self, model):
+        """With prefix reuse ON, serving many distinct prefix-share
+        lengths (full hits, partial CoW hits, misses, sampled and
+        greedy) still compiles only bucket prefill programs + ONE decode
+        program — no per-prefix-length or per-tail-length blowup."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=32, block_size=8,
+                     prefix_blocks=24)
+        try:
+            base = prompt_of(24, seed=7)
+            eng.submit(base, 4)  # seeds the tree
+            for i, cut in enumerate((24, 20, 17, 9, 5)):
+                tail = [(i * 11 + t) % 61 for t in range(3 + i)]
+                p = np.asarray(list(base[:cut]) + tail, np.int32)
+                temp = 0.0 if i % 2 == 0 else 0.8
+                eng.submit(p, 4, temperature=temp, seed=i)
+            stats = eng.stats()
+            assert stats["prefix_hits"] >= 4
+            assert len(stats["prefill_programs"]) <= len(stats["buckets"])
+            assert set(stats["prefill_programs"]) <= set(stats["buckets"])
+            assert 1 <= stats["decode_programs"] <= 2 * MAX_STEP_TOKENS
+        finally:
+            eng.shutdown()
+
+
+class TestBatchedSampling:
+    """temperature>0 / top-k rides the slot lanes; per-slot RNG keys
+    follow the exclusive lane's exact split schedule, so fixed-seed
+    output is token-identical to decode_lib.generate."""
+
+    @pytest.mark.parametrize("temp,top_k,seed", [
+        (1.0, None, 5), (0.7, 5, 11), (1.3, 3, 42), (0.9, None, 0),
+    ])
+    def test_sampled_token_identical_to_exclusive(self, model, engine,
+                                                  temp, top_k, seed):
+        cfg, params = model
+        p = prompt_of(9, seed=seed)
+        got = engine.submit(p, 8, temperature=temp, top_k=top_k,
+                            seed=seed)
+        assert got == unbatched(cfg, params, p, 8, temperature=temp,
+                                top_k=top_k, seed=seed)
+
+    def test_concurrent_mixed_greedy_and_sampled(self, model, engine):
+        """Greedy and sampled rows share one batched step; each row's
+        distribution and key schedule stay independent."""
+        cfg, params = model
+        cases = [
+            (prompt_of(7, 1), 8, 0.0, None, 0),
+            (prompt_of(13, 2), 6, 0.7, 5, 11),
+            (prompt_of(5, 3), 10, 1.3, None, 42),
+            (prompt_of(21, 4), 8, 1.0, 7, 7),
+        ]
+        results = {}
+
+        def run(i, p, n, t, k, s):
+            results[i] = engine.submit(p, n, temperature=t, top_k=k,
+                                       seed=s)
+
+        threads = [threading.Thread(target=run, args=(i, *c))
+                   for i, c in enumerate(cases)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, (p, n, t, k, s) in enumerate(cases):
+            assert results[i] == unbatched(
+                cfg, params, p, n, temperature=t, top_k=k, seed=s), \
+                f"case {i} diverged from the exclusive-lane program"
+
+    def test_seed_determinism_and_divergence(self, model, engine):
+        p = prompt_of(6, seed=8)
+        a = engine.submit(p, 8, temperature=1.0, seed=11)
+        b = engine.submit(p, 8, temperature=1.0, seed=11)
+        c = engine.submit(p, 8, temperature=1.0, seed=12)
+        assert a == b
+        assert c != a
+
+    def test_sampled_eos_truncates_like_exclusive(self, model, engine):
+        cfg, params = model
+        p = prompt_of(6, seed=13)
+        full = unbatched(cfg, params, p, 8, temperature=0.8, seed=2)
+        eos = full[2]
+        assert engine.submit(p, 8, eos_id=eos, temperature=0.8, seed=2) \
+            == unbatched(cfg, params, p, 8, eos_id=eos, temperature=0.8,
+                         seed=2)
+
+    def test_bad_sampling_args_rejected(self, model, engine):
+        with pytest.raises(ValueError, match="temperature"):
+            engine.submit(prompt_of(3), 2, temperature=-0.5)
+        with pytest.raises(ValueError, match="top_k"):
+            engine.submit(prompt_of(3), 2, temperature=1.0, top_k=0)
+
+
+class TestPrefixReuse:
+    """The paged KV cache's radix tree: shared prefixes attach by
+    reference, the divergence block copy-on-writes, and none of it may
+    change a single emitted token."""
+
+    @pytest.fixture()
+    def paged_engine(self, model):
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=32, block_size=8,
+                     prefix_blocks=24)
+        yield eng
+        eng.shutdown()
+
+    def test_repeat_prompt_attaches_full_blocks(self, model, paged_engine):
+        cfg, params = model
+        eng = paged_engine
+        p = prompt_of(20, seed=9)  # 2 full 8-token blocks + 4-token tail
+        a = eng.submit(p, 6)
+        assert eng.stats()["prefix_hits"] == 0  # cold tree: a miss
+        b = eng.submit(p, 6)
+        st = eng.stats()
+        assert a == b == unbatched(cfg, params, p, 6)
+        assert st["prefix_hits"] == 1
+        assert st["prefix_tokens_saved"] == 16  # both full blocks
+        assert st["tree_nodes"] >= 2
+
+    def test_divergent_tail_copy_on_write(self, model, paged_engine):
+        """Two prompts sharing 12 of their first 16 tokens: the second
+        attaches block 0 by reference, CoWs the divergence block for its
+        first 4 shared tokens, and prefills only its own tail — output
+        identical to the unbatched oracle for BOTH."""
+        cfg, params = model
+        eng = paged_engine
+        common = [int(x) for x in prompt_of(12, seed=5)]
+        p1 = np.asarray(common + [1, 2, 3, 4, 5], np.int32)
+        p2 = np.asarray(common + [9, 8, 7], np.int32)
+        r1 = eng.submit(p1, 6)
+        cow_before = eng.stats()["cow_copies"]
+        r2 = eng.submit(p2, 6)
+        st = eng.stats()
+        assert r1 == unbatched(cfg, params, p1, 6)
+        assert r2 == unbatched(cfg, params, p2, 6)
+        assert st["cow_copies"] == cow_before + 1
+        assert st["prefix_hits"] >= 1
+        # CoW must not corrupt the donor: the original prompt still
+        # generates identically (its tree blocks were never written)
+        assert eng.submit(p1, 6) == r1
+
+    def test_sampled_request_reuses_prefix(self, model, paged_engine):
+        cfg, params = model
+        eng = paged_engine
+        p = prompt_of(20, seed=3)
+        eng.submit(p, 4)  # seed the tree
+        got = eng.submit(p, 8, temperature=0.8, seed=17)
+        assert got == unbatched(cfg, params, p, 8, temperature=0.8,
+                                seed=17)
+        assert eng.stats()["prefix_hits"] == 1
+
+    def test_last_prompt_token_never_shared(self, model, paged_engine):
+        """A block-aligned fully-cached prompt still prefills >= 1 token
+        (the engine needs the last position's logits); savings cap at
+        len(prompt) - 1."""
+        cfg, params = model
+        eng = paged_engine
+        p = prompt_of(16, seed=21)  # exactly 2 blocks
+        a = eng.submit(p, 4)
+        b = eng.submit(p, 4)
+        st = eng.stats()
+        assert a == b == unbatched(cfg, params, p, 4)
+        # block 1 would cover tokens 8..15 = includes the last token, so
+        # only block 0 (8 tokens) plus a 7-token CoW share is reusable
+        assert st["prefix_tokens_saved"] <= 15
+
+
+class TestBlockRefcounts:
+    """Retiring a request must never free a block another slot (or the
+    tree) still references; pool refcounts must exactly match held
+    references after any churn."""
+
+    def test_retire_keeps_shared_blocks_alive(self, model):
+        """A short request sharing a long request's prefix retires first
+        and releases its references; the long request keeps decoding
+        correctly (its blocks were refcounted, not freed) — under a pool
+        sized so tightly that a premature free WOULD be recycled and
+        corrupt the survivor."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=16, block_size=8,
+                     prefix_blocks=2)
+        try:
+            p_long = prompt_of(20, seed=6)
+            eng.submit(p_long, 2)  # seed the tree with the prefix
+            out = {}
+
+            def run_long():
+                out["long"] = eng.submit(p_long, 24)
+
+            t = threading.Thread(target=run_long)
+            t.start()
+            deadline = time.time() + 30
+            while eng.stats()["steps"] < 2 and time.time() < deadline:
+                time.sleep(0.002)
+            # churn: short prefix-sharing requests join and retire while
+            # the long one is mid-decode
+            for i in range(4):
+                out[i] = eng.submit(p_long, 2)
+            t.join(60)
+            expect = unbatched(cfg, params, p_long, 24)
+            assert out["long"] == expect
+            for i in range(4):
+                assert out[i] == expect[:2]
+            eng.debug_check_blocks()
+        finally:
+            eng.shutdown()
+
+    def test_churned_join_retire_schedule_refcounts_exact(self, model):
+        """A storm of overlapping prefix-sharing and disjoint requests
+        (greedy + sampled, joins and retires interleaved) leaves the
+        pool with refcounts exactly equal to held references and zero
+        slot-held blocks."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=3, queue_limit=64, block_size=8,
+                     prefix_blocks=8)
+        try:
+            base = [int(x) for x in prompt_of(16, seed=30)]
+            results = {}
+
+            def run(i):
+                if i % 3 == 0:
+                    p = np.asarray(base + [i % 61], np.int32)
+                elif i % 3 == 1:
+                    p = np.asarray(base[:9] + [(i * 7) % 61, i % 61],
+                                   np.int32)
+                else:
+                    p = prompt_of(5 + i % 7, seed=100 + i)
+                temp = 0.0 if i % 2 == 0 else 0.9
+                results[i] = (p, temp,
+                              eng.submit(p, 3 + i % 5, temperature=temp,
+                                         seed=i))
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(18)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            st = eng.stats()
+            assert st["completed"] >= 18
+            assert st["active"] == 0
+            eng.debug_check_blocks()  # refcounts == held references
+            for i, (p, temp, got) in results.items():
+                assert got == unbatched(cfg, params, p, 3 + i % 5,
+                                        temperature=temp, seed=i), \
+                    f"request {i} corrupted under churn"
+        finally:
+            eng.shutdown()
+
+    def test_tree_eviction_under_tiny_pool(self, model):
+        """With minimal tree headroom, allocation evicts least-recently-
+        hit leaves instead of failing, and blocks a live slot references
+        survive eviction (only the tree's reference drops)."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=32, block_size=8,
+                     prefix_blocks=1)
+        try:
+            outs = []
+            for i in range(6):  # distinct prompts churn the 1-block tree
+                p = prompt_of(18, seed=50 + i)
+                outs.append((p, eng.submit(p, 4)))
+            for p, got in outs:
+                assert got == unbatched(cfg, params, p, 4)
+            st = eng.stats()
+            assert st["tree_nodes"] <= 1 + st["pool_blocks"]
+            eng.debug_check_blocks()
+        finally:
+            eng.shutdown()
+
+    def test_pool_floor_enforced(self, model):
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=8,
+                     prefix_blocks=0)
+        try:
+            import math
+            maxb = math.ceil(cfg.max_seq_len / eng.block_size)
+            assert eng.pool_blocks >= 1 + 2 * maxb
+            assert eng.stats()["tree_nodes"] == 0  # reuse disabled
         finally:
             eng.shutdown()
 
@@ -353,3 +642,31 @@ class TestEnvKnobs:
         monkeypatch.setenv("K8S_TPU_SERVE_QUEUE", "-2")
         assert env_slots() == DEFAULT_SLOTS
         assert env_queue() == DEFAULT_QUEUE
+
+    def test_prefix_blocks_env(self, monkeypatch):
+        from k8s_tpu.models.engine import env_prefix_blocks
+
+        monkeypatch.delenv("K8S_TPU_SERVE_PREFIX_BLOCKS", raising=False)
+        assert env_prefix_blocks() is None  # unset = auto-size
+        monkeypatch.setenv("K8S_TPU_SERVE_PREFIX_BLOCKS", "12")
+        assert env_prefix_blocks() == 12
+        monkeypatch.setenv("K8S_TPU_SERVE_PREFIX_BLOCKS", "0")
+        assert env_prefix_blocks() == 0  # explicit 0 = reuse off
+        monkeypatch.setenv("K8S_TPU_SERVE_PREFIX_BLOCKS", "-4")
+        assert env_prefix_blocks() == 0
+
+    def test_batch_sampling_env(self, monkeypatch):
+        from k8s_tpu.models.engine import env_batch_sampling
+
+        monkeypatch.delenv("K8S_TPU_SERVE_BATCH_SAMPLING", raising=False)
+        assert env_batch_sampling() is True  # default on
+        for off in ("0", "false", "no", "OFF"):
+            monkeypatch.setenv("K8S_TPU_SERVE_BATCH_SAMPLING", off)
+            assert env_batch_sampling() is False
+        monkeypatch.setenv("K8S_TPU_SERVE_BATCH_SAMPLING", "1")
+        assert env_batch_sampling() is True
+
+    def test_block_size_must_be_a_bucket(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError, match="block_size"):
+            Engine(cfg, params, slots=1, block_size=6)

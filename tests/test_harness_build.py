@@ -434,6 +434,41 @@ class TestGenjob:
         with pytest.raises(ValueError, match="multislice"):
             genjob.v5e_slice_for_hosts(128)
 
+    def test_serve_job_surfaces_engine_knobs(self):
+        """--serve jobs carry the serving engine's env knobs, including
+        the round-6 prefix-reuse pool size and sampling-lane routing."""
+        [job] = genjob.generate(1, serve=True, timestamp=7, serve_slots=4,
+                                serve_queue=32, serve_prefix_blocks=16,
+                                serve_batch_sampling=False)
+        c = job["spec"]["tfReplicaSpecs"]["Worker"][
+            "template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["K8S_TPU_SERVE_SLOTS"] == "4"
+        assert env["K8S_TPU_SERVE_QUEUE"] == "32"
+        assert env["K8S_TPU_SERVE_PREFIX_BLOCKS"] == "16"
+        assert env["K8S_TPU_SERVE_BATCH_SAMPLING"] == "0"
+        assert "k8s_tpu.models.server" in c["command"]
+        assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+        # schedulable on a real cluster: TPU/memory limits and the
+        # checkpoint volume --train_dir loads from (not just env)
+        assert c["resources"]["limits"]["google.com/tpu"] == 4
+        assert c["volumeMounts"][0]["mountPath"] == "/checkpoints"
+        vols = job["spec"]["tfReplicaSpecs"]["Worker"][
+            "template"]["spec"]["volumes"]
+        assert vols[0]["persistentVolumeClaim"]["claimName"] \
+            == "train-lm-checkpoints"
+        manifest.load_tfjob(job)  # defaults+validates as v1alpha2
+
+    def test_serve_job_default_prefix_sizing_is_auto(self):
+        # no PREFIX_BLOCKS env unless pinned: unset means auto-size in
+        # the engine (0 would DISABLE reuse — not a default)
+        [job] = genjob.generate(1, serve=True, timestamp=8)
+        c = job["spec"]["tfReplicaSpecs"]["Worker"][
+            "template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert "K8S_TPU_SERVE_PREFIX_BLOCKS" not in env
+        assert env["K8S_TPU_SERVE_BATCH_SAMPLING"] == "1"
+
     def test_unique_names_and_scheduler(self):
         jobs = genjob.generate(3, scheduler_name="kube-batch", timestamp=9)
         names = [j["metadata"]["name"] for j in jobs]

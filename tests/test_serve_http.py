@@ -17,7 +17,6 @@ import urllib.request
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from k8s_tpu.models.server import LmServer, parse_request, serve
@@ -110,7 +109,11 @@ class TestStructured400s:
         parsed = parse_request(cfg, {"tokens": [1, 2, 3]}, 16)
         assert parsed.batched
         assert list(parsed.ids) == [1, 2, 3]
+        # sampled requests are batch-eligible since round 6 (per-slot
+        # rng keys); only speculative stays exclusive-lane-only
         parsed = parse_request(cfg, {"text": "hi", "temperature": 0.7}, 16)
+        assert parsed.batched
+        parsed = parse_request(cfg, {"text": "hi", "speculative": 4}, 16)
         assert not parsed.batched
 
 
@@ -191,7 +194,11 @@ class TestObservability:
         assert status == 200
         for name in ("serve_requests_total", "serve_queue_depth",
                      "serve_batch_occupancy", "serve_tokens_total",
-                     "serve_request_duration_seconds", "serve_rejected_total"):
+                     "serve_request_duration_seconds", "serve_rejected_total",
+                     "serve_prefix_hits_total",
+                     "serve_prefill_tokens_saved_total",
+                     "serve_sampled_batched_total",
+                     "serve_kv_blocks_in_use"):
             assert name in body, f"{name} missing from /metrics"
         assert 'serve_requests_total{result="ok"}' in body
 
@@ -278,6 +285,103 @@ class TestObservability:
         assert info["serving"]["engine"] == "continuous-batching"
         assert info["serving"]["slots"] == 2
         assert info["model"]["vocab_size"] == 256
+        assert info["serving"]["paged"] is True
+        assert info["serving"]["batch_sampling"] is True
+        assert info["serving"]["block_size"] >= 1
+        assert info["serving"]["pool_blocks"] > 0
+
+
+class TestBatchedSamplingOverHTTP:
+    """Round-6 lane promotion: a fixed-seed temperature>0 request must
+    emit IDENTICAL tokens whether it rides the batched slot lanes or the
+    exclusive single-flight lane — flipping the routing knob can never
+    change model output."""
+
+    @pytest.fixture(scope="class")
+    def exclusive_server(self, model):
+        cfg, params = model
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      batch_sampling=False, registry=Registry())
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        yield url, lm
+        httpd.shutdown()
+        lm.close()
+
+    @pytest.mark.parametrize("payload", [
+        {"tokens": [5, 6, 7], "max_new_tokens": 8, "temperature": 1.0,
+         "seed": 11},
+        {"tokens": list(range(3, 20)), "max_new_tokens": 6,
+         "temperature": 0.7, "top_k": 5, "seed": 3},
+        {"tokens": [9] * 13, "max_new_tokens": 10, "temperature": 1.3,
+         "seed": 42},
+    ])
+    def test_fixed_seed_sampling_identical_across_lanes(
+            self, server, exclusive_server, payload):
+        url, lm, _ = server
+        u0, lm0 = exclusive_server
+        assert lm.batch_sampling and not lm0.batch_sampling
+        a = _post(url, payload)
+        b = _post(u0, payload)
+        assert a == b, f"lanes diverged for {payload}"
+
+    def test_sampled_batched_counter_counts_lane(self, server,
+                                                 exclusive_server):
+        url, lm, registry = server
+        u0, lm0 = exclusive_server
+        before = _count(registry, "serve_sampled_batched_total")
+        _post(url, {"tokens": [4, 5, 6], "max_new_tokens": 4,
+                    "temperature": 0.9, "seed": 1})
+        assert _count(registry, "serve_sampled_batched_total") \
+            == before + 1
+        # the exclusive-routing server never bumps it
+        reg0 = lm0.registry
+        before0 = _count(reg0, "serve_sampled_batched_total")
+        _post(u0, {"tokens": [4, 5, 6], "max_new_tokens": 4,
+                   "temperature": 0.9, "seed": 1})
+        assert _count(reg0, "serve_sampled_batched_total") == before0
+
+
+class TestPrefixReuseOverHTTP:
+    def test_repeated_prompt_hits_prefix_cache(self, model):
+        cfg, params = model
+        registry = Registry()
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      registry=registry)
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        try:
+            toks = list(range(2, 40))  # spans multiple KV blocks
+            a = _post(url, {"tokens": toks, "max_new_tokens": 5})
+            b = _post(url, {"tokens": toks, "max_new_tokens": 5})
+            assert a == b
+            exposed = registry.expose()
+            assert "serve_prefix_hits_total 1" in exposed
+            saved = _count(registry, "serve_prefill_tokens_saved_total")
+            assert saved >= lm.engine.block_size
+            assert "serve_kv_blocks_in_use" in exposed
+            info = lm.serving_info()
+            assert info["paged"] and info["prefix_hits"] == 1
+        finally:
+            httpd.shutdown()
+            lm.close()
+
+    def test_prefix_blocks_zero_disables_reuse(self, model):
+        cfg, params = model
+        lm = LmServer(config=cfg, params=params, slots=1, queue_limit=8,
+                      prefix_blocks=0, registry=Registry())
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        try:
+            toks = list(range(2, 40))
+            a = _post(url, {"tokens": toks, "max_new_tokens": 5})
+            b = _post(url, {"tokens": toks, "max_new_tokens": 5})
+            assert a == b
+            assert lm.engine.stats()["prefix_hits"] == 0
+            assert lm.engine.stats()["tree_nodes"] == 0
+        finally:
+            httpd.shutdown()
+            lm.close()
 
 
 class TestEquivalenceOverHTTP:
