@@ -14,6 +14,7 @@ same resource, as in a real apiserver.
 from __future__ import annotations
 
 import copy as _copy_mod
+import functools
 import itertools
 import queue
 import threading
@@ -21,6 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Iterable, Optional
 
+from k8s_tpu import flight
 from k8s_tpu.api.meta import now_rfc3339
 from k8s_tpu.client import errors
 from k8s_tpu.client.gvr import GVR
@@ -30,6 +32,33 @@ from k8s_tpu.client import strategic_merge as strategic_merge_mod
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+
+def _accounted(verb: str):
+    """Flight-recorder accounting for one backend-protocol method (ISSUE 7):
+    the fake records the same ``apiserver_requests_total{verb,resource,code}``
+    substrate the REST client does, so benches against the in-process
+    cluster measure exactly what a deployed operator would export.  The
+    ``flight.account`` reentrancy guard keeps composite calls (patch =
+    get + merge + update) at ONE count for the outermost verb — what a real
+    apiserver would have seen on the wire."""
+
+    # wire-parity success codes: a real apiserver answers 201 to a create
+    success_code = 201 if verb == "POST" else 200
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, resource, *args, **kwargs):
+            if not self.account_flight:
+                # server-side store of the HTTP apiserver fixture: the
+                # REST client already accounted this request on the wire —
+                # counting the store call too would double it
+                return fn(self, resource, *args, **kwargs)
+            with flight.account(verb, resource.plural,
+                                success_code=success_code):
+                return fn(self, resource, *args, **kwargs)
+        return wrapper
+    return deco
 
 
 @dataclass
@@ -119,6 +148,11 @@ class FakeCluster:
         # wire.
         self.create_delay_s = 0.0
         self.delete_delay_s = 0.0
+        # Flight-recorder call accounting (ISSUE 7).  True for in-process
+        # backends (the call IS the apiserver request); the HTTP apiserver
+        # fixture flips it off because the REST client accounts the same
+        # requests on the wire side.
+        self.account_flight = True
 
     def _next_rv(self) -> int:
         with self._lock:
@@ -174,6 +208,7 @@ class FakeCluster:
 
     # -- CRUD ----------------------------------------------------------------
 
+    @_accounted("POST")
     def create(self, resource: GVR, namespace: str, obj: dict) -> dict:
         if self.create_delay_s:
             time.sleep(self.create_delay_s)
@@ -207,6 +242,7 @@ class FakeCluster:
             self._notify(resource, ADDED, self._copy(stored))
             return self._copy(stored)
 
+    @_accounted("GET")
     def get(self, resource: GVR, namespace: str, name: str) -> dict:
         with self._lock:
             ns = namespace if resource.namespaced else ""
@@ -216,6 +252,7 @@ class FakeCluster:
                 raise errors.not_found(f"{resource.plural} {ns}/{name} not found")
             return self._copy(obj)
 
+    @_accounted("LIST")
     def list(
         self,
         resource: GVR,
@@ -248,6 +285,7 @@ class FakeCluster:
                 return False
         return True
 
+    @_accounted("PUT")
     def update(self, resource: GVR, namespace: str, obj: dict) -> dict:
         with self._lock:
             meta = obj.get("metadata") or {}
@@ -277,6 +315,7 @@ class FakeCluster:
             self._notify(resource, MODIFIED, self._copy(stored))
             return self._copy(stored)
 
+    @_accounted("PATCH")
     def patch_merge(self, resource: GVR, namespace: str, name: str, patch: dict) -> dict:
         """Strategic-merge-lite: recursive dict merge (lists replaced)."""
         with self._lock:
@@ -366,6 +405,7 @@ class FakeCluster:
     # real cluster rejects.
     _STRATEGIC_GROUPS = frozenset({"", "apps", "batch", "policy", "extensions"})
 
+    @_accounted("PATCH")
     def patch_strategic(self, resource: GVR, namespace: str, name: str,
                         patch: dict) -> dict:
         """application/strategic-merge-patch+json (client/strategic_merge)."""
@@ -391,6 +431,7 @@ class FakeCluster:
             self._record("patch", resource, namespace, name, patch)
             return self.update(resource, namespace, merged)
 
+    @_accounted("DELETE")
     def delete(
         self,
         resource: GVR,
@@ -419,6 +460,11 @@ class FakeCluster:
             if propagation in ("Background", "Foreground"):
                 self._gc_dependents(obj["metadata"].get("uid"), ns)
 
+    # NOT @_accounted: the REST client implements delete_collection as
+    # 1 LIST + N individual DELETEs on the wire, and so does this method
+    # via its inner list()/delete() calls — letting those account
+    # naturally keeps the fake's substrate identical to the deployed one
+    # (a single outer DELETE would hide the LIST from steady-state proofs).
     def delete_collection(self, resource: GVR, namespace: str, label_selector=None) -> int:
         with self._lock:
             victims = self.list(resource, namespace, label_selector)
@@ -453,6 +499,7 @@ class FakeCluster:
 
     # -- watch ---------------------------------------------------------------
 
+    @_accounted("LIST")
     def list_with_rv(
         self,
         resource: GVR,
@@ -466,6 +513,7 @@ class FakeCluster:
             items = self.list(resource, namespace, label_selector, field_selector)
             return items, self.latest_rv()
 
+    @_accounted("WATCH")
     def watch(
         self,
         resource: GVR,

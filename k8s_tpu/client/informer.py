@@ -14,9 +14,9 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Callable, Optional
 
+from k8s_tpu import flight
 from k8s_tpu.client.gvr import GVR
 
 log = logging.getLogger(__name__)
@@ -35,6 +35,12 @@ def split_meta_namespace_key(key: str) -> tuple[str, str]:
         ns, _, name = key.partition("/")
         return ns, name
     return "", key
+
+
+def _escalating_wait(n: int) -> float:
+    """Relist-throttle schedule: 0.1 * 2^n seconds capped at 5 (exponent
+    clamped well before int→float overflow could kill the reflector)."""
+    return min(0.1 * (2 ** min(n, 10)), 5.0)
 
 
 class Store:
@@ -141,6 +147,16 @@ class SharedInformer:
         self._threads: list[threading.Thread] = []
         self._active_watch = None
         self._watch_lock = threading.Lock()
+        # Why the NEXT relist will run (flight-recorder watch health):
+        # "initial" for the first list, then set by whichever failure path
+        # invalidates the resume point (410 vs transport/stream error).
+        self._next_relist_reason = flight.RELIST_INITIAL
+        self._streams_opened = 0
+        # set by _consume_watch when the CURRENT stream delivered a
+        # server-sent ERROR frame — distinguishes an errored stream from a
+        # clean end, which reasons alone can't (the post-relist default
+        # reason is already "error")
+        self._stream_error_frame = False
 
     # handler dict keys: on_add(obj), on_update(old, new), on_delete(obj)
     def add_event_handler(self, on_add=None, on_update=None, on_delete=None) -> None:
@@ -214,22 +230,55 @@ class SharedInformer:
         for key in set(old_objs) - new_keys:
             self._dispatch("delete", old_objs[key])
         self._synced.set()
+        # Recorded AFTER the list succeeded (a failed list is a retry, not a
+        # relist), with the reason that invalidated the previous resume
+        # point; any LATER unattributed gap defaults to "error".
+        flight.WATCH.record_relist(self.resource.plural,
+                                   self._next_relist_reason)
+        self._next_relist_reason = flight.RELIST_ERROR
         return rv
 
     def _reflector_loop(self) -> None:
         backoff = 0.1
         expired_in_row = 0
+        # consecutive non-410 stream gaps (ERROR frames, broken rv
+        # tracking): escalated separately from ``backoff``, which resets
+        # after every successful relist and so can never escalate across
+        # relist cycles
+        stream_gaps_in_row = 0
         # opaque rv (str from real servers, int from the fake's list_with_rv);
         # None → a full relist is required
         last_rv = None
         while not self._stop.is_set():
+            # Which phase of the cycle an exception came from.  "relist"
+            # means the list attempt itself raised (a retry of the pending
+            # relist); anything later is a watch/stream failure — inferring
+            # this from ``last_rv is None`` would misclassify every watch
+            # failure of a resume-free backend, where last_rv is ALWAYS
+            # None, as a harmless relist retry.
+            cycle_phase = "relist"
             try:
                 if last_rv is None:
                     last_rv = self._relist()
+                # rv=None from the list means the backend cannot mint
+                # resume points at all (rest.py list_with_rv's documented
+                # degradation) — every clean stream end then relists BY
+                # DESIGN and must not be treated as a gap below
+                resume_supported = last_rv is not None
                 backoff = 0.1
+                cycle_phase = "watch"
                 w = self.backend.watch(
                     self.resource, self.namespace, resource_version=last_rv
                 )
+                # watch-stream health: every reopen after the first is a
+                # restart (server watch-timeout recycling in the steady
+                # state; a restart SPIKE means streams are dying early)
+                self._streams_opened += 1
+                if self._streams_opened > 1:
+                    flight.WATCH.record_restart(self.resource.plural)
+                stream_token = flight.WATCH.stream_started(
+                    self.resource.plural)
+                self._stream_error_frame = False
                 with self._watch_lock:
                     self._active_watch = w
                 try:
@@ -238,29 +287,79 @@ class SharedInformer:
                     # steady state does NO relisting.  Only a gap (410
                     # Expired, no rv support, transport error) falls back.
                     last_rv = self._consume_watch(w, last_rv)
-                    expired_in_row = 0
                 finally:
+                    flight.WATCH.stream_ended(self.resource.plural,
+                                              stream_token)
                     with self._watch_lock:
                         self._active_watch = None
                     w.stop()
+                if last_rv is not None:
+                    expired_in_row = 0
+                    stream_gaps_in_row = 0
+                elif self._next_relist_reason == flight.RELIST_EXPIRED:
+                    # mid-stream 410 ERROR frame: the SAME compaction
+                    # signal as a 410 raised on the watch request — it
+                    # must share the same backoff accounting, or a server
+                    # whose history can't hold one watch cycle induces a
+                    # hot zero-sleep relist loop through this path
+                    expired_in_row += 1
+                    if expired_in_row > 1:
+                        self._stop.wait(_escalating_wait(expired_in_row))
+                elif resume_supported or self._stream_error_frame:
+                    # non-410 gap (error frame, rv tracking broke): its own
+                    # escalating wait — a server erroring every stream must
+                    # not full-LIST a 5k-object collection 10x/sec forever.
+                    # An error FRAME throttles even in resume-free mode:
+                    # no-rv doesn't make a server error healthy.
+                    stream_gaps_in_row += 1
+                    self._stop.wait(_escalating_wait(stream_gaps_in_row))
+                else:
+                    # resume-free mode, clean stream end: the per-cycle
+                    # relist is the healthy steady state — no backoff, the
+                    # gap counters RESET (they measure consecutive gaps,
+                    # not lifetime totals — without this, isolated errors
+                    # hours apart would each stall the full 5s cap), and
+                    # the relist attributed distinctly so
+                    # watch_relists_total never reads as a failure storm
+                    expired_in_row = 0
+                    stream_gaps_in_row = 0
+                    self._next_relist_reason = flight.RELIST_NO_RV
             except Exception as e:
                 if self._stop.is_set():
                     return
+                # A failure in the RELIST ATTEMPT itself is a retry of the
+                # pending relist, not a new gap — it must not overwrite the
+                # pending reason, or a flaky first list would record the
+                # initial (or 410) relist as "error".
+                was_relisting = cycle_phase == "relist"
                 last_rv = None  # any failure invalidates the resume point
                 if getattr(e, "code", None) == 410:
                     log.info(
                         "watch rv expired for %s; relisting", self.resource.plural
                     )
+                    self._next_relist_reason = flight.RELIST_EXPIRED
                     # first 410 relists immediately (expected after a churn
                     # burst); repeats back off — a server whose history
                     # can't hold one watch cycle must not induce a hot
                     # O(N)-list loop
                     expired_in_row += 1
                     if expired_in_row > 1:
-                        time.sleep(min(0.1 * (2 ** expired_in_row), 5.0))
+                        # stop()-aware wait: a plain sleep would hold the
+                        # reflector thread (and teardown) up to 5s
+                        self._stop.wait(_escalating_wait(expired_in_row))
                     continue
+                if not was_relisting:
+                    self._next_relist_reason = flight.RELIST_ERROR
+                    # a DYING watch (raised, e.g. proxy/LB connection kill)
+                    # is a stream gap exactly like an ERROR frame: it must
+                    # escalate across relist cycles — ``backoff`` alone
+                    # resets after every successful relist and would relist
+                    # a 5k-object collection 10x/sec forever
+                    stream_gaps_in_row += 1
                 log.exception("reflector relist for %s", self.resource.plural)
-                time.sleep(backoff)
+                self._stop.wait(max(backoff,
+                                    _escalating_wait(stream_gaps_in_row)
+                                    if not was_relisting else 0.0))
                 backoff = min(backoff * 2, 5.0)
 
     def _consume_watch(self, w, last_rv: Optional[int]) -> Optional[int]:
@@ -273,8 +372,17 @@ class SharedInformer:
                     return last_rv
                 continue
             event_type, obj = item
+            flight.WATCH.record_event(self.resource.plural, event_type)
             if event_type == "ERROR":
-                # server-sent error frame (e.g. 410 mid-stream): relist
+                # server-sent error frame (e.g. 410 mid-stream): relist.
+                # The frame's object is a Status whose code says why — a
+                # mid-stream 410 is the same compaction signal as a 410 on
+                # the watch request itself and is attributed the same way.
+                self._stream_error_frame = True
+                self._next_relist_reason = (
+                    flight.RELIST_EXPIRED
+                    if (obj or {}).get("code") == 410
+                    else flight.RELIST_ERROR)
                 return None
             if last_rv is not None:
                 # rv is opaque (K8s API contract): carry the string through

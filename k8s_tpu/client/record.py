@@ -4,6 +4,13 @@ K8s Events are load-bearing telemetry in this system: the e2e harness asserts
 on pod/service create events (py/test_runner.py:301-332), so controllers must
 record them faithfully (pkg/trainer/replicas.go:470-506,
 pkg/controller.v2/service_control.go:96-112).
+
+Flight-recorder integration (ISSUE 7): every recorded event also lands on
+the involved object's lifecycle timeline (``flight.TIMELINE``), and the
+recorder exports ``events_recorded_total`` / ``events_dropped_total`` /
+``events_aggregated_total`` through ``flight.EVENTS`` — a queue-overflow
+drop is *counted*, never raised, so the reconcile path can't be failed by
+its own telemetry.
 """
 
 from __future__ import annotations
@@ -12,7 +19,9 @@ import logging
 import queue
 import threading
 import time
+from collections import OrderedDict
 
+from k8s_tpu import flight
 from k8s_tpu.api.meta import now_rfc3339
 from k8s_tpu.client.clientset import Clientset
 
@@ -20,6 +29,19 @@ log = logging.getLogger(__name__)
 
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
+
+
+def _timeline_event(involved: dict, event_type: str, reason: str,
+                    message: str) -> None:
+    """Mirror one recorder event onto the involved object's flight-recorder
+    timeline (no-op while the recorder is inactive)."""
+    meta = involved.get("metadata") or {}
+    ns = meta.get("namespace", "default")
+    name = meta.get("name", "")
+    if not name:
+        return
+    flight.timeline(f"{ns}/{name}", "event", reason=reason, message=message,
+                    type=event_type, involved_kind=involved.get("kind", ""))
 
 
 class EventRecorder:
@@ -30,6 +52,12 @@ class EventRecorder:
         self.component = component
 
     def event(self, involved: dict, event_type: str, reason: str, message: str) -> None:
+        _timeline_event(involved, event_type, reason, message)
+        flight.EVENTS.record_recorded()
+        self._post(involved, event_type, reason, message)
+
+    def _build_event(self, involved: dict, event_type: str, reason: str,
+                     message: str) -> tuple[str, dict]:
         meta = involved.get("metadata") or {}
         ns = meta.get("namespace", "default")
         # Nanosecond suffix like client-go: unique across operator restarts
@@ -52,10 +80,21 @@ class EventRecorder:
             "lastTimestamp": now_rfc3339(),
             "count": 1,
         }
+        return ns, ev
+
+    def _post(self, involved: dict, event_type: str, reason: str,
+              message: str):
+        """Create the Event on the apiserver; returns the created object or
+        None (failures are logged AND counted as drops, never raised — a
+        send failure is a lost event, and 'drops counted, never raised'
+        has no silent third outcome)."""
+        ns, ev = self._build_event(involved, event_type, reason, message)
         try:
-            self.clientset.events(ns).create(ev)
+            return self.clientset.events(ns).create(ev)
         except Exception:
+            flight.EVENTS.record_dropped()
             log.exception("failed to record event %s/%s", reason, message)
+            return None
 
     def eventf(self, involved: dict, event_type: str, reason: str, fmt: str, *args) -> None:
         self.event(involved, event_type, reason, fmt % args if args else fmt)
@@ -69,16 +108,29 @@ class AsyncEventRecorder(EventRecorder):
 
     Measured motivation: under the 200-gang-job wire bench, synchronous
     event POSTs were ~9 of the ~27 HTTP requests per job *inside* the
-    reconcile loop.  Event content is unchanged (one event per message —
-    the harness parses pod names out of messages, so no cross-object
-    aggregation); only the posting moves off-thread.
+    reconcile loop.
 
-    Overflow drops the newest event with a log line, exactly like
-    client-go's full buffered channel.  ``flush()`` waits for the queue to
-    drain (tests; controller shutdown).
+    The sink aggregates EXACT repeats — same involved object, type, reason
+    AND message — by bumping ``count``/``lastTimestamp`` on the existing
+    Event object (client-go EventLogger dedup semantics) instead of
+    creating a new one.  Distinct messages are never merged: the e2e
+    harness parses pod names out of messages, so cross-object aggregation
+    (client-go's 10-similar-events aggregator) is deliberately not
+    modeled.
+
+    Overflow drops the newest event with a log line and a counter bump
+    (``events_dropped_total``), exactly like client-go's full buffered
+    channel.  ``flush()`` waits for the queue to drain (tests; controller
+    shutdown).
     """
 
     QUEUE_SIZE = 4096
+    # Aggregation cache: at most this many distinct (object, reason,
+    # message) keys remembered, each for at most AGG_TTL_S since its first
+    # post — bounded memory, and a key that went quiet re-creates fresh
+    # (matching the apiserver's own event TTL behavior).
+    AGG_MAX_KEYS = 1024
+    AGG_TTL_S = 600.0
 
     def __init__(self, clientset: Clientset, component: str):
         super().__init__(clientset, component)
@@ -86,18 +138,27 @@ class AsyncEventRecorder(EventRecorder):
         self._unfinished = 0
         self._closed = False
         self._cond = threading.Condition()
+        # touched only by the sink thread — no lock needed
+        self._agg: "OrderedDict[tuple, dict]" = OrderedDict()
         self._thread = threading.Thread(
             target=self._sink, daemon=True, name=f"event-sink-{component}")
         self._thread.start()
 
     def event(self, involved: dict, event_type: str, reason: str, message: str) -> None:
+        _timeline_event(involved, event_type, reason, message)
         try:
             with self._cond:
                 if self._closed:
+                    # late event after shutdown: still a drop, still
+                    # counted — "drops counted, never raised" has no
+                    # silent third outcome
+                    flight.EVENTS.record_dropped()
                     return
                 self._q.put_nowait((involved, event_type, reason, message))
                 self._unfinished += 1
+            flight.EVENTS.record_recorded()
         except queue.Full:
+            flight.EVENTS.record_dropped()
             log.warning("event queue full; dropping %s %s", reason, message)
 
     def _sink(self) -> None:
@@ -106,11 +167,50 @@ class AsyncEventRecorder(EventRecorder):
             if item is None:
                 return
             try:
-                super().event(*item)
+                self._post_aggregated(*item)
             finally:
                 with self._cond:
                     self._unfinished -= 1
                     self._cond.notify_all()
+
+    def _agg_key(self, involved: dict, event_type: str, reason: str,
+                 message: str) -> tuple:
+        meta = involved.get("metadata") or {}
+        return (meta.get("namespace", "default"), involved.get("kind", ""),
+                meta.get("name", ""), meta.get("uid", ""),
+                event_type, reason, message)
+
+    def _post_aggregated(self, involved: dict, event_type: str, reason: str,
+                         message: str) -> None:
+        """One sink-side send: an exact repeat within the TTL bumps the
+        existing Event's count/lastTimestamp via PATCH; anything else (or a
+        failed bump — the event may have been GC'd) creates fresh."""
+        key = self._agg_key(involved, event_type, reason, message)
+        now = time.monotonic()
+        ent = self._agg.get(key)
+        if ent is not None and now - ent["t0"] <= self.AGG_TTL_S:
+            try:
+                self.clientset.events(ent["ns"]).patch(ent["name"], {
+                    "count": ent["count"] + 1,
+                    "lastTimestamp": now_rfc3339(),
+                })
+                ent["count"] += 1
+                self._agg.move_to_end(key)
+                flight.EVENTS.record_aggregated()
+                return
+            except Exception:  # noqa: BLE001 - event gone/GC'd: create fresh
+                self._agg.pop(key, None)
+        created = self._post(involved, event_type, reason, message)
+        if created is not None:
+            self._agg[key] = {
+                "name": created["metadata"]["name"],
+                "ns": created["metadata"].get("namespace", "default"),
+                "count": 1,
+                "t0": now,
+            }
+            self._agg.move_to_end(key)
+            while len(self._agg) > self.AGG_MAX_KEYS:
+                self._agg.popitem(last=False)
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Block until every recorded event has been posted (or timeout)."""

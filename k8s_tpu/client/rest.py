@@ -19,6 +19,7 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
+from k8s_tpu import flight
 from k8s_tpu.client import errors
 from k8s_tpu.client.gvr import GVR
 from k8s_tpu.client.selectors import parse_label_selector
@@ -41,18 +42,50 @@ if WIRE_PROFILE_ENABLED:
 
 
 def _profile_key(method: str, path: str) -> str:
-    # /api/v1/namespaces/ns/pods/name?q -> "GET pods"; /apis/g/v/t -> t
-    path = path.split("?", 1)[0]
-    parts = [p for p in path.split("/") if p]
-    resource = "?"
-    if "namespaces" in parts:
-        i = parts.index("namespaces")
-        resource = parts[i + 2] if len(parts) > i + 2 else "namespaces"
-    elif parts[:1] == ["api"] and len(parts) >= 3:
-        resource = parts[2]
-    elif parts[:1] == ["apis"] and len(parts) >= 4:
-        resource = parts[3]
-    return f"{method} {resource}"
+    # /api/v1/namespaces/ns/pods/name?q -> "GET pods"; /apis/g/v/t -> t.
+    # Resource parsing is shared with the flight recorder (ONE parser —
+    # the wire-profile key and the accounting label must never disagree
+    # about a request's resource).
+    return f"{method} {_verb_and_resource(method, path)[1]}"
+
+
+def _verb_and_resource(method: str, path: str) -> tuple[str, str]:
+    """Flight-recorder (verb, resource) for one request, in ONE pass over
+    the path (this runs per wire attempt on the lean unary hot path).
+
+    Verb is the HTTP method except that streaming GETs count as WATCH and
+    collection GETs as LIST — the steady-state proof ("zero per-sync
+    LISTs") needs LIST to be a label, not a path-parsing exercise at
+    query time.  LIST is decided by path SHAPE (no name segment after the
+    resource segment), so a single object legally named like its plural
+    (GET .../pods/pods) still counts as a GET."""
+    raw, _, query = path.partition("?")
+    parts = [p for p in raw.split("/") if p]
+    resource, has_name = "?", True
+    # Anchor on the API root (the first api/apis segment — any earlier
+    # segments are a proxy base path) and parse by POSITION from there:
+    # a token scan for "namespaces" would misparse a cluster-scoped
+    # object literally named "namespaces" (GET /api/v1/nodes/namespaces).
+    root = next((j for j, p in enumerate(parts) if p in ("api", "apis")),
+                None)
+    if root is not None:
+        # after /api/<version> or /apis/<group>/<version>
+        rest = parts[root + (2 if parts[root] == "api" else 3):]
+        if rest[:1] == ["namespaces"] and len(rest) >= 3:
+            resource = rest[2]
+            has_name = len(rest) > 3
+        elif rest[:1] == ["namespaces"]:
+            # the namespaces resource itself: /api/v1/namespaces[/<name>]
+            resource = "namespaces"
+            has_name = len(rest) > 1
+        elif rest:  # cluster-scoped: /api/v1/nodes[/<name>]
+            resource = rest[0]
+            has_name = len(rest) > 1
+    if "watch=true" in query:
+        return "WATCH", resource
+    if method == "GET" and not has_name:
+        return "LIST", resource
+    return method, resource
 
 
 def _profile_record(method: str, path: str, seconds: float) -> None:
@@ -477,21 +510,34 @@ class RestClient:
         if body is not None and method == "PATCH":
             headers["Content-Type"] = content_type or "application/merge-patch+json"
         path = url
+        # Flight-recorder accounting (ISSUE 7): one record per WIRE ATTEMPT
+        # — a transport-retried GET is two attempts and two counts, exactly
+        # what the apiserver saw.  Transport failures with no status = 0.
+        acct_verb, acct_resource = _verb_and_resource(method, path)
 
         if stream:
             # dedicated connection: the response body is an open stream the
             # caller consumes until server close — never pooled
+            a0 = time.perf_counter()
             conn = self._new_conn(timeout=None)
-            conn.request(method, path, body=data, headers=headers)
-            # Capture the socket BEFORE getresponse(): for Connection:
-            # close responses (every watch stream) http.client detaches —
-            # conn.sock becomes None and the socket lives on only inside
-            # the response's buffered reader.  _RestWatch.stop() needs this
-            # direct reference to shutdown() a blocked reader; without it
-            # the stop blocks until the server's watch timeout (measured
-            # 59s, 2x per LocalCluster teardown in rest mode).
-            sock = conn.sock
-            resp = conn.getresponse()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                # Capture the socket BEFORE getresponse(): for Connection:
+                # close responses (every watch stream) http.client detaches —
+                # conn.sock becomes None and the socket lives on only inside
+                # the response's buffered reader.  _RestWatch.stop() needs
+                # this direct reference to shutdown() a blocked reader;
+                # without it the stop blocks until the server's watch
+                # timeout (measured 59s, 2x per LocalCluster teardown in
+                # rest mode).
+                sock = conn.sock
+                resp = conn.getresponse()
+            except Exception:
+                flight.record_api_call(acct_verb, acct_resource, 0,
+                                       time.perf_counter() - a0)
+                raise
+            flight.record_api_call(acct_verb, acct_resource, resp.status,
+                                   time.perf_counter() - a0)
             if resp.status >= 400:
                 raw = resp.read()
                 conn.close()
@@ -509,17 +555,22 @@ class RestClient:
             # lean raw-socket path (TLS stays on http.client below)
             t0 = time.perf_counter() if WIRE_PROFILE_ENABLED else 0.0
             for attempt in attempts:
+                a0 = time.perf_counter()
                 span, traceparent = self._trace_attempt(method, path, attempt)
                 try:
                     status, reason, raw = self._lean_unary(
                         method, path, data, headers.get("Content-Type", ""),
                         extra_hdr=(f"traceparent: {traceparent}\r\n"
                                    if traceparent else ""))
+                    flight.record_api_call(acct_verb, acct_resource, status,
+                                           time.perf_counter() - a0)
                     if span is not None:
                         span.set_attribute("http_status", status)
                         span.finish()
                     break
                 except (ConnectionError, OSError, ValueError) as e:
+                    flight.record_api_call(acct_verb, acct_resource, 0,
+                                           time.perf_counter() - a0)
                     if span is not None:
                         span.set_error(e)
                         span.finish()
@@ -537,6 +588,7 @@ class RestClient:
 
         t0 = time.perf_counter() if WIRE_PROFILE_ENABLED else 0.0
         for attempt in attempts:
+            a0 = time.perf_counter()
             span, traceparent = self._trace_attempt(method, path, attempt)
             if traceparent:
                 headers["traceparent"] = traceparent
@@ -545,6 +597,8 @@ class RestClient:
                 conn.request(method, path, body=data, headers=headers)
                 resp = conn.getresponse()
                 raw = resp.read()  # fully drain so the connection can be reused
+                flight.record_api_call(acct_verb, acct_resource, resp.status,
+                                       time.perf_counter() - a0)
                 if span is not None:
                     span.set_attribute("http_status", resp.status)
                     span.finish()
@@ -552,6 +606,8 @@ class RestClient:
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 # stale keep-alive (server closed between requests) or
                 # transport hiccup
+                flight.record_api_call(acct_verb, acct_resource, 0,
+                                       time.perf_counter() - a0)
                 if span is not None:
                     span.set_error(e)
                     span.finish()

@@ -206,7 +206,8 @@ class _BatchDeleteMixin:
 
 
 def run_create_wave(expectations, exp_key: str, submit_range, count: int,
-                    metrics, kind: str, describe, initial: int = 1) -> None:
+                    metrics, kind: str, describe, initial: int = 1,
+                    job: str | None = None) -> None:
     """The creation-wave contract shared by the pod/service reconcilers:
     raise ``count`` expectations up-front, submit creates in slow-start
     chunks of ``initial``, 2x, 4x, ... (client-go's slowStartBatch: a chunk
@@ -231,7 +232,7 @@ def run_create_wave(expectations, exp_key: str, submit_range, count: int,
     # binding.  An error re-raised out of the wave marks the span failed.
     with trace.span(f"create_{kind}s_batch", kind=kind, count=count):
         _run_wave(expectations, exp_key, submit_range, count, metrics,
-                  kind, describe, initial)
+                  kind, describe, initial, job)
 
 
 def _slow_start_submit(submit_range, count: int, initial: int, is_benign,
@@ -254,7 +255,8 @@ def _slow_start_submit(submit_range, count: int, initial: int, is_benign,
 
 
 def _run_wave(expectations, exp_key: str, submit_range, count: int,
-              metrics, kind: str, describe, initial: int) -> None:
+              metrics, kind: str, describe, initial: int,
+              job: str | None = None) -> None:
     expectations.expect_creations(exp_key, count)
     t0 = time.monotonic()
     results: list[tuple[dict | None, Exception | None]] = []
@@ -271,6 +273,7 @@ def _run_wave(expectations, exp_key: str, submit_range, count: int,
         for _ in range(count - len(results)):
             expectations.creation_observed(exp_key)
     record_batch_metrics(metrics, kind, results, time.monotonic() - t0)
+    _timeline_wave(job, "create_wave", kind, count, results)
     first_error: Exception | None = None
     for i, (_created, exc) in enumerate(results):
         if exc is None:
@@ -284,6 +287,22 @@ def _run_wave(expectations, exp_key: str, submit_range, count: int,
             first_error = exc
     if first_error is not None:
         raise first_error
+
+
+def _timeline_wave(job: str | None, wave: str, kind: str, count: int,
+                   results) -> None:
+    """One flight-recorder timeline entry per create/delete wave (ISSUE 7):
+    the "pods created"/"pods deleted" markers of a job's lifecycle, with
+    the per-slot outcome tallies.  ``job=None`` (bare unit-test wiring)
+    records nothing."""
+    if not job:
+        return
+    from k8s_tpu import flight
+
+    ok = sum(1 for _r, exc in results if exc is None)
+    flight.timeline(job, wave, resource=kind, count=count, ok=ok,
+                    errors=len(results) - ok,
+                    unsubmitted=count - len(results))
 
 
 def _is_already_exists(exc) -> bool:
@@ -343,7 +362,8 @@ def unwind_delete_expectations(expectations, exp_key: str | None,
 
 def run_delete_wave(expectations, exp_key: str | None, submit_range,
                     count: int, metrics, kind: str, describe,
-                    initial: int = 1, raise_on_error: bool = True) -> int:
+                    initial: int = 1, raise_on_error: bool = True,
+                    job: str | None = None) -> int:
     """The teardown-wave contract shared by gang restart, single-pod restart,
     and terminal cleanup: raise ``count`` deletion expectations up-front,
     submit deletes in slow-start chunks of ``initial``, 2x, 4x, ... (a hard
@@ -364,11 +384,12 @@ def run_delete_wave(expectations, exp_key: str | None, submit_range,
     with trace.span(f"delete_{kind}s_batch", kind=kind, count=count):
         return _run_delete_wave(expectations, exp_key, submit_range, count,
                                 metrics, kind, describe, initial,
-                                raise_on_error)
+                                raise_on_error, job)
 
 
 def _run_delete_wave(expectations, exp_key, submit_range, count, metrics,
-                     kind, describe, initial, raise_on_error) -> int:
+                     kind, describe, initial, raise_on_error,
+                     job: str | None = None) -> int:
     if exp_key:
         expectations.expect_deletions(exp_key, count)
     t0 = time.monotonic()
@@ -386,6 +407,7 @@ def _run_delete_wave(expectations, exp_key, submit_range, count, metrics,
                                    count - len(results))
     record_delete_batch_metrics(metrics, kind, results,
                                 time.monotonic() - t0)
+    _timeline_wave(job, "delete_wave", kind, count, results)
     first_error: Exception | None = None
     gone = 0
     for i, (_result, exc) in enumerate(results):

@@ -19,6 +19,7 @@ import os
 import threading
 import time
 
+from k8s_tpu import flight
 from k8s_tpu import scheduler as scheduler_mod
 from k8s_tpu import trace
 from k8s_tpu.api import register, validation
@@ -126,6 +127,12 @@ class TFJobController:
         self._pdb_cache: dict = {}
         self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v2")
+        # Flight recorder (ISSUE 7): activate the per-job lifecycle journal
+        # (/debug/timeline serves 404 until a controller does this) and
+        # register the apiserver/watch/event metric families so /metrics
+        # exports what flight.ACCOUNTING/WATCH/EVENTS have been counting.
+        flight.TIMELINE.activate()
+        metrics.flight_metrics()
         # Gang admission & capacity scheduler (ISSUE 4).  cluster_chips:
         # None -> K8S_TPU_CLUSTER_CHIPS, else derive from node allocatable
         # TPU resources per sync, else unlimited (admission off — the
@@ -230,7 +237,12 @@ class TFJobController:
         return meta_namespace_key(obj)
 
     def _add_tfjob(self, obj: dict) -> None:
-        self.enqueue_key(self._key_of(obj))
+        key = self._key_of(obj)
+        # timeline head: the job became visible to the control plane (fires
+        # again after a relist — entries are cheap and the journal bounded)
+        flight.timeline(key, "observed",
+                        uid=(obj.get("metadata") or {}).get("uid", ""))
+        self.enqueue_key(key)
 
     def _delete_tfjob(self, obj: dict) -> None:
         key = self._key_of(obj)
@@ -255,6 +267,7 @@ class TFJobController:
         # queue entry, and preemption marker all go, and freed chips wake
         # the parked jobs that were waiting on them
         self._release_scheduler_key(key)
+        flight.timeline(key, "deleted")
 
     def enqueue_tfjob(self, tfjob) -> None:
         self.enqueue_key(tpu_config.tfjob_key(tfjob))
@@ -392,6 +405,7 @@ class TFJobController:
                     status_mod.new_condition(
                         types.TFJobFailed, status_mod.TFJOB_FAILED_REASON, str(e)
                     ),
+                    job=key,
                 )
                 self.update_status_handler(tfjob)
                 return True
@@ -426,6 +440,7 @@ class TFJobController:
 
     def reconcile_tfjobs(self, tfjob) -> None:
         """reconcileTFJobs (controller.go:377-412)."""
+        job_key = tpu_config.tfjob_key(tfjob)
         if status_mod.is_finished(tfjob.status):
             # Terminal jobs: optionally clean up pods per cleanPodPolicy
             # (upstream added the field right after this snapshot; the
@@ -453,6 +468,7 @@ class TFJobController:
                     f"activeDeadlineSeconds="
                     f"{tfjob.spec.active_deadline_seconds}.",
                 ),
+                job=job_key,
             )
             if tfjob.status.completion_time is None:
                 tfjob.status.completion_time = now_rfc3339()
@@ -471,6 +487,7 @@ class TFJobController:
                     status_mod.TFJOB_CREATED_REASON,
                     f"TFJob {tfjob.metadata.name} is created.",
                 ),
+                job=job_key,
             )
 
         # Gang admission (ISSUE 4): all-or-nothing — either the whole
@@ -546,6 +563,9 @@ class TFJobController:
                     self.metrics["admitted_total"].labels(gen).inc()
                     self.metrics["admission_wait"].labels(gen).observe(
                         decision.wait_s)
+                    flight.timeline(key, "admitted", reason=decision.reason,
+                                    chips=chips, priority=priority,
+                                    wait_s=round(decision.wait_s, 3))
                     self._clear_queued_condition(tfjob, decision)
                 return True
             self._park_queued(tfjob, key, chips, decision)
@@ -568,7 +588,11 @@ class TFJobController:
             gen = self.metrics["generation"]
             self.metrics["preemptions_total"].labels(gen).inc(
                 len(decision.victims))
+            flight.timeline(key, "preempted_victims",
+                            victims=list(decision.victims), chips=chips)
             for vkey in decision.victims:
+                flight.timeline(vkey, "preempted", reason="Preempted",
+                                by=key, priority=priority)
                 ns, name = split_meta_namespace_key(vkey)
                 vobj = self.tfjob_lister.get(ns, name)
                 if vobj is not None:
@@ -594,7 +618,8 @@ class TFJobController:
             f"gang admitted after {decision.wait_s:.1f}s in the queue")
         cond.status = types.ConditionFalse
         with self._status_lock:
-            status_mod.set_condition(tfjob.status, cond)
+            status_mod.set_condition(tfjob.status, cond,
+                                     job=tpu_config.tfjob_key(tfjob))
         self.recorder.eventf(
             tfjob.to_dict(), "Normal", "GangAdmitted",
             "Admitted after %.1fs in the admission queue", decision.wait_s)
@@ -613,17 +638,20 @@ class TFJobController:
             reason = status_mod.TFJOB_QUEUED_REASON
             message = (f"waiting for {chips} TPU chip(s): "
                        f"{decision.reason}")
+        flight.timeline(key, "queued", reason=reason, message=message,
+                        chips=chips)
         with self._status_lock:
             status_mod.set_condition(
                 tfjob.status,
-                status_mod.new_condition(types.TFJobQueued, reason, message))
+                status_mod.new_condition(types.TFJobQueued, reason, message),
+                job=key)
             running = status_mod.get_condition(tfjob.status, types.TFJobRunning)
             if running is not None and running.status == types.ConditionTrue:
                 cond = status_mod.new_condition(
                     types.TFJobRunning, reason,
                     "gang torn down; job is requeued")
                 cond.status = types.ConditionFalse
-                status_mod.set_condition(tfjob.status, cond)
+                status_mod.set_condition(tfjob.status, cond, job=key)
         self._teardown_parked_pods(tfjob, key)
         self.update_status_handler(tfjob)
 
@@ -658,6 +686,7 @@ class TFJobController:
                 lambda i, names=names: f"pod {names[i]} (preemption teardown)",
                 initial=getattr(self.pod_control, "delete_width", 1),
                 raise_on_error=False,
+                job=key,
             )
         if deleted:
             self.recorder.eventf(
@@ -676,6 +705,9 @@ class TFJobController:
         self.metrics["queue_depth"].labels(self.metrics["generation"]).set(
             sched.queue_depth())
         if freed:
+            # recorded only when chips actually freed: forget() is
+            # idempotent, so resyncs of a finished job don't spam the ring
+            flight.timeline(key, "released", chips=freed)
             for waiting in sched.waiting_keys():
                 self.enqueue_key(waiting)
 
@@ -814,6 +846,7 @@ class TFJobController:
                 lambda i, names=names: f"pod {names[i]} (cleanPodPolicy)",
                 initial=getattr(self.pod_control, "delete_width", 1),
                 raise_on_error=False,
+                job=key,
             )
         svc_deleted = self._clean_up_terminal_services(tfjob, policy, key,
                                                        job_dict)
@@ -870,6 +903,7 @@ class TFJobController:
                 lambda i, names=names: f"service {names[i]} (cleanPodPolicy)",
                 initial=getattr(self.service_control, "delete_width", 1),
                 raise_on_error=False,
+                job=key,
             )
         return deleted
 

@@ -300,6 +300,7 @@ class PodReconciler:
                     status_mod.TFJOB_RESTARTING_REASON,
                     f"pod {name} exited retryably and is restarting",
                 ),
+                job=tpu_config.tfjob_key(tfjob),
             )
         # Single-pod restart batches trivially: a 1-slot wave buys the shared
         # expectation-unwind, NotFound-as-success, span, and metrics contract
@@ -348,6 +349,7 @@ class PodReconciler:
                     status_mod.TFJOB_RESTARTING_REASON,
                     f"gang {rtype} restarting: {len(failed)} pod(s) failed retryably",
                 ),
+                job=key,
             )
         self.recorder.eventf(
             job_dict, "Normal", "GangRestart",
@@ -381,6 +383,7 @@ class PodReconciler:
             len(names), self.metrics, "pod",
             lambda i: f"pod {names[i]} ({reason} of {key})",
             initial=getattr(self.pod_control, "delete_width", 1),
+            job=key,
         )
 
     # -- creation ------------------------------------------------------------
@@ -467,6 +470,7 @@ class PodReconciler:
             len(templates), self.metrics, "pod",
             lambda i: f"pod for {key} {rt}/{indices[i]}",
             initial=getattr(self.pod_control, "create_width", 1),
+            job=key,
         )
 
     def _job_snapshot(self, tfjob: types.TFJob) -> dict:

@@ -153,6 +153,7 @@ class ServiceReconciler:
             len(service_objs), self.metrics, "service",
             lambda i: f"service {service_objs[i]['metadata']['name']}",
             initial=getattr(self.service_control, "create_width", 1),
+            job=key,
         )
 
 

@@ -16,6 +16,7 @@ updateStatus only inspected TFReplicaTypeWorker.
 
 from __future__ import annotations
 
+from k8s_tpu import flight
 from k8s_tpu.api.meta import now_rfc3339
 from k8s_tpu.api.v1alpha2 import types
 
@@ -59,7 +60,13 @@ def filter_out_condition(conditions, cond_type: str):
     return [c for c in conditions if c.type != cond_type]
 
 
-def set_condition(status: types.TFJobStatus, condition: types.TFJobCondition) -> None:
+def set_condition(status: types.TFJobStatus, condition: types.TFJobCondition,
+                  job: str | None = None) -> None:
+    """setCondition with flight-recorder journaling: an ACTUAL transition
+    (the no-change early return doesn't count) lands one ``condition``
+    entry on ``job``'s lifecycle timeline when the caller passes the
+    ``namespace/name`` key.  ``job=None`` keeps the pure-function contract
+    for callers without one (tests, v1 compatibility)."""
     current = get_condition(status, condition.type)
     if (
         current is not None
@@ -70,6 +77,10 @@ def set_condition(status: types.TFJobStatus, condition: types.TFJobCondition) ->
     if current is not None and current.status == condition.status:
         condition.last_transition_time = current.last_transition_time
     status.conditions = filter_out_condition(status.conditions, condition.type) + [condition]
+    if job:
+        flight.timeline(job, "condition", reason=condition.reason,
+                        message=condition.message, type=condition.type,
+                        status=condition.status)
 
 
 def has_condition(status: types.TFJobStatus, cond_type: str) -> bool:
@@ -114,6 +125,11 @@ def update_status(tfjob: types.TFJob, rtype: str, replicas: int) -> None:
     running = rs.active
     failed = rs.failed
     name = tfjob.metadata.name
+    # the ONE job-key definition: timelines written here must land under
+    # the same key as those written from controller.py/pod.py
+    from k8s_tpu.controller_v2.tpu_config import tfjob_key
+
+    job_key = tfjob_key(tfjob)
 
     if rtype == completion_deciding_type(tfjob):
         if running == replicas and tfjob.status.start_time is None:
@@ -124,6 +140,7 @@ def update_status(tfjob: types.TFJob, rtype: str, replicas: int) -> None:
                 new_condition(
                     types.TFJobRunning, TFJOB_RUNNING_REASON, f"TFJob {name} is running."
                 ),
+                job=job_key,
             )
         if expected == 0:
             if tfjob.status.completion_time is None:
@@ -135,10 +152,12 @@ def update_status(tfjob: types.TFJob, rtype: str, replicas: int) -> None:
                     TFJOB_SUCCEEDED_REASON,
                     f"TFJob {name} is successfully completed.",
                 ),
+                job=job_key,
             )
 
     if failed > 0:
         set_condition(
             tfjob.status,
             new_condition(types.TFJobFailed, TFJOB_FAILED_REASON, f"TFJob {name} is failed."),
+            job=job_key,
         )

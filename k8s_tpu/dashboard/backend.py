@@ -117,6 +117,15 @@ def _make_handler(manager: ClientManager):
 
                     code, body, ctype = scheduler_mod.debug_response(query)
                     self._send_text(code, body, ctype)
+                elif path == "/debug/timeline":
+                    # Flight-recorder lifecycle journal — the SAME shared
+                    # responder the metrics server uses (one contract, one
+                    # implementation: flight.debug_timeline_response), with
+                    # the same per-process scope caveat as above.
+                    from k8s_tpu import flight
+
+                    code, body, ctype = flight.timeline_response(query)
+                    self._send_text(code, body, ctype)
                 elif path in ("", "/tfjobs/ui", "/tfjobs"):
                     self._serve_ui("index.html")
                 elif path.startswith("/tfjobs/ui/"):

@@ -438,6 +438,10 @@ class ApiServer:
         # the dominant per-request CPU under the 200-job wire bench.
         self.cluster = (cluster if cluster is not None
                         else FakeCluster(copy_on_io=False))
+        # This store is the SERVER side of a wire protocol: the REST client
+        # accounts every request per attempt already, so the store must not
+        # count the same call a second time into the flight recorder.
+        self.cluster.account_flight = False
         self.token = token
         self.watch_timeout = watch_timeout
         self.stopping = threading.Event()

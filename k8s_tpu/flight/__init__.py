@@ -1,0 +1,154 @@
+"""Control-plane flight recorder (ISSUE 7): apiserver call accounting,
+watch-stream health, and per-job lifecycle timelines.
+
+Three process-global instruments, mirroring the ``trace.TRACER`` /
+``scheduler.set_active`` pattern so HTTP debug endpoints and metric
+adapters need no controller reference:
+
+- :data:`ACCOUNTING` — every apiserver request either transport issues,
+  keyed ``(verb, resource, code)``, with durations and an in-process
+  rolling rate (``client/rest.py`` records per wire *attempt*;
+  ``client/fake.py`` per backend-protocol call).
+- :data:`WATCH` — reflector relists (initial/410/error), watch restarts,
+  delivered event counts, and live stream ages (``client/informer.py``).
+- :data:`TIMELINE` — a bounded per-job ring journal of lifecycle events
+  (conditions, admission/parking/preemption, create/delete waves,
+  recorder events), served as ``/debug/timeline`` on the metrics server
+  and dashboard.  Inactive (no-op, 404 on the endpoint) until the v2
+  controller activates it.
+- :data:`EVENTS` — EventRecorder send/drop/aggregate counters.
+
+This package is stdlib-only by policy (``harness/py_checks.py`` gates it
+like ``trace/`` and ``scheduler/``): it rides the REST client's request
+hot path and is read by two HTTP processes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from k8s_tpu.flight.accounting import (  # noqa: F401 (public surface)
+    CallAccounting,
+    EventStats,
+)
+from k8s_tpu.flight.debug import debug_timeline_response  # noqa: F401
+from k8s_tpu.flight.timeline import (  # noqa: F401
+    DEFAULT_MAX_EVENTS_PER_JOB,
+    DEFAULT_MAX_JOBS,
+    TimelineRecorder,
+)
+from k8s_tpu.flight.watchhealth import (  # noqa: F401
+    RELIST_ERROR,
+    RELIST_EXPIRED,
+    RELIST_INITIAL,
+    RELIST_NO_RV,
+    WatchHealth,
+)
+
+def _bound_from_env(name: str, default: int) -> int:
+    """Positive int from the environment, else the default (garbage and
+    non-positive values fall back — a journal bound of 0 is meaningless)."""
+    import os
+
+    try:
+        n = int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+    return n if n > 0 else default
+
+
+ACCOUNTING = CallAccounting()
+WATCH = WatchHealth()
+# Journal sizing knobs: the worst-case footprint is the PRODUCT of the two
+# bounds (defaults 256 x 8192 ≈ 2M entries ≈ hundreds of MB if every ring
+# of a huge churning fleet actually fills) — operators running very large
+# fleets on small control-plane pods can shrink either bound.
+TIMELINE = TimelineRecorder(
+    max_events_per_job=_bound_from_env("K8S_TPU_TIMELINE_EVENTS_PER_JOB",
+                                       DEFAULT_MAX_EVENTS_PER_JOB),
+    max_jobs=_bound_from_env("K8S_TPU_TIMELINE_JOBS", DEFAULT_MAX_JOBS),
+)
+EVENTS = EventStats()
+
+# Reentrancy guard for account(): composite backend calls (the fake's
+# patch = get + merge + update, delete_collection = list + N deletes)
+# must count as ONE apiserver request, matching what a real apiserver
+# would have seen on the wire for the outermost verb.
+_accounting_depth = threading.local()
+
+
+def record_api_call(verb: str, resource: str, code: int,
+                    seconds: float) -> None:
+    """Account one request attempt directly (the REST client's entry —
+    it times attempts itself because one logical call can be several).
+    Honors the same thread-local guard as :func:`account`, so
+    :func:`suppress_accounting` covers BOTH transports."""
+    if getattr(_accounting_depth, "n", 0):
+        return
+    ACCOUNTING.record(verb, resource, code, seconds)
+
+
+@contextlib.contextmanager
+def account(verb: str, resource: str, success_code: int = 200):
+    """Time and count one backend-protocol call.  The status code is
+    ``success_code`` on success (POST callers pass 201 for wire parity),
+    the ApiError's code on failure, 0 when the failure carries no HTTP
+    status.  Nested accounted calls on the same thread are not
+    double-counted (see the reentrancy note above)."""
+    depth = getattr(_accounting_depth, "n", 0)
+    _accounting_depth.n = depth + 1
+    if depth:
+        try:
+            yield
+        finally:
+            _accounting_depth.n = depth
+        return
+    t0 = time.monotonic()
+    code = success_code
+    try:
+        yield
+    except BaseException as e:
+        code = getattr(e, "code", 0)
+        if not isinstance(code, int):
+            code = 0
+        raise
+    finally:
+        _accounting_depth.n = depth
+        ACCOUNTING.record(verb, resource, code, time.monotonic() - t0)
+
+
+@contextlib.contextmanager
+def suppress_accounting():
+    """Suppress call accounting for calls made on THIS thread (bench
+    fault injection, harness setup traffic).  Thread-local by design:
+    concurrent operator threads keep counting — a global off-switch would
+    race them and silently swallow real operator traffic."""
+    depth = getattr(_accounting_depth, "n", 0)
+    _accounting_depth.n = depth + 1
+    try:
+        yield
+    finally:
+        _accounting_depth.n = depth
+
+
+def timeline(job: str, kind: str, reason: str = "", message: str = "",
+             **attrs) -> None:
+    """Record one lifecycle event on the process-global journal (no-op
+    while the recorder is inactive)."""
+    TIMELINE.record(job, kind, reason=reason, message=message, **attrs)
+
+
+def timeline_response(query: str = "") -> tuple[int, str, str]:
+    """The /debug/timeline endpoint body for the global recorder."""
+    return debug_timeline_response(TIMELINE, query)
+
+
+def reset_all() -> None:
+    """Zero every instrument (benches and tests; the timeline's
+    active/inactive state is preserved — only data is cleared)."""
+    ACCOUNTING.reset()
+    WATCH.reset()
+    TIMELINE.clear()
+    EVENTS.reset()

@@ -1,0 +1,177 @@
+"""Apiserver call accounting (the client half of the flight recorder).
+
+One process-global :class:`CallAccounting` (see ``flight.ACCOUNTING``)
+counts every request either transport issues — ``client/rest.py`` records
+one entry per *wire attempt* (a transport-retried GET is two attempts and
+two counts), and ``client/fake.py`` records one entry per backend-protocol
+call, so benches against the in-process cluster measure the same substrate
+a deployed operator exports.  The counters back the
+``apiserver_requests_total{verb,resource,code}`` and
+``apiserver_request_duration_seconds`` families in ``util/metrics.py``;
+``bench_operator --churn`` asserts flatness on ``total()`` deltas over
+explicit measurement windows (``rate()`` is an in-process debug
+convenience with coarser per-second bucketing).
+
+Verbs are HTTP-shaped with two refinements real operators need for
+steady-state proofs: collection GETs count as ``LIST`` and streaming GETs
+as ``WATCH`` — "zero per-sync LISTs" is only assertable if LIST is a
+label, not a path-parsing exercise.  Transport-level failures (no HTTP
+status ever arrived) count under code ``0``.
+
+Stdlib-only by policy (``harness/py_checks.py`` gates this package): the
+REST client records through here on its request hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+# Histogram bounds for request durations; chosen to match the
+# util.metrics default request-latency buckets so the exported family
+# lines up with the rest of the operator's latency metrics.
+DURATION_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Rolling-rate window state: per-second call buckets, pruned past this
+# horizon.  Coarse on purpose — the in-process rate() reader is a bench /
+# debug convenience, not a precision instrument.
+RATE_HORIZON_S = 120
+
+
+class CallAccounting:
+    """Thread-safe request counters keyed ``(verb, resource, code)`` plus a
+    process-wide duration histogram and a per-second rolling rate."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests: dict[tuple[str, str, int], int] = {}
+        self._bucket_counts = [0] * len(DURATION_BUCKETS)
+        self._duration_sum = 0.0
+        self._duration_count = 0
+        # int(monotonic second) -> calls landed in it (rolling rate source)
+        self._per_second: dict[int, int] = {}
+
+    def record(self, verb: str, resource: str, code: int,
+               seconds: float) -> None:
+        """Account one request attempt.  ``code`` is the HTTP status (0 for
+        transport failures that never produced one)."""
+        key = (str(verb), str(resource), int(code))
+        now_s = int(time.monotonic())
+        with self._lock:
+            self._requests[key] = self._requests.get(key, 0) + 1
+            self._duration_sum += seconds
+            self._duration_count += 1
+            for i, bound in enumerate(DURATION_BUCKETS):
+                if seconds <= bound:
+                    self._bucket_counts[i] += 1
+                    break
+            self._per_second[now_s] = self._per_second.get(now_s, 0) + 1
+            if len(self._per_second) > RATE_HORIZON_S + 2:
+                cutoff = now_s - RATE_HORIZON_S
+                for s in [s for s in self._per_second if s < cutoff]:
+                    del self._per_second[s]
+
+    # -- readers -------------------------------------------------------------
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._requests.values())
+
+    def snapshot(self) -> dict[tuple[str, str, int], int]:
+        """Copy of the ``(verb, resource, code) -> count`` table."""
+        with self._lock:
+            return dict(self._requests)
+
+    def by_verb_resource(self) -> dict[str, int]:
+        """Counts aggregated over status code, keyed ``"VERB resource"`` —
+        the churn-bench artifact's call-breakdown shape."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for (verb, resource, _code), n in self._requests.items():
+                k = f"{verb} {resource}"
+                out[k] = out.get(k, 0) + n
+        return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+    def count(self, verb: Optional[str] = None,
+              resource: Optional[str] = None) -> int:
+        """Total requests matching the given verb and/or resource."""
+        with self._lock:
+            return sum(
+                n for (v, r, _c), n in self._requests.items()
+                if (verb is None or v == verb)
+                and (resource is None or r == resource)
+            )
+
+    def rate(self, window_s: float = 5.0) -> float:
+        """Calls/second over the trailing ``window_s`` (whole seconds,
+        including the current in-progress one — a mid-second read slightly
+        understates a steady stream but never hides just-recorded calls)."""
+        window = max(1, int(window_s))
+        now_s = int(time.monotonic())
+        with self._lock:
+            calls = sum(n for s, n in self._per_second.items()
+                        if now_s - window < s <= now_s)
+        return calls / window
+
+    def duration_stats(self) -> dict:
+        with self._lock:
+            return {
+                "count": self._duration_count,
+                "sum": self._duration_sum,
+                "buckets": {
+                    str(b): c
+                    for b, c in zip(DURATION_BUCKETS, self._bucket_counts)
+                },
+            }
+
+    def duration_samples(self) -> tuple[tuple[float, ...], list[int], float, int]:
+        """(bucket bounds, per-bucket counts, sum, count) for the
+        Prometheus-histogram adapter in util/metrics.py."""
+        with self._lock:
+            return (DURATION_BUCKETS, list(self._bucket_counts),
+                    self._duration_sum, self._duration_count)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._requests.clear()
+            self._bucket_counts = [0] * len(DURATION_BUCKETS)
+            self._duration_sum = 0.0
+            self._duration_count = 0
+            self._per_second.clear()
+
+
+class EventStats:
+    """Recorder-event counters (``events_recorded_total`` /
+    ``events_dropped_total`` / ``events_aggregated_total``): recording must
+    never block or raise on the reconcile path, so the only observability a
+    dropped event gets is this counter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.recorded = 0
+        self.dropped = 0
+        self.aggregated = 0
+
+    def record_recorded(self, n: int = 1) -> None:
+        with self._lock:
+            self.recorded += n
+
+    def record_dropped(self, n: int = 1) -> None:
+        with self._lock:
+            self.dropped += n
+
+    def record_aggregated(self, n: int = 1) -> None:
+        with self._lock:
+            self.aggregated += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"recorded": self.recorded, "dropped": self.dropped,
+                    "aggregated": self.aggregated}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.recorded = self.dropped = self.aggregated = 0

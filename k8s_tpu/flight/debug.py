@@ -1,0 +1,61 @@
+"""/debug/timeline responder (mirror of trace.debug_traces_response and
+scheduler.debug_scheduler_response — ONE implementation shared by the
+metrics server and the dashboard backend, so both speak the same
+contract).
+
+Routes:
+
+- ``/debug/timeline``                     — journal summary (jobs + stats)
+- ``/debug/timeline?job=<ns/name>``       — that job's ordered lifecycle
+- ``?since=<seq>``                        — only entries newer than seq
+  (incremental polling: pass the last seq you saw)
+- ``?n=<limit>``                          — most recent N entries
+
+404 with an explicit body while no controller has activated the recorder
+(same contract as /debug/traces with tracing off).
+"""
+
+from __future__ import annotations
+
+import json
+from urllib.parse import parse_qs
+
+
+def debug_timeline_response(timeline, query: str = "") -> tuple[int, str, str]:
+    """(status_code, body, content_type) for GET /debug/timeline."""
+    if timeline is None or not timeline.active:
+        return (404,
+                "timeline recording inactive (the v2 controller activates "
+                "the flight recorder on startup)\n",
+                "text/plain")
+    params = parse_qs(query or "")
+
+    def _int_param(name: str):
+        raw = (params.get(name) or [None])[0]
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            return None
+
+    job = (params.get("job") or [None])[0]
+    if job:
+        since = _int_param("since")
+        entries = timeline.snapshot(job, since=since,
+                                    limit=_int_param("n"))
+        body = json.dumps({
+            "job": job,
+            "events": entries,
+            "count": len(entries),
+            # an empty incremental poll ECHOES the caller's since — a
+            # last_seq of 0 would make the next ?since=0 poll re-download
+            # the whole ring as apparent new events
+            "last_seq": entries[-1]["seq"] if entries else (since or 0),
+        }, indent=2)
+        return 200, body + "\n", "application/json"
+    body = json.dumps({
+        "jobs": timeline.jobs(),
+        "stats": timeline.stats(),
+    }, indent=2, sort_keys=True)
+    return 200, body + "\n", "application/json"

@@ -1,0 +1,148 @@
+"""Per-job lifecycle timelines (the journal half of the flight recorder).
+
+A bounded ring journal: one deque per job (oldest entries evicted at
+``max_events_per_job``) inside an LRU-bounded job registry (least recently
+*written* job evicted at ``max_jobs``) — a 5k-job churn storm can never
+grow the journal past a fixed footprint.  Entries are stamped with a
+process-wide monotonic sequence number (the ordering key — wall clocks
+can step backwards mid-run) plus a wall timestamp for humans.
+
+The v2 controller records condition transitions (``controller_v2/status``),
+admission/parking/preemption (the scheduler gate), create/delete waves
+(``controller_v2/control``), and recorder events (``client/record``)
+through the process-global ``flight.TIMELINE``; ``/debug/timeline`` on the
+metrics server and dashboard serves it back (``flight/debug.py``).
+
+The recorder starts *inactive* — ``record()`` is a cheap no-op until a
+controller (or test) calls ``activate()``.  This is what gives
+``/debug/timeline`` the same 404-with-explicit-body contract as
+``/debug/traces`` (tracing off) and ``/debug/scheduler`` (no scheduler
+registered).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+
+DEFAULT_MAX_EVENTS_PER_JOB = 256
+DEFAULT_MAX_JOBS = 8192
+
+
+class TimelineRecorder:
+    """Bounded, thread-safe per-job lifecycle journal."""
+
+    def __init__(self, max_events_per_job: int = DEFAULT_MAX_EVENTS_PER_JOB,
+                 max_jobs: int = DEFAULT_MAX_JOBS):
+        if max_events_per_job < 1 or max_jobs < 1:
+            raise ValueError("timeline bounds must be >= 1")
+        self.max_events_per_job = max_events_per_job
+        self.max_jobs = max_jobs
+        self._lock = threading.Lock()
+        self._seq = itertools.count(1)
+        # job key -> deque of entry dicts; OrderedDict gives LRU-by-write
+        self._jobs: "OrderedDict[str, deque]" = OrderedDict()
+        self._active = False
+        self._events_total = 0
+        self._evicted_jobs = 0
+        self._dropped_events = 0  # ring-evicted entries (per-job bound)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def activate(self) -> None:
+        self._active = True
+
+    def deactivate(self) -> None:
+        self._active = False
+
+    # -- writers -------------------------------------------------------------
+
+    def record(self, job: str, kind: str, reason: str = "",
+               message: str = "", **attrs) -> None:
+        """Append one entry to ``job``'s ring.  No-op while inactive; never
+        raises (the callers are reconcile hot paths)."""
+        if not self._active or not job:
+            return
+        entry = {
+            "ts_monotonic": time.monotonic(),
+            "ts_wall": time.time(),
+            "kind": str(kind),
+        }
+        if reason:
+            entry["reason"] = str(reason)
+        if message:
+            entry["message"] = str(message)
+        if attrs:
+            entry["attrs"] = {k: v for k, v in attrs.items()}
+        with self._lock:
+            # seq allocated UNDER the lock: allocating outside would let two
+            # writers to the same job append out of seq order, breaking
+            # snapshot()'s ordering and the ?since= incremental-poll contract
+            entry["seq"] = next(self._seq)
+            ring = self._jobs.get(job)
+            if ring is None:
+                ring = deque(maxlen=self.max_events_per_job)
+                self._jobs[job] = ring
+                if len(self._jobs) > self.max_jobs:
+                    self._jobs.popitem(last=False)
+                    self._evicted_jobs += 1
+            else:
+                self._jobs.move_to_end(job)
+            if len(ring) == ring.maxlen:
+                self._dropped_events += 1
+            ring.append(entry)
+            self._events_total += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._jobs.clear()
+            self._events_total = 0
+            self._evicted_jobs = 0
+            self._dropped_events = 0
+
+    # -- readers -------------------------------------------------------------
+
+    def jobs(self) -> list[str]:
+        with self._lock:
+            return list(self._jobs)
+
+    def snapshot(self, job: str, since: int | None = None,
+                 limit: int | None = None) -> list[dict]:
+        """``job``'s entries ordered by sequence number.  ``since`` keeps
+        only entries with ``seq > since`` (the incremental-poll contract of
+        ``?since=``); ``limit`` keeps the most recent N."""
+        with self._lock:
+            ring = self._jobs.get(job)
+            entries = [dict(e) for e in ring] if ring is not None else []
+        if since is not None:
+            entries = [e for e in entries if e["seq"] > since]
+        if limit is not None and limit >= 0:
+            entries = entries[-limit:] if limit else []
+        return entries
+
+    def stats(self) -> dict:
+        """Journal footprint + per-job depth distribution (the churn-bench
+        "timeline depth stats" artifact field)."""
+        with self._lock:
+            depths = sorted(len(ring) for ring in self._jobs.values())
+            out = {
+                "jobs": len(self._jobs),
+                "events_total": self._events_total,
+                "evicted_jobs": self._evicted_jobs,
+                "dropped_events": self._dropped_events,
+                "max_events_per_job": self.max_events_per_job,
+                "max_jobs": self.max_jobs,
+            }
+        if depths:
+            out["depth_p50"] = depths[len(depths) // 2]
+            out["depth_max"] = depths[-1]
+        else:
+            out["depth_p50"] = 0
+            out["depth_max"] = 0
+        return out

@@ -1,0 +1,129 @@
+"""Watch-stream health (the reflector half of the flight recorder).
+
+``client/informer.py`` records through the process-global instance
+(``flight.WATCH``) so a relist storm is *visible* instead of silent:
+
+- ``record_relist(resource, reason)`` — one full LIST+replace cycle, with
+  why (``initial`` / ``410`` / ``error``);
+- ``record_restart(resource)`` — a watch stream reopened after a previous
+  one ended (the steady state restarts on the server's watch timeout;
+  a restart *spike* means streams are dying early);
+- ``record_event(resource, type)`` — ADDED/MODIFIED/DELETED/ERROR frames
+  delivered;
+- ``stream_started`` / ``stream_ended`` — bounds for the
+  ``watch_stream_age_seconds`` gauge (the series is ABSENT while no
+  stream is open: a resource with no age sample has no live watch,
+  which is itself the signal).
+
+Backing for the ``watch_*`` metric families in ``util/metrics.py`` and
+the relist assertions in ``bench_operator --churn``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+RELIST_INITIAL = "initial"
+RELIST_EXPIRED = "410"
+RELIST_ERROR = "error"
+# resume-free backend (list responses carry no resourceVersion): every
+# clean stream end relists BY DESIGN — a healthy mode, distinguished from
+# "error" so it never reads as a permanent failure signal
+RELIST_NO_RV = "no_rv"
+
+
+class WatchHealth:
+    """Thread-safe per-resource watch/reflector counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._relists: dict[tuple[str, str], int] = {}  # (resource, reason)
+        self._restarts: dict[str, int] = {}
+        self._events: dict[tuple[str, str], int] = {}  # (resource, type)
+        # resource -> {stream token -> start monotonic}.  Token-keyed, not
+        # bare resource: two informers watching the same resource in one
+        # process (leader-failover candidates, embedded layouts) must not
+        # clobber each other's entries — one reflector's teardown popping a
+        # live sibling's stream would read as a false no-watch alarm.  The
+        # exposed age is the OLDEST open stream's.
+        self._streams: dict[str, dict[int, float]] = {}
+        self._stream_tokens = itertools.count(1)
+
+    def record_relist(self, resource: str, reason: str) -> None:
+        key = (str(resource), str(reason))
+        with self._lock:
+            self._relists[key] = self._relists.get(key, 0) + 1
+
+    def record_restart(self, resource: str) -> None:
+        with self._lock:
+            self._restarts[resource] = self._restarts.get(resource, 0) + 1
+
+    def record_event(self, resource: str, event_type: str) -> None:
+        key = (str(resource), str(event_type))
+        with self._lock:
+            self._events[key] = self._events.get(key, 0) + 1
+
+    def stream_started(self, resource: str) -> int:
+        """Register one opened stream; returns the token to pass back to
+        :meth:`stream_ended` when exactly this stream closes."""
+        with self._lock:
+            token = next(self._stream_tokens)
+            self._streams.setdefault(resource, {})[token] = time.monotonic()
+            return token
+
+    def stream_ended(self, resource: str, token: int) -> None:
+        with self._lock:
+            open_streams = self._streams.get(resource)
+            if open_streams is not None:
+                open_streams.pop(token, None)
+                if not open_streams:
+                    del self._streams[resource]
+
+    def _ages_locked(self, now: float) -> dict[str, float]:
+        return {res: now - min(t0s.values())
+                for res, t0s in self._streams.items() if t0s}
+
+    # -- readers -------------------------------------------------------------
+
+    def relists(self, resource: str | None = None,
+                reason: str | None = None) -> int:
+        with self._lock:
+            return sum(
+                n for (res, why), n in self._relists.items()
+                if (resource is None or res == resource)
+                and (reason is None or why == reason)
+            )
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "relists": {f"{res}/{why}": n
+                            for (res, why), n in sorted(self._relists.items())},
+                "restarts": dict(self._restarts),
+                "events": {f"{res}/{etype}": n
+                           for (res, etype), n in sorted(self._events.items())},
+                "stream_age_s": {res: round(age, 3)
+                                 for res, age
+                                 in self._ages_locked(now).items()},
+            }
+
+    def labeled(self) -> dict:
+        """Raw label-keyed tables for the Prometheus adapters."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "relists": dict(self._relists),
+                "restarts": dict(self._restarts),
+                "events": dict(self._events),
+                "stream_age_s": self._ages_locked(now),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._relists.clear()
+            self._restarts.clear()
+            self._events.clear()
+            self._streams.clear()

@@ -621,6 +621,373 @@ def run_contention(args) -> dict:
     }
 
 
+def bench_churn(jobs: int = 2000, replicas: int = 1,
+                fail_frac: float = 0.05, steady_s: float = 2.0,
+                resync_s: float = 1.0, threadiness: int = 4,
+                timeout_s: float = 300.0) -> dict:
+    """The --churn scenario (ISSUE 7): drive ``jobs`` concurrent TFJobs
+    through a create storm, two steady-state windows, and a fail/restart
+    storm against FakeCluster, measuring everything through the flight
+    recorder — the same ``apiserver_requests_total`` /
+    ``watch_relists_total`` substrate a deployed operator exports.
+
+    Embedded assertions (raise on failure — this bench is the scale PROOF
+    of ROADMAP item 1, not advisory trend data):
+
+    - **flatness**: steady-state apiserver calls/sec at N jobs stays flat
+      vs N/2 jobs (the informer steady state is store reads + status
+      no-ops: syncs scale with job count, apiserver calls do NOT);
+    - **zero steady LISTs**: no LIST lands on pods/services/tfjobs/nodes
+      during either steady window (informer listers serve every sync);
+    - **churn cost scales with churn events**: apiserver calls during the
+      restart storm stay under a per-event constant independent of N;
+    - **relists stay at the expected count**: exactly one ``initial``
+      relist per informer, zero 410/error relists through the whole run;
+    - **sync p99 bounded**: steady-state sync latency stays store-bound.
+
+    The returned dict carries the ``{verb,resource}`` call breakdown and
+    the timeline depth stats (the JSON artifact contract).
+    """
+    from k8s_tpu import flight
+    from k8s_tpu.client.gvr import PODS, TFJOBS_V1ALPHA2
+    from k8s_tpu.e2e.local import LocalCluster
+
+    if jobs < 4:
+        raise ValueError("churn needs >= 4 jobs (two ramp phases)")
+    # a window shorter than two resync periods can legitimately see zero
+    # syncs (a tick straddling the window edge) and flake the non-vacuity
+    # guard — the measurement needs at least one full resync cycle inside
+    if steady_s < 2.0 * resync_s:
+        print(json.dumps({
+            "note": "churn steady window raised to 2x the resync period",
+            "requested_steady_s": steady_s,
+            "effective_steady_s": 2.0 * resync_s,
+        }), file=sys.stderr)
+        steady_s = 2.0 * resync_s
+    ns = "bench"
+    flight.reset_all()
+    # phase-tagged per-sync latencies: the steady-window p99 is the
+    # store-bound claim; storm syncs (create waves) are reported separately
+    phase = {"name": "ramp"}
+    sync_samples: list[tuple[str, float]] = []
+
+    lc = LocalCluster(version="v1alpha2", namespace=ns,
+                      enable_gang_scheduling=True,
+                      kubelet_kwargs={"default_runtime_s": 20 * timeout_s},
+                      threadiness=threadiness, resync_period_s=resync_s)
+    # The kubelet simulator's periodic relist fallback is an observer
+    # artifact (a real kubelet is watch-driven; the fallback only covers
+    # dropped streams, which this bench never produces) — park it so the
+    # zero-LIST steady-state assertion measures the OPERATOR, not the
+    # test harness's safety net.
+    lc.kubelet.RELIST_FALLBACK_S = 100 * timeout_s
+    _orig_sync = lc.controller.sync_tfjob
+
+    def _timed_sync(key):
+        t0 = time.perf_counter()
+        try:
+            return _orig_sync(key)
+        finally:
+            sync_samples.append((phase["name"],
+                                 time.perf_counter() - t0))
+
+    lc.controller.sync_tfjob = _timed_sync
+
+    acct = flight.ACCOUNTING
+
+    def _list_total() -> int:
+        return acct.count(verb="LIST")
+
+    def _steady_window(label: str) -> dict:
+        """One measurement window: no bench-side API traffic at all —
+        only the operator's own steady state lands in the accounting."""
+        phase["name"] = label
+        c0, l0, s0 = acct.total(), _list_total(), len(sync_samples)
+        time.sleep(steady_s)
+        calls = acct.total() - c0
+        return {
+            "calls": calls,
+            "calls_per_sec": round(calls / steady_s, 2),
+            "lists": _list_total() - l0,
+            "syncs": len(sync_samples) - s0,
+        }
+
+    with lc:
+        jw = lc.backend.watch(TFJOBS_V1ALPHA2, ns)
+        pw = lc.backend.watch(PODS, ns)
+        try:
+            ready: set[str] = set()
+            # pod name -> (phase, owning job name): fed by the pod watch so
+            # the bench never LISTs during a measurement window
+            pod_state: dict[str, tuple[str, str]] = {}
+
+            def _apply_pod(et: str, pod: dict) -> None:
+                pname = (pod.get("metadata") or {}).get("name")
+                owner = next(
+                    (r.get("name") for r in
+                     (pod.get("metadata") or {}).get(
+                         "ownerReferences") or []), "")
+                if et == "DELETED":
+                    pod_state.pop(pname, None)
+                else:
+                    pod_state[pname] = (
+                        (pod.get("status") or {}).get("phase", ""), owner)
+
+            def _pump(deadline: float, pred, what: str) -> None:
+                while not pred():
+                    if time.perf_counter() >= deadline:
+                        raise RuntimeError(
+                            f"churn bench: {what} not reached in "
+                            f"{timeout_s}s ({len(ready)} ready)")
+                    progressed = False
+                    item = jw.next(timeout=0.05)
+                    if item is not None:
+                        _et, job = item
+                        name = (job.get("metadata") or {}).get("name")
+                        if _all_replicas_running(job):
+                            ready.add(name)
+                        progressed = True
+                    # drain the pod queue fully: one-event-per-iteration
+                    # behind a 50ms job-watch block would throttle pod
+                    # state to ~20 events/s and inflate churn recovery
+                    while True:
+                        item = pw.next(timeout=0.001)
+                        if item is None:
+                            break
+                        _apply_pod(*item)
+                        progressed = True
+                    if not progressed:
+                        time.sleep(0.005)
+
+            def _create(names: list[str]) -> None:
+                for name in names:
+                    lc.clientset.tfjobs_unstructured(ns).create(
+                        _tpu_job(name, ns, replicas))
+
+            all_names = [f"churn-{i}" for i in range(jobs)]
+            half = jobs // 2
+
+            phase["name"] = "ramp_half"
+            t_ramp0 = time.perf_counter()
+            _create(all_names[:half])
+            _pump(time.perf_counter() + timeout_s,
+                  lambda: len(ready) >= half, "first ramp Running")
+            ramp_half_s = time.perf_counter() - t_ramp0
+
+            steady_half = _steady_window("steady_half")
+
+            phase["name"] = "ramp_full"
+            t_ramp1 = time.perf_counter()
+            _create(all_names[half:])
+            _pump(time.perf_counter() + timeout_s,
+                  lambda: len(ready) >= jobs, "full ramp Running")
+            ramp_full_s = time.perf_counter() - t_ramp1
+
+            steady_full = _steady_window("steady_full")
+
+            # -- churn storm: fail one pod of each victim job -------------
+            # drain the pod watch first: readiness is tracked off the JOB
+            # watch, so pod MODIFIED events can still be queued when the
+            # ramp predicate flips — victim selection needs them applied
+            while True:
+                item = pw.next(timeout=0.05)
+                if item is None:
+                    break
+                _apply_pod(*item)
+            n_events = max(1, int(jobs * fail_frac))
+            victims = all_names[:n_events]
+            victim_set = set(victims)
+            incumbent = {
+                pname for pname, (_ph, owner) in pod_state.items()
+                if owner in victim_set
+            }
+            victim_pod = {}
+            for pname, (ph, owner) in pod_state.items():
+                if owner in victim_set and ph == "Running":
+                    victim_pod.setdefault(owner, pname)
+            missing = victim_set - set(victim_pod)
+            if missing:
+                raise RuntimeError(
+                    f"churn bench: no Running pod tracked for "
+                    f"{len(missing)} victim job(s): {sorted(missing)[:5]}")
+            phase["name"] = "churn"
+            c0 = acct.total()
+            t_churn0 = time.perf_counter()
+            # Fault injection runs UNACCOUNTED: set_pod_phase (a GET + PUT
+            # per victim) has no real-world analogue — an actual pod
+            # failure costs the apiserver nothing.  Everything else in the
+            # churn window stays counted, because it all exists in a real
+            # deployment: the operator's deletes/creates/status/events AND
+            # the kubelet's Running-status PATCH per recovered pod.
+            # Thread-local suppression, NOT the backend-wide flag: the
+            # operator's worker threads react to the first victims while
+            # later ones are still being injected, and their calls must
+            # keep counting.
+            with flight.suppress_accounting():
+                for owner, pname in victim_pod.items():
+                    lc.backend.set_pod_phase(
+                        ns, pname, "Failed",
+                        containerStatuses=[{
+                            "name": "tensorflow",
+                            "state": {"terminated": {"exitCode": 143}},
+                        }])
+
+            def _recovered() -> bool:
+                per_job: dict[str, int] = {}
+                for pname, (ph, owner) in pod_state.items():
+                    if (owner in victim_set and ph == "Running"
+                            and pname not in incumbent):
+                        per_job[owner] = per_job.get(owner, 0) + 1
+                return all(per_job.get(v, 0) >= replicas for v in victims)
+
+            _pump(time.perf_counter() + timeout_s, _recovered,
+                  "churned gangs re-Running")
+            churn_s = time.perf_counter() - t_churn0
+            churn_calls = acct.total() - c0
+
+            steady_post = _steady_window("steady_post")
+            # syncs completing during cluster teardown must not be tagged
+            # into the last steady window's p99 (a teardown-slowed sync
+            # would spuriously fail the store-bound assertion)
+            phase["name"] = "teardown"
+        finally:
+            jw.stop()
+            pw.stop()
+
+    # -- assemble + assert ---------------------------------------------------
+    steady_syncs = sorted(
+        dt for ph, dt in sync_samples
+        if ph in ("steady_half", "steady_full", "steady_post"))
+    all_syncs = sorted(dt for _ph, dt in sync_samples)
+    relists = flight.WATCH.snapshot()["relists"]
+    relists_initial = flight.WATCH.relists(reason=flight.RELIST_INITIAL)
+    relists_bad = (flight.WATCH.relists(reason=flight.RELIST_EXPIRED)
+                   + flight.WATCH.relists(reason=flight.RELIST_ERROR))
+    # 4 informers (tfjobs, pods, services, nodes) list exactly once each
+    expected_initial = 4
+    per_event_calls = churn_calls / n_events
+    steady_sync_p99 = _quantile(steady_syncs, 0.99)
+    rate_half = steady_half["calls_per_sec"]
+    rate_full = steady_full["calls_per_sec"]
+    # flatness: going from N/2 to N jobs must not scale the steady-state
+    # call rate.  An O(N) regression would DOUBLE the rate, so the
+    # tolerance must sit well under 2x (1.25x; the floor of 5 calls/s
+    # absorbs timing noise around the expected zero).
+    flat_ok = rate_full <= max(1.25 * rate_half, 5.0)
+
+    failures = []
+    if not flat_ok:
+        failures.append(
+            f"steady calls/sec not flat: {rate_half} at {half} jobs -> "
+            f"{rate_full} at {jobs} jobs")
+    if steady_half["lists"] or steady_full["lists"] or steady_post["lists"]:
+        failures.append(
+            f"steady-state LISTs detected (informer bypass): "
+            f"{steady_half['lists']}/{steady_full['lists']}"
+            f"/{steady_post['lists']}")
+    if (steady_half["syncs"] + steady_full["syncs"]
+            + steady_post["syncs"]) <= 0:
+        failures.append("no syncs during any steady window (resync dead — "
+                        "the zero-LIST result would be vacuous)")
+    if relists_initial != expected_initial or relists_bad:
+        failures.append(
+            f"relists off: {relists} (expected exactly {expected_initial} "
+            f"initial, zero 410/error)")
+    if per_event_calls > 40 * max(1, replicas):
+        failures.append(
+            f"churn cost not event-bound: {per_event_calls:.1f} "
+            f"calls/event for {n_events} events")
+    if steady_sync_p99 > 0.25:
+        failures.append(
+            f"steady sync p99 {steady_sync_p99:.3f}s not store-bound")
+
+    # the acceptance artifact: one victim's ordered lifecycle exists
+    sample_job = f"{ns}/{victims[0]}"
+    sample_timeline = flight.TIMELINE.snapshot(sample_job)
+    result = {
+        "jobs": jobs,
+        "replicas": replicas,
+        "churn_events": n_events,
+        "ramp_half_s": round(ramp_half_s, 2),
+        "ramp_full_s": round(ramp_full_s, 2),
+        "jobs_per_sec": round(jobs / (ramp_half_s + ramp_full_s), 1),
+        "steady_half": steady_half,
+        "steady_full": steady_full,
+        "steady_post": steady_post,
+        "steady_calls_per_sec_flat": flat_ok,
+        "churn_s": round(churn_s, 2),
+        "churn_calls": churn_calls,
+        "churn_calls_per_event": round(per_event_calls, 1),
+        "sync_count": len(all_syncs),
+        "sync_latency_p50_s": round(_quantile(all_syncs, 0.50), 4),
+        "sync_latency_p99_s": round(_quantile(all_syncs, 0.99), 4),
+        "steady_sync_p99_s": round(steady_sync_p99, 4),
+        "relists": relists,
+        "watch": flight.WATCH.snapshot(),
+        "apiserver_calls_total": acct.total(),
+        "apiserver_calls_by_verb_resource": acct.by_verb_resource(),
+        "timeline_stats": flight.TIMELINE.stats(),
+        "sample_job": sample_job,
+        "sample_timeline_kinds": [e["kind"] for e in sample_timeline],
+    }
+    if failures:
+        # the measurements are attached to the error so the caller can
+        # still write the artifact — a churn regression with no artifact
+        # to debug from would defeat the point of the recorder
+        result["failures"] = failures
+        err = RuntimeError("churn bench assertions failed:\n  "
+                           + "\n  ".join(failures))
+        err.result = result
+        raise err
+    return result
+
+
+def _write_artifact(path: str | None, payload: dict) -> None:
+    """One JSON-line bench artifact writer (churn + serve share it)."""
+    if not path:
+        return
+    import os
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps(payload) + "\n")
+
+
+def run_churn(args) -> dict:
+    """The --churn scenario wrapper (bench.py contract: one JSON-able dict
+    with a metric/value/unit headline).  The JSON artifact is written on
+    failure too — with a ``failures`` field — so a churn regression in the
+    non-gating CI tier leaves the numbers behind for whoever debugs it."""
+    try:
+        r = bench_churn(
+            jobs=args.churn_jobs,
+            replicas=args.churn_replicas,
+            fail_frac=args.churn_fail_frac,
+            steady_s=args.churn_steady,
+            resync_s=args.churn_resync,
+            threadiness=args.churn_threadiness,
+            timeout_s=max(args.timeout, 120.0),
+        )
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write_artifact(args.churn_out, {
+                "metric": "churn_steady_calls_per_sec",
+                "value": partial["steady_full"]["calls_per_sec"],
+                "unit": "calls/sec",
+                **partial,
+            })
+        raise
+    out = {
+        "metric": "churn_steady_calls_per_sec",
+        "value": r["steady_full"]["calls_per_sec"],
+        "unit": "calls/sec",
+        **r,
+    }
+    _write_artifact(args.churn_out, out)
+    return out
+
+
 def run_serve(args) -> dict:
     """The --serve scenario wrapper: the continuous-batching serving
     bench (harness/bench_serve.py — single-flight vs batched tokens/s
@@ -636,12 +1003,7 @@ def run_serve(args) -> dict:
         max_new_long=args.serve_max_new_long,
         sampled=bool(args.serve_sampled),
         shared_frac=args.serve_shared_frac)
-    if args.serve_out:
-        import os
-
-        os.makedirs(os.path.dirname(args.serve_out) or ".", exist_ok=True)
-        with open(args.serve_out, "w") as f:
-            f.write(json.dumps(result) + "\n")
+    _write_artifact(args.serve_out, result)
     return result
 
 
@@ -799,6 +1161,36 @@ def main(argv=None) -> int:
     p.add_argument("--serve-out", default=None,
                    help="also write the --serve JSON result to this path "
                    "(bench artifact)")
+    p.add_argument("--churn", action="store_true",
+                   help="run the churn-at-scale scenario (ISSUE 7): "
+                   "--churn-jobs concurrent TFJobs through a create storm, "
+                   "steady-state windows at N/2 and N jobs, and a "
+                   "fail/restart storm, measured through the flight "
+                   "recorder; EMBEDDED ASSERTIONS (steady apiserver "
+                   "calls/sec flat vs job count, zero steady-state LISTs, "
+                   "churn cost bounded per event, relists at the expected "
+                   "count, sync p99 store-bound) fail the bench; emits one "
+                   "JSON line with the {verb,resource} call breakdown and "
+                   "timeline depth stats; combinable with other scenarios")
+    p.add_argument("--churn-jobs", type=int, default=2000,
+                   help="concurrent TFJobs for --churn (the scale proof "
+                   "target is 2000-5000)")
+    p.add_argument("--churn-replicas", type=int, default=1,
+                   help="TPU replicas per churn job")
+    p.add_argument("--churn-fail-frac", type=float, default=0.05,
+                   help="fraction of jobs whose gang is failed in the "
+                   "churn storm")
+    p.add_argument("--churn-steady", type=float, default=2.0,
+                   help="seconds per steady-state measurement window")
+    p.add_argument("--churn-resync", type=float, default=1.0,
+                   help="informer resync period for --churn (every job "
+                   "resyncs each period; proves steady syncs do zero "
+                   "apiserver calls)")
+    p.add_argument("--churn-threadiness", type=int, default=4,
+                   help="controller worker threads for --churn")
+    p.add_argument("--churn-out", default=None,
+                   help="also write the --churn JSON result to this path "
+                   "(bench artifact)")
     p.add_argument("--trace", action="store_true",
                    help="force tracing on (sample rate 1.0) and append a "
                    "per-stage p50/p99 breakdown ('stages') to the JSON "
@@ -814,13 +1206,13 @@ def main(argv=None) -> int:
         trace.configure(sample_rate=1.0)
 
     if args.slice_scale or args.measure_restart or args.contention \
-            or args.serve:
+            or args.serve or args.churn:
         if args.backend != "fake" and (args.slice_scale
                                        or args.measure_restart
-                                       or args.contention):
-            p.error("--slice-scale/--measure-restart/--contention require "
-                    "--backend fake: the injected RTTs and the capacity "
-                    "knob only exist on the in-process cluster")
+                                       or args.contention or args.churn):
+            p.error("--slice-scale/--measure-restart/--contention/--churn "
+                    "require --backend fake: the injected RTTs and the "
+                    "capacity knob only exist on the in-process cluster")
         if args.create_latency is None:
             args.create_latency = 0.01
         if args.delete_latency is None:
@@ -832,6 +1224,10 @@ def main(argv=None) -> int:
             results.append(run_measure_restart(args))
         if args.contention:
             results.append(run_contention(args))
+        if args.churn:
+            # last operator scenario: it resets the flight counters, so
+            # earlier scenarios' accounting must already be consumed
+            results.append(run_churn(args))
         if args.serve:
             results.append(run_serve(args))
         if args.trace:

@@ -26,8 +26,12 @@ EXCLUDE_DIRS = {".git", "__pycache__", ".eggs", "build", "vendor", "node_modules
 # Packages that must stay stdlib-only (plus themselves): trace/ rides the
 # REST client's request hot path; scheduler/ (ISSUE 4) holds cross-job
 # admission state consulted from every sync and is served by two HTTP
-# processes — neither may grow a third-party (or even intra-repo) import.
-STDLIB_ONLY_PACKAGES = ("k8s_tpu.trace", "k8s_tpu.scheduler")
+# processes; flight/ (ISSUE 7) is the control-plane flight recorder — call
+# accounting on the REST request hot path, watch health in the reflector
+# loop, lifecycle timelines served by two HTTP processes.  None may grow a
+# third-party (or even intra-repo) import.
+STDLIB_ONLY_PACKAGES = ("k8s_tpu.trace", "k8s_tpu.scheduler",
+                        "k8s_tpu.flight")
 
 
 def check_stdlib_only(path: str, source: bytes | None = None,

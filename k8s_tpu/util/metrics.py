@@ -259,7 +259,153 @@ class Registry:
         return "\n".join(lines) + "\n" if lines else ""
 
 
+class ProxyMetric(_Metric):
+    """A metric whose samples are computed at collect time from an external
+    source (the flight recorder's own counters): ``sample_fn(name)`` yields
+    fully-formatted exposition lines.  Unlike the callable-Gauge shortcut
+    this supports labeled families and histograms, which is what the
+    apiserver/watch adapters need."""
+
+    def __init__(self, name, help_text, kind, sample_fn):
+        super().__init__(name, help_text)
+        self.kind = kind
+        self._sample_fn = sample_fn
+
+    def collect(self):
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        yield from self._sample_fn(self.name)
+
+
 REGISTRY = Registry()
+
+
+# --- flight-recorder exposition (ISSUE 7) -----------------------------------
+#
+# The flight recorder (k8s_tpu.flight) keeps its own counters — it is
+# stdlib-only by policy and may not import this module — so exposition is a
+# set of ProxyMetric adapters reading its snapshots at scrape time.  The v2
+# controller registers the family on construction; benches read the flight
+# counters directly (same substrate, no scrape needed).
+
+
+def flight_metrics(registry: Optional[Registry] = None) -> dict:
+    """Register the apiserver call-accounting, watch-stream health, and
+    event-recorder families backed by ``k8s_tpu.flight``'s process-global
+    instruments.  Idempotent (the registry dedupes by name)."""
+    from k8s_tpu import flight
+
+    r = registry or REGISTRY
+
+    def _requests(name):
+        for (verb, resource, code), n in sorted(
+                flight.ACCOUNTING.snapshot().items()):
+            labels = _format_labels(("verb", "resource", "code"),
+                                    (verb, resource, str(code)))
+            yield f"{name}{labels} {_format_value(n)}"
+
+    def _request_duration(name):
+        bounds, counts, total, count = flight.ACCOUNTING.duration_samples()
+        cumulative = 0
+        for bound, c in zip(bounds, counts):
+            cumulative += c
+            labels = _format_labels(("le",), (_format_value(bound),))
+            yield f"{name}_bucket{labels} {cumulative}"
+        yield f"{name}_bucket{{le=\"+Inf\"}} {count}"
+        yield f"{name}_sum {_format_value(total)}"
+        yield f"{name}_count {count}"
+
+    def _relists(name):
+        for (resource, reason), n in sorted(
+                flight.WATCH.labeled()["relists"].items()):
+            labels = _format_labels(("resource", "reason"), (resource, reason))
+            yield f"{name}{labels} {_format_value(n)}"
+
+    def _restarts(name):
+        for resource, n in sorted(flight.WATCH.labeled()["restarts"].items()):
+            yield (f"{name}{_format_labels(('resource',), (resource,))} "
+                   f"{_format_value(n)}")
+
+    def _watch_events(name):
+        for (resource, etype), n in sorted(
+                flight.WATCH.labeled()["events"].items()):
+            labels = _format_labels(("resource", "type"), (resource, etype))
+            yield f"{name}{labels} {_format_value(n)}"
+
+    def _stream_age(name):
+        for resource, age in sorted(
+                flight.WATCH.labeled()["stream_age_s"].items()):
+            yield (f"{name}{_format_labels(('resource',), (resource,))} "
+                   f"{_format_value(round(age, 3))}")
+
+    def _event_counter(field):
+        def sample(name):
+            yield f"{name} {_format_value(flight.EVENTS.snapshot()[field])}"
+        return sample
+
+    def _timeline_gauge(field):
+        def sample(name):
+            yield f"{name} {_format_value(flight.TIMELINE.stats()[field])}"
+        return sample
+
+    return {
+        "requests": r.register(ProxyMetric(
+            "apiserver_requests_total",
+            "Apiserver requests by verb/resource/HTTP status (one count "
+            "per wire attempt; code 0 = transport failure; collection "
+            "GETs count as LIST, streaming GETs as WATCH).",
+            "counter", _requests)),
+        "duration": r.register(ProxyMetric(
+            "apiserver_request_duration_seconds",
+            "Apiserver request attempt latency.",
+            "histogram", _request_duration)),
+        "relists": r.register(ProxyMetric(
+            "watch_relists_total",
+            "Reflector full-relist cycles by resource and reason "
+            "(initial / 410 / error / no_rv).  Beyond the initial lists, "
+            "410 and error mean watch gaps; no_rv is the by-design "
+            "per-cycle relist of a backend that mints no resourceVersions.",
+            "counter", _relists)),
+        "restarts": r.register(ProxyMetric(
+            "watch_restarts_total",
+            "Watch streams reopened after a previous one ended (the "
+            "steady state restarts on the server's watch timeout; a "
+            "spike means streams are dying early).",
+            "counter", _restarts)),
+        "watch_events": r.register(ProxyMetric(
+            "watch_events_total",
+            "Watch events delivered to reflectors, by resource and type.",
+            "counter", _watch_events)),
+        "stream_age": r.register(ProxyMetric(
+            "watch_stream_age_seconds",
+            "Age of each resource's live watch stream (absent = no open "
+            "stream).",
+            "gauge", _stream_age)),
+        "events_recorded": r.register(ProxyMetric(
+            "events_recorded_total",
+            "K8s Events accepted by the recorder (buffered enqueue on the "
+            "async recorder; not necessarily posted yet).",
+            "counter", _event_counter("recorded"))),
+        "events_dropped": r.register(ProxyMetric(
+            "events_dropped_total",
+            "K8s Events lost by the recorder — queue overflow, post-close "
+            "sends, or failed apiserver posts (counted, never raised).",
+            "counter", _event_counter("dropped"))),
+        "events_aggregated": r.register(ProxyMetric(
+            "events_aggregated_total",
+            "Exact-repeat events folded into an existing Event object by "
+            "count/lastTimestamp bump instead of a fresh create.",
+            "counter", _event_counter("aggregated"))),
+        "timeline_jobs": r.register(ProxyMetric(
+            "timeline_jobs_tracked",
+            "Jobs with entries in the flight-recorder lifecycle journal.",
+            "gauge", _timeline_gauge("jobs"))),
+        "timeline_events": r.register(ProxyMetric(
+            "timeline_events_recorded_total",
+            "Lifecycle events recorded into the journal (including "
+            "ring-evicted entries).",
+            "counter", _timeline_gauge("events_total"))),
+    }
 
 
 # --- the operator's own telemetry (consumed by controllers and dashboard) ---

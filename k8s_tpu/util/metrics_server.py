@@ -108,6 +108,15 @@ class MetricsServer:
 
                     code, body, ctype = scheduler_mod.debug_response(query)
                     return self._send(code, body, ctype)
+                if path == "/debug/timeline":
+                    # flight-recorder lifecycle journal: ?job=<ns/name>
+                    # for one job's ordered events, ?since=/?n= filters
+                    # (404 with an explicit body until a controller
+                    # activates the recorder — /debug/traces parity)
+                    from k8s_tpu import flight
+
+                    code, body, ctype = flight.timeline_response(query)
+                    return self._send(code, body, ctype)
                 return self._send(404, "not found\n", "text/plain")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

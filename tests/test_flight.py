@@ -1,0 +1,575 @@
+"""Flight-recorder tests (ISSUE 7): timeline ordering/bounding/eviction
+under concurrent writers, apiserver call-accounting label correctness
+(including one-count-per-wire-attempt across transport retries), watch
+health through forced 410s, /debug/timeline 404-when-inactive parity with
+/debug/traces and /debug/scheduler, event-recorder aggregation/drop
+counters, and the churn bench at smoke scale."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_tpu import flight
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.errors import ApiError
+from k8s_tpu.client.fake import FakeCluster
+from k8s_tpu.client.gvr import PODS
+from k8s_tpu.flight.timeline import TimelineRecorder
+
+
+# -- timeline ----------------------------------------------------------------
+
+
+class TestTimeline:
+    def _active(self, **kw) -> TimelineRecorder:
+        t = TimelineRecorder(**kw)
+        t.activate()
+        return t
+
+    def test_entries_ordered_and_since_filters(self):
+        t = self._active()
+        for i in range(5):
+            t.record("ns/j", "step", message=f"m{i}")
+        entries = t.snapshot("ns/j")
+        assert [e["message"] for e in entries] == [f"m{i}" for i in range(5)]
+        seqs = [e["seq"] for e in entries]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 5
+        newer = t.snapshot("ns/j", since=seqs[2])
+        assert [e["message"] for e in newer] == ["m3", "m4"]
+        assert t.snapshot("ns/j", limit=2) == entries[-2:]
+
+    def test_per_job_ring_bound_evicts_oldest(self):
+        t = self._active(max_events_per_job=4)
+        for i in range(10):
+            t.record("ns/j", "step", message=f"m{i}")
+        entries = t.snapshot("ns/j")
+        assert [e["message"] for e in entries] == ["m6", "m7", "m8", "m9"]
+        assert t.stats()["dropped_events"] == 6
+        assert t.stats()["events_total"] == 10
+
+    def test_job_registry_lru_eviction(self):
+        t = self._active(max_jobs=2)
+        t.record("ns/a", "x")
+        t.record("ns/b", "x")
+        t.record("ns/a", "y")  # a becomes most recent
+        t.record("ns/c", "x")  # evicts b (least recently written)
+        assert set(t.jobs()) == {"ns/a", "ns/c"}
+        assert t.snapshot("ns/b") == []
+        assert t.stats()["evicted_jobs"] == 1
+
+    def test_inactive_recorder_is_a_noop(self):
+        t = TimelineRecorder()
+        t.record("ns/j", "step")
+        assert t.jobs() == []
+        t.activate()
+        t.record("ns/j", "step")
+        assert t.jobs() == ["ns/j"]
+
+    def test_concurrent_writers_keep_order_and_counts(self):
+        t = self._active(max_events_per_job=64)
+        n_threads, per_thread = 8, 200
+
+        def writer(tid):
+            for i in range(per_thread):
+                t.record(f"ns/own-{tid}", "step", i=i)
+                t.record("ns/shared", "step", tid=tid, i=i)
+
+        threads = [threading.Thread(target=writer, args=(tid,))
+                   for tid in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        stats = t.stats()
+        assert stats["events_total"] == n_threads * per_thread * 2
+        # per-thread jobs kept their bound; entries stay seq-ordered
+        for tid in range(n_threads):
+            entries = t.snapshot(f"ns/own-{tid}")
+            assert len(entries) == 64
+            seqs = [e["seq"] for e in entries]
+            assert seqs == sorted(seqs)
+            # ring kept the NEWEST 64 of this thread's writes
+            assert [e["attrs"]["i"] for e in entries] == list(
+                range(per_thread - 64, per_thread))
+        shared = t.snapshot("ns/shared")
+        assert len(shared) == 64
+        seqs = [e["seq"] for e in shared]
+        assert seqs == sorted(seqs)
+
+
+# -- call accounting ---------------------------------------------------------
+
+
+class TestCallAccounting:
+    def test_labels_and_aggregation(self):
+        flight.reset_all()
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        cs.pods("ns").create({"metadata": {"name": "p1"}})
+        cs.pods("ns").get("p1")
+        cs.pods("ns").list()
+        with pytest.raises(ApiError):
+            cs.pods("ns").get("missing")
+        snap = flight.ACCOUNTING.snapshot()
+        # wire-parity code labels: a create is a 201 on a real apiserver
+        assert snap[("POST", "pods", 201)] == 1
+        assert snap[("GET", "pods", 200)] == 1
+        assert snap[("LIST", "pods", 200)] == 1
+        assert snap[("GET", "pods", 404)] == 1
+        assert flight.ACCOUNTING.count(verb="GET", resource="pods") == 2
+        assert flight.ACCOUNTING.by_verb_resource()["GET pods"] == 2
+        assert flight.ACCOUNTING.duration_stats()["count"] == 4
+
+    def test_composite_fake_calls_count_once(self):
+        """patch = get + merge + update inside the fake, but a real
+        apiserver saw ONE PATCH — the reentrancy guard keeps it at one."""
+        flight.reset_all()
+        fc = FakeCluster()
+        cs = Clientset(fc)
+        cs.pods("ns").create({"metadata": {"name": "p1"}})
+        cs.pods("ns").patch("p1", {"status": {"phase": "Running"}})
+        by = flight.ACCOUNTING.by_verb_resource()
+        assert by == {"POST pods": 1, "PATCH pods": 1}
+
+    def test_account_context_captures_api_error_code(self):
+        flight.reset_all()
+        with pytest.raises(ApiError):
+            with flight.account("GET", "pods"):
+                raise ApiError(409, "Conflict", "boom")
+        with pytest.raises(ValueError):
+            with flight.account("GET", "pods"):
+                raise ValueError("no http status here")
+        snap = flight.ACCOUNTING.snapshot()
+        assert snap[("GET", "pods", 409)] == 1
+        assert snap[("GET", "pods", 0)] == 1
+
+    def test_rest_transport_retry_counts_each_attempt(self):
+        """One wire attempt = one count: a GET whose first connection dies
+        before any response must show up as code-0 AND code-200 entries."""
+        from k8s_tpu.client.rest import ClusterConfig, RestClient
+
+        flight.reset_all()
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(5)
+        port = srv.getsockname()[1]
+        body = json.dumps({"kind": "Pod", "metadata": {"name": "p"}}).encode()
+        resp = (b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                + b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+
+        def serve():
+            # first connection: slam shut before answering (transport error)
+            c1, _ = srv.accept()
+            c1.close()
+            # second connection: one proper keep-alive response
+            c2, _ = srv.accept()
+            c2.recv(65536)
+            c2.sendall(resp)
+            c2.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        try:
+            client = RestClient(ClusterConfig(host=f"http://127.0.0.1:{port}"))
+            got = client.get(PODS, "ns", "p")
+            assert got["metadata"]["name"] == "p"
+        finally:
+            srv.close()
+        snap = flight.ACCOUNTING.snapshot()
+        assert snap[("GET", "pods", 0)] == 1, snap
+        assert snap[("GET", "pods", 200)] == 1, snap
+
+    def test_rest_verbs_list_and_watch(self):
+        from k8s_tpu.client.rest import _verb_and_resource
+
+        def verb(method, path):
+            return _verb_and_resource(method, path)[0]
+
+        assert verb("GET", "/api/v1/namespaces/ns/pods") == "LIST"
+        assert verb("GET", "/api/v1/namespaces/ns/pods/p") == "GET"
+        assert verb("GET", "/api/v1/namespaces/ns/pods?watch=true") == "WATCH"
+        assert verb("POST", "/api/v1/namespaces/ns/pods") == "POST"
+        # LIST is decided by path SHAPE: an object legally named like its
+        # plural is still a single-object GET, not a phantom LIST
+        assert _verb_and_resource(
+            "GET", "/api/v1/namespaces/ns/pods/pods") == ("GET", "pods")
+        # cluster-scoped + group-scoped shapes
+        assert _verb_and_resource("GET", "/api/v1/nodes") == ("LIST", "nodes")
+        assert _verb_and_resource("GET", "/api/v1/nodes/n1") == ("GET", "nodes")
+        assert _verb_and_resource(
+            "GET", "/apis/kubeflow.org/v1alpha2/namespaces/ns/tfjobs"
+        ) == ("LIST", "tfjobs")
+        assert _verb_and_resource(
+            "GET", "/api/v1/namespaces") == ("LIST", "namespaces")
+        assert _verb_and_resource(
+            "GET", "/api/v1/namespaces/ns") == ("GET", "namespaces")
+        # a cluster-scoped object literally named "namespaces" (legal DNS
+        # name for a node) is a single-object GET, not LIST namespaces
+        assert _verb_and_resource(
+            "GET", "/api/v1/nodes/namespaces") == ("GET", "nodes")
+        # proxy-fronted apiserver: base path before the api root
+        assert _verb_and_resource(
+            "GET", "/k8s/clusters/c-abc/api/v1/namespaces/ns/pods"
+        ) == ("LIST", "pods")
+
+    def test_rolling_rate_window(self):
+        acct = flight.CallAccounting()
+        for _ in range(10):
+            acct.record("GET", "pods", 200, 0.001)
+        # all 10 calls landed within the horizon; a wide window sees them
+        assert acct.rate(window_s=60) * 60 >= 9
+
+
+# -- watch-stream health -----------------------------------------------------
+
+
+class _DelegatingBackend:
+    """FakeCluster wrapper with scriptable watch failures."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.expire_watches = 0  # raise 410 on the next N watch() calls
+        self.scripted_watch = None  # one-shot canned watch object
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def watch(self, resource, namespace=None, resource_version=None):
+        from k8s_tpu.client import errors
+
+        if self.expire_watches > 0:
+            self.expire_watches -= 1
+            raise errors.expired("resourceVersion too old (scripted)")
+        if self.scripted_watch is not None:
+            w, self.scripted_watch = self.scripted_watch, None
+            return w
+        return self.inner.watch(resource, namespace, resource_version)
+
+
+class _ScriptedWatch:
+    def __init__(self, events):
+        self._events = list(events)
+        self.stopped = False
+
+    def next(self, timeout=None):
+        if self._events:
+            return self._events.pop(0)
+        self.stopped = True
+        return None
+
+    def stop(self):
+        self.stopped = True
+
+
+def _wait_for(pred, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.01)
+
+
+class TestWatchHealth:
+    def test_counters_through_forced_410(self):
+        from k8s_tpu.client.informer import SharedInformer
+
+        flight.reset_all()
+        backend = _DelegatingBackend(FakeCluster())
+        cs = Clientset(backend.inner)
+        cs.pods("ns").create({"metadata": {"name": "p0"}})
+        inf = SharedInformer(backend, PODS, resync_period=0)
+        inf.run()
+        try:
+            assert inf.wait_for_cache_sync(5)
+            assert flight.WATCH.relists(
+                resource="pods", reason=flight.RELIST_INITIAL) == 1
+            # a live stream exists and its age gauge is exposed
+            _wait_for(lambda: "pods" in flight.WATCH.snapshot()["stream_age_s"],
+                      what="live stream age")
+            # force a 410 on the next watch open: end the current stream
+            backend.expire_watches = 1
+            with inf._watch_lock:
+                inf._active_watch.stop()
+            _wait_for(lambda: flight.WATCH.relists(
+                resource="pods", reason=flight.RELIST_EXPIRED) == 1,
+                what="410 relist")
+            # the reflector recovered: restart counted, stream live again
+            _wait_for(lambda: flight.WATCH.snapshot()["restarts"].get(
+                "pods", 0) >= 1, what="watch restart counter")
+            # events flow on the recovered stream
+            cs.pods("ns").create({"metadata": {"name": "p1"}})
+            _wait_for(lambda: flight.WATCH.snapshot()["events"].get(
+                "pods/ADDED", 0) >= 1, what="ADDED event counter")
+        finally:
+            inf.stop()
+
+    def test_stream_age_survives_a_sibling_informer_teardown(self):
+        """Two informers on the SAME resource in one process (leader
+        failover, embedded layouts): one reflector ending its stream must
+        not pop the sibling's live entry — the age gauge refcounts open
+        streams per resource and exposes the oldest."""
+        wh = flight.WatchHealth()
+        t1 = wh.stream_started("pods")
+        time.sleep(0.02)
+        t2 = wh.stream_started("pods")
+        age_before = wh.labeled()["stream_age_s"]["pods"]
+        wh.stream_ended("pods", t2)  # the NEWER sibling goes away
+        ages = wh.labeled()["stream_age_s"]
+        assert "pods" in ages  # the older live stream still shows
+        assert ages["pods"] >= age_before  # and it IS the older one
+        wh.stream_ended("pods", t1)
+        assert "pods" not in wh.labeled()["stream_age_s"]
+
+    def test_midstream_410_error_frame_counts_as_expired(self):
+        from k8s_tpu.client.informer import SharedInformer
+
+        flight.reset_all()
+        backend = _DelegatingBackend(FakeCluster())
+        backend.scripted_watch = _ScriptedWatch([("ERROR", {"code": 410})])
+        inf = SharedInformer(backend, PODS, resync_period=0)
+        inf.run()
+        try:
+            assert inf.wait_for_cache_sync(5)
+            # the scripted first watch delivered a mid-stream 410 Status
+            # frame; the reflector must relist attributing it to "410"
+            _wait_for(lambda: flight.WATCH.relists(
+                resource="pods", reason=flight.RELIST_EXPIRED) == 1,
+                what="mid-stream 410 relist")
+            assert flight.WATCH.snapshot()["events"].get("pods/ERROR") == 1
+        finally:
+            inf.stop()
+
+
+# -- /debug/timeline endpoint parity -----------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestTimelineEndpoint:
+    def test_metrics_server_404_when_inactive_then_serves(self):
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        was_active = flight.TIMELINE.active
+        flight.TIMELINE.deactivate()
+        srv = MetricsServer(0).start()
+        try:
+            code, body = _get(
+                f"http://127.0.0.1:{srv.port}/debug/timeline")
+            assert code == 404
+            assert "inactive" in body  # explicit body, not a route typo 404
+            flight.TIMELINE.activate()
+            flight.TIMELINE.clear()
+            flight.timeline("ns/j1", "observed")
+            flight.timeline("ns/j1", "condition", reason="TFJobCreated")
+            flight.timeline("ns/j2", "observed")
+            code, body = _get(
+                f"http://127.0.0.1:{srv.port}/debug/timeline?job=ns/j1")
+            assert code == 200
+            payload = json.loads(body)
+            assert payload["job"] == "ns/j1"
+            kinds = [e["kind"] for e in payload["events"]]
+            assert kinds == ["observed", "condition"]
+            seqs = [e["seq"] for e in payload["events"]]
+            assert seqs == sorted(seqs)
+            # ?since= pagination from the advertised last_seq
+            code, body = _get(
+                f"http://127.0.0.1:{srv.port}/debug/timeline"
+                f"?job=ns/j1&since={payload['last_seq']}")
+            assert json.loads(body)["events"] == []
+            # summary view lists both jobs + stats
+            code, body = _get(
+                f"http://127.0.0.1:{srv.port}/debug/timeline")
+            summary = json.loads(body)
+            assert set(summary["jobs"]) == {"ns/j1", "ns/j2"}
+            assert summary["stats"]["jobs"] == 2
+        finally:
+            srv.stop()
+            if was_active:
+                flight.TIMELINE.activate()
+            else:
+                flight.TIMELINE.deactivate()
+
+    def test_dashboard_serves_same_responder(self):
+        from k8s_tpu.dashboard.backend import DashboardServer
+
+        was_active = flight.TIMELINE.active
+        flight.TIMELINE.deactivate()
+        server = DashboardServer(Clientset(FakeCluster()),
+                                 host="127.0.0.1", port=0)
+        server.start_background()
+        try:
+            code, body = _get(
+                f"http://127.0.0.1:{server.port}/debug/timeline")
+            assert code == 404 and "inactive" in body
+            flight.TIMELINE.activate()
+            flight.TIMELINE.clear()
+            flight.timeline("ns/j1", "observed")
+            code, body = _get(
+                f"http://127.0.0.1:{server.port}/debug/timeline?job=ns/j1")
+            assert code == 200
+            assert [e["kind"] for e in json.loads(body)["events"]] == [
+                "observed"]
+        finally:
+            server.shutdown()
+            if was_active:
+                flight.TIMELINE.activate()
+            else:
+                flight.TIMELINE.deactivate()
+
+    def test_flight_metric_families_exposed(self):
+        from k8s_tpu.util import metrics as metrics_mod
+
+        flight.reset_all()
+        reg = metrics_mod.Registry()
+        metrics_mod.flight_metrics(reg)
+        flight.ACCOUNTING.record("GET", "pods", 200, 0.003)
+        flight.WATCH.record_relist("pods", flight.RELIST_INITIAL)
+        flight.EVENTS.record_recorded()
+        text = reg.expose()
+        assert ('apiserver_requests_total{verb="GET",resource="pods",'
+                'code="200"} 1') in text
+        assert 'watch_relists_total{resource="pods",reason="initial"} 1' in text
+        assert "events_recorded_total 1" in text
+        assert "apiserver_request_duration_seconds_count 1" in text
+
+
+# -- event-recorder hot path (satellite: aggregation + counters) -------------
+
+
+class TestEventRecorderAggregation:
+    def test_exact_repeats_bump_count_not_new_objects(self):
+        from k8s_tpu.client.gvr import EVENTS
+        from k8s_tpu.client.record import AsyncEventRecorder
+
+        flight.reset_all()
+        fc = FakeCluster()
+        rec = AsyncEventRecorder(Clientset(fc), "test-controller")
+        involved = {"kind": "TFJob", "apiVersion": "kubeflow.org/v1alpha2",
+                    "metadata": {"name": "j1", "namespace": "ns",
+                                 "uid": "u1"}}
+        try:
+            for _ in range(3):
+                rec.event(involved, "Normal", "Synced", "same message")
+            rec.event(involved, "Normal", "Synced", "different message")
+            assert rec.flush(5)
+        finally:
+            rec.close()
+        events = list(fc.objects(EVENTS))
+        by_msg = {e["message"]: e for e in events}
+        # 3 identical sends -> ONE object with count 3; distinct messages
+        # are never merged (the e2e harness parses pod names from them)
+        assert len(events) == 2
+        assert by_msg["same message"]["count"] == 3
+        assert by_msg["different message"]["count"] == 1
+        snap = flight.EVENTS.snapshot()
+        assert snap["recorded"] == 4
+        assert snap["aggregated"] == 2
+        assert snap["dropped"] == 0
+
+    def test_overflow_drops_are_counted_never_raised(self):
+        from k8s_tpu.client.record import AsyncEventRecorder
+
+        class TinyQueueRecorder(AsyncEventRecorder):
+            QUEUE_SIZE = 1
+
+        flight.reset_all()
+        fc = FakeCluster()
+        fc.create_delay_s = 0.3  # wedge the sink on its first post
+        rec = TinyQueueRecorder(Clientset(fc), "test-controller")
+        involved = {"kind": "TFJob",
+                    "metadata": {"name": "j1", "namespace": "ns"}}
+        try:
+            for i in range(6):
+                rec.event(involved, "Normal", "Spam", f"m{i}")
+        finally:
+            fc.create_delay_s = 0.0
+            rec.close()
+        snap = flight.EVENTS.snapshot()
+        assert snap["dropped"] >= 1
+        assert snap["recorded"] + snap["dropped"] == 6
+
+    def test_events_land_on_the_involved_objects_timeline(self):
+        from k8s_tpu.client.record import EventRecorder
+
+        was_active = flight.TIMELINE.active
+        flight.TIMELINE.activate()
+        flight.TIMELINE.clear()
+        try:
+            rec = EventRecorder(Clientset(FakeCluster()), "test-controller")
+            involved = {"kind": "TFJob",
+                        "metadata": {"name": "j1", "namespace": "ns"}}
+            rec.eventf(involved, "Warning", "FailedCreate", "boom %d", 7)
+            entries = flight.TIMELINE.snapshot("ns/j1")
+            assert [e["kind"] for e in entries] == ["event"]
+            assert entries[0]["reason"] == "FailedCreate"
+            assert entries[0]["message"] == "boom 7"
+        finally:
+            flight.TIMELINE.clear()
+            if not was_active:
+                flight.TIMELINE.deactivate()
+
+
+# -- churn bench (smoke scale; the full 2-5k proof runs via --churn) ---------
+
+
+class TestChurnBenchSmoke:
+    def test_embedded_assertions_pass_at_smoke_scale(self):
+        from k8s_tpu.harness.bench_operator import bench_churn
+
+        r = bench_churn(jobs=24, fail_frac=0.25, steady_s=0.5,
+                        resync_s=0.3, threadiness=2, timeout_s=60.0)
+        assert r["steady_calls_per_sec_flat"] is True
+        assert r["steady_half"]["lists"] == 0
+        assert r["steady_full"]["lists"] == 0
+        assert r["churn_events"] == 6
+        assert r["churn_calls_per_event"] <= 40
+        assert r["relists"] == {"nodes/initial": 1, "pods/initial": 1,
+                                "services/initial": 1, "tfjobs/initial": 1}
+        # the artifact carries the verb/resource breakdown + depth stats
+        assert "POST pods" in r["apiserver_calls_by_verb_resource"]
+        assert r["timeline_stats"]["jobs"] == 24
+        # ordered lifecycle for a churned job: observed -> created ->
+        # pods created -> running -> gang teardown -> recreate
+        kinds = r["sample_timeline_kinds"]
+        assert kinds[0] == "observed"
+        assert "create_wave" in kinds and "delete_wave" in kinds
+        assert kinds.index("delete_wave") > kinds.index("create_wave")
+
+    def test_failed_assertions_still_write_the_artifact(self, tmp_path,
+                                                        monkeypatch):
+        """A churn regression in the non-gating CI tier must leave the
+        measured numbers behind: the artifact is written WITH a failures
+        field before the error propagates."""
+        import argparse
+
+        from k8s_tpu.harness import bench_operator
+
+        def exploding_bench(**kw):
+            err = RuntimeError("churn bench assertions failed:\n  boom")
+            err.result = {"steady_full": {"calls_per_sec": 7.5},
+                          "failures": ["boom"]}
+            raise err
+
+        monkeypatch.setattr(bench_operator, "bench_churn", exploding_bench)
+        out = tmp_path / "bench_churn.json"
+        args = argparse.Namespace(
+            churn_jobs=8, churn_replicas=1, churn_fail_frac=0.25,
+            churn_steady=0.5, churn_resync=0.3, churn_threadiness=1,
+            churn_out=str(out), timeout=30)
+        with pytest.raises(RuntimeError):
+            bench_operator.run_churn(args)
+        payload = json.loads(out.read_text())
+        assert payload["failures"] == ["boom"]
+        assert payload["value"] == 7.5

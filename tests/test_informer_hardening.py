@@ -1,0 +1,399 @@
+"""resourceVersion-resume / re-list-on-410 hardening (ISSUE 7 satellite):
+regression coverage for the reflector loop (client/informer.py) —
+mid-stream 410 error frames, resume-from-last-rv after a watch disconnect
+(no spurious relist), relist-detected deletions during a churn storm, and
+handler callbacks seeing REAL pre-relist objects."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from k8s_tpu import flight
+from k8s_tpu.client import errors
+from k8s_tpu.client.clientset import Clientset
+from k8s_tpu.client.fake import FakeCluster
+from k8s_tpu.client.gvr import PODS
+from k8s_tpu.client.informer import SharedInformer
+
+
+def _wait_for(pred, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.01)
+
+
+def _stop_active_watch(inf: SharedInformer) -> None:
+    _wait_for(lambda: inf._active_watch is not None, what="active watch")
+    with inf._watch_lock:
+        inf._active_watch.stop()
+
+
+class _Armed410Backend:
+    """FakeCluster wrapper whose watch() raises 410 Expired while armed —
+    the deterministic stand-in for 'the rv history was compacted out from
+    under the reflector' during a churn storm."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.armed = False
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def watch(self, resource, namespace=None, resource_version=None):
+        if self.armed:
+            raise errors.expired("resourceVersion too old (armed)")
+        return self.inner.watch(resource, namespace, resource_version)
+
+
+class _Handlers:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.adds: list[dict] = []
+        self.updates: list[tuple[dict, dict]] = []
+        self.deletes: list[dict] = []
+
+    def wire(self, inf: SharedInformer) -> None:
+        inf.add_event_handler(
+            on_add=lambda o: self._push(self.adds, o),
+            on_update=lambda old, new: self._push(self.updates, (old, new)),
+            on_delete=lambda o: self._push(self.deletes, o),
+        )
+
+    def _push(self, bucket, item):
+        with self.lock:
+            bucket.append(item)
+
+    def deleted_names(self):
+        with self.lock:
+            return [(d.get("metadata") or {}).get("name")
+                    for d in self.deletes]
+
+
+def _fake_list_count(fc: FakeCluster) -> int:
+    return sum(1 for a in fc.actions if a.verb == "list"
+               and a.resource == "pods")
+
+
+def test_failed_list_retry_keeps_the_pending_relist_reason():
+    """A transport failure in the RELIST ATTEMPT itself is a retry, not a
+    new gap: when the retried list succeeds, the relist must still be
+    attributed to its original cause (initial), not mislabeled 'error'."""
+
+    class _FlakyListBackend:
+        def __init__(self, inner, failures=1):
+            self.inner = inner
+            self.failures = failures
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def list_with_rv(self, resource, namespace=None,
+                         label_selector=None, field_selector=None):
+            if self.failures > 0:
+                self.failures -= 1
+                raise ConnectionError("apiserver briefly unreachable")
+            return self.inner.list_with_rv(resource, namespace,
+                                           label_selector, field_selector)
+
+    flight.reset_all()
+    backend = _FlakyListBackend(FakeCluster())
+    Clientset(backend.inner).pods("ns").create({"metadata": {"name": "p0"}})
+    inf = SharedInformer(backend, PODS, resync_period=0)
+    inf.run()
+    try:
+        assert inf.wait_for_cache_sync(5)
+        assert flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_INITIAL) == 1
+        assert flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_ERROR) == 0
+    finally:
+        inf.stop()
+
+
+def test_resume_free_backend_relists_without_backoff_or_error_label():
+    """A backend that mints no resourceVersions (list_with_rv absent —
+    rest.py's documented degradation) relists every cycle BY DESIGN: the
+    relists must be labeled no_rv (never error) and must not trip the
+    stream-gap backoff (stream ends are not gaps in this mode)."""
+
+    class _NoRvBackend:
+        """Delegates list/watch only — deliberately NO list_with_rv."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def list(self, resource, namespace=None, label_selector=None,
+                 field_selector=None):
+            return self._inner.list(resource, namespace, label_selector,
+                                    field_selector)
+
+        def watch(self, resource, namespace=None, resource_version=None):
+            assert resource_version is None  # nothing to resume from
+            return self._inner.watch(resource, namespace, None)
+
+    flight.reset_all()
+    backend = _NoRvBackend(FakeCluster())
+    Clientset(backend._inner).pods("ns").create({"metadata": {"name": "p0"}})
+    inf = SharedInformer(backend, PODS, resync_period=0)
+    inf.run()
+    try:
+        assert inf.wait_for_cache_sync(5)
+        t0 = time.monotonic()
+        for _ in range(3):  # three clean stream ends = three design relists
+            before = flight.WATCH.relists(resource="pods")
+            _stop_active_watch(inf)
+            _wait_for(lambda: flight.WATCH.relists(
+                resource="pods") > before, what="per-cycle relist")
+        assert time.monotonic() - t0 < 2.0, "backoff applied to healthy mode"
+        assert flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_NO_RV) >= 3
+        assert flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_ERROR) == 0
+    finally:
+        inf.stop()
+
+
+def test_resume_free_backend_with_dying_watch_is_throttled():
+    """A resume-free backend whose watch endpoint RAISES every time (LB
+    killing connections) must still hit the escalating relist throttle:
+    last_rv is always None in this mode, so the gap classification must
+    come from the cycle phase, not from the resume point."""
+
+    class _NoRvDyingWatchBackend:
+        def __init__(self, inner):
+            self._inner = inner
+            self.watch_attempts = 0
+            self.list_calls = 0
+
+        def list(self, resource, namespace=None, label_selector=None,
+                 field_selector=None):
+            self.list_calls += 1
+            return self._inner.list(resource, namespace, label_selector,
+                                    field_selector)
+
+        def watch(self, resource, namespace=None, resource_version=None):
+            self.watch_attempts += 1
+            raise ConnectionError("LB killed the watch connection")
+
+    flight.reset_all()
+    backend = _NoRvDyingWatchBackend(FakeCluster())
+    Clientset(backend._inner).pods("ns").create({"metadata": {"name": "p0"}})
+    inf = SharedInformer(backend, PODS, resync_period=0)
+    inf.run()
+    try:
+        assert inf.wait_for_cache_sync(5)
+        time.sleep(1.2)
+        # unthrottled this would be ~10+ LISTs; the escalating waits
+        # (0.2, 0.4, 0.8, ...) bound it to a handful
+        assert backend.list_calls <= 6, backend.list_calls
+        # and these relists are attributed as errors, never no_rv — the
+        # watch endpoint IS erroring, resume-free mode doesn't hide it
+        assert flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_NO_RV) == 0
+        assert flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_ERROR) >= 1
+    finally:
+        inf.stop()
+
+
+def test_resume_from_last_rv_after_disconnect_no_spurious_relist():
+    """A cleanly-ended watch resumes from the last delivered event's rv:
+    objects created across the gap arrive (replayed or live), the store
+    converges, and NO second LIST is issued."""
+    flight.reset_all()
+    fc = FakeCluster()
+    cs = Clientset(fc)
+    cs.pods("ns").create({"metadata": {"name": "p0"}})
+    inf = SharedInformer(fc, PODS, resync_period=0)
+    h = _Handlers()
+    h.wire(inf)
+    inf.run()
+    try:
+        assert inf.wait_for_cache_sync(5)
+        lists_before = _fake_list_count(fc)
+        # end the stream; the object created across the gap must be
+        # recovered purely from the rv-resumed watch (replay from history
+        # if the reflector hasn't reopened yet, live delivery if it has)
+        _stop_active_watch(inf)
+        cs.pods("ns").create({"metadata": {"name": "p-gap"}})
+        _wait_for(lambda: inf.store.get_by_key("ns/p-gap") is not None,
+                  what="gap object recovered via resume")
+        _wait_for(lambda: any(
+            (a.get("metadata") or {}).get("name") == "p-gap"
+            for a in h.adds), what="add handler for gap object")
+        assert _fake_list_count(fc) == lists_before, \
+            "resume must not relist"
+        assert flight.WATCH.relists(resource="pods") == 1  # initial only
+        assert flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_INITIAL) == 1
+    finally:
+        inf.stop()
+
+
+def test_relist_on_410_recovers_deletions_with_last_known_objects():
+    """Deletions that happened inside a watch gap ending in 410 are
+    detected by the relist diff and dispatched with the LAST-KNOWN full
+    object (labels/ownerRefs intact — expectations unwind needs them)."""
+    flight.reset_all()
+    backend = _Armed410Backend(FakeCluster())
+    cs = Clientset(backend.inner)
+    cs.pods("ns").create({"metadata": {"name": "keep"}})
+    cs.pods("ns").create({"metadata": {"name": "doomed",
+                                       "labels": {"tf-replica-type": "tpu"}}})
+    inf = SharedInformer(backend, PODS, resync_period=0)
+    h = _Handlers()
+    h.wire(inf)
+    inf.run()
+    try:
+        assert inf.wait_for_cache_sync(5)
+        backend.armed = True
+        _stop_active_watch(inf)
+        cs.pods("ns").delete("doomed")  # lands inside the gap
+        # stay armed until the deletion is DISPATCHED: every reopen 410s,
+        # so recovery can only come from the relist diff (disarming early
+        # would let an rv-resumed replay deliver it instead)
+        _wait_for(lambda: "doomed" in h.deleted_names(),
+                  what="relist-detected deletion")
+        backend.armed = False
+        with h.lock:
+            doomed = next(d for d in h.deletes
+                          if d["metadata"]["name"] == "doomed")
+        # the dispatched object is the REAL pre-relist cache entry
+        assert doomed["metadata"]["labels"] == {"tf-replica-type": "tpu"}
+        assert inf.store.get_by_key("ns/doomed") is None
+        assert inf.store.get_by_key("ns/keep") is not None
+        assert flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_EXPIRED) >= 1
+    finally:
+        inf.stop()
+
+
+def test_update_handlers_see_real_pre_relist_objects():
+    """An update recovered across a 410 gap must hand the handler the
+    actual old object (distinct resourceVersions) — a same-object echo
+    would suppress changes recovered across the gap."""
+    flight.reset_all()
+    backend = _Armed410Backend(FakeCluster())
+    cs = Clientset(backend.inner)
+    created = cs.pods("ns").create({"metadata": {"name": "p0"},
+                                    "status": {"phase": "Pending"}})
+    old_rv = created["metadata"]["resourceVersion"]
+    inf = SharedInformer(backend, PODS, resync_period=0)
+    h = _Handlers()
+    h.wire(inf)
+    inf.run()
+    try:
+        assert inf.wait_for_cache_sync(5)
+        backend.armed = True
+        _stop_active_watch(inf)
+        backend.inner.set_pod_phase("ns", "p0", "Running")
+        backend.armed = False
+
+        def changed_update():
+            with h.lock:
+                return [(o, n) for o, n in h.updates
+                        if o["metadata"].get("resourceVersion")
+                        != n["metadata"].get("resourceVersion")]
+
+        _wait_for(lambda: len(changed_update()) >= 1,
+                  what="relist-recovered update")
+        old, new = changed_update()[0]
+        assert old["metadata"]["resourceVersion"] == old_rv
+        assert (old.get("status") or {}).get("phase") == "Pending"
+        assert new["status"]["phase"] == "Running"
+    finally:
+        inf.stop()
+
+
+def test_midstream_410_error_frame_relists_and_converges():
+    """A server-sent ERROR frame with code 410 mid-stream (no exception on
+    the watch call itself) must invalidate the resume point, relist, and
+    leave the store converged with the backend."""
+
+    class _OneErrorFrameBackend:
+        def __init__(self, inner):
+            self.inner = inner
+            self.frames_left = 1
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def watch(self, resource, namespace=None, resource_version=None):
+            if self.frames_left > 0:
+                self.frames_left -= 1
+
+                class _W:
+                    stopped = False
+
+                    def __init__(w):
+                        w._sent = False
+
+                    def next(w, timeout=None):
+                        if not w._sent:
+                            w._sent = True
+                            return ("ERROR", {"kind": "Status", "code": 410,
+                                              "reason": "Expired"})
+                        w.stopped = True
+                        return None
+
+                    def stop(w):
+                        w.stopped = True
+
+                return _W()
+            return self.inner.watch(resource, namespace, resource_version)
+
+    flight.reset_all()
+    backend = _OneErrorFrameBackend(FakeCluster())
+    cs = Clientset(backend.inner)
+    cs.pods("ns").create({"metadata": {"name": "p0"}})
+    inf = SharedInformer(backend, PODS, resync_period=0)
+    inf.run()
+    try:
+        assert inf.wait_for_cache_sync(5)
+        _wait_for(lambda: flight.WATCH.relists(
+            resource="pods", reason=flight.RELIST_EXPIRED) == 1,
+            what="mid-stream 410 relist")
+        # post-recovery: live events flow again and the store converges
+        cs.pods("ns").create({"metadata": {"name": "p1"}})
+        _wait_for(lambda: inf.store.get_by_key("ns/p1") is not None,
+                  what="store convergence after recovery")
+    finally:
+        inf.stop()
+
+
+def test_churn_storm_through_event_history_trim_stays_consistent():
+    """A watch gap spanning MORE events than the fake's retained history
+    (the etcd-compaction analogue) forces the real 410 path end-to-end:
+    resume raises Expired, the reflector relists, and the store converges
+    on exactly the surviving objects."""
+    flight.reset_all()
+    fc = FakeCluster()
+    fc.EVENT_HISTORY_LIMIT = 16  # shrink the retention window (instance attr)
+    cs = Clientset(fc)
+    cs.pods("ns").create({"metadata": {"name": "p0"}})
+    inf = SharedInformer(fc, PODS, resync_period=0)
+    inf.run()
+    try:
+        assert inf.wait_for_cache_sync(5)
+        # Freeze the reflector in a dead stream, then churn far past the
+        # retention window so its resume rv is compacted away.
+        _stop_active_watch(inf)
+        for i in range(40):  # > 2x the retention window
+            cs.pods("ns").create({"metadata": {"name": f"churn-{i}"}})
+            if i % 2 == 0:
+                cs.pods("ns").delete(f"churn-{i}")
+        survivors = {f"ns/churn-{i}" for i in range(40) if i % 2 == 1}
+        survivors.add("ns/p0")
+        _wait_for(lambda: set(inf.store.keys()) == survivors,
+                  timeout=10.0, what="store converged after 410 churn")
+        # the gap was (probably) recovered via 410; whichever way the race
+        # went, there must be NO error relists and no relist storm
+        assert flight.WATCH.relists(resource="pods",
+                                    reason=flight.RELIST_ERROR) == 0
+        assert flight.WATCH.relists(resource="pods") <= 3
+    finally:
+        inf.stop()
