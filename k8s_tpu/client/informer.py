@@ -461,6 +461,8 @@ class Lister:
 
 OWNER_INDEX = "controller-uid"
 ORPHAN_INDEX = "orphans-by-namespace"
+FLEET_SCRAPE_INDEX = "fleet-scrape"
+FLEET_SCRAPE_KEY = "scrapeable"
 
 
 def index_by_controller_uid(obj: dict) -> list[str]:
@@ -479,6 +481,19 @@ def index_orphans_by_namespace(obj: dict) -> list[str]:
         if ref.get("controller"):
             return []
     return [(obj.get("metadata") or {}).get("namespace", "")]
+
+
+def index_fleet_scrape_pods(obj: dict) -> list[str]:
+    """Index key: the constant ``FLEET_SCRAPE_KEY`` for pods declaring a
+    fleet scrape port (ISSUE 8).  The fleet plane's per-cycle discovery
+    is then a point query over the (normally small) serving subset
+    instead of an O(all cached pods) scan — at a 5k-pod training fleet
+    with a handful of serving jobs, the scrape cycle reads only the
+    serving pods.  The predicate is the SAME one discovery applies
+    (``fleet.scrape_port``), so indexed and discoverable cannot drift."""
+    from k8s_tpu.fleet.discovery import scrape_port
+
+    return [FLEET_SCRAPE_KEY] if scrape_port(obj) is not None else []
 
 
 class SharedInformerFactory:

@@ -22,6 +22,12 @@ log = logging.getLogger(__name__)
 V5E_CHIPS_PER_HOST = 4
 V5E_MAX_HOSTS = 64  # v5litepod-256 (16x16) is the largest v5e slice
 
+# The serving container's HTTP port (models/server.py --port): the
+# command, containerPort, readiness probe, and the DEFAULT fleet scrape
+# target all derive from this one constant — /metrics lives on the same
+# server, so advertising any other scrape port means a sidecar exporter.
+SERVE_HTTP_PORT = 8000
+
 
 def v5e_slice_for_hosts(num_hosts: int) -> tuple[str, str]:
     """(acceleratorType, topology) for a v5e slice of ``num_hosts`` hosts
@@ -59,13 +65,31 @@ def serve_tfjob_template(
     serve_batch_sampling: bool = True,
     priority: int | None = None,
     queue: str | None = None,
+    fleet_scrape_port: int | None = SERVE_HTTP_PORT,
+    fleet_interval_s: float | None = None,
 ) -> dict:
     """A resident serving TFJob (the examples/tf_job_serve_http.yaml
     shape) with the engine knobs surfaced as env: decode slots and
     admission queue bound, plus the round-6 shared-prefix KV pool
     retention (``K8S_TPU_SERVE_PREFIX_BLOCKS``; omit for auto, 0
     disables reuse) and batched-sampling lane routing
-    (``K8S_TPU_SERVE_BATCH_SAMPLING``)."""
+    (``K8S_TPU_SERVE_BATCH_SAMPLING``).
+
+    ISSUE 8: generated serving jobs are **fleet-discoverable by
+    default** — the pod template carries the
+    ``kubeflow.org/fleet-scrape-port`` annotation and the
+    ``K8S_TPU_FLEET_SCRAPE_PORT`` env (both pointing at the server's
+    HTTP port, where ``/metrics`` lives), so the operator's fleet
+    telemetry plane scrapes them with zero extra configuration.
+    ``fleet_scrape_port=None`` opts the job out.  The default is the
+    server's own HTTP port (``SERVE_HTTP_PORT`` — /metrics lives on the
+    same listener); a DIFFERENT value means a sidecar exporter serves
+    /metrics there, since the generated command pins the server to
+    ``SERVE_HTTP_PORT`` — there is no listener on an arbitrary port.
+    ``fleet_interval_s`` additionally surfaces the operator-side
+    ``K8S_TPU_FLEET_INTERVAL_S`` knob on the pod for humans reading
+    the manifest (the interval is an operator setting — the env on a
+    serving pod is documentation, the annotation is the contract)."""
     env = [
         {"name": "K8S_TPU_SERVE_SLOTS", "value": str(serve_slots)},
         {"name": "K8S_TPU_SERVE_QUEUE", "value": str(serve_queue)},
@@ -75,6 +99,17 @@ def serve_tfjob_template(
     if serve_prefix_blocks is not None:
         env.append({"name": "K8S_TPU_SERVE_PREFIX_BLOCKS",
                     "value": str(serve_prefix_blocks)})
+    if fleet_scrape_port is not None:
+        env.append({"name": "K8S_TPU_FLEET_SCRAPE_PORT",
+                    "value": str(fleet_scrape_port)})
+        if fleet_interval_s is not None:
+            env.append({"name": "K8S_TPU_FLEET_INTERVAL_S",
+                        "value": str(fleet_interval_s)})
+    template_meta = {}
+    if fleet_scrape_port is not None:
+        template_meta["annotations"] = {
+            "kubeflow.org/fleet-scrape-port": str(fleet_scrape_port),
+        }
     job = {
         "apiVersion": "kubeflow.org/v1alpha2",
         "kind": "TFJob",
@@ -85,6 +120,8 @@ def serve_tfjob_template(
                     "replicas": 1,
                     "restartPolicy": "OnFailure",
                     "template": {
+                        **({"metadata": template_meta}
+                           if template_meta else {}),
                         "spec": {
                             "schedulerName": scheduler_name,
                             "containers": [
@@ -95,14 +132,17 @@ def serve_tfjob_template(
                                         "python", "-m",
                                         "k8s_tpu.models.server",
                                         f"--train_dir={train_dir}",
-                                        "--host=0.0.0.0", "--port=8000",
+                                        "--host=0.0.0.0",
+                                        f"--port={SERVE_HTTP_PORT}",
                                     ],
                                     "env": env,
-                                    "ports": [{"containerPort": 8000,
+                                    "ports": [{"containerPort":
+                                               SERVE_HTTP_PORT,
                                                "name": "http"}],
                                     "readinessProbe": {
                                         "httpGet": {"path": "/healthz",
-                                                    "port": 8000}
+                                                    "port":
+                                                    SERVE_HTTP_PORT}
                                     },
                                     # match the example manifest: a TPU
                                     # + memory request (the block pool
@@ -248,6 +288,8 @@ def generate(
     serve_queue: int = 64,
     serve_prefix_blocks: int | None = None,
     serve_batch_sampling: bool = True,
+    fleet_scrape_port: int | None = 8000,
+    fleet_interval_s: float | None = None,
 ) -> list[dict]:
     """N uniquely-named jobs, ``tfjob-<ts>-<i>`` (genjob.go:111-114)."""
     ts = timestamp if timestamp is not None else time.time_ns() % 10**9
@@ -259,7 +301,9 @@ def generate(
                 serve_slots=serve_slots, serve_queue=serve_queue,
                 serve_prefix_blocks=serve_prefix_blocks,
                 serve_batch_sampling=serve_batch_sampling,
-                priority=priority, queue=queue)
+                priority=priority, queue=queue,
+                fleet_scrape_port=fleet_scrape_port,
+                fleet_interval_s=fleet_interval_s)
             for i in range(n)
         ]
     return [
@@ -298,6 +342,18 @@ def main(argv=None) -> int:
                         choices=(0, 1), default=1,
                         help="K8S_TPU_SERVE_BATCH_SAMPLING for --serve "
                         "jobs (0 = exclusive-lane sampling)")
+    parser.add_argument("--fleet-scrape-port", type=int,
+                        default=SERVE_HTTP_PORT,
+                        help="kubeflow.org/fleet-scrape-port annotation + "
+                        "K8S_TPU_FLEET_SCRAPE_PORT env on --serve jobs so "
+                        "the operator's fleet plane discovers them "
+                        "(0 disables; default = the serving container's "
+                        "own HTTP port, where /metrics lives — any OTHER "
+                        "value must be a sidecar exporter's port, the "
+                        "server itself stays on %d)" % SERVE_HTTP_PORT)
+    parser.add_argument("--fleet-interval", type=float, default=None,
+                        help="surface K8S_TPU_FLEET_INTERVAL_S on --serve "
+                        "pods (the operator-side scrape cadence knob)")
     parser.add_argument(
         "--dump", action="store_true", help="print manifests instead of creating"
     )
@@ -318,6 +374,8 @@ def main(argv=None) -> int:
         serve_queue=args.serve_queue,
         serve_prefix_blocks=args.serve_prefix_blocks,
         serve_batch_sampling=bool(args.serve_batch_sampling),
+        fleet_scrape_port=args.fleet_scrape_port or None,
+        fleet_interval_s=args.fleet_interval,
     )
     if args.dump:
         yaml.safe_dump_all(jobs, sys.stdout)

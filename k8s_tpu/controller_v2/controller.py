@@ -19,6 +19,7 @@ import os
 import threading
 import time
 
+from k8s_tpu import fleet as fleet_mod
 from k8s_tpu import flight
 from k8s_tpu import scheduler as scheduler_mod
 from k8s_tpu import trace
@@ -71,6 +72,8 @@ class TFJobController:
         delete_concurrency: int | None = None,
         cluster_chips: int | None = None,
         scheduler=None,
+        fleet_scrape: bool | None = None,
+        fleet_interval_s: float | None = None,
     ):
         self.clientset = clientset
         # async sink: recording is a buffered enqueue, not an API round trip
@@ -133,6 +136,11 @@ class TFJobController:
         # exports what flight.ACCOUNTING/WATCH/EVENTS have been counting.
         flight.TIMELINE.activate()
         metrics.flight_metrics()
+        # Fleet telemetry plane (ISSUE 8): the families are registered
+        # unconditionally (HELP/TYPE-only while no plane is active, like
+        # any idle family); the plane itself is opt-in — fleet_scrape
+        # None defers to K8S_TPU_FLEET_SCRAPE, default off.
+        metrics.fleet_metrics()
         # Gang admission & capacity scheduler (ISSUE 4).  cluster_chips:
         # None -> K8S_TPU_CLUSTER_CHIPS, else derive from node allocatable
         # TPU resources per sync, else unlimited (admission off — the
@@ -206,6 +214,40 @@ class TFJobController:
             status_lock=self._status_lock, metrics=self.metrics,
         )
 
+        # Fleet telemetry plane (ISSUE 8): scrape targets resolve from the
+        # pod informer's STORE — plain cache reads, so steady-state
+        # scraping adds zero apiserver calls (the PR 7 churn property is
+        # preserved by construction; bench_operator --fleet asserts it).
+        # SLO breaches land a flight-timeline event + a K8s Event through
+        # the aggregating recorder via _fleet_breach_sink.
+        if fleet_scrape is None:
+            fleet_scrape = fleet_mod.scrape_enabled_from_env()
+        self.fleet_plane = None
+        if fleet_scrape:
+            # dedicated store index: per-cycle discovery is a point query
+            # over the scrapeable subset, not an O(all pods) scan
+            from k8s_tpu.client.informer import (
+                FLEET_SCRAPE_INDEX,
+                FLEET_SCRAPE_KEY,
+                index_fleet_scrape_pods,
+            )
+
+            self.pod_informer.store.add_index(FLEET_SCRAPE_INDEX,
+                                              index_fleet_scrape_pods)
+            self.fleet_plane = fleet_mod.FleetPlane(
+                lambda: fleet_mod.targets_from_pods(
+                    self.pod_informer.store.by_index(FLEET_SCRAPE_INDEX,
+                                                     FLEET_SCRAPE_KEY)),
+                interval_s=fleet_interval_s or fleet_mod.interval_from_env(),
+                timeout_s=fleet_mod.timeout_from_env(),
+                concurrency=fleet_mod.concurrency_from_env(),
+                windows=fleet_mod.windows_from_env(),
+                slo_rules=fleet_mod.rules_spec_from_env(),
+                max_jobs=fleet_mod.max_jobs_from_env(),
+            )
+            self.fleet_plane.add_sink(self._fleet_breach_sink)
+            fleet_mod.set_active(self.fleet_plane)
+
         # seam overridden by tests (controller_test.go updateStatusHandler)
         self.update_status_handler = self._update_tfjob_status
 
@@ -267,6 +309,11 @@ class TFJobController:
         # queue entry, and preemption marker all go, and freed chips wake
         # the parked jobs that were waiting on them
         self._release_scheduler_key(key)
+        if self.fleet_plane is not None:
+            # drop SLO rule state so a deleted job can't pin a stale
+            # breach; its scrape targets vanish with its pods on the
+            # next discovery pass
+            self.fleet_plane.forget(key)
         flight.timeline(key, "deleted")
 
     def enqueue_tfjob(self, tfjob) -> None:
@@ -298,6 +345,8 @@ class TFJobController:
             t = threading.Thread(target=self._run_worker, daemon=True, name=f"worker-{i}")
             t.start()
             self._workers.append(t)
+        if self.fleet_plane is not None:
+            self.fleet_plane.start()
         stop.wait()
         self.shutdown()
 
@@ -310,9 +359,13 @@ class TFJobController:
             t = threading.Thread(target=self._run_worker, daemon=True, name=f"worker-{i}")
             t.start()
             self._workers.append(t)
+        if self.fleet_plane is not None:
+            self.fleet_plane.start()
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.fleet_plane is not None:
+            self.fleet_plane.stop()
         self.queue.shut_down()
         self.factory.stop()
         with self._rtype_executor_lock:
@@ -693,6 +746,39 @@ class TFJobController:
                 job_dict, "Normal", "PreemptionTeardown",
                 "Deleted %d pod(s): gang preempted and requeued", deleted)
         return deleted
+
+    # -- fleet telemetry plane (ISSUE 8) --------------------------------------
+
+    def _fleet_breach_sink(self, job: str, rule, state: dict,
+                           breached: bool) -> None:
+        """SLO transition → flight-timeline entry + K8s Event (through the
+        PR 7 aggregating recorder, so a flapping rule folds into one Event
+        with a climbing count instead of an Event storm)."""
+        burn_short = state.get("burn_short")
+        burn_long = state.get("burn_long")
+
+        def _fmt(v):
+            return f"{v:.2f}" if isinstance(v, float) else "n/a"
+
+        flight.timeline(
+            job, "slo_breach" if breached else "slo_recovered",
+            reason=rule.name,
+            message=(f"burn short={_fmt(burn_short)} "
+                     f"long={_fmt(burn_long)}"),
+            burn_short=burn_short, burn_long=burn_long)
+        ns, name = split_meta_namespace_key(job)
+        involved = self.tfjob_lister.get(ns, name)
+        if involved is None:
+            return  # job gone from the cache: the timeline entry stands
+        if breached:
+            self.recorder.eventf(
+                involved, "Warning", "SloBreach",
+                "fleet SLO rule %s breached (burn short=%s long=%s)",
+                rule.name, _fmt(burn_short), _fmt(burn_long))
+        else:
+            self.recorder.eventf(
+                involved, "Normal", "SloRecovered",
+                "fleet SLO rule %s recovered", rule.name)
 
     def _release_scheduler_key(self, key: str) -> None:
         """Drop every scheduler trace of a terminal/deleted job (reservation,

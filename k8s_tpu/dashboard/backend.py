@@ -126,6 +126,22 @@ def _make_handler(manager: ClientManager):
 
                     code, body, ctype = flight.timeline_response(query)
                     self._send_text(code, body, ctype)
+                elif path == "/debug/fleet":
+                    # Fleet telemetry plane — shared responder with the
+                    # metrics server (fleet.debug_fleet_response), same
+                    # per-process scope caveat as the other /debug routes.
+                    from k8s_tpu import fleet
+
+                    code, body, ctype = fleet.debug_response(query)
+                    self._send_text(code, body, ctype)
+                elif path == "/debug":
+                    # index of the debug endpoints with active state
+                    # (path is rstrip("/")-normalized above, so this
+                    # covers /debug/ too)
+                    from k8s_tpu.util.debug_index import debug_index_response
+
+                    code, body, ctype = debug_index_response(query)
+                    self._send_text(code, body, ctype)
                 elif path in ("", "/tfjobs/ui", "/tfjobs"):
                     self._serve_ui("index.html")
                 elif path.startswith("/tfjobs/ui/"):

@@ -36,6 +36,8 @@ class LocalCluster:
         delete_delay_s: float = 0.0,
         metrics_port: int | None = None,
         cluster_chips: int | None = None,
+        fleet_scrape: bool | None = None,
+        fleet_interval_s: float | None = None,
     ):
         # cluster_chips: total TPU chips the v2 controller's gang-admission
         # scheduler may reserve (ISSUE 4).  None = unlimited/off (the
@@ -100,6 +102,10 @@ class LocalCluster:
                 create_concurrency=create_concurrency,
                 delete_concurrency=delete_concurrency,
                 cluster_chips=cluster_chips,
+                # fleet telemetry plane (ISSUE 8): None defers to
+                # K8S_TPU_FLEET_SCRAPE (default off)
+                fleet_scrape=fleet_scrape,
+                fleet_interval_s=fleet_interval_s,
             )
         self.kubelet = KubeletSimulator(
             self.clientset, namespace, **(kubelet_kwargs or {})

@@ -1,0 +1,152 @@
+"""Fleet telemetry plane (ISSUE 8): per-pod scrape loop, per-job
+aggregation, and SLO burn-rate tracking.
+
+The read-side half of ROADMAP item 2: the router/autoscaler needs to
+know what the serving fleet is doing *right now* — aggregate tokens/s,
+queue depth, batch occupancy, whether the p99 SLO is burning — derived
+from the ``serve_*`` metrics every serving pod already exports (PR 5/6)
+without a single extra apiserver call (discovery reads the informer
+cache; PR 7's zero-steady-LIST property is preserved by construction).
+
+Mirrors the ``trace.TRACER`` / ``scheduler.set_active`` /
+``flight.TIMELINE`` pattern: one process-global *active plane* registry
+so the metrics server and dashboard serve ``/debug/fleet`` without a
+controller reference, 404-with-explicit-body while inactive.
+
+This package is stdlib-only by policy (``harness/py_checks.py`` gates
+it like ``trace/``, ``scheduler/``, and ``flight/``): it runs a scrape
+thread inside the operator process and is read by two HTTP servers; all
+informer/TFJob knowledge stays with its callers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from k8s_tpu.fleet.aggregate import (  # noqa: F401 (public surface)
+    FleetAggregator,
+    fraction_above,
+    quantile_from_buckets,
+)
+from k8s_tpu.fleet.debug import debug_fleet_response  # noqa: F401
+from k8s_tpu.fleet.discovery import (  # noqa: F401
+    ANNOTATION_SCRAPE_PORT,
+    ENV_SCRAPE_PORT,
+    ScrapeTarget,
+    scrape_port,
+    targets_from_pods,
+)
+from k8s_tpu.fleet.parser import (  # noqa: F401
+    Family,
+    ParseError,
+    histogram_points,
+    parse_exposition,
+    render,
+)
+from k8s_tpu.fleet.plane import DEFAULT_WINDOWS, FleetPlane  # noqa: F401
+from k8s_tpu.fleet.scrape import (  # noqa: F401
+    DEFAULT_INTERVAL_S,
+    ScrapeLoop,
+    ScrapeStats,
+)
+from k8s_tpu.fleet.slo import (  # noqa: F401
+    DEFAULT_RULES_SPEC,
+    SloEvaluator,
+    SloRule,
+    parse_rules,
+)
+
+# -- env knobs ----------------------------------------------------------------
+
+ENV_SCRAPE_ENABLE = "K8S_TPU_FLEET_SCRAPE"
+ENV_INTERVAL = "K8S_TPU_FLEET_INTERVAL_S"
+ENV_TIMEOUT = "K8S_TPU_FLEET_TIMEOUT_S"
+ENV_CONCURRENCY = "K8S_TPU_FLEET_CONCURRENCY"
+ENV_SLO_RULES = "K8S_TPU_FLEET_SLO"
+ENV_WINDOWS = "K8S_TPU_FLEET_WINDOWS"
+ENV_MAX_JOBS = "K8S_TPU_FLEET_MAX_JOBS"
+
+
+def scrape_enabled_from_env() -> bool:
+    """K8S_TPU_FLEET_SCRAPE: truthy enables the controller's fleet plane
+    (default off — the compatibility default; /debug/fleet then 404s)."""
+    return os.environ.get(ENV_SCRAPE_ENABLE, "").lower() in ("1", "true",
+                                                             "on", "yes")
+
+
+def _float_from_env(name: str, default: float) -> float:
+    try:
+        v = float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _int_from_env(name: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def interval_from_env() -> float:
+    return _float_from_env(ENV_INTERVAL, DEFAULT_INTERVAL_S)
+
+
+def timeout_from_env() -> float:
+    from k8s_tpu.fleet.scrape import DEFAULT_TIMEOUT_S
+
+    return _float_from_env(ENV_TIMEOUT, DEFAULT_TIMEOUT_S)
+
+
+def concurrency_from_env() -> int:
+    from k8s_tpu.fleet.scrape import DEFAULT_CONCURRENCY
+
+    return _int_from_env(ENV_CONCURRENCY, DEFAULT_CONCURRENCY)
+
+
+def max_jobs_from_env() -> int:
+    from k8s_tpu.fleet.aggregate import DEFAULT_MAX_JOBS
+
+    return _int_from_env(ENV_MAX_JOBS, DEFAULT_MAX_JOBS)
+
+
+def rules_spec_from_env() -> str:
+    return os.environ.get(ENV_SLO_RULES, "") or DEFAULT_RULES_SPEC
+
+
+def windows_from_env() -> tuple:
+    """K8S_TPU_FLEET_WINDOWS: "short,long" seconds for the SLO /
+    aggregation windows (default 30,300).  Garbage or a non-increasing
+    pair falls back to the default."""
+    raw = os.environ.get(ENV_WINDOWS, "")
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    if len(parts) == 2:
+        try:
+            short, long_ = float(parts[0]), float(parts[1])
+        except ValueError:
+            return DEFAULT_WINDOWS
+        if 0 < short < long_:
+            return (short, long_)
+    return DEFAULT_WINDOWS
+
+
+# -- process-global active plane (trace.TRACER / scheduler pattern) -----------
+
+_ACTIVE: Optional[FleetPlane] = None
+
+
+def set_active(plane: Optional[FleetPlane]) -> None:
+    global _ACTIVE
+    _ACTIVE = plane
+
+
+def active() -> Optional[FleetPlane]:
+    return _ACTIVE
+
+
+def debug_response(query: str = "") -> tuple[int, str, str]:
+    """The /debug/fleet endpoint body for the active plane."""
+    return debug_fleet_response(_ACTIVE, query)

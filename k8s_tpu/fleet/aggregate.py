@@ -1,0 +1,389 @@
+"""Per-job fleet aggregation over scraped samples.
+
+Every scrape cycle feeds parsed families per (job, pod) in here; the
+aggregator keeps **bounded time-series rings** so any "tokens/s over the
+last 30s/5m" question is a pure read over memory — no apiserver, no
+re-scrape, no unbounded growth:
+
+- **counters**: per (job, family, labelset, pod) ring of ``(t, value)``
+  cumulative samples → windowed rates as the sum of per-pod positive
+  deltas over the window (a pod restart resets its counter; negative
+  deltas are treated as a reset, counting the post-reset value);
+- **gauges**: per-pod latest values → fleet max / mean, plus a ring of
+  per-cycle fleet maxima so SLO rules can ask for a *windowed* bound;
+- **histograms**: per-pod rings of cumulative bucket snapshots →
+  windowed per-pod bucket deltas merged across the fleet, with p50/p99
+  estimated by linear interpolation inside the winning bucket (the
+  standard Prometheus ``histogram_quantile`` estimate).
+
+Bounds: rings hold ``max_samples`` points (sized by the plane from the
+long window / scrape interval), jobs are LRU-evicted past ``max_jobs``,
+and only families matching ``family_prefixes`` are retained at all —
+an exporter with 10k ad-hoc families cannot balloon the plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+
+_INF = float("inf")
+
+DEFAULT_MAX_SAMPLES = 512
+# sized ABOVE the repo's proven 2-5k-job churn regime: when live
+# scrapeable jobs exceed this bound, each cycle rotates jobs through
+# LRU eviction and their windows never fill (K8S_TPU_FLEET_MAX_JOBS
+# raises it; the footprint is rings-per-family per job, small)
+DEFAULT_MAX_JOBS = 8192
+DEFAULT_FAMILY_PREFIXES = ("serve_",)
+
+
+def _window_slice(ring: deque, now: float, window_s: float):
+    """(oldest_in_window, newest) from a ring of (t, payload) tuples, or
+    None when fewer than two points fall inside the window."""
+    if len(ring) < 2:
+        return None
+    newest = ring[-1]
+    oldest = None
+    cutoff = now - window_s
+    for point in ring:
+        if point[0] >= cutoff:
+            oldest = point
+            break
+    if oldest is None or oldest is newest or newest[0] <= oldest[0]:
+        return None
+    return oldest, newest
+
+
+def _counter_rate(ring: deque, now: float, window_s: float) -> float | None:
+    """Positive-delta rate over the window, reset-aware: a decrease means
+    the pod restarted, and the post-reset value is the delta since then."""
+    if len(ring) < 2:
+        return None
+    cutoff = now - window_s
+    points = [p for p in ring if p[0] >= cutoff]
+    if len(points) < 2:
+        return None
+    delta = 0.0
+    prev = points[0][1]
+    for _t, v in points[1:]:
+        delta += (v - prev) if v >= prev else v
+        prev = v
+    span = points[-1][0] - points[0][0]
+    return delta / span if span > 0 else None
+
+
+def _merge_bucket_deltas(per_pod: list[tuple[dict, dict]]) -> dict:
+    """Sum per-pod windowed bucket deltas: each item is (old_point,
+    new_point) with ``{"buckets": [(le, cum)], "count": n}`` shapes.
+    Returns ``{"buckets": [(le, cum_delta)], "count": total}`` — still
+    cumulative in ``le`` (each pod's new−old difference of cumulative
+    counts preserves monotonicity), so the result is quantile-ready."""
+    merged: dict[float, float] = {}
+    total = 0.0
+    for old, new in per_pod:
+        old_by_le = dict(old["buckets"])
+        for le, cum in new["buckets"]:
+            delta = cum - old_by_le.get(le, 0.0)
+            if delta < 0:  # pod restart: take the post-reset cumulative
+                delta = cum
+            merged[le] = merged.get(le, 0.0) + delta
+        new_count = new.get("count") or (new["buckets"][-1][1]
+                                         if new["buckets"] else 0.0)
+        old_count = old.get("count") or (old["buckets"][-1][1]
+                                         if old["buckets"] else 0.0)
+        dcount = new_count - old_count
+        total += dcount if dcount >= 0 else new_count
+    return {"buckets": sorted(merged.items()), "count": total}
+
+
+def quantile_from_buckets(buckets: list[tuple[float, float]],
+                          q: float) -> float | None:
+    """Prometheus-style histogram_quantile over CUMULATIVE (le, count)
+    pairs: linear interpolation inside the winning bucket; the +Inf
+    bucket answers with the highest finite bound."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= rank:
+            if le == _INF:
+                # beyond the last finite bound: report that bound (the
+                # Prometheus convention — the estimate is a floor)
+                return prev_le if prev_le > 0 else None
+            if cum == prev_cum:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_cum) / (cum - prev_cum)
+        prev_le, prev_cum = le, cum
+    return buckets[-1][0] if buckets[-1][0] != _INF else prev_le
+
+
+def fraction_above(buckets: list[tuple[float, float]],
+                   threshold: float) -> float | None:
+    """Fraction of observations above ``threshold``, from cumulative
+    (le, count) pairs — the SLO "bad fraction".  Uses the smallest
+    FINITE bound >= threshold (conservative: observations between the
+    threshold and that bound count as good).  A threshold above every
+    finite bound counts the +Inf tail as bad — an unbounded observation
+    is not provably under ANY finite bound, and an SLO set past the
+    exporter's top bucket must not silently neuter the rule."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    below = None
+    for le, cum in buckets:
+        if le != _INF and le >= threshold:
+            below = cum
+            break
+    if below is None:
+        finite = [cum for le, cum in buckets if le != _INF]
+        below = finite[-1] if finite else 0.0
+    return max(0.0, (total - below) / total)
+
+
+class FleetAggregator:
+    """Thread-safe per-job rollup state (one instance per fleet plane)."""
+
+    def __init__(self, max_samples: int = DEFAULT_MAX_SAMPLES,
+                 max_jobs: int = DEFAULT_MAX_JOBS,
+                 family_prefixes: tuple = DEFAULT_FAMILY_PREFIXES):
+        if max_samples < 2 or max_jobs < 1:
+            raise ValueError("aggregator bounds must be >= 2 samples / 1 job")
+        self.max_samples = max_samples
+        self.max_jobs = max_jobs
+        self.family_prefixes = tuple(family_prefixes)
+        self._lock = threading.Lock()
+        # job -> {"counters": {(family, labels): {pod: ring}},
+        #         "gauges":   {family: ({pod: (t, value)}, max_ring)},
+        #         "hist":     {family: {pod: ring-of-points}}}
+        self._jobs: "OrderedDict[str, dict]" = OrderedDict()
+
+    def _keep(self, name: str) -> bool:
+        if not self.family_prefixes:
+            return True
+        return any(name.startswith(p) for p in self.family_prefixes)
+
+    def _job_state(self, job: str) -> dict:
+        state = self._jobs.get(job)
+        if state is None:
+            state = {"counters": {}, "gauges": {}, "hist": {}}
+            self._jobs[job] = state
+            if len(self._jobs) > self.max_jobs:
+                self._jobs.popitem(last=False)
+        else:
+            self._jobs.move_to_end(job)
+        return state
+
+    def ingest(self, job: str, pod: str, families: dict, now: float) -> None:
+        """Fold one pod's parsed scrape into the job's rings.
+        ``families`` is the parser's ``{name: Family}`` output."""
+        from k8s_tpu.fleet.parser import histogram_points
+
+        with self._lock:
+            state = self._job_state(job)
+            for name, fam in families.items():
+                if not self._keep(name):
+                    continue
+                if fam.kind == "counter":
+                    for labels_key, value in fam.values().items():
+                        series = state["counters"].setdefault(
+                            (name, labels_key), {})
+                        ring = series.get(pod)
+                        if ring is None:
+                            ring = series[pod] = deque(maxlen=self.max_samples)
+                        ring.append((now, value))
+                elif fam.kind == "gauge":
+                    for labels_key, value in fam.values().items():
+                        latest, max_ring = state["gauges"].setdefault(
+                            (name, labels_key),
+                            ({}, deque(maxlen=self.max_samples)))
+                        latest[pod] = (now, value)
+                elif fam.kind == "histogram":
+                    try:
+                        points = histogram_points(fam)
+                    except Exception:  # noqa: BLE001 - parser validated already
+                        continue
+                    for labels_key, point in points.items():
+                        series = state["hist"].setdefault(
+                            (name, labels_key), {})
+                        ring = series.get(pod)
+                        if ring is None:
+                            ring = series[pod] = deque(maxlen=self.max_samples)
+                        ring.append((now, point))
+
+    def cycle_done(self, now: float, stale_after_s: float) -> None:
+        """End-of-cycle bookkeeping: append per-cycle fleet maxima to the
+        gauge rings (the windowed-gauge substrate) and drop pods whose
+        series went stale (scaled-down / deleted pods must not pin old
+        gauge readings into the fleet max forever)."""
+        cutoff = now - stale_after_s
+        with self._lock:
+            for state in self._jobs.values():
+                for _key, (latest, cycle_ring) in state["gauges"].items():
+                    for pod in [p for p, (t, _v) in latest.items()
+                                if t < cutoff]:
+                        del latest[pod]
+                    if latest:
+                        values = [v for _t, v in latest.values()]
+                        # (t, fleet max, fleet mean): both reducers need
+                        # a windowed history, or multi-window SLO rules
+                        # on a gauge would be vacuous
+                        cycle_ring.append(
+                            (now, max(values),
+                             sum(values) / len(values)))
+                for series in list(state["counters"].values()) \
+                        + list(state["hist"].values()):
+                    for pod in [p for p, ring in series.items()
+                                if ring and ring[-1][0] < cutoff]:
+                        del series[pod]
+
+    # -- pure reads ----------------------------------------------------------
+
+    def jobs(self) -> list[str]:
+        with self._lock:
+            return list(self._jobs)
+
+    def forget(self, job: str) -> None:
+        """Drop a deleted job's rings.  Without this the job would live
+        in ``jobs()`` until LRU eviction — and the SLO evaluator, which
+        builds its job list from there, would recreate the deleted job's
+        rule state from the stale in-window samples and re-fire a breach
+        that no longer exists."""
+        with self._lock:
+            self._jobs.pop(job, None)
+
+    def counter_rate(self, job: str, family: str, window_s: float,
+                     now: float, labels: tuple = ()) -> float | None:
+        """Fleet rate: sum of per-pod reset-aware rates over the window."""
+        with self._lock:
+            state = self._jobs.get(job)
+            if state is None:
+                return None
+            series = state["counters"].get((family, tuple(labels)))
+            if not series:
+                return None
+            rates = [r for r in
+                     (_counter_rate(ring, now, window_s)
+                      for ring in series.values())
+                     if r is not None]
+        return sum(rates) if rates else None
+
+    def gauge_stats(self, job: str, family: str,
+                    labels: tuple = ()) -> dict | None:
+        """Latest per-pod readings → fleet max/mean/sum."""
+        with self._lock:
+            state = self._jobs.get(job)
+            if state is None:
+                return None
+            entry = state["gauges"].get((family, tuple(labels)))
+            if entry is None or not entry[0]:
+                return None
+            values = [v for _t, v in entry[0].values()]
+        return {"max": max(values), "mean": sum(values) / len(values),
+                "sum": sum(values), "pods": len(values)}
+
+    def gauge_window_mean(self, job: str, family: str, window_s: float,
+                          now: float, of: str = "max",
+                          labels: tuple = ()) -> float | None:
+        """Windowed mean of the per-cycle fleet **max** (``of="max"`` —
+        "was the worst pod's queue depth above X, sustained?") or fleet
+        **mean** (``of="mean"``).  Both SLO gauge reducers read here so
+        short and long windows genuinely differ."""
+        idx = 1 if of == "max" else 2
+        with self._lock:
+            state = self._jobs.get(job)
+            if state is None:
+                return None
+            entry = state["gauges"].get((family, tuple(labels)))
+            if entry is None:
+                return None
+            cutoff = now - window_s
+            points = [p[idx] for p in entry[1] if p[0] >= cutoff]
+        return sum(points) / len(points) if points else None
+
+
+    def histogram_window(self, job: str, family: str, window_s: float,
+                         now: float, labels: tuple = ()) -> dict | None:
+        """Fleet-merged windowed histogram: ``{"buckets": [(le, cum)],
+        "count": n}`` with per-pod deltas over the window summed, then
+        accumulated back to cumulative form for quantile estimation."""
+        with self._lock:
+            state = self._jobs.get(job)
+            if state is None:
+                return None
+            series = state["hist"].get((family, tuple(labels)))
+            if not series:
+                return None
+            per_pod = []
+            for ring in series.values():
+                sl = _window_slice(ring, now, window_s)
+                if sl is None:
+                    continue
+                per_pod.append((sl[0][1], sl[1][1]))
+        if not per_pod:
+            return None
+        # per-le deltas of CUMULATIVE counts are themselves cumulative in
+        # le (new−old preserves monotonicity), so the merge is directly
+        # quantile-ready — re-accumulating would double-count
+        return _merge_bucket_deltas(per_pod)
+
+    def quantile(self, job: str, family: str, q: float, window_s: float,
+                 now: float, labels: tuple = ()) -> float | None:
+        win = self.histogram_window(job, family, window_s, now, labels)
+        if win is None:
+            return None
+        return quantile_from_buckets(win["buckets"], q)
+
+    def rollup(self, job: str, now: float,
+               windows: tuple = (30.0, 300.0)) -> dict:
+        """The /debug/fleet per-job payload: every retained family's
+        windowed rates / gauge stats / quantiles.  A pure read."""
+        with self._lock:
+            state = self._jobs.get(job)
+            if state is None:
+                return {}
+            counter_keys = list(state["counters"])
+            gauge_keys = list(state["gauges"])
+            hist_keys = list(state["hist"])
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for family, labels in counter_keys:
+            entry: dict = {}
+            for w in windows:
+                rate = self.counter_rate(job, family, w, now, labels)
+                if rate is not None:
+                    entry[f"rate_{int(w)}s"] = round(rate, 4)
+            if entry:
+                out["counters"][_display(family, labels)] = entry
+        for family, labels in gauge_keys:
+            stats = self.gauge_stats(job, family, labels)
+            if stats:
+                stats = {k: (round(v, 4) if isinstance(v, float) else v)
+                         for k, v in stats.items()}
+                out["gauges"][_display(family, labels)] = stats
+        for family, labels in hist_keys:
+            entry = {}
+            for w in windows:
+                win = self.histogram_window(job, family, w, now, labels)
+                if win is None:
+                    continue
+                for q in (0.5, 0.99):
+                    val = quantile_from_buckets(win["buckets"], q)
+                    if val is not None:
+                        entry[f"p{int(q * 100)}_{int(w)}s"] = round(val, 6)
+                entry[f"count_{int(w)}s"] = win["count"]
+            if entry:
+                out["histograms"][_display(family, labels)] = entry
+        return out
+
+
+def _display(family: str, labels: tuple) -> str:
+    if not labels:
+        return family
+    pairs = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{family}{{{pairs}}}"

@@ -1,0 +1,67 @@
+"""/debug/fleet responder (mirror of trace.debug_traces_response,
+scheduler.debug_scheduler_response, and flight.debug_timeline_response —
+ONE implementation shared by the metrics server and the dashboard
+backend, so both speak the same contract).
+
+Routes:
+
+- ``/debug/fleet``                 — plane summary (jobs, targets,
+  staleness, scrape counters, SLO rules + breach flags)
+- ``/debug/fleet?job=<ns/name>``   — that job's windowed rollups
+  (counter rates, gauge stats, histogram p50/p99), targets, SLO state,
+  and its recent events
+- ``?since=<seq>``                 — only events newer than seq
+  (incremental polling; the response echoes ``last_seq`` back)
+- ``?n=<limit>``                   — most recent N events
+
+404 with an explicit body while no fleet plane is active (the v2
+controller starts one when fleet scraping is enabled) — the same
+contract as every other /debug route.
+"""
+
+from __future__ import annotations
+
+import json
+from urllib.parse import parse_qs
+
+
+def debug_fleet_response(plane, query: str = "") -> tuple[int, str, str]:
+    """(status_code, body, content_type) for GET /debug/fleet."""
+    if plane is None or not plane.active:
+        return (404,
+                "fleet telemetry inactive (enable K8S_TPU_FLEET_SCRAPE so "
+                "the v2 controller starts the scrape plane)\n",
+                "text/plain")
+    params = parse_qs(query or "")
+
+    def _int_param(name: str):
+        raw = (params.get(name) or [None])[0]
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            return None
+
+    job = (params.get("job") or [None])[0]
+    since = _int_param("since")
+    limit = _int_param("n")
+    if job:
+        events = plane.events(since=since, job=job)
+        if limit is not None and limit >= 0:
+            events = events[-limit:] if limit else []
+        body = json.dumps({
+            "job": job,
+            "rollup": plane.rollup(job),
+            "slo": plane.slo.state(job),
+            "targets": [t for t in plane.stats.targets()
+                        if t.get("job") == job],
+            "events": events,
+            # empty incremental polls echo the caller's since (the
+            # /debug/timeline contract: a last_seq of 0 would make the
+            # next ?since=0 poll re-download the ring)
+            "last_seq": events[-1]["seq"] if events else (since or 0),
+        }, indent=2, default=str)
+        return 200, body + "\n", "application/json"
+    body = json.dumps(plane.summary(), indent=2, sort_keys=True, default=str)
+    return 200, body + "\n", "application/json"

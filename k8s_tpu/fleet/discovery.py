@@ -1,0 +1,150 @@
+"""Scrape-target discovery from the informer's pod cache.
+
+The fleet plane never talks to the apiserver: the controller hands it a
+``targets_fn`` that reads the pod informer's *store* (plain dicts, the
+same zero-steady-LIST substrate every sync uses — PR 7's churn bench
+property is preserved by construction).  This module is the pure
+function from those cached pod dicts to scrape targets; it imports
+nothing from the client layer.
+
+A pod is scrape-discoverable when it is Running, not terminating, and
+declares a scrape port — either the ``kubeflow.org/fleet-scrape-port``
+annotation (what ``genjob --serve`` stamps) or a
+``K8S_TPU_FLEET_SCRAPE_PORT`` container env var.  The target address
+prefers the annotation host override (benches / exotic networks), then
+``status.podIP``, then the pod's per-index headless-service DNS name
+(the service the controller already created for it — no extra lookup
+needed, the name is derivable from the labels on the pod).
+"""
+
+from __future__ import annotations
+
+# Annotation keys (pod template metadata → every pod of the job).
+ANNOTATION_SCRAPE_PORT = "kubeflow.org/fleet-scrape-port"
+ANNOTATION_SCRAPE_PATH = "kubeflow.org/fleet-scrape-path"
+ANNOTATION_SCRAPE_HOST = "kubeflow.org/fleet-scrape-host"
+ANNOTATION_SCRAPE = "kubeflow.org/fleet-scrape"  # "false" opts a pod out
+
+# Env var fallback carried by serving containers (genjob --serve).
+ENV_SCRAPE_PORT = "K8S_TPU_FLEET_SCRAPE_PORT"
+
+# Same label keys as controller_v2/tpu_config.py — literal by design:
+# this package may not import controller modules (stdlib-only gate), and
+# the label contract is pinned by tests on both sides.
+_LABEL_REPLICA_TYPE = "tf-replica-type"
+_LABEL_REPLICA_INDEX = "tf-replica-index"
+_LABEL_TFJOB_KEY = "tf_job_key"
+
+
+class ScrapeTarget:
+    """One scrapeable pod: its owning job key (``namespace/name``), pod
+    identity, and the URL to GET."""
+
+    __slots__ = ("job", "namespace", "job_name", "pod", "index", "url")
+
+    def __init__(self, job: str, namespace: str, job_name: str, pod: str,
+                 index: str, url: str):
+        self.job = job
+        self.namespace = namespace
+        self.job_name = job_name
+        self.pod = pod
+        self.index = index
+        self.url = url
+
+    def key(self) -> str:
+        return f"{self.job}:{self.pod}"
+
+    def to_dict(self) -> dict:
+        return {"job": self.job, "pod": self.pod, "index": self.index,
+                "url": self.url}
+
+    def __repr__(self):
+        return f"ScrapeTarget({self.job}:{self.pod} -> {self.url})"
+
+
+def _controller_owner(meta: dict):
+    for ref in meta.get("ownerReferences") or []:
+        if ref.get("controller") and ref.get("kind") == "TFJob":
+            return ref
+    return None
+
+
+def scrape_port(pod: dict) -> int | None:
+    """The pod's declared fleet scrape port (annotation first, then the
+    container env), or None when the pod is not scrape-discoverable.
+    Public: the informer layer's fleet-scrape index keys off this same
+    predicate, so "indexed" and "discoverable" cannot drift apart."""
+    meta = pod.get("metadata") or {}
+    annotations = meta.get("annotations") or {}
+    raw = annotations.get(ANNOTATION_SCRAPE_PORT)
+    if raw is None:
+        for container in ((pod.get("spec") or {}).get("containers")) or []:
+            for env in container.get("env") or []:
+                if env.get("name") == ENV_SCRAPE_PORT:
+                    raw = env.get("value")
+                    break
+            if raw is not None:
+                break
+    if raw is None:
+        return None
+    try:
+        port = int(raw)
+    except (TypeError, ValueError):
+        return None
+    return port if 0 < port < 65536 else None
+
+
+def _dns_host(meta: dict) -> str | None:
+    """The pod's per-index headless-service DNS name, rebuilt from the
+    labels the controller stamped (tpu_config.gen_general_name contract:
+    ``<ns>-<name>-<rtype>-<index>.<ns>.svc.cluster.local``)."""
+    labels = meta.get("labels") or {}
+    job_key = labels.get(_LABEL_TFJOB_KEY)
+    rtype = labels.get(_LABEL_REPLICA_TYPE)
+    index = labels.get(_LABEL_REPLICA_INDEX)
+    ns = meta.get("namespace", "")
+    if not (job_key and rtype and index is not None and ns):
+        return None
+    return f"{job_key}-{rtype}-{index}.{ns}.svc.cluster.local"
+
+
+def targets_from_pods(pods: list[dict]) -> list[ScrapeTarget]:
+    """Resolve the scrapeable subset of the cached pods.
+
+    Pure function over store dicts — safe to call per scrape cycle, no
+    copies made, nothing mutated (the informer's read-only contract)."""
+    targets: list[ScrapeTarget] = []
+    for pod in pods:
+        meta = pod.get("metadata") or {}
+        if meta.get("deletionTimestamp"):
+            continue
+        if (pod.get("status") or {}).get("phase") != "Running":
+            continue
+        annotations = meta.get("annotations") or {}
+        if annotations.get(ANNOTATION_SCRAPE, "").lower() in ("false", "0"):
+            continue
+        port = scrape_port(pod)
+        if port is None:
+            continue
+        ref = _controller_owner(meta)
+        if ref is None:
+            continue
+        ns = meta.get("namespace", "")
+        job_name = ref.get("name", "")
+        host = (annotations.get(ANNOTATION_SCRAPE_HOST)
+                or (pod.get("status") or {}).get("podIP")
+                or _dns_host(meta))
+        if not host:
+            continue
+        path = annotations.get(ANNOTATION_SCRAPE_PATH) or "/metrics"
+        if not path.startswith("/"):
+            path = "/" + path
+        targets.append(ScrapeTarget(
+            job=f"{ns}/{job_name}" if ns else job_name,
+            namespace=ns,
+            job_name=job_name,
+            pod=meta.get("name", ""),
+            index=(meta.get("labels") or {}).get(_LABEL_REPLICA_INDEX, ""),
+            url=f"http://{host}:{port}{path}",
+        ))
+    return targets

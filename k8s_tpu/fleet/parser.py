@@ -1,0 +1,312 @@
+"""Prometheus text-exposition (0.0.4) parser + renderer.
+
+The scrape loop's wire format is exactly what ``util/metrics.py`` (and
+any real Prometheus client) emits: ``# HELP`` / ``# TYPE`` comments
+followed by sample lines ``name{label="value",...} 1.5``.  The parser
+groups samples into *families* keyed by base name — histogram
+``_bucket`` / ``_sum`` / ``_count`` suffix lines fold under the
+histogram's declared name — and validates the histogram contract
+(``le`` bounds present, numerically ordered, ``+Inf`` last and equal to
+``_count``) so a malformed exporter fails the scrape instead of
+corrupting fleet quantiles.
+
+``render`` is the exact inverse; ``tests/test_fleet.py`` pins the
+round trip over every family ``util/metrics.py`` exposes, so this
+parser and that exposition format cannot drift apart silently.
+
+Stdlib-only (``harness/py_checks.py`` gates the whole package).
+"""
+
+from __future__ import annotations
+
+_INF = float("inf")
+
+# sample-name suffixes that belong to a declared histogram family
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+class ParseError(ValueError):
+    """Malformed exposition text (carries the offending line number)."""
+
+    def __init__(self, message: str, lineno: int = 0):
+        super().__init__(f"line {lineno}: {message}" if lineno else message)
+        self.lineno = lineno
+
+
+class Family:
+    """One metric family: name, kind (counter/gauge/histogram/untyped),
+    help text, and its samples as ``(sample_name, labels_dict, value)``
+    triples in arrival order (``sample_name`` differs from ``name`` only
+    for histogram suffix lines)."""
+
+    __slots__ = ("name", "kind", "help", "samples", "_points")
+
+    def __init__(self, name: str, kind: str = "untyped", help_text: str = ""):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.samples: list[tuple[str, dict, float]] = []
+        # histogram_points memo: parse-time validation and the
+        # aggregator's ingest read the same decomposition; families are
+        # immutable after parsing, so computing it twice per scrape of
+        # every histogram (once under the aggregator lock) is pure waste
+        self._points = None
+
+    def values(self) -> dict:
+        """``{labels_tuple: value}`` for non-suffixed samples (counters
+        and gauges; histogram families use :func:`histogram_points`)."""
+        out = {}
+        for sname, labels, value in self.samples:
+            if sname == self.name:
+                out[tuple(sorted(labels.items()))] = value
+        return out
+
+    def __repr__(self):  # debugging aid only
+        return f"Family({self.name!r}, {self.kind!r}, {len(self.samples)} samples)"
+
+
+def _parse_value(raw: str, lineno: int) -> float:
+    raw = raw.strip()
+    if raw == "+Inf":
+        return _INF
+    if raw == "-Inf":
+        return -_INF
+    try:
+        return float(raw)
+    except ValueError:
+        raise ParseError(f"bad sample value {raw!r}", lineno) from None
+
+
+def _unescape(raw: str) -> str:
+    out = []
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if c == "\\" and i + 1 < len(raw):
+            nxt = raw[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt in ("\\", '"'):
+                out.append(nxt)
+            else:  # unknown escape: keep verbatim (prometheus behavior)
+                out.append(c)
+                out.append(nxt)
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_labels(raw: str, lineno: int) -> dict:
+    """``name="value",...`` (the text between ``{`` and ``}``)."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(raw)
+    while i < n:
+        eq = raw.find("=", i)
+        if eq < 0:
+            raise ParseError(f"bad label pair in {raw!r}", lineno)
+        key = raw[i:eq].strip().lstrip(",").strip()
+        if not key:
+            raise ParseError(f"empty label name in {raw!r}", lineno)
+        j = eq + 1
+        while j < n and raw[j] in " \t":
+            j += 1
+        if j >= n or raw[j] != '"':
+            raise ParseError(f"unquoted label value in {raw!r}", lineno)
+        j += 1
+        buf = []
+        while j < n:
+            c = raw[j]
+            if c == "\\" and j + 1 < n:
+                buf.append(c)
+                buf.append(raw[j + 1])
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        if j >= n:
+            raise ParseError(f"unterminated label value in {raw!r}", lineno)
+        labels[key] = _unescape("".join(buf))
+        i = j + 1
+    return labels
+
+
+def _base_name(sample_name: str, families: dict) -> str:
+    """Fold histogram suffix lines under their declared family."""
+    for suffix in _HISTOGRAM_SUFFIXES:
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            fam = families.get(base)
+            if fam is not None and fam.kind == "histogram":
+                return base
+    return sample_name
+
+
+def parse_exposition(text: str) -> dict[str, Family]:
+    """Parse one exposition body into ``{family_name: Family}``.
+
+    Families appear in declaration order (dicts preserve insertion);
+    a sample line with no preceding ``# TYPE`` gets an ``untyped``
+    family.  Raises :class:`ParseError` on malformed lines — a scrape
+    of a broken exporter must count as a failed scrape.
+    """
+    families: dict[str, Family] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            # "# HELP name text..." / "# TYPE name kind"
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                name = parts[2]
+                fam = families.get(name)
+                if fam is None:
+                    fam = families[name] = Family(name)
+                if parts[1] == "TYPE":
+                    if len(parts) < 4:
+                        raise ParseError("TYPE line without a kind", lineno)
+                    fam.kind = parts[3].strip()
+                else:
+                    fam.help = parts[3] if len(parts) > 3 else ""
+            continue  # other comments are ignored per the format spec
+        # sample line: name[{labels}] value [timestamp]
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                raise ParseError(f"unbalanced braces in {line!r}", lineno)
+            sample_name = line[:brace].strip()
+            labels = _parse_labels(line[brace + 1:close], lineno)
+            rest = line[close + 1:].strip()
+        else:
+            fields = line.split()
+            if len(fields) < 2:
+                raise ParseError(f"sample line without a value: {line!r}",
+                                 lineno)
+            sample_name, rest = fields[0], " ".join(fields[1:])
+            labels = {}
+        if not sample_name:
+            raise ParseError(f"sample line without a name: {line!r}", lineno)
+        fields = rest.split()
+        if not fields:  # e.g. 'foo{a="b"}' — labels but no value
+            raise ParseError(f"sample line without a value: {line!r}",
+                             lineno)
+        value = _parse_value(fields[0], lineno)  # optional timestamp dropped
+        base = _base_name(sample_name, families)
+        fam = families.get(base)
+        if fam is None:
+            fam = families[base] = Family(base)
+        fam.samples.append((sample_name, labels, value))
+    _fold_stray_histogram_suffixes(families)
+    for fam in families.values():
+        if fam.kind == "histogram":
+            histogram_points(fam)  # validates le ordering / +Inf contract
+    return families
+
+
+def _fold_stray_histogram_suffixes(families: dict) -> None:
+    """Samples emitted BEFORE their family's ``# TYPE ... histogram``
+    line land in untyped ``<name>_bucket``/``_sum``/``_count`` families
+    (``_base_name`` can only fold suffixes under an already-declared
+    histogram).  Fold them back once the declaration is known — without
+    this, an out-of-order exporter's histogram data would be silently
+    dropped AND skip the +Inf/_count validation."""
+    for name, fam in list(families.items()):
+        if fam.kind != "histogram":
+            continue
+        for suffix in _HISTOGRAM_SUFFIXES:
+            stray = families.get(name + suffix)
+            if stray is None or stray.kind != "untyped" or stray.help:
+                continue  # a real (declared) family, not a stray
+            fam.samples.extend(stray.samples)
+            del families[name + suffix]
+
+
+def histogram_points(family: Family) -> dict:
+    """Per-labelset histogram decomposition with contract validation.
+
+    Returns ``{labels_tuple: {"buckets": [(le, cumulative_count), ...],
+    "sum": float, "count": float}}`` where ``labels_tuple`` excludes the
+    ``le`` label and buckets are sorted by bound.  Raises
+    :class:`ParseError` when bucket counts are not monotonically
+    non-decreasing with ``le``, when ``+Inf`` is missing, or when the
+    ``+Inf`` bucket disagrees with ``_count``.
+    """
+    if family.kind != "histogram":
+        raise ParseError(f"{family.name} is {family.kind}, not histogram")
+    if family._points is not None:
+        return family._points
+    points: dict[tuple, dict] = {}
+
+    def _point(labels: dict) -> dict:
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        return points.setdefault(key, {"buckets": [], "sum": None,
+                                       "count": None})
+
+    for sname, labels, value in family.samples:
+        if sname == family.name + "_bucket":
+            if "le" not in labels:
+                raise ParseError(
+                    f"{family.name}_bucket sample without an le label")
+            le = _parse_value(labels["le"], 0)
+            _point(labels)["buckets"].append((le, value))
+        elif sname == family.name + "_sum":
+            _point(labels)["sum"] = value
+        elif sname == family.name + "_count":
+            _point(labels)["count"] = value
+    for key, point in points.items():
+        buckets = sorted(point["buckets"])
+        point["buckets"] = buckets
+        if not buckets or buckets[-1][0] != _INF:
+            raise ParseError(
+                f"{family.name}{dict(key)}: histogram without a +Inf bucket")
+        last = -1.0
+        for le, cum in buckets:
+            if cum < last:
+                raise ParseError(
+                    f"{family.name}{dict(key)}: bucket counts decrease "
+                    f"at le={le!r} ({cum} < {last})")
+            last = cum
+        if point["count"] is not None and buckets[-1][1] != point["count"]:
+            raise ParseError(
+                f"{family.name}{dict(key)}: +Inf bucket "
+                f"{buckets[-1][1]} != _count {point['count']}")
+    family._points = points
+    return points
+
+
+def _format_value(v: float) -> str:
+    if v == _INF:
+        return "+Inf"
+    if v == -_INF:
+        return "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render(families: dict[str, Family]) -> str:
+    """The inverse of :func:`parse_exposition` (modulo float formatting):
+    used by the round-trip regression test and the bench's fake serving
+    pods."""
+    lines: list[str] = []
+    for fam in families.values():
+        lines.append(f"# HELP {fam.name} {fam.help}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for sname, labels, value in fam.samples:
+            if labels:
+                pairs = ",".join(f'{k}="{_escape(v)}"'
+                                 for k, v in labels.items())
+                lines.append(f"{sname}{{{pairs}}} {_format_value(value)}")
+            else:
+                lines.append(f"{sname} {_format_value(value)}")
+    return "\n".join(lines) + "\n" if lines else ""

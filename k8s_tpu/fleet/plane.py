@@ -1,0 +1,226 @@
+"""FleetPlane: the composed telemetry plane one controller owns.
+
+Discovery (``targets_fn`` over the informer cache) → scrape loop →
+aggregator → SLO evaluator, plus a bounded, sequence-numbered event
+ring (scrape failures, SLO transitions) that gives ``/debug/fleet`` the
+same ``?since=`` incremental-poll contract as ``/debug/timeline``.
+
+The plane starts *inactive*; ``/debug/fleet`` answers 404 with an
+explicit body until a controller (or bench) activates one — exactly the
+``/debug/traces`` / ``/debug/scheduler`` / ``/debug/timeline``
+contract.  External consumers (ROADMAP item 2's router/autoscaler) read
+``rollup()`` / ``slo.state()`` — pure in-memory reads.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+from k8s_tpu.fleet.aggregate import (
+    DEFAULT_FAMILY_PREFIXES,
+    FleetAggregator,
+)
+from k8s_tpu.fleet.scrape import (
+    DEFAULT_CONCURRENCY,
+    DEFAULT_INTERVAL_S,
+    DEFAULT_TIMEOUT_S,
+    OUTCOME_OK,
+    ScrapeLoop,
+    ScrapeStats,
+)
+from k8s_tpu.fleet.slo import DEFAULT_RULES_SPEC, SloEvaluator, parse_rules
+
+DEFAULT_WINDOWS = (30.0, 300.0)
+EVENT_RING_SIZE = 512
+
+
+class FleetPlane:
+    """One fleet telemetry plane (scraper + aggregator + SLO rules)."""
+
+    def __init__(self, targets_fn, *,
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 concurrency: int = DEFAULT_CONCURRENCY,
+                 windows: tuple = DEFAULT_WINDOWS,
+                 slo_rules: str | list = DEFAULT_RULES_SPEC,
+                 family_prefixes: tuple = DEFAULT_FAMILY_PREFIXES,
+                 max_jobs: int | None = None,
+                 fetch=None, url_override=None):
+        # ring depth ~ the long window at this cadence (+ slack), bounded
+        # so a 1s interval with a 5m window cannot grow unbounded
+        max_samples = max(8, min(4096, int(windows[-1] / interval_s) + 8))
+        self.windows = tuple(float(w) for w in windows)
+        self.interval_s = float(interval_s)
+        from k8s_tpu.fleet.aggregate import DEFAULT_MAX_JOBS
+
+        self.aggregator = FleetAggregator(max_samples=max_samples,
+                                          max_jobs=max_jobs
+                                          or DEFAULT_MAX_JOBS,
+                                          family_prefixes=family_prefixes)
+        rules = (parse_rules(slo_rules) if isinstance(slo_rules, str)
+                 else list(slo_rules))
+        self.slo = SloEvaluator(rules, self.aggregator, windows=self.windows)
+        self.stats = ScrapeStats()
+        self._url_override = url_override
+        self._targets_fn = targets_fn
+        self.loop = ScrapeLoop(
+            self._resolved_targets, self.aggregator, stats=self.stats,
+            interval_s=interval_s, timeout_s=timeout_s,
+            concurrency=concurrency, fetch=fetch,
+            on_cycle=self._on_cycle, on_failure=self._on_failure)
+        self._sinks: list = [self._event_ring_sink]
+        self._active = False
+        self._started_at: float | None = None
+        self._lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._events: deque = deque(maxlen=EVENT_RING_SIZE)
+
+    # -- wiring ---------------------------------------------------------------
+
+    @property
+    def url_override(self):
+        return self._url_override
+
+    @url_override.setter
+    def url_override(self, fn) -> None:
+        """Benches/tests rewrite target URLs (fake serving pods listen on
+        loopback ports, not pod DNS); discovery itself stays untouched so
+        the zero-apiserver-call property is still what's measured."""
+        self._url_override = fn
+
+    def _resolved_targets(self):
+        targets = list(self._targets_fn() or ())
+        override = self._url_override
+        if override is not None:
+            for t in targets:
+                url = override(t)
+                if url:
+                    t.url = url
+        return targets
+
+    def add_sink(self, sink) -> None:
+        """``sink(job, rule, state, breached)`` on every SLO transition
+        (the controller hangs the timeline event + K8s Event here)."""
+        self._sinks.append(sink)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def start(self) -> "FleetPlane":
+        self._active = True
+        self._started_at = time.time()
+        self.loop.start()
+        return self
+
+    def stop(self) -> None:
+        self.loop.stop()
+        self._active = False
+
+    def scrape_once(self, now: float | None = None) -> int:
+        """Synchronous single cycle (tests/benches); activates the plane
+        so debug surfaces serve what it gathered."""
+        self._active = True
+        if self._started_at is None:
+            self._started_at = time.time()
+        return self.loop.scrape_once(now)
+
+    def forget(self, job: str) -> None:
+        """Drop a deleted job's rule state, scrape counters, AND
+        aggregation rings — leaving the rings would let the next cycle
+        recreate the rule state from stale samples and re-fire a breach
+        for a job that no longer exists."""
+        self.slo.forget(job)
+        self.stats.forget(job)
+        self.aggregator.forget(job)
+
+    # -- cycle hooks ----------------------------------------------------------
+
+    def _on_cycle(self, targets, now: float) -> None:
+        jobs = sorted({t.job for t in targets} | set(self.aggregator.jobs()))
+        self.slo.evaluate(jobs, now, sinks=tuple(self._sinks))
+
+    def _on_failure(self, target, outcome: str, error: str) -> None:
+        self._record_event("scrape_failure", target.job, pod=target.pod,
+                           outcome=outcome, error=error[:200])
+
+    def _event_ring_sink(self, job: str, rule, state: dict,
+                         breached: bool) -> None:
+        self._record_event(
+            "slo_breach" if breached else "slo_recovered", job,
+            rule=rule.name,
+            burn_short=_round(state.get("burn_short")),
+            burn_long=_round(state.get("burn_long")))
+
+    def _record_event(self, kind: str, job: str, **attrs) -> None:
+        entry = {"ts": time.time(), "kind": kind, "job": job}
+        entry.update({k: v for k, v in attrs.items() if v is not None})
+        with self._lock:
+            entry["seq"] = next(self._seq)
+            self._events.append(entry)
+
+    # -- reads ----------------------------------------------------------------
+
+    def events(self, since: int | None = None,
+               job: str | None = None) -> list[dict]:
+        with self._lock:
+            entries = list(self._events)
+        if job:
+            entries = [e for e in entries if e["job"] == job]
+        if since is not None:
+            entries = [e for e in entries if e["seq"] > since]
+        return entries
+
+    def rollup(self, job: str, now: float | None = None) -> dict:
+        return self.aggregator.rollup(job, time.time() if now is None else now,
+                                      windows=self.windows)
+
+    def burn_rates(self) -> dict[tuple, float]:
+        """(job, rule) -> current short-window burn (the
+        ``fleet_slo_burn_rate`` gauge samples)."""
+        out = {}
+        for s in self.slo.state():
+            burn = s.get("burn_short")
+            if burn is not None:
+                out[(s["job"], s["rule"])] = burn
+        return out
+
+    def summary(self, now: float | None = None) -> dict:
+        now = time.time() if now is None else now
+        staleness = self.stats.staleness(now)
+        return {
+            "active": self._active,
+            "started_at": self._started_at,
+            "interval_s": self.interval_s,
+            "windows_s": list(self.windows),
+            "cycles": self.stats.cycles,
+            "last_cycle_s": round(self.stats.last_cycle_s, 4),
+            "jobs": {
+                job: {
+                    "targets": count,
+                    "staleness_s": (round(staleness[job], 3)
+                                    if staleness.get(job, float("inf"))
+                                    != float("inf") else None),
+                    "slo_breached": self.slo.breached(job),
+                }
+                for job, count in sorted(self.stats.target_count().items())
+            },
+            "rules": [r.to_dict() for r in self.slo.rules],
+            "scrapes": {
+                f"{job}:{outcome}": n
+                for (job, outcome), n in sorted(self.stats.counts().items())
+            },
+        }
+
+
+def _round(v):
+    return round(v, 4) if isinstance(v, float) else v
+
+
+# re-exported so plane consumers need one import
+__all__ = ["FleetPlane", "OUTCOME_OK", "DEFAULT_WINDOWS"]

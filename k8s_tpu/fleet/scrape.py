@@ -1,0 +1,303 @@
+"""Bounded-concurrency scrape loop with per-target deadlines.
+
+One cycle: resolve targets (a pure read over the informer cache via the
+plane's ``targets_fn``), fan the HTTP GETs over a fixed thread pool,
+parse each body through :mod:`k8s_tpu.fleet.parser`, and feed the
+aggregator.  Failures are *tracked, never raised* — a dead pod makes
+its target stale and its job's staleness gauge climb; it cannot stall
+the loop or the other targets.
+
+Self-observability (the ``fleet_scrape_*`` families the metrics module
+proxies): per-(job, outcome) scrape counts, a scrape-duration
+histogram, per-target last-success/failure state, and per-job
+staleness.  Intervals are jittered (±``jitter_frac``) so a fleet of
+operators scraping the same pods doesn't phase-lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor, wait
+
+DEFAULT_INTERVAL_S = 10.0
+DEFAULT_TIMEOUT_S = 2.0
+DEFAULT_CONCURRENCY = 8
+DEFAULT_JITTER_FRAC = 0.1
+
+# scrape-duration histogram bounds (seconds): scrapes are LAN-fast or
+# broken, so the resolution clusters low with a tail for sick targets
+DURATION_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0)
+
+OUTCOME_OK = "ok"
+OUTCOME_HTTP_ERROR = "http_error"
+OUTCOME_TIMEOUT = "timeout"
+OUTCOME_PARSE_ERROR = "parse_error"
+OUTCOME_ERROR = "error"
+
+
+def default_fetch(url: str, timeout_s: float) -> str:
+    """GET one exposition body (the production fetch seam; benches and
+    tests inject their own)."""
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        if resp.status != 200:
+            raise OSError(f"scrape got HTTP {resp.status}")
+        return resp.read().decode("utf-8", "replace")
+
+
+class ScrapeStats:
+    """Thread-safe scrape self-observability state.  Per-job scrape
+    counters are LRU-bounded by job (``max_count_jobs``): under the
+    repo's 2-5k-job churn regime a long-lived operator must not
+    accumulate a ``fleet_scrape_total`` label set (and the memory behind
+    it) for every job that ever existed — the least recently *scraped*
+    job's counters are evicted, the same bounded-everything contract as
+    the aggregator's job LRU and the plane's event ring.  (Prometheus
+    treats the resulting counter reset like any target restart.)"""
+
+    MAX_COUNT_JOBS = 1024
+
+    def __init__(self, max_count_jobs: int = MAX_COUNT_JOBS):
+        self._lock = threading.Lock()
+        self.max_count_jobs = max_count_jobs
+        # job -> {outcome: n}; OrderedDict gives LRU-by-scrape
+        self._counts: "OrderedDict[str, dict]" = OrderedDict()
+        self._duration_counts = [0] * len(DURATION_BUCKETS)
+        self._duration_sum = 0.0
+        self._duration_n = 0
+        self._targets: dict[str, dict] = {}  # target key -> status dict
+        self.cycles = 0
+        self.last_cycle_s = 0.0
+
+    def record(self, target, outcome: str, duration_s: float,
+               error: str = "", now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            per_job = self._counts.get(target.job)
+            if per_job is None:
+                per_job = self._counts[target.job] = {}
+                if len(self._counts) > self.max_count_jobs:
+                    self._counts.popitem(last=False)
+            else:
+                self._counts.move_to_end(target.job)
+            per_job[outcome] = per_job.get(outcome, 0) + 1
+            self._duration_sum += duration_s
+            self._duration_n += 1
+            for i, bound in enumerate(DURATION_BUCKETS):
+                if duration_s <= bound:
+                    self._duration_counts[i] += 1
+                    break
+            st = self._targets.setdefault(target.key(), {
+                "job": target.job, "pod": target.pod,
+                "last_success": None, "consecutive_failures": 0,
+            })
+            st["url"] = target.url
+            st["last_attempt"] = now
+            st["last_outcome"] = outcome
+            if outcome == OUTCOME_OK:
+                st["last_success"] = now
+                st["consecutive_failures"] = 0
+                st.pop("last_error", None)
+            else:
+                st["consecutive_failures"] += 1
+                st["last_error"] = error
+
+    def prune(self, live_keys: set) -> None:
+        """Drop state for targets discovery no longer returns (deleted or
+        scaled-down pods must not hold staleness forever)."""
+        with self._lock:
+            for key in [k for k in self._targets if k not in live_keys]:
+                del self._targets[key]
+
+    def counts(self) -> dict[tuple, int]:
+        """Flat ``{(job, outcome): n}`` view (the metric/label shape)."""
+        with self._lock:
+            return {(job, outcome): n
+                    for job, per_job in self._counts.items()
+                    for outcome, n in per_job.items()}
+
+    def forget(self, job: str) -> None:
+        """Drop a deleted job's scrape counters (cardinality hygiene —
+        the plane forwards controller-observed job deletions here)."""
+        with self._lock:
+            self._counts.pop(job, None)
+
+    def duration_samples(self) -> tuple:
+        """(bounds, per-bucket counts, sum, count) — the ProxyMetric
+        histogram shape ``util/metrics.flight_metrics`` also uses."""
+        with self._lock:
+            return (DURATION_BUCKETS, list(self._duration_counts),
+                    self._duration_sum, self._duration_n)
+
+    def targets(self) -> list[dict]:
+        with self._lock:
+            return [dict(v) for v in self._targets.values()]
+
+    def staleness(self, now: float | None = None) -> dict[str, float]:
+        """Per-job staleness: seconds since the *least recently
+        successful* target of the job (the straggler defines the job's
+        freshness — an aggregate missing one pod is not fresh)."""
+        now = time.time() if now is None else now
+        out: dict[str, float] = {}
+        with self._lock:
+            for st in self._targets.values():
+                last = st.get("last_success")
+                age = (now - last) if last is not None else float("inf")
+                job = st["job"]
+                if job not in out or age > out[job]:
+                    out[job] = age
+        return out
+
+    def target_count(self) -> dict[str, int]:
+        with self._lock:
+            counts: dict[str, int] = {}
+            for st in self._targets.values():
+                counts[st["job"]] = counts.get(st["job"], 0) + 1
+            return counts
+
+
+class ScrapeLoop:
+    """The cycle driver.  ``scrape_once`` is synchronous (tests and the
+    bench call it directly for determinism); ``start`` runs it on a
+    daemon thread at jittered intervals until ``stop``."""
+
+    def __init__(self, targets_fn, aggregator, *, stats: ScrapeStats,
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 concurrency: int = DEFAULT_CONCURRENCY,
+                 jitter_frac: float = DEFAULT_JITTER_FRAC,
+                 fetch=None, on_cycle=None, on_failure=None):
+        if interval_s <= 0 or timeout_s <= 0 or concurrency < 1:
+            raise ValueError("scrape loop needs positive interval/timeout "
+                             "and >= 1 concurrency")
+        self.targets_fn = targets_fn
+        self.aggregator = aggregator
+        self.stats = stats
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.concurrency = int(concurrency)
+        self.jitter_frac = float(jitter_frac)
+        self.fetch = fetch or default_fetch
+        self.on_cycle = on_cycle      # called (targets, now) after each cycle
+        self.on_failure = on_failure  # called (target, outcome, error)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._pool: ThreadPoolExecutor | None = None
+        self._pool_lock = threading.Lock()
+        # targets currently submitted to the pool: a cycle never
+        # re-enqueues a target whose previous scrape is still running,
+        # so a mass outage (every fetch riding its deadline) cannot grow
+        # the executor queue without bound cycle over cycle
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
+
+    def _get_pool(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.concurrency,
+                    thread_name_prefix="fleet-scrape")
+            return self._pool
+
+    def _scrape_target(self, target, now_fn) -> None:
+        from k8s_tpu.fleet import parser
+
+        t0 = time.monotonic()
+        outcome, error = OUTCOME_OK, ""
+        try:
+            body = self.fetch(target.url, self.timeout_s)
+            families = parser.parse_exposition(body)
+            self.aggregator.ingest(target.job, target.pod, families, now_fn())
+        except parser.ParseError as e:
+            outcome, error = OUTCOME_PARSE_ERROR, str(e)
+        except TimeoutError as e:
+            outcome, error = OUTCOME_TIMEOUT, str(e) or "timed out"
+        except OSError as e:
+            # urllib timeouts surface as socket.timeout (an OSError) or
+            # URLError wrapping one; classify by message so the staleness
+            # story distinguishes slow from refused
+            msg = str(e)
+            outcome = OUTCOME_TIMEOUT if "timed out" in msg \
+                else OUTCOME_HTTP_ERROR
+            error = msg
+        except Exception as e:  # noqa: BLE001 - tracked, never raised
+            outcome, error = OUTCOME_ERROR, f"{type(e).__name__}: {e}"
+        finally:
+            with self._inflight_lock:
+                self._inflight.discard(target.key())
+        self.stats.record(target, outcome, time.monotonic() - t0, error)
+        if outcome != OUTCOME_OK and self.on_failure is not None:
+            try:
+                self.on_failure(target, outcome, error)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def scrape_once(self, now: float | None = None) -> int:
+        """One full cycle: discover, fan out, wait (bounded by the
+        per-target timeout + slack), aggregate, evaluate.  Returns the
+        number of targets scraped."""
+        t_cycle = time.monotonic()
+        now = time.time() if now is None else now
+        targets = list(self.targets_fn() or ())
+        self.stats.prune({t.key() for t in targets})
+        if targets:
+            pool = self._get_pool()
+            # skip targets whose previous scrape is still in flight
+            # (mass-outage cycles must not stack duplicate fetches)
+            with self._inflight_lock:
+                fresh = [t for t in targets
+                         if t.key() not in self._inflight]
+                self._inflight.update(t.key() for t in fresh)
+            futures = [pool.submit(self._scrape_target, t, lambda: now)
+                       for t in fresh]
+            # budget for the WHOLE fan-out: with targets >> concurrency
+            # the pool legitimately needs batches * deadline of wall
+            # clock (every fetch has its own deadline inside); 2x slack
+            # covers resolver stalls the socket timeout doesn't
+            batches = -(-max(len(fresh), 1) // self.concurrency)
+            wait(futures, timeout=batches * self.timeout_s * 2 + 5.0)
+        self.aggregator.cycle_done(now, stale_after_s=self.interval_s * 3)
+        if self.on_cycle is not None:
+            try:
+                self.on_cycle(targets, now)
+            except Exception:  # noqa: BLE001 - evaluation must not kill the loop
+                import logging
+
+                logging.getLogger(__name__).exception("fleet cycle hook")
+        self.stats.cycles += 1
+        self.stats.last_cycle_s = time.monotonic() - t_cycle
+        return len(targets)
+
+    def _run(self) -> None:
+        import random
+
+        while not self._stop.is_set():
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 - the loop must survive anything
+                import logging
+
+                logging.getLogger(__name__).exception("fleet scrape cycle")
+            jitter = 1.0 + random.uniform(-self.jitter_frac, self.jitter_frac)
+            self._stop.wait(self.interval_s * jitter)
+
+    def start(self) -> "ScrapeLoop":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="fleet-scrape-loop")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s * 2 + 5.0)
+            self._thread = None
+        with self._pool_lock:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+                self._pool = None

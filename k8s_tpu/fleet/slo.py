@@ -1,0 +1,229 @@
+"""Multi-window SLO burn-rate rules over the fleet aggregates.
+
+A rule names a family, a reducer, and a bound, in a compact spec string
+(the ``K8S_TPU_FLEET_SLO`` knob / docs syntax):
+
+    serve_request_duration_seconds:p99<0.5,serve_queue_depth:max<48
+
+Two reducer shapes:
+
+- **quantile rules** (``p50``/``p90``/``p99`` on a histogram family):
+  the *burn rate* over a window is the fraction of observations above
+  the bound divided by the error budget the quantile allows (``p99 <
+  0.5s`` budgets 1% of requests above 0.5s; 3% slow ⇒ burn 3.0).
+- **gauge rules** (``max``/``mean`` on a gauge family): burn is the
+  windowed mean of the per-cycle fleet max (or mean) over the bound
+  (queue depth sustained at 2x its bound ⇒ burn 2.0).
+
+Breach needs burn ≥ 1 in **both** windows (default 30s/5m): the short
+window makes detection fast, the long window keeps a transient spike
+from flapping the rule — the standard SRE multi-window construction.
+State transitions (ok → breached and back) fire the plane's sinks,
+which is where the controller hangs the flight-timeline event and the
+K8s Event; the current burn is exported as the ``fleet_slo_burn_rate``
+gauge either way.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_QUANTILE_REDUCERS = {"p50": 0.50, "p90": 0.90, "p99": 0.99}
+_GAUGE_REDUCERS = ("max", "mean")
+
+DEFAULT_RULES_SPEC = ("serve_request_duration_seconds:p99<0.5,"
+                      "serve_queue_depth:max<48")
+
+
+class RuleError(ValueError):
+    """Malformed SLO rule spec."""
+
+
+class SloRule:
+    """One parsed rule: ``<family>:<reducer><op><bound>`` (op is ``<``)."""
+
+    __slots__ = ("family", "reducer", "bound", "name")
+
+    def __init__(self, family: str, reducer: str, bound: float):
+        if reducer not in _QUANTILE_REDUCERS and reducer not in _GAUGE_REDUCERS:
+            raise RuleError(f"unknown reducer {reducer!r} (expected one of "
+                            f"{sorted(_QUANTILE_REDUCERS)} + "
+                            f"{list(_GAUGE_REDUCERS)})")
+        if bound <= 0:
+            raise RuleError(f"rule bound must be > 0, got {bound}")
+        self.family = family
+        self.reducer = reducer
+        self.bound = bound
+        self.name = f"{family}:{reducer}<{_trim(bound)}"
+
+    @property
+    def quantile(self) -> float | None:
+        return _QUANTILE_REDUCERS.get(self.reducer)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "family": self.family,
+                "reducer": self.reducer, "bound": self.bound}
+
+
+def _trim(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def parse_rules(spec: str) -> list[SloRule]:
+    """Parse the comma-separated rule spec; raises :class:`RuleError` on
+    malformed entries (a silently-dropped SLO rule is an outage that
+    never pages)."""
+    rules = []
+    for chunk in (spec or "").split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        if ":" not in chunk or "<" not in chunk:
+            raise RuleError(f"bad rule {chunk!r} "
+                            "(expected family:reducer<bound)")
+        family, _, rest = chunk.partition(":")
+        reducer, _, bound_raw = rest.partition("<")
+        try:
+            bound = float(bound_raw)
+        except ValueError:
+            raise RuleError(f"bad bound {bound_raw!r} in {chunk!r}") from None
+        rules.append(SloRule(family.strip(), reducer.strip(), bound))
+    return rules
+
+
+class SloEvaluator:
+    """Evaluates every rule against every known job once per scrape
+    cycle and tracks breach state per (job, rule)."""
+
+    def __init__(self, rules: list[SloRule], aggregator,
+                 windows: tuple = (30.0, 300.0)):
+        if len(windows) != 2 or windows[0] >= windows[1]:
+            raise RuleError("windows must be (short, long) with short < long")
+        self.rules = list(rules)
+        self.aggregator = aggregator
+        self.windows = tuple(float(w) for w in windows)
+        self._lock = threading.Lock()
+        # (job, rule.name) -> state dict
+        self._state: dict[tuple, dict] = {}
+        self.breaches_total: dict[tuple, int] = {}
+
+    def _burn(self, job: str, rule: SloRule, window_s: float,
+              now: float) -> float | None:
+        from k8s_tpu.fleet.aggregate import fraction_above
+
+        agg = self.aggregator
+        q = rule.quantile
+        if q is not None:
+            win = agg.histogram_window(job, rule.family, window_s, now)
+            if win is None or win["count"] <= 0:
+                return None
+            bad = fraction_above(win["buckets"], rule.bound)
+            if bad is None:
+                return None
+            budget = 1.0 - q
+            return bad / budget if budget > 0 else None
+        # both gauge reducers are WINDOWED (mean of the per-cycle fleet
+        # max or fleet mean): an instantaneous read would make the two
+        # windows identical and the multi-window construction vacuous
+        value = agg.gauge_window_mean(job, rule.family, window_s, now,
+                                      of=rule.reducer)
+        if value is None:
+            return None
+        return value / rule.bound
+
+    def evaluate(self, jobs: list[str], now: float, sinks=()) -> None:
+        """One evaluation pass over the CURRENT job set; calls
+        ``sink(job, rule, state, breached)`` on every ok↔breached
+        transition.  Sinks run outside the lock and are fail-soft (a
+        broken sink cannot stall the scrape loop).
+
+        Two non-obvious rules keep churn honest: a **data gap** (no
+        samples in either window — scrape outage, aggregator ring
+        eviction) holds the last state instead of flipping a breached
+        job to "recovered" (absence of evidence is not recovery); and
+        state for jobs absent from ``jobs`` is **pruned** (the plane
+        passes targets ∪ aggregator jobs, so a vanished job's rule
+        state cannot accumulate past the aggregator's own LRU bound)."""
+        short_w, long_w = self.windows
+        transitions = []
+        job_set = set(jobs)
+        for job in jobs:
+            for rule in self.rules:
+                burn_short = self._burn(job, rule, short_w, now)
+                burn_long = self._burn(job, rule, long_w, now)
+                no_data = burn_short is None and burn_long is None
+                full_data = (burn_short is not None
+                             and burn_long is not None)
+                breached = (full_data and burn_short >= 1.0
+                            and burn_long >= 1.0)
+                key = (job, rule.name)
+                with self._lock:
+                    state = self._state.get(key)
+                    if state is None:
+                        if no_data:
+                            continue  # nothing known: no state to hold
+                        state = self._state[key] = {
+                            "job": job, "rule": rule.name,
+                            "breached": False, "since": None,
+                        }
+                    state["burn_short"] = burn_short
+                    state["burn_long"] = burn_long
+                    state["checked_at"] = now
+                    if not full_data:
+                        # total OR partial gap (e.g. the short window
+                        # emptied mid-outage while the long still holds
+                        # old samples): neither breach nor recovery is
+                        # affirmable — hold the last verdict.  A breach
+                        # needs full data by construction, and flipping
+                        # a breached rule to "recovered" because its
+                        # pods stopped answering would page-resolve the
+                        # very outage that caused the breach.
+                        continue
+                    if breached != state["breached"]:
+                        state["breached"] = breached
+                        state["since"] = now if breached else None
+                        if breached:
+                            self.breaches_total[key] = \
+                                self.breaches_total.get(key, 0) + 1
+                        transitions.append((job, rule, dict(state), breached))
+        with self._lock:
+            for key in [k for k in self._state if k[0] not in job_set]:
+                del self._state[key]
+            for key in [k for k in self.breaches_total
+                        if k[0] not in job_set]:
+                del self.breaches_total[key]
+        for job, rule, state, breached in transitions:
+            for sink in sinks:
+                try:
+                    sink(job, rule, state, breached)
+                except Exception:  # noqa: BLE001 - sinks are best-effort
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "SLO sink failed for %s %s", job, rule.name)
+
+    def state(self, job: str | None = None) -> list[dict]:
+        """Current per-(job, rule) burn/breach snapshot (a pure read)."""
+        with self._lock:
+            out = [dict(s) for k, s in self._state.items()
+                   if job is None or k[0] == job]
+        return sorted(out, key=lambda s: (s["job"], s["rule"]))
+
+    def breaches(self) -> dict[tuple, int]:
+        """(job, rule) -> lifetime breach-transition count (the
+        ``fleet_slo_breaches_total`` samples)."""
+        with self._lock:
+            return dict(self.breaches_total)
+
+    def breached(self, job: str) -> bool:
+        with self._lock:
+            return any(s["breached"] for k, s in self._state.items()
+                       if k[0] == job)
+
+    def forget(self, job: str) -> None:
+        """Drop a deleted job's rule state (no stale breach pinning)."""
+        with self._lock:
+            for key in [k for k in self._state if k[0] == job]:
+                del self._state[key]
+            for key in [k for k in self.breaches_total if k[0] == job]:
+                del self.breaches_total[key]

@@ -942,6 +942,429 @@ def bench_churn(jobs: int = 2000, replicas: int = 1,
     return result
 
 
+class _FleetPodStubs:
+    """N fake serving pods behind ONE loopback HTTP server: each path
+    ``/pod/<i>/metrics`` serves a deterministic ``serve_*`` exposition —
+    a token counter advancing at a known per-pod rate, a small queue-
+    depth gauge, and a latency histogram whose distribution is 98% under
+    0.1s / 2% in (0.25, 0.5] (true fleet p99 = 0.375s by interpolation).
+    Flipping a pod set to *slow* mode freezes the good counters and
+    routes ALL new observations into (1.0, 2.5] — cumulative counters
+    never rewrite history, exactly like a real exporter under a latency
+    regression.  Float counts by design: the distribution fractions stay
+    exact at any elapsed time, so the bench's reference quantile is
+    closed-form."""
+
+    OBS_RATE = 200.0  # latency observations per second per pod
+    FAST_FRAC = 0.98  # <= 0.1s
+    MID_FRAC = 0.02   # (0.25, 0.5]
+    TRUE_P99 = 0.375  # 0.25 + 0.25 * (0.99 - 0.98) / 0.02
+
+    def __init__(self, n: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.n = n
+        self.t0 = time.monotonic()
+        self.rates = [40.0 + 10.0 * (i % 8) for i in range(n)]
+        self.depths = [float(i % 5) for i in range(n)]
+        # pod index -> monotonic flip time (None = healthy)
+        self.slow_since: dict[int, float] = {}
+        stubs = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    i = int(self.path.split("/")[2])
+                    body = stubs.render(i).encode()
+                except Exception:  # noqa: BLE001
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class Server(ThreadingHTTPServer):
+            # the scrape fan-out opens up to K8S_TPU_FLEET_CONCURRENCY
+            # connections at once; the default listen backlog of 5 drops
+            # SYNs and the kernel's 1s retransmit would dominate the
+            # measured cycle cost
+            request_queue_size = 128
+            daemon_threads = True
+
+        self.httpd = Server(("127.0.0.1", 0), Handler)
+        import threading
+
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name="fleet-stubs")
+        self._thread.start()
+        self.port = self.httpd.server_address[1]
+
+    def url(self, i: int) -> str:
+        return f"http://127.0.0.1:{self.port}/pod/{i}/metrics"
+
+    def flip_slow(self, indices) -> float:
+        t = time.monotonic()
+        for i in indices:
+            self.slow_since.setdefault(i, t)
+        return t
+
+    def render(self, i: int) -> str:
+        now = time.monotonic()
+        el = now - self.t0
+        flip = self.slow_since.get(i)
+        good_el = el if flip is None else (flip - self.t0)
+        slow_el = 0.0 if flip is None else (now - flip)
+        fast = self.FAST_FRAC * self.OBS_RATE * good_el
+        mid = self.MID_FRAC * self.OBS_RATE * good_el
+        slow = self.OBS_RATE * slow_el
+        total = fast + mid + slow
+        tokens = self.rates[i] * el
+        return (
+            "# HELP serve_tokens_total Tokens emitted.\n"
+            "# TYPE serve_tokens_total counter\n"
+            f"serve_tokens_total {tokens}\n"
+            "# HELP serve_queue_depth Admission queue depth.\n"
+            "# TYPE serve_queue_depth gauge\n"
+            f"serve_queue_depth {self.depths[i]}\n"
+            "# HELP serve_request_duration_seconds Request latency.\n"
+            "# TYPE serve_request_duration_seconds histogram\n"
+            f'serve_request_duration_seconds_bucket{{le="0.1"}} {fast}\n'
+            f'serve_request_duration_seconds_bucket{{le="0.25"}} {fast}\n'
+            f'serve_request_duration_seconds_bucket{{le="0.5"}} '
+            f"{fast + mid}\n"
+            f'serve_request_duration_seconds_bucket{{le="1.0"}} '
+            f"{fast + mid}\n"
+            f'serve_request_duration_seconds_bucket{{le="2.5"}} '
+            f"{fast + mid + slow}\n"
+            f'serve_request_duration_seconds_bucket{{le="+Inf"}} {total}\n'
+            f"serve_request_duration_seconds_sum "
+            f"{0.05 * fast + 0.375 * mid + 1.75 * slow}\n"
+            f"serve_request_duration_seconds_count {total}\n"
+        )
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def _fleet_gang_job(name: str, namespace: str, replicas: int,
+                    scrape_port: int) -> dict:
+    """A serving-shaped Worker gang whose pod template carries the fleet
+    scrape annotation (what ``genjob --serve`` stamps) — every pod the
+    controller creates from it is fleet-discoverable from the informer
+    cache alone."""
+    job = _worker_gang_job(name, namespace, replicas)
+    template = job["spec"]["tfReplicaSpecs"]["Worker"]["template"]
+    template.setdefault("metadata", {}).setdefault("annotations", {})[
+        "kubeflow.org/fleet-scrape-port"] = str(scrape_port)
+    return job
+
+
+def bench_fleet(pods: int = 32, jobs: int = 4, interval_s: float = 0.25,
+                steady_cycles: int = 8, timeout_s: float = 60.0) -> dict:
+    """The --fleet scenario (ISSUE 8): ``jobs`` serving TFJobs totalling
+    ``pods`` fake serving pods, scraped by the controller's fleet plane,
+    with EMBEDDED assertions (raise on failure — this bench is the
+    acceptance proof of the telemetry plane, not advisory trend data):
+
+    - **aggregation truth**: each job's fleet ``serve_tokens_total`` rate
+      matches the sum of its pods' known per-pod rates within 10%;
+    - **quantile truth**: fleet p99 from the merged per-pod histograms
+      matches the closed-form reference (0.375s) within 0.02s;
+    - **zero apiserver cost**: a steady scraping window adds ZERO
+      apiserver calls (flight-recorder-verified — discovery reads the
+      informer cache, PR 7's property);
+    - **breach latency**: flipping one job's pods to slow latency trips
+      the p99 burn-rate rule within two scrape intervals and lands a
+      flight-timeline event plus a K8s Event through the aggregating
+      recorder;
+    - **scrape health**: every target scraped with zero failures and
+      cycle cost bounded under the interval.
+    """
+    import os
+
+    from k8s_tpu import flight
+    from k8s_tpu.client.gvr import EVENTS, TFJOBS_V1ALPHA2
+    from k8s_tpu.e2e.local import LocalCluster
+
+    if pods < jobs or jobs < 2:
+        raise ValueError("--fleet needs >= 2 jobs and >= 1 pod per job")
+    replicas = pods // jobs
+    pods = replicas * jobs  # keep gangs uniform
+    ns = "bench"
+    short_w = max(4 * interval_s, 1.0)
+    long_w = 4 * short_w
+    flight.reset_all()
+    stubs = _FleetPodStubs(pods)
+    env_overrides = {"K8S_TPU_FLEET_WINDOWS": f"{short_w},{long_w}"}
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        lc = LocalCluster(version="v1alpha2", namespace=ns,
+                          enable_gang_scheduling=False,
+                          kubelet_kwargs={
+                              "default_runtime_s": 20 * timeout_s},
+                          threadiness=2, resync_period_s=1.0,
+                          fleet_scrape=True, fleet_interval_s=interval_s)
+    finally:
+        # restored even when construction raises: a leaked 1s/4s window
+        # override would quietly reshape later scenarios' SLO math
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    # same rationale as --churn: the kubelet's relist fallback is a
+    # harness artifact; park it so the zero-call window measures the
+    # operator + fleet plane only
+    lc.kubelet.RELIST_FALLBACK_S = 100 * timeout_s
+    plane = lc.controller.fleet_plane
+    # fake pods have no pod network: rewrite each target's URL onto its
+    # loopback stub by (job, replica index) — discovery itself still
+    # resolves from the informer cache, which is what's under test
+    job_names = [f"fleet-{j}" for j in range(jobs)]
+    stub_index = {(f"{ns}/{job_names[j]}", str(r)): j * replicas + r
+                  for j in range(jobs) for r in range(replicas)}
+    plane.url_override = lambda t: (
+        stubs.url(stub_index[(t.job, t.index)])
+        if (t.job, t.index) in stub_index else None)
+
+    failures: list[str] = []
+    acct = flight.ACCOUNTING
+    try:
+        with lc:
+            jw = lc.backend.watch(TFJOBS_V1ALPHA2, ns)
+            try:
+                ready: set[str] = set()
+                for name in job_names:
+                    lc.clientset.tfjobs_unstructured(ns).create(
+                        _fleet_gang_job(name, ns, replicas, 9100))
+                deadline = time.perf_counter() + timeout_s
+                while len(ready) < jobs:
+                    if time.perf_counter() >= deadline:
+                        raise RuntimeError(
+                            f"fleet bench: only {len(ready)}/{jobs} jobs "
+                            f"Running in {timeout_s}s")
+                    item = jw.next(timeout=0.2)
+                    if item is None:
+                        continue
+                    _et, job = item
+                    if _all_replicas_running(job):
+                        ready.add((job.get("metadata") or {}).get("name"))
+            finally:
+                jw.stop()
+
+            # wait for full discovery + first scrapes of every target
+            deadline = time.perf_counter() + timeout_s
+            while sum(plane.stats.target_count().values()) < pods:
+                if time.perf_counter() >= deadline:
+                    raise RuntimeError(
+                        f"fleet bench: only "
+                        f"{sum(plane.stats.target_count().values())}/{pods} "
+                        f"targets discovered in {timeout_s}s")
+                time.sleep(interval_s / 4)
+            # let the rings grow past the short window before measuring
+            time.sleep(short_w + 2 * interval_s)
+
+            # -- steady window: zero apiserver calls ----------------------
+            c0, l0 = acct.total(), acct.count(verb="LIST")
+            cycles0 = plane.stats.cycles
+            time.sleep(steady_cycles * interval_s)
+            steady_calls = acct.total() - c0
+            steady_lists = acct.count(verb="LIST") - l0
+            steady_scrape_cycles = plane.stats.cycles - cycles0
+            if steady_calls:
+                failures.append(
+                    f"steady scraping cost {steady_calls} apiserver "
+                    f"call(s) ({steady_lists} LISTs) over "
+                    f"{steady_scrape_cycles} cycles — discovery must be "
+                    "store-only")
+            if steady_scrape_cycles < max(1, steady_cycles // 2):
+                failures.append(
+                    f"scrape loop stalled: {steady_scrape_cycles} cycles "
+                    f"in a {steady_cycles}-cycle window")
+
+            # -- aggregation truth ----------------------------------------
+            now = time.time()
+            rate_checks = {}
+            for j, name in enumerate(job_names):
+                key = f"{ns}/{name}"
+                truth = sum(stubs.rates[j * replicas + r]
+                            for r in range(replicas))
+                measured = plane.aggregator.counter_rate(
+                    key, "serve_tokens_total", short_w, now)
+                rate_checks[key] = {
+                    "truth": round(truth, 1),
+                    "measured": round(measured, 1)
+                    if measured is not None else None,
+                }
+                if measured is None or abs(measured - truth) > 0.10 * truth:
+                    failures.append(
+                        f"{key}: aggregated tokens/s {measured} vs known "
+                        f"per-pod truth {truth} (>10% off)")
+            p99_checks = {}
+            for name in job_names:
+                key = f"{ns}/{name}"
+                p99 = plane.aggregator.quantile(
+                    key, "serve_request_duration_seconds", 0.99, short_w,
+                    now)
+                p99_checks[key] = round(p99, 4) if p99 is not None else None
+                if p99 is None or abs(p99 - stubs.TRUE_P99) > 0.02:
+                    failures.append(
+                        f"{key}: fleet p99 {p99} vs reference "
+                        f"{stubs.TRUE_P99} (merged-histogram quantile off)")
+
+            # -- breach detection latency ---------------------------------
+            victim = f"{ns}/{job_names[0]}"
+            t_flip = stubs.flip_slow(range(replicas))
+            detect_deadline = time.monotonic() + max(10 * interval_s, 10.0)
+            detect_latency = None
+            while time.monotonic() < detect_deadline:
+                if plane.slo.breached(victim):
+                    detect_latency = time.monotonic() - t_flip
+                    break
+                time.sleep(interval_s / 10)
+            breach_budget = 2 * interval_s + max(0.5 * interval_s, 0.3)
+            if detect_latency is None:
+                failures.append(
+                    f"latency breach never tripped the burn-rate rule for "
+                    f"{victim}")
+            elif detect_latency > breach_budget:
+                failures.append(
+                    f"breach detected after {detect_latency:.2f}s "
+                    f"(> two scrape intervals + slack = "
+                    f"{breach_budget:.2f}s)")
+            # breached() flips before the evaluator's sinks run (state
+            # commits under the lock, sinks fire after the pass), so the
+            # timeline entry gets the same grace the Event check below has
+            timeline_kinds: list = []
+            tl_deadline = time.monotonic() + 5.0
+            while time.monotonic() < tl_deadline:
+                timeline_kinds = [e["kind"]
+                                  for e in flight.TIMELINE.snapshot(victim)]
+                if "slo_breach" in timeline_kinds:
+                    break
+                time.sleep(0.05)
+            if "slo_breach" not in timeline_kinds:
+                failures.append(
+                    f"no slo_breach timeline event for {victim} "
+                    f"(kinds: {timeline_kinds})")
+            event_seen = False
+            event_deadline = time.monotonic() + 5.0
+            with flight.suppress_accounting():
+                while time.monotonic() < event_deadline and not event_seen:
+                    event_seen = any(
+                        e.get("reason") == "SloBreach"
+                        and (e.get("involvedObject") or {}).get("name")
+                        == job_names[0]
+                        for e in lc.backend.list(EVENTS, ns))
+                    if not event_seen:
+                        time.sleep(0.05)
+            if not event_seen:
+                failures.append(
+                    "no SloBreach K8s Event recorded for the victim job")
+            healthy_breached = [
+                f"{ns}/{n}" for n in job_names[1:]
+                if plane.slo.breached(f"{ns}/{n}")]
+            if healthy_breached:
+                failures.append(
+                    f"healthy jobs report SLO breach: {healthy_breached}")
+
+            # -- scrape health / cost bounds ------------------------------
+            counts = plane.stats.counts()
+            bad = {k: v for k, v in counts.items() if k[1] != "ok"}
+            ok_total = sum(v for k, v in counts.items() if k[1] == "ok")
+            if bad:
+                failures.append(f"non-ok scrape outcomes: {bad}")
+            if ok_total < pods * 3:
+                failures.append(
+                    f"too few successful scrapes: {ok_total} for {pods} "
+                    "targets")
+            if plane.stats.last_cycle_s > interval_s:
+                failures.append(
+                    f"scrape cycle cost {plane.stats.last_cycle_s:.3f}s "
+                    f"exceeds the {interval_s}s interval at {pods} targets")
+            staleness = plane.stats.staleness()
+            stale = {j: round(s, 2) for j, s in staleness.items()
+                     if s > 3 * interval_s}
+            if stale:
+                failures.append(f"stale jobs after steady scraping: {stale}")
+            summary = plane.summary()
+    finally:
+        stubs.stop()
+
+    result = {
+        "pods": pods,
+        "jobs": jobs,
+        "replicas": replicas,
+        "interval_s": interval_s,
+        "windows_s": [short_w, long_w],
+        "scrape_cycles": summary["cycles"],
+        "last_cycle_s": summary["last_cycle_s"],
+        "steady_apiserver_calls": steady_calls,
+        "steady_apiserver_lists": steady_lists,
+        "steady_scrape_cycles": steady_scrape_cycles,
+        "rates": rate_checks,
+        "fleet_p99": p99_checks,
+        "p99_reference": stubs.TRUE_P99,
+        "breach_detect_latency_s": (round(detect_latency, 3)
+                                    if detect_latency is not None else None),
+        "breach_budget_s": round(breach_budget, 3),
+        "breach_timeline_ok": "slo_breach" in timeline_kinds,
+        "breach_event_ok": event_seen,
+        "scrapes_ok_total": ok_total,
+        "apiserver_calls_by_verb_resource": acct.by_verb_resource(),
+    }
+    if failures:
+        result["failures"] = failures
+        err = RuntimeError("fleet bench assertions failed:\n  "
+                           + "\n  ".join(failures))
+        err.result = result
+        raise err
+    return result
+
+
+def run_fleet(args) -> dict:
+    """The --fleet scenario wrapper (bench.py contract: one JSON-able dict
+    with a metric/value/unit headline).  The JSON artifact is written on
+    failure too — with a ``failures`` field — matching bench_churn.json."""
+    try:
+        r = bench_fleet(
+            pods=args.fleet_pods,
+            jobs=args.fleet_jobs,
+            interval_s=args.fleet_interval,
+            steady_cycles=args.fleet_steady_cycles,
+            timeout_s=max(args.timeout, 60.0),
+        )
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write_artifact(args.fleet_out, {
+                "metric": "fleet_breach_detect_latency",
+                "value": partial.get("breach_detect_latency_s"),
+                "unit": "s",
+                **partial,
+            })
+        raise
+    out = {
+        "metric": "fleet_breach_detect_latency",
+        "value": r["breach_detect_latency_s"],
+        "unit": "s",
+        **r,
+    }
+    _write_artifact(args.fleet_out, out)
+    return out
+
+
 def _write_artifact(path: str | None, payload: dict) -> None:
     """One JSON-line bench artifact writer (churn + serve share it)."""
     if not path:
@@ -993,16 +1416,24 @@ def run_serve(args) -> dict:
     bench (harness/bench_serve.py — single-flight vs batched tokens/s
     over real HTTP on the tiny CPU model), emitted on the same one-JSON-
     line contract as the operator scenarios.  Imported lazily: this is
-    the only scenario that pulls in JAX."""
+    the only scenario that pulls in JAX.  The artifact is written on
+    assertion failure too, ``failures`` field included (the
+    bench_churn.json contract)."""
     from k8s_tpu.harness import bench_serve
 
-    result = bench_serve.run_bench(
-        concurrency=args.serve_concurrency, slots=args.serve_slots,
-        requests_per_client=args.serve_requests,
-        max_new_short=args.serve_max_new_short,
-        max_new_long=args.serve_max_new_long,
-        sampled=bool(args.serve_sampled),
-        shared_frac=args.serve_shared_frac)
+    try:
+        result = bench_serve.run_bench(
+            concurrency=args.serve_concurrency, slots=args.serve_slots,
+            requests_per_client=args.serve_requests,
+            max_new_short=args.serve_max_new_short,
+            max_new_long=args.serve_max_new_long,
+            sampled=bool(args.serve_sampled),
+            shared_frac=args.serve_shared_frac)
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write_artifact(args.serve_out, partial)
+        raise
     _write_artifact(args.serve_out, result)
     return result
 
@@ -1191,6 +1622,31 @@ def main(argv=None) -> int:
     p.add_argument("--churn-out", default=None,
                    help="also write the --churn JSON result to this path "
                    "(bench artifact)")
+    p.add_argument("--fleet", action="store_true",
+                   help="run the fleet-telemetry scenario (ISSUE 8): "
+                   "--fleet-jobs serving TFJobs totalling --fleet-pods "
+                   "fake serving pods scraped by the controller's fleet "
+                   "plane; EMBEDDED ASSERTIONS (per-job aggregated "
+                   "counter rates match the known per-pod truth, fleet "
+                   "p99 from merged histograms matches the closed-form "
+                   "reference, steady-state scraping adds zero apiserver "
+                   "calls, an injected latency breach flips the burn-rate "
+                   "rule within two scrape intervals and lands a timeline "
+                   "event + K8s Event, zero scrape failures) fail the "
+                   "bench; emits one JSON line; combinable with other "
+                   "scenarios")
+    p.add_argument("--fleet-pods", type=int, default=32,
+                   help="total fake serving pods for --fleet (the "
+                   "acceptance floor is 32)")
+    p.add_argument("--fleet-jobs", type=int, default=4,
+                   help="serving TFJobs the pods are split across")
+    p.add_argument("--fleet-interval", type=float, default=0.25,
+                   help="scrape interval seconds for --fleet")
+    p.add_argument("--fleet-steady-cycles", type=int, default=8,
+                   help="scrape cycles in the zero-apiserver-call window")
+    p.add_argument("--fleet-out", default=None,
+                   help="also write the --fleet JSON result to this path "
+                   "(bench artifact)")
     p.add_argument("--trace", action="store_true",
                    help="force tracing on (sample rate 1.0) and append a "
                    "per-stage p50/p99 breakdown ('stages') to the JSON "
@@ -1206,13 +1662,15 @@ def main(argv=None) -> int:
         trace.configure(sample_rate=1.0)
 
     if args.slice_scale or args.measure_restart or args.contention \
-            or args.serve or args.churn:
+            or args.serve or args.churn or args.fleet:
         if args.backend != "fake" and (args.slice_scale
                                        or args.measure_restart
-                                       or args.contention or args.churn):
-            p.error("--slice-scale/--measure-restart/--contention/--churn "
-                    "require --backend fake: the injected RTTs and the "
-                    "capacity knob only exist on the in-process cluster")
+                                       or args.contention or args.churn
+                                       or args.fleet):
+            p.error("--slice-scale/--measure-restart/--contention/--churn/"
+                    "--fleet require --backend fake: the injected RTTs, "
+                    "the capacity knob, and the fake serving pods only "
+                    "exist on the in-process cluster")
         if args.create_latency is None:
             args.create_latency = 0.01
         if args.delete_latency is None:
@@ -1225,9 +1683,13 @@ def main(argv=None) -> int:
         if args.contention:
             results.append(run_contention(args))
         if args.churn:
-            # last operator scenario: it resets the flight counters, so
+            # late operator scenario: it resets the flight counters, so
             # earlier scenarios' accounting must already be consumed
             results.append(run_churn(args))
+        if args.fleet:
+            # also resets the flight counters (runs after --churn has
+            # consumed its own accounting)
+            results.append(run_fleet(args))
         if args.serve:
             results.append(run_serve(args))
         if args.trace:

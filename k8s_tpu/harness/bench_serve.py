@@ -409,6 +409,29 @@ def run_bench(concurrency: int = 16, slots: int = 8,
         result["sampled_shared_frac"] = shared_frac
         result["sampled_equivalence_ok"] = check_sampled_equivalence(
             config, params)
+    # Embedded assertions (the bench_churn.json contract, ISSUE 8
+    # drive-by: every bench artifact reports failures the same way): a
+    # violated invariant attaches a ``failures`` field and raises with
+    # the full result on the exception, so the artifact still lands in
+    # the non-gating CI tier for whoever debugs the regression.
+    failures: list[str] = []
+    for phase in (single, batched,
+                  result.get("sampled_exclusive") or {},
+                  result.get("sampled_batched") or {}):
+        if phase.get("errors"):
+            failures.append(
+                f"phase {phase.get('mode')}: request errors "
+                f"{phase['errors']}")
+    if sampled and not result["sampled_equivalence_ok"]:
+        failures.append(
+            "sampled routing not output-invariant: batched sampling lane "
+            "and exclusive lane emitted different tokens at a fixed seed")
+    if failures:
+        result["failures"] = failures
+        err = RuntimeError("serve bench assertions failed:\n  "
+                           + "\n  ".join(failures))
+        err.result = result
+        raise err
     return result
 
 
@@ -439,20 +462,32 @@ def main(argv=None) -> int:
                    "(bench artifact)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.WARNING)
-    result = run_bench(concurrency=args.concurrency, slots=args.slots,
-                       requests_per_client=args.requests,
-                       max_new_short=args.max_new_short,
-                       max_new_long=args.max_new_long, seed=args.seed,
-                       sampled=bool(args.sampled),
-                       shared_frac=args.shared_frac)
-    line = json.dumps(result)
-    print(line)
-    if args.out:
-        import os
 
-        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    def _write(payload: dict) -> None:
+        line = json.dumps(payload)
+        print(line)
+        if args.out:
+            import os
+
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+
+    try:
+        result = run_bench(concurrency=args.concurrency, slots=args.slots,
+                           requests_per_client=args.requests,
+                           max_new_short=args.max_new_short,
+                           max_new_long=args.max_new_long, seed=args.seed,
+                           sampled=bool(args.sampled),
+                           shared_frac=args.shared_frac)
+    except RuntimeError as e:
+        # artifact written on failure too, ``failures`` field included
+        # (the bench_churn.json contract)
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write(partial)
+        raise
+    _write(result)
     return 0
 
 

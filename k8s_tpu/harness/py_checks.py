@@ -28,10 +28,13 @@ EXCLUDE_DIRS = {".git", "__pycache__", ".eggs", "build", "vendor", "node_modules
 # admission state consulted from every sync and is served by two HTTP
 # processes; flight/ (ISSUE 7) is the control-plane flight recorder — call
 # accounting on the REST request hot path, watch health in the reflector
-# loop, lifecycle timelines served by two HTTP processes.  None may grow a
-# third-party (or even intra-repo) import.
+# loop, lifecycle timelines served by two HTTP processes; fleet/ (ISSUE 8)
+# is the fleet telemetry plane — a scrape thread inside the operator
+# process, read by two HTTP processes, all informer/TFJob knowledge kept
+# with its callers.  None may grow a third-party (or even intra-repo)
+# import.
 STDLIB_ONLY_PACKAGES = ("k8s_tpu.trace", "k8s_tpu.scheduler",
-                        "k8s_tpu.flight")
+                        "k8s_tpu.flight", "k8s_tpu.fleet")
 
 
 def check_stdlib_only(path: str, source: bytes | None = None,

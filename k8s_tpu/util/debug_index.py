@@ -1,0 +1,80 @@
+"""/debug index: one responder listing the live debug endpoints with
+their active/inactive state, shared by the metrics server and the
+dashboard backend (replacing the guess-the-URL experience — every
+``/debug/*`` route 404s with an explanatory body when its subsystem is
+off, but nothing *listed* them).
+
+Always 200: the index itself has no inactive state.  Each entry carries
+``active`` (would the endpoint serve data right now), ``activation``
+(what turns it on), and the supported query params.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def _traces_active() -> bool:
+    from k8s_tpu import trace
+
+    return bool(trace.enabled())
+
+
+def _scheduler_active() -> bool:
+    from k8s_tpu import scheduler as scheduler_mod
+
+    return scheduler_mod.active() is not None
+
+
+def _timeline_active() -> bool:
+    from k8s_tpu import flight
+
+    return bool(flight.TIMELINE.active)
+
+
+def _fleet_active() -> bool:
+    from k8s_tpu import fleet
+
+    plane = fleet.active()
+    return plane is not None and plane.active
+
+
+def debug_index_response(query: str = "") -> tuple[int, str, str]:
+    """(status_code, body, content_type) for GET /debug (and /debug/)."""
+    del query  # no parameters; kept for the shared responder signature
+    endpoints = [
+        {
+            "path": "/debug/traces",
+            "subsystem": "reconcile tracing (k8s_tpu.trace)",
+            "active": _traces_active(),
+            "activation": "K8S_TPU_TRACE_SAMPLE > 0",
+            "params": ["job", "n"],
+        },
+        {
+            "path": "/debug/scheduler",
+            "subsystem": "gang admission & capacity (k8s_tpu.scheduler)",
+            "active": _scheduler_active(),
+            "activation": "a v2 controller registers its scheduler on "
+                          "construction",
+            "params": ["queue", "events"],
+        },
+        {
+            "path": "/debug/timeline",
+            "subsystem": "flight-recorder lifecycle journal "
+                         "(k8s_tpu.flight)",
+            "active": _timeline_active(),
+            "activation": "a v2 controller activates the recorder on "
+                          "construction",
+            "params": ["job", "since", "n"],
+        },
+        {
+            "path": "/debug/fleet",
+            "subsystem": "fleet telemetry plane (k8s_tpu.fleet)",
+            "active": _fleet_active(),
+            "activation": "K8S_TPU_FLEET_SCRAPE=1 (the v2 controller "
+                          "starts the scrape plane)",
+            "params": ["job", "since", "n"],
+        },
+    ]
+    body = json.dumps({"endpoints": endpoints}, indent=2)
+    return 200, body + "\n", "application/json"

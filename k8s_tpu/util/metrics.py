@@ -408,6 +408,111 @@ def flight_metrics(registry: Optional[Registry] = None) -> dict:
     }
 
 
+# --- fleet telemetry plane exposition (ISSUE 8) ------------------------------
+#
+# The fleet plane (k8s_tpu.fleet) is stdlib-only like flight/ and keeps its
+# own counters; exposition is ProxyMetric adapters reading the ACTIVE plane
+# at scrape time.  With no plane active the families expose HELP/TYPE lines
+# with zero samples (parseable either way — the round-trip test covers it).
+
+
+def fleet_metrics(registry: Optional[Registry] = None) -> dict:
+    """Register the fleet scrape-plane families backed by
+    ``k8s_tpu.fleet.active()``.  Idempotent (the registry dedupes)."""
+    from k8s_tpu import fleet
+
+    r = registry or REGISTRY
+
+    def _scrapes(name):
+        plane = fleet.active()
+        if plane is None:
+            return
+        for (job, outcome), n in sorted(plane.stats.counts().items()):
+            labels = _format_labels(("job", "outcome"), (job, outcome))
+            yield f"{name}{labels} {_format_value(n)}"
+
+    def _scrape_duration(name):
+        plane = fleet.active()
+        if plane is None:
+            return
+        bounds, counts, total, count = plane.stats.duration_samples()
+        cumulative = 0
+        for bound, c in zip(bounds, counts):
+            cumulative += c
+            labels = _format_labels(("le",), (_format_value(bound),))
+            yield f"{name}_bucket{labels} {cumulative}"
+        yield f"{name}_bucket{{le=\"+Inf\"}} {count}"
+        yield f"{name}_sum {_format_value(round(total, 6))}"
+        yield f"{name}_count {count}"
+
+    def _targets(name):
+        plane = fleet.active()
+        if plane is None:
+            return
+        for job, n in sorted(plane.stats.target_count().items()):
+            yield (f"{name}{_format_labels(('job',), (job,))} "
+                   f"{_format_value(n)}")
+
+    def _staleness(name):
+        plane = fleet.active()
+        if plane is None:
+            return
+        for job, age in sorted(plane.stats.staleness().items()):
+            if age == float("inf"):
+                continue  # never-scraped: absent is the signal
+            yield (f"{name}{_format_labels(('job',), (job,))} "
+                   f"{_format_value(round(age, 3))}")
+
+    def _burn(name):
+        plane = fleet.active()
+        if plane is None:
+            return
+        for (job, rule), burn in sorted(plane.burn_rates().items()):
+            labels = _format_labels(("job", "rule"), (job, rule))
+            yield f"{name}{labels} {_format_value(round(burn, 4))}"
+
+    def _breaches(name):
+        plane = fleet.active()
+        if plane is None:
+            return
+        for (job, rule), n in sorted(plane.slo.breaches().items()):
+            labels = _format_labels(("job", "rule"), (job, rule))
+            yield f"{name}{labels} {_format_value(n)}"
+
+    return {
+        "scrapes": r.register(ProxyMetric(
+            "fleet_scrape_total",
+            "Fleet-plane scrapes by job and outcome (ok / http_error / "
+            "timeout / parse_error / error).",
+            "counter", _scrapes)),
+        "scrape_duration": r.register(ProxyMetric(
+            "fleet_scrape_duration_seconds",
+            "Per-target scrape latency (fetch + parse + ingest).",
+            "histogram", _scrape_duration)),
+        "targets": r.register(ProxyMetric(
+            "fleet_targets",
+            "Scrape targets currently tracked per job (Running pods "
+            "with a fleet scrape port, from the informer cache).",
+            "gauge", _targets)),
+        "staleness": r.register(ProxyMetric(
+            "fleet_staleness_seconds",
+            "Seconds since the job's least-recently-successful target "
+            "was scraped (the straggler defines fleet freshness; a "
+            "never-scraped job exposes no sample).",
+            "gauge", _staleness)),
+        "burn_rate": r.register(ProxyMetric(
+            "fleet_slo_burn_rate",
+            "Short-window SLO burn rate per job and rule (>= 1 means "
+            "the error budget is burning at or above the sustainable "
+            "rate; breach requires both windows).",
+            "gauge", _burn)),
+        "breaches": r.register(ProxyMetric(
+            "fleet_slo_breaches_total",
+            "SLO rule ok->breached transitions per job and rule.",
+            "counter", _breaches)),
+    }
+
+
 # --- the operator's own telemetry (consumed by controllers and dashboard) ---
 
 def controller_metrics(generation: str, registry: Optional[Registry] = None) -> dict:

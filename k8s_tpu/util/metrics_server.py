@@ -1,9 +1,12 @@
 """Operator observability endpoint: /metrics (Prometheus text 0.0.4 from
 util.metrics.Registry), /healthz, /debug/traces (recent span trees
 from the tracing ring buffer, slowest-first; 404 with an explicit
-"tracing disabled" body when K8S_TPU_TRACE_SAMPLE is 0), and
+"tracing disabled" body when K8S_TPU_TRACE_SAMPLE is 0),
 /debug/scheduler (gang-admission capacity ledger + priority queue; 404
-with an explicit body when no controller registered a scheduler).
+with an explicit body when no controller registered a scheduler),
+/debug/timeline (flight-recorder lifecycle journal), /debug/fleet
+(fleet telemetry plane rollups + SLO burn state), and /debug/ — the
+index listing every debug endpoint with its active/inactive state.
 
 The reference operator exposed no scrape endpoint at all (cmd/tf-operator*/
 app/server.go wires no HTTP server); a production operator needs one, so
@@ -116,6 +119,21 @@ class MetricsServer:
                     from k8s_tpu import flight
 
                     code, body, ctype = flight.timeline_response(query)
+                    return self._send(code, body, ctype)
+                if path == "/debug/fleet":
+                    # fleet telemetry plane: per-job scrape rollups +
+                    # SLO burn state (?job=/?since=/?n=; 404 with an
+                    # explicit body until a controller starts a plane)
+                    from k8s_tpu import fleet
+
+                    code, body, ctype = fleet.debug_response(query)
+                    return self._send(code, body, ctype)
+                if path in ("/debug", "/debug/"):
+                    # index of the debug endpoints with active state —
+                    # the same responder the dashboard serves
+                    from k8s_tpu.util.debug_index import debug_index_response
+
+                    code, body, ctype = debug_index_response(query)
                     return self._send(code, body, ctype)
                 return self._send(404, "not found\n", "text/plain")
 

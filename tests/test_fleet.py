@@ -1,0 +1,952 @@
+"""Fleet telemetry plane tests (ISSUE 8): Prometheus text-format parser
+(including the round-trip regression over every family util/metrics.py
+exposes), scrape-target discovery from cached pod dicts, per-job
+aggregation (rates/gauges/merged-histogram quantiles), multi-window SLO
+burn-rate rules, /debug/fleet 404-when-inactive parity on both HTTP
+servers, the /debug index, genjob --serve fleet discoverability, and
+the --fleet bench at smoke scale."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_tpu import fleet
+from k8s_tpu.fleet.aggregate import (
+    FleetAggregator,
+    fraction_above,
+    quantile_from_buckets,
+)
+from k8s_tpu.fleet.plane import FleetPlane
+from k8s_tpu.fleet.slo import RuleError, SloEvaluator, parse_rules
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _target(job="ns/j1", pod="p0", index="0", url="http://x/0"):
+    ns, _, name = job.partition("/")
+    return fleet.ScrapeTarget(job, ns, name, pod, index, url)
+
+
+# -- parser -------------------------------------------------------------------
+
+
+class TestParser:
+    def test_counters_gauges_labels_and_escapes(self):
+        text = (
+            "# HELP hits Total hits.\n"
+            "# TYPE hits counter\n"
+            'hits{job="ns/j1",outcome="ok"} 3\n'
+            'hits{job="ns/j2",outcome="a\\"b\\\\c\\nd"} 1.5\n'
+            "# TYPE temp gauge\n"
+            "temp 2.25\n")
+        fams = fleet.parse_exposition(text)
+        assert fams["hits"].kind == "counter"
+        assert fams["hits"].help == "Total hits."
+        values = fams["hits"].values()
+        assert values[(("job", "ns/j1"), ("outcome", "ok"))] == 3
+        assert values[(("job", "ns/j2"),
+                       ("outcome", 'a"b\\c\nd'))] == 1.5
+        assert fams["temp"].values()[()] == 2.25
+
+    def test_histogram_le_ordering_violation_rejected(self):
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 5\n'
+            'h_bucket{le="0.5"} 3\n'   # cumulative counts DECREASE
+            'h_bucket{le="+Inf"} 5\n'
+            "h_sum 1\nh_count 5\n")
+        with pytest.raises(fleet.ParseError, match="decrease"):
+            fleet.parse_exposition(text)
+
+    def test_histogram_missing_inf_rejected(self):
+        text = ("# TYPE h histogram\n"
+                'h_bucket{le="0.1"} 5\n'
+                "h_sum 1\nh_count 5\n")
+        with pytest.raises(fleet.ParseError, match=r"\+Inf"):
+            fleet.parse_exposition(text)
+
+    def test_histogram_inf_count_mismatch_rejected(self):
+        text = ("# TYPE h histogram\n"
+                'h_bucket{le="+Inf"} 4\n'
+                "h_sum 1\nh_count 5\n")
+        with pytest.raises(fleet.ParseError, match="_count"):
+            fleet.parse_exposition(text)
+
+    def test_histogram_samples_before_type_line_still_fold(self):
+        """An exporter emitting bucket lines BEFORE its # TYPE line must
+        not have its histogram silently dropped into untyped families —
+        and the folded family still gets the +Inf validation."""
+        text = ('h_bucket{le="0.1"} 3\n'
+                'h_bucket{le="+Inf"} 5\n'
+                "h_sum 1.5\n"
+                "h_count 5\n"
+                "# TYPE h histogram\n")
+        fams = fleet.parse_exposition(text)
+        assert set(fams) == {"h"}
+        pts = fleet.histogram_points(fams["h"])
+        assert pts[()]["count"] == 5 and pts[()]["buckets"][0] == (0.1, 3)
+        # the validation applies to folded families too
+        with pytest.raises(fleet.ParseError, match=r"\+Inf"):
+            fleet.parse_exposition('h_bucket{le="0.1"} 3\n'
+                                   "# TYPE h histogram\n")
+
+    def test_sample_without_type_is_untyped(self):
+        fams = fleet.parse_exposition("mystery 7\n")
+        assert fams["mystery"].kind == "untyped"
+        assert fams["mystery"].values()[()] == 7
+
+    def test_malformed_lines_rejected(self):
+        for bad in ("novalue\n", 'x{le="0.1" 3\n', "x nope\n",
+                    'x{a="b"}\n'):  # labels but no value
+            with pytest.raises(fleet.ParseError):
+                fleet.parse_exposition(bad)
+
+    def test_round_trip_every_util_metrics_family(self):
+        """THE regression pin (ISSUE 8 satellite): every family
+        util/metrics.py exposes — counter/gauge/histogram, including
+        the Proxy families of the flight recorder AND the fleet plane
+        itself — parses back losslessly through the fleet parser, and
+        render() is a fixed point."""
+        from k8s_tpu import flight
+        from k8s_tpu.util import metrics as metrics_mod
+
+        reg = metrics_mod.Registry()
+        cm = metrics_mod.controller_metrics("v2", reg)
+        cm["sync_duration"].labels("v2").observe(0.012)
+        cm["sync_total"].labels("v2", "success").inc()
+        cm["creates_total"].labels("v2", "pod", "success").inc(5)
+        cm["workqueue_depth"].labels("v2").set(3)
+        cm["admission_wait"].labels("v2").observe(42.0)
+        sm = metrics_mod.serving_metrics(reg)
+        sm["requests"].labels("ok").inc(7)
+        sm["duration"].observe(0.3)
+        sm["duration"].observe(7.5)  # lands in a high bucket
+        sm["occupancy"].set(3.5)
+        sm["queue_depth"].set(2)
+        flight.reset_all()
+        metrics_mod.flight_metrics(reg)
+        flight.ACCOUNTING.record("GET", "pods", 200, 0.004)
+        flight.ACCOUNTING.record("LIST", "tfjobs", 200, 0.1)
+        flight.WATCH.record_relist("pods", flight.RELIST_INITIAL)
+        flight.EVENTS.record_recorded()
+        metrics_mod.fleet_metrics(reg)
+        plane = FleetPlane(
+            lambda: [_target()],
+            interval_s=0.5, windows=(1.0, 4.0),
+            fetch=lambda url, t: ("# TYPE serve_tokens_total counter\n"
+                                  "serve_tokens_total 5\n"))
+        prev = fleet.active()
+        fleet.set_active(plane)
+        try:
+            plane.scrape_once()
+            text = reg.expose()
+        finally:
+            fleet.set_active(prev)
+        fams = fleet.parse_exposition(text)
+        # every family present with its declared kind, every sample line
+        # accounted for (no drift between exposition and parser)
+        sample_lines = [ln for ln in text.splitlines()
+                        if ln and not ln.startswith("#")]
+        assert sum(len(f.samples) for f in fams.values()) \
+            == len(sample_lines)
+        for expected in ("tfjob_sync_duration_seconds",
+                         "serve_request_duration_seconds",
+                         "apiserver_requests_total",
+                         "apiserver_request_duration_seconds",
+                         "watch_relists_total", "events_recorded_total",
+                         "fleet_scrape_total",
+                         "fleet_scrape_duration_seconds", "fleet_targets"):
+            assert expected in fams, f"family {expected} missing"
+        assert fams["tfjob_sync_duration_seconds"].kind == "histogram"
+        assert fams["fleet_scrape_total"].kind == "counter"
+        # histograms decompose cleanly (le ordering, +Inf == _count)
+        pts = fleet.histogram_points(fams["serve_request_duration_seconds"])
+        assert pts[()]["count"] == 2
+        # render -> reparse is a fixed point (lossless round trip)
+        fams2 = fleet.parse_exposition(fleet.render(fams))
+        assert {n: f.samples for n, f in fams.items()} \
+            == {n: f.samples for n, f in fams2.items()}
+        assert {n: (f.kind, f.help) for n, f in fams.items()} \
+            == {n: (f.kind, f.help) for n, f in fams2.items()}
+
+
+# -- discovery ----------------------------------------------------------------
+
+
+def _pod(name="p0", job="j1", ns="ns", phase="Running", port="9100",
+         via_env=False, **meta_extra):
+    meta = {
+        "name": name, "namespace": ns,
+        "labels": {"tf-replica-type": "worker", "tf-replica-index": "0",
+                   "tf_job_key": f"{ns}-{job}"},
+        "ownerReferences": [{"kind": "TFJob", "name": job,
+                             "controller": True, "uid": "u1"}],
+    }
+    meta.update(meta_extra)
+    pod = {"metadata": meta, "status": {"phase": phase}, "spec": {}}
+    if port is not None:
+        if via_env:
+            pod["spec"]["containers"] = [
+                {"name": "tensorflow",
+                 "env": [{"name": "K8S_TPU_FLEET_SCRAPE_PORT",
+                          "value": port}]}]
+        else:
+            meta.setdefault("annotations", {})[
+                "kubeflow.org/fleet-scrape-port"] = port
+    return pod
+
+
+class TestDiscovery:
+    def test_annotation_port_and_pod_ip(self):
+        pod = _pod()
+        pod["status"]["podIP"] = "10.0.0.7"
+        [t] = fleet.targets_from_pods([pod])
+        assert t.job == "ns/j1"
+        assert t.url == "http://10.0.0.7:9100/metrics"
+        assert t.index == "0"
+
+    def test_env_port_fallback_and_dns_host(self):
+        # no annotation, no podIP: port from the container env, host from
+        # the per-index headless-service DNS name derived from labels
+        [t] = fleet.targets_from_pods([_pod(via_env=True)])
+        assert t.url == ("http://ns-j1-worker-0.ns.svc.cluster.local"
+                         ":9100/metrics")
+
+    def test_host_and_path_annotation_overrides(self):
+        pod = _pod(port=None, annotations={
+            "kubeflow.org/fleet-scrape-port": "9200",
+            "kubeflow.org/fleet-scrape-host": "127.0.0.1",
+            "kubeflow.org/fleet-scrape-path": "stats",
+        })
+        [t] = fleet.targets_from_pods([pod])
+        assert t.url == "http://127.0.0.1:9200/stats"
+
+    def test_store_index_matches_discovery_predicate(self):
+        """The informer's fleet-scrape index and discovery share one
+        predicate: a pod is indexed iff it declares a scrape port."""
+        from k8s_tpu.client.informer import (
+            FLEET_SCRAPE_INDEX,
+            FLEET_SCRAPE_KEY,
+            Store,
+            index_fleet_scrape_pods,
+        )
+
+        store = Store()
+        store.add_index(FLEET_SCRAPE_INDEX, index_fleet_scrape_pods)
+        annotated = _pod(name="annotated")
+        via_env = _pod(name="via-env", via_env=True)
+        plain = _pod(name="plain", port=None)
+        for p in (annotated, via_env, plain):
+            store.add(p)
+        indexed = store.by_index(FLEET_SCRAPE_INDEX, FLEET_SCRAPE_KEY)
+        assert sorted(p["metadata"]["name"] for p in indexed) \
+            == ["annotated", "via-env"]
+        # removing the port removes the pod from the index on update
+        updated = _pod(name="annotated", port=None)
+        store.add(updated)
+        assert [p["metadata"]["name"]
+                for p in store.by_index(FLEET_SCRAPE_INDEX,
+                                        FLEET_SCRAPE_KEY)] == ["via-env"]
+
+    def test_undiscoverable_pods_skipped(self):
+        pods = [
+            _pod(name="no-port", port=None),
+            _pod(name="pending", phase="Pending"),
+            _pod(name="deleting", deletionTimestamp="2026-01-01T00:00:00Z"),
+            _pod(name="opted-out", annotations={
+                "kubeflow.org/fleet-scrape-port": "9100",
+                "kubeflow.org/fleet-scrape": "false"}),
+            _pod(name="bad-port", port="70000"),
+            _pod(name="garbage-port", port="nope"),
+        ]
+        orphan = _pod(name="orphan")
+        orphan["metadata"]["ownerReferences"] = []
+        orphan["status"]["podIP"] = "10.0.0.9"
+        pods.append(orphan)
+        assert fleet.targets_from_pods(pods) == []
+
+
+# -- aggregation --------------------------------------------------------------
+
+
+class TestAggregator:
+    def _fam(self, text):
+        return fleet.parse_exposition(text)
+
+    def test_counter_rates_sum_across_pods(self):
+        agg = FleetAggregator()
+        for t in range(5):
+            for pod, rate in (("p0", 10.0), ("p1", 30.0)):
+                agg.ingest("ns/j", pod, self._fam(
+                    "# TYPE serve_tokens_total counter\n"
+                    f"serve_tokens_total {rate * t}\n"), float(t))
+        assert agg.counter_rate("ns/j", "serve_tokens_total", 10.0, 4.0) \
+            == pytest.approx(40.0)
+
+    def test_counter_reset_does_not_go_negative(self):
+        agg = FleetAggregator()
+        for t, v in enumerate([100.0, 150.0, 5.0, 25.0]):  # restart at t=2
+            agg.ingest("ns/j", "p0", self._fam(
+                "# TYPE serve_tokens_total counter\n"
+                f"serve_tokens_total {v}\n"), float(t))
+        # deltas: 50 + (reset: 5) + 20 over 3s
+        assert agg.counter_rate("ns/j", "serve_tokens_total", 10.0, 3.0) \
+            == pytest.approx(75.0 / 3.0)
+
+    def test_gauge_stats_and_windowed_max(self):
+        agg = FleetAggregator()
+        for t in range(4):
+            for pod, v in (("p0", 2.0), ("p1", 6.0)):
+                agg.ingest("ns/j", pod, self._fam(
+                    "# TYPE serve_queue_depth gauge\n"
+                    f"serve_queue_depth {v}\n"), float(t))
+            agg.cycle_done(float(t), stale_after_s=10.0)
+        stats = agg.gauge_stats("ns/j", "serve_queue_depth")
+        assert stats["max"] == 6.0 and stats["mean"] == 4.0 \
+            and stats["pods"] == 2
+        assert agg.gauge_window_mean(
+            "ns/j", "serve_queue_depth", 10.0, 3.0,
+            of="max") == pytest.approx(6.0)
+        assert agg.gauge_window_mean(
+            "ns/j", "serve_queue_depth", 10.0, 3.0,
+            of="mean") == pytest.approx(4.0)
+
+    def test_histogram_merge_and_quantiles(self):
+        agg = FleetAggregator()
+        # two pods, identical distribution: 90% <= 0.1, 10% in (0.1, 1.0]
+        for t in (0.0, 10.0):
+            for pod in ("p0", "p1"):
+                n = 100 * (t + 1)
+                agg.ingest("ns/j", pod, self._fam(
+                    "# TYPE serve_request_duration_seconds histogram\n"
+                    f'serve_request_duration_seconds_bucket{{le="0.1"}} '
+                    f"{0.9 * n}\n"
+                    f'serve_request_duration_seconds_bucket{{le="1.0"}} '
+                    f"{n}\n"
+                    f'serve_request_duration_seconds_bucket{{le="+Inf"}} '
+                    f"{n}\n"
+                    f"serve_request_duration_seconds_sum {n}\n"
+                    f"serve_request_duration_seconds_count {n}\n"), t)
+        win = agg.histogram_window(
+            "ns/j", "serve_request_duration_seconds", 20.0, 10.0)
+        assert win["count"] == pytest.approx(2000.0)
+        # p50 inside the first bucket, p99 interpolated inside (0.1, 1.0]
+        assert agg.quantile("ns/j", "serve_request_duration_seconds",
+                            0.99, 20.0, 10.0) \
+            == pytest.approx(0.1 + 0.9 * 0.09 / 0.10)
+        assert fraction_above(win["buckets"], 0.1) == pytest.approx(0.1)
+
+    def test_fraction_above_counts_inf_tail_as_bad(self):
+        """An SLO bound above the exporter's largest finite bucket must
+        not neuter the rule: the +Inf tail counts as bad."""
+        buckets = [(0.1, 90.0), (1.0, 95.0), (float("inf"), 100.0)]
+        # conservative: the (0.1, 1.0] observations straddling 0.5 count
+        # as good; only the 5% tail is provably above
+        assert fraction_above(buckets, 0.5) == pytest.approx(0.05)
+        # threshold past the top finite bound: only the tail can exceed
+        # it, and it does — 5% of observations are unbounded
+        assert fraction_above(buckets, 6.0) == pytest.approx(0.05)
+
+    def test_quantile_helpers_edge_cases(self):
+        assert quantile_from_buckets([], 0.99) is None
+        assert quantile_from_buckets([(float("inf"), 0.0)], 0.5) is None
+        # everything in +Inf: the estimate floors at the last finite bound
+        assert quantile_from_buckets(
+            [(0.5, 0.0), (float("inf"), 10.0)], 0.99) == 0.5
+
+    def test_job_registry_lru_bound(self):
+        agg = FleetAggregator(max_jobs=2)
+        for job in ("ns/a", "ns/b", "ns/c"):
+            agg.ingest(job, "p0", self._fam(
+                "# TYPE serve_tokens_total counter\n"
+                "serve_tokens_total 1\n"), 0.0)
+        assert agg.jobs() == ["ns/b", "ns/c"]
+
+
+# -- SLO rules ----------------------------------------------------------------
+
+
+def _hist_text(fast, slow):
+    total = fast + slow
+    return ("# TYPE serve_request_duration_seconds histogram\n"
+            f'serve_request_duration_seconds_bucket{{le="0.1"}} {fast}\n'
+            f'serve_request_duration_seconds_bucket{{le="0.5"}} {fast}\n'
+            f'serve_request_duration_seconds_bucket{{le="2.5"}} {total}\n'
+            f'serve_request_duration_seconds_bucket{{le="+Inf"}} {total}\n'
+            f"serve_request_duration_seconds_sum {total}\n"
+            f"serve_request_duration_seconds_count {total}\n")
+
+
+class TestSlo:
+    def test_parse_rules(self):
+        rules = parse_rules(
+            "serve_request_duration_seconds:p99<0.5, serve_queue_depth"
+            ":max<48")
+        assert [r.name for r in rules] == [
+            "serve_request_duration_seconds:p99<0.5",
+            "serve_queue_depth:max<48"]
+        assert rules[0].quantile == 0.99 and rules[1].quantile is None
+
+    def test_bad_rules_rejected(self):
+        for bad in ("nope", "f:p98<1", "f:p99<abc", "f:p99<0"):
+            with pytest.raises(RuleError):
+                parse_rules(bad)
+
+    def test_breach_needs_both_windows(self):
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules(
+            "serve_request_duration_seconds:p99<0.5"), agg,
+            windows=(4.0, 16.0))
+        # 20 cycles of healthy traffic, then 2 bad cycles: the short
+        # window burns immediately; breach fires only once the long
+        # window's bad fraction crosses the budget too
+        transitions = []
+        sink = (lambda job, rule, state, breached:
+                transitions.append((breached, state["burn_short"])))
+        t = 0.0
+        for _ in range(20):
+            agg.ingest("ns/j", "p0", fleet.parse_exposition(
+                _hist_text(fast=100 * (t + 1), slow=0)), t)
+            ev.evaluate(["ns/j"], t, sinks=(sink,))
+            t += 1.0
+        assert transitions == []  # healthy: no transition at all
+        for _ in range(3):
+            agg.ingest("ns/j", "p0", fleet.parse_exposition(
+                _hist_text(fast=2100.0, slow=200.0 * (t - 19))), t)
+            ev.evaluate(["ns/j"], t, sinks=(sink,))
+            t += 1.0
+        assert transitions and transitions[0][0] is True
+        assert transitions[0][1] >= 1.0
+        [state] = ev.state("ns/j")
+        assert state["breached"] and state["burn_long"] >= 1.0
+        assert ev.breached("ns/j")
+        assert ev.breaches()[("ns/j",
+                              "serve_request_duration_seconds:p99<0.5")] == 1
+
+    def test_gauge_rule_and_recovery_transition(self):
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules("serve_queue_depth:max<10"), agg,
+                          windows=(2.0, 8.0))
+        transitions = []
+        sink = (lambda job, rule, state, breached:
+                transitions.append(breached))
+        t = 0.0
+        for depth in [25.0] * 10 + [1.0] * 12:
+            agg.ingest("ns/j", "p0", fleet.parse_exposition(
+                "# TYPE serve_queue_depth gauge\n"
+                f"serve_queue_depth {depth}\n"), t)
+            agg.cycle_done(t, stale_after_s=100.0)
+            ev.evaluate(["ns/j"], t, sinks=(sink,))
+            t += 1.0
+        assert transitions == [True, False]  # breached, then recovered
+        assert not ev.breached("ns/j")
+
+    def test_mean_reducer_is_windowed_not_instantaneous(self):
+        """A single-cycle spike in the fleet mean must not breach a
+        mean rule: both windows read windowed history, so the long
+        window genuinely resists the transient."""
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules("serve_queue_depth:mean<10"), agg,
+                          windows=(2.0, 16.0))
+        transitions = []
+        sink = (lambda job, rule, state, breached:
+                transitions.append(breached))
+        t = 0.0
+        # one-cycle spike: 10x the bound trips the SHORT window alone
+        # (burn ~34/10), but diluted over the 16s window the mean stays
+        # under the bound — multi-window resistance in action
+        for depth in [1.0] * 16 + [100.0] + [1.0] * 4:
+            agg.ingest("ns/j", "p0", fleet.parse_exposition(
+                "# TYPE serve_queue_depth gauge\n"
+                f"serve_queue_depth {depth}\n"), t)
+            agg.cycle_done(t, stale_after_s=100.0)
+            ev.evaluate(["ns/j"], t, sinks=(sink,))
+            t += 1.0
+        assert transitions == []  # the long window absorbed the spike
+
+    def test_forget_drops_aggregator_rings_no_breach_refire(self):
+        """plane.forget() clears the aggregation rings too: a deleted
+        job must not be resurrected from stale samples on the next
+        cycle and re-fire its breach sinks."""
+        fired = []
+        plane = FleetPlane(
+            lambda: [], interval_s=0.5, windows=(1.0, 4.0),
+            slo_rules="serve_queue_depth:max<1",
+            fetch=lambda url, t: "")
+        plane.add_sink(lambda job, rule, state, breached:
+                       fired.append((job, breached)))
+        import time as time_mod
+
+        now = time_mod.time()
+        for i in range(6):
+            plane.aggregator.ingest("ns/dead", "p0", fleet.parse_exposition(
+                "# TYPE serve_queue_depth gauge\nserve_queue_depth 9\n"),
+                now - 6 + i)
+            plane.aggregator.cycle_done(now - 6 + i, stale_after_s=100.0)
+        plane.scrape_once()
+        assert fired == [("ns/dead", True)]  # breached while alive
+        plane.forget("ns/dead")
+        assert "ns/dead" not in plane.aggregator.jobs()
+        plane.scrape_once()
+        plane.scrape_once()
+        assert fired == [("ns/dead", True)]  # no resurrection, no re-fire
+
+    def test_data_gap_holds_state_instead_of_recovering(self):
+        """A scrape outage / ring eviction leaves NO samples in either
+        window — that is a gap, not a recovery: the breached verdict
+        holds and no spurious SloRecovered fires."""
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules("serve_queue_depth:max<1"), agg,
+                          windows=(2.0, 8.0))
+        transitions = []
+        sink = (lambda job, rule, state, breached:
+                transitions.append(breached))
+        for t in range(10):
+            agg.ingest("ns/j", "p0", fleet.parse_exposition(
+                "# TYPE serve_queue_depth gauge\nserve_queue_depth 9\n"),
+                float(t))
+            agg.cycle_done(float(t), stale_after_s=100.0)
+            ev.evaluate(["ns/j"], float(t), sinks=(sink,))
+        assert transitions == [True]
+        agg.forget("ns/j")  # all samples gone; the job itself persists
+        ev.evaluate(["ns/j"], 11.0, sinks=(sink,))
+        assert transitions == [True]  # no recovery fired
+        assert ev.breached("ns/j")    # verdict held across the gap
+        [state] = ev.state("ns/j")
+        assert state["burn_short"] is None  # the gap itself is visible
+
+    def test_partial_gap_holds_breach_too(self):
+        """Short window empty while the long window still holds old
+        samples (the mid-outage shape): a breached rule must NOT flip
+        to recovered — only full two-window data can affirm recovery."""
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules("serve_queue_depth:max<1"), agg,
+                          windows=(2.0, 60.0))
+        transitions = []
+        sink = (lambda job, rule, state, breached:
+                transitions.append(breached))
+        for t in range(10):
+            agg.ingest("ns/j", "p0", fleet.parse_exposition(
+                "# TYPE serve_queue_depth gauge\nserve_queue_depth 9\n"),
+                float(t))
+            agg.cycle_done(float(t), stale_after_s=1000.0)
+            ev.evaluate(["ns/j"], float(t), sinks=(sink,))
+        assert transitions == [True]
+        # pods stop answering: evaluate 20s later — the 2s window is
+        # empty, the 60s window still sees the old breaching samples
+        ev.evaluate(["ns/j"], 29.0, sinks=(sink,))
+        [state] = ev.state("ns/j")
+        assert state["burn_short"] is None
+        assert state["burn_long"] is not None
+        assert transitions == [True] and ev.breached("ns/j")
+
+    def test_vanished_jobs_pruned_from_rule_state(self):
+        """Rule state for jobs absent from the evaluated set is pruned
+        (bounded-everything: churn can't accumulate (job, rule) state)."""
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules("serve_queue_depth:max<1"), agg,
+                          windows=(2.0, 8.0))
+        for t in range(5):
+            agg.ingest("ns/old", "p0", fleet.parse_exposition(
+                "# TYPE serve_queue_depth gauge\nserve_queue_depth 9\n"),
+                float(t))
+            agg.cycle_done(float(t), stale_after_s=100.0)
+            ev.evaluate(["ns/old"], float(t))
+        assert ev.state("ns/old")
+        ev.evaluate(["ns/new"], 6.0)  # old job gone from the set
+        assert ev.state("ns/old") == [] and ev.breaches() == {}
+
+    def test_forget_drops_rule_state(self):
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules("serve_queue_depth:max<1"), agg,
+                          windows=(2.0, 8.0))
+        for t in range(10):
+            agg.ingest("ns/j", "p0", fleet.parse_exposition(
+                "# TYPE serve_queue_depth gauge\nserve_queue_depth 9\n"),
+                float(t))
+            agg.cycle_done(float(t), stale_after_s=100.0)
+            ev.evaluate(["ns/j"], float(t))
+        assert ev.breached("ns/j")
+        ev.forget("ns/j")
+        assert not ev.breached("ns/j") and ev.state("ns/j") == []
+
+    def test_broken_sink_does_not_stall_evaluation(self):
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules("serve_queue_depth:max<1"), agg,
+                          windows=(2.0, 8.0))
+        def boom(*a):
+            raise RuntimeError("sink exploded")
+        for t in range(10):
+            agg.ingest("ns/j", "p0", fleet.parse_exposition(
+                "# TYPE serve_queue_depth gauge\nserve_queue_depth 9\n"),
+                float(t))
+            agg.cycle_done(float(t), stale_after_s=100.0)
+            ev.evaluate(["ns/j"], float(t), sinks=(boom,))
+        assert ev.breached("ns/j")  # state advanced despite the sink
+
+
+# -- plane (scrape loop + failure tracking + events ring) ---------------------
+
+
+class TestPlane:
+    def test_scrape_failures_tracked_never_raised(self):
+        calls = {"n": 0}
+
+        def fetch(url, timeout):
+            calls["n"] += 1
+            if url.endswith("/1"):
+                raise OSError("connection refused")
+            if url.endswith("/2"):
+                return "# TYPE h histogram\nh_bucket{le=\"0.1\"} 3\n"  # no +Inf
+            return "# TYPE serve_tokens_total counter\nserve_tokens_total 5\n"
+
+        targets = [_target(pod=f"p{i}", index=str(i), url=f"http://x/{i}")
+                   for i in range(3)]
+        plane = FleetPlane(lambda: targets, interval_s=0.5,
+                           windows=(1.0, 4.0), fetch=fetch)
+        plane.scrape_once(now=1.0)
+        counts = plane.stats.counts()
+        assert counts[("ns/j1", "ok")] == 1
+        assert counts[("ns/j1", "http_error")] == 1
+        assert counts[("ns/j1", "parse_error")] == 1
+        kinds = [e["kind"] for e in plane.events()]
+        assert kinds.count("scrape_failure") == 2
+        [t2] = [t for t in plane.stats.targets() if t["pod"] == "p1"]
+        assert t2["consecutive_failures"] == 1
+        assert "refused" in t2["last_error"]
+
+    def test_url_override_rewrites_targets(self):
+        seen = []
+
+        def fetch(url, timeout):
+            seen.append(url)
+            return "# TYPE serve_tokens_total counter\nserve_tokens_total 1\n"
+
+        plane = FleetPlane(lambda: [_target(url="http://dns:9100/metrics")],
+                           interval_s=0.5, windows=(1.0, 4.0), fetch=fetch)
+        plane.url_override = lambda t: "http://127.0.0.1:7/rewritten"
+        plane.scrape_once()
+        assert seen == ["http://127.0.0.1:7/rewritten"]
+
+    def test_scrape_counters_lru_bounded_and_forgettable(self):
+        """fleet_scrape_total cardinality is bounded under job churn:
+        least-recently-scraped jobs evict past the cap, and a deleted
+        job's counters drop via forget() (plane.forget forwards)."""
+        from k8s_tpu.fleet.scrape import ScrapeStats
+
+        stats = ScrapeStats(max_count_jobs=2)
+        for job in ("ns/a", "ns/b", "ns/c"):
+            stats.record(_target(job=job), "ok", 0.001)
+        assert {j for j, _o in stats.counts()} == {"ns/b", "ns/c"}
+        stats.forget("ns/c")
+        assert {j for j, _o in stats.counts()} == {"ns/b"}
+
+        def fetch(url, timeout):
+            return "# TYPE serve_tokens_total counter\nserve_tokens_total 1\n"
+        plane = FleetPlane(lambda: [_target()], interval_s=0.5,
+                           windows=(1.0, 4.0), fetch=fetch)
+        plane.scrape_once()
+        assert ("ns/j1", "ok") in plane.stats.counts()
+        plane.forget("ns/j1")
+        assert plane.stats.counts() == {}
+
+    def test_inflight_targets_not_resubmitted(self):
+        """A target whose previous scrape is still in flight is skipped
+        by the next cycle (a fleet-wide outage with every fetch riding
+        its deadline cannot stack duplicate futures), and a completed
+        scrape clears its in-flight mark."""
+        started = []
+
+        def fetch(url, timeout):
+            started.append(url)
+            return "# TYPE serve_tokens_total counter\nserve_tokens_total 1\n"
+
+        target = _target()
+        plane = FleetPlane(lambda: [target], interval_s=0.5,
+                           windows=(1.0, 4.0), fetch=fetch)
+        # simulate a still-running scrape from the previous cycle
+        plane.loop._inflight.add(target.key())
+        plane.scrape_once()
+        assert started == []  # skipped, not double-fetched
+        plane.loop._inflight.clear()
+        plane.scrape_once()
+        assert started == ["http://x/0"]
+        # the completed scrape discarded its own in-flight mark
+        assert plane.loop._inflight == set()
+        plane.scrape_once()
+        assert started == ["http://x/0"] * 2
+
+    def test_events_since_contract(self):
+        def fetch(url, timeout):
+            raise OSError("down")
+        plane = FleetPlane(lambda: [_target()], interval_s=0.5,
+                           windows=(1.0, 4.0), fetch=fetch)
+        plane.scrape_once()
+        plane.scrape_once()
+        events = plane.events()
+        assert len(events) == 2
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs)
+        assert plane.events(since=seqs[0]) == [events[1]]
+        code, body, _ = fleet.debug_fleet_response(
+            plane, f"job=ns/j1&since={seqs[-1]}")
+        payload = json.loads(body)
+        assert code == 200 and payload["events"] == []
+        assert payload["last_seq"] == seqs[-1]  # echoed, not reset to 0
+
+
+# -- /debug endpoints on both servers -----------------------------------------
+
+
+class TestFleetEndpoint:
+    def _plane_with_data(self):
+        import time
+
+        # real wall-clock timestamps: the debug responder's rollup reads
+        # "now", so fake sample times would fall outside every window
+        plane = FleetPlane(
+            lambda: [_target()], interval_s=0.5, windows=(1.0, 4.0),
+            fetch=lambda url, t: ("# TYPE serve_tokens_total counter\n"
+                                  "serve_tokens_total 5\n"))
+        plane.scrape_once()
+        time.sleep(0.02)
+        plane.scrape_once()
+        return plane
+
+    def test_metrics_server_404_when_inactive_then_serves(self):
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        prev = fleet.active()
+        fleet.set_active(None)
+        srv = MetricsServer(0).start()
+        try:
+            code, body = _get(f"http://127.0.0.1:{srv.port}/debug/fleet")
+            assert code == 404 and "inactive" in body
+            plane = self._plane_with_data()
+            fleet.set_active(plane)
+            code, body = _get(f"http://127.0.0.1:{srv.port}/debug/fleet")
+            assert code == 200
+            summary = json.loads(body)
+            assert summary["jobs"]["ns/j1"]["targets"] == 1
+            code, body = _get(
+                f"http://127.0.0.1:{srv.port}/debug/fleet?job=ns/j1")
+            payload = json.loads(body)
+            assert payload["job"] == "ns/j1"
+            assert "serve_tokens_total" in payload["rollup"]["counters"]
+            assert [t["pod"] for t in payload["targets"]] == ["p0"]
+        finally:
+            srv.stop()
+            fleet.set_active(prev)
+
+    def test_dashboard_serves_same_responder(self):
+        from k8s_tpu.client.clientset import Clientset
+        from k8s_tpu.client.fake import FakeCluster
+        from k8s_tpu.dashboard.backend import DashboardServer
+
+        prev = fleet.active()
+        fleet.set_active(None)
+        server = DashboardServer(Clientset(FakeCluster()),
+                                 host="127.0.0.1", port=0)
+        server.start_background()
+        try:
+            code, body = _get(f"http://127.0.0.1:{server.port}/debug/fleet")
+            assert code == 404 and "inactive" in body
+            fleet.set_active(self._plane_with_data())
+            code, body = _get(f"http://127.0.0.1:{server.port}/debug/fleet")
+            assert code == 200
+            assert json.loads(body)["jobs"]["ns/j1"]["targets"] == 1
+        finally:
+            server.shutdown()
+            fleet.set_active(prev)
+
+    def test_debug_index_on_both_servers(self):
+        """The /debug index satellite: both processes list the live
+        debug endpoints with active/inactive state."""
+        from k8s_tpu.client.clientset import Clientset
+        from k8s_tpu.client.fake import FakeCluster
+        from k8s_tpu.dashboard.backend import DashboardServer
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        prev = fleet.active()
+        fleet.set_active(None)
+        srv = MetricsServer(0).start()
+        dash = DashboardServer(Clientset(FakeCluster()),
+                               host="127.0.0.1", port=0)
+        dash.start_background()
+        try:
+            for base in (f"http://127.0.0.1:{srv.port}",
+                         f"http://127.0.0.1:{dash.port}"):
+                for path in ("/debug", "/debug/"):
+                    code, body = _get(base + path)
+                    assert code == 200, (base, path)
+                    endpoints = {e["path"]: e
+                                 for e in json.loads(body)["endpoints"]}
+                    assert set(endpoints) == {
+                        "/debug/traces", "/debug/scheduler",
+                        "/debug/timeline", "/debug/fleet"}
+                    assert endpoints["/debug/fleet"]["active"] is False
+                    for e in endpoints.values():
+                        assert "activation" in e and "params" in e
+            fleet.set_active(self._plane_with_data())
+            code, body = _get(f"http://127.0.0.1:{srv.port}/debug/")
+            endpoints = {e["path"]: e
+                         for e in json.loads(body)["endpoints"]}
+            assert endpoints["/debug/fleet"]["active"] is True
+        finally:
+            srv.stop()
+            dash.shutdown()
+            fleet.set_active(prev)
+
+    def test_fleet_families_in_metrics_exposition(self):
+        from k8s_tpu.util import metrics as metrics_mod
+
+        reg = metrics_mod.Registry()
+        metrics_mod.fleet_metrics(reg)
+        prev = fleet.active()
+        fleet.set_active(self._plane_with_data())
+        try:
+            text = reg.expose()
+        finally:
+            fleet.set_active(prev)
+        assert ('fleet_scrape_total{job="ns/j1",outcome="ok"} 2'
+                in text)
+        assert 'fleet_targets{job="ns/j1"} 1' in text
+        assert "fleet_scrape_duration_seconds_count 2" in text
+        # and the exposition itself round-trips through the parser
+        fams = fleet.parse_exposition(text)
+        assert fams["fleet_scrape_duration_seconds"].kind == "histogram"
+
+
+# -- genjob --serve fleet discoverability (satellite) -------------------------
+
+
+class TestGenjobFleetDiscovery:
+    def test_serve_job_is_fleet_discoverable_by_default(self):
+        from k8s_tpu.api import manifest
+        from k8s_tpu.cmd import genjob
+
+        [job] = genjob.generate(1, serve=True, timestamp=7)
+        template = job["spec"]["tfReplicaSpecs"]["Worker"]["template"]
+        assert template["metadata"]["annotations"][
+            "kubeflow.org/fleet-scrape-port"] == "8000"
+        c = template["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["K8S_TPU_FLEET_SCRAPE_PORT"] == "8000"
+        assert "K8S_TPU_FLEET_INTERVAL_S" not in env
+        manifest.load_tfjob(job)  # defaults+validates as v1alpha2
+        # and discovery actually picks the shape up once it's a Running
+        # pod (annotations travel template -> pod via the pod template)
+        pod = {"metadata": {
+            "name": "p0", "namespace": "default",
+            "annotations": dict(template["metadata"]["annotations"]),
+            "labels": {"tf-replica-type": "worker",
+                       "tf-replica-index": "0",
+                       "tf_job_key": "default-tfjob-7-0"},
+            "ownerReferences": [{"kind": "TFJob", "name": "tfjob-7-0",
+                                 "controller": True}]},
+            "status": {"phase": "Running", "podIP": "10.1.2.3"},
+            "spec": {}}
+        [t] = fleet.targets_from_pods([pod])
+        assert t.url == "http://10.1.2.3:8000/metrics"
+
+    def test_serve_job_fleet_knobs(self):
+        from k8s_tpu.cmd import genjob
+
+        [job] = genjob.generate(1, serve=True, timestamp=8,
+                                fleet_scrape_port=9999,
+                                fleet_interval_s=5.0)
+        template = job["spec"]["tfReplicaSpecs"]["Worker"]["template"]
+        assert template["metadata"]["annotations"][
+            "kubeflow.org/fleet-scrape-port"] == "9999"
+        env = {e["name"]: e["value"]
+               for e in template["spec"]["containers"][0]["env"]}
+        assert env["K8S_TPU_FLEET_SCRAPE_PORT"] == "9999"
+        assert env["K8S_TPU_FLEET_INTERVAL_S"] == "5.0"
+
+    def test_serve_job_fleet_opt_out(self):
+        from k8s_tpu.cmd import genjob
+
+        [job] = genjob.generate(1, serve=True, timestamp=9,
+                                fleet_scrape_port=None)
+        template = job["spec"]["tfReplicaSpecs"]["Worker"]["template"]
+        assert "metadata" not in template
+        env = {e["name"] for e in template["spec"]["containers"][0]["env"]}
+        assert "K8S_TPU_FLEET_SCRAPE_PORT" not in env
+
+
+# -- env knobs ----------------------------------------------------------------
+
+
+class TestEnvKnobs:
+    def test_windows_from_env(self, monkeypatch):
+        monkeypatch.setenv("K8S_TPU_FLEET_WINDOWS", "5, 60")
+        assert fleet.windows_from_env() == (5.0, 60.0)
+        for bad in ("garbage", "60,5", "5", "5,abc", ""):
+            monkeypatch.setenv("K8S_TPU_FLEET_WINDOWS", bad)
+            assert fleet.windows_from_env() == fleet.DEFAULT_WINDOWS
+
+    def test_scrape_enable_and_sizes(self, monkeypatch):
+        monkeypatch.delenv("K8S_TPU_FLEET_SCRAPE", raising=False)
+        assert not fleet.scrape_enabled_from_env()
+        monkeypatch.setenv("K8S_TPU_FLEET_SCRAPE", "1")
+        assert fleet.scrape_enabled_from_env()
+        monkeypatch.setenv("K8S_TPU_FLEET_INTERVAL_S", "0.5")
+        assert fleet.interval_from_env() == 0.5
+        monkeypatch.setenv("K8S_TPU_FLEET_INTERVAL_S", "-3")
+        assert fleet.interval_from_env() == fleet.DEFAULT_INTERVAL_S
+
+
+# -- the --fleet bench at smoke scale -----------------------------------------
+
+
+class TestFleetBenchSmoke:
+    def test_embedded_assertions_pass_at_smoke_scale(self):
+        """The acceptance loop end to end, CI-sized: real controller +
+        informers + kubelet simulator, fake serving pods behind loopback
+        HTTP, aggregation/quantile truth, the zero-apiserver-call steady
+        window, and breach-within-two-intervals — at 8 pods instead of
+        the bench_smoke tier's 32."""
+        from k8s_tpu.harness.bench_operator import bench_fleet
+
+        r = bench_fleet(pods=8, jobs=2, interval_s=0.2, steady_cycles=4,
+                        timeout_s=60.0)
+        assert r["steady_apiserver_calls"] == 0
+        assert r["breach_timeline_ok"] and r["breach_event_ok"]
+        assert r["breach_detect_latency_s"] <= r["breach_budget_s"]
+        for check in r["rates"].values():
+            assert check["measured"] == pytest.approx(check["truth"],
+                                                      rel=0.10)
+        for p99 in r["fleet_p99"].values():
+            assert p99 == pytest.approx(r["p99_reference"], abs=0.02)
+
+    def test_failed_assertions_still_write_the_artifact(self, tmp_path,
+                                                        monkeypatch):
+        """A fleet regression in the non-gating tier must leave the
+        numbers behind (the bench_churn.json contract)."""
+        import argparse
+
+        from k8s_tpu.harness import bench_operator
+
+        # poison the quantile reference so the p99 assertion fails while
+        # everything else still runs to completion
+        monkeypatch.setattr(bench_operator._FleetPodStubs, "TRUE_P99", 9.9)
+        out = tmp_path / "bench_fleet.json"
+        args = argparse.Namespace(
+            fleet_pods=4, fleet_jobs=2, fleet_interval=0.2,
+            fleet_steady_cycles=2, fleet_out=str(out), timeout=60.0)
+        with pytest.raises(RuntimeError, match="fleet bench assertions"):
+            bench_operator.run_fleet(args)
+        payload = json.loads(out.read_text())
+        assert payload["failures"]
+        assert any("p99" in f for f in payload["failures"])
+        assert payload["pods"] == 4
