@@ -63,6 +63,7 @@ def serve_tfjob_template(
     serve_queue: int = 64,
     serve_prefix_blocks: int | None = None,
     serve_batch_sampling: bool = True,
+    serve_batch_spec: bool = True,
     priority: int | None = None,
     queue: str | None = None,
     fleet_scrape_port: int | None = SERVE_HTTP_PORT,
@@ -72,8 +73,9 @@ def serve_tfjob_template(
     shape) with the engine knobs surfaced as env: decode slots and
     admission queue bound, plus the round-6 shared-prefix KV pool
     retention (``K8S_TPU_SERVE_PREFIX_BLOCKS``; omit for auto, 0
-    disables reuse) and batched-sampling lane routing
-    (``K8S_TPU_SERVE_BATCH_SAMPLING``).
+    disables reuse) and the lane-routing knobs — batched sampling
+    (``K8S_TPU_SERVE_BATCH_SAMPLING``) and round-9 batched speculative
+    decoding (``K8S_TPU_SERVE_BATCH_SPEC``).
 
     ISSUE 8: generated serving jobs are **fleet-discoverable by
     default** — the pod template carries the
@@ -95,6 +97,8 @@ def serve_tfjob_template(
         {"name": "K8S_TPU_SERVE_QUEUE", "value": str(serve_queue)},
         {"name": "K8S_TPU_SERVE_BATCH_SAMPLING",
          "value": "1" if serve_batch_sampling else "0"},
+        {"name": "K8S_TPU_SERVE_BATCH_SPEC",
+         "value": "1" if serve_batch_spec else "0"},
     ]
     if serve_prefix_blocks is not None:
         env.append({"name": "K8S_TPU_SERVE_PREFIX_BLOCKS",
@@ -288,6 +292,7 @@ def generate(
     serve_queue: int = 64,
     serve_prefix_blocks: int | None = None,
     serve_batch_sampling: bool = True,
+    serve_batch_spec: bool = True,
     fleet_scrape_port: int | None = 8000,
     fleet_interval_s: float | None = None,
 ) -> list[dict]:
@@ -301,6 +306,7 @@ def generate(
                 serve_slots=serve_slots, serve_queue=serve_queue,
                 serve_prefix_blocks=serve_prefix_blocks,
                 serve_batch_sampling=serve_batch_sampling,
+                serve_batch_spec=serve_batch_spec,
                 priority=priority, queue=queue,
                 fleet_scrape_port=fleet_scrape_port,
                 fleet_interval_s=fleet_interval_s)
@@ -342,6 +348,10 @@ def main(argv=None) -> int:
                         choices=(0, 1), default=1,
                         help="K8S_TPU_SERVE_BATCH_SAMPLING for --serve "
                         "jobs (0 = exclusive-lane sampling)")
+    parser.add_argument("--serve-batch-spec", type=int,
+                        choices=(0, 1), default=1,
+                        help="K8S_TPU_SERVE_BATCH_SPEC for --serve jobs "
+                        "(0 = exclusive-lane speculative decoding)")
     parser.add_argument("--fleet-scrape-port", type=int,
                         default=SERVE_HTTP_PORT,
                         help="kubeflow.org/fleet-scrape-port annotation + "
@@ -374,6 +384,7 @@ def main(argv=None) -> int:
         serve_queue=args.serve_queue,
         serve_prefix_blocks=args.serve_prefix_blocks,
         serve_batch_sampling=bool(args.serve_batch_sampling),
+        serve_batch_spec=bool(args.serve_batch_spec),
         fleet_scrape_port=args.fleet_scrape_port or None,
         fleet_interval_s=args.fleet_interval,
     )

@@ -1428,7 +1428,9 @@ def run_serve(args) -> dict:
             max_new_short=args.serve_max_new_short,
             max_new_long=args.serve_max_new_long,
             sampled=bool(args.serve_sampled),
-            shared_frac=args.serve_shared_frac)
+            shared_frac=args.serve_shared_frac,
+            spec=bool(args.serve_spec),
+            draft_k=args.serve_draft_k)
     except RuntimeError as e:
         partial = getattr(e, "result", None)
         if partial is not None:
@@ -1589,6 +1591,15 @@ def main(argv=None) -> int:
     p.add_argument("--serve-shared-frac", type=float, default=0.8,
                    help="fraction of sampled-phase requests sharing the "
                    "templated prompt prefix")
+    p.add_argument("--serve-spec", type=int, choices=(0, 1), default=1,
+                   help="include the speculative phases in --serve: "
+                   "exclusive-lane vs batched variable-width speculation "
+                   "over structured prompts (spec_batched >= 1.5x "
+                   "spec_exclusive asserted; acceptance rate + compile "
+                   "counts in the JSON artifact)")
+    p.add_argument("--serve-draft-k", type=int, default=4,
+                   help="speculative draft chunk width for the --serve "
+                   "spec phases")
     p.add_argument("--serve-out", default=None,
                    help="also write the --serve JSON result to this path "
                    "(bench artifact)")

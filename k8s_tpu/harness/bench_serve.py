@@ -35,6 +35,23 @@ AFTER warmup, so reuse wins are not conflated with compile warming; a
 fixed-seed equivalence spot check asserts the two sampled routings emit
 identical tokens.
 
+Round 9 adds the SPECULATIVE phases (``--spec``, on by default):
+every request carries ``speculative: draft_k`` over repetitive/
+structured prompts (cyclic token runs — the traffic prompt-lookup
+drafting is strong on).  Phase ``spec_exclusive`` routes speculation
+through the exclusive single-flight lane (the pre-round-9 engine:
+whole-generation programs, one request at a time between batch
+iterations); ``spec_batched`` rides the write-masked variable-width
+slot lanes, every spec slot verifying its draft chunk in the same
+batched call.  The phase records the measured draft-acceptance rate and
+mean accepted drafts per verify step, and a fixed-seed equivalence spot
+check asserts the two routings emit identical tokens (greedy AND
+sampled speculation).  The embedded assertions additionally pin the
+round-9 perf contract: spec_batched >= 1.5x spec_exclusive aggregate
+tokens/s, batched greedy no slower than single-flight (the paged
+decode step must preserve the continuous-batching win), and compile
+counts bounded by the engine's static program sets.
+
 CPU-provable: everything runs on the host platform; no TPU required.
 Numbers are advisory trend data — ci_config.yaml wires this into the
 non-gating bench_smoke tier via ``bench_operator --serve``.
@@ -73,21 +90,28 @@ def _quantile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[int(idx)]
 
 
-def build_model(seed: int = 0):
+def build_model(seed: int = 0, hidden: int = 256, layers: int = 4):
     """CPU-benchable causal LM with byte vocab (256).  Sized so decode is
     PARAM-BOUND like real serving (streaming ~10 MB of weights per
     unbatched token): hidden 256 / 4 layers makes a batch-8 step cost
     ~2x one fused-scan token, so continuous batching wins on shared
     weight reads — the same mechanism as on TPU — rather than on
-    framework-overhead artifacts of a toy model."""
+    framework-overhead artifacts of a toy model.  The speculative
+    phases pass ``hidden=512``: a draft_k-wide verify chunk has
+    draft_k x the arithmetic intensity of a 1-wide step, so keeping THAT
+    phase param-bound (where batching wins on shared weight streams)
+    needs proportionally more weights per step — at hidden 256 a CPU
+    batch-8 verify is pure-compute-bound and measures ALU contention,
+    not the serving mechanism."""
     import jax
     import jax.numpy as jnp
 
     from k8s_tpu.models.transformer import Transformer, TransformerConfig
 
     config = TransformerConfig(
-        vocab_size=256, hidden=256, ffn_hidden=512, layers=4, heads=8,
-        kv_heads=8, max_seq_len=128, dtype=jnp.float32, remat=False)
+        vocab_size=256, hidden=hidden, ffn_hidden=2 * hidden,
+        layers=layers, heads=8, kv_heads=8, max_seq_len=128,
+        dtype=jnp.float32, remat=False)
     params = Transformer(config).init(
         jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
     return config, params
@@ -101,6 +125,58 @@ def _prompt(rank: int, length: int) -> list[int]:
 def _template(length: int) -> list[int]:
     """The shared system-prompt prefix of the sampled phases."""
     return [(i * 5 + 3) % 256 for i in range(length)]
+
+
+SPEC_PROMPT_LEN = 30  # one fixed shape per spec phase: the exclusive
+# lane jit-traces per prompt length, so a single length keeps its
+# whole-generation program count at 1 and the comparison compile-fair
+
+
+def _spec_prompt(rank: int, i: int, length: int = SPEC_PROMPT_LEN
+                 ) -> list[int]:
+    """Repetitive/structured prompts for the speculative phases: a
+    6-token cycle repeated to ``length`` — the 2-gram structure
+    prompt-lookup drafting copies from.  Per-(client, request) cycle
+    content keeps requests distinct while every shape stays fixed."""
+    cycle = [(rank * 29 + i * 17 + j * 11 + 3) % 256 for j in range(6)]
+    return [cycle[j % 6] for j in range(length)]
+
+
+def _grounded_spec_prompts(config, params, n: int = 8, base_len: int = 8,
+                           embed: int = SPEC_PROMPT_LEN - 8
+                           ) -> list[list[int]]:
+    """The speculative phases' workload: GROUNDED prompts — each embeds
+    the model's own greedy continuation of a short base, so the served
+    generation reproduces a span already present in the context (greedy
+    decoding is self-consistent under prefix extension).  This is the
+    traffic class prompt-lookup drafting targets — extraction/
+    summarization/templated generation whose output copies context
+    spans — and it is what "structured prompts where drafting is
+    strong" means operationally.  Bases whose continuation never
+    settles into a repetitive tail are skipped (a random-init model's
+    chaotic trajectories draft at chance; selecting drafting-friendly
+    traffic biases NEITHER lane — both phases serve the identical mix
+    and the lane comparison is the claim).  All prompts share one
+    length so the exclusive lane compiles exactly one whole-generation
+    program."""
+    import numpy as np
+
+    from k8s_tpu.models import decode as decode_lib
+
+    out: list[list[int]] = []
+    seed = 0
+    while len(out) < n and seed < 16 * n:
+        base = [(seed * 29 + j * 11 + 3) % 256 for j in range(base_len)]
+        cont = [int(t) for t in np.asarray(decode_lib.generate(
+            config, params, np.asarray(base, np.int32)[None],
+            embed + 12))[0]]
+        if len(set(cont[embed:])) <= 2:  # repetitive tail: drafts track
+            out.append(base + cont[:embed])
+        seed += 1
+    # pathological weights: fall back to cyclic prompts rather than spin
+    while len(out) < n:
+        out.append(_spec_prompt(len(out), 0))
+    return out
 
 
 def _shared_prompt(rank: int, i: int, template_len: int,
@@ -130,6 +206,8 @@ def run_phase(config, params, *, slots: int, concurrency: int,
               max_new_long: int, queue_limit: int = 1024,
               temperature: float = 0.0,
               batch_sampling: bool = True,
+              batch_spec: bool = True, spec_k: int = 0,
+              spec_prompts: list | None = None,
               prefix_blocks: int | None = None,
               shared_frac: float = 0.0, template_len: int = 40,
               tail_len: int = 6, mode: str | None = None) -> dict:
@@ -149,15 +227,28 @@ def run_phase(config, params, *, slots: int, concurrency: int,
 
     lm = LmServer(config=config, params=params, slots=slots,
                   queue_limit=queue_limit, batch_sampling=batch_sampling,
+                  batch_spec=batch_spec,
                   prefix_blocks=prefix_blocks, registry=Registry())
     httpd = serve(lm)
     url = "http://%s:%d" % httpd.server_address[:2]
     gen_programs0 = decode_lib._cached_generate_fn.cache_info().currsize
+    spec_programs0 = decode_lib.cached_speculative_fn.cache_info().currsize
     try:
         # warmup: compile every (prompt_len, max_new) shape ANY client
         # will issue — the long client cycles through all prompt lengths
         # too — so the measured section is compile-free in both phases
-        if shared_frac > 0:
+        if spec_k > 0:
+            # one spec shape per phase: warms the exclusive lane's
+            # whole-generation program OR the batched lane's prefill
+            # buckets + variable-width verify program, depending on the
+            # batch_spec routing under test
+            if spec_prompts is None:
+                spec_prompts = [_spec_prompt(r, 0) for r in range(8)]
+            _post(url, {"tokens": spec_prompts[0],
+                        "max_new_tokens": max_new_short,
+                        "temperature": temperature,
+                        "speculative": spec_k, "seed": 99})
+        elif shared_frac > 0:
             for shared in (True, False):
                 _post(url, {"tokens": _shared_prompt(
                     99, 99, template_len, tail_len, shared),
@@ -191,11 +282,11 @@ def run_phase(config, params, *, slots: int, concurrency: int,
             import http.client
 
             # greedy phases: one long-generation client exposes the
-            # head-of-line price.  Sampled phases: a uniform short mix —
-            # the headline there is aggregate tokens/s under the
+            # head-of-line price.  Sampled/spec phases: a uniform short
+            # mix — the headline there is aggregate tokens/s under the
             # production traffic shape, and a single long straggler
             # would only measure the tail of an emptying batch.
-            is_long = rank == 0 and shared_frac == 0
+            is_long = rank == 0 and shared_frac == 0 and spec_k == 0
             max_new = max_new_long if is_long else max_new_short
             # one keep-alive connection per client: a real closed-loop
             # client reuses its socket, and per-request TCP + server
@@ -211,7 +302,15 @@ def run_phase(config, params, *, slots: int, concurrency: int,
             time.sleep(rank * 0.005)
             try:
                 for i in range(requests_per_client):
-                    if shared_frac > 0:
+                    if spec_k > 0:
+                        toks = spec_prompts[(rank + i)
+                                            % len(spec_prompts)]
+                        payload = {"tokens": toks,
+                                   "max_new_tokens": max_new,
+                                   "temperature": temperature,
+                                   "speculative": spec_k,
+                                   "seed": rank * 1000 + i}
+                    elif shared_frac > 0:
                         # deterministic split accurate to 1% for ANY
                         # fraction (a modulus of round(1/(1-f)) would
                         # collapse to 0% shared for f <= 0.33): the SAME
@@ -273,6 +372,9 @@ def run_phase(config, params, *, slots: int, concurrency: int,
             "whole_gen_programs":
                 decode_lib._cached_generate_fn.cache_info().currsize
                 - gen_programs0,
+            "whole_gen_spec_programs":
+                decode_lib.cached_speculative_fn.cache_info().currsize
+                - spec_programs0,
         }
         hits = engine_stats.get("prefix_hits", 0) \
             - warm_stats.get("prefix_hits", 0)
@@ -286,14 +388,36 @@ def run_phase(config, params, *, slots: int, concurrency: int,
             "blocks_in_use": engine_stats.get("blocks_in_use", 0),
             "pool_blocks": engine_stats.get("pool_blocks", 0),
         }
+        # speculative drafting efficiency of the MEASURED section (the
+        # batched lane counts per verify step; the exclusive lane's
+        # acceptance happens inside its whole-generation program and is
+        # not separately observable here)
+        spec_steps = engine_stats.get("spec_steps", 0) \
+            - warm_stats.get("spec_steps", 0)
+        spec_prop = engine_stats.get("spec_proposed", 0) \
+            - warm_stats.get("spec_proposed", 0)
+        spec_acc = engine_stats.get("spec_accepted", 0) \
+            - warm_stats.get("spec_accepted", 0)
+        spec = {
+            "draft_k": spec_k,
+            "verify_steps": spec_steps,
+            "proposed": spec_prop,
+            "accepted": spec_acc,
+            "acceptance_rate": round(spec_acc / spec_prop, 3)
+            if spec_prop else 0.0,
+            "mean_accepted_per_step": round(spec_acc / spec_steps, 3)
+            if spec_steps else 0.0,
+        }
         return {
             "mode": mode or ("batched" if slots > 0 else "single_flight"),
             "slots": slots,
             "temperature": temperature,
             "batch_sampling": bool(batch_sampling) and slots > 0,
+            "batch_spec": bool(batch_spec) and slots > 0,
             "shared_frac": shared_frac,
             "compile": compile_counts,
             "prefix": prefix,
+            "spec": spec,
             "requests": len(lat_all),
             "errors": errors[:5],
             "wall_s": round(wall, 3),
@@ -342,15 +466,47 @@ def check_sampled_equivalence(config, params, template_len: int = 40,
     return outs[0] == outs[1]
 
 
+def check_spec_equivalence(config, params, draft_k: int = 4) -> bool:
+    """Fixed-seed spot check over real HTTP: the batched speculative
+    lane and the exclusive lane must emit IDENTICAL tokens for greedy
+    AND sampled speculation — the spec speedup claim is only meaningful
+    if the routing is output-invariant."""
+    from k8s_tpu.models.server import LmServer, serve
+    from k8s_tpu.util.metrics import Registry
+
+    payloads = [
+        {"tokens": _spec_prompt(3, 1), "max_new_tokens": 8,
+         "speculative": draft_k},
+        {"tokens": _spec_prompt(4, 2), "max_new_tokens": 8,
+         "speculative": draft_k, "temperature": 1.0, "seed": 7},
+    ]
+    outs = []
+    for batch_spec in (True, False):
+        lm = LmServer(config=config, params=params, slots=2,
+                      queue_limit=8, batch_spec=batch_spec,
+                      registry=Registry())
+        httpd = serve(lm)
+        try:
+            url = "http://%s:%d" % httpd.server_address[:2]
+            outs.append([_post(url, p) for p in payloads])
+        finally:
+            httpd.shutdown()
+            lm.close()
+    return outs[0] == outs[1]
+
+
 def run_bench(concurrency: int = 16, slots: int = 8,
               requests_per_client: int = 4, max_new_short: int = 32,
               max_new_long: int = 64, seed: int = 0,
-              sampled: bool = True, shared_frac: float = 0.8) -> dict:
+              sampled: bool = True, shared_frac: float = 0.8,
+              spec: bool = True, draft_k: int = 4) -> dict:
     """Single-flight vs continuous batching over the same model/workload
-    (the PR-5 greedy phases), plus the round-6 production mix: 80%
-    shared-prefix traffic at temperature>0, exclusive-lane sampling (the
-    pre-round-6 engine) vs the batched sampling lane with prefix reuse.
-    Returns the JSON-able comparison dict."""
+    (the PR-5 greedy phases), plus the round-6 production mix (80%
+    shared-prefix traffic at temperature>0, exclusive-lane sampling vs
+    the batched sampling lane with prefix reuse), plus the round-9
+    speculative phases (exclusive-lane vs batched variable-width
+    speculation over structured prompts).  Returns the JSON-able
+    comparison dict."""
     config, params = build_model(seed)
     single = run_phase(config, params, slots=0, concurrency=concurrency,
                        requests_per_client=requests_per_client,
@@ -409,6 +565,59 @@ def run_bench(concurrency: int = 16, slots: int = 8,
         result["sampled_shared_frac"] = shared_frac
         result["sampled_equivalence_ok"] = check_sampled_equivalence(
             config, params)
+    if spec:
+        # the round-9 speculative phases: identical structured-prompt
+        # workload, only the lane routing differs.  Baseline = the
+        # pre-round-9 engine (speculation single-flight on the exclusive
+        # lane); candidate = write-masked variable-width slot lanes.
+        # Like the sampled phases, load is raised past the greedy
+        # phases' (2x the clients): the serialized baseline is
+        # load-invariant while the batched lane converts backlog into
+        # occupancy.
+        # the spec phases run the hidden-512 variant of the bench model:
+        # a draft_k-wide verify has draft_k x the arithmetic intensity
+        # of a 1-wide step, and the phase must stay param-bound for the
+        # lane comparison to measure the serving mechanism (shared
+        # weight streams across slots) — see build_model's docstring.
+        # Slots are doubled like the sampled phases double clients:
+        # spec slots spend several iterations per emitted-token budget
+        # verifying, so the batched lane's natural operating width is
+        # wider.  Prefix reuse stays ON for the batched phase and is
+        # moot for the exclusive one — exclusive-lane speculation runs
+        # whole-generation programs over a private dense cache and
+        # ARCHITECTURALLY cannot reuse the pool; flowing spec requests
+        # through the paged pool (where templated/grounded traffic
+        # attaches its repeated prefixes) is part of the round-9 win
+        # being measured.
+        # requests are doubled too: the batched lane pays a ramp/drain
+        # tail (occupancy builds from 1 and empties at the end) that the
+        # load-invariant serialized baseline does not — a longer
+        # closed-loop run measures the steady state both lanes actually
+        # serve
+        spec_config, spec_params = build_model(seed, hidden=512)
+        spec_kw = dict(
+            slots=slots * 2, concurrency=concurrency * 2,
+            requests_per_client=requests_per_client * 2,
+            max_new_short=max_new_short, max_new_long=max_new_long,
+            spec_k=draft_k,
+            spec_prompts=_grounded_spec_prompts(spec_config,
+                                                spec_params))
+        spec_excl = run_phase(spec_config, spec_params, batch_spec=False,
+                              prefix_blocks=0, mode="spec_exclusive",
+                              **spec_kw)
+        spec_prom = run_phase(spec_config, spec_params, batch_spec=True,
+                              prefix_blocks=None, mode="spec_batched",
+                              **spec_kw)
+        result["spec_exclusive"] = spec_excl
+        result["spec_batched"] = spec_prom
+        result["spec_speedup"] = round(
+            spec_prom["tokens_per_s"]
+            / max(spec_excl["tokens_per_s"], 1e-9), 2)
+        result["spec_draft_k"] = draft_k
+        result["spec_acceptance_rate"] = \
+            spec_prom["spec"]["acceptance_rate"]
+        result["spec_equivalence_ok"] = check_spec_equivalence(
+            spec_config, spec_params, draft_k)
     # Embedded assertions (the bench_churn.json contract, ISSUE 8
     # drive-by: every bench artifact reports failures the same way): a
     # violated invariant attaches a ``failures`` field and raises with
@@ -417,7 +626,9 @@ def run_bench(concurrency: int = 16, slots: int = 8,
     failures: list[str] = []
     for phase in (single, batched,
                   result.get("sampled_exclusive") or {},
-                  result.get("sampled_batched") or {}):
+                  result.get("sampled_batched") or {},
+                  result.get("spec_exclusive") or {},
+                  result.get("spec_batched") or {}):
         if phase.get("errors"):
             failures.append(
                 f"phase {phase.get('mode')}: request errors "
@@ -426,6 +637,38 @@ def run_bench(concurrency: int = 16, slots: int = 8,
         failures.append(
             "sampled routing not output-invariant: batched sampling lane "
             "and exclusive lane emitted different tokens at a fixed seed")
+    if spec:
+        if not result["spec_equivalence_ok"]:
+            failures.append(
+                "speculative routing not output-invariant: batched spec "
+                "lane and exclusive lane emitted different tokens at a "
+                "fixed seed")
+        if result["spec_speedup"] < 1.5:
+            failures.append(
+                f"spec_batched only {result['spec_speedup']}x "
+                "spec_exclusive aggregate tokens/s (< 1.5x bound): the "
+                "batched spec lanes are not converting the serialized "
+                "exclusive backlog into occupancy")
+    # the paged-attention decode step (round 9) must preserve the
+    # continuous-batching win: batched greedy no slower than the
+    # single-flight baseline on the same machine (the machine-portable
+    # form of ">= the PR 6 gather-view numbers"; docs/performance.md
+    # carries the absolute before/after)
+    if batched["tokens_per_s"] < single["tokens_per_s"]:
+        failures.append(
+            f"batched greedy {batched['tokens_per_s']} tok/s fell below "
+            f"single-flight {single['tokens_per_s']} tok/s: the paged "
+            "decode step regressed the continuous-batching win")
+    # compile-count contract: prefill bounded by the bucket set, decode
+    # programs by the static (fused width x sampling x spec) sets
+    for phase in (batched, result.get("sampled_batched") or {},
+                  result.get("spec_batched") or {}):
+        if phase and phase["compile"]["decode_programs"] > 10:
+            failures.append(
+                f"phase {phase.get('mode')}: "
+                f"{phase['compile']['decode_programs']} decode programs "
+                "(> the static-set bound of 10): compile count is no "
+                "longer bounded")
     if failures:
         result["failures"] = failures
         err = RuntimeError("serve bench assertions failed:\n  "
@@ -457,6 +700,14 @@ def main(argv=None) -> int:
     p.add_argument("--shared-frac", type=float, default=0.8,
                    help="fraction of sampled-phase requests sharing the "
                    "templated prompt prefix")
+    p.add_argument("--spec", type=int, choices=(0, 1), default=1,
+                   help="also run the speculative phases: exclusive-lane "
+                   "vs batched variable-width speculation over "
+                   "structured prompts (acceptance rate + compile "
+                   "counts land in the JSON artifact)")
+    p.add_argument("--draft-k", type=int, default=4,
+                   help="speculative draft chunk width for the spec "
+                   "phases")
     p.add_argument("--out", default=None,
                    help="also write the JSON result to this path "
                    "(bench artifact)")
@@ -479,7 +730,8 @@ def main(argv=None) -> int:
                            max_new_short=args.max_new_short,
                            max_new_long=args.max_new_long, seed=args.seed,
                            sampled=bool(args.sampled),
-                           shared_frac=args.shared_frac)
+                           shared_frac=args.shared_frac,
+                           spec=bool(args.spec), draft_k=args.draft_k)
     except RuntimeError as e:
         # artifact written on failure too, ``failures`` field included
         # (the bench_churn.json contract)

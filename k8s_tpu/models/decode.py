@@ -110,6 +110,196 @@ def sample_logits_rows(logits, keys, temperature, top_k):
     return jax.vmap(row)(keys, logits, temperature, top_k)
 
 
+def _spec_draft_padded(draft, pad_id: int = 0):
+    """Pad one column onto [B, K-1] drafts so acceptance-count gathers
+    stay in bounds; the pad value is never selected (masked by the
+    acceptance count everywhere it could surface)."""
+    return jnp.concatenate(
+        [draft, jnp.full((draft.shape[0], 1), pad_id, jnp.int32)], axis=1)
+
+
+def spec_accept_greedy(logits, draft):
+    """Greedy speculative acceptance: ``logits`` [B, K, V] are the
+    verify chunk's raw logits, ``draft`` [B, K-1] the proposals.
+    draft[i] is accepted iff it equals the model's own argmax after
+    consuming the (accepted) chunk prefix 0..i; the bonus token is the
+    argmax at the first mismatch (or the chunk's last position when all
+    drafts survive).  Returns ``(acc [B], bonus [B])`` — output is
+    argmax-EXACT with vanilla greedy by construction.
+
+    The ONE definition shared by the exclusive lane
+    (:func:`make_speculative_generate_fn`) and the engine's batched
+    variable-width step (:func:`spec_verify_rows`), so routing can never
+    change a token."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    match = (draft == greedy[:, :-1]).astype(jnp.int32)
+    acc = jnp.cumprod(match, axis=1).sum(axis=1)  # [B] in 0..K-1
+    bonus = jnp.take_along_axis(greedy, acc[:, None], 1)[:, 0]
+    return acc, bonus
+
+
+def spec_accept_sampled(x, draft, ku, kc, pad_id: int = 0):
+    """Rejection sampling against the point-mass draft proposal
+    (Leviathan et al.): ``x`` [B, K, V] are the verify chunk's
+    temperature/top-k PROCESSED logits (the softmax of ``x`` is the
+    sampling distribution), ``draft`` [B, K-1] the proposals, ``ku`` /
+    ``kc`` the uniform- and categorical-draw keys of this iteration.
+    draft[i] is accepted w.p. ``p_i(draft[i])``; on the first rejection
+    the emitted token is drawn from the renormalized residual (``p``
+    with the draft masked — the proposal's mass sits only at the draft,
+    so the residual IS renormalized ``p`` without it); when every draft
+    survives, a bonus token is drawn from the unmasked final
+    distribution.  Each emitted token is therefore distributed EXACTLY
+    as vanilla temperature/top-k sampling.  Returns ``(acc [B],
+    bonus [B])``.
+
+    One definition across the exclusive and batched spec lanes (see
+    :func:`spec_accept_greedy`); fixed-seed equivalence additionally
+    needs the caller to follow the shared key schedule
+    (``rng, ku, kc = jax.random.split(rng, 3)`` per verify)."""
+    logp = jax.nn.log_softmax(x, axis=-1)
+    pd = jnp.exp(jnp.take_along_axis(
+        logp[:, :-1], draft[..., None], 2)[..., 0])  # [B, K-1]
+    u = jax.random.uniform(ku, pd.shape)
+    accept = (u < pd).astype(jnp.int32)
+    acc = jnp.cumprod(accept, axis=1).sum(axis=1)
+    x_acc = jnp.take_along_axis(x, acc[:, None, None], 1)[:, 0]  # [B, V]
+    d_acc = jnp.take_along_axis(
+        _spec_draft_padded(draft, pad_id), acc[:, None], 1)[:, 0]
+    rejected = acc < draft.shape[1]
+    vocab = jnp.arange(x.shape[-1])[None, :]
+    x_res = jnp.where(
+        rejected[:, None] & (vocab == d_acc[:, None]), -1e30, x_acc)
+    bonus = jax.random.categorical(kc, x_res, axis=-1).astype(jnp.int32)
+    return acc, bonus
+
+
+def lookup_draft_host(ctx, draft_k: int) -> list[int]:
+    """Host-side prompt-lookup drafting for the engine's batched
+    speculative lane: propose ``draft_k - 1`` continuations of ``ctx``
+    (the row's full context: prompt plus every emitted token) by copying
+    what followed the most recent earlier occurrence of the trailing
+    2-gram; fallback is repeating the last token.
+
+    EXACTNESS CONTRACT: token-for-token what
+    :func:`make_speculative_generate_fn`'s device-side ``lookup_draft``
+    proposes for the same context — latest occurrence wins, matches are
+    only sought strictly before the trailing 2-gram itself, and
+    continuations past the written length fall back to the last token —
+    so the batched lane verifies the same chunks the exclusive lane
+    would and fixed-seed output stays identical.
+
+    The backward scan is O(len(ctx)) per verify step on the engine's
+    dispatch thread; contexts are bounded by max_seq_len, but an
+    incremental per-slot 2-gram -> latest-index map (updated as tokens
+    append) is the upgrade path if host drafting ever shows up in step
+    latency — it must preserve the latest-occurrence/j < n-2 contract
+    above bit-for-bit."""
+    n = len(ctx)
+    if n < 2:
+        raise ValueError("prompt-lookup drafting needs context >= 2")
+    a, last = ctx[-2], ctx[-1]
+    j = -1
+    for i in range(n - 3, -1, -1):  # j < n - 2, latest occurrence wins
+        if ctx[i] == a and ctx[i + 1] == last:
+            j = i
+            break
+    out = []
+    for d in range(draft_k - 1):
+        off = j + 2 + d
+        out.append(int(ctx[off]) if j >= 0 and off < n else int(last))
+    return out
+
+
+def spec_verify_rows(logits, chunk, keys, temperature, top_k, widths,
+                     sampling: bool):
+    """Row-wise accept/reject for the engine's batched variable-width
+    decode step: each slot advances a per-slot number of tokens from ONE
+    shared [B, W]-chunk model call.
+
+    ``logits`` is [B, W, V] (the verify chunk's logits); ``chunk`` [B, W]
+    is what each row fed (its last token, then its drafts; width-1 rows
+    pad); ``keys`` [B, 2] per-row PRNG carries; ``temperature`` [B] f32;
+    ``top_k`` [B] int32 (0 = off); ``widths`` [B] — 1 for plain
+    greedy/sampled rows, the row's ``draft_k`` for speculative rows (all
+    speculative rows in one call share draft_k == W; the engine groups
+    by draft_k).  ``sampling`` is the jit-static any-row-samples flag.
+    Returns ``(new_keys [B, 2], emit [B, W], n_emit [B])`` — row ``b``
+    emitted ``emit[b, :n_emit[b]]``.
+
+    EXACTNESS CONTRACT (asserted in tests/test_engine.py and over HTTP):
+
+    - width-1 rows compute exactly :func:`sample_logits_rows`'s per-row
+      math on position 0 — split once, draw with the sub key, raw-dtype
+      argmax for temperature-0 rows;
+    - speculative rows follow the exclusive lane's per-iteration
+      schedule: ``rng, ku, kc = split(rng, 3)``, temperature/top-k
+      processing mirroring :func:`_process_logits` value-for-value
+      (sort-based kth threshold, per-position), then the shared
+      :func:`spec_accept_sampled` / :func:`spec_accept_greedy` — for
+      every row this emits token-for-token what
+      :func:`make_speculative_generate_fn` emits for a batch-1 request
+      with the same seed.
+    """
+    W = logits.shape[1]
+    V = logits.shape[-1]
+
+    def row(key, lg, ck, t, tk, w):
+        is_spec = w > 1
+        draft = ck[1:]  # [W-1]
+        kk = jnp.clip(tk, 1, V) - 1
+        # --- width-1 lanes: the single-token batched schedule --------
+        g0 = jnp.argmax(lg[0]).astype(jnp.int32)
+        if sampling:
+            ks2 = jax.random.split(key)
+            x0 = lg[0].astype(jnp.float32) / jnp.where(t > 0, t, 1.0)
+            kth0 = jnp.sort(x0)[::-1][kk]
+            x0 = jnp.where((tk > 0) & (x0 < kth0), -1e30, x0)
+            s0 = jax.random.categorical(ks2[1], x0[None, :], axis=-1)[0]
+            tok1 = jnp.where(t > 0, s0.astype(jnp.int32), g0)
+        else:
+            tok1 = g0
+        # --- speculative lanes: one K-wide verify ---------------------
+        acc_g, bonus_g = spec_accept_greedy(lg[None], draft[None])
+        acc, bonus = acc_g[0], bonus_g[0]
+        new_key = key
+        if sampling:
+            ks3 = jax.random.split(key, 3)
+            # _process_logits row-wise over the whole chunk: divide by
+            # the row's temperature, kth-largest threshold per position
+            x = lg.astype(jnp.float32) / jnp.where(t > 0, t, 1.0)
+            kth = jnp.sort(x, axis=-1)[:, ::-1][:, kk]  # [W]
+            x = jnp.where((tk > 0) & (x < kth[:, None]), -1e30, x)
+            acc_s, bonus_s = spec_accept_sampled(
+                x[None], draft[None], ks3[1], ks3[2])
+            acc = jnp.where(t > 0, acc_s[0], acc)
+            bonus = jnp.where(t > 0, bonus_s[0], bonus)
+            new_key = jnp.where(is_spec, ks3[0], ks2[0])
+        dp = jnp.concatenate([draft, jnp.zeros((1,), jnp.int32)])
+        emit_spec = jnp.where(jnp.arange(W) < acc, dp, bonus)
+        emit_one = jnp.zeros((W,), jnp.int32).at[0].set(tok1)
+        emit = jnp.where(is_spec, emit_spec, emit_one)
+        n = jnp.where(is_spec, acc + 1, 1).astype(jnp.int32)
+        return new_key, emit, n
+
+    return jax.vmap(row)(keys, logits, chunk, temperature, top_k, widths)
+
+
+def check_speculative_capacity(config: TransformerConfig, prompt_len: int,
+                               max_new_tokens: int, draft_k: int) -> None:
+    """The full-cache headroom bound for speculative decoding: the final
+    verify writes draft positions up to prompt_len + max_new_tokens +
+    draft_k - 3, which must stay within the cache — the one definition
+    shared by the exclusive lane's trace-time guard and the engine's
+    batched-lane admission check."""
+    if config.window_size is None and \
+            prompt_len + max_new_tokens - 2 + draft_k > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) + "
+            f"draft_k ({draft_k}) headroom exceeds max_seq_len "
+            f"({config.max_seq_len})")
+
+
 def _check_cache_capacity(config: TransformerConfig, prompt_len: int,
                           max_new_tokens: int) -> None:
     """Shared full-cache bound for greedy and beam decoding: the LAST
@@ -342,12 +532,7 @@ def make_speculative_generate_fn(config: TransformerConfig,
         # same call attends.  Windowed rings wrap BY DESIGN (eviction
         # safety is the prefill_chunk >= draft_k build-time guard) and
         # decode indefinitely.
-        if config.window_size is None and \
-                Lp + max_new_tokens - 2 + draft_k > config.max_seq_len:
-            raise ValueError(
-                f"prompt ({Lp}) + max_new_tokens ({max_new_tokens}) + "
-                f"draft_k ({draft_k}) headroom exceeds max_seq_len "
-                f"({config.max_seq_len})")
+        check_speculative_capacity(config, Lp, max_new_tokens, draft_k)
         T = Lp + max_new_tokens
         K = draft_k
 
@@ -388,9 +573,7 @@ def make_speculative_generate_fn(config: TransformerConfig,
 
         def draft_padded(draft):
             # draft is [B, K-1]; pad one column so `where` shapes line up
-            return jnp.concatenate(
-                [draft, jnp.full((draft.shape[0], 1), pad_id, jnp.int32)],
-                axis=1)
+            return _spec_draft_padded(draft, pad_id)
 
         def body(carry):
             seq, n, last, done, cache, iters, rng = carry
@@ -403,37 +586,14 @@ def make_speculative_generate_fn(config: TransformerConfig,
                 positions=positions, mode="decode", mutable=["cache"])
             if sampling:
                 # rejection sampling against the point-mass draft
-                # proposal: accept draft[i] w.p. p_i(draft[i]); on the
-                # first rejection sample the residual (p with the draft
-                # masked — q's mass is only at the draft, so the residual
-                # IS renormalized p without it); all-accepted rows draw
-                # the bonus from the unmasked final distribution
+                # proposal — spec_accept_sampled, the one definition
+                # shared with the engine's batched spec lane (which must
+                # match this token-for-token at a fixed seed)
                 rng, ku, kc = jax.random.split(rng, 3)
-                x = _proc(logits)                            # [B, K, V]
-                logp = jax.nn.log_softmax(x, axis=-1)
-                pd = jnp.exp(jnp.take_along_axis(
-                    logp[:, :-1], draft[..., None], 2)[..., 0])  # [B, K-1]
-                u = jax.random.uniform(ku, pd.shape)
-                accept = (u < pd).astype(jnp.int32)
-                acc = jnp.cumprod(accept, axis=1).sum(axis=1)
-                x_acc = jnp.take_along_axis(
-                    x, acc[:, None, None], 1)[:, 0]          # [B, V]
-                d_acc = jnp.take_along_axis(
-                    draft_padded(draft), acc[:, None], 1)[:, 0]
-                rejected = acc < (K - 1)
-                vocab = jnp.arange(x.shape[-1])[None, :]
-                x_res = jnp.where(
-                    rejected[:, None] & (vocab == d_acc[:, None]),
-                    -1e30, x_acc)
-                bonus = jax.random.categorical(
-                    kc, x_res, axis=-1).astype(jnp.int32)
+                acc, bonus = spec_accept_sampled(
+                    _proc(logits), draft, ku, kc, pad_id)
             else:
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                # draft[i] is accepted iff it equals the model's own
-                # argmax after consuming the (accepted) chunk prefix 0..i
-                match = (draft == greedy[:, :-1]).astype(jnp.int32)
-                acc = jnp.cumprod(match, axis=1).sum(axis=1)  # [B] 0..K-1
-                bonus = jnp.take_along_axis(greedy, acc[:, None], 1)[:, 0]
+                acc, bonus = spec_accept_greedy(logits, draft)
             ar = jnp.arange(K)[None, :]
             emit = jnp.where(ar < acc[:, None], draft_padded(draft),
                              bonus[:, None])                 # [B, K]

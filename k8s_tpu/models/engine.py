@@ -23,21 +23,39 @@ cache with shared-prefix reuse and a batched sampling lane:
   path with exact per-token positions (no left-pad RoPE corruption) in
   bucket-sized chunks (decode.prefill_buckets_for / split_prefill), then
   land in the request's own pool blocks;
-- one **batched decode step** advances every active slot per iteration
-  over a gathered block-table view of the pool; requests join and retire
-  *between* steps, so a long generation never serializes short ones;
+- one **batched decode step** advances every active slot per iteration,
+  addressing the block pool DIRECTLY through per-row block tables behind
+  the ``paged_attention`` seam (models/paged.py, round 9): new K/V
+  scatters straight into pool blocks and attention gathers them in
+  table order — no per-row view is materialized or written back per
+  fused window, and a Pallas TPU kernel can replace the seam's body
+  without touching this engine; requests join and retire *between*
+  steps, so a long generation never serializes short ones;
 - **sampling rides the batch** (round 6): per-slot RNG keys, temperature
   and top-k are threaded through the batched step and
   ``decode.sample_logits_rows`` draws each row from its own distribution
   with the exact key schedule of the exclusive lane's program — a
   fixed-seed ``temperature>0`` request emits token-identical output on
-  either lane (asserted in tests).  Speculative and beam requests still
-  take the **exclusive lane** (single-flight between batch iterations):
-  their multi-token verify steps need write-masked variable-width
-  chunks the shared batched step does not express yet;
+  either lane (asserted in tests);
+- **speculative decoding rides the batch too** (round 9): a spec slot
+  verifies its ``draft_k``-token prompt-lookup chunk in the SAME model
+  call that advances its 1-token neighbors — per-slot widths with
+  write-masked padding lanes (a masked lane rides at position -1 and
+  its K/V write is dropped, so a mixed-width batch never scribbles past
+  a short row's block capacity), host-side drafting mirroring the
+  exclusive lane's ``lookup_draft`` exactly, and the shared
+  ``decode.spec_accept_*`` rejection-sampling path with the exclusive
+  lane's per-iteration key schedule — fixed-seed batched spec output is
+  token-identical to ``make_speculative_generate_fn``.  Spec slots with
+  different ``draft_k`` values are grouped per step (round-robin across
+  groups) so the chunk width stays uniform and per-row random draws
+  keep the exclusive lane's shapes.  Beam requests (and speculative on
+  windowed configs, whose dense rows have no write-maskable pool) still
+  take the **exclusive lane**;
 - compile count stays bounded: one prefill program per USED bucket, one
-  batched decode program, and a constant set of pool auxiliaries
-  (copy-on-write, block reset, row scatter) — never per prefix length;
+  batched decode program per (fused width, sampling, spec) tuple
+  actually used, and a constant set of pool auxiliaries (copy-on-write,
+  block reset, row scatter) — never per prefix length;
 - a **bounded admission queue** gives backpressure: when it is full,
   submit() raises :class:`QueueFull` and the HTTP layer answers 503 with
   ``Retry-After`` (readiness is not not-busy — /healthz stays 200 while
@@ -53,7 +71,8 @@ treats 0 as "engine off" → legacy single-flight),
 ``K8S_TPU_SERVE_PREFIX_BLOCKS`` (extra pool blocks retained for the
 prefix tree beyond the ``1 + slots x blocks_per_row`` floor; 0 disables
 prefix reuse, unset auto-sizes to two full-length rows).  The
-``K8S_TPU_SERVE_BATCH_SAMPLING`` lane-routing knob lives in the server.
+``K8S_TPU_SERVE_BATCH_SAMPLING`` and ``K8S_TPU_SERVE_BATCH_SPEC``
+lane-routing knobs live in the server.
 """
 
 from __future__ import annotations
@@ -131,6 +150,19 @@ def env_batch_sampling() -> bool:
     return True
 
 
+def env_batch_spec() -> bool:
+    """K8S_TPU_SERVE_BATCH_SPEC: route speculative requests onto the
+    batched slot lanes (default on; 0/false restores the exclusive
+    single-flight routing — the pre-round-9 behavior and the bench
+    baseline).  Consumed by models/server.py's lane routing; windowed
+    configs ride the exclusive lane regardless (their dense rows have no
+    write-maskable block pool)."""
+    raw = os.environ.get("K8S_TPU_SERVE_BATCH_SPEC", "").strip().lower()
+    if raw in ("0", "false", "no", "off"):
+        return False
+    return True
+
+
 class QueueFull(RuntimeError):
     """Admission queue at capacity; carries the Retry-After hint."""
 
@@ -157,6 +189,7 @@ class _Request:
     temperature: float = 0.0
     top_k: Optional[int] = None
     seed: int = 0
+    speculative: int = 0  # draft_k (>= 2) for batched spec; 0 = off
     fn: Optional[Callable[[], Any]] = None
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
@@ -175,7 +208,7 @@ class _Slot:
     (windowed fallback).  ``ready`` flips True once prefill landed."""
 
     __slots__ = ("idx", "req", "pos", "last", "tokens", "ready",
-                 "key", "table", "nblocks")
+                 "key", "table", "nblocks", "ctx")
 
     def __init__(self, idx: int, maxb: int):
         self.idx = idx
@@ -187,6 +220,9 @@ class _Slot:
         self.key = np.zeros(2, np.uint32)   # per-slot PRNG carry
         self.table = np.zeros(maxb, np.int32)  # pool block ids (0 = null)
         self.nblocks = 0
+        # full context (prompt + emitted) for speculative slots only:
+        # host-side prompt-lookup drafting reads it every verify step
+        self.ctx: Optional[list[int]] = None
 
     @property
     def free(self) -> bool:
@@ -198,6 +234,7 @@ class _Slot:
         self.ready = False
         self.table[:] = 0
         self.nblocks = 0
+        self.ctx = None
 
 
 def _reset_positions(tree):
@@ -230,15 +267,6 @@ def _map_cache(tree, fn):
     if isinstance(tree, Mapping):
         return {k: _map_cache(v, fn) for k, v in tree.items()}
     return tree
-
-
-def _map_cache2(a, b, fn):
-    """Like :func:`_map_cache` over two structurally-identical trees."""
-    if _is_cache_node(a):
-        return fn(a, b)
-    if isinstance(a, Mapping):
-        return {k: _map_cache2(v, b[k], fn) for k, v in a.items()}
-    return a
 
 
 class Engine:
@@ -317,8 +345,10 @@ class Engine:
         # (copy-on-write, block reset, row scatter, cache init) that
         # never grow with traffic or with distinct prefix lengths.
         self._prefill_fns: dict[int, Callable] = {}
-        # (fused width, has-sampling) step programs compiled so far
-        self._step_ks: set[tuple[int, bool]] = set()
+        # (fused width, has-sampling, is-spec) step programs compiled
+        # so far — spec verify steps are distinct programs from the
+        # k-fused greedy/sampled scans at the same width
+        self._step_ks: set[tuple[int, bool, bool]] = set()
         self._row_template = self._init_cache(1)
         if self.paged:
             # one jit entry point; the fused iteration count k and the
@@ -329,6 +359,12 @@ class Engine:
             self._step_fn = jax.jit(self._paged_step_impl,
                                     donate_argnums=(1,),
                                     static_argnums=(6, 7))
+            # the variable-width speculative step: chunk width W and the
+            # sampling flag are static, so spec traffic adds one program
+            # per (draft_k, sampling) pair actually used
+            self._spec_fn = jax.jit(self._spec_step_impl,
+                                    donate_argnums=(1,),
+                                    static_argnums=(7, 8))
             self._cow_fn = jax.jit(self._cow_impl, donate_argnums=(0,))
             self._pool = self._make_pool()
             self._row_template = None  # only _make_pool needed it; a
@@ -359,6 +395,10 @@ class Engine:
         self._prefix_hits = 0
         self._prefix_tokens_saved = 0
         self._cow_copies = 0
+        self._spec_proposed = 0   # draft tokens offered to verify steps
+        self._spec_accepted = 0   # draft tokens accepted by verify steps
+        self._spec_steps = 0      # verify calls (per participating slot)
+        self._spec_rr = 0         # round-robin over draft_k groups
         self._occupancy: deque[tuple[int, int]] = deque(maxlen=4096)
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -369,12 +409,20 @@ class Engine:
 
     def submit(self, ids, max_new_tokens: int, eos_id: Optional[int] = None,
                temperature: float = 0.0, top_k: Optional[int] = None,
-               seed: int = 0, timeout: Optional[float] = None) -> list[int]:
+               seed: int = 0, speculative: int = 0,
+               timeout: Optional[float] = None) -> list[int]:
         """Batched generation (greedy at ``temperature == 0``, otherwise
         temperature/top-k sampling with the exclusive lane's exact key
-        schedule for ``seed``); returns emitted tokens, stopping at the
-        first EOS inclusive.  Raises QueueFull under backpressure."""
-        from k8s_tpu.models.decode import _check_cache_capacity
+        schedule for ``seed``); ``speculative=draft_k`` (>= 2) verifies
+        prompt-lookup draft chunks in the batched variable-width step —
+        fixed-seed output token-identical to the exclusive lane's
+        ``make_speculative_generate_fn`` program.  Returns emitted
+        tokens, stopping at the first EOS inclusive.  Raises QueueFull
+        under backpressure."""
+        from k8s_tpu.models.decode import (
+            _check_cache_capacity,
+            check_speculative_capacity,
+        )
 
         ids = np.asarray(ids, np.int32).reshape(-1)
         if ids.size < 1:
@@ -385,6 +433,24 @@ class Engine:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if top_k is not None and top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if speculative:
+            if speculative < 2:
+                raise ValueError(
+                    f"speculative draft_k must be >= 2, got {speculative}")
+            if not self.paged:
+                raise ValueError(
+                    "batched speculative decoding needs the paged block "
+                    "cache; windowed configs ride the exclusive lane "
+                    "(models/server.py routes them there)")
+            if ids.size < 2:
+                raise ValueError(
+                    "prompt-lookup drafting needs prompt_len >= 2")
+            # the final verify writes draft positions past the emitted
+            # length; same trace-time bound as the exclusive lane,
+            # surfaced before the request occupies queue space
+            check_speculative_capacity(self.config, int(ids.size),
+                                       int(max_new_tokens),
+                                       int(speculative))
         # same bound the unbatched jit enforces at trace time, surfaced
         # BEFORE the request occupies queue space (an over-capacity row
         # would wrap slot = pos % S and corrupt its own cache row)
@@ -392,7 +458,8 @@ class Engine:
                               int(max_new_tokens))
         req = _Request(ids=ids, max_new_tokens=int(max_new_tokens),
                        eos_id=eos_id, temperature=float(temperature),
-                       top_k=top_k, seed=int(seed))
+                       top_k=top_k, seed=int(seed),
+                       speculative=int(speculative))
         return self._enqueue_and_wait(req, timeout)
 
     def submit_exclusive(self, fn: Callable[[], Any],
@@ -457,13 +524,22 @@ class Engine:
                 "peak_active": self._peak_active,
                 "buckets": list(self.buckets),
                 "prefill_programs": sorted(self._prefill_fns),
-                # one batched decode program per (fused width, sampling)
-                # combination actually used; bounded by a static set
-                # (widths {1,2,4} x greedy/sampling), never by traffic
+                # one batched decode program per (fused width, sampling,
+                # spec) tuple actually used; bounded by a static set
+                # (fused widths {1,2,4} x greedy/sampling, plus one per
+                # draft_k group x greedy/sampling), never by traffic
                 # shape
                 "decode_programs": len(self._step_ks),
                 "decode_step_ks": sorted(
                     [list(t) for t in self._step_ks]),
+                # speculative lane (round 9): drafting efficiency for
+                # /healthz and the fleet plane
+                "spec_proposed": self._spec_proposed,
+                "spec_accepted": self._spec_accepted,
+                "spec_steps": self._spec_steps,
+                "spec_mean_accepted": round(
+                    self._spec_accepted / self._spec_steps, 3)
+                if self._spec_steps else 0.0,
                 "occupancy_timeline": list(self._occupancy),
                 # paged-cache / prefix-reuse surface
                 "paged": self.paged,
@@ -539,61 +615,58 @@ class Engine:
 
         return _map_cache(self._row_template, build)
 
-    def _view(self, pool, tables, lens):
-        """Gather per-row block tables into a dense decode-cache view:
-        leaf ``[N, blk, ...]`` + tables ``[B, MAXB]`` →
-        ``[B, MAXB * blk, ...]``.  View index p IS absolute position p
-        (block p//blk, offset p%blk), so the model's ``slot = pos % S``
-        addressing is the identity for every in-capacity position.  The
-        pos leaf is synthesized: position p is valid iff ``p < lens[b]``
-        (everything below a row's written length is its own or shared
-        content by the table invariant; everything above — stale
-        recycled-block data, a CoW'd divergence tail, null-block
-        padding — is masked)."""
-        import jax.numpy as jnp
-
-        B = tables.shape[0]
-        S_view = self._maxb * self.block_size
-        idx = jnp.arange(S_view, dtype=jnp.int32)
-        pos_view = jnp.where(idx[None, :] < lens[:, None],
-                             idx[None, :], -1)
-
+    def _paged_cache(self, pool, tables, lens):
+        """Attach the per-row block ``table`` and written-``len`` bound
+        to every pool cache node: the collection the transformer's paged
+        decode path consumes (write straight into pool blocks, attend
+        behind the ``paged_attention`` seam) — replacing the round-6
+        gathered per-row view, which copied every KV leaf per fused
+        window (the ~15% decode tax docs/performance.md tracked)."""
         def build(node):
-            out = {k: v[tables].reshape((B, S_view) + v.shape[2:])
-                   for k, v in node.items()}
-            out["pos"] = pos_view
-            return out
+            return {**node, "table": tables, "len": lens}
 
         return _map_cache(pool, build)
 
+    @staticmethod
+    def _pool_from_cache(cache):
+        """Strip the table/len addressing back off a returned cache
+        collection, leaving just the pool leaves."""
+        def strip(node):
+            return {k: v for k, v in node.items()
+                    if k not in ("table", "len")}
+
+        return _map_cache(cache, strip)
+
     def _paged_step_impl(self, params, pool, tables, ints, keys, temps,
                          k: int, sampling: bool):
-        """``k`` fused batched decode iterations over ONE gathered pool
-        view (``k`` is jit-static, bounded by MAX_STEP_TOKENS): feed
-        each row's last token at its own position, sample/argmax per row
-        from its own distribution (decode.sample_logits_rows — the
-        exclusive lane's exact key schedule, one split per emitted
-        token), carry the updated view through a scan, then scatter all
-        written K/V back to the pool in one pass.  ``ints`` packs
-        [toks, poss, topks] into one [3, B] transfer; a row's position
-        doubles as its written length for the view.  Inactive rows ride
-        at position -1: their writes land at view slot S-1 → their
-        null-block table entry → harmless."""
+        """``k`` fused batched decode iterations over the block pool
+        (``k`` is jit-static, bounded by MAX_STEP_TOKENS): feed each
+        row's last token at its own position, sample/argmax per row from
+        its own distribution (decode.sample_logits_rows — the exclusive
+        lane's exact key schedule, one split per emitted token), carry
+        the POOL itself through a scan.  K/V writes scatter straight
+        into each row's blocks inside the model call and attention
+        indexes the pool through the block tables behind the
+        ``paged_attention`` seam — nothing is gathered into a per-row
+        view or written back.  ``ints`` packs [toks, poss, topks] into
+        one [3, B] transfer; a row's position doubles as its written
+        length for validity masking.  Inactive rows ride at position -1:
+        their writes are dropped before they reach the pool."""
         import jax
         import jax.numpy as jnp
 
         from k8s_tpu.models.decode import sample_logits_rows
 
         toks0, poss0, topks = ints[0], ints[1], ints[2]
-        S = self.config.max_seq_len
-        view = self._view(pool, tables, poss0)
 
         def body(carry, _):
-            cache, toks, poss, kk = carry
+            pool, toks, poss, kk = carry
+            cache = self._paged_cache(pool, tables, jnp.maximum(poss, 0))
             logits, varz = self._model.apply(
                 {"params": params, "cache": cache}, toks[:, None],
                 positions=poss[:, None], mode="decode",
                 mutable=["cache"])
+            pool = self._pool_from_cache(varz["cache"])
             if sampling:
                 new_keys, nxt = sample_logits_rows(logits[:, -1], kk,
                                                    temps, topks)
@@ -605,27 +678,44 @@ class Engine:
                 nxt = jnp.argmax(logits[:, -1],
                                  axis=-1).astype(jnp.int32)
             act = poss >= 0
-            return (varz["cache"], jnp.where(act, nxt, toks),
+            return (pool, jnp.where(act, nxt, toks),
                     jnp.where(act, poss + 1, poss), new_keys), nxt
 
-        (view, _, _, keys_out), toks_all = jax.lax.scan(
-            body, (view, toks0, poss0, keys), None, length=k)
-        # write back the k positions each row wrote (from the scanned
-        # view, which carries them); inactive rows target slot S-1
-        ar = jnp.arange(k)
-        idxs = jnp.where((poss0 >= 0)[:, None],
-                         poss0[:, None] + ar[None, :], S - 1) % S  # [B,k]
-        blk = self.block_size
-        dstb = jnp.take_along_axis(tables, idxs // blk, axis=1)
-        off = idxs % blk
-        rows = jnp.arange(tables.shape[0])[:, None]
-
-        def wb(pool_node, view_node):
-            return {name: v.at[dstb, off].set(view_node[name][rows, idxs])
-                    for name, v in pool_node.items()}
-
-        pool = _map_cache2(pool, view, wb)
+        (pool, _, _, keys_out), toks_all = jax.lax.scan(
+            body, (pool, toks0, poss0, keys), None, length=k)
         return pool, toks_all, keys_out  # toks_all [k, B]
+
+    def _spec_step_impl(self, params, pool, tables, chunk, ints, keys,
+                        temps, k: int, sampling: bool):
+        """ONE write-masked variable-width batched step (``k`` = the
+        jit-static chunk width W): every participating slot feeds its
+        own row of ``chunk`` [B, W] — a speculative slot its last token
+        plus ``draft_k - 1`` prompt-lookup drafts (width W), a plain
+        slot just its last token (width 1) — at per-slot positions.
+        Lanes past a row's width ride at position -1, so their K/V
+        writes are DROPPED before reaching the pool (the write mask: a
+        mixed-width batch can never scribble past a short row's block
+        capacity) and their queries attend nothing.  Accept/reject runs
+        row-wise in decode.spec_verify_rows with the exclusive lane's
+        exact per-iteration key schedule.  ``ints`` packs [poss, widths,
+        topks]; returns (pool, emit [B, W], n_emit [B], new_keys)."""
+        import jax.numpy as jnp
+
+        from k8s_tpu.models.decode import spec_verify_rows
+
+        poss, widths, topks = ints[0], ints[1], ints[2]
+        ar = jnp.arange(k, dtype=jnp.int32)
+        cpos = jnp.where(
+            (poss >= 0)[:, None] & (ar[None, :] < widths[:, None]),
+            poss[:, None] + ar[None, :], -1)  # [B, W]; -1 = write-masked
+        cache = self._paged_cache(pool, tables, jnp.maximum(poss, 0))
+        logits, varz = self._model.apply(
+            {"params": params, "cache": cache}, chunk,
+            positions=cpos, mode="decode", mutable=["cache"])
+        pool = self._pool_from_cache(varz["cache"])
+        new_keys, emit, n_emit = spec_verify_rows(
+            logits, chunk, keys, temps, topks, widths, sampling)
+        return pool, emit, n_emit, new_keys
 
     def _cow_impl(self, pool, src, dst):
         """Copy-on-write at the divergence block: duplicate block ``src``
@@ -668,9 +758,9 @@ class Engine:
 
     def _prefill_fn(self, chunk_len: int) -> Callable:
         """Per-bucket prefill program.  Paged mode: one chunked
-        decode-mode call over the request's gathered row view with the
-        written range scattered back to its pool blocks.  Dense mode:
-        the batch-1 row-cache call (scattered into the slot later)."""
+        decode-mode call writing straight into the request's pool blocks
+        through its table (the paged_attention seam).  Dense mode: the
+        batch-1 row-cache call (scattered into the slot later)."""
         fn = self._prefill_fns.get(chunk_len)
         if fn is None:
             import jax
@@ -680,24 +770,14 @@ class Engine:
                 def run(params, pool, table, chunk, positions):
                     # written length BEFORE this chunk = its first
                     # position (chunks land in order)
-                    view = self._view(pool, table[None, :],
-                                      positions[:, 0])
+                    cache = self._paged_cache(pool, table[None, :],
+                                              positions[:, 0])
                     logits, varz = self._model.apply(
-                        {"params": params, "cache": view}, chunk,
+                        {"params": params, "cache": cache}, chunk,
                         positions=positions, mode="decode",
                         mutable=["cache"])
-                    idxs = positions[0] % self.config.max_seq_len
-                    blk = self.block_size
-                    dstb = table[idxs // blk]
-                    off = idxs % blk
-
-                    def wb(pool_node, view_node):
-                        return {k: v.at[dstb, off].set(view_node[k][0,
-                                                                    idxs])
-                                for k, v in pool_node.items()}
-
-                    pool = _map_cache2(pool, varz["cache"], wb)
-                    return pool, logits[:, -1]
+                    return self._pool_from_cache(varz["cache"]), \
+                        logits[:, -1]
 
                 fn = jax.jit(run, donate_argnums=(1,))
             else:
@@ -952,6 +1032,9 @@ class Engine:
         slot.tokens = tokens
         slot.last = first
         slot.pos = len(ids)
+        if req.speculative:
+            # host-side prompt-lookup drafting reads the full context
+            slot.ctx = [int(t) for t in ids] + tokens
         slot.ready = True
         with self._cond:
             self._peak_active = max(
@@ -975,17 +1058,34 @@ class Engine:
 
     def _decode_step_all(self) -> None:
         """One batched step over every ready slot.  Inactive rows ride
-        along at position -1: the model's write slot wraps to S-1 with a
-        stored pos of -1, so (paged) their stray write lands in their
-        table's null block, never valid, or (dense) in a row the next
-        prefill scatter fully replaces.  Row independence of the batched
-        math keeps active rows exact."""
+        along at position -1: (paged) their writes are dropped before
+        reaching the pool, or (dense) the model's write slot wraps to
+        S-1 in a row the next prefill scatter fully replaces.  Row
+        independence of the batched math keeps active rows exact.
+
+        Speculative slots divert the whole step into the variable-width
+        path: all plain slots advance one token while every spec slot of
+        the chosen ``draft_k`` group verifies its draft chunk in the
+        same model call.  Groups with other ``draft_k`` values sit the
+        step out (their state untouched — the per-request key schedule
+        only advances on actual verifies) and a round-robin pointer
+        rotates the pick, so no group starves and the per-row random
+        draw shapes always match the exclusive lane's."""
         import jax.numpy as jnp
 
         from k8s_tpu import trace
 
         B = len(self._slots)
         active = [s for s in self._slots if s.ready]
+        spec_ks = sorted({s.req.speculative for s in active
+                          if s.req.speculative})
+        if spec_ks:
+            pick = spec_ks[self._spec_rr % len(spec_ks)]
+            self._spec_rr += 1
+            self._spec_step(
+                [s for s in active if s.req.speculative in (0, pick)],
+                pick)
+            return
         k = 1
         if self.paged and active:
             # fuse up to MAX_STEP_TOKENS iterations into one program
@@ -1052,7 +1152,7 @@ class Engine:
         # copy-on-write rebind like _prefill_fns: stats() reads this set
         # from probe threads without the engine lock
         self._step_ks = self._step_ks | {
-            (k if self.paged else 1, sampling)}
+            (k if self.paged else 1, sampling, False)}
         occ = self.metrics.get("occupancy")
         if occ is not None:
             occ.set(len(active))
@@ -1077,3 +1177,104 @@ class Engine:
                 s.key = keys_host[s.idx]
                 continue
             # retired: key update irrelevant (slot cleared)
+
+    def _spec_step(self, active: list, draft_k: int) -> None:
+        """One write-masked variable-width batched step (chunk width
+        W = ``draft_k``): every spec slot of the chosen group feeds its
+        last token + W-1 host-proposed prompt-lookup drafts; every plain
+        slot feeds just its last token with its padding lanes
+        write-masked at position -1.  Emissions are truncated host-side
+        at the first EOS / max_new_tokens exactly as the exclusive
+        lane's program truncates, so fixed-seed output matches it
+        token-for-token; rejected drafts need no rollback — their pool
+        writes sit above the row's written length, masked until the
+        next chunk overwrites them (the write-then-mask contract)."""
+        import jax.numpy as jnp
+
+        from k8s_tpu import trace
+        from k8s_tpu.models.decode import lookup_draft_host
+
+        B = len(self._slots)
+        W = draft_k
+        # grow tables so every (masked or not) spec write of this chunk
+        # lands in an owned block; plain slots only need their next slot
+        grew = False
+        for s in active:
+            w = W if s.req.speculative else 1
+            need_bi = (s.pos + w - 1) // self.block_size
+            while s.nblocks <= need_bi:
+                s.table[s.nblocks] = self._alloc_block()
+                s.nblocks += 1
+                grew = True
+        if grew:
+            self._tables_dirty = True
+            self._update_block_gauge()
+        chunk = np.full((B, W), self.pad_id, np.int32)
+        ints = np.zeros((3, B), np.int32)  # [poss, widths, topks]
+        ints[0] = -1
+        keys = np.zeros((B, 2), np.uint32)
+        temps = np.zeros((B,), np.float32)
+        for s in active:
+            chunk[s.idx, 0] = s.last
+            if s.req.speculative:
+                chunk[s.idx, 1:W] = lookup_draft_host(s.ctx, W)
+                ints[1, s.idx] = W
+            else:
+                ints[1, s.idx] = 1
+            ints[0, s.idx] = s.pos
+            ints[2, s.idx] = s.req.top_k or 0
+            keys[s.idx] = s.key
+            temps[s.idx] = s.req.temperature
+        sampling = any(s.req.temperature > 0 for s in active)
+        n_spec = sum(1 for s in active if s.req.speculative)
+        with trace.span("decode_step", active=len(active), fused=W,
+                        spec=n_spec):
+            if self._tables_dirty:
+                self._tables_dev = jnp.asarray(
+                    np.stack([s.table for s in self._slots]))
+                self._tables_dirty = False
+            self._pool, emit, n_emit, new_keys = self._spec_fn(
+                self.params, self._pool, self._tables_dev,
+                jnp.asarray(chunk), jnp.asarray(ints),
+                jnp.asarray(keys), jnp.asarray(temps), W, sampling)
+            emit_host = np.asarray(emit)      # [B, W]
+            n_host = np.asarray(n_emit)       # [B]
+            keys_host = np.asarray(new_keys)
+        self._step_ks = self._step_ks | {(W, sampling, True)}
+        occ = self.metrics.get("occupancy")
+        if occ is not None:
+            occ.set(len(active))
+        with self._cond:
+            self._steps += 1
+            self._occupancy.append((self._steps, len(active)))
+        prop_c = self.metrics.get("spec_proposed")
+        acc_c = self.metrics.get("spec_accepted")
+        for s in active:
+            req = s.req
+            n = int(n_host[s.idx])
+            toks = [int(t) for t in emit_host[s.idx, :n]]
+            s.key = keys_host[s.idx]
+            if req.speculative:
+                self._spec_steps += 1
+                self._spec_proposed += W - 1
+                self._spec_accepted += n - 1
+                if prop_c is not None:
+                    prop_c.inc(W - 1)
+                if acc_c is not None:
+                    acc_c.inc(n - 1)
+            out: list[int] = []
+            done = False
+            # truncate exactly as the exclusive lane's program: at the
+            # first emitted EOS inclusive, capped at max_new_tokens
+            for t in toks[:req.max_new_tokens - len(s.tokens)]:
+                out.append(t)
+                if req.eos_id is not None and t == req.eos_id:
+                    done = True
+                    break
+            s.tokens.extend(out)
+            s.pos += len(out)
+            s.last = out[-1]
+            if s.ctx is not None:
+                s.ctx.extend(out)
+            if done or len(s.tokens) >= req.max_new_tokens:
+                self._retire(s, req, s.tokens)

@@ -1,0 +1,155 @@
+"""Block-table paged attention: the decode-step seam between the serving
+engine's pooled KV cache and the attention math.
+
+The engine (models/engine.py) keeps decode KV state in one shared
+block-granular pool per cache leaf (``[num_blocks, block_size, kv_heads,
+head_dim]``) addressed through per-request **block tables**.  Until round
+9 the batched step materialized a per-row gathered *view* of the pool
+(``leaf[tables].reshape(B, S, ...)`` for every leaf, every fused window),
+ran the dense cache path over it, and scattered the written positions
+back — a full extra copy of every row's KV per window, the ~15% decode
+tax docs/performance.md tracks.  Now the transformer's decode step writes
+new K/V straight into the pool at ``(table[pos // block], pos % block)``
+and attends through this one function:
+
+    paged_attention(q, pool_k, pool_v, tables, lengths, positions)
+
+Everything the attention needs to address the pool goes through this
+seam, so a real TPU kernel — a Pallas grid over (batch row, block) that
+streams table-addressed blocks HBM→VMEM with no gathered copy at all,
+flash-style running softmax per row — can replace the body without
+touching the engine or the transformer.  The reference implementation
+below is plain XLA: it gathers K/V blocks in table order (numerically
+identical to the old view, so batched output stays token-identical to
+the dense oracle) and feeds them directly into the attention einsum; the
+gather is the only materialization left, and it is fused into the
+operand feed where XLA can manage it.
+
+Conventions (shared with the Pallas slot-in):
+
+- ``tables`` is ``[B, max_blocks]`` int32; entry 0 is the engine's
+  reserved **null block** — table padding points there and nothing valid
+  ever reads it.  Write-masked lanes do NOT write the null block: their
+  destination index is forced out of bounds (block ``N``) and the
+  scatter drops it, so masked rows never store anywhere (see
+  :func:`paged_kv_write`).
+- ``lengths`` is ``[B]``: the row's written length BEFORE this chunk.
+  View index ``p`` is absolute position ``p`` (block ``p // bs``, offset
+  ``p % bs``), so validity is purely length-based: positions below
+  ``lengths`` are the row's own (or shared, by the table invariant)
+  content; everything above — recycled-block garbage, a rejected-draft
+  tail, copy-on-write residue — is masked without any scrubbing pass.
+- ``positions`` is ``[B, Lc]`` absolute query positions.  **-1 marks a
+  write-masked slot**: an inactive row, or the padding lanes of a
+  shorter row in a variable-width (speculative) chunk.  Masked queries
+  attend nothing and their K/V writes are dropped before they reach the
+  pool, so a mixed-width batch can never scribble past a short row's
+  block capacity.
+- int8 KV pools carry ``k_scale`` / ``v_scale`` leaves ``[N, bs,
+  kv_heads]``; dequantization happens after the block load, exactly as
+  in the dense path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MASK_VALUE = -1e30
+
+
+def quantize_kv(x):
+    """Symmetric per-vector absmax int8 quantization for KV storage:
+    ``x`` is ``[..., D]`` vectors; returns ``(q int8 [..., D], scale f32
+    [...])``.  The ONE definition shared by the dense cache write
+    (transformer.Attention._kv_cache_write) and the pool write below, so
+    the int8 round trip is bitwise identical across paths."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]),
+        -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def paged_kv_write(leaf, tables, positions, x, *, scale_leaf=None,
+                   quantize: bool = False):
+    """Scatter chunk K/V straight into the pool: ``x`` is ``[B, Lc, H,
+    D]`` vectors for absolute ``positions`` ``[B, Lc]``; each lands at
+    ``(tables[b, p // bs], p % bs)``.  Write-masked slots (position -1)
+    target block index N — out of bounds — and are dropped, never
+    clipped into a live block.  Returns the updated leaf (and scale leaf
+    when quantizing int8)."""
+    N, bs = leaf.shape[0], leaf.shape[1]
+    S = tables.shape[1] * bs
+    pos_c = jnp.clip(positions, 0, S - 1)
+    dstb = jnp.take_along_axis(tables, pos_c // bs, axis=1)  # [B, Lc]
+    dstb = jnp.where(positions >= 0, dstb, N)  # masked -> dropped
+    off = pos_c % bs
+    if quantize:
+        q, scale = quantize_kv(x)
+        leaf = leaf.at[dstb, off].set(q, mode="drop")
+        scale_leaf = scale_leaf.at[dstb, off].set(scale, mode="drop")
+        return leaf, scale_leaf
+    leaf = leaf.at[dstb, off].set(x.astype(leaf.dtype), mode="drop")
+    return leaf, scale_leaf
+
+
+def paged_attention(q, pool_k, pool_v, tables, lengths, positions, *,
+                    k_scale=None, v_scale=None, dtype=None,
+                    mask_value: float = MASK_VALUE):
+    """Attention for one batched decode chunk over the block pool.
+
+    ``q`` is ``[B, Lc, H, D]`` post-rotary queries; ``pool_k`` /
+    ``pool_v`` are ``[N, bs, Hkv, D]`` pool leaves that ALREADY contain
+    this chunk's own K/V (write-then-attend, the dense path's order —
+    int8 pools therefore see the same quantize/dequantize round trip on
+    the chunk's own vectors).  Returns ``[B, Lc, H, D]``.
+
+    Reference XLA implementation of the seam: block-table gather in
+    table order feeding the grouped-query einsum — element-for-element
+    the computation the dense cache path performs on a gathered view, so
+    swapping the paths can never change a sampled token.  A Pallas
+    kernel replacing this body must preserve the masking contract
+    (validity from ``lengths`` plus this chunk's own positions,
+    causality from ``positions``) but is free to never materialize the
+    gather.
+    """
+    B, Lc, H, D = q.shape
+    bs = pool_k.shape[1]
+    kv_heads = pool_k.shape[2]
+    S = tables.shape[1] * bs
+
+    def gather(pool, scale):
+        g = pool[tables]  # [B, MAXB, bs, Hkv, D] — table-order blocks
+        if scale is not None:
+            gs = scale[tables]
+            # dequantize in f32, cast the product once (the dense path's
+            # _kv_cache_read contract — see transformer.py)
+            g = (g.astype(jnp.float32) * gs[..., None]).astype(dtype)
+        return g.reshape(B, S, kv_heads, D)
+
+    keys = gather(pool_k, k_scale)
+    values = gather(pool_v, v_scale)
+    # synthesized slot positions: index p IS position p below the row's
+    # written length; the chunk's own (unmasked) positions become valid
+    # for later in-chunk queries, exactly like the dense pos scatter
+    idx = jnp.arange(S, dtype=jnp.int32)
+    kpos = jnp.where(idx[None, :] < lengths[:, None], idx[None, :], -1)
+    b = jnp.arange(B)[:, None]
+    slot = jnp.where(positions >= 0, positions, S)  # masked -> dropped
+    kpos = kpos.at[b, slot].set(positions, mode="drop")
+    # grouped-query einsum + masked f32 softmax: one definition with the
+    # dense path (transformer.Attention._decode_step) so the two are
+    # bitwise interchangeable in exactness tests
+    rep = H // kv_heads
+    qg = q.reshape(B, Lc, kv_heads, rep, D)
+    scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg, keys).astype(jnp.float32)
+    scores = scores * (D ** -0.5)
+    mask = (kpos >= 0)[:, None, :] & \
+        (kpos[:, None, :] <= positions[:, :, None])  # [B, Lc, S]
+    scores = jnp.where(mask[:, None, None], scores, mask_value)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(values.dtype),
+                     values)
+    return out.reshape(B, Lc, H, D)

@@ -30,21 +30,26 @@ Endpoints (JSON over HTTP/1.1, stdlib-only like the rest of the repo):
   ``Retry-After`` header.
 
 Device work goes through the continuous-batching engine
-(k8s_tpu.models.engine): greedy AND sampled (``temperature > 0``,
-optional ``top_k``) requests share one batched decode step over
-K8S_TPU_SERVE_SLOTS slots with iteration-level join/retire and per-slot
-RNG keys, so a long generation no longer serializes short ones and the
-production sampling mix gets the batching speedup too — fixed-seed
-sampled output is token-identical across lanes.  The engine's paged KV
-cache reuses shared prompt prefixes across requests (radix tree,
-refcounted blocks, copy-on-write at the divergence block;
+(k8s_tpu.models.engine): greedy, sampled (``temperature > 0``, optional
+``top_k``) AND speculative requests share one batched decode step over
+K8S_TPU_SERVE_SLOTS slots with iteration-level join/retire, per-slot
+RNG keys, and per-slot step widths (a speculative slot verifies its
+draft chunk in the same call that advances its 1-token neighbors), so a
+long generation no longer serializes short ones and the production
+sampling/spec mix gets the batching speedup too — fixed-seed output is
+token-identical across lanes for every request type.  The engine's
+paged KV cache reuses shared prompt prefixes across requests (radix
+tree, refcounted blocks, copy-on-write at the divergence block;
 K8S_TPU_SERVE_PREFIX_BLOCKS sizes the retained pool, 0 disables reuse).
 ``K8S_TPU_SERVE_BATCH_SAMPLING=0`` (or ``--batch-sampling 0``) restores
-the exclusive-lane routing for sampled requests; speculative requests
-always run single-flight on the exclusive lane.  ``--slots 0`` disables
-the engine entirely and restores the original one-lock single-flight
-path (the bench_serve baseline).  Prompt-length compiles are bounded by
-the engine's bucket set instead of unbounded per-prompt-length.
+the exclusive-lane routing for sampled requests;
+``K8S_TPU_SERVE_BATCH_SPEC=0`` (or ``--batch-spec 0``) does the same
+for speculative requests (they also ride the exclusive lane on
+sliding-window configs, whose dense cache rows have no write-maskable
+block pool).  ``--slots 0`` disables the engine entirely and restores
+the original one-lock single-flight path (the bench_serve baseline).
+Prompt-length compiles are bounded by the engine's bucket set instead
+of unbounded per-prompt-length.
 """
 
 from __future__ import annotations
@@ -83,17 +88,6 @@ class ParsedRequest:
     eos: Optional[int]
     seed: int
     speculative: int
-
-    @property
-    def batched(self) -> bool:
-        """Eligible for the shared batch step: greedy and sampled
-        requests both ride the slot lanes (per-slot RNG keys); only
-        speculative requests are confined to the exclusive lane (their
-        multi-token verify step needs write-masked variable-width
-        chunks the batched step does not express).  The server's
-        ``batch_sampling`` toggle can still route sampled requests
-        exclusively."""
-        return self.speculative == 0
 
 
 def parse_request(config, req: dict, default_max_new_tokens: int
@@ -186,7 +180,8 @@ class LmServer:
                  config=None, params=None, slots: Optional[int] = None,
                  queue_limit: Optional[int] = None,
                  prefix_blocks: Optional[int] = None,
-                 batch_sampling: Optional[bool] = None, registry=None):
+                 batch_sampling: Optional[bool] = None,
+                 batch_spec: Optional[bool] = None, registry=None):
         from k8s_tpu.models import engine as engine_lib
         from k8s_tpu.util import metrics as metrics_mod
 
@@ -214,6 +209,9 @@ class LmServer:
         if batch_sampling is None:
             batch_sampling = engine_lib.env_batch_sampling()
         self.batch_sampling = bool(batch_sampling)
+        if batch_spec is None:
+            batch_spec = engine_lib.env_batch_spec()
+        self.batch_spec = bool(batch_spec)
         if slots > 0:
             self.engine: Optional[engine_lib.Engine] = engine_lib.Engine(
                 config, params, slots=slots, queue_limit=queue_limit,
@@ -249,11 +247,18 @@ class LmServer:
                 "active": s["active"], "queue_depth": s["queue_depth"],
                 "queue_limit": s["queue_limit"],
                 "batch_sampling": self.batch_sampling,
+                "batch_spec": self.batch_spec,
                 "paged": s["paged"], "block_size": s["block_size"],
                 "pool_blocks": s["pool_blocks"],
                 "blocks_in_use": s["blocks_in_use"],
                 "prefix_hits": s["prefix_hits"],
-                "prefix_tokens_saved": s["prefix_tokens_saved"]}
+                "prefix_tokens_saved": s["prefix_tokens_saved"],
+                # speculative drafting efficiency (ISSUE 9): proposed /
+                # accepted draft tokens and the mean accepted per verify
+                # step, so the fleet plane can rate acceptance per job
+                "spec_proposed": s["spec_proposed"],
+                "spec_accepted": s["spec_accepted"],
+                "spec_mean_accepted": s["spec_mean_accepted"]}
 
     def generate(self, parsed: ParsedRequest) -> dict:
         """One validated generation request (parse_request ran on the
@@ -263,14 +268,26 @@ class LmServer:
         from k8s_tpu.models.dataset import decode_bytes
         from k8s_tpu.models.serving import strip_after_eos
 
-        use_batched = parsed.batched and (
+        # lane routing: sampled requests ride the batch unless the
+        # batch_sampling knob routes them exclusively; speculative
+        # requests ride the batch unless batch_spec routes them
+        # exclusively OR the engine has no paged pool to write-mask
+        # (windowed configs) OR the prompt is too short to draft from
+        # (the exclusive lane rejects that at trace time — same 400,
+        # one lane).  Either routing emits identical tokens at a fixed
+        # seed; only throughput differs.
+        spec_batched = (parsed.speculative > 0 and self.batch_spec
+                        and self.engine is not None and self.engine.paged
+                        and parsed.ids.size >= 2)
+        use_batched = (parsed.speculative == 0 or spec_batched) and (
             parsed.temperature == 0.0 or self.batch_sampling)
         if self.engine is not None and use_batched:
             toks = self.engine.submit(parsed.ids, parsed.max_new_tokens,
                                       eos_id=parsed.eos,
                                       temperature=parsed.temperature,
                                       top_k=parsed.top_k,
-                                      seed=parsed.seed)
+                                      seed=parsed.seed,
+                                      speculative=parsed.speculative)
         elif self.engine is not None:
             toks = self.engine.submit_exclusive(
                 lambda: self._generate_exclusive(parsed))
@@ -472,6 +489,12 @@ def main(argv=None) -> int:
                    help="route temperature>0 requests onto the batched "
                    "slot lanes (default K8S_TPU_SERVE_BATCH_SAMPLING or "
                    "1; 0 = exclusive-lane sampling, the legacy routing)")
+    p.add_argument("--batch-spec", type=int, choices=(0, 1),
+                   default=None,
+                   help="route speculative requests onto the batched "
+                   "slot lanes (variable-width verify chunks; default "
+                   "K8S_TPU_SERVE_BATCH_SPEC or 1; 0 = exclusive-lane "
+                   "speculation, the legacy routing)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     lm = LmServer(args.train_dir, kv_cache=args.kv_cache,
@@ -480,7 +503,9 @@ def main(argv=None) -> int:
                   slots=args.slots, queue_limit=args.queue,
                   prefix_blocks=args.prefix_blocks,
                   batch_sampling=None if args.batch_sampling is None
-                  else bool(args.batch_sampling))
+                  else bool(args.batch_sampling),
+                  batch_spec=None if args.batch_spec is None
+                  else bool(args.batch_spec))
     httpd = serve(lm, args.host, args.port)
     host, port = httpd.server_address[:2]
     log.info("serving %s on http://%s:%d (POST /v1/generate)",

@@ -236,13 +236,12 @@ class Attention(nn.Module):
 
     def _kv_cache_write(self, ck, scale_var, b, slots, x):
         """Store [B, L, H, D] vectors at cache slots, quantizing when the
-        cache is int8 (symmetric absmax per vector)."""
+        cache is int8 (symmetric absmax per vector — models/paged.py's
+        quantize_kv, the one definition shared with the pool write)."""
         if self.config.kv_cache_dtype == "int8":
-            amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-            scale = jnp.maximum(amax, 1e-8) / 127.0
-            q = jnp.clip(
-                jnp.round(x.astype(jnp.float32) / scale[..., None]),
-                -127, 127).astype(jnp.int8)
+            from k8s_tpu.models.paged import quantize_kv
+
+            q, scale = quantize_kv(x)
             ck.value = ck.value.at[b, slots].set(q)
             scale_var.value = scale_var.value.at[b, slots].set(scale)
         else:
@@ -261,6 +260,48 @@ class Attention(nn.Module):
                     * scale_var.value[..., None]).astype(self.config.dtype)
         return ck.value
 
+    def _paged_decode_step(self, q, k, v, positions):
+        """Decode over the serving engine's block-pool cache: new K/V
+        scatter straight into pool blocks through the per-row block
+        table (write-masked slots at position -1 are dropped, never
+        clipped into a live block) and attention runs behind the
+        ``paged_attention`` seam (models/paged.py) — no per-row gathered
+        view is materialized or written back.  The engine provides the
+        cache collection: pool-shaped ``k``/``v`` (+ int8 scales) leaves
+        plus ``table`` [B, max_blocks] and ``len`` [B] (each row's
+        written length before this chunk, the validity bound)."""
+        cfg = self.config
+        if cfg.window_size:
+            raise ValueError(
+                "paged decode needs a full cache: a windowed ring wraps "
+                "positions per row and does not decompose into "
+                "absolute-position pool blocks")
+        from k8s_tpu.models import paged
+
+        def _missing():
+            raise ValueError("paged cache collections are built by the "
+                             "serving engine, never initialized here")
+
+        ck = self.variable("cache", "k", _missing)
+        cv = self.variable("cache", "v", _missing)
+        int8 = cfg.kv_cache_dtype == "int8"
+        cks = self.variable("cache", "k_scale", _missing) if int8 else None
+        cvs = self.variable("cache", "v_scale", _missing) if int8 else None
+        tables = self.variable("cache", "table", _missing).value
+        lengths = self.variable("cache", "len", _missing).value
+        ck.value, ks = paged.paged_kv_write(
+            ck.value, tables, positions, k,
+            scale_leaf=cks.value if int8 else None, quantize=int8)
+        cv.value, vs = paged.paged_kv_write(
+            cv.value, tables, positions, v,
+            scale_leaf=cvs.value if int8 else None, quantize=int8)
+        if int8:
+            cks.value, cvs.value = ks, vs
+        return paged.paged_attention(
+            q, ck.value, cv.value, tables, lengths, positions,
+            k_scale=cks.value if int8 else None,
+            v_scale=cvs.value if int8 else None, dtype=cfg.dtype)
+
     def _decode_step(self, q, k, v, positions):
         """One cached decode call: write this chunk's K/V, attend the cache.
 
@@ -271,8 +312,14 @@ class Attention(nn.Module):
         (kpos <= qpos, which also hides the chunk's own future tokens),
         and the sliding window (qpos - kpos < window) when configured,
         since a chunk-sized ring holds slightly more than one window.
+
+        When the engine hands over a block-pool cache (a ``table``
+        variable is present), the paged path takes over: pool-direct
+        writes plus the ``paged_attention`` seam.
         """
         cfg = self.config
+        if self.has_variable("cache", "table"):
+            return self._paged_decode_step(q, k, v, positions)
         B, Lc = q.shape[0], q.shape[1]
         if cfg.window_size and Lc > max(1, cfg.prefill_chunk):
             raise ValueError(

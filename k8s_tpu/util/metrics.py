@@ -667,4 +667,16 @@ def serving_metrics(registry: Optional[Registry] = None,
             "Live KV-cache pool blocks (slot tables + prefix tree), "
             "sampled after each allocation/release.",
         ),
+        # -- batched speculative decoding (ISSUE 9) ------------------------
+        "spec_proposed": r.counter(
+            "serve_spec_proposed_total",
+            "Draft tokens proposed to speculative verify steps (batched "
+            "slot lanes; draft_k - 1 per verify).",
+        ),
+        "spec_accepted": r.counter(
+            "serve_spec_accepted_total",
+            "Draft tokens accepted by speculative verify steps — "
+            "accepted/proposed is the drafting hit rate the fleet plane "
+            "can rate per job.",
+        ),
     }

@@ -63,6 +63,27 @@ def unbatched(cfg, params, prompt, max_new, eos_id=None,
     return out
 
 
+def unbatched_spec(cfg, params, prompt, max_new, draft_k, eos_id=None,
+                   temperature=0.0, top_k=None, seed=0, pad_id=0):
+    """The speculative oracle: the EXCLUSIVE lane's whole-generation
+    program (make_speculative_generate_fn), truncated the way the
+    engine reports — through the first EOS inclusive, with the
+    shape-static pad tail stripped.  Matching it at a fixed seed is the
+    round-9 batched-spec exactness claim."""
+    fn = decode_lib.cached_speculative_fn(
+        cfg, max_new, draft_k=draft_k, eos_id=eos_id,
+        temperature=temperature,
+        top_k=top_k if temperature > 0 else None, pad_id=pad_id)
+    row = np.asarray(fn(params, np.asarray(prompt, np.int32)[None],
+                        jax.random.PRNGKey(seed)))[0]
+    out = []
+    for t in row:
+        out.append(int(t))
+        if eos_id is not None and t == eos_id:
+            break
+    return out
+
+
 @pytest.fixture(scope="module")
 def model():
     cfg = tiny()
@@ -312,6 +333,232 @@ class TestBatchedSampling:
             engine.submit(prompt_of(3), 2, temperature=-0.5)
         with pytest.raises(ValueError, match="top_k"):
             engine.submit(prompt_of(3), 2, temperature=1.0, top_k=0)
+
+
+class TestBatchedSpec:
+    """Round-9 lane promotion: speculative requests ride the batched
+    slot lanes via write-masked variable-width chunks.  The load-bearing
+    properties: fixed-seed output token-identical to the exclusive
+    lane's whole-generation program, and a spec slot's draft_k-wide
+    verify must never perturb (let alone scribble) a 1-token neighbor's
+    blocks."""
+
+    @pytest.mark.parametrize("temp,top_k,draft_k,seed", [
+        (0.0, None, 4, 0), (0.0, None, 2, 3), (1.0, None, 4, 7),
+        (0.7, 5, 3, 11), (1.3, None, 4, 42),
+    ])
+    def test_fixed_seed_identical_to_exclusive_lane(self, model, engine,
+                                                    temp, top_k, draft_k,
+                                                    seed):
+        cfg, params = model
+        p = prompt_of(9, seed=seed)
+        got = engine.submit(p, 12, temperature=temp, top_k=top_k,
+                            seed=seed, speculative=draft_k)
+        assert got == unbatched_spec(cfg, params, p, 12, draft_k,
+                                     temperature=temp, top_k=top_k,
+                                     seed=seed), \
+            "batched spec diverged from make_speculative_generate_fn"
+
+    def test_greedy_spec_matches_vanilla_greedy(self, model, engine):
+        """Greedy speculative output is argmax-exact with vanilla greedy
+        by construction — chunking must not change a token."""
+        cfg, params = model
+        p = prompt_of(7, seed=2)
+        assert engine.submit(p, 10, speculative=4) == \
+            unbatched(cfg, params, p, 10)
+
+    def test_spec_eos_truncates_like_exclusive(self, model, engine):
+        cfg, params = model
+        p = prompt_of(6, seed=9)
+        full = unbatched_spec(cfg, params, p, 10, 4)
+        eos = full[3]
+        assert engine.submit(p, 10, eos_id=eos, speculative=4) == \
+            unbatched_spec(cfg, params, p, 10, 4, eos_id=eos)
+
+    def test_mixed_width_batch_all_lanes_exact(self, model):
+        """The tentpole integrity claim: spec slots (two different
+        draft_k groups), a greedy slot, and a sampled slot share the
+        batch concurrently; every lane matches its own oracle and pool
+        refcounts stay exact."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=4, queue_limit=64)
+        try:
+            cases = {
+                "spec4": (prompt_of(8, 1), 16,
+                          dict(speculative=4)),
+                "spec2": (prompt_of(9, 3), 14,
+                          dict(speculative=2)),
+                "spec4_sampled": (prompt_of(11, 5), 12,
+                                  dict(speculative=4, temperature=1.1,
+                                       seed=9)),
+                "greedy": (prompt_of(5, 2), 12, dict()),
+                "sampled": (prompt_of(7, 4), 10,
+                            dict(temperature=0.9, seed=5)),
+            }
+            results = {}
+
+            def run(name, p, mn, kw):
+                results[name] = eng.submit(p, mn, **kw)
+
+            threads = [threading.Thread(target=run, args=(n, p, mn, kw))
+                       for n, (p, mn, kw) in cases.items()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for name, (p, mn, kw) in cases.items():
+                if "speculative" in kw:
+                    exp = unbatched_spec(
+                        cfg, params, p, mn, kw["speculative"],
+                        temperature=kw.get("temperature", 0.0),
+                        seed=kw.get("seed", 0))
+                else:
+                    exp = unbatched(
+                        cfg, params, p, mn,
+                        temperature=kw.get("temperature", 0.0),
+                        seed=kw.get("seed", 0))
+                assert results[name] == exp, \
+                    f"lane {name} corrupted by the mixed-width batch"
+            eng.debug_check_blocks()
+            # both draft_k groups actually ran as spec programs
+            ks = {tuple(t) for t in eng.stats()["decode_step_ks"]}
+            assert any(k == 2 and spec for k, _, spec in ks)
+            assert any(k == 4 and spec for k, _, spec in ks)
+        finally:
+            eng.shutdown()
+
+    def test_spec_neighbor_leaves_donor_blocks_bit_identical(self, model):
+        """A spec slot's draft_k-wide verify writes W lanes per step;
+        the write mask must route every lane into the slot's OWN blocks
+        — shared prefix-tree blocks (a neighbor's attached content) stay
+        BIT-identical through the spec churn."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=32, block_size=8,
+                     prefix_blocks=8)
+        try:
+            donor = prompt_of(16, seed=40)  # exactly two 8-token blocks
+            first = eng.submit(donor, 2)  # seeds the tree
+            blocks: list[int] = []
+
+            def walk(node):
+                for c in node.children.values():
+                    blocks.append(c.block)
+                    walk(c)
+
+            walk(eng._tree.root)
+            assert len(blocks) >= 2, "tree should hold both donor blocks"
+            snap = [np.asarray(leaf)[blocks].copy()
+                    for leaf in jax.tree_util.tree_leaves(eng._pool)]
+            # disjoint spec traffic next to the donor's cached blocks
+            for i in range(3):
+                p = prompt_of(9 + i, seed=41 + i)
+                got = eng.submit(p, 10, speculative=4)
+                assert got == unbatched_spec(cfg, params, p, 10, 4)
+            after = [np.asarray(leaf)[blocks]
+                     for leaf in jax.tree_util.tree_leaves(eng._pool)]
+            for a, b in zip(snap, after):
+                np.testing.assert_array_equal(
+                    a, b, err_msg="spec verify scribbled a shared block")
+            # and the donor still attaches + generates identically
+            assert eng.submit(donor, 2) == first
+            eng.debug_check_blocks()
+        finally:
+            eng.shutdown()
+
+    def test_spec_join_mid_greedy_decode(self, model, engine):
+        """A spec request joining while a greedy generation is mid-flight
+        perturbs neither (iteration-level join, write-masked widths)."""
+        cfg, params = model
+        long_p, spec_p = prompt_of(9, seed=1), prompt_of(6, seed=21)
+        out = {}
+
+        def run_long():
+            out["long"] = engine.submit(long_p, 24)
+
+        t = threading.Thread(target=run_long)
+        t.start()
+        deadline = time.time() + 30
+        while engine.stats()["steps"] < 3 and time.time() < deadline:
+            time.sleep(0.002)
+        assert engine.stats()["steps"] >= 3, "long request never stepped"
+        out["spec"] = engine.submit(spec_p, 8, speculative=4)
+        t.join(60)
+        assert out["long"] == unbatched(cfg, params, long_p, 24)
+        assert out["spec"] == unbatched_spec(cfg, params, spec_p, 8, 4)
+
+    def test_acceptance_counters_accumulate(self, model):
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=16)
+        try:
+            # a repetitive prompt gives prompt-lookup drafting a real
+            # shot; counters must move regardless of the hit rate
+            p = np.asarray([5, 9, 5, 9, 5, 9, 5, 9], np.int32)
+            eng.submit(p, 12, speculative=4)
+            st = eng.stats()
+            assert st["spec_steps"] >= 1
+            assert st["spec_proposed"] == 3 * st["spec_steps"]
+            assert 0 <= st["spec_accepted"] <= st["spec_proposed"]
+            assert st["spec_mean_accepted"] == pytest.approx(
+                st["spec_accepted"] / st["spec_steps"], abs=1e-3)
+        finally:
+            eng.shutdown()
+
+    def test_spec_validation(self, model, engine):
+        cfg, _ = model
+        with pytest.raises(ValueError, match="draft_k"):
+            engine.submit(prompt_of(5), 4, speculative=1)
+        with pytest.raises(ValueError, match="prompt_len >= 2"):
+            engine.submit(prompt_of(1), 4, speculative=4)
+        with pytest.raises(ValueError, match="headroom"):
+            # passes the plain capacity bound, fails the spec headroom
+            engine.submit(prompt_of(5), cfg.max_seq_len - 4,
+                          speculative=4)
+
+    def test_windowed_engine_rejects_batched_spec(self):
+        """Dense windowed rows have no write-maskable pool; the engine
+        refuses and the server routes these to the exclusive lane."""
+        cfg = tiny(window_size=8, prefill_chunk=4)
+        eng = Engine(cfg, init_params(cfg), slots=1, queue_limit=8)
+        try:
+            with pytest.raises(ValueError, match="paged"):
+                eng.submit(prompt_of(5), 4, speculative=4)
+        finally:
+            eng.shutdown()
+
+    def test_int8_kv_pool_stays_exact(self):
+        """The paged write path quantizes through the same quantize_kv
+        definition as the dense cache (models/paged.py), so an int8 pool
+        stays token-identical to the int8 exclusive lane — greedy and
+        speculative."""
+        cfg = tiny(kv_cache_dtype="int8")
+        params = init_params(cfg)
+        eng = Engine(cfg, params, slots=2, queue_limit=16)
+        try:
+            p = prompt_of(9, seed=5)
+            assert eng.submit(p, 6) == unbatched(cfg, params, p, 6)
+            assert eng.submit(p, 8, speculative=4) == \
+                unbatched_spec(cfg, params, p, 8, 4)
+            eng.debug_check_blocks()
+        finally:
+            eng.shutdown()
+
+    def test_compile_count_bounded_with_spec(self, model):
+        """Spec traffic adds one program per (draft_k, sampling) pair
+        used — never per prompt/draft content."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=32)
+        try:
+            for i in range(6):
+                eng.submit(prompt_of(5 + i, seed=i), 6, speculative=4)
+            for i in range(3):
+                eng.submit(prompt_of(4 + i, seed=9 + i), 6,
+                           temperature=0.8, seed=i, speculative=4)
+            st = eng.stats()
+            spec_ks = [t for t in st["decode_step_ks"] if t[2]]
+            assert len(spec_ks) <= 2  # (4, greedy) and (4, sampling)
+            assert st["decode_programs"] <= 2 * MAX_STEP_TOKENS + 2
+        finally:
+            eng.shutdown()
 
 
 class TestPrefixReuse:
@@ -665,6 +912,17 @@ class TestEnvKnobs:
             assert env_batch_sampling() is False
         monkeypatch.setenv("K8S_TPU_SERVE_BATCH_SAMPLING", "1")
         assert env_batch_sampling() is True
+
+    def test_batch_spec_env(self, monkeypatch):
+        from k8s_tpu.models.engine import env_batch_spec
+
+        monkeypatch.delenv("K8S_TPU_SERVE_BATCH_SPEC", raising=False)
+        assert env_batch_spec() is True  # default on
+        for off in ("0", "false", "no", "OFF"):
+            monkeypatch.setenv("K8S_TPU_SERVE_BATCH_SPEC", off)
+            assert env_batch_spec() is False
+        monkeypatch.setenv("K8S_TPU_SERVE_BATCH_SPEC", "1")
+        assert env_batch_spec() is True
 
     def test_block_size_must_be_a_bucket(self, model):
         cfg, params = model

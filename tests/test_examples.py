@@ -85,12 +85,14 @@ class TestExampleManifests:
         assert "k8s_tpu.models.server" in c["command"]
         assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
         assert any(p.get("containerPort") == 8000 for p in c["ports"])
-        # all four engine knobs surfaced: slots/queue (ISSUE 5) plus
-        # prefix-reuse retention and sampling-lane routing (ISSUE 6)
+        # all five engine knobs surfaced: slots/queue (ISSUE 5), the
+        # prefix-reuse retention and sampling-lane routing (ISSUE 6),
+        # and the speculative-lane routing (ISSUE 9)
         env = {e["name"] for e in c["env"]}
         assert {"K8S_TPU_SERVE_SLOTS", "K8S_TPU_SERVE_QUEUE",
                 "K8S_TPU_SERVE_PREFIX_BLOCKS",
-                "K8S_TPU_SERVE_BATCH_SAMPLING"} <= env
+                "K8S_TPU_SERVE_BATCH_SAMPLING",
+                "K8S_TPU_SERVE_BATCH_SPEC"} <= env
 
     def test_tpu_smoke_yaml(self):
         job = load_one("tpu_smoke.yaml")

@@ -436,10 +436,12 @@ class TestGenjob:
 
     def test_serve_job_surfaces_engine_knobs(self):
         """--serve jobs carry the serving engine's env knobs, including
-        the round-6 prefix-reuse pool size and sampling-lane routing."""
+        the round-6 prefix-reuse pool size and the sampling- and
+        speculative-lane routing."""
         [job] = genjob.generate(1, serve=True, timestamp=7, serve_slots=4,
                                 serve_queue=32, serve_prefix_blocks=16,
-                                serve_batch_sampling=False)
+                                serve_batch_sampling=False,
+                                serve_batch_spec=False)
         c = job["spec"]["tfReplicaSpecs"]["Worker"][
             "template"]["spec"]["containers"][0]
         env = {e["name"]: e["value"] for e in c["env"]}
@@ -447,6 +449,7 @@ class TestGenjob:
         assert env["K8S_TPU_SERVE_QUEUE"] == "32"
         assert env["K8S_TPU_SERVE_PREFIX_BLOCKS"] == "16"
         assert env["K8S_TPU_SERVE_BATCH_SAMPLING"] == "0"
+        assert env["K8S_TPU_SERVE_BATCH_SPEC"] == "0"
         assert "k8s_tpu.models.server" in c["command"]
         assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
         # schedulable on a real cluster: TPU/memory limits and the
@@ -468,6 +471,7 @@ class TestGenjob:
         env = {e["name"]: e["value"] for e in c["env"]}
         assert "K8S_TPU_SERVE_PREFIX_BLOCKS" not in env
         assert env["K8S_TPU_SERVE_BATCH_SAMPLING"] == "1"
+        assert env["K8S_TPU_SERVE_BATCH_SPEC"] == "1"  # default on
 
     def test_unique_names_and_scheduler(self):
         jobs = genjob.generate(3, scheduler_name="kube-batch", timestamp=9)

@@ -104,17 +104,18 @@ class TestStructured400s:
 
     def test_parse_runs_without_device_state(self, model):
         """parse_request needs only the config — proof the validation
-        path cannot touch the engine, the cache, or any lock."""
+        path cannot touch the engine, the cache, or any lock.  Lane
+        routing is the SERVER's decision (batch_sampling / batch_spec
+        knobs + engine shape), not the parse result's: every request
+        type is batch-eligible since round 9."""
         cfg, _ = model
         parsed = parse_request(cfg, {"tokens": [1, 2, 3]}, 16)
-        assert parsed.batched
         assert list(parsed.ids) == [1, 2, 3]
-        # sampled requests are batch-eligible since round 6 (per-slot
-        # rng keys); only speculative stays exclusive-lane-only
+        assert parsed.speculative == 0
         parsed = parse_request(cfg, {"text": "hi", "temperature": 0.7}, 16)
-        assert parsed.batched
+        assert parsed.temperature == 0.7
         parsed = parse_request(cfg, {"text": "hi", "speculative": 4}, 16)
-        assert not parsed.batched
+        assert parsed.speculative == 4
 
 
 class TestBackpressure:
@@ -340,6 +341,77 @@ class TestBatchedSamplingOverHTTP:
         _post(u0, {"tokens": [4, 5, 6], "max_new_tokens": 4,
                    "temperature": 0.9, "seed": 1})
         assert _count(reg0, "serve_sampled_batched_total") == before0
+
+
+class TestBatchedSpecOverHTTP:
+    """Round-9 lane promotion at the HTTP level: a fixed-seed
+    speculative request must emit IDENTICAL tokens whether it rides the
+    batched variable-width lanes or the exclusive single-flight lane —
+    flipping --batch-spec can never change model output, only
+    throughput."""
+
+    @pytest.fixture(scope="class")
+    def spec_exclusive_server(self, model):
+        cfg, params = model
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      batch_spec=False, registry=Registry())
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        yield url, lm
+        httpd.shutdown()
+        lm.close()
+
+    @pytest.mark.parametrize("payload", [
+        {"tokens": [5, 6, 7, 5, 6, 7], "max_new_tokens": 10,
+         "speculative": 4},
+        {"tokens": list(range(3, 20)), "max_new_tokens": 8,
+         "speculative": 3, "temperature": 0.7, "top_k": 5, "seed": 3},
+        {"tokens": [9, 4] * 6, "max_new_tokens": 12, "speculative": 4,
+         "temperature": 1.1, "seed": 42},
+    ])
+    def test_fixed_seed_spec_identical_across_lanes(
+            self, server, spec_exclusive_server, payload):
+        url, lm, _ = server
+        u0, lm0 = spec_exclusive_server
+        assert lm.batch_spec and not lm0.batch_spec
+        a = _post(url, payload)
+        b = _post(u0, payload)
+        assert a == b, f"spec lanes diverged for {payload}"
+
+    def test_spec_counters_and_serving_info(self, model):
+        cfg, params = model
+        registry = Registry()
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      registry=registry)
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        try:
+            _post(url, {"tokens": [3, 8, 3, 8, 3, 8],
+                        "max_new_tokens": 10, "speculative": 4})
+            proposed = _count(registry, "serve_spec_proposed_total")
+            accepted = _count(registry, "serve_spec_accepted_total")
+            assert proposed >= 3  # >= one verify step of draft_k - 1
+            assert 0 <= accepted <= proposed
+            status, body = _get(url, "/healthz")
+            assert status == 200
+            serving = json.loads(body)["serving"]
+            assert serving["batch_spec"] is True
+            assert serving["spec_proposed"] == proposed
+            assert serving["spec_accepted"] == accepted
+            assert "spec_mean_accepted" in serving
+        finally:
+            httpd.shutdown()
+            lm.close()
+
+    def test_spec_exclusive_routing_never_bumps_counters(
+            self, spec_exclusive_server):
+        u0, lm0 = spec_exclusive_server
+        reg0 = lm0.registry
+        before = _count(reg0, "serve_spec_proposed_total")
+        _post(u0, {"tokens": [2, 4, 2, 4], "max_new_tokens": 6,
+                   "speculative": 4})
+        assert _count(reg0, "serve_spec_proposed_total") == before
+        assert lm0.serving_info()["batch_spec"] is False
 
 
 class TestPrefixReuseOverHTTP:
