@@ -1,0 +1,26 @@
+"""Concurrency auditor for the control plane (ISSUE 10).
+
+Two halves, both stdlib-only:
+
+- :mod:`k8s_tpu.analysis.static` — an AST pass over the whole ``k8s_tpu``
+  tree that builds an interprocedural lock acquisition-order graph per
+  module (failing on cycles with witness paths), enforces guarded-by
+  discipline on fields written under a lock, and flags blocking calls
+  (sleep/join/Future.result/apiserver client verbs/...) made while a lock
+  is held.  Wired into the gating ``lint`` tier by
+  :mod:`k8s_tpu.harness.py_checks`.
+- :mod:`k8s_tpu.analysis.checkedlock` — a drop-in
+  Lock/RLock/Condition factory that, under ``K8S_TPU_LOCK_CHECK=1``,
+  records the process-global acquisition DAG live, raises on cycle
+  formation with both threads' stacks, runs a held-too-long watchdog,
+  and emits a ``lock_audit.json`` artifact.  Zero overhead when off
+  (the factories return raw ``threading`` primitives).
+
+See docs/static_analysis.md for annotation and allowlist syntax.
+
+No eager submodule imports here: ~25 hot-path modules import
+``checkedlock`` at startup, and they must not drag the whole static
+analyzer (CI-only machinery) into every operator/bench process —
+consumers import ``k8s_tpu.analysis.static`` / ``.checkedlock``
+directly.
+"""

@@ -1,0 +1,50 @@
+"""CLI: run the static concurrency analyzer over a tree.
+
+    python -m k8s_tpu.analysis [--root k8s_tpu] [--allowlist ...] [--json out]
+
+Exit 0 when clean (after allowlist), 1 when findings remain.  The lint CI
+tier invokes the same entry through :mod:`k8s_tpu.harness.py_checks`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from k8s_tpu.analysis import static
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_ALLOWLIST = os.path.join(
+    REPO_ROOT, "k8s_tpu", "analysis", "allowlist.txt")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--root", default=os.path.join(REPO_ROOT, "k8s_tpu"))
+    p.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                   help="audited-exemption file; 'none' disables")
+    p.add_argument("--json", default=None,
+                   help="write the full report JSON here")
+    args = p.parse_args(argv)
+    allowlist = None if args.allowlist == "none" else (
+        args.allowlist if os.path.exists(args.allowlist) else None)
+    report = static.analyze_tree(args.root, allowlist_path=allowlist)
+    if args.json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json)),
+                    exist_ok=True)
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report.as_dict(), f, indent=1, sort_keys=True)
+    for f in report.findings:
+        print(str(f), file=sys.stderr)
+    print(f"[analysis] {report.module_count} modules, {report.lock_count} "
+          f"locks, {len(report.edges)} order edges, "
+          f"{len(report.findings)} findings, "
+          f"{len(report.suppressed)} suppressed")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,89 @@
+"""Shared AST-walker utilities.
+
+Used by the concurrency analyzer (:mod:`k8s_tpu.analysis.static`) and by
+the in-tree linter (:mod:`k8s_tpu.harness.pylint_lite`) — one copy of the
+noqa parser, the scope-bounded walker, and the dotted-name resolver
+instead of a private reimplementation per tool.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+#: directories never descended into when walking a source tree
+EXCLUDE_DIRS = {".git", "__pycache__", ".eggs", "build", "vendor",
+                "node_modules"}
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+
+
+def iter_py_files(src_dir: str):
+    """Yield every ``.py`` path under ``src_dir``, sorted per directory,
+    skipping :data:`EXCLUDE_DIRS`."""
+    for root, dirs, files in os.walk(src_dir):
+        dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+
+
+def noqa_lines(source: str) -> dict[int, set[str] | None]:
+    """Parse ``# noqa`` comments: line -> None (blanket) or a set of
+    lower-cased codes (``# noqa: CODE1, CODE2`` — trailing prose after a
+    code token is tolerated)."""
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        if "# noqa" not in line:
+            continue
+        _, _, tail = line.partition("# noqa")
+        tail = tail.strip()
+        if tail.startswith(":"):
+            codes = set()
+            for chunk in tail[1:].split(","):
+                tok = chunk.strip().split()
+                if not tok:
+                    continue
+                codes.add(tok[0].lower())
+            out[i] = codes
+        else:
+            out[i] = None
+    return out
+
+
+def own_scope_nodes(fn: ast.AST):
+    """Walk a function's own body, stopping at nested function / class /
+    lambda scopes (their bodies belong to a different runtime context)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None (calls,
+    subscripts, and literals break the chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def line_comments(source: str, marker: str) -> dict[int, str]:
+    """Map line number -> trailing text for lines carrying a
+    ``# <marker>:`` comment (e.g. ``# guarded-by: _lock`` or
+    ``# lock-ok: reason``).  The text after the colon is stripped."""
+    tag = f"# {marker}:"
+    out: dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        if tag in line:
+            _, _, tail = line.partition(tag)
+            out[i] = tail.strip()
+    return out

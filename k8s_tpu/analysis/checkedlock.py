@@ -1,0 +1,578 @@
+"""Runtime deadlock-and-race detector: checked Lock/RLock/Condition.
+
+Drop-in factories for the control plane's hot-path locks::
+
+    self._lock = checkedlock.make_lock("engine.slots")
+    self._cond = checkedlock.make_condition("workqueue.cond")
+
+With ``K8S_TPU_LOCK_CHECK`` unset (the default) the factories return raw
+``threading`` primitives — zero instrumentation, zero overhead.  With
+``K8S_TPU_LOCK_CHECK=1`` every acquisition updates a process-global
+acquisition DAG (per lock *instance*, so two queues of the same class are
+two nodes and an ABBA interleave across instances is caught):
+
+- acquiring B while holding A adds the edge A->B; if a path B->...->A
+  already exists the acquire RAISES :class:`LockOrderViolation` carrying
+  this thread's stack AND the stack captured when the reverse path's
+  first edge was formed — the two halves of the potential deadlock.
+- re-acquiring a non-reentrant checked Lock on the same thread raises
+  immediately (the undetectable-until-production self-deadlock).
+- a daemon watchdog scans held locks and records (never raises) a
+  violation with the holder's live stack once a lock has been held
+  longer than ``K8S_TPU_LOCK_MAX_HOLD_S`` (default 30s).
+- contention (acquire had to block) and max-hold-time are counted per
+  lock name and exported by :func:`audit_snapshot` /
+  :func:`write_audit` — the ``lock_audit.json`` artifact the bench tier
+  emits.
+
+The wrappers interoperate with ``threading.Condition`` (they provide
+``_release_save`` / ``_acquire_restore`` / ``_is_owned``), so
+``make_condition`` is a Condition over a checked RLock and a
+``cond.wait()`` correctly *removes* the lock from the thread's held set
+for the duration of the wait.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+import time
+import traceback
+import weakref
+
+DEFAULT_MAX_HOLD_S = 30.0
+WATCHDOG_HITS_MAX = 256     # recorded held-too-long violations kept
+
+_registry_lock = threading.Lock()   # leaf lock: guards the graph/stats only
+_edges: dict[int, dict[int, dict]] = {}     # id(a) -> id(b) -> witness
+_nodes: dict[int, str] = {}                 # id -> name (live checked locks)
+_stats: dict[str, dict] = {}                # name -> counters
+_watchdog_hits: list[dict] = []
+_cycle_hits = 0
+_watchdog_thread: threading.Thread | None = None
+_watchdog_hook = None       # test seam: called with each violation dict
+_tls = threading.local()    # .held: list of [lock, depth, t_acquire, tracked]
+
+
+def _registry_acquire(blocking: bool = True) -> bool:
+    """Take the process-global registry lock for bookkeeping; False means
+    the caller must skip (best-effort) instead.
+
+    Signal-safety: a SIGTERM handler (signals.py runs shutdown callbacks
+    on the interrupted thread) may call into checked locks while THIS
+    thread's interrupted frame is inside a registry critical section —
+    blocking on the non-reentrant registry lock there would self-deadlock
+    the process for the whole grace window.  A thread-local in-registry
+    flag set for the duration of every critical section (including while
+    blocked acquiring it) lets the re-entered frame detect that and skip
+    bookkeeping; order checking and stats are best-effort in handler
+    context, the inner lock semantics are not."""
+    if getattr(_tls, "in_registry", False):
+        return False
+    _tls.in_registry = True
+    if _registry_lock.acquire(blocking):
+        return True
+    _tls.in_registry = False
+    return False
+
+
+def _registry_release() -> None:
+    _registry_lock.release()
+    _tls.in_registry = False
+
+
+class LockOrderViolation(RuntimeError):
+    """Acquisition would close a cycle in the lock-order DAG."""
+
+
+def _stat_locked(name: str) -> dict:
+    """The per-name counter row, (re)seeded on demand — reset() may have
+    dropped it while the lock instance stayed alive, and a KeyError in
+    release() would leak the inner lock locked forever."""
+    return _stats.setdefault(name, {
+        "acquisitions": 0, "contention": 0, "max_hold_s": 0.0,
+        "total_hold_s": 0.0, "live": 0})
+
+
+def enabled() -> bool:
+    return os.environ.get("K8S_TPU_LOCK_CHECK") == "1"
+
+
+def max_hold_s() -> float:
+    try:
+        return float(os.environ.get("K8S_TPU_LOCK_MAX_HOLD_S", ""))
+    except ValueError:
+        return DEFAULT_MAX_HOLD_S
+
+
+# --- factories ---------------------------------------------------------------
+
+
+def make_lock(name: str | None = None):
+    """A ``threading.Lock`` (checking off) or a checked non-reentrant
+    lock (checking on)."""
+    if not enabled():
+        return threading.Lock()
+    return _CheckedLock(threading.Lock(), name or _callsite(), False)
+
+
+def make_rlock(name: str | None = None):
+    if not enabled():
+        return threading.RLock()
+    return _CheckedLock(threading.RLock(), name or _callsite(), True)
+
+
+def make_condition(name: str | None = None):
+    """A Condition whose underlying lock participates in checking."""
+    if not enabled():
+        return threading.Condition()
+    return threading.Condition(
+        _CheckedLock(threading.RLock(), name or _callsite(), True))
+
+
+def _callsite() -> str:
+    f = sys._getframe(2)
+    mod = f.f_globals.get("__name__", "?")
+    return f"{mod}:{f.f_lineno}"
+
+
+# --- the wrapper -------------------------------------------------------------
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+class _CheckedLock:
+    __slots__ = ("_inner", "name", "reentrant", "__weakref__")
+
+    def __init__(self, inner, name: str, reentrant: bool):
+        self._inner = inner
+        self.name = name
+        self.reentrant = reentrant
+        if _registry_acquire():
+            try:
+                _drain_pending_locked()
+                _nodes[id(self)] = name
+                _stat_locked(name)["live"] += 1
+            finally:
+                _registry_release()
+        else:
+            # created from a frame that re-entered the registry (signal
+            # handler): queue the registration like a deferred forget
+            _pending_ops.append(("reg", id(self), name))
+        # prune this instance's node/edges when it is collected so the
+        # per-instance graph stays bounded under object churn
+        weakref.finalize(self, _forget_node, id(self), name)
+        _ensure_watchdog()
+
+    # -- core protocol
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        for entry in held:
+            if entry[0] is self:
+                if self.reentrant:
+                    ok = self._inner.acquire(blocking, timeout)
+                    if ok:
+                        entry[1] += 1
+                    return ok
+                if not blocking:
+                    # raw-Lock contract: trylock on a held lock returns
+                    # False, whoever holds it — checkpoint._save_now's
+                    # SIGTERM handler relies on exactly that to SKIP the
+                    # final save when it interrupted the interval save
+                    # mid-hold; raising here would throw into the
+                    # interrupted frame instead
+                    return False
+                raise LockOrderViolation(
+                    f"self-deadlock: thread {threading.current_thread().name}"
+                    f" re-acquiring non-reentrant lock {self.name!r}\n"
+                    + "".join(traceback.format_stack()))
+        if not blocking:
+            # signal-safe path: a trylock (checkpoint _save_now's SIGTERM
+            # handler) must never wait on the registry lock — the
+            # interrupted thread may be inside a bookkeeping critical
+            # section, and blocking here would self-deadlock the process
+            # for the whole grace window.  Order checking only matters for
+            # waits, so it is skipped; stats are best-effort.
+            if not self._inner.acquire(False):
+                if _registry_acquire(False):
+                    try:
+                        _stat_locked(self.name)["contention"] += 1
+                    finally:
+                        _registry_release()
+                return False
+            t0 = time.monotonic()
+            tracked = _registry_acquire(False)
+            if tracked:
+                try:
+                    me = threading.current_thread()
+                    _stat_locked(self.name)["acquisitions"] += 1
+                    _live_holds[(me.ident, id(self))] = (self.name, me.name,
+                                                         t0)
+                finally:
+                    _registry_release()
+            held.append([self, 1, t0, tracked])
+            return True
+        self._check_order(held)
+        if self._inner.acquire(False):
+            got = True
+        else:
+            if _registry_acquire():
+                try:
+                    _stat_locked(self.name)["contention"] += 1
+                finally:
+                    _registry_release()
+            got = self._inner.acquire(True, timeout)
+        if not got:
+            return False
+        t0 = time.monotonic()
+        me = threading.current_thread()
+        tracked = _registry_acquire()
+        if tracked:
+            try:
+                _stat_locked(self.name)["acquisitions"] += 1
+                _live_holds[(me.ident, id(self))] = (self.name, me.name, t0)
+            finally:
+                _registry_release()
+        held.append([self, 1, t0, tracked])
+        return True
+
+    def _end_hold(self, entry: list) -> None:
+        """Hold-time stat + live-hold unwind shared by release() and
+        _release_save().  An untracked (signal-handler) hold has no
+        registry state to unwind; a re-entered registry skips best-effort
+        (worst case: one stale _live_holds row until this thread's next
+        tracked release, which the watchdog may RECORD — never raise —
+        as a long hold)."""
+        dt = time.monotonic() - entry[2]
+        if entry[3] and _registry_acquire():
+            try:
+                st = _stat_locked(self.name)
+                st["total_hold_s"] += dt
+                if dt > st["max_hold_s"]:
+                    st["max_hold_s"] = dt
+                _live_holds.pop((threading.get_ident(), id(self)), None)
+            finally:
+                _registry_release()
+
+    def release(self):
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                held[i][1] -= 1
+                if held[i][1] == 0:
+                    entry = held[i]
+                    del held[i]
+                    self._end_hold(entry)
+                break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else self._inner._is_owned()
+
+    # -- Condition interop: wait() must drop the lock from the held set
+
+    def _release_save(self):
+        held = _held()
+        depth = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                depth = held[i][1]
+                entry = held[i]
+                del held[i]
+                self._end_hold(entry)
+                break
+        if hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), depth)
+        self._inner.release()
+        return (None, depth)
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(inner_state)
+        else:
+            self._inner.acquire()
+        t0 = time.monotonic()
+        me = threading.current_thread()
+        tracked = _registry_acquire()
+        if tracked:
+            try:
+                _live_holds[(me.ident, id(self))] = (self.name, me.name, t0)
+            finally:
+                _registry_release()
+        _held().append([self, depth or 1, t0, tracked])
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return any(e[0] is self for e in _held())
+
+    # -- ordering
+
+    def _check_order(self, held: list):
+        """Add edges held->self; raise if any would close a cycle.
+
+        Stack formatting (traceback.format_stack reads source files
+        through linecache — disk I/O) happens OUTSIDE the registry
+        critical section: witnesses for new edges are inserted with a
+        placeholder and filled in after release (a concurrent cycle
+        report racing the fill-in sees the placeholder at worst), so no
+        thread ever serializes the process-wide lock bookkeeping behind
+        file reads."""
+        global _cycle_hits
+        if not held:
+            return
+        me = id(self)
+        cycle = None
+        new_witnesses: list[dict] = []
+        if not _registry_acquire():
+            return  # re-entered from a signal handler: best-effort skip
+        try:
+            # cycle test first: does a path me -> ... -> any held exist?
+            held_ids = {id(e[0]) for e in held}
+            path = _find_path(me, held_ids)
+            if path is not None:
+                _cycle_hits += 1
+                first_edge = _edges[path[0]][path[1]]
+                cycle = ([_nodes.get(n, "?") for n in path],
+                         [_nodes.get(i, "?") for i in held_ids],
+                         dict(first_edge))
+            else:
+                for entry in held:
+                    a = id(entry[0])
+                    tgt = _edges.setdefault(a, {})
+                    if me not in tgt:
+                        w = tgt[me] = {
+                            "from_name": entry[0].name, "to_name": self.name,
+                            "thread": threading.current_thread().name,
+                            "stack": "<stack pending>", "count": 1}
+                        new_witnesses.append(w)
+                    else:
+                        tgt[me]["count"] += 1
+        finally:
+            _registry_release()
+        if cycle is not None:
+            names, held_names, other = cycle
+            raise LockOrderViolation(
+                "lock-order cycle: acquiring "
+                f"{self.name!r} while holding "
+                f"{held_names} would close "
+                f"the cycle {' -> '.join(names + [self.name])}\n"
+                "--- this thread "
+                f"({threading.current_thread().name}) ---\n"
+                + "".join(traceback.format_stack())
+                + f"--- reverse edge {other['from_name']} -> "
+                f"{other['to_name']} first formed by thread "
+                f"{other['thread']} ---\n" + other["stack"])
+        if new_witnesses:
+            # one format per batch of new edges; GIL-atomic store
+            stack_text = "".join(traceback.format_stack())
+            for w in new_witnesses:
+                w["stack"] = stack_text
+
+
+def _find_path(src: int, targets: set[int]) -> list[int] | None:
+    """DFS in the edge graph from src to any of targets; returns the node
+    path or None.  Caller holds the registry lock."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _edges.get(node, ()):
+            if nxt in targets:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+# finalize callbacks run synchronously wherever GC fires — possibly on a
+# thread that is INSIDE a _registry_lock critical section (allocation under
+# the lock can trigger a cyclic-GC pass that collects a cycle-trapped
+# checked lock).  Blocking on the non-reentrant registry lock there would
+# self-deadlock the detector, so forgets (and signal-context
+# registrations) are queued and drained IN ORDER by whoever can take the
+# lock without waiting — FIFO matters: id() of a collected lock can be
+# reused, so its forget must land before the successor's registration.
+_pending_ops: collections.deque[tuple[str, int, str]] = collections.deque()
+
+
+def _forget_node(node_id: int, name: str):
+    _pending_ops.append(("forget", node_id, name))  # deque.append: GIL-atomic
+    _drain_pending()
+
+
+def _drain_pending():
+    if not _registry_acquire(False):
+        return  # holder (or the next forget/audit) drains the queue
+    try:
+        _drain_pending_locked()
+    finally:
+        _registry_release()
+
+
+def _drain_pending_locked():
+    while _pending_ops:
+        op, node_id, name = _pending_ops.popleft()
+        if op == "reg":
+            _nodes[node_id] = name
+            _stat_locked(name)["live"] += 1
+            continue
+        _nodes.pop(node_id, None)
+        _edges.pop(node_id, None)
+        for tgt in _edges.values():
+            tgt.pop(node_id, None)
+        st = _stats.get(name)
+        if st is not None:
+            st["live"] -= 1
+
+
+# --- watchdog ----------------------------------------------------------------
+
+
+def _ensure_watchdog():
+    global _watchdog_thread
+    t = None
+    if not _registry_acquire():
+        return  # signal-context factory call: the next one starts it
+    try:
+        if _watchdog_thread is None or not _watchdog_thread.is_alive():
+            t = threading.Thread(target=_watchdog_loop, daemon=True,
+                                 name="checkedlock-watchdog")
+            _watchdog_thread = t
+    finally:
+        _registry_release()
+    if t is not None:
+        t.start()
+
+
+def _watchdog_loop():
+    reported: set[tuple[int, float]] = set()
+    while True:
+        threshold = max_hold_s()
+        time.sleep(min(max(threshold / 4.0, 0.01), 1.0))
+        now = time.monotonic()
+        frames = None
+        with _registry_lock:
+            snapshots = list(_long_holds(now, threshold))
+            live_keys = {(lock_id, t0)
+                         for (_, lock_id), (_, _, t0) in _live_holds.items()}
+        # a (lock, t_acquire) key can't recur once the hold ends, so
+        # pruning against the live set both bounds `reported` in a
+        # long-lived soak and keeps the dedup exact
+        reported &= live_keys
+        for lock_name, tid, tname, held_s, key in snapshots:
+            if key in reported:
+                continue
+            reported.add(key)
+            if frames is None:
+                frames = sys._current_frames()
+            stack = "".join(traceback.format_stack(frames[tid])) \
+                if tid in frames else "<thread gone>"
+            hit = {"lock": lock_name, "thread": tname, "held_s": held_s,
+                   "stack": stack}
+            with _registry_lock:
+                _watchdog_hits.append(hit)
+                if len(_watchdog_hits) > WATCHDOG_HITS_MAX:
+                    # keep the most recent hits; each retains a multi-KB
+                    # stack, and a recurring long hold in a soak run must
+                    # not grow the process without bound
+                    del _watchdog_hits[0]
+            hook = _watchdog_hook
+            if hook is not None:
+                try:
+                    hook(hit)
+                except Exception:
+                    pass
+            print(f"[checkedlock] WATCHDOG: {lock_name!r} held "
+                  f"{held_s:.2f}s by {tname}\n{stack}", file=sys.stderr)
+
+
+# the watchdog needs (thread, lock, t_acquire) for every live hold; the
+# held stacks are thread-local, so acquire() also mirrors them here
+_live_holds: dict[tuple[int, int], tuple[str, str, float]] = {}
+
+
+def _long_holds(now: float, threshold: float):
+    for (tid, lock_id), (lock_name, tname, t0) in list(_live_holds.items()):
+        held_s = now - t0
+        if held_s > threshold:
+            yield lock_name, tid, tname, held_s, (lock_id, t0)
+
+
+# --- audit -------------------------------------------------------------------
+
+
+def audit_snapshot() -> dict:
+    """The ``lock_audit.json`` payload: per-name stats, the acquisition
+    graph aggregated by name, and recorded violations."""
+    if not _registry_acquire():
+        # re-entered from a handler frame that holds the registry
+        return {"enabled": enabled(), "reentered": True}
+    try:
+        _drain_pending_locked()
+        by_name: dict[tuple[str, str], int] = {}
+        for a, targets in _edges.items():
+            for b, w in targets.items():
+                key = (w["from_name"], w["to_name"])
+                by_name[key] = by_name.get(key, 0) + w["count"]
+        return {
+            "enabled": enabled(),
+            "locks": {name: {k: (round(v, 6) if isinstance(v, float) else v)
+                             for k, v in st.items()}
+                      for name, st in sorted(_stats.items())},
+            "edges": [{"from": a, "to": b, "count": n}
+                      for (a, b), n in sorted(by_name.items())],
+            "watchdog_violations": [
+                {k: (round(v, 3) if isinstance(v, float) else v)
+                 for k, v in hit.items() if k != "stack"}
+                for hit in _watchdog_hits],
+            "cycle_violations": _cycle_hits,
+        }
+    finally:
+        _registry_release()
+
+
+def write_audit(path: str) -> dict:
+    import json
+
+    snap = audit_snapshot()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+    return snap
+
+
+def reset() -> None:
+    """Test seam: drop the global graph, stats, and violation records."""
+    global _cycle_hits
+    if not _registry_acquire():
+        return  # signal-context re-entry: nothing sane to reset here
+    try:
+        _pending_ops.clear()
+        _edges.clear()
+        _nodes.clear()
+        _stats.clear()
+        _watchdog_hits.clear()
+        _live_holds.clear()
+        _cycle_hits = 0
+    finally:
+        _registry_release()

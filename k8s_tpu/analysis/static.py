@@ -1,0 +1,765 @@
+"""Static concurrency analysis: lock-order, guarded-by, blocking-under-lock.
+
+RacerD-style lock-consistency checking scoped to this repo's idioms
+(``with self._lock:`` regions, ``_locked``-suffixed helpers, Conditions,
+module-level locks).  Three passes over every module of the tree:
+
+- **lock-order**: every acquisition site feeds an interprocedural
+  acquisition-order graph (per class/module lock identities); a cycle is
+  a potential deadlock and fails the build with the witness site of every
+  edge on the cycle.
+- **guarded-by**: a field written under one of its class's locks in at
+  least one non-``__init__`` method (or annotated ``# guarded-by: _lock``
+  on its ``__init__`` assignment) is *guarded*; any read/write/mutation of
+  it outside a region holding one of its guard locks is a violation unless
+  annotated ``# unguarded-ok: reason`` or allowlisted.
+- **blocking-under-lock**: sleep / Thread.join / Future.result /
+  Event.wait / urlopen / subprocess / apiserver client verbs reached
+  (directly or through same-module calls) while a lock is held.  Waiting
+  on the *sole held* Condition is exempt — ``wait()`` releases it.
+
+Interprocedural approximation: underscore-named methods/functions inherit
+the intersection of locks held at their intra-class (intra-module) call
+sites as an entry context — this is what makes the ``_admit_locked``
+helper idiom analyzable without annotations.  Public names get an empty
+entry context (any caller may call them unlocked).
+
+Allowlist file (one audited survivor per line, reason mandatory)::
+
+    <check> <repo-relative-file> <qualifier> -- <reason>
+
+``qualifier`` is ``Class.field`` for guarded-by, ``Qualname:desc`` for
+blocking-under-lock, and ``lockA->lockB`` for lock-order edges.  Unused
+entries fail the run (stale allowlists rot into blanket exemptions).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from k8s_tpu.analysis import astutil
+
+# --- lock model --------------------------------------------------------------
+
+# constructor names that make an attribute/global a lock; value is the
+# lock kind ("lock" = non-reentrant, "rlock"/"cond" = reentrant).  Matched
+# against the LAST component of the called dotted name, so any receiver
+# spelling — `threading.Lock`, `checkedlock.make_lock`, or an aliased
+# `_checkedlock.make_lock` (rest.py) — resolves the same
+LOCK_CTORS = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "cond",
+    "make_lock": "lock", "make_rlock": "rlock", "make_condition": "cond",
+}
+
+# clientset resource accessors: `.pods(ns).create(...)` is an apiserver call
+_CLIENT_ACCESSORS = {"pods", "services", "events", "endpoints", "configmaps",
+                     "namespaces", "pdbs", "crds", "tfjobs",
+                     "tfjobs_unstructured"}
+_CLIENT_VERBS = {"create", "get", "list", "update", "patch", "delete",
+                 "delete_collection", "watch"}
+
+# pod/service-control fan-out methods (controller_v2/control.py surface)
+_CONTROL_PREFIXES = ("create_pod", "delete_pod", "patch_pod",
+                     "create_service", "delete_service", "patch_service")
+
+# fully-dotted callables that block
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "sleep": "time.sleep",
+    "urllib.request.urlopen": "urllib.request.urlopen",
+    "urlopen": "urllib.request.urlopen",
+    "socket.create_connection": "socket.create_connection",
+    "select.select": "select.select",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+}
+
+# attribute method calls that mutate their receiver in place (used to
+# classify `self._counts.pop(...)` as a write to `_counts`)
+_MUTATORS = {"append", "appendleft", "add", "pop", "popitem", "popleft",
+             "clear", "update", "extend", "remove", "discard", "insert",
+             "setdefault", "move_to_end", "sort", "reverse", "rotate"}
+
+
+class Finding:
+    def __init__(self, code: str, path: str, lineno: int, message: str,
+                 qualifier: str = ""):
+        self.code = code
+        self.path = path
+        self.lineno = lineno
+        self.message = message
+        self.qualifier = qualifier  # the allowlist matching key
+
+    def __str__(self):
+        return f"{self.path}:{self.lineno}: {self.code}: {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"code": self.code, "path": self.path, "lineno": self.lineno,
+                "qualifier": self.qualifier, "message": self.message}
+
+
+class AllowlistError(ValueError):
+    pass
+
+
+def load_allowlist(path: str) -> list[dict]:
+    """Parse the allowlist; every entry must carry a ``-- reason``."""
+    entries = []
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            head, sep, reason = line.partition("--")
+            reason = reason.strip()
+            if not sep or not reason:
+                raise AllowlistError(
+                    f"{path}:{i}: allowlist entry without a '-- reason' "
+                    f"justification: {line!r}")
+            # split(None, 2): the qualifier is everything after the file
+            # and may itself contain spaces (blocking-under-lock emits
+            # e.g. 'C.sync:apiserver .pods().create'); strip the
+            # whitespace maxsplit leaves before the '--'
+            parts = [p.strip() for p in head.split(None, 2)]
+            if len(parts) != 3:
+                raise AllowlistError(
+                    f"{path}:{i}: expected '<check> <file> <qualifier> -- "
+                    f"<reason>', got {line!r}")
+            entries.append({"check": parts[0], "file": parts[1],
+                            "qualifier": parts[2], "reason": reason,
+                            "line": i, "used": False})
+    return entries
+
+
+# --- per-function extraction -------------------------------------------------
+
+
+class _FnSummary:
+    """Everything one function contributes to the module-level analysis."""
+
+    def __init__(self, qualname: str, name: str, cls: str | None):
+        self.qualname = qualname
+        self.name = name
+        self.cls = cls
+        # (lock_id, held_tuple, lineno) for each `with <lock>:` entry
+        self.acquires: list[tuple[str, tuple, int]] = []
+        # (attr, "read"|"write", held_tuple, lineno)
+        self.accesses: list[tuple[str, str, tuple, int]] = []
+        # (kind "method"|"func", target, held_tuple, lineno)
+        self.calls: list[tuple[str, str, tuple, int]] = []
+        # (desc, held_tuple, lineno, receiver_lock_or_None)
+        self.blocking: list[tuple[str, tuple, int, str | None]] = []
+        self.entry_held: frozenset = frozenset()
+
+
+class _FnVisitor(ast.NodeVisitor):
+    """Walks one function body tracking the stack of held known locks.
+
+    Nested function/class/lambda bodies are skipped — they run in a
+    different context, and are summarized separately with an empty entry
+    context."""
+
+    def __init__(self, summary: _FnSummary, class_locks: dict[str, str],
+                 module_locks: dict[str, str], class_methods: set[str],
+                 module_funcs: set[str], lock_prefix: str):
+        self.s = summary
+        self.class_locks = class_locks      # attr -> kind
+        self.module_locks = module_locks    # global name -> kind
+        self.class_methods = class_methods
+        self.module_funcs = module_funcs
+        self.lock_prefix = lock_prefix      # "Class." or "" for lock ids
+        self.held: list[str] = []
+
+    # -- lock resolution
+
+    def _resolve_lock(self, node: ast.AST) -> str | None:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ("self", "cls")
+                and node.attr in self.class_locks):
+            return self.lock_prefix + node.attr
+        if isinstance(node, ast.Name) and node.id in self.module_locks:
+            return node.id
+        return None
+
+    # -- traversal
+
+    def visit_FunctionDef(self, node):  # nested scope: separate summary
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_With(self, node):
+        pushed = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            lock = self._resolve_lock(item.context_expr)
+            if lock is not None:
+                self.s.acquires.append((lock, tuple(self.held),
+                                        item.context_expr.lineno))
+                self.held.append(lock)
+                pushed += 1
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- field accesses
+
+    def _record_self_attr(self, attr: str, kind: str, lineno: int):
+        self.s.accesses.append((attr, kind, tuple(self.held), lineno))
+
+    def _write_target(self, target: ast.AST):
+        """Record assignment/deletion targets rooted at self.X as writes
+        (``self.X = ...``, ``self.X[k] = ...``, ``del self.X[k]``); the
+        target is still visited afterwards so subscript indexes and the
+        inner ``self.X`` load are traversed normally."""
+        node = target
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            self._record_self_attr(node.attr, "write", target.lineno)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._write_target(t)
+            self.visit(t)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        self._write_target(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._write_target(node.target)
+            self.visit(node.value)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            self._write_target(t)
+            self.visit(t)
+
+    def visit_Attribute(self, node):
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and isinstance(node.ctx, ast.Load)):
+            self._record_self_attr(node.attr, "read", node.lineno)
+        self.generic_visit(node)
+
+    # -- calls
+
+    def visit_Call(self, node):
+        func = node.func
+        handled = False
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            # self.X.mutator(...): a write to field X
+            if (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"
+                    and func.attr in _MUTATORS):
+                self._record_self_attr(recv.attr, "write", node.lineno)
+            # self.method(...): intra-class call
+            if (isinstance(recv, ast.Name) and recv.id == "self"
+                    and func.attr in self.class_methods):
+                self.s.calls.append(("method", func.attr, tuple(self.held),
+                                     node.lineno))
+                handled = True
+        elif isinstance(func, ast.Name) and func.id in self.module_funcs:
+            self.s.calls.append(("func", func.id, tuple(self.held),
+                                 node.lineno))
+            handled = True
+        if not handled:
+            desc, recv_lock = self._blocking_desc(node)
+            if desc is not None:
+                self.s.blocking.append((desc, tuple(self.held), node.lineno,
+                                        recv_lock))
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    def _blocking_desc(self, node: ast.Call):
+        """(description, receiver_lock_or_None) when the call blocks."""
+        func = node.func
+        dotted = astutil.dotted_name(func)
+        if dotted is not None and dotted in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[dotted], None
+        if not isinstance(func, ast.Attribute):
+            return None, None
+        attr = func.attr
+        recv = func.value
+        if attr in ("wait", "wait_for"):
+            lock = self._resolve_lock(recv)
+            return f"{astutil.dotted_name(recv) or '<expr>'}.{attr}", lock
+        if attr == "result" and len(node.args) <= 1:
+            return "Future.result", None
+        if attr == "join" and not isinstance(recv, ast.Constant):
+            # str.join always takes a positional iterable; Thread.join
+            # takes nothing or a timeout keyword
+            kw = {k.arg for k in node.keywords}
+            if not node.args and kw <= {"timeout"}:
+                return "Thread.join", None
+        if attr in _CLIENT_VERBS and isinstance(recv, ast.Call) and \
+                isinstance(recv.func, ast.Attribute) and \
+                recv.func.attr in _CLIENT_ACCESSORS:
+            return f"apiserver .{recv.func.attr}().{attr}", None
+        if any(attr.startswith(p) for p in _CONTROL_PREFIXES):
+            return f"podcontrol.{attr}", None
+        return None, None
+
+
+# --- per-module analysis -----------------------------------------------------
+
+
+def _lock_ctor_kind(value: ast.AST) -> str | None:
+    for n in ast.walk(value):
+        if isinstance(n, ast.Call):
+            name = astutil.dotted_name(n.func)
+            if name and name.rsplit(".", 1)[-1] in LOCK_CTORS:
+                return LOCK_CTORS[name.rsplit(".", 1)[-1]]
+    return None
+
+
+class _Module:
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.guard_notes = astutil.line_comments(source, "guarded-by")
+        self.unguarded_ok = astutil.line_comments(source, "unguarded-ok")
+        self.lock_ok = astutil.line_comments(source, "lock-ok")
+        self.source_lines = source.count("\n") + 1
+        self.module_locks: dict[str, str] = {}
+        self.module_funcs: dict[str, ast.AST] = {}
+        self.classes: dict[str, dict] = {}
+        self.summaries: dict[str, _FnSummary] = {}
+        self._collect()
+        self._summarize()
+        self._entry_contexts()
+
+    def note(self, notes: dict[int, str], line: int) -> str | None:
+        """An annotation suppresses findings on its own line or (comments
+        usually precede the statement) up to two lines below it."""
+        for ln in (line, line - 1, line - 2):
+            if ln in notes:
+                return notes[ln]
+        return None
+
+    # -- collection
+
+    def _collect(self):
+        # own_scope_nodes, not tree.body: module-level locks/functions may
+        # sit inside top-level if/try/with blocks (rest.py creates
+        # _wire_profile_lock under `if WIRE_PROFILE_ENABLED:`) and must
+        # still be visible to all three passes; class and function bodies
+        # stay separate scopes, collected as units below
+        for node in astutil.own_scope_nodes(self.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    self.module_locks[node.targets[0].id] = kind
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self._collect_class(node)
+
+    def _collect_class(self, cls: ast.ClassDef):
+        locks: dict[str, str] = {}
+        annotations: dict[str, str] = {}  # field -> guard lock attr
+        methods: dict[str, ast.AST] = {}
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[node.name] = node
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                kind = _lock_ctor_kind(sub.value)
+                                if kind:
+                                    locks.setdefault(t.attr, kind)
+                                note = self.guard_notes.get(sub.lineno)
+                                if note:
+                                    annotations[t.attr] = (
+                                        note[5:] if note.startswith("self.")
+                                        else note)
+        self.classes[cls.name] = {"node": cls, "locks": locks,
+                                  "methods": methods,
+                                  "annotations": annotations}
+
+    # -- summaries
+
+    def _summarize(self):
+        for name, node in self.module_funcs.items():
+            s = _FnSummary(name, name, None)
+            v = _FnVisitor(s, {}, self.module_locks, set(),
+                           set(self.module_funcs), "")
+            for stmt in node.body:
+                v.visit(stmt)
+            self.summaries[name] = s
+        for cname, info in self.classes.items():
+            for mname, node in info["methods"].items():
+                qual = f"{cname}.{mname}"
+                s = _FnSummary(qual, mname, cname)
+                v = _FnVisitor(s, info["locks"], self.module_locks,
+                               set(info["methods"]),
+                               set(self.module_funcs), f"{cname}.")
+                for stmt in node.body:
+                    v.visit(stmt)
+                self.summaries[qual] = s
+
+    def _resolve_callee(self, caller: _FnSummary, kind: str,
+                        target: str) -> str | None:
+        if kind == "method" and caller.cls is not None:
+            qual = f"{caller.cls}.{target}"
+            return qual if qual in self.summaries else None
+        if kind == "func":
+            return target if target in self.summaries else None
+        return None
+
+    def _entry_contexts(self):
+        """Private helpers inherit the intersection of locks held at their
+        intra-module call sites.  Fixpoint, capped."""
+        sites: dict[str, list[tuple[str, tuple]]] = {}
+        for qual, s in self.summaries.items():
+            for kind, target, held, _lineno in s.calls:
+                callee = self._resolve_callee(s, kind, target)
+                if callee is not None:
+                    sites.setdefault(callee, []).append((qual, held))
+        for _ in range(10):
+            changed = False
+            for qual, s in self.summaries.items():
+                if not s.name.startswith("_") or s.name.startswith("__"):
+                    continue  # public or dunder: callable from anywhere
+                call_sites = sites.get(qual)
+                if not call_sites:
+                    continue
+                ctxs = [frozenset(held) | self.summaries[caller].entry_held
+                        for caller, held in call_sites]
+                new = frozenset.intersection(*ctxs) if ctxs else frozenset()
+                if new != s.entry_held:
+                    s.entry_held = new
+                    changed = True
+            if not changed:
+                break
+
+
+# --- report ------------------------------------------------------------------
+
+
+class Report:
+    def __init__(self):
+        self.findings: list[Finding] = []
+        self.suppressed: list[dict] = []
+        self.edges: dict[tuple[str, str], dict] = {}
+        self.lock_count = 0
+        self.module_count = 0
+        self.allowlist_unused: list[dict] = []
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "modules": self.module_count,
+            "locks": self.lock_count,
+            "edges": [
+                {"from": a, "to": b, "path": w["path"],
+                 "line": w["line"], "via": w["via"]}
+                for (a, b), w in sorted(self.edges.items())],
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": self.suppressed,
+            "allowlist_unused": self.allowlist_unused,
+        }
+
+
+def _module_lock_id(relpath: str, lock: str) -> str:
+    return f"{relpath}::{lock}"
+
+
+def _analyze_module(mod: _Module, report: Report):
+    rel = mod.relpath
+    summaries = mod.summaries
+
+    # transitive lock-acquisition sets per function, with one witness chain
+    acq: dict[str, dict[str, list]] = {
+        q: {lock: [(rel, line, q)]
+            for lock, _held, line in s.acquires}
+        for q, s in summaries.items()}
+    # transitive blocking descriptors, with witness chain + receiver lock
+    blk: dict[str, dict[str, tuple[list, str | None]]] = {}
+    for q, s in summaries.items():
+        blk[q] = {}
+        for desc, _held, line, recv_lock in s.blocking:
+            blk[q].setdefault(desc, ([(rel, line, q)], recv_lock))
+    for _ in range(10):
+        changed = False
+        for q, s in summaries.items():
+            for kind, target, _held, line in s.calls:
+                callee = mod._resolve_callee(s, kind, target)
+                if callee is None:
+                    continue
+                for lock, chain in acq.get(callee, {}).items():
+                    if lock not in acq[q]:
+                        acq[q][lock] = [(rel, line, q)] + chain
+                        changed = True
+                for desc, (chain, recv_lock) in blk.get(callee, {}).items():
+                    if desc not in blk[q]:
+                        blk[q][desc] = ([(rel, line, q)] + chain, recv_lock)
+                        changed = True
+        if not changed:
+            break
+
+    def lock_key(lock: str) -> str:
+        return _module_lock_id(rel, lock)
+
+    def add_edge(a: str, b: str, witness: dict):
+        key = (lock_key(a), lock_key(b))
+        report.edges.setdefault(key, witness)
+
+    kinds = dict(mod.module_locks)
+    for cname, info in mod.classes.items():
+        for attr, kind in info["locks"].items():
+            kinds[f"{cname}.{attr}"] = kind
+    report.lock_count += len(kinds)
+
+    # -- lock-order edges
+    for q, s in summaries.items():
+        eff_entry = s.entry_held
+        for lock, held, line in s.acquires:
+            for h in frozenset(held) | eff_entry:
+                if h == lock:
+                    if kinds.get(lock) == "lock":
+                        report.findings.append(Finding(
+                            "lock-order-cycle", rel, line,
+                            f"nested re-acquisition of non-reentrant lock "
+                            f"{lock} in {q} (self-deadlock)",
+                            qualifier=f"{lock}->{lock}"))
+                    continue
+                add_edge(h, lock, {"path": rel, "line": line,
+                                   "via": q})
+        for kind, target, held, line in s.calls:
+            callee = mod._resolve_callee(s, kind, target)
+            if callee is None:
+                continue
+            eff = frozenset(held) | eff_entry
+            for lock, chain in acq.get(callee, {}).items():
+                for h in eff:
+                    if h == lock:
+                        continue
+                    via = " -> ".join(hop[2] for hop in
+                                      [(rel, line, q)] + chain)
+                    add_edge(h, lock, {"path": rel, "line": line,
+                                       "via": via})
+
+    # -- blocking-under-lock
+    for q, s in summaries.items():
+        eff_entry = s.entry_held
+
+        def _flag(desc, eff_held, line, recv_lock, via=None):
+            hazard = set(eff_held)
+            if recv_lock is not None:
+                hazard.discard(recv_lock)  # cond.wait releases its own lock
+            if not hazard:
+                return
+            note = mod.note(mod.lock_ok, line)
+            if note:
+                report.suppressed.append({
+                    "code": "blocking-under-lock", "path": rel,
+                    "lineno": line, "reason": note,
+                    "qualifier": f"{q}:{desc}"})
+                return
+            held_s = ", ".join(sorted(hazard))
+            msg = f"blocking call {desc} while holding {held_s}"
+            if via:
+                msg += f" (via {via})"
+            report.findings.append(Finding(
+                "blocking-under-lock", rel, line, msg,
+                qualifier=f"{q}:{desc}"))
+
+        for desc, held, line, recv_lock in s.blocking:
+            _flag(desc, frozenset(held) | eff_entry, line, recv_lock)
+        for kind, target, held, line in s.calls:
+            callee = mod._resolve_callee(s, kind, target)
+            if callee is None:
+                continue
+            eff = frozenset(held) | eff_entry
+            if not eff:
+                continue
+            for desc, (chain, recv_lock) in blk.get(callee, {}).items():
+                via = " -> ".join(hop[2] for hop in chain)
+                _flag(desc, eff, line, recv_lock, via=via)
+
+    # -- guarded-by
+    for cname, info in mod.classes.items():
+        class_lock_ids = {f"{cname}.{a}" for a in info["locks"]}
+        if not class_lock_ids:
+            continue
+        guards: dict[str, set[str]] = {}   # field -> guard lock ids
+        for attr, lockname in info["annotations"].items():
+            guards.setdefault(attr, set()).add(f"{cname}.{lockname}")
+        accesses: list[tuple[str, str, str, frozenset, int]] = []
+        for mname in info["methods"]:
+            s = summaries[f"{cname}.{mname}"]
+            for attr, kind, held, line in s.accesses:
+                if attr in info["locks"]:
+                    continue
+                eff = frozenset(held) | s.entry_held
+                accesses.append((mname, attr, kind, eff, line))
+                if kind == "write" and mname not in ("__init__",
+                                                     "__post_init__"):
+                    under = eff & class_lock_ids
+                    if under:
+                        guards.setdefault(attr, set()).update(under)
+        for mname, attr, kind, eff, line in accesses:
+            if attr not in guards:
+                continue
+            if mname in ("__init__", "__post_init__"):
+                continue
+            if eff & guards[attr]:
+                continue
+            note = mod.note(mod.unguarded_ok, line)
+            if note:
+                report.suppressed.append({
+                    "code": "guarded-by", "path": rel, "lineno": line,
+                    "reason": note,
+                    "qualifier": f"{cname}.{attr}"})
+                continue
+            guard_s = ", ".join(sorted(guards[attr]))
+            report.findings.append(Finding(
+                "guarded-by", rel, line,
+                f"{kind} of {cname}.{attr} in {mname}() outside its guard "
+                f"lock ({guard_s})",
+                qualifier=f"{cname}.{attr}"))
+
+
+def _detect_cycles(report: Report):
+    """DFS over the global edge set; every cycle found becomes a finding
+    carrying the witness site of each edge on it."""
+    graph: dict[str, list[str]] = {}
+    for (a, b) in report.edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    color: dict[str, int] = {}
+    stack: list[str] = []
+    cycles: list[list[str]] = []
+    seen_cycles: set[frozenset] = set()
+
+    def dfs(node: str):
+        color[node] = 1
+        stack.append(node)
+        for nxt in graph[node]:
+            if color.get(nxt, 0) == 0:
+                dfs(nxt)
+            elif color.get(nxt) == 1:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+        stack.pop()
+        color[node] = 2
+
+    for node in sorted(graph):
+        if color.get(node, 0) == 0:
+            dfs(node)
+
+    for cyc in cycles:
+        edges = list(zip(cyc, cyc[1:]))
+        witness_lines = []
+        for a, b in edges:
+            w = report.edges[(a, b)]
+            witness_lines.append(
+                f"{a} -> {b} at {w['path']}:{w['line']} (via {w['via']})")
+        first = report.edges[edges[0]]
+        a_short = cyc[0].split("::")[-1]
+        report.findings.append(Finding(
+            "lock-order-cycle", first["path"], first["line"],
+            "potential deadlock: acquisition-order cycle "
+            + " -> ".join(c.split("::")[-1] for c in cyc)
+            + "; witnesses: " + "; ".join(witness_lines),
+            qualifier=f"{a_short}->{cyc[1].split('::')[-1]}"))
+
+
+def _apply_allowlist(report: Report, entries: list[dict]):
+    kept = []
+    for f in report.findings:
+        hit = None
+        for e in entries:
+            if (e["check"] == f.code and e["file"] == f.path
+                    and e["qualifier"] == f.qualifier):
+                hit = e
+                break
+        if hit is not None:
+            hit["used"] = True
+            report.suppressed.append({
+                "code": f.code, "path": f.path, "lineno": f.lineno,
+                "qualifier": f.qualifier, "reason": hit["reason"]})
+        else:
+            kept.append(f)
+    report.findings = kept
+    for e in entries:
+        if not e["used"]:
+            report.allowlist_unused.append(e)
+            report.findings.append(Finding(
+                "stale-allowlist", e["file"], e["line"],
+                f"allowlist entry never matched a finding: {e['check']} "
+                f"{e['file']} {e['qualifier']} — delete it or fix the "
+                f"qualifier", qualifier=e["qualifier"]))
+
+
+def analyze_tree(root: str, allowlist_path: str | None = None,
+                 rel_base: str | None = None) -> Report:
+    """Run all three passes over every module under ``root``.
+
+    ``rel_base`` anchors the repo-relative paths findings/allowlists use
+    (defaults to ``root``'s parent so paths read ``k8s_tpu/...``)."""
+    entries = load_allowlist(allowlist_path) if allowlist_path else []
+    base = rel_base or os.path.dirname(os.path.abspath(root))
+    report = Report()
+    for path in astutil.iter_py_files(root):
+        rel = os.path.relpath(os.path.abspath(path), base).replace(
+            os.sep, "/")
+        try:
+            with open(path, "rb") as f:
+                source = f.read().decode("utf-8", "replace")
+            tree = ast.parse(source, path)
+        except SyntaxError:
+            continue  # the lint syntax layer owns this failure
+        mod = _Module(path, rel, source, tree)
+        report.module_count += 1
+        _analyze_module(mod, report)
+    _detect_cycles(report)
+    _apply_allowlist(report, entries)
+    report.findings.sort(key=lambda f: (f.path, f.lineno, f.code))
+    return report
+
+
+def analyze_source(source: str, relpath: str = "mod.py") -> Report:
+    """Single-module entry point for tests/fixtures."""
+    report = Report()
+    tree = ast.parse(source, relpath)
+    mod = _Module(relpath, relpath, source, tree)
+    report.module_count = 1
+    _analyze_module(mod, report)
+    _detect_cycles(report)
+    report.findings.sort(key=lambda f: (f.path, f.lineno, f.code))
+    return report

@@ -17,7 +17,7 @@ import copy as _copy_mod
 import functools
 import itertools
 import queue
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from dataclasses import dataclass
 from typing import Any, Iterable, Optional
@@ -122,7 +122,7 @@ class FakeCluster:
     EVENT_HISTORY_LIMIT = 2048
 
     def __init__(self, copy_on_io: bool = True):
-        self._lock = threading.RLock()
+        self._lock = checkedlock.make_rlock("fake.store")
         self._store: dict[tuple[str, str], dict[tuple[str, str], dict]] = {}
         self._watches: dict[tuple[str, str], list[_Watch]] = {}
         self._uid_counter = itertools.count(1)
@@ -457,8 +457,11 @@ class FakeCluster:
             obj["metadata"] = dict(obj["metadata"])
             obj["metadata"]["resourceVersion"] = str(self._next_rv())
             self._notify(resource, DELETED, obj)
-            if propagation in ("Background", "Foreground"):
-                self._gc_dependents(obj["metadata"].get("uid"), ns)
+        # cascade OUTSIDE the lock: every dependent delete sleeps the
+        # injected delete_delay_s RTT, and a GC wave under the store lock
+        # would freeze the whole fake apiserver for N x RTT
+        if propagation in ("Background", "Foreground"):
+            self._gc_dependents(obj["metadata"].get("uid"), ns)
 
     # NOT @_accounted: the REST client implements delete_collection as
     # 1 LIST + N individual DELETEs on the wire, and so does this method
@@ -466,36 +469,50 @@ class FakeCluster:
     # naturally keeps the fake's substrate identical to the deployed one
     # (a single outer DELETE would hide the LIST from steady-state proofs).
     def delete_collection(self, resource: GVR, namespace: str, label_selector=None) -> int:
+        # enumerate under the lock, delete OUTSIDE it: each inner delete
+        # sleeps the injected RTT (delete_delay_s), and N sleeps while
+        # holding the store lock would stall every other API call for the
+        # whole wave (the blocking-under-lock class k8s_tpu.analysis
+        # gates on).  A real apiserver's LIST + N DELETEs aren't atomic
+        # either.
         with self._lock:
             victims = self.list(resource, namespace, label_selector)
-            deleted = 0
-            for v in victims:
-                # Use each victim's own namespace: with namespace=None the
-                # caller's argument is not a valid delete target.
-                vns = v["metadata"].get("namespace", "")
-                try:
-                    self.delete(resource, vns, v["metadata"]["name"])
-                    deleted += 1
-                except errors.ApiError:
-                    pass
-            return deleted
+        deleted = 0
+        for v in victims:
+            # Use each victim's own namespace: with namespace=None the
+            # caller's argument is not a valid delete target.
+            vns = v["metadata"].get("namespace", "")
+            try:
+                self.delete(resource, vns, v["metadata"]["name"])
+                deleted += 1
+            except errors.ApiError:
+                pass
+        return deleted
 
     def _gc_dependents(self, owner_uid: Optional[str], namespace: str) -> None:
-        """Owner-reference GC: cascade-delete dependents of a deleted owner."""
+        """Owner-reference GC: cascade-delete dependents of a deleted owner.
+
+        Scans the store under the lock but issues the deletes unlocked —
+        ``delete()`` sleeps the injected ``delete_delay_s`` RTT, and a
+        cascade must not serialize the whole cluster behind it."""
         if not owner_uid:
             return
-        for key in list(self._store):
-            bucket = self._store[key]
-            for (ns, name), obj in list(bucket.items()):
-                refs = (obj.get("metadata") or {}).get("ownerReferences") or []
-                if any(r.get("uid") == owner_uid for r in refs):
-                    group, plural = key
-                    gvr = GVR(group, obj.get("apiVersion", "v1").split("/")[-1], plural,
-                              obj.get("kind", ""))
-                    try:
-                        self.delete(gvr, ns, name)
-                    except errors.ApiError:
-                        pass
+        victims: list[tuple[GVR, str, str]] = []
+        with self._lock:
+            for key in list(self._store):
+                bucket = self._store[key]
+                for (ns, name), obj in list(bucket.items()):
+                    refs = (obj.get("metadata") or {}).get("ownerReferences") or []
+                    if any(r.get("uid") == owner_uid for r in refs):
+                        group, plural = key
+                        gvr = GVR(group, obj.get("apiVersion", "v1").split("/")[-1], plural,
+                                  obj.get("kind", ""))
+                        victims.append((gvr, ns, name))
+        for gvr, ns, name in victims:
+            try:
+                self.delete(gvr, ns, name)
+            except errors.ApiError:
+                pass
 
     # -- watch ---------------------------------------------------------------
 

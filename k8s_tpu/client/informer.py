@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from k8s_tpu.analysis import checkedlock
 from typing import Callable, Optional
 
 from k8s_tpu import flight
@@ -52,7 +53,7 @@ class Store:
     fix past 200 concurrent jobs."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = checkedlock.make_rlock("informer.store")
         self._items: dict[str, dict] = {}
         self._index_funcs: dict[str, Callable[[dict], list[str]]] = {}
         # index name -> index key -> set of object keys
@@ -146,7 +147,7 @@ class SharedInformer:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._active_watch = None
-        self._watch_lock = threading.Lock()
+        self._watch_lock = checkedlock.make_lock("informer.watch")
         # Why the NEXT relist will run (flight-recorder watch health):
         # "initial" for the first list, then set by whichever failure path
         # invalidates the resume point (410 vs transport/stream error).

@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from collections import OrderedDict
 
@@ -137,7 +138,7 @@ class AsyncEventRecorder(EventRecorder):
         self._q: "queue.Queue" = queue.Queue(maxsize=self.QUEUE_SIZE)
         self._unfinished = 0
         self._closed = False
-        self._cond = threading.Condition()
+        self._cond = checkedlock.make_condition("record.queue")
         # touched only by the sink thread — no lock needed
         self._agg: "OrderedDict[tuple, dict]" = OrderedDict()
         self._thread = threading.Thread(

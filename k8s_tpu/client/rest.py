@@ -36,9 +36,9 @@ WIRE_PROFILE_ENABLED = os.environ.get("K8S_TPU_WIRE_PROFILE") == "1"
 _wire_profile: dict = {}
 _wire_profile_lock = None
 if WIRE_PROFILE_ENABLED:
-    import threading as _threading
+    from k8s_tpu.analysis import checkedlock as _checkedlock
 
-    _wire_profile_lock = _threading.Lock()
+    _wire_profile_lock = _checkedlock.make_lock("rest.wire_profile")
 
 
 def _profile_key(method: str, path: str) -> str:

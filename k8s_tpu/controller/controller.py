@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from k8s_tpu.analysis import checkedlock
 import time
 
 from k8s_tpu.api import register, v1alpha1
@@ -42,7 +43,7 @@ class Controller:
         self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v1")
         self.jobs: dict[str, TrainingJob] = {}  # key -> TrainingJob
-        self._jobs_lock = threading.Lock()
+        self._jobs_lock = checkedlock.make_lock("controller_v1.jobs")
 
         self.factory = informer_factory or SharedInformerFactory(clientset.backend)
         self.tfjob_informer = self.factory.informer_for(TFJOBS_V1ALPHA1)

@@ -13,7 +13,7 @@ from __future__ import annotations
 import copy
 import logging
 import os
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -45,7 +45,7 @@ DEFAULT_DELETE_CONCURRENCY = 16
 
 _shared_executor: ThreadPoolExecutor | None = None
 _shared_delete_executor: ThreadPoolExecutor | None = None
-_shared_executor_lock = threading.Lock()
+_shared_executor_lock = checkedlock.make_lock("control.shared_executor")
 
 
 def _concurrency_env(var: str) -> int:
@@ -650,7 +650,7 @@ class FakePodControl(_BatchCreateMixin, _BatchDeleteMixin):
     *controller* may call the fake from concurrent reconcile tasks."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("control.fake_pod")
         self.templates: list[dict] = []
         self.controller_refs: list[OwnerReference] = []
         self.delete_pod_names: list[str] = []
@@ -660,10 +660,12 @@ class FakePodControl(_BatchCreateMixin, _BatchDeleteMixin):
 
     def create_pods_with_controller_ref(self, namespace, template, controller_obj, controller_ref):
         _validate_controller_ref(controller_ref)
-        if self.create_error is not None:
-            raise self.create_error
         captured = copy.deepcopy(template)
         with self._lock:
+            # error injection is cleared under the lock (clear()), so the
+            # racing reconcile threads must read it there too
+            if self.create_error is not None:
+                raise self.create_error
             self.templates.append(captured)
             self.controller_refs.append(controller_ref)
         return _pod_from_template(template, controller_ref)
@@ -682,9 +684,9 @@ class FakePodControl(_BatchCreateMixin, _BatchDeleteMixin):
         ])
 
     def delete_pod(self, namespace, name, controller_obj):
-        if self.delete_error is not None:
-            raise self.delete_error
         with self._lock:
+            if self.delete_error is not None:
+                raise self.delete_error
             self.delete_pod_names.append(name)
 
     def patch_pod(self, namespace, name, patch):
@@ -708,7 +710,7 @@ class FakeServiceControl(_BatchCreateMixin, _BatchDeleteMixin):
     needs failure tests exactly like the pod side."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("control.fake_service")
         self.services: list[dict] = []
         self.controller_refs: list[OwnerReference] = []
         self.delete_service_names: list[str] = []
@@ -718,10 +720,10 @@ class FakeServiceControl(_BatchCreateMixin, _BatchDeleteMixin):
 
     def create_services_with_controller_ref(self, namespace, service, controller_obj, controller_ref):
         _validate_controller_ref(controller_ref)
-        if self.create_error is not None:
-            raise self.create_error
         captured = copy.deepcopy(service)
         with self._lock:
+            if self.create_error is not None:
+                raise self.create_error
             self.services.append(captured)
             self.controller_refs.append(controller_ref)
         return copy.deepcopy(service)
@@ -740,9 +742,9 @@ class FakeServiceControl(_BatchCreateMixin, _BatchDeleteMixin):
         ])
 
     def delete_service(self, namespace, name, controller_obj):
-        if self.delete_error is not None:
-            raise self.delete_error
         with self._lock:
+            if self.delete_error is not None:
+                raise self.delete_error
             self.delete_service_names.append(name)
 
     def patch_service(self, namespace, name, patch):

@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+from k8s_tpu.analysis import checkedlock
 import time
 
 from k8s_tpu import fleet as fleet_mod
@@ -165,14 +166,14 @@ class TFJobController:
         # Serializes tfjob.status mutation across concurrent per-replica-type
         # reconcile tasks (one lock per controller: workers sync different
         # jobs, so contention is bounded by the rtype fan-out width).
-        self._status_lock = threading.Lock()
+        self._status_lock = checkedlock.make_lock("controller_v2.status")
         # Per-replica-type fan-out pool: DISTINCT from the create pool — the
         # rtype tasks themselves submit create batches, and nesting both on
         # one saturated executor would deadlock.  Width 4 covers every valid
         # replica-type combination; serial mode (create_concurrency=1) skips
         # it entirely.  Lazily created on the first multi-type sync.
         self._rtype_executor = None
-        self._rtype_executor_lock = threading.Lock()
+        self._rtype_executor_lock = checkedlock.make_lock("controller_v2.rtype_executor")
 
         self.service_reconciler = service_mod.ServiceReconciler(
             self.service_control, self.expectations, metrics=self.metrics,

@@ -9,7 +9,7 @@ not arrived yet.  ``satisfied(key)`` is the gate before a full reconcile
 
 from __future__ import annotations
 
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 
 EXPECTATION_TTL_SECONDS = 5 * 60.0  # ExpectationsTimeout in upstream
@@ -32,7 +32,7 @@ class _Expectation:
 
 class ControllerExpectations:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("expectations")
         self._store: dict[str, _Expectation] = {}
 
     def expect_creations(self, key: str, count: int) -> None:

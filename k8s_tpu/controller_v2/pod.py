@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import copy
 import logging
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 
 from k8s_tpu.api.v1alpha2 import types
@@ -216,7 +216,7 @@ class PodReconciler:
         # Serializes tfjob.status mutations when the controller reconciles
         # replica types concurrently: set_condition is read-modify-write on
         # the shared conditions list, and replica counters live in one dict.
-        self.status_lock = status_lock or threading.Lock()
+        self.status_lock = status_lock or checkedlock.make_lock("podcontrol.status")
         self.metrics = metrics  # optional controller_metrics dict
 
     def reconcile(

@@ -9,7 +9,7 @@ reference's one-service-per-index contract is preserved.
 from __future__ import annotations
 
 import logging
-import threading
+from k8s_tpu.analysis import checkedlock
 
 from k8s_tpu.api.v1alpha2 import types
 from k8s_tpu.controller_v2 import tpu_config
@@ -64,7 +64,7 @@ class ServiceReconciler:
         # Shared with PodReconciler: tfjob.status is mutated under it by
         # concurrent replica-type tasks, so the job-dict snapshot below must
         # hold it too (an unlocked to_dict() can crash mid-iteration).
-        self.status_lock = status_lock or threading.Lock()
+        self.status_lock = status_lock or checkedlock.make_lock("servicecontrol.status")
 
     def reconcile(
         self,

@@ -20,6 +20,7 @@ import logging
 import os
 import subprocess
 import threading
+from k8s_tpu.analysis import checkedlock
 import time
 
 from k8s_tpu.client import errors
@@ -56,7 +57,7 @@ class KubeletSimulator:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._active_watch = None
-        self._watch_lock = threading.Lock()
+        self._watch_lock = checkedlock.make_lock("kubelet.watch")
         # Command-less (synthetic) pods run on a single timer wheel instead
         # of a thread each: at e2e scale (1600+ pods) thread-per-pod meant
         # a thread + its own pooled REST connection + a server-side handler
@@ -66,7 +67,7 @@ class KubeletSimulator:
         # loop, not a thread per container.
         self._timer_heap: list = []
         self._timer_seq = itertools.count()
-        self._timer_cond = threading.Condition()
+        self._timer_cond = checkedlock.make_condition("kubelet.timer")
         self._timer_thread: threading.Thread | None = None
         self._deleted: set[str] = set()  # synthetic pods deleted mid-flight
 

@@ -24,7 +24,7 @@ an exporter with 10k ad-hoc families cannot balloon the plane.
 
 from __future__ import annotations
 
-import threading
+from k8s_tpu.analysis import checkedlock
 from collections import OrderedDict, deque
 
 _INF = float("inf")
@@ -158,7 +158,7 @@ class FleetAggregator:
         self.max_samples = max_samples
         self.max_jobs = max_jobs
         self.family_prefixes = tuple(family_prefixes)
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("fleet.aggregate")
         # job -> {"counters": {(family, labels): {pod: ring}},
         #         "gauges":   {family: ({pod: (t, value)}, max_ring)},
         #         "hist":     {family: {pod: ring-of-points}}}

@@ -15,7 +15,7 @@ contract.  External consumers (ROADMAP item 2's router/autoscaler) read
 from __future__ import annotations
 
 import itertools
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from collections import deque
 
@@ -74,7 +74,7 @@ class FleetPlane:
         self._sinks: list = [self._event_ring_sink]
         self._active = False
         self._started_at: float | None = None
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("fleet.plane")
         self._seq = itertools.count(1)
         self._events: deque = deque(maxlen=EVENT_RING_SIZE)
 

@@ -17,6 +17,7 @@ operators scraping the same pods doesn't phase-lock.
 from __future__ import annotations
 
 import threading
+from k8s_tpu.analysis import checkedlock
 import time
 import urllib.request
 from collections import OrderedDict
@@ -61,7 +62,7 @@ class ScrapeStats:
     MAX_COUNT_JOBS = 1024
 
     def __init__(self, max_count_jobs: int = MAX_COUNT_JOBS):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("fleet.scrape_stats")
         self.max_count_jobs = max_count_jobs
         # job -> {outcome: n}; OrderedDict gives LRU-by-scrape
         self._counts: "OrderedDict[str, dict]" = OrderedDict()
@@ -186,13 +187,13 @@ class ScrapeLoop:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._pool: ThreadPoolExecutor | None = None
-        self._pool_lock = threading.Lock()
+        self._pool_lock = checkedlock.make_lock("fleet.scrape_pool")
         # targets currently submitted to the pool: a cycle never
         # re-enqueues a target whose previous scrape is still running,
         # so a mass outage (every fetch riding its deadline) cannot grow
         # the executor queue without bound cycle over cycle
         self._inflight: set = set()
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = checkedlock.make_lock("fleet.scrape_inflight")
 
     def _get_pool(self) -> ThreadPoolExecutor:
         with self._pool_lock:

@@ -26,7 +26,7 @@ gauge either way.
 
 from __future__ import annotations
 
-import threading
+from k8s_tpu.analysis import checkedlock
 
 _QUANTILE_REDUCERS = {"p50": 0.50, "p90": 0.90, "p99": 0.99}
 _GAUGE_REDUCERS = ("max", "mean")
@@ -102,7 +102,7 @@ class SloEvaluator:
         self.rules = list(rules)
         self.aggregator = aggregator
         self.windows = tuple(float(w) for w in windows)
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("fleet.slo")
         # (job, rule.name) -> state dict
         self._state: dict[tuple, dict] = {}
         self.breaches_total: dict[tuple, int] = {}

@@ -24,7 +24,7 @@ REST client records through here on its request hot path.
 
 from __future__ import annotations
 
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from typing import Optional
 
@@ -46,7 +46,7 @@ class CallAccounting:
     process-wide duration histogram and a per-second rolling rate."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("flight.accounting")
         self._requests: dict[tuple[str, str, int], int] = {}
         self._bucket_counts = [0] * len(DURATION_BUCKETS)
         self._duration_sum = 0.0
@@ -150,7 +150,7 @@ class EventStats:
     dropped event gets is this counter."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("flight.events")
         self.recorded = 0
         self.dropped = 0
         self.aggregated = 0

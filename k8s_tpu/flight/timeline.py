@@ -23,7 +23,7 @@ registered).
 from __future__ import annotations
 
 import itertools
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from collections import OrderedDict, deque
 
@@ -40,7 +40,7 @@ class TimelineRecorder:
             raise ValueError("timeline bounds must be >= 1")
         self.max_events_per_job = max_events_per_job
         self.max_jobs = max_jobs
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("flight.timeline")
         self._seq = itertools.count(1)
         # job key -> deque of entry dicts; OrderedDict gives LRU-by-write
         self._jobs: "OrderedDict[str, deque]" = OrderedDict()

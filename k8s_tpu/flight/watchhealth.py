@@ -22,7 +22,7 @@ the relist assertions in ``bench_operator --churn``.
 from __future__ import annotations
 
 import itertools
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 
 RELIST_INITIAL = "initial"
@@ -38,7 +38,7 @@ class WatchHealth:
     """Thread-safe per-resource watch/reflector counters."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("flight.watchhealth")
         self._relists: dict[tuple[str, str], int] = {}  # (resource, reason)
         self._restarts: dict[str, int] = {}
         self._events: dict[tuple[str, str], int] = {}  # (resource, type)

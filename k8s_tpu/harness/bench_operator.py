@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -1658,6 +1659,15 @@ def main(argv=None) -> int:
     p.add_argument("--fleet-out", default=None,
                    help="also write the --fleet JSON result to this path "
                    "(bench artifact)")
+    p.add_argument("--lock-audit-out", default=None,
+                   help="enable the runtime lock checker "
+                   "(K8S_TPU_LOCK_CHECK=1; k8s_tpu.analysis.checkedlock) "
+                   "for the whole bench run and write the lock_audit.json "
+                   "artifact — acquisition DAG aggregated by lock name, "
+                   "per-lock contention counts and max hold times, "
+                   "watchdog/cycle violation records — to this path; a "
+                   "cycle violation raises inside the offending scenario "
+                   "(the JSON still records it)")
     p.add_argument("--trace", action="store_true",
                    help="force tracing on (sample rate 1.0) and append a "
                    "per-stage p50/p99 breakdown ('stages') to the JSON "
@@ -1667,6 +1677,27 @@ def main(argv=None) -> int:
                    "nonzero exit)")
     args = p.parse_args(argv)
 
+    old_lock_check = os.environ.get("K8S_TPU_LOCK_CHECK")
+    if args.lock_audit_out:
+        # before any scenario constructs a cluster/engine: the checkedlock
+        # factories read the env at lock-creation time
+        os.environ["K8S_TPU_LOCK_CHECK"] = "1"
+
+    try:
+        return _run(args, p)
+    finally:
+        # the artifact must land on failed runs too — a cycle violation
+        # raising inside a scenario is exactly the run worth auditing
+        _write_lock_audit(args)
+        if args.lock_audit_out:
+            # in-process callers (tests) must not inherit checker mode
+            if old_lock_check is None:
+                os.environ.pop("K8S_TPU_LOCK_CHECK", None)
+            else:
+                os.environ["K8S_TPU_LOCK_CHECK"] = old_lock_check
+
+
+def _run(args, p) -> int:
     if args.trace:
         from k8s_tpu import trace
 
@@ -1749,6 +1780,28 @@ def main(argv=None) -> int:
             "by_verb": profile,
         }))
     return 0
+
+
+def _write_lock_audit(args) -> None:
+    """Emit the runtime lock checker's lock_audit.json artifact (ISSUE 10)
+    plus a one-line JSON summary on stdout, when --lock-audit-out is set."""
+    if not getattr(args, "lock_audit_out", None):
+        return
+    from k8s_tpu.analysis import checkedlock
+
+    snap = checkedlock.write_audit(args.lock_audit_out)
+    print(json.dumps({
+        "metric": "lock_audit",
+        "path": args.lock_audit_out,
+        "locks": len(snap["locks"]),
+        "edges": len(snap["edges"]),
+        "max_hold_s": max(
+            [st["max_hold_s"] for st in snap["locks"].values()] or [0.0]),
+        "contention_total": sum(
+            st["contention"] for st in snap["locks"].values()),
+        "watchdog_violations": len(snap["watchdog_violations"]),
+        "cycle_violations": snap["cycle_violations"],
+    }))
 
 
 if __name__ == "__main__":

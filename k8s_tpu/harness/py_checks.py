@@ -5,6 +5,12 @@ files, emitting one junit XML per check.  Here lint is ``pyflakes`` when
 importable, else a ``compile()`` syntax pass (no pylint in this image), and
 the test tier runs pytest; junit files land in ``--artifacts_dir`` for
 :func:`k8s_tpu.harness.prow.check_no_errors` to inspect.
+
+The lint tier additionally runs the static concurrency analyzer
+(:mod:`k8s_tpu.analysis`, ISSUE 10) over the whole ``k8s_tpu`` tree —
+lock-order cycles, guarded-by discipline, blocking-calls-under-lock — with
+its own junit + JSON artifact; see docs/static_analysis.md for the
+annotation and allowlist syntax.
 """
 
 from __future__ import annotations
@@ -16,11 +22,12 @@ import subprocess
 import sys
 import time
 
+from k8s_tpu.analysis import astutil
 from k8s_tpu.harness import junit
 
 log = logging.getLogger(__name__)
 
-EXCLUDE_DIRS = {".git", "__pycache__", ".eggs", "build", "vendor", "node_modules"}
+EXCLUDE_DIRS = astutil.EXCLUDE_DIRS  # shared with the analysis AST walkers
 
 
 # Packages that must stay stdlib-only (plus themselves): trace/ rides the
@@ -31,10 +38,18 @@ EXCLUDE_DIRS = {".git", "__pycache__", ".eggs", "build", "vendor", "node_modules
 # loop, lifecycle timelines served by two HTTP processes; fleet/ (ISSUE 8)
 # is the fleet telemetry plane — a scrape thread inside the operator
 # process, read by two HTTP processes, all informer/TFJob knowledge kept
-# with its callers.  None may grow a third-party (or even intra-repo)
-# import.
+# with its callers; analysis/ (ISSUE 10) is the concurrency auditor whose
+# checkedlock wrappers sit inside every hot-path lock.  None may grow a
+# third-party (or even intra-repo) import — with ONE carve-out: any of
+# them may import ``k8s_tpu.analysis`` (itself stdlib-only, so the
+# transitive guarantee holds) so their locks can be created through the
+# runtime-checkable ``checkedlock`` factories.
 STDLIB_ONLY_PACKAGES = ("k8s_tpu.trace", "k8s_tpu.scheduler",
-                        "k8s_tpu.flight", "k8s_tpu.fleet")
+                        "k8s_tpu.flight", "k8s_tpu.fleet",
+                        "k8s_tpu.analysis")
+
+# the carve-out target: stdlib-only packages may import this package
+_STDLIB_ONLY_SHARED = "k8s_tpu.analysis"
 
 
 def check_stdlib_only(path: str, source: bytes | None = None,
@@ -67,6 +82,9 @@ def check_stdlib_only(path: str, source: bytes | None = None,
         for name in names:
             if name == package or name.startswith(package + "."):
                 continue
+            if name == _STDLIB_ONLY_SHARED or \
+                    name.startswith(_STDLIB_ONLY_SHARED + "."):
+                continue  # checkedlock carve-out (see STDLIB_ONLY_PACKAGES)
             if name.split(".", 1)[0] in sys.stdlib_module_names:
                 continue
             violations.append(
@@ -88,12 +106,7 @@ def _stdlib_only_package_of(path: str) -> str | None:
     return None
 
 
-def iter_py_files(src_dir: str):
-    for root, dirs, files in os.walk(src_dir):
-        dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
-        for name in sorted(files):
-            if name.endswith(".py"):
-                yield os.path.join(root, name)
+iter_py_files = astutil.iter_py_files
 
 
 def _lint_one(path: str) -> str | None:
@@ -149,6 +162,58 @@ def run_lint(src_dir: str, artifacts_dir: str) -> bool:
     return ok
 
 
+def run_concurrency(src_dir: str, artifacts_dir: str) -> bool:
+    """The static concurrency analyzer (ISSUE 10) as a lint-tier gate:
+    one junit case per check pass, plus the full report JSON artifact
+    (``concurrency_report.json``).  Zero unexplained allowlist entries by
+    construction — the allowlist loader rejects reason-less lines and
+    stale entries become findings."""
+    import json
+
+    from k8s_tpu.analysis import static
+
+    suite = junit.TestSuite("concurrency")
+    start = time.time()
+    tree_root = os.path.join(src_dir, "k8s_tpu")
+    if not os.path.isdir(tree_root):
+        tree_root = src_dir
+    allowlist = os.path.join(tree_root, "analysis", "allowlist.txt")
+    case = suite.create("analyze")
+    try:
+        report = static.analyze_tree(
+            tree_root,
+            allowlist_path=allowlist if os.path.exists(allowlist) else None,
+            rel_base=os.path.dirname(os.path.abspath(tree_root)))
+    except static.AllowlistError as e:
+        case.failure = f"unexplained allowlist entry: {e}"
+        case.time = time.time() - start
+        junit.create_junit_xml_file(
+            suite, os.path.join(artifacts_dir, "junit_concurrency.xml"))
+        return False
+    case.time = time.time() - start
+    by_code: dict[str, list] = {}
+    for f in report.findings:
+        by_code.setdefault(f.code, []).append(f)
+    for code in ("lock-order-cycle", "guarded-by", "blocking-under-lock",
+                 "stale-allowlist"):
+        sub = suite.create(code)
+        # time-less cases render as "Test was not run." failures in
+        # junit.create_xml, and prow.check_no_errors fails the job on any
+        sub.time = 0.0
+        found = by_code.get(code, [])
+        if found:
+            sub.failure = "\n".join(str(f) for f in found)
+    with open(os.path.join(artifacts_dir, "concurrency_report.json"),
+              "w", encoding="utf-8") as f:
+        json.dump(report.as_dict(), f, indent=1, sort_keys=True)
+    junit.create_junit_xml_file(
+        suite, os.path.join(artifacts_dir, "junit_concurrency.xml"))
+    if not report.ok:
+        for finding in report.findings:
+            log.error("concurrency: %s", finding)
+    return report.ok
+
+
 def run_tests(src_dir: str, artifacts_dir: str) -> bool:
     """Run the pytest tier writing junit_pytests.xml (the *_test.py loop of
     py_checks.py:86-121, delegated to pytest's own junit emitter)."""
@@ -181,6 +246,7 @@ def main(argv=None) -> int:
     ok = True
     if args.check in ("lint", "all"):
         ok = run_lint(args.src_dir, args.artifacts_dir) and ok
+        ok = run_concurrency(args.src_dir, args.artifacts_dir) and ok
     if args.check in ("test", "all"):
         ok = run_tests(args.src_dir, args.artifacts_dir) and ok
     return 0 if ok else 1

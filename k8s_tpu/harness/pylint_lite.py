@@ -34,6 +34,8 @@ import ast
 import builtins
 import symtable
 
+from k8s_tpu.analysis import astutil
+
 _BUILTIN_NAMES = set(dir(builtins)) | {
     "__file__", "__name__", "__doc__", "__spec__", "__loader__",
     "__package__", "__builtins__", "__debug__", "__annotations__",
@@ -64,27 +66,8 @@ _NOQA_ALIASES = {
 }
 
 
-def _noqa_lines(source: str) -> dict[int, set[str] | None]:
-    """line -> None (blanket noqa) or set of codes."""
-    out: dict[int, set[str] | None] = {}
-    for i, line in enumerate(source.splitlines(), 1):
-        if "# noqa" not in line:
-            continue
-        _, _, tail = line.partition("# noqa")
-        tail = tail.strip()
-        if tail.startswith(":"):
-            # codes run until the first token that isn't a comma-separated
-            # identifier (trailing prose is tolerated)
-            codes = set()
-            for chunk in tail[1:].split(","):
-                tok = chunk.strip().split()
-                if not tok:
-                    continue
-                codes.add(tok[0].lower())
-            out[i] = codes
-        else:
-            out[i] = None
-    return out
+# noqa parsing is shared with the concurrency analyzer's walker utilities
+_noqa_lines = astutil.noqa_lines
 
 
 def _module_bindings(tree: ast.Module, table: symtable.SymbolTable) -> set[str]:
@@ -256,15 +239,7 @@ def _check_unused_locals(tree: ast.Module) -> list[Finding]:
     bindings are included (the unused-binding idiom is ``_``).
     """
     findings = []
-
-    def own_body_nodes(fn):
-        stack = list(ast.iter_child_nodes(fn))
-        while stack:
-            n = stack.pop()
-            yield n
-            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef, ast.Lambda)):
-                stack.extend(ast.iter_child_nodes(n))
+    own_body_nodes = astutil.own_scope_nodes
 
     # tuple/list unpacking is exempt (pyflakes F841 behavior): the
     # B, L, H, D = x.shape idiom DOCUMENTS the shape; partial use is

@@ -26,7 +26,7 @@ inspectable with stock tooling.
 from __future__ import annotations
 
 import logging
-import threading
+from k8s_tpu.analysis import checkedlock
 from typing import Any, Optional
 
 log = logging.getLogger(__name__)
@@ -55,7 +55,7 @@ class Checkpointer:
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("checkpoint")
 
     # -- save ------------------------------------------------------------
 

@@ -82,6 +82,7 @@ import logging
 import math
 import os
 import threading
+from k8s_tpu.analysis import checkedlock
 from collections import deque
 from collections.abc import Mapping
 from typing import Any, Callable, Optional
@@ -310,7 +311,7 @@ class Engine:
         self.metrics = metrics or {}
         self._model = Transformer(config)
         self._queue: deque[_Request] = deque()
-        self._cond = threading.Condition()
+        self._cond = checkedlock.make_condition("engine.cond")
         self._closed = False
         self._crashed = False
 
@@ -502,6 +503,8 @@ class Engine:
         the serving /healthz must flip to 503 so the kubelet restarts the
         pod instead of routing to a process that 500s every generate.
         Deliberate shutdown() and queue shedding are NOT unhealthy."""
+        # unguarded-ok: /healthz must stay lock-free — a wedged engine loop
+        # holding _cond must not hang the probe; a bool read is GIL-atomic
         return not self._crashed
 
     def queue_depth(self) -> int:

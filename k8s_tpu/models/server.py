@@ -59,6 +59,7 @@ import dataclasses
 import json
 import logging
 import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -220,7 +221,7 @@ class LmServer:
             # legacy single-flight path: one lock around all device work
             # (kept as the bench_serve baseline and an escape hatch)
             self.engine = None
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("server.singleflight")
 
     def close(self) -> None:
         if self.metrics["queue_depth"]._fn == self.queue_depth:

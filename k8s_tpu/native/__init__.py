@@ -18,24 +18,26 @@ import os
 import shutil
 import subprocess
 import threading
+from k8s_tpu.analysis import checkedlock
 
 log = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "runtime.cc")
 _DL_SRC = os.path.join(_DIR, "src", "dataloader.cc")
+_TSAN_WAIT_HDR = os.path.join(_DIR, "src", "tsan_wait.h")
 _BUILD_DIR = os.path.join(_DIR, "_build")
 _LIB = os.path.join(_BUILD_DIR, "libk8stpu_runtime.so")
 
-_lock = threading.Lock()
+_lock = checkedlock.make_lock("native.build")
 _lib: ctypes.CDLL | None = None
 _tried = False
 
 
 def build(force: bool = False) -> str | None:
     """Compile the library if stale; returns the .so path or None."""
-    sources = [p for p in (_SRC, _DL_SRC) if os.path.exists(p)]
-    if len(sources) < 2:
+    sources = [p for p in (_SRC, _DL_SRC, _TSAN_WAIT_HDR) if os.path.exists(p)]
+    if len(sources) < 3:
         log.warning("native sources missing; native runtime unavailable")
         return None  # graceful: callers fall back to pure Python
     src_mtime = max(os.path.getmtime(p) for p in sources)
@@ -74,7 +76,7 @@ def build_stress_binary(tsan: bool = False) -> str | None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     out = os.path.join(_BUILD_DIR, "stress_tsan" if tsan else "stress")
     sources_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_STRESS_SRC),
-                        os.path.getmtime(_DL_SRC))
+                        os.path.getmtime(_DL_SRC), os.path.getmtime(_TSAN_WAIT_HDR))
     if os.path.exists(out) and os.path.getmtime(out) >= sources_mtime:
         return out
     cmd = [gxx, "-O1", "-g", "-std=c++17", "-pthread",

@@ -30,6 +30,8 @@
 #include <fcntl.h>
 #include <unistd.h>
 
+#include "tsan_wait.h"
+
 namespace {
 
 struct Desc {
@@ -171,8 +173,8 @@ int64_t dl_next(void* h, char* out, uint64_t out_cap, int timeout_ms) {
   Slot& slot = L->slots[seq % L->slots.size()];
   {
     std::unique_lock<std::mutex> lock(L->mu);
-    bool ok = L->ready_cv.wait_for(
-        lock, std::chrono::milliseconds(timeout_ms), [&] {
+    bool ok = tsan_safe_wait_for(
+        L->ready_cv, lock, std::chrono::milliseconds(timeout_ms), [&] {
           return L->stopping || L->error.load() ||
                  slot.ready.load(std::memory_order_acquire) != 0;
         });

@@ -29,6 +29,8 @@
 #include <unordered_set>
 #include <vector>
 
+#include "tsan_wait.h"
+
 using Clock = std::chrono::steady_clock;
 
 static double now_s() {
@@ -169,7 +171,7 @@ struct RateLimitingQueue {
         wait = std::min(wait, rem);
       }
       if (wait < 0.0001) wait = 0.0001;
-      cv.wait_for(l, std::chrono::duration<double>(wait));
+      tsan_safe_wait_for(cv, l, std::chrono::duration<double>(wait));
     }
     std::string item = queue.front();
     queue.pop_front();

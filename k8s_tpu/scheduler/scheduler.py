@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import collections
 import os
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -83,7 +83,7 @@ class GangScheduler:
     def __init__(self, total_chips: Optional[int] = None,
                  aging_interval_s: Optional[float] = None,
                  max_aging_boost: int = 5):
-        self._lock = threading.RLock()
+        self._lock = checkedlock.make_rlock("scheduler.ledger")
         self.capacity = ClusterCapacity(total_chips=total_chips)
         self.queue = AdmissionQueue(
             aging_interval_s=(aging_interval_s if aging_interval_s is not None

@@ -12,7 +12,7 @@ writers (one lock covers the append+evict pair).
 from __future__ import annotations
 
 import json
-import threading
+from k8s_tpu.analysis import checkedlock
 import urllib.parse
 from collections import deque
 
@@ -26,7 +26,7 @@ class RingBufferExporter:
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("trace.export")
         self._traces: deque[dict] = deque(maxlen=capacity)
         self._exported = 0
         self._evicted = 0

@@ -27,7 +27,7 @@ from __future__ import annotations
 import contextvars
 import os
 import random
-import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from typing import Optional
 
@@ -101,7 +101,7 @@ class Span:
         self._tracer = tracer
         self._parent = parent
         self._token = None
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("trace.span")
 
     # -- recording -----------------------------------------------------------
 
@@ -133,9 +133,9 @@ class Span:
 
     def has_error(self) -> bool:
         """True when this span or any descendant recorded an error."""
-        if self.status == "error":
-            return True
         with self._lock:
+            if self.status == "error":
+                return True
             children = list(self.children)
         return any(c.has_error() for c in children)
 
@@ -177,6 +177,11 @@ class Span:
             attributes = dict(self.attributes)
             events = list(self.events)
             children = list(self.children)
+            # status and status_message are written together under the
+            # lock (set_error); snapshot them in the same critical
+            # section so a concurrent set_error can't tear the pair
+            status = self.status
+            status_message = self.status_message
         out = {
             "name": self.name,
             "trace_id": self.trace_id,
@@ -184,14 +189,14 @@ class Span:
             "parent_id": self.parent_id,
             "start_unix": round(self.start_wall, 6),
             "duration_ms": round(self.duration_s * 1e3, 3),
-            "status": self.status,
+            "status": status,
             "attributes": attributes,
             "events": events,
             "children": [c.to_dict() for c in
                          sorted(children, key=lambda c: c.start)],
         }
-        if self.status_message:
-            out["status_message"] = self.status_message
+        if status_message:
+            out["status_message"] = status_message
         return out
 
 

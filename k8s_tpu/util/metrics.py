@@ -11,7 +11,7 @@ through the default registry.
 from __future__ import annotations
 
 import bisect
-import threading
+from k8s_tpu.analysis import checkedlock
 from typing import Iterable, Optional, Sequence
 
 _DEFAULT_BUCKETS = (
@@ -49,7 +49,7 @@ class _Metric:
         self.name = name
         self.help = help_text
         self.label_names = tuple(label_names)
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("metrics.family")
         self._children: dict[tuple, object] = {}
 
     def labels(self, *label_values: str):
@@ -88,7 +88,7 @@ class _CounterChild:
 
     def __init__(self):
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("metrics.counter")
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
@@ -98,7 +98,8 @@ class _CounterChild:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Counter(_Metric):
@@ -123,7 +124,7 @@ class _GaugeChild:
 
     def __init__(self):
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("metrics.gauge")
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -138,7 +139,8 @@ class _GaugeChild:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Gauge(_Metric):
@@ -184,7 +186,7 @@ class _HistogramChild:
         self.counts = [0] * len(buckets)
         self.total = 0.0
         self.count = 0
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("metrics.histogram")
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -225,7 +227,7 @@ class Histogram(_Metric):
 
 class Registry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("metrics.registry")
         self._metrics: dict[str, _Metric] = {}
 
     def register(self, metric: _Metric) -> _Metric:

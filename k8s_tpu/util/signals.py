@@ -10,8 +10,9 @@ from __future__ import annotations
 import os
 import signal
 import threading
+from k8s_tpu.analysis import checkedlock
 
-_only_one = threading.Lock()
+_only_one = checkedlock.make_lock("signals.once")
 _installed = False
 _setup_called = False
 _callbacks: list = []

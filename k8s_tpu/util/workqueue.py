@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+from k8s_tpu.analysis import checkedlock
 import time
 from collections import deque
 from typing import Any, Hashable, Optional
@@ -37,7 +38,7 @@ class ItemExponentialFailureRateLimiter:
         self.base_delay = base_delay
         self.max_delay = max_delay
         self._failures: dict[Hashable, int] = {}
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("workqueue.backoff")
 
     def when(self, item: Hashable) -> float:
         with self._lock:
@@ -67,7 +68,7 @@ class BucketRateLimiter:
         self.burst = burst
         self._tokens = float(burst)
         self._last = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("workqueue.bucket")
 
     def when(self, item: Hashable) -> float:
         with self._lock:
@@ -123,7 +124,7 @@ def default_controller_rate_limiter() -> MaxOfRateLimiter:
 # (client-go's workqueue_queue_duration_seconds analogue).  Registered
 # lazily so importing this module never touches the metrics registry.
 _wait_histogram = None
-_wait_histogram_lock = threading.Lock()
+_wait_histogram_lock = checkedlock.make_lock("workqueue.wait_histogram")
 
 # Bench-measured queue waits span sub-ms (idle) to tens of seconds
 # (rate-limited backoff), so the default request-latency buckets clip
@@ -166,7 +167,7 @@ class WaitTracker:
     __slots__ = ("_lock", "_enqueued_at", "_waits")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = checkedlock.make_lock("workqueue.waits")
         self._enqueued_at: dict[Any, float] = {}
         self._waits: dict[Any, float] = {}
 
@@ -197,7 +198,7 @@ class WorkQueue:
     """FIFO queue with client-go dirty/processing dedup semantics."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = checkedlock.make_condition("workqueue.cond")
         self._queue: deque[Any] = deque()
         self._dirty: set[Any] = set()
         self._processing: set[Any] = set()
@@ -291,7 +292,7 @@ class DelayingQueue(WorkQueue):
         super().__init__()
         self._heap: list[tuple[float, int, Any]] = []
         self._seq = 0
-        self._timer_cond = threading.Condition()
+        self._timer_cond = checkedlock.make_condition("workqueue.timer")
         self._timer = threading.Thread(target=self._loop, daemon=True)
         self._timer.start()
 

@@ -1,0 +1,939 @@
+"""Concurrency auditor tier (ISSUE 10): the static passes must catch each
+seeded defect class, the committed allowlist must exactly cover the real
+tree, and the runtime checkedlock must detect cycles/self-deadlocks with
+both stacks while staying zero-instrumentation when off."""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from k8s_tpu.analysis import astutil, checkedlock, static
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _analyze(src: str, name: str = "mod.py") -> static.Report:
+    return static.analyze_source(textwrap.dedent(src), name)
+
+
+def _codes(report: static.Report) -> list[str]:
+    return [f.code for f in report.findings]
+
+
+# --- static: seeded defects ---------------------------------------------------
+
+
+class TestLockOrder:
+    def test_abba_cycle_with_both_witness_paths(self):
+        r = _analyze("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        self._grab_a()
+
+                def _grab_a(self):
+                    with self._a:
+                        pass
+        """)
+        assert "lock-order-cycle" in _codes(r)
+        msg = next(f for f in r.findings
+                   if f.code == "lock-order-cycle").message
+        # both edges of the cycle are witnessed, including the
+        # interprocedural one through the private helper
+        assert "S.forward" in msg
+        assert "S.backward -> S._grab_a" in msg
+
+    def test_consistent_order_is_clean(self):
+        r = _analyze("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        assert r.findings == []
+        assert len(r.edges) == 1
+
+    def test_nested_reacquire_of_plain_lock_is_self_deadlock(self):
+        r = _analyze("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """)
+        assert "lock-order-cycle" in _codes(r)
+
+    def test_rlock_reentry_is_fine(self):
+        r = _analyze("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """)
+        assert r.findings == []
+
+    def test_module_level_locks_participate(self):
+        r = _analyze("""
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def fwd():
+                with _a:
+                    with _b:
+                        pass
+
+            def bwd():
+                with _b:
+                    with _a:
+                        pass
+        """)
+        assert "lock-order-cycle" in _codes(r)
+
+    def test_module_lock_created_inside_a_toplevel_if_is_visible(self):
+        """rest.py builds _wire_profile_lock under `if WIRE_PROFILE_ENABLED:`
+        — an assignment in an ast.If body, not tree.body; the collector
+        must still see it or everything around that lock goes unanalyzed."""
+        r = _analyze("""
+            import os
+            import time
+            import threading
+
+            ENABLED = os.environ.get("X") == "1"
+            _lock = None
+            if ENABLED:
+                _lock = threading.Lock()
+
+            def slow():
+                with _lock:
+                    time.sleep(1.0)
+        """)
+        assert "blocking-under-lock" in _codes(r)
+
+    def test_aliased_factory_import_is_recognized(self):
+        """rest.py imports `checkedlock as _checkedlock`; the ctor match
+        is on the called name's last component, so the alias must not
+        hide the lock from the passes."""
+        r = _analyze("""
+            import time
+            from k8s_tpu.analysis import checkedlock as _checkedlock
+
+            _lock = _checkedlock.make_lock("wire")
+
+            def slow():
+                with _lock:
+                    time.sleep(1.0)
+        """)
+        assert "blocking-under-lock" in _codes(r)
+
+
+class TestGuardedBy:
+    def test_unguarded_read_of_locked_field(self):
+        r = _analyze("""
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def peek(self):
+                    return self.n
+        """)
+        assert _codes(r) == ["guarded-by"]
+        assert r.findings[0].qualifier == "T.n"
+
+    def test_mutator_call_counts_as_write(self):
+        r = _analyze("""
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+
+                def push(self, x):
+                    with self._lock:
+                        self.items.append(x)
+
+                def rogue(self, x):
+                    self.items.append(x)
+        """)
+        assert "guarded-by" in _codes(r)
+
+    def test_init_writes_are_exempt(self):
+        r = _analyze("""
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+        """)
+        assert r.findings == []
+
+    def test_locked_helper_inherits_entry_context(self):
+        # the _drain_locked idiom: private helper only called under the
+        # lock accesses guarded state without a false positive
+        r = _analyze("""
+            import threading
+
+            class U:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+
+                def push(self, x):
+                    with self._lock:
+                        self._push_locked(x)
+
+                def _push_locked(self, x):
+                    self.items.append(x)
+        """)
+        assert r.findings == []
+
+    def test_annotation_establishes_guard_without_locked_write(self):
+        r = _analyze("""
+            import threading
+
+            class V:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = "idle"  # guarded-by: _lock
+
+                def poke(self):
+                    self.state = "hot"
+        """)
+        assert _codes(r) == ["guarded-by"]
+
+    def test_unguarded_ok_annotation_suppresses(self):
+        r = _analyze("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.flag = False
+
+                def set(self):
+                    with self._lock:
+                        self.flag = True
+
+                def peek(self):
+                    # unguarded-ok: bool read is GIL-atomic
+                    return self.flag
+        """)
+        assert r.findings == []
+        assert any(s["code"] == "guarded-by" for s in r.suppressed)
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock(self):
+        r = _analyze("""
+            import threading, time
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """)
+        assert _codes(r) == ["blocking-under-lock"]
+
+    def test_transitive_blocking_through_helper(self):
+        r = _analyze("""
+            import threading, time
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._helper()
+
+                def _helper(self):
+                    time.sleep(0.1)
+        """)
+        found = [f for f in r.findings if f.code == "blocking-under-lock"]
+        assert found and "via" in found[0].message
+
+    def test_apiserver_chain_call_under_lock(self):
+        r = _analyze("""
+            import threading
+
+            class C:
+                def __init__(self, clientset):
+                    self._lock = threading.Lock()
+                    self.clientset = clientset
+
+                def sync(self, ns, pod):
+                    with self._lock:
+                        self.clientset.pods(ns).create(pod)
+        """)
+        assert "blocking-under-lock" in _codes(r)
+
+    def test_condition_wait_on_own_cond_is_exempt(self):
+        r = _analyze("""
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def loop(self):
+                    with self._cond:
+                        self._cond.wait()
+        """)
+        assert r.findings == []
+
+    def test_event_wait_under_lock_is_flagged(self):
+        r = _analyze("""
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.done = threading.Event()
+
+                def block(self):
+                    with self._lock:
+                        self.done.wait()
+        """)
+        assert "blocking-under-lock" in _codes(r)
+
+    def test_str_join_is_not_thread_join(self):
+        r = _analyze("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.parts = []
+
+                def render(self):
+                    with self._lock:
+                        return ", ".join(self.parts)
+        """)
+        assert r.findings == []
+
+    def test_lock_ok_annotation_suppresses(self):
+        r = _analyze("""
+            import threading, time
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    with self._lock:
+                        # lock-ok: deliberate serialization point
+                        time.sleep(0.1)
+        """)
+        assert r.findings == []
+        assert any(s["code"] == "blocking-under-lock"
+                   for s in r.suppressed)
+
+
+# --- allowlist ----------------------------------------------------------------
+
+
+class TestAllowlist:
+    def test_entry_without_reason_is_rejected(self, tmp_path):
+        p = tmp_path / "allow.txt"
+        p.write_text("guarded-by mod.py T.n\n")
+        with pytest.raises(static.AllowlistError):
+            static.load_allowlist(str(p))
+
+    def test_matching_entry_suppresses_and_stale_entry_fails(self, tmp_path):
+        src = textwrap.dedent("""
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def peek(self):
+                    return self.n
+        """)
+        tree = tmp_path / "pkg"
+        tree.mkdir()
+        (tree / "mod.py").write_text(src)
+        allow = tmp_path / "allow.txt"
+        allow.write_text(
+            "guarded-by pkg/mod.py T.n -- audited: torn read tolerated\n")
+        r = static.analyze_tree(str(tree), allowlist_path=str(allow),
+                                rel_base=str(tmp_path))
+        assert r.findings == []
+        assert any(s["qualifier"] == "T.n" for s in r.suppressed)
+        # the same entry against a clean tree is stale -> failure
+        (tree / "mod.py").write_text("x = 1\n")
+        r2 = static.analyze_tree(str(tree), allowlist_path=str(allow),
+                                 rel_base=str(tmp_path))
+        assert [f.code for f in r2.findings] == ["stale-allowlist"]
+
+    def test_spaced_qualifier_round_trips(self, tmp_path):
+        """Apiserver-verb blocking findings qualify as e.g.
+        'sync:apiserver .pods().create' — the qualifier contains a space
+        and must still be representable in the allowlist (everything
+        between the file and the '--')."""
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self, cs):
+                    self._lock = threading.Lock()
+                    self._cs = cs
+
+                def sync(self):
+                    with self._lock:
+                        self._cs.pods("ns").create({})
+        """)
+        tree = tmp_path / "pkg"
+        tree.mkdir()
+        (tree / "mod.py").write_text(src)
+        r = static.analyze_tree(str(tree), rel_base=str(tmp_path))
+        flagged = [f for f in r.findings if f.code == "blocking-under-lock"]
+        assert flagged and " " in flagged[0].qualifier
+        allow = tmp_path / "allow.txt"
+        allow.write_text(
+            f"blocking-under-lock pkg/mod.py {flagged[0].qualifier} "
+            "-- audited: create is bounded by the fake backend\n")
+        r2 = static.analyze_tree(str(tree), allowlist_path=str(allow),
+                                 rel_base=str(tmp_path))
+        assert r2.findings == []
+
+
+# --- self-audit ---------------------------------------------------------------
+
+
+class TestSelfAudit:
+    def test_real_tree_passes_with_committed_allowlist(self):
+        """The whole k8s_tpu tree is clean under the committed allowlist —
+        the same gate `py_checks --check lint` enforces in CI."""
+        root = os.path.join(REPO, "k8s_tpu")
+        allow = os.path.join(root, "analysis", "allowlist.txt")
+        report = static.analyze_tree(root, allowlist_path=allow,
+                                     rel_base=REPO)
+        assert report.findings == [], "\n".join(
+            str(f) for f in report.findings)
+        assert report.module_count > 100
+        assert report.lock_count > 30
+
+    def test_every_allowlist_entry_has_a_reason(self):
+        allow = os.path.join(REPO, "k8s_tpu", "analysis", "allowlist.txt")
+        for entry in static.load_allowlist(allow):
+            assert entry["reason"].strip()
+
+    def test_py_checks_lint_runs_the_analyzer(self, tmp_path):
+        from k8s_tpu.harness import py_checks
+
+        assert py_checks.run_concurrency(REPO, str(tmp_path))
+        assert (tmp_path / "junit_concurrency.xml").exists()
+        assert (tmp_path / "concurrency_report.json").exists()
+
+    def test_stdlib_only_carveout_allows_checkedlock(self):
+        from k8s_tpu.harness.py_checks import check_stdlib_only
+
+        src = (b"from k8s_tpu.analysis import checkedlock\n"
+               b"_lock = checkedlock.make_lock('x')\n")
+        assert check_stdlib_only("k8s_tpu/fleet/mod.py", source=src,
+                                 package="k8s_tpu.fleet") == []
+        bad = b"import numpy\n"
+        assert check_stdlib_only("k8s_tpu/fleet/mod.py", source=bad,
+                                 package="k8s_tpu.fleet")
+
+
+# --- shared AST utilities -----------------------------------------------------
+
+
+class TestAstUtil:
+    def test_noqa_shared_with_pylint_lite(self):
+        from k8s_tpu.harness import pylint_lite
+
+        assert pylint_lite._noqa_lines is astutil.noqa_lines
+        parsed = astutil.noqa_lines("x = 1  # noqa: F401, F841\ny = 2\n")
+        assert parsed == {1: {"f401", "f841"}}
+
+    def test_dotted_name(self):
+        import ast
+
+        expr = ast.parse("a.b.c").body[0].value
+        assert astutil.dotted_name(expr) == "a.b.c"
+        call = ast.parse("a.b().c").body[0].value
+        assert astutil.dotted_name(call) is None
+
+
+# --- regression tests for the hazards the analyzer surfaced ------------------
+
+
+class TestFixedHazards:
+    """Each real finding from the first analyzer run over k8s_tpu/ got a
+    fix; these pin the fixed behavior (the self-audit above pins that the
+    findings themselves stay gone)."""
+
+    def test_delete_collection_sleeps_outside_the_store_lock(self):
+        """delete_collection used to hold the store RLock across N inner
+        deletes, each sleeping the injected RTT — freezing every other
+        API call for N x RTT.  Reads must now proceed while the delete
+        wave sleeps."""
+        from k8s_tpu.client.fake import FakeCluster
+        from k8s_tpu.client.gvr import PODS
+
+        fc = FakeCluster()
+        for i in range(4):
+            fc.create(PODS, "ns", {"metadata": {"name": f"p{i}",
+                                                "namespace": "ns"}})
+        fc.delete_delay_s = 0.05
+        t = threading.Thread(
+            target=lambda: fc.delete_collection(PODS, "ns"))
+        t.start()
+        time.sleep(0.02)  # the wave is mid-sleep on some victim now
+        start = time.monotonic()
+        fc.list(PODS, "ns")
+        read_latency = time.monotonic() - start
+        t.join(5)
+        # with the old under-lock sleeps this read waited for the whole
+        # remaining wave (~0.2s); unlocked it's microseconds
+        assert read_latency < 0.04, read_latency
+        assert fc.list(PODS, "ns") == []
+
+    def test_cascade_gc_sleeps_outside_the_store_lock(self):
+        """Owner-reference GC issues its dependent deletes (each sleeping
+        delete_delay_s) after releasing the store lock."""
+        from k8s_tpu.client.fake import FakeCluster
+        from k8s_tpu.client.gvr import PODS, SERVICES
+
+        fc = FakeCluster()
+        owner = fc.create(PODS, "ns", {"metadata": {"name": "own",
+                                                    "namespace": "ns"}})
+        uid = owner["metadata"]["uid"]
+        for i in range(3):
+            fc.create(SERVICES, "ns", {"metadata": {
+                "name": f"dep{i}", "namespace": "ns",
+                "ownerReferences": [{"uid": uid}]}})
+        fc.delete_delay_s = 0.05
+        t = threading.Thread(target=lambda: fc.delete(PODS, "ns", "own"))
+        t.start()
+        time.sleep(0.08)  # owner gone; cascade mid-sleep
+        start = time.monotonic()
+        fc.list(PODS, "ns")
+        read_latency = time.monotonic() - start
+        t.join(5)
+        assert read_latency < 0.04, read_latency
+        assert fc.list(SERVICES, "ns") == []
+
+    def test_span_status_pair_never_tears(self):
+        """to_dict() snapshots status + status_message in set_error's own
+        critical section: a dict claiming status=error always carries
+        the message written with it."""
+        from k8s_tpu import trace
+
+        trace.configure(sample_rate=1.0)
+        try:
+            stop = threading.Event()
+            torn: list[dict] = []
+
+            def reader(span):
+                while not stop.is_set():
+                    d = span.to_dict()
+                    if d["status"] == "error" and not d.get(
+                            "status_message"):
+                        torn.append(d)
+
+            with trace.span("root") as span:
+                t = threading.Thread(target=reader, args=(span,))
+                t.start()
+                for i in range(200):
+                    span.set_error(RuntimeError(f"e{i}"))
+                stop.set()
+                t.join(5)
+            assert torn == []
+        finally:
+            trace.configure(sample_rate=0.0)
+
+    def test_fake_control_error_injection_is_read_under_lock(self):
+        """create/delete error injection still fires, and clear() racing
+        a create wave can't be half-observed (both read and write happen
+        under the control's lock now)."""
+        from k8s_tpu.controller_v2.control import FakePodControl
+
+        ctl = FakePodControl()
+        ctl.create_error = RuntimeError("boom")
+        with pytest.raises(RuntimeError):
+            ctl.create_pods_with_controller_ref(
+                "ns", {"metadata": {"name": "p"}}, {},
+                _owner_ref())
+        ctl.clear()
+        ctl.create_pods_with_controller_ref(
+            "ns", {"metadata": {"name": "p"}}, {}, _owner_ref())
+        assert len(ctl.templates) == 1
+
+    def test_metric_value_reads_locked(self):
+        from k8s_tpu.util.metrics import Counter, Gauge
+
+        c = Counter("t_total", "t")
+        c.inc(2)
+        assert c.value == 2
+        g = Gauge("t_gauge", "t")
+        g.set(3)
+        assert g.value == 3
+
+
+def _owner_ref():
+    from k8s_tpu.api.meta import OwnerReference
+
+    return OwnerReference(
+        api_version="kubeflow.org/v1alpha2", kind="TFJob", name="j",
+        uid="u", controller=True, block_owner_deletion=True)
+
+
+# --- runtime: checkedlock -----------------------------------------------------
+
+
+@pytest.fixture
+def lock_check(monkeypatch):
+    monkeypatch.setenv("K8S_TPU_LOCK_CHECK", "1")
+    checkedlock.reset()
+    yield
+    checkedlock._watchdog_hook = None
+    checkedlock.reset()
+
+
+class TestCheckedLockOff:
+    def test_factories_return_raw_primitives_when_off(self, monkeypatch):
+        monkeypatch.delenv("K8S_TPU_LOCK_CHECK", raising=False)
+        lock = checkedlock.make_lock("x")
+        rlock = checkedlock.make_rlock("x")
+        cond = checkedlock.make_condition("x")
+        assert type(lock) is type(threading.Lock())
+        assert type(rlock) is type(threading.RLock())
+        assert isinstance(cond, threading.Condition)
+        assert not isinstance(cond._lock, checkedlock._CheckedLock)
+
+    def test_off_means_zero_registry_growth(self, monkeypatch):
+        monkeypatch.delenv("K8S_TPU_LOCK_CHECK", raising=False)
+        checkedlock.reset()
+        for _ in range(10):
+            with checkedlock.make_lock("y"):
+                pass
+        snap = checkedlock.audit_snapshot()
+        assert snap["locks"] == {}
+        assert snap["edges"] == []
+
+
+class TestCheckedLockOn:
+    def test_cycle_raises_with_both_threads_stacks(self, lock_check):
+        a = checkedlock.make_lock("A")
+        b = checkedlock.make_lock("B")
+        barrier = threading.Barrier(2, timeout=5)
+        errors: list[BaseException] = []
+
+        def t1():
+            with a:
+                with b:
+                    barrier.wait()   # edge A->B is now recorded
+            barrier.wait()
+
+        def t2():
+            barrier.wait()           # wait until A->B exists
+            barrier.wait()           # and t1 released both
+            try:
+                with b:
+                    with a:
+                        pass
+            except checkedlock.LockOrderViolation as e:
+                errors.append(e)
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start(); th2.start()
+        th1.join(5); th2.join(5)
+        assert len(errors) == 1
+        msg = str(errors[0])
+        assert "this thread" in msg
+        assert "reverse edge" in msg and "A" in msg and "B" in msg
+
+    def test_self_deadlock_raises_immediately(self, lock_check):
+        lock = checkedlock.make_lock("L")
+        with pytest.raises(checkedlock.LockOrderViolation,
+                           match="self-deadlock"):
+            with lock:
+                lock.acquire()
+
+    def test_self_held_trylock_returns_false_like_raw_lock(self,
+                                                           lock_check):
+        """checkpoint._save_now's SIGTERM handler trylocks the lock the
+        interrupted interval save may hold, and SKIPS the final save on
+        False — the raw-Lock contract.  Only a BLOCKING same-thread
+        re-acquire is the self-deadlock the checker raises on."""
+        lock = checkedlock.make_lock("try")
+        with lock:
+            assert lock.acquire(blocking=False) is False
+        assert lock.acquire(blocking=False) is True
+        lock.release()
+
+    def test_rlock_reentry_allowed(self, lock_check):
+        r = checkedlock.make_rlock("R")
+        with r:
+            with r:
+                pass
+        assert checkedlock.audit_snapshot()["cycle_violations"] == 0
+
+    def test_condition_wait_releases_held_entry(self, lock_check,
+                                                monkeypatch):
+        monkeypatch.setenv("K8S_TPU_LOCK_MAX_HOLD_S", "0.2")
+        hits: list[dict] = []
+        checkedlock._watchdog_hook = hits.append
+        cond = checkedlock.make_condition("C")
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=1.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.6)  # well past the hold threshold
+        with cond:
+            cond.notify_all()
+        t.join(5)
+        assert [h for h in hits if h["lock"] == "C"] == []
+
+    def test_watchdog_fires_with_holder_stack(self, lock_check,
+                                              monkeypatch):
+        monkeypatch.setenv("K8S_TPU_LOCK_MAX_HOLD_S", "0.1")
+        hits: list[dict] = []
+        checkedlock._watchdog_hook = hits.append
+        hold = checkedlock.make_lock("H")
+        with hold:
+            deadline = time.monotonic() + 3.0
+            while not hits and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert hits and hits[0]["lock"] == "H"
+        assert hits[0]["held_s"] >= 0.1
+        assert "test_watchdog_fires" in hits[0]["stack"]
+
+    def test_audit_snapshot_counts(self, lock_check):
+        a = checkedlock.make_lock("a1")
+        b = checkedlock.make_lock("b1")
+        with a:
+            with b:
+                pass
+        snap = checkedlock.audit_snapshot()
+        assert snap["locks"]["a1"]["acquisitions"] == 1
+        assert {"from": "a1", "to": "b1", "count": 1} in snap["edges"]
+
+    def test_contention_counted(self, lock_check):
+        lock = checkedlock.make_lock("cont")
+        started = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                started.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        started.wait(5)
+        got = lock.acquire(blocking=False)
+        assert not got
+        release.set()
+        t.join(5)
+        assert checkedlock.audit_snapshot()["locks"]["cont"][
+            "contention"] >= 1
+
+    def test_write_audit_artifact(self, lock_check, tmp_path):
+        with checkedlock.make_lock("art"):
+            pass
+        out = tmp_path / "lock_audit.json"
+        snap = checkedlock.write_audit(str(out))
+        assert out.exists()
+        assert "art" in snap["locks"]
+
+    def test_trylock_never_waits_on_the_registry_lock(self, lock_check):
+        """acquire(blocking=False) must stay non-blocking even while the
+        process-global bookkeeping lock is held — checkpoint._save_now
+        trylocks from the SIGTERM handler, which may interrupt a thread
+        INSIDE a registry critical section; waiting there would wedge the
+        handler for the whole grace window."""
+        lock = checkedlock.make_lock("sigsafe")
+        done = threading.Event()
+        result = []
+
+        def handler_path():
+            got = lock.acquire(blocking=False)
+            if got:
+                lock.release()
+            result.append(got)
+            done.set()
+
+        checkedlock._registry_lock.acquire()
+        try:
+            t = threading.Thread(target=handler_path)
+            t.start()
+            assert done.wait(2), \
+                "trylock blocked on the held registry lock"
+        finally:
+            checkedlock._registry_lock.release()
+        t.join(5)
+        assert result == [True]
+
+    def test_finalize_forget_never_waits_on_the_registry_lock(
+            self, lock_check):
+        """_forget_node runs as a weakref.finalize callback, which GC can
+        fire on a thread already inside a registry critical section; it
+        must defer instead of blocking on the non-reentrant lock."""
+        lock = checkedlock.make_lock("doomed")
+        node_id = id(lock)
+        done = threading.Event()
+
+        def finalize_path():
+            # simulates GC collecting a checked lock while the registry
+            # lock is held elsewhere (or by this very thread's frame)
+            checkedlock._forget_node(node_id, "doomed")
+            done.set()
+
+        checkedlock._registry_lock.acquire()
+        try:
+            t = threading.Thread(target=finalize_path)
+            t.start()
+            assert done.wait(2), \
+                "finalize callback blocked on the held registry lock"
+        finally:
+            checkedlock._registry_lock.release()
+        t.join(5)
+        # the deferred forget drains on the next registry pass
+        checkedlock.audit_snapshot()
+        assert node_id not in checkedlock._nodes
+
+    def test_blocking_acquire_from_a_registry_frame_cannot_deadlock(
+            self, lock_check):
+        """signals.py runs shutdown callbacks ON the interrupted thread: a
+        SIGTERM can land while that thread is inside a registry critical
+        section, and a callback doing `with some_checked_lock:` (e.g. the
+        engine close path) re-enters checkedlock.  The blocking acquire —
+        and the paired release — must skip bookkeeping best-effort instead
+        of waiting forever on the non-reentrant registry lock this
+        thread's own interrupted frame holds."""
+        lock = checkedlock.make_lock("handler-blocking")
+        assert checkedlock._registry_acquire()
+        try:
+            # this thread now owns the registry lock, exactly like an
+            # interrupted bookkeeping frame; pre-fix this deadlocked here
+            with lock:
+                pass
+        finally:
+            checkedlock._registry_release()
+        # normal tracked acquisitions work again afterwards
+        with lock:
+            pass
+        assert checkedlock.audit_snapshot()["locks"][
+            "handler-blocking"]["acquisitions"] >= 1
+
+    def test_release_after_reset_does_not_leak_the_lock(self, lock_check):
+        """reset() drops the stats rows while lock instances stay alive;
+        a later release() must re-seed rather than KeyError (which would
+        return before the inner release and wedge the lock forever)."""
+        lock = checkedlock.make_lock("survivor")
+        lock.acquire()
+        checkedlock.reset()
+        lock.release()  # must not raise
+        assert lock.acquire(timeout=1)
+        lock.release()
+
+    def test_lock_audit_written_when_a_scenario_raises(self, lock_check,
+                                                       tmp_path,
+                                                       monkeypatch):
+        """--lock-audit-out promises the artifact on FAILED runs too (a
+        cycle violation raising inside a scenario is exactly the run
+        worth auditing): main() must land lock_audit.json before the
+        scenario's exception propagates."""
+        from k8s_tpu.harness import bench_operator
+
+        def boom(args):
+            raise RuntimeError("scenario exploded")
+
+        monkeypatch.setattr(bench_operator, "run_churn", boom)
+        out = tmp_path / "lock_audit.json"
+        with pytest.raises(RuntimeError, match="scenario exploded"):
+            bench_operator.main([
+                "--churn", "--churn-jobs", "1",
+                "--lock-audit-out", str(out)])
+        assert out.exists()
+        assert json.loads(out.read_text())["enabled"] is True
+
+    def test_hot_path_factories_produce_checked_locks(self, lock_check):
+        """The normalized control-plane constructors create checked
+        wrappers under K8S_TPU_LOCK_CHECK=1 (the conversion satellite)."""
+        from k8s_tpu.controller_v2.expectations import ControllerExpectations
+        from k8s_tpu.util import workqueue as wq
+
+        exp = ControllerExpectations()
+        assert isinstance(exp._lock, checkedlock._CheckedLock)
+        q = wq.WorkQueue()
+        assert isinstance(q._cond, threading.Condition)
+        assert isinstance(q._cond._lock, checkedlock._CheckedLock)
+        q.shut_down()

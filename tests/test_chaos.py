@@ -9,8 +9,11 @@ the failure story is tests/test_restart_semantics.py.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+import pytest
 
 from k8s_tpu.client.clientset import Clientset
 from k8s_tpu.client.fake import FakeCluster
@@ -19,6 +22,21 @@ from k8s_tpu.e2e.components import core_component
 from k8s_tpu.e2e.local import LocalCluster
 
 NS = "default"
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_check_enabled():
+    """Chaos e2e runs under the runtime deadlock detector (ISSUE 10):
+    the operator/cluster objects built per test create checkedlock
+    wrappers, so a lock-order cycle forming while pods are deleted out
+    from under the reconciler raises with both threads' stacks."""
+    old = os.environ.get("K8S_TPU_LOCK_CHECK")
+    os.environ["K8S_TPU_LOCK_CHECK"] = "1"
+    yield
+    if old is None:
+        os.environ.pop("K8S_TPU_LOCK_CHECK", None)
+    else:
+        os.environ["K8S_TPU_LOCK_CHECK"] = old
 
 
 def _slow_ok_command(runtime_s: float = 0.4) -> list[str]:

@@ -38,6 +38,24 @@ from k8s_tpu.util import workqueue as wq_mod
 KEYS = [f"ns/job-{i}" for i in range(16)]
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_check_enabled():
+    """This tier gates the runtime deadlock detector (ISSUE 10,
+    docs/static_analysis.md): queues/expectations/informers built while
+    these tests run get checkedlock wrappers recording the live
+    acquisition DAG — a lock-order cycle or self-deadlock forming under
+    the thread storms raises with both threads' stacks and fails the
+    test.  The ci stress tier additionally sets the env for the whole
+    process so module-level locks are covered too."""
+    old = os.environ.get("K8S_TPU_LOCK_CHECK")
+    os.environ["K8S_TPU_LOCK_CHECK"] = "1"
+    yield
+    if old is None:
+        os.environ.pop("K8S_TPU_LOCK_CHECK", None)
+    else:
+        os.environ["K8S_TPU_LOCK_CHECK"] = old
+
+
 def _make_queue(impl):
     if impl == "python":
         return wq_mod.RateLimitingQueue(
