@@ -1,6 +1,6 @@
-"""Concurrency auditor for the control plane (ISSUE 10).
+"""Concurrency + compile-surface auditors for the tree (ISSUEs 10, 11).
 
-Two halves, both stdlib-only:
+Four halves, all stdlib-only:
 
 - :mod:`k8s_tpu.analysis.static` — an AST pass over the whole ``k8s_tpu``
   tree that builds an interprocedural lock acquisition-order graph per
@@ -15,12 +15,28 @@ Two halves, both stdlib-only:
   formation with both threads' stacks, runs a held-too-long watchdog,
   and emits a ``lock_audit.json`` artifact.  Zero overhead when off
   (the factories return raw ``threading`` primitives).
+- :mod:`k8s_tpu.analysis.compilesurface` — the static compile-surface
+  pass (ISSUE 11): per-request ``jax.jit`` constructions without a
+  memoizing program-table idiom, Python branches on traced arguments
+  lacking a covering ``static_argnums`` entry, host-device sync points
+  reached from the engine's step loop or under a lock, and swallowing
+  broad exception handlers.  Same lint tier, same reason-mandatory
+  stale-entries-fail allowlist contract (``compile_allowlist.txt``).
+- :mod:`k8s_tpu.analysis.compileledger` — the runtime XLA compile
+  ledger (``K8S_TPU_COMPILE_LEDGER=1``, ``set_active``/``active()``
+  registry): every compile recorded with fingerprint + wall time +
+  stack via a ``jax.monitoring`` listener (the consumer passes the
+  module in, so this package never imports jax) or the wrapped jit's
+  cache-size delta; seams declare compile budgets and a recompile past
+  budget raises ``CompileBudgetExceeded``.  ``/debug/compiles`` on the
+  metrics server, dashboard, and serving pod; ``compile_audit.json``
+  from the bench tier.
 
 See docs/static_analysis.md for annotation and allowlist syntax.
 
 No eager submodule imports here: ~25 hot-path modules import
 ``checkedlock`` at startup, and they must not drag the whole static
 analyzer (CI-only machinery) into every operator/bench process —
-consumers import ``k8s_tpu.analysis.static`` / ``.checkedlock``
-directly.
+consumers import ``k8s_tpu.analysis.static`` / ``.checkedlock`` /
+``.compilesurface`` / ``.compileledger`` directly.
 """

@@ -1,9 +1,11 @@
-"""CLI: run the static concurrency analyzer over a tree.
+"""CLI: run the static analyzers over a tree.
 
-    python -m k8s_tpu.analysis [--root k8s_tpu] [--allowlist ...] [--json out]
+    python -m k8s_tpu.analysis [--check concurrency|compile-surface|all]
+                               [--root k8s_tpu] [--allowlist ...]
+                               [--compile-allowlist ...] [--json out]
 
-Exit 0 when clean (after allowlist), 1 when findings remain.  The lint CI
-tier invokes the same entry through :mod:`k8s_tpu.harness.py_checks`.
+Exit 0 when clean (after allowlists), 1 when findings remain.  The lint
+CI tier invokes the same passes through :mod:`k8s_tpu.harness.py_checks`.
 """
 
 from __future__ import annotations
@@ -13,37 +15,78 @@ import json
 import os
 import sys
 
-from k8s_tpu.analysis import static
-
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 DEFAULT_ALLOWLIST = os.path.join(
     REPO_ROOT, "k8s_tpu", "analysis", "allowlist.txt")
+DEFAULT_COMPILE_ALLOWLIST = os.path.join(
+    REPO_ROOT, "k8s_tpu", "analysis", "compile_allowlist.txt")
+
+
+def _resolve(path: str | None) -> str | None:
+    if path in (None, "none"):
+        return None
+    return path if os.path.exists(path) else None
+
+
+def _dump(report_dict: dict, path: str | None) -> None:
+    if not path:
+        return
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report_dict, f, indent=1, sort_keys=True)
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--check",
+                   choices=["concurrency", "compile-surface", "all"],
+                   default="all")
     p.add_argument("--root", default=os.path.join(REPO_ROOT, "k8s_tpu"))
     p.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
-                   help="audited-exemption file; 'none' disables")
+                   help="concurrency audited-exemption file; "
+                   "'none' disables")
+    p.add_argument("--compile-allowlist",
+                   default=DEFAULT_COMPILE_ALLOWLIST,
+                   help="compile-surface audited-exemption file; "
+                   "'none' disables")
     p.add_argument("--json", default=None,
-                   help="write the full report JSON here")
+                   help="write the full report JSON here (one combined "
+                   "object keyed by check)")
     args = p.parse_args(argv)
-    allowlist = None if args.allowlist == "none" else (
-        args.allowlist if os.path.exists(args.allowlist) else None)
-    report = static.analyze_tree(args.root, allowlist_path=allowlist)
-    if args.json:
-        os.makedirs(os.path.dirname(os.path.abspath(args.json)),
-                    exist_ok=True)
-        with open(args.json, "w", encoding="utf-8") as f:
-            json.dump(report.as_dict(), f, indent=1, sort_keys=True)
-    for f in report.findings:
-        print(str(f), file=sys.stderr)
-    print(f"[analysis] {report.module_count} modules, {report.lock_count} "
-          f"locks, {len(report.edges)} order edges, "
-          f"{len(report.findings)} findings, "
-          f"{len(report.suppressed)} suppressed")
-    return 0 if report.ok else 1
+
+    ok = True
+    combined: dict[str, dict] = {}
+    if args.check in ("concurrency", "all"):
+        from k8s_tpu.analysis import static
+
+        report = static.analyze_tree(
+            args.root, allowlist_path=_resolve(args.allowlist))
+        combined["concurrency"] = report.as_dict()
+        for f in report.findings:
+            print(str(f), file=sys.stderr)
+        print(f"[analysis] {report.module_count} modules, "
+              f"{report.lock_count} locks, {len(report.edges)} order "
+              f"edges, {len(report.findings)} findings, "
+              f"{len(report.suppressed)} suppressed")
+        ok = report.ok and ok
+    if args.check in ("compile-surface", "all"):
+        from k8s_tpu.analysis import compilesurface
+
+        report = compilesurface.analyze_tree(
+            args.root, allowlist_path=_resolve(args.compile_allowlist))
+        combined["compile_surface"] = report.as_dict()
+        for f in report.findings:
+            print(str(f), file=sys.stderr)
+        print(f"[compile-surface] {report.module_count} modules, "
+              f"{len(report.jit_sites)} jit sites, "
+              f"{len(report.wrappers)} wrappers, "
+              f"{len(report.hot_functions)} hot functions, "
+              f"{len(report.findings)} findings, "
+              f"{len(report.suppressed)} suppressed")
+        ok = report.ok and ok
+    _dump(combined, args.json)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -500,6 +500,7 @@ def _watchdog_loop():
             if hook is not None:
                 try:
                     hook(hit)
+                # except-ok: a broken test hook must not kill the watchdog
                 except Exception:
                     pass
             print(f"[checkedlock] WATCHDOG: {lock_name!r} held "

@@ -1,0 +1,483 @@
+"""Runtime XLA compile ledger with per-seam budgets (ISSUE 11).
+
+The runtime half of the compile-surface auditor: a process-global
+:class:`CompileLedger` that records every XLA compile — fingerprint
+(function name, abstract arg shapes/dtypes, static-arg values), wall
+time, and originating stack — attributed to the *seam* (jit entry
+point) that triggered it, and raises :class:`CompileBudgetExceeded`
+when a seam compiles more distinct programs than its declared budget
+(the engine declares its expected inventory: one prefill program per
+bucket, one decode program per (fused width, sampling) pair, one spec
+program per (draft_k, sampling) pair, a whole-generation table bound).
+
+Activation mirrors ``trace``/``scheduler``/``flight``/``fleet``:
+``K8S_TPU_COMPILE_LEDGER=1`` plus the :func:`set_active`/:func:`active`
+process-global registry; a zero-overhead no-op when unset (consumers
+check ``active() is None`` and use their raw jit functions).
+
+Compile *detection* has two sources, in preference order:
+
+1. a ``jax.monitoring`` event-duration listener on the backend-compile
+   event — the consumer passes the ``jax.monitoring`` module into
+   :func:`ensure_listener` so this module stays **stdlib-only** (the
+   ``py_checks`` gate on ``k8s_tpu.analysis`` holds; the jax import
+   lives with the jax-importing caller).  The listener is installed
+   once per process (jax offers no per-listener removal) and
+   dispatches to the wrap context / active ledger at event time.
+2. wrapping ``jax.jit`` returns: :meth:`CompileLedger.wrap` falls back
+   to the jitted function's ``_cache_size()`` delta when no listener
+   event arrived (older jax, or a non-jit callable under test).
+
+Served at ``/debug/compiles`` on the metrics server, the dashboard
+backend, and the serving pod's HTTP server (the shared-responder /
+404-parity pattern), and exported as the ``compile_audit.json`` bench
+artifact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import traceback
+from collections import deque
+from collections.abc import Mapping
+from typing import Callable, Optional
+from urllib.parse import parse_qs
+
+from k8s_tpu.analysis import checkedlock
+
+ENV_ENABLE = "K8S_TPU_COMPILE_LEDGER"
+
+#: the jax.monitoring event one XLA backend compile records
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: default budget for the engine's (draft_k, sampling) spec seam — the
+#: draft width is client-chosen, so a flood of distinct values is
+#: exactly the compile-surface DoS a budget should catch
+DEFAULT_SPEC_BUDGET = 8
+
+#: recent compile events kept for /debug/compiles (per ledger)
+EVENTS_MAX = 512
+
+#: stack frames kept per fingerprint witness
+STACK_FRAMES = 10
+
+
+def enabled_from_env() -> bool:
+    """K8S_TPU_COMPILE_LEDGER: truthy activates the ledger (default off
+    — the zero-overhead compatibility default)."""
+    return os.environ.get(ENV_ENABLE, "").lower() in ("1", "true", "on",
+                                                      "yes")
+
+
+class CompileBudgetExceeded(RuntimeError):
+    """A seam compiled more distinct XLA programs than it declared."""
+
+    def __init__(self, seam_name: str, budget: int, count: int,
+                 fingerprint: str, stack: Optional[str]):
+        msg = (f"compile budget exceeded for seam {seam_name!r}: "
+               f"{count} distinct programs > budget {budget}; offending "
+               f"fingerprint: {fingerprint}")
+        if stack:
+            msg += f"\ncompiled from:\n{stack}"
+        super().__init__(msg)
+        self.seam_name = seam_name
+        self.budget = budget
+        self.count = count
+        self.fingerprint = fingerprint
+        self.stack = stack
+
+
+class _Seam:
+    """One declared jit entry point: its budget and the distinct
+    program fingerprints observed compiling through it.  Mutated only
+    under the owning ledger's lock."""
+
+    def __init__(self, name: str, budget: Optional[int], note: str):
+        self.name = name
+        self.budget = budget
+        self.note = note
+        # fingerprint -> {count, duration_s, stack}
+        self.fingerprints: dict[str, dict] = {}
+        self.compiles = 0
+
+    def snapshot(self) -> dict:
+        programs = len(self.fingerprints)
+        return {"seam": self.name, "budget": self.budget,
+                "programs": programs, "compiles": self.compiles,
+                "over_budget": self.budget is not None
+                and programs > self.budget}
+
+
+# thread-local wrap context: a pending-durations list the monitoring
+# listener appends to while a wrapped call is on this thread's stack
+_tls = threading.local()
+
+
+def caller_stack(skip: int = 2) -> str:
+    """The originating stack, trimmed of this module's and jax's own
+    frames — what a human needs to find the recompiling call site.
+    Public: seams that record by hand (the server's whole-generation
+    accounting) attach the same witness the wrap path does."""
+    frames = traceback.extract_stack()[:-skip]
+    keep = [f for f in frames
+            if "/jax/" not in f.filename.replace(os.sep, "/")
+            and "/jaxlib/" not in f.filename.replace(os.sep, "/")
+            and not f.filename.endswith("compileledger.py")]
+    return "".join(traceback.format_list(keep[-STACK_FRAMES:])).rstrip()
+
+
+_caller_stack = caller_stack
+
+
+def _spec(x) -> str:
+    """Abstract-value summary of one argument: shape/dtype for arrays,
+    recursive structure for pytrees, the bare type otherwise — the
+    shape identity that decides whether jit retraces."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if isinstance(x, Mapping):
+        inner = ",".join(f"{k}:{_spec(v)}" for k, v in
+                         sorted(x.items(), key=lambda kv: str(kv[0])))
+        return _digest("{" + inner + "}")
+    if isinstance(x, (list, tuple)):
+        return _digest("(" + ",".join(_spec(v) for v in x) + ")")
+    if x is None or isinstance(x, (bool, int, float, complex, str)):
+        return type(x).__name__
+    return type(x).__name__
+
+
+def _digest(s: str) -> str:
+    """Large pytree specs collapse to a stable digest so fingerprints
+    stay greppable (identical trees -> identical digest)."""
+    if len(s) <= 48:
+        return s
+    return f"tree#{hashlib.md5(s.encode()).hexdigest()[:10]}"
+
+
+def _static_repr(v) -> str:
+    r = repr(v)
+    return r if len(r) <= 48 else r[:45] + "..."
+
+
+def fingerprint(name: str, args: tuple, kwargs: dict,
+                static_argnums: tuple = (), static_argnames: tuple = (),
+                context: tuple = ()) -> str:
+    """The program identity of one call: traced args by abstract
+    shape/dtype, static args by VALUE (they select the program), plus
+    any caller-supplied context pairs."""
+    statics = set(static_argnums)
+    parts = []
+    for i, a in enumerate(args):
+        parts.append(_static_repr(a) if i in statics else _spec(a))
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        parts.append(f"{k}={_static_repr(v) if k in static_argnames else _spec(v)}")
+    tail = "".join(f"; {k}={_static_repr(v)}" for k, v in context)
+    return f"{name}({', '.join(parts)}{tail})"
+
+
+class CompileLedger:
+    """Thread-safe record of every observed XLA compile, grouped by
+    seam and fingerprint, with per-seam budget enforcement."""
+
+    def __init__(self, events_max: int = EVENTS_MAX):
+        self._lock = checkedlock.make_lock("compileledger.registry")
+        self._seams: list[_Seam] = []
+        self._events: deque[dict] = deque(maxlen=events_max)
+        self._unattributed: Optional[_Seam] = None
+        self.created_at = time.time()
+
+    # -- declaration --------------------------------------------------
+
+    def declare(self, name: str, budget: Optional[int], note: str = "",
+                singleton: bool = False) -> _Seam:
+        """Declare a seam and its program budget (None = tracked,
+        unbudgeted).  ``singleton=True`` returns the existing seam of
+        that name (module-level seams like the whole-generation table);
+        the default creates a fresh instance per declaration (each
+        engine owns its own seam handles, so two engines in one
+        process don't pool their budgets)."""
+        with self._lock:
+            if singleton:
+                for s in self._seams:
+                    if s.name == name:
+                        return s
+            seam = _Seam(name, budget, note)
+            self._seams.append(seam)
+            return seam
+
+    def _unattributed_seam(self) -> _Seam:
+        with self._lock:
+            if self._unattributed is None:
+                seam = _Seam("(unattributed)", None,
+                             "compiles observed outside any wrapped seam "
+                             "(warmup, eager dispatch, exclusive-lane "
+                             "programs not yet wrapped)")
+                self._seams.append(seam)
+                self._unattributed = seam
+            return self._unattributed
+
+    # -- recording ----------------------------------------------------
+
+    def record(self, seam: _Seam, fp: str, duration_s: float,
+               stack: Optional[str] = None) -> None:
+        """One observed compile.  Raises :class:`CompileBudgetExceeded`
+        (after recording — the ledger never loses the evidence) when
+        the seam's distinct-program count passes its budget."""
+        over = None
+        with self._lock:
+            info = seam.fingerprints.get(fp)
+            if info is None:
+                info = seam.fingerprints[fp] = {
+                    "count": 0, "duration_s": 0.0, "stack": None}
+            info["count"] += 1
+            info["duration_s"] = round(info["duration_s"] + duration_s, 6)
+            if stack:
+                info["stack"] = stack
+            seam.compiles += 1
+            self._events.append({
+                "ts": round(time.time(), 3), "seam": seam.name,
+                "fingerprint": fp, "duration_s": round(duration_s, 6)})
+            if seam.budget is not None and \
+                    len(seam.fingerprints) > seam.budget:
+                over = (seam.name, seam.budget, len(seam.fingerprints))
+        if over is not None:
+            raise CompileBudgetExceeded(over[0], over[1], over[2], fp,
+                                        stack)
+
+    # -- the jit wrap -------------------------------------------------
+
+    def wrap(self, fn: Callable, seam: _Seam, *, name: str | None = None,
+             static_argnums: tuple = (), static_argnames: tuple = (),
+             context: Optional[dict] = None) -> Callable:
+        """Wrap a jitted callable so every compile it triggers lands in
+        the ledger under ``seam`` with a full fingerprint.  Detection:
+        monitoring-listener events drained from the wrap context when
+        the listener is installed, else the jitted function's
+        ``_cache_size()`` delta."""
+        label = name or getattr(fn, "__name__", "<jit>")
+        ctx_items = tuple(sorted((context or {}).items()))
+        statics = tuple(static_argnums)
+        static_names = tuple(static_argnames)
+        cache_size = getattr(fn, "_cache_size", None)
+
+        def wrapped(*args, **kwargs):
+            # the fingerprint walks every arg pytree (params, pool...) —
+            # compute it LAZILY, only when a compile was detected: the
+            # steady-state per-step cost of the wrap must stay at a tls
+            # swap + a cache-size read, or the ledger taxes the very hot
+            # loop it audits
+            pending: list[float] = []
+            prev = getattr(_tls, "pending", None)
+            _tls.pending = pending
+            before = None
+            if cache_size is not None:
+                try:
+                    before = cache_size()
+                except Exception:  # noqa: BLE001 - diagnostic seam only
+                    before = None
+            t0 = time.perf_counter()
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                _tls.pending = prev
+            if pending:
+                fp = fingerprint(label, args, kwargs, statics,
+                                 static_names, ctx_items)
+                stack = _caller_stack()
+                for dur in pending:
+                    self.record(seam, fp, dur, stack)
+            elif before is not None:
+                try:
+                    after = cache_size()
+                except Exception:  # noqa: BLE001
+                    after = before
+                if after > before:
+                    fp = fingerprint(label, args, kwargs, statics,
+                                     static_names, ctx_items)
+                    self.record(seam, fp, time.perf_counter() - t0,
+                                _caller_stack())
+            return out
+
+        wrapped.__wrapped__ = fn
+        wrapped.__name__ = f"ledgered_{label}"
+        return wrapped
+
+    # -- reads --------------------------------------------------------
+
+    def seams(self) -> list[dict]:
+        with self._lock:
+            return [s.snapshot() for s in self._seams]
+
+    def seam_programs(self, name: str) -> int:
+        """Distinct programs across every seam instance of ``name``."""
+        with self._lock:
+            return sum(len(s.fingerprints) for s in self._seams
+                       if s.name == name)
+
+    def seam_audit(self, seams: list) -> dict:
+        """One consumer's seam handles as an audit payload: snapshots
+        plus the over-budget subset — what ``Engine.compile_audit()``
+        returns and the bench phases assert on."""
+        with self._lock:
+            snaps = [s.snapshot() for s in seams]
+        return {"seams": snaps,
+                "programs": sum(s["programs"] for s in snaps),
+                "compiles": sum(s["compiles"] for s in snaps),
+                "over_budget": [s["seam"] for s in snaps
+                                if s["over_budget"]]}
+
+    def as_dict(self, stacks: bool = True) -> dict:
+        """The compile_audit.json payload: per-seam budgets and
+        per-fingerprint counts/durations/stacks plus the recent-event
+        ring."""
+        with self._lock:
+            seams = []
+            for s in self._seams:
+                fps = []
+                for fp, info in sorted(s.fingerprints.items()):
+                    row = {"fingerprint": fp, "count": info["count"],
+                           "duration_s": info["duration_s"]}
+                    if stacks and info["stack"]:
+                        row["stack"] = info["stack"]
+                    fps.append(row)
+                seams.append({**s.snapshot(), "note": s.note,
+                              "fingerprints": fps})
+            return {
+                "enabled": True,
+                "seams": seams,
+                "total_compiles": sum(s.compiles for s in self._seams),
+                "total_programs": sum(len(s.fingerprints)
+                                      for s in self._seams),
+                "over_budget": [s.name for s in self._seams
+                                if s.budget is not None
+                                and len(s.fingerprints) > s.budget],
+                "events": list(self._events),
+            }
+
+
+# -- process-global active ledger (trace.TRACER / fleet pattern) --------------
+
+_ACTIVE: Optional[CompileLedger] = None
+
+
+def set_active(ledger: Optional[CompileLedger]) -> None:
+    global _ACTIVE
+    _ACTIVE = ledger
+
+
+def active() -> Optional[CompileLedger]:
+    return _ACTIVE
+
+
+def maybe_active() -> Optional[CompileLedger]:
+    """The active ledger, auto-created on first use when
+    ``K8S_TPU_COMPILE_LEDGER`` is set — the activation seam consumers
+    (the engine, the exclusive decode lane) call at construction."""
+    global _ACTIVE
+    if _ACTIVE is None and enabled_from_env():
+        _ACTIVE = CompileLedger()
+    return _ACTIVE
+
+
+# -- the jax.monitoring listener ----------------------------------------------
+
+_listener_state = {"installed": False}
+
+
+def _on_event(event: str, duration_secs: float, **kwargs) -> None:
+    """One backend compile happened on this thread.  Inside a wrapped
+    call: park the duration for the wrapper to attribute (and to raise
+    budget violations OUTSIDE jax's compilation machinery).  Outside:
+    record unattributed against the active ledger, never raising."""
+    del kwargs
+    if event != COMPILE_EVENT:
+        return
+    pending = getattr(_tls, "pending", None)
+    if pending is not None:
+        pending.append(duration_secs)
+        return
+    ledger = _ACTIVE
+    if ledger is None:
+        return
+    ledger.record(ledger._unattributed_seam(), "(unattributed)",
+                  duration_secs, _caller_stack())
+
+
+def ensure_listener(monitoring) -> bool:
+    """Install the compile-event listener once per process.  The caller
+    passes the ``jax.monitoring`` module — this module never imports
+    jax, so the ``k8s_tpu.analysis`` stdlib-only gate holds.  Returns
+    True when a listener is (now) installed."""
+    if _listener_state["installed"]:
+        return True
+    if monitoring is None:
+        return False
+    try:
+        monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception:  # noqa: BLE001 - older jax: wrap fallback covers it
+        return False
+    _listener_state["installed"] = True
+    return True
+
+
+def listener_installed() -> bool:
+    return _listener_state["installed"]
+
+
+# -- /debug/compiles ----------------------------------------------------------
+
+
+def debug_compiles_response(query: str = "") -> tuple[int, str, str]:
+    """(status, body, content-type) for GET /debug/compiles — the ONE
+    responder the metrics server, the dashboard backend, and the
+    serving pod's HTTP server all route to (404 with an explicit body
+    while no ledger is active, like every other /debug route)."""
+    ledger = _ACTIVE
+    if ledger is None:
+        return (404,
+                "compile ledger inactive (set K8S_TPU_COMPILE_LEDGER=1 so "
+                "the engine/decode seams record XLA compiles)\n",
+                "text/plain")
+    params = parse_qs(query or "")
+    seam_filter = (params.get("seam") or [None])[0]
+    raw_n = (params.get("n") or [None])[0]
+    try:
+        limit = int(raw_n) if raw_n is not None else None
+    except ValueError:
+        limit = None
+    # ?stacks=0 drops the per-fingerprint origin stacks (the payload-cap
+    # knob docs/observability.md documents); default includes them.
+    # parse_qs drops blank-valued keys, so a bare "?stacks" reads as the
+    # default too — the VALUE decides, never key presence.
+    raw_stacks = (params.get("stacks") or ["1"])[0]
+    payload = ledger.as_dict(
+        stacks=raw_stacks.lower() not in ("0", "false", "no", "off"))
+    if seam_filter:
+        payload["seams"] = [s for s in payload["seams"]
+                            if s["seam"] == seam_filter]
+    if limit is not None and limit >= 0:
+        payload["events"] = payload["events"][-limit:] if limit else []
+        for s in payload["seams"]:
+            s["fingerprints"] = s["fingerprints"][:limit]
+    body = json.dumps(payload, indent=2, sort_keys=True)
+    return 200, body + "\n", "application/json"
+
+
+def write_audit(path: str) -> dict:
+    """Write the active ledger's audit JSON artifact (compile_audit.json
+    from the bench tier); returns the payload ({} when inactive)."""
+    ledger = _ACTIVE
+    payload = ledger.as_dict() if ledger is not None else {
+        "enabled": False, "seams": [], "total_compiles": 0,
+        "total_programs": 0, "over_budget": [], "events": []}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+    return payload

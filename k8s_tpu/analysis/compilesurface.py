@@ -1,0 +1,817 @@
+"""Static compile-surface analysis for the serving stack (ISSUE 11).
+
+The engine's throughput story rests on a hand-maintained compile
+discipline — bucketed prefill programs, power-of-two fused decode
+widths, (W, sampling) spec triples — and on keeping host-device syncs
+out of the step loop.  This pass *enforces* that discipline the way
+:mod:`k8s_tpu.analysis.static` enforces lock discipline: four AST
+sub-passes over the tree, gated in the ``py_checks`` lint tier.
+
+- **jit-surface** (``jit-per-call`` / ``jit-in-loop``): every
+  ``jax.jit``/``pjit`` construction site is classified.  OK classes:
+  module/import time, ``__init__`` construction, an
+  ``functools.lru_cache``-decorated builder, a function carrying the
+  memoizing *program-table* idiom (a mapping read — ``self.X.get`` /
+  ``in self.X`` — plus a store to the same table; the engine's
+  ``_prefill_fns`` copy-on-write rebind is the model), or a *factory*
+  whose jit escapes through a ``return`` / returned closure.  A jit
+  constructed per plain call, or any jit (or factory call) inside a
+  ``for``/``while`` body, is a finding: a fresh program per request is
+  exactly the recompile tax the engine exists to avoid.
+- **uncovered-traced-branch**: for each resolvable
+  ``jax.jit(target, static_argnums=..., static_argnames=...)`` wrapper
+  (bound methods drop ``self``), Python ``if``/``while``/``for``/
+  ternary tests inside the target (and its nested scopes, with
+  shadowing respected) must not branch on a parameter that is *traced*
+  — only on statics, locals, closure constants, or ``.shape``-class
+  attributes (trace-time constants).  Branching on a traced argument
+  either fails at trace time or silently bakes one path per value.
+- **host-sync** (``host-sync-hot-loop`` / ``host-sync-under-lock``):
+  ``.item()`` / ``block_until_ready`` / ``jax.device_get`` /
+  ``np.asarray``-family calls (plus ``int()``/``float()`` over a call
+  result) reached transitively from a hot root (a function named in
+  ``HOT_ROOT_NAMES``, default the engine's ``_loop``, or annotated
+  ``# hot-root: reason``) or while a known lock is held — composed
+  with the ISSUE-10 lock model (``with self._lock:`` regions plus the
+  underscore-helper entry-context inference).  Deliberate syncs carry
+  ``# sync-ok: <reason>``.
+- **swallowed-exception**: bare ``except:`` and
+  ``except Exception/BaseException:`` handlers whose whole body is
+  ``pass``/``continue``/``...`` anywhere under ``k8s_tpu/`` — silent
+  swallows rot into unobservable failures; deliberate ones carry
+  ``# except-ok: <reason>``.
+
+Annotations suppress on their own line or up to two lines above the
+finding (the ``static.py`` contract); everything else goes through the
+reason-mandatory allowlist (``compile_allowlist.txt``, same
+stale-entries-fail loader as ``allowlist.txt``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from k8s_tpu.analysis import astutil
+from k8s_tpu.analysis import static as _static
+
+Finding = _static.Finding
+AllowlistError = _static.AllowlistError
+load_allowlist = _static.load_allowlist
+
+#: last dotted component of a call that constructs an XLA program
+JIT_CALL_NAMES = {"jit", "pjit"}
+#: decorators that memoize a builder's return value
+LRU_DECORATORS = {"lru_cache", "cache"}
+#: attribute accesses on a traced value that are trace-time constants
+SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+#: functions whose transitive callees are "hot" (the engine step loop)
+HOT_ROOT_NAMES = ("_loop",)
+#: swallowing handlers are only flagged for these (or bare) types
+BROAD_EXCEPTIONS = {"Exception", "BaseException"}
+
+# dotted call names that force a device->host sync
+_SYNC_DOTTED = {
+    "np.asarray": "np.asarray", "numpy.asarray": "np.asarray",
+    "onp.asarray": "np.asarray",
+    "np.array": "np.array", "numpy.array": "np.array",
+    "onp.array": "np.array",
+    "jax.device_get": "jax.device_get", "device_get": "jax.device_get",
+}
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class CompileReport:
+    """Findings plus the classified inventory (jit sites, resolved jit
+    wrappers, hot functions) — the JSON artifact's payload."""
+
+    def __init__(self):
+        self.findings: list[Finding] = []
+        self.suppressed: list[dict] = []
+        self.jit_sites: list[dict] = []
+        self.wrappers: list[dict] = []
+        self.hot_functions: list[dict] = []
+        self.module_count = 0
+        self.allowlist_unused: list[dict] = []
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "modules": self.module_count,
+            "jit_sites": self.jit_sites,
+            "wrappers": self.wrappers,
+            "hot_functions": self.hot_functions,
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": self.suppressed,
+            "allowlist_unused": self.allowlist_unused,
+        }
+
+
+# --- shared helpers ----------------------------------------------------------
+
+
+def _last_comp(name: str | None) -> str | None:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _note(notes: dict[int, str], line: int) -> str | None:
+    """An annotation suppresses findings on its own line or (comments
+    usually precede the statement) up to two lines below it — the
+    ``static._Module.note`` contract."""
+    for ln in (line, line - 1, line - 2):
+        if ln in notes:
+            return notes[ln]
+    return None
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _last_comp(astutil.dotted_name(node.func)) in JIT_CALL_NAMES)
+
+
+def _memo_attr(node: ast.AST) -> str | None:
+    """``self.X`` / bare ``X`` spelled as a memo-table receiver."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in ("self", "cls"):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# --- per-function facts (jit-surface pass) -----------------------------------
+
+
+class _FnFacts:
+    def __init__(self, node: ast.AST, qualname: str):
+        self.node = node
+        self.qualname = qualname
+        self.name = getattr(node, "name", "<module>")
+        self.is_init = self.name in ("__init__", "__post_init__")
+        self.is_lru = any(
+            _last_comp(astutil.dotted_name(
+                d.func if isinstance(d, ast.Call) else d)) in LRU_DECORATORS
+            for d in getattr(node, "decorator_list", []))
+        self.memo = False
+        # (lineno, bound_name|None, in_loop, returned_direct)
+        self.jit_sites: list[tuple[int, str | None, bool, bool]] = []
+        # (lineno, callee_name) for every plain call, with loop context
+        self.calls_in_loops: list[tuple[int, str]] = []
+        self.returned_names: set[str] = set()
+        self.nested_free: set[str] = set()
+        self.is_factory = False
+
+
+def _collect_fn_facts(fn: ast.AST, qualname: str) -> _FnFacts:
+    facts = _FnFacts(fn, qualname)
+    memo_read: set[str] = set()
+    memo_store: set[str] = set()
+
+    def scan(node: ast.AST, in_loop: bool):
+        if isinstance(node, _FUNC_NODES) and node is not fn:
+            # a nested def: its decorators run in THIS scope (a
+            # @jax.jit-decorated nested def is a jit site here), its
+            # body's free names mark closure escape
+            for d in node.decorator_list:
+                if _is_jit_call(d) or _last_comp(
+                        astutil.dotted_name(d)) in JIT_CALL_NAMES:
+                    facts.jit_sites.append(
+                        (node.lineno, node.name, in_loop, False))
+            bound = {a.arg for a in node.args.posonlyargs + node.args.args
+                     + node.args.kwonlyargs}
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    if isinstance(sub.ctx, ast.Load) and sub.id not in bound:
+                        facts.nested_free.add(sub.id)
+            return
+        if isinstance(node, (ast.ClassDef, ast.Lambda)):
+            if isinstance(node, ast.Lambda):
+                for sub in ast.walk(node.body):
+                    if isinstance(sub, ast.Name) and \
+                            isinstance(sub.ctx, ast.Load):
+                        facts.nested_free.add(sub.id)
+            return
+        nxt = in_loop or isinstance(node, _LOOP_NODES)
+        if isinstance(node, ast.Return) and node.value is not None:
+            if _is_jit_call(node.value):
+                facts.jit_sites.append((node.value.lineno, None, in_loop,
+                                        True))
+                # the jit's operands still need scanning (nested calls)
+            if isinstance(node.value, ast.Name):
+                facts.returned_names.add(node.value.id)
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    facts.jit_sites.append((node.value.lineno, t.id,
+                                            in_loop, False))
+                    break
+            else:
+                facts.jit_sites.append((node.value.lineno, None, in_loop,
+                                        False))
+        elif _is_jit_call(node) and not _inside_recorded(facts, node):
+            facts.jit_sites.append((node.lineno, None, in_loop, False))
+        if isinstance(node, ast.Call):
+            # memo reads: self.X.get(...) / X.get(...)
+            if isinstance(node.func, ast.Attribute):
+                attr = _memo_attr(node.func.value)
+                if attr is not None:
+                    if node.func.attr == "get":
+                        memo_read.add(attr)
+                    elif node.func.attr == "setdefault":
+                        memo_read.add(attr)
+                        memo_store.add(attr)
+            if nxt or in_loop:
+                callee = astutil.dotted_name(node.func)
+                if callee:
+                    facts.calls_in_loops.append((node.lineno, callee))
+        if isinstance(node, ast.Compare) and \
+                any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            for cmp_ in node.comparators:
+                attr = _memo_attr(cmp_)
+                if attr is not None:
+                    memo_read.add(attr)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                tgt = t
+                while isinstance(tgt, ast.Subscript):
+                    inner = _memo_attr(tgt.value)
+                    if inner is not None:
+                        memo_store.add(inner)
+                    tgt = tgt.value
+                attr = _memo_attr(t)
+                if attr is not None and isinstance(node.value, ast.Dict):
+                    # copy-on-write rebind: self.X = {**self.X, k: v}
+                    for k, v in zip(node.value.keys, node.value.values):
+                        if k is None and _memo_attr(v) == attr:
+                            memo_store.add(attr)
+        for child in ast.iter_child_nodes(node):
+            scan(child, nxt)
+
+    for stmt in ast.iter_child_nodes(fn):
+        scan(stmt, False)
+    facts.memo = bool(memo_read & memo_store)
+    facts.is_factory = any(
+        (bound is not None and (bound in facts.returned_names
+                                or bound in facts.nested_free)) or direct
+        for _ln, bound, _loop, direct in facts.jit_sites)
+    return facts
+
+
+def _inside_recorded(facts: _FnFacts, node: ast.Call) -> bool:
+    """Avoid double-recording a jit already captured at its statement."""
+    return any(ln == node.lineno for ln, _b, _l, _d in facts.jit_sites)
+
+
+# --- jit-surface pass --------------------------------------------------------
+
+
+def _iter_functions(tree: ast.Module):
+    """Yield (qualname, node) for every function at every nesting depth
+    (methods as ``Class.method``, nested defs as ``outer.<locals>.inner``)."""
+    def rec(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from rec(child, f"{qual}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, f"{prefix}{child.name}.")
+            elif not isinstance(child, ast.Lambda):
+                yield from rec(child, prefix)
+
+    yield from rec(tree, "")
+
+
+def _jit_pass(mod_tree: ast.Module, source: str, rel: str,
+              report: CompileReport):
+    jit_ok = astutil.line_comments(source, "jit-ok")
+    note = _note
+
+    all_facts: dict[str, _FnFacts] = {
+        qual: _collect_fn_facts(node, qual)
+        for qual, node in _iter_functions(mod_tree)}
+
+    # module-scope jit sites (top-level assigns / decorated defs) are
+    # import-time programs: classified ok, recorded for the inventory
+    for node in astutil.own_scope_nodes(mod_tree):
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            report.jit_sites.append({
+                "path": rel, "line": node.value.lineno,
+                "scope": "<module>", "class": "import-time"})
+        elif isinstance(node, _FUNC_NODES):
+            for d in node.decorator_list:
+                if _is_jit_call(d) or _last_comp(
+                        astutil.dotted_name(d)) in JIT_CALL_NAMES:
+                    report.jit_sites.append({
+                        "path": rel, "line": node.lineno,
+                        "scope": "<module>", "class": "import-time"})
+
+    # memoized/lru builders RETURN a jit too, but calling them per
+    # request is the point — only unmemoized factories are loop hazards
+    factory_names = {f.name for f in all_facts.values()
+                     if f.is_factory and not (f.memo or f.is_lru)}
+
+    for qual, facts in all_facts.items():
+        if facts.is_init:
+            cls = "construction-time"
+        elif facts.is_lru:
+            cls = "memoized-builder"
+        elif facts.memo:
+            cls = "program-table"
+        elif facts.is_factory:
+            cls = "factory"
+        else:
+            cls = None
+        for line, bound, in_loop, direct in facts.jit_sites:
+            qualifier = f"{qual}:{bound or '<jit>'}"
+            site_cls = cls
+            code = None
+            if in_loop and cls not in ("construction-time",
+                                       "memoized-builder"):
+                code, site_cls = "jit-in-loop", "hazard"
+            elif cls is None and not (bound is not None and (
+                    bound in facts.returned_names
+                    or bound in facts.nested_free)) and not direct:
+                code, site_cls = "jit-per-call", "hazard"
+            elif cls is None:
+                site_cls = "factory"
+            report.jit_sites.append({
+                "path": rel, "line": line, "scope": qual,
+                "class": site_cls})
+            if code is None:
+                continue
+            reason = note(jit_ok, line)
+            if reason:
+                report.suppressed.append({
+                    "code": code, "path": rel, "lineno": line,
+                    "reason": reason, "qualifier": qualifier})
+                continue
+            msg = ("jax.jit constructed inside a loop body"
+                   if code == "jit-in-loop" else
+                   "jax.jit constructed per call (no memoizing "
+                   "program-table, lru_cache, or factory-return idiom)")
+            report.findings.append(Finding(
+                code, rel, line, f"{msg} in {qual} — a fresh XLA program "
+                "per invocation", qualifier=qualifier))
+        # calls to known jit factories from inside a loop compile a
+        # fresh program per iteration just the same
+        if facts.is_lru or facts.memo or facts.is_init:
+            continue
+        for line, callee in facts.calls_in_loops:
+            last = _last_comp(callee)
+            if last in factory_names:
+                qualifier = f"{qual}:{last}"
+                reason = note(jit_ok, line)
+                if reason:
+                    report.suppressed.append({
+                        "code": "jit-in-loop", "path": rel, "lineno": line,
+                        "reason": reason, "qualifier": qualifier})
+                    continue
+                report.findings.append(Finding(
+                    "jit-in-loop", rel, line,
+                    f"jit factory {last}() called inside a loop body in "
+                    f"{qual} — a fresh XLA program per iteration",
+                    qualifier=qualifier))
+
+
+# --- uncovered-traced-branch pass --------------------------------------------
+
+
+def _static_names(call: ast.Call, params: list[str]) -> tuple[set, bool]:
+    """(static param names, parsed_ok) from a jit call's keywords."""
+    static: set[str] = set()
+    ok = True
+
+    def ints(node):
+        nonlocal ok
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+                else:
+                    ok = False
+            return out
+        ok = False
+        return []
+
+    def strs(node):
+        nonlocal ok
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append(e.value)
+                else:
+                    ok = False
+            return out
+        ok = False
+        return []
+
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for i in ints(kw.value):
+                if 0 <= i < len(params):
+                    static.add(params[i])
+        elif kw.arg == "static_argnames":
+            static.update(strs(kw.value))
+    return static, ok
+
+
+def _params_of(fn: ast.AST, drop_self: bool) -> list[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if drop_self and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _branch_hits(expr: ast.AST, watched: set[str]) -> list[str]:
+    hits: list[str] = []
+
+    def rec(node):
+        if isinstance(node, ast.Attribute) and node.attr in SHAPE_ATTRS:
+            return  # x.shape / x.ndim / x.dtype are trace-time constants
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) \
+                and all(isinstance(c, ast.Constant) and c.value is None
+                        for c in node.comparators):
+            return  # `x is None`: None is a static pytree, not a tracer
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in watched:
+            hits.append(node.id)
+        for child in ast.iter_child_nodes(node):
+            rec(child)
+
+    rec(expr)
+    return hits
+
+
+def _check_traced_branches(target: ast.AST, watched: set[str],
+                           out: list[tuple[int, str]]):
+    """Collect (lineno, param) for branches on watched names, descending
+    into nested scopes with Python's name-shadowing rules."""
+    def assigned_names(fn):
+        names = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                 + fn.args.kwonlyargs}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                names.add(sub.id)
+        return names
+
+    def rec(node, watched):
+        if isinstance(node, _FUNC_NODES):
+            inner = watched - assigned_names(node)
+            for stmt in node.body:
+                rec(stmt, inner)
+            return
+        if isinstance(node, ast.Lambda):
+            inner = watched - {a.arg for a in node.args.args}
+            rec(node.body, inner)
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        test = None
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            test = node.test
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            test = node.iter
+        if test is not None:
+            for name in _branch_hits(test, watched):
+                out.append((test.lineno, name))
+        for child in ast.iter_child_nodes(node):
+            rec(child, watched)
+
+    for stmt in target.body:
+        rec(stmt, watched)
+
+
+def _traced_branch_pass(mod_tree: ast.Module, source: str, rel: str,
+                        report: CompileReport):
+    traced_ok = astutil.line_comments(source, "traced-ok")
+
+    # index every def by (enclosing-class, name) and (enclosing-func, name)
+    class_methods: dict[str, dict[str, ast.AST]] = {}
+    module_funcs: dict[str, ast.AST] = {}
+    for node in astutil.own_scope_nodes(mod_tree):
+        if isinstance(node, ast.ClassDef):
+            class_methods[node.name] = {
+                m.name: m for m in node.body if isinstance(m, _FUNC_NODES)}
+        elif isinstance(node, _FUNC_NODES):
+            module_funcs[node.name] = node
+
+    def resolve(call: ast.Call, cls_name: str | None,
+                local_defs: dict[str, ast.AST]):
+        """(target_def, drop_self, target_qual) or (None, ..)."""
+        if not call.args:
+            return None, False, None
+        arg0 = call.args[0]
+        if isinstance(arg0, ast.Attribute) and \
+                isinstance(arg0.value, ast.Name) and \
+                arg0.value.id in ("self", "cls") and cls_name:
+            m = class_methods.get(cls_name, {}).get(arg0.attr)
+            if m is not None:
+                return m, True, f"{cls_name}.{arg0.attr}"
+        if isinstance(arg0, ast.Name):
+            tgt = local_defs.get(arg0.id) or module_funcs.get(arg0.id)
+            if tgt is not None:
+                return tgt, False, arg0.id
+        return None, False, None
+
+    def visit_scope(scope: ast.AST, cls_name: str | None, qual: str):
+        local_defs = {n.name: n for n in astutil.own_scope_nodes(scope)
+                      if isinstance(n, _FUNC_NODES)}
+        # decorator form: @jax.jit def f — the def itself is the target
+        for node in astutil.own_scope_nodes(scope):
+            if isinstance(node, _FUNC_NODES):
+                for d in node.decorator_list:
+                    call = d if isinstance(d, ast.Call) else None
+                    is_jit = _is_jit_call(d) or _last_comp(
+                        astutil.dotted_name(d)) in JIT_CALL_NAMES
+                    if not is_jit:
+                        continue
+                    static = set()
+                    parsed = True
+                    params = _params_of(node, drop_self=False)
+                    if call is not None:
+                        static, parsed = _static_names(call, params)
+                    check(node, params, static, parsed,
+                          f"{qual}{node.name}", node.lineno)
+            if isinstance(node, ast.Call) and _is_jit_call(node):
+                tgt, drop_self, tqual = resolve(node, cls_name, local_defs)
+                if tgt is None:
+                    report.wrappers.append({
+                        "path": rel, "line": node.lineno,
+                        "target": None, "resolved": False})
+                    continue
+                params = _params_of(tgt, drop_self=drop_self)
+                static, parsed = _static_names(node, params)
+                check(tgt, params, static, parsed, tqual, node.lineno)
+
+    def check(target, params, static, parsed, tqual, wrapper_line):
+        watched = set(params) - static
+        report.wrappers.append({
+            "path": rel, "line": wrapper_line, "target": tqual,
+            "resolved": True, "params": params,
+            "static": sorted(static), "statics_parsed": parsed})
+        hits: list[tuple[int, str]] = []
+        _check_traced_branches(target, watched, hits)
+        for line, name in hits:
+            qualifier = f"{tqual}:{name}"
+            reason = _note(traced_ok, line)
+            if reason:
+                report.suppressed.append({
+                    "code": "uncovered-traced-branch", "path": rel,
+                    "lineno": line, "reason": reason,
+                    "qualifier": qualifier})
+                continue
+            report.findings.append(Finding(
+                "uncovered-traced-branch", rel, line,
+                f"Python branch on traced argument {name!r} in {tqual} "
+                f"(jit wrapper at line {wrapper_line} has no covering "
+                "static_argnums/static_argnames entry)",
+                qualifier=qualifier))
+
+    # walk every scope that can contain a jit wrapper construction
+    visit_scope(mod_tree, None, "")
+    for node in astutil.own_scope_nodes(mod_tree):
+        if isinstance(node, ast.ClassDef):
+            for m in node.body:
+                if isinstance(m, _FUNC_NODES):
+                    visit_scope(m, node.name, f"{node.name}.")
+        elif isinstance(node, _FUNC_NODES):
+            visit_scope(node, None, f"{node.name}.")
+
+
+# --- host-sync pass ----------------------------------------------------------
+
+
+def _sync_desc(node: ast.Call) -> str | None:
+    func = node.func
+    dotted = astutil.dotted_name(func)
+    if dotted in _SYNC_DOTTED:
+        return _SYNC_DOTTED[dotted]
+    if isinstance(func, ast.Attribute):
+        if func.attr == "item" and not node.args and not node.keywords:
+            return ".item()"
+        if func.attr == "block_until_ready":
+            return ".block_until_ready()"
+    if isinstance(func, ast.Name) and func.id in ("float", "int") \
+            and len(node.args) == 1:
+        arg = node.args[0]
+        inner_calls = [n for n in ast.walk(arg) if isinstance(n, ast.Call)]
+        if inner_calls and not any(_sync_desc(c) for c in inner_calls):
+            return f"{func.id}(<call>)"
+    return None
+
+
+class _SyncVisitor(_static._FnVisitor):
+    """The ISSUE-10 lock-tracking walker, extended to record host-sync
+    descriptors with the locks held at each site."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.syncs: list[tuple[str, tuple, int]] = []
+
+    def visit_Call(self, node):
+        desc = _sync_desc(node)
+        if desc is not None:
+            self.syncs.append((desc, tuple(self.held), node.lineno))
+        super().visit_Call(node)
+
+
+def _hot_set(mod: "_static._Module", hot_roots: tuple,
+             hot_notes: dict[int, str]) -> dict[str, str]:
+    """qualname -> root it is reached from, via same-module call BFS."""
+    roots: dict[str, str] = {}
+    for qual, s in mod.summaries.items():
+        if s.name in hot_roots:
+            roots[qual] = qual
+    for node in ast.walk(mod.tree):
+        # the annotation rides its own line above (or on) the def line —
+        # the same two-line window every other marker gets
+        if isinstance(node, _FUNC_NODES) and \
+                _note(hot_notes, node.lineno) is not None:
+            for qual, s in mod.summaries.items():
+                if s.qualname.endswith(node.name) and \
+                        qual.split(".")[-1] == node.name:
+                    roots.setdefault(qual, qual)
+    hot = dict(roots)
+    frontier = list(roots)
+    while frontier:
+        qual = frontier.pop()
+        s = mod.summaries.get(qual)
+        if s is None:
+            continue
+        for kind, target, _held, _line in s.calls:
+            callee = mod._resolve_callee(s, kind, target)
+            if callee is not None and callee not in hot:
+                hot[callee] = hot[qual]
+                frontier.append(callee)
+    return hot
+
+
+def _sync_pass(mod: "_static._Module", rel: str, report: CompileReport,
+               hot_roots: tuple):
+    sync_ok = astutil.line_comments(mod.source, "sync-ok")
+    hot_notes = astutil.line_comments(mod.source, "hot-root")
+    hot = _hot_set(mod, hot_roots, hot_notes)
+    for qual, root in sorted(hot.items()):
+        report.hot_functions.append({"path": rel, "function": qual,
+                                     "root": root})
+
+    for qual, s in mod.summaries.items():
+        node = None
+        if s.cls is not None:
+            node = mod.classes[s.cls]["methods"].get(s.name)
+            locks = mod.classes[s.cls]["locks"]
+            methods = set(mod.classes[s.cls]["methods"])
+            prefix = f"{s.cls}."
+        else:
+            node = mod.module_funcs.get(s.name)
+            locks, methods, prefix = {}, set(), ""
+        if node is None:
+            continue
+        summary = _static._FnSummary(qual, s.name, s.cls)
+        v = _SyncVisitor(summary, locks, mod.module_locks, methods,
+                         set(mod.module_funcs), prefix)
+        for stmt in node.body:
+            v.visit(stmt)
+        for desc, held, line in v.syncs:
+            eff = frozenset(held) | s.entry_held
+            if eff:
+                code = "host-sync-under-lock"
+                ctx = "while holding " + ", ".join(sorted(eff))
+            elif qual in hot:
+                code = "host-sync-hot-loop"
+                ctx = f"in the hot path of {hot[qual]}"
+            else:
+                continue
+            qualifier = f"{qual}:{desc}"
+            reason = mod.note(sync_ok, line)
+            if reason:
+                report.suppressed.append({
+                    "code": code, "path": rel, "lineno": line,
+                    "reason": reason, "qualifier": qualifier})
+                continue
+            report.findings.append(Finding(
+                code, rel, line,
+                f"host-device sync {desc} {ctx} in {qual}",
+                qualifier=qualifier))
+
+
+# --- swallowed-exception pass ------------------------------------------------
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue  # docstring / bare ... placeholder
+        return False
+    return True
+
+
+def _except_pass(mod_tree: ast.Module, source: str, rel: str,
+                 report: CompileReport):
+    except_ok = astutil.line_comments(source, "except-ok")
+
+    def rec(node, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                rec(child, f"{qual}.{child.name}" if qual else child.name)
+                continue
+            if isinstance(child, ast.ClassDef):
+                rec(child, f"{qual}.{child.name}" if qual else child.name)
+                continue
+            if isinstance(child, ast.ExceptHandler):
+                ename = None if child.type is None else _last_comp(
+                    astutil.dotted_name(child.type))
+                broad = child.type is None or ename in BROAD_EXCEPTIONS
+                if broad and _swallows(child):
+                    label = ename or "bare"
+                    qualifier = f"{qual or '<module>'}:{label}"
+                    reason = _note(except_ok, child.lineno)
+                    if reason:
+                        report.suppressed.append({
+                            "code": "swallowed-exception", "path": rel,
+                            "lineno": child.lineno, "reason": reason,
+                            "qualifier": qualifier})
+                    else:
+                        report.findings.append(Finding(
+                            "swallowed-exception", rel, child.lineno,
+                            f"broad '{'except:' if ename is None else f'except {ename}:'}' "
+                            f"handler swallows silently in "
+                            f"{qual or '<module>'} (body is only "
+                            "pass/continue)", qualifier=qualifier))
+            rec(child, qual)
+
+    rec(mod_tree, "")
+
+
+# --- entry points ------------------------------------------------------------
+
+
+def analyze_tree(root: str, allowlist_path: str | None = None,
+                 rel_base: str | None = None,
+                 compile_scope: str = "models",
+                 hot_roots: tuple = HOT_ROOT_NAMES) -> CompileReport:
+    """All four passes over ``root`` (the ``k8s_tpu`` package dir).
+
+    The jit-surface / traced-branch / host-sync passes run over modules
+    under ``root/<compile_scope>/`` (the jitted serving stack); the
+    swallowed-exception pass runs over the whole tree."""
+    entries = load_allowlist(allowlist_path) if allowlist_path else []
+    base = rel_base or os.path.dirname(os.path.abspath(root))
+    scope_dir = os.path.join(os.path.abspath(root), compile_scope) + os.sep
+    report = CompileReport()
+    for path in astutil.iter_py_files(root):
+        rel = os.path.relpath(os.path.abspath(path), base).replace(
+            os.sep, "/")
+        try:
+            with open(path, "rb") as f:
+                source = f.read().decode("utf-8", "replace")
+            tree = ast.parse(source, path)
+        except SyntaxError:
+            continue  # the lint syntax layer owns this failure
+        report.module_count += 1
+        _except_pass(tree, source, rel, report)
+        if os.path.abspath(path).startswith(scope_dir):
+            _jit_pass(tree, source, rel, report)
+            _traced_branch_pass(tree, source, rel, report)
+            mod = _static._Module(path, rel, source, tree)
+            _sync_pass(mod, rel, report, hot_roots)
+    _static._apply_allowlist(report, entries)
+    report.findings.sort(key=lambda f: (f.path, f.lineno, f.code))
+    return report
+
+
+def analyze_source(source: str, relpath: str = "mod.py",
+                   hot_roots: tuple = HOT_ROOT_NAMES) -> CompileReport:
+    """Single-module entry point for tests/fixtures: runs all four
+    passes (no allowlist)."""
+    report = CompileReport()
+    tree = ast.parse(source, relpath)
+    report.module_count = 1
+    _except_pass(tree, source, relpath, report)
+    _jit_pass(tree, source, relpath, report)
+    _traced_branch_pass(tree, source, relpath, report)
+    mod = _static._Module(relpath, relpath, source, tree)
+    _sync_pass(mod, relpath, report, hot_roots)
+    report.findings.sort(key=lambda f: (f.path, f.lineno, f.code))
+    return report

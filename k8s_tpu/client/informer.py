@@ -201,6 +201,8 @@ class SharedInformer:
             if self._active_watch is not None:
                 try:
                     self._active_watch.stop()
+                # except-ok: best-effort close on shutdown; the socket may
+                # already be torn down
                 except Exception:
                     pass
 

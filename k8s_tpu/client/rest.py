@@ -235,16 +235,19 @@ class _RestWatch:
                 import socket as _socket
 
                 sock.shutdown(_socket.SHUT_RDWR)
+        # except-ok: best-effort shutdown of an already-dying socket
         except Exception:
             pass
         try:
             self._resp.close()
+        # except-ok: best-effort close on watch teardown
         except Exception:
             pass
         conn = getattr(self._resp, "_k8s_tpu_conn", None)
         if conn is not None:
             try:
                 conn.close()
+            # except-ok: best-effort close on watch teardown
             except Exception:
                 pass
 
@@ -269,8 +272,9 @@ class _RestWatch:
                     continue
                 evt = json.loads(line)
                 return evt.get("type", ""), evt.get("object", {})
+        # except-ok: connection torn down — treat as end-of-stream
         except Exception:
-            pass  # connection torn down — treat as end-of-stream
+            pass
         self.stopped = True
         return None
 
@@ -475,6 +479,8 @@ class RestClient:
         if conn is not None:
             try:
                 conn.close()
+            # except-ok: dropping a broken keep-alive connection; close
+            # failures are the reason it is being dropped
             except Exception:
                 pass
             self._local.conn = None

@@ -134,6 +134,15 @@ def _make_handler(manager: ClientManager):
 
                     code, body, ctype = fleet.debug_response(query)
                     self._send_text(code, body, ctype)
+                elif path == "/debug/compiles":
+                    # XLA compile ledger — shared responder with the
+                    # metrics server and the serving pod, same
+                    # per-process scope caveat as the other /debug routes.
+                    from k8s_tpu.analysis import compileledger
+
+                    code, body, ctype = \
+                        compileledger.debug_compiles_response(query)
+                    self._send_text(code, body, ctype)
                 elif path == "/debug":
                     # index of the debug endpoints with active state
                     # (path is rstrip("/")-normalized above, so this

@@ -94,6 +94,8 @@ class ChaosMonkey:
                     if (p.get("status") or {}).get("phase")
                     in ("Running", "Pending") and self._victim_filter(p)
                 ]
+            # except-ok: chaos injection is best-effort by design —
+            # a cluster shutting down mid-list is not a monkey failure
             except Exception:  # noqa: BLE001 - cluster shutting down
                 continue
             self._rng.shuffle(pods)

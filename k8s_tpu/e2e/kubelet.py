@@ -123,6 +123,7 @@ class KubeletSimulator:
             if self._active_watch is not None:
                 try:
                     self._active_watch.stop()
+                # except-ok: best-effort close on simulator shutdown
                 except Exception:
                     pass
         for proc in list(self._procs.values()):

@@ -24,8 +24,11 @@ an exporter with 10k ad-hoc families cannot balloon the plane.
 
 from __future__ import annotations
 
+import logging
 from k8s_tpu.analysis import checkedlock
 from collections import OrderedDict, deque
+
+log = logging.getLogger(__name__)
 
 _INF = float("inf")
 
@@ -163,6 +166,11 @@ class FleetAggregator:
         #         "gauges":   {family: ({pod: (t, value)}, max_ring)},
         #         "hist":     {family: {pod: ring-of-points}}}
         self._jobs: "OrderedDict[str, dict]" = OrderedDict()
+        # histogram families dropped mid-ingest (malformed bucket tables
+        # that got past the parser): observable, not silently swallowed —
+        # a fleet plane that quietly stops aggregating latency rots every
+        # SLO burn rule downstream
+        self.hist_drops = 0
 
     def _keep(self, name: str) -> bool:
         if not self.family_prefixes:
@@ -207,7 +215,14 @@ class FleetAggregator:
                 elif fam.kind == "histogram":
                     try:
                         points = histogram_points(fam)
-                    except Exception:  # noqa: BLE001 - parser validated already
+                    except Exception as e:  # noqa: BLE001 - one bad family must not drop the scrape
+                        # ISSUE 11 first-audit fix: this swallow was
+                        # silent — a malformed bucket table now counts
+                        # and logs instead of vanishing
+                        self.hist_drops += 1
+                        log.warning(
+                            "fleet: dropping histogram family %r from "
+                            "%s/%s: %s", name, job, pod, e)
                         continue
                     for labels_key, point in points.items():
                         series = state["hist"].setdefault(

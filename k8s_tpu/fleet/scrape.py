@@ -233,8 +233,15 @@ class ScrapeLoop:
         if outcome != OUTCOME_OK and self.on_failure is not None:
             try:
                 self.on_failure(target, outcome, error)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 - hook failure must not kill the scrape thread
+                # ISSUE 11 first-audit fix: this swallow was silent — a
+                # raising failure hook is the SLO/burn-rate wiring
+                # breaking, which is itself an alertable condition
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "fleet: on_failure hook raised for %s (%s)",
+                    target.key(), outcome)
 
     def scrape_once(self, now: float | None = None) -> int:
         """One full cycle: discover, fan out, wait (bounded by the

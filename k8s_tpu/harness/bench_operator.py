@@ -1668,6 +1668,16 @@ def main(argv=None) -> int:
                    "watchdog/cycle violation records — to this path; a "
                    "cycle violation raises inside the offending scenario "
                    "(the JSON still records it)")
+    p.add_argument("--compile-audit-out", default=None,
+                   help="enable the runtime XLA compile ledger "
+                   "(K8S_TPU_COMPILE_LEDGER=1; "
+                   "k8s_tpu.analysis.compileledger) for the whole bench "
+                   "run and write the compile_audit.json artifact — "
+                   "per-seam budgets, per-fingerprint compile counts/"
+                   "durations/origin stacks, the recent-event ring — to "
+                   "this path; a seam recompiling past its declared "
+                   "budget raises CompileBudgetExceeded inside the "
+                   "offending scenario (the JSON still records it)")
     p.add_argument("--trace", action="store_true",
                    help="force tracing on (sample rate 1.0) and append a "
                    "per-stage p50/p99 breakdown ('stages') to the JSON "
@@ -1682,19 +1692,31 @@ def main(argv=None) -> int:
         # before any scenario constructs a cluster/engine: the checkedlock
         # factories read the env at lock-creation time
         os.environ["K8S_TPU_LOCK_CHECK"] = "1"
+    old_compile_ledger = os.environ.get("K8S_TPU_COMPILE_LEDGER")
+    if args.compile_audit_out:
+        # before the serve scenario constructs its engines: the
+        # ledger's maybe_active() reads the env at seam-declaration time
+        os.environ["K8S_TPU_COMPILE_LEDGER"] = "1"
 
     try:
         return _run(args, p)
     finally:
-        # the artifact must land on failed runs too — a cycle violation
-        # raising inside a scenario is exactly the run worth auditing
+        # the artifacts must land on failed runs too — a cycle/budget
+        # violation raising inside a scenario is exactly the run worth
+        # auditing
         _write_lock_audit(args)
+        _write_compile_audit(args)
         if args.lock_audit_out:
             # in-process callers (tests) must not inherit checker mode
             if old_lock_check is None:
                 os.environ.pop("K8S_TPU_LOCK_CHECK", None)
             else:
                 os.environ["K8S_TPU_LOCK_CHECK"] = old_lock_check
+        if args.compile_audit_out:
+            if old_compile_ledger is None:
+                os.environ.pop("K8S_TPU_COMPILE_LEDGER", None)
+            else:
+                os.environ["K8S_TPU_COMPILE_LEDGER"] = old_compile_ledger
 
 
 def _run(args, p) -> int:
@@ -1802,6 +1824,28 @@ def _write_lock_audit(args) -> None:
         "watchdog_violations": len(snap["watchdog_violations"]),
         "cycle_violations": snap["cycle_violations"],
     }))
+
+
+def _write_compile_audit(args) -> None:
+    """Emit the runtime compile ledger's compile_audit.json artifact
+    (ISSUE 11) plus a one-line JSON summary on stdout, when
+    --compile-audit-out is set.  In-process callers (tests) get a clean
+    slate afterwards: the process-global ledger is deactivated."""
+    if not getattr(args, "compile_audit_out", None):
+        return
+    from k8s_tpu.analysis import compileledger
+
+    payload = compileledger.write_audit(args.compile_audit_out)
+    print(json.dumps({
+        "metric": "compile_audit",
+        "path": args.compile_audit_out,
+        "enabled": payload["enabled"],
+        "seams": len(payload["seams"]),
+        "total_compiles": payload["total_compiles"],
+        "total_programs": payload["total_programs"],
+        "over_budget": payload["over_budget"],
+    }))
+    compileledger.set_active(None)
 
 
 if __name__ == "__main__":

@@ -376,6 +376,12 @@ def run_phase(config, params, *, slots: int, concurrency: int,
                 decode_lib.cached_speculative_fn.cache_info().currsize
                 - spec_programs0,
         }
+        # runtime compile ledger (ISSUE 11): when K8S_TPU_COMPILE_LEDGER
+        # is on, this phase's declared seams — prefill buckets, fused
+        # decode widths, spec pairs, whole-gen bound — with observed
+        # program counts; run_bench asserts none went over budget (the
+        # ledger-read replacement for the hand-rolled inventory bound)
+        compile_ledger = lm.compile_audit()
         hits = engine_stats.get("prefix_hits", 0) \
             - warm_stats.get("prefix_hits", 0)
         prefix = {
@@ -416,6 +422,7 @@ def run_phase(config, params, *, slots: int, concurrency: int,
             "batch_spec": bool(batch_spec) and slots > 0,
             "shared_frac": shared_frac,
             "compile": compile_counts,
+            "compile_ledger": compile_ledger,
             "prefix": prefix,
             "spec": spec,
             "requests": len(lat_all),
@@ -660,10 +667,29 @@ def run_bench(concurrency: int = 16, slots: int = 8,
             f"single-flight {single['tokens_per_s']} tok/s: the paged "
             "decode step regressed the continuous-batching win")
     # compile-count contract: prefill bounded by the bucket set, decode
-    # programs by the static (fused width x sampling x spec) sets
+    # programs by the static (fused width x sampling x spec) sets.
+    # With the runtime ledger on (K8S_TPU_COMPILE_LEDGER=1) the DECLARED
+    # budgets are the contract — every phase's seams must be in budget,
+    # exclusive lanes and whole-gen programs included; without it, fall
+    # back to the pre-ledger hand-rolled decode-program bound.
+    for phase in (single, batched,
+                  result.get("sampled_exclusive") or {},
+                  result.get("sampled_batched") or {},
+                  result.get("spec_exclusive") or {},
+                  result.get("spec_batched") or {}):
+        ledger_audit = phase.get("compile_ledger") if phase else None
+        if ledger_audit is not None and ledger_audit["over_budget"]:
+            detail = {s["seam"]: f"{s['programs']}>{s['budget']}"
+                      for s in ledger_audit["seams"]
+                      if s["over_budget"]}
+            failures.append(
+                f"phase {phase.get('mode')}: compile seams over budget "
+                f"{detail}: the declared program inventory no longer "
+                "bounds the compile surface")
     for phase in (batched, result.get("sampled_batched") or {},
                   result.get("spec_batched") or {}):
-        if phase and phase["compile"]["decode_programs"] > 10:
+        if phase and phase.get("compile_ledger") is None \
+                and phase["compile"]["decode_programs"] > 10:
             failures.append(
                 f"phase {phase.get('mode')}: "
                 f"{phase['compile']['decode_programs']} decode programs "

@@ -9,8 +9,12 @@ the test tier runs pytest; junit files land in ``--artifacts_dir`` for
 The lint tier additionally runs the static concurrency analyzer
 (:mod:`k8s_tpu.analysis`, ISSUE 10) over the whole ``k8s_tpu`` tree —
 lock-order cycles, guarded-by discipline, blocking-calls-under-lock — with
-its own junit + JSON artifact; see docs/static_analysis.md for the
-annotation and allowlist syntax.
+its own junit + JSON artifact, and the static compile-surface analyzer
+(:mod:`k8s_tpu.analysis.compilesurface`, ISSUE 11) — per-call
+``jax.jit`` constructions, uncovered traced branches, host-device syncs
+in the engine's hot loop or under a lock, swallowed broad exception
+handlers — likewise with junit + JSON artifacts; see
+docs/static_analysis.md for the annotation and allowlist syntax.
 """
 
 from __future__ import annotations
@@ -214,6 +218,60 @@ def run_concurrency(src_dir: str, artifacts_dir: str) -> bool:
     return report.ok
 
 
+def run_compile_surface(src_dir: str, artifacts_dir: str) -> bool:
+    """The static compile-surface analyzer (ISSUE 11) as a lint-tier
+    gate — the :func:`run_concurrency` shape: one junit case per check
+    pass, plus the full report JSON artifact
+    (``compile_surface_report.json``).  Allowlist entries are
+    reason-mandatory and stale entries become findings, so nothing is
+    exempt without an auditable justification."""
+    import json
+
+    from k8s_tpu.analysis import compilesurface
+
+    suite = junit.TestSuite("compile_surface")
+    start = time.time()
+    tree_root = os.path.join(src_dir, "k8s_tpu")
+    if not os.path.isdir(tree_root):
+        tree_root = src_dir
+    allowlist = os.path.join(tree_root, "analysis", "compile_allowlist.txt")
+    case = suite.create("analyze")
+    try:
+        report = compilesurface.analyze_tree(
+            tree_root,
+            allowlist_path=allowlist if os.path.exists(allowlist) else None,
+            rel_base=os.path.dirname(os.path.abspath(tree_root)))
+    except compilesurface.AllowlistError as e:
+        case.failure = f"unexplained allowlist entry: {e}"
+        case.time = time.time() - start
+        junit.create_junit_xml_file(
+            suite, os.path.join(artifacts_dir, "junit_compile_surface.xml"))
+        return False
+    case.time = time.time() - start
+    by_code: dict[str, list] = {}
+    for f in report.findings:
+        by_code.setdefault(f.code, []).append(f)
+    for code in ("jit-per-call", "jit-in-loop", "uncovered-traced-branch",
+                 "host-sync-hot-loop", "host-sync-under-lock",
+                 "swallowed-exception", "stale-allowlist"):
+        sub = suite.create(code)
+        # time-less cases render as "Test was not run." failures in
+        # junit.create_xml, and prow.check_no_errors fails the job on any
+        sub.time = 0.0
+        found = by_code.get(code, [])
+        if found:
+            sub.failure = "\n".join(str(f) for f in found)
+    with open(os.path.join(artifacts_dir, "compile_surface_report.json"),
+              "w", encoding="utf-8") as f:
+        json.dump(report.as_dict(), f, indent=1, sort_keys=True)
+    junit.create_junit_xml_file(
+        suite, os.path.join(artifacts_dir, "junit_compile_surface.xml"))
+    if not report.ok:
+        for finding in report.findings:
+            log.error("compile-surface: %s", finding)
+    return report.ok
+
+
 def run_tests(src_dir: str, artifacts_dir: str) -> bool:
     """Run the pytest tier writing junit_pytests.xml (the *_test.py loop of
     py_checks.py:86-121, delegated to pytest's own junit emitter)."""
@@ -247,6 +305,7 @@ def main(argv=None) -> int:
     if args.check in ("lint", "all"):
         ok = run_lint(args.src_dir, args.artifacts_dir) and ok
         ok = run_concurrency(args.src_dir, args.artifacts_dir) and ok
+        ok = run_compile_surface(args.src_dir, args.artifacts_dir) and ok
     if args.check in ("test", "all"):
         ok = run_tests(args.src_dir, args.artifacts_dir) and ok
     return 0 if ok else 1

@@ -198,6 +198,8 @@ class PrefetchIterator:
     def __del__(self):  # pragma: no cover - GC safety net
         try:
             self._stop.set()
+        # except-ok: destructors must never raise (interpreter teardown
+        # may have nulled the attribute)
         except Exception:
             pass
 

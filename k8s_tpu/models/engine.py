@@ -83,6 +83,7 @@ import math
 import os
 import threading
 from k8s_tpu.analysis import checkedlock
+from k8s_tpu.analysis import compileledger
 from collections import deque
 from collections.abc import Mapping
 from typing import Any, Callable, Optional
@@ -389,6 +390,19 @@ class Engine:
             self._pool_alloc = None
             self._tree = None
 
+        # runtime compile ledger (ISSUE 11, K8S_TPU_COMPILE_LEDGER=1):
+        # every jit entry point becomes a declared SEAM with the compile
+        # budget the engine's program inventory promises — one prefill
+        # program per bucket, one decode program per (fused width,
+        # sampling) pair, one spec program per (draft_k, sampling) pair,
+        # a small shape-constant auxiliary set — and a recompile past any
+        # budget raises CompileBudgetExceeded with the offending
+        # fingerprint + stack.  Zero overhead when the ledger is off:
+        # the raw jit functions are used unwrapped.
+        self._ledger = compileledger.maybe_active()
+        if self._ledger is not None:
+            self._declare_seams()
+
         # stats (mutated on the engine thread; read under _cond)
         self._steps = 0
         self._completed = 0
@@ -588,6 +602,72 @@ class Engine:
             raise AssertionError(f"block refcount drift: {diffs[:8]}")
 
     # -------------------------------------------------------- jit programs
+
+    def _declare_seams(self) -> None:
+        """Declare this engine's compile-budget seams on the active
+        ledger and wrap the step-loop jits so every XLA compile lands
+        attributed.  Budgets ARE the compile-bound contract stats()
+        documents: traffic shape must never grow any of them."""
+        try:
+            from jax import monitoring as _monitoring
+        except Exception:  # noqa: BLE001 - older jax: wrap fallback covers it
+            _monitoring = None
+        compileledger.ensure_listener(_monitoring)
+        ledger = self._ledger
+        fused = []
+        k = 1
+        while k <= MAX_STEP_TOKENS:
+            fused.append(k)
+            k *= 2
+        self._seam_prefill = ledger.declare(
+            "engine.prefill", len(self.buckets),
+            note="one chunked-prefill program per USED bucket size")
+        step_budget = (len(fused) * 2) if self.paged else 2
+        self._seam_step = ledger.declare(
+            "engine.decode_step", step_budget,
+            note="one batched decode program per (fused width, sampling)"
+            " pair (dense mode: sampling only)")
+        self._seam_aux = ledger.declare(
+            "engine.aux", 4,
+            note="shape-constant auxiliaries (copy-on-write, row "
+            "scatter) that never grow with traffic")
+        if self.paged:
+            self._seam_spec = ledger.declare(
+                "engine.spec_step", compileledger.DEFAULT_SPEC_BUDGET,
+                note="one variable-width verify program per (draft_k, "
+                "sampling) pair actually used")
+            self._step_fn = ledger.wrap(
+                self._step_fn, self._seam_step, name="paged_step",
+                static_argnums=(6, 7))
+            self._spec_fn = ledger.wrap(
+                self._spec_fn, self._seam_spec, name="spec_step",
+                static_argnums=(7, 8))
+            self._cow_fn = ledger.wrap(self._cow_fn, self._seam_aux,
+                                       name="cow")
+        else:
+            self._seam_spec = None
+            self._step_fn = ledger.wrap(
+                self._step_fn, self._seam_step, name="dense_step",
+                static_argnums=(7,))
+            self._scatter_fn = ledger.wrap(
+                self._scatter_fn, self._seam_aux, name="scatter")
+
+    def compile_seams(self) -> list:
+        """This engine's declared seam handles (empty when the ledger is
+        off) — the server folds its whole-gen seam in for one audit."""
+        if self._ledger is None:
+            return []
+        return [s for s in (self._seam_prefill, self._seam_step,
+                            self._seam_spec, self._seam_aux)
+                if s is not None]
+
+    def compile_audit(self) -> Optional[dict]:
+        """This engine's per-seam ledger view (snapshots + over-budget
+        names), or None when the ledger is off — what the bench phases
+        assert on and /debug/compiles aggregates."""
+        if self._ledger is None:
+            return None
+        return self._ledger.seam_audit(self.compile_seams())
 
     def _init_cache(self, batch: int):
         """Batched cache pytree for ``batch`` rows, every slot invalid.
@@ -792,6 +872,10 @@ class Engine:
                     return varz["cache"], logits[:, -1]
 
                 fn = jax.jit(run)
+            if self._ledger is not None:
+                fn = self._ledger.wrap(
+                    fn, self._seam_prefill, name="prefill",
+                    context={"bucket": chunk_len})
             # copy-on-write rebind: stats() iterates this dict from probe
             # threads without the engine lock, so never mutate in place
             self._prefill_fns = {**self._prefill_fns, chunk_len: fn}
@@ -912,8 +996,12 @@ class Engine:
 
         key = jax.random.PRNGKey(req.seed)
         ks = jax.random.split(key)
+        # sync-ok: once per request at the prefill boundary, not per
+        # step — the first token must reach the host to decide retire
         first = int(np.asarray(sample_logits(
             last_logits, ks[1], req.temperature, req.top_k))[0])
+        # sync-ok: the carried key joins the host-side per-slot key
+        # array fed back each step; once per request
         return first, np.asarray(ks[0])
 
     def _attach_prefix(self, slot: _Slot, ids) -> int:
@@ -1144,13 +1232,18 @@ class Engine:
                     self.params, self._pool, self._tables_dev,
                     jnp.asarray(ints), jnp.asarray(keys),
                     jnp.asarray(temps), k, sampling)
+                # sync-ok: THE one host read per fused step — tokens
+                # must reach the host for EOS/retire decisions
                 toks_host = np.asarray(toks_all)  # [k, B]
             else:
                 self._cache, nxt, new_keys = self._step_fn(
                     self.params, self._cache, jnp.asarray(ints[0]),
                     jnp.asarray(ints[1]), jnp.asarray(keys),
                     jnp.asarray(temps), jnp.asarray(ints[2]), sampling)
+                # sync-ok: the one host read per dense step (EOS/retire)
                 toks_host = np.asarray(nxt)[None, :]  # [1, B]
+            # sync-ok: per-slot keys live host-side (slots join/retire
+            # between steps; a device key stack would re-gather each time)
             keys_host = np.asarray(new_keys)
         # copy-on-write rebind like _prefill_fns: stats() reads this set
         # from probe threads without the engine lock
@@ -1240,8 +1333,12 @@ class Engine:
                 self.params, self._pool, self._tables_dev,
                 jnp.asarray(chunk), jnp.asarray(ints),
                 jnp.asarray(keys), jnp.asarray(temps), W, sampling)
+            # sync-ok: the one host read per verify step — emissions
+            # and acceptance counts drive host-side truncation/retire
             emit_host = np.asarray(emit)      # [B, W]
+            # sync-ok: acceptance counts, same single post-step read
             n_host = np.asarray(n_emit)       # [B]
+            # sync-ok: per-slot keys carried host-side between steps
             keys_host = np.asarray(new_keys)
         self._step_ks = self._step_ks | {(W, sampling, True)}
         occ = self.metrics.get("occupancy")

@@ -60,6 +60,7 @@ import json
 import logging
 import threading
 from k8s_tpu.analysis import checkedlock
+from k8s_tpu.analysis import compileledger
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -222,6 +223,29 @@ class LmServer:
             # (kept as the bench_serve baseline and an escape hatch)
             self.engine = None
         self._lock = checkedlock.make_lock("server.singleflight")
+        # compile ledger (ISSUE 11): the exclusive lane's whole-generation
+        # programs are the server's own compile surface — one program per
+        # (generation config, prompt length), bounded by the decode-module
+        # lru tables.  The engine declares its own seams at construction.
+        self._ledger = compileledger.maybe_active()
+        self._seam_whole_gen = None
+        if self._ledger is not None:
+            try:
+                from jax import monitoring as _monitoring
+            except Exception:  # noqa: BLE001 - older jax: wrap fallback covers it
+                _monitoring = None
+            compileledger.ensure_listener(_monitoring)
+            from k8s_tpu.models import decode as decode_lib
+
+            bound = ((decode_lib._cached_generate_fn.cache_info().maxsize
+                      or 8)
+                     + (decode_lib.cached_speculative_fn.cache_info()
+                        .maxsize or 32))
+            self._seam_whole_gen = self._ledger.declare(
+                "server.whole_gen", bound,
+                note="exclusive-lane whole-generation programs, bounded "
+                "by the decode-module lru tables "
+                "(_cached_generate_fn + cached_speculative_fn)")
 
     def close(self) -> None:
         if self.metrics["queue_depth"]._fn == self.queue_depth:
@@ -231,6 +255,22 @@ class LmServer:
 
     def queue_depth(self) -> int:
         return self.engine.queue_depth() if self.engine is not None else 0
+
+    def compile_seams(self) -> list:
+        """Every seam this server answers for: the engine's program
+        inventory plus the exclusive lane's whole-generation table."""
+        seams = list(self.engine.compile_seams()) \
+            if self.engine is not None else []
+        if self._seam_whole_gen is not None:
+            seams.append(self._seam_whole_gen)
+        return seams
+
+    def compile_audit(self) -> Optional[dict]:
+        """Per-seam compile-budget audit for this server (None when the
+        ledger is off) — the bench phases' assertion payload."""
+        if self._ledger is None:
+            return None
+        return self._ledger.seam_audit(self.compile_seams())
 
     def model_info(self) -> dict:
         c = self.config
@@ -290,12 +330,17 @@ class LmServer:
                                       seed=parsed.seed,
                                       speculative=parsed.speculative)
         elif self.engine is not None:
-            toks = self.engine.submit_exclusive(
-                lambda: self._generate_exclusive(parsed))
+            toks = np.asarray(self.engine.submit_exclusive(
+                lambda: self._generate_exclusive(parsed)))
             self.metrics["tokens"].inc(_emitted(toks, parsed.eos))
         else:
+            # jit dispatch is async: a dispatch-only lock would pipeline
+            # the device queue and this baseline would stop measuring
+            # single-flight at all
             with self._lock:
-                toks = self._generate_exclusive(parsed)
+                # sync-ok: the legacy single-flight BASELINE deliberately
+                # syncs under its lock — serialized device work is its definition
+                toks = np.asarray(self._generate_exclusive(parsed))
             self.metrics["tokens"].inc(_emitted(toks, parsed.eos))
         toks = strip_after_eos(np.asarray(toks), parsed.eos)
         if parsed.echo_text is not None:
@@ -303,15 +348,36 @@ class LmServer:
                     + decode_bytes(np.asarray(toks))}
         return {"tokens": [int(t) for t in toks]}
 
+    def _whole_gen_programs(self) -> int:
+        """Whole-generation builder constructions so far in the decode
+        module's lru tables (the exclusive lane's program inventory).
+        ``misses`` rather than ``currsize``: once a process-global table
+        fills to maxsize a fresh config EVICTS instead of growing, and
+        an evicted-then-reused config really does rebuild (and retrace)
+        its program — both are compiles the ledger must see."""
+        from k8s_tpu.models import decode as decode_lib
+
+        return (decode_lib._cached_generate_fn.cache_info().misses
+                + decode_lib.cached_speculative_fn.cache_info().misses)
+
     def _generate_exclusive(self, parsed: ParsedRequest):
         """The pre-engine device path (sampling / speculative / legacy
-        single-flight): one whole-generation program per shape."""
+        single-flight): one whole-generation program per shape.
+
+        Returns the DEVICE row so the caller chooses where to pay the
+        host transfer: the engine's exclusive lane materializes OUTSIDE
+        the lane (holding it across the transfer would stall every
+        batched slot for nothing), while the legacy single-flight path
+        deliberately syncs under its lock — that serialization is the
+        baseline's definition."""
         import jax
         import jax.numpy as jnp
-        import numpy as np
 
         from k8s_tpu.models import decode as decode_lib
 
+        ledger, seam = self._ledger, self._seam_whole_gen
+        before = self._whole_gen_programs() if ledger is not None else 0
+        t0 = time.perf_counter()
         prompt = jnp.asarray(parsed.ids)[None, :]
         if parsed.speculative > 0:
             # temperature/top_k compose via rejection sampling: the
@@ -329,7 +395,22 @@ class LmServer:
                 rng=jax.random.PRNGKey(parsed.seed),
                 temperature=parsed.temperature, top_k=parsed.top_k,
                 eos_id=parsed.eos)
-        return np.asarray(out)[0]
+        if ledger is not None and self._whole_gen_programs() > before:
+            # a fresh whole-generation builder was constructed for this
+            # request's generation config: one distinct program, keyed
+            # by everything that selects it (prompt shape included)
+            ledger.record(seam, compileledger.fingerprint(
+                "whole_gen", (), {
+                    "prompt_len": int(parsed.ids.size),
+                    "max_new": parsed.max_new_tokens,
+                    "draft_k": parsed.speculative,
+                    "temperature": parsed.temperature,
+                    "top_k": parsed.top_k, "eos": parsed.eos},
+                static_argnames=("prompt_len", "max_new", "draft_k",
+                                 "temperature", "top_k", "eos")),
+                time.perf_counter() - t0,
+                compileledger.caller_stack())
+        return out[0]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -392,6 +473,14 @@ class _Handler(BaseHTTPRequestHandler):
 
             code, body, ctype = trace.debug_traces_response(
                 trace.TRACER, query)
+            return self._send_text(code, body, ctype)
+        if path == "/debug/compiles":
+            # XLA compile ledger: per-seam budgets + fingerprints (the
+            # SAME shared responder the metrics server and dashboard
+            # route to; 404 with an explicit body while the ledger is
+            # off — /debug/traces parity)
+            code, body, ctype = compileledger.debug_compiles_response(
+                query)
             return self._send_text(code, body, ctype)
         return self._send(404, {"error": f"unknown path {self.path}"})
 

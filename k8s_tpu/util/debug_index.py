@@ -39,6 +39,12 @@ def _fleet_active() -> bool:
     return plane is not None and plane.active
 
 
+def _compiles_active() -> bool:
+    from k8s_tpu.analysis import compileledger
+
+    return compileledger.active() is not None
+
+
 def debug_index_response(query: str = "") -> tuple[int, str, str]:
     """(status_code, body, content_type) for GET /debug (and /debug/)."""
     del query  # no parameters; kept for the shared responder signature
@@ -74,6 +80,16 @@ def debug_index_response(query: str = "") -> tuple[int, str, str]:
             "activation": "K8S_TPU_FLEET_SCRAPE=1 (the v2 controller "
                           "starts the scrape plane)",
             "params": ["job", "since", "n"],
+        },
+        {
+            "path": "/debug/compiles",
+            "subsystem": "XLA compile ledger "
+                         "(k8s_tpu.analysis.compileledger)",
+            "active": _compiles_active(),
+            "activation": "K8S_TPU_COMPILE_LEDGER=1 (the engine/server "
+                          "declare their compile-budget seams on "
+                          "construction)",
+            "params": ["seam", "n", "stacks"],
         },
     ]
     body = json.dumps({"endpoints": endpoints}, indent=2)

@@ -128,6 +128,16 @@ class MetricsServer:
 
                     code, body, ctype = fleet.debug_response(query)
                     return self._send(code, body, ctype)
+                if path == "/debug/compiles":
+                    # XLA compile ledger: per-seam budgets, fingerprint
+                    # counts/stacks, recent compile events (?seam=/?n=/
+                    # ?stacks; 404 with an explicit body until a consumer
+                    # activates the ledger — /debug/traces parity)
+                    from k8s_tpu.analysis import compileledger
+
+                    code, body, ctype = \
+                        compileledger.debug_compiles_response(query)
+                    return self._send(code, body, ctype)
                 if path in ("/debug", "/debug/"):
                     # index of the debug endpoints with active state —
                     # the same responder the dashboard serves

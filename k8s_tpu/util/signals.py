@@ -27,6 +27,7 @@ def _handler(signum, frame):  # noqa: ARG001
     for cb in list(_callbacks):
         try:
             cb()
+        # except-ok: a signal handler must never raise past one callback
         except Exception:  # noqa: BLE001 - shutdown path must not raise
             pass
 

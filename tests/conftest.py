@@ -25,3 +25,34 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _compile_ledger_per_test():
+    """ISSUE 11: when a tier runs under ``K8S_TPU_COMPILE_LEDGER=1``
+    (workload, e2e, bench_smoke), give every test a FRESH process-global
+    compile ledger — the autouse analogue of the lock-check tiers' env
+    activation.  A no-op (no instrumentation at all) when the env is
+    unset.
+
+    Scope caveat: engines/servers bind the ACTIVE ledger at
+    construction, so a module-scoped server fixture keeps recording
+    into the ledger that was active when it was built (its own seam
+    budgets still enforce consistently), while ``/debug/compiles`` and
+    ``compileledger.active()`` read this test's fresh one.  Tests that
+    assert on ledger state must construct their engine/server with a
+    ledger they hold (the ``ledger``/``ledger_server`` fixtures in
+    test_engine/test_serve_http are the pattern), never reach through a
+    module-scoped server built under an earlier test's ledger."""
+    from k8s_tpu.analysis import compileledger
+
+    if not compileledger.enabled_from_env():
+        yield
+        return
+    compileledger.set_active(compileledger.CompileLedger())
+    try:
+        yield
+    finally:
+        compileledger.set_active(None)

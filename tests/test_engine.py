@@ -223,10 +223,29 @@ class TestSlotRecycling:
             eng.shutdown()
 
 
+@pytest.fixture()
+def ledger(monkeypatch):
+    """A fresh active compile ledger (ISSUE 11) so engines constructed
+    in the test declare budget seams and record fingerprints — the
+    compile-bound tests assert on LEDGER counts, not hand-maintained
+    stats tables, so any future recompile regression fails here with
+    the offending fingerprint + stack."""
+    from k8s_tpu.analysis import compileledger
+
+    monkeypatch.setenv("K8S_TPU_COMPILE_LEDGER", "1")
+    led = compileledger.CompileLedger()
+    compileledger.set_active(led)
+    yield led
+    compileledger.set_active(None)
+
+
 class TestCompileBound:
-    def test_distinct_lengths_bounded_by_bucket_set(self, model):
+    def test_distinct_lengths_bounded_by_bucket_set(self, model, ledger):
         """Serving M distinct prompt lengths compiles at most
-        len(buckets) prefill programs + 1 decode program."""
+        len(buckets) prefill programs + 1 decode program — asserted via
+        the runtime ledger's per-seam fingerprint counts (a recompile
+        past the declared budget raises CompileBudgetExceeded outright,
+        with the fingerprint and origin stack)."""
         cfg, params = model
         eng = Engine(cfg, params, slots=2, queue_limit=32)
         try:
@@ -239,10 +258,20 @@ class TestCompileBound:
             # used — a static set bounded by MAX_STEP_TOKENS, never by
             # prompt/prefix shape
             assert 1 <= stats["decode_programs"] <= 2 * MAX_STEP_TOKENS
+            # the ledger's fingerprint counts agree with the stats
+            # tables and every seam is within its declared budget
+            audit = eng.compile_audit()
+            by_seam = {s["seam"]: s for s in audit["seams"]}
+            assert audit["over_budget"] == []
+            assert by_seam["engine.prefill"]["programs"] == \
+                len(stats["prefill_programs"])
+            assert by_seam["engine.decode_step"]["programs"] == \
+                stats["decode_programs"]
         finally:
             eng.shutdown()
 
-    def test_prefix_reuse_compiles_no_per_prefix_programs(self, model):
+    def test_prefix_reuse_compiles_no_per_prefix_programs(self, model,
+                                                          ledger):
         """With prefix reuse ON, serving many distinct prefix-share
         lengths (full hits, partial CoW hits, misses, sampled and
         greedy) still compiles only bucket prefill programs + ONE decode
@@ -263,6 +292,36 @@ class TestCompileBound:
             assert len(stats["prefill_programs"]) <= len(stats["buckets"])
             assert set(stats["prefill_programs"]) <= set(stats["buckets"])
             assert 1 <= stats["decode_programs"] <= 2 * MAX_STEP_TOKENS
+            audit = eng.compile_audit()
+            by_seam = {s["seam"]: s for s in audit["seams"]}
+            assert audit["over_budget"] == []
+            # CoW programs land in the shape-constant auxiliary seam,
+            # never in the per-request surface
+            assert by_seam["engine.aux"]["programs"] <= 4
+            assert by_seam["engine.prefill"]["programs"] == \
+                len(stats["prefill_programs"])
+        finally:
+            eng.shutdown()
+
+    def test_injected_over_budget_recompile_raises(self, model, ledger):
+        """The acceptance injection: a seam that compiles more distinct
+        programs than it declared raises CompileBudgetExceeded naming
+        the offending fingerprint — here by recording synthetic
+        fingerprints past the engine's own declared prefill budget."""
+        from k8s_tpu.analysis import compileledger
+
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=32)
+        try:
+            eng.submit(prompt_of(5, seed=0), 3)
+            seam = eng._seam_prefill
+            budget = seam.budget
+            with pytest.raises(compileledger.CompileBudgetExceeded) as ei:
+                for i in range(budget + 1):
+                    ledger.record(seam, f"prefill(int32[1,{97 + i}])",
+                                  0.01, "injected")
+            assert "engine.prefill" in str(ei.value)
+            assert ei.value.fingerprint.startswith("prefill(")
         finally:
             eng.shutdown()
 
@@ -542,9 +601,10 @@ class TestBatchedSpec:
         finally:
             eng.shutdown()
 
-    def test_compile_count_bounded_with_spec(self, model):
+    def test_compile_count_bounded_with_spec(self, model, ledger):
         """Spec traffic adds one program per (draft_k, sampling) pair
-        used — never per prompt/draft content."""
+        used — never per prompt/draft content; the ledger's spec seam
+        carries the (W, sampling) fingerprints within its budget."""
         cfg, params = model
         eng = Engine(cfg, params, slots=2, queue_limit=32)
         try:
@@ -557,6 +617,10 @@ class TestBatchedSpec:
             spec_ks = [t for t in st["decode_step_ks"] if t[2]]
             assert len(spec_ks) <= 2  # (4, greedy) and (4, sampling)
             assert st["decode_programs"] <= 2 * MAX_STEP_TOKENS + 2
+            audit = eng.compile_audit()
+            by_seam = {s["seam"]: s for s in audit["seams"]}
+            assert audit["over_budget"] == []
+            assert 1 <= by_seam["engine.spec_step"]["programs"] <= 2
         finally:
             eng.shutdown()
 

@@ -793,7 +793,8 @@ class TestFleetEndpoint:
                                  for e in json.loads(body)["endpoints"]}
                     assert set(endpoints) == {
                         "/debug/traces", "/debug/scheduler",
-                        "/debug/timeline", "/debug/fleet"}
+                        "/debug/timeline", "/debug/fleet",
+                        "/debug/compiles"}
                     assert endpoints["/debug/fleet"]["active"] is False
                     for e in endpoints.values():
                         assert "activation" in e and "params" in e

@@ -494,3 +494,91 @@ class TestEquivalenceOverHTTP:
         b = _post(url, {"tokens": toks, "max_new_tokens": 10,
                         "speculative": 4})
         assert a == b
+
+
+class TestCompileLedgerOverHTTP:
+    """ISSUE 11: with K8S_TPU_COMPILE_LEDGER=1 the server declares its
+    compile-budget seams (engine inventory + exclusive-lane whole-gen
+    table), serves them at /debug/compiles, and the compile-bound
+    assertions read LEDGER fingerprint counts — future serving PRs get
+    recompile regressions for free."""
+
+    @pytest.fixture()
+    def ledger_server(self, model, monkeypatch):
+        from k8s_tpu.analysis import compileledger
+
+        monkeypatch.setenv("K8S_TPU_COMPILE_LEDGER", "1")
+        led = compileledger.CompileLedger()
+        compileledger.set_active(led)
+        cfg, params = model
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      batch_sampling=False, registry=Registry())
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        try:
+            yield url, lm, led
+        finally:
+            httpd.shutdown()
+            lm.close()
+            compileledger.set_active(None)
+
+    def test_debug_compiles_404_without_ledger(self, server):
+        # force-inactive even under a ledgered tier (the e2e tier's
+        # K8S_TPU_COMPILE_LEDGER=1 autouse fixture activates one per
+        # test): this test pins the OFF contract
+        from k8s_tpu.analysis import compileledger
+
+        prev = compileledger.active()
+        compileledger.set_active(None)
+        try:
+            url, _, _ = server
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(url, "/debug/compiles")
+            assert ei.value.code == 404
+            assert "K8S_TPU_COMPILE_LEDGER" in ei.value.read().decode()
+        finally:
+            compileledger.set_active(prev)
+
+    def test_seams_budgets_and_debug_endpoint(self, ledger_server):
+        url, lm, led = ledger_server
+        # batched greedy -> engine seams; temperature>0 with
+        # batch_sampling=False -> the exclusive whole-gen lane
+        # distinctive generation configs: the decode module's lru
+        # program tables are process-global, and only a FRESH builder
+        # construction records a whole-gen compile (reuse is the point)
+        _post(url, {"tokens": [3, 5, 7], "max_new_tokens": 4})
+        _post(url, {"tokens": [2, 4, 6, 8], "max_new_tokens": 19,
+                    "temperature": 0.93, "seed": 3})
+        audit = lm.compile_audit()
+        by_seam = {s["seam"]: s for s in audit["seams"]}
+        assert audit["over_budget"] == []
+        assert by_seam["engine.prefill"]["programs"] >= 1
+        assert by_seam["engine.decode_step"]["programs"] >= 1
+        assert by_seam["server.whole_gen"]["programs"] == 1
+        # the same numbers over HTTP, shared-responder contract
+        status, body = _get(url, "/debug/compiles")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["over_budget"] == []
+        served = {s["seam"] for s in payload["seams"]}
+        assert {"engine.prefill", "engine.decode_step",
+                "server.whole_gen"} <= served
+        # ?seam= filters; the whole-gen fingerprint names its config
+        status, body = _get(url, "/debug/compiles?seam=server.whole_gen")
+        wg = json.loads(body)["seams"]
+        assert len(wg) == 1
+        assert any("whole_gen(" in f["fingerprint"]
+                   for f in wg[0]["fingerprints"])
+
+    def test_whole_gen_fingerprints_count_configs_not_requests(
+            self, ledger_server):
+        url, lm, led = ledger_server
+        # configs unused anywhere else in the suite: only a fresh
+        # builder construction counts (the lru tables are process-global)
+        req = {"tokens": [2, 4, 6], "max_new_tokens": 21,
+               "temperature": 0.91, "seed": 1}
+        _post(url, req)
+        _post(url, dict(req, seed=2))  # same config: no new program
+        assert led.seam_programs("server.whole_gen") == 1
+        _post(url, dict(req, max_new_tokens=23))  # new config: one more
+        assert led.seam_programs("server.whole_gen") == 2
