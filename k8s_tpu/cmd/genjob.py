@@ -64,6 +64,8 @@ def serve_tfjob_template(
     serve_prefix_blocks: int | None = None,
     serve_batch_sampling: bool = True,
     serve_batch_spec: bool = True,
+    serve_request_log: bool = True,
+    serve_request_log_ring: int | None = None,
     priority: int | None = None,
     queue: str | None = None,
     fleet_scrape_port: int | None = SERVE_HTTP_PORT,
@@ -91,7 +93,14 @@ def serve_tfjob_template(
     ``fleet_interval_s`` additionally surfaces the operator-side
     ``K8S_TPU_FLEET_INTERVAL_S`` knob on the pod for humans reading
     the manifest (the interval is an operator setting — the env on a
-    serving pod is documentation, the annotation is the contract)."""
+    serving pod is documentation, the annotation is the contract).
+
+    ISSUE 12: generated serving jobs record **per-request timelines by
+    default** — ``K8S_TPU_REQUEST_LOG=1`` activates the request
+    lifecycle recorder (``/debug/requests`` + ``/debug/engine`` on the
+    serving port), ``serve_request_log_ring`` pins the finished-
+    timeline ring bound (``K8S_TPU_REQUEST_LOG_RING``; omit for the
+    512 default), and ``serve_request_log=False`` opts out."""
     env = [
         {"name": "K8S_TPU_SERVE_SLOTS", "value": str(serve_slots)},
         {"name": "K8S_TPU_SERVE_QUEUE", "value": str(serve_queue)},
@@ -99,10 +108,15 @@ def serve_tfjob_template(
          "value": "1" if serve_batch_sampling else "0"},
         {"name": "K8S_TPU_SERVE_BATCH_SPEC",
          "value": "1" if serve_batch_spec else "0"},
+        {"name": "K8S_TPU_REQUEST_LOG",
+         "value": "1" if serve_request_log else "0"},
     ]
     if serve_prefix_blocks is not None:
         env.append({"name": "K8S_TPU_SERVE_PREFIX_BLOCKS",
                     "value": str(serve_prefix_blocks)})
+    if serve_request_log_ring is not None:
+        env.append({"name": "K8S_TPU_REQUEST_LOG_RING",
+                    "value": str(serve_request_log_ring)})
     if fleet_scrape_port is not None:
         env.append({"name": "K8S_TPU_FLEET_SCRAPE_PORT",
                     "value": str(fleet_scrape_port)})
@@ -293,6 +307,8 @@ def generate(
     serve_prefix_blocks: int | None = None,
     serve_batch_sampling: bool = True,
     serve_batch_spec: bool = True,
+    serve_request_log: bool = True,
+    serve_request_log_ring: int | None = None,
     fleet_scrape_port: int | None = 8000,
     fleet_interval_s: float | None = None,
 ) -> list[dict]:
@@ -307,6 +323,8 @@ def generate(
                 serve_prefix_blocks=serve_prefix_blocks,
                 serve_batch_sampling=serve_batch_sampling,
                 serve_batch_spec=serve_batch_spec,
+                serve_request_log=serve_request_log,
+                serve_request_log_ring=serve_request_log_ring,
                 priority=priority, queue=queue,
                 fleet_scrape_port=fleet_scrape_port,
                 fleet_interval_s=fleet_interval_s)
@@ -352,6 +370,17 @@ def main(argv=None) -> int:
                         choices=(0, 1), default=1,
                         help="K8S_TPU_SERVE_BATCH_SPEC for --serve jobs "
                         "(0 = exclusive-lane speculative decoding)")
+    parser.add_argument("--serve-request-log", type=int,
+                        choices=(0, 1), default=1,
+                        help="K8S_TPU_REQUEST_LOG for --serve jobs: the "
+                        "per-request lifecycle recorder behind "
+                        "/debug/requests and /debug/engine (default on; "
+                        "0 disables)")
+    parser.add_argument("--serve-request-log-ring", type=int,
+                        default=None,
+                        help="K8S_TPU_REQUEST_LOG_RING for --serve jobs "
+                        "(finished-timeline ring bound; omit for the "
+                        "512 default)")
     parser.add_argument("--fleet-scrape-port", type=int,
                         default=SERVE_HTTP_PORT,
                         help="kubeflow.org/fleet-scrape-port annotation + "
@@ -385,6 +414,8 @@ def main(argv=None) -> int:
         serve_prefix_blocks=args.serve_prefix_blocks,
         serve_batch_sampling=bool(args.serve_batch_sampling),
         serve_batch_spec=bool(args.serve_batch_spec),
+        serve_request_log=bool(args.serve_request_log),
+        serve_request_log_ring=args.serve_request_log_ring,
         fleet_scrape_port=args.fleet_scrape_port or None,
         fleet_interval_s=args.fleet_interval,
     )

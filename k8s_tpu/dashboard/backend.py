@@ -143,6 +143,25 @@ def _make_handler(manager: ClientManager):
                     code, body, ctype = \
                         compileledger.debug_compiles_response(query)
                     self._send_text(code, body, ctype)
+                elif path == "/debug/requests":
+                    # per-request serving timelines (ISSUE 12) — shared
+                    # responder with the metrics server and the serving
+                    # pod, same per-process scope caveat (meaningful
+                    # when this process hosts the engine; a separately
+                    # deployed dashboard hits the serving pod directly).
+                    from k8s_tpu.models import requestlog
+
+                    code, body, ctype = \
+                        requestlog.debug_requests_response(query)
+                    self._send_text(code, body, ctype)
+                elif path == "/debug/engine":
+                    # engine step ledger (ISSUE 12) — shared responder,
+                    # same scope caveat as /debug/requests above.
+                    from k8s_tpu.models import requestlog
+
+                    code, body, ctype = \
+                        requestlog.debug_engine_response(query)
+                    self._send_text(code, body, ctype)
                 elif path == "/debug":
                     # index of the debug endpoints with active state
                     # (path is rstrip("/")-normalized above, so this

@@ -91,13 +91,10 @@ def _all_replicas_running(job: dict) -> bool:
     return bool((job.get("status") or {}).get("startTime"))
 
 
-def _quantile(sorted_vals: list[float], q: float) -> float:
-    """Nearest-rank quantile over raw samples (no interpolation surprises
-    at the tiny sample counts a bench round produces)."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
+# nearest-rank quantile over raw samples (no interpolation surprises at
+# the tiny sample counts a bench round produces) — the ONE shared
+# implementation, also the serve bench's and the request recorder's
+from k8s_tpu.util.util import quantile_nearest as _quantile  # noqa: E402
 
 
 def bench_time_to_ready(jobs: int = 20, replicas: int = 4,
@@ -1412,6 +1409,26 @@ def run_churn(args) -> dict:
     return out
 
 
+def _write_requests_audit(args, result: dict | None) -> None:
+    """The requests_audit.json bench_smoke artifact (ISSUE 12): the
+    serve phases' per-phase recorder audits, extracted from the serve
+    result — written on failed runs too (the caller passes the partial
+    result attached to the assertion error)."""
+    path = getattr(args, "requests_audit_out", None)
+    if not path or result is None:
+        return
+    audits = result.get("requests_audit") or {}
+    total = sum((a.get("stats") or {}).get("finished_total", 0)
+                for a in audits.values())
+    _write_artifact(path, {
+        "metric": "requests_recorded",
+        "value": total,
+        "unit": "requests",
+        "failures": result.get("failures", []),
+        "phases": audits,
+    })
+
+
 def run_serve(args) -> dict:
     """The --serve scenario wrapper: the continuous-batching serving
     bench (harness/bench_serve.py — single-flight vs batched tokens/s
@@ -1419,7 +1436,8 @@ def run_serve(args) -> dict:
     line contract as the operator scenarios.  Imported lazily: this is
     the only scenario that pulls in JAX.  The artifact is written on
     assertion failure too, ``failures`` field included (the
-    bench_churn.json contract)."""
+    bench_churn.json contract); --requests-audit-out additionally lands
+    the request-recorder audit artifact either way."""
     from k8s_tpu.harness import bench_serve
 
     try:
@@ -1436,8 +1454,10 @@ def run_serve(args) -> dict:
         partial = getattr(e, "result", None)
         if partial is not None:
             _write_artifact(args.serve_out, partial)
+            _write_requests_audit(args, partial)
         raise
     _write_artifact(args.serve_out, result)
+    _write_requests_audit(args, result)
     return result
 
 
@@ -1601,6 +1621,12 @@ def main(argv=None) -> int:
     p.add_argument("--serve-draft-k", type=int, default=4,
                    help="speculative draft chunk width for the --serve "
                    "spec phases")
+    p.add_argument("--requests-audit-out", default=None,
+                   help="write the --serve phases' request-recorder "
+                   "audit (per-phase TTFT/TPOT/queue-wait percentiles, "
+                   "dominant-phase counts, engine step-ledger rollups, "
+                   "slowest timelines) as a requests_audit.json "
+                   "artifact — written on failed runs too (ISSUE 12)")
     p.add_argument("--serve-out", default=None,
                    help="also write the --serve JSON result to this path "
                    "(bench artifact)")

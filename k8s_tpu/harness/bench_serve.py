@@ -52,6 +52,17 @@ tokens/s, batched greedy no slower than single-flight (the paged
 decode step must preserve the continuous-batching win), and compile
 counts bounded by the engine's static program sets.
 
+Round 12 adds per-request observability: the engine phases run under a
+fresh request lifecycle recorder (models/requestlog.py), cleared at the
+warmup boundary, so every phase's artifact carries TTFT/TPOT/queue-wait
+p50/p99 of the MEASURED section plus a ``requests_audit`` block
+(dominant-phase counts, engine step-ledger rollup, slowest timelines —
+the requests_audit.json artifact ``bench_operator --requests-audit-out``
+writes).  An identical recorder-OFF batched phase pins the overhead:
+recorder-ON batched tokens/s must stay within 3% of recorder-OFF (an
+EMBEDDED assertion — the recorder must pay for itself like the compile
+ledger's lazy fingerprinting did).
+
 CPU-provable: everything runs on the host platform; no TPU required.
 Numbers are advisory trend data — ci_config.yaml wires this into the
 non-gating bench_smoke tier via ``bench_operator --serve``.
@@ -83,11 +94,9 @@ def _downsample(timeline: list, points: int) -> list:
     return out
 
 
-def _quantile(sorted_vals: list[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[int(idx)]
+# the shared nearest-rank quantile (also the request recorder's) — one
+# implementation, so bench and /debug/requests percentiles cannot drift
+from k8s_tpu.util.util import quantile_nearest as _quantile  # noqa: E402
 
 
 def build_model(seed: int = 0, hidden: int = 256, layers: int = 4):
@@ -210,7 +219,8 @@ def run_phase(config, params, *, slots: int, concurrency: int,
               spec_prompts: list | None = None,
               prefix_blocks: int | None = None,
               shared_frac: float = 0.0, template_len: int = 40,
-              tail_len: int = 6, mode: str | None = None) -> dict:
+              tail_len: int = 6, mode: str | None = None,
+              request_log: bool = False) -> dict:
     """One closed-loop phase: start a server, warm every program shape,
     then hammer it with ``concurrency`` clients and measure.
 
@@ -220,15 +230,45 @@ def run_phase(config, params, *, slots: int, concurrency: int,
     ``temperature`` with a per-request seed, and the phase reports the
     prefix-cache hit rate of the MEASURED section (warmup pre-seeds the
     tree, then counters are snapshotted — reuse wins are not conflated
-    with compile warming)."""
+    with compile warming).
+
+    ``request_log`` runs the phase under a fresh request lifecycle
+    recorder (ISSUE 12): the recorder is cleared after warmup so the
+    reported TTFT/TPOT/queue-wait percentiles cover the MEASURED
+    section, and the phase dict gains ``request_phases`` (the
+    percentiles) plus ``requests_audit`` (dominant-phase counts, engine
+    step-ledger rollup, slowest timelines)."""
     from k8s_tpu.models import decode as decode_lib
+    from k8s_tpu.models import requestlog
     from k8s_tpu.models.server import LmServer, serve
     from k8s_tpu.util.metrics import Registry
 
-    lm = LmServer(config=config, params=params, slots=slots,
-                  queue_limit=queue_limit, batch_sampling=batch_sampling,
-                  batch_spec=batch_spec,
-                  prefix_blocks=prefix_blocks, registry=Registry())
+    import os
+
+    rec = None
+    prev_rec = requestlog.active()
+    # the env knob is neutralized for BOTH arms during engine binding:
+    # with K8S_TPU_REQUEST_LOG=1 ambient (the workload/e2e tier env),
+    # Engine.__init__'s maybe_active() would auto-create a recorder and
+    # turn the recorder-OFF baseline into a second ON arm — the 3%
+    # overhead assertion would compare ON vs ON and never fire
+    prev_env = os.environ.pop(requestlog.ENV_ENABLE, None)
+    if request_log:
+        # activated BEFORE LmServer: the engine binds the active
+        # recorder at construction
+        rec = requestlog.RequestRecorder()
+        requestlog.set_active(rec)
+    else:
+        requestlog.set_active(None)
+    try:
+        lm = LmServer(config=config, params=params, slots=slots,
+                      queue_limit=queue_limit,
+                      batch_sampling=batch_sampling,
+                      batch_spec=batch_spec,
+                      prefix_blocks=prefix_blocks, registry=Registry())
+    finally:
+        if prev_env is not None:
+            os.environ[requestlog.ENV_ENABLE] = prev_env
     httpd = serve(lm)
     url = "http://%s:%d" % httpd.server_address[:2]
     gen_programs0 = decode_lib._cached_generate_fn.cache_info().currsize
@@ -270,6 +310,10 @@ def run_phase(config, params, *, slots: int, concurrency: int,
                                 "max_new_tokens": max_new,
                                 "temperature": temperature})
         warm_stats = lm.engine.stats() if lm.engine is not None else {}
+        if rec is not None:
+            # warmup boundary: the reported percentiles must cover the
+            # measured section only (compile warming is not latency)
+            rec.clear()
 
         lat_all: list[float] = []
         lat_short: list[float] = []
@@ -414,9 +458,17 @@ def run_phase(config, params, *, slots: int, concurrency: int,
             "mean_accepted_per_step": round(spec_acc / spec_steps, 3)
             if spec_steps else 0.0,
         }
+        # per-request phase percentiles of the MEASURED section (ISSUE
+        # 12): TTFT/TPOT/queue-wait p50/p99 straight from the recorder,
+        # plus the audit block requests_audit.json aggregates
+        request_phases = rec.percentiles() if rec is not None else None
+        requests_audit = rec.audit_payload() if rec is not None else None
         return {
             "mode": mode or ("batched" if slots > 0 else "single_flight"),
             "slots": slots,
+            "request_log": rec is not None,
+            "request_phases": request_phases,
+            "requests_audit": requests_audit,
             "temperature": temperature,
             "batch_sampling": bool(batch_sampling) and slots > 0,
             "batch_spec": bool(batch_spec) and slots > 0,
@@ -445,6 +497,7 @@ def run_phase(config, params, *, slots: int, concurrency: int,
     finally:
         httpd.shutdown()
         lm.close()
+        requestlog.set_active(prev_rec)
 
 
 def check_sampled_equivalence(config, params, template_len: int = 40,
@@ -524,12 +577,32 @@ def run_bench(concurrency: int = 16, slots: int = 8,
     # into the "continuous batching vs single flight" claim (the warmup
     # even pre-seeds client 0's exact prompts).  The sampled phases
     # below measure reuse explicitly.
-    batched = run_phase(config, params, slots=slots,
-                        concurrency=concurrency,
-                        requests_per_client=requests_per_client,
-                        max_new_short=max_new_short,
-                        max_new_long=max_new_long, prefix_blocks=0)
+    # Recorder overhead pairs (ISSUE 12): the IDENTICAL batched
+    # workload with the request recorder off and on — the recorder must
+    # pay for itself (within 3%, asserted below) the way the compile
+    # ledger's lazy fingerprinting did.  Interleaved best-of-2 per arm:
+    # closed-loop tokens/s on a shared CI box swings several percent
+    # run-to-run, so a single off/on pair would flake the 3% bound on
+    # scheduler noise rather than recorder cost; the max of two
+    # interleaved runs per arm compares best-case against best-case.
+    # The recorder-ON winner is the headline: it is the shipped
+    # configuration.
+    greedy_kw = dict(slots=slots, concurrency=concurrency,
+                     requests_per_client=requests_per_client,
+                     max_new_short=max_new_short,
+                     max_new_long=max_new_long, prefix_blocks=0)
+    off_runs, on_runs = [], []
+    for _ in range(2):
+        off_runs.append(run_phase(config, params,
+                                  mode="batched_recorder_off",
+                                  **greedy_kw))
+        on_runs.append(run_phase(config, params, request_log=True,
+                                 **greedy_kw))
+    batched_off = max(off_runs, key=lambda p: p["tokens_per_s"])
+    batched = max(on_runs, key=lambda p: p["tokens_per_s"])
     speedup = batched["tokens_per_s"] / max(single["tokens_per_s"], 1e-9)
+    recorder_ratio = batched["tokens_per_s"] \
+        / max(batched_off["tokens_per_s"], 1e-9)
     result = {
         "metric": "serve_tokens_per_s",
         "value": batched["tokens_per_s"],
@@ -540,6 +613,14 @@ def run_bench(concurrency: int = 16, slots: int = 8,
         "max_new_long": max_new_long,
         "single_flight": single,
         "batched": batched,
+        "batched_recorder_off": batched_off,
+        "recorder_overhead": {
+            "on_tokens_per_s": batched["tokens_per_s"],
+            "off_tokens_per_s": batched_off["tokens_per_s"],
+            "on_runs": [p["tokens_per_s"] for p in on_runs],
+            "off_runs": [p["tokens_per_s"] for p in off_runs],
+            "ratio": round(recorder_ratio, 4),
+        },
         "speedup": round(speedup, 2),
         # iteration-level scheduling headline: short requests behind a
         # long generation (p99) — serialized vs continuous batching
@@ -557,7 +638,8 @@ def run_bench(concurrency: int = 16, slots: int = 8,
             slots=slots, concurrency=concurrency * 2,
             requests_per_client=requests_per_client,
             max_new_short=max_new_short, max_new_long=max_new_long,
-            temperature=1.0, shared_frac=shared_frac)
+            temperature=1.0, shared_frac=shared_frac,
+            request_log=True)
         exclusive = run_phase(config, params, batch_sampling=False,
                               prefix_blocks=0, mode="sampled_exclusive",
                               **sampled_kw)
@@ -606,7 +688,7 @@ def run_bench(concurrency: int = 16, slots: int = 8,
             slots=slots * 2, concurrency=concurrency * 2,
             requests_per_client=requests_per_client * 2,
             max_new_short=max_new_short, max_new_long=max_new_long,
-            spec_k=draft_k,
+            spec_k=draft_k, request_log=True,
             spec_prompts=_grounded_spec_prompts(spec_config,
                                                 spec_params))
         spec_excl = run_phase(spec_config, spec_params, batch_spec=False,
@@ -630,8 +712,17 @@ def run_bench(concurrency: int = 16, slots: int = 8,
     # violated invariant attaches a ``failures`` field and raises with
     # the full result on the exception, so the artifact still lands in
     # the non-gating CI tier for whoever debugs the regression.
+    # per-phase recorder audits (the requests_audit.json artifact shape
+    # bench_operator --requests-audit-out writes, on failure too)
+    result["requests_audit"] = {
+        phase["mode"]: phase["requests_audit"]
+        for phase in (batched, result.get("sampled_batched") or {},
+                      result.get("sampled_exclusive") or {},
+                      result.get("spec_batched") or {},
+                      result.get("spec_exclusive") or {})
+        if phase and phase.get("requests_audit") is not None}
     failures: list[str] = []
-    for phase in (single, batched,
+    for phase in (single, batched, batched_off,
                   result.get("sampled_exclusive") or {},
                   result.get("sampled_batched") or {},
                   result.get("spec_exclusive") or {},
@@ -640,6 +731,16 @@ def run_bench(concurrency: int = 16, slots: int = 8,
             failures.append(
                 f"phase {phase.get('mode')}: request errors "
                 f"{phase['errors']}")
+    # the recorder must pay for itself (ISSUE 12): recorder-ON batched
+    # tokens/s within 3% of recorder-OFF on the identical workload
+    if recorder_ratio < 0.97:
+        failures.append(
+            f"request recorder overhead too high: recorder-ON batched "
+            f"{batched['tokens_per_s']} tok/s is "
+            f"{round((1 - recorder_ratio) * 100, 1)}% below "
+            f"recorder-OFF {batched_off['tokens_per_s']} tok/s "
+            "(> 3% bound): per-request recording is taxing the decode "
+            "loop it observes")
     if sampled and not result["sampled_equivalence_ok"]:
         failures.append(
             "sampled routing not output-invariant: batched sampling lane "

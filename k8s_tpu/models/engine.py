@@ -73,6 +73,16 @@ prefix tree beyond the ``1 + slots x blocks_per_row`` floor; 0 disables
 prefix reuse, unset auto-sizes to two full-length rows).  The
 ``K8S_TPU_SERVE_BATCH_SAMPLING`` and ``K8S_TPU_SERVE_BATCH_SPEC``
 lane-routing knobs live in the server.
+
+Round 12: the engine narrates itself per request.  With
+``K8S_TPU_REQUEST_LOG=1`` (models/requestlog.py) every request gets a
+bounded timeline — queue wait, prefill chunks with the prefix-reuse
+outcome, every decode step its slot rode, spec propose/accept counts,
+evictions it caused, retire reason — closed with a dominant-phase
+attribution (queue|prefill|decode|spec_reject|compile|evict), plus a
+per-iteration engine step ledger; TTFT/TPOT/queue-wait/step-duration
+histograms and the prefill-convoy counter flow through the serving
+metrics family regardless of the recorder knob.
 """
 
 from __future__ import annotations
@@ -82,8 +92,10 @@ import logging
 import math
 import os
 import threading
+import time
 from k8s_tpu.analysis import checkedlock
 from k8s_tpu.analysis import compileledger
+from k8s_tpu.models import requestlog
 from collections import deque
 from collections.abc import Mapping
 from typing import Any, Callable, Optional
@@ -197,6 +209,13 @@ class _Request:
         default_factory=threading.Event)
     result: Any = None
     error: Optional[BaseException] = None
+    # observability (ISSUE 12): request-recorder timeline id, the
+    # remote trace context the ingress extracted from the inbound W3C
+    # traceparent, submit stamp and first-token latency (TTFT)
+    rid: Optional[int] = None
+    trace_ctx: Optional[tuple] = None
+    t_submit: float = 0.0
+    ttft_s: Optional[float] = None
 
     def finish(self, result=None, error=None) -> None:
         self.result = result
@@ -403,6 +422,15 @@ class Engine:
         if self._ledger is not None:
             self._declare_seams()
 
+        # request lifecycle recorder (ISSUE 12, K8S_TPU_REQUEST_LOG=1):
+        # per-request timelines (queue wait, prefill chunks + prefix
+        # outcome, decode-step participation, spec propose/accept,
+        # evictions, retire reason, dominant-phase attribution) plus the
+        # engine step ledger — served at /debug/requests and
+        # /debug/engine.  Zero overhead when off: every call site is
+        # guarded on the None binding.
+        self._reqlog = requestlog.maybe_active()
+
         # stats (mutated on the engine thread; read under _cond)
         self._steps = 0
         self._completed = 0
@@ -425,7 +453,8 @@ class Engine:
     def submit(self, ids, max_new_tokens: int, eos_id: Optional[int] = None,
                temperature: float = 0.0, top_k: Optional[int] = None,
                seed: int = 0, speculative: int = 0,
-               timeout: Optional[float] = None) -> list[int]:
+               timeout: Optional[float] = None,
+               trace_ctx: Optional[tuple] = None) -> list[int]:
         """Batched generation (greedy at ``temperature == 0``, otherwise
         temperature/top-k sampling with the exclusive lane's exact key
         schedule for ``seed``); ``speculative=draft_k`` (>= 2) verifies
@@ -474,38 +503,69 @@ class Engine:
         req = _Request(ids=ids, max_new_tokens=int(max_new_tokens),
                        eos_id=eos_id, temperature=float(temperature),
                        top_k=top_k, seed=int(seed),
-                       speculative=int(speculative))
+                       speculative=int(speculative), trace_ctx=trace_ctx)
+        req.t_submit = time.monotonic()
+        if self._reqlog is not None:
+            req.rid = self._reqlog.begin(
+                int(ids.size), int(max_new_tokens),
+                temperature=float(temperature), top_k=top_k,
+                speculative=int(speculative),
+                trace_id=trace_ctx[0] if trace_ctx else None)
         return self._enqueue_and_wait(req, timeout)
 
     def submit_exclusive(self, fn: Callable[[], Any],
-                         timeout: Optional[float] = None):
+                         timeout: Optional[float] = None,
+                         trace_ctx: Optional[tuple] = None):
         """Run ``fn`` single-flight on the engine thread between batch
         iterations (the speculative / beam lane); FIFO with batched
         admissions through the same bounded queue."""
-        req = _Request(fn=fn)
+        req = _Request(fn=fn, trace_ctx=trace_ctx)
+        req.t_submit = time.monotonic()
+        if self._reqlog is not None:
+            req.rid = self._reqlog.begin(
+                None, 0, kind="exclusive",
+                trace_id=trace_ctx[0] if trace_ctx else None)
         return self._enqueue_and_wait(req, timeout)
 
     def _enqueue_and_wait(self, req: _Request, timeout: Optional[float]):
-        with self._cond:
-            if self._closed:
-                raise EngineClosed("engine is shut down")
-            if len(self._queue) >= self.queue_limit:
-                rej = self.metrics.get("rejected")
-                if rej is not None:
-                    rej.inc()
-                raise QueueFull(len(self._queue), self.queue_limit)
-            self._queue.append(req)
-            self._cond.notify_all()
+        try:
+            with self._cond:
+                if self._closed:
+                    raise EngineClosed("engine is shut down")
+                if len(self._queue) >= self.queue_limit:
+                    rej = self.metrics.get("rejected")
+                    if rej is not None:
+                        rej.inc()
+                    raise QueueFull(len(self._queue), self.queue_limit)
+                self._queue.append(req)
+                self._cond.notify_all()
+        except QueueFull as e:
+            # recorded OUTSIDE the engine lock (the recorder lock stays
+            # a leaf); the timeline closes as shed/queue-dominant
+            if self._reqlog is not None:
+                self._reqlog.shed(req.rid, e.depth, e.limit)
+            raise
+        except EngineClosed:
+            # the just-opened timeline must close too: _live has no
+            # ring bound, and a client retry loop against a crashed
+            # engine would otherwise leak one entry per POST
+            if self._reqlog is not None:
+                self._reqlog.retire(req.rid, "closed")
+            raise
         if not req.done.wait(timeout):
             # best-effort cancellation: a still-queued request is removed
             # so abandoned retries don't pile phantom work onto a loaded
             # engine; one already admitted to a slot runs to completion
             # (its tokens are simply discarded)
+            removed = False
             with self._cond:
                 try:
                     self._queue.remove(req)
+                    removed = True
                 except ValueError:
                     pass
+            if removed and self._reqlog is not None:
+                self._reqlog.retire(req.rid, "abandoned")
             raise TimeoutError("generation did not complete in time")
         if req.error is not None:
             raise req.error
@@ -568,6 +628,11 @@ class Engine:
                 "prefix_hits": self._prefix_hits,
                 "prefix_tokens_saved": self._prefix_tokens_saved,
                 "cow_copies": self._cow_copies,
+                "tree_evictions": self._tree.evictions
+                if self._tree else 0,
+                # request recorder binding (ISSUE 12): whether this
+                # engine records per-request timelines
+                "request_log": self._reqlog is not None,
             }
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -883,14 +948,19 @@ class Engine:
 
     # ---------------------------------------------------- block machinery
 
-    def _alloc_block(self) -> int:
+    def _alloc_block(self, slot: Optional[_Slot] = None) -> int:
         """Pop a free pool block, evicting least-recently-hit prefix-tree
         leaves as needed; with the pool floor of 1 + slots x blocks_per_
         row this cannot fail while slot tables are within capacity.
         Recycled blocks need no scrubbing: stale content sits above the
         new owner's written length and is masked by the synthesized
-        validity."""
+        validity.  ``slot`` names the request the allocation serves so
+        evictions land on ITS timeline (the ``evict`` phase)."""
         idx = self._pool_alloc.alloc()
+        if idx is not None:
+            return idx
+        t0 = time.monotonic()
+        evicted = 0
         while idx is None:
             # only leaves whose block nothing else pins: evicting a
             # slot-referenced block frees nothing and throws away a hot
@@ -904,7 +974,12 @@ class Engine:
                     "blocks) — pool sizing invariant violated")
             released = self._pool_alloc.release(victim)
             assert released, "unpinned tree leaf must free its block"
+            evicted += 1
             idx = self._pool_alloc.alloc()
+        if self._reqlog is not None and slot is not None \
+                and slot.req is not None:
+            self._reqlog.evicted(slot.req.rid, evicted,
+                                 time.monotonic() - t0)
         return idx
 
     def _release_table(self, slot: _Slot) -> None:
@@ -937,7 +1012,22 @@ class Engine:
                     if req.fn is not None:
                         self._run_exclusive(req)
                     else:
+                        # prefill convoy (ISSUE 12): decode-ready slots
+                        # stalled behind this admission's prefill — the
+                        # stall bills to each VICTIM's prefill phase and
+                        # bumps serve_prefill_convoy_total
+                        waiting = [s.req.rid for s in self._slots
+                                   if s.ready and s.req is not None]
+                        t0 = time.monotonic()
                         self._prefill_into(slot, req)
+                        if waiting:
+                            conv = self.metrics.get("prefill_convoy")
+                            if conv is not None:
+                                conv.inc()
+                            if self._reqlog is not None:
+                                dur = time.monotonic() - t0
+                                for rid in waiting:
+                                    self._reqlog.convoy(rid, dur)
                 if any(s.ready for s in self._slots):
                     self._decode_step_all()
         except BaseException:  # noqa: BLE001 - engine thread must not die silently
@@ -950,9 +1040,14 @@ class Engine:
     def _drain_locked(self) -> None:
         err = EngineClosed("engine shut down with requests in flight")
         while self._queue:
-            self._queue.popleft().finish(error=err)
+            req = self._queue.popleft()
+            if self._reqlog is not None:
+                self._reqlog.retire(req.rid, "shutdown")
+            req.finish(error=err)
         for s in self._slots:
             if s.req is not None:
+                if self._reqlog is not None:
+                    self._reqlog.retire(s.req.rid, "shutdown")
                 s.req.finish(error=err)
                 s.clear()
 
@@ -976,13 +1071,34 @@ class Engine:
     def _run_exclusive(self, req: _Request) -> None:
         from k8s_tpu import trace
 
+        rlog = self._reqlog
+        t0 = time.monotonic()
+        if req.t_submit:
+            qw_h = self.metrics.get("queue_wait")
+            if qw_h is not None:
+                qw_h.observe(t0 - req.t_submit)
+        if rlog is not None:
+            rlog.admitted(req.rid, -1, t0 - req.t_submit
+                          if req.t_submit else 0.0)
         try:
-            with trace.span("exclusive_generate"):
+            # parented under the ingress's inbound traceparent (ISSUE
+            # 12): the exclusive lane runs on the engine thread, so the
+            # contextvar chain from the handler thread does not reach
+            # here — the explicit remote context does
+            with trace.span_under(req.trace_ctx, "exclusive_generate"):
                 result = req.fn()
         except BaseException as e:  # noqa: BLE001 - surfaced to the caller
             req.finish(error=e)
+            if rlog is not None:
+                rlog.step(req.rid, 0, 1, 0, time.monotonic() - t0)
+                rlog.retire(req.rid, "error")
             return
         req.finish(result=result)
+        if rlog is not None:
+            # the whole-generation program is opaque from out here: one
+            # step record carrying its full wall time (decode phase)
+            rlog.step(req.rid, 0, 1, 0, time.monotonic() - t0)
+            rlog.retire(req.rid, "ok")
         with self._cond:
             self._completed += 1
 
@@ -1004,16 +1120,17 @@ class Engine:
         # array fed back each step; once per request
         return first, np.asarray(ks[0])
 
-    def _attach_prefix(self, slot: _Slot, ids) -> int:
+    def _attach_prefix(self, slot: _Slot, ids) -> tuple:
         """Walk the prefix tree and attach shared blocks by reference;
         copy-on-write the divergence block when the match ends mid-run.
-        Returns the number of prompt tokens whose prefill is skipped
-        (always <= len(ids) - 1: the last prompt token is recomputed for
-        its logits)."""
+        Returns ``(shared, blocks, cow)``: the number of prompt tokens
+        whose prefill is skipped (always <= len(ids) - 1: the last
+        prompt token is recomputed for its logits), the blocks attached,
+        and whether the divergence block was copy-on-written."""
         import jax.numpy as jnp
 
         if self._tree is None:
-            return 0
+            return 0, 0, False
         full, partial = self._tree.match(ids, len(ids) - 1)
         shared = 0
         for node in full:
@@ -1023,7 +1140,7 @@ class Engine:
             shared += self.block_size
         if partial is not None:
             node, j = partial
-            dst = self._alloc_block()
+            dst = self._alloc_block(slot)
             self._pool = self._cow_fn(
                 self._pool, jnp.asarray(node.block, jnp.int32),
                 jnp.asarray(dst, jnp.int32))
@@ -1040,7 +1157,8 @@ class Engine:
             saved = self.metrics.get("prefill_saved")
             if saved is not None:
                 saved.inc(shared)
-        return shared
+        return shared, len(full) + (1 if partial is not None else 0), \
+            partial is not None
 
     def _prefill_into(self, slot: _Slot, req: _Request) -> None:
         """Prefill one prompt into the slot (tail-only when a prefix was
@@ -1052,24 +1170,40 @@ class Engine:
         from k8s_tpu import trace
 
         ids = req.ids
+        rlog = self._reqlog
+        t_adm = time.monotonic()
+        qw = t_adm - req.t_submit if req.t_submit else 0.0
+        qw_h = self.metrics.get("queue_wait")
+        if qw_h is not None:
+            qw_h.observe(qw)
+        if rlog is not None:
+            rlog.admitted(req.rid, slot.idx, qw)
         try:
             if self.paged:
-                shared = self._attach_prefix(slot, ids)
+                shared, pblocks, cow = self._attach_prefix(slot, ids)
+                if rlog is not None:
+                    rlog.prefix_outcome(
+                        req.rid,
+                        "cow" if cow else ("hit" if shared else "miss"),
+                        pblocks, shared)
                 # blocks covering the unshared prompt tail (the CoW
                 # block, if any, already covers its own span)
                 needed = math.ceil(len(ids) / self.block_size)
                 while slot.nblocks < needed:
-                    slot.table[slot.nblocks] = self._alloc_block()
+                    slot.table[slot.nblocks] = self._alloc_block(slot)
                     slot.nblocks += 1
                 self._tables_dirty = True
                 self._update_block_gauge()
                 chunks = split_prefill(len(ids) - shared, self.buckets)
-                with trace.span("prefill", prompt_len=len(ids),
-                                chunks=len(chunks), shared=shared):
+                with trace.span_under(req.trace_ctx, "prefill",
+                                      prompt_len=len(ids),
+                                      chunks=len(chunks), shared=shared):
                     table_dev = jnp.asarray(slot.table)
                     off = shared
                     last = None
                     for c in chunks:
+                        compiled = c not in self._prefill_fns
+                        tc0 = time.monotonic()
                         chunk = jnp.asarray(ids[off:off + c],
                                             jnp.int32)[None, :]
                         positions = (off + jnp.arange(
@@ -1077,6 +1211,10 @@ class Engine:
                         self._pool, last = self._prefill_fn(c)(
                             self.params, self._pool, table_dev, chunk,
                             positions)
+                        if rlog is not None:
+                            rlog.prefill_chunk(
+                                req.rid, c, time.monotonic() - tc0,
+                                compiled)
                         off += c
                     first, slot.key = self._first_token(req, last)
                 if self._tree is not None:
@@ -1091,31 +1229,54 @@ class Engine:
                         self._pool_alloc.retain(node.block)
             else:
                 chunks = split_prefill(len(ids), self.buckets)
-                with trace.span("prefill", prompt_len=len(ids),
-                                chunks=len(chunks)):
+                with trace.span_under(req.trace_ctx, "prefill",
+                                      prompt_len=len(ids),
+                                      chunks=len(chunks)):
                     cache = self._row_template
                     off = 0
                     last = None
                     for c in chunks:
+                        compiled = c not in self._prefill_fns
+                        tc0 = time.monotonic()
                         chunk = jnp.asarray(ids[off:off + c],
                                             jnp.int32)[None, :]
                         positions = (off + jnp.arange(
                             c, dtype=jnp.int32))[None, :]
                         cache, last = self._prefill_fn(c)(
                             self.params, cache, chunk, positions)
+                        if rlog is not None:
+                            rlog.prefill_chunk(
+                                req.rid, c, time.monotonic() - tc0,
+                                compiled)
                         off += c
                     first, slot.key = self._first_token(req, last)
         except BaseException as e:  # noqa: BLE001 - bad request must not kill the loop
             req.finish(error=e)
+            if rlog is not None:
+                rlog.retire(req.rid, "error")
             with self._cond:
                 if self.paged:
                     self._release_table(slot)
                 slot.clear()
             return
+        # TTFT: submit to first emitted token, the _first_token sync
+        # above having forced the whole prefill chain
+        now = time.monotonic()
+        req.ttft_s = now - req.t_submit if req.t_submit else None
+        if req.ttft_s is not None:
+            tt_h = self.metrics.get("ttft")
+            if tt_h is not None:
+                tt_h.observe(req.ttft_s)
+        if rlog is not None:
+            rlog.prefill_done(req.rid, now - t_adm,
+                              req.ttft_s if req.ttft_s is not None
+                              else now - t_adm)
         tokens = [first]
         if (req.eos_id is not None and first == req.eos_id) \
                 or req.max_new_tokens <= 1:
-            self._retire(slot, req, tokens)
+            self._retire(slot, req, tokens,
+                         "eos" if req.eos_id is not None
+                         and first == req.eos_id else "max_tokens")
             return
         if not self.paged:
             self._cache = self._scatter_fn(self._cache, cache,
@@ -1132,7 +1293,8 @@ class Engine:
                 self._peak_active,
                 sum(1 for s in self._slots if not s.free))
 
-    def _retire(self, slot: _Slot, req: _Request, tokens: list[int]) -> None:
+    def _retire(self, slot: _Slot, req: _Request, tokens: list[int],
+                reason: str = "max_tokens") -> None:
         tok_counter = self.metrics.get("tokens")
         if tok_counter is not None:
             tok_counter.inc(len(tokens))
@@ -1140,6 +1302,18 @@ class Engine:
             sampled = self.metrics.get("sampled_batched")
             if sampled is not None:
                 sampled.inc()
+        # TPOT: decode-side per-token latency, (e2e - TTFT) / (n - 1) —
+        # the Gemma-on-TPU serving comparison's definition, so the
+        # fleet-plane p99 means the same thing the paper reports
+        if req.ttft_s is not None and len(tokens) > 1 and req.t_submit:
+            tp_h = self.metrics.get("tpot")
+            if tp_h is not None:
+                tp_h.observe(
+                    (time.monotonic() - req.t_submit - req.ttft_s)
+                    / (len(tokens) - 1))
+        if self._reqlog is not None:
+            self._reqlog.retire(req.rid, reason, tokens=len(tokens),
+                                ttft_s=req.ttft_s)
         req.finish(result=tokens)
         with self._cond:
             self._completed += 1
@@ -1201,7 +1375,7 @@ class Engine:
             for s in active:
                 need_bi = (s.pos + k - 1) // self.block_size
                 while s.nblocks <= need_bi:
-                    s.table[s.nblocks] = self._alloc_block()
+                    s.table[s.nblocks] = self._alloc_block(s)
                     s.nblocks += 1
                     grew = True
             if grew:
@@ -1222,6 +1396,9 @@ class Engine:
         # argmax-only program (no per-row sort/split/categorical tax on
         # pure-greedy traffic)
         sampling = any(s.req.temperature > 0 for s in active)
+        step_key = (k if self.paged else 1, sampling, False)
+        step_compiled = step_key not in self._step_ks
+        t_step = time.monotonic()
         with trace.span("decode_step", active=len(active), fused=k):
             if self.paged:
                 if self._tables_dirty:
@@ -1245,10 +1422,13 @@ class Engine:
             # sync-ok: per-slot keys live host-side (slots join/retire
             # between steps; a device key stack would re-gather each time)
             keys_host = np.asarray(new_keys)
+        step_dur = time.monotonic() - t_step
+        sd_h = self.metrics.get("step_duration")
+        if sd_h is not None:
+            sd_h.observe(step_dur)
         # copy-on-write rebind like _prefill_fns: stats() reads this set
         # from probe threads without the engine lock
-        self._step_ks = self._step_ks | {
-            (k if self.paged else 1, sampling, False)}
+        self._step_ks = self._step_ks | {step_key}
         occ = self.metrics.get("occupancy")
         if occ is not None:
             occ.set(len(active))
@@ -1256,6 +1436,18 @@ class Engine:
             for i in range(k):
                 self._steps += 1
                 self._occupancy.append((self._steps, len(active)))
+            seq = self._steps
+        rlog = self._reqlog
+        if rlog is not None:
+            # ledger + per-request participation BEFORE the retire loop
+            # clears slots (the fused-step gate guarantees every active
+            # row emitted exactly k tokens); a step that compiled a
+            # fresh (width, sampling) program bills to the compile phase
+            rlog.engine_step(seq, len(active), k, 0,
+                             k * len(active), step_dur)
+            for s in active:
+                rlog.step(s.req.rid, seq, k, k, step_dur,
+                          compiled=step_compiled)
         for s in active:
             req = s.req
             for i in range(k):
@@ -1267,7 +1459,8 @@ class Engine:
                 if hit_eos or len(s.tokens) >= req.max_new_tokens:
                     assert i == k - 1, "mid-scan retirement is excluded" \
                         " by the fused-step gate"
-                    self._retire(s, req, s.tokens)
+                    self._retire(s, req, s.tokens,
+                                 "eos" if hit_eos else "max_tokens")
                     break
             else:
                 s.key = keys_host[s.idx]
@@ -1299,7 +1492,7 @@ class Engine:
             w = W if s.req.speculative else 1
             need_bi = (s.pos + w - 1) // self.block_size
             while s.nblocks <= need_bi:
-                s.table[s.nblocks] = self._alloc_block()
+                s.table[s.nblocks] = self._alloc_block(s)
                 s.nblocks += 1
                 grew = True
         if grew:
@@ -1323,6 +1516,9 @@ class Engine:
             temps[s.idx] = s.req.temperature
         sampling = any(s.req.temperature > 0 for s in active)
         n_spec = sum(1 for s in active if s.req.speculative)
+        step_key = (W, sampling, True)
+        step_compiled = step_key not in self._step_ks
+        t_step = time.monotonic()
         with trace.span("decode_step", active=len(active), fused=W,
                         spec=n_spec):
             if self._tables_dirty:
@@ -1340,13 +1536,26 @@ class Engine:
             n_host = np.asarray(n_emit)       # [B]
             # sync-ok: per-slot keys carried host-side between steps
             keys_host = np.asarray(new_keys)
-        self._step_ks = self._step_ks | {(W, sampling, True)}
+        step_dur = time.monotonic() - t_step
+        sd_h = self.metrics.get("step_duration")
+        if sd_h is not None:
+            sd_h.observe(step_dur)
+        self._step_ks = self._step_ks | {step_key}
         occ = self.metrics.get("occupancy")
         if occ is not None:
             occ.set(len(active))
         with self._cond:
             self._steps += 1
             self._occupancy.append((self._steps, len(active)))
+            seq = self._steps
+        rlog = self._reqlog
+        if rlog is not None:
+            # n_host is ALREADY on the host (the one post-step read
+            # above); summing it costs no device round-trip
+            emitted = 0
+            for s in active:  # sync-ok: host-side numpy sum, no device read
+                emitted += int(n_host[s.idx])
+            rlog.engine_step(seq, len(active), W, W, emitted, step_dur)
         prop_c = self.metrics.get("spec_proposed")
         acc_c = self.metrics.get("spec_accepted")
         for s in active:
@@ -1362,6 +1571,16 @@ class Engine:
                     prop_c.inc(W - 1)
                 if acc_c is not None:
                     acc_c.inc(n - 1)
+            if rlog is not None:
+                # a spec slot's verify chunk splits its wall time into
+                # accepted (decode) and rejected (spec_reject) shares;
+                # a plain rider records a width-1 decode participation
+                rlog.step(req.rid, seq,
+                          W if req.speculative else 1, n, step_dur,
+                          compiled=step_compiled,
+                          spec=bool(req.speculative),
+                          proposed=W - 1 if req.speculative else 0,
+                          accepted=n - 1 if req.speculative else 0)
             out: list[int] = []
             done = False
             # truncate exactly as the exclusive lane's program: at the
@@ -1377,4 +1596,5 @@ class Engine:
             if s.ctx is not None:
                 s.ctx.extend(out)
             if done or len(s.tokens) >= req.max_new_tokens:
-                self._retire(s, req, s.tokens)
+                self._retire(s, req, s.tokens,
+                             "eos" if done else "max_tokens")

@@ -111,6 +111,9 @@ class PrefixTree:
         self.root = PrefixNode((), 0, None)
         self._clock = 0
         self.nodes = 0
+        # lifetime leaf evictions (pool-pressure signal: the engine's
+        # stats() and the request recorder's evict phase both read it)
+        self.evictions = 0
 
     def _tick(self) -> int:
         self._clock += 1
@@ -207,6 +210,7 @@ class PrefixTree:
             return None
         del best.parent.children[best.tokens]
         self.nodes -= 1
+        self.evictions += 1
         return best.block
 
     def clear(self) -> list[int]:

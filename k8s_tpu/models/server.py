@@ -21,6 +21,13 @@ Endpoints (JSON over HTTP/1.1, stdlib-only like the rest of the repo):
 - ``GET /debug/traces`` → recent prefill/decode_step span trees (the
   operator's responder, k8s_tpu.trace; 404 with an explicit body when
   K8S_TPU_TRACE_SAMPLE is 0).
+- ``GET /debug/requests`` / ``GET /debug/engine`` → per-request serving
+  timelines with dominant-phase attribution and the engine step ledger
+  (ISSUE 12; the shared k8s_tpu.models.requestlog responders, 404 with
+  an explicit body until ``K8S_TPU_REQUEST_LOG=1`` activates the
+  recorder), plus ``GET /debug`` — the shared endpoint index.  An
+  inbound W3C ``traceparent`` on POST /v1/generate parents the server
+  and engine spans, joining caller → ingress → engine into one trace.
 - ``POST /v1/generate`` with ``{"text": str | "tokens": [int], ...}`` →
   ``{"text": str | "tokens": [int]}``.  Optional fields:
   ``max_new_tokens`` (default from --max_new_tokens), ``temperature``,
@@ -299,11 +306,20 @@ class LmServer:
                 # step, so the fleet plane can rate acceptance per job
                 "spec_proposed": s["spec_proposed"],
                 "spec_accepted": s["spec_accepted"],
-                "spec_mean_accepted": s["spec_mean_accepted"]}
+                "spec_mean_accepted": s["spec_mean_accepted"],
+                # per-request recorder binding (ISSUE 12)
+                "request_log": s["request_log"]}
 
-    def generate(self, parsed: ParsedRequest) -> dict:
+    def generate(self, parsed: ParsedRequest,
+                 trace_ctx: Optional[tuple] = None) -> dict:
         """One validated generation request (parse_request ran on the
-        handler thread).  May raise engine.QueueFull under backpressure."""
+        handler thread).  May raise engine.QueueFull under backpressure.
+
+        ``trace_ctx`` is the ``(trace_id, span_id, sampled)`` context the
+        HTTP ingress extracted from the inbound W3C ``traceparent`` (or
+        minted for its own server span): the engine parents its
+        prefill/exclusive spans under it across the thread hop, so one
+        trace spans caller -> ingress -> engine (ISSUE 12)."""
         import numpy as np
 
         from k8s_tpu.models.dataset import decode_bytes
@@ -328,10 +344,12 @@ class LmServer:
                                       temperature=parsed.temperature,
                                       top_k=parsed.top_k,
                                       seed=parsed.seed,
-                                      speculative=parsed.speculative)
+                                      speculative=parsed.speculative,
+                                      trace_ctx=trace_ctx)
         elif self.engine is not None:
             toks = np.asarray(self.engine.submit_exclusive(
-                lambda: self._generate_exclusive(parsed)))
+                lambda: self._generate_exclusive(parsed),
+                trace_ctx=trace_ctx))
             self.metrics["tokens"].inc(_emitted(toks, parsed.eos))
         else:
             # jit dispatch is async: a dispatch-only lock would pipeline
@@ -482,6 +500,28 @@ class _Handler(BaseHTTPRequestHandler):
             code, body, ctype = compileledger.debug_compiles_response(
                 query)
             return self._send_text(code, body, ctype)
+        if path == "/debug/requests":
+            # request lifecycle recorder (ISSUE 12): per-request serving
+            # timelines with dominant-phase attribution (?id=/?slow=/
+            # ?phase=/?n=; 404 with an explicit body until
+            # K8S_TPU_REQUEST_LOG activates a recorder)
+            from k8s_tpu.models import requestlog
+
+            code, body, ctype = requestlog.debug_requests_response(query)
+            return self._send_text(code, body, ctype)
+        if path == "/debug/engine":
+            # engine step ledger: per-iteration occupancy/width/tokens/
+            # wall-time records + windowed rollups (same 404 contract)
+            from k8s_tpu.models import requestlog
+
+            code, body, ctype = requestlog.debug_engine_response(query)
+            return self._send_text(code, body, ctype)
+        if path in ("/debug", "/debug/"):
+            # the shared debug index (what is servable right now)
+            from k8s_tpu.util.debug_index import debug_index_response
+
+            code, body, ctype = debug_index_response(query)
+            return self._send_text(code, body, ctype)
         return self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
@@ -513,11 +553,23 @@ class _Handler(BaseHTTPRequestHandler):
         except RequestError as e:
             m["requests"].labels("bad_request").inc()
             return self._send(400, {"error": str(e), "field": e.field})
+        from k8s_tpu import trace
         from k8s_tpu.models.engine import QueueFull
 
+        # end-to-end trace join (ISSUE 12): the inbound W3C traceparent
+        # (the operator-side propagation machinery emits it) parents this
+        # request's server span, and the engine's prefill/exclusive spans
+        # parent under THAT across the thread hop — one trace per request
+        # across processes.  With tracing off the recorder still keeps
+        # the inbound trace id on the timeline, so the join survives.
+        inbound = trace.parse_traceparent(self.headers.get("traceparent"))
         start = time.monotonic()
         try:
-            out = lm.generate(parsed)
+            with trace.span_under(inbound, "serve_request",
+                                  prompt_len=int(parsed.ids.size),
+                                  max_new=parsed.max_new_tokens) as sspan:
+                ctx = trace.span_context(sspan) or inbound
+                out = lm.generate(parsed, trace_ctx=ctx)
         except QueueFull as e:
             # backpressure: shed with an explicit retry hint; /healthz
             # stays 200 (the serve_rejected_total counter is incremented

@@ -70,6 +70,24 @@ def record_span(name: str, duration_s: float, **attributes):
     return TRACER.record_span(name, duration_s, **attributes)
 
 
+def span_under(parent_ctx, name: str, **attributes):
+    """A span under an explicit remote ``(trace_id, span_id, sampled)``
+    context (the :func:`parse_traceparent` shape) — how the serving
+    ingress parents the engine's prefill/decode/exclusive spans under an
+    inbound W3C ``traceparent`` across threads and processes.  ``None``
+    falls back to :func:`span`."""
+    return TRACER.start_span_under(parent_ctx, name, **attributes)
+
+
+def span_context(span) -> Optional[tuple]:
+    """The ``(trace_id, span_id, sampled)`` tuple of a live span, or
+    None for a no-op/absent span — the hand-off shape for parenting
+    work on another thread under it."""
+    if span is None or getattr(span, "trace_id", None) is None:
+        return None
+    return span.trace_id, span.span_id, span.head_sampled
+
+
 def current_traceparent() -> Optional[str]:
     """W3C traceparent for the current span, or None."""
     sp = current_span()

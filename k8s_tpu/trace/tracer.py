@@ -285,6 +285,35 @@ class Tracer:
         return Span(self, name, parent, parent.trace_id,
                     parent.head_sampled, attributes)
 
+    def start_span_under(self, parent_ctx, name: str, **attributes):
+        """A span explicitly parented under a REMOTE context —
+        ``parent_ctx`` is the ``(trace_id, span_id, sampled)`` tuple
+        :func:`k8s_tpu.trace.parse_traceparent` returns (the serving
+        ingress's inbound W3C header, or a server span handed across
+        threads to the engine).  ``None`` falls back to
+        :meth:`start_span`, so call sites need no branching.
+
+        The span joins the remote TRACE (same trace_id, parent_id = the
+        remote span id) but is a local root: it finishes through the
+        tail-based keep decision with the inbound sampled flag as its
+        head-sampling vote, so a sampled upstream keeps the local
+        subtree and an unsampled one still keeps slow/errored spans."""
+        if parent_ctx is None:
+            return self.start_span(name, **attributes)
+        if not self.enabled:
+            return NOOP_SPAN
+        trace_id, parent_span_id, sampled = parent_ctx
+        parent = _current_span.get()
+        if parent is not None and parent is not NOOP_SPAN \
+                and parent.trace_id == trace_id:
+            # already inside the same trace (the handler thread's server
+            # span): nest normally instead of forking a second root
+            return Span(self, name, parent, trace_id,
+                        parent.head_sampled, attributes)
+        span = Span(self, name, None, trace_id, bool(sampled), attributes)
+        span.parent_id = parent_span_id
+        return span
+
     def record_span(self, name: str, duration_s: float, **attributes):
         """Record an already-elapsed interval ending now as a child of the
         current span (e.g. the workqueue wait that preceded a sync).

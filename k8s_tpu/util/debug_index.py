@@ -45,6 +45,12 @@ def _compiles_active() -> bool:
     return compileledger.active() is not None
 
 
+def _requests_active() -> bool:
+    from k8s_tpu.models import requestlog
+
+    return requestlog.active() is not None
+
+
 def debug_index_response(query: str = "") -> tuple[int, str, str]:
     """(status_code, body, content_type) for GET /debug (and /debug/)."""
     del query  # no parameters; kept for the shared responder signature
@@ -90,6 +96,24 @@ def debug_index_response(query: str = "") -> tuple[int, str, str]:
                           "declare their compile-budget seams on "
                           "construction)",
             "params": ["seam", "n", "stacks"],
+        },
+        {
+            "path": "/debug/requests",
+            "subsystem": "request lifecycle recorder "
+                         "(k8s_tpu.models.requestlog)",
+            "active": _requests_active(),
+            "activation": "K8S_TPU_REQUEST_LOG=1 (the serving engine "
+                          "binds the recorder on construction)",
+            "params": ["id", "slow", "phase", "n"],
+        },
+        {
+            "path": "/debug/engine",
+            "subsystem": "engine step ledger "
+                         "(k8s_tpu.models.requestlog)",
+            "active": _requests_active(),
+            "activation": "K8S_TPU_REQUEST_LOG=1 (the serving engine "
+                          "binds the recorder on construction)",
+            "params": ["n"],
         },
     ]
     body = json.dumps({"endpoints": endpoints}, indent=2)

@@ -681,4 +681,42 @@ def serving_metrics(registry: Optional[Registry] = None,
             "accepted/proposed is the drafting hit rate the fleet plane "
             "can rate per job.",
         ),
+        # -- per-request phase metrics (ISSUE 12) --------------------------
+        # The TTFT/TPOT split the Gemma-on-TPU serving comparison
+        # reports: whole-request duration decomposed into time-to-first-
+        # token (queue + prefill + first sample) and per-output-token
+        # decode latency.  Histograms, so the fleet plane's merged-
+        # bucket quantiles and `serve_ttft_seconds:p99<…` SLO burn-rate
+        # rules work on them unchanged.
+        "ttft": r.histogram(
+            "serve_ttft_seconds",
+            "Time to first token: request submit to the first emitted "
+            "token (queue wait + prefill + first sample), batched-lane "
+            "generations.",
+        ),
+        "tpot": r.histogram(
+            "serve_tpot_seconds",
+            "Time per output token after the first: (e2e - TTFT) / "
+            "(tokens - 1), per completed generation with >= 2 tokens.",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 1.0),
+        ),
+        "queue_wait": r.histogram(
+            "serve_queue_wait_seconds",
+            "Admission-queue wait: request submit to slot admission "
+            "(or to the exclusive lane picking it up).",
+        ),
+        "step_duration": r.histogram(
+            "serve_step_duration_seconds",
+            "Wall time of one batched engine program call (fused decode "
+            "scan or speculative verify step), host read included.",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 1.0, 2.5),
+        ),
+        "prefill_convoy": r.counter(
+            "serve_prefill_convoy_total",
+            "Admissions whose prefill ran while >= 1 decode-ready slot "
+            "waited (the prefill convoy: decode stalled behind another "
+            "request's prefill).",
+        ),
     }

@@ -138,6 +138,24 @@ class MetricsServer:
                     code, body, ctype = \
                         compileledger.debug_compiles_response(query)
                     return self._send(code, body, ctype)
+                if path == "/debug/requests":
+                    # request lifecycle recorder (ISSUE 12): per-request
+                    # serving timelines with dominant-phase attribution
+                    # (?id=/?slow=/?phase=/?n=; 404 with an explicit
+                    # body until K8S_TPU_REQUEST_LOG activates it)
+                    from k8s_tpu.models import requestlog
+
+                    code, body, ctype = \
+                        requestlog.debug_requests_response(query)
+                    return self._send(code, body, ctype)
+                if path == "/debug/engine":
+                    # engine step ledger: per-iteration records +
+                    # windowed rollups (same 404 contract)
+                    from k8s_tpu.models import requestlog
+
+                    code, body, ctype = \
+                        requestlog.debug_engine_response(query)
+                    return self._send(code, body, ctype)
                 if path in ("/debug", "/debug/"):
                     # index of the debug endpoints with active state —
                     # the same responder the dashboard serves

@@ -39,6 +39,18 @@ def pformat(obj) -> str:
         return repr(obj)
 
 
+def quantile_nearest(sorted_vals, q: float) -> float:
+    """Nearest-rank quantile of an ascending-sorted sequence (0.0 for
+    empty input) — THE one implementation the bench harnesses and the
+    request recorder share, so their percentiles cannot silently
+    diverge."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[int(idx)]
+
+
 def rand_string(n: int, rng: random.Random | None = None) -> str:
     """Random lowercase ascii string of length ``n`` (pkg/util/util.go:59-66).
 

@@ -56,3 +56,28 @@ def _compile_ledger_per_test():
         yield
     finally:
         compileledger.set_active(None)
+
+
+@pytest.fixture(autouse=True)
+def _request_log_per_test():
+    """ISSUE 12: when a tier runs under ``K8S_TPU_REQUEST_LOG=1``
+    (workload, e2e, bench_smoke), give every test a FRESH process-global
+    request recorder — the compile-ledger conftest pattern.  A no-op (no
+    instrumentation at all) when the env is unset.
+
+    Same scope caveat as the compile ledger: engines bind the ACTIVE
+    recorder at construction, so a module-scoped server fixture keeps
+    recording into the recorder active when it was built, while
+    ``/debug/requests`` and ``requestlog.active()`` read this test's
+    fresh one.  Tests that assert on recorder state construct their own
+    engine under a recorder they hold."""
+    from k8s_tpu.models import requestlog
+
+    if not requestlog.enabled_from_env():
+        yield
+        return
+    requestlog.set_active(requestlog.RequestRecorder())
+    try:
+        yield
+    finally:
+        requestlog.set_active(None)

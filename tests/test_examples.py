@@ -85,14 +85,19 @@ class TestExampleManifests:
         assert "k8s_tpu.models.server" in c["command"]
         assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
         assert any(p.get("containerPort") == 8000 for p in c["ports"])
-        # all five engine knobs surfaced: slots/queue (ISSUE 5), the
+        # all seven engine knobs surfaced: slots/queue (ISSUE 5), the
         # prefix-reuse retention and sampling-lane routing (ISSUE 6),
-        # and the speculative-lane routing (ISSUE 9)
+        # the speculative-lane routing (ISSUE 9), and the per-request
+        # lifecycle recorder + ring bound (ISSUE 12)
         env = {e["name"] for e in c["env"]}
         assert {"K8S_TPU_SERVE_SLOTS", "K8S_TPU_SERVE_QUEUE",
                 "K8S_TPU_SERVE_PREFIX_BLOCKS",
                 "K8S_TPU_SERVE_BATCH_SAMPLING",
-                "K8S_TPU_SERVE_BATCH_SPEC"} <= env
+                "K8S_TPU_SERVE_BATCH_SPEC",
+                "K8S_TPU_REQUEST_LOG",
+                "K8S_TPU_REQUEST_LOG_RING"} <= env
+        envv = {e["name"]: e["value"] for e in c["env"]}
+        assert envv["K8S_TPU_REQUEST_LOG"] == "1"
 
     def test_tpu_smoke_yaml(self):
         job = load_one("tpu_smoke.yaml")

@@ -133,6 +133,12 @@ class TestParser:
         sm["duration"].observe(7.5)  # lands in a high bucket
         sm["occupancy"].set(3.5)
         sm["queue_depth"].set(2)
+        # ISSUE 12 per-request phase families (TTFT/TPOT split)
+        sm["ttft"].observe(0.21)
+        sm["tpot"].observe(0.008)
+        sm["queue_wait"].observe(0.04)
+        sm["step_duration"].observe(0.006)
+        sm["prefill_convoy"].inc(2)
         flight.reset_all()
         metrics_mod.flight_metrics(reg)
         flight.ACCOUNTING.record("GET", "pods", 200, 0.004)
@@ -165,10 +171,24 @@ class TestParser:
                          "apiserver_request_duration_seconds",
                          "watch_relists_total", "events_recorded_total",
                          "fleet_scrape_total",
-                         "fleet_scrape_duration_seconds", "fleet_targets"):
+                         "fleet_scrape_duration_seconds", "fleet_targets",
+                         # ISSUE 12: the per-request phase families the
+                         # serving pods export and the fleet plane
+                         # merges/burn-rates
+                         "serve_ttft_seconds", "serve_tpot_seconds",
+                         "serve_queue_wait_seconds",
+                         "serve_step_duration_seconds",
+                         "serve_prefill_convoy_total"):
             assert expected in fams, f"family {expected} missing"
         assert fams["tfjob_sync_duration_seconds"].kind == "histogram"
         assert fams["fleet_scrape_total"].kind == "counter"
+        assert fams["serve_ttft_seconds"].kind == "histogram"
+        assert fams["serve_tpot_seconds"].kind == "histogram"
+        assert fams["serve_prefill_convoy_total"].kind == "counter"
+        # the TTFT histogram decomposes: the fleet plane's merged-bucket
+        # quantiles (and serve_ttft_seconds:p99<… SLO rules) work on it
+        assert fleet.histogram_points(
+            fams["serve_ttft_seconds"])[()]["count"] == 1
         # histograms decompose cleanly (le ordering, +Inf == _count)
         pts = fleet.histogram_points(fams["serve_request_duration_seconds"])
         assert pts[()]["count"] == 2
@@ -432,6 +452,47 @@ class TestSlo:
         assert ev.breached("ns/j")
         assert ev.breaches()[("ns/j",
                               "serve_request_duration_seconds:p99<0.5")] == 1
+
+    def test_ttft_p99_rule_breaches_on_slow_first_tokens(self):
+        """ISSUE 12: the worked `serve_ttft_seconds:p99<0.5` rule from
+        docs/observability.md — the per-request TTFT histogram the
+        serving engine now exports flows through the fleet plane into a
+        burn-rate breach with zero new plumbing (the rule syntax gained
+        the family for free because it is a plain histogram)."""
+        def ttft_text(fast, slow):
+            total = fast + slow
+            return (
+                "# TYPE serve_ttft_seconds histogram\n"
+                f'serve_ttft_seconds_bucket{{le="0.1"}} {fast}\n'
+                f'serve_ttft_seconds_bucket{{le="0.5"}} {fast}\n'
+                f'serve_ttft_seconds_bucket{{le="2.5"}} {total}\n'
+                f'serve_ttft_seconds_bucket{{le="+Inf"}} {total}\n'
+                f"serve_ttft_seconds_sum {total}\n"
+                f"serve_ttft_seconds_count {total}\n")
+
+        agg = FleetAggregator()
+        ev = SloEvaluator(parse_rules("serve_ttft_seconds:p99<0.5"),
+                          agg, windows=(4.0, 16.0))
+        transitions = []
+        sink = (lambda job, rule, state, breached:
+                transitions.append((breached, state["burn_short"])))
+        t = 0.0
+        for _ in range(20):  # healthy: every first token under 100ms
+            agg.ingest("ns/serve", "p0", fleet.parse_exposition(
+                ttft_text(fast=100 * (t + 1), slow=0)), t)
+            ev.evaluate(["ns/serve"], t, sinks=(sink,))
+            t += 1.0
+        assert transitions == []
+        for _ in range(3):  # a prefill convoy: 10%+ of TTFTs go slow
+            agg.ingest("ns/serve", "p0", fleet.parse_exposition(
+                ttft_text(fast=2100.0, slow=200.0 * (t - 19))), t)
+            ev.evaluate(["ns/serve"], t, sinks=(sink,))
+            t += 1.0
+        assert transitions and transitions[0][0] is True
+        assert transitions[0][1] >= 1.0  # burning >= the budget rate
+        assert ev.breached("ns/serve")
+        assert ev.breaches()[("ns/serve",
+                              "serve_ttft_seconds:p99<0.5")] == 1
 
     def test_gauge_rule_and_recovery_transition(self):
         agg = FleetAggregator()
@@ -794,7 +855,8 @@ class TestFleetEndpoint:
                     assert set(endpoints) == {
                         "/debug/traces", "/debug/scheduler",
                         "/debug/timeline", "/debug/fleet",
-                        "/debug/compiles"}
+                        "/debug/compiles", "/debug/requests",
+                        "/debug/engine"}
                     assert endpoints["/debug/fleet"]["active"] is False
                     for e in endpoints.values():
                         assert "activation" in e and "params" in e

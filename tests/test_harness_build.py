@@ -436,12 +436,15 @@ class TestGenjob:
 
     def test_serve_job_surfaces_engine_knobs(self):
         """--serve jobs carry the serving engine's env knobs, including
-        the round-6 prefix-reuse pool size and the sampling- and
-        speculative-lane routing."""
+        the round-6 prefix-reuse pool size, the sampling- and
+        speculative-lane routing, and the round-12 request-recorder
+        activation + ring bound."""
         [job] = genjob.generate(1, serve=True, timestamp=7, serve_slots=4,
                                 serve_queue=32, serve_prefix_blocks=16,
                                 serve_batch_sampling=False,
-                                serve_batch_spec=False)
+                                serve_batch_spec=False,
+                                serve_request_log=False,
+                                serve_request_log_ring=128)
         c = job["spec"]["tfReplicaSpecs"]["Worker"][
             "template"]["spec"]["containers"][0]
         env = {e["name"]: e["value"] for e in c["env"]}
@@ -450,6 +453,8 @@ class TestGenjob:
         assert env["K8S_TPU_SERVE_PREFIX_BLOCKS"] == "16"
         assert env["K8S_TPU_SERVE_BATCH_SAMPLING"] == "0"
         assert env["K8S_TPU_SERVE_BATCH_SPEC"] == "0"
+        assert env["K8S_TPU_REQUEST_LOG"] == "0"
+        assert env["K8S_TPU_REQUEST_LOG_RING"] == "128"
         assert "k8s_tpu.models.server" in c["command"]
         assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
         # schedulable on a real cluster: TPU/memory limits and the
@@ -464,7 +469,8 @@ class TestGenjob:
 
     def test_serve_job_default_prefix_sizing_is_auto(self):
         # no PREFIX_BLOCKS env unless pinned: unset means auto-size in
-        # the engine (0 would DISABLE reuse — not a default)
+        # the engine (0 would DISABLE reuse — not a default); same for
+        # the request-log ring (unset = the recorder's 512 default)
         [job] = genjob.generate(1, serve=True, timestamp=8)
         c = job["spec"]["tfReplicaSpecs"]["Worker"][
             "template"]["spec"]["containers"][0]
@@ -472,6 +478,10 @@ class TestGenjob:
         assert "K8S_TPU_SERVE_PREFIX_BLOCKS" not in env
         assert env["K8S_TPU_SERVE_BATCH_SAMPLING"] == "1"
         assert env["K8S_TPU_SERVE_BATCH_SPEC"] == "1"  # default on
+        # ISSUE 12: generated serving jobs record request timelines by
+        # default, with the ring bound left to the recorder default
+        assert env["K8S_TPU_REQUEST_LOG"] == "1"
+        assert "K8S_TPU_REQUEST_LOG_RING" not in env
 
     def test_unique_names_and_scheduler(self):
         jobs = genjob.generate(3, scheduler_name="kube-batch", timestamp=9)

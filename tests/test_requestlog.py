@@ -1,0 +1,554 @@
+"""Per-request serving observability (ISSUE 12): the request lifecycle
+recorder + engine step ledger (models/requestlog.py), the engine's
+recording seams, dominant-phase attribution, the end-to-end traceparent
+join, and the /debug/requests + /debug/engine endpoints on all three
+HTTP servers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_tpu.models import requestlog
+from k8s_tpu.models.engine import Engine
+from k8s_tpu.models.server import LmServer, serve
+from k8s_tpu.models.transformer import Transformer, TransformerConfig
+from k8s_tpu.util.metrics import Registry
+
+
+def tiny(**kw):
+    base = dict(vocab_size=61, hidden=32, ffn_hidden=64, layers=2,
+                heads=4, kv_heads=4, max_seq_len=64, dtype=jnp.float32,
+                remat=False)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny()
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 5), jnp.int32))["params"]
+    return cfg, params
+
+
+@pytest.fixture()
+def recorder():
+    """A fresh recorder installed as THE active one (engines bind at
+    construction), restored afterwards — never leaks across tests."""
+    prev = requestlog.active()
+    rec = requestlog.RequestRecorder(max_requests=64)
+    requestlog.set_active(rec)
+    yield rec
+    requestlog.set_active(prev)
+
+
+def _engine(model, rec_expected=True, **kw):
+    cfg, params = model
+    eng = Engine(cfg, params, **kw)
+    assert (eng._reqlog is not None) == rec_expected
+    return eng
+
+
+def _get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# -- recorder core ------------------------------------------------------------
+
+
+class TestRecorderCore:
+    def test_ring_bounds_and_eviction(self):
+        """The finished ring is bounded: oldest-finished timelines are
+        evicted past max_requests and the eviction is counted."""
+        rec = requestlog.RequestRecorder(max_requests=3)
+        for _ in range(5):
+            rid = rec.begin(4, 8)
+            rec.retire(rid, "max_tokens", tokens=2)
+        stats = rec.stats()
+        assert stats["finished"] == 3
+        assert stats["finished_total"] == 5
+        assert stats["evicted_timelines"] == 2
+        # the survivors are the three most recent, in finish order
+        assert [e["id"] for e in rec.snapshot()] == [3, 4, 5]
+        # evicted ids are gone, recent ids resolvable
+        assert rec.request(1) is None
+        assert rec.request(5) is not None
+
+    def test_per_request_event_cap(self):
+        rec = requestlog.RequestRecorder(max_events_per_request=4)
+        rid = rec.begin(4, 128)
+        for seq in range(10):
+            rec.step(rid, seq, 1, 1, 0.001)
+        rec.retire(rid, "max_tokens")
+        entry = rec.request(rid)
+        # 4 kept (the retire event itself is then dropped too), rest
+        # counted instead of growing the timeline
+        assert len(entry["events"]) == 4
+        assert entry["events_dropped"] == 7
+        assert entry["steps"] == 10  # counters keep the full truth
+
+    def test_ring_size_env_knob(self, monkeypatch):
+        monkeypatch.setenv("K8S_TPU_REQUEST_LOG_RING", "7")
+        assert requestlog.RequestRecorder().max_requests == 7
+        monkeypatch.setenv("K8S_TPU_REQUEST_LOG_RING", "garbage")
+        assert requestlog.RequestRecorder().max_requests \
+            == requestlog.DEFAULT_MAX_REQUESTS
+
+    def test_shed_closes_timeline_queue_dominant(self):
+        rec = requestlog.RequestRecorder()
+        rid = rec.begin(4, 8)
+        rec.shed(rid, depth=64, limit=64)
+        [entry] = rec.snapshot()
+        assert entry["retire"] == "shed"
+        assert entry["dominant_phase"] == "queue"
+        assert rec.stats()["shed_total"] == 1
+
+    def test_retire_is_idempotent(self):
+        rec = requestlog.RequestRecorder()
+        rid = rec.begin(4, 8)
+        rec.retire(rid, "max_tokens", tokens=3)
+        rec.retire(rid, "error")  # late duplicate: ignored
+        [entry] = rec.snapshot()
+        assert entry["retire"] == "max_tokens"
+        assert rec.stats()["finished_total"] == 1
+
+    def test_slow_filter_sees_live_requests(self):
+        """A request STUCK in flight must be visible to ?slow= — live
+        entries report time-since-submit as their elapsed, not a None
+        e2e that filters them out."""
+        rec = requestlog.RequestRecorder()
+        rid = rec.begin(4, 8)
+        time.sleep(0.02)
+        [entry] = rec.snapshot(slow_s=0.01)
+        assert entry["id"] == rid and entry["state"] == "live"
+        assert entry["elapsed_s"] >= 0.01
+        # a still-queued live entry is provisionally queue-dominant, so
+        # the docs' ?slow=&phase=queue investigation query surfaces it
+        assert entry["dominant_phase"] == "queue"
+        assert rec.snapshot(slow_s=0.01, phase="queue")
+        # and a finished entry's elapsed is its e2e
+        rec.retire(rid, "max_tokens")
+        [entry] = rec.snapshot()
+        assert entry["elapsed_s"] == entry["e2e_s"]
+
+    def test_engine_ledger_ring_and_rollup(self):
+        rec = requestlog.RequestRecorder(max_steps=4)
+        for seq in range(6):
+            rec.engine_step(seq, active=2, width=1, spec_group=0,
+                            tokens=2, dur_s=0.01)
+        roll = rec.engine_rollup()
+        assert roll["window"] == 4  # ring bound
+        assert roll["steps_total"] == 6
+        assert roll["mean_occupancy"] == 2.0
+        assert roll["tokens_per_s"] == pytest.approx(200.0, rel=0.01)
+        assert len(rec.engine_steps(limit=10)) == 4
+
+
+# -- the engine records through it --------------------------------------------
+
+
+class TestEngineRecording:
+    def test_off_is_noop(self, model, monkeypatch):
+        """No active recorder at construction AND no env activation
+        (maybe_active would auto-create one under the CI tiers'
+        K8S_TPU_REQUEST_LOG=1): the engine binds None, serves normally,
+        and records nothing anywhere."""
+        monkeypatch.delenv("K8S_TPU_REQUEST_LOG", raising=False)
+        prev = requestlog.active()
+        requestlog.set_active(None)
+        try:
+            eng = _engine(model, rec_expected=False, slots=2,
+                          queue_limit=8)
+            out = eng.submit([1, 2, 3, 4, 5], 4)
+            assert len(out) == 4
+            assert not eng.stats()["request_log"]
+            assert requestlog.active() is None
+            eng.shutdown()
+        finally:
+            requestlog.set_active(prev)
+
+    def test_lifecycle_fields_recorded(self, model, recorder):
+        eng = _engine(model, slots=2, queue_limit=8)
+        out = eng.submit([1, 2, 3, 4, 5], 6, seed=1)
+        assert len(out) == 6
+        [entry] = recorder.snapshot()
+        assert entry["state"] == "done"
+        assert entry["retire"] == "max_tokens"
+        assert entry["prompt_len"] == 5 and entry["tokens"] == 6
+        assert entry["queue_wait_s"] is not None
+        assert entry["ttft_s"] is not None
+        assert entry["tpot_s"] is not None
+        assert entry["e2e_s"] >= entry["ttft_s"]
+        assert entry["steps"] >= 1
+        assert entry["prefix"] is not None  # paged engine: outcome set
+        assert entry["dominant_phase"] in requestlog.PHASES
+        # phase seconds cover a meaningful share of e2e (attribution is
+        # measurement, not guesswork)
+        assert sum(entry["phase_s"].values()) > 0.5 * entry["e2e_s"]
+        full = recorder.request(entry["id"])
+        kinds = [e["kind"] for e in full["events"]]
+        assert kinds[0] == "admitted" and "prefill_chunk" in kinds \
+            and "first_token" in kinds and kinds[-1] == "retire"
+        assert recorder.engine_rollup()["steps_total"] >= 1
+        eng.shutdown()
+
+    def test_queue_delayed_request_attributes_to_queue(self, model,
+                                                       recorder):
+        """THE acceptance-criterion scenario: a deliberately queue-
+        delayed request (slots=1 behind a long generation) must close
+        with dominant phase `queue`."""
+        eng = _engine(model, slots=1, queue_limit=8)
+        # warm every program the two requests use, so compile stalls
+        # don't smear into the attribution under test
+        eng.submit([1, 2, 3, 4, 5], 48)
+        eng.submit([9, 8, 7], 2)
+        recorder.clear()
+        long_t = threading.Thread(
+            target=lambda: eng.submit([1, 2, 3, 4, 5], 48), daemon=True)
+        long_t.start()
+        while eng.active_slots() == 0:  # long request owns THE slot
+            time.sleep(0.002)
+        out = eng.submit([9, 8, 7], 2)  # waits for the whole long gen
+        long_t.join()
+        assert len(out) == 2
+        victim = [e for e in recorder.snapshot()
+                  if e["prompt_len"] == 3][0]
+        assert victim["dominant_phase"] == "queue"
+        assert victim["queue_wait_s"] > 0.5 * victim["e2e_s"]
+        eng.shutdown()
+
+    def test_cow_heavy_request_records_cow_outcome(self, model,
+                                                   recorder):
+        """A deliberately CoW-heavy request — shares a prefix with a
+        cached prompt but diverges mid-block — records the copy-on-
+        write outcome with its attached blocks and saved tokens."""
+        cfg, _ = model
+        eng = _engine(model, slots=2, queue_limit=8)
+        bs = eng.block_size
+        base = [(i * 3 + 1) % 50 for i in range(2 * bs + 4)]
+        eng.submit(base, 2)  # seeds the tree with two full blocks
+        recorder.clear()
+        # same first block, diverge mid-way through the SECOND block
+        fork = base[:bs + bs // 2] + [55] * (bs // 2 + 4)
+        eng.submit(fork, 2)
+        [entry] = recorder.snapshot()
+        assert entry["prefix"]["outcome"] == "cow"
+        assert entry["prefix"]["blocks"] >= 2  # full hit + CoW block
+        assert entry["prefix"]["tokens_saved"] >= bs
+        assert entry["phase_s"]["prefill"] >= 0.0
+        eng.shutdown()
+
+    def test_spec_request_records_propose_accept(self, model, recorder):
+        eng = _engine(model, slots=2, queue_limit=8)
+        out = eng.submit([1, 2, 3] * 6, 6, speculative=3)
+        assert len(out) == 6
+        [entry] = recorder.snapshot()
+        assert entry["speculative"] == 3
+        assert entry["spec"]["chunks"] >= 1
+        assert entry["spec"]["proposed"] \
+            == 2 * entry["spec"]["chunks"]  # draft_k - 1 per verify
+        assert entry["spec"]["accepted"] <= entry["spec"]["proposed"]
+        # attribution saw the verify steps: decode and/or spec_reject
+        # (plus compile for the first-touch programs) own the tail
+        assert entry["phase_s"]["decode"] \
+            + entry["phase_s"]["spec_reject"] \
+            + entry["phase_s"]["compile"] > 0
+        eng.shutdown()
+
+    def test_shed_recorded_via_engine(self, model, recorder):
+        eng = _engine(model, slots=1, queue_limit=0)
+        from k8s_tpu.models.engine import QueueFull
+
+        with pytest.raises(QueueFull):
+            eng.submit([1, 2, 3], 4)
+        assert recorder.stats()["shed_total"] == 1
+        eng.shutdown()
+
+    def test_closed_engine_submit_leaks_no_live_timeline(self, model,
+                                                         recorder):
+        """A retry loop against a crashed/closed engine must not grow
+        the recorder: the EngineClosed path closes the just-opened
+        timeline (the _live dict has no ring bound)."""
+        from k8s_tpu.models.engine import EngineClosed
+
+        eng = _engine(model, slots=1, queue_limit=4)
+        eng.shutdown()
+        for _ in range(3):
+            with pytest.raises(EngineClosed):
+                eng.submit([1, 2, 3], 4)
+        stats = recorder.stats()
+        assert stats["live"] == 0
+        assert all(e["retire"] == "closed"
+                   for e in recorder.snapshot())
+
+    def test_fixed_seed_equivalence_unchanged_with_recorder_on(
+            self, model, recorder):
+        """Recorder-on must not perturb generation: batched sampling
+        lane output stays token-identical to the exclusive lane at a
+        fixed seed (the round-6 exactness claim, re-pinned under
+        recording)."""
+        cfg, params = model
+        payload = dict(ids=[1, 2, 3, 4, 5, 6, 7], max_new=6,
+                       temperature=1.0, seed=11)
+        outs = []
+        for batch_sampling in (True, False):
+            lm = LmServer(config=cfg, params=params, slots=2,
+                          queue_limit=8, batch_sampling=batch_sampling,
+                          registry=Registry())
+            try:
+                from k8s_tpu.models.server import parse_request
+
+                parsed = parse_request(
+                    cfg, {"tokens": payload["ids"],
+                          "max_new_tokens": payload["max_new"],
+                          "temperature": payload["temperature"],
+                          "seed": payload["seed"]}, 16)
+                outs.append(lm.generate(parsed))
+            finally:
+                lm.close()
+        assert outs[0] == outs[1]
+        # and both lanes recorded timelines while doing it
+        assert recorder.stats()["finished_total"] >= 2
+
+
+# -- traceparent join ---------------------------------------------------------
+
+
+class TestTraceJoin:
+    def test_inbound_traceparent_reaches_engine_spans_and_timeline(
+            self, model, recorder, monkeypatch):
+        """The end-to-end join: an inbound W3C traceparent on POST
+        /v1/generate parents the server span AND the engine's prefill
+        span (engine thread — no contextvar chain) under the caller's
+        trace id, and the recorder stamps the same trace id on the
+        request timeline."""
+        from k8s_tpu import trace
+
+        cfg, params = model
+        trace_id = "a" * 32
+        header = f"00-{trace_id}-{'b' * 16}-01"
+        exported = []
+        monkeypatch.setattr(
+            trace.TRACER, "sample_rate", 1.0, raising=False)
+        monkeypatch.setattr(
+            trace.TRACER.exporter, "export",
+            lambda root: exported.append(root))
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      registry=Registry())
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        try:
+            req = urllib.request.Request(
+                url + "/v1/generate",
+                data=json.dumps({"tokens": [1, 2, 3, 4, 5],
+                                 "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": header}, method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.status == 200
+        finally:
+            httpd.shutdown()
+            lm.close()
+        by_name = {}
+        stack = [r.to_dict() for r in exported]
+        while stack:
+            span = stack.pop()
+            by_name.setdefault(span["name"], []).append(span)
+            stack.extend(span.get("children") or [])
+        # the server span joined the inbound trace...
+        [srv] = by_name["serve_request"]
+        assert srv["trace_id"] == trace_id
+        # ...and the engine-side prefill span (another thread) did too
+        assert any(s["trace_id"] == trace_id
+                   for s in by_name["prefill"])
+        # the recorder's timeline carries the same id, so the join
+        # works even with tracing sampled out
+        [entry] = [e for e in recorder.snapshot()
+                   if e["trace_id"] is not None]
+        assert entry["trace_id"] == trace_id
+
+    def test_timeline_trace_id_without_tracer(self, model, recorder):
+        """Tracing off (the default): the recorder still joins — the
+        inbound trace id lands on the timeline."""
+        cfg, params = model
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      registry=Registry())
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        trace_id = "c" * 32
+        try:
+            req = urllib.request.Request(
+                url + "/v1/generate",
+                data=json.dumps({"tokens": [1, 2, 3],
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": f"00-{trace_id}-{'d' * 16}-01"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.status == 200
+        finally:
+            httpd.shutdown()
+            lm.close()
+        assert any(e["trace_id"] == trace_id
+                   for e in recorder.snapshot())
+
+    def test_span_under_falls_back_without_context(self):
+        from k8s_tpu import trace
+
+        # None context: plain span semantics, usable as a context mgr
+        with trace.span_under(None, "x"):
+            pass
+
+
+# -- debug endpoints: 404 parity on all three servers -------------------------
+
+
+class TestDebugEndpoints:
+    def _assert_404(self, url, path):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(url, path)
+        assert ei.value.code == 404
+        assert b"K8S_TPU_REQUEST_LOG" in ei.value.read()
+
+    def test_responders_404_when_inactive(self):
+        prev = requestlog.active()
+        requestlog.set_active(None)
+        try:
+            for fn in (requestlog.debug_requests_response,
+                       requestlog.debug_engine_response):
+                code, body, _ = fn("")
+                assert code == 404 and "K8S_TPU_REQUEST_LOG" in body
+        finally:
+            requestlog.set_active(prev)
+
+    def test_metrics_server_parity(self):
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        prev = requestlog.active()
+        requestlog.set_active(None)
+        srv = MetricsServer(0, registry=Registry()).start()
+        url = f"http://127.0.0.1:{srv.port}"
+        try:
+            for path in ("/debug/requests", "/debug/engine"):
+                self._assert_404(url, path)
+            rec = requestlog.RequestRecorder()
+            requestlog.set_active(rec)
+            rid = rec.begin(4, 8)
+            rec.retire(rid, "max_tokens", tokens=2)
+            status, body = _get(url, "/debug/requests?n=5")
+            assert status == 200
+            assert json.loads(body)["stats"]["finished"] == 1
+            status, body = _get(url, "/debug/engine")
+            assert status == 200 and "rollup" in json.loads(body)
+            # the /debug index lists both endpoints as active now
+            status, body = _get(url, "/debug/")
+            rows = {e["path"]: e
+                    for e in json.loads(body)["endpoints"]}
+            assert rows["/debug/requests"]["active"]
+            assert rows["/debug/engine"]["active"]
+        finally:
+            srv.stop()
+            requestlog.set_active(prev)
+
+    def test_dashboard_backend_parity(self):
+        from k8s_tpu.client.clientset import Clientset
+        from k8s_tpu.client.fake import FakeCluster
+        from k8s_tpu.dashboard.backend import DashboardServer
+
+        prev = requestlog.active()
+        requestlog.set_active(None)
+        server = DashboardServer(Clientset(FakeCluster()),
+                                 host="127.0.0.1", port=0)
+        server.start_background()
+        url = f"http://127.0.0.1:{server.port}"
+        try:
+            for path in ("/debug/requests", "/debug/engine"):
+                self._assert_404(url, path)
+            requestlog.set_active(requestlog.RequestRecorder())
+            status, _ = _get(url, "/debug/requests")
+            assert status == 200
+            status, _ = _get(url, "/debug/engine")
+            assert status == 200
+        finally:
+            server.shutdown()
+            requestlog.set_active(prev)
+
+    def test_serving_pod_parity_and_content(self, model, monkeypatch):
+        """The serving pod itself: 404 while inactive, live timelines
+        with dominant phases and the step ledger once active (plus the
+        /debug index row)."""
+        # env off for the inactive half: under the CI tiers'
+        # K8S_TPU_REQUEST_LOG=1 the engine's maybe_active() would
+        # auto-create a recorder and defeat the 404 assertion
+        monkeypatch.delenv("K8S_TPU_REQUEST_LOG", raising=False)
+        cfg, params = model
+        prev = requestlog.active()
+        requestlog.set_active(None)
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      registry=Registry())
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        try:
+            for path in ("/debug/requests", "/debug/engine"):
+                self._assert_404(url, path)
+        finally:
+            httpd.shutdown()
+            lm.close()
+            requestlog.set_active(prev)
+        # active recorder + fresh server: requests become lookups
+        rec = requestlog.RequestRecorder()
+        requestlog.set_active(rec)
+        lm = LmServer(config=cfg, params=params, slots=2, queue_limit=8,
+                      registry=Registry())
+        httpd = serve(lm)
+        url = "http://%s:%d" % httpd.server_address[:2]
+        try:
+            req = urllib.request.Request(
+                url + "/v1/generate",
+                data=json.dumps({"tokens": [1, 2, 3, 4, 5],
+                                 "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.status == 200
+            status, body = _get(url, "/debug/requests")
+            assert status == 200
+            payload = json.loads(body)
+            [entry] = payload["requests"]
+            assert entry["retire"] == "max_tokens"
+            assert entry["dominant_phase"] in requestlog.PHASES
+            # ?id= returns the full event timeline
+            status, body = _get(url,
+                                f"/debug/requests?id={entry['id']}")
+            assert status == 200
+            assert any(e["kind"] == "prefill_chunk" for e in
+                       json.loads(body)["request"]["events"])
+            # phase filter round-trips; a bogus phase is a 400
+            status, _ = _get(
+                url, f"/debug/requests?phase={entry['dominant_phase']}")
+            assert status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(url, "/debug/requests?phase=nonsense")
+            assert ei.value.code == 400
+            status, body = _get(url, "/debug/engine?n=8")
+            assert status == 200
+            engine_payload = json.loads(body)
+            assert engine_payload["rollup"]["steps_total"] >= 1
+            assert engine_payload["steps"]
+            # /healthz surfaces the binding
+            status, body = _get(url, "/healthz")
+            assert json.loads(body)["serving"]["request_log"] is True
+        finally:
+            httpd.shutdown()
+            lm.close()
+            requestlog.set_active(prev)
