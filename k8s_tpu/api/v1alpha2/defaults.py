@@ -43,3 +43,8 @@ def set_defaults_tfjob(tfjob: types.TFJob) -> None:
         tfjob.spec.priority = 0
     if not tfjob.spec.queue:
         tfjob.spec.queue = types.DEFAULT_SCHEDULING_QUEUE
+    # autoscale bounds (ISSUE 13): the scaled type defaults to Worker —
+    # the serving-job shape genjob --serve emits
+    if tfjob.spec.autoscale is not None \
+            and not tfjob.spec.autoscale.replica_type:
+        tfjob.spec.autoscale.replica_type = types.TFReplicaTypeWorker

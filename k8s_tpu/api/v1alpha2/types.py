@@ -111,6 +111,39 @@ class TFReplicaSpec:
 
 
 @dataclass
+class AutoscaleSpec:
+    """Serving-fleet autoscale bounds (ISSUE 13): the operator's
+    metric-driven autoscaler may move ``replicaType``'s replica count
+    inside ``[minReplicas, maxReplicas]`` — and nowhere else.  Absent
+    spec = that job is never autoscaled (the compatibility default);
+    the loop itself is additionally gated by ``K8S_TPU_AUTOSCALE``."""
+
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    # which replica type scales; SetDefaults fills "Worker"
+    replica_type: str = ""
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.min_replicas is not None:
+            d["minReplicas"] = self.min_replicas
+        if self.max_replicas is not None:
+            d["maxReplicas"] = self.max_replicas
+        if self.replica_type:
+            d["replicaType"] = self.replica_type
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "AutoscaleSpec":
+        d = d or {}
+        return cls(
+            min_replicas=d.get("minReplicas"),
+            max_replicas=d.get("maxReplicas"),
+            replica_type=d.get("replicaType", ""),
+        )
+
+
+@dataclass
 class TFJobSpec:
     """types.go:44-54 + TPU slice topology."""
 
@@ -126,6 +159,8 @@ class TFJobSpec:
     # grouping label.  None = unset; SetDefaults fills 0 / "default".
     priority: Optional[int] = None
     queue: Optional[str] = None
+    # serving autoscale bounds (ISSUE 13); None = never autoscaled
+    autoscale: Optional[AutoscaleSpec] = None
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -141,6 +176,8 @@ class TFJobSpec:
             d["priority"] = self.priority
         if self.queue is not None:
             d["queue"] = self.queue
+        if self.autoscale is not None:
+            d["autoscale"] = self.autoscale.to_dict()
         return d
 
     @classmethod
@@ -155,6 +192,8 @@ class TFJobSpec:
             active_deadline_seconds=d.get("activeDeadlineSeconds"),
             priority=d.get("priority"),
             queue=d.get("queue"),
+            autoscale=(AutoscaleSpec.from_dict(d["autoscale"])
+                       if d.get("autoscale") else None),
         )
 
 

@@ -64,6 +64,7 @@ def validate_v1alpha2_tfjob_spec(spec: v2.TFJobSpec) -> None:
             f"activeDeadlineSeconds must be > 0, "
             f"got {spec.active_deadline_seconds}")
     _validate_scheduling_fields(spec)
+    _validate_autoscale(spec)
     for rtype, r in spec.tf_replica_specs.items():
         if rtype not in v2.VALID_REPLICA_TYPES:
             raise ValidationError(
@@ -109,6 +110,35 @@ def _validate_scheduling_fields(spec: v2.TFJobSpec) -> None:
             raise ValidationError(
                 f"queue must be a label-shaped name (<= 63 chars, "
                 f"alphanumeric ends), got {spec.queue!r}")
+
+
+def _validate_autoscale(spec: v2.TFJobSpec) -> None:
+    """Autoscale bounds (ISSUE 13): genuine ints with
+    1 <= min <= max, and the scaled replica type must exist in the spec
+    (after SetDefaults filled "Worker") — a bound on a phantom type
+    would make the autoscaler a no-op that LOOKS configured."""
+    a = spec.autoscale
+    if a is None:
+        return
+    for field_name, value in (("minReplicas", a.min_replicas),
+                              ("maxReplicas", a.max_replicas)):
+        if value is None:
+            raise ValidationError(
+                f"autoscale.{field_name} is required when autoscale is set")
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise ValidationError(
+                f"autoscale.{field_name} must be an integer, got {value!r}")
+        if value < 1:
+            raise ValidationError(
+                f"autoscale.{field_name} must be >= 1, got {value}")
+    if a.min_replicas > a.max_replicas:
+        raise ValidationError(
+            f"autoscale.minReplicas {a.min_replicas} must be <= "
+            f"maxReplicas {a.max_replicas}")
+    if a.replica_type and a.replica_type not in spec.tf_replica_specs:
+        raise ValidationError(
+            f"autoscale.replicaType {a.replica_type!r} has no replica spec "
+            f"(have {sorted(spec.tf_replica_specs)})")
 
 
 def _require_container(template: dict, container_name: str, rtype: str) -> None:

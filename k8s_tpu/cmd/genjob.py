@@ -70,6 +70,8 @@ def serve_tfjob_template(
     queue: str | None = None,
     fleet_scrape_port: int | None = SERVE_HTTP_PORT,
     fleet_interval_s: float | None = None,
+    autoscale_min: int | None = None,
+    autoscale_max: int | None = None,
 ) -> dict:
     """A resident serving TFJob (the examples/tf_job_serve_http.yaml
     shape) with the engine knobs surfaced as env: decode slots and
@@ -100,7 +102,12 @@ def serve_tfjob_template(
     lifecycle recorder (``/debug/requests`` + ``/debug/engine`` on the
     serving port), ``serve_request_log_ring`` pins the finished-
     timeline ring bound (``K8S_TPU_REQUEST_LOG_RING``; omit for the
-    512 default), and ``serve_request_log=False`` opts out."""
+    512 default), and ``serve_request_log=False`` opts out.
+
+    ISSUE 13: ``autoscale_min``/``autoscale_max`` (both or neither)
+    stamp the ``spec.autoscale`` bounds the operator's metric-driven
+    gang autoscaler scales inside (``K8S_TPU_AUTOSCALE`` gates the loop
+    itself); the Worker replica count starts at ``autoscale_min``."""
     env = [
         {"name": "K8S_TPU_SERVE_SLOTS", "value": str(serve_slots)},
         {"name": "K8S_TPU_SERVE_QUEUE", "value": str(serve_queue)},
@@ -128,6 +135,9 @@ def serve_tfjob_template(
         template_meta["annotations"] = {
             "kubeflow.org/fleet-scrape-port": str(fleet_scrape_port),
         }
+    if (autoscale_min is None) != (autoscale_max is None):
+        raise ValueError("give both autoscale_min and autoscale_max "
+                         "(or neither)")
     job = {
         "apiVersion": "kubeflow.org/v1alpha2",
         "kind": "TFJob",
@@ -135,7 +145,8 @@ def serve_tfjob_template(
         "spec": {
             "tfReplicaSpecs": {
                 "Worker": {
-                    "replicas": 1,
+                    "replicas": (autoscale_min if autoscale_min is not None
+                                 else 1),
                     "restartPolicy": "OnFailure",
                     "template": {
                         **({"metadata": template_meta}
@@ -195,7 +206,79 @@ def serve_tfjob_template(
         job["spec"]["priority"] = priority
     if queue is not None:
         job["spec"]["queue"] = queue
+    if autoscale_min is not None:
+        job["spec"]["autoscale"] = {
+            "minReplicas": autoscale_min,
+            "maxReplicas": autoscale_max,
+            "replicaType": "Worker",
+        }
     return job
+
+
+ROUTER_HTTP_PORT = 8080
+
+
+def router_companion_template(
+    job_name: str,
+    namespace: str = "default",
+    router_port: int = ROUTER_HTTP_PORT,
+    policy: str = "affine",
+    block_size: int | None = None,
+    affinity_blocks: int | None = None,
+    retry_budget: int | None = None,
+) -> dict:
+    """The front-door companion Pod for one serving TFJob (ISSUE 13):
+    ``python -m k8s_tpu.cmd.router --job <ns>/<name>`` discovering the
+    job's pods from its own informer cache and proxying /v1/generate
+    with prefix-affine placement.  One router per JOB (it owns the
+    consistent-hash ring), not a per-pod sidecar; exposing it behind a
+    Service/LB is a deployment decision left to the chart."""
+    env = [{"name": "K8S_TPU_ROUTER_POLICY", "value": policy}]
+    if block_size is not None:
+        env.append({"name": "K8S_TPU_ROUTER_BLOCK_SIZE",
+                    "value": str(block_size)})
+    if affinity_blocks is not None:
+        env.append({"name": "K8S_TPU_ROUTER_AFFINITY_BLOCKS",
+                    "value": str(affinity_blocks)})
+    if retry_budget is not None:
+        env.append({"name": "K8S_TPU_ROUTER_RETRY_BUDGET",
+                    "value": str(retry_budget)})
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{job_name}-router",
+            "namespace": namespace,
+            "labels": {"app": "tpu-serve-router",
+                       "tf_job_name": job_name},
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "router",
+                    "image": "k8s-tpu/train-lm:latest",
+                    "command": [
+                        "python", "-m", "k8s_tpu.cmd.router",
+                        f"--job={namespace}/{job_name}",
+                        "--host=0.0.0.0",
+                        f"--port={router_port}",
+                        f"--policy={policy}",
+                    ],
+                    "env": env,
+                    "ports": [{"containerPort": router_port,
+                               "name": "http"}],
+                    "readinessProbe": {
+                        "httpGet": {"path": "/healthz",
+                                    "port": router_port}
+                    },
+                    # drain budget: SIGTERM triggers the clean drain
+                    # (503 new, finish in-flight); the grace period must
+                    # outlive the longest generation
+                }
+            ],
+            "terminationGracePeriodSeconds": 60,
+        },
+    }
 
 
 def tfjob_template(
@@ -311,13 +394,35 @@ def generate(
     serve_request_log_ring: int | None = None,
     fleet_scrape_port: int | None = 8000,
     fleet_interval_s: float | None = None,
+    router: bool = False,
+    router_port: int = ROUTER_HTTP_PORT,
+    router_policy: str = "affine",
+    router_block_size: int | None = None,
+    router_affinity_blocks: int | None = None,
+    router_retry_budget: int | None = None,
+    autoscale_min: int | None = None,
+    autoscale_max: int | None = None,
 ) -> list[dict]:
-    """N uniquely-named jobs, ``tfjob-<ts>-<i>`` (genjob.go:111-114)."""
+    """N uniquely-named jobs, ``tfjob-<ts>-<i>`` (genjob.go:111-114).
+    ``router=True`` (requires ``serve``) additionally emits each job's
+    front-door companion Pod right after its TFJob document."""
     ts = timestamp if timestamp is not None else time.time_ns() % 10**9
+    if router and not serve:
+        raise ValueError("--router requires --serve (the front door "
+                         "proxies serving jobs)")
+    if (autoscale_min is not None or autoscale_max is not None) \
+            and not serve:
+        # silently dropping the bounds would leave the user believing
+        # the job is autoscalable when the spec never carried them
+        raise ValueError("--autoscale-min/--autoscale-max require "
+                         "--serve (only serving jobs carry "
+                         "spec.autoscale)")
     if serve:
-        return [
-            serve_tfjob_template(
-                f"tfjob-{ts}-{i}", namespace,
+        out: list[dict] = []
+        for i in range(n):
+            name = f"tfjob-{ts}-{i}"
+            out.append(serve_tfjob_template(
+                name, namespace,
                 scheduler_name=scheduler_name,
                 serve_slots=serve_slots, serve_queue=serve_queue,
                 serve_prefix_blocks=serve_prefix_blocks,
@@ -327,9 +432,17 @@ def generate(
                 serve_request_log_ring=serve_request_log_ring,
                 priority=priority, queue=queue,
                 fleet_scrape_port=fleet_scrape_port,
-                fleet_interval_s=fleet_interval_s)
-            for i in range(n)
-        ]
+                fleet_interval_s=fleet_interval_s,
+                autoscale_min=autoscale_min,
+                autoscale_max=autoscale_max))
+            if router:
+                out.append(router_companion_template(
+                    name, namespace, router_port=router_port,
+                    policy=router_policy,
+                    block_size=router_block_size,
+                    affinity_blocks=router_affinity_blocks,
+                    retry_budget=router_retry_budget))
+        return out
     return [
         tfjob_template(f"tfjob-{ts}-{i}", namespace, gpu, tpu, scheduler_name,
                        priority=priority, queue=queue)
@@ -393,6 +506,38 @@ def main(argv=None) -> int:
     parser.add_argument("--fleet-interval", type=float, default=None,
                         help="surface K8S_TPU_FLEET_INTERVAL_S on --serve "
                         "pods (the operator-side scrape cadence knob)")
+    parser.add_argument("--router", action="store_true",
+                        help="with --serve: also emit each job's front-"
+                        "door companion Pod (python -m k8s_tpu.cmd.router "
+                        "--job <ns>/<name>): prefix-affine /v1/generate "
+                        "proxy with least-outstanding fallback and clean "
+                        "SIGTERM drain (ISSUE 13)")
+    parser.add_argument("--router-port", type=int,
+                        default=ROUTER_HTTP_PORT,
+                        help="the companion router's HTTP port")
+    parser.add_argument("--router-policy", default="affine",
+                        choices=("affine", "least", "random"),
+                        help="placement policy (K8S_TPU_ROUTER_POLICY; "
+                        "random is the bench's control arm)")
+    parser.add_argument("--router-block-size", type=int, default=None,
+                        help="K8S_TPU_ROUTER_BLOCK_SIZE on the companion "
+                        "(must match the serving engine's KV block size; "
+                        "omit for the default)")
+    parser.add_argument("--router-affinity-blocks", type=int,
+                        default=None,
+                        help="K8S_TPU_ROUTER_AFFINITY_BLOCKS on the "
+                        "companion (full prompt blocks fingerprinted; "
+                        "omit for the default)")
+    parser.add_argument("--router-retry-budget", type=int, default=None,
+                        help="K8S_TPU_ROUTER_RETRY_BUDGET on the "
+                        "companion (omit for the default)")
+    parser.add_argument("--autoscale-min", type=int, default=None,
+                        help="spec.autoscale.minReplicas on --serve jobs "
+                        "(with --autoscale-max; the operator's autoscaler "
+                        "scales the Worker count inside these bounds when "
+                        "K8S_TPU_AUTOSCALE is on)")
+    parser.add_argument("--autoscale-max", type=int, default=None,
+                        help="spec.autoscale.maxReplicas on --serve jobs")
     parser.add_argument(
         "--dump", action="store_true", help="print manifests instead of creating"
     )
@@ -418,6 +563,14 @@ def main(argv=None) -> int:
         serve_request_log_ring=args.serve_request_log_ring,
         fleet_scrape_port=args.fleet_scrape_port or None,
         fleet_interval_s=args.fleet_interval,
+        router=args.router,
+        router_port=args.router_port,
+        router_policy=args.router_policy,
+        router_block_size=args.router_block_size,
+        router_affinity_blocks=args.router_affinity_blocks,
+        router_retry_budget=args.router_retry_budget,
+        autoscale_min=args.autoscale_min,
+        autoscale_max=args.autoscale_max,
     )
     if args.dump:
         yaml.safe_dump_all(jobs, sys.stdout)
@@ -428,6 +581,10 @@ def main(argv=None) -> int:
 
     clientset = Clientset(RestClient(kubeconfig_config(args.kube_config_path)))
     for job in jobs:
+        if job.get("kind") == "Pod":
+            created = clientset.pods(args.namespace).create(job)
+            log.info("created router Pod %s", created["metadata"]["name"])
+            continue
         created = clientset.tfjobs_unstructured(
             args.namespace, api_version=job["apiVersion"]
         ).create(job)

@@ -1,0 +1,124 @@
+"""Front-door router binary with informer-cache discovery (ISSUE 13).
+
+    python -m k8s_tpu.cmd.router --job default/serve-lm --port 8080
+
+Builds its OWN pod informer (the operator's zero-apiserver-call
+discovery substrate — one LIST + a watch, then pure cache reads) and
+wires ``fleet.targets_from_pods`` over the fleet-scrape index as the
+router's ``targets_fn``: pods join the ring as they go Running and
+leave as they terminate, with no per-request apiserver traffic.  The
+stdlib-only core lives in :mod:`k8s_tpu.router`; this wrapper carries
+the client-layer imports that package may not (the same split as
+``cmd/operator_v2`` over ``controller_v2``).
+
+SIGTERM drains: new requests 503 with Retry-After while in-flight ones
+complete, then the process exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+
+from k8s_tpu import fleet as fleet_mod
+from k8s_tpu import router as router_mod
+from k8s_tpu.util.signals import setup_signal_handler
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-serve-router")
+    p.add_argument("--master", default="", help="apiserver URL override")
+    p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
+    p.add_argument("--job", required=True,
+                   help="serving TFJob key (namespace/name) to front")
+    p.add_argument("--host", default="0.0.0.0",
+                   help="bind address (the front door is meant to be "
+                   "reachable; pass 127.0.0.1 to restrict)")
+    p.add_argument("--port", type=int,
+                   default=router_mod._int_from_env(router_mod.ENV_PORT,
+                                                    8080))
+    p.add_argument("--policy", choices=router_mod.VALID_POLICIES,
+                   default=router_mod.policy_from_env())
+    p.add_argument("--block-size", type=int,
+                   default=router_mod.block_size_from_env())
+    p.add_argument("--affinity-blocks", type=int,
+                   default=router_mod.affinity_blocks_from_env())
+    p.add_argument("--retry-budget", type=int,
+                   default=router_mod.retry_budget_from_env())
+    p.add_argument("--drain-timeout", type=float, default=30.0)
+    return p
+
+
+def run(opts, backend=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    from k8s_tpu.client.gvr import PODS
+    from k8s_tpu.client.informer import (
+        FLEET_SCRAPE_INDEX,
+        FLEET_SCRAPE_KEY,
+        SharedInformerFactory,
+        index_fleet_scrape_pods,
+    )
+    from k8s_tpu.cmd.operator_v2 import make_backend
+
+    if "/" not in opts.job:
+        # targets_from_pods keys jobs as "namespace/name"; a bare name
+        # would silently match zero targets forever
+        opts.job = f"default/{opts.job}"
+    backend = backend if backend is not None else make_backend(opts)
+    factory = SharedInformerFactory(backend)
+    pod_informer = factory.informer_for(PODS)
+    pod_informer.store.add_index(FLEET_SCRAPE_INDEX,
+                                 index_fleet_scrape_pods)
+    factory.start()
+    if not factory.wait_for_cache_sync(30):
+        raise RuntimeError("failed to wait for pod cache to sync")
+
+    job = opts.job
+
+    def targets_fn():
+        return [t for t in fleet_mod.targets_from_pods(
+            pod_informer.store.by_index(FLEET_SCRAPE_INDEX,
+                                        FLEET_SCRAPE_KEY))
+                if t.job == job]
+
+    router = router_mod.Router(
+        targets_fn, job=job, policy=opts.policy,
+        block_size=opts.block_size,
+        affinity_blocks=opts.affinity_blocks,
+        retry_budget=opts.retry_budget)
+    server = router_mod.RouterServer(router, host=opts.host,
+                                     port=opts.port)
+    router_mod.set_active(router)
+    server.start()
+    print(f"READY http://{opts.host}:{server.port}", flush=True)
+    stop = setup_signal_handler()
+    drained = threading.Event()
+
+    def _drain():
+        stop.wait()
+        log.info("router: signal — draining (budget %.1fs)",
+                 opts.drain_timeout)
+        server.drain_and_stop(opts.drain_timeout)
+        drained.set()
+
+    threading.Thread(target=_drain, daemon=True,
+                     name="router-drain").start()
+    drained.wait()
+    router_mod.set_active(None)
+    factory.stop()
+    return 0
+
+
+def main() -> int:
+    return run(build_parser().parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

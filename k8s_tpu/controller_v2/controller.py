@@ -22,6 +22,7 @@ import time
 
 from k8s_tpu import fleet as fleet_mod
 from k8s_tpu import flight
+from k8s_tpu import router as router_mod
 from k8s_tpu import scheduler as scheduler_mod
 from k8s_tpu import trace
 from k8s_tpu.api import register, validation
@@ -75,6 +76,8 @@ class TFJobController:
         scheduler=None,
         fleet_scrape: bool | None = None,
         fleet_interval_s: float | None = None,
+        autoscale: bool | None = None,
+        autoscale_interval_s: float | None = None,
     ):
         self.clientset = clientset
         # async sink: recording is a buffered enqueue, not an API round trip
@@ -129,6 +132,9 @@ class TFJobController:
         self.enable_gang_scheduling = enable_gang_scheduling
         # (namespace, pdb-name, job-uid) -> minAvailable last created/verified
         self._pdb_cache: dict = {}
+        # job key -> ((uid, replica-count signature), priced chips):
+        # the reserved-gang demand-drift check's memo (ISSUE 13)
+        self._demand_cache: dict = {}
         self.queue = new_rate_limiting_queue()
         self.metrics = metrics.controller_metrics("v2")
         # Flight recorder (ISSUE 7): activate the per-job lifecycle journal
@@ -249,6 +255,37 @@ class TFJobController:
             self.fleet_plane.add_sink(self._fleet_breach_sink)
             fleet_mod.set_active(self.fleet_plane)
 
+        # Metric-driven gang autoscaler (ISSUE 13): off by default via
+        # K8S_TPU_AUTOSCALE; requires the fleet plane (its rollups are the
+        # scaling signals).  Scale-up extends the job's chip reservation
+        # through the gang scheduler BEFORE the spec is patched — or parks
+        # the expansion Queued, never a partial placement; scale-down
+        # drains the victim pods through the active router first.
+        if autoscale is None:
+            autoscale = router_mod.autoscale_enabled_from_env()
+        self.autoscale_loop = None
+        if autoscale:
+            if self.fleet_plane is None:
+                log.warning(
+                    "K8S_TPU_AUTOSCALE is set but fleet scraping is off; "
+                    "autoscaler disabled (enable K8S_TPU_FLEET_SCRAPE — "
+                    "the rollups are its scaling signals)")
+            else:
+                from k8s_tpu.router.autoscale import (
+                    autoscaler_kwargs_from_env,
+                )
+
+                self.autoscale_loop = router_mod.AutoscaleLoop(
+                    router_mod.Autoscaler(lambda: self.fleet_plane,
+                                          **autoscaler_kwargs_from_env()),
+                    self._autoscale_jobs, self._autoscale_apply,
+                    reserve_fn=self._autoscale_reserve,
+                    drain_fn=self._autoscale_drain,
+                    undrain_fn=self._autoscale_undrain,
+                    event_fn=self._autoscale_event,
+                    interval_s=(autoscale_interval_s
+                                or router_mod.autoscale_interval_from_env()))
+
         # seam overridden by tests (controller_test.go updateStatusHandler)
         self.update_status_handler = self._update_tfjob_status
 
@@ -310,11 +347,15 @@ class TFJobController:
         # queue entry, and preemption marker all go, and freed chips wake
         # the parked jobs that were waiting on them
         self._release_scheduler_key(key)
+        self._demand_cache.pop(key, None)
         if self.fleet_plane is not None:
             # drop SLO rule state so a deleted job can't pin a stale
             # breach; its scrape targets vanish with its pods on the
             # next discovery pass
             self.fleet_plane.forget(key)
+        if self.autoscale_loop is not None:
+            # hysteresis/cooldown/parked state dies with the job
+            self.autoscale_loop.autoscaler.forget(key)
         flight.timeline(key, "deleted")
 
     def enqueue_tfjob(self, tfjob) -> None:
@@ -348,6 +389,8 @@ class TFJobController:
             self._workers.append(t)
         if self.fleet_plane is not None:
             self.fleet_plane.start()
+        if self.autoscale_loop is not None:
+            self.autoscale_loop.start()
         stop.wait()
         self.shutdown()
 
@@ -362,9 +405,13 @@ class TFJobController:
             self._workers.append(t)
         if self.fleet_plane is not None:
             self.fleet_plane.start()
+        if self.autoscale_loop is not None:
+            self.autoscale_loop.start()
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.autoscale_loop is not None:
+            self.autoscale_loop.stop()
         if self.fleet_plane is not None:
             self.fleet_plane.stop()
         self.queue.shut_down()
@@ -563,6 +610,14 @@ class TFJobController:
 
         self._reconcile_replica_types(tfjob, pods, services)
 
+        # parked-scale-up clamp (ISSUE 13): reconcile ran at the
+        # reservation-covered size; restore the spec'd count BEFORE the
+        # status write, or update() would silently revert the patch
+        clamp = getattr(tfjob, "_autoscale_clamp", None)
+        if clamp is not None:
+            clamp_rtype, clamp_orig = clamp
+            tfjob.spec.tf_replica_specs[clamp_rtype].replicas = clamp_orig
+
         tfjob.status.last_reconcile_time = now_rfc3339()
         self.update_status_handler(tfjob)
 
@@ -590,10 +645,47 @@ class TFJobController:
         if sched.unlimited:
             return True
         key = tpu_config.tfjob_key(tfjob)
-        if sched.is_reserved(key):
-            # steady-state fast path: every sync of a running gang skips
-            # the O(replicas) demand computation below
-            return True
+        reserved = sched.reserved_chips(key)
+        if reserved is not None:
+            # Reserved gang: cheap steady-state path UNLESS the spec's
+            # demand drifted from the hold (an autoscale replica patch,
+            # ISSUE 13) — then the reservation resizes gang-atomically.
+            # A grow that does not fit keeps the job at its CURRENT size
+            # with a Queued condition (the scale-up parks; the gang is
+            # NEVER partially placed and never torn down for growing).
+            # The priced demand is memoized per replica-count signature:
+            # chips_for_tfjob walks the whole SPMD process table
+            # (O(hosts) — 256 iterations for a multislice gang), and
+            # the pre-drift fast path deliberately skipped that on
+            # every steady sync; the O(#rtypes) signature keeps it
+            # skipped until the counts actually change.
+            chips = self._priced_demand(tfjob, key)
+            if chips == reserved or chips <= 0:
+                # demand returned to the reservation (a parked ask was
+                # withdrawn / a manual edit reverted): the ScaleUpQueued
+                # condition must not outlive the drift — the sync's
+                # normal status write persists the flip
+                self._clear_scale_up_queued(tfjob, key)
+                return True
+            decision = sched.resize(key, chips)
+            if decision.admitted:
+                flight.timeline(key, "resized", chips=chips,
+                                was=reserved, reason=decision.reason)
+                if decision.reason == "shrunk":
+                    # freed chips wake the parked jobs immediately (the
+                    # forget() path's contract)
+                    for waiting in sched.waiting_keys():
+                        self.enqueue_key(waiting)
+                self._clear_scale_up_queued(tfjob, key)
+                return True
+            self._park_scale_up(tfjob, key, chips, reserved, decision)
+            # keep servicing the RUNNING gang at its reserved size while
+            # the expansion is parked: reconcile proceeds with the
+            # scaled type clamped back to the count the reservation
+            # covers (restored before the status write — the spec patch
+            # must not be silently reverted), so pod repair/restart is
+            # never frozen behind a parked scale-up
+            return self._clamp_to_reservation(tfjob, reserved)
         chips = tpu_config.chips_for_tfjob(tfjob)
         priority = getattr(tfjob.spec, "priority", 0) or 0
         queue_name = (getattr(tfjob.spec, "queue", None)
@@ -747,6 +839,299 @@ class TFJobController:
                 job_dict, "Normal", "PreemptionTeardown",
                 "Deleted %d pod(s): gang preempted and requeued", deleted)
         return deleted
+
+    def _priced_demand(self, tfjob, key: str) -> int:
+        """chips_for_tfjob memoized per (uid, replica-count signature):
+        the signature is O(#rtypes) to build, so steady syncs of a
+        running gang skip the O(hosts) process-table walk exactly as
+        the pre-ISSUE-13 fast path did."""
+        sig = (tfjob.metadata.uid,
+               tuple(sorted((rt, spec.replicas or 1)
+                            for rt, spec in
+                            tfjob.spec.tf_replica_specs.items())))
+        cached = self._demand_cache.get(key)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        chips = tpu_config.chips_for_tfjob(tfjob)
+        self._demand_cache[key] = (sig, chips)
+        return chips
+
+    def _park_scale_up(self, tfjob, key: str, chips: int, reserved: int,
+                       decision) -> None:
+        """A reserved gang's demand grew past available capacity: park
+        the EXPANSION (Queued=True, reason ScaleUpQueued) while the gang
+        keeps running at its reserved size — zero pods are torn down and
+        zero new pods are placed (gang-atomic or nothing, ISSUE 13).
+        Reconcile pauses for the job until the resize fits (capacity
+        frees) or the spec's demand returns to the reservation; the
+        autoscaler's reserve_fn gate makes this a backstop for manual
+        ``kubectl``-style replica edits and races, not the normal path."""
+        queued = status_mod.get_condition(tfjob.status, types.TFJobQueued)
+        message = (f"scale-up to {chips} chip(s) parked: holding "
+                   f"{reserved}, {decision.reason}")
+        flight.timeline(key, "scale_up_parked", chips=chips,
+                        reserved=reserved, reason=decision.reason)
+        if queued is not None \
+                and queued.status == types.ConditionTrue \
+                and queued.reason == status_mod.TFJOB_SCALE_UP_QUEUED_REASON:
+            return  # already parked; don't churn status writes
+        with self._status_lock:
+            status_mod.set_condition(
+                tfjob.status,
+                status_mod.new_condition(
+                    types.TFJobQueued,
+                    status_mod.TFJOB_SCALE_UP_QUEUED_REASON, message),
+                job=key)
+        self.recorder.eventf(
+            tfjob.to_dict(), "Warning", "ScaleUpQueued",
+            "Replica scale-up needs %d chip(s) (holding %d): %s",
+            chips, reserved, decision.reason)
+        self.update_status_handler(tfjob)
+
+    def _clamp_to_reservation(self, tfjob, reserved: int) -> bool:
+        """Find a replica count for the autoscaled type whose whole-job
+        demand equals the chips actually reserved, mutate the SYNC-LOCAL
+        spec to it, and stash the original so reconcile_tfjobs restores
+        it before any status write.  False when no clamp reproduces the
+        reservation (multi-type demand drift: reconcile pauses — the
+        conservative pre-clamp behavior)."""
+        auto = tfjob.spec.autoscale
+        if auto is not None and auto.replica_type:
+            candidates = [auto.replica_type]
+        else:
+            # manual-edit backstop: no declared autoscale type, so try
+            # each TPU-bearing type as the one whose count drifted
+            candidates = list(tfjob.spec.tf_replica_specs)
+        for rtype in candidates:
+            rspec = tfjob.spec.tf_replica_specs.get(rtype)
+            if rspec is None:
+                continue
+            original = rspec.replicas or 1
+            for r in range(original - 1, 0, -1):
+                rspec.replicas = r
+                if tpu_config.chips_for_tfjob(tfjob) == reserved:
+                    tfjob._autoscale_clamp = (rtype, original)
+                    return True
+            rspec.replicas = original
+        return False
+
+    def _clear_scale_up_queued(self, tfjob, key: str) -> None:
+        """A parked expansion finally fit (resize admitted): flip the
+        ScaleUpQueued condition to False, keeping it as history."""
+        queued = status_mod.get_condition(tfjob.status, types.TFJobQueued)
+        if queued is None or queued.status != types.ConditionTrue \
+                or queued.reason != status_mod.TFJOB_SCALE_UP_QUEUED_REASON:
+            return
+        cond = status_mod.new_condition(
+            types.TFJobQueued, status_mod.TFJOB_ADMITTED_REASON,
+            "parked scale-up admitted; reservation resized")
+        cond.status = types.ConditionFalse
+        with self._status_lock:
+            status_mod.set_condition(tfjob.status, cond, job=key)
+
+    # -- metric-driven gang autoscaler (ISSUE 13) -----------------------------
+
+    def _autoscale_jobs(self):
+        """Every autoscalable job's (key, current, min, max) — jobs with
+        spec.autoscale bounds, read from the TFJob informer cache (zero
+        apiserver calls, the fleet-discovery property)."""
+        out = []
+        for obj in self.tfjob_lister.list():
+            spec = obj.get("spec") or {}
+            bounds = spec.get("autoscale") or {}
+            lo, hi = bounds.get("minReplicas"), bounds.get("maxReplicas")
+            if not lo or not hi:
+                continue
+            rtype = bounds.get("replicaType") or types.TFReplicaTypeWorker
+            rspec = (spec.get("tfReplicaSpecs") or {}).get(rtype)
+            if rspec is None:
+                continue
+            status = obj.get("status") or {}
+            if any(c.get("type") in (types.TFJobSucceeded, types.TFJobFailed)
+                   and c.get("status") == types.ConditionTrue
+                   for c in status.get("conditions") or []):
+                continue  # terminal jobs scale nowhere
+            meta = obj.get("metadata") or {}
+            key = (f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+                   if meta.get("namespace") else meta.get("name", ""))
+            try:
+                out.append((key, int(rspec.get("replicas") or 1),
+                            int(lo), int(hi)))
+            except (TypeError, ValueError):
+                continue  # validation rejects these; don't crash the loop
+        return out
+
+    def _autoscale_rtype(self, obj: dict) -> str:
+        bounds = (obj.get("spec") or {}).get("autoscale") or {}
+        return bounds.get("replicaType") or types.TFReplicaTypeWorker
+
+    def _autoscale_reserve(self, job: str, target: int) -> bool:
+        """Extend the job's chip reservation for a scale-up BEFORE the
+        spec patch — the gang-atomic gate.  True also when capacity is
+        unlimited or the job prices at zero chips (nothing to arbitrate);
+        first admission of an unreserved job stays with sync_admit."""
+        sched = self.scheduler
+        if sched.unlimited:
+            return True
+        ns, name = split_meta_namespace_key(job)
+        obj = self.tfjob_lister.get(ns, name)
+        if obj is None:
+            return False
+        tfjob = register.tfjob_from_unstructured(obj)
+        register.default_tfjob(tfjob)
+        rtype = self._autoscale_rtype(obj)
+        rspec = tfjob.spec.tf_replica_specs.get(rtype)
+        if rspec is None:
+            return False
+        rspec.replicas = target
+        chips = tpu_config.chips_for_tfjob(tfjob)
+        if chips <= 0:
+            return True
+        if not sched.is_reserved(job):
+            # not admitted yet: the patch is safe — sync_admit arbitrates
+            # the whole (larger) gang before any pod exists
+            return True
+        return sched.resize(job, chips).admitted
+
+    def _autoscale_victims(self, job: str, n_victims: int) -> list[str]:
+        """The pods a scale-down will delete: the target replica type's
+        highest indices (the reconcile contract — pods at index >=
+        replicas are out of range)."""
+        ns, name = split_meta_namespace_key(job)
+        obj = self.tfjob_lister.get(ns, name)
+        if obj is None:
+            return []
+        rtype = self._autoscale_rtype(obj).lower()
+        indexed = []
+        from k8s_tpu.client.informer import OWNER_INDEX
+
+        uid = (obj.get("metadata") or {}).get("uid")
+        for pod in self.pod_lister.by_index(OWNER_INDEX, uid):
+            meta = pod.get("metadata") or {}
+            labels = meta.get("labels") or {}
+            if labels.get(tpu_config.LABEL_REPLICA_TYPE) != rtype:
+                continue
+            if meta.get("deletionTimestamp"):
+                continue
+            try:
+                idx = int(labels.get(tpu_config.LABEL_REPLICA_INDEX, ""))
+            except ValueError:
+                continue
+            indexed.append((idx, meta.get("name", "")))
+        indexed.sort(reverse=True)
+        return [name for _idx, name in indexed[:n_victims]]
+
+    def _annotate_drain(self, job: str, pods: list[str],
+                        value: str) -> None:
+        """Stamp the router-drain annotation on victim pods — the
+        CROSS-PROCESS half of the drain protocol: a router running as a
+        companion Pod observes the annotation through its own informer
+        cache (fleet discovery carries it) and stops placing onto the
+        victims; the in-process router (bench/LocalCluster) is handled
+        directly below."""
+        ns, _name = split_meta_namespace_key(job)
+        for pod in pods:
+            try:
+                self.clientset.pods(ns).patch(
+                    pod, {"metadata": {"annotations": {
+                        fleet_mod.discovery.ANNOTATION_ROUTER_DRAIN:
+                        value}}})
+            except errors.ApiError as e:
+                # best-effort: a vanished pod needs no drain
+                if not errors.is_not_found(e):
+                    log.warning("autoscale: drain-annotating %s/%s "
+                                "failed: %s", ns, pod, e)
+
+    def _autoscale_drain(self, job: str, n_victims: int,
+                         timeout_s: float = 10.0) -> bool:
+        """Route the scale-down victims through the router BEFORE the
+        patch that releases their chips: no new placements, in-flight
+        requests finish.  The victims are drain-annotated (any
+        companion-Pod router picks that up from its pod cache within a
+        refresh interval) AND marked directly on the in-process router
+        when one is active — only the latter's in-flight counts are
+        observable here, so the wait covers it; a remote router gets
+        the annotation lead time plus the victim pod's own SIGTERM
+        grace."""
+        victims = self._autoscale_victims(job, n_victims)
+        for pod in victims:
+            flight.timeline(job, "autoscale_drain", pod=pod)
+        self._annotate_drain(job, victims, "1")
+        rt = router_mod.active()
+        if rt is None:
+            return True
+        for pod in victims:
+            rt.set_draining(pod, True)
+        deadline = time.monotonic() + timeout_s
+        drained = True
+        for pod in victims:
+            while True:
+                inflight = rt.backend_inflight(pod)
+                if not inflight:  # 0 or unknown (already gone)
+                    break
+                if time.monotonic() >= deadline:
+                    drained = False
+                    break
+                time.sleep(0.02)
+        return drained
+
+    def _autoscale_undrain(self, job: str) -> None:
+        """Revert a drain whose spec patch failed: the victims must take
+        traffic again instead of sitting refused behind an unshrunk
+        spec — both the annotation (remote routers) and the in-process
+        flag are cleared."""
+        ns, name = split_meta_namespace_key(job)
+        obj = self.tfjob_lister.get(ns, name)
+        if obj is not None:
+            rtype = self._autoscale_rtype(obj).lower()
+            from k8s_tpu.client.informer import OWNER_INDEX
+
+            uid = (obj.get("metadata") or {}).get("uid")
+            annotated = [
+                (p.get("metadata") or {}).get("name", "")
+                for p in self.pod_lister.by_index(OWNER_INDEX, uid)
+                if ((p.get("metadata") or {}).get("annotations") or {})
+                .get(fleet_mod.discovery.ANNOTATION_ROUTER_DRAIN)
+                and ((p.get("metadata") or {}).get("labels") or {})
+                .get(tpu_config.LABEL_REPLICA_TYPE) == rtype
+            ]
+            self._annotate_drain(job, [p for p in annotated if p], "0")
+        rt = router_mod.active()
+        if rt is None:
+            return
+        for b in rt.backends():
+            if b["draining"]:
+                rt.set_draining(b["name"], False)
+
+    def _autoscale_apply(self, job: str, target: int) -> bool:
+        """Patch the serving TFJob's replica count (JSON merge patch —
+        only the one field travels); the normal sync then creates or
+        deletes the pods and resizes the reservation."""
+        ns, name = split_meta_namespace_key(job)
+        obj = self.tfjob_lister.get(ns, name)
+        if obj is None:
+            return False
+        rtype = self._autoscale_rtype(obj)
+        try:
+            self.clientset.tfjobs_unstructured(
+                ns, obj.get("apiVersion", "kubeflow.org/v1alpha2")).patch(
+                name,
+                {"spec": {"tfReplicaSpecs": {rtype: {"replicas": target}}}})
+        except errors.ApiError as e:
+            log.warning("autoscale: patching %s to %d replicas failed: %s",
+                        job, target, e)
+            return False
+        flight.timeline(job, "autoscaled", replicas=target, rtype=rtype)
+        self.enqueue_key(job)
+        return True
+
+    def _autoscale_event(self, job: str, kind: str, message: str) -> None:
+        ns, name = split_meta_namespace_key(job)
+        involved = self.tfjob_lister.get(ns, name)
+        if involved is None:
+            return
+        etype = "Warning" if kind == "ScaleUpQueued" else "Normal"
+        self.recorder.eventf(involved, etype, kind, "%s", message)
 
     # -- fleet telemetry plane (ISSUE 8) --------------------------------------
 

@@ -247,6 +247,15 @@ class PodReconciler:
             restarting = self._maybe_restart_gang(tfjob, pods, rtype, spec)
 
         if not restarting:
+            # scale-down (ISSUE 13): pods whose index fell out of
+            # [0, replicas) after an autoscale replica patch are torn
+            # down in one bounded wave — without this the gang never
+            # actually shrinks and the freed chips are a ledger fiction
+            extra = self._out_of_range_pods(pods, replicas)
+            if extra:
+                self._delete_pods_wave(
+                    tfjob, rt, extra, self._job_snapshot(tfjob),
+                    reason="scale-down")
             slices = get_pod_slices(pods, replicas)
             missing: list[int] = []
             for index, pod_slice in enumerate(slices):
@@ -364,6 +373,24 @@ class PodReconciler:
             tfjob, rtype, [p["metadata"]["name"] for p in pods], job_dict,
             reason="gang restart")
         return True
+
+    @staticmethod
+    def _out_of_range_pods(pods: list[dict], replicas: int) -> list[str]:
+        """Names of live pods with an index >= replicas (the scale-down
+        victims; already-terminating pods are skipped)."""
+        out: list[str] = []
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            if meta.get("deletionTimestamp"):
+                continue
+            try:
+                index = int((meta.get("labels") or {}).get(
+                    tpu_config.LABEL_REPLICA_INDEX, ""))
+            except ValueError:
+                continue
+            if index >= replicas:
+                out.append(meta.get("name", ""))
+        return [n for n in out if n]
 
     def _delete_pods_wave(
         self, tfjob: types.TFJob, rtype: str, names: list[str],

@@ -90,6 +90,12 @@ class ServiceReconciler:
         rt = rtype.lower()
         services = filter_services_for_replica_type(services, rt)
         replicas = spec.replicas or 1
+        # scale-down (ISSUE 13): drop the headless services of indices
+        # that fell out of range, symmetric with the pod reconciler —
+        # an autoscaled job must not leak one DNS name per past peak
+        extra = self._out_of_range_services(services, replicas)
+        if extra:
+            self._delete_services_wave(tfjob, rt, extra)
         missing: list[int] = []
         for index, svc_slice in enumerate(get_service_slices(services, replicas)):
             if len(svc_slice) > 1:
@@ -98,6 +104,44 @@ class ServiceReconciler:
                 missing.append(index)
         if missing:
             self._create_services_wave(tfjob, rtype, missing, spec)
+
+    @staticmethod
+    def _out_of_range_services(services: list[dict], replicas: int
+                               ) -> list[str]:
+        """Names of live services with an index >= replicas."""
+        out: list[str] = []
+        for svc in services:
+            meta = svc.get("metadata") or {}
+            if meta.get("deletionTimestamp"):
+                continue
+            try:
+                index = int((meta.get("labels") or {}).get(
+                    tpu_config.LABEL_REPLICA_INDEX, ""))
+            except ValueError:
+                continue
+            if index >= replicas:
+                out.append(meta.get("name", ""))
+        return [n for n in out if n]
+
+    def _delete_services_wave(self, tfjob: types.TFJob, rt: str,
+                              names: list[str]) -> None:
+        """Tear down ``names`` in one bounded wave (the pod counterpart's
+        contract: expectations up-front, per-slot unwind, NotFound counts
+        as deleted)."""
+        from k8s_tpu.controller_v2.control import run_delete_wave
+
+        key = tpu_config.tfjob_key(tfjob)
+        with self.status_lock:
+            job_dict = tfjob.to_dict()
+        run_delete_wave(
+            self.expectations, gen_expectation_services_key(key, rt),
+            lambda lo, hi: self.service_control.delete_services_batch(
+                tfjob.metadata.namespace, names[lo:hi], job_dict),
+            len(names), self.metrics, "service",
+            lambda i: f"service {names[i]} (scale-down of {key})",
+            initial=getattr(self.service_control, "delete_width", 1),
+            job=key,
+        )
 
     def _build_service(self, tfjob: types.TFJob, rtype: str, index: int) -> dict:
         """createNewService's object assembly (controller_service.go:91-149):

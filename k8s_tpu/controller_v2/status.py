@@ -35,6 +35,10 @@ TFJOB_DEADLINE_EXCEEDED_REASON = "DeadlineExceeded"
 TFJOB_QUEUED_REASON = "TFJobQueued"
 TFJOB_PREEMPTED_REASON = "Preempted"
 TFJOB_ADMITTED_REASON = "Admitted"
+# Autoscale (ISSUE 13): a replica-count grow whose chip delta does not
+# fit parks Queued=True with this reason — the gang keeps running at its
+# reserved size (never partially placed) until capacity frees.
+TFJOB_SCALE_UP_QUEUED_REASON = "ScaleUpQueued"
 
 
 def new_condition(cond_type: str, reason: str, message: str) -> types.TFJobCondition:

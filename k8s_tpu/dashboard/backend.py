@@ -134,6 +134,15 @@ def _make_handler(manager: ClientManager):
 
                     code, body, ctype = fleet.debug_response(query)
                     self._send_text(code, body, ctype)
+                elif path == "/debug/router":
+                    # serving front-door router (ISSUE 13) — shared
+                    # responder with the metrics server and the router's
+                    # own listener, same per-process scope caveat as the
+                    # other /debug routes.
+                    from k8s_tpu import router as router_mod
+
+                    code, body, ctype = router_mod.debug_response(query)
+                    self._send_text(code, body, ctype)
                 elif path == "/debug/compiles":
                     # XLA compile ledger — shared responder with the
                     # metrics server and the serving pod, same

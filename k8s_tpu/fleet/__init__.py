@@ -31,6 +31,7 @@ from k8s_tpu.fleet.aggregate import (  # noqa: F401 (public surface)
 )
 from k8s_tpu.fleet.debug import debug_fleet_response  # noqa: F401
 from k8s_tpu.fleet.discovery import (  # noqa: F401
+    ANNOTATION_ROUTER_DRAIN,
     ANNOTATION_SCRAPE_PORT,
     ENV_SCRAPE_PORT,
     ScrapeTarget,

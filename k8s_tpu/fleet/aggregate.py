@@ -303,6 +303,21 @@ class FleetAggregator:
         return {"max": max(values), "mean": sum(values) / len(values),
                 "sum": sum(values), "pods": len(values)}
 
+    def pod_gauge_latest(self, job: str, family: str,
+                         labels: tuple = ()) -> dict | None:
+        """Latest per-POD readings of one gauge family — ``{pod: value}``
+        (ISSUE 13: the router's least-outstanding fallback tie-breaks on
+        per-target ``serve_queue_depth``, which the per-job merge above
+        erases).  None when the job/family is unknown; a pure read."""
+        with self._lock:
+            state = self._jobs.get(job)
+            if state is None:
+                return None
+            entry = state["gauges"].get((family, tuple(labels)))
+            if entry is None or not entry[0]:
+                return None
+            return {pod: v for pod, (_t, v) in entry[0].items()}
+
     def gauge_window_mean(self, job: str, family: str, window_s: float,
                           now: float, of: str = "max",
                           labels: tuple = ()) -> float | None:

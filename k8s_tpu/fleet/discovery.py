@@ -24,6 +24,12 @@ ANNOTATION_SCRAPE_PORT = "kubeflow.org/fleet-scrape-port"
 ANNOTATION_SCRAPE_PATH = "kubeflow.org/fleet-scrape-path"
 ANNOTATION_SCRAPE_HOST = "kubeflow.org/fleet-scrape-host"
 ANNOTATION_SCRAPE = "kubeflow.org/fleet-scrape"  # "false" opts a pod out
+# Router drain protocol (ISSUE 13): the operator's autoscaler annotates
+# a scale-down victim POD (not the template) truthy before patching the
+# replica count; any router whose discovery feeds from the pod cache
+# marks that backend draining — no new placements, in-flight requests
+# finish — before the pod itself is deleted.  A falsy value un-drains.
+ANNOTATION_ROUTER_DRAIN = "kubeflow.org/router-drain"
 
 # Env var fallback carried by serving containers (genjob --serve).
 ENV_SCRAPE_PORT = "K8S_TPU_FLEET_SCRAPE_PORT"
@@ -38,18 +44,21 @@ _LABEL_TFJOB_KEY = "tf_job_key"
 
 class ScrapeTarget:
     """One scrapeable pod: its owning job key (``namespace/name``), pod
-    identity, and the URL to GET."""
+    identity, the URL to GET, and the router-drain flag (None = no
+    annotation; the router leaves its local drain state alone)."""
 
-    __slots__ = ("job", "namespace", "job_name", "pod", "index", "url")
+    __slots__ = ("job", "namespace", "job_name", "pod", "index", "url",
+                 "draining")
 
     def __init__(self, job: str, namespace: str, job_name: str, pod: str,
-                 index: str, url: str):
+                 index: str, url: str, draining=None):
         self.job = job
         self.namespace = namespace
         self.job_name = job_name
         self.pod = pod
         self.index = index
         self.url = url
+        self.draining = draining
 
     def key(self) -> str:
         return f"{self.job}:{self.pod}"
@@ -139,6 +148,9 @@ def targets_from_pods(pods: list[dict]) -> list[ScrapeTarget]:
         path = annotations.get(ANNOTATION_SCRAPE_PATH) or "/metrics"
         if not path.startswith("/"):
             path = "/" + path
+        drain_raw = annotations.get(ANNOTATION_ROUTER_DRAIN)
+        draining = (None if drain_raw is None
+                    else drain_raw.lower() in ("1", "true", "yes", "on"))
         targets.append(ScrapeTarget(
             job=f"{ns}/{job_name}" if ns else job_name,
             namespace=ns,
@@ -146,5 +158,6 @@ def targets_from_pods(pods: list[dict]) -> list[ScrapeTarget]:
             pod=meta.get("name", ""),
             index=(meta.get("labels") or {}).get(_LABEL_REPLICA_INDEX, ""),
             url=f"http://{host}:{port}{path}",
+            draining=draining,
         ))
     return targets

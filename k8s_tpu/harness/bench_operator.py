@@ -1461,6 +1461,740 @@ def run_serve(args) -> dict:
     return result
 
 
+class _StubServePod:
+    """One fake serving pod behind its own loopback listener: a
+    deterministic /v1/generate (tokens are a pure function of prompt +
+    seed, so fixed-seed identity through the router is checkable), a
+    REAL radix :class:`~k8s_tpu.models.kvblocks.PrefixTree` tracking
+    shared-prefix hits at the engine's exact block alignment, slot-
+    bounded service time (per-token sleeps, so aggregate tokens/s
+    scales with pods), 503 shedding past the queue bound, /healthz, and
+    a serve_* /metrics exposition.  ``kill()``/``restart()`` drop and
+    re-bind the SAME port — the pod-death/rejoin arm of the router
+    bench."""
+
+    def __init__(self, name: str, block_size: int = 8, slots: int = 4,
+                 queue_limit: int = 64, per_token_s: float = 0.003,
+                 per_prefill_token_s: float = 0.0004,
+                 max_new_default: int = 24):
+        import threading
+
+        from k8s_tpu.models.kvblocks import PrefixTree
+
+        self.name = name
+        self.block_size = block_size
+        self.slots = slots
+        self.queue_limit = queue_limit
+        self.per_token_s = per_token_s
+        self.per_prefill_token_s = per_prefill_token_s
+        self.max_new_default = max_new_default
+        self.tree = PrefixTree(block_size)
+        self._tree_lock = threading.Lock()
+        self._slots_sem = threading.Semaphore(slots)
+        self._state_lock = threading.Lock()
+        self.inflight = 0
+        self.requests = 0
+        self.rejected = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
+        self.tokens_total = 0
+        self.httpd = None
+        self._thread = None
+        self.port = 0
+        self._socks: set = set()
+        self._start(port=0)
+
+    @staticmethod
+    def generate_tokens(prompt: list, seed: int, max_new: int) -> list:
+        """The deterministic 'model': same (prompt, seed, max_new) ->
+        same output on EVERY pod, so routing can never change results."""
+        acc = (sum(int(t) for t in prompt) * 31 + seed * 17) % 65536
+        return [(acc + i * 7 + int(prompt[i % len(prompt)])) % 256
+                for i in range(max_new)]
+
+    def _start(self, port: int) -> None:
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        pod = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            # one TCP segment per response (the models/server.py
+            # rationale): unbuffered writes + Nagle + delayed ACK would
+            # add a ~40ms stall per response and swamp the per-token
+            # service times this bench measures
+            wbufsize = -1
+            disable_nagle_algorithm = True
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.partition("?")[0]
+                if path == "/healthz":
+                    return self._send(200, {"status": "ok",
+                                            "pod": pod.name})
+                if path == "/metrics":
+                    body = pod.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                return self._send(404, {"error": "unknown path"})
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                if self.path.partition("?")[0] != "/v1/generate":
+                    return self._send(404, {"error": "unknown path"})
+                try:
+                    req = json.loads(raw or b"{}")
+                    toks = [int(t) for t in req["tokens"]]
+                except Exception:  # noqa: BLE001 - client error
+                    return self._send(400, {"error": "bad request"})
+                code, obj, headers = pod.serve_one(req, toks)
+                return self._send(code, obj, headers)
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            request_queue_size = 128
+
+            def get_request(self):
+                # track live client sockets so kill() can sever them:
+                # a dead pod drops its keep-alive connections, and the
+                # router's health eviction is measured on exactly that
+                sock, addr = super().get_request()
+                with pod._state_lock:
+                    pod._socks.add(sock)
+                return sock, addr
+
+            def handle_error(self, request, client_address):
+                pass  # killed-socket noise is the point of the chaos arm
+
+        self.httpd = Server(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True, name=f"stub-pod-{self.name}")
+        self._thread.start()
+
+    def serve_one(self, req: dict, toks: list) -> tuple:
+        with self._state_lock:
+            if self.inflight >= self.slots + self.queue_limit:
+                self.rejected += 1
+                return 503, {"error": "queue full"}, {"Retry-After": "1"}
+            self.inflight += 1
+        try:
+            with self._slots_sem:  # slot-bounded service
+                matched = 0
+                with self._tree_lock:
+                    full, partial = self.tree.match(toks, len(toks))
+                    matched = len(full) * self.block_size + (
+                        partial[1] if partial else 0)
+                    n_full = len(toks) // self.block_size
+                    if n_full > len(full):
+                        # block ids are inert in the stub (no device
+                        # pool): absolute positions serve as ids
+                        self.tree.insert(full, toks,
+                                         list(range(n_full)))
+                with self._state_lock:
+                    self.requests += 1
+                    if matched >= self.block_size:
+                        self.prefix_hits += 1
+                        self.prefix_tokens_saved += matched
+                max_new = int(req.get("max_new_tokens")
+                              or self.max_new_default)
+                seed = int(req.get("seed") or 0)
+                # the "device work": prefill the unshared prompt tail,
+                # then decode — wall time scales down with prefix reuse
+                # and up with tokens, the real engine's cost shape
+                time.sleep((len(toks) - matched)
+                           * self.per_prefill_token_s
+                           + max_new * self.per_token_s)
+                out = self.generate_tokens(toks, seed, max_new)
+                with self._state_lock:
+                    self.tokens_total += len(out)
+                return 200, {"tokens": out}, {}
+        finally:
+            with self._state_lock:
+                self.inflight -= 1
+
+    def metrics_text(self) -> str:
+        with self._state_lock:
+            return (
+                "# TYPE serve_tokens_total counter\n"
+                f"serve_tokens_total {self.tokens_total}\n"
+                "# TYPE serve_queue_depth gauge\n"
+                f"serve_queue_depth {max(0, self.inflight - self.slots)}\n"
+                "# TYPE serve_prefix_hits_total counter\n"
+                f"serve_prefix_hits_total {self.prefix_hits}\n"
+                "# TYPE serve_rejected_total counter\n"
+                f"serve_rejected_total {self.rejected}\n"
+            )
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def kill(self) -> None:
+        """Hard pod death: listener AND every live connection drop (a
+        real pod's keep-alive sockets die with it); the port stays
+        reserved for restart()."""
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None
+            with self._state_lock:
+                socks, self._socks = self._socks, set()
+            import socket as socket_mod
+
+            for s in socks:
+                try:
+                    s.shutdown(socket_mod.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._thread.join(timeout=5)
+
+    def restart(self) -> None:
+        """The pod comes back on the SAME address (a restarted container
+        behind a stable service endpoint)."""
+        if self.httpd is None:
+            self._start(port=self.port)
+
+    def stop(self) -> None:
+        self.kill()
+
+
+def _router_workload(clients: int, requests_per_client: int,
+                     block_size: int, templates: int = 16,
+                     shared_frac: float = 0.8,
+                     template_blocks: int = 4) -> list:
+    """The 80%-shared template mix, deterministic per (client, i): a
+    shared request is one of ``templates`` template prefixes (each
+    ``template_blocks`` FULL blocks long — block-aligned by
+    construction) plus a short unique tail; the rest are fully unique
+    prompts of the same length.  Returns [per-client list of (tokens,
+    seed)]."""
+    tlen = template_blocks * block_size
+    out = []
+    for rank in range(clients):
+        reqs = []
+        for i in range(requests_per_client):
+            shared = ((rank * 37 + i * 11) % 100) < round(
+                shared_frac * 100)
+            if shared:
+                tid = (rank + i) % templates
+                prompt = [(tid * 13 + j * 5 + 3) % 256
+                          for j in range(tlen)]
+                prompt += [(rank * 17 + i * 13 + j) % 256
+                           for j in range(3)]  # tail < 1 block
+            else:
+                prompt = [(rank * 41 + i * 97 + j * 7 + 11) % 256
+                          for j in range(tlen + 3)]
+            reqs.append((prompt, rank * 1000 + i))
+        out.append(reqs)
+    return out
+
+
+def _router_closed_loop(url: str, workload: list, max_new: int,
+                        duration_s: float | None = None) -> dict:
+    """Closed-loop clients against one router URL: each client replays
+    its request list (cycling while ``duration_s`` says to keep going),
+    one keep-alive connection per client.  Returns latencies, tokens,
+    errors, and each request's (payload, response) for identity spot
+    checks."""
+    import http.client
+    import threading
+    from urllib.parse import urlsplit
+
+    netloc = urlsplit(url).netloc
+    lock = threading.Lock()
+    lat: list[float] = []
+    errors: list[str] = []
+    tokens = [0]
+    requests_done = [0]
+    completions: list[tuple[float, int]] = []  # (done_ts, tokens)
+    barrier = threading.Barrier(len(workload) + 1)
+
+    def client(rank: int) -> None:
+        conn = http.client.HTTPConnection(netloc, timeout=60)
+        barrier.wait()
+        time.sleep(rank * 0.003)  # desynchronize (bench_serve rationale)
+        deadline = (time.monotonic() + duration_s
+                    if duration_s is not None else None)
+        try:
+            i = 0
+            while True:
+                if deadline is None and i >= len(workload[rank]):
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                toks, seed = workload[rank][i % len(workload[rank])]
+                body = json.dumps({"tokens": toks, "seed": seed,
+                                   "max_new_tokens": max_new}).encode()
+                t0 = time.monotonic()
+                try:
+                    conn.request("POST", "/v1/generate", body=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    out = json.loads(resp.read())
+                    if resp.status != 200:
+                        raise RuntimeError(f"HTTP {resp.status}: {out}")
+                except Exception as e:  # noqa: BLE001 - count, don't crash
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    conn.close()
+                    conn = http.client.HTTPConnection(netloc, timeout=60)
+                    i += 1
+                    continue
+                t1 = time.monotonic()
+                with lock:
+                    lat.append(t1 - t0)
+                    tokens[0] += len(out["tokens"])
+                    requests_done[0] += 1
+                    completions.append((t1, len(out["tokens"])))
+                i += 1
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, args=(r,), daemon=True)
+               for r in range(len(workload))]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    lat.sort()
+    # steady-window throughput: the middle of the run, after the ramp
+    # and before the drain tail (a fixed-request closed loop loses
+    # concurrency as early-finishing clients stop; the ratio the scale
+    # assertion wants is between FULLY-LOADED fleets, not tails)
+    steady = None
+    if completions:
+        lo, hi = t0 + 0.15 * wall, t0 + 0.85 * wall
+        in_win = [(ts, n) for ts, n in completions if lo <= ts <= hi]
+        if in_win and hi > lo:
+            steady = round(sum(n for _ts, n in in_win) / (hi - lo), 1)
+    return {
+        "requests": requests_done[0],
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "tokens": tokens[0],
+        "tokens_per_s": round(tokens[0] / max(wall, 1e-9), 1),
+        "tokens_per_s_steady": steady,
+        "latency_p50_s": round(_quantile(lat, 0.50), 4) if lat else None,
+        "latency_p99_s": round(_quantile(lat, 0.99), 4) if lat else None,
+    }
+
+
+def _router_arm(n_pods: int, policy: str, workload: list, *,
+                block_size: int, max_new: int, retry_budget: int = 2
+                ) -> dict:
+    """One measured arm: fresh stub pods (cold prefix trees), a fresh
+    router at ``policy``, the closed-loop workload, then the fleet-level
+    prefix stats read back from the pods themselves."""
+    from k8s_tpu import router as router_mod
+
+    pods = [_StubServePod(f"pod-{i}", block_size=block_size)
+            for i in range(n_pods)]
+    targets = [(p.name, p.url) for p in pods]
+    router = router_mod.Router(lambda: targets, policy=policy,
+                               block_size=block_size,
+                               retry_budget=retry_budget,
+                               refresh_interval_s=0.2)
+    server = router_mod.RouterServer(router)
+    server.start()
+    try:
+        run = _router_closed_loop(f"http://127.0.0.1:{server.port}",
+                                  workload, max_new)
+        hits = sum(p.prefix_hits for p in pods)
+        reqs = sum(p.requests for p in pods)
+        run.update({
+            "pods": n_pods,
+            "policy": policy,
+            "fleet_prefix_hits": hits,
+            "fleet_prefix_hit_rate": round(hits / max(1, reqs), 3),
+            "prefix_tokens_saved": sum(p.prefix_tokens_saved
+                                       for p in pods),
+            "per_pod_requests": {p.name: p.requests for p in pods},
+            "router_counters": router.counters(),
+        })
+        return run
+    finally:
+        server.stop()
+        for p in pods:
+            p.stop()
+
+
+class _FakeAutoscalePlane:
+    """A fleet-plane stand-in for the autoscale ledger phase: settable
+    queue/occupancy gauges, no SLO breach."""
+
+    def __init__(self):
+        self.queue_mean = 0.0
+        self.occupancy_mean = 0.0
+        plane = self
+
+        class _Agg:
+            def gauge_stats(self, job, family, labels=()):
+                del job, labels
+                if family == "serve_queue_depth":
+                    return {"mean": plane.queue_mean,
+                            "max": plane.queue_mean, "sum": 0, "pods": 1}
+                if family == "serve_batch_occupancy":
+                    return {"mean": plane.occupancy_mean,
+                            "max": plane.occupancy_mean, "sum": 0,
+                            "pods": 1}
+                return None
+
+        class _Slo:
+            def breached(self, job):
+                del job
+                return False
+
+        self.aggregator = _Agg()
+        self.slo = _Slo()
+
+
+def _router_autoscale_ledger_phase(chips_per_replica: int = 4) -> dict:
+    """The gang-atomicity proof, against a REAL GangScheduler with a
+    full chip ledger: a wanted scale-up parks Queued (zero applies, the
+    reservation untouched — never partially placed) until chips free,
+    then admits atomically; scale-down drains through the router hook
+    BEFORE the apply that shrinks the reservation.  Raises on
+    violation; returns the phase record."""
+    from k8s_tpu import router as router_mod
+    from k8s_tpu import scheduler as scheduler_mod
+
+    job = "bench/serve-fleet"
+    sched = scheduler_mod.GangScheduler(total_chips=2 * chips_per_replica)
+    d = sched.sync_admit(job, 2 * chips_per_replica, 0, "default")
+    assert d.admitted, d.reason
+    plane = _FakeAutoscalePlane()
+    current = [2]
+    order: list[str] = []
+
+    def reserve_fn(j, target):
+        return sched.resize(j, target * chips_per_replica).admitted
+
+    def apply_fn(j, target):
+        order.append(f"apply:{target}")
+        current[0] = target
+        # the controller's sync resizes the reservation after a patch;
+        # mirror the shrink half here (the grow half was reserve_fn)
+        if target * chips_per_replica < (sched.reserved_chips(j) or 0):
+            sched.resize(j, target * chips_per_replica)
+        return True
+
+    def drain_fn(j, n):
+        del j
+        order.append(f"drain:{n}")
+        return True
+
+    autoscaler = router_mod.Autoscaler(
+        lambda: plane, up_queue_depth=4.0, down_queue_depth=0.5,
+        hold_evals=2, cooldown_s=30.0)
+    loop = router_mod.AutoscaleLoop(
+        autoscaler, lambda: [(job, current[0], 1, 4)], apply_fn,
+        reserve_fn=reserve_fn, drain_fn=drain_fn)
+
+    failures: list[str] = []
+    now = 1000.0
+    plane.queue_mean = 10.0  # sustained pressure
+    loop.tick_once(now=now)             # hysteresis tick 1: hold
+    loop.tick_once(now=now + 1)         # tick 2: up -> resize DENIED
+    parked = autoscaler.parked_target(job)
+    if current[0] != 2 or loop.applied:
+        failures.append(
+            f"full ledger: scale-up applied anyway (replicas "
+            f"{current[0]}, applied {loop.applied}) — partial placement")
+    if parked != 3:
+        failures.append(f"scale-up not parked (parked={parked})")
+    if sched.reserved_chips(job) != 2 * chips_per_replica:
+        failures.append(
+            f"reservation moved under a denied resize: "
+            f"{sched.reserved_chips(job)}")
+    # chips free -> the parked target admits atomically
+    sched.set_total(4 * chips_per_replica)
+    loop.tick_once(now=now + 2)
+    if current[0] != 3:
+        failures.append(
+            f"freed chips did not un-park the scale-up (replicas "
+            f"{current[0]})")
+    if sched.reserved_chips(job) != 3 * chips_per_replica:
+        failures.append(
+            f"reservation not grown atomically: "
+            f"{sched.reserved_chips(job)} != {3 * chips_per_replica}")
+    # idle -> scale-down drains BEFORE the apply releases chips
+    plane.queue_mean = 0.0
+    loop.tick_once(now=now + 100)       # past cooldown; streak 1
+    loop.tick_once(now=now + 101)       # streak 2 -> down
+    down_events = [e for e in order if e.startswith(("drain", "apply:2"))]
+    if down_events[:2] != ["drain:1", "apply:2"]:
+        failures.append(
+            f"scale-down order wrong (drain must precede apply): {order}")
+    if sched.reserved_chips(job) != 2 * chips_per_replica:
+        failures.append(
+            f"scale-down did not free the victim's chips: "
+            f"{sched.reserved_chips(job)}")
+    if failures:
+        raise RuntimeError("; ".join(failures))
+    return {"order": order, "final_replicas": current[0],
+            "final_chips": sched.reserved_chips(job),
+            "parked_then_admitted": True}
+
+
+def bench_router(pods: int = 4, clients: int = 16,
+                 requests_per_client: int = 16, block_size: int = 8,
+                 shared_frac: float = 0.8, max_new: int = 24,
+                 slo_p99_s: float = 0.75,
+                 kill_run_s: float = 4.5) -> dict:
+    """The --router scenario (ISSUE 13), EMBEDDED assertions throughout
+    (this bench is the acceptance proof of the front door, not advisory
+    trend data):
+
+    - **near-linear scale-out**: aggregate tokens/s behind the router at
+      ``pods`` pods >= 0.7 x pods x the 1-pod figure (same closed-loop
+      clients, same 80%-shared template mix);
+    - **affinity is a fleet asset**: fleet-level prefix hit rate under
+      affine routing >= the single-pod hit rate (the per-pod caches
+      compose instead of fragmenting), with the measured uplift vs a
+      ``random`` placement arm reported AND asserted positive;
+    - **fixed-seed identity**: the same (prompt, seed) answered through
+      the router matches a direct pod call byte-for-byte;
+    - **kill/rejoin under SLO**: a pod hard-killed mid-run is health-
+      evicted (zero client-visible errors — transport failures retry
+      against the next ring candidate), rejoins after restart, and p99
+      stays under ``slo_p99_s`` across the whole incident;
+    - **gang-atomic autoscale**: against a real GangScheduler with a
+      full ledger, a wanted scale-up parks (zero applies, reservation
+      untouched) until chips free, then admits atomically; scale-down
+      drains through the router hook before chips release.
+    """
+    from k8s_tpu import router as router_mod
+
+    failures: list[str] = []
+    workload = _router_workload(clients, requests_per_client, block_size,
+                                shared_frac=shared_frac)
+
+    # -- scale + affinity arms -------------------------------------------
+    single = _router_arm(1, router_mod.POLICY_AFFINE, workload,
+                         block_size=block_size, max_new=max_new)
+    affine = _router_arm(pods, router_mod.POLICY_AFFINE, workload,
+                         block_size=block_size, max_new=max_new)
+    randomized = _router_arm(pods, router_mod.POLICY_RANDOM, workload,
+                             block_size=block_size, max_new=max_new)
+    for arm in (single, affine, randomized):
+        if arm["errors"]:
+            failures.append(
+                f"arm pods={arm['pods']} policy={arm['policy']}: request "
+                f"errors {arm['errors'][:3]}")
+    scaling = ((affine["tokens_per_s_steady"]
+                or affine["tokens_per_s"])
+               / max(single["tokens_per_s_steady"]
+                     or single["tokens_per_s"], 1e-9))
+    if scaling < 0.7 * pods:
+        failures.append(
+            f"aggregate tokens/s not near-linear: {pods} pods gave "
+            f"{scaling:.2f}x one pod (< {0.7 * pods:.1f}x bound)")
+    hit_uplift = (affine["fleet_prefix_hit_rate"]
+                  - randomized["fleet_prefix_hit_rate"])
+    if affine["fleet_prefix_hit_rate"] < \
+            single["fleet_prefix_hit_rate"] - 0.05:
+        failures.append(
+            f"affine fleet hit rate {affine['fleet_prefix_hit_rate']} "
+            f"fell below the single-pod baseline "
+            f"{single['fleet_prefix_hit_rate']}: affinity is "
+            "fragmenting the shared templates across pods")
+    if hit_uplift <= 0.05:
+        failures.append(
+            f"affine routing shows no prefix-hit uplift vs random "
+            f"({affine['fleet_prefix_hit_rate']} vs "
+            f"{randomized['fleet_prefix_hit_rate']})")
+
+    # -- fixed-seed identity through the router vs direct ----------------
+    probe_prompt, probe_seed = workload[0][0]
+    direct = _StubServePod.generate_tokens(probe_prompt, probe_seed,
+                                           max_new)
+    pod = _StubServePod("probe-pod", block_size=block_size)
+    router = router_mod.Router(lambda: [(pod.name, pod.url)],
+                               block_size=block_size)
+    server = router_mod.RouterServer(router)
+    server.start()
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate",
+            data=json.dumps({"tokens": probe_prompt, "seed": probe_seed,
+                             "max_new_tokens": max_new}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            routed = json.loads(resp.read())["tokens"]
+    finally:
+        server.stop()
+        pod.stop()
+    if routed != direct:
+        failures.append(
+            "fixed-seed output through the router differs from the "
+            "direct pod call: the proxy is not transparent")
+
+    # -- pod kill + rejoin under SLO -------------------------------------
+    kill_pods = [_StubServePod(f"kp-{i}", block_size=block_size)
+                 for i in range(pods)]
+    targets = [(p.name, p.url) for p in kill_pods]
+    router = router_mod.Router(lambda: targets,
+                               policy=router_mod.POLICY_AFFINE,
+                               block_size=block_size,
+                               refresh_interval_s=0.1,
+                               fail_threshold=1, probe_timeout_s=0.2,
+                               request_timeout_s=10.0)
+    server = router_mod.RouterServer(router)
+    server.start()
+    victim = kill_pods[-1]
+    incident: dict = {}
+
+    def _chaos():
+        time.sleep(kill_run_s / 3)
+        victim.kill()
+        incident["killed_at"] = time.monotonic()
+        # observe the health eviction (fail_threshold=1 + the 0.1s
+        # refresh loop probing): the victim must leave the ring
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            state = {b["name"]: b for b in router.backends()}
+            if not state[victim.name]["healthy"]:
+                incident["evicted_s"] = round(
+                    time.monotonic() - incident["killed_at"], 3)
+                break
+            time.sleep(0.02)
+        time.sleep(kill_run_s / 3)
+        victim.restart()
+        incident["requests_at_rejoin"] = victim.requests
+
+    import threading as _threading
+
+    chaos = _threading.Thread(target=_chaos, daemon=True)
+    chaos.start()
+    kill_run = _router_closed_loop(
+        f"http://127.0.0.1:{server.port}", workload, max_new,
+        duration_s=kill_run_s)
+    chaos.join(timeout=10)
+    rejoined = {b["name"]: b for b in router.backends()}.get(
+        victim.name, {})
+    victim_post_rejoin = victim.requests - incident.get(
+        "requests_at_rejoin", 0)
+    server.stop()
+    for p in kill_pods:
+        p.stop()
+    if kill_run["errors"]:
+        failures.append(
+            f"{len(kill_run['errors'])} request(s) lost across the pod "
+            f"kill (first: {kill_run['errors'][:2]}) — transport "
+            "failures must retry against the next ring candidate")
+    if "evicted_s" not in incident:
+        failures.append("dead pod was never health-evicted from the ring")
+    if kill_run["latency_p99_s"] is not None \
+            and kill_run["latency_p99_s"] > slo_p99_s:
+        failures.append(
+            f"p99 {kill_run['latency_p99_s']}s breached the "
+            f"{slo_p99_s}s SLO across the kill/rejoin incident")
+    if not rejoined.get("healthy"):
+        failures.append("restarted pod was not re-admitted to the ring")
+    elif victim_post_rejoin <= 0:
+        failures.append(
+            "restarted pod took no traffic after rejoining the ring")
+
+    # -- gang-atomic autoscale against a full ledger ---------------------
+    try:
+        autoscale_phase = _router_autoscale_ledger_phase()
+    except RuntimeError as e:
+        autoscale_phase = {"error": str(e)}
+        failures.append(f"autoscale ledger phase: {e}")
+
+    result = {
+        "pods": pods,
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "block_size": block_size,
+        "shared_frac": shared_frac,
+        "single_pod": single,
+        "affine": affine,
+        "random": randomized,
+        "scaling_x": round(scaling, 2),
+        "scaling_bound_x": round(0.7 * pods, 2),
+        "affine_hit_rate": affine["fleet_prefix_hit_rate"],
+        "single_pod_hit_rate": single["fleet_prefix_hit_rate"],
+        "random_hit_rate": randomized["fleet_prefix_hit_rate"],
+        "affine_hit_uplift_vs_random": round(hit_uplift, 3),
+        "fixed_seed_identity_ok": routed == direct,
+        "kill_rejoin": {**kill_run, **incident,
+                        "victim_requests_after_rejoin":
+                        victim_post_rejoin,
+                        "slo_p99_s": slo_p99_s},
+        "autoscale": autoscale_phase,
+    }
+    if failures:
+        result["failures"] = failures
+        err = RuntimeError("router bench assertions failed:\n  "
+                           + "\n  ".join(failures))
+        err.result = result
+        raise err
+    return result
+
+
+def run_router(args) -> dict:
+    """The --router scenario wrapper (bench.py contract: one JSON-able
+    dict with a metric/value/unit headline).  The artifact is written on
+    failure too — with a ``failures`` field — like bench_fleet.json."""
+    try:
+        r = bench_router(
+            pods=args.router_pods,
+            clients=args.router_clients,
+            requests_per_client=args.router_requests,
+            shared_frac=args.router_shared_frac,
+        )
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write_artifact(args.router_out, {
+                "metric": "router_affine_hit_uplift",
+                "value": partial.get("affine_hit_uplift_vs_random"),
+                "unit": "hit_rate_delta",
+                **partial,
+            })
+        raise
+    out = {
+        "metric": "router_affine_hit_uplift",
+        "value": r["affine_hit_uplift_vs_random"],
+        "unit": "hit_rate_delta",
+        **r,
+    }
+    _write_artifact(args.router_out, out)
+    return out
+
+
 def _noop_ctx():
     import contextlib
 
@@ -1685,6 +2419,34 @@ def main(argv=None) -> int:
     p.add_argument("--fleet-out", default=None,
                    help="also write the --fleet JSON result to this path "
                    "(bench artifact)")
+    p.add_argument("--router", action="store_true",
+                   help="run the serving front-door scenario (ISSUE 13): "
+                   "closed-loop clients vs 1 -> --router-pods stub "
+                   "serving pods (real radix PrefixTrees, slot-bounded "
+                   "service, 503 shedding) behind the prefix-affine "
+                   "router; EMBEDDED ASSERTIONS (near-linear aggregate "
+                   "tokens/s, affine fleet prefix-hit-rate >= the "
+                   "single-pod baseline with measured uplift vs a "
+                   "--router-policy random arm, fixed-seed identity "
+                   "through the router, zero lost requests + p99 under "
+                   "SLO across a pod kill/rejoin, and gang-atomic "
+                   "autoscale against a full chip ledger: parked Queued "
+                   "never partial, drain before chip release) fail the "
+                   "bench; emits one JSON line; combinable with other "
+                   "scenarios")
+    p.add_argument("--router-pods", type=int, default=4,
+                   help="stub serving pods in the scale-out arm (the "
+                   "1-pod baseline always runs)")
+    p.add_argument("--router-clients", type=int, default=16,
+                   help="closed-loop client threads per --router arm")
+    p.add_argument("--router-requests", type=int, default=16,
+                   help="requests per client per --router arm")
+    p.add_argument("--router-shared-frac", type=float, default=0.8,
+                   help="fraction of --router requests sharing a "
+                   "templated block-aligned prompt prefix")
+    p.add_argument("--router-out", default=None,
+                   help="also write the --router JSON result to this "
+                   "path (bench artifact)")
     p.add_argument("--lock-audit-out", default=None,
                    help="enable the runtime lock checker "
                    "(K8S_TPU_LOCK_CHECK=1; k8s_tpu.analysis.checkedlock) "
@@ -1752,11 +2514,11 @@ def _run(args, p) -> int:
         trace.configure(sample_rate=1.0)
 
     if args.slice_scale or args.measure_restart or args.contention \
-            or args.serve or args.churn or args.fleet:
+            or args.serve or args.churn or args.fleet or args.router:
         if args.backend != "fake" and (args.slice_scale
                                        or args.measure_restart
                                        or args.contention or args.churn
-                                       or args.fleet):
+                                       or args.fleet or args.router):
             p.error("--slice-scale/--measure-restart/--contention/--churn/"
                     "--fleet require --backend fake: the injected RTTs, "
                     "the capacity knob, and the fake serving pods only "
@@ -1780,6 +2542,9 @@ def _run(args, p) -> int:
             # also resets the flight counters (runs after --churn has
             # consumed its own accounting)
             results.append(run_fleet(args))
+        if args.router:
+            # self-contained: stub pods + in-process router, no cluster
+            results.append(run_router(args))
         if args.serve:
             results.append(run_serve(args))
         if args.trace:

@@ -43,17 +43,17 @@ EXCLUDE_DIRS = astutil.EXCLUDE_DIRS  # shared with the analysis AST walkers
 # is the fleet telemetry plane — a scrape thread inside the operator
 # process, read by two HTTP processes, all informer/TFJob knowledge kept
 # with its callers; analysis/ (ISSUE 10) is the concurrency auditor whose
-# checkedlock wrappers sit inside every hot-path lock.  None may grow a
-# third-party (or even intra-repo) import — with ONE carve-out: any of
-# them may import ``k8s_tpu.analysis`` (itself stdlib-only, so the
-# transitive guarantee holds) so their locks can be created through the
-# runtime-checkable ``checkedlock`` factories.
+# checkedlock wrappers sit inside every hot-path lock; router/ (ISSUE 13)
+# is the serving front door + autoscaler — a standalone proxy process and
+# an operator control loop served by three HTTP processes.  None may grow
+# a third-party (or out-of-family intra-repo) import — with ONE carve-out:
+# any of them may import another STDLIB_ONLY_PACKAGES member (each is
+# itself gated, so the transitive stdlib guarantee holds): checkedlock
+# factories from ``analysis``, and the router's reuse of ``fleet``
+# discovery types + per-pod rollup reads.
 STDLIB_ONLY_PACKAGES = ("k8s_tpu.trace", "k8s_tpu.scheduler",
                         "k8s_tpu.flight", "k8s_tpu.fleet",
-                        "k8s_tpu.analysis")
-
-# the carve-out target: stdlib-only packages may import this package
-_STDLIB_ONLY_SHARED = "k8s_tpu.analysis"
+                        "k8s_tpu.analysis", "k8s_tpu.router")
 
 
 def check_stdlib_only(path: str, source: bytes | None = None,
@@ -86,9 +86,11 @@ def check_stdlib_only(path: str, source: bytes | None = None,
         for name in names:
             if name == package or name.startswith(package + "."):
                 continue
-            if name == _STDLIB_ONLY_SHARED or \
-                    name.startswith(_STDLIB_ONLY_SHARED + "."):
-                continue  # checkedlock carve-out (see STDLIB_ONLY_PACKAGES)
+            if any(name == member or name.startswith(member + ".")
+                   for member in STDLIB_ONLY_PACKAGES):
+                # family carve-out (see STDLIB_ONLY_PACKAGES): every
+                # member is itself gated, so the guarantee is transitive
+                continue
             if name.split(".", 1)[0] in sys.stdlib_module_names:
                 continue
             violations.append(

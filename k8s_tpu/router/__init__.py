@@ -1,0 +1,127 @@
+"""Serving fleet front door (ISSUE 13): prefix-affine request router +
+metric-driven gang autoscaler — the WRITE side of ROADMAP item 1 (the
+fleet plane, PR 8, is the read side).
+
+Two halves:
+
+- :mod:`k8s_tpu.router.router` — a standalone HTTP front-door process
+  that discovers a serving TFJob's pod endpoints (informer cache /
+  headless-service DNS via a ``targets_fn``, the fleet-discovery
+  contract), proxies ``/v1/generate`` with consistent-hash
+  **prefix-affine** placement (block-aligned fingerprints, same block
+  size as the engine's radix PrefixTree), least-outstanding fallback,
+  bounded 503 retries against the next ring candidate, health eviction
+  + probe re-admission, clean SIGTERM drain, and its own ``/metrics`` +
+  ``/debug/router``.
+- :mod:`k8s_tpu.router.autoscale` — an operator-side control loop (off
+  by default, ``K8S_TPU_AUTOSCALE``) that reads the fleet plane's
+  ``serve_queue_depth`` / ``serve_batch_occupancy`` / SLO burn rollups
+  and scales the serving TFJob's replica count inside spec-declared
+  min/max bounds with hysteresis + cooldown; scale-up is gang-admitted
+  through the PR 4 scheduler (or parked Queued — never partially
+  placed) and scale-down drains the victim through the router before
+  its chips free.
+
+Mirrors the ``fleet.active()`` pattern: one process-global *active
+router* so the metrics server and dashboard serve ``/debug/router``
+without a router reference, 404-with-explicit-body while inactive.
+
+Stdlib-only by policy (``harness/py_checks.py`` gates it like
+``fleet/``/``flight/``); sibling stdlib-only packages may be imported
+(the transitive guarantee holds — ``fleet`` for discovery types and
+per-pod rollup reads).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from k8s_tpu.router.autoscale import (  # noqa: F401 (public surface)
+    AutoscaleLoop,
+    Autoscaler,
+    enabled_from_env as autoscale_enabled_from_env,
+    interval_from_env as autoscale_interval_from_env,
+)
+from k8s_tpu.router.debug import (  # noqa: F401
+    debug_router_response,
+    router_index_entry,
+)
+from k8s_tpu.router.ring import (  # noqa: F401
+    DEFAULT_AFFINITY_BLOCKS,
+    HashRing,
+    fingerprint_request,
+    fingerprint_tokens,
+)
+from k8s_tpu.router.router import (  # noqa: F401
+    DEFAULT_BLOCK_SIZE,
+    DEFAULT_RETRY_BUDGET,
+    POLICY_AFFINE,
+    POLICY_LEAST,
+    POLICY_RANDOM,
+    VALID_POLICIES,
+    Backend,
+    Router,
+    RouterServer,
+)
+
+# -- env knobs ----------------------------------------------------------------
+
+ENV_PORT = "K8S_TPU_ROUTER_PORT"
+ENV_BLOCK_SIZE = "K8S_TPU_ROUTER_BLOCK_SIZE"
+ENV_AFFINITY_BLOCKS = "K8S_TPU_ROUTER_AFFINITY_BLOCKS"
+ENV_RETRY_BUDGET = "K8S_TPU_ROUTER_RETRY_BUDGET"
+ENV_POLICY = "K8S_TPU_ROUTER_POLICY"
+
+
+def _int_from_env(name: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def block_size_from_env() -> int:
+    """K8S_TPU_ROUTER_BLOCK_SIZE: the engine's KV block size the
+    fingerprint aligns to (must match the serving pods' PrefixTree, or
+    affinity degrades to approximate prefix grouping — still correct,
+    just fewer shared-block hits)."""
+    return _int_from_env(ENV_BLOCK_SIZE, DEFAULT_BLOCK_SIZE)
+
+
+def affinity_blocks_from_env() -> int:
+    return _int_from_env(ENV_AFFINITY_BLOCKS, DEFAULT_AFFINITY_BLOCKS)
+
+
+def retry_budget_from_env() -> int:
+    raw = os.environ.get(ENV_RETRY_BUDGET, "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return DEFAULT_RETRY_BUDGET
+    return v if v >= 0 else DEFAULT_RETRY_BUDGET
+
+
+def policy_from_env() -> str:
+    v = os.environ.get(ENV_POLICY, "").strip().lower()
+    return v if v in VALID_POLICIES else POLICY_AFFINE
+
+
+# -- process-global active router (fleet.active() pattern) --------------------
+
+_ACTIVE: Optional[Router] = None
+
+
+def set_active(router: Optional[Router]) -> None:
+    global _ACTIVE
+    _ACTIVE = router
+
+
+def active() -> Optional[Router]:
+    return _ACTIVE
+
+
+def debug_response(query: str = "") -> tuple[int, str, str]:
+    """The /debug/router endpoint body for the active router."""
+    return debug_router_response(_ACTIVE, query)

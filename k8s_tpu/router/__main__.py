@@ -1,0 +1,120 @@
+"""Standalone front-door entrypoint (stdlib-only):
+
+    python -m k8s_tpu.router --port 8080 \\
+        --backend pod-0=http://10.0.0.4:8000 \\
+        --backend pod-1=http://10.0.0.5:8000
+
+or against a serving TFJob's per-index headless-service DNS names (the
+controller's gen_general_name contract — zero apiserver calls):
+
+    python -m k8s_tpu.router --port 8080 \\
+        --dns-job default/serve-lm --dns-rtype worker --dns-replicas 4 \\
+        --dns-port 8000
+
+For informer-cache discovery against a live cluster (targets tracked as
+pods come and go) use ``python -m k8s_tpu.cmd.router`` — that wrapper
+carries the client-layer imports this stdlib-only package may not.
+
+SIGTERM drains cleanly: new requests get 503 + Retry-After while every
+in-flight request completes, then the process exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+import k8s_tpu.router as router_mod
+
+log = logging.getLogger(__name__)
+
+
+def dns_targets(job: str, rtype: str, replicas: int, port: int
+                ) -> list[tuple[str, str]]:
+    """Static per-index headless-service DNS targets for one serving
+    job: ``<ns>-<name>-<rtype>-<i>.<ns>.svc.cluster.local`` (the
+    fleet.discovery._dns_host contract, rebuilt from flags instead of
+    pod labels)."""
+    ns, _, name = job.partition("/")
+    if not name:
+        ns, name = "default", ns
+    key = f"{ns}-{name}"
+    return [
+        (f"{key}-{rtype}-{i}",
+         f"http://{key}-{rtype}-{i}.{ns}.svc.cluster.local:{port}")
+        for i in range(replicas)
+    ]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default loopback; set 0.0.0.0 "
+                   "explicitly for pod exposure)")
+    p.add_argument("--port", type=int,
+                   default=router_mod._int_from_env(router_mod.ENV_PORT,
+                                                    8080))
+    p.add_argument("--backend", action="append", default=[],
+                   metavar="NAME=URL",
+                   help="static backend (repeatable)")
+    p.add_argument("--dns-job", default=None,
+                   help="serving TFJob key (ns/name) whose per-index "
+                   "headless-service DNS names are the backends")
+    p.add_argument("--dns-rtype", default="worker")
+    p.add_argument("--dns-replicas", type=int, default=1)
+    p.add_argument("--dns-port", type=int, default=8000)
+    p.add_argument("--policy", choices=router_mod.VALID_POLICIES,
+                   default=router_mod.policy_from_env())
+    p.add_argument("--block-size", type=int,
+                   default=router_mod.block_size_from_env(),
+                   help="engine KV block size the affinity fingerprint "
+                   "aligns to (K8S_TPU_ROUTER_BLOCK_SIZE)")
+    p.add_argument("--affinity-blocks", type=int,
+                   default=router_mod.affinity_blocks_from_env())
+    p.add_argument("--retry-budget", type=int,
+                   default=router_mod.retry_budget_from_env())
+    p.add_argument("--drain-timeout", type=float, default=30.0)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    targets: list[tuple[str, str]] = []
+    for spec in args.backend:
+        name, _, url = spec.partition("=")
+        if not name or not url:
+            p.error(f"--backend must be NAME=URL, got {spec!r}")
+        targets.append((name, url))
+    if args.dns_job:
+        targets.extend(dns_targets(args.dns_job, args.dns_rtype,
+                                   args.dns_replicas, args.dns_port))
+    if not targets:
+        p.error("no backends: give --backend and/or --dns-job")
+
+    router = router_mod.Router(
+        lambda: targets, job=args.dns_job, policy=args.policy,
+        block_size=args.block_size, affinity_blocks=args.affinity_blocks,
+        retry_budget=args.retry_budget)
+    server = router_mod.RouterServer(router, host=args.host,
+                                     port=args.port)
+    router_mod.set_active(router)
+    server.start()
+    done = threading.Event()
+
+    def _sigterm(_signum, _frame):
+        log.info("router: SIGTERM — draining")
+        threading.Thread(
+            target=lambda: (server.drain_and_stop(args.drain_timeout),
+                            done.set()),
+            daemon=True, name="router-drain").start()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, _sigterm)
+    print(f"READY http://{args.host}:{server.port}", flush=True)
+    done.wait()
+    router_mod.set_active(None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

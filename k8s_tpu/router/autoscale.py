@@ -1,0 +1,411 @@
+"""Metric-driven gang autoscaler for serving TFJobs (ISSUE 13).
+
+The write side of the fleet plane: an operator-side control loop reads
+``serve_queue_depth`` / ``serve_batch_occupancy`` rollups and the SLO
+burn state from the ACTIVE fleet plane and computes a target replica
+count inside the spec-declared ``autoscale`` min/max bounds.  Decisions
+are deliberately sluggish:
+
+- **hysteresis**: a scale signal must persist for ``hold_evals``
+  consecutive evaluations before it acts (burn-rate flicker or one
+  queue spike cannot thrash the gang);
+- **cooldown**: after any applied change the job is frozen for
+  ``cooldown_s`` (the new capacity must show up in the windows before
+  it is judged);
+- **step**: one replica per action — each step flows through the gang
+  scheduler, so capacity changes stay whole-gang-atomic.
+
+Application is hook-based (the controller wires the hooks; this module
+stays stdlib-only and knows nothing about TFJobs):
+
+- ``reserve_fn(job, target_replicas)`` — extend the job's chip
+  reservation for a scale-UP before the spec is patched.  False parks
+  the scale-up: the job keeps its current size (never partially
+  placed), the pending target is recorded and surfaced (``parked``
+  state + an ``autoscale_parked`` event through ``event_fn``), and the
+  loop retries each tick until capacity frees.
+- ``drain_fn(job, victims)`` — route the scale-DOWN victims through
+  the router's per-backend drain (refuse new placements, finish
+  in-flight) BEFORE the patch that releases their chips.
+- ``apply_fn(job, target_replicas)`` — patch the serving TFJob's
+  replica count; the controller's normal sync then creates/deletes the
+  pods and resizes the reservation.
+
+Off by default: the controller only starts the loop when
+``K8S_TPU_AUTOSCALE`` is truthy (scrape_enabled_from_env parity).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from k8s_tpu.analysis import checkedlock
+
+log = logging.getLogger(__name__)
+
+ENV_ENABLE = "K8S_TPU_AUTOSCALE"
+ENV_INTERVAL = "K8S_TPU_AUTOSCALE_INTERVAL_S"
+ENV_UP_QUEUE = "K8S_TPU_AUTOSCALE_UP_QUEUE"
+ENV_DOWN_QUEUE = "K8S_TPU_AUTOSCALE_DOWN_QUEUE"
+ENV_COOLDOWN = "K8S_TPU_AUTOSCALE_COOLDOWN_S"
+ENV_HOLD = "K8S_TPU_AUTOSCALE_HOLD"
+
+DEFAULT_INTERVAL_S = 5.0
+DEFAULT_UP_QUEUE_DEPTH = 4.0     # mean queued requests per pod
+DEFAULT_DOWN_QUEUE_DEPTH = 0.5
+DEFAULT_DOWN_OCCUPANCY = 1.0     # mean active slots per pod
+DEFAULT_COOLDOWN_S = 30.0
+DEFAULT_HOLD_EVALS = 2
+
+
+def enabled_from_env() -> bool:
+    """K8S_TPU_AUTOSCALE: truthy starts the controller's autoscale loop
+    (default off — replica counts stay exactly as specced)."""
+    return os.environ.get(ENV_ENABLE, "").lower() in ("1", "true", "on",
+                                                      "yes")
+
+
+def _float_env(name: str, default: float) -> float:
+    try:
+        v = float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def interval_from_env() -> float:
+    return _float_env(ENV_INTERVAL, DEFAULT_INTERVAL_S)
+
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def autoscaler_kwargs_from_env() -> dict:
+    """The threshold knobs as Autoscaler constructor kwargs — read here
+    so every documented K8S_TPU_AUTOSCALE_* knob actually steers the
+    loop (the controller passes these through)."""
+    return {
+        "up_queue_depth": _float_env(ENV_UP_QUEUE,
+                                     DEFAULT_UP_QUEUE_DEPTH),
+        "down_queue_depth": _float_env(ENV_DOWN_QUEUE,
+                                       DEFAULT_DOWN_QUEUE_DEPTH),
+        "cooldown_s": _float_env(ENV_COOLDOWN, DEFAULT_COOLDOWN_S),
+        "hold_evals": _int_env(ENV_HOLD, DEFAULT_HOLD_EVALS),
+    }
+
+
+class Decision:
+    """One evaluation's outcome."""
+
+    __slots__ = ("job", "current", "target", "direction", "reason",
+                 "signals", "parked")
+
+    def __init__(self, job: str, current: int, target: int,
+                 direction: str, reason: str, signals: dict,
+                 parked: bool = False):
+        self.job = job
+        self.current = current
+        self.target = target
+        self.direction = direction  # "up" | "down" | "hold"
+        self.reason = reason
+        self.signals = signals
+        self.parked = parked
+
+    def to_dict(self) -> dict:
+        return {"job": self.job, "current": self.current,
+                "target": self.target, "direction": self.direction,
+                "reason": self.reason, "signals": self.signals,
+                "parked": self.parked}
+
+
+class Autoscaler:
+    """Pure decision engine: plane rollups in, clamped targets out, with
+    per-job hysteresis + cooldown state.  Thread-safe; no I/O."""
+
+    def __init__(self, plane_fn: Callable[[], object], *,
+                 up_queue_depth: float = DEFAULT_UP_QUEUE_DEPTH,
+                 down_queue_depth: float = DEFAULT_DOWN_QUEUE_DEPTH,
+                 down_occupancy: float = DEFAULT_DOWN_OCCUPANCY,
+                 hold_evals: int = DEFAULT_HOLD_EVALS,
+                 cooldown_s: float = DEFAULT_COOLDOWN_S):
+        if up_queue_depth <= down_queue_depth:
+            raise ValueError(
+                "up_queue_depth must exceed down_queue_depth "
+                f"(got {up_queue_depth} <= {down_queue_depth}: the "
+                "hysteresis band would be empty and the loop would flap)")
+        self._plane_fn = plane_fn
+        self.up_queue_depth = float(up_queue_depth)
+        self.down_queue_depth = float(down_queue_depth)
+        self.down_occupancy = float(down_occupancy)
+        self.hold_evals = max(1, int(hold_evals))
+        self.cooldown_s = float(cooldown_s)
+        self._lock = checkedlock.make_lock("router.autoscale")
+        # job -> {"streak_up", "streak_down", "last_action", "parked"}
+        self._state: dict[str, dict] = {}
+
+    def _signals(self, job: str) -> dict:
+        plane = self._plane_fn()
+        out: dict = {"queue_mean": None, "occupancy_mean": None,
+                     "slo_breached": False}
+        if plane is None:
+            return out
+        try:
+            q = plane.aggregator.gauge_stats(job, "serve_queue_depth")
+            occ = plane.aggregator.gauge_stats(job, "serve_batch_occupancy")
+            out["queue_mean"] = None if q is None else q.get("mean")
+            out["occupancy_mean"] = None if occ is None else occ.get("mean")
+            out["slo_breached"] = bool(plane.slo.breached(job))
+        except Exception:  # noqa: BLE001 - a broken read holds, never scales
+            log.exception("autoscale: reading fleet rollups for %s failed",
+                          job)
+        return out
+
+    def forget(self, job: str) -> None:
+        with self._lock:
+            self._state.pop(job, None)
+
+    def note_applied(self, job: str, now: Optional[float] = None) -> None:
+        """Start the cooldown clock — called by the loop AFTER apply_fn
+        succeeds, so a failed patch does not burn the cooldown."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            st = self._state.setdefault(
+                job, {"streak_up": 0, "streak_down": 0,
+                      "last_action": None, "parked": None})
+            st["last_action"] = now
+            st["streak_up"] = 0
+            st["streak_down"] = 0
+
+    def note_parked(self, job: str, target: int) -> None:
+        with self._lock:
+            st = self._state.setdefault(
+                job, {"streak_up": 0, "streak_down": 0,
+                      "last_action": None, "parked": None})
+            st["parked"] = target
+
+    def clear_parked(self, job: str) -> None:
+        with self._lock:
+            st = self._state.get(job)
+            if st is not None:
+                st["parked"] = None
+
+    def parked_target(self, job: str) -> Optional[int]:
+        with self._lock:
+            st = self._state.get(job)
+            return None if st is None else st.get("parked")
+
+    def evaluate(self, job: str, current: int, min_replicas: int,
+                 max_replicas: int, now: Optional[float] = None
+                 ) -> Decision:
+        """One tick for one job: reads the plane, updates hysteresis
+        state, returns the (clamped) decision.  ``direction == "hold"``
+        means no action this tick."""
+        now = time.monotonic() if now is None else now
+        signals = self._signals(job)
+        queue = signals["queue_mean"]
+        occ = signals["occupancy_mean"]
+        breached = signals["slo_breached"]
+        with self._lock:
+            st = self._state.setdefault(
+                job, {"streak_up": 0, "streak_down": 0,
+                      "last_action": None, "parked": None})
+            # a parked scale-up stays wanted until capacity frees or the
+            # pressure genuinely subsides
+            want_up = breached or (queue is not None
+                                   and queue > self.up_queue_depth)
+            want_down = (not breached
+                         and queue is not None
+                         and queue <= self.down_queue_depth
+                         and (occ is None or occ < self.down_occupancy))
+            if want_up:
+                st["streak_up"] += 1
+                st["streak_down"] = 0
+            elif want_down:
+                st["streak_down"] += 1
+                st["streak_up"] = 0
+            else:
+                st["streak_up"] = 0
+                st["streak_down"] = 0
+                if queue is not None:
+                    # pressure OBSERVED gone: drop the pending ask.  A
+                    # data gap (no rollup this tick — pod churn, plane
+                    # restart) is not calm: the parked target survives
+                    # it, or freed chips would find the ask withdrawn
+                    # and the job would re-accumulate the whole hold
+                    st["parked"] = None
+            in_cooldown = (st["last_action"] is not None
+                           and now - st["last_action"] < self.cooldown_s)
+            parked = st["parked"]
+            if parked is not None and want_up:
+                # retry the parked target every tick — no hold, no
+                # cooldown: admission was the only thing in the way
+                target = min(parked, max_replicas)
+                if target > current:
+                    return Decision(job, current, target, "up",
+                                    "retry-parked", signals, parked=True)
+                st["parked"] = None
+            if in_cooldown:
+                return Decision(job, current, current, "hold",
+                                "cooldown", signals)
+            if want_up and st["streak_up"] >= self.hold_evals:
+                target = min(current + 1, max_replicas)
+                if target > current:
+                    reason = ("slo-burn" if breached
+                              else f"queue-depth {queue:.1f} > "
+                                   f"{self.up_queue_depth:g}")
+                    return Decision(job, current, target, "up", reason,
+                                    signals)
+                return Decision(job, current, current, "hold",
+                                "at-max-replicas", signals)
+            if want_down and st["streak_down"] >= self.hold_evals:
+                target = max(current - 1, min_replicas)
+                if target < current:
+                    return Decision(
+                        job, current, target, "down",
+                        f"idle: queue {queue:.1f} <= "
+                        f"{self.down_queue_depth:g}", signals)
+                return Decision(job, current, current, "hold",
+                                "at-min-replicas", signals)
+            return Decision(job, current, current, "hold",
+                            "hysteresis", signals)
+
+    def state(self) -> dict:
+        with self._lock:
+            return {job: dict(st) for job, st in sorted(self._state.items())}
+
+
+class AutoscaleLoop:
+    """The operator-side control loop: evaluates every autoscalable job
+    each tick and applies decisions through the controller's hooks.
+
+    ``jobs_fn() -> [(job_key, current_replicas, min, max)]``
+    ``reserve_fn(job, target) -> bool`` (None = no admission gate)
+    ``drain_fn(job, n_victims) -> bool`` (None = no drain step)
+    ``undrain_fn(job)`` — revert a drain whose apply failed (optional)
+    ``apply_fn(job, target) -> bool``
+    ``event_fn(job, kind, message)`` (None = log only)
+    """
+
+    def __init__(self, autoscaler: Autoscaler, jobs_fn, apply_fn, *,
+                 reserve_fn=None, drain_fn=None, undrain_fn=None,
+                 event_fn=None,
+                 interval_s: float = DEFAULT_INTERVAL_S):
+        self.autoscaler = autoscaler
+        self._jobs_fn = jobs_fn
+        self._apply_fn = apply_fn
+        self._reserve_fn = reserve_fn
+        self._drain_fn = drain_fn
+        self._undrain_fn = undrain_fn
+        self._event_fn = event_fn
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.ticks = 0
+        self.applied: dict[str, int] = {}   # job -> last applied target
+        self.last_decisions: dict[str, dict] = {}
+
+    def start(self) -> "AutoscaleLoop":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscale-loop")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick_once()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("autoscale: tick failed")
+
+    def _event(self, job: str, kind: str, message: str) -> None:
+        if self._event_fn is not None:
+            try:
+                self._event_fn(job, kind, message)
+            except Exception:  # noqa: BLE001 - eventing must not stall scaling
+                log.exception("autoscale: event sink failed")
+        log.info("autoscale %s: %s %s", job, kind, message)
+
+    def tick_once(self, now: Optional[float] = None) -> list[Decision]:
+        """One synchronous evaluation pass (tests/benches drive this
+        directly); returns every job's decision."""
+        self.ticks += 1
+        decisions: list[Decision] = []
+        for job, current, min_r, max_r in list(self._jobs_fn() or ()):
+            d = self.autoscaler.evaluate(job, current, min_r, max_r,
+                                         now=now)
+            decisions.append(d)
+            self.last_decisions[job] = d.to_dict()
+            if d.direction == "up" and d.target > d.current:
+                self._scale_up(d, now)
+            elif d.direction == "down" and d.target < d.current:
+                self._scale_down(d, now)
+        return decisions
+
+    def _scale_up(self, d: Decision, now: Optional[float]) -> None:
+        if self._reserve_fn is not None \
+                and not self._reserve_fn(d.job, d.target):
+            # gang-atomic or nothing: the whole expansion parks Queued
+            # until the chips exist — NEVER a partial placement.  The
+            # event fires once per distinct parked target, not per
+            # retry tick (the loop re-asks every interval; an Event
+            # every 5s per parked job would be a Warning storm)
+            already = self.autoscaler.parked_target(d.job)
+            self.autoscaler.note_parked(d.job, d.target)
+            self.last_decisions[d.job]["parked"] = True
+            if already != d.target:
+                self._event(d.job, "ScaleUpQueued",
+                            f"scale-up to {d.target} replicas parked: "
+                            f"insufficient chips ({d.reason})")
+            return
+        if self._apply_fn(d.job, d.target):
+            self.autoscaler.clear_parked(d.job)
+            self.autoscaler.note_applied(d.job, now=now)
+            self.applied[d.job] = d.target
+            self._event(d.job, "ScaledUp",
+                        f"{d.current} -> {d.target} replicas ({d.reason})")
+
+    def _scale_down(self, d: Decision, now: Optional[float]) -> None:
+        drained = True
+        if self._drain_fn is not None:
+            # the victim drains through the router BEFORE the patch
+            # that releases its chips — no request is mid-flight on a
+            # pod whose deletion is already committed
+            drained = bool(self._drain_fn(d.job, d.current - d.target))
+        if self._apply_fn(d.job, d.target):
+            self.autoscaler.note_applied(d.job, now=now)
+            self.applied[d.job] = d.target
+            self._event(d.job, "ScaledDown",
+                        f"{d.current} -> {d.target} replicas ({d.reason}"
+                        f"{'' if drained else '; drain timed out'})")
+        elif self._drain_fn is not None and self._undrain_fn is not None:
+            # the patch failed: the drained victims must take traffic
+            # again, not sit refused-forever behind a spec that never
+            # shrank
+            try:
+                self._undrain_fn(d.job)
+            except Exception:  # noqa: BLE001 - best-effort revert
+                log.exception("autoscale: undrain of %s failed", d.job)
+
+    def state(self) -> dict:
+        return {
+            "interval_s": self.interval_s,
+            "ticks": self.ticks,
+            "applied": dict(self.applied),
+            "last_decisions": dict(self.last_decisions),
+            "hysteresis": self.autoscaler.state(),
+        }

@@ -1,0 +1,58 @@
+"""/debug/router responder — ONE implementation shared by the router's
+own listener, the operator's metrics server, and the dashboard backend
+(the fleet.debug_fleet_response pattern), so every process speaks the
+same contract.
+
+Routes:
+
+- ``/debug/router``            — full state: ring membership + keyspace
+  shares, per-backend health/in-flight/shed state, counters, recent
+  placements
+- ``?n=<limit>``               — most recent N placements (default 50)
+- ``?backends=1``              — backends + counters only (no placements)
+
+404 with an explicit body while no router is active in this process —
+the same contract as every other /debug route.
+"""
+
+from __future__ import annotations
+
+import json
+from urllib.parse import parse_qs
+
+
+def router_index_entry(active: bool) -> dict:
+    """The /debug index row for the router responder (consumed by
+    util.debug_index on the operator servers and by the router's own
+    minimal /debug index)."""
+    return {
+        "path": "/debug/router",
+        "subsystem": "serving front-door router (k8s_tpu.router)",
+        "active": active,
+        "activation": "a router process starts (python -m k8s_tpu.router) "
+                      "or a bench/test activates one in-process",
+        "params": ["n", "backends"],
+    }
+
+
+def debug_router_response(router, query: str = "") -> tuple[int, str, str]:
+    """(status_code, body, content_type) for GET /debug/router."""
+    if router is None or not router.active:
+        return (404,
+                "router inactive (start the front door with "
+                "python -m k8s_tpu.router, or a bench/test activates one "
+                "in-process)\n",
+                "text/plain")
+    params = parse_qs(query or "")
+    limit = 50
+    raw = (params.get("n") or [None])[0]
+    if raw is not None:
+        try:
+            limit = max(0, int(raw))
+        except ValueError:
+            pass
+    state = router.debug_state(n_placements=limit)
+    if (params.get("backends") or [""])[0] in ("1", "true"):
+        state.pop("placements", None)
+    body = json.dumps(state, indent=2, default=str)
+    return 200, body + "\n", "application/json"

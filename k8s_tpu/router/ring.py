@@ -1,0 +1,187 @@
+"""Consistent-hash ring + block-aligned prefix fingerprints.
+
+The affinity contract (ISSUE 13): two requests that share a prompt
+prefix of at least ``affinity_blocks * block_size`` tokens must hash to
+the SAME fingerprint, where ``block_size`` is the serving engine's KV
+block size (models/kvblocks.PrefixTree) — because that is the unit the
+radix tree caches at.  A fingerprint shorter than one full block is no
+fingerprint at all (the tree cannot share a partial block by reference;
+routing on it would pin unrelated traffic to one pod for zero reuse).
+
+The ring is classic consistent hashing: ``vnodes`` points per node on a
+2^64 circle keyed by ``sha1(node#i)``; a lookup walks clockwise from
+``sha1(fingerprint)``.  Properties the tests pin:
+
+- **deterministic**: same membership + key -> same node, across
+  processes (sha1, not ``hash()`` — PYTHONHASHSEED must not move
+  traffic);
+- **minimal remap**: adding/removing one node only remaps keys whose
+  clockwise-nearest point belonged to that node (~1/N of the keyspace),
+  so a pod join/leave does not reshuffle the whole fleet's warm KV;
+- **candidate order**: ``candidates(key)`` yields every node, nearest
+  first, each exactly once — the 503-retry walk visits distinct pods.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Iterable, Optional
+
+DEFAULT_VNODES = 64
+DEFAULT_AFFINITY_BLOCKS = 2
+
+
+def _point(data: str) -> int:
+    return int.from_bytes(hashlib.sha1(data.encode()).digest()[:8], "big")
+
+
+def fingerprint_tokens(tokens, block_size: int,
+                       affinity_blocks: int = DEFAULT_AFFINITY_BLOCKS
+                       ) -> Optional[str]:
+    """Block-aligned fingerprint of a token-id prompt, or None when the
+    prompt has no full block (affinity would be pure pinning).
+
+    Uses the first ``min(affinity_blocks, full_blocks)`` FULL blocks —
+    never a partial block, so the fingerprint only covers tokens the
+    target pod's prefix tree can actually share by reference, and a
+    unique tail shorter than one block cannot split a shared template
+    across pods."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    n_full = len(tokens) // block_size
+    if n_full < 1:
+        return None
+    use = min(max(1, affinity_blocks), n_full) * block_size
+    h = hashlib.sha1()
+    h.update(f"{block_size}:".encode())
+    for t in tokens[:use]:
+        h.update(f"{int(t)},".encode())
+    return h.hexdigest()
+
+
+def fingerprint_request(req: dict, block_size: int,
+                        affinity_blocks: int = DEFAULT_AFFINITY_BLOCKS
+                        ) -> Optional[str]:
+    """Fingerprint a /v1/generate JSON body: token requests fingerprint
+    their ids directly; text requests fingerprint the UTF-8 byte stream
+    (the serving tokenizer is byte-level, so byte runs ARE token runs)."""
+    tokens = req.get("tokens")
+    if isinstance(tokens, list):
+        try:
+            return fingerprint_tokens([int(t) for t in tokens], block_size,
+                                      affinity_blocks)
+        except (TypeError, ValueError):
+            return None  # malformed: the backend answers the 400
+    text = req.get("text")
+    if isinstance(text, str):
+        return fingerprint_tokens(text.encode("utf-8", "replace"),
+                                  block_size, affinity_blocks)
+    return None
+
+
+class HashRing:
+    """Deterministic consistent-hash ring over string node names."""
+
+    def __init__(self, nodes: Iterable[str] = (),
+                 vnodes: int = DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = vnodes
+        self._nodes: set[str] = set()
+        self._points: list[int] = []     # sorted ring positions
+        self._owners: list[str] = []     # owner of each position
+        for n in nodes:
+            self.add(n)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node: str) -> bool:
+        return node in self._nodes
+
+    @property
+    def nodes(self) -> list[str]:
+        return sorted(self._nodes)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.vnodes):
+            p = _point(f"{node}#{i}")
+            idx = bisect.bisect_left(self._points, p)
+            # sha1 collisions between distinct (node, vnode) labels are
+            # not a correctness hazard, just an owner preference; keep
+            # insertion deterministic by ordering equal points by name
+            while idx < len(self._points) and self._points[idx] == p \
+                    and self._owners[idx] < node:
+                idx += 1
+            self._points.insert(idx, p)
+            self._owners.insert(idx, node)
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        keep = [(p, o) for p, o in zip(self._points, self._owners)
+                if o != node]
+        self._points = [p for p, _o in keep]
+        self._owners = [o for _p, o in keep]
+
+    def replace(self, nodes: Iterable[str]) -> None:
+        """Reconcile membership to exactly ``nodes`` (minimal edits, so
+        surviving nodes keep their ring points — the minimal-remap
+        property holds across discovery refreshes, not just single
+        add/remove calls)."""
+        target = set(nodes)
+        for n in list(self._nodes - target):
+            self.remove(n)
+        for n in sorted(target - self._nodes):
+            self.add(n)
+
+    def lookup(self, key: str) -> Optional[str]:
+        """The key's owner (clockwise-nearest point), or None when empty."""
+        if not self._points:
+            return None
+        idx = bisect.bisect_right(self._points, _point(key))
+        if idx == len(self._points):
+            idx = 0
+        return self._owners[idx]
+
+    def candidates(self, key: str, limit: Optional[int] = None) -> list[str]:
+        """Every node in clockwise ring order from the key, nearest
+        first, each exactly once — the retry walk for idempotent 503s."""
+        if not self._points:
+            return []
+        limit = len(self._nodes) if limit is None else limit
+        out: list[str] = []
+        seen: set[str] = set()
+        start = bisect.bisect_right(self._points, _point(key))
+        n = len(self._points)
+        for off in range(n):
+            owner = self._owners[(start + off) % n]
+            if owner not in seen:
+                seen.add(owner)
+                out.append(owner)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def state(self) -> dict:
+        """The /debug/router ring payload: membership, vnode count, and
+        per-node keyspace share (fraction of the circle owned)."""
+        shares: dict[str, float] = {n: 0.0 for n in self._nodes}
+        if self._points:
+            full = 2 ** 64
+            prev = self._points[-1] - full
+            for p, o in zip(self._points, self._owners):
+                shares[o] += (p - prev) / full
+                prev = p
+        return {
+            "nodes": self.nodes,
+            "vnodes": self.vnodes,
+            "points": len(self._points),
+            "keyspace_share": {n: round(s, 4)
+                               for n, s in sorted(shares.items())},
+        }

@@ -1,0 +1,809 @@
+"""The serving fleet front door: a prefix-affine reverse proxy.
+
+One ``Router`` fronts one serving TFJob's pods and proxies
+``POST /v1/generate`` with **prefix-affine** placement: the request's
+block-aligned template-prefix fingerprint (ring.fingerprint_request —
+same block size as the engine's radix PrefixTree) is consistent-hashed
+onto the healthy-pod ring, so requests sharing a prompt land on the pod
+whose KV pool already holds those blocks, turning N private prefix
+caches into one fleet-wide asset.  Placement falls back to
+least-outstanding-requests — live in-flight counts per backend,
+tie-broken by the fleet plane's per-pod ``serve_queue_depth`` rollup —
+when the request has no full-block prefix, the affine pod is shedding
+(recent 503 / over the in-flight bound), or it is unhealthy/draining.
+
+Reliability contract:
+
+- idempotent 503s (and transport errors) retry against the NEXT ring
+  candidate, bounded by ``retry_budget`` — each attempt a distinct pod;
+- a backend is evicted from the ring after ``fail_threshold``
+  consecutive transport failures and re-admitted when its ``/healthz``
+  probes green again (a 503 is shedding, not unhealth);
+- ``drain()`` refuses new requests (503 + Retry-After) while completing
+  the in-flight ones — the SIGTERM path, and the per-backend variant
+  the autoscaler uses before releasing a victim pod's chips;
+- the inbound W3C ``traceparent`` is forwarded verbatim, so the PR 12
+  caller -> ingress -> engine trace join survives the extra hop.
+
+Discovery is a ``targets_fn`` callable (the standalone entrypoint wires
+``fleet.targets_from_pods`` over its own pod informer cache; benches
+pass a static list), so the router itself never touches the apiserver —
+the same zero-apiserver-call resolution the fleet plane proved.
+
+Observability: ``/metrics`` (router_requests_total{outcome,affine},
+router_affinity_hits_total, router_backend_inflight, router_retries_total),
+``/healthz``, and ``/debug/router`` (ring state, per-backend
+health/in-flight, recent placements) — served here AND by the operator's
+metrics server + dashboard through the shared responder in
+:mod:`k8s_tpu.router.debug`, 404-when-inactive like every other
+``/debug`` route.
+
+Stdlib-only by policy (harness/py_checks.py gates ``k8s_tpu.router``
+like ``fleet/``/``flight/``); it may import sibling stdlib-only
+packages (``fleet`` for discovery types and rollup reads) — the
+transitive guarantee holds.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import random
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import urlsplit
+
+from k8s_tpu.analysis import checkedlock
+from k8s_tpu.router import ring as ring_mod
+
+log = logging.getLogger(__name__)
+
+DEFAULT_BLOCK_SIZE = 8
+DEFAULT_RETRY_BUDGET = 2
+DEFAULT_FAIL_THRESHOLD = 2
+DEFAULT_REFRESH_S = 1.0
+DEFAULT_SHED_S = 1.0
+DEFAULT_PROBE_TIMEOUT_S = 1.0
+DEFAULT_REQUEST_TIMEOUT_S = 300.0
+PLACEMENT_RING = 256
+
+POLICY_AFFINE = "affine"
+POLICY_LEAST = "least"
+POLICY_RANDOM = "random"
+VALID_POLICIES = (POLICY_AFFINE, POLICY_LEAST, POLICY_RANDOM)
+
+
+class Backend:
+    """One serving pod behind the front door."""
+
+    __slots__ = ("name", "base_url", "healthy", "draining", "inflight",
+                 "consecutive_failures", "last_error", "requests",
+                 "shed_until")
+
+    def __init__(self, name: str, base_url: str):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.healthy = True
+        self.draining = False
+        self.inflight = 0
+        self.consecutive_failures = 0
+        self.last_error = ""
+        self.requests = 0
+        self.shed_until = 0.0
+
+    def to_dict(self, now: float) -> dict:
+        return {
+            "name": self.name,
+            "url": self.base_url,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "inflight": self.inflight,
+            "requests": self.requests,
+            "consecutive_failures": self.consecutive_failures,
+            "shedding": now < self.shed_until,
+            "last_error": self.last_error,
+        }
+
+
+def _base_url(url: str) -> str:
+    """scheme://host:port of any target URL (discovery hands the router
+    /metrics URLs; the generate endpoint lives on the same listener —
+    the genjob --serve contract)."""
+    parts = urlsplit(url)
+    if parts.scheme and parts.netloc:
+        return f"{parts.scheme}://{parts.netloc}"
+    return url.rstrip("/")
+
+
+class Router:
+    """Placement + health state for one serving job's pod fleet.
+
+    ``targets_fn`` yields objects with ``pod`` and ``url`` attributes
+    (fleet.ScrapeTarget) or plain ``(name, base_url)`` pairs.  All HTTP
+    I/O happens OUTSIDE the state lock."""
+
+    def __init__(self, targets_fn: Callable[[], list], *,
+                 job: Optional[str] = None,
+                 block_size: int = DEFAULT_BLOCK_SIZE,
+                 affinity_blocks: int = ring_mod.DEFAULT_AFFINITY_BLOCKS,
+                 vnodes: int = ring_mod.DEFAULT_VNODES,
+                 policy: str = POLICY_AFFINE,
+                 retry_budget: int = DEFAULT_RETRY_BUDGET,
+                 fail_threshold: int = DEFAULT_FAIL_THRESHOLD,
+                 max_inflight: Optional[int] = None,
+                 shed_s: float = DEFAULT_SHED_S,
+                 refresh_interval_s: float = DEFAULT_REFRESH_S,
+                 request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+                 probe_timeout_s: float = DEFAULT_PROBE_TIMEOUT_S):
+        if policy not in VALID_POLICIES:
+            raise ValueError(
+                f"policy {policy!r} must be one of {VALID_POLICIES}")
+        self.job = job
+        self.block_size = int(block_size)
+        self.affinity_blocks = int(affinity_blocks)
+        self.policy = policy
+        self.retry_budget = max(0, int(retry_budget))
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.max_inflight = max_inflight
+        self.shed_s = float(shed_s)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._targets_fn = targets_fn
+        self._ring = ring_mod.HashRing(vnodes=vnodes)
+        self._backends: dict[str, Backend] = {}
+        self._lock = checkedlock.make_lock("router.state")
+        self._draining = False
+        self._started_at: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # counters (under the state lock; rendered by /metrics)
+        self.requests_total: dict[tuple[str, str], int] = {}
+        self.affinity_hits_total = 0
+        self.retries_total = 0
+        self._placements: deque = deque(maxlen=PLACEMENT_RING)
+        self._rng = random.Random()
+        # keep-alive connection pool per backend netloc: a fresh TCP
+        # connect (and a fresh server-side handler thread) per proxied
+        # request costs more than the proxying itself at fleet request
+        # rates; stale pooled sockets are retried once on a fresh
+        # connection before counting as a backend transport failure
+        self._pool: dict[str, list] = {}
+        self._pool_cap = 32
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._started_at is not None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start(self) -> "Router":
+        self._started_at = time.time()
+        self.refresh_once()
+        if self.refresh_interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._maintenance_loop, daemon=True,
+                name="router-refresh")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._started_at = None
+        with self._lock:
+            pools, self._pool = self._pool, {}
+        for idle in pools.values():
+            for conn in idle:
+                conn.close()
+
+    def drain(self) -> None:
+        """Refuse new requests; in-flight ones complete (SIGTERM path)."""
+        self._draining = True
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """True when every in-flight request finished within the budget."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = sum(b.inflight for b in self._backends.values())
+            if busy == 0:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def _maintenance_loop(self) -> None:
+        while not self._stop.wait(self.refresh_interval_s):
+            try:
+                self.refresh_once()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("router: discovery refresh failed")
+
+    # -- discovery / health ---------------------------------------------------
+
+    def refresh_once(self) -> int:
+        """Reconcile the backend table + ring to the discovered targets
+        and probe unhealthy backends; returns the live backend count."""
+        targets = list(self._targets_fn() or ())
+        resolved: dict[str, tuple] = {}
+        for t in targets:
+            name = getattr(t, "pod", None)
+            url = getattr(t, "url", None)
+            if name is None and isinstance(t, (tuple, list)) and len(t) == 2:
+                name, url = t
+            if not name or not url:
+                continue
+            # the cross-process drain protocol: an operator that cannot
+            # reach this router in-process annotates the victim pod
+            # (fleet.ANNOTATION_ROUTER_DRAIN) and discovery carries the
+            # flag; None leaves the locally-set drain state alone
+            resolved[str(name)] = (_base_url(str(url)),
+                                   getattr(t, "draining", None))
+        with self._lock:
+            for name in list(self._backends):
+                if name not in resolved:
+                    del self._backends[name]
+            for name, (base, draining) in resolved.items():
+                b = self._backends.get(name)
+                if b is None:
+                    b = self._backends[name] = Backend(name, base)
+                elif b.base_url != base:
+                    b.base_url = base
+                if draining is not None:
+                    b.draining = draining
+            probe_list = [(b.name, b.base_url)
+                          for b in self._backends.values() if not b.healthy]
+            self._rebuild_ring_locked()
+            count = len(self._backends)
+        for name, base in probe_list:  # I/O outside the lock
+            self._probe(name, base)
+        return count
+
+    def _rebuild_ring_locked(self) -> None:
+        self._ring.replace(b.name for b in self._backends.values()
+                           if b.healthy and not b.draining)
+
+    def _probe(self, name: str, base_url: str) -> None:
+        """Active /healthz recheck of an evicted backend — success
+        re-admits it to the ring."""
+        ok = False
+        try:
+            parts = urlsplit(base_url)
+            conn = http.client.HTTPConnection(parts.netloc,
+                                              timeout=self.probe_timeout_s)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status == 200
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException):
+            # a garbled/non-HTTP answer (crash-looping container) is an
+            # unhealthy probe, not an exception that may abort the rest
+            # of the refresh cycle's probe list
+            ok = False
+        if ok:
+            with self._lock:
+                b = self._backends.get(name)
+                if b is not None and not b.healthy:
+                    b.healthy = True
+                    b.consecutive_failures = 0
+                    b.last_error = ""
+                    self._rebuild_ring_locked()
+
+    def set_draining(self, name: str, draining: bool = True) -> bool:
+        """Per-backend drain (the autoscaler's scale-down hook): a
+        draining pod takes no new placements; its in-flight requests
+        finish.  True when the backend exists."""
+        with self._lock:
+            b = self._backends.get(name)
+            if b is None:
+                return False
+            b.draining = draining
+            self._rebuild_ring_locked()
+            return True
+
+    def backend_inflight(self, name: str) -> Optional[int]:
+        with self._lock:
+            b = self._backends.get(name)
+            return None if b is None else b.inflight
+
+    # -- placement ------------------------------------------------------------
+
+    def _fleet_depths(self) -> dict[str, float]:
+        """Per-pod ``serve_queue_depth`` from the active fleet plane's
+        rollups (the least-outstanding tie-break); empty when no plane
+        is active or the job is unknown — in-flight counts then decide
+        alone."""
+        if not self.job:
+            return {}
+        try:
+            import k8s_tpu.fleet as fleet
+
+            plane = fleet.active()
+            if plane is None:
+                return {}
+            return plane.aggregator.pod_gauge_latest(
+                self.job, "serve_queue_depth") or {}
+        except Exception:  # noqa: BLE001 - a broken tie-break must not drop traffic
+            return {}
+
+    def _eligible_locked(self) -> list[Backend]:
+        return [b for b in self._backends.values()
+                if b.healthy and not b.draining]
+
+    def _available(self, b: Backend, now: float) -> bool:
+        if now < b.shed_until:
+            return False
+        if self.max_inflight is not None and b.inflight >= self.max_inflight:
+            return False
+        return True
+
+    def plan(self, req: dict) -> tuple[list[str], bool, Optional[str]]:
+        """(ordered backend names to try, affine, fingerprint) for one
+        request — pure placement, no I/O.  The first entry is the
+        placement; the rest are the retry walk."""
+        now = time.monotonic()
+        fp = None
+        if self.policy == POLICY_AFFINE:
+            fp = ring_mod.fingerprint_request(req, self.block_size,
+                                              self.affinity_blocks)
+            # affine fast path — the warm-fleet common case pays no
+            # fleet-rollup read and no least-outstanding sort
+            with self._lock:
+                eligible = self._eligible_locked()
+                if not eligible:
+                    return [], False, fp
+                if fp is not None:
+                    by_name = {b.name: b for b in eligible}
+                    ring_order = [n for n in self._ring.candidates(fp)
+                                  if n in by_name]
+                    if ring_order and self._available(
+                            by_name[ring_order[0]], now):
+                        # affine placement; retries walk the ring so
+                        # shared prefixes re-land deterministically
+                        # after a failure
+                        return ring_order, True, fp
+        # fallback / least / random: the per-pod fleet tie-break reads
+        # the aggregator (its own lock) OUTSIDE the router state lock
+        depths = self._fleet_depths()
+        with self._lock:
+            eligible = self._eligible_locked()
+            if not eligible:
+                return [], False, fp
+            by_name = {b.name: b for b in eligible}
+            # availability partitions the least-outstanding order: a
+            # shedding backend rejects fast, so its in-flight count is
+            # LOW — ordering on inflight alone would send the fallback
+            # straight back to the pod that just 503'd.  Shed/full pods
+            # stay in the order as a last resort (if everyone is
+            # shedding, someone still has to answer the 503).
+            least = sorted(
+                eligible,
+                key=lambda b: (not self._available(b, now), b.inflight,
+                               depths.get(b.name, 0.0), b.name))
+            if fp is not None:
+                # affine pod cold/shedding/absent: least-outstanding
+                # fallback, then the ring walk minus the fallback pick
+                ring_order = [n for n in self._ring.candidates(fp)
+                              if n in by_name]
+                order = [least[0].name] + [
+                    n for n in (ring_order or
+                                [b.name for b in least[1:]])
+                    if n != least[0].name]
+                return order, False, fp
+            if self.policy == POLICY_RANDOM:
+                names = [b.name for b in eligible]
+                self._rng.shuffle(names)
+                return names, False, None
+            return [b.name for b in least], False, None
+
+    # -- proxying -------------------------------------------------------------
+
+    def handle_generate(self, body: bytes, headers: dict) -> tuple[
+            int, dict, bytes, dict]:
+        """Proxy one /v1/generate: returns (status, response_headers,
+        body, placement_info).  All failures are mapped to a response —
+        this never raises."""
+        t0 = time.monotonic()
+        try:
+            req = json.loads(body or b"{}")
+            if not isinstance(req, dict):
+                req = {}
+        except (ValueError, json.JSONDecodeError):
+            req = {}  # the backend answers the 400; no affinity
+        order, affine, fp = self.plan(req)
+        if not order:
+            self._finish(None, "no_backends", affine, fp, 0, t0)
+            return (503, {"Retry-After": "1"},
+                    json.dumps({"error": "no healthy backends"}).encode(),
+                    {"outcome": "no_backends", "affine": affine})
+        attempts = min(len(order), 1 + self.retry_budget)
+        last_status, last_headers, last_body = 503, {}, json.dumps(
+            {"error": "all retry candidates failed"}).encode()
+        for i, name in enumerate(order[:attempts]):
+            status, resp_headers, resp_body, err = self._forward(
+                name, body, headers)
+            if err is not None:
+                self._note_transport_failure(name, err)
+                if i + 1 < attempts:
+                    self._count_retry()
+                last_status, last_headers, last_body = 502, {}, json.dumps(
+                    {"error": f"backend {name}: {err}"}).encode()
+                continue
+            if status >= 500:
+                # /v1/generate is idempotent (pure function of the
+                # payload), so EVERY 5xx walks to the next ring
+                # candidate: 503 is shedding (healthy — reset failures,
+                # mark the shed window); other 5xx mean the backend's
+                # ENGINE is sick behind a live listener (a crashed
+                # engine still drains keep-alive sockets and answers
+                # 500) — those count toward health eviction WITHOUT a
+                # success-reset first, or the counter would saturate at
+                # 1 and never reach fail_threshold; /healthz probes
+                # (which the serving pod fails while its engine is
+                # dead) gate re-admission
+                if status == 503:
+                    self._note_success(name, status)
+                else:
+                    self._note_transport_failure(
+                        name, f"HTTP {status} from backend")
+                if i + 1 < attempts:
+                    self._count_retry()
+                last_status, last_headers, last_body = (
+                    status, resp_headers, resp_body)
+                continue
+            self._note_success(name, status)
+            outcome = "ok" if status < 400 else "bad_request"
+            # "affine" means SERVED affine: the first attempt landed on
+            # the ring-designated pod (a retry hop is not a hit)
+            self._finish(name, outcome, affine and i == 0, fp, i, t0)
+            resp_headers["X-Router-Backend"] = name
+            resp_headers["X-Router-Affine"] = "1" if affine and i == 0 \
+                else "0"
+            return status, resp_headers, resp_body, {
+                "outcome": outcome, "affine": affine and i == 0,
+                "backend": name, "attempts": i + 1}
+        outcome = "shed" if last_status == 503 else "error"
+        self._finish(order[0], outcome, affine, fp,
+                     attempts - 1, t0, exhausted=True)
+        last_headers.setdefault("Retry-After", "1")
+        return last_status, last_headers, last_body, {
+            "outcome": outcome, "affine": False, "attempts": attempts}
+
+    def _checkout_conn(self, netloc: str):
+        """(connection, reused) — a pooled keep-alive connection when one
+        is idle, else a fresh one."""
+        with self._lock:
+            idle = self._pool.get(netloc)
+            if idle:
+                return idle.pop(), True
+        return http.client.HTTPConnection(
+            netloc, timeout=self.request_timeout_s), False
+
+    def _checkin_conn(self, netloc: str, conn) -> None:
+        with self._lock:
+            idle = self._pool.setdefault(netloc, [])
+            if len(idle) < self._pool_cap:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def _attempt(self, netloc: str, body: bytes, fwd: dict) -> tuple[
+            int, dict, bytes, Optional[str]]:
+        """One POST on one (possibly pooled) connection.  A failure on a
+        REUSED connection is retried once on a fresh socket — a server
+        closing an idle keep-alive is not a backend failure."""
+        for only_fresh in (False, True):
+            conn, reused = (self._checkout_conn(netloc) if not only_fresh
+                            else (http.client.HTTPConnection(
+                                netloc, timeout=self.request_timeout_s),
+                                False))
+            try:
+                conn.request("POST", "/v1/generate", body=body,
+                             headers=fwd)
+                resp = conn.getresponse()
+                resp_body = resp.read()
+                out_headers = {}
+                ra = resp.getheader("Retry-After")
+                if ra:
+                    out_headers["Retry-After"] = ra
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self._checkin_conn(netloc, conn)
+                return resp.status, out_headers, resp_body, None
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if reused:
+                    continue  # stale keep-alive: one fresh retry
+                return 0, {}, b"", f"{type(e).__name__}: {e}"
+        return 0, {}, b"", "unreachable"  # pragma: no cover
+
+    def _forward(self, name: str, body: bytes, headers: dict) -> tuple[
+            int, dict, bytes, Optional[str]]:
+        """One attempt against one backend; (status, headers, body,
+        transport_error)."""
+        with self._lock:
+            b = self._backends.get(name)
+            if b is None:
+                return 0, {}, b"", "backend vanished"
+            b.inflight += 1
+            b.requests += 1
+            netloc = urlsplit(b.base_url).netloc
+        try:
+            fwd = {"Content-Type": "application/json"}
+            tp = headers.get("traceparent")
+            if tp:
+                fwd["traceparent"] = tp  # PR 12 trace join survives
+            return self._attempt(netloc, body, fwd)
+        finally:
+            with self._lock:
+                b2 = self._backends.get(name)
+                if b2 is not None:
+                    b2.inflight = max(0, b2.inflight - 1)
+
+    # -- accounting -----------------------------------------------------------
+
+    def _note_transport_failure(self, name: str, err: str) -> None:
+        with self._lock:
+            b = self._backends.get(name)
+            if b is None:
+                return
+            b.consecutive_failures += 1
+            b.last_error = err[:200]
+            if b.healthy and b.consecutive_failures >= self.fail_threshold:
+                b.healthy = False  # evicted until a /healthz probe greens
+                self._rebuild_ring_locked()
+
+    def _note_success(self, name: str, status: int) -> None:
+        with self._lock:
+            b = self._backends.get(name)
+            if b is None:
+                return
+            b.consecutive_failures = 0
+            if status == 503:
+                # shedding is not unhealth: keep it in the ring but skip
+                # it for placement until the shed window passes
+                b.shed_until = time.monotonic() + self.shed_s
+
+    def _count_retry(self) -> None:
+        with self._lock:
+            self.retries_total += 1
+
+    def _finish(self, backend: Optional[str], outcome: str, affine: bool,
+                fp: Optional[str], retries: int, t0: float,
+                exhausted: bool = False) -> None:
+        with self._lock:
+            key = (outcome, "true" if affine and not exhausted else "false")
+            self.requests_total[key] = self.requests_total.get(key, 0) + 1
+            if affine and not exhausted and outcome == "ok" and retries == 0:
+                self.affinity_hits_total += 1
+            self._placements.append({
+                "ts": round(time.time(), 3),
+                "backend": backend,
+                "outcome": outcome,
+                "affine": bool(affine and not exhausted and retries == 0),
+                "fingerprint": (fp[:12] if fp else None),
+                "attempts": retries + 1,
+                "elapsed_s": round(time.monotonic() - t0, 4),
+            })
+
+    # -- reads ----------------------------------------------------------------
+
+    def backends(self) -> list[dict]:
+        now = time.monotonic()
+        with self._lock:
+            return [b.to_dict(now)
+                    for b in sorted(self._backends.values(),
+                                    key=lambda b: b.name)]
+
+    def placements(self, n: int = 50) -> list[dict]:
+        if n <= 0:
+            return []  # entries[-0:] would invert the bound to "all"
+        with self._lock:
+            entries = list(self._placements)
+        return entries[-n:]
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "requests_total": {
+                    f"{outcome}:{affine}": v
+                    for (outcome, affine), v in
+                    sorted(self.requests_total.items())},
+                "affinity_hits_total": self.affinity_hits_total,
+                "retries_total": self.retries_total,
+            }
+
+    def debug_state(self, n_placements: int = 50) -> dict:
+        """The /debug/router payload."""
+        with self._lock:
+            ring_state = self._ring.state()
+        return {
+            "job": self.job,
+            "policy": self.policy,
+            "draining": self._draining,
+            "started_at": self._started_at,
+            "block_size": self.block_size,
+            "affinity_blocks": self.affinity_blocks,
+            "retry_budget": self.retry_budget,
+            "ring": ring_state,
+            "backends": self.backends(),
+            "counters": self.counters(),
+            "placements": self.placements(n_placements),
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition 0.0.4 of the router families."""
+        with self._lock:
+            totals = dict(self.requests_total)
+            hits = self.affinity_hits_total
+            retries = self.retries_total
+            inflight = [(b.name, b.inflight)
+                        for b in sorted(self._backends.values(),
+                                        key=lambda b: b.name)]
+            healthy = sum(1 for b in self._backends.values() if b.healthy)
+            total_backends = len(self._backends)
+        lines = [
+            "# HELP router_requests_total Proxied /v1/generate requests "
+            "by outcome and affine placement.",
+            "# TYPE router_requests_total counter",
+        ]
+        for (outcome, affine), v in sorted(totals.items()):
+            lines.append(
+                f'router_requests_total{{outcome="{outcome}",'
+                f'affine="{affine}"}} {v}')
+        lines += [
+            "# HELP router_affinity_hits_total Requests served by their "
+            "ring-affine backend on the first attempt.",
+            "# TYPE router_affinity_hits_total counter",
+            f"router_affinity_hits_total {hits}",
+            "# HELP router_retries_total Retry attempts against a next "
+            "ring candidate (idempotent 503s and transport errors).",
+            "# TYPE router_retries_total counter",
+            f"router_retries_total {retries}",
+            "# HELP router_backend_inflight Live in-flight requests per "
+            "backend pod.",
+            "# TYPE router_backend_inflight gauge",
+        ]
+        for name, n in inflight:
+            lines.append(f'router_backend_inflight{{backend="{name}"}} {n}')
+        lines += [
+            "# HELP router_backends Known backends by health.",
+            "# TYPE router_backends gauge",
+            f'router_backends{{state="healthy"}} {healthy}',
+            f'router_backends{{state="unhealthy"}} '
+            f"{total_backends - healthy}",
+        ]
+        return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "k8s-tpu-router"
+    # one TCP segment per response (the models/server.py rationale):
+    # buffered writes + no Nagle, or keep-alive clients stall 40-200ms
+    wbufsize = -1
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):
+        log.debug("router: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              headers: Optional[dict] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            if k.lower() not in ("content-type", "content-length"):
+                self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        router: Router = self.server.router  # type: ignore[attr-defined]
+        path, _, query = self.path.partition("?")
+        if path == "/metrics":
+            return self._send(200, router.metrics_text().encode(),
+                              "text/plain; version=0.0.4; charset=utf-8")
+        if path == "/healthz":
+            with_backends = any(b["healthy"] for b in router.backends())
+            status = ("draining" if router.draining
+                      else "ok" if with_backends else "no backends")
+            code = 200 if with_backends and not router.draining else 503
+            return self._send(code, json.dumps(
+                {"status": status,
+                 "backends": len(router.backends())}).encode(),
+                "application/json")
+        if path == "/debug/router":
+            from k8s_tpu.router.debug import debug_router_response
+
+            code, body, ctype = debug_router_response(router, query)
+            return self._send(code, body.encode(), ctype)
+        if path in ("/debug", "/debug/"):
+            # the router process serves a minimal index of its own
+            # endpoints (the full cross-subsystem index lives on the
+            # operator's metrics server / dashboard, which aggregate
+            # every active subsystem in that process)
+            from k8s_tpu.router.debug import router_index_entry
+
+            body = json.dumps(
+                {"endpoints": [router_index_entry(active=True)]},
+                indent=2) + "\n"
+            return self._send(200, body.encode(), "application/json")
+        return self._send(404, json.dumps(
+            {"error": f"unknown path {path}"}).encode(), "application/json")
+
+    def do_POST(self):  # noqa: N802
+        router: Router = self.server.router  # type: ignore[attr-defined]
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            self.close_connection = True
+            return self._send(400, json.dumps(
+                {"error": "bad Content-Length"}).encode(), "application/json")
+        body = self.rfile.read(length) if length > 0 else b""
+        if self.path.partition("?")[0] != "/v1/generate":
+            return self._send(404, json.dumps(
+                {"error": f"unknown path {self.path}"}).encode(),
+                "application/json")
+        if router.draining:
+            return self._send(503, json.dumps(
+                {"error": "router draining"}).encode(), "application/json",
+                headers={"Retry-After": "1"})
+        status, headers, resp_body, _info = router.handle_generate(
+            body, {k.lower(): v for k, v in self.headers.items()})
+        return self._send(status, resp_body, "application/json",
+                          headers=headers)
+
+
+class RouterServer:
+    """The front-door HTTP process: a Router plus its listener."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.router = router  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "RouterServer":
+        self.router.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name="router-server")
+        self._thread.start()
+        log.info("router front door on :%d (POST /v1/generate)", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.router.stop()
+
+    def drain_and_stop(self, timeout_s: float = 30.0) -> bool:
+        """SIGTERM path: refuse new requests, finish in-flight ones,
+        then stop; True when the drain completed inside the budget."""
+        self.router.drain()
+        idle = self.router.wait_idle(timeout_s)
+        self.stop()
+        return idle

@@ -277,6 +277,52 @@ class GangScheduler:
         with self._lock:
             return key in self.capacity.reservations
 
+    def reserved_chips(self, key: str) -> Optional[int]:
+        """The job's current chip hold, or None when it holds nothing —
+        the drift check the controller runs when a replica-count patch
+        (autoscale, ISSUE 13) changes a reserved gang's demand."""
+        with self._lock:
+            r = self.capacity.reservations.get(key)
+            return None if r is None else r.chips
+
+    def resize(self, key: str, chips: int,
+               now: Optional[float] = None) -> Decision:
+        """Atomically resize an EXISTING reservation to ``chips`` — the
+        gang-atomic scale path (ISSUE 13).  A shrink always succeeds and
+        frees the delta back to the pool; a grow succeeds iff the whole
+        delta fits in the available chips RIGHT NOW, else nothing
+        changes and the caller parks the expansion (never a partial
+        placement).  Unreserved keys are refused: first admission goes
+        through :meth:`sync_admit`, where queue order and preemption
+        apply."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if self.unlimited:
+                return Decision(admitted=True, reason="unlimited")
+            r = self.capacity.reservations.get(key)
+            if r is None:
+                return Decision(admitted=False,
+                                reason="not-reserved (admit first)")
+            if chips <= 0:
+                return Decision(admitted=False,
+                                reason="resize to <= 0 chips is a release")
+            delta = chips - r.chips
+            if delta == 0:
+                return Decision(admitted=True, reason="unchanged")
+            if delta < 0:
+                r.chips = chips
+                self._event("shrink", key=key, chips=-delta)
+                return Decision(admitted=True, reason="shrunk",
+                                newly_admitted=False)
+            if delta > self.capacity.available():
+                self._event("resize-denied", key=key, chips=delta)
+                return Decision(
+                    admitted=False, reason="insufficient-capacity")
+            r.chips = chips
+            self._event("grow", key=key, chips=delta)
+            return Decision(admitted=True, reason="grown",
+                            newly_admitted=False)
+
     # -- release --------------------------------------------------------------
 
     def release(self, key: str) -> int:

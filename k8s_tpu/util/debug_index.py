@@ -51,6 +51,14 @@ def _requests_active() -> bool:
     return requestlog.active() is not None
 
 
+def _router_entry() -> dict:
+    from k8s_tpu import router as router_mod
+
+    r = router_mod.active()
+    return router_mod.router_index_entry(
+        active=r is not None and r.active)
+
+
 def debug_index_response(query: str = "") -> tuple[int, str, str]:
     """(status_code, body, content_type) for GET /debug (and /debug/)."""
     del query  # no parameters; kept for the shared responder signature
@@ -115,6 +123,10 @@ def debug_index_response(query: str = "") -> tuple[int, str, str]:
                           "binds the recorder on construction)",
             "params": ["n"],
         },
+        # serving front-door router (ISSUE 13): the row definition lives
+        # with the responder so the router's own minimal /debug index and
+        # this one cannot drift
+        _router_entry(),
     ]
     body = json.dumps({"endpoints": endpoints}, indent=2)
     return 200, body + "\n", "application/json"

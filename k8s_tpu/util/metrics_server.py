@@ -128,6 +128,16 @@ class MetricsServer:
 
                     code, body, ctype = fleet.debug_response(query)
                     return self._send(code, body, ctype)
+                if path == "/debug/router":
+                    # serving front-door router (ISSUE 13): ring state,
+                    # per-backend health/in-flight, recent placements
+                    # (?n=/?backends=; 404 with an explicit body until a
+                    # router is active in this process — /debug/fleet
+                    # parity)
+                    from k8s_tpu import router as router_mod
+
+                    code, body, ctype = router_mod.debug_response(query)
+                    return self._send(code, body, ctype)
                 if path == "/debug/compiles":
                     # XLA compile ledger: per-seam budgets, fingerprint
                     # counts/stacks, recent compile events (?seam=/?n=/

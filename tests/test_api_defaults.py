@@ -107,6 +107,32 @@ class TestV1Alpha2Defaults:
         assert got == [{"name": "tfjob-port", "containerPort": 2222}]
 
 
+def test_v1alpha2_autoscale_replica_type_defaults_to_worker():
+    """ISSUE 13: autoscale bounds without an explicit replicaType scale
+    the Worker type (the genjob --serve shape); absent autoscale stays
+    absent."""
+    job = v1alpha2.TFJob(
+        spec=v1alpha2.TFJobSpec(
+            tf_replica_specs={
+                "Worker": v1alpha2.TFReplicaSpec(template=_pod_template())
+            },
+            autoscale=v1alpha2.AutoscaleSpec(min_replicas=1,
+                                             max_replicas=3),
+        )
+    )
+    v1alpha2.set_defaults_tfjob(job)
+    assert job.spec.autoscale.replica_type == "Worker"
+    bare = v1alpha2.TFJob(
+        spec=v1alpha2.TFJobSpec(
+            tf_replica_specs={
+                "Worker": v1alpha2.TFReplicaSpec(template=_pod_template())
+            }
+        )
+    )
+    v1alpha2.set_defaults_tfjob(bare)
+    assert bare.spec.autoscale is None
+
+
 def test_scheme_dispatch_and_roundtrip():
     obj = {
         "apiVersion": "kubeflow.org/v1alpha2",

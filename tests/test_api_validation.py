@@ -132,6 +132,68 @@ class TestV1Alpha2Validation:
         validate_v1alpha2_tfjob_spec(spec)
 
 
+class TestAutoscaleValidation:
+    """spec.autoscale bounds (ISSUE 13)."""
+
+    def _spec(self, **autoscale_kw):
+        return v1alpha2.TFJobSpec(
+            tf_replica_specs={
+                "Worker": v1alpha2.TFReplicaSpec(template=_template())
+            },
+            autoscale=v1alpha2.AutoscaleSpec(**autoscale_kw),
+        )
+
+    def test_valid_bounds(self):
+        validate_v1alpha2_tfjob_spec(
+            self._spec(min_replicas=1, max_replicas=4))
+        validate_v1alpha2_tfjob_spec(
+            self._spec(min_replicas=2, max_replicas=2,
+                       replica_type="Worker"))
+
+    def test_bounds_required_together(self):
+        with pytest.raises(ValidationError, match="required"):
+            validate_v1alpha2_tfjob_spec(self._spec(min_replicas=1))
+        with pytest.raises(ValidationError, match="required"):
+            validate_v1alpha2_tfjob_spec(self._spec(max_replicas=4))
+
+    def test_bounds_must_be_genuine_positive_ints(self):
+        with pytest.raises(ValidationError, match="integer"):
+            validate_v1alpha2_tfjob_spec(
+                self._spec(min_replicas=True, max_replicas=4))
+        with pytest.raises(ValidationError, match="integer"):
+            validate_v1alpha2_tfjob_spec(
+                self._spec(min_replicas=1, max_replicas="4"))
+        with pytest.raises(ValidationError, match=">= 1"):
+            validate_v1alpha2_tfjob_spec(
+                self._spec(min_replicas=0, max_replicas=4))
+
+    def test_min_above_max_rejected(self):
+        with pytest.raises(ValidationError, match="<="):
+            validate_v1alpha2_tfjob_spec(
+                self._spec(min_replicas=5, max_replicas=2))
+
+    def test_phantom_replica_type_rejected(self):
+        # a bound on a type with no replica spec would make the
+        # autoscaler a no-op that LOOKS configured
+        with pytest.raises(ValidationError, match="replicaType"):
+            validate_v1alpha2_tfjob_spec(
+                self._spec(min_replicas=1, max_replicas=4,
+                           replica_type="PS"))
+
+    def test_autoscale_round_trip(self):
+        spec = self._spec(min_replicas=1, max_replicas=4,
+                          replica_type="Worker")
+        again = v1alpha2.TFJobSpec.from_dict(spec.to_dict())
+        assert again.autoscale.min_replicas == 1
+        assert again.autoscale.max_replicas == 4
+        assert again.autoscale.replica_type == "Worker"
+        # absent stays absent (no phantom autoscale block in to_dict)
+        bare = v1alpha2.TFJobSpec.from_dict(
+            {"tfReplicaSpecs": {"Worker": {"template": _template()}}})
+        assert bare.autoscale is None
+        assert "autoscale" not in bare.to_dict()
+
+
 def test_v1alpha2_missing_port_rejected():
     """Un-defaulted spec without tfjob-port fails terminally, not at env-gen."""
     spec = v1alpha2.TFJobSpec(

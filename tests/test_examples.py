@@ -99,6 +99,34 @@ class TestExampleManifests:
         envv = {e["name"]: e["value"] for e in c["env"]}
         assert envv["K8S_TPU_REQUEST_LOG"] == "1"
 
+    def test_tf_job_serve_router_yaml(self):
+        """The front-door example (ISSUE 13): an autoscalable serving
+        TFJob (spec.autoscale bounds validate and default) plus its
+        router companion Pod document (skipped by the TFJob loader,
+        applied by kubectl)."""
+        job = load_one("tf_job_serve_router.yaml")
+        assert job.api_version == v1alpha2.CRD_API_VERSION
+        a = job.spec.autoscale
+        assert a is not None
+        assert (a.min_replicas, a.max_replicas) == (1, 4)
+        assert a.replica_type == "Worker"
+        worker = job.spec.tf_replica_specs["Worker"]
+        assert worker.replicas == a.min_replicas
+        annotations = (worker.template.get("metadata") or {}).get(
+            "annotations") or {}
+        assert annotations.get("kubeflow.org/fleet-scrape-port") == "8000"
+        # the second document is the router companion Pod
+        with open(os.path.join(EXAMPLES, "tf_job_serve_router.yaml")) as f:
+            docs = list(manifest.load_yaml_documents(f.read()))
+        pods = [d for d in docs if d.get("kind") == "Pod"]
+        assert len(pods) == 1
+        container = pods[0]["spec"]["containers"][0]
+        assert "k8s_tpu.cmd.router" in container["command"]
+        assert any("--job=default/serve-lm-fleet" == c
+                   for c in container["command"])
+        probe = container["readinessProbe"]["httpGet"]
+        assert probe["path"] == "/healthz"
+
     def test_tpu_smoke_yaml(self):
         job = load_one("tpu_smoke.yaml")
         assert job.spec.tf_replica_specs["TPU"].restart_policy == v1alpha2.RestartPolicyNever

@@ -341,6 +341,34 @@ class TestAggregator:
             "ns/j", "serve_queue_depth", 10.0, 3.0,
             of="mean") == pytest.approx(4.0)
 
+    def test_pod_gauge_latest_round_trip(self):
+        """ISSUE 13 drive-by pin: the per-POD rollup accessor the
+        router's least-outstanding fallback tie-breaks on — per-target
+        values survive the scrape -> parse -> ingest -> read round trip
+        (the per-job merge must not erase pod identity), stale pods are
+        pruned by cycle_done, and unknown jobs/families answer None."""
+        agg = FleetAggregator()
+        for t in range(3):
+            for pod, v in (("p0", 7.0), ("p1", 1.0), ("p2", 3.0)):
+                agg.ingest("ns/j", pod, self._fam(
+                    "# TYPE serve_queue_depth gauge\n"
+                    f"serve_queue_depth {v}\n"), float(t))
+            agg.cycle_done(float(t), stale_after_s=10.0)
+        assert agg.pod_gauge_latest("ns/j", "serve_queue_depth") \
+            == {"p0": 7.0, "p1": 1.0, "p2": 3.0}
+        assert agg.pod_gauge_latest("ns/other", "serve_queue_depth") \
+            is None
+        assert agg.pod_gauge_latest("ns/j", "serve_nope") is None
+        # a scaled-down pod's reading is pruned with the gauge cycle
+        for t in (20.0, 21.0):
+            for pod, v in (("p0", 5.0), ("p1", 2.0)):  # p2 gone
+                agg.ingest("ns/j", pod, self._fam(
+                    "# TYPE serve_queue_depth gauge\n"
+                    f"serve_queue_depth {v}\n"), t)
+            agg.cycle_done(t, stale_after_s=10.0)
+        assert agg.pod_gauge_latest("ns/j", "serve_queue_depth") \
+            == {"p0": 5.0, "p1": 2.0}
+
     def test_histogram_merge_and_quantiles(self):
         agg = FleetAggregator()
         # two pods, identical distribution: 90% <= 0.1, 10% in (0.1, 1.0]
@@ -856,7 +884,7 @@ class TestFleetEndpoint:
                         "/debug/traces", "/debug/scheduler",
                         "/debug/timeline", "/debug/fleet",
                         "/debug/compiles", "/debug/requests",
-                        "/debug/engine"}
+                        "/debug/engine", "/debug/router"}
                     assert endpoints["/debug/fleet"]["active"] is False
                     for e in endpoints.values():
                         assert "activation" in e and "params" in e

@@ -483,6 +483,50 @@ class TestGenjob:
         assert env["K8S_TPU_REQUEST_LOG"] == "1"
         assert "K8S_TPU_REQUEST_LOG_RING" not in env
 
+    def test_serve_router_emits_companion_and_autoscale_bounds(self):
+        """--serve --router (ISSUE 13): each serving TFJob carries the
+        spec.autoscale bounds (validating as v1alpha2, Worker replicas
+        starting at minReplicas) and is followed by its front-door
+        companion Pod running the informer-discovery router binary."""
+        docs = genjob.generate(2, serve=True, timestamp=11, router=True,
+                               router_port=9090, router_policy="least",
+                               router_block_size=16,
+                               autoscale_min=2, autoscale_max=6)
+        assert [d["kind"] for d in docs] == ["TFJob", "Pod",
+                                            "TFJob", "Pod"]
+        job, companion = docs[0], docs[1]
+        assert job["spec"]["autoscale"] == {
+            "minReplicas": 2, "maxReplicas": 6, "replicaType": "Worker"}
+        assert job["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 2
+        manifest.load_tfjob(job)  # autoscale bounds default+validate
+        c = companion["spec"]["containers"][0]
+        assert "k8s_tpu.cmd.router" in c["command"]
+        job_key = f"default/{job['metadata']['name']}"
+        assert f"--job={job_key}" in c["command"]
+        assert "--port=9090" in c["command"]
+        assert "--policy=least" in c["command"]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["K8S_TPU_ROUTER_BLOCK_SIZE"] == "16"
+        assert env["K8S_TPU_ROUTER_POLICY"] == "least"
+        assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+        assert companion["metadata"]["name"] \
+            == job["metadata"]["name"] + "-router"
+
+    def test_serve_router_knob_defaults_and_guards(self):
+        # no --router: no companion, no autoscale block
+        [job] = genjob.generate(1, serve=True, timestamp=12)
+        assert "autoscale" not in job["spec"]
+        # --router requires --serve
+        with pytest.raises(ValueError):
+            genjob.generate(1, router=True, timestamp=12)
+        # autoscale bounds come as a pair
+        with pytest.raises(ValueError):
+            genjob.serve_tfjob_template("j", autoscale_min=2)
+        # ...and are refused (not silently dropped) without --serve
+        with pytest.raises(ValueError, match="require"):
+            genjob.generate(1, autoscale_min=1, autoscale_max=4,
+                            timestamp=12)
+
     def test_unique_names_and_scheduler(self):
         jobs = genjob.generate(3, scheduler_name="kube-batch", timestamp=9)
         names = [j["metadata"]["name"] for j in jobs]

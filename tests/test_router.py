@@ -1,0 +1,885 @@
+"""Serving front-door router + gang autoscaler tests (ISSUE 13):
+ring determinism and minimal remap, block-aligned affinity fingerprints
+landing on the target pod's REAL PrefixTree end-to-end, 503 retry
+walks with budget exhaustion, drain semantics, autoscaler hysteresis /
+cooldown / clamping, gang-atomic (parked-not-partial) scale-up against
+a full chip ledger, controller scale-down reconcile, per-pod fleet
+rollups, and /debug/router 404 parity on both HTTP servers."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import k8s_tpu.router as router_mod
+from k8s_tpu.router import ring as ring_mod
+from k8s_tpu.harness.bench_operator import (
+    _FakeAutoscalePlane,
+    _StubServePod,
+    _router_autoscale_ledger_phase,
+)
+
+
+def _post(url: str, payload: dict, timeout: float = 10.0):
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def _get(url: str, timeout: float = 10.0):
+    try:
+        resp = urllib.request.urlopen(url, timeout=timeout)
+        return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- consistent-hash ring -----------------------------------------------------
+
+
+class TestHashRing:
+    def test_deterministic_across_instances(self):
+        nodes = [f"pod-{i}" for i in range(5)]
+        a = ring_mod.HashRing(nodes)
+        b = ring_mod.HashRing(reversed(nodes))  # insertion order moot
+        for k in range(200):
+            key = f"key-{k}"
+            assert a.lookup(key) == b.lookup(key)
+
+    def test_minimal_remap_on_join_and_leave(self):
+        nodes = [f"pod-{i}" for i in range(4)]
+        ring = ring_mod.HashRing(nodes)
+        keys = [f"key-{i}" for i in range(2000)]
+        before = {k: ring.lookup(k) for k in keys}
+        ring.add("pod-4")
+        after = {k: ring.lookup(k) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        # a 5th node should claim ~1/5 of the keyspace; anything near a
+        # full reshuffle means the ring is mod-N hashing in disguise
+        assert 0 < len(moved) / len(keys) < 0.35
+        # every moved key moved TO the new node, nowhere else
+        assert all(after[k] == "pod-4" for k in moved)
+        # leave: only the departed node's keys move
+        ring.remove("pod-4")
+        restored = {k: ring.lookup(k) for k in keys}
+        assert restored == before
+
+    def test_candidates_distinct_nearest_first(self):
+        ring = ring_mod.HashRing([f"pod-{i}" for i in range(4)])
+        cands = ring.candidates("some-fingerprint")
+        assert len(cands) == 4
+        assert len(set(cands)) == 4
+        assert cands[0] == ring.lookup("some-fingerprint")
+
+    def test_replace_keeps_survivors(self):
+        ring = ring_mod.HashRing(["a", "b", "c"])
+        keys = [f"k{i}" for i in range(500)]
+        before = {k: ring.lookup(k) for k in keys}
+        ring.replace(["a", "b", "d"])  # c leaves, d joins
+        after = {k: ring.lookup(k) for k in keys}
+        for k in keys:
+            if before[k] in ("a", "b") and after[k] != before[k]:
+                # a survivor's key may only move to the newcomer
+                assert after[k] == "d"
+
+    def test_state_shares_sum_to_one(self):
+        ring = ring_mod.HashRing(["a", "b", "c"])
+        state = ring.state()
+        assert state["points"] == 3 * state["vnodes"]
+        assert abs(sum(state["keyspace_share"].values()) - 1.0) < 0.01
+
+
+# -- affinity fingerprints ----------------------------------------------------
+
+
+class TestFingerprint:
+    def test_block_alignment(self):
+        bs = 8
+        template = list(range(32))  # 4 full blocks
+        # same template, different sub-block tails -> SAME fingerprint
+        fp1 = ring_mod.fingerprint_tokens(template + [250, 251], bs)
+        fp2 = ring_mod.fingerprint_tokens(template + [99], bs)
+        assert fp1 == fp2 is not None
+        # under one full block -> no fingerprint (affinity would be
+        # pure pinning: the tree cannot share a partial block)
+        assert ring_mod.fingerprint_tokens(list(range(7)), bs) is None
+        # a different template differs
+        other = [t + 1 for t in template]
+        assert ring_mod.fingerprint_tokens(other, bs) != fp1
+
+    def test_affinity_blocks_cap(self):
+        bs = 4
+        shared2 = list(range(8))  # 2 shared blocks
+        a = shared2 + [1, 2, 3, 4]
+        b = shared2 + [9, 9, 9, 9]  # diverges in block 3
+        assert ring_mod.fingerprint_tokens(a, bs, affinity_blocks=2) \
+            == ring_mod.fingerprint_tokens(b, bs, affinity_blocks=2)
+        assert ring_mod.fingerprint_tokens(a, bs, affinity_blocks=3) \
+            != ring_mod.fingerprint_tokens(b, bs, affinity_blocks=3)
+
+    def test_request_forms(self):
+        bs = 8
+        fp_tokens = ring_mod.fingerprint_request(
+            {"tokens": list(range(16))}, bs)
+        assert fp_tokens is not None
+        text = "x" * 16
+        fp_text = ring_mod.fingerprint_request({"text": text}, bs)
+        # byte-level tokenizer: the text fingerprint IS the byte-run
+        # fingerprint
+        assert fp_text == ring_mod.fingerprint_tokens(
+            text.encode(), bs)
+        assert ring_mod.fingerprint_request({}, bs) is None
+        assert ring_mod.fingerprint_request({"tokens": ["x"]}, bs) is None
+
+
+# -- end-to-end affinity against real PrefixTrees -----------------------------
+
+
+class TestAffinityEndToEnd:
+    def test_affine_requests_hit_target_pods_tree(self):
+        """Two pods, one shared template: every request carrying the
+        template must land on ONE pod, and that pod's REAL radix
+        PrefixTree (models/kvblocks — the engine's own structure at the
+        engine's block alignment) must register the shared-block hits;
+        the other pod's tree never sees the template."""
+        bs = 8
+        pods = [_StubServePod(f"p{i}", block_size=bs) for i in range(2)]
+        targets = [(p.name, p.url) for p in pods]
+        router = router_mod.Router(lambda: targets, block_size=bs,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        url = f"http://127.0.0.1:{server.port}"
+        template = [(j * 5 + 3) % 256 for j in range(4 * bs)]
+        try:
+            backends = set()
+            for i in range(6):
+                status, headers, _out = _post(
+                    url, {"tokens": template + [200 + i],
+                          "max_new_tokens": 2})
+                assert status == 200
+                backends.add(headers["X-Router-Backend"])
+                assert headers["X-Router-Affine"] == "1"
+            assert len(backends) == 1  # the whole family on one pod
+            owner = next(p for p in pods if p.name in backends)
+            other = next(p for p in pods if p.name not in backends)
+            # 6 requests: the first inserts the template's 4 blocks,
+            # the next 5 ATTACH to them — real tree hits, real reuse
+            assert owner.prefix_hits == 5
+            assert owner.prefix_tokens_saved >= 5 * 4 * bs
+            assert other.requests == 0 and other.tree.nodes == 0
+            assert router.affinity_hits_total == 6
+        finally:
+            server.stop()
+            for p in pods:
+                p.stop()
+
+    def test_fixed_seed_identical_through_router_vs_direct(self):
+        pod = _StubServePod("p0")
+        router = router_mod.Router(lambda: [(pod.name, pod.url)],
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            payload = {"tokens": list(range(20)), "seed": 42,
+                       "max_new_tokens": 8}
+            _s, _h, via_router = _post(
+                f"http://127.0.0.1:{server.port}", payload)
+            _s, _h, direct = _post(pod.url, payload)
+            assert via_router == direct
+            assert via_router["tokens"] == _StubServePod.generate_tokens(
+                payload["tokens"], 42, 8)
+        finally:
+            server.stop()
+            pod.stop()
+
+
+# -- retry walk ---------------------------------------------------------------
+
+
+class _CannedBackend:
+    """A backend answering a fixed (status, body) — 503 shedding, 500s,
+    or 200s — while counting hits."""
+
+    def __init__(self, status: int = 200):
+        backend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                backend.hits += 1
+                body = json.dumps(
+                    {"tokens": [1]} if backend.status == 200
+                    else {"error": f"canned {backend.status}"}).encode()
+                self.send_response(backend.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if backend.status == 503:
+                    self.send_header("Retry-After", "7")
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.status = status
+        self.hits = 0
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         kwargs={"poll_interval": 0.05},
+                         daemon=True).start()
+        self.url = "http://127.0.0.1:%d" % self.httpd.server_address[1]
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestRetryWalk:
+    def test_503_retries_next_candidate_until_success(self):
+        shedding = [_CannedBackend(503), _CannedBackend(503)]
+        healthy = _CannedBackend(200)
+        # names order the zero-inflight tie-break: the shed pair is
+        # visited first, the healthy backend is the LAST candidate
+        targets = [("a-shed-0", shedding[0].url),
+                   ("b-shed-1", shedding[1].url),
+                   ("z-ok", healthy.url)]
+        router = router_mod.Router(lambda: targets, retry_budget=2,
+                                   policy=router_mod.POLICY_LEAST,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            status, headers, out = _post(
+                f"http://127.0.0.1:{server.port}",
+                {"tokens": [1, 2, 3]})
+            assert status == 200 and out == {"tokens": [1]}
+            assert headers["X-Router-Backend"] == "z-ok"
+            # every shed backend was tried at most once on the walk
+            assert shedding[0].hits + shedding[1].hits == 2
+            assert router.retries_total == 2
+        finally:
+            server.stop()
+            for b in shedding + [healthy]:
+                b.stop()
+
+    def test_budget_exhaustion_returns_503_with_retry_after(self):
+        backends = [_CannedBackend(503) for _ in range(4)]
+        targets = [(f"b{i}", b.url) for i, b in enumerate(backends)]
+        router = router_mod.Router(lambda: targets, retry_budget=2,
+                                   policy=router_mod.POLICY_LEAST,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{server.port}", {"tokens": [1]})
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After")
+            # budget 2 = 3 attempts total, each a DISTINCT backend
+            assert sum(b.hits for b in backends) == 3
+            assert router.retries_total == 2
+            counters = router.counters()
+            assert counters["requests_total"].get("shed:false") == 1
+        finally:
+            server.stop()
+            for b in backends:
+                b.stop()
+
+    def test_500_walks_ring_and_counts_toward_eviction(self):
+        """A crashed ENGINE behind a live listener answers 500 on its
+        still-open keep-alive sockets (found driving real LmServers):
+        generate is idempotent, so 5xx must walk to the next candidate
+        — and repeated 5xx evict the backend like transport failures
+        (its /healthz, which the serving pod fails while the engine is
+        dead, gates re-admission)."""
+        sick = _CannedBackend(500)
+        healthy = _CannedBackend(200)
+        targets = [("a-sick", sick.url), ("z-ok", healthy.url)]
+        # DEFAULT fail_threshold: consecutive 500s must accumulate (a
+        # success-reset before the failure count would saturate the
+        # counter at 1 and the sick pod would eat retries forever)
+        router = router_mod.Router(lambda: targets, retry_budget=2,
+                                   policy=router_mod.POLICY_LEAST,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            for _ in range(2):
+                status, headers, _out = _post(
+                    f"http://127.0.0.1:{server.port}", {"tokens": [1, 2]})
+                assert status == 200
+                assert headers["X-Router-Backend"] == "z-ok"
+            assert sick.hits == 2 and router.retries_total == 2
+            state = {b["name"]: b for b in router.backends()}
+            assert state["a-sick"]["healthy"] is False  # evicted at 2
+            # once evicted it leaves the placement order entirely
+            _s, headers, _o = _post(
+                f"http://127.0.0.1:{server.port}", {"tokens": [3]})
+            assert headers["X-Router-Backend"] == "z-ok"
+            assert sick.hits == 2
+        finally:
+            server.stop()
+            sick.stop()
+            healthy.stop()
+
+    def test_transport_failure_evicts_then_probe_readmits(self):
+        pod = _StubServePod("p0")
+        dead = _CannedBackend(200)
+        dead_url = dead.url
+        dead.stop()  # nothing listening: pure transport failure
+        targets = [("dead", dead_url), ("live", pod.url)]
+        router = router_mod.Router(lambda: targets, fail_threshold=1,
+                                   policy=router_mod.POLICY_LEAST,
+                                   refresh_interval_s=0,
+                                   probe_timeout_s=0.2)
+        router.start()
+        try:
+            # force one forward to the dead backend
+            status, _h, _b, err = router._forward("dead", b"{}", {})
+            assert err is not None and status == 0
+            router._note_transport_failure("dead", err)
+            state = {b["name"]: b for b in router.backends()}
+            assert state["dead"]["healthy"] is False
+            assert "dead" not in router._ring.nodes
+            # a later refresh probes /healthz; the dead one stays out
+            router.refresh_once()
+            state = {b["name"]: b for b in router.backends()}
+            assert state["dead"]["healthy"] is False
+            assert state["live"]["healthy"] is True
+        finally:
+            router.stop()
+            pod.stop()
+
+
+# -- drain --------------------------------------------------------------------
+
+
+class TestDrain:
+    def test_drain_completes_inflight_refuses_new(self):
+        pod = _StubServePod("p0", per_token_s=0.02)  # ~0.6s service
+        router = router_mod.Router(lambda: [(pod.name, pod.url)],
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        url = f"http://127.0.0.1:{server.port}"
+        result: dict = {}
+
+        def slow_request():
+            result["resp"] = _post(url, {"tokens": list(range(16)),
+                                         "max_new_tokens": 30})
+
+        t = threading.Thread(target=slow_request, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while router.backend_inflight("p0") == 0:
+            assert time.monotonic() < deadline, "request never started"
+            time.sleep(0.01)
+        router.drain()
+        # new requests are refused while draining...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, {"tokens": [1, 2]})
+        assert ei.value.code == 503
+        # ...the in-flight one completes, and the drain observes idle
+        assert router.wait_idle(10.0)
+        t.join(timeout=10)
+        status, _h, out = result["resp"]
+        assert status == 200 and len(out["tokens"]) == 30
+        server.stop()
+        pod.stop()
+
+    def test_annotated_drain_adopted_from_discovery(self):
+        """The cross-process drain protocol: a target carrying the
+        router-drain annotation flag (fleet discovery sets it from the
+        pod the operator annotated) drains the backend on the next
+        refresh — and un-drains when the flag flips — while targets
+        WITHOUT the annotation leave locally-set drain state alone."""
+        from k8s_tpu.fleet.discovery import ScrapeTarget
+
+        flags = {"p0": None, "p1": None}
+
+        def targets():
+            return [ScrapeTarget("ns/j", "ns", "j", name, "0",
+                                 f"http://127.0.0.1:{i + 1}/metrics",
+                                 draining=flags[name])
+                    for i, name in enumerate(sorted(flags))]
+
+        router = router_mod.Router(targets, refresh_interval_s=0)
+        router.refresh_once()
+        assert {b["name"] for b in router.backends()
+                if not b["draining"]} == {"p0", "p1"}
+        flags["p1"] = True
+        router.refresh_once()
+        state = {b["name"]: b for b in router.backends()}
+        assert state["p1"]["draining"] and "p1" not in router._ring.nodes
+        flags["p1"] = False
+        router.refresh_once()
+        assert "p1" in router._ring.nodes
+        # None (no annotation) must not clobber a local drain
+        router.set_draining("p0", True)
+        flags["p0"] = None
+        router.refresh_once()
+        assert {b["name"]: b["draining"]
+                for b in router.backends()}["p0"] is True
+
+    def test_shed_backend_deprioritized_in_fallback(self):
+        """A backend that just 503'd rejects FAST, so its in-flight
+        count is low — the least-outstanding order must rank it behind
+        available pods or the fallback bounces straight back onto the
+        shedding pod."""
+        router = router_mod.Router(
+            lambda: [("a-shed", "http://127.0.0.1:1"),
+                     ("b-ok", "http://127.0.0.1:2")],
+            policy=router_mod.POLICY_LEAST, refresh_interval_s=0)
+        router.refresh_once()
+        router._note_success("a-shed", 503)  # marks shedding
+        order, _affine, _fp = router.plan({"tokens": [1]})
+        assert order[0] == "b-ok"
+        # placements ?n=0 bound really means zero (not "all")
+        assert router.placements(0) == []
+
+    def test_backend_drain_excludes_from_placement(self):
+        pods = [_StubServePod(f"p{i}") for i in range(2)]
+        targets = [(p.name, p.url) for p in pods]
+        router = router_mod.Router(lambda: targets,
+                                   policy=router_mod.POLICY_LEAST,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        url = f"http://127.0.0.1:{server.port}"
+        try:
+            assert router.set_draining("p1", True)
+            for _ in range(4):
+                _s, headers, _o = _post(url, {"tokens": [1, 2, 3]})
+                assert headers["X-Router-Backend"] == "p0"
+            assert "p1" not in router._ring.nodes
+            router.set_draining("p1", False)
+            assert "p1" in router._ring.nodes
+        finally:
+            server.stop()
+            for p in pods:
+                p.stop()
+
+
+# -- autoscaler ---------------------------------------------------------------
+
+
+class TestAutoscaler:
+    def _autoscaler(self, plane, **kw):
+        kw.setdefault("up_queue_depth", 4.0)
+        kw.setdefault("down_queue_depth", 0.5)
+        kw.setdefault("hold_evals", 2)
+        kw.setdefault("cooldown_s", 30.0)
+        return router_mod.Autoscaler(lambda: plane, **kw)
+
+    def test_hysteresis_needs_sustained_signal(self):
+        plane = _FakeAutoscalePlane()
+        a = self._autoscaler(plane)
+        plane.queue_mean = 10.0
+        d1 = a.evaluate("j", 2, 1, 4, now=0.0)
+        assert d1.direction == "hold"  # one hot sample is flicker
+        d2 = a.evaluate("j", 2, 1, 4, now=1.0)
+        assert d2.direction == "up" and d2.target == 3
+        # an interleaved calm sample resets the streak
+        plane.queue_mean = 2.0
+        a.evaluate("j", 2, 1, 4, now=2.0)
+        plane.queue_mean = 10.0
+        d3 = a.evaluate("j", 2, 1, 4, now=3.0)
+        assert d3.direction == "hold"
+
+    def test_cooldown_freezes_after_apply(self):
+        plane = _FakeAutoscalePlane()
+        a = self._autoscaler(plane)
+        plane.queue_mean = 10.0
+        a.evaluate("j", 2, 1, 4, now=0.0)
+        d = a.evaluate("j", 2, 1, 4, now=1.0)
+        assert d.direction == "up"
+        a.note_applied("j", now=1.0)
+        for t in (2.0, 10.0, 30.0):
+            assert a.evaluate("j", 3, 1, 4, now=t).reason == "cooldown"
+        # past the cooldown the (still-hot) signal acts again
+        a.evaluate("j", 3, 1, 4, now=32.0)
+        assert a.evaluate("j", 3, 1, 4, now=33.0).direction == "up"
+
+    def test_min_max_clamping(self):
+        plane = _FakeAutoscalePlane()
+        a = self._autoscaler(plane)
+        plane.queue_mean = 10.0
+        a.evaluate("j", 4, 1, 4, now=0.0)
+        d = a.evaluate("j", 4, 1, 4, now=1.0)
+        assert d.direction == "hold" and d.reason == "at-max-replicas"
+        b = self._autoscaler(plane)
+        plane.queue_mean = 0.0
+        b.evaluate("j", 1, 1, 4, now=0.0)
+        d = b.evaluate("j", 1, 1, 4, now=1.0)
+        assert d.direction == "hold" and d.reason == "at-min-replicas"
+
+    def test_slo_burn_triggers_up(self):
+        plane = _FakeAutoscalePlane()
+        plane.queue_mean = 0.0  # queue calm; burn alone must scale
+
+        class _BurningSlo:
+            def breached(self, job):
+                return True
+
+        plane.slo = _BurningSlo()
+        a = self._autoscaler(plane)
+        a.evaluate("j", 1, 1, 4, now=0.0)
+        d = a.evaluate("j", 1, 1, 4, now=1.0)
+        assert d.direction == "up" and d.reason == "slo-burn"
+
+    def test_empty_hysteresis_band_rejected(self):
+        with pytest.raises(ValueError):
+            router_mod.Autoscaler(lambda: None, up_queue_depth=1.0,
+                                  down_queue_depth=2.0)
+
+    def test_parked_target_retries_without_hold(self):
+        plane = _FakeAutoscalePlane()
+        a = self._autoscaler(plane)
+        plane.queue_mean = 10.0
+        a.evaluate("j", 2, 1, 4, now=0.0)
+        assert a.evaluate("j", 2, 1, 4, now=1.0).direction == "up"
+        a.note_parked("j", 3)
+        # parked asks retry every tick while pressure persists...
+        d = a.evaluate("j", 2, 1, 4, now=2.0)
+        assert d.direction == "up" and d.parked and d.target == 3
+        # ...and are withdrawn when the pressure subsides
+        plane.queue_mean = 2.0
+        a.evaluate("j", 2, 1, 4, now=3.0)
+        assert a.parked_target("j") is None
+
+    def test_env_knobs_steer_thresholds(self, monkeypatch):
+        """Every documented K8S_TPU_AUTOSCALE_* knob must actually
+        reach the Autoscaler (a knob table entry that silently does
+        nothing is worse than no knob)."""
+        from k8s_tpu.router.autoscale import autoscaler_kwargs_from_env
+
+        for k in ("K8S_TPU_AUTOSCALE_UP_QUEUE",
+                  "K8S_TPU_AUTOSCALE_DOWN_QUEUE",
+                  "K8S_TPU_AUTOSCALE_COOLDOWN_S",
+                  "K8S_TPU_AUTOSCALE_HOLD"):
+            monkeypatch.delenv(k, raising=False)
+        assert autoscaler_kwargs_from_env() == {
+            "up_queue_depth": 4.0, "down_queue_depth": 0.5,
+            "cooldown_s": 30.0, "hold_evals": 2}
+        monkeypatch.setenv("K8S_TPU_AUTOSCALE_UP_QUEUE", "10")
+        monkeypatch.setenv("K8S_TPU_AUTOSCALE_DOWN_QUEUE", "1.5")
+        monkeypatch.setenv("K8S_TPU_AUTOSCALE_COOLDOWN_S", "60")
+        monkeypatch.setenv("K8S_TPU_AUTOSCALE_HOLD", "3")
+        kw = autoscaler_kwargs_from_env()
+        a = router_mod.Autoscaler(lambda: None, **kw)
+        assert (a.up_queue_depth, a.down_queue_depth,
+                a.cooldown_s, a.hold_evals) == (10.0, 1.5, 60.0, 3)
+        monkeypatch.setenv("K8S_TPU_AUTOSCALE_HOLD", "garbage")
+        assert autoscaler_kwargs_from_env()["hold_evals"] == 2
+
+    def test_data_gap_does_not_withdraw_parked_target(self):
+        """One scrape gap (queue_mean None) must not drop a parked
+        scale-up: only an OBSERVED calm reading withdraws the ask."""
+        plane = _FakeAutoscalePlane()
+        a = self._autoscaler(plane)
+        plane.queue_mean = 10.0
+        a.evaluate("j", 2, 1, 4, now=0.0)
+        a.evaluate("j", 2, 1, 4, now=1.0)
+        a.note_parked("j", 3)
+
+        class _GapAgg:
+            def gauge_stats(self, job, family, labels=()):
+                return None  # the plane has nothing this tick
+
+        plane.aggregator = _GapAgg()
+        a.evaluate("j", 2, 1, 4, now=2.0)
+        assert a.parked_target("j") == 3  # survived the gap
+
+    def test_parked_event_fires_once_per_target(self):
+        """The parked retry runs every tick; the ScaleUpQueued event
+        must not (a Warning every 5s per parked job is an Event
+        storm)."""
+        plane = _FakeAutoscalePlane()
+        plane.queue_mean = 10.0
+        a = self._autoscaler(plane)
+        events = []
+        loop = router_mod.AutoscaleLoop(
+            a, lambda: [("j", 2, 1, 4)], lambda j, t: True,
+            reserve_fn=lambda j, t: False,
+            event_fn=lambda j, k, m: events.append(k))
+        for t in range(6):
+            loop.tick_once(now=float(t))
+        assert events.count("ScaleUpQueued") == 1
+
+    def test_scale_up_parked_not_partial_under_full_ledger(self):
+        """The gang-atomicity contract end-to-end against a REAL
+        GangScheduler: full ledger -> parked (zero applies, reservation
+        untouched); freed chips -> atomic admit; scale-down drains
+        BEFORE the apply that frees chips.  The shared bench phase
+        raises on any violation."""
+        phase = _router_autoscale_ledger_phase()
+        assert phase["parked_then_admitted"] is True
+        assert phase["order"][0] == "apply:3"
+        assert phase["order"][1:3] == ["drain:1", "apply:2"]
+
+
+# -- controller scale-down reconcile ------------------------------------------
+
+
+class TestControllerScaleDown:
+    def test_out_of_range_pods_deleted_on_sync(self):
+        """An autoscale patch shrank replicas: the next sync deletes the
+        out-of-range pods (and services) in one wave — without this the
+        gang never actually shrinks and freed chips are fiction."""
+        from tests.test_controller_v2 import (
+            KEY,
+            build_controller,
+            make_pod,
+            make_service,
+            make_tfjob,
+        )
+
+        tfjob = make_tfjob(worker=1)
+        pods = [make_pod("worker", i, "Running") for i in range(3)]
+        services = [make_service("worker", i) for i in range(3)]
+        tc, pod_control, service_control, _cap = build_controller(
+            tfjob, pods, services)
+        tc.sync_tfjob(KEY)
+        assert len(pod_control.delete_pod_names) == 2
+        deleted = set(pod_control.delete_pod_names)
+        assert all(("-worker-1-" in n) or ("-worker-2-" in n)
+                   for n in deleted)
+        assert len(service_control.delete_service_names) == 2
+
+
+class TestParkedScaleUpClamp:
+    def test_parked_scale_up_keeps_reconciling_at_reserved_size(self):
+        """A reserved gang whose spec demand grew past capacity parks
+        the EXPANSION but keeps being serviced: reconcile runs at the
+        reservation-covered replica count (a dead pod is recreated, but
+        only ONE pod for one reservation — never the unfunded second),
+        and the status write restores the spec'd count so the patch is
+        not silently reverted."""
+        from k8s_tpu import scheduler as scheduler_mod
+        from k8s_tpu.controller_v2.pod import gen_expectation_pods_key
+        from k8s_tpu.controller_v2.service import (
+            gen_expectation_services_key,
+        )
+        from tests.test_controller_v2 import (
+            JOB_NAME,
+            KEY,
+            NS,
+            build_controller,
+            make_tfjob,
+        )
+
+        tfjob = make_tfjob(tpu=1)
+        tfjob.spec.autoscale = None  # manual-edit backstop path
+        tc, pod_control, _svc, captured = build_controller(tfjob, [], [])
+        sched = scheduler_mod.GangScheduler(total_chips=4)
+        tc.scheduler = sched
+        tc._capacity_pinned = True
+        assert tc.sync_tfjob(KEY) is True
+        assert len(pod_control.templates) == 1  # the funded gang
+        assert sched.reserved_chips(KEY) == 4
+        # autoscale/manual patch: replicas 2 -> demand 8 > total 4
+        stored = tc.clientset.tfjobs_unstructured(NS).patch(
+            JOB_NAME,
+            {"spec": {"tfReplicaSpecs": {"TPU": {"replicas": 2}}}})
+        tc.tfjob_informer.store.replace([stored])
+        tc.expectations.delete_expectations(
+            gen_expectation_pods_key(KEY, "tpu"))
+        tc.expectations.delete_expectations(
+            gen_expectation_services_key(KEY, "tpu"))
+        pod_control.templates.clear()
+        tc.sync_tfjob(KEY)
+        # the expansion parked: reservation untouched, Queued condition
+        assert sched.reserved_chips(KEY) == 4
+        conds = {c.type: c for c in captured[-1].status.conditions}
+        assert conds["Queued"].reason == "ScaleUpQueued"
+        # ...but the gang is still SERVICED at its reserved size: the
+        # (informer-lost) pod was recreated — exactly one, never two
+        assert len(pod_control.templates) == 1
+        # and the spec'd count survives the status write un-reverted
+        assert captured[-1].spec.tf_replica_specs["TPU"].replicas == 2
+        # reverting the edit withdraws the park: the ScaleUpQueued
+        # condition flips False instead of outliving the drift.  The
+        # captured update_status_handler never persisted sync 2's
+        # status, so seed the parked condition as the apiserver would
+        # hold it.
+        stored = tc.clientset.tfjobs_unstructured(NS).patch(
+            JOB_NAME,
+            {"spec": {"tfReplicaSpecs": {"TPU": {"replicas": 1}}},
+             "status": captured[-1].status.to_dict()})
+        tc.tfjob_informer.store.replace([stored])
+        tc.expectations.delete_expectations(
+            gen_expectation_pods_key(KEY, "tpu"))
+        tc.expectations.delete_expectations(
+            gen_expectation_services_key(KEY, "tpu"))
+        tc.sync_tfjob(KEY)
+        conds = {c.type: c for c in captured[-1].status.conditions}
+        assert conds["Queued"].status == "False"
+        assert conds["Queued"].reason == "Admitted"
+
+
+# -- per-pod fleet rollup (least-outstanding tie-break) -----------------------
+
+
+class TestFleetDepthTieBreak:
+    def test_least_outstanding_uses_fleet_depths(self):
+        """With zero in-flight everywhere, the fallback tie-breaks on
+        the fleet plane's per-pod serve_queue_depth rollup."""
+        import k8s_tpu.fleet as fleet_mod
+        from k8s_tpu.fleet.aggregate import FleetAggregator
+
+        class _PlaneStub:
+            def __init__(self):
+                self.aggregator = FleetAggregator()
+
+        plane = _PlaneStub()
+        from k8s_tpu.fleet.parser import parse_exposition
+
+        for pod, depth in (("p0", 7.0), ("p1", 1.0)):
+            fams = parse_exposition(
+                "# TYPE serve_queue_depth gauge\n"
+                f"serve_queue_depth {depth}\n")
+            plane.aggregator.ingest("ns/j", pod, fams, now=time.time())
+        prev = fleet_mod.active()
+        fleet_mod.set_active(plane)
+        try:
+            router = router_mod.Router(
+                lambda: [("p0", "http://127.0.0.1:1"),
+                         ("p1", "http://127.0.0.1:2")],
+                job="ns/j", policy=router_mod.POLICY_LEAST,
+                refresh_interval_s=0)
+            router.refresh_once()
+            order, affine, _fp = router.plan({"tokens": [1]})
+            assert order[0] == "p1" and affine is False
+        finally:
+            fleet_mod.set_active(prev)
+
+
+# -- /debug/router parity -----------------------------------------------------
+
+
+class TestDebugRouterParity:
+    def test_404_when_inactive_then_serves_on_both_servers(self):
+        from k8s_tpu.client.clientset import Clientset
+        from k8s_tpu.client.fake import FakeCluster
+        from k8s_tpu.dashboard.backend import DashboardServer
+        from k8s_tpu.util.metrics_server import MetricsServer
+
+        prev = router_mod.active()
+        router_mod.set_active(None)
+        srv = MetricsServer(0).start()
+        dash = DashboardServer(Clientset(FakeCluster()),
+                               host="127.0.0.1", port=0)
+        dash.start_background()
+        try:
+            bases = (f"http://127.0.0.1:{srv.port}",
+                     f"http://127.0.0.1:{dash.port}")
+            for base in bases:
+                code, body = _get(base + "/debug/router")
+                assert code == 404
+                assert "router inactive" in body
+            pod = _StubServePod("p0")
+            router = router_mod.Router(lambda: [(pod.name, pod.url)],
+                                       refresh_interval_s=0).start()
+            router_mod.set_active(router)
+            try:
+                for base in bases:
+                    code, body = _get(base + "/debug/router")
+                    assert code == 200
+                    state = json.loads(body)
+                    assert state["ring"]["nodes"] == ["p0"]
+                    assert state["backends"][0]["healthy"] is True
+                # the /debug index row flips active on both servers
+                for base in bases:
+                    code, body = _get(base + "/debug/")
+                    entries = {e["path"]: e
+                               for e in json.loads(body)["endpoints"]}
+                    assert entries["/debug/router"]["active"] is True
+            finally:
+                router.stop()
+                pod.stop()
+            router_mod.set_active(None)
+            for base in bases:
+                code, _body = _get(base + "/debug/router")
+                assert code == 404
+        finally:
+            srv.stop()
+            dash.shutdown()
+            router_mod.set_active(prev)
+
+    def test_router_own_listener_serves_debug_and_metrics(self):
+        pod = _StubServePod("p0")
+        router = router_mod.Router(lambda: [(pod.name, pod.url)],
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        url = f"http://127.0.0.1:{server.port}"
+        try:
+            _post(url, {"tokens": list(range(16)), "max_new_tokens": 2})
+            code, body = _get(url + "/debug/router")
+            assert code == 200
+            state = json.loads(body)
+            assert state["counters"]["requests_total"]
+            assert state["placements"]
+            code, text = _get(url + "/metrics")
+            assert code == 200
+            assert "router_requests_total{" in text
+            assert "router_affinity_hits_total" in text
+            assert "router_retries_total" in text
+            assert 'router_backend_inflight{backend="p0"}' in text
+            code, body = _get(url + "/healthz")
+            assert code == 200
+        finally:
+            server.stop()
+            pod.stop()
+
+
+# -- traceparent propagation --------------------------------------------------
+
+
+class TestTraceparent:
+    def test_traceparent_forwarded_verbatim(self):
+        seen = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                seen["traceparent"] = self.headers.get("traceparent")
+                body = json.dumps({"tokens": [1]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever,
+                         kwargs={"poll_interval": 0.05},
+                         daemon=True).start()
+        backend_url = "http://127.0.0.1:%d" % httpd.server_address[1]
+        router = router_mod.Router(lambda: [("b", backend_url)],
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=json.dumps({"tokens": [1]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": tp}, method="POST")
+            urllib.request.urlopen(req, timeout=10).read()
+            assert seen["traceparent"] == tp
+        finally:
+            server.stop()
+            httpd.shutdown()
+            httpd.server_close()

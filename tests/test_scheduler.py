@@ -219,6 +219,32 @@ class TestCapacityLedger:
         assert s.queue.get("ns/a") is None
         assert s.preempted_by("ns/a") is None
 
+    def test_resize_gang_atomic(self):
+        """ISSUE 13: an autoscale replica patch resizes the reservation
+        atomically — shrink always frees the delta, a grow fits whole or
+        changes NOTHING (the never-partially-placed contract), and an
+        unreserved key is refused (first admission stays with
+        sync_admit's queue order)."""
+        s = GangScheduler(total_chips=12, aging_interval_s=1000)
+        assert s.sync_admit("ns/a", 8, 0, now=0.0).admitted
+        assert s.reserved_chips("ns/a") == 8
+        # grow past capacity: denied, hold unchanged
+        d = s.resize("ns/a", 16)
+        assert not d.admitted and d.reason == "insufficient-capacity"
+        assert s.reserved_chips("ns/a") == 8
+        # grow inside capacity: the whole delta lands
+        assert s.resize("ns/a", 12).admitted
+        assert s.capacity.available() == 0
+        # shrink frees the delta
+        d = s.resize("ns/a", 4)
+        assert d.admitted and d.reason == "shrunk"
+        assert s.capacity.available() == 8
+        # no-op and guard rails
+        assert s.resize("ns/a", 4).reason == "unchanged"
+        assert not s.resize("ns/never", 4).admitted
+        assert not s.resize("ns/a", 0).admitted
+        assert s.reserved_chips("ns/never") is None
+
     def test_adoption_reality_wins_after_restart(self):
         # controller restart: a gang whose pods already run re-reserves
         # unconditionally, even past nominal capacity
