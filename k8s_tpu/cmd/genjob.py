@@ -27,6 +27,11 @@ V5E_MAX_HOSTS = 64  # v5litepod-256 (16x16) is the largest v5e slice
 # target all derive from this one constant — /metrics lives on the same
 # server, so advertising any other scrape port means a sidecar exporter.
 SERVE_HTTP_PORT = 8000
+# The multi-host serving gang's plan-bus port (ISSUE 14): a FIXED port,
+# stamped as K8S_TPU_SERVE_PLAN_PORT on every gang pod — workers dial
+# the chief pod's hostname on it, so an ephemeral (0) port would be
+# undiscoverable across pods and the gang could never rendezvous.
+SERVE_PLAN_PORT = 8471
 
 
 def v5e_slice_for_hosts(num_hosts: int) -> tuple[str, str]:
@@ -72,6 +77,8 @@ def serve_tfjob_template(
     fleet_interval_s: float | None = None,
     autoscale_min: int | None = None,
     autoscale_max: int | None = None,
+    serve_mesh: int | None = None,
+    serve_weight: float | None = None,
 ) -> dict:
     """A resident serving TFJob (the examples/tf_job_serve_http.yaml
     shape) with the engine knobs surfaced as env: decode slots and
@@ -107,7 +114,17 @@ def serve_tfjob_template(
     ISSUE 13: ``autoscale_min``/``autoscale_max`` (both or neither)
     stamp the ``spec.autoscale`` bounds the operator's metric-driven
     gang autoscaler scales inside (``K8S_TPU_AUTOSCALE`` gates the loop
-    itself); the Worker replica count starts at ``autoscale_min``."""
+    itself); the Worker replica count starts at ``autoscale_min``.
+
+    ISSUE 14: ``serve_mesh=N`` makes the job a **multi-host
+    tensor-parallel serving gang**: N Worker replicas all running the
+    same server binary (``K8S_TPU_SERVE_MESH=N``), rendezvousing
+    through the operator's ordinary gang env contract — replica 0
+    serves HTTP as the chief, the rest replay its batch plan
+    (docs/serving.md "Multi-host serving").  ``serve_weight`` stamps
+    the ``kubeflow.org/fleet-serve-weight`` annotation so the router's
+    weighted hash ring gives the pod keyspace proportional to its
+    capacity (a tp=4 gang next to single-chip pods declares 4.0)."""
     env = [
         {"name": "K8S_TPU_SERVE_SLOTS", "value": str(serve_slots)},
         {"name": "K8S_TPU_SERVE_QUEUE", "value": str(serve_queue)},
@@ -124,17 +141,37 @@ def serve_tfjob_template(
     if serve_request_log_ring is not None:
         env.append({"name": "K8S_TPU_REQUEST_LOG_RING",
                     "value": str(serve_request_log_ring)})
+    if serve_mesh is not None:
+        if serve_mesh < 1:
+            raise ValueError(f"serve_mesh must be >= 1, got {serve_mesh}")
+        if autoscale_min is not None:
+            raise ValueError(
+                "serve_mesh and autoscale are mutually exclusive: a "
+                "tensor-parallel gang's replica count IS its mesh shape "
+                "(scale serving capacity by adding jobs behind the "
+                "router, not replicas to the gang)")
+        env.append({"name": "K8S_TPU_SERVE_MESH",
+                    "value": str(serve_mesh)})
+        env.append({"name": "K8S_TPU_SERVE_PLAN_PORT",
+                    "value": str(SERVE_PLAN_PORT)})
     if fleet_scrape_port is not None:
         env.append({"name": "K8S_TPU_FLEET_SCRAPE_PORT",
                     "value": str(fleet_scrape_port)})
         if fleet_interval_s is not None:
             env.append({"name": "K8S_TPU_FLEET_INTERVAL_S",
                         "value": str(fleet_interval_s)})
-    template_meta = {}
+    template_meta: dict = {}
+    annotations: dict = {}
     if fleet_scrape_port is not None:
-        template_meta["annotations"] = {
-            "kubeflow.org/fleet-scrape-port": str(fleet_scrape_port),
-        }
+        annotations["kubeflow.org/fleet-scrape-port"] = \
+            str(fleet_scrape_port)
+    if serve_weight is not None:
+        if serve_weight <= 0:
+            raise ValueError(
+                f"serve_weight must be > 0, got {serve_weight}")
+        annotations["kubeflow.org/fleet-serve-weight"] = str(serve_weight)
+    if annotations:
+        template_meta["annotations"] = annotations
     if (autoscale_min is None) != (autoscale_max is None):
         raise ValueError("give both autoscale_min and autoscale_max "
                          "(or neither)")
@@ -145,8 +182,9 @@ def serve_tfjob_template(
         "spec": {
             "tfReplicaSpecs": {
                 "Worker": {
-                    "replicas": (autoscale_min if autoscale_min is not None
-                                 else 1),
+                    "replicas": (serve_mesh if serve_mesh is not None
+                                 else autoscale_min
+                                 if autoscale_min is not None else 1),
                     "restartPolicy": "OnFailure",
                     "template": {
                         **({"metadata": template_meta}
@@ -402,6 +440,8 @@ def generate(
     router_retry_budget: int | None = None,
     autoscale_min: int | None = None,
     autoscale_max: int | None = None,
+    serve_mesh: int | None = None,
+    serve_weight: float | None = None,
 ) -> list[dict]:
     """N uniquely-named jobs, ``tfjob-<ts>-<i>`` (genjob.go:111-114).
     ``router=True`` (requires ``serve``) additionally emits each job's
@@ -417,6 +457,12 @@ def generate(
         raise ValueError("--autoscale-min/--autoscale-max require "
                          "--serve (only serving jobs carry "
                          "spec.autoscale)")
+    if (serve_mesh is not None or serve_weight is not None) and not serve:
+        # same silent-drop hazard: a training job carries neither the
+        # gang env nor the weight annotation
+        raise ValueError("--serve-mesh/--serve-weight require --serve "
+                         "(only serving jobs form tensor-parallel gangs "
+                         "or join the router's weighted ring)")
     if serve:
         out: list[dict] = []
         for i in range(n):
@@ -434,7 +480,9 @@ def generate(
                 fleet_scrape_port=fleet_scrape_port,
                 fleet_interval_s=fleet_interval_s,
                 autoscale_min=autoscale_min,
-                autoscale_max=autoscale_max))
+                autoscale_max=autoscale_max,
+                serve_mesh=serve_mesh,
+                serve_weight=serve_weight))
             if router:
                 out.append(router_companion_template(
                     name, namespace, router_port=router_port,
@@ -494,6 +542,15 @@ def main(argv=None) -> int:
                         help="K8S_TPU_REQUEST_LOG_RING for --serve jobs "
                         "(finished-timeline ring bound; omit for the "
                         "512 default)")
+    parser.add_argument("--serve-mesh", type=int, default=None,
+                        help="multi-host tensor-parallel serving gang "
+                        "size: N Worker replicas, replica 0 the HTTP "
+                        "chief, the rest plan-replaying workers "
+                        "(K8S_TPU_SERVE_MESH; ISSUE 14)")
+    parser.add_argument("--serve-weight", type=float, default=None,
+                        help="kubeflow.org/fleet-serve-weight annotation: "
+                        "relative capacity for the router's weighted "
+                        "hash ring (e.g. 4.0 for a 4-chip gang)")
     parser.add_argument("--fleet-scrape-port", type=int,
                         default=SERVE_HTTP_PORT,
                         help="kubeflow.org/fleet-scrape-port annotation + "
@@ -571,6 +628,8 @@ def main(argv=None) -> int:
         router_retry_budget=args.router_retry_budget,
         autoscale_min=args.autoscale_min,
         autoscale_max=args.autoscale_max,
+        serve_mesh=args.serve_mesh,
+        serve_weight=args.serve_weight,
     )
     if args.dump:
         yaml.safe_dump_all(jobs, sys.stdout)

@@ -24,6 +24,11 @@ ANNOTATION_SCRAPE_PORT = "kubeflow.org/fleet-scrape-port"
 ANNOTATION_SCRAPE_PATH = "kubeflow.org/fleet-scrape-path"
 ANNOTATION_SCRAPE_HOST = "kubeflow.org/fleet-scrape-host"
 ANNOTATION_SCRAPE = "kubeflow.org/fleet-scrape"  # "false" opts a pod out
+# relative serving capacity (ISSUE 14): the router's weighted hash ring
+# plants keyspace points proportional to this — a 4-chip tensor-parallel
+# serving pod next to 1-chip pods declares 4.0 and receives ~4x the
+# affine placements.  Absent/garbage = 1.0; must be > 0.
+ANNOTATION_SERVE_WEIGHT = "kubeflow.org/fleet-serve-weight"
 # Router drain protocol (ISSUE 13): the operator's autoscaler annotates
 # a scale-down victim POD (not the template) truthy before patching the
 # replica count; any router whose discovery feeds from the pod cache
@@ -48,10 +53,11 @@ class ScrapeTarget:
     annotation; the router leaves its local drain state alone)."""
 
     __slots__ = ("job", "namespace", "job_name", "pod", "index", "url",
-                 "draining")
+                 "draining", "weight")
 
     def __init__(self, job: str, namespace: str, job_name: str, pod: str,
-                 index: str, url: str, draining=None):
+                 index: str, url: str, draining=None,
+                 weight: float = 1.0):
         self.job = job
         self.namespace = namespace
         self.job_name = job_name
@@ -59,6 +65,7 @@ class ScrapeTarget:
         self.index = index
         self.url = url
         self.draining = draining
+        self.weight = weight
 
     def key(self) -> str:
         return f"{self.job}:{self.pod}"
@@ -151,6 +158,12 @@ def targets_from_pods(pods: list[dict]) -> list[ScrapeTarget]:
         drain_raw = annotations.get(ANNOTATION_ROUTER_DRAIN)
         draining = (None if drain_raw is None
                     else drain_raw.lower() in ("1", "true", "yes", "on"))
+        try:
+            weight = float(annotations.get(ANNOTATION_SERVE_WEIGHT, 1.0))
+        except (TypeError, ValueError):
+            weight = 1.0  # garbage annotation: default share, not a crash
+        if weight <= 0:
+            weight = 1.0
         targets.append(ScrapeTarget(
             job=f"{ns}/{job_name}" if ns else job_name,
             namespace=ns,
@@ -159,5 +172,6 @@ def targets_from_pods(pods: list[dict]) -> list[ScrapeTarget]:
             index=(meta.get("labels") or {}).get(_LABEL_REPLICA_INDEX, ""),
             url=f"http://{host}:{port}{path}",
             draining=draining,
+            weight=weight,
         ))
     return targets

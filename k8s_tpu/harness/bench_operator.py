@@ -1461,6 +1461,32 @@ def run_serve(args) -> dict:
     return result
 
 
+def run_serve_mp(args) -> dict:
+    """The --serve-mp scenario wrapper (ISSUE 14): the multi-host
+    tensor-parallel serving bench (harness/bench_serve_mp.py — a REAL
+    1-process vs N-process serving gang over jax.distributed + the
+    plan bus, token-identity + mesh-overhead + per-process compile
+    budget assertions EMBEDDED), on the one-JSON-line contract.  The
+    MULTIPROC artifact trajectory's serving rung; bench_serve_mp.json
+    is written on assertion failure too, ``failures`` included."""
+    from k8s_tpu.harness import bench_serve_mp
+
+    try:
+        result = bench_serve_mp.run_bench(
+            processes=args.serve_mp_processes,
+            requests=args.serve_mp_requests,
+            slots=args.serve_mp_slots,
+            threads=args.serve_mp_threads,
+            timeout=args.timeout * 10 if args.timeout else 420.0)
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write_artifact(args.serve_mp_out, partial)
+        raise
+    _write_artifact(args.serve_mp_out, result)
+    return result
+
+
 class _StubServePod:
     """One fake serving pod behind its own loopback listener: a
     deterministic /v1/generate (tokens are a pure function of prompt +
@@ -2361,6 +2387,24 @@ def main(argv=None) -> int:
                    "dominant-phase counts, engine step-ledger rollups, "
                    "slowest timelines) as a requests_audit.json "
                    "artifact — written on failed runs too (ISSUE 12)")
+    p.add_argument("--serve-mp", action="store_true",
+                   help="multi-host tensor-parallel serving gang bench "
+                   "(harness/bench_serve_mp.py: 1-process vs N-process "
+                   "CPU mesh, token-identity + mesh-overhead + "
+                   "per-process compile-budget assertions embedded; "
+                   "ISSUE 14)")
+    p.add_argument("--serve-mp-processes", type=int, default=4,
+                   help="mesh size for --serve-mp")
+    p.add_argument("--serve-mp-requests", type=int, default=24,
+                   help="requests in the --serve-mp timed script")
+    p.add_argument("--serve-mp-slots", type=int, default=8,
+                   help="decode slots for --serve-mp")
+    p.add_argument("--serve-mp-threads", type=int, default=10,
+                   help="closed-loop submitters for --serve-mp")
+    p.add_argument("--serve-mp-out", default=None,
+                   help="also write the --serve-mp JSON artifact to "
+                   "this path (written on failure too, failures field "
+                   "included)")
     p.add_argument("--serve-out", default=None,
                    help="also write the --serve JSON result to this path "
                    "(bench artifact)")
@@ -2514,7 +2558,8 @@ def _run(args, p) -> int:
         trace.configure(sample_rate=1.0)
 
     if args.slice_scale or args.measure_restart or args.contention \
-            or args.serve or args.churn or args.fleet or args.router:
+            or args.serve or args.serve_mp or args.churn or args.fleet \
+            or args.router:
         if args.backend != "fake" and (args.slice_scale
                                        or args.measure_restart
                                        or args.contention or args.churn
@@ -2547,6 +2592,10 @@ def _run(args, p) -> int:
             results.append(run_router(args))
         if args.serve:
             results.append(run_serve(args))
+        if args.serve_mp:
+            # real OS-process gangs: runs last so the in-process
+            # scenarios' timings aren't perturbed by gang spawn load
+            results.append(run_serve_mp(args))
         if args.trace:
             # one stage table for the whole invocation, on the last line
             results[-1].update(trace_stage_breakdown())

@@ -1,0 +1,319 @@
+"""Multi-host tensor-parallel serving bench (ISSUE 14): 1-process vs
+4-process CPU mesh over the SAME fixed-seed three-lane workload.
+
+    python -m k8s_tpu.harness.bench_serve_mp --processes 4
+
+Each arm is a REAL serving gang (models/mp_serve.run_serve_gang): N OS
+processes under the operator env contract, ``jax.distributed`` + gloo
+collectives, params tensor-sharded, the KV pool head-sharded per host,
+the chief broadcasting the per-step batch plan.  Both arms run a
+compile-warming pass first, then the timed script (greedy + sampled +
+speculative lanes mixed), so the comparison measures serving, not
+tracing.
+
+Embedded assertions (a violation attaches ``failures`` and raises with
+the artifact on the exception — the bench_churn.json contract; the
+artifact lands on failure too):
+
+- **token identity**: the N-process mesh emits byte-identical tokens to
+  the 1-process mesh for every request of every lane — the ROADMAP
+  item 3 correctness bar, end to end through real processes;
+- **memory sharding**: each process holds ~1/N of the KV pool and the
+  tensor-sharded params (the reason multi-host serving exists: models
+  that do not fit one chip), asserted from each worker's MEASURED
+  addressable-shard sizes (mesh_serve.local_fraction), with the
+  spec-derived expectation alongside in the artifact;
+- **mesh overhead floor**: N-process aggregate tokens/s >=
+  ``efficiency_floor`` x single-host (default 0.12).  NOTE the honest
+  scope: this CPU mesh runs its per-layer psums over gloo TCP loopback
+  (millisecond-class latency); the TPU target — tokens/s per chip
+  within 20% of single-host, i.e. efficiency ~0.8 — needs ICI-class
+  microsecond collectives and is recorded in the artifact as
+  ``per_chip_tpu_target`` for the hardware run to assert
+  (docs/performance.md carries the measured CPU numbers and the
+  derivation).  The CI floor exists to catch mechanism regressions (a
+  serialization bug, a pool re-gather, a per-step recompile) that tank
+  the ratio, not to prove ICI scaling on a laptop;
+- **compile budgets per process**: the chief's engine seams AND every
+  worker's mirrored seams stay within their declared budgets
+  (K8S_TPU_COMPILE_LEDGER=1 is exported to the gang);
+- **clean gang exits**: every process exits 0 in both arms.
+
+Emits one JSON line (bench.py contract); ``--out`` additionally writes
+the ``bench_serve_mp.json`` artifact, on failure too with a
+``failures`` field.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+log = logging.getLogger(__name__)
+
+# calibrated regression floor for the gloo-loopback CPU mesh: measured
+# 0.26-0.34 on the 24-core reference box at hidden=256/layers=4,
+# slots 8-16 (see docs/performance.md); 0.12 leaves CI-noise headroom
+# while still catching anything that serializes the mesh
+DEFAULT_EFFICIENCY_FLOOR = 0.12
+PER_CHIP_TPU_TARGET = 0.8
+
+
+def bench_script(requests: int, max_new: int) -> list[dict]:
+    """The mixed three-lane fixed-seed workload both arms serve."""
+    out: list[dict] = []
+    for i in range(requests):
+        lane = i % 3
+        base = [(i * 13 + j * 7 + 1) % 256 for j in range(8)]
+        if lane == 0:
+            out.append({"tokens": base, "max_new_tokens": max_new})
+        elif lane == 1:
+            out.append({"tokens": base, "max_new_tokens": max_new,
+                        "temperature": 1.0, "seed": 100 + i})
+        else:
+            cyc = [(i * 29 + j * 11 + 3) % 256 for j in range(5)]
+            out.append({"tokens": [cyc[j % 5] for j in range(20)],
+                        "max_new_tokens": max_new, "speculative": 4,
+                        "seed": 200 + i})
+    return out
+
+
+def _arm(n: int, script: list, *, slots: int, threads: int, hidden: int,
+         layers: int, timeout: float) -> tuple:
+    from k8s_tpu.models import mp_serve
+
+    res, workers = mp_serve.run_serve_gang(
+        n, script=script, slots=slots, threads=threads, hidden=hidden,
+        layers=layers, heads=8, max_seq_len=128, timeout=timeout,
+        warmup=True, extra_env={"K8S_TPU_COMPILE_LEDGER": "1"})
+    return res, workers
+
+
+def run_bench(processes: int = 4, requests: int = 24, max_new: int = 24,
+              slots: int = 8, threads: int = 10, hidden: int = 256,
+              layers: int = 4, timeout: float = 420.0,
+              efficiency_floor: float = DEFAULT_EFFICIENCY_FLOOR) -> dict:
+    script = bench_script(requests, max_new)
+    failures: list[str] = []
+    arms: dict[int, dict] = {}
+    worker_audits: dict[int, list] = {}
+    for n in (1, processes):
+        res, workers = _arm(n, script, slots=slots, threads=threads,
+                            hidden=hidden, layers=layers, timeout=timeout)
+        if not res.success or res.chief_result is None:
+            tail = res.worker_outputs[-1][-800:] if res.worker_outputs \
+                else ""
+            failures.append(
+                f"{n}-process gang failed: exit codes {res.exit_codes}: "
+                f"{tail}")
+            arms[n] = {"exit_codes": res.exit_codes}
+            continue
+        c = res.chief_result
+        arms[n] = {
+            "num_processes": c["num_processes"],
+            "tp_degree": c["tp_degree"],
+            "tokens": c["tokens"],
+            "wall_s": c["wall_s"],
+            "tokens_per_s": c["tokens_per_s"],
+            "decode_programs": c["decode_programs"],
+            "prefill_programs": c["prefill_programs"],
+            "spec_mean_accepted": c["spec_mean_accepted"],
+            "compile_ledger": c["compile_ledger"],
+            "errors": c["errors"],
+            "gang_duration_s": round(res.duration_s, 1),
+            "results": c["results"],
+        }
+        worker_audits[n] = workers
+        if c["errors"]:
+            failures.append(f"{n}-process arm request errors: "
+                            f"{c['errors'][:3]}")
+
+    result: dict = {
+        "metric": "serve_mp_tokens_per_s",
+        "value": arms.get(processes, {}).get("tokens_per_s"),
+        "unit": "tok/s",
+        "processes": processes,
+        "requests": requests,
+        "max_new": max_new,
+        "slots": slots,
+        "threads": threads,
+        "model": {"hidden": hidden, "layers": layers, "heads": 8},
+        "per_chip_tpu_target": PER_CHIP_TPU_TARGET,
+        "efficiency_floor": efficiency_floor,
+        "single_host": {k: v for k, v in arms.get(1, {}).items()
+                        if k != "results"},
+        "mesh": {k: v for k, v in arms.get(processes, {}).items()
+                 if k != "results"},
+        "worker_audits": worker_audits.get(processes, []),
+    }
+
+    one, many = arms.get(1), arms.get(processes)
+    if one and many and "results" in one and "results" in many:
+        # -- token identity: the correctness bar ------------------------
+        identical = one["results"] == many["results"]
+        result["token_identity_ok"] = identical
+        if not identical:
+            diffs = [i for i, (a, b) in enumerate(
+                zip(one["results"], many["results"])) if a != b]
+            failures.append(
+                f"{processes}-process mesh diverged from 1-process on "
+                f"requests {diffs[:8]}: tensor-parallel decode is not "
+                "output-invariant")
+        # -- mesh overhead floor ---------------------------------------
+        eff = many["tokens_per_s"] / max(one["tokens_per_s"], 1e-9)
+        result["mp_efficiency"] = round(eff, 3)
+        if eff < efficiency_floor:
+            failures.append(
+                f"{processes}-process mesh at {many['tokens_per_s']} "
+                f"tok/s is {round(eff, 3)}x single-host "
+                f"{one['tokens_per_s']} tok/s (< {efficiency_floor} "
+                "floor): the plan/collective machinery is eating the "
+                "mesh (serialized steps? pool re-gather? per-step "
+                "recompile?)")
+        # -- compile budgets per process -------------------------------
+        for label, audit in [("chief-1p", one.get("compile_ledger")),
+                             (f"chief-{processes}p",
+                              many.get("compile_ledger"))] + [
+                (f"worker-{w.get('process_id')}",
+                 w.get("compile_ledger"))
+                for w in worker_audits.get(processes, [])]:
+            if audit is None:
+                failures.append(
+                    f"{label}: no compile-ledger audit (the gang runs "
+                    "under K8S_TPU_COMPILE_LEDGER=1; a missing audit "
+                    "means a process never declared its seams)")
+            elif audit["over_budget"]:
+                failures.append(
+                    f"{label}: compile seams over budget "
+                    f"{audit['over_budget']}: per-process program "
+                    "inventory no longer bounds the compile surface")
+        # -- memory sharding: 1/N pool + params per host, MEASURED -----
+        # from each worker's addressable shards (mesh_serve.
+        # local_fraction) — the spec-derived numbers ride the artifact
+        # as the expectation, the assertion reads runtime reality so a
+        # silent pool replication fails here
+        expect = _shard_fractions(processes, hidden, layers)
+        result["shard_fractions_expected"] = expect
+        measured = [(w.get("process_id"), w.get("pool_local_fraction"),
+                     w.get("params_local_fraction"))
+                    for w in worker_audits.get(processes, [])]
+        result["shard_fractions_measured"] = [
+            {"process_id": p, "pool": pf, "params": prf}
+            for p, pf, prf in measured]
+        for pid, pool_frac, param_frac in measured:
+            if pool_frac is None or \
+                    abs(pool_frac - 1.0 / processes) > 0.02:
+                failures.append(
+                    f"worker {pid} holds {pool_frac} of the KV pool, "
+                    f"expected ~1/{processes}: the pool is not "
+                    "head-sharded (a replicated pool forfeits the "
+                    "memory win multi-host serving exists for)")
+            if param_frac is None or \
+                    param_frac > expect["params"] + 0.05:
+                failures.append(
+                    f"worker {pid} holds {param_frac} of the params, "
+                    f"expected ~{expect['params']}: tensor sharding is "
+                    "not splitting the transformer weights")
+
+    # arms dropped the big results lists from the artifact copy above;
+    # keep a compact identity digest instead
+    if one and "results" in one:
+        result["results_digest"] = _digest(one["results"])
+
+    if failures:
+        result["failures"] = failures
+        err = RuntimeError("serve-mp bench assertions failed:\n  "
+                           + "\n  ".join(failures))
+        err.result = result
+        raise err
+    return result
+
+
+def _digest(results: list) -> str:
+    import hashlib
+
+    return hashlib.sha1(
+        json.dumps(results, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _shard_fractions(tp: int, hidden: int, layers: int) -> dict:
+    """Per-host memory share of the KV pool and params under the serve
+    tp specs, computed from the sharding rules themselves (in-process —
+    the same spec functions the gang compiles with)."""
+    from jax.sharding import PartitionSpec as P
+
+    from k8s_tpu.models.mp_serve import build_model
+    from k8s_tpu.parallel.sharding import serve_tp_param_specs
+
+    import jax
+
+    config, params = build_model(0, hidden=hidden, layers=layers, heads=8,
+                                 max_seq_len=128)
+    specs = serve_tp_param_specs(params)
+    total = 0
+    local = 0.0
+    def sharded(spec: P) -> bool:
+        return any(a == "tp" or (isinstance(a, tuple) and "tp" in a)
+                   for a in spec)
+
+    for leaf, spec in zip(jax.tree.leaves(params),
+                          jax.tree.leaves(specs,
+                                          is_leaf=lambda s:
+                                          isinstance(s, P))):
+        n = leaf.size
+        total += n
+        local += n / (tp if sharded(spec) else 1)
+    # pool leaves shard the kv-head axis over tp by construction
+    # (serve_pool_spec), so the per-host share is exactly 1/tp as long
+    # as kv_heads % tp == 0 — which MeshPlacement enforces
+    return {"params": round(local / max(total, 1), 3),
+            "pool": round(1.0 / tp, 3)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--processes", type=int, default=4)
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--max-new", type=int, default=24)
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--threads", type=int, default=10)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--timeout", type=float, default=420.0)
+    p.add_argument("--efficiency-floor", type=float,
+                   default=DEFAULT_EFFICIENCY_FLOOR)
+    p.add_argument("--out", default=None,
+                   help="also write the JSON artifact to this path")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.WARNING)
+
+    def _write(payload: dict) -> None:
+        line = json.dumps(payload)
+        print(line)
+        if args.out:
+            import os
+
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+
+    try:
+        result = run_bench(
+            processes=args.processes, requests=args.requests,
+            max_new=args.max_new, slots=args.slots, threads=args.threads,
+            hidden=args.hidden, layers=args.layers, timeout=args.timeout,
+            efficiency_floor=args.efficiency_floor)
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write(partial)
+        raise
+    _write(result)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -100,6 +100,21 @@ def initialize_distributed(config: Optional[LauncherConfig] = None) -> LauncherC
         )
     import jax
 
+    # Multi-process CPU worlds (localhost e2e gangs, CPU node pools) need
+    # an explicit cross-process collectives backend: without one the CPU
+    # client is built with collectives=None and every computation that
+    # spans processes dies with "Multiprocess computations aren't
+    # implemented on the CPU backend".  The gloo TCP implementation rides
+    # the same coordinator jax.distributed just connected.  Must happen
+    # BEFORE the first backend touch; idempotent and a no-op for TPU.
+    platform = os.environ.get("K8S_TPU_PLATFORM", "") or \
+        os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in platform:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:  # noqa: BLE001 - older jaxlib: no gloo build
+            log.warning("cpu collectives impl not configurable: %s", e)
+
     log.info(
         "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
         cfg.coordinator_address, cfg.num_processes, cfg.process_id,

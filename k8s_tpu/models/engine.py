@@ -74,6 +74,19 @@ prefix reuse, unset auto-sizes to two full-length rows).  The
 ``K8S_TPU_SERVE_BATCH_SAMPLING`` and ``K8S_TPU_SERVE_BATCH_SPEC``
 lane-routing knobs live in the server.
 
+Round 14 (ISSUE 14): the engine's device programs live behind a
+**placement-agnostic seam** (models/placement.py).  The slot scheduler,
+block-pool bookkeeping, and batch-plan construction in this module are
+host-side Python and run on ONE chief process; the jitted compute
+bodies (models/placement.PagedCompute) are compiled by a ``Placement``
+— ``LocalPlacement`` (plain jit, byte-for-byte the single-host path) or
+``MeshPlacement`` (models/mesh_serve.py: params tensor-sharded over a
+``tp`` mesh axis, the KV block pool sharded per-host along the head
+axis but addressed by the SAME block tables, the per-step batch plan
+broadcast to worker processes over a stdlib plan bus and sampled tokens
+collected replicated).  ``K8S_TPU_SERVE_MESH`` / ``K8S_TPU_SERVE_TP``
+select the mesh placement; unset keeps this file's original behavior.
+
 Round 12: the engine narrates itself per request.  With
 ``K8S_TPU_REQUEST_LOG=1`` (models/requestlog.py) every request gets a
 bounded timeline — queue wait, prefill chunks with the prefix-reuse
@@ -97,11 +110,11 @@ from k8s_tpu.analysis import checkedlock
 from k8s_tpu.analysis import compileledger
 from k8s_tpu.models import requestlog
 from collections import deque
-from collections.abc import Mapping
 from typing import Any, Callable, Optional
 
 import numpy as np
 
+from k8s_tpu.models import placement as placement_lib
 from k8s_tpu.models.decode import prefill_buckets_for, split_prefill
 from k8s_tpu.models.kvblocks import BlockPool, PrefixTree
 
@@ -258,38 +271,6 @@ class _Slot:
         self.ctx = None
 
 
-def _reset_positions(tree):
-    """Fresh-cache normalization: every ``pos`` leaf to -1 (no slot
-    valid), leaving K/V storage untouched — the mask keys validity off
-    ``pos``, so stale vectors are unreachable."""
-    import jax.numpy as jnp
-
-    def rec(node):
-        if isinstance(node, Mapping):
-            return {k: (jnp.full_like(v, -1) if k == "pos" else rec(v))
-                    for k, v in node.items()}
-        return node
-
-    return rec(tree)
-
-
-def _is_cache_node(node) -> bool:
-    # detect by k/v (not pos): the POOL's cache nodes carry no pos leaf —
-    # validity is synthesized from row lengths at view time
-    return isinstance(node, Mapping) and "k" in node and "v" in node \
-        and not isinstance(node["k"], Mapping)
-
-
-def _map_cache(tree, fn):
-    """Rebuild a cache pytree applying ``fn`` to every attention cache
-    node (the dict holding the k/v/pos(/scale) leaves)."""
-    if _is_cache_node(tree):
-        return fn(tree)
-    if isinstance(tree, Mapping):
-        return {k: _map_cache(v, fn) for k, v in tree.items()}
-    return tree
-
-
 class Engine:
     """Continuous-batching decode engine over one model + params.
 
@@ -302,11 +283,8 @@ class Engine:
                  buckets: Optional[tuple] = None, pad_id: int = 0,
                  block_size: Optional[int] = None,
                  prefix_blocks: Optional[int] = None,
-                 metrics: Optional[dict] = None):
-        import jax
-
-        from k8s_tpu.models.transformer import Transformer
-
+                 metrics: Optional[dict] = None,
+                 placement=None):
         if slots is None:
             slots = env_slots() or DEFAULT_SLOTS
         if slots < 1:
@@ -314,7 +292,21 @@ class Engine:
         if queue_limit is None:
             queue_limit = env_queue()
         self.config = config
-        self.params = params
+        # the placement seam (ISSUE 14): LocalPlacement is today's plain
+        # single-device jit; MeshPlacement shards the same compute
+        # bodies over a tp process mesh.  The scheduler below never
+        # branches on it — only compilation and host<->device transfer
+        # differ.
+        self._placement = placement if placement is not None \
+            else placement_lib.LocalPlacement()
+        if self._placement.is_mesh and config.window_size is not None:
+            raise ValueError(
+                "mesh serving needs the paged block pool; windowed "
+                "configs keep dense per-slot rows and stay single-host")
+        self._compute = placement_lib.PagedCompute(
+            config, apply_mesh=self._placement.mesh)
+        self._model = self._compute.model
+        self.params = self._placement.globalize_params(params)
         self.pad_id = pad_id
         self.queue_limit = queue_limit
         self.buckets = tuple(sorted(buckets or prefill_buckets_for(config)))
@@ -329,7 +321,6 @@ class Engine:
                 f"({config.prefill_chunk}): a windowed ring cache only "
                 "holds window + prefill_chunk - 1 slots")
         self.metrics = metrics or {}
-        self._model = Transformer(config)
         self._queue: deque[_Request] = deque()
         self._cond = checkedlock.make_condition("engine.cond")
         self._closed = False
@@ -370,26 +361,34 @@ class Engine:
         # so far — spec verify steps are distinct programs from the
         # k-fused greedy/sampled scans at the same width
         self._step_ks: set[tuple[int, bool, bool]] = set()
-        self._row_template = self._init_cache(1)
         if self.paged:
             # one jit entry point; the fused iteration count k and the
             # has-sampling flag are static arguments, so the decode
             # program set is (widths used) x (greedy-only | sampling) —
             # an all-greedy batch pays a bare argmax, never the per-row
-            # sort/split/categorical machinery
-            self._step_fn = jax.jit(self._paged_step_impl,
-                                    donate_argnums=(1,),
-                                    static_argnums=(6, 7))
+            # sort/split/categorical machinery.  resident_argnums marks
+            # device-resident state (params/pool/tables) a mesh
+            # placement keeps on every process; everything else is
+            # per-step host plan data the chief broadcasts.
+            self._step_fn = self._placement.wrap(
+                "paged_step", self._compute.paged_step,
+                donate_argnums=(1,), static_argnums=(6, 7),
+                resident_argnums=(0, 1, 2))
             # the variable-width speculative step: chunk width W and the
             # sampling flag are static, so spec traffic adds one program
             # per (draft_k, sampling) pair actually used
-            self._spec_fn = jax.jit(self._spec_step_impl,
-                                    donate_argnums=(1,),
-                                    static_argnums=(7, 8))
-            self._cow_fn = jax.jit(self._cow_impl, donate_argnums=(0,))
-            self._pool = self._make_pool()
-            self._row_template = None  # only _make_pool needed it; a
-            # dense [1, max_seq_len] row would idle on device forever
+            self._spec_fn = self._placement.wrap(
+                "spec_step", self._compute.spec_step,
+                donate_argnums=(1,), static_argnums=(7, 8),
+                resident_argnums=(0, 1, 2))
+            self._cow_fn = self._placement.wrap(
+                "cow", self._compute.cow, donate_argnums=(0,),
+                resident_argnums=(0,))
+            self._pool = self._placement.build_pool(
+                self._compute.pool_manifest(self.params, self.pool_blocks,
+                                            self.block_size))
+            self._row_template = None  # dense-mode only; a dense
+            # [1, max_seq_len] row would idle on device forever
             self._pool_alloc = BlockPool(self.pool_blocks)
             self._tree = PrefixTree(block_size) \
                 if self.prefix_blocks > 0 else None
@@ -399,12 +398,15 @@ class Engine:
             self._tables_dev = None
             self._tables_dirty = True
         else:
-            self._step_fn = jax.jit(self._dense_step_impl,
-                                    donate_argnums=(1,),
-                                    static_argnums=(7,))
-            self._scatter_fn = jax.jit(self._scatter_impl,
-                                       donate_argnums=(0,))
-            self._cache = self._init_cache(slots)
+            self._step_fn = self._placement.wrap(
+                "dense_step", self._compute.dense_step,
+                donate_argnums=(1,), static_argnums=(7,),
+                resident_argnums=(0, 1))
+            self._scatter_fn = self._placement.wrap(
+                "scatter", self._compute.scatter, donate_argnums=(0,),
+                resident_argnums=(0,))
+            self._row_template = self._compute.init_cache(self.params, 1)
+            self._cache = self._compute.init_cache(self.params, slots)
             self._pool = None
             self._pool_alloc = None
             self._tree = None
@@ -590,8 +592,18 @@ class Engine:
             return sum(1 for s in self._slots if not s.free)
 
     def stats(self) -> dict:
+        # mesh identity (ISSUE 14): read outside the engine lock — the
+        # placement is immutable after construction
+        mesh_info = self._placement.info()
         with self._cond:
             return {
+                # placement/mesh surface: lets the fleet plane and
+                # /debug/engine tell a tensor-sharded multi-process pod
+                # from a single-host one
+                "placement": mesh_info["placement"],
+                "num_processes": mesh_info["num_processes"],
+                "mesh_shape": mesh_info["mesh_shape"],
+                "tp_degree": mesh_info["tp_degree"],
                 "slots": len(self._slots),
                 "active": sum(1 for s in self._slots if not s.free),
                 "queue_depth": len(self._queue),
@@ -640,6 +652,9 @@ class Engine:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout)
+        # after the engine thread is done broadcasting: releases worker
+        # processes cleanly on a mesh placement (no-op locally)
+        self._placement.close()
 
     def debug_check_blocks(self) -> None:
         """Test hook: assert pool refcounts exactly equal the references
@@ -734,176 +749,6 @@ class Engine:
             return None
         return self._ledger.seam_audit(self.compile_seams())
 
-    def _init_cache(self, batch: int):
-        """Batched cache pytree for ``batch`` rows, every slot invalid.
-        Built by one eager decode-mode apply (flax initializes the cache
-        collection), then pos-reset — runs op-by-op, compiles nothing."""
-        import jax.numpy as jnp
-
-        toks = jnp.zeros((batch, 1), jnp.int32)
-        pos = jnp.zeros((batch, 1), jnp.int32)
-        _, varz = self._model.apply(
-            {"params": self.params}, toks, positions=pos, mode="decode",
-            mutable=["cache"])
-        return _reset_positions(varz["cache"])
-
-    def _make_pool(self):
-        """The block-granular KV pool: every dense-cache K/V(/scale)
-        leaf ``[1, S, ...]`` becomes ``[num_blocks, block_size, ...]``.
-        No pos leaf is pooled: validity is synthesized from each row's
-        written length at view time, so recycled blocks need no reset
-        pass and stale content is unreachable by construction."""
-        import jax.numpy as jnp
-
-        N, blk = self.pool_blocks, self.block_size
-
-        def build(node):
-            return {k: jnp.zeros((N, blk) + tuple(v.shape[2:]), v.dtype)
-                    for k, v in node.items() if k != "pos"}
-
-        return _map_cache(self._row_template, build)
-
-    def _paged_cache(self, pool, tables, lens):
-        """Attach the per-row block ``table`` and written-``len`` bound
-        to every pool cache node: the collection the transformer's paged
-        decode path consumes (write straight into pool blocks, attend
-        behind the ``paged_attention`` seam) — replacing the round-6
-        gathered per-row view, which copied every KV leaf per fused
-        window (the ~15% decode tax docs/performance.md tracked)."""
-        def build(node):
-            return {**node, "table": tables, "len": lens}
-
-        return _map_cache(pool, build)
-
-    @staticmethod
-    def _pool_from_cache(cache):
-        """Strip the table/len addressing back off a returned cache
-        collection, leaving just the pool leaves."""
-        def strip(node):
-            return {k: v for k, v in node.items()
-                    if k not in ("table", "len")}
-
-        return _map_cache(cache, strip)
-
-    def _paged_step_impl(self, params, pool, tables, ints, keys, temps,
-                         k: int, sampling: bool):
-        """``k`` fused batched decode iterations over the block pool
-        (``k`` is jit-static, bounded by MAX_STEP_TOKENS): feed each
-        row's last token at its own position, sample/argmax per row from
-        its own distribution (decode.sample_logits_rows — the exclusive
-        lane's exact key schedule, one split per emitted token), carry
-        the POOL itself through a scan.  K/V writes scatter straight
-        into each row's blocks inside the model call and attention
-        indexes the pool through the block tables behind the
-        ``paged_attention`` seam — nothing is gathered into a per-row
-        view or written back.  ``ints`` packs [toks, poss, topks] into
-        one [3, B] transfer; a row's position doubles as its written
-        length for validity masking.  Inactive rows ride at position -1:
-        their writes are dropped before they reach the pool."""
-        import jax
-        import jax.numpy as jnp
-
-        from k8s_tpu.models.decode import sample_logits_rows
-
-        toks0, poss0, topks = ints[0], ints[1], ints[2]
-
-        def body(carry, _):
-            pool, toks, poss, kk = carry
-            cache = self._paged_cache(pool, tables, jnp.maximum(poss, 0))
-            logits, varz = self._model.apply(
-                {"params": params, "cache": cache}, toks[:, None],
-                positions=poss[:, None], mode="decode",
-                mutable=["cache"])
-            pool = self._pool_from_cache(varz["cache"])
-            if sampling:
-                new_keys, nxt = sample_logits_rows(logits[:, -1], kk,
-                                                   temps, topks)
-            else:
-                # all-greedy batch: the raw-dtype argmax the exclusive
-                # lane takes at temperature 0; no key ever advances
-                # because no row will ever draw from one
-                new_keys = kk
-                nxt = jnp.argmax(logits[:, -1],
-                                 axis=-1).astype(jnp.int32)
-            act = poss >= 0
-            return (pool, jnp.where(act, nxt, toks),
-                    jnp.where(act, poss + 1, poss), new_keys), nxt
-
-        (pool, _, _, keys_out), toks_all = jax.lax.scan(
-            body, (pool, toks0, poss0, keys), None, length=k)
-        return pool, toks_all, keys_out  # toks_all [k, B]
-
-    def _spec_step_impl(self, params, pool, tables, chunk, ints, keys,
-                        temps, k: int, sampling: bool):
-        """ONE write-masked variable-width batched step (``k`` = the
-        jit-static chunk width W): every participating slot feeds its
-        own row of ``chunk`` [B, W] — a speculative slot its last token
-        plus ``draft_k - 1`` prompt-lookup drafts (width W), a plain
-        slot just its last token (width 1) — at per-slot positions.
-        Lanes past a row's width ride at position -1, so their K/V
-        writes are DROPPED before reaching the pool (the write mask: a
-        mixed-width batch can never scribble past a short row's block
-        capacity) and their queries attend nothing.  Accept/reject runs
-        row-wise in decode.spec_verify_rows with the exclusive lane's
-        exact per-iteration key schedule.  ``ints`` packs [poss, widths,
-        topks]; returns (pool, emit [B, W], n_emit [B], new_keys)."""
-        import jax.numpy as jnp
-
-        from k8s_tpu.models.decode import spec_verify_rows
-
-        poss, widths, topks = ints[0], ints[1], ints[2]
-        ar = jnp.arange(k, dtype=jnp.int32)
-        cpos = jnp.where(
-            (poss >= 0)[:, None] & (ar[None, :] < widths[:, None]),
-            poss[:, None] + ar[None, :], -1)  # [B, W]; -1 = write-masked
-        cache = self._paged_cache(pool, tables, jnp.maximum(poss, 0))
-        logits, varz = self._model.apply(
-            {"params": params, "cache": cache}, chunk,
-            positions=cpos, mode="decode", mutable=["cache"])
-        pool = self._pool_from_cache(varz["cache"])
-        new_keys, emit, n_emit = spec_verify_rows(
-            logits, chunk, keys, temps, topks, widths, sampling)
-        return pool, emit, n_emit, new_keys
-
-    def _cow_impl(self, pool, src, dst):
-        """Copy-on-write at the divergence block: duplicate block ``src``
-        into the private block ``dst``.  Only the shared prefix of the
-        run is ever valid for the attaching row (validity is length-
-        based); the divergent tail is overwritten by its own prefill
-        before the row's length reaches it."""
-        def cw(node):
-            return {k: v.at[dst].set(v[src]) for k, v in node.items()}
-
-        return _map_cache(pool, cw)
-
-    def _dense_step_impl(self, params, cache, toks, poss, keys, temps,
-                         topks, sampling: bool):
-        """One batched decode step over the dense per-slot rows (windowed
-        fallback): same row-wise sampling (or all-greedy argmax fast
-        path) as the paged step."""
-        import jax.numpy as jnp
-
-        from k8s_tpu.models.decode import sample_logits_rows
-
-        logits, varz = self._model.apply(
-            {"params": params, "cache": cache}, toks[:, None],
-            positions=poss[:, None], mode="decode", mutable=["cache"])
-        if sampling:
-            new_keys, nxt = sample_logits_rows(logits[:, -1], keys,
-                                               temps, topks)
-        else:
-            new_keys = keys
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return varz["cache"], nxt, new_keys
-
-    def _scatter_impl(self, cache, row, idx):
-        """Replace batch row ``idx`` of every cache leaf with the freshly
-        prefilled batch-1 row (dense-mode slot join)."""
-        import jax
-
-        return jax.tree_util.tree_map(
-            lambda full, r: full.at[idx].set(r[0]), cache, row)
-
     def _prefill_fn(self, chunk_len: int) -> Callable:
         """Per-bucket prefill program.  Paged mode: one chunked
         decode-mode call writing straight into the request's pool blocks
@@ -911,32 +756,14 @@ class Engine:
         batch-1 row-cache call (scattered into the slot later)."""
         fn = self._prefill_fns.get(chunk_len)
         if fn is None:
-            import jax
-            import jax.numpy as jnp
-
             if self.paged:
-                def run(params, pool, table, chunk, positions):
-                    # written length BEFORE this chunk = its first
-                    # position (chunks land in order)
-                    cache = self._paged_cache(pool, table[None, :],
-                                              positions[:, 0])
-                    logits, varz = self._model.apply(
-                        {"params": params, "cache": cache}, chunk,
-                        positions=positions, mode="decode",
-                        mutable=["cache"])
-                    return self._pool_from_cache(varz["cache"]), \
-                        logits[:, -1]
-
-                fn = jax.jit(run, donate_argnums=(1,))
+                fn = self._placement.wrap(
+                    "prefill", self._compute.prefill_paged,
+                    donate_argnums=(1,), resident_argnums=(0, 1))
             else:
-                def run(params, cache, chunk, positions):
-                    logits, varz = self._model.apply(
-                        {"params": params, "cache": cache}, chunk,
-                        positions=positions, mode="decode",
-                        mutable=["cache"])
-                    return varz["cache"], logits[:, -1]
-
-                fn = jax.jit(run)
+                fn = self._placement.wrap(
+                    "prefill_dense", self._compute.prefill_dense,
+                    resident_argnums=(0,))
             if self._ledger is not None:
                 fn = self._ledger.wrap(
                     fn, self._seam_prefill, name="prefill",
@@ -1112,8 +939,14 @@ class Engine:
 
         key = jax.random.PRNGKey(req.seed)
         ks = jax.random.split(key)
-        # sync-ok: once per request at the prefill boundary, not per
-        # step — the first token must reach the host to decide retire
+        # The logits are fetched BEFORE the sampling math so the draw
+        # runs on a host-local array: a multi-process mesh's replicated
+        # prefill output is fetchable everywhere but not fully
+        # addressable, so eager device ops on it would be illegal — and
+        # the local placement pays the same single sync either way.
+        # sync-ok: once per request at the prefill boundary, not per step
+        last_logits = np.asarray(last_logits)
+        # sync-ok: host-local sampling of the already-fetched logits
         first = int(np.asarray(sample_logits(
             last_logits, ks[1], req.temperature, req.top_k))[0])
         # sync-ok: the carried key joins the host-side per-slot key
@@ -1127,8 +960,6 @@ class Engine:
         whose prefill is skipped (always <= len(ids) - 1: the last
         prompt token is recomputed for its logits), the blocks attached,
         and whether the divergence block was copy-on-written."""
-        import jax.numpy as jnp
-
         if self._tree is None:
             return 0, 0, False
         full, partial = self._tree.match(ids, len(ids) - 1)
@@ -1142,8 +973,7 @@ class Engine:
             node, j = partial
             dst = self._alloc_block(slot)
             self._pool = self._cow_fn(
-                self._pool, jnp.asarray(node.block, jnp.int32),
-                jnp.asarray(dst, jnp.int32))
+                self._pool, np.int32(node.block), np.int32(dst))
             slot.table[slot.nblocks] = dst
             slot.nblocks += 1
             shared += j
@@ -1165,8 +995,6 @@ class Engine:
         attached), then emit the first token.  A first-token EOS or
         max_new_tokens == 1 retires the request without ever occupying a
         step."""
-        import jax.numpy as jnp
-
         from k8s_tpu import trace
 
         ids = req.ids
@@ -1198,18 +1026,20 @@ class Engine:
                 with trace.span_under(req.trace_ctx, "prefill",
                                       prompt_len=len(ids),
                                       chunks=len(chunks), shared=shared):
-                    table_dev = jnp.asarray(slot.table)
+                    # host plan data stays numpy: the placement owns the
+                    # transfer (plain jit uploads it; a mesh placement
+                    # broadcasts it to every process first)
+                    table = np.ascontiguousarray(slot.table)
                     off = shared
                     last = None
                     for c in chunks:
                         compiled = c not in self._prefill_fns
                         tc0 = time.monotonic()
-                        chunk = jnp.asarray(ids[off:off + c],
-                                            jnp.int32)[None, :]
-                        positions = (off + jnp.arange(
-                            c, dtype=jnp.int32))[None, :]
+                        chunk = ids[off:off + c][None, :]
+                        positions = (off + np.arange(
+                            c, dtype=np.int32))[None, :]
                         self._pool, last = self._prefill_fn(c)(
-                            self.params, self._pool, table_dev, chunk,
+                            self.params, self._pool, table, chunk,
                             positions)
                         if rlog is not None:
                             rlog.prefill_chunk(
@@ -1238,10 +1068,9 @@ class Engine:
                     for c in chunks:
                         compiled = c not in self._prefill_fns
                         tc0 = time.monotonic()
-                        chunk = jnp.asarray(ids[off:off + c],
-                                            jnp.int32)[None, :]
-                        positions = (off + jnp.arange(
-                            c, dtype=jnp.int32))[None, :]
+                        chunk = ids[off:off + c][None, :]
+                        positions = (off + np.arange(
+                            c, dtype=np.int32))[None, :]
                         cache, last = self._prefill_fn(c)(
                             self.params, cache, chunk, positions)
                         if rlog is not None:
@@ -1280,7 +1109,7 @@ class Engine:
             return
         if not self.paged:
             self._cache = self._scatter_fn(self._cache, cache,
-                                           jnp.asarray(slot.idx, jnp.int32))
+                                           np.int32(slot.idx))
         slot.tokens = tokens
         slot.last = first
         slot.pos = len(ids)
@@ -1336,8 +1165,6 @@ class Engine:
         only advances on actual verifies) and a round-robin pointer
         rotates the pick, so no group starves and the per-row random
         draw shapes always match the exclusive lane's."""
-        import jax.numpy as jnp
-
         from k8s_tpu import trace
 
         B = len(self._slots)
@@ -1402,21 +1229,19 @@ class Engine:
         with trace.span("decode_step", active=len(active), fused=k):
             if self.paged:
                 if self._tables_dirty:
-                    self._tables_dev = jnp.asarray(
+                    self._tables_dev = self._placement.put_tables(
                         np.stack([s.table for s in self._slots]))
                     self._tables_dirty = False
                 self._pool, toks_all, new_keys = self._step_fn(
                     self.params, self._pool, self._tables_dev,
-                    jnp.asarray(ints), jnp.asarray(keys),
-                    jnp.asarray(temps), k, sampling)
+                    ints, keys, temps, k, sampling)
                 # sync-ok: THE one host read per fused step — tokens
                 # must reach the host for EOS/retire decisions
                 toks_host = np.asarray(toks_all)  # [k, B]
             else:
                 self._cache, nxt, new_keys = self._step_fn(
-                    self.params, self._cache, jnp.asarray(ints[0]),
-                    jnp.asarray(ints[1]), jnp.asarray(keys),
-                    jnp.asarray(temps), jnp.asarray(ints[2]), sampling)
+                    self.params, self._cache, ints[0], ints[1], keys,
+                    temps, ints[2], sampling)
                 # sync-ok: the one host read per dense step (EOS/retire)
                 toks_host = np.asarray(nxt)[None, :]  # [1, B]
             # sync-ok: per-slot keys live host-side (slots join/retire
@@ -1478,8 +1303,6 @@ class Engine:
         token-for-token; rejected drafts need no rollback — their pool
         writes sit above the row's written length, masked until the
         next chunk overwrites them (the write-then-mask contract)."""
-        import jax.numpy as jnp
-
         from k8s_tpu import trace
         from k8s_tpu.models.decode import lookup_draft_host
 
@@ -1522,13 +1345,12 @@ class Engine:
         with trace.span("decode_step", active=len(active), fused=W,
                         spec=n_spec):
             if self._tables_dirty:
-                self._tables_dev = jnp.asarray(
+                self._tables_dev = self._placement.put_tables(
                     np.stack([s.table for s in self._slots]))
                 self._tables_dirty = False
             self._pool, emit, n_emit, new_keys = self._spec_fn(
                 self.params, self._pool, self._tables_dev,
-                jnp.asarray(chunk), jnp.asarray(ints),
-                jnp.asarray(keys), jnp.asarray(temps), W, sampling)
+                chunk, ints, keys, temps, W, sampling)
             # sync-ok: the one host read per verify step — emissions
             # and acceptance counts drive host-side truncation/retire
             emit_host = np.asarray(emit)      # [B, W]

@@ -1,0 +1,520 @@
+"""Multi-host tensor-parallel serving placement (ISSUE 14).
+
+``MeshPlacement`` compiles the engine's placement-agnostic compute
+bodies (models/placement.PagedCompute) over a device mesh built by
+``k8s_tpu.parallel.mesh``:
+
+- **params** are tensor-sharded over the ``tp`` axis (Megatron split —
+  q/k/v and gate/up column-sharded, o_proj/down_proj row-sharded with
+  GSPMD inserting the per-layer psums; parallel/sharding.serve_tp_*);
+- **the KV block pool** is sharded along the kv-head axis, so each host
+  holds its head slice of every block while the chief's block tables
+  address every shard identically; the pool write scatter and the
+  paged-attention read run inside ``shard_map`` islands
+  (models/paged.paged_kv_write_tp / paged_attention_tp) that PIN that
+  sharding — no collective ever touches the pool;
+- **the batch plan** (slot/table/position/token ints, PRNG keys,
+  temperatures) is per-step host data on the chief: it is broadcast to
+  every worker process over the stdlib plan bus (models/mp_plan.py) and
+  uploaded replicated, and sampled tokens come back replicated so only
+  the chief ever reads them.
+
+The chief process runs the full engine (scheduler, HTTP, metrics) —
+unchanged host-side logic; worker processes run :func:`follower_loop`,
+replaying the plan so every process dispatches the same program
+sequence.  ``jax.distributed`` brings the world up through the SAME
+operator env contract training gangs use (launcher.bootstrap), and the
+gang driver below reuses the e2e/multiprocess.py supervision pattern —
+a serving gang is launched, supervised, and failure-classified exactly
+like a training gang.  A chief crash closes the plan bus and every
+worker exits nonzero (asserted in tests): a half-dead serving gang
+restarts whole, it never hangs.
+
+CPU-provable: ``run_serve_gang`` spawns N local processes with one
+virtual CPU device each (the MULTIPROC bench trajectory), which is how
+CI pins token-identity across 1/2/4-process meshes with no TPU.
+
+Knobs: ``K8S_TPU_SERVE_MESH`` (process count; 0/unset = single-host),
+``K8S_TPU_SERVE_TP`` (tp degree, default = all visible devices),
+``K8S_TPU_SERVE_PLAN_PORT`` (the plan bus port workers dial).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from k8s_tpu.models import mp_plan
+from k8s_tpu.models import placement as placement_lib
+
+log = logging.getLogger(__name__)
+
+ENV_PLAN_PORT = "K8S_TPU_SERVE_PLAN_PORT"
+
+# plan-bus opcodes (the closed protocol the follower replays)
+OP_INIT = "init"
+OP_TABLES = "tables"
+OP_PAGED_STEP = "paged_step"
+OP_SPEC_STEP = "spec_step"
+OP_COW = "cow"
+OP_PREFILL = "prefill"
+
+
+def build_serve_mesh(tp: Optional[int] = None):
+    """The serving tp mesh over the visible devices (all of them by
+    default — in a multi-process world every process's devices must
+    participate or its jit dispatches would deadlock the collectives)."""
+    import jax
+
+    from k8s_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    devices = jax.devices()
+    tp = tp or placement_lib.env_tp() or len(devices)
+    if len(devices) % tp:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by tp={tp}")
+    if jax.process_count() > 1 and tp != len(devices):
+        raise ValueError(
+            f"a multi-process serving mesh must span every device "
+            f"(tp={tp}, devices={len(devices)}): a process outside the "
+            "mesh would never join the collectives")
+    return make_mesh(MeshConfig(tp=tp), devices[:tp])
+
+
+def _tree_manifest(tree) -> list:
+    """JSON-able (path, dtype, shape) list for a nested-dict pytree of
+    arrays — how the chief tells workers the pool's exact shape."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [[[str(getattr(k, "key", k)) for k in path],
+             str(leaf.dtype), list(leaf.shape)] for path, leaf in flat]
+
+
+def _tree_from_manifest(manifest: list, build: Callable) -> dict:
+    """Rebuild the nested dict, calling ``build(dtype, shape)`` per
+    leaf.  Key order is irrelevant: jax sorts dict keys at flatten time,
+    so chief and worker traces see one canonical structure."""
+    root: dict = {}
+    for path, dtype, shape in manifest:
+        node = root
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = build(dtype, tuple(shape))
+    return root
+
+
+class MeshPrograms:
+    """The sharded jit programs for one ``PagedCompute`` over one mesh —
+    used identically by the chief placement and worker followers, so
+    both sides always dispatch the same computation.
+
+    ``ledger=True`` (workers) declares this process's own compile-budget
+    seams on the active compile ledger — the chief's are declared by the
+    engine as always — so the "budgets honored per process" bench
+    assertion reads real per-process data.
+    """
+
+    def __init__(self, compute, mesh, *, ledger: bool = False,
+                 prefill_budget: Optional[int] = None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.compute = compute
+        self.mesh = mesh
+        self._repl = NamedSharding(mesh, P())
+        self._jits: dict[str, Callable] = {}
+        self._ledger = None
+        if ledger:
+            from k8s_tpu.analysis import compileledger
+
+            self._ledger = compileledger.maybe_active()
+            if self._ledger is not None:
+                try:
+                    from jax import monitoring as _monitoring
+                except Exception:  # noqa: BLE001 - wrap fallback covers it
+                    _monitoring = None
+                compileledger.ensure_listener(_monitoring)
+                fused = 1
+                widths = 0
+                while fused <= 8:  # mirrors engine.MAX_STEP_TOKENS cover
+                    widths += 1
+                    fused *= 2
+                self._seams = {
+                    OP_PREFILL: self._ledger.declare(
+                        "worker.prefill", prefill_budget,
+                        note="one prefill program per bucket, per "
+                        "process"),
+                    OP_PAGED_STEP: self._ledger.declare(
+                        "worker.decode_step", widths * 2,
+                        note="one decode program per (fused width, "
+                        "sampling) pair, per process"),
+                    OP_SPEC_STEP: self._ledger.declare(
+                        "worker.spec_step",
+                        compileledger.DEFAULT_SPEC_BUDGET,
+                        note="one verify program per (draft_k, "
+                        "sampling) pair, per process"),
+                    OP_COW: self._ledger.declare(
+                        "worker.aux", 4,
+                        note="shape-constant pool auxiliaries"),
+                }
+        self._jax = jax
+
+    def ledger_audit(self) -> Optional[dict]:
+        if self._ledger is None:
+            return None
+        return self._ledger.seam_audit(list(self._seams.values()))
+
+    # ------------------------------------------------------ array plumbing
+
+    def to_global(self, arr) -> Any:
+        """A host numpy value as a committed fully-replicated global
+        array (every process passes the same bytes — the plan bus
+        guarantees it)."""
+        import jax
+
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr.shape, self._repl, lambda idx: arr[idx])
+
+    def globalize(self, tree, specs) -> Any:
+        """A host-value pytree as committed global arrays under the
+        given PartitionSpec pytree.  Every process holds the identical
+        host value (same artifact / same seed / zeros), so each supplies
+        its own shards with no cross-process transfer."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        def put(leaf, spec):
+            local = np.asarray(leaf)
+            sharding = NamedSharding(self.mesh, spec)
+            return jax.make_array_from_callback(
+                local.shape, sharding, lambda idx: local[idx])
+
+        return jax.tree.map(put, tree, specs,
+                            is_leaf=lambda x: hasattr(x, "shape"))
+
+    def zeros_pool(self, manifest: list) -> Any:
+        """A global zero KV pool from the chief's init manifest,
+        head-sharded per serve_pool_spec — built shard-by-shard so no
+        process ever materializes a full pool leaf."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        from k8s_tpu.parallel.sharding import serve_pool_spec
+
+        def build(dtype, shape):
+            sharding = NamedSharding(self.mesh,
+                                     serve_pool_spec(_Shaped(shape)))
+            return jax.make_array_from_callback(
+                shape, sharding,
+                lambda idx: np.zeros(_index_shape(shape, idx), dtype))
+
+        return _tree_from_manifest(manifest, build)
+
+    def _pool_shardings(self, pool):
+        return self._jax.tree.map(lambda a: a.sharding, pool)
+
+    def _get_jit(self, op: str, pool) -> Callable:
+        fn = self._jits.get(op)
+        if fn is not None:
+            return fn
+        import jax
+
+        pool_sh = self._pool_shardings(pool)
+        if op == OP_PAGED_STEP:
+            fn = jax.jit(self.compute.paged_step, donate_argnums=(1,),
+                         static_argnums=(6, 7),
+                         out_shardings=(pool_sh, self._repl, self._repl))
+        elif op == OP_SPEC_STEP:
+            fn = jax.jit(self.compute.spec_step, donate_argnums=(1,),
+                         static_argnums=(7, 8),
+                         out_shardings=(pool_sh, self._repl, self._repl,
+                                        self._repl))
+        elif op == OP_COW:
+            fn = jax.jit(self.compute.cow, donate_argnums=(0,),
+                         out_shardings=pool_sh)
+        elif op == OP_PREFILL:
+            fn = jax.jit(self.compute.prefill_paged, donate_argnums=(1,),
+                         out_shardings=(pool_sh, self._repl))
+        else:
+            raise ValueError(f"unknown mesh op {op!r}")
+        if self._ledger is not None:
+            statics = {OP_PAGED_STEP: (6, 7), OP_SPEC_STEP: (7, 8)}.get(op, ())
+            fn = self._ledger.wrap(fn, self._seams[op],
+                                   name=f"worker.{op}",
+                                   static_argnums=statics)
+        self._jits[op] = fn
+        return fn
+
+    # ---------------------------------------------------------- execution
+
+    def execute(self, op: str, statics: dict, arrays: dict,
+                params, pool, tables):
+        """Run one plan op; returns ``(new_pool, new_tables, outputs)``.
+        The chief calls this right after broadcasting the same message;
+        followers call it on receipt — one code path, one program."""
+        if op == OP_TABLES:
+            return pool, self.to_global(arrays["tables"]), None
+        if op == OP_PAGED_STEP:
+            fn = self._get_jit(op, pool)
+            out = fn(params, pool, tables, self.to_global(arrays["ints"]),
+                     self.to_global(arrays["keys"]),
+                     self.to_global(arrays["temps"]),
+                     int(statics["k"]), bool(statics["sampling"]))
+            return out[0], tables, out
+        if op == OP_SPEC_STEP:
+            fn = self._get_jit(op, pool)
+            out = fn(params, pool, tables,
+                     self.to_global(arrays["chunk"]),
+                     self.to_global(arrays["ints"]),
+                     self.to_global(arrays["keys"]),
+                     self.to_global(arrays["temps"]),
+                     int(statics["k"]), bool(statics["sampling"]))
+            return out[0], tables, out
+        if op == OP_COW:
+            fn = self._get_jit(op, pool)
+            new_pool = fn(pool, self.to_global(arrays["src"]),
+                          self.to_global(arrays["dst"]))
+            return new_pool, tables, new_pool
+        if op == OP_PREFILL:
+            fn = self._get_jit(op, pool)
+            out = fn(params, pool, self.to_global(arrays["table"]),
+                     self.to_global(arrays["chunk"]),
+                     self.to_global(arrays["positions"]))
+            return out[0], tables, out
+        raise ValueError(f"unknown plan op {op!r}")
+
+
+class _Shaped:
+    """Shape-only stand-in so serve_pool_spec (which reads ndim via
+    ``.shape``) works before any array exists."""
+
+    def __init__(self, shape):
+        self.shape = shape
+
+
+def _index_shape(shape: tuple, idx) -> tuple:
+    """Concrete shard shape for an Index tuple over ``shape``."""
+    out = []
+    for dim, sl in zip(shape, idx):
+        start, stop, step = sl.indices(dim)
+        out.append(max(0, (stop - start + (step - 1)) // step))
+    return tuple(out)
+
+
+class MeshPlacement:
+    """The engine-facing seam for multi-host serving: same ``wrap`` /
+    ``globalize`` / ``put_tables`` surface as LocalPlacement, but every
+    program is sharded over the tp mesh and every per-call host array is
+    broadcast to the worker processes first."""
+
+    is_mesh = True
+
+    def __init__(self, config, mesh=None, *, bus: Optional[mp_plan.PlanBus]
+                 = None):
+        import jax
+
+        from k8s_tpu.parallel.sharding import check_serve_tp_config
+
+        self.mesh = mesh if mesh is not None else build_serve_mesh()
+        self.tp = int(self.mesh.shape.get("tp", 1))
+        check_serve_tp_config(config, self.tp)
+        self.config = config
+        self._bus = bus
+        self._progs = MeshPrograms(
+            placement_lib.PagedCompute(config, apply_mesh=self.mesh),
+            self.mesh)
+        self._num_processes = jax.process_count()
+
+    @classmethod
+    def from_env(cls, config) -> "MeshPlacement":
+        """The serving pod's placement: mesh over the already-initialized
+        ``jax.distributed`` world (launcher env contract), plan bus bound
+        on ``K8S_TPU_SERVE_PLAN_PORT`` when there are workers to feed."""
+        import jax
+
+        bus = None
+        if jax.process_count() > 1:
+            port = int(os.environ.get(ENV_PLAN_PORT, "0") or 0)
+            # bind ALL interfaces: in a real multi-pod gang the workers
+            # dial the chief POD's hostname (the coordinator host), not
+            # loopback — a 127.0.0.1 bind would strand every worker in
+            # connect-retry until the gang crash-loops
+            bus = mp_plan.PlanBus(jax.process_count() - 1, host="",
+                                  port=port)
+            bus.accept_workers()
+        return cls(config, bus=bus)
+
+    def info(self) -> dict:
+        return {
+            "num_processes": self._num_processes,
+            "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()
+                           if int(v) > 1} or {"tp": 1},
+            "tp_degree": self.tp,
+            "placement": "mesh",
+        }
+
+    # ------------------------------------------------------------- seam API
+
+    def _broadcast(self, op: str, statics: dict, arrays: dict) -> None:
+        if self._bus is not None:
+            self._bus.broadcast(op, statics, arrays)
+
+    def wrap(self, op: str, fn: Callable, *, donate_argnums=(),
+             static_argnums=(), resident_argnums=()) -> Callable:
+        """A callable with ``fn``'s signature that broadcasts the
+        per-call host plan (everything not resident/static) and executes
+        the sharded program.  ``fn`` itself is ignored: the sharded
+        programs compile the same PagedCompute bodies (one compute, one
+        math — the local jit and the mesh jit can't drift)."""
+        del fn, donate_argnums, static_argnums, resident_argnums
+        progs = self._progs
+
+        if op == OP_PAGED_STEP:
+            def step(params, pool, tables, ints, keys, temps, k, sampling):
+                msg = {"ints": ints, "keys": keys, "temps": temps}
+                self._broadcast(op, {"k": int(k),
+                                     "sampling": bool(sampling)}, msg)
+                _, _, out = progs.execute(
+                    op, {"k": k, "sampling": sampling}, msg,
+                    params, pool, tables)
+                return out
+            return step
+        if op == OP_SPEC_STEP:
+            def spec(params, pool, tables, chunk, ints, keys, temps, k,
+                     sampling):
+                msg = {"chunk": chunk, "ints": ints, "keys": keys,
+                       "temps": temps}
+                self._broadcast(op, {"k": int(k),
+                                     "sampling": bool(sampling)}, msg)
+                _, _, out = progs.execute(
+                    op, {"k": k, "sampling": sampling}, msg,
+                    params, pool, tables)
+                return out
+            return spec
+        if op == OP_COW:
+            def cow(pool, src, dst):
+                msg = {"src": np.int32(src), "dst": np.int32(dst)}
+                self._broadcast(op, {}, msg)
+                new_pool, _, _ = progs.execute(op, {}, msg,
+                                               None, pool, None)
+                return new_pool
+            return cow
+        if op == OP_PREFILL:
+            def prefill(params, pool, table, chunk, positions):
+                msg = {"table": table, "chunk": chunk,
+                       "positions": positions}
+                self._broadcast(op, {}, msg)
+                _, _, out = progs.execute(op, {}, msg,
+                                          params, pool, None)
+                return out
+            return prefill
+        raise ValueError(
+            f"mesh placement has no program for op {op!r} (windowed "
+            "dense configs are single-host)")
+
+    def globalize_params(self, params):
+        from k8s_tpu.parallel.sharding import serve_tp_param_specs
+
+        return self._progs.globalize(params, serve_tp_param_specs(params))
+
+    def build_pool(self, pool_shapes):
+        """Build the head-sharded zero pool from its shape manifest and
+        tell the workers — the ``init`` message every follower builds
+        its own pool from (no pool bytes cross the wire: zeros are
+        zeros on every host, and no host — chief included — ever
+        materializes a full-size leaf)."""
+        manifest = _tree_manifest(pool_shapes)
+        self._broadcast(OP_INIT, {"pool": manifest}, {})
+        return self._progs.zeros_pool(manifest)
+
+    def put_tables(self, stack):
+        self._broadcast(OP_TABLES, {}, {"tables": stack})
+        return self._progs.to_global(stack)
+
+    def close(self) -> None:
+        if self._bus is not None:
+            self._bus.close()
+
+
+# ---------------------------------------------------------------- follower
+
+def local_fraction(tree) -> float:
+    """MEASURED per-host share of a global-array pytree: addressable
+    shard elements over global elements.  ~1/N for the head-sharded
+    pool, between 1/N and 1 for params (replicated embedding/norms) —
+    the bench asserts on this, not on the spec functions, so a
+    regression that silently replicates the pool at runtime fails."""
+    import jax
+
+    total = 0
+    local = 0
+    for leaf in jax.tree.leaves(tree):
+        total += leaf.size
+        local += sum(s.data.size for s in leaf.addressable_shards)
+    return local / max(total, 1)
+
+
+def follower_loop(config, params, *, chief_host: str = "127.0.0.1",
+                  plan_port: Optional[int] = None) -> int:
+    """Worker-process main loop: build the same mesh/params the chief
+    holds, then replay plan messages until the chief says bye (exit 0)
+    or the stream dies (exit 1 — the gang restarts whole).  Returns the
+    exit code; prints one ``SERVE_MP_WORKER {json}`` line with the
+    per-process compile audit on clean shutdown."""
+    import jax
+
+    mesh = build_serve_mesh()
+    from k8s_tpu.models.decode import prefill_buckets_for
+    from k8s_tpu.parallel.sharding import (
+        check_serve_tp_config,
+        serve_tp_param_specs,
+    )
+
+    tp = int(mesh.shape.get("tp", 1))
+    check_serve_tp_config(config, tp)
+    progs = MeshPrograms(
+        placement_lib.PagedCompute(config, apply_mesh=mesh), mesh,
+        ledger=True, prefill_budget=len(prefill_buckets_for(config)))
+    params_g = progs.globalize(params, serve_tp_param_specs(params))
+    port = plan_port if plan_port is not None \
+        else int(os.environ.get(ENV_PLAN_PORT, "0") or 0)
+    follower = mp_plan.PlanFollower(chief_host, port)
+    pool = None
+    tables = None
+    steps = 0
+    pool_frac = None
+    try:
+        while True:
+            try:
+                op, statics, arrays = follower.recv()
+            except mp_plan.PlanBusClosed as e:
+                if e.clean:
+                    audit = progs.ledger_audit()
+                    print("SERVE_MP_WORKER " + json.dumps({
+                        "process_id": jax.process_index(),
+                        "ops": steps,
+                        "compile_ledger": audit,
+                        # MEASURED per-host memory shares (what the
+                        # bench asserts ~1/N on — not the spec math)
+                        "pool_local_fraction": pool_frac,
+                        "params_local_fraction": round(
+                            local_fraction(params_g), 4),
+                    }, sort_keys=True), flush=True)
+                    return 0
+                log.error("plan bus died (chief crashed?): %s", e)
+                return 1
+            if op == OP_INIT:
+                pool = progs.zeros_pool(statics["pool"])
+                pool_frac = round(local_fraction(pool), 4)
+                continue
+            pool, tables, _ = progs.execute(op, statics, arrays,
+                                            params_g, pool, tables)
+            steps += 1
+    finally:
+        follower.close()

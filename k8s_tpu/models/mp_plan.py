@@ -1,0 +1,227 @@
+"""Chief→worker batch-plan bus for multi-host serving (ISSUE 14).
+
+The multi-host engine keeps ALL scheduling host-side on one chief
+process (slot admission, block tables, batch-plan ints); worker
+processes only run device programs.  In a JAX multi-process world every
+process must dispatch the SAME jitted computation with the SAME global
+arrays each step — so before the chief dispatches, it broadcasts the
+per-step plan (opcode + static args + the host numpy arrays) here, and
+each worker replays it verbatim.  Per-step traffic is a few hundred
+bytes of ints (slot/table/position/token ids); the model, the KV pool,
+and every activation stay on device.
+
+Stdlib only (socket + struct + json), same discipline as the router and
+fleet planes.  Wire format per message::
+
+    [4-byte big-endian header length][header json][raw array bytes...]
+
+where the header is ``{"op": str, "statics": {...}, "arrays":
+[[name, dtype, shape], ...]}`` and the array payloads follow in header
+order, C-contiguous.  The stream is strictly ordered; workers execute
+in receive order, so chief and workers always dispatch the same program
+sequence (the device layer then enforces lockstep through its own
+collectives).
+
+Failure semantics are the gang's: a chief crash closes the TCP stream,
+every worker's ``recv()`` raises :class:`PlanBusClosed`, and the worker
+exits NONZERO — the operator's whole-gang restart policy takes it from
+there (a half-dead serving gang, like a half-dead SPMD training gang,
+can only hang).  A deliberate shutdown sends the ``bye`` op first so
+workers exit 0.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+from typing import Optional
+
+import numpy as np
+
+from k8s_tpu.analysis import checkedlock
+
+log = logging.getLogger(__name__)
+
+OP_BYE = "bye"
+_HDR = struct.Struct(">I")
+# plan messages are tiny; anything past this is a protocol bug, not a
+# big batch (guards a worker against interpreting a garbage/misaligned
+# stream as a multi-GB allocation)
+MAX_HEADER = 1 << 20
+MAX_ARRAY_BYTES = 1 << 28
+
+
+class PlanBusClosed(ConnectionError):
+    """The plan stream ended: deliberate ``bye`` or a dead chief."""
+
+    def __init__(self, msg: str, *, clean: bool):
+        super().__init__(msg)
+        self.clean = clean
+
+
+def _encode(op: str, statics: dict, arrays: dict[str, np.ndarray]
+            ) -> bytes:
+    metas = []
+    payloads = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        if arr.nbytes > MAX_ARRAY_BYTES:
+            raise ValueError(f"plan array {name} too large: {arr.nbytes}")
+        metas.append([name, str(arr.dtype), list(arr.shape)])
+        payloads.append(arr.tobytes())
+    header = json.dumps({"op": op, "statics": statics,
+                         "arrays": metas}).encode()
+    if len(header) > MAX_HEADER:
+        raise ValueError(f"plan header too large: {len(header)}")
+    return _HDR.pack(len(header)) + header + b"".join(payloads)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise PlanBusClosed(
+                "plan bus stream ended mid-message (chief gone)",
+                clean=False)
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _decode(sock: socket.socket) -> tuple[str, dict, dict]:
+    (hlen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if hlen > MAX_HEADER:
+        raise PlanBusClosed(f"bad plan header length {hlen}", clean=False)
+    header = json.loads(_recv_exact(sock, hlen))
+    arrays: dict[str, np.ndarray] = {}
+    for name, dtype, shape in header["arrays"]:
+        n = int(np.dtype(dtype).itemsize * int(np.prod(shape or [1])))
+        if n > MAX_ARRAY_BYTES:
+            raise PlanBusClosed(f"bad plan array size {n}", clean=False)
+        raw = _recv_exact(sock, n) if n else b""
+        arrays[name] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+    return header["op"], header.get("statics") or {}, arrays
+
+
+def mp_closed_during_accept() -> PlanBusClosed:
+    return PlanBusClosed("plan bus closed during worker accept",
+                         clean=True)
+
+
+class PlanBus:
+    """Chief side: accept one connection per worker, then broadcast
+    plan messages in step order.  All sends happen on the engine thread;
+    ``close()`` (any thread) sends ``bye`` once and tears down."""
+
+    def __init__(self, num_workers: int, host: str = "127.0.0.1",
+                 port: int = 0, accept_timeout: float = 120.0):
+        """``host`` is the BIND address: loopback for same-host gangs
+        (tests, the local driver); the serving chief binds all
+        interfaces (``""``) so workers on other pods can dial the
+        chief pod's hostname — MeshPlacement.from_env does that."""
+        self._lock = checkedlock.make_lock("mp.planbus")
+        self._listener = socket.create_server((host, port))
+        self.port = self._listener.getsockname()[1]
+        self.num_workers = num_workers
+        self._conns: list[socket.socket] = []
+        self._closed = False
+        self._accept_timeout = accept_timeout
+
+    def accept_workers(self) -> None:
+        """Block until every worker has dialed in (workers connect right
+        after ``jax.distributed`` init, so this bounds gang bring-up,
+        not steady state).  The accept socket is only ever touched here;
+        the shared connection list is mutated under the bus lock."""
+        self._listener.settimeout(self._accept_timeout)
+        accepted = 0
+        try:
+            while accepted < self.num_workers:
+                conn, addr = self._listener.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                accepted += 1
+                log.info("plan bus: worker %d/%d connected from %s",
+                         accepted, self.num_workers, addr)
+                with self._lock:
+                    if self._closed:
+                        conn.close()
+                        raise mp_closed_during_accept()
+                    self._conns.append(conn)
+        except socket.timeout:
+            raise TimeoutError(
+                f"plan bus: only {accepted}/{self.num_workers} "
+                "workers connected before the accept timeout") from None
+
+    def broadcast(self, op: str, statics: Optional[dict] = None,
+                  arrays: Optional[dict] = None) -> None:
+        data = _encode(op, statics or {}, arrays or {})
+        with self._lock:
+            if self._closed:
+                raise PlanBusClosed("plan bus closed", clean=True)
+            for conn in self._conns:
+                conn.sendall(data)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for conn in self._conns:
+                try:
+                    conn.sendall(_encode(OP_BYE, {}, {}))
+                except OSError:
+                    pass  # worker already gone; the gang policy covers it
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns = []
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class PlanFollower:
+    """Worker side: one blocking connection to the chief's bus.
+
+    ``recv()`` returns ``(op, statics, arrays)`` in stream order;
+    raises :class:`PlanBusClosed` with ``clean=True`` on ``bye`` and
+    ``clean=False`` when the stream dies (chief crash) — the worker
+    main converts the latter into a NONZERO exit so the gang supervisor
+    restarts the whole serving gang instead of leaving orphans parked
+    inside a collective."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 120.0,
+                 retry_interval: float = 0.1):
+        import time as _time
+
+        deadline = _time.monotonic() + connect_timeout
+        last: Optional[Exception] = None
+        self._sock: Optional[socket.socket] = None
+        while _time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection(
+                    (host, port), timeout=connect_timeout)
+                break
+            except OSError as e:  # chief still binding: retry
+                last = e
+                _time.sleep(retry_interval)
+        if self._sock is None:
+            raise ConnectionError(
+                f"plan bus: could not reach chief at {host}:{port}: {last}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)  # steady state blocks on the stream
+
+    def recv(self) -> tuple[str, dict, dict]:
+        op, statics, arrays = _decode(self._sock)
+        if op == OP_BYE:
+            raise PlanBusClosed("chief said bye", clean=True)
+        return op, statics, arrays
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -153,3 +153,89 @@ def paged_attention(q, pool_k, pool_v, tables, lengths, positions, *,
     out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(values.dtype),
                      values)
     return out.reshape(B, Lc, H, D)
+
+
+# ------------------------------------------------- tensor-parallel islands
+#
+# The multi-host serving placement (ISSUE 14) shards the KV pool along
+# the kv-head axis over the mesh's ``tp`` axis: each host holds ITS head
+# slice of every block, addressed by the SAME block tables the chief's
+# scheduler maintains.  Attention is embarrassingly parallel over heads
+# (per-head softmax, no cross-head reduction), so both the pool write
+# and the attention read are shard_map'd with ZERO collectives — the
+# islands exist to PIN the sharding: left to GSPMD's solver, the
+# table-order block gather on a replicated-table / sharded-pool operand
+# is exactly the kind of op that can lower to an all-gather of the pool,
+# which would silently re-materialize per-host the one tensor this
+# placement exists to split.  The bodies are the single-device reference
+# functions above, called per shard — numerics are identical per head,
+# so a tp mesh can never change a sampled token through attention.
+# (The surrounding o_proj/down_proj partial-sum psums are GSPMD's job,
+# outside these islands.)
+
+def _head_spec(P):
+    return P(None, None, "tp", None)
+
+
+def paged_kv_write_tp(mesh, leaf, tables, positions, x, *,
+                      scale_leaf=None, quantize: bool = False):
+    """:func:`paged_kv_write` over a kv-head-sharded pool: the scatter
+    indexes only (block, offset) — never the head axis — so each shard
+    writes its own head slice locally (no collectives)."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    hs = _head_spec(P)
+    rep = P()
+    if quantize:
+        body = partial(shard_map,
+                       mesh=mesh,
+                       in_specs=(hs, rep, rep, hs, P(None, None, "tp")),
+                       out_specs=(hs, P(None, None, "tp")))(
+            lambda lf, tb, ps, xx, sc: paged_kv_write(
+                lf, tb, ps, xx, scale_leaf=sc, quantize=True))
+        return body(leaf, tables, positions, x, scale_leaf)
+    body = partial(shard_map,
+                   mesh=mesh,
+                   in_specs=(hs, rep, rep, hs),
+                   out_specs=hs)(
+        lambda lf, tb, ps, xx: paged_kv_write(lf, tb, ps, xx)[0])
+    return body(leaf, tables, positions, x), None
+
+
+def paged_attention_tp(mesh, q, pool_k, pool_v, tables, lengths,
+                       positions, *, k_scale=None, v_scale=None,
+                       dtype=None, mask_value: float = MASK_VALUE):
+    """:func:`paged_attention` sharded over the ``tp`` mesh axis: query
+    heads and pool kv-heads split together (grouped-query ratios are
+    preserved per shard), tables/lengths/positions replicated, output
+    head-sharded for the row-sharded o_proj that follows.  Per-head math
+    is the reference body verbatim — no collective runs inside."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    hs = _head_spec(P)
+    rep = P()
+    if k_scale is not None:
+        body = partial(
+            shard_map, mesh=mesh,
+            in_specs=(hs, hs, hs, rep, rep, rep,
+                      P(None, None, "tp"), P(None, None, "tp")),
+            out_specs=hs)(
+            lambda qq, pk, pv, tb, ln, ps, ks, vs: paged_attention(
+                qq, pk, pv, tb, ln, ps, k_scale=ks, v_scale=vs,
+                dtype=dtype, mask_value=mask_value))
+        return body(q, pool_k, pool_v, tables, lengths, positions,
+                    k_scale, v_scale)
+    body = partial(
+        shard_map, mesh=mesh,
+        in_specs=(hs, hs, hs, rep, rep, rep),
+        out_specs=hs)(
+        lambda qq, pk, pv, tb, ln, ps: paged_attention(
+            qq, pk, pv, tb, ln, ps, dtype=dtype,
+            mask_value=mask_value))
+    return body(q, pool_k, pool_v, tables, lengths, positions)

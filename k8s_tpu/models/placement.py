@@ -1,0 +1,401 @@
+"""Placement-agnostic compute seam for the serving engine (ISSUE 14).
+
+The engine (models/engine.py) is two layers that used to be welded
+together: a host-side scheduler (slot admission, block-pool bookkeeping,
+batch-plan construction — pure Python over numpy) and a set of jitted
+device programs (batched decode step, variable-width spec verify,
+chunked prefill, copy-on-write).  This module is the seam between them:
+
+- :class:`PagedCompute` holds the pure jittable bodies — exactly the
+  math the engine's ``_paged_step_impl`` / ``_spec_step_impl`` /
+  prefill / CoW closures used to carry, moved verbatim so they can be
+  compiled under ANY placement.  Everything above these functions (the
+  transformer, the ``paged_attention`` seam) is untouched.
+- :class:`LocalPlacement` compiles them with plain ``jax.jit`` on the
+  default device — byte-for-byte today's single-host path: same
+  donation, same static arguments, same program inventory, same
+  compile-ledger seams.
+- ``MeshPlacement`` (models/mesh_serve.py) compiles the SAME bodies
+  over a multi-device / multi-process mesh: parameters tensor-sharded
+  over the ``tp`` axis, the KV block pool sharded along the head axis
+  (each host holds its head slice of every block, addressed by the SAME
+  block tables), batch-plan ints replicated.  The chief process runs
+  the scheduler unchanged; worker processes replay the per-step plan
+  broadcast over the plan bus (models/mp_plan.py).
+
+The seam contract the engine relies on:
+
+- ``wrap(op, fn, ...)`` returns a callable with ``fn``'s signature.
+  ``resident_argnums`` marks device-resident state (params, pool,
+  tables) that survives between calls on every process; every other
+  array argument is per-call host plan data (numpy) that a mesh
+  placement must broadcast before executing.
+- ``put_tables(np_stack)`` uploads the slot block tables; the returned
+  handle is passed back through a resident argument slot.
+- Host plan arguments are NUMPY; the placement owns the host→device
+  transfer (plain jit accepts numpy directly, so the local path pays
+  exactly what it always paid).
+- Outputs that the engine reads (sampled tokens, PRNG keys, last-chunk
+  logits, acceptance counts) come back fully replicated so
+  ``np.asarray`` works identically on a single device and on a
+  multi-process mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Mapping
+
+log = logging.getLogger(__name__)
+
+
+def env_mesh() -> int:
+    """K8S_TPU_SERVE_MESH: number of processes in the serving mesh
+    (0/unset = single-host LocalPlacement; >= 1 = MeshPlacement over
+    ``jax.process_count()`` processes — the launcher env contract
+    brings the world up before the server constructs the engine)."""
+    raw = os.environ.get("K8S_TPU_SERVE_MESH", "").strip()
+    try:
+        return max(0, int(raw)) if raw else 0
+    except ValueError:
+        log.warning("ignoring non-integer K8S_TPU_SERVE_MESH=%r", raw)
+        return 0
+
+
+def env_tp() -> int:
+    """K8S_TPU_SERVE_TP: tensor-parallel degree over the serving mesh
+    (0/unset = all visible devices)."""
+    raw = os.environ.get("K8S_TPU_SERVE_TP", "").strip()
+    try:
+        return max(0, int(raw)) if raw else 0
+    except ValueError:
+        log.warning("ignoring non-integer K8S_TPU_SERVE_TP=%r", raw)
+        return 0
+
+
+def _is_cache_node(node) -> bool:
+    # detect by k/v (not pos): the POOL's cache nodes carry no pos leaf —
+    # validity is synthesized from row lengths at view time
+    return isinstance(node, Mapping) and "k" in node and "v" in node \
+        and not isinstance(node["k"], Mapping)
+
+
+def map_cache(tree, fn):
+    """Rebuild a cache pytree applying ``fn`` to every attention cache
+    node (the dict holding the k/v/pos(/scale) leaves)."""
+    if _is_cache_node(tree):
+        return fn(tree)
+    if isinstance(tree, Mapping):
+        return {k: map_cache(v, fn) for k, v in tree.items()}
+    return tree
+
+
+class PagedCompute:
+    """The engine's pure jittable compute bodies over one transformer.
+
+    Every method is placement-free: it sees params / pool / plan arrays
+    and returns new arrays.  The engine compiles them through a
+    :class:`LocalPlacement` (plain jit — today's path) or a mesh
+    placement (sharded jit + shard_map'd paged attention); the math is
+    the same object either way, so the single-host and multi-host
+    engines can never drift apart numerically.
+
+    ``apply_mesh`` threads a device mesh into the transformer's
+    ``Attention`` module, which routes the paged decode path through the
+    shard_map'd ``paged_attention_tp`` island (models/paged.py) when the
+    mesh carries a tp axis > 1 — the transformer body above that seam is
+    untouched.
+    """
+
+    def __init__(self, config, *, apply_mesh=None):
+        from k8s_tpu.models.transformer import Transformer
+
+        self.config = config
+        self.model = Transformer(config)
+        self.apply_mesh = apply_mesh
+
+    # ---------------------------------------------------- cache helpers
+
+    def paged_cache(self, pool, tables, lens):
+        """Attach the per-row block ``table`` and written-``len`` bound
+        to every pool cache node: the collection the transformer's paged
+        decode path consumes (write straight into pool blocks, attend
+        behind the ``paged_attention`` seam)."""
+        def build(node):
+            return {**node, "table": tables, "len": lens}
+
+        return map_cache(pool, build)
+
+    @staticmethod
+    def pool_from_cache(cache):
+        """Strip the table/len addressing back off a returned cache
+        collection, leaving just the pool leaves."""
+        def strip(node):
+            return {k: v for k, v in node.items()
+                    if k not in ("table", "len")}
+
+        return map_cache(cache, strip)
+
+    def init_cache(self, params, batch: int):
+        """Batched dense cache pytree for ``batch`` rows, every slot
+        invalid: zeros for K/V(/scale) leaves, -1 for every ``pos`` leaf
+        (the mask keys validity off ``pos``, so nothing is reachable) —
+        exactly the flax cache init + pos reset, built from the
+        eval_shape skeleton so no eager device apply runs (a mesh
+        placement cannot run eager ops over global params)."""
+        import jax
+        import jax.numpy as jnp
+
+        def build(p):
+            toks = jnp.zeros((batch, 1), jnp.int32)
+            pos = jnp.zeros((batch, 1), jnp.int32)
+            _, varz = self.model.apply(
+                {"params": p}, toks, positions=pos, mode="decode",
+                mutable=["cache"])
+            return varz["cache"]
+
+        shapes = jax.eval_shape(build, params)
+
+        def materialize(path_key, leaf):
+            if path_key == "pos":
+                return jnp.full(leaf.shape, -1, leaf.dtype)
+            return jnp.zeros(leaf.shape, leaf.dtype)
+
+        def rec(node):
+            if isinstance(node, Mapping):
+                return {k: (materialize(k, v)
+                            if hasattr(v, "shape")
+                            and not isinstance(v, Mapping) else rec(v))
+                        for k, v in node.items()}
+            return node
+
+        return rec(shapes)
+
+    def pool_manifest(self, params, pool_blocks: int, block_size: int):
+        """Shape/dtype skeleton of the block-granular KV pool: every
+        dense-cache K/V(/scale) leaf ``[1, S, ...]`` becomes a
+        ``[num_blocks, block_size, ...]`` ShapeDtypeStruct.  No pos
+        leaf is pooled: validity is synthesized from each row's written
+        length at view time, so recycled blocks need no reset pass and
+        stale content is unreachable by construction.  NOTHING is
+        materialized here — the placement builds the zero pool from
+        this skeleton (shard-by-shard on a mesh, so no host ever holds
+        a full-size leaf: the 1/N-memory point of multi-host serving
+        must hold on the chief too)."""
+        import jax
+        import jax.numpy as jnp
+
+        def build_shapes(p):
+            toks = jnp.zeros((1, 1), jnp.int32)
+            pos = jnp.zeros((1, 1), jnp.int32)
+            _, varz = self.model.apply(
+                {"params": p}, toks, positions=pos, mode="decode",
+                mutable=["cache"])
+            return varz["cache"]
+
+        template = jax.eval_shape(build_shapes, params)
+        N, blk = pool_blocks, block_size
+
+        def build(node):
+            return {k: jax.ShapeDtypeStruct(
+                (N, blk) + tuple(v.shape[2:]), v.dtype)
+                for k, v in node.items() if k != "pos"}
+
+        return map_cache(template, build)
+
+    # ---------------------------------------------------- step programs
+
+    def paged_step(self, params, pool, tables, ints, keys, temps,
+                   k: int, sampling: bool):
+        """``k`` fused batched decode iterations over the block pool
+        (``k`` is jit-static, bounded by the engine's MAX_STEP_TOKENS):
+        feed each row's last token at its own position, sample/argmax
+        per row from its own distribution (decode.sample_logits_rows —
+        the exclusive lane's exact key schedule, one split per emitted
+        token), carry the POOL itself through a scan.  K/V writes
+        scatter straight into each row's blocks inside the model call
+        and attention indexes the pool through the block tables behind
+        the ``paged_attention`` seam — nothing is gathered into a
+        per-row view or written back.  ``ints`` packs [toks, poss,
+        topks] into one [3, B] transfer; a row's position doubles as its
+        written length for validity masking.  Inactive rows ride at
+        position -1: their writes are dropped before they reach the
+        pool."""
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_tpu.models.decode import sample_logits_rows
+
+        toks0, poss0, topks = ints[0], ints[1], ints[2]
+
+        def body(carry, _):
+            pool, toks, poss, kk = carry
+            cache = self.paged_cache(pool, tables, jnp.maximum(poss, 0))
+            logits, varz = self.model.apply(
+                {"params": params, "cache": cache}, toks[:, None],
+                positions=poss[:, None], mode="decode",
+                mutable=["cache"], mesh=self.apply_mesh)
+            pool = self.pool_from_cache(varz["cache"])
+            if sampling:
+                new_keys, nxt = sample_logits_rows(logits[:, -1], kk,
+                                                   temps, topks)
+            else:
+                # all-greedy batch: the raw-dtype argmax the exclusive
+                # lane takes at temperature 0; no key ever advances
+                # because no row will ever draw from one
+                new_keys = kk
+                nxt = jnp.argmax(logits[:, -1],
+                                 axis=-1).astype(jnp.int32)
+            act = poss >= 0
+            return (pool, jnp.where(act, nxt, toks),
+                    jnp.where(act, poss + 1, poss), new_keys), nxt
+
+        (pool, _, _, keys_out), toks_all = jax.lax.scan(
+            body, (pool, toks0, poss0, keys), None, length=k)
+        return pool, toks_all, keys_out  # toks_all [k, B]
+
+    def spec_step(self, params, pool, tables, chunk, ints, keys,
+                  temps, k: int, sampling: bool):
+        """ONE write-masked variable-width batched step (``k`` = the
+        jit-static chunk width W): every participating slot feeds its
+        own row of ``chunk`` [B, W] — a speculative slot its last token
+        plus ``draft_k - 1`` prompt-lookup drafts (width W), a plain
+        slot just its last token (width 1) — at per-slot positions.
+        Lanes past a row's width ride at position -1, so their K/V
+        writes are DROPPED before reaching the pool (the write mask: a
+        mixed-width batch can never scribble past a short row's block
+        capacity) and their queries attend nothing.  Accept/reject runs
+        row-wise in decode.spec_verify_rows with the exclusive lane's
+        exact per-iteration key schedule.  ``ints`` packs [poss, widths,
+        topks]; returns (pool, emit [B, W], n_emit [B], new_keys)."""
+        import jax.numpy as jnp
+
+        from k8s_tpu.models.decode import spec_verify_rows
+
+        poss, widths, topks = ints[0], ints[1], ints[2]
+        ar = jnp.arange(k, dtype=jnp.int32)
+        cpos = jnp.where(
+            (poss >= 0)[:, None] & (ar[None, :] < widths[:, None]),
+            poss[:, None] + ar[None, :], -1)  # [B, W]; -1 = write-masked
+        cache = self.paged_cache(pool, tables, jnp.maximum(poss, 0))
+        logits, varz = self.model.apply(
+            {"params": params, "cache": cache}, chunk,
+            positions=cpos, mode="decode", mutable=["cache"],
+            mesh=self.apply_mesh)
+        pool = self.pool_from_cache(varz["cache"])
+        new_keys, emit, n_emit = spec_verify_rows(
+            logits, chunk, keys, temps, topks, widths, sampling)
+        return pool, emit, n_emit, new_keys
+
+    def cow(self, pool, src, dst):
+        """Copy-on-write at the divergence block: duplicate block
+        ``src`` into the private block ``dst``.  Only the shared prefix
+        of the run is ever valid for the attaching row (validity is
+        length-based); the divergent tail is overwritten by its own
+        prefill before the row's length reaches it."""
+        def cw(node):
+            return {k: v.at[dst].set(v[src]) for k, v in node.items()}
+
+        return map_cache(pool, cw)
+
+    def prefill_paged(self, params, pool, table, chunk, positions):
+        """One chunked decode-mode prefill call writing straight into
+        the request's pool blocks through its table (the
+        paged_attention seam).  Written length BEFORE this chunk = its
+        first position (chunks land in order)."""
+        cache = self.paged_cache(pool, table[None, :], positions[:, 0])
+        logits, varz = self.model.apply(
+            {"params": params, "cache": cache}, chunk,
+            positions=positions, mode="decode", mutable=["cache"],
+            mesh=self.apply_mesh)
+        return self.pool_from_cache(varz["cache"]), logits[:, -1]
+
+    def prefill_dense(self, params, cache, chunk, positions):
+        """Dense-mode batch-1 row-cache prefill (scattered into the slot
+        later by :meth:`scatter`)."""
+        logits, varz = self.model.apply(
+            {"params": params, "cache": cache}, chunk,
+            positions=positions, mode="decode", mutable=["cache"])
+        return varz["cache"], logits[:, -1]
+
+    def dense_step(self, params, cache, toks, poss, keys, temps,
+                   topks, sampling: bool):
+        """One batched decode step over the dense per-slot rows
+        (windowed fallback): same row-wise sampling (or all-greedy
+        argmax fast path) as the paged step."""
+        import jax.numpy as jnp
+
+        from k8s_tpu.models.decode import sample_logits_rows
+
+        logits, varz = self.model.apply(
+            {"params": params, "cache": cache}, toks[:, None],
+            positions=poss[:, None], mode="decode", mutable=["cache"])
+        if sampling:
+            new_keys, nxt = sample_logits_rows(logits[:, -1], keys,
+                                               temps, topks)
+        else:
+            new_keys = keys
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return varz["cache"], nxt, new_keys
+
+    @staticmethod
+    def scatter(cache, row, idx):
+        """Replace batch row ``idx`` of every cache leaf with the
+        freshly prefilled batch-1 row (dense-mode slot join)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda full, r: full.at[idx].set(r[0]), cache, row)
+
+
+class LocalPlacement:
+    """Single-device placement: plain ``jax.jit`` on the default device
+    — the engine's original compile path, program for program.  Every
+    method is the identity where a mesh placement would shard,
+    broadcast, or assemble."""
+
+    is_mesh = False
+    mesh = None
+
+    def info(self) -> dict:
+        """Mesh identity for stats()/healthz: a single-host engine is a
+        1-process, tp=1 'mesh' so the fleet plane reads one schema."""
+        return {"num_processes": 1, "mesh_shape": {}, "tp_degree": 1,
+                "placement": "local"}
+
+    def wrap(self, op: str, fn: Callable, *, donate_argnums=(),
+             static_argnums=(), resident_argnums=()) -> Callable:
+        """Compile ``fn`` for this placement.  ``op`` names the program
+        for plan-bus replay (unused locally); ``resident_argnums`` marks
+        device-resident state (unused locally — jit takes every argument
+        by value either way)."""
+        import jax
+
+        del op, resident_argnums
+        return jax.jit(fn, donate_argnums=donate_argnums,
+                       static_argnums=static_argnums)
+
+    def globalize_params(self, params):
+        """Params as the step programs consume them (sharded on a mesh;
+        untouched locally)."""
+        return params
+
+    def build_pool(self, manifest):
+        """The zero KV pool from its shape manifest (head-sharded
+        shard-by-shard on a mesh; plain device zeros locally)."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(lambda leaf: jnp.zeros(leaf.shape, leaf.dtype),
+                            manifest)
+
+    def put_tables(self, stack):
+        """Upload the [slots, max_blocks] block-table stack (broadcast
+        to every process on a mesh)."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(stack)
+
+    def close(self) -> None:
+        pass

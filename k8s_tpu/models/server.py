@@ -57,6 +57,15 @@ block pool).  ``--slots 0`` disables the engine entirely and restores
 the original one-lock single-flight path (the bench_serve baseline).
 Prompt-length compiles are bounded by the engine's bucket set instead
 of unbounded per-prompt-length.
+
+Multi-host serving (ISSUE 14, docs/serving.md "Multi-host serving"):
+with ``K8S_TPU_SERVE_MESH=N`` every pod of an N-replica serving gang
+runs THIS binary — the launcher env contract brings up
+``jax.distributed``, replica 0 serves HTTP as the chief over a
+``MeshPlacement`` (params tensor-sharded over ``K8S_TPU_SERVE_TP``,
+KV block pool head-sharded per host), and the other replicas replay
+the chief's per-step batch plan (models/mesh_serve.follower_loop),
+exiting nonzero if the chief dies so the gang restarts whole.
 """
 
 from __future__ import annotations
@@ -190,7 +199,8 @@ class LmServer:
                  queue_limit: Optional[int] = None,
                  prefix_blocks: Optional[int] = None,
                  batch_sampling: Optional[bool] = None,
-                 batch_spec: Optional[bool] = None, registry=None):
+                 batch_spec: Optional[bool] = None, registry=None,
+                 placement=None):
         from k8s_tpu.models import engine as engine_lib
         from k8s_tpu.util import metrics as metrics_mod
 
@@ -222,9 +232,15 @@ class LmServer:
             batch_spec = engine_lib.env_batch_spec()
         self.batch_spec = bool(batch_spec)
         if slots > 0:
+            # placement seam (ISSUE 14): None = single-host LocalPlacement;
+            # a MeshPlacement makes THIS server the chief of a
+            # tensor-parallel serving gang (workers run
+            # mesh_serve.follower_loop — python -m k8s_tpu.models.server
+            # routes them there when K8S_TPU_SERVE_MESH is set)
             self.engine: Optional[engine_lib.Engine] = engine_lib.Engine(
                 config, params, slots=slots, queue_limit=queue_limit,
-                prefix_blocks=prefix_blocks, metrics=self.metrics)
+                prefix_blocks=prefix_blocks, metrics=self.metrics,
+                placement=placement)
         else:
             # legacy single-flight path: one lock around all device work
             # (kept as the bench_serve baseline and an escape hatch)
@@ -292,6 +308,13 @@ class LmServer:
                     "queue_depth": 0}
         s = self.engine.stats()
         return {"engine": "continuous-batching", "slots": s["slots"],
+                # mesh identity (ISSUE 14): the fleet plane and
+                # /debug/engine can tell a tensor-sharded multi-process
+                # pod from a single-host one
+                "placement": s["placement"],
+                "num_processes": s["num_processes"],
+                "mesh_shape": s["mesh_shape"],
+                "tp_degree": s["tp_degree"],
                 "active": s["active"], "queue_depth": s["queue_depth"],
                 "queue_limit": s["queue_limit"],
                 "batch_sampling": self.batch_sampling,
@@ -639,7 +662,32 @@ def main(argv=None) -> int:
                    "speculation, the legacy routing)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    lm = LmServer(args.train_dir, kv_cache=args.kv_cache,
+    from k8s_tpu.models import placement as placement_lib
+
+    placement = None
+    mesh_kw: dict = {"train_dir": args.train_dir}
+    if placement_lib.env_mesh() > 0:
+        # multi-host serving gang (ISSUE 14): every pod of the serving
+        # TFJob runs THIS binary; the launcher env contract brings up
+        # jax.distributed, workers replay the chief's batch plan, and
+        # the chief serves HTTP over the mesh placement.  Every process
+        # loads the same artifact, so no parameter broadcast is needed.
+        from k8s_tpu.launcher import bootstrap
+        from k8s_tpu.models import mesh_serve
+        from k8s_tpu.models import serving as serving_lib
+
+        lcfg = bootstrap.initialize_distributed()
+        config, params = serving_lib.load_for_serving(
+            args.train_dir, kv_cache=args.kv_cache,
+            param_dtype=args.param_dtype)
+        if lcfg.num_processes > 1 and lcfg.process_id != 0:
+            host = lcfg.coordinator_address.rsplit(":", 1)[0] \
+                if lcfg.coordinator_address else "127.0.0.1"
+            return mesh_serve.follower_loop(config, params,
+                                            chief_host=host)
+        placement = mesh_serve.MeshPlacement.from_env(config)
+        mesh_kw = {"config": config, "params": params}
+    lm = LmServer(kv_cache=args.kv_cache,
                   param_dtype=args.param_dtype,
                   default_max_new_tokens=args.max_new_tokens,
                   slots=args.slots, queue_limit=args.queue,
@@ -647,7 +695,8 @@ def main(argv=None) -> int:
                   batch_sampling=None if args.batch_sampling is None
                   else bool(args.batch_sampling),
                   batch_spec=None if args.batch_spec is None
-                  else bool(args.batch_spec))
+                  else bool(args.batch_spec),
+                  placement=placement, **mesh_kw)
     httpd = serve(lm, args.host, args.port)
     host, port = httpd.server_address[:2]
     log.info("serving %s on http://%s:%d (POST /v1/generate)",

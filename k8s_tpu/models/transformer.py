@@ -289,6 +289,26 @@ class Attention(nn.Module):
         cvs = self.variable("cache", "v_scale", _missing) if int8 else None
         tables = self.variable("cache", "table", _missing).value
         lengths = self.variable("cache", "len", _missing).value
+        # tensor-parallel serving (ISSUE 14): with a tp>1 mesh the pool
+        # is sharded along the kv-head axis per host and both the write
+        # scatter and the attention read run inside shard_map islands
+        # (models/paged.py) — zero collectives, same per-head math, and
+        # the sharding is PINNED so GSPMD can never re-materialize the
+        # pool.  Everything above this routing is untouched.
+        tp = self.mesh.shape.get("tp", 1) if self.mesh is not None else 1
+        if tp > 1:
+            ck.value, ks = paged.paged_kv_write_tp(
+                self.mesh, ck.value, tables, positions, k,
+                scale_leaf=cks.value if int8 else None, quantize=int8)
+            cv.value, vs = paged.paged_kv_write_tp(
+                self.mesh, cv.value, tables, positions, v,
+                scale_leaf=cvs.value if int8 else None, quantize=int8)
+            if int8:
+                cks.value, cvs.value = ks, vs
+            return paged.paged_attention_tp(
+                self.mesh, q, ck.value, cv.value, tables, lengths,
+                positions, k_scale=cks.value if int8 else None,
+                v_scale=cvs.value if int8 else None, dtype=cfg.dtype)
         ck.value, ks = paged.paged_kv_write(
             ck.value, tables, positions, k,
             scale_leaf=cks.value if int8 else None, quantize=int8)

@@ -129,3 +129,79 @@ def fsdp_sharding(params: Any, mesh: Mesh) -> Any:
 
 def apply_shardings(tree: Any, shardings: Any) -> Any:
     return jax.device_put(tree, shardings)
+
+
+# ---------------------------------------------------------------- serving tp
+
+def serve_tp_spec(path: tuple, leaf) -> P:
+    """Tensor-parallel PartitionSpec for one serving-transformer param,
+    keyed on its param-tree path (ISSUE 14 — the multi-host decode
+    placement).  The Megatron split: q/k/v and gate/up column-sharded
+    over ``tp`` (heads / ffn dims), o_proj and down_proj row-sharded so
+    their matmuls produce per-shard partials XLA psums, everything that
+    operates on the replicated hidden stream (embedding, norms)
+    replicated.  The embedding stays whole on every chip: the serving
+    configs' vocab side feeds the tied-logits einsum over a replicated
+    hidden, and decode-step activations are [B, 1, ...] — replication
+    costs HBM, sharding it would cost a per-token collective."""
+    names = {str(p) for p in path}
+    ndim = len(getattr(leaf, "shape", ()))
+    if names & {"q_proj", "k_proj", "v_proj"} and ndim == 3:
+        return P(None, "tp", None)       # [hidden, heads, head_dim]
+    if "o_proj" in names and ndim == 3:
+        return P("tp", None, None)       # [heads, head_dim, hidden]
+    if names & {"gate_proj", "up_proj"} and ndim == 2:
+        return P(None, "tp")             # [hidden, ffn]
+    if "down_proj" in names and ndim == 2:
+        return P("tp", None)             # [ffn, hidden]
+    return P()
+
+
+def serve_tp_param_specs(params: Any) -> Any:
+    """PartitionSpec pytree for a serving transformer's params under
+    tensor parallelism (see :func:`serve_tp_spec`)."""
+    def spec(path, leaf):
+        return serve_tp_spec(
+            tuple(str(getattr(k, "key", k)) for k in path), leaf)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def serve_pool_spec(leaf) -> P:
+    """PartitionSpec for one KV block-pool leaf: the kv-head axis is the
+    tp axis, so each host holds ITS head slice of every block and the
+    same block tables address every shard.  ``[N, bs, kv_heads, D]``
+    K/V leaves and ``[N, bs, kv_heads]`` int8 scale leaves both shard
+    axis 2; anything else (there is nothing else today) replicates."""
+    ndim = len(getattr(leaf, "shape", ()))
+    if ndim == 4:
+        return P(None, None, "tp", None)
+    if ndim == 3:
+        return P(None, None, "tp")
+    return P()
+
+
+def serve_pool_specs(pool: Any) -> Any:
+    """PartitionSpec pytree for the serving engine's KV block pool."""
+    return jax.tree.map(serve_pool_spec, pool)
+
+
+def check_serve_tp_config(config, tp: int) -> None:
+    """The divisibility contract serving tensor parallelism needs: every
+    sharded dimension must split evenly over ``tp`` or a shard would
+    hold a ragged slice (XLA would pad, and the shard_map'd paged
+    attention island would compute on garbage lanes)."""
+    problems = []
+    if config.heads % tp:
+        problems.append(f"heads {config.heads} % tp {tp}")
+    if config.kv_heads % tp:
+        problems.append(f"kv_heads {config.kv_heads} % tp {tp}")
+    if config.ffn_hidden % tp:
+        problems.append(f"ffn_hidden {config.ffn_hidden} % tp {tp}")
+    if getattr(config, "num_experts", 0):
+        problems.append("MoE serving is single-host for now "
+                        "(expert params ride the ep axis, not tp)")
+    if problems:
+        raise ValueError(
+            "config does not shard over tp=%d: %s"
+            % (tp, "; ".join(problems)))

@@ -20,6 +20,14 @@ The ring is classic consistent hashing: ``vnodes`` points per node on a
   so a pod join/leave does not reshuffle the whole fleet's warm KV;
 - **candidate order**: ``candidates(key)`` yields every node, nearest
   first, each exactly once — the 503-retry walk visits distinct pods.
+- **weighted share** (ISSUE 14): a node added with ``weight=w`` plants
+  ``round(vnodes * w)`` points (min 1), so its expected keyspace share
+  is proportional to ``w`` — heterogeneous pod sizes (a 4-chip
+  tensor-parallel pod next to 1-chip pods) get traffic proportional to
+  capacity.  Weights flow from the ``kubeflow.org/fleet-serve-weight``
+  pod annotation through fleet discovery; a weight CHANGE re-plants
+  only that node's points (everyone else's keyspace is untouched — the
+  minimal-remap property extends to resizes).
 """
 
 from __future__ import annotations
@@ -83,16 +91,20 @@ def fingerprint_request(req: dict, block_size: int,
 class HashRing:
     """Deterministic consistent-hash ring over string node names."""
 
-    def __init__(self, nodes: Iterable[str] = (),
+    def __init__(self, nodes: Iterable = (),
                  vnodes: int = DEFAULT_VNODES):
         if vnodes < 1:
             raise ValueError(f"vnodes must be >= 1, got {vnodes}")
         self.vnodes = vnodes
         self._nodes: set[str] = set()
+        self._weights: dict[str, float] = {}
         self._points: list[int] = []     # sorted ring positions
         self._owners: list[str] = []     # owner of each position
         for n in nodes:
-            self.add(n)
+            if isinstance(n, tuple):
+                self.add(n[0], weight=n[1])
+            else:
+                self.add(n)
 
     def __len__(self) -> int:
         return len(self._nodes)
@@ -104,11 +116,26 @@ class HashRing:
     def nodes(self) -> list[str]:
         return sorted(self._nodes)
 
-    def add(self, node: str) -> None:
+    def weight(self, node: str) -> float:
+        return self._weights.get(node, 0.0)
+
+    def _npoints(self, weight: float) -> int:
+        # min 1: a present node must own SOME keyspace or lookup could
+        # never reach it even as the only member
+        return max(1, int(round(self.vnodes * weight)))
+
+    def add(self, node: str, weight: float = 1.0) -> None:
+        if weight <= 0:
+            raise ValueError(f"weight must be > 0, got {weight}")
         if node in self._nodes:
-            return
+            if self._weights.get(node) == float(weight):
+                return
+            # weight change: re-plant ONLY this node's points (minimal
+            # remap extends to resizes — nobody else's keyspace moves)
+            self.remove(node)
         self._nodes.add(node)
-        for i in range(self.vnodes):
+        self._weights[node] = float(weight)
+        for i in range(self._npoints(weight)):
             p = _point(f"{node}#{i}")
             idx = bisect.bisect_left(self._points, p)
             # sha1 collisions between distinct (node, vnode) labels are
@@ -124,21 +151,32 @@ class HashRing:
         if node not in self._nodes:
             return
         self._nodes.discard(node)
+        self._weights.pop(node, None)
         keep = [(p, o) for p, o in zip(self._points, self._owners)
                 if o != node]
         self._points = [p for p, _o in keep]
         self._owners = [o for _p, o in keep]
 
-    def replace(self, nodes: Iterable[str]) -> None:
-        """Reconcile membership to exactly ``nodes`` (minimal edits, so
-        surviving nodes keep their ring points — the minimal-remap
-        property holds across discovery refreshes, not just single
-        add/remove calls)."""
-        target = set(nodes)
-        for n in list(self._nodes - target):
+    def replace(self, nodes: Iterable) -> None:
+        """Reconcile membership to exactly ``nodes`` — names, or
+        ``(name, weight)`` pairs, or a name→weight mapping (minimal
+        edits: surviving nodes at an unchanged weight keep their ring
+        points, so the minimal-remap property holds across discovery
+        refreshes, not just single add/remove calls)."""
+        if isinstance(nodes, dict):
+            target = {str(k): float(v) for k, v in nodes.items()}
+        else:
+            target = {}
+            for n in nodes:
+                if isinstance(n, tuple):
+                    target[str(n[0])] = float(n[1])
+                else:
+                    target[str(n)] = 1.0
+        for n in list(self._nodes - set(target)):
             self.remove(n)
-        for n in sorted(target - self._nodes):
-            self.add(n)
+        for n in sorted(target):
+            if n not in self._nodes or self._weights.get(n) != target[n]:
+                self.add(n, weight=target[n])
 
     def lookup(self, key: str) -> Optional[str]:
         """The key's owner (clockwise-nearest point), or None when empty."""
@@ -182,6 +220,8 @@ class HashRing:
             "nodes": self.nodes,
             "vnodes": self.vnodes,
             "points": len(self._points),
+            "weights": {n: self._weights.get(n, 1.0)
+                        for n in self.nodes},
             "keyspace_share": {n: round(s, 4)
                                for n, s in sorted(shares.items())},
         }

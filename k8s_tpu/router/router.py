@@ -82,9 +82,9 @@ class Backend:
 
     __slots__ = ("name", "base_url", "healthy", "draining", "inflight",
                  "consecutive_failures", "last_error", "requests",
-                 "shed_until")
+                 "shed_until", "weight")
 
-    def __init__(self, name: str, base_url: str):
+    def __init__(self, name: str, base_url: str, weight: float = 1.0):
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.healthy = True
@@ -94,6 +94,9 @@ class Backend:
         self.last_error = ""
         self.requests = 0
         self.shed_until = 0.0
+        # relative capacity from discovery (the fleet-serve-weight pod
+        # annotation): scales this backend's hash-ring keyspace share
+        self.weight = weight
 
     def to_dict(self, now: float) -> dict:
         return {
@@ -102,6 +105,7 @@ class Backend:
             "healthy": self.healthy,
             "draining": self.draining,
             "inflight": self.inflight,
+            "weight": self.weight,
             "requests": self.requests,
             "consecutive_failures": self.consecutive_failures,
             "shedding": now < self.shed_until,
@@ -247,20 +251,29 @@ class Router:
             # reach this router in-process annotates the victim pod
             # (fleet.ANNOTATION_ROUTER_DRAIN) and discovery carries the
             # flag; None leaves the locally-set drain state alone
+            try:
+                weight = float(getattr(t, "weight", 1.0) or 1.0)
+            except (TypeError, ValueError):
+                weight = 1.0
             resolved[str(name)] = (_base_url(str(url)),
-                                   getattr(t, "draining", None))
+                                   getattr(t, "draining", None),
+                                   weight if weight > 0 else 1.0)
         with self._lock:
             for name in list(self._backends):
                 if name not in resolved:
                     del self._backends[name]
-            for name, (base, draining) in resolved.items():
+            for name, (base, draining, weight) in resolved.items():
                 b = self._backends.get(name)
                 if b is None:
-                    b = self._backends[name] = Backend(name, base)
+                    b = self._backends[name] = Backend(name, base,
+                                                       weight=weight)
                 elif b.base_url != base:
                     b.base_url = base
                 if draining is not None:
                     b.draining = draining
+                # a weight change (pod resized / re-annotated) re-plants
+                # only that backend's ring points on the rebuild below
+                b.weight = weight
             probe_list = [(b.name, b.base_url)
                           for b in self._backends.values() if not b.healthy]
             self._rebuild_ring_locked()
@@ -270,8 +283,9 @@ class Router:
         return count
 
     def _rebuild_ring_locked(self) -> None:
-        self._ring.replace(b.name for b in self._backends.values()
-                           if b.healthy and not b.draining)
+        self._ring.replace({b.name: b.weight
+                            for b in self._backends.values()
+                            if b.healthy and not b.draining})
 
     def _probe(self, name: str, base_url: str) -> None:
         """Active /healthz recheck of an evicted backend — success

@@ -437,11 +437,20 @@ class TestSelfAudit:
         # every in-file suppression carries a reason (the annotation
         # grammar makes reason-less markers unmatchable, but pin it)
         assert all(s["reason"] for s in report.suppressed)
-        # the engine's jitted surface is actually classified, not skipped
+        # the engine's jitted surface is actually classified, not
+        # skipped — since ISSUE 14 it lives behind the placement seam:
+        # LocalPlacement compiles through a jit factory and the mesh
+        # programs keep a per-op program table
+        assert any(s["class"] == "factory" for s in report.jit_sites
+                   if s["path"] == "k8s_tpu/models/placement.py")
         assert any(s["class"] == "program-table" for s in report.jit_sites
-                   if s["path"] == "k8s_tpu/models/engine.py")
-        assert any(w["resolved"] for w in report.wrappers
-                   if w["path"] == "k8s_tpu/models/engine.py")
+                   if s["path"] == "k8s_tpu/models/mesh_serve.py")
+        # the seam's jit targets are parameters (one compute, many
+        # placements), so wrapper->body linkage is dynamic by design;
+        # the bodies themselves stay on the audit surface through the
+        # engine loop's hot-function analysis (host-sync lint above)
+        assert any(w["path"] == "k8s_tpu/models/placement.py"
+                   for w in report.wrappers)
 
     def test_cli_runs_compile_surface_clean(self, capsys):
         from k8s_tpu.analysis.__main__ import main

@@ -251,6 +251,28 @@ class TestDiscovery:
         [t] = fleet.targets_from_pods([pod])
         assert t.url == "http://127.0.0.1:9200/stats"
 
+    def test_serve_weight_annotation(self):
+        """ISSUE 14: the fleet-serve-weight annotation rides discovery
+        to the router's weighted ring; absent/garbage/non-positive all
+        default to 1.0 rather than dropping the pod."""
+        pod = _pod()
+        pod["metadata"]["annotations"][
+            "kubeflow.org/fleet-serve-weight"] = "4.0"
+        pod["status"]["podIP"] = "10.0.0.7"
+        [t] = fleet.targets_from_pods([pod])
+        assert t.weight == 4.0
+        for bad in ("chonky", "", "-2", "0"):
+            pod = _pod()
+            pod["metadata"]["annotations"][
+                "kubeflow.org/fleet-serve-weight"] = bad
+            pod["status"]["podIP"] = "10.0.0.7"
+            [t] = fleet.targets_from_pods([pod])
+            assert t.weight == 1.0, bad
+        pod = _pod()
+        pod["status"]["podIP"] = "10.0.0.7"
+        [t] = fleet.targets_from_pods([pod])
+        assert t.weight == 1.0
+
     def test_store_index_matches_discovery_predicate(self):
         """The informer's fleet-scrape index and discovery share one
         predicate: a pod is indexed iff it declares a scrape port."""

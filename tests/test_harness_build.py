@@ -467,6 +467,40 @@ class TestGenjob:
             == "train-lm-checkpoints"
         manifest.load_tfjob(job)  # defaults+validates as v1alpha2
 
+    def test_serve_mesh_gang_template(self):
+        """ISSUE 14: --serve-mesh N makes the job an N-replica
+        tensor-parallel serving gang (K8S_TPU_SERVE_MESH on every pod)
+        and --serve-weight stamps the router's weighted-ring
+        annotation; a mesh gang refuses autoscale bounds (its replica
+        count IS its mesh shape)."""
+        [job] = genjob.generate(1, serve=True, timestamp=9,
+                                serve_mesh=4, serve_weight=4.0)
+        worker = job["spec"]["tfReplicaSpecs"]["Worker"]
+        assert worker["replicas"] == 4
+        tmpl = worker["template"]
+        env = {e["name"]: e["value"]
+               for e in tmpl["spec"]["containers"][0]["env"]}
+        assert env["K8S_TPU_SERVE_MESH"] == "4"
+        # the plan bus needs a FIXED, discoverable port across pods
+        assert env["K8S_TPU_SERVE_PLAN_PORT"] == \
+            str(genjob.SERVE_PLAN_PORT)
+        ann = tmpl["metadata"]["annotations"]
+        assert ann["kubeflow.org/fleet-serve-weight"] == "4.0"
+        # the scrape annotation still rides alongside the weight
+        assert "kubeflow.org/fleet-scrape-port" in ann
+        manifest.load_tfjob(job)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            genjob.serve_tfjob_template("j", serve_mesh=2,
+                                        autoscale_min=1, autoscale_max=4)
+        with pytest.raises(ValueError, match="serve_weight"):
+            genjob.serve_tfjob_template("j", serve_weight=0.0)
+        # the PR-13 silent-drop guard pattern: mesh/weight flags
+        # without --serve are refused, never quietly ignored
+        with pytest.raises(ValueError, match="require --serve"):
+            genjob.generate(1, serve=False, serve_mesh=2)
+        with pytest.raises(ValueError, match="require --serve"):
+            genjob.generate(1, serve=False, serve_weight=2.0)
+
     def test_serve_job_default_prefix_sizing_is_auto(self):
         # no PREFIX_BLOCKS env unless pinned: unset means auto-size in
         # the engine (0 would DISABLE reuse — not a default); same for

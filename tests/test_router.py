@@ -96,6 +96,52 @@ class TestHashRing:
         assert state["points"] == 3 * state["vnodes"]
         assert abs(sum(state["keyspace_share"].values()) - 1.0) < 0.01
 
+    def test_weighted_keyspace_share_proportional(self):
+        """ISSUE 14: a weight-w node owns ~w/Σw of the circle AND of
+        actual key placements — heterogeneous pod sizes (a tp=4 gang
+        next to 1-chip pods) get traffic proportional to capacity."""
+        ring = ring_mod.HashRing([("a", 1.0), ("b", 2.0), ("c", 1.0)],
+                                 vnodes=128)
+        shares = ring.state()["keyspace_share"]
+        assert abs(shares["b"] - 0.5) < 0.08, shares
+        assert abs(shares["a"] - 0.25) < 0.08, shares
+        # the measured placement distribution agrees with the circle
+        keys = [f"key-{i}" for i in range(4000)]
+        owners = [ring.lookup(k) for k in keys]
+        frac_b = owners.count("b") / len(keys)
+        assert abs(frac_b - 0.5) < 0.08, frac_b
+        assert ring.state()["weights"] == {"a": 1.0, "b": 2.0, "c": 1.0}
+
+    def test_weight_change_replants_only_that_node(self):
+        """Growing one node's weight may only move keys TO it; every
+        other pairing keeps its placement (minimal remap extends to
+        resizes, so a pod-size change never reshuffles the fleet's
+        warm KV)."""
+        ring = ring_mod.HashRing(["a", "b", "c"])
+        keys = [f"k{i}" for i in range(2000)]
+        before = {k: ring.lookup(k) for k in keys}
+        ring.replace({"a": 1.0, "b": 3.0, "c": 1.0})
+        after = {k: ring.lookup(k) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        assert moved, "tripling b's weight must claim keyspace"
+        assert all(after[k] == "b" for k in moved)
+        # and shrinking back restores the original placement exactly
+        ring.replace({"a": 1.0, "b": 1.0, "c": 1.0})
+        assert {k: ring.lookup(k) for k in keys} == before
+
+    def test_weighted_candidates_stay_distinct(self):
+        ring = ring_mod.HashRing([("a", 0.5), ("b", 4.0), ("c", 1.0)])
+        cands = ring.candidates("fp")
+        assert sorted(cands) == ["a", "b", "c"]
+        assert cands[0] == ring.lookup("fp")
+
+    def test_bad_weights_rejected(self):
+        ring = ring_mod.HashRing()
+        with pytest.raises(ValueError):
+            ring.add("a", weight=0)
+        with pytest.raises(ValueError):
+            ring.add("a", weight=-1.5)
+
 
 # -- affinity fingerprints ----------------------------------------------------
 
@@ -427,6 +473,34 @@ class TestDrain:
         router.refresh_once()
         assert {b["name"]: b["draining"]
                 for b in router.backends()}["p0"] is True
+
+    def test_serve_weight_flows_from_discovery_to_ring(self):
+        """ISSUE 14: per-backend weights (heterogeneous pod sizes) ride
+        the fleet-serve-weight annotation through discovery into the
+        weighted hash ring — keyspace share proportional to capacity,
+        and a weight change on refresh re-plants only that backend."""
+        from k8s_tpu.fleet.discovery import ScrapeTarget
+
+        weights = {"p0": 1.0, "p1": 4.0}
+
+        def targets():
+            return [ScrapeTarget("ns/j", "ns", "j", name, "0",
+                                 f"http://127.0.0.1:{i + 1}/metrics",
+                                 weight=weights[name])
+                    for i, name in enumerate(sorted(weights))]
+
+        router = router_mod.Router(targets, refresh_interval_s=0)
+        router.refresh_once()
+        state = router._ring.state()
+        assert state["weights"] == {"p0": 1.0, "p1": 4.0}
+        assert state["keyspace_share"]["p1"] > \
+            2 * state["keyspace_share"]["p0"]
+        assert {b["name"]: b["weight"]
+                for b in router.backends()} == weights
+        # a re-annotated pod (resize) takes effect on the next refresh
+        weights["p1"] = 1.0
+        router.refresh_once()
+        assert router._ring.state()["weights"]["p1"] == 1.0
 
     def test_shed_backend_deprioritized_in_fallback(self):
         """A backend that just 503'd rejects FAST, so its in-flight

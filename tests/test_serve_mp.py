@@ -1,0 +1,328 @@
+"""Multi-host tensor-parallel serving engine (ISSUE 14).
+
+Three layers of proof:
+
+1. **Seam** (fast, in-process): the placement-agnostic compute seam is
+   behavior-preserving — a LocalPlacement engine and a MeshPlacement
+   engine over 1/2/4 virtual devices emit byte-identical fixed-seed
+   tokens across the greedy, sampled, AND speculative lanes; config
+   guards reject meshes the model cannot shard over.
+2. **Plan bus** (fast, no jax): wire codec round-trip, clean bye vs
+   dead-chief stream teardown.
+3. **Gang** (real OS processes, ``jax.distributed`` over the operator
+   env contract): 1-process vs 2-process mesh token identity end to
+   end, worker compile-budget audit, and the chief-crash drill — the
+   ROADMAP item 3 correctness bar that workers exit NONZERO rather
+   than hang when the chief dies.
+"""
+
+import json
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from k8s_tpu.models import mp_plan
+
+
+def _tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_tpu.models.transformer import Transformer, TransformerConfig
+
+    config = TransformerConfig(
+        vocab_size=64, hidden=32, ffn_hidden=64, layers=2, heads=4,
+        kv_heads=4, max_seq_len=64, dtype=jnp.float32, remat=False)
+    params = Transformer(config).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, params
+
+
+THREE_LANE_REQUESTS = [
+    # greedy
+    dict(ids=np.arange(5, dtype=np.int32) + 1, max_new_tokens=8),
+    # sampled (temperature + top_k, fixed seeds)
+    dict(ids=np.arange(5, dtype=np.int32) + 1, max_new_tokens=8,
+         temperature=1.0, seed=3),
+    dict(ids=np.asarray([9, 8, 7, 6, 5, 4, 3, 2, 1] * 2, np.int32),
+         max_new_tokens=6, temperature=0.7, top_k=5, seed=11),
+    # speculative (greedy and sampled) over a repetitive prompt
+    dict(ids=np.asarray([1, 2, 3, 1, 2, 3, 1, 2], np.int32),
+         max_new_tokens=8, speculative=3),
+    dict(ids=np.asarray([4, 5, 6, 4, 5, 6, 4, 5, 6, 4], np.int32),
+         max_new_tokens=8, speculative=4, temperature=0.9, seed=21),
+]
+
+
+def _run_engine(config, params, placement, requests=THREE_LANE_REQUESTS):
+    from k8s_tpu.models.engine import Engine
+
+    eng = Engine(config, params, slots=2, queue_limit=16,
+                 placement=placement)
+    try:
+        outs = [eng.submit(**r) for r in requests]
+        stats = eng.stats()
+    finally:
+        eng.shutdown()
+    return outs, stats
+
+
+class TestPlacementSeam:
+    """The refactor bar: mesh placements change WHERE the math runs,
+    never WHAT it computes."""
+
+    def test_local_placement_reports_single_host_identity(self):
+        config, params = _tiny_model()
+        _, stats = _run_engine(config, params, None,
+                               requests=THREE_LANE_REQUESTS[:1])
+        assert stats["placement"] == "local"
+        assert stats["num_processes"] == 1
+        assert stats["tp_degree"] == 1
+
+    def test_mesh_tp_degrees_token_identical_across_all_lanes(self):
+        """The ROADMAP item 3 correctness bar, in-process: a 1-device
+        (today's path, behavior-preserving) and a 4-device tp mesh emit
+        byte-identical fixed-seed tokens on the greedy, sampled, and
+        speculative lanes.  The 2-device rung rides the multi-process
+        gang suite (TestServeGang, e2e_multiprocess tier) — each tp
+        degree compiles its own program set, so tier-1 keeps two."""
+        from k8s_tpu.models import mesh_serve
+
+        config, params = _tiny_model()
+        base, _ = _run_engine(config, params, None)
+        for tp in (1, 4):
+            mesh = mesh_serve.build_serve_mesh(tp=tp)
+            placement = mesh_serve.MeshPlacement(config, mesh)
+            outs, stats = _run_engine(config, params, placement)
+            assert outs == base, f"tp={tp} diverged from local"
+            assert stats["placement"] == "mesh"
+            assert stats["tp_degree"] == tp
+            assert stats["mesh_shape"] == {"tp": tp}
+
+    def test_mesh_rejects_windowed_config(self):
+        import jax.numpy as jnp
+
+        from k8s_tpu.models import mesh_serve
+        from k8s_tpu.models.engine import Engine
+        from k8s_tpu.models.transformer import Transformer, TransformerConfig
+
+        import jax
+
+        config = TransformerConfig(
+            vocab_size=64, hidden=32, ffn_hidden=64, layers=1, heads=4,
+            kv_heads=4, max_seq_len=64, window_size=16, prefill_chunk=8,
+            dtype=jnp.float32, remat=False)
+        params = Transformer(config).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        mesh = mesh_serve.build_serve_mesh(tp=2)
+        # the seam contract: a windowed ring cache has no shareable
+        # absolute-position blocks, so there is nothing to head-shard
+        with pytest.raises(ValueError, match="paged block pool"):
+            Engine(config, params, slots=2,
+                   placement=mesh_serve.MeshPlacement(config, mesh))
+
+    def test_mesh_rejects_indivisible_heads(self):
+        from k8s_tpu.models import mesh_serve
+
+        config, _ = _tiny_model()  # kv_heads=4
+        mesh = mesh_serve.build_serve_mesh(tp=8)
+        with pytest.raises(ValueError, match="does not shard"):
+            mesh_serve.MeshPlacement(config, mesh)
+
+    def test_serving_info_carries_mesh_fields(self):
+        """/healthz serving info tells a sharded pod from a single-host
+        one — the fleet-plane satellite."""
+        from k8s_tpu.models import mesh_serve
+        from k8s_tpu.models.server import LmServer
+        from k8s_tpu.util.metrics import Registry
+
+        config, params = _tiny_model()
+        mesh = mesh_serve.build_serve_mesh(tp=2)
+        lm = LmServer(config=config, params=params, slots=2,
+                      queue_limit=8, registry=Registry(),
+                      placement=mesh_serve.MeshPlacement(config, mesh))
+        try:
+            info = lm.serving_info()
+            assert info["placement"] == "mesh"
+            assert info["tp_degree"] == 2
+            assert info["mesh_shape"] == {"tp": 2}
+            assert info["num_processes"] == 1  # in-process mesh
+        finally:
+            lm.close()
+        lm2 = LmServer(config=config, params=params, slots=2,
+                       queue_limit=8, registry=Registry())
+        try:
+            info = lm2.serving_info()
+            assert info["placement"] == "local"
+            assert info["tp_degree"] == 1
+        finally:
+            lm2.close()
+
+
+class TestPlanBus:
+    """Wire-level contract of the chief→worker plan stream."""
+
+    def test_roundtrip_ops_and_arrays(self):
+        bus = mp_plan.PlanBus(num_workers=1)
+        follower_box = {}
+
+        def dial():
+            follower_box["f"] = mp_plan.PlanFollower("127.0.0.1", bus.port)
+
+        t = threading.Thread(target=dial)
+        t.start()
+        bus.accept_workers()
+        t.join()
+        f = follower_box["f"]
+        ints = np.arange(12, dtype=np.int32).reshape(3, 4)
+        keys = np.arange(8, dtype=np.uint32).reshape(4, 2)
+        bus.broadcast("paged_step", {"k": 2, "sampling": True},
+                      {"ints": ints, "keys": keys})
+        op, statics, arrays = f.recv()
+        assert op == "paged_step"
+        assert statics == {"k": 2, "sampling": True}
+        np.testing.assert_array_equal(arrays["ints"], ints)
+        np.testing.assert_array_equal(arrays["keys"], keys)
+        assert arrays["keys"].dtype == np.uint32
+        # messages arrive strictly in order
+        bus.broadcast("tables", {}, {"tables": np.zeros((2, 3), np.int32)})
+        bus.broadcast("cow", {}, {"src": np.int32(3), "dst": np.int32(7)})
+        assert f.recv()[0] == "tables"
+        op, _, arrays = f.recv()
+        assert op == "cow"
+        assert int(arrays["src"]) == 3 and int(arrays["dst"]) == 7
+        bus.close()
+        with pytest.raises(mp_plan.PlanBusClosed) as ei:
+            f.recv()
+        assert ei.value.clean  # deliberate bye → worker exits 0
+        f.close()
+
+    def test_dead_chief_is_an_unclean_close(self):
+        """The chief-crash contract at the socket layer: an EOF without
+        a bye raises clean=False, which the follower converts into a
+        NONZERO worker exit (the gang restarts whole, never hangs)."""
+        bus = mp_plan.PlanBus(num_workers=1)
+        follower_box = {}
+        t = threading.Thread(target=lambda: follower_box.update(
+            f=mp_plan.PlanFollower("127.0.0.1", bus.port)))
+        t.start()
+        bus.accept_workers()
+        t.join()
+        f = follower_box["f"]
+        # simulate the crash: sockets die with no bye on the wire
+        for conn in bus._conns:
+            conn.close()
+        bus._listener.close()
+        with pytest.raises(mp_plan.PlanBusClosed) as ei:
+            f.recv()
+        assert not ei.value.clean
+        f.close()
+
+    def test_follower_connect_refused_eventually_raises(self):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        with pytest.raises(ConnectionError):
+            mp_plan.PlanFollower("127.0.0.1", port, connect_timeout=0.5,
+                                 retry_interval=0.1)
+
+
+@pytest.mark.slow
+class TestServeGang:
+    """REAL multi-process serving gangs: operator env contract →
+    jax.distributed world → chief engine + plan-replaying workers.
+    Slow-marked (each gang costs ~15 s of process spawn + rendezvous):
+    the e2e_multiprocess tier runs them; tier-1 covers the same seam
+    in-process via TestPlacementSeam."""
+
+    @pytest.fixture(scope="class")
+    def gangs(self):
+        """One 1-process and one 2-process gang over the identical
+        fixed-seed three-lane script (gang bring-up costs ~15 s each on
+        this box; the identity assertions share them)."""
+        from k8s_tpu.models import mp_serve
+
+        results = {}
+        for n in (1, 2):
+            res, workers = mp_serve.run_serve_gang(n, timeout=360)
+            if not res.success:
+                for i, out in enumerate(res.worker_outputs):
+                    print(f"--- proc {i} rc={res.exit_codes[i]} ---\n"
+                          f"{out[-2000:]}")
+            assert res.success, (n, res.exit_codes)
+            results[n] = (res, workers)
+        return results
+
+    def test_gang_exits_clean(self, gangs):
+        for n, (res, _workers) in gangs.items():
+            assert res.exit_codes == [0] * n
+
+    def test_two_process_mesh_token_identical_to_one(self, gangs):
+        """The multi-host half of the ROADMAP item 3 bar: the SAME
+        fixed-seed script (greedy + sampled + speculative lanes,
+        mp_serve.default_script) emits byte-identical tokens on a
+        1-process and a 2-process CPU mesh."""
+        one = gangs[1][0].chief_result
+        two = gangs[2][0].chief_result
+        assert one["results"] == two["results"]
+        assert two["num_processes"] == 2
+        assert two["tp_degree"] == 2
+        assert one["tp_degree"] == 1
+        # every lane actually ran
+        assert all(one["results"]), "a lane emitted nothing"
+        assert two["spec_mean_accepted"] >= 0
+
+    def test_worker_replayed_the_plan(self, gangs):
+        _, workers = gangs[2]
+        assert len(workers) == 1
+        assert workers[0]["process_id"] == 1
+        assert workers[0]["ops"] > 0
+
+    def test_four_process_mesh_token_identical(self, gangs):
+        """The full 4-process rung of the identity ladder."""
+        from k8s_tpu.models import mp_serve
+
+        res, _ = mp_serve.run_serve_gang(4, timeout=360)
+        assert res.success, res.exit_codes
+        assert res.chief_result["results"] == \
+            gangs[1][0].chief_result["results"]
+        assert res.chief_result["tp_degree"] == 4
+
+    def test_chief_crash_makes_workers_exit_nonzero(self):
+        """A dead chief must never strand workers parked inside a
+        collective: the plan-bus EOF (or the distributed runtime's own
+        coordinator-death path) turns into a NONZERO worker exit, so
+        the operator's whole-gang restart policy fires."""
+        from k8s_tpu.models import mp_serve
+
+        res, _ = mp_serve.run_serve_gang(
+            2, script=mp_serve.default_script(8), kill_chief_after=7.0,
+            timeout=240)
+        assert res.exit_codes[0] != 0  # the injected kill
+        assert res.exit_codes[1] is not None, "worker hung after chief died"
+        assert res.exit_codes[1] != 0, \
+            f"worker exited {res.exit_codes[1]} after chief crash; " \
+            "gang policy needs a nonzero exit to restart the gang"
+
+
+@pytest.mark.slow
+class TestWorkerLedger:
+    """Per-process compile budgets (the bench assertion's data source):
+    a worker under K8S_TPU_COMPILE_LEDGER declares its own seams and
+    reports the audit on clean shutdown.  Slow-marked with the other
+    gang suites (e2e_multiprocess tier)."""
+
+    def test_worker_reports_compile_audit(self):
+        from k8s_tpu.models import mp_serve
+
+        res, workers = mp_serve.run_serve_gang(
+            2, script=mp_serve.default_script(1), timeout=360,
+            extra_env={"K8S_TPU_COMPILE_LEDGER": "1"})
+        assert res.success, res.exit_codes
+        assert workers and workers[0]["compile_ledger"] is not None
+        audit = workers[0]["compile_ledger"]
+        assert not audit["over_budget"], json.dumps(audit, indent=2)
+        assert res.chief_result["compile_ledger"] is not None
+        assert not res.chief_result["compile_ledger"]["over_budget"]
