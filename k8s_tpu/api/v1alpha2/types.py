@@ -44,18 +44,27 @@ VALID_RESTART_POLICIES = (
     RestartPolicyExitCode,
 )
 
-# Replica types (types.go:94-112) + TPU gang type
+# Replica types (types.go:94-112) + TPU gang type + the disaggregated
+# serving tiers (ISSUE 15): a serving TFJob may split into a Prefill
+# tier (compute-bound prompt ingestion, exports KV block chains) and a
+# Decode tier (latency-bound token emission, imports them) — the same
+# multi-role replica machinery PS/Worker topologies use, priced
+# per-role by the capacity scheduler.
 TFReplicaTypePS = "PS"
 TFReplicaTypeWorker = "Worker"
 TFReplicaTypeChief = "Chief"
 TFReplicaTypeEval = "Eval"
 TFReplicaTypeTPU = "TPU"
+TFReplicaTypePrefill = "Prefill"
+TFReplicaTypeDecode = "Decode"
 VALID_REPLICA_TYPES = (
     TFReplicaTypePS,
     TFReplicaTypeWorker,
     TFReplicaTypeChief,
     TFReplicaTypeEval,
     TFReplicaTypeTPU,
+    TFReplicaTypePrefill,
+    TFReplicaTypeDecode,
 )
 
 # Condition types (types.go:168-196) + Queued (gang admission, ISSUE 4:

@@ -32,6 +32,10 @@ SERVE_HTTP_PORT = 8000
 # the chief pod's hostname on it, so an ephemeral (0) port would be
 # undiscoverable across pods and the gang could never rendezvous.
 SERVE_PLAN_PORT = 8471
+# The decode tier's KV block-transfer listener (ISSUE 15): fixed for
+# the same reason — prefill pods (via the router's kv_dest) dial decode
+# pods on it.  Must equal models/kvxfer.DEFAULT_PORT (pinned by test).
+KVXFER_PORT = 8472
 
 
 def v5e_slice_for_hosts(num_hosts: int) -> tuple[str, str]:
@@ -253,6 +257,175 @@ def serve_tfjob_template(
     return job
 
 
+def _serve_replica_spec(replicas: int, env: list, annotations: dict,
+                        scheduler_name: str, train_dir: str,
+                        restart_policy: str = "OnFailure") -> dict:
+    """One serving replica spec (the serve template's pod shape) with
+    the given env/annotations — shared by the Prefill and Decode tiers
+    of a disaggregated job."""
+    template: dict = {
+        "spec": {
+            "schedulerName": scheduler_name,
+            "containers": [
+                {
+                    "name": "tensorflow",
+                    "image": "k8s-tpu/train-lm:latest",
+                    "command": [
+                        "python", "-m", "k8s_tpu.models.server",
+                        f"--train_dir={train_dir}",
+                        "--host=0.0.0.0",
+                        f"--port={SERVE_HTTP_PORT}",
+                    ],
+                    "env": env,
+                    "ports": [{"containerPort": SERVE_HTTP_PORT,
+                               "name": "http"}],
+                    "readinessProbe": {
+                        "httpGet": {"path": "/healthz",
+                                    "port": SERVE_HTTP_PORT}
+                    },
+                    # the scheduler's TPU resource prefix, so each
+                    # tier's chip demand prices SEPARATELY through the
+                    # ordinary per-role walk (chips_for_tfjob) — a
+                    # 1-prefill/2-decode job reserves 3 hosts' chips
+                    "resources": {
+                        "limits": {
+                            "cloud-tpus.google.com/v5e":
+                                V5E_CHIPS_PER_HOST,
+                            "memory": "16Gi",
+                        }
+                    },
+                    "volumeMounts": [
+                        {"name": "checkpoints",
+                         "mountPath": "/checkpoints"}
+                    ],
+                }
+            ],
+            "volumes": [
+                {"name": "checkpoints",
+                 "persistentVolumeClaim": {
+                     "claimName": "train-lm-checkpoints"
+                 }}
+            ],
+        }
+    }
+    if annotations:
+        template["metadata"] = {"annotations": dict(annotations)}
+    return {
+        "replicas": replicas,
+        "restartPolicy": restart_policy,
+        "template": template,
+    }
+
+
+def disagg_serve_tfjob_template(
+    job_name: str,
+    namespace: str = "default",
+    train_dir: str = "/checkpoints/train-lm",
+    scheduler_name: str = "default",
+    prefill_replicas: int = 1,
+    decode_replicas: int = 2,
+    serve_slots: int = 8,
+    serve_queue: int = 64,
+    serve_prefix_blocks: int | None = None,
+    serve_batch_sampling: bool = True,
+    serve_batch_spec: bool = True,
+    serve_request_log: bool = True,
+    serve_request_log_ring: int | None = None,
+    priority: int | None = None,
+    queue: str | None = None,
+    fleet_scrape_port: int | None = SERVE_HTTP_PORT,
+    fleet_interval_s: float | None = None,
+    kvxfer_port: int = KVXFER_PORT,
+    kvxfer_int8: bool = False,
+) -> dict:
+    """A DISAGGREGATED serving TFJob (ISSUE 15): heterogeneous
+    ``Prefill`` and ``Decode`` replica tiers of the same artifact,
+    connected by the KV block-transfer plane.
+
+    - **Prefill** pods run ``K8S_TPU_SERVE_ROLE=prefill``: they serve
+      the router's phase-split long prompts, chunk-prefill, emit the
+      first token, and stream the finished block chain to the decode
+      pod the router chose (``kv_dest`` in the request) — no decode
+      slot is ever held.  ``kvxfer_int8`` stamps
+      ``K8S_TPU_KVXFER_INT8=1`` here (quantization happens on the
+      SENDING side; int8 pools ignore it).
+    - **Decode** pods run ``K8S_TPU_SERVE_ROLE=decode`` and listen on
+      ``K8S_TPU_KVXFER_PORT``: they seat migrated requests directly
+      from imported blocks and serve every short prompt locally.
+
+    Each tier's pod template carries ``kubeflow.org/serve-role`` (and
+    the decode tier ``kubeflow.org/kvxfer-port``), so fleet discovery
+    hands a role-aware backend set to the router, whose
+    ``K8S_TPU_ROUTER_PHASE_TOKENS`` knob does the traffic split.  The
+    capacity scheduler prices each tier's chips separately through the
+    ordinary per-role demand walk (``chips_for_tfjob``)."""
+    if prefill_replicas < 1 or decode_replicas < 1:
+        raise ValueError(
+            "a disaggregated job needs >= 1 replica per tier "
+            f"(got prefill={prefill_replicas}, decode={decode_replicas})")
+    base_env = [
+        {"name": "K8S_TPU_SERVE_SLOTS", "value": str(serve_slots)},
+        {"name": "K8S_TPU_SERVE_QUEUE", "value": str(serve_queue)},
+        {"name": "K8S_TPU_SERVE_BATCH_SAMPLING",
+         "value": "1" if serve_batch_sampling else "0"},
+        {"name": "K8S_TPU_SERVE_BATCH_SPEC",
+         "value": "1" if serve_batch_spec else "0"},
+        {"name": "K8S_TPU_REQUEST_LOG",
+         "value": "1" if serve_request_log else "0"},
+    ]
+    if serve_prefix_blocks is not None:
+        base_env.append({"name": "K8S_TPU_SERVE_PREFIX_BLOCKS",
+                         "value": str(serve_prefix_blocks)})
+    if serve_request_log_ring is not None:
+        base_env.append({"name": "K8S_TPU_REQUEST_LOG_RING",
+                         "value": str(serve_request_log_ring)})
+    if fleet_scrape_port is not None:
+        base_env.append({"name": "K8S_TPU_FLEET_SCRAPE_PORT",
+                         "value": str(fleet_scrape_port)})
+        if fleet_interval_s is not None:
+            base_env.append({"name": "K8S_TPU_FLEET_INTERVAL_S",
+                             "value": str(fleet_interval_s)})
+    base_annotations: dict = {}
+    if fleet_scrape_port is not None:
+        base_annotations["kubeflow.org/fleet-scrape-port"] = \
+            str(fleet_scrape_port)
+
+    # per-item copies so the dumped YAML carries no cross-tier anchors
+    prefill_env = [dict(e) for e in base_env] + [
+        {"name": "K8S_TPU_SERVE_ROLE", "value": "prefill"}]
+    if kvxfer_int8:
+        prefill_env.append({"name": "K8S_TPU_KVXFER_INT8", "value": "1"})
+    decode_env = [dict(e) for e in base_env] + [
+        {"name": "K8S_TPU_SERVE_ROLE", "value": "decode"},
+        {"name": "K8S_TPU_KVXFER_PORT", "value": str(kvxfer_port)},
+    ]
+    job = {
+        "apiVersion": "kubeflow.org/v1alpha2",
+        "kind": "TFJob",
+        "metadata": {"name": job_name, "namespace": namespace},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Prefill": _serve_replica_spec(
+                    prefill_replicas, prefill_env,
+                    {**base_annotations,
+                     "kubeflow.org/serve-role": "prefill"},
+                    scheduler_name, train_dir),
+                "Decode": _serve_replica_spec(
+                    decode_replicas, decode_env,
+                    {**base_annotations,
+                     "kubeflow.org/serve-role": "decode",
+                     "kubeflow.org/kvxfer-port": str(kvxfer_port)},
+                    scheduler_name, train_dir),
+            }
+        },
+    }
+    if priority is not None:
+        job["spec"]["priority"] = priority
+    if queue is not None:
+        job["spec"]["queue"] = queue
+    return job
+
+
 ROUTER_HTTP_PORT = 8080
 
 
@@ -264,6 +437,7 @@ def router_companion_template(
     block_size: int | None = None,
     affinity_blocks: int | None = None,
     retry_budget: int | None = None,
+    phase_split_tokens: int | None = None,
 ) -> dict:
     """The front-door companion Pod for one serving TFJob (ISSUE 13):
     ``python -m k8s_tpu.cmd.router --job <ns>/<name>`` discovering the
@@ -281,6 +455,12 @@ def router_companion_template(
     if retry_budget is not None:
         env.append({"name": "K8S_TPU_ROUTER_RETRY_BUDGET",
                     "value": str(retry_budget)})
+    if phase_split_tokens is not None:
+        # disaggregated phase split (ISSUE 15): prompts at/above this
+        # token count route to the Prefill tier, then follow their
+        # blocks to a Decode pod
+        env.append({"name": "K8S_TPU_ROUTER_PHASE_TOKENS",
+                    "value": str(phase_split_tokens)})
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -442,14 +622,36 @@ def generate(
     autoscale_max: int | None = None,
     serve_mesh: int | None = None,
     serve_weight: float | None = None,
+    disagg: bool = False,
+    disagg_prefill: int = 1,
+    disagg_decode: int = 2,
+    disagg_phase_tokens: int = 64,
+    kvxfer_port: int = KVXFER_PORT,
+    kvxfer_int8: bool = False,
 ) -> list[dict]:
     """N uniquely-named jobs, ``tfjob-<ts>-<i>`` (genjob.go:111-114).
     ``router=True`` (requires ``serve``) additionally emits each job's
-    front-door companion Pod right after its TFJob document."""
+    front-door companion Pod right after its TFJob document;
+    ``disagg=True`` (requires ``serve``) emits the two-tier
+    Prefill/Decode job instead of the single-role Worker job, with the
+    router companion carrying the phase-split threshold."""
     ts = timestamp if timestamp is not None else time.time_ns() % 10**9
     if router and not serve:
         raise ValueError("--router requires --serve (the front door "
                          "proxies serving jobs)")
+    if disagg and not serve:
+        raise ValueError("--disagg requires --serve (only serving jobs "
+                         "split into prefill/decode tiers)")
+    if disagg and serve_mesh is not None:
+        raise ValueError(
+            "--disagg and --serve-mesh are mutually exclusive for now: "
+            "a tensor-parallel gang has no single-host pool to export "
+            "(disaggregate ACROSS gangs once per-tier meshes land)")
+    if disagg and autoscale_min is not None:
+        raise ValueError(
+            "--disagg and --autoscale-* are mutually exclusive for "
+            "now: spec.autoscale targets ONE replica type; per-tier "
+            "autoscaling is a follow-up")
     if (autoscale_min is not None or autoscale_max is not None) \
             and not serve:
         # silently dropping the bounds would leave the user believing
@@ -467,29 +669,49 @@ def generate(
         out: list[dict] = []
         for i in range(n):
             name = f"tfjob-{ts}-{i}"
-            out.append(serve_tfjob_template(
-                name, namespace,
-                scheduler_name=scheduler_name,
-                serve_slots=serve_slots, serve_queue=serve_queue,
-                serve_prefix_blocks=serve_prefix_blocks,
-                serve_batch_sampling=serve_batch_sampling,
-                serve_batch_spec=serve_batch_spec,
-                serve_request_log=serve_request_log,
-                serve_request_log_ring=serve_request_log_ring,
-                priority=priority, queue=queue,
-                fleet_scrape_port=fleet_scrape_port,
-                fleet_interval_s=fleet_interval_s,
-                autoscale_min=autoscale_min,
-                autoscale_max=autoscale_max,
-                serve_mesh=serve_mesh,
-                serve_weight=serve_weight))
+            if disagg:
+                out.append(disagg_serve_tfjob_template(
+                    name, namespace,
+                    scheduler_name=scheduler_name,
+                    prefill_replicas=disagg_prefill,
+                    decode_replicas=disagg_decode,
+                    serve_slots=serve_slots, serve_queue=serve_queue,
+                    serve_prefix_blocks=serve_prefix_blocks,
+                    serve_batch_sampling=serve_batch_sampling,
+                    serve_batch_spec=serve_batch_spec,
+                    serve_request_log=serve_request_log,
+                    serve_request_log_ring=serve_request_log_ring,
+                    priority=priority, queue=queue,
+                    fleet_scrape_port=fleet_scrape_port,
+                    fleet_interval_s=fleet_interval_s,
+                    kvxfer_port=kvxfer_port,
+                    kvxfer_int8=kvxfer_int8))
+            else:
+                out.append(serve_tfjob_template(
+                    name, namespace,
+                    scheduler_name=scheduler_name,
+                    serve_slots=serve_slots, serve_queue=serve_queue,
+                    serve_prefix_blocks=serve_prefix_blocks,
+                    serve_batch_sampling=serve_batch_sampling,
+                    serve_batch_spec=serve_batch_spec,
+                    serve_request_log=serve_request_log,
+                    serve_request_log_ring=serve_request_log_ring,
+                    priority=priority, queue=queue,
+                    fleet_scrape_port=fleet_scrape_port,
+                    fleet_interval_s=fleet_interval_s,
+                    autoscale_min=autoscale_min,
+                    autoscale_max=autoscale_max,
+                    serve_mesh=serve_mesh,
+                    serve_weight=serve_weight))
             if router:
                 out.append(router_companion_template(
                     name, namespace, router_port=router_port,
                     policy=router_policy,
                     block_size=router_block_size,
                     affinity_blocks=router_affinity_blocks,
-                    retry_budget=router_retry_budget))
+                    retry_budget=router_retry_budget,
+                    phase_split_tokens=disagg_phase_tokens
+                    if disagg else None))
         return out
     return [
         tfjob_template(f"tfjob-{ts}-{i}", namespace, gpu, tpu, scheduler_name,
@@ -595,6 +817,30 @@ def main(argv=None) -> int:
                         "K8S_TPU_AUTOSCALE is on)")
     parser.add_argument("--autoscale-max", type=int, default=None,
                         help="spec.autoscale.maxReplicas on --serve jobs")
+    parser.add_argument("--disagg", action="store_true",
+                        help="with --serve: emit the DISAGGREGATED "
+                        "two-tier job (Prefill + Decode replica types, "
+                        "KV block migration between them; ISSUE 15) "
+                        "instead of the single-role Worker job; with "
+                        "--router the companion carries the phase-split "
+                        "threshold")
+    parser.add_argument("--disagg-prefill", type=int, default=1,
+                        help="Prefill-tier replica count for --disagg")
+    parser.add_argument("--disagg-decode", type=int, default=2,
+                        help="Decode-tier replica count for --disagg")
+    parser.add_argument("--disagg-phase-tokens", type=int, default=64,
+                        help="router phase-split threshold "
+                        "(K8S_TPU_ROUTER_PHASE_TOKENS on the companion): "
+                        "prompts of at least this many tokens go to the "
+                        "Prefill tier")
+    parser.add_argument("--kvxfer-port", type=int, default=KVXFER_PORT,
+                        help="K8S_TPU_KVXFER_PORT on Decode-tier pods "
+                        "(the block-transfer listener)")
+    parser.add_argument("--kvxfer-int8", type=int, choices=(0, 1),
+                        default=0,
+                        help="K8S_TPU_KVXFER_INT8 on Prefill-tier pods: "
+                        "quantize fp-pool KV content for transit "
+                        "(lossy on fp pools; no-op on int8 pools)")
     parser.add_argument(
         "--dump", action="store_true", help="print manifests instead of creating"
     )
@@ -630,6 +876,12 @@ def main(argv=None) -> int:
         autoscale_max=args.autoscale_max,
         serve_mesh=args.serve_mesh,
         serve_weight=args.serve_weight,
+        disagg=args.disagg,
+        disagg_prefill=args.disagg_prefill,
+        disagg_decode=args.disagg_decode,
+        disagg_phase_tokens=args.disagg_phase_tokens,
+        kvxfer_port=args.kvxfer_port,
+        kvxfer_int8=bool(args.kvxfer_int8),
     )
     if args.dump:
         yaml.safe_dump_all(jobs, sys.stdout)

@@ -50,6 +50,16 @@ def build_parser() -> argparse.ArgumentParser:
                    default=router_mod.affinity_blocks_from_env())
     p.add_argument("--retry-budget", type=int,
                    default=router_mod.retry_budget_from_env())
+    p.add_argument("--phase-split-tokens", type=int,
+                   default=router_mod.phase_tokens_from_env() or 0,
+                   help="route prompts of at least this many tokens to "
+                   "the prefill tier (disaggregated phase split, "
+                   "K8S_TPU_ROUTER_PHASE_TOKENS; 0 = off)")
+    p.add_argument("--hedge-s", type=float,
+                   default=router_mod.hedge_s_from_env(),
+                   help="hedge a stuck idempotent request against the "
+                   "next ring candidate after this many seconds "
+                   "(K8S_TPU_ROUTER_HEDGE_S; 0 = off)")
     p.add_argument("--drain-timeout", type=float, default=30.0)
     return p
 
@@ -92,7 +102,9 @@ def run(opts, backend=None) -> int:
         targets_fn, job=job, policy=opts.policy,
         block_size=opts.block_size,
         affinity_blocks=opts.affinity_blocks,
-        retry_budget=opts.retry_budget)
+        retry_budget=opts.retry_budget,
+        phase_split_tokens=opts.phase_split_tokens or None,
+        hedge_s=opts.hedge_s)
     server = router_mod.RouterServer(router, host=opts.host,
                                      port=opts.port)
     router_mod.set_active(router)

@@ -42,7 +42,13 @@ LABEL_REPLICA_INDEX = "tf-replica-index"
 # process 0 (the coordinator / chief) is stable across reconciles.  PS is a
 # deleted concept (SURVEY.md §2.4) and Eval runs out-of-band; neither joins
 # the jax.distributed world.
-SPMD_TYPE_ORDER = ("chief", "master", "tpu", "tpu_worker", "worker")
+# prefill/decode (ISSUE 15) are appended LAST so adding the serving
+# tiers never renumbers an existing topology's processes; each tier's
+# pods are independent single-host servers, but listing them here
+# routes their declared chip limits through the same per-role pricing
+# walk every gang uses (chips_for_tfjob).
+SPMD_TYPE_ORDER = ("chief", "master", "tpu", "tpu_worker", "worker",
+                   "prefill", "decode")
 
 
 class PortNotFoundError(ValueError):

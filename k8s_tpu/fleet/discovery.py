@@ -35,6 +35,13 @@ ANNOTATION_SERVE_WEIGHT = "kubeflow.org/fleet-serve-weight"
 # marks that backend draining — no new placements, in-flight requests
 # finish — before the pod itself is deleted.  A falsy value un-drains.
 ANNOTATION_ROUTER_DRAIN = "kubeflow.org/router-drain"
+# Disaggregated serving tiers (ISSUE 15): role marks a pod as a
+# prefill- or decode-tier member (absent = collapsed single-role pod),
+# and kvxfer-port is the decode pod's KV block-transfer listener — the
+# router derives the ``kv_dest`` long requests follow their blocks to
+# (host is the pod's scrape host, port this annotation).
+ANNOTATION_SERVE_ROLE = "kubeflow.org/serve-role"
+ANNOTATION_KVXFER_PORT = "kubeflow.org/kvxfer-port"
 
 # Env var fallback carried by serving containers (genjob --serve).
 ENV_SCRAPE_PORT = "K8S_TPU_FLEET_SCRAPE_PORT"
@@ -53,11 +60,12 @@ class ScrapeTarget:
     annotation; the router leaves its local drain state alone)."""
 
     __slots__ = ("job", "namespace", "job_name", "pod", "index", "url",
-                 "draining", "weight")
+                 "draining", "weight", "role", "kvxfer")
 
     def __init__(self, job: str, namespace: str, job_name: str, pod: str,
                  index: str, url: str, draining=None,
-                 weight: float = 1.0):
+                 weight: float = 1.0, role: str = "",
+                 kvxfer=None):
         self.job = job
         self.namespace = namespace
         self.job_name = job_name
@@ -66,6 +74,10 @@ class ScrapeTarget:
         self.url = url
         self.draining = draining
         self.weight = weight
+        # disaggregated tier membership + the pod's kv-transfer address
+        # ("host:port", decode-tier pods only) — ISSUE 15
+        self.role = role
+        self.kvxfer = kvxfer
 
     def key(self) -> str:
         return f"{self.job}:{self.pod}"
@@ -164,6 +176,19 @@ def targets_from_pods(pods: list[dict]) -> list[ScrapeTarget]:
             weight = 1.0  # garbage annotation: default share, not a crash
         if weight <= 0:
             weight = 1.0
+        role = str(annotations.get(ANNOTATION_SERVE_ROLE, "")
+                   ).strip().lower()
+        if role not in ("prefill", "decode"):
+            role = ""  # garbage annotation: collapsed pod, not a crash
+        kvxfer = None
+        raw_kv = annotations.get(ANNOTATION_KVXFER_PORT)
+        if raw_kv is not None:
+            try:
+                kv_port = int(raw_kv)
+            except (TypeError, ValueError):
+                kv_port = 0
+            if 0 < kv_port < 65536:
+                kvxfer = f"{host}:{kv_port}"
         targets.append(ScrapeTarget(
             job=f"{ns}/{job_name}" if ns else job_name,
             namespace=ns,
@@ -173,5 +198,7 @@ def targets_from_pods(pods: list[dict]) -> list[ScrapeTarget]:
             url=f"http://{host}:{port}{path}",
             draining=draining,
             weight=weight,
+            role=role,
+            kvxfer=kvxfer,
         ))
     return targets

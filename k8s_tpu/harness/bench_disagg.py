@@ -1,0 +1,681 @@
+"""Disaggregated prefill/decode serving bench (ISSUE 15): decode p99
+under a long-prompt storm, split tiers vs the collapsed baseline.
+
+    python -m k8s_tpu.harness.bench_disagg
+
+The scenario is the production complaint ROADMAP item 2 names: steady
+short decodes share a serving fleet with bursts of long prompts, and
+every long admission's chunked prefill runs INSIDE the engine loop —
+decode-ready slots stall behind it (the convoy
+``serve_prefill_convoy_total`` counts), so prefill load directly
+convoys decode p99.  Disaggregation splits the fleet into a prefill
+tier (chunk-prefill, first token, export the block chain — no decode
+slot held) and a decode tier (graft imported chains — no model forward
+per migrated request), with the router phase-splitting traffic by
+prompt length and the KV block-transfer plane (models/kvxfer.py)
+carrying the chains between REAL engines over real sockets.
+
+Both arms run the same three-pod hardware budget (the genjob
+--disagg default topology: 1 prefill + 2 decode pods, vs 3 collapsed
+pods), each pod a REAL OS process pinned to its own third of the
+box's cores, the same tiny CPU model (bench_serve.build_model —
+param-bound like real serving), the same router, and the same
+workload phases:
+
+- ``unloaded``: short decode clients only;
+- ``storm1x``: shorts + N long-prompt clients;
+- ``storm2x``: shorts + 2N long-prompt clients (prefill offered load
+  doubled).
+
+Embedded assertions (the bench_churn.json artifact contract — a
+violation attaches ``failures`` and the artifact still lands):
+
+- **decode p99 stays flat on the split topology**: disaggregated
+  shorts' p99 at storm2x <= ``flat_factor`` (1.25) x its own unloaded
+  p99 — the prefill tier absorbs the storm, the decode tier never runs
+  a prefill longer than one short prompt;
+- **the collapsed baseline convoys**: collapsed shorts' p99 at
+  storm2x >= ``convoy_factor`` (2.0) x its unloaded p99, with
+  ``serve_prefill_convoy_total`` > 0 on its pods — the bench proves
+  the disease before claiming the cure;
+- **fixed-seed identity**: a long (prompt, seed) answered through the
+  disaggregated router (prefill → migrate → decode on another engine)
+  is token-identical to a local single-engine call, greedy AND
+  sampled — migration moves bytes and the PRNG carry, never the math;
+- **migration really happened**: blocks/s migrated > 0 in the storm
+  phases, with the per-token transfer overhead
+  (``serve_kv_migrate_seconds`` sum / migrated tokens emitted)
+  reported in the artifact.
+
+CPU-provable; wired into the non-gating bench_smoke tier as
+``bench_operator --disagg`` (artifact ``bench_disagg.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+
+log = logging.getLogger(__name__)
+
+from k8s_tpu.util.util import quantile_nearest as _quantile  # noqa: E402
+
+DEFAULT_FLAT_FACTOR = 1.25
+DEFAULT_CONVOY_FACTOR = 2.0
+
+
+def _short_prompt(rank: int, i: int, n: int = 8) -> list[int]:
+    return [(rank * 17 + i * 13 + j * 5 + 1) % 256 for j in range(n)]
+
+
+def _long_prompt(rank: int, i: int, n: int) -> list[int]:
+    return [(rank * 41 + i * 97 + j * 7 + 11) % 256 for j in range(n)]
+
+
+def _post(port: int, body: dict, timeout: float = 120.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class _Fleet:
+    """One measured topology: three serving pods, each a REAL OS
+    process pinned to its own CPU share, behind the real router.
+    ``disagg=True`` makes pod 0 the prefill tier and pods 1-2 the
+    decode tier with the phase split at ``phase_tokens``; otherwise
+    every pod is a collapsed single-role server."""
+
+    def __init__(self, *, disagg: bool, slots: int, phase_tokens: int,
+                 hidden: int, layers: int, block_size: int):
+        from k8s_tpu import router as router_mod
+
+        self.disagg = disagg
+        # the genjob --disagg default topology: ONE prefill pod feeding
+        # TWO decode pods (prefill is compute-dense and batch-friendly;
+        # decode is where the latency SLO lives), vs three collapsed
+        # pods on the identical hardware budget
+        roles = ("prefill", "decode", "decode") if disagg \
+            else ("", "", "")
+        # split the box's cores between the pods: the whole point of
+        # disaggregation is that the prefill tier's compute is NOT the
+        # decode tier's — an in-process fleet would share one XLA CPU
+        # thread pool and prefill would steal decode's cores in BOTH
+        # arms, erasing the effect this bench measures.  The collapsed
+        # baseline gets the identical split, so the hardware budget is
+        # the same in both arms.
+        cpus = sorted(os.sched_getaffinity(0)) \
+            if hasattr(os, "sched_getaffinity") else []
+        share = len(cpus) // len(roles)
+        cpu_sets = [cpus[i * share:(i + 1) * share] if share >= 1
+                    else None for i in range(len(roles))]
+        self.pods = [
+            _SubprocPod(role=roles[i], cpus=cpu_sets[i], slots=slots,
+                        hidden=hidden, layers=layers)
+            for i in range(len(roles))]
+        self.ports = [p.port for p in self.pods]
+        targets = []
+        for i, role in enumerate(roles):
+            if role == "prefill":
+                targets.append((f"pod-prefill-{i}",
+                                f"http://127.0.0.1:{self.ports[i]}",
+                                "prefill", None))
+            elif role == "decode":
+                targets.append((
+                    f"pod-decode-{i}",
+                    f"http://127.0.0.1:{self.ports[i]}", "decode",
+                    f"127.0.0.1:{self.pods[i].kvxfer_port}"))
+            else:
+                targets.append((f"pod-{i}",
+                                f"http://127.0.0.1:{self.ports[i]}"))
+        # fingerprint at the ENGINE's block size (read back from the
+        # pod — the affinity contract)
+        engine_block = int(self.serving_info(0).get("block_size")
+                           or block_size)
+        self.router = router_mod.Router(
+            lambda: targets, block_size=engine_block,
+            phase_split_tokens=phase_tokens if disagg else None,
+            request_timeout_s=120.0, refresh_interval_s=0.5)
+        self.server = router_mod.RouterServer(self.router)
+        self.server.start()
+        self.port = self.server.port
+
+    def metric_value(self, pod: int, family: str, suffix: str = ""
+                     ) -> float:
+        """One un-labeled sample value off pod ``pod``'s own /metrics
+        (the fleet parser — the same substrate production scrapes)."""
+        from k8s_tpu.fleet import parser
+
+        name = family + suffix
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.ports[pod]}/metrics",
+                timeout=30) as resp:
+            text = resp.read().decode()
+        for fam in parser.parse_exposition(text).values():
+            for sname, labels, value in fam.samples:
+                if sname == name and not labels:
+                    return float(value)
+        return 0.0
+
+    def serving_info(self, pod: int) -> dict:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.ports[pod]}/healthz",
+                timeout=30) as resp:
+            return json.loads(resp.read())["serving"]
+
+    def decode_pods(self) -> list[int]:
+        """Indices of the pods that can seat migrations (decode-role on
+        the split topology; nobody on the collapsed one)."""
+        return [i for i, p in enumerate(self.pods)
+                if p.kvxfer_port is not None]
+
+    def blocks_migrated(self) -> float:
+        return sum(self.metric_value(i, "serve_kv_blocks_migrated_total")
+                   for i in self.decode_pods())
+
+    def kv_imports(self) -> int:
+        return int(sum(int(self.serving_info(i).get("kv_imports") or 0)
+                       for i in self.decode_pods()))
+
+    def convoys(self) -> int:
+        return int(sum(self.metric_value(i, "serve_prefill_convoy_total")
+                       for i in range(len(self.pods))))
+
+    def stop(self) -> None:
+        self.server.stop()
+        for p in self.pods:
+            p.stop()
+
+
+class _SubprocPod:
+    """One serving pod as a REAL OS process (``bench_disagg --pod``),
+    optionally pinned to a CPU set: builds the same seed-deterministic
+    tiny model, runs LmServer + the HTTP listener, prints its ports,
+    and serves until killed."""
+
+    def __init__(self, *, role: str, cpus, slots: int, hidden: int,
+                 layers: int, timeout: float = 300.0):
+        import subprocess
+        import sys
+
+        cmd = [sys.executable, "-m", "k8s_tpu.harness.bench_disagg",
+               "--pod", "--slots", str(slots), "--hidden", str(hidden),
+               "--layers", str(layers)]
+        if role:
+            cmd += ["--role", role]
+        if cpus:
+            cmd += ["--cpus", ",".join(str(c) for c in cpus)]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo_root)
+        self.port = None
+        self.kvxfer_port = None
+        deadline = time.monotonic() + timeout
+        head: list[str] = []
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"disagg pod (role={role!r}) died during bring-up:\n"
+                    + "".join(head[-30:]))
+            head.append(line)
+            if line.startswith(POD_READY):
+                info = json.loads(line[len(POD_READY):])
+                self.port = info["port"]
+                self.kvxfer_port = info["kvxfer_port"]
+                break
+        else:
+            self.proc.kill()
+            raise RuntimeError(
+                f"disagg pod (role={role!r}) never became ready:\n"
+                + "".join(head[-30:]))
+        # drain the pipe so the child can never block on a full buffer
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self) -> None:
+        for _line in self.proc.stdout:
+            pass
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+        try:
+            self.proc.wait(timeout=30)
+        except Exception:  # noqa: BLE001  # except-ok: best-effort teardown of a KILLed pod
+            pass
+
+
+POD_READY = "DISAGG_POD "
+
+
+def _pod_main(args) -> int:
+    """``--pod`` mode: one serving pod process.  CPU affinity is
+    applied BEFORE jax imports so the XLA thread pool sizes to the
+    pod's share of the box, not the whole box."""
+    if args.cpus and hasattr(os, "sched_setaffinity"):
+        os.sched_setaffinity(0, {int(c) for c in args.cpus.split(",")})
+    from k8s_tpu.harness.bench_serve import build_model
+    from k8s_tpu.models import server as server_mod
+    from k8s_tpu.util import metrics as metrics_mod
+
+    config, params = build_model(0, hidden=args.hidden,
+                                 layers=args.layers)
+    lm = server_mod.LmServer(
+        config=config, params=params, slots=args.slots,
+        queue_limit=256, role=args.role or "",
+        kvxfer_port=0 if args.role == "decode" else None,
+        registry=metrics_mod.Registry())
+    httpd = server_mod.serve(lm)
+    print(POD_READY + json.dumps({
+        "port": httpd.server_address[1],
+        "kvxfer_port": lm._kv_receiver.port
+        if lm._kv_receiver is not None else None,
+        "role": args.role or "",
+    }), flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        lm.close()
+    return 0
+
+
+def _closed_loop_phase(fleet: _Fleet, *, shorts: int, longs: int,
+                       duration_s: float, max_new_short: int,
+                       max_new_long: int, long_len: int,
+                       phase_tag: int) -> dict:
+    """One measured phase: ``shorts`` closed-loop short-decode clients
+    (their latencies are THE metric) plus ``longs`` closed-loop
+    long-prompt clients (the offered prefill load), all through the
+    router, for ``duration_s``."""
+    lock = threading.Lock()
+    short_lat: list[float] = []
+    long_lat: list[float] = []
+    long_done = [0]
+    errors: list[str] = []
+    stop = threading.Event()
+    barrier = threading.Barrier(shorts + longs + 1)
+
+    def client(rank: int, is_long: bool) -> None:
+        barrier.wait()
+        time.sleep((rank % 7) * 0.003)  # desynchronize (bench_serve)
+        i = 0
+        while not stop.is_set():
+            if is_long:
+                body = {"tokens": _long_prompt(rank, i + phase_tag * 1000,
+                                               long_len),
+                        "max_new_tokens": max_new_long}
+            else:
+                body = {"tokens": _short_prompt(rank, i),
+                        "max_new_tokens": max_new_short}
+            t0 = time.monotonic()
+            try:
+                out = _post(fleet.port, body)
+                if "tokens" not in out:
+                    raise RuntimeError(f"bad response: {out}")
+            except Exception as e:  # noqa: BLE001 - count, don't crash
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                i += 1
+                continue
+            dt = time.monotonic() - t0
+            with lock:
+                if is_long:
+                    long_lat.append(dt)
+                    long_done[0] += 1
+                else:
+                    short_lat.append(dt)
+            i += 1
+
+    threads = [threading.Thread(target=client, args=(r, False),
+                                daemon=True) for r in range(shorts)]
+    threads += [threading.Thread(target=client, args=(100 + r, True),
+                                 daemon=True) for r in range(longs)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+    short_lat.sort()
+    long_lat.sort()
+    return {
+        "shorts": shorts,
+        "longs": longs,
+        "duration_s": duration_s,
+        "short_requests": len(short_lat),
+        "long_requests": long_done[0],
+        "errors": errors[:5],
+        "error_count": len(errors),
+        "short_p50_s": round(_quantile(short_lat, 0.50), 4)
+        if short_lat else None,
+        "short_p99_s": round(_quantile(short_lat, 0.99), 4)
+        if short_lat else None,
+        "long_p50_s": round(_quantile(long_lat, 0.50), 4)
+        if long_lat else None,
+    }
+
+
+def _run_arm(*, disagg: bool, slots: int, phase_tokens: int,
+             shorts: int, longs: int, duration_s: float,
+             max_new_short: int, max_new_long: int, long_len: int,
+             hidden: int, layers: int,
+             identity_probes: list | None = None) -> dict:
+    fleet = _Fleet(disagg=disagg, slots=slots,
+                   phase_tokens=phase_tokens, hidden=hidden,
+                   layers=layers, block_size=16)
+    try:
+        # warm every program DIRECTLY on the pods that will run it, so
+        # no phase pays a compile: EVERY prefill bucket (prefix-reuse
+        # CoW tails decompose into arbitrary bucket chains — a shared
+        # prefix mid-storm would otherwise compile bucket 1/2/4
+        # programs and bill seconds to an unlucky request), the short
+        # and long shapes, plus one full migration to warm gather/graft
+        # on the split topology
+        blen = 1
+        buckets = []
+        while blen < long_len:
+            buckets.append(blen)
+            blen *= 2
+        for port in fleet.ports:
+            for blen in buckets:
+                _post(port, {"tokens": _short_prompt(901, blen, blen),
+                             "max_new_tokens": 1})
+            _post(port, {"tokens": _short_prompt(900, 0),
+                         "max_new_tokens": max_new_short})
+        if disagg:
+            for i in fleet.decode_pods():
+                kv = f"127.0.0.1:{fleet.pods[i].kvxfer_port}"
+                _post(fleet.ports[0],
+                      {"tokens": _long_prompt(900, i, long_len),
+                       "max_new_tokens": max_new_long, "kv_dest": kv})
+        else:
+            for port in fleet.ports:
+                _post(port, {"tokens": _long_prompt(900, 0, long_len),
+                             "max_new_tokens": max_new_long})
+        identity = None
+        if identity_probes:
+            # fixed-seed identity THROUGH the full hop (router phase
+            # split → prefill engine → socket migration → decode
+            # engine) vs the parent-side local reference
+            identity = {}
+            for lane, body, expected in identity_probes:
+                routed = _post(fleet.port, body)["tokens"]
+                identity[lane] = {"ok": routed == expected,
+                                  "local": expected, "routed": routed}
+            identity["migrations"] = fleet.kv_imports()
+        # unrecorded settle pass: the first seconds after server/router
+        # bring-up carry one-time costs (thread-pool spin-up, first-use
+        # allocator growth) that would land as phantom outliers in the
+        # unloaded baseline's p99 — the ratio assertions compare steady
+        # states, not cold starts
+        _closed_loop_phase(fleet, shorts=shorts, longs=0,
+                           duration_s=min(2.5, duration_s),
+                           max_new_short=max_new_short,
+                           max_new_long=max_new_long,
+                           long_len=long_len, phase_tag=9)
+        phases = {}
+        for tag, (name, n_long) in enumerate((
+                ("unloaded", 0), ("storm1x", longs),
+                ("storm2x", 2 * longs))):
+            blocks_before = fleet.blocks_migrated() if disagg else 0.0
+            t0 = time.monotonic()
+            phases[name] = _closed_loop_phase(
+                fleet, shorts=shorts, longs=n_long,
+                duration_s=duration_s, max_new_short=max_new_short,
+                max_new_long=max_new_long, long_len=long_len,
+                phase_tag=tag)
+            wall = time.monotonic() - t0
+            if disagg:
+                migrated = fleet.blocks_migrated() - blocks_before
+                phases[name]["blocks_migrated"] = int(migrated)
+                phases[name]["blocks_per_s_migrated"] = round(
+                    migrated / wall, 1)
+        out = {
+            "topology": "disaggregated" if disagg else "collapsed",
+            "phases": phases,
+            "prefill_convoys_total": fleet.convoys(),
+        }
+        if identity is not None:
+            out["identity"] = identity
+        if disagg:
+            # per-token transfer overhead: total sender-side migration
+            # seconds (send -> seated ack) over the tokens migrated
+            # requests emitted on the decode tier
+            mig_sum = fleet.metric_value(0, "serve_kv_migrate_seconds",
+                                         "_sum")
+            mig_count = fleet.metric_value(0, "serve_kv_migrate_seconds",
+                                           "_count")
+            long_tokens = sum(
+                p["long_requests"] for p in phases.values()) \
+                * max_new_long
+            out["migrations"] = int(mig_count)
+            out["migrate_seconds_total"] = round(mig_sum, 4)
+            out["migrate_s_per_migration"] = round(
+                mig_sum / mig_count, 5) if mig_count else None
+            out["transfer_overhead_s_per_token"] = round(
+                mig_sum / long_tokens, 6) if long_tokens else None
+            out["kv_exports"] = \
+                int(fleet.serving_info(0).get("kv_exports") or 0)
+            out["kv_imports"] = fleet.kv_imports()
+        return out
+    finally:
+        fleet.stop()
+
+
+def _reference_outputs(long_len: int, max_new: int, hidden: int,
+                       layers: int) -> list:
+    """The parent-side local oracle: greedy + sampled outputs for the
+    identity probe prompt from ONE local engine (the engine's own
+    batching-invariance tests make this the canonical local answer).
+    The engine is torn down before any pod spawns, so its compiles
+    never share the box with a measured phase."""
+    import numpy as np
+
+    from k8s_tpu.harness.bench_serve import build_model
+    from k8s_tpu.models.engine import Engine
+
+    config, params = build_model(0, hidden=hidden, layers=layers)
+    engine = Engine(config, params, slots=2, queue_limit=16)
+    try:
+        probes = []
+        prompt = _long_prompt(7, 7, long_len)
+        for lane, extra in (("greedy", {}),
+                            ("sampled", {"temperature": 1.0, "top_k": 7,
+                                         "seed": 1234})):
+            local = [int(t) for t in engine.submit(
+                np.asarray(prompt, np.int32), max_new,
+                temperature=float(extra.get("temperature", 0.0)),
+                top_k=extra.get("top_k"),
+                seed=int(extra.get("seed", 0)))]
+            probes.append((lane,
+                           {"tokens": prompt, "max_new_tokens": max_new,
+                            **extra},
+                           local))
+        return probes
+    finally:
+        engine.shutdown()
+
+
+def run_bench(shorts: int = 4, longs: int = 3, slots: int = 12,
+              duration_s: float = 4.0, max_new_short: int = 17,
+              max_new_long: int = 5, long_len: int = 112,
+              phase_tokens: int = 48, hidden: int = 256,
+              layers: int = 4,
+              flat_factor: float = DEFAULT_FLAT_FACTOR,
+              convoy_factor: float = DEFAULT_CONVOY_FACTOR) -> dict:
+    failures: list[str] = []
+    probes = _reference_outputs(long_len, 12, hidden, layers)
+
+    arms = {}
+    # the disaggregated arm runs FIRST: whichever arm runs first also
+    # absorbs the parent process's one-time costs (client threads,
+    # router code paths) as a fatter unloaded tail, which INFLATES its
+    # baseline and dilutes its storm ratio — that bias is conservative
+    # for the flatness assertion and must not dilute the collapsed
+    # arm's convoy ratio
+    for disagg in (True, False):
+        arms["disaggregated" if disagg else "collapsed"] = _run_arm(
+            disagg=disagg, slots=slots,
+            phase_tokens=phase_tokens, shorts=shorts, longs=longs,
+            duration_s=duration_s, max_new_short=max_new_short,
+            max_new_long=max_new_long, long_len=long_len,
+            hidden=hidden, layers=layers,
+            identity_probes=probes if disagg else None)
+
+    identity = arms["disaggregated"].pop("identity")
+    for lane in ("greedy", "sampled"):
+        if not identity[lane]["ok"]:
+            failures.append(
+                f"fixed-seed {lane} output through the disaggregated "
+                f"hop differs from local: migration changed the math "
+                f"(local {identity[lane]['local'][:6]}... vs routed "
+                f"{identity[lane]['routed'][:6]}...)")
+    if identity["migrations"] < 1:
+        failures.append(
+            "identity probes never migrated: the phase split did not "
+            "route through the prefill tier")
+
+    dis, col = arms["disaggregated"], arms["collapsed"]
+    for name, arm in arms.items():
+        errs = sum(p["error_count"] for p in arm["phases"].values())
+        if errs:
+            failures.append(
+                f"{name} arm: {errs} request error(s) "
+                f"(first: {next(p['errors'] for p in arm['phases'].values() if p['errors'])})")
+
+    def _ratio(arm) -> tuple:
+        base = arm["phases"]["unloaded"]["short_p99_s"]
+        stormed = arm["phases"]["storm2x"]["short_p99_s"]
+        if not base or not stormed:
+            return None, base, stormed
+        return stormed / base, base, stormed
+
+    dis_ratio, dis_base, dis_storm = _ratio(dis)
+    col_ratio, col_base, col_storm = _ratio(col)
+    if dis_ratio is None or col_ratio is None:
+        failures.append("a phase produced no short-request latencies")
+    else:
+        if dis_ratio > flat_factor:
+            failures.append(
+                f"disaggregated decode p99 degraded {dis_ratio:.2f}x "
+                f"({dis_base}s -> {dis_storm}s) under a doubled prefill "
+                f"storm (bound {flat_factor}x): the prefill tier is not "
+                "absorbing the storm")
+        if col_ratio < convoy_factor:
+            failures.append(
+                f"collapsed decode p99 only degraded {col_ratio:.2f}x "
+                f"({col_base}s -> {col_storm}s) under the storm (expected "
+                f">= {convoy_factor}x): the workload no longer convoys, "
+                "so this bench proves nothing — retune it")
+    if col["prefill_convoys_total"] < 1:
+        failures.append(
+            "collapsed arm recorded zero prefill convoys: the storm "
+            "never actually stalled a decode-ready slot")
+    storm_blocks = sum(
+        dis["phases"][p].get("blocks_migrated", 0)
+        for p in ("storm1x", "storm2x"))
+    if storm_blocks < 1:
+        failures.append(
+            "no KV blocks migrated during the storm phases: the "
+            "disaggregated arm never exercised the transfer plane")
+
+    result = {
+        "metric": "disagg_decode_p99_ratio_under_2x_prefill",
+        "value": round(dis_ratio, 3) if dis_ratio else None,
+        "unit": "x_vs_unloaded",
+        "collapsed_ratio": round(col_ratio, 3) if col_ratio else None,
+        "flat_factor_bound": flat_factor,
+        "convoy_factor_bound": convoy_factor,
+        "model": {"hidden": hidden, "layers": layers},
+        "workload": {"shorts": shorts, "longs": longs,
+                     "long_len": long_len, "phase_tokens": phase_tokens,
+                     "max_new_short": max_new_short,
+                     "max_new_long": max_new_long,
+                     "duration_s": duration_s, "slots": slots},
+        "identity": {
+            "greedy_ok": identity["greedy"]["ok"],
+            "sampled_ok": identity["sampled"]["ok"],
+            "migrations": identity["migrations"],
+        },
+        "collapsed": col,
+        "disaggregated": dis,
+    }
+    if failures:
+        result["failures"] = failures
+        err = RuntimeError("disagg bench assertions failed:\n  "
+                           + "\n  ".join(failures))
+        err.result = result
+        raise err
+    return result
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--shorts", type=int, default=4)
+    p.add_argument("--longs", type=int, default=3)
+    p.add_argument("--slots", type=int, default=12)
+    p.add_argument("--duration", type=float, default=4.0)
+    p.add_argument("--long-len", type=int, default=112)
+    p.add_argument("--phase-tokens", type=int, default=48)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--out", default=None)
+    # --pod mode: run as ONE serving pod process (spawned by _Fleet)
+    p.add_argument("--pod", action="store_true",
+                   help="internal: run as one serving pod process")
+    p.add_argument("--role", default="",
+                   choices=("", "prefill", "decode"))
+    p.add_argument("--cpus", default="",
+                   help="internal: comma-separated CPU affinity set")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.WARNING)
+    if args.pod:
+        return _pod_main(args)
+
+    def _write(payload: dict) -> None:
+        line = json.dumps(payload)
+        print(line)
+        if args.out:
+            import os
+
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+
+    try:
+        result = run_bench(
+            shorts=args.shorts, longs=args.longs, slots=args.slots,
+            duration_s=args.duration, long_len=args.long_len,
+            phase_tokens=args.phase_tokens, hidden=args.hidden,
+            layers=args.layers)
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write(partial)
+        raise
+    _write(result)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

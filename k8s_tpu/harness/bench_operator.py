@@ -1461,6 +1461,32 @@ def run_serve(args) -> dict:
     return result
 
 
+def run_disagg(args) -> dict:
+    """The --disagg scenario wrapper (ISSUE 15): disaggregated
+    prefill/decode serving (harness/bench_disagg.py — two REAL engines
+    per arm behind the real router, KV block chains migrating over real
+    sockets; decode-p99-flat vs collapsed-convoy, fixed-seed
+    migrated-vs-local identity, and blocks/s + per-token transfer
+    overhead EMBEDDED), on the one-JSON-line contract.  The
+    bench_disagg.json artifact is written on assertion failure too,
+    ``failures`` included."""
+    from k8s_tpu.harness import bench_disagg
+
+    try:
+        result = bench_disagg.run_bench(
+            shorts=args.disagg_shorts,
+            longs=args.disagg_longs,
+            duration_s=args.disagg_duration,
+            long_len=args.disagg_long_len)
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write_artifact(args.disagg_out, partial)
+        raise
+    _write_artifact(args.disagg_out, result)
+    return result
+
+
 def run_serve_mp(args) -> dict:
     """The --serve-mp scenario wrapper (ISSUE 14): the multi-host
     tensor-parallel serving bench (harness/bench_serve_mp.py — a REAL
@@ -2387,6 +2413,27 @@ def main(argv=None) -> int:
                    "dominant-phase counts, engine step-ledger rollups, "
                    "slowest timelines) as a requests_audit.json "
                    "artifact — written on failed runs too (ISSUE 12)")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated prefill/decode serving scenario "
+                   "(ISSUE 15): two real engines per arm behind the "
+                   "real router, long-prompt storms migrating KV block "
+                   "chains to the decode tier over real sockets — "
+                   "decode p99 stays flat on the split topology while "
+                   "the collapsed baseline convoys; fixed-seed "
+                   "migrated-vs-local identity embedded")
+    p.add_argument("--disagg-shorts", type=int, default=4,
+                   help="closed-loop short-decode clients (their p99 "
+                   "is the metric)")
+    p.add_argument("--disagg-longs", type=int, default=3,
+                   help="long-prompt storm clients at 1x (storm2x "
+                   "doubles this)")
+    p.add_argument("--disagg-duration", type=float, default=4.0,
+                   help="seconds per measured phase")
+    p.add_argument("--disagg-long-len", type=int, default=112,
+                   help="long-prompt token length")
+    p.add_argument("--disagg-out", default=None,
+                   help="write the bench_disagg.json artifact here "
+                   "(written on assertion failure too)")
     p.add_argument("--serve-mp", action="store_true",
                    help="multi-host tensor-parallel serving gang bench "
                    "(harness/bench_serve_mp.py: 1-process vs N-process "
@@ -2559,7 +2606,7 @@ def _run(args, p) -> int:
 
     if args.slice_scale or args.measure_restart or args.contention \
             or args.serve or args.serve_mp or args.churn or args.fleet \
-            or args.router:
+            or args.router or args.disagg:
         if args.backend != "fake" and (args.slice_scale
                                        or args.measure_restart
                                        or args.contention or args.churn
@@ -2592,6 +2639,10 @@ def _run(args, p) -> int:
             results.append(run_router(args))
         if args.serve:
             results.append(run_serve(args))
+        if args.disagg:
+            # real engines + real sockets like --serve; runs after it
+            # so the JAX warmup cost is already paid in-process
+            results.append(run_disagg(args))
         if args.serve_mp:
             # real OS-process gangs: runs last so the in-process
             # scenarios' timings aren't perturbed by gang spawn load

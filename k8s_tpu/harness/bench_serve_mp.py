@@ -112,6 +112,7 @@ def run_bench(processes: int = 4, requests: int = 24, max_new: int = 24,
         c = res.chief_result
         arms[n] = {
             "num_processes": c["num_processes"],
+            "plan_bus": c.get("plan_bus"),
             "tp_degree": c["tp_degree"],
             "tokens": c["tokens"],
             "wall_s": c["wall_s"],
@@ -171,6 +172,32 @@ def run_bench(processes: int = 4, requests: int = 24, max_new: int = 24,
                 "floor): the plan/collective machinery is eating the "
                 "mesh (serialized steps? pool re-gather? per-step "
                 "recompile?)")
+        # -- plan pipelining overlap (ISSUE 15 satellite) --------------
+        # the chief's broadcast must be an enqueue, not a socket wait:
+        # total enqueue-wait seconds a small fraction of the sender
+        # thread's actual send seconds proves the dispatch really
+        # overlaps the bus I/O (an un-pipelined bus has enqueue == send
+        # by definition, which fails this)
+        bus = many.get("plan_bus") or {}
+        result["plan_bus"] = bus
+        if not bus.get("pipelined"):
+            failures.append(
+                "plan bus is not pipelined: chunked-prefill broadcasts "
+                "serialize behind socket I/O again")
+        elif bus.get("send_error"):
+            failures.append(
+                f"plan bus sender died mid-run: {bus['send_error']}")
+        elif bus.get("broadcasts", 0) > 0:
+            enq = bus.get("enqueue_wait_s", 0.0)
+            snd = bus.get("send_s", 0.0)
+            result["plan_overlap_ratio"] = round(
+                enq / snd, 4) if snd else None
+            if enq > max(0.5 * snd, 0.005 * bus["broadcasts"]):
+                failures.append(
+                    f"plan enqueue wait {enq}s is not small vs send "
+                    f"{snd}s over {bus['broadcasts']} broadcasts: the "
+                    "pipeline is not overlapping (queue backpressure or "
+                    "a lock on the enqueue path)")
         # -- compile budgets per process -------------------------------
         for label, audit in [("chief-1p", one.get("compile_ledger")),
                              (f"chief-{processes}p",

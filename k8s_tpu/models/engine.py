@@ -87,6 +87,18 @@ broadcast to worker processes over a stdlib plan bus and sampled tokens
 collected replicated).  ``K8S_TPU_SERVE_MESH`` / ``K8S_TPU_SERVE_TP``
 select the mesh placement; unset keeps this file's original behavior.
 
+Round 15 (ISSUE 15): the engine disaggregates.  :meth:`Engine.
+prefill_export` runs a request in prefill-only mode — the ordinary
+slot path (prefix reuse, tree insert), first token, then the block
+chain exported host-side in ONE gather call and the slot released, no
+decode seat held — and :meth:`Engine.submit_prefilled` seats a request
+directly from an imported chain (one graft scatter into fresh blocks,
+tree graft so the migrated prefix is immediately shareable, the
+migrated PRNG carry continuing the exact key schedule).  The wire
+between them is models/kvxfer.py; models/server.py owns the role
+routing (``K8S_TPU_SERVE_ROLE``).  Fixed-seed migrated output is
+token-identical to local output on every lane by construction.
+
 Round 12: the engine narrates itself per request.  With
 ``K8S_TPU_REQUEST_LOG=1`` (models/requestlog.py) every request gets a
 bounded timeline — queue wait, prefill chunks with the prefix-reuse
@@ -205,6 +217,50 @@ class EngineClosed(RuntimeError):
     pass
 
 
+class PoolExhausted(RuntimeError):
+    """The KV block pool cannot take an imported block chain right now
+    (disaggregated receive-side backpressure, ISSUE 15): every free and
+    tree-evictable block counted, the migrated chain still does not
+    fit.  The sender maps this to a 503-class refusal so the router's
+    retry walk re-places the request instead of wedging the decode
+    pod."""
+
+    def __init__(self, needed: int, available: int,
+                 retry_after_s: float = 1.0):
+        super().__init__(
+            f"KV pool cannot seat {needed} migrated blocks "
+            f"({available} free+evictable)")
+        self.needed = needed
+        self.available = available
+        self.retry_after_s = retry_after_s
+
+
+def _flatten_tree(tree) -> dict:
+    """Nested-dict pytree → flat ``{"a/b/k": np.ndarray}`` host dict
+    (the kv-transfer wire shape; models/kvxfer.py never sees a pytree)."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        # sync-ok: export boundary — one host fetch per exported block
+        # chain, never per decode step
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _unflatten_tree(flat: dict) -> dict:
+    root: dict = {}
+    for path, arr in flat.items():
+        parts = path.split("/")
+        node = root
+        for k in parts[:-1]:
+            node = node.setdefault(k, {})
+        node[parts[-1]] = arr
+    return root
+
+
 @dataclasses.dataclass
 class _Request:
     """One queued unit of work: either a batched generation (``ids``
@@ -218,6 +274,12 @@ class _Request:
     seed: int = 0
     speculative: int = 0  # draft_k (>= 2) for batched spec; 0 = off
     fn: Optional[Callable[[], Any]] = None
+    # disaggregated serving (ISSUE 15): a prefill-only request emits
+    # first token + block manifest and retires without a decode slot; a
+    # manifest-carrying request seats directly from imported blocks
+    export: bool = False
+    manifest: Optional[dict] = None
+    seated_cb: Optional[Callable[[], None]] = None
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     result: Any = None
@@ -384,9 +446,32 @@ class Engine:
             self._cow_fn = self._placement.wrap(
                 "cow", self._compute.cow, donate_argnums=(0,),
                 resident_argnums=(0,))
+            # disaggregated block export/import (ISSUE 15): two
+            # shape-constant programs — gather one block to the host,
+            # graft one received block into a fresh local block.  A
+            # mesh placement has no single-host pool to export from;
+            # disaggregation composes tiers of single-host (or whole-
+            # gang) pods, so the seams stay local-only for now.
+            if not self._placement.is_mesh:
+                self._gather_fn = self._placement.wrap(
+                    "kv_gather", self._compute.gather_blocks,
+                    resident_argnums=(0,))
+                self._graft_fn = self._placement.wrap(
+                    "kv_graft", self._compute.graft_blocks,
+                    donate_argnums=(0,), resident_argnums=(0,))
+            else:
+                self._gather_fn = None
+                self._graft_fn = None
             self._pool = self._placement.build_pool(
                 self._compute.pool_manifest(self.params, self.pool_blocks,
                                             self.block_size))
+            # wire-manifest metadata: {leaf path: (per-block tail shape,
+            # dtype str)} — what submit_prefilled validates an imported
+            # chain against before any device work (shapes/dtypes are
+            # host metadata; no transfer happens here)
+            self._pool_leaf_meta = {
+                path: (tuple(leaf.shape[2:]), str(leaf.dtype))
+                for path, leaf in self._iter_pool_leaves()}
             self._row_template = None  # dense-mode only; a dense
             # [1, max_seq_len] row would idle on device forever
             self._pool_alloc = BlockPool(self.pool_blocks)
@@ -410,6 +495,9 @@ class Engine:
             self._pool = None
             self._pool_alloc = None
             self._tree = None
+            self._gather_fn = None
+            self._graft_fn = None
+            self._pool_leaf_meta = {}
 
         # runtime compile ledger (ISSUE 11, K8S_TPU_COMPILE_LEDGER=1):
         # every jit entry point becomes a declared SEAM with the compile
@@ -436,6 +524,11 @@ class Engine:
         # stats (mutated on the engine thread; read under _cond)
         self._steps = 0
         self._completed = 0
+        # disaggregated migration counters (ISSUE 15)
+        self._kv_exports = 0
+        self._kv_imports = 0
+        self._kv_blocks_out = 0
+        self._kv_blocks_in = 0
         self._peak_active = 0
         self._prefix_hits = 0
         self._prefix_tokens_saved = 0
@@ -449,6 +542,14 @@ class Engine:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="lm-engine")
         self._thread.start()
+
+    def _iter_pool_leaves(self):
+        import jax
+
+        flat = jax.tree_util.tree_flatten_with_path(self._pool)[0]
+        for path, leaf in flat:
+            yield "/".join(str(getattr(k, "key", k))
+                           for k in path), leaf
 
     # ------------------------------------------------------------------ API
 
@@ -465,12 +566,47 @@ class Engine:
         ``make_speculative_generate_fn`` program.  Returns emitted
         tokens, stopping at the first EOS inclusive.  Raises QueueFull
         under backpressure."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        # same bounds the unbatched jits enforce at trace time, surfaced
+        # BEFORE the request occupies queue space (an over-capacity row
+        # would wrap slot = pos % S and corrupt its own cache row)
+        self._validate_gen_args(ids, int(max_new_tokens),
+                                float(temperature), top_k,
+                                int(speculative))
+        req = _Request(ids=ids, max_new_tokens=int(max_new_tokens),
+                       eos_id=eos_id, temperature=float(temperature),
+                       top_k=top_k, seed=int(seed),
+                       speculative=int(speculative), trace_ctx=trace_ctx)
+        req.t_submit = time.monotonic()
+        if self._reqlog is not None:
+            req.rid = self._reqlog.begin(
+                int(ids.size), int(max_new_tokens),
+                temperature=float(temperature), top_k=top_k,
+                speculative=int(speculative),
+                trace_id=trace_ctx[0] if trace_ctx else None)
+        return self._enqueue_and_wait(req, timeout)
+
+    def _check_disagg_ready(self) -> None:
+        if not self.paged:
+            raise ValueError(
+                "disaggregated serving needs the paged block pool; "
+                "windowed configs keep dense per-slot rows")
+        if self._gather_fn is None or self._graft_fn is None:
+            raise ValueError(
+                "disaggregated serving tiers are single-host engines; "
+                "a mesh placement has no local pool to export/import "
+                "(compose disaggregation ACROSS gangs, not inside one)")
+
+    def _validate_gen_args(self, ids, max_new_tokens: int,
+                           temperature: float, top_k: Optional[int],
+                           speculative: int) -> None:
+        """The submit()-shape validation shared by every slot-seating
+        entry point (batched, prefill-export, seat-from-import)."""
         from k8s_tpu.models.decode import (
             _check_cache_capacity,
             check_speculative_capacity,
         )
 
-        ids = np.asarray(ids, np.int32).reshape(-1)
         if ids.size < 1:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -491,29 +627,181 @@ class Engine:
             if ids.size < 2:
                 raise ValueError(
                     "prompt-lookup drafting needs prompt_len >= 2")
-            # the final verify writes draft positions past the emitted
-            # length; same trace-time bound as the exclusive lane,
-            # surfaced before the request occupies queue space
             check_speculative_capacity(self.config, int(ids.size),
                                        int(max_new_tokens),
                                        int(speculative))
-        # same bound the unbatched jit enforces at trace time, surfaced
-        # BEFORE the request occupies queue space (an over-capacity row
-        # would wrap slot = pos % S and corrupt its own cache row)
         _check_cache_capacity(self.config, int(ids.size),
                               int(max_new_tokens))
+
+    def prefill_export(self, ids, max_new_tokens: int,
+                       eos_id: Optional[int] = None,
+                       temperature: float = 0.0,
+                       top_k: Optional[int] = None, seed: int = 0,
+                       speculative: int = 0,
+                       timeout: Optional[float] = None,
+                       trace_ctx: Optional[tuple] = None) -> dict:
+        """Prefill-only mode (ISSUE 15): chunk-prefill the prompt
+        through the normal slot path (prefix reuse, tree insert — the
+        prefill tier's radix trees compose exactly like a serving
+        pod's), emit the first token, then EXPORT the request's block
+        chain to the host and retire — no decode slot is held past the
+        prefill, so a prefill tier never convoys its own admissions
+        behind decodes it is not running.
+
+        Returns the migration manifest: ``ids``/``first``/``key`` (the
+        PRNG carry — the decode pod continues the exclusive lane's
+        exact key schedule)/``blocks`` (flat ``{leaf path: [n_blocks,
+        block_size, ...]}`` host arrays)/``n_blocks``/``block_size``,
+        plus ``done`` + ``tokens`` when the generation finished at the
+        first token (first-token EOS / ``max_new_tokens == 1`` — no
+        migration needed), and ``rid`` so the HTTP layer can close the
+        request timeline with the transfer span.  Raises QueueFull
+        under backpressure like :meth:`submit`."""
+        self._check_disagg_ready()
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        self._validate_gen_args(ids, int(max_new_tokens),
+                                float(temperature), top_k,
+                                int(speculative))
         req = _Request(ids=ids, max_new_tokens=int(max_new_tokens),
                        eos_id=eos_id, temperature=float(temperature),
                        top_k=top_k, seed=int(seed),
-                       speculative=int(speculative), trace_ctx=trace_ctx)
+                       speculative=int(speculative), export=True,
+                       trace_ctx=trace_ctx)
         req.t_submit = time.monotonic()
         if self._reqlog is not None:
             req.rid = self._reqlog.begin(
                 int(ids.size), int(max_new_tokens),
                 temperature=float(temperature), top_k=top_k,
-                speculative=int(speculative),
+                speculative=int(speculative), kind="prefill_export",
                 trace_id=trace_ctx[0] if trace_ctx else None)
         return self._enqueue_and_wait(req, timeout)
+
+    def submit_prefilled(self, ids, blocks: dict, *, first_token: int,
+                         key, max_new_tokens: int,
+                         eos_id: Optional[int] = None,
+                         temperature: float = 0.0,
+                         top_k: Optional[int] = None,
+                         speculative: int = 0,
+                         block_size: Optional[int] = None,
+                         timeout: Optional[float] = None,
+                         trace_id: Optional[str] = None,
+                         seated: Optional[Callable[[], None]] = None
+                         ) -> list[int]:
+        """Seat a request DIRECTLY from an imported block chain (the
+        decode half of disaggregated serving, ISSUE 15): graft the
+        received blocks into the local pool, insert the prompt's
+        full-block runs into the local prefix tree (a migrated prefix
+        is immediately shareable), and join the batched decode lanes at
+        position ``len(ids)`` with ``first_token`` as the last emitted
+        token and ``key`` as the PRNG carry — fixed-seed output is
+        token-identical to a local prefill by construction (same pool
+        bytes, same key schedule, row-independent batched math).
+
+        ``blocks`` is the sender's flat ``{leaf path: [n_blocks,
+        block_size, ...]}`` manifest; structural mismatches (paths,
+        shapes, an int8 pool fed non-int8 content) refuse with
+        ValueError BEFORE any device work, and a pool that cannot fit
+        the chain even after evicting every unpinned tree leaf refuses
+        with :class:`PoolExhausted` (receive-side backpressure — the
+        sender's router re-places the request).  ``seated()`` fires on
+        the engine thread the moment the request holds its slot (the
+        kv-transfer plane's ack seam; keep it O(set-an-event)).
+        Returns the full emitted token list, ``first_token``
+        included."""
+        self._check_disagg_ready()
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        self._validate_gen_args(ids, int(max_new_tokens),
+                                float(temperature), top_k,
+                                int(speculative))
+        bs = self.block_size if block_size is None else int(block_size)
+        if bs != self.block_size:
+            raise ValueError(
+                f"imported block_size {bs} != engine block_size "
+                f"{self.block_size}: disaggregated tiers must serve the "
+                "same artifact with the same bucket set")
+        n = math.ceil(int(ids.size) / self.block_size)
+        missing = set(self._pool_leaf_meta) - set(blocks)
+        extra = set(blocks) - set(self._pool_leaf_meta)
+        if missing or extra:
+            raise ValueError(
+                f"imported chain does not match the pool manifest "
+                f"(missing {sorted(missing)[:4]}, extra "
+                f"{sorted(extra)[:4]})")
+        for path, arr in blocks.items():
+            tail, dtype = self._pool_leaf_meta[path]
+            want = (n, self.block_size) + tail
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"imported leaf {path} has shape {tuple(arr.shape)}"
+                    f", expected {want}")
+            if dtype == "int8" and str(arr.dtype) != "int8":
+                raise ValueError(
+                    f"imported leaf {path} is {arr.dtype} but the pool "
+                    "stores int8: quantized pools migrate their native "
+                    "leaves bit-exact (no wire re-quantization)")
+        # receive-side backpressure: refuse BEFORE queuing when the
+        # chain cannot fit even after evicting every unpinned tree leaf
+        # (best-effort read — pool state moves on the engine thread,
+        # and the seat-time allocation path re-checks for real)
+        with self._cond:
+            try:
+                available = self._pool_alloc.free_blocks \
+                    + self._evictable_blocks()
+            # except-ok: the tree mutates on the engine thread without
+            # this lock; a torn walk must not refuse a seatable chain —
+            # the seat-time allocation path is the real check
+            except RuntimeError:  # noqa: BLE001
+                available = n
+        if available < n:
+            raise PoolExhausted(n, available)
+        req = _Request(ids=ids, max_new_tokens=int(max_new_tokens),
+                       eos_id=eos_id, temperature=float(temperature),
+                       top_k=top_k, speculative=int(speculative),
+                       manifest={
+                           "first": int(first_token),
+                           "key": np.asarray(key, np.uint32).reshape(2),
+                           "n_blocks": n,
+                           "nested": _unflatten_tree(blocks),
+                       },
+                       seated_cb=seated)
+        req.t_submit = time.monotonic()
+        if self._reqlog is not None:
+            req.rid = self._reqlog.begin(
+                int(ids.size), int(max_new_tokens),
+                temperature=float(temperature), top_k=top_k,
+                speculative=int(speculative), kind="migrated",
+                trace_id=trace_id)
+        return self._enqueue_and_wait(req, timeout)
+
+    def _evictable_blocks(self) -> int:
+        """Tree blocks eviction could EVENTUALLY free for an import
+        (caller holds ``_cond`` or accepts a benign best-effort read).
+        ``evict_one`` only removes leaves, but freeing a leaf exposes
+        its parent — so a whole unpinned chain is evictable bottom-up,
+        and counting only the current leaves would refuse imports a
+        warm pod (pool mostly tree-held chains) can in fact seat.  A
+        node counts iff nothing else pins it AND its entire subtree is
+        unpinned (a pinned descendant never becomes removable, so its
+        ancestors never become leaves)."""
+        if self._tree is None:
+            return 0
+        count = 0
+
+        def walk(node) -> bool:
+            nonlocal count
+            subtree_ok = True
+            for child in node.children.values():
+                if not walk(child):
+                    subtree_ok = False
+            if not subtree_ok \
+                    or self._pool_alloc.refcount(node.block) != 1:
+                return False
+            count += 1
+            return True
+
+        for child in self._tree.root.children.values():
+            walk(child)
+        return count
 
     def submit_exclusive(self, fn: Callable[[], Any],
                          timeout: Optional[float] = None,
@@ -572,6 +860,13 @@ class Engine:
         if req.error is not None:
             raise req.error
         return req.result
+
+    @property
+    def disagg_capable(self) -> bool:
+        """True when this engine can export/import KV block chains
+        (paged, single-host placement) — what the server gates the
+        kv-transfer plane on."""
+        return self.paged and self._gather_fn is not None
 
     @property
     def healthy(self) -> bool:
@@ -642,6 +937,12 @@ class Engine:
                 "cow_copies": self._cow_copies,
                 "tree_evictions": self._tree.evictions
                 if self._tree else 0,
+                # disaggregated migration surface (ISSUE 15): chains
+                # exported to decode pods / imported block chains seated
+                "kv_exports": self._kv_exports,
+                "kv_imports": self._kv_imports,
+                "kv_blocks_out": self._kv_blocks_out,
+                "kv_blocks_in": self._kv_blocks_in,
                 # request recorder binding (ISSUE 12): whether this
                 # engine records per-request timelines
                 "request_log": self._reqlog is not None,
@@ -724,7 +1025,20 @@ class Engine:
                 static_argnums=(7, 8))
             self._cow_fn = ledger.wrap(self._cow_fn, self._seam_aux,
                                        name="cow")
+            if self._gather_fn is not None:
+                self._seam_kvxfer = ledger.declare(
+                    "engine.kvxfer", 2 * self._maxb,
+                    note="block-chain export/import programs (gather + "
+                    "graft, one per chain length <= max blocks/row) — "
+                    "bounded by the table geometry, never by traffic")
+                self._gather_fn = ledger.wrap(
+                    self._gather_fn, self._seam_kvxfer, name="kv_gather")
+                self._graft_fn = ledger.wrap(
+                    self._graft_fn, self._seam_kvxfer, name="kv_graft")
+            else:
+                self._seam_kvxfer = None
         else:
+            self._seam_kvxfer = None
             self._seam_spec = None
             self._step_fn = ledger.wrap(
                 self._step_fn, self._seam_step, name="dense_step",
@@ -738,7 +1052,8 @@ class Engine:
         if self._ledger is None:
             return []
         return [s for s in (self._seam_prefill, self._seam_step,
-                            self._seam_spec, self._seam_aux)
+                            self._seam_spec, self._seam_aux,
+                            self._seam_kvxfer)
                 if s is not None]
 
     def compile_audit(self) -> Optional[dict]:
@@ -838,6 +1153,11 @@ class Engine:
                 for req, slot in actions:
                     if req.fn is not None:
                         self._run_exclusive(req)
+                    elif req.manifest is not None:
+                        # migrated seat (ISSUE 15): graft-only, no model
+                        # forward — orders of magnitude cheaper than the
+                        # prefill it replaces, so it does not convoy
+                        self._seat_prefilled(slot, req)
                     else:
                         # prefill convoy (ISSUE 12): decode-ready slots
                         # stalled behind this admission's prefill — the
@@ -1100,6 +1420,9 @@ class Engine:
             rlog.prefill_done(req.rid, now - t_adm,
                               req.ttft_s if req.ttft_s is not None
                               else now - t_adm)
+        if req.export:
+            self._finish_export(slot, req, first)
+            return
         tokens = [first]
         if (req.eos_id is not None and first == req.eos_id) \
                 or req.max_new_tokens <= 1:
@@ -1149,6 +1472,147 @@ class Engine:
             if self.paged:
                 self._release_table(slot)
             slot.clear()
+
+    def _finish_export(self, slot: _Slot, req: _Request,
+                       first: int) -> None:
+        """Close a prefill-export request: gather the block chain to the
+        host, release the slot (NO decode seat is held), and hand the
+        migration manifest back to the HTTP layer.  A generation that
+        finished at the first token skips the gather entirely — nothing
+        will be migrated."""
+        hit_eos = req.eos_id is not None and first == req.eos_id
+        done = hit_eos or req.max_new_tokens <= 1
+        export = {
+            "ids": req.ids,
+            "first": int(first),
+            # sync-ok: slot.key is host-side numpy (the per-slot PRNG
+            # carry lives on the host between steps); no device read
+            "key": np.asarray(slot.key),
+            "block_size": self.block_size,
+            "done": done,
+            "tokens": [int(first)],
+            "rid": req.rid,
+            "blocks": {},
+            "n_blocks": 0,
+        }
+        if not done:
+            export["blocks"] = self._export_blocks(slot)
+            export["n_blocks"] = int(slot.nblocks)
+        with self._cond:
+            self._completed += 1
+            self._kv_exports += 1
+            self._kv_blocks_out += export["n_blocks"]
+            self._release_table(slot)
+            slot.clear()
+        if done:
+            tok_counter = self.metrics.get("tokens")
+            if tok_counter is not None:
+                tok_counter.inc(1)
+            # nothing migrates: the timeline closes here like any local
+            # retirement; otherwise it stays LIVE so the HTTP layer can
+            # bill the transfer to the migrate phase before closing it
+            if self._reqlog is not None:
+                self._reqlog.retire(req.rid,
+                                    "eos" if hit_eos else "max_tokens",
+                                    tokens=1, ttft_s=req.ttft_s)
+        req.finish(result=export)
+
+    def _export_blocks(self, slot: _Slot) -> dict:
+        """The slot's block chain as flat host arrays ``{leaf path:
+        [n_blocks, block_size, ...]}`` in table order — ONE gather
+        program call per export (per chain length), fetched to the
+        host at the export boundary."""
+        idxs = np.ascontiguousarray(slot.table[:slot.nblocks])
+        return _flatten_tree(self._gather_fn(self._pool, idxs))
+
+    def _seat_prefilled(self, slot: _Slot, req: _Request) -> None:
+        """Seat a migrated request: graft each received block into a
+        freshly-allocated local block (refcount 1 — a graft can never
+        touch a donor another slot or the tree shares), insert the
+        prompt's runs into the local tree, and join the decode lanes at
+        the migrated position with the migrated PRNG carry."""
+        m = req.manifest
+        rlog = self._reqlog
+        t_adm = time.monotonic()
+        qw = t_adm - req.t_submit if req.t_submit else 0.0
+        qw_h = self.metrics.get("queue_wait")
+        if qw_h is not None:
+            qw_h.observe(qw)
+        if rlog is not None:
+            rlog.admitted(req.rid, slot.idx, qw)
+        ids = req.ids
+        n = int(m["n_blocks"])
+        nested = m["nested"]
+        try:
+            dsts = np.empty(n, np.int32)
+            for i in range(n):
+                dsts[i] = self._alloc_block(slot)
+                slot.table[slot.nblocks] = dsts[i]
+                slot.nblocks += 1
+            # one scatter for the whole chain: the decode loop pays a
+            # single dispatch per migration, not one per block
+            self._pool = self._graft_fn(self._pool, nested, dsts)
+            self._tables_dirty = True
+            self._update_block_gauge()
+            if self._tree is not None:
+                # migrated prefixes are immediately shareable: local
+                # requests with the same template attach by reference
+                created = self._tree.graft(
+                    ids, [int(b) for b in slot.table[:slot.nblocks]])
+                for node in created:
+                    self._pool_alloc.retain(node.block)
+        except BaseException as e:  # noqa: BLE001 - bad import must not kill the loop
+            req.finish(error=e)
+            if rlog is not None:
+                rlog.retire(req.rid, "error")
+            with self._cond:
+                self._release_table(slot)
+                slot.clear()
+            return
+        graft_s = time.monotonic() - t_adm
+        mig_c = self.metrics.get("kv_migrated")
+        if mig_c is not None:
+            mig_c.inc(n)
+        with self._cond:
+            self._kv_imports += 1
+            self._kv_blocks_in += n
+        if rlog is not None:
+            rlog.migrated(req.rid, n, graft_s)
+        if req.seated_cb is not None:
+            try:
+                req.seated_cb()
+            # except-ok: the seated ack is an observability seam (a dead
+            # kvxfer socket); the seated request must still decode
+            except Exception:  # noqa: BLE001
+                log.exception("kvxfer seated callback failed")
+        first = int(m["first"])
+        slot.tokens = [int(first)]
+        slot.last = first
+        slot.pos = int(ids.size)
+        # sync-ok: the migrated PRNG carry arrived as host numpy off
+        # the wire; no device read happens here
+        slot.key = np.asarray(m["key"], np.uint32)
+        # first token happened on the prefill pod: stamp the seat time
+        # so TPOT (decode-side per-token latency) still computes, but do
+        # NOT observe the TTFT histogram — this pod never prefilled
+        req.ttft_s = time.monotonic() - req.t_submit \
+            if req.t_submit else None
+        if (req.eos_id is not None and first == req.eos_id) \
+                or req.max_new_tokens <= 1:
+            # defensive: the sender short-circuits finished generations
+            # without migrating, but a direct API caller must not seat
+            # a request the decode loop would over-emit for
+            self._retire(slot, req, slot.tokens,
+                         "eos" if req.eos_id is not None
+                         and first == req.eos_id else "max_tokens")
+            return
+        if req.speculative:
+            slot.ctx = [int(t) for t in ids] + [first]
+        slot.ready = True
+        with self._cond:
+            self._peak_active = max(
+                self._peak_active,
+                sum(1 for s in self._slots if not s.free))
 
     def _decode_step_all(self) -> None:
         """One batched step over every ready slot.  Inactive rows ride

@@ -185,6 +185,18 @@ class PrefixTree:
             cur = child
         return created
 
+    def graft(self, ids, blocks: list[int]) -> list[PrefixNode]:
+        """Import seam (ISSUE 15): insert a MIGRATED prompt's full-block
+        runs so a prefix that was prefilled on another pod is
+        immediately shareable here — ``blocks[i]`` is the LOCAL pool
+        block the i-th run was grafted into.  Match-then-insert with the
+        engine's exact budget (the last prompt token stays private), so
+        runs already cached locally are reused, never duplicated.
+        Returns the NEW nodes; the caller retains one pool reference
+        per new node, exactly like :meth:`insert`."""
+        matched, _partial = self.match(ids, max(0, len(ids) - 1))
+        return self.insert(matched, ids, blocks)
+
     def evict_one(self, pinned=None) -> Optional[int]:
         """Remove the least-recently-hit LEAF node; returns its block id
         (the caller drops the tree's pool reference) or None when no

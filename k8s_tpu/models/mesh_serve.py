@@ -345,8 +345,11 @@ class MeshPlacement:
             # dial the chief POD's hostname (the coordinator host), not
             # loopback — a 127.0.0.1 bind would strand every worker in
             # connect-retry until the gang crash-loops
+            # pipelined (ISSUE 15 satellite): the chief's dispatch
+            # overlaps the plan's socket I/O — multi-host chunked
+            # prefill stops paying one serialized bus round per chunk
             bus = mp_plan.PlanBus(jax.process_count() - 1, host="",
-                                  port=port)
+                                  port=port, pipelined=True)
             bus.accept_workers()
         return cls(config, bus=bus)
 
@@ -436,6 +439,11 @@ class MeshPlacement:
     def put_tables(self, stack):
         self._broadcast(OP_TABLES, {}, {"tables": stack})
         return self._progs.to_global(stack)
+
+    def plan_bus_stats(self) -> Optional[dict]:
+        """The plan bus's pipelining telemetry (None without workers) —
+        the bench asserts enqueue-wait ≪ send seconds on it."""
+        return self._bus.stats() if self._bus is not None else None
 
     def close(self) -> None:
         if self._bus is not None:

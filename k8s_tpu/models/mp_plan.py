@@ -36,6 +36,9 @@ import json
 import logging
 import socket
 import struct
+import threading
+import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -112,11 +115,29 @@ def mp_closed_during_accept() -> PlanBusClosed:
 
 class PlanBus:
     """Chief side: accept one connection per worker, then broadcast
-    plan messages in step order.  All sends happen on the engine thread;
-    ``close()`` (any thread) sends ``bye`` once and tears down."""
+    plan messages in step order.
+
+    ``pipelined=False`` (default): sends happen inline on the engine
+    thread — broadcast returns after every worker's socket took the
+    frame.  ``pipelined=True`` (ISSUE 15 satellite — chunked-prefill
+    plan pipelining): broadcast ENQUEUES the encoded frame and returns
+    immediately; a dedicated sender thread drains the queue in FIFO
+    order, so the chief's next dispatch overlaps the socket I/O of the
+    current plan instead of serializing behind it — a multi-chunk
+    prefill stops paying one bus round per chunk.  Ordering is
+    preserved (one queue, one sender), the frame is encoded at enqueue
+    time (the engine may reuse its host buffers afterwards), and a
+    sender-side socket failure surfaces as :class:`PlanBusClosed` on
+    the NEXT broadcast — the same gang-fatal semantics as the inline
+    path, one step later.  ``stats()`` reports enqueue-wait vs actual
+    send seconds so the bench can assert the overlap is real.
+
+    ``close()`` (any thread) drains the queue, sends ``bye`` once and
+    tears down."""
 
     def __init__(self, num_workers: int, host: str = "127.0.0.1",
-                 port: int = 0, accept_timeout: float = 120.0):
+                 port: int = 0, accept_timeout: float = 120.0,
+                 pipelined: bool = False):
         """``host`` is the BIND address: loopback for same-host gangs
         (tests, the local driver); the serving chief binds all
         interfaces (``""``) so workers on other pods can dial the
@@ -128,6 +149,65 @@ class PlanBus:
         self._conns: list[socket.socket] = []
         self._closed = False
         self._accept_timeout = accept_timeout
+        self.pipelined = bool(pipelined)
+        # pipelined state, all under _send_cond (its own leaf lock so
+        # the sender never holds the conns lock across a syscall)
+        self._send_cond = checkedlock.make_condition("mp.planbus.sendq")
+        self._sendq: "deque[bytes]" = deque()
+        self._send_error: Optional[str] = None
+        self._sender_stop = False
+        self._stat_broadcasts = 0
+        self._stat_enqueue_s = 0.0
+        self._stat_send_s = 0.0
+        self._stat_bytes = 0
+        self._stat_max_depth = 0
+        self._sender: Optional[threading.Thread] = None
+        if self.pipelined:
+            self._sender = threading.Thread(
+                target=self._sender_loop, daemon=True,
+                name="planbus-sender")
+            self._sender.start()
+
+    def stats(self) -> dict:
+        """Pipelining telemetry: enqueue-wait vs send seconds is the
+        measured overlap (enqueue ≪ send means the engine thread really
+        stopped paying the socket I/O)."""
+        with self._send_cond:
+            return {
+                "pipelined": self.pipelined,
+                "broadcasts": self._stat_broadcasts,
+                "enqueue_wait_s": round(self._stat_enqueue_s, 6),
+                "send_s": round(self._stat_send_s, 6),
+                "bytes": self._stat_bytes,
+                "max_queue_depth": self._stat_max_depth,
+                "send_error": self._send_error,
+            }
+
+    def _sender_loop(self) -> None:
+        while True:
+            with self._send_cond:
+                while not self._sendq and not self._sender_stop:
+                    self._send_cond.wait()
+                if not self._sendq:
+                    return  # stopped and drained
+                data = self._sendq.popleft()
+                self._send_cond.notify_all()  # close() waits for drain
+            with self._lock:
+                conns = list(self._conns)
+            t0 = time.monotonic()
+            try:
+                for conn in conns:
+                    conn.sendall(data)
+            except OSError as e:
+                # a dead worker is gang-fatal: surface on the next
+                # broadcast (PlanBusClosed) instead of hanging the queue
+                with self._send_cond:
+                    self._send_error = f"{type(e).__name__}: {e}"
+                    self._sendq.clear()
+                    self._send_cond.notify_all()
+                return
+            with self._send_cond:
+                self._stat_send_s += time.monotonic() - t0
 
     def accept_workers(self) -> None:
         """Block until every worker has dialed in (workers connect right
@@ -156,13 +236,45 @@ class PlanBus:
     def broadcast(self, op: str, statics: Optional[dict] = None,
                   arrays: Optional[dict] = None) -> None:
         data = _encode(op, statics or {}, arrays or {})
-        with self._lock:
-            if self._closed:
+        if not self.pipelined:
+            with self._lock:
+                if self._closed:
+                    raise PlanBusClosed("plan bus closed", clean=True)
+                for conn in self._conns:
+                    conn.sendall(data)
+            return
+        t0 = time.monotonic()
+        with self._send_cond:
+            if self._sender_stop:
                 raise PlanBusClosed("plan bus closed", clean=True)
-            for conn in self._conns:
-                conn.sendall(data)
+            if self._send_error is not None:
+                raise PlanBusClosed(
+                    f"plan bus sender died: {self._send_error}",
+                    clean=False)
+            self._sendq.append(data)
+            self._stat_broadcasts += 1
+            self._stat_bytes += len(data)
+            self._stat_max_depth = max(self._stat_max_depth,
+                                       len(self._sendq))
+            self._send_cond.notify()
+            self._stat_enqueue_s += time.monotonic() - t0
+
+    def _drain_sender(self, timeout: float = 10.0) -> None:
+        """Flush queued frames, then stop the sender thread (``bye``
+        below must be the LAST frame on every worker's stream)."""
+        deadline = time.monotonic() + timeout
+        with self._send_cond:
+            while self._sendq and self._send_error is None \
+                    and time.monotonic() < deadline:
+                self._send_cond.wait(0.1)
+            self._sender_stop = True
+            self._send_cond.notify_all()
+        if self._sender is not None:
+            self._sender.join(timeout=5)
 
     def close(self) -> None:
+        if self.pipelined:
+            self._drain_sender()
         with self._lock:
             if self._closed:
                 return

@@ -204,7 +204,11 @@ def member_main(args) -> int:
         audit = engine.compile_audit()
     finally:
         engine.shutdown()
+    # after shutdown: the bus is drained+closed, so the send/enqueue
+    # totals cover every broadcast of the run
+    plan_bus = placement.plan_bus_stats()
     payload = {
+        "plan_bus": plan_bus,
         "num_processes": lcfg.num_processes,
         "tp_degree": stats["tp_degree"],
         "mesh_shape": stats["mesh_shape"],

@@ -288,6 +288,39 @@ class PagedCompute:
             logits, chunk, keys, temps, topks, widths, sampling)
         return pool, emit, n_emit, new_keys
 
+    def gather_blocks(self, pool, idxs):
+        """Export seam (ISSUE 15): a whole block CHAIN's K/V(/scale)
+        content — ``idxs`` is ``[n]`` pool block ids in table order,
+        each leaf comes back ``[n, block_size, ...]`` (the exact array
+        the kv-transfer plane ships).  ONE program call per export;
+        the chain length is part of the compiled shape, so the program
+        set is bounded by ``ceil(max_seq_len / block_size)``, never by
+        traffic."""
+        def g(node):
+            return {k: v[idxs] for k, v in node.items()}
+
+        return map_cache(pool, g)
+
+    def graft_blocks(self, pool, values, dsts):
+        """Import seam (ISSUE 15): write a migrated chain (``values`` —
+        the :meth:`gather_blocks` pytree, host numpy off the wire) into
+        the freshly-allocated LOCAL blocks ``dsts`` (``[n]`` int32) in
+        one scatter.  Donor safety is by construction: every ``dsts``
+        entry came off the free list at refcount 1, so a graft can
+        never touch a block a live slot or the tree shares (the CoW
+        invariant the tests bit-check).  One program call per seat —
+        the decode tier's engine loop pays a single dispatch per
+        migration, not one per block."""
+        def rec(p, v):
+            if _is_cache_node(p):
+                return {k: leaf.at[dsts].set(v[k].astype(leaf.dtype))
+                        for k, leaf in p.items()}
+            if isinstance(p, Mapping):
+                return {k: rec(val, v[k]) for k, val in p.items()}
+            return p
+
+        return rec(pool, values)
+
     def cow(self, pool, src, dst):
         """Copy-on-write at the divergence block: duplicate block
         ``src`` into the private block ``dst``.  Only the shared prefix

@@ -61,8 +61,11 @@ DEFAULT_MAX_EVENTS_PER_REQUEST = 256
 
 #: canonical phase order — also the tie-break order for the dominant-
 #: phase attribution (earlier wins on equal seconds, so an all-zero
-#: timeline attributes to "queue", the only phase every request has)
-PHASES = ("queue", "prefill", "decode", "spec_reject", "compile", "evict")
+#: timeline attributes to "queue", the only phase every request has).
+#: ``migrate`` (ISSUE 15) is the disaggregated cross-pod hop: block
+#: transfer on the prefill side, graft-and-seat on the decode side.
+PHASES = ("queue", "prefill", "migrate", "decode", "spec_reject",
+          "compile", "evict")
 
 
 def _dominant(phase_s: dict) -> str:
@@ -151,6 +154,9 @@ class RequestRecorder:
             "steps": 0,
             "prefix": None,
             "spec": {"chunks": 0, "proposed": 0, "accepted": 0},
+            # the prefill→decode hop (ISSUE 15): direction/blocks/peer,
+            # None for requests that never migrated
+            "migrate": None,
             "evictions": 0,
             "slot": None,
             "retire": None,
@@ -301,6 +307,43 @@ class RequestRecorder:
                         **({"proposed": proposed, "accepted": accepted}
                            if spec else {}))
 
+    def migrated(self, rid: Optional[int], blocks: int, dur_s: float,
+                 peer: Optional[str] = None) -> None:
+        """Decode-side half of the prefill→decode hop (ISSUE 15): the
+        imported chain was grafted into the local pool and the request
+        seated — the graft wall time bills to the ``migrate`` phase."""
+        if rid is None:
+            return
+        with self._lock:
+            entry = self._live.get(rid)
+            if entry is None:
+                return
+            self._phase(entry, "migrate", dur_s)
+            entry["migrate"] = {"direction": "in", "blocks": blocks,
+                                "peer": peer}
+            self._event(entry, "migrate_in", blocks=blocks,
+                        dur_s=round(dur_s, 6),
+                        **({"peer": peer} if peer else {}))
+
+    def migrate_send(self, rid: Optional[int], blocks: int,
+                     dur_s: float, dest: Optional[str] = None) -> None:
+        """Prefill-side half of the hop: the block chain was shipped and
+        the decode pod acked the seat — transfer wall time bills to
+        ``migrate`` (the HTTP layer closes the timeline with retire
+        reason ``migrated`` right after)."""
+        if rid is None:
+            return
+        with self._lock:
+            entry = self._live.get(rid)
+            if entry is None:
+                return
+            self._phase(entry, "migrate", dur_s)
+            entry["migrate"] = {"direction": "out", "blocks": blocks,
+                                "peer": dest}
+            self._event(entry, "migrate_out", blocks=blocks,
+                        dur_s=round(dur_s, 6),
+                        **({"dest": dest} if dest else {}))
+
     def evicted(self, rid: Optional[int], blocks: int,
                 dur_s: float) -> None:
         """Block-pool allocation for this request had to evict prefix-
@@ -401,7 +444,8 @@ class RequestRecorder:
             "id", "state", "kind", "wall_submit", "prompt_len",
             "max_new", "speculative", "trace_id", "queue_wait_s",
             "ttft_s", "tpot_s", "e2e_s", "tokens", "steps", "prefix",
-            "spec", "evictions", "slot", "retire", "dominant_phase")}
+            "spec", "migrate", "evictions", "slot", "retire",
+            "dominant_phase")}
         out["phase_s"] = dict(entry["phase_s"])
         if out["dominant_phase"] is None:
             # provisional attribution for LIVE entries, so

@@ -106,6 +106,10 @@ class ParsedRequest:
     eos: Optional[int]
     seed: int
     speculative: int
+    # disaggregated serving (ISSUE 15): the decode pod's kv-transfer
+    # address (host:port) the router injected for a phase-split request;
+    # None = serve locally (the collapsed path)
+    kv_dest: Optional[str] = None
 
 
 def parse_request(config, req: dict, default_max_new_tokens: int
@@ -171,10 +175,20 @@ def parse_request(config, req: dict, default_max_new_tokens: int
     if spec != 0 and spec < 2:
         raise RequestError("speculative",
                            "speculative must be >= 2 (0 disables)")
+    kv_dest = req.get("kv_dest")
+    if kv_dest is not None:
+        from k8s_tpu.models import kvxfer
+
+        if not isinstance(kv_dest, str):
+            raise RequestError("kv_dest", '"kv_dest" must be a string')
+        try:
+            kvxfer.parse_dest(kv_dest)
+        except ValueError as e:
+            raise RequestError("kv_dest", str(e))
     return ParsedRequest(
         ids=ids, echo_text=req["text"] if has_text else None,
         max_new_tokens=max_new, temperature=temperature, top_k=top_k,
-        eos=eos, seed=seed, speculative=spec)
+        eos=eos, seed=seed, speculative=spec, kv_dest=kv_dest)
 
 
 def _emitted(toks, eos) -> int:
@@ -200,8 +214,11 @@ class LmServer:
                  prefix_blocks: Optional[int] = None,
                  batch_sampling: Optional[bool] = None,
                  batch_spec: Optional[bool] = None, registry=None,
-                 placement=None):
+                 placement=None, role: Optional[str] = None,
+                 kvxfer_port: Optional[int] = None,
+                 kvxfer_int8: Optional[bool] = None):
         from k8s_tpu.models import engine as engine_lib
+        from k8s_tpu.models import kvxfer as kvxfer_lib
         from k8s_tpu.util import metrics as metrics_mod
 
         if train_dir is not None:
@@ -246,6 +263,30 @@ class LmServer:
             # (kept as the bench_serve baseline and an escape hatch)
             self.engine = None
         self._lock = checkedlock.make_lock("server.singleflight")
+        # disaggregated serving tier membership (ISSUE 15): a prefill
+        # pod never seats migrated requests; a decode pod always runs a
+        # kv-transfer receiver (ephemeral port when the env leaves it
+        # unset — tests/benches read it back from serving_info)
+        self.role = kvxfer_lib.env_role() if role is None else role
+        if self.role not in ("", kvxfer_lib.ROLE_PREFILL,
+                             kvxfer_lib.ROLE_DECODE):
+            raise ValueError(f"role must be prefill/decode/'' "
+                             f"(got {self.role!r})")
+        self.kvxfer_int8 = kvxfer_lib.env_kvxfer_int8() \
+            if kvxfer_int8 is None else bool(kvxfer_int8)
+        if kvxfer_port is None:
+            kvxfer_port = kvxfer_lib.env_kvxfer_port()
+        self._kv_receiver = None
+        self._kv_sender = None
+        if self.engine is not None and self.engine.disagg_capable:
+            if self.role != kvxfer_lib.ROLE_PREFILL and (
+                    kvxfer_port is not None
+                    or self.role == kvxfer_lib.ROLE_DECODE):
+                self._kv_receiver = kvxfer_lib.KvReceiver(
+                    self._seat_migrated, host="0.0.0.0",
+                    port=kvxfer_port or 0)
+            if self.role != kvxfer_lib.ROLE_DECODE:
+                self._kv_sender = kvxfer_lib.KvSender()
         # compile ledger (ISSUE 11): the exclusive lane's whole-generation
         # programs are the server's own compile surface — one program per
         # (generation config, prompt length), bounded by the decode-module
@@ -273,6 +314,10 @@ class LmServer:
     def close(self) -> None:
         if self.metrics["queue_depth"]._fn == self.queue_depth:
             self.metrics["queue_depth"]._fn = None
+        if self._kv_receiver is not None:
+            self._kv_receiver.stop()
+        if self._kv_sender is not None:
+            self._kv_sender.close()
         if self.engine is not None:
             self.engine.shutdown()
 
@@ -305,7 +350,7 @@ class LmServer:
         """Engine occupancy for /healthz (shedding is NOT unreadiness)."""
         if self.engine is None:
             return {"engine": "single-flight", "slots": 0,
-                    "queue_depth": 0}
+                    "queue_depth": 0, "role": self.role}
         s = self.engine.stats()
         return {"engine": "continuous-batching", "slots": s["slots"],
                 # mesh identity (ISSUE 14): the fleet plane and
@@ -331,7 +376,149 @@ class LmServer:
                 "spec_accepted": s["spec_accepted"],
                 "spec_mean_accepted": s["spec_mean_accepted"],
                 # per-request recorder binding (ISSUE 12)
-                "request_log": s["request_log"]}
+                "request_log": s["request_log"],
+                # disaggregated tier surface (ISSUE 15): role, the
+                # kv-transfer listener (decode pods; tests/benches read
+                # the ephemeral port back from here), and the migration
+                # counters the bench rates blocks/s from
+                "role": self.role,
+                "kvxfer_port": self._kv_receiver.port
+                if self._kv_receiver is not None else None,
+                "kvxfer_int8": self.kvxfer_int8,
+                "kv_exports": s["kv_exports"],
+                "kv_imports": s["kv_imports"],
+                "kv_blocks_out": s["kv_blocks_out"],
+                "kv_blocks_in": s["kv_blocks_in"]}
+
+    # -- disaggregated prefill/decode (ISSUE 15) -----------------------
+
+    def _wire_blocks(self, export: dict) -> tuple[dict, bool]:
+        """The export manifest's block arrays as wire arrays: int8 pools
+        ship their native leaves bit-exact; fp pools optionally
+        quantize k/v content for transit through THE quantize_kv
+        definition (``K8S_TPU_KVXFER_INT8`` — lossy, 4x less wire)."""
+        import numpy as np
+
+        blocks = export["blocks"]
+        if not self.kvxfer_int8:
+            return ({f"blk/{p}": a for p, a in blocks.items()}, False)
+        from k8s_tpu.models.paged import quantize_kv
+
+        out: dict = {}
+        quantized = False
+        for path, arr in blocks.items():
+            leaf = path.rsplit("/", 1)[-1]
+            if leaf in ("k", "v") and np.issubdtype(arr.dtype,
+                                                    np.floating):
+                q, scale = quantize_kv(arr)
+                out[f"blk/{path}"] = np.asarray(q)
+                out[f"blkscale/{path}"] = np.asarray(scale)
+                quantized = True
+            else:
+                out[f"blk/{path}"] = arr
+        return out, quantized
+
+    @staticmethod
+    def _unwire_blocks(arrays: dict, wire_int8: bool) -> dict:
+        """Receiver-side inverse of :meth:`_wire_blocks`: dequantize
+        wire-int8 content back to f32 (the engine's graft casts to the
+        pool dtype); bit-exact passthrough otherwise."""
+        import numpy as np
+
+        out: dict = {}
+        for name, arr in arrays.items():
+            if not name.startswith("blk/"):
+                continue
+            path = name[len("blk/"):]
+            scale = arrays.get(f"blkscale/{path}")
+            if wire_int8 and scale is not None:
+                out[path] = (arr.astype(np.float32)
+                             * scale[..., None].astype(np.float32))
+            else:
+                out[path] = arr
+        return out
+
+    def _seat_migrated(self, statics: dict, arrays: dict,
+                       on_seated) -> list[int]:
+        """The kv-receiver's seam onto the engine: rebuild the flat
+        block manifest from the wire and seat the request; typed
+        refusals (PoolExhausted / QueueFull / ValueError) travel back
+        to the sender as error frames."""
+        import numpy as np
+
+        req = statics.get("req") or {}
+        blocks = self._unwire_blocks(arrays,
+                                     bool(statics.get("wire_int8")))
+        return self.engine.submit_prefilled(
+            np.asarray(arrays["ids"], np.int32), blocks,
+            first_token=int(req["first"]),
+            key=np.asarray(arrays["key"], np.uint32),
+            max_new_tokens=int(req["max_new_tokens"]),
+            eos_id=req.get("eos"),
+            temperature=float(req.get("temperature") or 0.0),
+            top_k=req.get("top_k"),
+            speculative=int(req.get("speculative") or 0),
+            block_size=req.get("block_size"),
+            trace_id=statics.get("trace_id"),
+            seated=on_seated)
+
+    def _generate_disagg(self, parsed: ParsedRequest,
+                         trace_ctx: Optional[tuple]) -> "object":
+        """The phase-split path: prefill-only locally (no decode slot
+        held), stream the block chain to ``parsed.kv_dest``, and return
+        the decode pod's token list.  The transfer span joins the
+        caller trace; the request timeline closes with the ``migrate``
+        phase billed."""
+        from k8s_tpu import trace
+        from k8s_tpu.models import kvxfer as kvxfer_lib
+        from k8s_tpu.models import requestlog
+
+        export = self.engine.prefill_export(
+            parsed.ids, parsed.max_new_tokens, eos_id=parsed.eos,
+            temperature=parsed.temperature, top_k=parsed.top_k,
+            seed=parsed.seed, speculative=parsed.speculative,
+            trace_ctx=trace_ctx)
+        if export["done"]:
+            return export["tokens"]
+        rid = export.get("rid")
+        rlog = requestlog.active()
+        try:
+            wire, quantized = self._wire_blocks(export)
+            wire["ids"] = export["ids"]
+            wire["key"] = export["key"]
+            statics = {
+                "v": kvxfer_lib.PROTOCOL_VERSION,
+                "wire_int8": quantized,
+                "trace_id": trace_ctx[0] if trace_ctx else None,
+                "req": {
+                    "first": export["first"],
+                    "max_new_tokens": parsed.max_new_tokens,
+                    "eos": parsed.eos,
+                    "temperature": parsed.temperature,
+                    "top_k": parsed.top_k,
+                    "speculative": parsed.speculative,
+                    "block_size": export["block_size"],
+                },
+            }
+            with trace.span_under(trace_ctx, "kv_migrate",
+                                  dest=parsed.kv_dest,
+                                  blocks=export["n_blocks"],
+                                  wire_int8=quantized):
+                tokens, seated_s = self._kv_sender.migrate(
+                    parsed.kv_dest, statics, wire)
+            h = self.metrics.get("kv_migrate")
+            if h is not None:
+                h.observe(seated_s)
+            if rlog is not None:
+                rlog.migrate_send(rid, export["n_blocks"], seated_s,
+                                  dest=parsed.kv_dest)
+                rlog.retire(rid, "migrated", tokens=len(tokens))
+            return tokens
+        except BaseException:
+            # the export timeline must not leak live on a failed hop
+            if rlog is not None:
+                rlog.retire(rid, "error")
+            raise
 
     def generate(self, parsed: ParsedRequest,
                  trace_ctx: Optional[tuple] = None) -> dict:
@@ -361,7 +548,18 @@ class LmServer:
                         and parsed.ids.size >= 2)
         use_batched = (parsed.speculative == 0 or spec_batched) and (
             parsed.temperature == 0.0 or self.batch_sampling)
-        if self.engine is not None and use_batched:
+        if parsed.kv_dest and self._kv_sender is not None \
+                and self.engine is not None and self.engine.paged \
+                and use_batched:
+            # disaggregated phase split (ISSUE 15): prefill here, decode
+            # on the kv_dest peer.  A kv_dest landing on a pod that
+            # cannot send (decode role, windowed engine) — or a request
+            # the lane-routing knobs route EXCLUSIVELY (batch_sampling /
+            # batch_spec off: migration only seats batched lanes, and
+            # the operator's routing policy outranks the router's phase
+            # split) — falls through and serves locally, never a 500.
+            toks = np.asarray(self._generate_disagg(parsed, trace_ctx))
+        elif self.engine is not None and use_batched:
             toks = self.engine.submit(parsed.ids, parsed.max_new_tokens,
                                       eos_id=parsed.eos,
                                       temperature=parsed.temperature,
@@ -578,6 +776,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(400, {"error": str(e), "field": e.field})
         from k8s_tpu import trace
         from k8s_tpu.models.engine import QueueFull
+        from k8s_tpu.models.kvxfer import KvTransferError
 
         # end-to-end trace join (ISSUE 12): the inbound W3C traceparent
         # (the operator-side propagation machinery emits it) parents this
@@ -602,6 +801,19 @@ class _Handler(BaseHTTPRequestHandler):
                 503, {"error": str(e)},
                 headers={"Retry-After":
                          str(max(1, int(round(e.retry_after_s))))})
+        except KvTransferError as e:
+            # receive-side backpressure (pool exhausted / queue full on
+            # the decode peer) is a shed, not an error — the router's
+            # retry walk re-places the request; anything else (dead
+            # peer, protocol) is a 502-class failure the router also
+            # walks past
+            if e.kind in ("pool_exhausted", "queue_full"):
+                m["requests"].labels("rejected").inc()
+                return self._send(503, {"error": str(e)},
+                                  headers={"Retry-After": "1"})
+            log.warning("kv migration failed: %s", e)
+            m["requests"].labels("error").inc()
+            return self._send(502, {"error": f"kv migration: {e}"})
         except ValueError as e:
             m["requests"].labels("bad_request").inc()
             return self._send(400, {"error": str(e)})
@@ -660,6 +872,19 @@ def main(argv=None) -> int:
                    "slot lanes (variable-width verify chunks; default "
                    "K8S_TPU_SERVE_BATCH_SPEC or 1; 0 = exclusive-lane "
                    "speculation, the legacy routing)")
+    p.add_argument("--role", choices=("prefill", "decode"), default=None,
+                   help="disaggregated tier membership (default "
+                   "K8S_TPU_SERVE_ROLE; unset = collapsed single-role "
+                   "pod serving both phases)")
+    p.add_argument("--kvxfer-port", type=int, default=None,
+                   help="KV block-transfer listener port on decode-"
+                   "capable pods (default K8S_TPU_KVXFER_PORT; 0 = "
+                   "ephemeral; decode-role pods always listen)")
+    p.add_argument("--kvxfer-int8", type=int, choices=(0, 1),
+                   default=None,
+                   help="quantize fp-pool KV content to int8 for "
+                   "transit (default K8S_TPU_KVXFER_INT8 or 0; lossy "
+                   "on fp pools, no-op on int8 pools)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     from k8s_tpu.models import placement as placement_lib
@@ -696,6 +921,9 @@ def main(argv=None) -> int:
                   else bool(args.batch_sampling),
                   batch_spec=None if args.batch_spec is None
                   else bool(args.batch_spec),
+                  role=args.role, kvxfer_port=args.kvxfer_port,
+                  kvxfer_int8=None if args.kvxfer_int8 is None
+                  else bool(args.kvxfer_int8),
                   placement=placement, **mesh_kw)
     httpd = serve(lm, args.host, args.port)
     host, port = httpd.server_address[:2]

@@ -72,6 +72,8 @@ ENV_BLOCK_SIZE = "K8S_TPU_ROUTER_BLOCK_SIZE"
 ENV_AFFINITY_BLOCKS = "K8S_TPU_ROUTER_AFFINITY_BLOCKS"
 ENV_RETRY_BUDGET = "K8S_TPU_ROUTER_RETRY_BUDGET"
 ENV_POLICY = "K8S_TPU_ROUTER_POLICY"
+ENV_PHASE_TOKENS = "K8S_TPU_ROUTER_PHASE_TOKENS"
+ENV_HEDGE_S = "K8S_TPU_ROUTER_HEDGE_S"
 
 
 def _int_from_env(name: str, default: int) -> int:
@@ -106,6 +108,28 @@ def retry_budget_from_env() -> int:
 def policy_from_env() -> str:
     v = os.environ.get(ENV_POLICY, "").strip().lower()
     return v if v in VALID_POLICIES else POLICY_AFFINE
+
+
+def phase_tokens_from_env() -> Optional[int]:
+    """K8S_TPU_ROUTER_PHASE_TOKENS: prompts of at least this many
+    tokens route to the prefill tier (disaggregated phase split,
+    ISSUE 15); unset/0 = off.  Only engages while prefill-role pods
+    exist, so it is safe to leave set on a collapsed fleet."""
+    v = _int_from_env(ENV_PHASE_TOKENS, 0)
+    return v or None
+
+
+def hedge_s_from_env() -> float:
+    """K8S_TPU_ROUTER_HEDGE_S: seconds before hedging a stuck
+    idempotent request against the next ring candidate (first response
+    wins); unset/0 = off — a p99-derived value like 2x the fleet's
+    serve_request_duration p99 is the intended setting."""
+    raw = os.environ.get(ENV_HEDGE_S, "")
+    try:
+        v = float(raw)
+    except ValueError:
+        return 0.0
+    return v if v > 0 else 0.0
 
 
 # -- process-global active router (fleet.active() pattern) --------------------

@@ -75,6 +75,16 @@ def main(argv=None) -> int:
                    default=router_mod.affinity_blocks_from_env())
     p.add_argument("--retry-budget", type=int,
                    default=router_mod.retry_budget_from_env())
+    p.add_argument("--phase-split-tokens", type=int,
+                   default=router_mod.phase_tokens_from_env() or 0,
+                   help="route prompts of at least this many tokens to "
+                   "the prefill tier (disaggregated phase split, "
+                   "K8S_TPU_ROUTER_PHASE_TOKENS; 0 = off)")
+    p.add_argument("--hedge-s", type=float,
+                   default=router_mod.hedge_s_from_env(),
+                   help="hedge a stuck idempotent request against the "
+                   "next ring candidate after this many seconds "
+                   "(K8S_TPU_ROUTER_HEDGE_S; 0 = off)")
     p.add_argument("--drain-timeout", type=float, default=30.0)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -94,7 +104,9 @@ def main(argv=None) -> int:
     router = router_mod.Router(
         lambda: targets, job=args.dns_job, policy=args.policy,
         block_size=args.block_size, affinity_blocks=args.affinity_blocks,
-        retry_budget=args.retry_budget)
+        retry_budget=args.retry_budget,
+        phase_split_tokens=args.phase_split_tokens or None,
+        hedge_s=args.hedge_s)
     server = router_mod.RouterServer(router, host=args.host,
                                      port=args.port)
     router_mod.set_active(router)

@@ -23,7 +23,20 @@ Reliability contract:
   the in-flight ones — the SIGTERM path, and the per-backend variant
   the autoscaler uses before releasing a victim pod's chips;
 - the inbound W3C ``traceparent`` is forwarded verbatim, so the PR 12
-  caller -> ingress -> engine trace join survives the extra hop.
+  caller -> ingress -> engine trace join survives the extra hop;
+- optional **request hedging** (ISSUE 15 satellite, ``hedge_s`` /
+  ``K8S_TPU_ROUTER_HEDGE_S``, off by default): a first attempt with no
+  response after the hedge delay races the next ring candidate, first
+  response wins (``router_hedges_total{outcome}``).
+
+Disaggregated phase split (ISSUE 15): with prefill-role backends
+present (``kubeflow.org/serve-role`` annotation via fleet discovery)
+and ``phase_split_tokens`` set, prompts at/above the threshold plan
+over the prefill tier's OWN prefix-affine ring and carry the decode
+destination (``kv_dest`` — the ``kubeflow.org/kvxfer-port``-derived
+address of the decode pod chosen affine on the serving ring with the
+SAME fingerprint) in the forwarded body; short prompts and collapsed
+fleets are untouched, and prefill-role pods take no normal placements.
 
 Discovery is a ``targets_fn`` callable (the standalone entrypoint wires
 ``fleet.targets_from_pods`` over its own pod informer cache; benches
@@ -49,6 +62,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import queue
 import random
 import threading
 import time
@@ -82,9 +96,10 @@ class Backend:
 
     __slots__ = ("name", "base_url", "healthy", "draining", "inflight",
                  "consecutive_failures", "last_error", "requests",
-                 "shed_until", "weight")
+                 "shed_until", "weight", "role", "kvxfer")
 
-    def __init__(self, name: str, base_url: str, weight: float = 1.0):
+    def __init__(self, name: str, base_url: str, weight: float = 1.0,
+                 role: str = "", kvxfer: Optional[str] = None):
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.healthy = True
@@ -97,6 +112,12 @@ class Backend:
         # relative capacity from discovery (the fleet-serve-weight pod
         # annotation): scales this backend's hash-ring keyspace share
         self.weight = weight
+        # disaggregated tier membership (ISSUE 15): "prefill"/"decode"
+        # from the kubeflow.org/serve-role pod annotation ("" = the
+        # collapsed single-role pod), and the decode pod's kv-transfer
+        # address (host:port) long requests follow their blocks to
+        self.role = role
+        self.kvxfer = kvxfer
 
     def to_dict(self, now: float) -> dict:
         return {
@@ -106,6 +127,8 @@ class Backend:
             "draining": self.draining,
             "inflight": self.inflight,
             "weight": self.weight,
+            "role": self.role,
+            "kvxfer": self.kvxfer,
             "requests": self.requests,
             "consecutive_failures": self.consecutive_failures,
             "shedding": now < self.shed_until,
@@ -142,7 +165,9 @@ class Router:
                  shed_s: float = DEFAULT_SHED_S,
                  refresh_interval_s: float = DEFAULT_REFRESH_S,
                  request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
-                 probe_timeout_s: float = DEFAULT_PROBE_TIMEOUT_S):
+                 probe_timeout_s: float = DEFAULT_PROBE_TIMEOUT_S,
+                 phase_split_tokens: Optional[int] = None,
+                 hedge_s: float = 0.0):
         if policy not in VALID_POLICIES:
             raise ValueError(
                 f"policy {policy!r} must be one of {VALID_POLICIES}")
@@ -157,8 +182,23 @@ class Router:
         self.refresh_interval_s = float(refresh_interval_s)
         self.request_timeout_s = float(request_timeout_s)
         self.probe_timeout_s = float(probe_timeout_s)
+        # disaggregated phase split (ISSUE 15): prompts of at least
+        # this many tokens route to the prefill tier (then follow their
+        # blocks to a decode pod); None/0 = off — and it only engages
+        # while prefill-role backends actually exist, so a collapsed
+        # fleet never changes behavior
+        self.phase_split_tokens = (int(phase_split_tokens)
+                                   if phase_split_tokens else None)
+        # request hedging (ISSUE 13 headroom): after this many seconds
+        # without a response, race the idempotent request against the
+        # next ring candidate, first response wins; 0 = off (default)
+        self.hedge_s = max(0.0, float(hedge_s))
         self._targets_fn = targets_fn
         self._ring = ring_mod.HashRing(vnodes=vnodes)
+        # the prefill tier's own ring: prefix-affine placement there
+        # keeps that tier's radix trees composing exactly like the
+        # serving ring does
+        self._prefill_ring = ring_mod.HashRing(vnodes=vnodes)
         self._backends: dict[str, Backend] = {}
         self._lock = checkedlock.make_lock("router.state")
         self._draining = False
@@ -169,6 +209,8 @@ class Router:
         self.requests_total: dict[tuple[str, str], int] = {}
         self.affinity_hits_total = 0
         self.retries_total = 0
+        self.prefill_routed_total = 0
+        self.hedges_total: dict[str, int] = {}
         self._placements: deque = deque(maxlen=PLACEMENT_RING)
         self._rng = random.Random()
         # keep-alive connection pool per backend netloc: a fresh TCP
@@ -243,8 +285,18 @@ class Router:
         for t in targets:
             name = getattr(t, "pod", None)
             url = getattr(t, "url", None)
-            if name is None and isinstance(t, (tuple, list)) and len(t) == 2:
-                name, url = t
+            role = None
+            kvxfer = None
+            if name is None and isinstance(t, (tuple, list)) \
+                    and len(t) >= 2:
+                # static target forms: (name, url) or
+                # (name, url, role[, kvxfer]) — benches and tests
+                name, url = t[0], t[1]
+                role = t[2] if len(t) >= 3 else None
+                kvxfer = t[3] if len(t) >= 4 else None
+            else:
+                role = getattr(t, "role", None)
+                kvxfer = getattr(t, "kvxfer", None)
             if not name or not url:
                 continue
             # the cross-process drain protocol: an operator that cannot
@@ -255,18 +307,26 @@ class Router:
                 weight = float(getattr(t, "weight", 1.0) or 1.0)
             except (TypeError, ValueError):
                 weight = 1.0
+            role = str(role).strip().lower() if role else ""
+            if role not in ("prefill", "decode"):
+                role = ""
             resolved[str(name)] = (_base_url(str(url)),
                                    getattr(t, "draining", None),
-                                   weight if weight > 0 else 1.0)
+                                   weight if weight > 0 else 1.0,
+                                   role,
+                                   str(kvxfer) if kvxfer else None)
         with self._lock:
             for name in list(self._backends):
                 if name not in resolved:
                     del self._backends[name]
-            for name, (base, draining, weight) in resolved.items():
+            for name, (base, draining, weight, role,
+                       kvxfer) in resolved.items():
                 b = self._backends.get(name)
                 if b is None:
                     b = self._backends[name] = Backend(name, base,
-                                                       weight=weight)
+                                                       weight=weight,
+                                                       role=role,
+                                                       kvxfer=kvxfer)
                 elif b.base_url != base:
                     b.base_url = base
                 if draining is not None:
@@ -274,6 +334,8 @@ class Router:
                 # a weight change (pod resized / re-annotated) re-plants
                 # only that backend's ring points on the rebuild below
                 b.weight = weight
+                b.role = role
+                b.kvxfer = kvxfer
             probe_list = [(b.name, b.base_url)
                           for b in self._backends.values() if not b.healthy]
             self._rebuild_ring_locked()
@@ -283,9 +345,17 @@ class Router:
         return count
 
     def _rebuild_ring_locked(self) -> None:
+        # prefill-role pods serve the phase-split prefill leg only:
+        # they never take normal placements (a long prompt's decode leg
+        # and every short prompt stay on the serving ring)
         self._ring.replace({b.name: b.weight
                             for b in self._backends.values()
-                            if b.healthy and not b.draining})
+                            if b.healthy and not b.draining
+                            and b.role != "prefill"})
+        self._prefill_ring.replace({b.name: b.weight
+                                    for b in self._backends.values()
+                                    if b.healthy and not b.draining
+                                    and b.role == "prefill"})
 
     def _probe(self, name: str, base_url: str) -> None:
         """Active /healthz recheck of an evicted backend — success
@@ -354,8 +424,14 @@ class Router:
             return {}
 
     def _eligible_locked(self) -> list[Backend]:
+        # prefill-role pods are not placement candidates for normal
+        # traffic (they only take the phase-split prefill leg)
         return [b for b in self._backends.values()
-                if b.healthy and not b.draining]
+                if b.healthy and not b.draining and b.role != "prefill"]
+
+    def _prefill_eligible_locked(self) -> list[Backend]:
+        return [b for b in self._backends.values()
+                if b.healthy and not b.draining and b.role == "prefill"]
 
     def _available(self, b: Backend, now: float) -> bool:
         if now < b.shed_until:
@@ -363,6 +439,77 @@ class Router:
         if self.max_inflight is not None and b.inflight >= self.max_inflight:
             return False
         return True
+
+    @staticmethod
+    def _prompt_tokens(req: dict) -> int:
+        """Estimated prompt length in engine tokens: token requests
+        count ids; text requests count UTF-8 bytes (the byte-tokenizer
+        contract the fingerprint already relies on)."""
+        tokens = req.get("tokens")
+        if isinstance(tokens, list):
+            return len(tokens)
+        text = req.get("text")
+        if isinstance(text, str):
+            return len(text.encode("utf-8", "replace"))
+        return 0
+
+    def plan_disagg(self, req: dict) -> Optional[tuple[
+            list[str], bool, Optional[str], list[str]]]:
+        """Phase-split placement for a long prompt (ISSUE 15), or None
+        when the request stays on the normal plan: ``(prefill order,
+        affine, fingerprint, kv_dests)`` — the prefill leg is
+        prefix-affine over the prefill tier's own ring (that tier's
+        radix trees compose), and the request then follows its blocks
+        to a decode pod chosen affine on the SERVING ring with the
+        same fingerprint (so the migrated prefix lands where later
+        short requests with the same template will hash).
+        ``kv_dests`` is the ORDERED decode candidate walk, affine
+        first: a decode pod refusing (pool exhausted → the prefill pod
+        answers 503) must not pin every retry to the same exhausted
+        destination."""
+        if not self.phase_split_tokens \
+                or self._prompt_tokens(req) < self.phase_split_tokens \
+                or req.get("kv_dest"):
+            return None
+        fp = ring_mod.fingerprint_request(req, self.block_size,
+                                          self.affinity_blocks)
+        now = time.monotonic()
+        with self._lock:
+            prefill = self._prefill_eligible_locked()
+            if not prefill:
+                return None  # collapsed fleet: normal plan
+            by_name = {b.name: b for b in prefill}
+            if fp is not None:
+                order = [n for n in self._prefill_ring.candidates(fp)
+                         if n in by_name]
+                affine = bool(order) and self._available(
+                    by_name[order[0]], now)
+            else:
+                order, affine = [], False
+            if not order:
+                order = [b.name for b in sorted(
+                    prefill, key=lambda b: (
+                        not self._available(b, now), b.inflight,
+                        b.name))]
+            # decode destinations: affine ring walk over kvxfer-capable
+            # candidates first, then the least-outstanding remainder —
+            # every candidate appears exactly once
+            decode = [b for b in self._eligible_locked()
+                      if b.kvxfer]
+            if not decode:
+                return None  # nobody can receive blocks: serve locally
+            by_decode = {b.name: b for b in decode}
+            dests: list[str] = []
+            if fp is not None:
+                for n in self._ring.candidates(fp):
+                    cand = by_decode.get(n)
+                    if cand is not None and self._available(cand, now):
+                        dests.append(cand.kvxfer)
+            for b in sorted(decode, key=lambda b: (
+                    not self._available(b, now), b.inflight, b.name)):
+                if b.kvxfer not in dests:
+                    dests.append(b.kvxfer)
+            return order, affine, fp, dests
 
     def plan(self, req: dict) -> tuple[list[str], bool, Optional[str]]:
         """(ordered backend names to try, affine, fingerprint) for one
@@ -437,7 +584,21 @@ class Router:
                 req = {}
         except (ValueError, json.JSONDecodeError):
             req = {}  # the backend answers the 400; no affinity
-        order, affine, fp = self.plan(req)
+        disagg = self.plan_disagg(req) if req else None
+        kv_dests: Optional[list] = None
+        if disagg is not None:
+            # phase split (ISSUE 15): the prefill tier serves this one,
+            # then streams its blocks to a decode pod — the destination
+            # rides the body, ROTATING through the decode candidates on
+            # retries (an exhausted decode pod refuses as a 503 on the
+            # prefill side; re-sending the identical destination would
+            # shed every healthy prefill pod without ever trying the
+            # other decode pods)
+            order, affine, fp, kv_dests = disagg
+            with self._lock:
+                self.prefill_routed_total += 1
+        else:
+            order, affine, fp = self.plan(req)
         if not order:
             self._finish(None, "no_backends", affine, fp, 0, t0)
             return (503, {"Retry-After": "1"},
@@ -446,9 +607,26 @@ class Router:
         attempts = min(len(order), 1 + self.retry_budget)
         last_status, last_headers, last_body = 503, {}, json.dumps(
             {"error": "all retry candidates failed"}).encode()
+        hedge_loser: Optional[str] = None
         for i, name in enumerate(order[:attempts]):
-            status, resp_headers, resp_body, err = self._forward(
-                name, body, headers)
+            if kv_dests:
+                body = json.dumps(
+                    {**req,
+                     "kv_dest": kv_dests[i % len(kv_dests)]}).encode()
+            if i > 0 and name == hedge_loser:
+                # the hedged attempt already burned this candidate (it
+                # answered the losing/failing response); walk past it
+                continue
+            if i == 0 and self.hedge_s > 0 and attempts > 1:
+                name, status, resp_headers, resp_body, err = \
+                    self._forward_hedged(order[0], order[1], body,
+                                         headers)
+                if name != order[0] and (err is not None
+                                         or status >= 500):
+                    hedge_loser = name
+            else:
+                status, resp_headers, resp_body, err = self._forward(
+                    name, body, headers)
             if err is not None:
                 self._note_transport_failure(name, err)
                 if i + 1 < attempts:
@@ -481,13 +659,15 @@ class Router:
             self._note_success(name, status)
             outcome = "ok" if status < 400 else "bad_request"
             # "affine" means SERVED affine: the first attempt landed on
-            # the ring-designated pod (a retry hop is not a hit)
-            self._finish(name, outcome, affine and i == 0, fp, i, t0)
+            # the ring-designated pod (a retry hop — or a won hedge to
+            # the next candidate — is not a hit)
+            served_affine = affine and i == 0 and name == order[0]
+            self._finish(name, outcome, served_affine, fp, i, t0)
             resp_headers["X-Router-Backend"] = name
-            resp_headers["X-Router-Affine"] = "1" if affine and i == 0 \
+            resp_headers["X-Router-Affine"] = "1" if served_affine \
                 else "0"
             return status, resp_headers, resp_body, {
-                "outcome": outcome, "affine": affine and i == 0,
+                "outcome": outcome, "affine": served_affine,
                 "backend": name, "attempts": i + 1}
         outcome = "shed" if last_status == 503 else "error"
         self._finish(order[0], outcome, affine, fp,
@@ -568,6 +748,43 @@ class Router:
                 if b2 is not None:
                     b2.inflight = max(0, b2.inflight - 1)
 
+    def _forward_hedged(self, primary: str, candidate: str, body: bytes,
+                        headers: dict) -> tuple[
+            str, int, dict, bytes, Optional[str]]:
+        """Hedged first attempt (ISSUE 15 satellite, off by default):
+        forward to ``primary``; if no response lands within
+        ``hedge_s``, race the same idempotent request against
+        ``candidate`` (the next ring member) and take whichever answers
+        FIRST — a pod wedged mid-GC or mid-compile stops defining the
+        fleet's p99.  The loser runs to completion in the background
+        (its own in-flight accounting unwinds normally); a first-won
+        failure still falls through to the ordinary retry walk.
+        Returns ``(winner, status, headers, body, err)``."""
+        results: queue.Queue = queue.Queue()
+
+        def attempt(n: str) -> None:
+            results.put((n,) + self._forward(n, body, headers))
+
+        threading.Thread(target=attempt, args=(primary,), daemon=True,
+                         name="router-hedge-primary").start()
+        try:
+            return results.get(timeout=self.hedge_s)
+        except queue.Empty:
+            pass  # primary is stuck: fire the hedge
+        threading.Thread(target=attempt, args=(candidate,), daemon=True,
+                         name="router-hedge").start()
+        try:
+            winner = results.get(timeout=self.request_timeout_s + 5.0)
+        except queue.Empty:  # both wedged past the transport timeout
+            winner = (primary, 0, {}, b"", "hedged request timed out")
+        outcome = "primary" if winner[0] == primary else "hedge"
+        if winner[4] is not None:
+            outcome = "failed"
+        with self._lock:
+            self.hedges_total[outcome] = \
+                self.hedges_total.get(outcome, 0) + 1
+        return winner
+
     # -- accounting -----------------------------------------------------------
 
     def _note_transport_failure(self, name: str, err: str) -> None:
@@ -639,12 +856,16 @@ class Router:
                     sorted(self.requests_total.items())},
                 "affinity_hits_total": self.affinity_hits_total,
                 "retries_total": self.retries_total,
+                "prefill_routed_total": self.prefill_routed_total,
+                "hedges_total": dict(self.hedges_total),
             }
 
     def debug_state(self, n_placements: int = 50) -> dict:
         """The /debug/router payload."""
         with self._lock:
             ring_state = self._ring.state()
+            prefill_ring_state = self._prefill_ring.state() \
+                if len(self._prefill_ring) else None
         return {
             "job": self.job,
             "policy": self.policy,
@@ -653,7 +874,10 @@ class Router:
             "block_size": self.block_size,
             "affinity_blocks": self.affinity_blocks,
             "retry_budget": self.retry_budget,
+            "phase_split_tokens": self.phase_split_tokens,
+            "hedge_s": self.hedge_s,
             "ring": ring_state,
+            "prefill_ring": prefill_ring_state,
             "backends": self.backends(),
             "counters": self.counters(),
             "placements": self.placements(n_placements),
@@ -665,6 +889,8 @@ class Router:
             totals = dict(self.requests_total)
             hits = self.affinity_hits_total
             retries = self.retries_total
+            prefill_routed = self.prefill_routed_total
+            hedges = dict(self.hedges_total)
             inflight = [(b.name, b.inflight)
                         for b in sorted(self._backends.values(),
                                         key=lambda b: b.name)]
@@ -688,6 +914,20 @@ class Router:
             "ring candidate (idempotent 503s and transport errors).",
             "# TYPE router_retries_total counter",
             f"router_retries_total {retries}",
+            "# HELP router_prefill_routed_total Long-prompt requests "
+            "phase-split onto the prefill tier (disaggregated serving).",
+            "# TYPE router_prefill_routed_total counter",
+            f"router_prefill_routed_total {prefill_routed}",
+            "# HELP router_hedges_total Fired request hedges by outcome "
+            "(primary = original won after the hedge fired, hedge = the "
+            "raced candidate won, failed = first response was an error).",
+            "# TYPE router_hedges_total counter",
+        ]
+        for outcome in sorted(hedges):
+            lines.append(
+                f'router_hedges_total{{outcome="{outcome}"}} '
+                f"{hedges[outcome]}")
+        lines += [
             "# HELP router_backend_inflight Live in-flight requests per "
             "backend pod.",
             "# TYPE router_backend_inflight gauge",

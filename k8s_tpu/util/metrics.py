@@ -719,4 +719,18 @@ def serving_metrics(registry: Optional[Registry] = None,
             "waited (the prefill convoy: decode stalled behind another "
             "request's prefill).",
         ),
+        # -- disaggregated prefill/decode migration (ISSUE 15) -------------
+        "kv_migrated": r.counter(
+            "serve_kv_blocks_migrated_total",
+            "KV blocks grafted into this pod's pool from a prefill-tier "
+            "peer (counted on the RECEIVING decode pod).",
+        ),
+        "kv_migrate": r.histogram(
+            "serve_kv_migrate_seconds",
+            "Cross-pod KV migration latency on the SENDING prefill pod: "
+            "block-chain send to the decode pod's seated ack (transfer "
+            "+ graft, decode excluded).",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 1.0, 2.5),
+        ),
     }

@@ -201,3 +201,28 @@ def test_v1alpha2_missing_port_rejected():
     )
     with pytest.raises(ValidationError, match="tfjob-port"):
         validate_v1alpha2_tfjob_spec(spec)
+
+
+class TestDisaggReplicaTypes:
+    """ISSUE 15: the Prefill/Decode serving tiers are first-class
+    v1alpha2 replica types."""
+
+    def test_prefill_decode_accepted(self):
+        from k8s_tpu.api.v1alpha2 import types as v2
+
+        assert "Prefill" in v2.VALID_REPLICA_TYPES
+        assert "Decode" in v2.VALID_REPLICA_TYPES
+        spec = v1alpha2.TFJobSpec(tf_replica_specs={
+            "Prefill": v1alpha2.TFReplicaSpec(template=_template(),
+                                              replicas=1),
+            "Decode": v1alpha2.TFReplicaSpec(template=_template(),
+                                             replicas=2),
+        })
+        validate_v1alpha2_tfjob_spec(spec)  # does not raise
+
+    def test_unknown_type_still_rejected(self):
+        with pytest.raises(ValidationError, match="must be one of"):
+            validate_v1alpha2_tfjob_spec(v1alpha2.TFJobSpec(
+                tf_replica_specs={
+                    "Prefiller": v1alpha2.TFReplicaSpec(
+                        template=_template(), replicas=1)}))

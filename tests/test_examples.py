@@ -127,6 +127,33 @@ class TestExampleManifests:
         probe = container["readinessProbe"]["httpGet"]
         assert probe["path"] == "/healthz"
 
+    def test_tf_job_serve_disagg_yaml(self):
+        """The disaggregated serving example (ISSUE 15): one TFJob with
+        heterogeneous Prefill/Decode tiers wired for KV migration, plus
+        the phase-splitting router companion Pod."""
+        job = load_one("tf_job_serve_disagg.yaml")
+        assert set(job.spec.tf_replica_specs) == {"Prefill", "Decode"}
+        assert job.spec.tf_replica_specs["Prefill"].replicas == 1
+        assert job.spec.tf_replica_specs["Decode"].replicas == 2
+        for rtype, role in (("Prefill", "prefill"), ("Decode", "decode")):
+            spec = job.spec.tf_replica_specs[rtype]
+            annotations = (spec.template.get("metadata") or {}).get(
+                "annotations") or {}
+            assert annotations.get("kubeflow.org/serve-role") == role
+            env = {e["name"]: e["value"]
+                   for e in spec.template["spec"]["containers"][0]["env"]}
+            assert env["K8S_TPU_SERVE_ROLE"] == role
+        dec_ann = (job.spec.tf_replica_specs["Decode"].template.get(
+            "metadata") or {}).get("annotations") or {}
+        assert dec_ann.get("kubeflow.org/kvxfer-port") == "8472"
+        with open(os.path.join(EXAMPLES,
+                               "tf_job_serve_disagg.yaml")) as f:
+            docs = list(manifest.load_yaml_documents(f.read()))
+        [pod] = [d for d in docs if d.get("kind") == "Pod"]
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["K8S_TPU_ROUTER_PHASE_TOKENS"] == "64"
+
     def test_tpu_smoke_yaml(self):
         job = load_one("tpu_smoke.yaml")
         assert job.spec.tf_replica_specs["TPU"].restart_policy == v1alpha2.RestartPolicyNever

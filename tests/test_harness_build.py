@@ -600,3 +600,96 @@ def test_bench_operator_time_to_ready():
     # max < timeout holds by construction (a bound here is vacuous)
     assert result["time_to_ready_max_s"] >= result["time_to_ready_p50_s"]
     assert result["jobs_per_sec"] > 0
+
+
+class TestGenjobDisagg:
+    """genjob --disagg (ISSUE 15): the two-tier Prefill/Decode serving
+    TFJob with KV-transfer wiring, per-role chip pricing, and the
+    phase-split router companion."""
+
+    def _load(self, job):
+        from k8s_tpu.api import validation
+        from k8s_tpu.api.v1alpha2 import defaults
+        from k8s_tpu.api.v1alpha2 import types as v2types
+
+        spec = v2types.TFJobSpec.from_dict(job["spec"])
+        tfjob = v2types.TFJob(
+            metadata=v2types.ObjectMeta(name=job["metadata"]["name"],
+                                        namespace="default"),
+            spec=spec)
+        defaults.set_defaults_tfjob(tfjob)
+        validation.validate_v1alpha2_tfjob_spec(tfjob.spec)
+        return tfjob
+
+    @staticmethod
+    def _env(spec_dict, rtype):
+        return {e["name"]: e["value"]
+                for e in spec_dict["tfReplicaSpecs"][rtype]["template"]
+                ["spec"]["containers"][0]["env"]}
+
+    @staticmethod
+    def _annotations(spec_dict, rtype):
+        return spec_dict["tfReplicaSpecs"][rtype]["template"].get(
+            "metadata", {}).get("annotations", {})
+
+    def test_two_tier_template_validates_and_prices_per_role(self):
+        job = genjob.disagg_serve_tfjob_template(
+            "j1", prefill_replicas=1, decode_replicas=2)
+        tfjob = self._load(job)
+        from k8s_tpu.controller_v2 import tpu_config
+
+        assert set(job["spec"]["tfReplicaSpecs"]) == {"Prefill",
+                                                      "Decode"}
+        # per-role chip pricing through the ordinary demand walk:
+        # 3 hosts x 4 chips (1 prefill + 2 decode)
+        assert tpu_config.chips_for_tfjob(tfjob) == 12
+
+    def test_role_env_and_annotations(self):
+        job = genjob.disagg_serve_tfjob_template("j1", kvxfer_port=9999)
+        pre_env = self._env(job["spec"], "Prefill")
+        dec_env = self._env(job["spec"], "Decode")
+        assert pre_env["K8S_TPU_SERVE_ROLE"] == "prefill"
+        assert "K8S_TPU_KVXFER_PORT" not in pre_env
+        assert dec_env["K8S_TPU_SERVE_ROLE"] == "decode"
+        assert dec_env["K8S_TPU_KVXFER_PORT"] == "9999"
+        assert self._annotations(job["spec"], "Prefill")[
+            "kubeflow.org/serve-role"] == "prefill"
+        dec_ann = self._annotations(job["spec"], "Decode")
+        assert dec_ann["kubeflow.org/serve-role"] == "decode"
+        assert dec_ann["kubeflow.org/kvxfer-port"] == "9999"
+        # both tiers are fleet-discoverable by default
+        assert self._annotations(job["spec"], "Prefill")[
+            "kubeflow.org/fleet-scrape-port"] == "8000"
+
+    def test_kvxfer_int8_stamps_prefill_only(self):
+        job = genjob.disagg_serve_tfjob_template("j1", kvxfer_int8=True)
+        assert self._env(job["spec"], "Prefill")[
+            "K8S_TPU_KVXFER_INT8"] == "1"
+        assert "K8S_TPU_KVXFER_INT8" not in self._env(job["spec"],
+                                                      "Decode")
+
+    def test_generate_disagg_with_router_companion(self):
+        docs = genjob.generate(1, serve=True, disagg=True, router=True,
+                               disagg_phase_tokens=96, timestamp=3)
+        assert [d.get("kind") for d in docs] == ["TFJob", "Pod"]
+        router_env = {e["name"]: e["value"]
+                      for e in docs[1]["spec"]["containers"][0]["env"]}
+        assert router_env["K8S_TPU_ROUTER_PHASE_TOKENS"] == "96"
+        self._load(docs[0])
+
+    def test_disagg_guards(self):
+        with pytest.raises(ValueError, match="--serve"):
+            genjob.generate(1, disagg=True)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            genjob.generate(1, serve=True, disagg=True, serve_mesh=2)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            genjob.generate(1, serve=True, disagg=True,
+                            autoscale_min=1, autoscale_max=3)
+        with pytest.raises(ValueError, match="replica"):
+            genjob.disagg_serve_tfjob_template("j", prefill_replicas=0)
+
+    def test_non_disagg_router_has_no_phase_env(self):
+        docs = genjob.generate(1, serve=True, router=True, timestamp=3)
+        router_env = {e["name"]: e["value"]
+                      for e in docs[1]["spec"]["containers"][0]["env"]}
+        assert "K8S_TPU_ROUTER_PHASE_TOKENS" not in router_env

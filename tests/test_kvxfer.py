@@ -1,0 +1,299 @@
+"""KV block transfer plane (models/kvxfer.py, ISSUE 15) — protocol
+level: framing, typed refusals, dead-peer/truncated-frame teardown,
+connection pooling.  No jax anywhere (the unit tier's constraint): the
+engine seam is a plain callable here."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from k8s_tpu.models import kvxfer
+
+
+def _migrate_payload(n_blocks=3, bs=4):
+    statics = {"v": kvxfer.PROTOCOL_VERSION, "wire_int8": False,
+               "trace_id": "abc123",
+               "req": {"first": 7, "max_new_tokens": 8, "eos": None,
+                       "temperature": 0.0, "top_k": None,
+                       "speculative": 0, "block_size": bs}}
+    arrays = {
+        "ids": np.arange(n_blocks * bs, dtype=np.int32),
+        "key": np.asarray([1, 2], np.uint32),
+        "blk/layer0/k": np.arange(n_blocks * bs * 2,
+                                  dtype=np.float32).reshape(n_blocks,
+                                                            bs, 2),
+        "blk/layer0/v": np.ones((n_blocks, bs, 2), np.float32),
+    }
+    return statics, arrays
+
+
+class TestFraming:
+    def test_round_trip(self):
+        statics, arrays = _migrate_payload()
+        data = kvxfer.encode_frame(kvxfer.OP_MIGRATE, statics, arrays)
+        a, b = socket.socketpair()
+        try:
+            a.sendall(data)
+            op, st, arr = kvxfer.read_frame(b)
+        finally:
+            a.close()
+            b.close()
+        assert op == kvxfer.OP_MIGRATE
+        assert st == statics
+        assert set(arr) == set(arrays)
+        for name in arrays:
+            assert arr[name].dtype == arrays[name].dtype
+            assert np.array_equal(arr[name], arrays[name])
+
+    def test_truncated_frame_raises_peer_gone(self):
+        statics, arrays = _migrate_payload()
+        data = kvxfer.encode_frame(kvxfer.OP_MIGRATE, statics, arrays)
+        a, b = socket.socketpair()
+        try:
+            a.sendall(data[:len(data) // 2])
+            a.close()  # EOF mid-frame
+            with pytest.raises(kvxfer.KvPeerGone):
+                kvxfer.read_frame(b)
+        finally:
+            b.close()
+
+    def test_garbage_header_raises_peer_gone_not_alloc(self):
+        a, b = socket.socketpair()
+        try:
+            # a length prefix claiming a multi-MB header
+            a.sendall((1 << 25).to_bytes(4, "big") + b"x" * 64)
+            with pytest.raises(kvxfer.KvPeerGone):
+                kvxfer.read_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_non_json_header_is_peer_gone(self):
+        a, b = socket.socketpair()
+        try:
+            payload = b"not json at all"
+            a.sendall(len(payload).to_bytes(4, "big") + payload)
+            with pytest.raises(kvxfer.KvPeerGone):
+                kvxfer.read_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestParseDest:
+    def test_ok(self):
+        assert kvxfer.parse_dest("10.0.0.1:8472") == ("10.0.0.1", 8472)
+
+    @pytest.mark.parametrize("bad", ["nohost", ":8472", "h:not-int",
+                                     "h:0", "h:70000", ""])
+    def test_bad(self, bad):
+        with pytest.raises(ValueError):
+            kvxfer.parse_dest(bad)
+
+
+class _FakeEngineSeat:
+    """A seat_fn stand-in: records the payload, fires the seated
+    callback, returns canned tokens (or raises a scripted error)."""
+
+    def __init__(self, tokens=(7, 8, 9), error=None, seat_delay=0.0):
+        self.tokens = list(tokens)
+        self.error = error
+        self.seat_delay = seat_delay
+        self.calls = []
+
+    def __call__(self, statics, arrays, on_seated):
+        self.calls.append((statics, arrays))
+        if self.error is not None:
+            raise self.error
+        if self.seat_delay:
+            time.sleep(self.seat_delay)
+        on_seated()
+        return self.tokens
+
+
+class _PoolExhausted(RuntimeError):
+    """Name-mapped refusal (the receiver maps by type NAME so this
+    module never imports the engine)."""
+
+
+_PoolExhausted.__name__ = "PoolExhausted"
+
+
+class TestReceiverSender:
+    def _pair(self, seat):
+        recv = kvxfer.KvReceiver(seat, port=0)
+        send = kvxfer.KvSender()
+        return recv, send, f"127.0.0.1:{recv.port}"
+
+    def test_migrate_round_trip_and_pooling(self):
+        seat = _FakeEngineSeat(tokens=(1, 2, 3))
+        recv, send, dest = self._pair(seat)
+        try:
+            statics, arrays = _migrate_payload()
+            tokens, seated_s = send.migrate(dest, statics, arrays)
+            assert tokens == [1, 2, 3]
+            assert seated_s >= 0.0
+            # the decode side saw the exact bytes
+            st, arr = seat.calls[0]
+            assert st["req"]["first"] == 7
+            assert np.array_equal(arr["blk/layer0/k"],
+                                  arrays["blk/layer0/k"])
+            # second migration reuses the pooled connection
+            send.migrate(dest, statics, arrays)
+            assert send.stats()["pooled_connections"] == 1
+            assert recv.stats()["migrations"] == 2
+            assert recv.stats()["blocks_in"] == 6
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_typed_refusal_travels(self):
+        seat = _FakeEngineSeat(error=_PoolExhausted("no room"))
+        recv, send, dest = self._pair(seat)
+        try:
+            statics, arrays = _migrate_payload()
+            with pytest.raises(kvxfer.KvTransferError) as ei:
+                send.migrate(dest, statics, arrays)
+            assert ei.value.kind == "pool_exhausted"
+            # the refusal completed the conversation: the socket is
+            # reusable and a later migration succeeds
+            seat.error = None
+            tokens, _ = send.migrate(dest, statics, arrays)
+            assert tokens == [7, 8, 9]
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_bad_request_refusal_kind(self):
+        seat = _FakeEngineSeat(error=ValueError("shape mismatch"))
+        recv, send, dest = self._pair(seat)
+        try:
+            with pytest.raises(kvxfer.KvTransferError) as ei:
+                send.migrate(dest, *_migrate_payload())
+            assert ei.value.kind == "bad_request"
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_dead_peer_mid_conversation(self):
+        """Receiver dies between seated and tokens: the sender raises
+        KvPeerGone (kind peer_gone), not a hang."""
+        seat = _FakeEngineSeat(seat_delay=0.5)
+        recv, send, dest = self._pair(seat)
+
+        def chaos():
+            time.sleep(0.15)  # after the migrate frame landed
+            recv.stop()
+
+        t = threading.Thread(target=chaos, daemon=True)
+        t.start()
+        try:
+            with pytest.raises(kvxfer.KvTransferError):
+                send.migrate(dest, *_migrate_payload())
+        finally:
+            t.join()
+            send.close()
+
+    def test_truncated_frame_tears_down_connection_only(self):
+        """A garbage client connection is torn down; the receiver keeps
+        serving real migrations afterwards."""
+        seat = _FakeEngineSeat()
+        recv, send, dest = self._pair(seat)
+        try:
+            raw = socket.create_connection(("127.0.0.1", recv.port))
+            raw.sendall(b"\x00\x00\x00\x10short")  # truncated
+            raw.close()
+            deadline = time.monotonic() + 5
+            while recv.stats()["peer_gone"] < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert recv.stats()["peer_gone"] >= 1
+            tokens, _ = send.migrate(dest, *_migrate_payload())
+            assert tokens == [7, 8, 9]
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_stale_pooled_connection_gets_fresh_retry(self):
+        """The receiver closing an idle keep-alive is not a failure:
+        the sender retries once on a fresh socket."""
+        seat = _FakeEngineSeat()
+        recv, send, dest = self._pair(seat)
+        try:
+            send.migrate(dest, *_migrate_payload())
+            # sever the pooled socket server-side
+            with recv._lock:
+                conns = list(recv._conns)
+            for c in conns:
+                c.shutdown(socket.SHUT_RDWR)
+            time.sleep(0.05)
+            tokens, _ = send.migrate(dest, *_migrate_payload())
+            assert tokens == [7, 8, 9]
+        finally:
+            send.close()
+            recv.stop()
+
+
+class TestEnvKnobs:
+    def test_role(self, monkeypatch):
+        monkeypatch.delenv(kvxfer.ENV_ROLE, raising=False)
+        assert kvxfer.env_role() == ""
+        monkeypatch.setenv(kvxfer.ENV_ROLE, "Prefill")
+        assert kvxfer.env_role() == "prefill"
+        monkeypatch.setenv(kvxfer.ENV_ROLE, "decode")
+        assert kvxfer.env_role() == "decode"
+        monkeypatch.setenv(kvxfer.ENV_ROLE, "garbage")
+        assert kvxfer.env_role() == ""
+
+    def test_port(self, monkeypatch):
+        monkeypatch.delenv(kvxfer.ENV_PORT, raising=False)
+        assert kvxfer.env_kvxfer_port() is None
+        monkeypatch.setenv(kvxfer.ENV_PORT, "8472")
+        assert kvxfer.env_kvxfer_port() == 8472
+        monkeypatch.setenv(kvxfer.ENV_PORT, "0")
+        assert kvxfer.env_kvxfer_port() == 0
+        monkeypatch.setenv(kvxfer.ENV_PORT, "garbage")
+        assert kvxfer.env_kvxfer_port() is None
+        monkeypatch.setenv(kvxfer.ENV_PORT, "70000")
+        assert kvxfer.env_kvxfer_port() is None
+
+    def test_int8(self, monkeypatch):
+        monkeypatch.delenv(kvxfer.ENV_INT8, raising=False)
+        assert kvxfer.env_kvxfer_int8() is False
+        monkeypatch.setenv(kvxfer.ENV_INT8, "1")
+        assert kvxfer.env_kvxfer_int8() is True
+
+    def test_default_port_matches_genjob(self):
+        from k8s_tpu.cmd import genjob
+
+        assert genjob.KVXFER_PORT == kvxfer.DEFAULT_PORT
+
+
+class TestReplyTimeoutNoDuplicate:
+    def test_reply_timeout_does_not_resend(self):
+        """A reply timeout on a pooled connection must NOT be treated
+        as a stale keep-alive: the migrate frame already reached the
+        receiver, and a re-send would seat (and decode) the request a
+        second time on an already-slow decode pod."""
+        seat = _FakeEngineSeat()
+        recv = kvxfer.KvReceiver(seat, port=0)
+        send = kvxfer.KvSender(reply_timeout_s=0.25)
+        dest = f"127.0.0.1:{recv.port}"
+        try:
+            send.migrate(dest, *_migrate_payload())  # pools the socket
+            assert len(seat.calls) == 1
+            seat.seat_delay = 1.0  # slower than the reply timeout
+            with pytest.raises(kvxfer.KvPeerGone, match="timed out"):
+                send.migrate(dest, *_migrate_payload())
+            time.sleep(1.2)  # let the slow seat finish server-side
+            # exactly TWO migrate frames ever reached the receiver —
+            # the timed-out attempt was not re-sent
+            assert len(seat.calls) == 2
+        finally:
+            send.close()
+            recv.stop()

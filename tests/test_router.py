@@ -957,3 +957,349 @@ class TestTraceparent:
             server.stop()
             httpd.shutdown()
             httpd.server_close()
+
+
+class _EchoPod:
+    """A minimal backend recording the bodies it serves (disagg/hedge
+    tests); optional per-request delay."""
+
+    def __init__(self, name: str, delay: float = 0.0):
+        self.name = name
+        self.delay = delay
+        self.bodies: list[dict] = []
+        pod = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n))
+                pod.bodies.append(body)
+                if pod.delay:
+                    time.sleep(pod.delay)
+                out = json.dumps({"tokens": [1, 2, 3],
+                                  "served_by": pod.name}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         kwargs={"poll_interval": 0.05},
+                         daemon=True).start()
+        self.url = "http://127.0.0.1:%d" % self.httpd.server_address[1]
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestPhaseSplit:
+    """Disaggregated phase split (ISSUE 15): long prompts route to the
+    prefill tier with the decode destination injected; short prompts
+    and collapsed fleets are untouched."""
+
+    def _fleet(self, phase_tokens=8):
+        pre = _EchoPod("pre")
+        dec = _EchoPod("dec")
+        targets = [("pre", pre.url, "prefill", None),
+                   ("dec", dec.url, "decode", "127.0.0.1:9999")]
+        router = router_mod.Router(lambda: targets, block_size=4,
+                                   phase_split_tokens=phase_tokens,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        return pre, dec, router, server
+
+    def test_long_routes_prefill_with_kv_dest(self):
+        pre, dec, router, server = self._fleet()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            _status, _h, out = _post(url, {"tokens": list(range(16))})
+            assert out["served_by"] == "pre"
+            assert pre.bodies[-1]["kv_dest"] == "127.0.0.1:9999"
+            assert router.counters()["prefill_routed_total"] == 1
+        finally:
+            server.stop()
+            pre.stop()
+            dec.stop()
+
+    def test_short_stays_on_decode_tier(self):
+        pre, dec, router, server = self._fleet()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            _status, _h, out = _post(url, {"tokens": [1, 2, 3]})
+            assert out["served_by"] == "dec"
+            assert "kv_dest" not in dec.bodies[-1]
+            assert router.counters()["prefill_routed_total"] == 0
+        finally:
+            server.stop()
+            pre.stop()
+            dec.stop()
+
+    def test_text_prompts_split_on_byte_length(self):
+        pre, dec, router, server = self._fleet(phase_tokens=10)
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            _s, _h, out = _post(url, {"text": "x" * 32})
+            assert out["served_by"] == "pre"
+            _s, _h, out = _post(url, {"text": "hi"})
+            assert out["served_by"] == "dec"
+        finally:
+            server.stop()
+            pre.stop()
+            dec.stop()
+
+    def test_collapsed_fleet_ignores_threshold(self):
+        """phase_split_tokens set but no prefill-role backends: the
+        normal plan serves everything (safe to leave configured)."""
+        a = _EchoPod("a")
+        router = router_mod.Router(lambda: [("a", a.url)], block_size=4,
+                                   phase_split_tokens=8,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            _s, _h, out = _post(url, {"tokens": list(range(16))})
+            assert out["served_by"] == "a"
+            assert "kv_dest" not in a.bodies[-1]
+        finally:
+            server.stop()
+            a.stop()
+
+    def test_prefill_pods_excluded_from_normal_placement(self):
+        """Without the threshold, prefill-role pods take NO traffic —
+        they only serve the phase-split leg."""
+        pre = _EchoPod("pre")
+        dec = _EchoPod("dec")
+        targets = [("pre", pre.url, "prefill", None),
+                   ("dec", dec.url, "decode", None)]
+        router = router_mod.Router(lambda: targets, block_size=4,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            for _attempt in range(4):
+                _s, _h, out = _post(url, {"tokens": list(range(16))})
+                assert out["served_by"] == "dec"
+        finally:
+            server.stop()
+            pre.stop()
+            dec.stop()
+
+    def test_no_kvxfer_capable_decode_serves_locally(self):
+        """Prefill tier exists but no decode pod advertises a kvxfer
+        address: the request is served like a collapsed fleet instead
+        of 500ing."""
+        pre = _EchoPod("pre")
+        dec = _EchoPod("dec")
+        targets = [("pre", pre.url, "prefill", None),
+                   ("dec", dec.url, "decode", None)]
+        router = router_mod.Router(lambda: targets, block_size=4,
+                                   phase_split_tokens=8,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            _s, _h, out = _post(url, {"tokens": list(range(16))})
+            assert out["served_by"] == "dec"
+            assert "kv_dest" not in dec.bodies[-1]
+        finally:
+            server.stop()
+            pre.stop()
+            dec.stop()
+
+    def test_discovery_annotations_reach_backends(self):
+        """The serve-role / kvxfer-port pod annotations flow through
+        fleet discovery into role-aware router backends."""
+        from k8s_tpu.fleet import discovery
+
+        def pod(name, role, kvxfer_port=None):
+            ann = {discovery.ANNOTATION_SCRAPE_PORT: "8000",
+                   discovery.ANNOTATION_SERVE_ROLE: role}
+            if kvxfer_port:
+                ann[discovery.ANNOTATION_KVXFER_PORT] = str(kvxfer_port)
+            return {
+                "metadata": {
+                    "name": name, "namespace": "ns",
+                    "annotations": ann,
+                    "labels": {"tf_job_key": "ns-j",
+                               "tf-replica-type": "decode",
+                               "tf-replica-index": "0"},
+                    "ownerReferences": [{"controller": True,
+                                         "kind": "TFJob", "name": "j"}],
+                },
+                "status": {"phase": "Running", "podIP": "10.0.0.7"},
+            }
+
+        targets = discovery.targets_from_pods(
+            [pod("p0", "prefill"), pod("p1", "decode", 8472),
+             pod("p2", "garbage-role")])
+        by_name = {t.pod: t for t in targets}
+        assert by_name["p0"].role == "prefill"
+        assert by_name["p0"].kvxfer is None
+        assert by_name["p1"].role == "decode"
+        assert by_name["p1"].kvxfer == "10.0.0.7:8472"
+        assert by_name["p2"].role == ""  # garbage: collapsed pod
+        router = router_mod.Router(lambda: targets, refresh_interval_s=0,
+                                   phase_split_tokens=4)
+        router.refresh_once()
+        backends = {b["name"]: b for b in router.backends()}
+        assert backends["p0"]["role"] == "prefill"
+        assert backends["p1"]["kvxfer"] == "10.0.0.7:8472"
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv(router_mod.ENV_PHASE_TOKENS, "64")
+        monkeypatch.setenv(router_mod.ENV_HEDGE_S, "1.5")
+        assert router_mod.phase_tokens_from_env() == 64
+        assert router_mod.hedge_s_from_env() == 1.5
+        monkeypatch.setenv(router_mod.ENV_PHASE_TOKENS, "0")
+        monkeypatch.setenv(router_mod.ENV_HEDGE_S, "garbage")
+        assert router_mod.phase_tokens_from_env() is None
+        assert router_mod.hedge_s_from_env() == 0.0
+
+
+class TestHedging:
+    """Request hedging (ISSUE 15 satellite, off by default): a stuck
+    primary races the next ring candidate, first response wins."""
+
+    def test_hedge_wins_over_stuck_primary(self):
+        a = _EchoPod("a", delay=1.5)
+        b = _EchoPod("b")
+        router = router_mod.Router(
+            lambda: [("a", a.url), ("b", b.url)],
+            policy=router_mod.POLICY_LEAST, hedge_s=0.15,
+            refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            t0 = time.monotonic()
+            _s, headers, out = _post(url, {"tokens": [1]})
+            elapsed = time.monotonic() - t0
+            assert out["served_by"] == "b"
+            assert elapsed < 1.0  # did not wait out the stuck primary
+            assert headers["X-Router-Backend"] == "b"
+            assert router.counters()["hedges_total"] == {"hedge": 1}
+            assert "router_hedges_total" in router.metrics_text()
+        finally:
+            server.stop()
+            a.stop()
+            b.stop()
+
+    def test_fast_primary_fires_no_hedge(self):
+        a = _EchoPod("a")
+        b = _EchoPod("b")
+        router = router_mod.Router(
+            lambda: [("a", a.url), ("b", b.url)],
+            policy=router_mod.POLICY_LEAST, hedge_s=0.5,
+            refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            _s, _h, out = _post(url, {"tokens": [1]})
+            assert out["served_by"] == "a"
+            assert router.counters()["hedges_total"] == {}
+            assert b.bodies == []
+        finally:
+            server.stop()
+            a.stop()
+            b.stop()
+
+    def test_hedge_off_by_default(self):
+        a = _EchoPod("a", delay=0.4)
+        b = _EchoPod("b")
+        router = router_mod.Router(
+            lambda: [("a", a.url), ("b", b.url)],
+            policy=router_mod.POLICY_LEAST, refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            _s, _h, out = _post(url, {"tokens": [1]})
+            assert out["served_by"] == "a"  # waited: no hedging
+            assert b.bodies == []
+        finally:
+            server.stop()
+            a.stop()
+            b.stop()
+
+
+class TestKvDestRotation:
+    def test_retry_walk_rotates_decode_destinations(self):
+        """A decode pod refusing a migration surfaces as a 503 on the
+        prefill side; the retry walk must try the NEXT decode
+        destination instead of re-pinning every attempt to the
+        exhausted one."""
+        seen_dests: list = []
+
+        class _RefusingPod(_EchoPod):
+            """Refuses whichever destination the router tries FIRST
+            (an exhausted decode pod looks like this from the prefill
+            side), accepts any other — so the test is independent of
+            which decode pod the fingerprint hashes to."""
+
+            def __init__(self, name):
+                super().__init__(name)
+                pod = self
+
+                class H(BaseHTTPRequestHandler):
+                    protocol_version = "HTTP/1.1"
+
+                    def log_message(self, *a):
+                        pass
+
+                    def do_POST(self):  # noqa: N802
+                        n = int(self.headers.get("Content-Length") or 0)
+                        body = json.loads(self.rfile.read(n))
+                        pod.bodies.append(body)
+                        dest = body.get("kv_dest")
+                        seen_dests.append(dest)
+                        if dest == seen_dests[0]:
+                            out = json.dumps(
+                                {"error": "pool exhausted"}).encode()
+                            code = 503
+                        else:
+                            out = json.dumps(
+                                {"tokens": [1],
+                                 "served_by": pod.name}).encode()
+                            code = 200
+                        self.send_response(code)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(out)))
+                        self.end_headers()
+                        self.wfile.write(out)
+
+                # rebind the handler on the already-running server
+                self.httpd.RequestHandlerClass = H
+
+        pre_a = _RefusingPod("pre-a")
+        pre_b = _RefusingPod("pre-b")
+        dec_a = _EchoPod("dec-a")
+        dec_b = _EchoPod("dec-b")
+        targets = [("pre-a", pre_a.url, "prefill", None),
+                   ("pre-b", pre_b.url, "prefill", None),
+                   ("dec-a", dec_a.url, "decode", "127.0.0.1:9001"),
+                   ("dec-b", dec_b.url, "decode", "127.0.0.1:9002")]
+        router = router_mod.Router(lambda: targets, block_size=4,
+                                   phase_split_tokens=8,
+                                   retry_budget=3,
+                                   refresh_interval_s=0)
+        server = router_mod.RouterServer(router).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            status, _h, out = _post(url, {"tokens": list(range(16))})
+            assert status == 200
+            assert out["tokens"] == [1]
+            # the walk tried more than one distinct destination
+            assert len(set(d for d in seen_dests if d)) >= 2
+        finally:
+            server.stop()
+            for p in (pre_a, pre_b, dec_a, dec_b):
+                p.stop()
