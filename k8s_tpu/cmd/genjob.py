@@ -75,6 +75,8 @@ def serve_tfjob_template(
     serve_batch_spec: bool = True,
     serve_request_log: bool = True,
     serve_request_log_ring: int | None = None,
+    serve_spill_mb: int | None = None,
+    kvxfer_dedup: bool | None = None,
     priority: int | None = None,
     queue: str | None = None,
     fleet_scrape_port: int | None = SERVE_HTTP_PORT,
@@ -115,6 +117,15 @@ def serve_tfjob_template(
     timeline ring bound (``K8S_TPU_REQUEST_LOG_RING``; omit for the
     512 default), and ``serve_request_log=False`` opts out.
 
+    ISSUE 17: ``serve_spill_mb`` stamps ``K8S_TPU_SERVE_SPILL_MB`` —
+    the host-RAM KV spill tier's budget (0/omitted = off).  The spill
+    buffers live in POD memory, on top of params and the device pool's
+    host shadow: size ``resources.limits.memory`` with at least that
+    headroom or the kubelet OOM-kills the pod at exactly the moment
+    the tier fills.  ``kvxfer_dedup`` stamps
+    ``K8S_TPU_KVXFER_DEDUP`` (the migration block-dedup handshake;
+    omit for the server's default, which is ON).
+
     ISSUE 13: ``autoscale_min``/``autoscale_max`` (both or neither)
     stamp the ``spec.autoscale`` bounds the operator's metric-driven
     gang autoscaler scales inside (``K8S_TPU_AUTOSCALE`` gates the loop
@@ -145,6 +156,15 @@ def serve_tfjob_template(
     if serve_request_log_ring is not None:
         env.append({"name": "K8S_TPU_REQUEST_LOG_RING",
                     "value": str(serve_request_log_ring)})
+    if serve_spill_mb is not None:
+        if serve_spill_mb < 0:
+            raise ValueError(
+                f"serve_spill_mb must be >= 0, got {serve_spill_mb}")
+        env.append({"name": "K8S_TPU_SERVE_SPILL_MB",
+                    "value": str(serve_spill_mb)})
+    if kvxfer_dedup is not None:
+        env.append({"name": "K8S_TPU_KVXFER_DEDUP",
+                    "value": "1" if kvxfer_dedup else "0"})
     if serve_mesh is not None:
         if serve_mesh < 1:
             raise ValueError(f"serve_mesh must be >= 1, got {serve_mesh}")
@@ -331,6 +351,8 @@ def disagg_serve_tfjob_template(
     serve_batch_spec: bool = True,
     serve_request_log: bool = True,
     serve_request_log_ring: int | None = None,
+    serve_spill_mb: int | None = None,
+    kvxfer_dedup: bool | None = None,
     priority: int | None = None,
     queue: str | None = None,
     fleet_scrape_port: int | None = SERVE_HTTP_PORT,
@@ -352,6 +374,14 @@ def disagg_serve_tfjob_template(
     - **Decode** pods run ``K8S_TPU_SERVE_ROLE=decode`` and listen on
       ``K8S_TPU_KVXFER_PORT``: they seat migrated requests directly
       from imported blocks and serve every short prompt locally.
+
+    ISSUE 17 stamps both tiers: ``serve_spill_mb`` sets
+    ``K8S_TPU_SERVE_SPILL_MB`` (the host-RAM KV spill tier budget;
+    prefill pods spill their prefix tree too — size each tier's
+    ``resources.limits.memory`` with that much headroom), and
+    ``kvxfer_dedup`` sets ``K8S_TPU_KVXFER_DEDUP`` — the prefill
+    sender's block-dedup offer AND the decode receiver's index seam
+    (omit for the default, ON).
 
     Each tier's pod template carries ``kubeflow.org/serve-role`` (and
     the decode tier ``kubeflow.org/kvxfer-port``), so fleet discovery
@@ -379,6 +409,15 @@ def disagg_serve_tfjob_template(
     if serve_request_log_ring is not None:
         base_env.append({"name": "K8S_TPU_REQUEST_LOG_RING",
                          "value": str(serve_request_log_ring)})
+    if serve_spill_mb is not None:
+        if serve_spill_mb < 0:
+            raise ValueError(
+                f"serve_spill_mb must be >= 0, got {serve_spill_mb}")
+        base_env.append({"name": "K8S_TPU_SERVE_SPILL_MB",
+                         "value": str(serve_spill_mb)})
+    if kvxfer_dedup is not None:
+        base_env.append({"name": "K8S_TPU_KVXFER_DEDUP",
+                         "value": "1" if kvxfer_dedup else "0"})
     if fleet_scrape_port is not None:
         base_env.append({"name": "K8S_TPU_FLEET_SCRAPE_PORT",
                          "value": str(fleet_scrape_port)})
@@ -610,6 +649,8 @@ def generate(
     serve_batch_spec: bool = True,
     serve_request_log: bool = True,
     serve_request_log_ring: int | None = None,
+    serve_spill_mb: int | None = None,
+    kvxfer_dedup: bool | None = None,
     fleet_scrape_port: int | None = 8000,
     fleet_interval_s: float | None = None,
     router: bool = False,
@@ -681,6 +722,8 @@ def generate(
                     serve_batch_spec=serve_batch_spec,
                     serve_request_log=serve_request_log,
                     serve_request_log_ring=serve_request_log_ring,
+                    serve_spill_mb=serve_spill_mb,
+                    kvxfer_dedup=kvxfer_dedup,
                     priority=priority, queue=queue,
                     fleet_scrape_port=fleet_scrape_port,
                     fleet_interval_s=fleet_interval_s,
@@ -696,6 +739,8 @@ def generate(
                     serve_batch_spec=serve_batch_spec,
                     serve_request_log=serve_request_log,
                     serve_request_log_ring=serve_request_log_ring,
+                    serve_spill_mb=serve_spill_mb,
+                    kvxfer_dedup=kvxfer_dedup,
                     priority=priority, queue=queue,
                     fleet_scrape_port=fleet_scrape_port,
                     fleet_interval_s=fleet_interval_s,
@@ -841,6 +886,18 @@ def main(argv=None) -> int:
                         help="K8S_TPU_KVXFER_INT8 on Prefill-tier pods: "
                         "quantize fp-pool KV content for transit "
                         "(lossy on fp pools; no-op on int8 pools)")
+    parser.add_argument("--serve-spill-mb", type=int, default=None,
+                        help="K8S_TPU_SERVE_SPILL_MB: host-RAM KV spill "
+                        "tier budget in MB (ISSUE 17; 0 or omitted = "
+                        "off).  Counts against the pod memory limit — "
+                        "leave that much headroom in resources.limits."
+                        "memory")
+    parser.add_argument("--kvxfer-dedup", type=int, choices=(0, 1),
+                        default=None,
+                        help="K8S_TPU_KVXFER_DEDUP: the migration "
+                        "block-fingerprint dedup handshake (ISSUE 17). "
+                        "Omit for the server default (on); 0 ships "
+                        "every block unconditionally")
     parser.add_argument(
         "--dump", action="store_true", help="print manifests instead of creating"
     )
@@ -864,6 +921,9 @@ def main(argv=None) -> int:
         serve_batch_spec=bool(args.serve_batch_spec),
         serve_request_log=bool(args.serve_request_log),
         serve_request_log_ring=args.serve_request_log_ring,
+        serve_spill_mb=args.serve_spill_mb,
+        kvxfer_dedup=(None if args.kvxfer_dedup is None
+                      else bool(args.kvxfer_dedup)),
         fleet_scrape_port=args.fleet_scrape_port or None,
         fleet_interval_s=args.fleet_interval,
         router=args.router,

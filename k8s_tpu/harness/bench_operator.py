@@ -1487,6 +1487,32 @@ def run_disagg(args) -> dict:
     return result
 
 
+def run_kvtier(args) -> dict:
+    """The --kvtier scenario wrapper (ISSUE 17): the tiered KV memory
+    hierarchy bench (harness/bench_kvtier.py — host-RAM spill tier vs
+    evict-recompute on a corpus ~10x the pool's prefix headroom,
+    fingerprint-dedup migration storm over real sockets, fixed-seed
+    identity through demote->promote and deduped migration on every
+    lane EMBEDDED), on the one-JSON-line contract.  The
+    bench_kvtier.json artifact is written on assertion failure too,
+    ``failures`` included."""
+    from k8s_tpu.harness import bench_kvtier
+
+    try:
+        result = bench_kvtier.run_bench(
+            corpus=args.kvtier_corpus,
+            rounds=args.kvtier_rounds,
+            spill_mb=args.kvtier_spill_mb,
+            storm=args.kvtier_storm)
+    except RuntimeError as e:
+        partial = getattr(e, "result", None)
+        if partial is not None:
+            _write_artifact(args.kvtier_out, partial)
+        raise
+    _write_artifact(args.kvtier_out, result)
+    return result
+
+
 def run_serve_mp(args) -> dict:
     """The --serve-mp scenario wrapper (ISSUE 14): the multi-host
     tensor-parallel serving bench (harness/bench_serve_mp.py — a REAL
@@ -2434,6 +2460,28 @@ def main(argv=None) -> int:
     p.add_argument("--disagg-out", default=None,
                    help="write the bench_disagg.json artifact here "
                    "(written on assertion failure too)")
+    p.add_argument("--kvtier", action="store_true",
+                   help="tiered KV memory hierarchy scenario (ISSUE "
+                   "17): host-RAM spill tier vs evict-recompute on a "
+                   "corpus ~10x pool capacity (tokens/s + post-warmup "
+                   "prefix hit rate must strictly beat the baseline), "
+                   "fingerprint-dedup migration storm (wire bytes "
+                   "saved > 0), and fixed-seed identity through "
+                   "demote->promote and deduped migration on every "
+                   "lane — greedy/sampled/top-k/spec")
+    p.add_argument("--kvtier-corpus", type=int, default=24,
+                   help="distinct prompts in the spill replay corpus "
+                   "(~10x the pool's prefix headroom at the default "
+                   "geometry)")
+    p.add_argument("--kvtier-rounds", type=int, default=3,
+                   help="measured post-warmup replay rounds per arm")
+    p.add_argument("--kvtier-spill-mb", type=int, default=16,
+                   help="host spill budget for the spill arm")
+    p.add_argument("--kvtier-storm", type=int, default=6,
+                   help="repeated-prefix migrations in the dedup storm")
+    p.add_argument("--kvtier-out", default=None,
+                   help="write the bench_kvtier.json artifact here "
+                   "(written on assertion failure too)")
     p.add_argument("--serve-mp", action="store_true",
                    help="multi-host tensor-parallel serving gang bench "
                    "(harness/bench_serve_mp.py: 1-process vs N-process "
@@ -2606,7 +2654,7 @@ def _run(args, p) -> int:
 
     if args.slice_scale or args.measure_restart or args.contention \
             or args.serve or args.serve_mp or args.churn or args.fleet \
-            or args.router or args.disagg:
+            or args.router or args.disagg or args.kvtier:
         if args.backend != "fake" and (args.slice_scale
                                        or args.measure_restart
                                        or args.contention or args.churn
@@ -2643,6 +2691,10 @@ def _run(args, p) -> int:
             # real engines + real sockets like --serve; runs after it
             # so the JAX warmup cost is already paid in-process
             results.append(run_disagg(args))
+        if args.kvtier:
+            # in-process engines + one real socket pair, after --disagg
+            # so the JAX warmup cost is already paid in-process
+            results.append(run_kvtier(args))
         if args.serve_mp:
             # real OS-process gangs: runs last so the in-process
             # scenarios' timings aren't perturbed by gang spawn load

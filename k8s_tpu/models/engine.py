@@ -126,9 +126,10 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from k8s_tpu.models import kvtier
 from k8s_tpu.models import placement as placement_lib
 from k8s_tpu.models.decode import prefill_buckets_for, split_prefill
-from k8s_tpu.models.kvblocks import BlockPool, PrefixTree
+from k8s_tpu.models.kvblocks import BlockPool, PrefixTree, chain_tokens
 
 log = logging.getLogger(__name__)
 
@@ -233,6 +234,16 @@ class PoolExhausted(RuntimeError):
         self.needed = needed
         self.available = available
         self.retry_after_s = retry_after_s
+
+
+class DedupStale(RuntimeError):
+    """A deduped migration promised prefix blocks this engine no longer
+    holds (evicted between the OP_NEED answer and the seat, ISSUE 17).
+    The ``kind`` travels back as a typed kvxfer error frame; the sender
+    re-sends the full chain once — the dedup index is advisory, the
+    seat path is the truth."""
+
+    kind = "dedup_stale"
 
 
 def _flatten_tree(tree) -> dict:
@@ -346,7 +357,8 @@ class Engine:
                  block_size: Optional[int] = None,
                  prefix_blocks: Optional[int] = None,
                  metrics: Optional[dict] = None,
-                 placement=None):
+                 placement=None,
+                 spill_mb: Optional[int] = None):
         if slots is None:
             slots = env_slots() or DEFAULT_SLOTS
         if slots < 1:
@@ -477,6 +489,16 @@ class Engine:
             self._pool_alloc = BlockPool(self.pool_blocks)
             self._tree = PrefixTree(block_size) \
                 if self.prefix_blocks > 0 else None
+            # host-RAM spill tier (ISSUE 17): evicted tree leaves demote
+            # to bounded host buffers instead of dying; 0 MB (the
+            # default) keeps the pre-hierarchy evict-means-recompute
+            # behavior.  Needs the gather/graft chain seams, so a mesh
+            # placement (no local pool export) stays single-tier.
+            if spill_mb is None:
+                spill_mb = kvtier.env_spill_mb()
+            self._spill = kvtier.SpillTier(int(spill_mb) * (1 << 20)) \
+                if (self._tree is not None and int(spill_mb) > 0
+                    and self._gather_fn is not None) else None
             self._cache = None
             # device-side table stack, refreshed only when a slot's
             # table changes (join/retire/growth) — not every step
@@ -498,6 +520,7 @@ class Engine:
             self._gather_fn = None
             self._graft_fn = None
             self._pool_leaf_meta = {}
+            self._spill = None
 
         # runtime compile ledger (ISSUE 11, K8S_TPU_COMPILE_LEDGER=1):
         # every jit entry point becomes a declared SEAM with the compile
@@ -529,6 +552,17 @@ class Engine:
         self._kv_imports = 0
         self._kv_blocks_out = 0
         self._kv_blocks_in = 0
+        # tiered-KV counters (ISSUE 17): blocks a deduped migration
+        # attached locally instead of receiving, and full-block prefix
+        # chains grafted in through the fleet fetch-on-miss path
+        self._kv_blocks_deduped = 0
+        self._kv_prefix_fetched = 0
+        # chain-fingerprint index over the tree's resident chains
+        # (spill entries carry their own): mutated ONLY on the engine
+        # thread; cross-thread readers (prefix_index, dedup_have) take
+        # GIL-atomic dict snapshots and every consumer re-verifies at
+        # use time
+        self._tree_fps: dict[str, bool] = {}
         self._peak_active = 0
         self._prefix_hits = 0
         self._prefix_tokens_saved = 0
@@ -683,6 +717,7 @@ class Engine:
                          top_k: Optional[int] = None,
                          speculative: int = 0,
                          block_size: Optional[int] = None,
+                         skip: int = 0,
                          timeout: Optional[float] = None,
                          trace_id: Optional[str] = None,
                          seated: Optional[Callable[[], None]] = None
@@ -707,7 +742,16 @@ class Engine:
         the engine thread the moment the request holds its slot (the
         kv-transfer plane's ack seam; keep it O(set-an-event)).
         Returns the full emitted token list, ``first_token``
-        included."""
+        included.
+
+        ``skip`` (ISSUE 17, migration dedup): the sender omitted the
+        chain's first ``skip`` FULL blocks after this engine's dedup
+        index promised it already holds them (in-tree or in-spill);
+        ``blocks`` then carries only the shipped tail and the seat path
+        attaches the promised prefix by reference (promoting from the
+        spill tier when needed).  A promise the tree can no longer keep
+        refuses with :class:`DedupStale` and the sender re-sends the
+        full chain."""
         self._check_disagg_ready()
         ids = np.asarray(ids, np.int32).reshape(-1)
         self._validate_gen_args(ids, int(max_new_tokens),
@@ -720,6 +764,16 @@ class Engine:
                 f"{self.block_size}: disaggregated tiers must serve the "
                 "same artifact with the same bucket set")
         n = math.ceil(int(ids.size) / self.block_size)
+        skip = int(skip)
+        if not 0 <= skip < n:
+            raise ValueError(
+                f"dedup skip {skip} out of range for a {n}-block chain")
+        if skip > max(0, (int(ids.size) - 1) // self.block_size):
+            raise ValueError(
+                f"dedup skip {skip} covers the last prompt token's "
+                "block — that block is never tree-shareable and must "
+                "always ship")
+        shipped = n - skip
         missing = set(self._pool_leaf_meta) - set(blocks)
         extra = set(blocks) - set(self._pool_leaf_meta)
         if missing or extra:
@@ -729,7 +783,7 @@ class Engine:
                 f"{sorted(extra)[:4]})")
         for path, arr in blocks.items():
             tail, dtype = self._pool_leaf_meta[path]
-            want = (n, self.block_size) + tail
+            want = (shipped, self.block_size) + tail
             if tuple(arr.shape) != want:
                 raise ValueError(
                     f"imported leaf {path} has shape {tuple(arr.shape)}"
@@ -742,7 +796,9 @@ class Engine:
         # receive-side backpressure: refuse BEFORE queuing when the
         # chain cannot fit even after evicting every unpinned tree leaf
         # (best-effort read — pool state moves on the engine thread,
-        # and the seat-time allocation path re-checks for real)
+        # and the seat-time allocation path re-checks for real).  A
+        # deduped chain only needs fresh blocks for its shipped tail;
+        # the skipped prefix attaches by reference.
         with self._cond:
             try:
                 available = self._pool_alloc.free_blocks \
@@ -751,9 +807,9 @@ class Engine:
             # this lock; a torn walk must not refuse a seatable chain —
             # the seat-time allocation path is the real check
             except RuntimeError:  # noqa: BLE001
-                available = n
-        if available < n:
-            raise PoolExhausted(n, available)
+                available = shipped
+        if available < shipped:
+            raise PoolExhausted(shipped, available)
         req = _Request(ids=ids, max_new_tokens=int(max_new_tokens),
                        eos_id=eos_id, temperature=float(temperature),
                        top_k=top_k, speculative=int(speculative),
@@ -761,6 +817,7 @@ class Engine:
                            "first": int(first_token),
                            "key": np.asarray(key, np.uint32).reshape(2),
                            "n_blocks": n,
+                           "skip": skip,
                            "nested": _unflatten_tree(blocks),
                        },
                        seated_cb=seated)
@@ -772,6 +829,185 @@ class Engine:
                 speculative=int(speculative), kind="migrated",
                 trace_id=trace_id)
         return self._enqueue_and_wait(req, timeout)
+
+    def prefix_index(self, limit: int = 128) -> list[str]:
+        """Chain fingerprints this pod can serve by reference (resident
+        tree chains) or re-promote (spill entries), most-recent-ish
+        first, capped at ``limit`` — what the fleet prefix cache index
+        advertises (ISSUE 17).  Advisory by design: the dedup seat path
+        and the fetch-on-miss path both re-verify at use time, so a
+        stale entry costs one round trip, never correctness."""
+        # unguarded-ok: called from scrape/metrics threads; both reads
+        # are single C-level snapshots (list(dict)) of maps mutated only
+        # on the engine thread, and every consumer re-verifies
+        fps: list[str] = []
+        if self.paged and self._tree is not None:
+            fps.extend(reversed(list(self._tree_fps)))
+        if self._spill is not None:
+            fps.extend(reversed(self._spill.fingerprints()))
+        seen: set[str] = set()
+        out: list[str] = []
+        for fp in fps:
+            if fp not in seen:
+                seen.add(fp)
+                out.append(fp)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def dedup_have(self, fps: list) -> int:
+        """Longest leading run of offered chain fingerprints held
+        in-tree or in-spill — the receiver half of the kvxfer dedup
+        handshake (ISSUE 17).  Advisory: the seat path re-verifies and
+        refuses with :class:`DedupStale` if eviction broke the promise
+        in between."""
+        # unguarded-ok: membership probes against maps mutated only on
+        # the engine thread; a torn read only mis-sizes the advisory
+        # skip, which the seat path re-verifies
+        spill = self._spill
+        have = 0
+        for fp in fps:
+            if fp in self._tree_fps or (spill is not None and fp in spill):
+                have += 1
+            else:
+                break
+        return have
+
+    def fetch_prefix(self, ids, timeout: Optional[float] = None
+                     ) -> Optional[dict]:
+        """Holder side of fleet fetch-on-miss (ISSUE 17): gather the
+        longest cached FULL-block prefix of ``ids`` — resident tree
+        chain first, extended straight from spill payloads (host bytes,
+        no pool writes) — as a wire-ready manifest ``{"n_blocks",
+        "block_size", "blocks": {leaf path: [n, block_size, ...]}}``,
+        or None when nothing is cached.  Runs on the engine thread via
+        :meth:`submit_exclusive` (the pool is donated per step; an
+        off-thread gather would race invalidated buffers).  The last
+        prompt token's block is never served — it is never
+        tree-shareable on the importer either."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if not self.paged or self._gather_fn is None \
+                or self._tree is None:
+            return None
+        cap = (int(ids.size) - 1) // self.block_size
+        if cap < 1:
+            return None
+
+        def _do() -> Optional[dict]:
+            bs = self.block_size
+            full, _ = self._tree.match(ids, cap * bs)
+            n = len(full)
+            flat_dev: dict = {}
+            if n:
+                idxs = np.ascontiguousarray(
+                    [nd.block for nd in full], np.int32)
+                flat_dev = {
+                    p: np.asarray(a) for p, a in _flatten_tree(
+                        self._gather_fn(self._pool, idxs)).items()}
+            extra: list[dict] = []
+            if self._spill is not None and n < cap:
+                fps = kvtier.chain_fingerprints(ids, bs, max_blocks=cap)
+                for k in range(n, cap):
+                    e = self._spill.peek(fps[k])
+                    if e is None:
+                        break
+                    extra.append(kvtier.decode_payload(e.payload))
+            total = n + len(extra)
+            if total == 0:
+                return None
+            out: dict[str, np.ndarray] = {}
+            for path, (tail, dtype) in self._pool_leaf_meta.items():
+                parts = []
+                if n:
+                    parts.append(flat_dev[path])
+                for dec in extra:
+                    parts.append(np.asarray(dec[path])[None])
+                arr = np.concatenate(parts, 0) if len(parts) > 1 \
+                    else parts[0]
+                # spill payloads for fp pools decode to f32; int8 pools
+                # stay native — cast so the manifest matches the pool
+                out[path] = np.ascontiguousarray(
+                    arr.astype(dtype, copy=False))
+            return {"n_blocks": total, "block_size": bs, "blocks": out}
+
+        return self.submit_exclusive(_do, timeout=timeout)
+
+    def import_prefix(self, ids, blocks: dict, n_blocks: int,
+                      timeout: Optional[float] = None) -> int:
+        """Requester side of fleet fetch-on-miss (ISSUE 17): graft a
+        fetched chain prefix into fresh pool blocks and insert its runs
+        into the tree, so the generation submitted right after attaches
+        it like any local prefix hit.  Best-effort by contract — any
+        shortfall (local coverage grew, pool pressure, structural
+        mismatch) imports less or nothing and the request simply
+        re-prefills the tail.  Returns the blocks adopted."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        n_blocks = int(n_blocks)
+        if not self.paged or self._graft_fn is None \
+                or self._tree is None or n_blocks < 1:
+            return 0
+        for path, arr in blocks.items():
+            meta = self._pool_leaf_meta.get(path)
+            if meta is None:
+                raise ValueError(f"fetched leaf {path} not in pool")
+            tail, dtype = meta
+            want = (n_blocks, self.block_size) + tail
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"fetched leaf {path} has shape {tuple(arr.shape)}"
+                    f", expected {want}")
+        if set(blocks) != set(self._pool_leaf_meta):
+            raise ValueError("fetched chain does not match the pool "
+                             "manifest")
+
+        def _do() -> int:
+            bs = self.block_size
+            cap = min(n_blocks, (int(ids.size) - 1) // bs)
+            if cap < 1:
+                return 0
+            full, _ = self._tree.match(ids, cap * bs)
+            start = len(full)
+            if start >= cap:
+                return 0  # already covered locally
+            dsts: list[int] = []
+            try:
+                for _ in range(cap - start):
+                    dsts.append(self._alloc_block(None))
+            # except-ok: pool pressure during an opportunistic import
+            # falls back to re-prefilling, never fails anything
+            except RuntimeError:  # noqa: BLE001
+                for b in dsts:
+                    self._pool_alloc.release(b)
+                return 0
+            # the allocs may have evicted part of the matched path;
+            # re-match so the insert path is attached (the same hazard
+            # _prefill_into's re-match comment names)
+            full2, _ = self._tree.match(ids, cap * bs)
+            if len(full2) != start:
+                for b in dsts:
+                    self._pool_alloc.release(b)
+                return 0
+            sliced = {p: np.ascontiguousarray(a[start:cap])
+                      for p, a in blocks.items()}
+            self._pool = self._graft_fn(
+                self._pool, _unflatten_tree(sliced),
+                np.ascontiguousarray(dsts, np.int32))
+            created = self._tree.insert(
+                full2, [int(t) for t in ids[:cap * bs]],
+                [0] * start + dsts)
+            # a fresh alloc's refcount-1 becomes the tree's reference;
+            # release any block the insert did not adopt
+            adopted = {node.block for node in created}
+            for b in dsts:
+                if b not in adopted:
+                    self._pool_alloc.release(b)
+            self._index_add(created)
+            self._update_block_gauge()
+            with self._cond:
+                self._kv_prefix_fetched += len(created)
+            return len(created)
+
+        return self.submit_exclusive(_do, timeout=timeout)
 
     def _evictable_blocks(self) -> int:
         """Tree blocks eviction could EVENTUALLY free for an import
@@ -943,6 +1179,21 @@ class Engine:
                 "kv_imports": self._kv_imports,
                 "kv_blocks_out": self._kv_blocks_out,
                 "kv_blocks_in": self._kv_blocks_in,
+                # tiered KV memory hierarchy (ISSUE 17): host spill tier
+                # occupancy + demote/promote lifetimes, dedup attaches,
+                # and fleet fetch-on-miss imports
+                "spill_enabled": self._spill is not None,
+                "spill_blocks": len(self._spill) if self._spill else 0,
+                "spill_bytes": self._spill.bytes_used
+                if self._spill else 0,
+                "spill_demotions": self._spill.spilled_blocks
+                if self._spill else 0,
+                "spill_promotions": self._spill.promoted_blocks
+                if self._spill else 0,
+                "spill_evictions": self._spill.spill_evictions
+                if self._spill else 0,
+                "kv_blocks_deduped": self._kv_blocks_deduped,
+                "kv_prefix_fetched": self._kv_prefix_fetched,
                 # request recorder binding (ISSUE 12): whether this
                 # engine records per-request timelines
                 "request_log": self._reqlog is not None,
@@ -1097,32 +1348,173 @@ class Engine:
         Recycled blocks need no scrubbing: stale content sits above the
         new owner's written length and is masked by the synthesized
         validity.  ``slot`` names the request the allocation serves so
-        evictions land on ITS timeline (the ``evict`` phase)."""
+        evictions land on ITS timeline (the ``evict`` phase).  With the
+        spill tier on (ISSUE 17) each victim's content demotes to host
+        buffers BEFORE its pool reference drops — eviction becomes
+        demotion, and the block's bytes survive for re-promotion."""
         idx = self._pool_alloc.alloc()
         if idx is not None:
             return idx
         t0 = time.monotonic()
         evicted = 0
+        spilled = 0
         while idx is None:
             # only leaves whose block nothing else pins: evicting a
             # slot-referenced block frees nothing and throws away a hot
             # cache entry for no progress
-            victim = self._tree.evict_one(
+            victim = self._tree.evict_leaf(
                 pinned=lambda b: self._pool_alloc.refcount(b) > 1) \
                 if self._tree else None
             if victim is None:
                 raise RuntimeError(
                     "KV block pool exhausted (no evictable prefix "
                     "blocks) — pool sizing invariant violated")
-            released = self._pool_alloc.release(victim)
+            spilled += self._demote_leaf(victim)
+            released = self._pool_alloc.release(victim.block)
             assert released, "unpinned tree leaf must free its block"
             evicted += 1
             idx = self._pool_alloc.alloc()
         if self._reqlog is not None and slot is not None \
                 and slot.req is not None:
-            self._reqlog.evicted(slot.req.rid, evicted,
-                                 time.monotonic() - t0)
+            dur = time.monotonic() - t0
+            self._reqlog.evicted(slot.req.rid, evicted, dur)
+            if spilled:
+                # the demote cost rides inside the evict window; the
+                # spill event carries the same wall span so dominant-
+                # phase attribution can name the tier, not just the walk
+                self._reqlog.spilled(slot.req.rid, spilled, dur)
         return idx
+
+    def _demote_leaf(self, node) -> int:
+        """Demote one evicted tree leaf to the host spill tier: gather
+        its block's content through the chain seam (a COPY — the
+        payload can never alias a live device block), int8-quantize
+        float K/V leaves through the one ``paged.quantize_kv``, and
+        park it keyed by the leaf's cumulative chain fingerprint.
+        Returns 1 when a payload is resident afterwards.  Must run
+        BEFORE the tree's pool reference is released — the gather reads
+        the victim block."""
+        fp = self._node_fp_of(node)
+        self._tree_fps.pop(fp, None)
+        spill = self._spill
+        if spill is None:
+            return 0
+        if spill.touch(fp):
+            # chain content is immutable once inserted: the resident
+            # host copy is already exact, the evict is a pure
+            # tree-reference drop
+            return 1
+        from k8s_tpu.models import paged
+        flat = _flatten_tree(self._gather_fn(
+            self._pool, np.ascontiguousarray([node.block], np.int32)))
+        flat = {p: a[0] for p, a in flat.items()}
+        payload, nbytes = kvtier.encode_payload(flat, paged.quantize_kv)
+        ok = spill.put(fp, node.tokens, payload, nbytes)
+        self._update_spill_gauges()
+        return 1 if ok else 0
+
+    def _promote_spill(self, slot: Optional["_Slot"], ids,
+                       max_tokens: int) -> int:
+        """Re-promote consecutive spilled chain blocks extending the
+        tree's coverage of ``ids`` (capped at ``max_tokens``) back into
+        the pool: fresh blocks, ONE chain-graft scatter (the same
+        ``kv_graft`` program migration seats ride), tree re-insert —
+        the caller's subsequent tree walk sees an ordinary prefix hit.
+        Returns the blocks promoted; 0 whenever the tier is off, cold,
+        or pool pressure says re-prefilling is the better deal."""
+        spill = self._spill
+        if spill is None or len(spill) == 0 or self._tree is None:
+            return 0
+        bs = self.block_size
+        cap = max(0, int(max_tokens)) // bs
+        if cap < 1:
+            return 0
+        fps = kvtier.chain_fingerprints(ids, bs, max_blocks=cap)
+        full, _ = self._tree.match(ids, cap * bs)
+        entries: list[tuple[str, kvtier.SpillEntry]] = []
+        for k in range(len(full), len(fps)):
+            e = spill.peek(fps[k])
+            if e is None:
+                break
+            entries.append((fps[k], e))
+        if not entries:
+            return 0
+        t0 = time.monotonic()
+        dsts: list[int] = []
+        try:
+            for _ in entries:
+                # may demote OTHER leaves to make room — the entry
+                # references held above stay valid even if the spill
+                # LRU rotates them out underneath
+                dsts.append(self._alloc_block(slot))
+        # except-ok: allocation pressure during a promote (nothing left
+        # to evict) falls back to re-prefilling the tail, never fails
+        # the request
+        except RuntimeError:  # noqa: BLE001
+            for b in dsts:
+                self._pool_alloc.release(b)
+            return 0
+        # the allocs may have evicted part of the matched path; re-match
+        # so the insert path is attached (the _prefill_into hazard)
+        full2, _ = self._tree.match(ids, cap * bs)
+        if len(full2) != len(full):
+            for b in dsts:
+                self._pool_alloc.release(b)
+            return 0
+        flat: dict[str, list] = {}
+        for fp, e in entries:
+            dec = kvtier.decode_payload(e.payload)
+            spill.get(fp)  # LRU refresh + promote accounting
+            for p, a in dec.items():
+                flat.setdefault(p, []).append(a)
+        stacked = {p: np.ascontiguousarray(np.stack(parts))
+                   for p, parts in flat.items()}
+        self._pool = self._graft_fn(
+            self._pool, _unflatten_tree(stacked),
+            np.ascontiguousarray(dsts, np.int32))
+        n_tok = (len(full2) + len(entries)) * bs
+        created = self._tree.insert(
+            full2, [int(t) for t in ids[:n_tok]],
+            [0] * len(full2) + dsts)
+        # a fresh alloc's refcount-1 becomes the tree's reference;
+        # release any block the insert did not adopt
+        adopted = {node.block for node in created}
+        for b in dsts:
+            if b not in adopted:
+                self._pool_alloc.release(b)
+        self._index_add(created)
+        self._update_block_gauge()
+        self._update_spill_gauges()
+        promos = self.metrics.get("kv_promotions")
+        if promos is not None:
+            promos.inc(len(created))
+        if self._reqlog is not None and slot is not None \
+                and slot.req is not None:
+            self._reqlog.promoted(slot.req.rid, len(created),
+                                  time.monotonic() - t0)
+        return len(created)
+
+    def _node_fp_of(self, node) -> str:
+        """A tree node's cumulative chain fingerprint (its whole
+        root-to-node token chain, hashed with the router's scheme)."""
+        return kvtier.chain_fingerprints(
+            chain_tokens(node), self.block_size)[-1]
+
+    def _index_add(self, created) -> None:
+        """Register freshly-inserted tree nodes in the chain-fingerprint
+        index (engine thread only)."""
+        for node in created:
+            self._tree_fps[self._node_fp_of(node)] = True
+
+    def _update_spill_gauges(self) -> None:
+        if self._spill is None:
+            return
+        g = self.metrics.get("kv_spilled_blocks")
+        if g is not None:
+            g.set(len(self._spill))
+        g = self.metrics.get("kv_spill_bytes")
+        if g is not None:
+            g.set(self._spill.bytes_used)
 
     def _release_table(self, slot: _Slot) -> None:
         for b in slot.table[:slot.nblocks]:
@@ -1282,6 +1674,10 @@ class Engine:
         and whether the divergence block was copy-on-written."""
         if self._tree is None:
             return 0, 0, False
+        # spilled chains re-promote BEFORE the walk (ISSUE 17): a
+        # demoted prefix grafts back into fresh blocks and the match
+        # below sees an ordinary tree hit
+        self._promote_spill(slot, ids, len(ids) - 1)
         full, partial = self._tree.match(ids, len(ids) - 1)
         shared = 0
         for node in full:
@@ -1377,6 +1773,7 @@ class Engine:
                         [int(b) for b in slot.table[:slot.nblocks]])
                     for node in created:
                         self._pool_alloc.retain(node.block)
+                    self._index_add(created)
             else:
                 chunks = split_prefill(len(ids), self.buckets)
                 with trace.span_under(req.trace_ctx, "prefill",
@@ -1542,10 +1939,33 @@ class Engine:
             rlog.admitted(req.rid, slot.idx, qw)
         ids = req.ids
         n = int(m["n_blocks"])
+        # sync-ok: the manifest is a plain host dict off the wire frame
+        skip = int(m.get("skip") or 0)
         nested = m["nested"]
         try:
-            dsts = np.empty(n, np.int32)
-            for i in range(n):
+            if skip:
+                # deduped migration (ISSUE 17): the sender omitted the
+                # first ``skip`` full blocks after our OP_NEED promised
+                # we hold them; attach by reference now, promoting from
+                # the spill tier when that is where they live.  A
+                # promise eviction broke refuses with the typed
+                # ``dedup_stale`` — the sender re-sends the full chain.
+                if self._tree is None:
+                    raise DedupStale(
+                        "deduped migration on an engine without a "
+                        "prefix tree")
+                self._promote_spill(slot, ids, skip * self.block_size)
+                full, _ = self._tree.match(ids, skip * self.block_size)
+                if len(full) < skip:
+                    raise DedupStale(
+                        f"receiver holds {len(full)}/{skip} promised "
+                        "prefix blocks (evicted since the offer)")
+                for node in full:
+                    self._pool_alloc.retain(node.block)
+                    slot.table[slot.nblocks] = node.block
+                    slot.nblocks += 1
+            dsts = np.empty(n - skip, np.int32)
+            for i in range(n - skip):
                 dsts[i] = self._alloc_block(slot)
                 slot.table[slot.nblocks] = dsts[i]
                 slot.nblocks += 1
@@ -1561,6 +1981,7 @@ class Engine:
                     ids, [int(b) for b in slot.table[:slot.nblocks]])
                 for node in created:
                     self._pool_alloc.retain(node.block)
+                self._index_add(created)
         except BaseException as e:  # noqa: BLE001 - bad import must not kill the loop
             req.finish(error=e)
             if rlog is not None:
@@ -1572,12 +1993,13 @@ class Engine:
         graft_s = time.monotonic() - t_adm
         mig_c = self.metrics.get("kv_migrated")
         if mig_c is not None:
-            mig_c.inc(n)
+            mig_c.inc(n - skip)
         with self._cond:
             self._kv_imports += 1
-            self._kv_blocks_in += n
+            self._kv_blocks_in += n - skip
+            self._kv_blocks_deduped += skip
         if rlog is not None:
-            rlog.migrated(req.rid, n, graft_s)
+            rlog.migrated(req.rid, n - skip, graft_s)
         if req.seated_cb is not None:
             try:
                 req.seated_cb()

@@ -98,6 +98,23 @@ class PrefixNode:
         self.last_hit = 0
 
 
+def chain_tokens(node: PrefixNode) -> list[int]:
+    """The full token chain a node's block terminates — its run plus
+    every ancestor's, root-first.  The spill tier (ISSUE 17) keys
+    demoted blocks by the chain's cumulative fingerprint, and the chain
+    is only reachable through ``parent`` links, so demotion reads it
+    BEFORE the node detaches."""
+    runs: list[tuple] = []
+    cur: Optional[PrefixNode] = node
+    while cur is not None and cur.parent is not None:
+        runs.append(cur.tokens)
+        cur = cur.parent
+    out: list[int] = []
+    for run in reversed(runs):
+        out.extend(run)
+    return out
+
+
 class PrefixTree:
     """Radix tree over block-sized token-id runs.  The root is a
     sentinel (no tokens, no block); every real node pins one pool block
@@ -197,13 +214,15 @@ class PrefixTree:
         matched, _partial = self.match(ids, max(0, len(ids) - 1))
         return self.insert(matched, ids, blocks)
 
-    def evict_one(self, pinned=None) -> Optional[int]:
-        """Remove the least-recently-hit LEAF node; returns its block id
-        (the caller drops the tree's pool reference) or None when no
-        evictable leaf exists.  ``pinned(block) -> bool`` marks blocks
-        other holders (live slots) still reference: evicting those frees
-        nothing AND loses a hot cache entry, so they are skipped — their
-        pins drop when the holding request retires.  The walk is
+    def evict_leaf(self, pinned=None) -> Optional["PrefixNode"]:
+        """Remove the least-recently-hit LEAF node and return it (the
+        caller drops the tree's pool reference — and, with a spill tier
+        (ISSUE 17), demotes the node's content first, reconstructing
+        its chain via :func:`chain_tokens` while ``node.parent`` is
+        still wired).  ``pinned(block) -> bool`` marks blocks other
+        holders (live slots) still reference: evicting those frees
+        nothing AND loses a hot cache entry, so they are skipped —
+        their pins drop when the holding request retires.  The walk is
         O(nodes) per call; nodes are bounded by the pool size (tens to
         hundreds), so no separate LRU structure is kept."""
         best: Optional[PrefixNode] = None
@@ -223,7 +242,13 @@ class PrefixTree:
         del best.parent.children[best.tokens]
         self.nodes -= 1
         self.evictions += 1
-        return best.block
+        return best
+
+    def evict_one(self, pinned=None) -> Optional[int]:
+        """Block-id convenience over :meth:`evict_leaf` (the pre-spill
+        call shape: evict means the block's content dies)."""
+        node = self.evict_leaf(pinned)
+        return None if node is None else node.block
 
     def clear(self) -> list[int]:
         """Drop every node; returns their block ids for deref."""

@@ -1,0 +1,239 @@
+"""Tiered KV memory hierarchy (ISSUE 17): the host-RAM spill tier
+beneath the engine's device block pool, plus the block-chain
+fingerprint scheme shared by the spill tier, the kvxfer dedup
+handshake, and the fleet prefix cache index.
+
+The pool (models/kvblocks.py) is device-memory-only: when the free
+list dries the radix tree LRU-evicts leaves, and before this module
+an evicted prefix meant a full re-prefill on the next hit.  The spill
+tier turns evict-means-recompute into demote-means-requantize:
+
+- **Demote** — the engine gathers the victim leaf's block content
+  through the same ``gather_blocks`` chain seam migration exports
+  ride, quantizes float K/V leaves to int8 through the ONE
+  ``paged.quantize_kv`` definition (native-int8 pools and their scale
+  leaves store bitwise as-is), and parks the payload here keyed by the
+  leaf's cumulative chain fingerprint.
+- **Promote** — on a prefix-tree miss whose chain fingerprint IS
+  resident, the engine allocates fresh pool blocks and grafts the
+  dequantized payload back through the same ``graft_blocks`` scatter
+  the kv-transfer plane uses, then re-inserts the tree nodes; the
+  attaching request sees an ordinary tree hit.
+
+Identity contract (mirrors the migration wire): int8 pools round-trip
+bit-exactly (int8 payloads are stored and grafted untouched); float
+pools round-trip through int8 quantization and are documented-lossy
+EXACTLY like a kvxfer migrate with ``wire_int8`` — same quantizer,
+same dequant expression — so a demote→promote never introduces a
+loss mode the wire doesn't already have.
+
+The tier is bounded (``K8S_TPU_SERVE_SPILL_MB``, default 0 = off) with
+its own LRU over host bytes; it holds HOST COPIES only — never a pool
+block reference — so ``debug_check_blocks`` refcount accounting is
+unchanged and a demoted payload can never alias a live device block.
+
+Chain fingerprints: the cumulative fingerprint at block ``k`` equals
+``router.ring.fingerprint_tokens(tokens, block_size, k)`` — one hash
+scheme across the router's affinity keys, the spill tier's entry keys,
+the kvxfer dedup offer frames, and the fleet index advertisements, so
+every layer of the hierarchy agrees about which bytes a fingerprint
+names.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+DEFAULT_SPILL_MB = 0
+
+
+def env_spill_mb() -> int:
+    """Host-RAM spill budget in MiB (``K8S_TPU_SERVE_SPILL_MB``,
+    default 0 = spill tier off — seed behaviour: evicted leaves die)."""
+    raw = os.environ.get("K8S_TPU_SERVE_SPILL_MB", "").strip()
+    if not raw:
+        return DEFAULT_SPILL_MB
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"K8S_TPU_SERVE_SPILL_MB must be an integer, got {raw!r}")
+    if val < 0:
+        raise ValueError(
+            f"K8S_TPU_SERVE_SPILL_MB must be >= 0, got {val}")
+    return val
+
+
+def chain_fingerprints(tokens, block_size: int,
+                       max_blocks: Optional[int] = None) -> list[str]:
+    """Cumulative chain fingerprint at every full-block boundary of
+    ``tokens``: entry ``k`` covers blocks ``0..k`` and equals
+    ``ring.fingerprint_tokens(tokens, block_size, k + 1)`` — the
+    router's affinity fingerprint IS the chain fingerprint at its
+    affinity depth.  Computed incrementally (one hasher, snapshotted
+    per boundary), so fingerprinting a whole prompt chain costs one
+    pass over its tokens."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    n_full = len(tokens) // block_size
+    if max_blocks is not None:
+        n_full = min(n_full, max(0, max_blocks))
+    h = hashlib.sha1()
+    h.update(f"{block_size}:".encode())
+    out: list[str] = []
+    for k in range(n_full):
+        for t in tokens[k * block_size:(k + 1) * block_size]:
+            h.update(f"{int(t)},".encode())
+        out.append(h.hexdigest())
+    return out
+
+
+def _is_kv_leaf(path: str, dtype) -> bool:
+    """Float K/V leaves quantize on demote; everything else (native
+    int8 K/V, their scale leaves) stores as-is.  Same test the serving
+    wire applies in ``server._wire_blocks``."""
+    return path.rsplit("/", 1)[-1] in ("k", "v") and (
+        np.issubdtype(np.dtype(dtype), np.floating))
+
+
+def encode_payload(flat: dict, quantize_kv) -> tuple[dict, int]:
+    """Pack one gathered block's flat leaves ``{path: array[bs, ...]}``
+    into a host spill payload: float K/V leaves become ``(q int8,
+    scale f32)`` via the one ``quantize_kv`` (passed in so this module
+    stays importable without jax at collection time); other leaves are
+    stored native.  Returns ``(payload, nbytes)``."""
+    payload: dict[str, tuple] = {}
+    nbytes = 0
+    for path, arr in flat.items():
+        if _is_kv_leaf(path, arr.dtype):
+            q, scale = quantize_kv(arr)
+            q = np.asarray(q, np.int8)
+            scale = np.asarray(scale, np.float32)
+            payload[path] = ("q8", q, scale)
+            nbytes += q.nbytes + scale.nbytes
+        else:
+            host = np.asarray(arr)
+            payload[path] = ("raw", host)
+            nbytes += host.nbytes
+    return payload, nbytes
+
+
+def decode_payload(payload: dict) -> dict:
+    """Inverse of :func:`encode_payload`: flat ``{path: array}`` ready
+    for the graft scatter.  Dequant is the wire's exact expression
+    (``q.astype(f32) * scale[..., None]``); the graft itself casts to
+    each pool leaf's dtype, so int8 pools receive their stored int8
+    bytes untouched."""
+    out: dict[str, np.ndarray] = {}
+    for path, packed in payload.items():
+        if packed[0] == "q8":
+            _, q, scale = packed
+            out[path] = q.astype(np.float32) * scale[..., None]
+        else:
+            out[path] = packed[1]
+    return out
+
+
+class SpillEntry:
+    __slots__ = ("fingerprint", "tokens", "payload", "nbytes")
+
+    def __init__(self, fingerprint: str, tokens: tuple, payload: dict,
+                 nbytes: int):
+        self.fingerprint = fingerprint  # cumulative chain fp at this block
+        self.tokens = tokens            # this block's token run (len == bs)
+        self.payload = payload          # {path: ("q8", q, scale) | ("raw", a)}
+        self.nbytes = nbytes
+
+
+class SpillTier:
+    """Byte-budgeted host LRU over demoted blocks, keyed by cumulative
+    chain fingerprint.  Single-threaded by design: every mutation
+    happens on the engine thread (the same no-locks contract
+    kvblocks.py states); cross-thread readers (the fleet index proxy
+    metric, the kvxfer dedup index_fn) only take GIL-atomic snapshots
+    through :meth:`fingerprints`."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes < 0:
+            raise ValueError(
+                f"spill budget must be >= 0, got {budget_bytes}")
+        self.budget_bytes = budget_bytes
+        self._entries: "OrderedDict[str, SpillEntry]" = OrderedDict()
+        self._bytes = 0
+        # lifetime counters (engine stats() + serving metrics read them)
+        self.spilled_blocks = 0
+        self.promoted_blocks = 0
+        self.spill_evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, fingerprint: str) -> bool:
+        return fingerprint in self._entries
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def put(self, fingerprint: str, tokens: tuple, payload: dict,
+            nbytes: int) -> bool:
+        """Admit one demoted block; evicts the LRU tail until the
+        budget holds.  A payload larger than the whole budget is
+        refused (False) — the tier never admits-then-immediately-drops.
+        Re-admitting a resident fingerprint just refreshes its LRU
+        position (block content for a chain fingerprint is immutable,
+        so the stored bytes are already right)."""
+        if fingerprint in self._entries:
+            self._entries.move_to_end(fingerprint)
+            return True
+        if nbytes > self.budget_bytes:
+            return False
+        while self._bytes + nbytes > self.budget_bytes and self._entries:
+            _, victim = self._entries.popitem(last=False)
+            self._bytes -= victim.nbytes
+            self.spill_evictions += 1
+        self._entries[fingerprint] = SpillEntry(
+            fingerprint, tuple(tokens), payload, nbytes)
+        self._bytes += nbytes
+        self.spilled_blocks += 1
+        return True
+
+    def touch(self, fingerprint: str) -> bool:
+        """True + LRU refresh when the fingerprint is already resident
+        (a re-demote of an immutable chain block needs no re-gather and
+        no re-quantize — the stored bytes are already right)."""
+        if fingerprint in self._entries:
+            self._entries.move_to_end(fingerprint)
+            return True
+        return False
+
+    def get(self, fingerprint: str) -> Optional[SpillEntry]:
+        """LRU-refreshing lookup; the entry STAYS resident — a promote
+        copies bytes back to the pool, and keeping the host copy means
+        the next demote of the same chain is a pure tree-reference drop
+        (no re-gather, no re-quantize)."""
+        entry = self._entries.get(fingerprint)
+        if entry is not None:
+            self._entries.move_to_end(fingerprint)
+            self.promoted_blocks += 1
+        return entry
+
+    def peek(self, fingerprint: str) -> Optional[SpillEntry]:
+        """Lookup without LRU refresh or promote accounting (dedup
+        index probes, tests)."""
+        return self._entries.get(fingerprint)
+
+    def fingerprints(self) -> list[str]:
+        """Resident chain fingerprints, LRU → MRU.  GIL-atomic snapshot
+        safe to call off the engine thread (fleet index, dedup
+        index_fn)."""
+        return list(self._entries)
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0

@@ -38,6 +38,25 @@ bandwidth, bound at serving block sizes):
   to HTTP semantics (``pool_exhausted`` / ``queue_full`` → 503-shed,
   anything else → 500).
 
+Tiered-KV extensions (ISSUE 17), both wire-compatible with peers that
+predate them (an old receiver answers the unknown op with the closed
+protocol's ``kind=protocol`` error and closes; the sender memoizes the
+peer as legacy and falls back to the classic conversation):
+
+- **dedup handshake** — ``offer`` (sender → receiver: the chain's
+  cumulative block fingerprints, models/kvtier.chain_fingerprints) /
+  ``need`` (receiver → sender: how many leading blocks it already
+  holds in-tree or in-spill) prepended to a migrate; the migrate frame
+  then carries ``statics["skip"]`` and only the ``blk/``/``blkscale/``
+  rows past the receiver's coverage.  The promise is advisory: a
+  receiver that evicted it refuses with ``kind=dedup_stale`` and the
+  sender re-sends the full chain once on the same stream;
+- **prefix fetch** — ``fetch`` (requester → holder: prompt ids) /
+  ``blocks`` (holder → requester: the longest cached full-block chain
+  prefix as a migrate-shaped array payload; ``n_blocks`` 0 = miss) —
+  the fleet prefix-cache index's cross-pod fetch-on-miss path, cheaper
+  than re-prefilling a long shared template.
+
 Failure semantics: a truncated frame or dead peer raises
 :class:`KvPeerGone` on the reader; the receiver tears down THAT
 connection (and discards the in-flight request's tokens if it was
@@ -72,6 +91,18 @@ OP_MIGRATE = "migrate"
 OP_SEATED = "seated"
 OP_TOKENS = "tokens"
 OP_ERROR = "error"
+# Tiered-KV extension ops (ISSUE 17).  ``offer``/``need`` prepend a
+# fingerprint handshake to the migrate conversation (the sender then
+# ships only blocks the receiver lacks); ``fetch``/``blocks`` are the
+# fleet prefix-cache fetch-on-miss exchange.  A peer predating them
+# answers any with the closed protocol's ``unexpected op`` error frame
+# (kind ``protocol``) and closes the connection — the sender treats
+# that as "legacy peer", caches the verdict, and falls back to the
+# classic full migrate, so mixed-version fleets interoperate.
+OP_OFFER = "offer"
+OP_NEED = "need"
+OP_FETCH = "fetch"
+OP_BLOCKS = "blocks"
 
 PROTOCOL_VERSION = 1
 
@@ -87,6 +118,7 @@ DEFAULT_PORT = 8472
 ENV_ROLE = "K8S_TPU_SERVE_ROLE"
 ENV_PORT = "K8S_TPU_KVXFER_PORT"
 ENV_INT8 = "K8S_TPU_KVXFER_INT8"
+ENV_DEDUP = "K8S_TPU_KVXFER_DEDUP"
 
 ROLE_PREFILL = "prefill"
 ROLE_DECODE = "decode"
@@ -126,6 +158,16 @@ def env_kvxfer_int8() -> bool:
     deployment opts in."""
     return os.environ.get(ENV_INT8, "").strip().lower() in (
         "1", "true", "on", "yes")
+
+
+def env_kvxfer_dedup() -> bool:
+    """K8S_TPU_KVXFER_DEDUP: the block-fingerprint dedup handshake on
+    migrations (ISSUE 17).  Default ON — the handshake is one tiny
+    frame round trip, falls back transparently on legacy peers, and
+    the receiver re-verifies every skip — set 0/false/off to ship
+    every block unconditionally."""
+    return os.environ.get(ENV_DEDUP, "").strip().lower() not in (
+        "0", "false", "off", "no")
 
 
 class KvTransferError(RuntimeError):
@@ -243,8 +285,19 @@ class KvReceiver:
     """
 
     def __init__(self, seat_fn: Callable, host: str = "127.0.0.1",
-                 port: int = 0, reply_timeout_s: float = 600.0):
+                 port: int = 0, reply_timeout_s: float = 600.0,
+                 index_fn: Optional[Callable] = None,
+                 fetch_fn: Optional[Callable] = None):
         self._seat_fn = seat_fn
+        # ISSUE 17 seams, both optional (None = the pre-hierarchy
+        # protocol: offers and fetches answer the closed protocol's
+        # ``unexpected op`` error, which senders read as "legacy"):
+        # ``index_fn(fps) -> int`` answers a dedup offer with the
+        # longest leading run of chain fingerprints this pod holds;
+        # ``fetch_fn(statics, arrays) -> (statics, arrays) | None``
+        # serves a prefix-cache fetch (None = nothing cached).
+        self._index_fn = index_fn
+        self._fetch_fn = fetch_fn
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
         self._lock = checkedlock.make_lock("kvxfer.receiver")
@@ -256,6 +309,10 @@ class KvReceiver:
         self._blocks_in = 0
         self._errors = 0
         self._peer_gone = 0
+        self._dedup_offers = 0
+        self._dedup_blocks_promised = 0
+        self._fetches = 0
+        self._fetch_blocks_out = 0
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="kvxfer-accept")
         self._accept_thread.start()
@@ -265,7 +322,11 @@ class KvReceiver:
             return {"port": self.port, "migrations": self._migrations,
                     "blocks_in": self._blocks_in, "errors": self._errors,
                     "peer_gone": self._peer_gone,
-                    "connections": len(self._conns)}
+                    "connections": len(self._conns),
+                    "dedup_offers": self._dedup_offers,
+                    "dedup_blocks_promised": self._dedup_blocks_promised,
+                    "fetches": self._fetches,
+                    "fetch_blocks_out": self._fetch_blocks_out}
 
     def _accept_loop(self) -> None:
         while True:
@@ -293,7 +354,35 @@ class KvReceiver:
                     with self._lock:
                         self._peer_gone += 1
                     return
+                if op == OP_OFFER and self._index_fn is not None:
+                    # dedup handshake (ISSUE 17): answer how many of
+                    # the offered chain fingerprints we hold, then stay
+                    # on the conversation — the (possibly sliced)
+                    # migrate frame follows on this connection
+                    fps = [str(f) for f in statics.get("fps") or []]
+                    try:
+                        have = int(self._index_fn(fps))
+                    # except-ok: the index is advisory; a failed probe
+                    # just means "ship everything", never a dead conn
+                    except Exception:  # noqa: BLE001
+                        log.exception("kvxfer dedup index probe failed")
+                        have = 0
+                    have = max(0, min(have, len(fps)))
+                    with self._lock:
+                        self._dedup_offers += 1
+                        self._dedup_blocks_promised += have
+                    if not self._reply(conn, encode_frame(
+                            OP_NEED, {"have": have})):
+                        return
+                    continue
+                if op == OP_FETCH and self._fetch_fn is not None:
+                    self._handle_fetch(conn, statics, arrays)
+                    continue
                 if op != OP_MIGRATE:
+                    # unknown op (or an ISSUE 17 op this pod has no
+                    # seam for): the closed protocol's error frame —
+                    # senders read kind=protocol as "legacy peer" and
+                    # fall back to the classic full migrate
                     self._reply(conn, encode_frame(
                         OP_ERROR, {"error": f"unexpected op {op!r}",
                                    "kind": "protocol"}))
@@ -318,6 +407,34 @@ class KvReceiver:
             with self._lock:
                 self._peer_gone += 1
             return False
+
+    def _handle_fetch(self, conn: socket.socket, statics: dict,
+                      arrays: dict) -> None:
+        """One prefix-cache fetch (ISSUE 17): serve the longest cached
+        chain prefix of the requested ids.  Runs inline on the
+        connection thread — ``fetch_fn`` bounds its own engine-thread
+        hop — and answers ``blocks`` (``n_blocks`` 0 = cache miss; the
+        requester re-prefills, a miss is never an error)."""
+        try:
+            reply = self._fetch_fn(statics, arrays)
+        except BaseException as e:  # noqa: BLE001 - typed onto the wire
+            with self._lock:
+                self._errors += 1
+            kind = getattr(e, "kind", None) or "error"
+            self._reply(conn, encode_frame(
+                OP_ERROR, {"error": f"{type(e).__name__}: {e}",
+                           "kind": kind}))
+            return
+        if reply is None:
+            self._reply(conn, encode_frame(OP_BLOCKS, {"n_blocks": 0}))
+            return
+        out_statics, out_arrays = reply
+        n = int(out_statics.get("n_blocks") or 0)
+        with self._lock:
+            self._fetches += 1
+            self._fetch_blocks_out += n
+        self._reply(conn, encode_frame(OP_BLOCKS, out_statics,
+                                       out_arrays))
 
     def _handle_migrate(self, conn: socket.socket, statics: dict,
                         arrays: dict) -> None:
@@ -425,13 +542,24 @@ class KvSender:
         self._reply_timeout_s = reply_timeout_s
         self._migrations = 0
         self._blocks_out = 0
+        # dedup accounting + the legacy-peer memo (ISSUE 17): a dest
+        # that answered an offer with the closed protocol's error never
+        # gets offered again — one wasted round trip per peer lifetime
+        self._dedup_blocks_skipped = 0
+        self._dedup_bytes_saved = 0
+        self._dedup_stale = 0
+        self._legacy_peers: set[str] = set()
 
     def stats(self) -> dict:
         with self._lock:
             return {"migrations": self._migrations,
                     "blocks_out": self._blocks_out,
                     "pooled_connections": sum(
-                        len(v) for v in self._pool.values())}
+                        len(v) for v in self._pool.values()),
+                    "dedup_blocks_skipped": self._dedup_blocks_skipped,
+                    "dedup_bytes_saved": self._dedup_bytes_saved,
+                    "dedup_stale": self._dedup_stale,
+                    "legacy_peers": len(self._legacy_peers)}
 
     def _checkout(self, dest: str) -> tuple[socket.socket, bool]:
         with self._lock:
@@ -452,15 +580,32 @@ class KvSender:
                 return
         sock.close()
 
-    def migrate(self, dest: str, statics: dict, arrays: dict
-                ) -> tuple[list[int], float]:
+    def migrate(self, dest: str, statics: dict, arrays: dict,
+                fingerprints: Optional[list] = None,
+                info: Optional[dict] = None) -> tuple[list[int], float]:
         """Run one migration conversation; returns ``(tokens,
         seated_s)`` where ``seated_s`` is send-to-seated-ack — the
         migration cost proper, decode excluded.  Raises
         :class:`KvTransferError` (typed) on refusal or a dead peer.
         A stale pooled connection gets ONE fresh retry (a receiver
-        closing an idle keep-alive is not a peer failure)."""
-        frame = encode_frame(OP_MIGRATE, statics, arrays)
+        closing an idle keep-alive is not a peer failure).
+
+        ``fingerprints`` (ISSUE 17, dedup): cumulative chain
+        fingerprints of the chain's leading dedup-eligible FULL blocks
+        (never the last prompt token's).  When given and the peer
+        speaks the handshake, an ``offer``/``need`` prologue runs
+        first and the migrate frame ships only ``blk/``/``blkscale/``
+        rows past the receiver's promised coverage; a peer answering
+        with the closed protocol's error is memoized as legacy and
+        gets the classic full migrate, and a ``dedup_stale`` refusal
+        (the receiver evicted the promise) re-sends the full chain
+        once on the same stream.  ``info`` (optional out-param dict)
+        receives this call's ``skipped_blocks``/``skipped_bytes`` —
+        per-call and race-free, unlike the aggregate stats()."""
+        full_frame = encode_frame(OP_MIGRATE, statics, arrays)
+        if info is not None:
+            info["skipped_blocks"] = 0
+            info["skipped_bytes"] = 0
         last: Optional[KvTransferError] = None
         for only_fresh in (False, True):
             try:
@@ -480,10 +625,69 @@ class KvSender:
                     f"kvxfer connect to {dest}: {e}") from None
             try:
                 sock.settimeout(self._reply_timeout_s)
+                with self._lock:
+                    offer = bool(fingerprints) \
+                        and dest not in self._legacy_peers
+                skip = 0
                 t0 = time.monotonic()
+                if offer:
+                    sock.sendall(encode_frame(OP_OFFER, {
+                        "v": PROTOCOL_VERSION,
+                        "fps": [str(f) for f in fingerprints]}))
+                    op, st, _arr = read_frame(sock)
+                    if op == OP_ERROR \
+                            and str(st.get("kind")) == "protocol":
+                        # legacy peer predating the handshake: it
+                        # closed the connection behind the error frame
+                        # — memoize, reconnect, run the classic
+                        # conversation
+                        with self._lock:
+                            self._legacy_peers.add(dest)
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        host, port = parse_dest(dest)
+                        sock = socket.create_connection(
+                            (host, port),
+                            timeout=self._connect_timeout_s)
+                        sock.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        sock.settimeout(self._reply_timeout_s)
+                        reused = False
+                        t0 = time.monotonic()
+                    elif op == OP_ERROR:
+                        raise KvTransferError(
+                            str(st.get("error")),
+                            kind=str(st.get("kind") or "error"))
+                    elif op == OP_NEED:
+                        skip = max(0, min(int(st.get("have") or 0),
+                                          len(fingerprints)))
+                    else:
+                        raise KvPeerGone(
+                            f"unexpected offer reply {op!r}")
+                frame = full_frame if not skip else encode_frame(
+                    OP_MIGRATE, {**statics, "skip": skip},
+                    {name: (a[skip:]
+                            if name.startswith(("blk/", "blkscale/"))
+                            else a)
+                     for name, a in arrays.items()})
                 sock.sendall(frame)
                 op, st, _arr = read_frame(sock)
                 seated_s = time.monotonic() - t0
+                if op == OP_ERROR and skip \
+                        and str(st.get("kind")) == "dedup_stale":
+                    # the receiver lost the promised prefix between
+                    # the offer and the seat (eviction race): one full
+                    # re-send on the same live stream — we still hold
+                    # every array, the index is advisory by contract
+                    with self._lock:
+                        self._dedup_stale += 1
+                    skip = 0
+                    t0 = time.monotonic()
+                    sock.sendall(full_frame)
+                    op, st, _arr = read_frame(sock)
+                    seated_s = time.monotonic() - t0
                 if op == OP_ERROR:
                     raise KvTransferError(
                         str(st.get("error")),
@@ -500,9 +704,19 @@ class KvSender:
                 n_blocks = next(
                     (int(a.shape[0]) for name, a in arrays.items()
                      if name.startswith("blk/")), 0)
+                saved = sum(
+                    (a.nbytes // max(1, int(a.shape[0]))) * skip
+                    for name, a in arrays.items()
+                    if name.startswith(("blk/", "blkscale/"))) \
+                    if skip else 0
                 with self._lock:
                     self._migrations += 1
-                    self._blocks_out += n_blocks
+                    self._blocks_out += n_blocks - skip
+                    self._dedup_blocks_skipped += skip
+                    self._dedup_bytes_saved += saved
+                if info is not None and skip:
+                    info["skipped_blocks"] = skip
+                    info["skipped_bytes"] = saved
                 self._checkin(dest, sock)
                 return tokens, seated_s
             except socket.timeout:
@@ -533,6 +747,76 @@ class KvSender:
                 # typed refusal on a live stream: the conversation is
                 # complete and the socket is reusable
                 self._checkin(dest, sock)
+                raise
+        raise last  # pragma: no cover - loop always returns or raises
+
+    def fetch(self, dest: str, statics: dict, arrays: dict
+              ) -> tuple[dict, dict]:
+        """One prefix-cache fetch conversation (ISSUE 17): ask ``dest``
+        for its cached chain prefix of the prompt in ``arrays``;
+        returns the ``blocks`` reply's ``(statics, arrays)`` —
+        ``n_blocks`` 0 is a cache miss, not an error.  Transport
+        semantics match :meth:`migrate`: typed errors (a legacy peer
+        answers kind ``protocol``), one fresh retry for a stale pooled
+        connection."""
+        frame = encode_frame(OP_FETCH, statics, arrays)
+        last: Optional[KvTransferError] = None
+        for only_fresh in (False, True):
+            try:
+                if only_fresh:
+                    host, port = parse_dest(dest)
+                    sock = socket.create_connection(
+                        (host, port), timeout=self._connect_timeout_s)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    reused = False
+                else:
+                    sock, reused = self._checkout(dest)
+            except OSError as e:
+                raise KvPeerGone(
+                    f"kvxfer connect to {dest}: {e}") from None
+            try:
+                sock.settimeout(self._reply_timeout_s)
+                sock.sendall(frame)
+                op, st, arr = read_frame(sock)
+                if op == OP_ERROR:
+                    raise KvTransferError(
+                        str(st.get("error")),
+                        kind=str(st.get("kind") or "error"))
+                if op != OP_BLOCKS:
+                    raise KvPeerGone(f"unexpected fetch reply {op!r}")
+                self._checkin(dest, sock)
+                return st, arr
+            except socket.timeout:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise KvPeerGone(
+                    f"kvxfer fetch reply from {dest} timed out after "
+                    f"{self._reply_timeout_s}s") from None
+            except (OSError, KvPeerGone) as e:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                last = e if isinstance(e, KvTransferError) \
+                    else KvPeerGone(f"kvxfer transport: {e}")
+                if reused:
+                    continue  # stale keep-alive: one fresh retry
+                raise last from None
+            except KvTransferError as e:
+                # typed refusal on a live stream: the conversation is
+                # complete and the socket is reusable — EXCEPT a legacy
+                # peer's ``protocol`` refusal, which closed the stream
+                # behind the error frame
+                if getattr(e, "kind", None) == "protocol":
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                else:
+                    self._checkin(dest, sock)
                 raise
         raise last  # pragma: no cover - loop always returns or raises
 

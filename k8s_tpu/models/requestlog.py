@@ -64,8 +64,14 @@ DEFAULT_MAX_EVENTS_PER_REQUEST = 256
 #: timeline attributes to "queue", the only phase every request has).
 #: ``migrate`` (ISSUE 15) is the disaggregated cross-pod hop: block
 #: transfer on the prefill side, graft-and-seat on the decode side.
+#: ``spill``/``promote`` (ISSUE 17) are the host-RAM KV tier: demoting
+#: evicted tree leaves to host buffers on this request's behalf, and
+#: re-grafting spilled chain blocks back into the pool at attach time.
+#: The spill span rides INSIDE the evict walk's wall span (the demote
+#: happens mid-eviction), so those two phases deliberately overlap —
+#: attribution names the tier, it does not partition wall time.
 PHASES = ("queue", "prefill", "migrate", "decode", "spec_reject",
-          "compile", "evict")
+          "compile", "evict", "spill", "promote")
 
 
 def _dominant(phase_s: dict) -> str:
@@ -158,6 +164,11 @@ class RequestRecorder:
             # None for requests that never migrated
             "migrate": None,
             "evictions": 0,
+            # tiered KV hierarchy (ISSUE 17): blocks this request's
+            # allocations demoted to the host spill tier, and spilled
+            # blocks promoted back to the pool for its prefix attach
+            "spilled": 0,
+            "promoted": 0,
             "slot": None,
             "retire": None,
             "dominant_phase": None,
@@ -359,6 +370,39 @@ class RequestRecorder:
             self._event(entry, "evict", blocks=blocks,
                         dur_s=round(dur_s, 6))
 
+    def spilled(self, rid: Optional[int], blocks: int,
+                dur_s: float) -> None:
+        """Block-pool allocation for this request demoted evicted tree
+        leaves to the host spill tier (ISSUE 17) instead of dropping
+        them.  The span rides inside the evict walk's wall time — see
+        the PHASES note on the deliberate overlap."""
+        if rid is None:
+            return
+        with self._lock:
+            entry = self._live.get(rid)
+            if entry is None:
+                return
+            entry["spilled"] += blocks
+            self._phase(entry, "spill", dur_s)
+            self._event(entry, "spill", blocks=blocks,
+                        dur_s=round(dur_s, 6))
+
+    def promoted(self, rid: Optional[int], blocks: int,
+                 dur_s: float) -> None:
+        """Spilled chain blocks were re-grafted into the pool so this
+        request's prompt attaches them as a tree hit (ISSUE 17) — the
+        gather/dequantize/graft wall time bills to ``promote``."""
+        if rid is None:
+            return
+        with self._lock:
+            entry = self._live.get(rid)
+            if entry is None:
+                return
+            entry["promoted"] += blocks
+            self._phase(entry, "promote", dur_s)
+            self._event(entry, "promote", blocks=blocks,
+                        dur_s=round(dur_s, 6))
+
     def retire(self, rid: Optional[int], reason: str,
                tokens: Optional[int] = None,
                ttft_s: Optional[float] = None) -> None:
@@ -444,8 +488,8 @@ class RequestRecorder:
             "id", "state", "kind", "wall_submit", "prompt_len",
             "max_new", "speculative", "trace_id", "queue_wait_s",
             "ttft_s", "tpot_s", "e2e_s", "tokens", "steps", "prefix",
-            "spec", "migrate", "evictions", "slot", "retire",
-            "dominant_phase")}
+            "spec", "migrate", "evictions", "spilled", "promoted",
+            "slot", "retire", "dominant_phase")}
         out["phase_s"] = dict(entry["phase_s"])
         if out["dominant_phase"] is None:
             # provisional attribution for LIVE entries, so

@@ -110,6 +110,12 @@ class ParsedRequest:
     # address (host:port) the router injected for a phase-split request;
     # None = serve locally (the collapsed path)
     kv_dest: Optional[str] = None
+    # fleet prefix fetch-on-miss (ISSUE 17): the kv-transfer address of
+    # a peer pod the fleet index says already caches this prompt's
+    # prefix chain — this pod fetches the blocks from there instead of
+    # re-prefilling them; best-effort (any failure falls back to a
+    # normal prefill), None = no known holder
+    kv_src: Optional[str] = None
 
 
 def parse_request(config, req: dict, default_max_new_tokens: int
@@ -175,20 +181,25 @@ def parse_request(config, req: dict, default_max_new_tokens: int
     if spec != 0 and spec < 2:
         raise RequestError("speculative",
                            "speculative must be >= 2 (0 disables)")
-    kv_dest = req.get("kv_dest")
-    if kv_dest is not None:
+    def kv_addr(key):
+        val = req.get(key)
+        if val is None:
+            return None
         from k8s_tpu.models import kvxfer
 
-        if not isinstance(kv_dest, str):
-            raise RequestError("kv_dest", '"kv_dest" must be a string')
+        if not isinstance(val, str):
+            raise RequestError(key, f'"{key}" must be a string')
         try:
-            kvxfer.parse_dest(kv_dest)
+            kvxfer.parse_dest(val)
         except ValueError as e:
-            raise RequestError("kv_dest", str(e))
+            raise RequestError(key, str(e))
+        return val
+
     return ParsedRequest(
         ids=ids, echo_text=req["text"] if has_text else None,
         max_new_tokens=max_new, temperature=temperature, top_k=top_k,
-        eos=eos, seed=seed, speculative=spec, kv_dest=kv_dest)
+        eos=eos, seed=seed, speculative=spec, kv_dest=kv_addr("kv_dest"),
+        kv_src=kv_addr("kv_src"))
 
 
 def _emitted(toks, eos) -> int:
@@ -216,7 +227,9 @@ class LmServer:
                  batch_spec: Optional[bool] = None, registry=None,
                  placement=None, role: Optional[str] = None,
                  kvxfer_port: Optional[int] = None,
-                 kvxfer_int8: Optional[bool] = None):
+                 kvxfer_int8: Optional[bool] = None,
+                 spill_mb: Optional[int] = None,
+                 kvxfer_dedup: Optional[bool] = None):
         from k8s_tpu.models import engine as engine_lib
         from k8s_tpu.models import kvxfer as kvxfer_lib
         from k8s_tpu.util import metrics as metrics_mod
@@ -257,7 +270,7 @@ class LmServer:
             self.engine: Optional[engine_lib.Engine] = engine_lib.Engine(
                 config, params, slots=slots, queue_limit=queue_limit,
                 prefix_blocks=prefix_blocks, metrics=self.metrics,
-                placement=placement)
+                placement=placement, spill_mb=spill_mb)
         else:
             # legacy single-flight path: one lock around all device work
             # (kept as the bench_serve baseline and an escape hatch)
@@ -274,6 +287,10 @@ class LmServer:
                              f"(got {self.role!r})")
         self.kvxfer_int8 = kvxfer_lib.env_kvxfer_int8() \
             if kvxfer_int8 is None else bool(kvxfer_int8)
+        # migration dedup (ISSUE 17): default on — a dedup-off peer
+        # interoperates through the legacy-fallback handshake either way
+        self.kvxfer_dedup = kvxfer_lib.env_kvxfer_dedup() \
+            if kvxfer_dedup is None else bool(kvxfer_dedup)
         if kvxfer_port is None:
             kvxfer_port = kvxfer_lib.env_kvxfer_port()
         self._kv_receiver = None
@@ -284,9 +301,26 @@ class LmServer:
                     or self.role == kvxfer_lib.ROLE_DECODE):
                 self._kv_receiver = kvxfer_lib.KvReceiver(
                     self._seat_migrated, host="0.0.0.0",
-                    port=kvxfer_port or 0)
+                    port=kvxfer_port or 0,
+                    index_fn=(self.engine.dedup_have
+                              if self.kvxfer_dedup else None),
+                    fetch_fn=self._serve_fetch)
             if self.role != kvxfer_lib.ROLE_DECODE:
                 self._kv_sender = kvxfer_lib.KvSender()
+        # fleet prefix cache index (ISSUE 17): advertise resident chain
+        # fingerprints (tree + spill) as a labeled gauge family the
+        # fleet plane already scrapes/aggregates — the router's index
+        # lookup reads them back per pod.  Same rebind-don't-rebake
+        # contract as queue_depth: the registry dedupes by name, so the
+        # proxy's sample_fn is rebound to THIS server (latest wins) and
+        # close() releases the binding.
+        proxy = self.registry.register(metrics_mod.ProxyMetric(
+            "serve_kv_prefix_cached",
+            "Chain fingerprints this pod can serve by reference or "
+            "re-promote (radix tree + host spill tier), one labeled "
+            "sample per fingerprint.", "gauge", None))
+        proxy._sample_fn = self._sample_prefix_index
+        self._prefix_index_proxy = proxy
         # compile ledger (ISSUE 11): the exclusive lane's whole-generation
         # programs are the server's own compile surface — one program per
         # (generation config, prompt length), bounded by the decode-module
@@ -311,9 +345,21 @@ class LmServer:
                 "by the decode-module lru tables "
                 "(_cached_generate_fn + cached_speculative_fn)")
 
+    def _sample_prefix_index(self, name: str):
+        """Exposition lines for the fleet prefix cache index family
+        (ProxyMetric sample_fn): one ``{fp="…"} 1`` gauge sample per
+        advertised chain fingerprint; nothing with no paged engine."""
+        if self.engine is None or not self.engine.paged:
+            return
+        for fp in self.engine.prefix_index():
+            yield f'{name}{{fp="{fp}"}} 1'
+
     def close(self) -> None:
         if self.metrics["queue_depth"]._fn == self.queue_depth:
             self.metrics["queue_depth"]._fn = None
+        if getattr(self._prefix_index_proxy, "_sample_fn", None) \
+                == self._sample_prefix_index:
+            self._prefix_index_proxy._sample_fn = None
         if self._kv_receiver is not None:
             self._kv_receiver.stop()
         if self._kv_sender is not None:
@@ -388,7 +434,18 @@ class LmServer:
                 "kv_exports": s["kv_exports"],
                 "kv_imports": s["kv_imports"],
                 "kv_blocks_out": s["kv_blocks_out"],
-                "kv_blocks_in": s["kv_blocks_in"]}
+                "kv_blocks_in": s["kv_blocks_in"],
+                # tiered KV hierarchy (ISSUE 17): host spill tier
+                # occupancy, dedup savings, and fleet fetch imports
+                "kvxfer_dedup": self.kvxfer_dedup,
+                "spill_enabled": s["spill_enabled"],
+                "spill_blocks": s["spill_blocks"],
+                "spill_bytes": s["spill_bytes"],
+                "spill_demotions": s["spill_demotions"],
+                "spill_promotions": s["spill_promotions"],
+                "spill_evictions": s["spill_evictions"],
+                "kv_blocks_deduped": s["kv_blocks_deduped"],
+                "kv_prefix_fetched": s["kv_prefix_fetched"]}
 
     # -- disaggregated prefill/decode (ISSUE 15) -----------------------
 
@@ -438,12 +495,71 @@ class LmServer:
                 out[path] = arr
         return out
 
+    def _serve_fetch(self, statics: dict, arrays: dict
+                     ) -> Optional[tuple[dict, dict]]:
+        """The kv-receiver's fetch seam (ISSUE 17): serve a peer's
+        fetch-on-miss request from this pod's cached prefix chain
+        (tree blocks + spill payloads), wire-encoded exactly like a
+        migration export; None = nothing cached (the peer re-prefills)."""
+        import numpy as np
+
+        from k8s_tpu.models import kvxfer as kvxfer_lib
+
+        if self.engine is None or not self.engine.paged:
+            return None
+        manifest = self.engine.fetch_prefix(
+            np.asarray(arrays["ids"], np.int32))
+        if manifest is None or not manifest["n_blocks"]:
+            return None
+        wire, quantized = self._wire_blocks(manifest)
+        return ({"v": kvxfer_lib.PROTOCOL_VERSION,
+                 "wire_int8": quantized,
+                 "n_blocks": manifest["n_blocks"],
+                 "block_size": manifest["block_size"]}, wire)
+
+    def _fetch_on_miss(self, parsed: ParsedRequest,
+                       trace_ctx: Optional[tuple]) -> int:
+        """Requester side of fleet fetch-on-miss (ISSUE 17): pull the
+        prompt's cached prefix chain from ``parsed.kv_src`` (the holder
+        the router's index lookup named) and graft it locally, so the
+        submit right after attaches it as an ordinary tree hit.
+        Best-effort end to end: any shortfall or transport failure
+        returns 0 and the request simply re-prefills."""
+        import numpy as np
+
+        from k8s_tpu import trace
+        from k8s_tpu.models import kvtier
+        from k8s_tpu.models import kvxfer as kvxfer_lib
+
+        engine = self.engine
+        bs = engine.block_size
+        fps = kvtier.chain_fingerprints(
+            parsed.ids, bs, max_blocks=(int(parsed.ids.size) - 1) // bs)
+        if not fps or engine.dedup_have(fps) >= len(fps):
+            return 0  # nothing fetchable, or already cached locally
+        try:
+            with trace.span_under(trace_ctx, "kv_fetch",
+                                  src=parsed.kv_src):
+                statics, arrays = self._kv_sender.fetch(
+                    parsed.kv_src, {"v": kvxfer_lib.PROTOCOL_VERSION},
+                    {"ids": np.asarray(parsed.ids, np.int32)})
+            n = int(statics.get("n_blocks") or 0)
+            if n <= 0:
+                return 0
+            blocks = self._unwire_blocks(arrays,
+                                         bool(statics.get("wire_int8")))
+            return engine.import_prefix(parsed.ids, blocks, n)
+        except Exception as e:  # noqa: BLE001 - fetch is an optimization, never an error
+            log.debug("kv fetch-on-miss from %s failed: %s",
+                      parsed.kv_src, e)
+            return 0
+
     def _seat_migrated(self, statics: dict, arrays: dict,
                        on_seated) -> list[int]:
         """The kv-receiver's seam onto the engine: rebuild the flat
         block manifest from the wire and seat the request; typed
-        refusals (PoolExhausted / QueueFull / ValueError) travel back
-        to the sender as error frames."""
+        refusals (PoolExhausted / QueueFull / ValueError / DedupStale)
+        travel back to the sender as error frames."""
         import numpy as np
 
         req = statics.get("req") or {}
@@ -451,6 +567,7 @@ class LmServer:
                                      bool(statics.get("wire_int8")))
         return self.engine.submit_prefilled(
             np.asarray(arrays["ids"], np.int32), blocks,
+            skip=int(statics.get("skip") or 0),
             first_token=int(req["first"]),
             key=np.asarray(arrays["key"], np.uint32),
             max_new_tokens=int(req["max_new_tokens"]),
@@ -500,18 +617,38 @@ class LmServer:
                     "block_size": export["block_size"],
                 },
             }
+            # migration dedup (ISSUE 17): offer the chain's cumulative
+            # block fingerprints so the receiver can claim blocks its
+            # tree/spill already holds and the wire ships only the rest
+            fps = None
+            info: dict = {}
+            if self.kvxfer_dedup:
+                from k8s_tpu.models import kvtier
+
+                ids = export["ids"]
+                # offer only blocks the receiver may legally skip: the
+                # last prompt token's block is never tree-shareable
+                fps = kvtier.chain_fingerprints(
+                    ids, export["block_size"],
+                    max_blocks=(len(ids) - 1) // export["block_size"])
             with trace.span_under(trace_ctx, "kv_migrate",
                                   dest=parsed.kv_dest,
                                   blocks=export["n_blocks"],
                                   wire_int8=quantized):
                 tokens, seated_s = self._kv_sender.migrate(
-                    parsed.kv_dest, statics, wire)
+                    parsed.kv_dest, statics, wire, fingerprints=fps,
+                    info=info)
+            skipped = int(info.get("skipped_blocks") or 0)
+            if skipped:
+                ded = self.metrics.get("kvxfer_dedup_skipped")
+                if ded is not None:
+                    ded.inc(skipped)
             h = self.metrics.get("kv_migrate")
             if h is not None:
                 h.observe(seated_s)
             if rlog is not None:
-                rlog.migrate_send(rid, export["n_blocks"], seated_s,
-                                  dest=parsed.kv_dest)
+                rlog.migrate_send(rid, export["n_blocks"] - skipped,
+                                  seated_s, dest=parsed.kv_dest)
                 rlog.retire(rid, "migrated", tokens=len(tokens))
             return tokens
         except BaseException:
@@ -548,6 +685,15 @@ class LmServer:
                         and parsed.ids.size >= 2)
         use_batched = (parsed.speculative == 0 or spec_batched) and (
             parsed.temperature == 0.0 or self.batch_sampling)
+        if parsed.kv_src and not parsed.kv_dest \
+                and self._kv_sender is not None \
+                and self.engine is not None and self.engine.paged:
+            # fleet fetch-on-miss (ISSUE 17): the router's index lookup
+            # named a peer that caches this prompt's prefix chain —
+            # pull it over the kvxfer plane and graft it locally before
+            # submitting, so the prefill attaches it as a tree hit.
+            # Best-effort: any failure just re-prefills.
+            self._fetch_on_miss(parsed, trace_ctx)
         if parsed.kv_dest and self._kv_sender is not None \
                 and self.engine is not None and self.engine.paged \
                 and use_batched:
@@ -885,6 +1031,18 @@ def main(argv=None) -> int:
                    help="quantize fp-pool KV content to int8 for "
                    "transit (default K8S_TPU_KVXFER_INT8 or 0; lossy "
                    "on fp pools, no-op on int8 pools)")
+    p.add_argument("--spill-mb", type=int, default=None,
+                   help="host-RAM KV spill tier budget in MiB: evicted "
+                   "prefix-tree leaves demote to quantized host buffers "
+                   "and re-promote on the next hit instead of "
+                   "re-prefilling (default K8S_TPU_SERVE_SPILL_MB or 0 "
+                   "= off)")
+    p.add_argument("--kvxfer-dedup", type=int, choices=(0, 1),
+                   default=None,
+                   help="fingerprint-dedup the kv migration wire: skip "
+                   "blocks the receiver already holds in-tree or "
+                   "in-spill (default K8S_TPU_KVXFER_DEDUP or 1; "
+                   "legacy peers interoperate either way)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     from k8s_tpu.models import placement as placement_lib
@@ -924,6 +1082,9 @@ def main(argv=None) -> int:
                   role=args.role, kvxfer_port=args.kvxfer_port,
                   kvxfer_int8=None if args.kvxfer_int8 is None
                   else bool(args.kvxfer_int8),
+                  spill_mb=args.spill_mb,
+                  kvxfer_dedup=None if args.kvxfer_dedup is None
+                  else bool(args.kvxfer_dedup),
                   placement=placement, **mesh_kw)
     httpd = serve(lm, args.host, args.port)
     host, port = httpd.server_address[:2]

@@ -211,6 +211,11 @@ class Router:
         self.retries_total = 0
         self.prefill_routed_total = 0
         self.hedges_total: dict[str, int] = {}
+        # fleet prefix cache index (ISSUE 17): placements upgraded onto
+        # a pod that ADVERTISES the fingerprint (vs "probably cached
+        # there"), and requests sent with a kv_src fetch-on-miss hint
+        self.index_hits_total = 0
+        self.kv_src_routed_total = 0
         self._placements: deque = deque(maxlen=PLACEMENT_RING)
         self._rng = random.Random()
         # keep-alive connection pool per backend netloc: a fresh TCP
@@ -423,6 +428,48 @@ class Router:
         except Exception:  # noqa: BLE001 - a broken tie-break must not drop traffic
             return {}
 
+    def _index_holders(self, fp: str) -> dict[str, float]:
+        """Pods advertising ``fp`` in the fleet prefix cache index
+        (``serve_kv_prefix_cached{fp=...}``, ISSUE 17) — pods whose
+        radix tree or spill tier actually HOLDS the prefix right now,
+        as of the last scrape.  Empty when no plane is active, the job
+        is unknown, or nobody advertises it — placement then falls
+        back to "probably cached there" ring affinity alone."""
+        if not self.job:
+            return {}
+        try:
+            import k8s_tpu.fleet as fleet
+
+            plane = fleet.active()
+            if plane is None:
+                return {}
+            return plane.aggregator.pod_gauge_latest(
+                self.job, "serve_kv_prefix_cached",
+                (("fp", fp),)) or {}
+        except Exception:  # noqa: BLE001 - a stale index must not drop traffic
+            return {}
+
+    def _index_kv_src(self, fp: Optional[str],
+                      target: Optional[str]) -> Optional[str]:
+        """kvxfer address of an index-advertised holder of ``fp`` when
+        the placed ``target`` is not itself a holder: the serving pod
+        fetches the prefix blocks on miss instead of recomputing them.
+        None when the index is cold, the target already holds the
+        prefix, or no holder exposes a kvxfer listener."""
+        if fp is None or target is None:
+            return None
+        holders = self._index_holders(fp)
+        if not holders or target in holders:
+            return None
+        with self._lock:
+            for name in holders:
+                b = self._backends.get(name)
+                if b is not None and b.healthy and b.kvxfer \
+                        and name != target:
+                    self.kv_src_routed_total += 1
+                    return b.kvxfer
+        return None
+
     def _eligible_locked(self) -> list[Backend]:
         # prefill-role pods are not placement candidates for normal
         # traffic (they only take the phase-split prefill leg)
@@ -537,8 +584,12 @@ class Router:
                         # after a failure
                         return ring_order, True, fp
         # fallback / least / random: the per-pod fleet tie-break reads
-        # the aggregator (its own lock) OUTSIDE the router state lock
+        # the aggregator (its own lock) OUTSIDE the router state lock,
+        # as does the prefix cache index (ISSUE 17) — a pod that
+        # ADVERTISES the fingerprint beats the plain least-outstanding
+        # pick when the ring-designated pod is cold or shedding
         depths = self._fleet_depths()
+        holders = self._index_holders(fp) if fp is not None else {}
         with self._lock:
             eligible = self._eligible_locked()
             if not eligible:
@@ -559,10 +610,24 @@ class Router:
                 # fallback, then the ring walk minus the fallback pick
                 ring_order = [n for n in self._ring.candidates(fp)
                               if n in by_name]
-                order = [least[0].name] + [
+                pick = least[0].name
+                if holders and pick not in holders:
+                    # fleet index upgrade: an available pod that holds
+                    # the prefix (tree or spill tier) serves it without
+                    # recompute — worth leaving the least-outstanding
+                    # pick for
+                    for b in least:
+                        if b.name in holders \
+                                and self._available(b, now):
+                            pick = b.name
+                            self.index_hits_total += 1
+                            break
+                elif holders and pick in holders:
+                    self.index_hits_total += 1
+                order = [pick] + [
                     n for n in (ring_order or
-                                [b.name for b in least[1:]])
-                    if n != least[0].name]
+                                [b.name for b in least])
+                    if n != pick]
                 return order, False, fp
             if self.policy == POLICY_RANDOM:
                 names = [b.name for b in eligible]
@@ -604,6 +669,16 @@ class Router:
             return (503, {"Retry-After": "1"},
                     json.dumps({"error": "no healthy backends"}).encode(),
                     {"outcome": "no_backends", "affine": affine})
+        if disagg is None and not affine and fp is not None and req \
+                and not req.get("kv_dest") and not req.get("kv_src"):
+            # cold placement (ISSUE 17): when another pod advertises
+            # this prefix in the fleet index, ride its kvxfer address
+            # on the body so the serving pod fetches the blocks instead
+            # of recomputing them (never alongside kv_dest — the server
+            # treats the two as mutually exclusive)
+            kv_src = self._index_kv_src(fp, order[0])
+            if kv_src is not None:
+                body = json.dumps({**req, "kv_src": kv_src}).encode()
         attempts = min(len(order), 1 + self.retry_budget)
         last_status, last_headers, last_body = 503, {}, json.dumps(
             {"error": "all retry candidates failed"}).encode()
@@ -858,6 +933,8 @@ class Router:
                 "retries_total": self.retries_total,
                 "prefill_routed_total": self.prefill_routed_total,
                 "hedges_total": dict(self.hedges_total),
+                "index_hits_total": self.index_hits_total,
+                "kv_src_routed_total": self.kv_src_routed_total,
             }
 
     def debug_state(self, n_placements: int = 50) -> dict:
@@ -891,6 +968,8 @@ class Router:
             retries = self.retries_total
             prefill_routed = self.prefill_routed_total
             hedges = dict(self.hedges_total)
+            index_hits = self.index_hits_total
+            kv_src_routed = self.kv_src_routed_total
             inflight = [(b.name, b.inflight)
                         for b in sorted(self._backends.values(),
                                         key=lambda b: b.name)]
@@ -918,6 +997,16 @@ class Router:
             "phase-split onto the prefill tier (disaggregated serving).",
             "# TYPE router_prefill_routed_total counter",
             f"router_prefill_routed_total {prefill_routed}",
+            "# HELP router_index_hits_total Cold placements upgraded "
+            "onto a pod advertising the prefix in the fleet cache "
+            "index.",
+            "# TYPE router_index_hits_total counter",
+            f"router_index_hits_total {index_hits}",
+            "# HELP router_kv_src_routed_total Requests forwarded with "
+            "a kv_src fetch-on-miss hint naming an index-advertised "
+            "holder.",
+            "# TYPE router_kv_src_routed_total counter",
+            f"router_kv_src_routed_total {kv_src_routed}",
             "# HELP router_hedges_total Fired request hedges by outcome "
             "(primary = original won after the hedge fired, hedge = the "
             "raced candidate won, failed = first response was an error).",

@@ -276,7 +276,11 @@ class ProxyMetric(_Metric):
     def collect(self):
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} {self.kind}"
-        yield from self._sample_fn(self.name)
+        # sample_fn may be rebound (latest-registrant-wins, the
+        # queue_depth contract) and released to None on close — an
+        # unbound proxy exposes an empty family, never a broken scrape
+        if self._sample_fn is not None:
+            yield from self._sample_fn(self.name)
 
 
 REGISTRY = Registry()
@@ -732,5 +736,28 @@ def serving_metrics(registry: Optional[Registry] = None,
             "+ graft, decode excluded).",
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                      0.25, 1.0, 2.5),
+        ),
+        # -- tiered KV memory hierarchy (ISSUE 17) -------------------------
+        "kv_spilled_blocks": r.gauge(
+            "serve_kv_spilled_blocks",
+            "KV blocks resident in the host-RAM spill tier (evicted "
+            "prefix-tree leaves demoted instead of dropped), sampled "
+            "after each demote/promote.",
+        ),
+        "kv_spill_bytes": r.gauge(
+            "serve_kv_spill_bytes",
+            "Host bytes held by the spill tier (quantized payloads), "
+            "bounded by K8S_TPU_SERVE_SPILL_MB.",
+        ),
+        "kv_promotions": r.counter(
+            "serve_kv_promotions_total",
+            "Spilled KV blocks promoted back into the device pool on a "
+            "prefix hit (each one a block-sized re-prefill avoided).",
+        ),
+        "kvxfer_dedup_skipped": r.counter(
+            "serve_kvxfer_dedup_blocks_skipped_total",
+            "KV blocks the migration wire skipped because the receiver "
+            "already held them in-tree or in-spill (counted on the "
+            "SENDING pod after the offer/need handshake).",
         ),
     }

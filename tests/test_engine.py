@@ -813,6 +813,158 @@ class TestBlockRefcounts:
         finally:
             eng.shutdown()
 
+    def test_churned_join_retire_with_spill_tier_refcounts_exact(
+            self, model):
+        """The 18-thread churn schedule with the spill tier ON
+        (ISSUE 17): eviction->demote must keep pool refcounts exactly
+        equal to held references (a demoted payload is a HOST COPY and
+        holds no pool reference, so it can never alias — or pin — a
+        live device block), outputs stay exact through demote/promote
+        churn, and the tier actually moved blocks."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=64, block_size=8,
+                     prefix_blocks=2, spill_mb=16)
+        try:
+            base = [int(x) for x in prompt_of(16, seed=30)]
+            results = {}
+
+            def run(i):
+                if i % 3 == 0:
+                    p = np.asarray(base + [i % 61], np.int32)
+                elif i % 3 == 1:
+                    p = np.asarray(base[:9] + [(i * 7) % 61, i % 61],
+                                   np.int32)
+                else:
+                    # disjoint ~5-block chains: cycling 6 of them
+                    # through the tight pool forces eviction -> demote
+                    p = prompt_of(40 + i % 7, seed=100 + i)
+                temp = 0.0 if i % 2 == 0 else 0.9
+                results[i] = (p, temp,
+                              eng.submit(p, 3 + i % 5, temperature=temp,
+                                         seed=i))
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(18)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            st = eng.stats()
+            assert st["completed"] >= 18
+            assert st["active"] == 0
+            assert st["spill_enabled"]
+            assert st["spill_demotions"] >= 1, \
+                "the churn never demoted: the pool is too roomy to " \
+                "prove the eviction->demote ordering — retune"
+            # refcounts == held references: no spill entry holds one
+            eng.debug_check_blocks()
+            # every demoted payload is a host copy with real content:
+            # promoting the shared base back must reproduce the exact
+            # churn-era answer even after the pool fully recycled
+            p = np.asarray(base + [0], np.int32)
+            assert eng.submit(p, 3) == unbatched(cfg, params, p, 3)
+            for i, (p, temp, got) in results.items():
+                assert got == unbatched(cfg, params, p, 3 + i % 5,
+                                        temperature=temp, seed=i), \
+                    f"request {i} corrupted under spill churn"
+        finally:
+            eng.shutdown()
+
+
+class TestSpillTierEngine:
+    """Host-RAM spill tier behind the block pool (ISSUE 17):
+    demote-on-evict, promote-on-tree-miss, and the identity contract
+    through the round trip."""
+
+    def test_spill_off_by_default(self, model):
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=8,
+                     prefix_blocks=2)
+        try:
+            st = eng.stats()
+            assert not st["spill_enabled"]
+            assert st["spill_blocks"] == 0
+        finally:
+            eng.shutdown()
+
+    def test_evicted_leaf_demotes_then_promotes_on_revisit(self, model):
+        """A prompt whose chain was LRU-evicted re-attaches through the
+        spill tier: the revisit is a prefix HIT (prefix_tokens_saved
+        moves), promotions move, and output is exact."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=32, block_size=8,
+                     prefix_blocks=2, spill_mb=16)
+        try:
+            p0 = prompt_of(24, seed=70)
+            ref = eng.submit(p0, 4)
+            # distinct chains flood the tiny tree: p0's leaves demote
+            for i in range(6):
+                eng.submit(prompt_of(24, seed=71 + i), 2)
+            st0 = eng.stats()
+            assert st0["spill_demotions"] >= 1
+            got = eng.submit(p0, 4)
+            st1 = eng.stats()
+            assert got == ref == unbatched(cfg, params, p0, 4)
+            assert st1["spill_promotions"] > st0["spill_promotions"], \
+                "revisit never promoted from the spill tier"
+            assert st1["prefix_tokens_saved"] > st0["prefix_tokens_saved"]
+            eng.debug_check_blocks()
+        finally:
+            eng.shutdown()
+
+    def test_identity_through_demote_promote_every_lane_int8_pool(
+            self, model):
+        """Fixed-seed token identity through demote->promote on an int8
+        KV pool — the bit-exact tier (int8 payloads spill raw; float
+        pools take the documented-lossy int8 round trip, exactly like
+        the migration wire) — on every lane: greedy, sampled, top-k,
+        speculative."""
+        import dataclasses
+
+        cfg, params = model
+        cfg8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+        eng = Engine(cfg8, params, slots=2, queue_limit=32,
+                     block_size=8, prefix_blocks=2, spill_mb=16)
+        try:
+            lanes = {
+                "greedy": {},
+                "sampled": {"temperature": 1.0, "seed": 1234},
+                "top_k": {"temperature": 0.7, "top_k": 7, "seed": 77},
+                "spec": {"speculative": 2},
+            }
+            prompts = {lane: prompt_of(20, seed=200 + i)
+                       for i, lane in enumerate(lanes)}
+            refs = {lane: eng.submit(prompts[lane], 6, **kw)
+                    for lane, kw in lanes.items()}
+            for i in range(8):  # flood: every lane's chain demotes
+                eng.submit(prompt_of(20, seed=300 + i), 2)
+            assert eng.stats()["spill_demotions"] >= 1
+            for lane, kw in lanes.items():
+                before = eng.stats()["spill_promotions"]
+                got = eng.submit(prompts[lane], 6, **kw)
+                assert eng.stats()["spill_promotions"] > before, \
+                    f"{lane}: revisit never promoted — proves nothing"
+                assert got == refs[lane], \
+                    f"{lane}: demote->promote changed the math"
+            eng.debug_check_blocks()
+        finally:
+            eng.shutdown()
+
+    def test_spill_budget_bounds_host_bytes(self, model):
+        """The tier never holds more than K8S_TPU_SERVE_SPILL_MB worth
+        of payload bytes, evicting its own LRU tail instead."""
+        cfg, params = model
+        eng = Engine(cfg, params, slots=2, queue_limit=32, block_size=8,
+                     prefix_blocks=1, spill_mb=1)
+        try:
+            for i in range(10):
+                eng.submit(prompt_of(24, seed=400 + i), 2)
+            st = eng.stats()
+            assert st["spill_bytes"] <= 1 << 20
+            assert st["spill_blocks"] >= 1
+        finally:
+            eng.shutdown()
+
 
 class TestBackpressureAndLifecycle:
     def test_queue_full_raises(self, model):

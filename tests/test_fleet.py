@@ -139,6 +139,11 @@ class TestParser:
         sm["queue_wait"].observe(0.04)
         sm["step_duration"].observe(0.006)
         sm["prefill_convoy"].inc(2)
+        # ISSUE 17 tiered-KV families (spill tier + migration dedup)
+        sm["kv_spilled_blocks"].set(12)
+        sm["kv_spill_bytes"].set(1 << 20)
+        sm["kv_promotions"].inc(4)
+        sm["kvxfer_dedup_skipped"].inc(9)
         flight.reset_all()
         metrics_mod.flight_metrics(reg)
         flight.ACCOUNTING.record("GET", "pods", 200, 0.004)
@@ -178,13 +183,24 @@ class TestParser:
                          "serve_ttft_seconds", "serve_tpot_seconds",
                          "serve_queue_wait_seconds",
                          "serve_step_duration_seconds",
-                         "serve_prefill_convoy_total"):
+                         "serve_prefill_convoy_total",
+                         # ISSUE 17: the tiered-KV families (spill tier
+                         # occupancy, promotions, migration dedup)
+                         "serve_kv_spilled_blocks",
+                         "serve_kv_spill_bytes",
+                         "serve_kv_promotions_total",
+                         "serve_kvxfer_dedup_blocks_skipped_total"):
             assert expected in fams, f"family {expected} missing"
         assert fams["tfjob_sync_duration_seconds"].kind == "histogram"
         assert fams["fleet_scrape_total"].kind == "counter"
         assert fams["serve_ttft_seconds"].kind == "histogram"
         assert fams["serve_tpot_seconds"].kind == "histogram"
         assert fams["serve_prefill_convoy_total"].kind == "counter"
+        assert fams["serve_kv_spilled_blocks"].kind == "gauge"
+        assert fams["serve_kv_promotions_total"].kind == "counter"
+        assert fams["serve_kvxfer_dedup_blocks_skipped_total"].kind \
+            == "counter"
+        assert fams["serve_kv_spilled_blocks"].values()[()] == 12
         # the TTFT histogram decomposes: the fleet plane's merged-bucket
         # quantiles (and serve_ttft_seconds:p99<… SLO rules) work on it
         assert fleet.histogram_points(

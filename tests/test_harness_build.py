@@ -516,6 +516,35 @@ class TestGenjob:
         # default, with the ring bound left to the recorder default
         assert env["K8S_TPU_REQUEST_LOG"] == "1"
         assert "K8S_TPU_REQUEST_LOG_RING" not in env
+        # ISSUE 17: spill tier and dedup are engine/server defaults
+        # unless pinned — no env row means "off" for spill (the
+        # server's env_spill_mb default) and "on" for dedup
+        assert "K8S_TPU_SERVE_SPILL_MB" not in env
+        assert "K8S_TPU_KVXFER_DEDUP" not in env
+
+    def test_serve_spill_and_dedup_knobs(self):
+        """ISSUE 17: --serve-spill-mb stamps the host-RAM spill tier
+        budget and --kvxfer-dedup pins the migration dedup handshake on
+        single-role serving jobs too (the sender side lives in every
+        server)."""
+        [job] = genjob.generate(1, serve=True, timestamp=17,
+                                serve_spill_mb=2048, kvxfer_dedup=False)
+        c = job["spec"]["tfReplicaSpecs"]["Worker"][
+            "template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["K8S_TPU_SERVE_SPILL_MB"] == "2048"
+        assert env["K8S_TPU_KVXFER_DEDUP"] == "0"
+        manifest.load_tfjob(job)
+        # spill_mb 0 is a legitimate pin (explicit off), negatives are
+        # refused at generation time, not at pod boot
+        [job0] = genjob.generate(1, serve=True, timestamp=18,
+                                 serve_spill_mb=0)
+        env0 = {e["name"]: e["value"]
+                for e in job0["spec"]["tfReplicaSpecs"]["Worker"][
+                    "template"]["spec"]["containers"][0]["env"]}
+        assert env0["K8S_TPU_SERVE_SPILL_MB"] == "0"
+        with pytest.raises(ValueError, match="serve_spill_mb"):
+            genjob.serve_tfjob_template("j", serve_spill_mb=-1)
 
     def test_serve_router_emits_companion_and_autoscale_bounds(self):
         """--serve --router (ISSUE 13): each serving TFJob carries the
@@ -667,6 +696,26 @@ class TestGenjobDisagg:
             "K8S_TPU_KVXFER_INT8"] == "1"
         assert "K8S_TPU_KVXFER_INT8" not in self._env(job["spec"],
                                                       "Decode")
+
+    def test_spill_and_dedup_stamp_both_tiers(self):
+        """ISSUE 17: the spill budget and the dedup knob land on BOTH
+        tiers — prefill pods spill their prefix tree too, and dedup is
+        a sender offer (prefill) verified by a receiver index seam
+        (decode)."""
+        job = genjob.disagg_serve_tfjob_template(
+            "j1", serve_spill_mb=1024, kvxfer_dedup=True)
+        for rtype in ("Prefill", "Decode"):
+            env = self._env(job["spec"], rtype)
+            assert env["K8S_TPU_SERVE_SPILL_MB"] == "1024"
+            assert env["K8S_TPU_KVXFER_DEDUP"] == "1"
+        # omitted means no rows (server defaults: spill off, dedup on)
+        job = genjob.disagg_serve_tfjob_template("j2")
+        for rtype in ("Prefill", "Decode"):
+            env = self._env(job["spec"], rtype)
+            assert "K8S_TPU_SERVE_SPILL_MB" not in env
+            assert "K8S_TPU_KVXFER_DEDUP" not in env
+        with pytest.raises(ValueError, match="serve_spill_mb"):
+            genjob.disagg_serve_tfjob_template("j", serve_spill_mb=-5)
 
     def test_generate_disagg_with_router_companion(self):
         docs = genjob.generate(1, serve=True, disagg=True, router=True,

@@ -1,0 +1,161 @@
+"""Tiered KV memory hierarchy primitives (models/kvtier.py, ISSUE 17)
+— unit tier: the host spill tier's LRU/budget accounting, the chain
+fingerprint scheme's pin against the router's affinity hash (ONE
+scheme fleet-wide: affinity, spill keys, dedup offers, the fleet
+index), and the int8 payload codec.  No jax anywhere."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from k8s_tpu.models import kvtier
+from k8s_tpu.router import ring
+
+
+def _put(tier: kvtier.SpillTier, fp: str, nbytes: int = 100) -> bool:
+    return tier.put(fp, (1, 2, 3),
+                    {"l0/k": ("raw", np.zeros(nbytes, np.int8))},
+                    nbytes)
+
+
+class TestChainFingerprints:
+    def test_matches_router_affinity_scheme_per_block(self):
+        """fps[k] must equal the router's fingerprint of the first k+1
+        full blocks — the fleet index and dedup offers only compose
+        with prefix-affine placement because this is ONE hash."""
+        tokens = [(i * 7 + 3) % 256 for i in range(70)]
+        fps = kvtier.chain_fingerprints(tokens, 16)
+        assert len(fps) == 4  # 70 // 16 full blocks
+        for k, fp in enumerate(fps):
+            assert fp == ring.fingerprint_tokens(tokens, 16,
+                                                 affinity_blocks=k + 1)
+
+    def test_prefix_of_longer_chain_shares_fingerprints(self):
+        a = [(i * 5) % 256 for i in range(64)]
+        b = a[:32] + [99] * 32
+        fa = kvtier.chain_fingerprints(a, 16)
+        fb = kvtier.chain_fingerprints(b, 16)
+        assert fa[:2] == fb[:2]
+        assert fa[2:] != fb[2:]
+
+    def test_max_blocks_caps_output(self):
+        tokens = list(range(64))
+        assert len(kvtier.chain_fingerprints(tokens, 16,
+                                             max_blocks=2)) == 2
+        assert kvtier.chain_fingerprints(tokens, 16, max_blocks=0) == []
+
+    def test_no_full_block_is_empty(self):
+        assert kvtier.chain_fingerprints([1, 2, 3], 16) == []
+
+
+class TestPayloadCodec:
+    def test_float_kv_leaves_quantize_to_int8(self):
+        rng = np.random.default_rng(0)
+        flat = {"layer0/k": rng.standard_normal((4, 8)).astype(
+            np.float32),
+            "layer0/v": rng.standard_normal((4, 8)).astype(np.float32)}
+        from k8s_tpu.models.paged import quantize_kv
+
+        payload, nbytes = kvtier.encode_payload(flat, quantize_kv)
+        kind, q, scale = payload["layer0/k"]
+        assert kind == "q8" and q.dtype == np.int8
+        dec = kvtier.decode_payload(payload)
+        # documented-lossy for fp pools: bounded by one int8 step
+        for p in flat:
+            err = np.abs(dec[p] - flat[p])
+            step = np.abs(flat[p]).max(axis=-1, keepdims=True) / 127.0
+            assert (err <= step + 1e-6).all()
+        assert nbytes < sum(a.nbytes for a in flat.values())
+
+    def test_int8_kv_leaves_pass_through_bit_exact(self):
+        flat = {"layer0/k": np.arange(32, dtype=np.int8).reshape(4, 8),
+                "layer0/k_scale": np.ones((4, 1), np.float32)}
+        payload, _ = kvtier.encode_payload(flat, None)
+        assert payload["layer0/k"][0] == "raw"
+        dec = kvtier.decode_payload(payload)
+        assert dec["layer0/k"].dtype == np.int8
+        assert np.array_equal(dec["layer0/k"], flat["layer0/k"])
+        assert np.array_equal(dec["layer0/k_scale"],
+                              flat["layer0/k_scale"])
+
+
+class TestSpillTier:
+    def test_lru_eviction_under_budget(self):
+        tier = kvtier.SpillTier(budget_bytes=250)
+        for i in range(3):
+            assert _put(tier, f"fp{i}", 100)
+        # fp0 is the LRU tail and must have been evicted for fp2
+        assert len(tier) == 2
+        assert "fp0" not in tier
+        assert tier.spill_evictions == 1
+        assert tier.bytes_used <= 250
+
+    def test_get_refreshes_lru_and_keeps_entry_resident(self):
+        tier = kvtier.SpillTier(budget_bytes=250)
+        _put(tier, "a", 100)
+        _put(tier, "b", 100)
+        assert tier.get("a") is not None  # promote: a becomes MRU
+        _put(tier, "c", 100)  # evicts b, not a
+        assert "a" in tier and "b" not in tier
+        assert tier.promoted_blocks == 1
+
+    def test_touch_refreshes_without_promote_accounting(self):
+        tier = kvtier.SpillTier(budget_bytes=250)
+        _put(tier, "a", 100)
+        _put(tier, "b", 100)
+        assert tier.touch("a")
+        assert not tier.touch("zz")
+        _put(tier, "c", 100)
+        assert "a" in tier and "b" not in tier
+        assert tier.promoted_blocks == 0
+
+    def test_re_put_of_resident_fingerprint_is_a_refresh(self):
+        """Re-demoting an entry that never left the tier (promote keeps
+        it resident) must refresh, not duplicate."""
+        tier = kvtier.SpillTier(budget_bytes=300)
+        _put(tier, "a", 100)
+        _put(tier, "b", 100)
+        assert _put(tier, "a", 100)
+        assert len(tier) == 2
+        assert tier.spilled_blocks == 2  # the refresh is not a spill
+
+    def test_oversized_entry_is_refused(self):
+        tier = kvtier.SpillTier(budget_bytes=50)
+        assert not _put(tier, "big", 100)
+        assert len(tier) == 0
+
+    def test_fingerprints_lists_lru_to_mru(self):
+        tier = kvtier.SpillTier(budget_bytes=1000)
+        for fp in ("a", "b", "c"):
+            _put(tier, fp)
+        tier.touch("a")
+        assert tier.fingerprints() == ["b", "c", "a"]
+
+    def test_clear_empties_and_zeroes_bytes(self):
+        tier = kvtier.SpillTier(budget_bytes=1000)
+        _put(tier, "a")
+        tier.clear()
+        assert len(tier) == 0 and tier.bytes_used == 0
+
+
+class TestEnvSpillMb:
+    ENV = "K8S_TPU_SERVE_SPILL_MB"
+
+    def test_unset_means_off(self, monkeypatch):
+        monkeypatch.delenv(self.ENV, raising=False)
+        assert kvtier.env_spill_mb() == 0
+
+    def test_value_parses(self, monkeypatch):
+        monkeypatch.setenv(self.ENV, "128")
+        assert kvtier.env_spill_mb() == 128
+
+    def test_zero_disables(self, monkeypatch):
+        monkeypatch.setenv(self.ENV, "0")
+        assert kvtier.env_spill_mb() == 0
+
+    @pytest.mark.parametrize("bad", ["-1", "lots", "1.5"])
+    def test_garbage_refused(self, monkeypatch, bad):
+        monkeypatch.setenv(self.ENV, bad)
+        with pytest.raises(ValueError):
+            kvtier.env_spill_mb()

@@ -273,6 +273,15 @@ class TestEnvKnobs:
 
         assert genjob.KVXFER_PORT == kvxfer.DEFAULT_PORT
 
+    def test_dedup_default_on(self, monkeypatch):
+        monkeypatch.delenv(kvxfer.ENV_DEDUP, raising=False)
+        assert kvxfer.env_kvxfer_dedup() is True
+        monkeypatch.setenv(kvxfer.ENV_DEDUP, "1")
+        assert kvxfer.env_kvxfer_dedup() is True
+        for off in ("0", "false", "off", "no"):
+            monkeypatch.setenv(kvxfer.ENV_DEDUP, off)
+            assert kvxfer.env_kvxfer_dedup() is False
+
 
 class TestReplyTimeoutNoDuplicate:
     def test_reply_timeout_does_not_resend(self):
@@ -294,6 +303,257 @@ class TestReplyTimeoutNoDuplicate:
             # exactly TWO migrate frames ever reached the receiver —
             # the timed-out attempt was not re-sent
             assert len(seat.calls) == 2
+        finally:
+            send.close()
+            recv.stop()
+
+
+class _DedupStale(RuntimeError):
+    """Receiver-side refusal kind for an evicted dedup promise (the
+    engine's real exception carries the same class attribute)."""
+
+    kind = "dedup_stale"
+
+
+class _StaleOnSkipSeat:
+    """Seat that refuses any SLICED migrate frame with ``dedup_stale``
+    (as if the promised prefix was evicted between offer and seat) but
+    accepts the full re-send."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, statics, arrays, on_seated):
+        self.calls.append((statics, arrays))
+        if statics.get("skip"):
+            raise _DedupStale("promised prefix evicted")
+        on_seated()
+        return [7, 8, 9]
+
+
+class TestDedupHandshake:
+    def _pair(self, seat, index_fn=None):
+        recv = kvxfer.KvReceiver(seat, port=0, index_fn=index_fn)
+        send = kvxfer.KvSender()
+        return recv, send, f"127.0.0.1:{recv.port}"
+
+    def test_offer_need_ships_only_missing_blk_rows(self):
+        """Receiver promises the first 2 of 3 blocks: the migrate frame
+        carries ``skip`` and only the last block's ``blk/``/``blkscale/``
+        rows — ``ids`` (and every non-block array) stay whole."""
+        seat = _FakeEngineSeat()
+        recv, send, dest = self._pair(seat, index_fn=lambda fps: 2)
+        try:
+            statics, arrays = _migrate_payload(n_blocks=3)
+            arrays["blkscale/layer0/k"] = np.arange(
+                3 * 4, dtype=np.float32).reshape(3, 4)
+            info = {}
+            tokens, _ = send.migrate(dest, statics, arrays,
+                                     fingerprints=["f0", "f1"],
+                                     info=info)
+            assert tokens == [7, 8, 9]
+            st, arr = seat.calls[0]
+            assert st["skip"] == 2
+            assert arr["blk/layer0/k"].shape[0] == 1
+            assert np.array_equal(arr["blk/layer0/k"],
+                                  arrays["blk/layer0/k"][2:])
+            assert np.array_equal(arr["blkscale/layer0/k"],
+                                  arrays["blkscale/layer0/k"][2:])
+            assert np.array_equal(arr["ids"], arrays["ids"])  # whole
+            assert info["skipped_blocks"] == 2
+            assert info["skipped_bytes"] > 0
+            assert send.stats()["dedup_blocks_skipped"] == 2
+            assert send.stats()["dedup_bytes_saved"] == \
+                info["skipped_bytes"]
+            assert send.stats()["blocks_out"] == 1
+            assert recv.stats()["dedup_offers"] == 1
+            assert recv.stats()["dedup_blocks_promised"] == 2
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_receiver_promise_clamped_to_offer_length(self):
+        """A buggy/over-eager index answer can never make the sender
+        skip more blocks than it offered."""
+        seat = _FakeEngineSeat()
+        recv, send, dest = self._pair(seat, index_fn=lambda fps: 99)
+        try:
+            statics, arrays = _migrate_payload(n_blocks=3)
+            send.migrate(dest, statics, arrays,
+                         fingerprints=["f0", "f1"])
+            st, arr = seat.calls[0]
+            assert st["skip"] == 2
+            assert arr["blk/layer0/k"].shape[0] == 1
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_zero_have_ships_full_frame(self):
+        seat = _FakeEngineSeat()
+        recv, send, dest = self._pair(seat, index_fn=lambda fps: 0)
+        try:
+            statics, arrays = _migrate_payload(n_blocks=3)
+            info = {}
+            send.migrate(dest, statics, arrays,
+                         fingerprints=["f0", "f1"], info=info)
+            st, arr = seat.calls[0]
+            assert "skip" not in st
+            assert arr["blk/layer0/k"].shape[0] == 3
+            assert info["skipped_blocks"] == 0
+            assert send.stats()["dedup_blocks_skipped"] == 0
+            assert recv.stats()["dedup_offers"] == 1
+            assert recv.stats()["dedup_blocks_promised"] == 0
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_index_probe_failure_is_advisory(self):
+        """A crashing index probe means "ship everything", never a
+        failed migration."""
+
+        def boom(fps):
+            raise RuntimeError("index wedged")
+
+        seat = _FakeEngineSeat()
+        recv, send, dest = self._pair(seat, index_fn=boom)
+        try:
+            tokens, _ = send.migrate(dest, *_migrate_payload(),
+                                     fingerprints=["f0", "f1"])
+            assert tokens == [7, 8, 9]
+            assert seat.calls[0][1]["blk/layer0/k"].shape[0] == 3
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_legacy_receiver_memoized_and_full_migrate(self):
+        """A receiver with no dedup seam answers the offer with the
+        closed protocol's ``protocol`` error and closes: the sender
+        memoizes the peer, reconnects, and runs the classic full
+        conversation — later migrations never re-offer (observable:
+        the pooled keep-alive survives the second call)."""
+        seat = _FakeEngineSeat()
+        recv, send, dest = self._pair(seat, index_fn=None)
+        try:
+            statics, arrays = _migrate_payload(n_blocks=3)
+            tokens, _ = send.migrate(dest, statics, arrays,
+                                     fingerprints=["f0", "f1"])
+            assert tokens == [7, 8, 9]
+            assert send.stats()["legacy_peers"] == 1
+            assert send.stats()["dedup_blocks_skipped"] == 0
+            st, arr = seat.calls[0]
+            assert "skip" not in st
+            assert arr["blk/layer0/k"].shape[0] == 3
+            # second migration: no offer prologue (a re-offer would
+            # error-and-close this stream again), pooled socket reused
+            send.migrate(dest, statics, arrays,
+                         fingerprints=["f0", "f1"])
+            assert send.stats()["pooled_connections"] == 1
+            assert send.stats()["legacy_peers"] == 1
+            assert recv.stats()["migrations"] == 2
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_dedup_stale_refusal_resends_full_once(self):
+        """Eviction race: the receiver promised blocks it has since
+        lost and refuses the sliced frame with ``dedup_stale`` — the
+        sender re-sends the FULL chain once on the same live stream."""
+        seat = _StaleOnSkipSeat()
+        recv, send, dest = self._pair(seat, index_fn=lambda fps: 2)
+        try:
+            statics, arrays = _migrate_payload(n_blocks=3)
+            info = {}
+            tokens, _ = send.migrate(dest, statics, arrays,
+                                     fingerprints=["f0", "f1"],
+                                     info=info)
+            assert tokens == [7, 8, 9]
+            assert len(seat.calls) == 2
+            assert seat.calls[0][0]["skip"] == 2
+            assert "skip" not in seat.calls[1][0]
+            assert seat.calls[1][1]["blk/layer0/k"].shape[0] == 3
+            # nothing was actually skipped end-to-end
+            assert info["skipped_blocks"] == 0
+            assert send.stats()["dedup_blocks_skipped"] == 0
+            assert send.stats()["dedup_stale"] == 1
+            # the conversation completed on one connection: reusable
+            assert send.stats()["pooled_connections"] == 1
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_no_fingerprints_means_no_offer(self):
+        """The classic call shape never pays the handshake round trip
+        (and never trips a dedup-capable receiver's offer counter)."""
+        seat = _FakeEngineSeat()
+        recv, send, dest = self._pair(seat, index_fn=lambda fps: 2)
+        try:
+            send.migrate(dest, *_migrate_payload())
+            assert recv.stats()["dedup_offers"] == 0
+            assert seat.calls[0][1]["blk/layer0/k"].shape[0] == 3
+        finally:
+            send.close()
+            recv.stop()
+
+
+class TestFetch:
+    def test_round_trip(self):
+        served = {"n_blocks": 2, "v": kvxfer.PROTOCOL_VERSION}
+        blocks = {"ids": np.arange(8, dtype=np.int32),
+                  "blk/layer0/k": np.ones((2, 4, 2), np.float32)}
+        calls = []
+
+        def fetch_fn(statics, arrays):
+            calls.append((statics, arrays))
+            return served, blocks
+
+        recv = kvxfer.KvReceiver(_FakeEngineSeat(), port=0,
+                                 fetch_fn=fetch_fn)
+        send = kvxfer.KvSender()
+        try:
+            st, arr = send.fetch(
+                f"127.0.0.1:{recv.port}",
+                {"v": kvxfer.PROTOCOL_VERSION},
+                {"ids": np.arange(12, dtype=np.int32)})
+            assert st["n_blocks"] == 2
+            assert np.array_equal(arr["blk/layer0/k"],
+                                  blocks["blk/layer0/k"])
+            assert np.array_equal(calls[0][1]["ids"],
+                                  np.arange(12, dtype=np.int32))
+            assert recv.stats()["fetches"] == 1
+            assert recv.stats()["fetch_blocks_out"] == 2
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_miss_is_zero_blocks_not_error(self):
+        recv = kvxfer.KvReceiver(_FakeEngineSeat(), port=0,
+                                 fetch_fn=lambda s, a: None)
+        send = kvxfer.KvSender()
+        try:
+            st, arr = send.fetch(
+                f"127.0.0.1:{recv.port}",
+                {"v": kvxfer.PROTOCOL_VERSION},
+                {"ids": np.arange(4, dtype=np.int32)})
+            assert st["n_blocks"] == 0
+            assert not arr
+            assert recv.stats()["fetches"] == 0
+        finally:
+            send.close()
+            recv.stop()
+
+    def test_legacy_receiver_is_protocol_refusal(self):
+        """A receiver with no fetch seam answers the closed protocol's
+        error (and closed the stream behind it — the sender must not
+        pool that socket)."""
+        recv = kvxfer.KvReceiver(_FakeEngineSeat(), port=0)
+        send = kvxfer.KvSender()
+        try:
+            with pytest.raises(kvxfer.KvTransferError) as ei:
+                send.fetch(f"127.0.0.1:{recv.port}",
+                           {"v": kvxfer.PROTOCOL_VERSION},
+                           {"ids": np.arange(4, dtype=np.int32)})
+            assert ei.value.kind == "protocol"
+            assert send.stats()["pooled_connections"] == 0
         finally:
             send.close()
             recv.stop()
